@@ -1,0 +1,95 @@
+"""paddle_tpu: a TPU-native deep-learning framework.
+
+Brand-new framework with the capabilities of the PaddlePaddle reference
+(surveyed in /root/repo/SURVEY.md), designed TPU-first:
+
+ - compute path: jax/XLA (single compiled program per step, MXU-shaped
+   matmuls, bf16-first AMP) with Pallas kernels for the hot fused ops
+ - autograd: eager tape over ``jax.vjp`` for dygraph ergonomics; functional
+   ``jax.grad`` under ``to_static``/jit for the fast path
+ - distributed: ``jax.sharding.Mesh`` + GSPMD + shard_map collectives over
+   ICI/DCN replace ProcessGroup/NCCL/TCPStore wholesale
+ - runtime around the compute path (tracing, flags, IO) backed by a native
+   C++ core where the reference is native
+
+Public API mirrors ``paddle.*`` so reference users can switch directly.
+"""
+from __future__ import annotations
+
+import builtins as _builtins
+
+__version__ = "0.1.0"
+
+# -- core framework ---------------------------------------------------------
+from .framework import (  # noqa: F401
+    dtype, iinfo, finfo, get_default_dtype, set_default_dtype,
+    set_flags, get_flags,
+    seed, get_rng_state, set_rng_state,
+    CPUPlace, TPUPlace, CUDAPlace, CustomPlace, XPUPlace,
+    set_device, get_device, device_count,
+    is_compiled_with_cuda, is_compiled_with_rocm, is_compiled_with_xpu,
+    is_compiled_with_tpu, is_compiled_with_cinn,
+    is_compiled_with_custom_device,
+)
+from .framework import (  # dtype singletons  # noqa: F401
+    bool_, uint8, int8, int16, int32, int64, float16, bfloat16, float32,
+    float64, complex64, complex128, float8_e4m3fn, float8_e5m2,
+)
+bool = bool_  # paddle.bool (shadows builtin inside this namespace only)
+
+# -- tensor + autograd ------------------------------------------------------
+from .tensor import Tensor, to_tensor, is_tensor  # noqa: F401
+from .autograd import no_grad, enable_grad, set_grad_enabled, grad  # noqa: F401
+from . import autograd  # noqa: F401
+
+# -- ops (flat namespace) ---------------------------------------------------
+from .ops import *  # noqa: F401,F403
+from .ops.linalg import einsum  # noqa: F401
+
+# -- submodules (grown incrementally; see SURVEY.md §7 build order) ---------
+from . import amp  # noqa: F401
+from . import linalg  # noqa: F401
+
+
+def _optional_submodules():
+    """Import API-surface submodules that exist; grown as the build widens."""
+    import importlib
+    names = ["nn", "optimizer", "io", "jit", "device", "distributed",
+             "vision", "metric", "hapi", "profiler", "static", "incubate",
+             "sparse", "distribution", "text", "audio", "quantization",
+             "utils", "fft", "signal", "models", "callbacks", "regularizer",
+             "onnx"]
+    loaded = {}
+    for n in names:
+        try:
+            loaded[n] = importlib.import_module(f".{n}", __name__)
+        except ModuleNotFoundError as e:
+            if f"paddle_tpu.{n}" not in str(e):
+                raise
+    return loaded
+
+
+globals().update(_optional_submodules())
+
+# convenience top-level re-exports that depend on optional modules
+try:
+    from .framework.io_state import save, load  # noqa: F401
+except ImportError:
+    pass
+try:
+    from .hapi.model import Model  # noqa: F401
+    from .hapi.summary import summary, flops  # noqa: F401
+except ImportError:
+    pass
+try:
+    from .nn.layer.layers import ParamAttr  # noqa: F401
+except ImportError:
+    pass
+try:
+    from .jit.api import enable_static, disable_static, in_dynamic_mode  # noqa: F401
+except ImportError:
+    pass
+try:
+    from .distributed.parallel import DataParallel  # noqa: F401
+except ImportError:
+    pass

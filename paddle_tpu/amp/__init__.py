@@ -1,0 +1,159 @@
+"""Automatic mixed precision.
+
+TPU-native re-design of the reference AMP stack:
+ - ``auto_cast`` context (``python/paddle/amp/auto_cast.py:646``,
+   amp_guard ``:271``) with O1 white/black lists (``amp_lists.py``)
+ - ``GradScaler`` dynamic loss scaling (``grad_scaler.py:576``)
+
+TPU differences by design:
+ - default AMP dtype is **bfloat16**, which shares float32's exponent range,
+   so loss scaling is unnecessary — GradScaler is provided for API parity
+   and for float16 mode, and is a near-no-op for bf16.
+ - O2 ("pure" mode) maps to casting parameters once (`decorate`), the
+   standard TPU recipe (params in bf16, optimizer state fp32).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.dtype import to_jax_dtype
+from .grad_scaler import GradScaler, AmpScaler  # noqa: F401
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "amp_decorate", "GradScaler",
+           "AmpScaler", "is_bfloat16_supported", "is_float16_supported",
+           "white_list", "black_list"]
+
+# O1 op lists — mirrors python/paddle/amp/amp_lists.py
+WHITE_LIST = {
+    "matmul", "bmm", "einsum", "conv1d", "conv2d", "conv3d",
+    "conv2d_transpose", "linear", "mm", "mv",
+}
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "mean", "sum", "softmax",
+    "log_softmax", "cross_entropy", "softmax_with_cross_entropy",
+    "layer_norm", "norm", "cumsum", "logsumexp", "erfinv", "pow",
+    "square", "reciprocal", "rsqrt",
+}
+
+
+class _AmpState:
+    __slots__ = ("enable", "dtype", "level", "white", "black")
+
+    def __init__(self, enable, dtype, level, white, black):
+        self.enable = enable
+        self.dtype = dtype
+        self.level = level
+        self.white = white
+        self.black = black
+
+
+_tls = threading.local()
+
+
+def _current_state():
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else None
+
+
+def _cast_for_op(state, op_name, tensors):
+    from ..tensor import Tensor
+    if state.level == "O0" or op_name in state.black:
+        return tensors
+    if state.level == "O2" or op_name in state.white:
+        target = state.dtype
+        out = []
+        for t in tensors:
+            if t is None or not isinstance(t, Tensor):
+                out.append(t)
+                continue
+            d = np.dtype(t._data.dtype)
+            if (np.issubdtype(d, np.floating) or d == jnp.bfloat16) \
+                    and d != target:
+                out.append(t.astype(target))
+            else:
+                out.append(t)
+        return tuple(out)
+    return tensors
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    """``paddle.amp.auto_cast`` equivalent."""
+    if level not in ("O0", "O1", "O2"):
+        raise ValueError(f"level must be O0/O1/O2, got {level}")
+    white = set(WHITE_LIST) | set(custom_white_list or ())
+    black = (set(BLACK_LIST) | set(custom_black_list or ())) - set(
+        custom_white_list or ())
+    state = _AmpState(enable, to_jax_dtype(dtype), level, white, black)
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    stack.append(state)
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """``paddle.amp.decorate``: cast model params for pure-bf16/fp16 (O2).
+
+    Master weights: optimizers keep fp32 copies automatically when
+    ``multi_precision`` is on (the default for Adam/Momentum here), mirroring
+    the reference's master-weight machinery.
+    """
+    if level not in ("O1", "O2"):
+        raise ValueError("decorate only supports O1/O2")
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        dt = to_jax_dtype(dtype)
+        for m in model_list:
+            for p in m.parameters():
+                d = np.dtype(p._data.dtype)
+                if np.issubdtype(d, np.floating) or d == jnp.bfloat16:
+                    p._data = p._data.astype(dt)
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+amp_decorate = decorate
+
+
+def is_bfloat16_supported(device=None):
+    return True
+
+
+def is_float16_supported(device=None):
+    return True
+
+
+def white_list():
+    return {"bfloat16": {"O1": sorted(WHITE_LIST)},
+            "float16": {"O1": sorted(WHITE_LIST)}}
+
+
+def black_list():
+    return {"bfloat16": {"O1": sorted(BLACK_LIST)},
+            "float16": {"O1": sorted(BLACK_LIST)}}
+
+
+# debugging helpers (ref: python/paddle/amp/debugging.py)
+def check_numerics(x, op_name="", debug_mode=None):
+    import jax
+    from ..tensor import Tensor
+    if isinstance(x, Tensor) and not isinstance(x._data, jax.core.Tracer):
+        bad = bool(jnp.any(~jnp.isfinite(x._data.astype(jnp.float32))))
+        if bad:
+            raise FloatingPointError(f"non-finite values after {op_name}")
+    return x

@@ -1,0 +1,134 @@
+"""Dynamic loss scaling (ref: ``python/paddle/amp/grad_scaler.py:576``).
+
+On TPU with bf16 AMP, scaling is mathematically unnecessary (bf16 has fp32's
+exponent); the scaler then degenerates to a pass-through that still tracks
+found_inf for parity. With float16 it performs real dynamic scaling.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = ["GradScaler", "AmpScaler", "OptimizerState"]
+
+
+class OptimizerState:
+    INIT, UNSCALED, STEPPED = 0, 1, 2
+
+
+class AmpScaler:
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 16,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        self._use_dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._opt_states = {}
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._use_dynamic
+
+    def get_scale_ratio(self):
+        return self._scale
+
+    # paddle API names
+    def is_enabled(self):
+        return self._enable
+
+    def scale(self, var):
+        from ..ops.math import multiply
+        if not self._enable:
+            return var
+        return multiply(var, self._scale)
+
+    def _check_grads(self, optimizer):
+        found = False
+        for p in optimizer._parameter_list:
+            if p.grad is None:
+                continue
+            g = p.grad._data
+            if bool(jnp.any(~jnp.isfinite(g.astype(jnp.float32)))):
+                found = True
+                break
+        self._found_inf = found
+        return found
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        if self._opt_states.get(id(optimizer)) == OptimizerState.UNSCALED:
+            return
+        inv = 1.0 / self._scale
+        for p in optimizer._parameter_list:
+            if p.grad is not None:
+                p.grad._data = p.grad._data * np.asarray(
+                    inv, dtype=np.float32).astype(p.grad._data.dtype)
+        self._opt_states[id(optimizer)] = OptimizerState.UNSCALED
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._check_grads(optimizer):
+            optimizer.step()
+        self._opt_states[id(optimizer)] = OptimizerState.STEPPED
+
+    def update(self):
+        if not (self._enable and self._use_dynamic):
+            self._opt_states.clear()
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n_nan_or_inf:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._opt_states.clear()
+
+    def minimize(self, optimizer, loss, **kwargs):
+        self.step(optimizer)
+        self.update()
+
+    # state io
+    def state_dict(self):
+        return {
+            "scale": self._scale, "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio,
+            "incr_every_n_steps": self._incr_every_n_steps,
+            "decr_every_n_nan_or_inf": self._decr_every_n_nan_or_inf,
+            "good_steps": self._good_steps, "bad_steps": self._bad_steps,
+            "use_dynamic_loss_scaling": self._use_dynamic,
+        }
+
+    def load_state_dict(self, state):
+        self._scale = state["scale"]
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+
+class GradScaler(AmpScaler):
+    """paddle.amp.GradScaler."""
+    pass

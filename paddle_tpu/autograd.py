@@ -1,0 +1,434 @@
+"""Eager autograd engine.
+
+TPU-native re-design of the reference's dygraph autograd:
+ - grad-node graph + queue-based backward walk:
+   ``paddle/fluid/eager/backward.cc:104 RunBackward``,
+   ``paddle/fluid/eager/grad_node_info.h:168 GradNodeBase``
+ - per-op capture: the reference *code-generates* a GradNode class per op
+   (``eager/auto_code_generator/generator/eager_gen.py:960``); here a single
+   generic tape node captures ``jax.vjp`` of the op's pure function — JAX's
+   tracing IS the code generator, so there is nothing to generate.
+
+Key property: ``jax.vjp(fn, *primals)`` runs the forward exactly once on
+device and returns a host-side closure over the residuals, so eager mode pays
+no double-compute for recording gradients. Under ``to_static``/jit tracing the
+tape is bypassed (`functional_guard`) and gradients come from functional
+``jax.grad`` over the whole step — the fast path.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import threading
+import weakref
+from collections import deque
+
+import numpy as np
+import jax
+
+from .framework import flags as _flags
+
+__all__ = [
+    "no_grad", "enable_grad", "set_grad_enabled", "is_grad_enabled",
+    "backward", "grad", "PyLayer", "PyLayerContext",
+]
+
+_state = threading.local()
+
+
+def is_grad_enabled() -> bool:
+    return getattr(_state, "enabled", True)
+
+
+def _set_enabled(v: bool):
+    _state.enabled = v
+
+
+def in_functional_mode() -> bool:
+    """True while tracing a functional (jit) program — tape disabled."""
+    return getattr(_state, "functional", 0) > 0
+
+
+@contextlib.contextmanager
+def functional_guard():
+    _state.functional = getattr(_state, "functional", 0) + 1
+    try:
+        yield
+    finally:
+        _state.functional -= 1
+
+
+class _GradCtx:
+    """Context manager / decorator toggling grad recording (paddle.no_grad)."""
+
+    def __init__(self, enable: bool):
+        self._enable = enable
+
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        _set_enabled(self._enable)
+        return self
+
+    def __exit__(self, *exc):
+        _set_enabled(self._prev)
+        return False
+
+    def __call__(self, fn):
+        enable = self._enable
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with _GradCtx(enable):
+                return fn(*args, **kwargs)
+        return wrapper
+
+
+class no_grad(_GradCtx):
+    def __init__(self):
+        super().__init__(False)
+
+
+class enable_grad(_GradCtx):
+    def __init__(self):
+        super().__init__(True)
+
+
+class set_grad_enabled(_GradCtx):
+    def __init__(self, mode: bool):
+        super().__init__(bool(mode))
+
+
+class Node:
+    """One recorded op on the tape.
+
+    inputs:  Tensors the op consumed (strong refs keep the graph alive as
+             long as any output lives — same lifetime rule as the
+             reference's shared_ptr grad-node chain).
+    vjp_fn:  jax-produced pullback closure over device residuals.
+    outputs: weakrefs to produced Tensors (to locate incoming cotangents).
+    """
+
+    __slots__ = ("inputs", "vjp_fn", "out_refs", "out_avals", "name",
+                 "_hooks", "__weakref__")
+
+    def __init__(self, inputs, vjp_fn, outputs, name=""):
+        self.inputs = list(inputs)
+        self.vjp_fn = vjp_fn
+        self.out_refs = [weakref.ref(t) for t in outputs]
+        self.out_avals = [(t.shape, t._data.dtype) for t in outputs]
+        self.name = name
+        self._hooks = None
+
+    def release(self):
+        self.vjp_fn = None
+        self.inputs = []
+
+
+def record(fn, tensors, outputs_wrap, name=""):
+    """Run `fn(*datas)` with optional tape capture.
+
+    fn: pure function over raw jax arrays returning array or tuple of arrays.
+    tensors: Tensor inputs in fn arg order.
+    outputs_wrap: callable(raw_out, requires_grad) -> (tensors_list, result)
+    """
+    datas = tuple(t._data for t in tensors)
+    needs_grad = (
+        is_grad_enabled()
+        and not in_functional_mode()
+        and any(not t.stop_gradient for t in tensors)
+    )
+    if needs_grad:
+        raw, vjp_fn = jax.vjp(fn, *datas)
+    else:
+        raw, vjp_fn = fn(*datas), None
+    out_tensors, result = outputs_wrap(raw, needs_grad)
+    if needs_grad:
+        node = Node(tensors, vjp_fn, out_tensors, name=name)
+        for i, t in enumerate(out_tensors):
+            t._node = node
+            t._out_idx = i
+    if _flags.flag("check_nan_inf"):
+        _check_nan_inf(out_tensors, name)
+    return result
+
+
+def _check_nan_inf(tensors, name):
+    """FLAGS_check_nan_inf analog (ref: paddle/fluid/eager/nan_inf_utils.cc)."""
+    import jax.numpy as jnp
+    for t in tensors:
+        d = t._data
+        if isinstance(d, jax.core.Tracer):
+            continue
+        if np.issubdtype(np.dtype(d.dtype), np.floating) or d.dtype == jnp.bfloat16:
+            if bool(jnp.any(~jnp.isfinite(d))):
+                raise FloatingPointError(
+                    f"NaN/Inf detected in output of op '{name or 'unknown'}'")
+
+
+def _zero_cot(shape, dt):
+    if np.issubdtype(np.dtype(dt), np.integer) or np.dtype(dt) == np.bool_:
+        return np.zeros(shape, dtype=jax.dtypes.float0)
+    import jax.numpy as jnp
+    return jnp.zeros(shape, dtype=dt)
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """Queue-based reverse walk over the tape.
+
+    Mirrors ``egr::RunBackward`` (``backward.cc:104``): seed cotangents,
+    count consumer edges per node, process nodes whose consumers are all
+    done, accumulate into leaf ``.grad``.
+    """
+    import jax.numpy as jnp
+    from .tensor import Tensor
+
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+
+    # cotangent accumulator keyed by tensor identity
+    cots: dict[int, object] = {}
+    keep: dict[int, object] = {}  # keep tensors alive during walk
+
+    def accum(t, g):
+        if g is None or isinstance(g, np.ndarray) and g.dtype == jax.dtypes.float0:
+            return
+        k = id(t)
+        keep[k] = t
+        if k in cots:
+            cots[k] = cots[k] + g
+        else:
+            cots[k] = g
+
+    roots = []
+    for t, g in zip(tensors, grad_tensors):
+        if t._node is None and t.stop_gradient:
+            continue
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {t.shape}")
+            g = jnp.ones_like(t._data)
+        else:
+            g = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        if t._node is not None:
+            accum(t, g)
+            roots.append(t._node)
+        else:
+            # root IS a leaf: its seed gradient goes straight to .grad
+            _leaf_accum(t, g)
+
+    # reachable node set
+    reach: set[int] = set()
+    nodes: dict[int, Node] = {}
+    stack = list(roots)
+    while stack:
+        n = stack.pop()
+        if id(n) in reach:
+            continue
+        reach.add(id(n))
+        nodes[id(n)] = n
+        for t in n.inputs:
+            if t._node is not None:
+                stack.append(t._node)
+
+    # consumer edge counts
+    pending: dict[int, int] = {k: 0 for k in reach}
+    for n in nodes.values():
+        seen_producers = set()
+        for t in n.inputs:
+            p = t._node
+            if p is not None and id(p) in reach:
+                # one edge per (consumer, input-tensor) occurrence
+                pending[id(p)] += 1
+            del p
+        del seen_producers
+
+    # A node is initially ready iff no reachable node consumes its outputs.
+    ready = deque(n for k, n in nodes.items() if pending[k] == 0)
+    processed = set()
+    while ready:
+        n = ready.popleft()
+        if id(n) in processed:
+            continue
+        processed.add(id(n))
+        # gather cotangents for this node's outputs
+        out_cots = []
+        for ref, (shape, dt) in zip(n.out_refs, n.out_avals):
+            t = ref()
+            g = cots.pop(id(t), None) if t is not None else None
+            if g is None:
+                g = _zero_cot(shape, dt)
+            out_cots.append(g)
+        cot_in = out_cots[0] if len(out_cots) == 1 else tuple(out_cots)
+        if n.vjp_fn is None:
+            raise RuntimeError(
+                "Trying to backward through the graph a second time; "
+                "set retain_graph=True if you need to.")
+        in_grads = n.vjp_fn(cot_in)
+        if n._hooks:
+            in_grads = list(in_grads)
+            for i, h in n._hooks:
+                in_grads[i] = h(in_grads[i])
+        for t, g in zip(n.inputs, in_grads):
+            # a float0 cotangent (int-dtype input) carries no gradient, but
+            # the consumer edge must still be counted down or the producer
+            # node never becomes ready and valid sibling paths are dropped
+            is_f0 = isinstance(g, np.ndarray) and g.dtype == jax.dtypes.float0
+            if t._node is None:
+                if not t.stop_gradient and not is_f0:
+                    _leaf_accum(t, g)
+            else:
+                if not is_f0:
+                    accum(t, g)
+                p = t._node
+                if id(p) in reach:
+                    pending[id(p)] -= 1
+                    if pending[id(p)] == 0:
+                        ready.append(p)
+        if not retain_graph:
+            n.release()
+
+
+def _leaf_accum(t, g):
+    import jax.numpy as jnp
+    from .tensor import Tensor
+    capture = getattr(_state, "leaf_capture", None)
+    if capture is not None:
+        # scoped backward (paddle.grad): only capture requested leaves,
+        # never touch .grad of anything else
+        table, allowed = capture
+        if id(t) in allowed:
+            prev = table.get(id(t))
+            table[id(t)] = g if prev is None else prev + g
+        return
+    g = jnp.asarray(g)
+    if g.dtype != t._data.dtype:
+        g = g.astype(t._data.dtype)
+    if t._grad is None:
+        t._grad = Tensor(g, stop_gradient=True)
+    else:
+        t._grad._data = t._grad._data + g
+    if t._grad_hooks:
+        for h in t._grad_hooks.values():
+            out = h(t._grad)
+            if out is not None:
+                t._grad = out
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """``paddle.grad`` equivalent: returns grads of `outputs` w.r.t `inputs`
+    without touching ``.grad`` accumulators.
+
+    Implemented as a scoped backward: leaf accumulation is redirected to a
+    side table covering ONLY `inputs`, so no tensor's ``.grad`` (including
+    model parameters reachable from `outputs`) is touched. ``create_graph``
+    (higher-order) is supported through the functional path only
+    (use ``paddle_tpu.incubate.autograd``).
+    """
+    from .tensor import Tensor
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True in eager tape mode is not supported; use the "
+            "functional API (paddle_tpu.incubate.autograd.grad) which "
+            "composes jax.grad.")
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    sg = [(t, t.stop_gradient) for t in inputs]
+    table: dict[int, object] = {}
+    _state.leaf_capture = (table, {id(t) for t in inputs})
+    try:
+        for t in inputs:
+            t.stop_gradient = False
+        backward(outputs, grad_tensors=grad_outputs,
+                 retain_graph=bool(retain_graph))
+        results = []
+        for t in inputs:
+            g = table.get(id(t))
+            if g is None:
+                if not allow_unused:
+                    raise RuntimeError(
+                        "One of the differentiated tensors appears unused; "
+                        "pass allow_unused=True to return None for it.")
+                results.append(None)
+            else:
+                results.append(Tensor(g, stop_gradient=True))
+        return results
+    finally:
+        _state.leaf_capture = None
+        for t, s in sg:
+            t.stop_gradient = s
+
+
+class PyLayerContext:
+    """Saved-tensor context for custom ops (ref:
+    ``paddle/fluid/eager/pylayer``, python ``paddle.autograd.PyLayer``)."""
+
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    # paddle also exposes it as a method
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayer:
+    """User-defined differentiable op with explicit forward/backward.
+
+    Subclass and define ``forward(ctx, *args)`` and ``backward(ctx, *grads)``
+    as staticmethods operating on Tensors, then call ``MyOp.apply(...)``.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from .tensor import Tensor
+        ctx = PyLayerContext()
+        tensor_args = [a for a in args if isinstance(a, Tensor)]
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(out, (list, tuple))
+        outs = list(out) if multi else [out]
+        needs = (is_grad_enabled() and not in_functional_mode()
+                 and any(not t.stop_gradient for t in tensor_args))
+        if needs:
+            def vjp_fn(cot):
+                cots = list(cot) if multi else [cot]
+                cot_tensors = [Tensor(c, stop_gradient=True) for c in cots]
+                with no_grad():
+                    gin = cls.backward(ctx, *cot_tensors)
+                if not isinstance(gin, (list, tuple)):
+                    gin = (gin,)
+                return tuple(
+                    (g._data if isinstance(g, Tensor) else g) if g is not None
+                    else np.zeros(t.shape, dtype=jax.dtypes.float0)
+                    for g, t in zip(gin, tensor_args))
+
+            for t in outs:
+                t.stop_gradient = False
+            node = Node(tensor_args, vjp_fn, outs, name=cls.__name__)
+            for i, t in enumerate(outs):
+                t._node = node
+                t._out_idx = i
+        return out if multi else outs[0]

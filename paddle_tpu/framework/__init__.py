@@ -1,0 +1,24 @@
+"""Core framework layer: dtypes, devices, flags, RNG.
+
+The TPU-native analog of the reference's ``paddle/phi/core`` +
+``paddle/fluid/platform`` glue, minus everything XLA subsumes (allocators,
+streams, kernel registry).
+"""
+from .dtype import (  # noqa: F401
+    DType, dtype, convert_dtype, to_jax_dtype, get_default_dtype,
+    set_default_dtype, default_jax_dtype, iinfo, finfo,
+    bool_, uint8, int8, int16, int32, int64, float16, bfloat16, float32,
+    float64, complex64, complex128, float8_e4m3fn, float8_e5m2,
+)
+from .flags import set_flags, get_flags, define_flag, flag  # noqa: F401
+from .random import (  # noqa: F401
+    seed, get_rng_state, set_rng_state, default_generator, next_key,
+    RNGStatesTracker, get_tracker, rng_state_guard,
+)
+from .device import (  # noqa: F401
+    Place, CPUPlace, TPUPlace, CUDAPlace, CustomPlace, XPUPlace,
+    set_device, get_device, get_all_devices, device_count,
+    is_compiled_with_cuda, is_compiled_with_rocm, is_compiled_with_xpu,
+    is_compiled_with_tpu, is_compiled_with_cinn,
+    is_compiled_with_custom_device, device_guard, get_jax_device,
+)

@@ -1,0 +1,188 @@
+"""Device and place management.
+
+TPU-native replacement for the reference's device layer:
+ - ``phi::Place`` / ``CUDAPlace`` / ``CPUPlace`` (``paddle/phi/common/place.h``)
+ - ``phi::DeviceManager`` enumeration (``paddle/phi/backends/device_manager.h:128``)
+ - ``paddle.set_device`` (``python/paddle/device/__init__.py``)
+
+On TPU, device enumeration comes from the PJRT client via ``jax.devices()``;
+"place" maps to a jax Device, and a `device_guard` maps to
+``jax.default_device``. There are no user-visible streams: XLA owns ordering
+(the reference's stream/event machinery — ``paddle/phi/backends/stream.h`` —
+is subsumed by the compiler's async scheduling).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+__all__ = [
+    "Place", "CPUPlace", "TPUPlace", "CUDAPlace", "CustomPlace", "XPUPlace",
+    "set_device", "get_device", "get_all_devices", "device_count",
+    "is_compiled_with_cuda", "is_compiled_with_rocm", "is_compiled_with_xpu",
+    "is_compiled_with_tpu", "is_compiled_with_cinn",
+    "is_compiled_with_custom_device", "device_guard", "get_jax_device",
+]
+
+
+class Place:
+    """Base place: (device_type, index)."""
+
+    device_type = "undefined"
+
+    def __init__(self, index: int = 0):
+        self._index = int(index)
+
+    def get_device_id(self) -> int:
+        return self._index
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self._index})"
+
+    def __eq__(self, other):
+        return (isinstance(other, Place)
+                and other.device_type == self.device_type
+                and other._index == self._index)
+
+    def __hash__(self):
+        return hash((self.device_type, self._index))
+
+    def jax_device(self):
+        devs = [d for d in jax.devices() if _platform_matches(d, self.device_type)]
+        if not devs:
+            if self.device_type == "cpu":
+                return jax.devices("cpu")[0]
+            raise RuntimeError(f"No {self.device_type} device available")
+        return devs[min(self._index, len(devs) - 1)]
+
+    def is_cpu_place(self):
+        return self.device_type == "cpu"
+
+    def is_gpu_place(self):
+        return False
+
+    def is_tpu_place(self):
+        return self.device_type == "tpu"
+
+
+def _platform_matches(dev, device_type: str) -> bool:
+    plat = dev.platform
+    if device_type == "tpu":
+        # 'axon'-tunnelled TPUs report a vendor platform name; treat any
+        # non-cpu accelerator as the tpu place.
+        return plat != "cpu"
+    return plat == device_type
+
+
+class CPUPlace(Place):
+    device_type = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
+
+
+class TPUPlace(Place):
+    device_type = "tpu"
+
+
+# Parity aliases: reference scripts say CUDAPlace; on this framework the
+# accelerator is the TPU.
+class CUDAPlace(TPUPlace):
+    pass
+
+
+class XPUPlace(TPUPlace):
+    pass
+
+
+class CustomPlace(Place):
+    def __init__(self, device_type="tpu", index=0):
+        super().__init__(index)
+        self.device_type = device_type
+
+
+_current_device: str | None = None
+
+
+def _default_device_str() -> str:
+    try:
+        d = jax.devices()[0]
+        return "cpu" if d.platform == "cpu" else f"tpu:{d.id}"
+    except RuntimeError:
+        return "cpu"
+
+
+def set_device(device: str):
+    """``paddle.set_device``: 'cpu', 'tpu', 'tpu:0' (also accepts 'gpu' as a
+    parity alias for the accelerator)."""
+    global _current_device
+    device = device.lower().replace("gpu", "tpu").replace("xpu", "tpu")
+    if device in ("tpu", "cpu"):
+        device += ":0"
+    kind, _, idx = device.partition(":")
+    if kind not in ("cpu", "tpu"):
+        raise ValueError(f"Unknown device {device!r}")
+    place = CPUPlace() if kind == "cpu" else TPUPlace(int(idx or 0))
+    jax.config.update("jax_default_device", place.jax_device())
+    _current_device = f"{kind}:{idx or 0}" if kind != "cpu" else "cpu"
+    return place
+
+
+def get_device() -> str:
+    return _current_device or _default_device_str()
+
+
+def get_all_devices():
+    return [("cpu" if d.platform == "cpu" else f"tpu:{d.id}") for d in jax.devices()]
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+def get_jax_device(place=None):
+    if place is None:
+        dev = get_device()
+        kind, _, idx = dev.partition(":")
+        place = CPUPlace() if kind == "cpu" else TPUPlace(int(idx or 0))
+    elif isinstance(place, str):
+        kind, _, idx = place.lower().replace("gpu", "tpu").partition(":")
+        place = CPUPlace() if kind == "cpu" else TPUPlace(int(idx or 0))
+    return place.jax_device()
+
+
+@contextlib.contextmanager
+def device_guard(device: str):
+    """Scoped default device (ref: ``paddle.static.device_guard``)."""
+    prev = get_device()
+    set_device(device)
+    try:
+        yield
+    finally:
+        set_device(prev)
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_cinn() -> bool:
+    # XLA plays CINN's role and is always present.
+    return True
+
+
+def is_compiled_with_tpu() -> bool:
+    return any(d.platform != "cpu" for d in jax.devices())
+
+
+def is_compiled_with_custom_device(device_type: str = "tpu") -> bool:
+    return True

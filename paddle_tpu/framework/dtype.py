@@ -1,0 +1,191 @@
+"""Dtype system.
+
+TPU-native re-design of the reference dtype machinery
+(``paddle/phi/common/data_type.h`` and the pybind'd ``paddle.dtype`` enum).
+Rather than an enum dispatched through a kernel registry, dtypes here are thin
+wrappers over numpy/jax dtypes that flow straight into XLA.
+
+Notes on TPU policy:
+ - 64-bit types are *accepted* at the API surface but canonicalised to their
+   32-bit counterparts (JAX x64-disabled mode), which is the right default on
+   TPU: the MXU natively computes in bf16/f32 and 64-bit integer indexing is
+   never needed for on-chip shapes.
+ - ``bfloat16`` is a first-class citizen (the AMP default), unlike the
+   reference where fp16 is primary (``python/paddle/amp/auto_cast.py``).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes
+
+__all__ = [
+    "DType", "dtype", "convert_dtype", "to_jax_dtype",
+    "get_default_dtype", "set_default_dtype", "iinfo", "finfo",
+    "bool_", "uint8", "int8", "int16", "int32", "int64",
+    "float16", "bfloat16", "float32", "float64", "complex64", "complex128",
+    "float8_e4m3fn", "float8_e5m2",
+]
+
+
+class DType:
+    """A framework dtype: named wrapper around a canonical numpy dtype.
+
+    Mirrors the surface of the reference's ``paddle.dtype`` (repr, equality
+    with strings / numpy dtypes) without the VarType protobuf enum behind it.
+    """
+
+    __slots__ = ("name", "np_dtype", "_canonical_name")
+
+    def __init__(self, name: str, np_dtype, canonical_name: str | None = None):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype)
+        # what this dtype canonicalises to under TPU (x64-disabled) policy
+        self._canonical_name = canonical_name or name
+
+    # -- identity ----------------------------------------------------------
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __hash__(self):
+        return hash(self.name)
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.name == other.name
+        if isinstance(other, str):
+            return other in (self.name, f"paddle_tpu.{self.name}")
+        try:
+            return np.dtype(other) == self.np_dtype
+        except TypeError:
+            return NotImplemented
+
+    def __ne__(self, other):
+        r = self.__eq__(other)
+        return r if r is NotImplemented else not r
+
+    # -- properties --------------------------------------------------------
+    @property
+    def itemsize(self):
+        return self.np_dtype.itemsize
+
+    @property
+    def is_floating_point(self):
+        return np.issubdtype(self.np_dtype, np.floating) or self.np_dtype in (
+            np.dtype(ml_dtypes.bfloat16),
+            np.dtype(ml_dtypes.float8_e4m3fn),
+            np.dtype(ml_dtypes.float8_e5m2),
+        )
+
+    @property
+    def is_integer(self):
+        return np.issubdtype(self.np_dtype, np.integer)
+
+    @property
+    def is_complex(self):
+        return np.issubdtype(self.np_dtype, np.complexfloating)
+
+
+# Canonical dtype singletons ------------------------------------------------
+bool_ = DType("bool", np.bool_)
+uint8 = DType("uint8", np.uint8)
+int8 = DType("int8", np.int8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64, canonical_name="int32")
+float16 = DType("float16", np.float16)
+bfloat16 = DType("bfloat16", ml_dtypes.bfloat16)
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64, canonical_name="float32")
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128, canonical_name="complex64")
+float8_e4m3fn = DType("float8_e4m3fn", ml_dtypes.float8_e4m3fn)
+float8_e5m2 = DType("float8_e5m2", ml_dtypes.float8_e5m2)
+
+_ALL = [bool_, uint8, int8, int16, int32, int64, float16, bfloat16, float32,
+        float64, complex64, complex128, float8_e4m3fn, float8_e5m2]
+_BY_NAME = {d.name: d for d in _ALL}
+_BY_NAME["bool_"] = bool_
+_BY_NAME["float"] = float32
+_BY_NAME["double"] = float64
+_BY_NAME["half"] = float16
+_BY_NAME["int"] = int32
+_BY_NAME["long"] = int64
+_BY_NP = {}
+for _d in _ALL:
+    _BY_NP.setdefault(_d.np_dtype, _d)
+
+
+def dtype(obj) -> DType:
+    """Coerce anything dtype-like to a framework DType."""
+    if isinstance(obj, DType):
+        return obj
+    if isinstance(obj, str):
+        name = obj.replace("paddle_tpu.", "").replace("paddle.", "")
+        if name in _BY_NAME:
+            return _BY_NAME[name]
+    npd = np.dtype(obj)
+    if npd in _BY_NP:
+        return _BY_NP[npd]
+    raise TypeError(f"Unsupported dtype: {obj!r}")
+
+
+def to_jax_dtype(obj):
+    """Framework/str/numpy dtype -> jax-canonical numpy dtype (x64 policy)."""
+    d = dtype(obj)
+    return np.dtype(_BY_NAME[d._canonical_name].np_dtype)
+
+
+def convert_dtype(obj) -> str:
+    """Dtype-like -> canonical name string (reference:
+    ``python/paddle/fluid/data_feeder.py convert_dtype``)."""
+    return dtype(obj).name
+
+
+_default_dtype = float32
+
+
+def set_default_dtype(d):
+    """Set default floating dtype for tensor creation (``paddle.set_default_dtype``)."""
+    global _default_dtype
+    d = dtype(d)
+    if not d.is_floating_point:
+        raise TypeError(f"default dtype must be floating point, got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype() -> str:
+    return _default_dtype.name
+
+
+def default_jax_dtype():
+    return to_jax_dtype(_default_dtype)
+
+
+class iinfo:
+    """``paddle.iinfo`` equivalent."""
+
+    def __init__(self, d):
+        info = np.iinfo(dtype(d).np_dtype)
+        self.min, self.max, self.bits = info.min, info.max, info.bits
+        self.dtype = convert_dtype(d)
+
+
+class finfo:
+    """``paddle.finfo`` equivalent (supports bfloat16/fp8 via ml_dtypes)."""
+
+    def __init__(self, d):
+        info = ml_dtypes.finfo(dtype(d).np_dtype)
+        self.min = float(info.min)
+        self.max = float(info.max)
+        self.eps = float(info.eps)
+        self.tiny = float(info.tiny)
+        self.smallest_normal = float(info.smallest_normal)
+        self.resolution = float(info.resolution)
+        self.bits = info.bits
+        self.dtype = convert_dtype(d)
+
+
+def result_dtype(*arrs):
+    """Promotion helper used by binary ops."""
+    return jnp.result_type(*arrs)

@@ -1,0 +1,116 @@
+"""Global flag registry.
+
+TPU-native re-design of the reference's gflags-backed flag system
+(``paddle/phi/core/flags.cc`` defines 91 ``PHI_DEFINE_EXPORTED_*`` flags;
+Python access via ``paddle.set_flags/get_flags`` in
+``python/paddle/fluid/framework.py:7472``).
+
+Here the registry is a typed python dict with an env-var override layer
+(``FLAGS_<name>``), mirrored into the native runtime core when it is loaded
+(see ``paddle_tpu/core``). Flags that only make sense on CUDA are accepted
+but inert, so reference-style scripts keep working.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable
+
+__all__ = ["define_flag", "set_flags", "get_flags", "flag"]
+
+
+@dataclass
+class _Flag:
+    name: str
+    default: Any
+    type: type
+    help: str
+    on_change: Callable[[Any], None] | None = None
+
+
+_registry: dict[str, _Flag] = {}
+_values: dict[str, Any] = {}
+_lock = threading.Lock()
+
+
+def _parse(typ, raw):
+    if typ is bool:
+        if isinstance(raw, str):
+            return raw.lower() in ("1", "true", "yes", "on")
+        return bool(raw)
+    return typ(raw)
+
+
+def define_flag(name: str, default, help: str = "", type_=None,
+                on_change: Callable[[Any], None] | None = None):
+    typ = type_ or type(default)
+    with _lock:
+        _registry[name] = _Flag(name, default, typ, help, on_change)
+        env = os.environ.get(f"FLAGS_{name}")
+        _values[name] = _parse(typ, env) if env is not None else default
+    return _values[name]
+
+
+def set_flags(flags: dict):
+    """``paddle.set_flags`` equivalent. Unknown flags are registered on the
+    fly (the reference tolerates vendor-specific flags the same way)."""
+    if not isinstance(flags, dict):
+        raise TypeError("set_flags expects a dict of {name: value}")
+    for name, value in flags.items():
+        name = name.removeprefix("FLAGS_")
+        with _lock:
+            f = _registry.get(name)
+            if f is None:
+                f = _Flag(name, value, type(value), "(runtime-defined)")
+                _registry[name] = f
+            _values[name] = _parse(f.type, value)
+        if f.on_change is not None:
+            f.on_change(_values[name])
+
+
+def get_flags(flags) -> dict:
+    """``paddle.get_flags`` equivalent; accepts a name or list of names."""
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for name in flags:
+        key = name.removeprefix("FLAGS_")
+        if key not in _values:
+            raise ValueError(f"Unknown flag: {name}")
+        out[name] = _values[key]
+    return out
+
+
+def flag(name: str, default=None):
+    """Fast internal read."""
+    return _values.get(name, default)
+
+
+# -- Core flags (TPU-meaningful subset of paddle/phi/core/flags.cc) ---------
+define_flag("check_nan_inf", False,
+            "Scan op outputs for NaN/Inf after every eager op "
+            "(ref: paddle/fluid/eager/nan_inf_utils.cc)")
+define_flag("benchmark", False, "Synchronize after every eager op for timing")
+def _set_matmul_precision(v):
+    import jax
+    jax.config.update("jax_default_matmul_precision",
+                      None if v in ("default", "") else v)
+
+
+define_flag("tpu_matmul_precision", "default",
+            "XLA matmul precision: default (bf16 passes on MXU) | "
+            "float32|tensorfloat32|bfloat16_3x|highest "
+            "(ref analog: FLAGS_gemm_use_half_precision_compute_type)",
+            on_change=_set_matmul_precision)
+define_flag("log_level", 0, "VLOG-style verbosity for the python runtime")
+define_flag("use_stream_safe_cuda_allocator", True, "inert on TPU (parity)")
+define_flag("allocator_strategy", "auto_growth", "inert on TPU (parity)")
+define_flag("eager_delete_tensor_gb", 0.0, "inert on TPU (parity)")
+define_flag("cudnn_deterministic", False,
+            "Maps to XLA deterministic ops on TPU where applicable")
+define_flag("embedding_deterministic", 0, "inert on TPU (parity)")
+define_flag("flash_attn_version", 2, "Select pallas flash-attention version")
+define_flag("use_pallas_kernels", True,
+            "Use hand-written Pallas TPU kernels where available "
+            "(flash attention etc.); pure-XLA fallback otherwise")

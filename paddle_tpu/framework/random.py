@@ -1,0 +1,150 @@
+"""Random state management.
+
+The reference uses per-device stateful Philox generators
+(``paddle/phi/core/generator.cc``; python ``paddle.seed``). On TPU the
+idiomatic design is counter-based splitting of a functional threefry key —
+stateful mutation does not compose with jit/pjit.
+
+Design: a global `Generator` holds a root jax PRNG key and a fold counter.
+Eager ops draw fresh keys by folding the counter (cheap, traceable); jitted
+code should thread keys explicitly or use `rng_state_guard` /
+`RNGStatesTracker` (the TP-dropout tracker, re-designed from
+``fleet/meta_parallel/parallel_layers/random.py:34 RNGStatesTracker``).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import numpy as np
+
+__all__ = ["seed", "get_rng_state", "set_rng_state", "default_generator",
+           "Generator", "next_key", "RNGStatesTracker", "get_tracker",
+           "rng_state_guard"]
+
+
+class Generator:
+    """Counter-based key generator; `state` is (seed, counter).
+
+    The root key is materialised lazily: importing the framework must never
+    initialise the PJRT backend (the reference has the same rule — device
+    init happens on first op, ``paddle/fluid/platform/init.cc``).
+    """
+
+    def __init__(self, seed_: int = 0):
+        self._lock = threading.Lock()
+        self.manual_seed(seed_)
+
+    def manual_seed(self, seed_: int):
+        self._seed = int(seed_) & 0xFFFFFFFFFFFFFFFF
+        self._root = None  # lazily created on first draw
+        self._counter = 0
+        return self
+
+    def _root_key(self):
+        if self._root is None:
+            self._root = jax.random.key(self._seed)
+        return self._root
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self, n: int | None = None):
+        """Draw `n` fresh keys (or one if n is None)."""
+        with self._lock:
+            c = self._counter
+            self._counter += (n or 1)
+            root = self._root_key()
+        if n is None:
+            return jax.random.fold_in(root, c)
+        return jax.vmap(lambda i: jax.random.fold_in(root, i))(
+            np.arange(c, c + n, dtype=np.uint32))
+
+    def get_state(self):
+        return (self._seed, self._counter)
+
+    def set_state(self, state):
+        self._seed = int(state[0])
+        self._root = None
+        self._counter = int(state[1])
+
+
+default_generator = Generator(0)
+
+
+def seed(s: int) -> Generator:
+    """``paddle.seed`` equivalent: reseed the global generator."""
+    return default_generator.manual_seed(s)
+
+
+def next_key(n=None):
+    return default_generator.next_key(n)
+
+
+def get_rng_state():
+    return default_generator.get_state()
+
+
+def set_rng_state(state):
+    default_generator.set_state(state)
+
+
+@contextlib.contextmanager
+def rng_state_guard(seed_: int):
+    """Run a block under a temporary deterministic RNG state."""
+    old = default_generator.get_state()
+    default_generator.manual_seed(seed_)
+    try:
+        yield
+    finally:
+        default_generator.set_state(old)
+
+
+class RNGStatesTracker:
+    """Named RNG states for model-parallel dropout.
+
+    Re-design of the reference tracker
+    (``fleet/meta_parallel/parallel_layers/random.py:34``): tensor-parallel
+    regions need dropout masks that *differ* across mp ranks for partitioned
+    activations but *match* for replicated ones. Here each named state is an
+    independent fold counter over a seed; the mp axis offset is folded in at
+    mesh-aware call sites.
+    """
+
+    def __init__(self):
+        self.states_: dict[str, Generator] = {}
+
+    def reset(self):
+        self.states_.clear()
+
+    def add(self, name: str, seed_: int):
+        if name in self.states_:
+            raise ValueError(f"rng state {name} already exists")
+        self.states_[name] = Generator(seed_)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = "global_seed"):
+        global default_generator
+        if name not in self.states_:
+            raise ValueError(f"rng state {name} does not exist")
+        prev = default_generator
+        default_generator = self.states_[name]
+        try:
+            yield
+        finally:
+            default_generator = prev
+
+    def get_states_tracker(self):
+        return {k: g.get_state() for k, g in self.states_.items()}
+
+    def set_states_tracker(self, states):
+        for k, s in states.items():
+            self.states_.setdefault(k, Generator(0)).set_state(s)
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_tracker() -> RNGStatesTracker:
+    return _tracker

@@ -1,0 +1,10 @@
+"""``paddle.linalg`` namespace (ref: ``python/paddle/linalg.py``)."""
+from .ops.linalg import (  # noqa: F401
+    matmul, mm, bmm, dot, mv, dist, norm, cond, cholesky, cholesky_solve,
+    qr, svd, svdvals, pca_lowrank, lu, lu_unpack, inverse, det, slogdet,
+    solve, triangular_solve, lstsq, matrix_power, matrix_rank, eig, eigh,
+    eigvals, eigvalsh, pinv, cross, multi_dot, corrcoef, cov, einsum,
+    householder_product, matrix_exp, vecdot, vector_norm, matrix_norm,
+)
+
+inv = inverse

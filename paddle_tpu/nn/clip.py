@@ -1,0 +1,136 @@
+"""Gradient clipping (ref: ``python/paddle/nn/clip.py``).
+
+ClipGradByGlobalNorm computes the global norm in one fused XLA reduction
+when used inside a jitted train step; eagerly it runs over the tape grads.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm",
+           "clip_grad_norm_", "clip_grad_value_"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        """params_grads: list[(param, grad Tensor|None)] -> same structure."""
+        raise NotImplementedError
+
+    # functional form used inside jitted train steps
+    def apply_arrays(self, grads: list):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def apply_arrays(self, grads):
+        return [None if g is None else jnp.clip(g, self.min, self.max)
+                for g in grads]
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+            else:
+                out.append((p, Tensor(jnp.clip(g._data, self.min, self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def apply_arrays(self, grads):
+        out = []
+        for g in grads:
+            if g is None:
+                out.append(None)
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((g.astype(jnp.float32) * scale).astype(g.dtype))
+        return out
+
+    def __call__(self, params_grads):
+        arrays = [None if g is None else g._data for _, g in params_grads]
+        clipped = self.apply_arrays(arrays)
+        return [(p, g if c is None else Tensor(c))
+                for (p, g), c in zip(params_grads, clipped)]
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def apply_arrays(self, grads):
+        sq = [jnp.sum(jnp.square(g.astype(jnp.float32)))
+              for g in grads if g is not None]
+        if not sq:
+            return grads
+        global_norm = jnp.sqrt(sum(sq))
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        return [None if g is None else
+                (g.astype(jnp.float32) * scale).astype(g.dtype)
+                for g in grads]
+
+    def __call__(self, params_grads):
+        arrays = [None if g is None else g._data for _, g in params_grads]
+        # respect need_clip (params can opt out, ref ParamAttr.need_clip)
+        mask = [getattr(p, "need_clip", True) for p, _ in params_grads]
+        sq = [jnp.sum(jnp.square(a.astype(jnp.float32)))
+              for a, m in zip(arrays, mask) if a is not None and m]
+        if not sq:
+            return params_grads
+        global_norm = jnp.sqrt(sum(sq))
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        out = []
+        for (p, g), a, m in zip(params_grads, arrays, mask):
+            if a is None or not m:
+                out.append((p, g))
+            else:
+                out.append((p, Tensor(
+                    (a.astype(jnp.float32) * scale).astype(a.dtype))))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """torch-style util the reference also exposes
+    (``paddle.nn.utils.clip_grad_norm_``)."""
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(g._data)) for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g._data.astype(jnp.float32)) ** norm_type)
+             for g in grads])) ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError("non-finite gradient norm")
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._data = (p.grad._data.astype(jnp.float32) * scale).astype(
+                p.grad._data.dtype)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._data = jnp.clip(p.grad._data, -clip_value, clip_value)

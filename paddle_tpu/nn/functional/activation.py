@@ -1,0 +1,230 @@
+"""Activation functionals (ref: ``python/paddle/nn/functional/activation.py``).
+
+Every one of these is a single fused VPU expression under XLA — the
+reference's per-activation CUDA kernels (phi/kernels/gpu/activation_kernel.cu)
+have no equivalent to maintain.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...tensor import Tensor
+from ...ops.op_utils import ensure_tensor, unary as _unary, nary
+from ...framework import random as _random
+
+__all__ = [
+    "relu", "relu_", "relu6", "elu", "elu_", "selu", "celu", "gelu", "silu",
+    "swish", "mish", "softplus", "softsign", "softshrink", "hardshrink",
+    "tanhshrink", "thresholded_relu", "leaky_relu", "prelu", "rrelu",
+    "hardtanh", "hardsigmoid", "hardswish", "sigmoid", "log_sigmoid",
+    "tanh", "tanh_", "softmax", "softmax_", "log_softmax", "gumbel_softmax",
+    "maxout", "glu", "stanh",
+]
+
+
+def relu(x, name=None):
+    return _unary(jax.nn.relu, x, name="relu")
+
+
+def relu_(x, name=None):
+    out = relu(x)
+    x._data = out._data
+    return out
+
+
+def relu6(x, name=None):
+    return _unary(jax.nn.relu6, x, name="relu6")
+
+
+def elu(x, alpha=1.0, name=None):
+    return _unary(lambda d: jax.nn.elu(d, alpha=alpha), x, name="elu")
+
+
+elu_ = elu
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return _unary(lambda d: scale * jnp.where(
+        d > 0, d, alpha * (jnp.exp(d) - 1)), x, name="selu")
+
+
+def celu(x, alpha=1.0, name=None):
+    return _unary(lambda d: jax.nn.celu(d, alpha=alpha), x, name="celu")
+
+
+def gelu(x, approximate=False, name=None):
+    return _unary(lambda d: jax.nn.gelu(d, approximate=approximate), x,
+                  name="gelu")
+
+
+def silu(x, name=None):
+    return _unary(jax.nn.silu, x, name="silu")
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def mish(x, name=None):
+    return _unary(lambda d: d * jnp.tanh(jax.nn.softplus(d)), x, name="mish")
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return _unary(lambda d: jnp.where(
+        d * beta > threshold, d,
+        (1.0 / beta) * jnp.log1p(jnp.exp(beta * d))), x, name="softplus")
+
+
+def softsign(x, name=None):
+    return _unary(jax.nn.soft_sign, x, name="softsign")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return _unary(lambda d: jnp.where(
+        d > threshold, d - threshold,
+        jnp.where(d < -threshold, d + threshold, 0.0)), x, name="softshrink")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return _unary(lambda d: jnp.where(jnp.abs(d) > threshold, d, 0.0), x,
+                  name="hardshrink")
+
+
+def tanhshrink(x, name=None):
+    return _unary(lambda d: d - jnp.tanh(d), x, name="tanhshrink")
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return _unary(lambda d: jnp.where(d > threshold, d, value), x,
+                  name="thresholded_relu")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _unary(lambda d: jax.nn.leaky_relu(d, negative_slope=negative_slope),
+                  x, name="leaky_relu")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(d, w):
+        if w.size == 1:
+            return jnp.where(d >= 0, d, w.ravel()[0] * d)
+        shape = [1] * d.ndim
+        ch_axis = 1 if data_format[1] == "C" else d.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(d >= 0, d, w.reshape(shape) * d)
+    return nary(f, [x, ensure_tensor(weight)], name="prelu")
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
+    x = ensure_tensor(x)
+    if training:
+        key = _random.next_key()
+
+        def f(d):
+            a = jax.random.uniform(key, d.shape, dtype=jnp.float32,
+                                   minval=lower, maxval=upper).astype(d.dtype)
+            return jnp.where(d >= 0, d, a * d)
+        return _unary(f, x, name="rrelu")
+    mid = (lower + upper) / 2.0
+    return _unary(lambda d: jnp.where(d >= 0, d, mid * d), x, name="rrelu")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return _unary(lambda d: jnp.clip(d, min, max), x, name="hardtanh")
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return _unary(lambda d: jnp.clip(slope * d + offset, 0.0, 1.0), x,
+                  name="hardsigmoid")
+
+
+def hardswish(x, name=None):
+    return _unary(lambda d: d * jnp.clip(d + 3.0, 0.0, 6.0) / 6.0, x,
+                  name="hardswish")
+
+
+def sigmoid(x, name=None):
+    return _unary(jax.nn.sigmoid, x, name="sigmoid")
+
+
+def log_sigmoid(x, name=None):
+    return _unary(jax.nn.log_sigmoid, x, name="log_sigmoid")
+
+
+def tanh(x, name=None):
+    return _unary(jnp.tanh, x, name="tanh")
+
+
+def tanh_(x, name=None):
+    out = tanh(x)
+    x._data = out._data
+    return out
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...framework.dtype import to_jax_dtype
+    dt = to_jax_dtype(dtype) if dtype is not None else None
+
+    def f(d):
+        if dt is not None:
+            d = d.astype(dt)
+        return jax.nn.softmax(d, axis=axis)
+    return _unary(f, x, name="softmax")
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    out = softmax(x, axis, dtype)
+    x._data = out._data
+    return out
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...framework.dtype import to_jax_dtype
+    dt = to_jax_dtype(dtype) if dtype is not None else None
+
+    def f(d):
+        if dt is not None:
+            d = d.astype(dt)
+        return jax.nn.log_softmax(d, axis=axis)
+    return _unary(f, x, name="log_softmax")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    x = ensure_tensor(x)
+    key = _random.next_key()
+
+    def f(d):
+        g = -jnp.log(-jnp.log(
+            jax.random.uniform(key, d.shape, dtype=jnp.float32,
+                               minval=1e-10, maxval=1.0) + 1e-10)).astype(d.dtype)
+        y = jax.nn.softmax((d + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis,
+                                        inplace=False)
+            y = y_hard - jax.lax.stop_gradient(y) + y
+        return y
+    return _unary(f, x, name="gumbel_softmax")
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(d):
+        ax = axis % d.ndim
+        c = d.shape[ax]
+        new_shape = d.shape[:ax] + (c // groups, groups) + d.shape[ax + 1:]
+        return jnp.max(d.reshape(new_shape), axis=ax + 1)
+    return _unary(f, x, name="maxout")
+
+
+def glu(x, axis=-1, name=None):
+    def f(d):
+        a, b = jnp.split(d, 2, axis=axis)
+        return a * jax.nn.sigmoid(b)
+    return _unary(f, x, name="glu")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return _unary(lambda d: scale_b * jnp.tanh(scale_a * d), x, name="stanh")

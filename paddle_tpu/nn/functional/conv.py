@@ -1,0 +1,201 @@
+"""Convolution functionals.
+
+Ref: ``python/paddle/nn/functional/conv.py`` → cudnn kernels.
+TPU-native: one ``lax.conv_general_dilated`` per call — XLA tiles it onto
+the MXU directly; layout (NCHW vs NHWC) is a compiler concern, not a kernel
+zoo (the reference maintains separate cudnn/onednn layouts).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...tensor import Tensor
+from ...ops.op_utils import ensure_tensor, nary, maybe_autocast
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose",
+           "conv2d_transpose", "conv3d_transpose"]
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    return tuple(int(x) for x in v)
+
+
+def _norm_padding(padding, n, data_format):
+    """Returns lax-style padding: 'SAME', 'VALID' or [(lo, hi)] * n."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding), int(padding))] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, (int, np.integer))
+                                 for p in padding):
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1]))
+                for i in range(n)]
+    # paddle also allows [[0,0],[0,0],[lo,hi],...] including batch/channel
+    if len(padding) == n + 2:
+        spatial = padding[2:] if data_format[1] == "C" else padding[1:-1]
+        return [(int(p[0]), int(p[1])) if isinstance(p, (list, tuple))
+                else (int(p), int(p)) for p in spatial]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _dim_numbers(n, channel_last):
+    if n == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if n == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else \
+            ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else \
+        ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format,
+          opname):
+    x, weight = maybe_autocast(opname, ensure_tensor(x), ensure_tensor(weight))
+    channel_last = data_format[-1] == "C"
+    dn = _dim_numbers(n, channel_last)
+    strides = _norm_tuple(stride, n)
+    dil = _norm_tuple(dilation, n)
+    pad = _norm_padding(padding, n, data_format)
+
+    def f(d, w, *b):
+        # our weight layout follows the reference: (out_c, in_c/groups, *k)
+        if channel_last:
+            w = jnp.moveaxis(w, (0, 1), (-1, -2))  # -> (*k, in, out)
+        out = lax.conv_general_dilated(
+            d, w, window_strides=strides, padding=pad,
+            rhs_dilation=dil, feature_group_count=groups,
+            dimension_numbers=lax.conv_dimension_numbers(
+                d.shape, w.shape, dn))
+        if b:
+            bshape = [1] * out.ndim
+            bshape[-1 if channel_last else 1] = b[0].size
+            out = out + b[0].reshape(bshape).astype(out.dtype)
+        return out
+
+    args = [x, weight] + ([ensure_tensor(bias)] if bias is not None else [])
+    return nary(f, args, name=opname)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    df = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, df,
+                 "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format, "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format, "conv3d")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                    dilation, groups, n, data_format, output_size, opname):
+    """Fractionally-strided conv: dilate the input by `stride` and run a
+    regular conv with the kernel flipped and its in/out roles swapped —
+    the textbook construction XLA fuses into one conv HLO.
+
+    Reference weight layout: (in_c, out_c/groups, *k)
+    (ref: paddle/phi/kernels/impl/conv_transpose_kernel_impl.h).
+    """
+    x, weight = maybe_autocast(opname, ensure_tensor(x), ensure_tensor(weight))
+    channel_last = data_format[-1] == "C"
+    strides = _norm_tuple(stride, n)
+    dil = _norm_tuple(dilation, n)
+    pad = _norm_padding(padding, n, data_format)
+    out_pad = _norm_tuple(output_padding, n)
+    dn = _dim_numbers(n, channel_last=False)
+
+    def f(d, w, *b):
+        if channel_last:
+            d = jnp.moveaxis(d, -1, 1)
+        c_in = w.shape[0]
+        c_out_per_g = w.shape[1]
+        k = w.shape[2:]
+        # (in, out/g, *k) -> (g, in/g, out/g, *k) -> (g, out/g, in/g, *k)
+        #                 -> (out, in/g, *k), then flip spatial
+        wg = w.reshape((groups, c_in // groups, c_out_per_g) + k)
+        wg = jnp.swapaxes(wg, 1, 2)
+        w2 = wg.reshape((groups * c_out_per_g, c_in // groups) + k)
+        w2 = jnp.flip(w2, axis=tuple(range(2, w2.ndim)))
+        if isinstance(pad, str):
+            eff = [dil[i] * (k[i] - 1) for i in range(n)]
+            if pad == "SAME":
+                raise NotImplementedError(
+                    "SAME padding for conv_transpose: pass explicit ints")
+            padding_cfg = [(e, e + out_pad[i]) for i, e in enumerate(eff)]
+        else:
+            padding_cfg = [(dil[i] * (k[i] - 1) - pad[i][0],
+                            dil[i] * (k[i] - 1) - pad[i][1] + out_pad[i])
+                           for i in range(n)]
+        out = lax.conv_general_dilated(
+            d, w2, window_strides=(1,) * n, padding=padding_cfg,
+            lhs_dilation=strides, rhs_dilation=dil,
+            feature_group_count=groups,
+            dimension_numbers=lax.conv_dimension_numbers(
+                d.shape, w2.shape, dn))
+        if b:
+            bshape = [1] * out.ndim
+            bshape[1] = b[0].size
+            out = out + b[0].reshape(bshape).astype(out.dtype)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    args = [x, weight] + ([ensure_tensor(bias)] if bias is not None else [])
+    out = nary(f, args, name=opname)
+    if output_size is not None:
+        want = _norm_tuple(output_size, n)
+        have = out.shape[2:] if not channel_last else out.shape[1:-1]
+        if tuple(have) != tuple(want):
+            # pad tail to requested size (paddle allows sizes within stride)
+            extra = [w_ - h_ for w_, h_ in zip(want, have)]
+            widths = [(0, 0)] * out.ndim
+            off = 2 if not channel_last else 1
+            for i, e in enumerate(extra):
+                widths[off + i] = (0, e)
+            from ...ops.manipulation import pad as _pad_op
+            flat = []
+            for lo, hi in widths:
+                flat += [lo, hi]
+            out = _pad_op(out, flat)
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCL", name=None):
+    df = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, df, output_size,
+                           "conv1d_transpose")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format, output_size,
+                           "conv2d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format, output_size,
+                           "conv3d_transpose")

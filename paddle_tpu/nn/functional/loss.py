@@ -1,0 +1,450 @@
+"""Loss functionals (ref: ``python/paddle/nn/functional/loss.py``).
+
+cross_entropy fuses log_softmax + gather (one XLA computation), the TPU
+equivalent of the reference's fused ``softmax_with_cross_entropy`` CUDA
+kernel (``paddle/phi/kernels/gpu/cross_entropy_kernel.cu``).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...tensor import Tensor
+from ...ops.op_utils import ensure_tensor, nary, unary as _unary
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "mse_loss", "l1_loss", "nll_loss",
+    "smooth_l1_loss", "kl_div", "margin_ranking_loss", "cosine_similarity",
+    "cosine_embedding_loss", "hinge_embedding_loss", "triplet_margin_loss",
+    "triplet_margin_with_distance_loss", "ctc_loss", "log_loss",
+    "square_error_cost", "sigmoid_focal_loss", "dice_loss",
+    "npair_loss", "poisson_nll_loss", "gaussian_nll_loss",
+    "multi_label_soft_margin_loss", "soft_margin_loss", "rnnt_loss",
+]
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def f(logits, lab, *w):
+        ax = axis % logits.ndim
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=ax) \
+            if use_softmax else jnp.log(jnp.maximum(
+                logits.astype(jnp.float32), 1e-30))
+        n_class = logits.shape[ax]
+        if soft_label or (lab.ndim == logits.ndim and
+                          lab.shape[ax] == n_class and
+                          lab.dtype.kind == "f"):
+            soft = lab.astype(jnp.float32)
+            if label_smoothing > 0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / n_class
+            loss = -jnp.sum(soft * logp, axis=ax)
+            if w:
+                wvec = w[0].astype(jnp.float32)
+                loss = loss * jnp.sum(soft * wvec, axis=ax)
+            return _reduce(loss, reduction)
+        lab_i = lab.astype(jnp.int32)
+        if lab_i.ndim == logits.ndim:
+            lab_i = jnp.squeeze(lab_i, axis=ax)
+        onehot_ll = jnp.take_along_axis(
+            logp, jnp.expand_dims(jnp.clip(lab_i, 0, n_class - 1), ax),
+            axis=ax)
+        loss = -jnp.squeeze(onehot_ll, axis=ax)
+        if label_smoothing > 0:
+            smooth_loss = -jnp.mean(logp, axis=ax)
+            loss = (1 - label_smoothing) * loss + label_smoothing * smooth_loss
+        valid = (lab_i != ignore_index)
+        loss = jnp.where(valid, loss, 0.0)
+        if w:
+            wvec = w[0].astype(jnp.float32)
+            sample_w = jnp.take(wvec, jnp.clip(lab_i, 0, n_class - 1))
+            sample_w = jnp.where(valid, sample_w, 0.0)
+            loss = loss * sample_w
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(sample_w), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(
+                jnp.sum(valid.astype(jnp.float32)), 1.0)
+        return _reduce(loss, reduction)
+
+    args = [input, label] + ([ensure_tensor(weight)] if weight is not None
+                             else [])
+    return nary(f, args, name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    from .activation import softmax as _softmax
+    from ...ops.manipulation import unsqueeze
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    def f(p, y, *w):
+        p32 = jnp.clip(p.astype(jnp.float32), 1e-12, 1.0 - 1e-7)
+        out = -(y * jnp.log(p32) + (1 - y) * jnp.log1p(-p32))
+        if w:
+            out = out * w[0]
+        return _reduce(out, reduction)
+    args = [ensure_tensor(input), ensure_tensor(label)]
+    if weight is not None:
+        args.append(ensure_tensor(weight))
+    return nary(f, args, name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    def f(z, y, *extra):
+        z = z.astype(jnp.float32)
+        y = y.astype(jnp.float32)
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = extra[i]; i += 1
+        if pos_weight is not None:
+            pw = extra[i]; i += 1
+        # stable: max(z,0) - z*y + log(1+exp(-|z|)), pos_weight variant
+        if pw is not None:
+            log_w = (pw - 1) * y + 1
+            out = (1 - y) * z + log_w * (jnp.logaddexp(0.0, -jnp.abs(z))
+                                         + jnp.maximum(-z, 0.0))
+        else:
+            out = jnp.maximum(z, 0) - z * y + jnp.logaddexp(0.0, -jnp.abs(z))
+        if w is not None:
+            out = out * w
+        return _reduce(out, reduction)
+    args = [ensure_tensor(logit), ensure_tensor(label)]
+    if weight is not None:
+        args.append(ensure_tensor(weight))
+    if pos_weight is not None:
+        args.append(ensure_tensor(pos_weight))
+    return nary(f, args, name="bce_with_logits")
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return nary(lambda a, b: _reduce(jnp.square(a - b), reduction),
+                [ensure_tensor(input), ensure_tensor(label)], name="mse_loss")
+
+
+def square_error_cost(input, label):
+    return nary(lambda a, b: jnp.square(a - b),
+                [ensure_tensor(input), ensure_tensor(label)],
+                name="square_error_cost")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return nary(lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                [ensure_tensor(input), ensure_tensor(label)], name="l1_loss")
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    def f(logp, lab, *w):
+        lab_i = lab.astype(jnp.int32)
+        n_class = logp.shape[1]
+        ll = jnp.take_along_axis(
+            logp, jnp.expand_dims(jnp.clip(lab_i, 0, n_class - 1), 1), axis=1)
+        loss = -jnp.squeeze(ll, axis=1)
+        valid = lab_i != ignore_index
+        loss = jnp.where(valid, loss, 0.0)
+        if w:
+            sw = jnp.take(w[0], jnp.clip(lab_i, 0, n_class - 1))
+            sw = jnp.where(valid, sw, 0.0)
+            loss = loss * sw
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(sw), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(
+                jnp.sum(valid.astype(jnp.float32)), 1.0)
+        return _reduce(loss, reduction)
+    args = [ensure_tensor(input), ensure_tensor(label)]
+    if weight is not None:
+        args.append(ensure_tensor(weight))
+    return nary(f, args, name="nll_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        diff = jnp.abs(a - b)
+        out = jnp.where(diff < delta, 0.5 * diff * diff / delta,
+                        diff - 0.5 * delta)
+        return _reduce(out, reduction)
+    return nary(f, [ensure_tensor(input), ensure_tensor(label)],
+                name="smooth_l1_loss")
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def f(logp, q):
+        if log_target:
+            out = jnp.exp(q) * (q - logp)
+        else:
+            out = jnp.where(q > 0, q * (jnp.log(jnp.maximum(q, 1e-30)) - logp),
+                            jnp.zeros_like(q))
+        if reduction == "batchmean":
+            return jnp.sum(out) / logp.shape[0]
+        return _reduce(out, reduction)
+    return nary(f, [ensure_tensor(input), ensure_tensor(label)], name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def f(a, b, y):
+        out = jnp.maximum(-y * (a - b) + margin, 0.0)
+        return _reduce(out, reduction)
+    return nary(f, [ensure_tensor(input), ensure_tensor(other),
+                    ensure_tensor(label)], name="margin_ranking_loss")
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def f(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.linalg.norm(a, axis=axis)
+        nb = jnp.linalg.norm(b, axis=axis)
+        return dot / jnp.maximum(na * nb, eps)
+    return nary(f, [ensure_tensor(x1), ensure_tensor(x2)],
+                name="cosine_similarity")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    def f(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-8)
+        out = jnp.where(y == 1, 1 - cos, jnp.maximum(cos - margin, 0.0))
+        return _reduce(out, reduction)
+    return nary(f, [ensure_tensor(input1), ensure_tensor(input2),
+                    ensure_tensor(label)], name="cosine_embedding_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def f(x, y):
+        out = jnp.where(y == 1, x, jnp.maximum(margin - x, 0.0))
+        return _reduce(out, reduction)
+    return nary(f, [ensure_tensor(input), ensure_tensor(label)],
+                name="hinge_embedding_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def f(a, pos, neg):
+        dp = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
+        dn = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
+        if swap:
+            dn2 = jnp.linalg.norm(pos - neg + epsilon, ord=p, axis=-1)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+    return nary(f, [ensure_tensor(input), ensure_tensor(positive),
+                    ensure_tensor(negative)], name="triplet_margin_loss")
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative, margin=margin,
+                                   swap=swap, reduction=reduction)
+    dp = distance_function(input, positive)
+    dn = distance_function(input, negative)
+    if swap:
+        dn2 = distance_function(positive, negative)
+        from ...ops.math import minimum
+        dn = minimum(dn, dn2)
+    from ...ops.math import maximum as _max, mean as _mean, sum as _sum
+    from ...ops.creation import zeros_like
+    out = _max((dp - dn) + margin, zeros_like(dp))
+    if reduction == "mean":
+        return _mean(out)
+    if reduction == "sum":
+        return _sum(out)
+    return out
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via the standard alpha-recursion in log space over a lax.scan —
+    replaces the reference's vendored warpctc (third_party/warpctc)."""
+    log_probs = ensure_tensor(log_probs)  # (T, N, C) paddle layout
+    labels = ensure_tensor(labels)        # (N, S)
+    input_lengths = ensure_tensor(input_lengths)
+    label_lengths = ensure_tensor(label_lengths)
+
+    def f(lp, lab, ilen, llen):
+        if lp.ndim == 3 and lab.ndim == 2 and lp.shape[1] == lab.shape[0]:
+            pass
+        T, N, C = lp.shape
+        S = lab.shape[1]
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        # extended label seq with blanks: length 2S+1
+        ext = jnp.full((N, 2 * S + 1), blank, dtype=jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        ext_len = 2 * llen.astype(jnp.int32) + 1
+        neg_inf = jnp.float32(-1e30)
+        # init alpha at t=0
+        alpha0 = jnp.full((N, 2 * S + 1), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, jnp.arange(N), ext[:, 0]])
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(ext_len > 1, lp[0, jnp.arange(N), ext[:, 1]], neg_inf))
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((N, 2), dtype=bool),
+             ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha, lp_t):
+            a_prev = alpha
+            a_shift1 = jnp.concatenate(
+                [jnp.full((N, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a_shift2 = jnp.concatenate(
+                [jnp.full((N, 2), neg_inf), alpha[:, :-2]], axis=1)
+            a_shift2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
+            merged = jnp.logaddexp(jnp.logaddexp(a_prev, a_shift1), a_shift2)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return merged + emit, None
+
+        def scan_step(carry, t):
+            alpha, = carry
+            new_alpha, _ = step(alpha, lp[t])
+            new_alpha = jnp.where((t < ilen)[:, None], new_alpha, alpha)
+            return (new_alpha,), None
+
+        (alphaT,), _ = jax.lax.scan(scan_step, (alpha0,), jnp.arange(1, T))
+        idx_last = ext_len - 1
+        ll_final = jnp.logaddexp(
+            jnp.take_along_axis(alphaT, idx_last[:, None], axis=1)[:, 0],
+            jnp.take_along_axis(alphaT, jnp.maximum(idx_last - 1, 0)[:, None],
+                                axis=1)[:, 0])
+        loss = -ll_final
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(llen.astype(jnp.float32), 1.0))
+        return _reduce(loss, reduction)
+
+    return nary(f, [log_probs, labels, input_lengths, label_lengths],
+                name="ctc_loss")
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    raise NotImplementedError(
+        "rnnt_loss is not yet implemented on the TPU backend (reference "
+        "vendors warprnnt; a lax.scan transducer recursion is planned)")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def f(p, y):
+        return -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon)
+    return nary(f, [ensure_tensor(input), ensure_tensor(label)],
+                name="log_loss")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def f(z, y, *n):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.logaddexp(0.0, -jnp.abs(z))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        out = a_t * jnp.power(1 - p_t, gamma) * ce
+        if n:
+            out = out / n[0]
+        return _reduce(out, reduction)
+    args = [ensure_tensor(logit), ensure_tensor(label)]
+    if normalizer is not None:
+        args.append(ensure_tensor(normalizer))
+    return nary(f, args, name="sigmoid_focal_loss")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def f(p, y):
+        y1 = jax.nn.one_hot(y.astype(jnp.int32)[..., 0], p.shape[-1],
+                            dtype=p.dtype)
+        reduce_dims = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * y1, axis=reduce_dims)
+        union = jnp.sum(p, axis=reduce_dims) + jnp.sum(y1, axis=reduce_dims)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+    return nary(f, [ensure_tensor(input), ensure_tensor(label)],
+                name="dice_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def f(a, p, y):
+        sim = a @ p.T
+        y = y.reshape(-1)
+        tgt = (y[:, None] == y[None, :]).astype(jnp.float32)
+        tgt = tgt / jnp.sum(tgt, axis=1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        xent = -jnp.mean(jnp.sum(tgt * logp, axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, axis=1)) +
+                        jnp.mean(jnp.sum(p * p, axis=1))) * 0.25
+        return xent + reg
+    return nary(f, [ensure_tensor(anchor), ensure_tensor(positive),
+                    ensure_tensor(labels)], name="npair_loss")
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def f(x, y):
+        if log_input:
+            out = jnp.exp(x) - y * x
+        else:
+            out = x - y * jnp.log(x + epsilon)
+        if full:
+            stirling = y * jnp.log(y + epsilon) - y + 0.5 * jnp.log(
+                2 * np.pi * (y + epsilon))
+            out = out + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(out, reduction)
+    return nary(f, [ensure_tensor(input), ensure_tensor(label)],
+                name="poisson_nll_loss")
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def f(mu, y, var):
+        var = jnp.maximum(var, epsilon)
+        out = 0.5 * (jnp.log(var) + jnp.square(y - mu) / var)
+        if full:
+            out = out + 0.5 * np.log(2 * np.pi)
+        return _reduce(out, reduction)
+    return nary(f, [ensure_tensor(input), ensure_tensor(label),
+                    ensure_tensor(variance)], name="gaussian_nll_loss")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    def f(x, y, *w):
+        out = -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+        out = jnp.mean(out, axis=-1)
+        if w:
+            out = out * w[0]
+        return _reduce(out, reduction)
+    args = [ensure_tensor(input), ensure_tensor(label)]
+    if weight is not None:
+        args.append(ensure_tensor(weight))
+    return nary(f, args, name="multi_label_soft_margin_loss")
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    def f(x, y):
+        return _reduce(jnp.log1p(jnp.exp(-y * x)), reduction)
+    return nary(f, [ensure_tensor(input), ensure_tensor(label)],
+                name="soft_margin_loss")

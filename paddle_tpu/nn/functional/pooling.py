@@ -1,0 +1,237 @@
+"""Pooling functionals (ref: ``python/paddle/nn/functional/pooling.py``).
+
+All pooling maps to ``lax.reduce_window`` — one HLO, fused by XLA.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...tensor import Tensor
+from ...ops.op_utils import ensure_tensor, unary as _unary, nary
+from .conv import _norm_tuple, _norm_padding
+
+__all__ = [
+    "avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d", "max_pool2d",
+    "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
+    "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
+    "adaptive_max_pool3d", "lp_pool1d", "lp_pool2d",
+]
+
+
+def _pool(x, kernel, stride, padding, n, data_format, reducer, init,
+          opname, ceil_mode=False, exclusive=True, divisor_override=None):
+    x = ensure_tensor(x)
+    channel_last = data_format[-1] == "C"
+    k = _norm_tuple(kernel, n)
+    s = _norm_tuple(stride if stride is not None else kernel, n)
+    pad = _norm_padding(padding, n, data_format)
+    if isinstance(pad, str):
+        pad_cfg = pad
+    else:
+        pad_cfg = pad
+
+    def f(d):
+        if channel_last:
+            d = jnp.moveaxis(d, -1, 1)
+        window = (1, 1) + k
+        strides = (1, 1) + s
+        if isinstance(pad_cfg, str):
+            padding_full = pad_cfg
+        else:
+            padding_full = [(0, 0), (0, 0)] + list(pad_cfg)
+            if ceil_mode:
+                padding_full = [(lo, hi + st - 1) if i >= 2 else (lo, hi)
+                                for i, ((lo, hi), st) in
+                                enumerate(zip(padding_full, strides))]
+        if reducer == "max":
+            out = lax.reduce_window(d, -jnp.inf if d.dtype.kind == "f"
+                                    else jnp.iinfo(d.dtype).min,
+                                    lax.max, window, strides, padding_full)
+        else:  # avg
+            summed = lax.reduce_window(d, 0.0, lax.add, window, strides,
+                                       padding_full)
+            if divisor_override:
+                out = summed / divisor_override
+            elif exclusive and (isinstance(pad_cfg, str) or
+                                any(p != (0, 0) for p in pad_cfg)) :
+                ones = jnp.ones_like(d)
+                counts = lax.reduce_window(ones, 0.0, lax.add, window,
+                                           strides, padding_full)
+                out = summed / counts
+            else:
+                out = summed / float(np.prod(k))
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    return _unary(f, x, name=opname)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    df = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _pool(x, kernel_size, stride, padding, 1, df, "avg", 0.0,
+                 "avg_pool1d", ceil_mode, exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, "avg", 0.0,
+                 "avg_pool2d", ceil_mode, exclusive, divisor_override)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, "avg", 0.0,
+                 "avg_pool3d", ceil_mode, exclusive, divisor_override)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    df = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    out = _pool(x, kernel_size, stride, padding, 1, df, "max", None,
+                "max_pool1d", ceil_mode)
+    if return_mask:
+        return out, _pool_argmax(x, kernel_size, stride, padding, 1, df,
+                                 ceil_mode)
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, data_format, "max", None,
+                "max_pool2d", ceil_mode)
+    if return_mask:
+        return out, _pool_argmax(x, kernel_size, stride, padding, 2,
+                                 data_format, ceil_mode)
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 3, data_format, "max", None,
+                "max_pool3d", ceil_mode)
+    if return_mask:
+        return out, _pool_argmax(x, kernel_size, stride, padding, 3,
+                                 data_format, ceil_mode)
+    return out
+
+
+def _pool_argmax(x, kernel, stride, padding, n, data_format, ceil_mode):
+    """Flat indices of max elements (paddle return_mask semantics)."""
+    x = ensure_tensor(x)
+    channel_last = data_format[-1] == "C"
+    k = _norm_tuple(kernel, n)
+    s = _norm_tuple(stride if stride is not None else kernel, n)
+    pad = _norm_padding(padding, n, data_format)
+
+    def f(d):
+        if channel_last:
+            d = jnp.moveaxis(d, -1, 1)
+        spatial = d.shape[2:]
+        flat_idx = jnp.arange(int(np.prod(spatial))).reshape(spatial)
+        flat_idx = jnp.broadcast_to(flat_idx, d.shape)
+        window = (1, 1) + k
+        strides = (1, 1) + s
+        padding_full = pad if isinstance(pad, str) else \
+            [(0, 0), (0, 0)] + list(pad)
+
+        def select(a, b):
+            av, ai = a
+            bv, bi = b
+            pick = av >= bv
+            return jnp.where(pick, av, bv), jnp.where(pick, ai, bi)
+        init = (-jnp.inf if d.dtype.kind == "f" else jnp.iinfo(d.dtype).min,
+                jnp.asarray(-1))
+        _, idx = lax.reduce_window(
+            (d, flat_idx.astype(jnp.int32)), init,
+            lambda a, b: select(a, b), window, strides, padding_full)
+        if channel_last:
+            idx = jnp.moveaxis(idx, 1, -1)
+        return idx
+    return _unary(f, x, name="max_pool_mask")
+
+
+def _adaptive(x, output_size, n, data_format, mode, opname, return_mask=False):
+    x = ensure_tensor(x)
+    channel_last = data_format[-1] == "C"
+    out_sz = _norm_tuple(output_size, n)
+
+    def f(d):
+        if channel_last:
+            d = jnp.moveaxis(d, -1, 1)
+        in_sz = d.shape[2:]
+        # adaptive pooling: each output cell covers [floor(i*in/out),
+        # ceil((i+1)*in/out)) — implement via mean/max over gathered slices
+        out = d
+        for dim in range(n):
+            isz, osz = in_sz[dim], out_sz[dim]
+            starts = [int(np.floor(i * isz / osz)) for i in range(osz)]
+            ends = [int(np.ceil((i + 1) * isz / osz)) for i in range(osz)]
+            segs = []
+            for st, en in zip(starts, ends):
+                sl = lax.slice_in_dim(out, st, en, axis=2 + dim)
+                if mode == "avg":
+                    segs.append(jnp.mean(sl, axis=2 + dim, keepdims=True))
+                else:
+                    segs.append(jnp.max(sl, axis=2 + dim, keepdims=True))
+            out = jnp.concatenate(segs, axis=2 + dim)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    return _unary(f, x, name=opname)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, "NCW", "avg", "adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, 2, data_format, "avg",
+                     "adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, 3, data_format, "avg",
+                     "adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive(x, output_size, 1, "NCW", "max", "adaptive_max_pool1d")
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out = _adaptive(x, output_size, 2, "NCHW", "max", "adaptive_max_pool2d")
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _adaptive(x, output_size, 3, "NCDHW", "max", "adaptive_max_pool3d")
+    return (out, None) if return_mask else out
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    p = float(norm_type)
+    from ...ops import math as M
+    xe = M.pow(M.abs(ensure_tensor(x)), p)
+    pooled = avg_pool1d(xe, kernel_size, stride, padding, exclusive=False,
+                        ceil_mode=ceil_mode, data_format=data_format)
+    k = _norm_tuple(kernel_size, 1)
+    return M.pow(M.multiply(pooled, float(np.prod(k))), 1.0 / p)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    p = float(norm_type)
+    from ...ops import math as M
+    xe = M.pow(M.abs(ensure_tensor(x)), p)
+    pooled = avg_pool2d(xe, kernel_size, stride, padding, exclusive=False,
+                        ceil_mode=ceil_mode, data_format=data_format)
+    k = _norm_tuple(kernel_size, 2)
+    return M.pow(M.multiply(pooled, float(np.prod(k))), 1.0 / p)

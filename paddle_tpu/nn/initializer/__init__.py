@@ -1,0 +1,214 @@
+"""Weight initializers (ref: ``python/paddle/nn/initializer/``).
+
+Initializers here are pure functions ``(shape, dtype) -> jax array`` drawing
+from the global counter-based RNG — no init ops in a startup Program like
+the reference's static path.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework import random as _random
+from ...tensor import Tensor
+
+__all__ = [
+    "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
+    "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
+    "Assign", "Orthogonal", "Dirac", "calculate_gain", "set_global_initializer",
+]
+
+_global_weight_init = None
+_global_bias_init = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    global _global_weight_init, _global_bias_init
+    _global_weight_init = weight_init
+    _global_bias_init = bias_init
+
+
+class Initializer:
+    def __call__(self, shape, dtype=jnp.float32):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype=jnp.float32):
+        return jnp.full(tuple(shape), self.value, dtype=dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=jnp.float32):
+        return (jax.random.normal(_random.next_key(), tuple(shape),
+                                  dtype=jnp.float32) * self.std
+                + self.mean).astype(dtype)
+
+
+class TruncatedNormal(Initializer):
+    """Truncated at 2 std like the reference's TruncatedNormalInitializer."""
+
+    def __init__(self, mean=0.0, std=1.0, a=-2.0, b=2.0):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, shape, dtype=jnp.float32):
+        z = jax.random.truncated_normal(_random.next_key(), self.a, self.b,
+                                        tuple(shape), dtype=jnp.float32)
+        return (z * self.std + self.mean).astype(dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype=jnp.float32):
+        return jax.random.uniform(_random.next_key(), tuple(shape),
+                                  dtype=jnp.float32, minval=self.low,
+                                  maxval=self.high).astype(dtype)
+
+
+def _fans(shape):
+    shape = tuple(shape)
+    if len(shape) < 1:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels: receptive field * channels.  Our conv weight layout is
+    # (out_c, in_c, *spatial) like the reference's NCHW-major layout.
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=jnp.float32):
+        fi, fo = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return (jax.random.normal(_random.next_key(), tuple(shape),
+                                  dtype=jnp.float32) * std).astype(dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=jnp.float32):
+        fi, fo = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(_random.next_key(), tuple(shape),
+                                  dtype=jnp.float32, minval=-limit,
+                                  maxval=limit).astype(dtype)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype=jnp.float32):
+        fi, _ = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        std = gain / math.sqrt(fi)
+        return (jax.random.normal(_random.next_key(), tuple(shape),
+                                  dtype=jnp.float32) * std).astype(dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype=jnp.float32):
+        fi, _ = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        limit = gain * math.sqrt(3.0 / fi)
+        return jax.random.uniform(_random.next_key(), tuple(shape),
+                                  dtype=jnp.float32, minval=-limit,
+                                  maxval=limit).astype(dtype)
+
+
+class Assign(Initializer):
+    def __init__(self, value, name=None):
+        self.value = value
+
+    def __call__(self, shape, dtype=jnp.float32):
+        v = self.value
+        arr = v._data if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+        if tuple(arr.shape) != tuple(shape):
+            arr = arr.reshape(tuple(shape))
+        return arr.astype(dtype)
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype=jnp.float32):
+        shape = tuple(shape)
+        rows = shape[0]
+        cols = int(np.prod(shape[1:]))
+        flat = (rows, cols)
+        a = jax.random.normal(_random.next_key(),
+                              (max(rows, cols), min(rows, cols)),
+                              dtype=jnp.float32)
+        q, r = jnp.linalg.qr(a)
+        q = q * jnp.sign(jnp.diagonal(r))
+        if rows < cols:
+            q = q.T
+        return (self.gain * q[:rows, :cols]).reshape(shape).astype(dtype)
+
+
+class Dirac(Initializer):
+    """Identity-preserving conv init (ref: nn/initializer/dirac.py)."""
+
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype=jnp.float32):
+        out_c, in_c = shape[0], shape[1]
+        spatial = shape[2:]
+        w = np.zeros(tuple(shape), dtype=np.float32)
+        center = tuple(s // 2 for s in spatial)
+        per_group = out_c // self.groups
+        for g in range(self.groups):
+            for i in range(min(per_group, in_c)):
+                w[(g * per_group + i, i) + center] = 1.0
+        return jnp.asarray(w).astype(dtype)
+
+
+def calculate_gain(nonlinearity, param=None):
+    if nonlinearity in ("sigmoid", "linear", "conv1d", "conv2d", "conv3d",
+                        "conv_transpose1d", "conv_transpose2d",
+                        "conv_transpose3d"):
+        return 1.0
+    if nonlinearity == "tanh":
+        return 5.0 / 3.0
+    if nonlinearity == "relu":
+        return math.sqrt(2.0)
+    if nonlinearity == "leaky_relu":
+        a = 0.01 if param is None else param
+        return math.sqrt(2.0 / (1 + a ** 2))
+    if nonlinearity == "selu":
+        return 3.0 / 4.0
+    raise ValueError(f"unknown nonlinearity {nonlinearity}")

@@ -1,0 +1,92 @@
+"""Activation layers (ref: ``python/paddle/nn/layer/activation.py``)."""
+from __future__ import annotations
+
+from .layers import Layer
+from .. import functional as F
+from .. import initializer as I
+
+__all__ = ["ReLU", "ReLU6", "ELU", "SELU", "CELU", "GELU", "Silu", "Swish",
+           "Mish", "Softplus", "Softsign", "Softshrink", "Hardshrink",
+           "Tanhshrink", "ThresholdedReLU", "LeakyReLU", "PReLU", "RReLU",
+           "Hardtanh", "Hardsigmoid", "Hardswish", "Sigmoid", "LogSigmoid",
+           "Tanh", "Softmax", "LogSoftmax", "Maxout", "GLU"]
+
+
+def _simple(fn_name, **fixed):
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            self._kwargs = {**fixed}
+            # positional args map onto the functional's kwargs in order
+            names = [k for k in _SIGS.get(fn_name, [])]
+            for n, v in zip(names, args):
+                self._kwargs[n] = v
+            for k, v in kwargs.items():
+                if k != "name":
+                    self._kwargs[k] = v
+
+        def forward(self, x):
+            return getattr(F, fn_name)(x, **self._kwargs)
+    _Act.__name__ = fn_name
+    return _Act
+
+
+_SIGS = {
+    "elu": ["alpha"], "selu": ["scale", "alpha"], "celu": ["alpha"],
+    "gelu": ["approximate"], "softplus": ["beta", "threshold"],
+    "softshrink": ["threshold"], "hardshrink": ["threshold"],
+    "thresholded_relu": ["threshold", "value"],
+    "leaky_relu": ["negative_slope"], "hardtanh": ["min", "max"],
+    "hardsigmoid": ["slope", "offset"], "softmax": ["axis"],
+    "log_softmax": ["axis"], "maxout": ["groups", "axis"], "glu": ["axis"],
+    "rrelu": ["lower", "upper"],
+}
+
+ReLU = _simple("relu")
+ReLU6 = _simple("relu6")
+ELU = _simple("elu")
+SELU = _simple("selu")
+CELU = _simple("celu")
+GELU = _simple("gelu")
+Silu = _simple("silu")
+Swish = _simple("swish")
+Mish = _simple("mish")
+Softplus = _simple("softplus")
+Softsign = _simple("softsign")
+Softshrink = _simple("softshrink")
+Hardshrink = _simple("hardshrink")
+Tanhshrink = _simple("tanhshrink")
+ThresholdedReLU = _simple("thresholded_relu")
+LeakyReLU = _simple("leaky_relu")
+Hardtanh = _simple("hardtanh")
+Hardsigmoid = _simple("hardsigmoid")
+Hardswish = _simple("hardswish")
+Sigmoid = _simple("sigmoid")
+LogSigmoid = _simple("log_sigmoid")
+Tanh = _simple("tanh")
+Softmax = _simple("softmax")
+LogSoftmax = _simple("log_softmax")
+Maxout = _simple("maxout")
+GLU = _simple("glu")
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            [num_parameters], attr=weight_attr,
+            default_initializer=I.Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, data_format=self._data_format)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, training=self.training)

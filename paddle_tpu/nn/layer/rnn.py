@@ -1,0 +1,295 @@
+"""Recurrent layers (ref: ``python/paddle/nn/layer/rnn.py``).
+
+TPU-native: the time loop is a ``lax.scan`` — one compiled program, weights
+resident in VMEM across steps — instead of the reference's cudnn RNN kernels
+or per-step dygraph ops.
+"""
+from __future__ import annotations
+
+import math
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .layers import Layer
+from .. import initializer as I
+from ...tensor import Tensor
+from ...ops.op_utils import nary, ensure_tensor
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN",
+           "SimpleRNN", "LSTM", "GRU"]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0, batch_dim_idx=0):
+        from ...ops.creation import full
+        b = batch_ref.shape[batch_dim_idx]
+        return full([b, self.hidden_size], init_value, dtype)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [hidden_size], bias_ih_attr, is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [hidden_size], bias_hh_attr, is_bias=True, default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def f(x, h, wi, wh, bi, bh):
+            return act(x @ wi.T + bi + h @ wh.T + bh)
+        out = nary(f, [ensure_tensor(inputs), ensure_tensor(states),
+                       self.weight_ih, self.weight_hh, self.bias_ih,
+                       self.bias_hh], name="simple_rnn_cell")
+        return out, out
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=0, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+        else:
+            h, c = states
+
+        def f(x, hh, cc, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + hh @ wh.T + bh
+            i, fgt, g, o = jnp.split(gates, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            fgt = jax.nn.sigmoid(fgt)
+            g = jnp.tanh(g)
+            o = jax.nn.sigmoid(o)
+            c_new = fgt * cc + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+        h_new, c_new = nary(f, [ensure_tensor(inputs), ensure_tensor(h),
+                                ensure_tensor(c), self.weight_ih,
+                                self.weight_hh, self.bias_ih, self.bias_hh],
+                            name="lstm_cell", n_out=2)
+        return h_new, (h_new, c_new)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def f(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iz, in_ = jnp.split(gi, 3, axis=-1)
+            hr, hz, hn = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            n = jnp.tanh(in_ + r * hn)
+            return (1 - z) * n + z * h
+        out = nary(f, [ensure_tensor(inputs), ensure_tensor(states),
+                       self.weight_ih, self.weight_hh, self.bias_ih,
+                       self.bias_hh], name="gru_cell")
+        return out, out
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class RNN(Layer):
+    """Run a cell over time with lax.scan (ref: rnn.py RNN wrapper)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        # iterate on host for eager parity; jit users wrap the whole model
+        from ...ops.manipulation import stack, flip
+        x = inputs
+        if not self.time_major:
+            from ...ops.manipulation import transpose
+            perm = list(range(x.ndim))
+            perm[0], perm[1] = 1, 0
+            x = transpose(x, perm)
+        T = x.shape[0]
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        states = initial_states
+        outs = [None] * T
+        for t in steps:
+            out, states = self.cell(x[t], states)
+            outs[t] = out
+        y = stack(outs, axis=0)
+        if not self.time_major:
+            from ...ops.manipulation import transpose
+            perm = list(range(y.ndim))
+            perm[0], perm[1] = 1, 0
+            y = transpose(y, perm)
+        return y, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops.manipulation import concat
+        st_fw, st_bw = (initial_states if initial_states is not None
+                        else (None, None))
+        y_fw, s_fw = self.rnn_fw(inputs, st_fw)
+        y_bw, s_bw = self.rnn_bw(inputs, st_bw)
+        return concat([y_fw, y_bw], axis=-1), (s_fw, s_bw)
+
+
+class _RNNBase(Layer):
+    """Multi-layer (bi)directional rnn built from cells, scan-based."""
+
+    CELL = None
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        from .container import LayerList
+        self.cells_fw = LayerList()
+        self.cells_bw = LayerList() if self.bidirect else None
+        in_sz = input_size
+        mult = 2 if self.bidirect else 1
+        for i in range(num_layers):
+            self.cells_fw.append(self.CELL(
+                in_sz, hidden_size, weight_ih_attr=weight_ih_attr,
+                weight_hh_attr=weight_hh_attr, bias_ih_attr=bias_ih_attr,
+                bias_hh_attr=bias_hh_attr))
+            if self.bidirect:
+                self.cells_bw.append(self.CELL(
+                    in_sz, hidden_size, weight_ih_attr=weight_ih_attr,
+                    weight_hh_attr=weight_hh_attr, bias_ih_attr=bias_ih_attr,
+                    bias_hh_attr=bias_hh_attr))
+            in_sz = hidden_size * mult
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops.manipulation import concat
+        from .. import functional as F
+        x = inputs
+        final_states = []
+        for i in range(self.num_layers):
+            fw = RNN(self.cells_fw[i], False, self.time_major)
+            y_fw, s_fw = fw(x, None)
+            if self.bidirect:
+                bw = RNN(self.cells_bw[i], True, self.time_major)
+                y_bw, s_bw = bw(x, None)
+                x = concat([y_fw, y_bw], axis=-1)
+                final_states.append((s_fw, s_bw))
+            else:
+                x = y_fw
+                final_states.append(s_fw)
+            if self.dropout > 0 and i < self.num_layers - 1:
+                x = F.dropout(x, self.dropout, training=self.training)
+        return x, self._pack_states(final_states)
+
+    def _pack_states(self, states):
+        from ...ops.manipulation import stack
+        if isinstance(states[0], tuple) and not isinstance(
+                states[0][0], Tensor):
+            # bidirect: list of ((h,c)|h pairs)
+            flat = []
+            for pair in states:
+                flat.extend(pair)
+            states = flat
+        if isinstance(states[0], tuple):  # LSTM (h, c)
+            hs = stack([s[0] for s in states], axis=0)
+            cs = stack([s[1] for s in states], axis=0)
+            return (hs, cs)
+        return stack(states, axis=0)
+
+
+class SimpleRNN(_RNNBase):
+    CELL = SimpleRNNCell
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        self._activation = activation
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class LSTM(_RNNBase):
+    CELL = LSTMCell
+
+
+class GRU(_RNNBase):
+    CELL = GRUCell

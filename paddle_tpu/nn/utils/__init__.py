@@ -1,0 +1,101 @@
+"""nn.utils (ref: ``python/paddle/nn/utils/``)."""
+from ..clip import clip_grad_norm_, clip_grad_value_  # noqa: F401
+from ...tensor import Tensor, Parameter
+
+__all__ = ["clip_grad_norm_", "clip_grad_value_", "parameters_to_vector",
+           "vector_to_parameters", "weight_norm", "remove_weight_norm",
+           "spectral_norm"]
+
+
+def parameters_to_vector(parameters, name=None):
+    from ...ops.manipulation import concat, reshape
+    return concat([reshape(p, [-1]) for p in parameters], axis=0)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    for p in parameters:
+        n = p.size
+        p._data = vec._data[offset:offset + n].reshape(p._data.shape)
+        offset += n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize weight = g * v/||v|| via a forward pre-hook."""
+    import jax.numpy as jnp
+    w = getattr(layer, name)
+    dim_ = dim if dim is not None else -1
+    axes = tuple(i for i in range(w.ndim) if i != (dim_ % w.ndim)) \
+        if dim is not None else None
+    norm = jnp.sqrt(jnp.sum(jnp.square(w._data), axis=axes, keepdims=True)) \
+        if axes is not None else jnp.linalg.norm(w._data)
+    g = Parameter(norm.squeeze() if axes is not None else norm)
+    v = Parameter(w._data)
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+    del layer._parameters[name]
+
+    def hook(lyr, inputs):
+        import jax.numpy as jnp
+        vv = lyr._parameters[name + "_v"]
+        gg = lyr._parameters[name + "_g"]
+        from ...ops.op_utils import nary
+        def f(vd, gd):
+            nrm = jnp.sqrt(jnp.sum(jnp.square(vd), axis=axes, keepdims=True))
+            gshape = list(nrm.shape)
+            return vd / nrm * gd.reshape(gshape)
+        w_new = nary(f, [vv, gg], name="weight_norm")
+        lyr._buffers[name] = w_new
+        return None
+
+    layer._buffers[name] = Tensor(w._data)
+    layer._non_persistable_buffer_names_set.add(name)
+    layer.register_forward_pre_hook(hook)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    v = layer._parameters.pop(name + "_v", None)
+    g = layer._parameters.pop(name + "_g", None)
+    if v is not None:
+        w = layer._buffers.pop(name, None)
+        layer.add_parameter(name, Parameter(
+            w._data if w is not None else v._data))
+    layer._forward_pre_hooks.clear()
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Apply spectral normalization via forward pre-hook."""
+    import numpy as np
+    import jax.numpy as jnp
+    w = getattr(layer, name)
+    d = dim if dim is not None else 0
+    h = w.shape[d]
+
+    u0 = np.random.normal(0, 1, h).astype(np.float32)
+
+    def hook(lyr, inputs):
+        from ...ops.op_utils import nary
+        ww = lyr._parameters.get(name + "_orig")
+        def f(wd):
+            wm = jnp.moveaxis(wd, d, 0).reshape(wd.shape[d], -1)
+            u = jnp.asarray(u0)
+            v = None
+            for _ in range(n_power_iterations):
+                v = wm.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = wm @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            sigma = u @ wm @ v
+            return wd / sigma
+        lyr._buffers[name] = nary(f, [ww], name="spectral_norm")
+        return None
+
+    layer.add_parameter(name + "_orig", Parameter(w._data))
+    del layer._parameters[name]
+    layer._buffers[name] = Tensor(w._data)
+    layer._non_persistable_buffer_names_set.add(name)
+    layer.register_forward_pre_hook(hook)
+    return layer

@@ -1,0 +1,123 @@
+"""Functional op surface.
+
+One flat namespace mirroring ``python/paddle/tensor/`` — creation, math,
+manipulation, logic, linalg, search — re-exported at the package top level
+(`paddle_tpu.add` etc.) and installed as Tensor methods
+(`x.add(y)`, `x + y`), matching the reference's monkey-patched tensor
+method surface (``python/paddle/tensor/__init__.py``).
+"""
+from . import creation, math, manipulation, logic, linalg, search  # noqa: F401
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+
+# star-export only op names, NOT the submodule objects — otherwise
+# `paddle_tpu.linalg`/`paddle_tpu.math` would shadow the real top-level
+# namespace modules of the same name
+__all__ = (creation.__all__ + math.__all__ + manipulation.__all__ +
+           logic.__all__ + linalg.__all__ + search.__all__)
+
+from ..tensor import Tensor
+from . import math as _m, manipulation as _mp, logic as _lg, linalg as _la, \
+    search as _s, creation as _c
+
+_METHOD_SOURCES = [_m, _mp, _lg, _la, _s]
+
+# names that become Tensor methods (subset of module functions whose first
+# arg is the tensor)
+_METHODS = [
+    # math
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod",
+    "remainder", "pow", "maximum", "minimum", "exp", "expm1", "log", "log2",
+    "log10", "log1p", "sqrt", "rsqrt", "square", "abs", "sign", "floor",
+    "ceil", "round", "trunc", "frac", "reciprocal", "neg", "sin", "cos",
+    "tan", "asin", "acos", "atan", "sinh", "cosh", "tanh", "asinh", "acosh",
+    "atanh", "atan2", "erf", "erfinv", "lgamma", "digamma", "logit",
+    "sigmoid", "sum", "mean", "max", "min", "prod", "amax", "amin",
+    "nansum", "nanmean", "cumsum", "cumprod", "logsumexp", "logcumsumexp",
+    "clip", "isnan", "isinf", "isfinite", "nan_to_num", "all", "any",
+    "heaviside", "kron", "trace", "diagonal", "angle", "conj", "real",
+    "imag", "lerp", "median", "nanmedian", "quantile", "std", "var",
+    "count_nonzero", "inner", "outer", "scale", "lcm", "gcd",
+    "add_", "subtract_", "multiply_", "divide_", "clip_", "scale_",
+    "floor_", "ceil_", "exp_", "sqrt_", "rsqrt_", "reciprocal_", "round_",
+    "sigmoid_", "tanh_",
+    # manipulation
+    "reshape", "reshape_", "flatten", "squeeze", "squeeze_", "unsqueeze",
+    "unsqueeze_", "transpose", "moveaxis", "concat", "split", "chunk",
+    "tile", "expand", "expand_as", "broadcast_to", "gather", "gather_nd",
+    "scatter", "scatter_", "scatter_nd_add", "index_select", "index_sample",
+    "index_add", "index_put", "take_along_axis", "put_along_axis", "roll",
+    "flip", "rot90", "unbind", "repeat_interleave", "slice", "strided_slice",
+    "pad", "masked_fill", "masked_select", "masked_scatter", "where",
+    "unflatten", "unfold", "tolist", "numel", "swapaxes", "tensor_split",
+    # logic
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "equal_all", "allclose", "isclose", "logical_and",
+    "logical_or", "logical_xor", "logical_not", "bitwise_and", "bitwise_or",
+    "bitwise_xor", "bitwise_not",
+    # linalg
+    "matmul", "mm", "bmm", "dot", "mv", "dist", "norm", "cholesky",
+    "inverse", "det", "slogdet", "solve", "matrix_power", "cross",
+    # search
+    "argmax", "argmin", "argsort", "sort", "topk", "kthvalue", "mode",
+    "nonzero", "unique", "bincount", "histogram",
+    # creation (tensor-first only)
+    "tril", "triu", "diag", "bernoulli", "normal_", "uniform_",
+    "exponential_", "zeros_like", "ones_like", "full_like",
+]
+
+
+def _install_tensor_methods():
+    for name in _METHODS:
+        fn = None
+        for mod in _METHOD_SOURCES + [_c]:
+            fn = getattr(mod, name, None)
+            if fn is not None:
+                break
+        if fn is None:
+            raise RuntimeError(f"op {name} not found for Tensor method binding")
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, fn)
+
+    # dunder operators
+    import jax.numpy as jnp
+    from .op_utils import binary as _binary, unary as _unary
+    Tensor.__add__ = lambda s, o: _m.add(s, o)
+    Tensor.__radd__ = lambda s, o: _m.add(o, s)
+    Tensor.__sub__ = lambda s, o: _m.subtract(s, o)
+    Tensor.__rsub__ = lambda s, o: _m.subtract(o, s)
+    Tensor.__mul__ = lambda s, o: _m.multiply(s, o)
+    Tensor.__rmul__ = lambda s, o: _m.multiply(o, s)
+    Tensor.__truediv__ = lambda s, o: _m.divide(s, o)
+    Tensor.__rtruediv__ = lambda s, o: _m.divide(o, s)
+    Tensor.__floordiv__ = lambda s, o: _m.floor_divide(s, o)
+    Tensor.__rfloordiv__ = lambda s, o: _m.floor_divide(o, s)
+    Tensor.__mod__ = lambda s, o: _m.mod(s, o)
+    Tensor.__rmod__ = lambda s, o: _m.mod(o, s)
+    Tensor.__pow__ = lambda s, o: _m.pow(s, o)
+    Tensor.__rpow__ = lambda s, o: _m.pow(o, s)
+    Tensor.__neg__ = lambda s: _m.neg(s)
+    Tensor.__abs__ = lambda s: _m.abs(s)
+    Tensor.__matmul__ = lambda s, o: _la.matmul(s, o)
+    Tensor.__rmatmul__ = lambda s, o: _la.matmul(o, s)
+    Tensor.__eq__ = lambda s, o: _lg.equal(s, o)
+    Tensor.__ne__ = lambda s, o: _lg.not_equal(s, o)
+    Tensor.__lt__ = lambda s, o: _lg.less_than(s, o)
+    Tensor.__le__ = lambda s, o: _lg.less_equal(s, o)
+    Tensor.__gt__ = lambda s, o: _lg.greater_than(s, o)
+    Tensor.__ge__ = lambda s, o: _lg.greater_equal(s, o)
+    Tensor.__and__ = lambda s, o: _lg.bitwise_and(s, o)
+    Tensor.__or__ = lambda s, o: _lg.bitwise_or(s, o)
+    Tensor.__xor__ = lambda s, o: _lg.bitwise_xor(s, o)
+    Tensor.__invert__ = lambda s: _lg.bitwise_not(s)
+    Tensor.__lshift__ = lambda s, o: _lg.bitwise_left_shift(s, o)
+    Tensor.__rshift__ = lambda s, o: _lg.bitwise_right_shift(s, o)
+    # hash must survive __eq__ override
+    Tensor.__hash__ = lambda s: id(s)
+
+
+_install_tensor_methods()

@@ -1,0 +1,290 @@
+"""Tensor creation ops (ref: ``python/paddle/tensor/creation.py``)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor, to_tensor  # noqa: F401
+from ..framework.dtype import to_jax_dtype, default_jax_dtype
+from ..framework import random as _random
+from .op_utils import ensure_tensor, unary
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "zeros_like", "ones_like",
+    "full_like", "empty", "empty_like", "arange", "linspace", "logspace",
+    "eye", "tril", "triu", "tril_indices", "triu_indices", "meshgrid",
+    "diag", "diagflat", "diag_embed", "assign", "clone", "rand", "randn",
+    "randint", "randint_like", "randperm", "uniform", "normal",
+    "standard_normal", "bernoulli", "multinomial", "poisson", "exponential_",
+    "uniform_", "normal_", "complex", "polar", "as_tensor",
+]
+
+
+def _dt(dtype):
+    return to_jax_dtype(dtype) if dtype is not None else default_jax_dtype()
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy().tolist())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._data if isinstance(s, Tensor) else s) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), dtype=_dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), dtype=_dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        return Tensor(jnp.full(_shape(shape), fill_value))
+    return Tensor(jnp.full(_shape(shape), fill_value, dtype=to_jax_dtype(dtype)))
+
+
+def zeros_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    dt = to_jax_dtype(dtype) if dtype is not None else None
+    return Tensor(jnp.zeros_like(x._data, dtype=dt))
+
+
+def ones_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    dt = to_jax_dtype(dtype) if dtype is not None else None
+    return Tensor(jnp.ones_like(x._data, dtype=dt))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    x = ensure_tensor(x)
+    dt = to_jax_dtype(dtype) if dtype is not None else None
+    return Tensor(jnp.full_like(x._data, fill_value, dtype=dt))
+
+
+def empty(shape, dtype=None, name=None):
+    # XLA has no uninitialized memory; zeros is the deterministic choice.
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    dt = to_jax_dtype(dtype) if dtype is not None else None
+    return Tensor(jnp.arange(start, end, step, dtype=dt))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    dt = to_jax_dtype(dtype) if dtype is not None else None
+    return Tensor(jnp.linspace(_v(start), _v(stop), int(_v(num)), dtype=dt))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    dt = to_jax_dtype(dtype) if dtype is not None else None
+    return Tensor(jnp.logspace(_v(start), _v(stop), int(_v(num)),
+                               base=_v(base), dtype=dt))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def tril(x, diagonal=0, name=None):
+    return unary(lambda d: jnp.tril(d, k=diagonal), x, name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    return unary(lambda d: jnp.triu(d, k=diagonal), x, name="triu")
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    col = col if col is not None else row
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.stack([jnp.asarray(r), jnp.asarray(c)]).astype(
+        to_jax_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = col if col is not None else row
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.stack([jnp.asarray(r), jnp.asarray(c)]).astype(
+        to_jax_dtype(dtype)))
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    arrs = jnp.meshgrid(*[ensure_tensor(a)._data for a in args], indexing="ij")
+    return [Tensor(a) for a in arrs]
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    x = ensure_tensor(x)
+    if x.ndim == 1 and padding_value != 0:
+        def f(d):
+            n = d.shape[0] + abs(offset)
+            out = jnp.full((n, n), padding_value, dtype=d.dtype)
+            idx = jnp.arange(d.shape[0])
+            return out.at[idx + max(-offset, 0), idx + max(offset, 0)].set(d)
+        return unary(f, x, name="diag")
+    return unary(lambda d: jnp.diag(d, k=offset), x, name="diag")
+
+
+def diagflat(x, offset=0, name=None):
+    return unary(lambda d: jnp.diagflat(d, k=offset), x, name="diagflat")
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    def f(d):
+        n = d.shape[-1] + abs(offset)
+        base = jnp.zeros(d.shape[:-1] + (n, n), dtype=d.dtype)
+        idx = jnp.arange(d.shape[-1])
+        out = base.at[..., idx + max(-offset, 0), idx + max(offset, 0)].set(d)
+        nd = out.ndim
+        d1, d2 = dim1 % nd, dim2 % nd
+        perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+        # place the two diag dims at dim1/dim2
+        order = perm.copy()
+        for pos, axis in sorted([(d1, nd - 2), (d2, nd - 1)]):
+            order.insert(pos, axis)
+        return jnp.transpose(out, order)
+    return unary(f, x, name="diag_embed")
+
+
+def assign(x, output=None):
+    x = ensure_tensor(x) if not isinstance(x, (np.ndarray, list, tuple, int, float)) \
+        else Tensor(np.asarray(x))
+    out = unary(jnp.copy, x, name="assign")
+    if output is not None:
+        output.set_value(out._data)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return ensure_tensor(x).clone()
+
+
+# -- random creation --------------------------------------------------------
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(_random.next_key(), _shape(shape),
+                                     dtype=_dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(_random.next_key(), _shape(shape),
+                                    dtype=_dt(dtype)))
+
+
+standard_normal = randn
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(_random.next_key(), _shape(shape),
+                                     low, high, dtype=to_jax_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return randint(low, high, tuple(x.shape), dtype or x.dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(_random.next_key(),
+                                         jnp.arange(n, dtype=to_jax_dtype(dtype))))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else _random.next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), dtype=_dt(dtype),
+                                     minval=min, maxval=max))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = ensure_tensor(mean)._data if isinstance(mean, Tensor) else mean
+        s = ensure_tensor(std)._data if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(jax.random.normal(_random.next_key(), shp,
+                                        dtype=default_jax_dtype()) * s + m)
+    return Tensor(jax.random.normal(_random.next_key(), _shape(shape),
+                                    dtype=default_jax_dtype()) * std + mean)
+
+
+def bernoulli(x, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jax.random.bernoulli(_random.next_key(),
+                                       x._data).astype(x._data.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    x = ensure_tensor(x)
+    key = _random.next_key()
+    logits = jnp.log(jnp.maximum(x._data, 1e-30))
+    if x.ndim == 1:
+        out = jax.random.choice(key, x._data.shape[0], (num_samples,),
+                                replace=replacement, p=x._data / x._data.sum())
+    else:
+        keys = jax.random.split(key, x._data.shape[0])
+        out = jnp.stack([
+            jax.random.choice(k, x._data.shape[1], (num_samples,),
+                              replace=replacement, p=row / row.sum())
+            for k, row in zip(keys, x._data)])
+    return Tensor(out.astype(jnp.int32))
+
+
+def poisson(x, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jax.random.poisson(_random.next_key(), x._data).astype(
+        x._data.dtype))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    x._data = jax.random.uniform(_random.next_key(), x._data.shape,
+                                 dtype=x._data.dtype, minval=min, maxval=max)
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x._data = (jax.random.normal(_random.next_key(), x._data.shape,
+                                 dtype=x._data.dtype) * std + mean)
+    return x
+
+
+def exponential_(x, lam=1.0, name=None):
+    x._data = jax.random.exponential(
+        _random.next_key(), x._data.shape, dtype=x._data.dtype) / lam
+    return x
+
+
+def complex(real, imag, name=None):
+    from .op_utils import binary
+    return binary(jax.lax.complex, real, imag, name="complex")
+
+
+def polar(abs, angle, name=None):
+    from .op_utils import binary
+    return binary(lambda a, t: jax.lax.complex(a * jnp.cos(t), a * jnp.sin(t)),
+                  abs, angle, name="polar")
+
+
+def as_tensor(data, dtype=None, place=None):
+    return to_tensor(data, dtype=dtype, place=place)

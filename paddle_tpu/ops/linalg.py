@@ -1,0 +1,302 @@
+"""Linear algebra ops (ref: ``python/paddle/tensor/linalg.py``).
+
+`matmul` is THE op on TPU: it lowers to a single dot_general on the MXU.
+The reference's call chain for this op is eight layers deep
+(``linalg.py:139 matmul`` → ``_C_ops.matmul`` → generated ad_func → phi API →
+kernel dispatch → cublas); here it is one jax call plus tape capture.
+
+matmul/bmm participate in AMP O1 auto-cast (white list), mirroring
+``eager_gen.py:461``'s generated AMP logic.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from .op_utils import (ensure_tensor, unary as _unary, binary as _binary,
+                       nary, maybe_autocast)
+
+__all__ = [
+    "matmul", "mm", "bmm", "dot", "mv", "dist", "norm", "cond",
+    "cholesky", "cholesky_solve", "qr", "svd", "svdvals", "pca_lowrank", "lu",
+    "lu_unpack", "inverse", "det", "slogdet", "solve", "triangular_solve",
+    "lstsq", "matrix_power", "matrix_rank", "eig", "eigh", "eigvals",
+    "eigvalsh", "pinv", "cross", "multi_dot", "corrcoef", "cov", "einsum",
+    "householder_product", "matrix_exp", "vecdot", "vector_norm", "matrix_norm",
+]
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    x, y = maybe_autocast("matmul", ensure_tensor(x), ensure_tensor(y))
+
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return _binary(f, x, y, name="matmul")
+
+
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    x, y = maybe_autocast("bmm", ensure_tensor(x), ensure_tensor(y))
+    return _binary(jnp.matmul, x, y, name="bmm")
+
+
+def dot(x, y, name=None):
+    def f(a, b):
+        return jnp.sum(a * b, axis=-1)
+    return _binary(f, x, y, name="dot")
+
+
+def vecdot(x, y, axis=-1, name=None):
+    return _binary(lambda a, b: jnp.sum(a * b, axis=axis), x, y, name="vecdot")
+
+
+def mv(x, vec, name=None):
+    return _binary(lambda a, b: jnp.matmul(a, b), x, vec, name="mv")
+
+
+def dist(x, y, p=2, name=None):
+    return _binary(lambda a, b: jnp.linalg.norm((a - b).ravel(), ord=p), x, y,
+                   name="dist")
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+
+    def f(d):
+        if axis is None and p is None:
+            return jnp.linalg.norm(d.ravel(), ord=2, keepdims=False)
+        if axis is None:
+            return jnp.linalg.norm(d.ravel(), ord=p if p != "fro" else 2)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        ord_ = p
+        if p == "fro":
+            ord_ = "fro" if isinstance(ax, tuple) else 2
+        elif p == "nuc":
+            ord_ = "nuc"
+        elif p is None:
+            ord_ = 2 if not isinstance(ax, tuple) else "fro"
+        if isinstance(ax, tuple) and not isinstance(ord_, str):
+            # element-wise p-norm over multiple axes
+            return jnp.sum(jnp.abs(d) ** ord_, axis=ax, keepdims=keepdim) ** (1.0 / ord_)
+        return jnp.linalg.norm(d, ord=ord_, axis=ax, keepdims=keepdim)
+    return _unary(f, x, name="norm")
+
+
+def vector_norm(x, p=2, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return _unary(lambda d: jnp.linalg.vector_norm(d, ord=p, axis=ax,
+                                                   keepdims=keepdim),
+                  x, name="vector_norm")
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return _unary(lambda d: jnp.linalg.matrix_norm(d, ord=p, keepdims=keepdim),
+                  x, name="matrix_norm")
+
+
+def cond(x, p=None, name=None):
+    return _unary(lambda d: jnp.linalg.cond(d, p=p), x, name="cond")
+
+
+def cholesky(x, upper=False, name=None):
+    def f(d):
+        L = jnp.linalg.cholesky(d)
+        return jnp.swapaxes(L, -1, -2).conj() if upper else L
+    return _unary(f, x, name="cholesky")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, L):
+        Lc = jnp.swapaxes(L, -1, -2).conj() if upper else L
+        z = jax.scipy.linalg.solve_triangular(Lc, b, lower=True)
+        return jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(Lc, -1, -2).conj(), z, lower=False)
+    return _binary(f, x, y, name="cholesky_solve")
+
+
+def qr(x, mode="reduced", name=None):
+    return nary(lambda d: tuple(jnp.linalg.qr(d, mode=mode)), [x],
+                name="qr", n_out=2)
+
+
+def svd(x, full_matrices=False, name=None):
+    return nary(lambda d: tuple(jnp.linalg.svd(d, full_matrices=full_matrices)),
+                [x], name="svd", n_out=3)
+
+
+def svdvals(x, name=None):
+    return _unary(lambda d: jnp.linalg.svdvals(d), x, name="svdvals")
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    x = ensure_tensor(x)
+    m, n = x.shape[-2], x.shape[-1]
+    q = q if q is not None else min(6, m, n)
+
+    def f(d):
+        c = d - d.mean(axis=-2, keepdims=True) if center else d
+        u, s, vt = jnp.linalg.svd(c, full_matrices=False)
+        return u[..., :q], s[..., :q], jnp.swapaxes(vt, -1, -2)[..., :q]
+    return nary(f, [x], name="pca_lowrank", n_out=3)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    x = ensure_tensor(x)
+    lu_, piv = jax.scipy.linalg.lu_factor(x._data)
+    outs = (Tensor(lu_), Tensor((piv + 1).astype(jnp.int32)))
+    if get_infos:
+        return outs + (Tensor(jnp.zeros((), jnp.int32)),)
+    return outs
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
+              name=None):
+    lu_data = ensure_tensor(lu_data)
+    n = lu_data.shape[-2]
+    L = jnp.tril(lu_data._data, -1) + jnp.eye(n, lu_data.shape[-1])
+    U = jnp.triu(lu_data._data)
+    piv = np.asarray(ensure_tensor(lu_pivots)._data) - 1
+    P = np.eye(n)
+    perm = np.arange(n)
+    for i, p in enumerate(piv.ravel()[:n]):
+        perm[[i, p]] = perm[[p, i]]
+    Pm = P[perm]
+    return Tensor(jnp.asarray(Pm.T)), Tensor(L), Tensor(U)
+
+
+def inverse(x, name=None):
+    return _unary(jnp.linalg.inv, x, name="inverse")
+
+
+def det(x, name=None):
+    return _unary(jnp.linalg.det, x, name="det")
+
+
+def slogdet(x, name=None):
+    def f(d):
+        sign, logdet = jnp.linalg.slogdet(d)
+        return jnp.stack([sign, logdet])
+    return _unary(f, x, name="slogdet")
+
+
+def solve(x, y, name=None):
+    def f(a, b):
+        if b.ndim == a.ndim - 1:
+            return jnp.linalg.solve(a, b[..., None])[..., 0]
+        return jnp.linalg.solve(a, b)
+    return _binary(f, x, y, name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    def f(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return _binary(f, x, y, name="triangular_solve")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    sol, res, rank_, sv = jnp.linalg.lstsq(x._data, y._data, rcond=rcond)
+    return (Tensor(sol), Tensor(res), Tensor(jnp.asarray(rank_)), Tensor(sv))
+
+
+def matrix_power(x, n, name=None):
+    return _unary(lambda d: jnp.linalg.matrix_power(d, n), x,
+                  name="matrix_power")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return _unary(lambda d: jnp.linalg.matrix_rank(d, rtol=tol).astype(jnp.int32),
+                  x, name="matrix_rank")
+
+
+def matrix_exp(x, name=None):
+    return _unary(jax.scipy.linalg.expm, x, name="matrix_exp")
+
+
+def eig(x, name=None):
+    x = ensure_tensor(x)
+    # general eig is CPU-only in every backend; route via host (same as the
+    # reference, which runs LAPACK on CPU for eig)
+    w, v = np.linalg.eig(np.asarray(x._data))
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigvals(x, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.asarray(np.linalg.eigvals(np.asarray(x._data))))
+
+
+def eigh(x, UPLO="L", name=None):
+    return nary(lambda d: tuple(jnp.linalg.eigh(d, symmetrize_input=True)),
+                [x], name="eigh", n_out=2)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return _unary(lambda d: jnp.linalg.eigvalsh(d), x, name="eigvalsh")
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return _unary(lambda d: jnp.linalg.pinv(d, rtol=rcond,
+                                            hermitian=hermitian), x,
+                  name="pinv")
+
+
+def cross(x, y, axis=9, name=None):
+    def f(a, b):
+        ax = axis
+        if ax == 9:
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+    return _binary(f, x, y, name="cross")
+
+
+def multi_dot(tensors, name=None):
+    return nary(lambda *ds: jnp.linalg.multi_dot(ds),
+                [ensure_tensor(t) for t in tensors], name="multi_dot")
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return _unary(lambda d: jnp.corrcoef(d, rowvar=rowvar), x, name="corrcoef")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = ensure_tensor(fweights)._data if fweights is not None else None
+    aw = ensure_tensor(aweights)._data if aweights is not None else None
+    return _unary(lambda d: jnp.cov(d, rowvar=rowvar,
+                                    ddof=1 if ddof else 0,
+                                    fweights=fw, aweights=aw), x, name="cov")
+
+
+def einsum(equation, *operands, name=None):
+    ops_ = [ensure_tensor(o) for o in operands]
+    ops_ = list(maybe_autocast("einsum", *ops_))
+    return nary(lambda *ds: jnp.einsum(equation, *ds), ops_, name="einsum")
+
+
+def householder_product(x, tau, name=None):
+    def f(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+
+        def body(i, Q):
+            v = jnp.where(jnp.arange(m) < i, 0.0, a[..., :, i])
+            v = v.at[i].set(1.0)
+            H = eye - t[..., i] * jnp.outer(v, v)
+            return Q @ H
+        Q = eye
+        for i in range(n):
+            Q = body(i, Q)
+        return Q[..., :, :n]
+    return _binary(f, x, tau, name="householder_product")

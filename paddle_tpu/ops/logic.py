@@ -1,0 +1,104 @@
+"""Comparison / logical / bitwise ops (ref: ``python/paddle/tensor/logic.py``)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from .op_utils import ensure_tensor, unary as _unary, binary as _binary
+
+__all__ = [
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "equal_all", "allclose", "isclose", "logical_and",
+    "logical_or", "logical_xor", "logical_not", "bitwise_and", "bitwise_or",
+    "bitwise_xor", "bitwise_not", "bitwise_left_shift", "bitwise_right_shift",
+    "is_empty", "is_tensor",
+]
+
+
+def equal(x, y, name=None):
+    return _binary(jnp.equal, x, y, name="equal")
+
+
+def not_equal(x, y, name=None):
+    return _binary(jnp.not_equal, x, y, name="not_equal")
+
+
+def greater_than(x, y, name=None):
+    return _binary(jnp.greater, x, y, name="greater_than")
+
+
+def greater_equal(x, y, name=None):
+    return _binary(jnp.greater_equal, x, y, name="greater_equal")
+
+
+def less_than(x, y, name=None):
+    return _binary(jnp.less, x, y, name="less_than")
+
+
+def less_equal(x, y, name=None):
+    return _binary(jnp.less_equal, x, y, name="less_equal")
+
+
+def equal_all(x, y, name=None):
+    return _binary(lambda a, b: jnp.array_equal(a, b), x, y, name="equal_all")
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return _binary(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                             equal_nan=equal_nan),
+                   x, y, name="allclose")
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return _binary(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                            equal_nan=equal_nan),
+                   x, y, name="isclose")
+
+
+def logical_and(x, y, out=None, name=None):
+    return _binary(jnp.logical_and, x, y, name="logical_and")
+
+
+def logical_or(x, y, out=None, name=None):
+    return _binary(jnp.logical_or, x, y, name="logical_or")
+
+
+def logical_xor(x, y, out=None, name=None):
+    return _binary(jnp.logical_xor, x, y, name="logical_xor")
+
+
+def logical_not(x, out=None, name=None):
+    return _unary(jnp.logical_not, x, name="logical_not")
+
+
+def bitwise_and(x, y, out=None, name=None):
+    return _binary(jnp.bitwise_and, x, y, name="bitwise_and")
+
+
+def bitwise_or(x, y, out=None, name=None):
+    return _binary(jnp.bitwise_or, x, y, name="bitwise_or")
+
+
+def bitwise_xor(x, y, out=None, name=None):
+    return _binary(jnp.bitwise_xor, x, y, name="bitwise_xor")
+
+
+def bitwise_not(x, out=None, name=None):
+    return _unary(jnp.bitwise_not, x, name="bitwise_not")
+
+
+def bitwise_left_shift(x, y, name=None):
+    return _binary(jnp.left_shift, x, y, name="bitwise_left_shift")
+
+
+def bitwise_right_shift(x, y, name=None):
+    return _binary(jnp.right_shift, x, y, name="bitwise_right_shift")
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(ensure_tensor(x).size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

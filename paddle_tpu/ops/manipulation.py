@@ -1,0 +1,648 @@
+"""Shape / layout manipulation ops (ref: ``python/paddle/tensor/manipulation.py``).
+
+All of these are metadata ops or gathers in XLA — reshape/transpose are free
+inside a fused computation; only gathers/scatters materialise data movement.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ..framework.dtype import to_jax_dtype
+from .op_utils import ensure_tensor, unary as _unary, binary as _binary, nary
+
+__all__ = [
+    "reshape", "reshape_", "flatten", "squeeze", "squeeze_", "unsqueeze",
+    "unsqueeze_", "transpose", "moveaxis", "swapaxes", "concat", "stack",
+    "hstack", "vstack", "dstack", "split", "vsplit", "hsplit", "dsplit",
+    "chunk", "tile", "expand", "expand_as", "broadcast_to", "broadcast_tensors",
+    "gather", "gather_nd", "scatter", "scatter_", "scatter_nd",
+    "scatter_nd_add", "index_select", "index_sample", "index_add", "index_put",
+    "take_along_axis", "put_along_axis", "roll", "flip", "rot90", "unbind",
+    "unstack", "repeat_interleave", "slice", "strided_slice", "crop", "pad",
+    "t", "as_real", "as_complex", "view", "view_as", "atleast_1d",
+    "atleast_2d", "atleast_3d", "tensordot", "flatten_", "masked_fill",
+    "masked_select", "masked_scatter", "where", "tolist", "numel", "rank",
+    "shard_index", "tensor_split", "unflatten", "as_strided", "unfold",
+]
+
+
+def _shape_vals(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in shape.numpy().tolist())
+    out = []
+    for s in shape:
+        out.append(int(s.item()) if isinstance(s, Tensor) else int(s))
+    return tuple(out)
+
+
+def reshape(x, shape, name=None):
+    shp = _shape_vals(shape)
+    return _unary(lambda d: jnp.reshape(d, shp), x, name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._data, x._node, x._out_idx = out._data, out._node, out._out_idx
+    x.stop_gradient = out.stop_gradient and x.stop_gradient
+    if x._node is not None:
+        import weakref
+        x._node.out_refs[x._out_idx] = weakref.ref(x)
+    return x
+
+
+view = reshape
+
+
+def view_as(x, other, name=None):
+    return reshape(x, ensure_tensor(other).shape)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = ensure_tensor(x)
+    nd = x.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+
+    def f(d):
+        shp = d.shape[:s] + (-1,) + d.shape[e + 1:]
+        return jnp.reshape(d, shp)
+    return _unary(f, x, name="flatten")
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    return _rebind(x, flatten(x, start_axis, stop_axis))
+
+
+def _rebind(x, out):
+    import weakref
+    x._data, x._node, x._out_idx = out._data, out._node, out._out_idx
+    x.stop_gradient = out.stop_gradient and x.stop_gradient
+    if x._node is not None:
+        x._node.out_refs[x._out_idx] = weakref.ref(x)
+    return x
+
+
+def squeeze(x, axis=None, name=None):
+    x = ensure_tensor(x)
+    if axis is None:
+        ax = None
+    else:
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        ax = tuple(a % x.ndim for a in axes if x.shape[a % x.ndim] == 1)
+    return _unary(lambda d: jnp.squeeze(d, axis=ax), x, name="squeeze")
+
+
+def squeeze_(x, axis=None, name=None):
+    return _rebind(x, squeeze(x, axis))
+
+
+def unsqueeze(x, axis, name=None):
+    x = ensure_tensor(x)
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = [int(a.item()) if isinstance(a, Tensor) else int(a) for a in axes]
+
+    def f(d):
+        out = d
+        nd = d.ndim + len(axes)
+        for a in sorted([a % nd for a in axes]):
+            out = jnp.expand_dims(out, a)
+        return out
+    return _unary(f, x, name="unsqueeze")
+
+
+def unsqueeze_(x, axis, name=None):
+    return _rebind(x, unsqueeze(x, axis))
+
+
+def transpose(x, perm, name=None):
+    p = tuple(int(v) for v in perm)
+    return _unary(lambda d: jnp.transpose(d, p), x, name="transpose")
+
+
+def moveaxis(x, source, destination, name=None):
+    return _unary(lambda d: jnp.moveaxis(d, source, destination), x,
+                  name="moveaxis")
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return _unary(lambda d: jnp.swapaxes(d, axis0, axis1), x, name="swapaxes")
+
+
+def t(x, name=None):
+    x = ensure_tensor(x)
+    if x.ndim > 2:
+        raise ValueError("paddle.t only supports ndim <= 2")
+    return _unary(jnp.transpose, x, name="t")
+
+
+def concat(x, axis=0, name=None):
+    tensors = [ensure_tensor(v) for v in x]
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return nary(lambda *ds: jnp.concatenate(ds, axis=ax), tensors,
+                name="concat")
+
+
+def stack(x, axis=0, name=None):
+    tensors = [ensure_tensor(v) for v in x]
+    return nary(lambda *ds: jnp.stack(ds, axis=axis), tensors, name="stack")
+
+
+def hstack(x, name=None):
+    return nary(lambda *ds: jnp.hstack(ds), [ensure_tensor(v) for v in x],
+                name="hstack")
+
+
+def vstack(x, name=None):
+    return nary(lambda *ds: jnp.vstack(ds), [ensure_tensor(v) for v in x],
+                name="vstack")
+
+
+def dstack(x, name=None):
+    return nary(lambda *ds: jnp.dstack(ds), [ensure_tensor(v) for v in x],
+                name="dstack")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = ensure_tensor(x)
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    ax = ax % x.ndim
+    dim = x.shape[ax]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"split: dimension {ax} (size {dim}) is not divisible by "
+                f"num={num_or_sections}; pass explicit section sizes")
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s) for s in num_or_sections]
+        n_minus = sum(1 for s in sizes if s < 0)
+        if n_minus:
+            rem = dim - sum(s for s in sizes if s >= 0)
+            sizes = [rem if s < 0 else s for s in sizes]
+    offsets = np.cumsum([0] + sizes)
+    outs = []
+    for i in range(len(sizes)):
+        sl = [np.s_[:]] * x.ndim
+        sl[ax] = np.s_[int(offsets[i]):int(offsets[i + 1])]
+        outs.append(_unary(lambda d, sl=tuple(sl): d[sl], x, name="split"))
+    return outs
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    x = ensure_tensor(x)
+    arrs = jnp.array_split(x._data, num_or_indices, axis=axis) \
+        if isinstance(num_or_indices, int) else \
+        jnp.split(x._data, num_or_indices, axis=axis)
+    # route through split-style recording for grad support
+    outs = []
+    start = 0
+    ax = axis % x.ndim
+    for a in arrs:
+        n = a.shape[ax]
+        sl = [np.s_[:]] * x.ndim
+        sl[ax] = np.s_[start:start + n]
+        outs.append(_unary(lambda d, sl=tuple(sl): d[sl], x, name="tensor_split"))
+        start += n
+    return outs
+
+
+def vsplit(x, num_or_sections, name=None):
+    return split(x, num_or_sections, axis=0)
+
+
+def hsplit(x, num_or_sections, name=None):
+    return split(x, num_or_sections, axis=1)
+
+
+def dsplit(x, num_or_sections, name=None):
+    return split(x, num_or_sections, axis=2)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis=axis)
+
+
+def unbind(x, axis=0, name=None):
+    x = ensure_tensor(x)
+    n = x.shape[axis % x.ndim]
+    return [_unary(lambda d, i=i: jnp.squeeze(
+                jax.lax.slice_in_dim(d, i, i + 1, axis=axis % d.ndim),
+                axis=axis % d.ndim), x, name="unbind")
+            for i in range(n)]
+
+
+unstack = unbind
+
+
+def tile(x, repeat_times, name=None):
+    reps = _shape_vals(repeat_times)
+    return _unary(lambda d: jnp.tile(d, reps), x, name="tile")
+
+
+def expand(x, shape, name=None):
+    shp = _shape_vals(shape)
+    x = ensure_tensor(x)
+
+    def f(d):
+        tgt = list(shp)
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                tgt[i] = d.shape[i - len(tgt) + d.ndim]
+        return jnp.broadcast_to(d, tuple(tgt))
+    return _unary(f, x, name="expand")
+
+
+def expand_as(x, y, name=None):
+    return expand(x, ensure_tensor(y).shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    datas = [ensure_tensor(t)._data for t in inputs]
+    shp = jnp.broadcast_shapes(*[d.shape for d in datas])
+    return [expand(t, shp) for t in inputs]
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [_unary(jnp.atleast_1d, x, name="atleast_1d") for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [_unary(jnp.atleast_2d, x, name="atleast_2d") for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [_unary(jnp.atleast_3d, x, name="atleast_3d") for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+# -- gathers / scatters -----------------------------------------------------
+def gather(x, index, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return nary(lambda d, i: jnp.take(d, i.astype(jnp.int32).ravel(), axis=ax),
+                [x, ensure_tensor(index)], name="gather")
+
+
+def gather_nd(x, index, name=None):
+    def f(d, idx):
+        idx = idx.astype(jnp.int32)
+        k = idx.shape[-1]
+        return d[tuple(jnp.moveaxis(idx, -1, 0))] if k == d.ndim else \
+            d[tuple(jnp.moveaxis(idx, -1, 0))]
+    return nary(f, [x, ensure_tensor(index)], name="gather_nd")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(d, i, u):
+        i = i.astype(jnp.int32).ravel()
+        if overwrite:
+            return d.at[i].set(u)
+        return d.at[i].set(0).at[i].add(u)
+    return nary(f, [x, ensure_tensor(index), ensure_tensor(updates)],
+                name="scatter")
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return _rebind(x, scatter(x, index, updates, overwrite))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    shp = _shape_vals(shape)
+
+    def f(i, u):
+        z = jnp.zeros(shp, dtype=u.dtype)
+        return z.at[tuple(jnp.moveaxis(i.astype(jnp.int32), -1, 0))].add(u)
+    return nary(f, [ensure_tensor(index), ensure_tensor(updates)],
+                name="scatter_nd")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def f(d, i, u):
+        return d.at[tuple(jnp.moveaxis(i.astype(jnp.int32), -1, 0))].add(u)
+    return nary(f, [x, ensure_tensor(index), ensure_tensor(updates)],
+                name="scatter_nd_add")
+
+
+def index_select(x, index, axis=0, name=None):
+    return nary(lambda d, i: jnp.take(d, i.astype(jnp.int32).ravel(), axis=axis),
+                [x, ensure_tensor(index)], name="index_select")
+
+
+def index_sample(x, index, name=None):
+    def f(d, i):
+        return jnp.take_along_axis(d, i.astype(jnp.int32), axis=1)
+    return nary(f, [x, ensure_tensor(index)], name="index_sample")
+
+
+def index_add(x, index, axis, value, name=None):
+    def f(d, i, v):
+        i = i.astype(jnp.int32)
+        dm = jnp.moveaxis(d, axis, 0)
+        vm = jnp.moveaxis(v, axis, 0)
+        out = dm.at[i].add(vm)
+        return jnp.moveaxis(out, 0, axis)
+    return nary(f, [x, ensure_tensor(index), ensure_tensor(value)],
+                name="index_add")
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx_tensors = [ensure_tensor(i) for i in indices]
+
+    def f(d, v, *idxs):
+        key = tuple(i.astype(jnp.int32) if jnp.issubdtype(i.dtype, jnp.integer)
+                    else i for i in idxs)
+        return d.at[key].add(v) if accumulate else d.at[key].set(v)
+    return nary(f, [x, ensure_tensor(value)] + idx_tensors, name="index_put")
+
+
+def take_along_axis(x, indices, axis, broadcast=True, name=None):
+    def f(d, i):
+        return jnp.take_along_axis(d, i.astype(jnp.int32), axis=axis)
+    return nary(f, [x, ensure_tensor(indices)], name="take_along_axis")
+
+
+def put_along_axis(x, indices, values, axis, reduce="assign", name=None):
+    def f(d, i, v):
+        i = i.astype(jnp.int32)
+        v = jnp.broadcast_to(v, i.shape) if jnp.ndim(v) else \
+            jnp.full(i.shape, v, dtype=d.dtype)
+        if reduce == "add":
+            return _put_add(d, i, v, axis)
+        if reduce in ("mul", "multiply"):
+            return _put_mul(d, i, v, axis)
+        return jnp.put_along_axis(d, i, v, axis=axis, inplace=False)
+    return nary(f, [x, ensure_tensor(indices), ensure_tensor(values)],
+                name="put_along_axis")
+
+
+def _put_add(d, i, v, axis):
+    dm = jnp.moveaxis(d, axis, 0)
+    im = jnp.moveaxis(i, axis, 0)
+    vm = jnp.moveaxis(jnp.broadcast_to(v, i.shape), axis, 0)
+    grid = jnp.indices(im.shape)
+    idx = (im,) + tuple(grid[k] for k in range(1, im.ndim))
+    return jnp.moveaxis(dm.at[idx].add(vm), 0, axis)
+
+
+def _put_mul(d, i, v, axis):
+    dm = jnp.moveaxis(d, axis, 0)
+    im = jnp.moveaxis(i, axis, 0)
+    vm = jnp.moveaxis(jnp.broadcast_to(v, i.shape), axis, 0)
+    grid = jnp.indices(im.shape)
+    idx = (im,) + tuple(grid[k] for k in range(1, im.ndim))
+    return jnp.moveaxis(dm.at[idx].multiply(vm), 0, axis)
+
+
+def masked_fill(x, mask, value, name=None):
+    val = value.item() if isinstance(value, Tensor) and value.size == 1 else value
+    if isinstance(val, Tensor):
+        return nary(lambda d, m, v: jnp.where(m, v.astype(d.dtype), d),
+                    [x, ensure_tensor(mask), val], name="masked_fill")
+    return nary(lambda d, m: jnp.where(m, jnp.asarray(val, dtype=d.dtype), d),
+                [x, ensure_tensor(mask)], name="masked_fill")
+
+
+def masked_select(x, mask, name=None):
+    """Dynamic-shaped: eager-only (host sync), like every data-dependent
+    shape op on an XLA backend. Inside jit use `where` instead."""
+    x, mask = ensure_tensor(x), ensure_tensor(mask)
+    if isinstance(x._data, jax.core.Tracer) or isinstance(mask._data, jax.core.Tracer):
+        raise RuntimeError(
+            "masked_select has a data-dependent output shape and cannot be "
+            "traced under jit; use paddle_tpu.where / multiplication by the "
+            "mask instead.")
+    m = np.asarray(mask._data)
+    idx = np.nonzero(np.broadcast_to(m, x._data.shape).ravel())[0]
+    return nary(lambda d: jnp.take(d.ravel(), jnp.asarray(idx)), [x],
+                name="masked_select")
+
+
+def masked_scatter(x, mask, value, name=None):
+    x, mask = ensure_tensor(x), ensure_tensor(mask)
+    m = np.asarray(mask._data)
+    flat_idx = np.nonzero(np.broadcast_to(m, x._data.shape).ravel())[0]
+
+    def f(d, v):
+        return d.ravel().at[jnp.asarray(flat_idx)].set(
+            v.ravel()[:flat_idx.size]).reshape(d.shape)
+    return nary(f, [x, ensure_tensor(value)], name="masked_scatter")
+
+
+def where(condition, x=None, y=None, name=None):
+    condition = ensure_tensor(condition)
+    if x is None and y is None:
+        return tuple(Tensor(a) for a in jnp.nonzero(np.asarray(condition._data)))
+    return nary(lambda c, a, b: jnp.where(c, a, b),
+                [condition, x, y], name="where")
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = _shape_vals(shifts) if isinstance(shifts, (list, tuple, Tensor)) else shifts
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return _unary(lambda d: jnp.roll(d, sh, axis=ax), x, name="roll")
+
+
+def flip(x, axis, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return _unary(lambda d: jnp.flip(d, axis=ax), x, name="flip")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return _unary(lambda d: jnp.rot90(d, k=k, axes=tuple(axes)), x, name="rot90")
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        reps = np.asarray(repeats._data)
+        total = int(reps.sum())
+        return _unary(lambda d: jnp.repeat(d, jnp.asarray(reps), axis=axis,
+                                           total_repeat_length=total), x,
+                      name="repeat_interleave")
+    return _unary(lambda d: jnp.repeat(d, repeats, axis=axis), x,
+                  name="repeat_interleave")
+
+
+def slice(x, axes, starts, ends, name=None):
+    x = ensure_tensor(x)
+    starts = _shape_vals(starts)
+    ends = _shape_vals(ends)
+    sls = [np.s_[:]] * x.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        sls[ax] = np.s_[s:e]
+    return _unary(lambda d: d[tuple(sls)], x, name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = ensure_tensor(x)
+    sls = [np.s_[:]] * x.ndim
+    for ax, s, e, st in zip(axes, _shape_vals(starts), _shape_vals(ends),
+                            _shape_vals(strides)):
+        sls[ax] = np.s_[s:e:st]
+    return _unary(lambda d: d[tuple(sls)], x, name="strided_slice")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = ensure_tensor(x)
+    shp = _shape_vals(shape)
+    offs = _shape_vals(offsets) if offsets is not None else (0,) * x.ndim
+    sls = tuple(np.s_[o:o + (s if s != -1 else x.shape[i] - o)]
+                for i, (o, s) in enumerate(zip(offs, shp)))
+    return _unary(lambda d: d[sls], x, name="crop")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    pad = _shape_vals(pad)
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        # full per-dim spec, paddle order = [d0_l, d0_r, d1_l, d1_r, ...]
+        widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # partial spec: pair j applies to the (last - j)-th spatial dim
+        # (paddle order [left,right, top,bottom, front,back] — W first),
+        # honoring data_format (ref F.pad semantics)
+        n_spatial = len(pad) // 2
+        widths = [(0, 0)] * nd
+        if data_format.endswith("C") and nd >= 3:  # NHWC-style
+            spatial_axes = list(range(1, nd - 1))
+        else:
+            spatial_axes = list(range(2, nd)) if nd > 2 else list(range(nd))
+        for j in range(n_spatial):
+            axq = spatial_axes[len(spatial_axes) - 1 - j]
+            widths[axq] = (pad[2 * j], pad[2 * j + 1])
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return _unary(lambda d: jnp.pad(d, widths, mode="constant",
+                                        constant_values=value), x, name="pad")
+    return _unary(lambda d: jnp.pad(d, widths, mode=jmode), x, name="pad")
+
+
+def as_complex(x, name=None):
+    return _unary(lambda d: jax.lax.complex(d[..., 0], d[..., 1]), x,
+                  name="as_complex")
+
+
+def as_real(x, name=None):
+    return _unary(lambda d: jnp.stack([jnp.real(d), jnp.imag(d)], axis=-1),
+                  x, name="as_real")
+
+
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, Tensor):
+        axes = axes.numpy().tolist()
+    return nary(lambda a, b: jnp.tensordot(a, b, axes=axes), [x, y],
+                name="tensordot")
+
+
+def unflatten(x, axis, shape, name=None):
+    x = ensure_tensor(x)
+    shp = _shape_vals(shape)
+    ax = axis % x.ndim
+
+    def f(d):
+        return jnp.reshape(d, d.shape[:ax] + tuple(shp) + d.shape[ax + 1:])
+    return _unary(f, x, name="unflatten")
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    x = ensure_tensor(x)
+    arr = np.lib.stride_tricks.as_strided(
+        np.asarray(x._data).ravel()[offset:],
+        shape=shape,
+        strides=[s * x.element_size() for s in stride])
+    return Tensor(jnp.asarray(arr.copy()))
+
+
+def unfold(x, axis, size, step, name=None):
+    x = ensure_tensor(x)
+    ax = axis % x.ndim
+    n = (x.shape[ax] - size) // step + 1
+
+    def f(d):
+        idx = jnp.arange(n)[:, None] * step + jnp.arange(size)[None, :]
+        g = jnp.take(d, idx.reshape(-1), axis=ax)
+        g = jnp.reshape(g, d.shape[:ax] + (n, size) + d.shape[ax + 1:])
+        return jnp.moveaxis(g, ax + 1, -1)
+    return _unary(f, x, name="unfold")
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(ensure_tensor(x).size, dtype=jnp.int32))
+
+
+def rank(x):
+    return Tensor(jnp.asarray(ensure_tensor(x).ndim, dtype=jnp.int32))
+
+
+def tolist(x):
+    return ensure_tensor(x).tolist()
+
+
+def shard_index(x, index_num, nshards, shard_id, ignore_value=-1, name=None):
+    """Map global ids to shard-local ids (ref: ``paddle.shard_index``)."""
+    size = (index_num + nshards - 1) // nshards
+
+    def f(d):
+        shard = d // size
+        local = d % size
+        return jnp.where(shard == shard_id, local, ignore_value)
+    return _unary(f, x, name="shard_index")
+
+
+# -- indexing engine for Tensor.__getitem__ / __setitem__ -------------------
+def _norm_index(idx):
+    """Convert Tensors inside an index expression to arrays."""
+    if isinstance(idx, tuple):
+        return tuple(_norm_index(i) for i in idx)
+    if isinstance(idx, Tensor):
+        d = idx._data
+        return d if d.dtype == jnp.bool_ else d.astype(jnp.int32)
+    if isinstance(idx, (list, np.ndarray)):
+        a = np.asarray(idx)
+        return a if a.dtype != np.bool_ else a
+    return idx
+
+
+def _has_bool_mask(idx):
+    items = idx if isinstance(idx, tuple) else (idx,)
+    for i in items:
+        if isinstance(i, (jax.Array, np.ndarray)) and i.dtype == np.bool_:
+            return True
+        if isinstance(i, jax.core.Tracer) and i.dtype == jnp.bool_:
+            return True
+    return False
+
+
+def _getitem(x, idx):
+    nidx = _norm_index(idx)
+    if _has_bool_mask(nidx) and not isinstance(x._data, jax.core.Tracer):
+        # dynamic-shape boolean mask: resolve on host (eager only)
+        arr = np.asarray(x._data)
+        np_idx = jax.tree_util.tree_map(np.asarray, nidx)
+        taken = arr[np_idx]
+        lin = np.arange(arr.size).reshape(arr.shape)[np_idx]
+        return nary(lambda d: jnp.take(d.ravel(),
+                                       jnp.asarray(lin.ravel())).reshape(taken.shape),
+                    [x], name="getitem_mask")
+    return _unary(lambda d: d[nidx], x, name="getitem")
+
+
+def _setitem(x, idx, value):
+    nidx = _norm_index(idx)
+    if isinstance(value, Tensor):
+        out = nary(lambda d, v: d.at[nidx].set(v.astype(d.dtype)), [x, value],
+                   name="setitem")
+    else:
+        val = jnp.asarray(value) if not np.isscalar(value) else value
+        out = nary(lambda d: d.at[nidx].set(val), [x], name="setitem")
+    _rebind(x, out)
+    return x

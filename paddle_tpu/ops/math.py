@@ -1,0 +1,510 @@
+"""Math ops: elementwise, reductions, cast, clip.
+
+Ref: ``python/paddle/tensor/math.py`` (and the kernels they dispatch to under
+``paddle/phi/kernels``). On TPU each op lowers to one XLA HLO op; elementwise
+chains fuse automatically, so there is no fused-kernel zoo to maintain.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ..framework.dtype import to_jax_dtype
+from .op_utils import ensure_tensor, unary as _unary, binary as _binary, nary
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod",
+    "remainder", "pow", "float_power", "maximum", "minimum", "fmax", "fmin",
+    "exp", "expm1", "log", "log2", "log10", "log1p", "sqrt", "rsqrt",
+    "square", "abs", "sign", "sgn", "floor", "ceil", "round", "trunc", "frac",
+    "reciprocal", "neg", "sin", "cos", "tan", "asin", "acos", "atan", "sinh",
+    "cosh", "tanh", "asinh", "acosh", "atanh", "atan2", "deg2rad", "rad2deg",
+    "erf", "erfinv", "lgamma", "digamma", "logit", "sigmoid", "expit",
+    "sum", "mean", "max", "min", "prod", "amax", "amin", "nansum", "nanmean",
+    "cumsum", "cumprod", "cummax", "cummin", "logcumsumexp", "logsumexp",
+    "clip", "cast", "isnan", "isinf", "isfinite", "nan_to_num",
+    "all", "any", "heaviside", "gcd", "lcm", "kron", "trace", "diagonal",
+    "angle", "conj", "real", "imag", "lerp", "rot90", "median", "nanmedian",
+    "quantile", "nanquantile", "std", "var", "count_nonzero", "add_n",
+    "inner", "outer", "stanh", "scale", "increment", "log_normalize",
+    "renorm", "take", "frexp", "ldexp", "hypot", "nextafter", "copysign",
+    "i0", "i0e", "i1", "i1e", "polygamma", "multiply_", "add_", "subtract_",
+    "divide_", "clip_", "scale_", "floor_", "ceil_", "exp_", "sqrt_",
+    "reciprocal_", "round_", "rsqrt_", "sigmoid_", "tanh_", "logaddexp",
+]
+
+
+# ---- binary elementwise ---------------------------------------------------
+def add(x, y, name=None):
+    return _binary(jnp.add, x, y, name="add")
+
+
+def subtract(x, y, name=None):
+    return _binary(jnp.subtract, x, y, name="subtract")
+
+
+def multiply(x, y, name=None):
+    return _binary(jnp.multiply, x, y, name="multiply")
+
+
+def divide(x, y, name=None):
+    return _binary(jnp.true_divide, x, y, name="divide")
+
+
+def floor_divide(x, y, name=None):
+    return _binary(jnp.floor_divide, x, y, name="floor_divide")
+
+
+def mod(x, y, name=None):
+    return _binary(jnp.mod, x, y, name="mod")
+
+
+remainder = mod
+
+
+def pow(x, y, name=None):
+    return _binary(jnp.power, x, y, name="pow")
+
+
+def float_power(x, y, name=None):
+    return _binary(lambda a, b: jnp.power(a.astype(jnp.float32),
+                                          b.astype(jnp.float32)), x, y,
+                   name="float_power")
+
+
+def maximum(x, y, name=None):
+    return _binary(jnp.maximum, x, y, name="maximum")
+
+
+def minimum(x, y, name=None):
+    return _binary(jnp.minimum, x, y, name="minimum")
+
+
+def fmax(x, y, name=None):
+    return _binary(jnp.fmax, x, y, name="fmax")
+
+
+def fmin(x, y, name=None):
+    return _binary(jnp.fmin, x, y, name="fmin")
+
+
+def atan2(x, y, name=None):
+    return _binary(jnp.arctan2, x, y, name="atan2")
+
+
+def heaviside(x, y, name=None):
+    return _binary(jnp.heaviside, x, y, name="heaviside")
+
+
+def gcd(x, y, name=None):
+    return _binary(jnp.gcd, x, y, name="gcd")
+
+
+def lcm(x, y, name=None):
+    return _binary(jnp.lcm, x, y, name="lcm")
+
+
+def logaddexp(x, y, name=None):
+    return _binary(jnp.logaddexp, x, y, name="logaddexp")
+
+
+def hypot(x, y, name=None):
+    return _binary(jnp.hypot, x, y, name="hypot")
+
+
+def nextafter(x, y, name=None):
+    return _binary(jnp.nextafter, x, y, name="nextafter")
+
+
+def copysign(x, y, name=None):
+    return _binary(jnp.copysign, x, y, name="copysign")
+
+
+def lerp(x, y, weight, name=None):
+    return nary(lambda a, b, w: a + w * (b - a), [x, y, weight], name="lerp")
+
+
+def kron(x, y, name=None):
+    return _binary(jnp.kron, x, y, name="kron")
+
+
+def inner(x, y, name=None):
+    return _binary(jnp.inner, x, y, name="inner")
+
+
+def outer(x, y, name=None):
+    return _binary(lambda a, b: jnp.outer(a, b), x, y, name="outer")
+
+
+# ---- unary elementwise ----------------------------------------------------
+def _make_unary(jfn, opname):
+    def op(x, name=None):
+        return _unary(jfn, x, name=opname)
+    op.__name__ = opname
+    return op
+
+
+exp = _make_unary(jnp.exp, "exp")
+expm1 = _make_unary(jnp.expm1, "expm1")
+log = _make_unary(jnp.log, "log")
+log2 = _make_unary(jnp.log2, "log2")
+log10 = _make_unary(jnp.log10, "log10")
+log1p = _make_unary(jnp.log1p, "log1p")
+sqrt = _make_unary(jnp.sqrt, "sqrt")
+rsqrt = _make_unary(jax.lax.rsqrt, "rsqrt")
+square = _make_unary(jnp.square, "square")
+abs = _make_unary(jnp.abs, "abs")
+sign = _make_unary(jnp.sign, "sign")
+sgn = sign
+floor = _make_unary(jnp.floor, "floor")
+ceil = _make_unary(jnp.ceil, "ceil")
+trunc = _make_unary(jnp.trunc, "trunc")
+reciprocal = _make_unary(jnp.reciprocal, "reciprocal")
+neg = _make_unary(jnp.negative, "neg")
+sin = _make_unary(jnp.sin, "sin")
+cos = _make_unary(jnp.cos, "cos")
+tan = _make_unary(jnp.tan, "tan")
+asin = _make_unary(jnp.arcsin, "asin")
+acos = _make_unary(jnp.arccos, "acos")
+atan = _make_unary(jnp.arctan, "atan")
+sinh = _make_unary(jnp.sinh, "sinh")
+cosh = _make_unary(jnp.cosh, "cosh")
+tanh = _make_unary(jnp.tanh, "tanh")
+asinh = _make_unary(jnp.arcsinh, "asinh")
+acosh = _make_unary(jnp.arccosh, "acosh")
+atanh = _make_unary(jnp.arctanh, "atanh")
+deg2rad = _make_unary(jnp.deg2rad, "deg2rad")
+rad2deg = _make_unary(jnp.rad2deg, "rad2deg")
+erf = _make_unary(jax.scipy.special.erf, "erf")
+erfinv = _make_unary(jax.scipy.special.erfinv, "erfinv")
+lgamma = _make_unary(jax.scipy.special.gammaln, "lgamma")
+digamma = _make_unary(jax.scipy.special.digamma, "digamma")
+sigmoid = _make_unary(jax.nn.sigmoid, "sigmoid")
+expit = sigmoid
+angle = _make_unary(jnp.angle, "angle")
+conj = _make_unary(jnp.conj, "conj")
+real = _make_unary(jnp.real, "real")
+imag = _make_unary(jnp.imag, "imag")
+i0 = _make_unary(jax.scipy.special.i0, "i0")
+i0e = _make_unary(jax.scipy.special.i0e, "i0e")
+i1 = _make_unary(jax.scipy.special.i1, "i1")
+i1e = _make_unary(jax.scipy.special.i1e, "i1e")
+
+
+def polygamma(x, n, name=None):
+    return _unary(lambda d: jax.scipy.special.polygamma(n, d), x,
+                  name="polygamma")
+
+
+def round(x, decimals=0, name=None):
+    return _unary(lambda d: jnp.round(d, decimals), x, name="round")
+
+
+def frac(x, name=None):
+    return _unary(lambda d: d - jnp.trunc(d), x, name="frac")
+
+
+def logit(x, eps=None, name=None):
+    def f(d):
+        z = jnp.clip(d, eps, 1 - eps) if eps else d
+        return jnp.log(z) - jnp.log1p(-z)
+    return _unary(f, x, name="logit")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return _unary(lambda d: scale_b * jnp.tanh(scale_a * d), x, name="stanh")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    def f(d):
+        out = d * scale + bias if bias_after_scale else (d + bias) * scale
+        return out
+    out = _unary(f, x, name="scale")
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def frexp(x, name=None):
+    x = ensure_tensor(x)
+    m, e = jnp.frexp(x._data)
+    return Tensor(m), Tensor(e)
+
+
+def ldexp(x, y, name=None):
+    return _binary(lambda a, b: jnp.ldexp(a, b.astype(jnp.int32)), x, y,
+                   name="ldexp")
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return _unary(lambda d: jnp.nan_to_num(d, nan=nan, posinf=posinf,
+                                           neginf=neginf), x, name="nan_to_num")
+
+
+def isnan(x, name=None):
+    return _unary(jnp.isnan, x, name="isnan")
+
+
+def isinf(x, name=None):
+    return _unary(jnp.isinf, x, name="isinf")
+
+
+def isfinite(x, name=None):
+    return _unary(jnp.isfinite, x, name="isfinite")
+
+
+def clip(x, min=None, max=None, name=None):
+    mn = min.item() if isinstance(min, Tensor) else min
+    mx = max.item() if isinstance(max, Tensor) else max
+    return _unary(lambda d: jnp.clip(d, mn, mx), x, name="clip")
+
+
+def cast(x, dtype):
+    x = ensure_tensor(x)
+    dt = to_jax_dtype(dtype)
+    if x._data.dtype == dt:
+        return x
+    return _unary(lambda d: d.astype(dt), x, name="cast")
+
+
+# ---- reductions -----------------------------------------------------------
+def _norm_axis(axis):
+    if isinstance(axis, Tensor):
+        axis = axis.numpy().tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return axis if axis is None else int(axis)
+
+
+def _make_reduce(jfn, opname, bool_to_int=False):
+    def op(x, axis=None, keepdim=False, name=None):
+        x = ensure_tensor(x)
+        ax = _norm_axis(axis)
+
+        def f(d):
+            if bool_to_int and d.dtype == jnp.bool_:
+                d = d.astype(jnp.int32)
+            return jfn(d, axis=ax, keepdims=keepdim)
+        return _unary(f, x, name=opname)
+    op.__name__ = opname
+    return op
+
+
+sum = _make_reduce(jnp.sum, "sum", bool_to_int=True)
+nansum = _make_reduce(jnp.nansum, "nansum")
+mean = _make_reduce(jnp.mean, "mean")
+nanmean = _make_reduce(jnp.nanmean, "nanmean")
+max = _make_reduce(jnp.max, "max")
+min = _make_reduce(jnp.min, "min")
+amax = _make_reduce(jnp.max, "amax")
+amin = _make_reduce(jnp.min, "amin")
+prod = _make_reduce(jnp.prod, "prod")
+all = _make_reduce(jnp.all, "all")
+any = _make_reduce(jnp.any, "any")
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return _unary(lambda d: jax.scipy.special.logsumexp(d, axis=ax,
+                                                        keepdims=keepdim),
+                  x, name="logsumexp")
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return _unary(lambda d: jnp.count_nonzero(d, axis=ax, keepdims=keepdim)
+                  .astype(jnp.int32), x, name="count_nonzero")
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return _unary(lambda d: jnp.std(d, axis=ax, ddof=1 if unbiased else 0,
+                                    keepdims=keepdim), x, name="std")
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return _unary(lambda d: jnp.var(d, axis=ax, ddof=1 if unbiased else 0,
+                                    keepdims=keepdim), x, name="var")
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = _norm_axis(axis)
+    if mode == "avg":
+        return _unary(lambda d: jnp.median(d, axis=ax, keepdims=keepdim), x,
+                      name="median")
+    # min mode: lower median
+    def f(d):
+        n = d.shape[ax] if ax is not None else d.size
+        k = (n - 1) // 2
+        s = jnp.sort(d, axis=ax) if ax is not None else jnp.sort(d.ravel())
+        return jnp.take(s, k, axis=ax if ax is not None else 0)
+    return _unary(f, x, name="median")
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return _unary(lambda d: jnp.nanmedian(d, axis=ax, keepdims=keepdim), x,
+                  name="nanmedian")
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    ax = _norm_axis(axis)
+    qv = q._data if isinstance(q, Tensor) else jnp.asarray(q)
+    return _unary(lambda d: jnp.quantile(d.astype(jnp.float32), qv, axis=ax,
+                                         keepdims=keepdim, method=interpolation),
+                  x, name="quantile")
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    qv = q._data if isinstance(q, Tensor) else jnp.asarray(q)
+    return _unary(lambda d: jnp.nanquantile(d.astype(jnp.float32), qv, axis=ax,
+                                            keepdims=keepdim), x,
+                  name="nanquantile")
+
+
+# ---- scans ----------------------------------------------------------------
+def cumsum(x, axis=None, dtype=None, name=None):
+    dt = to_jax_dtype(dtype) if dtype is not None else None
+    def f(d):
+        if axis is None:
+            return jnp.cumsum(d.ravel(), dtype=dt)
+        return jnp.cumsum(d, axis=axis, dtype=dt)
+    return _unary(f, x, name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    dt = to_jax_dtype(dtype) if dtype is not None else None
+    def f(d):
+        if dim is None:
+            return jnp.cumprod(d.ravel(), dtype=dt)
+        return jnp.cumprod(d, axis=dim, dtype=dt)
+    return _unary(f, x, name="cumprod")
+
+
+def _cum_extremum(x, axis, dtype, better):
+    """(values, indices) running extremum via one associative scan over
+    (value, index) pairs — O(n log n) depth, no O(n^2) blowup. Index of the
+    FIRST occurrence among ties (matches the reference kernel)."""
+    x = ensure_tensor(x)
+    ax = 0 if axis is None else axis
+    d = x._data.ravel() if axis is None else x._data
+    ax = ax % d.ndim
+    shape = [1] * d.ndim
+    shape[ax] = d.shape[ax]
+    idx0 = jnp.broadcast_to(
+        jnp.arange(d.shape[ax]).reshape(shape), d.shape)
+
+    def combine(a, b):
+        av, ai = a
+        bv, bi = b
+        # b is later in scan order; keep a on ties (first occurrence)
+        pick_b = better(bv, av)
+        return jnp.where(pick_b, bv, av), jnp.where(pick_b, bi, ai)
+
+    vals, idx = jax.lax.associative_scan(combine, (d, idx0), axis=ax)
+    return Tensor(vals), Tensor(idx.astype(to_jax_dtype(dtype)))
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    return _cum_extremum(x, axis, dtype, lambda b, a: b > a)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    return _cum_extremum(x, axis, dtype, lambda b, a: b < a)
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def f(d):
+        dd = d.ravel() if axis is None else d
+        ax = 0 if axis is None else axis
+        return jax.lax.associative_scan(jnp.logaddexp, dd, axis=ax)
+    return _unary(f, x, name="logcumsumexp")
+
+
+# ---- matrix-ish helpers kept in math for paddle-parity --------------------
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return _unary(lambda d: jnp.trace(d, offset=offset, axis1=axis1,
+                                      axis2=axis2), x, name="trace")
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return _unary(lambda d: jnp.diagonal(d, offset=offset, axis1=axis1,
+                                         axis2=axis2), x, name="diagonal")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return _unary(lambda d: jnp.rot90(d, k=k, axes=tuple(axes)), x,
+                  name="rot90")
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    return nary(lambda *ds: jnp.sum(jnp.stack(
+        [d.astype(jnp.result_type(*[dd.dtype for dd in ds])) for d in ds]),
+        axis=0), list(inputs), name="add_n")
+
+
+def increment(x, value=1.0, name=None):
+    x._data = x._data + value
+    return x
+
+
+def log_normalize(x, axis=-1, name=None):
+    return _unary(lambda d: d - jax.scipy.special.logsumexp(
+        d, axis=axis, keepdims=True), x, name="log_normalize")
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    def f(d):
+        dims = [i for i in range(d.ndim) if i != axis % d.ndim]
+        norms = jnp.sum(jnp.abs(d) ** p, axis=tuple(dims), keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return d * factor
+    return _unary(f, x, name="renorm")
+
+
+def take(x, index, mode="raise", name=None):
+    return nary(lambda d, i: jnp.take(d.ravel(), i.ravel(),
+                                      mode="clip" if mode != "wrap" else "wrap"
+                                      ).reshape(jnp.shape(i)),
+                [x, ensure_tensor(index)], name="take")
+
+
+# ---- in-place variants (rebind data) --------------------------------------
+def _make_inplace(fn):
+    def op(x, *args, **kwargs):
+        import weakref
+        out = fn(x, *args, **kwargs)
+        x._data = out._data
+        x._node = out._node
+        x._out_idx = out._out_idx
+        x.stop_gradient = out.stop_gradient and x.stop_gradient
+        if x._node is not None:
+            # repoint the tape node's output ref at the surviving tensor so
+            # backward finds cotangents accumulated under it
+            x._node.out_refs[x._out_idx] = weakref.ref(x)
+        return x
+    return op
+
+
+add_ = _make_inplace(add)
+subtract_ = _make_inplace(subtract)
+multiply_ = _make_inplace(multiply)
+divide_ = _make_inplace(divide)
+clip_ = _make_inplace(clip)
+scale_ = _make_inplace(scale)
+floor_ = _make_inplace(floor)
+ceil_ = _make_inplace(ceil)
+exp_ = _make_inplace(exp)
+sqrt_ = _make_inplace(sqrt)
+rsqrt_ = _make_inplace(rsqrt)
+reciprocal_ = _make_inplace(reciprocal)
+round_ = _make_inplace(round)
+sigmoid_ = _make_inplace(sigmoid)
+tanh_ = _make_inplace(tanh)

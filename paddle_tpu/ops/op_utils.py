@@ -1,0 +1,111 @@
+"""Op construction helpers.
+
+The reference routes every op through a codegen'd C++ dispatch chain
+(``paddle/phi/api/yaml/generator/api_gen.py`` → ``KernelFactory`` →
+per-backend kernel). On TPU there is exactly one backend (XLA), so "an op" is
+just a pure jax function plus tape recording — these helpers are the entire
+replacement for the kernel registry + dispatch layer
+(``paddle/phi/core/kernel_factory.h:61``).
+
+AMP note: ops that the reference's auto-cast white-list promotes (matmul,
+conv, ...) call ``maybe_autocast`` here, mirroring the AMP logic the
+reference injects into generated forward functions
+(``eager_gen.py:461 AMP_LOGIC_TEMPLATE``).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ..autograd import record
+from ..framework.dtype import to_jax_dtype
+
+
+def ensure_tensor(x, dtype=None) -> Tensor:
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(x, dtype=dtype)
+
+
+def const(x):
+    """Non-tensor operand: keep python scalars weakly typed, lift the rest."""
+    if isinstance(x, (int, float, bool, complex)):
+        return x
+    return jnp.asarray(x)
+
+
+def _wrap_single(raw, req):
+    t = Tensor(raw, stop_gradient=not req)
+    return [t], t
+
+
+def _wrap_tuple(raw, req):
+    ts = tuple(Tensor(r, stop_gradient=not req) for r in raw)
+    return list(ts), ts
+
+
+def unary(fn, x, name=""):
+    x = ensure_tensor(x)
+    return record(fn, [x], _wrap_single, name=name)
+
+
+def binary(fn, x, y, name=""):
+    tx, ty = isinstance(x, Tensor), isinstance(y, Tensor)
+    if tx and ty:
+        return record(fn, [x, y], _wrap_single, name=name)
+    if tx:
+        yv = const(y)
+        return record(lambda a: fn(a, yv), [x], _wrap_single, name=name)
+    if ty:
+        xv = const(x)
+        return record(lambda b: fn(xv, b), [y], _wrap_single, name=name)
+    return record(fn, [ensure_tensor(x), ensure_tensor(y)], _wrap_single,
+                  name=name)
+
+def ternary(fn, x, y, z, name=""):
+    return nary(fn, [x, y, z], name=name)
+
+
+def nary(fn, args, name="", n_out=1):
+    """fn over a mixed list of tensors/constants; constants closed over."""
+    tensors, slots = [], []
+    for a in args:
+        if isinstance(a, Tensor):
+            slots.append(len(tensors))
+            tensors.append(a)
+        else:
+            slots.append(const(a))
+
+    def packed(*datas):
+        vals = [datas[s] if isinstance(s, int) else s for s in slots]
+        return fn(*vals)
+
+    wrap = _wrap_single if n_out == 1 else _wrap_tuple
+    return record(packed, tensors, wrap, name=name)
+
+
+def multi_out(fn, args, name="", grad_mask=None):
+    """Op with tuple output (e.g. topk)."""
+    return nary(fn, args, name=name, n_out=2_0000)  # any != 1 triggers tuple
+
+
+def axis_tuple(axis, ndim):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) % ndim if a < 0 else int(a) for a in axis)
+    a = int(axis)
+    return a + ndim if a < 0 else a
+
+
+# -- AMP hook ---------------------------------------------------------------
+def maybe_autocast(op_name, *tensors):
+    """Cast inputs per active amp policy (O1 white/black list semantics,
+    ref ``python/paddle/amp/auto_cast.py:271 amp_guard``)."""
+    from .. import amp as _amp
+    state = _amp._current_state()
+    if state is None or not state.enable:
+        return tensors
+    return _amp._cast_for_op(state, op_name, tensors)

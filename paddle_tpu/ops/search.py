@@ -1,0 +1,230 @@
+"""Search / sort ops (ref: ``python/paddle/tensor/search.py``).
+
+Sorts and top-k lower to XLA's sort HLO; `unique`/`nonzero` have
+data-dependent shapes and are eager-only (the same ops are GPU-sync points in
+the reference too).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ..framework.dtype import to_jax_dtype
+from .op_utils import ensure_tensor, unary as _unary, nary
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "topk", "kthvalue", "mode",
+    "nonzero", "searchsorted", "bucketize", "index_select", "masked_select",
+    "unique", "unique_consecutive", "histogram", "histogramdd", "bincount",
+]
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    dt = to_jax_dtype(dtype)
+
+    def f(d):
+        out = jnp.argmax(d.ravel() if axis is None else d,
+                         axis=None if axis is None else axis,
+                         keepdims=keepdim if axis is not None else False)
+        return out.astype(dt)
+    return _unary(f, x, name="argmax")
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    dt = to_jax_dtype(dtype)
+
+    def f(d):
+        out = jnp.argmin(d.ravel() if axis is None else d,
+                         axis=None if axis is None else axis,
+                         keepdims=keepdim if axis is not None else False)
+        return out.astype(dt)
+    return _unary(f, x, name="argmin")
+
+
+def argsort(x, axis=-1, descending=False, stable=True, name=None):
+    def f(d):
+        idx = jnp.argsort(d, axis=axis, stable=stable,
+                          descending=descending)
+        return idx.astype(jnp.int32)
+    return _unary(f, x, name="argsort")
+
+
+def sort(x, axis=-1, descending=False, stable=True, name=None):
+    def f(d):
+        return jnp.sort(d, axis=axis, stable=stable, descending=descending)
+    return _unary(f, x, name="sort")
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    x = ensure_tensor(x)
+    kk = int(k.item()) if isinstance(k, Tensor) else int(k)
+    ax = (axis if axis is not None else -1) % x.ndim
+
+    def f(d):
+        dm = jnp.moveaxis(d, ax, -1)
+        if largest:
+            v, i = jax.lax.top_k(dm, kk)
+        else:
+            v, i = jax.lax.top_k(-dm, kk)
+            v = -v
+        return jnp.moveaxis(v, -1, ax), jnp.moveaxis(i.astype(jnp.int64 if False else jnp.int32), -1, ax)
+    return nary(f, [x], name="topk", n_out=2)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = axis % x.ndim
+
+    def f(d):
+        s = jnp.sort(d, axis=ax)
+        si = jnp.argsort(d, axis=ax)
+        v = jnp.take(s, k - 1, axis=ax)
+        i = jnp.take(si, k - 1, axis=ax)
+        if keepdim:
+            v, i = jnp.expand_dims(v, ax), jnp.expand_dims(i, ax)
+        return v, i.astype(jnp.int32)
+    return nary(f, [x], name="kthvalue", n_out=2)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = axis % x.ndim
+
+    def f(d):
+        s = jnp.sort(d, axis=ax)
+        # longest run of equal values along axis
+        dm = jnp.moveaxis(s, ax, -1)
+        n = dm.shape[-1]
+        eq = dm[..., :, None] == dm[..., None, :]
+        counts = eq.sum(-1)
+        best = jnp.argmax(counts, axis=-1)
+        vals = jnp.take_along_axis(dm, best[..., None], axis=-1)[..., 0]
+        # index of last occurrence in original order
+        orig = jnp.moveaxis(d, ax, -1)
+        match = (orig == vals[..., None]).astype(jnp.int32)
+        idx = jnp.argmax(match * (jnp.arange(n) + 1), axis=-1)
+        if keepdim:
+            vals, idx = vals[..., None], idx[..., None]
+            return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int32), -1, ax)
+        return vals, idx.astype(jnp.int32)
+    return nary(f, [x], name="mode", n_out=2)
+
+
+def nonzero(x, as_tuple=False):
+    x = ensure_tensor(x)
+    if isinstance(x._data, jax.core.Tracer):
+        raise RuntimeError("nonzero has data-dependent shape; eager only")
+    idx = np.nonzero(np.asarray(x._data))
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i)) for i in idx)
+    return Tensor(jnp.asarray(np.stack(idx, axis=1)))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    side = "right" if right else "left"
+    dt = jnp.int32
+
+    def f(s, v):
+        if s.ndim == 1:
+            return jnp.searchsorted(s, v, side=side).astype(dt)
+        return jax.vmap(lambda ss, vv: jnp.searchsorted(ss, vv, side=side))(
+            s.reshape(-1, s.shape[-1]), v.reshape(-1, v.shape[-1])
+        ).reshape(v.shape).astype(dt)
+    return nary(f, [ensure_tensor(sorted_sequence), ensure_tensor(values)],
+                name="searchsorted")
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def index_select(x, index, axis=0, name=None):
+    from .manipulation import index_select as _is
+    return _is(x, index, axis)
+
+
+def masked_select(x, mask, name=None):
+    from .manipulation import masked_select as _ms
+    return _ms(x, mask)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    if isinstance(x._data, jax.core.Tracer):
+        raise RuntimeError("unique has data-dependent shape; eager only "
+                           "(use jnp.unique with size= inside jit)")
+    arr = np.asarray(x._data)
+    out = np.unique(arr, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not (return_index or return_inverse or return_counts):
+        return Tensor(jnp.asarray(out))
+    outs = [Tensor(jnp.asarray(o)) for o in out]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    arr = np.asarray(x._data)
+    if axis is None:
+        arr = arr.ravel()
+        keep = np.concatenate([[True], arr[1:] != arr[:-1]])
+    else:
+        diff = (arr.take(range(1, arr.shape[axis]), axis=axis) !=
+                arr.take(range(arr.shape[axis] - 1), axis=axis))
+        keep = np.concatenate([[True], diff.any(
+            axis=tuple(i for i in range(arr.ndim) if i != axis))])
+    vals = arr[keep] if axis is None else arr.compress(keep, axis=axis)
+    outs = [Tensor(jnp.asarray(vals))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        outs.append(Tensor(jnp.asarray(inv)))
+    if return_counts:
+        pos = np.nonzero(keep)[0]
+        counts = np.diff(np.append(pos, keep.size))
+        outs.append(Tensor(jnp.asarray(counts)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def histogram(x, bins=100, min=0, max=0, weight=None, density=False, name=None):
+    x = ensure_tensor(x)
+
+    def f(d, *w):
+        lo, hi = (min, max) if (min != 0 or max != 0) else \
+            (d.min(), d.max())
+        h, _ = jnp.histogram(d.ravel(), bins=bins, range=(lo, hi),
+                             weights=w[0].ravel() if w else None,
+                             density=density)
+        return h if (density or w) else h.astype(jnp.int32)
+    args = [x] + ([ensure_tensor(weight)] if weight is not None else [])
+    return nary(f, args, name="histogram")
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    x = ensure_tensor(x)
+    h, edges = np.histogramdd(np.asarray(x._data), bins=bins, range=ranges,
+                              density=density,
+                              weights=np.asarray(weights._data) if weights is not None else None)
+    return Tensor(jnp.asarray(h)), [Tensor(jnp.asarray(e)) for e in edges]
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    x = ensure_tensor(x)
+    n = int(np.asarray(x._data).max()) + 1 if x.size else 0
+    length = builtins_max(n, minlength)
+
+    def f(d, *w):
+        return jnp.bincount(d.ravel().astype(jnp.int32),
+                            weights=w[0].ravel() if w else None,
+                            length=length)
+    args = [x] + ([ensure_tensor(weights)] if weights is not None else [])
+    return nary(f, args, name="bincount")
+
+
+def builtins_max(a, b):
+    return a if a > b else b

@@ -1,0 +1,360 @@
+"""The Tensor type.
+
+TPU-native re-design of the reference's tensor stack:
+ - ``phi::DenseTensor`` (``paddle/phi/core/dense_tensor.h:43``) +
+   ``paddle::Tensor`` (``paddle/phi/api/include/tensor.h:82``) +
+   the pybind eager Tensor (``paddle/fluid/pybind/eager.cc``), collapsed into
+   one Python class wrapping a ``jax.Array``.
+
+Memory, placement and layout are owned by XLA/PJRT (no allocator facade, no
+LoD, no layout transform pass — ``paddle/fluid/memory/allocation`` has no
+equivalent here by design). The autograd surface (``stop_gradient``, ``.grad``,
+``backward()``) matches the reference's dygraph tensor so training scripts
+carry over.
+
+Tensor is registered as a jax pytree, so Tensors can flow directly through
+``jax.jit`` / ``shard_map`` / optimizers as containers of their arrays.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from .framework import dtype as _dtype_mod  # noqa: F401  (module via package)
+from .framework.dtype import (DType, to_jax_dtype, default_jax_dtype,
+                              dtype as _as_dtype, _BY_NAME)
+from .framework.device import (CPUPlace, TPUPlace, Place, get_jax_device)
+
+__all__ = ["Tensor", "Parameter", "to_tensor", "is_tensor"]
+
+_tensor_count = 0
+
+
+def _next_name(prefix="generated_tensor"):
+    global _tensor_count
+    _tensor_count += 1
+    return f"{prefix}_{_tensor_count}"
+
+
+class Tensor:
+    __slots__ = ("_data", "stop_gradient", "_grad", "_node", "_out_idx",
+                 "name", "persistable", "_grad_hooks", "__weakref__",
+                 "trainable", "_spec")
+
+    __array_priority__ = 100  # win over numpy in mixed dunders
+
+    def __init__(self, data, dtype=None, place=None, stop_gradient=True,
+                 name=None):
+        if isinstance(data, Tensor):
+            data = data._data
+        if not isinstance(data, (jax.Array, jax.core.Tracer)):
+            data = np.asarray(data)
+            if dtype is None and data.dtype == np.float64:
+                data = data.astype(default_jax_dtype())
+            data = jnp.asarray(
+                data,
+                dtype=to_jax_dtype(dtype) if dtype is not None else None,
+                device=get_jax_device(place) if place is not None else None)
+        elif dtype is not None and data.dtype != to_jax_dtype(dtype):
+            data = data.astype(to_jax_dtype(dtype))
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        self._node = None
+        self._out_idx = 0
+        self.name = name or _next_name()
+        self.persistable = False
+        self.trainable = not stop_gradient
+        self._grad_hooks = None
+        self._spec = None  # optional jax PartitionSpec annotation (distributed)
+
+    # -- basic metadata ----------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    # paddle alias
+    @property
+    def dim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def dtype(self) -> DType:
+        return _as_dtype(np.dtype(self._data.dtype))
+
+    @property
+    def place(self) -> Place:
+        d = getattr(self._data, "devices", None)
+        if d is None or isinstance(self._data, jax.core.Tracer):
+            return TPUPlace(0)
+        dev = next(iter(self._data.devices()))
+        return CPUPlace() if dev.platform == "cpu" else TPUPlace(dev.id)
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    def element_size(self):
+        return np.dtype(self._data.dtype).itemsize
+
+    @property
+    def T(self):
+        from . import ops
+        return ops.manipulation.transpose(
+            self, list(range(self.ndim))[::-1]) if self.ndim > 1 else self
+
+    @property
+    def mT(self):
+        from . import ops
+        if self.ndim < 2:
+            raise ValueError("mT requires ndim >= 2")
+        perm = list(range(self.ndim))
+        perm[-1], perm[-2] = perm[-2], perm[-1]
+        return ops.manipulation.transpose(self, perm)
+
+    # -- value access ------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
+
+    def item(self, *args):
+        return self._data.item(*args) if args else np.asarray(self._data).item()
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError(
+                "The truth value of a Tensor with more than one element is "
+                "ambiguous (use .any()/.all()).")
+        return bool(np.asarray(self._data).item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __float__(self):
+        return float(self.item())
+
+    def __index__(self):
+        return int(self.item())
+
+    def __repr__(self):
+        grad_info = "" if self.stop_gradient else ", stop_gradient=False"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}"
+                f"{grad_info},\n       {np.asarray(self._data)!r})")
+
+    def __hash__(self):
+        return id(self)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # -- autograd surface --------------------------------------------------
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, value):
+        if value is not None and not isinstance(value, Tensor):
+            value = Tensor(value)
+        self._grad = value
+
+    def backward(self, grad_tensor=None, retain_graph=False):
+        autograd.backward([self], [grad_tensor] if grad_tensor is not None
+                          else None, retain_graph=retain_graph)
+
+    def clear_grad(self, set_to_zero=False):
+        if set_to_zero and self._grad is not None:
+            self._grad._data = jnp.zeros_like(self._grad._data)
+        else:
+            self._grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._data, stop_gradient=True)
+        return t
+
+    def detach_(self):
+        self._node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        from . import ops
+        return ops.math._unary(jnp.copy, self, name="clone")
+
+    def register_hook(self, hook):
+        """Hook called with the gradient when it is produced for this leaf
+        (ref: ``paddle.Tensor.register_hook``). Returns a handle with
+        ``remove()``."""
+        if self._grad_hooks is None:
+            self._grad_hooks = {}
+        hid = len(self._grad_hooks)
+        self._grad_hooks[hid] = hook
+
+        class _Handle:
+            def remove(_self):
+                self._grad_hooks.pop(hid, None)
+        return _Handle()
+
+    # -- conversion / movement --------------------------------------------
+    def astype(self, dtype) -> "Tensor":
+        from . import ops
+        return ops.math.cast(self, dtype)
+
+    cast = astype
+
+    def to(self, *args, **kwargs):
+        """Flexible .to(device|dtype|tensor) like the reference."""
+        out = self
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, (str, Place)):
+                if isinstance(a, str) and a.replace("paddle_tpu.", "") in \
+                        _BY_NAME:
+                    out = out.astype(a)
+                else:
+                    dev = get_jax_device(a if isinstance(a, (str, Place)) else None)
+                    out = Tensor(jax.device_put(out._data, dev),
+                                 stop_gradient=out.stop_gradient)
+            elif isinstance(a, (DType, np.dtype, type)):
+                out = out.astype(a)
+            elif isinstance(a, Tensor):
+                out = out.astype(a.dtype)
+        return out
+
+    def cpu(self):
+        return Tensor(jax.device_put(self._data, jax.devices("cpu")[0]),
+                      stop_gradient=self.stop_gradient)
+
+    def tpu(self, device_id=0, blocking=True):
+        return Tensor(jax.device_put(self._data, get_jax_device(f"tpu:{device_id}")),
+                      stop_gradient=self.stop_gradient)
+
+    cuda = tpu  # parity alias
+
+    def pin_memory(self):
+        return self
+
+    def contiguous(self):
+        return self
+
+    def is_contiguous(self):
+        return True
+
+    # -- indexing ----------------------------------------------------------
+    def __getitem__(self, idx):
+        from . import ops
+        return ops.manipulation._getitem(self, idx)
+
+    def __setitem__(self, idx, value):
+        from . import ops
+        ops.manipulation._setitem(self, idx, value)
+
+    # -- in-place value ops (rebind data; graph history of old value kept) --
+    def set_value(self, value):
+        value = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+        if tuple(value.shape) != tuple(self._data.shape):
+            raise ValueError(
+                f"set_value shape mismatch {value.shape} vs {self._data.shape}")
+        self._data = value.astype(self._data.dtype)
+        return self
+
+    def copy_(self, other, non_blocking=False):
+        return self.set_value(other)
+
+    def fill_(self, value):
+        self._data = jnp.full_like(self._data, value)
+        return self
+
+    def zero_(self):
+        self._data = jnp.zeros_like(self._data)
+        return self
+
+    # value_hook for optimizers: raw array access
+    @property
+    def data(self):
+        return self
+
+    @data.setter
+    def data(self, value):
+        self.set_value(value)
+
+    def _md5sum(self):
+        import hashlib
+        return hashlib.md5(np.ascontiguousarray(self.numpy()).tobytes()).hexdigest()
+
+
+class Parameter(Tensor):
+    """Trainable tensor (ref: ``python/paddle/fluid/framework.py Parameter``).
+
+    Created by ``Layer.create_parameter``; ``stop_gradient`` defaults False
+    and it is ``persistable`` (included in checkpoints).
+    """
+
+    __slots__ = ("optimize_attr", "regularizer", "do_model_average",
+                 "is_distributed", "need_clip")
+
+    def __init__(self, data, dtype=None, name=None, trainable=True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable,
+                         name=name or _next_name("param"))
+        self.persistable = True
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.do_model_average = None
+        self.is_distributed = False
+
+    def __repr__(self):
+        return (f"Parameter(name={self.name}, shape={self.shape}, "
+                f"dtype={self.dtype.name}, trainable={self.trainable},\n"
+                f"       {np.asarray(self._data)!r})")
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    """``paddle.to_tensor`` equivalent."""
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+# -- pytree registration ----------------------------------------------------
+def _flatten(t: Tensor):
+    return (t._data,), (type(t), t.stop_gradient)
+
+
+def _unflatten(aux, children):
+    cls, stop_gradient = aux
+    t = Tensor.__new__(cls)
+    Tensor.__init__(t, children[0], stop_gradient=stop_gradient)
+    return t
+
+
+jax.tree_util.register_pytree_node(Tensor, _flatten, _unflatten)
+jax.tree_util.register_pytree_node(
+    Parameter,
+    lambda t: ((t._data,), (Parameter, t.stop_gradient)),
+    _unflatten)

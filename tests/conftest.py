@@ -1,0 +1,40 @@
+"""Test config: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's testing trick of a fake device backend
+(`paddle/phi/backends/custom/fake_cpu_device.h`, custom_cpu plugin tests):
+multi-chip sharding logic is validated without TPU hardware by forcing the
+XLA CPU backend to expose 8 devices. MUST run before jax initializes.
+"""
+import os
+
+# FORCE cpu: the environment bakes JAX_PLATFORMS=axon (TPU tunnel) and a
+# sitecustomize registers that backend in every interpreter; unit tests must
+# never ride the tunnel (single-client, slow, bf16 default matmul).
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_COMPILATION_CACHE", "false")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+import jax  # noqa: E402
+
+# The environment's sitecustomize registers the TPU-tunnel backend and then
+# sets jax_platforms="axon,cpu" via config (which overrides the env var!).
+# Re-override to cpu-only BEFORE any backend initializes.
+jax.config.update("jax_platforms", "cpu")
+
+# numeric tests compare against float64 numpy: pin matmuls to true fp32
+# (the default 'bf16 passes' precision is the perf configuration, not the
+# numerics-test configuration)
+jax.config.update("jax_default_matmul_precision", "float32")
+
+
+@pytest.fixture(autouse=True)
+def _seed_all():
+    import paddle_tpu
+    paddle_tpu.seed(2024)
+    np.random.seed(2024)
+    yield

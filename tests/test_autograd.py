@@ -1,0 +1,158 @@
+"""Eager autograd tape (ref model: eager backward tests in
+test/legacy_test; engine re-design documented in paddle_tpu/autograd.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import Tensor, to_tensor
+
+
+class TestBackward:
+    def test_simple_chain(self):
+        x = to_tensor([2.0, 3.0], stop_gradient=False)
+        y = x * x + 1.0
+        loss = y.sum()
+        loss.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+    def test_two_branches(self):
+        x = to_tensor([1.0, 2.0], stop_gradient=False)
+        a = x * 2.0
+        b = x * 3.0
+        loss = (a + b).sum()
+        loss.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+
+    def test_matmul_grad(self):
+        a = to_tensor(np.ones((2, 3), np.float32), stop_gradient=False)
+        b = to_tensor(np.ones((3, 4), np.float32), stop_gradient=False)
+        loss = paddle_tpu.matmul(a, b).sum()
+        loss.backward()
+        np.testing.assert_allclose(a.grad.numpy(), 4 * np.ones((2, 3)))
+        np.testing.assert_allclose(b.grad.numpy(), 2 * np.ones((3, 4)))
+
+    def test_grad_accumulation(self):
+        x = to_tensor([1.0], stop_gradient=False)
+        (x * 2).backward()
+        (x * 3).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0])
+        x.clear_grad()
+        assert x.grad is None
+
+    def test_stop_gradient_cuts(self):
+        x = to_tensor([1.0], stop_gradient=False)
+        y = to_tensor([2.0], stop_gradient=True)
+        loss = (x * y).sum()
+        loss.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+        assert y.grad is None
+
+    def test_detach_cuts(self):
+        x = to_tensor([3.0], stop_gradient=False)
+        y = (x * 2).detach()
+        z = y * x
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+    def test_no_grad_context(self):
+        x = to_tensor([1.0], stop_gradient=False)
+        with paddle_tpu.no_grad():
+            y = x * 5
+        assert y.stop_gradient
+        assert y._node is None
+
+    def test_nonscalar_backward_raises(self):
+        x = to_tensor([1.0, 2.0], stop_gradient=False)
+        with pytest.raises(RuntimeError):
+            (x * 2).backward()
+
+    def test_backward_with_grad_tensor(self):
+        x = to_tensor([1.0, 2.0], stop_gradient=False)
+        y = x * 3
+        y.backward(to_tensor([1.0, 10.0]))
+        np.testing.assert_allclose(x.grad.numpy(), [3.0, 30.0])
+
+    def test_double_backward_raises_without_retain(self):
+        x = to_tensor([1.0], stop_gradient=False)
+        y = (x * x).sum()
+        y.backward(retain_graph=True)
+        y.backward(retain_graph=False)  # second ok because retained first
+        x.clear_grad()
+        z = (x * x).sum()
+        z.backward()
+        with pytest.raises(RuntimeError):
+            z.backward()
+
+    def test_multi_output_op_grad(self):
+        x = to_tensor([3.0, 1.0, 2.0], stop_gradient=False)
+        v, i = paddle_tpu.topk(x, 2)
+        v.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [1.0, 0.0, 1.0])
+
+    def test_broadcast_grad(self):
+        x = to_tensor(np.ones((2, 3), np.float32), stop_gradient=False)
+        b = to_tensor(np.ones((3,), np.float32), stop_gradient=False)
+        (x + b).sum().backward()
+        np.testing.assert_allclose(b.grad.numpy(), [2.0, 2.0, 2.0])
+
+    def test_deep_chain(self):
+        x = to_tensor([1.0], stop_gradient=False)
+        y = x
+        for _ in range(50):
+            y = y * 1.1
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [1.1 ** 50], rtol=1e-4)
+
+    def test_paddle_grad_api(self):
+        x = to_tensor([2.0], stop_gradient=False)
+        y = x * x
+        (gx,) = paddle_tpu.grad(y, x)
+        np.testing.assert_allclose(gx.numpy(), [4.0])
+        assert x.grad is None  # .grad untouched
+
+    def test_register_hook(self):
+        x = to_tensor([1.0], stop_gradient=False)
+        seen = []
+        h = x.register_hook(lambda g: seen.append(g.numpy()) or g * 2)
+        (x * 3).sum().backward()
+        assert len(seen) == 1
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
+        h.remove()
+
+    def test_int_op_no_grad_path(self):
+        x = to_tensor([1.0, 5.0, 3.0], stop_gradient=False)
+        am = paddle_tpu.argmax(x)
+        assert am.item() == 1  # int output, no crash in tape
+
+
+class TestPyLayer:
+    def test_custom_forward_backward(self):
+        class Double(paddle_tpu.autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, g):
+                return g * 2
+
+        x = to_tensor([1.0, 2.0], stop_gradient=False)
+        y = Double.apply(x)
+        np.testing.assert_allclose(y.numpy(), [2.0, 4.0])
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+class TestOpTestHarness:
+    def test_check_output_and_grad(self):
+        from op_test import check_output, check_grad
+        check_output(paddle_tpu.tanh, np.tanh, [np.random.rand(3, 4)])
+        check_grad(paddle_tpu.tanh, [np.random.rand(2, 3)])
+
+    def test_binary_grad(self):
+        from op_test import check_grad
+        a = np.random.rand(2, 2) + 0.5
+        b = np.random.rand(2, 2) + 0.5
+        check_grad(paddle_tpu.multiply, [a, b])
+        check_grad(paddle_tpu.divide, [a, b])

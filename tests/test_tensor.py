@@ -1,0 +1,203 @@
+"""Core Tensor semantics (ref model: test/legacy_test tensor tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import Tensor, to_tensor
+
+
+class TestCreation:
+    def test_to_tensor(self):
+        t = to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert t.shape == [2, 2]
+        assert t.dtype == paddle_tpu.float32
+        np.testing.assert_array_equal(t.numpy(), [[1, 2], [3, 4]])
+
+    def test_dtype_coercion(self):
+        assert to_tensor([1, 2]).dtype.is_integer
+        assert to_tensor([1.0], dtype="float32").dtype == "float32"
+        assert to_tensor(np.zeros(3, np.float64)).dtype == paddle_tpu.float32
+        t = to_tensor([1], dtype="bfloat16")
+        assert t.dtype == paddle_tpu.bfloat16
+
+    def test_factories(self):
+        assert paddle_tpu.zeros([2, 3]).shape == [2, 3]
+        assert paddle_tpu.ones([4]).numpy().sum() == 4
+        assert paddle_tpu.full([2], 7).numpy().tolist() == [7, 7]
+        assert paddle_tpu.arange(5).numpy().tolist() == [0, 1, 2, 3, 4]
+        assert paddle_tpu.eye(3).numpy().trace() == 3
+        assert paddle_tpu.linspace(0, 1, 5).shape == [5]
+        x = paddle_tpu.rand([3, 3])
+        assert paddle_tpu.zeros_like(x).shape == [3, 3]
+
+    def test_random_reproducible(self):
+        paddle_tpu.seed(42)
+        a = paddle_tpu.rand([4]).numpy()
+        paddle_tpu.seed(42)
+        b = paddle_tpu.rand([4]).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_randint(self):
+        t = paddle_tpu.randint(0, 10, [100])
+        assert t.numpy().min() >= 0 and t.numpy().max() < 10
+
+
+class TestArithmetic:
+    def test_binary_ops(self):
+        x = to_tensor([1.0, 2.0, 3.0])
+        y = to_tensor([4.0, 5.0, 6.0])
+        np.testing.assert_allclose((x + y).numpy(), [5, 7, 9])
+        np.testing.assert_allclose((x - y).numpy(), [-3, -3, -3])
+        np.testing.assert_allclose((x * y).numpy(), [4, 10, 18])
+        np.testing.assert_allclose((y / x).numpy(), [4, 2.5, 2])
+        np.testing.assert_allclose((x ** 2).numpy(), [1, 4, 9])
+
+    def test_scalar_broadcast(self):
+        x = to_tensor([1.0, 2.0])
+        np.testing.assert_allclose((x + 1).numpy(), [2, 3])
+        np.testing.assert_allclose((2 * x).numpy(), [2, 4])
+        np.testing.assert_allclose((1 - x).numpy(), [0, -1])
+
+    def test_matmul(self):
+        a = to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        b = to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+        c = a @ b
+        np.testing.assert_allclose(c.numpy(), a.numpy() @ b.numpy())
+        ct = paddle_tpu.matmul(b, a, transpose_x=True, transpose_y=True)
+        np.testing.assert_allclose(ct.numpy(), (a.numpy() @ b.numpy()).T)
+
+    def test_comparison(self):
+        x = to_tensor([1.0, 2.0, 3.0])
+        assert (x > 1.5).numpy().tolist() == [False, True, True]
+        assert (x == 2.0).numpy().tolist() == [False, True, False]
+
+    def test_reductions(self):
+        x = to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert x.sum().item() == 10
+        assert x.mean().item() == 2.5
+        assert x.max().item() == 4
+        np.testing.assert_allclose(x.sum(axis=0).numpy(), [4, 6])
+        np.testing.assert_allclose(x.sum(axis=1, keepdim=True).numpy(),
+                                   [[3], [7]])
+
+    def test_inplace(self):
+        x = to_tensor([1.0, 2.0])
+        x.add_(1.0)
+        np.testing.assert_allclose(x.numpy(), [2, 3])
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        x = to_tensor(np.arange(12, dtype=np.float32))
+        y = x.reshape([3, 4])
+        assert y.shape == [3, 4]
+        z = y.transpose([1, 0])
+        assert z.shape == [4, 3]
+        assert y.T.shape == [4, 3]
+
+    def test_concat_split_stack(self):
+        a = paddle_tpu.ones([2, 3])
+        b = paddle_tpu.zeros([2, 3])
+        c = paddle_tpu.concat([a, b], axis=0)
+        assert c.shape == [4, 3]
+        s = paddle_tpu.stack([a, b])
+        assert s.shape == [2, 2, 3]
+        parts = paddle_tpu.split(c, 2, axis=0)
+        assert len(parts) == 2 and parts[0].shape == [2, 3]
+        np.testing.assert_array_equal(parts[0].numpy(), a.numpy())
+
+    def test_indexing(self):
+        x = to_tensor(np.arange(24, dtype=np.float32).reshape(4, 6))
+        assert x[0].shape == [6]
+        assert x[1, 2].item() == 8
+        assert x[:, :3].shape == [4, 3]
+        assert x[::2].shape == [2, 6]
+        idx = to_tensor([0, 2])
+        assert x[idx].shape == [2, 6]
+
+    def test_bool_mask_indexing(self):
+        x = to_tensor([1.0, -2.0, 3.0, -4.0])
+        got = x[x < 0]
+        np.testing.assert_allclose(got.numpy(), [-2, -4])
+
+    def test_setitem(self):
+        x = paddle_tpu.zeros([3, 3])
+        x[1] = 5.0
+        assert x.numpy()[1].tolist() == [5, 5, 5]
+        x[0, 0] = 1.0
+        assert x[0, 0].item() == 1
+
+    def test_gather_scatter(self):
+        x = to_tensor(np.arange(12, dtype=np.float32).reshape(4, 3))
+        g = paddle_tpu.gather(x, to_tensor([0, 2]))
+        np.testing.assert_allclose(g.numpy(), x.numpy()[[0, 2]])
+        upd = paddle_tpu.scatter(x, to_tensor([1]), to_tensor([[9., 9., 9.]]))
+        assert upd.numpy()[1].tolist() == [9, 9, 9]
+
+    def test_where_topk_sort(self):
+        x = to_tensor([3.0, 1.0, 2.0])
+        v, i = paddle_tpu.topk(x, 2)
+        assert v.numpy().tolist() == [3, 2]
+        assert i.numpy().tolist() == [0, 2]
+        assert paddle_tpu.sort(x).numpy().tolist() == [1, 2, 3]
+        assert paddle_tpu.argsort(x).numpy().tolist() == [1, 2, 0]
+        w = paddle_tpu.where(x > 1.5, x, paddle_tpu.zeros_like(x))
+        assert w.numpy().tolist() == [3, 0, 2]
+
+    def test_pad_tile_flip(self):
+        x = to_tensor([[1.0, 2.0]])
+        assert paddle_tpu.tile(x, [2, 2]).shape == [2, 4]
+        assert paddle_tpu.flip(x, axis=1).numpy().tolist() == [[2, 1]]
+        # full-length pad spec pads dims first->last (paddle semantics)
+        p = paddle_tpu.pad(x, [1, 1, 0, 0])
+        assert p.shape == [3, 2]
+
+
+class TestAPI:
+    def test_item_and_conversions(self):
+        t = to_tensor(3.5)
+        assert t.item() == 3.5
+        assert float(t) == 3.5
+        assert to_tensor([[1, 2]]).tolist() == [[1, 2]]
+
+    def test_astype_cast(self):
+        x = to_tensor([1.9, 2.1])
+        y = x.astype("int32")
+        assert y.dtype == paddle_tpu.int32
+        assert y.numpy().tolist() == [1, 2]
+
+    def test_clone_detach(self):
+        x = to_tensor([1.0], stop_gradient=False)
+        d = x.detach()
+        assert d.stop_gradient
+        c = x.clone()
+        assert not c.stop_gradient
+
+    def test_numel_repr(self):
+        x = paddle_tpu.ones([2, 5])
+        assert x.size == 10
+        assert "Tensor" in repr(x)
+        assert x.element_size() == 4
+
+    def test_linalg(self):
+        a = np.array([[4.0, 1.0], [1.0, 3.0]], np.float32)
+        t = to_tensor(a)
+        np.testing.assert_allclose(paddle_tpu.linalg.inv(t).numpy(),
+                                   np.linalg.inv(a), atol=1e-5)
+        np.testing.assert_allclose(paddle_tpu.linalg.det(t).item(),
+                                   np.linalg.det(a), rtol=1e-5)
+        np.testing.assert_allclose(paddle_tpu.linalg.norm(t).item(),
+                                   np.linalg.norm(a), rtol=1e-5)
+
+    def test_einsum(self):
+        a = np.random.rand(2, 3).astype(np.float32)
+        b = np.random.rand(3, 4).astype(np.float32)
+        out = paddle_tpu.einsum("ij,jk->ik", to_tensor(a), to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+
+    def test_unique_nonzero(self):
+        x = to_tensor([1, 2, 2, 3, 1])
+        u = paddle_tpu.unique(x)
+        assert u.numpy().tolist() == [1, 2, 3]
+        nz = paddle_tpu.nonzero(to_tensor([0, 1, 0, 2]))
+        assert nz.numpy().ravel().tolist() == [1, 3]
