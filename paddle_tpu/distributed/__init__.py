@@ -1,0 +1,6 @@
+"""``paddle_tpu.distributed`` (ref: ``python/paddle/distributed/``).
+
+Grown incrementally: env/rank info first; mesh, collectives, fleet, and
+hybrid parallelism land in their own modules.
+"""
+from .env import get_rank, get_world_size, ParallelEnv  # noqa: F401

@@ -1,0 +1,84 @@
+"""Process/rank environment (ref: ``python/paddle/distributed/parallel.py
+ParallelEnv:646`` and the launcher env contract).
+
+Under the TPU runtime, ranks come from ``jax.process_index()`` once
+``jax.distributed`` is initialized; before that, from the launcher's env
+vars (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM — same names as the
+reference so launch tooling carries over).
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_rank", "get_world_size", "ParallelEnv"]
+
+
+def _jax_initialized():
+    import jax
+    try:
+        return jax.process_count() > 1
+    except Exception:
+        return False
+
+
+def get_rank(group=None):
+    if group is not None:
+        return group.get_group_rank(get_rank())
+    env = os.environ.get("PADDLE_TRAINER_ID")
+    if env is not None:
+        return int(env)
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    env = os.environ.get("PADDLE_TRAINERS_NUM")
+    if env is not None:
+        return int(env)
+    try:
+        import jax
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+class ParallelEnv:
+    """ref: parallel.py:646 ParallelEnv."""
+
+    def __init__(self):
+        self._rank = get_rank()
+        self._world_size = get_world_size()
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def local_rank(self):
+        return int(os.environ.get("PADDLE_LOCAL_RANK", self._rank))
+
+    @property
+    def world_size(self):
+        return self._world_size
+
+    @property
+    def nranks(self):
+        return self._world_size
+
+    @property
+    def dev_id(self):
+        return self.local_rank
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:6170")
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else [self.current_endpoint]

@@ -1,0 +1,84 @@
+"""paddle.save / paddle.load (ref: ``python/paddle/framework/io.py:278
+_pickle_save``).
+
+Same contract as the reference: pickle container with tensors converted to
+numpy; loads back into Tensors. Safety: loading uses a restricted
+unpickler that only reconstructs numpy arrays and builtin containers.
+"""
+from __future__ import annotations
+
+import io
+import os
+import pickle
+
+import numpy as np
+
+from ..tensor import Tensor
+
+__all__ = ["save", "load"]
+
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        return {"__tensor__": True, "data": np.asarray(obj._data),
+                "name": obj.name, "stop_gradient": obj.stop_gradient}
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_to_saveable(v) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def _from_saveable(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            if return_numpy:
+                return obj["data"]
+            t = Tensor(obj["data"], stop_gradient=obj.get("stop_gradient",
+                                                          True))
+            return t
+        return {k: _from_saveable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_from_saveable(v, return_numpy) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    """``paddle.save``: state_dicts, nested containers, single tensors."""
+    if hasattr(obj, "state_dict") and not isinstance(obj, dict):
+        obj = obj.state_dict()
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+class _SafeUnpickler(pickle.Unpickler):
+    _ALLOWED = {
+        ("numpy.core.multiarray", "_reconstruct"),
+        ("numpy._core.multiarray", "_reconstruct"),
+        ("numpy", "ndarray"),
+        ("numpy", "dtype"),
+        ("numpy.core.multiarray", "scalar"),
+        ("numpy._core.multiarray", "scalar"),
+        ("collections", "OrderedDict"),
+        ("ml_dtypes", "bfloat16"),
+        ("ml_dtypes", "float8_e4m3fn"),
+        ("ml_dtypes", "float8_e5m2"),
+    }
+
+    def find_class(self, module, name):
+        if (module, name) in self._ALLOWED or module.startswith("numpy"):
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"paddle_tpu.load refuses to unpickle {module}.{name}; "
+            "checkpoints may only contain arrays and containers")
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        obj = _SafeUnpickler(f).load()
+    return _from_saveable(obj, return_numpy=return_numpy)

@@ -51,11 +51,23 @@ class Generator:
         return self._seed
 
     def next_key(self, n: int | None = None):
-        """Draw `n` fresh keys (or one if n is None)."""
-        with self._lock:
-            c = self._counter
-            self._counter += (n or 1)
-            root = self._root_key()
+        """Draw `n` fresh keys (or one if n is None).
+
+        Under a functional trace (to_static / jitted train step), keys fold
+        from the per-call key tracer instead of host state, so dropout masks
+        are fresh on every call of the compiled program instead of baked in
+        as constants.
+        """
+        tk = _trace_key_state()
+        if tk is not None:
+            c = tk["counter"]
+            tk["counter"] += (n or 1)
+            root = tk["key"]
+        else:
+            with self._lock:
+                c = self._counter
+                self._counter += (n or 1)
+                root = self._root_key()
         if n is None:
             return jax.random.fold_in(root, c)
         return jax.vmap(lambda i: jax.random.fold_in(root, i))(
@@ -71,6 +83,26 @@ class Generator:
 
 
 default_generator = Generator(0)
+
+_trace_tls = threading.local()
+
+
+def _trace_key_state():
+    stack = getattr(_trace_tls, "stack", None)
+    return stack[-1] if stack else None
+
+
+@contextlib.contextmanager
+def trace_key_scope(key):
+    """While active, `next_key()` folds from `key` (a traced PRNG key)."""
+    stack = getattr(_trace_tls, "stack", None)
+    if stack is None:
+        stack = _trace_tls.stack = []
+    stack.append({"key": key, "counter": 0})
+    try:
+        yield
+    finally:
+        stack.pop()
 
 
 def seed(s: int) -> Generator:

@@ -1,0 +1,8 @@
+"""``paddle_tpu.io`` — data pipeline (ref: ``python/paddle/io/``)."""
+from .dataset import (Dataset, IterableDataset, TensorDataset,  # noqa: F401
+                      ComposeDataset, ChainDataset, Subset, ConcatDataset,
+                      random_split)
+from .sampler import (Sampler, SequenceSampler, RandomSampler,  # noqa: F401
+                      WeightedRandomSampler, BatchSampler,
+                      DistributedBatchSampler, SubsetRandomSampler)
+from .dataloader import DataLoader, default_collate_fn, get_worker_info  # noqa: F401

@@ -1,0 +1,5 @@
+"""``paddle_tpu.jit`` (ref: ``python/paddle/jit/__init__.py``)."""
+from .api import (to_static, not_to_static, StaticFunction, InputSpec,  # noqa: F401
+                  functional_call, enable_static, disable_static,
+                  in_dynamic_mode, ignore_module)
+from .save_load import save, load, TranslatedLayer  # noqa: F401

@@ -1,0 +1,309 @@
+"""to_static: trace-and-compile.
+
+TPU-native replacement for the reference's entire dygraph→static bridge:
+ - ``@to_static`` AST transformation (``python/paddle/jit/api.py:233``,
+   ``jit/dy2static/ast_transformer.py``) — NOT needed: jax tracing handles
+   python control flow natively (structured control flow via lax.cond/scan
+   where data-dependent).
+ - ``PartialProgramLayer`` + run_program op (``partial_program.py:150``,
+   ``paddle/fluid/eager/to_static/run_program_op_func.h:56``) — replaced by
+   one jitted pure function over (params, buffers, rng key, inputs).
+ - ``_ExecutorCache`` (``fluid/executor.py:701``) — replaced by jax.jit's
+   compile cache keyed on shapes/dtypes plus our static keys (arg tree
+   structure, python-scalar args, training mode).
+
+Eager interop: a call to a StaticFunction records ONE tape node whose vjp is
+the compiled backward — `loss.backward()` on a to_static model runs a fully
+compiled forward+backward.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from .. import autograd
+from ..framework import random as _random
+from ..nn.layer.layers import Layer
+
+__all__ = ["to_static", "not_to_static", "StaticFunction", "InputSpec",
+           "functional_call", "enable_static", "disable_static",
+           "in_dynamic_mode", "ignore_module"]
+
+_static_mode = False
+
+
+def enable_static():
+    global _static_mode
+    _static_mode = True
+
+
+def disable_static():
+    global _static_mode
+    _static_mode = False
+
+
+def in_dynamic_mode():
+    return not _static_mode
+
+
+def ignore_module(modules):
+    return None
+
+
+class InputSpec:
+    """Shape/dtype declaration (ref: ``paddle.static.InputSpec``).
+    None dims mean dynamic; to_static buckets compilation per concrete
+    shape (XLA requires static shapes)."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype.name, name)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
+
+
+def functional_call(layer: Layer, params: dict, buffers: dict, args=(),
+                    kwargs=None, training=None, forward_fn=None):
+    """Run `layer` as a pure function of (params, buffers, inputs).
+
+    Swaps the given arrays into the layer's parameter/buffer tensors, calls
+    forward, and returns (outputs, new_buffer_arrays). The layer's own
+    arrays are restored afterwards. This is the bridge that lets the
+    object-oriented Layer API compile to a single XLA program.
+    """
+    kwargs = kwargs or {}
+    named_p = dict(layer.named_parameters())
+    named_b = dict(layer.named_buffers())
+    saved_p = {k: t._data for k, t in named_p.items()}
+    saved_b = {k: t._data for k, t in named_b.items()}
+    saved_training = layer.training
+    try:
+        for k, arr in params.items():
+            named_p[k]._data = arr
+        for k, arr in buffers.items():
+            named_b[k]._data = arr
+        if training is not None and training != layer.training:
+            layer.train() if training else layer.eval()
+        with autograd.functional_guard():
+            # forward_fn overrides dispatch through layer.__call__ — needed
+            # when layer.forward itself has been replaced by a
+            # StaticFunction (to_static(layer)) to avoid re-entry
+            out = forward_fn(*args, **kwargs) if forward_fn is not None \
+                else layer(*args, **kwargs)
+        new_buffers = {k: named_b[k]._data for k in buffers}
+        return out, new_buffers
+    finally:
+        for k, arr in saved_p.items():
+            named_p[k]._data = arr
+        for k, arr in saved_b.items():
+            named_b[k]._data = arr
+        if training is not None and layer.training != saved_training:
+            layer.train() if saved_training else layer.eval()
+
+
+def _is_arraylike(x):
+    return isinstance(x, (jax.Array, jax.core.Tracer, np.ndarray))
+
+
+class StaticFunction:
+    """Compiled callable (ref: ``dy2static/program_translator.py:305``)."""
+
+    def __init__(self, function, input_spec=None, layer: Layer | None = None,
+                 build_strategy=None, backend=None, full_graph=True):
+        self._orig_fn = function
+        self._layer = layer
+        self._input_spec = input_spec
+        self._jitted = None
+        try:
+            functools.update_wrapper(self, function)
+        except AttributeError:
+            pass
+
+    @property
+    def layer(self):
+        return self._layer
+
+    def _build(self):
+        layer = self._layer
+        fn = self._orig_fn
+
+        def pure(params, buffers, key, traced, struct, traced_idx, statics,
+                 training):
+            # rebuild the (args, kwargs) pytree: traced arrays fill the
+            # traced slots (rewrapped as Tensors), static leaves fill theirs
+            n_leaves = len(traced) + len(statics)
+            leaves = [None] * n_leaves
+            for i, a in zip(traced_idx, traced):
+                leaves[i] = Tensor(a)
+            for i, v in statics:
+                leaves[i] = v
+            args, kwargs = jax.tree_util.tree_unflatten(struct, leaves)
+            with _random.trace_key_scope(key):
+                if layer is not None:
+                    out, new_buffers = functional_call(
+                        layer, params, buffers, args, kwargs,
+                        training=training, forward_fn=fn)
+                else:
+                    with autograd.functional_guard():
+                        out = fn(*args, **kwargs)
+                    new_buffers = {}
+            out_arrays = jax.tree_util.tree_map(
+                lambda t: t._data if isinstance(t, Tensor) else t, out,
+                is_leaf=lambda t: isinstance(t, Tensor))
+            return out_arrays, new_buffers
+
+        self._jitted = jax.jit(
+            pure, static_argnames=("struct", "traced_idx", "statics",
+                                   "training"))
+
+    def __call__(self, *args, **kwargs):
+        if self._jitted is None:
+            self._build()
+        layer = self._layer
+        training = layer.training if layer is not None else False
+
+        leaves, struct = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        traced_idx = []
+        traced_vals = []
+        tensor_slots = []  # (position in traced list, original Tensor)
+        statics = []
+        for i, leaf in enumerate(leaves):
+            if isinstance(leaf, Tensor):
+                tensor_slots.append((len(traced_vals), leaf))
+                traced_idx.append(i)
+                traced_vals.append(leaf._data)
+            elif _is_arraylike(leaf):
+                traced_idx.append(i)
+                traced_vals.append(jnp.asarray(leaf))
+            else:
+                try:
+                    hash(leaf)
+                    statics.append((i, leaf))
+                except TypeError:
+                    traced_idx.append(i)
+                    traced_vals.append(jnp.asarray(leaf))
+        traced_idx_t = tuple(traced_idx)
+        statics_t = tuple(statics)
+
+        params = dict(layer.named_parameters()) if layer is not None else {}
+        buffers = dict(layer.named_buffers()) if layer is not None else {}
+        p_names = sorted(params)
+        b_names = sorted(buffers)
+        p_tensors = [params[k] for k in p_names]
+        b_arrays = {k: buffers[k]._data for k in b_names}
+        key = _random.next_key()
+        jitted = self._jitted
+
+        def run(p_arrays, traced_list):
+            pd = dict(zip(p_names, p_arrays))
+            return jitted(pd, b_arrays, key, traced_list, struct,
+                          traced_idx_t, statics_t, training)
+
+        grad_tensors = [t for _, t in tensor_slots if not t.stop_gradient]
+        needs_grad = (autograd.is_grad_enabled()
+                      and not autograd.in_functional_mode()
+                      and (any(not p.stop_gradient for p in p_tensors)
+                           or bool(grad_tensors)))
+        if needs_grad:
+            grad_slots = [pos for pos, t in tensor_slots
+                          if not t.stop_gradient]
+
+            def for_vjp(p_arrays, *g_args):
+                tl = list(traced_vals)
+                for pos, a in zip(grad_slots, g_args):
+                    tl[pos] = a
+                return run(p_arrays, tl)
+
+            (out_arrays, new_buffers), vjp_fn = jax.vjp(
+                for_vjp, [p._data for p in p_tensors],
+                *[traced_vals[pos] for pos in grad_slots])
+
+            out_leaves, out_struct = jax.tree_util.tree_flatten(out_arrays)
+            out_tensors = [Tensor(a, stop_gradient=False) for a in out_leaves]
+            nb_zero = jax.tree_util.tree_map(jnp.zeros_like, new_buffers)
+
+            def node_vjp(cots):
+                cot_list = list(cots) if isinstance(cots, tuple) else [cots]
+                cot_tree = jax.tree_util.tree_unflatten(out_struct, cot_list)
+                gp, *gargs = vjp_fn((cot_tree, nb_zero))
+                return tuple(gp) + tuple(gargs)
+
+            node_inputs = p_tensors + [t for _, t in tensor_slots
+                                       if not t.stop_gradient]
+            node = autograd.Node(node_inputs, node_vjp, out_tensors,
+                                 name="to_static")
+            for i, t in enumerate(out_tensors):
+                t._node = node
+                t._out_idx = i
+            result = jax.tree_util.tree_unflatten(out_struct, out_tensors)
+        else:
+            out_arrays, new_buffers = run([p._data for p in p_tensors],
+                                          traced_vals)
+            result = jax.tree_util.tree_map(
+                lambda a: Tensor(a) if _is_arraylike(a) else a, out_arrays)
+
+        if layer is not None and new_buffers:
+            named_b = dict(layer.named_buffers())
+            for k, arr in new_buffers.items():
+                named_b[k]._data = arr
+        return result
+
+    # paddle parity helpers -------------------------------------------------
+    @property
+    def code(self):
+        import inspect
+        try:
+            return inspect.getsource(self._orig_fn)
+        except (OSError, TypeError):
+            return "<source unavailable>"
+
+    def concrete_program_specify_input_spec(self, *a, **k):
+        return None
+
+    def rollback(self):
+        if self._layer is not None and hasattr(self._layer, "_orig_forward"):
+            self._layer.forward = self._layer._orig_forward
+        return self._orig_fn
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """``paddle.jit.to_static`` equivalent (decorator or direct call).
+
+    Accepts a Layer (converts its forward in place and returns the layer),
+    a bound method of a Layer, or a plain function.
+    """
+
+    def decorate(obj):
+        if isinstance(obj, Layer):
+            sf = StaticFunction(obj.forward, input_spec, layer=obj,
+                                build_strategy=build_strategy)
+            obj._orig_forward = obj.forward
+            obj.forward = sf
+            return obj
+        self_layer = getattr(obj, "__self__", None)
+        if isinstance(self_layer, Layer):
+            return StaticFunction(obj, input_spec, layer=self_layer,
+                                  build_strategy=build_strategy)
+        return StaticFunction(obj, input_spec, layer=None,
+                              build_strategy=build_strategy)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(function=None):
+    return function

@@ -1,0 +1,125 @@
+"""jit.save / jit.load (ref: ``python/paddle/jit/api.py save/load`` and the
+C++ serializer ``paddle/fluid/jit/``).
+
+TPU-native format: StableHLO via ``jax.export`` (+ a .pdiparams-style npz of
+parameters and a JSON manifest). The exported artifact is hardware-portable
+and re-loadable without the python model class — same contract as the
+reference's saved inference programs.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ..nn.layer.layers import Layer
+from .api import StaticFunction, InputSpec, to_static, functional_call
+
+__all__ = ["save", "load", "TranslatedLayer"]
+
+
+def _spec_to_aval(spec: InputSpec):
+    from ..framework.dtype import to_jax_dtype
+    shape = tuple(1 if s is None or s < 0 else int(s) for s in spec.shape)
+    return jax.ShapeDtypeStruct(shape, to_jax_dtype(spec.dtype))
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Serialize layer to `path` + {.json, .npz, .stablehlo}."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    if isinstance(layer, StaticFunction):
+        sf = layer
+        layer = sf._layer
+    if not isinstance(layer, Layer):
+        raise TypeError("jit.save expects a Layer (or to_static Layer)")
+
+    was_training = layer.training
+    layer.eval()
+    try:
+        params = {k: np.asarray(p._data) for k, p in layer.named_parameters()}
+        buffers = {k: np.asarray(b._data) for k, b in layer.named_buffers()}
+
+        if input_spec is None:
+            raise ValueError(
+                "jit.save requires input_spec (XLA export needs concrete "
+                "shapes); pass e.g. input_spec=[InputSpec([1, 3, 224, 224])]")
+        specs = [s if isinstance(s, InputSpec) else InputSpec.from_tensor(s)
+                 for s in input_spec]
+        avals = [_spec_to_aval(s) for s in specs]
+
+        fwd = getattr(layer, "_orig_forward", layer.forward)
+        if isinstance(fwd, StaticFunction):
+            fwd = fwd._orig_fn
+
+        def pure(p, b, *inputs):
+            args = [Tensor(x) for x in inputs]
+            out, _ = functional_call(layer, p, b, tuple(args),
+                                     training=False, forward_fn=fwd)
+            return jax.tree_util.tree_map(
+                lambda t: t._data if isinstance(t, Tensor) else t, out,
+                is_leaf=lambda t: isinstance(t, Tensor))
+
+        p_tree = {k: jnp.asarray(v) for k, v in params.items()}
+        b_tree = {k: jnp.asarray(v) for k, v in buffers.items()}
+        exported = jax.export.export(jax.jit(pure))(p_tree, b_tree, *avals)
+        blob = exported.serialize()
+
+        with open(path + ".stablehlo", "wb") as f:
+            f.write(blob)
+        np.savez(path + ".pdiparams.npz", **params,
+                 **{f"__buffer__{k}": v for k, v in buffers.items()})
+        manifest = {
+            "format": "paddle_tpu.jit.v1",
+            "input_specs": [{"shape": list(s.shape), "dtype": str(s.dtype)}
+                            for s in specs],
+            "param_names": sorted(params),
+            "buffer_names": sorted(buffers),
+        }
+        with open(path + ".json", "w") as f:
+            json.dump(manifest, f, indent=2)
+    finally:
+        if was_training:
+            layer.train()
+    return path
+
+
+class TranslatedLayer(Layer):
+    """Loaded inference layer (ref: ``translated_layer.py TranslatedLayer``)."""
+
+    def __init__(self, exported, params, buffers, manifest):
+        super().__init__()
+        self._exported = exported
+        self._manifest = manifest
+        self._param_arrays = {k: jnp.asarray(v) for k, v in params.items()}
+        self._buffer_arrays = {k: jnp.asarray(v) for k, v in buffers.items()}
+        from ..tensor import Parameter
+        for k, v in self._param_arrays.items():
+            self.add_parameter(k.replace(".", "__"), Parameter(v,
+                                                               trainable=False))
+
+    def forward(self, *inputs):
+        arrays = [x._data if isinstance(x, Tensor) else jnp.asarray(x)
+                  for x in inputs]
+        out = self._exported.call(self._param_arrays, self._buffer_arrays,
+                                  *arrays)
+        return jax.tree_util.tree_map(
+            lambda a: Tensor(a) if isinstance(a, jax.Array) else a, out)
+
+
+def load(path, **configs):
+    with open(path + ".stablehlo", "rb") as f:
+        exported = jax.export.deserialize(f.read())
+    data = np.load(path + ".pdiparams.npz")
+    params, buffers = {}, {}
+    for k in data.files:
+        if k.startswith("__buffer__"):
+            buffers[k[len("__buffer__"):]] = data[k]
+        else:
+            params[k] = data[k]
+    with open(path + ".json") as f:
+        manifest = json.load(f)
+    return TranslatedLayer(exported, params, buffers, manifest)

@@ -1,0 +1,5 @@
+"""``paddle_tpu.optimizer`` (ref: ``python/paddle/optimizer/__init__.py``)."""
+from .optimizer import (Optimizer, SGD, Momentum, Adagrad, Adadelta,  # noqa: F401
+                        RMSProp)
+from .adam import Adam, AdamW, Adamax, Lamb, NAdam, RAdam  # noqa: F401
+from . import lr  # noqa: F401

@@ -1,0 +1,184 @@
+"""Adam family (ref: ``python/paddle/optimizer/{adam,adamw,adamax,lamb}.py``).
+
+The reference dispatches to fused CUDA kernels (``_C_ops.adam_``,
+``multi_tensor_adam``); here the pure `_update` compiles to one fused XLA
+kernel per parameter — and inside a jitted train step, the whole parameter
+tree updates in a single program with no per-tensor launch overhead at all.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+__all__ = ["Adam", "AdamW", "Adamax", "Lamb", "NAdam", "RAdam"]
+
+
+class Adam(Optimizer):
+    _state_slots = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=True,
+                 use_multi_tensor=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._hyper = {"beta1": beta1, "beta2": beta2, "epsilon": epsilon,
+                       "amsgrad": amsgrad}
+        if amsgrad:
+            # instance-level override (never mutate the class attribute)
+            self._state_slots = ("moment1", "moment2", "moment2_max")
+            self._accumulators = {s: {} for s in self._state_slots}
+
+    @staticmethod
+    def _update(p, g, state, lr, step=1, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, amsgrad=False):
+        m1, m2 = state[0], state[1]
+        t = jnp.maximum(step, 1).astype(jnp.float32)
+        m1_new = beta1 * m1 + (1 - beta1) * g
+        m2_new = beta2 * m2 + (1 - beta2) * g * g
+        bc1 = 1 - beta1 ** t
+        bc2 = 1 - beta2 ** t
+        m1_hat = m1_new / bc1
+        if amsgrad:
+            m2_max = jnp.maximum(state[2], m2_new)
+            m2_hat = m2_max / bc2
+            new_state = (m1_new, m2_new, m2_max)
+        else:
+            m2_hat = m2_new / bc2
+            new_state = (m1_new, m2_new)
+        return p - lr * m1_hat / (jnp.sqrt(m2_hat) + epsilon), new_state
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (default coeff 0.01 like the reference)."""
+
+    _decoupled_wd = True
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=True, amsgrad=False,
+                 name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         amsgrad=amsgrad, name=name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _param_weight_decay(self, p):
+        if self._apply_decay_param_fun is not None and \
+                not self._apply_decay_param_fun(p.name):
+            return 0.0
+        return super()._param_weight_decay(p)
+
+
+class Adamax(Optimizer):
+    _state_slots = ("moment", "inf_norm")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._hyper = {"beta1": beta1, "beta2": beta2, "epsilon": epsilon}
+
+    @staticmethod
+    def _update(p, g, state, lr, step=1, beta1=0.9, beta2=0.999,
+                epsilon=1e-8):
+        m, u = state
+        t = jnp.maximum(step, 1).astype(jnp.float32)
+        m_new = beta1 * m + (1 - beta1) * g
+        u_new = jnp.maximum(beta2 * u, jnp.abs(g))
+        bc1 = 1 - beta1 ** t
+        return p - lr / bc1 * m_new / (u_new + epsilon), (m_new, u_new)
+
+
+class Lamb(Optimizer):
+    """Layer-wise adaptive moments (ref: optimizer/lamb.py) — the
+    large-batch optimizer; trust ratio per parameter tensor."""
+
+    _state_slots = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         multi_precision)
+        self._hyper = {"beta1": beta1, "beta2": beta2, "epsilon": epsilon,
+                       "lamb_wd": lamb_weight_decay}
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    @staticmethod
+    def _update(p, g, state, lr, step=1, beta1=0.9, beta2=0.999,
+                epsilon=1e-6, lamb_wd=0.01):
+        m1, m2 = state
+        t = jnp.maximum(step, 1).astype(jnp.float32)
+        m1_new = beta1 * m1 + (1 - beta1) * g
+        m2_new = beta2 * m2 + (1 - beta2) * g * g
+        m1_hat = m1_new / (1 - beta1 ** t)
+        m2_hat = m2_new / (1 - beta2 ** t)
+        r = m1_hat / (jnp.sqrt(m2_hat) + epsilon) + lamb_wd * p
+        w_norm = jnp.linalg.norm(p)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return p - lr * trust * r, (m1_new, m2_new)
+
+
+class NAdam(Optimizer):
+    _state_slots = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=True,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._hyper = {"beta1": beta1, "beta2": beta2, "epsilon": epsilon,
+                       "psi": momentum_decay}
+
+    @staticmethod
+    def _update(p, g, state, lr, step=1, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, psi=0.004):
+        m1, m2 = state
+        t = jnp.maximum(step, 1).astype(jnp.float32)
+        mu_t = beta1 * (1 - 0.5 * 0.96 ** (t * psi))
+        mu_t1 = beta1 * (1 - 0.5 * 0.96 ** ((t + 1) * psi))
+        m1_new = beta1 * m1 + (1 - beta1) * g
+        m2_new = beta2 * m2 + (1 - beta2) * g * g
+        m1_hat = mu_t1 * m1_new / (1 - mu_t * mu_t1) + \
+            (1 - mu_t) * g / (1 - mu_t)
+        m2_hat = m2_new / (1 - beta2 ** t)
+        return p - lr * m1_hat / (jnp.sqrt(m2_hat) + epsilon), \
+            (m1_new, m2_new)
+
+
+class RAdam(Optimizer):
+    _state_slots = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._hyper = {"beta1": beta1, "beta2": beta2, "epsilon": epsilon}
+
+    @staticmethod
+    def _update(p, g, state, lr, step=1, beta1=0.9, beta2=0.999,
+                epsilon=1e-8):
+        m1, m2 = state
+        t = jnp.maximum(step, 1).astype(jnp.float32)
+        rho_inf = 2.0 / (1 - beta2) - 1
+        m1_new = beta1 * m1 + (1 - beta1) * g
+        m2_new = beta2 * m2 + (1 - beta2) * g * g
+        bc1 = 1 - beta1 ** t
+        bc2 = 1 - beta2 ** t
+        rho_t = rho_inf - 2 * t * (beta2 ** t) / bc2
+        m1_hat = m1_new / bc1
+        r = jnp.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf) /
+                     jnp.maximum((rho_inf - 4) * (rho_inf - 2) * rho_t, 1e-8))
+        adaptive = r * m1_hat / (jnp.sqrt(m2_new / bc2) + epsilon)
+        sgd_like = m1_hat
+        return p - lr * jnp.where(rho_t > 5.0, adaptive, sgd_like), \
+            (m1_new, m2_new)

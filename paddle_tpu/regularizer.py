@@ -1,0 +1,27 @@
+"""Weight-decay regularizers (ref: ``python/paddle/regularizer.py``).
+
+Applied by the optimizer inside the fused update kernel — there is no
+separate regularization op pass like the reference's append_regularization.
+"""
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self._coeff})"
+
+
+class L1Decay(WeightDecayRegularizer):
+    pass
+
+
+class L2Decay(WeightDecayRegularizer):
+    pass

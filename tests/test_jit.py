@@ -1,0 +1,218 @@
+"""to_static / jit compile path (ref model: test/dygraph_to_static/)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import to_tensor
+from paddle_tpu.jit import to_static, InputSpec
+
+
+class SmallNet(pt.nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = pt.nn.Linear(4, 16)
+        self.fc2 = pt.nn.Linear(16, 2)
+
+    def forward(self, x):
+        h = pt.nn.functional.relu(self.fc1(x))
+        return self.fc2(h)
+
+
+def test_to_static_matches_eager():
+    pt.seed(1)
+    net = SmallNet()
+    x = to_tensor(np.random.rand(3, 4).astype(np.float32))
+    eager_out = net(x).numpy()
+    snet = to_static(net)
+    static_out = snet(x).numpy()
+    np.testing.assert_allclose(eager_out, static_out, rtol=1e-5, atol=1e-6)
+
+
+def test_to_static_function():
+    @to_static
+    def f(a, b):
+        return a * 2 + b
+
+    out = f(to_tensor([1.0, 2.0]), to_tensor([10.0, 20.0]))
+    np.testing.assert_allclose(out.numpy(), [12.0, 24.0])
+
+
+def test_to_static_backward():
+    pt.seed(2)
+    net = to_static(SmallNet())
+    x = to_tensor(np.random.rand(8, 4).astype(np.float32))
+    y = to_tensor(np.random.randint(0, 2, 8))
+    loss = pt.nn.CrossEntropyLoss()(net(x), y)
+    loss.backward()
+    grads_static = [p.grad.numpy().copy() for p in net.parameters()]
+
+    # same weights, eager path
+    net.forward.rollback()
+    for p in net.parameters():
+        p.clear_grad()
+    loss2 = pt.nn.CrossEntropyLoss()(net(x), y)
+    loss2.backward()
+    grads_eager = [p.grad.numpy() for p in net.parameters()]
+    for gs, ge in zip(grads_static, grads_eager):
+        np.testing.assert_allclose(gs, ge, rtol=1e-4, atol=1e-6)
+
+
+def test_to_static_training_loop_converges():
+    pt.seed(3)
+    np.random.seed(3)
+    X = np.random.randn(64, 4).astype(np.float32)
+    Y = (X.sum(1) > 0).astype(np.int64)
+    net = to_static(SmallNet())
+    opt = pt.optimizer.Adam(learning_rate=0.05, parameters=net.parameters())
+    losses = []
+    for _ in range(30):
+        loss = pt.nn.CrossEntropyLoss()(net(to_tensor(X)), to_tensor(Y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_to_static_batchnorm_buffers_update():
+    class BNNet(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.bn = pt.nn.BatchNorm1D(4, data_format="NCL")
+
+        def forward(self, x):
+            return self.bn(x)
+
+    pt.seed(4)
+    net = BNNet()
+    snet = to_static(net)
+    x = to_tensor(np.random.rand(8, 4, 6).astype(np.float32) + 5.0)
+    before = net.bn._mean.numpy().copy()
+    snet(x)
+    after = net.bn._mean.numpy()
+    assert not np.allclose(before, after), "running mean must update"
+
+
+def test_to_static_dropout_fresh_masks():
+    class DropNet(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.drop = pt.nn.Dropout(0.5)
+
+        def forward(self, x):
+            return self.drop(x)
+
+    net = to_static(DropNet())
+    net.train()
+    x = to_tensor(np.ones((4, 32), np.float32))
+    a = net(x).numpy()
+    b = net(x).numpy()
+    assert not np.allclose(a, b), "dropout mask must differ across calls"
+    net.eval()
+    c = net(x).numpy()
+    np.testing.assert_allclose(c, np.ones_like(c))
+
+
+def test_control_flow_via_python():
+    @to_static
+    def f(x, flag):
+        if flag:  # static python branch — becomes part of the jit key
+            return x * 2
+        return x * 3
+
+    x = to_tensor([1.0])
+    assert f(x, True).numpy()[0] == 2
+    assert f(x, False).numpy()[0] == 3
+
+
+def test_jit_save_load(tmp_path):
+    pt.seed(5)
+    net = SmallNet()
+    x = np.random.rand(2, 4).astype(np.float32)
+    expect = net(to_tensor(x)).numpy()
+    path = str(tmp_path / "model")
+    pt.jit.save(net, path, input_spec=[InputSpec([2, 4], "float32")])
+    loaded = pt.jit.load(path)
+    got = loaded(to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_paddle_save_load_roundtrip(tmp_path):
+    net = SmallNet()
+    opt = pt.optimizer.Adam(learning_rate=0.01, parameters=net.parameters())
+    path = str(tmp_path / "ckpt.pdparams")
+    pt.save(net.state_dict(), path)
+    loaded = pt.load(path)
+    net2 = SmallNet()
+    net2.set_state_dict(loaded)
+    x = to_tensor(np.random.rand(2, 4).astype(np.float32))
+    np.testing.assert_allclose(net(x).numpy(), net2(x).numpy(), rtol=1e-6)
+
+
+def test_save_load_rejects_malicious_pickle(tmp_path):
+    import pickle
+
+    class Evil:
+        def __reduce__(self):
+            return (os.system, ("echo pwned",))
+
+    path = str(tmp_path / "evil.pdparams")
+    with open(path, "wb") as f:
+        pickle.dump({"w": Evil()}, f)
+    with pytest.raises(Exception):
+        pt.load(path)
+
+
+class TestDataLoader:
+    def _dataset(self, n=20):
+        class DS(pt.io.Dataset):
+            def __getitem__(self, i):
+                return (np.full((3,), i, np.float32),
+                        np.asarray(i % 2, np.int64))
+
+            def __len__(self):
+                return n
+        return DS()
+
+    def test_basic_batching(self):
+        dl = pt.io.DataLoader(self._dataset(), batch_size=4)
+        batches = list(dl)
+        assert len(batches) == 5
+        xb, yb = batches[0]
+        assert xb.shape == [4, 3]
+        assert yb.shape == [4]
+
+    def test_shuffle_and_drop_last(self):
+        dl = pt.io.DataLoader(self._dataset(10), batch_size=3, shuffle=True,
+                              drop_last=True)
+        batches = list(dl)
+        assert len(batches) == 3
+
+    def test_multiprocess_workers(self):
+        dl = pt.io.DataLoader(self._dataset(16), batch_size=4, num_workers=2)
+        batches = list(dl)
+        assert len(batches) == 4
+        seen = sorted({int(v) for xb, _ in batches
+                       for v in xb.numpy()[:, 0]})
+        assert seen == list(range(16))
+
+    def test_tensor_dataset_and_random_split(self):
+        X = np.random.rand(10, 2).astype(np.float32)
+        Y = np.arange(10)
+        ds = pt.io.TensorDataset([X, Y])
+        a, b = pt.io.random_split(ds, [7, 3])
+        assert len(a) == 7 and len(b) == 3
+        x0, y0 = ds[0]
+        assert x0.shape == [2]
+
+    def test_iterable_dataset(self):
+        class Stream(pt.io.IterableDataset):
+            def __iter__(self):
+                for i in range(7):
+                    yield np.full((2,), i, np.float32)
+        dl = pt.io.DataLoader(Stream(), batch_size=3, drop_last=False)
+        batches = list(dl)
+        assert len(batches) == 3
+        assert batches[-1].shape == [1, 2]
