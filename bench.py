@@ -1,0 +1,89 @@
+"""Benchmark entry (driver-run on real TPU hardware).
+
+Measures BASELINE.md config[0]: ResNet-50 training throughput on
+CIFAR-10-shaped data (batch 256, 3x32x32), images/sec, single chip.
+
+The whole train step (forward + backward + Adam/Momentum update) is one
+jitted XLA program with bf16 AMP — the framework's designed fast path.
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+BATCH = 256
+WARMUP = 5
+ITERS = 30
+
+
+def main():
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as pt
+    from paddle_tpu.jit.api import functional_call
+    from paddle_tpu.tensor import Tensor
+
+    pt.seed(0)
+    net = pt.vision.models.resnet50(num_classes=10)
+    # bf16 params for MXU throughput; fp32 master weights live in opt state
+    pt.amp.decorate(net, level="O2", dtype="bfloat16")
+    opt = pt.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                parameters=net.parameters(),
+                                multi_precision=True)
+
+    params = {k: p._data for k, p in net.named_parameters()}
+    buffers = {k: b._data for k, b in net.named_buffers()}
+    opt_state = opt.init_state_tree(params)
+    fwd = getattr(net, "_orig_forward", net.forward)
+
+    def train_step(params, buffers, opt_state, x, y):
+        def loss_of(p):
+            out, new_buffers = functional_call(
+                net, p, buffers, (Tensor(x),), training=True, forward_fn=fwd)
+            logits = out._data.astype(jnp.float32)
+            logp = jax.nn.log_softmax(logits)
+            loss = -jnp.take_along_axis(logp, y[:, None], axis=1).mean()
+            return loss, new_buffers
+
+        (loss, new_buffers), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(params)
+        new_params, new_opt = opt.apply_gradients_tree(params, grads,
+                                                       opt_state)
+        return loss, new_params, new_buffers, new_opt
+
+    step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(BATCH, 3, 32, 32).astype(np.float32)
+                    .astype(np.dtype("bfloat16") if False else np.float32))
+    x = x.astype(jnp.bfloat16)
+    y = jnp.asarray(rng.randint(0, 10, BATCH).astype(np.int32))
+
+    # warmup (includes compile)
+    for _ in range(WARMUP):
+        loss, params, buffers, opt_state = step(params, buffers, opt_state,
+                                                x, y)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        loss, params, buffers, opt_state = step(params, buffers, opt_state,
+                                                x, y)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    ips = BATCH * ITERS / dt
+    print(json.dumps({
+        "metric": "resnet50_cifar10_train_throughput",
+        "value": round(ips, 2),
+        "unit": "images/sec",
+        "vs_baseline": None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+"""``paddle.callbacks`` namespace parity."""
+from .hapi.callbacks import (Callback, ProgBarLogger, ModelCheckpoint,  # noqa: F401
+                             LRScheduler, EarlyStopping, VisualDL)

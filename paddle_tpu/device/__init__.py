@@ -1,0 +1,57 @@
+"""``paddle.device`` namespace (ref: ``python/paddle/device/``)."""
+from ..framework.device import (  # noqa: F401
+    set_device, get_device, get_all_devices, device_count,
+    is_compiled_with_cuda, is_compiled_with_rocm, is_compiled_with_xpu,
+    is_compiled_with_tpu, is_compiled_with_cinn,
+    is_compiled_with_custom_device, device_guard, Place, CPUPlace, TPUPlace,
+    CUDAPlace, CustomPlace, XPUPlace,
+)
+
+__all__ = ["set_device", "get_device", "get_all_devices", "device_count",
+           "is_compiled_with_cuda", "is_compiled_with_tpu", "cuda",
+           "get_available_device", "get_available_custom_device"]
+
+
+def get_available_device():
+    return get_all_devices()
+
+
+def get_available_custom_device():
+    return [d for d in get_all_devices() if d.startswith("tpu")]
+
+
+class cuda:
+    """Parity shim for paddle.device.cuda — maps to the TPU accelerator."""
+
+    @staticmethod
+    def device_count():
+        return device_count()
+
+    @staticmethod
+    def synchronize(device=None):
+        import jax
+        # block until all dispatched work completes
+        (jax.device_put(0) + 0).block_until_ready()
+
+    @staticmethod
+    def empty_cache():
+        import gc
+        gc.collect()
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        import jax
+        try:
+            stats = jax.devices()[0].memory_stats()
+            return stats.get("peak_bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def memory_allocated(device=None):
+        import jax
+        try:
+            stats = jax.devices()[0].memory_stats()
+            return stats.get("bytes_in_use", 0)
+        except Exception:
+            return 0

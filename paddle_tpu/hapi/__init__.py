@@ -1,0 +1,4 @@
+"""``paddle_tpu.hapi`` (ref: ``python/paddle/hapi/``)."""
+from .model import Model  # noqa: F401
+from .summary import summary, flops  # noqa: F401
+from . import callbacks  # noqa: F401

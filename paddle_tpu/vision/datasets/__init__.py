@@ -1,0 +1,150 @@
+"""Vision datasets (ref: ``python/paddle/vision/datasets/``).
+
+Zero-egress environment: datasets load from local files (`data_file=`) in
+the reference's formats; `FakeData` provides deterministic synthetic data
+for benchmarks and tests.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+__all__ = ["Cifar10", "Cifar100", "MNIST", "FashionMNIST", "FakeData"]
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image classification data."""
+
+    def __init__(self, size=1000, image_shape=(3, 32, 32), num_classes=10,
+                 transform=None, seed=0):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self._rng = np.random.RandomState(seed)
+        # per-class fixed signal so models can actually learn
+        self._centers = self._rng.randn(num_classes,
+                                        *self.image_shape).astype(np.float32)
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(idx)
+        label = idx % self.num_classes
+        img = (self._centers[label]
+               + 0.5 * rng.randn(*self.image_shape).astype(np.float32))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(label, dtype=np.int64)
+
+    def __len__(self):
+        return self.size
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 from the standard python-version tar.gz (ref:
+    ``vision/datasets/cifar.py``). Pass data_file=path/to/
+    cifar-10-python.tar.gz."""
+
+    MODE_TRAIN_BATCHES = [f"data_batch_{i}" for i in range(1, 6)]
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend="cv2"):
+        if data_file is None or not os.path.exists(data_file):
+            raise FileNotFoundError(
+                "Cifar10 requires data_file=<path to cifar-10-python.tar.gz> "
+                "(no network download in this environment); use "
+                "paddle_tpu.vision.datasets.FakeData for synthetic data")
+        self.transform = transform
+        self.mode = mode
+        datas, labels = [], []
+        wanted = self.MODE_TRAIN_BATCHES if mode == "train" else ["test_batch"]
+        with tarfile.open(data_file, "r:*") as tf:
+            for member in tf.getmembers():
+                base = os.path.basename(member.name)
+                if base in wanted:
+                    d = pickle.loads(tf.extractfile(member).read(),
+                                     encoding="bytes")
+                    datas.append(d[b"data"])
+                    labels.extend(d[b"labels"])
+        self.data = np.concatenate(datas).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, dtype=np.int64)
+
+    def __getitem__(self, idx):
+        img = self.data[idx].transpose(1, 2, 0)  # HWC uint8
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32) / 255.0
+            img = img.transpose(2, 0, 1)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Cifar100(Cifar10):
+    MODE_TRAIN_BATCHES = ["train"]
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend="cv2"):
+        if data_file is None or not os.path.exists(data_file):
+            raise FileNotFoundError(
+                "Cifar100 requires data_file=<path to "
+                "cifar-100-python.tar.gz>")
+        self.transform = transform
+        self.mode = mode
+        wanted = "train" if mode == "train" else "test"
+        with tarfile.open(data_file, "r:*") as tf:
+            for member in tf.getmembers():
+                if os.path.basename(member.name) == wanted:
+                    d = pickle.loads(tf.extractfile(member).read(),
+                                     encoding="bytes")
+                    self.data = d[b"data"].reshape(-1, 3, 32, 32)
+                    self.labels = np.asarray(d[b"fine_labels"],
+                                             dtype=np.int64)
+                    break
+
+
+class MNIST(Dataset):
+    """MNIST from the idx-format gz files (ref: vision/datasets/mnist.py)."""
+
+    NAME = "mnist"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend="cv2"):
+        if image_path is None or label_path is None or \
+                not (os.path.exists(image_path) and os.path.exists(label_path)):
+            raise FileNotFoundError(
+                f"{type(self).__name__} requires image_path/label_path to "
+                "local idx .gz files (no network download); use FakeData "
+                "for synthetic data")
+        self.transform = transform
+        with gzip.open(label_path, "rb") as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            self.labels = np.frombuffer(f.read(), dtype=np.uint8).astype(
+                np.int64)
+        with gzip.open(image_path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            self.images = np.frombuffer(f.read(), dtype=np.uint8).reshape(
+                n, rows, cols)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = (img.astype(np.float32) / 255.0)[None]
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"

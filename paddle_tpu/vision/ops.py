@@ -1,0 +1,226 @@
+"""Vision ops (ref: ``python/paddle/vision/ops.py``): boxes, RoI, deform
+conv subset. Box utilities are pure jnp; RoIAlign uses gather-based bilinear
+sampling (one XLA gather instead of a custom CUDA kernel)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ..ops.op_utils import ensure_tensor, nary, unary as _unary
+
+__all__ = ["box_coder", "box_area", "box_iou", "nms", "roi_align",
+           "roi_pool", "generate_proposals", "distribute_fpn_proposals",
+           "yolo_box", "yolo_loss", "DeformConv2D", "deform_conv2d",
+           "PSRoIPool", "psroi_pool", "RoIAlign", "RoIPool"]
+
+
+def box_area(boxes, name=None):
+    return _unary(lambda b: (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]),
+                  boxes, name="box_area")
+
+
+def box_iou(boxes1, boxes2, name=None):
+    def f(a, b):
+        area1 = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+        area2 = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+        lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+        rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / (area1[:, None] + area2[None, :] - inter)
+    return nary(f, [ensure_tensor(boxes1), ensure_tensor(boxes2)],
+                name="box_iou")
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Host-side NMS (data-dependent output size — eager only, like the
+    reference's CPU fallback path)."""
+    b = np.asarray(ensure_tensor(boxes)._data, dtype=np.float64)
+    s = np.asarray(ensure_tensor(scores)._data) if scores is not None \
+        else np.arange(len(b))[::-1]
+    order = np.argsort(-s)
+    keep = []
+    suppressed = np.zeros(len(b), dtype=bool)
+    areas = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        xx1 = np.maximum(b[i, 0], b[:, 0])
+        yy1 = np.maximum(b[i, 1], b[:, 1])
+        xx2 = np.minimum(b[i, 2], b[:, 2])
+        yy2 = np.minimum(b[i, 3], b[:, 3])
+        w = np.maximum(0.0, xx2 - xx1)
+        h = np.maximum(0.0, yy2 - yy1)
+        inter = w * h
+        iou = inter / (areas[i] + areas - inter + 1e-10)
+        suppressed |= iou > iou_threshold
+        suppressed[i] = True
+    keep = np.asarray(keep, dtype=np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(keep))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    x = ensure_tensor(x)
+    boxes = ensure_tensor(boxes)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    bn = np.asarray(ensure_tensor(boxes_num)._data)
+    batch_idx = np.repeat(np.arange(len(bn)), bn)
+
+    def f(feat, rois):
+        n_rois = rois.shape[0]
+        C = feat.shape[1]
+        offset = 0.5 if aligned else 0.0
+        x1 = rois[:, 0] * spatial_scale - offset
+        y1 = rois[:, 1] * spatial_scale - offset
+        x2 = rois[:, 2] * spatial_scale - offset
+        y2 = rois[:, 3] * spatial_scale - offset
+        rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+        rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+        # one sample per bin center (sampling_ratio=1 equivalent)
+        ys = y1[:, None] + (jnp.arange(oh) + 0.5) / oh * rh[:, None]
+        xs = x1[:, None] + (jnp.arange(ow) + 0.5) / ow * rw[:, None]
+
+        outs = []
+        for r in range(n_rois):
+            fmap = feat[batch_idx[r]]  # (C, H, W)
+            yy, xx = ys[r], xs[r]
+            H, W = fmap.shape[-2:]
+            y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, H - 1)
+            x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, W - 1)
+            y1_ = jnp.clip(y0 + 1, 0, H - 1)
+            x1_ = jnp.clip(x0 + 1, 0, W - 1)
+            wy = jnp.clip(yy - y0, 0, 1)[:, None]
+            wx = jnp.clip(xx - x0, 0, 1)[None, :]
+            v00 = fmap[:, y0][:, :, x0]
+            v01 = fmap[:, y0][:, :, x1_]
+            v10 = fmap[:, y1_][:, :, x0]
+            v11 = fmap[:, y1_][:, :, x1_]
+            out = (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                   v10 * wy * (1 - wx) + v11 * wy * wx)
+            outs.append(out)
+        return jnp.stack(outs) if outs else jnp.zeros((0, C, oh, ow),
+                                                      feat.dtype)
+    return nary(f, [x, boxes], name="roi_align")
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    # max-pool variant of roi_align with nearest binning
+    x = ensure_tensor(x)
+    boxes = ensure_tensor(boxes)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    bn = np.asarray(ensure_tensor(boxes_num)._data)
+    batch_idx = np.repeat(np.arange(len(bn)), bn)
+
+    def f(feat, rois):
+        outs = []
+        H, W = feat.shape[-2:]
+        for r in range(rois.shape[0]):
+            fmap = feat[batch_idx[r]]
+            x1 = jnp.round(rois[r, 0] * spatial_scale).astype(jnp.int32)
+            y1 = jnp.round(rois[r, 1] * spatial_scale).astype(jnp.int32)
+            x2 = jnp.maximum(jnp.round(rois[r, 2] * spatial_scale), x1 + 1)
+            y2 = jnp.maximum(jnp.round(rois[r, 3] * spatial_scale), y1 + 1)
+            ys = jnp.clip(jnp.linspace(y1, y2, oh + 1), 0, H).astype(jnp.int32)
+            xs = jnp.clip(jnp.linspace(x1, x2, ow + 1), 0, W).astype(jnp.int32)
+            # fixed-size gather grid (8 samples per bin edge-to-edge)
+            gy = jnp.clip((ys[:-1, None] + jnp.arange(8)[None, :]), 0, H - 1)
+            gx = jnp.clip((xs[:-1, None] + jnp.arange(8)[None, :]), 0, W - 1)
+            patch = fmap[:, gy][:, :, :, gx]  # C, oh, 8, ow, 8
+            outs.append(patch.max(axis=(2, 4)))
+        return jnp.stack(outs)
+    return nary(f, [x, boxes], name="roi_pool")
+
+
+class RoIAlign:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale)
+
+
+class RoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0, name=None):
+    def f(pb, pbv, tb):
+        pw = pb[:, 2] - pb[:, 0] + (0 if box_normalized else 1)
+        ph = pb[:, 3] - pb[:, 1] + (0 if box_normalized else 1)
+        px = pb[:, 0] + pw * 0.5
+        py = pb[:, 1] + ph * 0.5
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + (0 if box_normalized else 1)
+            th = tb[:, 3] - tb[:, 1] + (0 if box_normalized else 1)
+            tx = tb[:, 0] + tw * 0.5
+            ty = tb[:, 1] + th * 0.5
+            ox = (tx - px) / pw / pbv[:, 0]
+            oy = (ty - py) / ph / pbv[:, 1]
+            ow = jnp.log(tw / pw) / pbv[:, 2]
+            oh = jnp.log(th / ph) / pbv[:, 3]
+            return jnp.stack([ox, oy, ow, oh], axis=1)
+        ox = pbv[:, 0] * tb[..., 0] * pw + px
+        oy = pbv[:, 1] * tb[..., 1] * ph + py
+        ow = jnp.exp(pbv[:, 2] * tb[..., 2]) * pw
+        oh = jnp.exp(pbv[:, 3] * tb[..., 3]) * ph
+        return jnp.stack([ox - ow / 2, oy - oh / 2, ox + ow / 2,
+                          oy + oh / 2], axis=-1)
+    return nary(f, [ensure_tensor(prior_box), ensure_tensor(prior_box_var),
+                    ensure_tensor(target_box)], name="box_coder")
+
+
+def generate_proposals(*args, **kwargs):
+    raise NotImplementedError(
+        "generate_proposals: detection-specific dynamic-shape op; planned "
+        "via fixed-size top-k + masking")
+
+
+def distribute_fpn_proposals(*args, **kwargs):
+    raise NotImplementedError("distribute_fpn_proposals: planned")
+
+
+def yolo_box(*args, **kwargs):
+    raise NotImplementedError("yolo_box: planned")
+
+
+def yolo_loss(*args, **kwargs):
+    raise NotImplementedError("yolo_loss: planned")
+
+
+def deform_conv2d(*args, **kwargs):
+    raise NotImplementedError(
+        "deform_conv2d: planned as gather-based sampling + matmul")
+
+
+class DeformConv2D:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("DeformConv2D: planned")
+
+
+def psroi_pool(*args, **kwargs):
+    raise NotImplementedError("psroi_pool: planned")
+
+
+class PSRoIPool:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("PSRoIPool: planned")

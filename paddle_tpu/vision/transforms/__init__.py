@@ -1,0 +1,380 @@
+"""Vision transforms (ref: ``python/paddle/vision/transforms/``).
+
+Numpy/HWC-based (no PIL dependency); ToTensor converts to CHW float.
+"""
+from __future__ import annotations
+
+import numbers
+import random as pyrandom
+
+import numpy as np
+
+from ...tensor import Tensor
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "RandomCrop",
+           "CenterCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+           "RandomResizedCrop", "Transpose", "Pad", "BrightnessTransform",
+           "ContrastTransform", "SaturationTransform", "HueTransform",
+           "ColorJitter", "RandomRotation", "Grayscale", "BaseTransform",
+           "to_tensor", "normalize", "resize", "hflip", "vflip", "crop",
+           "center_crop"]
+
+
+class BaseTransform:
+    def __init__(self, keys=None):
+        self.keys = keys
+
+    def __call__(self, inputs):
+        return self._apply_image(inputs)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+def _as_hwc(img):
+    img = np.asarray(img)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return img
+
+
+def to_tensor(img, data_format="CHW"):
+    img = _as_hwc(img)
+    arr = img.astype(np.float32)
+    if img.dtype == np.uint8:
+        arr = arr / 255.0
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(arr)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    if isinstance(img, Tensor):
+        arr = np.asarray(img._data)
+    else:
+        arr = np.asarray(img, dtype=np.float32)
+    mean = np.asarray(mean, dtype=np.float32)
+    std = np.asarray(std, dtype=np.float32)
+    if data_format == "CHW":
+        mean = mean.reshape(-1, 1, 1)
+        std = std.reshape(-1, 1, 1)
+    out = (arr - mean) / std
+    return Tensor(out) if isinstance(img, Tensor) else out
+
+
+def resize(img, size, interpolation="bilinear"):
+    img = _as_hwc(img)
+    if isinstance(size, int):
+        h, w = img.shape[:2]
+        if h < w:
+            new_h, new_w = size, int(size * w / h)
+        else:
+            new_h, new_w = int(size * h / w), size
+    else:
+        new_h, new_w = size
+    # simple numpy bilinear/nearest resize
+    h, w = img.shape[:2]
+    if (h, w) == (new_h, new_w):
+        return img
+    ys = np.linspace(0, h - 1, new_h)
+    xs = np.linspace(0, w - 1, new_w)
+    if interpolation == "nearest":
+        out = img[np.round(ys).astype(int)[:, None],
+                  np.round(xs).astype(int)[None, :]]
+    else:
+        y0 = np.floor(ys).astype(int)
+        x0 = np.floor(xs).astype(int)
+        y1 = np.minimum(y0 + 1, h - 1)
+        x1 = np.minimum(x0 + 1, w - 1)
+        wy = (ys - y0)[:, None, None]
+        wx = (xs - x0)[None, :, None]
+        f = img.astype(np.float32)
+        out = (f[y0[:, None], x0[None, :]] * (1 - wy) * (1 - wx) +
+               f[y1[:, None], x0[None, :]] * wy * (1 - wx) +
+               f[y0[:, None], x1[None, :]] * (1 - wy) * wx +
+               f[y1[:, None], x1[None, :]] * wy * wx)
+        if img.dtype == np.uint8:
+            out = np.clip(out, 0, 255).astype(np.uint8)
+    return out
+
+
+def hflip(img):
+    return _as_hwc(img)[:, ::-1]
+
+
+def vflip(img):
+    return _as_hwc(img)[::-1]
+
+
+def crop(img, top, left, height, width):
+    return _as_hwc(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    img = _as_hwc(img)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    h, w = img.shape[:2]
+    th, tw = output_size
+    i = max(0, (h - th) // 2)
+    j = max(0, (w - tw) // 2)
+    return crop(img, i, j, th, tw)
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        super().__init__(keys)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        super().__init__(keys)
+        if isinstance(mean, numbers.Number):
+            mean = [mean, mean, mean]
+        if isinstance(std, numbers.Number):
+            std = [std, std, std]
+        self.mean, self.std = mean, std
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        if self.padding:
+            p = self.padding if isinstance(self.padding, (list, tuple)) else \
+                [self.padding] * 4
+            img = np.pad(img, [(p[1], p[3]), (p[0], p[2]), (0, 0)])
+        h, w = img.shape[:2]
+        th, tw = self.size
+        if h == th and w == tw:
+            return img
+        i = pyrandom.randint(0, h - th)
+        j = pyrandom.randint(0, w - tw)
+        return crop(img, i, j, th, tw)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
+        self.size = size
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if pyrandom.random() < self.prob:
+            return hflip(img)
+        return _as_hwc(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if pyrandom.random() < self.prob:
+            return vflip(img)
+        return _as_hwc(img)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3. / 4, 4. / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        import math
+        img = _as_hwc(img)
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = pyrandom.uniform(*self.scale) * area
+            ar = math.exp(pyrandom.uniform(math.log(self.ratio[0]),
+                                           math.log(self.ratio[1])))
+            cw = int(round(math.sqrt(target_area * ar)))
+            ch = int(round(math.sqrt(target_area / ar)))
+            if cw <= w and ch <= h:
+                i = pyrandom.randint(0, h - ch)
+                j = pyrandom.randint(0, w - cw)
+                return resize(crop(img, i, j, ch, cw), self.size,
+                              self.interpolation)
+        return resize(center_crop(img, min(h, w)), self.size,
+                      self.interpolation)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = order
+
+    def _apply_image(self, img):
+        return _as_hwc(img).transpose(self.order)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        p = padding if isinstance(padding, (list, tuple)) else [padding] * 4
+        if len(p) == 2:
+            p = [p[0], p[1], p[0], p[1]]
+        self.padding = p
+        self.fill = fill
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        p = self.padding
+        return np.pad(img, [(p[1], p[3]), (p[0], p[2]), (0, 0)],
+                      constant_values=self.fill)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        alpha = 1 + pyrandom.uniform(-self.value, self.value)
+        out = img.astype(np.float32) * alpha
+        return np.clip(out, 0, 255).astype(img.dtype) \
+            if img.dtype == np.uint8 else out
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        alpha = 1 + pyrandom.uniform(-self.value, self.value)
+        mean = img.astype(np.float32).mean()
+        out = img.astype(np.float32) * alpha + mean * (1 - alpha)
+        return np.clip(out, 0, 255).astype(img.dtype) \
+            if img.dtype == np.uint8 else out
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        alpha = 1 + pyrandom.uniform(-self.value, self.value)
+        gray = img.astype(np.float32).mean(axis=2, keepdims=True)
+        out = img.astype(np.float32) * alpha + gray * (1 - alpha)
+        return np.clip(out, 0, 255).astype(img.dtype) \
+            if img.dtype == np.uint8 else out
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        # cheap approximation: channel roll-mix
+        img = _as_hwc(img)
+        f = pyrandom.uniform(-self.value, self.value)
+        out = img.astype(np.float32)
+        rolled = np.roll(out, 1, axis=2)
+        out = out * (1 - abs(f)) + rolled * abs(f)
+        return np.clip(out, 0, 255).astype(img.dtype) \
+            if img.dtype == np.uint8 else out
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.ts = []
+        if brightness:
+            self.ts.append(BrightnessTransform(brightness))
+        if contrast:
+            self.ts.append(ContrastTransform(contrast))
+        if saturation:
+            self.ts.append(SaturationTransform(saturation))
+        if hue:
+            self.ts.append(HueTransform(hue))
+
+    def _apply_image(self, img):
+        ts = list(self.ts)
+        pyrandom.shuffle(ts)
+        for t in ts:
+            img = t(img)
+        return img
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) if isinstance(
+            degrees, numbers.Number) else degrees
+
+    def _apply_image(self, img):
+        # right-angle rotations only (exact, no scipy dependency)
+        img = _as_hwc(img)
+        angle = pyrandom.uniform(*self.degrees)
+        k = int(round(angle / 90.0)) % 4
+        return np.rot90(img, k=k, axes=(0, 1)).copy()
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        img = _as_hwc(img).astype(np.float32)
+        gray = (img[..., :3] @ np.array([0.299, 0.587, 0.114],
+                                        np.float32))[..., None]
+        if self.num_output_channels == 3:
+            gray = np.repeat(gray, 3, axis=2)
+        return gray
