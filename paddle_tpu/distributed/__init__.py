@@ -1,6 +1,32 @@
 """``paddle_tpu.distributed`` (ref: ``python/paddle/distributed/``).
 
-Grown incrementally: env/rank info first; mesh, collectives, fleet, and
-hybrid parallelism land in their own modules.
+TPU-native distributed stack: a global ``jax.sharding.Mesh`` + GSPMD +
+``shard_map`` collectives replace the reference's entire
+ProcessGroup/NCCL/TCPStore machinery (SURVEY §2.3, §5). The public surface
+mirrors ``paddle.distributed`` so reference training scripts port over.
 """
 from .env import get_rank, get_world_size, ParallelEnv  # noqa: F401
+from .mesh import (  # noqa: F401
+    build_mesh, init_mesh, get_mesh, set_mesh, mesh_axis_size, HYBRID_AXES,
+)
+from .collective import (  # noqa: F401
+    ReduceOp, Group, new_group, get_group, destroy_process_group,
+    is_initialized, all_reduce, all_gather, all_gather_object, broadcast,
+    broadcast_object_list, reduce, scatter, scatter_object_list, alltoall,
+    alltoall_single, all_to_all, reduce_scatter, send, recv, isend, irecv,
+    barrier, P2POp, batch_isend_irecv, wait, get_backend,
+)
+from .parallel import init_parallel_env, DataParallel  # noqa: F401
+from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
+from . import fleet  # noqa: F401
+from . import sharding  # noqa: F401
+from .fleet.meta_parallel.mp_ops import split  # noqa: F401
+from .auto_parallel_api import (  # noqa: F401
+    ProcessMesh, shard_tensor, shard_layer, dtensor_from_fn, reshard,
+    Shard, Replicate, Partial,
+)
+from . import rpc  # noqa: F401
+from . import utils  # noqa: F401
+
+# spawn-style launch (ref: python/paddle/distributed/spawn.py)
+from .launch_api import spawn, launch  # noqa: F401

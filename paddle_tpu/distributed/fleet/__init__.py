@@ -1,0 +1,17 @@
+"""Fleet: the distributed-training facade.
+
+ref: ``python/paddle/distributed/fleet/fleet.py:99`` (Fleet), ``fleet.py:167
+init``, ``:371 _init_hybrid_parallel_env``, ``model.py:30
+distributed_model``, ``fleet.py:1044 distributed_optimizer``.
+"""
+from .base.distributed_strategy import DistributedStrategy  # noqa: F401
+from .fleet import (  # noqa: F401
+    Fleet, fleet, init, get_hybrid_communicate_group, distributed_model,
+    distributed_optimizer, worker_num, worker_index, is_first_worker,
+    worker_endpoints, barrier_worker,
+)
+from ..topology import HybridCommunicateGroup, CommunicateTopology  # noqa: F401
+from . import recompute as _recompute_mod  # noqa: F401
+from .recompute import recompute  # noqa: F401
+from . import utils  # noqa: F401
+from . import meta_parallel  # noqa: F401

@@ -1,0 +1,80 @@
+"""DistributedStrategy: the feature-toggle config tree.
+
+ref: ``python/paddle/distributed/fleet/base/distributed_strategy.py`` backed
+by ``paddle/fluid/framework/distributed_strategy.proto``. The TPU build
+replaces the protobuf with a plain typed attribute tree (SURVEY §5 config
+stance: one typed config + env overrides); the attribute NAMES match the
+reference so user strategy code ports unchanged. Toggles that are NCSL/NCCL
+mechanics with no XLA meaning (e.g. ``fuse_grad_size_in_MB``) are accepted
+and ignored — XLA owns those decisions.
+"""
+from __future__ import annotations
+
+__all__ = ["DistributedStrategy"]
+
+_HYBRID_DEFAULTS = {
+    "dp_degree": 1, "mp_degree": 1, "pp_degree": 1, "sharding_degree": 1,
+    "sep_degree": 1, "order": ["dp", "pp", "sharding", "sep", "mp"],
+}
+
+
+class DistributedStrategy:
+    def __init__(self):
+        # collective / hybrid
+        self.hybrid_configs = dict(_HYBRID_DEFAULTS)
+        # AMP
+        self.amp = False
+        self.amp_configs = {
+            "init_loss_scaling": 32768.0, "incr_every_n_steps": 1000,
+            "decr_every_n_nan_or_inf": 2, "incr_ratio": 2.0,
+            "decr_ratio": 0.5, "use_dynamic_loss_scaling": True,
+            "custom_white_list": [], "custom_black_list": [],
+            "use_pure_fp16": False, "use_fp16_guard": True,
+            "use_bf16": True,
+        }
+        # recompute
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": [], "enable_offload": False}
+        # sharding (ZeRO)
+        self.sharding = False
+        self.sharding_configs = {"stage": 1, "degree": 8,
+                                 "offload": False}
+        # pipeline
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1,
+                                 "micro_batch_size": 1,
+                                 "schedule_mode": "1F1B"}
+        # gradient merge
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        # misc toggles kept for parity (no-ops under XLA)
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1
+        self.sync_nccl_allreduce = False
+        self.find_unused_parameters = False
+        self.gradient_scale_configs = {"scale_strategy": "avg"}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {"tensor_parallel_degree": 1}
+        self.lamb = False
+        self.lars = False
+        self.dgc = False
+        self.a_sync = False
+        self.heter_ccl_mode = False
+        self.without_graph_optimization = True
+
+    def __setattr__(self, key, value):
+        # dict-valued configs merge over defaults like the reference's
+        # check_configs_key (unknown keys rejected)
+        cur = self.__dict__.get(key)
+        if isinstance(cur, dict) and isinstance(value, dict):
+            unknown = set(value) - set(cur)
+            if unknown:
+                raise ValueError(f"unknown {key} keys: {sorted(unknown)}")
+            cur.update(value)
+        else:
+            object.__setattr__(self, key, value)
+
+    def __repr__(self):
+        rows = [f"  {k}={v!r}" for k, v in sorted(self.__dict__.items())]
+        return "DistributedStrategy(\n" + "\n".join(rows) + "\n)"

@@ -1,0 +1,167 @@
+"""The Fleet singleton (ref: ``fleet/fleet.py:99``)."""
+from __future__ import annotations
+
+import os
+
+from ..env import get_rank, get_world_size
+from ..parallel import init_parallel_env
+from ..topology import CommunicateTopology, HybridCommunicateGroup
+from .base.distributed_strategy import DistributedStrategy
+
+__all__ = ["Fleet", "fleet", "init", "get_hybrid_communicate_group",
+           "distributed_model", "distributed_optimizer"]
+
+_HCG: HybridCommunicateGroup | None = None
+
+
+class Fleet:
+    """ref: ``fleet.py:99``. ``init`` builds the hybrid topology + global
+    mesh (``fleet.py:371 _init_hybrid_parallel_env``)."""
+
+    def __init__(self):
+        self._is_initialized = False
+        self._user_defined_strategy: DistributedStrategy | None = None
+        self._hcg: HybridCommunicateGroup | None = None
+
+    def init(self, role_maker=None, is_collective=True, strategy=None,
+             log_level="INFO"):
+        global _HCG
+        if strategy is None:
+            strategy = DistributedStrategy()
+        self._user_defined_strategy = strategy
+        init_parallel_env()
+
+        hc = strategy.hybrid_configs
+        import jax
+        world = get_world_size()
+        if world <= 1:
+            world = jax.device_count()
+        dims = {"dp": hc.get("dp_degree", 1), "pp": hc.get("pp_degree", 1),
+                "sharding": hc.get("sharding_degree", 1),
+                "sep": hc.get("sep_degree", 1),
+                "mp": hc.get("mp_degree", 1)}
+        # infer dp if left at 1 and devices remain (ref fleet.py:373-377
+        # requires the product to match; we auto-absorb into dp)
+        prod = 1
+        for v in dims.values():
+            prod *= v
+        if prod < world and world % prod == 0 and dims["dp"] == 1:
+            dims["dp"] = world // prod
+        topo = CommunicateTopology(
+            hybrid_group_names=("data", "pipe", "sharding", "sep", "model"),
+            dims=(dims["dp"], dims["pp"], dims["sharding"], dims["sep"],
+                  dims["mp"]))
+        self._hcg = HybridCommunicateGroup(topo)
+        _HCG = self._hcg
+        self._is_initialized = True
+        return self
+
+    @property
+    def is_initialized(self):
+        return self._is_initialized
+
+    def get_hybrid_communicate_group(self) -> HybridCommunicateGroup:
+        return self._hcg
+
+    # -- role queries (ref fleet.py worker_* family) ----------------------
+    def worker_index(self):
+        return get_rank()
+
+    def worker_num(self):
+        return get_world_size()
+
+    def is_first_worker(self):
+        return get_rank() == 0
+
+    def worker_endpoints(self, to_string=False):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+        eps = [e for e in eps if e]
+        return ",".join(eps) if to_string else eps
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def barrier_worker(self):
+        from ..collective import barrier
+        barrier()
+
+    # -- model / optimizer wrapping ---------------------------------------
+    def distributed_model(self, model):
+        """ref: ``fleet/model.py:30`` — dispatch on parallel mode
+        (``model.py:134-166``)."""
+        hcg = self._hcg
+        if hcg is None:
+            raise RuntimeError("call fleet.init() first")
+        mode = hcg.get_parallel_mode()
+        if mode == "pipeline":
+            from .meta_parallel.pipeline_parallel import PipelineParallel
+            return PipelineParallel(model, hcg,
+                                    strategy=self._user_defined_strategy)
+        if mode == "model":
+            from .meta_parallel.tensor_parallel import TensorParallel
+            return TensorParallel(model, hcg,
+                                  strategy=self._user_defined_strategy)
+        from ..parallel import DataParallel
+        return DataParallel(model,
+                            group=hcg.get_data_parallel_group())
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        """ref: ``fleet.py:1044`` → HybridParallelOptimizer
+        (``dygraph_optimizer/hybrid_parallel_optimizer.py:238``)."""
+        if strategy is not None:
+            self._user_defined_strategy = strategy
+        from .meta_optimizers.hybrid_parallel_optimizer import \
+            HybridParallelOptimizer
+        return HybridParallelOptimizer(optimizer, self._hcg,
+                                       self._user_defined_strategy)
+
+    # -- save/load (ref fleet.py:829-1009) --------------------------------
+    def save(self, path, **configs):
+        from ...framework.io_state import save as _save
+        _save(configs.get("program", {}), path)
+
+    def save_persistables(self, executor, dirname, main_program=None,
+                          mode=0):
+        return None
+
+
+fleet = Fleet()
+
+
+def init(role_maker=None, is_collective=True, strategy=None):
+    return fleet.init(role_maker, is_collective, strategy)
+
+
+def get_hybrid_communicate_group():
+    return _HCG
+
+
+def distributed_model(model):
+    return fleet.distributed_model(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return fleet.distributed_optimizer(optimizer, strategy)
+
+
+def worker_num():
+    return fleet.worker_num()
+
+
+def worker_index():
+    return fleet.worker_index()
+
+
+def is_first_worker():
+    return fleet.is_first_worker()
+
+
+def worker_endpoints(to_string=False):
+    return fleet.worker_endpoints(to_string)
+
+
+def barrier_worker():
+    return fleet.barrier_worker()

@@ -1,0 +1,14 @@
+"""ref: ``python/paddle/distributed/fleet/meta_parallel/``."""
+from .parallel_layers.mp_layers import (  # noqa: F401
+    VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+    ParallelCrossEntropy,
+)
+from .parallel_layers.pp_layers import (  # noqa: F401
+    PipelineLayer, LayerDesc, SharedLayerDesc,
+)
+from ....framework.random import RNGStatesTracker, get_tracker  # noqa: F401
+from .random import get_rng_state_tracker, model_parallel_random_seed  # noqa: F401
+from .tensor_parallel import TensorParallel  # noqa: F401
+from .pipeline_parallel import PipelineParallel  # noqa: F401
+from .sharding_parallel import ShardingParallel  # noqa: F401
+from . import mp_ops  # noqa: F401

@@ -1,0 +1,165 @@
+"""Tensor-parallel communication primitives.
+
+ref: ``python/paddle/distributed/fleet/layers/mpu/mp_ops.py``
+(``_c_identity :26``, ``_c_concat :90``, ``_c_split :152``,
+``_mp_allreduce :218``). The reference implements these as custom autograd
+ops over NCCL; here they are ``jax.custom_vjp`` wrappers over ``lax``
+collectives, meaningful when tracing inside ``shard_map`` over the ``mp``
+axis (manual-SPMD mode). Outside that scope GSPMD owns partitioning and
+these reduce to identity/no-ops — calling code works in both modes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ....tensor import Tensor
+from ...collective import _group_of, _in_axis_scope
+
+__all__ = ["_c_identity", "_c_concat", "_c_split", "_mp_allreduce",
+           "_parallel_linear", "split"]
+
+
+def _axis_of(group):
+    # None means "the model-parallel axis of the global mesh", NOT the
+    # default (world) group — TP layers default to mp_group=None
+    return group.axis_name if group is not None else "mp"
+
+
+def _axis_n(group, ax):
+    if group is not None:
+        return group.nranks
+    try:
+        return jax.lax.axis_size(ax)
+    except Exception:
+        from ... import mesh as _mesh_mod
+        return _mesh_mod.mesh_axis_size(ax)
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _wrap(x, arr):
+    return Tensor(arr, stop_gradient=getattr(x, "stop_gradient", True)) \
+        if isinstance(x, Tensor) else arr
+
+
+def _c_identity(x, group=None):
+    """Identity forward, all-reduce backward (the f operator of Megatron).
+    ref: mp_ops.py:26."""
+    ax = _axis_of(group)
+    a = _arr(x)
+    if not _in_axis_scope(ax):
+        return x
+
+    @jax.custom_vjp
+    def f(v):
+        return v
+
+    f.defvjp(lambda v: (v, None),
+             lambda _, g: (lax.psum(g, ax),))
+    return _wrap(x, f(a))
+
+
+def _mp_allreduce(x, group=None, use_calc_stream=True, use_model_parallel=True,
+                  op=None):
+    """All-reduce forward, identity backward (the g operator).
+    ref: mp_ops.py:218."""
+    ax = _axis_of(group)
+    a = _arr(x)
+    if not _in_axis_scope(ax):
+        return x
+
+    @jax.custom_vjp
+    def f(v):
+        return lax.psum(v, ax)
+
+    f.defvjp(lambda v: (lax.psum(v, ax), None),
+             lambda _, g: (g,))
+    return _wrap(x, f(a))
+
+
+def _c_split(x, group=None):
+    """Keep this rank's chunk of the last dim; backward all-gathers.
+    ref: mp_ops.py:152."""
+    ax = _axis_of(group)
+    a = _arr(x)
+    if not _in_axis_scope(ax):
+        return x
+    n = _axis_n(group, ax)
+
+    @jax.custom_vjp
+    def f(v):
+        i = lax.axis_index(ax)
+        chunk = v.shape[-1] // n
+        return lax.dynamic_slice_in_dim(v, i * chunk, chunk, axis=-1)
+
+    def fwd(v):
+        return f(v), None
+
+    def bwd(_, ct):
+        return (lax.all_gather(ct, ax, axis=ct.ndim - 1, tiled=True),)
+
+    f.defvjp(fwd, bwd)
+    return _wrap(x, f(a))
+
+
+def _c_concat(x, group=None):
+    """All-gather chunks along the last dim; backward takes this rank's
+    slice. ref: mp_ops.py:90."""
+    ax = _axis_of(group)
+    a = _arr(x)
+    if not _in_axis_scope(ax):
+        return x
+    n = _axis_n(group, ax)
+
+    @jax.custom_vjp
+    def f(v):
+        return lax.all_gather(v, ax, axis=v.ndim - 1, tiled=True)
+
+    def fwd(v):
+        return f(v), v.shape[-1]
+
+    def bwd(local_dim, ct):
+        i = lax.axis_index(ax)
+        return (lax.dynamic_slice_in_dim(ct, i * local_dim, local_dim,
+                                         axis=-1),)
+
+    f.defvjp(fwd, bwd)
+    return _wrap(x, f(a))
+
+
+def _parallel_linear(x, num_rows, num_cols, axis, param_attr, bias_attr,
+                     gather_out, inner_rank, nranks, split_tensor, name,
+                     group=None):
+    """ref: mp_ops.py _parallel_linear — functional row/col split linear."""
+    from .parallel_layers.mp_layers import (ColumnParallelLinear,
+                                            RowParallelLinear)
+    if axis == 0:
+        layer = RowParallelLinear(num_rows, num_cols, weight_attr=param_attr,
+                                  has_bias=bias_attr is not False,
+                                  input_is_parallel=split_tensor, mp_group=group)
+    else:
+        layer = ColumnParallelLinear(num_rows, num_cols,
+                                     weight_attr=param_attr,
+                                     has_bias=bias_attr is not False,
+                                     gather_output=gather_out, mp_group=group)
+    return layer(x)
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """``paddle.distributed.split`` (ref: mp_ops.py:664): build + apply a
+    megatron-split linear/embedding in one call."""
+    if operation == "linear":
+        return _parallel_linear(x, size[0], size[1], axis, weight_attr,
+                                bias_attr, gather_out, 0, num_partitions,
+                                axis == 0, name)
+    if operation == "embedding":
+        from .parallel_layers.mp_layers import VocabParallelEmbedding
+        layer = VocabParallelEmbedding(size[0], size[1],
+                                       weight_attr=weight_attr)
+        return layer(x)
+    raise ValueError(f"unsupported split operation {operation!r}")

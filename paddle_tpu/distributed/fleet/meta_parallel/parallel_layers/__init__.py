@@ -1,0 +1,6 @@
+from .mp_layers import (  # noqa: F401
+    VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+    ParallelCrossEntropy,
+)
+from .pp_layers import PipelineLayer, LayerDesc, SharedLayerDesc  # noqa: F401
+from ..random import get_rng_state_tracker, model_parallel_random_seed  # noqa: F401

@@ -1,0 +1,155 @@
+"""Pipeline layer partitioning.
+
+ref: ``python/paddle/distributed/fleet/meta_parallel/parallel_layers/
+pp_layers.py`` (``PipelineLayer :239``, ``LayerDesc``, ``SharedLayerDesc``,
+virtual stages :249).
+
+TPU-native stance: the reference materializes ONLY this rank's stage
+layers (each process owns a stage); in single-controller JAX ALL stages are
+built, and the pipeline schedule (``pipeline_parallel.py``) places each
+stage's parameters on its ``pp`` mesh slice — stacking homogeneous stage
+blocks so the 1F1B loop runs as ONE ``shard_map`` program with
+``ppermute`` hops instead of NCCL p2p.
+"""
+from __future__ import annotations
+
+import math
+
+from .....nn.layer.layers import Layer
+from .....nn.layer.container import Sequential, LayerList
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer"]
+
+
+class LayerDesc:
+    """Deferred layer constructor (ref: pp_layers.py LayerDesc)."""
+
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer):
+            raise TypeError("LayerDesc expects a Layer subclass")
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Layer shared between stages, e.g. tied embeddings (ref:
+    pp_layers.py SharedLayerDesc). Single-controller: sharing is literal
+    object identity — no grad-sync group needed (the compiled backward sums
+    both uses' gradients naturally)."""
+
+    def __init__(self, key, layer_func, forward_func=None,
+                 shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    """ref: pp_layers.py:239. Accepts a list of Layer / LayerDesc, a
+    partition policy, and exposes per-stage segments.
+
+    seg_method: "uniform" or "layer:<ClassName>" (balance by count of that
+    layer class, the reference's transformer-block policy).
+    """
+
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform", recompute_interval=0,
+                 recompute_ctx=None, num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._topo = topology
+        self._recompute_interval = recompute_interval
+        if num_stages is None:
+            if topology is not None:
+                num_stages = topology.get_dim("pipe")
+            else:
+                from .... import mesh as _mesh_mod
+                num_stages = _mesh_mod.mesh_axis_size("pp")
+        self._num_stages = max(int(num_stages), 1)
+
+        self.descs = list(layers)
+        built = []
+        self._shared = {}
+        for d in self.descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in self._shared:
+                    layer = self._shared[d.layer_name]
+                else:
+                    layer = d.build_layer()
+                    self._shared[d.layer_name] = layer
+                built.append((layer, d.forward_func))
+            elif isinstance(d, LayerDesc):
+                built.append((d.build_layer(), None))
+            elif isinstance(d, Layer):
+                built.append((d, None))
+            elif callable(d):
+                built.append((d, None))
+            else:
+                raise TypeError(f"cannot build pipeline item {d!r}")
+        self._items = built
+        self.run_function = [l for l, _ in built]
+        # register as sublayers for state_dict
+        self._layer_list = LayerList([l for l, _ in built
+                                      if isinstance(l, Layer)])
+        self._segment(seg_method)
+
+    # -- partitioning (ref pp_layers.py _segment_network) ------------------
+    def _segment(self, seg_method):
+        n = len(self._items)
+        stages = self._num_stages
+        if seg_method.startswith("layer:"):
+            cls_name = seg_method.split(":", 1)[1]
+            marks = [i for i, (l, _) in enumerate(self._items)
+                     if type(l).__name__ == cls_name]
+            if not marks:
+                raise ValueError(f"no layer of class {cls_name} found")
+            per = math.ceil(len(marks) / stages)
+            bounds = [0]
+            for s in range(1, stages):
+                k = s * per
+                bounds.append(marks[k] if k < len(marks) else n)
+            bounds.append(n)
+        else:
+            per = math.ceil(n / stages)
+            bounds = [min(i * per, n) for i in range(stages)] + [n]
+        self.segment_parts = bounds
+
+    def get_stage_from_index(self, layer_idx):
+        for s in range(self._num_stages):
+            if self.segment_parts[s] <= layer_idx < self.segment_parts[s + 1]:
+                return s
+        return self._num_stages - 1
+
+    def stage_layers(self, stage_id):
+        lo, hi = self.segment_parts[stage_id], self.segment_parts[stage_id + 1]
+        return self._items[lo:hi]
+
+    @property
+    def num_stages(self):
+        return self._num_stages
+
+    def forward(self, x):
+        """Run ALL stages sequentially (the semantics oracle; the pipelined
+        execution lives in PipelineParallel)."""
+        from ...recompute import recompute as _recompute
+        out = x
+        for i, (layer, fwd_fn) in enumerate(self._items):
+            def call(v, _layer=layer, _f=fwd_fn):
+                if _f is not None:
+                    return _f(_layer, v)
+                return _layer(v)
+            if self._recompute_interval and \
+                    i % self._recompute_interval == 0 and \
+                    isinstance(layer, Layer):
+                out = _recompute(call, out)
+            else:
+                out = call(out)
+        return out

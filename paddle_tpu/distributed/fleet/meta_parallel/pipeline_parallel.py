@@ -1,0 +1,116 @@
+"""Pipeline-parallel execution.
+
+ref: ``python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py``
+(``PipelineParallel :124``, 1F1B schedule ``forward_backward_pipeline
+:372``, interleaved ``:807``) and the P2P layer
+(``pp_utils/p2p_communication.py:302``).
+
+TPU-native mapping: the reference's host-driven 1F1B of NCCL sends/recvs
+becomes ONE compiled program. ``train_batch`` splits the batch into
+micro-batches and accumulates gradients; when the ``pp`` mesh axis is >1
+and the stage stack is homogeneous, the compiled SPMD pipeline
+(``paddle_tpu.distributed.fleet.meta_parallel.pp_spmd``) runs the
+micro-batch loop inside ``lax.scan`` with ``ppermute`` hops between stage
+shards — the ICI-native 1F1B. Otherwise the schedule degrades gracefully
+to sequential micro-batch accumulation (identical numerics: pipelining
+changes time, not math).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ....tensor import Tensor
+from ....nn.layer.layers import Layer
+from .parallel_layers.pp_layers import PipelineLayer
+
+__all__ = ["PipelineParallel"]
+
+
+class PipelineParallel(Layer):
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError(
+                "PipelineParallel expects a PipelineLayer (ref: "
+                "pipeline_parallel.py:128)")
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        pcfg = (strategy.pipeline_configs
+                if strategy is not None else {"accumulate_steps": 1})
+        self.accumulate_steps = pcfg.get("accumulate_steps", 1)
+        self.micro_batch_size = pcfg.get("micro_batch_size", None)
+        self.total_loss = None
+
+    # -- reference API surface --------------------------------------------
+    def forward(self, x):
+        return self._layers(x)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """ref: pipeline_parallel.py:572 train_batch → 1F1B schedule.
+
+        data: (inputs, labels). Returns the averaged loss tensor.
+        """
+        if self._layers._loss_fn is None:
+            raise ValueError("train_batch requires PipelineLayer(loss_fn=..)")
+        inputs, labels = data
+        micro_inputs = self._split_micro(inputs)
+        micro_labels = self._split_micro(labels)
+        n = len(micro_inputs)
+
+        total = None
+        for x, y in zip(micro_inputs, micro_labels):
+            out = self._layers(x)
+            loss = self._layers._loss_fn(out, y)
+            if scaler is not None:
+                scaled = scaler.scale(loss / n)
+                scaled.backward()
+            else:
+                (loss / n).backward()
+            total = loss.detach() if total is None else total + loss.detach()
+
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        self.total_loss = total / n
+        return self.total_loss
+
+    def eval_batch(self, data, compute_loss=True):
+        inputs, labels = data
+        out = self._layers(inputs)
+        if compute_loss and self._layers._loss_fn is not None:
+            return self._layers._loss_fn(out, labels)
+        return out
+
+    def forward_backward_pipeline(self, data, scaler=None):
+        return self.train_batch(data, scaler=scaler)
+
+    def _split_micro(self, t):
+        n = self.accumulate_steps
+        if n <= 1:
+            return [t]
+        arr = t._data if isinstance(t, Tensor) else jnp.asarray(t)
+        if arr.shape[0] % n:
+            raise ValueError(
+                f"batch {arr.shape[0]} not divisible by accumulate_steps {n}")
+        return [Tensor(a, stop_gradient=getattr(t, "stop_gradient", True))
+                for a in jnp.split(arr, n, axis=0)]
+
+    # delegation ----------------------------------------------------------
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, *args, **kwargs):
+        return self._layers.named_parameters(*args, **kwargs)

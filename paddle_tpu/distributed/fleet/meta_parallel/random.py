@@ -1,0 +1,35 @@
+"""TP dropout RNG (ref: ``fleet/meta_parallel/parallel_layers/random.py``).
+
+The reference keeps one CUDA Philox state per (rank, region) so dropout
+masks differ across mp ranks inside partitioned regions. TPU-native: one
+functional tracker (``paddle_tpu.framework.random.RNGStatesTracker``);
+rank decorrelation comes from folding the mp axis index into the key at
+mesh-aware call sites — pure data flow, no device state.
+"""
+from __future__ import annotations
+
+from ....framework.random import RNGStatesTracker, get_tracker
+
+__all__ = ["get_rng_state_tracker", "model_parallel_random_seed",
+           "RNGStatesTracker"]
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return get_tracker()
+
+
+def model_parallel_random_seed(seed=None):
+    """ref: random.py model_parallel_random_seed — derive decorrelated
+    global/local seeds and register tracker states."""
+    import random as pyrandom
+    from ...env import get_rank
+    if seed is None:
+        seed = pyrandom.randint(0, 2 ** 31 - 1)
+    global_seed = seed
+    local_seed = seed + 1024 + get_rank()
+    tracker = get_tracker()
+    tracker.reset()
+    tracker.add("global_seed", global_seed)
+    tracker.add(MODEL_PARALLEL_RNG, local_seed)

@@ -1,0 +1,65 @@
+"""ShardingParallel wrapper (ref:
+``fleet/meta_parallel/sharding_parallel.py``): ZeRO-style parameter /
+optimizer-state sharding. Under XLA this is an axis annotation, not a
+runtime protocol — parameters get ``PartitionSpec`` specs over the
+``sharding`` mesh axis on their largest divisible dim (the fsdp recipe),
+and the optimizer state inherits them. See also
+``paddle_tpu.distributed.sharding.group_sharded_parallel``.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ....nn.layer.layers import Layer
+from ... import mesh as _mesh_mod
+
+__all__ = ["ShardingParallel", "annotate_fsdp_specs"]
+
+
+def annotate_fsdp_specs(layer: Layer, axis="sharding", min_size=1024):
+    """Give every parameter a spec sharding its largest dim divisible by
+    the axis size (keeping any existing mp spec on other dims)."""
+    n = _mesh_mod.mesh_axis_size(axis)
+    if n <= 1:
+        return layer
+    for _, p in layer.named_parameters():
+        if p.size < min_size:
+            continue
+        existing = list(p._spec) if p._spec is not None \
+            else [None] * p.ndim
+        while len(existing) < p.ndim:
+            existing.append(None)
+        # choose the largest dim not already sharded and divisible by n
+        dims = sorted(range(p.ndim), key=lambda d: -p.shape[d])
+        for d in dims:
+            if existing[d] is None and p.shape[d] % n == 0:
+                existing[d] = axis
+                break
+        p._spec = P(*existing)
+    return layer
+
+
+class ShardingParallel(Layer):
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        annotate_fsdp_specs(layers)
+        from .tensor_parallel import place_parameters_on_mesh
+        place_parameters_on_mesh(layers)
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, *args, **kwargs):
+        return self._layers.named_parameters(*args, **kwargs)

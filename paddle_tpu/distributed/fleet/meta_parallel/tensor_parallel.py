@@ -1,0 +1,74 @@
+"""TensorParallel model wrapper (ref:
+``fleet/meta_parallel/tensor_parallel.py``).
+
+The reference broadcasts initial parameters across the mp group and wires
+grad sync; under GSPMD the mp-sharded parameters are a single logical
+array (always consistent) and grad collectives are compiled in, so the
+wrapper's job reduces to: place mp-annotated parameters onto the mesh and
+shard inputs over dp.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....tensor import Tensor
+from ....nn.layer.layers import Layer
+from ... import mesh as _mesh_mod
+
+__all__ = ["TensorParallel"]
+
+
+def place_parameters_on_mesh(layer: Layer, mesh=None):
+    """device_put every parameter according to its ``_spec`` annotation
+    (replicated if none). Idempotent; the distributed entry point."""
+    mesh = mesh or _mesh_mod.get_mesh()
+    if mesh is None:
+        return layer
+    for _, p in layer.named_parameters():
+        if isinstance(p._data, jax.core.Tracer):
+            continue
+        spec = p._spec or P()
+        try:
+            p._data = jax.device_put(p._data, NamedSharding(mesh, spec))
+        except ValueError:
+            p._data = jax.device_put(p._data, NamedSharding(mesh, P()))
+    for _, b in layer.named_buffers():
+        if not isinstance(b._data, jax.core.Tracer):
+            b._data = jax.device_put(b._data, NamedSharding(mesh, P()))
+    return layer
+
+
+class TensorParallel(Layer):
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        place_parameters_on_mesh(layers)
+
+    def forward(self, *inputs, **kwargs):
+        mesh = _mesh_mod.get_mesh()
+        if mesh is not None and mesh.shape.get("dp", 1) > 1:
+            sharding = NamedSharding(mesh, P("dp"))
+
+            def shard_in(x):
+                if isinstance(x, Tensor) and x.ndim >= 1 and \
+                        not isinstance(x._data, jax.core.Tracer) and \
+                        x.shape[0] % mesh.shape["dp"] == 0:
+                    x._data = jax.device_put(x._data, sharding)
+                return x
+
+            inputs = tuple(shard_in(x) for x in inputs)
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, *args, **kwargs):
+        return self._layers.named_parameters(*args, **kwargs)

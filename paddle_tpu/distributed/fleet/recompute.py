@@ -1,0 +1,83 @@
+"""Activation recompute (checkpointing).
+
+ref: ``python/paddle/distributed/fleet/recompute/recompute.py`` (+
+``recompute_hybrid.py``). The reference re-runs forward under saved RNG
+state in the backward pass; the TPU-native design maps this to
+``jax.checkpoint`` (rematerialization) inside the compiled program — XLA
+re-schedules the recomputation into the backward where it saves HBM, and
+RNG replay is free because jax PRNG keys are pure values.
+
+In eager (tape) mode recompute executes normally — the memory win only
+exists on the compiled path, which is where TPU training runs
+(``to_static`` / ``functional_call``).
+"""
+from __future__ import annotations
+
+import jax
+
+from ... import autograd
+from ...tensor import Tensor
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def _to_arrays(tree):
+    return jax.tree_util.tree_map(
+        lambda t: t._data if isinstance(t, Tensor) else t, tree,
+        is_leaf=lambda t: isinstance(t, Tensor))
+
+
+def recompute(function, *args, **kwargs):
+    """Drop-in for ``paddle.distributed.fleet.utils.recompute``.
+
+    kwargs accepted for parity: ``use_reentrant`` (ignored — no reentrant
+    autograd here), ``preserve_rng_state`` (always true: keys are values).
+    """
+    kwargs.pop("use_reentrant", None)
+    kwargs.pop("preserve_rng_state", None)
+    if not autograd.in_functional_mode():
+        return function(*args, **kwargs)
+
+    flat_args, struct = jax.tree_util.tree_flatten(
+        args, is_leaf=lambda t: isinstance(t, Tensor))
+    tensor_idx = [i for i, a in enumerate(flat_args)
+                  if isinstance(a, Tensor)]
+    arrays = [flat_args[i]._data for i in tensor_idx]
+
+    def pure(*arrs):
+        leaves = list(flat_args)
+        for i, a in zip(tensor_idx, arrs):
+            leaves[i] = Tensor(a, stop_gradient=flat_args[i].stop_gradient)
+        rebuilt = jax.tree_util.tree_unflatten(struct, leaves)
+        out = function(*rebuilt, **kwargs)
+        return _to_arrays(out)
+
+    out_arrays = jax.checkpoint(pure)(*arrays)
+    return jax.tree_util.tree_map(lambda a: Tensor(a), out_arrays)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """ref: ``recompute_sequential`` — checkpoint a Sequential in segments."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    layers = list(functions)
+    n = len(layers)
+    seg = max(n // max(segments, 1), 1)
+    out = args
+    i = 0
+    while i < n:
+        chunk = layers[i:i + seg]
+
+        def run_chunk(*xs, _chunk=chunk):
+            y = xs
+            for l in _chunk:
+                y = l(*y) if isinstance(y, tuple) else l(y)
+                if not isinstance(y, tuple):
+                    y = (y,)
+            return y[0] if len(y) == 1 else y
+
+        out = recompute(run_chunk, *out) if isinstance(out, tuple) \
+            else recompute(run_chunk, out)
+        if not isinstance(out, tuple):
+            out = (out,)
+        i += seg
+    return out[0] if isinstance(out, tuple) and len(out) == 1 else out

@@ -1,0 +1,26 @@
+"""ref: ``python/paddle/distributed/fleet/utils/`` — recompute lives here
+in the reference's public API (``fleet.utils.recompute``)."""
+from ..recompute import recompute, recompute_sequential  # noqa: F401
+
+
+class LocalFS:
+    """Minimal filesystem shim (ref: ``fleet/utils/fs.py LocalFS``)."""
+
+    def ls_dir(self, path):
+        import os
+        return [], os.listdir(path) if os.path.isdir(path) else []
+
+    def is_exist(self, path):
+        import os
+        return os.path.exists(path)
+
+    def mkdirs(self, path):
+        import os
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path):
+        import shutil, os
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)

@@ -1,0 +1,53 @@
+"""spawn/launch entry (ref: ``python/paddle/distributed/spawn.py`` and the
+launcher ``python/paddle/distributed/launch/main.py:18``).
+
+Single-host TPU reality: ONE process drives all local chips, so the
+reference's N-processes-per-node model maps to (a) spawn with nprocs=1
+per host, or (b) multi-host launches where each host runs one process
+(env contract preserved: PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
+PADDLE_TRAINER_ENDPOINTS / MASTER_ADDR). The full process-manager CLI
+lives in ``paddle_tpu.distributed.launch``.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+
+__all__ = ["spawn", "launch"]
+
+
+def _worker(fn, rank, nprocs, env, args):
+    os.environ.update(env)
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    fn(*args)
+
+
+def spawn(func, args=(), nprocs=1, join=True, daemon=False, **options):
+    """ref: spawn.py:spawn. nprocs defaults to 1 (one controller per host
+    drives every local chip — unlike one-process-per-GPU)."""
+    if nprocs <= 1:
+        os.environ.setdefault("PADDLE_TRAINER_ID", "0")
+        os.environ.setdefault("PADDLE_TRAINERS_NUM", "1")
+        func(*args)
+        return None
+    ctx = mp.get_context("spawn")
+    env = {k: v for k, v in os.environ.items()}
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker,
+                        args=(func, rank, nprocs, env, args), daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        bad = [p.exitcode for p in procs if p.exitcode]
+        if bad:
+            raise RuntimeError(f"spawned workers failed with codes {bad}")
+    return procs
+
+
+def launch():
+    from .launch.main import main
+    main()

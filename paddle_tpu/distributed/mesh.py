@@ -1,0 +1,93 @@
+"""Global device mesh: the TPU-native replacement for process groups.
+
+Re-design of the reference's communicator bookkeeping
+(``python/paddle/distributed/fleet/base/topology.py:58 CommunicateTopology``
+and the per-axis NCCL comm creation in ``collective.py:178 new_group`` /
+``paddle/phi/core/distributed/comm_context_manager.h:48``): instead of
+creating one NCCL communicator per topology axis, we build ONE
+``jax.sharding.Mesh`` whose named axes are the parallelism dimensions.
+XLA compiles collectives against axis names; ICI/DCN routing is the
+compiler's job, not ours.
+
+Axis order follows the reference's hybrid topology order ``[dp, pp,
+sharding, mp]`` (``topology.py:58``), extended with ``sep`` (sequence /
+context parallel — a new first-class capability, absent in the reference
+snapshot per SURVEY §5).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["HYBRID_AXES", "build_mesh", "init_mesh", "get_mesh", "set_mesh",
+           "mesh_axis_size", "default_device_count"]
+
+# canonical axis order (outer→inner; mp innermost rides ICI fastest links)
+HYBRID_AXES = ("dp", "pp", "sharding", "sep", "mp")
+
+_GLOBAL_MESH: Mesh | None = None
+
+
+def default_device_count() -> int:
+    return jax.device_count()
+
+
+def build_mesh(degrees: dict | None = None, devices=None) -> Mesh:
+    """Build a hybrid mesh from per-axis degrees.
+
+    ``degrees`` maps axis name → size (e.g. ``{"dp": 2, "mp": 4}``);
+    missing axes get size 1; one unset axis may be -1 to absorb the
+    remaining devices. Axes of size 1 are still present in the mesh so
+    sharding specs can always name them.
+    """
+    degrees = dict(degrees or {})
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    sizes = []
+    infer_idx = None
+    for ax in HYBRID_AXES:
+        d = int(degrees.pop(ax, 1))
+        if d == -1:
+            infer_idx = len(sizes)
+            d = 1
+        sizes.append(d)
+    if degrees:
+        raise ValueError(f"unknown mesh axes {sorted(degrees)}; "
+                         f"valid: {HYBRID_AXES}")
+    prod = int(np.prod(sizes))
+    if infer_idx is not None:
+        if n % prod:
+            raise ValueError(f"{n} devices not divisible by {prod}")
+        sizes[infer_idx] = n // prod
+        prod = n
+    if prod > n:
+        raise ValueError(f"mesh {dict(zip(HYBRID_AXES, sizes))} needs {prod} "
+                         f"devices, have {n}")
+    devs = np.array(devices[:prod]).reshape(sizes)
+    return Mesh(devs, axis_names=HYBRID_AXES)
+
+
+def init_mesh(degrees: dict | None = None, devices=None) -> Mesh:
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = build_mesh(degrees, devices)
+    return _GLOBAL_MESH
+
+
+def set_mesh(mesh: Mesh | None):
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = mesh
+
+
+def get_mesh(create_default=True) -> Mesh | None:
+    """Current global mesh; lazily a pure-dp mesh over all devices."""
+    global _GLOBAL_MESH
+    if _GLOBAL_MESH is None and create_default:
+        _GLOBAL_MESH = build_mesh({"dp": -1})
+    return _GLOBAL_MESH
+
+
+def mesh_axis_size(axis: str) -> int:
+    m = get_mesh()
+    return m.shape[axis] if m is not None and axis in m.shape else 1

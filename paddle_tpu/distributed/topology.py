@@ -1,0 +1,224 @@
+"""Hybrid-parallel topology.
+
+Re-design of ``python/paddle/distributed/fleet/base/topology.py``
+(``CommunicateTopology :58``, ``HybridCommunicateGroup :144``): the
+reference computes per-axis rank groups and creates one NCCL communicator
+per group; here the same N-D rank arithmetic instead yields (a) Group
+bookkeeping objects for the eager API and (b) THE global
+``jax.sharding.Mesh`` whose axis names drive GSPMD sharding — no
+communicators exist.
+
+Axis order matches the reference: ``["dp", "pp", "sharding", "mp"]``
+(plus ``sep``, our sequence-parallel extension).
+"""
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+from . import mesh as _mesh_mod
+from .collective import Group, new_group
+from .env import get_rank
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup"]
+
+
+class CommunicateTopology:
+    """Pure rank arithmetic over the hybrid axes (ref: topology.py:58)."""
+
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "sep",
+                                           "model"),
+                 dims=(1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self._world_size = int(np.prod(self._dims))
+        ranges = [range(d) for d in self._dims]
+        all_coords = list(itertools.product(*ranges))
+        self._coord2rank = {c: i for i, c in enumerate(all_coords)}
+        self._rank2coord = {i: c for c, i in self._coord2rank.items()}
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world_size
+
+    def get_rank(self, **args):
+        coord = tuple(args[name] for name in self._parallel_names)
+        return self._coord2rank[coord]
+
+    def get_coord(self, rank):
+        return self._rank2coord[rank]
+
+    def get_axis_list(self, axis_name, index):
+        """All ranks whose coordinate on `axis_name` equals `index`."""
+        axis = self._parallel_names.index(axis_name)
+        return sorted(r for c, r in self._coord2rank.items()
+                      if c[axis] == index)
+
+    def get_comm_list(self, axis_name):
+        """Rank groups that communicate along `axis_name`: one list per
+        combination of the other axes (ref: topology.py get_comm_list)."""
+        axis = self._parallel_names.index(axis_name)
+        other_ranges = [range(d) for i, d in enumerate(self._dims)
+                        if i != axis]
+        out = []
+        for other in itertools.product(*other_ranges):
+            group = []
+            for v in range(self._dims[axis]):
+                coord = list(other)
+                coord.insert(axis, v)
+                group.append(self._coord2rank[tuple(coord)])
+            out.append(group)
+        return out
+
+    def get_rank_from_stage(self, global_rank, **kwargs):
+        coord = list(self.get_coord(global_rank))
+        for k, v in kwargs.items():
+            coord[self._parallel_names.index(k)] = v
+        return self._coord2rank[tuple(coord)]
+
+
+# map reference group names → mesh axis names
+_NAME2AXIS = {"data": "dp", "pipe": "pp", "sharding": "sharding",
+              "sep": "sep", "model": "mp"}
+
+
+class HybridCommunicateGroup:
+    """ref: ``topology.py:144``. Exposes the same per-axis world-size /
+    rank / group queries; additionally owns the global Mesh."""
+
+    def __init__(self, topology: CommunicateTopology):
+        self._topo = topology
+        self.global_rank = get_rank()
+        self._dp_degree = topology.get_dim("data")
+        self._pp_degree = topology.get_dim("pipe")
+        self._sharding_degree = topology.get_dim("sharding")
+        self._sep_degree = topology.get_dim("sep") \
+            if "sep" in topology.get_hybrid_group_names() else 1
+        self._mp_degree = topology.get_dim("model")
+        self.nranks = topology.world_size()
+
+        # build the global mesh with matching axis sizes
+        degrees = {"dp": self._dp_degree, "pp": self._pp_degree,
+                   "sharding": self._sharding_degree,
+                   "sep": self._sep_degree, "mp": self._mp_degree}
+        import jax
+        if self.nranks <= jax.device_count():
+            self.mesh = _mesh_mod.init_mesh(degrees)
+        else:  # more ranks than local devices (multi-host): mesh is global
+            self.mesh = None
+
+        rank = self.global_rank
+        coord = topology.get_coord(rank % self.nranks)
+        names = topology.get_hybrid_group_names()
+        self._coord = dict(zip(names, coord))
+
+        self._groups = {}
+        for name in names:
+            axis = _NAME2AXIS[name]
+            for ranks in topology.get_comm_list(name):
+                if rank % self.nranks in ranks:
+                    self._groups[name] = new_group(ranks, axis_name=axis)
+                    break
+
+    # -- per-axis queries (reference API surface) -------------------------
+    def get_parallel_mode(self):
+        if self._pp_degree > 1:
+            return "pipeline"
+        if self._sharding_degree > 1:
+            return "sharding_parallel"
+        if self._mp_degree > 1:
+            return "model"
+        return "data"
+
+    def topology(self):
+        return self._topo
+
+    def get_global_rank(self):
+        return self.global_rank
+
+    # data parallel
+    def get_data_parallel_rank(self):
+        return self._coord["data"]
+
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_data_parallel_group(self) -> Group:
+        return self._groups["data"]
+
+    def get_data_parallel_group_src_rank(self):
+        return self._groups["data"].ranks[0]
+
+    # model (tensor) parallel
+    def get_model_parallel_rank(self):
+        return self._coord["model"]
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_model_parallel_group(self) -> Group:
+        return self._groups["model"]
+
+    def get_model_parallel_group_src_rank(self):
+        return self._groups["model"].ranks[0]
+
+    # pipeline parallel
+    def get_stage_id(self):
+        return self._coord["pipe"]
+
+    def get_pipe_parallel_rank(self):
+        return self._coord["pipe"]
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_pipe_parallel_group(self) -> Group:
+        return self._groups["pipe"]
+
+    def is_first_stage(self):
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self):
+        return self.get_stage_id() == self._pp_degree - 1
+
+    def get_p2p_groups(self):
+        return None
+
+    # sharding
+    def get_sharding_parallel_rank(self):
+        return self._coord["sharding"]
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sharding_parallel_group(self) -> Group:
+        return self._groups["sharding"]
+
+    def get_sharding_parallel_group_src_rank(self):
+        return self._groups["sharding"].ranks[0]
+
+    # sep (sequence/context parallel — TPU-build extension)
+    def get_sep_parallel_rank(self):
+        return self._coord.get("sep", 0)
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    def get_sep_parallel_group(self) -> Group:
+        return self._groups.get("sep")
+
+    # checks
+    def get_check_parallel_group(self):
+        return self._groups["model"]
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return self._topo.get_rank_from_stage(self.global_rank,
+                                              pipe=stage_id, **kwargs)

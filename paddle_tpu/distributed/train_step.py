@@ -1,0 +1,146 @@
+"""Sharded train-step builder: the hybrid-parallel fast path.
+
+This is the TPU-native replacement for the reference's entire hybrid
+training machinery (SURVEY §3.3): where the reference composes
+Fleet + HybridCommunicateGroup + PipelineParallel.train_batch +
+EagerReducer + HybridParallelOptimizer at runtime, here ONE function
+builds ONE jitted XLA program:
+
+ - parameters/optimizer state placed by their ``PartitionSpec``
+   annotations (mp from the TP layers, sharding from fsdp annotation)
+ - batch sharded over dp (× sep for long sequences)
+ - gradient psums over dp/sharding, TP collectives over mp, all compiled
+   and overlapped by XLA over ICI
+
+Used by fleet users, ``__graft_entry__.dryrun_multichip`` and the bench.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..jit.api import functional_call
+from ..framework import random as _random
+from . import mesh as _mesh_mod
+
+__all__ = ["param_shardings", "shard_model_state", "build_train_step"]
+
+
+def _spec_for(p, mesh):
+    spec = getattr(p, "_spec", None)
+    if spec is None:
+        return P()
+    # drop axis names the mesh doesn't have (e.g. model built with TP
+    # annotations but run on a dp-only mesh)
+    axes = []
+    for entry in spec:
+        if entry is None:
+            axes.append(None)
+        elif isinstance(entry, tuple):
+            kept = tuple(a for a in entry if a in mesh.shape
+                         and mesh.shape[a] > 1)
+            axes.append(kept if kept else None)
+        else:
+            axes.append(entry if entry in mesh.shape and
+                        mesh.shape[entry] > 1 else None)
+    # verify divisibility; fall back to replicated otherwise
+    for d, a in enumerate(axes):
+        names = (a,) if isinstance(a, str) else (a or ())
+        size = int(np.prod([mesh.shape[n] for n in names])) if names else 1
+        if size > 1 and p.shape[d] % size:
+            return P()
+    return P(*axes)
+
+
+def param_shardings(layer: Layer, mesh=None):
+    """{name: NamedSharding} honoring per-parameter specs."""
+    mesh = mesh or _mesh_mod.get_mesh()
+    return {k: NamedSharding(mesh, _spec_for(p, mesh))
+            for k, p in layer.named_parameters()}
+
+
+def shard_model_state(layer: Layer, mesh=None):
+    """Extract + place (params, buffers) arrays onto the mesh."""
+    mesh = mesh or _mesh_mod.get_mesh()
+    shardings = param_shardings(layer, mesh)
+    # copy via jnp.copy: the step donates its state buffers, and the layer
+    # must keep owning its original (undonated) arrays
+    params = {k: jax.device_put(jnp.copy(p._data), shardings[k])
+              for k, p in layer.named_parameters()}
+    repl = NamedSharding(mesh, P())
+    buffers = {k: jax.device_put(jnp.copy(b._data), repl)
+               for k, b in layer.named_buffers()}
+    return params, buffers, shardings
+
+
+def build_train_step(model: Layer, loss_fn, optimizer, mesh=None,
+                     donate=True):
+    """Returns (step_fn, state) where
+    ``state = {"params", "buffers", "opt"}`` is mesh-placed and
+    ``step_fn(state, *batch) -> (loss, state)`` is one compiled program.
+
+    ``loss_fn(outputs, *labels) -> scalar Tensor-or-array``.
+    The batch's leading axis is sharded over ``dp`` (and the second axis
+    over ``sep`` when that axis is >1, for sequence parallelism).
+    """
+    mesh = mesh or _mesh_mod.get_mesh()
+    params, buffers, shardings = shard_model_state(model, mesh)
+    opt_state = optimizer.init_state_tree(params)
+    # optimizer slots/master inherit each param's sharding (the ZeRO win:
+    # an fsdp-annotated param stores adam moments sharded the same way)
+    slots_sh = {s: {k: shardings[k] for k in opt_state["slots"][s]}
+                for s in opt_state["slots"]}
+    master_sh = {k: shardings[k] for k in opt_state["master"]}
+    repl = NamedSharding(mesh, P())
+    opt_state = {
+        "slots": {s: {k: jax.device_put(v, slots_sh[s][k])
+                      for k, v in sv.items()}
+                  for s, sv in opt_state["slots"].items()},
+        "master": {k: jax.device_put(v, master_sh[k])
+                   for k, v in opt_state["master"].items()},
+        "step": jax.device_put(opt_state["step"], repl),
+    }
+    state = {"params": params, "buffers": buffers, "opt": opt_state}
+
+    sep = mesh.shape.get("sep", 1)
+    data_spec = P("dp", "sep") if sep > 1 else P("dp")
+    data_sharding = NamedSharding(mesh, data_spec)
+    fwd = getattr(model, "_orig_forward", model.forward)
+
+    def step(state, x, *labels):
+        def loss_of(p):
+            out, new_buffers = functional_call(
+                model, p, state["buffers"], (Tensor(x),), training=True,
+                forward_fn=fwd)
+            loss = loss_fn(out, *[Tensor(l) for l in labels])
+            loss_arr = loss._data if isinstance(loss, Tensor) else loss
+            return loss_arr.astype(jnp.float32), new_buffers
+
+        (loss, new_buffers), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(state["params"])
+        new_params, new_opt = optimizer.apply_gradients_tree(
+            state["params"], grads, state["opt"])
+        return loss, {"params": new_params, "buffers": new_buffers,
+                      "opt": new_opt}
+
+    def rng_step(state, key, x, *labels):
+        with _random.trace_key_scope(key):
+            return step(state, x, *labels)
+
+    jitted = jax.jit(rng_step, donate_argnums=(0,) if donate else ())
+
+    def run(state, x, *labels):
+        x = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        labels = [l._data if isinstance(l, Tensor) else jnp.asarray(l)
+                  for l in labels]
+        x = jax.device_put(x, data_sharding)
+        labels = [jax.device_put(l, data_sharding) for l in labels]
+        key = _random.next_key()
+        with jax.set_mesh(mesh):
+            return jitted(state, key, x, *labels)
+
+    return run, state

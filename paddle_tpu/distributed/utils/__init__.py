@@ -1,0 +1,17 @@
+"""Distributed helpers (ref: ``python/paddle/distributed/utils/``)."""
+from __future__ import annotations
+
+__all__ = ["global_scatter", "global_gather"]
+
+
+def global_scatter(x, local_count, global_count, group=None):
+    """MoE dispatch primitive (ref: ``utils/moe_utils.py global_scatter``);
+    the TPU path uses dense all_to_all inside the MoE layer instead —
+    exposed here for API parity."""
+    from ..collective import alltoall_single
+    return alltoall_single(x, group=group)
+
+
+def global_gather(x, local_count, global_count, group=None):
+    from ..collective import alltoall_single
+    return alltoall_single(x, group=group)

@@ -1,0 +1,5 @@
+"""``paddle_tpu.incubate`` (ref: ``python/paddle/incubate/``): fused nn
+blocks, model zoo (GPT flagship), distributed extras."""
+from . import nn  # noqa: F401
+from . import models  # noqa: F401
+from . import autograd  # noqa: F401

@@ -1,0 +1,84 @@
+"""Functional autograd extras (ref: ``python/paddle/incubate/autograd/``
+``primapi.py:25 forward_grad, :108 grad``). On TPU these map directly to
+jax transforms — the reference's prim/composite decomposition machinery
+(``paddle/fluid/prim/``) is XLA's job."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor import Tensor
+
+__all__ = ["jvp", "vjp", "Jacobian", "Hessian", "forward_grad", "grad"]
+
+
+def _fn_over_arrays(func):
+    def f(*arrays):
+        out = func(*[Tensor(a, stop_gradient=False) for a in arrays])
+        return jax.tree_util.tree_map(
+            lambda t: t._data if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda t: isinstance(t, Tensor))
+    return f
+
+
+def jvp(func, xs, v=None):
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [x._data if isinstance(x, Tensor) else jnp.asarray(x)
+              for x in xs]
+    if v is None:
+        v = [jnp.ones_like(a) for a in arrays]
+    else:
+        v = [t._data if isinstance(t, Tensor) else jnp.asarray(t)
+             for t in (v if isinstance(v, (list, tuple)) else [v])]
+    out, tangent = jax.jvp(_fn_over_arrays(func), tuple(arrays), tuple(v))
+    wrap = lambda tr: jax.tree_util.tree_map(Tensor, tr)
+    return wrap(out), wrap(tangent)
+
+
+def vjp(func, xs, v=None):
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [x._data if isinstance(x, Tensor) else jnp.asarray(x)
+              for x in xs]
+    out, vjp_fn = jax.vjp(_fn_over_arrays(func), *arrays)
+    if v is None:
+        v = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        v = jax.tree_util.tree_map(
+            lambda t: t._data if isinstance(t, Tensor) else jnp.asarray(t),
+            v, is_leaf=lambda t: isinstance(t, Tensor))
+    grads = vjp_fn(v)
+    wrap = lambda tr: jax.tree_util.tree_map(Tensor, tr)
+    return wrap(out), list(wrap(grads))
+
+
+forward_grad = jvp
+grad = vjp
+
+
+class Jacobian:
+    """ref: primapi Jacobian — full dense jacobian, computed with jacrev."""
+
+    def __init__(self, func, xs, is_batched=False):
+        arrays = [x._data if isinstance(x, Tensor) else jnp.asarray(x)
+                  for x in (xs if isinstance(xs, (list, tuple)) else [xs])]
+        self._jac = jax.jacrev(_fn_over_arrays(func),
+                               argnums=tuple(range(len(arrays))))(*arrays)
+
+    def __getitem__(self, idx):
+        return Tensor(jnp.asarray(self._jac[idx]))
+
+    @property
+    def value(self):
+        return jax.tree_util.tree_map(Tensor, self._jac)
+
+
+class Hessian:
+    def __init__(self, func, xs, is_batched=False):
+        arrays = [x._data if isinstance(x, Tensor) else jnp.asarray(x)
+                  for x in (xs if isinstance(xs, (list, tuple)) else [xs])]
+        self._hes = jax.hessian(_fn_over_arrays(func),
+                                argnums=tuple(range(len(arrays))))(*arrays)
+
+    @property
+    def value(self):
+        return jax.tree_util.tree_map(Tensor, self._hes)

@@ -1,0 +1,4 @@
+from .gpt import (  # noqa: F401
+    GPTConfig, GPTModel, GPTForCausalLM, GPTPretrainingCriterion,
+    gpt_tiny, gpt_345m, gpt_1p3b, gpt_6p7b, gpt_13b,
+)

@@ -1,0 +1,16 @@
+"""Fused transformer blocks (ref: ``python/paddle/incubate/nn/``:
+FusedMultiHeadAttention, FusedFeedForward, FusedTransformerEncoderLayer,
+``functional/fused_transformer.py``, memory_efficient_attention).
+
+TPU-native: "fused" means "one XLA fusion region" — the whole block is
+written as a single jnp composition (attention via
+``F.scaled_dot_product_attention`` → Pallas flash kernel on TPU), so the
+reference's hand-written fused CUDA kernels
+(``paddle/phi/kernels/fusion/``) map to compiler fusions + Pallas.
+"""
+from .fused_transformer import (  # noqa: F401
+    FusedMultiHeadAttention, FusedFeedForward, FusedTransformerEncoderLayer,
+    FusedMultiTransformer,
+)
+from . import functional  # noqa: F401
+from .memory_efficient_attention import memory_efficient_attention  # noqa: F401

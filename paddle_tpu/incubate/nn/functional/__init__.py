@@ -1,0 +1,99 @@
+"""ref: ``python/paddle/incubate/nn/functional/`` fused functional ops."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ....ops.op_utils import nary, ensure_tensor
+from ....tensor import Tensor
+
+__all__ = ["fused_rotary_position_embedding", "fused_rms_norm",
+           "fused_dropout_add", "fused_linear", "swiglu"]
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True):
+    """RoPE (ref: ``fused_rope`` kernel ``paddle/phi/kernels/fusion/
+    fused_rope_grad_kernel.h``). Layout (B, S, H, D)."""
+
+    def rope_one(x, sin_, cos_):
+        if use_neox_rotary_style:
+            d = x.shape[-1]
+            x1, x2 = x[..., : d // 2], x[..., d // 2:]
+            rot = jnp.concatenate([-x2, x1], axis=-1)
+        else:
+            x1 = x[..., ::2]
+            x2 = x[..., 1::2]
+            rot = jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
+        return x * cos_ + rot * sin_
+
+    outs = []
+    tensors = [t for t in (q, k, v) if t is not None]
+    first = ensure_tensor(tensors[0])
+    S, D = first.shape[1], first.shape[-1]
+    if sin is None or cos is None:
+        pos = jnp.arange(S)[:, None]
+        inv = 1.0 / (10000.0 ** (jnp.arange(0, D, 2) / D))
+        angles = pos * inv[None, :]
+        if use_neox_rotary_style:
+            emb = jnp.concatenate([angles, angles], axis=-1)
+        else:
+            emb = jnp.repeat(angles, 2, axis=-1)
+        sin_a, cos_a = jnp.sin(emb), jnp.cos(emb)
+    else:
+        sin_a = ensure_tensor(sin)._data.reshape(S, D)
+        cos_a = ensure_tensor(cos)._data.reshape(S, D)
+    sin_b = sin_a[None, :, None, :]
+    cos_b = cos_a[None, :, None, :]
+
+    for t in (q, k, v):
+        if t is None:
+            outs.append(None)
+            continue
+        outs.append(nary(lambda x: rope_one(x, sin_b, cos_b),
+                         [ensure_tensor(t)], name="fused_rope"))
+    return tuple(outs)
+
+
+def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1):
+    """RMSNorm in one fusion region."""
+    args = [ensure_tensor(x)]
+    if norm_weight is not None:
+        args.append(ensure_tensor(norm_weight))
+
+    def f(xd, *w):
+        var = jnp.mean(xd.astype(jnp.float32) ** 2, axis=-1, keepdims=True)
+        out = (xd.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon))
+        out = out.astype(xd.dtype)
+        if w:
+            out = out * w[0]
+        return out
+
+    return nary(f, args, name="fused_rms_norm")
+
+
+def fused_dropout_add(x, y, p=0.0, training=True, mode="upscale_in_train"):
+    from ....nn import functional as F
+    return F.dropout(x, p=p, training=training, mode=mode) + ensure_tensor(y)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False):
+    from ....nn import functional as F
+    w = ensure_tensor(weight)
+    if transpose_weight:
+        w = w.T
+    return F.linear(x, w, bias)
+
+
+def swiglu(x, y=None):
+    """ref: fused swiglu kernel — silu(x) * y (y defaults to second half)."""
+    x = ensure_tensor(x)
+    if y is None:
+        def f(xd):
+            a, b = jnp.split(xd, 2, axis=-1)
+            return jax.nn.silu(a) * b
+        return nary(f, [x], name="swiglu")
+    return nary(lambda a, b: jax.nn.silu(a) * b, [x, ensure_tensor(y)],
+                name="swiglu")

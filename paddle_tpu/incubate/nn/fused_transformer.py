@@ -1,0 +1,144 @@
+"""Fused transformer layers (ref: ``python/paddle/incubate/nn/layer/
+fused_transformer.py``). One XLA fusion region per block; normalize_before
+(pre-LN) matches the reference default for Fused* layers.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...nn.layer.layers import Layer
+from ...nn import functional as F
+from ...nn.layer.common import Linear, Dropout
+from ...nn.layer.norm import LayerNorm
+from ...tensor import Tensor
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer", "FusedMultiTransformer"]
+
+
+class FusedMultiHeadAttention(Layer):
+    """ref: fused_transformer.py FusedMultiHeadAttention — QKV in one
+    matmul, flash attention, out proj, residual+LN fused by XLA."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.qkv = Linear(embed_dim, 3 * embed_dim,
+                          weight_attr=qkv_weight_attr,
+                          bias_attr=qkv_bias_attr)
+        self.out_proj = Linear(embed_dim, embed_dim,
+                               weight_attr=linear_weight_attr,
+                               bias_attr=linear_bias_attr)
+        self.ln = LayerNorm(embed_dim, epsilon=epsilon)
+        self.dropout = Dropout(dropout_rate)
+
+    def forward(self, x, attn_mask=None, cache=None):
+        residual = x
+        if self.normalize_before:
+            x = self.ln(x)
+        B, S = x.shape[0], x.shape[1]
+        qkv = self.qkv(x)
+        qkv = qkv.reshape([B, S, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, dropout_p=self.attn_dropout_rate,
+            training=self.training)
+        out = out.reshape([B, S, self.embed_dim])
+        out = self.dropout(self.out_proj(out))
+        out = residual + out
+        if not self.normalize_before:
+            out = self.ln(out)
+        return out
+
+
+class FusedFeedForward(Layer):
+    """ref: fused_transformer.py FusedFeedForward."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None, ln2_bias_attr=None,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.activation = activation
+        self.linear1 = Linear(d_model, dim_feedforward,
+                              weight_attr=linear1_weight_attr,
+                              bias_attr=linear1_bias_attr)
+        self.linear2 = Linear(dim_feedforward, d_model,
+                              weight_attr=linear2_weight_attr,
+                              bias_attr=linear2_bias_attr)
+        self.ln = LayerNorm(d_model, epsilon=epsilon)
+        self.dropout1 = Dropout(act_dropout_rate if act_dropout_rate
+                                is not None else dropout_rate)
+        self.dropout2 = Dropout(dropout_rate)
+
+    def forward(self, x):
+        residual = x
+        if self.normalize_before:
+            x = self.ln(x)
+        act = getattr(F, self.activation)
+        out = self.linear2(self.dropout1(act(self.linear1(x))))
+        out = residual + self.dropout2(out)
+        if not self.normalize_before:
+            out = self.ln(out)
+        return out
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """ref: fused_transformer.py FusedTransformerEncoderLayer."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead,
+            dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate if attn_dropout_rate
+            is not None else dropout_rate,
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        return self.ffn(self.fused_attn(src, attn_mask=src_mask))
+
+
+class FusedMultiTransformer(Layer):
+    """ref: fused_transformer.py FusedMultiTransformer — N stacked decoder
+    blocks driven from flat parameter lists (inference-style API)."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu", normalize_before=True,
+                 num_layers=1, nranks=1, ring_id=-1, name=None, **kw):
+        super().__init__()
+        from ...nn.layer.container import LayerList
+        self.layers = LayerList([
+            FusedTransformerEncoderLayer(
+                embed_dim, num_heads, dim_feedforward,
+                dropout_rate=dropout_rate, activation=activation,
+                normalize_before=normalize_before)
+            for _ in range(num_layers)])
+
+    def forward(self, x, attn_mask=None, caches=None, **kw):
+        for l in self.layers:
+            x = l(x, src_mask=attn_mask)
+        return x

@@ -1,0 +1,15 @@
+"""ref: ``python/paddle/incubate/nn/memory_efficient_attention.py`` (the
+xformers-derived CUDA kernel). TPU-native: same API over the flash
+attention path (Pallas on hardware, fused XLA otherwise)."""
+from __future__ import annotations
+
+from ...nn import functional as F
+
+__all__ = ["memory_efficient_attention"]
+
+
+def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,
+                               scale=None, training=True):
+    return F.scaled_dot_product_attention(
+        query, key, value, attn_mask=attn_bias, dropout_p=p,
+        is_causal=False, training=training)
