@@ -60,7 +60,13 @@ _LAX_REDUCE = {
     ReduceOp.SUM: lax.psum,
     ReduceOp.MAX: lax.pmax,
     ReduceOp.MIN: lax.pmin,
-    ReduceOp.PROD: lambda x, ax: jnp.exp(lax.psum(jnp.log(x), ax)),
+    # product = sign * exp(sum(log|x|)); psum of the sign-parity keeps
+    # negatives exact and zeros propagate as zeros.
+    ReduceOp.PROD: lambda x, ax: (
+        jnp.where(lax.psum((x == 0).astype(jnp.int32), ax) > 0, 0.0,
+                  (1.0 - 2.0 * (lax.psum((x < 0).astype(jnp.int32), ax) % 2))
+                  * jnp.exp(lax.psum(jnp.log(jnp.maximum(jnp.abs(x), 1e-38)),
+                                     ax))).astype(x.dtype)),
     ReduceOp.AVG: lax.pmean,
 }
 
@@ -314,7 +320,9 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     all_gather+index (compiled to a broadcast over ICI)."""
     g = _group_of(group)
     x = _data(tensor)
-    src_local = g.get_group_rank(src) if src in g.ranks else src
+    if src not in g.ranks:
+        raise ValueError(f"broadcast src={src} is not in group {g.ranks}")
+    src_local = g.get_group_rank(src)
     if _in_axis_scope(g.axis_name):
         gathered = lax.all_gather(x, g.axis_name, axis=0)
         return _ret(gathered[src_local], tensor)
@@ -342,7 +350,9 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     g = _group_of(group)
     red = _LAX_REDUCE[op]
     x = _data(tensor)
-    dst_local = g.get_group_rank(dst) if dst in g.ranks else dst
+    if dst not in g.ranks:
+        raise ValueError(f"reduce dst={dst} is not in group {g.ranks}")
+    dst_local = g.get_group_rank(dst)
     if _in_axis_scope(g.axis_name):
         r = red(x, g.axis_name)
         i = lax.axis_index(g.axis_name)
@@ -521,13 +531,13 @@ def send(tensor, dst=0, group=None, sync_op=True):
         raise RuntimeError(
             "Inside shard_map use paddle_tpu.distributed.p2p helpers "
             "(ppermute) — a lone send has no SPMD meaning")
-    _MAILBOX.setdefault((g.id, dst), []).append(_data(tensor))
+    _MAILBOX.setdefault((g.id, g.rank, dst), []).append(_data(tensor))
     return _Task()
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
     g = _group_of(group)
-    box = _MAILBOX.get((g.id, max(g.rank, 0)), None)
+    box = _MAILBOX.get((g.id, src, max(g.rank, 0)), None)
     if not box:
         raise RuntimeError(f"recv: no message pending from rank {src}")
     return _ret(box.pop(0), tensor)

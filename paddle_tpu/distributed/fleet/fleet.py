@@ -104,6 +104,10 @@ class Fleet:
             from .meta_parallel.tensor_parallel import TensorParallel
             return TensorParallel(model, hcg,
                                   strategy=self._user_defined_strategy)
+        if mode == "sharding_parallel":
+            from .meta_parallel.sharding_parallel import ShardingParallel
+            return ShardingParallel(model, hcg,
+                                    strategy=self._user_defined_strategy)
         from ..parallel import DataParallel
         return DataParallel(model,
                             group=hcg.get_data_parallel_group())

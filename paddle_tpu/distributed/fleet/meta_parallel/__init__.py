@@ -12,3 +12,7 @@ from .tensor_parallel import TensorParallel  # noqa: F401
 from .pipeline_parallel import PipelineParallel  # noqa: F401
 from .sharding_parallel import ShardingParallel  # noqa: F401
 from . import mp_ops  # noqa: F401
+from .sequence_parallel import (  # noqa: F401
+    ring_attention, ulysses_attention, split_sequence, gather_sequence,
+    RingFlashAttention,
+)

@@ -213,6 +213,8 @@ class ParallelCrossEntropy(Layer):
         y = label._data if isinstance(label, Tensor) else jnp.asarray(label)
         if y.ndim == logits.ndim:  # [.., 1] form like the reference
             y = y.squeeze(-1)
+        valid = y != self.ignore_index
+        y_safe = jnp.where(valid, y, 0)
         if _in_axis_scope(ax):
             n_local = logits.shape[-1]
             i = lax.axis_index(ax)
@@ -220,23 +222,27 @@ class ParallelCrossEntropy(Layer):
             m = lax.pmax(jnp.max(logits, axis=-1), ax)
             shifted = logits - m[..., None]
             sumexp = lax.psum(jnp.sum(jnp.exp(shifted), axis=-1), ax)
-            in_range = (y >= start) & (y < start + n_local)
-            local_y = jnp.clip(y - start, 0, n_local - 1)
+            in_range = (y_safe >= start) & (y_safe < start + n_local)
+            local_y = jnp.clip(y_safe - start, 0, n_local - 1)
             tgt = jnp.take_along_axis(shifted, local_y[..., None],
                                       axis=-1)[..., 0]
             tgt = lax.psum(jnp.where(in_range, tgt, 0.0), ax)
-            loss = jnp.log(sumexp) - tgt
+            loss = jnp.where(valid, jnp.log(sumexp) - tgt, 0.0)
             return Tensor(loss[..., None], stop_gradient=False)
         # GSPMD mode: plain CE on the tape; XLA keeps the logits sharded
         from .....ops.op_utils import nary
 
+        ignore = self.ignore_index
+
         def ce(lg, yy):
+            ok = yy != ignore
+            yy_safe = jnp.where(ok, yy, 0)
             m = jnp.max(lg, axis=-1, keepdims=True)
             shifted = lg - jax.lax.stop_gradient(m)
             lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1))
-            tgt = jnp.take_along_axis(shifted, yy[..., None],
+            tgt = jnp.take_along_axis(shifted, yy_safe[..., None],
                                       axis=-1)[..., 0]
-            return (lse - tgt)[..., None]
+            return jnp.where(ok, lse - tgt, 0.0)[..., None]
 
         return nary(ce, [input if isinstance(input, Tensor)
                          else Tensor(input), Tensor(y)],

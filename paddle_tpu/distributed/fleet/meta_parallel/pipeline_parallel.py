@@ -88,8 +88,8 @@ class PipelineParallel(Layer):
             return self._layers._loss_fn(out, labels)
         return out
 
-    def forward_backward_pipeline(self, data, scaler=None):
-        return self.train_batch(data, scaler=scaler)
+    def forward_backward_pipeline(self, data, optimizer, scaler=None):
+        return self.train_batch(data, optimizer, scaler=scaler)
 
     def _split_micro(self, t):
         n = self.accumulate_steps

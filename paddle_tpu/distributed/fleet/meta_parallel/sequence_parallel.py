@@ -1,0 +1,203 @@
+"""Sequence / context parallelism — a NEW first-class capability.
+
+The reference snapshot has no SP/CP at all (SURVEY §5: no
+sequence_parallel / ring_attention / ulysses symbol anywhere); its
+long-context story is flash-attn + recompute + PP/TP.  Here the sequence
+axis is a real mesh axis (``sep`` in the hybrid mesh,
+paddle_tpu.distributed.mesh.HYBRID_AXES) and attention over sequences
+larger than one chip's HBM is computed two ways:
+
+* **Ring attention** (`ring_attention`): K/V shards rotate around the
+  ``sep`` ring via ``lax.ppermute`` (compiled to ICI neighbor DMA);
+  per-step partial softmax stats (out, lse) merge online, so no device
+  ever materializes the full sequence — O(S/n) memory, exact result.
+  Each step is wrapped in ``jax.checkpoint`` so backward recomputes the
+  per-step attention instead of saving n partial score matrices.
+
+* **Ulysses / all-to-all** (`ulysses_attention`): all_to_all swaps the
+  sequence shard for a head shard, runs dense local attention over the
+  full sequence on H/n heads, and swaps back.  Cheaper at moderate S
+  when H divides nicely; the classic DeepSpeed-Ulysses layout.
+
+All functions operate on raw (B, H, S_local, D) arrays *inside*
+shard_map/jit over a mesh with the given axis; `RingFlashAttention` is
+the Layer-facing wrapper taking paddle-layout (B, S, H, D) Tensors.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ring_attention", "ulysses_attention", "split_sequence",
+           "gather_sequence", "RingFlashAttention"]
+
+
+def _partial_attn(q, k, v, scale, mask):
+    """Partial softmax attention vs one kv block → (out, lse) in f32.
+
+    Fully-masked rows yield lse=-inf and out=0, which the online merge
+    treats as a zero-weight contribution.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    s = jnp.where(mask, s, -jnp.inf)
+    m = jnp.max(s, axis=-1)
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(mask, p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    lse = jnp.where(l > 0, jnp.log(jnp.maximum(l, 1e-38)) + m_safe,
+                    -jnp.inf)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    denom = jnp.where(l > 0, l, 1.0)
+    return out / denom[..., None], lse
+
+
+def _merge(o1, lse1, o2, lse2):
+    """Merge two partial-softmax results (flash-attention combine)."""
+    m = jnp.maximum(lse1, lse2)
+    m = jnp.where(jnp.isfinite(m), m, 0.0)
+    w1 = jnp.exp(lse1 - m)
+    w2 = jnp.exp(lse2 - m)
+    tot = w1 + w2
+    lse = jnp.where(tot > 0, jnp.log(jnp.maximum(tot, 1e-38)) + m, -jnp.inf)
+    safe = jnp.where(tot > 0, tot, 1.0)
+    o = (o1 * w1[..., None] + o2 * w2[..., None]) / safe[..., None]
+    return o, lse
+
+
+def ring_attention(q, k, v, axis_name="sep", causal=False, sm_scale=None):
+    """Exact attention over a sequence sharded on ``axis_name``.
+
+    Args are local shards (B, H, S_local, D) inside shard_map. Returns
+    the local (B, H, S_local, D) output shard.
+    """
+    n = lax.axis_size(axis_name)
+    r = lax.axis_index(axis_name)
+    b, h, sl, d = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    qpos = r * sl + lax.broadcasted_iota(jnp.int32, (sl, 1), 0)
+
+    @functools.partial(jax.checkpoint, static_argnums=())
+    def step_attn(q, kk, vv, src):
+        kpos = src * sl + lax.broadcasted_iota(jnp.int32, (1, sl), 1)
+        if causal:
+            mask = kpos <= qpos  # (sl, sl) global causal mask
+        else:
+            mask = jnp.ones((sl, sl), dtype=bool)
+        return _partial_attn(q, kk, vv, scale, mask[None, None])
+
+    def body(carry, _):
+        o, lse, kk, vv, src = carry
+        o2, lse2 = step_attn(q, kk, vv, src)
+        o, lse = _merge(o, lse, o2, lse2)
+        kk = lax.ppermute(kk, axis_name, perm)
+        vv = lax.ppermute(vv, axis_name, perm)
+        src = ((src + n - 1) % n).astype(jnp.int32)
+        return (o, lse, kk, vv, src), None
+
+    o0 = jnp.zeros((b, h, sl, d), jnp.float32)
+    lse0 = jnp.full((b, h, sl), -jnp.inf, jnp.float32)
+    # the merged carries become device-varying after step 1; mark the
+    # initial values as varying over the ring axis so scan's carry type
+    # is stable (jax vma tracking)
+    if hasattr(lax, "pcast"):
+        o0 = lax.pcast(o0, (axis_name,), to="varying")
+        lse0 = lax.pcast(lse0, (axis_name,), to="varying")
+    (o, lse, _, _, _), _ = lax.scan(
+        body, (o0, lse0, k, v, r.astype(jnp.int32)), None, length=n)
+    return o.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name="sep", causal=False, sm_scale=None,
+                      attn_fn=None):
+    """DeepSpeed-Ulysses: all_to_all seq-shard ↔ head-shard, dense local
+    attention on H/n heads over the full sequence, all_to_all back.
+
+    Local shards (B, H, S_local, D); H must be divisible by the axis
+    size.
+    """
+    n = lax.axis_size(axis_name)
+    b, h, sl, d = q.shape
+    if h % n:
+        raise ValueError(f"heads {h} not divisible by sep degree {n}")
+
+    def to_heads(x):  # (B,H,Sl,D) -> (B,H/n,S,D)
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def to_seq(x):  # (B,H/n,S,D) -> (B,H,Sl,D)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    if attn_fn is None:
+        s = qh.shape[2]
+        scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
+        if causal:
+            qi = lax.broadcasted_iota(jnp.int32, (s, s), 0)
+            ki = lax.broadcasted_iota(jnp.int32, (s, s), 1)
+            mask = (ki <= qi)[None, None]
+        else:
+            mask = jnp.ones((1, 1, s, s), dtype=bool)
+        out, _ = _partial_attn(qh, kh, vh, scale, mask)
+        out = out.astype(q.dtype)
+    else:
+        out = attn_fn(qh, kh, vh)
+    return to_seq(out)
+
+
+def split_sequence(x, axis_name="sep", axis=1):
+    """Scatter a replicated tensor's sequence axis across the sep ring
+    (the `_c_split` analog on the sequence dimension)."""
+    n = lax.axis_size(axis_name)
+    i = lax.axis_index(axis_name)
+    sl = x.shape[axis] // n
+    return lax.dynamic_slice_in_dim(x, i * sl, sl, axis=axis)
+
+
+def gather_sequence(x, axis_name="sep", axis=1):
+    """All-gather sequence shards back to the full sequence."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=True)
+
+
+class RingFlashAttention:
+    """Layer-facing wrapper: paddle layout (B, S_local, H, D) Tensors in
+    eager/GSPMD mode, routing to `ring_attention` when executing inside
+    a shard_map scope with a live ``sep`` axis, else plain attention.
+    """
+
+    def __init__(self, axis_name="sep", causal=True):
+        self.axis_name = axis_name
+        self.causal = causal
+
+    def __call__(self, q, k, v):
+        from ....ops.op_utils import ensure_tensor, nary
+        q, k, v = ensure_tensor(q), ensure_tensor(k), ensure_tensor(v)
+        ax = self.axis_name
+        causal = self.causal
+
+        def in_scope():
+            try:
+                lax.axis_size(ax)
+                return True
+            except NameError:
+                return False
+
+        if in_scope():
+            def f(qd, kd, vd):
+                o = ring_attention(jnp.swapaxes(qd, 1, 2),
+                                   jnp.swapaxes(kd, 1, 2),
+                                   jnp.swapaxes(vd, 1, 2),
+                                   axis_name=ax, causal=causal)
+                return jnp.swapaxes(o, 1, 2)
+        else:
+            from ....nn import functional as F
+            return F.scaled_dot_product_attention(q, k, v,
+                                                  is_causal=causal)
+        return nary(f, [q, k, v], name="ring_flash_attention")

@@ -49,16 +49,8 @@ class TensorParallel(Layer):
     def forward(self, *inputs, **kwargs):
         mesh = _mesh_mod.get_mesh()
         if mesh is not None and mesh.shape.get("dp", 1) > 1:
-            sharding = NamedSharding(mesh, P("dp"))
-
-            def shard_in(x):
-                if isinstance(x, Tensor) and x.ndim >= 1 and \
-                        not isinstance(x._data, jax.core.Tracer) and \
-                        x.shape[0] % mesh.shape["dp"] == 0:
-                    x._data = jax.device_put(x._data, sharding)
-                return x
-
-            inputs = tuple(shard_in(x) for x in inputs)
+            from ...parallel import shard_batch_inputs
+            inputs, kwargs = shard_batch_inputs(mesh, inputs, kwargs)
         return self._layers(*inputs, **kwargs)
 
     def state_dict(self, *args, **kwargs):

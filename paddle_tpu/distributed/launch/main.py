@@ -1,0 +1,162 @@
+"""Multi-process / multi-node launcher — ``python -m
+paddle_tpu.distributed.launch``.
+
+TPU-native redesign of the reference launcher (``python/paddle/
+distributed/launch/main.py:18`` + ``controllers/collective.py``): same
+CLI contract and env injection (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM
+/ PADDLE_TRAINER_ENDPOINTS), but the process model is one controller
+process per *host* (jax single-controller-per-host SPMD) instead of one
+per GPU.  ``--nproc_per_node > 1`` is still supported for CPU-mesh
+simulation tests: each local process gets a distinct rank and a virtual
+device count via XLA_FLAGS, which is how the reference's
+``test_parallel_dygraph_dataparallel.py TestMultipleGpus`` harness maps
+to TPU-less CI.
+
+Rendezvous: `--master host:port` selects jax.distributed's builtin
+coordination service (the TCPStore equivalent,
+``paddle/phi/core/distributed/store/tcp_store.h:120``); with no master,
+a free local port is chosen and rank 0 hosts the coordinator.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+__all__ = ["main", "launch"]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="paddle_tpu distributed launcher")
+    p.add_argument("--master", default=None,
+                   help="rendezvous endpoint host:port (rank 0 hosts it "
+                        "when unset)")
+    p.add_argument("--rank", type=int, default=-1,
+                   help="node rank; -1 = auto (single node → 0)")
+    p.add_argument("--nnodes", default="1",
+                   help="number of nodes (elastic ranges 'lo:hi' collapse "
+                        "to lo)")
+    p.add_argument("--nproc_per_node", type=int, default=1)
+    p.add_argument("--log_dir", default="log")
+    p.add_argument("--log_level", default="INFO")
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--devices", default=None,
+                   help="virtual device count per proc for CPU simulation")
+    p.add_argument("--run_mode", default="collective")
+    p.add_argument("--max_restart", type=int, default=0)
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _build_env(args, local_rank, nnodes):
+    nproc = args.nproc_per_node
+    world = nnodes * nproc
+    node_rank = max(args.rank, 0)
+    rank = node_rank * nproc + local_rank
+    env = dict(os.environ)
+    env.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(world),
+        "PADDLE_LOCAL_RANK": str(local_rank),
+        "PADDLE_LOCAL_SIZE": str(nproc),
+        "PADDLE_NNODES": str(nnodes),
+        "PADDLE_JOB_ID": args.job_id,
+        "MASTER_ADDR": args.master.split(":")[0] if args.master else
+        "127.0.0.1",
+        "MASTER_PORT": args.master.split(":")[1] if args.master else
+        str(_free_port()),
+    })
+    endpoints = ",".join(
+        f"{env['MASTER_ADDR']}:{int(env['MASTER_PORT']) + i}"
+        for i in range(world))
+    env["PADDLE_TRAINER_ENDPOINTS"] = endpoints
+    env["PADDLE_CURRENT_ENDPOINT"] = endpoints.split(",")[rank]
+    if args.devices:
+        # CPU-mesh simulation: N virtual devices per process
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = env.get("XLA_FLAGS", "")
+        env["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{args.devices}").strip()
+    return env
+
+
+def _run_once(args, nnodes):
+    os.makedirs(args.log_dir, exist_ok=True)
+    procs, logs = [], []
+    cmd = [sys.executable, "-u", args.training_script,
+           *args.training_script_args]
+    for lr in range(args.nproc_per_node):
+        env = _build_env(args, lr, nnodes)
+        rank = env["PADDLE_TRAINER_ID"]
+        log_path = os.path.join(
+            args.log_dir, f"workerlog.{rank}")
+        logf = open(log_path, "w")
+        logs.append(logf)
+        procs.append(subprocess.Popen(cmd, env=env, stdout=logf,
+                                      stderr=subprocess.STDOUT))
+
+    def _kill_all(*_):
+        for pr in procs:
+            if pr.poll() is None:
+                pr.terminate()
+
+    old = signal.signal(signal.SIGTERM, _kill_all)
+    try:
+        fail = 0
+        while True:
+            codes = [pr.poll() for pr in procs]
+            if any(c not in (None, 0) for c in codes):
+                _kill_all()
+                fail = next(c for c in codes if c not in (None, 0))
+                break
+            if all(c == 0 for c in codes):
+                break
+            time.sleep(0.2)
+    finally:
+        signal.signal(signal.SIGTERM, old)
+        for f in logs:
+            f.close()
+    return fail
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    nnodes = int(str(args.nnodes).split(":")[0])
+    restarts = 0
+    while True:
+        code = _run_once(args, nnodes)
+        if code == 0:
+            return 0
+        restarts += 1
+        if restarts > args.max_restart:
+            tail = ""
+            try:
+                logs = sorted(os.listdir(args.log_dir))
+                if logs:
+                    with open(os.path.join(args.log_dir, logs[0])) as f:
+                        tail = "".join(f.readlines()[-20:])
+            except OSError:
+                pass
+            print(f"launch: worker exited with code {code}\n{tail}",
+                  file=sys.stderr)
+            return code
+        print(f"launch: restarting ({restarts}/{args.max_restart})",
+              file=sys.stderr)
+
+
+def launch():
+    sys.exit(main())

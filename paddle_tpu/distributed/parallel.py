@@ -59,6 +59,22 @@ def init_parallel_env():
     return ParallelEnv()
 
 
+def shard_batch_inputs(mesh, inputs, kwargs):
+    """Shard concrete batch-leading tensors over the dp mesh axis (shared
+    by DataParallel/TensorParallel wrappers)."""
+    sharding = NamedSharding(mesh, P("dp"))
+
+    def shard_in(x):
+        if isinstance(x, Tensor) and x.ndim >= 1 and \
+                not isinstance(x._data, jax.core.Tracer) and \
+                x.shape[0] % mesh.shape["dp"] == 0:
+            x._data = jax.device_put(x._data, sharding)
+        return x
+
+    return (tuple(shard_in(x) for x in inputs),
+            {k: shard_in(v) for k, v in kwargs.items()})
+
+
 class DataParallel(Layer):
     """ref: ``parallel.py:190``. Shards the batch over the ``dp`` axis;
     gradient sync is compiled into the backward by GSPMD (psum over dp),
@@ -78,17 +94,7 @@ class DataParallel(Layer):
     def forward(self, *inputs, **kwargs):
         mesh = _mesh_mod.get_mesh()
         if mesh is not None and mesh.shape.get("dp", 1) > 1:
-            sharding = NamedSharding(mesh, P("dp"))
-
-            def shard_in(x):
-                if isinstance(x, Tensor) and x.ndim >= 1 and \
-                        not isinstance(x._data, jax.core.Tracer) and \
-                        x.shape[0] % mesh.shape["dp"] == 0:
-                    x._data = jax.device_put(x._data, sharding)
-                return x
-
-            inputs = tuple(shard_in(x) for x in inputs)
-            kwargs = {k: shard_in(v) for k, v in kwargs.items()}
+            inputs, kwargs = shard_batch_inputs(mesh, inputs, kwargs)
         return self._layers(*inputs, **kwargs)
 
     def scale_loss(self, loss):

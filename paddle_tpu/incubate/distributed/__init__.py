@@ -1,0 +1,3 @@
+"""``paddle_tpu.incubate.distributed`` (ref:
+``python/paddle/incubate/distributed/``)."""
+from . import models  # noqa: F401

@@ -1,0 +1,5 @@
+"""Mixture-of-experts / expert parallelism (ref:
+``python/paddle/incubate/distributed/models/moe/``)."""
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate  # noqa: F401
+from .moe_layer import ExpertMlp, MoELayer  # noqa: F401
+from . import functional  # noqa: F401

@@ -1,0 +1,67 @@
+"""Gate networks (ref: ``python/paddle/incubate/distributed/models/moe/
+gate/{base_gate,naive_gate,gshard_gate,switch_gate}.py``).
+
+A gate is a Layer producing routing logits (T, E); the routing math
+itself (top-k, capacity, aux loss) lives in functional.py and is chosen
+by ``top_k``.
+"""
+from __future__ import annotations
+
+from .....nn import Layer, Linear
+
+__all__ = ["BaseGate", "NaiveGate", "GShardGate", "SwitchGate"]
+
+
+class BaseGate(Layer):
+    def __init__(self, num_expert, world_size=1):
+        super().__init__()
+        self.world_size = world_size
+        self.num_expert = num_expert
+        self.tot_expert = world_size * num_expert
+        self.loss = None
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def set_loss(self, loss):
+        self.loss = loss
+
+    def get_loss(self, clear=True):
+        loss = self.loss
+        if clear:
+            self.loss = None
+        return loss
+
+
+class NaiveGate(BaseGate):
+    """Plain linear gate, top-k chosen by the layer; no noise."""
+
+    top_k = 2
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2):
+        super().__init__(num_expert, world_size)
+        self.gate = Linear(d_model, self.tot_expert)
+        self.top_k = topk
+
+    def forward(self, inp):
+        return self.gate(inp)
+
+
+class GShardGate(NaiveGate):
+    """top-2 with capacity + load-balancing aux loss (gshard_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size=1,
+                 capacity=(1.2, 2.4), group=None):
+        super().__init__(d_model, num_expert, world_size, topk=2)
+        self.capacity_factor = capacity[0] if isinstance(
+            capacity, (tuple, list)) else capacity
+
+
+class SwitchGate(NaiveGate):
+    """top-1 switch-transformer gate (switch_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size=1,
+                 capacity=(1.2, 2.4), group=None):
+        super().__init__(d_model, num_expert, world_size, topk=1)
+        self.capacity_factor = capacity[0] if isinstance(
+            capacity, (tuple, list)) else capacity

@@ -1,0 +1,161 @@
+"""MoELayer — expert-parallel mixture of experts.
+
+TPU-native redesign of ``python/paddle/incubate/distributed/models/moe/
+moe_layer.py:263 MoELayer``: the reference's routing pipeline
+(count_by_gate → limit_by_capacity CUDA ops → global_scatter /
+global_gather NCCL all-to-alls) becomes GShard einsum dispatch/combine
+(functional.py).  When the expert dimension is sharded over a mesh axis
+(``moe_axis``), XLA lowers those einsums to all_to_all over ICI; on one
+chip they're plain batched matmuls.  Either way the whole layer is one
+differentiable XLA subgraph — no host-side routing.
+
+Experts:
+* ``ExpertMlp`` — stacked expert weights (E, D, Dff): the fast path,
+  one einsum per projection for ALL experts (MXU-batched).
+* any ``LayerList`` of per-expert Layers — generic fallback, looped.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from .....nn import Layer, LayerList, initializer
+from .....tensor import Tensor
+from .....ops.op_utils import nary
+from ..... import ops
+from .functional import combine, dispatch, top1_gating, top2_gating
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+
+__all__ = ["MoELayer", "ExpertMlp"]
+
+
+class ExpertMlp(Layer):
+    """E parallel FFN experts with stacked weights (E, D, Dff)."""
+
+    def __init__(self, num_expert, d_model, d_hidden, activation="gelu"):
+        super().__init__()
+        self.num_expert = num_expert
+        self.d_model = d_model
+        self.d_hidden = d_hidden
+        self.activation = activation
+        bound1 = 1.0 / math.sqrt(d_model)
+        bound2 = 1.0 / math.sqrt(d_hidden)
+        self.w1 = self.create_parameter(
+            [num_expert, d_model, d_hidden],
+            default_initializer=initializer.Uniform(-bound1, bound1))
+        self.b1 = self.create_parameter(
+            [num_expert, 1, d_hidden],
+            default_initializer=initializer.Constant(0.0))
+        self.w2 = self.create_parameter(
+            [num_expert, d_hidden, d_model],
+            default_initializer=initializer.Uniform(-bound2, bound2))
+        self.b2 = self.create_parameter(
+            [num_expert, 1, d_model],
+            default_initializer=initializer.Constant(0.0))
+
+    def forward(self, xe):
+        """xe: Tensor (E, C, D) → (E, C, D)."""
+        act = self.activation
+
+        def f(x, w1, b1, w2, b2):
+            h = jnp.einsum("ecd,edf->ecf", x, w1) + b1
+            if act == "gelu":
+                import jax
+                h = jax.nn.gelu(h)
+            else:
+                h = jnp.maximum(h, 0)
+            return jnp.einsum("ecf,efd->ecd", h, w2) + b2
+
+        return nary(f, [xe, self.w1, self.b1, self.w2, self.b2],
+                    name="expert_mlp")
+
+
+class MoELayer(Layer):
+    """ref: moe_layer.py:263. ``gate`` is a dict config ({"type":
+    "gshard"|"switch"|"naive", "top_k": k}) or a BaseGate instance;
+    ``experts`` an ExpertMlp or LayerList.
+
+    The load-balancing aux loss of the last forward is in ``self.l_aux``
+    (and on the gate via ``gate.get_loss()``) — add it to the training
+    loss scaled by your aux weight.
+    """
+
+    def __init__(self, d_model, experts, gate=None, moe_group=None,
+                 mp_group=None, recompute_interval=0,
+                 capacity_factor=1.2, moe_axis=None, **kwargs):
+        super().__init__()
+        self.d_model = d_model
+        if isinstance(experts, (list, tuple)):
+            experts = LayerList(experts)
+        self.experts = experts
+        if isinstance(experts, ExpertMlp):
+            self.num_expert = experts.num_expert
+        else:
+            self.num_expert = len(experts)
+        if gate is None:
+            gate = {"type": "gshard", "top_k": 2}
+        if isinstance(gate, dict):
+            typ = gate.get("type", "gshard")
+            top_k = gate.get("top_k", 2)
+            if typ == "switch" or top_k == 1:
+                gate = SwitchGate(d_model, self.num_expert)
+            elif typ == "naive":
+                gate = NaiveGate(d_model, self.num_expert, topk=top_k)
+            else:
+                gate = GShardGate(d_model, self.num_expert)
+        assert isinstance(gate, BaseGate)
+        self.gate = gate
+        self.top_k = getattr(gate, "top_k", 2)
+        self.capacity_factor = capacity_factor
+        self.moe_axis = moe_axis
+        self.l_aux = None
+
+    def _capacity(self, num_tokens):
+        cap = int(math.ceil(
+            self.top_k * self.capacity_factor * num_tokens
+            / self.num_expert))
+        return max(cap, 4)
+
+    def forward(self, inp):
+        x = inp if isinstance(inp, Tensor) else Tensor(inp)
+        orig_shape = list(x.shape)
+        d = orig_shape[-1]
+        tokens = 1
+        for s in orig_shape[:-1]:
+            tokens *= s
+        xt = ops.reshape(x, [tokens, d])
+
+        logits = self.gate(xt)  # (T, E)
+        cap = self._capacity(tokens)
+        top_k = self.top_k
+
+        def route(lg):
+            if top_k == 1:
+                comb, disp, aux, _, _ = top1_gating(lg, cap)
+            else:
+                comb, disp, aux = top2_gating(lg, cap)
+            return comb, disp.astype(jnp.float32), aux
+
+        comb, disp, aux = nary(route, [logits], name="moe_gating",
+                               n_out=3)
+        self.l_aux = aux
+        self.gate.set_loss(aux)
+
+        xe = nary(lambda xx, dd: dispatch(xx, dd), [xt, disp],
+                  name="moe_dispatch")
+
+        if isinstance(self.experts, ExpertMlp):
+            ye = self.experts(xe)
+        else:
+            outs = []
+            for i, expert in enumerate(self.experts):
+                xi = ops.reshape(
+                    ops.slice(xe, axes=[0], starts=[i], ends=[i + 1]),
+                    [cap, d])
+                outs.append(expert(xi))
+            ye = ops.stack(outs, axis=0)
+
+        y = nary(lambda cc, yy: combine(yy, cc), [comb, ye],
+                 name="moe_combine")
+        return ops.reshape(y, orig_shape)

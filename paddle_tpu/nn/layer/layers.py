@@ -160,6 +160,7 @@ class Layer:
                 not name.startswith("_"):
             # plain tensors assigned as attributes become (persistable)
             # buffers, matching the reference's behavior
+            self.__dict__.pop(name, None)
             buffers[name] = value
         else:
             object.__setattr__(self, name, value)

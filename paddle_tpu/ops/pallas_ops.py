@@ -1,0 +1,404 @@
+"""Hand-written TPU Pallas kernels — the `phi/kernels/fusion` equivalent.
+
+The reference ships fused CUDA kernels (flash attention:
+``paddle/phi/kernels/gpu/flash_attn_kernel.cu`` + vendored
+``third_party/flashattn``; fused rope/adam under
+``paddle/phi/kernels/fusion/``).  On TPU the only ops worth hand-writing
+are the ones XLA cannot fuse into O(S) memory itself — attention.  This
+module implements FlashAttention-2 style tiled attention (forward +
+backward as ``jax.custom_vjp``) with online softmax, f32 accumulation,
+and MXU-aligned 128x128 tiles.
+
+Everything here works on raw ``jnp`` arrays in **(B, H, S, D)** layout;
+`flash_attention` adapts from the paddle **(B, S, H, D)** convention and
+from the framework `Tensor` type.  On non-TPU backends the kernels run
+in Pallas interpret mode so the exact same code path is testable on the
+CPU mesh used by the test-suite.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention", "mha", "mha_reference"]
+
+_NEG_INF = -1e30
+_LANES = 128
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _interpret_default() -> bool:
+    try:
+        return jax.default_backend() != "tpu"
+    except Exception:
+        return True
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, causal, sm_scale, block_q, block_k, q_len, kv_len):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, _NEG_INF, jnp.float32)
+        l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * sm_scale
+
+        kcol = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = kcol < kv_len
+        if causal:
+            qrow = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            mask = jnp.logical_and(mask, kcol <= qrow + (kv_len - q_len))
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(p, v_ref[0].astype(jnp.float32),
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    if causal:
+        # Blocks fully above the diagonal have nothing to attend to.
+        @pl.when(qi * block_q + block_q - 1 + (kv_len - q_len)
+                 >= ki * block_k)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, :] = m_scr[:, 0] + jnp.log(l_safe[:, 0])
+
+
+def _fwd(q, k, v, *, causal, sm_scale, block_q, block_k, q_len, kv_len,
+         interpret):
+    bh, sq, d = q.shape
+    skv = k.shape[1]
+    nq, nk = sq // block_q, skv // block_k
+    kernel = functools.partial(
+        _fwd_kernel, causal=causal, sm_scale=sm_scale, block_q=block_q,
+        block_k=block_k, q_len=q_len, kv_len=kv_len)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, causal, sm_scale, block_q, block_k,
+                   q_len, kv_len):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros(dq_scr.shape, jnp.float32)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * sm_scale
+        kcol = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = kcol < kv_len
+        if causal:
+            qrow = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            mask = jnp.logical_and(mask, kcol <= qrow + (kv_len - q_len))
+        p = jnp.exp(s - lse_ref[0, :][:, None])
+        p = jnp.where(mask, p, 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, :][:, None])
+        dq_scr[:] = dq_scr[:] + sm_scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(qi * block_q + block_q - 1 + (kv_len - q_len)
+                 >= ki * block_k)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, causal, sm_scale,
+                    block_q, block_k, q_len, kv_len):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[:] = jnp.zeros(dv_scr.shape, jnp.float32)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * sm_scale
+        kcol = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = kcol < kv_len
+        if causal:
+            qrow = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            mask = jnp.logical_and(mask, kcol <= qrow + (kv_len - q_len))
+        p = jnp.exp(s - lse_ref[0, :][:, None])
+        p = jnp.where(mask, p, 0.0)
+        # dv += p^T @ do
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, :][:, None])
+        # dk += ds^T @ q
+        dk_scr[:] = dk_scr[:] + sm_scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(qi * block_q + block_q - 1 + (kv_len - q_len)
+                 >= ki * block_k)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd(q, k, v, out, lse, do, *, causal, sm_scale, block_q, block_k,
+         q_len, kv_len, interpret):
+    bh, sq, d = q.shape
+    skv = k.shape[1]
+    nq, nk = sq // block_q, skv // block_k
+    delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
+                    axis=-1)  # (bh, sq)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal=causal, sm_scale=sm_scale,
+                          block_q=block_q, block_k=block_k, q_len=q_len,
+                          kv_len=kv_len),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, causal=causal, sm_scale=sm_scale,
+                          block_q=block_q, block_k=block_k, q_len=q_len,
+                          kv_len=kv_len),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, skv, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, skv, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper on padded (BH, S, D) arrays
+# ---------------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k, q_len, kv_len,
+           interpret):
+    out, _ = _fwd(q, k, v, causal=causal, sm_scale=sm_scale,
+                  block_q=block_q, block_k=block_k, q_len=q_len,
+                  kv_len=kv_len, interpret=interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, q_len, kv_len,
+               interpret):
+    out, lse = _fwd(q, k, v, causal=causal, sm_scale=sm_scale,
+                    block_q=block_q, block_k=block_k, q_len=q_len,
+                    kv_len=kv_len, interpret=interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, q_len, kv_len, interpret,
+               res, do):
+    q, k, v, out, lse = res
+    return _bwd(q, k, v, out, lse, do, causal=causal, sm_scale=sm_scale,
+                block_q=block_q, block_k=block_k, q_len=q_len,
+                kv_len=kv_len, interpret=interpret)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+def mha(q, k, v, *, causal=False, sm_scale=None, block_q=128, block_k=128,
+        interpret=None):
+    """Tiled flash attention on raw arrays in (B, H, S, D) layout.
+
+    Pads S to the tile size and D to the 128-lane width (zero-padding is
+    exact: padded head dims contribute 0 to logits; padded keys are
+    masked by ``kv_len``; padded query rows are sliced off).
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    b, h, sq, d = q.shape
+    skv = k.shape[2]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    block_q = min(block_q, _ceil_to(sq, 8))
+    block_k = min(block_k, _ceil_to(skv, 8))
+    sq_p, skv_p = _ceil_to(sq, block_q), _ceil_to(skv, block_k)
+    d_p = _ceil_to(d, _LANES)
+
+    def prep(x, s_p):
+        x = x.reshape(b * h, x.shape[2], d)
+        return jnp.pad(x, ((0, 0), (0, s_p - x.shape[1]), (0, d_p - d)))
+
+    qp, kp, vp = prep(q, sq_p), prep(k, skv_p), prep(v, skv_p)
+    out = _flash(qp, kp, vp, causal, sm_scale, block_q, block_k, sq, skv,
+                 interpret)
+    return out[:, :sq, :d].reshape(b, h, sq, d)
+
+
+def mha_reference(q, k, v, *, causal=False, sm_scale=None):
+    """Plain-XLA reference used by the kernel unit tests ((B,H,S,D))."""
+    d = q.shape[-1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    if causal:
+        sq, skv = s.shape[-2], s.shape[-1]
+        qrow = jax.lax.broadcasted_iota(jnp.int32, (sq, skv), 0)
+        kcol = jax.lax.broadcasted_iota(jnp.int32, (sq, skv), 1)
+        s = jnp.where(kcol <= qrow + (skv - sq), s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(
+        q.dtype)
+
+
+def flash_attention(query, key, value, *, causal=False, interpret=None):
+    """Framework-facing entry: paddle (B, S, H, D) layout, Tensor in/out.
+
+    TPU replacement for the reference's flash_attn path
+    (``python/paddle/nn/functional/flash_attention.py`` →
+    ``paddle/phi/kernels/gpu/flash_attn_kernel.cu``).
+    """
+    from .op_utils import ensure_tensor, nary
+
+    q, k, v = (ensure_tensor(t) for t in (query, key, value))
+
+    def f(qd, kd, vd):
+        o = mha(jnp.swapaxes(qd, 1, 2), jnp.swapaxes(kd, 1, 2),
+                jnp.swapaxes(vd, 1, 2), causal=causal, interpret=interpret)
+        return jnp.swapaxes(o, 1, 2)
+
+    return nary(f, [q, k, v], name="flash_attention")

@@ -1,0 +1,55 @@
+"""Launcher CLI tests (ref harness: test/legacy_test/
+test_parallel_dygraph_dataparallel.py TestMultipleGpus — launches a
+script under the launcher and checks rank env + exit codes)."""
+import os
+import subprocess
+import sys
+
+SCRIPT = """
+import os, sys
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+world = int(os.environ["PADDLE_TRAINERS_NUM"])
+assert os.environ["PADDLE_CURRENT_ENDPOINT"] in \
+    os.environ["PADDLE_TRAINER_ENDPOINTS"].split(",")
+print(f"rank={rank} world={world}")
+if len(sys.argv) > 1 and sys.argv[1] == "--fail" and rank == 1:
+    sys.exit(3)
+"""
+
+
+def _run(tmp_path, extra_args, script_args=()):
+    script = tmp_path / "worker.py"
+    script.write_text(SCRIPT)
+    return subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--log_dir", str(tmp_path / "log"), *extra_args, str(script),
+         *script_args],
+        capture_output=True, text=True, timeout=120,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def test_launch_two_procs(tmp_path):
+    r = _run(tmp_path, ["--nproc_per_node", "2"])
+    assert r.returncode == 0, r.stderr
+    logs = sorted(os.listdir(tmp_path / "log"))
+    assert logs == ["workerlog.0", "workerlog.1"]
+    body = (tmp_path / "log" / "workerlog.1").read_text()
+    assert "rank=1 world=2" in body
+
+
+def test_launch_propagates_failure(tmp_path):
+    r = _run(tmp_path, ["--nproc_per_node", "2"], ("--fail",))
+    assert r.returncode == 3
+    assert "exited with code 3" in r.stderr
+
+
+def test_spawn_single_proc_env():
+    from paddle_tpu.distributed import spawn
+
+    captured = {}
+
+    def f():
+        captured["rank"] = os.environ["PADDLE_TRAINER_ID"]
+
+    spawn(f, nprocs=1)
+    assert captured["rank"] == "0"
