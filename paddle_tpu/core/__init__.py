@@ -1,0 +1,462 @@
+"""Native runtime core bindings (ctypes over libptcore.so).
+
+The TPU compute path is jax/XLA; this package is the native *host* runtime
+around it, the part of the reference that is C++ and stays C++ here:
+
+ - host tracer        — RecordEvent spans + chrome trace export
+                        (ref: paddle/fluid/platform/profiler/event_tracing.h)
+ - flag registry      — shared native/python flag table
+                        (ref: paddle/phi/core/flags.cc)
+ - host buffer pool   — auto-growth best-fit allocator + stats
+                        (ref: paddle/fluid/memory/allocation/
+                         auto_growth_best_fit_allocator.h:30)
+ - work queue         — threadpool for input-pipeline/IO jobs
+                        (ref: paddle/fluid/framework/new_executor/workqueue/)
+ - TCPStore           — rendezvous / elastic heartbeat KV store
+                        (ref: paddle/phi/core/distributed/store/tcp_store.h:120)
+
+If no C++ toolchain is available the pure-Python fallbacks below keep every
+API working (slower, same semantics) — mirroring the reference's CPU
+fallback philosophy.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+
+from .build import build_ptcore
+
+__all__ = [
+    "native_available", "RecordEvent", "tracer_enable", "tracer_disable",
+    "tracer_dump", "tracer_clear", "tracer_events", "HostBufferPool",
+    "host_memory_stats", "WorkQueue", "TCPStore",
+]
+
+_lib = None
+_lib_err = None
+
+
+def _load():
+    global _lib, _lib_err
+    if _lib is not None or _lib_err is not None:
+        return _lib
+    if os.environ.get("PADDLE_TPU_DISABLE_NATIVE"):
+        _lib_err = "disabled by PADDLE_TPU_DISABLE_NATIVE"
+        return None
+    path = build_ptcore()
+    if path is None:
+        from . import build as _build
+        _lib_err = _build.LAST_ERROR or "no C++ toolchain"
+        return None
+    lib = ctypes.CDLL(path)
+    # --- signatures ---
+    lib.pt_trace_push.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.pt_trace_dump_json.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.pt_trace_export.restype = ctypes.c_int64
+    lib.pt_trace_export.argtypes = [
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_char_p, ctypes.c_int64,
+        ctypes.c_int64]
+    lib.pt_trace_count.restype = ctypes.c_int64
+    lib.pt_flag_set.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.pt_flag_get.restype = ctypes.c_int64
+    lib.pt_flag_get.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                ctypes.c_int64]
+    lib.pt_alloc.restype = ctypes.c_void_p
+    lib.pt_alloc.argtypes = [ctypes.c_size_t]
+    lib.pt_free.argtypes = [ctypes.c_void_p]
+    lib.pt_pool_release.restype = ctypes.c_uint64
+    lib.pt_pool_stats.argtypes = [ctypes.POINTER(ctypes.c_uint64)] * 4
+    lib.pt_wq_create.restype = ctypes.c_void_p
+    lib.pt_wq_create.argtypes = [ctypes.c_int]
+    lib.pt_wq_submit.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                 ctypes.c_void_p]
+    lib.pt_wq_wait.argtypes = [ctypes.c_void_p]
+    lib.pt_wq_destroy.argtypes = [ctypes.c_void_p]
+    lib.pt_wq_pending.restype = ctypes.c_int64
+    lib.pt_wq_pending.argtypes = [ctypes.c_void_p]
+    lib.pt_store_server_start.restype = ctypes.c_void_p
+    lib.pt_store_server_start.argtypes = [ctypes.c_int]
+    lib.pt_store_server_port.restype = ctypes.c_int
+    lib.pt_store_server_port.argtypes = [ctypes.c_void_p]
+    lib.pt_store_server_stop.argtypes = [ctypes.c_void_p]
+    lib.pt_store_client_connect.restype = ctypes.c_void_p
+    lib.pt_store_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                            ctypes.c_int]
+    lib.pt_store_set.restype = ctypes.c_int
+    lib.pt_store_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_char_p, ctypes.c_int64]
+    lib.pt_store_get.restype = ctypes.c_int64
+    lib.pt_store_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_char_p, ctypes.c_int64,
+                                 ctypes.c_int]
+    lib.pt_store_add.restype = ctypes.c_int64
+    lib.pt_store_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_int64]
+    lib.pt_store_del.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.pt_store_numkeys.restype = ctypes.c_int64
+    lib.pt_store_numkeys.argtypes = [ctypes.c_void_p]
+    lib.pt_store_client_close.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    # replay flags set before the native core loaded, so both tables agree
+    try:
+        from ..framework import flags as _flags
+        for name, value in list(_flags._values.items()):
+            lib.pt_flag_set(name.encode(), str(value).encode())
+    except Exception:
+        pass
+    return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+# --------------------------------------------------------------------------
+# Tracer
+# --------------------------------------------------------------------------
+_py_events: list[tuple[str, float, float, int]] = []
+_py_trace_on = False
+_py_mu = threading.Lock()
+
+
+def tracer_enable(level: int = 1) -> None:
+    lib = _load()
+    if lib:
+        lib.pt_trace_enable(level)
+    else:
+        global _py_trace_on
+        _py_trace_on = True
+
+
+def tracer_disable() -> None:
+    lib = _load()
+    if lib:
+        lib.pt_trace_disable()
+    else:
+        global _py_trace_on
+        _py_trace_on = False
+
+
+def tracer_clear() -> None:
+    lib = _load()
+    if lib:
+        lib.pt_trace_clear()
+    with _py_mu:
+        _py_events.clear()
+
+
+class RecordEvent:
+    """RAII host span (ref: ``platform/profiler/event_tracing.h`` RecordEvent).
+
+    Usable as a context manager or decorator::
+
+        with core.RecordEvent("forward"):
+            ...
+    """
+
+    def __init__(self, name: str, level: int = 1):
+        self.name = name
+        self.level = level
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+    def begin(self):
+        lib = _load()
+        if lib:
+            lib.pt_trace_push(self.name.encode(), self.level)
+        elif _py_trace_on:
+            import time
+            self._t0 = time.perf_counter_ns()
+
+    def end(self):
+        lib = _load()
+        if lib:
+            lib.pt_trace_pop()
+        elif _py_trace_on and hasattr(self, "_t0"):
+            import time
+            with _py_mu:
+                _py_events.append((self.name, self._t0,
+                                   time.perf_counter_ns(),
+                                   threading.get_ident() & 0xFFFFFF))
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with RecordEvent(self.name, self.level):
+                return fn(*a, **k)
+        return wrapper
+
+
+def tracer_dump(path: str, pid: int | None = None) -> None:
+    """Export collected host events as chrome://tracing JSON."""
+    lib = _load()
+    if lib:
+        rc = lib.pt_trace_dump_json(path.encode(),
+                                    os.getpid() if pid is None else pid)
+        if rc != 0:
+            raise OSError(f"cannot write trace to {path}")
+        return
+    import json
+    with _py_mu, open(path, "w") as f:
+        t0 = min((e[1] for e in _py_events), default=0)
+        json.dump({"traceEvents": [
+            {"name": n, "ph": "X", "ts": (s - t0) / 1e3,
+             "dur": (e - s) / 1e3,
+             "pid": os.getpid() if pid is None else pid, "tid": t,
+             "cat": "host"}
+            for (n, s, e, t) in _py_events]}, f)
+
+
+def tracer_events(cap: int = 65536):
+    """Return completed host events as a list of
+    ``(name, start_ns, dur_ns, tid)`` for summary tables."""
+    lib = _load()
+    if not lib:
+        with _py_mu:
+            return [(n, s, e - s, t) for (n, s, e, t) in _py_events]
+    starts = (ctypes.c_uint64 * cap)()
+    durs = (ctypes.c_uint64 * cap)()
+    tids = (ctypes.c_uint64 * cap)()
+    name_buf = ctypes.create_string_buffer(cap * 48)
+    n = lib.pt_trace_export(starts, durs, tids, name_buf, len(name_buf), cap)
+    names = bytes(name_buf.raw[:]).split(b"\0")
+    out = []
+    for i in range(n):
+        out.append((names[i].decode(errors="replace"), int(starts[i]),
+                    int(durs[i]), int(tids[i])))
+    return out
+
+
+# --------------------------------------------------------------------------
+# Host buffer pool
+# --------------------------------------------------------------------------
+class HostBufferPool:
+    """Pooled aligned host buffers (numpy-visible) for staging batches.
+
+    ``take(nbytes)`` returns a ``(memoryview, token)``; ``give(token)``
+    returns the buffer to the pool. Falls back to plain bytearrays without
+    the native lib.
+    """
+
+    def take(self, nbytes: int):
+        lib = _load()
+        if not lib:
+            buf = bytearray(nbytes)
+            return memoryview(buf), buf
+        ptr = lib.pt_alloc(nbytes)
+        if not ptr:
+            raise MemoryError(f"pt_alloc({nbytes}) failed")
+        mv = memoryview((ctypes.c_ubyte * nbytes).from_address(ptr)).cast("B")
+        return mv, ptr
+
+    def give(self, token) -> None:
+        lib = _load()
+        if lib and isinstance(token, int):
+            lib.pt_free(token)
+
+    def release_free(self) -> int:
+        lib = _load()
+        return int(lib.pt_pool_release()) if lib else 0
+
+
+def host_memory_stats() -> dict:
+    """Pool stats (ref: paddle.device.cuda.memory_allocated family)."""
+    lib = _load()
+    if not lib:
+        return {"allocated": 0, "reserved": 0, "peak_allocated": 0,
+                "chunks": 0}
+    vals = [ctypes.c_uint64() for _ in range(4)]
+    lib.pt_pool_stats(*[ctypes.byref(v) for v in vals])
+    return {"allocated": int(vals[0].value), "reserved": int(vals[1].value),
+            "peak_allocated": int(vals[2].value), "chunks": int(vals[3].value)}
+
+
+# --------------------------------------------------------------------------
+# Work queue
+# --------------------------------------------------------------------------
+_JOB_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+
+class WorkQueue:
+    """Native threadpool; jobs are Python callables (run with GIL held by the
+    ctypes callback bridge). Without the native lib, a
+    ``concurrent.futures`` pool provides the same API."""
+
+    def __init__(self, num_threads: int = 4):
+        self._lib = _load()
+        self._jobs: dict[int, object] = {}
+        self._next = 1  # 0 would arrive as None through the c_void_p callback
+        self._mu = threading.Lock()
+        if self._lib:
+            self._h = self._lib.pt_wq_create(num_threads)
+
+            def trampoline(arg):
+                with self._mu:
+                    fn = self._jobs.pop(arg)
+                try:
+                    fn()
+                except Exception:  # job errors must not kill the worker
+                    import traceback
+                    traceback.print_exc()
+            self._tramp = _JOB_FN(trampoline)
+        else:
+            import concurrent.futures
+            self._pool = concurrent.futures.ThreadPoolExecutor(num_threads)
+            self._futures = []
+
+    def submit(self, fn) -> None:
+        if self._lib:
+            with self._mu:
+                token = self._next
+                self._next += 1
+                self._jobs[token] = fn
+            self._lib.pt_wq_submit(self._h, ctypes.cast(self._tramp,
+                                                        ctypes.c_void_p),
+                                   token)
+        else:
+            self._futures.append(self._pool.submit(fn))
+
+    def wait(self) -> None:
+        if self._lib:
+            self._lib.pt_wq_wait(self._h)
+        else:
+            import concurrent.futures
+            concurrent.futures.wait(self._futures)
+            self._futures = [f for f in self._futures if not f.done()]
+
+    def pending(self) -> int:
+        if self._lib:
+            return int(self._lib.pt_wq_pending(self._h))
+        return sum(1 for f in self._futures if not f.done())
+
+    def shutdown(self) -> None:
+        if self._lib:
+            if getattr(self, "_h", None):
+                self._lib.pt_wq_wait(self._h)
+                self._lib.pt_wq_destroy(self._h)
+                self._h = None
+        else:
+            self._pool.shutdown(wait=True)
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass
+
+
+# --------------------------------------------------------------------------
+# TCPStore
+# --------------------------------------------------------------------------
+class TCPStore:
+    """Key-value rendezvous store (ref: ``tcp_store.h:120``).
+
+    ``TCPStore(host, port, is_master=True)`` starts the native server (and a
+    loopback client); workers connect with ``is_master=False``. ``get``
+    blocks until the key is set (the reference's semantics); ``add`` is the
+    atomic counter used for barriers.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 is_master: bool = False, timeout: float = 30.0):
+        lib = _load()
+        self._lib = lib
+        self._server = None
+        self._client = None
+        if lib is None:
+            raise RuntimeError("TCPStore requires the native core "
+                               f"(unavailable: {_lib_err}); use "
+                               "jax.distributed rendezvous instead")
+        if is_master:
+            self._server = lib.pt_store_server_start(port)
+            if not self._server:
+                raise OSError(f"cannot bind TCPStore on port {port}")
+            port = lib.pt_store_server_port(self._server)
+            host = "127.0.0.1"
+        self.host, self.port = host, port
+        self._client = lib.pt_store_client_connect(
+            host.encode(), port, int(timeout * 1000))
+        if not self._client:
+            self.close()
+            raise TimeoutError(f"cannot reach TCPStore at {host}:{port}")
+
+    def set(self, key: str, value: bytes | str) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        rc = self._lib.pt_store_set(self._client, key.encode(), value,
+                                    len(value))
+        if rc != 0:
+            raise ConnectionError("TCPStore set failed")
+
+    def get(self, key: str, wait: bool = True,
+            timeout: float | None = None) -> bytes | None:
+        """Fetch a key. ``wait=True`` blocks until the key is set — via
+        client-side polling so a ``timeout`` can abort the wait with a
+        diagnostic instead of hanging the whole job (the failure mode of a
+        server-side blocking WAIT when a peer rank dies)."""
+        import time as _time
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        while True:
+            buf = ctypes.create_string_buffer(1 << 20)
+            n = self._lib.pt_store_get(self._client, key.encode(), buf,
+                                       len(buf), 0)
+            if n >= 0:
+                if n > len(buf):  # value larger than buffer: retry sized
+                    buf = ctypes.create_string_buffer(int(n))
+                    n = self._lib.pt_store_get(self._client, key.encode(),
+                                               buf, len(buf), 0)
+                return buf.raw[:n]
+            if n != -1:
+                raise ConnectionError("TCPStore get failed")
+            if not wait:
+                return None
+            if deadline is not None and _time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"TCPStore: key '{key}' not set within {timeout}s "
+                    f"(a peer rank may have died before rendezvous)")
+            _time.sleep(0.02)
+
+    def add(self, key: str, delta: int = 1) -> int:
+        v = self._lib.pt_store_add(self._client, key.encode(), delta)
+        if v == -(2**63):
+            raise ConnectionError("TCPStore add failed")
+        return int(v)
+
+    def delete(self, key: str) -> None:
+        self._lib.pt_store_del(self._client, key.encode())
+
+    def num_keys(self) -> int:
+        return int(self._lib.pt_store_numkeys(self._client))
+
+    def wait(self, keys, timeout: float = 300.0) -> None:
+        import time as _time
+        if isinstance(keys, str):
+            keys = [keys]
+        deadline = _time.monotonic() + timeout
+        for k in keys:
+            self.get(k, wait=True,
+                     timeout=max(0.0, deadline - _time.monotonic()))
+
+    def close(self) -> None:
+        if self._client:
+            self._lib.pt_store_client_close(self._client)
+            self._client = None
+        if self._server:
+            self._lib.pt_store_server_stop(self._server)
+            self._server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

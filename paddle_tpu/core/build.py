@@ -1,0 +1,68 @@
+"""Build the native runtime core (libptcore.so) on demand.
+
+The reference ships its native core prebuilt via CMake
+(paddle/scripts/paddle_build.sh); here the core is small enough to compile
+at first import with g++ and cache by source hash, which keeps the package
+pip-less and hermetic. Rebuilds happen only when a source file changes.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import tempfile
+
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "native")
+_SOURCES = ["trace.cc", "flags.cc", "alloc.cc", "workqueue.cc", "store.cc"]
+_HEADERS = ["common.h"]
+
+#: last build failure detail (compiler stderr / missing toolchain), for
+#: callers that got None back and want the real reason
+LAST_ERROR: str | None = None
+
+
+def _source_hash() -> str:
+    h = hashlib.sha256()
+    for name in _HEADERS + _SOURCES:
+        with open(os.path.join(_SRC_DIR, name), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def _cache_dir() -> str:
+    d = os.environ.get("PADDLE_TPU_CACHE",
+                       os.path.join(os.path.expanduser("~"), ".cache",
+                                    "paddle_tpu"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def build_ptcore(verbose: bool = False) -> str | None:
+    """Compile (or reuse) libptcore.so; returns its path, or None if no
+    toolchain is available."""
+    so_path = os.path.join(_cache_dir(), f"libptcore-{_source_hash()}.so")
+    if os.path.exists(so_path):
+        return so_path
+    srcs = [os.path.join(_SRC_DIR, s) for s in _SOURCES]
+    # build into a temp file then atomically rename, so concurrent importers
+    # (multi-process launch) never load a half-written .so
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_cache_dir())
+    os.close(fd)
+    cmd = ["g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-pthread",
+           "-fvisibility=hidden", "-o", tmp] + srcs
+    global LAST_ERROR
+    try:
+        res = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    except (FileNotFoundError, subprocess.TimeoutExpired) as e:
+        os.unlink(tmp)
+        LAST_ERROR = f"toolchain unavailable: {e!r}"
+        return None
+    if res.returncode != 0:
+        os.unlink(tmp)
+        LAST_ERROR = f"g++ failed:\n{res.stderr}"
+        if verbose:
+            raise RuntimeError(f"ptcore build failed:\n{res.stderr}")
+        return None
+    LAST_ERROR = None
+    os.replace(tmp, so_path)
+    return so_path

@@ -1,0 +1,175 @@
+// Auto-growth best-fit host allocator.
+//
+// Native counterpart of the reference's AutoGrowthBestFitAllocator
+// (paddle/fluid/memory/allocation/auto_growth_best_fit_allocator.h:30) and
+// the StatAllocator stats plumbing: on TPU the *device* heap belongs to
+// XLA/PJRT, so this pool serves host-side staging buffers (dataloader
+// batches, checkpoint shards) where malloc/free churn and page faults would
+// otherwise eat into input-pipeline throughput.
+//
+// Strategy (same shape as the reference):
+//  - carve aligned blocks out of large chunks obtained from the system
+//  - free blocks kept in a size-ordered multimap (best fit)
+//  - adjacent free blocks within a chunk are coalesced on free
+//  - chunks grow geometrically; idle chunks released on demand
+#include "common.h"
+
+#include <cstdlib>
+#include <map>
+#include <set>
+#include <vector>
+
+namespace ptcore {
+namespace {
+
+constexpr size_t kAlign = 64;
+constexpr size_t kMinChunk = 1 << 20;  // 1 MiB
+
+struct Chunk;
+
+struct Block {
+  char *ptr;
+  size_t size;
+  bool free_;
+  Chunk *chunk;
+  Block *prev = nullptr;  // address-ordered neighbors within chunk
+  Block *next = nullptr;
+};
+
+struct Chunk {
+  char *base;
+  size_t size;
+  Block *first;
+};
+
+struct Pool {
+  std::mutex mu;
+  std::multimap<size_t, Block *> free_blocks;  // size -> block
+  std::map<char *, Block *> by_ptr;            // allocated blocks
+  std::vector<Chunk *> chunks;
+  size_t allocated = 0;  // bytes handed out
+  size_t reserved = 0;   // bytes obtained from the system
+  size_t peak = 0;
+  size_t next_chunk = kMinChunk;
+
+  void erase_free(Block *b) {
+    auto range = free_blocks.equal_range(b->size);
+    for (auto it = range.first; it != range.second; ++it)
+      if (it->second == b) {
+        free_blocks.erase(it);
+        return;
+      }
+  }
+};
+
+Pool g_pool;
+
+size_t align_up(size_t n) { return (n + kAlign - 1) & ~(kAlign - 1); }
+
+}  // namespace
+}  // namespace ptcore
+
+using namespace ptcore;
+
+PT_EXPORT void *pt_alloc(size_t n) {
+  if (n == 0) n = kAlign;
+  n = align_up(n);
+  std::lock_guard<std::mutex> lk(g_pool.mu);
+  // best fit from the free map
+  auto it = g_pool.free_blocks.lower_bound(n);
+  Block *b = nullptr;
+  if (it != g_pool.free_blocks.end()) {
+    b = it->second;
+    g_pool.free_blocks.erase(it);
+  } else {
+    // grow: new chunk at least max(n, next_chunk)
+    size_t csize = g_pool.next_chunk;
+    if (csize < n) csize = align_up(n);
+    g_pool.next_chunk = csize * 2;
+    char *base = (char *)aligned_alloc(kAlign, csize);
+    if (!base) return nullptr;
+    Chunk *c = new Chunk{base, csize, nullptr};
+    b = new Block{base, csize, false, c};
+    c->first = b;
+    g_pool.chunks.push_back(c);
+    g_pool.reserved += csize;
+  }
+  // split if worthwhile
+  if (b->size >= n + kAlign) {
+    Block *rest = new Block{b->ptr + n, b->size - n, true, b->chunk};
+    rest->prev = b;
+    rest->next = b->next;
+    if (b->next) b->next->prev = rest;
+    b->next = rest;
+    b->size = n;
+    g_pool.free_blocks.emplace(rest->size, rest);
+  }
+  b->free_ = false;
+  g_pool.by_ptr[b->ptr] = b;
+  g_pool.allocated += b->size;
+  if (g_pool.allocated > g_pool.peak) g_pool.peak = g_pool.allocated;
+  return b->ptr;
+}
+
+PT_EXPORT void pt_free(void *p) {
+  if (!p) return;
+  std::lock_guard<std::mutex> lk(g_pool.mu);
+  auto it = g_pool.by_ptr.find((char *)p);
+  if (it == g_pool.by_ptr.end()) return;  // not ours
+  Block *b = it->second;
+  g_pool.by_ptr.erase(it);
+  g_pool.allocated -= b->size;
+  b->free_ = true;
+  // coalesce with next
+  if (b->next && b->next->free_) {
+    Block *nx = b->next;
+    g_pool.erase_free(nx);
+    b->size += nx->size;
+    b->next = nx->next;
+    if (nx->next) nx->next->prev = b;
+    delete nx;
+  }
+  // coalesce with prev
+  if (b->prev && b->prev->free_) {
+    Block *pv = b->prev;
+    g_pool.erase_free(pv);
+    pv->size += b->size;
+    pv->next = b->next;
+    if (b->next) b->next->prev = pv;
+    delete b;
+    b = pv;
+  }
+  g_pool.free_blocks.emplace(b->size, b);
+}
+
+// Release chunks that are one whole free block back to the system.
+// Returns bytes released.
+PT_EXPORT uint64_t pt_pool_release() {
+  std::lock_guard<std::mutex> lk(g_pool.mu);
+  uint64_t released = 0;
+  std::vector<Chunk *> keep;
+  for (Chunk *c : g_pool.chunks) {
+    Block *b = c->first;
+    if (b->free_ && b->size == c->size && !b->next) {
+      g_pool.erase_free(b);
+      released += c->size;
+      g_pool.reserved -= c->size;
+      free(c->base);
+      delete b;
+      delete c;
+    } else {
+      keep.push_back(c);
+    }
+  }
+  g_pool.chunks.swap(keep);
+  return released;
+}
+
+PT_EXPORT void pt_pool_stats(uint64_t *allocated, uint64_t *reserved,
+                             uint64_t *peak, uint64_t *chunks) {
+  std::lock_guard<std::mutex> lk(g_pool.mu);
+  if (allocated) *allocated = g_pool.allocated;
+  if (reserved) *reserved = g_pool.reserved;
+  if (peak) *peak = g_pool.peak;
+  if (chunks) *chunks = g_pool.chunks.size();
+}

@@ -1,0 +1,31 @@
+// Shared helpers for the paddle_tpu native runtime core.
+//
+// TPU-native counterpart of the reference's native runtime plumbing
+// (paddle/phi/backends/, paddle/fluid/platform/): the XLA compiler owns the
+// device compute path, so the native core is the *host* runtime around it —
+// tracing, flags, host memory pooling, work queues, and the TCP key-value
+// store used for rendezvous (ref: paddle/phi/core/distributed/store/
+// tcp_store.h:120).
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <chrono>
+#include <mutex>
+#include <string>
+
+#if defined(_WIN32)
+#error "paddle_tpu native core targets POSIX"
+#endif
+
+#define PT_EXPORT extern "C" __attribute__((visibility("default")))
+
+namespace ptcore {
+
+inline uint64_t now_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+}  // namespace ptcore

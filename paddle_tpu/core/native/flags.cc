@@ -1,0 +1,52 @@
+// Native flag registry.
+//
+// Counterpart of the reference's gflags-backed PHI_DEFINE_EXPORTED_* flags
+// (paddle/phi/core/flags.cc): a string key/value table with env-var
+// (FLAGS_<name>) seeding, shared between Python (paddle_tpu.set_flags) and
+// any native component that wants to consult a flag without crossing back
+// into Python.
+#include "common.h"
+
+#include <cstdlib>
+#include <map>
+
+namespace ptcore {
+namespace {
+std::mutex g_flag_mu;
+std::map<std::string, std::string> g_flags;
+}  // namespace
+}  // namespace ptcore
+
+using namespace ptcore;
+
+PT_EXPORT void pt_flag_set(const char *name, const char *value) {
+  std::lock_guard<std::mutex> lk(g_flag_mu);
+  g_flags[name] = value ? value : "";
+}
+
+// Returns value length, or -1 if unset (after also checking FLAGS_<name> in
+// the environment, mirroring the reference's env seeding).
+PT_EXPORT int64_t pt_flag_get(const char *name, char *buf, int64_t buflen) {
+  std::lock_guard<std::mutex> lk(g_flag_mu);
+  auto it = g_flags.find(name);
+  std::string val;
+  if (it != g_flags.end()) {
+    val = it->second;
+  } else {
+    std::string env = std::string("FLAGS_") + name;
+    const char *e = getenv(env.c_str());
+    if (!e) return -1;
+    val = e;
+    g_flags[name] = val;
+  }
+  int64_t n = (int64_t)val.size();
+  if (buf && buflen > n) {
+    memcpy(buf, val.c_str(), n + 1);
+  }
+  return n;
+}
+
+PT_EXPORT int64_t pt_flag_count() {
+  std::lock_guard<std::mutex> lk(g_flag_mu);
+  return (int64_t)g_flags.size();
+}

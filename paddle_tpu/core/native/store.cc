@@ -1,0 +1,331 @@
+// TCP key-value store.
+//
+// Native counterpart of the reference's TCPStore
+// (paddle/phi/core/distributed/store/tcp_store.h:120,
+// tcp_store.cc): rank-0 hosts a tiny KV server, other ranks connect as
+// clients; supports SET / GET / WAIT (block until key exists) / ADD
+// (atomic int64 increment, used as a barrier counter). In the TPU build the
+// heavy collectives are XLA's business; the store remains the bootstrap and
+// elastic-heartbeat channel (fleet.elastic, launcher rendezvous).
+//
+// Wire protocol (all little-endian):
+//   request:  u8 op | u32 klen | key bytes | u32 vlen | value bytes
+//   ops: 1=SET 2=GET(nonblock) 3=WAIT(get, block until set) 4=ADD(v=i64 delta)
+//        5=DEL 6=NUMKEYS
+//   reply: i32 status(0 ok, -1 missing) | u32 vlen | value bytes
+#include "common.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <deque>
+#include <map>
+#include <thread>
+#include <vector>
+
+namespace ptcore {
+namespace {
+
+bool read_full(int fd, void *buf, size_t n) {
+  char *p = (char *)buf;
+  while (n) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+bool write_full(int fd, const void *buf, size_t n) {
+  const char *p = (const char *)buf;
+  while (n) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+struct Conn {
+  std::thread th;
+  // owner handoff: whichever of {conn thread, server stop} exchanges the fd
+  // to -1 first closes it, so a recycled descriptor is never touched
+  std::atomic<int> fd{-1};
+};
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  std::mutex mu;
+  std::condition_variable cv;  // signaled on every SET/ADD
+  std::map<std::string, std::string> kv;
+  std::deque<Conn> conns;
+  std::thread accept_thread;
+  std::atomic<bool> stop{false};
+
+  void serve_conn(Conn *conn, int fd) {
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    for (;;) {
+      uint8_t op;
+      uint32_t klen, vlen;
+      if (!read_full(fd, &op, 1) || !read_full(fd, &klen, 4)) break;
+      std::string key(klen, 0);
+      if (klen && !read_full(fd, key.data(), klen)) break;
+      if (!read_full(fd, &vlen, 4)) break;
+      std::string val(vlen, 0);
+      if (vlen && !read_full(fd, val.data(), vlen)) break;
+
+      int32_t status = 0;
+      std::string out;
+      switch (op) {
+        case 1: {  // SET
+          std::lock_guard<std::mutex> lk(mu);
+          kv[key] = val;
+          cv.notify_all();
+          break;
+        }
+        case 2: {  // GET
+          std::lock_guard<std::mutex> lk(mu);
+          auto it = kv.find(key);
+          if (it == kv.end())
+            status = -1;
+          else
+            out = it->second;
+          break;
+        }
+        case 3: {  // WAIT (blocking get)
+          std::unique_lock<std::mutex> lk(mu);
+          cv.wait(lk, [&] { return stop.load() || kv.count(key); });
+          if (stop.load() && !kv.count(key)) {
+            status = -1;
+          } else {
+            out = kv[key];
+          }
+          break;
+        }
+        case 4: {  // ADD
+          int64_t delta = 0;
+          if (val.size() == 8) memcpy(&delta, val.data(), 8);
+          std::lock_guard<std::mutex> lk(mu);
+          int64_t cur = 0;
+          auto it = kv.find(key);
+          if (it != kv.end() && it->second.size() == 8)
+            memcpy(&cur, it->second.data(), 8);
+          cur += delta;
+          std::string enc(8, 0);
+          memcpy(enc.data(), &cur, 8);
+          kv[key] = enc;
+          out = enc;
+          cv.notify_all();
+          break;
+        }
+        case 5: {  // DEL
+          std::lock_guard<std::mutex> lk(mu);
+          kv.erase(key);
+          break;
+        }
+        case 6: {  // NUMKEYS
+          std::lock_guard<std::mutex> lk(mu);
+          int64_t n = (int64_t)kv.size();
+          std::string enc(8, 0);
+          memcpy(enc.data(), &n, 8);
+          out = enc;
+          break;
+        }
+        default:
+          status = -1;
+      }
+      uint32_t olen = (uint32_t)out.size();
+      if (!write_full(fd, &status, 4) || !write_full(fd, &olen, 4)) break;
+      if (olen && !write_full(fd, out.data(), olen)) break;
+    }
+    // close under the server mutex so stop() can never shutdown a
+    // recycled descriptor
+    std::lock_guard<std::mutex> lk(mu);
+    int owned = conn->fd.exchange(-1);
+    if (owned >= 0) ::close(owned);
+  }
+
+  void accept_loop() {
+    for (;;) {
+      int fd = ::accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) {
+        if (stop.load()) return;
+        continue;
+      }
+      if (stop.load()) {
+        ::close(fd);
+        return;
+      }
+      std::lock_guard<std::mutex> lk(mu);
+      conns.emplace_back();
+      Conn *c = &conns.back();
+      c->fd.store(fd);
+      c->th = std::thread([this, c, fd] { serve_conn(c, fd); });
+    }
+  }
+};
+
+struct Client {
+  int fd = -1;
+  std::mutex mu;  // one request in flight at a time
+
+  int64_t request(uint8_t op, const std::string &key, const std::string &val,
+                  std::string *out) {
+    std::lock_guard<std::mutex> lk(mu);
+    uint32_t klen = (uint32_t)key.size(), vlen = (uint32_t)val.size();
+    if (!write_full(fd, &op, 1) || !write_full(fd, &klen, 4) ||
+        (klen && !write_full(fd, key.data(), klen)) ||
+        !write_full(fd, &vlen, 4) ||
+        (vlen && !write_full(fd, val.data(), vlen)))
+      return -2;
+    int32_t status;
+    uint32_t olen;
+    if (!read_full(fd, &status, 4) || !read_full(fd, &olen, 4)) return -2;
+    std::string buf(olen, 0);
+    if (olen && !read_full(fd, buf.data(), olen)) return -2;
+    if (out) *out = std::move(buf);
+    return status;
+  }
+};
+
+}  // namespace
+}  // namespace ptcore
+
+using namespace ptcore;
+
+// Start a server on `port` (0 = ephemeral). Returns handle or null.
+PT_EXPORT void *pt_store_server_start(int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons((uint16_t)port);
+  if (::bind(fd, (sockaddr *)&addr, sizeof(addr)) < 0 ||
+      ::listen(fd, 128) < 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(fd, (sockaddr *)&addr, &alen);
+  auto *s = new Server();
+  s->listen_fd = fd;
+  s->port = ntohs(addr.sin_port);
+  s->accept_thread = std::thread([s] { s->accept_loop(); });
+  return s;
+}
+
+PT_EXPORT int pt_store_server_port(void *h) { return ((Server *)h)->port; }
+
+PT_EXPORT void pt_store_server_stop(void *h) {
+  auto *s = (Server *)h;
+  s->stop.store(true);
+  s->cv.notify_all();
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  // wake every connection thread (blocked in read_full or cv.wait) and
+  // join it before freeing the server — no detached thread may outlive `s`
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    for (auto &c : s->conns) {
+      int fd = c.fd.load();
+      if (fd >= 0) ::shutdown(fd, SHUT_RDWR);  // conn thread closes it
+    }
+  }
+  for (auto &c : s->conns)
+    if (c.th.joinable()) c.th.join();
+  delete s;
+}
+
+PT_EXPORT void *pt_store_client_connect(const char *host, int port,
+                                        int timeout_ms) {
+  uint64_t deadline = now_ns() + (uint64_t)timeout_ms * 1000000ull;
+  char portstr[16];
+  snprintf(portstr, sizeof(portstr), "%d", port);
+  for (;;) {
+    // resolve each attempt (DNS may come up after the process does)
+    addrinfo hints{};
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo *res = nullptr;
+    if (getaddrinfo(host, portstr, &hints, &res) == 0) {
+      for (addrinfo *ai = res; ai; ai = ai->ai_next) {
+        int fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+        if (fd < 0) continue;
+        if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) {
+          int one = 1;
+          setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          freeaddrinfo(res);
+          auto *c = new Client();
+          c->fd = fd;
+          return c;
+        }
+        ::close(fd);
+      }
+      freeaddrinfo(res);
+    }
+    if (now_ns() >= deadline) return nullptr;
+    usleep(50 * 1000);
+  }
+}
+
+PT_EXPORT int pt_store_set(void *h, const char *key, const void *val,
+                           int64_t len) {
+  std::string v((const char *)val, (size_t)len);
+  return (int)((Client *)h)->request(1, key, v, nullptr);
+}
+
+// GET/WAIT: returns value length (copied into buf up to buflen), -1 missing
+// (GET only), -2 connection error.
+PT_EXPORT int64_t pt_store_get(void *h, const char *key, void *buf,
+                               int64_t buflen, int wait) {
+  std::string out;
+  int64_t st = ((Client *)h)->request(wait ? 3 : 2, key, "", &out);
+  if (st < 0) return st;
+  int64_t n = (int64_t)out.size();
+  if (buf && buflen >= n) memcpy(buf, out.data(), n);
+  return n;
+}
+
+PT_EXPORT int64_t pt_store_add(void *h, const char *key, int64_t delta) {
+  std::string v(8, 0);
+  memcpy(v.data(), &delta, 8);
+  std::string out;
+  int64_t st = ((Client *)h)->request(4, key, v, &out);
+  if (st < 0 || out.size() != 8) return INT64_MIN;
+  int64_t cur;
+  memcpy(&cur, out.data(), 8);
+  return cur;
+}
+
+PT_EXPORT int pt_store_del(void *h, const char *key) {
+  return (int)((Client *)h)->request(5, key, "", nullptr);
+}
+
+PT_EXPORT int64_t pt_store_numkeys(void *h) {
+  std::string out;
+  int64_t st = ((Client *)h)->request(6, "", "", &out);
+  if (st < 0 || out.size() != 8) return -1;
+  int64_t n;
+  memcpy(&n, out.data(), 8);
+  return n;
+}
+
+PT_EXPORT void pt_store_client_close(void *h) {
+  auto *c = (Client *)h;
+  ::close(c->fd);
+  delete c;
+}

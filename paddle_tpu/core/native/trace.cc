@@ -1,0 +1,175 @@
+// Host event tracer.
+//
+// Equivalent of the reference's RecordEvent/HostTraceLevel machinery
+// (paddle/fluid/platform/profiler/event_tracing.h, host_tracer.cc): RAII
+// push/pop spans per thread, collected into a global buffer and exported as
+// chrome://tracing JSON (ref: chrometracing_logger.cc). Device-side timing
+// comes from the XLA/jax profiler; this covers the host framework side.
+#include "common.h"
+
+#include <atomic>
+#include <cstdio>
+#include <thread>
+#include <vector>
+
+namespace ptcore {
+namespace {
+
+struct Event {
+  std::string name;
+  uint64_t start_ns;
+  uint64_t end_ns;    // 0 while open; ==start for instant events
+  uint64_t tid;
+  uint32_t level;
+  bool instant;
+};
+
+std::atomic<int> g_trace_level{0};  // 0 = disabled
+std::mutex g_mu;
+std::vector<Event> g_events;
+uint64_t g_trace_start_ns = 0;
+
+struct ThreadStack {
+  // Spans complete strictly LIFO per thread, so staging is a stack: each pop
+  // finalizes staging.back() and moves it straight to the global buffer —
+  // dump/export never miss completed events from threads still inside an
+  // outer span.
+  std::vector<bool> open_recorded;  // false = pushed while disabled
+  std::vector<Event> staging;
+};
+thread_local ThreadStack t_stack;
+
+uint64_t tid_hash() {
+  return std::hash<std::thread::id>()(std::this_thread::get_id()) & 0xffffff;
+}
+
+}  // namespace
+}  // namespace ptcore
+
+using namespace ptcore;
+
+PT_EXPORT void pt_trace_enable(int level) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (g_trace_start_ns == 0) g_trace_start_ns = now_ns();
+  g_trace_level.store(level > 0 ? level : 1);
+}
+
+PT_EXPORT void pt_trace_disable() { g_trace_level.store(0); }
+
+PT_EXPORT int pt_trace_level() { return g_trace_level.load(); }
+
+PT_EXPORT void pt_trace_push(const char *name, int level) {
+  if (g_trace_level.load() < level || g_trace_level.load() == 0) {
+    // record a sentinel so pop stays balanced
+    t_stack.open_recorded.push_back(false);
+    return;
+  }
+  Event e;
+  e.name = name ? name : "?";
+  e.start_ns = now_ns();
+  e.end_ns = 0;
+  e.tid = tid_hash();
+  e.level = level;
+  e.instant = false;
+  t_stack.staging.push_back(e);
+  t_stack.open_recorded.push_back(true);
+}
+
+PT_EXPORT void pt_trace_pop() {
+  auto &st = t_stack;
+  if (st.open_recorded.empty()) return;
+  bool recorded = st.open_recorded.back();
+  st.open_recorded.pop_back();
+  if (!recorded) return;  // disabled-at-push sentinel
+  st.staging.back().end_ns = now_ns();
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    g_events.push_back(std::move(st.staging.back()));
+  }
+  st.staging.pop_back();
+}
+
+PT_EXPORT void pt_trace_instant(const char *name) {
+  if (g_trace_level.load() == 0) return;
+  std::lock_guard<std::mutex> lk(g_mu);
+  Event e;
+  e.name = name ? name : "?";
+  e.start_ns = e.end_ns = now_ns();
+  e.tid = tid_hash();
+  e.level = 1;
+  e.instant = true;
+  g_events.push_back(e);
+}
+
+PT_EXPORT int64_t pt_trace_count() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  return (int64_t)g_events.size();
+}
+
+PT_EXPORT void pt_trace_clear() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_events.clear();
+}
+
+static void json_escape(FILE *f, const std::string &s) {
+  for (char c : s) {
+    if (c == '"' || c == '\\')
+      fputc('\\', f), fputc(c, f);
+    else if ((unsigned char)c < 0x20)
+      fprintf(f, "\\u%04x", c);
+    else
+      fputc(c, f);
+  }
+}
+
+// Writes chrome://tracing "traceEvents" JSON (ts/dur in microseconds).
+PT_EXPORT int pt_trace_dump_json(const char *path, int pid) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  FILE *f = fopen(path, "w");
+  if (!f) return -1;
+  fprintf(f, "{\"traceEvents\":[\n");
+  bool first = true;
+  for (auto &e : g_events) {
+    if (!first) fprintf(f, ",\n");
+    first = false;
+    double ts = (e.start_ns - g_trace_start_ns) / 1e3;
+    fprintf(f, "{\"name\":\"");
+    json_escape(f, e.name);
+    if (e.instant) {
+      fprintf(f, "\",\"ph\":\"i\",\"s\":\"t\",\"ts\":%.3f,"
+                 "\"pid\":%d,\"tid\":%llu}",
+              ts, pid, (unsigned long long)e.tid);
+    } else {
+      double dur = (e.end_ns - e.start_ns) / 1e3;
+      fprintf(f, "\",\"ph\":\"X\",\"ts\":%.3f,\"dur\":%.3f,"
+                 "\"pid\":%d,\"tid\":%llu,\"cat\":\"host\"}",
+              ts, dur, pid, (unsigned long long)e.tid);
+    }
+  }
+  fprintf(f, "\n]}\n");
+  fclose(f);
+  return 0;
+}
+
+// Fill parallel arrays with up to `cap` completed events (for the Python
+// profiler's summary tables). Returns the number written. Names are copied
+// into `name_buf` back-to-back, NUL-separated (name_buf_len total capacity).
+PT_EXPORT int64_t pt_trace_export(uint64_t *start_ns, uint64_t *dur_ns,
+                                  uint64_t *tids, char *name_buf,
+                                  int64_t name_buf_len, int64_t cap) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  int64_t n = 0;
+  int64_t off = 0;
+  for (auto &e : g_events) {
+    if (n >= cap) break;
+    int64_t need = (int64_t)e.name.size() + 1;
+    if (off + need > name_buf_len) break;
+    start_ns[n] = e.start_ns - g_trace_start_ns;
+    dur_ns[n] = e.end_ns - e.start_ns;
+    tids[n] = e.tid;
+    memcpy(name_buf + off, e.name.c_str(), need);
+    off += need;
+    ++n;
+  }
+  return n;
+}

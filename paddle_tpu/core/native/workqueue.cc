@@ -1,0 +1,98 @@
+// Threadpool work queue.
+//
+// Native counterpart of the reference executor's workqueue
+// (paddle/fluid/framework/new_executor/workqueue/): a fixed pool of worker
+// threads draining a FIFO of jobs, with a drain barrier. On TPU the XLA
+// executable replaces per-op async dispatch, so this pool serves the host
+// side: dataloader prefetch, checkpoint shard IO, and profiler flushing.
+// Jobs are C function pointers (Python hands in ctypes callbacks, which
+// re-acquire the GIL themselves).
+#include "common.h"
+
+#include <condition_variable>
+#include <deque>
+#include <thread>
+#include <vector>
+
+namespace ptcore {
+namespace {
+
+using JobFn = void (*)(void *);
+
+struct WorkQueue {
+  std::mutex mu;
+  std::condition_variable cv_job;    // workers wait for jobs
+  std::condition_variable cv_drain;  // waiters wait for quiescence
+  std::deque<std::pair<JobFn, void *>> jobs;
+  std::vector<std::thread> threads;
+  int in_flight = 0;
+  bool stop = false;
+
+  explicit WorkQueue(int n) {
+    for (int i = 0; i < n; ++i)
+      threads.emplace_back([this] { worker(); });
+  }
+
+  void worker() {
+    for (;;) {
+      std::pair<JobFn, void *> job;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_job.wait(lk, [this] { return stop || !jobs.empty(); });
+        if (stop && jobs.empty()) return;
+        job = jobs.front();
+        jobs.pop_front();
+        ++in_flight;
+      }
+      job.first(job.second);
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        --in_flight;
+        if (jobs.empty() && in_flight == 0) cv_drain.notify_all();
+      }
+    }
+  }
+
+  ~WorkQueue() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      stop = true;
+    }
+    cv_job.notify_all();
+    for (auto &t : threads) t.join();
+  }
+};
+
+}  // namespace
+}  // namespace ptcore
+
+using namespace ptcore;
+
+PT_EXPORT void *pt_wq_create(int num_threads) {
+  if (num_threads <= 0) num_threads = 1;
+  return new WorkQueue(num_threads);
+}
+
+PT_EXPORT void pt_wq_submit(void *h, void (*fn)(void *), void *arg) {
+  auto *q = (WorkQueue *)h;
+  {
+    std::lock_guard<std::mutex> lk(q->mu);
+    q->jobs.emplace_back(fn, arg);
+  }
+  q->cv_job.notify_one();
+}
+
+// Block until every submitted job has finished.
+PT_EXPORT void pt_wq_wait(void *h) {
+  auto *q = (WorkQueue *)h;
+  std::unique_lock<std::mutex> lk(q->mu);
+  q->cv_drain.wait(lk, [q] { return q->jobs.empty() && q->in_flight == 0; });
+}
+
+PT_EXPORT void pt_wq_destroy(void *h) { delete (WorkQueue *)h; }
+
+PT_EXPORT int64_t pt_wq_pending(void *h) {
+  auto *q = (WorkQueue *)h;
+  std::lock_guard<std::mutex> lk(q->mu);
+  return (int64_t)q->jobs.size() + q->in_flight;
+}

@@ -67,6 +67,21 @@ def set_flags(flags: dict):
             _values[name] = _parse(f.type, value)
         if f.on_change is not None:
             f.on_change(_values[name])
+        _mirror_to_native(name, _values[name])
+
+
+def _mirror_to_native(name, value):
+    """Mirror into the native core's flag table (paddle/phi/core/flags.cc
+    analog) so C++ components can consult flags without re-entering Python.
+    Only when the lib is already loaded — set_flags must never trigger the
+    g++ build; ``core._load`` replays the full table on first load."""
+    try:
+        import sys
+        _core = sys.modules.get("paddle_tpu.core")
+        if _core is not None and _core._lib is not None:
+            _core._lib.pt_flag_set(name.encode(), str(value).encode())
+    except Exception:
+        pass
 
 
 def get_flags(flags) -> dict:

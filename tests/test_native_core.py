@@ -1,0 +1,192 @@
+"""Native runtime core tests (paddle_tpu.core over libptcore.so).
+
+Mirrors the reference's C++ runtime unit tests (test/cpp/phi, the
+custom-device capi_test) at the ctypes boundary: tracer spans, flag table,
+host buffer pool semantics, workqueue drain, and TCPStore set/get/wait/add
+across processes.
+"""
+from __future__ import annotations
+
+import json
+import multiprocessing
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.core as core
+
+pytestmark = pytest.mark.skipif(
+    not core.native_available(), reason="no C++ toolchain for native core")
+
+
+class TestTracer:
+    def setup_method(self):
+        core.tracer_clear()
+        core.tracer_enable()
+
+    def teardown_method(self):
+        core.tracer_disable()
+        core.tracer_clear()
+
+    def test_spans_nested(self):
+        with core.RecordEvent("outer"):
+            with core.RecordEvent("inner"):
+                time.sleep(0.002)
+        names = {e[0] for e in core.tracer_events()}
+        assert {"outer", "inner"} <= names
+        # inner nested within outer: shorter duration
+        ev = {e[0]: e for e in core.tracer_events()}
+        assert ev["inner"][2] <= ev["outer"][2]
+
+    def test_disabled_push_pop_balanced(self):
+        core.tracer_disable()
+        with core.RecordEvent("ghost"):
+            pass
+        core.tracer_enable()
+        with core.RecordEvent("real"):
+            pass
+        names = [e[0] for e in core.tracer_events()]
+        assert "ghost" not in names and "real" in names
+
+    def test_chrome_dump(self, tmp_path):
+        with core.RecordEvent("step"):
+            time.sleep(0.001)
+        out = tmp_path / "trace.json"
+        core.tracer_dump(str(out))
+        j = json.loads(out.read_text())
+        assert any(e["name"] == "step" and e["ph"] == "X"
+                   for e in j["traceEvents"])
+
+    def test_decorator(self):
+        @core.RecordEvent("fn_span")
+        def f(x):
+            return x + 1
+        assert f(1) == 2
+        assert "fn_span" in [e[0] for e in core.tracer_events()]
+
+    def test_multithreaded(self):
+        def work(i):
+            with core.RecordEvent(f"t{i}"):
+                time.sleep(0.001)
+        ts = [threading.Thread(target=work, args=(i,)) for i in range(8)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        names = {e[0] for e in core.tracer_events()}
+        assert {f"t{i}" for i in range(8)} <= names
+
+
+class TestFlags:
+    def test_native_mirror(self):
+        import paddle_tpu as pt
+        pt.set_flags({"check_nan_inf": True})
+        lib = core._load()
+        import ctypes
+        buf = ctypes.create_string_buffer(64)
+        n = lib.pt_flag_get(b"check_nan_inf", buf, 64)
+        assert n > 0 and buf.value == b"True"
+        pt.set_flags({"check_nan_inf": False})
+
+
+class TestHostPool:
+    def test_reuse_and_stats(self):
+        pool = core.HostBufferPool()
+        mv1, tok1 = pool.take(4096)
+        mv1[:8] = b"01234567"
+        assert np.frombuffer(mv1, np.uint8)[:8].tobytes() == b"01234567"
+        s1 = core.host_memory_stats()
+        assert s1["allocated"] >= 4096 and s1["reserved"] >= s1["allocated"]
+        pool.give(tok1)
+        s2 = core.host_memory_stats()
+        assert s2["allocated"] == s1["allocated"] - 4096
+        # freed block is reused (best-fit) without growing reserved
+        mv2, tok2 = pool.take(4096)
+        assert core.host_memory_stats()["reserved"] == s2["reserved"]
+        pool.give(tok2)
+
+    def test_many_sizes(self):
+        pool = core.HostBufferPool()
+        toks = []
+        for sz in [1, 63, 64, 65, 1 << 10, 1 << 16, (1 << 20) + 3]:
+            mv, tok = pool.take(sz)
+            assert len(mv) == sz
+            mv[-1:] = b"\x07"
+            toks.append(tok)
+        for t in toks:
+            pool.give(t)
+        released = pool.release_free()
+        assert released >= 0  # chunks fully coalesced can be released
+
+
+class TestWorkQueue:
+    def test_drain(self):
+        wq = core.WorkQueue(4)
+        hits = []
+        lock = threading.Lock()
+        for i in range(200):
+            def job(i=i):
+                with lock:
+                    hits.append(i)
+            wq.submit(job)
+        wq.wait()
+        assert sorted(hits) == list(range(200))
+        assert wq.pending() == 0
+        wq.shutdown()
+
+    def test_job_error_does_not_kill_pool(self, capsys):
+        wq = core.WorkQueue(2)
+        done = []
+        wq.submit(lambda: 1 / 0)
+        wq.submit(lambda: done.append(1))
+        wq.wait()
+        assert done == [1]
+        wq.shutdown()
+
+
+def _store_worker(port, rank, q):
+    import paddle_tpu.core as core
+    c = core.TCPStore("127.0.0.1", port)
+    c.set(f"rank{rank}", str(rank))
+    n = c.add("barrier", 1)
+    # blocking get: master sets "go" only after all ranks arrive
+    q.put((rank, c.get("go"), n))
+    c.close()
+
+
+class TestTCPStore:
+    def test_set_get_add(self):
+        s = core.TCPStore(is_master=True)
+        s.set("k", b"v1")
+        assert s.get("k") == b"v1"
+        assert s.get("missing", wait=False) is None
+        assert s.add("ctr", 3) == 3
+        assert s.add("ctr", -1) == 2
+        s.delete("k")
+        assert s.get("k", wait=False) is None
+        s.close()
+
+    def test_multiprocess_rendezvous(self):
+        ctx = multiprocessing.get_context("spawn")
+        s = core.TCPStore(is_master=True)
+        q = ctx.Queue()
+        ps = [ctx.Process(target=_store_worker, args=(s.port, r, q))
+              for r in range(3)]
+        [p.start() for p in ps]
+        # wait until all 3 hit the barrier, then release them
+        while True:
+            got = s.get("barrier", wait=False)
+            if got is not None and int.from_bytes(got, "little",
+                                                  signed=True) == 3:
+                break
+            time.sleep(0.01)
+        s.set("go", b"now")
+        results = [q.get(timeout=30) for _ in range(3)]
+        [p.join(timeout=30) for p in ps]
+        assert {r[0] for r in results} == {0, 1, 2}
+        assert all(r[1] == b"now" for r in results)
+        assert {r[2] for r in results} == {1, 2, 3}
+        for r in range(3):
+            assert s.get(f"rank{r}") == str(r).encode()
+        s.close()
