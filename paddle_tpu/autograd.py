@@ -124,6 +124,12 @@ class Node:
         self.inputs = []
 
 
+# static-graph recorder hook; installed by paddle_tpu.static.graph so the
+# one op funnel serves both dygraph (execute + tape) and static (record node)
+_static_recorder = None
+_STATIC_SENTINEL = None
+
+
 def record(fn, tensors, outputs_wrap, name=""):
     """Run `fn(*datas)` with optional tape capture.
 
@@ -131,6 +137,10 @@ def record(fn, tensors, outputs_wrap, name=""):
     tensors: Tensor inputs in fn arg order.
     outputs_wrap: callable(raw_out, requires_grad) -> (tensors_list, result)
     """
+    if _static_recorder is not None:
+        res = _static_recorder(fn, tensors, outputs_wrap, name)
+        if res is not _STATIC_SENTINEL:
+            return res
     datas = tuple(t._data for t in tensors)
     needs_grad = (
         is_grad_enabled()

@@ -33,21 +33,23 @@ __all__ = ["to_static", "not_to_static", "StaticFunction", "InputSpec",
            "functional_call", "enable_static", "disable_static",
            "in_dynamic_mode", "ignore_module"]
 
-_static_mode = False
-
-
+# static/graph.py owns the one mode flag; these delegate (single source of
+# truth — a desync would make Optimizer/record disagree about the mode)
 def enable_static():
-    global _static_mode
-    _static_mode = True
+    """Switch to static-graph mode: ops on ``static.data`` placeholders
+    record into the default Program (see ``paddle_tpu.static``)."""
+    from ..static import graph as _g
+    _g.enable_static()
 
 
 def disable_static():
-    global _static_mode
-    _static_mode = False
+    from ..static import graph as _g
+    _g.disable_static()
 
 
 def in_dynamic_mode():
-    return not _static_mode
+    from ..static import graph as _g
+    return not _g.in_static_mode()
 
 
 def ignore_module(modules):

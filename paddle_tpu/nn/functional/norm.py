@@ -29,6 +29,37 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
 
     rm, rv = ensure_tensor(running_mean), ensure_tensor(running_var)
 
+    symbolic = not isinstance(x._data, (jax.Array, jax.core.Tracer))
+
+    def f(d, m, v, *wb):
+        shape = [1] * d.ndim
+        shape[ch_axis] = d.shape[ch_axis]
+        out = (d - m.reshape(shape)) * jax.lax.rsqrt(
+            v.reshape(shape).astype(d.dtype) + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape).astype(d.dtype)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape).astype(d.dtype)
+        return out
+
+    if use_batch_stats and symbolic:
+        # static-graph mode: batch stats fold into the recorded op; running
+        # stats are not threaded through the Program (the reference's static
+        # BN updates them via in-place ops in the scope — here inference
+        # graphs should be built with is_test/eval so global stats are used)
+        def f_sym(d, *wb):
+            return f(d, jnp.mean(d, axis=reduce_axes),
+                     jnp.var(d, axis=reduce_axes), *wb)
+
+        args = [x]
+        if weight is not None:
+            args.append(ensure_tensor(weight))
+        if bias is not None:
+            args.append(ensure_tensor(bias))
+        return nary(f_sym, args, name="batch_norm")
+
     if use_batch_stats:
         # compute batch stats, update running stats (eager mutation)
         def stats(d):
@@ -45,19 +76,6 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         var_t = Tensor(v_arr)
     else:
         mean_t, var_t = rm, rv
-
-    def f(d, m, v, *wb):
-        shape = [1] * d.ndim
-        shape[ch_axis] = d.shape[ch_axis]
-        out = (d - m.reshape(shape)) * jax.lax.rsqrt(
-            v.reshape(shape).astype(d.dtype) + epsilon)
-        i = 0
-        if weight is not None:
-            out = out * wb[i].reshape(shape).astype(d.dtype)
-            i += 1
-        if bias is not None:
-            out = out + wb[i].reshape(shape).astype(d.dtype)
-        return out
 
     args = [x, mean_t, var_t]
     if weight is not None:

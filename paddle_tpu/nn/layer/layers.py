@@ -123,6 +123,10 @@ class Layer:
 
     def register_buffer(self, name, tensor, persistable=True):
         self._buffers[name] = tensor
+        if tensor is not None:
+            # scope-resident in static mode (not a baked constant), and
+            # included in checkpoints — ref framework.py persistable vars
+            tensor.persistable = persistable
         if not persistable:
             self._non_persistable_buffer_names_set.add(name)
         else:

@@ -42,9 +42,12 @@ class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None, multi_precision=True):
         if parameters is None:
-            raise ValueError(
-                "parameters must be given in dygraph mode "
-                "(pass model.parameters())")
+            from ..jit.api import in_dynamic_mode
+            if in_dynamic_mode():
+                raise ValueError(
+                    "parameters must be given in dygraph mode "
+                    "(pass model.parameters())")
+            parameters = []  # static mode: minimize() finds params via graph
         self._parameter_list = list(parameters)
         self._learning_rate = learning_rate
         self._grad_clip = grad_clip
@@ -171,6 +174,11 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from ..static.graph import Variable as _StaticVar
+        if isinstance(loss, _StaticVar):
+            # static mode: record the fused backward+update node
+            from ..static.gradients import append_minimize
+            return append_minimize(self, loss, parameters=parameters)
         if loss._node is not None:
             loss.backward()
         self.step()

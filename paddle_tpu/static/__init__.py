@@ -1,0 +1,37 @@
+"""``paddle.static`` — static-graph API surface.
+
+TPU-native redesign of the reference static stack (see graph.py /
+executor.py / gradients.py docstrings for the mapping):
+ProgramDesc→recorded jax-fn DAG, InterpreterCore→one jitted XLA program,
+append_backward→`jax.value_and_grad` over the replayed subgraph,
+inference model→StableHLO export.
+
+Ref entry points: ``python/paddle/static/``, Executor
+``python/paddle/fluid/executor.py:895``.
+"""
+from .graph import (  # noqa: F401
+    Program, Variable, program_guard, default_main_program,
+    default_startup_program, data, name_scope,
+)
+from .executor import (  # noqa: F401
+    Executor, Scope, global_scope, scope_guard, CompiledProgram,
+)
+from .gradients import append_backward, gradients  # noqa: F401
+from .io import (  # noqa: F401
+    save, load, save_inference_model, load_inference_model,
+)
+from . import nn_static as nn  # noqa: F401
+
+InputSpec = None  # set below (shared with jit)
+try:
+    from ..jit.api import InputSpec  # noqa: F401,F811
+except Exception:
+    pass
+
+__all__ = [
+    "Program", "Variable", "program_guard", "default_main_program",
+    "default_startup_program", "data", "name_scope", "Executor", "Scope",
+    "global_scope", "scope_guard", "CompiledProgram", "append_backward",
+    "gradients", "save", "load", "save_inference_model",
+    "load_inference_model", "nn", "InputSpec",
+]

@@ -1,0 +1,221 @@
+"""Static-graph Executor: one jitted XLA program per (feed, fetch) shape.
+
+TPU-native replacement for the reference's standalone executor stack
+(``StandaloneExecutor`` ``new_executor/standalone_executor.cc:28``,
+``InterpreterCore``/``ProgramInterpreter`` instruction scheduling,
+``_ExecutorCache`` ``python/paddle/fluid/executor.py:701``):
+
+ - "Convert program → instruction list + dependency/stream analysis" becomes
+   "compose the node DAG into one pure function and ``jax.jit`` it" — XLA
+   owns scheduling, fusion, streams, and memory planning.
+ - The compile cache is keyed on (program version, feed shapes/dtypes,
+   fetch set), the analog of `_ExecutorCache`'s (program, scope) key.
+ - Scope semantics (``paddle/fluid/framework/scope.h``): persistable
+   parameters and optimizer state live in a Scope dict across runs; update
+   nodes declare scope writes, applied after each run from the jitted
+   program's donated outputs.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from . import graph as G
+
+__all__ = ["Executor", "Scope", "global_scope", "scope_guard",
+           "CompiledProgram"]
+
+
+class Scope:
+    """name -> jax.Array container (ref: ``scope.h``)."""
+
+    def __init__(self):
+        self.vars: dict[str, jax.Array] = {}
+
+    def find_var(self, name):
+        return self.vars.get(name)
+
+    def var(self, name):
+        return self.vars.setdefault(name, None)
+
+    def set(self, name, value):
+        self.vars[name] = value
+
+    def drop_kids(self):
+        self.vars.clear()
+
+
+_global_scope = Scope()
+_scope_stack = [_global_scope]
+
+
+def global_scope() -> Scope:
+    return _scope_stack[-1]
+
+
+class scope_guard:
+    def __init__(self, scope: Scope):
+        self.scope = scope
+
+    def __enter__(self):
+        _scope_stack.append(self.scope)
+        return self.scope
+
+    def __exit__(self, *exc):
+        _scope_stack.pop()
+        return False
+
+
+class CompiledProgram:
+    """Parity shim (``paddle.static.CompiledProgram``): every program is
+    compiled here, so this only carries the underlying program through."""
+
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+
+
+class Executor:
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: dict = {}
+
+    # -- startup -----------------------------------------------------------
+    def _run_startup(self, program: "G.Program", scope: Scope):
+        main = program._paired_main() if program._paired_main else None
+        progs = [p for p in (main, program) if p is not None]
+        for p in progs:
+            for key, t in p.scope_tensors.items():
+                scope.set(key, t._data)
+            for key, init in p.scope_init.items():
+                scope.set(key, jnp.asarray(init()))
+        return []
+
+    # -- main --------------------------------------------------------------
+    def run(self, program=None, feed=None, fetch_list=None, scope=None,
+            return_numpy=True, use_program_cache=True):
+        if isinstance(program, CompiledProgram):
+            program = program.program
+        program = program or G.default_main_program()
+        scope = scope or global_scope()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+
+        if not program.nodes:  # startup program
+            return self._run_startup(program, scope)
+
+        fetch_vids = tuple(self._fetch_vid(program, f) for f in fetch_list)
+        feed_names = tuple(sorted(feed))
+        feed_arrays = {}
+        for name in feed_names:
+            val = feed[name]
+            if isinstance(val, Tensor):
+                val = val._data
+            vid = program.feed_map.get(name)
+            if vid is None:
+                raise KeyError(f"feed '{name}' is not a data() var of this "
+                               f"program (has {list(program.feed_map)})")
+            want = program.var_meta[vid]._data.dtype
+            feed_arrays[name] = jnp.asarray(val, dtype=want)
+
+        key = (id(program), program.version, fetch_vids,
+               tuple((n, feed_arrays[n].shape, str(feed_arrays[n].dtype))
+                     for n in feed_names))
+        entry = self._cache.get(key) if use_program_cache else None
+        if entry is None:
+            entry = self._compile(program, feed_names, fetch_vids)
+            if use_program_cache:
+                self._cache[key] = entry
+        fn, scope_keys, write_keys, host_fns = entry
+
+        # materialize scope inputs (implicit startup for missing params)
+        scope_vals = []
+        for k in scope_keys:
+            v = scope.find_var(k)
+            if v is None:
+                t = program.scope_tensors.get(k)
+                if t is not None:
+                    v = t._data
+                elif k in program.scope_init:
+                    v = jnp.asarray(program.scope_init[k]())
+                else:
+                    raise KeyError(f"scope var '{k}' has no value and no "
+                                   "initializer; run the startup program")
+                scope.set(k, v)
+            scope_vals.append(v)
+
+        host_vals = tuple(jnp.asarray(hf(), jnp.float32) for hf in host_fns)
+        fetches, writes = fn(tuple(feed_arrays[n] for n in feed_names),
+                             tuple(scope_vals), host_vals)
+        for k, v in zip(write_keys, writes):
+            scope.set(k, v)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return [Tensor(f) for f in fetches]
+
+    def _fetch_vid(self, program, f):
+        if isinstance(f, str):
+            vid = program.var_by_name.get(f)
+            if vid is None:
+                raise KeyError(f"no variable named '{f}' to fetch")
+            return program.resolve(vid)
+        if isinstance(f, G.Variable):
+            return program.resolve(f._vid)
+        raise TypeError(f"fetch_list entries must be Variable or str, "
+                        f"got {type(f)}")
+
+    def _compile(self, program: "G.Program", feed_names, fetch_vids):
+        """Dead-node-eliminated composition of the DAG into one jittable fn.
+        Nodes with scope writes always run (they ARE the training step)."""
+        write_nodes = [n for n in program.nodes if n.scope_writes]
+        target_vids = list(fetch_vids) + [ov for n in write_nodes
+                                          for ov in n.out_vids]
+        needed, _, _ = program.subgraph_to(target_vids)
+        needed_set = {id(n) for n in needed} | {id(n) for n in write_nodes}
+        nodes = [n for n in program.nodes if id(n) in needed_set]
+
+        scope_keys, host_fns = [], []
+        for n in nodes:
+            for r in n.in_refs:
+                if r[0] == "s" and r[1] not in scope_keys:
+                    scope_keys.append(r[1])
+            for hf in n.host_fns:
+                host_fns.append(hf)
+        write_keys = list(dict.fromkeys(
+            k for n in nodes for (k, _) in n.scope_writes))
+
+        feed_vid_of = dict(program.feed_map)
+
+        def composed(feed_tuple, scope_tuple, host_tuple):
+            env = {}
+            for name, arr in zip(feed_names, feed_tuple):
+                env[feed_vid_of[name]] = arr
+            scope_env = dict(zip(scope_keys, scope_tuple))
+            hi = 0
+            writes = {}
+            for n in nodes:
+                args = []
+                for r in n.in_refs:
+                    kind, ref = r
+                    if kind == "v":
+                        args.append(env[ref])
+                    elif kind == "s":
+                        args.append(scope_env[ref])
+                    elif kind == "c":
+                        args.append(n.consts[ref])
+                    else:  # "h"
+                        args.append(host_tuple[hi])
+                        hi += 1
+                out = n.fn(*args)
+                outs = (out,) if not isinstance(out, (tuple, list)) else out
+                for vid, o in zip(n.out_vids, outs):
+                    env[vid] = o
+                for skey, oidx in n.scope_writes:
+                    writes[skey] = outs[oidx]
+                    scope_env[skey] = outs[oidx]  # later nodes see the update
+            fetches = tuple(env[v] for v in fetch_vids)
+            return fetches, tuple(writes[k] for k in write_keys)
+
+        jitted = jax.jit(composed)
+        return jitted, scope_keys, tuple(write_keys), host_fns

@@ -1,0 +1,257 @@
+"""Static-mode autodiff: append_backward / gradients / optimizer.minimize.
+
+The reference builds backward by emitting per-op grad OpDescs
+(``python/paddle/fluid/backward.py`` append_backward) then running them
+through the executor. Here the recorded forward subgraph is replayed inside
+``jax.value_and_grad`` as ONE node — XLA differentiates and fuses the whole
+step, and its CSE merges the replay with any forward nodes fetched alongside
+(so fetching loss + running minimize costs one forward, not two).
+
+``minimize`` additionally folds the optimizer update
+(``Optimizer.apply_gradients_tree``) into the same node, with optimizer
+state living in the Scope (the reference's persistable accumulators,
+ref ``python/paddle/optimizer/optimizer.py`` _create_accumulators) and the
+current LR passed per run as a host scalar (so LRScheduler steps don't
+recompile).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import graph as G
+
+__all__ = ["append_backward", "gradients", "append_minimize"]
+
+
+def _replay_fn(prog: "G.Program", loss_vid: int):
+    """Build pure fn(feed_env: dict vid->arr, scope_env: dict key->arr)
+    -> loss array, plus the feed vids / scope keys it needs."""
+    nodes, feed_vids, scope_keys = prog.subgraph_to([loss_vid])
+    loss_vid = prog.resolve(loss_vid)
+
+    def replay(feed_env, scope_env):
+        env = dict(feed_env)
+        for n in nodes:
+            args = []
+            for kind, ref in n.in_refs:
+                if kind == "v":
+                    args.append(env[ref])
+                elif kind == "s":
+                    args.append(scope_env[ref])
+                elif kind == "c":
+                    args.append(n.consts[ref])
+                else:
+                    raise RuntimeError(
+                        "cannot differentiate through a host-input node; "
+                        "call minimize before adding dependent nodes")
+            out = n.fn(*args)
+            outs = (out,) if not isinstance(out, (tuple, list)) else out
+            for vid, o in zip(n.out_vids, outs):
+                # setdefault: values injected into feed_env (e.g. gradients
+                # w.r.t. an intermediate var) must stay connected — the
+                # producing node must not overwrite them
+                if vid not in env:
+                    env[vid] = o
+        return env[loss_vid]
+
+    return replay, sorted(feed_vids), scope_keys
+
+
+def _feed_refs(prog, feed_vids):
+    return [("v", v) for v in feed_vids]
+
+
+def gradients(targets, inputs, target_gradients=None):
+    """``paddle.static.gradients``: grads of targets wrt input vars, seeded
+    with ``target_gradients`` cotangents (ones by default)."""
+    if not isinstance(targets, (list, tuple)):
+        targets = [targets]
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    if target_gradients is None:
+        target_gradients = [None] * len(targets)
+    if len(target_gradients) != len(targets):
+        raise ValueError("target_gradients must match targets in length")
+    prog = targets[0]._prog or G.default_main_program()
+
+    # one replay per target (each over its own subgraph)
+    replays, feed_set, scope_keys = [], set(), []
+    for t in targets:
+        rp, fv, sk = _replay_fn(prog, t._vid)
+        replays.append(rp)
+        feed_set |= set(fv)
+        for k in sk:
+            if k not in scope_keys:
+                scope_keys.append(k)
+    in_vids = [v._vid for v in inputs]
+    feed_set |= set(in_vids)
+    # cotangent vars are extra graph inputs
+    tg_vids = [tg._vid for tg in target_gradients
+               if isinstance(tg, G.Variable)]
+    feed_vids = sorted(feed_set | set(tg_vids))
+
+    n_feed = len(feed_vids)
+
+    def grad_node_fn(*datas):
+        feed_env = dict(zip(feed_vids, datas[:n_feed]))
+        scope_env = dict(zip(scope_keys, datas[n_feed:]))
+
+        def loss_of(wrt_vals):
+            fe = dict(feed_env)
+            fe.update(dict(zip(in_vids, wrt_vals)))
+            total = None
+            for rp, tg in zip(replays, target_gradients):
+                out = rp(dict(fe), scope_env)
+                if isinstance(tg, G.Variable):
+                    out = out * feed_env[tg._vid]
+                elif tg is not None:
+                    out = out * jnp.asarray(
+                        tg._data if hasattr(tg, "_data") else tg)
+                contrib = jnp.sum(out)
+                total = contrib if total is None else total + contrib
+            return total
+
+        grads = jax.grad(loss_of)([feed_env[v] for v in in_vids])
+        return tuple(grads)
+
+    in_refs = _feed_refs(prog, feed_vids) + [("s", k) for k in scope_keys]
+    out_vars = []
+    for v in inputs:
+        gv = G.Variable(list(v._data.shape), "float32", prog=prog,
+                        name=f"{v.name}@GRAD")
+        gv._data = jax.ShapeDtypeStruct(tuple(v._data.shape), v._data.dtype)
+        gv._sym_shape = list(v._data.shape)
+        prog.add_var(gv)
+        out_vars.append(gv)
+    prog.add_node(G.Node(grad_node_fn, in_refs, [v._vid for v in out_vars],
+                         name="gradients"))
+    return out_vars
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None):
+    """``paddle.static.append_backward``: returns [(param, grad_var)]."""
+    prog = loss._prog or G.default_main_program()
+    replay, feed_vids, scope_keys = _replay_fn(prog, loss._vid)
+    params = {k: t for k, t in ((k, prog.scope_tensors[k])
+                                for k in scope_keys)
+              if getattr(t, "trainable", True) and not t.stop_gradient}
+    if parameter_list is not None:
+        names = {p.name if hasattr(p, "name") else p for p in parameter_list}
+        params = {k: t for k, t in params.items() if k in names}
+    if no_grad_set:
+        params = {k: t for k, t in params.items() if k not in no_grad_set}
+    pkeys = list(params)
+    n_feed = len(feed_vids)
+
+    def bwd_fn(*datas):
+        feed_env = dict(zip(feed_vids, datas[:n_feed]))
+        scope_env = dict(zip(scope_keys, datas[n_feed:]))
+
+        def loss_of(pvals):
+            se = dict(scope_env)
+            se.update(dict(zip(pkeys, pvals)))
+            return replay(feed_env, se)
+
+        grads = jax.grad(loss_of)([scope_env[k] for k in pkeys])
+        return tuple(grads)
+
+    in_refs = _feed_refs(prog, feed_vids) + [("s", k) for k in scope_keys]
+    out = []
+    for k in pkeys:
+        t = params[k]
+        gv = G.Variable(list(t._data.shape), "float32", prog=prog,
+                        name=f"{k}@GRAD")
+        gv._data = jax.ShapeDtypeStruct(tuple(t._data.shape), t._data.dtype)
+        gv._sym_shape = list(t._data.shape)
+        prog.add_var(gv)
+        out.append((t, gv))
+    prog.add_node(G.Node(bwd_fn, in_refs, [gv._vid for (_, gv) in out],
+                         name="append_backward"))
+    return out
+
+
+def append_minimize(optimizer, loss, parameters=None):
+    """Record the fused backward+update node for ``optimizer.minimize(loss)``
+    in static mode. Parameters and optimizer state update in the Scope."""
+    prog = loss._prog or G.default_main_program()
+    replay, feed_vids, scope_keys = _replay_fn(prog, loss._vid)
+
+    params = {}
+    for k in scope_keys:
+        t = prog.scope_tensors.get(k)
+        if t is not None and getattr(t, "trainable", True) \
+                and not t.stop_gradient:
+            params[k] = t
+    if parameters is not None:
+        names = {p.name if hasattr(p, "name") else p for p in parameters}
+        params = {k: t for k, t in params.items() if k in names}
+    pkeys = list(params)
+
+    # optimizer state: initialized into the scope by the startup program
+    state0 = optimizer.init_state_tree(
+        {k: t._data for k, t in params.items()})
+    state_leaves, state_def = jax.tree_util.tree_flatten(state0)
+    opt_tag = f"opt_{id(optimizer) & 0xffffff:x}"
+    skeys = [f"{opt_tag}@state@{i}" for i in range(len(state_leaves))]
+    for key, leaf in zip(skeys, state_leaves):
+        prog.register_scope_init(key, (lambda v=leaf: v))
+
+    all_scope = list(dict.fromkeys(scope_keys + skeys))
+    n_feed = len(feed_vids)
+    n_state = len(skeys)
+
+    def update_fn(lr, *datas):
+        feed_env = dict(zip(feed_vids, datas[:n_feed]))
+        rest = datas[n_feed:]
+        scope_env = dict(zip(all_scope, rest[:len(all_scope)]))
+        state = jax.tree_util.tree_unflatten(
+            state_def, [scope_env[k] for k in skeys])
+
+        def loss_of(pvals):
+            se = dict(scope_env)
+            se.update(pvals)
+            return replay(feed_env, se)
+
+        pdict = {k: scope_env[k] for k in pkeys}
+        loss_val, grads = jax.value_and_grad(loss_of)(pdict)
+        new_params, new_state = optimizer.apply_gradients_tree(
+            pdict, grads, state, lr=lr)
+        new_leaves = jax.tree_util.tree_leaves(new_state)
+        return (loss_val, *[new_params[k] for k in pkeys], *new_leaves)
+
+    in_refs = ([("h", 0)] + _feed_refs(prog, feed_vids)
+               + [("s", k) for k in all_scope])
+    loss_out = G.Variable([], "float32", prog=prog,
+                          name=f"{loss.name}@MIN")
+    loss_out._data = jax.ShapeDtypeStruct((), loss._data.dtype)
+    loss_out._sym_shape = []
+    prog.add_var(loss_out)
+    out_vids = [loss_out._vid]
+    scope_writes = []
+    for i, k in enumerate(pkeys):
+        t = params[k]
+        pv = G.Variable(list(t._data.shape), "float32", prog=prog,
+                        name=f"{k}@NEW")
+        pv._data = jax.ShapeDtypeStruct(tuple(t._data.shape), t._data.dtype)
+        pv._sym_shape = list(t._data.shape)
+        prog.add_var(pv)
+        out_vids.append(pv._vid)
+        scope_writes.append((k, i + 1))
+    for i, k in enumerate(skeys):
+        leaf = state_leaves[i]
+        sv = G.Variable(list(leaf.shape), "float32", prog=prog,
+                        name=f"{k}@NEW")
+        sv._data = jax.ShapeDtypeStruct(tuple(leaf.shape), leaf.dtype)
+        sv._sym_shape = list(leaf.shape)
+        prog.add_var(sv)
+        out_vids.append(sv._vid)
+        scope_writes.append((k, len(pkeys) + 1 + i))
+
+    prog.add_node(G.Node(update_fn, in_refs, out_vids,
+                         host_fns=[optimizer.get_lr],
+                         scope_writes=scope_writes, name="minimize"))
+    # fetching the original loss var rides the fused node (XLA CSE would
+    # merge anyway; the alias avoids even building the standalone path)
+    prog.alias[loss._vid] = loss_out._vid
+    return None, [(params[k], None) for k in pkeys]

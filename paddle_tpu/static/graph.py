@@ -1,0 +1,371 @@
+"""Static graph core: Program, Variable, the op recorder.
+
+TPU-native re-design of the reference's static-graph layer
+(``paddle/fluid/framework/``: ProgramDesc/BlockDesc/OpDesc over protobuf,
+``python/paddle/fluid/framework.py`` Program/Block/Variable mirrors):
+
+ - A ``Program`` is a recorded dataflow DAG of pure jax functions — the
+   jaxpr/XLA-era replacement for protobuf op descs. No separate
+   InferShape pass: output metadata comes from ``jax.eval_shape`` (the
+   InferMeta analog, ref ``paddle/phi/infermeta/``), which costs zero FLOPs.
+ - ``Variable`` is a symbolic Tensor whose ``_data`` is a
+   ``jax.ShapeDtypeStruct``; every existing ``paddle_tpu`` op and ``nn``
+   layer works unchanged on Variables because all ops funnel through
+   ``autograd.record``, where the recorder hook lives.
+ - Parameters stay eager Tensors; when an op touches one, it is registered
+   as a scope-resident input (the reference's persistable var in a Scope,
+   ref ``paddle/fluid/framework/scope.h``).
+
+Execution lives in ``executor.py``: the whole program compiles to ONE XLA
+computation per (feed-shapes, fetch-set) — the standalone-executor
+instruction list (``new_executor/interpretercore.h:29``) collapses into the
+XLA schedule.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import weakref
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import autograd as _autograd
+from ..tensor import Tensor
+from ..framework.dtype import to_jax_dtype, DType
+
+__all__ = [
+    "Program", "Variable", "program_guard", "default_main_program",
+    "default_startup_program", "data", "enable_static", "disable_static",
+    "in_static_mode", "name_scope",
+]
+
+_tls = threading.local()
+
+
+def _stack():
+    if not hasattr(_tls, "progs"):
+        _tls.progs = []
+    return _tls.progs
+
+
+_static_mode = False
+_default_main: "Program|None" = None
+_default_startup: "Program|None" = None
+
+
+def enable_static():
+    """``paddle.enable_static()``."""
+    global _static_mode
+    _static_mode = True
+
+
+def disable_static():
+    """``paddle.disable_static()``."""
+    global _static_mode
+    _static_mode = False
+
+
+def in_static_mode() -> bool:
+    return _static_mode
+
+
+def default_main_program() -> "Program":
+    global _default_main
+    if _stack():
+        return _stack()[-1][0]
+    if _default_main is None:
+        _default_main = Program()
+    return _default_main
+
+
+def default_startup_program() -> "Program":
+    global _default_startup
+    if _stack():
+        return _stack()[-1][1]
+    if _default_startup is None:
+        _default_startup = Program()
+        _default_startup._paired_main = weakref.ref(default_main_program())
+    return _default_startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    """``paddle.static.program_guard`` equivalent."""
+    if startup_program is None:
+        startup_program = Program()
+    startup_program._paired_main = weakref.ref(main_program)
+    _stack().append((main_program, startup_program))
+    try:
+        yield
+    finally:
+        _stack().pop()
+
+
+@contextlib.contextmanager
+def name_scope(prefix):
+    """``paddle.static.name_scope`` — cosmetic grouping (kept for parity)."""
+    yield
+
+
+class Variable(Tensor):
+    """Symbolic tensor in a Program (ref: ``framework.py`` Variable /
+    ``paddle/fluid/framework/var_desc.h``). ``shape`` reports -1 for dynamic
+    dims (specialized per feed at Executor.run)."""
+
+    __slots__ = ("_vid", "_sym_shape", "_prog")
+
+    def __init__(self, shape, dtype, name=None, prog=None):
+        shape = list(shape)
+        rep = tuple(1 if (d is None or int(d) < 0) else int(d)
+                    for d in shape)
+        # no super().__init__: _data is metadata, not an array
+        self._data = jax.ShapeDtypeStruct(rep, to_jax_dtype(dtype))
+        self._sym_shape = [-1 if (d is None or int(d) < 0) else int(d)
+                           for d in shape]
+        self.stop_gradient = True
+        self._grad = None
+        self._node = None
+        self._out_idx = 0
+        self.name = name or f"var_{id(self) & 0xffffff:x}"
+        self.persistable = False
+        self.trainable = False
+        self._grad_hooks = []
+        self._spec = None
+        self._prog = prog
+        self._vid = None  # assigned by Program.add_var
+
+    @property
+    def shape(self):
+        return list(self._sym_shape)
+
+    def numpy(self):
+        raise RuntimeError(
+            f"Variable '{self.name}' is symbolic (static graph); fetch it "
+            "through Executor.run(..., fetch_list=[var]) to get values")
+
+    def item(self, *a):
+        self.numpy()
+
+    def __repr__(self):
+        return (f"Variable(name={self.name}, shape={self._sym_shape}, "
+                f"dtype={self._data.dtype})")
+
+    __str__ = __repr__
+
+
+class Node:
+    """One recorded op: a pure jax fn over positional inputs.
+
+    ``in_refs`` entries: ("v", vid) graph variable | ("s", key) scope entry
+    (parameter / optimizer state) | ("c", idx) baked constant |
+    ("h", idx) host-provided scalar (fetched per run, e.g. current LR).
+    ``scope_writes``: [(scope_key, out_index)] — outputs written back to the
+    scope after each run (optimizer updates).
+    """
+
+    __slots__ = ("fn", "in_refs", "out_vids", "consts", "host_fns",
+                 "scope_writes", "name")
+
+    def __init__(self, fn, in_refs, out_vids, consts=(), host_fns=(),
+                 scope_writes=(), name=""):
+        self.fn = fn
+        self.in_refs = in_refs
+        self.out_vids = out_vids
+        self.consts = list(consts)
+        self.host_fns = list(host_fns)
+        self.scope_writes = list(scope_writes)
+        self.name = name
+
+
+class Program:
+    """Recorded op DAG + var/parameter tables (ref: ProgramDesc)."""
+
+    def __init__(self):
+        self.nodes: list[Node] = []
+        self.feed_map: dict[str, int] = {}     # data() name -> vid
+        self.var_meta: dict[int, Variable] = {}
+        self.var_by_name: dict[str, int] = {}
+        self.scope_tensors: dict[str, Tensor] = {}  # key -> live param
+        self.scope_init: dict[str, object] = {}     # key -> () -> array
+        self.alias: dict[int, int] = {}        # vid -> replacement vid
+        self.version = 0
+        self._var_count = 0
+        self._paired_main = None
+        self.random_seed = 0
+
+    # -- construction -------------------------------------------------------
+    def add_var(self, v: Variable) -> int:
+        vid = self._var_count
+        self._var_count += 1
+        v._vid = vid
+        v._prog = self
+        self.var_meta[vid] = v
+        self.var_by_name[v.name] = vid
+        self.version += 1
+        return vid
+
+    def add_node(self, node: Node):
+        self.nodes.append(node)
+        self.version += 1
+
+    def register_param(self, t: Tensor) -> str:
+        key = t.name
+        if key not in self.scope_tensors:
+            self.scope_tensors[key] = t
+            self.version += 1
+        return key
+
+    def register_scope_init(self, key: str, init_fn):
+        self.scope_init[key] = init_fn
+        self.version += 1
+
+    # -- queries ------------------------------------------------------------
+    def resolve(self, vid: int) -> int:
+        while vid in self.alias:
+            vid = self.alias[vid]
+        return vid
+
+    def subgraph_to(self, vids):
+        """Nodes (in order) needed to compute `vids`, plus the feed vids and
+        scope keys they consume."""
+        producer = {}
+        for n in self.nodes:
+            for ov in n.out_vids:
+                producer[ov] = n
+        needed_nodes, seen_nodes = [], set()
+        feed_vids, scope_keys = set(), []
+        scope_seen = set()
+        # iterative DFS — deep programs (thousands of sequential ops) must
+        # not hit Python's recursion limit
+        stack = [self.resolve(v) for v in reversed(vids)]
+        while stack:
+            vid = stack.pop()
+            n = producer.get(vid)
+            if n is None:
+                feed_vids.add(vid)
+                continue
+            if id(n) in seen_nodes:
+                continue
+            seen_nodes.add(id(n))
+            needed_nodes.append(n)
+            for r in n.in_refs:
+                if r[0] == "v":
+                    stack.append(r[1])
+                elif r[0] == "s" and r[1] not in scope_seen:
+                    scope_seen.add(r[1])
+                    scope_keys.append(r[1])
+        # preserve program order
+        order = {id(n): i for i, n in enumerate(self.nodes)}
+        needed_nodes.sort(key=lambda n: order[id(n)])
+        return needed_nodes, feed_vids, scope_keys
+
+    def global_block(self):
+        return _BlockShim(self)
+
+    def list_vars(self):
+        return list(self.var_meta.values())
+
+    def clone(self, for_test=False):
+        import copy
+        p = Program.__new__(Program)
+        p.__dict__ = dict(self.__dict__)
+        p.nodes = list(self.nodes)
+        p.feed_map = dict(self.feed_map)
+        p.var_meta = dict(self.var_meta)
+        p.var_by_name = dict(self.var_by_name)
+        p.scope_tensors = dict(self.scope_tensors)
+        p.scope_init = dict(self.scope_init)
+        p.alias = dict(self.alias)
+        return p
+
+    def __repr__(self):
+        return (f"Program(nodes={len(self.nodes)}, "
+                f"vars={len(self.var_meta)}, "
+                f"params={list(self.scope_tensors)})")
+
+
+class _BlockShim:
+    """Minimal Block facade (``Program.global_block().var(name)``)."""
+
+    def __init__(self, prog):
+        self._prog = prog
+
+    def var(self, name):
+        vid = self._prog.var_by_name.get(name)
+        if vid is None:
+            raise ValueError(f"no variable named '{name}'")
+        return self._prog.var_meta[vid]
+
+    def all_parameters(self):
+        return list(self._prog.scope_tensors.values())
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """``paddle.static.data`` — declare a feed placeholder."""
+    prog = default_main_program()
+    v = Variable(shape, dtype, name=name, prog=prog)
+    prog.add_var(v)
+    prog.feed_map[name] = v._vid
+    return v
+
+
+# ---------------------------------------------------------------------------
+# Recorder hook (installed into autograd.record's dispatch)
+# ---------------------------------------------------------------------------
+_NOT_STATIC = object()
+
+
+def _spec_of(t: Tensor):
+    d = t._data
+    return jax.ShapeDtypeStruct(tuple(d.shape), d.dtype)
+
+
+def _maybe_record(fn, tensors, outputs_wrap, name):
+    """Called by autograd.record first. Returns _NOT_STATIC to fall through
+    to eager execution (not in static mode / no symbolic inputs)."""
+    if not _static_mode:
+        return _NOT_STATIC
+    if not any(isinstance(t, Variable) for t in tensors):
+        return _NOT_STATIC  # initializers etc. stay eager
+    prog = None
+    for t in tensors:
+        if isinstance(t, Variable) and t._prog is not None:
+            prog = t._prog
+            break
+    if prog is None:
+        prog = default_main_program()
+
+    in_refs, specs, consts = [], [], []
+    for t in tensors:
+        if isinstance(t, Variable):
+            in_refs.append(("v", t._vid))
+            specs.append(_spec_of(t))
+        elif t.persistable or getattr(t, "trainable", False) or \
+                not t.stop_gradient:
+            key = prog.register_param(t)
+            in_refs.append(("s", key))
+            specs.append(_spec_of(t))
+        else:
+            in_refs.append(("c", len(consts)))
+            consts.append(t._data)
+            specs.append(_spec_of(t))
+
+    out_struct = jax.eval_shape(fn, *specs)
+    single = isinstance(out_struct, jax.ShapeDtypeStruct)
+    outs_struct = [out_struct] if single else list(out_struct)
+    out_vars = []
+    for st in outs_struct:
+        v = Variable(st.shape, "float32", prog=prog)
+        v._data = jax.ShapeDtypeStruct(tuple(st.shape), st.dtype)
+        v._sym_shape = list(st.shape)
+        prog.add_var(v)
+        out_vars.append(v)
+    prog.add_node(Node(fn, in_refs, [v._vid for v in out_vars],
+                       consts=consts, name=name))
+    return out_vars[0] if single else tuple(out_vars)
+
+
+_autograd._static_recorder = _maybe_record
+_autograd._STATIC_SENTINEL = _NOT_STATIC

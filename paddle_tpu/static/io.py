@@ -1,0 +1,170 @@
+"""Static-graph persistence (ref: ``python/paddle/static/io.py``).
+
+``save/load``: program parameters + optimizer state from the Scope, pickled
+as numpy (same container discipline as ``paddle.save``).
+
+``save_inference_model/load_inference_model``: the reference serializes a
+pruned ProgramDesc + persistables; the TPU-native artifact is a **StableHLO
+export** of the composed feed→fetch function (via ``jax.export``) plus the
+parameter values — the deployment story XLA understands (the
+AnalysisPredictor equivalent consumes it in ``paddle_tpu.inference``).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import graph as G
+from .executor import global_scope, Executor
+
+__all__ = ["save", "load", "save_inference_model", "load_inference_model"]
+
+
+def _program_state(program, scope):
+    state = {}
+    for key, t in program.scope_tensors.items():
+        v = scope.find_var(key)
+        state[key] = np.asarray(v if v is not None else t._data)
+    for key in program.scope_init:
+        v = scope.find_var(key)
+        if v is not None:
+            state[key] = np.asarray(v)
+    return state
+
+
+def save(program, model_path, protocol=4):
+    """``paddle.static.save``: parameters → `model_path.pdparams`, optimizer
+    state → `model_path.pdopt`."""
+    scope = global_scope()
+    state = _program_state(program, scope)
+    params = {k: v for k, v in state.items() if "@state@" not in k}
+    opt = {k: v for k, v in state.items() if "@state@" in k}
+    os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(params, f, protocol=protocol)
+    with open(model_path + ".pdopt", "wb") as f:
+        pickle.dump(opt, f, protocol=protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """``paddle.static.load``: restore scope vars saved by ``save``."""
+    scope = global_scope()
+    for suffix in (".pdparams", ".pdopt"):
+        path = model_path + suffix
+        if not os.path.exists(path):
+            continue
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        for k, v in state.items():
+            scope.set(k, jnp.asarray(v))
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         program=None):
+    """Export feed→fetch as serialized StableHLO + params."""
+    if not isinstance(feed_vars, (list, tuple)):
+        feed_vars = [feed_vars]
+    if not isinstance(fetch_vars, (list, tuple)):
+        fetch_vars = [fetch_vars]
+    program = program or (feed_vars[0]._prog if feed_vars else None) \
+        or G.default_main_program()
+    scope = global_scope()
+
+    from .gradients import _replay_fn
+    fetch_vids = [program.resolve(v._vid) for v in fetch_vars]
+    # scope keys over all fetches
+    all_scope, replays = [], []
+    for fv in fetch_vids:
+        replay, feed_vids, scope_keys = _replay_fn(program, fv)
+        replays.append((replay, feed_vids))
+        for k in scope_keys:
+            if k not in all_scope:
+                all_scope.append(k)
+    feed_vid_list = [v._vid for v in feed_vars]
+
+    params = {}
+    for k in all_scope:
+        v = scope.find_var(k)
+        if v is None:
+            t = program.scope_tensors.get(k)
+            v = t._data if t is not None else jnp.asarray(
+                program.scope_init[k]())
+        params[k] = v
+
+    def infer_fn(params, *feeds):
+        feed_env = dict(zip(feed_vid_list, feeds))
+        outs = []
+        for replay, fvids in replays:
+            missing = [v for v in fvids if v not in feed_env]
+            if missing:
+                raise ValueError(
+                    f"fetch needs feed vids {missing} not among feed_vars")
+            outs.append(replay(feed_env, params))
+        return tuple(outs)
+
+    # dynamic (-1/None) feed dims export shape-polymorphically so the
+    # artifact serves any batch size (ref: the -1 dims a ProgramDesc keeps)
+    n_dyn = 0
+    dim_strs = []
+    for v in feed_vars:
+        ds = []
+        for d in v._sym_shape:
+            if d < 0:
+                ds.append(f"_dyn{n_dyn}")
+                n_dyn += 1
+            else:
+                ds.append(str(d))
+        dim_strs.append(",".join(ds) if ds else "")
+    if n_dyn:
+        scope_sym = jax.export.SymbolicScope()
+        feed_specs = [
+            jax.ShapeDtypeStruct(
+                jax.export.symbolic_shape(s, scope=scope_sym) if s else (),
+                v._data.dtype)
+            for s, v in zip(dim_strs, feed_vars)]
+    else:
+        feed_specs = [jax.ShapeDtypeStruct(tuple(v._data.shape),
+                                           v._data.dtype)
+                      for v in feed_vars]
+    param_specs = {k: jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
+                   for k, v in params.items()}
+    exported = jax.export.export(jax.jit(infer_fn))(param_specs, *feed_specs)
+    blob = exported.serialize()
+
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(blob)
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        pickle.dump({"params": {k: np.asarray(v) for k, v in params.items()},
+                     "feed_names": [v.name for v in feed_vars],
+                     "fetch_names": [v.name for v in fetch_vars]}, f)
+
+
+class _LoadedInferenceProgram:
+    """Deserialized StableHLO artifact, runnable via Executor.run."""
+
+    def __init__(self, exported, params, feed_names, fetch_names):
+        self.exported = exported
+        self.params = params
+        self.feed_names = feed_names
+        self.fetch_names = fetch_names
+
+    def __call__(self, *feeds):
+        return self.exported.call(self.params, *feeds)
+
+
+def load_inference_model(path_prefix, executor=None):
+    """Returns (program, feed_names, fetch_names); run the program with
+    ``program(*feed_arrays)`` or through ``Executor.run``."""
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        exported = jax.export.deserialize(bytearray(f.read()))
+    with open(path_prefix + ".pdiparams", "rb") as f:
+        meta = pickle.load(f)
+    params = {k: jnp.asarray(v) for k, v in meta["params"].items()}
+    prog = _LoadedInferenceProgram(exported, params, meta["feed_names"],
+                                   meta["fetch_names"])
+    return prog, meta["feed_names"], meta["fetch_names"]

@@ -1,0 +1,85 @@
+"""``paddle.static.nn`` layer functions (ref: ``python/paddle/static/nn/``).
+
+In the reference these emit OpDescs + create persistable params in the
+startup program. Here each call instantiates the matching ``paddle_tpu.nn``
+layer (whose parameters are eager Tensors, auto-registered into the Scope
+when an op touches them) and applies it to the symbolic input — one code
+path for dygraph and static, the design the reference converged toward.
+"""
+from __future__ import annotations
+
+import importlib
+
+
+def __getattr__(name):  # lazy so static can import before paddle_tpu.nn
+    raise AttributeError(name)
+
+
+def _nn_mod():
+    return importlib.import_module("paddle_tpu.nn")
+
+
+__all__ = ["fc", "conv2d", "batch_norm", "embedding", "conv2d_transpose"]
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    in_features = 1
+    for d in x.shape[num_flatten_dims:]:
+        if d < 0:
+            raise ValueError("fc needs static non-batch dims")
+        in_features *= d
+    if num_flatten_dims != 1 or len(x.shape) > 2:
+        from ..ops.manipulation import reshape
+        x = reshape(x, [-1 if x.shape[0] < 0 else x.shape[0], in_features]) \
+            if len(x.shape) != 2 else x
+    layer = _nn_mod().Linear(in_features, size,
+                       weight_attr=weight_attr, bias_attr=bias_attr)
+    out = layer(x)
+    if activation:
+        import paddle_tpu.nn.functional as F
+        out = getattr(F, activation)(out)
+    return out
+
+
+def conv2d(x, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, weight_attr=None, bias_attr=None, name=None,
+           act=None, data_format="NCHW"):
+    in_channels = x.shape[1] if data_format == "NCHW" else x.shape[-1]
+    layer = _nn_mod().Conv2D(in_channels, num_filters, filter_size, stride=stride,
+                       padding=padding, dilation=dilation, groups=groups,
+                       weight_attr=weight_attr, bias_attr=bias_attr,
+                       data_format=data_format)
+    out = layer(x)
+    if act:
+        import paddle_tpu.nn.functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv2d_transpose(x, num_filters, filter_size, stride=1, padding=0,
+                     weight_attr=None, bias_attr=None, name=None,
+                     data_format="NCHW"):
+    in_channels = x.shape[1] if data_format == "NCHW" else x.shape[-1]
+    layer = _nn_mod().Conv2DTranspose(in_channels, num_filters, filter_size,
+                                stride=stride, padding=padding,
+                                weight_attr=weight_attr, bias_attr=bias_attr,
+                                data_format=data_format)
+    return layer(x)
+
+
+def batch_norm(x, momentum=0.9, epsilon=1e-5, data_layout="NCHW",
+               is_test=False, name=None):
+    ch = x.shape[1] if data_layout == "NCHW" else x.shape[-1]
+    layer = _nn_mod().BatchNorm2D(ch, momentum=momentum, epsilon=epsilon,
+                            data_format=data_layout)
+    if is_test:
+        layer.eval()
+    return layer(x)
+
+
+def embedding(input, size, weight_attr=None, is_sparse=False,
+              padding_idx=None, name=None):
+    layer = _nn_mod().Embedding(size[0], size[1], padding_idx=padding_idx,
+                          weight_attr=weight_attr)
+    return layer(input)
