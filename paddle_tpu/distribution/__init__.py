@@ -1,0 +1,38 @@
+"""``paddle.distribution`` — probability distributions.
+
+TPU-native re-design of the reference package
+(``python/paddle/distribution/``, 5,994 LoC): the same class surface
+(Distribution base, 15+ families, Transform algebra,
+TransformedDistribution, Independent, kl_divergence registry), with every
+density/sampler expressed as pure jax — samples draw counter-folded threefry
+keys (``paddle_tpu.framework.random``), so sampling composes with jit/pjit
+instead of relying on a stateful Philox generator
+(``paddle/phi/core/generator.cc``).
+"""
+from .distribution import Distribution  # noqa: F401
+from .families import (  # noqa: F401
+    Normal, Uniform, Bernoulli, Categorical, Beta, Dirichlet, Exponential,
+    Gamma, Geometric, Gumbel, Laplace, LogNormal, Multinomial, Poisson,
+    Cauchy, StudentT, Binomial, ContinuousBernoulli, ExponentialFamily,
+)
+from .transform import (  # noqa: F401
+    Transform, AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    IndependentTransform, PowerTransform, ReshapeTransform,
+    SigmoidTransform, SoftmaxTransform, StackTransform, StickBreakingTransform,
+    TanhTransform,
+)
+from .transformed_distribution import TransformedDistribution  # noqa: F401
+from .independent import Independent  # noqa: F401
+from .kl import kl_divergence, register_kl  # noqa: F401
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Bernoulli", "Categorical", "Beta",
+    "Dirichlet", "Exponential", "Gamma", "Geometric", "Gumbel", "Laplace",
+    "LogNormal", "Multinomial", "Poisson", "Cauchy", "StudentT", "Binomial",
+    "ContinuousBernoulli", "ExponentialFamily", "Transform", "AbsTransform",
+    "AffineTransform", "ChainTransform", "ExpTransform",
+    "IndependentTransform", "PowerTransform", "ReshapeTransform",
+    "SigmoidTransform", "SoftmaxTransform", "StackTransform",
+    "StickBreakingTransform", "TanhTransform", "TransformedDistribution",
+    "Independent", "kl_divergence", "register_kl",
+]

@@ -1,0 +1,107 @@
+"""Distribution base class (ref: ``python/paddle/distribution/
+distribution.py`` Distribution)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ..framework.random import next_key
+
+__all__ = ["Distribution"]
+
+
+def _as_array(x, dtype=None):
+    if isinstance(x, Tensor):
+        x = x._data
+    a = jnp.asarray(x)
+    if a.dtype == jnp.float64:
+        a = a.astype(jnp.float32)
+    if dtype is not None:
+        a = a.astype(dtype)
+    if a.dtype in (jnp.int32, jnp.int64) and dtype is None:
+        a = a.astype(jnp.float32)
+    return a
+
+
+def _wrap(x):
+    return Tensor(x)
+
+
+class Distribution:
+    """Base of all distributions; subclasses implement the pure-jax
+    ``_sample(key, shape)`` / ``_log_prob(value)`` kernels and declare
+    ``batch_shape`` / ``event_shape``."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(int(d) for d in batch_shape)
+        self._event_shape = tuple(int(d) for d in event_shape)
+
+    # -- shapes -------------------------------------------------------------
+    @property
+    def batch_shape(self):
+        return list(self._batch_shape)
+
+    @property
+    def event_shape(self):
+        return list(self._event_shape)
+
+    def _extend_shape(self, sample_shape):
+        return tuple(sample_shape) + self._batch_shape + self._event_shape
+
+    # -- core API -----------------------------------------------------------
+    def sample(self, shape=()):
+        """Draw without gradients."""
+        return _wrap(jax.lax.stop_gradient(
+            self._sample(next_key(), tuple(int(s) for s in shape))))
+
+    def rsample(self, shape=()):
+        """Reparameterized draw (gradients flow where supported)."""
+        return _wrap(self._rsample(next_key(), tuple(int(s) for s in shape)))
+
+    def log_prob(self, value):
+        return _wrap(self._log_prob(_as_array(value)))
+
+    def prob(self, value):
+        return _wrap(jnp.exp(self._log_prob(_as_array(value))))
+
+    def entropy(self):
+        return _wrap(self._entropy())
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+    # -- hooks ---------------------------------------------------------------
+    def _sample(self, key, shape):
+        return self._rsample(key, shape)
+
+    def _rsample(self, key, shape):
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support rsample")
+
+    def _log_prob(self, value):
+        raise NotImplementedError
+
+    def _entropy(self):
+        raise NotImplementedError
+
+    # -- moments (optional per family) ---------------------------------------
+    @property
+    def mean(self):
+        return _wrap(self._mean())
+
+    @property
+    def variance(self):
+        return _wrap(self._variance())
+
+    @property
+    def stddev(self):
+        return _wrap(jnp.sqrt(self._variance()))
+
+    def _mean(self):
+        raise NotImplementedError
+
+    def _variance(self):
+        raise NotImplementedError

@@ -1,0 +1,551 @@
+"""Distribution families (ref: ``python/paddle/distribution/{normal,uniform,
+bernoulli,categorical,beta,dirichlet,exponential_family,geometric,gumbel,
+laplace,lognormal,multinomial,cauchy}.py`` + incubate families).
+
+Samplers use jax.random primitives; densities are closed-form jnp. All are
+pure (jit/vmap/grad-compatible) — the gradient-through-sampling story
+(rsample) comes from reparameterization, not the reference's
+per-op CUDA samplers.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import random as jr
+from jax.scipy import special as jsp
+
+from .distribution import Distribution, _as_array, _wrap
+
+__all__ = [
+    "Normal", "Uniform", "Bernoulli", "Categorical", "Beta", "Dirichlet",
+    "Exponential", "Gamma", "Geometric", "Gumbel", "Laplace", "LogNormal",
+    "Multinomial", "Poisson", "Cauchy", "StudentT", "Binomial",
+    "ContinuousBernoulli", "ExponentialFamily",
+]
+
+
+class ExponentialFamily(Distribution):
+    """Marker base (ref: exponential_family.py); entropy via Bregman
+    divergence is replaced by closed forms in each family."""
+
+
+def _bcast_shape(*arrs):
+    return jnp.broadcast_shapes(*(a.shape for a in arrs))
+
+
+class Normal(ExponentialFamily):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_array(loc)
+        self.scale = _as_array(scale)
+        super().__init__(_bcast_shape(self.loc, self.scale))
+
+    def _rsample(self, key, shape):
+        full = shape + self._batch_shape
+        return self.loc + self.scale * jr.normal(key, full,
+                                                 dtype=self.loc.dtype)
+
+    def _log_prob(self, v):
+        var = self.scale ** 2
+        return (-((v - self.loc) ** 2) / (2 * var)
+                - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def _entropy(self):
+        return jnp.broadcast_to(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale),
+            self._batch_shape)
+
+    def _mean(self):
+        return jnp.broadcast_to(self.loc, self._batch_shape)
+
+    def _variance(self):
+        return jnp.broadcast_to(self.scale ** 2, self._batch_shape)
+
+    def cdf(self, value):
+        v = _as_array(value)
+        return _wrap(0.5 * (1 + jsp.erf((v - self.loc) /
+                                        (self.scale * math.sqrt(2)))))
+
+    def icdf(self, value):
+        v = _as_array(value)
+        return _wrap(self.loc + self.scale * math.sqrt(2)
+                     * jsp.erfinv(2 * v - 1))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _as_array(low)
+        self.high = _as_array(high)
+        super().__init__(_bcast_shape(self.low, self.high))
+
+    def _rsample(self, key, shape):
+        full = shape + self._batch_shape
+        u = jr.uniform(key, full, dtype=self.low.dtype)
+        return self.low + (self.high - self.low) * u
+
+    def _log_prob(self, v):
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return jnp.where(inside, lp, -jnp.inf)
+
+    def _entropy(self):
+        return jnp.broadcast_to(jnp.log(self.high - self.low),
+                                self._batch_shape)
+
+    def _mean(self):
+        return jnp.broadcast_to((self.low + self.high) / 2,
+                                self._batch_shape)
+
+    def _variance(self):
+        return jnp.broadcast_to((self.high - self.low) ** 2 / 12,
+                                self._batch_shape)
+
+
+class Bernoulli(ExponentialFamily):
+    def __init__(self, probs=None, logits=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if probs is not None:
+            self.probs = _as_array(probs)
+            self.logits = jnp.log(self.probs) - jnp.log1p(-self.probs)
+        else:
+            self.logits = _as_array(logits)
+            self.probs = jax.nn.sigmoid(self.logits)
+        super().__init__(self.probs.shape)
+
+    def _sample(self, key, shape):
+        full = shape + self._batch_shape
+        return jr.bernoulli(key, self.probs, full).astype(self.probs.dtype)
+
+    def _log_prob(self, v):
+        return v * jax.nn.log_sigmoid(self.logits) + \
+            (1 - v) * jax.nn.log_sigmoid(-self.logits)
+
+    def _entropy(self):
+        p = self.probs
+        return -(p * jnp.log(jnp.clip(p, 1e-37)) +
+                 (1 - p) * jnp.log(jnp.clip(1 - p, 1e-37)))
+
+    def _mean(self):
+        return self.probs
+
+    def _variance(self):
+        return self.probs * (1 - self.probs)
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is None and probs is None:
+            raise ValueError("pass logits or probs")
+        if logits is not None:
+            # the reference's Categorical(logits) treats input as
+            # UNNORMALIZED nonnegative weights only in legacy mode; modern
+            # semantics: logits are log-weights
+            self.logits = _as_array(logits)
+            self._log_p = jax.nn.log_softmax(self.logits, axis=-1)
+        else:
+            p = _as_array(probs)
+            self._log_p = jnp.log(p / p.sum(-1, keepdims=True))
+            self.logits = self._log_p
+        super().__init__(self._log_p.shape[:-1])
+        self._n = self._log_p.shape[-1]
+
+    def _sample(self, key, shape):
+        full = shape + self._batch_shape
+        return jr.categorical(key, self._log_p, shape=full)
+
+    def _log_prob(self, v):
+        idx = v.astype(jnp.int32)
+        return jnp.take_along_axis(
+            jnp.broadcast_to(self._log_p, idx.shape + (self._n,)),
+            idx[..., None], axis=-1)[..., 0]
+
+    def _entropy(self):
+        p = jnp.exp(self._log_p)
+        return -(p * self._log_p).sum(-1)
+
+    @property
+    def probs_tensor(self):
+        return _wrap(jnp.exp(self._log_p))
+
+
+class Beta(ExponentialFamily):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _as_array(alpha)
+        self.beta = _as_array(beta)
+        super().__init__(_bcast_shape(self.alpha, self.beta))
+
+    def _rsample(self, key, shape):
+        full = shape + self._batch_shape
+        return jr.beta(key, self.alpha, self.beta, full)
+
+    def _log_prob(self, v):
+        a, b = self.alpha, self.beta
+        return ((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+                - (jsp.gammaln(a) + jsp.gammaln(b) - jsp.gammaln(a + b)))
+
+    def _entropy(self):
+        a, b = self.alpha, self.beta
+        return (jsp.gammaln(a) + jsp.gammaln(b) - jsp.gammaln(a + b)
+                - (a - 1) * jsp.digamma(a) - (b - 1) * jsp.digamma(b)
+                + (a + b - 2) * jsp.digamma(a + b))
+
+    def _mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    def _variance(self):
+        s = self.alpha + self.beta
+        return self.alpha * self.beta / (s ** 2 * (s + 1))
+
+
+class Dirichlet(ExponentialFamily):
+    def __init__(self, concentration, name=None):
+        self.concentration = _as_array(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    def _rsample(self, key, shape):
+        full = shape + self._batch_shape
+        return jr.dirichlet(key, self.concentration, full)
+
+    def _log_prob(self, v):
+        c = self.concentration
+        return (((c - 1) * jnp.log(v)).sum(-1)
+                + jsp.gammaln(c.sum(-1)) - jsp.gammaln(c).sum(-1))
+
+    def _entropy(self):
+        c = self.concentration
+        c0 = c.sum(-1)
+        k = c.shape[-1]
+        lnB = jsp.gammaln(c).sum(-1) - jsp.gammaln(c0)
+        return (lnB + (c0 - k) * jsp.digamma(c0)
+                - ((c - 1) * jsp.digamma(c)).sum(-1))
+
+    def _mean(self):
+        return self.concentration / self.concentration.sum(-1, keepdims=True)
+
+    def _variance(self):
+        c = self.concentration
+        c0 = c.sum(-1, keepdims=True)
+        a = c / c0
+        return a * (1 - a) / (c0 + 1)
+
+
+class Exponential(ExponentialFamily):
+    def __init__(self, rate, name=None):
+        self.rate = _as_array(rate)
+        super().__init__(self.rate.shape)
+
+    def _rsample(self, key, shape):
+        full = shape + self._batch_shape
+        return jr.exponential(key, full, dtype=self.rate.dtype) / self.rate
+
+    def _log_prob(self, v):
+        return jnp.log(self.rate) - self.rate * v
+
+    def _entropy(self):
+        return 1 - jnp.log(self.rate)
+
+    def _mean(self):
+        return 1 / self.rate
+
+    def _variance(self):
+        return 1 / self.rate ** 2
+
+
+class Gamma(ExponentialFamily):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _as_array(concentration)
+        self.rate = _as_array(rate)
+        super().__init__(_bcast_shape(self.concentration, self.rate))
+
+    def _rsample(self, key, shape):
+        full = shape + self._batch_shape
+        return jr.gamma(key, self.concentration, full) / self.rate
+
+    def _log_prob(self, v):
+        a, b = self.concentration, self.rate
+        return (a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+                - jsp.gammaln(a))
+
+    def _entropy(self):
+        a, b = self.concentration, self.rate
+        return (a - jnp.log(b) + jsp.gammaln(a)
+                + (1 - a) * jsp.digamma(a))
+
+    def _mean(self):
+        return self.concentration / self.rate
+
+    def _variance(self):
+        return self.concentration / self.rate ** 2
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k in {0,1,2,...} (ref geometric.py)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _as_array(probs)
+        super().__init__(self.probs.shape)
+
+    def _sample(self, key, shape):
+        full = shape + self._batch_shape
+        u = jr.uniform(key, full, dtype=self.probs.dtype, minval=1e-7)
+        return jnp.floor(jnp.log(u) / jnp.log1p(-self.probs))
+
+    def _log_prob(self, v):
+        return v * jnp.log1p(-self.probs) + jnp.log(self.probs)
+
+    def _entropy(self):
+        p = self.probs
+        return -((1 - p) * jnp.log1p(-p) + p * jnp.log(p)) / p
+
+    def _mean(self):
+        return (1 - self.probs) / self.probs
+
+    def _variance(self):
+        return (1 - self.probs) / self.probs ** 2
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_array(loc)
+        self.scale = _as_array(scale)
+        super().__init__(_bcast_shape(self.loc, self.scale))
+
+    def _rsample(self, key, shape):
+        full = shape + self._batch_shape
+        return self.loc + self.scale * jr.gumbel(key, full,
+                                                 dtype=self.loc.dtype)
+
+    def _log_prob(self, v):
+        z = (v - self.loc) / self.scale
+        return -(z + jnp.exp(-z)) - jnp.log(self.scale)
+
+    def _entropy(self):
+        return jnp.broadcast_to(jnp.log(self.scale) + 1 + float(np.euler_gamma),
+                                self._batch_shape)
+
+    def _mean(self):
+        return jnp.broadcast_to(self.loc + self.scale * float(np.euler_gamma),
+                                self._batch_shape)
+
+    def _variance(self):
+        return jnp.broadcast_to((math.pi ** 2 / 6) * self.scale ** 2,
+                                self._batch_shape)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_array(loc)
+        self.scale = _as_array(scale)
+        super().__init__(_bcast_shape(self.loc, self.scale))
+
+    def _rsample(self, key, shape):
+        full = shape + self._batch_shape
+        return self.loc + self.scale * jr.laplace(key, full,
+                                                  dtype=self.loc.dtype)
+
+    def _log_prob(self, v):
+        return -jnp.abs(v - self.loc) / self.scale - jnp.log(2 * self.scale)
+
+    def _entropy(self):
+        return jnp.broadcast_to(1 + jnp.log(2 * self.scale),
+                                self._batch_shape)
+
+    def _mean(self):
+        return jnp.broadcast_to(self.loc, self._batch_shape)
+
+    def _variance(self):
+        return jnp.broadcast_to(2 * self.scale ** 2, self._batch_shape)
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_array(loc)
+        self.scale = _as_array(scale)
+        self._base = Normal(loc, scale)
+        super().__init__(_bcast_shape(self.loc, self.scale))
+
+    def _rsample(self, key, shape):
+        return jnp.exp(self._base._rsample(key, shape))
+
+    def _log_prob(self, v):
+        return self._base._log_prob(jnp.log(v)) - jnp.log(v)
+
+    def _entropy(self):
+        return self._base._entropy() + self.loc
+
+    def _mean(self):
+        return jnp.exp(self.loc + self.scale ** 2 / 2)
+
+    def _variance(self):
+        s2 = self.scale ** 2
+        return (jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2)
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _as_array(probs)
+        self.probs = self.probs / self.probs.sum(-1, keepdims=True)
+        super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
+
+    def _sample(self, key, shape):
+        full = shape + self._batch_shape
+        logits = jnp.log(self.probs)
+        draws = jr.categorical(key, logits,
+                               shape=(self.total_count,) + full)
+        k = self.probs.shape[-1]
+        one_hot = jax.nn.one_hot(draws, k, dtype=self.probs.dtype)
+        return one_hot.sum(0)
+
+    def _log_prob(self, v):
+        logits = jnp.log(self.probs)
+        return (jsp.gammaln(self.total_count + 1.0)
+                - jsp.gammaln(v + 1.0).sum(-1)
+                + (v * logits).sum(-1))
+
+    def _mean(self):
+        return self.total_count * self.probs
+
+    def _variance(self):
+        return self.total_count * self.probs * (1 - self.probs)
+
+
+class Poisson(ExponentialFamily):
+    def __init__(self, rate, name=None):
+        self.rate = _as_array(rate)
+        super().__init__(self.rate.shape)
+
+    def _sample(self, key, shape):
+        full = shape + self._batch_shape
+        return jr.poisson(key, self.rate, full).astype(self.rate.dtype)
+
+    def _log_prob(self, v):
+        return v * jnp.log(self.rate) - self.rate - jsp.gammaln(v + 1)
+
+    def _mean(self):
+        return self.rate
+
+    def _variance(self):
+        return self.rate
+
+    def _entropy(self):
+        # series approximation (exact only asymptotically), matching the
+        # reference's numeric approach
+        r = self.rate
+        return (0.5 * jnp.log(2 * math.pi * math.e * r)
+                - 1 / (12 * r) - 1 / (24 * r ** 2))
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_array(loc)
+        self.scale = _as_array(scale)
+        super().__init__(_bcast_shape(self.loc, self.scale))
+
+    def _rsample(self, key, shape):
+        full = shape + self._batch_shape
+        return self.loc + self.scale * jr.cauchy(key, full,
+                                                 dtype=self.loc.dtype)
+
+    def _log_prob(self, v):
+        z = (v - self.loc) / self.scale
+        return -jnp.log(math.pi * self.scale * (1 + z ** 2))
+
+    def _entropy(self):
+        return jnp.broadcast_to(jnp.log(4 * math.pi * self.scale),
+                                self._batch_shape)
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _as_array(df)
+        self.loc = _as_array(loc)
+        self.scale = _as_array(scale)
+        super().__init__(_bcast_shape(self.df, self.loc, self.scale))
+
+    def _rsample(self, key, shape):
+        full = shape + self._batch_shape
+        return self.loc + self.scale * jr.t(key, self.df, full)
+
+    def _log_prob(self, v):
+        d, z = self.df, (v - self.loc) / self.scale
+        return (jsp.gammaln((d + 1) / 2) - jsp.gammaln(d / 2)
+                - 0.5 * jnp.log(d * math.pi) - jnp.log(self.scale)
+                - (d + 1) / 2 * jnp.log1p(z ** 2 / d))
+
+    def _mean(self):
+        return jnp.where(self.df > 1, self.loc, jnp.nan)
+
+    def _variance(self):
+        d = self.df
+        return jnp.where(d > 2, self.scale ** 2 * d / (d - 2),
+                         jnp.where(d > 1, jnp.inf, jnp.nan))
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _as_array(probs)
+        super().__init__(self.probs.shape)
+
+    def _sample(self, key, shape):
+        full = shape + self._batch_shape
+        u = jr.uniform(key, (self.total_count,) + full,
+                       dtype=self.probs.dtype)
+        return (u < self.probs).astype(self.probs.dtype).sum(0)
+
+    def _log_prob(self, v):
+        n, p = self.total_count, self.probs
+        return (jsp.gammaln(n + 1.0) - jsp.gammaln(v + 1.0)
+                - jsp.gammaln(n - v + 1.0)
+                + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+
+    def _mean(self):
+        return self.total_count * self.probs
+
+    def _variance(self):
+        return self.total_count * self.probs * (1 - self.probs)
+
+
+class ContinuousBernoulli(Distribution):
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = _as_array(probs)
+        self._lims = lims
+        super().__init__(self.probs.shape)
+
+    def _log_norm_const(self):
+        p = self.probs
+        safe = jnp.where((p < self._lims[0]) | (p > self._lims[1]),
+                         p, self._lims[0] - 1e-2)
+        c = jnp.log((2 * jnp.arctanh(1 - 2 * safe)) / (1 - 2 * safe))
+        taylor = math.log(2.0) + 4 / 3 * (p - 0.5) ** 2
+        return jnp.where((p < self._lims[0]) | (p > self._lims[1]), c,
+                         taylor)
+
+    def _log_prob(self, v):
+        p = self.probs
+        return (v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+                + self._log_norm_const())
+
+    def _rsample(self, key, shape):
+        full = shape + self._batch_shape
+        u = jr.uniform(key, full, dtype=self.probs.dtype, minval=1e-6,
+                       maxval=1 - 1e-6)
+        p = self.probs
+        safe = jnp.where((p < self._lims[0]) | (p > self._lims[1]),
+                         p, self._lims[0] - 1e-2)
+        icdf = (jnp.log1p(u * (2 * safe - 1) / (1 - safe))
+                / (jnp.log(safe) - jnp.log1p(-safe)))
+        return jnp.where((p < self._lims[0]) | (p > self._lims[1]), icdf, u)
+
+    def _mean(self):
+        p = self.probs
+        safe = jnp.where((p < self._lims[0]) | (p > self._lims[1]),
+                         p, self._lims[0] - 1e-2)
+        m = safe / (2 * safe - 1) + 1 / (2 * jnp.arctanh(1 - 2 * safe))
+        return jnp.where((p < self._lims[0]) | (p > self._lims[1]), m,
+                         0.5 + (p - 0.5) / 3)

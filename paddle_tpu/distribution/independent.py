@@ -1,0 +1,44 @@
+"""Independent (ref: ``python/paddle/distribution/independent.py``):
+reinterprets trailing batch dims as event dims."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .distribution import Distribution
+
+__all__ = ["Independent"]
+
+
+class Independent(Distribution):
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        b = tuple(base.batch_shape)
+        if self.rank > len(b):
+            raise ValueError("reinterpreted_batch_rank exceeds batch rank")
+        super().__init__(b[:len(b) - self.rank],
+                         b[len(b) - self.rank:] + tuple(base.event_shape))
+
+    def _sample(self, key, shape):
+        return self.base._sample(key, shape)
+
+    def _rsample(self, key, shape):
+        return self.base._rsample(key, shape)
+
+    def _log_prob(self, value):
+        lp = self.base._log_prob(value)
+        if self.rank:
+            lp = lp.sum(axis=tuple(range(-self.rank, 0)))
+        return lp
+
+    def _entropy(self):
+        e = self.base._entropy()
+        if self.rank:
+            e = e.sum(axis=tuple(range(-self.rank, 0)))
+        return e
+
+    def _mean(self):
+        return self.base._mean()
+
+    def _variance(self):
+        return self.base._variance()

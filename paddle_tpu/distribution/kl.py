@@ -1,0 +1,159 @@
+"""KL divergence registry (ref: ``python/paddle/distribution/kl.py``
+_REGISTER_TABLE / register_kl / kl_divergence with MRO-closest match)."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from .distribution import Distribution, _wrap
+from . import families as F
+from .independent import Independent
+
+__all__ = ["kl_divergence", "register_kl"]
+
+_REGISTER_TABLE: dict = {}
+
+
+def register_kl(cls_p, cls_q):
+    if not (issubclass(cls_p, Distribution)
+            and issubclass(cls_q, Distribution)):
+        raise TypeError("cls_p and cls_q must be Distribution subclasses")
+
+    def deco(f):
+        _REGISTER_TABLE[cls_p, cls_q] = f
+        return f
+
+    return deco
+
+
+def _dispatch(type_p, type_q):
+    matches = [(p, q) for (p, q) in _REGISTER_TABLE
+               if issubclass(type_p, p) and issubclass(type_q, q)]
+    if not matches:
+        raise NotImplementedError(
+            f"no KL registered for ({type_p.__name__}, {type_q.__name__})")
+
+    def total_order(pair):
+        p, q = pair
+        return (sum(issubclass(op, p) for (op, _) in matches),
+                sum(issubclass(oq, q) for (_, oq) in matches))
+
+    best = min(matches, key=total_order)
+    return _REGISTER_TABLE[best]
+
+
+def kl_divergence(p, q):
+    """``paddle.distribution.kl_divergence``."""
+    return _wrap(_dispatch(type(p), type(q))(p, q))
+
+
+# -- closed forms ------------------------------------------------------------
+@register_kl(F.Normal, F.Normal)
+def _kl_normal(p, q):
+    vr = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return 0.5 * (vr + t1 - 1 - jnp.log(vr))
+
+
+@register_kl(F.Uniform, F.Uniform)
+def _kl_uniform(p, q):
+    return jnp.log((q.high - q.low) / (p.high - p.low))
+
+
+@register_kl(F.Bernoulli, F.Bernoulli)
+def _kl_bernoulli(p, q):
+    a, b = p.probs, q.probs
+    eps = 1e-7
+    a = jnp.clip(a, eps, 1 - eps)
+    b = jnp.clip(b, eps, 1 - eps)
+    return a * (jnp.log(a) - jnp.log(b)) + \
+        (1 - a) * (jnp.log1p(-a) - jnp.log1p(-b))
+
+
+@register_kl(F.Categorical, F.Categorical)
+def _kl_categorical(p, q):
+    pp = jnp.exp(p._log_p)
+    return (pp * (p._log_p - q._log_p)).sum(-1)
+
+
+@register_kl(F.Beta, F.Beta)
+def _kl_beta(p, q):
+    sp = p.alpha + p.beta
+    return (jsp.gammaln(sp) - jsp.gammaln(p.alpha) - jsp.gammaln(p.beta)
+            - jsp.gammaln(q.alpha + q.beta) + jsp.gammaln(q.alpha)
+            + jsp.gammaln(q.beta)
+            + (p.alpha - q.alpha) * (jsp.digamma(p.alpha) - jsp.digamma(sp))
+            + (p.beta - q.beta) * (jsp.digamma(p.beta) - jsp.digamma(sp)))
+
+
+@register_kl(F.Dirichlet, F.Dirichlet)
+def _kl_dirichlet(p, q):
+    a, b = p.concentration, q.concentration
+    a0 = a.sum(-1)
+    return (jsp.gammaln(a0) - jsp.gammaln(a).sum(-1)
+            - jsp.gammaln(b.sum(-1)) + jsp.gammaln(b).sum(-1)
+            + ((a - b) * (jsp.digamma(a)
+                          - jsp.digamma(a0)[..., None])).sum(-1))
+
+
+@register_kl(F.Exponential, F.Exponential)
+def _kl_exponential(p, q):
+    r = q.rate / p.rate
+    return jnp.log(p.rate) - jnp.log(q.rate) + r - 1
+
+
+@register_kl(F.Gamma, F.Gamma)
+def _kl_gamma(p, q):
+    return ((p.concentration - q.concentration) * jsp.digamma(p.concentration)
+            - jsp.gammaln(p.concentration) + jsp.gammaln(q.concentration)
+            + q.concentration * (jnp.log(p.rate) - jnp.log(q.rate))
+            + p.concentration * (q.rate / p.rate - 1))
+
+
+@register_kl(F.Geometric, F.Geometric)
+def _kl_geometric(p, q):
+    return (-p._entropy()
+            - jnp.log(q.probs) - (1 - p.probs) / p.probs
+            * jnp.log1p(-q.probs))
+
+
+@register_kl(F.Laplace, F.Laplace)
+def _kl_laplace(p, q):
+    # log(b2/b1) + |u1-u2|/b2 + (b1/b2) exp(-|u1-u2|/b1) - 1
+    d = jnp.abs(p.loc - q.loc)
+    return (jnp.log(q.scale) - jnp.log(p.scale) + d / q.scale
+            + (p.scale / q.scale) * jnp.exp(-d / p.scale) - 1)
+
+
+@register_kl(F.Poisson, F.Poisson)
+def _kl_poisson(p, q):
+    return p.rate * (jnp.log(p.rate) - jnp.log(q.rate)) - p.rate + q.rate
+
+
+@register_kl(F.LogNormal, F.LogNormal)
+def _kl_lognormal(p, q):
+    return _kl_normal(p._base, q._base)
+
+
+@register_kl(F.Gumbel, F.Gumbel)
+def _kl_gumbel(p, q):
+    # log(b2/b1) + g*(b1/b2 - 1) + (u1-u2)/b2
+    #   + exp((u2-u1)/b2) * Gamma(1 + b1/b2) - 1   (g = Euler-Mascheroni)
+    import numpy as np
+    euler = float(np.euler_gamma)
+    br = p.scale / q.scale
+    dz = (p.loc - q.loc) / q.scale
+    return (jnp.log(q.scale) - jnp.log(p.scale) + euler * (br - 1) + dz
+            + jnp.exp(-dz + jsp.gammaln(1 + br)) - 1)
+
+
+@register_kl(Independent, Independent)
+def _kl_independent(p, q):
+    if p.rank != q.rank:
+        raise NotImplementedError("mismatched reinterpreted ranks")
+    inner = _dispatch(type(p.base), type(q.base))(p.base, q.base)
+    if p.rank:
+        inner = inner.sum(axis=tuple(range(-p.rank, 0)))
+    return inner

@@ -1,0 +1,39 @@
+"""TransformedDistribution (ref: ``python/paddle/distribution/
+transformed_distribution.py``)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .distribution import Distribution, _as_array
+from .transform import Transform, ChainTransform
+
+__all__ = ["TransformedDistribution"]
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base, transforms):
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.base = base
+        self.transform = ChainTransform(list(transforms))
+        shape = tuple(base.batch_shape) + tuple(base.event_shape)
+        out = self.transform.forward_shape(shape)
+        nb = len(base.batch_shape)
+        super().__init__(out[:nb], out[nb:])
+
+    def _sample(self, key, shape):
+        return self.transform._forward(self.base._sample(key, shape))
+
+    def _rsample(self, key, shape):
+        return self.transform._forward(self.base._rsample(key, shape))
+
+    def _log_prob(self, value):
+        x = self.transform._inverse(value)
+        ld = self.transform._fldj(x)
+        base_lp = self.base._log_prob(x)
+        # reduce per-element log-dets over event dims if the base is
+        # scalar-event but the transform didn't reduce
+        if hasattr(ld, "shape") and ld.shape != base_lp.shape \
+                and ld.ndim > base_lp.ndim:
+            ld = ld.sum(axis=tuple(range(base_lp.ndim, ld.ndim)))
+        return base_lp - ld

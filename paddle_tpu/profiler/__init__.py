@@ -1,0 +1,273 @@
+"""``paddle.profiler`` — profiling API.
+
+TPU-native re-design of the reference profiler stack
+(``python/paddle/profiler/profiler.py:349`` Profiler, ``make_scheduler
+:117``, ``export_chrome_tracing :215``; C++ host tracer
+``paddle/fluid/platform/profiler/host_tracer.cc``, CUPTI tracer
+``cuda_tracer.cc``):
+
+ - host spans come from the native core tracer (``paddle_tpu.core``
+   RecordEvent → libptcore), exported as chrome://tracing JSON — the
+   ``chrometracing_logger.cc`` equivalent;
+ - device timing comes from the XLA/jax profiler (xplane protobufs under
+   the logdir, viewable in TensorBoard/XProf) — the CUPTI equivalent;
+ - the state machine (CLOSED/READY/RECORD[_AND_RETURN]) and scheduler
+   semantics match the reference so training-loop integrations carry over.
+"""
+from __future__ import annotations
+
+import enum
+import os
+from typing import Callable, Iterable, Optional
+
+from .. import core as _core
+from ..core import RecordEvent  # noqa: F401  (public, same name as ref)
+from . import utils  # noqa: F401
+
+__all__ = [
+    "Profiler", "ProfilerState", "ProfilerTarget", "make_scheduler",
+    "export_chrome_tracing", "RecordEvent", "load_profiler_result",
+    "SortedKeys", "SummaryView",
+]
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1      # accepted for API parity; maps to the XLA device trace
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+class SortedKeys(enum.Enum):
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    Calls = 4
+
+
+def make_scheduler(*, closed: int, ready: int, record: int,
+                   repeat: int = 0, skip_first: int = 0) -> Callable:
+    """Ref ``profiler.py:117``: per-step state schedule
+    [skip_first][closed][ready][record] x repeat."""
+    period = closed + ready + record
+
+    def schedule(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return schedule
+
+
+def _default_schedule(step: int) -> ProfilerState:
+    return ProfilerState.RECORD
+
+
+def export_chrome_tracing(dir_name: str, worker_name: str | None = None):
+    """Ref ``profiler.py:215``: returns an on_trace_ready callback that dumps
+    chrome://tracing JSON into ``dir_name``."""
+
+    def handle(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"host_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}_step{prof.step_num}.json")
+        _core.tracer_dump(path)
+        prof._exported_paths.append(path)
+
+    return handle
+
+
+def export_protobuf(dir_name: str, worker_name: str | None = None):
+    """Parity alias — the xplane protobufs that jax writes under the logdir
+    are the protobuf export; host spans still dump as chrome JSON."""
+    return export_chrome_tracing(dir_name, worker_name)
+
+
+class _EventStat:
+    __slots__ = ("name", "calls", "total_ns", "max_ns", "min_ns")
+
+    def __init__(self, name):
+        self.name = name
+        self.calls = 0
+        self.total_ns = 0
+        self.max_ns = 0
+        self.min_ns = 1 << 62
+
+    def add(self, dur):
+        self.calls += 1
+        self.total_ns += dur
+        self.max_ns = max(self.max_ns, dur)
+        self.min_ns = min(self.min_ns, dur)
+
+
+class SummaryView:
+    """Aggregated host-event table (the reference's summary printer)."""
+
+    def __init__(self, events):
+        stats: dict[str, _EventStat] = {}
+        for (name, _start, dur, _tid) in events:
+            stats.setdefault(name, _EventStat(name)).add(dur)
+        self.rows = sorted(stats.values(), key=lambda s: -s.total_ns)
+
+    def table(self, sorted_by: SortedKeys = SortedKeys.CPUTotal) -> str:
+        key = {
+            SortedKeys.CPUTotal: lambda s: -s.total_ns,
+            SortedKeys.CPUAvg: lambda s: -(s.total_ns / max(s.calls, 1)),
+            SortedKeys.CPUMax: lambda s: -s.max_ns,
+            SortedKeys.CPUMin: lambda s: s.min_ns,
+            SortedKeys.Calls: lambda s: -s.calls,
+        }[sorted_by]
+        rows = sorted(self.rows, key=key)
+        out = [f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>10}"
+               f"{'Max(ms)':>10}{'Min(ms)':>10}"]
+        out.append("-" * 90)
+        for s in rows:
+            out.append(
+                f"{s.name[:39]:<40}{s.calls:>8}"
+                f"{s.total_ns / 1e6:>12.3f}"
+                f"{s.total_ns / max(s.calls, 1) / 1e6:>10.3f}"
+                f"{s.max_ns / 1e6:>10.3f}"
+                f"{(0 if s.calls == 0 else s.min_ns) / 1e6:>10.3f}")
+        return "\n".join(out)
+
+    def __str__(self):
+        return self.table()
+
+
+class Profiler:
+    """``paddle.profiler.Profiler`` equivalent.
+
+    ``targets`` including a device target also starts the jax/XLA device
+    trace (xplane under ``profile_path``); host spans always record through
+    the native tracer.
+    """
+
+    def __init__(self, *, targets: Optional[Iterable[ProfilerTarget]] = None,
+                 scheduler=None, on_trace_ready=None, timer_only=False,
+                 record_shapes=False, profile_memory=False,
+                 with_flops=False, profile_path="./profiler_log"):
+        self.targets = list(targets) if targets is not None else [
+            ProfilerTarget.CPU]
+        if callable(scheduler):
+            self.scheduler = scheduler
+        elif isinstance(scheduler, (tuple, list)) and len(scheduler) == 2:
+            start, end = scheduler
+            self.scheduler = make_scheduler(
+                closed=max(start, 0), ready=0, record=end - start, repeat=1)
+        elif scheduler is None:
+            self.scheduler = _default_schedule
+        else:
+            raise TypeError("scheduler must be callable, (start, end) or "
+                            "None")
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self.profile_path = profile_path
+        self.step_num = 0
+        self.current_state = ProfilerState.CLOSED
+        self._device_tracing = False
+        self._exported_paths: list[str] = []
+
+    # -- device (XLA) trace ----------------------------------------------
+    def _device_targets(self):
+        return any(t in (ProfilerTarget.GPU, ProfilerTarget.TPU,
+                         ProfilerTarget.CUSTOM_DEVICE)
+                   for t in self.targets)
+
+    def _start_device_trace(self):
+        if self._device_tracing or self.timer_only:
+            return
+        if self._device_targets():
+            try:
+                import jax
+                jax.profiler.start_trace(self.profile_path)
+                self._device_tracing = True
+            except Exception:
+                self._device_tracing = False
+
+    def _stop_device_trace(self):
+        if self._device_tracing:
+            import jax
+            try:
+                jax.profiler.stop_trace()
+            finally:
+                self._device_tracing = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        self.current_state = self.scheduler(self.step_num)
+        if self.current_state != ProfilerState.CLOSED:
+            self._enter_recording()
+
+    def _enter_recording(self):
+        if not self.timer_only:
+            _core.tracer_enable()
+        self._start_device_trace()
+
+    def _exit_recording(self):
+        _core.tracer_disable()
+        self._stop_device_trace()
+
+    def step(self, num_samples=None):
+        prev = self.current_state
+        self.step_num += 1
+        self.current_state = self.scheduler(self.step_num)
+        if prev == ProfilerState.RECORD_AND_RETURN or (
+                prev in (ProfilerState.RECORD,)
+                and self.current_state == ProfilerState.CLOSED):
+            if self.on_trace_ready is not None:
+                self.on_trace_ready(self)
+        if prev == ProfilerState.CLOSED and \
+                self.current_state != ProfilerState.CLOSED:
+            self._enter_recording()
+        elif prev != ProfilerState.CLOSED and \
+                self.current_state == ProfilerState.CLOSED:
+            self._exit_recording()
+
+    def stop(self):
+        if self.current_state != ProfilerState.CLOSED:
+            if self.on_trace_ready is not None:
+                self.on_trace_ready(self)
+            self._exit_recording()
+        self.current_state = ProfilerState.CLOSED
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- results -----------------------------------------------------------
+    def summary(self, sorted_by: SortedKeys = SortedKeys.CPUTotal,
+                op_detail=True, thread_sep=False, time_unit="ms"):
+        view = SummaryView(_core.tracer_events())
+        return view.table(sorted_by)
+
+    def export(self, path: str, format: str = "json"):
+        _core.tracer_dump(path)
+
+
+def load_profiler_result(filename: str):
+    """Load a chrome-tracing JSON exported by this profiler."""
+    import json
+    with open(filename) as f:
+        return json.load(f)

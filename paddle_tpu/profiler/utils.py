@@ -1,0 +1,65 @@
+"""Profiler utilities (ref: ``python/paddle/profiler/utils.py``)."""
+from __future__ import annotations
+
+import functools
+
+from ..core import RecordEvent
+
+__all__ = ["wrap_optimizers", "benchmark", "record_function"]
+
+
+def record_function(name):
+    """Decorator: wrap a function in a host RecordEvent span."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with RecordEvent(name):
+                return fn(*a, **k)
+        return wrapper
+    return deco
+
+
+def wrap_optimizers():
+    """Instrument Optimizer.step with RecordEvent spans (the reference
+    patches optimizer classes the same way)."""
+    from .. import optimizer as opt_mod
+    base = opt_mod.Optimizer
+    if getattr(base, "_profiler_wrapped", False):
+        return
+    orig = base.step
+
+    @functools.wraps(orig)
+    def step(self, *a, **k):
+        with RecordEvent(f"Optimizer.step#{type(self).__name__}"):
+            return orig(self, *a, **k)
+
+    base.step = step
+    base._profiler_wrapped = True
+
+
+class benchmark:
+    """Minimal ips/latency helper (ref ``utils.py`` benchmark context)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._times = []
+
+    def begin(self):
+        import time
+        self._t0 = time.perf_counter()
+
+    def end(self, num_samples=1):
+        import time
+        dt = time.perf_counter() - self._t0
+        self._times.append((dt, num_samples))
+
+    def report(self):
+        if not self._times:
+            return {}
+        total = sum(t for t, _ in self._times)
+        samples = sum(n for _, n in self._times)
+        return {"steps": len(self._times), "total_s": total,
+                "avg_latency_s": total / len(self._times),
+                "ips": samples / total if total else 0.0}

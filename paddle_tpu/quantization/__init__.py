@@ -1,0 +1,37 @@
+"""``paddle.quantization`` — QAT / PTQ.
+
+TPU-native re-design of the reference quantization stack
+(``python/paddle/quantization/``: QuantConfig/QAT/PTQ/observers/quanters,
+imperative fake-quant layers in ``quantization/imperative/``):
+
+ - fake-quant uses the straight-through estimator expressed as
+   ``x + stop_gradient(q(x) - x)`` — AD-framework-native (works under
+   eager vjp, jit and pjit alike), replacing the reference's dedicated
+   fake_quantize CUDA kernels (``paddle/phi/kernels/gpu/quantize_linear*``).
+ - observers are host-side stat trackers (abs-max / moving-average /
+   histogram-percentile), applied per-tensor or per-channel.
+ - int8 simulation: scales from observers, symmetric quant, dequant on the
+   fly — the XLA graph stays bf16/fp32 with quant ops fused in.
+"""
+from .config import QuantConfig  # noqa: F401
+from .observers import (  # noqa: F401
+    BaseObserver, AbsmaxObserver, MovingAverageAbsmaxObserver,
+    HistObserver, PerChannelAbsmaxObserver,
+)
+from .quanters import (  # noqa: F401
+    BaseQuanter, FakeQuanterWithAbsMaxObserver,
+    FakeQuanterChannelWiseAbsMaxObserver, quanter,
+)
+from .qat import QAT  # noqa: F401
+from .ptq import PTQ  # noqa: F401
+from .wrapper import QuantedLinear, QuantedConv2D  # noqa: F401
+from .functional import fake_quant, quant_dequant  # noqa: F401
+
+__all__ = [
+    "QuantConfig", "QAT", "PTQ", "BaseObserver", "AbsmaxObserver",
+    "MovingAverageAbsmaxObserver", "HistObserver",
+    "PerChannelAbsmaxObserver", "BaseQuanter",
+    "FakeQuanterWithAbsMaxObserver", "FakeQuanterChannelWiseAbsMaxObserver",
+    "quanter", "QuantedLinear", "QuantedConv2D", "fake_quant",
+    "quant_dequant",
+]

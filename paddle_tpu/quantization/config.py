@@ -1,0 +1,41 @@
+"""QuantConfig (ref: ``python/paddle/quantization/config.py``): maps layers
+/ layer types to activation+weight quanter prototypes."""
+from __future__ import annotations
+
+__all__ = ["QuantConfig"]
+
+
+class QuantConfig:
+    def __init__(self, activation=None, weight=None):
+        self._default_act = activation
+        self._default_weight = weight
+        self._layer_configs = []   # (predicate, act, weight)
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        ids = {id(l) for l in layers}
+        self._layer_configs.append(
+            (lambda l: id(l) in ids, activation, weight))
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = tuple(layer_type) if isinstance(layer_type, (list, tuple)) \
+            else (layer_type,)
+        self._layer_configs.append(
+            (lambda l: isinstance(l, types), activation, weight))
+
+    def add_name_config(self, layer_name, activation=None, weight=None):
+        names = layer_name if isinstance(layer_name, (list, tuple)) \
+            else [layer_name]
+        self._layer_configs.append(
+            (lambda l: getattr(l, "_full_name", "") in names,
+             activation, weight))
+
+    def config_for(self, layer):
+        """(act_quanter, weight_quanter) prototypes for this layer, or
+        (None, None) if unquantized."""
+        for pred, act, w in self._layer_configs:
+            if pred(layer):
+                return act, w
+        if self._default_act is not None or self._default_weight is not None:
+            return self._default_act, self._default_weight
+        return None, None

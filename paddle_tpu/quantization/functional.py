@@ -1,0 +1,35 @@
+"""Fake-quant primitives with straight-through gradients."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ..ops.op_utils import unary
+
+__all__ = ["fake_quant", "quant_dequant"]
+
+
+def _qdq(d, scale, bit_length, channel_axis=None):
+    bound = float(2 ** (bit_length - 1) - 1)
+    s = jnp.asarray(scale)
+    if channel_axis is not None and s.ndim == 1:
+        shape = [1] * d.ndim
+        shape[channel_axis] = -1
+        s = s.reshape(shape)
+    s = jnp.maximum(s, 1e-9)
+    q = jnp.clip(jnp.round(d / s * bound), -bound, bound) * s / bound
+    # straight-through estimator: identity gradient through the rounding
+    return d + jax.lax.stop_gradient(q - d)
+
+
+def quant_dequant(x, scale, bit_length=8, channel_axis=None):
+    """Simulated symmetric quantize-dequantize (the fake_quantize_dequantize
+    op family, ref ``paddle/phi/kernels/fake_quantize_*``)."""
+    if isinstance(x, Tensor):
+        return unary(lambda d: _qdq(d, scale, bit_length, channel_axis), x,
+                     name="quant_dequant")
+    return _qdq(jnp.asarray(x), scale, bit_length, channel_axis)
+
+
+fake_quant = quant_dequant

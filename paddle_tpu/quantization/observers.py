@@ -1,0 +1,118 @@
+"""Observers: scale statistics collectors (ref:
+``python/paddle/quantization/observers/abs_max.py`` and the imperative
+``moving_average_abs_max``/``hist`` observers)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..tensor import Tensor
+
+__all__ = ["BaseObserver", "AbsmaxObserver", "MovingAverageAbsmaxObserver",
+           "HistObserver", "PerChannelAbsmaxObserver"]
+
+
+def _np(x):
+    return np.asarray(x._data if isinstance(x, Tensor) else x,
+                      dtype=np.float32)
+
+
+class BaseObserver:
+    """Collects statistics on tensors passing through; yields a scale."""
+
+    def __init__(self, quant_bits=8):
+        self.quant_bits = quant_bits
+        self._scale = None
+
+    def observe(self, x):
+        raise NotImplementedError
+
+    def scales(self):
+        return self._scale if self._scale is not None else 1e-9
+
+    def bit_length(self):
+        return self.quant_bits
+
+    def quant_axis(self):
+        return None
+
+    # factory protocol used by QuantConfig
+    def _instance(self, layer):
+        return type(self)(quant_bits=self.quant_bits)
+
+
+class AbsmaxObserver(BaseObserver):
+    def observe(self, x):
+        m = float(np.max(np.abs(_np(x))))
+        self._scale = m if self._scale is None else max(self._scale, m)
+
+
+class MovingAverageAbsmaxObserver(BaseObserver):
+    def __init__(self, quant_bits=8, moving_rate=0.9):
+        super().__init__(quant_bits)
+        self.moving_rate = moving_rate
+
+    def observe(self, x):
+        m = float(np.max(np.abs(_np(x))))
+        if self._scale is None:
+            self._scale = m
+        else:
+            self._scale = (self.moving_rate * self._scale
+                           + (1 - self.moving_rate) * m)
+
+    def _instance(self, layer):
+        return type(self)(quant_bits=self.quant_bits,
+                          moving_rate=self.moving_rate)
+
+
+class HistObserver(BaseObserver):
+    """Percentile-of-histogram scale (a lightweight KL-free calibrator)."""
+
+    def __init__(self, quant_bits=8, bins=2048, percentile=0.999):
+        super().__init__(quant_bits)
+        self.bins = bins
+        self.percentile = percentile
+        self._hist = None
+        self._max = 0.0
+
+    def observe(self, x):
+        a = np.abs(_np(x)).ravel()
+        m = float(a.max()) if a.size else 0.0
+        if self._hist is None or m > self._max:
+            # rebin against the new max
+            self._max = max(m, self._max, 1e-9)
+            hist, _ = np.histogram(a, bins=self.bins,
+                                   range=(0, self._max))
+            if self._hist is None:
+                self._hist = hist.astype(np.float64)
+            else:
+                self._hist += hist
+        else:
+            hist, _ = np.histogram(a, bins=self.bins, range=(0, self._max))
+            self._hist += hist
+        cdf = np.cumsum(self._hist) / self._hist.sum()
+        idx = int(np.searchsorted(cdf, self.percentile))
+        self._scale = (idx + 1) / self.bins * self._max
+
+    def _instance(self, layer):
+        return type(self)(quant_bits=self.quant_bits, bins=self.bins,
+                          percentile=self.percentile)
+
+
+class PerChannelAbsmaxObserver(BaseObserver):
+    def __init__(self, quant_bits=8, quant_axis_=0):
+        super().__init__(quant_bits)
+        self._axis = quant_axis_
+
+    def observe(self, x):
+        a = _np(x)
+        axes = tuple(i for i in range(a.ndim) if i != self._axis)
+        m = np.max(np.abs(a), axis=axes)
+        self._scale = m if self._scale is None else np.maximum(
+            self._scale, m)
+
+    def quant_axis(self):
+        return self._axis
+
+    def _instance(self, layer):
+        return type(self)(quant_bits=self.quant_bits,
+                          quant_axis_=self._axis)

@@ -1,0 +1,74 @@
+"""PTQ driver (ref: ``python/paddle/quantization/ptq.py``): insert
+observers, calibrate on sample batches, convert to quantized weights."""
+from __future__ import annotations
+
+from ..nn.layer.layers import Layer
+from .functional import quant_dequant
+from .wrapper import QuantedLinear, QuantedConv2D
+from .qat import _walk_and_wrap
+
+__all__ = ["PTQ"]
+
+
+class _ObservedLayer(Layer):
+    """Runs the inner layer while observing input activations + weights."""
+
+    def __init__(self, inner, act_observer, weight_observer):
+        super().__init__()
+        self._inner = inner
+        self._act_obs = act_observer
+        self._w_obs = weight_observer
+
+    def forward(self, *args, **kwargs):
+        if self._act_obs is not None and args:
+            self._act_obs.observe(args[0])
+        if self._w_obs is not None and hasattr(self._inner, "weight"):
+            self._w_obs.observe(self._inner.weight)
+        return self._inner(*args, **kwargs)
+
+
+class PTQ:
+    def __init__(self, config):
+        self._config = config
+
+    def quantize(self, model, inplace=False):
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+
+        def make(layer):
+            act_proto, w_proto = self._config.config_for(layer)
+            if act_proto is None and w_proto is None:
+                return None
+            act = act_proto._instance(layer) if act_proto else None
+            w = w_proto._instance(layer) if w_proto else None
+            return _ObservedLayer(layer, act, w)
+
+        return _walk_and_wrap(model, make)
+
+    def convert(self, model, inplace=False):
+        """Apply calibrated scales: quant-dequant weights, attach activation
+        scales for the deploy pass."""
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+
+        def fold(m):
+            for name, sub in list(m._sub_layers.items()):
+                if isinstance(sub, _ObservedLayer):
+                    inner = sub._inner
+                    if sub._w_obs is not None and \
+                            getattr(inner, "weight", None) is not None:
+                        inner.weight.set_value(quant_dequant(
+                            inner.weight, sub._w_obs.scales(),
+                            sub._w_obs.bit_length(),
+                            sub._w_obs.quant_axis()))
+                    if sub._act_obs is not None:
+                        inner.quant_scale = sub._act_obs.scales()
+                        inner.quant_bits = sub._act_obs.bit_length()
+                    m._sub_layers[name] = inner
+                elif sub is not None:
+                    fold(sub)
+
+        fold(model)
+        return model

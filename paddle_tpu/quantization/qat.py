@@ -1,0 +1,73 @@
+"""QAT driver (ref: ``python/paddle/quantization/qat.py`` QAT.quantize /
+convert)."""
+from __future__ import annotations
+
+from .wrapper import wrap_quanted, QuantedLinear, QuantedConv2D
+from .functional import quant_dequant
+
+__all__ = ["QAT"]
+
+
+def _walk_and_wrap(model, make_wrappers):
+    from ..nn.layer.layers import Layer
+    for name, sub in list(model._sub_layers.items()):
+        if sub is None:
+            continue
+        wrapped = make_wrappers(sub)
+        if wrapped is not None:
+            model._sub_layers[name] = wrapped
+        else:
+            _walk_and_wrap(sub, make_wrappers)
+    return model
+
+
+class QAT:
+    def __init__(self, config):
+        self._config = config
+
+    def quantize(self, model, inplace=False):
+        """Insert fake-quant (quanter) wrappers per the QuantConfig."""
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+
+        def make(layer):
+            act_proto, w_proto = self._config.config_for(layer)
+            if act_proto is None and w_proto is None:
+                return None
+            act = act_proto._instance(layer) if act_proto else None
+            w = w_proto._instance(layer) if w_proto else None
+            return wrap_quanted(layer, act, w)
+
+        return _walk_and_wrap(model, make)
+
+    def convert(self, model, inplace=False):
+        """Fold quanters into static scales: weights become
+        quantize-dequantized constants, wrappers collapse to plain layers
+        carrying ``quant_scale`` metadata (the deploy form; ref
+        ``qat.py convert``)."""
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+
+        def fold(m):
+            for name, sub in list(m._sub_layers.items()):
+                if isinstance(sub, (QuantedLinear, QuantedConv2D)):
+                    inner = sub._inner
+                    if sub.weight_quanter is not None:
+                        inner.weight.set_value(quant_dequant(
+                            inner.weight,
+                            sub.weight_quanter.scales(),
+                            sub.weight_quanter.bit_length(),
+                            sub.weight_quanter._observer.quant_axis()))
+                    if sub.activation_quanter is not None:
+                        inner.quant_scale = \
+                            sub.activation_quanter.scales()
+                        inner.quant_bits = \
+                            sub.activation_quanter.bit_length()
+                    m._sub_layers[name] = inner
+                elif sub is not None:
+                    fold(sub)
+
+        fold(model)
+        return model

@@ -1,0 +1,75 @@
+"""Quanters: trainable fake-quant modules for QAT (ref:
+``python/paddle/quantization/quanters/abs_max.py``
+FakeQuanterWithAbsMaxObserver)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..tensor import Tensor
+from .functional import quant_dequant
+from .observers import (AbsmaxObserver, MovingAverageAbsmaxObserver,
+                        PerChannelAbsmaxObserver)
+
+__all__ = ["BaseQuanter", "FakeQuanterWithAbsMaxObserver",
+           "FakeQuanterChannelWiseAbsMaxObserver", "quanter"]
+
+
+class BaseQuanter:
+    """Observes then fake-quantizes; used inside Quanted* wrappers."""
+
+    observer_cls = MovingAverageAbsmaxObserver
+
+    def __init__(self, quant_bits=8, **kw):
+        self.quant_bits = quant_bits
+        self._observer = self.observer_cls(quant_bits=quant_bits, **kw)
+
+    def __call__(self, x):
+        self._observer.observe(x)
+        return quant_dequant(x, self._observer.scales(), self.quant_bits,
+                             self._observer.quant_axis())
+
+    def scales(self):
+        return self._observer.scales()
+
+    def bit_length(self):
+        return self.quant_bits
+
+    def _instance(self, layer):
+        return type(self)(quant_bits=self.quant_bits)
+
+
+class FakeQuanterWithAbsMaxObserver(BaseQuanter):
+    observer_cls = MovingAverageAbsmaxObserver
+
+    def __init__(self, moving_rate=0.9, quant_bits=8, dtype="float32",
+                 name=None):
+        self.quant_bits = quant_bits
+        self._observer = MovingAverageAbsmaxObserver(
+            quant_bits=quant_bits, moving_rate=moving_rate)
+        self._moving_rate = moving_rate
+
+    def _instance(self, layer):
+        return type(self)(moving_rate=self._moving_rate,
+                          quant_bits=self.quant_bits)
+
+
+class FakeQuanterChannelWiseAbsMaxObserver(BaseQuanter):
+    def __init__(self, quant_bits=8, quant_axis=0, dtype="float32",
+                 name=None):
+        self.quant_bits = quant_bits
+        self._observer = PerChannelAbsmaxObserver(quant_bits=quant_bits,
+                                                  quant_axis_=quant_axis)
+        self._axis = quant_axis
+
+    def _instance(self, layer):
+        return type(self)(quant_bits=self.quant_bits,
+                          quant_axis=self._axis)
+
+
+def quanter(name):
+    """Factory-registration decorator (ref ``factory.py quanter``); kept for
+    API parity — classes register under ``quanters.<name>``."""
+    def deco(cls):
+        globals()[name] = cls
+        return cls
+    return deco

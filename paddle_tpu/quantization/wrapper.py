@@ -1,0 +1,54 @@
+"""Quantized layer wrappers (ref: ``python/paddle/quantization/wrapper.py``
+and imperative quant layers ``quantization/imperative/qat.py``
+QuantizedLinear/QuantizedConv2D)."""
+from __future__ import annotations
+
+from ..nn.layer.layers import Layer
+import paddle_tpu.nn.functional as F
+
+__all__ = ["QuantedLinear", "QuantedConv2D", "wrap_quanted"]
+
+
+class QuantedLinear(Layer):
+    def __init__(self, layer, act_quanter=None, weight_quanter=None):
+        super().__init__()
+        self._inner = layer
+        self.activation_quanter = act_quanter
+        self.weight_quanter = weight_quanter
+
+    def forward(self, x):
+        w = self._inner.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        return F.linear(x, w, self._inner.bias)
+
+
+class QuantedConv2D(Layer):
+    def __init__(self, layer, act_quanter=None, weight_quanter=None):
+        super().__init__()
+        self._inner = layer
+        self.activation_quanter = act_quanter
+        self.weight_quanter = weight_quanter
+
+    def forward(self, x):
+        inner = self._inner
+        w = inner.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        return F.conv2d(x, w, inner.bias, stride=inner._stride,
+                        padding=inner._padding, dilation=inner._dilation,
+                        groups=inner._groups,
+                        data_format=inner._data_format)
+
+
+def wrap_quanted(layer, act_quanter, weight_quanter):
+    from ..nn import Linear, Conv2D
+    if isinstance(layer, Linear):
+        return QuantedLinear(layer, act_quanter, weight_quanter)
+    if isinstance(layer, Conv2D):
+        return QuantedConv2D(layer, act_quanter, weight_quanter)
+    return None

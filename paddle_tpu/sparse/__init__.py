@@ -1,0 +1,361 @@
+"""``paddle.sparse`` — sparse tensors and ops.
+
+TPU-native re-design of the reference sparse stack
+(``python/paddle/sparse/`` API over ``phi::SparseCooTensor`` /
+``SparseCsrTensor`` C++ tensors and cuSPARSE kernels,
+``paddle/phi/kernels/sparse/``):
+
+ - storage: ``jax.experimental.sparse`` BCOO/BCSR — the XLA-era sparse
+   format (indices+values as dense arrays, ops lowered to gather/scatter/
+   segment-sum which XLA can fuse and shard).
+ - ``SparseCooTensor``/``SparseCsrTensor`` here are thin wrappers carrying
+   the paddle API (``.indices()``, ``.values()``, ``.to_dense()``...).
+ - elementwise zero-preserving ops map over ``values`` only; matmul rides
+   ``bcoo_dot_general`` (TPU-compatible: no cuSPARSE analog needed).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..tensor import Tensor
+
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+    "sparse_csr_tensor", "is_same_shape", "coalesce",
+    # unary
+    "sin", "tan", "asin", "atan", "sinh", "tanh", "asinh", "atanh", "sqrt",
+    "square", "log1p", "abs", "pow", "cast", "neg", "deg2rad", "rad2deg",
+    "expm1", "isnan",
+    # binary / multiary
+    "add", "subtract", "multiply", "divide", "matmul", "masked_matmul",
+    "addmm", "mv", "transpose", "sum", "reshape", "slice",
+    "nn",
+]
+
+
+def _data(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class SparseCooTensor:
+    """COO sparse tensor (ref: ``paddle/phi/core/sparse_coo_tensor.h``)."""
+
+    format = "coo"
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._bcoo = bcoo
+
+    # -- paddle surface -----------------------------------------------------
+    def indices(self):
+        return Tensor(self._bcoo.indices.T)  # paddle: [sparse_dim, nnz]
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def to_sparse_csr(self):
+        coo = self.coalesce_()._bcoo
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(coo))
+
+    def coalesce_(self):
+        return SparseCooTensor(
+            jsparse.bcoo_sum_duplicates(self._bcoo))
+
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    @property
+    def nnz(self):
+        return self._bcoo.nse
+
+    def numpy(self):
+        return np.asarray(self._bcoo.todense())
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+    # convenience arithmetic
+    def __add__(self, other):
+        return add(self, other)
+
+    def __sub__(self, other):
+        return subtract(self, other)
+
+    def __mul__(self, other):
+        return multiply(self, other)
+
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+
+class SparseCsrTensor:
+    """CSR sparse tensor (ref: ``paddle/phi/core/sparse_csr_tensor.h``)."""
+
+    format = "csr"
+
+    def __init__(self, bcsr: jsparse.BCSR):
+        self._bcsr = bcsr
+
+    def crows(self):
+        return Tensor(self._bcsr.indptr)
+
+    def cols(self):
+        return Tensor(self._bcsr.indices)
+
+    def values(self):
+        return Tensor(self._bcsr.data)
+
+    def to_dense(self):
+        return Tensor(self._bcsr.todense())
+
+    def to_sparse_coo(self, sparse_dim=None):
+        return SparseCooTensor(self._bcsr.to_bcoo())
+
+    @property
+    def shape(self):
+        return list(self._bcsr.shape)
+
+    @property
+    def dtype(self):
+        return self._bcsr.dtype
+
+    @property
+    def nnz(self):
+        return self._bcsr.nse
+
+    def numpy(self):
+        return np.asarray(self._bcsr.todense())
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    """``paddle.sparse.sparse_coo_tensor`` (indices: [sparse_dim, nnz])."""
+    idx = _data(indices).astype(jnp.int32).T  # jax BCOO: [nnz, sparse_dim]
+    vals = _data(values)
+    if dtype is not None:
+        from ..framework.dtype import to_jax_dtype
+        vals = vals.astype(to_jax_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(i) + 1 for i in idx.max(axis=0))
+        shape = shape + vals.shape[1:]
+    bcoo = jsparse.BCOO((vals, idx), shape=tuple(int(s) for s in shape))
+    return SparseCooTensor(bcoo)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None):
+    """``paddle.sparse.sparse_csr_tensor``."""
+    vals = _data(values)
+    if dtype is not None:
+        from ..framework.dtype import to_jax_dtype
+        vals = vals.astype(to_jax_dtype(dtype))
+    bcsr = jsparse.BCSR(
+        (vals, _data(cols).astype(jnp.int32),
+         _data(crows).astype(jnp.int32)),
+        shape=tuple(int(s) for s in shape))
+    return SparseCsrTensor(bcsr)
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+def coalesce(x):
+    return x.coalesce_()
+
+
+def _coo(x) -> jsparse.BCOO:
+    if isinstance(x, SparseCooTensor):
+        return x._bcoo
+    if isinstance(x, SparseCsrTensor):
+        return x._bcsr.to_bcoo()
+    raise TypeError(f"expected sparse tensor, got {type(x)}")
+
+
+def _same_kind(x, bcoo):
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(
+            jsparse.bcoo_sum_duplicates(bcoo)))
+    return SparseCooTensor(bcoo)
+
+
+# -- unary (zero-preserving: map over values) --------------------------------
+def _unary(fn):
+    def op(x, name=None):
+        if isinstance(x, SparseCsrTensor):
+            b = x._bcsr
+            return SparseCsrTensor(
+                jsparse.BCSR((fn(b.data), b.indices, b.indptr),
+                             shape=b.shape))
+        b = _coo(x)
+        return SparseCooTensor(
+            jsparse.BCOO((fn(b.data), b.indices), shape=b.shape))
+    return op
+
+
+sin = _unary(jnp.sin)
+tan = _unary(jnp.tan)
+asin = _unary(jnp.arcsin)
+atan = _unary(jnp.arctan)
+sinh = _unary(jnp.sinh)
+tanh = _unary(jnp.tanh)
+asinh = _unary(jnp.arcsinh)
+atanh = _unary(jnp.arctanh)
+sqrt = _unary(jnp.sqrt)
+square = _unary(jnp.square)
+log1p = _unary(jnp.log1p)
+abs = _unary(jnp.abs)
+neg = _unary(jnp.negative)
+deg2rad = _unary(jnp.deg2rad)
+rad2deg = _unary(jnp.rad2deg)
+expm1 = _unary(jnp.expm1)
+isnan = _unary(jnp.isnan)
+
+
+def pow(x, factor, name=None):
+    return _unary(lambda v: jnp.power(v, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    from ..framework.dtype import to_jax_dtype
+    b = _coo(x)
+    vals = b.data if value_dtype is None else b.data.astype(
+        to_jax_dtype(value_dtype))
+    idx = b.indices if index_dtype is None else b.indices.astype(
+        to_jax_dtype(index_dtype))
+    return _same_kind(x, jsparse.BCOO((vals, idx), shape=b.shape))
+
+
+# -- binary ------------------------------------------------------------------
+def _union_binary(x, y, fn):
+    """sparse op sparse over the union of patterns (concat + dedup)."""
+    a, b = _coo(x), _coo(y)
+    if a.shape != b.shape:
+        raise ValueError(f"shape mismatch {a.shape} vs {b.shape}")
+    data = jnp.concatenate([a.data, fn(b.data)])
+    idx = jnp.concatenate([a.indices, b.indices], axis=0)
+    out = jsparse.bcoo_sum_duplicates(
+        jsparse.BCOO((data, idx), shape=a.shape))
+    return _same_kind(x, out)
+
+
+def add(x, y, name=None):
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        return _union_binary(x, y, lambda v: v)
+    return Tensor(_coo(x).todense() + _data(y))
+
+
+def subtract(x, y, name=None):
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        return _union_binary(x, y, jnp.negative)
+    return Tensor(_coo(x).todense() - _data(y))
+
+
+def multiply(x, y, name=None):
+    if isinstance(y, (int, float)):
+        return _unary(lambda v: v * y)(x)
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        # same-pattern fast path, else dense fallback
+        a, b = _coo(x), _coo(y)
+        if a.indices.shape == b.indices.shape:
+            a = jsparse.bcoo_sum_duplicates(a)
+            b = jsparse.bcoo_sum_duplicates(b)
+            if bool(jnp.all(a.indices == b.indices)):
+                return _same_kind(x, jsparse.BCOO((a.data * b.data,
+                                                   a.indices),
+                                                  shape=a.shape))
+        return Tensor(a.todense() * b.todense())
+    # sparse * dense: gather dense at indices
+    a = jsparse.bcoo_sum_duplicates(_coo(x))
+    d = _data(y)
+    gathered = d[tuple(a.indices[:, i] for i in range(a.indices.shape[1]))]
+    return _same_kind(x, jsparse.BCOO((a.data * gathered, a.indices),
+                                      shape=a.shape))
+
+
+def divide(x, y, name=None):
+    if isinstance(y, (int, float)):
+        return _unary(lambda v: v / y)(x)
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        return Tensor(_coo(x).todense() / _coo(y).todense())
+    a = jsparse.bcoo_sum_duplicates(_coo(x))
+    d = _data(y)
+    gathered = d[tuple(a.indices[:, i] for i in range(a.indices.shape[1]))]
+    return _same_kind(x, jsparse.BCOO((a.data / gathered, a.indices),
+                                      shape=a.shape))
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense -> dense (ref: sparse matmul via cuSPARSE; here
+    ``bcoo_dot_general`` lowers to XLA gather/segment-sum)."""
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        a = _coo(x)
+        out = jsparse.bcoo_dot_general(
+            a, _data(y), dimension_numbers=(([a.ndim - 1], [0]), ([], [])))
+        return Tensor(out)
+    raise TypeError("matmul expects a sparse lhs")
+
+
+def mv(x, vec, name=None):
+    return matmul(x, vec)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return Tensor(beta * _data(input) + alpha * matmul(x, y)._data)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """dense @ dense evaluated only at ``mask``'s sparsity pattern."""
+    a, b = _data(x), _data(y)
+    m = jsparse.bcoo_sum_duplicates(_coo(mask))
+    rows, cols = m.indices[:, 0], m.indices[:, 1]
+    vals = jnp.einsum("nk,nk->n", a[rows, :], b[:, cols].T)
+    return SparseCooTensor(jsparse.BCOO((vals, m.indices), shape=m.shape))
+
+
+def transpose(x, perm, name=None):
+    return _same_kind(x, jsparse.bcoo_transpose(
+        _coo(x), permutation=tuple(perm)))
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    d = _coo(x).todense().sum(axis=axis, keepdims=keepdim)
+    if dtype is not None:
+        from ..framework.dtype import to_jax_dtype
+        d = d.astype(to_jax_dtype(dtype))
+    return Tensor(d)
+
+
+def reshape(x, shape, name=None):
+    return _same_kind(x, jsparse.bcoo_reshape(
+        jsparse.bcoo_sum_duplicates(_coo(x)),
+        new_sizes=tuple(int(s) for s in shape)))
+
+
+def slice(x, axes, starts, ends, name=None):
+    b = jsparse.bcoo_sum_duplicates(_coo(x))
+    start = [0] * b.ndim
+    limit = list(b.shape)
+    for ax, s, e in zip(axes, starts, ends):
+        start[ax] = int(s) if s >= 0 else int(s) + b.shape[ax]
+        limit[ax] = min(int(e) if e >= 0 else int(e) + b.shape[ax],
+                        b.shape[ax])
+    return _same_kind(x, jsparse.bcoo_slice(b, start_indices=start,
+                                            limit_indices=limit))
+
+
+from . import nn  # noqa: E402,F401

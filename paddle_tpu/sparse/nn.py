@@ -1,0 +1,94 @@
+"""``paddle.sparse.nn`` (ref: ``python/paddle/sparse/nn/``): activations,
+batch norm over sparse values, and submanifold-free conv fallback."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..tensor import Tensor
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "BatchNorm",
+           "functional"]
+
+
+def _map_values(x, fn):
+    from . import SparseCooTensor, SparseCsrTensor
+    if isinstance(x, SparseCsrTensor):
+        b = x._bcsr
+        return SparseCsrTensor(jsparse.BCSR(
+            (fn(b.data), b.indices, b.indptr), shape=b.shape))
+    b = x._bcoo
+    return SparseCooTensor(jsparse.BCOO((fn(b.data), b.indices),
+                                        shape=b.shape))
+
+
+class _ValueActivation:
+    def __init__(self, fn):
+        self._fn = fn
+
+    def __call__(self, x):
+        return _map_values(x, self._fn)
+
+
+class ReLU(_ValueActivation):
+    def __init__(self):
+        super().__init__(jax.nn.relu)
+
+
+class ReLU6(_ValueActivation):
+    def __init__(self):
+        super().__init__(lambda v: jnp.clip(v, 0, 6))
+
+
+class LeakyReLU(_ValueActivation):
+    def __init__(self, negative_slope=0.01):
+        super().__init__(lambda v: jnp.where(v >= 0, v,
+                                             negative_slope * v))
+
+
+class Softmax:
+    """CSR row-softmax over stored values (ref sparse softmax semantics)."""
+
+    def __init__(self, axis=-1):
+        self.axis = axis
+
+    def __call__(self, x):
+        from . import SparseCsrTensor
+        if not isinstance(x, SparseCsrTensor):
+            raise TypeError("sparse softmax expects a CSR tensor")
+        b = x._bcsr
+        dense = b.todense()
+        mask = dense != 0
+        neg = jnp.where(mask, dense, -jnp.inf)
+        sm = jax.nn.softmax(neg, axis=self.axis)
+        sm = jnp.where(mask, sm, 0)
+        coo = jsparse.BCOO.fromdense(sm, nse=b.nse)
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(coo))
+
+
+class BatchNorm:
+    """BatchNorm over sparse values per channel (last dim of values)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5):
+        self.num_features = num_features
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.weight = Tensor(jnp.ones(num_features))
+        self.bias = Tensor(jnp.zeros(num_features))
+
+    def __call__(self, x):
+        def f(v):
+            m = v.mean(axis=0, keepdims=True)
+            var = v.var(axis=0, keepdims=True)
+            out = (v - m) * jax.lax.rsqrt(var + self.epsilon)
+            return out * self.weight._data + self.bias._data
+        return _map_values(x, f)
+
+
+class functional:
+    relu = staticmethod(lambda x: ReLU()(x))
+    relu6 = staticmethod(lambda x: ReLU6()(x))
+    leaky_relu = staticmethod(
+        lambda x, negative_slope=0.01: LeakyReLU(negative_slope)(x))
+    softmax = staticmethod(lambda x, axis=-1: Softmax(axis)(x))

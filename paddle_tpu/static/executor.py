@@ -102,10 +102,23 @@ class Executor:
         feed = feed or {}
         fetch_list = fetch_list or []
 
-        if not program.nodes:  # startup program
+        if not program.nodes and not fetch_list:  # startup program
             return self._run_startup(program, scope)
 
         fetch_vids = tuple(self._fetch_vid(program, f) for f in fetch_list)
+        if not program.nodes:
+            # no ops: fetches can only be feed placeholders
+            by_vid = {vid: name for name, vid in program.feed_map.items()}
+            out = []
+            for vid in fetch_vids:
+                name = by_vid.get(vid)
+                if name is None or name not in feed:
+                    raise KeyError("fetch of an unfed placeholder in an "
+                                   "empty program")
+                val = feed[name]
+                out.append(np.asarray(val._data if isinstance(val, Tensor)
+                                      else val))
+            return out if return_numpy else [Tensor(o) for o in out]
         feed_names = tuple(sorted(feed))
         feed_arrays = {}
         for name in feed_names:
@@ -124,10 +137,13 @@ class Executor:
                      for n in feed_names))
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
-            entry = self._compile(program, feed_names, fetch_vids)
+            # the entry holds the program reference: id(program) in the key
+            # must stay valid for as long as the cache line lives
+            entry = self._compile(program, feed_names, fetch_vids) \
+                + (program,)
             if use_program_cache:
                 self._cache[key] = entry
-        fn, scope_keys, write_keys, host_fns = entry
+        fn, scope_keys, write_keys, host_fns = entry[:4]
 
         # materialize scope inputs (implicit startup for missing params)
         scope_vals = []

@@ -58,7 +58,7 @@ def _replay_fn(prog: "G.Program", loss_vid: int):
     return replay, sorted(feed_vids), scope_keys
 
 
-def _feed_refs(prog, feed_vids):
+def _feed_refs(feed_vids):
     return [("v", v) for v in feed_vids]
 
 
@@ -115,7 +115,7 @@ def gradients(targets, inputs, target_gradients=None):
         grads = jax.grad(loss_of)([feed_env[v] for v in in_vids])
         return tuple(grads)
 
-    in_refs = _feed_refs(prog, feed_vids) + [("s", k) for k in scope_keys]
+    in_refs = _feed_refs(feed_vids) + [("s", k) for k in scope_keys]
     out_vars = []
     for v in inputs:
         gv = G.Variable(list(v._data.shape), "float32", prog=prog,
@@ -156,7 +156,7 @@ def append_backward(loss, parameter_list=None, no_grad_set=None):
         grads = jax.grad(loss_of)([scope_env[k] for k in pkeys])
         return tuple(grads)
 
-    in_refs = _feed_refs(prog, feed_vids) + [("s", k) for k in scope_keys]
+    in_refs = _feed_refs(feed_vids) + [("s", k) for k in scope_keys]
     out = []
     for k in pkeys:
         t = params[k]
@@ -199,7 +199,6 @@ def append_minimize(optimizer, loss, parameters=None):
 
     all_scope = list(dict.fromkeys(scope_keys + skeys))
     n_feed = len(feed_vids)
-    n_state = len(skeys)
 
     def update_fn(lr, *datas):
         feed_env = dict(zip(feed_vids, datas[:n_feed]))
@@ -220,7 +219,7 @@ def append_minimize(optimizer, loss, parameters=None):
         new_leaves = jax.tree_util.tree_leaves(new_state)
         return (loss_val, *[new_params[k] for k in pkeys], *new_leaves)
 
-    in_refs = ([("h", 0)] + _feed_refs(prog, feed_vids)
+    in_refs = ([("h", 0)] + _feed_refs(feed_vids)
                + [("s", k) for k in all_scope])
     loss_out = G.Variable([], "float32", prog=prog,
                           name=f"{loss.name}@MIN")

@@ -268,7 +268,6 @@ class Program:
         return list(self.var_meta.values())
 
     def clone(self, for_test=False):
-        import copy
         p = Program.__new__(Program)
         p.__dict__ = dict(self.__dict__)
         p.nodes = list(self.nodes)
@@ -278,6 +277,13 @@ class Program:
         p.scope_tensors = dict(self.scope_tensors)
         p.scope_init = dict(self.scope_init)
         p.alias = dict(self.alias)
+        if for_test:
+            # drop training-only nodes (optimizer updates / grad nodes) so
+            # evaluating the clone never writes the scope (ref:
+            # Program.clone(for_test=True) pruning backward+optimize ops)
+            p.nodes = [n for n in p.nodes if not n.scope_writes]
+            produced = {ov for n in p.nodes for ov in n.out_vids}
+            p.alias = {k: v for k, v in p.alias.items() if v in produced}
         return p
 
     def __repr__(self):
@@ -355,11 +361,35 @@ def _maybe_record(fn, tensors, outputs_wrap, name):
     out_struct = jax.eval_shape(fn, *specs)
     single = isinstance(out_struct, jax.ShapeDtypeStruct)
     outs_struct = [out_struct] if single else list(out_struct)
+
+    # dynamic-dim propagation: probe with a second representative size for
+    # every dynamic input dim; output dims that change are dynamic (-1)
+    dyn_struct = None
+    if any(isinstance(t, Variable) and -1 in t._sym_shape for t in tensors):
+        specs2 = []
+        for t, sp in zip(tensors, specs):
+            if isinstance(t, Variable) and -1 in t._sym_shape:
+                shape2 = tuple(2 if sd == -1 else d
+                               for sd, d in zip(t._sym_shape, sp.shape))
+                specs2.append(jax.ShapeDtypeStruct(shape2, sp.dtype))
+            else:
+                specs2.append(sp)
+        try:
+            probe = jax.eval_shape(fn, *specs2)
+            dyn_struct = [probe] if isinstance(
+                probe, jax.ShapeDtypeStruct) else list(probe)
+        except Exception:
+            dyn_struct = None  # op requires concrete dims; treat as static
+
     out_vars = []
-    for st in outs_struct:
-        v = Variable(st.shape, "float32", prog=prog)
+    for i, st in enumerate(outs_struct):
+        sym = list(st.shape)
+        if dyn_struct is not None:
+            sym = [-1 if d1 != d2 else d1
+                   for d1, d2 in zip(st.shape, dyn_struct[i].shape)]
+        v = Variable(sym, "float32", prog=prog)
         v._data = jax.ShapeDtypeStruct(tuple(st.shape), st.dtype)
-        v._sym_shape = list(st.shape)
+        v._sym_shape = sym
         prog.add_var(v)
         out_vars.append(v)
     prog.add_node(Node(fn, in_refs, [v._vid for v in out_vars],

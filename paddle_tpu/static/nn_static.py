@@ -11,11 +11,7 @@ from __future__ import annotations
 import importlib
 
 
-def __getattr__(name):  # lazy so static can import before paddle_tpu.nn
-    raise AttributeError(name)
-
-
-def _nn_mod():
+def _nn_mod():  # lazy so static can import before paddle_tpu.nn
     return importlib.import_module("paddle_tpu.nn")
 
 
@@ -24,18 +20,24 @@ __all__ = ["fc", "conv2d", "batch_norm", "embedding", "conv2d_transpose"]
 
 def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
        activation=None, name=None):
+    """Paddle fc semantics: dims [num_flatten_dims:] flatten into the
+    feature axis, leading dims are preserved in the output."""
+    lead = list(x.shape[:num_flatten_dims])
     in_features = 1
     for d in x.shape[num_flatten_dims:]:
         if d < 0:
-            raise ValueError("fc needs static non-batch dims")
+            raise ValueError("fc needs static non-batch (feature) dims")
         in_features *= d
-    if num_flatten_dims != 1 or len(x.shape) > 2:
+    if len(x.shape) != num_flatten_dims + 1 or \
+            x.shape[num_flatten_dims] != in_features:
         from ..ops.manipulation import reshape
-        x = reshape(x, [-1 if x.shape[0] < 0 else x.shape[0], in_features]) \
-            if len(x.shape) != 2 else x
+        x = reshape(x, [-1, in_features])
     layer = _nn_mod().Linear(in_features, size,
-                       weight_attr=weight_attr, bias_attr=bias_attr)
+                             weight_attr=weight_attr, bias_attr=bias_attr)
     out = layer(x)
+    if len(lead) != 1:
+        from ..ops.manipulation import reshape
+        out = reshape(out, [(-1 if d < 0 else d) for d in lead] + [size])
     if activation:
         import paddle_tpu.nn.functional as F
         out = getattr(F, activation)(out)

@@ -1,0 +1,232 @@
+"""paddle.distribution tests (ref: test/distribution/ test_distribution_*).
+
+Oracles: closed-form moments, Monte-Carlo agreement between samples and
+densities, and KL identities (KL(p,p)=0, KL vs numeric integral for 1-D).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import distribution as D
+
+SEED = 1234
+
+
+def setup_module():
+    pt.seed(SEED)
+
+
+def mc_mean(dist, n=20000):
+    return np.asarray(dist.sample([n]).numpy()).mean(axis=0)
+
+
+class TestMoments:
+    @pytest.mark.parametrize("dist,mean,var", [
+        (lambda: D.Normal(1.5, 2.0), 1.5, 4.0),
+        (lambda: D.Uniform(0.0, 4.0), 2.0, 16 / 12),
+        (lambda: D.Bernoulli(probs=0.3), 0.3, 0.21),
+        (lambda: D.Beta(2.0, 3.0), 0.4, 0.04),
+        (lambda: D.Exponential(2.0), 0.5, 0.25),
+        (lambda: D.Gamma(3.0, 2.0), 1.5, 0.75),
+        (lambda: D.Laplace(0.5, 1.0), 0.5, 2.0),
+        (lambda: D.Poisson(3.0), 3.0, 3.0),
+        (lambda: D.Geometric(0.25), 3.0, 12.0),
+        (lambda: D.LogNormal(0.0, 0.5),
+         math.exp(0.125), (math.exp(0.25) - 1) * math.exp(0.25)),
+    ])
+    def test_mean_var(self, dist, mean, var):
+        d = dist()
+        np.testing.assert_allclose(float(d.mean.numpy()), mean, rtol=1e-5)
+        np.testing.assert_allclose(float(d.variance.numpy()), var, rtol=1e-5)
+
+    def test_sample_matches_mean(self):
+        for d, m in [(D.Normal(1.0, 0.5), 1.0),
+                     (D.Uniform(-1.0, 1.0), 0.0),
+                     (D.Gumbel(0.0, 1.0), float(np.euler_gamma)),
+                     (D.Cauchy(0.0, 1.0), None)]:
+            s = np.asarray(d.sample([8000]).numpy())
+            if m is not None:
+                np.testing.assert_allclose(s.mean(), m, atol=0.08)
+
+
+class TestLogProb:
+    def test_normal_matches_formula(self):
+        d = D.Normal(0.0, 1.0)
+        x = np.linspace(-3, 3, 7).astype(np.float32)
+        lp = np.asarray(d.log_prob(pt.to_tensor(x)).numpy())
+        want = -0.5 * x ** 2 - 0.5 * math.log(2 * math.pi)
+        np.testing.assert_allclose(lp, want, rtol=1e-5)
+
+    def test_density_integrates_to_one(self):
+        # numeric integral of prob over the support ≈ 1
+        for d, lo, hi in [(D.Normal(0.3, 1.2), -8, 8),
+                          (D.Gumbel(0.0, 1.0), -6, 20),
+                          (D.Laplace(0.0, 2.0), -25, 25),
+                          (D.Cauchy(0.0, 1.0), -2000, 2000),
+                          (D.Gamma(2.0, 1.0), 1e-5, 40)]:
+            x = np.linspace(lo, hi, 60001).astype(np.float64)
+            p = np.asarray(d.prob(pt.to_tensor(
+                x.astype(np.float32))).numpy()).astype(np.float64)
+            integral = np.trapezoid(p, x)
+            np.testing.assert_allclose(integral, 1.0, atol=5e-3), type(d)
+
+    def test_categorical(self):
+        logits = np.log(np.array([0.2, 0.3, 0.5], np.float32))
+        d = D.Categorical(logits=logits)
+        lp = np.asarray(d.log_prob(pt.to_tensor(
+            np.array([0, 1, 2]))).numpy())
+        np.testing.assert_allclose(np.exp(lp), [0.2, 0.3, 0.5], rtol=1e-5)
+        s = np.asarray(d.sample([20000]).numpy())
+        freq = np.bincount(s, minlength=3) / len(s)
+        np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.02)
+
+    def test_multinomial(self):
+        d = D.Multinomial(10, np.array([0.5, 0.5], np.float32))
+        s = np.asarray(d.sample([500]).numpy())
+        assert s.shape == (500, 2)
+        np.testing.assert_allclose(s.sum(-1), 10)
+        lp = float(d.log_prob(pt.to_tensor(
+            np.array([5.0, 5.0], np.float32))).numpy())
+        want = math.log(math.comb(10, 5) * 0.5 ** 10)
+        np.testing.assert_allclose(lp, want, rtol=1e-5)
+
+    def test_dirichlet_event_shape(self):
+        d = D.Dirichlet(np.array([1.0, 2.0, 3.0], np.float32))
+        assert d.event_shape == [3]
+        s = np.asarray(d.sample([64]).numpy())
+        np.testing.assert_allclose(s.sum(-1), 1.0, rtol=1e-5)
+
+
+class TestRsampleGrad:
+    def test_normal_reparameterized(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.framework.random import next_key
+
+        def f(mu):
+            d = D.Normal(mu, 1.0)
+            return d._rsample(jax.random.key(0), (1000,)).mean()
+
+        g = jax.grad(f)(jnp.float32(2.0))
+        np.testing.assert_allclose(float(g), 1.0, atol=1e-4)
+
+
+class TestKL:
+    def test_kl_self_zero(self):
+        cases = [D.Normal(0.5, 2.0), D.Uniform(0., 1.),
+                 D.Bernoulli(probs=0.4), D.Beta(2., 3.),
+                 D.Exponential(1.5), D.Gamma(2., 2.),
+                 D.Laplace(0., 1.), D.Poisson(2.0),
+                 D.Gumbel(0.0, 1.0),
+                 D.Categorical(logits=np.zeros(4, np.float32))]
+        for d in cases:
+            kl = float(np.asarray(D.kl_divergence(d, d).numpy()))
+            np.testing.assert_allclose(kl, 0.0, atol=1e-5), type(d)
+
+    @pytest.mark.parametrize("p,q,lo,hi", [
+        (lambda: D.Normal(0.0, 1.0), lambda: D.Normal(1.0, 2.0), -10, 10),
+        (lambda: D.Laplace(0.0, 1.0), lambda: D.Laplace(0.5, 2.0), -30, 30),
+        (lambda: D.Gumbel(0.0, 1.0), lambda: D.Gumbel(0.5, 1.5), -8, 40),
+        (lambda: D.Gamma(2.0, 1.0), lambda: D.Gamma(3.0, 2.0), 1e-4, 60),
+        (lambda: D.Exponential(1.0), lambda: D.Exponential(2.5), 1e-6, 40),
+    ])
+    def test_kl_matches_numeric_integral(self, p, q, lo, hi):
+        p, q = p(), q()
+        kl = float(np.asarray(D.kl_divergence(p, q).numpy()))
+        x = np.linspace(lo, hi, 200001).astype(np.float64)
+        xp = pt.to_tensor(x.astype(np.float32))
+        pp = np.asarray(p.prob(xp).numpy()).astype(np.float64)
+        lpq = (np.asarray(p.log_prob(xp).numpy()).astype(np.float64)
+               - np.asarray(q.log_prob(xp).numpy()).astype(np.float64))
+        numeric = np.trapezoid(pp * lpq, x)
+        np.testing.assert_allclose(kl, numeric, rtol=2e-3, atol=2e-3)
+
+    def test_register_custom(self):
+        class MyDist(D.Normal):
+            pass
+
+        @D.register_kl(MyDist, MyDist)
+        def _kl_my(p, q):
+            import jax.numpy as jnp
+            return jnp.float32(42.0)
+
+        assert float(D.kl_divergence(MyDist(0., 1.),
+                                     MyDist(0., 1.)).numpy()) == 42.0
+
+
+class TestTransforms:
+    def test_affine_round_trip_and_ldj(self):
+        t = D.AffineTransform(1.0, 3.0)
+        x = np.array([0.5, -2.0], np.float32)
+        y = np.asarray(t.forward(pt.to_tensor(x)).numpy())
+        np.testing.assert_allclose(y, 1.0 + 3.0 * x)
+        back = np.asarray(t.inverse(pt.to_tensor(y)).numpy())
+        np.testing.assert_allclose(back, x, rtol=1e-6)
+        ldj = np.asarray(t.forward_log_det_jacobian(
+            pt.to_tensor(x)).numpy())
+        np.testing.assert_allclose(ldj, np.log(3.0), rtol=1e-6)
+
+    @pytest.mark.parametrize("t,x", [
+        (D.ExpTransform(), np.array([0.1, 1.0], np.float32)),
+        (D.SigmoidTransform(), np.array([-1.0, 2.0], np.float32)),
+        (D.TanhTransform(), np.array([-0.5, 0.5], np.float32)),
+        (D.PowerTransform(2.0), np.array([0.5, 2.0], np.float32)),
+    ])
+    def test_round_trip_and_numeric_ldj(self, t, x):
+        y = np.asarray(t.forward(pt.to_tensor(x)).numpy())
+        back = np.asarray(t.inverse(pt.to_tensor(y)).numpy())
+        np.testing.assert_allclose(back, x, rtol=1e-4, atol=1e-5)
+        # numeric jacobian
+        eps = 1e-3
+        dy = (np.asarray(t.forward(pt.to_tensor(x + eps)).numpy())
+              - np.asarray(t.forward(pt.to_tensor(x - eps)).numpy())) / (
+                  2 * eps)
+        ldj = np.asarray(t.forward_log_det_jacobian(
+            pt.to_tensor(x)).numpy())
+        np.testing.assert_allclose(ldj, np.log(np.abs(dy)), atol=2e-3)
+
+    def test_chain(self):
+        chain = D.ChainTransform([D.AffineTransform(0.0, 2.0),
+                                  D.ExpTransform()])
+        x = np.array([0.3], np.float32)
+        y = np.asarray(chain.forward(pt.to_tensor(x)).numpy())
+        np.testing.assert_allclose(y, np.exp(2 * x), rtol=1e-6)
+        ldj = np.asarray(chain.forward_log_det_jacobian(
+            pt.to_tensor(x)).numpy())
+        np.testing.assert_allclose(ldj, np.log(2.0) + 2 * x, rtol=1e-5)
+
+    def test_stickbreaking(self):
+        t = D.StickBreakingTransform()
+        x = np.array([0.2, -0.5, 1.0], np.float32)
+        y = np.asarray(t.forward(pt.to_tensor(x)).numpy())
+        assert y.shape == (4,)
+        np.testing.assert_allclose(y.sum(), 1.0, rtol=1e-5)
+        back = np.asarray(t.inverse(pt.to_tensor(y)).numpy())
+        np.testing.assert_allclose(back, x, rtol=1e-4, atol=1e-5)
+
+
+class TestComposed:
+    def test_transformed_distribution_lognormal(self):
+        base = D.Normal(0.0, 0.5)
+        td = D.TransformedDistribution(base, [D.ExpTransform()])
+        ln = D.LogNormal(0.0, 0.5)
+        x = np.array([0.5, 1.0, 2.0], np.float32)
+        np.testing.assert_allclose(
+            np.asarray(td.log_prob(pt.to_tensor(x)).numpy()),
+            np.asarray(ln.log_prob(pt.to_tensor(x)).numpy()), rtol=1e-5)
+
+    def test_independent(self):
+        d = D.Independent(D.Normal(np.zeros((3, 4), np.float32),
+                                   np.ones((3, 4), np.float32)), 1)
+        assert d.batch_shape == [3] and d.event_shape == [4]
+        x = np.zeros((3, 4), np.float32)
+        lp = np.asarray(d.log_prob(pt.to_tensor(x)).numpy())
+        assert lp.shape == (3,)
+        np.testing.assert_allclose(
+            lp, 4 * (-0.5 * math.log(2 * math.pi)), rtol=1e-5)
+        kl = np.asarray(D.kl_divergence(d, d).numpy())
+        np.testing.assert_allclose(kl, np.zeros(3), atol=1e-6)

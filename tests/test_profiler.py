@@ -1,0 +1,107 @@
+"""Profiler tests (ref: test/legacy_test/test_profiler.py family)."""
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.profiler as profiler
+from paddle_tpu.profiler import (Profiler, ProfilerState, ProfilerTarget,
+                                 make_scheduler, export_chrome_tracing,
+                                 RecordEvent, SortedKeys)
+
+
+class TestScheduler:
+    def test_states(self):
+        sched = make_scheduler(closed=1, ready=1, record=2, repeat=1,
+                               skip_first=1)
+        states = [sched(i) for i in range(7)]
+        assert states[0] == ProfilerState.CLOSED          # skip_first
+        assert states[1] == ProfilerState.CLOSED
+        assert states[2] == ProfilerState.READY
+        assert states[3] == ProfilerState.RECORD
+        assert states[4] == ProfilerState.RECORD_AND_RETURN
+        assert states[5] == ProfilerState.CLOSED          # repeat exhausted
+        assert states[6] == ProfilerState.CLOSED
+
+
+class TestProfiler:
+    def setup_method(self):
+        import paddle_tpu.core as core
+        core.tracer_disable()
+        core.tracer_clear()
+
+    def test_record_and_export(self, tmp_path):
+        out_dir = str(tmp_path / "prof")
+        p = Profiler(targets=[ProfilerTarget.CPU],
+                     scheduler=make_scheduler(closed=0, ready=0, record=3,
+                                              repeat=1),
+                     on_trace_ready=export_chrome_tracing(out_dir, "w0"))
+        p.start()
+        for step in range(3):
+            with RecordEvent("train_step"):
+                _ = (pt.to_tensor(np.ones((4, 4), np.float32)) * 2).numpy()
+            p.step()
+        p.stop()
+        files = os.listdir(out_dir)
+        assert files, "no trace exported"
+        j = json.load(open(os.path.join(out_dir, files[0])))
+        names = {e["name"] for e in j["traceEvents"]}
+        assert "train_step" in names
+
+    def test_summary_table(self):
+        with Profiler(targets=[ProfilerTarget.CPU]) as p:
+            for _ in range(5):
+                with RecordEvent("stepA"):
+                    pass
+                with RecordEvent("stepB"):
+                    pass
+        table = p.summary(sorted_by=SortedKeys.Calls)
+        assert "stepA" in table and "stepB" in table
+        assert "Calls" in table
+
+    def test_context_manager_and_scheduler_window(self, tmp_path):
+        exported = []
+        p = Profiler(scheduler=(1, 3),
+                     on_trace_ready=lambda prof: exported.append(
+                         prof.step_num))
+        p.start()
+        for _ in range(4):
+            with RecordEvent("w"):
+                pass
+            p.step()
+        p.stop()
+        assert exported, "on_trace_ready never fired"
+
+    def test_record_function_decorator(self):
+        from paddle_tpu.profiler.utils import record_function
+        import paddle_tpu.core as core
+        core.tracer_clear()
+        core.tracer_enable()
+
+        @record_function("my_fn")
+        def f(x):
+            return x * 2
+
+        assert f(21) == 42
+        assert "my_fn" in [e[0] for e in core.tracer_events()]
+        core.tracer_disable()
+
+    def test_wrap_optimizers(self):
+        from paddle_tpu.profiler.utils import wrap_optimizers
+        import paddle_tpu.core as core
+        wrap_optimizers()
+        core.tracer_clear()
+        core.tracer_enable()
+        lin = pt.nn.Linear(4, 2)
+        opt = pt.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+        loss = (lin(pt.to_tensor(np.ones((2, 4), np.float32))) ** 2).mean()
+        loss.backward()
+        opt.step()
+        names = [e[0] for e in core.tracer_events()]
+        assert any(n.startswith("Optimizer.step") for n in names)
+        core.tracer_disable()

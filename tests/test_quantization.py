@@ -1,0 +1,133 @@
+"""Quantization tests (ref: test/quantization/ test_quant_aware /
+test_ptq)."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import quantization as Q
+
+
+def _model():
+    pt.seed(3)
+    return pt.nn.Sequential(
+        pt.nn.Linear(8, 16), pt.nn.ReLU(), pt.nn.Linear(16, 4))
+
+
+class TestFakeQuant:
+    def test_quant_dequant_levels(self):
+        x = np.linspace(-1, 1, 101).astype(np.float32)
+        out = Q.quant_dequant(pt.to_tensor(x), scale=1.0,
+                              bit_length=8).numpy()
+        # 8-bit symmetric: values land on k/127 grid
+        np.testing.assert_allclose(out * 127, np.round(out * 127),
+                                   atol=1e-4)
+        assert np.abs(out - x).max() <= 1 / 127 + 1e-6
+
+    def test_straight_through_gradient(self):
+        x = pt.to_tensor(np.array([0.3, -0.7], np.float32),
+                         stop_gradient=False)
+        y = Q.quant_dequant(x, scale=1.0, bit_length=8)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.ones(2))
+
+    def test_per_channel(self):
+        x = np.stack([np.full(4, 0.5), np.full(4, 5.0)]).astype(np.float32)
+        out = Q.quant_dequant(pt.to_tensor(x),
+                              scale=np.array([0.5, 5.0], np.float32),
+                              bit_length=8, channel_axis=0).numpy()
+        np.testing.assert_allclose(out, x, rtol=1e-2)
+
+
+class TestObservers:
+    def test_absmax(self):
+        obs = Q.AbsmaxObserver()
+        obs.observe(pt.to_tensor(np.array([1.0, -3.0], np.float32)))
+        obs.observe(pt.to_tensor(np.array([2.0], np.float32)))
+        assert obs.scales() == 3.0
+
+    def test_moving_average(self):
+        obs = Q.MovingAverageAbsmaxObserver(moving_rate=0.5)
+        obs.observe(pt.to_tensor(np.array([4.0], np.float32)))
+        obs.observe(pt.to_tensor(np.array([2.0], np.float32)))
+        assert obs.scales() == pytest.approx(3.0)
+
+    def test_per_channel_absmax(self):
+        obs = Q.PerChannelAbsmaxObserver(quant_axis_=0)
+        obs.observe(pt.to_tensor(np.array([[1., -2.], [3., 0.5]],
+                                          np.float32)))
+        np.testing.assert_allclose(obs.scales(), [2.0, 3.0])
+
+    def test_hist_percentile(self):
+        obs = Q.HistObserver(percentile=0.5)
+        obs.observe(pt.to_tensor(np.linspace(0, 10, 1001,
+                                             dtype=np.float32)))
+        assert 4.0 < obs.scales() < 6.0  # median magnitude ≈ 5
+
+
+class TestQAT:
+    def test_quantize_wraps_and_trains(self):
+        model = _model()
+        cfg = Q.QuantConfig(
+            activation=Q.FakeQuanterWithAbsMaxObserver(),
+            weight=Q.FakeQuanterWithAbsMaxObserver())
+        qat = Q.QAT(cfg)
+        qmodel = qat.quantize(model, inplace=True)
+        kinds = [type(l).__name__ for l in qmodel.sublayers()]
+        assert kinds.count("QuantedLinear") == 2
+        # trains end-to-end with STE gradients
+        opt = pt.optimizer.SGD(learning_rate=0.1,
+                               parameters=qmodel.parameters())
+        X = np.random.RandomState(0).randn(32, 8).astype(np.float32)
+        Y = np.random.RandomState(1).randint(0, 4, 32)
+        losses = []
+        for _ in range(15):
+            loss = pt.nn.CrossEntropyLoss()(qmodel(pt.to_tensor(X)),
+                                            pt.to_tensor(Y))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+    def test_convert_folds_scales(self):
+        model = _model()
+        cfg = Q.QuantConfig(
+            activation=Q.FakeQuanterWithAbsMaxObserver(),
+            weight=Q.FakeQuanterWithAbsMaxObserver())
+        qat = Q.QAT(cfg)
+        qmodel = qat.quantize(model, inplace=True)
+        X = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+        qmodel(pt.to_tensor(X))  # calibrate
+        deployed = qat.convert(qmodel, inplace=True)
+        kinds = [type(l).__name__ for l in deployed.sublayers()]
+        assert "QuantedLinear" not in kinds
+        lin = [l for l in deployed.sublayers()
+               if type(l).__name__ == "Linear"][0]
+        assert hasattr(lin, "quant_scale")
+        # folded weights lie on the int8 grid for their scale
+        w = lin.weight.numpy()
+        s = np.abs(w).max()
+        grid = np.round(w / s * 127)
+        np.testing.assert_allclose(w, grid * s / 127, atol=1e-6)
+
+
+class TestPTQ:
+    def test_calibrate_and_convert(self):
+        model = _model()
+        cfg = Q.QuantConfig(activation=Q.AbsmaxObserver(),
+                            weight=Q.AbsmaxObserver())
+        ptq = Q.PTQ(cfg)
+        qmodel = ptq.quantize(model, inplace=True)
+        rng = np.random.RandomState(0)
+        ref_out = None
+        for _ in range(4):
+            X = rng.randn(16, 8).astype(np.float32)
+            out = qmodel(pt.to_tensor(X))
+        deployed = ptq.convert(qmodel, inplace=True)
+        # deployed model output stays close to float model
+        X = rng.randn(16, 8).astype(np.float32)
+        got = deployed(pt.to_tensor(X)).numpy()
+        want = _model()(pt.to_tensor(X)).numpy()  # same seed -> same init
+        np.testing.assert_allclose(got, want, atol=0.15)

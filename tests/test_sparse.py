@@ -1,0 +1,152 @@
+"""paddle.sparse tests (ref: test/legacy_test/test_sparse_*_op.py family).
+
+Oracle: dense numpy reference for every op (the sparse OpTest pattern)."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import sparse as S
+
+
+@pytest.fixture
+def coo():
+    idx = np.array([[0, 0, 1, 2], [0, 2, 1, 0]])
+    vals = np.array([1., 2., 3., 4.], np.float32)
+    dense = np.zeros((3, 3), np.float32)
+    dense[tuple(idx)] = vals
+    return S.sparse_coo_tensor(idx, vals, shape=[3, 3]), dense
+
+
+class TestCreation:
+    def test_coo_round_trip(self, coo):
+        x, dense = coo
+        np.testing.assert_allclose(x.to_dense().numpy(), dense)
+        assert x.nnz == 4 and x.shape == [3, 3]
+        # indices come back in paddle layout [sparse_dim, nnz]
+        assert x.indices().numpy().shape == (2, 4)
+
+    def test_csr_round_trip(self):
+        crows = np.array([0, 2, 3, 4])
+        cols = np.array([0, 2, 1, 0])
+        vals = np.array([1., 2., 3., 4.], np.float32)
+        x = S.sparse_csr_tensor(crows, cols, vals, [3, 3])
+        dense = np.zeros((3, 3), np.float32)
+        dense[0, 0], dense[0, 2], dense[1, 1], dense[2, 0] = 1, 2, 3, 4
+        np.testing.assert_allclose(x.to_dense().numpy(), dense)
+
+    def test_coo_csr_conversion(self, coo):
+        x, dense = coo
+        csr = x.to_sparse_csr()
+        np.testing.assert_allclose(csr.to_dense().numpy(), dense)
+        back = csr.to_sparse_coo()
+        np.testing.assert_allclose(back.to_dense().numpy(), dense)
+
+    def test_coalesce(self):
+        idx = np.array([[0, 0], [1, 1]])  # duplicate entry
+        x = S.sparse_coo_tensor(idx, np.array([2., 5.], np.float32),
+                                shape=[2, 2])
+        c = S.coalesce(x)
+        assert c.nnz <= 2
+        np.testing.assert_allclose(c.to_dense().numpy()[0, 1], 7.0)
+
+
+class TestUnary:
+    @pytest.mark.parametrize("op,ref", [
+        ("sin", np.sin), ("tanh", np.tanh), ("sqrt", np.sqrt),
+        ("square", np.square), ("log1p", np.log1p), ("abs", np.abs),
+        ("neg", np.negative), ("expm1", np.expm1),
+    ])
+    def test_value_ops(self, coo, op, ref):
+        x, dense = coo
+        out = getattr(S, op)(x).to_dense().numpy()
+        want = np.where(dense != 0, ref(dense.astype(np.float64)), 0)
+        np.testing.assert_allclose(out, want.astype(np.float32), rtol=1e-5)
+
+    def test_pow_cast(self, coo):
+        x, dense = coo
+        np.testing.assert_allclose(S.pow(x, 3).to_dense().numpy(),
+                                   dense ** 3, rtol=1e-5)
+        c = S.cast(x, value_dtype="float16")
+        assert str(c.dtype) == "float16"
+
+
+class TestBinary:
+    def test_add_subtract_union_pattern(self, coo):
+        x, dense = coo
+        other = np.zeros((3, 3), np.float32)
+        other[0, 0], other[2, 2] = 10, 20
+        y = S.sparse_coo_tensor(np.array([[0, 2], [0, 2]]),
+                                np.array([10., 20.], np.float32), [3, 3])
+        np.testing.assert_allclose(S.add(x, y).to_dense().numpy(),
+                                   dense + other)
+        np.testing.assert_allclose(S.subtract(x, y).to_dense().numpy(),
+                                   dense - other)
+
+    def test_multiply(self, coo):
+        x, dense = coo
+        np.testing.assert_allclose(S.multiply(x, 2.5).to_dense().numpy(),
+                                   dense * 2.5)
+        d = np.random.RandomState(0).randn(3, 3).astype(np.float32)
+        np.testing.assert_allclose(S.multiply(x, d).to_dense().numpy(),
+                                   dense * d, rtol=1e-6)
+
+    def test_matmul_mv(self, coo):
+        x, dense = coo
+        d = np.random.RandomState(0).randn(3, 5).astype(np.float32)
+        np.testing.assert_allclose(S.matmul(x, d).numpy(), dense @ d,
+                                   rtol=1e-5)
+        v = np.random.RandomState(1).randn(3).astype(np.float32)
+        np.testing.assert_allclose(S.mv(x, v).numpy(), dense @ v, rtol=1e-5)
+
+    def test_masked_matmul(self, coo):
+        x, dense = coo
+        a = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+        b = np.random.RandomState(2).randn(4, 3).astype(np.float32)
+        out = S.masked_matmul(a, b, x).to_dense().numpy()
+        np.testing.assert_allclose(out, np.where(dense != 0, a @ b, 0),
+                                   rtol=1e-5)
+
+    def test_addmm(self, coo):
+        x, dense = coo
+        inp = np.random.RandomState(3).randn(3, 5).astype(np.float32)
+        d = np.random.RandomState(4).randn(3, 5).astype(np.float32)
+        out = S.addmm(inp, x, d, beta=0.5, alpha=2.0).numpy()
+        np.testing.assert_allclose(out, 0.5 * inp + 2.0 * (dense @ d),
+                                   rtol=1e-5)
+
+
+class TestManipulation:
+    def test_transpose_reshape_slice_sum(self, coo):
+        x, dense = coo
+        np.testing.assert_allclose(S.transpose(x, [1, 0]).to_dense().numpy(),
+                                   dense.T)
+        np.testing.assert_allclose(S.reshape(x, [9]).to_dense().numpy(),
+                                   dense.reshape(9))
+        np.testing.assert_allclose(
+            S.slice(x, [0], [0], [2]).to_dense().numpy(), dense[:2])
+        np.testing.assert_allclose(float(S.sum(x).numpy()), dense.sum())
+        np.testing.assert_allclose(S.sum(x, axis=0).numpy(), dense.sum(0))
+
+
+class TestNN:
+    def test_relu_family(self, coo):
+        x, dense = coo
+        neg = S.neg(x)
+        np.testing.assert_allclose(
+            S.nn.functional.relu(neg).to_dense().numpy(),
+            np.maximum(-dense, 0))
+        np.testing.assert_allclose(
+            S.nn.functional.leaky_relu(neg, 0.1).to_dense().numpy(),
+            np.where(-dense >= 0, -dense, -0.1 * dense), rtol=1e-6)
+
+    def test_csr_softmax_rows(self):
+        crows = np.array([0, 2, 3])
+        cols = np.array([0, 2, 1])
+        vals = np.array([1., 2., 5.], np.float32)
+        x = S.sparse_csr_tensor(crows, cols, vals, [2, 3])
+        sm = S.nn.functional.softmax(x).to_dense().numpy()
+        row0 = np.exp([1., 2.]) / np.exp([1., 2.]).sum()
+        np.testing.assert_allclose(sm[0, [0, 2]], row0, rtol=1e-5)
+        np.testing.assert_allclose(sm[1, 1], 1.0, rtol=1e-6)

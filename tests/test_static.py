@@ -56,6 +56,58 @@ class TestProgramBuild:
             with pytest.raises(RuntimeError):
                 h.numpy()
 
+    def test_dynamic_dim_propagates(self, static_mode):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 16], "float32")
+            eye = pt.to_tensor(np.eye(16, dtype=np.float32))
+            y = pt.matmul(x, eye)
+            assert y.shape == [-1, 16]
+            s = F.relu(y).sum(axis=1)
+            assert s.shape == [-1]
+
+    def test_fc_num_flatten_dims(self, static_mode):
+        main = static.Program()
+        with static.program_guard(main):
+            z = static.data("z", [2, 3, 4], "float32")
+            out = static.nn.fc(z, 5, num_flatten_dims=2)
+            assert out.shape == [2, 3, 5]
+        Z = np.random.RandomState(0).randn(2, 3, 4).astype(np.float32)
+        r, = static.Executor().run(main, feed={"z": Z}, fetch_list=[out])
+        assert r.shape == (2, 3, 5)
+
+    def test_clone_for_test_does_not_train(self, static_mode):
+        pt.seed(0)
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [8, 4], "float32")
+            loss = (pt.nn.Linear(4, 2)(x) ** 2).mean()
+            pt.optimizer.SGD(learning_rate=0.5).minimize(loss)
+        test_prog = main.clone(for_test=True)
+        exe = static.Executor()
+        exe.run(startup)
+        X = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+        key = next(iter(main.scope_tensors))
+        l1, = exe.run(test_prog, feed={"x": X}, fetch_list=[loss])
+        w1 = np.asarray(static.global_scope().find_var(key))
+        l2, = exe.run(test_prog, feed={"x": X}, fetch_list=[loss])
+        np.testing.assert_allclose(
+            w1, np.asarray(static.global_scope().find_var(key)))
+        np.testing.assert_allclose(l1, l2)
+        # training program still updates
+        l3, = exe.run(main, feed={"x": X}, fetch_list=[loss])
+        l4, = exe.run(main, feed={"x": X}, fetch_list=[loss])
+        assert float(l4) < float(l3)
+
+    def test_empty_program_fetches_feed(self, static_mode):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [3], "float32")
+        out, = static.Executor().run(
+            main, feed={"x": np.arange(3, dtype=np.float32)},
+            fetch_list=[x])
+        np.testing.assert_allclose(out, [0, 1, 2])
+
     def test_fetch_by_name(self, static_mode):
         main = static.Program()
         with static.program_guard(main):
