@@ -1,0 +1,17 @@
+"""``paddle.audio`` (ref: ``python/paddle/audio/``): feature layers +
+functional DSP. Backends (file IO) are out of scope of the compute
+framework — load waveforms with any IO library and pass arrays."""
+from . import functional as _func_mod
+from . import features  # noqa: F401
+from .window import get_window  # noqa: F401
+
+
+class functional:  # namespace mirroring paddle.audio.functional
+    from .functional import (  # noqa: F401
+        hz_to_mel, mel_to_hz, mel_frequencies, fft_frequencies,
+        compute_fbank_matrix, power_to_db, create_dct,
+    )
+    from .window import get_window  # noqa: F401
+
+
+__all__ = ["functional", "features", "get_window"]

@@ -1,0 +1,98 @@
+"""``paddle.audio.features`` (ref: ``python/paddle/audio/features/
+layers.py``): Spectrogram / MelSpectrogram / LogMelSpectrogram / MFCC as
+nn Layers — each forward is one fused XLA program (stft + matmul + log)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..nn.layer.layers import Layer
+from ..tensor import Tensor
+from .. import signal as _signal
+from .functional import compute_fbank_matrix, power_to_db, create_dct
+from .window import get_window
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.fft_window = get_window(window, self.win_length, fftbins=True,
+                                     dtype=dtype)
+
+    def forward(self, x):
+        spec = _signal.stft(x, self.n_fft, self.hop_length, self.win_length,
+                            window=self.fft_window, center=self.center,
+                            pad_mode=self.pad_mode)
+        return spec.abs() ** self.power
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        dtype)
+        self.n_mels = n_mels
+        self.fbank_matrix = compute_fbank_matrix(
+            sr=sr, n_fft=n_fft, n_mels=n_mels, f_min=f_min, f_max=f_max,
+            htk=htk, norm=norm, dtype=dtype)
+
+    def forward(self, x):
+        spec = self._spectrogram(x)  # [..., freq, frames]
+        return self.fbank_matrix @ spec
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 ref_value=1.0, amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = self._melspectrogram(x)
+        return power_to_db(mel, ref_value=self.ref_value, amin=self.amin,
+                           top_db=self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype)
+        self.dct_matrix = create_dct(n_mfcc=n_mfcc, n_mels=n_mels,
+                                     dtype=dtype)
+
+    def forward(self, x):
+        log_mel = self._log_melspectrogram(x)  # [..., n_mels, frames]
+        from ..ops.linalg import matmul
+        from ..ops.manipulation import transpose
+        # dct^T @ log_mel -> [..., n_mfcc, frames]
+        ndim = len(log_mel.shape)
+        perm = list(range(ndim - 2)) + [ndim - 1, ndim - 2]
+        return transpose(matmul(transpose(log_mel, perm), self.dct_matrix),
+                         perm)

@@ -1,0 +1,107 @@
+"""``paddle.audio.functional`` (ref: ``python/paddle/audio/functional/
+functional.py``): mel scales, filterbanks, dB conversion, DCT."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct"]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def hz_to_mel(freq, htk=False):
+    """Ref ``functional.py:22`` — slaney (default) or HTK mel scale."""
+    scalar = isinstance(freq, (int, float))
+    f = jnp.asarray(freq, jnp.float32) if scalar else _arr(freq)
+    if htk:
+        mel = 2595.0 * jnp.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mel = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mel = jnp.where(f >= min_log_hz,
+                        min_log_mel + jnp.log(
+                            jnp.maximum(f, 1e-10) / min_log_hz) / logstep,
+                        mel)
+    return float(mel) if scalar else Tensor(mel)
+
+
+def mel_to_hz(mel, htk=False):
+    scalar = isinstance(mel, (int, float))
+    m = jnp.asarray(mel, jnp.float32) if scalar else _arr(mel)
+    if htk:
+        hz = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        hz = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        hz = jnp.where(m >= min_log_mel,
+                       min_log_hz * jnp.exp(logstep * (m - min_log_mel)),
+                       hz)
+    return float(hz) if scalar else Tensor(hz)
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    lo = hz_to_mel(float(f_min), htk)
+    hi = hz_to_mel(float(f_max), htk)
+    mels = jnp.linspace(lo, hi, n_mels)
+    return mel_to_hz(Tensor(mels), htk)
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    return Tensor(jnp.linspace(0, sr / 2, 1 + n_fft // 2))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """Triangular mel filterbank [n_mels, 1 + n_fft//2]
+    (ref ``functional.py:186``)."""
+    f_max = f_max or sr / 2
+    fftfreqs = fft_frequencies(sr, n_fft)._data
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)._data
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    return Tensor(weights)
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """10*log10 with clamping (ref ``functional.py:259``)."""
+    s = _arr(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, s))
+    log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return Tensor(log_spec) if isinstance(spect, Tensor) else log_spec
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """DCT-II matrix [n_mels, n_mfcc] (ref ``functional.py:303``)."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)[None, :]
+    dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        scale = jnp.full((1, n_mfcc), math.sqrt(2.0 / n_mels))
+        scale = scale.at[0, 0].set(math.sqrt(1.0 / n_mels))
+        dct = dct * scale
+    else:
+        dct = dct * 2.0
+    return Tensor(dct)

@@ -1,0 +1,138 @@
+"""Window functions (ref: ``python/paddle/audio/functional/window.py``
+get_window + registered families)."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+
+__all__ = ["get_window"]
+
+
+def _extend(M, sym):
+    return (M + 1, True) if not sym else (M, False)
+
+
+def _truncate(w, trunc):
+    return w[:-1] if trunc else w
+
+
+def _general_cosine(M, a, sym):
+    M, trunc = _extend(M, sym)
+    fac = jnp.linspace(-math.pi, math.pi, M)
+    w = jnp.zeros(M)
+    for k, ak in enumerate(a):
+        w = w + ak * jnp.cos(k * fac)
+    return _truncate(w, trunc)
+
+
+def _hamming(M, sym=True):
+    return _general_cosine(M, [0.54, 0.46], sym)
+
+
+def _hann(M, sym=True):
+    return _general_cosine(M, [0.5, 0.5], sym)
+
+
+def _blackman(M, sym=True):
+    return _general_cosine(M, [0.42, 0.50, 0.08], sym)
+
+
+def _nuttall(M, sym=True):
+    return _general_cosine(M, [0.3635819, 0.4891775, 0.1365995, 0.0106411],
+                           sym)
+
+
+def _gaussian(M, std, sym=True):
+    M, trunc = _extend(M, sym)
+    n = jnp.arange(M) - (M - 1) / 2
+    return _truncate(jnp.exp(-0.5 * (n / std) ** 2), trunc)
+
+
+def _exponential(M, center=None, tau=1.0, sym=True):
+    M, trunc = _extend(M, sym)
+    if center is None:
+        center = (M - 1) / 2
+    n = jnp.arange(M)
+    return _truncate(jnp.exp(-jnp.abs(n - center) / tau), trunc)
+
+
+def _triang(M, sym=True):
+    M, trunc = _extend(M, sym)
+    n = jnp.arange(1, (M + 1) // 2 + 1)
+    if M % 2 == 0:
+        w = (2 * n - 1.0) / M
+        w = jnp.concatenate([w, w[::-1]])
+    else:
+        w = 2 * n / (M + 1.0)
+        w = jnp.concatenate([w, w[-2::-1]])
+    return _truncate(w, trunc)
+
+
+def _bohman(M, sym=True):
+    M, trunc = _extend(M, sym)
+    fac = jnp.abs(jnp.linspace(-1, 1, M))
+    w = (1 - fac) * jnp.cos(math.pi * fac) + \
+        1.0 / math.pi * jnp.sin(math.pi * fac)
+    w = w.at[0].set(0).at[-1].set(0)
+    return _truncate(w, trunc)
+
+
+def _cosine(M, sym=True):
+    M, trunc = _extend(M, sym)
+    return _truncate(jnp.sin(math.pi / M * (jnp.arange(M) + 0.5)), trunc)
+
+
+def _tukey(M, alpha=0.5, sym=True):
+    M, trunc = _extend(M, sym)
+    if alpha <= 0:
+        w = jnp.ones(M)
+    elif alpha >= 1:
+        w = _hann(M, sym=True)
+        return _truncate(w, trunc)
+    else:
+        n = jnp.arange(M)
+        width = int(alpha * (M - 1) / 2)
+        w = jnp.ones(M)
+        edge = 0.5 * (1 + jnp.cos(math.pi * (-1 + 2.0 * n / alpha / (M - 1))))
+        tail = 0.5 * (1 + jnp.cos(
+            math.pi * (-2.0 / alpha + 1 + 2.0 * n / alpha / (M - 1))))
+        w = jnp.where(n <= width, edge, w)
+        w = jnp.where(n >= M - width - 1, tail, w)
+    return _truncate(w, trunc)
+
+
+_WINDOWS = {
+    "hamming": _hamming,
+    "hann": _hann,
+    "blackman": _blackman,
+    "nuttall": _nuttall,
+    "gaussian": _gaussian,
+    "exponential": _exponential,
+    "triang": _triang,
+    "bohman": _bohman,
+    "cosine": _cosine,
+    "tukey": _tukey,
+}
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """``paddle.audio.functional.get_window``: name or (name, arg) tuple;
+    ``fftbins=True`` means periodic (sym=False)."""
+    sym = not fftbins
+    if isinstance(window, str):
+        name, args = window, ()
+    elif isinstance(window, tuple):
+        name, args = window[0], window[1:]
+    else:
+        raise ValueError(f"unsupported window spec: {window!r}")
+    fn = _WINDOWS.get(name)
+    if fn is None:
+        raise ValueError(f"unknown window '{name}' "
+                         f"(available: {sorted(_WINDOWS)})")
+    w = fn(win_length, *args, sym=sym)
+    from ..framework.dtype import to_jax_dtype
+    return Tensor(w.astype(to_jax_dtype(dtype)))

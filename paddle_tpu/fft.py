@@ -1,0 +1,76 @@
+"""``paddle.fft`` (ref: ``python/paddle/fft.py``): discrete Fourier
+transforms over ``jnp.fft`` — XLA lowers these to its native FFT (TPU has a
+dedicated FFT path), replacing the reference's cuFFT/pocketfft backends
+(``paddle/phi/kernels/funcs/fft.cc``)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .ops.op_utils import unary
+from .tensor import Tensor
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2",
+    "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn", "fftfreq",
+    "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _norm(norm):
+    # paddle: "backward" | "ortho" | "forward" — same contract as numpy
+    return norm or "backward"
+
+
+def _mk1(jfn):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        return unary(lambda d: jfn(d, n=n, axis=axis, norm=_norm(norm)), x,
+                     name=jfn.__name__)
+    return op
+
+
+def _mk2(jfn):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return unary(lambda d: jfn(d, s=s, axes=axes, norm=_norm(norm)), x,
+                     name=jfn.__name__)
+    return op
+
+
+def _mkn(jfn):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        return unary(lambda d: jfn(d, s=s, axes=axes, norm=_norm(norm)), x,
+                     name=jfn.__name__)
+    return op
+
+
+fft = _mk1(jnp.fft.fft)
+ifft = _mk1(jnp.fft.ifft)
+rfft = _mk1(jnp.fft.rfft)
+irfft = _mk1(jnp.fft.irfft)
+hfft = _mk1(jnp.fft.hfft)
+ihfft = _mk1(jnp.fft.ihfft)
+fft2 = _mk2(jnp.fft.fft2)
+ifft2 = _mk2(jnp.fft.ifft2)
+rfft2 = _mk2(jnp.fft.rfft2)
+irfft2 = _mk2(jnp.fft.irfft2)
+fftn = _mkn(jnp.fft.fftn)
+ifftn = _mkn(jnp.fft.ifftn)
+rfftn = _mkn(jnp.fft.rfftn)
+irfftn = _mkn(jnp.fft.irfftn)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.fftfreq(int(n), d=float(d)))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.rfftfreq(int(n), d=float(d)))
+
+
+def fftshift(x, axes=None, name=None):
+    return unary(lambda d: jnp.fft.fftshift(d, axes=axes), x,
+                 name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    return unary(lambda d: jnp.fft.ifftshift(d, axes=axes), x,
+                 name="ifftshift")

@@ -1,3 +1,8 @@
+from .bert import (  # noqa: F401
+    BertConfig, BertModel, BertForSequenceClassification,
+    BertForPretraining, BertPretrainingCriterion, bert_tiny, bert_base,
+    bert_large,
+)
 from .gpt import (  # noqa: F401
     GPTConfig, GPTModel, GPTForCausalLM, GPTPretrainingCriterion,
     gpt_tiny, gpt_345m, gpt_1p3b, gpt_6p7b, gpt_13b,

@@ -1,0 +1,201 @@
+"""BERT encoder family (baseline config[1]: BERT-base SST-2 finetune under
+to_static).
+
+The reference exercises BERT through its dygraph→static tests
+(``test/dygraph_to_static/bert_dygraph_model.py``: PrePostProcessLayer /
+MultiHeadAttention / encoder stack + pretraining heads) with the same
+building blocks this framework re-designs. TPU-first choices mirror GPT:
+bf16-first compute via AMP, ``F.scaled_dot_product_attention`` (Pallas
+flash path on hardware), optional TP via the same parallel layers, and the
+whole finetune step compiled by ``to_static`` into one XLA program.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...tensor import Tensor
+from ...nn.layer.layers import Layer
+from ...nn.layer.common import Linear, Dropout, Embedding
+from ...nn.layer.norm import LayerNorm
+from ...nn.layer.container import LayerList
+from ...nn import functional as F
+
+__all__ = ["BertConfig", "BertModel", "BertForSequenceClassification",
+           "BertForPretraining", "BertPretrainingCriterion", "bert_tiny",
+           "bert_base", "bert_large"]
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30528          # padded to a multiple of 64 for MXU
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+
+
+class BertEmbeddings(Layer):
+    """word + position + token-type embeddings → LN → dropout."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.word_embeddings = Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = Embedding(cfg.max_position_embeddings,
+                                             cfg.hidden_size)
+        self.token_type_embeddings = Embedding(cfg.type_vocab_size,
+                                               cfg.hidden_size)
+        self.layer_norm = LayerNorm(cfg.hidden_size,
+                                    epsilon=cfg.layer_norm_eps)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        seq_len = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = Tensor(jnp.arange(seq_len)[None, :])
+        if token_type_ids is None:
+            token_type_ids = Tensor(
+                jnp.zeros((1, seq_len), jnp.int32))
+        emb = (self.word_embeddings(input_ids)
+               + self.position_embeddings(position_ids)
+               + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertSelfAttention(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.num_heads = cfg.num_attention_heads
+        self.head_dim = cfg.hidden_size // cfg.num_attention_heads
+        self.qkv = Linear(cfg.hidden_size, 3 * cfg.hidden_size)
+        self.out = Linear(cfg.hidden_size, cfg.hidden_size)
+        self.attn_drop = cfg.attention_probs_dropout_prob
+        self.proj_drop = Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, x, attention_mask=None):
+        B, T, H = x.shape
+        qkv = self.qkv(x).reshape([B, T, 3, self.num_heads, self.head_dim])
+        q, k, v = (qkv[:, :, i] for i in range(3))
+        ctx = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attention_mask,
+            dropout_p=self.attn_drop if self.training else 0.0,
+            is_causal=False)
+        return self.proj_drop(self.out(ctx.reshape([B, T, H])))
+
+
+class BertLayer(Layer):
+    """post-LN transformer block (BERT convention)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.attention = BertSelfAttention(cfg)
+        self.ln1 = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.fc1 = Linear(cfg.hidden_size, cfg.intermediate_size)
+        self.fc2 = Linear(cfg.intermediate_size, cfg.hidden_size)
+        self.ln2 = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, x, attention_mask=None):
+        x = self.ln1(x + self.attention(x, attention_mask))
+        h = self.fc2(F.gelu(self.fc1(x)))
+        return self.ln2(x + self.dropout(h))
+
+
+class BertModel(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        self.encoder = LayerList([BertLayer(cfg)
+                                  for _ in range(cfg.num_layers)])
+        self.pooler = Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        if attention_mask is not None and len(attention_mask.shape) == 2:
+            # [B, T] padding mask → additive [B, 1, 1, T]
+            m = attention_mask.astype("float32")
+            attention_mask = (m - 1.0).unsqueeze(1).unsqueeze(1) * 1e4
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        for layer in self.encoder:
+            x = layer(x, attention_mask)
+        pooled = F.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class BertForSequenceClassification(Layer):
+    """SST-2-style finetune head (config[1])."""
+
+    def __init__(self, cfg: BertConfig, num_classes=2):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+        self.classifier = Linear(cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids,
+                              attention_mask=attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+class BertForPretraining(Layer):
+    """MLM + NSP heads (ref bert_dygraph_model.py PretrainModelLayer)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.mlm_transform = Linear(cfg.hidden_size, cfg.hidden_size)
+        self.mlm_ln = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.mlm_bias = self.create_parameter(
+            [cfg.vocab_size], is_bias=True)
+        self.nsp = Linear(cfg.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                masked_positions=None):
+        seq, pooled = self.bert(input_ids, token_type_ids,
+                                attention_mask=attention_mask)
+        h = self.mlm_ln(F.gelu(self.mlm_transform(seq)))
+        # tied decoder: logits = h @ word_emb^T + bias
+        w = self.bert.embeddings.word_embeddings.weight
+        mlm_logits = F.linear(h, w.transpose([1, 0])) + self.mlm_bias
+        nsp_logits = self.nsp(pooled)
+        return mlm_logits, nsp_logits
+
+
+class BertPretrainingCriterion(Layer):
+    def forward(self, mlm_logits, nsp_logits, masked_lm_labels,
+                next_sentence_labels, masked_lm_weights=None):
+        mlm = F.cross_entropy(
+            mlm_logits.reshape([-1, mlm_logits.shape[-1]]),
+            masked_lm_labels.reshape([-1]), reduction="none")
+        if masked_lm_weights is not None:
+            w = masked_lm_weights.reshape([-1]).astype("float32")
+            mlm = (mlm * w).sum() / (w.sum() + 1e-6)
+        else:
+            mlm = mlm.mean()
+        nsp = F.cross_entropy(nsp_logits, next_sentence_labels)
+        return mlm + nsp
+
+
+def bert_tiny(**kw):
+    return BertConfig(vocab_size=1024, hidden_size=64, num_layers=2,
+                      num_attention_heads=2, intermediate_size=128,
+                      max_position_embeddings=128, **kw)
+
+
+def bert_base(**kw):
+    return BertConfig(**kw)
+
+
+def bert_large(**kw):
+    return BertConfig(hidden_size=1024, num_layers=24,
+                      num_attention_heads=16, intermediate_size=4096, **kw)

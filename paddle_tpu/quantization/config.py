@@ -1,5 +1,9 @@
 """QuantConfig (ref: ``python/paddle/quantization/config.py``): maps layers
-/ layer types to activation+weight quanter prototypes."""
+/ layer types / layer names to activation+weight quanter prototypes.
+
+Entries are structured (not opaque predicates) so QAT/PTQ can translate
+layer identities through the deepcopy they perform when ``inplace=False``.
+"""
 from __future__ import annotations
 
 __all__ = ["QuantConfig"]
@@ -9,33 +13,49 @@ class QuantConfig:
     def __init__(self, activation=None, weight=None):
         self._default_act = activation
         self._default_weight = weight
-        self._layer_configs = []   # (predicate, act, weight)
+        # entries: {"kind": "layers"|"types"|"names", "payload", act, weight}
+        self._entries = []
 
     def add_layer_config(self, layer, activation=None, weight=None):
         layers = layer if isinstance(layer, (list, tuple)) else [layer]
-        ids = {id(l) for l in layers}
-        self._layer_configs.append(
-            (lambda l: id(l) in ids, activation, weight))
+        self._entries.append({"kind": "layers",
+                              "payload": {id(l) for l in layers},
+                              "act": activation, "weight": weight})
 
     def add_type_config(self, layer_type, activation=None, weight=None):
         types = tuple(layer_type) if isinstance(layer_type, (list, tuple)) \
             else (layer_type,)
-        self._layer_configs.append(
-            (lambda l: isinstance(l, types), activation, weight))
+        self._entries.append({"kind": "types", "payload": types,
+                              "act": activation, "weight": weight})
 
     def add_name_config(self, layer_name, activation=None, weight=None):
-        names = layer_name if isinstance(layer_name, (list, tuple)) \
-            else [layer_name]
-        self._layer_configs.append(
-            (lambda l: getattr(l, "_full_name", "") in names,
-             activation, weight))
+        names = set(layer_name if isinstance(layer_name, (list, tuple))
+                    else [layer_name])
+        self._entries.append({"kind": "names", "payload": names,
+                              "act": activation, "weight": weight})
+
+    def translate_ids(self, memo):
+        """After ``copy.deepcopy(model, memo)``, rewrite layer-identity
+        entries to the copied objects (memo maps id(original) -> copy)."""
+        for e in self._entries:
+            if e["kind"] == "layers":
+                e["payload"] = {id(memo[oid]) for oid in e["payload"]
+                                if oid in memo} | e["payload"]
 
     def config_for(self, layer):
         """(act_quanter, weight_quanter) prototypes for this layer, or
         (None, None) if unquantized."""
-        for pred, act, w in self._layer_configs:
-            if pred(layer):
-                return act, w
+        for e in self._entries:
+            kind, payload = e["kind"], e["payload"]
+            if kind == "layers" and id(layer) in payload:
+                return e["act"], e["weight"]
+            if kind == "types" and isinstance(layer, payload):
+                return e["act"], e["weight"]
+            if kind == "names":
+                name = layer.full_name() if hasattr(layer, "full_name") \
+                    else ""
+                if name in payload:
+                    return e["act"], e["weight"]
         if self._default_act is not None or self._default_weight is not None:
             return self._default_act, self._default_weight
         return None, None

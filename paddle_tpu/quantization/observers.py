@@ -77,17 +77,24 @@ class HistObserver(BaseObserver):
     def observe(self, x):
         a = np.abs(_np(x)).ravel()
         m = float(a.max()) if a.size else 0.0
-        if self._hist is None or m > self._max:
-            # rebin against the new max
-            self._max = max(m, self._max, 1e-9)
-            hist, _ = np.histogram(a, bins=self.bins,
-                                   range=(0, self._max))
-            if self._hist is None:
-                self._hist = hist.astype(np.float64)
-            else:
-                self._hist += hist
+        if self._hist is not None and m > self._max:
+            # range grew: redistribute old counts into the new binning by
+            # bin-center value, otherwise old magnitudes are inflated
+            old_centers = (np.arange(self.bins) + 0.5) / self.bins * self._max
+            new_max = max(m, 1e-9)
+            new_idx = np.minimum(
+                (old_centers / new_max * self.bins).astype(np.int64),
+                self.bins - 1)
+            rebinned = np.zeros(self.bins, np.float64)
+            np.add.at(rebinned, new_idx, self._hist)
+            self._hist = rebinned
+            self._max = new_max
+        elif self._hist is None:
+            self._max = max(m, 1e-9)
+        hist, _ = np.histogram(a, bins=self.bins, range=(0, self._max))
+        if self._hist is None:
+            self._hist = hist.astype(np.float64)
         else:
-            hist, _ = np.histogram(a, bins=self.bins, range=(0, self._max))
             self._hist += hist
         cdf = np.cumsum(self._hist) / self._hist.sum()
         idx = int(np.searchsorted(cdf, self.percentile))

@@ -34,9 +34,17 @@ class PTQ:
     def quantize(self, model, inplace=False):
         if not inplace:
             import copy
-            model = copy.deepcopy(model)
+            memo = {}
+            model = copy.deepcopy(model, memo)
+            self._config.translate_ids(memo)
 
         def make(layer):
+            # only observe quantizable leaves — containers must be recursed
+            # into, not wrapped whole (their inner Linear/Conv would never
+            # be observed)
+            from ..nn import Linear, Conv2D
+            if not isinstance(layer, (Linear, Conv2D)):
+                return None
             act_proto, w_proto = self._config.config_for(layer)
             if act_proto is None and w_proto is None:
                 return None

@@ -29,7 +29,10 @@ class QAT:
         """Insert fake-quant (quanter) wrappers per the QuantConfig."""
         if not inplace:
             import copy
-            model = copy.deepcopy(model)
+            memo = {}
+            model = copy.deepcopy(model, memo)
+            # layer-identity configs must follow their layers into the copy
+            self._config.translate_ids(memo)
 
         def make(layer):
             act_proto, w_proto = self._config.config_for(layer)

@@ -1,0 +1,122 @@
+"""``paddle.signal`` (ref: ``python/paddle/signal.py``): stft / istft built
+from framing + ``jnp.fft`` (one fused XLA program; no cuFFT plan cache
+needed)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .ops.op_utils import ensure_tensor, nary, unary
+from .tensor import Tensor
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice into overlapping frames (ref ``signal.py frame``)."""
+    def f(d):
+        if axis not in (-1, d.ndim - 1):
+            raise NotImplementedError("frame supports the last axis")
+        n = d.shape[-1]
+        num = 1 + (n - frame_length) // hop_length
+        idx = (jnp.arange(frame_length)[None, :]
+               + hop_length * jnp.arange(num)[:, None])
+        out = d[..., idx]  # [..., num, frame_length]
+        # paddle layout: [..., frame_length, num_frames]
+        return jnp.swapaxes(out, -1, -2)
+    return unary(f, x, name="frame")
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame (ref ``signal.py overlap_add``)."""
+    def f(d):
+        # paddle layout: [..., frame_length, num_frames]
+        frame_length = d.shape[-2]
+        num = d.shape[-1]
+        n = frame_length + hop_length * (num - 1)
+        frames = jnp.swapaxes(d, -1, -2)  # [..., num, frame_length]
+        idx = (jnp.arange(frame_length)[None, :]
+               + hop_length * jnp.arange(num)[:, None])  # [num, fl]
+        out = jnp.zeros(d.shape[:-2] + (n,), d.dtype)
+        return out.at[..., idx].add(frames)
+    return unary(f, x, name="overlap_add")
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform (ref ``signal.py stft``).
+
+    Returns [..., n_fft//2+1 (or n_fft), num_frames] complex."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    if window is None:
+        win = jnp.ones(win_length, jnp.float32)
+    else:
+        win = window._data if isinstance(window, Tensor) else \
+            jnp.asarray(window)
+    if win_length < n_fft:  # center-pad window to n_fft
+        pad = n_fft - win_length
+        win = jnp.pad(win, (pad // 2, pad - pad // 2))
+
+    def f(d):
+        if center:
+            pad = n_fft // 2
+            d = jnp.pad(d, [(0, 0)] * (d.ndim - 1) + [(pad, pad)],
+                        mode=pad_mode)
+        n = d.shape[-1]
+        num = 1 + (n - n_fft) // hop_length
+        idx = (jnp.arange(n_fft)[None, :]
+               + hop_length * jnp.arange(num)[:, None])
+        frames = d[..., idx] * win  # [..., num, n_fft]
+        spec = jnp.fft.rfft(frames, axis=-1) if onesided \
+            else jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return jnp.swapaxes(spec, -1, -2)  # [..., freq, frames]
+    return unary(f, x, name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT with window-square normalization (ref ``istft``)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    if window is None:
+        win = jnp.ones(win_length, jnp.float32)
+    else:
+        win = window._data if isinstance(window, Tensor) else \
+            jnp.asarray(window)
+    if win_length < n_fft:
+        pad = n_fft - win_length
+        win = jnp.pad(win, (pad // 2, pad - pad // 2))
+
+    def f(d):
+        spec = jnp.swapaxes(d, -1, -2)  # [..., frames, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        frames = jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided \
+            else jnp.fft.ifft(spec, axis=-1).real
+        frames = frames * win
+        num = frames.shape[-2]
+        n = n_fft + hop_length * (num - 1)
+        idx = (jnp.arange(n_fft)[None, :]
+               + hop_length * jnp.arange(num)[:, None])
+        out = jnp.zeros(frames.shape[:-2] + (n,), frames.dtype)
+        out = out.at[..., idx].add(frames)
+        norm = jnp.zeros(n, frames.dtype).at[idx.reshape(-1)].add(
+            jnp.tile(win ** 2, num))
+        out = out / jnp.maximum(norm, 1e-11)
+        if center:
+            out = out[..., n_fft // 2: n - n_fft // 2]
+        if length is not None:
+            if out.shape[-1] < length:  # pad the un-reconstructible tail
+                out = jnp.pad(out, [(0, 0)] * (out.ndim - 1)
+                              + [(0, length - out.shape[-1])])
+            out = out[..., :length]
+        return out
+    return unary(f, x, name="istft")

@@ -269,15 +269,14 @@ def multiply(x, y, name=None):
     if isinstance(y, (int, float)):
         return _unary(lambda v: v * y)(x)
     if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
-        # same-pattern fast path, else dense fallback
-        a, b = _coo(x), _coo(y)
-        if a.indices.shape == b.indices.shape:
-            a = jsparse.bcoo_sum_duplicates(a)
-            b = jsparse.bcoo_sum_duplicates(b)
-            if bool(jnp.all(a.indices == b.indices)):
-                return _same_kind(x, jsparse.BCOO((a.data * b.data,
-                                                   a.indices),
-                                                  shape=a.shape))
+        # same-pattern fast path, else dense fallback (dedup BEFORE the
+        # shape comparison — duplicates change nse)
+        a = jsparse.bcoo_sum_duplicates(_coo(x))
+        b = jsparse.bcoo_sum_duplicates(_coo(y))
+        if a.indices.shape == b.indices.shape and \
+                bool(jnp.all(a.indices == b.indices)):
+            return _same_kind(x, jsparse.BCOO((a.data * b.data, a.indices),
+                                              shape=a.shape))
         return Tensor(a.todense() * b.todense())
     # sparse * dense: gather dense at indices
     a = jsparse.bcoo_sum_duplicates(_coo(x))

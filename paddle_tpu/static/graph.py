@@ -369,7 +369,9 @@ def _maybe_record(fn, tensors, outputs_wrap, name):
         specs2 = []
         for t, sp in zip(tensors, specs):
             if isinstance(t, Variable) and -1 in t._sym_shape:
-                shape2 = tuple(2 if sd == -1 else d
+                # probe with rep+1 (never a constant — the rep size itself
+                # may equal the constant and mask the dynamic dim)
+                shape2 = tuple(d + 1 if sd == -1 else d
                                for sd, d in zip(t._sym_shape, sp.shape))
                 specs2.append(jax.ShapeDtypeStruct(shape2, sp.dtype))
             else:

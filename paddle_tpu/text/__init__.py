@@ -1,0 +1,16 @@
+"""``paddle.text`` (ref: ``python/paddle/text/``): viterbi decode + dataset
+classes.
+
+Dataset note: the reference datasets stream from Baidu mirrors
+(``python/paddle/text/datasets/*.py`` DATA_URL). This framework is built
+for air-gapped TPU pods, so each dataset accepts ``data_file`` (a local
+copy, same format as the reference) and offers ``synthetic=True`` to
+generate a deterministic synthetic split with the right schema for
+pipeline tests — the pattern the reference's unit tests use for speed.
+"""
+from .viterbi import viterbi_decode, ViterbiDecoder  # noqa: F401
+from . import datasets  # noqa: F401
+from .datasets import Imdb, Imikolov, UCIHousing, Conll05st, Movielens  # noqa: F401
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets", "Imdb",
+           "Imikolov", "UCIHousing", "Conll05st", "Movielens"]

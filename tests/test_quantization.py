@@ -113,7 +113,56 @@ class TestQAT:
         np.testing.assert_allclose(w, grid * s / 127, atol=1e-6)
 
 
+class TestConfigTargeting:
+    def test_layer_config_survives_deepcopy(self):
+        model = _model()
+        cfg = Q.QuantConfig()
+        cfg.add_layer_config(model[0],
+                             activation=Q.FakeQuanterWithAbsMaxObserver(),
+                             weight=Q.FakeQuanterWithAbsMaxObserver())
+        qmodel = Q.QAT(cfg).quantize(model)  # inplace=False → deepcopy
+        kinds = [type(l).__name__ for l in qmodel.sublayers()]
+        assert kinds.count("QuantedLinear") == 1
+        # original untouched
+        assert all(type(l).__name__ != "QuantedLinear"
+                   for l in model.sublayers())
+
+    def test_type_config(self):
+        model = _model()
+        cfg = Q.QuantConfig()
+        cfg.add_type_config(pt.nn.Linear,
+                            weight=Q.FakeQuanterWithAbsMaxObserver())
+        qmodel = Q.QAT(cfg).quantize(model, inplace=True)
+        kinds = [type(l).__name__ for l in qmodel.sublayers()]
+        assert kinds.count("QuantedLinear") == 2
+
+    def test_hist_observer_range_growth(self):
+        obs = Q.HistObserver(percentile=0.99)
+        # batch of small values, then one big outlier batch
+        obs.observe(pt.to_tensor(np.full(1000, 0.99, np.float32)))
+        obs.observe(pt.to_tensor(np.array([10.0], np.float32)))
+        # 99th percentile of {1000×0.99, 1×10.0} must stay near 1, not 10
+        assert obs.scales() < 2.0
+
+
 class TestPTQ:
+    def test_nested_layers_observed(self):
+        pt.seed(0)
+        model = pt.nn.Sequential(
+            pt.nn.Sequential(pt.nn.Linear(4, 8), pt.nn.ReLU()),
+            pt.nn.Linear(8, 2))
+        cfg = Q.QuantConfig(activation=Q.AbsmaxObserver(),
+                            weight=Q.AbsmaxObserver())
+        qmodel = Q.PTQ(cfg).quantize(model, inplace=True)
+        kinds = [type(l).__name__ for l in qmodel.sublayers()]
+        assert kinds.count("_ObservedLayer") == 2  # both Linears, not the
+        # container
+        qmodel(pt.to_tensor(np.ones((2, 4), np.float32)))
+        deployed = Q.PTQ(cfg).convert(qmodel, inplace=True)
+        linears = [l for l in deployed.sublayers()
+                   if type(l).__name__ == "Linear"]
+        assert all(hasattr(l, "quant_scale") for l in linears)
+
     def test_calibrate_and_convert(self):
         model = _model()
         cfg = Q.QuantConfig(activation=Q.AbsmaxObserver(),
