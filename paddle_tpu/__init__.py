@@ -58,6 +58,7 @@ def _optional_submodules():
              "vision", "metric", "hapi", "profiler", "static", "incubate",
              "sparse", "distribution", "text", "audio", "quantization",
              "utils", "fft", "signal", "models", "callbacks", "regularizer",
+             "inference",
              "onnx"]
     loaded = {}
     for n in names:

@@ -1,0 +1,18 @@
+"""``paddle.distributed.auto_tuner`` — parallel-config search.
+
+TPU-native re-design of the reference auto-tuner
+(``python/paddle/distributed/auto_tuner/{tuner,search,prune,recorder}.py``):
+grid/prune search over dp/mp(tp)/pp/sharding/micro-batch/recompute
+candidates, a prune-rule registry, and a recorder of trial metrics. On TPU
+the candidate axes map to mesh-shape choices (``dp × mp × pp × sharding``
+must tile the chip count; GSPMD takes the chosen shape via
+``paddle_tpu.distributed.mesh``), so the same tuner drives mesh-shape
+search instead of launcher re-invocations.
+"""
+from .tuner import AutoTuner  # noqa: F401
+from .search import GridSearch, SearchAlgo  # noqa: F401
+from .prune import register_prune, prune_by_rules, PRUNE_RULES  # noqa: F401
+from .recorder import HistoryRecorder  # noqa: F401
+
+__all__ = ["AutoTuner", "GridSearch", "SearchAlgo", "register_prune",
+           "prune_by_rules", "PRUNE_RULES", "HistoryRecorder"]

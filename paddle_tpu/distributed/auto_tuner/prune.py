@@ -1,0 +1,96 @@
+"""Prune rules (ref: ``auto_tuner/prune.py`` _PRUNE_FUNC registry): each
+rule gets (tuner_cfg, cur_cfg, history_cfgs) and returns True to prune."""
+from __future__ import annotations
+
+__all__ = ["register_prune", "prune_by_rules", "PRUNE_RULES"]
+
+PRUNE_RULES = []
+
+
+def register_prune(fn):
+    PRUNE_RULES.append(fn)
+    return fn
+
+
+def prune_by_rules(tuner_cfg, cur_cfg, history_cfgs=None):
+    history_cfgs = history_cfgs or []
+    return any(rule(tuner_cfg, cur_cfg, history_cfgs)
+               for rule in PRUNE_RULES)
+
+
+@register_prune
+def prune_by_num_chips(tuner_cfg, cur_cfg, history):
+    """dp*mp*pp*sharding must exactly tile the chip count (mesh shape)."""
+    n = tuner_cfg.get("num_gpus") or tuner_cfg.get("num_chips")
+    if n is None:
+        return False
+    degree = 1
+    for k in ("dp_degree", "mp_degree", "pp_degree", "sharding_degree"):
+        v = cur_cfg.get(k)
+        if v:
+            degree *= v
+    return degree != n
+
+@register_prune
+def prune_by_mp_bound(tuner_cfg, cur_cfg, history):
+    """mp beyond one host's chips rides DCN, not ICI — prune unless
+    explicitly allowed (ref prune_by_mp_degree)."""
+    mp = cur_cfg.get("mp_degree")
+    bound = tuner_cfg.get("max_mp_degree")
+    return bound is not None and mp is not None and mp > bound
+
+
+@register_prune
+def prune_by_micro_batch(tuner_cfg, cur_cfg, history):
+    """global batch must divide into dp*sharding*micro_batch."""
+    gbs = tuner_cfg.get("global_batch_size")
+    mbs = cur_cfg.get("micro_batch_size")
+    if gbs is None or mbs is None:
+        return False
+    dp = (cur_cfg.get("dp_degree") or 1) * (cur_cfg.get("sharding_degree")
+                                            or 1)
+    if gbs % dp != 0:
+        return True
+    per = gbs // dp
+    return per % mbs != 0
+
+
+@register_prune
+def prune_by_sharding_stage(tuner_cfg, cur_cfg, history):
+    """stage>0 needs sharding_degree>1."""
+    stage = cur_cfg.get("sharding_stage")
+    deg = cur_cfg.get("sharding_degree") or 1
+    return bool(stage) and stage > 0 and deg <= 1
+
+
+@register_prune
+def prune_by_recompute(tuner_cfg, cur_cfg, history):
+    """granularity only meaningful when recompute is on."""
+    use = cur_cfg.get("use_recompute")
+    gran = cur_cfg.get("recompute_granularity")
+    return use is False and gran not in (None, "none")
+
+
+@register_prune
+def prune_by_history_oom(tuner_cfg, cur_cfg, history):
+    """a strictly-more-memory-hungry config than an OOM'd one is pruned
+    (ref prune_by_mbs/memory heuristics)."""
+    for h in history:
+        if h.get("status") != "oom":
+            continue
+        cur_r = bool(cur_cfg.get("use_recompute", False))
+        h_r = bool(h.get("use_recompute", False))
+        # cur uses at least as much memory per chip as the OOM'd config:
+        # bigger (or equal) micro-batch, no more splitting on ANY
+        # memory-reducing axis (mp, pp, sharding), and no recompute
+        # advantage over it
+        if (cur_cfg.get("micro_batch_size") or 0) >= \
+                (h.get("micro_batch_size") or 0) and \
+                (cur_cfg.get("mp_degree") or 1) <= (h.get("mp_degree") or 1) \
+                and (cur_cfg.get("pp_degree") or 1) <= \
+                (h.get("pp_degree") or 1) \
+                and (cur_cfg.get("sharding_degree") or 1) <= \
+                (h.get("sharding_degree") or 1) \
+                and ((not cur_r) or h_r):
+            return True
+    return False

@@ -1,0 +1,63 @@
+"""Trial recorder (ref: ``auto_tuner/recorder.py`` History_recorder)."""
+from __future__ import annotations
+
+import csv
+import json
+import os
+
+__all__ = ["HistoryRecorder"]
+
+
+class HistoryRecorder:
+    def __init__(self, metric="throughput", maximize=True):
+        self.history = []
+        self.metric = metric
+        self.maximize = maximize
+
+    def add_cfg(self, **cfg):
+        self.history.append(dict(cfg))
+
+    def sort_metric(self):
+        def key(c):
+            v = c.get(self.metric)
+            if not isinstance(v, (int, float)):  # None / '' after CSV load
+                return float("-inf") if self.maximize else float("inf")
+            return v
+        self.history.sort(key=key, reverse=self.maximize)
+
+    def get_best(self):
+        self.sort_metric()
+        ok = [c for c in self.history
+              if c.get("status", "ok") == "ok" and
+              isinstance(c.get(self.metric), (int, float))]
+        if not ok:
+            return None, True
+        return ok[0], False
+
+    def store_history(self, path="./history.csv"):
+        if not self.history:
+            return
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        keys = sorted({k for c in self.history for k in c})
+        with open(path, "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=keys)
+            w.writeheader()
+            for c in self.history:
+                w.writerow(c)
+
+    def load_history(self, path="./history.csv"):
+        if not os.path.exists(path):
+            return [], True
+        with open(path) as f:
+            rows = list(csv.DictReader(f))
+        for r in rows:
+            for k, v in list(r.items()):
+                if v == "":  # CSV writes None as empty string
+                    r[k] = None
+                    continue
+                try:
+                    r[k] = json.loads(v)
+                except Exception:
+                    pass
+        self.history = rows
+        return rows, False

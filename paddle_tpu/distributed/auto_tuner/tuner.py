@@ -1,0 +1,46 @@
+"""AutoTuner driver (ref: ``auto_tuner/tuner.py:19`` AutoTuner)."""
+from __future__ import annotations
+
+from .recorder import HistoryRecorder
+from .search import GridSearch
+
+__all__ = ["AutoTuner"]
+
+
+class AutoTuner:
+    """Usage (same loop as the reference's launcher integration)::
+
+        tuner = AutoTuner({"candidates": {...}, "num_chips": 8,
+                           "global_batch_size": 64})
+        while (cfg := tuner.search_once()) is not None:
+            metric, status = run_trial(cfg)       # user-provided
+            tuner.add_cfg(**cfg, throughput=metric, status=status)
+        best, _ = tuner.get_best()
+    """
+
+    def __init__(self, tuner_cfg):
+        self.tuner_cfg = dict(tuner_cfg)
+        algo = self.tuner_cfg.get("search_algo", "grid")
+        if algo == "grid":
+            self.algo = GridSearch(self.tuner_cfg)
+        else:
+            raise ValueError(f"unknown search_algo '{algo}'")
+        self.recorder = HistoryRecorder(
+            metric=self.tuner_cfg.get("metric", "throughput"),
+            maximize=self.tuner_cfg.get("maximize", True))
+        self.cur_task_id = 0
+
+    def search_once(self):
+        cfg = self.algo.search_once(self.recorder.history)
+        if cfg is not None:
+            self.cur_task_id += 1
+        return cfg
+
+    def add_cfg(self, **cfg):
+        self.recorder.add_cfg(**cfg)
+
+    def get_best(self):
+        return self.recorder.get_best()
+
+    def search_space_size(self):
+        return len(self.algo.all_cfgs)

@@ -1,0 +1,18 @@
+"""``paddle.distributed.fleet.elastic`` — fault tolerance / elastic scaling.
+
+TPU-native re-design of the reference ElasticManager
+(``python/paddle/distributed/fleet/elastic/manager.py:124``): nodes
+register heartbeats in a coordination store and a watcher detects
+join/leave, recomputes the rank map (``_match`` ``manager.py:417``) and
+restarts local trainers (``LauncherInterface`` ``manager.py:54``).
+
+Mapping: etcd leases → the native-core :class:`~paddle_tpu.core.TCPStore`
+(heartbeat keys with timestamps; rank-0 hosts the store). On TPU pods the
+restart story is "rebuild the mesh from the surviving hosts and resume
+from the latest checkpoint" — a dead chip kills its jax client, so
+in-run self-healing is process-level, exactly like the reference's
+NCCL-abort-then-relaunch model.
+"""
+from .manager import ElasticManager, ElasticStatus, LauncherInterface  # noqa: F401
+
+__all__ = ["ElasticManager", "ElasticStatus", "LauncherInterface"]

@@ -1,0 +1,179 @@
+"""Elastic manager over the native TCPStore (see package docstring)."""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import signal
+import subprocess
+import threading
+import time
+
+__all__ = ["ElasticManager", "ElasticStatus", "LauncherInterface"]
+
+_PREFIX = "elastic/nodes/"
+
+
+class ElasticStatus(enum.Enum):
+    COMPLETED = 0
+    ERROR = 1
+    HOLD = 2        # waiting for np in [np_min, np_max]
+    RESTART = 3     # membership changed; relaunch
+    EXIT = 4
+
+
+class LauncherInterface:
+    """Local trainer process control (ref ``manager.py:54``)."""
+
+    def __init__(self, args):
+        self.args = list(args)
+        self.proc = None
+
+    def launch(self, extra_env=None):
+        env = dict(os.environ)
+        env.update(extra_env or {})
+        self.proc = subprocess.Popen(self.args, env=env)
+        return self.proc
+
+    def stop(self, timeout=10.0):
+        if self.proc is None or self.proc.poll() is not None:
+            return
+        self.proc.send_signal(signal.SIGTERM)
+        try:
+            self.proc.wait(timeout)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait()
+
+    def watch(self):
+        """Returns exit code or None while running."""
+        return None if self.proc is None else self.proc.poll()
+
+
+class ElasticManager:
+    """Heartbeat + membership watcher.
+
+    Args mirror the reference: ``np`` may be "min:max" for elastic range.
+    ``store`` is a connected :class:`paddle_tpu.core.TCPStore` (master on
+    rank-0's host).
+    """
+
+    def __init__(self, store, host, np="1", heartbeat_interval=1.0,
+                 lease_ttl=5.0):
+        self.store = store
+        self.host = host
+        if isinstance(np, str) and ":" in np:
+            lo, hi = np.split(":")
+            self.np_min, self.np_max = int(lo), int(hi)
+        else:
+            self.np_min = self.np_max = int(np)
+        self.interval = heartbeat_interval
+        self.ttl = lease_ttl
+        self._stop = threading.Event()
+        self._membership_changed = threading.Event()
+        self._last_members: list[str] = []
+        self._hb_thread = None
+        self._watch_thread = None
+
+    # -- heartbeats ---------------------------------------------------------
+    def _beat(self):
+        self.store.set(_PREFIX + self.host,
+                       json.dumps({"ts": time.time()}))
+
+    def _heartbeat_loop(self):
+        while not self._stop.is_set():
+            try:
+                self._beat()
+            except Exception:
+                pass
+            self._stop.wait(self.interval)
+
+    def alive_nodes(self):
+        """Hosts whose lease has not expired. Membership is enumerated via
+        atomically-allocated slot keys (see ``register``) — there is no
+        shared read-modify-write, so concurrent joins cannot lose members."""
+        now = time.time()
+        n = self.store.add("elastic/nslots", 0)
+        nodes, seen = [], set()
+        for slot in range(1, n + 1):
+            h = self.store.get(f"elastic/slot/{slot}", wait=False)
+            if not h:
+                continue
+            h = h.decode()
+            if h in seen:
+                continue
+            seen.add(h)
+            v = self.store.get(_PREFIX + h, wait=False)
+            if not v:
+                continue
+            ts = json.loads(v).get("ts", 0)
+            if now - ts <= self.ttl:
+                nodes.append(h)
+        return sorted(nodes)
+
+    def register(self):
+        """Join membership (atomic slot allocation) and start
+        heartbeating. A rejoining host gets a fresh slot; dead slots age
+        out via the heartbeat lease."""
+        slot = self.store.add("elastic/nslots", 1)
+        self.store.set(f"elastic/slot/{slot}", self.host)
+        self._slot = slot
+        self._beat()
+        self._hb_thread = threading.Thread(target=self._heartbeat_loop,
+                                           daemon=True)
+        self._hb_thread.start()
+
+    # -- membership ---------------------------------------------------------
+    def match(self):
+        """Recompute the rank map (ref ``_match`` ``manager.py:417``):
+        returns (ok, hosts, rank_of_self). ok is True when the alive count
+        is inside [np_min, np_max]."""
+        hosts = self.alive_nodes()
+        ok = self.np_min <= len(hosts) <= self.np_max
+        rank = hosts.index(self.host) if self.host in hosts else -1
+        return ok, hosts, rank
+
+    def _watch_loop(self):
+        while not self._stop.is_set():
+            hosts = self.alive_nodes()
+            if self._last_members and hosts != self._last_members:
+                self._membership_changed.set()
+            self._last_members = hosts
+            self._stop.wait(self.interval)
+
+    def watch(self, timeout=None):
+        """Block until membership changes (ref ``watch`` ``manager.py:604``);
+        returns ELASTIC status."""
+        if self._watch_thread is None:
+            self._last_members = self.alive_nodes()
+            self._watch_thread = threading.Thread(target=self._watch_loop,
+                                                  daemon=True)
+            self._watch_thread.start()
+        changed = self._membership_changed.wait(timeout)
+        if not changed:
+            return ElasticStatus.COMPLETED
+        self._membership_changed.clear()
+        ok, hosts, _ = self.match()
+        return ElasticStatus.RESTART if ok else ElasticStatus.HOLD
+
+    def wait_for_np(self, timeout=60.0):
+        """Hold until the alive count enters [np_min, np_max]."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            ok, hosts, rank = self.match()
+            if ok:
+                return hosts, rank
+            time.sleep(self.interval)
+        raise TimeoutError(
+            f"elastic: np stayed outside [{self.np_min},{self.np_max}] "
+            f"for {timeout}s (alive={self.alive_nodes()})")
+
+    def exit(self):
+        self._stop.set()
+        # deregister: clear own slot + heartbeat (both are per-node keys)
+        try:
+            if getattr(self, "_slot", None) is not None:
+                self.store.delete(f"elastic/slot/{self._slot}")
+            self.store.delete(_PREFIX + self.host)
+        except Exception:
+            pass

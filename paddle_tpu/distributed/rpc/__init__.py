@@ -1,68 +1,175 @@
-"""``paddle.distributed.rpc`` parity (ref: ``python/paddle/distributed/rpc/
-rpc.py`` over brpc ``paddle/fluid/distributed/rpc/rpc_agent.cc``).
+"""``paddle.distributed.rpc`` (ref: ``python/paddle/distributed/rpc/rpc.py``
+over the brpc agent ``paddle/fluid/distributed/rpc/rpc_agent.cc``).
 
-TPU-native stance: control-plane RPC between training processes is out of
-the XLA data path; a minimal in-process/multiprocessing implementation
-covers the API (init_rpc, rpc_sync, rpc_async, shutdown) for single-host
-use. Cross-host RPC should ride the user's own transport — the reference's
-brpc dependency is deliberately not replicated.
+TPU-native design: a lightweight socket RPC agent per worker — the
+control-plane companion to the XLA data path. Rendezvous rides the native
+:class:`paddle_tpu.core.TCPStore` (the reference uses its TCPStore the same
+way, ``rpc.py:73 init_rpc``); requests are pickled callables executed on
+the target worker and answered with pickled results (the same
+trusted-cluster model as the reference's brpc transport — ranks of one
+training job on a private network).
 """
 from __future__ import annotations
 
 import concurrent.futures
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+from dataclasses import dataclass
 
-__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown", "get_worker_info",
-           "get_all_worker_infos", "get_current_worker_info"]
-
-_pool = None
-_workers = {}
-_me = None
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos",
+           "get_current_worker_info", "WorkerInfo"]
 
 
+@dataclass
 class WorkerInfo:
-    def __init__(self, name, rank, ip="127.0.0.1", port=0):
-        self.name = name
-        self.rank = rank
-        self.ip = ip
-        self.port = port
-
-    def __repr__(self):
-        return f"WorkerInfo(name={self.name}, rank={self.rank})"
+    name: str
+    rank: int
+    ip: str = "127.0.0.1"
+    port: int = 0
 
 
-def init_rpc(name, rank=0, world_size=1, master_endpoint=None):
-    global _pool, _me
-    _pool = concurrent.futures.ThreadPoolExecutor(max_workers=4)
-    _me = WorkerInfo(name, rank)
-    _workers[name] = _me
-    return _me
+_state = {"server": None, "pool": None, "workers": {}, "me": None,
+          "store": None}
 
 
-def rpc_sync(to, fn, args=None, kwargs=None, timeout=None):
-    return fn(*(args or ()), **(kwargs or {}))
+def _send_msg(sock, payload: bytes):
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
 
 
-def rpc_async(to, fn, args=None, kwargs=None, timeout=None):
-    if _pool is None:
+def _recv_msg(sock) -> bytes:
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        hdr += chunk
+    (n,) = struct.unpack("<Q", hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        buf += chunk
+    return bytes(buf)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        try:
+            payload = _recv_msg(self.request)
+        except ConnectionError:
+            return
+        try:
+            fn, args, kwargs = pickle.loads(payload)
+            result = ("ok", fn(*args, **(kwargs or {})))
+        except Exception as e:  # errors propagate to the caller
+            result = ("err", e)
+        try:
+            _send_msg(self.request, pickle.dumps(result))
+        except (ConnectionError, OSError):
+            pass
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Start this worker's agent and rendezvous with the others
+    (ref ``rpc.py:73``). ``master_endpoint`` is "host:port" of the rank-0
+    store; single-process usage may omit rank/world_size."""
+    server = _Server(("0.0.0.0", 0), _Handler)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    ip = "127.0.0.1"
+    me = WorkerInfo(name, 0 if rank is None else rank, ip, port)
+    _state.update(server=server, me=me,
+                  pool=concurrent.futures.ThreadPoolExecutor(8))
+
+    if world_size is None or world_size <= 1:
+        _state["workers"] = {name: me}
+        return me
+
+    from ... import core
+    host, sport = (master_endpoint or "127.0.0.1:0").split(":")
+    store = core.TCPStore(host, int(sport), is_master=(rank == 0),
+                          timeout=60.0)
+    _state["store"] = store
+    store.set(f"rpc/worker/{rank}", pickle.dumps((name, rank, ip, port)))
+    workers = {}
+    for r in range(world_size):
+        info = pickle.loads(store.get(f"rpc/worker/{r}", wait=True))
+        workers[info[0]] = WorkerInfo(*info)
+    _state["workers"] = workers
+    # barrier: nobody proceeds until all have published + read the table
+    store.add("rpc/ready", 1)
+    while store.add("rpc/ready", 0) < world_size:
+        time.sleep(0.02)
+    return me
+
+
+def _target(to) -> WorkerInfo:
+    w = _state["workers"].get(to)
+    if w is None:
+        raise ValueError(f"unknown rpc worker '{to}' "
+                         f"(have {list(_state['workers'])})")
+    return w
+
+
+def _invoke(to, fn, args, kwargs, timeout):
+    w = _target(to)
+    me = _state["me"]
+    if me is not None and w.name == me.name:
+        return fn(*(args or ()), **(kwargs or {}))  # local fast path
+    with socket.create_connection((w.ip, w.port), timeout=timeout) as s:
+        s.settimeout(timeout)
+        _send_msg(s, pickle.dumps((fn, args or (), kwargs or {})))
+        status, value = pickle.loads(_recv_msg(s))
+    if status == "err":
+        raise value
+    return value
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=180.0):
+    """Blocking call on worker ``to`` (ref ``rpc.py:141``)."""
+    return _invoke(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=180.0):
+    """Returns a concurrent.futures.Future (ref ``rpc.py:179``)."""
+    if _state["pool"] is None:
         raise RuntimeError("call init_rpc first")
-    return _pool.submit(fn, *(args or ()), **(kwargs or {}))
+    return _state["pool"].submit(_invoke, to, fn, args, kwargs, timeout)
 
 
 def shutdown():
-    global _pool
-    if _pool is not None:
-        _pool.shutdown(wait=True)
-        _pool = None
-    _workers.clear()
+    if _state["server"] is not None:
+        _state["server"].shutdown()
+        _state["server"].server_close()
+        _state["server"] = None
+    if _state["pool"] is not None:
+        _state["pool"].shutdown(wait=False)
+        _state["pool"] = None
+    if _state["store"] is not None:
+        _state["store"].close()
+        _state["store"] = None
+    _state["workers"] = {}
+    _state["me"] = None
 
 
 def get_worker_info(name):
-    return _workers.get(name)
+    return _target(name)
 
 
 def get_all_worker_infos():
-    return list(_workers.values())
+    return list(_state["workers"].values())
 
 
 def get_current_worker_info():
-    return _me
+    return _state["me"]

@@ -1,0 +1,250 @@
+"""``paddle.inference`` — the deployment predictor API.
+
+TPU-native re-design of the reference inference stack
+(``paddle/fluid/inference/``, 87K LoC: AnalysisPredictor
+``analysis_predictor.cc``, IR passes, TensorRT/ONNXRT engines, zero-copy
+tensors ``paddle_infer::Tensor``):
+
+ - the IR-optimization + engine-selection pipeline collapses into XLA AOT:
+   the artifact is serialized StableHLO (from ``jit.save`` or
+   ``static.save_inference_model``) compiled once per shape at load;
+ - ``Config``/``create_predictor``/``Predictor``/input-output handles keep
+   the reference's API so serving code ports over;
+ - "zero copy" is the default: handles wrap device arrays, and host→device
+   transfer happens once per ``copy_from_cpu``.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor as _PTensor
+
+__all__ = ["Config", "Predictor", "create_predictor", "Tensor",
+           "PredictorPool", "PlaceType", "DataType"]
+
+
+class PlaceType:
+    CPU = "cpu"
+    GPU = "gpu"
+    TPU = "tpu"
+    XPU = "xpu"
+    CUSTOM = "custom"
+
+
+class DataType:
+    FLOAT32 = "float32"
+    FLOAT16 = "float16"
+    BFLOAT16 = "bfloat16"
+    INT8 = "int8"
+    INT32 = "int32"
+    INT64 = "int64"
+
+
+class Config:
+    """``paddle_infer.Config`` analog. GPU/TRT/MKLDNN toggles are accepted
+    and inert (XLA owns optimization on TPU)."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        # paddle 2.x: Config(model_dir) or Config(prog, params) — here the
+        # artifact is a path prefix (jit.save / save_inference_model)
+        self.model_prefix = prog_file
+        self._device = PlaceType.TPU
+        self._memory_optim = True
+        self._glog_info = False
+
+    def set_prog_file(self, path):
+        self.model_prefix = path
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device = PlaceType.GPU  # accepted; runs on the jax backend
+
+    def enable_xpu(self, *a, **k):
+        self._device = PlaceType.XPU
+
+    def disable_gpu(self):
+        self._device = PlaceType.CPU
+
+    def enable_memory_optim(self, flag=True):
+        self._memory_optim = flag
+
+    def disable_glog_info(self):
+        self._glog_info = False
+
+    def switch_ir_optim(self, flag=True):
+        pass  # XLA always optimizes
+
+    def enable_tensorrt_engine(self, *a, **k):
+        pass  # no TRT on TPU; XLA compiles the whole graph
+
+    def enable_mkldnn(self):
+        pass
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+    def summary(self):
+        return (f"Config(model={self.model_prefix}, device={self._device}, "
+                f"memory_optim={self._memory_optim})")
+
+
+class Tensor:
+    """IO handle (ref ``paddle_infer::Tensor``): named slot with
+    copy_from_cpu / copy_to_cpu; device array underneath."""
+
+    def __init__(self, name, spec=None):
+        self.name = name
+        self._spec = spec
+        self._value = None
+
+    def reshape(self, shape):
+        pass  # shape comes from the copied array
+
+    def copy_from_cpu(self, arr):
+        self._value = jnp.asarray(arr)
+
+    def share_external_data(self, arr):
+        if isinstance(arr, _PTensor):
+            arr = arr._data
+        self._value = arr  # no copy
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+    def shape(self):
+        return list(self._value.shape) if self._value is not None else \
+            (list(self._spec["shape"]) if self._spec else [])
+
+    def type(self):
+        return str(self._value.dtype) if self._value is not None else \
+            (self._spec or {}).get("dtype", "float32")
+
+
+class Predictor:
+    """Loads a StableHLO artifact and serves it (AnalysisPredictor
+    analog)."""
+
+    def __init__(self, config: Config, _share_from: "Predictor" = None):
+        prefix = config.model_prefix
+        if prefix is None:
+            raise ValueError("Config needs a model path prefix")
+        self.config = config
+        if _share_from is not None:
+            # share the deserialized program + weights (PredictorPool):
+            # only the IO handles are per-predictor
+            self._call = _share_from._call
+            self._in_names = list(_share_from._in_names)
+            self._in_specs = list(_share_from._in_specs)
+            self._out_names = (list(_share_from._out_names)
+                               if _share_from._out_names else None)
+            self._inputs = {n: Tensor(n, s) for n, s in
+                            zip(self._in_names, self._in_specs)}
+            self._outputs = None
+            return
+        self._load(prefix)
+
+    def _load(self, prefix):
+        if os.path.exists(prefix + ".stablehlo"):  # jit.save artifact
+            from ..jit.save_load import load as jit_load
+            layer = jit_load(prefix)
+            self._call = lambda *xs: _ensure_tuple(
+                layer._exported.call(layer._param_arrays,
+                                     layer._buffer_arrays, *xs))
+            specs = layer._manifest.get("input_specs", [])
+            self._in_names = [f"x{i}" for i in range(len(specs))]
+            self._in_specs = specs
+            self._out_names = None
+        elif os.path.exists(prefix + ".pdmodel"):  # static artifact
+            from ..static.io import load_inference_model
+            prog, feeds, fetches = load_inference_model(prefix)
+            self._call = lambda *xs: _ensure_tuple(prog(*xs))
+            self._in_names = list(feeds)
+            self._in_specs = [None] * len(feeds)
+            self._out_names = list(fetches)
+        else:
+            raise FileNotFoundError(
+                f"no inference artifact at '{prefix}' (.stablehlo from "
+                "jit.save or .pdmodel from save_inference_model)")
+        self._inputs = {n: Tensor(n, s)
+                        for n, s in zip(self._in_names, self._in_specs)}
+        self._outputs = None
+
+    # -- reference API ------------------------------------------------------
+    def get_input_names(self):
+        return list(self._in_names)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def run(self, inputs=None):
+        """Either Predictor.run() after copy_from_cpu on handles, or the
+        2.x convenience run([arrays...]) returning arrays."""
+        if inputs is not None:
+            if len(inputs) != len(self._in_names):
+                raise ValueError(
+                    f"model takes {len(self._in_names)} inputs "
+                    f"({self._in_names}), got {len(inputs)}")
+            for n, a in zip(self._in_names, inputs):
+                self._inputs[n].copy_from_cpu(
+                    a._data if isinstance(a, _PTensor) else a)
+        args = [self._inputs[n]._value for n in self._in_names]
+        if any(a is None for a in args):
+            missing = [n for n in self._in_names
+                       if self._inputs[n]._value is None]
+            raise ValueError(f"inputs not set: {missing}")
+        outs = self._call(*args)
+        names = self._out_names or [f"out{i}" for i in range(len(outs))]
+        self._outputs = {}
+        for n, o in zip(names, outs):
+            h = Tensor(n)
+            h._value = o
+            self._outputs[n] = h
+        if inputs is not None:
+            return [np.asarray(o) for o in outs]
+        return True
+
+    def get_output_names(self):
+        if self._outputs is None:
+            return list(self._out_names or [])
+        return list(self._outputs)
+
+    def get_output_handle(self, name):
+        if self._outputs is None:
+            raise RuntimeError("run() first")
+        return self._outputs[name]
+
+    def clear_intermediate_tensor(self):
+        pass
+
+    def try_shrink_memory(self):
+        pass
+
+
+def _ensure_tuple(x):
+    if isinstance(x, (tuple, list)):
+        return tuple(x)
+    return (x,)
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+class PredictorPool:
+    """N independent predictors over one artifact (ref
+    ``paddle_infer::services::PredictorPool``). On TPU they share the
+    compiled executable (XLA caches by computation)."""
+
+    def __init__(self, config: Config, size: int = 1):
+        first = Predictor(config)
+        self._preds = [first] + [Predictor(config, _share_from=first)
+                                 for _ in range(size - 1)]
+
+    def retrive(self, idx):  # reference spells it "retrive"
+        return self._preds[idx]
+
+    retrieve = retrive

@@ -16,7 +16,10 @@ import numpy as np
 
 from ...io.dataset import Dataset
 
-__all__ = ["Cifar10", "Cifar100", "MNIST", "FashionMNIST", "FakeData"]
+__all__ = ["Cifar10", "Cifar100", "MNIST", "FashionMNIST", "FakeData",
+           "DatasetFolder", "ImageFolder"]
+
+from .folder import DatasetFolder, ImageFolder  # noqa: E402,F401
 
 
 class FakeData(Dataset):

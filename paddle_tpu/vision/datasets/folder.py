@@ -1,0 +1,98 @@
+"""Folder-based datasets (ref: ``python/paddle/vision/datasets/folder.py``
+DatasetFolder / ImageFolder): class-per-subdirectory image trees, no
+download required — the natural air-gapped dataset format."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+__all__ = ["DatasetFolder", "ImageFolder", "default_loader"]
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                  ".tiff", ".webp", ".npy")
+
+
+def default_loader(path):
+    """PIL for images, numpy for .npy arrays."""
+    if path.endswith(".npy"):
+        return np.load(path)
+    from PIL import Image
+    with open(path, "rb") as f:
+        return np.asarray(Image.open(f).convert("RGB"))
+
+
+def _is_valid(path, extensions):
+    return path.lower().endswith(tuple(extensions))
+
+
+class DatasetFolder(Dataset):
+    """root/class_x/xxx.ext layout → (sample, class_index)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or default_loader
+        self.transform = transform
+        extensions = extensions or IMG_EXTENSIONS
+        classes = sorted(d.name for d in os.scandir(root) if d.is_dir())
+        if not classes:
+            raise FileNotFoundError(f"no class directories under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for fname in sorted(files):
+                    path = os.path.join(dirpath, fname)
+                    ok = is_valid_file(path) if is_valid_file else \
+                        _is_valid(path, extensions)
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise FileNotFoundError(
+                f"no files with extensions {extensions} under {root}")
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat (unlabeled) image folder → [sample] (ref ImageFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or default_loader
+        self.transform = transform
+        extensions = extensions or IMG_EXTENSIONS
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                path = os.path.join(dirpath, fname)
+                ok = is_valid_file(path) if is_valid_file else \
+                    _is_valid(path, extensions)
+                if ok:
+                    self.samples.append(path)
+        if not self.samples:
+            raise FileNotFoundError(
+                f"no files with extensions {extensions} under {root}")
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)

@@ -1,0 +1,125 @@
+"""DenseNet (ref: ``python/paddle/vision/models/densenet.py``)."""
+from __future__ import annotations
+
+from ...nn.layer.layers import Layer
+from ... import nn
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "densenet264"]
+
+
+class _DenseLayer(Layer):
+    def __init__(self, num_channels, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.bn1 = nn.BatchNorm2D(num_channels)
+        self.conv1 = nn.Conv2D(num_channels, bn_size * growth_rate, 1,
+                               bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = nn.Conv2D(bn_size * growth_rate, growth_rate, 3,
+                               padding=1, bias_attr=False)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.bn1(x)))
+        out = self.conv2(self.relu(self.bn2(out)))
+        if self.dropout is not None:
+            out = self.dropout(out)
+        from ...ops.manipulation import concat
+        return concat([x, out], axis=1)
+
+
+class _DenseBlock(Layer):
+    def __init__(self, num_layers, num_channels, growth_rate, bn_size,
+                 dropout):
+        super().__init__()
+        self.layers = nn.LayerList([
+            _DenseLayer(num_channels + i * growth_rate, growth_rate,
+                        bn_size, dropout)
+            for i in range(num_layers)])
+
+    def forward(self, x):
+        for layer in self.layers:
+            x = layer(x)
+        return x
+
+
+class _Transition(Layer):
+    def __init__(self, num_channels, num_output):
+        super().__init__()
+        self.bn = nn.BatchNorm2D(num_channels)
+        self.conv = nn.Conv2D(num_channels, num_output, 1, bias_attr=False)
+        self.pool = nn.AvgPool2D(2, stride=2)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.bn(x))))
+
+
+_CFG = {
+    121: (64, 32, [6, 12, 24, 16]),
+    161: (96, 48, [6, 12, 36, 24]),
+    169: (64, 32, [6, 12, 32, 32]),
+    201: (64, 32, [6, 12, 48, 32]),
+    264: (64, 32, [6, 12, 64, 48]),
+}
+
+
+class DenseNet(Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        num_init, growth_rate, block_cfg = _CFG[layers]
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, num_init, 7, stride=2, padding=3, bias_attr=False),
+            nn.BatchNorm2D(num_init), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        blocks = []
+        ch = num_init
+        for i, n in enumerate(block_cfg):
+            blocks.append(_DenseBlock(n, ch, growth_rate, bn_size, dropout))
+            ch += n * growth_rate
+            if i != len(block_cfg) - 1:
+                blocks.append(_Transition(ch, ch // 2))
+                ch //= 2
+        self.blocks = nn.Sequential(*blocks)
+        self.bn_last = nn.BatchNorm2D(ch)
+        self.relu = nn.ReLU()
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.relu(self.bn_last(self.blocks(self.stem(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+def _make(layers, **kw):
+    return DenseNet(layers=layers, **kw)
+
+
+def densenet121(pretrained=False, **kw):
+    return _make(121, **kw)
+
+
+def densenet161(pretrained=False, **kw):
+    return _make(161, **kw)
+
+
+def densenet169(pretrained=False, **kw):
+    return _make(169, **kw)
+
+
+def densenet201(pretrained=False, **kw):
+    return _make(201, **kw)
+
+
+def densenet264(pretrained=False, **kw):
+    return _make(264, **kw)

@@ -1,0 +1,90 @@
+"""GoogLeNet / Inception v1 (ref: ``python/paddle/vision/models/
+googlenet.py``)."""
+from __future__ import annotations
+
+from ...nn.layer.layers import Layer
+from ... import nn
+
+__all__ = ["GoogLeNet", "googlenet"]
+
+
+class _Inception(Layer):
+    def __init__(self, in_ch, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        R = nn.ReLU
+        self.b1 = nn.Sequential(nn.Conv2D(in_ch, c1, 1), R())
+        self.b2 = nn.Sequential(nn.Conv2D(in_ch, c3r, 1), R(),
+                                nn.Conv2D(c3r, c3, 3, padding=1), R())
+        self.b3 = nn.Sequential(nn.Conv2D(in_ch, c5r, 1), R(),
+                                nn.Conv2D(c5r, c5, 5, padding=2), R())
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, stride=1, padding=1),
+                                nn.Conv2D(in_ch, proj, 1), R())
+
+    def forward(self, x):
+        from ...ops.manipulation import concat
+        return concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)],
+                      axis=1)
+
+
+class GoogLeNet(Layer):
+    """Returns (out, aux1, aux2) like the reference."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        R = nn.ReLU
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 64, 7, stride=2, padding=3), R(),
+            nn.MaxPool2D(3, stride=2, padding=1),
+            nn.Conv2D(64, 64, 1), R(),
+            nn.Conv2D(64, 192, 3, padding=1), R(),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        self.ince3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.ince3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.ince4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.ince4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.ince4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.ince4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.ince4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.ince5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.ince5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        if with_pool:
+            self.pool5 = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.4)
+            self.fc = nn.Linear(1024, num_classes)
+            # aux heads (train-time deep supervision, ref googlenet.py)
+            self.aux1 = nn.Sequential(
+                nn.AdaptiveAvgPool2D(4), nn.Conv2D(512, 128, 1), R())
+            self.aux1_fc = nn.Sequential(nn.Linear(2048, 1024), R(),
+                                         nn.Dropout(0.7),
+                                         nn.Linear(1024, num_classes))
+            self.aux2 = nn.Sequential(
+                nn.AdaptiveAvgPool2D(4), nn.Conv2D(528, 128, 1), R())
+            self.aux2_fc = nn.Sequential(nn.Linear(2048, 1024), R(),
+                                         nn.Dropout(0.7),
+                                         nn.Linear(1024, num_classes))
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.ince3b(self.ince3a(x)))
+        a = self.ince4a(x)
+        b = self.ince4d(self.ince4c(self.ince4b(a)))
+        x = self.pool4(self.ince4e(b))
+        x = self.ince5b(self.ince5a(x))
+        out = aux1 = aux2 = None
+        if self.with_pool:
+            x = self.pool5(x)
+        if self.num_classes > 0:
+            out = self.fc(self.dropout(x.flatten(1)))
+            aux1 = self.aux1_fc(self.aux1(a).flatten(1))
+            aux2 = self.aux2_fc(self.aux2(b).flatten(1))
+            return out, aux1, aux2
+        return x
+
+
+def googlenet(pretrained=False, **kw):
+    return GoogLeNet(**kw)

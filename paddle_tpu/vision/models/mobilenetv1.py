@@ -1,0 +1,64 @@
+"""MobileNetV1 (ref: ``python/paddle/vision/models/mobilenetv1.py``)."""
+from __future__ import annotations
+
+from ...nn.layer.layers import Layer
+from ... import nn
+
+__all__ = ["MobileNetV1", "mobilenet_v1"]
+
+
+class _ConvBNRelu(Layer):
+    def __init__(self, in_ch, out_ch, kernel, stride=1, padding=0, groups=1):
+        super().__init__()
+        self.conv = nn.Conv2D(in_ch, out_ch, kernel, stride=stride,
+                              padding=padding, groups=groups,
+                              bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_ch)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+class _DepthwiseSeparable(Layer):
+    def __init__(self, in_ch, out1, out2, stride, scale):
+        super().__init__()
+        c1, c2, c3 = int(in_ch * scale), int(out1 * scale), int(out2 * scale)
+        self.dw = _ConvBNRelu(c1, c2, 3, stride=stride, padding=1, groups=c1)
+        self.pw = _ConvBNRelu(c2, c3, 1)
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetV1(Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        s = scale
+        self.conv1 = _ConvBNRelu(3, int(32 * s), 3, stride=2, padding=1)
+        cfg = [  # in, out1, out2, stride (per reference)
+            (32, 32, 64, 1), (64, 64, 128, 2), (128, 128, 128, 1),
+            (128, 128, 256, 2), (256, 256, 256, 1), (256, 256, 512, 2),
+            (512, 512, 512, 1), (512, 512, 512, 1), (512, 512, 512, 1),
+            (512, 512, 512, 1), (512, 512, 512, 1), (512, 512, 1024, 2),
+            (1024, 1024, 1024, 1)]
+        self.blocks = nn.Sequential(*[
+            _DepthwiseSeparable(i, o1, o2, st, s) for (i, o1, o2, st) in cfg])
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(int(1024 * s), num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.conv1(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kw):
+    return MobileNetV1(scale=scale, **kw)

@@ -1,0 +1,138 @@
+"""ShuffleNetV2 (ref: ``python/paddle/vision/models/shufflenetv2.py``)."""
+from __future__ import annotations
+
+from ...nn.layer.layers import Layer
+from ... import nn
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+           "shufflenet_v2_x2_0", "shufflenet_v2_swish"]
+
+
+def _channel_shuffle(x, groups):
+    from ...ops.manipulation import reshape, transpose
+    b, c, h, w = x.shape
+    x = reshape(x, [b, groups, c // groups, h, w])
+    x = transpose(x, [0, 2, 1, 3, 4])
+    return reshape(x, [b, c, h, w])
+
+
+def _act(name):
+    return nn.Swish() if name == "swish" else nn.ReLU()
+
+
+class _InvertedResidual(Layer):
+    def __init__(self, in_ch, out_ch, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        branch_ch = out_ch // 2
+        if stride == 1:
+            self.branch2 = nn.Sequential(
+                nn.Conv2D(branch_ch, branch_ch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_ch), _act(act),
+                nn.Conv2D(branch_ch, branch_ch, 3, stride=1, padding=1,
+                          groups=branch_ch, bias_attr=False),
+                nn.BatchNorm2D(branch_ch),
+                nn.Conv2D(branch_ch, branch_ch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_ch), _act(act))
+        else:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(in_ch, in_ch, 3, stride=stride, padding=1,
+                          groups=in_ch, bias_attr=False),
+                nn.BatchNorm2D(in_ch),
+                nn.Conv2D(in_ch, branch_ch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_ch), _act(act))
+            self.branch2 = nn.Sequential(
+                nn.Conv2D(in_ch, branch_ch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_ch), _act(act),
+                nn.Conv2D(branch_ch, branch_ch, 3, stride=stride, padding=1,
+                          groups=branch_ch, bias_attr=False),
+                nn.BatchNorm2D(branch_ch),
+                nn.Conv2D(branch_ch, branch_ch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_ch), _act(act))
+
+    def forward(self, x):
+        from ...ops.manipulation import concat, split
+        if self.stride == 1:
+            x1, x2 = split(x, 2, axis=1)
+            out = concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+_STAGE_OUT = {
+    0.25: [24, 24, 48, 96, 512],
+    0.33: [24, 32, 64, 128, 512],
+    0.5: [24, 48, 96, 192, 1024],
+    1.0: [24, 116, 232, 464, 1024],
+    1.5: [24, 176, 352, 704, 1024],
+    2.0: [24, 244, 488, 976, 2048],
+}
+
+
+class ShuffleNetV2(Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        stage_repeats = [4, 8, 4]
+        out_ch = _STAGE_OUT[scale]
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, out_ch[0], 3, stride=2, padding=1,
+                      bias_attr=False),
+            nn.BatchNorm2D(out_ch[0]), _act(act))
+        self.max_pool = nn.MaxPool2D(3, stride=2, padding=1)
+        blocks = []
+        in_ch = out_ch[0]
+        for stage, repeats in enumerate(stage_repeats):
+            oc = out_ch[stage + 1]
+            for i in range(repeats):
+                blocks.append(_InvertedResidual(in_ch, oc,
+                                                2 if i == 0 else 1, act))
+                in_ch = oc
+        self.blocks = nn.Sequential(*blocks)
+        self.conv_last = nn.Sequential(
+            nn.Conv2D(in_ch, out_ch[-1], 1, bias_attr=False),
+            nn.BatchNorm2D(out_ch[-1]), _act(act))
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(out_ch[-1], num_classes)
+
+    def forward(self, x):
+        x = self.conv_last(self.blocks(self.max_pool(self.conv1(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    return ShuffleNetV2(scale=0.25, **kw)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kw):
+    return ShuffleNetV2(scale=0.33, **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    return ShuffleNetV2(scale=0.5, **kw)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    return ShuffleNetV2(scale=1.0, **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    return ShuffleNetV2(scale=1.5, **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    return ShuffleNetV2(scale=2.0, **kw)
+
+
+def shufflenet_v2_swish(pretrained=False, **kw):
+    return ShuffleNetV2(scale=1.0, act="swish", **kw)

@@ -156,3 +156,42 @@ class TestOpTestHarness:
         b = np.random.rand(2, 2) + 0.5
         check_grad(paddle_tpu.multiply, [a, b])
         check_grad(paddle_tpu.divide, [a, b])
+
+
+class TestIncubateAutograd:
+    """ref: python/paddle/incubate/autograd functional.py jvp/vjp/Jacobian."""
+
+    def test_jvp_vjp(self):
+        import numpy as np
+        import paddle_tpu as pt
+        from paddle_tpu.incubate.autograd import jvp, vjp
+
+        def f(x):
+            return (x ** 2).sum()
+
+        x = pt.to_tensor(np.array([1., 2., 3.], np.float32))
+        out, tangent = jvp(f, [x], [pt.to_tensor(np.ones(3, np.float32))])
+        np.testing.assert_allclose(float(out.numpy()), 14.0)
+        np.testing.assert_allclose(float(tangent.numpy()), 12.0)  # sum(2x)
+        out, grads = vjp(f, [x])
+        np.testing.assert_allclose(grads[0].numpy(), [2., 4., 6.])
+
+    def test_jacobian_hessian(self):
+        import numpy as np
+        import paddle_tpu as pt
+        from paddle_tpu.incubate.autograd import Jacobian, Hessian
+
+        def f(x):
+            return x ** 3
+
+        x = pt.to_tensor(np.array([1., 2.], np.float32))
+        J = Jacobian(f, [x])
+        np.testing.assert_allclose(np.asarray(J[0].numpy()),
+                                   np.diag([3., 12.]), rtol=1e-5)
+
+        def g(x):
+            return (x ** 3).sum()
+
+        H = Hessian(g, [x])
+        h = np.asarray(H.value[0][0].numpy())
+        np.testing.assert_allclose(h, np.diag([6., 12.]), rtol=1e-5)

@@ -1,0 +1,166 @@
+"""Elastic manager + auto-tuner tests (ref: test/collective/fleet/
+test_elastic_manager.py, test/auto_tuner/)."""
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.core as core
+from paddle_tpu.distributed.auto_tuner import (AutoTuner, HistoryRecorder,
+                                               prune_by_rules)
+from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                  ElasticStatus,
+                                                  LauncherInterface)
+
+
+class TestAutoTuner:
+    CFG = {
+        "candidates": {
+            "dp_degree": [1, 2, 4, 8],
+            "mp_degree": [1, 2, 4],
+            "pp_degree": [1, 2],
+            "micro_batch_size": [1, 2, 4],
+            "sharding_degree": [1],
+            "sharding_stage": [None],
+            "use_recompute": [False, True],
+            "recompute_granularity": [None],
+        },
+        "num_chips": 8,
+        "global_batch_size": 16,
+    }
+
+    def test_grid_yields_only_valid_mesh_shapes(self):
+        tuner = AutoTuner(self.CFG)
+        seen = []
+        while (cfg := tuner.search_once()) is not None:
+            seen.append(cfg)
+        assert seen, "search space empty"
+        for cfg in seen:
+            assert cfg["dp_degree"] * cfg["mp_degree"] * cfg["pp_degree"] \
+                == 8
+            per = 16 // cfg["dp_degree"]
+            assert per % cfg["micro_batch_size"] == 0
+
+    def test_best_selection_and_oom_prune(self):
+        tuner = AutoTuner(self.CFG)
+        # simulate: bigger mbs oom, smaller ok
+        n = 0
+        while (cfg := tuner.search_once()) is not None and n < 12:
+            n += 1
+            if cfg["micro_batch_size"] >= 4:
+                tuner.add_cfg(**cfg, throughput=None, status="oom")
+            else:
+                tuner.add_cfg(**cfg,
+                              throughput=100 * cfg["micro_batch_size"],
+                              status="ok")
+        best, err = tuner.get_best()
+        assert not err
+        assert best["status"] == "ok"
+        assert best["throughput"] == max(
+            c.get("throughput") or 0 for c in tuner.recorder.history)
+
+    def test_oom_history_prunes_larger(self):
+        cfg = {"num_chips": None}
+        history = [{"micro_batch_size": 2, "mp_degree": 1, "status": "oom",
+                    "use_recompute": False}]
+        assert prune_by_rules(cfg, {"micro_batch_size": 4, "mp_degree": 1},
+                              history)
+        assert not prune_by_rules(cfg, {"micro_batch_size": 1,
+                                        "mp_degree": 1}, history)
+
+    def test_recorder_store_load(self, tmp_path):
+        r = HistoryRecorder()
+        r.add_cfg(dp_degree=2, throughput=10.5, status="ok")
+        r.add_cfg(dp_degree=4, throughput=20.0, status="ok")
+        path = str(tmp_path / "hist.csv")
+        r.store_history(path)
+        r2 = HistoryRecorder()
+        rows, err = r2.load_history(path)
+        assert not err and len(rows) == 2
+        best, _ = r2.get_best()
+        assert best["throughput"] == 20.0
+
+
+@pytest.mark.skipif(not core.native_available(),
+                    reason="needs native TCPStore")
+class TestElastic:
+    def _mgr(self, store, host, np="1:3", ttl=0.6):
+        return ElasticManager(store, host, np=np,
+                              heartbeat_interval=0.1, lease_ttl=ttl)
+
+    def test_register_and_match(self):
+        master = core.TCPStore(is_master=True)
+        try:
+            m1 = self._mgr(master, "host-a")
+            m1.register()
+            c2 = core.TCPStore("127.0.0.1", master.port)
+            m2 = self._mgr(c2, "host-b")
+            m2.register()
+            ok, hosts, rank = m1.match()
+            assert ok and hosts == ["host-a", "host-b"]
+            assert rank == 0 and m2.match()[2] == 1
+            m1.exit()
+            m2.exit()
+            c2.close()
+        finally:
+            master.close()
+
+    def test_dead_node_detected_and_rematch(self):
+        master = core.TCPStore(is_master=True)
+        try:
+            m1 = self._mgr(master, "host-a", ttl=0.5)
+            m1.register()
+            c2 = core.TCPStore("127.0.0.1", master.port)
+            m2 = self._mgr(c2, "host-b", ttl=0.5)
+            m2.register()
+            assert len(m1.alive_nodes()) == 2
+            # host-b dies (heartbeat stops)
+            m2._stop.set()
+            time.sleep(1.2)
+            hosts, rank = m1.wait_for_np(timeout=5.0)
+            assert hosts == ["host-a"] and rank == 0
+            m1.exit()
+            c2.close()
+        finally:
+            master.close()
+
+    def test_watch_detects_join(self):
+        master = core.TCPStore(is_master=True)
+        try:
+            m1 = self._mgr(master, "host-a")
+            m1.register()
+            assert m1.watch(timeout=0.3) == ElasticStatus.COMPLETED
+            c2 = core.TCPStore("127.0.0.1", master.port)
+            m2 = self._mgr(c2, "host-b")
+            m2.register()
+            status = m1.watch(timeout=3.0)
+            assert status == ElasticStatus.RESTART
+            m1.exit()
+            m2.exit()
+            c2.close()
+        finally:
+            master.close()
+
+    def test_launcher_interface(self):
+        li = LauncherInterface([sys.executable, "-c",
+                                "import time; time.sleep(30)"])
+        li.launch()
+        assert li.watch() is None
+        li.stop(timeout=5.0)
+        assert li.watch() is not None
+
+    def test_hold_below_np_min(self):
+        master = core.TCPStore(is_master=True)
+        try:
+            m1 = self._mgr(master, "host-a", np="2:3")
+            m1.register()
+            ok, hosts, _ = m1.match()
+            assert not ok and hosts == ["host-a"]
+            with pytest.raises(TimeoutError):
+                m1.wait_for_np(timeout=0.5)
+            m1.exit()
+        finally:
+            master.close()

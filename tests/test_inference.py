@@ -1,0 +1,80 @@
+"""Inference predictor tests (ref: test/inference API tests /
+test_analysis_predictor)."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import inference as infer
+from paddle_tpu.jit.api import InputSpec
+
+
+def _save_jit_artifact(tmp_path):
+    pt.seed(0)
+    net = pt.nn.Sequential(pt.nn.Linear(8, 16), pt.nn.ReLU(),
+                           pt.nn.Linear(16, 3))
+    prefix = str(tmp_path / "model")
+    pt.jit.save(net, prefix, input_spec=[InputSpec([4, 8], "float32")])
+    return net, prefix
+
+
+class TestPredictorJitArtifact:
+    def test_handles_round_trip(self, tmp_path):
+        net, prefix = _save_jit_artifact(tmp_path)
+        cfg = infer.Config(prefix)
+        pred = infer.create_predictor(cfg)
+        names = pred.get_input_names()
+        assert len(names) == 1
+        X = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+        h = pred.get_input_handle(names[0])
+        h.copy_from_cpu(X)
+        assert h.shape() == [4, 8]
+        pred.run()
+        out_name = pred.get_output_names()[0]
+        out = pred.get_output_handle(out_name).copy_to_cpu()
+        net.eval()
+        want = net(pt.to_tensor(X)).numpy()
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+    def test_convenience_run(self, tmp_path):
+        net, prefix = _save_jit_artifact(tmp_path)
+        pred = infer.create_predictor(infer.Config(prefix))
+        X = np.random.RandomState(1).randn(4, 8).astype(np.float32)
+        outs = pred.run([X])
+        assert outs[0].shape == (4, 3)
+
+    def test_predictor_pool(self, tmp_path):
+        _, prefix = _save_jit_artifact(tmp_path)
+        pool = infer.PredictorPool(infer.Config(prefix), size=2)
+        X = np.ones((4, 8), np.float32)
+        o1 = pool.retrive(0).run([X])[0]
+        o2 = pool.retrieve(1).run([X])[0]
+        np.testing.assert_allclose(o1, o2)
+
+    def test_missing_artifact(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            infer.create_predictor(infer.Config(str(tmp_path / "nope")))
+
+
+class TestPredictorStaticArtifact:
+    def test_static_artifact(self, tmp_path):
+        pt.enable_static()
+        try:
+            from paddle_tpu import static
+            main, startup = static.Program(), static.Program()
+            with static.program_guard(main, startup):
+                x = static.data("x", [2, 5], "float32")
+                y = pt.nn.Linear(5, 3)(x)
+            exe = static.Executor()
+            exe.run(startup)
+            X = np.random.RandomState(0).randn(2, 5).astype(np.float32)
+            want, = exe.run(main, feed={"x": X}, fetch_list=[y])
+            prefix = str(tmp_path / "sm")
+            static.save_inference_model(prefix, [x], [y], exe)
+        finally:
+            pt.disable_static()
+        pred = infer.create_predictor(infer.Config(prefix))
+        assert pred.get_input_names() == ["x"]
+        got = pred.run([X])[0]
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,103 @@
+"""Vision model zoo completion tests (ref: test/legacy_test/
+test_vision_models.py pattern: construct each family, forward a small
+batch, check logits shape)."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+M = pt.vision.models
+
+
+def _x(size=64, batch=1):
+    return pt.to_tensor(np.random.RandomState(0)
+                        .randn(batch, 3, size, size).astype(np.float32))
+
+
+CASES = [
+    ("densenet121", lambda: M.densenet121(num_classes=7), 64),
+    ("squeezenet1_1", lambda: M.squeezenet1_1(num_classes=7), 64),
+    ("mobilenet_v1", lambda: M.mobilenet_v1(num_classes=7), 64),
+    ("mobilenet_v3_small", lambda: M.mobilenet_v3_small(num_classes=7), 64),
+    ("shufflenet_v2_x0_25", lambda: M.shufflenet_v2_x0_25(num_classes=7),
+     64),
+    ("inception_v3", lambda: M.inception_v3(num_classes=7), 96),
+]
+
+
+class TestZooForward:
+    @pytest.mark.parametrize("name,ctor,size", CASES,
+                             ids=[c[0] for c in CASES])
+    def test_forward_shape(self, name, ctor, size):
+        pt.seed(0)
+        m = ctor()
+        m.eval()
+        out = m(_x(size))
+        assert out.shape == [1, 7]
+        assert np.isfinite(out.numpy()).all()
+
+    def test_googlenet_aux_heads(self):
+        pt.seed(0)
+        g = M.googlenet(num_classes=7)
+        g.eval()
+        out, aux1, aux2 = g(_x(96))
+        assert out.shape == aux1.shape == aux2.shape == [1, 7]
+
+    def test_mobilenet_v3_trains(self):
+        pt.seed(0)
+        m = M.mobilenet_v3_small(num_classes=4, scale=0.35)
+        opt = pt.optimizer.SGD(learning_rate=0.05,
+                               parameters=m.parameters())
+        X = _x(32, batch=4)
+        Y = pt.to_tensor(np.array([0, 1, 2, 3]))
+        losses = []
+        for _ in range(4):
+            loss = pt.nn.CrossEntropyLoss()(m(X), Y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+
+class TestDatasetFolder:
+    def _make_tree(self, root):
+        for cls in ("cat", "dog"):
+            d = os.path.join(root, cls)
+            os.makedirs(d)
+            for i in range(3):
+                np.save(os.path.join(d, f"{i}.npy"),
+                        np.full((8, 8, 3), ord(cls[0]), np.uint8))
+
+    def test_dataset_folder(self, tmp_path):
+        self._make_tree(str(tmp_path))
+        ds = pt.vision.datasets.DatasetFolder(str(tmp_path))
+        assert ds.classes == ["cat", "dog"]
+        assert len(ds) == 6
+        img, label = ds[0]
+        assert img.shape == (8, 8, 3) and label == 0
+        img, label = ds[5]
+        assert label == 1
+
+    def test_image_folder(self, tmp_path):
+        self._make_tree(str(tmp_path))
+        ds = pt.vision.datasets.ImageFolder(str(tmp_path))
+        assert len(ds) == 6
+        (img,) = ds[0]
+        assert img.shape == (8, 8, 3)
+
+    def test_transform_applied(self, tmp_path):
+        self._make_tree(str(tmp_path))
+        T = pt.vision.transforms
+        ds = pt.vision.datasets.DatasetFolder(
+            str(tmp_path), transform=T.Compose([T.ToTensor()]))
+        img, _ = ds[0]
+        assert list(img.shape) == [3, 8, 8]
+
+    def test_empty_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            pt.vision.datasets.DatasetFolder(str(tmp_path))
