@@ -46,7 +46,7 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
         n_class = logits.shape[ax]
         if soft_label or (lab.ndim == logits.ndim and
                           lab.shape[ax] == n_class and
-                          lab.dtype.kind == "f"):
+                          jnp.issubdtype(lab.dtype, jnp.floating)):
             soft = lab.astype(jnp.float32)
             if label_smoothing > 0:
                 soft = soft * (1 - label_smoothing) + label_smoothing / n_class

@@ -47,7 +47,9 @@ def _pool(x, kernel, stride, padding, n, data_format, reducer, init,
                                 for i, ((lo, hi), st) in
                                 enumerate(zip(padding_full, strides))]
         if reducer == "max":
-            out = lax.reduce_window(d, -jnp.inf if d.dtype.kind == "f"
+            # NB: numpy's dtype.kind is 'V' for bfloat16 — use issubdtype.
+            out = lax.reduce_window(d, -jnp.inf
+                                    if jnp.issubdtype(d.dtype, jnp.floating)
                                     else jnp.iinfo(d.dtype).min,
                                     lax.max, window, strides, padding_full)
         else:  # avg
@@ -145,8 +147,9 @@ def _pool_argmax(x, kernel, stride, padding, n, data_format, ceil_mode):
             bv, bi = b
             pick = av >= bv
             return jnp.where(pick, av, bv), jnp.where(pick, ai, bi)
-        init = (-jnp.inf if d.dtype.kind == "f" else jnp.iinfo(d.dtype).min,
-                jnp.asarray(-1))
+        init = (jnp.asarray(-jnp.inf if jnp.issubdtype(d.dtype, jnp.floating)
+                            else jnp.iinfo(d.dtype).min, d.dtype),
+                jnp.asarray(-1, jnp.int32))
         _, idx = lax.reduce_window(
             (d, flat_idx.astype(jnp.int32)), init,
             lambda a, b: select(a, b), window, strides, padding_full)

@@ -1,0 +1,161 @@
+"""bf16 + f32 dtype matrix over pooling/conv/norm functionals.
+
+Regression shield for the round-1 bench crash: `max_pool2d` on bfloat16 fell
+into `jnp.iinfo` because numpy's `dtype.kind` is 'V' for bfloat16
+(pooling.py). Every functional that the AMP-O2 CNN fast path touches must
+run under BOTH float32 and bfloat16 (ref test pattern:
+`test/legacy_test/eager_op_test.py` dtype sweeps + `test/amp/`).
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn.functional as F
+from paddle_tpu.tensor import Tensor
+
+DTYPES = ["float32", "bfloat16"]
+
+
+def _x(shape, dtype, seed=0):
+    rng = np.random.RandomState(seed)
+    return Tensor(jnp.asarray(rng.randn(*shape).astype(np.float32)).astype(dtype))
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+class TestPoolingDtypes:
+    def test_max_pool2d(self, dtype):
+        out = F.max_pool2d(_x((2, 3, 8, 8), dtype), 2)
+        assert out.dtype == getattr(pt, dtype) and out.shape == [2, 3, 4, 4]
+
+    def test_max_pool2d_mask(self, dtype):
+        out, mask = F.max_pool2d(_x((2, 3, 8, 8), dtype), 2, return_mask=True)
+        assert mask.shape == [2, 3, 4, 4]
+
+    def test_max_pool1d(self, dtype):
+        assert F.max_pool1d(_x((2, 3, 8), dtype), 2).shape == [2, 3, 4]
+
+    def test_max_pool3d(self, dtype):
+        assert F.max_pool3d(_x((1, 2, 4, 4, 4), dtype), 2).shape == \
+            [1, 2, 2, 2, 2]
+
+    def test_avg_pool2d(self, dtype):
+        out = F.avg_pool2d(_x((2, 3, 8, 8), dtype), 2)
+        assert out.shape == [2, 3, 4, 4]
+
+    def test_avg_pool2d_padded(self, dtype):
+        out = F.avg_pool2d(_x((2, 3, 8, 8), dtype), 3, stride=2, padding=1)
+        assert out.shape == [2, 3, 4, 4]
+
+    def test_max_pool2d_ceil(self, dtype):
+        out = F.max_pool2d(_x((2, 3, 7, 7), dtype), 2, ceil_mode=True)
+        assert out.shape == [2, 3, 4, 4]
+
+    def test_adaptive_avg_pool2d(self, dtype):
+        assert F.adaptive_avg_pool2d(_x((2, 3, 8, 8), dtype), 1).shape == \
+            [2, 3, 1, 1]
+
+    def test_adaptive_max_pool2d(self, dtype):
+        assert F.adaptive_max_pool2d(_x((2, 3, 9, 9), dtype), 3).shape == \
+            [2, 3, 3, 3]
+
+    def test_lp_pool2d(self, dtype):
+        assert F.lp_pool2d(_x((2, 3, 8, 8), dtype), 2.0, 2).shape == \
+            [2, 3, 4, 4]
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+class TestConvNormDtypes:
+    def test_conv2d(self, dtype):
+        w = _x((4, 3, 3, 3), dtype, 1)
+        out = F.conv2d(_x((2, 3, 8, 8), dtype), w, padding=1)
+        assert out.shape == [2, 4, 8, 8]
+
+    def test_conv2d_stride(self, dtype):
+        w = _x((4, 3, 3, 3), dtype, 1)
+        assert F.conv2d(_x((2, 3, 8, 8), dtype), w, stride=2,
+                        padding=1).shape == [2, 4, 4, 4]
+
+    def test_conv1d(self, dtype):
+        w = _x((4, 3, 3), dtype, 1)
+        assert F.conv1d(_x((2, 3, 8), dtype), w, padding=1).shape == [2, 4, 8]
+
+    def test_conv2d_transpose(self, dtype):
+        w = _x((3, 4, 2, 2), dtype, 1)
+        out = F.conv2d_transpose(_x((2, 3, 4, 4), dtype), w, stride=2)
+        assert out.shape == [2, 4, 8, 8]
+
+    def test_batch_norm(self, dtype):
+        x = _x((4, 3, 8, 8), dtype)
+        rm = Tensor(jnp.zeros((3,), jnp.float32))
+        rv = Tensor(jnp.ones((3,), jnp.float32))
+        w = Tensor(jnp.ones((3,), jnp.float32))
+        b = Tensor(jnp.zeros((3,), jnp.float32))
+        out = F.batch_norm(x, rm, rv, w, b, training=True)
+        assert out.shape == [4, 3, 8, 8]
+
+    def test_layer_norm(self, dtype):
+        x = _x((4, 8), dtype)
+        w = Tensor(jnp.ones((8,), jnp.float32))
+        b = Tensor(jnp.zeros((8,), jnp.float32))
+        assert F.layer_norm(x, [8], w, b).shape == [4, 8]
+
+    def test_relu_softmax_gelu(self, dtype):
+        x = _x((4, 8), dtype)
+        for fn in (F.relu, F.gelu, lambda t: F.softmax(t, axis=-1),
+                   F.sigmoid, F.silu):
+            assert fn(x).shape == [4, 8]
+
+    def test_linear(self, dtype):
+        w = _x((8, 4), dtype, 1)
+        assert F.linear(_x((2, 8), dtype), w).shape == [2, 4]
+
+    def test_cross_entropy_bf16_logits(self, dtype):
+        logits = _x((4, 10), dtype)
+        lab = Tensor(jnp.asarray([1, 2, 3, 4], jnp.int32))
+        loss = F.cross_entropy(logits, lab)
+        assert np.isfinite(np.asarray(loss._data, np.float32))
+
+    def test_dropout(self, dtype):
+        assert F.dropout(_x((4, 8), dtype), 0.5, training=True).shape == [4, 8]
+
+
+class TestAmpO2BenchPath:
+    """The exact bench.py fast path on a tiny net — compile + one step."""
+
+    def test_resnet_amp_o2_train_step(self):
+        import jax
+        from paddle_tpu.jit.api import functional_call
+
+        pt.seed(0)
+        net = pt.vision.models.resnet18(num_classes=10)
+        pt.amp.decorate(net, level="O2", dtype="bfloat16")
+        opt = pt.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=net.parameters(),
+                                    multi_precision=True)
+        params = {k: p._data for k, p in net.named_parameters()}
+        buffers = {k: b._data for k, b in net.named_buffers()}
+        opt_state = opt.init_state_tree(params)
+        fwd = getattr(net, "_orig_forward", net.forward)
+
+        def train_step(params, buffers, opt_state, x, y):
+            def loss_of(p):
+                out, nb = functional_call(net, p, buffers, (Tensor(x),),
+                                          training=True, forward_fn=fwd)
+                logits = out._data.astype(jnp.float32)
+                logp = jax.nn.log_softmax(logits)
+                return -jnp.take_along_axis(logp, y[:, None],
+                                            axis=1).mean(), nb
+
+            (loss, nb), grads = jax.value_and_grad(loss_of, has_aux=True)(
+                params)
+            np_, no_ = opt.apply_gradients_tree(params, grads, opt_state)
+            return loss, np_, nb, no_
+
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.rand(4, 3, 32, 32).astype(np.float32)).astype(
+            jnp.bfloat16)
+        y = jnp.asarray(rng.randint(0, 10, 4).astype(np.int32))
+        loss, params, buffers, opt_state = jax.jit(train_step)(
+            params, buffers, opt_state, x, y)
+        assert np.isfinite(float(loss))
