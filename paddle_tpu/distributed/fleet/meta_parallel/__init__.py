@@ -16,3 +16,8 @@ from .sequence_parallel import (  # noqa: F401
     ring_attention, ulysses_attention, split_sequence, gather_sequence,
     RingFlashAttention,
 )
+from . import pp_spmd  # noqa: F401
+from .pp_spmd import (  # noqa: F401
+    pipeline_spmd, stack_trees, unstack_tree, pipeline_executor_scope,
+    current_pipeline_executor,
+)

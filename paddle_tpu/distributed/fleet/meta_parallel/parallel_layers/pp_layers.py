@@ -136,20 +136,76 @@ class PipelineLayer(Layer):
     def num_stages(self):
         return self._num_stages
 
+    # -- compiled-pipeline adapter (consumed by build_train_step) ----------
+    def _homogeneous_run(self):
+        """Longest run of same-class Layer items (the pipelineable block
+        stack); returns (start, end) item indices or None."""
+        best = None
+        i, n = 0, len(self._items)
+        while i < n:
+            l0, f0 = self._items[i]
+            if not isinstance(l0, Layer) or f0 is not None:
+                i += 1
+                continue
+            j = i + 1
+            while j < n:
+                lj, fj = self._items[j]
+                if not (isinstance(lj, Layer) and fj is None and
+                        type(lj) is type(l0)):
+                    break
+                j += 1
+            if best is None or j - i > best[1] - best[0]:
+                best = (i, j)
+            i = j
+        if best is not None and best[1] - best[0] >= 2:
+            return best
+        return None
+
+    def _layerlist_index(self, item_idx):
+        """Item index -> index within _layer_list (Layers only)."""
+        return sum(1 for l, _ in self._items[:item_idx]
+                   if isinstance(l, Layer))
+
+    def pipeline_blocks(self):
+        """build_train_step adapter: the homogeneous block run's parameter
+        prefixes + a representative block layer."""
+        run = self._homogeneous_run()
+        if run is None:
+            raise ValueError("no homogeneous block run to pipeline")
+        lo, hi = run
+        j0 = self._layerlist_index(lo)
+        prefixes = [f"_layer_list.{j0 + k}." for k in range(hi - lo)]
+        return prefixes, self._items[lo][0]
+
     def forward(self, x):
-        """Run ALL stages sequentially (the semantics oracle; the pipelined
-        execution lives in PipelineParallel)."""
+        """Run ALL stages sequentially (the semantics oracle). When a
+        pipeline executor scope is active (compiled train step on a pp
+        mesh), the homogeneous block run executes as the compiled SPMD
+        schedule instead."""
         from ...recompute import recompute as _recompute
+        from ..pp_spmd import current_pipeline_executor
+        pexec = current_pipeline_executor()
+        run = self._homogeneous_run() if pexec is not None else None
+
+        def call_item(v, layer, fwd_fn):
+            if fwd_fn is not None:
+                return fwd_fn(layer, v)
+            return layer(v)
+
         out = x
-        for i, (layer, fwd_fn) in enumerate(self._items):
-            def call(v, _layer=layer, _f=fwd_fn):
-                if _f is not None:
-                    return _f(_layer, v)
-                return _layer(v)
+        i, n = 0, len(self._items)
+        while i < n:
+            if run is not None and i == run[0]:
+                out = pexec(out)
+                i = run[1]
+                continue
+            layer, fwd_fn = self._items[i]
             if self._recompute_interval and \
                     i % self._recompute_interval == 0 and \
                     isinstance(layer, Layer):
-                out = _recompute(call, out)
+                out = _recompute(lambda v, _l=layer, _f=fwd_fn:
+                                 call_item(v, _l, _f), out)
             else:
-                out = call(out)
+                out = call_item(out, layer, fwd_fn)
+            i += 1
         return out

@@ -6,12 +6,12 @@ ref: ``python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py``
 (``pp_utils/p2p_communication.py:302``).
 
 TPU-native mapping: the reference's host-driven 1F1B of NCCL sends/recvs
-becomes ONE compiled program. ``train_batch`` splits the batch into
-micro-batches and accumulates gradients; when the ``pp`` mesh axis is >1
-and the stage stack is homogeneous, the compiled SPMD pipeline
-(``paddle_tpu.distributed.fleet.meta_parallel.pp_spmd``) runs the
-micro-batch loop inside ``lax.scan`` with ``ppermute`` hops between stage
-shards — the ICI-native 1F1B. Otherwise the schedule degrades gracefully
+becomes ONE compiled program. When the ``pp`` mesh axis is >1 and the
+stage stack is homogeneous, ``train_batch`` runs the compiled SPMD
+pipeline (``pp_spmd.pipeline_spmd`` via
+``distributed.train_step.build_train_step``): stacked stage parameters
+sharded over ``pp``, the micro-batch tick loop inside ``lax.scan`` with
+``ppermute`` hops — the ICI-native 1F1B. Otherwise the schedule degrades
 to sequential micro-batch accumulation (identical numerics: pipelining
 changes time, not math).
 """
@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from ....tensor import Tensor
 from ....nn.layer.layers import Layer
 from .parallel_layers.pp_layers import PipelineLayer
+from .pp_spmd import PP_STACK_PREFIX
 
 __all__ = ["PipelineParallel"]
 
@@ -42,6 +43,11 @@ class PipelineParallel(Layer):
         self.accumulate_steps = pcfg.get("accumulate_steps", 1)
         self.micro_batch_size = pcfg.get("micro_batch_size", None)
         self.total_loss = None
+        # compiled-pipeline cache (built lazily on a pp>1 mesh)
+        self._pp_step = None
+        self._pp_state = None
+        self._pp_optimizer = None
+        self._pp_dirty = False
 
     # -- reference API surface --------------------------------------------
     def forward(self, x):
@@ -51,10 +57,21 @@ class PipelineParallel(Layer):
         """ref: pipeline_parallel.py:572 train_batch → 1F1B schedule.
 
         data: (inputs, labels). Returns the averaged loss tensor.
+
+        On a mesh with ``pp > 1`` and a homogeneous stage stack this runs
+        the compiled SPMD 1F1B (one XLA program; stage params stacked and
+        sharded over ``pp``); otherwise sequential micro-batch
+        accumulation on the eager tape.
         """
         if self._layers._loss_fn is None:
             raise ValueError("train_batch requires PipelineLayer(loss_fn=..)")
         inputs, labels = data
+        if scaler is None and self._pp_mesh_degree() > 1:
+            loss = self._compiled_train_batch(inputs, labels, optimizer)
+            if loss is not None:
+                if lr_scheduler is not None:
+                    lr_scheduler.step()
+                return loss
         micro_inputs = self._split_micro(inputs)
         micro_labels = self._split_micro(labels)
         n = len(micro_inputs)
@@ -91,6 +108,67 @@ class PipelineParallel(Layer):
     def forward_backward_pipeline(self, data, optimizer, scaler=None):
         return self.train_batch(data, optimizer, scaler=scaler)
 
+    # -- compiled SPMD path ------------------------------------------------
+    def _pp_mesh_degree(self):
+        from ... import mesh as _mesh_mod
+        return _mesh_mod.mesh_axis_size("pp")
+
+    def _compiled_train_batch(self, inputs, labels, optimizer):
+        """Build (once) + run the compiled pipelined step. Returns the
+        loss Tensor, or None when the stack cannot be pipelined (falls
+        back to the sequential schedule — same math, no pipelining)."""
+        from ...train_step import build_train_step, pipeline_compatible
+        if not pipeline_compatible(self._layers, self._pp_mesh_degree()):
+            return None
+        if getattr(self, "_pp_step", None) is None or \
+                self._pp_optimizer is not optimizer:
+            n_micro = max(self.accumulate_steps,
+                          self._pp_mesh_degree())
+            self._pp_step, self._pp_state = build_train_step(
+                self._layers, self._layers._loss_fn, optimizer,
+                pipeline_microbatches=n_micro)
+            self._pp_optimizer = optimizer
+        loss, self._pp_state = self._pp_step(self._pp_state, inputs, labels)
+        self._pp_dirty = True
+        return Tensor(loss)
+
+    def _sync_state_to_layers(self):
+        """Write compiled state (params, buffers, optimizer slots) back
+        into the layer/optimizer objects — unstacking the pp-stacked
+        blocks — so state_dict()s are current."""
+        if not getattr(self, "_pp_dirty", False):
+            return
+        prefixes, _ = self._layers.pipeline_blocks()
+        named = dict(self._layers.named_parameters())
+
+        def for_each(k, v, apply):
+            """apply(tensor, array) for the (possibly stacked) entry."""
+            if k.startswith(PP_STACK_PREFIX):
+                loc = k[len(PP_STACK_PREFIX):]
+                for i, pfx in enumerate(prefixes):
+                    apply(named[pfx + loc], v[i])
+            elif k in named:
+                apply(named[k], v)
+
+        for k, v in self._pp_state["params"].items():
+            for_each(k, v, lambda t, a: setattr(t, "_data", a))
+        named_b = dict(self._layers.named_buffers())
+        for k, v in self._pp_state["buffers"].items():
+            if k in named_b:
+                named_b[k]._data = v
+        # optimizer accumulators are keyed by tensor name, not model path
+        opt = self._pp_optimizer
+        opt_state = self._pp_state["opt"]
+        for slot, d in opt_state["slots"].items():
+            for k, v in d.items():
+                for_each(k, v, lambda t, a, _s=slot:
+                         opt._accumulators[_s].__setitem__(t.name, a))
+        for k, v in opt_state["master"].items():
+            for_each(k, v, lambda t, a:
+                     opt._master_weights.__setitem__(t.name, a))
+        opt._global_step = int(opt_state["step"])
+        self._pp_dirty = False
+
     def _split_micro(self, t):
         n = self.accumulate_steps
         if n <= 1:
@@ -104,9 +182,15 @@ class PipelineParallel(Layer):
 
     # delegation ----------------------------------------------------------
     def state_dict(self, *args, **kwargs):
+        self._sync_state_to_layers()
         return self._layers.state_dict(*args, **kwargs)
 
     def set_state_dict(self, state_dict, *args, **kwargs):
+        # loaded weights invalidate the compiled-state cache: the next
+        # train_batch rebuilds state from the (just-updated) layer tensors
+        self._pp_step = None
+        self._pp_state = None
+        self._pp_dirty = False
         return self._layers.set_state_dict(state_dict, *args, **kwargs)
 
     def parameters(self, include_sublayers=True):

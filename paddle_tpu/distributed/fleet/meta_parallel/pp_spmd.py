@@ -1,0 +1,166 @@
+"""Compiled SPMD pipeline parallelism — the TPU-native 1F1B.
+
+ref: ``python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py``
+(1F1B host schedule ``forward_backward_pipeline :372``, interleaved ``:807``)
+and the NCCL P2P layer (``pp_utils/p2p_communication.py:302,436,478``).
+
+TPU-first re-design: instead of a host loop issuing per-micro-batch NCCL
+sends/recvs, the WHOLE schedule is one XLA program:
+
+ - the homogeneous stage blocks' parameters are *stacked* along a new
+   leading axis of size ``n_blocks`` and sharded over the ``pp`` mesh axis
+   (stage s owns blocks ``[s*L, (s+1)*L)``) — each chip stores only its
+   stage, the pipeline memory win;
+ - a ``shard_map`` manual only over ``pp`` (dp/mp/sharding/sep stay under
+   GSPMD) runs the tick loop in ``lax.scan``: at tick ``t`` stage ``s``
+   processes micro-batch ``t - s``, then hands its activation to stage
+   ``s+1`` with one ``lax.ppermute`` hop over ICI;
+ - backward is ``jax.grad`` through the scan (``ppermute`` transposes to
+   the reverse hop — the compiled analog of ``send_backward``/
+   ``recv_backward``), with ``jax.checkpoint`` on the stage body so the
+   scan stores only per-tick stage *inputs* (the 1F1B activation-memory
+   discipline) and recomputes inside backward.
+
+The bubble executes masked dummy work (standard SPMD pipelining); with
+``M`` micro-batches utilization is ``M / (M + pp - 1)``.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ... import mesh as _mesh_mod
+from ....framework import random as _random
+
+__all__ = ["stack_trees", "unstack_tree", "pipeline_spmd",
+           "microbatch_utilization", "pipeline_executor_scope",
+           "current_pipeline_executor", "PP_STACK_PREFIX"]
+
+# flat-dict key prefix for stacked block parameters in a pipelined
+# train-step state (build_train_step): "__ppstack__.<block-local name>"
+PP_STACK_PREFIX = "__ppstack__."
+
+_executor_tls = threading.local()
+
+
+@contextlib.contextmanager
+def pipeline_executor_scope(fn):
+    """While active, pipeline-aware models route their homogeneous block
+    loop through ``fn(x, *extras) -> x`` instead of running it inline."""
+    prev = getattr(_executor_tls, "fn", None)
+    _executor_tls.fn = fn
+    try:
+        yield
+    finally:
+        _executor_tls.fn = prev
+
+
+def current_pipeline_executor():
+    return getattr(_executor_tls, "fn", None)
+
+
+def stack_trees(trees):
+    """Stack a list of identical pytrees along a new leading axis."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def unstack_tree(tree, n):
+    """Inverse of :func:`stack_trees`: one pytree -> list of n pytrees."""
+    return [jax.tree.map(lambda a, i=i: a[i], tree) for i in range(n)]
+
+
+def microbatch_utilization(num_microbatches, pp):
+    """Fraction of non-bubble ticks: M / (M + pp - 1)."""
+    return num_microbatches / (num_microbatches + pp - 1)
+
+
+def pipeline_spmd(stage_fn, stage_params, x, num_microbatches, *,
+                  mesh=None, axis_name="pp", remat=True):
+    """Run ``x`` through ``pp`` pipeline stages as one compiled schedule.
+
+    stage_fn(stage_params_local, h) -> h' where ``stage_params_local`` is
+    ``stage_params`` with the leading (stage) axis reduced to this stage's
+    slice, and ``h``/``h'`` are one micro-batch of activations with
+    identical shape/dtype (homogeneous-stage requirement, same as the
+    reference's ``PipelineLayer`` contract).
+
+    stage_params: pytree; every leaf has leading dim divisible by ``pp``
+    (``n_blocks`` total blocks → ``L = n_blocks/pp`` per stage) and is
+    expected to be sharded ``P(axis_name, ...)`` on that axis.
+
+    x: ``[B, ...]`` activations entering stage 0; ``B`` must be divisible
+    by ``num_microbatches``.
+
+    Returns ``[B, ...]`` activations leaving the last stage. Differentiable
+    (gradients flow to both ``stage_params`` and ``x``).
+    """
+    mesh = mesh or _mesh_mod.get_mesh()
+    pp = mesh.shape.get(axis_name, 1)
+    M = int(num_microbatches)
+    if x.shape[0] % M:
+        raise ValueError(
+            f"batch {x.shape[0]} not divisible by num_microbatches {M}")
+
+    if pp <= 1:
+        # no pp axis: plain sequential over the stacked blocks
+        return stage_fn(stage_params, x)
+
+    mb_shape = (M, x.shape[0] // M) + tuple(x.shape[1:])
+    body = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def pipelined(sp, mbs, key):
+        # sp leaves arrive [n_blocks/pp, ...] (this stage's slice);
+        # mbs [M, mb, ...] replicated over pp.
+        idx = lax.axis_index(axis_name)
+        # per-stage, per-tick RNG: distinct dropout keys on every stage
+        stage_key = jax.random.fold_in(key, idx)
+
+        perm = [(i, (i + 1) % pp) for i in range(pp)]
+        T = M + pp - 1
+
+        def tick(carry, t):
+            act, out_buf = carry
+            x_in = jnp.where(idx == 0, mbs[jnp.clip(t, 0, M - 1)], act)
+
+            def run(h, key):
+                with _random.trace_key_scope(key):
+                    return body(sp, h)
+
+            y = run(x_in, jax.random.fold_in(stage_key, t))
+            out_t = t - (pp - 1)
+            oc = jnp.clip(out_t, 0, M - 1)
+            valid = (out_t >= 0) & (out_t < M) & (idx == pp - 1)
+            upd = jnp.where(valid, y, out_buf[oc])
+            out_buf = lax.dynamic_update_index_in_dim(out_buf, upd, oc, 0)
+            # hand activations to the next stage over ICI
+            act = lax.ppermute(y, axis_name, perm)
+            return (act, out_buf), None
+
+        init = (jnp.zeros(mb_shape[1:], x.dtype),
+                jnp.zeros(mb_shape, x.dtype))
+        (_act, out_buf), _ = lax.scan(tick, init, jnp.arange(T))
+        # only the last stage holds real outputs; psum over pp replicates
+        # them (everyone else contributes zeros)
+        out = lax.psum(jnp.where(idx == pp - 1, out_buf,
+                                 jnp.zeros_like(out_buf)), axis_name)
+        return out
+
+    mbs = jnp.reshape(x, mb_shape)
+    # RNG: when a functional trace scope is active (build_train_step), fold
+    # from its traced key; otherwise use a fresh literal key — we must NOT
+    # touch the global generator here, or its cached root key would be
+    # created as a tracer inside this trace and leak.
+    if _random._trace_key_state() is not None:
+        key = _random.next_key()
+    else:
+        key = jax.random.key(0)
+    mapped = jax.shard_map(
+        pipelined, mesh=mesh, in_specs=(P(axis_name), P(), P()),
+        out_specs=P(), axis_names={axis_name}, check_vma=False)
+    out = mapped(stage_params, mbs, key)
+    return jnp.reshape(out, x.shape)

@@ -78,7 +78,7 @@ def shard_model_state(layer: Layer, mesh=None):
 
 
 def build_train_step(model: Layer, loss_fn, optimizer, mesh=None,
-                     donate=True):
+                     donate=True, pipeline_microbatches=None):
     """Returns (step_fn, state) where
     ``state = {"params", "buffers", "opt"}`` is mesh-placed and
     ``step_fn(state, *batch) -> (loss, state)`` is one compiled program.
@@ -86,8 +86,19 @@ def build_train_step(model: Layer, loss_fn, optimizer, mesh=None,
     ``loss_fn(outputs, *labels) -> scalar Tensor-or-array``.
     The batch's leading axis is sharded over ``dp`` (and the second axis
     over ``sep`` when that axis is >1, for sequence parallelism).
+
+    When the mesh has a ``pp`` axis >1 and the model implements
+    ``pipeline_blocks()``, the homogeneous block stack is *stacked* into
+    ``__ppstack__.*`` leaves sharded over ``pp`` and executed as a compiled
+    1F1B schedule (``meta_parallel.pp_spmd``) — each chip stores only its
+    stage's blocks. ``pipeline_microbatches`` defaults to the pp degree.
     """
     mesh = mesh or _mesh_mod.get_mesh()
+    pp = mesh.shape.get("pp", 1)
+    if pp > 1 and pipeline_compatible(model, pp):
+        return _build_pipelined_train_step(
+            model, loss_fn, optimizer, mesh, donate,
+            pipeline_microbatches or pp)
     params, buffers, shardings = shard_model_state(model, mesh)
     opt_state = optimizer.init_state_tree(params)
     # optimizer slots/master inherit each param's sharding (the ZeRO win:
@@ -111,7 +122,7 @@ def build_train_step(model: Layer, loss_fn, optimizer, mesh=None,
     data_sharding = NamedSharding(mesh, data_spec)
     fwd = getattr(model, "_orig_forward", model.forward)
 
-    def step(state, x, *labels):
+    def step(state, lr, x, *labels):
         def loss_of(p):
             out, new_buffers = functional_call(
                 model, p, state["buffers"], (Tensor(x),), training=True,
@@ -123,13 +134,13 @@ def build_train_step(model: Layer, loss_fn, optimizer, mesh=None,
         (loss, new_buffers), grads = jax.value_and_grad(
             loss_of, has_aux=True)(state["params"])
         new_params, new_opt = optimizer.apply_gradients_tree(
-            state["params"], grads, state["opt"])
+            state["params"], grads, state["opt"], lr=lr)
         return loss, {"params": new_params, "buffers": new_buffers,
                       "opt": new_opt}
 
-    def rng_step(state, key, x, *labels):
+    def rng_step(state, key, lr, x, *labels):
         with _random.trace_key_scope(key):
-            return step(state, x, *labels)
+            return step(state, lr, x, *labels)
 
     jitted = jax.jit(rng_step, donate_argnums=(0,) if donate else ())
 
@@ -140,7 +151,158 @@ def build_train_step(model: Layer, loss_fn, optimizer, mesh=None,
         x = jax.device_put(x, data_sharding)
         labels = [jax.device_put(l, data_sharding) for l in labels]
         key = _random.next_key()
+        # LR threaded as a runtime arg: schedulers advance between compiled
+        # steps without retracing
+        lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
         with jax.set_mesh(mesh):
-            return jitted(state, key, x, *labels)
+            return jitted(state, key, lr, x, *labels)
+
+    return run, state
+
+
+def pipeline_compatible(model, pp):
+    """True when the model's block stack can run the compiled pipeline:
+    a pipeline_blocks() adapter, block count divisible by pp, and
+    identical param sets/shapes across blocks (jnp.stack-able)."""
+    if not hasattr(model, "pipeline_blocks"):
+        return False
+    try:
+        prefixes, _ = model.pipeline_blocks()
+    except ValueError:
+        return False
+    if not prefixes or len(prefixes) % pp:
+        return False
+    named = dict(model.named_parameters())
+    locals0 = sorted(k[len(prefixes[0]):] for k in named
+                     if k.startswith(prefixes[0]))
+    if not locals0:
+        return False
+    for pfx in prefixes[1:]:
+        locs = sorted(k[len(pfx):] for k in named if k.startswith(pfx))
+        if locs != locals0:
+            return False
+        for loc in locs:
+            if tuple(named[pfx + loc].shape) != \
+                    tuple(named[prefixes[0] + loc].shape):
+                return False
+    return True
+
+
+def _build_pipelined_train_step(model, loss_fn, optimizer, mesh, donate,
+                                num_microbatches):
+    """Pipeline-parallel variant of :func:`build_train_step`.
+
+    State layout: the homogeneous blocks' parameters are stacked into
+    ``__ppstack__.<local>`` leaves of shape ``[n_blocks, ...]`` sharded
+    ``P("pp", *block_spec)`` — stage ``s`` physically stores blocks
+    ``[s*L, (s+1)*L)`` only. The forward routes the model's block loop
+    through ``pp_spmd.pipeline_spmd`` via the pipeline-executor scope.
+    """
+    from .fleet.meta_parallel.pp_spmd import (
+        PP_STACK_PREFIX, pipeline_spmd, pipeline_executor_scope)
+
+    pp = mesh.shape["pp"]
+    prefixes, block_layer = model.pipeline_blocks()
+    n_blocks = len(prefixes)
+    if n_blocks % pp:
+        raise ValueError(
+            f"{n_blocks} pipeline blocks not divisible by pp={pp}")
+    if dict(block_layer.named_buffers()):
+        raise ValueError("pipelined blocks must be buffer-free")
+    n_local = n_blocks // pp
+
+    named = dict(model.named_parameters())
+    block_locals = [k[len(prefixes[0]):] for k in named
+                    if k.startswith(prefixes[0])]
+    # stack [n_blocks, ...] per block-local param, shard over pp
+    stacked, stacked_sh = {}, {}
+    for loc in block_locals:
+        p0 = named[prefixes[0] + loc]
+        spec = _spec_for(p0, mesh)
+        stacked[PP_STACK_PREFIX + loc] = jnp.stack(
+            [jnp.copy(named[pfx + loc]._data) for pfx in prefixes])
+        stacked_sh[PP_STACK_PREFIX + loc] = NamedSharding(
+            mesh, P(*(("pp",) + tuple(spec))))
+    block_names = {pfx + loc for pfx in prefixes for loc in block_locals}
+
+    rest_sh = {k: NamedSharding(mesh, _spec_for(p, mesh))
+               for k, p in named.items() if k not in block_names}
+    params = {k: jax.device_put(jnp.copy(named[k]._data), rest_sh[k])
+              for k in rest_sh}
+    params.update({k: jax.device_put(v, stacked_sh[k])
+                   for k, v in stacked.items()})
+    shardings = {**rest_sh, **stacked_sh}
+
+    repl = NamedSharding(mesh, P())
+    buffers = {k: jax.device_put(jnp.copy(b._data), repl)
+               for k, b in model.named_buffers()}
+
+    opt_state = optimizer.init_state_tree(params)
+    opt_state = {
+        "slots": {s: {k: jax.device_put(v, shardings[k])
+                      for k, v in sv.items()}
+                  for s, sv in opt_state["slots"].items()},
+        "master": {k: jax.device_put(v, shardings[k])
+                   for k, v in opt_state["master"].items()},
+        "step": jax.device_put(opt_state["step"], repl),
+    }
+    state = {"params": params, "buffers": buffers, "opt": opt_state}
+
+    sep = mesh.shape.get("sep", 1)
+    data_spec = P("dp", "sep") if sep > 1 else P("dp")
+    data_sharding = NamedSharding(mesh, data_spec)
+    fwd = getattr(model, "_orig_forward", model.forward)
+
+    def step(state, lr, x, *labels):
+        def loss_of(p):
+            sp = {k[len(PP_STACK_PREFIX):]: v for k, v in p.items()
+                  if k.startswith(PP_STACK_PREFIX)}
+            rest = {k: v for k, v in p.items()
+                    if not k.startswith(PP_STACK_PREFIX)}
+
+            def executor(h, *extras):
+                def stage_fn(sp_local, harr):
+                    t = Tensor(harr)
+                    for j in range(n_local):
+                        pj = {kk: vv[j] for kk, vv in sp_local.items()}
+                        out, _ = functional_call(block_layer, pj, {},
+                                                 (t,) + tuple(extras))
+                        t = out
+                    return t._data
+                y = pipeline_spmd(stage_fn, sp, h._data, num_microbatches,
+                                  mesh=mesh)
+                return Tensor(y)
+
+            with pipeline_executor_scope(executor):
+                out, new_buffers = functional_call(
+                    model, rest, state["buffers"], (Tensor(x),),
+                    training=True, forward_fn=fwd)
+            loss = loss_fn(out, *[Tensor(l) for l in labels])
+            loss_arr = loss._data if isinstance(loss, Tensor) else loss
+            return loss_arr.astype(jnp.float32), new_buffers
+
+        (loss, new_buffers), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(state["params"])
+        new_params, new_opt = optimizer.apply_gradients_tree(
+            state["params"], grads, state["opt"], lr=lr)
+        return loss, {"params": new_params, "buffers": new_buffers,
+                      "opt": new_opt}
+
+    def rng_step(state, key, lr, x, *labels):
+        with _random.trace_key_scope(key):
+            return step(state, lr, x, *labels)
+
+    jitted = jax.jit(rng_step, donate_argnums=(0,) if donate else ())
+
+    def run(state, x, *labels):
+        x = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        labels = [l._data if isinstance(l, Tensor) else jnp.asarray(l)
+                  for l in labels]
+        x = jax.device_put(x, data_sharding)
+        labels = [jax.device_put(l, data_sharding) for l in labels]
+        key = _random.next_key()
+        lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
+        with jax.set_mesh(mesh):
+            return jitted(state, key, lr, x, *labels)
 
     return run, state

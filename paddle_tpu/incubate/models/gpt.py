@@ -202,7 +202,13 @@ class GPTModel(Layer):
 
     def forward(self, input_ids, position_ids=None, attention_mask=None):
         x = self.embeddings(input_ids, position_ids)
-        if self.use_recompute:
+        from ...distributed.fleet.meta_parallel.pp_spmd import \
+            current_pipeline_executor
+        pexec = current_pipeline_executor()
+        if pexec is not None:
+            # compiled SPMD pipeline over the decoder stack (pp mesh axis)
+            x = pexec(x, attention_mask)
+        elif self.use_recompute:
             from ...distributed.fleet.recompute import recompute
             for layer in self.layers:
                 x = recompute(layer, x, attention_mask)
@@ -230,6 +236,18 @@ class GPTForCausalLM(Layer):
             else:
                 self.lm_head = Linear(cfg.hidden_size, cfg.vocab_size,
                                       weight_attr=init, bias_attr=False)
+
+    def pipeline_blocks(self):
+        """Pipeline-parallel adapter (consumed by
+        ``distributed.train_step.build_train_step`` when the mesh has a
+        ``pp`` axis): the homogeneous decoder stack to shard over stages.
+
+        Returns (block_param_prefixes, block_layer): prefixes name each
+        block's parameters in ``named_parameters()`` order; ``block_layer``
+        is one representative block for functional per-stage calls.
+        """
+        n = len(self.gpt.layers)
+        return ([f"gpt.layers.{i}." for i in range(n)], self.gpt.layers[0])
 
     def forward(self, input_ids, position_ids=None, attention_mask=None):
         x = self.gpt(input_ids, position_ids, attention_mask)
