@@ -27,6 +27,10 @@ from .auto_parallel_api import (  # noqa: F401
 )
 from . import rpc  # noqa: F401
 from . import utils  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    save_sharded, load_sharded, save_state, load_state,
+)
 
 # spawn-style launch (ref: python/paddle/distributed/spawn.py)
 from .launch_api import spawn, launch  # noqa: F401

@@ -131,6 +131,20 @@ class Fleet:
                           mode=0):
         return None
 
+    def save_sharded(self, state, path):
+        """Distributed checkpoint of a build_train_step state: per-host
+        shard files + index, reshardable on load (ref:
+        ``auto_parallel/static/dist_saver.py``)."""
+        from ..checkpoint import save_state
+        save_state(state, path)
+
+    def load_sharded(self, path, state):
+        """Restore a sharded checkpoint into a freshly built train-step
+        state — the saved mesh may differ (ref: ``converter.py``,
+        ``pp_parallel_adaptor.py``)."""
+        from ..checkpoint import load_state
+        return load_state(path, state)
+
 
 fleet = Fleet()
 

@@ -118,12 +118,21 @@ class PipelineParallel(Layer):
         loss Tensor, or None when the stack cannot be pipelined (falls
         back to the sequential schedule — same math, no pipelining)."""
         from ...train_step import build_train_step, pipeline_compatible
-        if not pipeline_compatible(self._layers, self._pp_mesh_degree()):
-            return None
-        if getattr(self, "_pp_step", None) is None or \
-                self._pp_optimizer is not optimizer:
-            n_micro = max(self.accumulate_steps,
-                          self._pp_mesh_degree())
+        n_micro = max(self.accumulate_steps, self._pp_mesh_degree())
+        batch = (inputs._data.shape[0] if isinstance(inputs, Tensor)
+                 else np.asarray(inputs).shape[0])
+        if batch % n_micro:
+            return None  # sequential fallback handles ragged batches
+        cached = self._pp_step is not None and \
+            self._pp_optimizer is optimizer
+        if not cached:
+            # the compatibility scan is O(params) — only on (re)build
+            if not pipeline_compatible(self._layers,
+                                       self._pp_mesh_degree()):
+                return None
+            # a prior compiled state must land in the layer tensors
+            # BEFORE rebuild re-extracts them (optimizer swap mid-run)
+            self._sync_state_to_layers()
             self._pp_step, self._pp_state = build_train_step(
                 self._layers, self._layers._loss_fn, optimizer,
                 pipeline_microbatches=n_micro)

@@ -80,14 +80,15 @@ def microbatch_utilization(num_microbatches, pp):
 
 
 def pipeline_spmd(stage_fn, stage_params, x, num_microbatches, *,
-                  mesh=None, axis_name="pp", remat=True):
+                  mesh=None, axis_name="pp", remat=True, extras=()):
     """Run ``x`` through ``pp`` pipeline stages as one compiled schedule.
 
-    stage_fn(stage_params_local, h) -> h' where ``stage_params_local`` is
-    ``stage_params`` with the leading (stage) axis reduced to this stage's
-    slice, and ``h``/``h'`` are one micro-batch of activations with
-    identical shape/dtype (homogeneous-stage requirement, same as the
-    reference's ``PipelineLayer`` contract).
+    stage_fn(stage_params_local, h, *extras_mb) -> h' where
+    ``stage_params_local`` is ``stage_params`` with the leading (stage)
+    axis reduced to this stage's slice, and ``h``/``h'`` are one
+    micro-batch of activations with identical shape/dtype
+    (homogeneous-stage requirement, same as the reference's
+    ``PipelineLayer`` contract).
 
     stage_params: pytree; every leaf has leading dim divisible by ``pp``
     (``n_blocks`` total blocks → ``L = n_blocks/pp`` per stage) and is
@@ -96,24 +97,36 @@ def pipeline_spmd(stage_fn, stage_params, x, num_microbatches, *,
     x: ``[B, ...]`` activations entering stage 0; ``B`` must be divisible
     by ``num_microbatches``.
 
+    extras: auxiliary arrays fed to every stage call (e.g. an attention
+    mask). An extra whose leading dim equals ``B`` is split into
+    micro-batches and indexed at each stage's own offset ``t - s`` (stage
+    ``s`` processes micro-batch ``t - s`` at tick ``t``); other extras
+    (broadcast masks etc.) pass through whole.
+
     Returns ``[B, ...]`` activations leaving the last stage. Differentiable
-    (gradients flow to both ``stage_params`` and ``x``).
+    (gradients flow to ``stage_params``, ``x`` and split ``extras``).
     """
     mesh = mesh or _mesh_mod.get_mesh()
     pp = mesh.shape.get(axis_name, 1)
     M = int(num_microbatches)
-    if x.shape[0] % M:
+    B = x.shape[0]
+    if B % M:
         raise ValueError(
-            f"batch {x.shape[0]} not divisible by num_microbatches {M}")
+            f"batch {B} not divisible by num_microbatches {M}")
 
     if pp <= 1:
         # no pp axis: plain sequential over the stacked blocks
-        return stage_fn(stage_params, x)
+        return stage_fn(stage_params, x, *extras)
 
-    mb_shape = (M, x.shape[0] // M) + tuple(x.shape[1:])
+    mb_shape = (M, B // M) + tuple(x.shape[1:])
+    split_mask = [getattr(e, "ndim", 0) >= 1 and e.shape[0] == B
+                  for e in extras]
+    extras_in = tuple(
+        jnp.reshape(e, (M, B // M) + tuple(e.shape[1:])) if sp else e
+        for e, sp in zip(extras, split_mask))
     body = jax.checkpoint(stage_fn) if remat else stage_fn
 
-    def pipelined(sp, mbs, key):
+    def pipelined(sp, mbs, key, *extras_mb):
         # sp leaves arrive [n_blocks/pp, ...] (this stage's slice);
         # mbs [M, mb, ...] replicated over pp.
         idx = lax.axis_index(axis_name)
@@ -126,10 +139,14 @@ def pipeline_spmd(stage_fn, stage_params, x, num_microbatches, *,
         def tick(carry, t):
             act, out_buf = carry
             x_in = jnp.where(idx == 0, mbs[jnp.clip(t, 0, M - 1)], act)
+            # stage s processes micro-batch t - s at tick t
+            mb_i = jnp.clip(t - idx, 0, M - 1)
+            e_in = tuple(e[mb_i] if sp else e
+                         for e, sp in zip(extras_mb, split_mask))
 
             def run(h, key):
                 with _random.trace_key_scope(key):
-                    return body(sp, h)
+                    return body(sp, h, *e_in)
 
             y = run(x_in, jax.random.fold_in(stage_key, t))
             out_t = t - (pp - 1)
@@ -160,7 +177,8 @@ def pipeline_spmd(stage_fn, stage_params, x, num_microbatches, *,
     else:
         key = jax.random.key(0)
     mapped = jax.shard_map(
-        pipelined, mesh=mesh, in_specs=(P(axis_name), P(), P()),
+        pipelined, mesh=mesh,
+        in_specs=(P(axis_name), P(), P()) + tuple(P() for _ in extras_in),
         out_specs=P(), axis_names={axis_name}, check_vma=False)
-    out = mapped(stage_params, mbs, key)
+    out = mapped(stage_params, mbs, key, *extras_in)
     return jnp.reshape(out, x.shape)

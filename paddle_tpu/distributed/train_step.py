@@ -167,11 +167,13 @@ def pipeline_compatible(model, pp):
     if not hasattr(model, "pipeline_blocks"):
         return False
     try:
-        prefixes, _ = model.pipeline_blocks()
+        prefixes, block_layer = model.pipeline_blocks()
     except ValueError:
         return False
     if not prefixes or len(prefixes) % pp:
         return False
+    if dict(block_layer.named_buffers()):
+        return False  # stage calls are buffer-free pure functions
     named = dict(model.named_parameters())
     locals0 = sorted(k[len(prefixes[0]):] for k in named
                      if k.startswith(prefixes[0]))
@@ -261,16 +263,25 @@ def _build_pipelined_train_step(model, loss_fn, optimizer, mesh, donate,
                     if not k.startswith(PP_STACK_PREFIX)}
 
             def executor(h, *extras):
-                def stage_fn(sp_local, harr):
+                # extras (e.g. attention masks) ride as arrays so the
+                # schedule can split per-micro-batch ones
+                e_arrs = tuple(e._data if isinstance(e, Tensor) else e
+                               for e in extras if e is not None)
+                e_none = tuple(e is None for e in extras)
+
+                def stage_fn(sp_local, harr, *earrs):
                     t = Tensor(harr)
+                    it = iter(earrs)
+                    eargs = tuple(None if none else Tensor(next(it))
+                                  for none in e_none)
                     for j in range(n_local):
                         pj = {kk: vv[j] for kk, vv in sp_local.items()}
                         out, _ = functional_call(block_layer, pj, {},
-                                                 (t,) + tuple(extras))
+                                                 (t,) + eargs)
                         t = out
                     return t._data
                 y = pipeline_spmd(stage_fn, sp, h._data, num_microbatches,
-                                  mesh=mesh)
+                                  mesh=mesh, extras=e_arrs)
                 return Tensor(y)
 
             with pipeline_executor_scope(executor):

@@ -284,8 +284,24 @@ class Model:
         return outputs
 
     # -- io -----------------------------------------------------------------
-    def save(self, path, training=True):
+    def save(self, path, training=True, sharded=False):
         from ..framework.io_state import save as _save
+        if sharded:
+            # distributed checkpoint: per-host shard files, reshardable on
+            # load (ref: auto_parallel dist_saver)
+            from ..distributed.checkpoint import save_sharded
+            params = {k: t._data for k, t in
+                      self.network.state_dict().items()}
+            tree = {"params": params}
+            if training and self._optimizer is not None:
+                # hapi's compiled train step keeps optimizer state in
+                # _opt_state (never the eager accumulators) — that tree
+                # is the source of truth; zeros if training hasn't started
+                tree["opt_tree"] = (
+                    self._opt_state if self._opt_state is not None
+                    else self._optimizer.init_state_tree(params))
+            save_sharded(tree, path)
+            return
         if training:
             _save(self.network.state_dict(), path + ".pdparams")
             if self._optimizer is not None:
@@ -298,6 +314,23 @@ class Model:
 
     def load(self, path, skip_mismatch=False, reset_optimizer=False):
         from ..framework.io_state import load as _load
+        if os.path.isdir(path):  # sharded checkpoint directory
+            from ..distributed.checkpoint import load_sharded
+            from ..tensor import Tensor
+            tree = load_sharded(path)
+            self.network.set_state_dict(
+                {k: Tensor(v) for k, v in tree["params"].items()})
+            if not reset_optimizer and self._optimizer is not None and \
+                    "opt_tree" in tree:
+                ot = tree["opt_tree"]
+                # empty subtrees (no master weights / slot-less SGD) have
+                # no leaves to save — restore their containers
+                ot.setdefault("slots", {})
+                ot.setdefault("master", {})
+                for s in self._optimizer._state_slots:
+                    ot["slots"].setdefault(s, {})
+                self._opt_state = ot
+            return
         state = _load(path + ".pdparams") if os.path.exists(
             path + ".pdparams") else _load(path)
         self.network.set_state_dict(state)
