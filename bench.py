@@ -1,40 +1,129 @@
 """Benchmark entry (driver-run on real TPU hardware).
 
-Measures BASELINE.md config[0]: ResNet-50 training throughput on
-CIFAR-10-shaped data (batch 256, 3x32x32), images/sec, single chip.
+Measures two BASELINE.md configs on a single chip:
+ - configs[0]: ResNet-50 training throughput, CIFAR-10-shaped data
+   (batch 256, 3x32x32), images/sec.
+ - configs[3]-class: GPT-345M causal-LM training, seq 1024, bf16 AMP,
+   tokens/sec/chip + MFU — the transformer fast path the framework is for.
 
-The whole train step (forward + backward + Adam/Momentum update) is one
-jitted XLA program with bf16 AMP — the framework's designed fast path.
-Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+Each train step (forward + backward + optimizer update) is ONE jitted XLA
+program with bf16 AMP. MFU comes from XLA's own cost analysis vs the chip's
+public bf16 peak.
+
+Robustness (BENCH_r02 post-mortem: a refused tunnel connection at
+param-init time produced rc=1 and zero signal): every device-touching
+stage runs under bounded retry-with-backoff, and the script ALWAYS prints
+its one JSON line — with partial fields (device_kind, compile time,
+cost-analysis FLOPs, error tails) when a stage could not complete. rc=0
+iff at least one throughput number was measured.
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
+import traceback
 
-BATCH = 256
-WARMUP = 5
-ITERS = 30
+SMOKE = bool(os.environ.get("BENCH_SMOKE"))  # tiny-shape CI structure check
+RESNET_BATCH = 8 if SMOKE else 256
+GPT_SEQ = 64 if SMOKE else 1024
+WARMUP = 1 if SMOKE else 5
+ITERS = 2 if SMOKE else 30
+RETRIES = 1 if SMOKE else 5
+BACKOFF = (5, 10, 20, 40, 60)  # seconds between attempts
+
+# bf16 peak FLOP/s per chip by device kind (public spec sheets)
+_PEAK = {
+    "TPU v4": 275e12, "TPU v5": 459e12, "TPU v5p": 459e12,
+    "TPU v5e": 197e12, "TPU v5 lite": 197e12, "TPU v6e": 918e12,
+    "TPU v6 lite": 918e12, "TPU v3": 123e12, "TPU v2": 45e12,
+}
 
 
-def main():
+def _retry(stage_name, fn, errors, attempts=RETRIES):
+    """Run fn() with bounded retry-with-backoff. Returns result or None;
+    records the last error tail in errors[stage_name]."""
+    for attempt in range(attempts):
+        try:
+            out = fn()
+            errors.pop(stage_name, None)  # earlier attempts' noise
+            return out
+        except Exception:
+            tb = traceback.format_exc(limit=20)
+            errors[stage_name] = tb.strip().splitlines()[-1][:400]
+            if attempt < attempts - 1:
+                time.sleep(BACKOFF[min(attempt, len(BACKOFF) - 1)])
+    return None
+
+
+def _honor_cpu_override():
+    """The environment's sitecustomize force-registers the TPU-tunnel
+    backend via jax.config (overriding the JAX_PLATFORMS env var); when
+    the caller explicitly asked for cpu, re-assert it before any backend
+    initializes."""
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        try:
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+
+
+def _flops_per_step(compiled):
+    """Model FLOPs per step from XLA's own cost analysis (None if n/a)."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        return float(ca.get("flops", 0.0)) or None
+    except Exception:
+        return None
+
+
+def _peak_flops(device_kind):
+    kind = (device_kind or "").lower()
+    # longest prefix wins ("TPU v5 lite" must not match "TPU v5")
+    for k in sorted(_PEAK, key=len, reverse=True):
+        if kind.startswith(k.lower()):
+            return _PEAK[k]
+    return None
+
+
+def _time_compiled(compiled, args, n_state):
+    """Warmup + timed loop over an AOT-compiled step whose first n_state
+    outputs feed back as its first n_state inputs. Returns seconds."""
+    import jax
+    state = list(args[:n_state])
+    rest = list(args[n_state:])
+    for _ in range(WARMUP):
+        out = compiled(*state, *rest)
+        state = list(out[1:1 + n_state])
+    jax.block_until_ready(out[0])
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = compiled(*state, *rest)
+        state = list(out[1:1 + n_state])
+    jax.block_until_ready(out[0])
+    return time.perf_counter() - t0
+
+
+def bench_resnet(result, errors):
     import numpy as np
     import jax
     import jax.numpy as jnp
-
     import paddle_tpu as pt
     from paddle_tpu.jit.api import functional_call
     from paddle_tpu.tensor import Tensor
 
     pt.seed(0)
     net = pt.vision.models.resnet50(num_classes=10)
-    # bf16 params for MXU throughput; fp32 master weights live in opt state
     pt.amp.decorate(net, level="O2", dtype="bfloat16")
     opt = pt.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
                                 parameters=net.parameters(),
                                 multi_precision=True)
-
     params = {k: p._data for k, p in net.named_parameters()}
     buffers = {k: b._data for k, b in net.named_buffers()}
     opt_state = opt.init_state_tree(params)
@@ -56,67 +145,150 @@ def main():
         return loss, new_params, new_buffers, new_opt
 
     step = jax.jit(train_step, donate_argnums=(0, 1, 2))
-
-    def _flops_per_step(compiled):
-        """Model FLOPs per step from XLA's own cost analysis (None if n/a)."""
-        try:
-            ca = compiled.cost_analysis()
-            if isinstance(ca, (list, tuple)):
-                ca = ca[0]
-            return float(ca.get("flops", 0.0)) or None
-        except Exception:
-            return None
-
-    # bf16 peak FLOP/s per chip by device kind (public spec sheets)
-    _PEAK = {
-        "TPU v4": 275e12, "TPU v5": 459e12, "TPU v5p": 459e12,
-        "TPU v5e": 197e12, "TPU v5 lite": 197e12, "TPU v6e": 918e12,
-        "TPU v6 lite": 918e12, "TPU v3": 123e12, "TPU v2": 45e12,
-    }
-
-    def _peak_flops():
-        kind = jax.local_devices()[0].device_kind.lower()
-        # longest prefix wins ("TPU v5 lite" must not match "TPU v5")
-        for k in sorted(_PEAK, key=len, reverse=True):
-            if kind.startswith(k.lower()):
-                return _PEAK[k]
-        return None
-
     rng = np.random.RandomState(0)
-    x = jnp.asarray(rng.rand(BATCH, 3, 32, 32).astype(np.float32)).astype(
-        jnp.bfloat16)
-    y = jnp.asarray(rng.randint(0, 10, BATCH).astype(np.int32))
-
-    # one AOT compile; the timing loop runs the same executable
-    compiled = step.lower(params, buffers, opt_state, x, y).compile()
-    flops = _flops_per_step(compiled)
-
-    for _ in range(WARMUP):
-        loss, params, buffers, opt_state = compiled(params, buffers,
-                                                    opt_state, x, y)
-    jax.block_until_ready(loss)
+    x = jnp.asarray(rng.rand(RESNET_BATCH, 3, 32, 32)
+                    .astype(np.float32)).astype(jnp.bfloat16)
+    y = jnp.asarray(rng.randint(0, 10, RESNET_BATCH).astype(np.int32))
 
     t0 = time.perf_counter()
-    for _ in range(ITERS):
-        loss, params, buffers, opt_state = compiled(params, buffers,
-                                                    opt_state, x, y)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
+    compiled = step.lower(params, buffers, opt_state, x, y).compile()
+    result["resnet50_compile_sec"] = round(time.perf_counter() - t0, 2)
+    flops = _flops_per_step(compiled)
+    result["resnet50_flops_per_step"] = flops
 
-    ips = BATCH * ITERS / dt
-    peak = _peak_flops()
-    mfu = None
+    dt = _time_compiled(compiled, (params, buffers, opt_state, x, y), 3)
+    ips = RESNET_BATCH * ITERS / dt
+    result["value"] = round(ips, 2)
+    peak = _peak_flops(result.get("device_kind"))
     if flops and peak:
-        mfu = round(flops * (ITERS / dt) / peak, 4)
-    print(json.dumps({
+        result["mfu"] = round(flops * (ITERS / dt) / peak, 4)
+    return ips
+
+
+def bench_gpt(result, errors, batch):
+    """GPT-345M-class train step (bf16, seq 1024) — tokens/sec/chip + MFU."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.jit.api import functional_call
+    from paddle_tpu.tensor import Tensor
+    from paddle_tpu.incubate.models import (GPTForCausalLM,
+                                            GPTPretrainingCriterion,
+                                            gpt_345m)
+
+    pt.seed(0)
+    if SMOKE:
+        from paddle_tpu.incubate.models import gpt_tiny
+        cfg = gpt_tiny(tensor_parallel=False, use_recompute=True)
+    else:
+        cfg = gpt_345m(tensor_parallel=False, use_recompute=True,
+                       max_position_embeddings=GPT_SEQ)
+    model = GPTForCausalLM(cfg)
+    pt.amp.decorate(model, level="O2", dtype="bfloat16")
+    crit = GPTPretrainingCriterion()
+    opt = pt.optimizer.AdamW(learning_rate=1e-4,
+                             parameters=model.parameters(),
+                             multi_precision=True)
+    params = {k: p._data for k, p in model.named_parameters()}
+    buffers = {k: b._data for k, b in model.named_buffers()}
+    opt_state = opt.init_state_tree(params)
+    fwd = getattr(model, "_orig_forward", model.forward)
+    n_params = sum(int(np.prod(p.shape)) for p in params.values())
+    result["gpt345m_n_params"] = n_params
+
+    def train_step(params, buffers, opt_state, ids, labels):
+        def loss_of(p):
+            out, new_buffers = functional_call(
+                model, p, buffers, (Tensor(ids),), training=True,
+                forward_fn=fwd)
+            loss = crit(out, Tensor(labels))
+            return loss._data.astype(jnp.float32), new_buffers
+
+        (loss, new_buffers), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(params)
+        new_params, new_opt = opt.apply_gradients_tree(params, grads,
+                                                       opt_state)
+        return loss, new_params, new_buffers, new_opt
+
+    step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, GPT_SEQ))
+                      .astype(np.int32))
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, GPT_SEQ))
+                         .astype(np.int32))
+
+    t0 = time.perf_counter()
+    compiled = step.lower(params, buffers, opt_state, ids, labels).compile()
+    result["gpt345m_compile_sec"] = round(time.perf_counter() - t0, 2)
+    flops = _flops_per_step(compiled)
+    result["gpt345m_flops_per_step"] = flops
+
+    dt = _time_compiled(compiled, (params, buffers, opt_state, ids, labels),
+                        3)
+    tps = batch * GPT_SEQ * ITERS / dt
+    result["gpt345m_tokens_per_sec"] = round(tps, 1)
+    result["gpt345m_batch"] = batch
+    result["gpt345m_seq"] = GPT_SEQ
+    peak = _peak_flops(result.get("device_kind"))
+    if flops and peak:
+        result["gpt345m_mfu"] = round(flops * (ITERS / dt) / peak, 4)
+    return tps
+
+
+def main():
+    errors: dict = {}
+    result: dict = {
         "metric": "resnet50_cifar10_train_throughput",
-        "value": round(ips, 2),
+        "value": None,
         "unit": "images/sec",
         "vs_baseline": None,
-        "mfu": mfu,
-        "flops_per_step": flops,
-        "device_kind": jax.local_devices()[0].device_kind,
-    }))
+    }
+
+    _honor_cpu_override()
+
+    def probe():
+        # subprocess probe with a hard timeout: a HANGING tunnel (observed
+        # in round 3: jax.devices() blocked >6 min) must not stall the
+        # whole bench past the driver's budget. Only after the probe
+        # succeeds do we initialize jax in-process.
+        import subprocess
+        code = ("import os, jax\n"
+                "if os.environ.get('JAX_PLATFORMS','').strip() == 'cpu':\n"
+                "    jax.config.update('jax_platforms', 'cpu')\n"
+                "print(jax.local_devices()[0].device_kind)\n")
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=60 if SMOKE else 120)
+        if out.returncode != 0:
+            raise RuntimeError(out.stderr.strip().splitlines()[-1][:400]
+                               if out.stderr.strip() else "probe failed")
+        return out.stdout.strip().splitlines()[-1]
+
+    kind = _retry("device_probe", probe, errors, attempts=3)
+    result["device_kind"] = kind
+
+    if kind is not None:
+        _retry("resnet50", lambda: bench_resnet(result, errors), errors)
+
+        def run_gpt():
+            # halve the batch on OOM; anything else retries as-is
+            for b in (16, 8, 4):
+                try:
+                    return bench_gpt(result, errors, b)
+                except Exception as e:
+                    if "RESOURCE_EXHAUSTED" not in str(e) or b == 4:
+                        raise
+            return None
+
+        _retry("gpt345m", run_gpt, errors)
+
+    if errors:
+        result["errors"] = errors
+    ok = (result["value"] is not None or
+          result.get("gpt345m_tokens_per_sec") is not None)
+    print(json.dumps(result))
+    sys.exit(0 if ok else 1)
 
 
 if __name__ == "__main__":

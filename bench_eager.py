@@ -1,0 +1,103 @@
+"""Eager (dygraph) per-op dispatch microbenchmark.
+
+The reference spends an entire codegen subsystem keeping eager dispatch
+cheap (``paddle/fluid/eager/auto_code_generator/``, SURVEY §3.1). Our
+dygraph tape instead pays one ``jax.vjp`` trace per recorded op. This
+script puts a number on that: per-op wall time for
+
+ - ``raw_jax``      : bare jax.numpy dispatch (the floor),
+ - ``tape_off``     : paddle_tpu Tensor op with stop_gradient=True
+                      (funnel overhead, no autograd),
+ - ``tape_on``      : same op recorded on the tape (jax.vjp per op),
+ - ``jit_chain``    : the whole chain as one jitted program (per-op cost
+                      amortized — the designed fast path for hot loops).
+
+Host-side dispatch cost: runs on the CPU backend (never the TPU tunnel).
+Prints ONE json line.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+N_OPS = 200
+REPEATS = 5
+SHAPE = (64, 64)
+
+
+def _bench(fn, block):
+    # one untimed run to pay any first-call setup
+    block(fn())
+    best = float("inf")
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        block(fn())
+        best = min(best, time.perf_counter() - t0)
+    return best / N_OPS
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as pt
+
+    x = jnp.ones(SHAPE, jnp.float32)
+    y = jnp.full(SHAPE, 0.5, jnp.float32)
+
+    def raw_jax():
+        z = x
+        for _ in range(N_OPS):
+            z = z * y + y
+        return z
+
+    tx = pt.to_tensor(x)
+    ty = pt.to_tensor(y)
+    tx.stop_gradient = True
+    ty.stop_gradient = True
+
+    def tape_off():
+        z = tx
+        for _ in range(N_OPS):
+            z = z * ty + ty
+        return z
+
+    gx = pt.to_tensor(x)
+    gy = pt.to_tensor(y)
+    gx.stop_gradient = False
+    gy.stop_gradient = False
+
+    def tape_on():
+        z = gx
+        for _ in range(N_OPS):
+            z = z * gy + gy
+        return z
+
+    jitted = jax.jit(raw_jax)
+    jitted()  # compile outside the timing
+
+    block_jax = lambda z: jax.block_until_ready(z)
+    block_pt = lambda z: jax.block_until_ready(z._data)
+
+    res = {
+        "metric": "eager_dispatch_overhead",
+        "unit": "us/op",
+        "raw_jax": round(_bench(raw_jax, block_jax) * 1e6, 2),
+        "tape_off": round(_bench(tape_off, block_pt) * 1e6, 2),
+        "tape_on": round(_bench(tape_on, block_pt) * 1e6, 2),
+        "jit_chain": round(_bench(jitted, block_jax) * 1e6, 2),
+        "n_ops": N_OPS,
+        "shape": list(SHAPE),
+    }
+    # each op here is mul+add fused in one funnel call; normalize names
+    res["tape_overhead_ratio"] = round(res["tape_on"] / res["raw_jax"], 2) \
+        if res["raw_jax"] else None
+    res["value"] = res["tape_on"]
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()

@@ -108,12 +108,14 @@ class AmpScaler:
 
     # state io
     def state_dict(self):
+        # fields may be lazy device scalars after a compiled train step
         return {
-            "scale": self._scale, "incr_ratio": self._incr_ratio,
+            "scale": float(self._scale), "incr_ratio": self._incr_ratio,
             "decr_ratio": self._decr_ratio,
             "incr_every_n_steps": self._incr_every_n_steps,
             "decr_every_n_nan_or_inf": self._decr_every_n_nan_or_inf,
-            "good_steps": self._good_steps, "bad_steps": self._bad_steps,
+            "good_steps": int(self._good_steps),
+            "bad_steps": int(self._bad_steps),
             "use_dynamic_loss_scaling": self._use_dynamic,
         }
 
@@ -123,7 +125,7 @@ class AmpScaler:
         self._bad_steps = state.get("bad_steps", 0)
 
     def get_loss_scaling(self):
-        return self._scale
+        return float(self._scale)
 
     def set_init_loss_scaling(self, v):
         self._scale = float(v)

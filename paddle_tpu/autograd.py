@@ -104,24 +104,50 @@ class Node:
     inputs:  Tensors the op consumed (strong refs keep the graph alive as
              long as any output lives — same lifetime rule as the
              reference's shared_ptr grad-node chain).
-    vjp_fn:  jax-produced pullback closure over device residuals.
+    vjp_fn:  pullback closure. Funnel-recorded ops build it LAZILY — the
+             forward only runs the bare op and stashes (fn, input arrays);
+             ``jax.vjp`` is traced at backward time via :meth:`pullback`.
+             The reference pays a whole codegen subsystem to keep eager
+             dispatch cheap (``paddle/fluid/eager/auto_code_generator/``);
+             deferring the trace is the tape's analog — forward dispatch
+             drops from one jax trace per op to one jnp call per op
+             (bench_eager.py measures it). PyLayer / functional_call nodes
+             still pass an explicit vjp_fn.
     outputs: weakrefs to produced Tensors (to locate incoming cotangents).
     """
 
-    __slots__ = ("inputs", "vjp_fn", "out_refs", "out_avals", "name",
-                 "_hooks", "__weakref__")
+    __slots__ = ("inputs", "vjp_fn", "fn", "datas", "out_refs", "out_avals",
+                 "name", "_hooks", "_released", "__weakref__")
 
-    def __init__(self, inputs, vjp_fn, outputs, name=""):
+    def __init__(self, inputs, vjp_fn, outputs, name="", fn=None,
+                 datas=None):
         self.inputs = list(inputs)
         self.vjp_fn = vjp_fn
+        self.fn = fn
+        self.datas = datas
         self.out_refs = [weakref.ref(t) for t in outputs]
         self.out_avals = [(t.shape, t._data.dtype) for t in outputs]
         self.name = name
         self._hooks = None
+        self._released = False
+
+    def pullback(self, cot):
+        if self._released:
+            raise RuntimeError(
+                "Trying to backward through the graph a second time; "
+                "set retain_graph=True if you need to.")
+        if self.vjp_fn is None:
+            # deferred trace: input arrays were captured at record time, so
+            # later in-place rebinds of the input Tensors don't corrupt it
+            _, self.vjp_fn = jax.vjp(self.fn, *self.datas)
+        return self.vjp_fn(cot)
 
     def release(self):
         self.vjp_fn = None
+        self.fn = None
+        self.datas = None
         self.inputs = []
+        self._released = True
 
 
 # static-graph recorder hook; installed by paddle_tpu.static.graph so the
@@ -147,13 +173,11 @@ def record(fn, tensors, outputs_wrap, name=""):
         and not in_functional_mode()
         and any(not t.stop_gradient for t in tensors)
     )
-    if needs_grad:
-        raw, vjp_fn = jax.vjp(fn, *datas)
-    else:
-        raw, vjp_fn = fn(*datas), None
+    raw = fn(*datas)
     out_tensors, result = outputs_wrap(raw, needs_grad)
     if needs_grad:
-        node = Node(tensors, vjp_fn, out_tensors, name=name)
+        node = Node(tensors, None, out_tensors, name=name, fn=fn,
+                    datas=datas)
         for i, t in enumerate(out_tensors):
             t._node = node
             t._out_idx = i
@@ -275,11 +299,7 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
                 g = _zero_cot(shape, dt)
             out_cots.append(g)
         cot_in = out_cots[0] if len(out_cots) == 1 else tuple(out_cots)
-        if n.vjp_fn is None:
-            raise RuntimeError(
-                "Trying to backward through the graph a second time; "
-                "set retain_graph=True if you need to.")
-        in_grads = n.vjp_fn(cot_in)
+        in_grads = n.pullback(cot_in)
         if n._hooks:
             in_grads = list(in_grads)
             for i, h in n._hooks:

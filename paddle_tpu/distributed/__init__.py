@@ -25,6 +25,8 @@ from .auto_parallel_api import (  # noqa: F401
     ProcessMesh, shard_tensor, shard_layer, dtensor_from_fn, reshard,
     Shard, Replicate, Partial,
 )
+from . import auto_parallel  # noqa: F401
+from .auto_parallel import Engine, to_static  # noqa: F401
 from . import rpc  # noqa: F401
 from . import utils  # noqa: F401
 from . import checkpoint  # noqa: F401

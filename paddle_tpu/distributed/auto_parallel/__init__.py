@@ -1,0 +1,14 @@
+"""``paddle_tpu.distributed.auto_parallel`` (ref:
+``python/paddle/distributed/auto_parallel/``): annotation API
+(ProcessMesh / shard_tensor / reshard, re-exported from
+``auto_parallel_api``) plus the strategy-driven :class:`Engine`
+(ref ``static/engine.py:55``)."""
+from ..auto_parallel_api import (  # noqa: F401
+    ProcessMesh, Shard, Replicate, Partial, shard_tensor, shard_layer,
+    dtensor_from_fn, reshard,
+)
+from .engine import Engine, to_static  # noqa: F401
+
+__all__ = ["ProcessMesh", "Shard", "Replicate", "Partial", "shard_tensor",
+           "shard_layer", "dtensor_from_fn", "reshard", "Engine",
+           "to_static"]

@@ -257,6 +257,71 @@ class _RowReader:
         return out[0]
 
 
+class _LeadLayoutReader:
+    """Present a saved ``__ppstack__`` leaf under a different leading
+    layout: flat ``[N, ...]`` ↔ interleaved ``[v, N/v, ...]``. Both are
+    row-major views of the natural block order, so only leading-index
+    arithmetic changes."""
+
+    def __init__(self, reader, shape):
+        self.reader = reader
+        self.shape = tuple(shape)
+        self.dtype = reader.dtype
+        # leading-dim count per side: 1 (flat) or 2 (interleaved)
+        self._src_lead = 2 if len(reader.shape) > len(shape) else 1
+        self._tgt_lead = 2 if len(shape) > len(reader.shape) else 1
+
+    def _read_flat_rows(self, lo, hi, rest):
+        r = self.reader
+        if self._src_lead == 1:
+            return r.read((slice(lo, hi),) + rest)
+        R = r.shape[1]
+        parts = []
+        for g in range(lo // R, (hi - 1) // R + 1):
+            r0 = max(lo - g * R, 0)
+            r1 = min(hi - g * R, R)
+            parts.append(r.read((slice(g, g + 1), slice(r0, r1)) + rest)[0])
+        return np.concatenate(parts, 0)
+
+    def read(self, idx):
+        idx = tuple(idx) if idx else ()
+        full = tuple(slice(0, d) for d in self.shape)
+        idx = tuple(s if (s.start is not None or s.stop is not None) else f
+                    for s, f in zip(idx, full)) + full[len(idx):]
+        if self._tgt_lead == 1:
+            lo = idx[0].start or 0
+            hi = idx[0].stop if idx[0].stop is not None else self.shape[0]
+            return self._read_flat_rows(lo, hi, idx[1:])
+        R = self.shape[1]
+        g0 = idx[0].start or 0
+        g1 = idx[0].stop if idx[0].stop is not None else self.shape[0]
+        r0 = idx[1].start or 0
+        r1 = idx[1].stop if idx[1].stop is not None else R
+        rows = [self._read_flat_rows(g * R + r0, g * R + r1, idx[2:])[None]
+                for g in range(g0, g1)]
+        return np.concatenate(rows, 0) if rows else np.empty(
+            (0, r1 - r0) + tuple(
+                (s.stop or d) - (s.start or 0)
+                for s, d in zip(idx[2:], self.shape[2:])), self.dtype)
+
+
+def _adapt_pp_layout(readers, tmpl_flat):
+    """Bridge flat vs interleaved pp-stack layouts (same total blocks,
+    different leading split) between checkpoint and template."""
+    for tk, tmpl in tmpl_flat.items():
+        r = readers.get(tk)
+        if r is None:
+            continue
+        name = _unesc(tk.split(_SEP)[-1])
+        tshape = tuple(getattr(tmpl, "shape", ()) or ())
+        if (name.startswith(_PP) and tshape and
+                tuple(r.shape) != tshape and
+                int(np.prod(r.shape)) == int(np.prod(tshape)) and
+                abs(len(r.shape) - len(tshape)) == 1):
+            readers[tk] = _LeadLayoutReader(r, tshape)
+    return readers
+
+
 def _translate_pp(readers, tmpl_flat):
     """Reconcile __ppstack__ stacked leaves between checkpoint and
     template: synthesize missing readers in either direction (the
@@ -299,8 +364,14 @@ def _translate_pp(readers, tmpl_flat):
                     loc = sname[len(_PP):]
                     order = sibling_blocks(tmpl_flat, parent, loc)
                     if tk in order:
-                        readers[tk] = _RowReader(readers[sk],
-                                                 order.index(tk))
+                        base = readers[sk]
+                        if base.shape[0] != len(order):
+                            # interleaved [v, pp*Lv, ...] saved layout:
+                            # view it flat before slicing block rows
+                            base = _LeadLayoutReader(
+                                base,
+                                (len(order),) + tuple(base.shape[2:]))
+                        readers[tk] = _RowReader(base, order.index(tk))
                     break
     return readers
 
@@ -348,6 +419,7 @@ def load_sharded(path, mesh=None, shardings=None, template=None):
         # and template, then restore only what the template asks for
         readers = _translate_pp(readers, tmpl_flat)
         readers = {k: r for k, r in readers.items() if k in tmpl_flat}
+        readers = _adapt_pp_layout(readers, tmpl_flat)
 
     flat_out = {}
     for leaf, reader in readers.items():

@@ -43,7 +43,8 @@ class DistributedStrategy:
         self.pipeline = False
         self.pipeline_configs = {"accumulate_steps": 1,
                                  "micro_batch_size": 1,
-                                 "schedule_mode": "1F1B"}
+                                 "schedule_mode": "1F1B",
+                                 "virtual_pp_degree": 1}
         # gradient merge
         self.gradient_merge = False
         self.gradient_merge_configs = {"k_steps": 1, "avg": True}

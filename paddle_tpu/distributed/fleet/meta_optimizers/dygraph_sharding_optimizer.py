@@ -33,6 +33,9 @@ class DygraphShardingOptimizer:
         n = (hcg.get_sharding_parallel_world_size()
              if hcg is not None else 1)
         self._rank2params = self._partition_parameters(max(n, 1))
+        # compiled train steps built over this optimizer partition the
+        # state tree over the `sharding` axis (train_step._zero_level)
+        setattr(self._inner_opt, "_group_sharded_level", "os")
 
     def _partition_parameters(self, n):
         """Greedy size-balanced assignment (ref :66)."""

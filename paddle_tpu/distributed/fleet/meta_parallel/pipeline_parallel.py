@@ -23,7 +23,7 @@ import jax.numpy as jnp
 from ....tensor import Tensor
 from ....nn.layer.layers import Layer
 from .parallel_layers.pp_layers import PipelineLayer
-from .pp_spmd import PP_STACK_PREFIX
+from .pp_spmd import PP_STACK_PREFIX, natural_stack
 
 __all__ = ["PipelineParallel"]
 
@@ -42,6 +42,8 @@ class PipelineParallel(Layer):
                 if strategy is not None else {"accumulate_steps": 1})
         self.accumulate_steps = pcfg.get("accumulate_steps", 1)
         self.micro_batch_size = pcfg.get("micro_batch_size", None)
+        # interleaved virtual stages (ref pipeline_parallel.py:807)
+        self.virtual_pp_degree = pcfg.get("virtual_pp_degree", 1)
         self.total_loss = None
         # compiled-pipeline cache (built lazily on a pp>1 mesh)
         self._pp_step = None
@@ -66,12 +68,26 @@ class PipelineParallel(Layer):
         if self._layers._loss_fn is None:
             raise ValueError("train_batch requires PipelineLayer(loss_fn=..)")
         inputs, labels = data
-        if scaler is None and self._pp_mesh_degree() > 1:
-            loss = self._compiled_train_batch(inputs, labels, optimizer)
+        if self._pp_mesh_degree() > 1:
+            # dynamic loss scaling compiles INTO the pipelined step (ref
+            # runs its 1F1B with the scaler too,
+            # ``hybrid_parallel_gradscaler.py``) — no silent degrade to
+            # the sequential schedule for AMP users
+            loss = self._compiled_train_batch(inputs, labels, optimizer,
+                                              scaler)
             if loss is not None:
                 if lr_scheduler is not None:
                     lr_scheduler.step()
                 return loss
+            # sequential fallback (e.g. a ragged last batch) trains the
+            # LAYER tensors: land any compiled state into them first and
+            # drop the compiled cache so the next compiled batch rebuilds
+            # from the (about to be updated) layers instead of resuming a
+            # stale _pp_state
+            self._sync_state_to_layers()
+            self._pp_step = None
+            self._pp_state = None
+            self._pp_optimizer = None
         micro_inputs = self._split_micro(inputs)
         micro_labels = self._split_micro(labels)
         n = len(micro_inputs)
@@ -113,7 +129,7 @@ class PipelineParallel(Layer):
         from ... import mesh as _mesh_mod
         return _mesh_mod.mesh_axis_size("pp")
 
-    def _compiled_train_batch(self, inputs, labels, optimizer):
+    def _compiled_train_batch(self, inputs, labels, optimizer, scaler=None):
         """Build (once) + run the compiled pipelined step. Returns the
         loss Tensor, or None when the stack cannot be pipelined (falls
         back to the sequential schedule — same math, no pipelining)."""
@@ -124,21 +140,34 @@ class PipelineParallel(Layer):
         if batch % n_micro:
             return None  # sequential fallback handles ragged batches
         cached = self._pp_step is not None and \
-            self._pp_optimizer is optimizer
+            self._pp_optimizer is optimizer and \
+            getattr(self, "_pp_scaler", None) is scaler
+        v = max(int(self.virtual_pp_degree), 1)
         if not cached:
             # the compatibility scan is O(params) — only on (re)build
-            if not pipeline_compatible(self._layers,
-                                       self._pp_mesh_degree()):
+            pp = self._pp_mesh_degree()
+            if not pipeline_compatible(self._layers, pp):
                 return None
+            if v > 1 and not pipeline_compatible(self._layers, pp * v):
+                v = 1  # blocks don't divide pp*v: plain (non-interleaved)
             # a prior compiled state must land in the layer tensors
             # BEFORE rebuild re-extracts them (optimizer swap mid-run)
             self._sync_state_to_layers()
             self._pp_step, self._pp_state = build_train_step(
                 self._layers, self._layers._loss_fn, optimizer,
-                pipeline_microbatches=n_micro)
+                pipeline_microbatches=n_micro, scaler=scaler,
+                pipeline_virtual_stages=v)
             self._pp_optimizer = optimizer
+            self._pp_scaler = scaler
         loss, self._pp_state = self._pp_step(self._pp_state, inputs, labels)
         self._pp_dirty = True
+        ss = self._pp_state.get("scaler")
+        if ss is not None and scaler is not None:
+            # mirror device scaler state back (lazy jax scalars, no sync)
+            scaler._scale = ss["scale"]
+            scaler._good_steps = ss["good"]
+            scaler._bad_steps = ss["bad"]
+            scaler._found_inf = ss["found_inf"]
         return Tensor(loss)
 
     def _sync_state_to_layers(self):
@@ -154,6 +183,7 @@ class PipelineParallel(Layer):
             """apply(tensor, array) for the (possibly stacked) entry."""
             if k.startswith(PP_STACK_PREFIX):
                 loc = k[len(PP_STACK_PREFIX):]
+                v = natural_stack(v, len(prefixes))
                 for i, pfx in enumerate(prefixes):
                     apply(named[pfx + loc], v[i])
             elif k in named:

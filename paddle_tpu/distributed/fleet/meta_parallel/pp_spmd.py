@@ -18,11 +18,16 @@ sends/recvs, the WHOLE schedule is one XLA program:
  - backward is ``jax.grad`` through the scan (``ppermute`` transposes to
    the reverse hop — the compiled analog of ``send_backward``/
    ``recv_backward``), with ``jax.checkpoint`` on the stage body so the
-   scan stores only per-tick stage *inputs* (the 1F1B activation-memory
-   discipline) and recomputes inside backward.
+   scan stores only per-tick stage *inputs* and recomputes inside
+   backward. The schedule is therefore GPipe-family (all forwards, one
+   backward sweep) with 1F1B's activation-residency achieved via remat —
+   not a literal host-interleaved 1F1B;
+ - interleaved virtual stages (ref ``:807``) via ``virtual_stages=v``:
+   each chip holds ``v`` non-adjacent block groups and the bubble
+   fraction drops from ``(pp-1)/(M+pp-1)`` to ``(pp-1)/(M·v+pp-1)``.
 
 The bubble executes masked dummy work (standard SPMD pipelining); with
-``M`` micro-batches utilization is ``M / (M + pp - 1)``.
+``M`` micro-batches utilization is ``M·v / (M·v + pp - 1)``.
 """
 from __future__ import annotations
 
@@ -37,7 +42,7 @@ from jax.sharding import PartitionSpec as P
 from ... import mesh as _mesh_mod
 from ....framework import random as _random
 
-__all__ = ["stack_trees", "unstack_tree", "pipeline_spmd",
+__all__ = ["stack_trees", "unstack_tree", "natural_stack", "pipeline_spmd",
            "microbatch_utilization", "pipeline_executor_scope",
            "current_pipeline_executor", "PP_STACK_PREFIX"]
 
@@ -74,41 +79,76 @@ def unstack_tree(tree, n):
     return [jax.tree.map(lambda a, i=i: a[i], tree) for i in range(n)]
 
 
+def natural_stack(arr, n_blocks):
+    """View a ``__ppstack__`` leaf in natural ``[n_blocks, ...]`` block
+    order, flattening the interleaved ``[v, pp*Lv, ...]`` layout when
+    present (both are row-major views of the same order)."""
+    if arr.shape[0] != n_blocks:
+        return arr.reshape((n_blocks,) + tuple(arr.shape[2:]))
+    return arr
+
+
 def microbatch_utilization(num_microbatches, pp):
     """Fraction of non-bubble ticks: M / (M + pp - 1)."""
     return num_microbatches / (num_microbatches + pp - 1)
 
 
 def pipeline_spmd(stage_fn, stage_params, x, num_microbatches, *,
-                  mesh=None, axis_name="pp", remat=True, extras=()):
+                  mesh=None, axis_name="pp", remat=True, extras=(),
+                  virtual_stages=1):
     """Run ``x`` through ``pp`` pipeline stages as one compiled schedule.
 
-    stage_fn(stage_params_local, h, *extras_mb) -> h' where
-    ``stage_params_local`` is ``stage_params`` with the leading (stage)
-    axis reduced to this stage's slice, and ``h``/``h'`` are one
-    micro-batch of activations with identical shape/dtype
-    (homogeneous-stage requirement, same as the reference's
+    stage_fn(stage_params_group, h, *extras_mb) -> h' where
+    ``stage_params_group`` is ``stage_params`` reduced to the blocks this
+    stage applies on this visit (leading axis = blocks-per-call), and
+    ``h``/``h'`` are one micro-batch of activations with identical
+    shape/dtype (homogeneous-stage requirement, same as the reference's
     ``PipelineLayer`` contract).
 
-    stage_params: pytree; every leaf has leading dim divisible by ``pp``
-    (``n_blocks`` total blocks → ``L = n_blocks/pp`` per stage) and is
-    expected to be sharded ``P(axis_name, ...)`` on that axis.
+    stage_params: pytree. With ``virtual_stages == 1`` every leaf is
+    ``[n_blocks, ...]`` sharded ``P(axis_name, ...)``. With
+    ``virtual_stages == v > 1`` every leaf is the row-major reshape
+    ``[v, pp * Lv, ...]`` (``Lv = n_blocks / (pp * v)``) sharded
+    ``P(None, axis_name, ...)`` — chip ``s`` then physically owns virtual
+    stages ``{g * pp + s}``, the Megatron interleaved placement (ref
+    ``pipeline_parallel.py:807 PipelineParallelWithInterleave``), with NO
+    block permutation: the reshape alone interleaves ownership.
+
+    Schedule (one generalized ring): an activation circulates the pp ring
+    ``v`` times; on lap ``g`` chip ``s`` applies virtual stage
+    ``g * pp + s`` (its local group ``g``). A micro-batch enters chip 0
+    whenever the arriving ring slot is free (initial fill, or its previous
+    occupant finished lap ``v``). Total ticks
+    ``T = ((M-1)//pp)·v·pp + (M-1)%pp + v·pp``; for ``pp | M`` that is
+    ``M·v + pp - 1`` ticks of ``Lv`` blocks each — the bubble shrinks by
+    ``v`` versus the non-interleaved schedule (utilization
+    ``M·v / (M·v + pp - 1)``).
+
+    This is a GPipe-family synchronous schedule compiled into ``lax.scan``
+    (all micro-batch forwards, then one backward through the scan with
+    ``jax.checkpoint`` on the stage body — per-tick stage *inputs* are the
+    only stored activations); it is not literal host-scheduled 1F1B, but
+    matches its activation-residency discipline via remat.
 
     x: ``[B, ...]`` activations entering stage 0; ``B`` must be divisible
-    by ``num_microbatches``.
+    by ``num_microbatches``. The micro-batch buffer keeps its ``dp``
+    sharding on the batch dim (pinned below); it is replicated over the
+    ``pp`` axis only.
 
     extras: auxiliary arrays fed to every stage call (e.g. an attention
     mask). An extra whose leading dim equals ``B`` is split into
-    micro-batches and indexed at each stage's own offset ``t - s`` (stage
-    ``s`` processes micro-batch ``t - s`` at tick ``t``); other extras
-    (broadcast masks etc.) pass through whole.
+    micro-batches and indexed at the micro-batch each chip is processing;
+    other extras (broadcast masks etc.) pass through whole.
 
-    Returns ``[B, ...]`` activations leaving the last stage. Differentiable
-    (gradients flow to ``stage_params``, ``x`` and split ``extras``).
+    Returns ``[B, ...]`` activations leaving the last stage (read from the
+    last stage's shard — no all-reduce; XLA broadcasts on consumption).
+    Differentiable (gradients flow to ``stage_params``, ``x`` and split
+    ``extras``).
     """
     mesh = mesh or _mesh_mod.get_mesh()
     pp = mesh.shape.get(axis_name, 1)
     M = int(num_microbatches)
+    v = int(virtual_stages)
     B = x.shape[0]
     if B % M:
         raise ValueError(
@@ -116,6 +156,9 @@ def pipeline_spmd(stage_fn, stage_params, x, num_microbatches, *,
 
     if pp <= 1:
         # no pp axis: plain sequential over the stacked blocks
+        if v > 1:  # flatten [v, Lv*pp, ...] back to natural block order
+            stage_params = jax.tree.map(
+                lambda a: a.reshape((-1,) + a.shape[2:]), stage_params)
         return stage_fn(stage_params, x, *extras)
 
     mb_shape = (M, B // M) + tuple(x.shape[1:])
@@ -125,49 +168,68 @@ def pipeline_spmd(stage_fn, stage_params, x, num_microbatches, *,
         jnp.reshape(e, (M, B // M) + tuple(e.shape[1:])) if sp else e
         for e, sp in zip(extras, split_mask))
     body = jax.checkpoint(stage_fn) if remat else stage_fn
+    T = ((M - 1) // pp) * v * pp + (M - 1) % pp + v * pp
 
     def pipelined(sp, mbs, key, *extras_mb):
-        # sp leaves arrive [n_blocks/pp, ...] (this stage's slice);
-        # mbs [M, mb, ...] replicated over pp.
+        # sp leaves arrive [n_local, ...] (v==1) or [v, Lv, ...] (v>1):
+        # this chip's blocks only. mbs [M, mb, ...] replicated over pp
+        # (dp-sharded on the batch dim via the auto axes).
         idx = lax.axis_index(axis_name)
         # per-stage, per-tick RNG: distinct dropout keys on every stage
         stage_key = jax.random.fold_in(key, idx)
-
         perm = [(i, (i + 1) % pp) for i in range(pp)]
-        T = M + pp - 1
 
         def tick(carry, t):
-            act, out_buf = carry
-            x_in = jnp.where(idx == 0, mbs[jnp.clip(t, 0, M - 1)], act)
-            # stage s processes micro-batch t - s at tick t
-            mb_i = jnp.clip(t - idx, 0, M - 1)
-            e_in = tuple(e[mb_i] if sp else e
-                         for e, sp in zip(extras_mb, split_mask))
+            act, r, m, n_inj, out_buf = carry
+            # the arriving ring slot is free iff its occupant has finished
+            # all v laps (init: r = v marks every slot free)
+            inject = (idx == 0) & (r >= v) & (n_inj < M)
+            x_in = jnp.where(inject, mbs[jnp.clip(n_inj, 0, M - 1)], act)
+            r_cur = jnp.where(inject, 0, r)
+            m_cur = jnp.where(inject, n_inj, m)
+            n_inj = n_inj + inject.astype(jnp.int32)
+
+            mb_i = jnp.clip(m_cur, 0, M - 1)
+            e_in = tuple(e[mb_i] if sp_ else e
+                         for e, sp_ in zip(extras_mb, split_mask))
+            g = jnp.clip(r_cur, 0, v - 1)
+            sp_g = sp if v == 1 else jax.tree.map(lambda a: a[g], sp)
 
             def run(h, key):
                 with _random.trace_key_scope(key):
-                    return body(sp, h, *e_in)
+                    return body(sp_g, h, *e_in)
 
             y = run(x_in, jax.random.fold_in(stage_key, t))
-            out_t = t - (pp - 1)
-            oc = jnp.clip(out_t, 0, M - 1)
-            valid = (out_t >= 0) & (out_t < M) & (idx == pp - 1)
-            upd = jnp.where(valid, y, out_buf[oc])
-            out_buf = lax.dynamic_update_index_in_dim(out_buf, upd, oc, 0)
-            # hand activations to the next stage over ICI
+            # a micro-batch leaves the pipeline at the last chip of its
+            # final lap; bubble slots (r_cur >= v) never write
+            done = (idx == pp - 1) & (r_cur == v - 1)
+            upd = jnp.where(done, y, out_buf[mb_i])
+            out_buf = lax.dynamic_update_index_in_dim(out_buf, upd, mb_i, 0)
+            # laps advance when the activation wraps pp-1 -> 0
+            r_next = jnp.where(idx == pp - 1, r_cur + 1, r_cur)
+            # hand (activation, lap, micro-batch id) to the next stage
             act = lax.ppermute(y, axis_name, perm)
-            return (act, out_buf), None
+            r = lax.ppermute(r_next, axis_name, perm)
+            m = lax.ppermute(m_cur, axis_name, perm)
+            return (act, r, m, n_inj, out_buf), None
 
         init = (jnp.zeros(mb_shape[1:], x.dtype),
+                jnp.int32(v), jnp.int32(0), jnp.int32(0),
                 jnp.zeros(mb_shape, x.dtype))
-        (_act, out_buf), _ = lax.scan(tick, init, jnp.arange(T))
-        # only the last stage holds real outputs; psum over pp replicates
-        # them (everyone else contributes zeros)
-        out = lax.psum(jnp.where(idx == pp - 1, out_buf,
-                                 jnp.zeros_like(out_buf)), axis_name)
-        return out
+        (_, _, _, _, out_buf), _ = lax.scan(tick, init, jnp.arange(T))
+        # out_specs stacks the per-stage buffers over pp; only the last
+        # stage's row is real (cheaper than the old full-output psum:
+        # consumers slice row pp-1 and XLA broadcasts just that)
+        return out_buf[None]
 
     mbs = jnp.reshape(x, mb_shape)
+    # keep the micro-batch buffer dp-sharded inside the shard_map: pin the
+    # batch dim (dim 1 after the reshape) to 'dp' when it divides
+    dp = mesh.shape.get("dp", 1)
+    if dp > 1 and mb_shape[1] % dp == 0:
+        mbs = jax.lax.with_sharding_constraint(
+            mbs, jax.sharding.NamedSharding(
+                mesh, P(None, "dp", *([None] * (len(mb_shape) - 2)))))
     # RNG: when a functional trace scope is active (build_train_step), fold
     # from its traced key; otherwise use a fresh literal key — we must NOT
     # touch the global generator here, or its cached root key would be
@@ -176,9 +238,10 @@ def pipeline_spmd(stage_fn, stage_params, x, num_microbatches, *,
         key = _random.next_key()
     else:
         key = jax.random.key(0)
+    sp_spec = P(axis_name) if v == 1 else P(None, axis_name)
     mapped = jax.shard_map(
         pipelined, mesh=mesh,
-        in_specs=(P(axis_name), P(), P()) + tuple(P() for _ in extras_in),
-        out_specs=P(), axis_names={axis_name}, check_vma=False)
+        in_specs=(sp_spec, P(), P()) + tuple(P() for _ in extras_in),
+        out_specs=P(axis_name), axis_names={axis_name}, check_vma=False)
     out = mapped(stage_params, mbs, key, *extras_in)
-    return jnp.reshape(out, x.shape)
+    return jnp.reshape(out[pp - 1], x.shape)

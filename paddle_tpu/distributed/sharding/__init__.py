@@ -28,10 +28,12 @@ def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
     if level == "p_g_os":
         annotate_fsdp_specs(model, axis="sharding")
         place_parameters_on_mesh(model)
-    # os / os_g: optimizer state + grad sharding is inherited from the
-    # parameter specs at compile time; grads/state of replicated params
-    # stay replicated (stage 1/2 memory win applies on the compiled path
-    # where XLA shards the update computation over the sharding axis).
+    # os / os_g: build_train_step reads this level and partitions the
+    # optimizer slot/master trees over the `sharding` mesh axis
+    # independently of the (replicated) param specs — per-device state
+    # bytes shrink ~1/N (train_step.zero_spec). os_g additionally
+    # constrains grads to the same partition, turning the dp grad
+    # all-reduce into reduce-scatter (stage-2 semantics).
     setattr(optimizer, "_group_sharded_level", level)
     return model, optimizer, scaler
 

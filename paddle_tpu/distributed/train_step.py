@@ -63,6 +63,36 @@ def param_shardings(layer: Layer, mesh=None):
             for k, p in layer.named_parameters()}
 
 
+def zero_spec(spec, shape, mesh, axis="sharding"):
+    """ZeRO partition spec for an optimizer-state leaf: the param's spec
+    with the ``sharding`` axis additionally placed on the largest dim it
+    divides (ref ``dygraph_sharding_optimizer.py:29`` partitions the param
+    LIST per rank; sharding each state tensor over the same mesh axis is
+    the SPMD equivalent — per-device state bytes shrink ~1/N and XLA runs
+    the update shard-local)."""
+    n = mesh.shape.get(axis, 1)
+    if n <= 1:
+        return spec
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+
+    def _axes(e):
+        return (e,) if isinstance(e, str) else tuple(e or ())
+
+    if any(axis in _axes(e) for e in entries):
+        return spec  # param already fsdp-sharded; state inherits it
+    for d in sorted(range(len(shape)), key=lambda d: -shape[d]):
+        if entries[d] is None and shape[d] % n == 0:
+            entries[d] = axis
+            return P(*entries)
+    return spec  # no divisible dim: this leaf stays replicated
+
+
+def _zero_level(optimizer):
+    """'os' | 'os_g' | None — set by group_sharded_parallel/strategy."""
+    lvl = getattr(optimizer, "_group_sharded_level", None)
+    return lvl if lvl in ("os", "os_g") else None
+
+
 def shard_model_state(layer: Layer, mesh=None):
     """Extract + place (params, buffers) arrays onto the mesh."""
     mesh = mesh or _mesh_mod.get_mesh()
@@ -77,8 +107,89 @@ def shard_model_state(layer: Layer, mesh=None):
     return params, buffers, shardings
 
 
+def _place_opt_state(optimizer, params, shardings, mesh, zero):
+    """Init + mesh-place the optimizer state tree. Slots/master inherit
+    each param's sharding; with a ZeRO level they are additionally
+    partitioned over the ``sharding`` axis (:func:`zero_spec`)."""
+    opt_state = optimizer.init_state_tree(params)
+    if zero:
+        opt_sh = {k: NamedSharding(mesh, zero_spec(
+            shardings[k].spec, params[k].shape, mesh))
+            for k in params}
+    else:
+        opt_sh = dict(shardings)
+    repl = NamedSharding(mesh, P())
+    placed = {
+        "slots": {s: {k: jax.device_put(v, opt_sh[k])
+                      for k, v in sv.items()}
+                  for s, sv in opt_state["slots"].items()},
+        "master": {k: jax.device_put(v, opt_sh[k])
+                   for k, v in opt_state["master"].items()},
+        "step": jax.device_put(opt_state["step"], repl),
+    }
+    return placed, opt_sh
+
+
+def _constrain_opt_state(opt_state, opt_sh):
+    """Pin updated slot/master leaves to their shardings inside the trace
+    (donation aliases buffers but does not force output shardings)."""
+    return {
+        "slots": {s: {k: jax.lax.with_sharding_constraint(v, opt_sh[k])
+                      for k, v in sv.items()}
+                  for s, sv in opt_state["slots"].items()},
+        "master": {k: jax.lax.with_sharding_constraint(v, opt_sh[k])
+                   for k, v in opt_state["master"].items()},
+        "step": opt_state["step"],
+    }
+
+
+def _scaler_init_state(scaler):
+    """Loss-scaling state as device scalars so the whole dynamic-scaling
+    protocol (ref ``amp/grad_scaler.py:576`` + the pipeline's
+    ``hybrid_parallel_gradscaler.py``) compiles into the train step: scale
+    the loss, unscale grads, all-reduce-free finite check, skip the update
+    on overflow, grow/shrink the scale — zero host round-trips."""
+    return {"scale": jnp.float32(scaler.get_loss_scaling()),
+            "good": jnp.int32(scaler._good_steps),
+            "bad": jnp.int32(scaler._bad_steps),
+            "found_inf": jnp.bool_(False)}
+
+
+def _scaler_finish(scaler, grads, scale, old_state):
+    """Unscale grads, detect non-finite, advance the scaler counters.
+    Returns (unscaled grads, select(new, old) choosing old on overflow,
+    new scaler state)."""
+    inv = 1.0 / scale
+    grads = {k: (g.astype(jnp.float32) * inv).astype(g.dtype)
+             for k, g in grads.items()}
+    finite = jnp.array(True)
+    for g in grads.values():
+        finite &= jnp.all(jnp.isfinite(g.astype(jnp.float32)))
+
+    def select(new, old):
+        return jax.tree.map(lambda a, b: jnp.where(finite, a, b), new, old)
+
+    good = jnp.where(finite, old_state["good"] + 1, 0)
+    bad = jnp.where(finite, 0, old_state["bad"] + 1)
+    if scaler.is_use_dynamic_loss_scaling():
+        grow = finite & (good >= scaler._incr_every_n_steps)
+        shrink = (~finite) & (bad >= scaler._decr_every_n_nan_or_inf)
+        new_scale = jnp.where(
+            grow, scale * scaler._incr_ratio,
+            jnp.where(shrink, jnp.maximum(scale * scaler._decr_ratio, 1.0),
+                      scale))
+        good = jnp.where(grow, 0, good)
+        bad = jnp.where(shrink, 0, bad)
+    else:
+        new_scale = scale
+    sstate = {"scale": new_scale, "good": good, "bad": bad,
+              "found_inf": ~finite}
+    return grads, select, sstate
+
+
 def build_train_step(model: Layer, loss_fn, optimizer, mesh=None,
-                     donate=True, pipeline_microbatches=None):
+                     donate=True, pipeline_microbatches=None, scaler=None,
+                     pipeline_virtual_stages=1):
     """Returns (step_fn, state) where
     ``state = {"params", "buffers", "opt"}`` is mesh-placed and
     ``step_fn(state, *batch) -> (loss, state)`` is one compiled program.
@@ -92,30 +203,41 @@ def build_train_step(model: Layer, loss_fn, optimizer, mesh=None,
     ``__ppstack__.*`` leaves sharded over ``pp`` and executed as a compiled
     1F1B schedule (``meta_parallel.pp_spmd``) — each chip stores only its
     stage's blocks. ``pipeline_microbatches`` defaults to the pp degree.
+
+    ``scaler``: an ``amp.GradScaler`` — dynamic loss scaling runs INSIDE
+    the compiled step (state gains a ``"scaler"`` entry; the update is
+    skipped on overflow with no host round-trip).
+
+    ``pipeline_virtual_stages``: interleaved-pipeline virtual stage count
+    ``v`` (ref ``pipeline_parallel.py:807``): each chip holds ``v``
+    non-adjacent block groups, shrinking the bubble by ``v``.
     """
     mesh = mesh or _mesh_mod.get_mesh()
+    if scaler is not None and not scaler.is_enable():
+        scaler = None
     pp = mesh.shape.get("pp", 1)
     if pp > 1 and pipeline_compatible(model, pp):
+        # an explicit-but-indivisible virtual-stage request must fail
+        # loudly, not silently build a NON-pipelined (fully replicated)
+        # step on a pp mesh
+        if pipeline_virtual_stages > 1 and not pipeline_compatible(
+                model, pp * pipeline_virtual_stages):
+            raise ValueError(
+                f"pipeline blocks not divisible by pp*v = "
+                f"{pp}*{pipeline_virtual_stages}; drop "
+                f"pipeline_virtual_stages or change the block count")
         return _build_pipelined_train_step(
             model, loss_fn, optimizer, mesh, donate,
-            pipeline_microbatches or pp)
+            pipeline_microbatches or pp, scaler,
+            pipeline_virtual_stages)
     params, buffers, shardings = shard_model_state(model, mesh)
-    opt_state = optimizer.init_state_tree(params)
-    # optimizer slots/master inherit each param's sharding (the ZeRO win:
-    # an fsdp-annotated param stores adam moments sharded the same way)
-    slots_sh = {s: {k: shardings[k] for k in opt_state["slots"][s]}
-                for s in opt_state["slots"]}
-    master_sh = {k: shardings[k] for k in opt_state["master"]}
-    repl = NamedSharding(mesh, P())
-    opt_state = {
-        "slots": {s: {k: jax.device_put(v, slots_sh[s][k])
-                      for k, v in sv.items()}
-                  for s, sv in opt_state["slots"].items()},
-        "master": {k: jax.device_put(v, master_sh[k])
-                   for k, v in opt_state["master"].items()},
-        "step": jax.device_put(opt_state["step"], repl),
-    }
+    zero = _zero_level(optimizer)
+    opt_state, opt_sh = _place_opt_state(optimizer, params, shardings,
+                                         mesh, zero)
     state = {"params": params, "buffers": buffers, "opt": opt_state}
+    if scaler is not None:
+        repl = NamedSharding(mesh, P())
+        state["scaler"] = jax.device_put(_scaler_init_state(scaler), repl)
 
     sep = mesh.shape.get("sep", 1)
     data_spec = P("dp", "sep") if sep > 1 else P("dp")
@@ -123,20 +245,40 @@ def build_train_step(model: Layer, loss_fn, optimizer, mesh=None,
     fwd = getattr(model, "_orig_forward", model.forward)
 
     def step(state, lr, x, *labels):
+        scale = (state["scaler"]["scale"] if scaler is not None
+                 else jnp.float32(1.0))
+
         def loss_of(p):
             out, new_buffers = functional_call(
                 model, p, state["buffers"], (Tensor(x),), training=True,
                 forward_fn=fwd)
             loss = loss_fn(out, *[Tensor(l) for l in labels])
             loss_arr = loss._data if isinstance(loss, Tensor) else loss
-            return loss_arr.astype(jnp.float32), new_buffers
+            loss_arr = loss_arr.astype(jnp.float32)
+            return loss_arr * scale, (loss_arr, new_buffers)
 
-        (loss, new_buffers), grads = jax.value_and_grad(
+        (_, (loss, new_buffers)), grads = jax.value_and_grad(
             loss_of, has_aux=True)(state["params"])
+        if zero == "os_g":
+            # ZeRO-2: constrain grads to the optimizer-state partition —
+            # GSPMD turns the dp grad all-reduce into reduce-scatter and
+            # the update runs shard-local (params re-gather on output)
+            grads = {k: jax.lax.with_sharding_constraint(g, opt_sh[k])
+                     for k, g in grads.items()}
+        if scaler is not None:
+            grads, select, sstate = _scaler_finish(
+                scaler, grads, scale, state["scaler"])
         new_params, new_opt = optimizer.apply_gradients_tree(
             state["params"], grads, state["opt"], lr=lr)
-        return loss, {"params": new_params, "buffers": new_buffers,
-                      "opt": new_opt}
+        new_opt = _constrain_opt_state(new_opt, opt_sh)
+        out_state = {"params": new_params, "buffers": new_buffers,
+                     "opt": new_opt}
+        if scaler is not None:
+            out_state["params"] = select(out_state["params"],
+                                         state["params"])
+            out_state["opt"] = select(out_state["opt"], state["opt"])
+            out_state["scaler"] = sstate
+        return loss, out_state
 
     def rng_step(state, key, lr, x, *labels):
         with _random.trace_key_scope(key):
@@ -191,40 +333,50 @@ def pipeline_compatible(model, pp):
 
 
 def _build_pipelined_train_step(model, loss_fn, optimizer, mesh, donate,
-                                num_microbatches):
+                                num_microbatches, scaler=None,
+                                virtual_stages=1):
     """Pipeline-parallel variant of :func:`build_train_step`.
 
     State layout: the homogeneous blocks' parameters are stacked into
-    ``__ppstack__.<local>`` leaves of shape ``[n_blocks, ...]`` sharded
-    ``P("pp", *block_spec)`` — stage ``s`` physically stores blocks
-    ``[s*L, (s+1)*L)`` only. The forward routes the model's block loop
-    through ``pp_spmd.pipeline_spmd`` via the pipeline-executor scope.
+    ``__ppstack__.<local>`` leaves — shape ``[n_blocks, ...]`` sharded
+    ``P("pp", *block_spec)`` (stage ``s`` physically stores blocks
+    ``[s*L, (s+1)*L)`` only), or, with ``virtual_stages = v > 1``, the
+    row-major reshape ``[v, pp*Lv, ...]`` sharded ``P(None, "pp", ...)``
+    so chip ``s`` owns the interleaved virtual stages ``{g*pp + s}``. The
+    forward routes the model's block loop through
+    ``pp_spmd.pipeline_spmd`` via the pipeline-executor scope.
     """
     from .fleet.meta_parallel.pp_spmd import (
         PP_STACK_PREFIX, pipeline_spmd, pipeline_executor_scope)
 
     pp = mesh.shape["pp"]
+    vstages = int(virtual_stages)
     prefixes, block_layer = model.pipeline_blocks()
     n_blocks = len(prefixes)
-    if n_blocks % pp:
+    if n_blocks % (pp * vstages):
         raise ValueError(
-            f"{n_blocks} pipeline blocks not divisible by pp={pp}")
+            f"{n_blocks} pipeline blocks not divisible by pp*v={pp * vstages}")
     if dict(block_layer.named_buffers()):
         raise ValueError("pipelined blocks must be buffer-free")
-    n_local = n_blocks // pp
 
     named = dict(model.named_parameters())
     block_locals = [k[len(prefixes[0]):] for k in named
                     if k.startswith(prefixes[0])]
-    # stack [n_blocks, ...] per block-local param, shard over pp
+    # stack [n_blocks, ...] per block-local param, shard over pp;
+    # interleaved: reshape to [v, pp*Lv, ...] (natural order preserved)
     stacked, stacked_sh = {}, {}
     for loc in block_locals:
         p0 = named[prefixes[0] + loc]
         spec = _spec_for(p0, mesh)
-        stacked[PP_STACK_PREFIX + loc] = jnp.stack(
+        arr = jnp.stack(
             [jnp.copy(named[pfx + loc]._data) for pfx in prefixes])
-        stacked_sh[PP_STACK_PREFIX + loc] = NamedSharding(
-            mesh, P(*(("pp",) + tuple(spec))))
+        if vstages > 1:
+            arr = arr.reshape((vstages, n_blocks // vstages) + arr.shape[1:])
+            sh = P(*((None, "pp") + tuple(spec)))
+        else:
+            sh = P(*(("pp",) + tuple(spec)))
+        stacked[PP_STACK_PREFIX + loc] = arr
+        stacked_sh[PP_STACK_PREFIX + loc] = NamedSharding(mesh, sh)
     block_names = {pfx + loc for pfx in prefixes for loc in block_locals}
 
     rest_sh = {k: NamedSharding(mesh, _spec_for(p, mesh))
@@ -239,16 +391,12 @@ def _build_pipelined_train_step(model, loss_fn, optimizer, mesh, donate,
     buffers = {k: jax.device_put(jnp.copy(b._data), repl)
                for k, b in model.named_buffers()}
 
-    opt_state = optimizer.init_state_tree(params)
-    opt_state = {
-        "slots": {s: {k: jax.device_put(v, shardings[k])
-                      for k, v in sv.items()}
-                  for s, sv in opt_state["slots"].items()},
-        "master": {k: jax.device_put(v, shardings[k])
-                   for k, v in opt_state["master"].items()},
-        "step": jax.device_put(opt_state["step"], repl),
-    }
+    zero = _zero_level(optimizer)
+    opt_state, opt_sh = _place_opt_state(optimizer, params, shardings,
+                                         mesh, zero)
     state = {"params": params, "buffers": buffers, "opt": opt_state}
+    if scaler is not None:
+        state["scaler"] = jax.device_put(_scaler_init_state(scaler), repl)
 
     sep = mesh.shape.get("sep", 1)
     data_spec = P("dp", "sep") if sep > 1 else P("dp")
@@ -256,6 +404,9 @@ def _build_pipelined_train_step(model, loss_fn, optimizer, mesh, donate,
     fwd = getattr(model, "_orig_forward", model.forward)
 
     def step(state, lr, x, *labels):
+        scale = (state["scaler"]["scale"] if scaler is not None
+                 else jnp.float32(1.0))
+
         def loss_of(p):
             sp = {k[len(PP_STACK_PREFIX):]: v for k, v in p.items()
                   if k.startswith(PP_STACK_PREFIX)}
@@ -274,14 +425,18 @@ def _build_pipelined_train_step(model, loss_fn, optimizer, mesh, donate,
                     it = iter(earrs)
                     eargs = tuple(None if none else Tensor(next(it))
                                   for none in e_none)
-                    for j in range(n_local):
+                    # blocks-per-call = the received leaves' leading dim
+                    # (n_blocks/pp plain; n_blocks/(pp*v) interleaved)
+                    n_rows = next(iter(sp_local.values())).shape[0]
+                    for j in range(n_rows):
                         pj = {kk: vv[j] for kk, vv in sp_local.items()}
                         out, _ = functional_call(block_layer, pj, {},
                                                  (t,) + eargs)
                         t = out
                     return t._data
                 y = pipeline_spmd(stage_fn, sp, h._data, num_microbatches,
-                                  mesh=mesh, extras=e_arrs)
+                                  mesh=mesh, extras=e_arrs,
+                                  virtual_stages=vstages)
                 return Tensor(y)
 
             with pipeline_executor_scope(executor):
@@ -290,14 +445,28 @@ def _build_pipelined_train_step(model, loss_fn, optimizer, mesh, donate,
                     training=True, forward_fn=fwd)
             loss = loss_fn(out, *[Tensor(l) for l in labels])
             loss_arr = loss._data if isinstance(loss, Tensor) else loss
-            return loss_arr.astype(jnp.float32), new_buffers
+            loss_arr = loss_arr.astype(jnp.float32)
+            return loss_arr * scale, (loss_arr, new_buffers)
 
-        (loss, new_buffers), grads = jax.value_and_grad(
+        (_, (loss, new_buffers)), grads = jax.value_and_grad(
             loss_of, has_aux=True)(state["params"])
+        if zero == "os_g":
+            grads = {k: jax.lax.with_sharding_constraint(g, opt_sh[k])
+                     for k, g in grads.items()}
+        if scaler is not None:
+            grads, select, sstate = _scaler_finish(
+                scaler, grads, scale, state["scaler"])
         new_params, new_opt = optimizer.apply_gradients_tree(
             state["params"], grads, state["opt"], lr=lr)
-        return loss, {"params": new_params, "buffers": new_buffers,
-                      "opt": new_opt}
+        new_opt = _constrain_opt_state(new_opt, opt_sh)
+        out_state = {"params": new_params, "buffers": new_buffers,
+                     "opt": new_opt}
+        if scaler is not None:
+            out_state["params"] = select(out_state["params"],
+                                         state["params"])
+            out_state["opt"] = select(out_state["opt"], state["opt"])
+            out_state["scaler"] = sstate
+        return loss, out_state
 
     def rng_step(state, key, lr, x, *labels):
         with _random.trace_key_scope(key):

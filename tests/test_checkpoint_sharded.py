@@ -269,3 +269,85 @@ def test_pipeline_train_batch_ragged_batch_falls_back():
     loss = pp.train_batch((Tensor(x), Tensor(y)), opt)
     assert np.isfinite(float(loss))
     assert pp._pp_step is None  # compiled path not taken
+
+
+def test_reshard_flat_to_interleaved_pp_layout(tmp_path):
+    """A checkpoint written with the flat pp layout restores into an
+    INTERLEAVED (virtual-stage [v, pp*Lv, ...]) template and vice versa —
+    both are row-major views of the natural block order
+    (checkpoint._LeadLayoutReader)."""
+    pt.seed(0)
+    cfg = _cfg()
+    cfg.num_layers = 4
+    cfg.tensor_parallel = False
+    model = GPTForCausalLM(cfg)
+    crit = GPTPretrainingCriterion()
+    rng = np.random.RandomState(3)
+    ids = rng.randint(0, 1024, (8, 16)).astype(np.int32)
+    lab = rng.randint(0, 1024, (8, 16)).astype(np.int32)
+
+    # write with flat pp2 layout
+    dist.init_mesh({"dp": 4, "pp": 2})
+    opt = pt.optimizer.AdamW(learning_rate=1e-3,
+                             parameters=model.parameters())
+    step, state = build_train_step(model, crit, opt, donate=False)
+    _, state = step(state, ids, lab)
+    ckpt.save_state(state, str(tmp_path / "flat"))
+    loss_cont, _ = step(state, ids, lab)
+
+    # restore into interleaved pp2 x v2 template
+    opt2 = pt.optimizer.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+    step2, state2 = build_train_step(model, crit, opt2, donate=False,
+                                     pipeline_virtual_stages=2)
+    restored = ckpt.load_state(str(tmp_path / "flat"), state2)
+    for k, a in restored["params"].items():
+        if k.startswith("__ppstack__."):
+            assert a.shape[0] == 2  # interleaved leading layout
+    loss_resumed, _ = step2(restored, ids, lab)
+    np.testing.assert_allclose(float(loss_cont), float(loss_resumed),
+                               rtol=1e-5, atol=1e-5)
+
+    # and the reverse: interleaved checkpoint -> flat template
+    ckpt.save_state(restored, str(tmp_path / "ileave"))
+    opt3 = pt.optimizer.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+    step3, state3 = build_train_step(model, crit, opt3, donate=False)
+    restored3 = ckpt.load_state(str(tmp_path / "ileave"), state3)
+    loss3, _ = step3(restored3, ids, lab)
+    np.testing.assert_allclose(float(loss_cont), float(loss3),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_interleaved_checkpoint_to_unstacked_template(tmp_path):
+    """An interleaved ([v, pp*Lv, ...]) pipelined checkpoint restores into
+    a NON-pipelined (per-block param names) template — the _RowReader
+    direction must view the saved leaf flat first."""
+    pt.seed(0)
+    cfg = _cfg()
+    cfg.num_layers = 4
+    cfg.tensor_parallel = False
+    model = GPTForCausalLM(cfg)
+    crit = GPTPretrainingCriterion()
+    rng = np.random.RandomState(5)
+    ids = rng.randint(0, 1024, (8, 16)).astype(np.int32)
+    lab = rng.randint(0, 1024, (8, 16)).astype(np.int32)
+
+    dist.init_mesh({"dp": 4, "pp": 2})
+    opt = pt.optimizer.AdamW(learning_rate=1e-3,
+                             parameters=model.parameters())
+    step, state = build_train_step(model, crit, opt, donate=False,
+                                   pipeline_virtual_stages=2)
+    _, state = step(state, ids, lab)
+    ckpt.save_state(state, str(tmp_path / "il"))
+    loss_cont, _ = step(state, ids, lab)
+
+    dist.init_mesh({"dp": 1})
+    opt2 = pt.optimizer.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+    step2, state2 = build_train_step(model, crit, opt2, donate=False)
+    assert not any(k.startswith("__ppstack__.") for k in state2["params"])
+    restored = ckpt.load_state(str(tmp_path / "il"), state2)
+    loss1, _ = step2(restored, ids, lab)
+    np.testing.assert_allclose(float(loss_cont), float(loss1),
+                               rtol=1e-5, atol=1e-5)

@@ -273,3 +273,83 @@ def test_gpt_pipeline_with_attention_mask_extras():
     del model._orig_forward
     np.testing.assert_allclose(float(loss_ref), float(loss_pp),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_gpt_interleaved_pipeline_pp2_v2_matches_single_device():
+    """Interleaved virtual stages (ref pipeline_parallel.py:807): pp2 with
+    v=2 (4 blocks -> 4 virtual stages of 1 block, chip s owns vstages
+    {s, s+2}) matches the single-device step over 3 steps."""
+    pt.seed(0)
+    cfg = _tiny(tp=False)
+    cfg.num_layers = 4
+    model = GPTForCausalLM(cfg)
+    crit = GPTPretrainingCriterion()
+    rng = np.random.RandomState(17)
+    ids = rng.randint(0, 1024, (8, 16)).astype(np.int32)
+    labels = rng.randint(0, 1024, (8, 16)).astype(np.int32)
+
+    def loss_fn(logits, lab):
+        return crit(logits, lab)
+
+    dist.init_mesh({"dp": 1})
+    opt1 = pt.optimizer.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+    step1, state1 = build_train_step(model, loss_fn, opt1)
+    ref = []
+    for _ in range(3):
+        loss, state1 = step1(state1, ids, labels)
+        ref.append(float(loss))
+
+    dist.init_mesh({"dp": 4, "pp": 2})
+    opt2 = pt.optimizer.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+    step2, state2 = build_train_step(model, loss_fn, opt2,
+                                     pipeline_microbatches=4,
+                                     pipeline_virtual_stages=2)
+    # interleaved layout: [v, pp*Lv, ...] sharded P(None, 'pp', ...)
+    stacked = {k: a for k, a in state2["params"].items()
+               if k.startswith("__ppstack__.")}
+    assert stacked
+    for k, a in stacked.items():
+        assert a.shape[0] == 2, (k, a.shape)
+        spec = a.sharding.spec
+        assert spec[0] is None and spec[1] == "pp", (k, spec)
+        # each chip stores 1/pp of the stacked blocks (the memory win
+        # survives interleaving)
+        assert a.addressable_shards[0].data.size == a.size // 2
+    got = []
+    for _ in range(3):
+        loss, state2 = step2(state2, ids, labels)
+        got.append(float(loss))
+    np.testing.assert_allclose(ref, got, rtol=2e-4, atol=2e-4)
+
+
+def test_gpt_interleaved_pipeline_pp4_v2():
+    """pp4 × v=2 over 8 blocks (Lv=1), M=8 microbatches == pp1 oracle."""
+    pt.seed(0)
+    cfg = _tiny(tp=False)
+    cfg.num_layers = 8
+    model = GPTForCausalLM(cfg)
+    crit = GPTPretrainingCriterion()
+    rng = np.random.RandomState(19)
+    ids = rng.randint(0, 1024, (8, 16)).astype(np.int32)
+    labels = rng.randint(0, 1024, (8, 16)).astype(np.int32)
+
+    def loss_fn(logits, lab):
+        return crit(logits, lab)
+
+    dist.init_mesh({"dp": 1})
+    opt1 = pt.optimizer.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+    step1, state1 = build_train_step(model, loss_fn, opt1)
+    loss_ref, _ = step1(state1, ids, labels)
+
+    dist.init_mesh({"dp": 2, "pp": 4})
+    opt2 = pt.optimizer.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+    step2, state2 = build_train_step(model, loss_fn, opt2,
+                                     pipeline_microbatches=8,
+                                     pipeline_virtual_stages=2)
+    loss_pp, _ = step2(state2, ids, labels)
+    np.testing.assert_allclose(float(loss_ref), float(loss_pp),
+                               rtol=2e-4, atol=2e-4)

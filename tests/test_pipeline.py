@@ -139,3 +139,72 @@ def test_lr_scheduler_threaded_into_compiled_step():
     _, state = step(state, x, y)
     w2 = np.asarray(state["params"]["weight"]).copy()
     np.testing.assert_allclose(w1, w2)     # zero LR => no movement
+
+
+def test_scaler_through_compiled_pipeline_parity():
+    """AMP scaler + pp2 must take the COMPILED path (ref runs 1F1B with
+    its scaler, ``hybrid_parallel_gradscaler.py``) and match the eager
+    sequential schedule's losses."""
+    rng = np.random.RandomState(3)
+    x = rng.randn(8, 16).astype(np.float32)
+    y = rng.randint(0, 10, 8).astype(np.int32)
+
+    dist.init_mesh({"dp": 8})
+    pt.seed(0)
+    pl1 = _make()
+    pp1 = PipelineParallel(pl1)
+    pp1.accumulate_steps = 4
+    opt1 = pt.optimizer.SGD(learning_rate=0.1, parameters=pl1.parameters())
+    sc1 = pt.amp.GradScaler(init_loss_scaling=256.0)
+    ref = [float(pp1.train_batch((Tensor(x), Tensor(y)), opt1, scaler=sc1))
+           for _ in range(3)]
+
+    dist.init_mesh({"dp": 4, "pp": 2})
+    pt.seed(0)
+    pl2 = _make()
+    pp2 = PipelineParallel(pl2)
+    pp2.accumulate_steps = 4
+    opt2 = pt.optimizer.SGD(learning_rate=0.1, parameters=pl2.parameters())
+    sc2 = pt.amp.GradScaler(init_loss_scaling=256.0)
+    got = [float(pp2.train_batch((Tensor(x), Tensor(y)), opt2, scaler=sc2))
+           for _ in range(3)]
+    assert getattr(pp2, "_pp_step", None) is not None, \
+        "scaler forced the sequential fallback (silent degrade)"
+    assert "scaler" in pp2._pp_state
+    np.testing.assert_allclose(ref, got, rtol=2e-4, atol=2e-4)
+    assert float(sc2._scale) == 256.0  # finite grads: scale unchanged
+
+
+def test_compiled_scaler_skips_update_on_overflow():
+    """A non-finite batch must leave params untouched and shrink the
+    scale; the next finite batch trains normally."""
+    from paddle_tpu.distributed.train_step import build_train_step
+
+    dist.init_mesh({"dp": 8})
+    pt.seed(0)
+    model = pt.nn.Linear(8, 8)
+    opt = pt.optimizer.SGD(learning_rate=0.1,
+                           parameters=model.parameters())
+    scaler = pt.amp.GradScaler(init_loss_scaling=64.0)
+
+    def loss_fn(out, y):
+        return ((out - y) ** 2).mean()
+
+    step, state = build_train_step(model, loss_fn, opt, scaler=scaler)
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 8).astype(np.float32)
+    y = rng.randn(8, 8).astype(np.float32)
+    bad_x = x.copy()
+    bad_x[0, 0] = np.inf
+
+    w0 = np.asarray(state["params"]["weight"]).copy()
+    _, state = step(state, bad_x, y)
+    w1 = np.asarray(state["params"]["weight"]).copy()
+    np.testing.assert_allclose(w0, w1)        # overflow: update skipped
+    assert bool(state["scaler"]["found_inf"])
+    assert float(state["scaler"]["scale"]) == 32.0  # 64 * decr_ratio 0.5
+
+    _, state = step(state, x, y)
+    w2 = np.asarray(state["params"]["weight"]).copy()
+    assert not np.allclose(w1, w2)            # finite: trained
+    assert not bool(state["scaler"]["found_inf"])

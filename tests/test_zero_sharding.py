@@ -1,0 +1,138 @@
+"""ZeRO stage-1/2 optimizer-state sharding (ref:
+``python/paddle/distributed/fleet/meta_parallel/sharding/
+group_sharded_stage2.py``, ``group_sharded_optimizer_stage2.py``,
+``dygraph_optimizer/dygraph_sharding_optimizer.py:29``).
+
+Asserts the real memory win: with level "os"/"os_g" the optimizer
+slot/master trees are partitioned over the `sharding` mesh axis — each
+device stores ~1/N of the state bytes — and training losses match the
+unsharded baseline exactly (same math, different placement)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.sharding import group_sharded_parallel
+from paddle_tpu.distributed.train_step import build_train_step, zero_spec
+from jax.sharding import PartitionSpec as P
+
+
+def _mlp():
+    pt.seed(7)
+    return nn.Sequential(
+        nn.Linear(64, 256), nn.ReLU(),
+        nn.Linear(256, 256), nn.ReLU(),
+        nn.Linear(256, 8))
+
+
+def _loss_fn(out, y):
+    return pt.nn.functional.cross_entropy(out, y)
+
+
+def _batch():
+    rng = np.random.RandomState(0)
+    x = rng.rand(16, 64).astype(np.float32)
+    y = rng.randint(0, 8, (16,)).astype(np.int64)
+    return x, y
+
+
+def _max_local_bytes(arr):
+    """Largest per-device shard of a placed jax array, in bytes."""
+    return max(s.data.nbytes for s in arr.addressable_shards)
+
+
+def _opt_bytes_per_device(state):
+    total = 0
+    for sv in state["opt"]["slots"].values():
+        total += sum(_max_local_bytes(v) for v in sv.values())
+    total += sum(_max_local_bytes(v)
+                 for v in state["opt"]["master"].values())
+    return total
+
+
+def _train(level, steps=3):
+    mesh = dist.init_mesh({"dp": 2, "sharding": 4})
+    model = _mlp()
+    opt = pt.optimizer.AdamW(learning_rate=1e-3,
+                             parameters=model.parameters())
+    if level is not None:
+        model, opt, _ = group_sharded_parallel(model, opt, level=level)
+    step, state = build_train_step(model, _loss_fn, opt, mesh=mesh)
+    x, y = _batch()
+    losses = []
+    for _ in range(steps):
+        loss, state = step(state, x, y)
+        losses.append(float(loss))
+    return losses, state
+
+
+class TestZeroSpec:
+    def test_inserts_sharding_axis_on_largest_divisible_dim(self):
+        mesh = dist.init_mesh({"dp": 2, "sharding": 4})
+        assert zero_spec(P(), (256, 64), mesh) == P("sharding", None)
+        # dim0 indivisible by 4 -> falls to dim1
+        assert zero_spec(P(), (66, 256), mesh) == P(None, "sharding")
+
+    def test_respects_existing_axes(self):
+        mesh = dist.init_mesh({"dp": 2, "sharding": 4})
+        # param already fsdp-sharded: state inherits, no double insert
+        assert zero_spec(P("sharding", None), (256, 64), mesh) == \
+            P("sharding", None)
+        # mp-sharded dim is occupied; sharding goes to the free dim
+        assert zero_spec(P("mp", None), (256, 64), mesh) == \
+            P("mp", "sharding")
+
+    def test_indivisible_leaf_stays_replicated(self):
+        mesh = dist.init_mesh({"dp": 2, "sharding": 4})
+        assert zero_spec(P(), (7, 9), mesh) == P()
+
+
+class TestZeroStage12:
+    def test_os_state_is_partitioned(self):
+        _, state = _train("os", steps=1)
+        m1 = state["opt"]["slots"]["moment1"]
+        # every shardable leaf carries the sharding axis
+        w = m1["0.weight"]
+        assert "sharding" in jax.tree.leaves(
+            [w.sharding.spec])[0:] or "sharding" in str(w.sharding.spec)
+        shard = w.addressable_shards[0].data
+        assert shard.size == w.size // 4
+
+    def test_os_memory_shrinks_vs_baseline(self):
+        _, base_state = _train(None, steps=1)
+        _, os_state = _train("os", steps=1)
+        base = _opt_bytes_per_device(base_state)
+        shard = _opt_bytes_per_device(os_state)
+        # biases (size 256/8) shard too where divisible; demand >=3x
+        assert shard * 3 <= base, (shard, base)
+
+    @pytest.mark.parametrize("level", ["os", "os_g"])
+    def test_loss_parity_with_baseline(self, level):
+        ref, _ = _train(None)
+        got, _ = _train(level)
+        assert np.allclose(ref, got, atol=1e-5), (ref, got)
+
+    def test_os_g_grad_constraint_compiles(self):
+        # stage 2 runs and keeps state sharded across steps (donated
+        # buffers must not silently re-replicate)
+        _, state = _train("os_g", steps=2)
+        w = state["opt"]["slots"]["moment2"]["2.weight"]
+        assert w.addressable_shards[0].data.size == w.size // 4
+
+
+class TestDygraphShardingOptimizer:
+    def test_partition_and_level(self):
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            DygraphShardingOptimizer)
+        model = _mlp()
+        inner = pt.optimizer.AdamW(learning_rate=1e-3,
+                                   parameters=model.parameters())
+        opt = DygraphShardingOptimizer(optimizer=inner)
+        assert inner._group_sharded_level == "os"
+        # greedy partition covers every parameter exactly once
+        allp = [p for ps in opt._rank2params.values() for p in ps]
+        assert len(allp) == len(list(model.parameters()))
