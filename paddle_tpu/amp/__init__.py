@@ -25,7 +25,7 @@ from .grad_scaler import GradScaler, AmpScaler  # noqa: F401
 
 __all__ = ["auto_cast", "amp_guard", "decorate", "amp_decorate", "GradScaler",
            "AmpScaler", "is_bfloat16_supported", "is_float16_supported",
-           "white_list", "black_list"]
+           "white_list", "black_list", "debugging"]
 
 # O1 op lists — mirrors python/paddle/amp/amp_lists.py
 WHITE_LIST = {
@@ -157,3 +157,6 @@ def check_numerics(x, op_name="", debug_mode=None):
         if bad:
             raise FloatingPointError(f"non-finite values after {op_name}")
     return x
+
+
+from . import debugging  # noqa: E402,F401  (amp.debugging.* tooling)

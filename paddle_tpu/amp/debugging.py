@@ -1,0 +1,247 @@
+"""AMP debugging / accuracy tooling.
+
+ref: ``python/paddle/amp/debugging.py`` (``collect_operator_stats``,
+``TensorCheckerConfig``/``enable_tensor_checker``, ``compare_accuracy``)
+and ``python/paddle/amp/accuracy_compare.py``. On a bf16-first TPU stack
+this is how users localize loss blow-ups: count which ops ran in which
+dtype, find the first op producing NaN/Inf, and diff fp32-vs-low-precision
+activations per layer. All three ride the single op funnel
+(``autograd.add_op_observer``) instead of the reference's codegen'd
+per-op hooks.
+"""
+from __future__ import annotations
+
+import contextlib
+import enum
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import autograd as _autograd
+from ..framework import flags as _flags
+from ..tensor import Tensor
+
+__all__ = [
+    "DebugMode", "TensorCheckerConfig", "enable_tensor_checker",
+    "disable_tensor_checker", "enable_operator_stats_collection",
+    "disable_operator_stats_collection", "collect_operator_stats",
+    "compare_accuracy",
+]
+
+
+# -- operator stats ---------------------------------------------------------
+
+_stats = None
+_stats_observer = None
+
+
+def _observe_stats(name, inputs, outputs):
+    for t in outputs:
+        d = getattr(t, "_data", None)
+        if d is None:
+            continue
+        dt = str(np.dtype(d.dtype)) if d.dtype != jnp.bfloat16 \
+            else "bfloat16"
+        key = name or "unknown"
+        _stats.setdefault(key, {}).setdefault(dt, 0)
+        _stats[key][dt] += 1
+
+
+def enable_operator_stats_collection():
+    """Start counting (op, output dtype) occurrences
+    (ref ``debugging.py enable_operator_stats_collection``)."""
+    global _stats, _stats_observer
+    _stats = {}
+    _stats_observer = _observe_stats
+    _autograd.add_op_observer(_stats_observer)
+
+
+def disable_operator_stats_collection():
+    """Stop collection and print the four-bucket table like the
+    reference (fp32 / fp16 / bf16 / other calls per op)."""
+    global _stats_observer
+    if _stats_observer is not None:
+        _autograd.remove_op_observer(_stats_observer)
+        _stats_observer = None
+    _print_operator_stats(_stats or {})
+    return _stats
+
+
+def _print_operator_stats(stats):
+    print("<{:-^120}>".format(" op list "))
+    row = "<{:-^40}" + "|{:-^17}" * 4 + ">"
+    print(row.format(" Op Name ", " FP16 Calls ", " BF16 Calls ",
+                     " FP32 Calls ", " Other Calls "))
+    for op in sorted(stats):
+        d = stats[op]
+        other = sum(v for k, v in d.items()
+                    if k not in ("float16", "bfloat16", "float32"))
+        print("<{:-^40}|{:-^17}|{:-^17}|{:-^17}|{:-^17}>".format(
+            op, d.get("float16", 0), d.get("bfloat16", 0),
+            d.get("float32", 0), other))
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    """``with collect_operator_stats(): ...`` (ref ``debugging.py:464``)."""
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+# -- tensor checker (nan/inf localization) ----------------------------------
+
+class DebugMode(enum.Enum):
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 2
+
+
+class TensorCheckerConfig:
+    """ref ``debugging.py TensorCheckerConfig``: which ops to watch and
+    what to do on a non-finite output."""
+
+    def __init__(self, enable=True,
+                 debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None,
+                 skipped_op_list=None, debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = set(checked_op_list or [])
+        self.skipped_op_list = set(skipped_op_list or [])
+        self.debug_step = debug_step
+
+
+_checker_cfg = None
+_checker_observer = None
+_checker_findings: list = []
+
+
+def _observe_checker(name, inputs, outputs):
+    cfg = _checker_cfg
+    if cfg is None:
+        return
+    key = name or "unknown"
+    if cfg.checked_op_list and key not in cfg.checked_op_list:
+        return
+    if key in cfg.skipped_op_list:
+        return
+    for t in outputs:
+        d = getattr(t, "_data", None)
+        if d is None or isinstance(d, jax.core.Tracer):
+            continue
+        if not (np.issubdtype(np.dtype(d.dtype), np.floating)
+                or d.dtype == jnp.bfloat16):
+            continue
+        bad = int(jnp.size(d) - jnp.isfinite(
+            d.astype(jnp.float32)).sum())
+        if bad:
+            finding = {"op": key, "num_nan_inf": bad,
+                       "shape": tuple(d.shape), "dtype": str(d.dtype)}
+            _checker_findings.append(finding)
+            if cfg.debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+                raise FloatingPointError(
+                    f"TensorChecker: {bad} NaN/Inf values in output of "
+                    f"op '{key}' shape={tuple(d.shape)}")
+            print(f"[TensorChecker] op={key} nan/inf={bad} "
+                  f"shape={tuple(d.shape)} dtype={d.dtype}")
+
+
+def enable_tensor_checker(checker_config: TensorCheckerConfig):
+    """ref ``debugging.py enable_tensor_checker``: every funnel op's
+    outputs are scanned; the FIRST offending op is named (the
+    localization the reference gets from per-kernel nan-inf utils)."""
+    global _checker_cfg, _checker_observer
+    if not checker_config.enable:
+        return
+    _checker_cfg = checker_config
+    _checker_findings.clear()
+    _checker_observer = _observe_checker
+    _autograd.add_op_observer(_checker_observer)
+
+
+def disable_tensor_checker():
+    """Returns the findings accumulated while enabled."""
+    global _checker_cfg, _checker_observer
+    if _checker_observer is not None:
+        _autograd.remove_op_observer(_checker_observer)
+        _checker_observer = None
+    _checker_cfg = None
+    return list(_checker_findings)
+
+
+# -- fp32 vs low-precision accuracy compare ---------------------------------
+
+def compare_accuracy(layer, inputs, dtype="bfloat16", atol=1e-2, rtol=1e-2,
+                     print_report=True):
+    """Per-sublayer fp32-vs-``dtype`` forward activation diff
+    (ref ``amp/accuracy_compare.py`` — the reference diffs two dumped
+    run logs; here both runs happen in-process via forward hooks).
+
+    Returns a list of rows ``{"layer", "type", "max_abs_diff",
+    "mean_abs_diff", "exceeds"}`` ordered by execution; the first
+    ``exceeds`` row is where low-precision diverges past
+    ``atol + rtol*|fp32|``.
+    """
+    from . import auto_cast
+
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    inputs = [x if isinstance(x, Tensor) else Tensor(x) for x in inputs]
+
+    def run(low_precision):
+        captured = []
+        hooks = []
+
+        def make_hook(name, sub):
+            def hook(lyr, ins, out):
+                o = out[0] if isinstance(out, (list, tuple)) else out
+                if isinstance(o, Tensor):
+                    captured.append(
+                        (name, type(lyr).__name__,
+                         np.asarray(o._data.astype(jnp.float32))))
+            return hook
+
+        for name, sub in layer.named_sublayers(include_self=False):
+            hooks.append(sub.register_forward_post_hook(
+                make_hook(name, sub)))
+        try:
+            was_training = layer.training
+            layer.eval()
+            if low_precision:
+                with auto_cast(enable=True, dtype=dtype, level="O1"):
+                    layer(*inputs)
+            else:
+                layer(*inputs)
+            if was_training:
+                layer.train()
+        finally:
+            for h in hooks:
+                h.remove()
+        return captured
+
+    ref = run(False)
+    low = run(True)
+    rows = []
+    for (name, ltype, a), (_, _, b) in zip(ref, low):
+        if a.shape != b.shape:
+            continue
+        diff = np.abs(a - b)
+        thresh = atol + rtol * np.abs(a)
+        rows.append({
+            "layer": name, "type": ltype,
+            "max_abs_diff": float(diff.max()) if diff.size else 0.0,
+            "mean_abs_diff": float(diff.mean()) if diff.size else 0.0,
+            "exceeds": bool((diff > thresh).any()),
+        })
+    if print_report:
+        print(f"{'layer':<40}{'type':<24}{'max_abs':>12}{'mean_abs':>12}"
+              f"{'exceeds':>9}")
+        for r in rows:
+            print(f"{r['layer']:<40}{r['type']:<24}"
+                  f"{r['max_abs_diff']:>12.3e}{r['mean_abs_diff']:>12.3e}"
+                  f"{str(r['exceeds']):>9}")
+    return rows

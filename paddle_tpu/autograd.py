@@ -155,6 +155,25 @@ class Node:
 _static_recorder = None
 _STATIC_SENTINEL = None
 
+# op observers: every funnel-recorded op reports (name, inputs, outputs).
+# Serves amp.debugging operator-stats / tensor-checker tooling (ref
+# ``python/paddle/amp/debugging.py``); empty-list check keeps the hot
+# path free when unused.
+_op_observers: list = []
+
+
+def add_op_observer(fn):
+    """fn(op_name, input_tensors, output_tensors) on every recorded op."""
+    _op_observers.append(fn)
+    return fn
+
+
+def remove_op_observer(fn):
+    try:
+        _op_observers.remove(fn)
+    except ValueError:
+        pass
+
 
 def record(fn, tensors, outputs_wrap, name=""):
     """Run `fn(*datas)` with optional tape capture.
@@ -183,6 +202,9 @@ def record(fn, tensors, outputs_wrap, name=""):
             t._out_idx = i
     if _flags.flag("check_nan_inf"):
         _check_nan_inf(out_tensors, name)
+    if _op_observers:
+        for ob in list(_op_observers):
+            ob(name, tensors, out_tensors)
     return result
 
 

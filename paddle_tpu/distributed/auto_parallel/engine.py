@@ -74,12 +74,19 @@ class Engine:
         s = self._strategy
         mesh = self._mesh or _mesh_mod.get_mesh()
 
+        autocast = None
         if getattr(s, "amp", False):
             from ... import amp as _amp
             cfg = s.amp_configs
             dtype = "bfloat16" if cfg.get("use_bf16", True) else "float16"
             if cfg.get("use_pure_fp16", False) or dtype == "bfloat16":
                 _amp.decorate(self._model, level="O2", dtype=dtype)
+            else:
+                # fp16 O1: white-list ops cast at trace time inside the
+                # compiled step (auto_cast state is read by the op funnel)
+                def autocast():
+                    return _amp.auto_cast(enable=True, level="O1",
+                                          dtype=dtype)
             if self._scaler is None and cfg.get("use_dynamic_loss_scaling",
                                                 True):
                 self._scaler = _amp.GradScaler(
@@ -108,13 +115,18 @@ class Engine:
             n_micro = int(s.pipeline_configs.get("accumulate_steps", 1))
             v_pp = int(s.pipeline_configs.get("virtual_pp_degree", 1))
 
-        if mode == "train" and self._optimizer is not None:
+        if mode == "train":
+            if self._optimizer is None:
+                raise ValueError(
+                    "Engine.fit/load require an optimizer; pass one to "
+                    "Engine(..., optimizer=...)")
             if self._loss is None:
                 raise ValueError("Engine.fit requires a loss")
             self._step_fn, self._state = build_train_step(
                 self._model, self._loss_adapter, self._optimizer,
                 mesh=mesh, pipeline_microbatches=n_micro,
-                scaler=self._scaler, pipeline_virtual_stages=v_pp)
+                scaler=self._scaler, pipeline_virtual_stages=v_pp,
+                autocast=autocast)
         return self
 
     def _loss_adapter(self, out, *labels):
@@ -185,18 +197,17 @@ class Engine:
         self._build_eval_step()
         for m in self._metrics:
             m.reset()
+        # state is loop-invariant: unstack any pp-stacked leaves ONCE
+        params, buffers = self._eval_arrays()
         total, count = 0.0, 0
-        metric_vals = {}
         for step_i, batch in enumerate(loader):
             if steps is not None and step_i >= steps:
                 break
             x, labels = self._split_batch(batch)
-            params, buffers = self._eval_arrays()
             loss, preds = self._eval_jit(params, buffers, x,
                                          *[_arr(l) for l in labels])
             if loss is not None:
-                bs = int(np.asarray(x).shape[0]) if hasattr(x, "shape") \
-                    else 1
+                bs = int(x.shape[0]) if hasattr(x, "shape") else 1
                 total += float(loss) * bs
                 count += bs
             for m in self._metrics:
@@ -220,12 +231,12 @@ class Engine:
                               drop_last=False, num_workers=num_workers,
                               collate_fn=collate_fn)
         self._build_eval_step()
+        params, buffers = self._eval_arrays()
         outs = []
         for step_i, batch in enumerate(loader):
             if steps is not None and step_i >= steps:
                 break
             x, _ = self._split_batch(batch, allow_unlabeled=True)
-            params, buffers = self._eval_arrays()
             _, preds = self._eval_jit(params, buffers, x)
             outs.append(np.asarray(preds))
         return outs

@@ -14,5 +14,7 @@ in-run self-healing is process-level, exactly like the reference's
 NCCL-abort-then-relaunch model.
 """
 from .manager import ElasticManager, ElasticStatus, LauncherInterface  # noqa: F401
+from .preemption import on_preemption, clear_preemption_handler  # noqa: F401
 
-__all__ = ["ElasticManager", "ElasticStatus", "LauncherInterface"]
+__all__ = ["ElasticManager", "ElasticStatus", "LauncherInterface",
+           "on_preemption", "clear_preemption_handler"]

@@ -168,6 +168,44 @@ class ElasticManager:
             f"elastic: np stayed outside [{self.np_min},{self.np_max}] "
             f"for {timeout}s (alive={self.alive_nodes()})")
 
+    def supervise(self, make_launcher, max_restarts=5, poll=0.25,
+                  hold_timeout=60.0):
+        """Drive this node's local trainer under elastic membership
+        (ref ``manager.py`` main loop: watch ``:604`` → re-match ``:417``
+        → relaunch via ``LauncherInterface :54``).
+
+        make_launcher(hosts, rank) -> LauncherInterface for the CURRENT
+        rank map; called again after every membership change or trainer
+        death. Returns ElasticStatus.COMPLETED when the trainer exits 0,
+        ERROR when the restart budget is exhausted.
+        """
+        hosts, rank = self.wait_for_np(hold_timeout)
+        launcher = make_launcher(hosts, rank)
+        launcher.launch()
+        restarts = 0
+        # arm the membership watcher
+        self.watch(timeout=0)
+        while True:
+            rc = launcher.watch()
+            if rc == 0:
+                return ElasticStatus.COMPLETED
+            relaunch = False
+            if rc is not None:
+                relaunch = True      # local trainer died
+            else:
+                status = self.watch(timeout=poll)
+                if status in (ElasticStatus.RESTART, ElasticStatus.HOLD):
+                    relaunch = True  # peers joined/left: rank map changed
+            if relaunch:
+                if restarts >= max_restarts:
+                    launcher.stop()
+                    return ElasticStatus.ERROR
+                restarts += 1
+                launcher.stop()
+                hosts, rank = self.wait_for_np(hold_timeout)
+                launcher = make_launcher(hosts, rank)
+                launcher.launch()
+
     def exit(self):
         self._stop.set()
         # deregister: clear own slot + heartbeat (both are per-node keys)

@@ -1,0 +1,63 @@
+"""Preemption / SIGTERM checkpoint hook.
+
+SURVEY §5 designates TPU preemption handling as the equivalent of the
+reference's elastic fault tolerance (``fleet/elastic/manager.py:124``):
+cloud TPU VMs receive SIGTERM ahead of maintenance/preemption. This module
+installs a handler that saves a (sharded) checkpoint and exits, so the
+relaunched job resumes via ``distributed.checkpoint.load_state``.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+
+__all__ = ["on_preemption", "clear_preemption_handler"]
+
+_state = threading.local()
+_installed: dict[int, object] = {}
+
+
+def on_preemption(save_fn, signals=(signal.SIGTERM,), exit_code=143,
+                  exit=True):
+    """Install ``save_fn()`` as the preemption handler.
+
+    save_fn runs once, in the main thread, when any of ``signals``
+    arrives; the process then exits with ``exit_code`` (Unix convention
+    128+SIGTERM) unless ``exit=False`` (then the previous disposition is
+    NOT re-raised — the caller owns shutdown).
+
+    Typical use::
+
+        eng = Engine(model, loss, opt)
+        on_preemption(lambda: eng.save(ckpt_dir))
+    """
+    done = threading.Event()
+
+    def handler(signum, frame):
+        if done.is_set():  # double signal: force exit
+            os._exit(exit_code)
+        done.set()
+        try:
+            save_fn()
+        finally:
+            if exit:
+                sys.exit(exit_code)
+
+    for sig in signals:
+        prev = signal.signal(sig, handler)
+        # remember only the ORIGINAL disposition: re-installing must not
+        # make clear_preemption_handler restore a stale save handler
+        _installed.setdefault(sig, prev)
+    return handler
+
+
+def clear_preemption_handler():
+    """Restore the dispositions replaced by :func:`on_preemption`."""
+    for sig, prev in _installed.items():
+        try:
+            signal.signal(sig, prev)
+        except Exception:
+            pass
+    _installed.clear()

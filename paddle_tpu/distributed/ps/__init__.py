@@ -1,0 +1,150 @@
+"""Large-sparse-embedding training — the TPU-native parameter-server story.
+
+ref: ``paddle/fluid/distributed/ps/`` (~32K LoC of C++ PS tables/servers)
++ ``python/paddle/distributed/ps/`` + ``fleet.utils`` PS entry points. The
+reference reaches "trillion-parameter" scale by holding huge embedding
+tables on parameter servers and exchanging SPARSE gradients
+asynchronously over RPC (``ps/table/common_sparse_table.cc``,
+``ps/service/brpc_ps_server.cc``).
+
+**Design decision (explicit descope + replacement).** An asynchronous
+push/pull PS is an anti-pattern on TPU pods: every chip is connected by
+ICI to every table shard, XLA compiles gather/scatter over sharded
+operands into exactly the all-to-all exchanges the PS does by hand, and
+synchronous SPMD steps remove the staleness/consistency machinery
+entirely. The capability the PS provides — tables far larger than one
+accelerator's memory, touched sparsely — maps to:
+
+ - :class:`ShardedEmbedding`: the table's VOCAB dim sharded over the data
+   axes (``dp × sharding`` — the PS "server shard" analog; ``mp`` also
+   honored). Per-device bytes shrink 1/N; a 10M-vocab × 512 fp32 table
+   (20 GB) fits a v5e-256 pod at 80 MB/chip.
+ - lookups: XLA gather over the sharded table (the compiler inserts the
+   id-routed collective — the "pull");
+ - gradients: inside a jitted train step the gather's transpose is a
+   scatter-add routed to the owning shard (the "push"); combined with
+   ZeRO (``group_sharded_parallel``) the optimizer state shards the same
+   way, so the dense-update cost is O(vocab/N) per chip per step.
+ - :func:`row_sparse_apply` + :class:`RowSparseAdagrad`: the eager-mode
+   analog of the reference's lazy sparse tables — only TOUCHED rows are
+   read/updated, never a dense [vocab, dim] buffer.
+
+What is deliberately NOT built: brpc servers, async optimizers
+(``DownpourSGD``), staleness control, CPU-side SSD table spill
+(``ps/table/ssd_sparse_table.cc``). On TPU they have no hardware to win
+on; their scale target is covered by the sharded table above. This note
+is the SURVEY §2 "parameter server" line's resolution.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...tensor import Tensor
+from ...nn.layer.layers import Layer
+from ...nn import functional as F
+from .. import mesh as _mesh_mod
+
+__all__ = ["ShardedEmbedding", "row_sparse_apply", "RowSparseAdagrad"]
+
+
+class ShardedEmbedding(Layer):
+    """Embedding whose vocab dim is sharded over the mesh's data axes.
+
+    The TPU replacement for a PS sparse table
+    (ref ``ps/table/common_sparse_table.cc``): ``axes`` (default
+    ``("dp", "sharding", "mp")``, intersected with the live mesh and
+    filtered to sizes that divide ``num_embeddings``) shard dim 0 of the
+    weight. Under a jitted train step XLA routes lookups/grads to the
+    owning shard over ICI.
+    """
+
+    def __init__(self, num_embeddings, embedding_dim,
+                 axes=("dp", "sharding", "mp"), padding_idx=None,
+                 weight_attr=None, name=None):
+        super().__init__()
+        self._num_embeddings = num_embeddings
+        self._embedding_dim = embedding_dim
+        self._padding_idx = padding_idx
+        live = []
+        size = 1
+        for a in axes:
+            n = _mesh_mod.mesh_axis_size(a)
+            if n > 1 and num_embeddings % (size * n) == 0:
+                live.append(a)
+                size *= n
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr)
+        self.weight._spec = P(tuple(live) if live else None, None)
+        mesh = _mesh_mod.get_mesh(create_default=False)
+        if mesh is not None and live and not isinstance(
+                self.weight._data, jax.core.Tracer):
+            self.weight._data = jax.device_put(
+                self.weight._data,
+                NamedSharding(mesh, self.weight._spec))
+        self._shard_axes = tuple(live)
+
+    def forward(self, ids):
+        return F.embedding(ids, self.weight, padding_idx=self._padding_idx)
+
+
+def row_sparse_apply(weight, ids, row_grads, update_fn):
+    """Apply an update to only the TOUCHED rows of ``weight``.
+
+    The eager analog of the reference's lazy sparse-table update
+    (``ps/table/sparse_sgd_rule.cc``): duplicate ids are pre-summed with a
+    segment-sum over the unique set, then one scatter updates the rows —
+    no dense [vocab, dim] gradient is ever materialized.
+
+    weight: [V, D] array. ids: int array (any shape). row_grads:
+    ids.shape + [D] per-occurrence gradients. update_fn(rows, grads) ->
+    new_rows over the deduplicated [U, D] slices.
+    Returns (new_weight, unique_ids).
+    """
+    flat_ids = ids.reshape(-1)
+    flat_g = row_grads.reshape(-1, row_grads.shape[-1])
+    # pad slots point OUT of range: their scatter updates are dropped by
+    # XLA's OOB-scatter rule, so they can never clobber a real row
+    uniq, inv = jnp.unique(flat_ids, return_inverse=True,
+                           size=flat_ids.shape[0],
+                           fill_value=weight.shape[0])
+    summed = jax.ops.segment_sum(flat_g, inv.reshape(-1),
+                                 num_segments=uniq.shape[0])
+    rows = weight[uniq]
+    new_rows = update_fn(rows, summed)
+    return weight.at[uniq].set(new_rows), uniq
+
+
+class RowSparseAdagrad:
+    """Row-lazy Adagrad for :class:`ShardedEmbedding`-style tables (ref
+    ``ps/table/sparse_sgd_rule.cc`` SparseAdaGradSGDRule): accumulator
+    rows update only for touched ids; untouched rows cost nothing."""
+
+    def __init__(self, table: Tensor, learning_rate=0.01, epsilon=1e-8):
+        self._table = table
+        self._lr = learning_rate
+        self._eps = epsilon
+        self._acc = jnp.zeros((table.shape[0],), jnp.float32)
+
+    def step_rows(self, ids, row_grads):
+        """ids: occurrences; row_grads: matching [..., D] grads (e.g.
+        ``out.grad`` rows from an embedding lookup)."""
+        ids = ids._data if isinstance(ids, Tensor) else jnp.asarray(ids)
+        g = row_grads._data if isinstance(row_grads, Tensor) \
+            else jnp.asarray(row_grads)
+        w = self._table._data
+        flat_ids = ids.reshape(-1)
+        flat_g = g.reshape(-1, g.shape[-1]).astype(jnp.float32)
+        uniq, inv = jnp.unique(flat_ids, return_inverse=True,
+                               size=flat_ids.shape[0],
+                               fill_value=w.shape[0])
+        summed = jax.ops.segment_sum(flat_g, inv.reshape(-1),
+                                     num_segments=uniq.shape[0])
+        rows = w[uniq].astype(jnp.float32)
+        acc_rows = self._acc[uniq] + (summed * summed).mean(-1)
+        new_rows = rows - self._lr * summed / (
+            jnp.sqrt(acc_rows)[:, None] + self._eps)
+        self._table._data = w.at[uniq].set(new_rows.astype(w.dtype))
+        self._acc = self._acc.at[uniq].set(acc_rows)
+        return uniq

@@ -16,6 +16,8 @@ Used by fleet users, ``__graft_entry__.dryrun_multichip`` and the bench.
 """
 from __future__ import annotations
 
+import contextlib
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -189,7 +191,7 @@ def _scaler_finish(scaler, grads, scale, old_state):
 
 def build_train_step(model: Layer, loss_fn, optimizer, mesh=None,
                      donate=True, pipeline_microbatches=None, scaler=None,
-                     pipeline_virtual_stages=1):
+                     pipeline_virtual_stages=1, autocast=None):
     """Returns (step_fn, state) where
     ``state = {"params", "buffers", "opt"}`` is mesh-placed and
     ``step_fn(state, *batch) -> (loss, state)`` is one compiled program.
@@ -211,6 +213,11 @@ def build_train_step(model: Layer, loss_fn, optimizer, mesh=None,
     ``pipeline_virtual_stages``: interleaved-pipeline virtual stage count
     ``v`` (ref ``pipeline_parallel.py:807``): each chip holds ``v``
     non-adjacent block groups, shrinking the bubble by ``v``.
+
+    ``autocast``: optional zero-arg callable returning a context manager
+    (e.g. ``lambda: amp.auto_cast(level="O1", dtype="float16")``) entered
+    around the forward at trace time — O1 white-list casts compile into
+    the step.
     """
     mesh = mesh or _mesh_mod.get_mesh()
     if scaler is not None and not scaler.is_enable():
@@ -229,7 +236,7 @@ def build_train_step(model: Layer, loss_fn, optimizer, mesh=None,
         return _build_pipelined_train_step(
             model, loss_fn, optimizer, mesh, donate,
             pipeline_microbatches or pp, scaler,
-            pipeline_virtual_stages)
+            pipeline_virtual_stages, autocast)
     params, buffers, shardings = shard_model_state(model, mesh)
     zero = _zero_level(optimizer)
     opt_state, opt_sh = _place_opt_state(optimizer, params, shardings,
@@ -249,10 +256,12 @@ def build_train_step(model: Layer, loss_fn, optimizer, mesh=None,
                  else jnp.float32(1.0))
 
         def loss_of(p):
-            out, new_buffers = functional_call(
-                model, p, state["buffers"], (Tensor(x),), training=True,
-                forward_fn=fwd)
-            loss = loss_fn(out, *[Tensor(l) for l in labels])
+            with (autocast() if autocast is not None
+                  else contextlib.nullcontext()):
+                out, new_buffers = functional_call(
+                    model, p, state["buffers"], (Tensor(x),), training=True,
+                    forward_fn=fwd)
+                loss = loss_fn(out, *[Tensor(l) for l in labels])
             loss_arr = loss._data if isinstance(loss, Tensor) else loss
             loss_arr = loss_arr.astype(jnp.float32)
             return loss_arr * scale, (loss_arr, new_buffers)
@@ -334,7 +343,7 @@ def pipeline_compatible(model, pp):
 
 def _build_pipelined_train_step(model, loss_fn, optimizer, mesh, donate,
                                 num_microbatches, scaler=None,
-                                virtual_stages=1):
+                                virtual_stages=1, autocast=None):
     """Pipeline-parallel variant of :func:`build_train_step`.
 
     State layout: the homogeneous blocks' parameters are stacked into
@@ -439,11 +448,13 @@ def _build_pipelined_train_step(model, loss_fn, optimizer, mesh, donate,
                                   virtual_stages=vstages)
                 return Tensor(y)
 
-            with pipeline_executor_scope(executor):
+            with pipeline_executor_scope(executor), \
+                    (autocast() if autocast is not None
+                     else contextlib.nullcontext()):
                 out, new_buffers = functional_call(
                     model, rest, state["buffers"], (Tensor(x),),
                     training=True, forward_fn=fwd)
-            loss = loss_fn(out, *[Tensor(l) for l in labels])
+                loss = loss_fn(out, *[Tensor(l) for l in labels])
             loss_arr = loss._data if isinstance(loss, Tensor) else loss
             loss_arr = loss_arr.astype(jnp.float32)
             return loss_arr * scale, (loss_arr, new_buffers)

@@ -189,18 +189,170 @@ def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_siz
                     ensure_tensor(target_box)], name="box_coder")
 
 
-def generate_proposals(*args, **kwargs):
-    raise NotImplementedError(
-        "generate_proposals: detection-specific dynamic-shape op; planned "
-        "via fixed-size top-k + masking")
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """RPN proposal generation (ref ``python/paddle/vision/ops.py``
+    generate_proposals → ``phi/kernels/gpu/generate_proposals_kernel.cu``).
+
+    Host-side like :func:`nms` (data-dependent output sizes — the
+    reference also emits LoD rois): per image, decode anchor deltas,
+    clip to the image, drop boxes under ``min_size``, keep the
+    ``pre_nms_top_n`` best, NMS, keep ``post_nms_top_n``.
+
+    scores ``[N, A, H, W]``; bbox_deltas ``[N, 4A, H, W]``; img_size
+    ``[N, 2]`` (h, w); anchors/variances ``[H, W, A, 4]``. Returns
+    (rois ``[R, 4]``, roi_probs ``[R, 1]``[, rois_num ``[N]``]).
+    """
+    sc = np.asarray(ensure_tensor(scores)._data, np.float32)
+    de = np.asarray(ensure_tensor(bbox_deltas)._data, np.float32)
+    iszs = np.asarray(ensure_tensor(img_size)._data, np.float32)
+    an = np.asarray(ensure_tensor(anchors)._data, np.float32).reshape(-1, 4)
+    va = np.asarray(ensure_tensor(variances)._data,
+                    np.float32).reshape(-1, 4)
+    N, A, H, W = sc.shape
+    offset = 1.0 if pixel_offset else 0.0
+
+    all_rois, all_probs, nums = [], [], []
+    for n in range(N):
+        # [A,H,W] -> [H,W,A] -> flat, matching the anchors' [H,W,A,4]
+        s = sc[n].transpose(1, 2, 0).reshape(-1)
+        d = de[n].reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        k = min(pre_nms_top_n, s.size) if pre_nms_top_n > 0 else s.size
+        order = np.argsort(-s)[:k]
+        s, d, a, v = s[order], d[order], an[order], va[order]
+        # decode (variance-scaled center-size transform)
+        aw = a[:, 2] - a[:, 0] + offset
+        ah = a[:, 3] - a[:, 1] + offset
+        acx = a[:, 0] + 0.5 * aw
+        acy = a[:, 1] + 0.5 * ah
+        cx = v[:, 0] * d[:, 0] * aw + acx
+        cy = v[:, 1] * d[:, 1] * ah + acy
+        wN = np.exp(np.minimum(v[:, 2] * d[:, 2], np.log(1000. / 16.))) * aw
+        hN = np.exp(np.minimum(v[:, 3] * d[:, 3], np.log(1000. / 16.))) * ah
+        boxes = np.stack([cx - 0.5 * wN, cy - 0.5 * hN,
+                          cx + 0.5 * wN - offset,
+                          cy + 0.5 * hN - offset], axis=1)
+        imh, imw = iszs[n, 0], iszs[n, 1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, imw - offset)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, imh - offset)
+        ws = boxes[:, 2] - boxes[:, 0] + offset
+        hs = boxes[:, 3] - boxes[:, 1] + offset
+        keep = (ws >= min_size) & (hs >= min_size)
+        boxes, s = boxes[keep], s[keep]
+        if boxes.shape[0]:
+            keep_idx = np.asarray(
+                nms(Tensor(jnp.asarray(boxes)), iou_threshold=nms_thresh,
+                    scores=Tensor(jnp.asarray(s)),
+                    top_k=post_nms_top_n)._data)
+            boxes, s = boxes[keep_idx], s[keep_idx]
+        all_rois.append(boxes)
+        all_probs.append(s[:, None])
+        nums.append(len(boxes))
+    rois = Tensor(jnp.asarray(np.concatenate(all_rois, 0)
+                              if all_rois else np.zeros((0, 4), np.float32)))
+    probs = Tensor(jnp.asarray(np.concatenate(all_probs, 0)
+                               if all_probs else np.zeros((0, 1),
+                                                          np.float32)))
+    if return_rois_num:
+        return rois, probs, Tensor(jnp.asarray(np.asarray(nums, np.int32)))
+    return rois, probs
 
 
-def distribute_fpn_proposals(*args, **kwargs):
-    raise NotImplementedError("distribute_fpn_proposals: planned")
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """Route RoIs to FPN levels by scale (ref ``python/paddle/vision/
+    ops.py distribute_fpn_proposals``): level = floor(log2(sqrt(area) /
+    refer_scale) + refer_level), clipped to [min_level, max_level].
+    Returns (multi_rois per level, restore_ind[, rois_num_per_level])."""
+    r = np.asarray(ensure_tensor(fpn_rois)._data, np.float32)
+    offset = 1.0 if pixel_offset else 0.0
+    w = r[:, 2] - r[:, 0] + offset
+    h = r[:, 3] - r[:, 1] + offset
+    scale = np.sqrt(np.maximum(w * h, 1e-12))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8) + refer_level)
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+
+    multi_rois, lvl_nums, order = [], [], []
+    for l in range(min_level, max_level + 1):
+        idx = np.where(lvl == l)[0]
+        order.append(idx)
+        multi_rois.append(Tensor(jnp.asarray(
+            r[idx] if idx.size else np.zeros((0, 4), np.float32))))
+        lvl_nums.append(idx.size)
+    order = np.concatenate(order) if order else np.zeros((0,), np.int64)
+    restore = np.empty_like(order)
+    restore[order] = np.arange(order.size)
+    restore_ind = Tensor(jnp.asarray(restore[:, None].astype(np.int32)))
+    if rois_num is not None:
+        return multi_rois, restore_ind, [
+            Tensor(jnp.asarray(np.asarray([n], np.int32)))
+            for n in lvl_nums]
+    return multi_rois, restore_ind
 
 
-def yolo_box(*args, **kwargs):
-    raise NotImplementedError("yolo_box: planned")
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """Decode a YOLOv3 head (ref ``python/paddle/vision/ops.py yolo_box``
+    → ``phi/kernels/.../yolo_box_kernel``): pure jnp, jit-friendly.
+
+    x ``[N, an*(5+class_num), H, W]``; img_size ``[N, 2]`` (h, w).
+    Returns (boxes ``[N, an*H*W, 4]`` xyxy, scores ``[N, an*H*W,
+    class_num]``); predictions under ``conf_thresh`` are zeroed like the
+    reference.
+    """
+    def f(feat, im):
+        an = jnp.asarray(np.asarray(anchors, np.float32).reshape(-1, 2))
+        n_anchor = an.shape[0]
+        N, C, H, W = feat.shape
+        iou_pred = None
+        if iou_aware:
+            # PP-YOLO layout: [N, an + an*(5+cls), H, W] — the per-anchor
+            # IoU logits come first (ref yolo_box kernel entry_index)
+            iou_pred = jax.nn.sigmoid(feat[:, :n_anchor])  # [N, an, H, W]
+            feat = feat[:, n_anchor:]
+        p = feat.reshape(N, n_anchor, 5 + class_num, H, W)
+        p = jnp.moveaxis(p, 2, -1)            # [N, an, H, W, 5+cls]
+        gx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+        gy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+        alpha = scale_x_y
+        beta = -0.5 * (scale_x_y - 1.0)
+        cx = (jax.nn.sigmoid(p[..., 0]) * alpha + beta + gx) / W
+        cy = (jax.nn.sigmoid(p[..., 1]) * alpha + beta + gy) / H
+        input_w = jnp.float32(downsample_ratio * W)
+        input_h = jnp.float32(downsample_ratio * H)
+        bw = jnp.exp(p[..., 2]) * an[None, :, None, None, 0] / input_w
+        bh = jnp.exp(p[..., 3]) * an[None, :, None, None, 1] / input_h
+        conf = jax.nn.sigmoid(p[..., 4])
+        if iou_pred is not None:
+            # IoU-aware rescoring: conf^(1-f) * iou^f
+            conf = conf ** (1.0 - iou_aware_factor) * \
+                iou_pred ** iou_aware_factor
+        cls = jax.nn.sigmoid(p[..., 5:]) * conf[..., None]
+        imh = im[:, 0].astype(jnp.float32)[:, None, None, None]
+        imw = im[:, 1].astype(jnp.float32)[:, None, None, None]
+        x0 = (cx - bw / 2) * imw
+        y0 = (cy - bh / 2) * imh
+        x1 = (cx + bw / 2) * imw
+        y1 = (cy + bh / 2) * imh
+        if clip_bbox:
+            x0 = jnp.clip(x0, 0, imw - 1)
+            y0 = jnp.clip(y0, 0, imh - 1)
+            x1 = jnp.clip(x1, 0, imw - 1)
+            y1 = jnp.clip(y1, 0, imh - 1)
+        boxes = jnp.stack([x0, y0, x1, y1], axis=-1)
+        mask = (conf >= conf_thresh).astype(boxes.dtype)
+        boxes = boxes * mask[..., None]
+        cls = cls * mask[..., None]
+        return (boxes.reshape(N, -1, 4),
+                cls.reshape(N, -1, class_num))
+
+    return nary(f, [ensure_tensor(x), ensure_tensor(img_size)],
+                name="yolo_box", n_out=2)
 
 
 def yolo_loss(*args, **kwargs):

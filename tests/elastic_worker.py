@@ -1,0 +1,123 @@
+"""Trainer subprocess for the elastic kill-and-relaunch integration test.
+
+Joins elastic membership over the shared TCPStore, resumes from the
+latest sharded checkpoint if one exists, trains a toy model for
+TOTAL_STEPS eager SGD steps (rank 0 checkpoints every step, atomically),
+then exits 0. Registers the SIGTERM preemption hook so a graceful stop
+also snapshots.
+
+Env: ELASTIC_STORE_PORT, ELASTIC_HOST (logical host id), ELASTIC_CKPT
+(checkpoint dir), ELASTIC_TOTAL_STEPS, ELASTIC_STEP_SECS,
+ELASTIC_LOG (progress file the test asserts on).
+"""
+import glob
+import json
+import os
+import shutil
+import sys
+import time
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import paddle_tpu as pt  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu import core  # noqa: E402
+from paddle_tpu.distributed import checkpoint as ckpt  # noqa: E402
+from paddle_tpu.distributed.fleet.elastic import (  # noqa: E402
+    ElasticManager, on_preemption)
+
+
+def log(entry):
+    with open(os.environ["ELASTIC_LOG"], "a") as f:
+        f.write(json.dumps(entry) + "\n")
+
+
+def save_atomic(state, path):
+    """Write-then-swap so a SIGKILL mid-save never corrupts `path`."""
+    pid = os.getpid()
+    tmp, old = f"{path}.tmp-{pid}", f"{path}.old-{pid}"
+    shutil.rmtree(tmp, ignore_errors=True)
+    ckpt.save_state(state, tmp)
+    shutil.rmtree(old, ignore_errors=True)
+    try:
+        if os.path.exists(path):
+            os.rename(path, old)
+        os.rename(tmp, path)
+    except OSError:
+        shutil.rmtree(tmp, ignore_errors=True)
+    shutil.rmtree(old, ignore_errors=True)
+
+
+def load_retry(path, state, tries=5):
+    for i in range(tries):
+        try:
+            if glob.glob(os.path.join(path, "index.*.json")):
+                return ckpt.load_state(path, state), True
+            return state, False
+        except Exception:
+            if i == tries - 1:
+                raise
+            time.sleep(0.1)
+    return state, False
+
+
+def main():
+    port = int(os.environ["ELASTIC_STORE_PORT"])
+    host = os.environ["ELASTIC_HOST"]
+    path = os.environ["ELASTIC_CKPT"]
+    total = int(os.environ.get("ELASTIC_TOTAL_STEPS", "40"))
+    dt = float(os.environ.get("ELASTIC_STEP_SECS", "0.05"))
+
+    store = core.TCPStore("127.0.0.1", port)
+    man = ElasticManager(store, host, np="1:2", heartbeat_interval=0.2,
+                         lease_ttl=1.0)
+    man.register()
+    _, hosts, rank = man.match()
+
+    pt.seed(0)
+    dist.init_mesh({"dp": 1})
+    model = pt.nn.Linear(8, 8)
+    opt = pt.optimizer.SGD(learning_rate=0.05,
+                           parameters=model.parameters())
+    from paddle_tpu.distributed.train_step import build_train_step
+
+    def loss_fn(out, y):
+        return ((out - y) ** 2).mean()
+
+    step_fn, state = build_train_step(model, loss_fn, opt, donate=False)
+    state = dict(state)
+    state["train_step"] = jnp.int32(0)
+
+    state, resumed = load_retry(path, state)
+    start = int(state["train_step"])
+    log({"event": "start", "host": host, "rank": rank,
+         "resumed_from": start, "hosts": hosts, "pid": os.getpid()})
+
+    on_preemption(lambda: (save_atomic(state, path),
+                           log({"event": "preempt_save", "host": host,
+                                "step": int(state["train_step"])})))
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 8).astype(np.float32)
+    y = rng.randn(8, 8).astype(np.float32)
+    loss = None
+    for i in range(start, total):
+        loss, new_state = step_fn(
+            {k: state[k] for k in ("params", "buffers", "opt")}, x, y)
+        state.update(new_state)
+        state["train_step"] = jnp.int32(i + 1)
+        if rank == 0:
+            save_atomic(state, path)
+        time.sleep(dt)
+    log({"event": "done", "host": host, "final_step": total,
+         "final_loss": float(loss) if loss is not None else None})
+    # NOTE: no man.exit() — the node's membership belongs to its
+    # supervisor; a finishing trainer must not deregister the host
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

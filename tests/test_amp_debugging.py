@@ -1,0 +1,62 @@
+"""AMP debugging tooling (ref: python/paddle/amp/debugging.py,
+accuracy_compare.py): operator dtype stats, nan/inf localization by op
+name, per-layer fp32-vs-bf16 accuracy compare."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.amp.debugging import (
+    DebugMode, TensorCheckerConfig, enable_tensor_checker,
+    disable_tensor_checker, collect_operator_stats, compare_accuracy)
+
+
+def test_collect_operator_stats_counts_dtypes(capsys):
+    x = pt.to_tensor(np.random.rand(8, 8).astype(np.float32))
+    xb = x.astype("bfloat16")
+    with collect_operator_stats():
+        _ = x + x          # fp32
+        _ = pt.matmul(xb, xb)  # bf16
+    out = capsys.readouterr().out
+    assert "Op Name" in out and "BF16 Calls" in out
+    assert "matmul" in out
+
+
+def test_tensor_checker_localizes_first_bad_op():
+    x = pt.to_tensor(np.array([1.0, 0.0], np.float32))
+    enable_tensor_checker(TensorCheckerConfig(
+        enable=True, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT))
+    try:
+        with pytest.raises(FloatingPointError, match="divide|log"):
+            y = x / pt.to_tensor(np.array([1.0, 0.0], np.float32))
+            _ = pt.log(pt.to_tensor(np.array([-1.0], np.float32)))
+    finally:
+        findings = disable_tensor_checker()
+    assert findings and findings[0]["num_nan_inf"] >= 1
+
+
+def test_tensor_checker_log_mode_collects_all(capsys):
+    enable_tensor_checker(TensorCheckerConfig(
+        enable=True, debug_mode=DebugMode.CHECK_NAN_INF))
+    try:
+        _ = pt.log(pt.to_tensor(np.array([-1.0], np.float32)))
+        _ = pt.to_tensor(np.array([1.0], np.float32)) / \
+            pt.to_tensor(np.array([0.0], np.float32))
+    finally:
+        findings = disable_tensor_checker()
+    assert len(findings) >= 2
+    assert {f["op"] for f in findings} >= {"log"}
+
+
+def test_compare_accuracy_reports_per_layer_divergence():
+    pt.seed(0)
+    net = pt.nn.Sequential(
+        pt.nn.Linear(32, 64), pt.nn.ReLU(), pt.nn.Linear(64, 8))
+    x = np.random.RandomState(0).randn(4, 32).astype(np.float32) * 100
+    rows = compare_accuracy(net, pt.to_tensor(x), dtype="bfloat16",
+                            atol=1e-3, rtol=1e-3, print_report=False)
+    assert rows, "no layers captured"
+    names = [r["layer"] for r in rows]
+    assert any("0" in n for n in names)
+    # bf16 matmul on large-magnitude inputs must show a nonzero diff
+    assert max(r["max_abs_diff"] for r in rows) > 0
+    assert any(r["exceeds"] for r in rows)

@@ -91,6 +91,7 @@ def test_reshard_on_load_different_mesh(tmp_path):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_reshard_pipeline_stacked_state(tmp_path):
     """pp-stacked train state written on (dp2, pp2) restores onto
     (dp1, pp4) — stage re-partitioning on load (pp_parallel_adaptor)."""
@@ -168,6 +169,7 @@ def test_fleet_sharded_facade(tmp_path):
                                   np.asarray(restored["params"][k]))
 
 
+@pytest.mark.slow
 def test_pp_stacked_to_unstacked_translation(tmp_path):
     """pp-stacked checkpoint loads onto a NON-pp mesh (unstack) and a
     plain checkpoint loads onto a pp mesh (stack) — both directions of
@@ -271,6 +273,7 @@ def test_pipeline_train_batch_ragged_batch_falls_back():
     assert pp._pp_step is None  # compiled path not taken
 
 
+@pytest.mark.slow
 def test_reshard_flat_to_interleaved_pp_layout(tmp_path):
     """A checkpoint written with the flat pp layout restores into an
     INTERLEAVED (virtual-stage [v, pp*Lv, ...]) template and vice versa —
@@ -319,6 +322,7 @@ def test_reshard_flat_to_interleaved_pp_layout(tmp_path):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_interleaved_checkpoint_to_unstacked_template(tmp_path):
     """An interleaved ([v, pp*Lv, ...]) pipelined checkpoint restores into
     a NON-pipelined (per-block param names) template — the _RowReader

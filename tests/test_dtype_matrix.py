@@ -123,6 +123,7 @@ class TestConvNormDtypes:
 class TestAmpO2BenchPath:
     """The exact bench.py fast path on a tiny net — compile + one step."""
 
+    @pytest.mark.slow
     def test_resnet_amp_o2_train_step(self):
         import jax
         from paddle_tpu.jit.api import functional_call

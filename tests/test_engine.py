@@ -51,6 +51,7 @@ def _loss(out, y):
     return pt.nn.functional.cross_entropy(out, y)
 
 
+@pytest.mark.slow
 def test_engine_fit_bert_loss_decreases():
     dist.init_mesh({"dp": 4, "mp": 2})
     model = _bert()
@@ -62,6 +63,7 @@ def test_engine_fit_bert_loss_decreases():
     assert hist["loss"][-1] < hist["loss"][0], hist["loss"]
 
 
+@pytest.mark.slow
 def test_engine_evaluate_and_predict():
     dist.init_mesh({"dp": 8})
     model = _bert()
@@ -77,6 +79,7 @@ def test_engine_evaluate_and_predict():
     assert preds[0].shape == (8, 2)
 
 
+@pytest.mark.slow
 def test_engine_strategy_amp_and_sharding():
     """strategy.amp builds a compiled scaler; strategy.sharding partitions
     the optimizer state over the sharding axis."""
@@ -129,3 +132,29 @@ def test_to_static_returns_engine():
                              parameters=model.parameters())
     eng = to_static(model, loss=_loss, optimizer=opt)
     assert isinstance(eng, Engine)
+
+
+def test_engine_fp16_o1_strategy_casts_matmuls():
+    """amp with use_bf16=False (fp16 O1) must actually change compute
+    dtype inside the compiled step, not silently run fp32."""
+    dist.init_mesh({"dp": 8})
+    model = _bert()
+    opt = pt.optimizer.AdamW(learning_rate=1e-3,
+                             parameters=model.parameters())
+    s = DistributedStrategy()
+    s.amp = True
+    s.amp_configs = {"use_bf16": False}
+    eng = Engine(model, loss=_loss, optimizer=opt, strategy=s)
+    hist = eng.fit(_SST2Toy(), batch_size=8, epochs=1, verbose=0)
+    assert np.isfinite(hist["loss"][0])
+    assert "scaler" in eng._state
+    # O1: params remain fp32 (no O2 decorate)
+    assert str(eng._state["params"]["classifier.weight"].dtype) == "float32"
+
+
+def test_engine_without_optimizer_raises_clearly():
+    dist.init_mesh({"dp": 8})
+    model = _bert()
+    eng = Engine(model, loss=_loss)
+    with pytest.raises(ValueError, match="optimizer"):
+        eng.fit(_SST2Toy(), batch_size=8, epochs=1, verbose=0)

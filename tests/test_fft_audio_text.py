@@ -188,6 +188,7 @@ class TestTextDatasets:
 
 
 class TestBert:
+    @pytest.mark.slow
     def test_forward_and_finetune(self):
         from paddle_tpu.incubate.models import (bert_tiny,
                                                 BertForSequenceClassification)

@@ -175,6 +175,7 @@ def test_gpt_pipeline_pp2_matches_single_device():
     np.testing.assert_allclose(ref, got, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_gpt_pipeline_pp4_microbatches():
     """pp4 with 4 blocks (L=1) and M=8 microbatches matches pp=1."""
     pt.seed(0)
@@ -232,6 +233,7 @@ def test_pipeline_spmd_stage_sharding():
             assert state["opt"]["slots"][s][k].sharding.spec[0] == "pp"
 
 
+@pytest.mark.slow
 def test_gpt_pipeline_with_attention_mask_extras():
     """Per-sample attention masks are micro-batched through the pipeline
     (each stage indexes the mask at its own micro-batch offset)."""
@@ -275,6 +277,7 @@ def test_gpt_pipeline_with_attention_mask_extras():
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_gpt_interleaved_pipeline_pp2_v2_matches_single_device():
     """Interleaved virtual stages (ref pipeline_parallel.py:807): pp2 with
     v=2 (4 blocks -> 4 virtual stages of 1 block, chip s owns vstages
@@ -324,6 +327,7 @@ def test_gpt_interleaved_pipeline_pp2_v2_matches_single_device():
     np.testing.assert_allclose(ref, got, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_gpt_interleaved_pipeline_pp4_v2():
     """pp4 × v=2 over 8 blocks (Lv=1), M=8 microbatches == pp1 oracle."""
     pt.seed(0)

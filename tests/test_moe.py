@@ -59,7 +59,8 @@ def test_dispatch_combine_roundtrip_identity_experts():
                                rtol=1e-4, atol=1e-5)
 
 
-@pytest.mark.parametrize("gate_type", ["gshard", "switch"])
+@pytest.mark.parametrize("gate_type", [
+    pytest.param("gshard", marks=pytest.mark.slow), "switch"])
 def test_moe_layer_forward_backward(gate_type):
     pt.seed(0)
     layer = MoELayer(d_model=16,

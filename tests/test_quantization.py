@@ -67,6 +67,7 @@ class TestObservers:
 
 
 class TestQAT:
+    @pytest.mark.slow
     def test_quantize_wraps_and_trains(self):
         model = _model()
         cfg = Q.QuantConfig(

@@ -1,0 +1,207 @@
+"""RNNT loss (numpy lattice-DP oracle) + detection ops
+(generate_proposals / distribute_fpn_proposals / yolo_box).
+Ref oracles: warprnnt transducer recursion (Graves 2012 eq. 16-18);
+``python/paddle/vision/ops.py`` semantics."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.tensor import Tensor
+import paddle_tpu.vision.ops as V
+
+
+def _np_rnnt(acts, labels, T, U, blank=0):
+    """Graves transducer -log p(y|x), single sample, numpy DP."""
+    a = acts - np.max(acts, -1, keepdims=True)
+    lp = a - np.log(np.exp(a).sum(-1, keepdims=True))
+    alpha = np.full((T, U + 1), -np.inf)
+    alpha[0, 0] = 0.0
+    for t in range(T):
+        for u in range(U + 1):
+            if t == 0 and u == 0:
+                continue
+            terms = []
+            if t > 0:
+                terms.append(alpha[t - 1, u] + lp[t - 1, u, blank])
+            if u > 0:
+                terms.append(alpha[t, u - 1] + lp[t, u - 1, labels[u - 1]])
+            alpha[t, u] = np.logaddexp.reduce(terms)
+    return -(alpha[T - 1, U] + lp[T - 1, U, blank])
+
+
+class TestRNNTLoss:
+    def test_matches_numpy_dp(self):
+        rng = np.random.RandomState(0)
+        B, T, U, V_ = 3, 6, 4, 8
+        acts = rng.randn(B, T, U + 1, V_).astype(np.float32)
+        labels = rng.randint(1, V_, (B, U)).astype(np.int32)
+        ilen = np.array([6, 5, 3], np.int32)
+        ulen = np.array([4, 2, 3], np.int32)
+
+        loss = pt.nn.functional.rnnt_loss(
+            Tensor(acts), Tensor(labels), Tensor(ilen), Tensor(ulen),
+            blank=0, fastemit_lambda=0.0, reduction="none")
+        got = np.asarray(loss._data)
+        want = np.array([
+            _np_rnnt(acts[b, :ilen[b], :ulen[b] + 1], labels[b, :ulen[b]],
+                     int(ilen[b]), int(ulen[b]))
+            for b in range(B)])
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_gradients_flow_and_match_fd(self):
+        rng = np.random.RandomState(1)
+        T, U, V_ = 4, 2, 5
+        acts = rng.randn(1, T, U + 1, V_).astype(np.float32)
+        labels = np.array([[2, 3]], np.int32)
+        ilen = np.array([T], np.int32)
+        ulen = np.array([U], np.int32)
+
+        def loss_of(a):
+            t = Tensor(a)
+            t.stop_gradient = False
+            out = pt.nn.functional.rnnt_loss(
+                t, Tensor(labels), Tensor(ilen), Tensor(ulen),
+                fastemit_lambda=0.0, reduction="mean")
+            return out
+
+        x = Tensor(acts)
+        x.stop_gradient = False
+        out = pt.nn.functional.rnnt_loss(
+            x, Tensor(labels), Tensor(ilen), Tensor(ulen),
+            fastemit_lambda=0.0, reduction="mean")
+        out.backward()
+        g = np.asarray(x.grad._data)
+        assert np.abs(g).sum() > 0
+        # finite-difference check on a few coordinates
+        eps = 1e-3
+        for (t, u, v) in [(0, 0, 0), (2, 1, 3), (3, 2, 0)]:
+            ap = acts.copy()
+            ap[0, t, u, v] += eps
+            am = acts.copy()
+            am[0, t, u, v] -= eps
+            fd = (float(loss_of(ap)._data) - float(loss_of(am)._data)) / (
+                2 * eps)
+            np.testing.assert_allclose(g[0, t, u, v], fd, rtol=5e-2,
+                                       atol=5e-3)
+
+    def test_fastemit_increases_emit_weight(self):
+        rng = np.random.RandomState(2)
+        acts = rng.randn(1, 4, 3, 6).astype(np.float32)
+        labels = np.array([[1, 2]], np.int32)
+        il, ul = np.array([4], np.int32), np.array([2], np.int32)
+        l0 = float(pt.nn.functional.rnnt_loss(
+            Tensor(acts), Tensor(labels), Tensor(il), Tensor(ul),
+            fastemit_lambda=0.0)._data)
+        l1 = float(pt.nn.functional.rnnt_loss(
+            Tensor(acts), Tensor(labels), Tensor(il), Tensor(ul),
+            fastemit_lambda=0.1)._data)
+        assert l1 < l0  # emit paths up-weighted => higher ll, lower loss
+
+
+class TestGenerateProposals:
+    def _inputs(self):
+        rng = np.random.RandomState(3)
+        N, A, H, W = 1, 3, 4, 4
+        scores = rng.rand(N, A, H, W).astype(np.float32)
+        deltas = (rng.randn(N, 4 * A, H, W) * 0.1).astype(np.float32)
+        img = np.array([[64.0, 64.0]], np.float32)
+        ys, xs = np.meshgrid(np.arange(H), np.arange(W), indexing="ij")
+        base = np.stack([xs * 16, ys * 16, xs * 16 + 15, ys * 16 + 15],
+                        axis=-1).astype(np.float32)
+        anchors = np.broadcast_to(base[:, :, None, :], (H, W, A, 4)).copy()
+        var = np.ones((H, W, A, 4), np.float32)
+        return scores, deltas, img, anchors, var
+
+    def test_shapes_and_ordering(self):
+        scores, deltas, img, anchors, var = self._inputs()
+        rois, probs, num = V.generate_proposals(
+            Tensor(scores), Tensor(deltas), Tensor(img), Tensor(anchors),
+            Tensor(var), pre_nms_top_n=30, post_nms_top_n=10,
+            nms_thresh=0.7, min_size=1.0, return_rois_num=True)
+        r = np.asarray(rois._data)
+        p = np.asarray(probs._data).ravel()
+        assert r.shape[1] == 4 and r.shape[0] == int(num._data[0])
+        assert r.shape[0] <= 10
+        assert np.all(np.diff(p) <= 1e-6)          # score-sorted
+        assert np.all(r[:, 0] >= 0) and np.all(r[:, 2] <= 64)
+        assert np.all(r[:, 2] >= r[:, 0]) and np.all(r[:, 3] >= r[:, 1])
+
+    def test_nms_suppresses_overlaps(self):
+        scores, deltas, img, anchors, var = self._inputs()
+        rois, _ = V.generate_proposals(
+            Tensor(scores), Tensor(deltas), Tensor(img), Tensor(anchors),
+            Tensor(var), pre_nms_top_n=48, post_nms_top_n=48,
+            nms_thresh=0.3, min_size=1.0)
+        r = np.asarray(rois._data)
+        ious = np.asarray(V.box_iou(Tensor(r), Tensor(r))._data).copy()
+        np.fill_diagonal(ious, 0.0)
+        assert ious.max() <= 0.3 + 1e-5
+
+
+class TestDistributeFpn:
+    def test_routing_and_restore(self):
+        rois = np.array([
+            [0, 0, 16, 16],      # small -> low level
+            [0, 0, 224, 224],    # refer_scale -> refer_level
+            [0, 0, 500, 500],    # large -> high level
+            [0, 0, 20, 20],
+        ], np.float32)
+        multi, restore, nums = V.distribute_fpn_proposals(
+            Tensor(rois), min_level=2, max_level=5, refer_level=4,
+            refer_scale=224, rois_num=Tensor(np.array([4], np.int32)))
+        sizes = [int(np.asarray(m._data).shape[0]) for m in multi]
+        assert sum(sizes) == 4
+        assert sizes[-1] >= 1          # the 500-box went to the top level
+        # restore index is a permutation that rebuilds the input order
+        cat = np.concatenate([np.asarray(m._data) for m in multi
+                              if np.asarray(m._data).size])
+        ri = np.asarray(restore._data).ravel()
+        np.testing.assert_allclose(cat[ri], rois)
+
+
+class TestYoloBox:
+    def test_decode_shapes_and_ranges(self):
+        rng = np.random.RandomState(4)
+        N, an, cls, H, W = 2, 3, 5, 4, 4
+        x = rng.randn(N, an * (5 + cls), H, W).astype(np.float32)
+        img = np.array([[128, 128], [96, 160]], np.int32)
+        boxes, scores = V.yolo_box(
+            Tensor(x), Tensor(img), anchors=[10, 13, 16, 30, 33, 23],
+            class_num=cls, conf_thresh=0.0, downsample_ratio=32)
+        b = np.asarray(boxes._data)
+        s = np.asarray(scores._data)
+        assert b.shape == (N, an * H * W, 4)
+        assert s.shape == (N, an * H * W, cls)
+        assert np.all(s >= 0) and np.all(s <= 1)
+        assert np.all(b[0, :, 2] <= 127.0 + 1e-5)  # clipped to image
+        assert np.all(b[:, :, 0] >= 0)
+
+    def test_conf_thresh_zeroes_low_confidence(self):
+        rng = np.random.RandomState(5)
+        x = rng.randn(1, 2 * 7, 2, 2).astype(np.float32) * 0.01  # conf~0.5
+        img = np.array([[64, 64]], np.int32)
+        boxes, scores = V.yolo_box(
+            Tensor(x), Tensor(img), anchors=[10, 13, 16, 30], class_num=2,
+            conf_thresh=0.9, downsample_ratio=32)
+        assert float(jnp.abs(boxes._data).sum()) == 0.0
+        assert float(jnp.abs(scores._data).sum()) == 0.0
+
+    def test_iou_aware_rescoring(self):
+        rng = np.random.RandomState(6)
+        an, cls, H, W = 2, 3, 2, 2
+        x = rng.randn(1, an + an * (5 + cls), H, W).astype(np.float32)
+        img = np.array([[64, 64]], np.int32)
+        b1, s1 = V.yolo_box(Tensor(x), Tensor(img),
+                            anchors=[10, 13, 16, 30], class_num=cls,
+                            conf_thresh=0.0, downsample_ratio=32,
+                            iou_aware=True, iou_aware_factor=0.5)
+        # factor 0 must reduce to plain decoding of the non-iou part
+        b0, s0 = V.yolo_box(Tensor(x[:, an:]), Tensor(img),
+                            anchors=[10, 13, 16, 30], class_num=cls,
+                            conf_thresh=0.0, downsample_ratio=32)
+        np.testing.assert_allclose(np.asarray(b1._data),
+                                   np.asarray(b0._data), rtol=1e-5)
+        assert not np.allclose(np.asarray(s1._data), np.asarray(s0._data))

@@ -41,6 +41,7 @@ def test_ring_attention_matches_dense(causal):
 
 
 @pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.slow
 def test_ring_attention_grads_match_dense(causal):
     b, h, s, d = 1, 2, 32, 8
     n = 4

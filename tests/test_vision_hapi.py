@@ -3,6 +3,8 @@ model tests; config[0] ResNet path in miniature)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # model-zoo tier: run with -m slow
+
 import paddle_tpu as pt
 from paddle_tpu.vision.datasets import FakeData
 

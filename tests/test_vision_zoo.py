@@ -8,6 +8,8 @@ import os
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # model-zoo tier: run with -m slow
+
 import paddle_tpu as pt
 
 M = pt.vision.models
