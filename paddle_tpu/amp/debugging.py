@@ -208,17 +208,17 @@ def compare_accuracy(layer, inputs, dtype="bfloat16", atol=1e-2, rtol=1e-2,
         for name, sub in layer.named_sublayers(include_self=False):
             hooks.append(sub.register_forward_post_hook(
                 make_hook(name, sub)))
+        was_training = layer.training
         try:
-            was_training = layer.training
             layer.eval()
             if low_precision:
                 with auto_cast(enable=True, dtype=dtype, level="O1"):
                     layer(*inputs)
             else:
                 layer(*inputs)
+        finally:
             if was_training:
                 layer.train()
-        finally:
             for h in hooks:
                 h.remove()
         return captured

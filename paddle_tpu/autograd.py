@@ -228,16 +228,25 @@ def _zero_cot(shape, dt):
     return jnp.zeros(shape, dtype=dt)
 
 
-def backward(tensors, grad_tensors=None, retain_graph=False):
+def backward(tensors, grad_tensors=None, retain_graph=False,
+             create_graph=False):
     """Queue-based reverse walk over the tape.
 
     Mirrors ``egr::RunBackward`` (``backward.cc:104``): seed cotangents,
     count consumer edges per node, process nodes whose consumers are all
     done, accumulate into leaf ``.grad``.
+
+    ``create_graph=True`` (higher-order, ref ``paddle/fluid/prim/`` +
+    ``incubate/autograd/primapi.py:220``): each node's pullback is
+    re-executed THROUGH the tape (:func:`_taped_pullback`) and cotangent
+    accumulation uses taped adds, so the produced gradients carry their
+    own tape and can be differentiated again. Implies retain_graph.
     """
     import jax.numpy as jnp
     from .tensor import Tensor
 
+    if create_graph:
+        retain_graph = True
     if not isinstance(tensors, (list, tuple)):
         tensors = [tensors]
     if grad_tensors is None:
@@ -255,7 +264,7 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
         k = id(t)
         keep[k] = t
         if k in cots:
-            cots[k] = cots[k] + g
+            cots[k] = cots[k] + g  # taped add when both are Tensors
         else:
             cots[k] = g
 
@@ -271,6 +280,8 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
             g = jnp.ones_like(t._data)
         else:
             g = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        if create_graph:
+            g = Tensor(g, stop_gradient=True)
         if t._node is not None:
             accum(t, g)
             roots.append(t._node)
@@ -319,9 +330,16 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
             g = cots.pop(id(t), None) if t is not None else None
             if g is None:
                 g = _zero_cot(shape, dt)
+                if create_graph and not (isinstance(g, np.ndarray)
+                                         and g.dtype == jax.dtypes.float0):
+                    from .tensor import Tensor as _T
+                    g = _T(g, stop_gradient=True)
             out_cots.append(g)
-        cot_in = out_cots[0] if len(out_cots) == 1 else tuple(out_cots)
-        in_grads = n.pullback(cot_in)
+        if create_graph:
+            in_grads = _taped_pullback(n, out_cots)
+        else:
+            cot_in = out_cots[0] if len(out_cots) == 1 else tuple(out_cots)
+            in_grads = n.pullback(cot_in)
         if n._hooks:
             in_grads = list(in_grads)
             for i, h in n._hooks:
@@ -346,6 +364,84 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
             n.release()
 
 
+def _taped_pullback(n, out_cots):
+    """create_graph backward-of-backward: run the node's vjp THROUGH the
+    tape so the produced gradients are themselves differentiable.
+
+    The pullback is the pure function ``(cot, *float_inputs) ->
+    float_input_grads`` (re-traced from the node's stored ``fn``);
+    recording it via :func:`record` gives the grads tape edges back to
+    both the cotangents and the node's input tensors. Nodes built from an
+    opaque ``vjp_fn`` (PyLayer / functional_call) cannot be re-traced —
+    their grads come back as constants (the graph stops there, like a
+    non-differentiable custom backward in the reference).
+    """
+    from .tensor import Tensor
+    multi = len(out_cots) > 1
+
+    if n.fn is None or n.datas is None:
+        if n._released:
+            raise RuntimeError(
+                "Trying to backward through the graph a second time; "
+                "set retain_graph=True if you need to.")
+        raw_cots = [c._data if isinstance(c, Tensor) else c
+                    for c in out_cots]
+        raw = n.vjp_fn(tuple(raw_cots) if multi else raw_cots[0])
+        return [Tensor(g, stop_gradient=True)
+                if not (isinstance(g, np.ndarray)
+                        and g.dtype == jax.dtypes.float0) else g
+                for g in raw]
+
+    # differentiable slots: float cotangents + float node inputs
+    slots: list = []          # Tensors handed to record()
+    cot_template: list = []   # per-cot: slot index or the constant itself
+    for c in out_cots:
+        if isinstance(c, Tensor):
+            cot_template.append(len(slots))
+            slots.append(c)
+        else:
+            cot_template.append(c)  # float0 constant for int outputs
+    fn, datas = n.fn, n.datas
+
+    def _is_float(a):
+        import jax.numpy as jnp
+        return (np.issubdtype(np.dtype(a.dtype), np.floating)
+                or a.dtype == jnp.bfloat16)
+
+    float_in = [i for i, d in enumerate(datas) if _is_float(d)]
+    base = len(slots)
+    slots.extend(n.inputs[i] for i in float_in)
+
+    def pb(*arrs):
+        cots = [arrs[s] if isinstance(s, int) else s for s in cot_template]
+        ds = list(datas)
+        for j, i in enumerate(float_in):
+            ds[i] = arrs[base + j]
+        primal, vjp = jax.vjp(fn, *ds)
+        # cotangent structure must mirror fn's own output tree (some op
+        # fns return 1-tuples even for single-output nodes)
+        cot = tuple(cots) if isinstance(primal, (tuple, list)) else cots[0]
+        gin = vjp(cot)
+        gout = tuple(gin[i] for i in float_in)
+        # single-output nodes carry a bare array (tape cot_in contract)
+        return gout[0] if len(gout) == 1 else gout
+
+    def wrap(raw, req):
+        raws = raw if isinstance(raw, tuple) else (raw,)
+        ts = [Tensor(r, stop_gradient=not req) for r in raws]
+        return ts, ts
+
+    grads_f = record(pb, slots, wrap, name=(n.name or "op") + "_grad")
+    out = []
+    it = iter(grads_f)
+    for i, d in enumerate(datas):
+        if i in set(float_in):
+            out.append(next(it))
+        else:
+            out.append(np.zeros(d.shape, dtype=jax.dtypes.float0))
+    return out
+
+
 def _leaf_accum(t, g):
     import jax.numpy as jnp
     from .tensor import Tensor
@@ -358,13 +454,18 @@ def _leaf_accum(t, g):
             prev = table.get(id(t))
             table[id(t)] = g if prev is None else prev + g
         return
-    g = jnp.asarray(g)
-    if g.dtype != t._data.dtype:
-        g = g.astype(t._data.dtype)
-    if t._grad is None:
-        t._grad = Tensor(g, stop_gradient=True)
+    if isinstance(g, Tensor):
+        # create_graph backward: keep the taped gradient as .grad so the
+        # user can differentiate through it
+        t._grad = g if t._grad is None else t._grad + g
     else:
-        t._grad._data = t._grad._data + g
+        g = jnp.asarray(g)
+        if g.dtype != t._data.dtype:
+            g = g.astype(t._data.dtype)
+        if t._grad is None:
+            t._grad = Tensor(g, stop_gradient=True)
+        else:
+            t._grad._data = t._grad._data + g
     if t._grad_hooks:
         for h in t._grad_hooks.values():
             out = h(t._grad)
@@ -380,16 +481,14 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
 
     Implemented as a scoped backward: leaf accumulation is redirected to a
     side table covering ONLY `inputs`, so no tensor's ``.grad`` (including
-    model parameters reachable from `outputs`) is touched. ``create_graph``
-    (higher-order) is supported through the functional path only
-    (use ``paddle_tpu.incubate.autograd``).
+    model parameters reachable from `outputs`) is touched.
+
+    ``create_graph=True`` runs the backward pass THROUGH the tape
+    (:func:`_taped_pullback`): the returned grads carry their own graph
+    and can be fed back into :func:`grad` for second/higher derivatives
+    (ref ``python/paddle/incubate/autograd/primapi.py:220`` double-grad).
     """
     from .tensor import Tensor
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True in eager tape mode is not supported; use the "
-            "functional API (paddle_tpu.incubate.autograd.grad) which "
-            "composes jax.grad.")
     if not isinstance(inputs, (list, tuple)):
         inputs = [inputs]
     sg = [(t, t.stop_gradient) for t in inputs]
@@ -399,7 +498,8 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         for t in inputs:
             t.stop_gradient = False
         backward(outputs, grad_tensors=grad_outputs,
-                 retain_graph=bool(retain_graph))
+                 retain_graph=bool(retain_graph) or create_graph,
+                 create_graph=create_graph)
         results = []
         for t in inputs:
             g = table.get(id(t))
@@ -409,6 +509,8 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                         "One of the differentiated tensors appears unused; "
                         "pass allow_unused=True to return None for it.")
                 results.append(None)
+            elif isinstance(g, Tensor):
+                results.append(g)  # create_graph: keep the taped grad
             else:
                 results.append(Tensor(g, stop_gradient=True))
         return results

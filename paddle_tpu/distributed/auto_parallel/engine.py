@@ -74,28 +74,10 @@ class Engine:
         s = self._strategy
         mesh = self._mesh or _mesh_mod.get_mesh()
 
-        autocast = None
-        if getattr(s, "amp", False):
-            from ... import amp as _amp
-            cfg = s.amp_configs
-            dtype = "bfloat16" if cfg.get("use_bf16", True) else "float16"
-            if cfg.get("use_pure_fp16", False) or dtype == "bfloat16":
-                _amp.decorate(self._model, level="O2", dtype=dtype)
-            else:
-                # fp16 O1: white-list ops cast at trace time inside the
-                # compiled step (auto_cast state is read by the op funnel)
-                def autocast():
-                    return _amp.auto_cast(enable=True, level="O1",
-                                          dtype=dtype)
-            if self._scaler is None and cfg.get("use_dynamic_loss_scaling",
-                                                True):
-                self._scaler = _amp.GradScaler(
-                    init_loss_scaling=cfg.get("init_loss_scaling", 2.0**15),
-                    incr_ratio=cfg.get("incr_ratio", 2.0),
-                    decr_ratio=cfg.get("decr_ratio", 0.5),
-                    incr_every_n_steps=cfg.get("incr_every_n_steps", 1000),
-                    decr_every_n_nan_or_inf=cfg.get(
-                        "decr_every_n_nan_or_inf", 2))
+        from ..fleet.base.distributed_strategy import strategy_amp_setup
+        autocast, scaler = strategy_amp_setup(s, self._model)
+        if self._scaler is None:
+            self._scaler = scaler
 
         if getattr(s, "sharding", False):
             stage = int(s.sharding_configs.get("stage", 1))
@@ -243,9 +225,11 @@ class Engine:
 
     # -- save/load ------------------------------------------------------------
     def save(self, path, training=True):
-        """Sharded checkpoint of the engine state (params + optimizer)."""
+        """Sharded checkpoint of the engine state (params + optimizer);
+        eval-only engines (no optimizer) save plain weights."""
         from .. import checkpoint as ckpt
-        self.prepare(mode="train" if training else "predict")
+        if training and self._optimizer is not None:
+            self.prepare(mode="train")
         if self._state is not None:
             ckpt.save_state(self._state, path)
         else:

@@ -79,3 +79,38 @@ class DistributedStrategy:
     def __repr__(self):
         rows = [f"  {k}={v!r}" for k, v in sorted(self.__dict__.items())]
         return "DistributedStrategy(\n" + "\n".join(rows) + "\n)"
+
+
+def strategy_amp_setup(strategy, model=None):
+    """Apply ``strategy.amp``/``amp_configs`` and return
+    ``(autocast_factory, scaler)`` — the ONE place the strategy's AMP
+    semantics live (used by the auto-parallel Engine and the fleet
+    facade, so neither can silently no-op a toggle).
+
+    - bf16 or pure fp16 (O2): ``model``'s params are cast in place.
+    - fp16 O1: returns an autocast factory for ``build_train_step`` —
+      white-list ops cast at trace time.
+    - dynamic loss scaling on: returns a GradScaler built from the
+      configs.
+    """
+    if not getattr(strategy, "amp", False):
+        return None, None
+    from .... import amp as _amp
+    cfg = strategy.amp_configs
+    dtype = "bfloat16" if cfg.get("use_bf16", True) else "float16"
+    autocast = None
+    if cfg.get("use_pure_fp16", False) or dtype == "bfloat16":
+        if model is not None:
+            _amp.decorate(model, level="O2", dtype=dtype)
+    else:
+        def autocast():
+            return _amp.auto_cast(enable=True, level="O1", dtype=dtype)
+    scaler = None
+    if cfg.get("use_dynamic_loss_scaling", True):
+        scaler = _amp.GradScaler(
+            init_loss_scaling=cfg.get("init_loss_scaling", 2.0 ** 15),
+            incr_ratio=cfg.get("incr_ratio", 2.0),
+            decr_ratio=cfg.get("decr_ratio", 0.5),
+            incr_every_n_steps=cfg.get("incr_every_n_steps", 1000),
+            decr_every_n_nan_or_inf=cfg.get("decr_every_n_nan_or_inf", 2))
+    return autocast, scaler

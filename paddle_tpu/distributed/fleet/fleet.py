@@ -91,10 +91,24 @@ class Fleet:
     # -- model / optimizer wrapping ---------------------------------------
     def distributed_model(self, model):
         """ref: ``fleet/model.py:30`` — dispatch on parallel mode
-        (``model.py:134-166``)."""
+        (``model.py:134-166``). Strategy toggles (amp / recompute) are
+        applied here, like the Engine does — they must not be silent
+        no-ops."""
         hcg = self._hcg
         if hcg is None:
             raise RuntimeError("call fleet.init() first")
+        s = self._user_defined_strategy
+        if s is not None:
+            from .base.distributed_strategy import strategy_amp_setup
+            autocast, _ = strategy_amp_setup(s, model)
+            # fp16 O1: compiled paths (PipelineParallel) read this; eager
+            # modes follow the user's own amp.auto_cast context like the
+            # reference dygraph flow
+            s._amp_autocast = autocast
+            if getattr(s, "recompute", False):
+                mcfg = getattr(model, "config", None)
+                if mcfg is not None and hasattr(mcfg, "use_recompute"):
+                    mcfg.use_recompute = True
         mode = hcg.get_parallel_mode()
         if mode == "pipeline":
             from .meta_parallel.pipeline_parallel import PipelineParallel
@@ -117,6 +131,16 @@ class Fleet:
         (``dygraph_optimizer/hybrid_parallel_optimizer.py:238``)."""
         if strategy is not None:
             self._user_defined_strategy = strategy
+        s = self._user_defined_strategy
+        if s is not None and getattr(s, "sharding", False):
+            # ZeRO stage from the strategy: compiled train steps built
+            # over this optimizer partition state over the sharding axis
+            # (train_step._zero_level); stage 3 is applied model-side by
+            # ShardingParallel
+            stage = int(s.sharding_configs.get("stage", 1))
+            level = {1: "os", 2: "os_g"}.get(stage)
+            if level is not None:
+                setattr(optimizer, "_group_sharded_level", level)
         from .meta_optimizers.hybrid_parallel_optimizer import \
             HybridParallelOptimizer
         return HybridParallelOptimizer(optimizer, self._hcg,

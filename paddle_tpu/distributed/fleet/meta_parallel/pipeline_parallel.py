@@ -156,7 +156,8 @@ class PipelineParallel(Layer):
             self._pp_step, self._pp_state = build_train_step(
                 self._layers, self._layers._loss_fn, optimizer,
                 pipeline_microbatches=n_micro, scaler=scaler,
-                pipeline_virtual_stages=v)
+                pipeline_virtual_stages=v,
+                autocast=getattr(self._strategy, "_amp_autocast", None))
             self._pp_optimizer = optimizer
             self._pp_scaler = scaler
         loss, self._pp_state = self._pp_step(self._pp_state, inputs, labels)

@@ -3,3 +3,4 @@ blocks, model zoo (GPT flagship), distributed extras."""
 from . import nn  # noqa: F401
 from . import models  # noqa: F401
 from . import autograd  # noqa: F401
+from . import autotune  # noqa: F401

@@ -1,0 +1,55 @@
+"""``paddle.incubate.autotune`` parity (ref:
+``python/paddle/incubate/autotune.py`` set_config →
+``paddle/phi/kernels/autotune/``).
+
+``set_config({"kernel": {"enable": True}})`` switches the kernel-config
+autotune cache on; :func:`tune_flash_attention` is the warmup tuner for
+the Pallas flash-attention block sizes (timing must happen eagerly —
+see ``ops/autotune.py``). The cache can be persisted/restored like the
+reference's autotune cache file.
+"""
+from __future__ import annotations
+
+import json
+
+from ..ops import autotune as _at
+from ..ops.pallas_ops import tune_mha
+
+__all__ = ["set_config", "tune_flash_attention", "save_cache",
+           "load_cache"]
+
+
+def set_config(config=None):
+    """config: dict or path to a JSON file, reference schema:
+    ``{"kernel": {"enable": bool}, ...}`` (dataloader/layout sections are
+    accepted and inert — XLA owns layout on TPU)."""
+    if config is None:
+        _at.set_enabled(True)
+        return
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    kcfg = config.get("kernel", {})
+    _at.set_enabled(bool(kcfg.get("enable", False)))
+
+
+def tune_flash_attention(query, key, value, *, causal=False,
+                         interpret=None):
+    """Eagerly time flash-attention block configs for these shapes and
+    cache the winner (picked up by all subsequent calls, traced or not).
+    Accepts Tensors or arrays in paddle (B, S, H, D) layout. Returns
+    (best_config, timings)."""
+    import jax.numpy as jnp
+    from ..tensor import Tensor
+
+    def arr(x):
+        return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+    q = jnp.swapaxes(arr(query), 1, 2)
+    k = jnp.swapaxes(arr(key), 1, 2)
+    v = jnp.swapaxes(arr(value), 1, 2)
+    return tune_mha(q, k, v, causal=causal, interpret=interpret)
+
+
+save_cache = _at.save_cache
+load_cache = _at.load_cache

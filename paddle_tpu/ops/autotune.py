@@ -1,0 +1,83 @@
+"""Kernel autotune cache (ref: ``paddle/phi/kernels/autotune/`` —
+``cache.h`` AutoTuneCache, ``auto_tune_base.h`` timing loop, enabled via
+``paddle.incubate.autotune.set_config``).
+
+TPU-native scope: XLA already autotunes its own kernels; what remains is
+the choice of PALLAS kernel launch configs (flash-attention block sizes).
+Because Pallas calls usually execute inside a jit trace (where nothing
+can be timed), tuning is a WARMUP step: time candidates eagerly once per
+(shape, dtype, flags) key, cache the winner, and let traced calls read
+the cache. The cache persists to JSON like the reference's autotune
+cache file.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+__all__ = ["enabled", "set_enabled", "cache_get", "cache_put",
+           "cache_clear", "save_cache", "load_cache", "time_candidates"]
+
+_enabled = False
+_cache: dict = {}
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_enabled(flag: bool):
+    global _enabled
+    _enabled = bool(flag)
+
+
+def _key(kernel: str, key: tuple) -> str:
+    return json.dumps([kernel, list(key)])
+
+
+def cache_get(kernel: str, key: tuple):
+    hit = _cache.get(_key(kernel, key))
+    return tuple(hit) if hit is not None else None
+
+
+def cache_put(kernel: str, key: tuple, config):
+    _cache[_key(kernel, key)] = list(config)
+
+
+def cache_clear():
+    _cache.clear()
+
+
+def save_cache(path: str):
+    with open(path, "w") as f:
+        json.dump(_cache, f)
+
+
+def load_cache(path: str):
+    with open(path) as f:
+        _cache.update(json.load(f))
+
+
+def time_candidates(run, candidates, warmup=1, iters=3):
+    """Pick the fastest config: ``run(config)`` must execute the kernel
+    and block until ready (ref ``auto_tune_base.h`` RunAndMeasureKernel).
+    Returns (best_config, {config: seconds}). Configs that fail to
+    compile/run are skipped."""
+    timings = {}
+    best, best_t = None, float("inf")
+    for cfg in candidates:
+        try:
+            for _ in range(warmup):
+                run(cfg)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                run(cfg)
+            dt = (time.perf_counter() - t0) / iters
+        except Exception:
+            continue
+        timings[cfg] = dt
+        if dt < best_t:
+            best, best_t = cfg, dt
+    if best is None:
+        raise RuntimeError("no autotune candidate ran successfully")
+    return best, timings

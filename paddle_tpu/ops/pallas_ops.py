@@ -339,18 +339,36 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 # ---------------------------------------------------------------------------
 # public API
 # ---------------------------------------------------------------------------
-def mha(q, k, v, *, causal=False, sm_scale=None, block_q=128, block_k=128,
+def _mha_tune_key(q, k, causal, interpret):
+    return (q.shape[2], k.shape[2], q.shape[3], str(q.dtype), bool(causal),
+            bool(interpret))
+
+
+def mha(q, k, v, *, causal=False, sm_scale=None, block_q=None, block_k=None,
         interpret=None):
     """Tiled flash attention on raw arrays in (B, H, S, D) layout.
 
     Pads S to the tile size and D to the 128-lane width (zero-padding is
     exact: padded head dims contribute 0 to logits; padded keys are
     masked by ``kv_len``; padded query rows are sliced off).
+
+    ``block_q``/``block_k`` default to an autotuned choice when
+    :func:`tune_mha` has cached one for this (seq, d, dtype, causal) key
+    (ref ``paddle/phi/kernels/autotune/``), else 128/128.
     """
     if interpret is None:
         interpret = _interpret_default()
     b, h, sq, d = q.shape
     skv = k.shape[2]
+    if block_q is None and block_k is None:
+        from . import autotune as _at
+        hit = _at.cache_get("flash_mha", _mha_tune_key(
+            q, k, causal, interpret)) if _at.enabled() else None
+        if hit is not None:
+            block_q, block_k = hit
+    # explicitly passed blocks always win; unset ones default to 128
+    block_q = 128 if block_q is None else block_q
+    block_k = 128 if block_k is None else block_k
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
     block_q = min(block_q, _ceil_to(sq, 8))
@@ -366,6 +384,40 @@ def mha(q, k, v, *, causal=False, sm_scale=None, block_q=128, block_k=128,
     out = _flash(qp, kp, vp, causal, sm_scale, block_q, block_k, sq, skv,
                  interpret)
     return out[:, :sq, :d].reshape(b, h, sq, d)
+
+
+def tune_mha(q, k, v, *, causal=False, interpret=None,
+             candidates=((128, 128), (256, 128), (128, 256), (256, 256),
+                         (512, 128))):
+    """Warmup autotune for :func:`mha`: eagerly time the candidate
+    (block_q, block_k) configs on REAL arrays, cache the winner keyed by
+    (seq, d, dtype, causal) so subsequent (including traced) calls pick
+    it up. Returns (best_config, timings). Candidates larger than the
+    padded sequence are deduplicated after clamping."""
+    import jax as _jax
+    from . import autotune as _at
+
+    if interpret is None:
+        interpret = _interpret_default()
+    sq, skv = q.shape[2], k.shape[2]
+    seen, todo = set(), []
+    for bq, bk in candidates:
+        clamped = (min(bq, _ceil_to(sq, 8)), min(bk, _ceil_to(skv, 8)))
+        if clamped not in seen:
+            seen.add(clamped)
+            todo.append(clamped)
+
+    def run(cfg):
+        out = mha(q, k, v, causal=causal, block_q=cfg[0], block_k=cfg[1],
+                  interpret=interpret)
+        _jax.block_until_ready(out)
+
+    best, timings = _at.time_candidates(run, todo)
+    _at.cache_put("flash_mha", _mha_tune_key(q, k, causal, interpret), best)
+    # explicit tuning is intent: turn cache consumption on (still
+    # switch-offable via incubate.autotune.set_config kernel.enable=False)
+    _at.set_enabled(True)
+    return best, timings
 
 
 def mha_reference(q, k, v, *, causal=False, sm_scale=None):

@@ -276,21 +276,31 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
     lvl = np.floor(np.log2(scale / refer_scale + 1e-8) + refer_level)
     lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
 
+    # image id of every roi (from the per-image counts, when batched)
+    if rois_num is not None:
+        counts = np.asarray(ensure_tensor(rois_num)._data,
+                            np.int64).ravel()
+        img_of = np.repeat(np.arange(counts.size), counts)
+    else:
+        counts = np.array([r.shape[0]], np.int64)
+        img_of = np.zeros(r.shape[0], np.int64)
+
     multi_rois, lvl_nums, order = [], [], []
     for l in range(min_level, max_level + 1):
         idx = np.where(lvl == l)[0]
         order.append(idx)
         multi_rois.append(Tensor(jnp.asarray(
             r[idx] if idx.size else np.zeros((0, 4), np.float32))))
-        lvl_nums.append(idx.size)
+        # per-IMAGE counts at this level, shape [N] (ref semantics)
+        lvl_nums.append(np.bincount(img_of[idx],
+                                    minlength=counts.size).astype(np.int32))
     order = np.concatenate(order) if order else np.zeros((0,), np.int64)
     restore = np.empty_like(order)
     restore[order] = np.arange(order.size)
     restore_ind = Tensor(jnp.asarray(restore[:, None].astype(np.int32)))
     if rois_num is not None:
-        return multi_rois, restore_ind, [
-            Tensor(jnp.asarray(np.asarray([n], np.int32)))
-            for n in lvl_nums]
+        return multi_rois, restore_ind, [Tensor(jnp.asarray(n))
+                                         for n in lvl_nums]
     return multi_rois, restore_ind
 
 

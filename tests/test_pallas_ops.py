@@ -114,3 +114,49 @@ def test_framework_entry_tensor_layout():
     np.testing.assert_allclose(
         np.asarray(jnp.swapaxes(out._data, 1, 2)), np.asarray(ref),
         atol=2e-3, rtol=2e-3)
+
+
+class TestKernelAutotune:
+    """Kernel-config autotune (ref: paddle/phi/kernels/autotune/): warmup
+    timing picks a block config, the cache feeds later (traced) calls."""
+
+    def test_tune_mha_populates_cache_and_outputs_match(self):
+        import jax
+        from paddle_tpu.ops import autotune as at
+        from paddle_tpu.ops.pallas_ops import mha, tune_mha, mha_reference
+        at.cache_clear()
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.randn(1, 2, 64, 16).astype(np.float32))
+        k = jnp.asarray(rng.randn(1, 2, 64, 16).astype(np.float32))
+        v = jnp.asarray(rng.randn(1, 2, 64, 16).astype(np.float32))
+        best, timings = tune_mha(q, k, v, causal=True, interpret=True,
+                                 candidates=((128, 128), (64, 64)))
+        assert best in timings and len(timings) >= 1
+        # the cached choice drives default-config calls now
+        key_hit = at.cache_get(
+            "flash_mha", (64, 64, 16, "float32", True, True))
+        assert key_hit == best
+        out = mha(q, k, v, causal=True, interpret=True)
+        ref = mha_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-3, atol=2e-3)
+
+    def test_cache_roundtrip_and_set_config(self, tmp_path):
+        from paddle_tpu.ops import autotune as at
+        from paddle_tpu.incubate import autotune as iat
+        at.cache_clear()
+        at.cache_put("flash_mha", (128, 128, 64, "bfloat16", False, False),
+                     (256, 128))
+        p = str(tmp_path / "tune.json")
+        iat.save_cache(p)
+        at.cache_clear()
+        assert at.cache_get(
+            "flash_mha", (128, 128, 64, "bfloat16", False, False)) is None
+        iat.load_cache(p)
+        assert at.cache_get(
+            "flash_mha",
+            (128, 128, 64, "bfloat16", False, False)) == (256, 128)
+        iat.set_config({"kernel": {"enable": True}})
+        assert at.enabled()
+        iat.set_config({"kernel": {"enable": False}})
+        assert not at.enabled()
