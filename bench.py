@@ -83,6 +83,21 @@ def _flops_per_step(compiled):
         return None
 
 
+def _memory_report(compiled):
+    """Per-step HBM footprint from XLA's memory analysis (the L1
+    peak-memory reporting: arguments = resident state, temp = activation
+    working set)."""
+    try:
+        ma = compiled.memory_analysis()
+        return {
+            "argument_bytes": int(getattr(ma, "argument_size_in_bytes", 0)),
+            "temp_bytes": int(getattr(ma, "temp_size_in_bytes", 0)),
+            "output_bytes": int(getattr(ma, "output_size_in_bytes", 0)),
+        }
+    except Exception:
+        return None
+
+
 def _peak_flops(device_kind):
     kind = (device_kind or "").lower()
     # longest prefix wins ("TPU v5 lite" must not match "TPU v5")
@@ -155,6 +170,7 @@ def bench_resnet(result, errors):
     result["resnet50_compile_sec"] = round(time.perf_counter() - t0, 2)
     flops = _flops_per_step(compiled)
     result["resnet50_flops_per_step"] = flops
+    result["resnet50_memory"] = _memory_report(compiled)
 
     dt = _time_compiled(compiled, (params, buffers, opt_state, x, y), 3)
     ips = RESNET_BATCH * ITERS / dt
@@ -223,6 +239,7 @@ def bench_gpt(result, errors, batch):
     result["gpt345m_compile_sec"] = round(time.perf_counter() - t0, 2)
     flops = _flops_per_step(compiled)
     result["gpt345m_flops_per_step"] = flops
+    result["gpt345m_memory"] = _memory_report(compiled)
 
     dt = _time_compiled(compiled, (params, buffers, opt_state, ids, labels),
                         3)

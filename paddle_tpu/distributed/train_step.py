@@ -308,6 +308,11 @@ def build_train_step(model: Layer, loss_fn, optimizer, mesh=None,
         with jax.set_mesh(mesh):
             return jitted(state, key, lr, x, *labels)
 
+    # expose internals for AOT inspection (bench/memory tests lower the
+    # jitted step to read XLA cost/memory analysis)
+    run.jitted = jitted
+    run.mesh = mesh
+    run.data_sharding = data_sharding
     return run, state
 
 
@@ -496,4 +501,9 @@ def _build_pipelined_train_step(model, loss_fn, optimizer, mesh, donate,
         with jax.set_mesh(mesh):
             return jitted(state, key, lr, x, *labels)
 
+    # expose internals for AOT inspection (bench/memory tests lower the
+    # jitted step to read XLA cost/memory analysis)
+    run.jitted = jitted
+    run.mesh = mesh
+    run.data_sharding = data_sharding
     return run, state

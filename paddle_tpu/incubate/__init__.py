@@ -4,3 +4,6 @@ from . import nn  # noqa: F401
 from . import models  # noqa: F401
 from . import autograd  # noqa: F401
 from . import autotune  # noqa: F401
+from . import asp  # noqa: F401
+from .optimizer import (  # noqa: F401
+    LookAhead, ModelAverage, DistributedFusedLamb)

@@ -1,0 +1,127 @@
+"""ASP — automatic structured (n:m) sparsity (ref:
+``python/paddle/incubate/asp/`` → ``asp.py`` ``prune_model``/``decorate``,
+``utils.py`` mask generation, ``supported_layer_list.py``).
+
+The reference targets Ampere sparse-tensor-core 2:4 kernels; on TPU there
+is no 2:4 hardware path, but the PRUNING WORKFLOW is hardware-neutral and
+kept at API parity: generate n:m masks for supported weights, apply them,
+and guarantee sparsity across optimizer steps by re-masking after every
+update (``OptimizerWithSparsityGuarantee``). Masked weights stay exactly
+zero, so XLA-level value-based optimizations and model-compression
+pipelines work unchanged.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...nn.layer.layers import Layer
+from ...nn.layer.common import Linear
+
+__all__ = ["calculate_density", "check_sparsity", "create_mask",
+           "prune_model", "decorate", "reset_excluded_layers",
+           "set_excluded_layers", "OptimizerWithSparsityGuarantee"]
+
+_excluded: set = set()
+_masks: dict = {}  # param name -> mask array
+
+
+def calculate_density(x) -> float:
+    """ref ``asp.py calculate_density``: nonzero fraction."""
+    arr = np.asarray(getattr(x, "_data", x))
+    return float((arr != 0).sum()) / max(arr.size, 1)
+
+
+def create_mask(tensor, func_name="mask_1d", n=2, m=4):
+    """n:m mask along the LAST axis: keep the ``n`` largest |w| of every
+    contiguous group of ``m`` (ref ``utils.py create_mask / get_mask_1d``).
+    Trailing remainder (last-dim not divisible by m) is kept dense."""
+    arr = np.asarray(getattr(tensor, "_data", tensor), np.float32)
+    last = arr.shape[-1]
+    groups = last // m
+    mask = np.ones_like(arr, dtype=np.float32)
+    if groups == 0:
+        return mask
+    head = arr[..., :groups * m].reshape(arr.shape[:-1] + (groups, m))
+    order = np.argsort(-np.abs(head), axis=-1)
+    keep = np.zeros_like(head)
+    np.put_along_axis(keep, order[..., :n], 1.0, axis=-1)
+    mask[..., :groups * m] = keep.reshape(arr.shape[:-1] + (groups * m,))
+    return mask
+
+
+def check_sparsity(tensor, func_name="check_mask_1d", n=2, m=4) -> bool:
+    """True iff every complete m-group along the last axis has at most n
+    nonzeros (ref ``utils.py check_sparsity``)."""
+    arr = np.asarray(getattr(tensor, "_data", tensor))
+    last = arr.shape[-1]
+    groups = last // m
+    if groups == 0:
+        return True
+    head = arr[..., :groups * m].reshape(-1, m)
+    return bool(((head != 0).sum(-1) <= n).all())
+
+
+def set_excluded_layers(param_names, main_program=None):
+    _excluded.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def _supported_params(model: Layer):
+    for lname, sub in model.named_sublayers(include_self=True):
+        if not isinstance(sub, Linear):
+            continue
+        for pname, p in sub.named_parameters(include_sublayers=False):
+            if pname != "weight":
+                continue
+            full = f"{lname}.{pname}" if lname else pname
+            if full in _excluded or lname in _excluded:
+                continue
+            if p.ndim == 2 and p.shape[-1] % 4 == 0:
+                yield full, p
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply n:m masks to every supported weight and register them so
+    ``decorate``-wrapped optimizers re-assert sparsity after each step
+    (ref ``asp.py prune_model``). Returns {param_name: mask}."""
+    out = {}
+    for name, p in _supported_params(model):
+        mask = create_mask(p, func_name=mask_algo, n=n, m=m)
+        p._data = p._data * jnp.asarray(mask, dtype=p._data.dtype)
+        if with_mask:
+            _masks[p.name] = mask  # keyed by tensor name (optimizer view)
+        out[name] = mask
+    return out
+
+
+class OptimizerWithSparsityGuarantee:
+    """ref ``asp.py OptimizerWithSparsityGuarantee``: after every inner
+    step, multiply the registered masks back in — dense gradient flow,
+    guaranteed-sparse weights."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+
+    def step(self):
+        self._optimizer.step()
+        for p in self._optimizer._parameter_list:
+            mask = _masks.get(p.name)
+            if mask is not None:
+                p._data = p._data * jnp.asarray(mask, dtype=p._data.dtype)
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        self._optimizer.clear_grad()
+        return None, []
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+
+def decorate(optimizer):
+    return OptimizerWithSparsityGuarantee(optimizer)

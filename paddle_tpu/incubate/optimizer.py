@@ -1,0 +1,157 @@
+"""Incubate optimizers (ref: ``python/paddle/incubate/optimizer/``):
+LookAhead, ModelAverage, DistributedFusedLamb.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..optimizer.adam import Lamb
+from ..tensor import Tensor
+
+__all__ = ["LookAhead", "ModelAverage", "DistributedFusedLamb"]
+
+
+class LookAhead:
+    """ref ``incubate/optimizer/lookahead.py LookAhead``: keep slow
+    weights; every ``k`` inner steps move them ``alpha`` toward the fast
+    weights and reset the fast weights to them."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        # snapshot slow weights NOW (ref lookahead.py: slow params start
+        # at the initial fast params, so the first sync really pulls the
+        # fast weights back toward the start)
+        self._slow = {p.name: jnp.copy(p._data)
+                      for p in inner_optimizer._parameter_list}
+        self._steps = 0
+
+    @property
+    def _parameter_list(self):
+        return self.inner_optimizer._parameter_list
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._steps += 1
+        if self._steps % self.k:
+            return
+        for p in self._parameter_list:
+            slow = self._slow.get(p.name)
+            if slow is None:  # param added after construction
+                slow = p._data
+            slow = slow + self.alpha * (p._data - slow)
+            self._slow[p.name] = slow
+            # distinct buffer: the inner optimizer's fused update DONATES
+            # p._data, which must never alias the stored slow weights
+            p._data = jnp.copy(slow).astype(p._data.dtype)
+
+    def clear_grad(self, set_to_zero=False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, []
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        out = self.inner_optimizer.state_dict()
+        for name, arr in self._slow.items():
+            out[f"{name}_lookahead_slow"] = Tensor(arr)
+        out["lookahead_steps"] = self._steps
+        return out
+
+    def set_state_dict(self, state):
+        state = dict(state)
+        self._steps = int(state.pop("lookahead_steps", 0))
+        for key in list(state):
+            if key.endswith("_lookahead_slow"):
+                v = state.pop(key)
+                self._slow[key[:-len("_lookahead_slow")]] = (
+                    v._data if isinstance(v, Tensor) else jnp.asarray(v))
+        self.inner_optimizer.set_state_dict(state)
+
+    def __getattr__(self, item):
+        return getattr(self.inner_optimizer, item)
+
+
+class ModelAverage:
+    """ref ``incubate/optimizer/modelaverage.py``: maintain a WINDOWED
+    running average of parameters; ``apply()`` swaps it in for
+    evaluation, ``restore()`` swaps back.
+
+    Windowing follows the reference's block scheme (sum_1/sum_2 rotation):
+    two accumulator blocks of at most ``max_average_window`` steps each;
+    when the current block fills, it displaces the previous one — the
+    average always covers the most recent ``(max_average_window,
+    2*max_average_window]`` steps instead of the whole run."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._params = list(parameters or [])
+        self._rate = average_window_rate
+        self._min_window = int(min_average_window)
+        self._max_window = int(max_average_window)
+        zeros = {p.name: jnp.zeros_like(p._data.astype(jnp.float32))
+                 for p in self._params}
+        self._sum_cur = dict(zeros)
+        self._sum_old = {k: v for k, v in zeros.items()}
+        self._cnt_cur = 0
+        self._cnt_old = 0
+        self._backup = None
+
+    def step(self):
+        if self._cnt_cur >= self._max_window:
+            self._sum_old = self._sum_cur
+            self._cnt_old = self._cnt_cur
+            self._sum_cur = {p.name: jnp.zeros_like(
+                p._data.astype(jnp.float32)) for p in self._params}
+            self._cnt_cur = 0
+        for p in self._params:
+            self._sum_cur[p.name] = self._sum_cur[p.name] + p._data.astype(
+                jnp.float32)
+        self._cnt_cur += 1
+
+    def apply(self, executor=None, need_restore=True):
+        total = self._cnt_cur + self._cnt_old
+        if not total:
+            return
+        self._backup = {p.name: p._data for p in self._params}
+        for p in self._params:
+            avg = (self._sum_cur[p.name] + self._sum_old[p.name]) / total
+            p._data = avg.astype(p._data.dtype)
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p in self._params:
+            p._data = self._backup[p.name]
+        self._backup = None
+
+    def minimize(self, loss, **kw):
+        self.step()
+
+
+class DistributedFusedLamb(Lamb):
+    """ref ``incubate/optimizer/distributed_fused_lamb.py``: the
+    reference fuses LAMB updates into custom CUDA kernels and shards the
+    optimizer state across ranks. TPU-native: XLA fuses the whole
+    tree-update already (optimizer._update is one compiled kernel), and
+    the ZeRO machinery partitions state over the ``sharding`` mesh axis —
+    so this is Lamb with stage-2 sharding on by default."""
+
+    def __init__(self, *args, **kwargs):
+        kwargs.pop("clip_after_allreduce", None)
+        kwargs.pop("is_grad_scaled_by_nranks", None)
+        kwargs.pop("use_master_param_norm", None)
+        kwargs.pop("gradient_accumulation_steps", None)
+        kwargs.pop("use_master_acc_grad", None)
+        super().__init__(*args, **kwargs)
+        self._group_sharded_level = "os_g"

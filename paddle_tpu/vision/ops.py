@@ -9,6 +9,7 @@ import jax.numpy as jnp
 
 from ..tensor import Tensor
 from ..ops.op_utils import ensure_tensor, nary, unary as _unary
+from ..nn.layer.layers import Layer
 
 __all__ = ["box_coder", "box_area", "box_iou", "nms", "roi_align",
            "roi_pool", "generate_proposals", "distribute_fpn_proposals",
@@ -369,20 +370,183 @@ def yolo_loss(*args, **kwargs):
     raise NotImplementedError("yolo_loss: planned")
 
 
-def deform_conv2d(*args, **kwargs):
-    raise NotImplementedError(
-        "deform_conv2d: planned as gather-based sampling + matmul")
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1/v2 (ref ``python/paddle/vision/ops.py
+    deform_conv2d`` → ``phi/kernels/.../deformable_conv_kernel``).
+
+    TPU-native: one gather-based bilinear sampling of all kernel taps
+    (the "deformed im2col") followed by one einsum with the weight — XLA
+    maps both onto gathers + the MXU instead of a custom CUDA kernel.
+    ``mask`` (modulated, v2) multiplies the sampled values.
+
+    x ``[N, Cin, H, W]``; offset ``[N, 2*dg*kh*kw, Hout, Wout]`` ordered
+    (dy, dx) per tap; weight ``[Cout, Cin/groups, kh, kw]``.
+    """
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    sh, sw = _pair(stride)
+    ph, pw = _pair(padding)
+    dh, dw = _pair(dilation)
+
+    def f(xd, off, w, *rest):
+        it = iter(rest)
+        b = next(it) if bias is not None else None
+        m = next(it) if mask is not None else None
+        N, Cin, H, W = xd.shape
+        Cout, Cin_g, kh, kw = w.shape
+        Ho = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        Wo = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        dg = deformable_groups
+        K = kh * kw
+        off = off.reshape(N, dg, K, 2, Ho, Wo)
+
+        # base sampling grid per tap: [K, Ho, Wo]
+        oy = jnp.arange(Ho) * sh - ph
+        ox = jnp.arange(Wo) * sw - pw
+        ky, kx = jnp.meshgrid(jnp.arange(kh) * dh, jnp.arange(kw) * dw,
+                              indexing="ij")
+        base_y = ky.reshape(K, 1, 1) + oy[None, :, None]
+        base_x = kx.reshape(K, 1, 1) + ox[None, None, :]
+        # deformed positions: [N, dg, K, Ho, Wo]
+        py = base_y[None, None] + off[:, :, :, 0]
+        px = base_x[None, None] + off[:, :, :, 1]
+
+        # bilinear sample with zero padding outside
+        y0 = jnp.floor(py)
+        x0 = jnp.floor(px)
+        wy = py - y0
+        wx = px - x0
+
+        def tap(yi, xi):
+            inside = ((yi >= 0) & (yi < H) & (xi >= 0) & (xi < W))
+            yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+            xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+            # per deformable group, gather its channel slice
+            cg = Cin // dg
+            xg = xd.reshape(N, dg, cg, H, W)
+            # vals[n, g, c, k, i, j] = xg[n, g, c, yc[n,g,k,i,j], xc[...]]
+            flat = xg.reshape(N, dg, cg, H * W)
+            idx = (yc * W + xc).reshape(N, dg, 1, -1)
+            got = jnp.take_along_axis(
+                flat, jnp.broadcast_to(idx, (N, dg, cg, idx.shape[-1])),
+                axis=3)
+            got = got.reshape(N, dg, cg, K, Ho, Wo)
+            return got * inside[:, :, None].astype(got.dtype)
+
+        v = (tap(y0, x0) * ((1 - wy) * (1 - wx))[:, :, None]
+             + tap(y0, x0 + 1) * ((1 - wy) * wx)[:, :, None]
+             + tap(y0 + 1, x0) * (wy * (1 - wx))[:, :, None]
+             + tap(y0 + 1, x0 + 1) * (wy * wx)[:, :, None])
+        if m is not None:
+            mm = m.reshape(N, dg, 1, K, Ho, Wo)
+            v = v * mm
+        # [N, Cin, K, Ho, Wo] -> grouped einsum with weight
+        v = v.reshape(N, Cin, kh, kw, Ho, Wo)
+        v = v.reshape(N, groups, Cin // groups, kh, kw, Ho, Wo)
+        wg = w.reshape(groups, Cout // groups, Cin_g, kh, kw)
+        out = jnp.einsum("ngcrsij,gocrs->ngoij", v, wg)
+        out = out.reshape(N, Cout, Ho, Wo)
+        if b is not None:
+            out = out + b.reshape(1, -1, 1, 1)
+        return out
+
+    args = [ensure_tensor(x), ensure_tensor(offset), ensure_tensor(weight)]
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+    if mask is not None:
+        args.append(ensure_tensor(mask))
+    return nary(f, args, name="deform_conv2d")
 
 
-class DeformConv2D:
-    def __init__(self, *a, **k):
-        raise NotImplementedError("DeformConv2D: planned")
+class DeformConv2D(Layer):
+    """Layer wrapper over :func:`deform_conv2d` (ref vision/ops.py
+    DeformConv2D) — a real Layer so its parameters register with
+    ``parameters()``/``state_dict()`` and follow the framework RNG."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ..nn import initializer as I
+        kh, kw = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        fan_in = in_channels // groups * kh * kw
+        bound = 1.0 / np.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, kh, kw],
+            attr=weight_attr, default_initializer=I.Uniform(-bound, bound))
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                [out_channels], attr=bias_attr, is_bias=True,
+                default_initializer=I.Uniform(-bound, bound))
+        self._cfg = dict(stride=stride, padding=padding, dilation=dilation,
+                         deformable_groups=deformable_groups, groups=groups)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, bias=self.bias,
+                             mask=mask, **self._cfg)
 
 
-def psroi_pool(*args, **kwargs):
-    raise NotImplementedError("psroi_pool: planned")
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (ref ``python/paddle/vision/ops.py
+    psroi_pool`` → R-FCN): input channels are ``C_out * ph * pw``; output
+    bin (i, j) of class-channel c average-pools ITS OWN channel slice
+    ``c*ph*pw + i*pw + j`` over the bin's integer window. Pure jnp
+    (bin-membership masks + one einsum), so it is differentiable and
+    jit-compatible like :func:`roi_align`."""
+    ph, pw = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+    bn = np.asarray(ensure_tensor(boxes_num)._data)
+    batch_idx = np.repeat(np.arange(len(bn)), bn)
+
+    def f(feat, rois):
+        N, C, H, W = feat.shape
+        Co = C // (ph * pw)
+        # channel owned by (class c, bin i, bin j)
+        ch_idx = (np.arange(Co)[:, None, None] * ph * pw
+                  + np.arange(ph)[None, :, None] * pw
+                  + np.arange(pw)[None, None, :])  # [Co, ph, pw]
+        outs = []
+        for r in range(rois.shape[0]):
+            fmap = feat[batch_idx[r]].astype(jnp.float32)
+            x0 = rois[r, 0] * spatial_scale
+            y0 = rois[r, 1] * spatial_scale
+            x1 = rois[r, 2] * spatial_scale
+            y1 = rois[r, 3] * spatial_scale
+            rh = jnp.maximum(y1 - y0, 0.1) / ph
+            rw = jnp.maximum(x1 - x0, 0.1) / pw
+            hh = jnp.arange(H, dtype=jnp.float32)
+            ww = jnp.arange(W, dtype=jnp.float32)
+            i_ = jnp.arange(ph, dtype=jnp.float32)[:, None]
+            j_ = jnp.arange(pw, dtype=jnp.float32)[:, None]
+            # integer windows [floor(start), ceil(end)) per bin
+            my = ((hh[None, :] >= jnp.floor(y0 + i_ * rh))
+                  & (hh[None, :] < jnp.ceil(y0 + (i_ + 1) * rh))
+                  & (hh[None, :] >= 0)).astype(jnp.float32)   # [ph, H]
+            mx = ((ww[None, :] >= jnp.floor(x0 + j_ * rw))
+                  & (ww[None, :] < jnp.ceil(x0 + (j_ + 1) * rw))
+                  & (ww[None, :] >= 0)).astype(jnp.float32)   # [pw, W]
+            counts = my.sum(1)[:, None] * mx.sum(1)[None, :]  # [ph, pw]
+            sums = jnp.einsum("chw,ih,jw->cij", fmap, my, mx)  # [C,ph,pw]
+            avg = sums / jnp.maximum(counts, 1.0)[None]
+            outs.append(avg[ch_idx, np.arange(ph)[None, :, None],
+                            np.arange(pw)[None, None, :]])
+        return (jnp.stack(outs).astype(feat.dtype) if outs
+                else jnp.zeros((0, Co, ph, pw), feat.dtype))
+
+    return nary(f, [ensure_tensor(x), ensure_tensor(boxes)],
+                name="psroi_pool")
 
 
 class PSRoIPool:
-    def __init__(self, *a, **k):
-        raise NotImplementedError("PSRoIPool: planned")
+    def __init__(self, output_size, spatial_scale=1.0):
+        self._size = output_size
+        self._scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._size, self._scale)

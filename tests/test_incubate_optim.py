@@ -1,0 +1,167 @@
+"""Incubate optimizers + ASP structured sparsity (ref:
+``python/paddle/incubate/optimizer/lookahead.py``, ``modelaverage.py``,
+``distributed_fused_lamb.py``, ``python/paddle/incubate/asp/``)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.incubate import LookAhead, ModelAverage, DistributedFusedLamb
+from paddle_tpu.incubate import asp
+
+
+@pytest.fixture(autouse=True)
+def _clean_asp():
+    yield
+    asp.reset_excluded_layers()
+    asp._masks.clear()
+
+
+def _problem(seed=0):
+    pt.seed(seed)
+    net = pt.nn.Linear(8, 8)
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 8).astype(np.float32)
+    y = rng.randn(32, 8).astype(np.float32)
+
+    def step(opt):
+        loss = ((net(pt.to_tensor(x)) - pt.to_tensor(y)) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return float(loss)
+
+    return net, step
+
+
+class TestLookAhead:
+    def test_slow_weights_sync_every_k(self):
+        net, step = _problem()
+        inner = pt.optimizer.SGD(learning_rate=0.1,
+                                 parameters=net.parameters())
+        opt = LookAhead(inner, alpha=0.5, k=3)
+        losses = [step(opt) for _ in range(12)]
+        assert losses[-1] < losses[0]
+        # slow weights exist for every param after a sync point
+        assert set(opt._slow) == {p.name for p in net.parameters()}
+
+    def test_state_dict_roundtrip(self):
+        net, step = _problem()
+        inner = pt.optimizer.SGD(learning_rate=0.1,
+                                 parameters=net.parameters())
+        opt = LookAhead(inner, alpha=0.5, k=2)
+        for _ in range(4):
+            step(opt)
+        sd = opt.state_dict()
+        assert sd["lookahead_steps"] == 4
+
+        net2, _ = _problem(seed=1)
+        opt2 = LookAhead(pt.optimizer.SGD(learning_rate=0.1,
+                                          parameters=net2.parameters()),
+                         alpha=0.5, k=2)
+        opt2.set_state_dict(sd)
+        assert opt2._steps == 4 and opt2._slow
+
+
+class TestModelAverage:
+    def test_apply_restore(self):
+        net, step = _problem()
+        inner = pt.optimizer.SGD(learning_rate=0.1,
+                                 parameters=net.parameters())
+        avg = ModelAverage(parameters=net.parameters())
+        for _ in range(5):
+            step(inner)
+            avg.step()
+        current = np.asarray(net.weight._data).copy()
+        avg.apply()
+        averaged = np.asarray(net.weight._data)
+        assert not np.allclose(current, averaged)
+        avg.restore()
+        np.testing.assert_array_equal(np.asarray(net.weight._data), current)
+
+
+class TestDistributedFusedLamb:
+    def test_trains_and_defaults_to_zero2(self):
+        net, step = _problem()
+        opt = DistributedFusedLamb(learning_rate=0.01,
+                                   parameters=net.parameters(),
+                                   clip_after_allreduce=True)
+        assert opt._group_sharded_level == "os_g"
+        losses = [step(opt) for _ in range(10)]
+        assert losses[-1] < losses[0]
+
+
+class TestASP:
+    def test_mask_is_2_of_4(self):
+        w = np.random.RandomState(0).randn(8, 8).astype(np.float32)
+        mask = asp.create_mask(w)
+        assert mask.shape == w.shape
+        assert asp.check_sparsity(w * mask)
+        assert abs(asp.calculate_density(w * mask) - 0.5) < 1e-6
+        # kept entries are the 2 largest |w| of each group of 4
+        g = (np.abs(w).reshape(8, 2, 4)).argsort(-1)[..., 2:]
+        kept = np.zeros((8, 2, 4))
+        np.put_along_axis(kept, g, 1.0, -1)
+        np.testing.assert_array_equal(mask.reshape(8, 2, 4), kept)
+
+    def test_prune_model_and_sparsity_guarantee(self):
+        net, step = _problem()
+        masks = asp.prune_model(net)
+        assert masks and asp.check_sparsity(net.weight)
+        opt = asp.decorate(pt.optimizer.AdamW(
+            learning_rate=0.01, parameters=net.parameters()))
+        losses = [step(opt) for _ in range(5)]
+        assert losses[-1] < losses[0]
+        # sparsity survived five dense-gradient updates
+        assert asp.check_sparsity(net.weight)
+        assert abs(asp.calculate_density(net.weight) - 0.5) < 1e-6
+
+    def test_excluded_layers(self):
+        net, _ = _problem()
+        asp.set_excluded_layers([""])  # the root Linear itself
+        masks = asp.prune_model(net)
+        assert not masks
+
+
+def test_lookahead_first_sync_pulls_back():
+    """Slow weights snapshot the INITIAL params: the first sync moves the
+    fast weights alpha of the way back toward the start."""
+    net, step = _problem()
+    w0 = np.asarray(net.weight._data).copy()
+    inner = pt.optimizer.SGD(learning_rate=0.1,
+                             parameters=net.parameters())
+    opt = LookAhead(inner, alpha=0.5, k=2)
+    step(opt)                       # step 1: fast only
+    w_fast = np.asarray(net.weight._data).copy()
+    step(opt)                       # step 2: sync point
+    w_sync = np.asarray(net.weight._data)
+    # closer to w0 than a pure fast trajectory would be
+    assert np.linalg.norm(w_sync - w0) < np.linalg.norm(w_fast - w0) + 1e-9
+
+
+def test_model_average_windowing():
+    """Only the most recent <= 2*max_average_window steps contribute."""
+    p = pt.to_tensor(np.zeros((2,), np.float32))
+    p.name = "p"
+    avg = ModelAverage(parameters=[p], max_average_window=3)
+    # 9 steps with values 1..9: window keeps blocks {4,5,6} + {7,8,9}
+    for v in range(1, 10):
+        p._data = pt.to_tensor(np.full((2,), float(v), np.float32))._data
+        avg.step()
+    avg.apply()
+    got = float(np.asarray(p._data)[0])
+    assert abs(got - np.mean([4, 5, 6, 7, 8, 9])) < 1e-6, got
+    avg.restore()
+    assert float(np.asarray(p._data)[0]) == 9.0
+
+
+def test_deform_conv2d_layer_registers_params():
+    import paddle_tpu.vision.ops as V
+    pt.seed(0)
+    layer = V.DeformConv2D(2, 3, 3)
+    names = {n for n, _ in layer.named_parameters()}
+    assert names == {"weight", "bias"}
+    assert "weight" in layer.state_dict()
+    # framework RNG drives init: two layers differ
+    layer2 = V.DeformConv2D(2, 3, 3)
+    assert not np.allclose(np.asarray(layer.weight._data),
+                           np.asarray(layer2.weight._data))

@@ -1,0 +1,236 @@
+"""Systematic per-op OpTest corpus (ref: ``test/legacy_test/
+eager_op_test.py:377`` + the per-op tolerance tables in
+``test/white_list/op_accuracy_white_list.py``).
+
+One declarative table drives three checks per op:
+ - float32 output vs numpy reference (eager AND jitted paths),
+ - bfloat16 output vs the float32 numpy reference at the op's bf16
+   tolerance (the TPU-first accuracy contract),
+ - float32 analytic-vs-finite-difference gradient (where differentiable).
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+import paddle_tpu.nn.functional as F
+from paddle_tpu import Tensor
+from op_test import check_output, check_grad
+
+
+def _sp(*shape, seed=0, pos=False, lo=-2.0, hi=2.0):
+    rng = np.random.RandomState(seed)
+    a = rng.uniform(lo, hi, shape).astype(np.float32)
+    if pos:
+        a = np.abs(a) + 0.5
+    return a
+
+
+def _softmax_np(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def _erf_np(x):
+    from math import erf
+    return np.vectorize(erf)(x).astype(np.float64)
+
+
+# (name, op_fn, np_ref, inputs, {opts})
+# opts: grad=False to skip FD check; bf16_atol/bf16_rtol overrides;
+#       atol/rtol f32 overrides; grad_atol for noisy pullbacks.
+OPS = [
+    # -- activations --------------------------------------------------------
+    ("relu", F.relu, lambda x: np.maximum(x, 0), [_sp(3, 4)], {}),
+    ("relu6", F.relu6, lambda x: np.clip(x, 0, 6), [_sp(3, 4, hi=8)], {}),
+    ("sigmoid", F.sigmoid, lambda x: 1 / (1 + np.exp(-x)), [_sp(3, 4)], {}),
+    ("tanh", F.tanh, np.tanh, [_sp(3, 4)], {}),
+    ("silu", F.silu, lambda x: x / (1 + np.exp(-x)), [_sp(3, 4)], {}),
+    ("softplus", F.softplus, lambda x: np.log1p(np.exp(x)), [_sp(3, 4)],
+     {}),
+    ("softsign", F.softsign, lambda x: x / (1 + np.abs(x)), [_sp(3, 4)],
+     {}),
+    ("gelu", F.gelu,
+     lambda x: 0.5 * x * (1 + _erf_np(x / np.sqrt(2))), [_sp(3, 4)], {}),
+    ("elu", F.elu,
+     lambda x: np.where(x > 0, x, np.exp(np.minimum(x, 0)) - 1),
+     [_sp(3, 4)], {}),
+    ("leaky_relu", F.leaky_relu,
+     lambda x: np.where(x > 0, x, 0.01 * x), [_sp(3, 4)], {}),
+    ("hardtanh", F.hardtanh, lambda x: np.clip(x, -1, 1), [_sp(3, 4)],
+     {"grad": False}),  # FD unstable at the clip kinks
+    ("hardsigmoid", F.hardsigmoid,
+     lambda x: np.clip(x / 6 + 0.5, 0, 1), [_sp(3, 4, hi=8, lo=-8)],
+     {"grad": False}),
+    ("hardswish", F.hardswish,
+     lambda x: x * np.clip(x + 3, 0, 6) / 6, [_sp(3, 4, hi=5, lo=-5)],
+     {"grad": False}),
+    ("mish", F.mish,
+     lambda x: x * np.tanh(np.log1p(np.exp(x))), [_sp(3, 4)], {}),
+    ("log_sigmoid", F.log_sigmoid,
+     lambda x: -np.log1p(np.exp(-x)), [_sp(3, 4)], {}),
+    ("tanhshrink", F.tanhshrink, lambda x: x - np.tanh(x), [_sp(3, 4)],
+     {"grad_atol": 2e-2}),
+    ("hardshrink", F.hardshrink,
+     lambda x: np.where(np.abs(x) > 0.5, x, 0), [_sp(3, 4)],
+     {"grad": False}),
+    ("softshrink", F.softshrink,
+     lambda x: np.where(x > 0.5, x - 0.5, np.where(x < -0.5, x + 0.5, 0)),
+     [_sp(3, 4)], {"grad": False}),
+    ("selu", F.selu,
+     lambda x: 1.0507009873554805 * np.where(
+         x > 0, x, 1.6732632423543772 * (np.exp(np.minimum(x, 0)) - 1)),
+     [_sp(3, 4)], {}),
+    ("celu", F.celu,
+     lambda x: np.maximum(x, 0) + np.minimum(
+         0, np.exp(np.minimum(x, 0)) - 1), [_sp(3, 4)], {}),
+    ("softmax", F.softmax, _softmax_np, [_sp(3, 5)], {}),
+    ("log_softmax", F.log_softmax,
+     lambda x: np.log(_softmax_np(x)), [_sp(3, 5)], {}),
+    # -- elementwise math ---------------------------------------------------
+    ("add", pt.add, np.add, [_sp(3, 4), _sp(3, 4, seed=1)], {}),
+    ("subtract", pt.subtract, np.subtract,
+     [_sp(3, 4), _sp(3, 4, seed=1)], {}),
+    ("multiply", pt.multiply, np.multiply,
+     [_sp(3, 4), _sp(3, 4, seed=1)], {}),
+    ("divide", pt.divide, np.divide,
+     [_sp(3, 4), _sp(3, 4, seed=1, pos=True)], {}),
+    ("pow", lambda x: pt.pow(x, 3.0), lambda x: x ** 3, [_sp(3, 4)], {}),
+    ("exp", pt.exp, np.exp, [_sp(3, 4)], {}),
+    ("log", pt.log, np.log, [_sp(3, 4, pos=True)], {}),
+    ("log2", pt.log2, np.log2, [_sp(3, 4, pos=True)], {}),
+    ("log1p", pt.log1p, np.log1p, [_sp(3, 4, pos=True)], {}),
+    ("sqrt", pt.sqrt, np.sqrt, [_sp(3, 4, pos=True)], {}),
+    ("rsqrt", pt.rsqrt, lambda x: 1 / np.sqrt(x), [_sp(3, 4, pos=True)],
+     {}),
+    ("abs", pt.abs, np.abs, [_sp(3, 4)], {"grad": False}),
+    ("sin", pt.sin, np.sin, [_sp(3, 4)], {}),
+    ("cos", pt.cos, np.cos, [_sp(3, 4)], {}),
+    ("tan", pt.tan, np.tan, [_sp(3, 4, hi=1.2, lo=-1.2)], {}),
+    ("asin", pt.asin, np.arcsin, [_sp(3, 4, hi=0.9, lo=-0.9)], {}),
+    ("acos", pt.acos, np.arccos, [_sp(3, 4, hi=0.9, lo=-0.9)], {}),
+    ("atan", pt.atan, np.arctan, [_sp(3, 4)], {}),
+    ("sinh", pt.sinh, np.sinh, [_sp(3, 4)], {}),
+    ("cosh", pt.cosh, np.cosh, [_sp(3, 4)], {}),
+    ("expm1", pt.expm1, np.expm1, [_sp(3, 4)], {}),
+    ("floor", pt.floor, np.floor, [_sp(3, 4)], {"grad": False}),
+    ("ceil", pt.ceil, np.ceil, [_sp(3, 4)], {"grad": False}),
+    ("round", pt.round, np.round, [_sp(3, 4)], {"grad": False}),
+    ("sign", pt.sign, np.sign, [_sp(3, 4)], {"grad": False}),
+    ("clip", lambda x: pt.clip(x, -1.0, 1.0),
+     lambda x: np.clip(x, -1, 1), [_sp(3, 4)], {"grad": False}),
+    ("maximum", pt.maximum, np.maximum,
+     [_sp(3, 4), _sp(3, 4, seed=1)], {"grad": False}),
+    ("minimum", pt.minimum, np.minimum,
+     [_sp(3, 4), _sp(3, 4, seed=1)], {"grad": False}),
+    ("reciprocal", pt.reciprocal, lambda x: 1 / x,
+     [_sp(3, 4, pos=True)], {}),
+    ("square", pt.square, np.square, [_sp(3, 4)], {}),
+    ("logit", pt.logit, lambda x: np.log(x / (1 - x)),
+     [_sp(3, 4, hi=0.9, lo=0.1)], {}),
+    # -- reductions ---------------------------------------------------------
+    ("sum", pt.sum, np.sum, [_sp(3, 4)], {}),
+    ("mean", pt.mean, np.mean, [_sp(3, 4)], {}),
+    ("max", pt.max, np.max, [_sp(3, 4)], {"grad": False}),
+    ("min", pt.min, np.min, [_sp(3, 4)], {"grad": False}),
+    ("prod", pt.prod, np.prod, [_sp(2, 3)], {"grad_atol": 2e-2}),
+    ("logsumexp", pt.logsumexp,
+     lambda x: np.log(np.exp(x).sum()), [_sp(3, 4)], {}),
+    ("var", pt.var, lambda x: np.var(x, ddof=1), [_sp(3, 4)], {}),
+    ("std", pt.std, lambda x: np.std(x, ddof=1), [_sp(3, 4)], {}),
+    ("sum_axis", lambda x: pt.sum(x, axis=1),
+     lambda x: np.sum(x, axis=1), [_sp(3, 4)], {}),
+    ("cumsum", lambda x: pt.cumsum(x, axis=1),
+     lambda x: np.cumsum(x, axis=1), [_sp(3, 4)], {}),
+    # -- linalg / matmul ----------------------------------------------------
+    ("matmul", pt.matmul, np.matmul, [_sp(3, 4), _sp(4, 5, seed=1)],
+     {"bf16_atol": 5e-2, "bf16_rtol": 5e-2}),
+    ("bmm", pt.bmm, np.matmul, [_sp(2, 3, 4), _sp(2, 4, 5, seed=1)],
+     {"bf16_atol": 5e-2, "bf16_rtol": 5e-2}),
+    ("t_2d", pt.t, np.transpose, [_sp(3, 4)], {}),
+    # -- shape ops ----------------------------------------------------------
+    ("reshape", lambda x: pt.reshape(x, [4, 3]),
+     lambda x: np.reshape(x, (4, 3)), [_sp(3, 4)], {}),
+    ("transpose", lambda x: pt.transpose(x, [1, 0]),
+     lambda x: np.transpose(x), [_sp(3, 4)], {}),
+    ("squeeze", lambda x: pt.squeeze(x, axis=1),
+     lambda x: np.squeeze(x, 1), [_sp(3, 1, 4)], {}),
+    ("unsqueeze", lambda x: pt.unsqueeze(x, axis=0),
+     lambda x: x[None], [_sp(3, 4)], {}),
+    ("flip", lambda x: pt.flip(x, axis=[1]),
+     lambda x: x[:, ::-1].copy(), [_sp(3, 4)], {}),
+    ("roll", lambda x: pt.roll(x, 1, axis=1),
+     lambda x: np.roll(x, 1, 1), [_sp(3, 4)], {}),
+    ("tile", lambda x: pt.tile(x, [2, 1]),
+     lambda x: np.tile(x, (2, 1)), [_sp(3, 4)], {}),
+    ("concat2", lambda a, b: pt.concat([a, b], axis=1),
+     lambda a, b: np.concatenate([a, b], 1),
+     [_sp(3, 4), _sp(3, 2, seed=1)], {}),
+    ("stack2", lambda a, b: pt.stack([a, b], axis=0),
+     lambda a, b: np.stack([a, b], 0),
+     [_sp(3, 4), _sp(3, 4, seed=1)], {}),
+    # paddle semantics: len(pad)==2*ndim pads FIRST dim to last
+    # ([d0_l, d0_r, d1_l, d1_r]), unlike torch's last-dim-first
+    ("pad2d", lambda x: F.pad(x, [1, 1, 2, 0]),
+     lambda x: np.pad(x, ((1, 1), (2, 0))), [_sp(3, 4)], {}),
+    ("where", lambda c, a, b: pt.where(c > 0, a, b),
+     lambda c, a, b: np.where(c > 0, a, b),
+     [_sp(3, 4, seed=2), _sp(3, 4), _sp(3, 4, seed=1)], {"grad": False}),
+    # -- losses -------------------------------------------------------------
+    ("mse_loss", F.mse_loss,
+     lambda x, y: np.mean((x - y) ** 2),
+     [_sp(3, 4), _sp(3, 4, seed=1)], {}),
+    ("l1_loss", F.l1_loss,
+     lambda x, y: np.mean(np.abs(x - y)),
+     [_sp(3, 4), _sp(3, 4, seed=1)], {"grad": False}),
+    ("smooth_l1", F.smooth_l1_loss,
+     lambda x, y: np.mean(np.where(np.abs(x - y) < 1.0,
+                                   0.5 * (x - y) ** 2,
+                                   np.abs(x - y) - 0.5)),
+     [_sp(3, 4), _sp(3, 4, seed=1)], {}),
+    ("bce_with_logits", F.binary_cross_entropy_with_logits,
+     lambda x, y: np.mean(np.maximum(x, 0) - x * y + np.log1p(
+         np.exp(-np.abs(x)))),
+     [_sp(3, 4), (_sp(3, 4, seed=1) > 0).astype(np.float32)], {}),
+    ("kl_div", lambda a, b: F.kl_div(a, b, reduction="mean"),
+     lambda a, b: np.mean(b * (np.log(b) - a)),
+     [np.log(_sp(3, 4, pos=True) / 4), _sp(3, 4, seed=1, pos=True) / 4],
+     {"grad": False}),
+    ("cosine_similarity", F.cosine_similarity,
+     lambda a, b: (a * b).sum(-1) / (
+         np.linalg.norm(a, axis=-1) * np.linalg.norm(b, axis=-1)),
+     [_sp(3, 4), _sp(3, 4, seed=1)], {}),
+]
+
+_IDS = [row[0] for row in OPS]
+
+
+@pytest.mark.parametrize("name,op,ref,inputs,opts", OPS, ids=_IDS)
+def test_output_float32(name, op, ref, inputs, opts):
+    check_output(op, ref, inputs,
+                 atol=opts.get("atol", 1e-5), rtol=opts.get("rtol", 1e-5))
+
+
+@pytest.mark.parametrize("name,op,ref,inputs,opts", OPS, ids=_IDS)
+def test_output_bfloat16(name, op, ref, inputs, opts):
+    """bf16 inputs vs the float32 numpy oracle at the op's bf16
+    tolerance (default 2e-2 — one bf16 ulp at unit scale)."""
+    tensors = [Tensor(jnp.asarray(a).astype(jnp.bfloat16)) for a in inputs]
+    out = op(*tensors)
+    out = out[0] if isinstance(out, (list, tuple)) else out
+    got = np.asarray(out._data.astype(jnp.float32), dtype=np.float64)
+    want = np.asarray(ref(*[np.asarray(a) for a in inputs]),
+                      dtype=np.float64)
+    np.testing.assert_allclose(
+        got, want, atol=opts.get("bf16_atol", 2e-2),
+        rtol=opts.get("bf16_rtol", 2e-2), err_msg=f"bf16 {name}")
+
+
+@pytest.mark.parametrize(
+    "name,op,ref,inputs,opts",
+    [row for row in OPS if row[4].get("grad", True)],
+    ids=[row[0] for row in OPS if row[4].get("grad", True)])
+def test_grad_float32(name, op, ref, inputs, opts):
+    check_grad(op, inputs, atol=opts.get("grad_atol", 5e-3),
+               rtol=opts.get("grad_atol", 5e-3))

@@ -205,3 +205,98 @@ class TestYoloBox:
         np.testing.assert_allclose(np.asarray(b1._data),
                                    np.asarray(b0._data), rtol=1e-5)
         assert not np.allclose(np.asarray(s1._data), np.asarray(s0._data))
+
+
+class TestDeformConv2d:
+    def test_zero_offsets_match_plain_conv(self):
+        """With zero offsets (and no mask) deformable conv IS standard
+        convolution — oracle: F.conv2d."""
+        rng = np.random.RandomState(7)
+        x = rng.randn(2, 4, 8, 8).astype(np.float32)
+        w = rng.randn(6, 4, 3, 3).astype(np.float32)
+        off = np.zeros((2, 2 * 9, 6, 6), np.float32)
+        got = V.deform_conv2d(Tensor(x), Tensor(off), Tensor(w))
+        want = pt.nn.functional.conv2d(Tensor(x), Tensor(w))
+        np.testing.assert_allclose(np.asarray(got._data),
+                                   np.asarray(want._data),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_integer_offset_shifts_sampling(self):
+        """An integer (dy, dx) = (0, 1) offset on every tap equals
+        convolving the input shifted left by one pixel."""
+        rng = np.random.RandomState(8)
+        x = rng.randn(1, 2, 8, 8).astype(np.float32)
+        w = rng.randn(3, 2, 3, 3).astype(np.float32)
+        off = np.zeros((1, 2 * 9, 6, 6), np.float32)
+        off[:, 1::2] = 1.0  # dx = +1 on every tap
+        got = V.deform_conv2d(Tensor(x), Tensor(off), Tensor(w))
+        x_shift = np.zeros_like(x)
+        x_shift[..., :-1] = x[..., 1:]
+        want = pt.nn.functional.conv2d(Tensor(x_shift), Tensor(w))
+        # interior columns identical (border columns touch zero padding)
+        np.testing.assert_allclose(
+            np.asarray(got._data)[..., :-1],
+            np.asarray(want._data)[..., :-1], rtol=1e-4, atol=1e-4)
+
+    def test_modulated_mask_and_grads(self):
+        rng = np.random.RandomState(9)
+        x = Tensor(rng.randn(1, 2, 6, 6).astype(np.float32))
+        x.stop_gradient = False
+        w = Tensor(rng.randn(2, 2, 3, 3).astype(np.float32))
+        w.stop_gradient = False
+        off = Tensor((rng.randn(1, 18, 4, 4) * 0.5).astype(np.float32))
+        off.stop_gradient = False
+        mask = Tensor(np.full((1, 9, 4, 4), 0.5, np.float32))
+        out = V.deform_conv2d(x, off, w, mask=mask)
+        out.sum().backward()
+        assert x.grad is not None and np.abs(
+            np.asarray(x.grad._data)).sum() > 0
+        assert w.grad is not None and off.grad is not None
+        # mask=0.5 halves the output vs mask=None
+        out2 = V.deform_conv2d(x, off, w)
+        np.testing.assert_allclose(np.asarray(out._data) * 2,
+                                   np.asarray(out2._data),
+                                   rtol=1e-4, atol=1e-4)
+
+
+class TestPSRoIPool:
+    def test_position_sensitive_channel_selection(self):
+        """Oracle: explicit numpy loop over bins/channels."""
+        rng = np.random.RandomState(10)
+        ph = pw = 2
+        Co = 3
+        x = rng.randn(1, Co * ph * pw, 8, 8).astype(np.float32)
+        rois = np.array([[0.0, 0.0, 8.0, 8.0],
+                         [2.0, 2.0, 6.0, 6.0]], np.float32)
+        out = V.psroi_pool(Tensor(x), Tensor(rois),
+                           Tensor(np.array([2], np.int32)), 2)
+        got = np.asarray(out._data)
+        assert got.shape == (2, Co, 2, 2)
+
+        def oracle(box):
+            o = np.zeros((Co, ph, pw), np.float32)
+            x0, y0, x1, y1 = box
+            rh, rw = max(y1 - y0, .1) / ph, max(x1 - x0, .1) / pw
+            for c in range(Co):
+                for i in range(ph):
+                    for j in range(pw):
+                        ys = int(np.floor(y0 + i * rh))
+                        ye = int(np.ceil(y0 + (i + 1) * rh))
+                        xs = int(np.floor(x0 + j * rw))
+                        xe = int(np.ceil(x0 + (j + 1) * rw))
+                        ch = c * ph * pw + i * pw + j
+                        o[c, i, j] = x[0, ch, ys:ye, xs:xe].mean()
+            return o
+
+        for r in range(2):
+            np.testing.assert_allclose(got[r], oracle(rois[r]),
+                                       rtol=1e-5, atol=1e-5)
+
+    def test_gradients_flow(self):
+        rng = np.random.RandomState(11)
+        x = Tensor(rng.randn(1, 8, 6, 6).astype(np.float32))
+        x.stop_gradient = False
+        rois = Tensor(np.array([[0.0, 0.0, 6.0, 6.0]], np.float32))
+        out = V.psroi_pool(x, rois, Tensor(np.array([1], np.int32)), 2)
+        out.sum().backward()
+        assert np.abs(np.asarray(x.grad._data)).sum() > 0
