@@ -181,7 +181,7 @@ def bench_resnet(result, errors):
     return ips
 
 
-def bench_gpt(result, errors, batch):
+def bench_gpt(result, errors, batch, recompute=True):
     """GPT-345M-class train step (bf16, seq 1024) — tokens/sec/chip + MFU."""
     import numpy as np
     import jax
@@ -196,10 +196,11 @@ def bench_gpt(result, errors, batch):
     pt.seed(0)
     if SMOKE:
         from paddle_tpu.incubate.models import gpt_tiny
-        cfg = gpt_tiny(tensor_parallel=False, use_recompute=True)
+        cfg = gpt_tiny(tensor_parallel=False, use_recompute=recompute)
     else:
-        cfg = gpt_345m(tensor_parallel=False, use_recompute=True,
+        cfg = gpt_345m(tensor_parallel=False, use_recompute=recompute,
                        max_position_embeddings=GPT_SEQ)
+    result["gpt345m_recompute"] = recompute
     model = GPTForCausalLM(cfg)
     pt.amp.decorate(model, level="O2", dtype="bfloat16")
     crit = GPTPretrainingCriterion()
@@ -289,16 +290,40 @@ def main():
         _retry("resnet50", lambda: bench_resnet(result, errors), errors)
 
         def run_gpt():
-            # halve the batch on OOM; anything else retries as-is
-            for b in (16, 8, 4):
+            # ladder: no-remat first (fastest when it fits), then remat,
+            # then halve the batch; non-OOM errors retry via _retry
+            for b, rc in ((16, False), (16, True), (8, True), (4, True)):
                 try:
-                    return bench_gpt(result, errors, b)
+                    return bench_gpt(result, errors, b, recompute=rc)
                 except Exception as e:
-                    if "RESOURCE_EXHAUSTED" not in str(e) or b == 4:
+                    if "RESOURCE_EXHAUSTED" not in str(e) or \
+                            (b, rc) == (4, True):
                         raise
             return None
 
         _retry("gpt345m", run_gpt, errors)
+
+    def run_eager_bench():
+        # host-side dispatch microbench (bench_eager.py) in a CPU-forced
+        # subprocess; its one JSON line rides along in the record
+        import subprocess
+        here = os.path.dirname(os.path.abspath(__file__))
+        out = subprocess.run(
+            [sys.executable, os.path.join(here, "bench_eager.py")],
+            capture_output=True, text=True, timeout=300,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        if out.returncode != 0:
+            raise RuntimeError(out.stderr.strip().splitlines()[-1][:200]
+                               if out.stderr.strip()
+                               else f"bench_eager rc={out.returncode}")
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    eager = _retry("eager_dispatch", run_eager_bench, errors, attempts=1)
+    if eager:
+        result["eager_dispatch_us_per_op"] = {
+            k: eager[k] for k in ("raw_jax", "tape_off", "tape_on",
+                                  "jit_chain", "tape_overhead_ratio")
+            if k in eager}
 
     if errors:
         result["errors"] = errors

@@ -7,3 +7,7 @@ from .gpt import (  # noqa: F401
     GPTConfig, GPTModel, GPTForCausalLM, GPTPretrainingCriterion,
     gpt_tiny, gpt_345m, gpt_1p3b, gpt_6p7b, gpt_13b,
 )
+from .ernie import (  # noqa: F401
+    ErnieConfig, ErnieModel, ErnieForSequenceClassification,
+    ErnieForPretraining, ErniePretrainingCriterion, ernie_tiny, ernie_1_0,
+    ernie_3_0_base)

@@ -87,16 +87,18 @@ class ModelAverage:
     evaluation, ``restore()`` swaps back.
 
     Windowing follows the reference's block scheme (sum_1/sum_2 rotation):
-    two accumulator blocks of at most ``max_average_window`` steps each;
-    when the current block fills, it displaces the previous one — the
-    average always covers the most recent ``(max_average_window,
-    2*max_average_window]`` steps instead of the whole run."""
+    two accumulator blocks; when the current block reaches the effective
+    window — ``clip(average_window_rate * num_updates,
+    min_average_window, max_average_window)``, the reference's window
+    rule — it displaces the previous one, so the average always covers
+    roughly the most recent one-to-two windows instead of the whole
+    run."""
 
     def __init__(self, average_window_rate=0.15, parameters=None,
                  min_average_window=10000, max_average_window=10000,
                  name=None):
         self._params = list(parameters or [])
-        self._rate = average_window_rate
+        self._rate = float(average_window_rate)
         self._min_window = int(min_average_window)
         self._max_window = int(max_average_window)
         zeros = {p.name: jnp.zeros_like(p._data.astype(jnp.float32))
@@ -105,10 +107,15 @@ class ModelAverage:
         self._sum_old = {k: v for k, v in zeros.items()}
         self._cnt_cur = 0
         self._cnt_old = 0
+        self._total = 0
         self._backup = None
 
+    def _window(self):
+        return int(max(min(self._rate * max(self._total, 1),
+                           self._max_window), self._min_window))
+
     def step(self):
-        if self._cnt_cur >= self._max_window:
+        if self._cnt_cur >= self._window():
             self._sum_old = self._sum_cur
             self._cnt_old = self._cnt_cur
             self._sum_cur = {p.name: jnp.zeros_like(
@@ -118,6 +125,7 @@ class ModelAverage:
             self._sum_cur[p.name] = self._sum_cur[p.name] + p._data.astype(
                 jnp.float32)
         self._cnt_cur += 1
+        self._total += 1
 
     def apply(self, executor=None, need_restore=True):
         total = self._cnt_cur + self._cnt_old

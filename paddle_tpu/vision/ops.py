@@ -366,8 +366,135 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh,
                 name="yolo_box", n_out=2)
 
 
-def yolo_loss(*args, **kwargs):
-    raise NotImplementedError("yolo_loss: planned")
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss (ref ``python/paddle/vision/ops.py yolo_loss``
+    → ``phi/kernels/.../yolov3_loss_kernel``), one head.
+
+    Pure jnp re-design: responsibility assignment (best shape-IoU anchor
+    per gt) and target construction are scatters with out-of-bounds
+    drops for invalid/other-head gts; the ignore mask comes from a dense
+    pred-vs-gt IoU. Returns the per-sample loss ``[N]``.
+
+    x ``[N, len(anchor_mask)*(5+class_num), H, W]``; gt_box ``[N, B, 4]``
+    (cx, cy, w, h, normalized to the image); gt_label ``[N, B]`` int
+    (boxes with ``w <= 0`` are padding).
+    """
+    anchors_np = np.asarray(anchors, np.float32).reshape(-1, 2)
+    mask_np = np.asarray(anchor_mask, np.int64)
+    A = len(mask_np)
+
+    def f(feat, gtb, gtl, *rest):
+        gsc = rest[0] if gt_score is not None else None
+        N, C, H, W = feat.shape
+        B = gtb.shape[1]
+        input_h = jnp.float32(downsample_ratio * H)
+        input_w = jnp.float32(downsample_ratio * W)
+        p = feat.reshape(N, A, 5 + class_num, H, W)
+        p = jnp.moveaxis(p, 2, -1)  # [N, A, H, W, 5+cls]
+        tx, ty, tw, th = p[..., 0], p[..., 1], p[..., 2], p[..., 3]
+        tobj, tcls = p[..., 4], p[..., 5:]
+
+        an_all = jnp.asarray(anchors_np)          # [An, 2] pixels
+        an_head = an_all[jnp.asarray(mask_np)]    # [A, 2]
+
+        # -- decode predicted boxes (relative units) for the ignore mask
+        gx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+        gy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+        alpha, beta = scale_x_y, -0.5 * (scale_x_y - 1.0)
+        bx = (jax.nn.sigmoid(tx) * alpha + beta + gx) / W
+        by = (jax.nn.sigmoid(ty) * alpha + beta + gy) / H
+        bw = jnp.exp(tw) * an_head[None, :, None, None, 0] / input_w
+        bh = jnp.exp(th) * an_head[None, :, None, None, 1] / input_h
+
+        valid = gtb[..., 2] > 0                   # [N, B]
+
+        def iou_centerwh(ax, ay, aw, ah, bx_, by_, bw_, bh_):
+            x0 = jnp.maximum(ax - aw / 2, bx_ - bw_ / 2)
+            x1 = jnp.minimum(ax + aw / 2, bx_ + bw_ / 2)
+            y0 = jnp.maximum(ay - ah / 2, by_ - bh_ / 2)
+            y1 = jnp.minimum(ay + ah / 2, by_ + bh_ / 2)
+            inter = jnp.clip(x1 - x0, 0) * jnp.clip(y1 - y0, 0)
+            union = aw * ah + bw_ * bh_ - inter
+            return inter / jnp.maximum(union, 1e-10)
+
+        # best IoU of each prediction against any valid gt: [N,A,H,W]
+        iou_pg = iou_centerwh(
+            bx[..., None], by[..., None], bw[..., None], bh[..., None],
+            gtb[:, None, None, None, :, 0], gtb[:, None, None, None, :, 1],
+            gtb[:, None, None, None, :, 2], gtb[:, None, None, None, :, 3])
+        iou_pg = jnp.where(valid[:, None, None, None, :], iou_pg, 0.0)
+        ignore = iou_pg.max(-1) > ignore_thresh
+
+        # -- responsibility: best shape-IoU over the FULL anchor set
+        gw_pix = gtb[..., 2] * input_w
+        gh_pix = gtb[..., 3] * input_h
+        inter = (jnp.minimum(gw_pix[..., None], an_all[None, None, :, 0])
+                 * jnp.minimum(gh_pix[..., None], an_all[None, None, :, 1]))
+        union = (gw_pix[..., None] * gh_pix[..., None]
+                 + an_all[None, None, :, 0] * an_all[None, None, :, 1]
+                 - inter)
+        best_anchor = jnp.argmax(inter / jnp.maximum(union, 1e-10), -1)
+        # slot of that anchor within THIS head's mask (-1 -> other head)
+        slot_of = jnp.full((int(an_all.shape[0]),), -1, jnp.int32)
+        slot_of = slot_of.at[jnp.asarray(mask_np)].set(
+            jnp.arange(A, dtype=jnp.int32))
+        slot = slot_of[best_anchor]               # [N, B]
+        gi = jnp.clip((gtb[..., 0] * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gtb[..., 1] * H).astype(jnp.int32), 0, H - 1)
+        owns = valid & (slot >= 0)
+        # OOB slot index => scatter dropped
+        slot_s = jnp.where(owns, slot, A + 1)
+
+        nidx = jnp.broadcast_to(jnp.arange(N)[:, None], (N, B))
+        score = (gsc if gsc is not None
+                 else jnp.ones((N, B), jnp.float32))
+        box_w = score * (2.0 - gtb[..., 2] * gtb[..., 3])  # small-box boost
+
+        def scat(values):
+            buf = jnp.zeros((N, A, H, W), jnp.float32)
+            return buf.at[nidx, slot_s, gj, gi].set(values)
+
+        pos = scat(jnp.ones((N, B), jnp.float32))
+        # gt_score is the responsible cell's objectness TARGET (mixup):
+        # a half-confidence blended box trains conf toward 0.5, not 1
+        obj_t = scat(score)
+        w_t = scat(box_w)
+        txt = scat(gtb[..., 0] * W - gi)
+        tyt = scat(gtb[..., 1] * H - gj)
+        twt = scat(jnp.log(jnp.maximum(
+            gw_pix / jnp.maximum(an_all[best_anchor][..., 0], 1e-6),
+            1e-6)))
+        tht = scat(jnp.log(jnp.maximum(
+            gh_pix / jnp.maximum(an_all[best_anchor][..., 1], 1e-6),
+            1e-6)))
+        cls_t = jnp.zeros((N, A, H, W, class_num), jnp.float32)
+        onehot = jax.nn.one_hot(gtl.astype(jnp.int32), class_num)
+        if use_label_smooth:
+            # ref yolov3_loss kernel: delta = min(1/class_num, 1/40);
+            # positives 1-delta, negatives delta
+            delta = min(1.0 / max(class_num, 1), 1.0 / 40.0)
+            onehot = onehot * (1.0 - delta) + (1.0 - onehot) * delta
+        cls_t = cls_t.at[nidx, slot_s, gj, gi].set(onehot)
+
+        def bce(logit, target):
+            return (jnp.maximum(logit, 0) - logit * target
+                    + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+
+        loss_xy = (pos * w_t * (bce(tx, txt) + bce(ty, tyt))).sum((1, 2, 3))
+        loss_wh = (pos * w_t * 0.5 * ((tw - twt) ** 2
+                                      + (th - tht) ** 2)).sum((1, 2, 3))
+        obj_bce = bce(tobj, obj_t)
+        noobj = (1.0 - pos) * (~ignore).astype(jnp.float32)
+        loss_obj = (pos * obj_bce + noobj * obj_bce).sum((1, 2, 3))
+        loss_cls = (pos[..., None] * bce(tcls, cls_t)).sum((1, 2, 3, 4))
+        return loss_xy + loss_wh + loss_obj + loss_cls
+
+    args = [ensure_tensor(x), ensure_tensor(gt_box), ensure_tensor(gt_label)]
+    if gt_score is not None:
+        args.append(ensure_tensor(gt_score))
+    return nary(f, args, name="yolo_loss")
 
 
 def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
@@ -532,10 +659,11 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
                   & (ww[None, :] < jnp.ceil(x0 + (j_ + 1) * rw))
                   & (ww[None, :] >= 0)).astype(jnp.float32)   # [pw, W]
             counts = my.sum(1)[:, None] * mx.sum(1)[None, :]  # [ph, pw]
-            sums = jnp.einsum("chw,ih,jw->cij", fmap, my, mx)  # [C,ph,pw]
-            avg = sums / jnp.maximum(counts, 1.0)[None]
-            outs.append(avg[ch_idx, np.arange(ph)[None, :, None],
-                            np.arange(pw)[None, None, :]])
+            # gather each bin's OWN channel first ([Co,ph,pw,H,W]) so the
+            # reduction touches only the kept slices, not all C channels
+            sel = fmap[ch_idx]
+            sums = jnp.einsum("cijhw,ih,jw->cij", sel, my, mx)
+            outs.append(sums / jnp.maximum(counts, 1.0)[None])
         return (jnp.stack(outs).astype(feat.dtype) if outs
                 else jnp.zeros((0, Co, ph, pw), feat.dtype))
 

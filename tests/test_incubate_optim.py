@@ -142,7 +142,8 @@ def test_model_average_windowing():
     """Only the most recent <= 2*max_average_window steps contribute."""
     p = pt.to_tensor(np.zeros((2,), np.float32))
     p.name = "p"
-    avg = ModelAverage(parameters=[p], max_average_window=3)
+    avg = ModelAverage(average_window_rate=1.0, parameters=[p],
+                       min_average_window=3, max_average_window=3)
     # 9 steps with values 1..9: window keeps blocks {4,5,6} + {7,8,9}
     for v in range(1, 10):
         p._data = pt.to_tensor(np.full((2,), float(v), np.float32))._data

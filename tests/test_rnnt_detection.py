@@ -300,3 +300,82 @@ class TestPSRoIPool:
         out = V.psroi_pool(x, rois, Tensor(np.array([1], np.int32)), 2)
         out.sum().backward()
         assert np.abs(np.asarray(x.grad._data)).sum() > 0
+
+
+class TestYoloLoss:
+    def _setup(self, seed=12):
+        rng = np.random.RandomState(seed)
+        N, an, cls, H, W = 2, 3, 4, 4, 4
+        x = (rng.randn(N, an * (5 + cls), H, W) * 0.1).astype(np.float32)
+        gt_box = np.zeros((N, 3, 4), np.float32)
+        gt_box[0, 0] = [0.3, 0.4, 0.25, 0.3]   # one real box
+        gt_box[1, 0] = [0.6, 0.6, 0.4, 0.5]
+        gt_label = np.zeros((N, 3), np.int64)
+        gt_label[0, 0] = 2
+        gt_label[1, 0] = 1
+        kw = dict(anchors=[10, 13, 16, 30, 33, 23],
+                  anchor_mask=[0, 1, 2], class_num=cls,
+                  ignore_thresh=0.7, downsample_ratio=8,
+                  use_label_smooth=False)
+        return x, gt_box, gt_label, kw
+
+    def test_shape_and_finite(self):
+        x, gtb, gtl, kw = self._setup()
+        loss = V.yolo_loss(Tensor(x), Tensor(gtb), Tensor(gtl), **kw)
+        got = np.asarray(loss._data)
+        assert got.shape == (2,)
+        assert np.all(np.isfinite(got)) and np.all(got > 0)
+
+    def test_trains_head_to_lower_loss(self):
+        x, gtb, gtl, kw = self._setup()
+        import paddle_tpu as ptm
+        t = Tensor(x)
+        t.stop_gradient = False
+        opt = ptm.optimizer.Adam(learning_rate=0.05, parameters=[t])
+        first = None
+        for _ in range(30):
+            loss = V.yolo_loss(t, Tensor(gtb), Tensor(gtl), **kw).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if first is None:
+                first = float(loss)
+        assert float(loss) < 0.5 * first, (first, float(loss))
+
+    def test_padding_boxes_are_ignored(self):
+        x, gtb, gtl, kw = self._setup()
+        l1 = np.asarray(V.yolo_loss(Tensor(x), Tensor(gtb), Tensor(gtl),
+                                    **kw)._data)
+        # extra padding rows (w=0) must not change the loss
+        gtb2 = np.concatenate([gtb, np.zeros((2, 5, 4), np.float32)], 1)
+        gtl2 = np.concatenate([gtl, np.zeros((2, 5), np.int64)], 1)
+        l2 = np.asarray(V.yolo_loss(Tensor(x), Tensor(gtb2), Tensor(gtl2),
+                                    **kw)._data)
+        np.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+    def test_gt_score_is_objectness_target(self):
+        """Mixup: gt_score=0.5 must lower the loss of a head predicting
+        conf=0.5 vs one predicting conf=1 at the responsible cell."""
+        x, gtb, gtl, kw = self._setup()
+        sc = np.zeros((2, 3), np.float32)
+        sc[0, 0] = sc[1, 0] = 0.5
+        l_half = np.asarray(V.yolo_loss(
+            Tensor(x), Tensor(gtb), Tensor(gtl),
+            gt_score=Tensor(sc), **kw)._data)
+        l_full = np.asarray(V.yolo_loss(
+            Tensor(x), Tensor(gtb), Tensor(gtl), **kw)._data)
+        assert not np.allclose(l_half, l_full)
+
+    def test_label_smoothing_formula(self):
+        """Default use_label_smooth=True applies the reference delta =
+        min(1/class_num, 1/40) two-sided smoothing (changes the loss)."""
+        x, gtb, gtl, kw = self._setup()
+        kw.pop("use_label_smooth")
+        l_smooth = np.asarray(V.yolo_loss(
+            Tensor(x), Tensor(gtb), Tensor(gtl),
+            use_label_smooth=True, **kw)._data)
+        l_hard = np.asarray(V.yolo_loss(
+            Tensor(x), Tensor(gtb), Tensor(gtl),
+            use_label_smooth=False, **kw)._data)
+        assert np.all(np.isfinite(l_smooth))
+        assert not np.allclose(l_smooth, l_hard)
