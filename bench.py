@@ -30,6 +30,7 @@ import traceback
 SMOKE = bool(os.environ.get("BENCH_SMOKE"))  # tiny-shape CI structure check
 RESNET_BATCH = 8 if SMOKE else 256
 GPT_SEQ = 64 if SMOKE else 1024
+BERT_SEQ = 128
 WARMUP = 1 if SMOKE else 5
 ITERS = 2 if SMOKE else 30
 RETRIES = 1 if SMOKE else 5
@@ -254,6 +255,71 @@ def bench_gpt(result, errors, batch, recompute=True):
     return tps
 
 
+def bench_bert(result, errors, batch):
+    """BERT-base SST-2-style finetune step (config[1]): seq/sec via the
+    compiled (to_static-equivalent) path, bf16 AMP."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.jit.api import functional_call
+    from paddle_tpu.tensor import Tensor
+    from paddle_tpu.incubate.models import (BertForSequenceClassification,
+                                            bert_base, bert_tiny)
+
+    pt.seed(0)
+    cfg = bert_tiny() if SMOKE else bert_base()
+    model = BertForSequenceClassification(cfg, num_classes=2)
+    pt.amp.decorate(model, level="O2", dtype="bfloat16")
+    opt = pt.optimizer.AdamW(learning_rate=2e-5,
+                             parameters=model.parameters(),
+                             multi_precision=True)
+    params = {k: p._data for k, p in model.named_parameters()}
+    buffers = {k: b._data for k, b in model.named_buffers()}
+    opt_state = opt.init_state_tree(params)
+    fwd = getattr(model, "_orig_forward", model.forward)
+    seq = 32 if SMOKE else BERT_SEQ
+
+    def train_step(params, buffers, opt_state, ids, y):
+        def loss_of(p):
+            out, new_buffers = functional_call(
+                model, p, buffers, (Tensor(ids),), training=True,
+                forward_fn=fwd)
+            logits = out._data.astype(jnp.float32)
+            logp = jax.nn.log_softmax(logits)
+            loss = -jnp.take_along_axis(logp, y[:, None], axis=1).mean()
+            return loss, new_buffers
+
+        (loss, new_buffers), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(params)
+        new_params, new_opt = opt.apply_gradients_tree(params, grads,
+                                                       opt_state)
+        return loss, new_params, new_buffers, new_opt
+
+    step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq))
+                      .astype(np.int32))
+    y = jnp.asarray(rng.randint(0, 2, batch).astype(np.int32))
+
+    t0 = time.perf_counter()
+    compiled = step.lower(params, buffers, opt_state, ids, y).compile()
+    result["bert_base_compile_sec"] = round(time.perf_counter() - t0, 2)
+    flops = _flops_per_step(compiled)
+    result["bert_base_flops_per_step"] = flops
+    result["bert_base_memory"] = _memory_report(compiled)
+
+    dt = _time_compiled(compiled, (params, buffers, opt_state, ids, y), 3)
+    sps = batch * ITERS / dt
+    result["bert_base_seq_per_sec"] = round(sps, 1)
+    result["bert_base_batch"] = batch
+    result["bert_base_seq_len"] = seq
+    peak = _peak_flops(result.get("device_kind"))
+    if flops and peak:
+        result["bert_base_mfu"] = round(flops * (ITERS / dt) / peak, 4)
+    return sps
+
+
 def main():
     errors: dict = {}
     result: dict = {
@@ -302,6 +368,17 @@ def main():
             return None
 
         _retry("gpt345m", run_gpt, errors)
+
+        def run_bert():
+            for b in (32, 16, 8):
+                try:
+                    return bench_bert(result, errors, b)
+                except Exception as e:
+                    if "RESOURCE_EXHAUSTED" not in str(e) or b == 8:
+                        raise
+            return None
+
+        _retry("bert_base", run_bert, errors)
 
     def run_eager_bench():
         # host-side dispatch microbench (bench_eager.py) in a CPU-forced

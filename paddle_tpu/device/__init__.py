@@ -6,10 +6,13 @@ from ..framework.device import (  # noqa: F401
     is_compiled_with_custom_device, device_guard, Place, CPUPlace, TPUPlace,
     CUDAPlace, CustomPlace, XPUPlace,
 )
+from .plugin import (  # noqa: F401
+    load_custom_runtime_lib, load_custom_device_plugins, registered_plugins)
 
 __all__ = ["set_device", "get_device", "get_all_devices", "device_count",
            "is_compiled_with_cuda", "is_compiled_with_tpu", "cuda",
-           "get_available_device", "get_available_custom_device"]
+           "get_available_device", "get_available_custom_device",
+           "load_custom_runtime_lib", "load_custom_device_plugins"]
 
 
 def get_available_device():

@@ -1,0 +1,66 @@
+"""Custom-device plugin loading (ref: ``LoadCustomRuntimeLib``
+``paddle/phi/backends/custom/custom_device.cc:1065,1087`` and the
+``CUSTOM_DEVICE_ROOT`` scan in ``paddle/fluid/platform/init.cc:144,240``).
+
+The reference dlopens vendor ``.so`` files implementing its C device ABI
+(``device_ext.h``). The TPU-native equivalent of that ABI is PJRT: a
+vendor backend ships a PJRT plugin shared library, and registering it
+with jax makes its devices first-class (``jax.devices("<name>")``), with
+XLA providing the kernel + collective surface the reference's
+DeviceInterface/CCL hooks define by hand.
+"""
+from __future__ import annotations
+
+import glob
+import os
+
+__all__ = ["load_custom_runtime_lib", "load_custom_device_plugins",
+           "registered_plugins"]
+
+_registered: dict = {}
+
+
+def registered_plugins():
+    return dict(_registered)
+
+
+def load_custom_runtime_lib(path, name=None):
+    """Register one PJRT plugin library with jax.
+
+    path: a ``.so`` file or a directory containing one (the reference
+    accepts both, ``custom_device.cc:1087``). name defaults to the
+    library basename. Returns the registered plugin name. Must be called
+    before the jax backend initializes (same constraint as the
+    reference's load-at-init)."""
+    if os.path.isdir(path):
+        libs = sorted(glob.glob(os.path.join(path, "*.so")))
+        if not libs:
+            raise FileNotFoundError(
+                f"no .so plugin libraries under '{path}'")
+        return [load_custom_runtime_lib(p, name=None) for p in libs]
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"plugin library '{path}' not found")
+    plug = name or os.path.splitext(os.path.basename(path))[0]
+    plug = plug.removeprefix("lib").removeprefix("pjrt_")
+    from jax._src import xla_bridge
+    xla_bridge.register_plugin(plug, library_path=path)
+    _registered[plug] = path
+    return plug
+
+
+def load_custom_device_plugins(root=None):
+    """Scan ``CUSTOM_DEVICE_ROOT`` (or ``root``) for plugin libraries and
+    register each — the reference's init-time behavior
+    (``init.cc:144``). Missing/empty root is a no-op like the reference.
+    Returns the list of registered plugin names."""
+    root = root if root is not None else os.environ.get(
+        "CUSTOM_DEVICE_ROOT", "")
+    if not root or not os.path.isdir(root):
+        return []
+    out = []
+    for lib in sorted(glob.glob(os.path.join(root, "*.so"))):
+        try:
+            out.append(load_custom_runtime_lib(lib))
+        except Exception:
+            continue  # a broken vendor lib must not kill init (ref parity)
+    return out

@@ -11,6 +11,8 @@ from .dtype import (  # noqa: F401
     float64, complex64, complex128, float8_e4m3fn, float8_e5m2,
 )
 from .flags import set_flags, get_flags, define_flag, flag  # noqa: F401
+from .string_tensor import (  # noqa: F401
+    StringTensor, SelectedRows, strings_lower, strings_upper)
 from .random import (  # noqa: F401
     seed, get_rng_state, set_rng_state, default_generator, next_key,
     RNGStatesTracker, get_tracker, rng_state_guard,

@@ -1,0 +1,96 @@
+"""StringTensor + SelectedRows analogs (SURVEY §2 "Tensor types" row).
+
+ref: ``paddle/phi/core/string_tensor.h`` + the strings kernels
+(``paddle/phi/kernels/strings/case_convert_kernel.h`` lower/upper) and
+``paddle/phi/core/selected_rows.h``.
+
+TPU stance: strings never touch the accelerator (the reference's string
+kernels are CPU-only too) — StringTensor is a host container with the
+case-conversion ops the reference ships. SelectedRows is the row-sparse
+(rows, values, height) gradient container; its TPU-native update path is
+``distributed.ps.row_sparse_apply`` (dedup + OOB-dropped scatter).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["StringTensor", "SelectedRows", "strings_lower", "strings_upper"]
+
+
+class StringTensor:
+    """Host tensor of variable-length unicode strings."""
+
+    def __init__(self, data, name=None):
+        self._data = np.asarray(data, dtype=object)
+        self.name = name
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def dtype(self):
+        return "pstring"  # the reference's dtype name
+
+    def numpy(self):
+        return self._data
+
+    def lower(self, use_utf8_encoding=True):
+        return StringTensor(np.vectorize(
+            lambda s: s.lower(), otypes=[object])(self._data))
+
+    def upper(self, use_utf8_encoding=True):
+        return StringTensor(np.vectorize(
+            lambda s: s.upper(), otypes=[object])(self._data))
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        return out if isinstance(out, str) else StringTensor(out)
+
+    def __len__(self):
+        return len(self._data)
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, {self._data!r})"
+
+
+def strings_lower(x, use_utf8_encoding=True, name=None):
+    """ref ``paddle/phi/kernels/strings/case_convert_kernel.h`` lower."""
+    return (x if isinstance(x, StringTensor) else StringTensor(x)).lower()
+
+
+def strings_upper(x, use_utf8_encoding=True, name=None):
+    return (x if isinstance(x, StringTensor) else StringTensor(x)).upper()
+
+
+class SelectedRows:
+    """Row-sparse value container (ref ``selected_rows.h``): ``rows`` are
+    the touched indices into a ``[height, ...]`` dense space, ``value``
+    holds one slice per row. The analog of the PS sparse-grad format; see
+    ``distributed.ps.row_sparse_apply`` for the lazy update."""
+
+    def __init__(self, rows, value, height):
+        import jax.numpy as jnp
+        self.rows = jnp.asarray(np.asarray(rows, np.int32))
+        self.value = jnp.asarray(value)
+        self.height = int(height)
+
+    @property
+    def shape(self):
+        return [self.height] + list(self.value.shape[1:])
+
+    def to_dense(self):
+        """Scatter-ADD duplicates into the dense form (reference merge
+        semantics for gradient SelectedRows)."""
+        import jax.numpy as jnp
+        dense = jnp.zeros((self.height,) + tuple(self.value.shape[1:]),
+                          self.value.dtype)
+        return dense.at[self.rows].add(self.value)
+
+    def apply_to(self, weight, update_fn):
+        """Row-lazy update of ``weight`` with these values (dedup +
+        OOB-drop scatter via ``distributed.ps.row_sparse_apply``)."""
+        from ..distributed.ps import row_sparse_apply
+        new_w, _ = row_sparse_apply(weight, self.rows, self.value,
+                                    update_fn)
+        return new_w

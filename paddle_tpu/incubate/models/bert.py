@@ -110,6 +110,28 @@ class BertLayer(Layer):
         return self.ln2(x + self.dropout(h))
 
 
+def additive_attention_mask(attention_mask):
+    """[B, T] padding mask → additive [B, 1, 1, T] (shared by the BERT
+    and ERNIE encoders)."""
+    if attention_mask is not None and len(attention_mask.shape) == 2:
+        m = attention_mask.astype("float32")
+        return (m - 1.0).unsqueeze(1).unsqueeze(1) * 1e4
+    return attention_mask
+
+
+def run_encoder(layers, x, attention_mask, use_recompute, training):
+    """Encoder stack loop, optionally rematerialized per block
+    (``jax.checkpoint`` via fleet.recompute — the config[4] recipe)."""
+    if use_recompute and training:
+        from ...distributed.fleet.recompute import recompute
+        for layer in layers:
+            x = recompute(layer, x, attention_mask)
+    else:
+        for layer in layers:
+            x = layer(x, attention_mask)
+    return x
+
+
 class BertModel(Layer):
     def __init__(self, cfg: BertConfig):
         super().__init__()
@@ -121,13 +143,11 @@ class BertModel(Layer):
 
     def forward(self, input_ids, token_type_ids=None, position_ids=None,
                 attention_mask=None):
-        if attention_mask is not None and len(attention_mask.shape) == 2:
-            # [B, T] padding mask → additive [B, 1, 1, T]
-            m = attention_mask.astype("float32")
-            attention_mask = (m - 1.0).unsqueeze(1).unsqueeze(1) * 1e4
+        attention_mask = additive_attention_mask(attention_mask)
         x = self.embeddings(input_ids, token_type_ids, position_ids)
-        for layer in self.encoder:
-            x = layer(x, attention_mask)
+        x = run_encoder(self.encoder, x, attention_mask,
+                        getattr(self.cfg, "use_recompute", False),
+                        self.training)
         pooled = F.tanh(self.pooler(x[:, 0]))
         return x, pooled
 

@@ -26,7 +26,7 @@ from ...nn.layer.common import Linear, Dropout, Embedding
 from ...nn.layer.norm import LayerNorm
 from ...nn.layer.container import LayerList
 from ...nn import functional as F
-from .bert import BertLayer
+from .bert import BertLayer, additive_attention_mask, run_encoder
 
 __all__ = ["ErnieConfig", "ErnieModel", "ErnieForSequenceClassification",
            "ErnieForPretraining", "ErniePretrainingCriterion",
@@ -102,18 +102,11 @@ class ErnieModel(Layer):
 
     def forward(self, input_ids, token_type_ids=None, position_ids=None,
                 attention_mask=None, task_type_ids=None):
-        if attention_mask is not None and len(attention_mask.shape) == 2:
-            m = attention_mask.astype("float32")
-            attention_mask = (m - 1.0).unsqueeze(1).unsqueeze(1) * 1e4
+        attention_mask = additive_attention_mask(attention_mask)
         x = self.embeddings(input_ids, token_type_ids, position_ids,
                             task_type_ids)
-        if self.cfg.use_recompute and self.training:
-            from ...distributed.fleet.recompute import recompute
-            for layer in self.encoder:
-                x = recompute(layer, x, attention_mask)
-        else:
-            for layer in self.encoder:
-                x = layer(x, attention_mask)
+        x = run_encoder(self.encoder, x, attention_mask,
+                        self.cfg.use_recompute, self.training)
         pooled = F.tanh(self.pooler(x[:, 0]))
         return x, pooled
 

@@ -408,7 +408,8 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
         bw = jnp.exp(tw) * an_head[None, :, None, None, 0] / input_w
         bh = jnp.exp(th) * an_head[None, :, None, None, 1] / input_h
 
-        valid = gtb[..., 2] > 0                   # [N, B]
+        # ref GtValid (yolo_loss_kernel.cc:163): BOTH dims must be > 0
+        valid = (gtb[..., 2] > 0) & (gtb[..., 3] > 0)  # [N, B]
 
         def iou_centerwh(ax, ay, aw, ah, bx_, by_, bw_, bh_):
             x0 = jnp.maximum(ax - aw / 2, bx_ - bw_ / 2)
@@ -452,44 +453,53 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
                  else jnp.ones((N, B), jnp.float32))
         box_w = score * (2.0 - gtb[..., 2] * gtb[..., 3])  # small-box boost
 
+        def bce(logit, target):
+            return (jnp.maximum(logit, 0) - logit * target
+                    + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+
+        # -- per-GT location/class losses, GATHERED at the responsible
+        # cell (like the reference's per-gt loop, kernel.cc:328,346 —
+        # two gts sharing a cell both contribute; no scatter collapse)
+        slot_g = jnp.clip(slot, 0, A - 1)
+        ow = owns.astype(jnp.float32)
+
+        def gather(t):
+            return t[nidx, slot_g, gj, gi]        # [N, B]
+
+        txt = gtb[..., 0] * W - gi
+        tyt = gtb[..., 1] * H - gj
+        twt = jnp.log(jnp.maximum(
+            gw_pix / jnp.maximum(an_all[best_anchor][..., 0], 1e-6), 1e-6))
+        tht = jnp.log(jnp.maximum(
+            gh_pix / jnp.maximum(an_all[best_anchor][..., 1], 1e-6), 1e-6))
+        # ref CalcBoxLocationLoss: SCE on x/y, L1 on w/h
+        loc_b = (bce(gather(tx), txt) + bce(gather(ty), tyt)
+                 + jnp.abs(gather(tw) - twt) + jnp.abs(gather(th) - tht))
+        loss_loc = (ow * box_w * loc_b).sum(-1)
+
+        onehot = jax.nn.one_hot(gtl.astype(jnp.int32), class_num)
+        if use_label_smooth:
+            # ref kernel: delta = min(1/class_num, 1/40); pos 1-delta,
+            # neg delta
+            delta = min(1.0 / max(class_num, 1), 1.0 / 40.0)
+            onehot = onehot * (1.0 - delta) + (1.0 - onehot) * delta
+        cls_logits = tcls[nidx, slot_g, gj, gi]   # [N, B, cls]
+        # ref CalcLabelLoss: per-class SCE weighted by the mixup score
+        loss_cls = (ow * score * bce(cls_logits, onehot).sum(-1)).sum(-1)
+
+        # -- objectness per cell: target 1 at responsible cells WEIGHTED
+        # by the mixup score (ref kernel.cc:148 obj_mask = score), 0
+        # elsewhere except ignored cells
         def scat(values):
             buf = jnp.zeros((N, A, H, W), jnp.float32)
             return buf.at[nidx, slot_s, gj, gi].set(values)
 
         pos = scat(jnp.ones((N, B), jnp.float32))
-        # gt_score is the responsible cell's objectness TARGET (mixup):
-        # a half-confidence blended box trains conf toward 0.5, not 1
-        obj_t = scat(score)
-        w_t = scat(box_w)
-        txt = scat(gtb[..., 0] * W - gi)
-        tyt = scat(gtb[..., 1] * H - gj)
-        twt = scat(jnp.log(jnp.maximum(
-            gw_pix / jnp.maximum(an_all[best_anchor][..., 0], 1e-6),
-            1e-6)))
-        tht = scat(jnp.log(jnp.maximum(
-            gh_pix / jnp.maximum(an_all[best_anchor][..., 1], 1e-6),
-            1e-6)))
-        cls_t = jnp.zeros((N, A, H, W, class_num), jnp.float32)
-        onehot = jax.nn.one_hot(gtl.astype(jnp.int32), class_num)
-        if use_label_smooth:
-            # ref yolov3_loss kernel: delta = min(1/class_num, 1/40);
-            # positives 1-delta, negatives delta
-            delta = min(1.0 / max(class_num, 1), 1.0 / 40.0)
-            onehot = onehot * (1.0 - delta) + (1.0 - onehot) * delta
-        cls_t = cls_t.at[nidx, slot_s, gj, gi].set(onehot)
-
-        def bce(logit, target):
-            return (jnp.maximum(logit, 0) - logit * target
-                    + jnp.log1p(jnp.exp(-jnp.abs(logit))))
-
-        loss_xy = (pos * w_t * (bce(tx, txt) + bce(ty, tyt))).sum((1, 2, 3))
-        loss_wh = (pos * w_t * 0.5 * ((tw - twt) ** 2
-                                      + (th - tht) ** 2)).sum((1, 2, 3))
-        obj_bce = bce(tobj, obj_t)
+        obj_w = scat(score)
         noobj = (1.0 - pos) * (~ignore).astype(jnp.float32)
-        loss_obj = (pos * obj_bce + noobj * obj_bce).sum((1, 2, 3))
-        loss_cls = (pos[..., None] * bce(tcls, cls_t)).sum((1, 2, 3, 4))
-        return loss_xy + loss_wh + loss_obj + loss_cls
+        loss_obj = (pos * obj_w * bce(tobj, jnp.ones_like(tobj))
+                    + noobj * bce(tobj, jnp.zeros_like(tobj))).sum((1, 2, 3))
+        return loss_loc + loss_obj + loss_cls
 
     args = [ensure_tensor(x), ensure_tensor(gt_box), ensure_tensor(gt_label)]
     if gt_score is not None:

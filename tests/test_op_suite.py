@@ -203,6 +203,61 @@ OPS = [
      [_sp(3, 4), _sp(3, 4, seed=1)], {}),
 ]
 
+
+def _conv2d_np(x, w):
+    N, C, H, W = x.shape
+    O, _, kh, kw = w.shape
+    out = np.zeros((N, O, H - kh + 1, W - kw + 1), np.float64)
+    for i in range(H - kh + 1):
+        for j in range(W - kw + 1):
+            patch = x[:, :, i:i + kh, j:j + kw]
+            out[:, :, i, j] = np.tensordot(patch, w, ([1, 2, 3], [1, 2, 3]))
+    return out
+
+
+def _pool2_np(x, red):
+    N, C, H, W = x.shape
+    return red(x.reshape(N, C, H // 2, 2, W // 2, 2), (3, 5))
+
+
+OPS += [
+    # -- conv / pool / norm / resize ---------------------------------------
+    ("conv2d", F.conv2d, _conv2d_np,
+     [_sp(1, 2, 5, 5), _sp(3, 2, 3, 3, seed=1)],
+     {"bf16_atol": 5e-2, "bf16_rtol": 5e-2, "atol": 1e-4, "rtol": 1e-4}),
+    ("linear_wb", F.linear,
+     lambda x, w, b: x @ w + b,
+     [_sp(3, 4), _sp(4, 5, seed=1), _sp(5, seed=2)],
+     {"bf16_atol": 5e-2, "bf16_rtol": 5e-2}),
+    ("max_pool2d", lambda x: F.max_pool2d(x, 2),
+     lambda x: _pool2_np(x, np.max), [_sp(1, 2, 4, 4)], {"grad": False}),
+    ("avg_pool2d", lambda x: F.avg_pool2d(x, 2),
+     lambda x: _pool2_np(x, np.mean), [_sp(1, 2, 4, 4)], {}),
+    ("adaptive_avg_pool2d_1", lambda x: F.adaptive_avg_pool2d(x, 1),
+     lambda x: x.mean((2, 3), keepdims=True), [_sp(1, 2, 4, 4)], {}),
+    ("layer_norm", lambda x: F.layer_norm(x, 4),
+     lambda x: (x - x.mean(-1, keepdims=True)) / np.sqrt(
+         x.var(-1, keepdims=True) + 1e-5),
+     [_sp(3, 4)], {"grad_atol": 2e-2}),
+    ("normalize_l2", F.normalize,
+     lambda x: x / np.maximum(
+         np.linalg.norm(x, axis=-1, keepdims=True), 1e-12),
+     [_sp(3, 4)], {}),
+    ("interp_nearest",
+     lambda x: F.interpolate(x, scale_factor=2, mode="nearest"),
+     lambda x: x.repeat(2, axis=2).repeat(2, axis=3),
+     [_sp(1, 2, 3, 3)], {}),
+    ("pixel_shuffle", lambda x: F.pixel_shuffle(x, 2),
+     lambda x: x.reshape(1, 1, 2, 2, 3, 3).transpose(
+         0, 1, 4, 2, 5, 3).reshape(1, 1, 6, 6),
+     [_sp(1, 4, 3, 3)], {}),
+    ("unfold3", lambda x: pt.unsqueeze(F.unfold(x, 3), 0).squeeze(0),
+     lambda x: np.stack(
+         [x[0, :, i:i + 3, j:j + 3].reshape(-1)
+          for i in range(2) for j in range(2)], -1)[None],
+     [_sp(1, 2, 4, 4)], {"grad": False}),
+]
+
 _IDS = [row[0] for row in OPS]
 
 

@@ -1,0 +1,83 @@
+"""Custom-device plugin loading + StringTensor/SelectedRows analogs
+(ref: custom_device.cc:1065 LoadCustomRuntimeLib, init.cc:144
+CUSTOM_DEVICE_ROOT scan; phi/core/string_tensor.h, selected_rows.h)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.device import (load_custom_runtime_lib,
+                               load_custom_device_plugins,
+                               registered_plugins)
+from paddle_tpu.framework import (StringTensor, SelectedRows,
+                                  strings_lower, strings_upper)
+
+
+class TestPluginLoading:
+    def test_missing_library_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            load_custom_runtime_lib(str(tmp_path / "libnpu.so"))
+
+    def test_empty_dir_raises_and_empty_root_noop(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            load_custom_runtime_lib(str(tmp_path))
+        assert load_custom_device_plugins(root="") == []
+        assert load_custom_device_plugins(root=str(tmp_path)) == []
+
+    def test_registers_pjrt_plugin(self, tmp_path, monkeypatch):
+        lib = tmp_path / "libpjrt_mynpu.so"
+        lib.write_bytes(b"\x7fELF")
+        calls = {}
+        from jax._src import xla_bridge
+        monkeypatch.setattr(
+            xla_bridge, "register_plugin",
+            lambda name, library_path=None, **kw: calls.setdefault(
+                name, library_path))
+        name = load_custom_runtime_lib(str(lib))
+        assert name == "mynpu"
+        assert calls == {"mynpu": str(lib)}
+        assert registered_plugins()["mynpu"] == str(lib)
+
+    def test_root_scan(self, tmp_path, monkeypatch):
+        (tmp_path / "liba.so").write_bytes(b"\x7fELF")
+        (tmp_path / "libb.so").write_bytes(b"\x7fELF")
+        from jax._src import xla_bridge
+        monkeypatch.setattr(xla_bridge, "register_plugin",
+                            lambda name, library_path=None, **kw: None)
+        names = load_custom_device_plugins(root=str(tmp_path))
+        assert names == ["a", "b"]
+
+
+class TestStringTensor:
+    def test_case_convert(self):
+        st = StringTensor([["Hello", "WORLD"], ["MiXeD", "ok"]])
+        assert st.shape == [2, 2] and st.dtype == "pstring"
+        low = st.lower()
+        up = strings_upper(st)
+        assert low.numpy()[0, 1] == "world"
+        assert up.numpy()[1, 0] == "MIXED"
+        assert strings_lower([["A"]]).numpy()[0, 0] == "a"
+        assert st[0][1] == "WORLD"
+
+
+class TestSelectedRows:
+    def test_to_dense_merges_duplicates(self):
+        sr = SelectedRows(rows=[1, 3, 1], value=np.ones((3, 2), np.float32),
+                          height=5)
+        dense = np.asarray(sr.to_dense())
+        assert dense.shape == (5, 2)
+        np.testing.assert_allclose(dense[1], [2, 2])  # duplicate merged
+        np.testing.assert_allclose(dense[3], [1, 1])
+        np.testing.assert_allclose(dense[0], [0, 0])
+
+    def test_apply_to_updates_only_touched_rows(self):
+        import jax.numpy as jnp
+        w = jnp.zeros((6, 2), jnp.float32)
+        sr = SelectedRows(rows=[2, 4], value=np.ones((2, 2), np.float32),
+                          height=6)
+        new_w = sr.apply_to(w, lambda rows, grads: rows - 0.5 * grads)
+        got = np.asarray(new_w)
+        np.testing.assert_allclose(got[2], [-0.5, -0.5])
+        np.testing.assert_allclose(got[4], [-0.5, -0.5])
+        assert np.abs(got[[0, 1, 3, 5]]).sum() == 0

@@ -353,18 +353,46 @@ class TestYoloLoss:
                                     **kw)._data)
         np.testing.assert_allclose(l1, l2, rtol=1e-5)
 
-    def test_gt_score_is_objectness_target(self):
-        """Mixup: gt_score=0.5 must lower the loss of a head predicting
-        conf=0.5 vs one predicting conf=1 at the responsible cell."""
+    def test_gt_score_weights_positive_terms_linearly(self):
+        """Mixup semantics per the reference kernel: gt_score WEIGHTS the
+        positive-sample terms (obj target stays 1), so the loss is linear
+        in the score: l(0.5) == (l(0) + l(1)) / 2."""
         x, gtb, gtl, kw = self._setup()
-        sc = np.zeros((2, 3), np.float32)
-        sc[0, 0] = sc[1, 0] = 0.5
-        l_half = np.asarray(V.yolo_loss(
-            Tensor(x), Tensor(gtb), Tensor(gtl),
-            gt_score=Tensor(sc), **kw)._data)
-        l_full = np.asarray(V.yolo_loss(
-            Tensor(x), Tensor(gtb), Tensor(gtl), **kw)._data)
-        assert not np.allclose(l_half, l_full)
+
+        def loss_with(s):
+            sc = np.zeros((2, 3), np.float32)
+            sc[0, 0] = sc[1, 0] = s
+            return np.asarray(V.yolo_loss(
+                Tensor(x), Tensor(gtb), Tensor(gtl),
+                gt_score=Tensor(sc), **kw)._data)
+
+        l0, l_half, l1 = loss_with(0.0), loss_with(0.5), loss_with(1.0)
+        assert not np.allclose(l_half, l1)
+        np.testing.assert_allclose(l_half, (l0 + l1) / 2, rtol=1e-5)
+
+    def test_two_gts_in_same_cell_both_contribute(self):
+        """Reference accumulates per-gt losses — a duplicate (cell,
+        anchor) assignment must not silently drop one box."""
+        x, gtb, gtl, kw = self._setup()
+        gtb2 = gtb.copy()
+        gtb2[0, 1] = gtb2[0, 0]          # same center/shape => same cell
+        gtl2 = gtl.copy()
+        gtl2[0, 1] = 3                   # different class
+        l_one = np.asarray(V.yolo_loss(Tensor(x), Tensor(gtb),
+                                       Tensor(gtl), **kw)._data)
+        l_two = np.asarray(V.yolo_loss(Tensor(x), Tensor(gtb2),
+                                       Tensor(gtl2), **kw)._data)
+        assert l_two[0] > l_one[0]       # second gt's loc+cls terms added
+
+    def test_degenerate_height_box_is_padding(self):
+        x, gtb, gtl, kw = self._setup()
+        gtb2 = gtb.copy()
+        gtb2[0, 1] = [0.5, 0.5, 0.3, 0.0]   # w>0, h==0: invalid per ref
+        l1 = np.asarray(V.yolo_loss(Tensor(x), Tensor(gtb),
+                                    Tensor(gtl), **kw)._data)
+        l2 = np.asarray(V.yolo_loss(Tensor(x), Tensor(gtb2),
+                                    Tensor(gtl), **kw)._data)
+        np.testing.assert_allclose(l1, l2, rtol=1e-5)
 
     def test_label_smoothing_formula(self):
         """Default use_label_smooth=True applies the reference delta =
