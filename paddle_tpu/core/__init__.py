@@ -68,6 +68,12 @@ def _load():
     lib.pt_free.argtypes = [ctypes.c_void_p]
     lib.pt_pool_release.restype = ctypes.c_uint64
     lib.pt_pool_stats.argtypes = [ctypes.POINTER(ctypes.c_uint64)] * 4
+    lib.pt_shm_create.restype = ctypes.c_int64
+    lib.pt_shm_create.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.pt_shm_open_map.restype = ctypes.c_int64
+    lib.pt_shm_open_map.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.pt_shm_unmap.argtypes = [ctypes.c_int64, ctypes.c_int64]
+    lib.pt_shm_unlink.argtypes = [ctypes.c_char_p]
     lib.pt_wq_create.restype = ctypes.c_void_p
     lib.pt_wq_create.argtypes = [ctypes.c_int]
     lib.pt_wq_submit.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
@@ -460,3 +466,61 @@ class TCPStore:
             self.close()
         except Exception:
             pass
+
+
+class ShmSegment:
+    """Named POSIX shared-memory segment over the native core's shm.cc
+    (ref ``paddle/fluid/memory/allocation/mmap_allocator.cc`` — the
+    reference DataLoader's use_shared_memory transport). ``create`` in
+    the producer, ``attach`` in the consumer; the consumer unlinks."""
+
+    def __init__(self, name, size, _create):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native core unavailable: {_lib_err}")
+        self.name = name
+        self.size = int(size)
+        fn = lib.pt_shm_create if _create else lib.pt_shm_open_map
+        self._addr = fn(name.encode(), self.size)
+        if not self._addr:
+            raise OSError(
+                f"shm {'create' if _create else 'attach'} failed: {name}")
+        self._lib = lib
+
+    @classmethod
+    def create(cls, name, size):
+        return cls(name, size, True)
+
+    @classmethod
+    def attach(cls, name, size):
+        return cls(name, size, False)
+
+    def buffer(self):
+        if not self._addr:
+            raise ValueError(f"shm segment {self.name} is closed")
+        return (ctypes.c_char * self.size).from_address(self._addr)
+
+    def close(self):
+        if self._addr:
+            self._lib.pt_shm_unmap(self._addr, self.size)
+            self._addr = 0
+
+    def unlink(self):
+        self._lib.pt_shm_unlink(self.name.encode())
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def shm_available() -> bool:
+    return _load() is not None
+
+
+def shm_unlink(name: str) -> None:
+    """Unlink a named segment without mapping it (cleanup path)."""
+    lib = _load()
+    if lib is not None:
+        lib.pt_shm_unlink(name.encode())

@@ -13,7 +13,8 @@ import subprocess
 import tempfile
 
 _SRC_DIR = os.path.join(os.path.dirname(__file__), "native")
-_SOURCES = ["trace.cc", "flags.cc", "alloc.cc", "workqueue.cc", "store.cc"]
+_SOURCES = ["trace.cc", "flags.cc", "alloc.cc", "workqueue.cc", "store.cc",
+            "shm.cc"]
 _HEADERS = ["common.h"]
 
 #: last build failure detail (compiler stderr / missing toolchain), for

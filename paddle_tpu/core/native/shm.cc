@@ -1,0 +1,46 @@
+// POSIX shared-memory tensor transport for the DataLoader.
+//
+// ref: paddle/fluid/memory/allocation/mmap_allocator.cc — the reference's
+// DataLoader ships worker-produced batches to the trainer through shared
+// memory when use_shared_memory=True instead of pickling tensor bytes
+// through a pipe. Same design here: workers write batch buffers into a
+// named segment; the parent maps it, wraps the bytes zero-copy, and
+// unlinks after device upload.
+#include "common.h"
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+PT_EXPORT int64_t pt_shm_create(const char* name, int64_t size) {
+  int fd = shm_open(name, O_CREAT | O_RDWR | O_EXCL, 0600);
+  if (fd < 0) return 0;
+  if (ftruncate(fd, size) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return 0;
+  }
+  void* p = mmap(nullptr, size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (p == MAP_FAILED) {
+    shm_unlink(name);
+    return 0;
+  }
+  return static_cast<int64_t>(reinterpret_cast<intptr_t>(p));
+}
+
+PT_EXPORT int64_t pt_shm_open_map(const char* name, int64_t size) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return 0;
+  void* p = mmap(nullptr, size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (p == MAP_FAILED) return 0;
+  return static_cast<int64_t>(reinterpret_cast<intptr_t>(p));
+}
+
+PT_EXPORT int pt_shm_unmap(int64_t addr, int64_t size) {
+  return munmap(reinterpret_cast<void*>(static_cast<intptr_t>(addr)), size);
+}
+
+PT_EXPORT int pt_shm_unlink(const char* name) { return shm_unlink(name); }

@@ -81,8 +81,104 @@ def _to_tensor_tree(obj):
     return obj
 
 
+_SHM_TAG = "__ptshm__"
+
+
+def _shm_pack(data, name):
+    """Write the batch's numpy leaves into one shared-memory segment
+    (native shm.cc; ref mmap_allocator.cc): the queue then carries only
+    metadata instead of pickled tensor bytes. Returns the tagged payload
+    or None when shm is unavailable / there is nothing big to ship."""
+    try:
+        from ..core import ShmSegment, shm_available
+        if not shm_available():
+            return None
+    except Exception:
+        return None
+    leaves = []
+
+    def skel(obj):
+        # object/structured dtypes can't ride raw bytes — leave them on
+        # the pickle path
+        if isinstance(obj, np.ndarray) and obj.nbytes > 0 \
+                and not obj.dtype.hasobject:
+            leaves.append(obj)
+            return (_SHM_TAG, "leaf", len(leaves) - 1)
+        if isinstance(obj, dict):
+            return {k: skel(v) for k, v in obj.items()}
+        if isinstance(obj, (list, tuple)):
+            out = [skel(v) for v in obj]
+            return out if isinstance(obj, list) else tuple(out)
+        return obj
+
+    skeleton = skel(data)
+    if not leaves:
+        return None
+
+    def align(o):
+        return (o + 63) & ~63
+
+    total, offs = 0, []
+    for a in leaves:
+        offs.append(align(total))
+        total = offs[-1] + a.nbytes
+    try:
+        seg = ShmSegment.create(name, max(total, 1))
+    except Exception:
+        return None
+    buf = seg.buffer()
+    meta = []
+    for a, off in zip(leaves, offs):
+        # copy straight into the mapping (no tobytes() intermediate)
+        dst = np.frombuffer(buf, dtype=a.dtype, count=a.size,
+                            offset=off).reshape(a.shape)
+        np.copyto(dst, a)
+        meta.append((a.shape, a.dtype.str, off, a.nbytes))
+    seg.close()  # producer unmaps; the segment lives until consumer unlinks
+    return (_SHM_TAG, name, max(total, 1), skeleton, meta)
+
+
+def _shm_discard(payload):
+    """Unlink a packed segment without reading it (early-exit cleanup:
+    POSIX shm outlives the process, so unconsumed payloads must not leak
+    into /dev/shm)."""
+    try:
+        from ..core import shm_unlink
+        shm_unlink(payload[1])
+    except Exception:
+        pass
+
+
+def _shm_unpack(payload):
+    """Rebuild the batch tree from a packed segment, then unlink it."""
+    from ..core import ShmSegment
+    _, name, total, skeleton, meta = payload
+    seg = ShmSegment.attach(name, total)
+    buf = seg.buffer()
+    arrs = [np.frombuffer(buf, dtype=np.dtype(dt), count=n // np.dtype(
+        dt).itemsize, offset=off).reshape(shape).copy()
+        for shape, dt, off, n in meta]
+
+    def rebuild(obj):
+        if isinstance(obj, tuple) and len(obj) == 3 and obj[0] == _SHM_TAG \
+                and obj[1] == "leaf":
+            return arrs[obj[2]]
+        if isinstance(obj, dict):
+            return {k: rebuild(v) for k, v in obj.items()}
+        if isinstance(obj, (list, tuple)):
+            out = [rebuild(v) for v in obj]
+            return out if isinstance(obj, list) else tuple(out)
+        return obj
+
+    out = rebuild(skeleton)
+    seg.close()
+    seg.unlink()
+    return out
+
+
 def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id,
-                 num_workers, seed):
+                 num_workers, seed, use_shared_memory=False):
+    import os
     _worker_info.info = WorkerInfo(worker_id, num_workers, dataset, seed)
     np.random.seed((seed + worker_id) % (2 ** 31))
     while True:
@@ -93,6 +189,11 @@ def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id,
         try:
             samples = [dataset[i] for i in indices]
             data = collate_fn(samples)
+            if use_shared_memory:
+                payload = _shm_pack(
+                    data, f"/ptdl_{os.getpid()}_{batch_id}")
+                if payload is not None:
+                    data = payload
             data_queue.put((batch_id, data, None))
         except Exception as e:  # propagate worker errors to the main process
             import traceback
@@ -108,6 +209,7 @@ class DataLoader:
                  persistent_workers=False):
         self.dataset = dataset
         self.num_workers = max(0, int(num_workers))
+        self.use_shared_memory = bool(use_shared_memory)
         self.collate_fn = collate_fn or default_collate_fn
         self.prefetch_factor = max(1, prefetch_factor)
         self.timeout = timeout
@@ -210,10 +312,12 @@ class DataLoader:
             w = ctx.Process(
                 target=_worker_loop,
                 args=(self.dataset, index_queues[wid], data_queue,
-                      self.collate_fn, wid, self.num_workers, seed),
+                      self.collate_fn, wid, self.num_workers, seed,
+                      self.use_shared_memory),
                 daemon=True)
             w.start()
             workers.append(w)
+        reorder: dict = {}
         try:
             batches = list(self.batch_sampler)
             n = len(batches)
@@ -224,7 +328,6 @@ class DataLoader:
                     if next_send < n:
                         index_queues[wid].put((next_send, batches[next_send]))
                         next_send += 1
-            reorder: dict = {}
             next_yield = 0
             while next_yield < n:
                 if next_yield in reorder:
@@ -236,6 +339,9 @@ class DataLoader:
                     timeout=self.timeout if self.timeout else None)
                 if err is not None:
                     raise RuntimeError(f"DataLoader worker failed:\n{err}")
+                if isinstance(data, tuple) and len(data) == 5 and \
+                        data[0] == _SHM_TAG:
+                    data = _shm_unpack(data)
                 if next_send < n:
                     index_queues[batch_id % self.num_workers].put(
                         (next_send, batches[next_send]))
@@ -247,6 +353,20 @@ class DataLoader:
                     q_.put(None)
                 except Exception:
                     pass
+            # drain unconsumed payloads (early break / error): their shm
+            # segments must be unlinked or they leak past process exit
+            for leftover in list(reorder.values()):
+                if isinstance(leftover, tuple) and len(leftover) == 5 \
+                        and leftover[0] == _SHM_TAG:
+                    _shm_discard(leftover)
+            while True:
+                try:
+                    _, data, _err = data_queue.get_nowait()
+                except Exception:
+                    break
+                if isinstance(data, tuple) and len(data) == 5 and \
+                        data[0] == _SHM_TAG:
+                    _shm_discard(data)
             for w in workers:
                 w.join(timeout=1)
                 if w.is_alive():

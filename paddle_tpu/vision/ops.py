@@ -496,9 +496,14 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
 
         pos = scat(jnp.ones((N, B), jnp.float32))
         obj_w = scat(score)
-        noobj = (1.0 - pos) * (~ignore).astype(jnp.float32)
-        loss_obj = (pos * obj_w * bce(tobj, jnp.ones_like(tobj))
-                    + noobj * bce(tobj, jnp.zeros_like(tobj))).sum((1, 2, 3))
+        # ref CalcObjnessLoss: obj > 1e-5 → positive weighted by the
+        # score; obj == 0 (incl. a responsible cell whose mixup score is
+        # ~0) → negative; ignored (-1) cells contribute nothing
+        pos_eff = pos * (obj_w > 1e-5).astype(jnp.float32)
+        neg = ((1.0 - pos) * (~ignore).astype(jnp.float32)
+               + pos * (obj_w <= 1e-5).astype(jnp.float32))
+        loss_obj = (pos_eff * obj_w * bce(tobj, jnp.ones_like(tobj))
+                    + neg * bce(tobj, jnp.zeros_like(tobj))).sum((1, 2, 3))
         return loss_loc + loss_obj + loss_cls
 
     args = [ensure_tensor(x), ensure_tensor(gt_box), ensure_tensor(gt_label)]

@@ -190,3 +190,61 @@ class TestTCPStore:
         for r in range(3):
             assert s.get(f"rank{r}") == str(r).encode()
         s.close()
+
+
+class TestShmSegment:
+    """Shared-memory batch transport (native shm.cc; ref
+    mmap_allocator.cc)."""
+
+    def test_create_attach_roundtrip(self):
+        import os
+        from paddle_tpu.core import ShmSegment, shm_available
+        if not shm_available():
+            pytest.skip("native core unavailable")
+        name = f"/pt_test_{os.getpid()}"
+        seg = ShmSegment.create(name, 64)
+        seg.buffer()[:5] = b"hello"
+        seg.close()
+        seg2 = ShmSegment.attach(name, 64)
+        assert bytes(seg2.buffer()[:5]) == b"hello"
+        seg2.close()
+        seg2.unlink()
+        with pytest.raises(OSError):
+            ShmSegment.attach(name, 64)  # unlinked
+
+    def test_dataloader_pack_unpack(self):
+        import os
+        from paddle_tpu.core import shm_available
+        if not shm_available():
+            pytest.skip("native core unavailable")
+        from paddle_tpu.io.dataloader import _shm_pack, _shm_unpack
+        rng = np.random.RandomState(0)
+        batch = (rng.randn(8, 3).astype(np.float32),
+                 {"y": rng.randint(0, 5, (8,)).astype(np.int64),
+                  "tag": "keep-me"})
+        payload = _shm_pack(batch, f"/pt_test_dl_{os.getpid()}")
+        assert payload is not None
+        out = _shm_unpack(payload)
+        np.testing.assert_array_equal(out[0], batch[0])
+        np.testing.assert_array_equal(out[1]["y"], batch[1]["y"])
+        assert out[1]["tag"] == "keep-me"
+
+    def test_dataloader_shared_memory_e2e(self):
+        from paddle_tpu.io import DataLoader
+        from paddle_tpu.io.dataset import Dataset
+
+        class DS(Dataset):
+            def __getitem__(self, i):
+                return (np.full((4, 4), i, np.float32),
+                        np.int64(i))
+
+            def __len__(self):
+                return 16
+
+        dl = DataLoader(DS(), batch_size=4, num_workers=2, shuffle=False,
+                        use_shared_memory=True)
+        seen = []
+        for x, y in dl:
+            assert x.shape == [4, 4, 4]
+            seen.extend(np.asarray(y._data).tolist())
+        assert sorted(seen) == list(range(16))

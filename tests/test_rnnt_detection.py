@@ -366,9 +366,17 @@ class TestYoloLoss:
                 Tensor(x), Tensor(gtb), Tensor(gtl),
                 gt_score=Tensor(sc), **kw)._data)
 
-        l0, l_half, l1 = loss_with(0.0), loss_with(0.5), loss_with(1.0)
-        assert not np.allclose(l_half, l1)
-        np.testing.assert_allclose(l_half, (l0 + l1) / 2, rtol=1e-5)
+        # linear on the positive range (score > 1e-5)
+        l25, l50, l75 = loss_with(0.25), loss_with(0.5), loss_with(0.75)
+        assert not np.allclose(l25, l75)
+        np.testing.assert_allclose(l50, (l25 + l75) / 2, rtol=1e-5)
+        # ref CalcObjnessLoss endpoint: score==0 flips the responsible
+        # cell to a NEGATIVE sample, adding SCE(conf, 0) loss beyond the
+        # linear extrapolation
+        l0 = loss_with(0.0)
+        extrap = 2 * l25 - l50
+        assert np.all(l0 > extrap - 1e-6)
+        assert np.any(l0 > extrap + 1e-6)
 
     def test_two_gts_in_same_cell_both_contribute(self):
         """Reference accumulates per-gt losses — a duplicate (cell,
