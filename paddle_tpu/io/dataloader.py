@@ -353,12 +353,16 @@ class DataLoader:
                     q_.put(None)
                 except Exception:
                     pass
-            # drain unconsumed payloads (early break / error): their shm
-            # segments must be unlinked or they leak past process exit
-            for leftover in list(reorder.values()):
-                if isinstance(leftover, tuple) and len(leftover) == 5 \
-                        and leftover[0] == _SHM_TAG:
-                    _shm_discard(leftover)
+            # join FIRST so in-flight batches land in the queue, THEN
+            # drain and unlink their shm segments (early break / error) —
+            # POSIX shm outlives the process, so unconsumed payloads must
+            # not leak into /dev/shm. (reorder never holds tagged
+            # payloads: they are unpacked before insertion.)
+            for w in workers:
+                w.join(timeout=2)
+                if w.is_alive():
+                    w.terminate()
+                    w.join(timeout=1)
             while True:
                 try:
                     _, data, _err = data_queue.get_nowait()
@@ -367,7 +371,3 @@ class DataLoader:
                 if isinstance(data, tuple) and len(data) == 5 and \
                         data[0] == _SHM_TAG:
                     _shm_discard(data)
-            for w in workers:
-                w.join(timeout=1)
-                if w.is_alive():
-                    w.terminate()

@@ -8,8 +8,10 @@ from .pooling import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .common import *  # noqa: F401,F403
+from .vision import *  # noqa: F401,F403
 
 from . import activation, conv, pooling, norm, loss, common  # noqa: F401
+from . import vision  # noqa: F401
 
 __all__ = (activation.__all__ + conv.__all__ + pooling.__all__ +
-           norm.__all__ + loss.__all__ + common.__all__)
+           norm.__all__ + loss.__all__ + common.__all__ + vision.__all__)

@@ -1,0 +1,118 @@
+"""Spatial sampling ops (ref: ``python/paddle/nn/functional/vision.py``
+``affine_grid`` / ``grid_sample`` → ``phi/kernels/.../grid_sample_kernel``).
+
+TPU-native: both are pure gather/arithmetic programs — the bilinear
+sample is four gathers + a lerp that XLA fuses, jit- and grad-friendly
+(no custom CUDA sampler kernel needed).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.op_utils import ensure_tensor, nary
+
+__all__ = ["affine_grid", "grid_sample"]
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """theta ``[N, 2, 3]`` affine matrices → sampling grid
+    ``[N, H, W, 2]`` of normalized (x, y) coords in [-1, 1]."""
+    if hasattr(out_shape, "_data"):
+        out_shape = [int(v) for v in out_shape._data]
+    N, C, H, W = [int(v) for v in out_shape]
+
+    def f(th):
+        if align_corners:
+            xs = jnp.linspace(-1.0, 1.0, W)
+            ys = jnp.linspace(-1.0, 1.0, H)
+        else:
+            xs = (jnp.arange(W) * 2 + 1) / W - 1.0
+            ys = (jnp.arange(H) * 2 + 1) / H - 1.0
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H, W, 3]
+        # [N,2,3] @ [H*W,3]^T -> [N,H,W,2]
+        out = jnp.einsum("nij,hwj->nhwi", th.astype(jnp.float32), base)
+        return out.astype(th.dtype)
+
+    return nary(f, [ensure_tensor(theta)], name="affine_grid")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample ``x [N, C, H, W]`` at ``grid [N, Hg, Wg, 2]`` normalized
+    (x, y) locations. Modes: bilinear | nearest; padding: zeros | border
+    | reflection (reference semantics)."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"mode must be bilinear|nearest, got {mode}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(f"bad padding_mode {padding_mode}")
+
+    def f(xd, g):
+        N, C, H, W = xd.shape
+        gf = g.astype(jnp.float32)
+        if align_corners:
+            ix = (gf[..., 0] + 1) / 2 * (W - 1)
+            iy = (gf[..., 1] + 1) / 2 * (H - 1)
+        else:
+            ix = ((gf[..., 0] + 1) * W - 1) / 2
+            iy = ((gf[..., 1] + 1) * H - 1) / 2
+
+        def reflect(v, lo, hi):
+            # reflect into [lo, hi] (reference GridSampler reflection)
+            if hi <= lo:
+                return jnp.zeros_like(v)
+            rng_ = hi - lo
+            v = jnp.abs(v - lo) % (2 * rng_)
+            return lo + jnp.where(v > rng_, 2 * rng_ - v, v)
+
+        if padding_mode == "reflection":
+            if align_corners:
+                ix = reflect(ix, 0.0, W - 1.0)
+                iy = reflect(iy, 0.0, H - 1.0)
+            else:
+                ix = jnp.clip(reflect(ix, -0.5, W - 0.5), 0, W - 1)
+                iy = jnp.clip(reflect(iy, -0.5, H - 0.5), 0, H - 1)
+        inb = ((ix >= 0) & (ix <= W - 1) & (iy >= 0)
+               & (iy <= H - 1)).astype(jnp.float32)
+
+        def fetch(yi, xi, valid):
+            yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+            xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+            flat = xd.reshape(N, C, H * W)
+            idx = (yc * W + xc).reshape(N, 1, -1)
+            got = jnp.take_along_axis(
+                flat, jnp.broadcast_to(idx, (N, C, idx.shape[-1])), axis=2)
+            got = got.reshape(N, C, *yi.shape[1:])
+            if padding_mode == "zeros":
+                got = got * valid[:, None].astype(got.dtype)
+            return got
+
+        if mode == "nearest":
+            yn, xn = jnp.round(iy), jnp.round(ix)
+            ok = inb if padding_mode == "zeros" else jnp.ones_like(inb)
+            return fetch(yn, xn, ((yn >= 0) & (yn <= H - 1) & (xn >= 0)
+                                  & (xn <= W - 1)).astype(jnp.float32)
+                         if padding_mode == "zeros" else ok)
+
+        x0, y0 = jnp.floor(ix), jnp.floor(iy)
+        wx, wy = ix - x0, iy - y0
+
+        def ok(yi, xi):
+            if padding_mode != "zeros":
+                return jnp.ones_like(yi)
+            return ((yi >= 0) & (yi <= H - 1) & (xi >= 0)
+                    & (xi <= W - 1)).astype(jnp.float32)
+
+        v00 = fetch(y0, x0, ok(y0, x0))
+        v01 = fetch(y0, x0 + 1, ok(y0, x0 + 1))
+        v10 = fetch(y0 + 1, x0, ok(y0 + 1, x0))
+        v11 = fetch(y0 + 1, x0 + 1, ok(y0 + 1, x0 + 1))
+        wx = wx[:, None]
+        wy = wy[:, None]
+        out = (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+               + v10 * wy * (1 - wx) + v11 * wy * wx)
+        return out.astype(xd.dtype)
+
+    return nary(f, [ensure_tensor(x), ensure_tensor(grid)],
+                name="grid_sample")

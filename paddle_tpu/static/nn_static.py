@@ -85,3 +85,150 @@ def embedding(input, size, weight_attr=None, is_sparse=False,
     layer = _nn_mod().Embedding(size[0], size[1], padding_idx=padding_idx,
                           weight_attr=weight_attr)
     return layer(input)
+
+
+# -- control flow (ref: python/paddle/static/nn/control_flow.py ---------------
+# cond :1253, While/while_loop :1507, case :123?, switch_case) — the
+# reference lowers these to ConditionalBlock / While ops interpreted by the
+# executor; here they ARE the XLA structured-control-flow primitives
+# (lax.cond / lax.while_loop / lax.switch), the compiler-friendly form the
+# task maps to on TPU. With a CONCRETE predicate (eager mode) they take the
+# Python branch directly, which keeps full tape autograd.
+
+def _tree_arrays(obj):
+    from ..tensor import Tensor
+    import jax
+    return jax.tree.map(
+        lambda t: t._data if isinstance(t, Tensor) else t, obj,
+        is_leaf=lambda t: isinstance(t, Tensor))
+
+
+def _tree_tensors(obj, like):
+    from ..tensor import Tensor
+    import jax
+    return jax.tree.map(
+        lambda a, t: Tensor(a) if isinstance(t, Tensor) else a, obj, like,
+        is_leaf=lambda t: isinstance(t, Tensor))
+
+
+def _is_traced(x):
+    import jax
+    from ..tensor import Tensor
+    d = x._data if isinstance(x, Tensor) else x
+    return isinstance(d, jax.core.Tracer)
+
+
+def _wrap_arrays(obj):
+    """Wrap every array leaf of a lax control-flow output as a Tensor."""
+    import jax
+    from ..tensor import Tensor
+    return jax.tree.map(
+        lambda a: Tensor(a)
+        if isinstance(a, (jax.Array, jax.core.Tracer)) else a, obj)
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """ref ``static/nn/control_flow.py cond``: run ``true_fn()`` or
+    ``false_fn()`` by ``pred``. Concrete pred → direct Python branch
+    (differentiable on the tape); traced pred → ``lax.cond`` (both
+    branches must return matching structures, same contract as the
+    reference)."""
+    import jax
+    from jax import lax
+    from ..tensor import Tensor
+
+    false_fn = false_fn or (lambda: None)
+    true_fn = true_fn or (lambda: None)
+    if not _is_traced(pred):
+        p = bool(pred._data if isinstance(pred, Tensor) else pred)
+        return true_fn() if p else false_fn()
+    p = pred._data if isinstance(pred, Tensor) else pred
+    p = p.reshape(()) if getattr(p, "ndim", 0) else p
+    # BOTH branches trace inside lax.cond (never pre-executed in the
+    # enclosing trace — a domain-guarded op in the unselected branch must
+    # not run, or its NaNs poison gradients through 0*nan)
+    out_arrays = lax.cond(p,
+                          lambda: _tree_arrays(true_fn()),
+                          lambda: _tree_arrays(false_fn()))
+    return _wrap_arrays(out_arrays)
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """ref ``control_flow.py while_loop``: iterate ``body`` while
+    ``cond(*vars)`` holds. Concrete entry values still lower through
+    ``lax.while_loop`` so the loop compiles to ONE XLA while op instead
+    of unrolling (reverse-mode AD through it is not defined — use
+    ``lax.scan``-style fixed-length loops for differentiable recurrences,
+    the same restriction the compiled reference path has)."""
+    from jax import lax
+    from ..tensor import Tensor
+
+    loop_vars = list(loop_vars)
+
+    def c(arrs):
+        out = cond(*_tree_tensors(arrs, loop_vars))
+        out = out._data if isinstance(out, Tensor) else out
+        return out.reshape(()) if getattr(out, "ndim", 0) else out
+
+    def b(arrs):
+        out = body(*_tree_tensors(arrs, loop_vars))
+        if not isinstance(out, (list, tuple)):
+            out = [out]
+        return _tree_arrays(list(out))
+
+    final = lax.while_loop(c, b, _tree_arrays(loop_vars))
+    return _tree_tensors(final, loop_vars)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """ref ``control_flow.py case``: first pair whose pred holds wins."""
+    if not pred_fn_pairs:
+        raise ValueError("case needs at least one (pred, fn) pair")
+    pred0, fn0 = pred_fn_pairs[0]
+    rest = pred_fn_pairs[1:]
+    if default is None and not rest:
+        return cond(pred0, fn0, fn0)
+    return cond(pred0, fn0,
+                (lambda: case(rest, default)) if rest else default)
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """ref ``control_flow.py switch_case``: pick a branch by integer
+    index (``lax.switch`` when traced; direct call when concrete)."""
+    import jax
+    from jax import lax
+    from ..tensor import Tensor
+
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = list(enumerate(branch_fns))
+    keys = [k for k, _ in items]
+    fns = [f for _, f in items]
+    idx = branch_index._data if isinstance(branch_index, Tensor) \
+        else branch_index
+    if not _is_traced(branch_index):
+        i = int(idx)
+        for k, f in items:
+            if k == i:
+                return f()
+        if default is not None:
+            return default()
+        raise ValueError(f"branch index {i} not in {keys} and no default")
+    if keys != list(range(len(keys))):
+        raise ValueError(
+            "traced switch_case requires contiguous 0..N-1 branch keys")
+    n_real = len(fns)
+    if default is not None:
+        fns = fns + [default]
+    arr_fns = [(lambda f=f: _tree_arrays(f())) for f in fns]
+    if default is not None:
+        # out-of-range index selects the default slot (eager parity)
+        sel = jax.numpy.where((idx >= 0) & (idx < n_real), idx, n_real)
+    else:
+        sel = jax.numpy.clip(idx, 0, n_real - 1)
+    out = lax.switch(sel, arr_fns)
+    return _wrap_arrays(out)
+
+
+__all__ += ["cond", "while_loop", "case", "switch_case"]

@@ -1,0 +1,131 @@
+"""static.nn control flow (ref: python/paddle/static/nn/control_flow.py
+cond/while_loop/case/switch_case) — eager Python-branch semantics plus
+lax.cond/while_loop/switch under trace."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.static import nn as snn
+from paddle_tpu.tensor import Tensor
+
+
+def test_cond_eager_differentiable():
+    x = pt.to_tensor(np.array([2.0], np.float32))
+    x.stop_gradient = False
+    out = snn.cond(pt.to_tensor(True),
+                   lambda: x * 3.0, lambda: x * 5.0)
+    out.backward()
+    assert float(x.grad) == 3.0
+    out2 = snn.cond(pt.to_tensor(False),
+                    lambda: x * 3.0, lambda: x * 5.0)
+    assert float(out2) == 10.0
+
+
+def test_cond_traced_lowers_to_lax_cond():
+    from paddle_tpu.jit.api import to_static
+
+    @to_static
+    def f(x):
+        return snn.cond(x.sum() > 0,
+                        lambda: x * 2.0, lambda: x - 1.0)
+
+    a = np.ones((3,), np.float32)
+    np.testing.assert_allclose(np.asarray(f(pt.to_tensor(a))._data), a * 2)
+    np.testing.assert_allclose(
+        np.asarray(f(pt.to_tensor(-a))._data), -a - 1)
+
+
+def test_while_loop_compiles_single_while_op():
+    i = pt.to_tensor(np.int32(0))
+    acc = pt.to_tensor(np.float32(1.0))
+    if_, acc_f = snn.while_loop(
+        lambda i, a: i < 5,
+        lambda i, a: [i + 1, a * 2.0],
+        [i, acc])
+    assert int(if_) == 5 and float(acc_f) == 32.0
+
+
+def test_while_loop_under_jit():
+    from paddle_tpu.jit.api import to_static
+
+    @to_static
+    def f(n):
+        i = pt.to_tensor(np.int32(0))
+        s = pt.to_tensor(np.float32(0.0))
+        _, out = snn.while_loop(lambda i, s: i < n,
+                                lambda i, s: [i + 1, s + 2.0],
+                                [i, s])
+        return out
+
+    assert float(f(pt.to_tensor(np.int32(4)))) == 8.0
+    assert float(f(pt.to_tensor(np.int32(7)))) == 14.0
+
+
+def test_case_and_switch_case():
+    x = pt.to_tensor(np.float32(3.0))
+    out = snn.case([(pt.to_tensor(False), lambda: x * 10),
+                    (pt.to_tensor(True), lambda: x + 1)],
+                   default=lambda: x)
+    assert float(out) == 4.0
+
+    out2 = snn.switch_case(pt.to_tensor(np.int32(1)),
+                           [lambda: x * 2, lambda: x * 3, lambda: x * 4])
+    assert float(out2) == 9.0
+    out3 = snn.switch_case(pt.to_tensor(np.int32(9)),
+                           {0: lambda: x, 1: lambda: x * 2},
+                           default=lambda: x * 100)
+    assert float(out3) == 300.0
+
+
+def test_switch_case_traced():
+    from paddle_tpu.jit.api import to_static
+    x = pt.to_tensor(np.float32(2.0))
+
+    @to_static
+    def f(i):
+        return snn.switch_case(i, [lambda: x * 2, lambda: x * 3,
+                                   lambda: x * 4])
+
+    assert float(f(pt.to_tensor(np.int32(0)))) == 4.0
+    assert float(f(pt.to_tensor(np.int32(2)))) == 8.0
+
+
+def test_cond_unselected_branch_never_executes():
+    """A domain-guarded op in the unselected branch must not poison
+    gradients (both branches trace INSIDE lax.cond)."""
+    from paddle_tpu import autograd
+    from paddle_tpu.jit.api import to_static
+
+    @to_static
+    def f(x):
+        # pred False selects the safe branch; sqrt of the NEGATIVE input
+        # sits in the UNSELECTED branch and must contribute nothing
+        out = snn.cond(x.sum() > 0,
+                       lambda: pt.sqrt(x),
+                       lambda: x * 2.0)
+        return out.sum()
+
+    x = pt.to_tensor(np.array([-4.0, -9.0], np.float32))
+    x.stop_gradient = False
+    y = f(x)
+    (g,) = autograd.grad(y, x)
+    assert float(y) == -26.0
+    np.testing.assert_allclose(np.asarray(g._data), [2.0, 2.0])
+    assert np.all(np.isfinite(np.asarray(g._data)))
+
+
+def test_switch_case_traced_out_of_range_uses_default():
+    from paddle_tpu.jit.api import to_static
+    x = pt.to_tensor(np.float32(2.0))
+
+    @to_static
+    def f(i):
+        return snn.switch_case(i, [lambda: x * 2, lambda: x * 3],
+                               default=lambda: x * 100)
+
+    assert float(f(pt.to_tensor(np.int32(-1)))) == 200.0
+    assert float(f(pt.to_tensor(np.int32(5)))) == 200.0
+    assert float(f(pt.to_tensor(np.int32(1)))) == 6.0

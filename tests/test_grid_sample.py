@@ -1,0 +1,88 @@
+"""affine_grid / grid_sample (ref: python/paddle/nn/functional/vision.py
+-> phi grid_sample kernels). Oracles: identity-transform passthrough,
+integer-shift equivalence, manual bilinear math."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn.functional as F
+from paddle_tpu.tensor import Tensor
+
+
+def _x(N=1, C=2, H=5, W=5, seed=0):
+    return np.random.RandomState(seed).randn(N, C, H, W).astype(np.float32)
+
+
+def _identity_grid(N, H, W):
+    theta = np.tile(np.array([[1, 0, 0], [0, 1, 0]], np.float32), (N, 1, 1))
+    return F.affine_grid(Tensor(theta), [N, 1, H, W], align_corners=True)
+
+
+def test_identity_affine_grid_samples_input_exactly():
+    x = _x()
+    grid = _identity_grid(1, 5, 5)
+    out = F.grid_sample(Tensor(x), grid, align_corners=True)
+    np.testing.assert_allclose(np.asarray(out._data), x, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_affine_grid_shape_and_range():
+    g = np.asarray(_identity_grid(2, 4, 6)._data)
+    assert g.shape == (2, 4, 6, 2)
+    assert g.min() == -1.0 and g.max() == 1.0
+
+
+def test_translation_shifts_pixels():
+    x = _x(H=4, W=4)
+    # shift one pixel right in normalized units (align_corners=True)
+    theta = np.array([[[1, 0, 2.0 / 3.0], [0, 1, 0]]], np.float32)
+    grid = F.affine_grid(Tensor(theta), [1, 1, 4, 4], align_corners=True)
+    out = np.asarray(F.grid_sample(Tensor(x), grid,
+                                   align_corners=True)._data)
+    np.testing.assert_allclose(out[..., :3], x[..., 1:], rtol=1e-4,
+                               atol=1e-5)
+    # zeros padding beyond the right edge
+    np.testing.assert_allclose(out[..., 3], 0.0, atol=1e-6)
+
+
+def test_border_and_reflection_padding():
+    x = _x(H=4, W=4)
+    theta = np.array([[[1, 0, 1.0], [0, 1, 0]]], np.float32)  # big shift
+    grid = F.affine_grid(Tensor(theta), [1, 1, 4, 4], align_corners=True)
+    border = np.asarray(F.grid_sample(Tensor(x), grid,
+                                      padding_mode="border",
+                                      align_corners=True)._data)
+    np.testing.assert_allclose(border[..., -1], x[..., -1], rtol=1e-5)
+    refl = np.asarray(F.grid_sample(Tensor(x), grid,
+                                    padding_mode="reflection",
+                                    align_corners=True)._data)
+    assert np.all(np.isfinite(refl))
+
+
+def test_nearest_mode_matches_rounding():
+    x = _x(H=3, W=3)
+    grid = _identity_grid(1, 3, 3)
+    out = np.asarray(F.grid_sample(Tensor(x), grid, mode="nearest",
+                                   align_corners=True)._data)
+    np.testing.assert_allclose(out, x, rtol=1e-6)
+
+
+def test_manual_bilinear_point():
+    x = np.zeros((1, 1, 2, 2), np.float32)
+    x[0, 0] = [[1.0, 2.0], [3.0, 4.0]]
+    # sample the exact center: average of all four
+    grid = np.zeros((1, 1, 1, 2), np.float32)
+    out = F.grid_sample(Tensor(x), Tensor(grid), align_corners=True)
+    assert abs(float(out._data.reshape(())) - 2.5) < 1e-6
+
+
+def test_gradients_flow_through_sampler():
+    x = Tensor(_x())
+    x.stop_gradient = False
+    theta = Tensor(np.array([[[1, 0, 0.1], [0, 1, -0.1]]], np.float32))
+    theta.stop_gradient = False
+    grid = F.affine_grid(theta, [1, 1, 5, 5], align_corners=True)
+    out = F.grid_sample(x, grid, align_corners=True)
+    out.sum().backward()
+    assert np.abs(np.asarray(x.grad._data)).sum() > 0
+    assert np.abs(np.asarray(theta.grad._data)).sum() > 0
