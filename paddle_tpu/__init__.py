@@ -58,7 +58,7 @@ def _optional_submodules():
              "vision", "metric", "hapi", "profiler", "static", "incubate",
              "sparse", "distribution", "text", "audio", "quantization",
              "utils", "fft", "signal", "models", "callbacks", "regularizer",
-             "inference",
+             "inference", "geometric", "hub", "cost_model",
              "onnx"]
     loaded = {}
     for n in names:
@@ -73,6 +73,7 @@ def _optional_submodules():
 globals().update(_optional_submodules())
 
 # convenience top-level re-exports that depend on optional modules
+from .batch import batch  # noqa: F401
 try:
     from .framework.io_state import save, load  # noqa: F401
 except ImportError:

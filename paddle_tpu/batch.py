@@ -1,0 +1,22 @@
+"""``paddle.batch`` (ref: ``python/paddle/batch.py``)."""
+
+__all__ = ["batch"]
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Wrap an instance reader into a mini-batch reader."""
+    if batch_size <= 0:
+        raise ValueError(
+            f"batch_size should be a positive integer, got {batch_size}")
+
+    def batch_reader():
+        buf = []
+        for instance in reader():
+            buf.append(instance)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader

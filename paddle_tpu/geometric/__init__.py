@@ -1,0 +1,21 @@
+"""``paddle.geometric`` — graph-learning ops (ref:
+``python/paddle/geometric/__init__.py``).
+
+TPU stance: the reference backs these with hand-written CUDA scatter/gather
+kernels (``paddle/phi/kernels/gpu/graph_send_recv_kernel.cu``); here the
+reduction ops lower to XLA's native ``scatter-add/min/max`` HLO via
+``jax.ops.segment_*`` — one fused program, differentiable through the tape.
+The sampling / reindex ops are data-dependent-shape by nature and run on the
+host (they are CPU/GPU sync points in the reference too).
+"""
+from .math import segment_sum, segment_mean, segment_min, segment_max  # noqa: F401
+from .message_passing import send_u_recv, send_ue_recv, send_uv  # noqa: F401
+from .reindex import reindex_graph, reindex_heter_graph  # noqa: F401
+from .sampling import sample_neighbors, weighted_sample_neighbors  # noqa: F401
+
+__all__ = [
+    "send_u_recv", "send_ue_recv", "send_uv",
+    "segment_sum", "segment_mean", "segment_min", "segment_max",
+    "reindex_graph", "reindex_heter_graph",
+    "sample_neighbors", "weighted_sample_neighbors",
+]

@@ -1,0 +1,74 @@
+"""Segment reductions (ref: ``python/paddle/geometric/math.py``).
+
+Reference semantics: ``segment_ids`` is sorted non-negative int32/int64;
+output has ``max(segment_ids)+1`` rows; segments that never appear produce
+rows of 0 (the CUDA kernel leaves the zero-initialised output untouched —
+``paddle/phi/kernels/cpu/segment_pool_kernel.cc``). XLA needs a static row
+count, so the row count is read eagerly from ``segment_ids`` at op-build
+time (these APIs are eager/host-driven in the reference too).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..ops.op_utils import ensure_tensor, nary
+
+__all__ = ["segment_sum", "segment_mean", "segment_min", "segment_max"]
+
+
+def _num_segments(segment_ids) -> int:
+    ids = np.asarray(segment_ids)
+    if ids.size == 0:
+        return 0
+    return int(ids.max()) + 1
+
+
+def _segment_reduce(data, segment_ids, pool_type, name):
+    data = ensure_tensor(data)
+    segment_ids = ensure_tensor(segment_ids)
+    n = _num_segments(segment_ids)
+
+    def f(d, ids):
+        return _apply_segment(d, ids, n, pool_type)
+
+    return nary(f, [data, segment_ids], name=name)
+
+
+def _apply_segment(d, ids, n, pool_type):
+    """Pure segment reduce; also reused by message_passing."""
+    if pool_type == "sum":
+        return jax.ops.segment_sum(d, ids, num_segments=n)
+    if pool_type == "mean":
+        total = jax.ops.segment_sum(d, ids, num_segments=n)
+        count = jax.ops.segment_sum(jnp.ones((d.shape[0],), d.dtype), ids,
+                                    num_segments=n)
+        count = jnp.maximum(count, 1).reshape((n,) + (1,) * (d.ndim - 1))
+        return total / count
+    if pool_type in ("min", "max"):
+        fn = jax.ops.segment_min if pool_type == "min" else jax.ops.segment_max
+        out = fn(d, ids, num_segments=n)
+        # empty segments: the identity element (±inf / dtype extremum)
+        # must become 0 to match the reference's zero-init kernels
+        count = jax.ops.segment_sum(jnp.ones((d.shape[0],), jnp.int32), ids,
+                                    num_segments=n)
+        mask = (count > 0).reshape((n,) + (1,) * (d.ndim - 1))
+        return jnp.where(mask, out, jnp.zeros_like(out))
+    raise ValueError(f"unknown segment pool type {pool_type!r}")
+
+
+def segment_sum(data, segment_ids, name=None):
+    return _segment_reduce(data, segment_ids, "sum", "segment_sum")
+
+
+def segment_mean(data, segment_ids, name=None):
+    return _segment_reduce(data, segment_ids, "mean", "segment_mean")
+
+
+def segment_min(data, segment_ids, name=None):
+    return _segment_reduce(data, segment_ids, "min", "segment_min")
+
+
+def segment_max(data, segment_ids, name=None):
+    return _segment_reduce(data, segment_ids, "max", "segment_max")

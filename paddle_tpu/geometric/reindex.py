@@ -1,0 +1,57 @@
+"""Graph reindexing (ref: ``python/paddle/geometric/reindex.py``).
+
+Output shape depends on how many distinct node ids appear, so these run on
+the host (the reference's kernel is likewise a hash-table build —
+``paddle/phi/kernels/gpu/graph_reindex_kernel.cu`` — and syncs the stream).
+``value_buffer``/``index_buffer`` are accepted for API parity; the hash-table
+they pre-allocate in the reference has no analog here.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..tensor import Tensor
+
+__all__ = ["reindex_graph", "reindex_heter_graph"]
+
+
+def _reindex(x, neighbor_arrays):
+    """Shared core: build the old-id -> new-id map with x first, then
+    unseen neighbor ids in order of first appearance."""
+    x = np.asarray(x)
+    mapping = {int(v): i for i, v in enumerate(x)}
+    out_nodes = list(x.tolist())
+    reindexed = []
+    for neigh in neighbor_arrays:
+        idx = np.empty(len(neigh), dtype=x.dtype)
+        for j, v in enumerate(np.asarray(neigh).tolist()):
+            pos = mapping.get(int(v))
+            if pos is None:
+                pos = len(out_nodes)
+                mapping[int(v)] = pos
+                out_nodes.append(int(v))
+            idx[j] = pos
+        reindexed.append(idx)
+    return reindexed, np.asarray(out_nodes, dtype=x.dtype)
+
+
+def _dst_of(x_len, count, dtype):
+    return np.repeat(np.arange(x_len, dtype=dtype), np.asarray(count))
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    xs = np.asarray(x)
+    (reindex_src,), out_nodes = _reindex(xs, [np.asarray(neighbors)])
+    reindex_dst = _dst_of(len(xs), count, xs.dtype)
+    return (Tensor(reindex_src), Tensor(reindex_dst), Tensor(out_nodes))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    xs = np.asarray(x)
+    neighs = [np.asarray(n) for n in neighbors]
+    srcs, out_nodes = _reindex(xs, neighs)
+    dsts = [_dst_of(len(xs), c, xs.dtype) for c in count]
+    return (Tensor(np.concatenate(srcs)), Tensor(np.concatenate(dsts)),
+            Tensor(out_nodes))

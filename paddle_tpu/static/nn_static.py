@@ -227,7 +227,7 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
         sel = jax.numpy.where((idx >= 0) & (idx < n_real), idx, n_real)
     else:
         sel = jax.numpy.clip(idx, 0, n_real - 1)
-    out = lax.switch(sel, arr_fns)
+    out = lax.switch(sel.reshape(()), arr_fns)
     return _wrap_arrays(out)
 
 

@@ -1,0 +1,39 @@
+"""``paddle.utils`` (ref: ``python/paddle/utils/__init__.py``).
+
+Structure-tree helpers (`flatten`/`pack_sequence_as`/`map_structure`) ride
+``jax.tree_util`` — on this stack a "nested structure" IS a pytree, so the
+reference's hand-rolled recursion (``utils/layers_utils.py``) collapses to
+registered-pytree traversal.
+"""
+from . import unique_name  # noqa: F401
+from . import dlpack  # noqa: F401
+from . import download  # noqa: F401
+from . import cpp_extension  # noqa: F401
+from .deprecated import deprecated  # noqa: F401
+from .lazy_import import try_import  # noqa: F401
+from .install_check import run_check  # noqa: F401
+from .layers_utils import (  # noqa: F401
+    convert_to_list, is_sequence, to_sequence, flatten, pack_sequence_as,
+    map_structure, assert_same_structure,
+)
+
+__all__ = ["deprecated", "run_check", "require_version", "try_import",
+           "unique_name", "dlpack", "download", "cpp_extension"]
+
+
+def require_version(min_version, max_version=None):
+    """Check the installed framework version against bounds (ref:
+    ``python/paddle/fluid/framework.py require_version``)."""
+    from .. import __version__
+
+    def _tup(v):
+        return tuple(int(p) for p in str(v).split(".")[:3] if p.isdigit())
+
+    cur = _tup(__version__)
+    if _tup(min_version) > cur:
+        raise Exception(
+            f"installed version {__version__} < required {min_version}")
+    if max_version is not None and _tup(max_version) < cur:
+        raise Exception(
+            f"installed version {__version__} > allowed {max_version}")
+    return True

@@ -1,0 +1,106 @@
+"""Custom C++ op toolchain (ref:
+``python/paddle/utils/cpp_extension/cpp_extension.py``).
+
+The reference JIT-compiles CUDA/C++ custom operators against libpaddle and
+registers them into the op registry. The TPU compute path is XLA, so device
+code cannot be injected post-hoc — custom *device* ops are Pallas kernels +
+PyLayer (see ``incubate/nn``). What native extensions still buy on this
+stack is host-side work (readers, tokenizers, samplers), so:
+
+ - ``load(name, sources)`` JIT-compiles C++ into a shared library cached by
+   source hash (same atomic-rename scheme as ``core/build.py``) and returns
+   a ``ctypes.CDLL``.
+ - ``setup``/``CppExtension``/``BuildExtension`` wrap setuptools for
+   ahead-of-time builds of CPython extension modules, mirroring the
+   reference's entry points.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import tempfile
+
+__all__ = ["CppExtension", "load", "setup", "BuildExtension",
+           "get_build_directory"]
+
+
+def get_build_directory(verbose=False):
+    d = os.environ.get(
+        "PADDLE_TPU_EXTENSION_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                     "extensions"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def CppExtension(sources, *args, **kwargs):
+    """A setuptools Extension pre-configured for this toolchain."""
+    from setuptools import Extension
+    kwargs.setdefault("language", "c++")
+    extra = list(kwargs.pop("extra_compile_args", []) or [])
+    if not any(a.startswith("-std=") for a in extra):
+        extra.append("-std=c++17")
+    kwargs["extra_compile_args"] = extra
+    name = kwargs.pop("name", "paddle_tpu_ext")
+    return Extension(name, sources, *args, **kwargs)
+
+
+class BuildExtension:
+    """build_ext factory matching the reference's ``BuildExtension.with_options``."""
+
+    @classmethod
+    def with_options(cls, **options):
+        from setuptools.command.build_ext import build_ext
+
+        class _Cmd(build_ext):
+            def build_extensions(self):
+                for ext in self.extensions:
+                    ext.name = options.get("name", ext.name)
+                super().build_extensions()
+
+        return _Cmd
+
+
+def setup(**attrs):
+    from setuptools import setup as _setup
+    attrs.setdefault("cmdclass", {})
+    attrs["cmdclass"].setdefault(
+        "build_ext", BuildExtension.with_options(
+            name=attrs.get("name", "paddle_tpu_ext")))
+    return _setup(**attrs)
+
+
+def load(name, sources, extra_cxx_flags=None, build_directory=None,
+         verbose=False, **_ignored):
+    """JIT-compile C++ ``sources`` into ``lib<name>-<hash>.so`` and load it.
+
+    Returns a ``ctypes.CDLL``; call exported ``extern "C"`` symbols
+    directly, or wire them into a PyLayer for autograd.
+    """
+    import ctypes
+
+    build_directory = build_directory or get_build_directory()
+    flags = list(extra_cxx_flags or [])
+    h = hashlib.sha256()
+    for s in sources:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    h.update(" ".join(flags).encode())
+    so_path = os.path.join(build_directory,
+                           f"lib{name}-{h.hexdigest()[:16]}.so")
+    if not os.path.exists(so_path):
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=build_directory)
+        os.close(fd)
+        cmd = (["g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-pthread",
+                "-o", tmp] + flags + list(sources))
+        if verbose:
+            print(" ".join(cmd))
+        res = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=600)
+        if res.returncode != 0:
+            os.unlink(tmp)
+            raise RuntimeError(f"extension '{name}' build failed:\n"
+                               f"{res.stderr}")
+        os.replace(tmp, so_path)
+    return ctypes.CDLL(so_path)

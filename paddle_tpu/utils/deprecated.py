@@ -1,0 +1,34 @@
+"""``@deprecated`` decorator (ref: ``python/paddle/utils/deprecated.py``):
+prepends a Deprecated note to the docstring and warns once per call site."""
+from __future__ import annotations
+
+import functools
+import warnings
+
+__all__ = ["deprecated"]
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    def decorator(func):
+        msg = f'API "{func.__module__}.{func.__name__}" is deprecated'
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f', please use "{update_to}" instead'
+        if reason:
+            msg += f". Reason: {reason}"
+
+        doc = f"""\n\nWarning:\n    {msg}.\n\n"""
+        func.__doc__ = doc + (func.__doc__ or "")
+        if level == 0:
+            return func
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            if level == 2:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+
+        return wrapper
+    return decorator

@@ -1,0 +1,28 @@
+"""DLPack interop (ref: ``python/paddle/utils/dlpack.py``).
+
+Zero-copy tensor exchange with torch/numpy/cupy via the DLPack protocol;
+jax arrays speak it natively, so both directions are thin."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Export a Tensor as a DLPack-protocol object (implements
+    ``__dlpack__``/``__dlpack_device__``; consumable by torch/numpy/cupy
+    ``from_dlpack``). jax deprecated capsule export in favor of the
+    protocol, so the device buffer itself is the exchange object —
+    zero-copy either way."""
+    if isinstance(x, Tensor):
+        x = x._data
+    return jnp.asarray(x)
+
+
+def from_dlpack(dlpack):
+    """Import a DLPack capsule / __dlpack__-bearing object as a Tensor."""
+    return Tensor(jax.dlpack.from_dlpack(dlpack))

@@ -1,0 +1,85 @@
+"""Weight-file resolution (ref: ``python/paddle/utils/download.py``).
+
+This deployment runs with zero egress, so the network leg is gated: a URL
+resolves from the local cache (``$PADDLE_TPU_HOME/weights``, plus any dirs
+on ``$PADDLE_TPU_WEIGHT_PATH``) and a cache miss raises with the exact path
+to drop the file at. md5 verification and archive decompression — the parts
+that don't need a network — are fully implemented.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import os.path as osp
+import tarfile
+import zipfile
+
+__all__ = ["is_url", "get_weights_path_from_url", "get_path_from_url"]
+
+WEIGHTS_HOME = osp.join(
+    os.environ.get("PADDLE_TPU_HOME",
+                   osp.join(osp.expanduser("~"), ".cache", "paddle_tpu")),
+    "weights")
+
+
+def is_url(path):
+    return str(path).startswith(("http://", "https://"))
+
+
+def _search_dirs():
+    dirs = [WEIGHTS_HOME]
+    extra = os.environ.get("PADDLE_TPU_WEIGHT_PATH", "")
+    dirs += [d for d in extra.split(os.pathsep) if d]
+    return dirs
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)
+
+
+def get_path_from_url(url, root_dir=None, md5sum=None, check_exist=True,
+                      decompress=True):
+    if not is_url(url):
+        if osp.exists(url):
+            return url
+        raise FileNotFoundError(url)
+    fname = osp.split(url)[-1]
+    for d in ([root_dir] if root_dir else []) + _search_dirs():
+        fullname = osp.join(d, fname)
+        if osp.exists(fullname):
+            if md5sum and not _md5check(fullname, md5sum):
+                raise IOError(f"{fullname} exists but fails md5 check")
+            if decompress and (tarfile.is_tarfile(fullname)
+                               or zipfile.is_zipfile(fullname)):
+                return _decompress(fullname)
+            return fullname
+    raise RuntimeError(
+        f"cannot fetch {url}: this build runs without network access. "
+        f"Place the file at {osp.join(root_dir or WEIGHTS_HOME, fname)} "
+        f"or add its directory to $PADDLE_TPU_WEIGHT_PATH.")
+
+
+def _md5check(fullname, md5sum=None):
+    if md5sum is None:
+        return True
+    md5 = hashlib.md5()
+    with open(fullname, 'rb') as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            md5.update(chunk)
+    return md5.hexdigest() == md5sum
+
+
+def _decompress(fname):
+    dst_dir = osp.splitext(fname)[0]
+    if osp.isdir(dst_dir) and os.listdir(dst_dir):
+        return dst_dir
+    os.makedirs(dst_dir, exist_ok=True)
+    if tarfile.is_tarfile(fname):
+        with tarfile.open(fname) as tf:
+            tf.extractall(dst_dir, filter="data")
+    elif zipfile.is_zipfile(fname):
+        with zipfile.ZipFile(fname) as zf:
+            zf.extractall(dst_dir)
+    else:
+        raise TypeError(f"unsupported archive: {fname}")
+    return dst_dir

@@ -1,0 +1,93 @@
+"""``paddle.utils.run_check`` (ref:
+``python/paddle/utils/install_check.py:209``).
+
+Same shape as the reference's check — a tiny linear model is trained one
+step in dygraph and once through the compiled (to_static analog) path, then,
+when more than one device is visible, a data-parallel step runs over the
+full device mesh — but the parallel leg is a GSPMD ``pjit`` over a
+``jax.sharding.Mesh`` instead of spawning NCCL worker processes.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["run_check"]
+
+
+def _simple_network():
+    import paddle_tpu as paddle
+
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = paddle.nn.Linear(4, 8)
+            self.out = paddle.nn.Linear(8, 2)
+
+        def forward(self, x):
+            return self.out(paddle.nn.functional.relu(self.fc(x)))
+
+    net = Net()
+    x = paddle.to_tensor(np.random.rand(8, 4).astype(np.float32))
+    label = paddle.to_tensor(np.random.randint(0, 2, (8,)))
+    return net, x, label
+
+
+def _run_dygraph_single():
+    import paddle_tpu as paddle
+    net, x, label = _simple_network()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    loss = paddle.nn.functional.cross_entropy(net(x), label)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    return float(loss.item())
+
+
+def _run_compiled_single():
+    import paddle_tpu as paddle
+    net, x, label = _simple_network()
+
+    @paddle.jit.to_static
+    def step(x):
+        return paddle.nn.functional.cross_entropy(net(x), label)
+
+    return float(step(x).item())
+
+
+def _run_parallel(devices):
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    n = len(devices)
+    mesh = Mesh(np.asarray(devices), ("dp",))
+    w = jax.device_put(np.ones((4, 2), np.float32),
+                       NamedSharding(mesh, P()))
+    x = jax.device_put(np.random.rand(n * 2, 4).astype(np.float32),
+                       NamedSharding(mesh, P("dp")))
+
+    @jax.jit
+    def step(w, x):
+        return ((x @ w) ** 2).mean()
+
+    return float(step(w, x))
+
+
+def run_check():
+    import jax
+    import paddle_tpu as paddle
+
+    devs = jax.devices()
+    kind = devs[0].device_kind if devs else "none"
+    print(f"Running verify PaddlePaddle-TPU program ... "
+          f"({len(devs)} x {kind})")
+    _run_dygraph_single()
+    _run_compiled_single()
+    if len(devs) > 1:
+        _run_parallel(devs)
+        print(f"PaddlePaddle-TPU works well on {len(devs)} devices.")
+    else:
+        print("PaddlePaddle-TPU works well on 1 device.")
+    print("PaddlePaddle-TPU is installed successfully! Let's start deep "
+          "learning with PaddlePaddle-TPU now.")

@@ -1,0 +1,67 @@
+"""Nested-structure helpers (ref: ``python/paddle/utils/layers_utils.py``).
+
+The reference hand-rolls recursion over lists/tuples/dicts; here a nested
+structure is exactly a jax pytree, so flatten/pack/map delegate to
+``jax.tree_util`` (Tensors are leaves: they are not registered pytree
+nodes)."""
+from __future__ import annotations
+
+import collections.abc
+
+import jax
+
+__all__ = ["convert_to_list", "is_sequence", "to_sequence", "flatten",
+           "pack_sequence_as", "map_structure", "assert_same_structure"]
+
+
+def convert_to_list(value, n, name, dtype=int):
+    """Scalar -> n-list; validating n-sequence passthrough (conv arg glue)."""
+    if isinstance(value, dtype):
+        return [value] * n
+    try:
+        value_list = list(value)
+    except TypeError:
+        raise ValueError(
+            f"The {name}'s type must be {dtype} or {n}-elem sequence, "
+            f"received {value}")
+    if len(value_list) != n:
+        raise ValueError(f"The {name} must have {n} elements, got {value}")
+    return value_list
+
+
+def is_sequence(seq):
+    if isinstance(seq, dict):
+        return True
+    return (isinstance(seq, collections.abc.Sequence)
+            and not isinstance(seq, str))
+
+
+def to_sequence(nest):
+    return nest if is_sequence(nest) else [nest]
+
+
+def flatten(nest):
+    return jax.tree_util.tree_leaves(
+        nest, is_leaf=lambda x: not is_sequence(x))
+
+
+def pack_sequence_as(structure, flat_sequence):
+    treedef = jax.tree_util.tree_structure(
+        structure, is_leaf=lambda x: not is_sequence(x))
+    return jax.tree_util.tree_unflatten(treedef, flat_sequence)
+
+
+def map_structure(func, *structure):
+    return jax.tree_util.tree_map(
+        func, *structure, is_leaf=lambda x: not is_sequence(x))
+
+
+def assert_same_structure(nest1, nest2, check_types=True):
+    t1 = jax.tree_util.tree_structure(
+        nest1, is_leaf=lambda x: not is_sequence(x))
+    t2 = jax.tree_util.tree_structure(
+        nest2, is_leaf=lambda x: not is_sequence(x))
+    if t1 != t2:
+        raise ValueError(
+            f"The two structures don't have the same nested structure: "
+            f"{t1} vs {t2}")

@@ -1,0 +1,16 @@
+"""``try_import`` (ref: ``python/paddle/utils/lazy_import.py``)."""
+import importlib
+
+__all__ = ["try_import"]
+
+
+def try_import(module_name, err_msg=None):
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        if err_msg is None:
+            err_msg = (f"Failed importing {module_name}. This likely means "
+                       f"that some modules require additional dependencies "
+                       f"that have to be manually installed (usually with "
+                       f"`pip install {module_name}`).")
+        raise ImportError(err_msg)

@@ -34,6 +34,7 @@ def _cfg():
     return cfg
 
 
+@pytest.mark.slow
 def test_save_load_roundtrip_same_mesh(tmp_path):
     pt.seed(0)
     model = GPTForCausalLM(_cfg())
@@ -56,6 +57,7 @@ def test_save_load_roundtrip_same_mesh(tmp_path):
         np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
 
 
+@pytest.mark.slow
 def test_reshard_on_load_different_mesh(tmp_path):
     """Save on (dp2, mp2, sharding2); load on (dp4, mp2); resumed loss
     must match continuing on the original mesh bit-for-bit-ish."""

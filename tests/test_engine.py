@@ -105,6 +105,7 @@ def test_engine_strategy_amp_and_sharding():
     assert eng._state["opt"]["master"], "O2 master weights missing"
 
 
+@pytest.mark.slow
 def test_engine_save_load_roundtrip(tmp_path):
     dist.init_mesh({"dp": 8})
     model = _bert()
@@ -134,6 +135,7 @@ def test_to_static_returns_engine():
     assert isinstance(eng, Engine)
 
 
+@pytest.mark.slow
 def test_engine_fp16_o1_strategy_casts_matmuls():
     """amp with use_bf16=False (fp16 O1) must actually change compute
     dtype inside the compiled step, not silently run fp32."""

@@ -40,6 +40,7 @@ def test_ernie_forward_shapes_and_task_embedding():
     assert not np.allclose(np.asarray(seq._data), np.asarray(seq2._data))
 
 
+@pytest.mark.slow
 def test_ernie_pretraining_amp_o2_recompute_loss_decreases():
     """The config[4] recipe end-to-end: MLM+SOP pretraining, bf16 O2
     params, per-block recompute, one compiled train step on a dp mesh."""
@@ -71,6 +72,7 @@ def test_ernie_pretraining_amp_o2_recompute_loss_decreases():
     assert state["opt"]["master"], "O2 master weights missing"
 
 
+@pytest.mark.slow
 def test_ernie_recompute_matches_plain():
     """Per-block jax.checkpoint must not change the math."""
     pt.seed(2)
@@ -102,6 +104,7 @@ def test_ernie_recompute_matches_plain():
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_ernie_finetune_classifier():
     pt.seed(3)
     cfg = ernie_tiny()

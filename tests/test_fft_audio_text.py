@@ -48,6 +48,7 @@ class TestFFT:
 
 
 class TestSignal:
+    @pytest.mark.slow
     def test_stft_istft_round_trip(self):
         sig = np.sin(np.linspace(0, 50, 400)).astype(np.float32)[None]
         win = pt.audio.get_window("hann", 128)
@@ -233,6 +234,7 @@ class TestBert:
         np.testing.assert_allclose(seq2.numpy()[:, :6], seq3.numpy()[:, :6],
                                    atol=1e-5)
 
+    @pytest.mark.slow
     def test_pretraining_heads(self):
         from paddle_tpu.incubate.models import (bert_tiny,
                                                 BertForPretraining,

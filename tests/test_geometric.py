@@ -1,0 +1,196 @@
+"""paddle.geometric parity tests.
+
+Expected values follow the reference docstrings
+(``python/paddle/geometric/message_passing/send_recv.py:36``,
+``geometric/reindex.py:25``, ``geometric/math.py:23``).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestSegment:
+    def _data(self):
+        return paddle.to_tensor(
+            np.array([[1., 2., 3.], [3., 2., 1.], [4., 5., 6.]], np.float32))
+
+    def test_sum(self):
+        out = paddle.geometric.segment_sum(
+            self._data(), paddle.to_tensor(np.array([0, 0, 1], np.int32)))
+        np.testing.assert_allclose(out.numpy(), [[4., 4., 4.], [4., 5., 6.]])
+
+    def test_mean(self):
+        out = paddle.geometric.segment_mean(
+            self._data(), paddle.to_tensor(np.array([0, 0, 1], np.int32)))
+        np.testing.assert_allclose(out.numpy(), [[2., 2., 2.], [4., 5., 6.]])
+
+    def test_min_max(self):
+        ids = paddle.to_tensor(np.array([0, 0, 1], np.int32))
+        mn = paddle.geometric.segment_min(self._data(), ids)
+        mx = paddle.geometric.segment_max(self._data(), ids)
+        np.testing.assert_allclose(mn.numpy(), [[1., 2., 1.], [4., 5., 6.]])
+        np.testing.assert_allclose(mx.numpy(), [[3., 2., 3.], [4., 5., 6.]])
+
+    def test_empty_segment_zero_filled(self):
+        # segment 1 never appears: row must be 0, not +/-inf
+        ids = paddle.to_tensor(np.array([0, 0, 2], np.int32))
+        for fn in (paddle.geometric.segment_min, paddle.geometric.segment_max,
+                   paddle.geometric.segment_sum, paddle.geometric.segment_mean):
+            out = fn(self._data(), ids)
+            assert out.shape[0] == 3
+            np.testing.assert_allclose(out.numpy()[1], [0., 0., 0.])
+
+    def test_grad(self):
+        x = self._data()
+        x.stop_gradient = False
+        ids = paddle.to_tensor(np.array([0, 0, 1], np.int32))
+        out = paddle.geometric.segment_mean(x, ids)
+        out.sum().backward()
+        np.testing.assert_allclose(
+            x.grad.numpy(), [[.5] * 3, [.5] * 3, [1.] * 3])
+
+
+class TestSendRecv:
+    def setup_method(self, _):
+        self.x = paddle.to_tensor(
+            np.array([[0., 2., 3.], [1., 4., 5.], [2., 6., 7.]], np.float32))
+        self.src = paddle.to_tensor(np.array([0, 1, 2, 0], np.int32))
+        self.dst = paddle.to_tensor(np.array([1, 2, 1, 0], np.int32))
+
+    def test_sum(self):
+        out = paddle.geometric.send_u_recv(self.x, self.src, self.dst,
+                                           reduce_op="sum")
+        np.testing.assert_allclose(
+            out.numpy(), [[0., 2., 3.], [2., 8., 10.], [1., 4., 5.]])
+
+    def test_mean_out_size(self):
+        out = paddle.geometric.send_u_recv(self.x, self.src, self.dst,
+                                           reduce_op="mean", out_size=4)
+        assert out.shape[0] == 4
+        np.testing.assert_allclose(out.numpy()[1], [1., 4., 5.])
+        np.testing.assert_allclose(out.numpy()[3], [0., 0., 0.])
+
+    def test_max_grad(self):
+        self.x.stop_gradient = False
+        out = paddle.geometric.send_u_recv(self.x, self.src, self.dst,
+                                           reduce_op="max")
+        out.sum().backward()
+        assert self.x.grad is not None
+
+    def test_send_ue_recv(self):
+        e = paddle.to_tensor(np.array([1., 2., 3., 4.], np.float32))
+        out = paddle.geometric.send_ue_recv(self.x, e, self.src, self.dst,
+                                            message_op="add", reduce_op="sum")
+        # messages: x[0]+1 -> 1, x[1]+2 -> 2, x[2]+3 -> 1, x[0]+4 -> 0
+        np.testing.assert_allclose(
+            out.numpy(), [[4., 6., 7.], [6., 12., 14.], [3., 6., 7.]])
+
+    def test_send_uv(self):
+        y = paddle.to_tensor(
+            np.array([[0., 1., 2.], [2., 3., 4.], [4., 5., 6.]], np.float32))
+        out = paddle.geometric.send_uv(self.x, y, self.src, self.dst,
+                                       message_op="mul")
+        np.testing.assert_allclose(out.numpy()[0],
+                                   self.x.numpy()[0] * y.numpy()[1])
+        assert out.shape == [4, 3]
+
+    def test_bad_ops_raise(self):
+        with pytest.raises(ValueError):
+            paddle.geometric.send_u_recv(self.x, self.src, self.dst,
+                                         reduce_op="prod")
+        with pytest.raises(ValueError):
+            paddle.geometric.send_ue_recv(
+                self.x, self.x, self.src, self.dst, message_op="pow")
+
+
+class TestReindex:
+    def test_reindex_graph(self):
+        x = paddle.to_tensor(np.array([0, 1, 2], np.int64))
+        neighbors = paddle.to_tensor(np.array([8, 9, 0, 4, 7, 6, 7], np.int64))
+        count = paddle.to_tensor(np.array([2, 3, 2], np.int32))
+        src, dst, nodes = paddle.geometric.reindex_graph(x, neighbors, count)
+        np.testing.assert_array_equal(src.numpy(), [3, 4, 0, 5, 6, 7, 6])
+        np.testing.assert_array_equal(dst.numpy(), [0, 0, 1, 1, 1, 2, 2])
+        np.testing.assert_array_equal(nodes.numpy(), [0, 1, 2, 8, 9, 4, 7, 6])
+
+    def test_reindex_heter_graph(self):
+        x = paddle.to_tensor(np.array([0, 1, 2], np.int64))
+        na = np.array([8, 9, 0, 4, 7, 6, 7], np.int64)
+        nb = np.array([0, 2, 3, 5, 1], np.int64)
+        ca, cb = np.array([2, 3, 2], np.int32), np.array([1, 3, 1], np.int32)
+        src, dst, nodes = paddle.geometric.reindex_heter_graph(
+            x, [na, nb], [ca, cb])
+        np.testing.assert_array_equal(
+            src.numpy(), [3, 4, 0, 5, 6, 7, 6, 0, 2, 8, 9, 1])
+        np.testing.assert_array_equal(
+            dst.numpy(), [0, 0, 1, 1, 1, 2, 2, 0, 1, 1, 1, 2])
+        np.testing.assert_array_equal(
+            nodes.numpy(), [0, 1, 2, 8, 9, 4, 7, 6, 3, 5])
+
+
+class TestSampling:
+    def _csc(self):
+        # 3 nodes; node0 <- {1,2}, node1 <- {0,1,2,0}, node2 <- {2}
+        row = np.array([1, 2, 0, 1, 2, 0, 2], np.int64)
+        colptr = np.array([0, 2, 6, 7], np.int64)
+        return row, colptr
+
+    def test_full_neighborhood(self):
+        row, colptr = self._csc()
+        n, c = paddle.geometric.sample_neighbors(
+            paddle.to_tensor(row), paddle.to_tensor(colptr),
+            paddle.to_tensor(np.array([0, 2], np.int64)), sample_size=-1)
+        np.testing.assert_array_equal(c.numpy(), [2, 1])
+        np.testing.assert_array_equal(n.numpy(), [1, 2, 2])
+
+    def test_subsample_and_eids(self):
+        row, colptr = self._csc()
+        eids = np.arange(7, dtype=np.int64) * 10
+        n, c, e = paddle.geometric.sample_neighbors(
+            paddle.to_tensor(row), paddle.to_tensor(colptr),
+            paddle.to_tensor(np.array([1], np.int64)), sample_size=2,
+            eids=paddle.to_tensor(eids), return_eids=True)
+        assert c.numpy()[0] == 2 and len(n.numpy()) == 2
+        # sampled eids must point back at the sampled rows
+        for ei, ni in zip(e.numpy(), n.numpy()):
+            assert row[ei // 10] == ni
+
+    def test_weighted_prefers_heavy_edges(self):
+        row, colptr = self._csc()
+        # node1 has 4 in-edges; weight edge idx 3 (row value 1) overwhelmingly
+        w = np.array([1, 1, 1e-6, 1e6, 1e-6, 1e-6, 1], np.float64)
+        hits = 0
+        for s in range(20):
+            paddle.seed(1000 + s)
+            n, c = paddle.geometric.weighted_sample_neighbors(
+                paddle.to_tensor(row), paddle.to_tensor(colptr),
+                paddle.to_tensor(w),
+                paddle.to_tensor(np.array([1], np.int64)), sample_size=1)
+            hits += int(n.numpy()[0] == 1)
+        assert hits >= 18
+
+    def test_successive_calls_draw_fresh_samples(self):
+        # regression: _rng must advance the generator counter, not
+        # rebuild from the fixed seed (else every mini-batch sees the
+        # identical neighborhood)
+        row, colptr = self._csc()
+        paddle.seed(3)
+        draws = set()
+        for _ in range(6):
+            n, _ = paddle.geometric.sample_neighbors(
+                paddle.to_tensor(row), paddle.to_tensor(colptr),
+                paddle.to_tensor(np.array([1], np.int64)), sample_size=2)
+            draws.add(tuple(n.numpy().tolist()))
+        assert len(draws) > 1
+
+    def test_deterministic_under_seed(self):
+        row, colptr = self._csc()
+        outs = []
+        for _ in range(2):
+            paddle.seed(7)
+            n, _ = paddle.geometric.sample_neighbors(
+                paddle.to_tensor(row), paddle.to_tensor(colptr),
+                paddle.to_tensor(np.array([1], np.int64)), sample_size=2)
+            outs.append(n.numpy())
+        np.testing.assert_array_equal(outs[0], outs[1])

@@ -41,6 +41,7 @@ def test_gpt_forward_shapes():
     assert logits.shape == [2, 16, 1024]
 
 
+@pytest.mark.slow
 def test_gpt_loss_backward_eager():
     dist.init_mesh({"dp": 8})
     pt.seed(0)
@@ -58,6 +59,7 @@ def test_gpt_loss_backward_eager():
     assert np.isfinite(float(loss))
 
 
+@pytest.mark.slow
 def test_gpt_hybrid_train_step_matches_single_device():
     """dp2 × mp2 × sharding2 compiled step == single-device step."""
     pt.seed(0)
@@ -99,6 +101,7 @@ def test_gpt_hybrid_train_step_matches_single_device():
         rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_gpt_recompute_matches_plain():
     pt.seed(0)
     dist.init_mesh({"dp": 1})
@@ -135,6 +138,7 @@ def test_gpt_rope_variant_runs():
     assert logits.shape == [2, 8, 1024]
 
 
+@pytest.mark.slow
 def test_gpt_pipeline_pp2_matches_single_device():
     """dp2 × mp2 × pp2 compiled 1F1B == single-device step, 3 steps.
 

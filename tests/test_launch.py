@@ -5,6 +5,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 SCRIPT = """
 import os, sys
 rank = int(os.environ["PADDLE_TRAINER_ID"])
@@ -28,6 +30,7 @@ def _run(tmp_path, extra_args, script_args=()):
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+@pytest.mark.slow
 def test_launch_two_procs(tmp_path):
     r = _run(tmp_path, ["--nproc_per_node", "2"])
     assert r.returncode == 0, r.stderr
@@ -37,6 +40,7 @@ def test_launch_two_procs(tmp_path):
     assert "rank=1 world=2" in body
 
 
+@pytest.mark.slow
 def test_launch_propagates_failure(tmp_path):
     r = _run(tmp_path, ["--nproc_per_node", "2"], ("--fail",))
     assert r.returncode == 3

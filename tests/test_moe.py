@@ -81,6 +81,7 @@ def test_moe_layer_forward_backward(gate_type):
     assert np.isfinite(g).all() and np.abs(g).sum() > 0
 
 
+@pytest.mark.slow
 def test_moe_layer_list_experts_matches_stacked():
     """Generic LayerList experts path produces the same result as the
     stacked ExpertMlp when weights are copied across."""
@@ -116,6 +117,7 @@ def test_moe_layer_list_experts_matches_stacked():
     np.testing.assert_allclose(ys, yl, rtol=2e-3, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_moe_expert_axis_gspmd_shardable():
     """The dispatch einsum compiles under a mesh with the expert dim
     sharded (the global_scatter equivalent is XLA's all_to_all)."""

@@ -167,6 +167,7 @@ class TestTCPStore:
         assert s.get("k", wait=False) is None
         s.close()
 
+    @pytest.mark.slow
     def test_multiprocess_rendezvous(self):
         ctx = multiprocessing.get_context("spawn")
         s = core.TCPStore(is_master=True)

@@ -41,6 +41,7 @@ def test_flash_forward_matches_reference(causal, b, h, sq, skv, d):
                                atol=2e-3, rtol=2e-3)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("causal", [False, True])
 def test_flash_grads_match_reference(causal):
     b, h, s, d = 1, 2, 128, 64
@@ -90,6 +91,7 @@ def test_flash_bf16():
                                np.asarray(ref), atol=3e-2, rtol=3e-2)
 
 
+@pytest.mark.slow
 def test_framework_entry_tensor_layout():
     """flash_attention takes paddle (B, S, H, D) Tensors and autodiffs
     through the framework tape."""
@@ -120,6 +122,7 @@ class TestKernelAutotune:
     """Kernel-config autotune (ref: paddle/phi/kernels/autotune/): warmup
     timing picks a block config, the cache feeds later (traced) calls."""
 
+    @pytest.mark.slow
     def test_tune_mha_populates_cache_and_outputs_match(self):
         import jax
         from paddle_tpu.ops import autotune as at

@@ -61,6 +61,7 @@ def test_forward_oracle_runs():
     assert out.shape == [4, 10]
 
 
+@pytest.mark.slow
 def test_train_batch_sequential_vs_compiled_parity():
     """pp2 compiled train_batch == no-pp eager accumulation, 3 steps."""
     rng = np.random.RandomState(1)

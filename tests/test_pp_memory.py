@@ -37,6 +37,7 @@ def _mem(step, state, ids, labels):
     return (int(ma.argument_size_in_bytes), int(ma.temp_size_in_bytes))
 
 
+@pytest.mark.slow
 def test_pipelined_state_bytes_beat_replicated_baseline():
     pt.seed(0)
     cfg = gpt_tiny(tensor_parallel=False)

@@ -326,6 +326,7 @@ class TestYoloLoss:
         assert got.shape == (2,)
         assert np.all(np.isfinite(got)) and np.all(got > 0)
 
+    @pytest.mark.slow
     def test_trains_head_to_lower_loss(self):
         x, gtb, gtl, kw = self._setup()
         import paddle_tpu as ptm

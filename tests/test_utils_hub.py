@@ -1,0 +1,228 @@
+"""paddle.utils / paddle.hub / paddle.batch / paddle.cost_model /
+paddle.onnx surface tests (ref: ``python/paddle/utils``, ``hapi/hub.py``,
+``batch.py``, ``cost_model/cost_model.py``, ``onnx/export.py``)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import utils
+
+
+class TestUniqueName:
+    def test_generate_counts(self):
+        a = utils.unique_name.generate("fc")
+        b = utils.unique_name.generate("fc")
+        assert a != b and a.startswith("fc_")
+
+    def test_guard_isolates(self):
+        with utils.unique_name.guard():
+            a = utils.unique_name.generate("x")
+        with utils.unique_name.guard():
+            b = utils.unique_name.generate("x")
+        assert a == b  # fresh namespace each guard
+
+    def test_guard_prefix(self):
+        with utils.unique_name.guard("pre_"):
+            assert utils.unique_name.generate("y").startswith("pre_y_")
+
+
+class TestDlpack:
+    def test_roundtrip(self):
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+        cap = utils.dlpack.to_dlpack(x)
+        y = utils.dlpack.from_dlpack(cap)
+        np.testing.assert_array_equal(x.numpy(), y.numpy())
+
+    def test_from_numpy_protocol(self):
+        # numpy >= 1.23 arrays speak __dlpack__
+        arr = np.arange(6, dtype=np.float32)
+        y = utils.dlpack.from_dlpack(arr)
+        np.testing.assert_array_equal(y.numpy(), arr)
+
+    def test_torch_interop(self):
+        torch = pytest.importorskip("torch")
+        t = torch.arange(8, dtype=torch.float32)
+        y = utils.dlpack.from_dlpack(t)
+        np.testing.assert_array_equal(y.numpy(), t.numpy())
+
+
+class TestStructure:
+    def test_flatten_pack(self):
+        nest = {"a": [1, 2, (3,)], "b": 4}
+        flat = utils.flatten(nest)
+        assert flat == [1, 2, 3, 4]
+        again = utils.pack_sequence_as(nest, [x * 10 for x in flat])
+        assert again == {"a": [10, 20, (30,)], "b": 40}
+
+    def test_map_structure(self):
+        out = utils.map_structure(lambda a, b: a + b, [1, [2]], [10, [20]])
+        assert out == [11, [22]]
+
+    def test_assert_same_structure(self):
+        utils.assert_same_structure([1, (2, 3)], [9, (8, 7)])
+        with pytest.raises(ValueError):
+            utils.assert_same_structure([1, 2], [1, [2]])
+
+    def test_convert_to_list(self):
+        assert utils.convert_to_list(3, 2, "stride") == [3, 3]
+        assert utils.convert_to_list((1, 2), 2, "stride") == [1, 2]
+        with pytest.raises(ValueError):
+            utils.convert_to_list((1, 2, 3), 2, "stride")
+
+
+class TestDeprecatedAndVersion:
+    def test_deprecated_warns(self):
+        @utils.deprecated(since="2.0", update_to="paddle.new_api", level=1)
+        def old():
+            """doc."""
+            return 1
+
+        with pytest.warns(DeprecationWarning):
+            assert old() == 1
+        assert "deprecated" in old.__doc__
+
+    def test_deprecated_raises_at_level2(self):
+        @utils.deprecated(level=2)
+        def gone():
+            return 1
+
+        with pytest.raises(RuntimeError):
+            gone()
+
+    def test_require_version(self):
+        assert utils.require_version("0.0.1")
+        with pytest.raises(Exception):
+            utils.require_version("999.0.0")
+
+    def test_try_import(self):
+        assert utils.try_import("json") is not None
+        with pytest.raises(ImportError):
+            utils.try_import("definitely_not_a_module_xyz")
+
+
+class TestDownload:
+    def test_local_path_passthrough(self, tmp_path):
+        p = tmp_path / "w.bin"
+        p.write_bytes(b"abc")
+        assert utils.download.get_path_from_url(str(p)) == str(p)
+
+    def test_cache_hit_and_md5(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_WEIGHT_PATH", str(tmp_path))
+        (tmp_path / "model.bin").write_bytes(b"weights")
+        got = utils.download.get_path_from_url(
+            "https://example.com/model.bin")
+        assert got == str(tmp_path / "model.bin")
+        import hashlib
+        good = hashlib.md5(b"weights").hexdigest()
+        assert utils.download.get_path_from_url(
+            "https://example.com/model.bin", md5sum=good) == got
+        with pytest.raises(IOError):
+            utils.download.get_path_from_url(
+                "https://example.com/model.bin", md5sum="0" * 32)
+
+    def test_cache_miss_raises_no_egress(self):
+        with pytest.raises(RuntimeError, match="without network"):
+            utils.download.get_path_from_url("https://example.com/nope.bin")
+
+
+class TestRunCheck:
+    def test_run_check(self, capsys):
+        utils.run_check()
+        out = capsys.readouterr().out
+        assert "installed successfully" in out
+
+
+class TestCppExtension:
+    def test_jit_load_and_call(self, tmp_path):
+        src = tmp_path / "addmul.cc"
+        src.write_text("""
+        extern "C" {
+        double addmul(double a, double b) { return a * b + a; }
+        }
+        """)
+        lib = utils.cpp_extension.load("addmul", [str(src)],
+                                       build_directory=str(tmp_path))
+        import ctypes
+        lib.addmul.restype = ctypes.c_double
+        lib.addmul.argtypes = [ctypes.c_double, ctypes.c_double]
+        assert lib.addmul(3.0, 4.0) == 15.0
+
+    def test_cpp_extension_object(self):
+        ext = utils.cpp_extension.CppExtension(["a.cc"])
+        assert "-std=c++17" in ext.extra_compile_args
+
+
+class TestHubBatch:
+    def _make_repo(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "dependencies = ['numpy']\n"
+            "def small_model(scale=1):\n"
+            "    '''Tiny model entrypoint.'''\n"
+            "    return {'scale': scale}\n")
+        return str(tmp_path)
+
+    def test_hub_local(self, tmp_path):
+        repo = self._make_repo(tmp_path)
+        assert "small_model" in paddle.hub.list(repo, source="local")
+        assert "Tiny model" in paddle.hub.help(repo, "small_model",
+                                               source="local")
+        assert paddle.hub.load(repo, "small_model", source="local",
+                               scale=3) == {"scale": 3}
+
+    def test_hub_remote_gated(self):
+        with pytest.raises(RuntimeError, match="network"):
+            paddle.hub.load("owner/repo", "m", source="github")
+
+    def test_batch(self):
+        def reader():
+            yield from range(5)
+
+        out = [b for b in paddle.batch(reader, batch_size=2)()]
+        assert out == [[0, 1], [2, 3], [4]]
+        out = [b for b in paddle.batch(reader, 2, drop_last=True)()]
+        assert out == [[0, 1], [2, 3]]
+
+
+class TestCostModel:
+    def test_analytic_cost(self):
+        import jax.numpy as jnp
+        cm = paddle.cost_model.CostModel()
+        cost = cm.analytic_cost(lambda x: x @ x, np.eye(64, dtype=np.float32))
+        assert cost["flops"] >= 2 * 64**3 * 0.9
+
+    def test_static_table(self):
+        cm = paddle.cost_model.CostModel()
+        data = cm.static_cost_data()
+        assert any(r["op"] == "matmul" for r in data)
+        t = cm.get_static_op_time("matmul")
+        assert t["op_time"] > 0
+        tb = cm.get_static_op_time("conv2d", forward=False)
+        assert tb["op_time"] > 0
+
+    def test_profile_measure(self):
+        cm = paddle.cost_model.CostModel()
+        startup, main = cm.build_program()
+        stats = cm.profile_measure(startup, main)
+        assert isinstance(stats, dict)
+
+
+class TestOnnxExport:
+    def test_export_writes_stablehlo(self, tmp_path):
+        net = paddle.nn.Linear(4, 2)
+        spec = [paddle.static.InputSpec(shape=[3, 4], dtype="float32")]
+        out = paddle.onnx.export(net, str(tmp_path / "m.onnx"),
+                                 input_spec=spec)
+        loaded = paddle.jit.load(out)
+        x = paddle.to_tensor(np.ones((3, 4), np.float32))
+        np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(),
+                                   rtol=1e-5)
+
+    def test_strict_onnx_raises(self, tmp_path):
+        net = paddle.nn.Linear(4, 2)
+        with pytest.raises((ImportError, NotImplementedError)):
+            paddle.onnx.export(net, str(tmp_path / "m.onnx"),
+                               input_spec=[paddle.static.InputSpec(
+                                   shape=[3, 4], dtype="float32")],
+                               format="onnx")
