@@ -374,24 +374,38 @@ def main():
         _retry("resnet50", lambda: bench_resnet(result, errors), errors)
 
         def run_gpt():
-            # ladder: no-remat first (fastest when it fits), then remat,
-            # then halve the batch; non-OOM errors retry via _retry.
-            # (v5e-lite 16G lands on (4, True): args ~5G + temps ~9.6G.)
-            ladder = ((16, False), (16, True), (8, True), (4, True),
-                      (2, True))
+            # ladder: no-remat first (fewer FLOPs when it fits), then
+            # remat, then halve the batch; non-OOM errors retry via
+            # _retry. First-fit is NOT always fastest (on v5e-lite 16G,
+            # (8, no-remat) beats (16, remat)), so keep measuring until
+            # two configs succeed and report the better one.
+            ladder = ((16, False), (8, False), (16, True), (8, True),
+                      (4, True), (2, True))
+            best, successes = None, 0
             for b, rc in ladder:
+                trial = dict(result)
                 try:
-                    out = bench_gpt(result, errors, b, recompute=rc)
-                    # success: earlier rungs' OOMs are descent, not errors
-                    for bb, rr in ladder:
-                        errors.pop(f"gpt345m_b{bb}_rc{int(rr)}", None)
-                    return out
+                    bench_gpt(trial, errors, b, recompute=rc)
                 except Exception as e:
                     errors[f"gpt345m_b{b}_rc{int(rc)}"] = _error_tail(
                         traceback.format_exc(limit=20))
+                    if successes > 0:
+                        break  # keep the measured config, don't discard it
                     if not _is_oom(e) or (b, rc) == ladder[-1]:
                         raise
-            return None
+                    continue
+                successes += 1
+                if best is None or (trial.get("gpt345m_tokens_per_sec", 0)
+                                    > best.get("gpt345m_tokens_per_sec", 0)):
+                    best = trial
+                if successes >= 2:
+                    break
+            if best is not None:
+                result.update(best)
+                # successful descent: earlier rungs' OOMs aren't errors
+                for bb, rr in ladder:
+                    errors.pop(f"gpt345m_b{bb}_rc{int(rr)}", None)
+            return best
 
         _retry("gpt345m", run_gpt, errors)
 

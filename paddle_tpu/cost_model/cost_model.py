@@ -128,7 +128,7 @@ class CostModel:
             bwd = jax.jit(jax.grad(f))
 
             def timed(g):
-                g(inp)  # compile + warm
+                jax.block_until_ready(g(inp))  # compile + warm, fully
                 t0 = time.perf_counter()
                 for _ in range(iters):
                     jax.block_until_ready(g(inp))

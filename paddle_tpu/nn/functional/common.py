@@ -353,11 +353,15 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     q, k_, v = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
     q, k_, v = maybe_autocast("matmul", q, k_, v)
 
-    use_pallas = _flags.flag("use_pallas_kernels") and _on_tpu()
-    if use_pallas and attn_mask is None and dropout_p == 0.0:
+    # canary last: it compiles a kernel, so only probe when the Pallas
+    # path is actually reachable for this call
+    use_pallas = (attn_mask is None and _flags.flag("use_pallas_kernels")
+                  and _on_tpu() and _flash_usable())
+    eff_drop = dropout_p if training else 0.0
+    if use_pallas:
         try:
             from ...ops.pallas_ops import flash_attention as _fa
-            return _fa(q, k_, v, causal=is_causal)
+            return _fa(q, k_, v, causal=is_causal, dropout_p=eff_drop)
         except Exception:
             pass  # fall back to XLA path
 
@@ -392,6 +396,38 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     if attn_mask is not None:
         args.append(ensure_tensor(attn_mask))
     return nary(f, args, name="scaled_dot_product_attention")
+
+
+_FLASH_CANARY = None
+
+
+def _flash_usable():
+    """One-time eager canary compile+run of a tiny flash kernel.
+
+    A kernel that traces fine can still fail at LOWERING time, which
+    under ``jax.jit`` happens outside any try/except at the call site and
+    would kill the whole compiled train step (exactly how the r03 bench
+    lost its GPT number) — so probe eagerly once and cache the verdict.
+    """
+    global _FLASH_CANARY
+    if _FLASH_CANARY is None:
+        try:
+            from ...ops.pallas_ops import mha
+            x = jnp.zeros((1, 1, 128, 64), jnp.bfloat16)
+            # exercise every lowering variant a train step can hit:
+            # fwd, fwd+dropout (SMEM seed path), and both bwd kernels
+            out = mha(x, x, x, causal=True, interpret=False)
+            seed = jnp.ones((), jnp.float32)
+            outd = mha(x, x, x, causal=True, dropout_p=0.1, seed=seed,
+                       interpret=False)
+            g = jax.grad(lambda q: mha(
+                q, x, x, causal=True, dropout_p=0.1, seed=seed,
+                interpret=False).astype(jnp.float32).sum())(x)
+            jax.block_until_ready((out, outd, g))
+            _FLASH_CANARY = True
+        except Exception:
+            _FLASH_CANARY = False
+    return _FLASH_CANARY
 
 
 def _on_tpu():

@@ -35,6 +35,34 @@ def _ceil_to(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+def _tile_keep_mask(seed, bh, qi, ki, block_q, block_k, p_drop):
+    """Deterministic per-element keep mask for attention dropout.
+
+    Counter-based hash (murmur3-finalizer rounds) over the element's
+    GLOBAL (bh, q, k) coordinates, so the forward and both backward
+    kernels regenerate the identical mask for a tile without ever
+    materialising the (S, S) mask in HBM — the same trick the
+    reference's vendored flashattn uses with its Philox offsets
+    (``third_party/flashattn``). Plain vector int ops, so it runs the
+    same on real TPU and in interpret mode (pltpu.prng_* has no
+    interpret-mode lowering).
+    """
+    rows = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    cols = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    def _i32(x):  # uint32 constant -> wrapped int32
+        return jnp.int32(x - (1 << 32) if x >= (1 << 31) else x)
+
+    h = rows * _i32(0x0001_93E9) + cols  # row-major element id, wraps
+    h = h ^ seed ^ (bh * _i32(0x9E37_79B1))
+    for mult in (_i32(0x85EB_CA6B), _i32(0xC2B2_AE35)):
+        h = h * mult
+        h = h ^ jax.lax.shift_right_logical(h, 15)
+    u24 = jax.lax.shift_right_logical(h, 8)  # uniform in [0, 2^24)
+    return u24 >= jnp.int32(int(p_drop * (1 << 24)))
+
+
 def _interpret_default() -> bool:
     try:
         return jax.default_backend() != "tpu"
@@ -45,8 +73,15 @@ def _interpret_default() -> bool:
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, causal, sm_scale, block_q, block_k, q_len, kv_len):
+def _fwd_kernel(*refs, causal, sm_scale, block_q, block_k, q_len, kv_len,
+                p_drop):
+    if p_drop > 0.0:
+        seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, \
+            acc_scr = refs
+    else:
+        seed_ref = None
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+    b = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -79,7 +114,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         p = jnp.exp(s - m_new)
         p = jnp.where(mask, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)
+        # l accumulates the UNdropped row sum (softmax denominator);
+        # dropout applies to the numerator only: out = (p∘M/(1-r)) @ v / l
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        if p_drop > 0.0:
+            keep = _tile_keep_mask(seed_ref[0], b, qi, ki, block_q, block_k,
+                                   p_drop)
+            p = jnp.where(keep, p / (1.0 - p_drop), 0.0)
         pv = jax.lax.dot_general(p, v_ref[0].astype(jnp.float32),
                                  (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -101,32 +142,44 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l = l_scr[:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
-        lse_ref[0, :] = m_scr[:, 0] + jnp.log(l_safe[:, 0])
+        # stats ride a trailing-singleton dim: block (block_q, 1) keeps the
+        # TPU (8,128) tiling rule satisfied (block (1, block_q) on a 2-D
+        # (BH, S) stats array does not lower on real hardware)
+        lse_ref[0] = m_scr[:, :1] + jnp.log(l_safe)
 
 
-def _fwd(q, k, v, *, causal, sm_scale, block_q, block_k, q_len, kv_len,
-         interpret):
+def _seed_spec_args(seed, p_drop):
+    """(extra in_specs, extra args) for the dropout seed SMEM scalar."""
+    if p_drop <= 0.0:
+        return [], ()
+    s32 = jax.lax.bitcast_convert_type(seed, jnp.int32).reshape(1)
+    return [pl.BlockSpec(memory_space=pltpu.SMEM)], (s32,)
+
+
+def _fwd(q, k, v, seed, *, causal, sm_scale, block_q, block_k, q_len,
+         kv_len, p_drop, interpret):
     bh, sq, d = q.shape
     skv = k.shape[1]
     nq, nk = sq // block_q, skv // block_k
     kernel = functools.partial(
         _fwd_kernel, causal=causal, sm_scale=sm_scale, block_q=block_q,
-        block_k=block_k, q_len=q_len, kv_len=kv_len)
+        block_k=block_k, q_len=q_len, kv_len=kv_len, p_drop=p_drop)
+    seed_specs, seed_args = _seed_spec_args(seed, p_drop)
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
-        in_specs=[
+        in_specs=seed_specs + [
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),
@@ -136,16 +189,23 @@ def _fwd(q, k, v, *, causal, sm_scale, block_q, block_k, q_len, kv_len,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v)
+    )(*seed_args, q, k, v)
     return out, lse
 
 
 # ---------------------------------------------------------------------------
 # backward
 # ---------------------------------------------------------------------------
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_scr, *, causal, sm_scale, block_q, block_k,
-                   q_len, kv_len):
+def _bwd_dq_kernel(*refs, causal, sm_scale, block_q, block_k,
+                   q_len, kv_len, p_drop):
+    if p_drop > 0.0:
+        seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, \
+            dq_scr = refs
+    else:
+        seed_ref = None
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, \
+            dq_scr = refs
+    b = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -168,11 +228,17 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             qrow = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 0)
             mask = jnp.logical_and(mask, kcol <= qrow + (kv_len - q_len))
-        p = jnp.exp(s - lse_ref[0, :][:, None])
+        p = jnp.exp(s - lse_ref[0])
         p = jnp.where(mask, p, 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0, :][:, None])
+        if p_drop > 0.0:
+            # gradient flows only through kept elements (dp ∘ M/(1-r));
+            # delta = rowsum(do∘out) already reflects the dropped forward
+            keep = _tile_keep_mask(seed_ref[0], b, qi, ki, block_q, block_k,
+                                   p_drop)
+            dp = jnp.where(keep, dp / (1.0 - p_drop), 0.0)
+        ds = p * (dp - delta_ref[0])
         dq_scr[:] = dq_scr[:] + sm_scale * jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -190,9 +256,16 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr, *, causal, sm_scale,
-                    block_q, block_k, q_len, kv_len):
+def _bwd_dkv_kernel(*refs, causal, sm_scale, block_q, block_k, q_len,
+                    kv_len, p_drop):
+    if p_drop > 0.0:
+        seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, \
+            dk_ref, dv_ref, dk_scr, dv_scr = refs
+    else:
+        seed_ref = None
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, \
+            dk_ref, dv_ref, dk_scr, dv_scr = refs
+    b = pl.program_id(0)
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -216,15 +289,24 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             qrow = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 0)
             mask = jnp.logical_and(mask, kcol <= qrow + (kv_len - q_len))
-        p = jnp.exp(s - lse_ref[0, :][:, None])
+        p = jnp.exp(s - lse_ref[0])
         p = jnp.where(mask, p, 0.0)
-        # dv += p^T @ do
+        if p_drop > 0.0:
+            keep = _tile_keep_mask(seed_ref[0], b, qi, ki, block_q, block_k,
+                                   p_drop)
+            inv = 1.0 / (1.0 - p_drop)
+            p_tilde = jnp.where(keep, p * inv, 0.0)
+        else:
+            p_tilde = p
+        # dv += p̃^T @ do (dropped probabilities fed the forward output)
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p_tilde, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0, :][:, None])
+        if p_drop > 0.0:
+            dp = jnp.where(keep, dp * inv, 0.0)
+        ds = p * (dp - delta_ref[0])
         # dk += ds^T @ q
         dk_scr[:] = dk_scr[:] + sm_scale * jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
@@ -244,26 +326,27 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd(q, k, v, out, lse, do, *, causal, sm_scale, block_q, block_k,
-         q_len, kv_len, interpret):
+def _bwd(q, k, v, out, lse, do, seed, *, causal, sm_scale, block_q,
+         block_k, q_len, kv_len, p_drop, interpret):
     bh, sq, d = q.shape
     skv = k.shape[1]
     nq, nk = sq // block_q, skv // block_k
     delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
-                    axis=-1)  # (bh, sq)
+                    axis=-1, keepdims=True)  # (bh, sq, 1)
+    seed_specs, seed_args = _seed_spec_args(seed, p_drop)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, sm_scale=sm_scale,
                           block_q=block_q, block_k=block_k, q_len=q_len,
-                          kv_len=kv_len),
+                          kv_len=kv_len, p_drop=p_drop),
         grid=(bh, nq, nk),
-        in_specs=[
+        in_specs=seed_specs + [
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
@@ -271,20 +354,20 @@ def _bwd(q, k, v, out, lse, do, *, causal, sm_scale, block_q, block_k,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*seed_args, q, k, v, do, lse, delta)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, causal=causal, sm_scale=sm_scale,
                           block_q=block_q, block_k=block_k, q_len=q_len,
-                          kv_len=kv_len),
+                          kv_len=kv_len, p_drop=p_drop),
         grid=(bh, nk, nq),
-        in_specs=[
+        in_specs=seed_specs + [
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -301,36 +384,42 @@ def _bwd(q, k, v, out, lse, do, *, causal, sm_scale, block_q, block_k,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*seed_args, q, k, v, do, lse, delta)
     return dq, dk, dv
 
 
 # ---------------------------------------------------------------------------
 # custom_vjp wrapper on padded (BH, S, D) arrays
 # ---------------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
-def _flash(q, k, v, causal, sm_scale, block_q, block_k, q_len, kv_len,
-           interpret):
-    out, _ = _fwd(q, k, v, causal=causal, sm_scale=sm_scale,
+# seed is a float32 scalar (bitcast to int32 inside): custom_vjp needs a
+# float cotangent slot for every traced arg, and the per-step dropout seed
+# must be traced (a python int would retrace the train step every step)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10,
+                                                    11))
+def _flash(q, k, v, seed, causal, sm_scale, block_q, block_k, q_len,
+           kv_len, p_drop, interpret):
+    out, _ = _fwd(q, k, v, seed, causal=causal, sm_scale=sm_scale,
                   block_q=block_q, block_k=block_k, q_len=q_len,
-                  kv_len=kv_len, interpret=interpret)
+                  kv_len=kv_len, p_drop=p_drop, interpret=interpret)
     return out
 
 
-def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, q_len, kv_len,
-               interpret):
-    out, lse = _fwd(q, k, v, causal=causal, sm_scale=sm_scale,
+def _flash_fwd(q, k, v, seed, causal, sm_scale, block_q, block_k, q_len,
+               kv_len, p_drop, interpret):
+    out, lse = _fwd(q, k, v, seed, causal=causal, sm_scale=sm_scale,
                     block_q=block_q, block_k=block_k, q_len=q_len,
-                    kv_len=kv_len, interpret=interpret)
-    return out, (q, k, v, out, lse)
+                    kv_len=kv_len, p_drop=p_drop, interpret=interpret)
+    return out, (q, k, v, seed, out, lse)
 
 
-def _flash_bwd(causal, sm_scale, block_q, block_k, q_len, kv_len, interpret,
-               res, do):
-    q, k, v, out, lse = res
-    return _bwd(q, k, v, out, lse, do, causal=causal, sm_scale=sm_scale,
-                block_q=block_q, block_k=block_k, q_len=q_len,
-                kv_len=kv_len, interpret=interpret)
+def _flash_bwd(causal, sm_scale, block_q, block_k, q_len, kv_len, p_drop,
+               interpret, res, do):
+    q, k, v, seed, out, lse = res
+    dq, dk, dv = _bwd(q, k, v, out, lse, do, seed, causal=causal,
+                      sm_scale=sm_scale, block_q=block_q, block_k=block_k,
+                      q_len=q_len, kv_len=kv_len, p_drop=p_drop,
+                      interpret=interpret)
+    return dq, dk, dv, jnp.zeros((), jnp.float32)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -345,12 +434,17 @@ def _mha_tune_key(q, k, causal, interpret):
 
 
 def mha(q, k, v, *, causal=False, sm_scale=None, block_q=None, block_k=None,
-        interpret=None):
+        dropout_p=0.0, seed=None, interpret=None):
     """Tiled flash attention on raw arrays in (B, H, S, D) layout.
 
     Pads S to the tile size and D to the 128-lane width (zero-padding is
     exact: padded head dims contribute 0 to logits; padded keys are
     masked by ``kv_len``; padded query rows are sliced off).
+
+    ``dropout_p`` > 0 applies attention-probability dropout INSIDE the
+    kernel (counter-based mask regenerated in the backward — the
+    reference's flash_attn dropout path, ``flash_attn_kernel.cu``);
+    ``seed`` is a traced f32 scalar that must change per training step.
 
     ``block_q``/``block_k`` default to an autotuned choice when
     :func:`tune_mha` has cached one for this (seq, d, dtype, causal) key
@@ -375,14 +469,19 @@ def mha(q, k, v, *, causal=False, sm_scale=None, block_q=None, block_k=None,
     block_k = min(block_k, _ceil_to(skv, 8))
     sq_p, skv_p = _ceil_to(sq, block_q), _ceil_to(skv, block_k)
     d_p = _ceil_to(d, _LANES)
+    p_drop = float(dropout_p)
+    if seed is None:
+        seed = jnp.zeros((), jnp.float32)
+    else:
+        seed = jnp.asarray(seed, jnp.float32).reshape(())
 
     def prep(x, s_p):
         x = x.reshape(b * h, x.shape[2], d)
         return jnp.pad(x, ((0, 0), (0, s_p - x.shape[1]), (0, d_p - d)))
 
     qp, kp, vp = prep(q, sq_p), prep(k, skv_p), prep(v, skv_p)
-    out = _flash(qp, kp, vp, causal, sm_scale, block_q, block_k, sq, skv,
-                 interpret)
+    out = _flash(qp, kp, vp, seed, causal, sm_scale, block_q, block_k, sq,
+                 skv, p_drop, interpret)
     return out[:, :sq, :d].reshape(b, h, sq, d)
 
 
@@ -437,20 +536,33 @@ def mha_reference(q, k, v, *, causal=False, sm_scale=None):
         q.dtype)
 
 
-def flash_attention(query, key, value, *, causal=False, interpret=None):
+def flash_attention(query, key, value, *, causal=False, dropout_p=0.0,
+                    interpret=None):
     """Framework-facing entry: paddle (B, S, H, D) layout, Tensor in/out.
 
     TPU replacement for the reference's flash_attn path
     (``python/paddle/nn/functional/flash_attention.py`` →
-    ``paddle/phi/kernels/gpu/flash_attn_kernel.cu``).
+    ``paddle/phi/kernels/gpu/flash_attn_kernel.cu``), incl. its dropout
+    support. The per-call dropout seed draws from the framework
+    generator, so it folds from the trace key under jit (fresh mask
+    every compiled step) and from host state in eager mode.
     """
     from .op_utils import ensure_tensor, nary
+    from ..framework import random as _random
 
     q, k, v = (ensure_tensor(t) for t in (query, key, value))
+    inputs = [q, k, v]
+    if dropout_p > 0.0:
+        key_seed = jax.random.bits(_random.next_key(), (),
+                                   jnp.uint32).astype(jnp.int32)
+        seed_f32 = jax.lax.bitcast_convert_type(key_seed, jnp.float32)
+        inputs.append(ensure_tensor(seed_f32))
 
-    def f(qd, kd, vd):
+    def f(qd, kd, vd, *rest):
         o = mha(jnp.swapaxes(qd, 1, 2), jnp.swapaxes(kd, 1, 2),
-                jnp.swapaxes(vd, 1, 2), causal=causal, interpret=interpret)
+                jnp.swapaxes(vd, 1, 2), causal=causal,
+                dropout_p=dropout_p, seed=rest[0] if rest else None,
+                interpret=interpret)
         return jnp.swapaxes(o, 1, 2)
 
-    return nary(f, [q, k, v], name="flash_attention")
+    return nary(f, inputs, name="flash_attention")

@@ -163,3 +163,99 @@ class TestKernelAutotune:
         assert at.enabled()
         iat.set_config({"kernel": {"enable": False}})
         assert not at.enabled()
+
+
+class TestFlashDropout:
+    """In-kernel attention dropout (ref flash_attn dropout path,
+    ``paddle/phi/kernels/gpu/flash_attn_kernel.cu``): the counter-based
+    mask is deterministic given (seed, coords), so an exact oracle can
+    rebuild it outside the kernel via _tile_keep_mask."""
+
+    PD = 0.3
+
+    def _setup(self, b=1, h=2, s=256, d=64):
+        q, k, v = (_rand((b, h, s, d), i) for i in range(3))
+        seed = jnp.asarray(1.2345, jnp.float32)
+        return q, k, v, seed
+
+    def _oracle(self, q, k, v, seed, pd):
+        from paddle_tpu.ops.pallas_ops import _tile_keep_mask
+        b, h, s, d = q.shape
+        bh = b * h
+        qq, kk, vv = (x.reshape(bh, s, d) for x in (q, k, v))
+        p = jax.nn.softmax(
+            jnp.einsum("bqd,bkd->bqk", qq, kk) / np.sqrt(d), axis=-1)
+        s32 = jax.lax.bitcast_convert_type(seed, jnp.int32)
+        M = jnp.stack([
+            jnp.concatenate([
+                jnp.concatenate([
+                    _tile_keep_mask(s32, jnp.int32(bi), jnp.int32(qi),
+                                    jnp.int32(ki), 128, 128, pd)
+                    for ki in range(s // 128)], axis=1)
+                for qi in range(s // 128)], axis=0)
+            for bi in range(bh)])
+        pt = jnp.where(M, p / (1 - pd), 0.0)
+        return jnp.einsum("bqk,bkd->bqd", pt, vv).reshape(b, h, s, d)
+
+    def test_forward_matches_mask_oracle(self):
+        q, k, v, seed = self._setup()
+        out = mha(q, k, v, dropout_p=self.PD, seed=seed, interpret=True,
+                  block_q=128, block_k=128)
+        ref = self._oracle(q, k, v, seed, self.PD)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_grads_match_mask_oracle(self):
+        q, k, v, seed = self._setup()
+        g = jax.grad(lambda *a: (mha(*a[:3], dropout_p=self.PD, seed=a[3],
+                                     interpret=True, block_q=128,
+                                     block_k=128) ** 2).sum(),
+                     argnums=(0, 1, 2))(q, k, v, seed)
+        gr = jax.grad(lambda *a: (self._oracle(*a, self.PD) ** 2).sum(),
+                      argnums=(0, 1, 2))(q, k, v, seed)
+        for a, b_ in zip(g, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       atol=3e-4, rtol=3e-4)
+
+    def test_keep_fraction_and_seed_sensitivity(self):
+        from paddle_tpu.ops.pallas_ops import _tile_keep_mask
+        s32 = jnp.int32(12345)
+        m = _tile_keep_mask(s32, jnp.int32(0), jnp.int32(0), jnp.int32(0),
+                            128, 128, self.PD)
+        assert abs(float(m.mean()) - (1 - self.PD)) < 0.02
+        m2 = _tile_keep_mask(jnp.int32(54321), jnp.int32(0), jnp.int32(0),
+                             jnp.int32(0), 128, 128, self.PD)
+        assert bool((m != m2).any())
+        # different tiles get different masks
+        m3 = _tile_keep_mask(s32, jnp.int32(0), jnp.int32(1), jnp.int32(0),
+                             128, 128, self.PD)
+        assert bool((m != m3).any())
+
+    def test_dropout_changes_with_seed_and_zero_is_exact(self):
+        q, k, v, _ = self._setup()
+        o1 = mha(q, k, v, dropout_p=self.PD,
+                 seed=jnp.asarray(1.0, jnp.float32), interpret=True)
+        o2 = mha(q, k, v, dropout_p=self.PD,
+                 seed=jnp.asarray(2.0, jnp.float32), interpret=True)
+        assert float(jnp.abs(o1 - o2).max()) > 1e-4
+        o0 = mha(q, k, v, dropout_p=0.0, interpret=True)
+        np.testing.assert_allclose(np.asarray(o0),
+                                   np.asarray(mha_reference(q, k, v)),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_framework_entry_dropout_trains(self):
+        """flash_attention with dropout through the tape: grads flow and
+        two eager calls draw different masks (generator advances)."""
+        import paddle_tpu as pt
+        from paddle_tpu.ops.pallas_ops import flash_attention
+        pt.seed(11)
+        x = np.random.RandomState(0).randn(1, 128, 2, 64).astype(np.float32)
+        q = pt.to_tensor(x, stop_gradient=False)
+        o1 = flash_attention(q, pt.to_tensor(x), pt.to_tensor(x),
+                             causal=True, dropout_p=0.4, interpret=True)
+        o1.sum().backward()
+        assert q.grad is not None and np.isfinite(q.grad.numpy()).all()
+        o2 = flash_attention(pt.to_tensor(x), pt.to_tensor(x),
+                             pt.to_tensor(x), causal=True, dropout_p=0.4,
+                             interpret=True)
+        assert float(np.abs(o1.numpy() - o2.numpy()).max()) > 1e-5
