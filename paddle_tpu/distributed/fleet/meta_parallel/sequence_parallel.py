@@ -69,22 +69,38 @@ def _merge(o1, lse1, o2, lse2):
     return o, lse
 
 
-def ring_attention(q, k, v, axis_name="sep", causal=False, sm_scale=None):
+def ring_attention(q, k, v, axis_name="sep", causal=False, sm_scale=None,
+                   use_kernel=None, interpret=None):
     """Exact attention over a sequence sharded on ``axis_name``.
 
     Args are local shards (B, H, S_local, D) inside shard_map. Returns
     the local (B, H, S_local, D) output shard.
+
+    ``use_kernel=True`` computes each ring step's partial attention with
+    the Pallas flash kernel (``ops.pallas_ops.mha``) instead of the XLA
+    O(S_local^2) softmax: the kernel's traced ``causal_shift`` encodes
+    the per-step (my_rank - src_rank) * S_local diagonal offset, and its
+    differentiable lse output feeds the online merge. Default: kernel on
+    TPU backends, XLA elsewhere.
     """
     n = lax.axis_size(axis_name)
     r = lax.axis_index(axis_name)
     b, h, sl, d = q.shape
     scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
     perm = [(i, (i + 1) % n) for i in range(n)]
+    if use_kernel is None:
+        use_kernel = jax.default_backend() == "tpu"
 
     qpos = r * sl + lax.broadcasted_iota(jnp.int32, (sl, 1), 0)
 
     @functools.partial(jax.checkpoint, static_argnums=())
     def step_attn(q, kk, vv, src):
+        if use_kernel:
+            from ....ops.pallas_ops import mha
+            o, lse = mha(q, kk, vv, causal=causal, sm_scale=scale,
+                         causal_shift=(r - src) * sl if causal else None,
+                         return_lse=True, interpret=interpret)
+            return o.astype(jnp.float32), lse
         kpos = src * sl + lax.broadcasted_iota(jnp.int32, (1, sl), 1)
         if causal:
             mask = kpos <= qpos  # (sl, sl) global causal mask

@@ -63,6 +63,7 @@ class GPTConfig:
     use_rope: bool = False           # GPT-3 uses learned positions
     tie_word_embeddings: bool = True
     use_recompute: bool = False
+    recompute_policy: str | None = None  # see fleet.recompute._POLICIES
     tensor_parallel: bool = True     # annotate megatron specs
 
     def __post_init__(self):
@@ -199,6 +200,7 @@ class GPTModel(Layer):
         self.final_ln = LayerNorm(cfg.hidden_size,
                                   epsilon=cfg.layer_norm_epsilon)
         self.use_recompute = cfg.use_recompute
+        self.recompute_policy = cfg.recompute_policy
 
     def forward(self, input_ids, position_ids=None, attention_mask=None):
         x = self.embeddings(input_ids, position_ids)
@@ -211,7 +213,8 @@ class GPTModel(Layer):
         elif self.use_recompute:
             from ...distributed.fleet.recompute import recompute
             for layer in self.layers:
-                x = recompute(layer, x, attention_mask)
+                x = recompute(layer, x, attention_mask,
+                              policy=self.recompute_policy)
         else:
             for layer in self.layers:
                 x = layer(x, attention_mask)

@@ -118,6 +118,56 @@ def _is_arraylike(x):
     return isinstance(x, (jax.Array, jax.core.Tracer, np.ndarray))
 
 
+def _closure_layer_targets(fn):
+    """(prefix, Layer) pairs a plain function closes over.
+
+    ``to_static`` on a bare function (not a Layer) must still thread the
+    parameters of any Layer captured in the function's closure (or bound
+    ``self``) through the jitted program as real inputs — otherwise they
+    trace as constants, no tape node is recorded, and ``backward()``
+    silently produces no gradients (the failure is invisible: the loss
+    simply never moves). Ref: dy2static resolves the same case through
+    its live-variable analysis (``program_translator.py``).
+    """
+    out, seen = [], set()
+
+    def add(prefix, val):
+        if isinstance(val, Layer) and id(val) not in seen:
+            seen.add(id(val))
+            out.append((prefix, val))
+
+    def add_container(name, val):
+        add(name, val)
+        if isinstance(val, (list, tuple)):
+            for i, v in enumerate(val):
+                add(f"{name}.{i}", v)
+        elif isinstance(val, dict):
+            for k, v in val.items():
+                add(f"{name}.{k}", v)
+
+    obj = getattr(fn, "__self__", None)
+    if obj is not None:
+        add("self", obj)
+    raw = getattr(fn, "__wrapped__", fn)
+    code = getattr(raw, "__code__", None)
+    cells = getattr(raw, "__closure__", None) or ()
+    names = code.co_freevars if code is not None else ()
+    for name, cell in zip(names, cells):
+        try:
+            val = cell.cell_contents
+        except ValueError:
+            continue
+        add_container(name, val)
+    # module-level globals the code object references (co_names) — the
+    # most common script style (`net = Linear(...)` at top level)
+    if code is not None:
+        g = getattr(raw, "__globals__", {})
+        for name in code.co_names:
+            if name in g:
+                add_container(name, g[name])
+    return out
+
+
 class StaticFunction:
     """Compiled callable (ref: ``dy2static/program_translator.py:305``)."""
 
@@ -127,6 +177,9 @@ class StaticFunction:
         self._layer = layer
         self._input_spec = input_spec
         self._jitted = None
+        self._closure_param_tensors = None
+        self._closure_buffer_tensors = None
+        self._closure_targets_cache = None
         try:
             functools.update_wrapper(self, function)
         except AttributeError:
@@ -157,9 +210,27 @@ class StaticFunction:
                         layer, params, buffers, args, kwargs,
                         training=training, forward_fn=fn)
                 else:
-                    with autograd.functional_guard():
-                        out = fn(*args, **kwargs)
-                    new_buffers = {}
+                    # swap closure-captured layers' param/buffer arrays so
+                    # they trace as program inputs (see
+                    # _closure_layer_targets); restore afterwards
+                    targets = dict(self._closure_param_tensors or [])
+                    btargets = dict(self._closure_buffer_tensors or [])
+                    saved = {k: t._data for k, t in targets.items()}
+                    bsaved = {k: t._data for k, t in btargets.items()}
+                    try:
+                        for k, t in targets.items():
+                            t._data = params[k]
+                        for k, t in btargets.items():
+                            t._data = buffers[k]
+                        with autograd.functional_guard():
+                            out = fn(*args, **kwargs)
+                        new_buffers = {k: t._data
+                                       for k, t in btargets.items()}
+                    finally:
+                        for k, t in targets.items():
+                            t._data = saved[k]
+                        for k, t in btargets.items():
+                            t._data = bsaved[k]
             out_arrays = jax.tree_util.tree_map(
                 lambda t: t._data if isinstance(t, Tensor) else t, out,
                 is_leaf=lambda t: isinstance(t, Tensor))
@@ -199,8 +270,30 @@ class StaticFunction:
         traced_idx_t = tuple(traced_idx)
         statics_t = tuple(statics)
 
-        params = dict(layer.named_parameters()) if layer is not None else {}
-        buffers = dict(layer.named_buffers()) if layer is not None else {}
+        if layer is not None:
+            params = dict(layer.named_parameters())
+            buffers = dict(layer.named_buffers())
+        else:
+            params, buffers = {}, {}
+            cp, cb, modes = [], [], []
+            if self._closure_targets_cache is None:
+                self._closure_targets_cache = _closure_layer_targets(
+                    self._orig_fn)
+            for pref, ly in self._closure_targets_cache:
+                for k, t in dict(ly.named_parameters()).items():
+                    params[f"{pref}::{k}"] = t
+                    cp.append((f"{pref}::{k}", t))
+                for k, t in dict(ly.named_buffers()).items():
+                    buffers[f"{pref}::{k}"] = t
+                    cb.append((f"{pref}::{k}", t))
+                modes.append((pref, ly.training))
+            self._closure_param_tensors = cp
+            self._closure_buffer_tensors = cb
+            # per-layer modes form the static cache key: each layer reads
+            # its OWN .training at trace time, so any single flip (bn
+            # eval vs dropout train) must retrace, not cache-hit on an
+            # aggregate boolean
+            training = tuple(modes)
         p_names = sorted(params)
         b_names = sorted(buffers)
         p_tensors = [params[k] for k in p_names]
@@ -256,10 +349,15 @@ class StaticFunction:
             result = jax.tree_util.tree_map(
                 lambda a: Tensor(a) if _is_arraylike(a) else a, out_arrays)
 
-        if layer is not None and new_buffers:
-            named_b = dict(layer.named_buffers())
-            for k, arr in new_buffers.items():
-                named_b[k]._data = arr
+        if new_buffers:
+            if layer is not None:
+                named_b = dict(layer.named_buffers())
+                for k, arr in new_buffers.items():
+                    named_b[k]._data = arr
+            elif self._closure_buffer_tensors:
+                targets = dict(self._closure_buffer_tensors)
+                for k, arr in new_buffers.items():
+                    targets[k]._data = arr
         return result
 
     # paddle parity helpers -------------------------------------------------

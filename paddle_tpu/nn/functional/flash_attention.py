@@ -1,0 +1,119 @@
+"""``paddle.nn.functional.flash_attention`` (ref:
+``python/paddle/nn/functional/flash_attention.py:125 flash_attention``,
+``:272 flash_attn_unpadded``) over the Pallas kernel
+(``paddle_tpu.ops.pallas_ops``).
+
+The reference's unpadded entry takes packed tokens + ``cu_seqlens``
+(CUDA varlen kernels iterate ragged rows). XLA wants static shapes, so
+here the packed input is scattered into a padded (B, max_seqlen, H, D)
+batch, the kernel masks keys per row via its SMEM length vector, and the
+result gathers back to packed layout — all static-shape ops, one fused
+program.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.op_utils import ensure_tensor, nary
+from ...framework import random as _random
+
+__all__ = ["flash_attention", "flash_attn_unpadded"]
+
+
+def _seed_input(dropout, training):
+    if dropout > 0.0 and training:
+        bits = jax.random.bits(_random.next_key(), (), jnp.uint32)
+        return [ensure_tensor(
+            jax.lax.bitcast_convert_type(bits, jnp.float32))]
+    return []
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, *, fixed_seed_offset=None,
+                    rng_name="", training=True, name=None):
+    """(B, S, H, D) tensors; returns (out, softmax) — softmax is None
+    unless ``return_softmax``, which falls back to the XLA path (the
+    flash kernel never materialises it; same restriction as the
+    reference's ``return_softmax`` + fp16 path)."""
+    from ...ops.pallas_ops import flash_attention as _fa
+    q, k, v = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
+    if return_softmax:
+        from .common import scaled_dot_product_attention
+        probs = _softmax_probs(q, k, v, causal)
+        out = scaled_dot_product_attention(
+            q, k, v, dropout_p=dropout, is_causal=causal, training=training)
+        return out, probs
+    eff = dropout if training else 0.0
+    return _fa(q, k, v, causal=causal, dropout_p=eff), None
+
+
+def _softmax_probs(q, k, v, causal):
+    import numpy as np
+
+    def f(qd, kd, vd):
+        qt, kt = jnp.swapaxes(qd, 1, 2), jnp.swapaxes(kd, 1, 2)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) / np.sqrt(
+            qd.shape[-1])
+        if causal:
+            S, K = logits.shape[-2], logits.shape[-1]
+            logits = jnp.where(jnp.tril(jnp.ones((S, K), bool)), logits,
+                               -jnp.inf)
+        return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    return nary(f, [q, k, v], name="flash_attention_softmax")
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
+                        causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """Packed varlen attention: ``query`` is (total_q, H, D); sequence i
+    occupies rows ``cu_seqlens_q[i]:cu_seqlens_q[i+1]``. Self-attention
+    lengths only (cu_seqlens_q == cu_seqlens_k), like the reference's
+    main use (BERT-style padded batches)."""
+    from ...ops.pallas_ops import mha
+    import numpy as np
+    q = ensure_tensor(query)
+    k, v = ensure_tensor(key), ensure_tensor(value)
+    cu_q = jnp.asarray(ensure_tensor(cu_seqlens_q)._data, jnp.int32)
+    cu_k = jnp.asarray(ensure_tensor(cu_seqlens_k)._data, jnp.int32)
+    if not np.array_equal(np.asarray(cu_q), np.asarray(cu_k)):
+        raise NotImplementedError(
+            "flash_attn_unpadded currently supports self-attention "
+            "lengths only (cu_seqlens_q == cu_seqlens_k); cross-attention "
+            "varlen is not implemented")
+    max_q = int(max_seqlen_q)
+    eff = dropout if training else 0.0
+    seeds = _seed_input(eff, True)
+
+    def f(qd, kd, vd, cu, *rest):
+        bsz = cu.shape[0] - 1
+        h, d = qd.shape[1], qd.shape[2]
+        lens = cu[1:] - cu[:-1]
+        # scatter packed rows -> (B, max_q) padded positions
+        pos = jnp.arange(max_q, dtype=jnp.int32)
+        idx = cu[:-1, None] + pos[None, :]                  # (B, max_q)
+        idx = jnp.minimum(idx, qd.shape[0] - 1)
+        valid = pos[None, :] < lens[:, None]
+
+        def pad(x):
+            g = x[idx.reshape(-1)].reshape(bsz, max_q, h, d)
+            return jnp.where(valid[:, :, None, None], g, 0.0)
+
+        qp, kp, vp = pad(qd), pad(kd), pad(vd)
+        out = mha(jnp.swapaxes(qp, 1, 2), jnp.swapaxes(kp, 1, 2),
+                  jnp.swapaxes(vp, 1, 2), causal=causal, sm_scale=scale,
+                  dropout_p=eff, seed=rest[0] if rest else None,
+                  seq_lens=lens)
+        out = jnp.swapaxes(out, 1, 2)                        # (B,max_q,H,D)
+        # gather padded -> packed: row t belongs to seq searchsorted(t)
+        tok = jnp.arange(qd.shape[0], dtype=jnp.int32)
+        seq_of = jnp.searchsorted(cu, tok, side="right") - 1
+        off = tok - cu[seq_of]
+        return out[seq_of, off]
+
+    out = nary(f, [q, k, v, ensure_tensor(cu_q)] + seeds,
+               name="flash_attn_unpadded")
+    return out, None

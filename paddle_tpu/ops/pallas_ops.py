@@ -70,17 +70,69 @@ def _interpret_default() -> bool:
         return True
 
 
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct whose varying-mesh-axes (vma) match ``like`` —
+    required for pallas_call outputs under shard_map(check_vma=True)
+    (ring attention runs the kernel inside shard_map)."""
+    vma = None
+    try:
+        vma = jax.typeof(like).vma
+    except Exception:
+        pass
+    if vma is not None:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _split_refs(refs, p_drop, has_lens, has_shift=False):
+    """Peel the optional SMEM scalars (dropout seed, per-row kv lengths,
+    traced causal shift) off the front of a kernel's ref list."""
+    i = 0
+    seed_ref = lens_ref = shift_ref = None
+    if p_drop > 0.0:
+        seed_ref, i = refs[0], 1
+    if has_lens:
+        lens_ref, i = refs[i], i + 1
+    if has_shift:
+        shift_ref, i = refs[i], i + 1
+    return seed_ref, lens_ref, shift_ref, refs[i:]
+
+
+def _key_mask(lens_ref, shift_ref, b, qi, ki, block_q, block_k, q_len,
+              kv_len, causal):
+    """Validity mask for one (block_q, block_k) tile.
+
+    Fixed-length: keys < kv_len, causal diagonal offset kv_len - q_len
+    (end-aligned cross attention). Varlen (lens_ref set): keys < lens[b]
+    per row-of-batch, causal from position 0 (self-attention semantics —
+    the reference's flash_attn_unpadded path). shift_ref (traced)
+    overrides the causal diagonal offset — ring attention's per-step
+    (my_rank - src_rank) * block shift.
+    """
+    shape = (block_q, block_k)
+    kcol = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+    if lens_ref is not None:
+        mask = kcol < lens_ref[b]
+        off = 0
+    else:
+        mask = kcol < kv_len
+        off = kv_len - q_len
+    if shift_ref is not None:
+        off = shift_ref[0]
+    if causal:
+        qrow = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+        mask = jnp.logical_and(mask, kcol <= qrow + off)
+    return mask
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 def _fwd_kernel(*refs, causal, sm_scale, block_q, block_k, q_len, kv_len,
-                p_drop):
-    if p_drop > 0.0:
-        seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, \
-            acc_scr = refs
-    else:
-        seed_ref = None
-        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+                p_drop, has_lens, has_shift):
+    seed_ref, lens_ref, shift_ref, (q_ref, k_ref, v_ref, o_ref, lse_ref,
+                                    m_scr, l_scr, acc_scr) = _split_refs(
+        refs, p_drop, has_lens, has_shift)
     b = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -99,12 +151,8 @@ def _fwd_kernel(*refs, causal, sm_scale, block_q, block_k, q_len, kv_len,
                                 preferred_element_type=jnp.float32)
         s = s * sm_scale
 
-        kcol = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        mask = kcol < kv_len
-        if causal:
-            qrow = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 0)
-            mask = jnp.logical_and(mask, kcol <= qrow + (kv_len - q_len))
+        mask = _key_mask(lens_ref, shift_ref, b, qi, ki, block_q,
+                         block_k, q_len, kv_len, causal)
         s = jnp.where(mask, s, _NEG_INF)
 
         m_prev = m_scr[:, :1]
@@ -130,8 +178,9 @@ def _fwd_kernel(*refs, causal, sm_scale, block_q, block_k, q_len, kv_len,
 
     if causal:
         # Blocks fully above the diagonal have nothing to attend to.
-        @pl.when(qi * block_q + block_q - 1 + (kv_len - q_len)
-                 >= ki * block_k)
+        _off = shift_ref[0] if shift_ref is not None else kv_len - q_len
+
+        @pl.when(qi * block_q + block_q - 1 + _off >= ki * block_k)
         def _():
             _compute()
     else:
@@ -148,23 +197,31 @@ def _fwd_kernel(*refs, causal, sm_scale, block_q, block_k, q_len, kv_len,
         lse_ref[0] = m_scr[:, :1] + jnp.log(l_safe)
 
 
-def _seed_spec_args(seed, p_drop):
-    """(extra in_specs, extra args) for the dropout seed SMEM scalar."""
-    if p_drop <= 0.0:
-        return [], ()
-    s32 = jax.lax.bitcast_convert_type(seed, jnp.int32).reshape(1)
-    return [pl.BlockSpec(memory_space=pltpu.SMEM)], (s32,)
+def _seed_spec_args(seed, p_drop, lens, shift=None):
+    """(extra in_specs, extra args) for the SMEM scalars: dropout seed,
+    per-row kv lengths, traced causal shift. All cross the custom_vjp
+    boundary as f32 bitcasts (custom_vjp needs a float cotangent slot per
+    traced arg)."""
+    specs, args = [], ()
+    for val, want in ((seed, p_drop > 0.0), (lens, lens is not None),
+                      (shift, shift is not None)):
+        if want:
+            v32 = jax.lax.bitcast_convert_type(val, jnp.int32).reshape(-1)
+            specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+            args += (v32,)
+    return specs, args
 
 
-def _fwd(q, k, v, seed, *, causal, sm_scale, block_q, block_k, q_len,
-         kv_len, p_drop, interpret):
+def _fwd(q, k, v, seed, lens, shift, *, causal, sm_scale, block_q,
+         block_k, q_len, kv_len, p_drop, interpret):
     bh, sq, d = q.shape
     skv = k.shape[1]
     nq, nk = sq // block_q, skv // block_k
     kernel = functools.partial(
         _fwd_kernel, causal=causal, sm_scale=sm_scale, block_q=block_q,
-        block_k=block_k, q_len=q_len, kv_len=kv_len, p_drop=p_drop)
-    seed_specs, seed_args = _seed_spec_args(seed, p_drop)
+        block_k=block_k, q_len=q_len, kv_len=kv_len, p_drop=p_drop,
+        has_lens=lens is not None, has_shift=shift is not None)
+    seed_specs, seed_args = _seed_spec_args(seed, p_drop, lens, shift)
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
@@ -178,8 +235,8 @@ def _fwd(q, k, v, seed, *, causal, sm_scale, block_q, block_k, q_len,
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+            _sds((bh, sq, d), q.dtype, q),
+            _sds((bh, sq, 1), jnp.float32, q),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),
@@ -197,14 +254,11 @@ def _fwd(q, k, v, seed, *, causal, sm_scale, block_q, block_k, q_len,
 # backward
 # ---------------------------------------------------------------------------
 def _bwd_dq_kernel(*refs, causal, sm_scale, block_q, block_k,
-                   q_len, kv_len, p_drop):
-    if p_drop > 0.0:
-        seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, \
-            dq_scr = refs
-    else:
-        seed_ref = None
-        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, \
-            dq_scr = refs
+                   q_len, kv_len, p_drop, has_lens, has_shift):
+    seed_ref, lens_ref, shift_ref, (q_ref, k_ref, v_ref, do_ref, lse_ref,
+                                    delta_ref, dq_ref,
+                                    dq_scr) = _split_refs(
+        refs, p_drop, has_lens, has_shift)
     b = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -222,12 +276,8 @@ def _bwd_dq_kernel(*refs, causal, sm_scale, block_q, block_k,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * sm_scale
-        kcol = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        mask = kcol < kv_len
-        if causal:
-            qrow = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 0)
-            mask = jnp.logical_and(mask, kcol <= qrow + (kv_len - q_len))
+        mask = _key_mask(lens_ref, shift_ref, b, qi, ki, block_q,
+                         block_k, q_len, kv_len, causal)
         p = jnp.exp(s - lse_ref[0])
         p = jnp.where(mask, p, 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -244,8 +294,9 @@ def _bwd_dq_kernel(*refs, causal, sm_scale, block_q, block_k,
             preferred_element_type=jnp.float32)
 
     if causal:
-        @pl.when(qi * block_q + block_q - 1 + (kv_len - q_len)
-                 >= ki * block_k)
+        _off = shift_ref[0] if shift_ref is not None else kv_len - q_len
+
+        @pl.when(qi * block_q + block_q - 1 + _off >= ki * block_k)
         def _():
             _compute()
     else:
@@ -257,14 +308,11 @@ def _bwd_dq_kernel(*refs, causal, sm_scale, block_q, block_k,
 
 
 def _bwd_dkv_kernel(*refs, causal, sm_scale, block_q, block_k, q_len,
-                    kv_len, p_drop):
-    if p_drop > 0.0:
-        seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, \
-            dk_ref, dv_ref, dk_scr, dv_scr = refs
-    else:
-        seed_ref = None
-        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, \
-            dk_ref, dv_ref, dk_scr, dv_scr = refs
+                    kv_len, p_drop, has_lens, has_shift):
+    seed_ref, lens_ref, shift_ref, (q_ref, k_ref, v_ref, do_ref, lse_ref,
+                                    delta_ref, dk_ref, dv_ref, dk_scr,
+                                    dv_scr) = _split_refs(
+        refs, p_drop, has_lens, has_shift)
     b = pl.program_id(0)
     ki = pl.program_id(1)
     qi = pl.program_id(2)
@@ -283,12 +331,8 @@ def _bwd_dkv_kernel(*refs, causal, sm_scale, block_q, block_k, q_len,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * sm_scale
-        kcol = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        mask = kcol < kv_len
-        if causal:
-            qrow = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 0)
-            mask = jnp.logical_and(mask, kcol <= qrow + (kv_len - q_len))
+        mask = _key_mask(lens_ref, shift_ref, b, qi, ki, block_q,
+                         block_k, q_len, kv_len, causal)
         p = jnp.exp(s - lse_ref[0])
         p = jnp.where(mask, p, 0.0)
         if p_drop > 0.0:
@@ -313,8 +357,9 @@ def _bwd_dkv_kernel(*refs, causal, sm_scale, block_q, block_k, q_len,
             preferred_element_type=jnp.float32)
 
     if causal:
-        @pl.when(qi * block_q + block_q - 1 + (kv_len - q_len)
-                 >= ki * block_k)
+        _off = shift_ref[0] if shift_ref is not None else kv_len - q_len
+
+        @pl.when(qi * block_q + block_q - 1 + _off >= ki * block_k)
         def _():
             _compute()
     else:
@@ -326,19 +371,26 @@ def _bwd_dkv_kernel(*refs, causal, sm_scale, block_q, block_k, q_len,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd(q, k, v, out, lse, do, seed, *, causal, sm_scale, block_q,
-         block_k, q_len, kv_len, p_drop, interpret):
+def _bwd(q, k, v, out, lse, do, seed, lens, shift, *, causal, sm_scale,
+         block_q, block_k, q_len, kv_len, p_drop, interpret, dlse=None):
     bh, sq, d = q.shape
     skv = k.shape[1]
     nq, nk = sq // block_q, skv // block_k
     delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
                     axis=-1, keepdims=True)  # (bh, sq, 1)
-    seed_specs, seed_args = _seed_spec_args(seed, p_drop)
+    if dlse is not None:
+        # d/ds of lse is p, so an lse cotangent folds into the delta
+        # vector: ds = p∘(dp - (delta - dlse))
+        delta = delta - dlse.astype(jnp.float32)
+    seed_specs, seed_args = _seed_spec_args(seed, p_drop, lens, shift)
+    has_lens = lens is not None
+    has_shift = shift is not None
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, sm_scale=sm_scale,
                           block_q=block_q, block_k=block_k, q_len=q_len,
-                          kv_len=kv_len, p_drop=p_drop),
+                          kv_len=kv_len, p_drop=p_drop, has_lens=has_lens,
+                          has_shift=has_shift),
         grid=(bh, nq, nk),
         in_specs=seed_specs + [
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -349,7 +401,7 @@ def _bwd(q, k, v, out, lse, do, seed, *, causal, sm_scale, block_q,
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        out_shape=_sds((bh, sq, d), q.dtype, q),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
@@ -359,7 +411,8 @@ def _bwd(q, k, v, out, lse, do, seed, *, causal, sm_scale, block_q,
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, causal=causal, sm_scale=sm_scale,
                           block_q=block_q, block_k=block_k, q_len=q_len,
-                          kv_len=kv_len, p_drop=p_drop),
+                          kv_len=kv_len, p_drop=p_drop, has_lens=has_lens,
+                          has_shift=has_shift),
         grid=(bh, nk, nq),
         in_specs=seed_specs + [
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
@@ -374,8 +427,8 @@ def _bwd(q, k, v, out, lse, do, seed, *, causal, sm_scale, block_q,
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, skv, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, skv, d), v.dtype),
+            _sds((bh, skv, d), k.dtype, k),
+            _sds((bh, skv, d), v.dtype, v),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -391,38 +444,76 @@ def _bwd(q, k, v, out, lse, do, seed, *, causal, sm_scale, block_q,
 # ---------------------------------------------------------------------------
 # custom_vjp wrapper on padded (BH, S, D) arrays
 # ---------------------------------------------------------------------------
-# seed is a float32 scalar (bitcast to int32 inside): custom_vjp needs a
-# float cotangent slot for every traced arg, and the per-step dropout seed
-# must be traced (a python int would retrace the train step every step)
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10,
-                                                    11))
-def _flash(q, k, v, seed, causal, sm_scale, block_q, block_k, q_len,
-           kv_len, p_drop, interpret):
-    out, _ = _fwd(q, k, v, seed, causal=causal, sm_scale=sm_scale,
-                  block_q=block_q, block_k=block_k, q_len=q_len,
-                  kv_len=kv_len, p_drop=p_drop, interpret=interpret)
+# seed / lens / shift are float32 (bitcast to int32 inside): custom_vjp
+# needs a float cotangent slot for every traced arg, and the per-step
+# dropout seed must be traced (a python int would retrace the train step
+# every step). lens/shift=None are allowed: None is a static pytree.
+_STATICS = (6, 7, 8, 9, 10, 11, 12, 13)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=_STATICS)
+def _flash(q, k, v, seed, lens, shift, causal, sm_scale, block_q, block_k,
+           q_len, kv_len, p_drop, interpret):
+    out, _ = _fwd(q, k, v, seed, lens, shift, causal=causal,
+                  sm_scale=sm_scale, block_q=block_q, block_k=block_k,
+                  q_len=q_len, kv_len=kv_len, p_drop=p_drop,
+                  interpret=interpret)
     return out
 
 
-def _flash_fwd(q, k, v, seed, causal, sm_scale, block_q, block_k, q_len,
-               kv_len, p_drop, interpret):
-    out, lse = _fwd(q, k, v, seed, causal=causal, sm_scale=sm_scale,
-                    block_q=block_q, block_k=block_k, q_len=q_len,
-                    kv_len=kv_len, p_drop=p_drop, interpret=interpret)
-    return out, (q, k, v, seed, out, lse)
+def _flash_fwd(q, k, v, seed, lens, shift, causal, sm_scale, block_q,
+               block_k, q_len, kv_len, p_drop, interpret):
+    out, lse = _fwd(q, k, v, seed, lens, shift, causal=causal,
+                    sm_scale=sm_scale, block_q=block_q, block_k=block_k,
+                    q_len=q_len, kv_len=kv_len, p_drop=p_drop,
+                    interpret=interpret)
+    return out, (q, k, v, seed, lens, shift, out, lse)
 
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, q_len, kv_len, p_drop,
-               interpret, res, do):
-    q, k, v, seed, out, lse = res
-    dq, dk, dv = _bwd(q, k, v, out, lse, do, seed, causal=causal,
-                      sm_scale=sm_scale, block_q=block_q, block_k=block_k,
-                      q_len=q_len, kv_len=kv_len, p_drop=p_drop,
-                      interpret=interpret)
-    return dq, dk, dv, jnp.zeros((), jnp.float32)
+               interpret, res, do, dlse=None):
+    q, k, v, seed, lens, shift, out, lse = res
+    dq, dk, dv = _bwd(q, k, v, out, lse, do, seed, lens, shift,
+                      causal=causal, sm_scale=sm_scale, block_q=block_q,
+                      block_k=block_k, q_len=q_len, kv_len=kv_len,
+                      p_drop=p_drop, interpret=interpret, dlse=dlse)
+    return (dq, dk, dv, jnp.zeros((), jnp.float32),
+            None if lens is None else jnp.zeros_like(lens),
+            None if shift is None else jnp.zeros_like(shift))
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=_STATICS)
+def _flash_lse(q, k, v, seed, lens, shift, causal, sm_scale, block_q,
+               block_k, q_len, kv_len, p_drop, interpret):
+    """(out, lse) variant for online-merge consumers (ring attention):
+    the lse output is itself differentiable (d lse/d s = p folds into the
+    backward delta vector)."""
+    return _fwd(q, k, v, seed, lens, shift, causal=causal,
+                sm_scale=sm_scale, block_q=block_q, block_k=block_k,
+                q_len=q_len, kv_len=kv_len, p_drop=p_drop,
+                interpret=interpret)
+
+
+def _flash_lse_fwd(q, k, v, seed, lens, shift, causal, sm_scale, block_q,
+                   block_k, q_len, kv_len, p_drop, interpret):
+    out, lse = _fwd(q, k, v, seed, lens, shift, causal=causal,
+                    sm_scale=sm_scale, block_q=block_q, block_k=block_k,
+                    q_len=q_len, kv_len=kv_len, p_drop=p_drop,
+                    interpret=interpret)
+    return (out, lse), (q, k, v, seed, lens, shift, out, lse)
+
+
+def _flash_lse_bwd(causal, sm_scale, block_q, block_k, q_len, kv_len,
+                   p_drop, interpret, res, cots):
+    do, dlse = cots
+    return _flash_bwd(causal, sm_scale, block_q, block_k, q_len, kv_len,
+                      p_drop, interpret, res, do, dlse=dlse)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 # ---------------------------------------------------------------------------
@@ -434,7 +525,8 @@ def _mha_tune_key(q, k, causal, interpret):
 
 
 def mha(q, k, v, *, causal=False, sm_scale=None, block_q=None, block_k=None,
-        dropout_p=0.0, seed=None, interpret=None):
+        dropout_p=0.0, seed=None, seq_lens=None, causal_shift=None,
+        return_lse=False, interpret=None):
     """Tiled flash attention on raw arrays in (B, H, S, D) layout.
 
     Pads S to the tile size and D to the 128-lane width (zero-padding is
@@ -474,14 +566,36 @@ def mha(q, k, v, *, causal=False, sm_scale=None, block_q=None, block_k=None,
         seed = jnp.zeros((), jnp.float32)
     else:
         seed = jnp.asarray(seed, jnp.float32).reshape(())
+    lens = None
+    if seq_lens is not None:
+        # per-sequence valid kv lengths (B,) -> (B*H,), f32-bitcast for
+        # the custom_vjp boundary; varlen is self-attention semantics
+        if sq != skv:
+            raise ValueError("seq_lens requires self-attention (sq == skv)")
+        l = jnp.asarray(seq_lens, jnp.int32).reshape(b)
+        lens = jax.lax.bitcast_convert_type(
+            jnp.repeat(l, h), jnp.float32)
+    shift = None
+    if causal_shift is not None:
+        # traced diagonal offset (ring attention): col <= row + shift
+        if not causal:
+            raise ValueError("causal_shift requires causal=True")
+        shift = jax.lax.bitcast_convert_type(
+            jnp.asarray(causal_shift, jnp.int32).reshape(()), jnp.float32)
 
     def prep(x, s_p):
         x = x.reshape(b * h, x.shape[2], d)
         return jnp.pad(x, ((0, 0), (0, s_p - x.shape[1]), (0, d_p - d)))
 
     qp, kp, vp = prep(q, sq_p), prep(k, skv_p), prep(v, skv_p)
-    out = _flash(qp, kp, vp, seed, causal, sm_scale, block_q, block_k, sq,
-                 skv, p_drop, interpret)
+    if return_lse:
+        out, lse = _flash_lse(qp, kp, vp, seed, lens, shift, causal,
+                              sm_scale, block_q, block_k, sq, skv, p_drop,
+                              interpret)
+        return (out[:, :sq, :d].reshape(b, h, sq, d),
+                lse[:, :sq, 0].reshape(b, h, sq))
+    out = _flash(qp, kp, vp, seed, lens, shift, causal, sm_scale, block_q,
+                 block_k, sq, skv, p_drop, interpret)
     return out[:, :sq, :d].reshape(b, h, sq, d)
 
 

@@ -356,6 +356,36 @@ def test_recompute_grad_matches_plain():
                                    rtol=1e-5, atol=1e-6)
 
 
+def test_recompute_policy_matches_plain():
+    """policy= selects a jax.checkpoint_policies saveable set without
+    changing the math (ref recompute granularity core_attn/full)."""
+    from paddle_tpu.distributed.fleet.utils import recompute
+    from paddle_tpu.jit.api import functional_call
+    net = pt.nn.Sequential(pt.nn.Linear(8, 8), pt.nn.GELU(),
+                           pt.nn.Linear(8, 4))
+    x = np.random.RandomState(6).rand(3, 8).astype(np.float32)
+    params = {k: p._data for k, p in net.named_parameters()}
+
+    def loss(p, xs, policy):
+        def inner(xs_t):
+            out, _ = functional_call(net, p, {}, (xs_t,))
+            return out
+        if policy == "plain":
+            return jnp.sum(inner(Tensor(xs))._data ** 2)
+        out = recompute(inner, Tensor(xs), policy=policy)
+        return jnp.sum(out._data ** 2)
+
+    ref = jax.grad(loss)(params, jnp.asarray(x), "plain")
+    for policy in ("dots", "dots_with_no_batch_dims", None):
+        g = jax.grad(loss)(params, jnp.asarray(x), policy)
+        for k in ref:
+            np.testing.assert_allclose(np.asarray(ref[k]), np.asarray(g[k]),
+                                       rtol=1e-5, atol=1e-6)
+    import pytest as _pytest
+    with _pytest.raises(AttributeError):
+        jax.grad(loss)(params, jnp.asarray(x), "not_a_policy")
+
+
 def test_data_parallel_wrapper_shards_and_trains():
     dist.init_mesh({"dp": N})
     net = pt.nn.Linear(4, 2)

@@ -216,3 +216,97 @@ class TestDataLoader:
         batches = list(dl)
         assert len(batches) == 3
         assert batches[-1].shape == [1, 2]
+
+
+def test_to_static_function_closure_layer_trains():
+    """to_static on a bare FUNCTION must thread closure-captured layers'
+    params through the program — previously they traced as constants and
+    backward() silently produced no grads (loss never moved)."""
+    pt.seed(9)
+    np.random.seed(9)
+    net = pt.nn.Sequential(pt.nn.Linear(8, 16), pt.nn.GELU(),
+                           pt.nn.Linear(16, 2))
+    opt = pt.optimizer.SGD(learning_rate=0.3, parameters=net.parameters())
+    X = np.random.randn(32, 8).astype(np.float32)
+    Y = (X.sum(1) > 0).astype(np.int64)
+
+    @pt.jit.to_static
+    def step(xb, yb):
+        return pt.nn.functional.cross_entropy(net(xb), yb)
+
+    losses = []
+    for _ in range(20):
+        loss = step(to_tensor(X), to_tensor(Y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5, losses[::19]
+
+
+def test_to_static_function_closure_buffers_update():
+    """Buffer mutations (BN running stats) inside a closure-captured layer
+    must write back to the live layer after a compiled-function call."""
+    pt.seed(4)
+    bn = pt.nn.BatchNorm1D(4, data_format="NCL")
+    bn.train()
+
+    @pt.jit.to_static
+    def fwd(x):
+        return bn(x)
+
+    before = bn._mean.numpy().copy()
+    x = to_tensor(np.random.rand(8, 4, 6).astype(np.float32) + 5.0)
+    fwd(x)
+    assert not np.allclose(before, bn._mean.numpy())
+
+
+_global_net = None
+
+
+def test_to_static_function_global_layer_trains():
+    """Layers referenced as module-level globals (not closure freevars)
+    must also thread through the compiled program."""
+    global _global_net
+    pt.seed(12)
+    np.random.seed(12)
+    _global_net = pt.nn.Linear(6, 2)
+    opt = pt.optimizer.SGD(learning_rate=0.5,
+                           parameters=_global_net.parameters())
+    X = np.random.randn(32, 6).astype(np.float32)
+    Y = (X.sum(1) > 0).astype(np.int64)
+
+    @pt.jit.to_static
+    def step(xb, yb):
+        return pt.nn.functional.cross_entropy(_global_net(xb), yb)
+
+    losses = []
+    for _ in range(15):
+        loss = step(to_tensor(X), to_tensor(Y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.7, losses[::14]
+
+
+def test_to_static_function_per_layer_mode_retrace():
+    """Flipping ONE captured layer's train/eval mode must retrace — an
+    aggregate boolean cache key would silently keep the stale mode."""
+    pt.seed(13)
+    drop = pt.nn.Dropout(0.5)
+    scalev = pt.nn.Linear(8, 8)
+    drop.train()
+
+    @pt.jit.to_static
+    def fwd(x):
+        return drop(scalev(x))
+
+    x = to_tensor(np.ones((4, 8), np.float32))
+    a = fwd(x).numpy()
+    b = fwd(x).numpy()
+    assert not np.allclose(a, b)  # dropout active
+    drop.eval()  # only drop's mode changes
+    c = fwd(x).numpy()
+    d = fwd(x).numpy()
+    np.testing.assert_allclose(c, d)  # deterministic now

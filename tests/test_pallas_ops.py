@@ -173,7 +173,7 @@ class TestFlashDropout:
 
     PD = 0.3
 
-    def _setup(self, b=1, h=2, s=256, d=64):
+    def _setup(self, b=1, h=2, s=128, d=64):
         q, k, v = (_rand((b, h, s, d), i) for i in range(3))
         seed = jnp.asarray(1.2345, jnp.float32)
         return q, k, v, seed
@@ -259,3 +259,87 @@ class TestFlashDropout:
                              pt.to_tensor(x), causal=True, dropout_p=0.4,
                              interpret=True)
         assert float(np.abs(o1.numpy() - o2.numpy()).max()) > 1e-5
+
+
+class TestVarlen:
+    """Per-row kv-length masking (ref flash_attn_unpadded,
+    ``python/paddle/nn/functional/flash_attention.py:272``)."""
+
+    def _ref_padded(self, q, k, v, lens, causal=False):
+        b, h, s, d = q.shape
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
+        kcol = jnp.arange(s)[None, None, None, :]
+        mask = kcol < jnp.asarray(lens)[:, None, None, None]
+        if causal:
+            qrow = jnp.arange(s)[None, None, :, None]
+            mask = mask & (kcol <= qrow)
+        logits = jnp.where(mask, logits, -jnp.inf)
+        p = jax.nn.softmax(logits, axis=-1)
+        p = jnp.where(jnp.isnan(p), 0.0, p)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+    def test_forward_matches_masked_reference(self):
+        q, k, v = (_rand((3, 2, 128, 64), i) for i in range(3))
+        lens = np.array([128, 70, 1], np.int32)
+        for causal in (False, True):
+            out = mha(q, k, v, seq_lens=lens, causal=causal, interpret=True)
+            ref = self._ref_padded(q, k, v, lens, causal)
+            # only rows < len are meaningful
+            for bi, L in enumerate(lens):
+                np.testing.assert_allclose(
+                    np.asarray(out)[bi, :, :L], np.asarray(ref)[bi, :, :L],
+                    atol=2e-5, rtol=2e-5)
+
+    def test_grads_match_masked_reference(self):
+        q, k, v = (_rand((2, 2, 128, 64), i) for i in range(3))
+        lens = np.array([100, 40], np.int32)
+
+        def valid_loss(out):
+            # padded query rows excluded, as a caller's loss mask would
+            m = (jnp.arange(128)[None, :] < jnp.asarray(lens)[:, None])
+            return ((out * m[:, None, :, None]) ** 2).sum()
+
+        g = jax.grad(lambda *a: valid_loss(
+            mha(*a, seq_lens=lens, interpret=True)), argnums=(0, 1, 2))(
+                q, k, v)
+        gr = jax.grad(lambda *a: valid_loss(
+            self._ref_padded(*a, lens)), argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(g, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       atol=3e-4, rtol=3e-4)
+
+    def test_unpadded_api_packed_layout(self):
+        import paddle_tpu as pt
+        from paddle_tpu.nn.functional import flash_attn_unpadded
+        rs = np.random.RandomState(3)
+        lens = [60, 128, 13]
+        cu = np.cumsum([0] + lens).astype(np.int32)
+        total, h, d = int(cu[-1]), 2, 64
+        qkv = [rs.randn(total, h, d).astype(np.float32) for _ in range(3)]
+        out, _ = flash_attn_unpadded(
+            pt.to_tensor(qkv[0]), pt.to_tensor(qkv[1]), pt.to_tensor(qkv[2]),
+            pt.to_tensor(cu), pt.to_tensor(cu), 128, 128,
+            scale=1.0 / np.sqrt(d))
+        assert tuple(out.shape) == (total, h, d)
+        # each packed sequence must equal standalone attention on itself
+        for i in range(len(lens)):
+            s0, s1 = int(cu[i]), int(cu[i + 1])
+            qi = jnp.asarray(qkv[0][s0:s1])[None].swapaxes(1, 2)
+            ki = jnp.asarray(qkv[1][s0:s1])[None].swapaxes(1, 2)
+            vi = jnp.asarray(qkv[2][s0:s1])[None].swapaxes(1, 2)
+            ref = mha_reference(qi, ki, vi)[0].swapaxes(0, 1)
+            np.testing.assert_allclose(out.numpy()[s0:s1], np.asarray(ref),
+                                       atol=2e-3, rtol=2e-3)
+
+    def test_flash_attention_api(self):
+        import paddle_tpu as pt
+        from paddle_tpu.nn.functional.flash_attention import flash_attention
+        x = np.random.RandomState(0).randn(1, 128, 2, 64).astype(np.float32)
+        t = pt.to_tensor(x)
+        out, sm = flash_attention(t, t, t, causal=True)
+        assert sm is None and tuple(out.shape) == (1, 128, 2, 64)
+        out2, sm2 = flash_attention(t, t, t, causal=True,
+                                    return_softmax=True)
+        assert sm2 is not None
+        np.testing.assert_allclose(out.numpy(), out2.numpy(), atol=2e-3,
+                                   rtol=2e-3)

@@ -142,6 +142,7 @@ def test_lr_scheduler_threaded_into_compiled_step():
     np.testing.assert_allclose(w1, w2)     # zero LR => no movement
 
 
+@pytest.mark.slow
 def test_scaler_through_compiled_pipeline_parity():
     """AMP scaler + pp2 must take the COMPILED path (ref runs 1F1B with
     its scaler, ``hybrid_parallel_gradscaler.py``) and match the eager

@@ -51,6 +51,7 @@ class TestRNNTLoss:
             for b in range(B)])
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
 
+    @pytest.mark.slow
     def test_gradients_flow_and_match_fd(self):
         rng = np.random.RandomState(1)
         T, U, V_ = 4, 2, 5
@@ -238,6 +239,7 @@ class TestDeformConv2d:
             np.asarray(got._data)[..., :-1],
             np.asarray(want._data)[..., :-1], rtol=1e-4, atol=1e-4)
 
+    @pytest.mark.slow
     def test_modulated_mask_and_grads(self):
         rng = np.random.RandomState(9)
         x = Tensor(rng.randn(1, 2, 6, 6).astype(np.float32))
