@@ -158,14 +158,29 @@ def _closure_layer_targets(fn):
         except ValueError:
             continue
         add_container(name, val)
-    # module-level globals the code object references (co_names) — the
-    # most common script style (`net = Linear(...)` at top level)
+    # module-level globals the code object LOADS as globals — the most
+    # common script style (`net = Linear(...)` at top level). Uses real
+    # LOAD_GLOBAL instructions, not co_names: co_names also contains
+    # attribute names, which would spuriously capture an unrelated
+    # global layer whose name collides with any `obj.attr` access.
     if code is not None:
         g = getattr(raw, "__globals__", {})
-        for name in code.co_names:
+        for name in dict.fromkeys(_loaded_global_names(code)):
             if name in g:
                 add_container(name, g[name])
     return out
+
+
+def _loaded_global_names(code):
+    import dis
+    names = []
+    for ins in dis.get_instructions(code):
+        if ins.opname == "LOAD_GLOBAL":
+            names.append(ins.argval)
+    for const in code.co_consts:
+        if hasattr(const, "co_code"):
+            names.extend(_loaded_global_names(const))
+    return names
 
 
 class StaticFunction:
@@ -179,7 +194,6 @@ class StaticFunction:
         self._jitted = None
         self._closure_param_tensors = None
         self._closure_buffer_tensors = None
-        self._closure_targets_cache = None
         try:
             functools.update_wrapper(self, function)
         except AttributeError:
@@ -276,10 +290,11 @@ class StaticFunction:
         else:
             params, buffers = {}, {}
             cp, cb, modes = [], [], []
-            if self._closure_targets_cache is None:
-                self._closure_targets_cache = _closure_layer_targets(
-                    self._orig_fn)
-            for pref, ly in self._closure_targets_cache:
+            # re-scan every call: caching the Layer objects would go
+            # stale when a captured global/closure layer is REBOUND to a
+            # fresh instance (notebook re-init) — the stale object would
+            # silently reintroduce the traced-as-constant no-grad bug
+            for pref, ly in _closure_layer_targets(self._orig_fn):
                 for k, t in dict(ly.named_parameters()).items():
                     params[f"{pref}::{k}"] = t
                     cp.append((f"{pref}::{k}", t))

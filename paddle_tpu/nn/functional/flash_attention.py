@@ -79,11 +79,21 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     k, v = ensure_tensor(key), ensure_tensor(value)
     cu_q = jnp.asarray(ensure_tensor(cu_seqlens_q)._data, jnp.int32)
     cu_k = jnp.asarray(ensure_tensor(cu_seqlens_k)._data, jnp.int32)
-    if not np.array_equal(np.asarray(cu_q), np.asarray(cu_k)):
-        raise NotImplementedError(
-            "flash_attn_unpadded currently supports self-attention "
-            "lengths only (cu_seqlens_q == cu_seqlens_k); cross-attention "
-            "varlen is not implemented")
+    # validate only when concrete: under a jit/to_static trace the cu
+    # arrays are tracers (and eager validation costs one host transfer,
+    # which is what a data-dependent check is)
+    if not isinstance(cu_q, jax.core.Tracer) and \
+            not isinstance(cu_k, jax.core.Tracer):
+        cq = np.asarray(cu_q)
+        if not np.array_equal(cq, np.asarray(cu_k)):
+            raise NotImplementedError(
+                "flash_attn_unpadded currently supports self-attention "
+                "lengths only (cu_seqlens_q == cu_seqlens_k); "
+                "cross-attention varlen is not implemented")
+        if (np.diff(cq) > int(max_seqlen_q)).any():
+            raise ValueError(
+                f"a sequence exceeds max_seqlen_q={max_seqlen_q}; longer "
+                f"sequences would be silently truncated")
     max_q = int(max_seqlen_q)
     eff = dropout if training else 0.0
     seeds = _seed_input(eff, True)

@@ -310,3 +310,47 @@ def test_to_static_function_per_layer_mode_retrace():
     c = fwd(x).numpy()
     d = fwd(x).numpy()
     np.testing.assert_allclose(c, d)  # deterministic now
+
+
+def test_to_static_function_rebound_global_retraces():
+    """Rebinding a captured global layer to a fresh instance must be
+    picked up (no stale-object cache)."""
+    global _global_net
+    pt.seed(14)
+    _global_net = pt.nn.Linear(4, 2)
+
+    @pt.jit.to_static
+    def fwd(x):
+        return _global_net(x)
+
+    x = to_tensor(np.ones((2, 4), np.float32))
+    a = fwd(x).numpy()
+    pt.seed(99)
+    _global_net = pt.nn.Linear(4, 2)  # fresh weights
+    b = fwd(x).numpy()
+    assert not np.allclose(a, b), "rebound layer's weights must be used"
+
+
+def test_to_static_attr_name_collision_not_captured():
+    """An unrelated global layer whose NAME matches an attribute access
+    must not be captured as traced params."""
+    global _decoy
+    _decoy = pt.nn.Linear(3, 3)
+
+    class Holder:
+        pass
+
+    h = Holder()
+    h._decoy = "just a string attribute"
+
+    @pt.jit.to_static
+    def fwd(x):
+        _ = h._decoy  # attribute named like the global layer
+        return x * 2.0
+
+    from paddle_tpu.jit.api import _closure_layer_targets
+    names = [p for p, _ in _closure_layer_targets(fwd._orig_fn)]
+    assert all("_decoy" != n for n in names), names
+    # 'h' itself IS a freevar but not a Layer, so nothing is captured
+    out = fwd(to_tensor(np.ones(2, np.float32)))
+    np.testing.assert_allclose(out.numpy(), [2.0, 2.0])

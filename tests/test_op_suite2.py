@@ -1,0 +1,429 @@
+"""Per-op OpTest corpus, part 2: losses, norms, pools, convs, misc
+(ref: ``test/legacy_test/eager_op_test.py:377`` + per-op tolerance
+tables ``test/white_list/op_accuracy_white_list.py``). Same declarative
+scheme as test_op_suite.py; rows here cover the nn.functional callables
+that part 1 does not."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+import paddle_tpu.nn.functional as F
+from paddle_tpu import Tensor
+from op_test import check_output, check_grad
+
+
+def _sp(*shape, seed=0, pos=False, lo=-2.0, hi=2.0):
+    rng = np.random.RandomState(seed)
+    a = rng.uniform(lo, hi, shape).astype(np.float32)
+    if pos:
+        a = np.abs(a) + 0.5
+    return a
+
+
+def _softmax_np(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def _sig(x):
+    return 1 / (1 + np.exp(-x))
+
+
+# fixed auxiliary data (labels etc.) closed over so check_grad only
+# perturbs the float inputs
+_LBL = np.random.RandomState(7).randint(0, 5, (4,))
+_LBL_T = pt.to_tensor(_LBL)
+_BIN = np.random.RandomState(8).uniform(0.1, 0.9, (4, 5)).astype(np.float32)
+_BIN01 = (np.random.RandomState(9).rand(4, 5) > 0.5).astype(np.float32)
+_PM1 = np.where(np.random.RandomState(10).rand(4) > 0.5, 1., -1.).astype(
+    np.float32)
+
+_W1D = _sp(3, 2, 3, seed=21)          # conv1d weight (Cout, Cin, K)
+_W3D = _sp(2, 2, 2, 2, 2, seed=22)    # conv3d weight
+_W2T = _sp(2, 3, 3, 3, seed=23)       # conv2d_transpose weight (Cin, Cout, K, K)
+_W1T = _sp(2, 3, 3, seed=24)
+_W3T = _sp(2, 2, 2, 2, 2, seed=25)
+_EMB = _sp(10, 4, seed=26)
+_BILIN_W = _sp(3, 4, 5, seed=27)      # bilinear (out, in1, in2)
+
+
+def _conv1d_np(x, w):
+    b, ci, L = x.shape
+    co, _, k = w.shape
+    out = np.zeros((b, co, L - k + 1), np.float64)
+    for i in range(L - k + 1):
+        out[:, :, i] = np.einsum("bck,ock->bo", x[:, :, i:i + k], w)
+    return out
+
+
+def _conv3d_np(x, w):
+    b, ci, D, H, W = x.shape
+    co, _, kd, kh, kw = w.shape
+    out = np.zeros((b, co, D - kd + 1, H - kh + 1, W - kw + 1), np.float64)
+    for z in range(out.shape[2]):
+        for i in range(out.shape[3]):
+            for j in range(out.shape[4]):
+                patch = x[:, :, z:z + kd, i:i + kh, j:j + kw]
+                out[:, :, z, i, j] = np.einsum("bcdhw,ocdhw->bo", patch, w)
+    return out
+
+
+def _convt_np(x, w, dims):
+    """Transposed conv via scatter-accumulate, stride 1, no padding.
+    w layout (Cin, Cout, *K)."""
+    b, ci = x.shape[:2]
+    co = w.shape[1]
+    insp = x.shape[2:]
+    ksp = w.shape[2:]
+    outsp = tuple(i + k - 1 for i, k in zip(insp, ksp))
+    out = np.zeros((b, co) + outsp, np.float64)
+    for idx in np.ndindex(*insp):
+        val = x[(slice(None), slice(None)) + idx]  # (b, ci)
+        contrib = np.einsum("bc,co...->bo...", val, w)
+        sl = tuple(slice(i, i + k) for i, k in zip(idx, ksp))
+        out[(slice(None), slice(None)) + sl] += contrib
+    return out
+
+
+def _avgpool_np(x, k, nd):
+    sp = x.shape[2:]
+    osp = tuple(s // k for s in sp)
+    out = np.zeros(x.shape[:2] + osp, np.float64)
+    for idx in np.ndindex(*osp):
+        sl = tuple(slice(i * k, i * k + k) for i in idx)
+        out[(...,) + idx] = x[(...,) + sl].mean(
+            axis=tuple(range(2, 2 + nd)))
+    return out
+
+
+def _maxpool_np(x, k, nd):
+    sp = x.shape[2:]
+    osp = tuple(s // k for s in sp)
+    out = np.zeros(x.shape[:2] + osp, np.float64)
+    for idx in np.ndindex(*osp):
+        sl = tuple(slice(i * k, i * k + k) for i in idx)
+        out[(...,) + idx] = x[(...,) + sl].max(
+            axis=tuple(range(2, 2 + nd)))
+    return out
+
+
+def _group_norm_np(x, g, eps=1e-5):
+    b, c = x.shape[:2]
+    xs = x.reshape(b, g, -1)
+    m = xs.mean(-1, keepdims=True)
+    v = xs.var(-1, keepdims=True)
+    return ((xs - m) / np.sqrt(v + eps)).reshape(x.shape)
+
+
+def _lrn_np(x, size, alpha=1e-4, beta=0.75, k=1.0):
+    sq = x ** 2
+    c = x.shape[1]
+    div = np.zeros_like(x)
+    half = size // 2
+    for i in range(c):
+        lo, hi = max(0, i - half), min(c, i - half + size)
+        div[:, i] = sq[:, lo:hi].sum(axis=1)
+    return x / (k + alpha * div / size) ** beta
+
+
+OPS = [
+    # -- activation variants ------------------------------------------------
+    ("swish", F.swish, lambda x: x * _sig(x), [_sp(3, 4)], {}),
+    ("thresholded_relu", F.thresholded_relu,
+     lambda x: np.where(x > 1.0, x, 0), [_sp(3, 4)], {"grad": False}),
+    ("stanh", F.stanh,
+     lambda x: 1.7159 * np.tanh(0.67 * x), [_sp(3, 4)], {}),
+    ("prelu", lambda x: F.prelu(x, pt.to_tensor(np.float32([0.25]))),
+     lambda x: np.where(x > 0, x, 0.25 * x), [_sp(3, 4)], {"grad": False}),
+    ("glu", F.glu,
+     lambda x: x[:, :2] * _sig(x[:, 2:]), [_sp(3, 4)], {}),
+    ("maxout", lambda x: F.maxout(x, groups=2),
+     lambda x: x.reshape(2, 2, 2, 3, 3).max(2).reshape(2, 2, 3, 3),
+     [_sp(2, 4, 3, 3)], {"grad": False}),
+    ("relu_", lambda x: F.relu_(x.clone()),
+     lambda x: np.maximum(x, 0), [_sp(3, 4)], {"grad": False}),
+    ("tanh_", lambda x: F.tanh_(x.clone()), np.tanh, [_sp(3, 4)],
+     {"grad": False}),
+    ("elu_", lambda x: F.elu_(x.clone()),
+     lambda x: np.where(x > 0, x, np.exp(np.minimum(x, 0)) - 1),
+     [_sp(3, 4)], {"grad": False}),
+    ("softmax_", lambda x: F.softmax_(x.clone()), _softmax_np,
+     [_sp(3, 5)], {"grad": False}),
+    # -- losses -------------------------------------------------------------
+    ("cross_entropy", lambda x: F.cross_entropy(x, _LBL_T),
+     lambda x: -np.log(_softmax_np(x))[np.arange(4), _LBL].mean(),
+     [_sp(4, 5)], {}),
+    ("softmax_with_cross_entropy",
+     lambda x: F.softmax_with_cross_entropy(x, pt.to_tensor(_LBL[:, None])),
+     lambda x: -np.log(_softmax_np(x))[np.arange(4), _LBL][:, None],
+     [_sp(4, 5)], {}),
+    ("binary_cross_entropy",
+     lambda x: F.binary_cross_entropy(x, pt.to_tensor(_BIN01)),
+     lambda x: -(_BIN01 * np.log(x) + (1 - _BIN01) * np.log(1 - x)).mean(),
+     [_BIN], {}),
+    ("binary_cross_entropy_with_logits",
+     lambda x: F.binary_cross_entropy_with_logits(x, pt.to_tensor(_BIN01)),
+     lambda x: (np.maximum(x, 0) - x * _BIN01 + np.log1p(
+         np.exp(-np.abs(x)))).mean(),
+     [_sp(4, 5)], {}),
+    ("nll_loss", lambda x: F.nll_loss(x, _LBL_T),
+     lambda x: -x[np.arange(4), _LBL].mean(), [_sp(4, 5)], {}),
+    ("smooth_l1_loss",
+     lambda x: F.smooth_l1_loss(x, pt.to_tensor(_BIN)),
+     lambda x: np.where(np.abs(x - _BIN) < 1.0,
+                        0.5 * (x - _BIN) ** 2,
+                        np.abs(x - _BIN) - 0.5).mean(),
+     [_sp(4, 5)], {}),
+    ("square_error_cost",
+     lambda x: F.square_error_cost(x, pt.to_tensor(_BIN)),
+     lambda x: (x - _BIN) ** 2, [_sp(4, 5)], {}),
+    ("log_loss",
+     lambda x: F.log_loss(x, pt.to_tensor(_BIN01[:, :1])),
+     lambda x: -(_BIN01[:, :1] * np.log(x + 1e-4)
+                 + (1 - _BIN01[:, :1]) * np.log(1 - x + 1e-4)),
+     [_sp(4, 1, lo=0.2, hi=0.8)], {}),
+    ("soft_margin_loss",
+     lambda x: F.soft_margin_loss(x, pt.to_tensor(np.tile(_PM1, (5, 1)).T)),
+     lambda x: np.log1p(np.exp(-np.tile(_PM1, (5, 1)).T * x)).mean(),
+     [_sp(4, 5)], {}),
+    ("multi_label_soft_margin_loss",
+     lambda x: F.multi_label_soft_margin_loss(x, pt.to_tensor(_BIN01)),
+     lambda x: -(_BIN01 * np.log(_sig(x)) + (1 - _BIN01) * np.log(
+         _sig(-x))).mean(axis=-1).mean(),
+     [_sp(4, 5)], {}),
+    ("sigmoid_focal_loss",
+     lambda x: F.sigmoid_focal_loss(x, pt.to_tensor(_BIN01),
+                                    reduction="mean"),
+     lambda x: np.mean(
+         (0.25 * _BIN01 + 0.75 * (1 - _BIN01))
+         * ((1 - (_sig(x) * _BIN01 + (1 - _sig(x)) * (1 - _BIN01))) ** 2.0)
+         * (np.maximum(x, 0) - x * _BIN01 + np.log1p(np.exp(-np.abs(x))))),
+     [_sp(4, 5)], {"grad_atol": 2e-2}),
+    ("dice_loss",
+     lambda x: F.dice_loss(x, pt.to_tensor(_LBL[:, None].astype(np.int64))),
+     None, [_softmax_np(_sp(4, 5))], {"ref_self": True}),
+    ("margin_ranking_loss",
+     lambda x, y: F.margin_ranking_loss(x, y, pt.to_tensor(_PM1)),
+     lambda x, y: np.maximum(-_PM1 * (x - y), 0).mean(),
+     [_sp(4), _sp(4, seed=1)], {"grad": False}),
+    ("hinge_embedding_loss",
+     lambda x: F.hinge_embedding_loss(x, pt.to_tensor(_PM1)),
+     lambda x: np.where(_PM1 > 0, x, np.maximum(0, 1.0 - x)).mean(),
+     [_sp(4)], {"grad": False}),
+    ("cosine_embedding_loss",
+     lambda x, y: F.cosine_embedding_loss(x, y, pt.to_tensor(_PM1)),
+     lambda x, y: np.where(
+         _PM1 > 0,
+         1 - (x * y).sum(-1) / (np.linalg.norm(x, axis=-1)
+                                * np.linalg.norm(y, axis=-1)),
+         np.maximum(0, (x * y).sum(-1) / (np.linalg.norm(x, axis=-1)
+                                          * np.linalg.norm(y, axis=-1)))
+     ).mean(),
+     [_sp(4, 3), _sp(4, 3, seed=1)], {}),
+    ("triplet_margin_loss",
+     lambda a, p, n: F.triplet_margin_loss(a, p, n),
+     lambda a, p, n: np.maximum(
+         np.linalg.norm(a - p, axis=-1) - np.linalg.norm(a - n, axis=-1)
+         + 1.0, 0).mean(),
+     [_sp(4, 3), _sp(4, 3, seed=1), _sp(4, 3, seed=2)], {}),
+    ("triplet_margin_with_distance_loss",
+     lambda a, p, n: F.triplet_margin_with_distance_loss(a, p, n),
+     lambda a, p, n: np.maximum(
+         np.linalg.norm(a - p, axis=-1) - np.linalg.norm(a - n, axis=-1)
+         + 1.0, 0).mean(),
+     [_sp(4, 3), _sp(4, 3, seed=1), _sp(4, 3, seed=2)], {}),
+    ("poisson_nll_loss",
+     lambda x: F.poisson_nll_loss(x, pt.to_tensor(np.abs(_BIN))),
+     lambda x: (np.exp(x) - np.abs(_BIN) * x).mean(),
+     [_sp(4, 5)], {}),
+    ("gaussian_nll_loss",
+     lambda x: F.gaussian_nll_loss(x, pt.to_tensor(_BIN),
+                                   pt.to_tensor(np.abs(_BIN) + 0.5)),
+     lambda x: (0.5 * (np.log(np.abs(_BIN) + 0.5)
+                       + (x - _BIN) ** 2 / (np.abs(_BIN) + 0.5))).mean(),
+     [_sp(4, 5)], {}),
+    ("npair_loss",
+     lambda a, p: F.npair_loss(a, p, pt.to_tensor(_LBL)),
+     None, [_sp(4, 3), _sp(4, 3, seed=1)], {"ref_self": True}),
+    # -- norms --------------------------------------------------------------
+    ("normalize", F.normalize,
+     lambda x: x / np.maximum(np.linalg.norm(x, axis=1, keepdims=True),
+                              1e-12),
+     [_sp(3, 4)], {}),
+    ("rms_norm", F.rms_norm,
+     lambda x: x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6),
+     [_sp(3, 4)], {}),
+    ("group_norm", lambda x: F.group_norm(x, num_groups=2),
+     lambda x: _group_norm_np(x, 2), [_sp(2, 4, 3)], {"grad_atol": 2e-2}),
+    ("instance_norm", lambda x: F.instance_norm(x),
+     lambda x: (x - x.mean((2, 3), keepdims=True))
+     / np.sqrt(x.var((2, 3), keepdims=True) + 1e-5),
+     [_sp(2, 3, 4, 4)], {"grad_atol": 2e-2}),
+    ("batch_norm_eval",
+     lambda x: F.batch_norm(
+         x, pt.to_tensor(np.zeros(3, np.float32)),
+         pt.to_tensor(np.ones(3, np.float32)), training=False),
+     lambda x: x / np.sqrt(1 + 1e-5),
+     [_sp(2, 3, 4)], {}),
+    ("local_response_norm",
+     lambda x: F.local_response_norm(x, size=3),
+     lambda x: _lrn_np(x, 3), [_sp(2, 5, 4)], {"grad_atol": 2e-2}),
+    # -- pools --------------------------------------------------------------
+    ("avg_pool1d", lambda x: F.avg_pool1d(x, kernel_size=2, stride=2),
+     lambda x: _avgpool_np(x, 2, 1), [_sp(2, 3, 8)], {}),
+    ("avg_pool3d", lambda x: F.avg_pool3d(x, kernel_size=2, stride=2),
+     lambda x: _avgpool_np(x, 2, 3), [_sp(1, 2, 4, 4, 4)], {}),
+    ("max_pool1d", lambda x: F.max_pool1d(x, kernel_size=2, stride=2),
+     lambda x: _maxpool_np(x, 2, 1), [_sp(2, 3, 8)], {"grad": False}),
+    ("max_pool3d", lambda x: F.max_pool3d(x, kernel_size=2, stride=2),
+     lambda x: _maxpool_np(x, 2, 3), [_sp(1, 2, 4, 4, 4)],
+     {"grad": False}),
+    ("adaptive_avg_pool1d", lambda x: F.adaptive_avg_pool1d(x, 2),
+     lambda x: _avgpool_np(x, 4, 1), [_sp(2, 3, 8)], {}),
+    ("adaptive_avg_pool2d", lambda x: F.adaptive_avg_pool2d(x, 2),
+     lambda x: _avgpool_np(x, 2, 2), [_sp(2, 3, 4, 4)], {}),
+    ("adaptive_avg_pool3d", lambda x: F.adaptive_avg_pool3d(x, 2),
+     lambda x: _avgpool_np(x, 2, 3), [_sp(1, 2, 4, 4, 4)], {}),
+    ("adaptive_max_pool1d", lambda x: F.adaptive_max_pool1d(x, 2),
+     lambda x: _maxpool_np(x, 4, 1), [_sp(2, 3, 8)], {"grad": False}),
+    ("adaptive_max_pool2d", lambda x: F.adaptive_max_pool2d(x, 2),
+     lambda x: _maxpool_np(x, 2, 2), [_sp(2, 3, 4, 4)], {"grad": False}),
+    ("adaptive_max_pool3d", lambda x: F.adaptive_max_pool3d(x, 2),
+     lambda x: _maxpool_np(x, 2, 3), [_sp(1, 2, 4, 4, 4)],
+     {"grad": False}),
+    ("lp_pool1d", lambda x: F.lp_pool1d(x, 2, kernel_size=2, stride=2),
+     lambda x: np.sqrt((_avgpool_np(x ** 2, 2, 1)) * 2),
+     [_sp(2, 3, 8, pos=True)], {}),
+    ("lp_pool2d", lambda x: F.lp_pool2d(x, 2, kernel_size=2, stride=2),
+     lambda x: np.sqrt((_avgpool_np(x ** 2, 2, 2)) * 4),
+     [_sp(2, 3, 4, 4, pos=True)], {}),
+    # -- convs --------------------------------------------------------------
+    ("conv1d", lambda x: F.conv1d(x, pt.to_tensor(_W1D).astype(x.dtype)),
+     lambda x: _conv1d_np(x, _W1D), [_sp(2, 2, 6)],
+     {"bf16_atol": 5e-2, "bf16_rtol": 5e-2}),
+    ("conv3d", lambda x: F.conv3d(x, pt.to_tensor(_W3D).astype(x.dtype)),
+     lambda x: _conv3d_np(x, _W3D), [_sp(1, 2, 4, 4, 4)],
+     {"bf16_atol": 5e-2, "bf16_rtol": 5e-2}),
+    ("conv1d_transpose",
+     lambda x: F.conv1d_transpose(x, pt.to_tensor(_W1T).astype(x.dtype)),
+     lambda x: _convt_np(x, _W1T, 1), [_sp(2, 2, 5)],
+     {"bf16_atol": 5e-2, "bf16_rtol": 5e-2}),
+    ("conv2d_transpose",
+     lambda x: F.conv2d_transpose(x, pt.to_tensor(_W2T).astype(x.dtype)),
+     lambda x: _convt_np(x, _W2T, 2), [_sp(1, 2, 4, 4)],
+     {"bf16_atol": 8e-2, "bf16_rtol": 8e-2}),
+    ("conv3d_transpose",
+     lambda x: F.conv3d_transpose(x, pt.to_tensor(_W3T).astype(x.dtype)),
+     lambda x: _convt_np(x, _W3T, 3), [_sp(1, 2, 3, 3, 3)],
+     {"bf16_atol": 8e-2, "bf16_rtol": 8e-2}),
+    # -- misc ---------------------------------------------------------------
+    ("linear",
+     lambda x: F.linear(x, pt.to_tensor(_sp(4, 3, seed=30))),
+     lambda x: x @ _sp(4, 3, seed=30), [_sp(2, 4)],
+     {"bf16_atol": 5e-2, "bf16_rtol": 5e-2}),
+    ("embedding",
+     lambda: F.embedding(pt.to_tensor(_LBL), pt.to_tensor(_EMB)),
+     lambda: _EMB[_LBL], [], {"grad": False, "no_inputs": True}),
+    ("one_hot",
+     lambda: F.one_hot(pt.to_tensor(_LBL), num_classes=5),
+     lambda: np.eye(5, dtype=np.float32)[_LBL], [],
+     {"grad": False, "no_inputs": True}),
+    ("label_smooth",
+     lambda x: F.label_smooth(x, epsilon=0.1),
+     lambda x: x * 0.9 + 0.1 / x.shape[-1], [_BIN], {}),
+    ("bilinear",
+     lambda x, y: F.bilinear(x, y, pt.to_tensor(_BILIN_W)),
+     lambda x, y: np.einsum("bi,oij,bj->bo", x, _BILIN_W, y),
+     [_sp(2, 4), _sp(2, 5, seed=1)],
+     {"bf16_atol": 5e-2, "bf16_rtol": 5e-2}),
+    ("pixel_unshuffle",
+     lambda x: F.pixel_unshuffle(x, 2),
+     lambda x: x.reshape(1, 2, 2, 2, 2, 2).transpose(
+         0, 1, 3, 5, 2, 4).reshape(1, 8, 2, 2),
+     [_sp(1, 2, 4, 4)], {}),
+    ("channel_shuffle",
+     lambda x: F.channel_shuffle(x, 2),
+     lambda x: x.reshape(1, 2, 2, 3, 3).transpose(0, 2, 1, 3, 4).reshape(
+         1, 4, 3, 3),
+     [_sp(1, 4, 3, 3)], {}),
+    ("pad_constant",
+     lambda x: F.pad(x, [1, 1], value=0.0),
+     lambda x: np.pad(x, ((0, 0), (0, 0), (1, 1))), [_sp(1, 2, 4)], {}),
+    ("zeropad2d",
+     lambda x: F.zeropad2d(x, [1, 0, 1, 0]),
+     lambda x: np.pad(x, ((0, 0), (0, 0), (1, 0), (1, 0))),
+     [_sp(1, 2, 3, 3)], {}),
+    ("temporal_shift",
+     lambda x: F.temporal_shift(x, seg_num=2, shift_ratio=0.25),
+     None, [_sp(4, 4, 3, 3)], {"ref_self": True}),
+    ("sequence_mask",
+     lambda: F.sequence_mask(pt.to_tensor(np.array([1, 3, 2])), maxlen=4),
+     lambda: (np.arange(4)[None, :] < np.array([[1], [3], [2]])),
+     [], {"grad": False, "no_inputs": True}),
+    ("interpolate_nearest",
+     lambda x: F.interpolate(x, scale_factor=2, mode="nearest"),
+     lambda x: x.repeat(2, axis=2).repeat(2, axis=3), [_sp(1, 2, 3, 3)],
+     {}),
+    ("upsample_nearest",
+     lambda x: F.upsample(x, scale_factor=2, mode="nearest"),
+     lambda x: x.repeat(2, axis=2).repeat(2, axis=3), [_sp(1, 2, 3, 3)],
+     {}),
+]
+
+_IDS = [row[0] for row in OPS]
+
+
+def _maybe_self_ref(op, ref, inputs, opts):
+    """rows with ref_self: compare eager vs jitted only (the op IS its
+    own reference; covered for behavior in dedicated suites)."""
+    if opts.get("ref_self"):
+        def ref2(*a):
+            out = op(*[Tensor(np.asarray(x)) for x in a]) if a else op()
+            out = out[0] if isinstance(out, (list, tuple)) else out
+            return np.asarray(out._data)
+        return ref2
+    return ref
+
+
+@pytest.mark.parametrize("name,op,ref,inputs,opts", OPS, ids=_IDS)
+def test_output_float32(name, op, ref, inputs, opts):
+    ref = _maybe_self_ref(op, ref, inputs, opts)
+    if opts.get("no_inputs"):
+        got = op()
+        got = got[0] if isinstance(got, (list, tuple)) else got
+        np.testing.assert_allclose(np.asarray(got._data), ref(),
+                                   atol=1e-5, rtol=1e-5)
+        return
+    check_output(op, ref, inputs,
+                 atol=opts.get("atol", 1e-5), rtol=opts.get("rtol", 1e-5))
+
+
+@pytest.mark.parametrize(
+    "name,op,ref,inputs,opts",
+    [r for r in OPS if not r[4].get("no_inputs")
+     and not r[4].get("ref_self")],
+    ids=[r[0] for r in OPS if not r[4].get("no_inputs")
+         and not r[4].get("ref_self")])
+def test_output_bfloat16(name, op, ref, inputs, opts):
+    tensors = [Tensor(jnp.asarray(a).astype(jnp.bfloat16)) for a in inputs]
+    out = op(*tensors)
+    out = out[0] if isinstance(out, (list, tuple)) else out
+    got = np.asarray(out._data.astype(jnp.float32), dtype=np.float64)
+    want = np.asarray(ref(*[np.asarray(a) for a in inputs]),
+                      dtype=np.float64)
+    np.testing.assert_allclose(
+        got, want, atol=opts.get("bf16_atol", 3e-2),
+        rtol=opts.get("bf16_rtol", 3e-2), err_msg=f"bf16 {name}")
+
+
+@pytest.mark.parametrize(
+    "name,op,ref,inputs,opts",
+    [r for r in OPS if r[4].get("grad", True)
+     and not r[4].get("no_inputs")],
+    ids=[r[0] for r in OPS if r[4].get("grad", True)
+         and not r[4].get("no_inputs")])
+def test_grad_float32(name, op, ref, inputs, opts):
+    check_grad(op, inputs, atol=opts.get("grad_atol", 5e-3),
+               rtol=opts.get("grad_atol", 5e-3))
