@@ -129,3 +129,7 @@ define_flag("flash_attn_version", 2, "Select pallas flash-attention version")
 define_flag("use_pallas_kernels", True,
             "Use hand-written Pallas TPU kernels where available "
             "(flash attention etc.); pure-XLA fallback otherwise")
+define_flag("flash_min_seq", 512,
+            "Minimum q-sequence length for SDPA to pick the Pallas flash "
+            "kernel; below it XLA's fused O(S^2) attention is faster "
+            "(measured on v5e: BERT s=128 808 vs 750 seq/s)")

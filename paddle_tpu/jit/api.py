@@ -171,7 +171,11 @@ def _closure_layer_targets(fn):
     return out
 
 
+@functools.lru_cache(maxsize=512)
 def _loaded_global_names(code):
+    """Names a code object LOADs as globals. Cached per code object —
+    bytecode is immutable, so only the *bindings* need re-resolution per
+    call, never the disassembly."""
     import dis
     names = []
     for ins in dis.get_instructions(code):
@@ -180,7 +184,7 @@ def _loaded_global_names(code):
     for const in code.co_consts:
         if hasattr(const, "co_code"):
             names.extend(_loaded_global_names(const))
-    return names
+    return tuple(names)
 
 
 class StaticFunction:

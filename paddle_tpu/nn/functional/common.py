@@ -354,8 +354,12 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     q, k_, v = maybe_autocast("matmul", q, k_, v)
 
     # canary last: it compiles a kernel, so only probe when the Pallas
-    # path is actually reachable for this call
-    use_pallas = (attn_mask is None and _flags.flag("use_pallas_kernels")
+    # path is actually reachable for this call. Short sequences stay on
+    # XLA: its fused attention wins below ~flash_min_seq (the kernel's
+    # padding + grid overhead outweighs the O(S^2) saving).
+    use_pallas = (attn_mask is None
+                  and q.shape[1] >= int(_flags.flag("flash_min_seq"))
+                  and _flags.flag("use_pallas_kernels")
                   and _on_tpu() and _flash_usable())
     eff_drop = dropout_p if training else 0.0
     if use_pallas:

@@ -98,10 +98,14 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     eff = dropout if training else 0.0
     seeds = _seed_input(eff, True)
 
-    def f(qd, kd, vd, cu, *rest):
+    def f(qd, kd, vd, cu, cuk, *rest):
         bsz = cu.shape[0] - 1
         h, d = qd.shape[1], qd.shape[2]
         lens = cu[1:] - cu[:-1]
+        # traced guard: the eager-only validation above is skipped for
+        # tracers, so poison the output with NaN (visible, not silent)
+        # if cu_q != cu_k or a sequence overflows max_seqlen at runtime
+        ok = jnp.logical_and((cu == cuk).all(), (lens <= max_q).all())
         # scatter packed rows -> (B, max_q) padded positions
         pos = jnp.arange(max_q, dtype=jnp.int32)
         idx = cu[:-1, None] + pos[None, :]                  # (B, max_q)
@@ -122,8 +126,9 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
         tok = jnp.arange(qd.shape[0], dtype=jnp.int32)
         seq_of = jnp.searchsorted(cu, tok, side="right") - 1
         off = tok - cu[seq_of]
-        return out[seq_of, off]
+        packed = out[seq_of, off]
+        return jnp.where(ok, packed, jnp.nan)
 
-    out = nary(f, [q, k, v, ensure_tensor(cu_q)] + seeds,
-               name="flash_attn_unpadded")
+    out = nary(f, [q, k, v, ensure_tensor(cu_q), ensure_tensor(cu_k)]
+               + seeds, name="flash_attn_unpadded")
     return out, None

@@ -288,4 +288,4 @@ def test_output_bfloat16(name, op, ref, inputs, opts):
     ids=[row[0] for row in OPS if row[4].get("grad", True)])
 def test_grad_float32(name, op, ref, inputs, opts):
     check_grad(op, inputs, atol=opts.get("grad_atol", 5e-3),
-               rtol=opts.get("grad_atol", 5e-3))
+               rtol=opts.get("grad_rtol", opts.get("grad_atol", 5e-3)))

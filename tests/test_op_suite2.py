@@ -426,4 +426,4 @@ def test_output_bfloat16(name, op, ref, inputs, opts):
          and not r[4].get("no_inputs")])
 def test_grad_float32(name, op, ref, inputs, opts):
     check_grad(op, inputs, atol=opts.get("grad_atol", 5e-3),
-               rtol=opts.get("grad_atol", 5e-3))
+               rtol=opts.get("grad_rtol", opts.get("grad_atol", 5e-3)))
