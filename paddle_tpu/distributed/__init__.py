@@ -11,7 +11,8 @@ from .mesh import (  # noqa: F401
 )
 from .collective import (  # noqa: F401
     ReduceOp, Group, new_group, get_group, destroy_process_group,
-    is_initialized, all_reduce, all_gather, all_gather_object, broadcast,
+    is_initialized, all_reduce, all_gather, gather, all_gather_object,
+    broadcast,
     broadcast_object_list, reduce, scatter, scatter_object_list, alltoall,
     alltoall_single, all_to_all, reduce_scatter, send, recv, isend, irecv,
     barrier, P2POp, batch_isend_irecv, wait, get_backend,
@@ -20,6 +21,8 @@ from .parallel import init_parallel_env, DataParallel  # noqa: F401
 from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
 from . import fleet  # noqa: F401
 from . import sharding  # noqa: F401
+from . import communication  # noqa: F401
+from .communication import stream  # noqa: F401
 from .fleet.meta_parallel.mp_ops import split  # noqa: F401
 from .auto_parallel_api import (  # noqa: F401
     ProcessMesh, shard_tensor, shard_layer, dtensor_from_fn, reshard,

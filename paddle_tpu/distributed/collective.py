@@ -37,7 +37,7 @@ from . import mesh as _mesh_mod
 
 __all__ = [
     "ReduceOp", "Group", "new_group", "get_group", "destroy_process_group",
-    "is_initialized", "all_reduce", "all_gather", "all_gather_object",
+    "is_initialized", "all_reduce", "all_gather", "gather", "all_gather_object",
     "broadcast", "broadcast_object_list", "reduce", "scatter",
     "scatter_object_list", "alltoall", "alltoall_single", "all_to_all",
     "reduce_scatter", "send", "recv", "isend", "irecv", "barrier",
@@ -305,6 +305,28 @@ def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True,
         out_list.extend(Tensor(out[i]) for i in range(g.nranks))
         return out_list
     return Tensor(out)
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """ref: ``communication/gather.py``: collect per-rank tensors into
+    ``gather_list`` on ``dst``. Single-controller eager mode sees every
+    rank slot, so the list is filled from the rank-major dim (the dst
+    restriction is a multi-controller artifact)."""
+    g = _group_of(group)
+    x = _data(tensor)
+    if gather_list is None:
+        gather_list = []
+    if _in_axis_scope(g.axis_name):
+        gathered = lax.all_gather(x, g.axis_name, axis=0, tiled=False)
+        gather_list.clear()
+        gather_list.extend(Tensor(gathered[i]) for i in range(g.nranks))
+        return gather_list
+    if x.shape[0] != g.nranks:
+        raise ValueError(
+            f"eager gather expects rank-major [nranks={g.nranks}, ...]")
+    gather_list.clear()
+    gather_list.extend(Tensor(x[i]) for i in range(g.nranks))
+    return gather_list
 
 
 def all_gather_object(object_list, obj, group=None):

@@ -1,0 +1,4 @@
+"""``paddle.distributed.communication`` package (ref:
+``python/paddle/distributed/communication/``): the same collective
+surface re-exported, plus the ``stream`` sub-namespace."""
+from . import stream  # noqa: F401

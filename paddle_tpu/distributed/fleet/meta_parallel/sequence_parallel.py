@@ -131,7 +131,7 @@ def ring_attention(q, k, v, axis_name="sep", causal=False, sm_scale=None,
 
 
 def ulysses_attention(q, k, v, axis_name="sep", causal=False, sm_scale=None,
-                      attn_fn=None):
+                      attn_fn=None, use_kernel=None, interpret=None):
     """DeepSpeed-Ulysses: all_to_all seq-shard ↔ head-shard, dense local
     attention on H/n heads over the full sequence, all_to_all back.
 
@@ -153,16 +153,30 @@ def ulysses_attention(q, k, v, axis_name="sep", causal=False, sm_scale=None,
 
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
     if attn_fn is None:
-        s = qh.shape[2]
         scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
-        if causal:
-            qi = lax.broadcasted_iota(jnp.int32, (s, s), 0)
-            ki = lax.broadcasted_iota(jnp.int32, (s, s), 1)
-            mask = (ki <= qi)[None, None]
+        if use_kernel is None:
+            # same gate as SDPA: below flash_min_seq XLA's fused
+            # attention is measured faster than the kernel
+            from ....framework import flags as _flags
+            full_seq = sl * n
+            use_kernel = (jax.default_backend() == "tpu"
+                          and full_seq >= int(_flags.flag("flash_min_seq")))
+        if use_kernel:
+            # dense attention over the FULL sequence with H/n heads —
+            # exactly the flash kernel's O(S) sweet spot at long context
+            from ....ops.pallas_ops import mha
+            out = mha(qh, kh, vh, causal=causal, sm_scale=scale,
+                      interpret=interpret)
         else:
-            mask = jnp.ones((1, 1, s, s), dtype=bool)
-        out, _ = _partial_attn(qh, kh, vh, scale, mask)
-        out = out.astype(q.dtype)
+            s = qh.shape[2]
+            if causal:
+                qi = lax.broadcasted_iota(jnp.int32, (s, s), 0)
+                ki = lax.broadcasted_iota(jnp.int32, (s, s), 1)
+                mask = (ki <= qi)[None, None]
+            else:
+                mask = jnp.ones((1, 1, s, s), dtype=bool)
+            out, _ = _partial_attn(qh, kh, vh, scale, mask)
+            out = out.astype(q.dtype)
     else:
         out = attn_fn(qh, kh, vh)
     return to_seq(out)

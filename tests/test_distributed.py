@@ -444,3 +444,29 @@ def test_fleet_strategy_toggles_are_applied():
     opt = fleet.distributed_optimizer(opt)
     # sharding stage 2 -> ZeRO level on the inner optimizer
     assert getattr(opt._inner_opt, "_group_sharded_level", None) == "os_g"
+
+
+def test_stream_namespace_collectives():
+    """paddle.distributed.communication.stream variants (ref
+    ``distributed/communication/stream/``): same ops, stream knobs
+    accepted — XLA's one logical stream subsumes use_calc_stream."""
+    import paddle_tpu as paddle
+    assert paddle.distributed.stream is dist.communication.stream
+    x = np.arange(N * 3, dtype=np.float32).reshape(N, 3)
+    out = dist.stream.all_reduce(Tensor(x.copy()), use_calc_stream=True)
+    np.testing.assert_allclose(
+        out.numpy(), np.tile(x.sum(0, keepdims=True), (N, 1)), rtol=1e-6)
+    out2 = dist.stream.broadcast(Tensor(x.copy()), src=1)
+    np.testing.assert_allclose(out2.numpy(), np.tile(x[1:2], (N, 1)),
+                               rtol=1e-6)
+
+
+def test_gather_eager_and_stream_guard():
+    x = np.random.RandomState(6).rand(N, 3).astype(np.float32)
+    out = []
+    dist.gather(Tensor(x.copy()), out, dst=0)
+    assert len(out) == N
+    np.testing.assert_allclose(out[2].numpy(), x[2], rtol=1e-6)
+    with pytest.raises(RuntimeError, match="use_calc_stream"):
+        dist.stream.all_reduce(Tensor(x.copy()), sync_op=False,
+                               use_calc_stream=True)

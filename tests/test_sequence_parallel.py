@@ -165,3 +165,23 @@ def test_ring_attention_kernel_path_grads():
     for a, b_ in zip(gk, gx):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    atol=3e-4, rtol=3e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_kernel_path_matches_xla(causal):
+    b, h, s, d = 1, 4, 64, 16
+    n = 4
+    q, k, v = (_rand((b, h, s, d), 60 + i) for i in range(3))
+
+    def run(use_kernel):
+        def f(q, k, v):
+            return ulysses_attention(q, k, v, axis_name="sep",
+                                     causal=causal, use_kernel=use_kernel,
+                                     interpret=True)
+        return jax.jit(jax.shard_map(
+            f, mesh=_mesh(n), in_specs=P(None, None, "sep", None),
+            out_specs=P(None, None, "sep", None), check_vma=False))(q, k, v)
+
+    np.testing.assert_allclose(np.asarray(run(True)),
+                               np.asarray(run(False)),
+                               atol=2e-4, rtol=2e-4)
