@@ -58,7 +58,7 @@ def _optional_submodules():
              "vision", "metric", "hapi", "profiler", "static", "incubate",
              "sparse", "distribution", "text", "audio", "quantization",
              "utils", "fft", "signal", "models", "callbacks", "regularizer",
-             "inference", "geometric", "hub", "cost_model",
+             "inference", "geometric", "hub", "cost_model", "reader",
              "onnx"]
     loaded = {}
     for n in names:

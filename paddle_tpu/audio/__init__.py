@@ -1,8 +1,10 @@
 """``paddle.audio`` (ref: ``python/paddle/audio/``): feature layers +
-functional DSP. Backends (file IO) are out of scope of the compute
-framework — load waveforms with any IO library and pass arrays."""
+functional DSP + wav IO backends (stdlib ``wave``-based PCM16, like the
+reference's default wave_backend)."""
 from . import functional as _func_mod
 from . import features  # noqa: F401
+from . import backends  # noqa: F401
+from .backends import info, load, save  # noqa: F401
 from .window import get_window  # noqa: F401
 
 

@@ -1,0 +1,106 @@
+"""``paddle.audio.backends`` (ref:
+``python/paddle/audio/backends/wave_backend.py``): wav info/load/save
+over the stdlib ``wave`` module — no native soundfile dependency, same
+PCM16 semantics as the reference's default backend."""
+from __future__ import annotations
+
+import wave
+from collections import namedtuple
+
+import numpy as np
+
+from ..tensor import Tensor
+
+__all__ = ["AudioInfo", "info", "load", "save", "list_available_backends",
+           "get_current_backend", "set_backend"]
+
+AudioInfo = namedtuple(
+    "AudioInfo", ["sample_rate", "num_frames", "num_channels",
+                  "bits_per_sample", "encoding"])
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    return "wave_backend"
+
+
+def set_backend(backend_name):
+    if backend_name != "wave_backend":
+        raise NotImplementedError(
+            f"only the stdlib 'wave_backend' ships in-tree, got "
+            f"{backend_name!r} (the reference's soundfile backend is an "
+            f"optional external dependency there too)")
+
+
+def info(filepath) -> AudioInfo:
+    """Signal information of a wav file (or file object). Caller-provided
+    file objects are left open (only handles opened here are closed)."""
+    own = not hasattr(filepath, "read")
+    file_obj = open(filepath, "rb") if own else filepath
+    try:
+        try:
+            f = wave.open(file_obj)
+        except (wave.Error, EOFError):
+            raise NotImplementedError(
+                "only PCM wav is supported by the in-tree wave backend")
+        return AudioInfo(f.getframerate(), f.getnframes(),
+                         f.getnchannels(), f.getsampwidth() * 8, "PCM_S")
+    finally:
+        if own:
+            file_obj.close()
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """Returns (Tensor waveform, sample_rate). float32 in [-1, 1] when
+    ``normalize`` else raw int16; (C, T) when ``channels_first``."""
+    own = not hasattr(filepath, "read")
+    file_obj = open(filepath, "rb") if own else filepath
+    try:
+        try:
+            f = wave.open(file_obj)
+        except (wave.Error, EOFError):
+            raise NotImplementedError(
+                "only PCM wav is supported by the in-tree wave backend")
+        sr = f.getframerate()
+        channels = f.getnchannels()
+        if f.getsampwidth() != 2:
+            raise NotImplementedError("only 16-bit PCM wav is supported")
+        f.setpos(min(frame_offset, f.getnframes()))
+        n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(max(n, 0))
+    finally:
+        if own:
+            file_obj.close()
+    data = np.frombuffer(raw, dtype="<i2").reshape(-1, channels)
+    if normalize:
+        data = (data.astype(np.float32) / 32768.0)
+    arr = data.T if channels_first else data
+    return Tensor(np.ascontiguousarray(arr)), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         encoding="PCM_S", bits_per_sample=16):
+    """Write a (C, T) (or (T, C)) waveform Tensor/array as 16-bit PCM."""
+    if bits_per_sample != 16 or encoding != "PCM_S":
+        raise NotImplementedError(
+            "the in-tree wave backend writes 16-bit PCM_S only")
+    a = np.asarray(src._data if isinstance(src, Tensor) else src)
+    if a.ndim == 1:
+        a = a[:, None]                   # mono -> (T, 1) either layout
+    elif channels_first:
+        a = a.T                          # (C, T) -> (T, C)
+    if a.dtype.kind == "f":
+        a = np.clip(a, -1.0, 1.0)
+        a = (a * 32767.0).astype("<i2")
+    else:
+        a = a.astype("<i2")
+    target = filepath if hasattr(filepath, "write") else str(filepath)
+    with wave.open(target, "wb") as f:
+        f.setnchannels(a.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(np.ascontiguousarray(a).tobytes())

@@ -1,0 +1,197 @@
+"""``paddle.reader`` decorators (ref:
+``python/paddle/reader/decorator.py``): composable generator
+transformers from the legacy IO stack. Retained for parity — the modern
+path is ``paddle_tpu.io.DataLoader``. ``xmap_readers`` uses threads
+(the host-side map is IO-bound; process fan-out belongs to DataLoader's
+worker pool)."""
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import random as _random
+import threading
+
+__all__ = ["cache", "map_readers", "shuffle", "chain", "compose",
+           "buffered", "firstn", "xmap_readers"]
+
+
+class _Raise:
+    """Exception carrier: worker threads forward errors to the consumer
+    instead of dying silently (which would either hang the consumer on a
+    missing sentinel or silently truncate the stream)."""
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
+def cache(reader):
+    """Materialize once, replay from memory on every epoch."""
+    all_data = tuple(reader())
+
+    def cache_reader():
+        yield from all_data
+
+    return cache_reader
+
+
+def map_readers(func, *readers):
+    """Element-wise func over zipped readers."""
+
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    """Buffered shuffle (reservoir of ``buf_size``)."""
+
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return data_reader
+
+
+def chain(*readers):
+    """Concatenate readers back-to-back."""
+
+    def reader():
+        for r in readers:
+            yield from r()
+
+    return reader
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into flattened tuples; ``check_alignment`` (default
+    True) raises if they run out at different lengths."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum((make_tuple(o) for o in outputs), ())
+        else:
+            for outputs in itertools.zip_longest(*rs):
+                if any(o is None for o in outputs):
+                    raise ValueError(
+                        "outputs of readers are not aligned")
+                yield sum((make_tuple(o) for o in outputs), ())
+
+    return reader
+
+
+def buffered(reader, size):
+    """Producer-thread prefetch buffer of up to ``size`` items."""
+    _end = object()
+
+    def data_reader():
+        q = _queue.Queue(maxsize=size)
+
+        def produce():
+            try:
+                for d in reader():
+                    q.put(d)
+            except BaseException as e:  # forwarded, not swallowed
+                q.put(_Raise(e))
+            finally:
+                q.put(_end)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is _end:
+                break
+            if isinstance(e, _Raise):
+                raise e.exc
+            yield e
+
+    return data_reader
+
+
+def firstn(reader, n):
+    """First n elements."""
+
+    def firstn_reader():
+        for i, item in enumerate(reader()):
+            if i >= n:
+                break
+            yield item
+
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map with ``process_num`` worker threads and a
+    ``buffer_size`` queue; ``order=True`` preserves input order. Errors
+    in the source reader or the mapper propagate to the consumer."""
+    _end = object()
+
+    def data_reader():
+        in_q = _queue.Queue(buffer_size)
+        out_q = _queue.Queue(buffer_size)
+
+        def feed():
+            try:
+                for i, d in enumerate(reader()):
+                    in_q.put((i, d))
+            except BaseException as e:
+                out_q.put(_Raise(e))
+            finally:
+                for _ in range(process_num):
+                    in_q.put(_end)
+
+        def work():
+            try:
+                while True:
+                    item = in_q.get()
+                    if item is _end:
+                        return
+                    i, d = item
+                    out_q.put((i, mapper(d)))
+            except BaseException as e:
+                out_q.put(_Raise(e))
+            finally:
+                out_q.put(_end)
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        # ordered mode: only this consumer thread touches `results`
+        results = {}
+        finished = 0
+        next_idx = 0
+        while finished < process_num:
+            item = out_q.get()
+            if item is _end:
+                finished += 1
+                continue
+            if isinstance(item, _Raise):
+                raise item.exc
+            i, d = item
+            if not order:
+                yield d
+                continue
+            results[i] = d
+            while next_idx in results:
+                yield results.pop(next_idx)
+                next_idx += 1
+
+    return data_reader

@@ -1,0 +1,145 @@
+"""paddle.reader decorators + paddle.audio wav IO backends (ref:
+``python/paddle/reader/decorator.py``,
+``python/paddle/audio/backends/wave_backend.py``)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _r(n=6):
+    def reader():
+        yield from range(n)
+    return reader
+
+
+class TestReaderDecorators:
+    def test_cache_replays(self):
+        calls = []
+
+        def reader():
+            calls.append(1)
+            yield from range(3)
+
+        c = paddle.reader.cache(reader)
+        assert list(c()) == [0, 1, 2]
+        assert list(c()) == [0, 1, 2]
+        assert len(calls) == 1  # source consumed exactly once
+
+    def test_map_readers(self):
+        out = list(paddle.reader.map_readers(
+            lambda a, b: a + b, _r(), _r())())
+        assert out == [0, 2, 4, 6, 8, 10]
+
+    def test_shuffle_is_permutation(self):
+        np.random.seed(0)
+        out = list(paddle.reader.shuffle(_r(20), buf_size=8)())
+        assert sorted(out) == list(range(20))
+
+    def test_chain_and_firstn(self):
+        out = list(paddle.reader.chain(_r(2), _r(3))())
+        assert out == [0, 1, 0, 1, 2]
+        assert list(paddle.reader.firstn(_r(10), 4)()) == [0, 1, 2, 3]
+
+    def test_compose_alignment(self):
+        out = list(paddle.reader.compose(_r(3), _r(3))())
+        assert out == [(0, 0), (1, 1), (2, 2)]
+        with pytest.raises(ValueError):
+            list(paddle.reader.compose(_r(2), _r(3))())
+
+    def test_buffered(self):
+        assert list(paddle.reader.buffered(_r(5), size=2)()) == list(range(5))
+
+    @pytest.mark.parametrize("order", [False, True])
+    def test_xmap_readers(self, order):
+        out = list(paddle.reader.xmap_readers(
+            lambda x: x * 10, _r(12), process_num=3, buffer_size=4,
+            order=order)())
+        if order:
+            assert out == [i * 10 for i in range(12)]
+        else:
+            assert sorted(out) == [i * 10 for i in range(12)]
+
+
+class TestAudioIO:
+    def test_save_info_load_roundtrip(self, tmp_path):
+        sr = 16000
+        t = np.linspace(0, 1, sr // 10, dtype=np.float32)
+        wav = np.stack([np.sin(2 * np.pi * 440 * t) * 0.5,
+                        np.cos(2 * np.pi * 220 * t) * 0.25])
+        p = str(tmp_path / "t.wav")
+        paddle.audio.save(p, paddle.to_tensor(wav), sr)
+
+        got_info = paddle.audio.info(p)
+        assert got_info.sample_rate == sr
+        assert got_info.num_channels == 2
+        assert got_info.bits_per_sample == 16
+        assert got_info.num_frames == wav.shape[1]
+
+        back, sr2 = paddle.audio.load(p)
+        assert sr2 == sr and tuple(back.shape) == wav.shape
+        np.testing.assert_allclose(back.numpy(), wav, atol=1e-3)
+
+    def test_load_raw_and_offsets(self, tmp_path):
+        sr = 8000
+        wav = (np.arange(100, dtype=np.float32) / 200.0)[None]
+        p = str(tmp_path / "o.wav")
+        paddle.audio.save(p, wav, sr)
+        raw, _ = paddle.audio.load(p, normalize=False)
+        assert raw.numpy().dtype == np.int16
+        seg, _ = paddle.audio.load(p, frame_offset=10, num_frames=20)
+        assert tuple(seg.shape) == (1, 20)
+        np.testing.assert_allclose(seg.numpy(), wav[:, 10:30], atol=1e-3)
+
+    def test_backend_registry(self):
+        assert paddle.audio.backends.list_available_backends() == \
+            ["wave_backend"]
+        with pytest.raises(NotImplementedError):
+            paddle.audio.backends.set_backend("soundfile")
+
+
+class TestErrorPropagation:
+    def test_xmap_mapper_error_propagates(self):
+        def src():
+            yield from [1, 0, 2]
+
+        with pytest.raises(ZeroDivisionError):
+            list(paddle.reader.xmap_readers(
+                lambda x: 1 / x, src, process_num=2, buffer_size=4)())
+
+    def test_xmap_reader_error_propagates(self):
+        def src():
+            yield 1
+            raise IOError("source died")
+
+        with pytest.raises(IOError):
+            list(paddle.reader.xmap_readers(
+                lambda x: x, src, process_num=2, buffer_size=4)())
+
+    def test_buffered_error_propagates(self):
+        def src():
+            yield 1
+            raise IOError("truncated")
+
+        with pytest.raises(IOError):
+            list(paddle.reader.buffered(src, size=2)())
+
+    def test_audio_file_object_handling(self, tmp_path):
+        sr = 8000
+        wav = np.zeros(80, np.float32)
+        p = tmp_path / "f.wav"
+        with open(p, "wb") as f:
+            paddle.audio.save(f, wav, sr)
+        with open(p, "rb") as f:
+            got = paddle.audio.info(f)
+            assert got.num_frames == 80
+            f.seek(0)
+            back, _ = paddle.audio.load(f)  # handle still open
+            assert tuple(back.shape) == (1, 80)
+
+    def test_audio_mono_channels_last_save(self, tmp_path):
+        p = str(tmp_path / "m.wav")
+        paddle.audio.save(p, np.zeros(100, np.float32), 8000,
+                          channels_first=False)
+        assert paddle.audio.info(p).num_channels == 1
+        assert paddle.audio.info(p).num_frames == 100
