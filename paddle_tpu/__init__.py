@@ -59,6 +59,7 @@ def _optional_submodules():
              "sparse", "distribution", "text", "audio", "quantization",
              "utils", "fft", "signal", "models", "callbacks", "regularizer",
              "inference", "geometric", "hub", "cost_model", "reader",
+             "version", "sysconfig",
              "onnx"]
     loaded = {}
     for n in names:

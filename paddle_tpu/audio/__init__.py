@@ -16,4 +16,5 @@ class functional:  # namespace mirroring paddle.audio.functional
     from .window import get_window  # noqa: F401
 
 
-__all__ = ["functional", "features", "get_window"]
+__all__ = ["functional", "features", "get_window", "backends", "info",
+           "load", "save"]

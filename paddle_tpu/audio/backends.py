@@ -46,8 +46,11 @@ def info(filepath) -> AudioInfo:
         except (wave.Error, EOFError):
             raise NotImplementedError(
                 "only PCM wav is supported by the in-tree wave backend")
+        width = f.getsampwidth()
+        # 8-bit wav is unsigned by spec; wider PCM is signed
         return AudioInfo(f.getframerate(), f.getnframes(),
-                         f.getnchannels(), f.getsampwidth() * 8, "PCM_S")
+                         f.getnchannels(), width * 8,
+                         "PCM_U" if width == 1 else "PCM_S")
     finally:
         if own:
             file_obj.close()

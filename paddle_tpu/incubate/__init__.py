@@ -5,5 +5,6 @@ from . import models  # noqa: F401
 from . import autograd  # noqa: F401
 from . import autotune  # noqa: F401
 from . import asp  # noqa: F401
+from . import multiprocessing  # noqa: F401
 from .optimizer import (  # noqa: F401
     LookAhead, ModelAverage, DistributedFusedLamb)

@@ -1,0 +1,23 @@
+"""``paddle.incubate.multiprocessing`` (ref:
+``python/paddle/incubate/multiprocessing/``): the stdlib
+multiprocessing surface plus Tensor pickling-over-shared-memory.
+
+The reference registers ForkingPickler reductions that move tensor
+storage into file-system shared memory. Here the same hook serializes a
+Tensor's array into a named POSIX shm segment via the native core
+(``core/native/shm.cc``, the DataLoader's transport) and rebuilds a
+device array on the consumer side; falls back to plain bytes when shm
+is unavailable.
+"""
+from multiprocessing import *  # noqa: F401,F403
+import multiprocessing as _mp
+
+from .reductions import init_reductions
+
+__all__ = []
+
+init_reductions()
+
+
+def get_context(method=None):
+    return _mp.get_context(method)

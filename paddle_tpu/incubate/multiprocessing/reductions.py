@@ -1,0 +1,89 @@
+"""Tensor reductions for multiprocessing (ref:
+``python/paddle/incubate/multiprocessing/reductions.py``).
+
+Lifetime model (file_system-strategy semantics): the PRODUCER owns each
+shm segment and unlinks all of its segments at interpreter exit;
+consumers attach, copy, and close. This makes pickles re-loadable (a
+segment survives multiple loads) and bounds leaks to the producer's
+lifetime even when a queued pickle is never delivered — the failure
+mode the reference's torch-style tracker exists for.
+"""
+from __future__ import annotations
+
+import atexit
+import os
+from multiprocessing.reduction import ForkingPickler
+
+import numpy as np
+
+from ...tensor import Parameter, Tensor
+
+_COUNTER = [0]
+_OWNED: list[str] = []
+_MIN_SHM_BYTES = 1 << 16  # small tensors ride plain bytes
+
+
+@atexit.register
+def _cleanup_owned():
+    try:
+        from ...core import shm_unlink
+    except Exception:
+        return
+    for name in _OWNED:
+        try:
+            shm_unlink(name)
+        except Exception:
+            pass
+    _OWNED.clear()
+
+
+def _restore(t, is_param, stop_gradient, name):
+    if is_param:
+        p = Parameter(t._data, trainable=not stop_gradient, name=name)
+        return p
+    t.stop_gradient = stop_gradient
+    t.name = name
+    return t
+
+
+def _rebuild_from_shm(shm_name, shape, dtype_str, nbytes, is_param,
+                      stop_gradient, name):
+    from ...core import ShmSegment
+    seg = ShmSegment.attach(shm_name, nbytes)
+    arr = np.frombuffer(seg.buffer(), dtype=np.dtype(dtype_str),
+                        count=int(np.prod(shape)) if shape else 1)
+    out = Tensor(arr.reshape(shape).copy())
+    seg.close()  # producer unlinks at its exit; pickle stays loadable
+    return _restore(out, is_param, stop_gradient, name)
+
+
+def _rebuild_from_bytes(buf, shape, dtype_str, is_param, stop_gradient,
+                        name):
+    arr = np.frombuffer(buf, dtype=np.dtype(dtype_str)).reshape(shape)
+    return _restore(Tensor(arr.copy()), is_param, stop_gradient, name)
+
+
+def _reduce_tensor(t: Tensor):
+    a = np.asarray(t._data)
+    meta = (isinstance(t, Parameter), bool(t.stop_gradient), t.name)
+    try:
+        from ...core import ShmSegment, shm_available
+        if shm_available() and a.nbytes >= _MIN_SHM_BYTES \
+                and not a.dtype.hasobject:
+            _COUNTER[0] += 1
+            shm_name = f"/ptmp_{os.getpid()}_{_COUNTER[0]}"
+            seg = ShmSegment.create(shm_name, a.nbytes)
+            dst = np.frombuffer(seg.buffer(), dtype=a.dtype, count=a.size)
+            np.copyto(dst.reshape(a.shape), a)
+            seg.close()
+            _OWNED.append(shm_name)
+            return (_rebuild_from_shm,
+                    (shm_name, a.shape, a.dtype.str, a.nbytes) + meta)
+    except Exception:
+        pass
+    return (_rebuild_from_bytes, (a.tobytes(), a.shape, a.dtype.str) + meta)
+
+
+def init_reductions():
+    ForkingPickler.register(Tensor, _reduce_tensor)
+    ForkingPickler.register(Parameter, _reduce_tensor)

@@ -1,0 +1,18 @@
+"""``paddle.sysconfig`` (ref: ``python/paddle/sysconfig.py``)."""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include():
+    """Directory containing the framework's C++ headers (the native host
+    core's ``common.h`` — the TPU compute path needs no C++ headers)."""
+    return os.path.join(os.path.dirname(__file__), "core", "native")
+
+
+def get_lib():
+    """Directory containing the compiled native core library (built on
+    demand; see ``core/build.py``)."""
+    from .core.build import build_ptcore, _cache_dir
+    build_ptcore()
+    return _cache_dir()

@@ -1,0 +1,44 @@
+"""``paddle.version`` (ref: generated ``python/paddle/version/__init__.py``)."""
+# single source of truth: the package __version__ (bound before this
+# optional submodule imports)
+from paddle_tpu import __version__ as full_version
+
+major, minor, patch = full_version.split(".")
+rc = "0"
+istaged = True
+commit = "unknown"
+with_mkl = "OFF"
+cuda_version = "False"
+cudnn_version = "False"
+xpu_version = "False"
+
+__all__ = ["full_version", "major", "minor", "patch", "rc", "show",
+           "cuda", "cudnn", "xpu"]
+
+
+def show():
+    """Print the installed version breakdown (ref ``version.show()``)."""
+    if istaged:
+        print("full_version:", full_version)
+        print("major:", major)
+        print("minor:", minor)
+        print("patch:", patch)
+        print("rc:", rc)
+    else:
+        print("commit:", commit)
+    print("cuda:", cuda_version)
+    print("cudnn:", cudnn_version)
+    print("xpu:", xpu_version)
+
+
+def cuda():
+    """No CUDA on this stack (TPU/XLA); parity returns 'False'."""
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version
+
+
+def xpu():
+    return xpu_version

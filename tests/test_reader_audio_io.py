@@ -143,3 +143,46 @@ class TestErrorPropagation:
                           channels_first=False)
         assert paddle.audio.info(p).num_channels == 1
         assert paddle.audio.info(p).num_frames == 100
+
+
+class TestIncubateMultiprocessing:
+    def test_tensor_pickles_through_forking_pickler(self):
+        """The registered reduction must round-trip a Tensor through
+        ForkingPickler bytes (shm or raw fallback), same process."""
+        import io as _io
+        from multiprocessing.reduction import ForkingPickler
+        import pickle
+        import paddle_tpu.incubate.multiprocessing  # registers reductions
+
+        t = paddle.to_tensor(np.arange(256 * 256, dtype=np.float32)
+                             .reshape(256, 256))  # >=64K: shm path when available
+        buf = _io.BytesIO()
+        ForkingPickler(buf, pickle.HIGHEST_PROTOCOL).dump(t)
+        back = pickle.loads(buf.getvalue())
+        np.testing.assert_array_equal(back.numpy(), t.numpy())
+        # pickles must be re-loadable (segment survives multiple loads)
+        back2 = pickle.loads(buf.getvalue())
+        np.testing.assert_array_equal(back2.numpy(), t.numpy())
+
+    def test_parameter_roundtrip_preserves_subclass(self):
+        import io as _io
+        from multiprocessing.reduction import ForkingPickler
+        import pickle
+        from paddle_tpu.tensor import Parameter
+        import paddle_tpu.incubate.multiprocessing  # registers reductions
+
+        p = Parameter(np.ones((64, 64), np.float32), name="w0")
+        buf = _io.BytesIO()
+        ForkingPickler(buf, pickle.HIGHEST_PROTOCOL).dump(p)
+        back = pickle.loads(buf.getvalue())
+        assert isinstance(back, Parameter)
+        assert back.name == "w0" and not back.stop_gradient
+        np.testing.assert_array_equal(back.numpy(), p.numpy())
+
+    def test_version_and_sysconfig(self):
+        import os
+        assert paddle.version.full_version == paddle.__version__
+        paddle.version.show()
+        assert paddle.version.cuda() == "False"
+        inc = paddle.sysconfig.get_include()
+        assert os.path.exists(os.path.join(inc, "common.h"))
