@@ -10,14 +10,11 @@ device array on the consumer side; falls back to plain bytes when shm
 is unavailable.
 """
 from multiprocessing import *  # noqa: F401,F403
-import multiprocessing as _mp
 
-from .reductions import init_reductions
+from .reductions import (  # noqa: F401
+    init_reductions, set_sharing_strategy, get_sharing_strategy,
+)
 
 __all__ = []
 
 init_reductions()
-
-
-def get_context(method=None):
-    return _mp.get_context(method)

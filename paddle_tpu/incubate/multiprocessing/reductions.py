@@ -21,6 +21,21 @@ from ...tensor import Parameter, Tensor
 _COUNTER = [0]
 _OWNED: list[str] = []
 _MIN_SHM_BYTES = 1 << 16  # small tensors ride plain bytes
+_STRATEGY = ["bytes"]  # "bytes" (default) | "file_system"
+
+
+def set_sharing_strategy(strategy):
+    """"file_system" ships tensor payloads through named POSIX shm
+    (zero pickle-copy, producer-lifetime segments); the default "bytes"
+    embeds them in the pickle (normal lifetime, no /dev/shm growth for
+    long-running queue producers)."""
+    if strategy not in ("bytes", "file_system"):
+        raise ValueError(f"unknown sharing strategy {strategy!r}")
+    _STRATEGY[0] = strategy
+
+
+def get_sharing_strategy():
+    return _STRATEGY[0]
 
 
 @atexit.register
@@ -68,15 +83,17 @@ def _reduce_tensor(t: Tensor):
     meta = (isinstance(t, Parameter), bool(t.stop_gradient), t.name)
     try:
         from ...core import ShmSegment, shm_available
-        if shm_available() and a.nbytes >= _MIN_SHM_BYTES \
-                and not a.dtype.hasobject:
+        if _STRATEGY[0] == "file_system" and shm_available() \
+                and a.nbytes >= _MIN_SHM_BYTES and not a.dtype.hasobject:
             _COUNTER[0] += 1
             shm_name = f"/ptmp_{os.getpid()}_{_COUNTER[0]}"
             seg = ShmSegment.create(shm_name, a.nbytes)
+            # record ownership IMMEDIATELY: a copy failure below must
+            # still be unlinked at exit, not orphaned forever
+            _OWNED.append(shm_name)
             dst = np.frombuffer(seg.buffer(), dtype=a.dtype, count=a.size)
             np.copyto(dst.reshape(a.shape), a)
             seg.close()
-            _OWNED.append(shm_name)
             return (_rebuild_from_shm,
                     (shm_name, a.shape, a.dtype.str, a.nbytes) + meta)
     except Exception:

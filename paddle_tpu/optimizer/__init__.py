@@ -2,4 +2,5 @@
 from .optimizer import (Optimizer, SGD, Momentum, Adagrad, Adadelta,  # noqa: F401
                         RMSProp)
 from .adam import Adam, AdamW, Adamax, Lamb, NAdam, RAdam  # noqa: F401
+from .lbfgs import LBFGS  # noqa: F401
 from . import lr  # noqa: F401

@@ -1,0 +1,231 @@
+"""LBFGS optimizer (ref: ``python/paddle/optimizer/lbfgs.py``).
+
+Limited-memory BFGS with optional strong-Wolfe line search, the
+closure-style ``step(closure)`` API of the reference. The quasi-Newton
+math runs on ONE flattened f32 vector on device (jnp) — history
+dot-products and the two-loop recursion are a handful of fused
+elementwise/reduction XLA ops, not per-parameter Python loops.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+__all__ = ["LBFGS"]
+
+
+def _flat(arrays):
+    return jnp.concatenate([jnp.ravel(a).astype(jnp.float32)
+                            for a in arrays])
+
+
+class LBFGS(Optimizer):
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        if weight_decay is not None or grad_clip is not None:
+            # decay/clip would make the line search's f and g inconsistent
+            # (closure computes f without them); refuse loudly rather than
+            # silently training unregularized
+            raise NotImplementedError(
+                "LBFGS does not support weight_decay/grad_clip: fold the "
+                "penalty into the closure's loss instead")
+        super().__init__(learning_rate=learning_rate, parameters=parameters,
+                         weight_decay=weight_decay, grad_clip=grad_clip,
+                         name=name, multi_precision=False)
+        if max_eval is None:
+            max_eval = max_iter * 5 // 4
+        if line_search_fn not in (None, "strong_wolfe"):
+            raise ValueError(
+                f"only 'strong_wolfe' line search is supported, got "
+                f"{line_search_fn!r}")
+        self.max_iter = max_iter
+        self.max_eval = max_eval
+        self.tolerance_grad = tolerance_grad
+        self.tolerance_change = tolerance_change
+        self.history_size = history_size
+        self.line_search_fn = line_search_fn
+        self._hist_s: list = []
+        self._hist_y: list = []
+        self._rho: list = []
+        self._prev_flat_grad = None
+        self._n_evals = 0
+
+    # -- flat <-> param views ----------------------------------------------
+    def _params(self):
+        return [p for p in self._parameter_list if not p.stop_gradient]
+
+    def _gather(self, attr):
+        ps = self._params()
+        if attr == "data":
+            return _flat([p._data for p in ps])
+        return _flat([(p.grad._data if p.grad is not None
+                       else jnp.zeros_like(p._data)) for p in ps])
+
+    def _scatter(self, flat):
+        off = 0
+        for p in self._params():
+            n = int(np.prod(p.shape)) if p.shape else 1
+            p._data = jnp.reshape(flat[off:off + n],
+                                  p._data.shape).astype(p._data.dtype)
+            off += n
+
+    def _closure_eval(self, closure, x=None):
+        if x is not None:
+            self._scatter(x)
+        self.clear_grad()
+        loss = closure()
+        self._n_evals += 1
+        return float(loss.item()), self._gather("grad")
+
+    # -- two-loop recursion --------------------------------------------------
+    def _direction(self, g):
+        q = -g
+        if not self._hist_s:
+            return q
+        alphas = []
+        for s, y, rho in zip(reversed(self._hist_s),
+                             reversed(self._hist_y),
+                             reversed(self._rho)):
+            a = rho * jnp.vdot(s, q)
+            alphas.append(a)
+            q = q - a * y
+        s_last, y_last = self._hist_s[-1], self._hist_y[-1]
+        gamma = jnp.vdot(s_last, y_last) / jnp.maximum(
+            jnp.vdot(y_last, y_last), 1e-20)
+        q = q * gamma
+        for (s, y, rho), a in zip(zip(self._hist_s, self._hist_y,
+                                      self._rho), reversed(alphas)):
+            b = rho * jnp.vdot(y, q)
+            q = q + s * (a - b)
+        return q
+
+    def _push_history(self, s, y):
+        ys = float(jnp.vdot(y, s))
+        if ys > 1e-10:
+            self._hist_s.append(s)
+            self._hist_y.append(y)
+            self._rho.append(1.0 / ys)
+            if len(self._hist_s) > self.history_size:
+                self._hist_s.pop(0)
+                self._hist_y.pop(0)
+                self._rho.pop(0)
+
+    # -- strong-Wolfe line search (cubic interpolation, torch/paddle algo) --
+    def _strong_wolfe(self, closure, x0, d, f0, g0, t, c1=1e-4, c2=0.9,
+                      max_ls=25):
+        dg0 = float(jnp.vdot(g0, d))
+        if dg0 >= 0:  # not a descent direction; bail with no move
+            return f0, g0, 0.0
+
+        def phi(t_):
+            f, g = self._closure_eval(closure, x0 + t_ * d)
+            return f, g, float(jnp.vdot(g, d))
+
+        # bracket phase
+        t_prev, f_prev, dg_prev = 0.0, f0, dg0
+        g_prev = g0
+        bracket = None
+        for _ in range(max_ls):
+            f_new, g_new, dg_new = phi(t)
+            if f_new > f0 + c1 * t * dg0 or f_new >= f_prev:
+                bracket = (t_prev, t, f_prev, f_new, g_prev, g_new,
+                           dg_prev, dg_new)
+                break
+            if abs(dg_new) <= -c2 * dg0:
+                return f_new, g_new, t
+            if dg_new >= 0:
+                bracket = (t, t_prev, f_new, f_prev, g_new, g_prev,
+                           dg_new, dg_prev)
+                break
+            t_prev, f_prev, g_prev, dg_prev = t, f_new, g_new, dg_new
+            t = t * 2.0
+        else:
+            # exhausted: return the LAST EVALUATED point (t was doubled
+            # after phi ran; returning the doubled t would pair a step
+            # with a loss/grad measured elsewhere)
+            return f_new, g_new, t_prev
+
+        # zoom phase
+        lo, hi, f_lo, f_hi, g_lo, g_hi, dg_lo, dg_hi = bracket
+        for _ in range(max_ls):
+            if abs(hi - lo) * abs(dg0) < self.tolerance_change:
+                break
+            t = 0.5 * (lo + hi)  # bisection (cubic adds little here)
+            f_new, g_new, dg_new = phi(t)
+            if f_new > f0 + c1 * t * dg0 or f_new >= f_lo:
+                hi, f_hi, g_hi, dg_hi = t, f_new, g_new, dg_new
+            else:
+                if abs(dg_new) <= -c2 * dg0:
+                    return f_new, g_new, t
+                if dg_new * (hi - lo) >= 0:
+                    hi, f_hi, g_hi, dg_hi = lo, f_lo, g_lo, dg_lo
+                lo, f_lo, g_lo, dg_lo = t, f_new, g_new, dg_new
+        return f_lo, g_lo, lo
+
+    # -- the closure-driven step --------------------------------------------
+    def step(self, closure=None):
+        """One LBFGS optimization pass (up to ``max_iter`` inner
+        iterations). ``closure`` re-evaluates the loss and its gradients
+        (call ``loss.backward()`` inside, like the reference)."""
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure")
+        self._n_evals = 0
+        lr = self.get_lr()
+
+        loss, flat_grad = self._closure_eval(closure)
+        if float(jnp.abs(flat_grad).max()) <= self.tolerance_grad:
+            return loss
+
+        x = self._gather("data")
+        for _ in range(self.max_iter):
+            d = self._direction(flat_grad)
+            if self._prev_flat_grad is None:
+                t = min(1.0, 1.0 / max(float(jnp.abs(flat_grad).sum()),
+                                       1e-10)) * lr
+            else:
+                t = lr
+            self._prev_flat_grad = flat_grad
+
+            if self.line_search_fn == "strong_wolfe":
+                f_new, g_new, t = self._strong_wolfe(
+                    closure, x, d, loss, flat_grad, t)
+                x_new = x + t * d
+                self._scatter(x_new)
+            else:
+                x_new = x + t * d
+                f_new, g_new = self._closure_eval(closure, x_new)
+
+            self._push_history(x_new - x, g_new - flat_grad)
+            delta_x = float(jnp.abs(x_new - x).max()) if t != 0 else 0.0
+            delta_f = abs(f_new - loss)
+            x, loss, flat_grad = x_new, f_new, g_new
+
+            if float(jnp.abs(flat_grad).max()) <= self.tolerance_grad:
+                break
+            if t == 0.0 or delta_x <= self.tolerance_change \
+                    or delta_f <= self.tolerance_change:
+                break
+            if self._n_evals >= self.max_eval:
+                break
+        self._scatter(x)
+        return loss
+
+    def clear_grad(self):
+        for p in self._parameter_list:
+            p.clear_grad()
+
+    def state_dict(self):
+        return {
+            "hist_s": [np.asarray(s) for s in self._hist_s],
+            "hist_y": [np.asarray(y) for y in self._hist_y],
+            "rho": list(self._rho),
+        }
+
+    def set_state_dict(self, state):
+        self._hist_s = [jnp.asarray(s) for s in state.get("hist_s", [])]
+        self._hist_y = [jnp.asarray(y) for y in state.get("hist_y", [])]
+        self._rho = list(state.get("rho", []))

@@ -3,7 +3,8 @@
 # optional submodule imports)
 from paddle_tpu import __version__ as full_version
 
-major, minor, patch = full_version.split(".")
+_parts = (full_version.split(".") + ["0", "0"])[:3]
+major, minor, patch = _parts
 rc = "0"
 istaged = True
 commit = "unknown"

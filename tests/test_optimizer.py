@@ -193,3 +193,64 @@ class TestLRSchedulers:
         for _ in range(5):
             poly.step()
         assert abs(poly() - 0.5) < 0.11
+
+
+class TestLBFGS:
+    """ref: python/paddle/optimizer/lbfgs.py (closure-style step)."""
+
+    def test_quadratic_converges_fast(self):
+        pt.seed(0)
+        # min ||Ax - b||^2 — LBFGS should crush this in a few steps
+        rs = np.random.RandomState(0)
+        A = pt.to_tensor(rs.randn(12, 6).astype(np.float32))
+        b = pt.to_tensor(rs.randn(12).astype(np.float32))
+        x = pt.to_tensor(np.zeros(6, np.float32), stop_gradient=False)
+        opt = pt.optimizer.LBFGS(parameters=[x], max_iter=20,
+                                 line_search_fn="strong_wolfe")
+
+        def closure():
+            loss = ((pt.matmul(A, x) - b) ** 2).sum()
+            loss.backward()
+            return loss
+
+        final = opt.step(closure)
+        x_star = np.linalg.lstsq(np.asarray(A.numpy(), np.float64),
+                                 np.asarray(b.numpy(), np.float64),
+                                 rcond=None)[0]
+        np.testing.assert_allclose(x.numpy(), x_star, atol=1e-3, rtol=1e-3)
+
+    def test_rosenbrock_descends(self):
+        xy = pt.to_tensor(np.array([-1.2, 1.0], np.float32),
+                          stop_gradient=False)
+        opt = pt.optimizer.LBFGS(parameters=[xy], max_iter=30,
+                                 line_search_fn="strong_wolfe")
+
+        def closure():
+            x, y = xy[0], xy[1]
+            loss = (1 - x) ** 2 + 100 * (y - x ** 2) ** 2
+            loss.backward()
+            return loss
+
+        f0 = float(closure().item())
+        opt.clear_grad()
+        for _ in range(3):
+            f = opt.step(closure)
+        assert f < f0 * 1e-3, (f0, f)
+
+    def test_plain_step_without_line_search(self):
+        w = pt.to_tensor(np.array([5.0], np.float32), stop_gradient=False)
+        opt = pt.optimizer.LBFGS(parameters=[w], learning_rate=0.5,
+                                 max_iter=10)
+
+        def closure():
+            loss = (w ** 2).sum()
+            loss.backward()
+            return loss
+
+        loss = opt.step(closure)
+        assert abs(float(w.numpy()[0])) < 1.0
+
+    def test_rejects_unknown_line_search(self):
+        w = pt.to_tensor(np.ones(1, np.float32), stop_gradient=False)
+        with pytest.raises(ValueError):
+            pt.optimizer.LBFGS(parameters=[w], line_search_fn="armijo")

@@ -154,8 +154,10 @@ class TestIncubateMultiprocessing:
         import pickle
         import paddle_tpu.incubate.multiprocessing  # registers reductions
 
+        import paddle_tpu.incubate.multiprocessing as pmp
+        pmp.set_sharing_strategy("file_system")  # opt in to shm transport
         t = paddle.to_tensor(np.arange(256 * 256, dtype=np.float32)
-                             .reshape(256, 256))  # >=64K: shm path when available
+                             .reshape(256, 256))  # >=64K: shm path
         buf = _io.BytesIO()
         ForkingPickler(buf, pickle.HIGHEST_PROTOCOL).dump(t)
         back = pickle.loads(buf.getvalue())
@@ -163,6 +165,10 @@ class TestIncubateMultiprocessing:
         # pickles must be re-loadable (segment survives multiple loads)
         back2 = pickle.loads(buf.getvalue())
         np.testing.assert_array_equal(back2.numpy(), t.numpy())
+        pmp.set_sharing_strategy("bytes")
+        assert pmp.get_sharing_strategy() == "bytes"
+        with pytest.raises(ValueError):
+            pmp.set_sharing_strategy("cuda_ipc")
 
     def test_parameter_roundtrip_preserves_subclass(self):
         import io as _io
