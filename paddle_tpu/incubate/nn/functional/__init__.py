@@ -9,7 +9,8 @@ from ....ops.op_utils import nary, ensure_tensor
 from ....tensor import Tensor
 
 __all__ = ["fused_rotary_position_embedding", "fused_rms_norm",
-           "fused_dropout_add", "fused_linear", "swiglu"]
+           "fused_dropout_add", "fused_linear", "swiglu",
+           "fused_matmul_bias", "fused_ec_moe", "fused_gate_attention"]
 
 
 def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
@@ -97,3 +98,111 @@ def swiglu(x, y=None):
         return nary(f, [x], name="swiglu")
     return nary(lambda a, b: jax.nn.silu(a) * b, [x, ensure_tensor(y)],
                 name="swiglu")
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """ref: ``incubate/nn/functional/fused_matmul_bias.py`` — matmul with
+    epilogue bias; XLA fuses the add into the MXU epilogue."""
+    def f(xd, yd, *b):
+        a = jnp.swapaxes(xd, -1, -2) if transpose_x else xd
+        w = jnp.swapaxes(yd, -1, -2) if transpose_y else yd
+        out = a @ w
+        if b:
+            out = out + b[0]
+        return out
+
+    args = [ensure_tensor(x), ensure_tensor(y)]
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+    return nary(f, args, name="fused_matmul_bias")
+
+
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
+                 act_type="gelu"):
+    """ref: ``incubate/nn/functional/fused_ec_moe.py`` (CUDA 'moe' op,
+    sm75+). Dense soft mixture: every token runs every expert's FFN and
+    the outputs combine with softmax(gate) weights — one batched einsum
+    pair over the expert dim, which GSPMD can shard on an expert axis.
+
+    x [B,S,D]; gate [B,S,E]; bmm0 [E,D,F] (+bias [E,1,F]);
+    bmm1 [E,F,D] (+bias [E,1,D]).
+    """
+    if act_type not in ("gelu", "relu"):
+        raise ValueError(f"act_type must be gelu/relu, got {act_type!r}")
+
+    def f(xd, gd, w0, b0, w1, b1):
+        h = jnp.einsum("bsd,edf->bsef", xd, w0) + b0[None, :, 0]
+        h = jax.nn.gelu(h, approximate=False) if act_type == "gelu" \
+            else jax.nn.relu(h)
+        y = jnp.einsum("bsef,efd->bsed", h, w1) + b1[None, :, 0]
+        p = jax.nn.softmax(gd.astype(jnp.float32), axis=-1).astype(y.dtype)
+        return jnp.einsum("bsed,bse->bsd", y, p)
+
+    return nary(f, [ensure_tensor(a) for a in
+                    (x, gate, bmm0_weight, bmm0_bias, bmm1_weight,
+                     bmm1_bias)], name="fused_ec_moe")
+
+
+def fused_gate_attention(query, key=None, query_weight=None, key_weight=None,
+                         value_weight=None, qkv_weight=None,
+                         gate_linear_weight=None, gate_linear_bias=None,
+                         out_linear_weight=None, out_linear_bias=None,
+                         nonbatched_bias=None, attn_mask=None,
+                         has_gating=True, merge_qkv=True,
+                         use_flash_attn=False):
+    """ref: ``incubate/nn/functional/fused_gate_attention.py`` —
+    AlphaFold-style gated attention over [B, msa, res, dim] inputs,
+    following the reference pseudo-code exactly (einsum chain + sigmoid
+    gate + output projection). One traced XLA program; the fused-kernel
+    benefit comes from XLA fusion rather than a bespoke CUDA kernel."""
+    tensors = {"q": ensure_tensor(query)}
+    if merge_qkv:
+        tensors["qkv_w"] = ensure_tensor(qkv_weight)
+    else:
+        tensors["k"] = ensure_tensor(key)
+        tensors["qw"] = ensure_tensor(query_weight)
+        tensors["kw"] = ensure_tensor(key_weight)
+        tensors["vw"] = ensure_tensor(value_weight)
+    if has_gating:
+        tensors["gw"] = ensure_tensor(gate_linear_weight)
+        tensors["gb"] = ensure_tensor(gate_linear_bias)
+    tensors["ow"] = ensure_tensor(out_linear_weight)
+    if out_linear_bias is not None:
+        tensors["ob"] = ensure_tensor(out_linear_bias)
+    if nonbatched_bias is not None:
+        tensors["nb"] = ensure_tensor(nonbatched_bias)
+    if attn_mask is not None:
+        tensors["mask"] = ensure_tensor(attn_mask)
+    keys = list(tensors)
+
+    def f(*vals):
+        t = dict(zip(keys, vals))
+        qd = t["q"]
+        if merge_qkv:
+            # qkv_w [3, H, Dh, q_dim]
+            q = jnp.einsum("nbqa,hca->nbqhc", qd, t["qkv_w"][0])
+            k = jnp.einsum("nbka,hca->nbkhc", qd, t["qkv_w"][1])
+            v = jnp.einsum("nbka,hca->nbkhc", qd, t["qkv_w"][2])
+        else:
+            q = jnp.einsum("nbqa,ahc->nbqhc", qd, t["qw"])
+            k = jnp.einsum("nbka,ahc->nbkhc", t["k"], t["kw"])
+            v = jnp.einsum("nbka,ahc->nbkhc", t["k"], t["vw"])
+        c = q.shape[-1] ** (-0.5)
+        logits = jnp.einsum("nbqhc,nbkhc->nbhqk", q * c, k)
+        if "mask" in t:
+            logits = logits + t["mask"].astype(logits.dtype)
+        if "nb" in t:
+            logits = logits + t["nb"].astype(logits.dtype)
+        w = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(
+            qd.dtype)
+        avg = jnp.einsum("nbhqk,nbkhc->nbqhc", w, v)
+        if has_gating:
+            gate = jnp.einsum("nbqc,chv->nbqhv", qd, t["gw"]) + t["gb"]
+            avg = avg * jax.nn.sigmoid(gate)
+        out = jnp.einsum("nbqhc,hco->nbqo", avg, t["ow"])
+        if "ob" in t:
+            out = out + t["ob"]
+        return out
+
+    return nary(f, list(tensors.values()), name="fused_gate_attention")

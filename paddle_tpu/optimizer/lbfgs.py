@@ -51,8 +51,9 @@ class LBFGS(Optimizer):
         self._hist_s: list = []
         self._hist_y: list = []
         self._rho: list = []
-        self._prev_flat_grad = None
+        self._first_iter = True
         self._n_evals = 0
+        self._last_loss_tensor = None
 
     # -- flat <-> param views ----------------------------------------------
     def _params(self):
@@ -78,6 +79,7 @@ class LBFGS(Optimizer):
             self._scatter(x)
         self.clear_grad()
         loss = closure()
+        self._last_loss_tensor = loss  # step() returns the Tensor (ref API)
         self._n_evals += 1
         return float(loss.item()), self._gather("grad")
 
@@ -178,17 +180,17 @@ class LBFGS(Optimizer):
 
         loss, flat_grad = self._closure_eval(closure)
         if float(jnp.abs(flat_grad).max()) <= self.tolerance_grad:
-            return loss
+            return self._last_loss_tensor
 
         x = self._gather("data")
         for _ in range(self.max_iter):
             d = self._direction(flat_grad)
-            if self._prev_flat_grad is None:
+            if self._first_iter:
                 t = min(1.0, 1.0 / max(float(jnp.abs(flat_grad).sum()),
                                        1e-10)) * lr
+                self._first_iter = False
             else:
                 t = lr
-            self._prev_flat_grad = flat_grad
 
             if self.line_search_fn == "strong_wolfe":
                 f_new, g_new, t = self._strong_wolfe(
@@ -212,20 +214,22 @@ class LBFGS(Optimizer):
             if self._n_evals >= self.max_eval:
                 break
         self._scatter(x)
-        return loss
-
-    def clear_grad(self):
-        for p in self._parameter_list:
-            p.clear_grad()
+        return self._last_loss_tensor
 
     def state_dict(self):
-        return {
+        out = super().state_dict()
+        out["lbfgs"] = {
             "hist_s": [np.asarray(s) for s in self._hist_s],
             "hist_y": [np.asarray(y) for y in self._hist_y],
             "rho": list(self._rho),
+            "first_iter": self._first_iter,
         }
+        return out
 
     def set_state_dict(self, state):
-        self._hist_s = [jnp.asarray(s) for s in state.get("hist_s", [])]
-        self._hist_y = [jnp.asarray(y) for y in state.get("hist_y", [])]
-        self._rho = list(state.get("rho", []))
+        lb = state.pop("lbfgs", {}) if isinstance(state, dict) else {}
+        super().set_state_dict(state)
+        self._hist_s = [jnp.asarray(s) for s in lb.get("hist_s", [])]
+        self._hist_y = [jnp.asarray(y) for y in lb.get("hist_y", [])]
+        self._rho = list(lb.get("rho", []))
+        self._first_iter = bool(lb.get("first_iter", True))

@@ -166,3 +166,91 @@ def test_deform_conv2d_layer_registers_params():
     layer2 = V.DeformConv2D(2, 3, 3)
     assert not np.allclose(np.asarray(layer.weight._data),
                            np.asarray(layer2.weight._data))
+
+
+class TestFusedIncubateOps:
+    """fused_matmul_bias / fused_ec_moe / fused_gate_attention (ref:
+    ``incubate/nn/functional/``) vs plain numpy/einsum oracles."""
+
+    def test_fused_matmul_bias(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(3, 4).astype(np.float32)
+        w = rs.randn(5, 4).astype(np.float32)
+        b = rs.randn(5).astype(np.float32)
+        from paddle_tpu.incubate.nn.functional import fused_matmul_bias
+        out = fused_matmul_bias(pt.to_tensor(x), pt.to_tensor(w),
+                                pt.to_tensor(b), transpose_y=True)
+        np.testing.assert_allclose(out.numpy(), x @ w.T + b, rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_fused_ec_moe_matches_loop(self):
+        from paddle_tpu.incubate.nn.functional import fused_ec_moe
+        rs = np.random.RandomState(1)
+        B, S, D, F_, E = 2, 3, 4, 8, 3
+        x = rs.randn(B, S, D).astype(np.float32)
+        gate = rs.randn(B, S, E).astype(np.float32)
+        w0 = rs.randn(E, D, F_).astype(np.float32)
+        b0 = rs.randn(E, 1, F_).astype(np.float32)
+        w1 = rs.randn(E, F_, D).astype(np.float32)
+        b1 = rs.randn(E, 1, D).astype(np.float32)
+        out = fused_ec_moe(pt.to_tensor(x), pt.to_tensor(gate),
+                           pt.to_tensor(w0), pt.to_tensor(b0),
+                           pt.to_tensor(w1), pt.to_tensor(b1), "relu")
+        # oracle: explicit loop over experts
+        p = np.exp(gate - gate.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        want = np.zeros((B, S, D), np.float64)
+        for e in range(E):
+            h = np.maximum(x @ w0[e] + b0[e][0], 0)
+            y = h @ w1[e] + b1[e][0]
+            want += p[..., e:e + 1] * y
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-4, atol=1e-4)
+        with pytest.raises(ValueError):
+            fused_ec_moe(pt.to_tensor(x), pt.to_tensor(gate),
+                         pt.to_tensor(w0), pt.to_tensor(b0),
+                         pt.to_tensor(w1), pt.to_tensor(b1), "swish")
+
+    def test_fused_gate_attention_merge_qkv(self):
+        from paddle_tpu.incubate.nn.functional import fused_gate_attention
+        rs = np.random.RandomState(2)
+        B, M, R, D, H, Dh = 2, 3, 4, 8, 2, 4
+        q = rs.randn(B, M, R, D).astype(np.float32)
+        qkv_w = rs.randn(3, H, Dh, D).astype(np.float32)
+        gw = rs.randn(D, H, Dh).astype(np.float32)
+        gb = rs.randn(H, Dh).astype(np.float32)
+        ow = rs.randn(H, Dh, D).astype(np.float32)
+        ob = rs.randn(D).astype(np.float32)
+        out = fused_gate_attention(
+            pt.to_tensor(q), qkv_weight=pt.to_tensor(qkv_w),
+            gate_linear_weight=pt.to_tensor(gw),
+            gate_linear_bias=pt.to_tensor(gb),
+            out_linear_weight=pt.to_tensor(ow),
+            out_linear_bias=pt.to_tensor(ob))
+        # oracle: the reference pseudo-code verbatim in numpy/einsum
+        qq = np.einsum("nbqa,hca->nbqhc", q, qkv_w[0])
+        kk = np.einsum("nbka,hca->nbkhc", q, qkv_w[1])
+        vv = np.einsum("nbka,hca->nbkhc", q, qkv_w[2])
+        c = Dh ** (-0.5)
+        logits = np.einsum("nbqhc,nbkhc->nbhqk", qq * c, kk)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        w = e / e.sum(-1, keepdims=True)
+        avg = np.einsum("nbhqk,nbkhc->nbqhc", w, vv)
+        gate = 1 / (1 + np.exp(-(np.einsum("nbqc,chv->nbqhv", q, gw) + gb)))
+        want = np.einsum("nbqhc,hco->nbqo", avg * gate, ow) + ob
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-4, atol=1e-4)
+        assert tuple(out.shape) == (B, M, R, D)
+
+    def test_fused_gate_attention_separate_kv_grads(self):
+        from paddle_tpu.incubate.nn.functional import fused_gate_attention
+        rs = np.random.RandomState(3)
+        B, M, R, D, H, Dh = 1, 2, 3, 4, 2, 2
+        q = pt.to_tensor(rs.randn(B, M, R, D).astype(np.float32),
+                         stop_gradient=False)
+        k = pt.to_tensor(rs.randn(B, M, R, D).astype(np.float32))
+        mk = lambda *s: pt.to_tensor(rs.randn(*s).astype(np.float32))
+        out = fused_gate_attention(
+            q, key=k, query_weight=mk(D, H, Dh), key_weight=mk(D, H, Dh),
+            value_weight=mk(D, H, Dh), out_linear_weight=mk(H, Dh, D),
+            has_gating=False, merge_qkv=False)
+        out.sum().backward()
+        assert q.grad is not None and np.isfinite(q.grad.numpy()).all()

@@ -234,7 +234,7 @@ class TestLBFGS:
         f0 = float(closure().item())
         opt.clear_grad()
         for _ in range(3):
-            f = opt.step(closure)
+            f = float(opt.step(closure).item())  # step returns the Tensor
         assert f < f0 * 1e-3, (f0, f)
 
     def test_plain_step_without_line_search(self):

@@ -158,6 +158,15 @@ class TestIncubateMultiprocessing:
         pmp.set_sharing_strategy("file_system")  # opt in to shm transport
         t = paddle.to_tensor(np.arange(256 * 256, dtype=np.float32)
                              .reshape(256, 256))  # >=64K: shm path
+        try:
+            self._roundtrip(t, pmp)
+        finally:
+            pmp.set_sharing_strategy("bytes")
+
+    def _roundtrip(self, t, pmp):
+        import io as _io
+        from multiprocessing.reduction import ForkingPickler
+        import pickle
         buf = _io.BytesIO()
         ForkingPickler(buf, pickle.HIGHEST_PROTOCOL).dump(t)
         back = pickle.loads(buf.getvalue())
@@ -165,8 +174,7 @@ class TestIncubateMultiprocessing:
         # pickles must be re-loadable (segment survives multiple loads)
         back2 = pickle.loads(buf.getvalue())
         np.testing.assert_array_equal(back2.numpy(), t.numpy())
-        pmp.set_sharing_strategy("bytes")
-        assert pmp.get_sharing_strategy() == "bytes"
+        assert pmp.get_sharing_strategy() == "file_system"
         with pytest.raises(ValueError):
             pmp.set_sharing_strategy("cuda_ipc")
 
