@@ -193,7 +193,9 @@ def fused_gate_attention(query, key=None, query_weight=None, key_weight=None,
         if "mask" in t:
             logits = logits + t["mask"].astype(logits.dtype)
         if "nb" in t:
-            logits = logits + t["nb"].astype(logits.dtype)
+            # ref: unsqueeze(nonbatched_bias, axis=1) — broadcast over msa
+            logits = logits + jnp.expand_dims(t["nb"], 1).astype(
+                logits.dtype)
         w = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(
             qd.dtype)
         avg = jnp.einsum("nbhqk,nbkhc->nbqhc", w, v)

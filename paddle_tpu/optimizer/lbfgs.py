@@ -179,8 +179,11 @@ class LBFGS(Optimizer):
         lr = self.get_lr()
 
         loss, flat_grad = self._closure_eval(closure)
+        # the reference returns the PRE-step loss (the first closure
+        # evaluation), not whatever trial point the line search last saw
+        orig_loss = self._last_loss_tensor
         if float(jnp.abs(flat_grad).max()) <= self.tolerance_grad:
-            return self._last_loss_tensor
+            return orig_loss
 
         x = self._gather("data")
         for _ in range(self.max_iter):
@@ -214,7 +217,7 @@ class LBFGS(Optimizer):
             if self._n_evals >= self.max_eval:
                 break
         self._scatter(x)
-        return self._last_loss_tensor
+        return orig_loss
 
     def state_dict(self):
         out = super().state_dict()
@@ -227,7 +230,7 @@ class LBFGS(Optimizer):
         return out
 
     def set_state_dict(self, state):
-        lb = state.pop("lbfgs", {}) if isinstance(state, dict) else {}
+        lb = state.get("lbfgs", {}) if isinstance(state, dict) else {}
         super().set_state_dict(state)
         self._hist_s = [jnp.asarray(s) for s in lb.get("hist_s", [])]
         self._hist_y = [jnp.asarray(y) for y in lb.get("hist_y", [])]

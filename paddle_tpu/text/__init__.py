@@ -10,7 +10,8 @@ pipeline tests — the pattern the reference's unit tests use for speed.
 """
 from .viterbi import viterbi_decode, ViterbiDecoder  # noqa: F401
 from . import datasets  # noqa: F401
-from .datasets import Imdb, Imikolov, UCIHousing, Conll05st, Movielens  # noqa: F401
+from .datasets import (  # noqa: F401
+    Imdb, Imikolov, UCIHousing, Conll05st, Movielens, WMT14, WMT16)
 
 __all__ = ["viterbi_decode", "ViterbiDecoder", "datasets", "Imdb",
-           "Imikolov", "UCIHousing", "Conll05st", "Movielens"]
+           "Imikolov", "UCIHousing", "Conll05st", "Movielens", "WMT14", "WMT16"]

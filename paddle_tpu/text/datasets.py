@@ -13,7 +13,8 @@ import numpy as np
 
 from ..io.dataset import Dataset
 
-__all__ = ["Imdb", "Imikolov", "UCIHousing", "Conll05st", "Movielens"]
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "Conll05st", "Movielens",
+           "WMT14", "WMT16"]
 
 
 class _SyntheticMixin:
@@ -203,3 +204,81 @@ class Movielens(_SyntheticMixin, Dataset):
 
     def __len__(self):
         return len(self.samples)
+
+
+class WMT14(_SyntheticMixin, Dataset):
+    """WMT14 en-fr translation (ref ``datasets/wmt14.py``): items are
+    (src_ids, trg_ids, trg_ids_next) int64 arrays; ids 0/1/2 are
+    <s>/<e>/<unk> like the reference's tarred dict."""
+
+    UNK = 2
+
+    def __init__(self, data_file=None, mode="train", dict_size=30000,
+                 synthetic=False, n_samples=256, max_len=16,
+                 src_dict_size=None, trg_dict_size=None):
+        src = self._require(data_file, synthetic)
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        self.dict_size = dict_size
+        src_n = src_dict_size or dict_size
+        trg_n = trg_dict_size or dict_size
+        if src == "file":
+            self._load_archive(data_file, mode, src_n, trg_n)
+        else:
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            for _ in range(n_samples):
+                ls = rng.randint(4, max_len)
+                lt = rng.randint(4, max_len)
+                s = rng.randint(3, src_n, ls, dtype=np.int64)
+                t = rng.randint(3, trg_n, lt, dtype=np.int64)
+                self.src_ids.append(s)
+                self.trg_ids.append(
+                    np.concatenate([[0], t]).astype(np.int64))
+                self.trg_ids_next.append(
+                    np.concatenate([t, [1]]).astype(np.int64))
+
+    @staticmethod
+    def _word_id(w, n):
+        """Stable hash into [3, n): crc32 is process-invariant (builtin
+        str hash is salted per interpreter) and 0/1/2 stay reserved for
+        <s>/<e>/<unk>."""
+        import zlib
+        return 3 + zlib.crc32(w.encode("utf8")) % max(n - 3, 1)
+
+    def _load_archive(self, data_file, mode, src_n, trg_n):
+        split = {"train": "train/train", "test": "test/test",
+                 "gen": "gen/gen"}[mode]
+        with tarfile.open(data_file) as tf:
+            names = [m for m in tf.getmembers()
+                     if m.name.endswith(split)]
+            for m in names:
+                for line in tf.extractfile(m).read().splitlines():
+                    parts = line.decode("utf8").split("\t")
+                    if len(parts) != 2:
+                        continue
+                    s = [self._word_id(w, src_n) for w in parts[0].split()]
+                    t = [self._word_id(w, trg_n) for w in parts[1].split()]
+                    self.src_ids.append(np.asarray(s, np.int64))
+                    self.trg_ids.append(np.asarray([0] + t, np.int64))
+                    self.trg_ids_next.append(np.asarray(t + [1], np.int64))
+
+    def __getitem__(self, idx):
+        return (self.src_ids[idx], self.trg_ids[idx],
+                self.trg_ids_next[idx])
+
+    def __len__(self):
+        return len(self.src_ids)
+
+
+class WMT16(WMT14):
+    """WMT16 en-de (ref ``datasets/wmt16.py``): same item schema as
+    WMT14 with configurable vocab sizes."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=30000,
+                 trg_dict_size=30000, lang="en", synthetic=False,
+                 n_samples=256, max_len=16):
+        super().__init__(data_file=data_file, mode=mode,
+                         dict_size=max(src_dict_size, trg_dict_size),
+                         synthetic=synthetic, n_samples=n_samples,
+                         max_len=max_len, src_dict_size=src_dict_size,
+                         trg_dict_size=trg_dict_size)
+        self.lang = lang

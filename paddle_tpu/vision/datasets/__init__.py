@@ -17,7 +17,7 @@ import numpy as np
 from ...io.dataset import Dataset
 
 __all__ = ["Cifar10", "Cifar100", "MNIST", "FashionMNIST", "FakeData",
-           "DatasetFolder", "ImageFolder"]
+           "DatasetFolder", "ImageFolder", "Flowers", "VOC2012"]
 
 from .folder import DatasetFolder, ImageFolder  # noqa: E402,F401
 
@@ -151,3 +151,83 @@ class MNIST(Dataset):
 
 class FashionMNIST(MNIST):
     NAME = "fashion-mnist"
+
+
+class Flowers(Dataset):
+    """Flowers-102 (ref ``vision/datasets/flowers.py``): (image, label).
+
+    Pass data_file=<102flowers.tgz> + label_file=<imagelabels.mat> +
+    setid_file=<setid.mat> (the reference's three downloads), or rely on
+    per-class synthetic images via ``FakeData``-style generation when
+    ``synthetic=True`` (no network in this environment)."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, backend="cv2",
+                 synthetic=False, n_samples=128):
+        self.transform = transform
+        if synthetic or data_file is None:
+            if not synthetic:
+                raise FileNotFoundError(
+                    "Flowers requires data_file/label_file/setid_file "
+                    "(no network download); or pass synthetic=True")
+            fake = FakeData(size=n_samples, image_shape=(3, 64, 64),
+                            num_classes=102,
+                            seed=0 if mode == "train" else 1)
+            self._fake = fake
+            return
+        raise NotImplementedError(
+            "jpeg decoding needs an image library; provide decoded arrays "
+            "via DatasetFolder or use synthetic=True")
+
+    def __getitem__(self, idx):
+        img, label = self._fake[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self._fake)
+
+
+class VOC2012(Dataset):
+    """VOC2012 segmentation (ref ``vision/datasets/voc2012.py``):
+    (image, mask) pairs; synthetic mode generates blob masks so
+    segmentation pipelines are testable offline."""
+
+    NUM_CLASSES = 21
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 backend="cv2", synthetic=False, n_samples=64,
+                 image_shape=(3, 64, 64)):
+        self.transform = transform
+        self.image_shape = tuple(image_shape)
+        if not synthetic:
+            if data_file is not None and os.path.exists(data_file):
+                raise NotImplementedError(
+                    "jpeg/png decoding needs an image library; use "
+                    "synthetic=True or a DatasetFolder of decoded arrays")
+            raise FileNotFoundError(
+                "VOC2012 requires the VOCtrainval tar (no network "
+                "download in this environment); pass synthetic=True for "
+                "generated (image, mask) pairs")
+        self.n = n_samples
+        self.seed = 0 if mode == "train" else 1
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self.seed * 100003 + idx)
+        c, h, w = self.image_shape
+        img = rng.rand(c, h, w).astype(np.float32)
+        mask = np.zeros((h, w), np.int64)
+        # a couple of rectangular "objects"
+        for _ in range(rng.randint(1, 4)):
+            cls = rng.randint(1, self.NUM_CLASSES)
+            y0, x0 = rng.randint(0, h // 2), rng.randint(0, w // 2)
+            mask[y0:y0 + rng.randint(4, h // 2),
+                 x0:x0 + rng.randint(4, w // 2)] = cls
+            img[:, mask == cls] += cls / self.NUM_CLASSES
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, mask
+
+    def __len__(self):
+        return self.n

@@ -249,3 +249,21 @@ class TestBert:
             mlm, nsp, pt.to_tensor(ids),
             pt.to_tensor(np.zeros(2, np.int64)))
         assert float(loss.numpy()) > 0
+
+
+class TestWMT:
+    def test_wmt14_synthetic_schema(self):
+        ds = pt.text.WMT14(synthetic=True, n_samples=8, dict_size=100)
+        assert len(ds) == 8
+        s, t, tn = ds[0]
+        # trg starts with <s>=0; trg_next ends with <e>=1; shifted pair
+        assert t[0] == 0 and tn[-1] == 1
+        np.testing.assert_array_equal(t[1:], tn[:-1])
+        assert s.dtype == np.int64
+
+    def test_wmt16_subclass(self):
+        ds = pt.text.WMT16(synthetic=True, n_samples=4, src_dict_size=50,
+                           trg_dict_size=60, lang="de")
+        assert len(ds) == 4 and ds.lang == "de"
+        with pytest.raises(FileNotFoundError):
+            pt.text.WMT14()  # no file, no synthetic -> loud error

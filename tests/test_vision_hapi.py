@@ -119,3 +119,23 @@ class TestHapi:
         corr = m.compute(pred, label)
         m.update(corr)
         assert abs(m.accumulate() - 0.5) < 1e-6
+
+
+def test_flowers_voc_synthetic():
+    """Flowers / VOC2012 dataset surface (ref vision/datasets/{flowers,
+    voc2012}.py): offline synthetic splits feed classification and
+    segmentation pipelines."""
+    f = pt.vision.datasets.Flowers(synthetic=True, n_samples=8)
+    img, lbl = f[3]
+    assert img.shape == (3, 64, 64) and 0 <= int(lbl) < 102
+    v = pt.vision.datasets.VOC2012(synthetic=True, n_samples=4)
+    img, mask = v[0]
+    assert mask.shape == (64, 64) and mask.dtype == np.int64
+    assert 0 < mask.max() < v.NUM_CLASSES
+    # train/eval splits differ
+    v2 = pt.vision.datasets.VOC2012(synthetic=True, mode="val", n_samples=4)
+    assert not np.array_equal(v[0][1], v2[0][1])
+    with pytest.raises(FileNotFoundError):
+        pt.vision.datasets.Flowers()
+    with pytest.raises(FileNotFoundError):
+        pt.vision.datasets.VOC2012()
