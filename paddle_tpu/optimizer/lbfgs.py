@@ -230,7 +230,11 @@ class LBFGS(Optimizer):
         return out
 
     def set_state_dict(self, state):
-        lb = state.get("lbfgs", {}) if isinstance(state, dict) else {}
+        if isinstance(state, dict):
+            state = dict(state)  # caller's dict stays unmutated
+            lb = state.pop("lbfgs", {})  # base would jnp.asarray() it
+        else:
+            lb = {}
         super().set_state_dict(state)
         self._hist_s = [jnp.asarray(s) for s in lb.get("hist_s", [])]
         self._hist_y = [jnp.asarray(y) for y in lb.get("hist_y", [])]

@@ -14,7 +14,9 @@ from ..nn.layer.layers import Layer
 __all__ = ["box_coder", "box_area", "box_iou", "nms", "roi_align",
            "roi_pool", "generate_proposals", "distribute_fpn_proposals",
            "yolo_box", "yolo_loss", "DeformConv2D", "deform_conv2d",
-           "PSRoIPool", "psroi_pool", "RoIAlign", "RoIPool"]
+           "PSRoIPool", "psroi_pool", "RoIAlign", "RoIPool",
+           "read_file", "decode_jpeg", "prior_box", "matrix_nms",
+           "ConvNormActivation"]
 
 
 def box_area(boxes, name=None):
@@ -693,3 +695,194 @@ class PSRoIPool:
 
     def __call__(self, x, boxes, boxes_num):
         return psroi_pool(x, boxes, boxes_num, self._size, self._scale)
+
+
+def read_file(filename, name=None):
+    """ref ``vision/ops.py read_file``: raw file bytes as a 1-D uint8
+    Tensor (pair with :func:`decode_jpeg`)."""
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return Tensor(data)
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """ref ``vision/ops.py decode_jpeg`` (CPU/GPU jpeg decoder). Decodes
+    a 1-D uint8 byte Tensor into CHW uint8 via PIL — host-side, like the
+    reference's CPU path; TPU consumes the decoded array."""
+    import io
+    from PIL import Image
+
+    buf = bytes(np.asarray(ensure_tensor(x)._data, np.uint8))
+    img = Image.open(io.BytesIO(buf))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(np.ascontiguousarray(arr))
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior (anchor) boxes (ref ``vision/ops.py prior_box``):
+    returns (boxes [H, W, P, 4], variances [H, W, P, 4]) — pure anchor
+    arithmetic, computed once and traced as constants by XLA."""
+    input = ensure_tensor(input)
+    image = ensure_tensor(image)
+    fh, fw = int(input.shape[2]), int(input.shape[3])
+    ih, iw = int(image.shape[2]), int(image.shape[3])
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+
+    whs = []  # per-prior (w, h) in pixels
+    for k, ms in enumerate(min_sizes):
+        ms = float(ms)
+        if min_max_aspect_ratios_order:
+            whs.append((ms, ms))
+            if max_sizes:
+                big = np.sqrt(ms * float(max_sizes[k]))
+                whs.append((big, big))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        else:
+            for ar in ars:
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+            if max_sizes:
+                big = np.sqrt(ms * float(max_sizes[k]))
+                whs.append((big, big))
+    whs = np.asarray(whs, np.float32)  # [P, 2]
+
+    cx = (np.arange(fw, dtype=np.float32) + offset) * step_w
+    cy = (np.arange(fh, dtype=np.float32) + offset) * step_h
+    cxg, cyg = np.meshgrid(cx, cy)  # [H, W]
+    P = whs.shape[0]
+    boxes = np.empty((fh, fw, P, 4), np.float32)
+    boxes[..., 0] = (cxg[:, :, None] - whs[None, None, :, 0] / 2) / iw
+    boxes[..., 1] = (cyg[:, :, None] - whs[None, None, :, 1] / 2) / ih
+    boxes[..., 2] = (cxg[:, :, None] + whs[None, None, :, 0] / 2) / iw
+    boxes[..., 3] = (cyg[:, :, None] + whs[None, None, :, 1] / 2) / ih
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    vars_ = np.broadcast_to(np.asarray(variance, np.float32),
+                            boxes.shape).copy()
+    return Tensor(boxes), Tensor(vars_)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (ref ``vision/ops.py matrix_nms``): soft suppression
+    with a pairwise-IoU decay matrix instead of hard pruning. Host-side
+    (data-dependent output count, a stream-sync in the reference too)."""
+    b = np.asarray(ensure_tensor(bboxes)._data)
+    s = np.asarray(ensure_tensor(scores)._data)
+    N, C, M = s.shape
+
+    def iou_matrix(boxes):
+        x1, y1, x2, y2 = boxes.T
+        off = 0.0 if normalized else 1.0
+        area = (x2 - x1 + off) * (y2 - y1 + off)
+        ix1 = np.maximum(x1[:, None], x1[None, :])
+        iy1 = np.maximum(y1[:, None], y1[None, :])
+        ix2 = np.minimum(x2[:, None], x2[None, :])
+        iy2 = np.minimum(y2[:, None], y2[None, :])
+        iw = np.maximum(ix2 - ix1 + off, 0)
+        ih = np.maximum(iy2 - iy1 + off, 0)
+        inter = iw * ih
+        return inter / np.maximum(area[:, None] + area[None, :] - inter,
+                                  1e-10)
+
+    out_rows, out_idx, rois_num = [], [], []
+    for n in range(N):
+        rows = []
+        for c in range(C):
+            if c == background_label:
+                continue
+            sc = s[n, c]
+            keep = np.where(sc > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            order = keep[np.argsort(-sc[keep])]
+            if nms_top_k > -1:
+                order = order[:nms_top_k]
+            boxes = b[n, order]
+            scs = sc[order]
+            ious = np.triu(iou_matrix(boxes), k=1)
+            # decay_j = min_{i<j} f(iou_ij) / f(iou_cmax_i), where
+            # iou_cmax_i is suppressor i's own max overlap with boxes
+            # scored above IT (row-indexed denominator)
+            iou_cmax = ious.max(axis=0)  # per box: overlap w/ higher
+            if use_gaussian:
+                decay = np.exp(-(ious ** 2 - iou_cmax[:, None] ** 2)
+                               * gaussian_sigma)
+            else:
+                decay = (1 - ious) / np.maximum(1 - iou_cmax[:, None],
+                                                1e-10)
+            decay = np.where(np.triu(np.ones_like(ious), k=1) > 0,
+                             decay, np.inf)
+            decay = np.minimum(decay.min(axis=0), 1.0)
+            dec_scores = scs * decay
+            sel = dec_scores > post_threshold
+            for j in np.where(sel)[0]:
+                rows.append((c, dec_scores[j], *b[n, order[j]],
+                             n * M + order[j]))
+        rows.sort(key=lambda r: -r[1])
+        if keep_top_k > -1:
+            rows = rows[:keep_top_k]
+        rois_num.append(len(rows))
+        out_rows.extend(r[:6] for r in rows)
+        out_idx.extend(r[6] for r in rows)
+
+    out = (np.asarray(out_rows, np.float32) if out_rows
+           else np.zeros((0, 6), np.float32))
+    ret = [Tensor(out)]
+    if return_index:
+        ret.append(Tensor(np.asarray(out_idx, np.int64).reshape(-1, 1)))
+    if return_rois_num:
+        ret.append(Tensor(np.asarray(rois_num, np.int32)))
+    return tuple(ret) if len(ret) > 1 else ret[0]
+
+
+class ConvNormActivation(object):
+    """Built lazily to avoid importing nn at module import; see
+    ``paddle_tpu.vision.models`` blocks for the pattern (ref
+    ``vision/ops.py ConvNormActivation``)."""
+
+    _DEFAULT = object()  # sentinel: None means "omit this layer" (ref)
+
+    def __new__(cls, in_channels, out_channels, kernel_size=3, stride=1,
+                padding=None, groups=1, norm_layer=_DEFAULT,
+                activation_layer=_DEFAULT, dilation=1, bias=None):
+        from .. import nn
+        if padding is None:
+            padding = (kernel_size - 1) // 2 * dilation
+        if norm_layer is cls._DEFAULT:
+            norm_layer = nn.BatchNorm2D
+        if activation_layer is cls._DEFAULT:
+            activation_layer = nn.ReLU
+        if bias is None:
+            bias = norm_layer is None
+        layers = [nn.Conv2D(in_channels, out_channels, kernel_size, stride,
+                            padding, dilation=dilation, groups=groups,
+                            bias_attr=bias if bias else False)]
+        if norm_layer is not None:
+            layers.append(norm_layer(out_channels))
+        if activation_layer is not None:
+            layers.append(activation_layer())
+        return nn.Sequential(*layers)

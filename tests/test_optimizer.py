@@ -254,3 +254,25 @@ class TestLBFGS:
         w = pt.to_tensor(np.ones(1, np.float32), stop_gradient=False)
         with pytest.raises(ValueError):
             pt.optimizer.LBFGS(parameters=[w], line_search_fn="armijo")
+
+
+def test_lbfgs_state_dict_roundtrip():
+    """set_state_dict must neither mutate the caller's dict nor leak the
+    'lbfgs' sub-dict into the base class's array conversion."""
+    w = pt.to_tensor(np.array([3.0, -2.0], np.float32), stop_gradient=False)
+    opt = pt.optimizer.LBFGS(parameters=[w], max_iter=5)
+
+    def closure():
+        loss = (w ** 2).sum()
+        loss.backward()
+        return loss
+
+    opt.step(closure)
+    sd = opt.state_dict()
+    w2 = pt.to_tensor(np.zeros(2, np.float32), stop_gradient=False)
+    opt2 = pt.optimizer.LBFGS(parameters=[w2], max_iter=5)
+    opt2.set_state_dict(sd)
+    assert "lbfgs" in sd  # caller's dict untouched
+    opt3 = pt.optimizer.LBFGS(parameters=[w2], max_iter=5)
+    opt3.set_state_dict(sd)  # second load still sees the history
+    assert len(opt3._hist_s) == len(opt._hist_s)

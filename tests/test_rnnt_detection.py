@@ -418,3 +418,96 @@ class TestYoloLoss:
             use_label_smooth=False, **kw)._data)
         assert np.all(np.isfinite(l_smooth))
         assert not np.allclose(l_smooth, l_hard)
+
+
+class TestVisionOpsExtra:
+    """read_file/decode_jpeg/prior_box/matrix_nms/ConvNormActivation
+    (ref ``python/paddle/vision/ops.py``)."""
+
+    def test_read_decode_jpeg_roundtrip(self, tmp_path):
+        from PIL import Image
+        import paddle_tpu as ptm
+        # smooth gradient: random noise is JPEG-hostile at any quality
+        yy, xx = np.mgrid[0:16, 0:20].astype(np.float32)
+        arr = np.stack([yy * 15, xx * 12, (yy + xx) * 7],
+                       -1).astype(np.uint8)
+        p = str(tmp_path / "t.jpg")
+        Image.fromarray(arr).save(p, quality=95)
+        raw = ptm.vision.ops.read_file(p)
+        assert raw.numpy().dtype == np.uint8 and raw.numpy().ndim == 1
+        img = ptm.vision.ops.decode_jpeg(raw, mode="rgb")
+        assert tuple(img.shape) == (3, 16, 20)
+        # jpeg is lossy; mean error must still be small
+        assert np.abs(img.numpy().transpose(1, 2, 0).astype(np.int32)
+                      - arr.astype(np.int32)).mean() < 16
+        g = ptm.vision.ops.decode_jpeg(raw, mode="gray")
+        assert tuple(g.shape) == (1, 16, 20)
+
+    def test_prior_box_geometry(self):
+        import paddle_tpu as ptm
+        feat = ptm.to_tensor(np.zeros((1, 8, 4, 4), np.float32))
+        img = ptm.to_tensor(np.zeros((1, 3, 32, 32), np.float32))
+        boxes, variances = ptm.vision.ops.prior_box(
+            feat, img, min_sizes=[8.0], max_sizes=[16.0],
+            aspect_ratios=[2.0], flip=True, clip=True)
+        # priors: ar 1 + big + ar 2 + ar 1/2 = 4
+        assert tuple(boxes.shape) == (4, 4, 4, 4)
+        b = boxes.numpy()
+        assert (b >= 0).all() and (b <= 1).all()
+        # center of cell (0,0) is at offset*step = 4px -> 0.125
+        cx = (b[0, 0, 0, 0] + b[0, 0, 0, 2]) / 2
+        np.testing.assert_allclose(cx, 0.125, atol=1e-6)
+        np.testing.assert_allclose(variances.numpy()[0, 0, 0],
+                                   [0.1, 0.1, 0.2, 0.2])
+
+    def test_matrix_nms_suppresses_overlaps(self):
+        import paddle_tpu as ptm
+        # two heavily-overlapping boxes + one separate box, one class
+        boxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                           [20, 20, 30, 30]]], np.float32)
+        scores = np.array([[[0.0, 0.0, 0.0],      # class 0 = background
+                            [0.9, 0.8, 0.7]]], np.float32)
+        out, rois_num = ptm.vision.ops.matrix_nms(
+            ptm.to_tensor(boxes), ptm.to_tensor(scores),
+            score_threshold=0.1, post_threshold=0.5, nms_top_k=10,
+            keep_top_k=10)
+        o = out.numpy()
+        assert rois_num.numpy()[0] == o.shape[0]
+        # top box and the separate box survive; the duplicate is decayed
+        kept_scores = sorted(o[:, 1], reverse=True)
+        assert kept_scores[0] > 0.89
+        assert all(o[:, 0] == 1)  # class label 1
+        dup = [r for r in o if abs(r[2] - 0.5) < 0.2 and r[1] > 0.5]
+        assert not dup, dup  # decayed duplicate must drop below 0.5
+        assert o.shape[0] == 2, o
+
+    def test_matrix_nms_return_index_and_gaussian(self):
+        import paddle_tpu as ptm
+        boxes = np.random.RandomState(0).rand(2, 5, 4).astype(np.float32)
+        boxes[..., 2:] += boxes[..., :2] + 0.1
+        scores = np.random.RandomState(1).rand(2, 2, 5).astype(np.float32)
+        out, idx, rn = ptm.vision.ops.matrix_nms(
+            ptm.to_tensor(boxes), ptm.to_tensor(scores),
+            score_threshold=0.0, post_threshold=0.0, nms_top_k=-1,
+            keep_top_k=3, use_gaussian=True, return_index=True)
+        assert rn.numpy().sum() == out.numpy().shape[0]
+        assert idx.numpy().shape == (out.numpy().shape[0], 1)
+        assert (rn.numpy() <= 3).all()
+
+    def test_conv_norm_activation_block(self):
+        import paddle_tpu as ptm
+        blk = ptm.vision.ops.ConvNormActivation(3, 8, kernel_size=3)
+        x = ptm.to_tensor(np.random.RandomState(0)
+                          .rand(2, 3, 8, 8).astype(np.float32))
+        out = blk(x)
+        assert tuple(out.shape) == (2, 8, 8, 8)
+        assert float(out.numpy().min()) >= 0  # ReLU tail
+
+    def test_conv_norm_activation_none_omits_layers(self):
+        import paddle_tpu as ptm
+        blk = ptm.vision.ops.ConvNormActivation(3, 8, norm_layer=None,
+                                                activation_layer=None)
+        names = [type(l).__name__ for l in blk]
+        assert names == ["Conv2D"], names
+        # norm-free conv keeps its bias (reference default)
+        assert blk[0].bias is not None
