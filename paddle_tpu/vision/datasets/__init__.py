@@ -157,36 +157,79 @@ class Flowers(Dataset):
     """Flowers-102 (ref ``vision/datasets/flowers.py``): (image, label).
 
     Pass data_file=<102flowers.tgz> + label_file=<imagelabels.mat> +
-    setid_file=<setid.mat> (the reference's three downloads), or rely on
-    per-class synthetic images via ``FakeData``-style generation when
-    ``synthetic=True`` (no network in this environment)."""
+    setid_file=<setid.mat> (the reference's three downloads; decoded
+    with PIL + scipy like the reference's backends), or
+    ``synthetic=True`` for per-class generated images (no network in
+    this environment). Reference semantics preserved: the train/test
+    split arrays are deliberately EXCHANGED (train uses ``tstid``, the
+    larger set), labels are the raw 1-based values with shape (1,), and
+    item order follows setid.mat file order.
+    """
+
+    # the reference swaps these on purpose (flowers.py MODE_FLAG_MAP)
+    _SPLIT_KEY = {"train": "tstid", "valid": "valid", "test": "trnid"}
 
     def __init__(self, data_file=None, label_file=None, setid_file=None,
                  mode="train", transform=None, backend="cv2",
                  synthetic=False, n_samples=128):
+        mode = str(mode).lower()
+        if mode not in self._SPLIT_KEY:
+            raise ValueError(f"mode must be train/valid/test, got {mode!r}")
+        if backend not in ("pil", "cv2"):
+            raise ValueError(f"backend must be 'pil' or 'cv2', got "
+                             f"{backend!r}")
         self.transform = transform
-        if synthetic or data_file is None:
-            if not synthetic:
+        self.backend = backend
+        self._fake = None
+        if synthetic:
+            self._fake = FakeData(size=n_samples, image_shape=(3, 64, 64),
+                                  num_classes=102,
+                                  seed=0 if mode == "train" else 1)
+            return
+        for f in (data_file, label_file, setid_file):
+            if f is None or not os.path.exists(f):
                 raise FileNotFoundError(
                     "Flowers requires data_file/label_file/setid_file "
                     "(no network download); or pass synthetic=True")
-            fake = FakeData(size=n_samples, image_shape=(3, 64, 64),
-                            num_classes=102,
-                            seed=0 if mode == "train" else 1)
-            self._fake = fake
-            return
-        raise NotImplementedError(
-            "jpeg decoding needs an image library; provide decoded arrays "
-            "via DatasetFolder or use synthetic=True")
+        import scipy.io as sio
+        labels = sio.loadmat(label_file)["labels"][0]       # 1-based
+        # file order preserved: sample i matches the reference's sample i
+        self._ids = [int(i) for i in
+                     sio.loadmat(setid_file)[self._SPLIT_KEY[mode]][0]]
+        self._labels = {i: int(labels[i - 1]) for i in self._ids}
+        # extract ONCE (the tgz is gzip — members are not seekable, so
+        # per-item extractfile would re-decompress the archive each time;
+        # the reference extracts to disk in __init__ too)
+        import tempfile
+        self._dir = tempfile.mkdtemp(prefix="flowers_")
+        with tarfile.open(data_file) as tf:
+            tf.extractall(self._dir, filter="data")
+        self._paths = {}
+        for root, _, files in os.walk(self._dir):
+            for name in files:
+                if name.endswith(".jpg"):
+                    self._paths[name] = os.path.join(root, name)
 
     def __getitem__(self, idx):
-        img, label = self._fake[idx]
+        if self._fake is not None:
+            img, label = self._fake[idx]
+            if self.transform is not None:
+                img = self.transform(img)
+            return img, label
+        from PIL import Image
+        img_id = self._ids[idx]
+        img = Image.open(self._paths[f"image_{img_id:05d}.jpg"])
+        img = img.convert("RGB")
+        if self.backend == "cv2":
+            img = np.asarray(img)
         if self.transform is not None:
             img = self.transform(img)
-        return img, label
+        return img, np.array([self._labels[img_id]], np.int64)
 
     def __len__(self):
-        return len(self._fake)
+        if self._fake is not None:
+            return len(self._fake)
+        return len(self._ids)
 
 
 class VOC2012(Dataset):

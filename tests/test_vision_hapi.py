@@ -139,3 +139,46 @@ def test_flowers_voc_synthetic():
         pt.vision.datasets.Flowers()
     with pytest.raises(FileNotFoundError):
         pt.vision.datasets.VOC2012()
+
+
+def test_flowers_real_archive(tmp_path):
+    """Real-archive Flowers path with REFERENCE semantics: train/test
+    split arrays exchanged (train = tstid), 1-based labels of shape
+    (1,), setid file order preserved, extract-once loading."""
+    import io, tarfile
+    from PIL import Image
+    import scipy.io as sio
+    tgz = str(tmp_path / "102flowers.tgz")
+    with tarfile.open(tgz, "w:gz") as tf:
+        for i in range(1, 5):
+            arr = np.full((8, 8, 3), i * 40, np.uint8)
+            buf = io.BytesIO()
+            Image.fromarray(arr).save(buf, format="JPEG")
+            data = buf.getvalue()
+            info = tarfile.TarInfo(f"jpg/image_{i:05d}.jpg")
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    lab = str(tmp_path / "imagelabels.mat")
+    sio.savemat(lab, {"labels": np.array([[5, 6, 5, 6]])})
+    sid = str(tmp_path / "setid.mat")
+    sio.savemat(sid, {"trnid": np.array([[4]]),
+                      "valid": np.array([[1]]),
+                      "tstid": np.array([[3, 2]])})  # non-ascending order
+    ds = pt.vision.datasets.Flowers(data_file=tgz, label_file=lab,
+                                    setid_file=sid, mode="train")
+    # train reads tstid (the reference's deliberate swap), file order kept
+    assert len(ds) == 2
+    img, label = ds[0]
+    assert img.shape == (8, 8, 3)
+    assert label.shape == (1,) and int(label[0]) == 5  # raw 1-based
+    test = pt.vision.datasets.Flowers(data_file=tgz, label_file=lab,
+                                      setid_file=sid, mode="test")
+    assert len(test) == 1 and int(test[0][1][0]) == 6  # trnid id 4
+    # pil backend returns a PIL image; bogus backend/mode raise
+    pil_ds = pt.vision.datasets.Flowers(data_file=tgz, label_file=lab,
+                                        setid_file=sid, backend="pil")
+    assert hasattr(pil_ds[0][0], "resize")
+    with pytest.raises(ValueError):
+        pt.vision.datasets.Flowers(synthetic=True, backend="cv")
+    with pytest.raises(ValueError):
+        pt.vision.datasets.Flowers(synthetic=True, mode="generate")
