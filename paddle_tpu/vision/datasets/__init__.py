@@ -16,6 +16,15 @@ import numpy as np
 
 from ...io.dataset import Dataset
 
+
+def _safe_extractall(tf, dst):
+    """extractall with the 3.12+ 'data' filter when available (the
+    filter= kwarg only exists from the 3.10.12/3.11.4 backports on)."""
+    try:
+        tf.extractall(dst, filter="data")
+    except TypeError:
+        tf.extractall(dst)
+
 __all__ = ["Cifar10", "Cifar100", "MNIST", "FashionMNIST", "FakeData",
            "DatasetFolder", "ImageFolder", "Flowers", "VOC2012"]
 
@@ -201,9 +210,10 @@ class Flowers(Dataset):
         # per-item extractfile would re-decompress the archive each time;
         # the reference extracts to disk in __init__ too)
         import tempfile
-        self._dir = tempfile.mkdtemp(prefix="flowers_")
+        self._tmpdir = tempfile.TemporaryDirectory(prefix="flowers_")
+        self._dir = self._tmpdir.name  # reclaimed with the dataset object
         with tarfile.open(data_file) as tf:
-            tf.extractall(self._dir, filter="data")
+            _safe_extractall(tf, self._dir)
         self._paths = {}
         for root, _, files in os.walk(self._dir):
             for name in files:
@@ -216,12 +226,11 @@ class Flowers(Dataset):
             if self.transform is not None:
                 img = self.transform(img)
             return img, label
-        from PIL import Image
+        from ..image import image_load
         img_id = self._ids[idx]
-        img = Image.open(self._paths[f"image_{img_id:05d}.jpg"])
-        img = img.convert("RGB")
-        if self.backend == "cv2":
-            img = np.asarray(img)
+        # same contract as image_load: 'pil' -> PIL.Image, 'cv2' -> BGR
+        img = image_load(self._paths[f"image_{img_id:05d}.jpg"],
+                         backend=self.backend)
         if self.transform is not None:
             img = self.transform(img)
         return img, np.array([self._labels[img_id]], np.int64)

@@ -16,9 +16,14 @@ IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
 
 
 def default_loader(path):
-    """PIL for images, numpy for .npy arrays."""
+    """Dispatches on the global image backend
+    (``paddle.vision.set_image_backend``), like the reference; .npy
+    arrays load directly."""
     if path.endswith(".npy"):
         return np.load(path)
+    from ..image import get_image_backend, image_load
+    if get_image_backend() == "cv2":
+        return image_load(path, backend="cv2")
     from PIL import Image
     with open(path, "rb") as f:
         return np.asarray(Image.open(f).convert("RGB"))

@@ -1,0 +1,37 @@
+"""``paddle.vision.image`` (ref: ``python/paddle/vision/image.py``):
+global image-loading backend switch + ``image_load`` used by
+DatasetFolder/ImageFolder."""
+from __future__ import annotations
+
+__all__ = ["set_image_backend", "get_image_backend", "image_load"]
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend):
+    """'pil' or 'cv2' (both available in this environment)."""
+    global _image_backend
+    if backend not in ("pil", "cv2"):
+        raise ValueError(
+            f"Expected backend are one of ['pil', 'cv2'], but got "
+            f"{backend}")
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Returns PIL.Image ('pil') or HWC BGR np.ndarray ('cv2'), exactly
+    as the reference's loaders do."""
+    if backend is None:
+        backend = _image_backend
+    if backend == "pil":
+        from PIL import Image
+        return Image.open(path)
+    if backend == "cv2":
+        import cv2
+        return cv2.imread(path)  # IMREAD_COLOR: 3-channel BGR (ref)
+    raise ValueError(
+        f"Expected backend are one of ['pil', 'cv2'], but got {backend}")

@@ -511,3 +511,23 @@ class TestVisionOpsExtra:
         assert names == ["Conv2D"], names
         # norm-free conv keeps its bias (reference default)
         assert blk[0].bias is not None
+
+    def test_image_backend_and_load(self, tmp_path):
+        import paddle_tpu as ptm
+        from PIL import Image
+        p = str(tmp_path / "img.png")
+        arr = np.arange(48, dtype=np.uint8).reshape(4, 4, 3)
+        Image.fromarray(arr).save(p)
+        assert ptm.vision.get_image_backend() == "pil"
+        img = ptm.vision.image_load(p)
+        assert hasattr(img, "resize")  # PIL object
+        arr2 = ptm.vision.image_load(p, backend="cv2")
+        assert isinstance(arr2, np.ndarray)
+        np.testing.assert_array_equal(arr2[..., ::-1], arr)  # BGR vs RGB
+        ptm.vision.set_image_backend("cv2")
+        try:
+            assert isinstance(ptm.vision.image_load(p), np.ndarray)
+        finally:
+            ptm.vision.set_image_backend("pil")
+        with pytest.raises(ValueError):
+            ptm.vision.set_image_backend("turbojpeg")

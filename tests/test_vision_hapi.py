@@ -174,10 +174,14 @@ def test_flowers_real_archive(tmp_path):
     test = pt.vision.datasets.Flowers(data_file=tgz, label_file=lab,
                                       setid_file=sid, mode="test")
     assert len(test) == 1 and int(test[0][1][0]) == 6  # trnid id 4
-    # pil backend returns a PIL image; bogus backend/mode raise
+    # pil backend returns a PIL image; cv2 returns BGR ndarray
     pil_ds = pt.vision.datasets.Flowers(data_file=tgz, label_file=lab,
                                         setid_file=sid, backend="pil")
     assert hasattr(pil_ds[0][0], "resize")
+    cv_ds = pt.vision.datasets.Flowers(data_file=tgz, label_file=lab,
+                                       setid_file=sid, backend="cv2")
+    assert isinstance(cv_ds[0][0], np.ndarray)
+    assert cv_ds[0][0].shape[-1] == 3
     with pytest.raises(ValueError):
         pt.vision.datasets.Flowers(synthetic=True, backend="cv")
     with pytest.raises(ValueError):
