@@ -18,12 +18,17 @@ from ...io.dataset import Dataset
 
 
 def _safe_extractall(tf, dst):
-    """extractall with the 3.12+ 'data' filter when available (the
-    filter= kwarg only exists from the 3.10.12/3.11.4 backports on)."""
-    try:
+    """extractall with the 'data' path-traversal filter; on Pythons
+    predating the filter= backport (3.10.12/3.11.4), validate members
+    manually instead of extracting unfiltered (fail-closed)."""
+    if hasattr(tarfile, "data_filter"):
         tf.extractall(dst, filter="data")
-    except TypeError:
-        tf.extractall(dst)
+        return
+    for m in tf.getmembers():
+        name = m.name
+        if name.startswith(("/", "\\")) or ".." in name.split("/"):
+            raise ValueError(f"unsafe tar member path: {name!r}")
+    tf.extractall(dst)
 
 __all__ = ["Cifar10", "Cifar100", "MNIST", "FashionMNIST", "FakeData",
            "DatasetFolder", "ImageFolder", "Flowers", "VOC2012"]

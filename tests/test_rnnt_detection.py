@@ -321,6 +321,7 @@ class TestYoloLoss:
                   use_label_smooth=False)
         return x, gt_box, gt_label, kw
 
+    @pytest.mark.slow
     def test_shape_and_finite(self):
         x, gtb, gtl, kw = self._setup()
         loss = V.yolo_loss(Tensor(x), Tensor(gtb), Tensor(gtl), **kw)

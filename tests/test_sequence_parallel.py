@@ -143,6 +143,7 @@ def test_ring_attention_kernel_path_matches_xla(causal):
                                atol=2e-4, rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_ring_attention_kernel_path_grads():
     b, h, s, d = 1, 1, 64, 16
     n = 4

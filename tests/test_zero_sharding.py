@@ -110,12 +110,14 @@ class TestZeroStage12:
         # biases (size 256/8) shard too where divisible; demand >=3x
         assert shard * 3 <= base, (shard, base)
 
-    @pytest.mark.parametrize("level", ["os", "os_g"])
+    @pytest.mark.parametrize("level", [
+    pytest.param("os", marks=pytest.mark.slow), "os_g"])
     def test_loss_parity_with_baseline(self, level):
         ref, _ = _train(None)
         got, _ = _train(level)
         assert np.allclose(ref, got, atol=1e-5), (ref, got)
 
+    @pytest.mark.slow
     def test_os_g_grad_constraint_compiles(self):
         # stage 2 runs and keeps state sharded across steps (donated
         # buffers must not silently re-replicate)
