@@ -25,12 +25,14 @@ from .framework import (  # noqa: F401
     dtype, iinfo, finfo, get_default_dtype, set_default_dtype,
     set_flags, get_flags,
     seed, get_rng_state, set_rng_state,
-    CPUPlace, TPUPlace, CUDAPlace, CustomPlace, XPUPlace,
+    CPUPlace, TPUPlace, CUDAPlace, CustomPlace, XPUPlace, CUDAPinnedPlace,
     set_device, get_device, device_count,
     is_compiled_with_cuda, is_compiled_with_rocm, is_compiled_with_xpu,
     is_compiled_with_tpu, is_compiled_with_cinn,
     is_compiled_with_custom_device,
 )
+from .framework.random import get_cuda_rng_state, set_cuda_rng_state  # noqa: F401
+from .framework.lazy_init import LazyGuard  # noqa: F401
 from .framework import (  # dtype singletons  # noqa: F401
     bool_, uint8, int8, int16, int32, int64, float16, bfloat16, float32,
     float64, complex64, complex128, float8_e4m3fn, float8_e5m2,
@@ -38,9 +40,16 @@ from .framework import (  # dtype singletons  # noqa: F401
 bool = bool_  # paddle.bool (shadows builtin inside this namespace only)
 
 # -- tensor + autograd ------------------------------------------------------
-from .tensor import Tensor, to_tensor, is_tensor  # noqa: F401
-from .autograd import no_grad, enable_grad, set_grad_enabled, grad  # noqa: F401
+from .tensor import Tensor, to_tensor, is_tensor, set_printoptions  # noqa: F401
+from .autograd import (no_grad, enable_grad, set_grad_enabled, grad,  # noqa: F401
+                       is_grad_enabled)
 from . import autograd  # noqa: F401
+
+
+def disable_signal_handler():
+    """Parity shim (ref ``framework.py disable_signal_handler``): the
+    reference unhooks its C++ fault handlers; this build installs none, so
+    there is nothing to disable."""
 
 # -- ops (flat namespace) ---------------------------------------------------
 from .ops import *  # noqa: F401,F403

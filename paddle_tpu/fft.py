@@ -12,7 +12,8 @@ from .tensor import Tensor
 __all__ = [
     "fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2",
     "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn", "fftfreq",
-    "rfftfreq", "fftshift", "ifftshift",
+    "rfftfreq", "fftshift", "ifftshift", "hfft2", "ihfft2", "hfftn",
+    "ihfftn",
 ]
 
 
@@ -74,3 +75,61 @@ def fftshift(x, axes=None, name=None):
 def ifftshift(x, axes=None, name=None):
     return unary(lambda d: jnp.fft.ifftshift(d, axes=axes), x,
                  name="ifftshift")
+
+
+def _hermitian_axes(d, s, axes):
+    """numpy/scipy axes defaulting: all dims when neither s nor axes is
+    given, the last len(s) dims when only s is."""
+    if axes is not None:
+        axes = tuple(axes)
+    elif s is not None:
+        axes = tuple(range(-len(s), 0))
+    else:
+        axes = tuple(range(-d.ndim, 0))
+    if s is not None and len(s) != len(axes):
+        raise ValueError("fft: s and axes must have the same length")
+    return axes
+
+
+def _hfftn_impl(d, s, axes, norm):
+    """Hermitian N-d FFT (ref ``fft.py:1123 hfftn``): full complex FFT over
+    the leading axes, Hermitian (real-output) FFT over the last. jnp has no
+    hfftn — compose it; separate-axis FFTs commute."""
+    axes = _hermitian_axes(d, s, axes)
+    if len(axes) > 1:
+        d = jnp.fft.fftn(d, s=tuple(s[:-1]) if s is not None else None,
+                         axes=axes[:-1], norm=norm)
+    return jnp.fft.hfft(d, n=s[-1] if s is not None else None,
+                        axis=axes[-1], norm=norm)
+
+
+def _ihfftn_impl(d, s, axes, norm):
+    axes = _hermitian_axes(d, s, axes)
+    out = jnp.fft.ihfft(d, n=s[-1] if s is not None else None,
+                        axis=axes[-1], norm=norm)
+    if len(axes) > 1:
+        out = jnp.fft.ifftn(out, s=tuple(s[:-1]) if s is not None else None,
+                            axes=axes[:-1], norm=norm)
+    return out
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    return unary(lambda d: _hfftn_impl(d, s, axes, _norm(norm)), x,
+                 name="hfftn")
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    return unary(lambda d: _ihfftn_impl(d, s, axes, _norm(norm)), x,
+                 name="ihfftn")
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    axes = tuple(axes) if axes is not None else None
+    return unary(lambda d: _hfftn_impl(d, s, axes, _norm(norm)), x,
+                 name="hfft2")
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    axes = tuple(axes) if axes is not None else None
+    return unary(lambda d: _ihfftn_impl(d, s, axes, _norm(norm)), x,
+                 name="ihfft2")

@@ -19,6 +19,7 @@ from .random import (  # noqa: F401
 )
 from .device import (  # noqa: F401
     Place, CPUPlace, TPUPlace, CUDAPlace, CustomPlace, XPUPlace,
+    CUDAPinnedPlace,
     set_device, get_device, get_all_devices, device_count,
     is_compiled_with_cuda, is_compiled_with_rocm, is_compiled_with_xpu,
     is_compiled_with_tpu, is_compiled_with_cinn,

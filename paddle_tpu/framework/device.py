@@ -19,6 +19,7 @@ import jax
 
 __all__ = [
     "Place", "CPUPlace", "TPUPlace", "CUDAPlace", "CustomPlace", "XPUPlace",
+    "CUDAPinnedPlace",
     "set_device", "get_device", "get_all_devices", "device_count",
     "is_compiled_with_cuda", "is_compiled_with_rocm", "is_compiled_with_xpu",
     "is_compiled_with_tpu", "is_compiled_with_cinn",
@@ -94,6 +95,15 @@ class CUDAPlace(TPUPlace):
 
 class XPUPlace(TPUPlace):
     pass
+
+
+class CUDAPinnedPlace(Place):
+    """Host staging-memory place. On TPU the analog of CUDA pinned memory
+    is the host side of the PJRT transfer path; kept for API parity."""
+    device_type = "cuda_pinned"
+
+    def __init__(self):
+        super().__init__(0)
 
 
 class CustomPlace(Place):

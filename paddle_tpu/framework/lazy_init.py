@@ -1,0 +1,32 @@
+"""Lazy parameter initialization (ref: ``python/paddle/fluid/lazy_init.py:91
+LazyGuard``).
+
+Under ``with LazyGuard():`` layer construction defers the (potentially
+expensive, device-touching) initializer: parameters are created with
+zero-filled host placeholders plus a recorded ``(initializer, shape, dtype)``
+closure, and ``param.initialize()`` runs the real init later. On TPU this
+matters at scale — constructing a model inside the guard performs no device
+allocation, so a sharded init (or a checkpoint load) can place parameters
+directly with their final sharding.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["LazyGuard", "lazy_init_active"]
+
+_tls = threading.local()
+
+
+def lazy_init_active() -> bool:
+    return getattr(_tls, "depth", 0) > 0
+
+
+class LazyGuard:
+    def __enter__(self):
+        _tls.depth = getattr(_tls, "depth", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _tls.depth -= 1
+        return False

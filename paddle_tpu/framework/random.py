@@ -180,3 +180,17 @@ _tracker = RNGStatesTracker()
 
 def get_tracker() -> RNGStatesTracker:
     return _tracker
+
+
+def get_cuda_rng_state():
+    """Parity shim (ref ``framework.py get_cuda_rng_state``): there are no
+    CUDA generators on this build — returns an empty list, the reference's
+    behavior on a CPU-only build."""
+    return []
+
+
+def set_cuda_rng_state(state_list):
+    """Parity shim: accepts and ignores an empty state list."""
+    if state_list:
+        raise ValueError(
+            "set_cuda_rng_state: no CUDA generators on a TPU build")

@@ -5,6 +5,7 @@ from .ops.linalg import (  # noqa: F401
     solve, triangular_solve, lstsq, matrix_power, matrix_rank, eig, eigh,
     eigvals, eigvalsh, pinv, cross, multi_dot, corrcoef, cov, einsum,
     householder_product, matrix_exp, vecdot, vector_norm, matrix_norm,
+    cdist,
 )
 
 inv = inverse

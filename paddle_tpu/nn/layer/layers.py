@@ -53,6 +53,42 @@ class ParamAttr:
         raise TypeError(f"Cannot interpret {attr!r} as ParamAttr")
 
 
+def make_parameter(shape, attr=None, dtype="float32", is_bias=False,
+                   default_initializer=None):
+    """Shared parameter factory behind ``Layer.create_parameter`` and the
+    standalone ``paddle.create_parameter``. Honors ``LazyGuard``: under the
+    guard the parameter holds a host-side numpy placeholder (NO device
+    allocation) and the initializer runs at ``Parameter.initialize()``."""
+    attr = ParamAttr._to_attr(attr)
+    if attr is None:
+        return None
+    init = attr.initializer or default_initializer or (
+        I.Constant(0.0) if is_bias else I.XavierNormal())
+    from ...framework.lazy_init import lazy_init_active
+    if lazy_init_active():
+        import numpy as _np
+        jdt = to_jax_dtype(dtype)
+        try:
+            ph_dtype = _np.dtype(jdt)  # bf16/fp16 work via ml_dtypes
+        except TypeError:
+            ph_dtype = _np.float32
+        p = Parameter(_np.zeros((), _np.float32), name=attr.name,
+                      trainable=attr.trainable)
+        # host placeholder, rebound after ctor so jnp.asarray never runs
+        # on the full shape (a model built under the guard must not touch
+        # device HBM)
+        p._data = _np.zeros(tuple(int(s) for s in shape), dtype=ph_dtype)
+        p._lazy = (init, tuple(int(s) for s in shape), jdt)
+    else:
+        data = init(shape, to_jax_dtype(dtype))
+        p = Parameter(data, name=attr.name, trainable=attr.trainable)
+    p.optimize_attr = {"learning_rate": attr.learning_rate}
+    p.regularizer = attr.regularizer
+    p.do_model_average = attr.do_model_average
+    p.need_clip = attr.need_clip if hasattr(attr, "need_clip") else True
+    return p
+
+
 class HookRemoveHelper:
     def __init__(self, hooks, hook_id):
         self._hooks = hooks
@@ -101,19 +137,10 @@ class Layer:
                          default_initializer=None):
         """ref: ``layers.py create_parameter`` — default init is Xavier for
         weights, zeros for bias, matching the reference's defaults."""
-        attr = ParamAttr._to_attr(attr)
-        if attr is None:
-            return None
-        dtype = dtype or self._dtype or "float32"
-        init = attr.initializer or default_initializer or (
-            I.Constant(0.0) if is_bias else I.XavierNormal())
-        data = init(shape, to_jax_dtype(dtype))
-        p = Parameter(data, name=attr.name, trainable=attr.trainable)
-        p.optimize_attr = {"learning_rate": attr.learning_rate}
-        p.regularizer = attr.regularizer
-        p.do_model_average = attr.do_model_average
-        p.need_clip = attr.need_clip if hasattr(attr, "need_clip") else True
-        return p
+        return make_parameter(shape, attr=attr,
+                              dtype=dtype or self._dtype or "float32",
+                              is_bias=is_bias,
+                              default_initializer=default_initializer)
 
     def add_parameter(self, name, parameter):
         if parameter is not None and not isinstance(parameter, Parameter):

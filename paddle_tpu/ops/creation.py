@@ -18,6 +18,7 @@ __all__ = [
     "randint", "randint_like", "randperm", "uniform", "normal",
     "standard_normal", "bernoulli", "multinomial", "poisson", "exponential_",
     "uniform_", "normal_", "complex", "polar", "as_tensor",
+    "create_parameter", "check_shape",
 ]
 
 
@@ -288,3 +289,37 @@ def polar(abs, angle, name=None):
 
 def as_tensor(data, dtype=None, place=None):
     return to_tensor(data, dtype=dtype, place=place)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Standalone parameter factory (ref: ``tensor/creation.py
+    create_parameter``): Xavier-normal weights / zero biases by default,
+    honoring ``ParamAttr`` and ``LazyGuard`` (same path as
+    ``Layer.create_parameter``)."""
+    from ..nn.layer.layers import make_parameter, ParamAttr
+    if attr is None and name is not None:
+        attr = ParamAttr(name=name)
+    return make_parameter(_shape(shape), attr=attr, dtype=dtype,
+                          is_bias=is_bias,
+                          default_initializer=default_initializer)
+
+
+def check_shape(shape):
+    """Validate a shape argument before creation ops (ref:
+    ``utils/layers_utils.py:463``)."""
+    if isinstance(shape, Tensor):
+        if np.dtype(shape._data.dtype).kind not in "iu":
+            raise TypeError("shape tensor must be int32/int64")
+        return
+    for ele in shape:
+        if isinstance(ele, Tensor):
+            continue
+        if not isinstance(ele, (int, np.integer)):
+            raise TypeError(
+                "All elements in `shape` must be integers when it's a "
+                "list or tuple")
+        if ele < 0:
+            raise ValueError(
+                "All elements in `shape` must be positive when it's a "
+                "list or tuple")

@@ -12,7 +12,7 @@ __all__ = [
     "less_equal", "equal_all", "allclose", "isclose", "logical_and",
     "logical_or", "logical_xor", "logical_not", "bitwise_and", "bitwise_or",
     "bitwise_xor", "bitwise_not", "bitwise_left_shift", "bitwise_right_shift",
-    "is_empty", "is_tensor",
+    "is_empty", "is_tensor", "is_complex", "is_integer", "is_floating_point",
 ]
 
 
@@ -102,3 +102,21 @@ def is_empty(x, name=None):
 
 def is_tensor(x):
     return isinstance(x, Tensor)
+
+
+def _kind(x):
+    return np.dtype(ensure_tensor(x)._data.dtype).kind
+
+
+def is_complex(x):
+    """ref: ``tensor/attribute.py is_complex`` — host-side dtype predicate."""
+    return _kind(x) == "c"
+
+
+def is_integer(x):
+    return _kind(x) in "iu"
+
+
+def is_floating_point(x):
+    d = ensure_tensor(x)._data.dtype
+    return _kind(x) == "f" or d == jnp.bfloat16

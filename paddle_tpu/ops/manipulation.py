@@ -26,6 +26,7 @@ __all__ = [
     "atleast_2d", "atleast_3d", "tensordot", "flatten_", "masked_fill",
     "masked_select", "masked_scatter", "where", "tolist", "numel", "rank",
     "shard_index", "tensor_split", "unflatten", "as_strided", "unfold",
+    "reverse", "shape",
 ]
 
 
@@ -646,3 +647,16 @@ def _setitem(x, idx, value):
         out = nary(lambda d: d.at[nidx].set(val), [x], name="setitem")
     _rebind(x, out)
     return x
+
+
+def reverse(x, axis, name=None):
+    """Reverse along ``axis`` (legacy alias of ``flip``; ref
+    ``tensor/manipulation.py reverse``)."""
+    return flip(x, axis)
+
+
+def shape(input, name=None):
+    """Shape of ``input`` as an int32 Tensor (ref:
+    ``tensor/attribute.py:59``). Shapes are static under XLA, so this is a
+    host-side constant — no kernel launch."""
+    return Tensor(jnp.asarray(ensure_tensor(input).shape, dtype=jnp.int32))

@@ -33,6 +33,8 @@ __all__ = [
     "i0", "i0e", "i1", "i1e", "polygamma", "multiply_", "add_", "subtract_",
     "divide_", "clip_", "scale_", "floor_", "ceil_", "exp_", "sqrt_",
     "reciprocal_", "round_", "rsqrt_", "sigmoid_", "tanh_", "logaddexp",
+    "floor_mod", "pow_", "addmm", "addmm_", "diff", "trapezoid",
+    "cumulative_trapezoid", "vander", "multiplex", "broadcast_shape",
 ]
 
 
@@ -476,6 +478,110 @@ def take(x, index, mode="raise", name=None):
                 [x, ensure_tensor(index)], name="take")
 
 
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """``beta*input + alpha*(x @ y)`` (ref: ``tensor/math.py addmm``) —
+    one fused XLA dot+axpy, MXU-shaped."""
+    return nary(lambda i, a, b: beta * i + alpha * jnp.matmul(a, b),
+                [input, x, y], name="addmm")
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    """n-th forward difference along ``axis`` (ref: ``tensor/math.py
+    diff``)."""
+    args = [x]
+    has_pre = prepend is not None
+    has_app = append is not None
+    if has_pre:
+        args.append(ensure_tensor(prepend))
+    if has_app:
+        args.append(ensure_tensor(append))
+
+    def f(d, *extra):
+        pre = extra[0] if has_pre else None
+        app = extra[-1] if has_app else None
+        return jnp.diff(d, n=n, axis=axis, prepend=pre, append=app)
+
+    return nary(f, args, name="diff")
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """Trapezoidal-rule integral (ref: ``tensor/math.py trapezoid``)."""
+    if x is not None and dx is not None:
+        raise ValueError("Not permitted to provide x and dx input together.")
+    if x is not None:
+        return nary(lambda yd, xd: jax.scipy.integrate.trapezoid(
+            yd, x=xd, axis=axis), [y, ensure_tensor(x)], name="trapezoid")
+    step = 1.0 if dx is None else dx
+    return _unary(lambda yd: jax.scipy.integrate.trapezoid(
+        yd, dx=step, axis=axis), y, name="trapezoid")
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """Cumulative trapezoidal integral (ref: ``tensor/math.py
+    cumulative_trapezoid``): output has size-1 shorter ``axis``."""
+    if x is not None and dx is not None:
+        raise ValueError("Not permitted to provide x and dx input together.")
+
+    def _cum(yd, spacing):
+        lo = jax.lax.slice_in_dim(yd, 0, yd.shape[axis] - 1, axis=axis)
+        hi = jax.lax.slice_in_dim(yd, 1, yd.shape[axis], axis=axis)
+        return jnp.cumsum((lo + hi) * 0.5 * spacing, axis=axis)
+
+    if x is not None:
+        def f(yd, xd):
+            if xd.ndim == 1:
+                shape = [1] * yd.ndim
+                shape[axis] = xd.shape[0]
+                xd = xd.reshape(shape)
+            return _cum(yd, jnp.diff(xd, axis=axis))
+        return nary(f, [y, ensure_tensor(x)], name="cumulative_trapezoid")
+    step = 1.0 if dx is None else dx
+    return _unary(lambda yd: _cum(yd, step), y,
+                  name="cumulative_trapezoid")
+
+
+def vander(x, n=None, increasing=False, name=None):
+    """Vandermonde matrix (ref: ``tensor/math.py vander``)."""
+    return _unary(lambda d: jnp.vander(
+        d, N=n, increasing=increasing), x, name="vander")
+
+
+def multiplex(inputs, index, name=None):
+    """Row-wise select across m stacked inputs: ``out[i] =
+    inputs[index[i]][i]`` (ref: ``tensor/math.py multiplex :308``).
+    TPU design: one stack + one batched gather instead of the reference's
+    dedicated CUDA kernel."""
+    idx = ensure_tensor(index)
+    if not isinstance(idx._data, jax.core.Tracer):
+        # eager: validate up front — XLA gather clamps OOB indices, which
+        # would turn a corrupt index tensor into plausible wrong data
+        iv = np.asarray(idx._data)
+        mx = int(np.max(iv)) if idx.size else 0
+        mn = int(np.min(iv)) if idx.size else 0
+        if mx >= len(inputs) or mn < 0:
+            raise ValueError(
+                f"multiplex: index values must be in [0, {len(inputs)}) "
+                f"but found {mn if mn < 0 else mx}")
+
+    def f(*ds):
+        sel = ds[-1].reshape(-1).astype(jnp.int32)
+        stacked = jnp.stack(ds[:-1])          # (m, M, ...)
+        rows = jnp.arange(sel.shape[0])
+        return stacked[sel, rows]
+
+    return nary(f, list(inputs) + [idx], name="multiplex")
+
+
+def broadcast_shape(x_shape, y_shape):
+    """Broadcast result shape of two shapes (ref: ``tensor/math.py
+    broadcast_shape :4189``). Pure host computation — shapes are static
+    under XLA."""
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+floor_mod = mod
+
+
 # ---- in-place variants (rebind data) --------------------------------------
 def _make_inplace(fn):
     def op(x, *args, **kwargs):
@@ -507,4 +613,6 @@ rsqrt_ = _make_inplace(rsqrt)
 reciprocal_ = _make_inplace(reciprocal)
 round_ = _make_inplace(round)
 sigmoid_ = _make_inplace(sigmoid)
+pow_ = _make_inplace(pow)
+addmm_ = _make_inplace(addmm)
 tanh_ = _make_inplace(tanh)

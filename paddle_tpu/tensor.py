@@ -159,8 +159,9 @@ class Tensor:
 
     def __repr__(self):
         grad_info = "" if self.stop_gradient else ", stop_gradient=False"
-        return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}"
-                f"{grad_info},\n       {np.asarray(self._data)!r})")
+        with np.printoptions(**_np_print_kwargs()):
+            return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}"
+                    f"{grad_info},\n       {np.asarray(self._data)!r})")
 
     def __hash__(self):
         return id(self)
@@ -314,7 +315,7 @@ class Parameter(Tensor):
     """
 
     __slots__ = ("optimize_attr", "regularizer", "do_model_average",
-                 "is_distributed", "need_clip")
+                 "is_distributed", "need_clip", "_lazy")
 
     def __init__(self, data, dtype=None, name=None, trainable=True):
         super().__init__(data, dtype=dtype, stop_gradient=not trainable,
@@ -326,10 +327,60 @@ class Parameter(Tensor):
         self.do_model_average = None
         self.is_distributed = False
 
+    def initialize(self):
+        """Run the deferred initializer recorded under ``LazyGuard``
+        (ref: ``fluid/lazy_init.py``). No-op for eagerly-created params."""
+        lazy = getattr(self, "_lazy", None)
+        if lazy is not None:
+            init, shape, jdt = lazy
+            self._data = jnp.asarray(init(list(shape), jdt))
+            self._lazy = None
+        return self
+
     def __repr__(self):
-        return (f"Parameter(name={self.name}, shape={self.shape}, "
-                f"dtype={self.dtype.name}, trainable={self.trainable},\n"
-                f"       {np.asarray(self._data)!r})")
+        with np.printoptions(**_np_print_kwargs()):
+            return (f"Parameter(name={self.name}, shape={self.shape}, "
+                    f"dtype={self.dtype.name}, trainable={self.trainable},\n"
+                    f"       {np.asarray(self._data)!r})")
+
+
+_print_options: dict = {}
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Tensor print formatting (ref: ``tensor/to_string.py
+    set_printoptions``). Applied inside ``Tensor.__repr__`` only — numpy's
+    global state is left alone."""
+    if precision is not None:
+        _print_options["precision"] = int(precision)
+    if threshold is not None:
+        _print_options["threshold"] = int(threshold)
+    if edgeitems is not None:
+        _print_options["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        _print_options["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        _print_options["sci_mode"] = _builtins_bool(sci_mode)
+
+
+_builtins_bool = bool
+
+
+def _np_print_kwargs() -> dict:
+    """Translate the paddle-style options into np.printoptions kwargs.
+    sci_mode=True needs an explicit float formatter — numpy's
+    ``suppress=False`` is the default and cannot *force* scientific."""
+    kw = {k: v for k, v in _print_options.items() if k != "sci_mode"}
+    sci = _print_options.get("sci_mode")
+    if sci is True:
+        prec = _print_options.get("precision", 8)
+        kw["formatter"] = {
+            "float_kind": lambda v: np.format_float_scientific(
+                v, precision=prec)}
+    elif sci is False:
+        kw["suppress"] = True
+    return kw
 
 
 def is_tensor(x) -> bool:

@@ -1,0 +1,160 @@
+"""Round-4 API long tail: multiplex, attribute predicates, LazyGuard,
+printoptions, hermitian FFTs (ref: ``python/paddle/__init__.py __all__``,
+``python/paddle/fft.py:1123``)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.fft as ptf
+from paddle_tpu import Tensor
+
+scipy_fft = pytest.importorskip("scipy.fft")
+
+
+def test_multiplex_rows():
+    ins = [pt.to_tensor(np.full((4, 3), i, "float32")) for i in range(3)]
+    idx = pt.to_tensor(np.array([[2], [0], [1], [0]], "int32"))
+    out = pt.multiplex(ins, idx).numpy()
+    np.testing.assert_allclose(out[:, 0], [2, 0, 1, 0])
+
+
+def test_multiplex_grad():
+    a = Tensor(np.ones((2, 3), np.float32), stop_gradient=False)
+    b = Tensor(np.ones((2, 3), np.float32) * 2, stop_gradient=False)
+    idx = pt.to_tensor(np.array([[0], [1]], "int32"))
+    out = pt.multiplex([a, b], idx)
+    pt.sum(out).backward()
+    # row 0 comes from a, row 1 from b
+    np.testing.assert_allclose(np.asarray(a.grad._data),
+                               [[1, 1, 1], [0, 0, 0]])
+    np.testing.assert_allclose(np.asarray(b.grad._data),
+                               [[0, 0, 0], [1, 1, 1]])
+
+
+def test_shape_and_predicates():
+    x = pt.to_tensor(np.zeros((2, 3), "float32"))
+    np.testing.assert_array_equal(pt.shape(x).numpy(), [2, 3])
+    assert pt.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+    assert pt.is_floating_point(x)
+    assert not pt.is_integer(x)
+    assert pt.is_integer(pt.to_tensor(np.array([1], "int32")))
+    assert pt.is_complex(pt.to_tensor(np.array([1 + 2j], "complex64")))
+    bf = pt.to_tensor(np.zeros(2, "float32")).astype("bfloat16")
+    assert pt.is_floating_point(bf)
+
+
+def test_check_shape():
+    pt.check_shape([2, 3])
+    with pytest.raises(ValueError):
+        pt.check_shape([2, -3])
+    with pytest.raises(TypeError):
+        pt.check_shape([2, 3.5])
+
+
+def test_create_parameter():
+    p = pt.create_parameter([3, 4], "float32")
+    assert type(p).__name__ == "Parameter" and p.shape == [3, 4]
+    assert float(np.abs(p.numpy()).sum()) > 0  # xavier, not zeros
+
+
+def test_lazy_guard_defers_init():
+    import paddle_tpu.nn as nn
+    with pt.LazyGuard():
+        fc = nn.Linear(8, 8)
+    # under the guard: host numpy placeholder, no device array
+    assert isinstance(fc.weight._data, np.ndarray)
+    assert float(np.abs(fc.weight.numpy()).sum()) == 0.0
+    fc.weight.initialize()
+    assert not isinstance(fc.weight._data, np.ndarray)
+    assert float(np.abs(fc.weight.numpy()).sum()) > 0
+    # bias initializer is zeros either way; initialize() is a no-op after
+    fc.weight.initialize()
+
+
+def test_lazy_guard_standalone_create_parameter():
+    with pt.LazyGuard():
+        p = pt.create_parameter([4, 4], "float32")
+    assert isinstance(p._data, np.ndarray)
+    p.initialize()
+    assert float(np.abs(p.numpy()).sum()) > 0
+
+
+def test_trapezoid_x_dx_conflict():
+    y = pt.to_tensor(np.ones((3,), "float32"))
+    with pytest.raises(ValueError):
+        pt.trapezoid(y, x=y, dx=1.0)
+    with pytest.raises(ValueError):
+        pt.cumulative_trapezoid(y, x=y, dx=1.0)
+
+
+def test_multiplex_oob_index():
+    ins = [pt.to_tensor(np.ones((2, 3), "float32"))] * 2
+    with pytest.raises(ValueError):
+        pt.multiplex(ins, pt.to_tensor(np.array([[5], [0]], "int32")))
+
+
+def test_hfftn_s_defaults_axes():
+    rng = np.random.RandomState(2)
+    a = (rng.rand(2, 3, 5) + 1j * rng.rand(2, 3, 5)).astype("complex64")
+    np.testing.assert_allclose(
+        ptf.hfftn(pt.to_tensor(a), s=[4, 6]).numpy(),
+        scipy_fft.hfftn(a, s=[4, 6]), atol=1e-3, rtol=1e-3)
+
+
+def test_sci_mode_printoptions():
+    pt.set_printoptions(precision=3, sci_mode=True)
+    s = repr(pt.to_tensor(np.array([1.5], "float32")))
+    assert "e+00" in s
+    pt.set_printoptions(sci_mode=False)
+    s2 = repr(pt.to_tensor(np.array([1.5], "float32")))
+    assert "e+00" not in s2
+    # Parameter honors the same options
+    p = pt.create_parameter([2], "float32")
+    pt.set_printoptions(sci_mode=True)
+    assert "e" in repr(p)
+    pt.set_printoptions(sci_mode=False, precision=8)
+
+
+def test_set_printoptions_scoped():
+    pt.set_printoptions(precision=2)
+    s = repr(pt.to_tensor(np.array([1.23456789], "float32")))
+    assert "1.23" in s and "1.2345" not in s
+    # numpy's own global state must be untouched
+    assert np.get_printoptions()["precision"] == 8
+    pt.set_printoptions(precision=8)
+
+
+def test_cuda_parity_shims():
+    assert pt.get_cuda_rng_state() == []
+    pt.set_cuda_rng_state([])
+    with pytest.raises(ValueError):
+        pt.set_cuda_rng_state([1])
+    pt.disable_signal_handler()
+    assert pt.CUDAPinnedPlace() is not None
+
+
+@pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+def test_hfft2_ihfft2_vs_scipy(norm):
+    rng = np.random.RandomState(0)
+    a = (rng.rand(3, 5) + 1j * rng.rand(3, 5)).astype("complex64")
+    np.testing.assert_allclose(
+        ptf.hfft2(pt.to_tensor(a), norm=norm).numpy(),
+        scipy_fft.hfft2(a, norm=norm), atol=1e-3, rtol=1e-3)
+    r = rng.rand(4, 8).astype("float32")
+    np.testing.assert_allclose(
+        ptf.ihfft2(pt.to_tensor(r), norm=norm).numpy(),
+        scipy_fft.ihfft2(r, norm=norm), atol=1e-5, rtol=1e-4)
+
+
+def test_hfftn_ihfftn_with_s():
+    rng = np.random.RandomState(1)
+    a = (rng.rand(3, 5) + 1j * rng.rand(3, 5)).astype("complex64")
+    np.testing.assert_allclose(
+        ptf.hfftn(pt.to_tensor(a), s=[4, 6]).numpy(),
+        scipy_fft.hfftn(a, s=[4, 6]), atol=1e-3, rtol=1e-3)
+    r = rng.rand(4, 8).astype("float32")
+    np.testing.assert_allclose(
+        ptf.ihfftn(pt.to_tensor(r), s=[3, 6]).numpy(),
+        scipy_fft.ihfftn(r, s=[3, 6]), atol=1e-5, rtol=1e-4)
+    with pytest.raises(ValueError):
+        ptf.hfftn(pt.to_tensor(a), s=[4], axes=(0, 1))

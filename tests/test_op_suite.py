@@ -256,6 +256,37 @@ OPS += [
          [x[0, :, i:i + 3, j:j + 3].reshape(-1)
           for i in range(2) for j in range(2)], -1)[None],
      [_sp(1, 2, 4, 4)], {"grad": False}),
+    # -- round-4 long tail --------------------------------------------------
+    ("addmm", lambda i, a, b: pt.addmm(i, a, b, beta=0.5, alpha=2.0),
+     lambda i, a, b: 0.5 * i + 2.0 * (a @ b),
+     [_sp(2, 5), _sp(2, 3), _sp(3, 5, seed=1)],
+     {"bf16_atol": 5e-2, "bf16_rtol": 5e-2}),
+    ("diff", pt.diff, lambda x: np.diff(x), [_sp(3, 5)], {}),
+    ("diff_n2_ax0", lambda x: pt.diff(x, n=2, axis=0),
+     lambda x: np.diff(x, n=2, axis=0), [_sp(4, 3)], {}),
+    ("trapezoid", pt.trapezoid,
+     lambda y: np.trapz(y, axis=-1), [_sp(3, 5)], {}),
+    ("trapezoid_x", pt.trapezoid,
+     lambda y, x: np.trapz(y, x=np.sort(x), axis=-1),
+     [_sp(3, 5), np.sort(_sp(5, seed=3))], {"grad": False}),
+    ("cumulative_trapezoid", pt.cumulative_trapezoid,
+     lambda y: np.stack([np.cumsum((y[..., :-1] + y[..., 1:]) * 0.5,
+                                   axis=-1)])[0],
+     [_sp(3, 5)], {}),
+    ("vander", lambda x: pt.vander(x, n=4),
+     lambda x: np.vander(x, N=4), [_sp(5, lo=0.5, hi=2.0)],
+     {"grad": False, "atol": 1e-4, "rtol": 1e-4, "bf16_atol": 2e-1,
+      "bf16_rtol": 2e-1}),
+    ("cdist", pt.linalg.cdist,
+     lambda a, b: np.sqrt(((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)),
+     [_sp(4, 3), _sp(5, 3, seed=1)],
+     {"atol": 1e-4, "rtol": 1e-4, "grad_atol": 2e-2,
+      "bf16_atol": 1e-1, "bf16_rtol": 1e-1}),
+    ("cdist_p1", lambda a, b: pt.linalg.cdist(a, b, p=1.0),
+     lambda a, b: np.abs(a[:, None, :] - b[None, :, :]).sum(-1),
+     [_sp(4, 3), _sp(5, 3, seed=1)], {"grad": False}),
+    ("reverse", lambda x: pt.reverse(x, [0]),
+     lambda x: x[::-1], [_sp(3, 4)], {}),
 ]
 
 _IDS = [row[0] for row in OPS]
