@@ -311,6 +311,9 @@ def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
     ``|x|^2 + |y|^2 - 2 x.y^T`` form — one MXU matmul instead of an
     O(P*R*M) broadcast — unless the caller forces the naive path.
     """
+    if p < 0:
+        raise ValueError("cdist only supports non-negative p values")
+
     def f(a, b):
         if p == 2.0 and compute_mode != "donot_use_mm_for_euclid_dist":
             x2 = jnp.sum(a * a, axis=-1)[..., :, None]

@@ -552,6 +552,11 @@ def multiplex(inputs, index, name=None):
     TPU design: one stack + one batched gather instead of the reference's
     dedicated CUDA kernel."""
     idx = ensure_tensor(index)
+    rows = ensure_tensor(inputs[0]).shape[0] if len(inputs) else 0
+    if int(np.prod(idx.shape)) != rows:
+        raise ValueError(
+            f"multiplex: index must have one entry per row "
+            f"({rows}), got shape {idx.shape}")
     if not isinstance(idx._data, jax.core.Tracer):
         # eager: validate up front — XLA gather clamps OOB indices, which
         # would turn a corrupt index tensor into plausible wrong data

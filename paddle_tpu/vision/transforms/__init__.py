@@ -11,13 +11,21 @@ import numpy as np
 
 from ...tensor import Tensor
 
+from . import functional  # noqa: F401
+from .functional import (  # noqa: F401
+    pad, affine, rotate, perspective, to_grayscale, adjust_brightness,
+    adjust_contrast, adjust_saturation, adjust_hue, erase)
+
 __all__ = ["Compose", "ToTensor", "Normalize", "Resize", "RandomCrop",
            "CenterCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
            "RandomResizedCrop", "Transpose", "Pad", "BrightnessTransform",
            "ContrastTransform", "SaturationTransform", "HueTransform",
            "ColorJitter", "RandomRotation", "Grayscale", "BaseTransform",
+           "RandomAffine", "RandomPerspective", "RandomErasing",
            "to_tensor", "normalize", "resize", "hflip", "vflip", "crop",
-           "center_crop"]
+           "center_crop", "pad", "affine", "rotate", "perspective",
+           "to_grayscale", "adjust_brightness", "adjust_contrast",
+           "adjust_saturation", "adjust_hue", "erase"]
 
 
 class BaseTransform:
@@ -259,17 +267,13 @@ class Transpose(BaseTransform):
 class Pad(BaseTransform):
     def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
         super().__init__(keys)
-        p = padding if isinstance(padding, (list, tuple)) else [padding] * 4
-        if len(p) == 2:
-            p = [p[0], p[1], p[0], p[1]]
-        self.padding = p
+        self.padding = padding
         self.fill = fill
+        self.padding_mode = padding_mode
 
     def _apply_image(self, img):
-        img = _as_hwc(img)
-        p = self.padding
-        return np.pad(img, [(p[1], p[3]), (p[0], p[2]), (0, 0)],
-                      constant_values=self.fill)
+        return pad(img, self.padding, fill=self.fill,
+                   padding_mode=self.padding_mode)
 
 
 class BrightnessTransform(BaseTransform):
@@ -316,17 +320,12 @@ class SaturationTransform(BaseTransform):
 class HueTransform(BaseTransform):
     def __init__(self, value, keys=None):
         super().__init__(keys)
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value should be in [0, 0.5]")
         self.value = value
 
     def _apply_image(self, img):
-        # cheap approximation: channel roll-mix
-        img = _as_hwc(img)
-        f = pyrandom.uniform(-self.value, self.value)
-        out = img.astype(np.float32)
-        rolled = np.roll(out, 1, axis=2)
-        out = out * (1 - abs(f)) + rolled * abs(f)
-        return np.clip(out, 0, 255).astype(img.dtype) \
-            if img.dtype == np.uint8 else out
+        return adjust_hue(img, pyrandom.uniform(-self.value, self.value))
 
 
 class ColorJitter(BaseTransform):
@@ -356,14 +355,17 @@ class RandomRotation(BaseTransform):
                  center=None, fill=0, keys=None):
         super().__init__(keys)
         self.degrees = (-degrees, degrees) if isinstance(
-            degrees, numbers.Number) else degrees
+            degrees, numbers.Number) else tuple(degrees)
+        self.interpolation = interpolation
+        self.expand = expand
+        self.center = center
+        self.fill = fill
 
     def _apply_image(self, img):
-        # right-angle rotations only (exact, no scipy dependency)
-        img = _as_hwc(img)
         angle = pyrandom.uniform(*self.degrees)
-        k = int(round(angle / 90.0)) % 4
-        return np.rot90(img, k=k, axes=(0, 1)).copy()
+        return rotate(img, angle, interpolation=self.interpolation,
+                      expand=self.expand, center=self.center,
+                      fill=self.fill)
 
 
 class Grayscale(BaseTransform):
@@ -378,3 +380,163 @@ class Grayscale(BaseTransform):
         if self.num_output_channels == 3:
             gray = np.repeat(gray, 3, axis=2)
         return gray
+
+
+class RandomAffine(BaseTransform):
+    """Random affine: rotation/translate/scale/shear sampled per call
+    (ref ``transforms.py:1385 RandomAffine``)."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) if isinstance(
+            degrees, numbers.Number) else tuple(degrees)
+        if translate is not None:
+            for t in translate:
+                if not 0.0 <= t <= 1.0:
+                    raise ValueError(
+                        "translation values should be between 0 and 1")
+        self.translate = translate
+        if scale is not None and any(s <= 0 for s in scale):
+            raise ValueError("scale values should be positive")
+        self.scale = scale
+        if isinstance(shear, numbers.Number):
+            shear = (-shear, shear)
+        self.shear = tuple(shear) if shear is not None else None
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def _get_param(self, img_size):
+        w, h = img_size
+        angle = pyrandom.uniform(*self.degrees)
+        if self.translate is not None:
+            max_dx = self.translate[0] * w
+            max_dy = self.translate[1] * h
+            tx = int(round(pyrandom.uniform(-max_dx, max_dx)))
+            ty = int(round(pyrandom.uniform(-max_dy, max_dy)))
+        else:
+            tx = ty = 0
+        scale = pyrandom.uniform(*self.scale) if self.scale else 1.0
+        if self.shear is not None:
+            sx = pyrandom.uniform(self.shear[0], self.shear[1])
+            sy = pyrandom.uniform(*self.shear[2:4]) \
+                if len(self.shear) == 4 else 0.0
+        else:
+            sx = sy = 0.0
+        return angle, (tx, ty), scale, (sx, sy)
+
+    def _apply_image(self, img):
+        arr = _as_hwc(img)
+        h, w = arr.shape[:2]
+        angle, translate, scale, shear = self._get_param((w, h))
+        return affine(img, angle, translate=translate, scale=scale,
+                      shear=shear, interpolation=self.interpolation,
+                      fill=self.fill, center=self.center)
+
+
+class RandomPerspective(BaseTransform):
+    """Random four-corner perspective distortion
+    (ref ``transforms.py:1836 RandomPerspective``)."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        if not 0 <= prob <= 1:
+            raise ValueError("prob must be in [0, 1]")
+        if not 0 <= distortion_scale <= 1:
+            raise ValueError("distortion_scale must be in [0, 1]")
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _get_param(self, width, height):
+        d = self.distortion_scale
+        half_w, half_h = width // 2, height // 2
+        tl = (pyrandom.randint(0, int(d * half_w)),
+              pyrandom.randint(0, int(d * half_h)))
+        tr = (width - 1 - pyrandom.randint(0, int(d * half_w)),
+              pyrandom.randint(0, int(d * half_h)))
+        br = (width - 1 - pyrandom.randint(0, int(d * half_w)),
+              height - 1 - pyrandom.randint(0, int(d * half_h)))
+        bl = (pyrandom.randint(0, int(d * half_w)),
+              height - 1 - pyrandom.randint(0, int(d * half_h)))
+        start = [(0, 0), (width - 1, 0), (width - 1, height - 1),
+                 (0, height - 1)]
+        return start, [tl, tr, br, bl]
+
+    def _apply_image(self, img):
+        if pyrandom.random() >= self.prob:
+            return img
+        arr = _as_hwc(img)
+        h, w = arr.shape[:2]
+        start, end = self._get_param(w, h)
+        return perspective(img, start, end,
+                           interpolation=self.interpolation,
+                           fill=self.fill)
+
+
+class RandomErasing(BaseTransform):
+    """Random rectangle erasure, the Zhong et al. augmentation
+    (ref ``transforms.py RandomErasing``); runs after ToTensor in the
+    reference recipes, so CHW Tensors and HWC arrays both work."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        if not (isinstance(scale, (tuple, list)) and len(scale) == 2):
+            raise TypeError("scale should be a tuple or list of length 2")
+        if not 0 <= scale[0] <= scale[1] <= 1:
+            raise ValueError("scale should be of kind (min, max) in [0,1]")
+        if ratio[0] > ratio[1]:
+            raise ValueError("ratio should be of kind (min, max)")
+        if not isinstance(value, (numbers.Number, str, tuple, list)):
+            raise TypeError("value must be a number, 'random', or sequence")
+        if isinstance(value, str) and value != "random":
+            raise ValueError("value must be 'random' when str")
+        self.prob = prob
+        self.scale = tuple(scale)
+        self.ratio = tuple(ratio)
+        self.value = value
+        self.inplace = inplace
+
+    def _get_param(self, h, w, c):
+        import math
+        area = h * w
+        for _ in range(10):
+            target = pyrandom.uniform(*self.scale) * area
+            ar = math.exp(pyrandom.uniform(math.log(self.ratio[0]),
+                                           math.log(self.ratio[1])))
+            eh = int(round(math.sqrt(target / ar)))
+            ew = int(round(math.sqrt(target * ar)))
+            if eh < h and ew < w and eh > 0 and ew > 0:
+                i = pyrandom.randint(0, h - eh)
+                j = pyrandom.randint(0, w - ew)
+                if self.value == "random":
+                    v = np.random.normal(size=(eh, ew, c)).astype(np.float32)
+                elif isinstance(self.value, (tuple, list)):
+                    v = np.asarray(self.value, np.float32).reshape(1, 1, c)
+                    v = np.broadcast_to(v, (eh, ew, c))
+                else:
+                    v = self.value
+                return i, j, eh, ew, v
+        return None
+
+    def _apply_image(self, img):
+        if pyrandom.random() >= self.prob:
+            return img
+        chw_tensor = isinstance(img, Tensor) and img.ndim == 3
+        if chw_tensor:
+            c, h, w = img.shape
+        else:
+            arr = _as_hwc(img)
+            h, w, c = arr.shape
+            img = arr
+        param = self._get_param(h, w, c)
+        if param is None:
+            return img
+        i, j, eh, ew, v = param
+        if chw_tensor and not np.isscalar(v):
+            v = np.transpose(v, (2, 0, 1))  # CHW region fill
+        return erase(img, i, j, eh, ew, v, inplace=self.inplace)

@@ -1,0 +1,336 @@
+"""Functional image transforms (ref: ``python/paddle/vision/transforms/
+functional.py``; geometric kernels ``functional_pil.py`` /
+``functional_cv2.py``).
+
+Numpy/HWC implementations: one inverse-mapping warp engine drives affine /
+rotate / perspective (the reference delegates to PIL's ``Image.transform``
+with the same inverse matrices). Host-side by design — augmentation runs in
+dataloader workers on CPU, keeping the TPU step graph static-shaped.
+"""
+from __future__ import annotations
+
+import math
+import numbers
+
+import numpy as np
+
+from ...tensor import Tensor
+
+__all__ = ["pad", "affine", "rotate", "perspective", "to_grayscale",
+           "adjust_brightness", "adjust_contrast", "adjust_saturation",
+           "adjust_hue", "erase"]
+
+
+def _as_hwc(img):
+    unwrap = isinstance(img, Tensor)
+    arr = np.asarray(img._data) if unwrap else np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return arr
+
+
+def _restore(out, img):
+    if isinstance(img, Tensor):
+        return Tensor(out)
+    return out
+
+
+def _clip_like(out, ref_dtype):
+    if ref_dtype == np.uint8:
+        return np.clip(np.round(out), 0, 255).astype(np.uint8)
+    return out.astype(np.float32)
+
+
+# -- pad --------------------------------------------------------------------
+_PAD_MODES = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+              "symmetric": "symmetric"}
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    """Pad on all sides (ref ``functional.py pad``): padding is int,
+    (left/right, top/bottom) or (left, top, right, bottom)."""
+    arr = _as_hwc(img)
+    if isinstance(padding, numbers.Number):
+        p = [int(padding)] * 4
+    else:
+        p = [int(v) for v in padding]
+        if len(p) == 2:
+            p = [p[0], p[1], p[0], p[1]]
+    if padding_mode not in _PAD_MODES:
+        raise ValueError(f"padding_mode must be one of {list(_PAD_MODES)}")
+    widths = [(p[1], p[3]), (p[0], p[2]), (0, 0)]
+    if padding_mode == "constant":
+        if isinstance(fill, (tuple, list)):
+            # per-channel fill: pad each channel plane separately
+            out = np.stack([
+                np.pad(arr[..., ci], widths[:2], constant_values=fv)
+                for ci, fv in enumerate(fill)], axis=2)
+        else:
+            out = np.pad(arr, widths, constant_values=fill)
+    else:
+        out = np.pad(arr, widths, mode=_PAD_MODES[padding_mode])
+    return _restore(out, img)
+
+
+# -- warp engine ------------------------------------------------------------
+def _warp(arr, inv3x3, out_hw, interpolation="nearest", fill=0):
+    """Inverse-mapping resample: for each output pixel, apply ``inv3x3`` to
+    (x, y, 1) to find the source location; sample nearest/bilinear; pixels
+    mapping outside the input get ``fill``."""
+    H, W = arr.shape[:2]
+    oh, ow = out_hw
+    ys, xs = np.meshgrid(np.arange(oh, dtype=np.float64),
+                         np.arange(ow, dtype=np.float64), indexing="ij")
+    denom = inv3x3[2, 0] * xs + inv3x3[2, 1] * ys + inv3x3[2, 2]
+    denom = np.where(np.abs(denom) < 1e-12, 1e-12, denom)
+    xin = (inv3x3[0, 0] * xs + inv3x3[0, 1] * ys + inv3x3[0, 2]) / denom
+    yin = (inv3x3[1, 0] * xs + inv3x3[1, 1] * ys + inv3x3[1, 2]) / denom
+
+    f = arr.astype(np.float32)
+    if np.isscalar(fill):
+        fillv = np.full((arr.shape[2],), float(fill), np.float32)
+    else:
+        fillv = np.asarray(fill, np.float32)
+    if interpolation in ("nearest", 0):
+        xi = np.round(xin).astype(np.int64)
+        yi = np.round(yin).astype(np.int64)
+        valid = (xi >= 0) & (xi < W) & (yi >= 0) & (yi < H)
+        out = f[np.clip(yi, 0, H - 1), np.clip(xi, 0, W - 1)]
+        out = np.where(valid[..., None], out, fillv)
+    else:  # bilinear
+        x0 = np.floor(xin).astype(np.int64)
+        y0 = np.floor(yin).astype(np.int64)
+        x1, y1 = x0 + 1, y0 + 1
+        wx = (xin - x0)[..., None].astype(np.float32)
+        wy = (yin - y0)[..., None].astype(np.float32)
+
+        def sample(yy, xx):
+            v = f[np.clip(yy, 0, H - 1), np.clip(xx, 0, W - 1)]
+            ok = (xx >= 0) & (xx < W) & (yy >= 0) & (yy < H)
+            return np.where(ok[..., None], v, fillv)
+
+        out = (sample(y0, x0) * (1 - wy) * (1 - wx) +
+               sample(y0, x1) * (1 - wy) * wx +
+               sample(y1, x0) * wy * (1 - wx) +
+               sample(y1, x1) * wy * wx)
+    return _clip_like(out, arr.dtype)
+
+
+def _inverse_affine_matrix(center, angle, translate, scale, shear):
+    """Inverse (output->input) affine matrix, the standard PIL/torchvision
+    parameterization: rotate about ``center`` by ``angle`` degrees CCW,
+    shear (x, y) degrees, scale, then translate."""
+    rot = math.radians(angle)
+    sx = math.radians(shear[0])
+    sy = math.radians(shear[1])
+    cx, cy = center
+    tx, ty = translate
+
+    a = math.cos(rot - sy) / math.cos(sy)
+    b = -math.cos(rot - sy) * math.tan(sx) / math.cos(sy) - math.sin(rot)
+    c = math.sin(rot - sy) / math.cos(sy)
+    d = -math.sin(rot - sy) * math.tan(sx) / math.cos(sy) + math.cos(rot)
+
+    m = [d / scale, -b / scale, 0.0, -c / scale, a / scale, 0.0]
+    m[2] += m[0] * (-cx - tx) + m[1] * (-cy - ty)
+    m[5] += m[3] * (-cx - tx) + m[4] * (-cy - ty)
+    m[2] += cx
+    m[5] += cy
+    return np.array([[m[0], m[1], m[2]], [m[3], m[4], m[5]],
+                     [0.0, 0.0, 1.0]], np.float64)
+
+
+def affine(img, angle, translate=(0, 0), scale=1.0, shear=(0, 0),
+           interpolation="nearest", fill=0, center=None):
+    """Affine transform (ref ``functional.py affine``)."""
+    arr = _as_hwc(img)
+    if isinstance(shear, numbers.Number):
+        shear = (shear, 0.0)
+    if scale <= 0:
+        raise ValueError("scale must be positive")
+    H, W = arr.shape[:2]
+    if center is None:
+        center = ((W - 1) * 0.5, (H - 1) * 0.5)
+    inv = _inverse_affine_matrix(center, angle, translate, scale,
+                                 tuple(shear))
+    return _restore(_warp(arr, inv, (H, W), interpolation, fill), img)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Rotate ``angle`` degrees counter-clockwise (ref ``functional.py
+    rotate``); ``expand`` grows the canvas to hold the whole rotated
+    image (only valid for rotation about the image center).
+
+    Note the convention split the reference also has: ``rotate`` is CCW
+    (PIL semantics) while ``affine``'s angle is clockwise."""
+    arr = _as_hwc(img)
+    angle = -angle  # the shared matrix is clockwise-positive
+    H, W = arr.shape[:2]
+    if center is None:
+        center = ((W - 1) * 0.5, (H - 1) * 0.5)
+    if not expand:
+        inv = _inverse_affine_matrix(center, angle, (0, 0), 1.0, (0, 0))
+        return _restore(_warp(arr, inv, (H, W), interpolation, fill), img)
+    # expanded canvas: the rotated corners' bbox sets the output size
+    # (symmetric in the angle's sign, so the cw/ccw flip doesn't matter)
+    rot = math.radians(angle)
+    cosr, sinr = math.cos(rot), math.sin(rot)
+    cx, cy = (W - 1) * 0.5, (H - 1) * 0.5
+    corners = np.array([[0, 0], [W - 1, 0], [W - 1, H - 1], [0, H - 1]],
+                       np.float64) - [cx, cy]
+    rc = corners @ np.array([[cosr, sinr], [-sinr, cosr]]).T
+    ow = int(math.ceil(rc[:, 0].max() - rc[:, 0].min() + 1))
+    oh = int(math.ceil(rc[:, 1].max() - rc[:, 1].min() + 1))
+    # same clockwise matrix as the non-expand path; the translate term
+    # re-centers expanded-output coords onto the input canvas first
+    ocx, ocy = (ow - 1) * 0.5, (oh - 1) * 0.5
+    inv = _inverse_affine_matrix((cx, cy), angle, (ocx - cx, ocy - cy),
+                                 1.0, (0, 0))
+    return _restore(_warp(arr, inv, (oh, ow), interpolation, fill), img)
+
+
+def _perspective_coeffs(startpoints, endpoints):
+    """Solve the 8-dof homography (output->input), torchvision/PIL
+    parameterization: maps each endpoint to its startpoint."""
+    a = np.zeros((8, 8), np.float64)
+    b = np.zeros((8,), np.float64)
+    for i, ((sx, sy), (ex, ey)) in enumerate(zip(startpoints, endpoints)):
+        a[2 * i] = [ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey]
+        a[2 * i + 1] = [0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey]
+        b[2 * i] = sx
+        b[2 * i + 1] = sy
+    h = np.linalg.solve(a, b)
+    return np.array([[h[0], h[1], h[2]], [h[3], h[4], h[5]],
+                     [h[6], h[7], 1.0]], np.float64)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """Perspective transform mapping ``startpoints`` (in the input) to
+    ``endpoints`` (in the output) — ref ``functional.py perspective``."""
+    arr = _as_hwc(img)
+    H, W = arr.shape[:2]
+    inv = _perspective_coeffs(startpoints, endpoints)
+    return _restore(_warp(arr, inv, (H, W), interpolation, fill), img)
+
+
+# -- photometric ------------------------------------------------------------
+def to_grayscale(img, num_output_channels=1):
+    """ITU-R 601-2 luma (what PIL's ``convert('L')`` uses)."""
+    arr = _as_hwc(img)
+    f = arr.astype(np.float32)
+    gray = (f[..., :3] @ np.array([0.299, 0.587, 0.114],
+                                  np.float32))[..., None]
+    if num_output_channels == 3:
+        gray = np.repeat(gray, 3, axis=2)
+    elif num_output_channels != 1:
+        raise ValueError("num_output_channels should be either 1 or 3")
+    return _restore(_clip_like(gray, arr.dtype), img)
+
+
+def adjust_brightness(img, brightness_factor):
+    """``img * factor`` (PIL ImageEnhance.Brightness semantics)."""
+    if brightness_factor < 0:
+        raise ValueError("brightness_factor is not non-negative.")
+    arr = _as_hwc(img)
+    out = arr.astype(np.float32) * brightness_factor
+    return _restore(_clip_like(out, arr.dtype), img)
+
+
+def adjust_contrast(img, contrast_factor):
+    """Blend with the mean gray level (PIL ImageEnhance.Contrast)."""
+    if contrast_factor < 0:
+        raise ValueError("contrast_factor is not non-negative.")
+    arr = _as_hwc(img)
+    f = arr.astype(np.float32)
+    gray = f[..., :3] @ np.array([0.299, 0.587, 0.114], np.float32)
+    mean = np.round(gray.mean()) if arr.dtype == np.uint8 else gray.mean()
+    out = f * contrast_factor + mean * (1 - contrast_factor)
+    return _restore(_clip_like(out, arr.dtype), img)
+
+
+def adjust_saturation(img, saturation_factor):
+    """Blend with the grayscale image (PIL ImageEnhance.Color)."""
+    if saturation_factor < 0:
+        raise ValueError("saturation_factor is not non-negative.")
+    arr = _as_hwc(img)
+    f = arr.astype(np.float32)
+    gray = (f[..., :3] @ np.array([0.299, 0.587, 0.114],
+                                  np.float32))[..., None]
+    out = f * saturation_factor + gray * (1 - saturation_factor)
+    return _restore(_clip_like(out, arr.dtype), img)
+
+
+def _rgb_to_hsv(rgb):
+    """Vectorized RGB->HSV on [0,1] floats (colorsys convention)."""
+    r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+    maxc = np.max(rgb, axis=-1)
+    minc = np.min(rgb, axis=-1)
+    v = maxc
+    rng = maxc - minc
+    s = np.where(maxc > 0, rng / np.where(maxc > 0, maxc, 1), 0.0)
+    safe = np.where(rng > 0, rng, 1.0)
+    rc = (maxc - r) / safe
+    gc = (maxc - g) / safe
+    bc = (maxc - b) / safe
+    h = np.where(r == maxc, bc - gc,
+                 np.where(g == maxc, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = np.where(rng > 0, (h / 6.0) % 1.0, 0.0)
+    return h, s, v
+
+
+def _hsv_to_rgb(h, s, v):
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    i = i.astype(np.int64) % 6
+    r = np.choose(i, [v, q, p, p, t, v])
+    g = np.choose(i, [t, v, v, q, p, p])
+    b = np.choose(i, [p, p, t, v, v, q])
+    return np.stack([r, g, b], axis=-1)
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by ``hue_factor`` of a full HSV turn, in [-0.5, 0.5]
+    (ref ``functional.py adjust_hue``)."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor is not in [-0.5, 0.5].")
+    arr = _as_hwc(img)
+    if arr.shape[2] < 3:
+        # grayscale has no hue (PIL 'L'-mode behavior: unchanged)
+        return img
+    scale = 255.0 if arr.dtype == np.uint8 else 1.0
+    f = arr.astype(np.float32)[..., :3] / scale
+    h, s, v = _rgb_to_hsv(f)
+    h = (h + hue_factor) % 1.0
+    out = _hsv_to_rgb(h, s, v) * scale
+    if arr.shape[2] > 3:
+        out = np.concatenate([out, arr[..., 3:].astype(np.float32)], -1)
+    return _restore(_clip_like(out, arr.dtype), img)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Fill the (i, j, h, w) region with ``v`` (ref ``functional.py
+    erase``). Works on HWC numpy and on CHW Tensors (paddle's RandomErasing
+    runs after ToTensor)."""
+    if isinstance(img, Tensor):
+        # np.asarray over a jax array is a read-only view — always copy
+        out = np.array(img._data)
+        val = np.asarray(v, out.dtype) if not np.isscalar(v) else v
+        if out.ndim == 3:  # CHW
+            out[..., i:i + h, j:j + w] = val
+        else:
+            out[i:i + h, j:j + w] = val
+        if inplace:
+            import jax.numpy as jnp
+            img._data = jnp.asarray(out)
+            return img
+        return Tensor(out)
+    arr = np.asarray(img)
+    out = arr if inplace else arr.copy()
+    out[i:i + h, j:j + w] = np.asarray(v, out.dtype) if not np.isscalar(v) \
+        else v
+    return out

@@ -1,0 +1,188 @@
+"""Round-4 vision transform parity: geometric warps vs PIL, photometric
+adjusts vs PIL ImageEnhance / colorsys, Random* classes
+(ref: ``python/paddle/vision/transforms/transforms.py:1385,1836``,
+``functional.py``)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.vision.transforms as T
+
+Image = pytest.importorskip("PIL.Image")
+from PIL import ImageEnhance  # noqa: E402
+
+RNG = np.random.RandomState(0)
+IMG = RNG.randint(0, 255, (16, 20, 3)).astype(np.uint8)
+PIM = Image.fromarray(IMG)
+
+
+@pytest.mark.parametrize("angle", [90, 37, -120, 180])
+def test_rotate_matches_pil_exactly(angle):
+    got = T.rotate(IMG, angle, interpolation="nearest")
+    want = np.asarray(PIM.rotate(angle, resample=Image.NEAREST))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_rotate_expand_matches_pil():
+    got = T.rotate(IMG, 45, interpolation="nearest", expand=True)
+    want = np.asarray(PIM.rotate(45, resample=Image.NEAREST, expand=True))
+    assert got.shape == want.shape
+    # allow a sliver of edge rounding difference
+    assert (got != want).mean() < 0.02
+
+
+def test_affine_identity_and_translate():
+    np.testing.assert_array_equal(T.affine(IMG, 0), IMG)
+    got = T.affine(IMG, 0, translate=(3, 2), interpolation="nearest")
+    want = np.asarray(PIM.rotate(0, translate=(3, 2),
+                                 resample=Image.NEAREST))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_affine_scale_shear_runs():
+    out = T.affine(IMG, 15, translate=(1, 1), scale=1.3, shear=(5, 5),
+                   interpolation="bilinear")
+    assert out.shape == IMG.shape and out.dtype == np.uint8
+
+
+def test_perspective_identity_and_shift():
+    corners = [(0, 0), (19, 0), (19, 15), (0, 15)]
+    np.testing.assert_array_equal(
+        T.perspective(IMG, corners, corners), IMG)
+    # pure translation expressed as a perspective: shift right by 2
+    end = [(x + 2, y) for x, y in corners]
+    got = T.perspective(IMG, corners, end, interpolation="nearest")
+    np.testing.assert_array_equal(got[:, 2:], IMG[:, :-2])
+
+
+@pytest.mark.parametrize("factor", [0.4, 1.0, 1.7])
+def test_photometric_vs_pil(factor):
+    cases = [(T.adjust_brightness, ImageEnhance.Brightness),
+             (T.adjust_contrast, ImageEnhance.Contrast),
+             (T.adjust_saturation, ImageEnhance.Color)]
+    for fn, enh in cases:
+        got = fn(IMG, factor).astype(int)
+        want = np.asarray(enh(PIM).enhance(factor)).astype(int)
+        assert np.abs(got - want).max() <= 1, fn.__name__
+
+
+def test_adjust_hue_vs_colorsys():
+    import colorsys
+    x = RNG.rand(64, 3).astype(np.float32)
+    got = T.adjust_hue(x.reshape(64, 1, 3), 0.25).reshape(64, 3)
+    want = np.array([
+        colorsys.hsv_to_rgb((colorsys.rgb_to_hsv(*p)[0] + 0.25) % 1.0,
+                            *colorsys.rgb_to_hsv(*p)[1:]) for p in x])
+    np.testing.assert_allclose(got, want, atol=1e-5)
+    with pytest.raises(ValueError):
+        T.adjust_hue(IMG, 0.7)
+
+
+def test_to_grayscale():
+    g1 = T.to_grayscale(IMG)
+    assert g1.shape == (16, 20, 1)
+    g3 = T.to_grayscale(IMG, num_output_channels=3)
+    assert g3.shape == IMG.shape
+    want = np.asarray(PIM.convert("L"))
+    assert np.abs(g1[..., 0].astype(int) - want.astype(int)).max() <= 1
+
+
+def test_pad_modes():
+    out = T.pad(IMG, 2)
+    assert out.shape == (20, 24, 3) and out[0, 0, 0] == 0
+    out = T.pad(IMG, (1, 2), fill=7)
+    assert out.shape == (20, 22, 3) and out[0, 0, 0] == 7
+    out = T.pad(IMG, (1, 2, 3, 4), padding_mode="reflect")
+    assert out.shape == (22, 24, 3)
+    with pytest.raises(ValueError):
+        T.pad(IMG, 1, padding_mode="bogus")
+
+
+def test_erase_hwc_and_chw():
+    out = T.erase(IMG, 2, 3, 4, 5, 0)
+    assert (out[2:6, 3:8] == 0).all() and (IMG[2:6, 3:8] != 0).any()
+    t = pt.to_tensor(np.ones((3, 8, 8), "float32"))
+    out = T.erase(t, 1, 1, 2, 2, 0.5)
+    assert np.allclose(out.numpy()[:, 1:3, 1:3], 0.5)
+    # inplace on tensor mutates in place
+    T.erase(t, 0, 0, 1, 1, -1.0, inplace=True)
+    assert float(t.numpy()[0, 0, 0]) == -1.0
+
+
+def test_random_affine_class():
+    tr = T.RandomAffine(degrees=20, translate=(0.1, 0.1),
+                        scale=(0.8, 1.2), shear=10)
+    out = tr(IMG)
+    assert out.shape == IMG.shape and out.dtype == np.uint8
+    with pytest.raises(ValueError):
+        T.RandomAffine(10, translate=(1.5, 0))
+    with pytest.raises(ValueError):
+        T.RandomAffine(10, scale=(-1, 1))
+
+
+def test_random_perspective_class():
+    tr = T.RandomPerspective(prob=1.0, distortion_scale=0.4)
+    out = tr(IMG)
+    assert out.shape == IMG.shape
+    tr0 = T.RandomPerspective(prob=0.0)
+    np.testing.assert_array_equal(tr0(IMG), IMG)
+    with pytest.raises(ValueError):
+        T.RandomPerspective(prob=2.0)
+
+
+def test_random_erasing_class():
+    import random as pyrandom
+    pyrandom.seed(3)
+    tr = T.RandomErasing(prob=1.0, scale=(0.1, 0.3), value=0)
+    src = np.ones((16, 16, 3), np.float32)
+    out = tr(src)
+    assert (out == 0).any() and src.shape == out.shape
+    # CHW tensor path with value='random'
+    trr = T.RandomErasing(prob=1.0, value="random")
+    t = pt.to_tensor(np.zeros((3, 16, 16), "float32"))
+    out = trr(t)
+    assert out.shape == [3, 16, 16]
+    with pytest.raises(ValueError):
+        T.RandomErasing(value="bogus")
+
+
+def test_random_rotation_arbitrary_angle():
+    import random as pyrandom
+    pyrandom.seed(0)
+    tr = T.RandomRotation(30, interpolation="bilinear")
+    out = tr(IMG)
+    assert out.shape == IMG.shape
+
+
+def test_hue_transform_uses_real_hsv():
+    import random as pyrandom
+    pyrandom.seed(1)
+    tr = T.HueTransform(0.3)
+    out = tr(IMG)
+    assert out.shape == IMG.shape and out.dtype == np.uint8
+    with pytest.raises(ValueError):
+        T.HueTransform(0.9)
+
+
+def test_review_fixes():
+    # grayscale hue no-op
+    g = np.zeros((4, 4), np.uint8)
+    assert T.adjust_hue(g, 0.2) is g
+    # per-channel pad fill
+    out = T.pad(IMG, 2, fill=(255, 0, 0))
+    assert out[0, 0, 0] == 255 and out[0, 0, 1] == 0
+    # Pad class honors padding_mode
+    out = T.Pad(2, padding_mode="edge")(IMG)
+    assert out[0, 2, 0] == IMG[0, 0, 0]
+    # RandomPerspective skip path returns input untouched
+    src2d = np.zeros((5, 6), np.uint8)
+    assert T.RandomPerspective(prob=0.0)(src2d) is src2d
+
+
+def test_multiplex_cdist_validation():
+    ins = [pt.to_tensor(np.ones((2, 3), "float32"))] * 2
+    with pytest.raises(ValueError):
+        pt.multiplex(ins, pt.to_tensor(np.array([[0], [1], [1]], "int32")))
+    with pytest.raises(ValueError):
+        pt.linalg.cdist(pt.to_tensor(np.ones((2, 2), "float32")),
+                        pt.to_tensor(np.ones((2, 2), "float32")), p=-1.0)
