@@ -9,6 +9,9 @@ from .layer.container import *  # noqa: F401,F403
 from .layer.loss import *  # noqa: F401,F403
 from .layer.transformer import *  # noqa: F401,F403
 from .layer.rnn import *  # noqa: F401,F403
+from .layer.rnn import RNNCellBase  # noqa: F401
+from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
+from . import decode  # noqa: F401
 from .clip import (ClipGradByValue, ClipGradByNorm,  # noqa: F401
                    ClipGradByGlobalNorm)
 from . import functional  # noqa: F401

@@ -1,0 +1,244 @@
+"""Sequence decoding: BeamSearchDecoder + dynamic_decode + gather_tree
+(ref: ``python/paddle/nn/decode.py:153 BeamSearchDecoder``, ``:994
+dynamic_decode``; ``paddle/phi/kernels/cpu/gather_tree_kernel.cc``).
+
+TPU design notes:
+ - ``gather_tree`` is a reverse ``lax.scan`` over the time axis — one
+   compiled backward walk, no per-step host sync.
+ - ``dynamic_decode`` drives the beam step from the host with early exit
+   when every beam finishes (the idiomatic way to run autoregressive
+   decoding against jitted steps); each step itself is pure and traceable,
+   so the whole loop can also be captured under ``to_static`` with a fixed
+   ``max_step_num``.
+"""
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ..ops.op_utils import ensure_tensor, nary
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode", "gather_tree"]
+
+
+def gather_tree(ids, parents):
+    """Reconstruct full beams from per-step tokens + parent pointers.
+    Shapes [max_time, batch, beam_size] (ref ``gather_tree_kernel.cc``)."""
+    ids = ensure_tensor(ids)
+    parents = ensure_tensor(parents)
+    if ids.ndim != 3:
+        raise ValueError("gather_tree expects [max_time, batch, beam] ids")
+
+    def f(idv, parv):
+        T, B, K = idv.shape
+        binx = jnp.arange(B)[:, None]
+
+        def step(cur, tp):
+            tok, par = tp
+            out = jnp.take_along_axis(tok, cur, axis=-1)
+            nxt = jnp.take_along_axis(par, cur, axis=-1)
+            return nxt, out
+
+        init = jnp.broadcast_to(jnp.arange(K)[None, :], (B, K))
+        _, outs = jax.lax.scan(step, init, (idv, parv), reverse=True)
+        del binx
+        return outs
+
+    return nary(f, [ids, parents], name="gather_tree")
+
+
+class Decoder:
+    """Abstract decoder interface (ref ``decode.py Decoder``)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam search over an RNN cell (ref ``decode.py:153``).
+
+    The cell is called on [batch*beam, ...] merged tensors; scores,
+    predicted ids and parent ids are emitted per step and finalized with
+    ``gather_tree``.
+    """
+
+    OutputWrapper = collections.namedtuple(
+        "OutputWrapper", ("scores", "predicted_ids", "parent_ids"))
+    StateWrapper = collections.namedtuple(
+        "StateWrapper", ("cell_states", "log_probs", "finished", "lengths"))
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- beam helpers (all pure jnp) ----------------------------------------
+    def _merge(self, x):
+        """[batch, beam, ...] -> [batch*beam, ...]"""
+        s = x.shape
+        return x.reshape((s[0] * s[1],) + tuple(s[2:]))
+
+    def _split(self, x):
+        s = x.shape
+        return x.reshape((s[0] // self.beam_size, self.beam_size)
+                         + tuple(s[1:]))
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """Public helper (ref ``decode.py tile_beam_merge_with_batch``):
+        tile a [batch, ...] tensor to [batch*beam_size, ...]."""
+        x = ensure_tensor(x)
+        return nary(lambda d: jnp.repeat(d, beam_size, axis=0), [x],
+                    name="tile_beam_merge_with_batch")
+
+    def initialize(self, inits):
+        """inits: initial cell states, [batch, ...] leaves."""
+        states = jax.tree_util.tree_map(
+            lambda t: jnp.repeat(np.asarray(t._data) if isinstance(t, Tensor)
+                                 else jnp.asarray(t), self.beam_size, axis=0),
+            inits, is_leaf=lambda t: isinstance(t, Tensor))
+        leaf = jax.tree_util.tree_leaves(states)[0]
+        batch = leaf.shape[0] // self.beam_size
+        # first beam live (log prob 0), the rest dead (-inf)
+        log_probs = jnp.tile(
+            jnp.asarray([0.0] + [-1e9] * (self.beam_size - 1),
+                        jnp.float32)[None, :], (batch, 1))
+        init = self.StateWrapper(
+            cell_states=states, log_probs=log_probs,
+            finished=jnp.zeros((batch, self.beam_size), bool),
+            lengths=jnp.zeros((batch, self.beam_size), jnp.int32))
+        start = jnp.full((batch, self.beam_size), self.start_token,
+                         jnp.int32)
+        return start, init, init.finished
+
+    def step(self, time, inputs, states, **kwargs):
+        """inputs: [batch, beam] token ids; states: StateWrapper."""
+        ids = inputs if not isinstance(inputs, Tensor) else inputs._data
+        if self.embedding_fn is not None:
+            emb = self.embedding_fn(Tensor(self._merge(ids)))
+            emb = emb._data if isinstance(emb, Tensor) else emb
+        else:
+            emb = self._merge(ids)
+        cell_out, next_cell = self.cell(
+            Tensor(emb),
+            jax.tree_util.tree_map(Tensor, states.cell_states),
+            **kwargs)
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        logits = cell_out._data if isinstance(cell_out, Tensor) else cell_out
+        next_cell = jax.tree_util.tree_map(
+            lambda t: t._data if isinstance(t, Tensor) else t, next_cell,
+            is_leaf=lambda t: isinstance(t, Tensor))
+
+        V = logits.shape[-1]
+        K = self.beam_size
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        logp = self._split(logp)                       # [B, K, V]
+        # finished beams may only emit end_token, at no cost
+        fin = states.finished[..., None]
+        onehot_end = jax.nn.one_hot(self.end_token, V, dtype=jnp.float32)
+        masked = jnp.where(fin, jnp.log(onehot_end + 1e-38)[None, None, :],
+                           logp)
+        total = states.log_probs[..., None] + masked   # [B, K, V]
+        B = total.shape[0]
+        flat = total.reshape(B, K * V)
+        top_scores, top_idx = jax.lax.top_k(flat, K)
+        parent = (top_idx // V).astype(jnp.int32)      # [B, K]
+        token = (top_idx % V).astype(jnp.int32)
+
+        def pick_beam(t):
+            t = self._split(t)
+            picked = jnp.take_along_axis(
+                t, parent.reshape(parent.shape + (1,) * (t.ndim - 2)),
+                axis=1)
+            return self._merge(picked)
+
+        next_cell = jax.tree_util.tree_map(pick_beam, next_cell)
+        prev_fin = jnp.take_along_axis(states.finished, parent, axis=1)
+        prev_len = jnp.take_along_axis(states.lengths, parent, axis=1)
+        now_fin = prev_fin | (token == self.end_token)
+        lengths = prev_len + (~prev_fin).astype(jnp.int32)
+        next_state = self.StateWrapper(
+            cell_states=next_cell, log_probs=top_scores,
+            finished=now_fin, lengths=lengths)
+        outputs = self.OutputWrapper(scores=top_scores, predicted_ids=token,
+                                     parent_ids=parent)
+        return outputs, next_state, token, now_fin
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        """outputs: OutputWrapper of [T, B, K] stacks → beams via
+        gather_tree."""
+        preds = gather_tree(Tensor(outputs.predicted_ids),
+                            Tensor(outputs.parent_ids))
+        return preds, final_states
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Run ``decoder`` until every sequence finishes or ``max_step_num``
+    (ref ``decode.py:994``). Host-driven loop over pure steps with early
+    exit; see module docstring for the TPU stance."""
+    if max_step_num is None:
+        max_step_num = 256
+    inputs, states, finished = decoder.initialize(inits)
+    step_outputs = []
+    own_lengths = None  # fallback when the decoder's states carry none
+    for t in range(int(max_step_num)):
+        outputs, states, inputs, finished = decoder.step(
+            t, inputs, states, **kwargs)
+        step_outputs.append(outputs)
+        fin = finished._data if isinstance(finished, Tensor) else finished
+        fin = jnp.asarray(fin)
+        if own_lengths is None:
+            own_lengths = jnp.zeros(fin.shape, jnp.int32)
+        own_lengths = jnp.where(fin & (own_lengths == 0), t + 1, own_lengths)
+        if not isinstance(fin, jax.core.Tracer) and bool(jnp.all(fin)):
+            break
+    own_lengths = jnp.where(own_lengths == 0, len(step_outputs), own_lengths)
+
+    def _stack(*leaves):
+        return jnp.stack([leaf._data if isinstance(leaf, Tensor) else leaf
+                          for leaf in leaves])
+
+    # stack the per-step output structures along a new time axis
+    stacked = jax.tree_util.tree_map(
+        _stack, *step_outputs, is_leaf=lambda t: isinstance(t, Tensor))
+    final, final_states = decoder.finalize(stacked, states, None)
+    lengths = getattr(final_states, "lengths", own_lengths)
+    seq_len = lengths if isinstance(lengths, Tensor) else Tensor(lengths)
+
+    def _batch_major(leaf):
+        arr = leaf._data if isinstance(leaf, Tensor) else leaf
+        if hasattr(arr, "ndim") and arr.ndim >= 2:
+            arr = jnp.swapaxes(arr, 0, 1)
+        return Tensor(arr) if isinstance(leaf, Tensor) else arr
+
+    if not output_time_major:
+        final = jax.tree_util.tree_map(
+            _batch_major, final, is_leaf=lambda t: isinstance(t, Tensor))
+    if return_length:
+        return final, final_states, seq_len
+    return final, final_states

@@ -14,6 +14,7 @@ from . import activation, conv, pooling, norm, loss, common  # noqa: F401
 from . import vision  # noqa: F401
 from . import flash_attention  # noqa: F401  (module path, ref parity)
 from .flash_attention import flash_attn_unpadded  # noqa: F401
+from ..decode import gather_tree  # noqa: F401  (ref: functional/extension.py)
 
 __all__ = (activation.__all__ + conv.__all__ + pooling.__all__ +
            norm.__all__ + loss.__all__ + common.__all__ + vision.__all__ +

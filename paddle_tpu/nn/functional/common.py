@@ -24,6 +24,7 @@ __all__ = [
     "channel_shuffle", "unfold", "fold", "bilinear",
     "scaled_dot_product_attention", "pad", "zeropad2d", "cosine_similarity",
     "temporal_shift", "class_center_sample", "sequence_mask",
+    "pairwise_distance", "sparse_attention", "diag_embed",
 ]
 
 from ...ops.manipulation import pad  # noqa: F401  re-export (paddle has F.pad)
@@ -439,3 +440,87 @@ def _on_tpu():
         return jax.devices()[0].platform not in ("cpu",)
     except RuntimeError:
         return False
+
+
+from ...ops.creation import diag_embed  # noqa: F401,E402  (F.diag_embed parity)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """p-norm of (x - y + eps) along the last axis (ref
+    ``nn/functional/distance.py pairwise_distance``)."""
+    import math as _math
+
+    def f(a, b):
+        d = a - b + epsilon
+        if _math.isinf(float(p)):
+            out = jnp.max(jnp.abs(d), axis=-1, keepdims=keepdim) \
+                if p > 0 else jnp.min(jnp.abs(d), axis=-1, keepdims=keepdim)
+        elif p == 0:
+            out = jnp.sum((d != 0).astype(d.dtype), axis=-1,
+                          keepdims=keepdim)
+        else:
+            out = jnp.sum(jnp.abs(d) ** p, axis=-1,
+                          keepdims=keepdim) ** (1.0 / p)
+        return out
+    return nary(f, [ensure_tensor(x), ensure_tensor(y)],
+                name="pairwise_distance")
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Block-sparse attention with a CSR sparsity pattern (ref
+    ``nn/functional/sparse_attention.py``; CUDA kernel
+    ``sparse_attention_kernel.cu``).
+
+    TPU realization: the CSR pattern is expanded to a boolean mask and the
+    computation runs as masked dense attention — XLA has no CSR-gather
+    attention primitive, and for the seq lengths this op targets the MXU
+    prefers the dense masked form. Same results as the reference kernel.
+    """
+    q = ensure_tensor(query)
+    k_ = ensure_tensor(key)
+    v = ensure_tensor(value)
+    offs = ensure_tensor(sparse_csr_offset)
+    cols = ensure_tensor(sparse_csr_columns)
+    args = [q, k_, v, offs, cols]
+    if key_padding_mask is not None:
+        args.append(ensure_tensor(key_padding_mask))
+    if attn_mask is not None:
+        args.append(ensure_tensor(attn_mask))
+
+    def f(qd, kd, vd, od, cd, *masks):
+        B, H, S, D = qd.shape
+        scale = 1.0 / np.sqrt(D)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qd, kd) * scale
+
+        def fill(bh_cols, bh_offsets):
+            # CSR -> dense bool [S, S]: one O(nnz) scatter; entry i
+            # belongs to the row r with offsets[r] <= i < offsets[r+1]
+            nnz = bh_cols.shape[0]
+            pos = jnp.arange(nnz)
+            rows = jnp.searchsorted(bh_offsets, pos, side="right") - 1
+            valid = pos < bh_offsets[-1]
+            m = jnp.zeros((S, S), bool)
+            return m.at[jnp.clip(rows, 0, S - 1),
+                        jnp.clip(bh_cols, 0, S - 1)].max(valid)
+
+        mask = jax.vmap(jax.vmap(fill))(cd, od)
+        neg = jnp.asarray(-1e9, logits.dtype)
+        logits = jnp.where(mask, logits, neg)
+        mi = 0
+        if key_padding_mask is not None:
+            kp = masks[mi]
+            mi += 1
+            logits = jnp.where(kp[:, None, None, :] != 0, logits, neg)
+        if attn_mask is not None:
+            # paddle semantics: 0 -> masked out (same rule as
+            # key_padding_mask), not an additive bias
+            am = masks[mi]
+            logits = jnp.where(am[None, None, :, :] != 0 if am.ndim == 2
+                               else am != 0, logits, neg)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(
+            qd.dtype)
+        probs = jnp.where(mask, probs, 0.0)
+        return jnp.einsum("bhqk,bhkd->bhqd", probs, vd)
+
+    return nary(f, args, name="sparse_attention")

@@ -22,6 +22,7 @@ __all__ = [
     "square_error_cost", "sigmoid_focal_loss", "dice_loss",
     "npair_loss", "poisson_nll_loss", "gaussian_nll_loss",
     "multi_label_soft_margin_loss", "soft_margin_loss", "rnnt_loss",
+    "margin_cross_entropy", "hsigmoid_loss", "multi_margin_loss",
 ]
 
 
@@ -517,3 +518,155 @@ def soft_margin_loss(input, label, reduction="mean", name=None):
         return _reduce(jnp.log1p(jnp.exp(-y * x)), reduction)
     return nary(f, [ensure_tensor(input), ensure_tensor(label)],
                 name="soft_margin_loss")
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """ArcFace/CosFace combined-margin CE over (possibly class-sharded)
+    cosine logits (ref: ``loss.py:2033``; CUDA kernel
+    ``margin_cross_entropy_kernel.cu``).
+
+    TP-aware the TPU way: when called inside an ``mp`` shard_map scope the
+    class dim is sharded — the margin is applied locally by the rank that
+    owns the target class and softmax statistics reduce with pmax/psum,
+    mirroring the ParallelCrossEntropy design (never materializes the
+    gathered [N, num_classes] logits). ``group=False`` skips communication
+    (data-parallel mode).
+    """
+    logits = ensure_tensor(logits)
+    label = ensure_tensor(label)
+    from jax import lax
+    from ...distributed.fleet.meta_parallel.parallel_layers.mp_layers import (
+        _in_axis_scope, _MP)
+
+    ax = group.axis_name if (group not in (None, False)
+                             and hasattr(group, "axis_name")) else _MP
+    sharded = group is not False and _in_axis_scope(ax)
+
+    def margin_target(tgt_cos):
+        # cos(m1*theta + m2) - m3, numerically guarded acos
+        theta = jnp.arccos(jnp.clip(tgt_cos, -1.0 + 1e-7, 1.0 - 1e-7))
+        return jnp.cos(margin1 * theta + margin2) - margin3
+
+    def f(lg, y):
+        if y.ndim == lg.ndim:
+            y = y.squeeze(-1)
+        lg = lg.astype(jnp.float32)
+        n_local = lg.shape[-1]
+        if sharded:
+            i = lax.axis_index(ax)
+            start = i * n_local
+        else:
+            start = 0
+        in_range = (y >= start) & (y < start + n_local)
+        local_y = jnp.clip(y - start, 0, n_local - 1)
+        onehot = jax.nn.one_hot(local_y, n_local, dtype=bool) \
+            & in_range[..., None]
+        modified = jnp.where(onehot, margin_target(lg), lg) * scale
+        if sharded:
+            m = lax.pmax(jnp.max(modified, axis=-1), ax)
+            shifted = modified - m[..., None]
+            sumexp = lax.psum(jnp.sum(jnp.exp(shifted), axis=-1), ax)
+            tgt = jnp.take_along_axis(shifted, local_y[..., None],
+                                      axis=-1)[..., 0]
+            tgt = lax.psum(jnp.where(in_range, tgt, 0.0), ax)
+        else:
+            m = jnp.max(modified, axis=-1)
+            shifted = modified - m[..., None]
+            sumexp = jnp.sum(jnp.exp(shifted), axis=-1)
+            tgt = jnp.take_along_axis(shifted, local_y[..., None],
+                                      axis=-1)[..., 0]
+        loss = (jnp.log(sumexp) - tgt)[..., None]
+        softmax = jnp.exp(shifted) / sumexp[..., None]
+        if reduction == "mean":
+            loss = jnp.mean(loss)
+        elif reduction == "sum":
+            loss = jnp.sum(loss)
+        return loss, softmax
+
+    out = nary(f, [logits, label], name="margin_cross_entropy", n_out=2)
+    return (out[0], out[1]) if return_softmax else out[0]
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (ref: ``loss.py hsigmoid_loss``; tree
+    encoding ``phi/kernels/funcs/matrix_bit_code.h SimpleCode``: class c
+    encodes as c + num_classes; node index at bit b is (code>>(b+1))-1,
+    branch bit is (code>>b)&1).
+
+    TPU design: the per-sample variable-length tree path is evaluated as a
+    fixed ``ceil(log2)`` -deep masked gather+dot — static shapes for XLA;
+    ``is_sparse`` is accepted (gathers are already 'sparse' here)."""
+    input = ensure_tensor(input)
+    label = ensure_tensor(label)
+    args = [input, label, ensure_tensor(weight)]
+    has_bias = bias is not None
+    if has_bias:
+        args.append(ensure_tensor(bias))
+    custom = path_table is not None
+    if custom != (path_code is not None):
+        raise ValueError("path_table and path_code must be given together")
+    if custom:
+        args += [ensure_tensor(path_table), ensure_tensor(path_code)]
+    max_len = int(np.ceil(np.log2(max(num_classes, 2)))) + 1 \
+        if not custom else None
+
+    def f(x, y, w, *rest):
+        b = rest[0] if has_bias else None
+        if y.ndim == 2:
+            y = y[..., 0]
+        if custom:
+            table = rest[-2]
+            code_bits = rest[-1]
+            node_idx = table.astype(jnp.int32)          # [N, L]
+            bits = code_bits.astype(jnp.float32)        # [N, L]
+            mask = (node_idx >= 0).astype(jnp.float32)
+            node_safe = jnp.maximum(node_idx, 0)
+        else:
+            code = y.astype(jnp.int32) + num_classes    # [N]
+            L = max_len
+            bit_pos = jnp.arange(L)                     # [L]
+            lengths = jnp.floor(
+                jnp.log2(code.astype(jnp.float32))).astype(jnp.int32)
+            mask = (bit_pos[None, :] < lengths[:, None]).astype(jnp.float32)
+            node_safe = jnp.maximum(
+                (code[:, None] >> (bit_pos[None, :] + 1)) - 1, 0)
+            bits = ((code[:, None] >> bit_pos[None, :]) & 1).astype(
+                jnp.float32)
+        wpath = w[node_safe]                            # [N, L, D]
+        pre = jnp.einsum("nld,nd->nl", wpath.astype(jnp.float32),
+                         x.astype(jnp.float32))
+        if b is not None:
+            pre = pre + b.reshape(-1)[node_safe]
+        # BCE-with-logits against the branch bit, masked over real path
+        per_node = jnp.maximum(pre, 0) - pre * bits + jnp.log1p(
+            jnp.exp(-jnp.abs(pre)))
+        return jnp.sum(per_node * mask, axis=-1, keepdims=True)
+
+    return nary(f, args, name="hsigmoid_loss")
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """Multi-class margin (hinge) loss (ref: ``loss.py multi_margin_loss``)."""
+    input = ensure_tensor(input)
+    label = ensure_tensor(label)
+    args = [input, label]
+    if weight is not None:
+        args.append(ensure_tensor(weight))
+
+    def f(x, y, *w):
+        if y.ndim == 2:
+            y = y[..., 0]
+        C = x.shape[1]
+        tgt = jnp.take_along_axis(x, y[:, None], axis=1)
+        hinge = jnp.maximum(0.0, margin - tgt + x) ** p
+        if w:
+            hinge = hinge * w[0][y][:, None]
+        hinge = hinge * (1 - jax.nn.one_hot(y, C, dtype=x.dtype))
+        return _reduce(jnp.sum(hinge, axis=1) / C, reduction)
+
+    return nary(f, args, name="multi_margin_loss")

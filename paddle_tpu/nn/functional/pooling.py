@@ -18,6 +18,7 @@ __all__ = [
     "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
     "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
     "adaptive_max_pool3d", "lp_pool1d", "lp_pool2d",
+    "max_unpool1d", "max_unpool2d", "max_unpool3d",
 ]
 
 
@@ -132,6 +133,9 @@ def _pool_argmax(x, kernel, stride, padding, n, data_format, ceil_mode):
     pad = _norm_padding(padding, n, data_format)
 
     def f(d):
+        # indices are integral metadata — never differentiate through the
+        # (value, index) reduce_window (its tuple form has no JVP rule)
+        d = jax.lax.stop_gradient(d)
         if channel_last:
             d = jnp.moveaxis(d, -1, 1)
         spatial = d.shape[2:]
@@ -238,3 +242,59 @@ def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
                         ceil_mode=ceil_mode, data_format=data_format)
     k = _norm_tuple(kernel_size, 2)
     return M.pow(M.multiply(pooled, float(np.prod(k))), 1.0 / p)
+
+
+def _max_unpool(x, indices, kernel, stride, padding, n, output_size,
+                data_format, opname):
+    """Inverse of max_pool with return_mask (ref ``pooling.py:1204``
+    MaxUnPool): scatter pooled values back to their argmax positions —
+    one XLA scatter over the flattened spatial dims."""
+    x = ensure_tensor(x)
+    indices = ensure_tensor(indices)
+    channel_last = data_format[-1] == "C"
+    k = _norm_tuple(kernel, n)
+    s = _norm_tuple(stride if stride is not None else kernel, n)
+    p = _norm_tuple(padding, n)
+    in_spatial = (x.shape[1:-1] if channel_last else x.shape[2:])
+    if output_size is None:
+        out_spatial = tuple(
+            (i - 1) * st + kk - 2 * pp
+            for i, st, kk, pp in zip(in_spatial, s, k, p))
+    else:
+        out_spatial = tuple(output_size)[-n:]
+
+    def f(d, idx):
+        if channel_last:
+            d = jnp.moveaxis(d, -1, 1)
+            idx = jnp.moveaxis(idx, -1, 1)
+        N, C = d.shape[:2]
+        flat_out = int(np.prod(out_spatial))
+        dv = d.reshape(N, C, -1)
+        iv = idx.reshape(N, C, -1).astype(jnp.int32)
+        out = jnp.zeros((N, C, flat_out), d.dtype)
+        out = jax.vmap(jax.vmap(
+            lambda o, i, v: o.at[i].set(v)))(out, iv, dv)
+        out = out.reshape((N, C) + out_spatial)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    return nary(f, [x, indices], name=opname)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, 1,
+                       output_size, "NCW" if data_format in ("NCL", "NCW")
+                       else "NWC", "max_unpool1d")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, 2,
+                       output_size, data_format, "max_unpool2d")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, 3,
+                       output_size, data_format, "max_unpool3d")

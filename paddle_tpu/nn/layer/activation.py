@@ -9,7 +9,7 @@ __all__ = ["ReLU", "ReLU6", "ELU", "SELU", "CELU", "GELU", "Silu", "Swish",
            "Mish", "Softplus", "Softsign", "Softshrink", "Hardshrink",
            "Tanhshrink", "ThresholdedReLU", "LeakyReLU", "PReLU", "RReLU",
            "Hardtanh", "Hardsigmoid", "Hardswish", "Sigmoid", "LogSigmoid",
-           "Tanh", "Softmax", "LogSoftmax", "Maxout", "GLU"]
+           "Tanh", "Softmax", "LogSoftmax", "Maxout", "GLU", "Softmax2D"]
 
 
 def _simple(fn_name, **fixed):
@@ -90,3 +90,20 @@ class RReLU(Layer):
 
     def forward(self, x):
         return F.rrelu(x, self.lower, self.upper, training=self.training)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel axis of NCHW / CHW inputs (ref
+    ``layer/activation.py Softmax2D``)."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        from ...ops.op_utils import ensure_tensor
+        x = ensure_tensor(x)
+        if x.ndim not in (3, 4):
+            raise ValueError(
+                f"Softmax2D requires a 3D or 4D tensor, got {x.ndim}D")
+        from .. import functional as F
+        return F.softmax(x, axis=-3)

@@ -11,7 +11,7 @@ __all__ = ["Linear", "Dropout", "Dropout2D", "Dropout3D", "AlphaDropout",
            "Embedding", "Flatten", "Identity", "Upsample", "UpsamplingNearest2D",
            "UpsamplingBilinear2D", "Bilinear", "Pad1D", "Pad2D", "Pad3D",
            "ZeroPad2D", "CosineSimilarity", "Unfold", "Fold", "PixelShuffle",
-           "PixelUnshuffle", "ChannelShuffle"]
+           "PixelUnshuffle", "ChannelShuffle", "PairwiseDistance", "Unflatten"]
 
 
 class Linear(Layer):
@@ -262,3 +262,32 @@ class ChannelShuffle(Layer):
 
     def forward(self, x):
         return F.channel_shuffle(x, self.groups, self.data_format)
+
+
+class PairwiseDistance(Layer):
+    """p-norm distance between paired rows (ref ``layer/distance.py``)."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        from ..functional.common import pairwise_distance
+        return pairwise_distance(x, y, p=self.p, epsilon=self.epsilon,
+                                 keepdim=self.keepdim)
+
+
+class Unflatten(Layer):
+    """Expand one axis into the given shape (ref ``layer/common.py
+    Unflatten``)."""
+
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis = axis
+        self.shape = shape
+
+    def forward(self, x):
+        from ...ops.manipulation import unflatten
+        return unflatten(x, self.axis, self.shape)

@@ -10,7 +10,8 @@ __all__ = ["CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCELoss",
            "CosineEmbeddingLoss", "TripletMarginLoss",
            "TripletMarginWithDistanceLoss", "SoftMarginLoss",
            "MultiLabelSoftMarginLoss", "PoissonNLLLoss", "GaussianNLLLoss",
-           "SigmoidFocalLoss"]
+           "SigmoidFocalLoss", "HSigmoidLoss", "MultiMarginLoss",
+           "RNNTLoss"]
 
 
 class CrossEntropyLoss(Layer):
@@ -231,3 +232,68 @@ class SigmoidFocalLoss(Layer):
     def forward(self, logit, label):
         n, a, g, r = self.args
         return F.sigmoid_focal_loss(logit, label, n, a, g, r)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid (ref ``layer/loss.py HSigmoidLoss``): owns the
+    [num_classes-1, feature] node weights; see F.hsigmoid_loss for the
+    tree encoding."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if (num_classes < 2) and (not is_custom):
+            raise ValueError("num_classes must not be less than 2 "
+                             "with default tree")
+        self.num_classes = num_classes
+        self.is_custom = is_custom
+        self.is_sparse = is_sparse
+        C = num_classes if is_custom else num_classes - 1
+        from .. import initializer as I
+        self.weight = self.create_parameter(
+            [C, feature_size], attr=weight_attr,
+            default_initializer=I.Uniform(
+                -((2.0 / feature_size) ** 0.5),
+                (2.0 / feature_size) ** 0.5))
+        self.bias = self.create_parameter([C, 1], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               bias=self.bias, path_table=path_table,
+                               path_code=path_code,
+                               is_sparse=self.is_sparse)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.p = p
+        self.margin = margin
+        self.weight = weight
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, p=self.p,
+                                   margin=self.margin, weight=self.weight,
+                                   reduction=self.reduction)
+
+
+class RNNTLoss(Layer):
+    """RNN-Transducer loss layer over the functional ``F.rnnt_loss``
+    (ref ``layer/loss.py RNNTLoss``)."""
+
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           blank=self.blank,
+                           fastemit_lambda=self.fastemit_lambda,
+                           reduction=self.reduction)

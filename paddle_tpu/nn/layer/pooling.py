@@ -7,7 +7,8 @@ from .. import functional as F
 __all__ = ["AvgPool1D", "AvgPool2D", "AvgPool3D", "MaxPool1D", "MaxPool2D",
            "MaxPool3D", "AdaptiveAvgPool1D", "AdaptiveAvgPool2D",
            "AdaptiveAvgPool3D", "AdaptiveMaxPool1D", "AdaptiveMaxPool2D",
-           "AdaptiveMaxPool3D", "LPPool1D", "LPPool2D"]
+           "AdaptiveMaxPool3D", "LPPool1D", "LPPool2D",
+           "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D"]
 
 
 class _Pool(Layer):
@@ -111,3 +112,45 @@ class LPPool2D(Layer):
     def forward(self, x):
         n, k, s, p, c, df = self.args
         return F.lp_pool2d(x, n, k, s, p, c, df)
+
+
+class _MaxUnPool(Layer):
+    def __init__(self, n, kernel_size, stride=None, padding=0,
+                 data_format=None, output_size=None, name=None):
+        super().__init__()
+        self.n = n
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.data_format = data_format
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        fn = [F.max_unpool1d, F.max_unpool2d, F.max_unpool3d][self.n - 1]
+        return fn(x, indices, self.kernel_size, stride=self.stride,
+                  padding=self.padding, data_format=self.data_format,
+                  output_size=self.output_size)
+
+
+class MaxUnPool1D(_MaxUnPool):
+    """Inverse of MaxPool1D given return_mask indices (ref
+    ``layer/pooling.py:1204`` family)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__(1, kernel_size, stride, padding, data_format,
+                         output_size)
+
+
+class MaxUnPool2D(_MaxUnPool):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__(2, kernel_size, stride, padding, data_format,
+                         output_size)
+
+
+class MaxUnPool3D(_MaxUnPool):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__(3, kernel_size, stride, padding, data_format,
+                         output_size)

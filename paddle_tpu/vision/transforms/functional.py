@@ -61,6 +61,10 @@ def pad(img, padding, fill=0, padding_mode="constant"):
     widths = [(p[1], p[3]), (p[0], p[2]), (0, 0)]
     if padding_mode == "constant":
         if isinstance(fill, (tuple, list)):
+            if len(fill) != arr.shape[2]:
+                raise ValueError(
+                    f"pad fill has {len(fill)} values but the image has "
+                    f"{arr.shape[2]} channels")
             # per-channel fill: pad each channel plane separately
             out = np.stack([
                 np.pad(arr[..., ci], widths[:2], constant_values=fv)

@@ -1,0 +1,403 @@
+"""Round-4 nn gaps: margin_cross_entropy (incl. mp-sharded), hsigmoid,
+multi-margin, pairwise distance, max-unpool, Softmax2D/Unflatten, beam
+search decode (ref: ``python/paddle/nn/functional/loss.py:2033``,
+``python/paddle/nn/decode.py:153,994``)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import Tensor
+
+RNG = np.random.RandomState(0)
+
+
+def _cosine_logits(n, c, seed=0):
+    r = np.random.RandomState(seed)
+    X = r.randn(n, 3)
+    X /= np.linalg.norm(X, axis=1, keepdims=True)
+    W = r.randn(3, c)
+    W /= np.linalg.norm(W, axis=0, keepdims=True)
+    return (X @ W).astype("float32")
+
+
+def _mce_ref(logits, label, m1=1.0, m2=0.5, m3=0.0, s=64.0):
+    mod = logits.copy().astype(np.float64)
+    for i in range(len(label)):
+        c = np.clip(logits[i, label[i]], -1 + 1e-7, 1 - 1e-7)
+        mod[i, label[i]] = np.cos(m1 * np.arccos(c) + m2) - m3
+    mod *= s
+    sm = np.exp(mod - mod.max(1, keepdims=True))
+    sm /= sm.sum(1, keepdims=True)
+    return -np.log(sm[np.arange(len(label)), label]), sm
+
+
+def test_margin_cross_entropy_single():
+    logits = _cosine_logits(4, 6)
+    label = np.array([2, 0, 5, 3], "int64")
+    loss, sm = F.margin_cross_entropy(
+        pt.to_tensor(logits), pt.to_tensor(label), return_softmax=True,
+        reduction=None)
+    ref_loss, ref_sm = _mce_ref(logits, label)
+    np.testing.assert_allclose(loss.numpy().ravel(), ref_loss, atol=1e-4)
+    np.testing.assert_allclose(sm.numpy(), ref_sm, atol=1e-4)
+    # reductions
+    lm = F.margin_cross_entropy(pt.to_tensor(logits), pt.to_tensor(label),
+                                reduction="mean")
+    np.testing.assert_allclose(float(lm.numpy()), ref_loss.mean(), rtol=1e-4)
+
+
+def test_margin_cross_entropy_mp_sharded():
+    """Class-sharded margin CE over an mp mesh must match the gathered
+    single-device result (the reference's model-parallel mode)."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the 8-device CPU mesh")
+    n_mp = 4
+    C = 8  # 2 classes per rank
+    logits = _cosine_logits(6, C, seed=1)
+    label = np.array([0, 3, 7, 5, 2, 6], "int64")
+    ref_loss, _ = _mce_ref(logits, label)
+    mesh = Mesh(np.array(jax.devices()[:n_mp]).reshape(n_mp), ("mp",))
+
+    def f(lg, y):
+        out = F.margin_cross_entropy(Tensor(lg), Tensor(y), reduction=None)
+        return out._data
+
+    sharded = jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=(P(None, "mp"), P()), out_specs=P()))
+    got = np.asarray(sharded(jnp.asarray(logits), jnp.asarray(label)))
+    np.testing.assert_allclose(got.ravel(), ref_loss, atol=1e-4)
+
+
+def test_hsigmoid_loss_default_tree():
+    D, K = 5, 8
+    x = RNG.randn(3, D).astype("float32")
+    w = RNG.randn(K - 1, D).astype("float32")
+    b = RNG.randn(K - 1, 1).astype("float32")
+    y = np.array([0, 3, 7], "int64")
+    out = F.hsigmoid_loss(pt.to_tensor(x), pt.to_tensor(y), K,
+                          pt.to_tensor(w), bias=pt.to_tensor(b))
+
+    def ref(xi, yi):
+        c = yi + K
+        tot = 0.0
+        for bit in range(int(np.floor(np.log2(c)))):
+            idx = (c >> (bit + 1)) - 1
+            t = float((c >> bit) & 1)
+            pre = w[idx] @ xi + b[idx, 0]
+            tot += max(pre, 0) - pre * t + np.log1p(np.exp(-abs(pre)))
+        return tot
+
+    want = [ref(x[i], int(y[i])) for i in range(3)]
+    np.testing.assert_allclose(out.numpy().ravel(), want, atol=1e-4)
+
+
+def test_hsigmoid_custom_path():
+    D = 4
+    x = RNG.randn(2, D).astype("float32")
+    w = RNG.randn(5, D).astype("float32")
+    table = np.array([[0, 2, 4], [1, 3, -1]], "int64")
+    code = np.array([[1, 0, 1], [0, 1, 0]], "int64")
+    out = F.hsigmoid_loss(pt.to_tensor(x), pt.to_tensor(
+        np.array([0, 1], "int64")), 6, pt.to_tensor(w),
+        path_table=pt.to_tensor(table), path_code=pt.to_tensor(code))
+    want = []
+    for i in range(2):
+        tot = 0.0
+        for jj in range(3):
+            if table[i, jj] < 0:
+                continue
+            pre = w[table[i, jj]] @ x[i]
+            t = float(code[i, jj])
+            tot += max(pre, 0) - pre * t + np.log1p(np.exp(-abs(pre)))
+        want.append(tot)
+    np.testing.assert_allclose(out.numpy().ravel(), want, atol=1e-4)
+    with pytest.raises(ValueError):
+        F.hsigmoid_loss(pt.to_tensor(x), pt.to_tensor(
+            np.array([0, 1], "int64")), 6, pt.to_tensor(w),
+            path_table=pt.to_tensor(table))
+
+
+def test_hsigmoid_layer_trains():
+    layer = nn.HSigmoidLoss(6, 10)
+    x = Tensor(RNG.randn(4, 6).astype("float32"), stop_gradient=False)
+    loss = pt.sum(layer(x, pt.to_tensor(np.array([1, 5, 9, 0], "int64"))))
+    loss.backward()
+    assert x.grad is not None
+    assert np.isfinite(np.asarray(layer.weight.grad._data)).all()
+
+
+def test_multi_margin_loss():
+    x = RNG.randn(4, 5).astype("float32")
+    y = RNG.randint(0, 5, 4).astype("int64")
+    w = np.abs(RNG.randn(5)).astype("float32")
+    got = F.multi_margin_loss(pt.to_tensor(x), pt.to_tensor(y), p=2,
+                              margin=0.7, weight=pt.to_tensor(w),
+                              reduction="none")
+    want = []
+    for i in range(4):
+        acc = 0.0
+        for j in range(5):
+            if j != y[i]:
+                acc += w[y[i]] * max(0.0, 0.7 - x[i, y[i]] + x[i, j]) ** 2
+        want.append(acc / 5)
+    np.testing.assert_allclose(got.numpy(), want, rtol=1e-4)
+    assert nn.MultiMarginLoss()(pt.to_tensor(x),
+                                pt.to_tensor(y)).shape == []
+
+
+def test_pairwise_distance():
+    x = RNG.randn(4, 6).astype("float32")
+    y = RNG.randn(4, 6).astype("float32")
+    got = F.pairwise_distance(pt.to_tensor(x), pt.to_tensor(y))
+    want = np.linalg.norm(x - y + 1e-6, axis=-1)
+    np.testing.assert_allclose(got.numpy(), want, rtol=1e-5)
+    got = nn.PairwiseDistance(p=np.inf, keepdim=True)(
+        pt.to_tensor(x), pt.to_tensor(y))
+    np.testing.assert_allclose(
+        got.numpy(), np.abs(x - y + 1e-6).max(-1, keepdims=True), rtol=1e-5)
+
+
+@pytest.mark.parametrize("nd", [1, 2, 3])
+def test_max_unpool_roundtrip(nd):
+    shape = {1: (2, 3, 8), 2: (2, 3, 8, 8), 3: (1, 2, 4, 4, 4)}[nd]
+    x = RNG.randn(*shape).astype("float32")
+    pool = [F.max_pool1d, F.max_pool2d, F.max_pool3d][nd - 1]
+    unpool = [F.max_unpool1d, F.max_unpool2d, F.max_unpool3d][nd - 1]
+    pooled, idx = pool(pt.to_tensor(x), 2, return_mask=True)
+    un = unpool(pooled, idx, 2)
+    assert un.shape == list(shape)
+    # every pooled max value lands back at its argmax position
+    uv = un.numpy()
+    pv = pooled.numpy()
+    np.testing.assert_allclose(np.sort(uv[uv != 0]), np.sort(pv.ravel()),
+                               rtol=1e-6)
+    layer = [nn.MaxUnPool1D, nn.MaxUnPool2D, nn.MaxUnPool3D][nd - 1](2)
+    np.testing.assert_allclose(layer(pooled, idx).numpy(), uv)
+
+
+def test_max_unpool_grad():
+    x = Tensor(RNG.randn(1, 2, 4, 4).astype("float32"),
+               stop_gradient=False)
+    pooled, idx = F.max_pool2d(x, 2, return_mask=True)
+    out = F.max_unpool2d(pooled, idx, 2)
+    pt.sum(out * out).backward()
+    assert np.isfinite(np.asarray(x.grad._data)).all()
+
+
+def test_softmax2d_unflatten():
+    x = RNG.randn(2, 3, 4, 5).astype("float32")
+    out = nn.Softmax2D()(pt.to_tensor(x))
+    np.testing.assert_allclose(out.numpy().sum(1),
+                               np.ones((2, 4, 5)), rtol=1e-5)
+    with pytest.raises(ValueError):
+        nn.Softmax2D()(pt.to_tensor(np.zeros((2, 3), "float32")))
+    u = nn.Unflatten(1, [2, 2])(pt.to_tensor(RNG.randn(3, 4).astype("f")))
+    assert u.shape == [3, 2, 2]
+
+
+def test_rnnt_loss_layer():
+    logits = RNG.randn(2, 4, 3, 5).astype("float32")
+    labels = np.array([[1, 2], [1, 1]], "int32")
+    layer = nn.RNNTLoss(blank=0, fastemit_lambda=0.0)
+    out = layer(pt.to_tensor(logits), pt.to_tensor(labels),
+                pt.to_tensor(np.array([4, 4], "int32")),
+                pt.to_tensor(np.array([2, 2], "int32")))
+    want = F.rnnt_loss(pt.to_tensor(logits), pt.to_tensor(labels),
+                       pt.to_tensor(np.array([4, 4], "int32")),
+                       pt.to_tensor(np.array([2, 2], "int32")),
+                       blank=0, fastemit_lambda=0.0)
+    np.testing.assert_allclose(out.numpy(), want.numpy())
+
+
+def test_gather_tree_docs_example():
+    ids = pt.to_tensor(np.array(
+        [[[2, 2], [6, 1]], [[3, 9], [6, 1]], [[0, 1], [9, 0]]], "int64"))
+    parents = pt.to_tensor(np.array(
+        [[[0, 0], [1, 1]], [[1, 0], [1, 0]], [[0, 0], [0, 1]]], "int64"))
+    out = F.gather_tree(ids, parents)
+    want = [[[2, 2], [1, 6]], [[3, 3], [6, 1]], [[0, 1], [9, 0]]]
+    assert out.numpy().tolist() == want
+    with pytest.raises(ValueError):
+        F.gather_tree(pt.to_tensor(np.zeros((2, 2), "int64")), parents)
+
+
+def test_beam_search_decode_end_token_wins():
+    """A rigged cell that always prefers the end token must finish every
+    beam immediately and early-exit the decode loop."""
+    V, H = 7, 4
+
+    class RiggedCell(nn.RNNCellBase):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(H, V)
+
+        def forward(self, inputs, states=None):
+            logits = np.full((inputs.shape[0], V), -5.0, np.float32)
+            logits[:, 1] = 5.0  # end token
+            return Tensor(jnp.asarray(logits)), states
+
+    dec = nn.BeamSearchDecoder(RiggedCell(), start_token=0, end_token=1,
+                               beam_size=2,
+                               embedding_fn=nn.Embedding(V, H))
+    h0 = pt.to_tensor(np.zeros((3, H), "float32"))
+    outs, states, lens = nn.dynamic_decode(dec, inits=h0, max_step_num=10,
+                                           return_length=True)
+    # beam 0 ends at step 1; beam 1 keeps one non-end candidate for one
+    # more step (correct beam-search bookkeeping) — loop exits at T=2,
+    # far before max_step_num
+    assert outs.shape[1] == 2
+    arr = np.asarray(outs._data)
+    assert (arr[:, 0, 0] == 1).all()          # top beam: end immediately
+    assert np.asarray(states.finished).all()  # every beam finished
+    assert (np.asarray(lens._data)[:, 0] == 1).all()
+
+
+def test_beam_search_decode_greedy_path():
+    """Deterministic cell: token probabilities depend on the previous
+    token so the top beam must follow the argmax chain."""
+    V, H = 6, 5
+    table = np.random.RandomState(42).randn(V, V).astype("float32") * 3
+
+    class ChainCell(nn.RNNCellBase):
+        def forward(self, inputs, states=None):
+            # inputs: embedded previous token — we smuggle the raw id in
+            # states instead (states = last token ids [N, 1])
+            prev = states
+            logits = jnp.asarray(table)[prev._data[:, 0]]
+            return Tensor(logits), Tensor(prev._data)
+
+    class IdEmb(nn.Layer):
+        def forward(self, ids):
+            return ids
+
+    dec = nn.BeamSearchDecoder(ChainCell(), start_token=2, end_token=V - 1,
+                               beam_size=3, embedding_fn=IdEmb())
+    # states carry the previous ids; initialize with start token
+    h0 = pt.to_tensor(np.full((2, 1), 2, "int32"))
+
+    # patch: ChainCell ignores inputs; drive states with chosen tokens
+    class ChainCell2(ChainCell):
+        def forward(self, inputs, states=None):
+            ids = inputs._data.reshape(-1)
+            logits = jnp.asarray(table)[ids]
+            return Tensor(logits), states
+
+    dec = nn.BeamSearchDecoder(ChainCell2(), start_token=2,
+                               end_token=V - 1, beam_size=3,
+                               embedding_fn=IdEmb())
+    outs, _ = nn.dynamic_decode(dec, inits=h0, max_step_num=4)
+    got_first = np.asarray(outs._data)[0, :, 0]  # batch 0, top beam
+    # manual greedy chain from token 2 (greedy == top beam for step 1)
+    assert got_first[0] == int(np.argmax(table[2]))
+
+
+def test_margin_ce_layerwise_grad():
+    logits = Tensor(_cosine_logits(4, 6), stop_gradient=False)
+    label = pt.to_tensor(np.array([2, 0, 5, 3], "int64"))
+    loss = F.margin_cross_entropy(logits, label)
+    loss.backward()
+    assert np.isfinite(np.asarray(logits.grad._data)).all()
+
+
+def test_sparse_attention_vs_dense():
+    """CSR-masked attention must equal dense attention with the same
+    boolean mask (incl. an all-empty row and a padding/attn mask)."""
+    B, H, S, D = 2, 2, 8, 4
+    q = RNG.randn(B, H, S, D).astype("float32")
+    k = RNG.randn(B, H, S, D).astype("float32")
+    v = RNG.randn(B, H, S, D).astype("float32")
+    # random CSR pattern per (b, h); row 3 of (0,0) left empty
+    offsets = np.zeros((B, H, S + 1), "int32")
+    columns = np.zeros((B, H, S * S), "int32")
+    dense = np.zeros((B, H, S, S), bool)
+    for b in range(B):
+        for h in range(H):
+            ptr = 0
+            for r in range(S):
+                if (b, h, r) == (0, 0, 3):
+                    nnz = 0
+                else:
+                    nnz = RNG.randint(1, S)
+                cs = np.sort(RNG.choice(S, nnz, replace=False))
+                columns[b, h, ptr:ptr + nnz] = cs
+                dense[b, h, r, cs] = True
+                ptr += nnz
+                offsets[b, h, r + 1] = ptr
+    got = F.sparse_attention(
+        pt.to_tensor(q), pt.to_tensor(k), pt.to_tensor(v),
+        pt.to_tensor(offsets), pt.to_tensor(columns)).numpy()
+    # dense reference
+    s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+    s = np.where(dense, s, -1e9)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    p = np.where(dense, p, 0.0)
+    p = p / np.maximum(p.sum(-1, keepdims=True), 1e-9)
+    want = np.einsum("bhqk,bhkd->bhqd", p, v)
+    # empty row: output ~0 in our impl (all probs masked)
+    np.testing.assert_allclose(got[0, 0, 3], 0.0, atol=1e-5)
+    mask_rows = dense.any(-1)
+    np.testing.assert_allclose(got[mask_rows], want[mask_rows], atol=1e-4)
+
+
+def test_sparse_attention_masks_zero_means_masked():
+    B, H, S, D = 1, 1, 4, 4
+    q = RNG.randn(B, H, S, D).astype("float32")
+    # full CSR pattern
+    offsets = np.arange(0, (S + 1) * S, S, dtype="int32").reshape(1, 1, -1)
+    columns = np.tile(np.arange(S, dtype="int32"), S).reshape(1, 1, -1)
+    kpm = np.array([[1, 1, 0, 1]], "float32")  # key 2 padded out
+    got = F.sparse_attention(
+        pt.to_tensor(q), pt.to_tensor(q), pt.to_tensor(q),
+        pt.to_tensor(offsets), pt.to_tensor(columns),
+        key_padding_mask=pt.to_tensor(kpm)).numpy()
+    # attn_mask with 0 at (q=1, k=3) must match kpm-style masking there
+    am = np.ones((S, S), "float32")
+    am[1, 3] = 0.0
+    got2 = F.sparse_attention(
+        pt.to_tensor(q), pt.to_tensor(q), pt.to_tensor(q),
+        pt.to_tensor(offsets), pt.to_tensor(columns),
+        attn_mask=pt.to_tensor(am)).numpy()
+    assert np.isfinite(got).all() and np.isfinite(got2).all()
+    # key 2 contributes nothing under kpm: recompute densely without it
+    s = np.einsum("bhqd,bhkd->bhqk", q, q) / np.sqrt(D)
+    s[..., 2] = -1e9
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    want = np.einsum("bhqk,bhkd->bhqd", p, q)
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_dynamic_decode_custom_decoder():
+    """A minimal custom Decoder (plain tuples, no namedtuple/lengths)
+    must work through dynamic_decode."""
+    class CountDecoder(nn.decode.Decoder):
+        def initialize(self, inits):
+            import jax.numpy as jnp2
+            n = inits
+            return (jnp2.zeros((n,), "int32"),
+                    jnp2.zeros((n,), "int32"),
+                    jnp2.zeros((n,), bool))
+
+        def step(self, time, inputs, states, **kw):
+            import jax.numpy as jnp2
+            nxt = states + 1
+            out = inputs + nxt
+            fin = nxt >= 3
+            return out, nxt, out, fin
+
+        def finalize(self, outputs, final_states, seq_lens):
+            return outputs, final_states
+
+    outs, final, lens = nn.dynamic_decode(CountDecoder(), inits=4,
+                                          max_step_num=10,
+                                          return_length=True)
+    arr = np.asarray(outs)
+    assert arr.shape == (4, 3)  # batch-major [N, T]
+    assert (np.asarray(lens._data) == 3).all()
