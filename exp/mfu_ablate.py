@@ -1,0 +1,154 @@
+"""One GPT-345M MFU ablation on the real chip. Usage:
+
+    python exp/mfu_ablate.py '{"name": "base", "batch": 8, ...}'
+
+Config fields (all optional except name):
+  batch (8), seq (1024), dropout (None -> model default 0.1),
+  recompute (False), policy (None), mode ("step"|"fwd_bwd"|"fwd"|"loss_sum"),
+  flash (True), prng_impl (None|"rbg"|"unsafe_rbg"), iters (10), warmup (2)
+
+Prints ONE json line and appends it to exp/results.jsonl.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+cfg = json.loads(sys.argv[1]) if len(sys.argv) > 1 else {}
+NAME = cfg.get("name", "base")
+BATCH = int(cfg.get("batch", 8))
+SEQ = int(cfg.get("seq", 1024))
+DROPOUT = cfg.get("dropout")
+RECOMPUTE = bool(cfg.get("recompute", False))
+POLICY = cfg.get("policy")
+MODE = cfg.get("mode", "step")
+FLASH = bool(cfg.get("flash", True))
+PRNG = cfg.get("prng_impl")
+ITERS = int(cfg.get("iters", 10))
+WARMUP = int(cfg.get("warmup", 2))
+
+import jax  # noqa: E402
+
+if PRNG:
+    jax.config.update("jax_default_prng_impl", PRNG)
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import paddle_tpu as pt  # noqa: E402
+from paddle_tpu.jit.api import functional_call  # noqa: E402
+from paddle_tpu.tensor import Tensor  # noqa: E402
+from paddle_tpu.framework import flags as _flags  # noqa: E402
+from paddle_tpu.incubate.models import (GPTForCausalLM,  # noqa: E402
+                                        GPTPretrainingCriterion, gpt_345m)
+
+if not FLASH:
+    _flags.set_flags({"flash_min_seq": 1 << 30})
+
+pt.seed(0)
+kw = dict(tensor_parallel=False, use_recompute=RECOMPUTE,
+          recompute_policy=POLICY, max_position_embeddings=SEQ)
+if DROPOUT is not None:
+    kw.update(hidden_dropout_prob=DROPOUT,
+              attention_probs_dropout_prob=DROPOUT)
+mcfg = gpt_345m(**kw)
+model = GPTForCausalLM(mcfg)
+pt.amp.decorate(model, level="O2", dtype="bfloat16")
+crit = GPTPretrainingCriterion()
+opt = pt.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                         multi_precision=True)
+params = {k: p._data for k, p in model.named_parameters()}
+buffers = {k: b._data for k, b in model.named_buffers()}
+opt_state = opt.init_state_tree(params)
+fwd = getattr(model, "_orig_forward", model.forward)
+n_params = sum(int(np.prod(p.shape)) for p in params.values())
+
+
+def loss_of(p, ids, labels):
+    out, new_buffers = functional_call(model, p, buffers, (Tensor(ids),),
+                                       training=True, forward_fn=fwd)
+    if MODE == "loss_sum":
+        return out._data.astype(jnp.float32).mean(), new_buffers
+    loss = crit(out, Tensor(labels))
+    return loss._data.astype(jnp.float32), new_buffers
+
+
+if MODE == "fwd":
+    def step_fn(params, opt_state, ids, labels):
+        loss, _ = loss_of(params, ids, labels)
+        return (loss,)
+    donate = ()
+    n_state = 0
+elif MODE in ("fwd_bwd",):
+    def step_fn(params, opt_state, ids, labels):
+        (loss, _), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(params, ids, labels)
+        return loss, grads
+    donate = ()
+    n_state = 0
+else:  # step / loss_sum: full train step
+    def step_fn(params, opt_state, ids, labels):
+        (loss, _), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(params, ids, labels)
+        new_params, new_opt = opt.apply_gradients_tree(params, grads,
+                                                       opt_state)
+        return loss, new_params, new_opt
+    donate = (0, 1)
+    n_state = 2
+
+step = jax.jit(step_fn, donate_argnums=donate)
+rng = np.random.RandomState(0)
+ids = jnp.asarray(rng.randint(0, mcfg.vocab_size, (BATCH, SEQ))
+                  .astype(np.int32))
+labels = jnp.asarray(rng.randint(0, mcfg.vocab_size, (BATCH, SEQ))
+                     .astype(np.int32))
+
+res = {"name": NAME, "cfg": cfg, "n_params": n_params}
+t0 = time.perf_counter()
+compiled = step.lower(params, opt_state, ids, labels).compile()
+res["compile_sec"] = round(time.perf_counter() - t0, 2)
+try:
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0]
+    res["flops"] = float(ca.get("flops", 0.0))
+except Exception:
+    res["flops"] = None
+try:
+    ma = compiled.memory_analysis()
+    res["mem"] = {"arg": int(ma.argument_size_in_bytes),
+                  "temp": int(ma.temp_size_in_bytes)}
+except Exception:
+    pass
+
+state = [params, opt_state][:n_state]
+rest = [params, opt_state][n_state:] + [ids, labels]
+out = None
+for _ in range(WARMUP):
+    out = compiled(*state, *rest)
+    if n_state:
+        state = list(out[1:1 + n_state])
+jax.block_until_ready(out)
+t0 = time.perf_counter()
+for _ in range(ITERS):
+    out = compiled(*state, *rest)
+    if n_state:
+        state = list(out[1:1 + n_state])
+jax.block_until_ready(out)
+dt = time.perf_counter() - t0
+# read back the loss: proves the steps really executed on-device (a
+# too-good-to-be-true step time with a NaN/garbage loss = broken run)
+res["final_loss"] = float(np.asarray(out[0]))
+
+res["step_ms"] = round(dt / ITERS * 1000, 2)
+tps = BATCH * SEQ * ITERS / dt
+res["tokens_per_sec"] = round(tps, 1)
+per_token = 6 * n_params + 6 * mcfg.num_layers * SEQ * mcfg.hidden_size
+res["mfu_model"] = round(tps * per_token / 197e12, 4)
+
+line = json.dumps(res)
+print(line)
+with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "results.jsonl"), "a") as f:
+    f.write(line + "\n")

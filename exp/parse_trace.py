@@ -1,0 +1,44 @@
+"""Parse the captured xplane and print per-line structure + category and
+per-op aggregates for the device plane. Usage:
+    python exp/parse_trace.py [xplane.pb path]
+"""
+import collections
+import glob
+import re
+import sys
+
+from jax.profiler import ProfileData
+
+path = sys.argv[1] if len(sys.argv) > 1 else sorted(
+    glob.glob("/tmp/jaxtrace/**/*.xplane.pb", recursive=True))[-1]
+pd = ProfileData.from_file(path)
+
+STEPS = 3
+
+for plane in pd.planes:
+    if plane.name != "/device:TPU:0":
+        continue
+    for line in plane.lines:
+        events = list(line.events)
+        total = sum(e.duration_ns for e in events)
+        print(f"line {line.name!r}: {len(events)} events, "
+              f"{total/1e6:.1f} ms total")
+    for line in plane.lines:
+        if "XLA Ops" not in line.name and "Ops" not in line.name:
+            continue
+        agg = collections.defaultdict(float)
+        cat = collections.defaultdict(float)
+        for ev in line.events:
+            name = ev.name
+            agg[name] += ev.duration_ns
+            m = re.match(r"%?([a-zA-Z][a-zA-Z0-9_-]*)", name)
+            prefix = m.group(1).rstrip("0123456789.") if m else name[:20]
+            cat[prefix] += ev.duration_ns
+        total = sum(agg.values())
+        print(f"\n== line {line.name!r}: total {total/STEPS/1e6:.1f} ms/step")
+        print("-- by category:")
+        for k, v in sorted(cat.items(), key=lambda kv: -kv[1])[:20]:
+            print(f"  {v/STEPS/1e6:9.2f} ms/step {100*v/total:5.1f}%  {k}")
+        print("-- top ops:")
+        for k, v in sorted(agg.items(), key=lambda kv: -kv[1])[:30]:
+            print(f"  {v/STEPS/1e6:9.2f} ms/step {100*v/total:5.1f}%  {k[:140]}")

@@ -1,0 +1,79 @@
+"""Per-step SYNCED timing probe: forces a scalar readback every step so
+async-dispatch artifacts can't fake throughput. Compares dropout on/off in
+one process. Usage: python exp/probe_sync.py [batch]
+"""
+import json
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+SEQ = 1024
+STEPS = 12
+
+import paddle_tpu as pt  # noqa: E402
+from paddle_tpu.jit.api import functional_call  # noqa: E402
+from paddle_tpu.tensor import Tensor  # noqa: E402
+from paddle_tpu.incubate.models import (GPTForCausalLM,  # noqa: E402
+                                        GPTPretrainingCriterion, gpt_345m)
+
+
+def build(dropout):
+    pt.seed(0)
+    cfg = gpt_345m(tensor_parallel=False, use_recompute=False,
+                   max_position_embeddings=SEQ,
+                   hidden_dropout_prob=dropout,
+                   attention_probs_dropout_prob=dropout)
+    model = GPTForCausalLM(cfg)
+    pt.amp.decorate(model, level="O2", dtype="bfloat16")
+    crit = GPTPretrainingCriterion()
+    opt = pt.optimizer.AdamW(learning_rate=1e-4,
+                             parameters=model.parameters(),
+                             multi_precision=True)
+    params = {k: p._data for k, p in model.named_parameters()}
+    buffers = {k: b._data for k, b in model.named_buffers()}
+    opt_state = opt.init_state_tree(params)
+    fwd = getattr(model, "_orig_forward", model.forward)
+
+    def step_fn(params, opt_state, ids, labels):
+        def loss_of(p):
+            out, _ = functional_call(model, p, buffers, (Tensor(ids),),
+                                     training=True, forward_fn=fwd)
+            return crit(out, Tensor(labels))._data.astype(jnp.float32), None
+        (loss, _), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
+        new_params, new_opt = opt.apply_gradients_tree(params, grads,
+                                                       opt_state)
+        return loss, new_params, new_opt
+
+    step = jax.jit(step_fn, donate_argnums=(0, 1))
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (BATCH, SEQ))
+                      .astype(np.int32))
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (BATCH, SEQ))
+                         .astype(np.int32))
+    t0 = time.perf_counter()
+    compiled = step.lower(params, opt_state, ids, labels).compile()
+    csec = time.perf_counter() - t0
+    return compiled, params, opt_state, ids, labels, csec
+
+
+for dropout in ([float(sys.argv[2])] if len(sys.argv) > 2 else (0.1, 0.0)):
+    compiled, params, opt_state, ids, labels, csec = build(dropout)
+    times, losses = [], []
+    state = (params, opt_state)
+    for i in range(STEPS):
+        t0 = time.perf_counter()
+        loss, p2, o2 = compiled(*state, ids, labels)
+        lv = float(np.asarray(loss))  # hard sync: host readback
+        times.append(time.perf_counter() - t0)
+        losses.append(round(lv, 4))
+        state = (p2, o2)
+    times_ms = [round(t * 1000, 1) for t in times]
+    print(json.dumps({
+        "dropout": dropout, "batch": BATCH, "compile_sec": round(csec, 1),
+        "per_step_ms": times_ms,
+        "median_ms": round(sorted(times_ms)[len(times_ms) // 2], 1),
+        "losses": losses}))

@@ -1,0 +1,95 @@
+"""Capture a device trace of the GPT-345M train step and print the top
+op-time sinks, using jax.profiler + ProfileData (no tensorboard needed).
+Usage: python exp/profile_step.py [dropout]
+"""
+import collections
+import glob
+import os
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+DROPOUT = float(sys.argv[1]) if len(sys.argv) > 1 else 0.1
+BATCH, SEQ = 8, 1024
+
+import paddle_tpu as pt  # noqa: E402
+from paddle_tpu.jit.api import functional_call  # noqa: E402
+from paddle_tpu.tensor import Tensor  # noqa: E402
+from paddle_tpu.incubate.models import (GPTForCausalLM,  # noqa: E402
+                                        GPTPretrainingCriterion, gpt_345m)
+
+pt.seed(0)
+cfg = gpt_345m(tensor_parallel=False, use_recompute=False,
+               max_position_embeddings=SEQ, hidden_dropout_prob=DROPOUT,
+               attention_probs_dropout_prob=DROPOUT)
+model = GPTForCausalLM(cfg)
+pt.amp.decorate(model, level="O2", dtype="bfloat16")
+crit = GPTPretrainingCriterion()
+opt = pt.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                         multi_precision=True)
+params = {k: p._data for k, p in model.named_parameters()}
+buffers = {k: b._data for k, b in model.named_buffers()}
+opt_state = opt.init_state_tree(params)
+fwd = getattr(model, "_orig_forward", model.forward)
+
+
+def step_fn(params, opt_state, ids, labels):
+    def loss_of(p):
+        out, _ = functional_call(model, p, buffers, (Tensor(ids),),
+                                 training=True, forward_fn=fwd)
+        return crit(out, Tensor(labels))._data.astype(jnp.float32), None
+    (loss, _), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
+    new_params, new_opt = opt.apply_gradients_tree(params, grads, opt_state)
+    return loss, new_params, new_opt
+
+
+step = jax.jit(step_fn, donate_argnums=(0, 1))
+rng = np.random.RandomState(0)
+ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (BATCH, SEQ))
+                  .astype(np.int32))
+labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (BATCH, SEQ))
+                     .astype(np.int32))
+print("compiling...", flush=True)
+compiled = step.lower(params, opt_state, ids, labels).compile()
+state = (params, opt_state)
+for _ in range(2):
+    out = compiled(*state, ids, labels)
+    state = (out[1], out[2])
+jax.block_until_ready(out[0])
+
+logdir = "/tmp/jaxtrace"
+os.system(f"rm -rf {logdir}")
+print("tracing...", flush=True)
+with jax.profiler.trace(logdir):
+    for _ in range(3):
+        out = compiled(*state, ids, labels)
+        state = (out[1], out[2])
+    jax.block_until_ready(out[0])
+
+files = glob.glob(f"{logdir}/**/*.xplane.pb", recursive=True)
+print("xplane files:", files, flush=True)
+if not files:
+    sys.exit(1)
+from jax.profiler import ProfileData
+pd = ProfileData.from_file(files[0])
+agg = collections.defaultdict(float)
+plane_names = []
+for plane in pd.planes:
+    plane_names.append(plane.name)
+    if "TPU" not in plane.name and "Device" not in plane.name \
+            and "/device" not in plane.name.lower():
+        continue
+    for line in plane.lines:
+        for ev in line.events:
+            dur = ev.duration_ns
+            name = ev.name
+            agg[name] += dur
+print("planes:", plane_names)
+top = sorted(agg.items(), key=lambda kv: -kv[1])[:40]
+total = sum(agg.values())
+print(f"total device ns (3 steps): {total:.3e}")
+for name, ns in top:
+    print(f"{ns/3/1e6:9.2f} ms/step  {100*ns/total:5.1f}%  {name[:120]}")

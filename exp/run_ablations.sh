@@ -1,0 +1,19 @@
+#!/bin/bash
+# Serial MFU ablation ladder on the real chip. Each config is a fresh
+# process (clean compile). Results accumulate in exp/results.jsonl.
+cd "$(dirname "$0")/.."
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+run() {
+  echo "=== $1 ($(date +%H:%M:%S)) ==="
+  timeout 600 python exp/mfu_ablate.py "$1" 2>&1 | tail -2
+}
+run '{"name": "base", "batch": 8}'
+run '{"name": "fwd", "batch": 8, "mode": "fwd"}'
+run '{"name": "fwd_bwd", "batch": 8, "mode": "fwd_bwd"}'
+run '{"name": "nodrop", "batch": 8, "dropout": 0.0}'
+run '{"name": "loss_sum", "batch": 8, "mode": "loss_sum"}'
+run '{"name": "noflash", "batch": 8, "flash": false}'
+run '{"name": "b16_dots", "batch": 16, "recompute": true, "policy": "dots"}'
+run '{"name": "s2048_b4", "batch": 4, "seq": 2048}'
+run '{"name": "nodrop_rbg", "batch": 8, "dropout": 0.0, "prng_impl": "rbg"}'
+echo "=== DONE ($(date +%H:%M:%S)) ==="

@@ -1,0 +1,14 @@
+#!/bin/bash
+cd "$(dirname "$0")/.."
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+run() {
+  echo "=== $1 ($(date +%H:%M:%S)) ==="
+  timeout 600 python exp/mfu_ablate.py "$1" 2>&1 | tail -3
+}
+run '{"name": "fwd", "batch": 8, "mode": "fwd"}'
+run '{"name": "fwd_bwd", "batch": 8, "mode": "fwd_bwd"}'
+run '{"name": "nodrop", "batch": 8, "dropout": 0.0}'
+run '{"name": "loss_sum", "batch": 8, "mode": "loss_sum"}'
+run '{"name": "noflash_b4", "batch": 4, "flash": false}'
+run '{"name": "nodrop_rbg", "batch": 8, "dropout": 0.0, "prng_impl": "rbg"}'
+echo "=== DONE ($(date +%H:%M:%S)) ==="

@@ -155,6 +155,9 @@ class Node:
 _static_recorder = None
 _STATIC_SENTINEL = None
 
+_node_new = Node.__new__
+_flag_values = _flags._values  # direct dict ref for the per-op hot path
+
 # op observers: every funnel-recorded op reports (name, inputs, outputs).
 # Serves amp.debugging operator-stats / tensor-checker tooling (ref
 # ``python/paddle/amp/debugging.py``); empty-list check keeps the hot
@@ -187,20 +190,34 @@ def record(fn, tensors, outputs_wrap, name=""):
         if res is not _STATIC_SENTINEL:
             return res
     datas = tuple(t._data for t in tensors)
-    needs_grad = (
-        is_grad_enabled()
-        and not in_functional_mode()
-        and any(not t.stop_gradient for t in tensors)
-    )
+    # inlined is_grad_enabled()/in_functional_mode(): the per-op eager
+    # path is the framework's dispatch floor (bench_eager.py tracks it),
+    # so thread-local state is read via one __dict__ lookup each
+    st = _state.__dict__
+    needs_grad = False
+    if st.get("enabled", True) and not st.get("functional", 0):
+        for t in tensors:
+            if not t.stop_gradient:
+                needs_grad = True
+                break
     raw = fn(*datas)
     out_tensors, result = outputs_wrap(raw, needs_grad)
     if needs_grad:
-        node = Node(tensors, None, out_tensors, name=name, fn=fn,
-                    datas=datas)
+        node = _node_new(Node)
+        node.inputs = tensors  # callers pass fresh lists; alias, no copy
+        node.vjp_fn = None
+        node.fn = fn
+        node.datas = datas
+        node.out_refs = [weakref.ref(t) for t in out_tensors]
+        node.out_avals = [(t._data.shape, t._data.dtype)
+                          for t in out_tensors]
+        node.name = name
+        node._hooks = None
+        node._released = False
         for i, t in enumerate(out_tensors):
             t._node = node
             t._out_idx = i
-    if _flags.flag("check_nan_inf"):
+    if _flag_values.get("check_nan_inf"):
         _check_nan_inf(out_tensors, name)
     if _op_observers:
         for ob in list(_op_observers):

@@ -107,6 +107,11 @@ define_flag("check_nan_inf", False,
             "Scan op outputs for NaN/Inf after every eager op "
             "(ref: paddle/fluid/eager/nan_inf_utils.cc)")
 define_flag("benchmark", False, "Synchronize after every eager op for timing")
+define_flag("prng_impl", "rbg",
+            "PRNG implementation for framework-drawn keys: 'rbg' uses the "
+            "TPU-native XLA rng_bit_generator (threefry-seeded; measured "
+            "~60ms/step cheaper than 'threefry2x32' for GPT-345M dropout "
+            "masks on v5e), 'threefry2x32' is jax's default splittable RNG")
 def _set_matmul_precision(v):
     import jax
     jax.config.update("jax_default_matmul_precision",

@@ -44,7 +44,9 @@ class Generator:
 
     def _root_key(self):
         if self._root is None:
-            self._root = jax.random.key(self._seed)
+            from . import flags as _flags
+            impl = _flags.flag("prng_impl") or None
+            self._root = jax.random.key(self._seed, impl=impl)
         return self._root
 
     def initial_seed(self) -> int:

@@ -35,7 +35,6 @@ def gather_tree(ids, parents):
 
     def f(idv, parv):
         T, B, K = idv.shape
-        binx = jnp.arange(B)[:, None]
 
         def step(cur, tp):
             tok, par = tp
@@ -45,7 +44,6 @@ def gather_tree(ids, parents):
 
         init = jnp.broadcast_to(jnp.arange(K)[None, :], (B, K))
         _, outs = jax.lax.scan(step, init, (idv, parv), reverse=True)
-        del binx
         return outs
 
     return nary(f, [ids, parents], name="gather_tree")
@@ -112,7 +110,7 @@ class BeamSearchDecoder(Decoder):
     def initialize(self, inits):
         """inits: initial cell states, [batch, ...] leaves."""
         states = jax.tree_util.tree_map(
-            lambda t: jnp.repeat(np.asarray(t._data) if isinstance(t, Tensor)
+            lambda t: jnp.repeat(t._data if isinstance(t, Tensor)
                                  else jnp.asarray(t), self.beam_size, axis=0),
             inits, is_leaf=lambda t: isinstance(t, Tensor))
         leaf = jax.tree_util.tree_leaves(states)[0]
@@ -207,8 +205,20 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
     step_outputs = []
     own_lengths = None  # fallback when the decoder's states carry none
     for t in range(int(max_step_num)):
+        prev_fin = finished._data if isinstance(finished, Tensor) \
+            else jnp.asarray(finished)
         outputs, states, inputs, finished = decoder.step(
             t, inputs, states, **kwargs)
+        if impute_finished:
+            # reference semantics: steps after a sequence finished emit
+            # zeros (so time-reductions over the outputs match)
+            def _zero_done(leaf, fin=prev_fin):
+                arr = leaf._data if isinstance(leaf, Tensor) else leaf
+                f = fin.reshape(fin.shape + (1,) * (arr.ndim - fin.ndim))
+                out = jnp.where(f, jnp.zeros((), arr.dtype), arr)
+                return Tensor(out) if isinstance(leaf, Tensor) else out
+            outputs = jax.tree_util.tree_map(
+                _zero_done, outputs, is_leaf=lambda x: isinstance(x, Tensor))
         step_outputs.append(outputs)
         fin = finished._data if isinstance(finished, Tensor) else finished
         fin = jnp.asarray(fin)

@@ -18,4 +18,4 @@ from ..decode import gather_tree  # noqa: F401  (ref: functional/extension.py)
 
 __all__ = (activation.__all__ + conv.__all__ + pooling.__all__ +
            norm.__all__ + loss.__all__ + common.__all__ + vision.__all__ +
-           ["flash_attn_unpadded"])
+           ["flash_attn_unpadded", "gather_tree"])

@@ -86,13 +86,15 @@ def _install_tensor_methods():
     # dunder operators
     import jax.numpy as jnp
     from .op_utils import binary as _binary, unary as _unary
-    Tensor.__add__ = lambda s, o: _m.add(s, o)
+    # forward binary dunders bind the op directly (no lambda frame —
+    # this is the eager dispatch floor, see bench_eager.py)
+    Tensor.__add__ = _m.add
     Tensor.__radd__ = lambda s, o: _m.add(o, s)
-    Tensor.__sub__ = lambda s, o: _m.subtract(s, o)
+    Tensor.__sub__ = _m.subtract
     Tensor.__rsub__ = lambda s, o: _m.subtract(o, s)
-    Tensor.__mul__ = lambda s, o: _m.multiply(s, o)
+    Tensor.__mul__ = _m.multiply
     Tensor.__rmul__ = lambda s, o: _m.multiply(o, s)
-    Tensor.__truediv__ = lambda s, o: _m.divide(s, o)
+    Tensor.__truediv__ = _m.divide
     Tensor.__rtruediv__ = lambda s, o: _m.divide(o, s)
     Tensor.__floordiv__ = lambda s, o: _m.floor_divide(s, o)
     Tensor.__rfloordiv__ = lambda s, o: _m.floor_divide(o, s)

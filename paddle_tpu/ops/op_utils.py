@@ -36,13 +36,38 @@ def const(x):
     return jnp.asarray(x)
 
 
+_tensor_new = Tensor.__new__
+_jax_types = (jax.Array, jax.core.Tracer)
+
+
+def _fast_tensor(raw, req):
+    """Slot-writing Tensor constructor for op outputs — the eager hot
+    path (SURVEY §3.1: the reference spends a codegen subsystem keeping
+    per-op dispatch cheap; here it is skipping __init__'s conversion
+    logic for already-jax outputs, ~2µs/op)."""
+    if not isinstance(raw, _jax_types):
+        return Tensor(raw, stop_gradient=not req)
+    t = _tensor_new(Tensor)
+    t._data = raw
+    t.stop_gradient = not req
+    t._grad = None
+    t._node = None
+    t._out_idx = 0
+    # t.name stays unset — lazily generated on first access
+    t.persistable = False
+    t.trainable = req
+    t._grad_hooks = None
+    t._spec = None
+    return t
+
+
 def _wrap_single(raw, req):
-    t = Tensor(raw, stop_gradient=not req)
+    t = _fast_tensor(raw, req)
     return [t], t
 
 
 def _wrap_tuple(raw, req):
-    ts = tuple(Tensor(r, stop_gradient=not req) for r in raw)
+    ts = tuple(_fast_tensor(r, req) for r in raw)
     return list(ts), ts
 
 

@@ -145,9 +145,12 @@ def _fwd_kernel(*refs, causal, sm_scale, block_q, block_k, q_len, kv_len,
         acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+        # MXU contract: feed bf16 operands, accumulate fp32 via
+        # preferred_element_type — an fp32 .astype before the dot would
+        # run the MXU in fp32 mode at ~1/4 throughput (this exact
+        # mistake cost 56% of the r03 GPT step, profile 2026-07-30)
+        s = jax.lax.dot_general(q_ref[0], k_ref[0],
+                                (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * sm_scale
 
@@ -169,7 +172,7 @@ def _fwd_kernel(*refs, causal, sm_scale, block_q, block_k, q_len, kv_len,
             keep = _tile_keep_mask(seed_ref[0], b, qi, ki, block_q, block_k,
                                    p_drop)
             p = jnp.where(keep, p / (1.0 - p_drop), 0.0)
-        pv = jax.lax.dot_general(p, v_ref[0].astype(jnp.float32),
+        pv = jax.lax.dot_general(p.astype(v_ref.dtype), v_ref[0],
                                  (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         acc_scr[:] = acc_scr[:] * alpha + pv
@@ -269,18 +272,18 @@ def _bwd_dq_kernel(*refs, causal, sm_scale, block_q, block_k,
         dq_scr[:] = jnp.zeros(dq_scr.shape, jnp.float32)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+        # bf16 operands into every dot; fp32 only for accumulators and
+        # the softmax math (see the fwd kernel's MXU-contract note)
+        s = jax.lax.dot_general(q_ref[0], k_ref[0],
+                                (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * sm_scale
         mask = _key_mask(lens_ref, shift_ref, b, qi, ki, block_q,
                          block_k, q_len, kv_len, causal)
         p = jnp.exp(s - lse_ref[0])
         p = jnp.where(mask, p, 0.0)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+        dp = jax.lax.dot_general(do_ref[0], v_ref[0],
+                                 (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         if p_drop > 0.0:
             # gradient flows only through kept elements (dp ∘ M/(1-r));
@@ -290,7 +293,7 @@ def _bwd_dq_kernel(*refs, causal, sm_scale, block_q, block_k,
             dp = jnp.where(keep, dp / (1.0 - p_drop), 0.0)
         ds = p * (dp - delta_ref[0])
         dq_scr[:] = dq_scr[:] + sm_scale * jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
@@ -324,11 +327,9 @@ def _bwd_dkv_kernel(*refs, causal, sm_scale, block_q, block_k, q_len,
         dv_scr[:] = jnp.zeros(dv_scr.shape, jnp.float32)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+        # bf16 operands into every dot (see the fwd kernel's MXU note)
+        s = jax.lax.dot_general(q_ref[0], k_ref[0],
+                                (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * sm_scale
         mask = _key_mask(lens_ref, shift_ref, b, qi, ki, block_q,
@@ -344,16 +345,17 @@ def _bwd_dkv_kernel(*refs, causal, sm_scale, block_q, block_k, q_len,
             p_tilde = p
         # dv += p̃^T @ do (dropped probabilities fed the forward output)
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
-            p_tilde, do, (((0,), (0,)), ((), ())),
+            p_tilde.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+        dp = jax.lax.dot_general(do_ref[0], v_ref[0],
+                                 (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         if p_drop > 0.0:
             dp = jnp.where(keep, dp * inv, 0.0)
         ds = p * (dp - delta_ref[0])
         # dk += ds^T @ q
         dk_scr[:] = dk_scr[:] + sm_scale * jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
@@ -552,9 +554,12 @@ def mha(q, k, v, *, causal=False, sm_scale=None, block_q=None, block_k=None,
             q, k, causal, interpret)) if _at.enabled() else None
         if hit is not None:
             block_q, block_k = hit
-    # explicitly passed blocks always win; unset ones default to 128
-    block_q = 128 if block_q is None else block_q
-    block_k = 128 if block_k is None else block_k
+    # explicitly passed blocks always win. Default: big q/k blocks —
+    # on v5e the per-grid-step revisit overhead dominates below ~512,
+    # measured 2026-07-30 at (8,16,1024,64): fwd+bwd 11.4ms at 128/128
+    # vs 3.2ms at 1024/512 (exp/bench_flash.py)
+    block_q = 1024 if block_q is None else block_q
+    block_k = 512 if block_k is None else block_k
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
     block_q = min(block_q, _ceil_to(sq, 8))
@@ -600,14 +605,13 @@ def mha(q, k, v, *, causal=False, sm_scale=None, block_q=None, block_k=None,
 
 
 def tune_mha(q, k, v, *, causal=False, interpret=None,
-             candidates=((128, 128), (256, 128), (128, 256), (256, 256),
-                         (512, 128))):
+             candidates=((128, 128), (256, 256), (512, 256), (512, 512),
+                         (1024, 256), (1024, 512))):
     """Warmup autotune for :func:`mha`: eagerly time the candidate
     (block_q, block_k) configs on REAL arrays, cache the winner keyed by
     (seq, d, dtype, causal) so subsequent (including traced) calls pick
     it up. Returns (best_config, timings). Candidates larger than the
     padded sequence are deduplicated after clamping."""
-    import jax as _jax
     from . import autotune as _at
 
     if interpret is None:
@@ -620,10 +624,17 @@ def tune_mha(q, k, v, *, causal=False, interpret=None,
             seen.add(clamped)
             todo.append(clamped)
 
+    state = {"q": q}
+
     def run(cfg):
-        out = mha(q, k, v, causal=causal, block_q=cfg[0], block_k=cfg[1],
-                  interpret=interpret)
-        _jax.block_until_ready(out)
+        # thread the output back in (fresh inputs per call) and fence
+        # with a host readback: remote-device backends can both cache
+        # identical repeated executions and no-op block_until_ready,
+        # which would make every candidate time the same
+        out = mha(state["q"], k, v, causal=causal, block_q=cfg[0],
+                  block_k=cfg[1], interpret=interpret)
+        state["q"] = (out.astype(jnp.float32) * 1e-3).astype(q.dtype)
+        float(jnp.sum(state["q"].astype(jnp.float32)))
 
     best, timings = _at.time_candidates(run, todo)
     _at.cache_put("flash_mha", _mha_tune_key(q, k, causal, interpret), best)

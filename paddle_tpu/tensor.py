@@ -64,11 +64,22 @@ class Tensor:
         self._grad = None
         self._node = None
         self._out_idx = 0
-        self.name = name or _next_name()
+        if name is not None:
+            self.name = name  # else lazily generated via __getattr__
         self.persistable = False
         self.trainable = not stop_gradient
         self._grad_hooks = None
         self._spec = None  # optional jax PartitionSpec annotation (distributed)
+
+    def __getattr__(self, attr):
+        # unset slots raise AttributeError which routes here: generate
+        # tensor names lazily — most op outputs are never asked for one,
+        # and the f-string counter shows up in the eager dispatch floor
+        if attr == "name":
+            n = _next_name()
+            self.name = n
+            return n
+        raise AttributeError(attr)
 
     # -- basic metadata ----------------------------------------------------
     @property

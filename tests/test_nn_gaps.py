@@ -401,3 +401,48 @@ def test_dynamic_decode_custom_decoder():
     arr = np.asarray(outs)
     assert arr.shape == (4, 3)  # batch-major [N, T]
     assert (np.asarray(lens._data) == 3).all()
+
+
+def test_fastpath_fields_cover_slots():
+    """The hot-path inlined constructors (autograd.record's Node fill,
+    op_utils._fast_tensor) must keep setting every slot their classes
+    declare — guards the duplicated field lists against silent desync."""
+    from paddle_tpu.autograd import Node
+    a = Tensor(np.ones(2, "float32"), stop_gradient=False)
+    out = a * 2.0  # goes through _fast_tensor + inlined Node fill
+    lazy_ok = {"name"}  # generated on first access via __getattr__
+    for slot in Tensor.__slots__:
+        if slot in ("__weakref__",) or slot in lazy_ok:
+            continue
+        assert hasattr(out, slot), f"_fast_tensor missed slot {slot}"
+    node = out._node
+    for slot in Node.__slots__:
+        if slot == "__weakref__":
+            continue
+        assert hasattr(node, slot), f"record() missed Node slot {slot}"
+
+
+def test_dynamic_decode_impute_finished():
+    class TwoStep(nn.decode.Decoder):
+        def initialize(self, inits):
+            return (jnp.zeros((3,), "float32"), jnp.zeros((3,), "int32"),
+                    jnp.zeros((3,), bool))
+
+        def step(self, time, inputs, states, **kw):
+            nxt = states + 1
+            out = jnp.ones((3,), "float32")
+            fin = nxt >= jnp.asarray([1, 2, 3])
+            return out, nxt, out, fin
+
+        def finalize(self, outputs, final_states, seq_lens):
+            return outputs, final_states
+
+    outs, _, lens = nn.dynamic_decode(TwoStep(), inits=None, max_step_num=5,
+                                      impute_finished=True,
+                                      return_length=True)
+    arr = np.asarray(outs)  # [3, 3] batch-major
+    # row 0 finished at t=1 -> steps 2,3 imputed to 0
+    np.testing.assert_allclose(arr[0], [1, 0, 0])
+    np.testing.assert_allclose(arr[1], [1, 1, 0])
+    np.testing.assert_allclose(arr[2], [1, 1, 1])
+    np.testing.assert_array_equal(np.asarray(lens._data), [1, 2, 3])
