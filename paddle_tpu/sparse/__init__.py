@@ -45,14 +45,19 @@ class SparseCooTensor:
 
     format = "coo"
 
-    def __init__(self, bcoo: jsparse.BCOO):
+    def __init__(self, bcoo: jsparse.BCOO, values_t=None):
         self._bcoo = bcoo
+        # optional tape-linked values Tensor (set by sparse.nn ops so
+        # gradients flow through sparse layers like dense ones)
+        self._values_t = values_t
 
     # -- paddle surface -----------------------------------------------------
     def indices(self):
         return Tensor(self._bcoo.indices.T)  # paddle: [sparse_dim, nnz]
 
     def values(self):
+        if self._values_t is not None:
+            return self._values_t
         return Tensor(self._bcoo.data)
 
     def to_dense(self):
@@ -146,6 +151,16 @@ def sparse_coo_tensor(indices, values, shape=None, dtype=None,
                       place=None, stop_gradient=True):
     """``paddle.sparse.sparse_coo_tensor`` (indices: [sparse_dim, nnz])."""
     idx = _data(indices).astype(jnp.int32).T  # jax BCOO: [nnz, sparse_dim]
+    # keep the tape link only when the caller did NOT ask for a detached
+    # tensor (explicit stop_gradient=False, the paddle contract) — or
+    # when the values are themselves a recorded op output (sparse.nn
+    # internals thread gradients through here)
+    is_op_output = (isinstance(values, Tensor)
+                    and not values.stop_gradient
+                    and values._node is not None)
+    keep_link = (isinstance(values, Tensor) and dtype is None
+                 and (not stop_gradient or is_op_output))
+    vals_t = values if keep_link else None
     vals = _data(values)
     if dtype is not None:
         from ..framework.dtype import to_jax_dtype
@@ -154,7 +169,7 @@ def sparse_coo_tensor(indices, values, shape=None, dtype=None,
         shape = tuple(int(i) + 1 for i in idx.max(axis=0))
         shape = shape + vals.shape[1:]
     bcoo = jsparse.BCOO((vals, idx), shape=tuple(int(s) for s in shape))
-    return SparseCooTensor(bcoo)
+    return SparseCooTensor(bcoo, values_t=vals_t)
 
 
 def sparse_csr_tensor(crows, cols, values, shape, dtype=None):

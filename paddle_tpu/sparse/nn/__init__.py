@@ -1,0 +1,186 @@
+"""``paddle.sparse.nn`` layers (ref: ``python/paddle/sparse/nn/``;
+conv layers ``layer/conv.py:239 Conv3D`` / ``:509 SubmConv3D``).
+
+See ``functional`` for the TPU realization (scatter → dense XLA op on
+the MXU → gather at the rulebook output pattern, tape-recorded).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...nn.layer.layers import Layer
+from ...nn import initializer as I
+from . import functional  # noqa: F401
+from . import functional as F
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "BatchNorm",
+           "SyncBatchNorm", "Conv2D", "Conv3D", "SubmConv2D", "SubmConv3D",
+           "MaxPool3D"]
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return F.relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return F.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01, name=None):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        return F.leaky_relu(x, self.negative_slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, self.axis)
+
+
+class _SparseConv(Layer):
+    def __init__(self, nd, subm, in_channels, out_channels, kernel_size,
+                 stride=1, padding=0, dilation=1, groups=1,
+                 padding_mode="zeros", weight_attr=None, bias_attr=None,
+                 data_format=None, key=None):
+        super().__init__()
+        if padding_mode != "zeros":
+            raise NotImplementedError("sparse conv padding_mode")
+        self._nd = nd
+        self._subm = subm
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.groups = groups
+        k = kernel_size if isinstance(kernel_size, (tuple, list)) \
+            else (kernel_size,) * nd
+        # paddle sparse weight layout: DHWIO (spatial..., in, out)
+        fan_in = int(np.prod(k)) * in_channels
+        self.weight = self.create_parameter(
+            list(k) + [in_channels, out_channels], attr=weight_attr,
+            default_initializer=I.Normal(std=(2.0 / fan_in) ** 0.5))
+        self.bias = self.create_parameter([out_channels], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x):
+        fn = {(2, False): F.conv2d, (2, True): F.subm_conv2d,
+              (3, False): F.conv3d, (3, True): F.subm_conv3d}[
+            (self._nd, self._subm)]
+        return fn(x, self.weight, bias=self.bias, stride=self.stride,
+                  padding=self.padding, dilation=self.dilation,
+                  groups=self.groups)
+
+
+class Conv3D(_SparseConv):
+    """ref ``sparse/nn/layer/conv.py:239``."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__(3, False, in_channels, out_channels, kernel_size,
+                         stride, padding, dilation, groups, padding_mode,
+                         weight_attr, bias_attr, data_format)
+
+
+class SubmConv3D(_SparseConv):
+    """ref ``sparse/nn/layer/conv.py:509``: output sites == input sites."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NDHWC"):
+        super().__init__(3, True, in_channels, out_channels, kernel_size,
+                         stride, padding, dilation, groups, padding_mode,
+                         weight_attr, bias_attr, data_format, key=key)
+
+
+class Conv2D(_SparseConv):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NHWC"):
+        super().__init__(2, False, in_channels, out_channels, kernel_size,
+                         stride, padding, dilation, groups, padding_mode,
+                         weight_attr, bias_attr, data_format)
+
+
+class SubmConv2D(_SparseConv):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NHWC"):
+        super().__init__(2, True, in_channels, out_channels, kernel_size,
+                         stride, padding, dilation, groups, padding_mode,
+                         weight_attr, bias_attr, data_format, key=key)
+
+
+class BatchNorm(Layer):
+    """Sparse batch norm over active values (ref ``sparse/nn/layer/
+    norm.py BatchNorm``)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.use_global_stats = use_global_stats
+        self.weight = self.create_parameter(
+            [num_features], attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter([num_features], attr=bias_attr,
+                                          is_bias=True)
+        from ...tensor import Tensor
+        import jax.numpy as jnp
+        self.register_buffer("_mean", Tensor(jnp.zeros(num_features)))
+        self.register_buffer("_variance", Tensor(jnp.ones(num_features)))
+
+    def forward(self, x):
+        return F.batch_norm(
+            x, self._mean, self._variance, weight=self.weight,
+            bias=self.bias, training=self.training,
+            momentum=self.momentum, epsilon=self.epsilon,
+            use_global_stats=self.use_global_stats)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-replica sparse BN. On TPU the stats ride the same GSPMD
+    machinery as dense SyncBatchNorm — under a data-parallel mesh the
+    value statistics are computed over the global (sharded) nnz axis by
+    XLA; single-process semantics equal BatchNorm (ref
+    ``sparse/nn/layer/norm.py SyncBatchNorm``)."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        if isinstance(layer, BatchNorm) and not isinstance(
+                layer, SyncBatchNorm):
+            new = cls.__new__(cls)
+            new.__dict__.update(layer.__dict__)
+            return new
+        for name, sub in list(getattr(layer, "_sub_layers", {}).items()):
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return layer
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 return_mask=False, data_format="NDHWC", name=None):
+        super().__init__()
+        if return_mask:
+            raise NotImplementedError(
+                "sparse MaxPool3D return_mask is not supported")
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.ceil_mode = ceil_mode
+
+    def forward(self, x):
+        return F.max_pool3d(x, self.kernel_size, stride=self.stride,
+                            padding=self.padding, ceil_mode=self.ceil_mode)

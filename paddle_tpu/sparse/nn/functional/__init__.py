@@ -1,0 +1,352 @@
+"""``paddle.sparse.nn.functional`` (ref: ``python/paddle/sparse/nn/
+functional/``; kernels ``paddle/phi/kernels/sparse/gpu/conv_kernel.cu``).
+
+TPU design: XLA has no sparse-conv primitive, and on the MXU a dense
+conv over the scattered activations is the fast realization at the
+densities these layers see in practice — so each op is
+scatter(values) → dense XLA op → gather(output pattern), all recorded on
+the tape (grads flow to values AND layer parameters). The output
+sparsity pattern is computed from the INPUT pattern alone (the
+reference's rulebook semantics, not value thresholding):
+
+ - subm_conv*: output pattern == input pattern (submanifold rule)
+ - conv* / max_pool3d: a site is active iff its kernel window touches an
+   active input site — a host-side numpy union over kernel offsets
+   (the reference builds the same product set on device, conv_kernel.cu
+   ProductRuleBook).
+"""
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ....tensor import Tensor
+from ....ops.op_utils import ensure_tensor, nary
+from ... import SparseCooTensor, SparseCsrTensor
+from ...import _coo
+
+__all__ = ["conv2d", "conv3d", "subm_conv2d", "subm_conv3d", "max_pool3d",
+           "relu", "relu6", "leaky_relu", "softmax", "attention",
+           "batch_norm"]
+
+
+def _tup(v, n):
+    return tuple(v) if isinstance(v, (tuple, list)) else (int(v),) * n
+
+
+def _values_tensor(x: SparseCooTensor) -> Tensor:
+    if getattr(x, "_values_t", None) is not None:
+        return x._values_t
+    return Tensor(x._bcoo.data)
+
+
+def _host_indices(x: SparseCooTensor) -> np.ndarray:
+    return np.asarray(x._bcoo.indices)  # [nnz, 1 + nd] (batch + spatial)
+
+
+def _out_pattern(idx, spatial_in, kernel, stride, padding, dilation,
+                 ceil_mode=False):
+    """Active output sites for a standard sparse conv/pool: union over
+    kernel offsets of reachable strided positions. Host-side numpy —
+    pattern discovery is data-dependent (dynamic nnz), exactly like the
+    reference's rulebook build."""
+    nd = len(kernel)
+
+    def _osz(si, k, s, p, d):
+        num = si + 2 * p - (d * (k - 1) + 1)
+        return (num + s - 1) // s + 1 if ceil_mode else num // s + 1
+
+    spatial_out = tuple(
+        _osz(si, k, s, p, d)
+        for si, k, s, p, d in zip(spatial_in, kernel, stride, padding,
+                                  dilation))
+    batch = idx[:, 0]
+    sp = idx[:, 1:1 + nd]
+    outs = []
+    for off in itertools.product(*(range(k) for k in kernel)):
+        cand = sp + np.asarray(padding) - np.asarray(off) * np.asarray(
+            dilation)
+        ok = np.ones(len(cand), bool)
+        for a in range(nd):
+            ok &= (cand[:, a] % stride[a] == 0)
+        pos = cand // np.asarray(stride)
+        for a in range(nd):
+            ok &= (pos[:, a] >= 0) & (pos[:, a] < spatial_out[a])
+        if ok.any():
+            outs.append(np.concatenate(
+                [batch[ok, None], pos[ok]], axis=1))
+    if not outs:
+        return np.zeros((0, 1 + nd), np.int32), spatial_out
+    uni = np.unique(np.concatenate(outs, axis=0), axis=0)
+    return uni.astype(np.int32), spatial_out
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, subm, nd,
+          opname):
+    """Shared sparse conv: NDHWC/NHWC input, DHWIO/HWIO weight (paddle
+    sparse layout)."""
+    if groups != 1:
+        raise NotImplementedError("sparse conv groups > 1")
+    kernel_t = ensure_tensor(weight)
+    k = tuple(int(s) for s in kernel_t.shape[:nd])
+    stride = _tup(stride, nd)
+    padding = _tup(padding, nd)
+    dilation = _tup(dilation, nd)
+    shape = tuple(x.shape)
+    spatial_in = shape[1:1 + nd]
+    cin, cout = int(kernel_t.shape[nd]), int(kernel_t.shape[nd + 1])
+    idx = _host_indices(x)
+    if subm:
+        if stride != (1,) * nd:
+            raise ValueError("subm conv requires stride 1")
+        out_idx, spatial_out = idx.astype(np.int32), spatial_in
+    else:
+        out_idx, spatial_out = _out_pattern(idx, spatial_in, k, stride,
+                                            padding, dilation)
+    out_shape = (shape[0],) + tuple(spatial_out) + (cout,)
+    vals_t = _values_tensor(x)
+    args = [vals_t, kernel_t]
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+    idx_j = jnp.asarray(idx)
+    out_idx_j = jnp.asarray(out_idx)
+    dn = lax.conv_dimension_numbers(
+        (1,) * (nd + 2), (1,) * (nd + 2),
+        ("NDHWC" if nd == 3 else "NHWC",
+         "DHWIO" if nd == 3 else "HWIO",
+         "NDHWC" if nd == 3 else "NHWC"))
+
+    def f(vals, w, *b):
+        dense = jnp.zeros(shape[:1 + nd] + (cin,), vals.dtype)
+        dense = dense.at[tuple(idx_j[:, i] for i in range(1 + nd))].set(vals)
+        out = lax.conv_general_dilated(
+            dense, w, window_strides=stride,
+            padding=[(p, p) for p in padding], rhs_dilation=dilation,
+            dimension_numbers=dn)
+        if b:
+            out = out + b[0]
+        return out[tuple(out_idx_j[:, i] for i in range(1 + nd))]
+
+    out_vals = nary(f, args, name=opname)
+    from ....sparse import sparse_coo_tensor
+    return sparse_coo_tensor(Tensor(out_idx_j.T), out_vals,
+                             shape=out_shape)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", name=None):
+    """ref ``sparse/nn/functional/conv.py conv3d``."""
+    return _conv(x, weight, bias, stride, padding, dilation, groups,
+                 subm=False, nd=3, opname="sparse_conv3d")
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    """Submanifold conv (ref ``conv.py subm_conv3d``): output pattern ==
+    input pattern."""
+    return _conv(x, weight, bias, stride, padding, dilation, groups,
+                 subm=True, nd=3, opname="sparse_subm_conv3d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NHWC", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups,
+                 subm=False, nd=2, opname="sparse_conv2d")
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NHWC", key=None, name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups,
+                 subm=True, nd=2, opname="sparse_subm_conv2d")
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NDHWC", name=None):
+    """Sparse max pool (ref ``sparse/nn/functional/pooling.py``): dense
+    reduce_window over the scattered sites, output pattern from the
+    input pattern."""
+    nd = 3
+    k = _tup(kernel_size, nd)
+    stride = _tup(stride if stride is not None else kernel_size, nd)
+    padding = _tup(padding, nd)
+    shape = tuple(x.shape)
+    spatial_in = shape[1:1 + nd]
+    C = shape[-1]
+    idx = _host_indices(x)
+    out_idx, spatial_out = _out_pattern(idx, spatial_in, k, stride, padding,
+                                        (1,) * nd, ceil_mode=ceil_mode)
+    out_shape = (shape[0],) + tuple(spatial_out) + (C,)
+    idx_j = jnp.asarray(idx)
+    out_idx_j = jnp.asarray(out_idx)
+    vals_t = _values_tensor(x)
+
+    def f(vals):
+        dense = jnp.full(shape[:1 + nd] + (C,), -jnp.inf, vals.dtype)
+        dense = dense.at[tuple(idx_j[:, i] for i in range(1 + nd))].set(vals)
+        pads = [(0, 0)] + [
+            (p, p + (s - 1 if ceil_mode else 0))
+            for p, s in zip(padding, stride)] + [(0, 0)]
+        out = lax.reduce_window(
+            dense, -jnp.inf, lax.max, (1,) + k + (1,), (1,) + stride + (1,),
+            pads)
+        return out[tuple(out_idx_j[:, i] for i in range(1 + nd))]
+
+    out_vals = nary(f, [vals_t], name="sparse_max_pool3d")
+    from ....sparse import sparse_coo_tensor
+    return sparse_coo_tensor(Tensor(out_idx_j.T), out_vals, shape=out_shape)
+
+
+def _value_unary(fn, opname):
+    def op(x, *fargs, name=None):
+        vals_t = _values_tensor(x)
+        out_vals = nary(lambda v: fn(v, *fargs), [vals_t], name=opname)
+        b = x._bcoo
+        import jax.experimental.sparse as jsparse
+        return SparseCooTensor(
+            jsparse.BCOO((out_vals._data, b.indices), shape=b.shape),
+            values_t=out_vals)
+    return op
+
+
+relu = _value_unary(lambda v: jnp.maximum(v, 0), "sparse_relu")
+relu6 = _value_unary(lambda v: jnp.clip(v, 0, 6), "sparse_relu6")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _value_unary(
+        lambda v: jnp.where(v > 0, v, v * negative_slope),
+        "sparse_leaky_relu")(x)
+
+
+def _row_softmax(vals_t, row_ids, nrows, opname):
+    def f(vals):
+        v32 = vals.astype(jnp.float32)
+        vmax = jax.ops.segment_max(v32, row_ids, num_segments=nrows)
+        shifted = jnp.exp(v32 - vmax[row_ids])
+        denom = jax.ops.segment_sum(shifted, row_ids, num_segments=nrows)
+        return (shifted / denom[row_ids]).astype(vals.dtype)
+    return nary(f, [vals_t], name=opname)
+
+
+def softmax(x, axis=-1, name=None):
+    """Per-row softmax over the stored values (ref
+    ``sparse/nn/functional/activation.py softmax``, axis=-1 only).
+
+    COO input keeps its value order AND tape link (gradients flow from
+    downstream ops to upstream sparse layers); CSR input records from
+    its stored values."""
+    if axis != -1:
+        raise ValueError("sparse softmax only supports axis=-1")
+    import jax.experimental.sparse as jsparse
+    if isinstance(x, SparseCooTensor):
+        b = x._bcoo
+        if b.data.ndim > 1:
+            # trailing DENSE dims (e.g. channels): axis=-1 is a plain
+            # per-site softmax over the dense axis
+            out_vals = nary(
+                lambda v: jax.nn.softmax(
+                    v.astype(jnp.float32), axis=-1).astype(v.dtype),
+                [_values_tensor(x)], name="sparse_softmax")
+            return SparseCooTensor(
+                jsparse.BCOO((out_vals._data, b.indices), shape=b.shape),
+                values_t=out_vals)
+        idx = np.asarray(b.indices)
+        if idx.shape[0] != len(np.unique(idx, axis=0)):
+            raise ValueError("sparse softmax requires a coalesced COO "
+                             "tensor (call coalesce() first)")
+        # fully sparse: rows = flattened leading sparse dims
+        lead_shape = tuple(x.shape[:idx.shape[1] - 1])
+        row_ids = jnp.asarray(np.ravel_multi_index(
+            tuple(idx[:, a] for a in range(idx.shape[1] - 1)),
+            lead_shape).astype(np.int32))
+        nrows = int(np.prod(lead_shape))
+        out_vals = _row_softmax(_values_tensor(x), row_ids, nrows,
+                                "sparse_softmax")
+        return SparseCooTensor(
+            jsparse.BCOO((out_vals._data, b.indices), shape=b.shape),
+            values_t=out_vals)
+    if isinstance(x, SparseCsrTensor):
+        b = x._bcsr
+        indptr = np.asarray(b.indptr)
+        if indptr.ndim > 1:
+            raise NotImplementedError("batched CSR softmax")
+        nrows = indptr.shape[0] - 1
+        row_ids = jnp.asarray(np.repeat(np.arange(nrows),
+                                        np.diff(indptr)).astype(np.int32))
+        out_vals = _row_softmax(Tensor(b.data), row_ids, nrows,
+                                "sparse_softmax")
+        return SparseCsrTensor(jsparse.BCSR(
+            (out_vals._data, b.indices, b.indptr), shape=b.shape))
+    raise TypeError("sparse softmax expects a sparse tensor")
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NDHWC", use_global_stats=None, name=None):
+    """Channel batch-norm over the ACTIVE values only (ref
+    ``sparse/nn/layer/norm.py BatchNorm``: stats over nnz, not the
+    zero-filled dense volume)."""
+    vals_t = _values_tensor(x)
+    rm = ensure_tensor(running_mean)
+    rv = ensure_tensor(running_var)
+    use_stats = (not training) if use_global_stats is None \
+        else use_global_stats
+
+    args = [vals_t]
+    for t in (weight, bias):
+        if t is not None:
+            args.append(ensure_tensor(t))
+    has_w = weight is not None
+    has_b = bias is not None
+
+    def f(vals, *wb):
+        v32 = vals.astype(jnp.float32)
+        if use_stats:
+            mean, var = rm._data, rv._data
+        else:
+            mean = jnp.mean(v32, axis=0)
+            var = jnp.var(v32, axis=0)
+        out = (v32 - mean) / jnp.sqrt(var + epsilon)
+        i = 0
+        if has_w:
+            out = out * wb[i]
+            i += 1
+        if has_b:
+            out = out + wb[i]
+        return out.astype(vals.dtype)
+
+    out_vals = nary(f, args, name="sparse_batch_norm")
+    if training and not use_stats:
+        # running-stat update (host path, like the dense BN layer)
+        v32 = np.asarray(vals_t._data, np.float32) \
+            if not isinstance(vals_t._data, jax.core.Tracer) else None
+        if v32 is not None:
+            m, v = v32.mean(0), v32.var(0)
+            rm._data = rm._data * momentum + m * (1 - momentum)
+            rv._data = rv._data * momentum + v * (1 - momentum)
+    b = x._bcoo
+    import jax.experimental.sparse as jsparse
+    return SparseCooTensor(
+        jsparse.BCOO((out_vals._data, b.indices), shape=b.shape),
+        values_t=out_vals)
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse transformer attention (ref ``sparse/nn/functional/
+    transformer.py attention``): q/k/v dense [B, H, S, D]; sparse_mask a
+    CSR [B*H, S, S] pattern. Rides the dense-masked
+    ``F.sparse_attention`` realization."""
+    from ....nn.functional.common import sparse_attention as _dense_sa
+    q = ensure_tensor(query)
+    B, H, S, _ = q.shape
+    b = sparse_mask._bcsr
+    indptr = jnp.asarray(b.indptr).reshape(B, H, S + 1)
+    cols = jnp.asarray(b.indices).reshape(B, H, -1)
+    return _dense_sa(q, key, value, Tensor(indptr), Tensor(cols),
+                     key_padding_mask=key_padding_mask,
+                     attn_mask=attn_mask)

@@ -1,0 +1,256 @@
+"""sparse.nn: conv/pool/norm/activation over BCOO vs dense masked oracles
+(ref: ``python/paddle/sparse/nn/layer/conv.py:239,509``)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+from jax import lax
+
+import paddle_tpu as pt
+import paddle_tpu.sparse as sp
+import paddle_tpu.sparse.nn as snn
+from paddle_tpu import Tensor
+
+RNG = np.random.RandomState(0)
+
+
+def _rand_coo(nnz=20, shape=(2, 6, 6, 6, 3)):
+    nd = len(shape) - 2
+    idx = np.stack([RNG.randint(0, shape[0], nnz)] +
+                   [RNG.randint(0, shape[1 + a], nnz) for a in range(nd)])
+    idx = np.unique(idx.T, axis=0).T
+    vals = RNG.randn(idx.shape[1], shape[-1]).astype("float32")
+    x = sp.sparse_coo_tensor(pt.to_tensor(idx), pt.to_tensor(vals),
+                             shape=list(shape))
+    return x, idx, vals
+
+
+def _dense(idx, vals, shape):
+    d = np.zeros(shape, "float32")
+    d[tuple(idx)] = vals
+    return d
+
+
+def _conv_oracle(dense, w, b, stride, pad, nd):
+    dn = lax.conv_dimension_numbers(
+        (1,) * (nd + 2), (1,) * (nd + 2),
+        ("NDHWC" if nd == 3 else "NHWC", "DHWIO" if nd == 3 else "HWIO",
+         "NDHWC" if nd == 3 else "NHWC"))
+    out = lax.conv_general_dilated(
+        jnp.asarray(dense), jnp.asarray(w), (stride,) * nd,
+        [(pad, pad)] * nd, dimension_numbers=dn)
+    return np.asarray(out) + (b if b is not None else 0)
+
+
+def test_subm_conv3d_matches_masked_dense():
+    x, idx, vals = _rand_coo()
+    conv = snn.SubmConv3D(3, 4, 3, padding=1)
+    out = conv(x)
+    assert out.nnz == x.nnz and out.shape == [2, 6, 6, 6, 4]
+    oracle = _conv_oracle(_dense(idx, vals, (2, 6, 6, 6, 3)),
+                          np.asarray(conv.weight._data),
+                          np.asarray(conv.bias._data), 1, 1, 3)
+    got = out.to_dense().numpy()
+    np.testing.assert_allclose(got[tuple(idx)], oracle[tuple(idx)],
+                               atol=1e-4)
+    # submanifold rule: zero everywhere else, even where the oracle isn't
+    mask = np.zeros((2, 6, 6, 6), bool)
+    mask[tuple(idx)] = True
+    assert np.allclose(got[~mask], 0)
+
+
+def test_conv3d_pattern_and_values():
+    x, idx, vals = _rand_coo()
+    conv = snn.Conv3D(3, 4, 3, stride=2, padding=1)
+    out = conv(x)
+    assert out.shape == [2, 3, 3, 3, 4]
+    oracle = _conv_oracle(_dense(idx, vals, (2, 6, 6, 6, 3)),
+                          np.asarray(conv.weight._data),
+                          np.asarray(conv.bias._data), 2, 1, 3)
+    oi = np.asarray(out._bcoo.indices)
+    np.testing.assert_allclose(out.to_dense().numpy()[tuple(oi.T)],
+                               oracle[tuple(oi.T)], atol=1e-4)
+    # rulebook completeness: every site whose window touches an active
+    # input must be in the pattern
+    active = set(map(tuple, oi))
+    for (b, d, h, w) in map(tuple, idx.T[:, :4]):
+        od, oh, ow = (d + 1) // 2, (h + 1) // 2, (w + 1) // 2
+        if od < 3 and oh < 3 and ow < 3:
+            assert (b, od, oh, ow) in active
+
+
+def test_subm_conv2d():
+    x, idx, vals = _rand_coo(15, (2, 8, 8, 3))
+    conv = snn.SubmConv2D(3, 5, 3, padding=1)
+    out = conv(x)
+    oracle = _conv_oracle(_dense(idx, vals, (2, 8, 8, 3)),
+                          np.asarray(conv.weight._data),
+                          np.asarray(conv.bias._data), 1, 1, 2)
+    got = out.to_dense().numpy()
+    np.testing.assert_allclose(got[tuple(idx)], oracle[tuple(idx)],
+                               atol=1e-4)
+
+
+def test_sparse_conv_grad_fd():
+    """FD check on one weight element through subm conv + relu."""
+    x, idx, vals = _rand_coo(8, (1, 4, 4, 4, 2))
+    conv = snn.SubmConv3D(2, 2, 3, padding=1)
+
+    def loss_val():
+        out = snn.functional.relu(conv(x))
+        return float(pt.sum(out.values() * out.values()).numpy())
+
+    out = snn.functional.relu(conv(x))
+    loss = pt.sum(out.values() * out.values())
+    loss.backward()
+    g = np.asarray(conv.weight.grad._data)
+
+    w = conv.weight
+    eps = 1e-2
+    base = np.asarray(w._data).copy()
+    for pos in [(0, 0, 0, 0, 0), (1, 2, 1, 1, 1)]:
+        pert = base.copy()
+        pert[pos] += eps
+        w._data = jnp.asarray(pert)
+        up = loss_val()
+        pert[pos] -= 2 * eps
+        w._data = jnp.asarray(pert)
+        dn = loss_val()
+        w._data = jnp.asarray(base)
+        fd = (up - dn) / (2 * eps)
+        np.testing.assert_allclose(g[pos], fd, rtol=5e-2, atol=5e-2)
+
+
+def test_sparse_batch_norm_stats():
+    x, idx, vals = _rand_coo()
+    bn = snn.BatchNorm(3)
+    bn.train()
+    out = bn(x)
+    ov = out.values().numpy()
+    # normalized over active values only
+    np.testing.assert_allclose(ov.mean(0), 0, atol=1e-4)
+    np.testing.assert_allclose(ov.var(0), 1, atol=1e-3)
+    # eval mode uses running stats
+    bn.eval()
+    out2 = bn(x).values().numpy()
+    assert not np.allclose(out2.mean(0), 0, atol=1e-6)
+
+
+def test_sparse_activations_and_pool():
+    x, idx, vals = _rand_coo()
+    r = snn.ReLU()(x).values().numpy()
+    np.testing.assert_allclose(r, np.maximum(vals, 0), atol=1e-6)
+    l = snn.LeakyReLU(0.1)(x).values().numpy()
+    np.testing.assert_allclose(l, np.where(vals > 0, vals, 0.1 * vals),
+                               atol=1e-6)
+    r6 = snn.functional.relu6(x).values().numpy()
+    np.testing.assert_allclose(r6, np.clip(vals, 0, 6), atol=1e-6)
+    mp = snn.MaxPool3D(2)(x)
+    dense = _dense(idx, vals, (2, 6, 6, 6, 3))
+    # dense max pool oracle at the active output sites; empty windows in
+    # the sparse realization hold -inf -> only compare active sites
+    oracle = np.asarray(lax.reduce_window(
+        jnp.asarray(np.where(dense == 0, -np.inf, dense)), -jnp.inf,
+        lax.max, (1, 2, 2, 2, 1), (1, 2, 2, 2, 1), "VALID"))
+    oi = np.asarray(mp._bcoo.indices)
+    got = mp.values().numpy()
+    want = oracle[tuple(oi.T)]
+    # windows whose max is an explicit active value
+    np.testing.assert_allclose(got[np.isfinite(want)],
+                               want[np.isfinite(want)], atol=1e-5)
+
+
+def test_sparse_softmax_csr():
+    m = RNG.rand(5, 6)
+    m[m < 0.5] = 0
+    csr = sp.sparse_coo_tensor(
+        pt.to_tensor(np.stack(np.nonzero(m))),
+        pt.to_tensor(m[m != 0].astype("float32")),
+        shape=[5, 6]).to_sparse_csr()
+    out = snn.Softmax()(csr).to_dense().numpy()
+    rows = (m != 0)
+    for r in range(5):
+        if rows[r].any():
+            e = np.exp(m[r][rows[r]] - m[r][rows[r]].max())
+            want = e / e.sum()
+            np.testing.assert_allclose(out[r][rows[r]], want, atol=1e-5)
+    with pytest.raises(ValueError):
+        snn.functional.softmax(csr, axis=0)
+
+
+def test_sparse_attention_wrapper():
+    B, H, S, D = 1, 2, 4, 4
+    q = pt.to_tensor(RNG.randn(B, H, S, D).astype("float32"))
+    # full mask pattern as a batched CSR [B*H, S, S] (ref layout)
+    crows = np.tile(np.arange(0, (S + 1) * S, S, dtype="int32"),
+                    (B * H, 1))
+    cols = np.tile(np.tile(np.arange(S, dtype="int32"), S), (B * H, 1))
+    vals = np.ones((B * H, S * S), "float32")
+    mask = sp.sparse_csr_tensor(crows, cols, vals, [B * H, S, S])
+    out = snn.functional.attention(q, q, q, mask)
+    # equals dense softmax attention with full pattern
+    qn = q.numpy()
+    s = np.einsum("bhqd,bhkd->bhqk", qn, qn) / np.sqrt(D)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    want = np.einsum("bhqk,bhkd->bhqd", p, qn)
+    np.testing.assert_allclose(out.numpy(), want, atol=1e-4)
+
+
+def test_sync_batchnorm_convert():
+    class Net(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.bn = snn.BatchNorm(3)
+
+    net = Net()
+    out = snn.SyncBatchNorm.convert_sync_batchnorm(net)
+    assert isinstance(out.bn, snn.SyncBatchNorm)
+
+
+def test_softmax_coo_keeps_tape():
+    """conv -> relu -> COO softmax -> backward must reach the conv
+    weights (the severed-tape regression)."""
+    x, idx, vals = _rand_coo(10, (1, 4, 4, 4, 2))
+    conv = snn.SubmConv3D(2, 3, 3, padding=1)
+    out = snn.functional.softmax(snn.functional.relu(conv(x)))
+    assert isinstance(out, sp.SparseCooTensor)
+    loss = pt.sum(out.values() * out.values())
+    loss.backward()
+    assert conv.weight.grad is not None
+    assert np.isfinite(np.asarray(conv.weight.grad._data)).all()
+    # channel softmax: each active site's channel vector sums to 1
+    ov = out.values().numpy()
+    np.testing.assert_allclose(ov.sum(-1), 1.0, atol=1e-5)
+    # fully sparse COO: softmax over the last sparse dim, tape-linked
+    vals1 = Tensor(RNG.randn(4).astype("float32"), stop_gradient=False)
+    idx1 = pt.to_tensor(np.array([[0, 0, 1, 1], [0, 1, 0, 2]], "int64"))
+    m = sp.sparse_coo_tensor(idx1, vals1, shape=[2, 3],
+                             stop_gradient=False)
+    sm = snn.functional.softmax(m)
+    d1 = sm.to_dense().numpy()
+    np.testing.assert_allclose(d1[0, :2].sum(), 1.0, atol=1e-5)
+    pt.sum(sm.values()).backward()
+    assert vals1.grad is not None
+
+
+def test_sparse_coo_tensor_stop_gradient_contract():
+    vals = Tensor(RNG.randn(3, 2).astype("float32"), stop_gradient=False)
+    idx = pt.to_tensor(np.array([[0, 1, 2], [0, 1, 0]], "int64"))
+    # default stop_gradient=True -> detached values
+    t = sp.sparse_coo_tensor(idx, vals, shape=[3, 3, 2])
+    assert t.values().stop_gradient
+    # explicit stop_gradient=False keeps the link
+    t2 = sp.sparse_coo_tensor(idx, vals, shape=[3, 3, 2],
+                              stop_gradient=False)
+    assert t2.values() is vals
+
+
+def test_sparse_pool_ceil_mode():
+    x, idx, vals = _rand_coo(12, (1, 5, 5, 5, 2))
+    out_floor = snn.MaxPool3D(2, stride=2)(x)
+    out_ceil = snn.MaxPool3D(2, stride=2, ceil_mode=True)(x)
+    assert out_floor.shape[1:4] == [2, 2, 2]
+    assert out_ceil.shape[1:4] == [3, 3, 3]
+    with pytest.raises(NotImplementedError):
+        snn.MaxPool3D(2, return_mask=True)
