@@ -1,0 +1,18 @@
+#!/bin/bash
+# Wait for the TPU tunnel to recover, then run the full bench.
+cd /root/repo
+for i in $(seq 1 40); do
+  echo "=== probe attempt $i ($(date +%H:%M:%S))"
+  if timeout 120 python -c "
+import jax
+x = jax.numpy.ones((128,128), jax.numpy.bfloat16)
+print('tunnel ok', float((x@x).sum()))"; then
+    echo "=== tunnel up, running bench ($(date +%H:%M:%S))"
+    python /root/repo/bench.py > /tmp/bench_full.log 2>&1
+    echo "=== bench rc=$? ($(date +%H:%M:%S))"
+    exit 0
+  fi
+  sleep 120
+done
+echo "=== gave up"
+exit 1

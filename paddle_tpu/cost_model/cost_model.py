@@ -97,6 +97,12 @@ class CostModel:
                        else "paddle_gpu_time_backward")
                 op_cost["op_time"] = op_data[key]
                 op_cost["config"] = op_data["config"]
+        if not op_cost:
+            raise KeyError(
+                f"no cost-table row for op {op_name!r} with dtype "
+                f"{dtype!r}; the table may have been generated on a "
+                f"different device kind — re-run "
+                f"CostModel.benchmark_ops() on this host")
         return op_cost
 
     # -- table generation (replaces the reference's CI benchmark job) -----

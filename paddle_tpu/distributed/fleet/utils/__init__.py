@@ -8,7 +8,14 @@ class LocalFS:
 
     def ls_dir(self, path):
         import os
-        return [], os.listdir(path) if os.path.isdir(path) else []
+        if not os.path.isdir(path):
+            return [], []
+        entries = os.listdir(path)
+        dirs = [e for e in entries
+                if os.path.isdir(os.path.join(path, e))]
+        files = [e for e in entries
+                 if not os.path.isdir(os.path.join(path, e))]
+        return dirs, files
 
     def is_exist(self, path):
         import os

@@ -19,8 +19,156 @@ from .executor import (  # noqa: F401
 from .gradients import append_backward, gradients  # noqa: F401
 from .io import (  # noqa: F401
     save, load, save_inference_model, load_inference_model,
+    serialize_program, serialize_persistables, save_to_file,
+    deserialize_program, deserialize_persistables, load_from_file,
+    normalize_program, load_program_state, set_program_state,
 )
+from .ema import ExponentialMovingAverage  # noqa: F401
 from . import nn_static as nn  # noqa: F401
+from ..framework.device import device_guard, CPUPlace, TPUPlace  # noqa: F401
+from ..ops.creation import create_parameter  # noqa: F401
+
+
+def cpu_places(device_count=None):
+    """ref ``static/__init__.py cpu_places``."""
+    import os as _os
+    n = device_count or int(_os.environ.get("CPU_NUM", 1))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """Parity alias: the accelerator places on this build are TPU chips."""
+    import jax as _jax
+    if device_ids is None:
+        device_ids = range(len(_jax.devices()))
+    return [TPUPlace(i) for i in device_ids]
+
+
+xpu_places = cuda_places
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """Scope-resident constant var (ref ``tensor/creation.py
+    create_global_var``)."""
+    from ..tensor import Tensor
+    from ..framework.dtype import to_jax_dtype
+    import jax.numpy as _jnp
+    data = _jnp.full(tuple(int(s) for s in shape), value,
+                     to_jax_dtype(dtype))
+    t = Tensor(data, name=name)
+    t.persistable = persistable
+    prog = default_main_program()
+    if prog is not None and persistable:
+        prog.register_param(t)
+    return t
+
+
+def Print(input, first_n=-1, message=None, summarize=20, **kwargs):
+    """Debug print pass-through (ref ``static/nn/control_flow.py Print``);
+    eager/traced-safe via jax.debug.print."""
+    import jax
+    from ..ops.op_utils import unary
+    msg = message or ""
+
+    def f(d):
+        # debug.callback, not debug.print: the message is user text, not
+        # a format spec (braces in it must print literally)
+        jax.debug.callback(lambda arr: print(msg, arr), d)
+        return d
+    return unary(f, input, name="print")
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-callback op (ref ``static/nn/common.py py_func``): runs a
+    python function over tensor values via pure_callback."""
+    import jax
+    from ..ops.op_utils import nary, ensure_tensor
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    specs = [jax.ShapeDtypeStruct(tuple(o.shape), o._data.dtype)
+             for o in outs]
+
+    def f(*datas):
+        res = jax.pure_callback(
+            lambda *arrs: func(*arrs), specs if len(specs) > 1 else specs[0],
+            *datas)
+        return res
+    return nary(f, [ensure_tensor(v) for v in xs], name="py_func",
+                n_out=len(specs))
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """Batch top-k accuracy (ref ``static/nn/metric.py accuracy``)."""
+    from ..metric import accuracy as _acc
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """Batch AUC (ref ``static/nn/metric.py auc``) — returns the AUC
+    value computed over this batch."""
+    import numpy as _np
+    from ..tensor import Tensor
+    from ..metric import Auc
+    m = Auc(curve=curve, num_thresholds=num_thresholds)
+    preds = _np.asarray(input._data)
+    if preds.ndim == 1:
+        preds = _np.stack([1 - preds, preds], axis=1)
+    m.update(preds, _np.asarray(label._data))
+    return Tensor(_np.asarray(m.accumulate(), _np.float32))
+
+
+class BuildStrategy:
+    """Graph-build options holder (ref ``BuildStrategy`` pybind). On TPU
+    the XLA pipeline subsumes the pass toggles — attributes are accepted
+    and recorded so reference scripts run unchanged."""
+
+    def __init__(self):
+        self.__dict__["_opts"] = {}
+
+    def __setattr__(self, k, v):
+        self._opts[k] = v
+
+    def __getattr__(self, k):
+        if k.startswith("_"):
+            raise AttributeError(k)
+        return self._opts.get(k)
+
+
+class ExecutionStrategy(BuildStrategy):
+    """Executor options holder (ref ``ExecutionStrategy`` pybind)."""
+
+
+from ..nn.layer.layers import ParamAttr as _ParamAttr  # noqa: E402
+
+
+class WeightNormParamAttr(_ParamAttr):
+    """Weight-normalized parameter attribute (ref
+    ``static/param_attr.py WeightNormParamAttr``). Records ``dim``; the
+    reparameterization itself rides ``nn.utils.weight_norm``."""
+
+    def __init__(self, dim=None, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = dim
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """LR schedule factory (legacy ``static`` spelling, ref
+    ``layers/learning_rate_scheduler.py exponential_decay``):
+    lr * decay_rate^(step/decay_steps), floored per interval when
+    ``staircase``. Returns the dygraph/static-unified scheduler form."""
+    import math as _math
+    from ..optimizer.lr import LambdaDecay
+
+    def factor(step):
+        t = step / float(decay_steps)
+        if staircase:
+            t = _math.floor(t)
+        return decay_rate ** t
+
+    return LambdaDecay(learning_rate=learning_rate, lr_lambda=factor)
 
 InputSpec = None  # set below (shared with jit)
 try:
@@ -34,4 +182,11 @@ __all__ = [
     "global_scope", "scope_guard", "CompiledProgram", "append_backward",
     "gradients", "save", "load", "save_inference_model",
     "load_inference_model", "nn", "InputSpec",
+    "serialize_program", "serialize_persistables", "save_to_file",
+    "deserialize_program", "deserialize_persistables", "load_from_file",
+    "normalize_program", "load_program_state", "set_program_state",
+    "ExponentialMovingAverage", "device_guard", "create_parameter",
+    "cpu_places", "cuda_places", "xpu_places", "create_global_var",
+    "Print", "py_func", "accuracy", "auc", "BuildStrategy",
+    "ExecutionStrategy", "WeightNormParamAttr", "exponential_decay",
 ]

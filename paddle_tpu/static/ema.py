@@ -1,0 +1,87 @@
+"""ExponentialMovingAverage for static programs (ref:
+``python/paddle/static/__init__.py`` → ``incubate/optimizer/...
+ExponentialMovingAverage`` in the reference tree).
+
+The reference builds EMA as extra program ops over persistable vars; here
+the scope IS the parameter store, so EMA is three scope transforms:
+``update()`` folds current params into the shadow dict, ``apply()``
+swaps shadows in (context manager), ``restore()`` swaps back.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+import jax.numpy as jnp
+
+from .executor import global_scope
+from . import graph as G
+
+__all__ = ["ExponentialMovingAverage"]
+
+
+class ExponentialMovingAverage:
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = float(decay)
+        self._thres_steps = thres_steps
+        self._shadow: dict = {}
+        self._backup: dict = {}
+        self._injected: list = []  # keys set with no prior scope value
+        self._dtypes: dict = {}
+        self._step = 0
+
+    def _param_keys(self, program):
+        program = program or G.default_main_program()
+        return [k for k in program.scope_tensors if "@state@" not in k]
+
+    def update(self, program=None):
+        """Fold current parameter values into the shadow average. With
+        ``thres_steps`` the effective decay warms up like the reference:
+        min(decay, (1+steps)/(10+steps))."""
+        scope = global_scope()
+        d = self._decay
+        if self._thres_steps is not None:
+            d = min(d, (1.0 + self._step) / (10.0 + self._step))
+        for k in self._param_keys(program):
+            v = scope.find_var(k)
+            if v is None:
+                continue
+            # accumulate in f32, remember the param dtype for apply()
+            cur = np.asarray(v).astype(np.float32)
+            self._dtypes[k] = np.asarray(v).dtype
+            if k not in self._shadow:
+                self._shadow[k] = cur.copy()
+            else:
+                self._shadow[k] = d * self._shadow[k] + (1.0 - d) * cur
+        self._step += 1
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        """Swap EMA values into the scope for evaluation."""
+        scope = global_scope()
+        self._backup = {}
+        self._injected = []
+        for k, ema_v in self._shadow.items():
+            v = scope.find_var(k)
+            if v is not None:
+                self._backup[k] = v
+            else:
+                self._injected.append(k)
+            dt = self._dtypes.get(k, np.float32)
+            scope.set(k, jnp.asarray(ema_v.astype(dt)))
+        try:
+            yield self
+        finally:
+            if need_restore:
+                self.restore(executor)
+
+    def restore(self, executor=None):
+        scope = global_scope()
+        for k, v in self._backup.items():
+            scope.set(k, v)
+        # keys that had NO scope value before apply() must not linger —
+        # a later Executor.run would silently pick up the EMA value
+        for k in self._injected:
+            scope.vars.pop(k, None)
+        self._backup = {}
+        self._injected = []

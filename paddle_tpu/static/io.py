@@ -21,7 +21,11 @@ import jax.numpy as jnp
 from . import graph as G
 from .executor import global_scope, Executor
 
-__all__ = ["save", "load", "save_inference_model", "load_inference_model"]
+__all__ = ["save", "load", "save_inference_model", "load_inference_model",
+           "serialize_program", "serialize_persistables", "save_to_file",
+           "deserialize_program", "deserialize_persistables",
+           "load_from_file", "normalize_program", "load_program_state",
+           "set_program_state"]
 
 
 def _program_state(program, scope):
@@ -168,3 +172,114 @@ def load_inference_model(path_prefix, executor=None):
     prog = _LoadedInferenceProgram(exported, params, meta["feed_names"],
                                    meta["fetch_names"])
     return prog, meta["feed_names"], meta["fetch_names"]
+
+
+# -- program/persistable (de)serialization (ref static/io.py) ---------------
+_EXPORT_CACHE: dict = {}
+
+
+def _export_blob(feed_vars, fetch_vars, program=None):
+    """Shared core of save_inference_model/serialize_program: export the
+    feed→fetch function to a serialized StableHLO blob + params. The
+    canonical call pattern (serialize_program then serialize_persistables
+    on the same vars) must not pay the StableHLO trace twice — one-entry
+    memo keyed by the exact (feed, fetch, program) identity."""
+    import tempfile
+    key = (tuple(id(v) for v in feed_vars),
+           tuple(id(v) for v in fetch_vars), id(program))
+    hit = _EXPORT_CACHE.get("entry")
+    if hit is not None and hit[0] == key:
+        return hit[1], hit[2]
+    with tempfile.TemporaryDirectory() as td:
+        prefix = os.path.join(td, "m")
+        save_inference_model(prefix, feed_vars, fetch_vars, None,
+                             program=program)
+        with open(prefix + ".pdmodel", "rb") as f:
+            model = f.read()
+        with open(prefix + ".pdiparams", "rb") as f:
+            persist = f.read()
+    _EXPORT_CACHE["entry"] = (key, model, persist)
+    return model, persist
+
+
+def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):
+    """Program -> bytes (the StableHLO export; ref ``static/io.py
+    serialize_program`` emits the pruned ProgramDesc proto)."""
+    return _export_blob(feed_vars, fetch_vars, program)[0]
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None,
+                           program=None, **kwargs):
+    """Persistable values -> bytes."""
+    return _export_blob(feed_vars, fetch_vars, program)[1]
+
+
+def deserialize_program(data):
+    """bytes -> runnable exported program (jax.export artifact)."""
+    return jax.export.deserialize(bytearray(data))
+
+
+def deserialize_persistables(program, data, executor=None):
+    """bytes -> {name: array}; also loads them into the global scope so a
+    subsequent Executor.run sees the restored values."""
+    meta = pickle.loads(data)
+    params = {k: jnp.asarray(v) for k, v in meta["params"].items()}
+    scope = global_scope()
+    for k, v in params.items():
+        scope.set(k, v)
+    return params
+
+
+def save_to_file(path, content):
+    """Raw bytes → file (ref ``static/io.py save_to_file``)."""
+    if not isinstance(content, bytes):
+        raise TypeError("save_to_file expects bytes content")
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """Prune/normalize for export (ref ``static/io.py
+    normalize_program``). The TPU export path prunes at StableHLO trace
+    time (only ops reachable from the fetches are replayed), so this is
+    a validated clone."""
+    if not isinstance(program, G.Program):
+        raise TypeError("program must be a Program")
+    return program.clone()
+
+
+def load_program_state(model_path, var_list=None):
+    """``model_path(.pdparams/.pdopt)`` -> {name: ndarray} without
+    touching any scope (ref ``static/io.py load_program_state``)."""
+    state = {}
+    for suffix in (".pdparams", ".pdopt"):
+        p = model_path + suffix
+        if os.path.exists(p):
+            with open(p, "rb") as f:
+                state.update(pickle.load(f))
+    if not state:
+        raise FileNotFoundError(
+            f"no program state at {model_path}(.pdparams/.pdopt)")
+    return state
+
+
+def set_program_state(program, state_dict):
+    """Write a ``load_program_state`` dict into the program's scope vars
+    (ref ``static/io.py set_program_state``)."""
+    scope = global_scope()
+    unknown = [k for k in state_dict
+               if k not in program.scope_tensors
+               and k not in program.scope_init]
+    for k, v in state_dict.items():
+        scope.set(k, jnp.asarray(v))
+    if unknown:
+        import warnings
+        warnings.warn(
+            f"set_program_state: {len(unknown)} keys not tracked by the "
+            f"program (first: {unknown[:3]})")

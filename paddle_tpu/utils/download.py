@@ -69,6 +69,27 @@ def _md5check(fullname, md5sum=None):
     return md5.hexdigest() == md5sum
 
 
+def _safe_extractall(tf, dst):
+    """extractall with the 'data' path-traversal filter; on Pythons
+    predating the filter= backport (3.10.12/3.11.4), validate members
+    manually — rejecting absolute/.. paths AND link members (a symlink
+    pointing outside dst followed by a file through it escapes even when
+    every name looks clean) — instead of extracting unfiltered
+    (fail-closed). Shared by every tar extraction site."""
+    if hasattr(tarfile, "data_filter"):
+        tf.extractall(dst, filter="data")
+        return
+    for m in tf.getmembers():
+        name = m.name
+        if name.startswith(("/", "\\")) or ".." in name.split("/"):
+            raise ValueError(f"unsafe tar member path: {name!r}")
+        if m.issym() or m.islnk():
+            raise ValueError(
+                f"tar member {name!r} is a link; refusing to extract "
+                f"without the 'data' filter")
+    tf.extractall(dst)
+
+
 def _decompress(fname):
     dst_dir = osp.splitext(fname)[0]
     if osp.isdir(dst_dir) and os.listdir(dst_dir):
@@ -76,7 +97,7 @@ def _decompress(fname):
     os.makedirs(dst_dir, exist_ok=True)
     if tarfile.is_tarfile(fname):
         with tarfile.open(fname) as tf:
-            tf.extractall(dst_dir, filter="data")
+            _safe_extractall(tf, dst_dir)
     elif zipfile.is_zipfile(fname):
         with zipfile.ZipFile(fname) as zf:
             zf.extractall(dst_dir)

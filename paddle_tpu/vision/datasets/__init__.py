@@ -17,18 +17,8 @@ import numpy as np
 from ...io.dataset import Dataset
 
 
-def _safe_extractall(tf, dst):
-    """extractall with the 'data' path-traversal filter; on Pythons
-    predating the filter= backport (3.10.12/3.11.4), validate members
-    manually instead of extracting unfiltered (fail-closed)."""
-    if hasattr(tarfile, "data_filter"):
-        tf.extractall(dst, filter="data")
-        return
-    for m in tf.getmembers():
-        name = m.name
-        if name.startswith(("/", "\\")) or ".." in name.split("/"):
-            raise ValueError(f"unsafe tar member path: {name!r}")
-    tf.extractall(dst)
+from ...utils.download import _safe_extractall  # noqa: E402  (shared
+# fail-closed tar extraction — one policy for every extraction site)
 
 __all__ = ["Cifar10", "Cifar100", "MNIST", "FashionMNIST", "FakeData",
            "DatasetFolder", "ImageFolder", "Flowers", "VOC2012"]

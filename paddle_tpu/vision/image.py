@@ -32,6 +32,11 @@ def image_load(path, backend=None):
         return Image.open(path)
     if backend == "cv2":
         import cv2
-        return cv2.imread(path)  # IMREAD_COLOR: 3-channel BGR (ref)
+        img = cv2.imread(path)  # IMREAD_COLOR: 3-channel BGR (ref)
+        if img is None:
+            # cv2 signals missing/corrupt/unsupported files with None,
+            # which would fail far downstream inside a transform
+            raise ValueError(f"cv2 could not read image: {path!r}")
+        return img
     raise ValueError(
         f"Expected backend are one of ['pil', 'cv2'], but got {backend}")

@@ -1,0 +1,158 @@
+"""Round-4 static extras: EMA, program (de)serialization, program state,
+py_func/Print/metrics shims (ref: ``python/paddle/static/__init__.py``,
+``static/io.py``)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import static
+
+
+@pytest.fixture
+def static_mode():
+    pt.enable_static()
+    yield
+    pt.disable_static()
+
+
+def _build_linear_prog():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4, 3], "float32")
+        lin = pt.nn.Linear(3, 2)
+        y = lin(x)
+    return main, startup, x, y, lin
+
+
+def test_ema_update_apply_restore(static_mode):
+    main, startup, x, y, lin = _build_linear_prog()
+    exe = static.Executor()
+    exe.run(startup)
+    feeds = {"x": np.ones((4, 3), "float32")}
+    exe.run(main, feed=feeds, fetch_list=[y])
+
+    ema = static.ExponentialMovingAverage(decay=0.5)
+    scope = static.global_scope()
+    wkey = lin.weight.name
+    assert wkey in main.scope_tensors
+    w0 = np.asarray(scope.find_var(wkey))
+    ema.update(main)
+    # shift the live weight, update again: shadow = 0.5*w0 + 0.5*(w0+1)
+    scope.set(wkey, scope.find_var(wkey) + 1.0)
+    ema.update(main)
+    with ema.apply():
+        now = np.asarray(scope.find_var(wkey))
+        np.testing.assert_allclose(now, w0 + 0.5, atol=1e-5)
+    back = np.asarray(scope.find_var(wkey))
+    np.testing.assert_allclose(back, w0 + 1.0, atol=1e-6)
+
+
+def test_serialize_roundtrip(tmp_path, static_mode):
+    main, startup, x, y, lin = _build_linear_prog()
+    exe = static.Executor()
+    exe.run(startup)
+    feeds = {"x": np.random.RandomState(0).rand(4, 3).astype("float32")}
+    want = exe.run(main, feed=feeds, fetch_list=[y])[0]
+
+    blob = static.serialize_program([x], [y], program=main)
+    persist = static.serialize_persistables([x], [y], program=main)
+    p1 = str(tmp_path / "prog.bin")
+    static.save_to_file(p1, blob)
+    loaded = static.deserialize_program(static.load_from_file(p1))
+    params = static.deserialize_persistables(main, persist)
+    out = loaded.call({k: v for k, v in params.items()}, feeds["x"])
+    np.testing.assert_allclose(np.asarray(out[0]), want, atol=1e-5)
+    with pytest.raises(TypeError):
+        static.save_to_file(p1, "not-bytes")
+
+
+def test_program_state_roundtrip(tmp_path, static_mode):
+    main, startup, x, y, lin = _build_linear_prog()
+    exe = static.Executor()
+    exe.run(startup)
+    path = str(tmp_path / "model")
+    static.save(main, path)
+    state = static.load_program_state(path)
+    wkey = lin.weight.name
+    assert wkey in state
+    # zero the scope, restore from state
+    scope = static.global_scope()
+    orig = state[wkey].copy()
+    scope.set(wkey, np.zeros_like(orig))
+    static.set_program_state(main, state)
+    np.testing.assert_allclose(np.asarray(scope.find_var(wkey)), orig)
+    with pytest.raises(FileNotFoundError):
+        static.load_program_state(str(tmp_path / "nope"))
+
+
+def test_misc_shims(static_mode):
+    assert len(static.cpu_places(2)) == 2
+    g = static.create_global_var([2, 2], 1.5, "float32", persistable=True)
+    np.testing.assert_allclose(g.numpy(), 1.5)
+    bs = static.BuildStrategy()
+    bs.fuse_elewise_add_act_ops = True
+    assert bs.fuse_elewise_add_act_ops is True
+    attr = static.WeightNormParamAttr(dim=0, name="w")
+    assert attr.dim == 0 and attr.name == "w"
+    sched = static.exponential_decay(0.1, 100, 0.9)
+    assert abs(sched.get_lr() - 0.1) < 1e-9
+
+
+def test_pyfunc_and_print_eager():
+    pt.disable_static()
+    x = pt.to_tensor(np.array([1.0, 2.0], "float32"))
+    out = static.py_func(lambda a: np.asarray(a) * 3.0, x, x)
+    np.testing.assert_allclose(out.numpy(), [3.0, 6.0])
+    y = static.Print(x, message="dbg")
+    np.testing.assert_allclose(y.numpy(), [1.0, 2.0])
+
+
+def test_static_metrics():
+    pt.disable_static()
+    logits = pt.to_tensor(np.array([[0.1, 0.9], [0.8, 0.2]], "float32"))
+    label = pt.to_tensor(np.array([[1], [0]], "int64"))
+    acc = static.accuracy(logits, label)
+    assert float(np.asarray(acc._data if hasattr(acc, "_data") else acc)) \
+        == 1.0
+    a = static.auc(pt.to_tensor(np.array([[0.2, 0.8], [0.7, 0.3],
+                                          [0.4, 0.6]], "float32")),
+                   pt.to_tensor(np.array([[1], [0], [1]], "int64")))
+    assert 0.99 <= float(a.numpy()) <= 1.0
+
+
+def test_exponential_decay_semantics():
+    sched = static.exponential_decay(0.1, decay_steps=10, decay_rate=0.5,
+                                     staircase=True)
+    for _ in range(9):
+        sched.step()
+    assert abs(sched.get_lr() - 0.1) < 1e-9  # still in the first interval
+    sched.step()
+    assert abs(sched.get_lr() - 0.05) < 1e-9
+    smooth = static.exponential_decay(0.1, decay_steps=10, decay_rate=0.5)
+    for _ in range(5):
+        smooth.step()
+    assert abs(smooth.get_lr() - 0.1 * 0.5 ** 0.5) < 1e-9
+
+
+def test_print_message_with_braces():
+    pt.disable_static()
+    x = pt.to_tensor(np.array([1.0], "float32"))
+    y = static.Print(x, message="loss {step}")
+    np.testing.assert_allclose(y.numpy(), [1.0])
+
+
+def test_ema_injected_key_cleanup(static_mode):
+    main, startup, x, y, lin = _build_linear_prog()
+    exe = static.Executor()
+    exe.run(startup)
+    ema = static.ExponentialMovingAverage(0.9)
+    ema.update(main)
+    scope = static.global_scope()
+    wkey = lin.weight.name
+    # clear the scope var; apply must inject and restore must REMOVE it
+    del scope.vars[wkey]
+    with ema.apply():
+        assert scope.find_var(wkey) is not None
+    assert scope.find_var(wkey) is None
