@@ -107,6 +107,9 @@ define_flag("check_nan_inf", False,
             "Scan op outputs for NaN/Inf after every eager op "
             "(ref: paddle/fluid/eager/nan_inf_utils.cc)")
 define_flag("benchmark", False, "Synchronize after every eager op for timing")
+define_flag("check_varlen", False,
+            "Validate cu_seqlens inside traced flash_attn_unpadded calls "
+            "via a host callback (debug mode)")
 define_flag("prng_impl", "rbg",
             "PRNG implementation for framework-drawn keys: 'rbg' uses the "
             "TPU-native XLA rng_bit_generator (threefry-seeded; measured "

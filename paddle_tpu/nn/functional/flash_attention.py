@@ -64,70 +64,60 @@ def _softmax_probs(q, k, v, causal):
     return nary(f, [q, k, v], name="flash_attention_softmax")
 
 
+def _validate_cu(cu, total, what):
+    import numpy as np
+    c = np.asarray(cu)
+    if c[0] != 0 or (np.diff(c) < 0).any() or c[-1] != total:
+        raise ValueError(
+            f"{what} must be nondecreasing, start at 0 and end at the "
+            f"packed token count {total}; got {c.tolist()[:8]}...")
+
+
 def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                         max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
                         causal=False, return_softmax=False,
                         fixed_seed_offset=None, rng_name="", training=True,
                         name=None):
-    """Packed varlen attention: ``query`` is (total_q, H, D); sequence i
-    occupies rows ``cu_seqlens_q[i]:cu_seqlens_q[i+1]``. Self-attention
-    lengths only (cu_seqlens_q == cu_seqlens_k), like the reference's
-    main use (BERT-style padded batches)."""
-    from ...ops.pallas_ops import mha
-    import numpy as np
+    """Packed ragged varlen attention: ``query`` is (total_q, H, D);
+    sequence i occupies rows ``cu_seqlens_q[i]:cu_seqlens_q[i+1]``.
+
+    Runs the genuinely PACKED Pallas kernel (``ops.pallas_ops.mha_packed``):
+    sequences are block-aligned in a packed buffer and off-band tiles are
+    skipped, so compute is O(sum len_i^2) — no pad-to-max scatter.
+    Cross-attention lengths (``cu_seqlens_q != cu_seqlens_k``) are
+    supported; ``causal`` uses the flash-attn bottom-right alignment.
+
+    cu_seqlens are VALIDATED eagerly when concrete (raising, not
+    NaN-poisoning). Under a jit trace they are tracers and cannot be
+    checked for free; set the ``check_varlen`` flag to validate inside
+    the traced program via a host callback (debug mode).
+    """
+    from ...ops.pallas_ops import mha_packed
+    from ...framework import flags as _flags
     q = ensure_tensor(query)
     k, v = ensure_tensor(key), ensure_tensor(value)
     cu_q = jnp.asarray(ensure_tensor(cu_seqlens_q)._data, jnp.int32)
     cu_k = jnp.asarray(ensure_tensor(cu_seqlens_k)._data, jnp.int32)
-    # validate only when concrete: under a jit/to_static trace the cu
-    # arrays are tracers (and eager validation costs one host transfer,
-    # which is what a data-dependent check is)
-    if not isinstance(cu_q, jax.core.Tracer) and \
-            not isinstance(cu_k, jax.core.Tracer):
-        cq = np.asarray(cu_q)
-        if not np.array_equal(cq, np.asarray(cu_k)):
-            raise NotImplementedError(
-                "flash_attn_unpadded currently supports self-attention "
-                "lengths only (cu_seqlens_q == cu_seqlens_k); "
-                "cross-attention varlen is not implemented")
-        if (np.diff(cq) > int(max_seqlen_q)).any():
-            raise ValueError(
-                f"a sequence exceeds max_seqlen_q={max_seqlen_q}; longer "
-                f"sequences would be silently truncated")
-    max_q = int(max_seqlen_q)
+    if not isinstance(cu_q, jax.core.Tracer):
+        _validate_cu(cu_q, q.shape[0], "cu_seqlens_q")
+    if not isinstance(cu_k, jax.core.Tracer):
+        _validate_cu(cu_k, k.shape[0], "cu_seqlens_k")
     eff = dropout if training else 0.0
     seeds = _seed_input(eff, True)
+    check = bool(_flags.flag("check_varlen"))
 
     def f(qd, kd, vd, cu, cuk, *rest):
-        bsz = cu.shape[0] - 1
-        h, d = qd.shape[1], qd.shape[2]
-        lens = cu[1:] - cu[:-1]
-        # traced guard: the eager-only validation above is skipped for
-        # tracers, so poison the output with NaN (visible, not silent)
-        # if cu_q != cu_k or a sequence overflows max_seqlen at runtime
-        ok = jnp.logical_and((cu == cuk).all(), (lens <= max_q).all())
-        # scatter packed rows -> (B, max_q) padded positions
-        pos = jnp.arange(max_q, dtype=jnp.int32)
-        idx = cu[:-1, None] + pos[None, :]                  # (B, max_q)
-        idx = jnp.minimum(idx, qd.shape[0] - 1)
-        valid = pos[None, :] < lens[:, None]
+        if check:
+            def _cb(c, ck):
+                _validate_cu(c, qd.shape[0], "cu_seqlens_q")
+                _validate_cu(ck, kd.shape[0], "cu_seqlens_k")
 
-        def pad(x):
-            g = x[idx.reshape(-1)].reshape(bsz, max_q, h, d)
-            return jnp.where(valid[:, :, None, None], g, 0.0)
-
-        qp, kp, vp = pad(qd), pad(kd), pad(vd)
-        out = mha(jnp.swapaxes(qp, 1, 2), jnp.swapaxes(kp, 1, 2),
-                  jnp.swapaxes(vp, 1, 2), causal=causal, sm_scale=scale,
-                  dropout_p=eff, seed=rest[0] if rest else None,
-                  seq_lens=lens)
-        out = jnp.swapaxes(out, 1, 2)                        # (B,max_q,H,D)
-        # gather padded -> packed: row t belongs to seq searchsorted(t)
-        tok = jnp.arange(qd.shape[0], dtype=jnp.int32)
-        seq_of = jnp.searchsorted(cu, tok, side="right") - 1
-        off = tok - cu[seq_of]
-        packed = out[seq_of, off]
-        return jnp.where(ok, packed, jnp.nan)
+            # debug.callback is effectful — a pure_callback whose result
+            # is unused would be dead-code-eliminated under jit
+            jax.debug.callback(_cb, cu, cuk)
+        return mha_packed(qd, kd, vd, cu, cuk, causal=causal,
+                          sm_scale=scale, dropout_p=eff,
+                          seed=rest[0] if rest else None)
 
     out = nary(f, [q, k, v, ensure_tensor(cu_q), ensure_tensor(cu_k)]
                + seeds, name="flash_attn_unpadded")

@@ -691,3 +691,462 @@ def flash_attention(query, key, value, *, causal=False, dropout_p=0.0,
         return jnp.swapaxes(o, 1, 2)
 
     return nary(f, inputs, name="flash_attention")
+
+
+# ---------------------------------------------------------------------------
+# packed (ragged varlen) flash attention
+# ---------------------------------------------------------------------------
+# True varlen: sequences stay PACKED (total_tokens, H, D) — no pad-to-max
+# batch. Each sequence is block-aligned inside a packed buffer so every
+# (block_q, block_k) tile belongs to exactly one sequence; per-q-block
+# [klo, khi] (and per-k-block [qlo, qhi]) SMEM ranges skip everything off
+# the block-diagonal band. Compute scales as sum(len_i * len_j-of-own-seq)
+# = O(sum len^2), the true ragged cost, instead of the padded path's
+# O(B * max_len^2). Cross-attention lengths (cu_q != cu_k) are supported;
+# causal uses the bottom-right alignment (col_pos <= row_pos + len_k -
+# len_q), the flash-attn varlen convention.
+# Ref: ``python/paddle/nn/functional/flash_attention.py:272`` over
+# ``third_party/flashattn`` cu_seqlens grids.
+
+def _packed_mask(pq_ref, okq_ref, off_ref, pk_ref, okk_ref, causal,
+                 block_q, block_k):
+    pq = pq_ref[:, :1]                        # (bq, 1) int32
+    okq = okq_ref[:, :1] > 0
+    pk = pk_ref[:, 0][None, :]                # (1, bk)
+    okk = okk_ref[:, 0][None, :] > 0
+    mask = jnp.logical_and(okq, okk)
+    if causal:
+        off = off_ref[:, :1]
+        mask = jnp.logical_and(mask, pk <= pq + off)
+    return mask
+
+
+def _pk_fwd_kernel(*refs, causal, sm_scale, block_q, block_k, p_drop):
+    i = 1 if p_drop > 0.0 else 0
+    seed_ref = refs[0] if p_drop > 0.0 else None
+    (klo_ref, khi_ref, q_ref, k_ref, v_ref, pq_ref, okq_ref, off_ref,
+     pk_ref, okk_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr) = refs[i:]
+    h = pl.program_id(0)
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, _NEG_INF, jnp.float32)
+        l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    def _compute():
+        s = jax.lax.dot_general(q_ref[0], k_ref[0],
+                                (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * sm_scale
+        mask = _packed_mask(pq_ref, okq_ref, off_ref, pk_ref, okk_ref,
+                            causal, block_q, block_k)
+        s = jnp.where(mask, s, _NEG_INF)
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        if p_drop > 0.0:
+            keep = _tile_keep_mask(seed_ref[0], h, qi, ki, block_q,
+                                   block_k, p_drop)
+            p = jnp.where(keep, p / (1.0 - p_drop), 0.0)
+        pv = jax.lax.dot_general(p.astype(v_ref.dtype), v_ref[0],
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(jnp.logical_and(ki >= klo_ref[qi], ki <= khi_ref[qi]))
+    def _():
+        _compute()
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[:, :1] + jnp.log(l_safe)
+
+
+def _pk_bwd_dq_kernel(*refs, causal, sm_scale, block_q, block_k, p_drop):
+    i = 1 if p_drop > 0.0 else 0
+    seed_ref = refs[0] if p_drop > 0.0 else None
+    (klo_ref, khi_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+     pq_ref, okq_ref, off_ref, pk_ref, okk_ref, dq_ref,
+     dq_scr) = refs[i:]
+    h = pl.program_id(0)
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros(dq_scr.shape, jnp.float32)
+
+    def _compute():
+        s = jax.lax.dot_general(q_ref[0], k_ref[0],
+                                (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * sm_scale
+        mask = _packed_mask(pq_ref, okq_ref, off_ref, pk_ref, okk_ref,
+                            causal, block_q, block_k)
+        p = jnp.exp(s - lse_ref[0])
+        p = jnp.where(mask, p, 0.0)
+        dp = jax.lax.dot_general(do_ref[0], v_ref[0],
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        if p_drop > 0.0:
+            keep = _tile_keep_mask(seed_ref[0], h, qi, ki, block_q,
+                                   block_k, p_drop)
+            dp = jnp.where(keep, dp / (1.0 - p_drop), 0.0)
+        ds = p * (dp - delta_ref[0])
+        dq_scr[:] = dq_scr[:] + sm_scale * jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(jnp.logical_and(ki >= klo_ref[qi], ki <= khi_ref[qi]))
+    def _():
+        _compute()
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _pk_bwd_dkv_kernel(*refs, causal, sm_scale, block_q, block_k, p_drop):
+    i = 1 if p_drop > 0.0 else 0
+    seed_ref = refs[0] if p_drop > 0.0 else None
+    (qlo_ref, qhi_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+     pq_ref, okq_ref, off_ref, pk_ref, okk_ref, dk_ref, dv_ref, dk_scr,
+     dv_scr) = refs[i:]
+    h = pl.program_id(0)
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[:] = jnp.zeros(dv_scr.shape, jnp.float32)
+
+    def _compute():
+        s = jax.lax.dot_general(q_ref[0], k_ref[0],
+                                (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * sm_scale
+        mask = _packed_mask(pq_ref, okq_ref, off_ref, pk_ref, okk_ref,
+                            causal, block_q, block_k)
+        p = jnp.exp(s - lse_ref[0])
+        p = jnp.where(mask, p, 0.0)
+        if p_drop > 0.0:
+            keep = _tile_keep_mask(seed_ref[0], h, qi, ki, block_q,
+                                   block_k, p_drop)
+            inv = 1.0 / (1.0 - p_drop)
+            p_tilde = jnp.where(keep, p * inv, 0.0)
+        else:
+            p_tilde = p
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p_tilde.astype(do_ref.dtype), do_ref[0],
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do_ref[0], v_ref[0],
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        if p_drop > 0.0:
+            dp = jnp.where(keep, dp * inv, 0.0)
+        ds = p * (dp - delta_ref[0])
+        dk_scr[:] = dk_scr[:] + sm_scale * jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(jnp.logical_and(qi >= qlo_ref[ki], qi <= qhi_ref[ki]))
+    def _():
+        _compute()
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _pk_fwd(q, k, v, seed, meta, *, causal, sm_scale, block_q, block_k,
+            p_drop, interpret):
+    """q/k/v: (H, CapQ/K, D). meta: int32 arrays (see mha_packed)."""
+    pos_q, ok_q, off_q, pos_k, ok_k, klo, khi, qlo, qhi = meta
+    H, capq, d = q.shape
+    capk = k.shape[1]
+    nq, nk = capq // block_q, capk // block_k
+    seed_specs, seed_args = (([pl.BlockSpec(memory_space=pltpu.SMEM)],
+                              (jax.lax.bitcast_convert_type(
+                                  seed, jnp.int32).reshape(-1),))
+                             if p_drop > 0.0 else ([], ()))
+    row_spec_q = pl.BlockSpec((block_q, 1), lambda h, i, j: (i, 0))
+    row_spec_k = pl.BlockSpec((block_k, 1), lambda h, i, j: (j, 0))
+    out, lse = pl.pallas_call(
+        functools.partial(_pk_fwd_kernel, causal=causal, sm_scale=sm_scale,
+                          block_q=block_q, block_k=block_k, p_drop=p_drop),
+        grid=(H, nq, nk),
+        in_specs=seed_specs + [
+            pl.BlockSpec(memory_space=pltpu.SMEM),   # klo
+            pl.BlockSpec(memory_space=pltpu.SMEM),   # khi
+            pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),
+            row_spec_q, row_spec_q, row_spec_q,
+            row_spec_k, row_spec_k,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda h, i, j: (h, i, 0)),
+        ],
+        out_shape=[
+            _sds((H, capq, d), q.dtype, q),
+            _sds((H, capq, 1), jnp.float32, q),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(*seed_args, klo, khi, q, k, v, pos_q[:, None], ok_q[:, None],
+      off_q[:, None], pos_k[:, None], ok_k[:, None])
+    return out, lse
+
+
+def _pk_bwd(q, k, v, out, lse, do, seed, meta, *, causal, sm_scale,
+            block_q, block_k, p_drop, interpret):
+    pos_q, ok_q, off_q, pos_k, ok_k, klo, khi, qlo, qhi = meta
+    H, capq, d = q.shape
+    capk = k.shape[1]
+    nq, nk = capq // block_q, capk // block_k
+    delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    seed_specs, seed_args = (([pl.BlockSpec(memory_space=pltpu.SMEM)],
+                              (jax.lax.bitcast_convert_type(
+                                  seed, jnp.int32).reshape(-1),))
+                             if p_drop > 0.0 else ([], ()))
+    row_q = pl.BlockSpec((block_q, 1), lambda h, i, j: (i, 0))
+    row_k = pl.BlockSpec((block_k, 1), lambda h, i, j: (j, 0))
+    dq = pl.pallas_call(
+        functools.partial(_pk_bwd_dq_kernel, causal=causal,
+                          sm_scale=sm_scale, block_q=block_q,
+                          block_k=block_k, p_drop=p_drop),
+        grid=(H, nq, nk),
+        in_specs=seed_specs + [
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda h, i, j: (h, i, 0)),
+            row_q, row_q, row_q, row_k, row_k,
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
+        out_shape=_sds((H, capq, d), q.dtype, q),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(*seed_args, klo, khi, q, k, v, do, lse, delta, pos_q[:, None],
+      ok_q[:, None], off_q[:, None], pos_k[:, None], ok_k[:, None])
+
+    row_q2 = pl.BlockSpec((block_q, 1), lambda h, j, i: (i, 0))
+    row_k2 = pl.BlockSpec((block_k, 1), lambda h, j, i: (j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_pk_bwd_dkv_kernel, causal=causal,
+                          sm_scale=sm_scale, block_q=block_q,
+                          block_k=block_k, p_drop=p_drop),
+        grid=(H, nk, nq),
+        in_specs=seed_specs + [
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, block_q, d), lambda h, j, i: (h, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, j, i: (h, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, j, i: (h, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda h, j, i: (h, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda h, j, i: (h, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda h, j, i: (h, i, 0)),
+            row_q2, row_q2, row_q2, row_k2, row_k2,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda h, j, i: (h, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, j, i: (h, j, 0)),
+        ],
+        out_shape=[
+            _sds((H, capk, d), k.dtype, k),
+            _sds((H, capk, d), v.dtype, v),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(*seed_args, qlo, qhi, q, k, v, do, lse, delta, pos_q[:, None],
+      ok_q[:, None], off_q[:, None], pos_k[:, None], ok_k[:, None])
+    return dq, dk, dv
+
+
+_PK_STATICS = tuple(range(13, 19))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=_PK_STATICS)
+def _pk_flash(q, k, v, seed, pos_q, ok_q, off_q, pos_k, ok_k, klo, khi,
+              qlo, qhi, causal, sm_scale, block_q, block_k, p_drop,
+              interpret):
+    out, _ = _pk_fwd(q, k, v, seed,
+                     (pos_q, ok_q, off_q, pos_k, ok_k, klo, khi, qlo, qhi),
+                     causal=causal, sm_scale=sm_scale, block_q=block_q,
+                     block_k=block_k, p_drop=p_drop, interpret=interpret)
+    return out
+
+
+def _pk_flash_fwd(q, k, v, seed, pos_q, ok_q, off_q, pos_k, ok_k, klo, khi,
+                  qlo, qhi, causal, sm_scale, block_q, block_k, p_drop,
+                  interpret):
+    meta = (pos_q, ok_q, off_q, pos_k, ok_k, klo, khi, qlo, qhi)
+    out, lse = _pk_fwd(q, k, v, seed, meta, causal=causal,
+                       sm_scale=sm_scale, block_q=block_q, block_k=block_k,
+                       p_drop=p_drop, interpret=interpret)
+    return out, (q, k, v, seed, meta, out, lse)
+
+
+def _pk_flash_bwd(causal, sm_scale, block_q, block_k, p_drop, interpret,
+                  res, do):
+    q, k, v, seed, meta, out, lse = res
+    dq, dk, dv = _pk_bwd(q, k, v, out, lse, do, seed, meta, causal=causal,
+                         sm_scale=sm_scale, block_q=block_q,
+                         block_k=block_k, p_drop=p_drop,
+                         interpret=interpret)
+    zmeta = tuple(jnp.zeros_like(m) for m in meta)
+    return (dq, dk, dv, jnp.zeros((), jnp.float32)) + zmeta
+
+
+_pk_flash.defvjp(_pk_flash_fwd, _pk_flash_bwd)
+
+
+def mha_packed(q, k, v, cu_q, cu_k, *, causal=False, sm_scale=None,
+               dropout_p=0.0, seed=None, block_q=None, block_k=None,
+               interpret=None):
+    """Ragged varlen flash attention over PACKED tokens.
+
+    q: (total_q, H, D); k/v: (total_k, H, D); cu_q/cu_k: (B+1,) int32
+    cumulative lengths (may be traced). Cross-attention lengths
+    (cu_q != cu_k) are supported; ``causal`` uses bottom-right alignment
+    within each pair (col_pos <= row_pos + len_k - len_q).
+
+    Each sequence is block-aligned inside a static-capacity packed
+    buffer; the kernels skip all tiles outside each block's own
+    sequence, so compute is O(sum_i lq_i * lk_i), not O(B * max^2).
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    total_q, H, d_in = q.shape
+    total_k = k.shape[0]
+    B = cu_q.shape[0] - 1
+    bq = 512 if block_q is None else block_q
+    bk = 512 if block_k is None else block_k
+    bq = min(bq, _ceil_to(total_q, 8))
+    bk = min(bk, _ceil_to(total_k, 8))
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d_in)
+    d = _ceil_to(d_in, _LANES)
+    capq = (total_q + B * bq + bq - 1) // bq * bq
+    capk = (total_k + B * bk + bk - 1) // bk * bk
+    nq, nk = capq // bq, capk // bk
+    i32 = jnp.int32
+    cu_q = jnp.asarray(cu_q, i32)
+    cu_k = jnp.asarray(cu_k, i32)
+    lens_q = cu_q[1:] - cu_q[:-1]
+    lens_k = cu_k[1:] - cu_k[:-1]
+    plen_q = (lens_q + bq - 1) // bq * bq
+    plen_k = (lens_k + bk - 1) // bk * bk
+    starts_q = jnp.concatenate([jnp.zeros(1, i32),
+                                jnp.cumsum(plen_q)])[:-1]
+    starts_k = jnp.concatenate([jnp.zeros(1, i32),
+                                jnp.cumsum(plen_k)])[:-1]
+    off_seq = lens_k - lens_q  # bottom-right causal alignment
+
+    def pack_meta(total, cap, cu, starts, lens, offs):
+        tok = jnp.arange(total, dtype=i32)
+        s_of = jnp.clip(jnp.searchsorted(cu, tok, side="right") - 1,
+                        0, B - 1)
+        newpos = starts[s_of] + tok - cu[s_of]
+        r = jnp.arange(cap, dtype=i32)
+        sp = jnp.clip(jnp.searchsorted(starts, r, side="right") - 1,
+                      0, B - 1)
+        local = r - starts[sp]
+        valid = local < lens[sp]
+        pos = jnp.where(valid, local, -1)
+        return newpos, pos, valid.astype(i32), offs[sp]
+
+    def scatter(x, cap, newpos):
+        buf = jnp.zeros((cap, H, d), x.dtype)
+        xp = jnp.pad(x, ((0, 0), (0, 0), (0, d - d_in)))
+        return jnp.swapaxes(buf.at[newpos].set(xp), 0, 1)
+
+    newpos_q, pos_q, ok_q, off_q = pack_meta(
+        total_q, capq, cu_q, starts_q, lens_q, off_seq)
+    newpos_k, pos_k, ok_k, _ = pack_meta(
+        total_k, capk, cu_k, starts_k, lens_k, off_seq)
+    qp = scatter(q, capq, newpos_q)
+    kp = scatter(k, capk, newpos_k)
+    vp = scatter(v, capk, newpos_k)  # k and v share the packing
+
+    # per-q-block k ranges
+    rb = jnp.arange(nq, dtype=i32) * bq
+    sb = jnp.clip(jnp.searchsorted(starts_q, rb, side="right") - 1,
+                  0, B - 1)
+    has_data = rb < starts_q[sb] + plen_q[sb]
+    klo = jnp.where(has_data, starts_k[sb] // bk, 1)
+    khi_full = jnp.where(has_data,
+                         (starts_k[sb] + plen_k[sb] - 1) // bk, 0)
+    if causal:
+        end_local = rb + bq - 1 - starts_q[sb]
+        kcol_max = starts_k[sb] + end_local + off_seq[sb]
+        khi = jnp.where(kcol_max >= starts_k[sb],
+                        jnp.minimum(khi_full, kcol_max // bk), 0)
+        khi = jnp.where(has_data, khi, 0)
+        klo = jnp.where(jnp.logical_and(has_data,
+                                        kcol_max >= starts_k[sb]),
+                        klo, 1)
+    else:
+        khi = khi_full
+    # per-k-block q ranges (dkv)
+    rk = jnp.arange(nk, dtype=i32) * bk
+    sk = jnp.clip(jnp.searchsorted(starts_k, rk, side="right") - 1,
+                  0, B - 1)
+    has_k = rk < starts_k[sk] + plen_k[sk]
+    qlo_full = jnp.where(has_k, starts_q[sk] // bq, 1)
+    qhi = jnp.where(has_k, (starts_q[sk] + plen_q[sk] - 1) // bq, 0)
+    if causal:
+        qmin_global = starts_q[sk] + (rk - starts_k[sk]) - off_seq[sk]
+        qmin_global = jnp.maximum(qmin_global, starts_q[sk])
+        qlo = jnp.maximum(qlo_full, qmin_global // bq)
+        qlo = jnp.where(has_k, qlo, 1)
+    else:
+        qlo = qlo_full
+
+    if seed is None:
+        seed = jnp.zeros((), jnp.float32)
+    else:
+        seed = jnp.asarray(seed, jnp.float32).reshape(())
+    out = _pk_flash(qp, kp, vp, seed, pos_q, ok_q, off_q, pos_k, ok_k,
+                    klo, khi, qlo, qhi, causal, sm_scale, bq, bk,
+                    float(dropout_p), interpret)
+    out = jnp.swapaxes(out, 0, 1)                 # (capq, H, D)
+    return out[newpos_q][:, :, :d_in]             # packed (total_q, H, D)

@@ -185,8 +185,16 @@ def _export_blob(feed_vars, fetch_vars, program=None):
     on the same vars) must not pay the StableHLO trace twice — one-entry
     memo keyed by the exact (feed, fetch, program) identity."""
     import tempfile
+    # the params live in the scope and change between calls — include an
+    # identity stamp of the current scope values (jax arrays are
+    # immutable; scope.set rebinds, changing the ids) so a checkpoint
+    # loop never gets stale weights back from the memo
+    prog = program or G.default_main_program()
+    scope = global_scope()
+    stamp = tuple(id(scope.find_var(k)) for k in prog.scope_tensors) \
+        if prog is not None else ()
     key = (tuple(id(v) for v in feed_vars),
-           tuple(id(v) for v in fetch_vars), id(program))
+           tuple(id(v) for v in fetch_vars), id(program), stamp)
     hit = _EXPORT_CACHE.get("entry")
     if hit is not None and hit[0] == key:
         return hit[1], hit[2]

@@ -371,3 +371,126 @@ def test_sdpa_flash_min_seq_gate(monkeypatch):
     assert calls == []  # 128 < flash_min_seq -> XLA path
     C.scaled_dot_product_attention(x_long, x_long, x_long)
     assert calls == [(1, 512, 2, 64)]
+
+
+class TestPackedVarlen:
+    """True ragged varlen kernel (mha_packed): cross lengths, causal
+    bottom-right alignment, tape grads, validation (ref
+    ``python/paddle/nn/functional/flash_attention.py:272``)."""
+
+    @staticmethod
+    def _oracle(q, k, v, cu_q, cu_k, causal):
+        d = q.shape[-1]
+        out = np.zeros_like(q)
+        for i in range(len(cu_q) - 1):
+            qs, qe = cu_q[i], cu_q[i + 1]
+            ks, ke = cu_k[i], cu_k[i + 1]
+            qq = q[qs:qe].transpose(1, 0, 2)
+            kk = k[ks:ke].transpose(1, 0, 2)
+            vv = v[ks:ke].transpose(1, 0, 2)
+            s = np.einsum("hqd,hkd->hqk", qq, kk) / np.sqrt(d)
+            lq, lk = qe - qs, ke - ks
+            if causal:
+                mask = (np.arange(lk)[None, :]
+                        <= np.arange(lq)[:, None] + (lk - lq))
+                s = np.where(mask, s, -np.inf)
+            with np.errstate(invalid="ignore"):
+                p = np.exp(s - s.max(-1, keepdims=True))
+                p = np.nan_to_num(p, nan=0.0)
+                den = p.sum(-1, keepdims=True)
+                p = np.where(den > 0, p / np.where(den > 0, den, 1.0), 0.0)
+            out[qs:qe] = np.einsum("hqk,hkd->hqd", p, vv).transpose(1, 0, 2)
+        return out
+
+    def test_self_and_cross_all_modes(self):
+        from paddle_tpu.ops.pallas_ops import mha_packed
+        rs = np.random.RandomState(0)
+        H, D = 2, 64
+        cu = np.cumsum([0, 64, 200, 37]).astype(np.int32)
+        cuk = np.cumsum([0, 80, 150, 100]).astype(np.int32)
+        q = rs.randn(int(cu[-1]), H, D).astype(np.float32)
+        k = rs.randn(int(cuk[-1]), H, D).astype(np.float32)
+        v = rs.randn(int(cuk[-1]), H, D).astype(np.float32)
+        for cu_k_used, kk, vv in ((cu, q, q), (cuk, k, v)):
+            for causal in (False, True):
+                got = np.asarray(mha_packed(
+                    jnp.asarray(q), jnp.asarray(kk), jnp.asarray(vv),
+                    jnp.asarray(cu), jnp.asarray(cu_k_used),
+                    causal=causal, block_q=128, block_k=128,
+                    interpret=True))
+                want = self._oracle(q, kk, vv, cu, cu_k_used, causal)
+                np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+    def test_grads_vs_dense(self):
+        from paddle_tpu.ops.pallas_ops import mha_packed
+        rs = np.random.RandomState(1)
+        H, D = 2, 64
+        cu = np.cumsum([0, 50, 90]).astype(np.int32)
+        q = jnp.asarray(rs.randn(int(cu[-1]), H, D).astype(np.float32))
+
+        def loss(q, k, v):
+            o = mha_packed(q, k, v, jnp.asarray(cu), jnp.asarray(cu),
+                           causal=True, block_q=64, block_k=64,
+                           interpret=True)
+            return (o.astype(jnp.float32) ** 2).sum()
+
+        g = jax.grad(loss, argnums=(0, 1, 2))(q, q, q)
+
+        def dense(q, k, v):
+            outs = []
+            for i in range(len(cu) - 1):
+                s0, s1 = int(cu[i]), int(cu[i + 1])
+                qq = jnp.swapaxes(q[s0:s1], 0, 1)
+                kk = jnp.swapaxes(k[s0:s1], 0, 1)
+                vv = jnp.swapaxes(v[s0:s1], 0, 1)
+                s = jnp.einsum("hqd,hkd->hqk", qq, kk) / np.sqrt(D)
+                L = s1 - s0
+                mask = jnp.tril(jnp.ones((L, L), bool))
+                p = jax.nn.softmax(jnp.where(mask, s, -1e30), axis=-1)
+                outs.append(jnp.swapaxes(
+                    jnp.einsum("hqk,hkd->hqd", p, vv), 0, 1))
+            return (jnp.concatenate(outs) ** 2).sum()
+
+        gw = jax.grad(dense, argnums=(0, 1, 2))(q, q, q)
+        for a, b_ in zip(g, gw):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       atol=3e-4, rtol=3e-4)
+
+    def test_unpadded_api_cross_lengths_and_validation(self):
+        import paddle_tpu as pt
+        from paddle_tpu.nn.functional import flash_attn_unpadded
+        rs = np.random.RandomState(5)
+        H, D = 2, 64
+        cu = np.cumsum([0, 40, 70]).astype(np.int32)
+        cuk = np.cumsum([0, 64, 32]).astype(np.int32)
+        q = rs.randn(int(cu[-1]), H, D).astype(np.float32)
+        k = rs.randn(int(cuk[-1]), H, D).astype(np.float32)
+        v = rs.randn(int(cuk[-1]), H, D).astype(np.float32)
+        out, _ = flash_attn_unpadded(
+            pt.to_tensor(q), pt.to_tensor(k), pt.to_tensor(v),
+            pt.to_tensor(cu), pt.to_tensor(cuk), 70, 64,
+            scale=1.0 / np.sqrt(D))
+        want = self._oracle(q, k, v, cu, cuk, False)
+        np.testing.assert_allclose(out.numpy(), want, atol=2e-3, rtol=2e-3)
+        # malformed cu raises eagerly (no NaN poison)
+        bad = np.array([0, 80, 30], np.int32)
+        with pytest.raises(ValueError):
+            flash_attn_unpadded(
+                pt.to_tensor(q), pt.to_tensor(k), pt.to_tensor(v),
+                pt.to_tensor(bad), pt.to_tensor(cuk), 70, 64,
+                scale=1.0 / np.sqrt(D))
+
+    def test_unpadded_grad_through_tape(self):
+        import paddle_tpu as pt
+        from paddle_tpu.nn.functional import flash_attn_unpadded
+        from paddle_tpu import Tensor
+        rs = np.random.RandomState(6)
+        cu = np.cumsum([0, 30, 50]).astype(np.int32)
+        q = Tensor(rs.randn(int(cu[-1]), 2, 64).astype(np.float32),
+                   stop_gradient=False)
+        out, _ = flash_attn_unpadded(q, q, q, pt.to_tensor(cu),
+                                     pt.to_tensor(cu), 50, 50, scale=0.125,
+                                     causal=True)
+        pt.sum(out * out).backward()
+        assert q.grad is not None
+        assert np.isfinite(np.asarray(q.grad._data)).all()

@@ -156,3 +156,20 @@ def test_ema_injected_key_cleanup(static_mode):
     with ema.apply():
         assert scope.find_var(wkey) is not None
     assert scope.find_var(wkey) is None
+
+
+def test_serialize_persistables_not_stale(tmp_path, static_mode):
+    """Checkpoint loop: serialize after a weight change must reflect the
+    NEW values (the export memo must not serve stale params)."""
+    import pickle
+    main, startup, x, y, lin = _build_linear_prog()
+    exe = static.Executor()
+    exe.run(startup)
+    p1 = static.serialize_persistables([x], [y], program=main)
+    scope = static.global_scope()
+    wkey = lin.weight.name
+    scope.set(wkey, scope.find_var(wkey) * 0 + 7.0)
+    p2 = static.serialize_persistables([x], [y], program=main)
+    w2 = pickle.loads(p2)["params"][wkey]
+    np.testing.assert_allclose(np.asarray(w2), 7.0)
+    assert p1 != p2
