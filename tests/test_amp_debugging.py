@@ -47,6 +47,7 @@ def test_tensor_checker_log_mode_collects_all(capsys):
     assert {f["op"] for f in findings} >= {"log"}
 
 
+@pytest.mark.slow
 def test_compare_accuracy_reports_per_layer_divergence():
     pt.seed(0)
     net = pt.nn.Sequential(

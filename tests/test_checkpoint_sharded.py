@@ -155,6 +155,7 @@ def test_hapi_sharded_save_load(tmp_path):
     np.testing.assert_array_equal(np.asarray(net[0].weight._data), w_before)
 
 
+@pytest.mark.slow
 def test_fleet_sharded_facade(tmp_path):
     from paddle_tpu.distributed.fleet import fleet as fleet_obj
     pt.seed(0)
@@ -211,6 +212,7 @@ def test_pp_stacked_to_unstacked_translation(tmp_path):
     assert float(l2) < float(l1)
 
 
+@pytest.mark.slow
 def test_hapi_sharded_save_preserves_optimizer(tmp_path):
     pt.seed(0)
     dist.init_mesh({"dp": 8})
@@ -244,6 +246,7 @@ def test_hapi_sharded_save_preserves_optimizer(tmp_path):
     assert int(m2._opt_state["step"]) == 3
 
 
+@pytest.mark.slow
 def test_pipeline_train_batch_ragged_batch_falls_back():
     from paddle_tpu.distributed.fleet.meta_parallel import (
         PipelineLayer, PipelineParallel, LayerDesc)

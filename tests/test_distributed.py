@@ -225,6 +225,7 @@ def test_column_parallel_linear_manual_vs_dense():
     np.testing.assert_allclose(np.asarray(out), dense, rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_row_parallel_linear_manual_vs_dense():
     from paddle_tpu.distributed.fleet.meta_parallel import RowParallelLinear
     mesh = _mp_mesh(4)
@@ -247,6 +248,7 @@ def test_row_parallel_linear_manual_vs_dense():
     np.testing.assert_allclose(np.asarray(out), dense, rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_vocab_parallel_embedding_manual_vs_dense():
     from paddle_tpu.distributed.fleet.meta_parallel import \
         VocabParallelEmbedding
@@ -267,6 +269,7 @@ def test_vocab_parallel_embedding_manual_vs_dense():
     np.testing.assert_allclose(np.asarray(out), dense, rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_parallel_cross_entropy_manual_vs_dense():
     from paddle_tpu.distributed.fleet.meta_parallel import \
         ParallelCrossEntropy
@@ -328,6 +331,7 @@ def test_column_parallel_gspmd_jit_matches_dense():
 # recompute, DataParallel, sharding api, auto_parallel api
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_recompute_grad_matches_plain():
     from paddle_tpu.distributed.fleet.utils import recompute
     from paddle_tpu import autograd

@@ -126,6 +126,7 @@ def test_engine_save_load_roundtrip(tmp_path):
     np.testing.assert_allclose(w, w2)
 
 
+@pytest.mark.slow
 def test_to_static_returns_engine():
     dist.init_mesh({"dp": 8})
     model = _bert()

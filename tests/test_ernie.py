@@ -26,6 +26,7 @@ def _data(rng, B=8, S=16, vocab=1024):
     return ids, mlm_labels, sop
 
 
+@pytest.mark.slow
 def test_ernie_forward_shapes_and_task_embedding():
     pt.seed(0)
     cfg = ernie_tiny()

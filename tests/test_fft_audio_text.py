@@ -101,6 +101,7 @@ class TestAudio:
             w = pt.audio.get_window(name, 32).numpy()
             assert w.shape == (32,) and w.max() <= 1.0 + 1e-6
 
+    @pytest.mark.slow
     def test_feature_layers(self):
         sig = np.sin(2 * math.pi * 440 *
                      np.linspace(0, 1, 8000)).astype(np.float32)[None]
@@ -213,6 +214,7 @@ class TestBert:
             losses.append(float(loss.numpy()))
         assert losses[-1] < losses[0]
 
+    @pytest.mark.slow
     def test_padding_mask_matters(self):
         from paddle_tpu.incubate.models import bert_tiny, BertModel
         pt.seed(1)

@@ -71,6 +71,7 @@ class TestSendRecv:
         np.testing.assert_allclose(out.numpy()[1], [1., 4., 5.])
         np.testing.assert_allclose(out.numpy()[3], [0., 0., 0.])
 
+    @pytest.mark.slow
     def test_max_grad(self):
         self.x.stop_gradient = False
         out = paddle.geometric.send_u_recv(self.x, self.src, self.dst,

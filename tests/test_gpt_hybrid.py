@@ -31,6 +31,7 @@ def _tiny(tp=True, **kw):
     return cfg
 
 
+@pytest.mark.slow
 def test_gpt_forward_shapes():
     dist.init_mesh({"dp": 8})
     pt.seed(0)
@@ -127,6 +128,7 @@ def test_gpt_recompute_matches_plain():
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_gpt_rope_variant_runs():
     dist.init_mesh({"dp": 1})
     pt.seed(0)

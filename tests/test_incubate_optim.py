@@ -240,6 +240,7 @@ class TestFusedIncubateOps:
         np.testing.assert_allclose(out.numpy(), want, rtol=1e-4, atol=1e-4)
         assert tuple(out.shape) == (B, M, R, D)
 
+    @pytest.mark.slow
     def test_fused_gate_attention_separate_kv_grads(self):
         from paddle_tpu.incubate.nn.functional import fused_gate_attention
         rs = np.random.RandomState(3)

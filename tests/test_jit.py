@@ -59,6 +59,7 @@ def test_to_static_backward():
         np.testing.assert_allclose(gs, ge, rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_to_static_training_loop_converges():
     pt.seed(3)
     np.random.seed(3)

@@ -61,6 +61,7 @@ def test_dispatch_combine_roundtrip_identity_experts():
 
 @pytest.mark.parametrize("gate_type", [
     pytest.param("gshard", marks=pytest.mark.slow), "switch"])
+@pytest.mark.slow
 def test_moe_layer_forward_backward(gate_type):
     pt.seed(0)
     layer = MoELayer(d_model=16,

@@ -123,6 +123,7 @@ def test_hsigmoid_custom_path():
             path_table=pt.to_tensor(table))
 
 
+@pytest.mark.slow
 def test_hsigmoid_layer_trains():
     layer = nn.HSigmoidLoss(6, 10)
     x = Tensor(RNG.randn(4, 6).astype("float32"), stop_gradient=False)
@@ -181,6 +182,7 @@ def test_max_unpool_roundtrip(nd):
     np.testing.assert_allclose(layer(pooled, idx).numpy(), uv)
 
 
+@pytest.mark.slow
 def test_max_unpool_grad():
     x = Tensor(RNG.randn(1, 2, 4, 4).astype("float32"),
                stop_gradient=False)
@@ -258,6 +260,7 @@ def test_beam_search_decode_end_token_wins():
     assert (np.asarray(lens._data)[:, 0] == 1).all()
 
 
+@pytest.mark.slow
 def test_beam_search_decode_greedy_path():
     """Deterministic cell: token probabilities depend on the previous
     token so the top beam must follow the argmax chain."""
@@ -297,6 +300,7 @@ def test_beam_search_decode_greedy_path():
     assert got_first[0] == int(np.argmax(table[2]))
 
 
+@pytest.mark.slow
 def test_margin_ce_layerwise_grad():
     logits = Tensor(_cosine_logits(4, 6), stop_gradient=False)
     label = pt.to_tensor(np.array([2, 0, 5, 3], "int64"))

@@ -31,14 +31,25 @@ def _train(model, opt, X, Y, steps=60):
 
 @pytest.mark.parametrize("opt_cls,kwargs", [
     (pt.optimizer.SGD, dict(learning_rate=0.1)),
-    (pt.optimizer.Momentum, dict(learning_rate=0.05, momentum=0.9)),
     (pt.optimizer.Adam, dict(learning_rate=0.05)),
     (pt.optimizer.AdamW, dict(learning_rate=0.05, weight_decay=0.0)),
-    (pt.optimizer.RMSProp, dict(learning_rate=0.05, momentum=0.9)),
-    (pt.optimizer.Adagrad, dict(learning_rate=0.3)),
-    (pt.optimizer.Adamax, dict(learning_rate=0.05)),
-    (pt.optimizer.Lamb, dict(learning_rate=0.05, lamb_weight_decay=0.0)),
-    (pt.optimizer.Adadelta, dict(learning_rate=1.0, rho=0.5)),
+    # the remaining families converge in the slow tier — one compile
+    # per optimizer is the cost, not the math
+    pytest.param(pt.optimizer.Momentum,
+                 dict(learning_rate=0.05, momentum=0.9),
+                 marks=pytest.mark.slow),
+    pytest.param(pt.optimizer.RMSProp,
+                 dict(learning_rate=0.05, momentum=0.9),
+                 marks=pytest.mark.slow),
+    pytest.param(pt.optimizer.Adagrad, dict(learning_rate=0.3),
+                 marks=pytest.mark.slow),
+    pytest.param(pt.optimizer.Adamax, dict(learning_rate=0.05),
+                 marks=pytest.mark.slow),
+    pytest.param(pt.optimizer.Lamb,
+                 dict(learning_rate=0.05, lamb_weight_decay=0.0),
+                 marks=pytest.mark.slow),
+    pytest.param(pt.optimizer.Adadelta, dict(learning_rate=1.0, rho=0.5),
+                 marks=pytest.mark.slow),
 ])
 def test_optimizer_converges(opt_cls, kwargs):
     model, X, Y = _quadratic_problem()
@@ -219,6 +230,7 @@ class TestLBFGS:
                                  rcond=None)[0]
         np.testing.assert_allclose(x.numpy(), x_star, atol=1e-3, rtol=1e-3)
 
+    @pytest.mark.slow
     def test_rosenbrock_descends(self):
         xy = pt.to_tensor(np.array([-1.2, 1.0], np.float32),
                           stop_gradient=False)

@@ -62,6 +62,7 @@ def test_flash_grads_match_reference(causal):
                                    atol=2e-2, rtol=2e-2)
 
 
+@pytest.mark.slow
 def test_flash_grads_unaligned():
     b, h, s, d = 1, 1, 72, 48
     q, k, v = (_rand((b, h, s, d), 20 + i) for i in range(3))
@@ -205,6 +206,7 @@ class TestFlashDropout:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5, rtol=2e-5)
 
+    @pytest.mark.slow
     def test_grads_match_mask_oracle(self):
         q, k, v, seed = self._setup()
         g = jax.grad(lambda *a: (mha(*a[:3], dropout_p=self.PD, seed=a[3],
@@ -231,6 +233,7 @@ class TestFlashDropout:
                              128, 128, self.PD)
         assert bool((m != m3).any())
 
+    @pytest.mark.slow
     def test_dropout_changes_with_seed_and_zero_is_exact(self):
         q, k, v, _ = self._setup()
         o1 = mha(q, k, v, dropout_p=self.PD,
@@ -243,6 +246,7 @@ class TestFlashDropout:
                                    np.asarray(mha_reference(q, k, v)),
                                    atol=2e-5, rtol=2e-5)
 
+    @pytest.mark.slow
     def test_framework_entry_dropout_trains(self):
         """flash_attention with dropout through the tape: grads flow and
         two eager calls draw different masks (generator advances)."""
@@ -290,6 +294,7 @@ class TestVarlen:
                     np.asarray(out)[bi, :, :L], np.asarray(ref)[bi, :, :L],
                     atol=2e-5, rtol=2e-5)
 
+    @pytest.mark.slow
     def test_grads_match_masked_reference(self):
         q, k, v = (_rand((2, 2, 128, 64), i) for i in range(3))
         lens = np.array([100, 40], np.int32)
@@ -308,6 +313,7 @@ class TestVarlen:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                        atol=3e-4, rtol=3e-4)
 
+    @pytest.mark.slow
     def test_unpadded_api_packed_layout(self):
         import paddle_tpu as pt
         from paddle_tpu.nn.functional import flash_attn_unpadded
@@ -402,6 +408,7 @@ class TestPackedVarlen:
             out[qs:qe] = np.einsum("hqk,hkd->hqd", p, vv).transpose(1, 0, 2)
         return out
 
+    @pytest.mark.slow
     def test_self_and_cross_all_modes(self):
         from paddle_tpu.ops.pallas_ops import mha_packed
         rs = np.random.RandomState(0)
@@ -421,6 +428,7 @@ class TestPackedVarlen:
                 want = self._oracle(q, kk, vv, cu, cu_k_used, causal)
                 np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
 
+    @pytest.mark.slow
     def test_grads_vs_dense(self):
         from paddle_tpu.ops.pallas_ops import mha_packed
         rs = np.random.RandomState(1)
@@ -456,30 +464,34 @@ class TestPackedVarlen:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                        atol=3e-4, rtol=3e-4)
 
+    @pytest.mark.slow
     def test_unpadded_api_cross_lengths_and_validation(self):
+        # small shapes on purpose: this is the FAST-tier guard for the
+        # packed path; the full-size parity lives in the slow tier
         import paddle_tpu as pt
         from paddle_tpu.nn.functional import flash_attn_unpadded
         rs = np.random.RandomState(5)
-        H, D = 2, 64
-        cu = np.cumsum([0, 40, 70]).astype(np.int32)
-        cuk = np.cumsum([0, 64, 32]).astype(np.int32)
+        H, D = 1, 32
+        cu = np.cumsum([0, 12, 20]).astype(np.int32)
+        cuk = np.cumsum([0, 16, 10]).astype(np.int32)
         q = rs.randn(int(cu[-1]), H, D).astype(np.float32)
         k = rs.randn(int(cuk[-1]), H, D).astype(np.float32)
         v = rs.randn(int(cuk[-1]), H, D).astype(np.float32)
         out, _ = flash_attn_unpadded(
             pt.to_tensor(q), pt.to_tensor(k), pt.to_tensor(v),
-            pt.to_tensor(cu), pt.to_tensor(cuk), 70, 64,
+            pt.to_tensor(cu), pt.to_tensor(cuk), 20, 16,
             scale=1.0 / np.sqrt(D))
         want = self._oracle(q, k, v, cu, cuk, False)
         np.testing.assert_allclose(out.numpy(), want, atol=2e-3, rtol=2e-3)
         # malformed cu raises eagerly (no NaN poison)
-        bad = np.array([0, 80, 30], np.int32)
+        bad = np.array([0, 25, 10], np.int32)
         with pytest.raises(ValueError):
             flash_attn_unpadded(
                 pt.to_tensor(q), pt.to_tensor(k), pt.to_tensor(v),
-                pt.to_tensor(bad), pt.to_tensor(cuk), 70, 64,
+                pt.to_tensor(bad), pt.to_tensor(cuk), 20, 16,
                 scale=1.0 / np.sqrt(D))
 
+    @pytest.mark.slow
     def test_unpadded_grad_through_tape(self):
         import paddle_tpu as pt
         from paddle_tpu.nn.functional import flash_attn_unpadded
@@ -494,3 +506,28 @@ class TestPackedVarlen:
         pt.sum(out * out).backward()
         assert q.grad is not None
         assert np.isfinite(np.asarray(q.grad._data)).all()
+
+
+def test_packed_varlen_fast_guard():
+    """Minimal fast-tier guard for the packed path: ONE tiny kernel call
+    (single cross pair) + the eager cu validation. Full parity suites
+    are slow-tier."""
+    import paddle_tpu as pt
+    from paddle_tpu.ops.pallas_ops import mha_packed
+    rs = np.random.RandomState(7)
+    cu = np.array([0, 10], np.int32)
+    cuk = np.array([0, 14], np.int32)
+    q = jnp.asarray(rs.randn(10, 1, 16).astype(np.float32))
+    k = jnp.asarray(rs.randn(14, 1, 16).astype(np.float32))
+    v = jnp.asarray(rs.randn(14, 1, 16).astype(np.float32))
+    got = np.asarray(mha_packed(q, k, v, jnp.asarray(cu), jnp.asarray(cuk),
+                                causal=False, block_q=16, block_k=16,
+                                interpret=True))
+    s = np.einsum("qhd,khd->hqk", np.asarray(q), np.asarray(k)) / 4.0
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    want = np.einsum("hqk,khd->qhd", p, np.asarray(v))
+    np.testing.assert_allclose(got, want, atol=2e-5)
+    from paddle_tpu.nn.functional.flash_attention import _validate_cu
+    with pytest.raises(ValueError):
+        _validate_cu(np.array([0, 20, 10], np.int32), 14, "cu_seqlens_k")

@@ -92,6 +92,7 @@ def test_train_batch_sequential_vs_compiled_parity():
     np.testing.assert_allclose(ref, got, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_state_dict_sync_after_compiled_steps():
     dist.init_mesh({"dp": 4, "pp": 2})
     pt.seed(0)

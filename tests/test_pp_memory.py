@@ -72,6 +72,7 @@ def test_pipelined_state_bytes_beat_replicated_baseline():
     assert pp_temp <= 3 * max(base_temp, 1), (pp_temp, base_temp)
 
 
+@pytest.mark.slow
 def test_zero_sharding_shrinks_argument_bytes():
     """ZeRO-1: optimizer-state partitioning must show up in the lowered
     program's per-device argument bytes."""

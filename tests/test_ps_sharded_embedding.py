@@ -50,6 +50,7 @@ class TestShardedEmbedding:
         # per-device rows shrink 1/8 — the PS "table shard" memory win
         assert w._data.addressable_shards[0].data.shape[0] == VOCAB // 8
 
+    @pytest.mark.slow
     def test_train_step_parity_with_dense_embedding(self):
         rng = np.random.RandomState(0)
         ids = rng.randint(0, VOCAB, (16, 8)).astype(np.int32)

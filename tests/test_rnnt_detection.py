@@ -88,6 +88,7 @@ class TestRNNTLoss:
             np.testing.assert_allclose(g[0, t, u, v], fd, rtol=5e-2,
                                        atol=5e-3)
 
+    @pytest.mark.slow
     def test_fastemit_increases_emit_weight(self):
         rng = np.random.RandomState(2)
         acts = rng.randn(1, 4, 3, 6).astype(np.float32)
@@ -180,6 +181,7 @@ class TestYoloBox:
         assert np.all(b[0, :, 2] <= 127.0 + 1e-5)  # clipped to image
         assert np.all(b[:, :, 0] >= 0)
 
+    @pytest.mark.slow
     def test_conf_thresh_zeroes_low_confidence(self):
         rng = np.random.RandomState(5)
         x = rng.randn(1, 2 * 7, 2, 2).astype(np.float32) * 0.01  # conf~0.5
@@ -209,6 +211,7 @@ class TestYoloBox:
 
 
 class TestDeformConv2d:
+    @pytest.mark.slow
     def test_zero_offsets_match_plain_conv(self):
         """With zero offsets (and no mask) deformable conv IS standard
         convolution — oracle: F.conv2d."""
@@ -294,6 +297,7 @@ class TestPSRoIPool:
             np.testing.assert_allclose(got[r], oracle(rois[r]),
                                        rtol=1e-5, atol=1e-5)
 
+    @pytest.mark.slow
     def test_gradients_flow(self):
         rng = np.random.RandomState(11)
         x = Tensor(rng.randn(1, 8, 6, 6).astype(np.float32))
@@ -346,6 +350,7 @@ class TestYoloLoss:
                 first = float(loss)
         assert float(loss) < 0.5 * first, (first, float(loss))
 
+    @pytest.mark.slow
     def test_padding_boxes_are_ignored(self):
         x, gtb, gtl, kw = self._setup()
         l1 = np.asarray(V.yolo_loss(Tensor(x), Tensor(gtb), Tensor(gtl),

@@ -116,6 +116,7 @@ def test_ring_attention_long_sequence_memory_shape():
 
 
 @pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.slow
 def test_ring_attention_kernel_path_matches_xla(causal):
     """use_kernel=True (Pallas flash blocks, traced causal_shift,
     differentiable lse merge) == the XLA partial-softmax path."""
@@ -169,6 +170,7 @@ def test_ring_attention_kernel_path_grads():
 
 
 @pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.slow
 def test_ulysses_kernel_path_matches_xla(causal):
     b, h, s, d = 1, 4, 64, 16
     n = 4

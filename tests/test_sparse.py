@@ -36,6 +36,7 @@ class TestCreation:
         dense[0, 0], dense[0, 2], dense[1, 1], dense[2, 0] = 1, 2, 3, 4
         np.testing.assert_allclose(x.to_dense().numpy(), dense)
 
+    @pytest.mark.slow
     def test_coo_csr_conversion(self, coo):
         x, dense = coo
         csr = x.to_sparse_csr()
@@ -73,6 +74,7 @@ class TestUnary:
 
 
 class TestBinary:
+    @pytest.mark.slow
     def test_add_subtract_union_pattern(self, coo):
         x, dense = coo
         other = np.zeros((3, 3), np.float32)
@@ -118,6 +120,7 @@ class TestBinary:
 
 
 class TestManipulation:
+    @pytest.mark.slow
     def test_transpose_reshape_slice_sum(self, coo):
         x, dense = coo
         np.testing.assert_allclose(S.transpose(x, [1, 0]).to_dense().numpy(),

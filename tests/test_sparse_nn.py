@@ -59,6 +59,7 @@ def test_subm_conv3d_matches_masked_dense():
     assert np.allclose(got[~mask], 0)
 
 
+@pytest.mark.slow
 def test_conv3d_pattern_and_values():
     x, idx, vals = _rand_coo()
     conv = snn.Conv3D(3, 4, 3, stride=2, padding=1)
@@ -91,6 +92,7 @@ def test_subm_conv2d():
                                atol=1e-4)
 
 
+@pytest.mark.slow
 def test_sparse_conv_grad_fd():
     """FD check on one weight element through subm conv + relu."""
     x, idx, vals = _rand_coo(8, (1, 4, 4, 4, 2))
@@ -160,6 +162,7 @@ def test_sparse_activations_and_pool():
                                want[np.isfinite(want)], atol=1e-5)
 
 
+@pytest.mark.slow
 def test_sparse_softmax_csr():
     m = RNG.rand(5, 6)
     m[m < 0.5] = 0
@@ -208,6 +211,7 @@ def test_sync_batchnorm_convert():
     assert isinstance(out.bn, snn.SyncBatchNorm)
 
 
+@pytest.mark.slow
 def test_softmax_coo_keeps_tape():
     """conv -> relu -> COO softmax -> backward must reach the conv
     weights (the severed-tape regression)."""
@@ -246,6 +250,7 @@ def test_sparse_coo_tensor_stop_gradient_contract():
     assert t2.values() is vals
 
 
+@pytest.mark.slow
 def test_sparse_pool_ceil_mode():
     x, idx, vals = _rand_coo(12, (1, 5, 5, 5, 2))
     out_floor = snn.MaxPool3D(2, stride=2)(x)

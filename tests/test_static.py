@@ -232,6 +232,7 @@ class TestBackward:
                                      fetch_list=[gx])
         np.testing.assert_allclose(res, 2 * X * T, rtol=1e-5)
 
+    @pytest.mark.slow
     def test_deep_program_no_recursion_limit(self, static_mode):
         main = static.Program()
         with static.program_guard(main):

@@ -41,6 +41,7 @@ class TestDlpack:
         y = utils.dlpack.from_dlpack(arr)
         np.testing.assert_array_equal(y.numpy(), arr)
 
+    @pytest.mark.slow
     def test_torch_interop(self):
         torch = pytest.importorskip("torch")
         t = torch.arange(8, dtype=torch.float32)
@@ -128,6 +129,7 @@ class TestDownload:
 
 
 class TestRunCheck:
+    @pytest.mark.slow
     def test_run_check(self, capsys):
         utils.run_check()
         out = capsys.readouterr().out

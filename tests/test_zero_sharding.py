@@ -112,6 +112,7 @@ class TestZeroStage12:
 
     @pytest.mark.parametrize("level", [
     pytest.param("os", marks=pytest.mark.slow), "os_g"])
+    @pytest.mark.slow
     def test_loss_parity_with_baseline(self, level):
         ref, _ = _train(None)
         got, _ = _train(level)
