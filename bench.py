@@ -1,28 +1,36 @@
 """Benchmark entry (driver-run on real TPU hardware).
 
-Measures two BASELINE.md configs on a single chip:
+Measures BASELINE.md configs on a single chip:
  - configs[0]: ResNet-50 training throughput, CIFAR-10-shaped data
-   (batch 256, 3x32x32), images/sec.
+   (batch 256, 3x32x32), images/sec  -> the headline "value".
  - configs[3]-class: GPT-345M causal-LM training, seq 1024, bf16 AMP,
    tokens/sec/chip + MFU — the transformer fast path the framework is for.
+ - BERT-base finetune step, ring attention at S=8192, and the packed
+   ragged-varlen flash kernel vs its padded equivalent.
 
 Each train step (forward + backward + optimizer update) is ONE jitted XLA
 program with bf16 AMP. MFU comes from XLA's own cost analysis vs the chip's
-public bf16 peak.
+public bf16 peak (plus the analytic 6N model MFU for GPT, since XLA cannot
+see Pallas FLOPs).
 
-Robustness (BENCH_r02 post-mortem: a refused tunnel connection at
-param-init time produced rc=1 and zero signal): every device-touching
-stage runs under bounded retry-with-backoff, and the script ALWAYS prints
-its one JSON line — with partial fields (device_kind, compile time,
-cost-analysis FLOPs, error tails) when a stage could not complete. rc=0
-iff at least one throughput number was measured.
+Architecture (BENCH r01/r02/r04 post-mortems — three rounds of rc=1):
+the PARENT PROCESS NEVER INITIALIZES JAX. Every device-touching leg runs
+in its own subprocess with a hard watchdog timeout, so a hanging tunnel
+(observed: ``jax.local_devices()`` blocking >6 min) costs one leg, not
+the run. The merged JSON line is re-printed after EVERY leg — if the
+driver kills the run mid-leg, the last stdout line still carries every
+number measured so far. A canary failure downgrades to a reduced leg
+list rather than skipping TPU entirely. rc=0 iff at least one
+throughput number was measured.
 
-Prints ONE json line: {"metric", "value", "unit", "vs_baseline", ...}.
+Prints its json line (last line = most complete):
+{"metric", "value", "unit", "vs_baseline", ...}.
 """
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 import traceback
@@ -34,8 +42,23 @@ BERT_SEQ = 128
 WARMUP = 1 if SMOKE else 5
 ITERS = 2 if SMOKE else 15       # steps per timed block
 BLOCKS = 1 if SMOKE else 3       # timed blocks -> min/median/max spread
-RETRIES = 1 if SMOKE else 5
-BACKOFF = (5, 10, 20, 40, 60)  # seconds between attempts
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_GPT_CACHE = os.path.join(_HERE, ".bench_gpt_best.json")
+
+# Wall-clock budget for the whole script. The driver's patience is finite
+# (r04 died with nothing); finish inside it and print what we have.
+BUDGET_SEC = float(os.environ.get("BENCH_BUDGET_SEC",
+                                  "900" if SMOKE else "2700"))
+
+# Per-leg watchdog timeouts (seconds). GPT-345M compile alone is
+# ~75-100 s over the tunnel; timing adds ~3 blocks * 15 steps * ~0.3 s.
+_T = (lambda full, smoke: smoke if SMOKE else full)
+LEG_TIMEOUT = {
+    "canary": _T(300, 120), "canary_retry": _T(420, 120),
+    "resnet": _T(600, 300), "gpt": _T(900, 300), "bert": _T(600, 300),
+    "ring": _T(600, 300), "packed": _T(600, 300),
+}
 
 # Driver-captured r03 numbers (BENCH_r03.json, 2026-07-30) — the
 # reproducible baseline this build is measured against. vs_baseline is
@@ -65,27 +88,11 @@ def _error_tail(tb: str) -> str:
     return (lines[-1] if lines else "")[:400]
 
 
-def _is_oom(e: Exception) -> bool:
-    s = str(e)
+def _is_oom_str(s: str) -> bool:
     return any(t in s for t in (
         "RESOURCE_EXHAUSTED", "Resource exhausted", "out of memory",
         "Out of memory", "OOM", "Allocation failure",
         "exceeds the memory capacity", "exceeds available memory"))
-
-
-def _retry(stage_name, fn, errors, attempts=RETRIES):
-    """Run fn() with bounded retry-with-backoff. Returns result or None;
-    records the last error tail in errors[stage_name]."""
-    for attempt in range(attempts):
-        try:
-            out = fn()
-            errors.pop(stage_name, None)  # earlier attempts' noise
-            return out
-        except Exception:
-            errors[stage_name] = _error_tail(traceback.format_exc(limit=20))
-            if attempt < attempts - 1:
-                time.sleep(BACKOFF[min(attempt, len(BACKOFF) - 1)])
-    return None
 
 
 def _honor_cpu_override():
@@ -134,6 +141,11 @@ def _peak_flops(device_kind):
         if kind.startswith(k.lower()):
             return _PEAK[k]
     return None
+
+
+def _device_kind():
+    import jax
+    return jax.local_devices()[0].device_kind
 
 
 def _fetch_scalar(out):
@@ -203,7 +215,25 @@ def _spread_ms(times):
             "max": round(s[-1], 2)}
 
 
-def bench_resnet(result, errors):
+# ---------------------------------------------------------------------------
+# Legs (each runs inside its own subprocess; writes into `result`)
+# ---------------------------------------------------------------------------
+
+def leg_canary(result):
+    """Tiny matmul on the device: proves the tunnel is alive and records
+    the device kind. Must be cheap — it is the gatekeeper the heavy legs
+    consult, not a benchmark."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    result["device_kind"] = _device_kind()
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    y = jax.jit(lambda a: a @ a)(x)
+    assert float(np.asarray(y[0, 0])) == 256.0
+    result["canary_ok"] = True
+
+
+def bench_resnet(result):
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -211,6 +241,7 @@ def bench_resnet(result, errors):
     from paddle_tpu.jit.api import functional_call
     from paddle_tpu.tensor import Tensor
 
+    result["device_kind"] = _device_kind()
     pt.seed(0)
     net = pt.vision.models.resnet50(num_classes=10)
     pt.amp.decorate(net, level="O2", dtype="bfloat16")
@@ -264,7 +295,7 @@ def bench_resnet(result, errors):
     return ips
 
 
-def bench_gpt(result, errors, batch, recompute=True):
+def bench_gpt(result, batch, recompute=True):
     """GPT-345M-class train step (bf16, seq 1024) — tokens/sec/chip + MFU."""
     import numpy as np
     import jax
@@ -276,6 +307,7 @@ def bench_gpt(result, errors, batch, recompute=True):
                                             GPTPretrainingCriterion,
                                             gpt_345m)
 
+    result["device_kind"] = _device_kind()
     pt.seed(0)
     if SMOKE:
         from paddle_tpu.incubate.models import gpt_tiny
@@ -351,7 +383,7 @@ def bench_gpt(result, errors, batch, recompute=True):
     return tps
 
 
-def bench_bert(result, errors, batch):
+def bench_bert(result, batch):
     """BERT-base SST-2-style finetune step (config[1]): seq/sec via the
     compiled (to_static-equivalent) path, bf16 AMP."""
     import numpy as np
@@ -363,6 +395,7 @@ def bench_bert(result, errors, batch):
     from paddle_tpu.incubate.models import (BertForSequenceClassification,
                                             bert_base, bert_tiny)
 
+    result["device_kind"] = _device_kind()
     pt.seed(0)
     cfg = bert_tiny() if SMOKE else bert_base()
     model = BertForSequenceClassification(cfg, num_classes=2)
@@ -421,7 +454,7 @@ def bench_bert(result, errors, batch):
     return sps
 
 
-def bench_ring(result, errors):
+def bench_ring(result):
     """Ring-attention leg: the Pallas flash kernel driven through the
     shard_map ring schedule on the real chip (1-device mesh still
     exercises the kernel lowering + collective plumbing), S=8192 —
@@ -437,6 +470,7 @@ def bench_ring(result, errors):
     from paddle_tpu.distributed.fleet.meta_parallel.sequence_parallel \
         import ring_attention
 
+    result["device_kind"] = _device_kind()
     B, H, S, D = 1, 16, 512 if SMOKE else 8192, 64
     mesh = Mesh(np.array(jax.devices()[:1]), ("sep",))
     rng = np.random.RandomState(0)
@@ -489,98 +523,214 @@ def bench_ring(result, errors):
     return ms
 
 
+def bench_packed(result):
+    """Packed ragged-varlen flash attention on the real chip — the r04
+    kernel that until now only ever ran in interpret mode.
+
+    Mixed lengths 64..1024 (sum 3392 vs 8*1024=8192 padded tokens;
+    sum len^2 is 3.6x below B*max^2), fwd+bwd through all three packed
+    kernels (fwd/dq/dkv), vs the SAME data through the padded batched
+    flash kernel. Valid rows of both paths must agree (parity recorded),
+    and packed should win by skipping off-band tiles."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas_ops import mha, mha_packed
+
+    result["device_kind"] = _device_kind()
+    H, D = 16, 64
+    lens = [16, 32, 48, 24] if SMOKE else [64, 128, 896, 256, 1024, 192,
+                                           512, 320]
+    B, mx = len(lens), max(lens)
+    total = sum(lens)
+    cu = jnp.asarray(np.concatenate([[0], np.cumsum(lens)]).astype(np.int32))
+    rng = np.random.RandomState(0)
+    qp = jnp.asarray(rng.randn(total, H, D).astype(np.float32)).astype(
+        jnp.bfloat16)
+    kp = jnp.asarray(rng.randn(total, H, D).astype(np.float32)).astype(
+        jnp.bfloat16)
+    vp = jnp.asarray(rng.randn(total, H, D).astype(np.float32)).astype(
+        jnp.bfloat16)
+    # the same tokens scattered to a padded (B, H, mx, D) batch (mha's
+    # layout); advanced indexing at axes 0/2 broadcasts (total, H, D)
+    rows = np.concatenate([np.full(L, i) for i, L in enumerate(lens)])
+    cols = np.concatenate([np.arange(L) for L in lens])
+
+    def pad_batch(x):
+        buf = jnp.zeros((B, H, mx, D), x.dtype)
+        return buf.at[rows, :, cols].set(x)
+
+    qb, kb, vb = pad_batch(qp), pad_batch(kp), pad_batch(vp)
+
+    interp = None if SMOKE else False  # SMOKE runs on CPU via interpret
+
+    def packed_fb(q):
+        def loss(q):
+            out = mha_packed(q, kp, vp, cu, cu, causal=True,
+                             interpret=interp)
+            return jnp.sum(out.astype(jnp.float32)), out
+        (s, out), dq = jax.value_and_grad(loss, has_aux=True)(q)
+        return s, out, dq
+
+    def padded_fb(q):
+        def loss(q):
+            out = mha(q, kb, vb, causal=True, interpret=interp)
+            return jnp.sum(out.astype(jnp.float32)), out
+        (s, out), dq = jax.value_and_grad(loss, has_aux=True)(q)
+        return s, out, dq
+
+    cpk = jax.jit(packed_fb).lower(qp).compile()
+    cpd = jax.jit(padded_fb).lower(qb).compile()
+    result["packed_varlen_memory"] = _memory_report(cpk)
+
+    # parity on valid rows (fwd outputs; bf16 tolerance)
+    _, op, _ = cpk(qp)
+    _, ob, _ = cpd(qb)
+    err = float(jnp.max(jnp.abs(
+        op.astype(jnp.float32) - ob[rows, :, cols].astype(jnp.float32))))
+    result["packed_varlen_parity_err"] = round(err, 4)
+
+    def timed(compiled, q0):
+        s, _, dq = compiled(q0)
+        float(np.asarray(s))
+        qq, iters = q0, 2 if SMOKE else 10
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            s, _, dq = compiled(qq)
+            qq = (qq.astype(jnp.float32)
+                  + dq.astype(jnp.float32) * 1e-3).astype(qq.dtype)
+        float(np.asarray(s))
+        dt = time.perf_counter() - t0
+        return max(dt - _fence_cost(), 1e-9) / iters * 1000
+
+    ms_packed = timed(cpk, qp)
+    ms_padded = timed(cpd, qb)
+    result["packed_varlen_fwdbwd_ms"] = round(ms_packed, 2)
+    result["padded_equiv_fwdbwd_ms"] = round(ms_padded, 2)
+    result["packed_varlen_speedup"] = round(ms_padded / ms_packed, 2)
+    result["packed_varlen_tokens_per_sec"] = round(
+        total / (ms_packed / 1000), 1)
+    result["packed_varlen_lens"] = lens
+    return ms_packed
+
+
+# ---------------------------------------------------------------------------
+# Leg subprocess plumbing
+# ---------------------------------------------------------------------------
+
+def _leg_main(name, batch, recompute):
+    """Child entry: run one leg, print one JSON line, exit 0 always
+    (errors travel in the JSON)."""
+    _honor_cpu_override()
+    fields: dict = {}
+    rec = {"ok": True, "fields": fields}
+    try:
+        if name == "canary":
+            leg_canary(fields)
+        elif name == "resnet":
+            bench_resnet(fields)
+        elif name == "gpt":
+            bench_gpt(fields, batch, recompute=recompute)
+        elif name == "bert":
+            bench_bert(fields, batch)
+        elif name == "ring":
+            bench_ring(fields)
+        elif name == "packed":
+            bench_packed(fields)
+        else:
+            raise ValueError(f"unknown leg {name}")
+    except Exception:
+        tb = traceback.format_exc(limit=20)
+        rec["ok"] = False
+        rec["error"] = _error_tail(tb)
+        rec["oom"] = _is_oom_str(tb)
+    print(json.dumps(rec), flush=True)
+
+
+def _run_leg(name, timeout, args=(), extra_env=None):
+    """Run one leg in a watchdog-guarded subprocess; parse its JSON line.
+    Never raises: returns {"ok": False, "error": ...} on any failure."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--leg", name,
+           *map(str, args)]
+    try:
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=timeout, cwd=_HERE,
+                             env={**os.environ, **(extra_env or {})})
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "error": f"watchdog timeout after {timeout}s",
+                "timeout": True}
+    except Exception:
+        return {"ok": False,
+                "error": _error_tail(traceback.format_exc(limit=5))}
+    for line in reversed(out.stdout.strip().splitlines()):
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except Exception:
+                break
+    tail = (out.stderr.strip().splitlines() or ["no output"])[-1][:400]
+    return {"ok": False, "error": f"leg rc={out.returncode}: {tail}",
+            "oom": _is_oom_str(out.stderr)}
+
+
+def _gpt_ladder_start():
+    """Persisted known-good GPT config (committed cache file; updated on
+    a successful local run). Avoids burning a ~100 s compile every round
+    to rediscover that (16, no-remat) OOMs a 16G chip."""
+    try:
+        with open(_GPT_CACHE) as f:
+            c = json.load(f)
+        return int(c["batch"]), bool(c["recompute"])
+    except Exception:
+        return 8, False
+
+
 def main():
+    if len(sys.argv) >= 3 and sys.argv[1] == "--leg":
+        name = sys.argv[2]
+        batch = int(sys.argv[3]) if len(sys.argv) > 3 else 0
+        recompute = bool(int(sys.argv[4])) if len(sys.argv) > 4 else True
+        _leg_main(name, batch, recompute)
+        return
+
+    t_start = time.time()
     errors: dict = {}
     result: dict = {
         "metric": "resnet50_cifar10_train_throughput",
         "value": None,
         "unit": "images/sec",
         "vs_baseline": None,
+        "device_kind": None,
     }
 
-    _honor_cpu_override()
+    def remaining():
+        return BUDGET_SEC - (time.time() - t_start)
 
-    def probe():
-        # subprocess probe with a hard timeout: a HANGING tunnel (observed
-        # in round 3: jax.devices() blocked >6 min) must not stall the
-        # whole bench past the driver's budget. Only after the probe
-        # succeeds do we initialize jax in-process.
-        import subprocess
-        code = ("import os, jax\n"
-                "if os.environ.get('JAX_PLATFORMS','').strip() == 'cpu':\n"
-                "    jax.config.update('jax_platforms', 'cpu')\n"
-                "print(jax.local_devices()[0].device_kind)\n")
+    def emit():
+        # partial emission: the driver keeps the tail of stdout, so the
+        # last printed line always carries everything measured so far
+        if errors:
+            result["errors"] = dict(errors)
+        else:
+            result.pop("errors", None)
+        print(json.dumps(result), flush=True)
+
+    def merge(rec, stage):
+        for k, v in (rec.get("fields") or {}).items():
+            if v is not None or k not in result:
+                result[k] = v
+        if rec.get("ok"):
+            errors.pop(stage, None)
+        elif rec.get("error"):
+            errors[stage] = rec["error"]
+        emit()
+        return bool(rec.get("ok"))
+
+    # --- CPU leg first: the host-side dispatch microbench never needs
+    # the tunnel, so its numbers land even if every TPU leg dies.
+    def run_eager():
         out = subprocess.run(
-            [sys.executable, "-c", code], capture_output=True, text=True,
-            timeout=60 if SMOKE else 120)
-        if out.returncode != 0:
-            raise RuntimeError(out.stderr.strip().splitlines()[-1][:400]
-                               if out.stderr.strip() else "probe failed")
-        return out.stdout.strip().splitlines()[-1]
-
-    kind = _retry("device_probe", probe, errors, attempts=3)
-    result["device_kind"] = kind
-
-    if kind is not None:
-        _retry("resnet50", lambda: bench_resnet(result, errors), errors)
-
-        def run_gpt():
-            # ladder: no-remat first (fewer FLOPs when it fits), then
-            # remat, then halve the batch; non-OOM errors retry via
-            # _retry. First-fit is NOT always fastest (on v5e-lite 16G,
-            # (8, no-remat) beats (16, remat)), so keep measuring until
-            # two configs succeed and report the better one.
-            ladder = ((16, False), (8, False), (16, True), (8, True),
-                      (4, True), (2, True))
-            best, successes = None, 0
-            for b, rc in ladder:
-                trial = dict(result)
-                try:
-                    bench_gpt(trial, errors, b, recompute=rc)
-                except Exception as e:
-                    errors[f"gpt345m_b{b}_rc{int(rc)}"] = _error_tail(
-                        traceback.format_exc(limit=20))
-                    if successes > 0:
-                        break  # keep the measured config, don't discard it
-                    if not _is_oom(e) or (b, rc) == ladder[-1]:
-                        raise
-                    continue
-                successes += 1
-                if best is None or (trial.get("gpt345m_tokens_per_sec", 0)
-                                    > best.get("gpt345m_tokens_per_sec", 0)):
-                    best = trial
-                if successes >= 2:
-                    break
-            if best is not None:
-                result.update(best)
-                # successful descent: earlier rungs' OOMs aren't errors
-                for bb, rr in ladder:
-                    errors.pop(f"gpt345m_b{bb}_rc{int(rr)}", None)
-            return best
-
-        _retry("gpt345m", run_gpt, errors)
-
-        def run_bert():
-            ladder = (32, 16, 8)
-            for b in ladder:
-                try:
-                    return bench_bert(result, errors, b)
-                except Exception as e:
-                    if not _is_oom(e) or b == ladder[-1]:
-                        raise
-            return None
-
-        _retry("bert_base", run_bert, errors)
-        _retry("ring_attn", lambda: bench_ring(result, errors), errors,
-               attempts=2)
-
-    def run_eager_bench():
-        # host-side dispatch microbench (bench_eager.py) in a CPU-forced
-        # subprocess; its one JSON line rides along in the record
-        import subprocess
-        here = os.path.dirname(os.path.abspath(__file__))
-        out = subprocess.run(
-            [sys.executable, os.path.join(here, "bench_eager.py")],
+            [sys.executable, os.path.join(_HERE, "bench_eager.py")],
             capture_output=True, text=True, timeout=300,
             env={**os.environ, "JAX_PLATFORMS": "cpu"})
         if out.returncode != 0:
@@ -589,18 +739,128 @@ def main():
                                else f"bench_eager rc={out.returncode}")
         return json.loads(out.stdout.strip().splitlines()[-1])
 
-    eager = _retry("eager_dispatch", run_eager_bench, errors, attempts=1)
-    if eager:
+    try:
+        eager = run_eager()
         result["eager_dispatch_us_per_op"] = {
             k: eager[k] for k in ("raw_jax", "tape_off", "tape_on",
                                   "jit_chain", "tape_overhead_ratio")
             if k in eager}
+    except Exception:
+        errors["eager_dispatch"] = _error_tail(traceback.format_exc(limit=5))
+    emit()
 
-    if errors:
-        result["errors"] = errors
-    ok = (result["value"] is not None or
-          result.get("gpt345m_tokens_per_sec") is not None)
-    print(json.dumps(result))
+    # --- canary: is the tunnel alive? Two watchdogged attempts (the
+    # tunnel has been observed taking >2.5 min just to hand out
+    # jax.local_devices()). A dead canary REDUCES the leg list — it
+    # must not zero it (the r04 failure: probe timeout => no TPU legs
+    # at all => nothing to judge).
+    canary_ok = merge(_run_leg("canary", LEG_TIMEOUT["canary"]), "canary")
+    if not canary_ok and remaining() > LEG_TIMEOUT["canary_retry"] + 120:
+        time.sleep(5 if SMOKE else 30)
+        canary_ok = merge(_run_leg("canary", LEG_TIMEOUT["canary_retry"]),
+                          "canary")
+
+    def leg_budget(name):
+        t = min(LEG_TIMEOUT[name], max(remaining() - 60, 0))
+        return t if t >= 180 or SMOKE else 0
+
+    def try_leg(name, stage=None, args=()):
+        t = leg_budget(name)
+        if t <= 0:
+            errors[stage or name] = "skipped: bench budget exhausted"
+            emit()
+            return None
+        rec = _run_leg(name, t, args=args)
+        merge(rec, stage or name)
+        return rec
+
+    # --- heavy legs. On a dead canary still attempt the two that
+    # matter most (resnet = headline value, gpt = MFU target) — the
+    # canary may have failed on a transient while the tunnel recovers.
+    if canary_ok:
+        # headline leg gets a budget-gated second attempt: a transient
+        # tunnel blip must not cost the round's "value" (the old code
+        # had attempts=5; one retry preserves that invariant cheaply)
+        rec = try_leg("resnet")
+        if rec is not None and not rec.get("ok"):
+            try_leg("resnet")
+
+        # GPT ladder, fastest-first; start at the persisted known-good
+        # rung, descend on OOM/timeout, and on success CLIMB one rung
+        # back up (budget permitting) so a transient OOM in a past
+        # round cannot pin the cache to a slow config forever. One
+        # config per subprocess (two 345M step builds in one process
+        # OOM the 16G chip).
+        rungs = [(8, False), (8, True), (4, True), (2, True)]
+        start = _gpt_ladder_start()
+        if start not in rungs:
+            rungs.insert(0, start)  # hand-edited cache: trust it first
+        i0 = rungs.index(start)
+        measured: dict = {}  # cfg -> tokens/sec
+        i = i0
+        while i < len(rungs):
+            b, rc = rungs[i]
+            rec = try_leg("gpt", stage=f"gpt345m_b{b}_rc{int(rc)}",
+                          args=(b, int(rc)))
+            if rec is None:
+                break
+            if rec.get("ok"):
+                measured[rungs[i]] = (rec.get("fields") or {}).get(
+                    "gpt345m_tokens_per_sec") or 0
+                break
+            if not rec.get("oom") and not rec.get("timeout"):
+                break  # real error: retrying a smaller batch won't help
+            i += 1
+        if measured and i == i0 and i0 > 0:
+            t = leg_budget("gpt")
+            if t > 0:
+                b, rc = rungs[i0 - 1]
+                up = _run_leg("gpt", t, args=(b, int(rc)))
+                tps = (up.get("fields") or {}).get("gpt345m_tokens_per_sec") \
+                    if up.get("ok") else None
+                if tps and tps > max(measured.values()):
+                    measured[rungs[i0 - 1]] = tps
+                    merge(up, f"gpt345m_b{b}_rc{int(rc)}")
+                # a failed climb is expected exploration, not an error
+        if measured:
+            for b, rc in rungs:  # OOM rungs above a success aren't errors
+                errors.pop(f"gpt345m_b{b}_rc{int(rc)}", None)
+            emit()
+            best_cfg = max(measured, key=measured.get)
+            try:
+                with open(_GPT_CACHE, "w") as f:
+                    json.dump({"batch": best_cfg[0],
+                               "recompute": best_cfg[1]}, f)
+            except OSError:
+                pass
+
+        # new-kernel evidence legs before bert (bert has 3 prior
+        # driver captures already; packed/ring have none)
+        try_leg("packed")
+        try_leg("ring")
+
+        def bert_ladder():
+            for b in (32, 16, 8):
+                rec = try_leg("bert", stage=f"bert_b{b}", args=(b,))
+                if rec is None or rec.get("ok") or not rec.get("oom"):
+                    if rec is not None and rec.get("ok"):
+                        for bb in (32, 16, 8):
+                            errors.pop(f"bert_b{bb}", None)
+                    return
+        bert_ladder()
+    else:
+        # tunnel looked dead — still attempt the two headline legs with
+        # watchdogs; worst case they burn their timeouts and we report.
+        try_leg("resnet")
+        b, rc = _gpt_ladder_start()
+        try_leg("gpt", stage=f"gpt345m_b{b}_rc{int(rc)}", args=(b, int(rc)))
+
+    result["bench_wall_sec"] = round(time.time() - t_start, 1)
+    # rc=0 iff at least one throughput number was measured — any leg's
+    ok = any(result.get(k) is not None for k in (
+        "value", "gpt345m_tokens_per_sec", "bert_base_seq_per_sec",
+        "ring_attn_fwdbwd_ms", "packed_varlen_tokens_per_sec"))
+    emit()
     sys.exit(0 if ok else 1)
 
 
