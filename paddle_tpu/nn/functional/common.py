@@ -403,36 +403,41 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     return nary(f, args, name="scaled_dot_product_attention")
 
 
-_FLASH_CANARY = None
+_CANARY_CACHE: dict = {}
 
 
-def _flash_usable():
-    """One-time eager canary compile+run of a tiny flash kernel.
+def _kernel_canary(key, probe):
+    """One-time eager canary compile+run of a kernel configuration.
 
     A kernel that traces fine can still fail at LOWERING time, which
     under ``jax.jit`` happens outside any try/except at the call site and
     would kill the whole compiled train step (exactly how the r03 bench
-    lost its GPT number) — so probe eagerly once and cache the verdict.
-    """
-    global _FLASH_CANARY
-    if _FLASH_CANARY is None:
+    lost its GPT number) — so probe eagerly once and cache the verdict
+    per ``key``. ``probe`` returns arrays to block on."""
+    if key not in _CANARY_CACHE:
         try:
-            from ...ops.pallas_ops import mha
-            x = jnp.zeros((1, 1, 128, 64), jnp.bfloat16)
-            # exercise every lowering variant a train step can hit:
-            # fwd, fwd+dropout (SMEM seed path), and both bwd kernels
-            out = mha(x, x, x, causal=True, interpret=False)
-            seed = jnp.ones((), jnp.float32)
-            outd = mha(x, x, x, causal=True, dropout_p=0.1, seed=seed,
-                       interpret=False)
-            g = jax.grad(lambda q: mha(
-                q, x, x, causal=True, dropout_p=0.1, seed=seed,
-                interpret=False).astype(jnp.float32).sum())(x)
-            jax.block_until_ready((out, outd, g))
-            _FLASH_CANARY = True
+            jax.block_until_ready(probe())
+            _CANARY_CACHE[key] = True
         except Exception:
-            _FLASH_CANARY = False
-    return _FLASH_CANARY
+            _CANARY_CACHE[key] = False
+    return _CANARY_CACHE[key]
+
+
+def _flash_usable():
+    def probe():
+        from ...ops.pallas_ops import mha
+        x = jnp.zeros((1, 1, 128, 64), jnp.bfloat16)
+        # exercise every lowering variant a train step can hit:
+        # fwd, fwd+dropout (SMEM seed path), and both bwd kernels
+        out = mha(x, x, x, causal=True, interpret=False)
+        seed = jnp.ones((), jnp.float32)
+        outd = mha(x, x, x, causal=True, dropout_p=0.1, seed=seed,
+                   interpret=False)
+        g = jax.grad(lambda q: mha(
+            q, x, x, causal=True, dropout_p=0.1, seed=seed,
+            interpret=False).astype(jnp.float32).sum())(x)
+        return out, outd, g
+    return _kernel_canary("flash_mha", probe)
 
 
 def _on_tpu():
