@@ -157,6 +157,19 @@ _STATIC_SENTINEL = None
 
 _node_new = Node.__new__
 _flag_values = _flags._values  # direct dict ref for the per-op hot path
+_wref = weakref.ref
+
+# single-output fast path: op_utils registers its (_wrap_single,
+# _fast_tensor) pair so record() can skip the list-of-outputs protocol
+# — no [t] alloc, no comprehensions — for the overwhelmingly common
+# one-output op (the per-op dispatch floor bench_eager.py tracks)
+_single_wrap_fn = None
+_single_ctor = None
+
+
+def _register_single_wrap(wrap, ctor):
+    global _single_wrap_fn, _single_ctor
+    _single_wrap_fn, _single_ctor = wrap, ctor
 
 # op observers: every funnel-recorded op reports (name, inputs, outputs).
 # Serves amp.debugging operator-stats / tensor-checker tooling (ref
@@ -201,6 +214,27 @@ def record(fn, tensors, outputs_wrap, name=""):
                 needs_grad = True
                 break
     raw = fn(*datas)
+    if outputs_wrap is _single_wrap_fn:
+        t = _single_ctor(raw, needs_grad)
+        if needs_grad:
+            node = _node_new(Node)
+            node.inputs = tensors  # callers pass fresh lists; alias
+            node.vjp_fn = None
+            node.fn = fn
+            node.datas = datas
+            node.out_refs = (_wref(t),)
+            d = t._data
+            node.out_avals = ((d.shape, d.dtype),)
+            node.name = name
+            node._hooks = None
+            node._released = False
+            t._node = node  # _out_idx is already 0 from the ctor
+        if _flag_values.get("check_nan_inf"):
+            _check_nan_inf((t,), name)
+        if _op_observers:
+            for ob in list(_op_observers):
+                ob(name, tensors, (t,))
+        return t
     out_tensors, result = outputs_wrap(raw, needs_grad)
     if needs_grad:
         node = _node_new(Node)

@@ -66,6 +66,13 @@ def _wrap_single(raw, req):
     return [t], t
 
 
+# record()'s single-output fast path dispatches on this identity — the
+# wrap itself is only called when a custom recorder (static graph) or a
+# future multi-wrap path needs the generic protocol
+from ..autograd import _register_single_wrap  # noqa: E402
+_register_single_wrap(_wrap_single, _fast_tensor)
+
+
 def _wrap_tuple(raw, req):
     ts = tuple(_fast_tensor(r, req) for r in raw)
     return list(ts), ts

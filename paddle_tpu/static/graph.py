@@ -59,12 +59,16 @@ def enable_static():
     """``paddle.enable_static()``."""
     global _static_mode
     _static_mode = True
+    # install the recorder only while static mode is on: eager dispatch
+    # must not pay a per-op no-op call (bench_eager.py dispatch floor)
+    _autograd._static_recorder = _maybe_record
 
 
 def disable_static():
     """``paddle.disable_static()``."""
     global _static_mode
     _static_mode = False
+    _autograd._static_recorder = None
 
 
 def in_static_mode() -> bool:
@@ -399,5 +403,4 @@ def _maybe_record(fn, tensors, outputs_wrap, name):
     return out_vars[0] if single else tuple(out_vars)
 
 
-_autograd._static_recorder = _maybe_record
 _autograd._STATIC_SENTINEL = _NOT_STATIC
