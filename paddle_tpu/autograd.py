@@ -171,6 +171,59 @@ def _register_single_wrap(wrap, ctor):
     global _single_wrap_fn, _single_ctor
     _single_wrap_fn, _single_ctor = wrap, ctor
 
+
+def _repoint_out_ref(node, idx, ref):
+    refs = node.out_refs
+    if type(refs) is tuple:  # single-output fast path stores a tuple
+        node.out_refs = refs[:idx] + (ref,) + refs[idx + 1:]
+    else:
+        refs[idx] = ref
+
+
+def rebind_inplace(x, out):
+    """Make ``x`` become ``out`` in place (paddle's ``op_`` variants):
+    rebind data + tape linkage, then repoint the producing node's output
+    ref at the surviving tensor so backward finds cotangents under it.
+
+    When the op was recorded for grad and ``x`` is among its inputs, the
+    pre-inplace producer chain must survive the rebind: a lightweight
+    proxy tensor takes ``x``'s place in the node's inputs (and in the
+    old producer's out_refs), so backward still reaches everything
+    upstream of the overwritten value. A grad-requiring LEAF cannot be
+    rebound this way — same rule as the reference
+    (``paddle/fluid/eager/api/utils/tensor_utils.cc`` inplace check:
+    "Leaf Var that doesn't stop gradient can't use inplace strategy")."""
+    node = out._node
+    if node is not None:
+        # ONE proxy shared by every occurrence of x in the inputs: a
+        # proxy per occurrence would fight over the producer's single
+        # out_ref and silently drop all but the last cotangent. A
+        # stop-gradient leaf gets a constant proxy (_node=None) too —
+        # leaving x itself in inputs would make the node consume its
+        # own output after the rebind and deadlock the backward walk.
+        proxy = None
+        for j, t in enumerate(node.inputs):
+            if t is x:
+                if proxy is None:
+                    if x._node is None and not x.stop_gradient:
+                        raise RuntimeError(
+                            "Leaf Tensor that doesn't stop gradient can't "
+                            "use inplace strategy; detach() it or wrap the "
+                            "update in no_grad()")
+                    proxy = _single_ctor(x._data, not x.stop_gradient)
+                    if x._node is not None:
+                        proxy._node = x._node
+                        proxy._out_idx = x._out_idx
+                        _repoint_out_ref(x._node, x._out_idx, _wref(proxy))
+                node.inputs[j] = proxy  # strong ref keeps proxy alive
+    x._data = out._data
+    x._node = out._node
+    x._out_idx = out._out_idx
+    x.stop_gradient = out.stop_gradient and x.stop_gradient
+    if node is not None:
+        _repoint_out_ref(node, x._out_idx, _wref(x))
+    return x
+
 # op observers: every funnel-recorded op reports (name, inputs, outputs).
 # Serves amp.debugging operator-stats / tensor-checker tooling (ref
 # ``python/paddle/amp/debugging.py``); empty-list check keeps the hot

@@ -18,7 +18,9 @@ from .collective import (  # noqa: F401
     barrier, P2POp, batch_isend_irecv, wait, get_backend,
 )
 from .parallel import init_parallel_env, DataParallel  # noqa: F401
-from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
+from .topology import (  # noqa: F401
+    CommunicateTopology, HybridCommunicateGroup, ParallelMode,
+)
 from . import fleet  # noqa: F401
 from . import sharding  # noqa: F401
 from . import communication  # noqa: F401
@@ -40,3 +42,11 @@ from .checkpoint import (  # noqa: F401
 
 # spawn-style launch (ref: python/paddle/distributed/spawn.py)
 from .launch_api import spawn, launch  # noqa: F401
+
+
+def is_available():
+    """Whether the distributed package is usable (ref:
+    ``python/paddle/distributed/collective.py:306``). Always true on
+    this build: collectives ride XLA — no separate comm library to be
+    compiled out."""
+    return True

@@ -21,7 +21,17 @@ from . import mesh as _mesh_mod
 from .collective import Group, new_group
 from .env import get_rank
 
-__all__ = ["CommunicateTopology", "HybridCommunicateGroup"]
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup", "ParallelMode"]
+
+
+class ParallelMode:
+    """Parallel-mode enum (ref:
+    ``python/paddle/distributed/fleet/base/topology.py:33``)."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
 
 
 class CommunicateTopology:

@@ -50,7 +50,7 @@ _METHODS = [
     "unsqueeze_", "transpose", "moveaxis", "concat", "split", "chunk",
     "tile", "expand", "expand_as", "broadcast_to", "gather", "gather_nd",
     "scatter", "scatter_", "scatter_nd_add", "index_select", "index_sample",
-    "index_add", "index_put", "take_along_axis", "put_along_axis", "roll",
+    "index_add", "index_add_", "index_put", "index_put_", "take_along_axis", "put_along_axis", "roll",
     "flip", "rot90", "unbind", "repeat_interleave", "slice", "strided_slice",
     "pad", "masked_fill", "masked_select", "masked_scatter", "where",
     "unflatten", "unfold", "tolist", "numel", "swapaxes", "tensor_split",

@@ -19,7 +19,8 @@ __all__ = [
     "hstack", "vstack", "dstack", "split", "vsplit", "hsplit", "dsplit",
     "chunk", "tile", "expand", "expand_as", "broadcast_to", "broadcast_tensors",
     "gather", "gather_nd", "scatter", "scatter_", "scatter_nd",
-    "scatter_nd_add", "index_select", "index_sample", "index_add", "index_put",
+    "scatter_nd_add", "index_select", "index_sample", "index_add",
+    "index_add_", "index_put", "index_put_",
     "take_along_axis", "put_along_axis", "roll", "flip", "rot90", "unbind",
     "unstack", "repeat_interleave", "slice", "strided_slice", "crop", "pad",
     "t", "as_real", "as_complex", "view", "view_as", "atleast_1d",
@@ -45,13 +46,7 @@ def reshape(x, shape, name=None):
 
 
 def reshape_(x, shape, name=None):
-    out = reshape(x, shape)
-    x._data, x._node, x._out_idx = out._data, out._node, out._out_idx
-    x.stop_gradient = out.stop_gradient and x.stop_gradient
-    if x._node is not None:
-        import weakref
-        x._node.out_refs[x._out_idx] = weakref.ref(x)
-    return x
+    return _rebind(x, reshape(x, shape))
 
 
 view = reshape
@@ -77,13 +72,7 @@ def flatten_(x, start_axis=0, stop_axis=-1, name=None):
     return _rebind(x, flatten(x, start_axis, stop_axis))
 
 
-def _rebind(x, out):
-    import weakref
-    x._data, x._node, x._out_idx = out._data, out._node, out._out_idx
-    x.stop_gradient = out.stop_gradient and x.stop_gradient
-    if x._node is not None:
-        x._node.out_refs[x._out_idx] = weakref.ref(x)
-    return x
+from ..autograd import rebind_inplace as _rebind  # noqa: E402
 
 
 def squeeze(x, axis=None, name=None):
@@ -354,6 +343,13 @@ def index_add(x, index, axis, value, name=None):
                 name="index_add")
 
 
+def index_add_(x, index, axis, value, name=None):
+    """In-place ``index_add`` (ref
+    ``python/paddle/tensor/manipulation.py:4502``): embedding surgery /
+    KV-cache writes mutate the tensor, tape linkage rebinds."""
+    return _rebind(x, index_add(x, index, axis, value))
+
+
 def index_put(x, indices, value, accumulate=False, name=None):
     idx_tensors = [ensure_tensor(i) for i in indices]
 
@@ -362,6 +358,12 @@ def index_put(x, indices, value, accumulate=False, name=None):
                     else i for i in idxs)
         return d.at[key].add(v) if accumulate else d.at[key].set(v)
     return nary(f, [x, ensure_tensor(value)] + idx_tensors, name="index_put")
+
+
+def index_put_(x, indices, value, accumulate=False, name=None):
+    """In-place ``index_put`` (ref
+    ``python/paddle/tensor/manipulation.py:4633``)."""
+    return _rebind(x, index_put(x, indices, value, accumulate))
 
 
 def take_along_axis(x, indices, axis, broadcast=True, name=None):

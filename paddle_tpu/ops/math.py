@@ -589,18 +589,10 @@ floor_mod = mod
 
 # ---- in-place variants (rebind data) --------------------------------------
 def _make_inplace(fn):
+    from ..autograd import rebind_inplace
+
     def op(x, *args, **kwargs):
-        import weakref
-        out = fn(x, *args, **kwargs)
-        x._data = out._data
-        x._node = out._node
-        x._out_idx = out._out_idx
-        x.stop_gradient = out.stop_gradient and x.stop_gradient
-        if x._node is not None:
-            # repoint the tape node's output ref at the surviving tensor so
-            # backward finds cotangents accumulated under it
-            x._node.out_refs[x._out_idx] = weakref.ref(x)
-        return x
+        return rebind_inplace(x, fn(x, *args, **kwargs))
     return op
 
 

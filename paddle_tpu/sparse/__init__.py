@@ -32,6 +32,7 @@ __all__ = [
     # binary / multiary
     "add", "subtract", "multiply", "divide", "matmul", "masked_matmul",
     "addmm", "mv", "transpose", "sum", "reshape", "slice",
+    "pca_lowrank",
     "nn",
 ]
 
@@ -373,3 +374,64 @@ def slice(x, axes, starts, ends, name=None):
 
 
 from . import nn  # noqa: E402,F401
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized PCA of a sparse matrix (ref:
+    ``python/paddle/sparse/unary.py:956 pca_lowrank``).
+
+    Halko-style randomized range finding; every product against X rides
+    the sparse ``bcoo_dot_general`` path, so X is never densified.
+    Centering uses the rank-one correction (X - 1·c) @ W =
+    X @ W - 1·(c @ W) — the same trick the reference uses so sparse
+    inputs stay sparse. Returns dense (U, S, V) Tensors.
+    """
+    if not isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        raise TypeError("sparse.pca_lowrank expects a sparse COO/CSR tensor")
+    a = _coo(x)
+    if a.ndim != 2:
+        raise ValueError("pca_lowrank expects a 2-D matrix")
+    n, m = a.shape
+    if q is None:
+        q = min(6, n, m)
+    if not 0 < q <= min(n, m):
+        raise ValueError(f"q must be in (0, min(N, M)={min(n, m)}]; got {q}")
+    from ..framework import random as _random
+    key = _random.next_key()
+    dt = a.dtype if jnp.issubdtype(a.dtype, jnp.floating) else jnp.float32
+    if a.dtype != dt:  # int input: cast once so bcoo_dot_general agrees
+        a = jsparse.BCOO((a.data.astype(dt), a.indices), shape=a.shape)
+
+    def smm(w):  # X @ w without densifying X
+        return jsparse.bcoo_dot_general(
+            a, w, dimension_numbers=(([1], [0]), ([], [])))
+
+    def smm_t(w):  # X^T @ w: contract X's rows against w's rows -> (M, q)
+        return jsparse.bcoo_dot_general(
+            a, w, dimension_numbers=(([0], [0]), ([], [])))
+
+    ones = jnp.ones((n, 1), dt)
+    if center:
+        c = (jsparse.bcoo_dot_general(
+            a, jnp.ones((n,), dt),
+            dimension_numbers=(([0], [0]), ([], []))) / n)[None, :]  # (1, M)
+    else:
+        c = jnp.zeros((1, m), dt)
+
+    def cmm(w):        # (X - 1 c) @ w
+        return smm(w) - ones @ (c @ w)
+
+    def cmm_t(w):      # (X - 1 c)^T @ w
+        return smm_t(w) - c.T @ (ones.T @ w)
+
+    p = min(q + 6, n, m)  # oversampled range dim; truncated back to q
+    omega = jax.random.normal(key, (m, p), dt)
+    y = cmm(omega)
+    qmat, _ = jnp.linalg.qr(y)
+    for _ in range(niter):
+        z, _ = jnp.linalg.qr(cmm_t(qmat))
+        qmat, _ = jnp.linalg.qr(cmm(z))
+    b = cmm_t(qmat).T                       # (p, M)
+    ub, s_, vt = jnp.linalg.svd(b, full_matrices=False)
+    return (Tensor((qmat @ ub)[:, :q]), Tensor(s_[:q]),
+            Tensor(vt.T[:, :q]))

@@ -158,3 +158,102 @@ def test_hfftn_ihfftn_with_s():
         scipy_fft.ihfftn(r, s=[3, 6]), atol=1e-5, rtol=1e-4)
     with pytest.raises(ValueError):
         ptf.hfftn(pt.to_tensor(a), s=[4], axes=(0, 1))
+
+
+class TestInplaceIndexOps:
+    """index_add_/index_put_ (ref manipulation.py:4502,4633) + the
+    rebind-inplace grad semantics they ride on."""
+
+    def test_index_add__values_and_grads(self):
+        x = pt.to_tensor(np.zeros((4, 3), np.float32))
+        x.stop_gradient = False
+        v = pt.to_tensor(np.ones((2, 3), np.float32))
+        v.stop_gradient = False
+        y = x * 2.0
+        out = pt.index_add_(y, pt.to_tensor(np.array([0, 2], np.int64)),
+                            0, v)
+        assert out is y
+        want = np.zeros((4, 3), np.float32)
+        want[[0, 2]] = 1.0
+        np.testing.assert_allclose(y.numpy(), want)
+        y.sum().backward()
+        # chain through the overwritten intermediate must survive
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   np.full((4, 3), 2.0, np.float32))
+        np.testing.assert_allclose(v.grad.numpy(),
+                                   np.ones((2, 3), np.float32))
+
+    def test_index_put__set_and_accumulate(self):
+        z = pt.to_tensor(np.zeros((3, 3), np.float32))
+        idx = [pt.to_tensor(np.array([0, 1]))]
+        val = pt.to_tensor(np.ones((2, 3), np.float32))
+        pt.index_put_(z, idx, val)
+        assert float(z.numpy().sum()) == 6.0
+        pt.index_put_(z, idx, val, accumulate=True)
+        assert float(z.numpy().sum()) == 12.0
+        # tensor-method form
+        z2 = pt.to_tensor(np.zeros((4,), np.float32))
+        z2.index_put_([pt.to_tensor(np.array([3]))],
+                      pt.to_tensor(np.array([5.0], np.float32)))
+        assert float(z2.numpy()[3]) == 5.0
+
+    def test_leaf_with_grad_raises(self):
+        x = pt.to_tensor(np.ones((2, 2), np.float32))
+        x.stop_gradient = False
+        with pytest.raises(RuntimeError, match="[Ll]eaf"):
+            pt.index_add_(x, pt.to_tensor(np.array([0])), 0,
+                          pt.to_tensor(np.ones((1, 2), np.float32)))
+        with pt.no_grad():  # init-style writes stay allowed
+            pt.index_add_(x, pt.to_tensor(np.array([0])), 0,
+                          pt.to_tensor(np.ones((1, 2), np.float32)))
+
+
+def test_sparse_pca_lowrank_matches_dense_svd():
+    """sparse.pca_lowrank (ref sparse/unary.py:956): randomized PCA over
+    BCOO matmuls; singular values must match the centered dense SVD."""
+    rs = np.random.RandomState(0)
+    d = rs.randn(30, 12).astype(np.float32)
+    d[rs.rand(30, 12) > 0.4] = 0.0
+    nz = np.nonzero(d)
+    sx = pt.sparse.sparse_coo_tensor(np.stack(nz), d[nz], shape=[30, 12])
+    U, S, V = pt.sparse.pca_lowrank(sx, q=5)
+    assert tuple(U.shape) == (30, 5) and tuple(V.shape) == (12, 5)
+    c = d - d.mean(0, keepdims=True)
+    s_ref = np.linalg.svd(c, compute_uv=False)[:5]
+    np.testing.assert_allclose(np.asarray(S._data), s_ref, rtol=0.05)
+    with pytest.raises(ValueError):
+        pt.sparse.pca_lowrank(sx, q=999)
+    with pytest.raises(TypeError):
+        pt.sparse.pca_lowrank(pt.to_tensor(d))
+
+
+def test_distributed_parallel_mode_and_is_available():
+    import paddle_tpu.distributed as dist
+    assert dist.ParallelMode.DATA_PARALLEL == 0
+    assert dist.ParallelMode.TENSOR_PARALLEL == 1
+    assert dist.ParallelMode.PIPELINE_PARALLEL == 2
+    assert dist.ParallelMode.SHARDING_PARALLEL == 3
+    assert dist.is_available() is True
+
+
+def test_inplace_duplicate_occurrence_keeps_full_grad():
+    # y.add_(y): both occurrences of y in the node's inputs must
+    # share one proxy or half the gradient silently vanishes
+    x = pt.to_tensor(np.ones(2, np.float32))
+    x.stop_gradient = False
+    y = x * 1.0
+    pt.add_(y, y)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_inplace_stop_gradient_buffer_write_flows_to_values():
+    # KV-cache pattern: write grad-carrying values into a stop-gradient
+    # buffer; the node must not consume its own output after the rebind
+    # (backward would deadlock silently)
+    z = pt.to_tensor(np.zeros((3, 3), np.float32))
+    v = pt.to_tensor(np.ones((2, 3), np.float32))
+    v.stop_gradient = False
+    pt.index_add_(z, pt.to_tensor(np.array([0, 2])), 0, v)
+    z.sum().backward()
+    np.testing.assert_allclose(v.grad.numpy(), np.ones((2, 3)))

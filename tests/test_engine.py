@@ -155,6 +155,7 @@ def test_engine_fp16_o1_strategy_casts_matmuls():
     assert str(eng._state["params"]["classifier.weight"].dtype) == "float32"
 
 
+@pytest.mark.slow
 def test_engine_without_optimizer_raises_clearly():
     dist.init_mesh({"dp": 8})
     model = _bert()

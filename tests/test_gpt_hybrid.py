@@ -212,6 +212,7 @@ def test_gpt_pipeline_pp4_microbatches():
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_pipeline_spmd_stage_sharding():
     """Stacked block params are physically sharded over pp (the memory
     win ZeRO-style asserted on sharding specs, VERDICT weak #4)."""

@@ -362,6 +362,7 @@ class TestYoloLoss:
                                     **kw)._data)
         np.testing.assert_allclose(l1, l2, rtol=1e-5)
 
+    @pytest.mark.slow
     def test_gt_score_weights_positive_terms_linearly(self):
         """Mixup semantics per the reference kernel: gt_score WEIGHTS the
         positive-sample terms (obj target stays 1), so the loss is linear
