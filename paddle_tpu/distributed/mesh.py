@@ -23,8 +23,11 @@ from jax.sharding import Mesh
 __all__ = ["HYBRID_AXES", "build_mesh", "init_mesh", "get_mesh", "set_mesh",
            "mesh_axis_size", "default_device_count"]
 
-# canonical axis order (outer→inner; mp innermost rides ICI fastest links)
-HYBRID_AXES = ("dp", "pp", "sharding", "sep", "mp")
+# canonical axis order (outer→inner; mp innermost rides ICI fastest
+# links). 'ep' is the expert-parallel axis — MoE dispatch/combine
+# einsums sharded over it lower to XLA all_to_all (the reference's
+# global_scatter/global_gather NCCL path, moe_layer.py:263).
+HYBRID_AXES = ("dp", "pp", "sharding", "sep", "ep", "mp")
 
 _GLOBAL_MESH: Mesh | None = None
 

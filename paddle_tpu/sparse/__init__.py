@@ -398,6 +398,9 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
         raise ValueError(f"q must be in (0, min(N, M)={min(n, m)}]; got {q}")
     from ..framework import random as _random
     key = _random.next_key()
+    if jnp.issubdtype(a.dtype, jnp.complexfloating):
+        raise TypeError("pca_lowrank does not support complex input "
+                        "(reference supports float32/float64 only)")
     dt = a.dtype if jnp.issubdtype(a.dtype, jnp.floating) else jnp.float32
     if a.dtype != dt:  # int input: cast once so bcoo_dot_general agrees
         a = jsparse.BCOO((a.data.astype(dt), a.indices), shape=a.shape)
@@ -410,19 +413,19 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
         return jsparse.bcoo_dot_general(
             a, w, dimension_numbers=(([0], [0]), ([], [])))
 
-    ones = jnp.ones((n, 1), dt)
     if center:
+        ones = jnp.ones((n, 1), dt)
         c = (jsparse.bcoo_dot_general(
             a, jnp.ones((n,), dt),
             dimension_numbers=(([0], [0]), ([], []))) / n)[None, :]  # (1, M)
+
+        def cmm(w):        # (X - 1 c) @ w
+            return smm(w) - ones @ (c @ w)
+
+        def cmm_t(w):      # (X - 1 c)^T @ w
+            return smm_t(w) - c.T @ (ones.T @ w)
     else:
-        c = jnp.zeros((1, m), dt)
-
-    def cmm(w):        # (X - 1 c) @ w
-        return smm(w) - ones @ (c @ w)
-
-    def cmm_t(w):      # (X - 1 c)^T @ w
-        return smm_t(w) - c.T @ (ones.T @ w)
+        cmm, cmm_t = smm, smm_t
 
     p = min(q + 6, n, m)  # oversampled range dim; truncated back to q
     omega = jax.random.normal(key, (m, p), dt)
