@@ -32,6 +32,7 @@ from .auto_parallel_api import (  # noqa: F401
 )
 from . import auto_parallel  # noqa: F401
 from .auto_parallel import Engine, to_static  # noqa: F401
+from . import passes  # noqa: F401
 from . import rpc  # noqa: F401
 from . import ps  # noqa: F401
 from . import utils  # noqa: F401

@@ -1,0 +1,19 @@
+"""``paddle.distributed.passes`` — user-extensible program-rewrite passes.
+
+Re-design of the reference pass framework
+(``python/paddle/distributed/passes/pass_base.py:25``: PassContext /
+PassBase registry / register_pass / new_pass over ProgramDesc rewrites).
+Here a pass rewrites the recorded :class:`paddle_tpu.static.graph.Program`
+op DAG — each node is a pure jax fn, so rewrites compose as function
+wrapping (AMP dtype policies, ``jax.checkpoint`` rematerialisation) or
+node-list surgery, and the rewritten program still jit-compiles to one
+XLA computation. The reference's CPP pass wrapper has no analog: XLA's
+own pipeline owns low-level fusion.
+"""
+from .pass_base import (  # noqa: F401
+    PassBase, PassContext, PassType, new_pass, register_pass,
+)
+from . import builtin  # noqa: F401  (registers the built-in passes)
+
+__all__ = ["PassBase", "PassContext", "PassType", "new_pass",
+           "register_pass"]

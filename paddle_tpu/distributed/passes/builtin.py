@@ -1,0 +1,104 @@
+"""Built-in program-rewrite passes.
+
+The train-step toggles (amp / recompute) exposed as inspectable,
+composable passes over the static Program (ref:
+``distributed/passes/auto_parallel_amp.py``,
+``auto_parallel_recompute.py``). Sharding/ZeRO and pipeline scheduling
+remain :func:`build_train_step` options — they shard STATE across a
+mesh, which is an execution-placement concern, not a graph rewrite, in
+the XLA model.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .pass_base import PassBase, PassType, register_pass
+
+# ops worth running in low precision: the MXU-bound compute (matches the
+# O1 white list in amp/auto_cast.py)
+_AMP_WHITELIST = frozenset({
+    "matmul", "mm", "bmm", "einsum", "conv2d", "conv3d",
+    "conv2d_transpose", "flash_attention", "scaled_dot_product_attention",
+    "linear", "addmm",
+})
+
+
+def _is_float(a):
+    return hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+
+
+@register_pass("auto_parallel_amp")
+class AMPPass(PassBase):
+    """Cast whitelisted compute nodes' float inputs to the AMP dtype
+    (ref ``auto_parallel_amp.py``: cast-insertion around whitelist ops).
+    attrs: ``dtype`` ("bfloat16" default), ``custom_white_list``."""
+
+    def _check_self(self):
+        return self.get_attr("dtype", "bfloat16") in ("bfloat16", "float16")
+
+    def _check_conflict(self, other_pass):
+        # applying amp twice is a no-op wrapped in a no-op; forbid it
+        return other_pass.name != self.name
+
+    def _type(self):
+        return PassType.CALC_OPT
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        dtype = jnp.bfloat16 if self.get_attr(
+            "dtype", "bfloat16") == "bfloat16" else jnp.float16
+        white = _AMP_WHITELIST | frozenset(
+            self.get_attr("custom_white_list", ()))
+        n_rewritten = 0
+        for node in main_program.nodes:
+            if node.name not in white:
+                continue
+            inner = node.fn
+
+            def amp_fn(*args, _inner=inner):
+                cast = tuple(a.astype(dtype) if _is_float(a) else a
+                             for a in args)
+                return _inner(*cast)
+
+            node.fn = amp_fn
+            n_rewritten += 1
+        context.set_attr("amp_nodes_rewritten",
+                         context.get_attr("amp_nodes_rewritten", 0)
+                         + n_rewritten)
+
+
+@register_pass("auto_parallel_recompute")
+class RecomputePass(PassBase):
+    """Wrap compute nodes in ``jax.checkpoint`` so their activations are
+    rematerialised in backward instead of stored (ref
+    ``auto_parallel_recompute.py``: the segment-replay rewrite; XLA's
+    remat is the TPU-native equivalent). attrs: ``segments`` — node
+    names to wrap (default: every node with >= ``min_inputs`` tensor
+    inputs, i.e. real compute, not metadata ops)."""
+
+    def _check_self(self):
+        return True
+
+    def _check_conflict(self, other_pass):
+        # double application would nest jax.checkpoint and silently
+        # multiply backward recompute cost
+        return other_pass.name != self.name
+
+    def _type(self):
+        return PassType.CALC_OPT
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        segments = self.get_attr("segments")
+        min_inputs = int(self.get_attr("min_inputs", 2))
+        n_rewritten = 0
+        for node in main_program.nodes:
+            if segments is not None:
+                if node.name not in segments:
+                    continue
+            elif len(node.in_refs) < min_inputs:
+                continue
+            node.fn = jax.checkpoint(node.fn)
+            n_rewritten += 1
+        context.set_attr("recompute_nodes_rewritten",
+                         context.get_attr("recompute_nodes_rewritten", 0)
+                         + n_rewritten)

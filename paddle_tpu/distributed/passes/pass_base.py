@@ -1,0 +1,141 @@
+"""Pass framework core (ref: ``distributed/passes/pass_base.py``)."""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+
+__all__ = ["PassContext", "PassType", "PassBase", "register_pass",
+           "new_pass"]
+
+
+class PassContext:
+    """Carries applied-pass history + shared attrs across a pipeline
+    (ref: ``pass_base.py PassContext``)."""
+
+    def __init__(self):
+        self._applied_passes = []
+        self._attrs = {}
+
+    def set_attr(self, key, value):
+        self._attrs[key] = value
+
+    def get_attr(self, key, default=None):
+        return self._attrs.get(key, default)
+
+    @property
+    def passes(self):
+        return list(self._applied_passes)
+
+    def _add_pass(self, pass_obj):
+        self._applied_passes.append(pass_obj)
+
+
+class PassType:
+    UNKNOWN = 0
+    COMM_OPT = 1
+    CALC_OPT = 2
+    PARALLEL_OPT = 3
+    FUSION_OPT = 4
+
+
+class PassBase(ABC):
+    """A program-rewrite pass. Subclass and implement ``_check_self``,
+    ``_check_conflict`` and ``_apply_single_impl(main, startup, ctx)``;
+    register with :func:`register_pass`.
+
+    ``apply`` mirrors the reference semantics: self-check, conflict
+    check against every already-applied pass in the context (fusion
+    passes must come last — the one common rule the reference installs
+    that is meaningful here), then apply to each (main, startup) pair.
+    """
+
+    _REGISTERED_PASSES: dict = {}
+
+    name: str | None = None
+
+    @staticmethod
+    def _register(pass_name, pass_class):
+        assert issubclass(pass_class, PassBase)
+        PassBase._REGISTERED_PASSES[pass_name] = pass_class
+
+    def __init__(self):
+        self._attrs = {}
+
+    def set_attr(self, key, value):
+        self._attrs[key] = value
+        return self
+
+    def get_attr(self, key, default=None):
+        return self._attrs.get(key, default)
+
+    @abstractmethod
+    def _check_self(self):
+        """Return False to skip (bad attrs / not applicable)."""
+
+    @abstractmethod
+    def _check_conflict(self, other_pass):
+        """Return False if this pass cannot run after ``other_pass``."""
+
+    def _type(self):
+        return PassType.UNKNOWN
+
+    def _check_conflict_including_common_rules(self, other_pass):
+        # fusion passes last: anything else conflicts when applied
+        # after a FUSION_OPT (ref pass_base.py _fusion_opt_last_rule)
+        if (other_pass._type() == PassType.FUSION_OPT
+                and self._type() != PassType.FUSION_OPT):
+            return False
+        return self._check_conflict(other_pass)
+
+    def apply(self, main_programs, startup_programs, context=None):
+        """Apply to lists of programs; returns the (possibly fresh)
+        PassContext. A failed check leaves the programs untouched."""
+        # validate the argument shape BEFORE the check gates: a failed
+        # check must not mask misuse that would resurface later
+        if not isinstance(main_programs, (list, tuple)) or \
+                not isinstance(startup_programs, (list, tuple)):
+            raise TypeError("apply() takes LISTS of programs; wrap the "
+                            "single program in a list")
+        if len(main_programs) != len(startup_programs):
+            raise ValueError("main/startup program list length mismatch")
+        if context is None:
+            context = PassContext()
+        if not self._check_self():
+            return context
+        if not all(self._check_conflict_including_common_rules(p)
+                   for p in context.passes):
+            return context
+        for main, startup in zip(main_programs, startup_programs):
+            self._apply_single_impl(main, startup, context)
+            # a pass-authored mutation must invalidate the executor's
+            # compile cache (keyed on program.version) even when the
+            # pass only rewrote node.fn in place
+            for prog in (main, startup):
+                if hasattr(prog, "version"):
+                    prog.version += 1
+        context._add_pass(self)
+        return context
+
+    @abstractmethod
+    def _apply_single_impl(self, main_program, startup_program, context):
+        """Mutate one (main, startup) Program pair in place."""
+
+
+def register_pass(name):
+    """Decorator: ``@register_pass("my_pass") class MyPass(PassBase)``."""
+    def impl(cls):
+        PassBase._register(name, cls)
+        cls.name = name
+        return cls
+    return impl
+
+
+def new_pass(name, pass_attrs=None):
+    """Instantiate a registered pass with attrs (ref ``new_pass``)."""
+    pass_class = PassBase._REGISTERED_PASSES.get(name)
+    if pass_class is None:
+        known = sorted(PassBase._REGISTERED_PASSES)
+        raise ValueError(f"Pass {name!r} is not registered; known: {known}")
+    pass_obj = pass_class()
+    for k, v in (pass_attrs or {}).items():
+        pass_obj.set_attr(k, v)
+    return pass_obj

@@ -1,0 +1,179 @@
+"""Distributed pass framework (ref: distributed/passes/pass_base.py):
+registry, conflict/ordering rules, built-in amp/recompute rewrites, and
+a custom user pass mutating a traced program."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.static as static
+from paddle_tpu.distributed.passes import (PassBase, PassContext, PassType,
+                                           new_pass, register_pass)
+
+
+def _build_program():
+    pt.seed(0)
+    pt.enable_static()
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4, 8], "float32")
+        w = pt.create_parameter([8, 8], "float32")
+        h = pt.matmul(x, w)
+        y = pt.tanh(h)
+        out = pt.matmul(y, w)
+        loss = pt.mean(out)
+    return main, startup, loss
+
+
+def _run(main, startup, loss):
+    exe = static.Executor()
+    exe.run(startup)
+    out = exe.run(main, feed={"x": np.ones((4, 8), np.float32)},
+                  fetch_list=[loss])
+    pt.disable_static()
+    return float(np.asarray(out[0]))
+
+
+def test_amp_pass_rewrites_matmuls_only():
+    main, startup, loss = _build_program()
+    ref = None
+    try:
+        p = new_pass("auto_parallel_amp", {"dtype": "bfloat16"})
+        ctx = p.apply([main], [startup])
+        assert ctx.get_attr("amp_nodes_rewritten") == 2  # both matmuls
+        assert [type(q).name for q in ctx.passes] == ["auto_parallel_amp"]
+        got = _run(main, startup, loss)
+    finally:
+        pt.disable_static()
+    # bf16 matmuls still produce a close loss on this tiny program
+    main2, startup2, loss2 = _build_program()
+    ref = _run(main2, startup2, loss2)
+    assert abs(got - ref) < 0.05 * (abs(ref) + 1e-3)
+
+
+def test_recompute_pass_wraps_and_preserves_values():
+    main, startup, loss = _build_program()
+    try:
+        p = new_pass("auto_parallel_recompute")
+        ctx = p.apply([main], [startup])
+        assert ctx.get_attr("recompute_nodes_rewritten") >= 2
+        got = _run(main, startup, loss)
+    finally:
+        pt.disable_static()
+    main2, startup2, loss2 = _build_program()
+    ref = _run(main2, startup2, loss2)
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_custom_user_pass_mutates_traced_program():
+    """The user-extension point the reference provides via
+    register_pass (pass_base.py:121): scale every tanh node's output."""
+
+    @register_pass("test_scale_tanh")
+    class ScaleTanh(PassBase):
+        def _check_self(self):
+            return True
+
+        def _check_conflict(self, other):
+            return True
+
+        def _apply_single_impl(self, main, startup, context):
+            for node in main.nodes:
+                if node.name == "tanh":
+                    inner = node.fn
+                    node.fn = (lambda *a, _i=inner:
+                               _i(*a) * self.get_attr("scale", 2.0))
+                    context.set_attr("scaled", True)
+
+    main, startup, loss = _build_program()
+    try:
+        ref_main, ref_startup, ref_loss = _build_program()
+    finally:
+        pass
+    ctx = new_pass("test_scale_tanh", {"scale": 3.0}).apply([main], [startup])
+    assert ctx.get_attr("scaled") is True
+    got = _run(main, startup, loss)
+    ref = _run(ref_main, ref_startup, ref_loss)
+    assert abs(got - 3.0 * ref) < 1e-4  # linear head => loss scales by 3
+
+
+def test_conflict_and_ordering_rules():
+    @register_pass("test_fusion_last")
+    class Fusion(PassBase):
+        def _check_self(self):
+            return True
+
+        def _check_conflict(self, other):
+            return True
+
+        def _type(self):
+            return PassType.FUSION_OPT
+
+        def _apply_single_impl(self, main, startup, context):
+            context.set_attr("fusion_applied", True)
+
+    main, startup, _ = _build_program()
+    pt.disable_static()
+    ctx = PassContext()
+    new_pass("test_fusion_last").apply([main], [startup], ctx)
+    # a CALC_OPT pass after a fusion pass is refused (fusion-last rule)
+    before = len(ctx.passes)
+    new_pass("auto_parallel_amp").apply([main], [startup], ctx)
+    assert len(ctx.passes) == before
+    # amp twice: second application refused by its own conflict rule
+    ctx2 = PassContext()
+    new_pass("auto_parallel_amp").apply([main], [startup], ctx2)
+    new_pass("auto_parallel_amp").apply([main], [startup], ctx2)
+    assert [type(q).name for q in ctx2.passes] == ["auto_parallel_amp"]
+
+
+def test_new_pass_unknown_name_raises():
+    with pytest.raises(ValueError, match="not registered"):
+        new_pass("definitely_not_a_pass")
+
+
+def test_pass_after_run_invalidates_compile_cache():
+    """A pass applied AFTER the program already executed must take
+    effect on the next run (the executor caches on program.version)."""
+
+    @register_pass("test_double_output")
+    class DoubleOut(PassBase):
+        def _check_self(self):
+            return True
+
+        def _check_conflict(self, other):
+            return True
+
+        def _apply_single_impl(self, main, startup, context):
+            for node in main.nodes:
+                if node.name == "matmul":
+                    inner = node.fn
+                    node.fn = lambda *a, _i=inner: _i(*a) * 2.0
+
+    main, startup, loss = _build_program()
+    exe = static.Executor()
+    exe.run(startup)
+    feed = {"x": np.ones((4, 8), np.float32)}
+    before = float(np.asarray(exe.run(main, feed=feed,
+                                      fetch_list=[loss])[0]))
+    new_pass("test_double_output").apply([main], [startup])
+    after = float(np.asarray(exe.run(main, feed=feed,
+                                     fetch_list=[loss])[0]))
+    pt.disable_static()
+    assert abs(after) > abs(before) * 1.5, (before, after)
+
+
+def test_recompute_refuses_double_application():
+    main, startup, _ = _build_program()
+    pt.disable_static()
+    ctx = PassContext()
+    new_pass("auto_parallel_recompute").apply([main], [startup], ctx)
+    new_pass("auto_parallel_recompute").apply([main], [startup], ctx)
+    assert [type(q).name for q in ctx.passes] == ["auto_parallel_recompute"]
+
+
+def test_apply_rejects_bare_program_even_when_check_fails():
+    main, startup, _ = _build_program()
+    pt.disable_static()
+    with pytest.raises(TypeError, match="LISTS"):
+        new_pass("auto_parallel_amp", {"dtype": "float32"}).apply(
+            main, startup)
