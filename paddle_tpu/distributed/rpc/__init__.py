@@ -22,7 +22,44 @@ from dataclasses import dataclass
 
 __all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
            "get_worker_info", "get_all_worker_infos",
-           "get_current_worker_info", "WorkerInfo"]
+           "get_current_worker_info", "WorkerInfo", "FutureWrapper"]
+
+# reference default: -1 = infinite timeout (rpc.py:28 _DEFAULT_RPC_TIMEOUT)
+_DEFAULT_RPC_TIMEOUT = -1
+
+
+def _dumps(obj):
+    """Callables cross the wire with cloudpickle when available (plain
+    pickle rejects lambdas/closures; the reference's PythonFunc pickle
+    has the same limitation — this is a strict superset)."""
+    try:
+        import cloudpickle
+        return cloudpickle.dumps(obj)
+    except ImportError:  # pragma: no cover - cloudpickle is baked in
+        return pickle.dumps(obj)
+
+
+class FutureWrapper:
+    """Future returned by :func:`rpc_async` (ref ``rpc.py FutureWrapper``):
+    ``wait()`` blocks and returns the result (re-raising remote errors)."""
+
+    def __init__(self, fut):
+        self._fut = fut
+
+    def wait(self, timeout=None):
+        return self._fut.result(timeout)
+
+    # concurrent.futures-style alias so either idiom works
+    def result(self, timeout=None):
+        return self._fut.result(timeout)
+
+    def done(self):
+        return self._fut.done()
+
+    def __getattr__(self, name):
+        # preserve the concurrent.futures surface this API used to
+        # return (cancel / exception / add_done_callback ...)
+        return getattr(self._fut, name)
 
 
 @dataclass
@@ -70,7 +107,12 @@ class _Handler(socketserver.BaseRequestHandler):
         except Exception as e:  # errors propagate to the caller
             result = ("err", e)
         try:
-            _send_msg(self.request, pickle.dumps(result))
+            try:
+                reply = _dumps(result)
+            except Exception as e:  # unpicklable result/exception state
+                reply = _dumps(("err", RuntimeError(
+                    f"rpc result not serializable: {e!r}")))
+            _send_msg(self.request, reply)
         except (ConnectionError, OSError):
             pass
 
@@ -127,25 +169,33 @@ def _invoke(to, fn, args, kwargs, timeout):
     me = _state["me"]
     if me is not None and w.name == me.name:
         return fn(*(args or ()), **(kwargs or {}))  # local fast path
-    with socket.create_connection((w.ip, w.port), timeout=timeout) as s:
-        s.settimeout(timeout)
-        _send_msg(s, pickle.dumps((fn, args or (), kwargs or {})))
+    # reference timeout semantics (rpc.py:141): <= 0 means infinite —
+    # including the connect phase (slow cluster start-up must not trip it)
+    sock_timeout = None if timeout is None or timeout <= 0 else timeout
+    with socket.create_connection((w.ip, w.port),
+                                  timeout=sock_timeout) as s:
+        s.settimeout(sock_timeout)
+        _send_msg(s, _dumps((fn, args or (), kwargs or {})))
         status, value = pickle.loads(_recv_msg(s))
     if status == "err":
         raise value
     return value
 
 
-def rpc_sync(to, fn, args=None, kwargs=None, timeout=180.0):
-    """Blocking call on worker ``to`` (ref ``rpc.py:141``)."""
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=_DEFAULT_RPC_TIMEOUT):
+    """Blocking call on worker ``to`` (ref ``rpc.py:141``). ``timeout``
+    in seconds; <= 0 (the default) never times out; on expiry a
+    ``socket.timeout`` (OSError subclass) is raised."""
     return _invoke(to, fn, args, kwargs, timeout)
 
 
-def rpc_async(to, fn, args=None, kwargs=None, timeout=180.0):
-    """Returns a concurrent.futures.Future (ref ``rpc.py:179``)."""
+def rpc_async(to, fn, args=None, kwargs=None, timeout=_DEFAULT_RPC_TIMEOUT):
+    """Non-blocking call; returns a :class:`FutureWrapper` whose
+    ``wait()`` yields the result (ref ``rpc.py:179``)."""
     if _state["pool"] is None:
         raise RuntimeError("call init_rpc first")
-    return _state["pool"].submit(_invoke, to, fn, args, kwargs, timeout)
+    return FutureWrapper(
+        _state["pool"].submit(_invoke, to, fn, args, kwargs, timeout))
 
 
 def shutdown():

@@ -1,0 +1,116 @@
+"""``distributed.rpc`` round-trip tests (ref: ``test/rpc/test_rpc_base.py``
+/ ``test_rpc.py``): sync/async calls, futures, serialization of
+closures, error and timeout propagation, worker-info surface."""
+import multiprocessing as mp
+import socket
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.distributed.rpc as rpc
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _add(a, b):
+    return a + b
+
+
+def _np_mul(x, k):
+    return (np.asarray(x) * k).tolist()
+
+
+def _boom():
+    raise ValueError("remote boom")
+
+
+@pytest.fixture
+def agent():
+    info = rpc.init_rpc("worker0")
+    yield info
+    rpc.shutdown()
+
+
+class TestSingleWorker:
+    def test_sync_async_local(self, agent):
+        assert rpc.rpc_sync("worker0", _add, args=(2, 3)) == 5
+        fut = rpc.rpc_async("worker0", _add, args=(4, 5))
+        assert fut.wait() == 9
+        assert fut.done()
+        assert fut.result() == 9
+
+    def test_worker_info_surface(self, agent):
+        me = rpc.get_current_worker_info()
+        assert me.name == "worker0" and me.rank == 0
+        assert rpc.get_worker_info("worker0") == me
+        assert rpc.get_all_worker_infos() == [me]
+        with pytest.raises(ValueError, match="unknown rpc worker"):
+            rpc.get_worker_info("nobody")
+
+    def test_socket_path_closure_and_errors(self, agent):
+        # alias the local server under another name: calls take the real
+        # wire path (serialize -> socket -> execute -> reply) in-process
+        me = rpc.get_current_worker_info()
+        rpc._state["workers"]["remote0"] = rpc.WorkerInfo(
+            "remote0", 1, me.ip, me.port)
+        assert rpc.rpc_sync("remote0", _add, args=(10, 20)) == 30
+        # closures need cloudpickle — the reference's plain-pickle
+        # PythonFunc cannot do this
+        k = 7
+        assert rpc.rpc_sync("remote0", lambda v: v * k, args=(6,)) == 42
+        assert rpc.rpc_sync("remote0", _np_mul,
+                            args=([1, 2, 3], 2)) == [2, 4, 6]
+        with pytest.raises(ValueError, match="remote boom"):
+            rpc.rpc_sync("remote0", _boom)
+        fut = rpc.rpc_async("remote0", _boom)
+        with pytest.raises(ValueError, match="remote boom"):
+            fut.wait()
+
+    def test_timeout_raises(self, agent):
+        me = rpc.get_current_worker_info()
+        rpc._state["workers"]["remote0"] = rpc.WorkerInfo(
+            "remote0", 1, me.ip, me.port)
+        with pytest.raises(OSError):  # socket.timeout is an OSError
+            rpc.rpc_sync("remote0", time.sleep, args=(3,), timeout=0.3)
+        # timeout <= 0 = infinite (reference default): must NOT raise
+        assert rpc.rpc_sync("remote0", _add, args=(1, 1), timeout=-1) == 2
+
+
+def _worker(rank, world_size, endpoint, q):
+    import paddle_tpu.distributed.rpc as rpc
+    rpc.init_rpc(f"worker{rank}", rank, world_size, endpoint)
+    if rank == 1:
+        got = rpc.rpc_sync("worker0", _add, args=(40, 2))
+        fut = rpc.rpc_async("worker0", _np_mul, args=([5], 3))
+        q.put((got, fut.wait()))
+    else:
+        # keep serving until the caller reports completion
+        for _ in range(200):
+            if not q.empty():
+                break
+            time.sleep(0.05)
+    rpc.shutdown()
+
+
+@pytest.mark.slow
+def test_two_process_round_trip():
+    """The reference's RpcTestBase pattern: N processes rendezvous on a
+    master endpoint, worker1 calls into worker0, results via a queue."""
+    endpoint = f"127.0.0.1:{_free_port()}"
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    ps = [ctx.Process(target=_worker, args=(r, 2, endpoint, q))
+          for r in range(2)]
+    for p in ps:
+        p.start()
+    got = q.get(timeout=120)
+    q.put("done")  # let worker0 exit
+    for p in ps:
+        p.join(timeout=60)
+        assert p.exitcode == 0
+    assert got == (42, [15])
