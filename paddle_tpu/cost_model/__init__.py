@@ -1,3 +1,7 @@
 from .cost_model import CostModel  # noqa: F401
+from .parallel_cost import (  # noqa: F401
+    predict, predict_memory_bytes, predict_step_time,
+)
 
-__all__ = ["CostModel"]
+__all__ = ["CostModel", "predict", "predict_memory_bytes",
+           "predict_step_time"]

@@ -8,7 +8,8 @@ from ..auto_parallel_api import (  # noqa: F401
     dtensor_from_fn, reshard,
 )
 from .engine import Engine, to_static  # noqa: F401
+from .cluster import Cluster  # noqa: F401
 
-__all__ = ["ProcessMesh", "Shard", "Replicate", "Partial", "shard_tensor",
+__all__ = ["Cluster", "ProcessMesh", "Shard", "Replicate", "Partial", "shard_tensor",
            "shard_layer", "dtensor_from_fn", "reshard", "Engine",
            "to_static"]

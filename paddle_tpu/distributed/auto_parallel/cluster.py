@@ -1,0 +1,82 @@
+"""Cluster model (ref:
+``python/paddle/distributed/auto_parallel/static/cluster.py:412`` —
+machine/device topology + bandwidths feeding the cost model and tuner).
+
+TPU-native: the mesh is homogeneous, so the model is per-chip specs
+(HBM, peak bf16 FLOP/s) + per-link bandwidths (ICI within a host/slice,
+DCN across). Auto-detected from the runtime's device kind; every number
+is public-spec-sheet data and overridable.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field, asdict
+
+__all__ = ["Cluster", "CHIP_SPECS"]
+
+# public spec-sheet numbers per device kind: (peak bf16 FLOP/s, HBM
+# bytes, ICI GB/s per link-direction aggregate, chips/host)
+CHIP_SPECS = {
+    "TPU v2": (45e12, 8 << 30, 496e9, 4),
+    "TPU v3": (123e12, 16 << 30, 656e9, 4),
+    "TPU v4": (275e12, 32 << 30, 1200e9, 4),
+    "TPU v5 lite": (197e12, 16 << 30, 400e9, 4),
+    "TPU v5e": (197e12, 16 << 30, 400e9, 4),
+    "TPU v5": (459e12, 96 << 30, 1200e9, 4),
+    "TPU v5p": (459e12, 96 << 30, 1200e9, 4),
+    "TPU v6 lite": (918e12, 32 << 30, 1600e9, 4),
+    "TPU v6e": (918e12, 32 << 30, 1600e9, 4),
+    "cpu": (1e12, 8 << 30, 50e9, 1),  # virtual-mesh testing fallback
+}
+
+
+@dataclass
+class Cluster:
+    num_chips: int = 1
+    device_kind: str = "TPU v5e"
+    peak_flops: float = 197e12          # bf16 per chip
+    hbm_bytes: int = 16 << 30           # usable HBM per chip
+    ici_bandwidth: float = 400e9        # bytes/s per chip, intra-slice
+    dcn_bandwidth: float = 25e9         # bytes/s per host, cross-slice
+    chips_per_host: int = 4
+    num_slices: int = 1                 # multislice: ICI inside, DCN across
+    extras: dict = field(default_factory=dict)
+
+    @classmethod
+    def auto_detect(cls, devices=None):
+        """Build from the live runtime (chip count + device kind)."""
+        import jax
+        try:
+            devices = devices if devices is not None else jax.devices()
+            kind = getattr(devices[0], "device_kind", "cpu") or "cpu"
+            n = len(devices)
+        except Exception:
+            kind, n = "cpu", 1
+        spec = None
+        for k in sorted(CHIP_SPECS, key=len, reverse=True):
+            if kind.lower().startswith(k.lower()):
+                spec = CHIP_SPECS[k]
+                break
+        if spec is None:
+            spec = CHIP_SPECS["cpu"]
+        peak, hbm, ici, cph = spec
+        return cls(num_chips=n, device_kind=kind, peak_flops=peak,
+                   hbm_bytes=hbm, ici_bandwidth=ici, chips_per_host=cph)
+
+    def bandwidth(self, degree):
+        """Effective collective bandwidth for a group of ``degree``
+        chips. A TPU SLICE is ICI-connected across all its hosts (a pod
+        is one slice of thousands of chips), so the boundary that drops
+        a collective to DCN is the slice, not the host: groups that fit
+        ``num_chips / num_slices`` ride ICI; only multislice groups pay
+        DCN (the scaling-book rule: lay out shardings so collectives
+        ride ICI)."""
+        if degree <= 1:
+            return self.ici_bandwidth
+        chips_per_slice = max(self.num_chips // max(self.num_slices, 1), 1)
+        if degree <= chips_per_slice:
+            return self.ici_bandwidth
+        slices = (degree + chips_per_slice - 1) // chips_per_slice
+        return min(self.ici_bandwidth, self.dcn_bandwidth * slices)
+
+    def to_dict(self):
+        return asdict(self)

@@ -49,7 +49,7 @@ class Engine:
     """
 
     def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
-                 strategy=None, mesh=None, scaler=None):
+                 strategy=None, mesh=None, scaler=None, cluster=None):
         if not isinstance(model, Layer):
             raise TypeError("Engine requires a paddle_tpu.nn.Layer model")
         self._model = model
@@ -59,10 +59,31 @@ class Engine:
         self._strategy = strategy or DistributedStrategy()
         self._mesh = getattr(mesh, "mesh", mesh)  # ProcessMesh or jax Mesh
         self._scaler = scaler
+        self._cluster = cluster
         self._step_fn = None
         self._state = None
         self._eval_jit = None
         self.history = {}
+
+    @property
+    def cluster(self):
+        """Hardware model backing cost estimates (ref
+        ``static/cluster.py``); auto-detected from the runtime on first
+        access unless one was passed in."""
+        if self._cluster is None:
+            from .cluster import Cluster
+            self._cluster = Cluster.auto_detect(
+                self._mesh.devices.ravel() if self._mesh is not None
+                else None)
+        return self._cluster
+
+    def estimate_cost(self, model_desc, cfg=None, global_batch_size=None):
+        """Predicted (seconds_per_step, memory_bytes, fits) for running
+        ``model_desc`` under ``cfg`` on this engine's cluster (the
+        estimator the reference wires via auto_parallel/static/cost/)."""
+        from ...cost_model.parallel_cost import predict
+        return predict(model_desc, cfg or {}, self.cluster,
+                       global_batch_size=global_batch_size)
 
     # -- strategy application ----------------------------------------------
     def prepare(self, inputs_spec=None, labels_spec=None, main_program=None,

@@ -1,0 +1,134 @@
+"""cost_model -> auto_tuner wiring (VERDICT r04 item 6; ref:
+``auto_parallel/static/cluster.py`` + ``cost/`` estimator feeding the
+tuner): predicted-OOM pruning, best-predicted-first ordering, and the
+headline property — the guided tuner reaches a same-or-better config in
+fewer measured trials than blind grid search on a recorded scenario."""
+import numpy as np
+import pytest
+
+from paddle_tpu.cost_model import (predict, predict_memory_bytes,
+                                   predict_step_time)
+from paddle_tpu.distributed.auto_parallel import Cluster
+from paddle_tpu.distributed.auto_tuner import AutoTuner
+
+# GPT-1.3B-class: big enough that some VALID 8-chip tilings genuinely
+# exceed 16G HBM (dp=8 no-remat), so OOM pruning has real work to do
+MODEL = dict(n_params=1.3e9, num_layers=24, hidden_size=2048, seq_len=1024)
+CLUSTER = Cluster(num_chips=8, device_kind="TPU v5e", peak_flops=197e12,
+                  hbm_bytes=16 << 30, ici_bandwidth=400e9)
+CANDIDATES = {
+    "dp_degree": [1, 2, 4, 8],
+    "mp_degree": [1, 2, 4],
+    "pp_degree": [1, 2],
+    "sharding_degree": [1, 2],
+    "micro_batch_size": [2, 4, 8, 32],
+    "use_recompute": [False, True],
+}
+GBS = 64
+
+
+def _tuner_cfg(with_model):
+    cfg = {"candidates": dict(CANDIDATES), "num_chips": 8,
+           "global_batch_size": GBS}
+    if with_model:
+        cfg["model"] = MODEL
+        cfg["cluster"] = CLUSTER
+    return cfg
+
+
+def _ground_truth(cfg):
+    """The 'real hardware': same physics family as the predictor but a
+    DIFFERENT cluster (slower interconnect, lower efficiency) plus a
+    deterministic per-config wobble — the tuner must win via ordering,
+    not via the oracle being identical."""
+    real = Cluster(num_chips=8, device_kind="TPU v5e",
+                   peak_flops=197e12 * 0.8, hbm_bytes=15 << 30,
+                   ici_bandwidth=250e9)
+    mem = predict_memory_bytes(MODEL, cfg, real)
+    if mem > real.hbm_bytes * 0.9:
+        return None, "oom"
+    t = predict_step_time(MODEL, cfg, real, global_batch_size=GBS)
+    # crc32, not hash(): builtin string hashing is randomized per
+    # process, which would make the ground truth flake across CI runs
+    import zlib
+    digest = zlib.crc32(repr(sorted(
+        (k, v) for k, v in cfg.items() if k in CANDIDATES)).encode())
+    wobble = 1.0 + 0.06 * ((digest % 100) / 100.0 - 0.5)
+    tput = GBS * MODEL["seq_len"] / (t * wobble)
+    return tput, "ok"
+
+
+def _run_search(with_model, stop_within=None, best_tput=None):
+    """Run the tuner loop; return (trials_to_near_best, best_found)."""
+    tuner = AutoTuner(_tuner_cfg(with_model))
+    trials, first_hit = 0, None
+    while (cfg := tuner.search_once()) is not None:
+        tput, status = _ground_truth(cfg)
+        trials += 1
+        tuner.add_cfg(**cfg, throughput=tput, status=status)
+        if (first_hit is None and tput is not None and best_tput
+                and tput >= stop_within * best_tput):
+            first_hit = trials
+    best, err = tuner.get_best()
+    assert not err
+    return first_hit, best, trials
+
+
+def _global_best():
+    tuner = AutoTuner(_tuner_cfg(False))
+    best = 0.0
+    while (cfg := tuner.search_once()) is not None:
+        tput, status = _ground_truth(cfg)
+        tuner.add_cfg(**cfg, throughput=tput, status=status)
+        if status == "ok":
+            best = max(best, tput)
+    return best
+
+
+def test_predicted_oom_configs_never_trialed():
+    tuner = AutoTuner(_tuner_cfg(True))
+    assert tuner.pruned_by_cost > 0
+    seen = []
+    while (cfg := tuner.search_once()) is not None:
+        seen.append(cfg)
+    for cfg in seen:
+        assert cfg["predicted_memory_bytes"] <= CLUSTER.hbm_bytes * 0.92
+        assert "predicted_step_time" in cfg  # predicted-vs-measured rows
+
+
+def test_guided_order_is_best_predicted_first():
+    tuner = AutoTuner(_tuner_cfg(True))
+    times = []
+    while (cfg := tuner.search_once()) is not None:
+        times.append(cfg["predicted_step_time"])
+    assert times == sorted(times) and len(times) > 5
+
+
+def test_guided_tuner_converges_in_fewer_trials():
+    best = _global_best()
+    hit_guided, best_guided, n_guided = _run_search(
+        True, stop_within=0.97, best_tput=best)
+    hit_blind, best_blind, n_blind = _run_search(
+        False, stop_within=0.97, best_tput=best)
+    assert hit_guided is not None
+    # the cost model must put a near-best config within the first few
+    # trials; blind grid order takes (much) longer
+    assert hit_guided < hit_blind, (hit_guided, hit_blind)
+    assert hit_guided <= 5, hit_guided
+    # and the chosen config is same-or-better
+    assert best_guided["throughput"] >= best_blind["throughput"] * 0.97
+    # the guided search also visits a smaller space (OOM pruned)
+    assert n_guided < n_blind
+
+
+def test_cluster_auto_detect_and_engine_estimate():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    c = Cluster.auto_detect()
+    assert c.num_chips >= 1 and c.peak_flops > 0
+    import paddle_tpu as pt
+    from paddle_tpu.distributed.auto_parallel import Engine
+    eng = Engine(pt.nn.Linear(4, 4))
+    t, m, fits = eng.estimate_cost(MODEL, {"dp_degree": 1,
+                                           "micro_batch_size": 1})
+    assert t > 0 and m > 0 and isinstance(fits, (bool, np.bool_))
