@@ -30,23 +30,37 @@ def _deg(cfg, key):
     return int(v) if v else 1
 
 
-def predict_memory_bytes(model, cfg, cluster):
-    """Per-chip HBM: params + grads + AdamW state (+master) + acts."""
+def predict_memory_bytes(model, cfg, cluster, global_batch_size=None):
+    """Per-chip HBM: params + grads + AdamW state (+master) + acts.
+
+    Activations count the 1F1B in-flight depth: a pipeline stage keeps
+    up to ``min(pp, micro_steps)`` micro-batches of its layers' saved
+    activations resident, not one. With ``vocab_size`` present the lm
+    head's logits buffer (the dominant single activation for large
+    vocabularies) is counted too."""
     n = float(model["n_params"])
     L = int(model.get("num_layers", 1))
     H = int(model.get("hidden_size", 1))
     S = int(model.get("seq_len", 1))
-    mp, pp = _deg(cfg, "mp_degree"), _deg(cfg, "pp_degree")
-    shard = _deg(cfg, "sharding_degree")
+    V = int(model.get("vocab_size", 0))
+    dp, mp = _deg(cfg, "dp_degree"), _deg(cfg, "mp_degree")
+    pp, shard = _deg(cfg, "pp_degree"), _deg(cfg, "sharding_degree")
     mbs = int(cfg.get("micro_batch_size") or 1)
     remat = bool(cfg.get("use_recompute", False))
+    gbs = global_batch_size or cfg.get("global_batch_size")
+    micro_steps = max(int(gbs) // max(dp * shard * mbs, 1), 1) if gbs \
+        else pp
+    in_flight = min(pp, micro_steps)
 
     n_local = n / (mp * pp)                  # bf16 params + bf16 grads
     weights = n_local * 2 + n_local * 2
     # AdamW m, v + fp32 master: ZeRO partitions these over sharding
     opt = n_local * 12 / max(shard, 1)
     act_per_tok = _ACT_BYTES_REMAT if remat else _ACT_BYTES_FULL
-    acts = mbs * S * H * (L / pp) / mp * act_per_tok
+    acts = mbs * S * H * (L / pp) / mp * act_per_tok * in_flight
+    if V:
+        # bf16 logits + fp32 softmax/CE working set on the last stage
+        acts += mbs * S * V * 6.0 / mp
     return weights + opt + acts
 
 
@@ -100,5 +114,5 @@ def predict_step_time(model, cfg, cluster, global_batch_size=None):
 def predict(model, cfg, cluster, global_batch_size=None):
     """(seconds_per_step, memory_bytes_per_chip, fits) triple."""
     t = predict_step_time(model, cfg, cluster, global_batch_size)
-    m = predict_memory_bytes(model, cfg, cluster)
+    m = predict_memory_bytes(model, cfg, cluster, global_batch_size)
     return t, m, m <= cluster.hbm_bytes * 0.92  # runtime reserve

@@ -32,7 +32,15 @@ from .auto_parallel_api import (  # noqa: F401
 )
 from . import auto_parallel  # noqa: F401
 from .auto_parallel import Engine, to_static  # noqa: F401
+from . import io  # noqa: F401
 from . import passes  # noqa: F401
+from .entry_attr import (  # noqa: F401
+    CountFilterEntry, ProbabilityEntry, ShowClickEntry,
+)
+from .parallel_with_gloo import (  # noqa: F401
+    gloo_barrier, gloo_init_parallel_env, gloo_release,
+)
+from .fleet.dataset import InMemoryDataset, QueueDataset  # noqa: F401
 from . import rpc  # noqa: F401
 from . import ps  # noqa: F401
 from . import utils  # noqa: F401

@@ -73,6 +73,13 @@ class AutoTuner:
             ranked.append(cfg)
         ranked.sort(key=lambda c: c["predicted_step_time"])
         self.pruned_by_cost = len(viable) - len(ranked)
+        if viable and not ranked:
+            raise ValueError(
+                f"cost model predicts every one of the {len(viable)} "
+                f"viable configs exceeds {cluster.hbm_bytes / 2**30:.1f} "
+                f"GiB HBM on {cluster.device_kind!r} — the model is too "
+                f"big for this cluster/candidate grid, the search would "
+                f"be empty")
         self.algo.all_cfgs = ranked
         self.algo.idx = 0
 

@@ -1,0 +1,68 @@
+"""Sparse-table entry filters (ref:
+``python/paddle/distributed/entry_attr.py``): admission policies for
+large-scale sparse embedding tables — a feature id enters the table
+only probabilistically / after a show count / weighted by show-click.
+Consumed by the parameter-server embedding
+(:mod:`paddle_tpu.distributed.ps`)."""
+from __future__ import annotations
+
+__all__ = ["ProbabilityEntry", "CountFilterEntry", "ShowClickEntry"]
+
+
+class EntryAttr:
+    def __init__(self):
+        self._name = None
+
+    def _to_attr(self):
+        raise NotImplementedError("EntryAttr is abstract")
+
+
+class ProbabilityEntry(EntryAttr):
+    """Admit a new feature id with fixed probability (ref
+    ``entry_attr.py:57``)."""
+
+    def __init__(self, probability):
+        super().__init__()
+        if not isinstance(probability, float):
+            raise ValueError("probability must be a float in (0,1)")
+        if probability <= 0 or probability >= 1:
+            raise ValueError("probability must be a float in (0,1)")
+        self._name = "probability_entry"
+        self._probability = probability
+
+    def _to_attr(self):
+        return ":".join([self._name, str(self._probability)])
+
+
+class CountFilterEntry(EntryAttr):
+    """Admit a feature id once it has been seen ``count_filter`` times
+    (ref ``entry_attr.py:121``)."""
+
+    def __init__(self, count_filter):
+        super().__init__()
+        if not isinstance(count_filter, int):
+            raise ValueError("count_filter must be a valid integer")
+        if count_filter < 0:
+            raise ValueError("count_filter must be a integer larger than 0")
+        self._name = "count_filter_entry"
+        self._count_filter = count_filter
+
+    def _to_attr(self):
+        return ":".join([self._name, str(self._count_filter)])
+
+
+class ShowClickEntry(EntryAttr):
+    """Weight feature admission by show/click statistic slots (ref
+    ``entry_attr.py:184``)."""
+
+    def __init__(self, show_name, click_name):
+        super().__init__()
+        if not isinstance(show_name, str) or not isinstance(click_name,
+                                                            str):
+            raise ValueError("show_name/click_name must be strings")
+        self._name = "show_click_entry"
+        self._show_name = show_name
+        self._click_name = click_name
+
+    def _to_attr(self):
+        return ":".join([self._name, self._show_name, self._click_name])

@@ -1,0 +1,47 @@
+"""``paddle.distributed.io`` (ref:
+``python/paddle/distributed/io.py``): persistable-variable save/load
+for distributed training jobs.
+
+The reference splits persistables into local vs remote (PS-hosted)
+pieces and pulls the remote ones over RPC before writing. Here ALL
+program state lives in the executor scope (XLA arrays; PS tables are
+host-side ShardedEmbedding state), so persistables round-trip through
+the static save/load path in one place.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["save_persistables", "load_persistables", "is_persistable"]
+
+
+def is_persistable(var):
+    """ref ``io.py:355``: does this variable survive across steps
+    (parameters / optimizer state), as opposed to per-batch temps."""
+    return bool(getattr(var, "persistable", False))
+
+
+def _resolve(main_program, dirname, filename):
+    from ..static.graph import default_main_program
+    prog = main_program if main_program is not None \
+        else default_main_program()
+    return prog, os.path.join(dirname, filename or "persistables")
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """Write every persistable of ``main_program`` under ``dirname``
+    (ref ``io.py:386``)."""
+    from ..static import io as static_io
+    prog, path = _resolve(main_program, dirname, filename)
+    os.makedirs(dirname, exist_ok=True)
+    static_io.save(prog, path)
+    return path
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    """Restore what :func:`save_persistables` wrote (ref
+    ``io.py:131``)."""
+    from ..static import io as static_io
+    prog, path = _resolve(main_program, dirname, filename)
+    static_io.load(prog, path, executor)
+    return path

@@ -1,0 +1,62 @@
+"""CPU-side rendezvous without a device runtime (ref:
+``python/paddle/distributed/parallel_with_gloo.py``: gloo-backed
+init/barrier/release for data-pipeline and PS processes that never
+touch an accelerator).
+
+TPU-native: the native TCPStore (``core/native/store.cc``) is the
+transport — the same store the comm bootstrap and RPC rendezvous ride —
+so no second comm library exists just for CPU barriers.
+"""
+from __future__ import annotations
+
+import time
+
+__all__ = ["gloo_init_parallel_env", "gloo_barrier", "gloo_release"]
+
+_gloo = {"store": None, "rank": 0, "world": 1, "round": 0}
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """Rendezvous ``rank_num`` CPU processes on ``server_endpoint``
+    ("ip:port"; rank 0 hosts the store) — ref
+    ``parallel_with_gloo.py:42``."""
+    if rank_num <= 1:
+        _gloo.update(store=None, rank=0, world=1, round=0)
+        return
+    from .. import core
+    host, port = server_endpoint.rsplit(":", 1)
+    store = core.TCPStore(host, int(port), is_master=(rank_id == 0),
+                          timeout=120.0)
+    _gloo.update(store=store, rank=rank_id, world=rank_num, round=0)
+    gloo_barrier()  # everyone waits until the full world arrived
+
+
+def gloo_barrier(timeout=900.0):
+    """Block until every initialized rank reaches the same barrier round
+    (ref ``parallel_with_gloo.py:139``). Raises TimeoutError after
+    ``timeout`` seconds — a dead peer must not hang the job silently."""
+    store, world = _gloo["store"], _gloo["world"]
+    if store is None or world <= 1:
+        return
+    _gloo["round"] += 1
+    key = f"gloo/barrier/{_gloo['round']}"
+    store.add(key, 1)
+    deadline = time.monotonic() + timeout
+    while store.add(key, 0) < world:
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"gloo_barrier: only {store.add(key, 0)}/{world} ranks "
+                f"arrived within {timeout}s — a peer likely died")
+        time.sleep(0.01)
+
+
+def gloo_release():
+    """Tear down the rendezvous state (ref
+    ``parallel_with_gloo.py:197``)."""
+    store = _gloo["store"]
+    if store is not None:
+        try:
+            store.close()
+        except Exception:
+            pass
+    _gloo.update(store=None, rank=0, world=1, round=0)

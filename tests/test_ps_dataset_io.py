@@ -1,0 +1,159 @@
+"""PS-era distributed surface (ref: ``distributed/fleet/dataset/``,
+``distributed/entry_attr.py``, ``distributed/io.py``,
+``parallel_with_gloo.py``): MultiSlot dataset streaming/shuffle, entry
+attr configs, persistables round trip, gloo single-rank lifecycle."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.distributed as dist
+
+
+def _write_multislot(path, rows):
+    # each row: ([dense floats], [sparse int ids])
+    with open(path, "w") as f:
+        for dense, ids in rows:
+            f.write(f"{len(dense)} " + " ".join(map(str, dense)) + " "
+                    + f"{len(ids)} " + " ".join(map(str, ids)) + "\n")
+
+
+class _Var:
+    def __init__(self, name, dtype, shape=None):
+        self.name, self.dtype, self.shape = name, dtype, shape
+
+
+@pytest.fixture
+def slot_files(tmp_path):
+    rows1 = [([0.5, 1.5], [7, 8, 9]), ([2.5, 3.5], [1]),
+             ([4.5, 5.5], [2, 3])]
+    rows2 = [([6.5, 7.5], [4, 5]), ([8.5, 9.5], [6])]
+    p1, p2 = str(tmp_path / "a.txt"), str(tmp_path / "b.txt")
+    _write_multislot(p1, rows1)
+    _write_multislot(p2, rows2)
+    return [p1, p2]
+
+
+def _make(cls, files, batch_size=2):
+    ds = cls()
+    ds.init(batch_size=batch_size, thread_num=1,
+            use_var=[_Var("dense", "float32", [-1, 2]),
+                     _Var("ids", "int64")],   # ids: no static size -> ragged
+            pipe_command="cat")
+    ds.set_filelist(files)
+    return ds
+
+
+class TestQueueDataset:
+    def test_streams_batches_through_pipe(self, slot_files):
+        ds = _make(dist.QueueDataset, slot_files)
+        batches = list(ds)
+        assert len(batches) == 3  # 5 records, batch 2 -> 2+2+1
+        b0 = batches[0]
+        np.testing.assert_allclose(b0["dense"],
+                                   [[0.5, 1.5], [2.5, 3.5]])
+        assert b0["dense"].dtype == np.float32
+        # undeclared-size slot is ALWAYS a list, even when a batch's
+        # lengths coincide (type must not flip between batches)
+        assert [a.tolist() for a in b0["ids"]] == [[7, 8, 9], [1]]
+        assert isinstance(batches[1]["ids"], list)  # lens 2,2 — still list
+        assert batches[2]["dense"].shape == (1, 2)
+
+    def test_pipe_command_is_real(self, slot_files):
+        ds = _make(dist.QueueDataset, slot_files[:1])
+        # a pipe that keeps only the first record
+        ds.pipe_command = "head -n 1"
+        assert sum(len(b["dense"]) for b in ds) == 1
+
+    def test_parse_error_is_loud(self, tmp_path):
+        bad = str(tmp_path / "bad.txt")
+        with open(bad, "w") as f:
+            f.write("2 1.0\n")  # declares 2 values, has 1
+        ds = _make(dist.QueueDataset, [bad])
+        ds.use_var = [_Var("dense", "float32")]
+        with pytest.raises(ValueError, match="MultiSlot"):
+            list(ds)
+
+    def test_declared_static_size_enforced(self, tmp_path):
+        p = str(tmp_path / "mixed.txt")
+        with open(p, "w") as f:
+            f.write("2 1.0 2.0\n3 1.0 2.0 3.0\n")
+        ds = _make(dist.QueueDataset, [p])
+        ds.use_var = [_Var("dense", "float32", [-1, 2])]
+        with pytest.raises(ValueError, match="MultiSlot"):
+            list(ds)
+
+
+class TestInMemoryDataset:
+    def test_load_shuffle_release(self, slot_files):
+        ds = _make(dist.InMemoryDataset, slot_files)
+        with pytest.raises(RuntimeError, match="load_into_memory"):
+            list(ds)
+        ds.load_into_memory()
+        assert ds.get_memory_data_size() == 5
+        assert ds.get_shuffle_data_size() == 5
+        dense_before = [b["dense"].sum() for b in ds]
+        ds.local_shuffle()
+        total_after = sum(b["dense"].sum() for b in ds)
+        np.testing.assert_allclose(total_after, sum(dense_before))
+        ds.global_shuffle()
+        assert ds.get_memory_data_size() == 5
+        ds.release_memory()
+        assert ds.get_memory_data_size() == 0
+        ds._init_distributed_settings(parse_ins_id=True)
+        ds.update_settings(batch_size=4)
+        assert ds.batch_size == 4
+
+
+def test_entry_attrs_match_reference_attr_strings():
+    assert dist.ProbabilityEntry(0.1)._to_attr() == "probability_entry:0.1"
+    assert dist.CountFilterEntry(10)._to_attr() == "count_filter_entry:10"
+    assert dist.ShowClickEntry("show", "click")._to_attr() == \
+        "show_click_entry:show:click"
+    with pytest.raises(ValueError):
+        dist.ProbabilityEntry(1.5)
+    with pytest.raises(ValueError):
+        dist.CountFilterEntry(-1)
+    with pytest.raises(ValueError):
+        dist.ShowClickEntry("show", 3)
+
+
+def test_io_persistables_round_trip(tmp_path):
+    import paddle_tpu.static as static
+    pt.seed(0)
+    pt.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2, 4], "float32")
+            w = pt.create_parameter([4, 3], "float32")
+            y = pt.matmul(x, w)
+        exe = static.Executor()
+        exe.run(startup)
+        feed = {"x": np.ones((2, 4), np.float32)}
+        before = np.asarray(exe.run(main, feed=feed, fetch_list=[y])[0])
+        dist.io.save_persistables(exe, str(tmp_path), main)
+        assert dist.io.is_persistable(w)
+        assert not dist.io.is_persistable(x)
+        # clobber then restore
+        from paddle_tpu.static.executor import global_scope
+        import jax.numpy as jnp
+        scope = global_scope()
+        for k in list(main.scope_tensors):
+            v = scope.find_var(k)
+            base = v if v is not None else main.scope_tensors[k]._data
+            scope.set(k, jnp.zeros_like(base))
+        mid = np.asarray(exe.run(main, feed=feed, fetch_list=[y])[0])
+        assert abs(mid).max() == 0.0
+        dist.io.load_persistables(exe, str(tmp_path), main)
+        after = np.asarray(exe.run(main, feed=feed, fetch_list=[y])[0])
+        np.testing.assert_allclose(after, before)
+    finally:
+        pt.disable_static()
+
+
+def test_gloo_single_rank_lifecycle():
+    dist.gloo_init_parallel_env(0, 1, "127.0.0.1:0")
+    dist.gloo_barrier()  # no-op at world 1, must not hang
+    dist.gloo_release()
