@@ -94,6 +94,11 @@ class DatasetBase:
                     f"static size {fixed} but a record carries {n} values")
             i += 1 + n
             rec.append(vals)
+        if i != len(toks):
+            raise ValueError(
+                f"MultiSlot parse error: {len(toks) - i} trailing tokens "
+                f"after the {len(meta)} declared slots — use_var is "
+                f"missing a slot or lists slots in the wrong order")
         return rec
 
     def _stream_records(self):

@@ -153,7 +153,8 @@ class Fleet:
 
     def save_persistables(self, executor, dirname, main_program=None,
                           mode=0):
-        return None
+        from ..io import save_persistables as _sp
+        return _sp(executor, dirname, main_program)
 
     def save_sharded(self, state, path):
         """Distributed checkpoint of a build_train_step state: per-host

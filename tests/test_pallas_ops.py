@@ -282,6 +282,7 @@ class TestVarlen:
         p = jnp.where(jnp.isnan(p), 0.0, p)
         return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
+    @pytest.mark.slow
     def test_forward_matches_masked_reference(self):
         q, k, v = (_rand((3, 2, 128, 64), i) for i in range(3))
         lens = np.array([128, 70, 1], np.int32)
@@ -508,6 +509,25 @@ class TestPackedVarlen:
         assert np.isfinite(np.asarray(q.grad._data)).all()
 
 
+def test_packed_varlen_minimal_fast():
+    """FAST-tier guard for the packed kernel itself: one tiny single-
+    sequence forward (every capability keeps at least one fast test;
+    the richer guard + parity suites are slow-tier)."""
+    from paddle_tpu.ops.pallas_ops import mha_packed
+    rs = np.random.RandomState(9)
+    q = jnp.asarray(rs.randn(8, 1, 8).astype(np.float32))
+    cu = jnp.asarray(np.array([0, 8], np.int32))
+    got = np.asarray(mha_packed(q, q, q, cu, cu, causal=True, block_q=8,
+                                block_k=8, interpret=True))[:, 0]
+    qq = np.asarray(q)[:, 0]
+    lg = qq @ qq.T / np.sqrt(8)
+    lg = np.where(np.tril(np.ones_like(lg, dtype=bool)), lg, -1e30)
+    pr = np.exp(lg - lg.max(-1, keepdims=True))
+    pr /= pr.sum(-1, keepdims=True)
+    np.testing.assert_allclose(got, pr @ qq, atol=2e-5)
+
+
+@pytest.mark.slow
 def test_packed_varlen_fast_guard():
     """Minimal fast-tier guard for the packed path: ONE tiny kernel call
     (single cross pair) + the eager cu validation. Full parity suites

@@ -225,6 +225,24 @@ class TestDeformConv2d:
                                    np.asarray(want._data),
                                    rtol=1e-4, atol=1e-4)
 
+    def test_zero_offset_matches_plain_conv_fast(self):
+        # FAST-tier guard: zero offsets reduce deform_conv2d to a plain
+        # convolution (capability keeps one fast test; the sampling-
+        # shift and modulation suites are slow-tier)
+        rs = np.random.RandomState(3)
+        x = rs.randn(1, 2, 6, 6).astype(np.float32)
+        w = rs.randn(3, 2, 3, 3).astype(np.float32)
+        off = np.zeros((1, 18, 6, 6), np.float32)
+        got = np.asarray(V.deform_conv2d(
+            Tensor(x), Tensor(off), Tensor(w), padding=1)._data)
+        import jax
+        ref = jax.lax.conv_general_dilated(
+            x, w, (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        np.testing.assert_allclose(got, np.asarray(ref), atol=2e-4,
+                                   rtol=2e-4)
+
+    @pytest.mark.slow
     def test_integer_offset_shifts_sampling(self):
         """An integer (dy, dx) = (0, 1) offset on every tap equals
         convolving the input shifted left by one pixel."""
@@ -388,6 +406,7 @@ class TestYoloLoss:
         assert np.all(l0 > extrap - 1e-6)
         assert np.any(l0 > extrap + 1e-6)
 
+    @pytest.mark.slow
     def test_two_gts_in_same_cell_both_contribute(self):
         """Reference accumulates per-gt losses — a duplicate (cell,
         anchor) assignment must not silently drop one box."""
