@@ -6,5 +6,14 @@ from . import autograd  # noqa: F401
 from . import autotune  # noqa: F401
 from . import asp  # noqa: F401
 from . import multiprocessing  # noqa: F401
+from .operators import (  # noqa: F401
+    graph_khop_sampler, graph_reindex, graph_sample_neighbors,
+    graph_send_recv, identity_loss, softmax_mask_fuse,
+    softmax_mask_fuse_upper_triangle,
+)
+# graduated-to-geometric math kept at the incubate spelling too
+from ..geometric import (  # noqa: F401
+    segment_max, segment_mean, segment_min, segment_sum,
+)
 from .optimizer import (  # noqa: F401
     LookAhead, ModelAverage, DistributedFusedLamb)

@@ -1,0 +1,168 @@
+"""Legacy ``paddle.incubate`` operator aliases (ref:
+``python/paddle/incubate/operators/``): the graph ops that later
+graduated to ``paddle.geometric`` plus the fused-softmax helpers. The
+implementations live in :mod:`paddle_tpu.geometric`; these wrappers
+keep the incubate-era signatures (``pool_type`` instead of
+``reduce_op``, buffer/flag arguments accepted and ignored — they tuned
+the CUDA hashtable path)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..ops.op_utils import ensure_tensor, nary
+
+__all__ = [
+    "softmax_mask_fuse", "softmax_mask_fuse_upper_triangle",
+    "graph_send_recv", "graph_khop_sampler", "graph_sample_neighbors",
+    "graph_reindex", "identity_loss",
+]
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """Fused softmax(x + mask) (ref
+    ``operators/softmax_mask_fuse.py:20`` over the CUDA fused kernel;
+    XLA fuses the add into the softmax on TPU)."""
+    def f(xd, md):
+        return jax.nn.softmax((xd.astype(jnp.float32)
+                               + md.astype(jnp.float32)), axis=-1) \
+            .astype(xd.dtype)
+    return nary(f, [ensure_tensor(x), ensure_tensor(mask)],
+                name="softmax_mask_fuse")
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """Fused causal softmax: mask out the strictly-upper triangle (ref
+    ``operators/softmax_mask_fuse_upper_triangle.py:20``)."""
+    def f(xd):
+        s, k = xd.shape[-2], xd.shape[-1]
+        keep = jnp.tril(jnp.ones((s, k), bool))
+        logits = jnp.where(keep, xd.astype(jnp.float32),
+                           jnp.finfo(jnp.float32).min)
+        return jax.nn.softmax(logits, axis=-1).astype(xd.dtype)
+    return nary(f, [ensure_tensor(x)],
+                name="softmax_mask_fuse_upper_triangle")
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """Legacy spelling of :func:`paddle_tpu.geometric.send_u_recv`
+    (ref ``operators/graph_send_recv.py:37``; ``pool_type`` became
+    ``reduce_op`` on graduation)."""
+    from ..geometric import send_u_recv
+    return send_u_recv(x, src_index, dst_index,
+                       reduce_op=str(pool_type).lower(),
+                       out_size=out_size)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    """Legacy spelling of :func:`paddle_tpu.geometric.sample_neighbors`
+    (ref ``operators/graph_sample_neighbors.py:28``); the perm-buffer
+    args tuned the CUDA fisher-yates path and are accepted unused."""
+    from ..geometric import sample_neighbors
+    return sample_neighbors(row, colptr, input_nodes,
+                            sample_size=sample_size, eids=eids,
+                            return_eids=return_eids)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None,
+                  index_buffer=None, flag_buffer_hashtable=False,
+                  name=None):
+    """Legacy spelling of :func:`paddle_tpu.geometric.reindex_graph`
+    (ref ``operators/graph_reindex.py:28``)."""
+    from ..geometric import reindex_graph
+    return reindex_graph(x, neighbors, count)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling + subgraph reindex (ref
+    ``operators/graph_khop_sampler.py:21``): one
+    :func:`~paddle_tpu.geometric.sample_neighbors` round per entry of
+    ``sample_sizes`` over the frontier, then one reindex of the union.
+    Returns (edge_src, edge_dst, sample_index, reindex_nodes
+    [, edge_eids])."""
+    from ..geometric import sample_neighbors, reindex_graph
+    from ..tensor import Tensor
+
+    frontier = ensure_tensor(input_nodes)
+    seeds_np = np.asarray(frontier._data).ravel()
+    all_neighbors, all_counts, all_eids = [], [], []
+    centers = []
+    for hop, size in enumerate(list(sample_sizes)):
+        res = sample_neighbors(row, colptr, frontier,
+                               sample_size=int(size),
+                               eids=sorted_eids,
+                               return_eids=return_eids)
+        if return_eids:
+            nbr, cnt, eid = res
+            all_eids.append(np.asarray(eid._data).ravel())
+        else:
+            nbr, cnt = res
+        nbr_np = np.asarray(nbr._data).ravel()
+        cnt_np = np.asarray(cnt._data).ravel()
+        centers.append(np.asarray(frontier._data).ravel())
+        all_neighbors.append(nbr_np)
+        all_counts.append(cnt_np)
+        # next frontier: the new neighbors (dedup, keep order)
+        frontier = Tensor(jnp.asarray(
+            np.unique(nbr_np) if len(nbr_np) else nbr_np))
+    # union subgraph: per-hop center/neighbor lists concatenate; the
+    # reindex covers seeds + every sampled node
+    x_nodes = np.concatenate(centers)
+    neighbors = np.concatenate(all_neighbors) if all_neighbors else \
+        np.zeros((0,), seeds_np.dtype)
+    counts = np.concatenate(all_counts) if all_counts else \
+        np.zeros((0,), np.int32)
+    # reindex_graph wants unique center ids; dedup while preserving
+    # first occurrence, remapping counts accordingly
+    uniq, first_idx = np.unique(x_nodes, return_index=True)
+    order = np.argsort(first_idx)
+    uniq_ordered = uniq[order]
+    # aggregate neighbor segments per center occurrence -> per unique id
+    seg_starts = np.concatenate([[0], np.cumsum(counts)])[:-1]
+    per_center = {int(c): [] for c in uniq_ordered}
+    for c, s, n in zip(x_nodes, seg_starts, counts):
+        per_center[int(c)].append(neighbors[s:s + int(n)])
+    merged_counts = np.asarray(
+        [sum(len(a) for a in per_center[int(c)]) for c in uniq_ordered],
+        dtype=counts.dtype if counts.size else np.int32)
+    merged_neighbors = np.concatenate(
+        [a for c in uniq_ordered for a in per_center[int(c)]]) \
+        if neighbors.size else neighbors
+    reindex_src, reindex_dst, out_nodes = reindex_graph(
+        Tensor(jnp.asarray(uniq_ordered)),
+        Tensor(jnp.asarray(merged_neighbors)),
+        Tensor(jnp.asarray(merged_counts)))
+    out_nodes_np = np.asarray(out_nodes._data).ravel()
+    pos = {int(n): i for i, n in enumerate(out_nodes_np)}
+    reindex_nodes = Tensor(jnp.asarray(
+        np.asarray([pos[int(n)] for n in seeds_np],
+                   dtype=seeds_np.dtype)))
+    out = (reindex_src, reindex_dst, out_nodes, reindex_nodes)
+    if return_eids:
+        eids_cat = np.concatenate(all_eids) if all_eids else \
+            np.zeros((0,), seeds_np.dtype)
+        return out + (Tensor(jnp.asarray(eids_cat)),)
+    return out
+
+
+def identity_loss(x, reduction="none"):
+    """Loss-marker op (ref ``incubate/nn/loss.py:21``; IPU used it to
+    anchor backprop — here it is the documented reduction)."""
+    if isinstance(reduction, str):
+        reduction = {"sum": 0, "mean": 1, "none": 2}.get(reduction.lower())
+        if reduction is None:
+            raise Exception("Unsupported reduction type.")
+    t = ensure_tensor(x)
+    if reduction == 0:
+        return t.sum()
+    if reduction == 1:
+        return t.mean()
+    if reduction == 2:
+        return t
+    raise Exception("Unsupported reduction type.")

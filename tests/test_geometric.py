@@ -195,3 +195,64 @@ class TestSampling:
                 paddle.to_tensor(np.array([1], np.int64)), sample_size=2)
             outs.append(n.numpy())
         np.testing.assert_array_equal(outs[0], outs[1])
+
+
+class TestIncubateLegacyAliases:
+    """The incubate-era spellings (ref: ``python/paddle/incubate/
+    operators/``) stay available after graduation to geometric."""
+
+    def test_graph_send_recv_matches_send_u_recv(self):
+        x = paddle.to_tensor(np.array([[0, 2, 3], [1, 4, 5], [2, 6, 7]],
+                                  np.float32))
+        src = paddle.to_tensor(np.array([0, 1, 2, 0]))
+        dst = paddle.to_tensor(np.array([1, 2, 1, 0]))
+        got = paddle.incubate.graph_send_recv(x, src, dst, pool_type="sum")
+        want = paddle.geometric.send_u_recv(x, src, dst, reduce_op="sum")
+        np.testing.assert_allclose(got.numpy(), want.numpy())
+
+    def test_khop_sampler_docstring_graph(self):
+        row = paddle.to_tensor(np.array([3, 7, 0, 9, 1, 4, 2, 9, 3, 9, 1, 9,
+                                     7], np.int64))
+        colptr = paddle.to_tensor(np.array([0, 2, 4, 5, 6, 7, 9, 11, 11, 13,
+                                        13], np.int64))
+        nodes = paddle.to_tensor(np.array([0, 8, 1, 2], np.int64))
+        es, ed, si, rn = paddle.incubate.graph_khop_sampler(
+            row, colptr, nodes, [2, 2])
+        es, ed, si, rn = (t.numpy() for t in (es, ed, si, rn))
+        # seeds come first in the sample index and reindex to themselves
+        assert si[:4].tolist() == [0, 8, 1, 2]
+        assert rn.tolist() == [0, 1, 2, 3]
+        # every edge endpoint is a valid reindexed node id
+        assert es.max() < len(si) and ed.max() < len(si)
+        # edges decode back to real graph edges: dst's original id must
+        # list src's original id among its CSC column
+        rown, cols = np.asarray(row.numpy()), np.asarray(colptr.numpy())
+        for s, d in zip(es, ed):
+            src_orig, dst_orig = si[s], si[d]
+            nbrs = rown[cols[dst_orig]:cols[dst_orig + 1]]
+            assert src_orig in nbrs
+
+    def test_softmax_mask_fuse_and_upper_triangle(self):
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(2, 3, 5, 5).astype(np.float32))
+        mask = paddle.to_tensor(
+            np.where(rs.rand(2, 1, 5, 5) > 0.5, 0.0, -1e9)
+            .astype(np.float32))
+        p = paddle.incubate.softmax_mask_fuse(x, mask).numpy()
+        np.testing.assert_allclose(p.sum(-1), np.ones_like(p.sum(-1)),
+                                   atol=1e-5)
+        assert p[np.broadcast_to(mask.numpy() < -1e8, p.shape)].max() \
+            < 1e-6
+        pu = paddle.incubate.softmax_mask_fuse_upper_triangle(x).numpy()
+        assert np.abs(np.triu(pu, 1)).max() == 0.0
+        np.testing.assert_allclose(pu.sum(-1), np.ones_like(pu.sum(-1)),
+                                   atol=1e-5)
+
+    def test_identity_loss_reductions(self):
+        x = paddle.to_tensor(np.array([[1.0], [2.0], [3.0]], np.float32))
+        assert float(paddle.incubate.identity_loss(x, "sum").numpy()) == 6.0
+        assert float(paddle.incubate.identity_loss(x, 1).numpy()) == 2.0
+        np.testing.assert_allclose(
+            paddle.incubate.identity_loss(x, "none").numpy(), x.numpy())
+        with pytest.raises(Exception, match="Unsupported"):
+            paddle.incubate.identity_loss(x, "bogus")
