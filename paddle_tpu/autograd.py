@@ -31,6 +31,7 @@ from .framework import flags as _flags
 __all__ = [
     "no_grad", "enable_grad", "set_grad_enabled", "is_grad_enabled",
     "backward", "grad", "PyLayer", "PyLayerContext",
+    "saved_tensors_hooks", "jacobian", "hessian", "Jacobian", "Hessian",
 ]
 
 _state = threading.local()
@@ -117,7 +118,7 @@ class Node:
     """
 
     __slots__ = ("inputs", "vjp_fn", "fn", "datas", "out_refs", "out_avals",
-                 "name", "_hooks", "_released", "__weakref__")
+                 "name", "_hooks", "_released", "_unpack", "__weakref__")
 
     def __init__(self, inputs, vjp_fn, outputs, name="", fn=None,
                  datas=None):
@@ -130,6 +131,7 @@ class Node:
         self.name = name
         self._hooks = None
         self._released = False
+        self._unpack = None
 
     def pullback(self, cot):
         if self._released:
@@ -139,7 +141,10 @@ class Node:
         if self.vjp_fn is None:
             # deferred trace: input arrays were captured at record time, so
             # later in-place rebinds of the input Tensors don't corrupt it
-            _, self.vjp_fn = jax.vjp(self.fn, *self.datas)
+            datas = self.datas
+            if self._unpack is not None:
+                datas = tuple(_unpack_saved(self._unpack, p) for p in datas)
+            _, self.vjp_fn = jax.vjp(self.fn, *datas)
         return self.vjp_fn(cot)
 
     def release(self):
@@ -178,6 +183,49 @@ def _repoint_out_ref(node, idx, ref):
         node.out_refs = refs[:idx] + (ref,) + refs[idx + 1:]
     else:
         refs[idx] = ref
+
+
+def _hooks_stack():
+    """Per-thread hook stack — a hooks context in one thread must not
+    pack tensors recorded concurrently by other threads (all other
+    autograd mode state lives on ``_state`` for the same reason)."""
+    st = _state.__dict__
+    stack = st.get("saved_hooks")
+    if stack is None:
+        stack = st["saved_hooks"] = []
+    return stack
+
+
+class saved_tensors_hooks:
+    """Pack/unpack hooks over tensors saved for backward (ref
+    ``python/paddle/autograd/saved_tensors_hooks.py:20``): every array
+    the tape captures for a node's deferred vjp is passed (as a Tensor)
+    through ``pack_hook`` at record time, and ``unpack_hook`` rebuilds
+    it at backward time — the offload-to-CPU/disk extension point."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        _hooks_stack().append((self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        _hooks_stack().pop()
+        return False
+
+
+def _pack_saved(node):
+    from .tensor import Tensor
+    pack, unpack = _hooks_stack()[-1]
+    node.datas = tuple(pack(Tensor(d)) for d in node.datas)
+    node._unpack = unpack
+
+
+def _unpack_saved(unpack, packed):
+    t = unpack(packed)
+    return t._data if hasattr(t, "_data") else t
 
 
 def rebind_inplace(x, out):
@@ -281,6 +329,9 @@ def record(fn, tensors, outputs_wrap, name=""):
             node.name = name
             node._hooks = None
             node._released = False
+            node._unpack = None
+            if st.get("saved_hooks"):
+                _pack_saved(node)
             t._node = node  # _out_idx is already 0 from the ctor
         if _flag_values.get("check_nan_inf"):
             _check_nan_inf((t,), name)
@@ -301,6 +352,9 @@ def record(fn, tensors, outputs_wrap, name=""):
         node.name = name
         node._hooks = None
         node._released = False
+        node._unpack = None
+        if st.get("saved_hooks"):
+            _pack_saved(node)
         for i, t in enumerate(out_tensors):
             t._node = node
             t._out_idx = i
@@ -506,6 +560,8 @@ def _taped_pullback(n, out_cots):
         else:
             cot_template.append(c)  # float0 constant for int outputs
     fn, datas = n.fn, n.datas
+    if n._unpack is not None:  # saved_tensors_hooks pack/unpack
+        datas = tuple(_unpack_saved(n._unpack, p) for p in datas)
 
     def _is_float(a):
         import jax.numpy as jnp
@@ -633,15 +689,31 @@ class PyLayerContext:
         self.materialize_grads = True
 
     def save_for_backward(self, *tensors):
-        self._saved = tensors
+        # saved tensors route through active saved_tensors_hooks, same
+        # contract as the funnel tape (ref saved_tensors_hooks.py:30)
+        if _hooks_stack():
+            pack, unpack = _hooks_stack()[-1]
+            self._saved_packed = tuple(pack(t) for t in tensors)
+            self._saved_unpack = unpack
+            self._saved = None
+        else:
+            self._saved = tensors
+            self._saved_unpack = None
+
+    def _restore_saved(self):
+        if getattr(self, "_saved_unpack", None) is not None:
+            self._saved = tuple(self._saved_unpack(p)
+                                for p in self._saved_packed)
+            self._saved_unpack = None
+        return self._saved
 
     @property
     def saved_tensor(self):
-        return self._saved
+        return self._restore_saved()
 
     # paddle also exposes it as a method
     def saved_tensors(self):
-        return self._saved
+        return self._restore_saved()
 
 
 class PyLayer:
@@ -690,3 +762,8 @@ class PyLayer:
                 t._node = node
                 t._out_idx = i
         return out if multi else outs[0]
+
+
+from .autograd_functional import (  # noqa: E402
+    Hessian, Jacobian, hessian, jacobian,
+)

@@ -1,0 +1,167 @@
+"""``paddle.autograd`` functional API: lazy Jacobian / Hessian (ref:
+``python/paddle/autograd/autograd.py:30 Jacobian``, ``:450 jacobian``,
+``:542 hessian``).
+
+The reference evaluates rows lazily through repeated dygraph backward
+calls; here each row is one tape :func:`~paddle_tpu.autograd.grad` with
+a one-hot cotangent (rows cache at row granularity, same contract).
+``ys`` must be tape-recorded outputs of ``xs``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Jacobian", "Hessian", "jacobian", "hessian"]
+
+
+def _is_seq(x):
+    return isinstance(x, (list, tuple))
+
+
+class Jacobian:
+    """Lazy d(ys)/d(xs) for one (ys, xs) Tensor pair.
+
+    ``batch_axis=None``: xs [N], ys [M] -> shape [M, N];
+    ``batch_axis=0``:    xs [B, N], ys [B, M] -> shape [B, M, N].
+    Indexing evaluates (and caches) only the rows the index touches.
+    """
+
+    def __init__(self, ys, xs, batch_axis=None, create_graph=False):
+        if batch_axis not in (None, 0):
+            raise ValueError("batch_axis must be None or 0, got "
+                             f"{batch_axis!r}")
+        nd_ok = (1, 2) if batch_axis == 0 else (0, 1)
+        if ys.ndim not in nd_ok or xs.ndim not in nd_ok:
+            raise ValueError(
+                f"with batch_axis={batch_axis}, ys/xs must be "
+                f"{nd_ok}-dimensional; got ys.ndim={ys.ndim}, "
+                f"xs.ndim={xs.ndim}")
+        self._ys, self._xs = ys, xs
+        self._batch = batch_axis == 0
+        self._create_graph = create_graph
+        self._rows: dict = {}
+
+    @property
+    def shape(self):
+        ys, xs = self._ys, self._xs
+        if self._batch:
+            return [ys.shape[0], int(np.prod(ys.shape[1:]) or 1),
+                    int(np.prod(xs.shape[1:]) or 1)]
+        return [int(np.prod(ys.shape) or 1), int(np.prod(xs.shape) or 1)]
+
+    def _n_rows(self):
+        return self.shape[1] if self._batch else self.shape[0]
+
+    def _row(self, m):
+        if m not in self._rows:
+            import jax.numpy as jnp
+            from .autograd import grad
+            from .tensor import Tensor
+            ys = self._ys
+            dt = ys._data.dtype  # cotangent must match the output aval
+            if not jnp.issubdtype(dt, jnp.floating):
+                dt = jnp.float32
+            if self._batch:
+                cot = jnp.zeros(ys.shape, dt)
+                cot = cot.reshape(ys.shape[0], -1).at[:, m].set(1.0) \
+                    .reshape(ys.shape)
+            else:
+                cot = jnp.zeros(ys.shape, dt) if ys.ndim else \
+                    jnp.ones((), dt)
+                if ys.ndim:
+                    cot = cot.ravel().at[m].set(1.0).reshape(ys.shape)
+            (g,) = grad(ys, [self._xs], grad_outputs=Tensor(cot),
+                        retain_graph=True,
+                        create_graph=self._create_graph,
+                        allow_unused=True)
+            if g is None:
+                from .ops.creation import zeros_like
+                g = zeros_like(self._xs)
+            self._rows[m] = g
+        return self._rows[m]
+
+    def _materialize(self, rows):
+        """Stack the requested rows into one Tensor along the row axis."""
+        from . import ops
+        parts = [self._row(m) for m in rows]
+        if self._batch:
+            # each part is [B, N_flat...] -> [B, len(rows), N]
+            parts = [ops.reshape(p, [p.shape[0], 1, -1]) for p in parts]
+            return ops.concat(parts, axis=1)
+        parts = [ops.reshape(p, [1, -1]) for p in parts]
+        return ops.concat(parts, axis=0)
+
+    def _rows_touched(self, idx):
+        """Row indices (along the row axis) the index needs, or None
+        for 'all' (fancy/unsupported index forms)."""
+        M = self._n_rows()
+        parts = idx if isinstance(idx, tuple) else (idx,)
+        row_pos = 1 if self._batch else 0
+        if len(parts) <= row_pos:
+            return None  # row axis untouched by the index -> all rows
+        r = parts[row_pos]
+        if isinstance(r, int):
+            return [r % M]
+        if isinstance(r, slice):
+            return list(range(*r.indices(M)))
+        return None
+
+    def __getitem__(self, idx):
+        # lazy contract: evaluate (and cache) ONLY the rows the index
+        # touches — one backward per new row
+        rows = self._rows_touched(idx)
+        if rows is None:
+            return self._materialize(range(self._n_rows()))[idx]
+        sub = self._materialize(rows)
+        # remap the row component of the index into the submatrix
+        parts = list(idx) if isinstance(idx, tuple) else [idx]
+        row_pos = 1 if self._batch else 0
+        r = parts[row_pos]
+        parts[row_pos] = 0 if isinstance(r, int) else slice(None)
+        return sub[tuple(parts) if len(parts) > 1 else parts[0]]
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._materialize(range(self._n_rows()))._data)
+        return a.astype(dtype) if dtype is not None else a
+
+
+class Hessian(Jacobian):
+    """d2(ys)/d(xs)2 for scalar ``ys`` (per batch element when
+    ``batch_axis=0``): the Jacobian of the create_graph first-order
+    gradient (ref ``autograd.py:183``)."""
+
+    def __init__(self, ys, xs, batch_axis=None):
+        from .autograd import grad
+        n = int(np.prod(ys.shape) or 1)
+        expect = ys.shape[0] if batch_axis == 0 else 1
+        if n != (expect if batch_axis == 0 else 1):
+            raise ValueError("hessian requires scalar ys (one value per "
+                             f"batch element); got shape {list(ys.shape)}")
+        (g,) = grad(ys, [xs], retain_graph=True, create_graph=True)
+        super().__init__(g, xs, batch_axis=batch_axis)
+
+
+def _nest(ys, xs, batch_axis, cls):
+    if _is_seq(ys):
+        return tuple(_nest(y, xs, batch_axis, cls) for y in ys)
+    if _is_seq(xs):
+        return tuple(cls(ys, x, batch_axis) for x in xs)
+    return cls(ys, xs, batch_axis)
+
+
+def jacobian(ys, xs, batch_axis=None):
+    """ref ``autograd.py:450``: tuple nesting mirrors (ys, xs)."""
+    return _nest(ys, xs, batch_axis, Jacobian)
+
+
+def hessian(ys, xs, batch_axis=None):
+    """ref ``autograd.py:542``: ``ys`` must be scalar(-per-batch)."""
+    if _is_seq(ys):
+        raise ValueError("hessian expects a single scalar ys")
+    if _is_seq(xs):
+        # symmetric block structure: row blocks d/dx_i of grads wrt x_j
+        from .autograd import grad
+        gs = grad(ys, list(xs), retain_graph=True, create_graph=True)
+        return tuple(tuple(Jacobian(g, x, batch_axis) for x in xs)
+                     for g in gs)
+    return Hessian(ys, xs, batch_axis)

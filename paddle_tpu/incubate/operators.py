@@ -91,6 +91,13 @@ def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
 
     frontier = ensure_tensor(input_nodes)
     seeds_np = np.asarray(frontier._data).ravel()
+    if not list(sample_sizes):  # degenerate: seeds only, no edges
+        empty = Tensor(jnp.asarray(np.zeros((0,), seeds_np.dtype)))
+        out_nodes = Tensor(jnp.asarray(seeds_np))
+        reindex_nodes = Tensor(jnp.asarray(
+            np.arange(len(seeds_np), dtype=seeds_np.dtype)))
+        out = (empty, empty, out_nodes, reindex_nodes)
+        return out + (empty,) if return_eids else out
     all_neighbors, all_counts, all_eids = [], [], []
     centers = []
     for hop, size in enumerate(list(sample_sizes)):
@@ -118,21 +125,28 @@ def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
         np.zeros((0,), seeds_np.dtype)
     counts = np.concatenate(all_counts) if all_counts else \
         np.zeros((0,), np.int32)
+    eids_flat = (np.concatenate(all_eids) if all_eids
+                 else np.zeros((0,), seeds_np.dtype)) if return_eids \
+        else None
     # reindex_graph wants unique center ids; dedup while preserving
-    # first occurrence, remapping counts accordingly
+    # first occurrence, remapping counts accordingly. eids travel with
+    # their neighbor segments through the SAME regrouping so the i-th
+    # eid still labels the i-th output edge.
     uniq, first_idx = np.unique(x_nodes, return_index=True)
     order = np.argsort(first_idx)
     uniq_ordered = uniq[order]
-    # aggregate neighbor segments per center occurrence -> per unique id
     seg_starts = np.concatenate([[0], np.cumsum(counts)])[:-1]
-    per_center = {int(c): [] for c in uniq_ordered}
+    per_center: dict = {int(c): [] for c in uniq_ordered}
     for c, s, n in zip(x_nodes, seg_starts, counts):
-        per_center[int(c)].append(neighbors[s:s + int(n)])
+        sl = slice(int(s), int(s) + int(n))
+        per_center[int(c)].append(
+            (neighbors[sl], eids_flat[sl] if return_eids else None))
     merged_counts = np.asarray(
-        [sum(len(a) for a in per_center[int(c)]) for c in uniq_ordered],
+        [sum(len(a) for a, _ in per_center[int(c)])
+         for c in uniq_ordered],
         dtype=counts.dtype if counts.size else np.int32)
     merged_neighbors = np.concatenate(
-        [a for c in uniq_ordered for a in per_center[int(c)]]) \
+        [a for c in uniq_ordered for a, _ in per_center[int(c)]]) \
         if neighbors.size else neighbors
     reindex_src, reindex_dst, out_nodes = reindex_graph(
         Tensor(jnp.asarray(uniq_ordered)),
@@ -145,9 +159,10 @@ def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
                    dtype=seeds_np.dtype)))
     out = (reindex_src, reindex_dst, out_nodes, reindex_nodes)
     if return_eids:
-        eids_cat = np.concatenate(all_eids) if all_eids else \
-            np.zeros((0,), seeds_np.dtype)
-        return out + (Tensor(jnp.asarray(eids_cat)),)
+        merged_eids = np.concatenate(
+            [e for c in uniq_ordered for _, e in per_center[int(c)]]) \
+            if neighbors.size else eids_flat
+        return out + (Tensor(jnp.asarray(merged_eids)),)
     return out
 
 

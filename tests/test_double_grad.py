@@ -2,6 +2,7 @@
 ``paddle/fluid/prim/`` double-grad, ``incubate/autograd/primapi.py:220``).
 Oracles are analytic derivatives."""
 import numpy as np
+import pytest
 
 import paddle_tpu as pt
 from paddle_tpu import autograd
@@ -75,3 +76,137 @@ def test_first_order_paths_unchanged():
     (g,) = autograd.grad(y, x)
     assert g.stop_gradient
     assert g._node is None
+
+
+class TestJacobianHessian:
+    """paddle.autograd.jacobian / hessian (ref autograd/autograd.py:450,
+    :542): lazy row-cached objects over the tape."""
+
+    def test_jacobian_vector(self):
+        x = pt.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        x.stop_gradient = False
+        y = x * x  # dy_i/dx_j = diag(2x)
+        J = pt.autograd.jacobian(y, x)
+        got = np.asarray(J[:])
+        np.testing.assert_allclose(got, np.diag([2.0, 4.0, 6.0]),
+                                   atol=1e-6)
+        assert J.shape == [3, 3]
+
+    def test_jacobian_batched(self):
+        rs = np.random.RandomState(0)
+        A = rs.randn(4, 2).astype(np.float32)
+        x = pt.to_tensor(rs.randn(3, 4).astype(np.float32))
+        x.stop_gradient = False
+        y = pt.matmul(x, pt.to_tensor(A))          # [3, 2]
+        J = pt.autograd.jacobian(y, x, batch_axis=0)
+        got = np.asarray(J[:])                      # [3, 2, 4]
+        want = np.broadcast_to(A.T, (3, 2, 4))
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_jacobian_tuple_inputs(self):
+        a = pt.to_tensor(np.array([1.0, 2.0], np.float32))
+        b = pt.to_tensor(np.array([3.0], np.float32))
+        a.stop_gradient = b.stop_gradient = False
+        y = pt.concat([a * 2.0, b * 5.0])
+        Ja, Jb = pt.autograd.jacobian(y, (a, b))
+        np.testing.assert_allclose(np.asarray(Ja[:]),
+                                   [[2, 0], [0, 2], [0, 0]], atol=1e-6)
+        np.testing.assert_allclose(np.asarray(Jb[:]),
+                                   [[0], [0], [5]], atol=1e-6)
+
+    def test_hessian_quadratic(self):
+        # f(x) = x^T A x  =>  H = A + A^T
+        A = np.array([[2.0, 1.0], [0.0, 3.0]], np.float32)
+        x = pt.to_tensor(np.array([1.5, -0.5], np.float32))
+        x.stop_gradient = False
+        y = pt.sum(x * pt.matmul(pt.to_tensor(A), x))
+        H = pt.autograd.hessian(y, x)
+        np.testing.assert_allclose(np.asarray(H[:]), A + A.T, atol=1e-5)
+
+    def test_hessian_rejects_vector_ys(self):
+        x = pt.to_tensor(np.ones(3, np.float32))
+        x.stop_gradient = False
+        with pytest.raises(ValueError, match="scalar"):
+            pt.autograd.hessian(x * x, x)
+
+
+class TestSavedTensorsHooks:
+    def test_pack_unpack_round_trip_and_call_counts(self):
+        packed, unpacked = [], []
+
+        def pack(t):
+            packed.append(tuple(t.shape))
+            return np.asarray(t._data)  # "offload to host"
+
+        def unpack(p):
+            unpacked.append(p.shape)
+            return pt.to_tensor(p)
+
+        x = pt.to_tensor(np.full((2, 2), 3.0, np.float32))
+        x.stop_gradient = False
+        with pt.autograd.saved_tensors_hooks(pack, unpack):
+            y = x * x
+        (y.sum()).backward()
+        assert packed and unpacked  # both hooks actually ran
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   np.full((2, 2), 6.0), atol=1e-6)
+
+    def test_pylayer_saved_tensor_routes_through_hooks(self):
+        seen = []
+
+        class Sq(pt.autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, a):
+                ctx.save_for_backward(a)
+                return a * a
+
+            @staticmethod
+            def backward(ctx, g):
+                (a,) = ctx.saved_tensor
+                return g * a * 2.0
+
+        def pack(t):
+            seen.append("pack")
+            return t
+
+        def unpack(p):
+            seen.append("unpack")
+            return p
+
+        x = pt.to_tensor(np.array([2.0], np.float32))
+        x.stop_gradient = False
+        with pt.autograd.saved_tensors_hooks(pack, unpack):
+            y = Sq.apply(x)
+        y.backward()
+        assert "pack" in seen and "unpack" in seen
+        np.testing.assert_allclose(x.grad.numpy(), [4.0], atol=1e-6)
+
+
+def test_jacobian_lazy_rows_and_hooks_with_create_graph():
+    # laziness: indexing one row must evaluate exactly one row
+    x = pt.to_tensor(np.arange(1.0, 6.0, dtype=np.float32))
+    x.stop_gradient = False
+    y = x * x
+    J = pt.autograd.jacobian(y, x)
+    _ = np.asarray(J[2]._data if hasattr(J[2], "_data") else J[2])
+    assert len(J._rows) == 1
+    _ = J[1:3]
+    assert len(J._rows) == 2  # row 2 cached, row 1 new
+    # hooks + create_graph (hessian) must unpack packed datas
+    calls = []
+
+    def pack(t):
+        calls.append("p")
+        return np.asarray(t._data)
+
+    def unpack(p):
+        calls.append("u")
+        return pt.to_tensor(p)
+
+    x2 = pt.to_tensor(np.array([1.0, 2.0], np.float32))
+    x2.stop_gradient = False
+    with pt.autograd.saved_tensors_hooks(pack, unpack):
+        y2 = pt.sum(x2 * x2 * x2)
+    H = np.asarray(pt.autograd.hessian(y2, x2)[:])
+    np.testing.assert_allclose(H, np.diag([6.0, 12.0]), atol=1e-5)
+    assert "p" in calls and "u" in calls

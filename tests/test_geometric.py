@@ -256,3 +256,29 @@ class TestIncubateLegacyAliases:
             paddle.incubate.identity_loss(x, "none").numpy(), x.numpy())
         with pytest.raises(Exception, match="Unsupported"):
             paddle.incubate.identity_loss(x, "bogus")
+
+
+def test_khop_eids_align_with_edges_and_empty_hops():
+    row = paddle.to_tensor(np.array([3, 7, 0, 9, 1, 4, 2, 9, 3, 9, 1, 9,
+                                     7], np.int64))
+    colptr = paddle.to_tensor(np.array([0, 2, 4, 5, 6, 7, 9, 11, 11, 13,
+                                        13], np.int64))
+    nodes = paddle.to_tensor(np.array([0, 8, 1, 2], np.int64))
+    eids = paddle.to_tensor(np.arange(13, dtype=np.int64))
+    es, ed, si, rn, ee = paddle.incubate.graph_khop_sampler(
+        row, colptr, nodes, [2, 2], sorted_eids=eids, return_eids=True)
+    es, ed, si, ee = (t.numpy() for t in (es, ed, si, ee))
+    rown = np.array([3, 7, 0, 9, 1, 4, 2, 9, 3, 9, 1, 9, 7])
+    cols = np.array([0, 2, 4, 5, 6, 7, 9, 11, 11, 13, 13])
+    assert len(ee) == len(es)
+    for s, d, e in zip(es, ed, ee):
+        # eid e must be a CSC position inside dst's column whose row
+        # entry is exactly src's original id
+        dst_orig, src_orig = si[d], si[s]
+        assert cols[dst_orig] <= e < cols[dst_orig + 1]
+        assert rown[e] == src_orig
+    # empty sample_sizes: seeds-only degenerate result, no crash
+    es0, ed0, si0, rn0 = paddle.incubate.graph_khop_sampler(
+        row, colptr, nodes, [])
+    assert len(es0.numpy()) == 0 and si0.numpy().tolist() == [0, 8, 1, 2]
+    assert rn0.numpy().tolist() == [0, 1, 2, 3]
