@@ -150,12 +150,17 @@ def black_list():
 
 # debugging helpers (ref: python/paddle/amp/debugging.py)
 def check_numerics(x, op_name="", debug_mode=None):
+    """Pass-through finiteness guard. The counting/reporting API is
+    :func:`paddle_tpu.amp.debugging.check_numerics` (ref
+    ``debugging.py:265``); this wrapper shares it rather than
+    re-implementing the scan, and returns ``x`` for chaining."""
     import jax
     from ..tensor import Tensor
     if isinstance(x, Tensor) and not isinstance(x._data, jax.core.Tracer):
-        bad = bool(jnp.any(~jnp.isfinite(x._data.astype(jnp.float32))))
-        if bad:
-            raise FloatingPointError(f"non-finite values after {op_name}")
+        from .debugging import DebugMode
+        from .debugging import check_numerics as _cn
+        _cn(x, op_name or "op", "x",
+            debug_mode or DebugMode.CHECK_NAN_INF_AND_ABORT)
     return x
 
 

@@ -26,7 +26,7 @@ __all__ = [
     "DebugMode", "TensorCheckerConfig", "enable_tensor_checker",
     "disable_tensor_checker", "enable_operator_stats_collection",
     "disable_operator_stats_collection", "collect_operator_stats",
-    "compare_accuracy",
+    "compare_accuracy", "check_numerics",
 ]
 
 
@@ -245,3 +245,31 @@ def compare_accuracy(layer, inputs, dtype="bfloat16", atol=1e-2, rtol=1e-2,
                   f"{r['max_abs_diff']:>12.3e}{r['mean_abs_diff']:>12.3e}"
                   f"{str(r['exceeds']):>9}")
     return rows
+
+
+def check_numerics(tensor, op_type, var_name,
+                   debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT):
+    """Count NaN/Inf/zero and report max/min/mean of ``tensor`` (ref
+    ``amp/debugging.py:265``). Returns (stats[3] int64, values[3]
+    float32); under CHECK_NAN_INF_AND_ABORT a non-finite tensor raises.
+    """
+    import jax.numpy as jnp
+    from ..tensor import Tensor
+    d = tensor._data if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    f = d.astype(jnp.float32)
+    n_nan = jnp.isnan(f).sum()
+    n_inf = jnp.isinf(f).sum()
+    n_zero = (f == 0).sum()
+    stats = jnp.stack([n_nan, n_inf, n_zero]).astype(jnp.int64)
+    finite = jnp.where(jnp.isfinite(f), f, jnp.nan)
+    values = jnp.stack([jnp.nanmax(finite), jnp.nanmin(finite),
+                        jnp.nanmean(finite)])
+    bad = int(n_nan) + int(n_inf)
+    if bad and debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+        raise FloatingPointError(
+            f"[check_numerics] op={op_type} var={var_name}: "
+            f"{int(n_nan)} NaN, {int(n_inf)} Inf")
+    if bad and debug_mode == DebugMode.CHECK_NAN_INF:
+        print(f"[check_numerics] op={op_type} var={var_name}: "
+              f"{int(n_nan)} NaN, {int(n_inf)} Inf")
+    return Tensor(stats), Tensor(values)

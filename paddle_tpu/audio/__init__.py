@@ -4,6 +4,7 @@ reference's default wave_backend)."""
 from . import functional as _func_mod
 from . import features  # noqa: F401
 from . import backends  # noqa: F401
+from . import datasets  # noqa: F401
 from .backends import info, load, save  # noqa: F401
 from .window import get_window  # noqa: F401
 
@@ -16,5 +17,5 @@ class functional:  # namespace mirroring paddle.audio.functional
     from .window import get_window  # noqa: F401
 
 
-__all__ = ["functional", "features", "get_window", "backends", "info",
+__all__ = ["functional", "features", "get_window", "backends", "datasets", "info",
            "load", "save"]

@@ -1,0 +1,14 @@
+"""``paddle.audio.datasets`` (ref:
+``python/paddle/audio/datasets/{dataset,tess,esc50}.py``): audio
+classification datasets over the framework Dataset protocol, with
+on-the-fly feature extraction through :mod:`paddle_tpu.audio.features`.
+
+``DATA_HOME`` honors the ``PADDLE_TPU_DATA_HOME`` env var so tests and
+offline machines can point at pre-extracted archives (zero-egress: the
+download only triggers when the directory is absent).
+"""
+from .dataset import AudioClassificationDataset  # noqa: F401
+from .esc50 import ESC50  # noqa: F401
+from .tess import TESS  # noqa: F401
+
+__all__ = ["AudioClassificationDataset", "TESS", "ESC50"]

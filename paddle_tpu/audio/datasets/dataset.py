@@ -1,0 +1,64 @@
+"""Base audio-classification dataset (ref:
+``python/paddle/audio/datasets/dataset.py``)."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ...io import Dataset
+from .. import features as _features
+
+DATA_HOME = os.environ.get(
+    "PADDLE_TPU_DATA_HOME",
+    os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                 "datasets"))
+
+feat_funcs = {
+    "raw": None,
+    "melspectrogram": _features.MelSpectrogram,
+    "mfcc": _features.MFCC,
+    "logmelspectrogram": _features.LogMelSpectrogram,
+    "spectrogram": _features.Spectrogram,
+}
+
+
+class AudioClassificationDataset(Dataset):
+    """(waveform-or-feature, label) pairs from audio files (ref
+    ``dataset.py AudioClassificationDataset``)."""
+
+    def __init__(self, files, labels, feat_type="raw", sample_rate=None,
+                 **kwargs):
+        super().__init__()
+        if feat_type not in feat_funcs:
+            raise RuntimeError(
+                f"Unknown feat_type: {feat_type}, it must be one in "
+                f"{list(feat_funcs)}")
+        self.files = list(files)
+        self.labels = list(labels)
+        self.feat_type = feat_type
+        self.sample_rate = sample_rate
+        self._feat_layer = None
+        self._feat_kwargs = kwargs
+
+    def _convert_to_record(self, idx):
+        from .. import backends
+        wav, sr = backends.load(self.files[idx])
+        wav = np.asarray(wav, np.float32)
+        if wav.ndim > 1:
+            wav = wav.mean(axis=0)  # mono
+        if self.feat_type == "raw":
+            return wav, self.labels[idx]
+        if self._feat_layer is None:
+            kw = dict(self._feat_kwargs)
+            kw.setdefault("sr", self.sample_rate or sr)
+            self._feat_layer = feat_funcs[self.feat_type](**kw)
+        from ...tensor import Tensor
+        feat = self._feat_layer(Tensor(wav[None, :]))
+        return np.asarray(feat._data)[0], self.labels[idx]
+
+    def __getitem__(self, idx):
+        return self._convert_to_record(idx)
+
+    def __len__(self):
+        return len(self.files)

@@ -1,0 +1,59 @@
+"""ESC-50 environmental sound dataset (ref:
+``python/paddle/audio/datasets/esc50.py:26``)."""
+from __future__ import annotations
+
+import collections
+import csv
+import os
+
+from .dataset import DATA_HOME, AudioClassificationDataset
+
+__all__ = ["ESC50"]
+
+
+class ESC50(AudioClassificationDataset):
+    """2000 5-second clips in 50 classes, 5 predefined folds; the meta
+    csv carries (filename, fold, target, ...)."""
+
+    archive = {
+        "url": "https://paddleaudio.bj.bcebos.com/datasets/ESC-50-master.zip",
+        "md5": "7771e4b9d86d0945acce719c7a59305a",
+    }
+    meta = os.path.join("ESC-50-master", "meta", "esc50.csv")
+    meta_info = collections.namedtuple(
+        "META_INFO",
+        ("filename", "fold", "target", "category", "esc10", "src_file",
+         "take"))
+    audio_path = os.path.join("ESC-50-master", "audio")
+
+    def __init__(self, mode="train", split=1, feat_type="raw",
+                 archive=None, **kwargs):
+        if split not in range(1, 6):
+            raise AssertionError(
+                f"The selected split should be 1 <= split <= 5, but got "
+                f"{split}")
+        if archive is not None:
+            self.archive = archive
+        files, labels = self._get_data(mode, split)
+        super().__init__(files=files, labels=labels, feat_type=feat_type,
+                         **kwargs)
+
+    def _get_meta_info(self):
+        with open(os.path.join(DATA_HOME, self.meta)) as f:
+            rows = list(csv.reader(f))
+        return [self.meta_info(*r[:7]) for r in rows[1:]]
+
+    def _get_data(self, mode, split):
+        if not os.path.isdir(os.path.join(DATA_HOME, self.audio_path)) \
+                or not os.path.isfile(os.path.join(DATA_HOME, self.meta)):
+            from ...utils.download import get_path_from_url
+            get_path_from_url(self.archive["url"], DATA_HOME,
+                              self.archive["md5"], decompress=True)
+        files, labels = [], []
+        for sample in self._get_meta_info():
+            dev = int(sample.fold) == split
+            if (mode == "train") != dev:
+                files.append(os.path.join(DATA_HOME, self.audio_path,
+                                          sample.filename))
+                labels.append(int(sample.target))
+        return files, labels

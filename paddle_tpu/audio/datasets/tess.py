@@ -1,0 +1,68 @@
+"""TESS emotional speech dataset (ref:
+``python/paddle/audio/datasets/tess.py:26``)."""
+from __future__ import annotations
+
+import collections
+import os
+
+from .dataset import DATA_HOME, AudioClassificationDataset
+
+__all__ = ["TESS"]
+
+
+class TESS(AudioClassificationDataset):
+    """Toronto Emotional Speech Set: 2800 clips, 7 emotions, filenames
+    ``<speaker>_<word>_<emotion>.wav`` under one directory. Fold split:
+    every ``n_folds``-th sample (round-robin) is the dev fold."""
+
+    archive = {
+        "url": ("https://bj.bcebos.com/paddleaudio/datasets/"
+                "TESS_Toronto_emotional_speech_set.zip"),
+        "md5": "1465311b24d1de704c4c63e4ccc470c7",
+    }
+    label_list = ["angry", "disgust", "fear", "happy", "neutral", "ps",
+                  "sad"]
+    meta_info = collections.namedtuple("META_INFO",
+                                       ("speaker", "word", "emotion"))
+    audio_path = "TESS_Toronto_emotional_speech_set"
+
+    def __init__(self, mode="train", n_folds=5, split=1, feat_type="raw",
+                 archive=None, **kwargs):
+        if not (isinstance(n_folds, int) and n_folds >= 1):
+            raise AssertionError(
+                f"the n_folds should be integer and n_folds >= 1, but "
+                f"got {n_folds}")
+        if split not in range(1, n_folds + 1):
+            raise AssertionError(
+                f"The selected split should be integer and should be "
+                f"1 <= split <= {n_folds}, but got {split}")
+        if archive is not None:
+            self.archive = archive
+        files, labels = self._get_data(mode, n_folds, split)
+        super().__init__(files=files, labels=labels, feat_type=feat_type,
+                         **kwargs)
+
+    def _get_meta_info(self, files):
+        return [self.meta_info(*os.path.basename(f)[:-4].split("_"))
+                for f in files]
+
+    def _get_data(self, mode, n_folds, split):
+        root = os.path.join(DATA_HOME, self.audio_path)
+        if not os.path.isdir(root):
+            from ...utils.download import get_path_from_url
+            get_path_from_url(self.archive["url"], DATA_HOME,
+                              self.archive["md5"], decompress=True)
+        wav_files = sorted(
+            os.path.join(base, f)
+            for base, _, fs in os.walk(root)
+            for f in fs if f.lower().endswith(".wav"))
+        files, labels = [], []
+        for i, f in enumerate(wav_files):
+            fold = i % n_folds + 1
+            if (mode == "train") == (fold != split):
+                emotion = os.path.basename(f)[:-4].split("_")[-1].lower()
+                if emotion not in self.label_list:
+                    continue
+                files.append(f)
+                labels.append(self.label_list.index(emotion))
+        return files, labels

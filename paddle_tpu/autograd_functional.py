@@ -45,9 +45,11 @@ class Jacobian:
     def shape(self):
         ys, xs = self._ys, self._xs
         if self._batch:
-            return [ys.shape[0], int(np.prod(ys.shape[1:]) or 1),
-                    int(np.prod(xs.shape[1:]) or 1)]
-        return [int(np.prod(ys.shape) or 1), int(np.prod(xs.shape) or 1)]
+            # np.prod(()) == 1 covers scalars; a genuine 0-size dim
+            # must stay 0, not be coerced to 1
+            return [ys.shape[0], int(np.prod(ys.shape[1:])),
+                    int(np.prod(xs.shape[1:]))]
+        return [int(np.prod(ys.shape)), int(np.prod(xs.shape))]
 
     def _n_rows(self):
         return self.shape[1] if self._batch else self.shape[0]
@@ -101,6 +103,10 @@ class Jacobian:
             return None  # row axis untouched by the index -> all rows
         r = parts[row_pos]
         if isinstance(r, int):
+            if not -M <= r < M:
+                raise IndexError(
+                    f"row index {r} out of range for Jacobian with {M} "
+                    f"rows")
             return [r % M]
         if isinstance(r, slice):
             return list(range(*r.indices(M)))
@@ -160,6 +166,11 @@ def hessian(ys, xs, batch_axis=None):
         raise ValueError("hessian expects a single scalar ys")
     if _is_seq(xs):
         # symmetric block structure: row blocks d/dx_i of grads wrt x_j
+        n = int(np.prod(ys.shape))
+        expect = ys.shape[0] if batch_axis == 0 else 1
+        if n != expect:
+            raise ValueError("hessian requires scalar ys (one value per "
+                             f"batch element); got shape {list(ys.shape)}")
         from .autograd import grad
         gs = grad(ys, list(xs), retain_graph=True, create_graph=True)
         return tuple(tuple(Jacobian(g, x, batch_axis) for x in xs)

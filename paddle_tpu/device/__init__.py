@@ -12,7 +12,11 @@ from .plugin import (  # noqa: F401
 __all__ = ["set_device", "get_device", "get_all_devices", "device_count",
            "is_compiled_with_cuda", "is_compiled_with_tpu", "cuda",
            "get_available_device", "get_available_custom_device",
-           "load_custom_runtime_lib", "load_custom_device_plugins"]
+           "load_custom_runtime_lib", "load_custom_device_plugins",
+           "get_cudnn_version", "IPUPlace", "is_compiled_with_ipu",
+           "get_all_device_type", "get_all_custom_device_type",
+           "Stream", "Event", "current_stream", "set_stream",
+           "stream_guard", "synchronize"]
 
 
 def get_available_device():
@@ -58,3 +62,124 @@ class cuda:
             return stats.get("bytes_in_use", 0)
         except Exception:
             return 0
+
+
+# -- stream/event surface (ref device/__init__.py:410-877) ---------------
+# XLA owns scheduling on TPU: one ordered stream per device, host-side
+# synchronization is a block_until_ready. These objects keep the API so
+# CUDA-era scripts run; "waiting" degrades to full-device sync.
+
+def get_cudnn_version():
+    """ref ``device/__init__.py``: None when not built with cuDNN."""
+    return None
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+class IPUPlace:
+    def __init__(self):
+        raise RuntimeError("paddle_tpu is not compiled with IPU support")
+
+
+def get_all_device_type():
+    """ref: device types this build can drive (the jax platform name —
+    a gpu backend must not masquerade as tpu)."""
+    import jax
+    kinds = {"cpu"}
+    try:
+        for d in jax.devices():
+            kinds.add(d.platform)
+    except Exception:
+        pass
+    return sorted(kinds)
+
+
+def get_all_custom_device_type():
+    return [t for t in get_all_device_type() if t not in ("cpu", "gpu")]
+
+
+class Event:
+    """ref ``device/__init__.py:410``. Records a point in the device
+    timeline; on XLA the only observable point is "everything submitted
+    so far is done", via synchronize."""
+
+    def __init__(self, device=None, enable_timing=False, blocking=False,
+                 interprocess=False):
+        self._recorded = False
+
+    def record(self, stream=None):
+        self._recorded = True
+
+    def query(self):
+        return True  # XLA execution is ordered; nothing is "pending"
+
+    def synchronize(self):
+        synchronize()
+
+
+class Stream:
+    """ref ``device/__init__.py:555``. XLA has one compute stream per
+    chip; this object exists so stream-annotated code runs unchanged."""
+
+    def __init__(self, device=None, priority=2, blocking=False):
+        self.device = device
+
+    def record_event(self, event=None):
+        ev = event or Event()
+        ev.record(self)
+        return ev
+
+    def wait_event(self, event):
+        synchronize()
+
+    def wait_stream(self, stream):
+        synchronize()
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+_current_stream = Stream()
+
+
+def current_stream(device=None):
+    return _current_stream
+
+
+def set_stream(stream):
+    global _current_stream
+    prev = _current_stream
+    _current_stream = stream
+    return prev
+
+
+class stream_guard:
+    def __init__(self, stream):
+        self._stream = stream
+
+    def __enter__(self):
+        self._prev = set_stream(self._stream)
+        return self._stream
+
+    def __exit__(self, *exc):
+        set_stream(self._prev)
+        return False
+
+
+def synchronize(device=None):
+    """Block until every submitted computation finished (ref
+    ``device/__init__.py:877``). XLA dispatch is async and ORDERED per
+    device, so joining on a fresh trailing computation joins everything
+    submitted before it (same pattern as ``cuda.synchronize``);
+    effects_barrier additionally joins effectful ones."""
+    import jax
+    try:
+        jax.effects_barrier()
+    except Exception:
+        pass
+    (jax.device_put(0) + 0).block_until_ready()

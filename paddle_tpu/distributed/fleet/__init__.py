@@ -14,4 +14,11 @@ from ..topology import HybridCommunicateGroup, CommunicateTopology  # noqa: F401
 from . import recompute as _recompute_mod  # noqa: F401
 from .recompute import recompute  # noqa: F401
 from . import utils  # noqa: F401
+from .role_maker import (  # noqa: F401
+    PaddleCloudRoleMaker, Role, UserDefinedRoleMaker,
+)
+from .data_generator import (  # noqa: F401
+    DataGenerator, MultiSlotDataGenerator, MultiSlotStringDataGenerator,
+)
+from .util import UtilBase  # noqa: F401
 from . import meta_parallel  # noqa: F401

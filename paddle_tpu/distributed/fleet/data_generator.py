@@ -1,0 +1,98 @@
+"""Fleet data generators (ref:
+``python/paddle/distributed/fleet/data_generator/data_generator.py``):
+the PRODUCER side of the MultiSlot pipe contract — a generator script
+reads raw lines on stdin and writes ``<n> v1 ... vn`` slot text on
+stdout, which :class:`~paddle_tpu.distributed.fleet.dataset
+.QueueDataset`'s ``pipe_command`` consumes."""
+from __future__ import annotations
+
+import sys
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]
+
+
+class DataGenerator:
+    def __init__(self):
+        self.batch_size_ = 32
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    # -- user hooks --------------------------------------------------------
+    def generate_sample(self, line):
+        """Return a local_iter() yielding (slot_name, values) tuples for
+        one raw input line (ref ``data_generator.py:171``)."""
+        raise NotImplementedError(
+            "Please rewrite this function to return a list or tuple: " +
+            "[(name, [feasign, ...]), ...] or ((name, [feasign, ...]), ...)")
+
+    def generate_batch(self, samples):
+        """Optional batch-level rewrite (ref ``:205``); defaults to
+        yielding each sample unchanged."""
+        def local_iter():
+            for sample in samples:
+                yield sample
+        return local_iter
+
+    # -- drivers -----------------------------------------------------------
+    def _run(self, lines, out=None):
+        out = out or sys.stdout
+        batch = []
+
+        def flush(batch):
+            for sample in self.generate_batch(batch)():
+                out.write(self._gen_str(sample))
+
+        for line in lines:
+            it = self.generate_sample(line)
+            for parsed in it():
+                if parsed is None:
+                    continue
+                batch.append(parsed)
+                if len(batch) == self.batch_size_:
+                    flush(batch)
+                    batch = []
+        if batch:
+            flush(batch)
+
+    def run_from_memory(self):
+        self._run([None])
+
+    def run_from_stdin(self):
+        self._run(sys.stdin)
+
+    def _gen_str(self, line):
+        raise NotImplementedError(
+            "Please inherit MultiSlotDataGenerator or "
+            "MultiSlotStringDataGenerator to implement _gen_str")
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """Values are already strings (ref ``data_generator.py:239``)."""
+
+    def _gen_str(self, line):
+        if not isinstance(line, (list, tuple)):
+            raise ValueError(
+                "the output of process() must be in list or tuple type")
+        out = ""
+        for name, elements in line:
+            out += str(len(elements)) + " " + " ".join(elements) + " "
+        return out.strip() + "\n"
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Values are ints/floats, validated (ref ``:284``)."""
+
+    def _gen_str(self, line):
+        if not isinstance(line, (list, tuple)):
+            raise ValueError(
+                "the output of process() must be in list or tuple type")
+        out = ""
+        for name, elements in line:
+            if not elements:
+                raise ValueError(
+                    f"the elements of slot {name} are empty")
+            out += str(len(elements)) + " " + " ".join(
+                str(x) for x in elements) + " "
+        return out.strip() + "\n"

@@ -1,0 +1,57 @@
+"""Fleet util (ref:
+``python/paddle/distributed/fleet/base/util_factory.py:49 UtilBase``):
+job-level helpers — collective reductions over worker scalars, file
+sharding across workers, rank-scoped printing."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["UtilBase"]
+
+
+class UtilBase:
+    def __init__(self, role_maker=None):
+        self.role_maker = role_maker
+
+    def _worker(self):
+        from .fleet import worker_index, worker_num
+        if self.role_maker is not None:
+            return (self.role_maker.worker_index(),
+                    self.role_maker.worker_num())
+        return worker_index(), worker_num()
+
+    # -- collectives over host scalars (ref util_factory all_reduce) -------
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        from ..collective import all_reduce as _ar, ReduceOp
+        from ...tensor import Tensor
+        op = {"sum": ReduceOp.SUM, "max": ReduceOp.MAX,
+              "min": ReduceOp.MIN}[mode]
+        t = Tensor(np.asarray(input))
+        _ar(t, op=op)
+        return np.asarray(t._data)
+
+    def all_gather(self, input, comm_world="worker"):
+        from ..collective import all_gather_object
+        return all_gather_object(input)
+
+    def barrier(self, comm_world="worker"):
+        from ..collective import barrier
+        barrier()
+
+    # -- file sharding (ref util_factory get_file_shard) -------------------
+    def get_file_shard(self, files):
+        """Split ``files`` contiguously across workers; earlier workers
+        take the remainder (the reference's blocking split)."""
+        if not isinstance(files, list):
+            raise TypeError("files should be a list of file need to be read.")
+        idx, n = self._worker()
+        per, rem = divmod(len(files), n)
+        begin = idx * per + min(idx, rem)
+        return files[begin:begin + per + (1 if idx < rem else 0)]
+
+    def print_on_rank(self, message, rank_id):
+        idx, _ = self._worker()
+        if idx == rank_id:
+            print(message)

@@ -9,7 +9,23 @@ import jax.numpy as jnp
 
 from ...tensor import Tensor
 
-__all__ = ["jvp", "vjp", "Jacobian", "Hessian", "forward_grad", "grad"]
+__all__ = ["jvp", "vjp", "Jacobian", "Hessian", "forward_grad", "grad",
+           "enable_prim", "disable_prim"]
+
+# primitive-mode toggles (ref incubate/autograd/primapi.py): the
+# reference lowers ops to primitive ops for higher-order AD; jax traces
+# are already primitive-level, so the switch only records intent
+_prim_enabled = False
+
+
+def enable_prim():
+    global _prim_enabled
+    _prim_enabled = True
+
+
+def disable_prim():
+    global _prim_enabled
+    _prim_enabled = False
 
 
 def _fn_over_arrays(func):

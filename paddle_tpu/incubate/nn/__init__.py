@@ -12,5 +12,9 @@ from .fused_transformer import (  # noqa: F401
     FusedMultiHeadAttention, FusedFeedForward, FusedTransformerEncoderLayer,
     FusedMultiTransformer,
 )
+from .fused_extras import (  # noqa: F401
+    FusedBiasDropoutResidualLayerNorm, FusedDropoutAdd, FusedEcMoe,
+    FusedLinear,
+)
 from . import functional  # noqa: F401
 from .memory_efficient_attention import memory_efficient_attention  # noqa: F401

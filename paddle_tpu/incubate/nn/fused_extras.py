@@ -1,0 +1,159 @@
+"""Remaining fused layers (ref: ``python/paddle/incubate/nn/layer/
+{fused_linear,fused_dropout_add,fused_ec_moe,fused_transformer}.py``).
+
+"Fused" on TPU = one XLA fusion region: each layer is a single jnp
+composition the compiler fuses, replacing the reference's hand-written
+CUDA fusion kernels (``paddle/phi/kernels/fusion/``).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...nn import Layer, functional as F
+from ...ops.op_utils import ensure_tensor, nary
+from ...framework import random as _random
+
+__all__ = ["FusedLinear", "FusedDropoutAdd", "FusedEcMoe",
+           "FusedBiasDropoutResidualLayerNorm"]
+
+
+class FusedLinear(Layer):
+    """Linear whose matmul+bias lower as one fused op (ref
+    ``fused_linear.py:19``); ``transpose_weight`` stores W^T."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self.transpose_weight = transpose_weight
+        shape = [out_features, in_features] if transpose_weight else \
+            [in_features, out_features]
+        self.weight = self.create_parameter(shape=shape, attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            shape=[out_features], attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        args = [ensure_tensor(x), self.weight]
+        if self.bias is not None:
+            args.append(self.bias)
+        tw = self.transpose_weight
+
+        def f(xd, wd, *rest):
+            w = wd.T if tw else wd
+            y = xd @ w
+            return y + rest[0] if rest else y
+        return nary(f, args, name="fused_linear")
+
+
+class FusedDropoutAdd(Layer):
+    """dropout(x) + y in one region (ref ``fused_dropout_add.py:19``)."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        if mode not in ("upscale_in_train", "downscale_in_infer"):
+            raise ValueError(f"mode {mode!r} is not supported")
+        self.p = float(p)
+        self.mode = mode
+
+    def forward(self, x, y):
+        x, y = ensure_tensor(x), ensure_tensor(y)
+        if self.p == 0.0 or not self.training:
+            if self.mode == "downscale_in_infer" and not self.training:
+                return nary(lambda a, b: a * (1 - self.p) + b, [x, y],
+                            name="fused_dropout_add")
+            return nary(lambda a, b: a + b, [x, y],
+                        name="fused_dropout_add")
+        key = _random.next_key()
+
+        def f(a, b):
+            keep = jax.random.bernoulli(key, 1.0 - self.p, a.shape)
+            scale = 1.0 / (1.0 - self.p) if \
+                self.mode == "upscale_in_train" else 1.0
+            return jnp.where(keep, a * scale, 0.0).astype(a.dtype) + b
+        return nary(f, [x, y], name="fused_dropout_add")
+
+    def extra_repr(self):
+        return f"p={self.p}, mode={self.mode}"
+
+
+class FusedEcMoe(Layer):
+    """Expert-choice MoE FFN with stacked expert weights — the whole
+    gate-softmax + two batched matmuls run as one region (ref
+    ``fused_ec_moe.py:19``)."""
+
+    def __init__(self, hidden_size, inter_size, num_experts, act_type,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        if act_type not in ("gelu", "relu"):
+            raise ValueError("act_type must be 'gelu' or 'relu'")
+        self.act_type = act_type
+        self.bmm_weight0 = self.create_parameter(
+            shape=[num_experts, hidden_size, inter_size], attr=weight_attr)
+        self.bmm_bias0 = self.create_parameter(
+            shape=[num_experts, 1, inter_size], attr=bias_attr,
+            is_bias=True)
+        self.bmm_weight1 = self.create_parameter(
+            shape=[num_experts, inter_size, hidden_size], attr=weight_attr)
+        self.bmm_bias1 = self.create_parameter(
+            shape=[num_experts, 1, hidden_size], attr=bias_attr,
+            is_bias=True)
+
+    def forward(self, x, gate):
+        act = jax.nn.gelu if self.act_type == "gelu" else jax.nn.relu
+
+        def f(xd, gd, w0, b0, w1, b1):
+            # xd: (B, S, H); gd: (B, S, E) gate logits
+            probs = jax.nn.softmax(gd.astype(jnp.float32), axis=-1) \
+                .astype(xd.dtype)
+            h = jnp.einsum("bsh,ehi->besi", xd, w0) + b0[None]
+            h = act(h)
+            o = jnp.einsum("besi,eih->besh", h, w1) + b1[None]
+            return jnp.einsum("besh,bse->bsh", o, probs)
+        return nary(f, [ensure_tensor(x), ensure_tensor(gate),
+                        self.bmm_weight0, self.bmm_bias0,
+                        self.bmm_weight1, self.bmm_bias1],
+                    name="fused_ec_moe")
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """layer_norm(residual + dropout(x + bias)) in one region (ref
+    ``fused_transformer.py:83``)."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        if embed_dim <= 0:
+            raise ValueError("embed_dim must be positive")
+        self.embed_dim = embed_dim
+        self.dropout_rate = float(dropout_rate)
+        self._epsilon = epsilon
+        self.linear_bias = self.create_parameter(
+            shape=[embed_dim], attr=bias_attr, is_bias=True)
+        self.ln_scale = self.create_parameter(
+            shape=[embed_dim], attr=weight_attr, default_initializer=None)
+        import numpy as np
+        self.ln_scale.set_value(np.ones([embed_dim], np.float32))
+        self.ln_bias = self.create_parameter(
+            shape=[embed_dim], attr=bias_attr, is_bias=True)
+
+    def forward(self, x, residual):
+        p = self.dropout_rate if self.training else 0.0
+        key = _random.next_key() if p > 0 else None
+        eps = self._epsilon
+
+        def f(xd, rd, b, g, lb):
+            h = xd + b
+            if key is not None:
+                keep = jax.random.bernoulli(key, 1.0 - p, h.shape)
+                h = jnp.where(keep, h / (1.0 - p), 0.0).astype(h.dtype)
+            h = rd + h
+            mu = h.mean(-1, keepdims=True)
+            var = ((h - mu) ** 2).mean(-1, keepdims=True)
+            return (h - mu) / jnp.sqrt(var + eps) * g + lb
+        return nary(f, [ensure_tensor(x), ensure_tensor(residual),
+                        self.linear_bias, self.ln_scale, self.ln_bias],
+                    name="fused_bias_dropout_residual_layer_norm")
+
+    def extra_repr(self):
+        return (f"embed_dim={self.embed_dim}, seq_len=None, "
+                f"dropout_rate={self.dropout_rate}, epsilon={self._epsilon}")

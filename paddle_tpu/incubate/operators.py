@@ -91,7 +91,8 @@ def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
 
     frontier = ensure_tensor(input_nodes)
     seeds_np = np.asarray(frontier._data).ravel()
-    if not list(sample_sizes):  # degenerate: seeds only, no edges
+    sample_sizes = list(sample_sizes)  # may be a one-shot iterator
+    if not sample_sizes:  # degenerate: seeds only, no edges
         empty = Tensor(jnp.asarray(np.zeros((0,), seeds_np.dtype)))
         out_nodes = Tensor(jnp.asarray(seeds_np))
         reindex_nodes = Tensor(jnp.asarray(
@@ -100,7 +101,7 @@ def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
         return out + (empty,) if return_eids else out
     all_neighbors, all_counts, all_eids = [], [], []
     centers = []
-    for hop, size in enumerate(list(sample_sizes)):
+    for hop, size in enumerate(sample_sizes):
         res = sample_neighbors(row, colptr, frontier,
                                sample_size=int(size),
                                eids=sorted_eids,

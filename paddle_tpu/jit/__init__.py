@@ -3,3 +3,29 @@ from .api import (to_static, not_to_static, StaticFunction, InputSpec,  # noqa: 
                   functional_call, enable_static, disable_static,
                   in_dynamic_mode, ignore_module)
 from .save_load import save, load, TranslatedLayer  # noqa: F401
+
+
+# -- debugging toggles (ref python/paddle/jit/dy2static/logging_utils.py)
+# the flags live in jit.api (the only reader); these are the setters
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Log transformed code up to ``level`` (ref ``jit.set_code_level``).
+    Trace-based to_static has no source transform stages; at level>0
+    StaticFunction prints its traced jaxpr on build."""
+    from . import api as _api
+    _api._code_level = int(level)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """ref ``jit.set_verbosity``."""
+    from . import api as _api
+    _api._verbosity = int(level)
+
+
+def enable_to_static(enable=True):
+    """Globally toggle to_static compilation (ref
+    ``jit.enable_to_static``): when off, decorated functions run eagerly
+    (the dygraph fallback the reference provides for debugging)."""
+    from . import api as _api
+    _api._to_static_enabled = bool(enable)

@@ -187,6 +187,11 @@ def _loaded_global_names(code):
     return tuple(names)
 
 
+_to_static_enabled = True
+_code_level = 0
+_verbosity = 0
+
+
 class StaticFunction:
     """Compiled callable (ref: ``dy2static/program_translator.py:305``)."""
 
@@ -259,6 +264,11 @@ class StaticFunction:
                                    "training"))
 
     def __call__(self, *args, **kwargs):
+        if not _to_static_enabled:
+            # jit.enable_to_static(False): run the original dygraph code
+            # (the reference's debugging fallback); _orig_fn is already
+            # bound when wrapping a Layer's forward
+            return self._orig_fn(*args, **kwargs)
         if self._jitted is None:
             self._build()
         layer = self._layer

@@ -17,4 +17,5 @@ from .clip import (ClipGradByValue, ClipGradByNorm,  # noqa: F401
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
 from . import utils  # noqa: F401
+from . import quant  # noqa: F401
 from .layer import layers  # noqa: F401

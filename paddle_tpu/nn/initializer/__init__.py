@@ -16,6 +16,7 @@ from ...framework import random as _random
 from ...tensor import Tensor
 
 __all__ = [
+    "Bilinear",
     "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
     "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
     "Assign", "Orthogonal", "Dirac", "calculate_gain", "set_global_initializer",
@@ -212,3 +213,27 @@ def calculate_gain(nonlinearity, param=None):
     if nonlinearity == "selu":
         return 3.0 / 4.0
     raise ValueError(f"unknown nonlinearity {nonlinearity}")
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel initializer for transposed conv
+    weights (ref ``nn/initializer/Bilinear.py``): weight[c, 0, i, j] is
+    the separable triangle kernel value, so a stride-f Conv2DTranspose
+    initialised with it performs bilinear upsampling by factor f."""
+
+    def __call__(self, shape, dtype=jnp.float32):
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer requires a 4-D "
+                             f"(C_out, C_in/groups, K, K) shape; got {shape}")
+        import numpy as np
+        k_h, k_w = shape[-2], shape[-1]
+        f_h, f_w = (k_h + 1) // 2, (k_w + 1) // 2
+        c_h = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h)
+        c_w = (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
+        i = np.arange(k_h)[:, None]
+        j = np.arange(k_w)[None, :]
+        kern = ((1 - np.abs(i / f_h - c_h))
+                * (1 - np.abs(j / f_w - c_w))).astype(np.float32)
+        w = np.zeros(shape, np.float32)
+        w[...] = kern  # every (c_out, c_in) channel pair gets the kernel
+        return jnp.asarray(w).astype(dtype)

@@ -1,0 +1,22 @@
+"""``paddle.nn.quant`` (ref: ``python/paddle/nn/quant/``): layer-side
+quantization helpers. The working PTQ/QAT machinery lives in
+:mod:`paddle_tpu.quantization`; this module carries the layer-facing
+``Stub`` placeholder (the only name the reference exports here)."""
+from __future__ import annotations
+
+from ..layer.layers import Layer
+
+__all__ = ["Stub"]
+
+
+class Stub(Layer):
+    """Observer placeholder (ref ``nn/quant/stub.py:20``): inserted in a
+    forward where a functional API needs quantization; the QAT/PTQ pass
+    replaces it with the configured observer. Identity until then."""
+
+    def __init__(self, observer=None):
+        super().__init__()
+        self._observer = observer
+
+    def forward(self, input):
+        return input

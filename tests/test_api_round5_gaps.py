@@ -1,0 +1,207 @@
+"""Round-5 API-surface closures: device stream shims, jit toggles,
+check_numerics, Bilinear initializer, fused incubate layers, fleet
+role-makers/data-generators/util, resnext variants, nn.quant.Stub
+(refs in each implementation's docstring)."""
+import io
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+class TestDeviceShims:
+    def test_stream_event_surface(self):
+        d = pt.device
+        s = d.current_stream()
+        e = s.record_event()
+        assert e.query() and s.query()
+        e.synchronize()
+        s.wait_event(e)
+        with d.stream_guard(d.Stream()) as g:
+            assert d.current_stream() is g
+        d.synchronize()
+        assert d.get_cudnn_version() is None
+        assert d.is_compiled_with_ipu() is False
+        assert "cpu" in d.get_all_device_type()
+        with pytest.raises(RuntimeError):
+            d.IPUPlace()
+
+
+def test_jit_toggles_and_eager_fallback():
+    import paddle_tpu.jit as jit
+
+    class M(pt.nn.Layer):
+        def forward(self, x):
+            return x * 3.0
+
+    f = jit.to_static(M())
+    x = pt.to_tensor(np.ones(2, np.float32))
+    y_compiled = f(x).numpy()
+    jit.enable_to_static(False)
+    try:
+        y_eager = f(x).numpy()
+    finally:
+        jit.enable_to_static(True)
+    np.testing.assert_allclose(y_compiled, y_eager)
+    jit.set_code_level(50)
+    jit.set_verbosity(1)
+
+
+def test_check_numerics_counts_and_abort():
+    dbg = pt.amp.debugging
+    t = pt.to_tensor(np.array([1.0, np.inf, 0.0, -2.0], np.float32))
+    stats, values = dbg.check_numerics(t, "op", "x",
+                                       dbg.DebugMode.CHECK_NAN_INF)
+    assert np.asarray(stats._data).tolist() == [0, 1, 1]
+    np.testing.assert_allclose(np.asarray(values._data),
+                               [1.0, -2.0, -1.0 / 3.0], atol=1e-6)
+    with pytest.raises(FloatingPointError):
+        dbg.check_numerics(pt.to_tensor(np.array([np.nan], np.float32)),
+                           "op", "x")
+
+
+def test_bilinear_initializer_upsamples_exactly():
+    init = pt.nn.initializer.Bilinear()
+    w = init((1, 1, 4, 4))
+    conv = pt.nn.Conv2DTranspose(1, 1, kernel_size=4, padding=1, stride=2,
+                                 bias_attr=False)
+    conv.weight.set_value(np.asarray(w))
+    # a linear ramp upsamples to a linear ramp (interior exactness)
+    x = np.arange(4, dtype=np.float32)[None, None, None, :].repeat(4, 2)
+    y = conv(pt.to_tensor(x)).numpy()[0, 0]
+    row = y[4]
+    np.testing.assert_allclose(row[1:-1], np.arange(0.25, 3.26, 0.5)[:6],
+                               atol=1e-5)
+
+
+class TestFusedExtras:
+    def test_fused_linear_matches_plain(self):
+        from paddle_tpu.incubate.nn import FusedLinear
+        pt.seed(0)
+        fl = FusedLinear(6, 3)
+        x = pt.to_tensor(np.random.RandomState(0)
+                         .randn(4, 6).astype(np.float32))
+        ref = x.numpy() @ fl.weight.numpy() + fl.bias.numpy()
+        np.testing.assert_allclose(fl(x).numpy(), ref, atol=1e-5)
+        flt = FusedLinear(6, 3, transpose_weight=True)
+        assert tuple(flt.weight.shape) == (3, 6)
+        assert tuple(flt(x).shape) == (4, 3)
+
+    def test_fused_dropout_add_modes(self):
+        from paddle_tpu.incubate.nn import FusedDropoutAdd
+        a = pt.to_tensor(np.ones((8, 8), np.float32))
+        b = pt.to_tensor(np.full((8, 8), 2.0, np.float32))
+        da = FusedDropoutAdd(p=0.5)
+        da.eval()
+        np.testing.assert_allclose(da(a, b).numpy(), 3.0)
+        da.train()
+        out = da(a, b).numpy()
+        assert set(np.unique(out.round(2))) <= {2.0, 4.0}
+        di = FusedDropoutAdd(p=0.5, mode="downscale_in_infer")
+        di.eval()
+        np.testing.assert_allclose(di(a, b).numpy(), 2.5)
+        with pytest.raises(ValueError):
+            FusedDropoutAdd(mode="bogus")
+
+    def test_fused_ec_moe_and_bias_dropout_ln(self):
+        from paddle_tpu.incubate.nn import (
+            FusedBiasDropoutResidualLayerNorm, FusedEcMoe)
+        pt.seed(1)
+        moe = FusedEcMoe(8, 16, 4, "gelu")
+        x = pt.to_tensor(np.random.RandomState(1)
+                         .randn(2, 3, 8).astype(np.float32))
+        g = pt.to_tensor(np.random.RandomState(2)
+                         .randn(2, 3, 4).astype(np.float32))
+        g.stop_gradient = False
+        out = moe(x, g)
+        assert tuple(out.shape) == (2, 3, 8)
+        out.sum().backward()
+        assert g.grad is not None  # gate is differentiable
+        ln = FusedBiasDropoutResidualLayerNorm(8, dropout_rate=0.0)
+        r = pt.to_tensor(np.random.RandomState(3)
+                         .randn(2, 3, 8).astype(np.float32))
+        z = ln(x, r).numpy()
+        assert abs(z.mean(-1)).max() < 1e-4
+        with pytest.raises(ValueError):
+            FusedEcMoe(8, 16, 4, "tanh")
+
+
+class TestFleetRoleMakerUtil:
+    def test_paddlecloud_role_from_env(self, monkeypatch):
+        from paddle_tpu.distributed.fleet import (PaddleCloudRoleMaker,
+                                                  Role)
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "4")
+        monkeypatch.setenv("PADDLE_TRAINER_ENDPOINTS",
+                           "a:1,b:2,c:3,d:4")
+        rm = PaddleCloudRoleMaker()
+        assert rm.is_worker() and not rm.is_server()
+        assert rm.worker_index() == 2 and rm.worker_num() == 4
+        assert not rm.is_first_worker()
+        assert len(rm.get_trainer_endpoints()) == 4
+        assert Role.SERVER == 2
+
+    def test_user_defined_role_and_file_shard(self):
+        from paddle_tpu.distributed.fleet import (UserDefinedRoleMaker,
+                                                  UtilBase)
+        rm = UserDefinedRoleMaker(current_id=1, worker_num=3)
+        util = UtilBase(rm)
+        files = [f"f{i}" for i in range(8)]  # 8 files over 3 workers
+        shard = util.get_file_shard(files)
+        assert shard == ["f3", "f4", "f5"]
+        all_files = []
+        for wid in range(3):
+            u = UtilBase(UserDefinedRoleMaker(current_id=wid,
+                                              worker_num=3))
+            all_files += u.get_file_shard(files)
+        assert all_files == files  # partition: no loss, no overlap
+
+    def test_data_generator_produces_dataset_food(self, tmp_path):
+        from paddle_tpu.distributed.fleet import MultiSlotDataGenerator
+        import paddle_tpu.distributed as dist
+
+        class Gen(MultiSlotDataGenerator):
+            def generate_sample(self, line):
+                def local_iter():
+                    a, b = line.split("|")
+                    yield [("dense", [float(x) for x in a.split()]),
+                           ("ids", [int(x) for x in b.split()])]
+                return local_iter
+
+        gen = Gen()
+        buf = io.StringIO()
+        gen._run(["1.0 2.0|7 8", "3.0 4.0|9"], out=buf)
+        path = str(tmp_path / "gen.txt")
+        with open(path, "w") as f:
+            f.write(buf.getvalue())
+
+        class _V:
+            def __init__(self, name, dtype, shape=None):
+                self.name, self.dtype, self.shape = name, dtype, shape
+
+        ds = dist.QueueDataset()
+        ds.init(batch_size=2, use_var=[_V("dense", "float32", [-1, 2]),
+                                       _V("ids", "int64")],
+                pipe_command="cat")
+        ds.set_filelist([path])
+        (batch,) = list(ds)
+        np.testing.assert_allclose(batch["dense"],
+                                   [[1.0, 2.0], [3.0, 4.0]])
+        assert [a.tolist() for a in batch["ids"]] == [[7, 8], [9]]
+
+
+def test_resnext_variants_forward():
+    for name in ("resnext50_64x4d", "resnext101_32x4d",
+                 "resnext152_32x4d", "resnext152_64x4d"):
+        assert hasattr(pt.vision.models, name)
+    m = pt.vision.models.resnext50_64x4d(num_classes=7)
+    out = m(pt.to_tensor(np.random.RandomState(0)
+                         .randn(1, 3, 32, 32).astype(np.float32)))
+    assert tuple(out.shape) == (1, 7)
+
+
+def test_nn_quant_stub_identity():
+    s = pt.nn.quant.Stub()
+    x = pt.to_tensor(np.random.RandomState(0).randn(3).astype(np.float32))
+    np.testing.assert_allclose(s(x).numpy(), x.numpy())

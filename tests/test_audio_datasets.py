@@ -1,0 +1,78 @@
+"""audio.datasets (ref: python/paddle/audio/datasets/): TESS and ESC50
+over locally generated archives (zero-egress: download only fires when
+the data directory is absent)."""
+import csv
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+SR = 16000
+
+
+def _tone(i):
+    return (0.1 * np.sin(2 * np.pi * 220 * (i + 1)
+                         * np.arange(SR // 10) / SR)).astype(np.float32)
+
+
+@pytest.fixture
+def data_home(tmp_path, monkeypatch):
+    home = str(tmp_path)
+    import paddle_tpu.audio.datasets.dataset as dsm
+    import paddle_tpu.audio.datasets.tess as tm
+    import paddle_tpu.audio.datasets.esc50 as em
+    for mod in (dsm, tm, em):
+        monkeypatch.setattr(mod, "DATA_HOME", home)
+    return home
+
+
+def test_tess_folds_and_features(data_home):
+    d = os.path.join(data_home, "TESS_Toronto_emotional_speech_set")
+    os.makedirs(d)
+    emos = ["angry", "happy", "sad", "fear", "neutral", "ps", "disgust",
+            "angry", "happy", "sad"]
+    for i, emo in enumerate(emos):
+        pt.audio.save(os.path.join(d, f"OAF_w{i}_{emo}.wav"),
+                      pt.to_tensor(_tone(i)[None, :]), SR)
+    train = pt.audio.datasets.TESS(mode="train", n_folds=5, split=1)
+    dev = pt.audio.datasets.TESS(mode="dev", n_folds=5, split=1)
+    assert len(train) + len(dev) == 10 and len(dev) == 2
+    wav, label = train[0]
+    assert wav.ndim == 1 and wav.dtype == np.float32
+    assert 0 <= label < len(pt.audio.datasets.TESS.label_list)
+    # feature extraction path
+    mf = pt.audio.datasets.TESS(mode="dev", n_folds=5, split=1,
+                                feat_type="mfcc", n_mfcc=13)
+    feat, _ = mf[0]
+    assert feat.shape[0] == 13
+    with pytest.raises(AssertionError):
+        pt.audio.datasets.TESS(n_folds=5, split=9)
+    with pytest.raises(RuntimeError, match="feat_type"):
+        pt.audio.datasets.AudioClassificationDataset([], [],
+                                                     feat_type="bogus")
+
+
+def test_esc50_meta_split(data_home):
+    audio_dir = os.path.join(data_home, "ESC-50-master", "audio")
+    meta_dir = os.path.join(data_home, "ESC-50-master", "meta")
+    os.makedirs(audio_dir)
+    os.makedirs(meta_dir)
+    rows = [["filename", "fold", "target", "category", "esc10",
+             "src_file", "take"]]
+    for i in range(10):
+        fn = f"1-{i}-A-{i % 3}.wav"
+        pt.audio.save(os.path.join(audio_dir, fn),
+                      pt.to_tensor(_tone(i)[None, :]), SR)
+        rows.append([fn, str(i % 5 + 1), str(i % 3), "cat", "False",
+                     "x", "A"])
+    with open(os.path.join(meta_dir, "esc50.csv"), "w", newline="") as f:
+        csv.writer(f).writerows(rows)
+    tr = pt.audio.datasets.ESC50(mode="train", split=1)
+    dv = pt.audio.datasets.ESC50(mode="dev", split=1)
+    assert len(tr) == 8 and len(dv) == 2
+    wav, label = dv[0]
+    assert wav.ndim == 1 and 0 <= label < 3
+    with pytest.raises(AssertionError):
+        pt.audio.datasets.ESC50(split=7)
