@@ -1,3 +1,4 @@
 """``paddle.callbacks`` namespace parity."""
 from .hapi.callbacks import (Callback, ProgBarLogger, ModelCheckpoint,  # noqa: F401
-                             LRScheduler, EarlyStopping, VisualDL)
+                             LRScheduler, EarlyStopping, VisualDL,
+                             ReduceLROnPlateau, WandbCallback)

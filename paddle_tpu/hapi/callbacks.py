@@ -7,7 +7,8 @@ import time
 import numpy as np
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
-           "EarlyStopping", "VisualDL", "config_callbacks", "CallbackList"]
+           "EarlyStopping", "VisualDL", "config_callbacks", "CallbackList",
+           "ReduceLROnPlateau", "WandbCallback"]
 
 
 class Callback:
@@ -175,6 +176,20 @@ class LRScheduler(Callback):
             self._sched().step()
 
 
+def _auto_mode(monitor, mode):
+    if mode == "auto":
+        return "max" if "acc" in monitor else "min"
+    return mode
+
+
+def _improved(v, best, mode, min_delta):
+    if best is None:
+        return True
+    if mode == "min":
+        return v < best - min_delta
+    return v > best + min_delta
+
+
 class EarlyStopping(Callback):
     def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
                  min_delta=0, baseline=None, save_best_model=True):
@@ -185,16 +200,10 @@ class EarlyStopping(Callback):
         self.baseline = baseline
         self.wait = 0
         self.best = None
-        if mode == "auto":
-            mode = "max" if "acc" in monitor else "min"
-        self.mode = mode
+        self.mode = _auto_mode(monitor, mode)
 
     def _better(self, v):
-        if self.best is None:
-            return True
-        if self.mode == "min":
-            return v < self.best - self.min_delta
-        return v > self.best + self.min_delta
+        return _improved(v, self.best, self.mode, self.min_delta)
 
     def on_epoch_end(self, epoch, logs=None):
         v = (logs or {}).get(self.monitor)
@@ -244,3 +253,107 @@ def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
         "verbose": verbose, "metrics": metrics or ["loss"],
     })
     return cbk_list
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce the optimizer lr when the monitored metric plateaus (ref
+    ``hapi/callbacks.py:1172``)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = float(factor)
+        if self.factor >= 1.0:
+            raise ValueError(
+                "ReduceLROnPlateau does not support a factor >= 1.0")
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.mode = _auto_mode(monitor, mode)
+        self.best = None
+        self.wait = 0
+        self.cooldown_counter = 0
+
+    def _better(self, v):
+        return _improved(v, self.best, self.mode, self.min_delta)
+
+    def on_eval_end(self, logs=None):
+        self._check(logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._check(logs)
+
+    def _check(self, logs):
+        logs = logs or {}
+        v = logs.get(self.monitor)
+        if v is None:
+            return
+        v = float(np.mean(v)) if np.ndim(v) else float(v)
+        if self.cooldown_counter > 0:
+            # patience must not advance while cooling down (Keras/ref
+            # semantics) — but a genuine improvement still updates best
+            self.cooldown_counter -= 1
+            self.wait = 0
+            if self._better(v):
+                self.best = v
+            return
+        if self._better(v):
+            self.best = v
+            self.wait = 0
+            return
+        self.wait += 1
+        if self.wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is None:
+                return
+            lr = float(opt.get_lr())
+            new_lr = max(lr * self.factor, self.min_lr)
+            if new_lr < lr:
+                opt.set_lr(new_lr)
+                if self.verbose:
+                    print(f"ReduceLROnPlateau: lr {lr:.3e} -> {new_lr:.3e}")
+            self.cooldown_counter = self.cooldown
+            self.wait = 0
+
+
+class WandbCallback(Callback):
+    """Weights & Biases logger (ref ``hapi/callbacks.py:1345``):
+    requires the ``wandb`` package at run time; metric logs forward to
+    ``wandb.log`` with the reference's train/eval prefixes."""
+
+    def __init__(self, project=None, entity=None, name=None, dir=None,
+                 mode=None, job_type=None, **kwargs):
+        super().__init__()
+        try:
+            import wandb
+        except ImportError:
+            raise ImportError(
+                "WandbCallback requires the wandb package; install it "
+                "with: pip install wandb")
+        self.wandb = wandb
+        self._owns_run = wandb.run is None
+        self.run = wandb.init(project=project, entity=entity, name=name,
+                              dir=dir, mode=mode, job_type=job_type,
+                              **kwargs) if self._owns_run else wandb.run
+
+    def _log(self, prefix, logs):
+        logs = logs or {}
+        payload = {f"{prefix}/{k}": (float(np.mean(v)) if np.ndim(v)
+                                     else float(v))
+                   for k, v in logs.items()
+                   if isinstance(v, (int, float, list, tuple, np.ndarray))}
+        if payload:
+            self.run.log(payload)
+
+    def on_train_batch_end(self, step, logs=None):
+        self._log("train", logs)
+
+    def on_eval_end(self, logs=None):
+        self._log("eval", logs)
+
+    def on_train_end(self, logs=None):
+        if self._owns_run:  # never finish a run the user created
+            self.run.finish()

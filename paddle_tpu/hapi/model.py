@@ -206,10 +206,12 @@ class Model:
             if self._optimizer is not None and \
                     self._optimizer._learning_rate_scheduler is not None:
                 self._optimizer._learning_rate_scheduler.step()
-            cbks.on_epoch_end(epoch, logs)
+            # eval metrics merge BEFORE on_epoch_end so callbacks can
+            # monitor eval_loss/eval_acc (ReduceLROnPlateau etc.)
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 eval_logs = self.evaluate(eval_loader, verbose=0)
                 logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            cbks.on_epoch_end(epoch, logs)
         cbks.on_end("train", logs)
         return self
 

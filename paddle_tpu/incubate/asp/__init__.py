@@ -20,9 +20,28 @@ from ...nn.layer.common import Linear
 
 __all__ = ["calculate_density", "check_sparsity", "create_mask",
            "prune_model", "decorate", "reset_excluded_layers",
-           "set_excluded_layers", "OptimizerWithSparsityGuarantee"]
+           "set_excluded_layers", "OptimizerWithSparsityGuarantee",
+           "add_supported_layer"]
 
 _excluded: set = set()
+# user-extended supported layer types (ref supported_layer_list.py:84):
+# type -> optional custom pruning fn(weight_nparray, m, n, func_name,
+# param_name) -> mask ndarray
+_extra_supported: dict = {}
+
+
+def add_supported_layer(layer, pruning_func=None):
+    """Register a layer TYPE (or its class name) whose 2-D weights ASP
+    should prune, optionally with a custom mask function (ref
+    ``supported_layer_list.py:84``)."""
+    if isinstance(layer, str):
+        name = layer
+    elif isinstance(layer, type) and issubclass(layer, Layer):
+        name = layer.__name__
+    else:
+        raise TypeError(
+            "layer must be a Layer subclass or its class-name string")
+    _extra_supported[name] = pruning_func
 _masks: dict = {}  # param name -> mask array
 
 
@@ -72,8 +91,10 @@ def reset_excluded_layers(main_program=None):
 
 def _supported_params(model: Layer):
     for lname, sub in model.named_sublayers(include_self=True):
-        if not isinstance(sub, Linear):
+        tname = type(sub).__name__
+        if not isinstance(sub, Linear) and tname not in _extra_supported:
             continue
+        custom = _extra_supported.get(tname)
         for pname, p in sub.named_parameters(include_sublayers=False):
             if pname != "weight":
                 continue
@@ -81,7 +102,7 @@ def _supported_params(model: Layer):
             if full in _excluded or lname in _excluded:
                 continue
             if p.ndim == 2 and p.shape[-1] % 4 == 0:
-                yield full, p
+                yield full, p, custom
 
 
 def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
@@ -89,8 +110,15 @@ def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
     ``decorate``-wrapped optimizers re-assert sparsity after each step
     (ref ``asp.py prune_model``). Returns {param_name: mask}."""
     out = {}
-    for name, p in _supported_params(model):
-        mask = create_mask(p, func_name=mask_algo, n=n, m=m)
+    for name, p, custom_fn in _supported_params(model):
+        if custom_fn is not None:
+            # user mask fn contract (ref supported_layer_list.py:84):
+            # (weight_nparray, m, n, func_name, param_name) -> mask
+            import numpy as _np
+            mask = _np.asarray(custom_fn(_np.asarray(p._data), m, n,
+                                         mask_algo, name))
+        else:
+            mask = create_mask(p, func_name=mask_algo, n=n, m=m)
         p._data = p._data * jnp.asarray(mask, dtype=p._data.dtype)
         if with_mask:
             _masks[p.name] = mask  # keyed by tensor name (optimizer view)

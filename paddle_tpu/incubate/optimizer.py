@@ -163,3 +163,8 @@ class DistributedFusedLamb(Lamb):
         kwargs.pop("use_master_acc_grad", None)
         super().__init__(*args, **kwargs)
         self._group_sharded_level = "os_g"
+
+
+# ref python/paddle/incubate/optimizer/__init__.py exposes LBFGS here
+# (it later graduated to paddle.optimizer; one implementation serves both)
+from ..optimizer.lbfgs import LBFGS  # noqa: E402,F401

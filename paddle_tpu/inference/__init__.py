@@ -25,7 +25,47 @@ import jax.numpy as jnp
 from ..tensor import Tensor as _PTensor
 
 __all__ = ["Config", "Predictor", "create_predictor", "Tensor",
-           "PredictorPool", "PlaceType", "DataType"]
+           "PredictorPool", "PlaceType", "DataType", "PrecisionType",
+           "get_version", "get_num_bytes_of_data_type",
+           "convert_to_mixed_precision", "get_trt_compile_version",
+           "get_trt_runtime_version", "XpuConfig", "_get_phi_kernel_name"]
+
+
+class PrecisionType:
+    """ref ``paddle/fidle/inference/api/paddle_analysis_config.h``
+    Precision enum; bf16 is the TPU-native half type."""
+    Float32 = 0
+    Half = 1
+    Int8 = 2
+    Bfloat16 = 3
+
+
+class XpuConfig:
+    """Accepted-for-parity XPU tuning knobs (no XPU in this build)."""
+
+    def __init__(self, **kwargs):
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+
+def get_version():
+    from .. import __version__
+    return f"version : {__version__}"
+
+
+def get_trt_compile_version():
+    return (0, 0, 0)  # no TensorRT in a TPU build
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def get_num_bytes_of_data_type(dtype):
+    import numpy as np
+    if dtype == DataType.BFLOAT16:
+        return 2
+    return int(np.dtype(dtype).itemsize)  # DataType members ARE np names
 
 
 class PlaceType:
@@ -51,7 +91,10 @@ class Config:
 
     def __init__(self, prog_file=None, params_file=None):
         # paddle 2.x: Config(model_dir) or Config(prog, params) — here the
-        # artifact is a path prefix (jit.save / save_inference_model)
+        # artifact is a path prefix (jit.save / save_inference_model);
+        # a reference-style full .pdmodel file path is accepted too
+        if isinstance(prog_file, str) and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[:-len(".pdmodel")]
         self.model_prefix = prog_file
         self._device = PlaceType.TPU
         self._memory_optim = True
@@ -248,3 +291,71 @@ class PredictorPool:
         return self._preds[idx]
 
     retrieve = retrive
+
+
+def _get_phi_kernel_name(op_name):
+    """ref ``inference/__init__.py``: fluid-op -> phi-kernel name map.
+    This build has no phi registry — op names ARE the jax-function
+    names, so the mapping is the identity."""
+    return op_name
+
+
+def convert_to_mixed_precision(src_model, src_params, dst_model,
+                               dst_params, mixed_precision="bfloat16",
+                               backend=None, black_list=None, **kwargs):
+    """Convert a saved inference artifact to low-precision WEIGHTS (ref
+    ``inference/__init__.py convert_to_mixed_precision`` over the
+    mixed-precision pass).
+
+    The exported StableHLO blob pins its compute dtypes, so this
+    implements the storage half of the pass: float32 params (minus
+    ``black_list`` names) are stored as ``mixed_precision`` and upcast
+    inside a re-exported wrapper program — halving the artifact +
+    resident weight bytes, which is the part that matters on HBM-bound
+    TPU serving."""
+    import pickle
+
+    import jax
+
+    table = {"half": jnp.float16, "float16": jnp.float16,
+             "fp16": jnp.float16, "bfloat16": jnp.bfloat16,
+             "bf16": jnp.bfloat16, PrecisionType.Half: jnp.float16,
+             PrecisionType.Bfloat16: jnp.bfloat16}
+    key = mixed_precision.lower() if isinstance(mixed_precision, str) \
+        else mixed_precision
+    prec = table.get(key)
+    if prec is None:
+        raise ValueError(
+            f"mixed_precision must be float16/bfloat16 (or the matching "
+            f"PrecisionType); got {mixed_precision!r}")
+    black = set(black_list or ())
+
+    with open(src_model, "rb") as f:
+        exported = jax.export.deserialize(f.read())
+    with open(src_params, "rb") as f:
+        payload = pickle.load(f)
+    params = payload["params"]
+    orig_dtypes = {k: np.asarray(v).dtype for k, v in params.items()}
+    cast_params = {
+        k: (np.asarray(v).astype(prec)
+            if np.asarray(v).dtype == np.float32 and k not in black
+            else np.asarray(v))
+        for k, v in params.items()}
+
+    def wrapped(p, *feeds):
+        restored = {k: jnp.asarray(v).astype(orig_dtypes[k])
+                    for k, v in p.items()}
+        return exported.call(restored, *feeds)
+
+    param_specs = {k: jax.ShapeDtypeStruct(np.asarray(v).shape, v.dtype)
+                   for k, v in cast_params.items()}
+    # in_avals flattens (params_dict, *feeds): dict leaves first
+    feed_specs = list(exported.in_avals[len(cast_params):])
+    new_exported = jax.export.export(jax.jit(wrapped))(param_specs,
+                                                       *feed_specs)
+    for dst in (dst_model, dst_params):
+        os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
+    with open(dst_model, "wb") as f:
+        f.write(new_exported.serialize())
+    with open(dst_params, "wb") as f:
+        pickle.dump({**payload, "params": cast_params}, f)

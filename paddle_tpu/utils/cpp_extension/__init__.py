@@ -1,3 +1,3 @@
 from .cpp_extension import (  # noqa: F401
-    CppExtension, load, setup, BuildExtension, get_build_directory,
+    CppExtension, CUDAExtension, load, setup, BuildExtension, get_build_directory,
 )

@@ -21,7 +21,7 @@ import os
 import subprocess
 import tempfile
 
-__all__ = ["CppExtension", "load", "setup", "BuildExtension",
+__all__ = ["CppExtension", "CUDAExtension", "load", "setup", "BuildExtension",
            "get_build_directory"]
 
 
@@ -104,3 +104,13 @@ def load(name, sources, extra_cxx_flags=None, build_directory=None,
                                f"{res.stderr}")
         os.replace(tmp, so_path)
     return ctypes.CDLL(so_path)
+
+
+def CUDAExtension(sources, *args, **kwargs):
+    """ref ``utils/cpp_extension/cpp_extension.py CUDAExtension``: this
+    is a TPU build — no nvcc toolchain exists; custom device kernels
+    come in as Pallas or PJRT plugins instead."""
+    raise RuntimeError(
+        "CUDAExtension is unavailable in the TPU build: there is no CUDA "
+        "toolchain. Use CppExtension for host ops, Pallas for device "
+        "kernels, or a PJRT plugin for custom devices.")
