@@ -1,0 +1,349 @@
+"""LoD sequence ops (ref: ``python/paddle/static/nn/sequence_lod.py``).
+
+The reference's LoDTensor carries level-of-detail offsets inside the
+tensor; this build's Tensors are plain arrays, so the lod lives in a
+weak side registry: :func:`set_lod` attaches ``[len_0, len_1, ...]`` to
+a tensor (``paddle_tpu.static.data(..., lod_level=1)`` feeds do it for
+you), sequence ops read it, and every op re-attaches the proper lod to
+its output. A tensor with no lod is one sequence — the same degenerate
+rule the reference applies to plain Tensors.
+"""
+from __future__ import annotations
+
+import weakref
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..ops.op_utils import ensure_tensor, nary
+
+__all__ = [
+    "set_lod", "get_lod", "sequence_conv", "sequence_softmax",
+    "sequence_pool", "sequence_concat", "sequence_first_step",
+    "sequence_last_step", "sequence_slice", "sequence_expand",
+    "sequence_expand_as", "sequence_pad", "sequence_unpad",
+    "sequence_reshape", "sequence_scatter", "sequence_enumerate",
+    "sequence_reverse",
+]
+
+# id(tensor) -> np.ndarray of sequence lengths; weakref.finalize evicts
+# (Tensor.__eq__ returns a Tensor, so a WeakKeyDictionary would trip on
+# bucket equality — identity keys avoid that entirely)
+_lods: dict = {}
+
+
+def set_lod(tensor, lengths):
+    t = ensure_tensor(tensor)
+    lens = np.asarray(lengths, np.int64).ravel()
+    if int(lens.sum()) != t.shape[0]:
+        raise ValueError(
+            f"lod lengths sum to {int(lens.sum())} but dim0 is "
+            f"{t.shape[0]}")
+    _lods[id(t)] = lens
+    weakref.finalize(t, _lods.pop, id(t), None)
+    return t
+
+
+def get_lod(tensor):
+    t = ensure_tensor(tensor)
+    lens = _lods.get(id(t))
+    if lens is None:
+        return np.asarray([t.shape[0]], np.int64)  # one sequence
+    return lens
+
+
+def _offsets(lens):
+    return np.concatenate([[0], np.cumsum(lens)]).astype(np.int64)
+
+
+def sequence_softmax(input, use_cudnn=False, name=None):
+    """Softmax within each sequence over dim0 (ref
+    ``sequence_lod.py sequence_softmax``)."""
+    x = ensure_tensor(input)
+    lens = get_lod(x)
+    off = _offsets(lens)
+    seg = np.repeat(np.arange(len(lens)), lens)
+
+    def f(d):
+        flat = d.reshape(d.shape[0])
+        mx = jnp.asarray([flat[off[i]:off[i + 1]].max()
+                          for i in range(len(lens))])
+        e = jnp.exp(flat - mx[seg])
+        z = jnp.zeros(len(lens)).at[seg].add(e)
+        return (e / z[seg]).reshape(d.shape)
+
+    return set_lod(nary(f, [x], name="sequence_softmax"), lens)
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0):
+    x = ensure_tensor(input)
+    lens = get_lod(x)
+    off = _offsets(lens)
+    pool_type = pool_type.lower()
+    seg = np.repeat(np.arange(len(lens)), lens)
+    n = len(lens)
+
+    def f(d):
+        if pool_type in ("sum", "average", "sqrt"):
+            z = jnp.zeros((n,) + d.shape[1:], d.dtype).at[seg].add(d)
+            if pool_type == "average":
+                z = z / jnp.maximum(jnp.asarray(lens, d.dtype), 1
+                                    ).reshape((n,) + (1,) * (d.ndim - 1))
+            elif pool_type == "sqrt":
+                z = z / jnp.sqrt(jnp.maximum(
+                    jnp.asarray(lens, d.dtype), 1)).reshape(
+                        (n,) + (1,) * (d.ndim - 1))
+        elif pool_type == "max":
+            z = jnp.full((n,) + d.shape[1:], -jnp.inf, d.dtype) \
+                .at[seg].max(d)
+        elif pool_type == "first":
+            z = d[jnp.asarray(off[:-1])]
+        elif pool_type == "last":
+            z = d[jnp.asarray(off[1:] - 1)]
+        else:
+            raise ValueError(f"unknown pool_type {pool_type!r}")
+        empty = jnp.asarray(lens == 0).reshape(
+            (n,) + (1,) * (d.ndim - 1))
+        return jnp.where(empty, jnp.asarray(pad_value, d.dtype), z)
+
+    return nary(f, [x], name="sequence_pool")
+
+
+def sequence_first_step(input):
+    return sequence_pool(input, "first")
+
+
+def sequence_last_step(input):
+    return sequence_pool(input, "last")
+
+
+def sequence_concat(input, name=None):
+    """Concatenate the i-th sequences of every input (ref
+    ``sequence_concat``): out lod_i = sum of input lod_i."""
+    xs = [ensure_tensor(v) for v in input]
+    lods = [get_lod(v) for v in xs]
+    n = len(lods[0])
+    if any(len(l) != n for l in lods):
+        raise ValueError("sequence_concat inputs need equal seq counts")
+    offs = [_offsets(l) for l in lods]
+    order = []  # (input idx, start, stop) in output order
+    for i in range(n):
+        for j, off in enumerate(offs):
+            order.append((j, int(off[i]), int(off[i + 1])))
+
+    def f(*ds):
+        return jnp.concatenate([ds[j][a:b] for j, a, b in order], axis=0)
+
+    out_lens = np.sum(np.stack(lods), axis=0)
+    return set_lod(nary(f, xs, name="sequence_concat"), out_lens)
+
+
+def sequence_slice(input, offset, length, name=None):
+    x = ensure_tensor(input)
+    lens = get_lod(x)
+    off = _offsets(lens)
+    o = np.asarray(ensure_tensor(offset)._data).ravel()
+    ln = np.asarray(ensure_tensor(length)._data).ravel()
+    spans = [(int(off[i] + o[i]), int(off[i] + o[i] + ln[i]))
+             for i in range(len(lens))]
+    for i, (a, b) in enumerate(spans):
+        if a < off[i] or b > off[i + 1]:
+            raise ValueError(
+                f"sequence_slice out of range for sequence {i}")
+
+    def f(d):
+        return jnp.concatenate([d[a:b] for a, b in spans], axis=0)
+
+    return set_lod(nary(f, [x], name="sequence_slice"), ln)
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    """Repeat x's i-th sequence len(y_i) times (ref
+    ``sequence_expand``)."""
+    xt, yt = ensure_tensor(x), ensure_tensor(y)
+    xl = get_lod(xt)
+    yl = get_lod(yt)
+    off = _offsets(xl)
+    idx = []
+    out_lens = []
+    for i, reps in enumerate(yl):
+        for _ in range(int(reps)):
+            idx.extend(range(int(off[i]), int(off[i + 1])))
+            out_lens.append(int(xl[i]))
+    gather = jnp.asarray(np.asarray(idx, np.int64))
+    out = nary(lambda d: d[gather], [xt], name="sequence_expand")
+    return set_lod(out, out_lens)
+
+
+def sequence_expand_as(x, y, name=None):
+    """Expand each x ROW to the length of y's i-th sequence (ref
+    ``sequence_expand_as``: x has one row per y sequence)."""
+    xt, yt = ensure_tensor(x), ensure_tensor(y)
+    yl = get_lod(yt)
+    if xt.shape[0] != len(yl):
+        raise ValueError("sequence_expand_as: x rows must equal y's "
+                         "sequence count")
+    gather = jnp.asarray(np.repeat(np.arange(len(yl)), yl))
+    out = nary(lambda d: d[gather], [xt], name="sequence_expand_as")
+    return set_lod(out, yl)
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    """Pack sequences into (num_seq, maxlen, ...) + lengths (ref
+    ``sequence_pad``); returns (out, length)."""
+    from ..tensor import Tensor
+    xt = ensure_tensor(x)
+    pv = ensure_tensor(pad_value)
+    lens = get_lod(xt)
+    off = _offsets(lens)
+    m = int(maxlen) if maxlen is not None else int(lens.max())
+    if (lens > m).any():
+        raise ValueError(f"maxlen {m} shorter than longest sequence")
+    n = len(lens)
+    rows = np.concatenate([np.full(int(l), i) for i, l in
+                           enumerate(lens)]) if n else np.zeros(0, int)
+    cols = np.concatenate([np.arange(int(l)) for l in lens]) if n else \
+        np.zeros(0, int)
+
+    def f(d, p):
+        buf = jnp.broadcast_to(p.astype(d.dtype),
+                               (n, m) + d.shape[1:]).copy() \
+            if p.ndim else jnp.full((n, m) + d.shape[1:], p, d.dtype)
+        return buf.at[rows, cols].set(d)
+
+    out = nary(f, [xt, pv], name="sequence_pad")
+    return out, Tensor(jnp.asarray(lens))
+
+
+def sequence_unpad(x, length, name=None):
+    xt = ensure_tensor(x)
+    lens = np.asarray(ensure_tensor(length)._data).ravel()
+    rows = np.concatenate([np.full(int(l), i) for i, l in
+                           enumerate(lens)])
+    cols = np.concatenate([np.arange(int(l)) for l in lens])
+
+    def f(d):
+        return d[rows, cols]
+
+    return set_lod(nary(f, [xt], name="sequence_unpad"), lens)
+
+
+def sequence_reshape(input, new_dim, name=None):
+    x = ensure_tensor(input)
+    lens = get_lod(x)
+    d = x.shape[-1]
+    total = lens * d
+    if (total % new_dim).any():
+        raise ValueError("each sequence's total elements must divide "
+                         "new_dim")
+    out_lens = total // new_dim
+    out = nary(lambda a: a.reshape(-1, new_dim), [x],
+               name="sequence_reshape")
+    return set_lod(out, out_lens)
+
+
+def sequence_scatter(input, index, updates, name=None):
+    """Scatter-add updates into input at per-sequence positions (ref
+    ``sequence_scatter``: index is a lod tensor of positions local to
+    each sequence; input rows correspond to sequences)."""
+    xt = ensure_tensor(input)
+    it = ensure_tensor(index)
+    ut = ensure_tensor(updates)
+    ilens = get_lod(it)
+    rows = np.repeat(np.arange(len(ilens)), ilens)
+
+    def f(d, i, u):
+        return d.at[rows, i.reshape(-1).astype(jnp.int32)].add(u)
+
+    return nary(f, [xt, it, ut], name="sequence_scatter")
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    """Sliding windows of ids within each sequence (ref
+    ``sequence_enumerate``): out[i] = ids[i:i+win], padded past each
+    sequence end."""
+    x = ensure_tensor(input)
+    lens = get_lod(x)
+    off = _offsets(lens)
+    idx = np.zeros((int(lens.sum()), win_size), np.int64)
+    valid = np.zeros_like(idx, dtype=bool)
+    r = 0
+    for i, l in enumerate(lens):
+        for j in range(int(l)):
+            for k in range(win_size):
+                if j + k < int(l):
+                    idx[r, k] = off[i] + j + k
+                    valid[r, k] = True
+            r += 1
+
+    def f(d):
+        flat = d.reshape(d.shape[0])
+        out = flat[jnp.asarray(idx)]
+        return jnp.where(jnp.asarray(valid), out,
+                         jnp.asarray(pad_value, d.dtype))
+
+    return set_lod(nary(f, [x], name="sequence_enumerate"), lens)
+
+
+def sequence_reverse(x, name=None):
+    xt = ensure_tensor(x)
+    lens = get_lod(xt)
+    off = _offsets(lens)
+    perm = np.concatenate([np.arange(int(off[i + 1]) - 1,
+                                     int(off[i]) - 1, -1)
+                           for i in range(len(lens))]) if len(lens) else \
+        np.zeros(0, int)
+    gather = jnp.asarray(perm)
+    return set_lod(nary(lambda d: d[gather], [xt],
+                        name="sequence_reverse"), lens)
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None):
+    """Context-window convolution over each sequence (ref
+    ``sequence_conv``): each step's window of ``filter_size`` rows
+    (centered per ``padding_start``, zero-padded at sequence edges)
+    flattens and passes through one (filter_size*D, num_filters)
+    projection."""
+    from ..ops.creation import create_parameter
+    x = ensure_tensor(input)
+    lens = get_lod(x)
+    off = _offsets(lens)
+    d = x.shape[-1]
+    if filter_stride != 1:
+        raise ValueError("sequence_conv supports filter_stride=1 "
+                         "(reference kernel restriction)")
+    start = -int((filter_size - 1) // 2) if padding_start is None \
+        else int(padding_start)
+    w = create_parameter([filter_size * d, num_filters], "float32",
+                         attr=param_attr)
+    b = create_parameter([num_filters], "float32", attr=bias_attr,
+                         is_bias=True) if bias_attr is not False else None
+    # window gather indices: -1 marks a zero pad slot
+    tot = int(lens.sum())
+    idx = np.full((tot, filter_size), -1, np.int64)
+    for i in range(len(lens)):
+        for j in range(int(lens[i])):
+            r = int(off[i]) + j
+            for k in range(filter_size):
+                p = j + start + k
+                if 0 <= p < int(lens[i]):
+                    idx[r, k] = off[i] + p
+    gather = jnp.asarray(np.maximum(idx, 0))
+    mask = jnp.asarray((idx >= 0)[..., None])
+
+    args = [x, w] + ([b] if b is not None else [])
+
+    def f(dd, wd, *rest):
+        win = jnp.where(mask, dd[gather], 0.0)       # (tot, k, D)
+        flat = win.reshape(dd.shape[0], filter_size * d)
+        out = flat @ wd
+        return out + rest[0] if rest else out
+
+    out = nary(f, args, name="sequence_conv")
+    out = set_lod(out, lens)
+    if act:
+        import paddle_tpu.nn.functional as F
+        out = getattr(F, act)(out)
+    return out

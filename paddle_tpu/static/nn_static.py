@@ -232,3 +232,426 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
 
 
 __all__ += ["cond", "while_loop", "case", "switch_case"]
+
+
+# -- remaining static.nn layer wrappers (ref: python/paddle/static/nn/
+# common.py) — each builds the layer the dygraph API already provides and
+# applies it, the same delegation the reference performs onto nn ops.
+
+def _apply_act(out, act):
+    if act:
+        import paddle_tpu.nn.functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv3d(x, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, weight_attr=None, bias_attr=None, name=None,
+           act=None, data_format="NCDHW"):
+    in_channels = x.shape[1] if data_format == "NCDHW" else x.shape[-1]
+    layer = _nn_mod().Conv3D(in_channels, num_filters, filter_size,
+                             stride=stride, padding=padding,
+                             dilation=dilation, groups=groups,
+                             weight_attr=weight_attr, bias_attr=bias_attr,
+                             data_format=data_format)
+    return _apply_act(layer(x), act)
+
+
+def conv3d_transpose(x, num_filters, filter_size, stride=1, padding=0,
+                     weight_attr=None, bias_attr=None, name=None,
+                     act=None, data_format="NCDHW"):
+    in_channels = x.shape[1] if data_format == "NCDHW" else x.shape[-1]
+    layer = _nn_mod().Conv3DTranspose(in_channels, num_filters,
+                                      filter_size, stride=stride,
+                                      padding=padding,
+                                      weight_attr=weight_attr,
+                                      bias_attr=bias_attr,
+                                      data_format=data_format)
+    return _apply_act(layer(x), act)
+
+
+def group_norm(input, groups, epsilon=1e-05, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    ch = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    layer = _nn_mod().GroupNorm(groups, ch, epsilon=epsilon,
+                                weight_attr=param_attr,
+                                bias_attr=bias_attr,
+                                data_format=data_layout)
+    return _apply_act(layer(input), act)
+
+
+def instance_norm(input, epsilon=1e-05, param_attr=None, bias_attr=None,
+                  name=None):
+    nd = len(input.shape)
+    cls = {3: "InstanceNorm1D", 4: "InstanceNorm2D",
+           5: "InstanceNorm3D"}.get(nd)
+    if cls is None:
+        raise ValueError(f"instance_norm expects 3-5D input, got {nd}D")
+    layer = getattr(_nn_mod(), cls)(input.shape[1], epsilon=epsilon,
+                                    weight_attr=param_attr,
+                                    bias_attr=bias_attr)
+    return layer(input)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-05, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    """Normalize over dims [begin_norm_axis:] (the static-era knob the
+    dygraph LayerNorm expresses via normalized_shape)."""
+    normalized_shape = list(input.shape[begin_norm_axis:])
+    layer = _nn_mod().LayerNorm(
+        normalized_shape, epsilon=epsilon,
+        weight_attr=param_attr if scale else False,
+        bias_attr=bias_attr if shift else False)
+    return _apply_act(layer(input), act)
+
+
+def prelu(x, mode, param_attr=None, data_format="NCHW", name=None):
+    if mode == "element":
+        # per-element slope of shape x.shape[1:] (the nn.PReLU layer
+        # only models the per-channel axis)
+        from .. import create_parameter
+        from ..ops.op_utils import nary
+        from ..nn import initializer as I
+        import jax.numpy as jnp
+        w = create_parameter(list(x.shape[1:]), "float32",
+                             attr=param_attr,
+                             default_initializer=I.Constant(0.25))
+        return nary(lambda d, a: jnp.where(d > 0, d, a * d), [x, w],
+                    name="prelu")
+    if mode == "all":
+        num = 1
+    elif mode == "channel":
+        num = x.shape[1] if data_format == "NCHW" else x.shape[-1]
+    else:
+        raise ValueError("mode must be all/channel/element")
+    layer = _nn_mod().PReLU(num_parameters=num, weight_attr=param_attr,
+                            data_format=data_format)
+    return layer(x)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    layer = _nn_mod().SpectralNorm(weight.shape, dim=dim,
+                                   power_iters=power_iters, eps=eps)
+    return layer(weight)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    layer = _nn_mod().Bilinear(x.shape[-1], y.shape[-1], size,
+                               weight_attr=param_attr,
+                               bias_attr=bias_attr)
+    return _apply_act(layer(x, y), act)
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None,
+                  name=None):
+    """ref ``static/nn/common.py deform_conv2d`` (v2 when mask given)."""
+    from .. import create_parameter
+    from ..vision.ops import deform_conv2d as _dc
+    ks = filter_size if isinstance(filter_size, (list, tuple)) else \
+        (filter_size, filter_size)
+    w = create_parameter([num_filters, x.shape[1] // groups, ks[0], ks[1]],
+                         "float32", attr=param_attr)
+    b = create_parameter([num_filters], "float32", attr=bias_attr,
+                         is_bias=True) if bias_attr is not False else None
+    return _dc(x, offset, w, bias=b, stride=stride, padding=padding,
+               dilation=dilation, deformable_groups=deformable_groups,
+               groups=groups, mask=mask)
+
+
+def data_norm(input, act=None, epsilon=1e-05, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """Normalize by ACCUMULATED batch statistics (ref
+    ``static/nn/common.py data_norm`` — the CTR-era normalization whose
+    stats are summed counters, not EMA): mean = batch_sum/batch_size,
+    var likewise; counters update each training pass."""
+    from .. import create_parameter
+    from ..ops.op_utils import ensure_tensor, nary
+    import jax
+    import jax.numpy as jnp
+    from ..nn import initializer as I
+    x = ensure_tensor(input)
+    d = x.shape[-1]
+    # counters get their own anonymous attrs (the reference builds one
+    # distinct ParamAttr per counter; a shared named attr would collide)
+    batch_size = create_parameter(
+        [d], "float32", default_initializer=I.Constant(1e4))
+    batch_sum = create_parameter([d], "float32",
+                                 default_initializer=I.Constant(0.0))
+    batch_square_sum = create_parameter(
+        [d], "float32", default_initializer=I.Constant(1e4))
+
+    def f(xd, n, s, sq):
+        mean = s / n
+        var = jnp.maximum(sq / n - mean ** 2, 0.0)
+        return (xd - mean) / jnp.sqrt(var + epsilon)
+
+    out = nary(f, [x, batch_size, batch_sum, batch_square_sum],
+               name="data_norm")
+    # summary-counter update each training pass (ref: the op's
+    # BatchSize/BatchSum/BatchSquareSum outputs feed back every step);
+    # eager host-side accumulate, same mechanism as BN running stats
+    if not isinstance(x._data, jax.core.Tracer):
+        n_rows = float(x.shape[0])
+        batch_size._data = batch_size._data + n_rows
+        batch_sum._data = batch_sum._data + x._data.sum(axis=0)
+        batch_square_sum._data = (batch_square_sum._data
+                                  + (x._data ** 2).sum(axis=0))
+    return _apply_act(out, act)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead row convolution (ref ``static/nn/common.py:3327``):
+    out[t] = sum_{i=0..k} x[t+i] * w[i], per feature."""
+    from .. import create_parameter
+    from ..ops.op_utils import nary
+    import jax.numpy as jnp
+    d = input.shape[-1]
+    k = int(future_context_size)
+    w = create_parameter([k + 1, d], "float32", attr=param_attr)
+
+    def f(xd, wd):
+        pad = [(0, 0)] * xd.ndim
+        pad[-2] = (0, k)
+        xp = jnp.pad(xd, pad)
+        t_axis = xd.ndim - 2
+        out = 0.0
+        for i in range(k + 1):
+            out = out + jnp.take(xp, jnp.arange(i, i + xd.shape[t_axis]),
+                                 axis=t_axis) * wd[i]
+        return out
+
+    return _apply_act(nary(f, [input, w], name="row_conv"), act)
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (ref ``static/nn/common.py
+    nce``): binary logistic loss over the true class + uniformly sampled
+    negatives — the large-vocab training trick the reference ships a
+    CUDA kernel for; one gather + matmul region here."""
+    from .. import create_parameter
+    from ..ops.op_utils import nary
+    from ..framework import random as _random
+    import jax
+    import jax.numpy as jnp
+    d = input.shape[-1]
+    weight = create_parameter([num_total_classes, d], "float32",
+                              attr=param_attr)
+    bias = create_parameter([num_total_classes], "float32", attr=bias_attr,
+                            is_bias=True)
+    # fresh key per nce() call (each eager training step resamples);
+    # a captured static node keeps its build-time key — the same
+    # contract every sampling op in this framework has under capture
+    key = _random.next_key()
+
+    def f(xd, yd, wd, bd):
+        B = xd.shape[0]
+        neg = jax.random.randint(key, (B, num_neg_samples), 0,
+                                 num_total_classes)
+        yid = yd.reshape(B, 1).astype(jnp.int32)
+        cls = jnp.concatenate([yid, neg], axis=1)     # (B, 1+S)
+        wsel = wd[cls]                                # (B, 1+S, D)
+        logits = jnp.einsum("bd,bsd->bs", xd, wsel) + bd[cls]
+        labels = jnp.concatenate(
+            [jnp.ones((B, 1)), jnp.zeros((B, num_neg_samples))], axis=1)
+        loss = jnp.maximum(logits, 0) - logits * labels + \
+            jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        return loss.sum(axis=1, keepdims=True)
+
+    return nary(f, [input, label, weight, bias], name="nce")
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32", slot=None):
+    """ref ``static/nn/common.py sparse_embedding``: the PS-backed
+    embedding; the TPU build stores the table densely (XLA gather) and
+    accepts the PS-era knobs (entry/table_class) for parity."""
+    layer = _nn_mod().Embedding(size[0], size[1], padding_idx=padding_idx,
+                                weight_attr=param_attr)
+    return layer(input)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Run a host python function inside the program (ref
+    ``static/nn/common.py py_func`` over the py_func op). Eager values
+    call ``func`` directly; traced values route through
+    ``jax.pure_callback`` with ``out``'s shape/dtype as the result
+    template (``out`` is a Variable/Tensor template, as in the
+    reference)."""
+    import numpy as np
+    import jax
+    from ..tensor import Tensor
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    datas = [v._data if isinstance(v, Tensor) else v for v in xs]
+    if not any(isinstance(d, jax.core.Tracer) for d in datas):
+        res = func(*[np.asarray(d) for d in datas])
+        res = res if isinstance(res, (list, tuple)) else [res]
+        got = [Tensor(np.asarray(r)) for r in res]
+        return got if isinstance(out, (list, tuple)) else got[0]
+    templates = [jax.ShapeDtypeStruct(tuple(o.shape), o._data.dtype)
+                 for o in outs]
+
+    def cb(*arrs):
+        res = func(*[np.asarray(a) for a in arrs])
+        res = res if isinstance(res, (list, tuple)) else [res]
+        return tuple(np.asarray(r) for r in res)
+
+    raw = jax.pure_callback(cb, tuple(templates), *datas)
+    got = [Tensor(r) for r in raw]
+    return got if isinstance(out, (list, tuple)) else got[0]
+
+
+__all__ += ["conv3d", "conv3d_transpose", "group_norm", "instance_norm",
+            "layer_norm", "prelu", "spectral_norm",
+            "bilinear_tensor_product", "deform_conv2d", "data_norm",
+            "row_conv", "nce", "sparse_embedding", "py_func"]
+
+
+from .nn_sequence import *  # noqa: E402,F401,F403
+from .nn_sequence import __all__ as _seq_all
+__all__ += _seq_all
+
+
+class StaticRNN:
+    """Step-wise RNN builder (ref ``static/nn/control_flow.py
+    StaticRNN``): the ``with rnn.step():`` block defines ONE time step;
+    the runner unrolls it over dim0 of every ``step_input``.
+
+    TPU-native capture: ops inside the block record tape nodes anyway
+    (the funnel), so the block body is captured as the node sequence and
+    replayed per step THROUGH the same funnel — under ``to_static`` /
+    program capture each replayed step is recorded like hand-written
+    code, i.e. the loop unrolls statically (the XLA-friendly form).
+    """
+
+    def __init__(self, name=None):
+        self._nodes = []          # captured body nodes, creation order
+        self._subs = {}           # placeholder id -> role
+        self._seq = []            # (ph, full_tensor)
+        self._mems = []           # [ph, init_tensor, new_tensor|None]
+        self._outs = []
+        self._entered = False
+        self._done = False
+
+    # -- capture ------------------------------------------------------------
+    class _StepCtx:
+        def __init__(self, rnn):
+            self._rnn = rnn
+
+        def __enter__(self):
+            from ..autograd import add_op_observer
+            rnn = self._rnn
+            rnn._entered = True
+
+            def observe(name, inputs, outputs):
+                node = outputs[0]._node if outputs else None
+                if node is not None:
+                    rnn._nodes.append((node, list(outputs)))
+            rnn._observer = observe
+            add_op_observer(observe)
+            return rnn
+
+        def __exit__(self, *exc):
+            from ..autograd import remove_op_observer
+            remove_op_observer(self._rnn._observer)
+            self._rnn._done = True
+            return False
+
+    def step(self):
+        return StaticRNN._StepCtx(self)
+
+    def step_input(self, x):
+        from ..ops.op_utils import ensure_tensor
+        x = ensure_tensor(x)
+        ph = x[0]
+        # capture rides the tape: placeholders must be tracked so every
+        # body op records a Node (carrying the fn the replay needs)
+        ph.stop_gradient = False
+        self._seq.append((ph, x))
+        return ph
+
+    def memory(self, init=None, shape=None, batch_ref=None,
+               init_value=0.0, init_batch_dim_idx=0, ref_batch_dim_idx=1):
+        from ..ops.op_utils import ensure_tensor
+        from ..ops.creation import full
+        if init is None:
+            if shape is None and batch_ref is None:
+                raise ValueError("memory() needs init= or shape=/batch_ref=")
+            if batch_ref is not None:
+                b = ensure_tensor(batch_ref).shape[ref_batch_dim_idx]
+                shp = [b] + list(shape or [])
+            else:
+                shp = list(shape)
+            init = full(shp, init_value, "float32")
+        mem = ensure_tensor(init)
+        mem.stop_gradient = False  # see step_input: capture needs the tape
+        self._mems.append([mem, mem, None])
+        return mem
+
+    def update_memory(self, mem, x):
+        for rec in self._mems:
+            if rec[0] is mem:
+                rec[2] = x
+                return
+        raise ValueError("update_memory: unknown memory tensor")
+
+    def step_output(self, o):
+        self._outs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    # -- replay -------------------------------------------------------------
+    def _replay(self, env):
+        """Re-run the captured body with substitutions, THROUGH the op
+        funnel (so to_static / program capture sees real ops)."""
+        from ..ops.op_utils import nary
+        for node, outs in self._nodes:
+            if all(id(o) in env for o in outs):
+                continue  # substituted producer (step_input slice etc.)
+            args = [env.get(id(t), t) for t in node.inputs]
+            n_out = len(outs)
+            got = nary(node.fn, args, name=node.name, n_out=n_out)
+            got = got if isinstance(got, tuple) else (got,)
+            for o, g in zip(outs, got):
+                env[id(o)] = g
+        return env
+
+    def __call__(self):
+        from .. import ops
+        if not self._done:
+            raise RuntimeError("complete the `with rnn.step():` block "
+                               "before calling the rnn")
+        if not self._seq:
+            raise RuntimeError("StaticRNN needs at least one step_input")
+        T = self._seq[0][1].shape[0]
+        mems = {id(rec[0]): rec[1] for rec in self._mems}
+        step_outs = []
+        for t in range(T):
+            env = dict(mems)
+            for ph, full_x in self._seq:
+                env[id(ph)] = full_x[t]
+            env = self._replay(env)
+            step_outs.append([env.get(id(o), o) for o in self._outs])
+            mems = {id(rec[0]): (env.get(id(rec[2]), rec[2])
+                                 if rec[2] is not None
+                                 else mems[id(rec[0])])
+                    for rec in self._mems}
+        stacked = [ops.stack([row[i] for row in step_outs], axis=0)
+                   for i in range(len(self._outs))]
+        return stacked[0] if len(stacked) == 1 else stacked
+
+
+__all__ += ["StaticRNN"]
