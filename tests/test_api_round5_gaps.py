@@ -104,6 +104,7 @@ class TestFusedExtras:
         with pytest.raises(ValueError):
             FusedDropoutAdd(mode="bogus")
 
+    @pytest.mark.slow
     def test_fused_ec_moe_and_bias_dropout_ln(self):
         from paddle_tpu.incubate.nn import (
             FusedBiasDropoutResidualLayerNorm, FusedEcMoe)
@@ -191,6 +192,13 @@ class TestFleetRoleMakerUtil:
         assert [a.tolist() for a in batch["ids"]] == [[7, 8], [9]]
 
 
+def test_resnext_variant_names_resolve():
+    for name in ("resnext50_64x4d", "resnext101_32x4d",
+                 "resnext152_32x4d", "resnext152_64x4d"):
+        assert callable(getattr(pt.vision.models, name))
+
+
+@pytest.mark.slow
 def test_resnext_variants_forward():
     for name in ("resnext50_64x4d", "resnext101_32x4d",
                  "resnext152_32x4d", "resnext152_64x4d"):

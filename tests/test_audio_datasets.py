@@ -28,6 +28,7 @@ def data_home(tmp_path, monkeypatch):
     return home
 
 
+@pytest.mark.slow
 def test_tess_folds_and_features(data_home):
     d = os.path.join(data_home, "TESS_Toronto_emotional_speech_set")
     os.makedirs(d)

@@ -421,6 +421,7 @@ class TestYoloLoss:
                                        Tensor(gtl2), **kw)._data)
         assert l_two[0] > l_one[0]       # second gt's loc+cls terms added
 
+    @pytest.mark.slow
     def test_degenerate_height_box_is_padding(self):
         x, gtb, gtl, kw = self._setup()
         gtb2 = gtb.copy()

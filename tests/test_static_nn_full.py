@@ -15,6 +15,7 @@ def _t(a):
 
 
 class TestLayerWrappers:
+    @pytest.mark.slow
     def test_norm_wrappers_match_layers(self):
         pt.seed(0)
         x = _t(np.random.RandomState(0).randn(2, 4, 6, 6)
@@ -38,6 +39,20 @@ class TestLayerWrappers:
         assert tuple(S.conv3d_transpose(x, 3, 2, stride=2).shape) == \
             (1, 3, 8, 8, 8)
 
+    def test_bilinear_fast(self):
+        pt.seed(0)
+        a = _t(np.random.RandomState(0).randn(3, 4).astype(np.float32))
+        b = _t(np.random.RandomState(1).randn(3, 5).astype(np.float32))
+        assert tuple(S.bilinear_tensor_product(a, b, 6).shape) == (3, 6)
+
+    def test_row_conv_fast(self):
+        pt.seed(0)
+        seq = _t(np.random.RandomState(1).randn(1, 4, 2)
+                 .astype(np.float32))
+        rc = S.row_conv(seq, future_context_size=1)
+        assert tuple(rc.shape) == (1, 4, 2)
+
+    @pytest.mark.slow
     def test_bilinear_and_deform(self):
         pt.seed(0)
         a = _t(np.random.RandomState(0).randn(3, 4).astype(np.float32))
@@ -50,6 +65,7 @@ class TestLayerWrappers:
         out = S.deform_conv2d(x, off, mask, 4, 3, padding=1)
         assert tuple(out.shape) == (1, 4, 5, 5)
 
+    @pytest.mark.slow
     def test_data_norm_row_conv_nce(self):
         pt.seed(0)
         x = _t(np.random.RandomState(0).randn(8, 4).astype(np.float32))
