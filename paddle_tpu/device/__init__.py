@@ -183,3 +183,58 @@ def synchronize(device=None):
     except Exception:
         pass
     (jax.device_put(0) + 0).block_until_ready()
+
+
+# -- cuda-namespace parity additions (alias the device-level surface;
+# ref device/cuda/__init__.py) -------------------------------------------
+cuda.Stream = Stream
+cuda.Event = Event
+cuda.current_stream = staticmethod(current_stream)
+cuda.stream_guard = stream_guard
+
+
+def _mem_stat(which, device=None):
+    try:
+        import jax
+        stats = jax.local_devices()[0].memory_stats() or {}
+        return int(stats.get(which, 0))
+    except Exception:
+        return 0
+
+
+def _memory_reserved(device=None):
+    return _mem_stat("bytes_reserved") or _mem_stat("bytes_in_use")
+
+
+def _max_memory_reserved(device=None):
+    return _mem_stat("peak_bytes_in_use")
+
+
+cuda.memory_reserved = staticmethod(_memory_reserved)
+cuda.max_memory_reserved = staticmethod(_max_memory_reserved)
+
+
+def _get_device_properties(device=None):
+    import jax
+    d = jax.local_devices()[0]
+    class _Props:
+        name = getattr(d, "device_kind", "cpu")
+        major, minor = 0, 0
+        total_memory = _mem_stat("bytes_limit")
+        multi_processor_count = 1
+    return _Props()
+
+
+cuda.get_device_properties = staticmethod(_get_device_properties)
+cuda.get_device_name = staticmethod(
+    lambda device=None: _get_device_properties(device).name)
+cuda.get_device_capability = staticmethod(lambda device=None: (0, 0))
+
+
+class xpu:
+    """``paddle.device.xpu`` parity shim (no XPU in a TPU build; the
+    one exported name joins the ordered XLA stream like the others)."""
+
+    @staticmethod
+    def synchronize(device=None):
+        return synchronize(device)

@@ -12,7 +12,9 @@ from .fleet import (  # noqa: F401
 )
 from ..topology import HybridCommunicateGroup, CommunicateTopology  # noqa: F401
 from . import recompute as _recompute_mod  # noqa: F401
-from .recompute import recompute  # noqa: F401
+from .recompute import (  # noqa: F401
+    recompute, recompute_hybrid, recompute_sequential,
+)
 from . import utils  # noqa: F401
 from .role_maker import (  # noqa: F401
     PaddleCloudRoleMaker, Role, UserDefinedRoleMaker,

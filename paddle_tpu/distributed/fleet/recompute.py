@@ -107,3 +107,11 @@ def recompute_sequential(ctx, functions, *args, **kwargs):
             out = (out,)
         i += seg
     return out[0] if isinstance(out, tuple) and len(out) == 1 else out
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    """Recompute under hybrid parallelism (ref ``recompute.py:520``):
+    the mp_group/offload knobs in ``ctx`` tune the reference's CUDA rng
+    + offload bookkeeping; on TPU XLA remat owns scheduling, so they
+    are accepted and the function recomputes like :func:`recompute`."""
+    return recompute(function, *args, **kwargs)

@@ -31,3 +31,131 @@ class LocalFS:
             shutil.rmtree(path, ignore_errors=True)
         elif os.path.exists(path):
             os.remove(path)
+
+
+class HDFSClient:
+    """HDFS filesystem client over the hadoop CLI (ref:
+    ``fleet/utils/fs.py:424 HDFSClient`` — the reference shells out to
+    ``hadoop fs`` exactly the same way). Requires a hadoop installation;
+    constructing without one raises immediately with the reason."""
+
+    def __init__(self, hadoop_home, configs=None, time_out=5 * 60 * 1000,
+                 sleep_inter=1000):
+        import os
+        self._base = os.path.join(hadoop_home, "bin", "hadoop")
+        if not os.path.exists(self._base):
+            raise RuntimeError(
+                f"hadoop binary not found at {self._base}; HDFSClient "
+                f"needs a hadoop installation (hadoop_home)")
+        self._cfg = []
+        for k, v in (configs or {}).items():
+            self._cfg += ["-D", f"{k}={v}"]
+        self._timeout = time_out / 1000.0
+
+    def _run(self, *args):
+        import subprocess
+        out = subprocess.run([self._base, "fs"] + self._cfg + list(args),
+                             capture_output=True, text=True,
+                             timeout=self._timeout)
+        return out.returncode, out.stdout, out.stderr
+
+    def is_exist(self, path):
+        rc, _, _ = self._run("-test", "-e", path)
+        return rc == 0
+
+    def is_dir(self, path):
+        rc, _, _ = self._run("-test", "-d", path)
+        return rc == 0
+
+    def is_file(self, path):
+        return self.is_exist(path) and not self.is_dir(path)
+
+    def ls_dir(self, path):
+        rc, out, err = self._run("-ls", path)
+        if rc != 0:
+            return [], []
+        dirs, files = [], []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = parts[-1].rsplit("/", 1)[-1]
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def mkdirs(self, path):
+        rc, _, err = self._run("-mkdir", "-p", path)
+        if rc != 0:
+            raise RuntimeError(f"hdfs mkdirs failed: {err.strip()}")
+
+    def delete(self, path):
+        # -f: deleting a missing path is success, real failures raise
+        rc, _, err = self._run("-rm", "-r", "-f", path)
+        if rc != 0:
+            raise RuntimeError(f"hdfs delete failed: {err.strip()}")
+
+    def upload(self, local_path, fs_path, multi_processes=1,
+               overwrite=False):
+        if overwrite:
+            self.delete(fs_path)
+        rc, _, err = self._run("-put", local_path, fs_path)
+        if rc != 0:
+            raise RuntimeError(f"hdfs upload failed: {err.strip()}")
+
+    def download(self, fs_path, local_path, multi_processes=1,
+                 overwrite=False):
+        rc, _, err = self._run("-get", fs_path, local_path)
+        if rc != 0:
+            raise RuntimeError(f"hdfs download failed: {err.strip()}")
+
+    def touch(self, fs_path, exist_ok=True):
+        rc, _, err = self._run("-touchz", fs_path)
+        if rc != 0 and not exist_ok:
+            raise RuntimeError(f"hdfs touch failed: {err.strip()}")
+
+    def mv(self, src, dst, overwrite=False):
+        if overwrite:
+            self.delete(dst)
+        rc, _, err = self._run("-mv", src, dst)
+        if rc != 0:
+            raise RuntimeError(f"hdfs mv failed: {err.strip()}")
+
+    def cat(self, fs_path):
+        rc, out, _ = self._run("-cat", fs_path)
+        return out if rc == 0 else ""
+
+
+class DistributedInfer:
+    """PS-era distributed inference helper (ref:
+    ``fleet/utils/ps_util.py:24``): in the reference it rewrites the
+    program to pull remote sparse tables before inference. Tables here
+    live in the executor scope already, so get_dirname/init handling
+    reduces to loading persistables if a dirname is given."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        from ....static.graph import (default_main_program,
+                                      default_startup_program)
+        self.origin_main_program = main_program \
+            if main_program is not None else default_main_program()
+        self.startup_program = startup_program \
+            if startup_program is not None else default_startup_program()
+        self._inited = False
+
+    def init_distributed_infer_env(self, exe, loss, role_maker=None,
+                                   dirname=None):
+        if self._inited:
+            return
+        if dirname:
+            from ...io import load_persistables
+            load_persistables(exe, dirname, self.origin_main_program)
+        self._inited = True
+
+    def get_dist_infer_program(self):
+        """The reference splices sparse-table pulls into a clone; the
+        scope-resident tables make the original program already the
+        inference program."""
+        return self.origin_main_program
+
+
+__all__ = ["LocalFS", "HDFSClient", "DistributedInfer", "recompute",
+           "recompute_sequential"]

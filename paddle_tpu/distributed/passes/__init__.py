@@ -11,9 +11,9 @@ XLA computation. The reference's CPP pass wrapper has no analog: XLA's
 own pipeline owns low-level fusion.
 """
 from .pass_base import (  # noqa: F401
-    PassBase, PassContext, PassType, new_pass, register_pass,
+    PassBase, PassContext, PassManager, PassType, new_pass, register_pass,
 )
 from . import builtin  # noqa: F401  (registers the built-in passes)
 
-__all__ = ["PassBase", "PassContext", "PassType", "new_pass",
-           "register_pass"]
+__all__ = ["PassBase", "PassContext", "PassManager", "PassType",
+           "new_pass", "register_pass"]

@@ -3,8 +3,8 @@ from __future__ import annotations
 
 from abc import ABC, abstractmethod
 
-__all__ = ["PassContext", "PassType", "PassBase", "register_pass",
-           "new_pass"]
+__all__ = ["PassContext", "PassType", "PassBase", "PassManager",
+           "register_pass", "new_pass"]
 
 
 class PassContext:
@@ -139,3 +139,45 @@ def new_pass(name, pass_attrs=None):
     for k, v in (pass_attrs or {}).items():
         pass_obj.set_attr(k, v)
     return pass_obj
+
+
+class PassManager:
+    """Apply an ordered list of passes (ref ``pass_base.py:349``).
+    ``auto_solve_conflict`` reorders so FUSION_OPT passes run last (the
+    one common rule with meaning here) and drops later duplicates that
+    conflict with already-scheduled passes."""
+
+    def __init__(self, passes, context=None, auto_solve_conflict=True):
+        self._context = context if context is not None else PassContext()
+        passes = list(passes)
+        if auto_solve_conflict:
+            ordered = ([p for p in passes
+                        if p._type() != PassType.FUSION_OPT]
+                       + [p for p in passes
+                          if p._type() == PassType.FUSION_OPT])
+            kept = []
+            for p in ordered:
+                if all(p._check_conflict_including_common_rules(q)
+                       for q in kept):
+                    kept.append(p)
+            self._passes = kept
+        else:
+            self._passes = passes
+
+    def apply(self, main_programs, startup_programs):
+        for p in self._passes:
+            self._context = p.apply(main_programs, startup_programs,
+                                    self._context)
+        return self._context
+
+    @property
+    def context(self):
+        return self._context
+
+    @property
+    def names(self):
+        return [p.name for p in self._passes]
+
+    @property
+    def passes(self):
+        return tuple(self._passes)

@@ -6,6 +6,7 @@ from . import autograd  # noqa: F401
 from . import autotune  # noqa: F401
 from . import asp  # noqa: F401
 from . import multiprocessing  # noqa: F401
+from . import distributed  # noqa: F401
 from .operators import (  # noqa: F401
     graph_khop_sampler, graph_reindex, graph_sample_neighbors,
     graph_send_recv, identity_loss, softmax_mask_fuse,

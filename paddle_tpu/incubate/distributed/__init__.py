@@ -1,3 +1,5 @@
 """``paddle_tpu.incubate.distributed`` (ref:
 ``python/paddle/incubate/distributed/``)."""
 from . import models  # noqa: F401
+
+from . import fleet  # noqa: F401

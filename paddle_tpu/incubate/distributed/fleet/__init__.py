@@ -1,0 +1,6 @@
+"""ref ``python/paddle/incubate/distributed/fleet/__init__.py``."""
+from ....distributed.fleet.recompute import (  # noqa: F401
+    recompute_hybrid, recompute_sequential,
+)
+
+__all__ = ["recompute_sequential", "recompute_hybrid"]

@@ -208,3 +208,249 @@ def fused_gate_attention(query, key=None, query_weight=None, key_weight=None,
         return out
 
     return nary(f, list(tensors.values()), name="fused_gate_attention")
+
+
+def _fused_ln(h, g, b, eps):
+    import jax.numpy as jnp
+    mu = h.mean(-1, keepdims=True)
+    var = ((h - mu) ** 2).mean(-1, keepdims=True)
+    out = (h - mu) / jnp.sqrt(var + eps)
+    if g is not None:
+        out = out * g
+    if b is not None:
+        out = out + b
+    return out
+
+
+def _fused_drop(h, p, key, mode, training):
+    """One dropout semantics for every fused block: train-time masking
+    with upscale, or the downscale_in_infer (1-p) inference scaling."""
+    import jax
+    import jax.numpy as jnp
+    if key is not None:
+        keep = jax.random.bernoulli(key, 1.0 - p, h.shape)
+        s = 1.0 / (1.0 - p) if mode == "upscale_in_train" else 1.0
+        return jnp.where(keep, h * s, 0.0).astype(h.dtype)
+    if mode == "downscale_in_infer" and not training and p > 0:
+        return h * (1 - p)
+    return h
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.5,
+                                           ln_epsilon=1e-5, training=True,
+                                           mode="upscale_in_train",
+                                           name=None):
+    """layer_norm(residual + dropout(x + bias)) as one fusion region
+    (ref ``incubate/nn/functional/fused_transformer.py:275``)."""
+    import jax
+    import jax.numpy as jnp
+    from ....ops.op_utils import ensure_tensor, nary
+    from ....framework import random as _random
+    x, residual = ensure_tensor(x), ensure_tensor(residual)
+    p = dropout_rate if training else 0.0
+    key = _random.next_key() if p > 0 else None
+    extras = [ensure_tensor(t) for t in (bias, ln_scale, ln_bias)
+              if t is not None]
+    has = [t is not None for t in (bias, ln_scale, ln_bias)]
+
+    def f(xd, rd, *rest):
+        it = iter(rest)
+        b = next(it) if has[0] else None
+        g = next(it) if has[1] else None
+        lb = next(it) if has[2] else None
+        h = xd + b if b is not None else xd
+        h = _fused_drop(h, dropout_rate, key, mode, training)
+        return _fused_ln(rd + h, g, lb, ln_epsilon)
+
+    return nary(f, [x, residual] + extras,
+                name="fused_bias_dropout_residual_layer_norm")
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True,
+                      mode="upscale_in_train", ring_id=-1,
+                      add_residual=True, name=None):
+    """Transformer FFN block as one region (ref
+    ``fused_transformer.py:32`` pseudo code, pre/post-LN variants)."""
+    import jax
+    import jax.numpy as jnp
+    from ....ops.op_utils import ensure_tensor, nary
+    from ....framework import random as _random
+    x = ensure_tensor(x)
+    p1 = dropout1_rate if training else 0.0
+    p2 = dropout2_rate if training else 0.0
+    k1 = _random.next_key() if p1 > 0 else None
+    k2 = _random.next_key() if p2 > 0 else None
+    act = {"relu": jax.nn.relu, "gelu": jax.nn.gelu}[activation]
+    opt = (linear1_bias, linear2_bias, ln1_scale, ln1_bias, ln2_scale,
+           ln2_bias)
+    has = [t is not None for t in opt]
+    extras = [ensure_tensor(t) for t in opt if t is not None]
+
+    def f(xd, w1, w2, *rest):
+        it = iter(rest)
+        vals = [next(it) if h else None for h in has]
+        b1, b2, g1, lb1, g2, lb2 = vals
+        residual = xd
+        h = _fused_ln(xd, g1, lb1, ln1_epsilon) if pre_layer_norm else xd
+        h = h @ w1
+        if b1 is not None:
+            h = h + b1
+        h = _fused_drop(act(h), dropout1_rate, k1, mode, training)
+        h = h @ w2
+        if b2 is not None:
+            h = h + b2
+        h = _fused_drop(h, dropout2_rate, k2, mode, training)
+        if add_residual:
+            h = residual + h
+        if not pre_layer_norm:
+            h = _fused_ln(h, g2, lb2, ln2_epsilon)
+        return h
+
+    return nary(f, [x, ensure_tensor(linear1_weight),
+                    ensure_tensor(linear2_weight)] + extras,
+                name="fused_feedforward")
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-5,
+                               qkv_bias=None, linear_bias=None,
+                               cache_kv=None, attn_mask=None,
+                               dropout_rate=0.5, attn_dropout_rate=0.5,
+                               ln_epsilon=1e-5, training=True,
+                               mode="upscale_in_train", ring_id=-1,
+                               add_residual=True, num_heads=-1,
+                               transpose_qkv_wb=False, name=None):
+    """Self-attention block as one region (ref
+    ``fused_transformer.py:465`` pseudo code): optional pre-LN, packed
+    qkv projection (qkv_weight (3, H, h, D) or 2-D with
+    ``transpose_qkv_wb``), scaled dot-product with mask + dropout,
+    output projection, residual + post-LN."""
+    import jax
+    import jax.numpy as jnp
+    from ....ops.op_utils import ensure_tensor, nary
+    from ....framework import random as _random
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "fused_multi_head_attention cache_kv: use "
+            "nn.MultiHeadAttention's cache path for decoding")
+    x = ensure_tensor(x)
+    p_att = attn_dropout_rate if training else 0.0
+    p_out = dropout_rate if training else 0.0
+    ka = _random.next_key() if p_att > 0 else None
+    ko = _random.next_key() if p_out > 0 else None
+    opt = (pre_ln_scale, pre_ln_bias, ln_scale, ln_bias, qkv_bias,
+           linear_bias, attn_mask)
+    has = [t is not None for t in opt]
+    extras = [ensure_tensor(t) for t in opt if t is not None]
+
+    def f(xd, qkv_w, lin_w, *rest):
+        it = iter(rest)
+        vals = [next(it) if h else None for h in has]
+        pg, pb, g, lb, qb, ob, mask = vals
+        B, S, H = xd.shape
+        residual = xd
+        h = _fused_ln(xd, pg, pb, pre_ln_epsilon) if pre_layer_norm \
+            else xd
+        if transpose_qkv_wb:  # (H, 3H) layout
+            if num_heads <= 0:
+                raise ValueError(
+                    "transpose_qkv_wb=True requires num_heads > 0")
+            nh = num_heads
+            qkv = h @ qkv_w
+            if qb is not None:
+                qkv = qkv + qb
+            qkv = qkv.reshape(B, S, 3, nh, H // nh)
+        else:  # (3, num_heads, head_dim, H) layout
+            nh, hd = qkv_w.shape[1], qkv_w.shape[2]
+            qkv = jnp.einsum("bsh,tndh->bstnd", h, qkv_w)
+            if qb is not None:
+                qkv = qkv + qb.reshape(3, nh, hd)[None, None]
+        q, k, v = (jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))
+        logits = jnp.einsum("bnqd,bnkd->bnqk", q, k) / jnp.sqrt(
+            jnp.asarray(q.shape[-1], jnp.float32)).astype(xd.dtype)
+        if mask is not None:
+            logits = logits + mask.astype(logits.dtype)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), -1) \
+            .astype(xd.dtype)
+        probs = _fused_drop(probs, attn_dropout_rate, ka, mode,
+                            training)
+        ctx = jnp.einsum("bnqk,bnkd->bnqd", probs, v)
+        ctx = jnp.moveaxis(ctx, 1, 2).reshape(B, S, -1)
+        out = ctx @ lin_w
+        if ob is not None:
+            out = out + ob
+        out = _fused_drop(out, dropout_rate, ko, mode, training)
+        if add_residual:
+            out = residual + out
+        if not pre_layer_norm:
+            out = _fused_ln(out, g, lb, ln_epsilon)
+        return out
+
+    return nary(f, [x, ensure_tensor(qkv_weight),
+                    ensure_tensor(linear_weight)] + extras,
+                name="fused_multi_head_attention")
+
+
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights,
+                            qkv_biases, linear_weights, linear_biases,
+                            ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+                            ffn1_biases, ffn2_weights, ffn2_biases,
+                            pre_layer_norm=True, epsilon=1e-05,
+                            cache_kvs=None, pre_caches=None,
+                            seq_lens=None, rotary_embs=None,
+                            rotary_emb_dims=0, time_step=None,
+                            attn_mask=None, dropout_rate=0.0,
+                            activation="gelu", training=False,
+                            mode="upscale_in_train", trans_qkvw=True,
+                            ring_id=-1, name=None):
+    """Stack of fused transformer layers (ref
+    ``fused_transformer.py:873``): per-layer fused_multi_head_attention
+    + fused_feedforward, weights given as per-layer lists."""
+    if cache_kvs is not None or pre_caches is not None or \
+            time_step is not None:
+        raise NotImplementedError(
+            "fused_multi_transformer decode caches: use the "
+            "incubate.nn.FusedMultiTransformer layer for generation")
+    out = x
+    n_layers = len(qkv_weights)
+
+    def at(lst, i):
+        return None if lst is None else lst[i]
+
+    if not trans_qkvw:
+        raise NotImplementedError(
+            "fused_multi_transformer with trans_qkvw=False: pass the "
+            "(3, num_heads, head_dim, H) qkv weight layout instead")
+    for i in range(n_layers):
+        out = fused_multi_head_attention(
+            out, qkv_weights[i], linear_weights[i],
+            pre_layer_norm=pre_layer_norm, pre_ln_scale=at(ln_scales, i),
+            pre_ln_bias=at(ln_biases, i), ln_scale=at(ln_scales, i),
+            ln_bias=at(ln_biases, i), qkv_bias=at(qkv_biases, i),
+            linear_bias=at(linear_biases, i), attn_mask=attn_mask,
+            dropout_rate=dropout_rate, attn_dropout_rate=dropout_rate,
+            pre_ln_epsilon=epsilon, ln_epsilon=epsilon,
+            training=training, mode=mode, transpose_qkv_wb=False)
+        out = fused_feedforward(
+            out, ffn1_weights[i], ffn2_weights[i],
+            linear1_bias=at(ffn1_biases, i), linear2_bias=at(ffn2_biases, i),
+            ln1_scale=at(ffn_ln_scales, i), ln1_bias=at(ffn_ln_biases, i),
+            ln2_scale=at(ffn_ln_scales, i), ln2_bias=at(ffn_ln_biases, i),
+            dropout1_rate=dropout_rate, dropout2_rate=dropout_rate,
+            activation=activation, ln1_epsilon=epsilon,
+            ln2_epsilon=epsilon, pre_layer_norm=pre_layer_norm,
+            training=training, mode=mode)
+    return out
+
+
+__all__ += ["fused_bias_dropout_residual_layer_norm", "fused_feedforward",
+            "fused_multi_head_attention", "fused_multi_transformer"]

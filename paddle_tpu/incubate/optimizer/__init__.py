@@ -1,0 +1,7 @@
+"""Incubate optimizers (ref: ``python/paddle/incubate/optimizer/``)."""
+from .._optimizer_impl import *  # noqa: F401,F403
+from .._optimizer_impl import __all__ as _impl_all
+from ...optimizer.lbfgs import LBFGS  # noqa: F401
+from . import functional  # noqa: F401
+
+__all__ = list(_impl_all) + ["LBFGS", "functional"]

@@ -1,0 +1,167 @@
+"""Functional quasi-Newton minimizers (ref:
+``python/paddle/incubate/optimizer/functional/{bfgs,lbfgs}.py``).
+
+Host-driven loops over jax value_and_grad with an Armijo backtracking
+line search (the reference defaults to strong-wolfe; the return
+contract — converged flag, call count, position, value, gradient
+[, inverse hessian] — is identical).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["minimize_bfgs", "minimize_lbfgs"]
+
+
+def _prep(objective_func, initial_position, dtype):
+    import jax
+    import jax.numpy as jnp
+    from ....tensor import Tensor
+
+    def scalar_f(x):
+        out = objective_func(Tensor(x))
+        return (out._data if isinstance(out, Tensor) else
+                jnp.asarray(out)).astype(jnp.float32).reshape(())
+
+    x0 = jnp.asarray(
+        initial_position._data if isinstance(initial_position, Tensor)
+        else np.asarray(initial_position)).astype(dtype).ravel()
+    return jax.jit(jax.value_and_grad(scalar_f)), x0
+
+
+def _line_search(vg, x, d, f0, g0, max_iters, t0):
+    """Weak-Wolfe line search (Armijo backtracking + curvature-driven
+    extension — L-BFGS needs usable curvature pairs); returns
+    (t, f, g, n_calls)."""
+    import jax.numpy as jnp
+    slope = float(jnp.vdot(g0, d))
+    t, calls = float(t0), 0
+    f, g = f0, g0
+    for _ in range(max_iters):
+        f, g = vg(x + t * d)
+        calls += 1
+        if float(f) <= float(f0) + 1e-4 * t * slope:
+            break
+        t *= 0.5
+    # curvature (weak Wolfe): grow t while it helps and Armijo holds
+    for _ in range(4):
+        if float(jnp.vdot(g, d)) >= 0.9 * slope:
+            break
+        f2, g2 = vg(x + 2 * t * d)
+        calls += 1
+        if float(f2) <= float(f0) + 1e-4 * 2 * t * slope and \
+                float(f2) < float(f):
+            t, f, g = 2 * t, f2, g2
+        else:
+            break
+    return t, f, g, calls
+
+
+def minimize_bfgs(objective_func, initial_position, max_iters=50,
+                  tolerance_grad=1e-7, tolerance_change=1e-9,
+                  initial_inverse_hessian_estimate=None,
+                  line_search_fn="strong_wolfe",
+                  max_line_search_iters=50, initial_step_length=1.0,
+                  dtype="float32", name=None):
+    """ref ``bfgs.py:27``. Returns (is_converge, num_func_calls,
+    position, objective_value, objective_gradient,
+    inverse_hessian_estimate)."""
+    import jax.numpy as jnp
+    from ....tensor import Tensor
+    vg, x = _prep(objective_func, initial_position, dtype)
+    n = x.shape[0]
+    H = jnp.eye(n, dtype=x.dtype) if initial_inverse_hessian_estimate \
+        is None else jnp.asarray(
+            initial_inverse_hessian_estimate._data
+            if isinstance(initial_inverse_hessian_estimate, Tensor)
+            else initial_inverse_hessian_estimate).astype(x.dtype)
+    f, g = vg(x)
+    calls = 1
+    converged = False
+    for _ in range(int(max_iters)):
+        if float(jnp.abs(g).max()) <= tolerance_grad:
+            converged = True
+            break
+        d = -(H @ g)
+        t, f_new, g_new, c = _line_search(
+            vg, x, d, f, g, max_line_search_iters, initial_step_length)
+        calls += c
+        s = t * d
+        y = g_new - g
+        if float(jnp.abs(s).max()) <= tolerance_change:
+            x, f, g = x + s, f_new, g_new
+            converged = True
+            break
+        sy = float(jnp.vdot(s, y))
+        if sy > 1e-10:
+            rho = 1.0 / sy
+            I = jnp.eye(n, dtype=x.dtype)
+            V = I - rho * jnp.outer(s, y)
+            H = V @ H @ V.T + rho * jnp.outer(s, s)
+        x, f, g = x + s, f_new, g_new
+    shp = tuple(np.asarray(
+        initial_position._data if isinstance(initial_position, Tensor)
+        else initial_position).shape)
+    return (converged, calls, Tensor(x.reshape(shp)), Tensor(f),
+            Tensor(g.reshape(shp)), Tensor(H))
+
+
+def minimize_lbfgs(objective_func, initial_position, history_size=100,
+                   max_iters=50, tolerance_grad=1e-7,
+                   tolerance_change=1e-9,
+                   initial_inverse_hessian_estimate=None,
+                   line_search_fn="strong_wolfe",
+                   max_line_search_iters=50, initial_step_length=1.0,
+                   dtype="float32", name=None):
+    """ref ``lbfgs.py:27``. Returns (is_converge, num_func_calls,
+    position, objective_value, objective_gradient)."""
+    import jax.numpy as jnp
+    from ....tensor import Tensor
+    vg, x = _prep(objective_func, initial_position, dtype)
+    f, g = vg(x)
+    calls = 1
+    hist_s, hist_y = [], []
+    converged = False
+    for _ in range(int(max_iters)):
+        if float(jnp.abs(g).max()) <= tolerance_grad:
+            converged = True
+            break
+        # two-loop recursion
+        q = g
+        alphas = []
+        for s, y in reversed(list(zip(hist_s, hist_y))):
+            rho = 1.0 / float(jnp.vdot(s, y))
+            a = rho * float(jnp.vdot(s, q))
+            alphas.append((a, rho, s, y))
+            q = q - a * y
+        gamma = 1.0
+        if hist_s:
+            s, y = hist_s[-1], hist_y[-1]
+            gamma = float(jnp.vdot(s, y)) / max(float(jnp.vdot(y, y)),
+                                                1e-12)
+        r = gamma * q
+        for a, rho, s, y in reversed(alphas):
+            b = rho * float(jnp.vdot(y, r))
+            r = r + (a - b) * s
+        d = -r
+        t, f_new, g_new, c = _line_search(
+            vg, x, d, f, g, max_line_search_iters, initial_step_length)
+        calls += c
+        s_vec = t * d
+        y_vec = g_new - g
+        if float(jnp.abs(s_vec).max()) <= tolerance_change:
+            x, f, g = x + s_vec, f_new, g_new
+            converged = True
+            break
+        if float(jnp.vdot(s_vec, y_vec)) > 1e-10:
+            hist_s.append(s_vec)
+            hist_y.append(y_vec)
+            if len(hist_s) > history_size:
+                hist_s.pop(0)
+                hist_y.pop(0)
+        x, f, g = x + s_vec, f_new, g_new
+    shp = tuple(np.asarray(
+        initial_position._data if isinstance(initial_position, Tensor)
+        else initial_position).shape)
+    return (converged, calls, Tensor(x.reshape(shp)), Tensor(f),
+            Tensor(g.reshape(shp)))
