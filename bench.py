@@ -749,16 +749,23 @@ def main():
         errors["eager_dispatch"] = _error_tail(traceback.format_exc(limit=5))
     emit()
 
-    # --- canary: is the tunnel alive? Two watchdogged attempts (the
-    # tunnel has been observed taking >2.5 min just to hand out
-    # jax.local_devices()). A dead canary REDUCES the leg list — it
-    # must not zero it (the r04 failure: probe timeout => no TPU legs
-    # at all => nothing to judge).
-    canary_ok = merge(_run_leg("canary", LEG_TIMEOUT["canary"]), "canary")
-    if not canary_ok and remaining() > LEG_TIMEOUT["canary_retry"] + 120:
+    # --- canary: is the tunnel alive? A *fast* canary failure (import
+    # error, refused connection) gets a watchdogged retry — the tunnel
+    # has been observed taking >2.5 min just to hand out
+    # jax.local_devices(), so transients deserve a second look. A canary
+    # *watchdog timeout* is different: the process sat the full budget
+    # with a hung tunnel, and stacking a 420 s retry plus 600-900 s
+    # heavy legs on top is exactly the rc=124 driver kill of r05.
+    # Timeout => no retry, no heavy legs, one fast-fail record.
+    rec = _run_leg("canary", LEG_TIMEOUT["canary"])
+    canary_ok = merge(rec, "canary")
+    canary_hung = bool(rec.get("timeout"))
+    if (not canary_ok and not canary_hung
+            and remaining() > LEG_TIMEOUT["canary_retry"] + 120):
         time.sleep(5 if SMOKE else 30)
-        canary_ok = merge(_run_leg("canary", LEG_TIMEOUT["canary_retry"]),
-                          "canary")
+        rec = _run_leg("canary", LEG_TIMEOUT["canary_retry"])
+        canary_ok = merge(rec, "canary")
+        canary_hung = bool(rec.get("timeout"))
 
     def leg_budget(name):
         t = min(LEG_TIMEOUT[name], max(remaining() - 60, 0))
@@ -848,8 +855,18 @@ def main():
                             errors.pop(f"bert_b{bb}", None)
                     return
         bert_ladder()
+    elif canary_hung:
+        # the canary burned its whole watchdog with the tunnel hung:
+        # the heavy legs would do the same (their compiles alone exceed
+        # the canary's matmul). Emit the fast-fail record and stop —
+        # total wall stays ~eager + one canary budget instead of
+        # 300 + 420 + 600+ s of stacked watchdogs.
+        result["tpu_unreachable"] = True
+        errors["tpu"] = ("canary watchdog timeout — tunnel unreachable; "
+                         "heavy legs skipped (fast-fail)")
     else:
-        # tunnel looked dead — still attempt the two headline legs with
+        # canary failed fast (not a hang) — the tunnel may be recovering
+        # from a transient, so still attempt the two headline legs with
         # watchdogs; worst case they burn their timeouts and we report.
         try_leg("resnet")
         b, rc = _gpt_ladder_start()

@@ -130,8 +130,10 @@ class CostModel:
         }
         rows = []
         for name, (inp, f) in specs.items():
-            fwd = jax.jit(f)
-            bwd = jax.jit(jax.grad(f))
+            # each iteration compiles a DIFFERENT op on purpose — this
+            # is the benchmark that builds the cost table, not a hot path
+            fwd = jax.jit(f)  # tpu-lint: disable=TPU001
+            bwd = jax.jit(jax.grad(f))  # tpu-lint: disable=TPU001
 
             def timed(g):
                 jax.block_until_ready(g(inp))  # compile + warm, fully

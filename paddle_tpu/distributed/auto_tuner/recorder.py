@@ -57,7 +57,7 @@ class HistoryRecorder:
                     continue
                 try:
                     r[k] = json.loads(v)
-                except Exception:
-                    pass
+                except ValueError:
+                    pass  # not JSON: the raw CSV string is the value
         self.history = rows
         return rows, False

@@ -3,6 +3,7 @@ from __future__ import annotations
 
 import enum
 import json
+import logging
 import os
 import signal
 import subprocess
@@ -10,6 +11,8 @@ import threading
 import time
 
 __all__ = ["ElasticManager", "ElasticStatus", "LauncherInterface"]
+
+logger = logging.getLogger(__name__)
 
 _PREFIX = "elastic/nodes/"
 
@@ -84,8 +87,11 @@ class ElasticManager:
         while not self._stop.is_set():
             try:
                 self._beat()
-            except Exception:
-                pass
+            except Exception as e:
+                # a silent dead heartbeat gets this node evicted by its
+                # peers with nothing in the log to explain why
+                logger.warning("elastic heartbeat to store failed "
+                               "(node %s): %s", self.host, e)
             self._stop.wait(self.interval)
 
     def alive_nodes(self):
@@ -213,5 +219,8 @@ class ElasticManager:
             if getattr(self, "_slot", None) is not None:
                 self.store.delete(f"elastic/slot/{self._slot}")
             self.store.delete(_PREFIX + self.host)
-        except Exception:
-            pass
+        except Exception as e:
+            # best-effort on teardown (the lease expires anyway), but a
+            # swallowed store error here would also hide a dead store
+            logger.debug("elastic deregister failed for %s: %s",
+                         self.host, e)

@@ -58,6 +58,8 @@ def clear_preemption_handler():
     for sig, prev in _installed.items():
         try:
             signal.signal(sig, prev)
-        except Exception:
+        except (ValueError, OSError, TypeError):
+            # ValueError: not the main thread / bad signal number;
+            # restoring the rest still matters more than raising here
             pass
     _installed.clear()

@@ -9,9 +9,12 @@ so no second comm library exists just for CPU barriers.
 """
 from __future__ import annotations
 
+import logging
 import time
 
 __all__ = ["gloo_init_parallel_env", "gloo_barrier", "gloo_release"]
+
+logger = logging.getLogger(__name__)
 
 _gloo = {"store": None, "rank": 0, "world": 1, "round": 0}
 
@@ -57,6 +60,8 @@ def gloo_release():
     if store is not None:
         try:
             store.close()
-        except Exception:
-            pass
+        except Exception as e:
+            # release must not raise, but a close failure usually means
+            # peers are still blocked on this store — leave a trace
+            logger.warning("gloo_release: store close failed: %s", e)
     _gloo.update(store=None, rank=0, world=1, round=0)

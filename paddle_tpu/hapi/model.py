@@ -102,7 +102,9 @@ class Model:
                 training=False, forward_fn=fwd)
             outs = _to_list(outs)
             loss_v = None
-            if loss_fn is not None and labels:
+            # `labels` is a host-side list pytree: its truthiness is the
+            # arity of the batch, static under tracing, not a tensor bool
+            if loss_fn is not None and labels:  # tpu-lint: disable=TPU002
                 lbls = [Tensor(l) for l in labels]
                 loss = loss_fn(*(outs + lbls))
                 if isinstance(loss, (list, tuple)):
@@ -237,9 +239,14 @@ class Model:
             logs["loss"] = losses[0] if losses else None
             names = [n for m in self._metrics for n in _to_list(m.name())]
             for n, v in zip(names, metrics):
+                # per-batch metric materialization is the callback
+                # contract (on_batch_end receives floats, ref hapi)
+                # tpu-lint: disable=TPU007
                 logs[n] = float(np.asarray(v)) if not isinstance(v, list) \
                     else [float(x) for x in v]
-            logs["batch_size"] = (np.asarray(labels[0]).shape[0]
+            # np.shape reads metadata without copying device arrays to
+            # host (np.asarray here forced a full transfer per batch)
+            logs["batch_size"] = (np.shape(labels[0])[0]
                                   if labels else None)
             cbks.on_batch_end(mode, step, logs)
         return logs

@@ -44,7 +44,9 @@ def ones(shape, dtype=None, name=None):
 
 def full(shape, fill_value, dtype=None, name=None):
     if isinstance(fill_value, Tensor):
-        fill_value = fill_value.item()
+        # keep the fill value on device: jnp.full takes 0-d arrays, so
+        # no host sync and no tracer crash when called under jit
+        fill_value = fill_value._data.reshape(())
     if dtype is None:
         return Tensor(jnp.full(_shape(shape), fill_value))
     return Tensor(jnp.full(_shape(shape), fill_value, dtype=to_jax_dtype(dtype)))

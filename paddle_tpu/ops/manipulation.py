@@ -405,11 +405,15 @@ def _put_mul(d, i, v, axis):
 
 
 def masked_fill(x, mask, value, name=None):
-    val = value.item() if isinstance(value, Tensor) and value.size == 1 else value
-    if isinstance(val, Tensor):
-        return nary(lambda d, m, v: jnp.where(m, v.astype(d.dtype), d),
-                    [x, ensure_tensor(mask), val], name="masked_fill")
-    return nary(lambda d, m: jnp.where(m, jnp.asarray(val, dtype=d.dtype), d),
+    # tensor fills (any size, incl. 0-d) stay on device and broadcast in
+    # the jnp.where; the old size-1 .item() special case synced per call
+    if isinstance(value, Tensor):
+        # size-1 fills drop to 0-d (on device) so their rank never
+        # broadcasts the output wider than x, matching the scalar path
+        return nary(lambda d, m, v: jnp.where(
+            m, (v.reshape(()) if v.size == 1 else v).astype(d.dtype), d),
+            [x, ensure_tensor(mask), value], name="masked_fill")
+    return nary(lambda d, m: jnp.where(m, jnp.asarray(value, dtype=d.dtype), d),
                 [x, ensure_tensor(mask)], name="masked_fill")
 
 

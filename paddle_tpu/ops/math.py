@@ -259,8 +259,10 @@ def isfinite(x, name=None):
 
 
 def clip(x, min=None, max=None, name=None):
-    mn = min.item() if isinstance(min, Tensor) else min
-    mx = max.item() if isinstance(max, Tensor) else max
+    # tensor bounds stay on device: jnp.clip broadcasts 0-d arrays, and
+    # .item() here would stall the pipeline (and break under jit)
+    mn = min._data if isinstance(min, Tensor) else min
+    mx = max._data if isinstance(max, Tensor) else max
     return _unary(lambda d: jnp.clip(d, mn, mx), x, name="clip")
 
 

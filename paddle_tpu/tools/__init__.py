@@ -1,0 +1,6 @@
+"""Developer tooling (static analysis, codegen helpers).
+
+Deliberately empty: the lint modules themselves are pure ``ast`` — the
+only jax cost of ``python -m paddle_tpu.tools.lint`` is the parent
+package import, so the CLI works on accelerator-free boxes.
+"""

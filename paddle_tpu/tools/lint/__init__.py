@@ -1,0 +1,19 @@
+"""tpu-lint — tracing-safety and TPU-performance static analyzer.
+
+Pure-``ast`` (no jax import).  See rules.py for the catalog, README
+"Static analysis" for the CLI, and tests/test_tpu_lint.py for the
+self-clean gate that keeps the tree free of new violations.
+"""
+from .baseline import (default_baseline_path, diff_against_baseline,
+                       load_baseline, write_baseline)
+from .core import (Linter, Suppressions, Violation, iter_py_files,
+                   lint_file, lint_source, run_paths)
+from .rules import RULES, default_rules, register, rule_catalog
+
+__all__ = [
+    "Linter", "Suppressions", "Violation", "RULES",
+    "default_rules", "register", "rule_catalog",
+    "lint_source", "lint_file", "iter_py_files", "run_paths",
+    "default_baseline_path", "load_baseline", "write_baseline",
+    "diff_against_baseline",
+]

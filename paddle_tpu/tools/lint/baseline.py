@@ -1,0 +1,69 @@
+"""Baseline handling: grandfather existing violations, gate new ones.
+
+The baseline is a committed text file of violation keys
+(``path::RULE::<stripped source line>``).  Matching is a *multiset*
+compare: two identical ``x.item()`` lines in one file need two baseline
+entries, and fixing one of them without regenerating keeps the gate
+green (stale surplus entries are reported separately so they can be
+pruned).  Keys carry no line numbers, so edits elsewhere in a file never
+invalidate the baseline.
+"""
+from __future__ import annotations
+
+import os
+from collections import Counter
+
+__all__ = ["default_baseline_path", "load_baseline", "write_baseline",
+           "diff_against_baseline"]
+
+_HEADER = """\
+# tpu-lint baseline — grandfathered violations.
+#
+# Every entry is `path::RULE::<stripped source line>`.  The gate fails
+# only on violations NOT in this file.  Regenerate after intentional
+# changes with:
+#     python -m paddle_tpu.tools.lint --write-baseline paddle_tpu exp
+# Shrink it over time; never grow it to dodge a fix.
+"""
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.txt")
+
+
+def load_baseline(path: str) -> Counter:
+    """Keys -> allowed count.  A missing file is an empty baseline."""
+    counts: Counter = Counter()
+    if not os.path.exists(path):
+        return counts
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                counts[line] += 1
+    return counts
+
+
+def write_baseline(path: str, violations) -> int:
+    keys = sorted(v.key for v in violations)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(_HEADER)
+        for k in keys:
+            f.write(k + "\n")
+    return len(keys)
+
+
+def diff_against_baseline(violations, baseline: Counter):
+    """Split ``violations`` into (new, grandfathered) and report stale
+    baseline entries that no longer match anything."""
+    budget = Counter(baseline)
+    new, old = [], []
+    for v in violations:  # already sorted by (path, line): deterministic
+        if budget[v.key] > 0:
+            budget[v.key] -= 1
+            old.append(v)
+        else:
+            new.append(v)
+    stale = sorted(k for k, n in budget.items() if n > 0 for _ in range(n))
+    return new, old, stale

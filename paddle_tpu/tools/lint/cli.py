@@ -1,0 +1,99 @@
+"""tpu-lint CLI.
+
+``python -m paddle_tpu.tools.lint [paths...]`` (or the ``tpu-lint``
+console script).  Exit codes: 0 clean against the baseline, 1 new
+violations (or unparseable files), 2 usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .baseline import (default_baseline_path, diff_against_baseline,
+                       load_baseline, write_baseline)
+from .core import run_paths
+from .rules import default_rules, rule_catalog
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu-lint",
+        description="AST-based tracing-safety and TPU-performance linter "
+                    "for paddle_tpu (pure ast — never executes the "
+                    "linted code).")
+    p.add_argument("paths", nargs="*", default=["paddle_tpu"],
+                   help="files or directories to lint "
+                        "(default: paddle_tpu)")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help="baseline file (default: the committed "
+                        "tools/lint/baseline.txt)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every violation, ignoring the baseline")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="regenerate the baseline from the current tree "
+                        "and exit 0")
+    p.add_argument("--select", default=None, metavar="RULES",
+                   help="comma-separated rule ids to run "
+                        "(e.g. TPU001,TPU003)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="suppress per-violation output; summary only")
+    return p
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rid, name, rationale in rule_catalog():
+            print(f"{rid}  {name}")
+            print(f"       {rationale}")
+        return 0
+
+    try:
+        select = ([r.strip().upper() for r in args.select.split(",")
+                   if r.strip()] if args.select else None)
+        rules = default_rules(select)
+    except KeyError as e:
+        print(f"tpu-lint: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    violations, errors = run_paths(args.paths, rules=rules)
+    for path, msg in sorted(errors.items()):
+        print(f"{path}: ERROR {msg}", file=sys.stderr)
+
+    baseline_path = args.baseline or default_baseline_path()
+    if args.write_baseline:
+        n = write_baseline(baseline_path, violations)
+        print(f"tpu-lint: wrote {n} baseline entr"
+              f"{'y' if n == 1 else 'ies'} to {baseline_path}")
+        return 0
+
+    if args.no_baseline:
+        new, old, stale = violations, [], []
+    else:
+        new, old, stale = diff_against_baseline(
+            violations, load_baseline(baseline_path))
+
+    if not args.quiet:
+        for v in new:
+            print(v)
+        for k in stale:
+            print(f"stale baseline entry (violation no longer present — "
+                  f"prune it): {k}", file=sys.stderr)
+
+    summary = (f"tpu-lint: {len(new)} new violation"
+               f"{'' if len(new) == 1 else 's'}")
+    if old:
+        summary += f", {len(old)} baselined"
+    if stale:
+        summary += f", {len(stale)} stale baseline entries"
+    if errors:
+        summary += f", {len(errors)} unparseable files"
+    print(summary)
+    return 1 if (new or errors) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,459 @@
+"""tpu-lint core: scope-aware AST walking, suppressions, file running.
+
+The analyzer is a single :class:`Linter` pass per file.  It maintains the
+scope state every rule needs (function nesting, loop depth, which
+functions are jit/trace targets, which are ``lax.scan``-style bodies,
+per-function local bindings) and dispatches structural events to the
+rules registered in :mod:`.rules`.  Rules never re-walk the tree.
+
+Two properties matter for a lint gate that runs in CI forever:
+
+- **Never executes the linted code.**  Linting is pure
+  ``ast``/``tokenize``: no file under analysis is imported, so a broken
+  or accelerator-requiring module still gets linted.
+- **Stable violation keys.**  Baseline entries are keyed on
+  ``path::RULE::<stripped source line>`` rather than line numbers, so an
+  unrelated edit above a grandfathered violation does not invalidate the
+  baseline (same trick as clang-tidy's ``--export-fixes`` baselines).
+"""
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+# repo root = parents of paddle_tpu/tools/lint/core.py
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+__all__ = ["Violation", "Suppressions", "FuncInfo", "Linter",
+           "lint_source", "lint_file", "iter_py_files", "run_paths"]
+
+
+@dataclass(frozen=True)
+class Violation:
+    path: str        # normalized, repo-relative, posix separators
+    line: int
+    col: int
+    rule: str        # "TPU001"
+    message: str
+    line_text: str = ""
+
+    @property
+    def key(self) -> str:
+        """Baseline identity — content-addressed, line-number free."""
+        return f"{self.path}::{self.rule}::{self.line_text.strip()}"
+
+    def __str__(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule} {self.message}")
+
+
+_DIRECTIVE = re.compile(
+    r"#\s*tpu-lint:\s*disable=([A-Za-z]{3}\d{3}(?:\s*,\s*[A-Za-z]{3}\d{3})*"
+    r"|all)", re.IGNORECASE)
+
+
+class Suppressions:
+    """Per-line ``# tpu-lint: disable=RULE[,RULE...]`` directives.
+
+    A directive on a code line suppresses that line; a directive on a
+    standalone comment line suppresses the next line (pylint semantics).
+    ``disable=all`` suppresses every rule.
+    """
+
+    def __init__(self, source: str):
+        self._by_line: dict[int, set[str]] = {}
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _DIRECTIVE.search(tok.string)
+                if not m:
+                    continue
+                rules = {r.strip().upper()
+                         for r in m.group(1).split(",") if r.strip()}
+                line = tok.start[0]
+                self._by_line.setdefault(line, set()).update(rules)
+                if tok.line.lstrip().startswith("#"):
+                    # standalone comment: applies to the following line
+                    self._by_line.setdefault(line + 1, set()).update(rules)
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            pass  # unparseable comments never block the AST pass
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        s = self._by_line.get(line)
+        return bool(s) and ("ALL" in s or rule.upper() in s)
+
+
+# -- scope bookkeeping ------------------------------------------------------
+
+# dotted names whose call (or decorator) makes the wrapped function a
+# trace target: python control flow inside it runs on tracers
+_JIT_NAMES = {
+    "jax.jit", "jit", "pjit", "jax.pjit", "jax.experimental.pjit.pjit",
+    "to_static", "jit.to_static", "paddle_tpu.jit.to_static",
+}
+# transforms that trace arg0 (grad-like) — same hazards as jit for
+# control flow and leaks, though they don't themselves cache programs
+_TRACE_NAMES = _JIT_NAMES | {
+    "jax.grad", "jax.value_and_grad", "jax.vjp", "jax.jvp", "jax.vmap",
+    "jax.checkpoint", "jax.remat", "checkpoint", "jax.linearize",
+}
+# structured-control-flow primitives: (dotted name) -> indices of the
+# traced body callables among positional args
+_SCAN_BODY_ARGS = {
+    "lax.scan": (0,), "jax.lax.scan": (0,),
+    "lax.while_loop": (0, 1), "jax.lax.while_loop": (0, 1),
+    "lax.fori_loop": (2,), "jax.lax.fori_loop": (2,),
+    "lax.cond": (1, 2), "jax.lax.cond": (1, 2),
+    "lax.switch": (), "jax.lax.switch": (),  # branches start at arg1
+    "lax.map": (0,), "jax.lax.map": (0,),
+    "lax.associative_scan": (0,), "jax.lax.associative_scan": (0,),
+}
+
+
+def dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of an expression ('' if not name-like)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    if isinstance(node, ast.Call) and not parts:
+        # decorator factories: functools.partial(jax.jit, ...) names
+        # jax.jit; to_static(...) names to_static.  But a call buried in
+        # an attribute chain (np.asarray(x).max) is NOT a dotted name.
+        inner = dotted(node.func)
+        if inner in ("functools.partial", "partial") and node.args:
+            return dotted(node.args[0])
+        return inner
+    return ""
+
+
+@dataclass
+class FuncInfo:
+    node: ast.AST                      # FunctionDef / AsyncFunctionDef
+    name: str
+    params: set[str]
+    is_forward: bool = False           # forward / __call__ method body
+    is_traced: bool = False            # jit/grad/vmap target
+    is_scan_body: bool = False         # lax.scan / while_loop / cond body
+    local_stores: set[str] = field(default_factory=set)
+    globals_decl: set[str] = field(default_factory=set)
+    loop_depth: int = 0                # loops opened inside THIS function
+
+
+def _collect_local_stores(fn: ast.AST) -> set[str]:
+    """Names bound inside ``fn`` (params, assignments, loop/with targets,
+    comprehension targets, inner defs) — everything NOT captured."""
+    names: set[str] = set()
+    args = fn.args
+    for a in (args.posonlyargs + args.args + args.kwonlyargs
+              + ([args.vararg] if args.vararg else [])
+              + ([args.kwarg] if args.kwarg else [])):
+        names.add(a.arg)
+    for sub in ast.walk(fn):
+        if sub is fn:
+            continue
+        if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store):
+            names.add(sub.id)
+        elif isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+            names.add(sub.name)
+        elif isinstance(sub, ast.Import):
+            names.update(a.asname or a.name.split(".")[0]
+                         for a in sub.names)
+        elif isinstance(sub, ast.ImportFrom):
+            names.update(a.asname or a.name for a in sub.names)
+    return names
+
+
+class _Prepass(ast.NodeVisitor):
+    """Mark trace-target and scan-body functions before the rule pass.
+
+    Name resolution is file-global by function name: precise scope
+    resolution buys little for lint purposes and costs a symbol table.
+    """
+
+    def __init__(self):
+        self.by_name: dict[str, list[ast.AST]] = {}
+        self.traced: set[int] = set()      # id(funcdef)
+        self.scan_bodies: set[int] = set()
+
+    def visit_FunctionDef(self, node):
+        self.by_name.setdefault(node.name, []).append(node)
+        for dec in node.decorator_list:
+            if dotted(dec) in _TRACE_NAMES:
+                self.traced.add(id(node))
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _mark(self, arg: ast.AST, bucket: set[int]):
+        if isinstance(arg, ast.Name):
+            for fn in self.by_name.get(arg.id, ()):
+                bucket.add(id(fn))
+        elif isinstance(arg, ast.Lambda):
+            bucket.add(id(arg))
+
+    def visit_Call(self, node):
+        name = dotted(node.func)
+        if name in _TRACE_NAMES and node.args:
+            self._mark(node.args[0], self.traced)
+        body_idx = _SCAN_BODY_ARGS.get(name)
+        if body_idx is not None:
+            for i in body_idx:
+                if i < len(node.args):
+                    self._mark(node.args[i], self.scan_bodies)
+            if name.endswith("switch"):
+                for a in node.args[1:]:
+                    self._mark(a, self.scan_bodies)
+        self.generic_visit(node)
+
+
+class Linter(ast.NodeVisitor):
+    """One pass over one module; dispatches events to the rules."""
+
+    def __init__(self, path: str, source: str, rules, tree=None):
+        self.path = path
+        self.source_lines = source.splitlines()
+        self.rules = rules
+        self.suppressions = Suppressions(source)
+        self.violations: list[Violation] = []
+        self.func_stack: list[FuncInfo] = []
+        self.class_stack: list[str] = []
+        self._tree = tree if tree is not None else ast.parse(source)
+        self._pre = _Prepass()
+        self._pre.visit(self._tree)
+        # path-derived context
+        p = path.replace(os.sep, "/")
+        self.kernel_path = bool(re.search(
+            r"(^|/)(ops|kernels|nn/functional)(/|$)", p))
+        self.distributed_path = bool(re.search(
+            r"(^|/)(distributed|fleet|collective)(/|\.py$|$)", p))
+
+    # -- context helpers used by rules --------------------------------
+
+    @property
+    def current_func(self) -> FuncInfo | None:
+        return self.func_stack[-1] if self.func_stack else None
+
+    @property
+    def in_loop(self) -> bool:
+        """Inside a python loop of the innermost function (or module)."""
+        if self.func_stack:
+            return self.func_stack[-1].loop_depth > 0
+        return self._module_loop_depth > 0
+
+    def innermost_traced(self) -> FuncInfo | None:
+        for fi in reversed(self.func_stack):
+            if fi.is_traced or fi.is_scan_body:
+                return fi
+        return None
+
+    def in_forward(self) -> bool:
+        return any(fi.is_forward for fi in self.func_stack)
+
+    def enclosing_name_matches(self, pattern: str) -> bool:
+        rex = re.compile(pattern)
+        return any(rex.search(fi.name) for fi in self.func_stack)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.source_lines):
+            return self.source_lines[lineno - 1]
+        return ""
+
+    def report(self, node: ast.AST, rule: str, message: str):
+        line = getattr(node, "lineno", 1)
+        if self.suppressions.is_suppressed(rule, line):
+            return
+        self.violations.append(Violation(
+            self.path, line, getattr(node, "col_offset", 0) + 1,
+            rule, message, self.line_text(line)))
+
+    # -- traversal ----------------------------------------------------
+
+    _module_loop_depth = 0
+
+    def run(self) -> list[Violation]:
+        self.visit(self._tree)
+        self.violations.sort(key=lambda v: (v.line, v.col, v.rule))
+        return self.violations
+
+    def _dispatch(self, hook: str, node: ast.AST):
+        for rule in self.rules:
+            fn = getattr(rule, hook, None)
+            if fn is not None:
+                fn(node, self)
+
+    def visit_ClassDef(self, node):
+        self.class_stack.append(node.name)
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    def _visit_func(self, node):
+        fi = FuncInfo(
+            node=node, name=node.name,
+            params=_param_names(node),
+            is_forward=(node.name in ("forward", "__call__")
+                        and bool(self.class_stack)),
+            is_traced=id(node) in self._pre.traced,
+            is_scan_body=id(node) in self._pre.scan_bodies,
+            local_stores=_collect_local_stores(node),
+        )
+        for stmt in ast.walk(node):
+            if isinstance(stmt, ast.Global):
+                fi.globals_decl.update(stmt.names)
+        self.func_stack.append(fi)
+        self._dispatch("on_funcdef", node)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def _visit_loop(self, node):
+        is_for = isinstance(node, (ast.For, ast.AsyncFor))
+        self._dispatch("on_for" if is_for else "on_while", node)
+        if is_for:
+            # the iterable evaluates ONCE — jit built in the iterable
+            # expression is not per-iteration work
+            self.visit(node.target)
+            self.visit(node.iter)
+        if self.func_stack:
+            self.func_stack[-1].loop_depth += 1
+        else:
+            self._module_loop_depth += 1
+        if not is_for:
+            self.visit(node.test)  # while-test re-evaluates per iteration
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+        if self.func_stack:
+            self.func_stack[-1].loop_depth -= 1
+        else:
+            self._module_loop_depth -= 1
+
+    visit_For = _visit_loop
+    visit_AsyncFor = _visit_loop
+    visit_While = _visit_loop
+
+    def visit_If(self, node):
+        self._dispatch("on_if", node)
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        self._dispatch("on_call", node)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node):
+        self._dispatch("on_assign", node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._dispatch("on_assign", node)
+        self.generic_visit(node)
+
+    def visit_ExceptHandler(self, node):
+        self._dispatch("on_except", node)
+        self.generic_visit(node)
+
+
+def _param_names(fn: ast.AST) -> set[str]:
+    # *args/**kwargs are python containers — truthiness on them is
+    # static even when the elements are tracers, so they are not
+    # traced-value names for rule purposes
+    a = fn.args
+    names = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+    names.discard("self")
+    names.discard("cls")
+    return names
+
+
+# -- running ----------------------------------------------------------------
+
+def normalize_path(path: str) -> str:
+    """Repo-relative posix path when under the repo, else cwd-relative,
+    else absolute.  Baseline keys must not depend on where the CLI ran."""
+    ap = os.path.abspath(path)
+    for root in (_REPO_ROOT, os.getcwd()):
+        try:
+            rel = os.path.relpath(ap, root)
+        except ValueError:  # different drive (windows)
+            continue
+        if not rel.startswith(".."):
+            return rel.replace(os.sep, "/")
+    return ap.replace(os.sep, "/")
+
+
+def lint_source(source: str, path: str = "<string>",
+                rules=None) -> list[Violation]:
+    """Lint a source string (unit-test entry point — no filesystem)."""
+    if rules is None:
+        from .rules import default_rules
+        rules = default_rules()
+    tree = ast.parse(source)
+    return Linter(normalize_path(path) if path != "<string>" else path,
+                  source, rules, tree=tree).run()
+
+
+def lint_file(path: str, rules=None) -> list[Violation]:
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    return lint_source(source, path=path, rules=rules)
+
+
+_SKIP_DIRS = {"__pycache__", ".git", "build", "dist", ".eggs",
+              "node_modules"}
+
+
+def iter_py_files(paths):
+    """Expand files/dirs into a sorted, de-duplicated .py file list."""
+    seen, out = set(), []
+    for p in paths:
+        if os.path.isfile(p):
+            cands = [p]
+        else:
+            cands = []
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in _SKIP_DIRS
+                                 and not d.startswith("."))
+                cands.extend(os.path.join(root, f)
+                             for f in sorted(files) if f.endswith(".py"))
+        for c in cands:
+            ap = os.path.abspath(c)
+            if ap not in seen:
+                seen.add(ap)
+                out.append(c)
+    return out
+
+
+def run_paths(paths, rules=None):
+    """Lint every .py under ``paths``.
+
+    Returns ``(violations, errors)`` where ``errors`` maps path ->
+    message for files that failed to parse (reported, never fatal: a
+    syntax error in one file must not green-light the rest).
+    """
+    if rules is None:
+        from .rules import default_rules
+        rules = default_rules()
+    violations: list[Violation] = []
+    errors: dict[str, str] = {}
+    for f in iter_py_files(paths):
+        try:
+            violations.extend(lint_file(f, rules=rules))
+        except SyntaxError as e:
+            errors[normalize_path(f)] = f"syntax error: {e.msg} " \
+                                        f"(line {e.lineno})"
+        except (OSError, UnicodeDecodeError, RecursionError) as e:
+            errors[normalize_path(f)] = f"{type(e).__name__}: {e}"
+    violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return violations, errors

@@ -1,0 +1,428 @@
+"""tpu-lint rule catalog.
+
+Every rule targets a concrete way Python code silently destroys TPU
+throughput (or correctness) in a JAX-backed stack.  The catalog is the
+distillation of the failure modes this repo has actually hit or guards
+against — retrace storms, host round-trips in step loops, tracer leaks —
+plus the classic ones the JAX docs warn about.
+
+Rules are small classes with event hooks (``on_call``, ``on_if``,
+``on_assign``, ``on_except``, ``on_while``, ``on_for``); the
+:class:`~.core.Linter` owns all traversal and scope state.  Register new
+rules with :func:`register`.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import dotted
+
+__all__ = ["Rule", "register", "default_rules", "RULES", "rule_catalog"]
+
+RULES: dict[str, type] = {}
+
+
+def register(cls):
+    """Class decorator adding a rule to the default registry."""
+    RULES[cls.id] = cls
+    return cls
+
+
+class Rule:
+    id = "TPU000"
+    name = "abstract"
+    rationale = ""
+
+
+def default_rules(select=None):
+    """Instantiate the registry (optionally only ``select`` rule ids)."""
+    ids = sorted(RULES) if select is None else list(select)
+    out = []
+    for rid in ids:
+        if rid not in RULES:
+            raise KeyError(f"unknown rule id {rid!r} "
+                           f"(known: {', '.join(sorted(RULES))})")
+        out.append(RULES[rid]())
+    return out
+
+
+def rule_catalog():
+    return [(rid, RULES[rid].name, RULES[rid].rationale)
+            for rid in sorted(RULES)]
+
+
+# -- shared predicates ------------------------------------------------------
+
+_JIT_CONSTRUCTORS = {"jax.jit", "jit", "pjit", "jax.pjit",
+                     "jax.experimental.pjit.pjit"}
+
+# attribute reads on a tensor that are static under tracing (shape
+# metadata is concrete even on tracers)
+_SAFE_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "name"}
+# calls whose result is host-static even when an arg is traced
+_SAFE_CALLS = {"isinstance", "len", "hasattr", "getattr", "callable",
+               "type", "id"}
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    name = dotted(node.func)
+    if name in ("functools.partial", "partial") and node.args:
+        name = dotted(node.args[0])
+    return name in _JIT_CONSTRUCTORS
+
+
+def _literal(node: ast.AST) -> bool:
+    return isinstance(node, (ast.Constant, ast.List, ast.Tuple, ast.Dict,
+                             ast.Set))
+
+
+def _receiver_already_synced(recv: ast.AST, methods) -> bool:
+    """True when the receiver expression is itself a host-sync call
+    (``x.numpy().tolist()``) — the inner call carries the report."""
+    return (isinstance(recv, ast.Call)
+            and isinstance(recv.func, ast.Attribute)
+            and recv.func.attr in methods)
+
+
+def _hazard_params(expr: ast.AST, params: set) -> list:
+    """Parameter references in ``expr`` whose *value* feeds truthiness.
+
+    Skips statically-safe constructs: ``x is None``, ``isinstance(x, T)``,
+    ``len(x)``, and metadata reads like ``x.shape[0] > 1``.
+    """
+    hits = []
+
+    def walk(n, parent_attr=None):
+        if isinstance(n, ast.Attribute):
+            if n.attr in _SAFE_ATTRS:
+                return  # x.shape / x.ndim / x.dtype — static
+            walk(n.value)
+            return
+        if isinstance(n, ast.Call):
+            if dotted(n.func) in _SAFE_CALLS:
+                return
+            for a in n.args:
+                walk(a)
+            for k in n.keywords:
+                walk(k.value)
+            walk(n.func)
+            return
+        if isinstance(n, ast.Compare):
+            ops_safe = all(isinstance(o, (ast.Is, ast.IsNot, ast.In,
+                                          ast.NotIn)) for o in n.ops)
+            if ops_safe:
+                return  # `x is None`, `k in d` — identity/containment
+            walk(n.left)
+            for c in n.comparators:
+                walk(c)
+            return
+        if isinstance(n, ast.Name):
+            if n.id in params:
+                hits.append(n)
+            return
+        for c in ast.iter_child_nodes(n):
+            walk(c)
+
+    walk(expr)
+    return hits
+
+
+# -- the catalog ------------------------------------------------------------
+
+@register
+class JitInLoop(Rule):
+    id = "TPU001"
+    name = "jit-construction-in-hot-path"
+    rationale = ("jax.jit/pjit called inside a loop or per forward call "
+                 "builds a fresh cache entry every iteration — a retrace "
+                 "storm that recompiles instead of reusing the program")
+
+    def on_call(self, node, ctx):
+        if not _is_jit_call(node):
+            return
+        # a decorator list is visited as part of the funcdef; a
+        # decorator on a nested def inside a loop still retraces, so no
+        # special-casing needed — position decides.
+        if ctx.in_loop:
+            ctx.report(node, self.id,
+                       "jax.jit constructed inside a loop; hoist it out "
+                       "so the compiled program is reused")
+        elif ctx.in_forward():
+            ctx.report(node, self.id,
+                       "jax.jit constructed per call inside "
+                       "forward/__call__; build once (e.g. in __init__) "
+                       "and reuse")
+
+
+@register
+class TracedBool(Rule):
+    id = "TPU002"
+    name = "python-branch-on-traced-value"
+    rationale = ("`if`/`while` on a traced tensor raises "
+                 "TracerBoolConversionError under jit (or silently bakes "
+                 "one branch in); use lax.cond/jnp.where/lax.while_loop")
+
+    def _check(self, test, node, ctx, kind):
+        fi = ctx.innermost_traced()
+        if fi is None:
+            return
+        for ref in _hazard_params(test, fi.params):
+            ctx.report(node, self.id,
+                       f"python `{kind}` on traced value {ref.id!r} "
+                       f"inside trace target {fi.name!r}; use lax.cond / "
+                       f"jnp.where / lax.while_loop")
+            return  # one report per statement is enough
+
+    def on_if(self, node, ctx):
+        self._check(node.test, node, ctx, "if")
+
+    def on_while(self, node, ctx):
+        self._check(node.test, node, ctx, "while")
+
+
+@register
+class HostSyncInForward(Rule):
+    id = "TPU003"
+    name = "host-sync-in-forward-or-kernel"
+    rationale = ("`.item()`/`.numpy()`/np.asarray/float(tensor) in a "
+                 "forward or op body blocks on device->host transfer every "
+                 "call, serializing the pipeline (and crashes under jit)")
+
+    _SYNC_METHODS = {"item", "numpy", "tolist", "__array__"}
+    _NP_FUNCS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+                 "jax.device_get", "device_get"}
+
+    def _applicable(self, ctx):
+        return (ctx.in_forward() or ctx.innermost_traced() is not None
+                or (ctx.kernel_path and ctx.func_stack))
+
+    def on_call(self, node, ctx):
+        if not self._applicable(ctx):
+            return
+        name = dotted(node.func)
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in self._SYNC_METHODS):
+            if _receiver_already_synced(node.func.value,
+                                        self._SYNC_METHODS):
+                return  # x.numpy().tolist(): one sync, one report
+            ctx.report(node, self.id,
+                       f".{node.func.attr}() forces a device->host sync "
+                       f"in a hot path; keep the value on device "
+                       f"(jnp ops accept 0-d arrays)")
+            return
+        if name in self._NP_FUNCS:
+            if node.args and _literal(node.args[0]):
+                return  # np.asarray([0, 1]) — host constant, no transfer
+            ctx.report(node, self.id,
+                       f"{name}() on a device value forces a host "
+                       f"round-trip in a hot path; use jnp.asarray or "
+                       f"keep the array on device")
+            return
+        # float(x)/int(x)/bool(x) directly on a forward/traced parameter
+        if (name in ("float", "int", "bool") and node.args
+                and isinstance(node.args[0], ast.Name)):
+            fi = ctx.innermost_traced()
+            owners = [f for f in ctx.func_stack
+                      if f.is_forward or f is fi]
+            if any(node.args[0].id in f.params for f in owners):
+                ctx.report(node, self.id,
+                           f"{name}() on tensor argument "
+                           f"{node.args[0].id!r} synchronizes with the "
+                           f"host (TracerConversion under jit)")
+
+
+@register
+class TracerLeak(Rule):
+    id = "TPU004"
+    name = "tracer-leak-via-side-effect"
+    rationale = ("assigning to self.*/globals inside a jitted or traced "
+                 "function leaks tracers out of the trace — a "
+                 "UnexpectedTracerError later, or stale constants baked in")
+
+    def on_assign(self, node, ctx):
+        fi = ctx.innermost_traced()
+        if fi is None:
+            return
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for t in targets:
+            for sub in ast.walk(t):
+                if (isinstance(sub, ast.Attribute)
+                        and isinstance(sub.value, ast.Name)
+                        and sub.value.id == "self"):
+                    ctx.report(node, self.id,
+                               f"assignment to self.{sub.attr} inside "
+                               f"trace target {fi.name!r} leaks a tracer; "
+                               f"return the value instead")
+                    return
+                if (isinstance(sub, ast.Name)
+                        and sub.id in fi.globals_decl):
+                    ctx.report(node, self.id,
+                               f"assignment to global {sub.id!r} inside "
+                               f"trace target {fi.name!r} leaks a tracer")
+                    return
+
+
+@register
+class BadStaticArgnums(Rule):
+    id = "TPU005"
+    name = "invalid-static-argnums"
+    rationale = ("static_argnums must be hashable ints (and argnames "
+                 "strings); strings/floats/tensors there either raise or "
+                 "mark a tensor static, retracing on every distinct value")
+
+    def on_call(self, node, ctx):
+        if not _is_jit_call(node):
+            return
+        for kw in node.keywords:
+            if kw.arg == "static_argnums":
+                self._check_elems(
+                    kw.value, node, ctx, want=int,
+                    hint="index positions are ints; for names use "
+                         "static_argnames")
+            elif kw.arg == "static_argnames":
+                self._check_elems(
+                    kw.value, node, ctx, want=str,
+                    hint="argument names are strings; for positions use "
+                         "static_argnums")
+
+    @staticmethod
+    def _elems(value):
+        if isinstance(value, (ast.Tuple, ast.List, ast.Set)):
+            return value.elts
+        return [value]
+
+    def _check_elems(self, value, node, ctx, want, hint):
+        for el in self._elems(value):
+            if isinstance(el, ast.Constant):
+                ok = isinstance(el.value, want) and not (
+                    want is int and isinstance(el.value, bool))
+                if not ok:
+                    ctx.report(node, self.id,
+                               f"non-{want.__name__} constant "
+                               f"{el.value!r} in static_arg spec: {hint}")
+            elif _literal(el):
+                ctx.report(node, self.id,
+                           f"unhashable literal in static_arg spec: "
+                           f"{hint}")
+
+
+@register
+class ScanBodyMutation(Rule):
+    id = "TPU006"
+    name = "captured-mutation-in-scan-body"
+    rationale = ("mutating a captured list/dict inside a lax.scan/"
+                 "while_loop body runs once at trace time, not per step — "
+                 "the mutation silently records only tracer garbage")
+
+    _MUTATORS = {"append", "extend", "insert", "update", "pop", "popitem",
+                 "setdefault", "remove", "clear", "add", "discard"}
+
+    def _captured(self, name, ctx):
+        fi = ctx.current_func
+        return (fi is not None and fi.is_scan_body
+                and name not in fi.local_stores)
+
+    def on_call(self, node, ctx):
+        f = node.func
+        if (isinstance(f, ast.Attribute) and f.attr in self._MUTATORS
+                and isinstance(f.value, ast.Name)
+                and self._captured(f.value.id, ctx)):
+            ctx.report(node, self.id,
+                       f"{f.value.id}.{f.attr}() mutates a captured "
+                       f"container inside a scan/while_loop body; carry "
+                       f"it through the loop state instead")
+
+    def on_assign(self, node, ctx):
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for t in targets:
+            if (isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Name)
+                    and self._captured(t.value.id, ctx)):
+                ctx.report(node, self.id,
+                           f"subscript-assignment to captured "
+                           f"{t.value.id!r} inside a scan/while_loop "
+                           f"body; carry it through the loop state")
+
+
+@register
+class TransferInTrainLoop(Rule):
+    id = "TPU007"
+    name = "device-transfer-in-train-loop"
+    rationale = ("jax.device_get/.numpy()/.item() every training step "
+                 "stalls the device pipeline; sync once per logging "
+                 "interval, or after the loop")
+
+    _LOOP_FUNC = re.compile(r"(train|fit|epoch|run_steps?|step_loop)",
+                            re.IGNORECASE)
+    _SYNC_METHODS = {"numpy", "item", "tolist"}
+    _SYNC_FUNCS = {"jax.device_get", "device_get", "np.asarray",
+                   "numpy.asarray", "np.array", "numpy.array"}
+
+    def on_call(self, node, ctx):
+        if not ctx.in_loop:
+            return
+        if not any(self._LOOP_FUNC.search(fi.name)
+                   for fi in ctx.func_stack):
+            return
+        name = dotted(node.func)
+        hit = None
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in self._SYNC_METHODS):
+            if _receiver_already_synced(node.func.value,
+                                        self._SYNC_METHODS):
+                return
+            hit = f".{node.func.attr}()"
+        elif name in self._SYNC_FUNCS:
+            if node.args and _literal(node.args[0]):
+                return
+            hit = f"{name}()"
+        if hit:
+            ctx.report(node, self.id,
+                       f"{hit} inside a training-step loop forces a "
+                       f"device sync every iteration; hoist it out or "
+                       f"sync on a logging interval")
+
+
+@register
+class SwallowedDistributedError(Rule):
+    id = "TPU008"
+    name = "swallowed-error-in-distributed-path"
+    rationale = ("a bare/blanket except around collective or rendezvous "
+                 "code turns one dead rank into a silent hang of every "
+                 "other rank at the next barrier")
+
+    _BLANKET = {"Exception", "BaseException"}
+
+    def on_except(self, node, ctx):
+        if not ctx.distributed_path:
+            return
+        if node.type is None:
+            ctx.report(node, self.id,
+                       "bare `except:` in distributed code swallows "
+                       "everything incl. KeyboardInterrupt; catch the "
+                       "specific failure and at least log it")
+            return
+        names = {dotted(t) for t in (
+            node.type.elts if isinstance(node.type, ast.Tuple)
+            else [node.type])}
+        if names & self._BLANKET and self._trivial_body(node.body):
+            ctx.report(node, self.id,
+                       "`except Exception: pass` in distributed code "
+                       "hides rank failures (peers hang at the next "
+                       "collective); log the error or narrow the type")
+
+    @staticmethod
+    def _trivial_body(body):
+        for stmt in body:
+            if isinstance(stmt, ast.Pass):
+                continue
+            if isinstance(stmt, ast.Continue):
+                continue
+            if (isinstance(stmt, ast.Expr)
+                    and isinstance(stmt.value, ast.Constant)):
+                continue  # `...` or a lone docstring
+            return False
+        return True

@@ -1,0 +1,463 @@
+"""tpu-lint: rule fixtures, suppressions, baseline round-trip, and the
+self-clean gate that keeps paddle_tpu/ + exp/ free of new violations.
+
+Each rule gets a positive fixture (must fire) and a negative fixture
+(must stay silent) — the negative encodes the correct idiom the rule
+pushes toward, so a rule that over-triggers fails here before it ever
+annoys a developer.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from paddle_tpu.tools.lint import (
+    default_baseline_path, default_rules, diff_against_baseline,
+    lint_source, load_baseline, rule_catalog, run_paths, write_baseline,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GATE_PATHS = [os.path.join(ROOT, p)
+              for p in ("paddle_tpu", "exp", "bench.py", "bench_eager.py")]
+
+
+def rules_fired(src, path="pkg/mod.py"):
+    return {v.rule for v in lint_source(textwrap.dedent(src), path=path)}
+
+
+# -- rule fixtures -----------------------------------------------------------
+# {rule: (path, positive source, negative source)}
+FIXTURES = {
+    "TPU001": (
+        "pkg/mod.py",
+        """
+        import jax
+        def run(xs):
+            for x in xs:
+                f = jax.jit(lambda a: a + 1)
+                f(x)
+        """,
+        """
+        import jax
+        f = jax.jit(lambda a: a + 1)
+        def run(xs):
+            for x in xs:
+                f(x)
+        """,
+    ),
+    "TPU002": (
+        "pkg/mod.py",
+        """
+        import jax
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return x
+            return -x
+        """,
+        """
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def f(x):
+            if x.ndim > 0 and x is not None:
+                return jnp.where(x > 0, x, -x)
+            return x
+        """,
+    ),
+    "TPU003": (
+        "pkg/mod.py",
+        """
+        class Net:
+            def forward(self, x):
+                scale = float(x.mean().item())
+                return x * scale
+        """,
+        """
+        class Net:
+            def forward(self, x):
+                scale = x.mean()
+                return x * scale
+        """,
+    ),
+    "TPU004": (
+        "pkg/mod.py",
+        """
+        import jax
+        class Net:
+            def build(self):
+                @jax.jit
+                def step(x):
+                    self.cache = x * 2
+                    return x + 1
+                return step
+        """,
+        """
+        import jax
+        class Net:
+            def build(self):
+                @jax.jit
+                def step(x):
+                    return x * 2, x + 1
+                return step
+        """,
+    ),
+    "TPU005": (
+        "pkg/mod.py",
+        """
+        import jax
+        def build(f):
+            return jax.jit(f, static_argnums=("mode",))
+        """,
+        """
+        import jax
+        def build(f):
+            return jax.jit(f, static_argnums=(0, 1),
+                           static_argnames=("mode",))
+        """,
+    ),
+    "TPU006": (
+        "pkg/mod.py",
+        """
+        import jax
+        def outer(xs):
+            history = []
+            def body(carry, x):
+                history.append(x)
+                return carry + x, x
+            return jax.lax.scan(body, 0, xs)
+        """,
+        """
+        import jax
+        def outer(xs):
+            def body(carry, x):
+                acc = []
+                acc.append(x)
+                return carry + x, x
+            return jax.lax.scan(body, 0, xs)
+        """,
+    ),
+    "TPU007": (
+        "pkg/mod.py",
+        """
+        import jax
+        def train_loop(step, batches, state):
+            for b in batches:
+                state, loss = step(state, b)
+                print(jax.device_get(loss))
+            return state
+        """,
+        """
+        import jax
+        def train_loop(step, batches, state):
+            loss = None
+            for b in batches:
+                state, loss = step(state, b)
+            print(jax.device_get(loss))
+            return state
+        """,
+    ),
+    "TPU008": (
+        "pkg/distributed/mod.py",
+        """
+        def deregister(store, key):
+            try:
+                store.delete(key)
+            except Exception:
+                pass
+        """,
+        """
+        import logging
+        def deregister(store, key):
+            try:
+                store.delete(key)
+            except Exception as e:
+                logging.getLogger(__name__).warning("delete: %s", e)
+        """,
+    ),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(FIXTURES))
+def test_rule_fires_on_positive(rule):
+    path, pos, _ = FIXTURES[rule]
+    assert rule in rules_fired(pos, path=path)
+
+
+@pytest.mark.parametrize("rule", sorted(FIXTURES))
+def test_rule_silent_on_negative(rule):
+    path, _, neg = FIXTURES[rule]
+    assert rule not in rules_fired(neg, path=path)
+
+
+def test_catalog_has_at_least_eight_rules():
+    cat = rule_catalog()
+    assert len(cat) >= 8
+    for rid, name, rationale in cat:
+        assert rid.startswith("TPU") and len(rid) == 6
+        assert name and rationale
+
+
+# -- rule-specific edges -----------------------------------------------------
+
+def test_tpu001_fires_per_call_in_forward():
+    src = """
+    import jax
+    class Net:
+        def forward(self, x):
+            return jax.jit(lambda a: a + 1)(x)
+    """
+    assert "TPU001" in rules_fired(src)
+
+
+def test_tpu001_silent_for_jit_in_for_iterable():
+    # the iterable expression evaluates once, not per iteration
+    src = """
+    import jax
+    def bench(x):
+        out = []
+        for name, fn in [("a", jax.jit(abs))]:
+            out.append(fn(x))
+        return out
+    """
+    assert "TPU001" not in rules_fired(src)
+
+
+def test_tpu001_partial_jit_counts():
+    src = """
+    import functools, jax
+    def run(xs):
+        for x in xs:
+            f = functools.partial(jax.jit, donate_argnums=(0,))(abs)
+            f(x)
+    """
+    assert "TPU001" in rules_fired(src)
+
+
+def test_tpu002_star_args_truthiness_is_static():
+    src = """
+    import jax
+    @jax.jit
+    def f(x, *labels):
+        if labels:
+            return x + labels[0]
+        return x
+    """
+    assert "TPU002" not in rules_fired(src)
+
+
+def test_tpu002_while_on_traced_value():
+    src = """
+    import jax
+    @jax.jit
+    def f(x):
+        while x > 0:
+            x = x - 1
+        return x
+    """
+    assert "TPU002" in rules_fired(src)
+
+
+def test_tpu003_kernel_path_without_forward_name():
+    src = """
+    import numpy as np
+    def softmax(x, axis):
+        host = np.asarray(x._data)
+        return host
+    """
+    assert "TPU003" in rules_fired(src, path="paddle_tpu/ops/fake.py")
+    # same code outside a kernel path and outside forward: silent
+    assert "TPU003" not in rules_fired(src, path="pkg/utils.py")
+
+
+def test_tpu003_chained_sync_reports_once():
+    src = """
+    class Net:
+        def forward(self, x):
+            return x.numpy().tolist()
+    """
+    vs = [v for v in lint_source(textwrap.dedent(src)) if v.rule == "TPU003"]
+    assert len(vs) == 1
+
+
+def test_tpu005_static_argnames_int_flagged():
+    src = """
+    import jax
+    g = jax.jit(abs, static_argnames=(0,))
+    """
+    assert "TPU005" in rules_fired(src)
+
+
+def test_tpu008_bare_except_flagged_only_in_distributed_paths():
+    src = """
+    def f(store):
+        try:
+            store.get("k")
+        except:
+            pass
+    """
+    assert "TPU008" in rules_fired(src, path="pkg/fleet/util.py")
+    assert "TPU008" not in rules_fired(src, path="pkg/vision/util.py")
+
+
+# -- suppressions ------------------------------------------------------------
+
+SUPPRESSIBLE = """
+class Net:
+    def forward(self, x):
+        return float(x.item())
+"""
+
+
+def test_suppression_same_line():
+    src = SUPPRESSIBLE.replace(
+        "return float(x.item())",
+        "return float(x.item())  # tpu-lint: disable=TPU003")
+    assert "TPU003" not in rules_fired(src)
+
+
+def test_suppression_previous_line_comment():
+    src = SUPPRESSIBLE.replace(
+        "        return float(x.item())",
+        "        # tpu-lint: disable=TPU003\n"
+        "        return float(x.item())")
+    assert "TPU003" not in rules_fired(src)
+
+
+def test_suppression_all_and_multi_rule():
+    src = SUPPRESSIBLE.replace(
+        "return float(x.item())",
+        "return float(x.item())  # tpu-lint: disable=all")
+    assert rules_fired(src) == set()
+    src2 = SUPPRESSIBLE.replace(
+        "return float(x.item())",
+        "return float(x.item())  # tpu-lint: disable=TPU001,TPU003")
+    assert "TPU003" not in rules_fired(src2)
+
+
+def test_suppression_wrong_rule_does_not_mask():
+    src = SUPPRESSIBLE.replace(
+        "return float(x.item())",
+        "return float(x.item())  # tpu-lint: disable=TPU001")
+    assert "TPU003" in rules_fired(src)
+
+
+# -- baseline ----------------------------------------------------------------
+
+def _violating_file(tmp_path, name="mod.py"):
+    p = tmp_path / "distributed" / name
+    p.parent.mkdir(exist_ok=True)
+    p.write_text(textwrap.dedent("""
+        def f(store):
+            try:
+                store.get("k")
+            except Exception:
+                pass
+    """))
+    return str(p)
+
+
+def test_baseline_round_trip(tmp_path):
+    f = _violating_file(tmp_path)
+    vs, errors = run_paths([f])
+    assert not errors and len(vs) == 1
+
+    bl_path = str(tmp_path / "baseline.txt")
+    assert write_baseline(bl_path, vs) == 1
+
+    # identical tree against its own baseline: nothing new, nothing stale
+    vs2, _ = run_paths([f])
+    new, old, stale = diff_against_baseline(vs2, load_baseline(bl_path))
+    assert new == [] and len(old) == 1 and stale == []
+
+
+def test_baseline_catches_new_violation(tmp_path):
+    f = _violating_file(tmp_path)
+    vs, _ = run_paths([f])
+    bl_path = str(tmp_path / "baseline.txt")
+    write_baseline(bl_path, vs)
+
+    # add a second, distinct violation: only IT shows up as new
+    with open(f, "a") as fh:
+        fh.write(textwrap.dedent("""
+            def g(store):
+                try:
+                    store.set("k", "v")
+                except:
+                    pass
+        """))
+    vs2, _ = run_paths([f])
+    new, old, stale = diff_against_baseline(vs2, load_baseline(bl_path))
+    assert len(new) == 1 and len(old) == 1 and stale == []
+    assert "bare" in new[0].message
+
+
+def test_baseline_reports_stale_entries(tmp_path):
+    f = _violating_file(tmp_path)
+    vs, _ = run_paths([f])
+    bl_path = str(tmp_path / "baseline.txt")
+    write_baseline(bl_path, vs)
+
+    os.remove(f)
+    new, old, stale = diff_against_baseline([], load_baseline(bl_path))
+    assert new == [] and old == [] and len(stale) == 1
+
+
+def test_baseline_keys_are_line_number_free(tmp_path):
+    # editing ABOVE a grandfathered violation must not invalidate it
+    f = _violating_file(tmp_path)
+    vs, _ = run_paths([f])
+    bl_path = str(tmp_path / "baseline.txt")
+    write_baseline(bl_path, vs)
+
+    with open(f) as fh:
+        src = fh.read()
+    with open(f, "w") as fh:
+        fh.write("import os  # new first line\n" + src)
+    vs2, _ = run_paths([f])
+    new, old, stale = diff_against_baseline(vs2, load_baseline(bl_path))
+    assert new == [] and len(old) == 1 and stale == []
+
+
+def test_select_unknown_rule_raises():
+    with pytest.raises(KeyError):
+        default_rules(["TPU999"])
+
+
+# -- the self-clean gate -----------------------------------------------------
+
+def test_package_is_self_clean():
+    """paddle_tpu/ + exp/ + bench drivers carry zero non-baseline
+    violations — new hazards fail tier-1 from this commit forward."""
+    violations, errors = run_paths(GATE_PATHS)
+    assert errors == {}, errors
+    new, _, stale = diff_against_baseline(
+        violations, load_baseline(default_baseline_path()))
+    assert new == [], "new tpu-lint violations:\n" + "\n".join(
+        str(v) for v in new)
+    assert stale == [], ("baseline entries no longer needed — prune "
+                         "them (python -m paddle_tpu.tools.lint "
+                         "--write-baseline paddle_tpu exp bench.py "
+                         "bench_eager.py):\n" + "\n".join(stale))
+
+
+def test_cli_gate_exits_zero():
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.tools.lint",
+         "paddle_tpu", "exp", "bench.py", "bench_eager.py"],
+        cwd=ROOT, capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, timeout=180)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "0 new violations" in out.stdout
+
+
+def test_cli_list_rules():
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.tools.lint", "--list-rules"],
+        cwd=ROOT, capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, timeout=180)
+    assert out.returncode == 0
+    for rid in FIXTURES:
+        assert rid in out.stdout
