@@ -34,6 +34,7 @@ import subprocess
 import sys
 import time
 import traceback
+from paddle_tpu.distributed._jax_compat import shard_map as _shard_map, use_mesh as _use_mesh
 
 SMOKE = bool(os.environ.get("BENCH_SMOKE"))  # tiny-shape CI structure check
 RESNET_BATCH = 8 if SMOKE else 256
@@ -483,7 +484,7 @@ def bench_ring(result):
 
     def fwd_bwd(q, k, v):
         def loss(q):
-            out = jax.shard_map(
+            out = _shard_map(
                 lambda a, b, c: ring_attention(a, b, c, causal=True),
                 mesh=mesh, in_specs=(P(None, None, "sep"),) * 3,
                 out_specs=P(None, None, "sep"))(q, k, v)

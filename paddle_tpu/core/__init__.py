@@ -49,7 +49,14 @@ def _load():
         from . import build as _build
         _lib_err = _build.LAST_ERROR or "no C++ toolchain"
         return None
-    lib = ctypes.CDLL(path)
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError as e:
+        # a stale/incompatible cached .so (e.g. built against a different
+        # glibc) must degrade to the pure-python fallbacks, not crash
+        # every importer
+        _lib_err = f"cannot dlopen {path}: {e}"
+        return None
     # --- signatures ---
     lib.pt_trace_push.argtypes = [ctypes.c_char_p, ctypes.c_int]
     lib.pt_trace_dump_json.argtypes = [ctypes.c_char_p, ctypes.c_int]
@@ -390,11 +397,28 @@ class TCPStore:
             port = lib.pt_store_server_port(self._server)
             host = "127.0.0.1"
         self.host, self.port = host, port
-        self._client = lib.pt_store_client_connect(
-            host.encode(), port, int(timeout * 1000))
-        if not self._client:
+
+        # worker connect retries under the caller's timeout: a worker
+        # that races the master's bind gets connection-refused instantly
+        # and must back off, not die on its single shot
+        from ..utils.retry import retry_call
+
+        def _connect():
+            c = lib.pt_store_client_connect(
+                host.encode(), port, int(timeout * 1000))
+            if not c:
+                raise TimeoutError(
+                    f"cannot reach TCPStore at {host}:{port} "
+                    f"within {timeout}s")
+            return c
+
+        try:
+            self._client = retry_call(
+                _connect, retry_on=(TimeoutError,), deadline=timeout,
+                base=0.05, max_delay=1.0)
+        except TimeoutError:
             self.close()
-            raise TimeoutError(f"cannot reach TCPStore at {host}:{port}")
+            raise
 
     def set(self, key: str, value: bytes | str) -> None:
         if isinstance(value, str):
@@ -407,12 +431,13 @@ class TCPStore:
     def get(self, key: str, wait: bool = True,
             timeout: float | None = None) -> bytes | None:
         """Fetch a key. ``wait=True`` blocks until the key is set — via
-        client-side polling so a ``timeout`` can abort the wait with a
-        diagnostic instead of hanging the whole job (the failure mode of a
-        server-side blocking WAIT when a peer rank dies)."""
-        import time as _time
-        deadline = None if timeout is None else _time.monotonic() + timeout
-        while True:
+        client-side polling (jittered backoff) so a ``timeout`` can abort
+        the wait with a diagnostic instead of hanging the whole job (the
+        failure mode of a server-side blocking WAIT when a peer rank
+        dies)."""
+        from ..utils.retry import wait_until
+
+        def _poll():
             buf = ctypes.create_string_buffer(1 << 20)
             n = self._lib.pt_store_get(self._client, key.encode(), buf,
                                        len(buf), 0)
@@ -421,16 +446,21 @@ class TCPStore:
                     buf = ctypes.create_string_buffer(int(n))
                     n = self._lib.pt_store_get(self._client, key.encode(),
                                                buf, len(buf), 0)
-                return buf.raw[:n]
+                return (buf.raw[:n],)  # 1-tuple: b"" is a real value
             if n != -1:
                 raise ConnectionError("TCPStore get failed")
-            if not wait:
-                return None
-            if deadline is not None and _time.monotonic() >= deadline:
+            return None
+
+        got = _poll()
+        if got is None and wait:
+            try:
+                got = wait_until(_poll, timeout, base=0.01, factor=1.5,
+                                 max_delay=0.25, desc=f"key {key!r}")
+            except TimeoutError:
                 raise TimeoutError(
                     f"TCPStore: key '{key}' not set within {timeout}s "
                     f"(a peer rank may have died before rendezvous)")
-            _time.sleep(0.02)
+        return got[0] if got is not None else None
 
     def add(self, key: str, delta: int = 1) -> int:
         v = self._lib.pt_store_add(self._client, key.encode(), delta)

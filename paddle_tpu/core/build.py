@@ -16,6 +16,12 @@ _SRC_DIR = os.path.join(os.path.dirname(__file__), "native")
 _SOURCES = ["trace.cc", "flags.cc", "alloc.cc", "workqueue.cc", "store.cc",
             "shm.cc"]
 _HEADERS = ["common.h"]
+# -lrt: shm_open/shm_unlink live in librt until glibc 2.34; linking it is
+# harmless on newer glibc (empty archive) and required on older ones —
+# without it the .so builds fine but dlopen fails with an undefined symbol
+_CXXFLAGS = ["-std=c++17", "-O2", "-fPIC", "-shared", "-pthread",
+             "-fvisibility=hidden"]
+_LDFLAGS = ["-lrt"]
 
 #: last build failure detail (compiler stderr / missing toolchain), for
 #: callers that got None back and want the real reason
@@ -27,6 +33,9 @@ def _source_hash() -> str:
     for name in _HEADERS + _SOURCES:
         with open(os.path.join(_SRC_DIR, name), "rb") as f:
             h.update(f.read())
+    # flags are part of the identity: a flag fix (e.g. adding -lrt) must
+    # invalidate a cached .so built without it
+    h.update(" ".join(_CXXFLAGS + _LDFLAGS).encode())
     return h.hexdigest()[:16]
 
 
@@ -49,8 +58,7 @@ def build_ptcore(verbose: bool = False) -> str | None:
     # (multi-process launch) never load a half-written .so
     fd, tmp = tempfile.mkstemp(suffix=".so", dir=_cache_dir())
     os.close(fd)
-    cmd = ["g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-pthread",
-           "-fvisibility=hidden", "-o", tmp] + srcs
+    cmd = ["g++"] + _CXXFLAGS + ["-o", tmp] + srcs + _LDFLAGS
     global LAST_ERROR
     try:
         res = subprocess.run(cmd, capture_output=True, text=True, timeout=300)

@@ -73,7 +73,11 @@ class CostModel:
         """{'flops', 'bytes accessed', ...} for the compiled program."""
         import jax
         lowered = jax.jit(fn).lower(*example_args)
-        return lowered.compile().cost_analysis()
+        cost = lowered.compile().cost_analysis()
+        # older jax wraps the analysis dict in a per-program list
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        return cost
 
     # -- static table, reference schema -----------------------------------
     def static_cost_data(self):

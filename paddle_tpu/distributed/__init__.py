@@ -47,6 +47,10 @@ from . import utils  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .checkpoint import (  # noqa: F401
     save_sharded, load_sharded, save_state, load_state,
+    CheckpointCorruptError, is_committed, verify_checkpoint, store_barrier,
+)
+from .checkpoint_manager import (  # noqa: F401
+    CheckpointManager, latest_checkpoint,
 )
 
 # spawn-style launch (ref: python/paddle/distributed/spawn.py)

@@ -1,0 +1,58 @@
+"""Shims over jax API moves/renames so one tree runs on old and new jax.
+
+The distributed stack is written against the current jax surface
+(``jax.shard_map``, ``jax.set_mesh``); older installs (< 0.5) expose the
+same machinery as ``jax.experimental.shard_map.shard_map`` (with
+``check_rep``/``auto`` instead of ``check_vma``/``axis_names``) and use
+the ``Mesh`` object itself as the ambient-mesh context manager.  These
+helpers pick whichever exists — a robustness requirement, not a
+convenience: the fault-tolerance drills must run on the jax the
+container actually has.
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shard_map", "use_mesh", "axis_size"]
+
+
+def shard_map(fn, mesh, in_specs, out_specs, axis_names=None,
+              check_vma=False):
+    """``jax.shard_map`` when present, else the experimental spelling.
+
+    ``axis_names`` (new API: the axes manual inside the body) maps to the
+    old API's complement ``auto`` (the axes left automatic); ``check_vma``
+    maps to ``check_rep``.
+    """
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_vma=check_vma)
+        if axis_names is not None:
+            kwargs["axis_names"] = set(axis_names)
+        return sm(fn, **kwargs)
+    from jax.experimental.shard_map import shard_map as _sm
+    # Old jax has no ``axis_names``; its ``auto`` complement triggers an
+    # unsupported PartitionId lowering under SPMD partitioning (notably on
+    # CPU), so run fully manual instead: axes the caller left automatic are
+    # simply unmentioned in the specs, i.e. replicated — correct, if less
+    # parallel, which is the right trade for a compatibility path.
+    return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)
+
+
+def axis_size(axis_name):
+    """``lax.axis_size`` where it exists; older jax derives it from the
+    ambient axis environment (same mechanism, pre-rename spelling)."""
+    from jax import lax
+    fn = getattr(lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def use_mesh(mesh):
+    """Ambient-mesh context: ``jax.set_mesh`` where it exists; on older
+    jax a ``Mesh`` is itself the context manager."""
+    set_mesh = getattr(jax, "set_mesh", None)
+    return set_mesh(mesh) if set_mesh is not None else mesh

@@ -264,6 +264,21 @@ class Engine:
         self._state = ckpt.load_state(path, self._state)
         self._sync_state_to_model()
 
+    def restore_latest(self, root):
+        """Resume from the newest valid checkpoint under ``root`` — a
+        :class:`~..checkpoint_manager.CheckpointManager` directory of
+        ``step_<n>`` commits.  Uncommitted/corrupt steps are skipped.
+        Returns the resumed step number, or None when no valid
+        checkpoint exists (state untouched — fresh start)."""
+        from ..checkpoint_manager import CheckpointManager
+        self.prepare(mode="train")
+        mgr = CheckpointManager(root)
+        state, step = mgr.restore_latest(template=self._state)
+        if step is not None:
+            self._state = state
+            self._sync_state_to_model()
+        return step
+
     # -- plumbing -------------------------------------------------------------
     def _loader(self, data, batch_size, shuffle, drop_last, num_workers,
                 collate_fn):

@@ -1,0 +1,251 @@
+"""CheckpointManager: step-numbered, crash-consistent checkpoint rotation.
+
+The resume workflow on a preemptible TPU fleet (SURVEY §5):
+
+    mgr = CheckpointManager(root, keep_last_n=3)
+    state, step = mgr.restore_latest(template=state)   # relaunch path
+    for i in range(step or 0, total_steps):
+        loss, state = train_step(state, ...)
+        mgr.save(i + 1, state)                         # atomic commit
+    on_preemption(lambda: mgr.save(current_step, state))
+
+Each ``save(step, state)`` lands in ``<root>/step_<n>`` through the
+atomic-commit protocol of :mod:`.checkpoint` (stage + fsync + COMMIT
+manifest + rename), so a SIGKILL at any instant leaves either the
+previous committed checkpoint or the new one — never a half-written
+directory that loads as garbage.  ``restore_latest`` walks steps newest
+first, skipping uncommitted or corrupt directories (CRC/coverage), and
+keep-last-N garbage collection never deletes the only valid checkpoint.
+
+Async mode (``async_save=True``): the device→host copy happens on the
+caller (so donated/overwritten buffers can't corrupt an in-flight
+snapshot), while serialization + fsync + commit run on one background
+writer thread; a write failure is re-raised on the NEXT manager call —
+a checkpoint error must surface, not vanish with a daemon thread.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import re
+import shutil
+import threading
+
+import jax
+
+from . import checkpoint as _ckpt
+from .checkpoint import CheckpointCorruptError
+
+__all__ = ["CheckpointManager", "latest_checkpoint"]
+
+logger = logging.getLogger(__name__)
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+_TMP_RE = re.compile(r"^step_(\d+)\.(tmp|old)\.")
+
+
+def _step_dirname(step):
+    return f"step_{int(step):08d}"
+
+
+class CheckpointManager:
+    """Rotating step-numbered checkpoints with resume-from-latest.
+
+    Args:
+        root: directory holding ``step_<n>`` checkpoint subdirectories.
+        keep_last_n: committed checkpoints to retain (None = keep all).
+        async_save: commit on a background writer thread (see module doc).
+        store / world_size / process_index: multi-host commit plumbing,
+            forwarded to :func:`checkpoint.save_sharded`.
+        integrity: verification level for restores — "full" (CRC32),
+            "size", or "off" (markers only).
+        durable: fsync every write (disable only in tests).
+    """
+
+    def __init__(self, root, keep_last_n=3, async_save=False, store=None,
+                 world_size=None, process_index=None, integrity="full",
+                 durable=True):
+        if keep_last_n is not None and keep_last_n < 1:
+            raise ValueError(f"keep_last_n must be >= 1, got {keep_last_n}")
+        self.root = root
+        self.keep_last_n = keep_last_n
+        self.async_save = async_save
+        self.store = store
+        self.world_size = world_size
+        self.process_index = process_index
+        self.integrity = integrity
+        self.durable = durable
+        os.makedirs(root, exist_ok=True)
+        self._bad: set[int] = set()     # steps that failed a full verify
+        self._err: BaseException | None = None
+        self._lock = threading.Lock()
+        self._inflight: threading.Thread | None = None
+
+    # -- enumeration --------------------------------------------------------
+    def _step_dirs(self):
+        out = {}
+        try:
+            names = os.listdir(self.root)
+        except FileNotFoundError:
+            return out
+        for n in names:
+            m = _STEP_RE.match(n)
+            if m:
+                out[int(m.group(1))] = os.path.join(self.root, n)
+        return out
+
+    def step_dir(self, step):
+        return os.path.join(self.root, _step_dirname(step))
+
+    def all_steps(self):
+        """Every step directory present, committed or not, ascending."""
+        return sorted(self._step_dirs())
+
+    def valid_steps(self):
+        """Steps whose directory is committed and passes the cheap
+        size-level manifest scan (catches truncation without reading
+        data), minus any step a restore proved corrupt, ascending."""
+        out = []
+        for step, d in sorted(self._step_dirs().items()):
+            if step in self._bad:
+                continue
+            try:
+                _ckpt.verify_checkpoint(d, integrity="size")
+            except (CheckpointCorruptError, FileNotFoundError,
+                    ValueError) as e:
+                logger.debug("checkpoint %s not valid: %s", d, e)
+                continue
+            out.append(step)
+        return out
+
+    def latest_step(self):
+        """Newest valid (committed, size-verified, not known-corrupt)
+        step, or None."""
+        steps = self.valid_steps()
+        return steps[-1] if steps else None
+
+    # -- save ---------------------------------------------------------------
+    def _raise_pending(self):
+        with self._lock:
+            err, self._err = self._err, None
+        if err is not None:
+            raise err
+
+    def save(self, step, state, block=False):
+        """Commit ``state`` as step ``step``.
+
+        Sync mode writes + commits before returning.  Async mode copies
+        the shards to host now, queues the write, and returns; a failure
+        of the background commit is raised by the NEXT save()/wait().
+        ``block=True`` forces a synchronous commit even in async mode
+        (preemption handlers must not race process exit).
+        """
+        self._raise_pending()
+        proc = (jax.process_index() if self.process_index is None
+                else self.process_index)
+        world = (jax.process_count() if self.world_size is None
+                 else self.world_size)
+        path = self.step_dir(step)
+        if not self.async_save or block:
+            self.wait()
+            _ckpt._save_records(_ckpt._shard_records(state, proc), path,
+                                proc, world, store=self.store,
+                                durable=self.durable)
+            self._gc()
+            return
+        # device->host copy on the caller: the training loop may donate
+        # or overwrite these buffers the moment we return
+        records = list(_ckpt._shard_records(state, proc))
+        self.wait()  # one writer at a time; serializes step order
+
+        def _write():
+            try:
+                _ckpt._save_records(records, path, proc, world,
+                                    store=self.store, durable=self.durable)
+                self._gc()
+            except BaseException as e:  # surfaced on the next call
+                with self._lock:
+                    self._err = e
+
+        t = threading.Thread(target=_write, daemon=True,
+                             name=f"ckpt-save-{step}")
+        self._inflight = t
+        t.start()
+
+    def wait(self):
+        """Drain any in-flight async save; re-raises its failure."""
+        t, self._inflight = self._inflight, None
+        if t is not None:
+            t.join()
+        self._raise_pending()
+
+    # -- restore ------------------------------------------------------------
+    def restore_latest(self, template=None, mesh=None, shardings=None):
+        """Load the newest valid checkpoint, falling back past
+        uncommitted/corrupt directories to the most recent one that
+        verifies clean.
+
+        Returns ``(state, step)``; ``(template, None)`` when no valid
+        checkpoint exists (fresh start).  Directories that fail the full
+        integrity check are remembered so :meth:`latest_step` reports
+        the fallback step afterwards.
+        """
+        self.wait()
+        for step in reversed(self.valid_steps()):
+            d = self.step_dir(step)
+            try:
+                state = _ckpt.load_sharded(d, mesh=mesh,
+                                           shardings=shardings,
+                                           template=template,
+                                           integrity=self.integrity)
+                return state, step
+            except (CheckpointCorruptError, FileNotFoundError,
+                    ValueError) as e:
+                logger.warning(
+                    "checkpoint step %d at %s failed verification (%s); "
+                    "falling back to an earlier step", step, d, e)
+                self._bad.add(step)
+        return template, None
+
+    # -- retention ----------------------------------------------------------
+    def _gc(self):
+        """Keep the newest ``keep_last_n`` valid checkpoints.
+
+        Deletes (a) older committed checkpoints beyond the window,
+        (b) uncommitted/corrupt step dirs older than the newest valid one
+        (debris of crashed saves — a NEWER uncommitted dir may be a
+        concurrent in-flight save and is left alone), and (c) stale
+        ``.tmp``/``.old`` staging dirs.  By construction the newest valid
+        checkpoint — in particular the only one — is never deleted.
+        """
+        if self.keep_last_n is None:
+            return
+        valid = self.valid_steps()
+        if not valid:
+            return
+        newest = valid[-1]
+        keep = set(valid[-self.keep_last_n:])
+        for step, d in sorted(self._step_dirs().items()):
+            if step in keep or step >= newest:
+                continue
+            shutil.rmtree(d, ignore_errors=True)
+        for n in os.listdir(self.root):
+            m = _TMP_RE.match(n)
+            if m and int(m.group(1)) <= newest:
+                shutil.rmtree(os.path.join(self.root, n),
+                              ignore_errors=True)
+
+    def close(self):
+        self.wait()
+
+
+def latest_checkpoint(root):
+    """Path of the newest valid ``step_<n>`` checkpoint under ``root``,
+    or None — also None when ``root`` does not exist or holds no step
+    subdirectories (so callers can use it to sniff whether a directory
+    is a manager root at all)."""
+    if not os.path.isdir(root):
+        return None
+    mgr = CheckpointManager(root, keep_last_n=None)
+    step = mgr.latest_step()
+    return None if step is None else mgr.step_dir(step)

@@ -127,8 +127,9 @@ class Group:
         # check_vma off: collective outputs (all_gather/psum results) ARE
         # replicated but the static varying-axes checker can't always
         # prove it through custom-vjp wrappers
-        return jax.shard_map(fn, mesh=m, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=False)(*args)
+        from ._jax_compat import shard_map
+        return shard_map(fn, mesh=m, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)(*args)
 
     def __repr__(self):
         return f"Group(id={self.id}, ranks={self.ranks})"

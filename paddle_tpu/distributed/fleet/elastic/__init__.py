@@ -14,7 +14,10 @@ in-run self-healing is process-level, exactly like the reference's
 NCCL-abort-then-relaunch model.
 """
 from .manager import ElasticManager, ElasticStatus, LauncherInterface  # noqa: F401
-from .preemption import on_preemption, clear_preemption_handler  # noqa: F401
+from .preemption import (  # noqa: F401
+    on_preemption, clear_preemption_handler, SAVE_FAILED_EXIT_CODE,
+)
 
 __all__ = ["ElasticManager", "ElasticStatus", "LauncherInterface",
-           "on_preemption", "clear_preemption_handler"]
+           "on_preemption", "clear_preemption_handler",
+           "SAVE_FAILED_EXIT_CODE"]

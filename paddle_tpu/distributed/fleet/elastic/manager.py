@@ -10,6 +10,8 @@ import subprocess
 import threading
 import time
 
+from ....utils.retry import retry_call, wait_until
+
 __all__ = ["ElasticManager", "ElasticStatus", "LauncherInterface"]
 
 logger = logging.getLogger(__name__)
@@ -120,11 +122,20 @@ class ElasticManager:
     def register(self):
         """Join membership (atomic slot allocation) and start
         heartbeating. A rejoining host gets a fresh slot; dead slots age
-        out via the heartbeat lease."""
-        slot = self.store.add("elastic/nslots", 1)
-        self.store.set(f"elastic/slot/{slot}", self.host)
+        out via the heartbeat lease. The registration store ops retry
+        with backoff (bounded by one lease TTL): right after a mass
+        restart the store may still be coming up, and a node that gives
+        up on its first try never rejoins."""
+        slot = retry_call(self.store.add, "elastic/nslots", 1,
+                          retry_on=(ConnectionError, TimeoutError, OSError),
+                          deadline=self.ttl, base=0.05)
+        retry_call(self.store.set, f"elastic/slot/{slot}", self.host,
+                   retry_on=(ConnectionError, TimeoutError, OSError),
+                   deadline=self.ttl, base=0.05)
         self._slot = slot
-        self._beat()
+        retry_call(self._beat,
+                   retry_on=(ConnectionError, TimeoutError, OSError),
+                   deadline=self.ttl, base=0.05)
         self._hb_thread = threading.Thread(target=self._heartbeat_loop,
                                            daemon=True)
         self._hb_thread.start()
@@ -163,16 +174,22 @@ class ElasticManager:
         return ElasticStatus.RESTART if ok else ElasticStatus.HOLD
 
     def wait_for_np(self, timeout=60.0):
-        """Hold until the alive count enters [np_min, np_max]."""
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        """Hold until the alive count enters [np_min, np_max] — jittered
+        backoff polling so a whole restarted fleet doesn't hammer the
+        store in lockstep."""
+        def _ready():
             ok, hosts, rank = self.match()
-            if ok:
-                return hosts, rank
-            time.sleep(self.interval)
-        raise TimeoutError(
-            f"elastic: np stayed outside [{self.np_min},{self.np_max}] "
-            f"for {timeout}s (alive={self.alive_nodes()})")
+            return (hosts, rank) if ok else None
+
+        try:
+            return wait_until(
+                _ready, timeout, base=self.interval / 4, factor=1.5,
+                max_delay=self.interval,
+                desc=f"np in [{self.np_min},{self.np_max}]")
+        except TimeoutError:
+            raise TimeoutError(
+                f"elastic: np stayed outside [{self.np_min},{self.np_max}]"
+                f" for {timeout}s (alive={self.alive_nodes()})")
 
     def supervise(self, make_launcher, max_restarts=5, poll=0.25,
                   hold_timeout=60.0):

@@ -4,29 +4,50 @@ SURVEY §5 designates TPU preemption handling as the equivalent of the
 reference's elastic fault tolerance (``fleet/elastic/manager.py:124``):
 cloud TPU VMs receive SIGTERM ahead of maintenance/preemption. This module
 installs a handler that saves a (sharded) checkpoint and exits, so the
-relaunched job resumes via ``distributed.checkpoint.load_state``.
+relaunched job resumes via ``distributed.checkpoint.load_state`` (or
+``CheckpointManager.restore_latest``).
+
+Exit codes are the operator's only signal from a preempted worker, so
+they are disjoint: ``exit_code`` (default 143 = 128+SIGTERM) means
+"checkpoint saved, clean preemption exit"; ``error_exit_code`` (default
+75, EX_TEMPFAIL) means "the preemption save FAILED — the relaunch will
+resume from an older checkpoint".  A second signal while the save is
+still running force-exits immediately via ``os._exit`` (the platform is
+about to SIGKILL anyway; a wedged save must not block the exit).
 """
 from __future__ import annotations
 
+import logging
 import os
 import signal
 import sys
 import threading
 
-__all__ = ["on_preemption", "clear_preemption_handler"]
+__all__ = ["on_preemption", "clear_preemption_handler",
+           "SAVE_FAILED_EXIT_CODE"]
+
+logger = logging.getLogger(__name__)
+
+#: default exit code when save_fn raises (EX_TEMPFAIL: retry-able — the
+#: relaunched job falls back to the previous committed checkpoint)
+SAVE_FAILED_EXIT_CODE = 75
 
 _state = threading.local()
 _installed: dict[int, object] = {}
 
 
 def on_preemption(save_fn, signals=(signal.SIGTERM,), exit_code=143,
-                  exit=True):
+                  exit=True, error_exit_code=SAVE_FAILED_EXIT_CODE):
     """Install ``save_fn()`` as the preemption handler.
 
     save_fn runs once, in the main thread, when any of ``signals``
     arrives; the process then exits with ``exit_code`` (Unix convention
     128+SIGTERM) unless ``exit=False`` (then the previous disposition is
-    NOT re-raised — the caller owns shutdown).
+    NOT re-raised — the caller owns shutdown).  If ``save_fn`` raises,
+    the failure is logged and the process exits with ``error_exit_code``
+    instead, so operators can tell "saved then exited" from "save
+    failed" without grepping logs.  A repeated signal force-exits with
+    ``exit_code`` via ``os._exit`` even mid-save.
 
     Typical use::
 
@@ -41,9 +62,18 @@ def on_preemption(save_fn, signals=(signal.SIGTERM,), exit_code=143,
         done.set()
         try:
             save_fn()
-        finally:
+        except BaseException:
+            # without this, `finally: sys.exit(exit_code)` would both
+            # swallow the save failure and report a clean preemption
+            logger.exception(
+                "preemption save_fn failed (signal %s); exiting %d "
+                "instead of %d — relaunch resumes from the previous "
+                "committed checkpoint", signum, error_exit_code, exit_code)
             if exit:
-                sys.exit(exit_code)
+                sys.exit(error_exit_code)
+            raise
+        if exit:
+            sys.exit(exit_code)
 
     for sig in signals:
         prev = signal.signal(sig, handler)

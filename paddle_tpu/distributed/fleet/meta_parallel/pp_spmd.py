@@ -239,7 +239,8 @@ def pipeline_spmd(stage_fn, stage_params, x, num_microbatches, *,
     else:
         key = jax.random.key(0)
     sp_spec = P(axis_name) if v == 1 else P(None, axis_name)
-    mapped = jax.shard_map(
+    from ..._jax_compat import shard_map
+    mapped = shard_map(
         pipelined, mesh=mesh,
         in_specs=(sp_spec, P(), P()) + tuple(P() for _ in extras_in),
         out_specs=P(axis_name), axis_names={axis_name}, check_vma=False)

@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..._jax_compat import axis_size as _axis_size
+
 __all__ = ["ring_attention", "ulysses_attention", "split_sequence",
            "gather_sequence", "RingFlashAttention"]
 
@@ -83,7 +85,7 @@ def ring_attention(q, k, v, axis_name="sep", causal=False, sm_scale=None,
     differentiable lse output feeds the online merge. Default: kernel on
     TPU backends, XLA elsewhere.
     """
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     r = lax.axis_index(axis_name)
     b, h, sl, d = q.shape
     scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
@@ -138,7 +140,7 @@ def ulysses_attention(q, k, v, axis_name="sep", causal=False, sm_scale=None,
     Local shards (B, H, S_local, D); H must be divisible by the axis
     size.
     """
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     b, h, sl, d = q.shape
     if h % n:
         raise ValueError(f"heads {h} not divisible by sep degree {n}")
@@ -185,7 +187,7 @@ def ulysses_attention(q, k, v, axis_name="sep", causal=False, sm_scale=None,
 def split_sequence(x, axis_name="sep", axis=1):
     """Scatter a replicated tensor's sequence axis across the sep ring
     (the `_c_split` analog on the sequence dimension)."""
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     i = lax.axis_index(axis_name)
     sl = x.shape[axis] // n
     return lax.dynamic_slice_in_dim(x, i * sl, sl, axis=axis)
@@ -214,7 +216,7 @@ class RingFlashAttention:
 
         def in_scope():
             try:
-                lax.axis_size(ax)
+                _axis_size(ax)
                 return True
             except NameError:
                 return False

@@ -10,7 +10,8 @@ so no second comm library exists just for CPU barriers.
 from __future__ import annotations
 
 import logging
-import time
+
+from ..utils.retry import wait_until
 
 __all__ = ["gloo_init_parallel_env", "gloo_barrier", "gloo_release"]
 
@@ -44,13 +45,13 @@ def gloo_barrier(timeout=900.0):
     _gloo["round"] += 1
     key = f"gloo/barrier/{_gloo['round']}"
     store.add(key, 1)
-    deadline = time.monotonic() + timeout
-    while store.add(key, 0) < world:
-        if time.monotonic() > deadline:
-            raise TimeoutError(
-                f"gloo_barrier: only {store.add(key, 0)}/{world} ranks "
-                f"arrived within {timeout}s — a peer likely died")
-        time.sleep(0.01)
+    try:
+        wait_until(lambda: store.add(key, 0) >= world, timeout,
+                   base=0.01, max_delay=0.25, desc="gloo barrier")
+    except TimeoutError:
+        raise TimeoutError(
+            f"gloo_barrier: only {store.add(key, 0)}/{world} ranks "
+            f"arrived within {timeout}s — a peer likely died")
 
 
 def gloo_release():

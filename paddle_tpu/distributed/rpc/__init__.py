@@ -17,8 +17,9 @@ import socket
 import socketserver
 import struct
 import threading
-import time
 from dataclasses import dataclass
+
+from ...utils.retry import wait_until
 
 __all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
            "get_worker_info", "get_all_worker_infos",
@@ -151,8 +152,9 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     _state["workers"] = workers
     # barrier: nobody proceeds until all have published + read the table
     store.add("rpc/ready", 1)
-    while store.add("rpc/ready", 0) < world_size:
-        time.sleep(0.02)
+    wait_until(lambda: store.add("rpc/ready", 0) >= world_size,
+               timeout=60.0, base=0.02, max_delay=0.25,
+               desc="rpc rendezvous barrier")
     return me
 
 

@@ -32,6 +32,9 @@ from . import mesh as _mesh_mod
 __all__ = ["param_shardings", "shard_model_state", "build_train_step"]
 
 
+from ._jax_compat import use_mesh as _use_mesh  # noqa: E402
+
+
 def _spec_for(p, mesh):
     spec = getattr(p, "_spec", None)
     if spec is None:
@@ -305,7 +308,7 @@ def build_train_step(model: Layer, loss_fn, optimizer, mesh=None,
         # LR threaded as a runtime arg: schedulers advance between compiled
         # steps without retracing
         lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
-        with jax.set_mesh(mesh):
+        with _use_mesh(mesh):
             return jitted(state, key, lr, x, *labels)
 
     # expose internals for AOT inspection (bench/memory tests lower the
@@ -498,7 +501,7 @@ def _build_pipelined_train_step(model, loss_fn, optimizer, mesh, donate,
         labels = [jax.device_put(l, data_sharding) for l in labels]
         key = _random.next_key()
         lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
-        with jax.set_mesh(mesh):
+        with _use_mesh(mesh):
             return jitted(state, key, lr, x, *labels)
 
     # expose internals for AOT inspection (bench/memory tests lower the

@@ -325,7 +325,13 @@ class Model:
         from ..framework.io_state import load as _load
         if os.path.isdir(path):  # sharded checkpoint directory
             from ..distributed.checkpoint import load_sharded
+            from ..distributed.checkpoint_manager import latest_checkpoint
             from ..tensor import Tensor
+            # a CheckpointManager root (step_<n> subdirs) resolves to its
+            # newest committed-and-valid step
+            resolved = latest_checkpoint(path)
+            if resolved is not None:
+                path = resolved
             tree = load_sharded(path)
             self.network.set_state_dict(
                 {k: Tensor(v) for k, v in tree["params"].items()})

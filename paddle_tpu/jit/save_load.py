@@ -13,6 +13,7 @@ import os
 
 import numpy as np
 import jax
+import jax.export  # noqa: F401  (binds the submodule attr; not re-exported on older jax)
 import jax.numpy as jnp
 
 from ..tensor import Tensor

@@ -25,6 +25,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# older jax (< 0.5) spells pltpu.CompilerParams as TPUCompilerParams;
+# the kwargs we pass (dimension_semantics) exist under both names
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
 __all__ = ["flash_attention", "mha", "mha_reference"]
 
 _NEG_INF = -1e30
@@ -246,7 +251,7 @@ def _fwd(q, k, v, seed, lens, shift, *, causal, sm_scale, block_q,
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*seed_args, q, k, v)
@@ -405,7 +410,7 @@ def _bwd(q, k, v, out, lse, do, seed, lens, shift, *, causal, sm_scale,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=_sds((bh, sq, d), q.dtype, q),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*seed_args, q, k, v, do, lse, delta)
@@ -436,7 +441,7 @@ def _bwd(q, k, v, out, lse, do, seed, lens, shift, *, causal, sm_scale,
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*seed_args, q, k, v, do, lse, delta)
@@ -916,7 +921,7 @@ def _pk_fwd(q, k, v, seed, meta, *, causal, sm_scale, block_q, block_k,
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*seed_args, klo, khi, q, k, v, pos_q[:, None], ok_q[:, None],
@@ -957,7 +962,7 @@ def _pk_bwd(q, k, v, out, lse, do, seed, meta, *, causal, sm_scale,
         out_specs=pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
         out_shape=_sds((H, capq, d), q.dtype, q),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*seed_args, klo, khi, q, k, v, do, lse, delta, pos_q[:, None],
@@ -993,7 +998,7 @@ def _pk_bwd(q, k, v, out, lse, do, seed, meta, *, causal, sm_scale,
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*seed_args, qlo, qhi, q, k, v, do, lse, delta, pos_q[:, None],

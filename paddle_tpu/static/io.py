@@ -16,6 +16,7 @@ import pickle
 
 import numpy as np
 import jax
+import jax.export  # noqa: F401  (binds the submodule attr; not re-exported on older jax)
 import jax.numpy as jnp
 
 from . import graph as G

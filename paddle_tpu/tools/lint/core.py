@@ -238,6 +238,7 @@ class Linter(ast.NodeVisitor):
             r"(^|/)(ops|kernels|nn/functional)(/|$)", p))
         self.distributed_path = bool(re.search(
             r"(^|/)(distributed|fleet|collective)(/|\.py$|$)", p))
+        self.core_path = bool(re.search(r"(^|/)core(/|\.py$|$)", p))
 
     # -- context helpers used by rules --------------------------------
 

@@ -426,3 +426,27 @@ class SwallowedDistributedError(Rule):
                 continue  # `...` or a lone docstring
             return False
         return True
+
+
+@register
+class RawSleepPollLoop(Rule):
+    id = "TPU009"
+    name = "raw-sleep-poll-loop"
+    rationale = ("a bare time.sleep in a poll/retry loop in coordination "
+                 "code wakes a whole restarted fleet in lockstep and "
+                 "hammers the store; use utils.retry (retry_call / "
+                 "wait_until) for jittered backoff with a deadline")
+
+    _SLEEP_NAMES = {"time.sleep", "sleep", "_time.sleep"}
+
+    def on_call(self, node, ctx):
+        if not (ctx.distributed_path or ctx.core_path):
+            return
+        if not ctx.in_loop:
+            return
+        if dotted(node.func) in self._SLEEP_NAMES:
+            ctx.report(node, self.id,
+                       "raw sleep() in a poll/retry loop; use "
+                       "utils.retry.retry_call/wait_until (jittered "
+                       "backoff, deadline) or suppress if a fixed "
+                       "cadence is genuinely wanted")

@@ -6,6 +6,8 @@ reference's hand-rolled recursion (``utils/layers_utils.py``) collapses to
 registered-pytree traversal.
 """
 from . import unique_name  # noqa: F401
+from . import retry  # noqa: F401
+from .retry import retry_call, wait_until, backoff_delays  # noqa: F401
 from . import dlpack  # noqa: F401
 from . import download  # noqa: F401
 from . import cpp_extension  # noqa: F401
@@ -18,7 +20,8 @@ from .layers_utils import (  # noqa: F401
 )
 
 __all__ = ["deprecated", "run_check", "require_version", "try_import",
-           "unique_name", "dlpack", "download", "cpp_extension"]
+           "unique_name", "dlpack", "download", "cpp_extension",
+           "retry", "retry_call", "wait_until", "backoff_delays"]
 
 
 def require_version(min_version, max_version=None):

@@ -1,19 +1,18 @@
 """Trainer subprocess for the elastic kill-and-relaunch integration test.
 
 Joins elastic membership over the shared TCPStore, resumes from the
-latest sharded checkpoint if one exists, trains a toy model for
-TOTAL_STEPS eager SGD steps (rank 0 checkpoints every step, atomically),
+latest committed checkpoint via CheckpointManager (skipping any
+uncommitted/corrupt debris a SIGKILL left behind), trains a toy model
+for TOTAL_STEPS eager SGD steps (rank 0 commits every step atomically),
 then exits 0. Registers the SIGTERM preemption hook so a graceful stop
 also snapshots.
 
 Env: ELASTIC_STORE_PORT, ELASTIC_HOST (logical host id), ELASTIC_CKPT
-(checkpoint dir), ELASTIC_TOTAL_STEPS, ELASTIC_STEP_SECS,
+(CheckpointManager root), ELASTIC_TOTAL_STEPS, ELASTIC_STEP_SECS,
 ELASTIC_LOG (progress file the test asserts on).
 """
-import glob
 import json
 import os
-import shutil
 import sys
 import time
 
@@ -25,7 +24,8 @@ import numpy as np  # noqa: E402
 import paddle_tpu as pt  # noqa: E402
 import paddle_tpu.distributed as dist  # noqa: E402
 from paddle_tpu import core  # noqa: E402
-from paddle_tpu.distributed import checkpoint as ckpt  # noqa: E402
+from paddle_tpu.distributed.checkpoint_manager import (  # noqa: E402
+    CheckpointManager)
 from paddle_tpu.distributed.fleet.elastic import (  # noqa: E402
     ElasticManager, on_preemption)
 
@@ -33,35 +33,6 @@ from paddle_tpu.distributed.fleet.elastic import (  # noqa: E402
 def log(entry):
     with open(os.environ["ELASTIC_LOG"], "a") as f:
         f.write(json.dumps(entry) + "\n")
-
-
-def save_atomic(state, path):
-    """Write-then-swap so a SIGKILL mid-save never corrupts `path`."""
-    pid = os.getpid()
-    tmp, old = f"{path}.tmp-{pid}", f"{path}.old-{pid}"
-    shutil.rmtree(tmp, ignore_errors=True)
-    ckpt.save_state(state, tmp)
-    shutil.rmtree(old, ignore_errors=True)
-    try:
-        if os.path.exists(path):
-            os.rename(path, old)
-        os.rename(tmp, path)
-    except OSError:
-        shutil.rmtree(tmp, ignore_errors=True)
-    shutil.rmtree(old, ignore_errors=True)
-
-
-def load_retry(path, state, tries=5):
-    for i in range(tries):
-        try:
-            if glob.glob(os.path.join(path, "index.*.json")):
-                return ckpt.load_state(path, state), True
-            return state, False
-        except Exception:
-            if i == tries - 1:
-                raise
-            time.sleep(0.1)
-    return state, False
 
 
 def main():
@@ -91,14 +62,16 @@ def main():
     state = dict(state)
     state["train_step"] = jnp.int32(0)
 
-    state, resumed = load_retry(path, state)
+    mgr = CheckpointManager(path, keep_last_n=2)
+    state, _ = mgr.restore_latest(template=state)
     start = int(state["train_step"])
     log({"event": "start", "host": host, "rank": rank,
          "resumed_from": start, "hosts": hosts, "pid": os.getpid()})
 
-    on_preemption(lambda: (save_atomic(state, path),
-                           log({"event": "preempt_save", "host": host,
-                                "step": int(state["train_step"])})))
+    on_preemption(lambda: (
+        mgr.save(int(state["train_step"]), state, block=True),
+        log({"event": "preempt_save", "host": host,
+             "step": int(state["train_step"])})))
 
     rng = np.random.RandomState(0)
     x = rng.randn(8, 8).astype(np.float32)
@@ -110,7 +83,7 @@ def main():
         state.update(new_state)
         state["train_step"] = jnp.int32(i + 1)
         if rank == 0:
-            save_atomic(state, path)
+            mgr.save(i + 1, state)
         time.sleep(dt)
     log({"event": "done", "host": host, "final_step": total,
          "final_loss": float(loss) if loss is not None else None})

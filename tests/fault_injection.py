@@ -1,0 +1,131 @@
+"""Deterministic fault injection for the crash-consistent checkpoint layer.
+
+The checkpoint commit protocol funnels every durable byte through two
+module-level functions of ``paddle_tpu.distributed.checkpoint`` —
+``_write_file`` (each shard / index / COMMIT marker) and ``_replace_dir``
+(the atomic promote).  Patching exactly those two lets a test simulate a
+SIGKILL at ANY point of a save without subprocesses or timing games:
+
+    with FaultInjector(fail_after=2):
+        mgr.save(step, state)          # raises KilledSave mid-save
+    # disk now holds whatever a real crash would have left behind
+
+``corrupt_file`` / ``truncate_file`` simulate post-commit bit-rot and
+torn writes for the integrity-verification paths.
+"""
+import os
+
+from paddle_tpu.distributed import checkpoint as ckpt
+
+__all__ = ["KilledSave", "FaultInjector", "corrupt_file", "truncate_file",
+           "data_files"]
+
+
+class KilledSave(BaseException):
+    """The injected "process died here" signal.
+
+    Derives from BaseException on purpose: recovery code under test that
+    does ``except Exception`` must not be able to swallow a simulated
+    SIGKILL — a real one is not catchable either.
+    """
+
+
+class FaultInjector:
+    """Kill a save deterministically after the Nth durable file write.
+
+    Args:
+        fail_after: number of file writes allowed to land; the next one
+            raises :class:`KilledSave`.  0 kills before any byte hits
+            disk.  ``None`` never kills on write (use with
+            ``fail_before_rename``).
+        partial_bytes: when set, the killing write first lands this many
+            bytes of its payload — a torn write, the worst case for a
+            crash mid-``write(2)``.
+        fail_before_rename: let every write land, then kill between the
+            staging directory becoming complete and the atomic rename —
+            the narrowest crash window of the protocol.
+
+    The patch is scoped to the ``with`` block and restores the original
+    functions even when the injected kill propagates.
+    """
+
+    def __init__(self, fail_after=0, partial_bytes=None,
+                 fail_before_rename=False):
+        if fail_after is None and not fail_before_rename:
+            raise ValueError("fail_after=None requires fail_before_rename")
+        self.fail_after = fail_after
+        self.partial_bytes = partial_bytes
+        self.fail_before_rename = fail_before_rename
+        self.writes = 0          # writes that actually landed
+
+    def __enter__(self):
+        self._orig_write = ckpt._write_file
+        self._orig_replace = ckpt._replace_dir
+        self.writes = 0
+
+        def _write(path, data, durable=True):
+            if (self.fail_after is not None
+                    and self.writes >= self.fail_after):
+                if self.partial_bytes is not None:
+                    self._orig_write(path, data[:self.partial_bytes],
+                                     durable=durable)
+                raise KilledSave(
+                    f"injected kill at write #{self.writes + 1} "
+                    f"({os.path.basename(path)})")
+            self.writes += 1
+            return self._orig_write(path, data, durable=durable)
+
+        def _replace(tmp, final):
+            if self.fail_before_rename:
+                raise KilledSave(
+                    f"injected kill before atomic rename of {tmp}")
+            return self._orig_replace(tmp, final)
+
+        ckpt._write_file = _write
+        ckpt._replace_dir = _replace
+        return self
+
+    def __exit__(self, *exc):
+        ckpt._write_file = self._orig_write
+        ckpt._replace_dir = self._orig_replace
+        return False  # let KilledSave propagate to the test
+
+
+def corrupt_file(path, offset=-1, flip=0xFF):
+    """Flip one byte in place (CRC mismatch, size unchanged).
+
+    ``offset`` < 0 counts from the end of the file.  XOR with ``flip``
+    (default 0xFF) guarantees the byte changes.
+    """
+    with open(path, "r+b") as f:
+        if offset < 0:
+            f.seek(offset, os.SEEK_END)
+        else:
+            f.seek(offset)
+        pos = f.tell()
+        b = f.read(1)
+        if not b:
+            raise ValueError(f"offset {offset} out of range for {path}")
+        f.seek(pos)
+        f.write(bytes([b[0] ^ flip]))
+
+
+def truncate_file(path, keep=None):
+    """Drop bytes from the end (size mismatch — a torn/partial write).
+
+    ``keep`` defaults to half the current size."""
+    size = os.path.getsize(path)
+    if keep is None:
+        keep = size // 2
+    with open(path, "r+b") as f:
+        f.truncate(keep)
+
+
+def data_files(ckpt_dir):
+    """Sorted relative paths of every shard file under ``ckpt_dir``."""
+    out = []
+    data_root = os.path.join(ckpt_dir, "data")
+    for root, _dirs, files in os.walk(data_root):
+        for fn in files:
+            out.append(os.path.relpath(os.path.join(root, fn), ckpt_dir))
+    return sorted(out)

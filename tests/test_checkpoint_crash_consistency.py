@@ -1,0 +1,495 @@
+"""Crash-consistent checkpointing: the fault-injection proof.
+
+The contract under test (distributed/checkpoint.py + checkpoint_manager.py):
+a process killed at ANY instant of a save leaves the previous committed
+checkpoint loadable bit-for-bit, and post-commit corruption (bit-rot,
+truncation) is detected and skipped — never silently loaded.  Faults are
+injected deterministically via tests/fault_injection.py, which patches the
+two functions every durable byte funnels through.
+"""
+import json
+import os
+import random
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.distributed.checkpoint import (
+    CheckpointCorruptError, is_committed, load_sharded, save_sharded,
+    store_barrier, verify_checkpoint,
+)
+from paddle_tpu.distributed.checkpoint_manager import (
+    CheckpointManager, latest_checkpoint,
+)
+from paddle_tpu.utils.retry import backoff_delays, retry_call, wait_until
+
+from fault_injection import (
+    FaultInjector, KilledSave, corrupt_file, data_files, truncate_file,
+)
+
+
+def _state(v):
+    """Small deterministic pytree; distinct per version ``v``."""
+    return {"w": jnp.arange(32, dtype=jnp.float32).reshape(8, 4) + v,
+            "nested": {"b": jnp.full((6,), float(v), dtype=jnp.float32)}}
+
+
+def _assert_state_equal(a, b):
+    fa = sorted(ckpt._flat_items(a))
+    fb = sorted(ckpt._flat_items(b))
+    assert [p for p, _ in fa] == [p for p, _ in fb]
+    for (_, x), (_, y) in zip(fa, fb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _count_writes(tmp_path, state):
+    """Durable file writes of one single-host save of ``state``."""
+    with FaultInjector(fail_after=10 ** 6) as fi:
+        save_sharded(state, str(tmp_path / "_probe"))
+    return fi.writes
+
+
+# -- retry primitives (deterministic: injected rng/sleep/clock) --------------
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, d):
+        assert d >= 0
+        self.t += d
+
+
+def test_backoff_delays_shape_and_cap():
+    ds = list(backoff_delays(base=0.1, factor=2.0, max_delay=0.5,
+                             jitter=0.0, max_tries=5))
+    assert ds == [0.1, 0.2, 0.4, 0.5, 0.5]
+
+
+def test_backoff_delays_jitter_band():
+    rng = random.Random(0)
+    ds = list(backoff_delays(base=1.0, factor=1.0, max_delay=1.0,
+                             jitter=0.25, max_tries=100, rng=rng))
+    assert all(0.75 <= d <= 1.25 for d in ds)
+    assert len(set(ds)) > 1  # actually jittered
+
+
+def test_backoff_delays_respects_deadline():
+    clk = _FakeClock()
+    ds = backoff_delays(base=1.0, factor=1.0, max_delay=1.0, jitter=0.0,
+                        deadline=2.5, clock=clk)
+    out = []
+    for d in ds:
+        out.append(d)
+        clk.sleep(d)
+    # 1.0 + 1.0 + clipped 0.5 == deadline; never sleeps past it
+    assert out == [1.0, 1.0, 0.5]
+    assert clk.t == 2.5
+
+
+def test_backoff_delays_rejects_bad_policy():
+    with pytest.raises(ValueError):
+        next(backoff_delays(base=-1))
+    with pytest.raises(ValueError):
+        next(backoff_delays(factor=0.5))
+    with pytest.raises(ValueError):
+        next(backoff_delays(jitter=2.0))
+
+
+def test_retry_call_retries_then_succeeds():
+    clk = _FakeClock()
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("store not up yet")
+        return "ok"
+
+    seen = []
+    out = retry_call(flaky, retry_on=(ConnectionError,), deadline=60,
+                     base=0.05, jitter=0.0, sleep=clk.sleep, clock=clk,
+                     on_retry=lambda a, e, d: seen.append((a, d)))
+    assert out == "ok" and calls["n"] == 3
+    assert seen == [(1, 0.05), (2, 0.1)]
+
+
+def test_retry_call_exhausted_reraises_last():
+    clk = _FakeClock()
+
+    def always():
+        raise TimeoutError("still down")
+
+    with pytest.raises(TimeoutError, match="still down"):
+        retry_call(always, retry_on=(TimeoutError,), max_tries=3,
+                   jitter=0.0, sleep=clk.sleep, clock=clk)
+
+
+def test_retry_call_unlisted_exception_propagates_immediately():
+    calls = {"n": 0}
+
+    def boom():
+        calls["n"] += 1
+        raise ValueError("not retryable")
+
+    with pytest.raises(ValueError):
+        retry_call(boom, retry_on=(ConnectionError,), max_tries=10,
+                   sleep=lambda d: None)
+    assert calls["n"] == 1
+
+
+def test_wait_until_returns_first_truthy_value():
+    clk = _FakeClock()
+    vals = iter([None, 0, "", (1, 2)])
+    out = wait_until(lambda: next(vals), timeout=60, jitter=0.0,
+                     sleep=clk.sleep, clock=clk)
+    assert out == (1, 2)
+
+
+def test_wait_until_timeout_names_the_wait():
+    clk = _FakeClock()
+    with pytest.raises(TimeoutError, match="peer rendezvous"):
+        wait_until(lambda: False, timeout=1.0, jitter=0.0,
+                   desc="peer rendezvous", sleep=clk.sleep, clock=clk)
+    assert clk.t <= 1.0  # never slept past the deadline
+
+
+# -- atomic commit: kill at every write boundary -----------------------------
+
+def test_kill_after_any_write_falls_back_to_previous_commit(tmp_path):
+    """The tentpole proof: interrupt a save after the Nth durable write,
+    for EVERY N, and the previous committed checkpoint must restore
+    bit-for-bit with latest_step() reporting it."""
+    v1, v2 = _state(1), _state(2)
+    total = _count_writes(tmp_path, v1)
+    assert total >= 4  # 2 shards + index + COMMIT marker
+
+    for n in range(total):
+        root = str(tmp_path / f"root_{n}")
+        mgr = CheckpointManager(root, keep_last_n=3)
+        mgr.save(1, v1)
+        assert is_committed(mgr.step_dir(1))
+
+        with pytest.raises(KilledSave):
+            with FaultInjector(fail_after=n):
+                mgr.save(2, v2)
+
+        assert mgr.latest_step() == 1
+        restored, step = mgr.restore_latest(template=v1)
+        assert step == 1
+        _assert_state_equal(restored, v1)
+        # and the recovery path still saves cleanly afterwards
+        mgr.save(2, v2)
+        restored2, step2 = mgr.restore_latest(template=v1)
+        assert step2 == 2
+        _assert_state_equal(restored2, v2)
+
+
+def test_kill_before_rename_leaves_no_new_step(tmp_path):
+    """Crash in the narrowest window — staging complete, rename pending:
+    the new step dir must not exist and the old one must win."""
+    root = str(tmp_path / "root")
+    mgr = CheckpointManager(root)
+    mgr.save(1, _state(1))
+    with pytest.raises(KilledSave):
+        with FaultInjector(fail_after=None, fail_before_rename=True):
+            mgr.save(2, _state(2))
+    assert not os.path.isdir(mgr.step_dir(2))
+    assert mgr.latest_step() == 1
+    # staged debris is swept once a newer save commits
+    assert any(".tmp." in n for n in os.listdir(root))
+    mgr.save(3, _state(3))
+    assert not any(".tmp." in n for n in os.listdir(root))
+
+
+def test_torn_write_is_never_loadable(tmp_path):
+    """A torn write (partial payload of the killing write lands) must
+    leave the staged dir uncommitted — the COMMIT marker is written
+    last, so the tear can only hit data/index before any marker."""
+    root = str(tmp_path / "root")
+    mgr = CheckpointManager(root)
+    mgr.save(1, _state(1))
+    with pytest.raises(KilledSave):
+        with FaultInjector(fail_after=1, partial_bytes=7):
+            mgr.save(2, _state(2))
+    assert mgr.latest_step() == 1
+    restored, step = mgr.restore_latest(template=_state(0))
+    assert step == 1
+    _assert_state_equal(restored, _state(1))
+
+
+def test_overwrite_same_step_is_atomic(tmp_path):
+    """Re-saving an existing step (preemption re-save) swaps the old
+    commit out atomically; a kill mid-overwrite keeps the OLD content."""
+    root = str(tmp_path / "root")
+    mgr = CheckpointManager(root)
+    mgr.save(1, _state(1))
+    with pytest.raises(KilledSave):
+        with FaultInjector(fail_after=2):
+            mgr.save(1, _state(9))
+    restored, step = mgr.restore_latest(template=_state(0))
+    assert step == 1
+    _assert_state_equal(restored, _state(1))
+
+
+# -- integrity: post-commit corruption ---------------------------------------
+
+def test_corrupted_shard_detected_named_and_skipped(tmp_path):
+    root = str(tmp_path / "root")
+    mgr = CheckpointManager(root)
+    mgr.save(1, _state(1))
+    mgr.save(2, _state(2))
+    d2 = mgr.step_dir(2)
+    victim = data_files(d2)[0]
+    corrupt_file(os.path.join(d2, victim))
+
+    # direct load: raises, naming the offending file
+    with pytest.raises(CheckpointCorruptError, match="CRC"):
+        load_sharded(d2, template=_state(0))
+    with pytest.raises(CheckpointCorruptError,
+                       match=victim.replace("\\", "/").split("/")[-1]):
+        verify_checkpoint(d2, integrity="full")
+
+    # size-level scan can't see bit-rot (size unchanged)...
+    assert mgr.latest_step() == 2
+    # ...but restore_latest full-verifies, falls back, and remembers
+    restored, step = mgr.restore_latest(template=_state(0))
+    assert step == 1
+    _assert_state_equal(restored, _state(1))
+    assert mgr.latest_step() == 1  # reports the fallback step
+
+
+def test_truncated_shard_detected_by_cheap_scan(tmp_path):
+    root = str(tmp_path / "root")
+    mgr = CheckpointManager(root)
+    mgr.save(1, _state(1))
+    mgr.save(2, _state(2))
+    d2 = mgr.step_dir(2)
+    truncate_file(os.path.join(d2, data_files(d2)[0]))
+    # size mismatch: even the size-level manifest scan rejects step 2
+    assert mgr.latest_step() == 1
+    restored, step = mgr.restore_latest(template=_state(0))
+    assert step == 1
+    _assert_state_equal(restored, _state(1))
+
+
+def test_missing_shard_and_stray_file_detected(tmp_path):
+    p = str(tmp_path / "ck")
+    save_sharded(_state(1), p)
+    files = data_files(p)
+    os.remove(os.path.join(p, files[0]))
+    with pytest.raises(CheckpointCorruptError, match="missing"):
+        verify_checkpoint(p, integrity="size")
+
+
+def test_unreadable_commit_marker_is_corrupt_not_crash(tmp_path):
+    p = str(tmp_path / "ck")
+    save_sharded(_state(1), p)
+    marker = os.path.join(p, "COMMIT.0")
+    with open(marker, "w") as f:
+        f.write("{not json")
+    assert not is_committed(p)
+    with pytest.raises(CheckpointCorruptError):
+        verify_checkpoint(p)
+
+
+def test_uncommitted_dir_is_invisible_to_loads(tmp_path):
+    p = str(tmp_path / "ck")
+    save_sharded(_state(1), p)
+    os.remove(os.path.join(p, "COMMIT.0"))
+    assert not is_committed(p)
+    with pytest.raises(CheckpointCorruptError, match="COMMIT"):
+        load_sharded(p, template=_state(0))
+
+
+def test_legacy_unverified_load_still_works(tmp_path):
+    """integrity="off" skips manifest checks but still requires commit."""
+    p = str(tmp_path / "ck")
+    save_sharded(_state(3), p)
+    out = load_sharded(p, template=_state(0), integrity="off")
+    _assert_state_equal(out, _state(3))
+
+
+# -- multi-host commit markers ----------------------------------------------
+
+def test_multihost_commit_requires_all_markers(tmp_path):
+    p = str(tmp_path / "ck")
+    v = _state(4)
+    save_sharded(v, p, process_index=0, world_size=2)
+    # half-committed: proc 1's marker missing -> not loadable
+    assert os.path.exists(os.path.join(p, "COMMIT.0"))
+    assert not is_committed(p)
+    with pytest.raises(CheckpointCorruptError, match="1"):
+        verify_checkpoint(p, integrity="size")
+
+    save_sharded(v, p, process_index=1, world_size=2)
+    assert is_committed(p)
+    verify_checkpoint(p, integrity="full")
+    marker = json.load(open(os.path.join(p, "COMMIT.1")))
+    assert marker["world"] == 2 and marker["proc"] == 1
+
+
+def test_store_barrier_blocks_until_world_arrives():
+    class _Store:
+        def __init__(self):
+            self.counts = {}
+
+        def add(self, key, n):
+            self.counts[key] = self.counts.get(key, 0) + n
+            return self.counts[key]
+
+    s = _Store()
+    # world of 1: own arrival satisfies the barrier immediately
+    store_barrier(s, "ckpt/x/commit", 1)
+    # simulate the peer having arrived first: count reaches 2 instantly
+    s.add("ckpt/y/commit", 1)
+    store_barrier(s, "ckpt/y/commit", 2)
+    assert s.counts["ckpt/y/commit"] == 2
+
+    with pytest.raises(TimeoutError):
+        store_barrier(_Store(), "ckpt/z/commit", 2, timeout=0.2)
+
+
+# -- CheckpointManager: rotation, GC, async ----------------------------------
+
+def test_gc_keeps_last_n_only(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "root"), keep_last_n=2)
+    for i in range(1, 5):
+        mgr.save(i, _state(i))
+    assert mgr.all_steps() == [3, 4]
+    assert mgr.valid_steps() == [3, 4]
+
+
+def test_gc_never_deletes_only_valid_checkpoint(tmp_path):
+    root = str(tmp_path / "root")
+    mgr = CheckpointManager(root, keep_last_n=1)
+    mgr.save(1, _state(1))
+    for n in (0, 1, 2):
+        with pytest.raises(KilledSave):
+            with FaultInjector(fail_after=n):
+                mgr.save(2, _state(2))
+        assert mgr.latest_step() == 1  # sole survivor untouched
+    mgr.save(3, _state(3))
+    assert mgr.all_steps() == [3]  # rotation resumes once a commit lands
+
+
+def test_gc_sweeps_old_uncommitted_debris_not_newer(tmp_path):
+    root = str(tmp_path / "root")
+    mgr = CheckpointManager(root, keep_last_n=2)
+    mgr.save(1, _state(1))
+    # fake crash debris OLDER than the newest valid step...
+    os.makedirs(os.path.join(root, "step_00000000"))
+    # ...and an uncommitted dir NEWER (a concurrent in-flight save)
+    os.makedirs(os.path.join(root, "step_00000099"))
+    mgr.save(2, _state(2))
+    names = set(os.listdir(root))
+    assert "step_00000000" not in names   # swept
+    assert "step_00000099" in names       # left alone
+    assert mgr.latest_step() == 2
+
+
+def test_keep_last_n_validation(tmp_path):
+    with pytest.raises(ValueError):
+        CheckpointManager(str(tmp_path / "r"), keep_last_n=0)
+
+
+def test_restore_latest_on_empty_root_is_fresh_start(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "root"))
+    tpl = _state(0)
+    state, step = mgr.restore_latest(template=tpl)
+    assert step is None and state is tpl
+
+
+def test_async_save_round_trip_and_ordering(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "root"), async_save=True,
+                            keep_last_n=2)
+    for i in range(1, 4):
+        mgr.save(i, _state(i))
+    mgr.close()
+    assert mgr.all_steps() == [2, 3]
+    restored, step = mgr.restore_latest(template=_state(0))
+    assert step == 3
+    _assert_state_equal(restored, _state(3))
+
+
+def test_async_save_error_surfaces_on_next_call(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "root"), async_save=True)
+    mgr.save(1, _state(1))
+    mgr.wait()
+    with FaultInjector(fail_after=0):
+        mgr.save(2, _state(2))    # queues; writer dies in background
+        with pytest.raises(KilledSave):
+            mgr.wait()            # ...and the failure surfaces here
+    # manager remains usable; step 1 still the latest valid
+    assert mgr.latest_step() == 1
+    mgr.save(3, _state(3))
+    mgr.close()
+    assert mgr.latest_step() == 3
+
+
+def test_save_block_forces_synchronous_commit(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "root"), async_save=True)
+    mgr.save(1, _state(1), block=True)
+    # committed before returning — no wait() needed
+    assert is_committed(mgr.step_dir(1))
+
+
+def test_latest_checkpoint_helper(tmp_path):
+    root = str(tmp_path / "root")
+    assert latest_checkpoint(root) is None       # doesn't exist
+    mgr = CheckpointManager(root)
+    assert latest_checkpoint(root) is None       # no steps yet
+    mgr.save(7, _state(7))
+    assert latest_checkpoint(root) == mgr.step_dir(7)
+    # a plain (non-manager) sharded dir: None, caller keeps its path
+    p = str(tmp_path / "plain")
+    save_sharded(_state(1), p)
+    assert latest_checkpoint(p) is None
+
+
+def test_hapi_model_load_resolves_manager_root(tmp_path):
+    import paddle_tpu as pt
+    net = pt.nn.Sequential(pt.nn.Linear(4, 8), pt.nn.ReLU(),
+                           pt.nn.Linear(8, 2))
+    m = pt.Model(net)
+    root = str(tmp_path / "root")
+    mgr = CheckpointManager(root)
+    params = {k: t._data for k, t in net.state_dict().items()}
+    mgr.save(1, {"params": params})
+    w_before = np.asarray(net[0].weight._data).copy()
+    # corrupt a NEWER step: load must resolve to the older valid one
+    mgr.save(2, {"params": {k: v + 123.0 for k, v in params.items()}})
+    d2 = mgr.step_dir(2)
+    truncate_file(os.path.join(d2, data_files(d2)[0]))
+    net[0].weight._data = net[0].weight._data + 1.0
+    m.load(root)
+    np.testing.assert_array_equal(np.asarray(net[0].weight._data),
+                                  w_before)
+
+
+def test_engine_restore_latest(tmp_path):
+    import paddle_tpu as pt
+    from paddle_tpu.distributed.auto_parallel.engine import Engine
+
+    def _build():
+        pt.seed(0)
+        net = pt.nn.Sequential(pt.nn.Linear(4, 8), pt.nn.ReLU(),
+                               pt.nn.Linear(8, 2))
+        opt = pt.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+        return Engine(net, pt.nn.CrossEntropyLoss(), opt)
+
+    eng = _build()
+    eng.prepare(mode="train")
+    root = str(tmp_path / "root")
+    mgr = CheckpointManager(root)
+    assert _build().restore_latest(root) is None   # empty -> fresh start
+    mgr.save(5, eng._state)
+    eng2 = _build()
+    assert eng2.restore_latest(root) == 5
+    _assert_state_equal(eng2._state, eng._state)

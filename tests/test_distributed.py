@@ -16,6 +16,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 import paddle_tpu as pt
 import paddle_tpu.distributed as dist
 from paddle_tpu.tensor import Tensor
+from paddle_tpu.distributed._jax_compat import shard_map as _shard_map, use_mesh as _use_mesh
 
 
 N = 8  # virtual device count (conftest)
@@ -129,7 +130,7 @@ def test_all_reduce_spmd_inside_shard_map():
         t = dist.all_reduce(Tensor(xs), group=g)
         return t._data
 
-    out = jax.shard_map(body, mesh=mesh, in_specs=P("dp"),
+    out = _shard_map(body, mesh=mesh, in_specs=P("dp"),
                         out_specs=P("dp"))(jnp.asarray(x))
     np.testing.assert_allclose(np.asarray(out),
                                np.tile(x.sum(0, keepdims=True), (N, 1)),
@@ -144,7 +145,7 @@ def test_reduce_scatter_spmd():
     def body(xs):
         return dist.reduce_scatter(Tensor(xs), group=g)._data
 
-    out = jax.shard_map(body, mesh=mesh, in_specs=P("dp"),
+    out = _shard_map(body, mesh=mesh, in_specs=P("dp"),
                         out_specs=P("dp"), check_vma=False)(jnp.asarray(x))
     # per-rank input chunk [N*2]; psum_scatter: rank i gets the sum over
     # ranks of subchunk i
@@ -218,7 +219,7 @@ def test_column_parallel_linear_manual_vs_dense():
                                  (Tensor(xs),))
         return out._data
 
-    out = jax.shard_map(body, mesh=mesh,
+    out = _shard_map(body, mesh=mesh,
                         in_specs=(P(), P(None, "mp"), P("mp")),
                         out_specs=P(), check_vma=False)(
         jnp.asarray(x), jnp.asarray(w), jnp.asarray(b))
@@ -241,7 +242,7 @@ def test_row_parallel_linear_manual_vs_dense():
                                  (Tensor(xs),))
         return out._data
 
-    out = jax.shard_map(body, mesh=mesh,
+    out = _shard_map(body, mesh=mesh,
                         in_specs=(P(), P("mp", None), P()),
                         out_specs=P(), check_vma=False)(
         jnp.asarray(x), jnp.asarray(w), jnp.asarray(b))
@@ -263,7 +264,7 @@ def test_vocab_parallel_embedding_manual_vs_dense():
         out, _ = functional_call(layer, {"weight": ws}, {}, (Tensor(ids),))
         return out._data
 
-    out = jax.shard_map(body, mesh=mesh, in_specs=(P(), P("mp", None)),
+    out = _shard_map(body, mesh=mesh, in_specs=(P(), P("mp", None)),
                         out_specs=P(), check_vma=False)(
         jnp.asarray(idx), jnp.asarray(w))
     np.testing.assert_allclose(np.asarray(out), dense, rtol=1e-6)
@@ -286,7 +287,7 @@ def test_parallel_cross_entropy_manual_vs_dense():
     def body(lg, yy):
         return ce(Tensor(lg), Tensor(yy))._data
 
-    out = jax.shard_map(body, mesh=mesh, in_specs=(P(None, "mp"), P()),
+    out = _shard_map(body, mesh=mesh, in_specs=(P(None, "mp"), P()),
                         out_specs=P(), check_vma=False)(
         jnp.asarray(logits), jnp.asarray(y))
     np.testing.assert_allclose(np.asarray(out)[:, 0], dense, rtol=1e-5,
@@ -322,7 +323,7 @@ def test_column_parallel_gspmd_jit_matches_dense():
                  "rw": NamedSharding(mesh, P("mp", None)),
                  "rb": NamedSharding(mesh, P())}
     params = jax.device_put(params, shardings)
-    with jax.set_mesh(mesh):
+    with _use_mesh(mesh):
         out = jax.jit(fwd)(params, jnp.asarray(x))
     np.testing.assert_allclose(np.asarray(out), dense, rtol=2e-5, atol=2e-5)
 

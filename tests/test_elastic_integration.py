@@ -97,9 +97,10 @@ def test_kill_and_relaunch_resumes_from_checkpoint(tmp_path):
     _wait_for(lambda: len([e for e in _read_log(log_path)
                            if e["event"] == "start"]) >= 2,
               60, "both workers to start")
-    # let rank 0 write a few checkpoints, then SIGKILL nodeB's trainer
-    _wait_for(lambda: glob.glob(os.path.join(ckpt_dir, "index.*.json")),
-              30, "first checkpoint")
+    # let rank 0 commit a few checkpoints, then SIGKILL nodeB's trainer
+    _wait_for(lambda: glob.glob(os.path.join(ckpt_dir, "step_*",
+                                             "COMMIT.*")),
+              30, "first committed checkpoint")
     starts = [e for e in _read_log(log_path) if e["event"] == "start"]
     victim = next(e for e in starts if e["host"] == "nodeB")
     os.kill(victim["pid"], signal.SIGKILL)
@@ -149,6 +150,88 @@ def test_lease_expiry_detection_and_rank_remap():
     man.exit()
 
 
+def test_heartbeat_lease_expiry_drops_silent_node():
+    """A node that stops heartbeating falls out of alive_nodes() after
+    one lease TTL — the eviction primitive the watcher builds on."""
+    master = core.TCPStore(is_master=True)
+    store = core.TCPStore("127.0.0.1", master.port)
+    # heartbeat interval far beyond the lease: only register()'s initial
+    # beats land, then the node goes silent
+    man = ElasticManager(store, "hostA", np="1:2",
+                         heartbeat_interval=30.0, lease_ttl=0.5)
+    man.register()
+    assert man.alive_nodes() == ["hostA"]
+    _wait_for(lambda: man.alive_nodes() == [], 5.0,
+              "silent node to age out of the lease")
+    man.exit()
+
+
+_PREEMPT_STUB = r"""
+import os, signal, sys, time
+sys.path.insert(0, sys.argv[1])
+from paddle_tpu.distributed.fleet.elastic import on_preemption
+
+mode, flag = sys.argv[2], sys.argv[3]
+
+def slow_save():
+    open(flag, "w").write("saving")
+    time.sleep(60)   # wedged save: only a second signal can end this
+
+def bad_save():
+    raise RuntimeError("disk full")
+
+on_preemption(slow_save if mode == "slow" else bad_save)
+open(flag + ".ready", "w").write("ready")
+while True:
+    time.sleep(0.1)
+"""
+
+
+def _spawn_preempt_stub(tmp_path, mode):
+    flag = str(tmp_path / "flag")
+    stub = str(tmp_path / "stub.py")
+    with open(stub, "w") as f:
+        f.write(_PREEMPT_STUB)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, stub, root, mode, flag],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    _wait_for(lambda: os.path.exists(flag + ".ready"), 60,
+              "preemption stub ready")
+    return proc, flag
+
+
+def test_double_signal_force_exits_wedged_save(tmp_path):
+    """SIGTERM starts a save that never finishes; a second SIGTERM must
+    force-exit immediately via os._exit instead of hanging until the
+    platform's SIGKILL."""
+    proc, flag = _spawn_preempt_stub(tmp_path, "slow")
+    try:
+        proc.send_signal(signal.SIGTERM)
+        _wait_for(lambda: os.path.exists(flag), 30, "save_fn to start")
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=15)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert rc == 143, rc
+
+
+def test_failed_preemption_save_exits_distinct_code(tmp_path):
+    """A raising save_fn must not be swallowed into a clean 143 exit:
+    the worker exits SAVE_FAILED_EXIT_CODE so the operator can tell
+    'saved then exited' from 'save failed'."""
+    from paddle_tpu.distributed.fleet.elastic import SAVE_FAILED_EXIT_CODE
+    proc, _ = _spawn_preempt_stub(tmp_path, "bad")
+    try:
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert rc == SAVE_FAILED_EXIT_CODE, rc
+
+
 @pytest.mark.slow
 def test_sigterm_preemption_saves_checkpoint(tmp_path):
     """SIGTERM (TPU preemption notice) triggers the on_preemption hook:
@@ -171,7 +254,8 @@ def test_sigterm_preemption_saves_checkpoint(tmp_path):
                               for e in _read_log(log_path)),
                   60, "worker start")
         _wait_for(lambda: glob.glob(
-            os.path.join(ckpt_dir, "index.*.json")), 30, "first ckpt")
+            os.path.join(ckpt_dir, "step_*", "COMMIT.*")),
+            30, "first committed ckpt")
         proc.send_signal(signal.SIGTERM)
         rc = proc.wait(timeout=30)
     finally:
@@ -180,4 +264,4 @@ def test_sigterm_preemption_saves_checkpoint(tmp_path):
     assert rc == 143, rc
     events = _read_log(log_path)
     assert any(e["event"] == "preempt_save" for e in events), events
-    assert glob.glob(os.path.join(ckpt_dir, "index.*.json"))
+    assert glob.glob(os.path.join(ckpt_dir, "step_*", "COMMIT.*"))

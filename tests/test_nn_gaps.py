@@ -13,6 +13,7 @@ import paddle_tpu as pt
 import paddle_tpu.nn as nn
 import paddle_tpu.nn.functional as F
 from paddle_tpu import Tensor
+from paddle_tpu.distributed._jax_compat import shard_map as _shard_map, use_mesh as _use_mesh
 
 RNG = np.random.RandomState(0)
 
@@ -68,7 +69,7 @@ def test_margin_cross_entropy_mp_sharded():
         out = F.margin_cross_entropy(Tensor(lg), Tensor(y), reduction=None)
         return out._data
 
-    sharded = jax.jit(jax.shard_map(
+    sharded = jax.jit(_shard_map(
         f, mesh=mesh, in_specs=(P(None, "mp"), P()), out_specs=P()))
     got = np.asarray(sharded(jnp.asarray(logits), jnp.asarray(label)))
     np.testing.assert_allclose(got.ravel(), ref_loss, atol=1e-4)

@@ -16,6 +16,7 @@ from paddle_tpu.distributed.train_step import build_train_step
 from paddle_tpu.incubate.models import (GPTForCausalLM,
                                         GPTPretrainingCriterion, gpt_tiny)
 from paddle_tpu.framework import random as _random
+from paddle_tpu.distributed._jax_compat import shard_map as _shard_map, use_mesh as _use_mesh
 
 
 @pytest.fixture(autouse=True)
@@ -31,7 +32,7 @@ def _mem(step, state, ids, labels):
     lr = jnp.float32(1e-3)
     x = jax.device_put(jnp.asarray(ids), step.data_sharding)
     y = jax.device_put(jnp.asarray(labels), step.data_sharding)
-    with jax.set_mesh(step.mesh):
+    with _use_mesh(step.mesh):
         compiled = step.jitted.lower(state, key, lr, x, y).compile()
     ma = compiled.memory_analysis()
     return (int(ma.argument_size_in_bytes), int(ma.temp_size_in_bytes))

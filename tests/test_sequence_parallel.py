@@ -12,6 +12,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from paddle_tpu.distributed.fleet.meta_parallel.sequence_parallel import (
     gather_sequence, ring_attention, split_sequence, ulysses_attention)
 from paddle_tpu.ops.pallas_ops import mha_reference
+from paddle_tpu.distributed._jax_compat import shard_map as _shard_map, use_mesh as _use_mesh
 
 
 def _mesh(n=4):
@@ -33,7 +34,7 @@ def test_ring_attention_matches_dense(causal):
     def f(q, k, v):
         return ring_attention(q, k, v, axis_name="sep", causal=causal)
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(_shard_map(
         f, mesh=_mesh(n), in_specs=P(None, None, "sep", None),
         out_specs=P(None, None, "sep", None)))(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
@@ -50,7 +51,7 @@ def test_ring_attention_grads_match_dense(causal):
     def loss_ring(q, k, v):
         def f(q, k, v):
             return ring_attention(q, k, v, axis_name="sep", causal=causal)
-        o = jax.shard_map(f, mesh=_mesh(n),
+        o = _shard_map(f, mesh=_mesh(n),
                           in_specs=P(None, None, "sep", None),
                           out_specs=P(None, None, "sep", None))(q, k, v)
         return jnp.sum(o * jnp.sin(o))
@@ -76,7 +77,7 @@ def test_ulysses_matches_dense(causal):
     def f(q, k, v):
         return ulysses_attention(q, k, v, axis_name="sep", causal=causal)
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(_shard_map(
         f, mesh=_mesh(n), in_specs=P(None, None, "sep", None),
         out_specs=P(None, None, "sep", None)))(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
@@ -92,7 +93,7 @@ def test_split_gather_roundtrip():
         assert lo.shape == (2, 16, 8)
         return gather_sequence(lo, "sep", axis=1)
 
-    out = jax.shard_map(f, mesh=_mesh(n), in_specs=P(),
+    out = _shard_map(f, mesh=_mesh(n), in_specs=P(),
                         out_specs=P(), check_vma=False)(x)
     np.testing.assert_allclose(np.asarray(out), np.asarray(x))
 
@@ -107,7 +108,7 @@ def test_ring_attention_long_sequence_memory_shape():
         assert q.shape == (b, h, s // 8, d)
         return ring_attention(q, k, v, axis_name="sep", causal=True)
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(_shard_map(
         f, mesh=mesh, in_specs=P(None, None, "sep", None),
         out_specs=P(None, None, "sep", None)))(q, k, v)
     ref = mha_reference(q, k, v, causal=True)
@@ -131,7 +132,7 @@ def test_ring_attention_kernel_path_matches_xla(causal):
         # check_vma=False: the pallas HLO *interpreter* cannot propagate
         # sep-varying avals through its internal dynamic_slice (real-TPU
         # lowering does not take that path)
-        return jax.jit(jax.shard_map(
+        return jax.jit(_shard_map(
             f, mesh=_mesh(n), in_specs=P(None, None, "sep", None),
             out_specs=P(None, None, "sep", None), check_vma=False))(q, k, v)
 
@@ -156,7 +157,7 @@ def test_ring_attention_kernel_path_grads():
                                use_kernel=use_kernel, interpret=True)
             return o
         def l(q, k, v):
-            o = jax.shard_map(
+            o = _shard_map(
                 f, mesh=_mesh(n), in_specs=P(None, None, "sep", None),
                 out_specs=P(None, None, "sep", None),
                 check_vma=False)(q, k, v)
@@ -181,7 +182,7 @@ def test_ulysses_kernel_path_matches_xla(causal):
             return ulysses_attention(q, k, v, axis_name="sep",
                                      causal=causal, use_kernel=use_kernel,
                                      interpret=True)
-        return jax.jit(jax.shard_map(
+        return jax.jit(_shard_map(
             f, mesh=_mesh(n), in_specs=P(None, None, "sep", None),
             out_specs=P(None, None, "sep", None), check_vma=False))(q, k, v)
 

@@ -177,6 +177,23 @@ FIXTURES = {
                 logging.getLogger(__name__).warning("delete: %s", e)
         """,
     ),
+    "TPU009": (
+        "pkg/distributed/mod.py",
+        """
+        import time
+        def barrier(store, key, world):
+            store.add(key, 1)
+            while store.add(key, 0) < world:
+                time.sleep(0.01)
+        """,
+        """
+        from ..utils.retry import wait_until
+        def barrier(store, key, world, timeout):
+            store.add(key, 1)
+            wait_until(lambda: store.add(key, 0) >= world, timeout,
+                       desc="barrier")
+        """,
+    ),
 }
 
 
@@ -288,6 +305,28 @@ def test_tpu005_static_argnames_int_flagged():
     g = jax.jit(abs, static_argnames=(0,))
     """
     assert "TPU005" in rules_fired(src)
+
+
+def test_tpu009_scoped_to_distributed_and_core_paths():
+    src = """
+    import time
+    def poll(proc):
+        while proc.poll() is None:
+            time.sleep(0.2)
+    """
+    assert "TPU009" in rules_fired(src, path="pkg/distributed/launch.py")
+    assert "TPU009" in rules_fired(src, path="paddle_tpu/core/store.py")
+    # a data-loader pacing sleep outside coordination code is fine
+    assert "TPU009" not in rules_fired(src, path="pkg/vision/loader.py")
+
+
+def test_tpu009_sleep_outside_loop_is_silent():
+    src = """
+    import time
+    def settle():
+        time.sleep(0.1)
+    """
+    assert "TPU009" not in rules_fired(src, path="pkg/distributed/mod.py")
 
 
 def test_tpu008_bare_except_flagged_only_in_distributed_paths():
