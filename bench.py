@@ -207,6 +207,10 @@ def _time_compiled(compiled, args, n_state):
         dt = time.perf_counter() - t0
         fence = _fence_cost()
         times.append(max(dt - fence, 1e-9) / ITERS)
+    from paddle_tpu.observability import get_telemetry
+    tel = get_telemetry()
+    for t in times:  # block-averaged step times -> step histogram/p50/p95
+        tel.observe_step(t, mode="bench")
     return times, out
 
 
@@ -623,6 +627,8 @@ def _leg_main(name, batch, recompute):
     """Child entry: run one leg, print one JSON line, exit 0 always
     (errors travel in the JSON)."""
     _honor_cpu_override()
+    from paddle_tpu.observability import get_telemetry
+    tel = get_telemetry().enable()  # metrics + compile watch, no sink/server
     fields: dict = {}
     rec = {"ok": True, "fields": fields}
     try:
@@ -645,6 +651,9 @@ def _leg_main(name, batch, recompute):
         rec["ok"] = False
         rec["error"] = _error_tail(tb)
         rec["oom"] = _is_oom_str(tb)
+    # health snapshot rides along even when the leg died: compile count,
+    # step p50/p95, peak device memory at the moment of failure
+    fields[f"telemetry_{name}"] = tel.snapshot()
     print(json.dumps(rec), flush=True)
 
 
@@ -704,6 +713,13 @@ def main():
         "device_kind": None,
     }
 
+    # parent-side telemetry: cheap (the parent never touches the device —
+    # its snapshot proves that: 0 steps, 0 compiles, no device memory),
+    # but it carries pid/health onto every emitted record including the
+    # tpu_unreachable fast-fail, where the leg snapshots never happen
+    from paddle_tpu.observability import get_telemetry
+    tel = get_telemetry().enable()
+
     def remaining():
         return BUDGET_SEC - (time.time() - t_start)
 
@@ -714,6 +730,7 @@ def main():
             result["errors"] = dict(errors)
         else:
             result.pop("errors", None)
+        result["telemetry_driver"] = tel.snapshot()
         print(json.dumps(result), flush=True)
 
     def merge(rec, stage):

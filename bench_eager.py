@@ -84,6 +84,9 @@ def main():
             z = z * gy + gy
         return z
 
+    from paddle_tpu.observability import get_telemetry
+    tel = get_telemetry().enable()
+
     jitted = jax.jit(raw_jax)
     jitted()  # compile outside the timing
 
@@ -107,6 +110,7 @@ def main():
     res["tape_overhead_ratio"] = round(res["tape_on"] / res["raw_jax"], 2) \
         if res["raw_jax"] else None
     res["value"] = res["tape_on"]
+    res["telemetry"] = tel.snapshot()
     print(json.dumps(res))
 
 

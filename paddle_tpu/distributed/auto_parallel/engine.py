@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from ...tensor import Tensor
 from ...nn.layer.layers import Layer
 from ...jit.api import functional_call
+from ...observability import get_telemetry
 from ..fleet.base.distributed_strategy import DistributedStrategy
 from .. import mesh as _mesh_mod
 from ..train_step import build_train_step
@@ -160,6 +161,7 @@ class Engine:
             log_freq=log_freq, save_freq=save_freq, save_dir=save_dir,
             verbose=verbose, metrics=["loss"])
         history = {"loss": []}
+        tel = get_telemetry()
         cbks.on_begin("train")
         for epoch in range(epochs):
             cbks.on_epoch_begin(epoch)
@@ -169,7 +171,12 @@ class Engine:
                     break
                 cbks.on_batch_begin("train", step_i, logs)
                 x, labels = self._split_batch(batch)
+                tok = tel.step_start()
                 loss, self._state = self._step_fn(self._state, x, *labels)
+                # .shape is device-array metadata — no host transfer
+                tel.step_end(tok, mode="train",
+                             batch_size=(x.shape[0]
+                                         if getattr(x, "ndim", 0) else None))
                 logs["loss"] = loss  # lazy device scalar; float on read
                 cbks.on_batch_end("train", step_i, logs)
             if logs.get("loss") is not None:

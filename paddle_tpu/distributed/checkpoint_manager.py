@@ -30,11 +30,13 @@ import os
 import re
 import shutil
 import threading
+import time
 
 import jax
 
 from . import checkpoint as _ckpt
 from .checkpoint import CheckpointCorruptError
+from ..observability import get_telemetry
 
 __all__ = ["CheckpointManager", "latest_checkpoint"]
 
@@ -146,11 +148,21 @@ class CheckpointManager:
         world = (jax.process_count() if self.world_size is None
                  else self.world_size)
         path = self.step_dir(step)
+        tel = get_telemetry()
         if not self.async_save or block:
             self.wait()
-            _ckpt._save_records(_ckpt._shard_records(state, proc), path,
-                                proc, world, store=self.store,
-                                durable=self.durable)
+            t0 = time.perf_counter()
+            try:
+                _ckpt._save_records(_ckpt._shard_records(state, proc),
+                                    path, proc, world, store=self.store,
+                                    durable=self.durable)
+            except BaseException:
+                tel.record_checkpoint_save(time.perf_counter() - t0,
+                                           step=step, mode="sync",
+                                           ok=False)
+                raise
+            tel.record_checkpoint_save(time.perf_counter() - t0,
+                                       step=step, mode="sync")
             self._gc()
             return
         # device->host copy on the caller: the training loop may donate
@@ -159,11 +171,15 @@ class CheckpointManager:
         self.wait()  # one writer at a time; serializes step order
 
         def _write():
+            t0 = time.perf_counter()
             try:
                 _ckpt._save_records(records, path, proc, world,
                                     store=self.store, durable=self.durable)
+                tel.record_checkpoint_save(time.perf_counter() - t0,
+                                           step=step, mode="async")
                 self._gc()
             except BaseException as e:  # surfaced on the next call
+                tel.record_async_save_failure(step, e)
                 with self._lock:
                     self._err = e
 
@@ -191,16 +207,22 @@ class CheckpointManager:
         the fallback step afterwards.
         """
         self.wait()
+        tel = get_telemetry()
         for step in reversed(self.valid_steps()):
             d = self.step_dir(step)
+            t0 = time.perf_counter()
             try:
                 state = _ckpt.load_sharded(d, mesh=mesh,
                                            shardings=shardings,
                                            template=template,
                                            integrity=self.integrity)
+                tel.record_checkpoint_restore(time.perf_counter() - t0,
+                                              step=step)
                 return state, step
             except (CheckpointCorruptError, FileNotFoundError,
                     ValueError) as e:
+                tel.record_checkpoint_restore(time.perf_counter() - t0,
+                                              step=step, ok=False)
                 logger.warning(
                     "checkpoint step %d at %s failed verification (%s); "
                     "falling back to an earlier step", step, d, e)
@@ -225,15 +247,19 @@ class CheckpointManager:
             return
         newest = valid[-1]
         keep = set(valid[-self.keep_last_n:])
+        deleted = 0
         for step, d in sorted(self._step_dirs().items()):
             if step in keep or step >= newest:
                 continue
             shutil.rmtree(d, ignore_errors=True)
+            deleted += 1
         for n in os.listdir(self.root):
             m = _TMP_RE.match(n)
             if m and int(m.group(1)) <= newest:
                 shutil.rmtree(os.path.join(self.root, n),
                               ignore_errors=True)
+                deleted += 1
+        get_telemetry().record_checkpoint_gc(deleted)
 
     def close(self):
         self.wait()

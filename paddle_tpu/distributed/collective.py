@@ -207,6 +207,22 @@ def _data(x):
     return x._data if isinstance(x, Tensor) else jnp.asarray(x)
 
 
+def _observe(op, x):
+    """Per-op count + input-byte telemetry. Shape/dtype metadata only —
+    works on tracers and device arrays alike, never syncs. In SPMD
+    (traced) mode this runs once per trace, which is the honest count:
+    the op executes inside ONE compiled program thereafter."""
+    from ..observability import get_telemetry
+    tel = get_telemetry()
+    if not tel.enabled:
+        return
+    try:
+        nbytes = int(x.size) * x.dtype.itemsize
+    except Exception:
+        nbytes = 0
+    tel.collective_op(op, nbytes)
+
+
 def _ret(x, like):
     if isinstance(like, Tensor):
         like._data = x
@@ -248,6 +264,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     g = _group_of(group)
     red = _LAX_REDUCE[op]
     x = _data(tensor)
+    _observe("all_reduce", x)
     if _in_axis_scope(g.axis_name):
         return _ret(red(x, g.axis_name), tensor)
 
@@ -281,6 +298,7 @@ def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True,
     else:
         src = tensor_or_list
     x = _data(src)
+    _observe("all_gather", x)
 
     if _in_axis_scope(g.axis_name):
         gathered = lax.all_gather(x, g.axis_name, axis=axis, tiled=True)
@@ -315,6 +333,7 @@ def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
     restriction is a multi-controller artifact)."""
     g = _group_of(group)
     x = _data(tensor)
+    _observe("gather", x)
     if gather_list is None:
         gather_list = []
     if _in_axis_scope(g.axis_name):
@@ -343,6 +362,7 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     all_gather+index (compiled to a broadcast over ICI)."""
     g = _group_of(group)
     x = _data(tensor)
+    _observe("broadcast", x)
     if src not in g.ranks:
         raise ValueError(f"broadcast src={src} is not in group {g.ranks}")
     src_local = g.get_group_rank(src)
@@ -373,6 +393,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     g = _group_of(group)
     red = _LAX_REDUCE[op]
     x = _data(tensor)
+    _observe("reduce", x)
     if dst not in g.ranks:
         raise ValueError(f"reduce dst={dst} is not in group {g.ranks}")
     dst_local = g.get_group_rank(dst)
@@ -405,6 +426,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
         stacked = _data(tensor)
         if stacked.shape[0] != g.nranks:
             raise ValueError("scatter needs tensor_list or rank-major input")
+    _observe("scatter", stacked)
     if _in_axis_scope(g.axis_name):
         i = lax.axis_index(g.axis_name)
         return _ret(jnp.take(stacked, i, axis=0), tensor)
@@ -440,6 +462,7 @@ def alltoall(out_tensor_list, in_tensor_list=None, group=None, sync_op=True):
     else:
         x = jnp.stack([_data(t) for t in in_tensor_list])
         as_list = True
+    _observe("alltoall", x)
 
     if _in_axis_scope(g.axis_name):
         # x: [nranks, ...] per rank; swap rank axis with the group axis
@@ -480,6 +503,7 @@ def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
     ``communication/all_to_all.py alltoall_single``)."""
     g = _group_of(group)
     x = _data(in_tensor)
+    _observe("alltoall_single", x)
     if _in_axis_scope(g.axis_name):
         out = lax.all_to_all(x, g.axis_name, split_axis=0, concat_axis=0,
                              tiled=True)
@@ -514,6 +538,7 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
         x = jnp.concatenate([_data(t) for t in tensor_list], axis=0)
     else:
         x = _data(tensor)
+    _observe("reduce_scatter", x)
     if _in_axis_scope(g.axis_name):
         out = lax.psum_scatter(x, g.axis_name, scatter_dimension=0,
                                tiled=True)
@@ -554,7 +579,9 @@ def send(tensor, dst=0, group=None, sync_op=True):
         raise RuntimeError(
             "Inside shard_map use paddle_tpu.distributed.p2p helpers "
             "(ppermute) — a lone send has no SPMD meaning")
-    _MAILBOX.setdefault((g.id, g.rank, dst), []).append(_data(tensor))
+    x = _data(tensor)
+    _observe("send", x)
+    _MAILBOX.setdefault((g.id, g.rank, dst), []).append(x)
     return _Task()
 
 
@@ -563,6 +590,7 @@ def recv(tensor, src=0, group=None, sync_op=True):
     box = _MAILBOX.get((g.id, src, max(g.rank, 0)), None)
     if not box:
         raise RuntimeError(f"recv: no message pending from rank {src}")
+    _observe("recv", box[0])
     return _ret(box.pop(0), tensor)
 
 
@@ -593,6 +621,7 @@ def barrier(group=None):
     g = _group_of(group)
     ax = g.axis_name
     one = jnp.ones((g.nranks,), jnp.int32)
+    _observe("barrier", one)
 
     def f(x):
         return lax.psum(x, ax)

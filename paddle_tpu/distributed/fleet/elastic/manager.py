@@ -10,6 +10,7 @@ import subprocess
 import threading
 import time
 
+from ....observability import get_telemetry
 from ....utils.retry import retry_call, wait_until
 
 __all__ = ["ElasticManager", "ElasticStatus", "LauncherInterface"]
@@ -84,6 +85,7 @@ class ElasticManager:
     def _beat(self):
         self.store.set(_PREFIX + self.host,
                        json.dumps({"ts": time.time()}))
+        get_telemetry().heartbeat(ok=True, lease_ttl=self.ttl)
 
     def _heartbeat_loop(self):
         while not self._stop.is_set():
@@ -92,6 +94,7 @@ class ElasticManager:
             except Exception as e:
                 # a silent dead heartbeat gets this node evicted by its
                 # peers with nothing in the log to explain why
+                get_telemetry().heartbeat(ok=False, lease_ttl=self.ttl)
                 logger.warning("elastic heartbeat to store failed "
                                "(node %s): %s", self.host, e)
             self._stop.wait(self.interval)

@@ -18,6 +18,7 @@ from ..tensor import Tensor
 from ..nn.layer.layers import Layer
 from ..metric import Metric
 from ..framework import random as _random
+from ..observability import get_telemetry
 from .. import autograd
 from .callbacks import config_callbacks
 
@@ -221,6 +222,7 @@ class Model:
         for m in self._metrics:
             m.reset()
         logs = {}
+        tel = get_telemetry()
         for step, batch in enumerate(loader):
             batch = _to_list(batch)
             # convention: last element is the label set
@@ -228,10 +230,14 @@ class Model:
             if len(batch) == 1:
                 inputs, labels = batch, []
             cbks.on_batch_begin(mode, step, logs)
+            tok = tel.step_start()
             if mode == "train":
                 out = self.train_batch(inputs, labels)
             else:
                 out = self.eval_batch(inputs, labels)
+            tel.step_end(tok, mode=mode,
+                         batch_size=(np.shape(labels[0])[0]
+                                     if labels else None))
             if isinstance(out, tuple):
                 losses, metrics = out
             else:
@@ -260,10 +266,15 @@ class Model:
         for m in self._metrics:
             m.reset()
         total_loss, n = 0.0, 0
+        tel = get_telemetry()
         for batch in loader:
             batch = _to_list(batch)
             inputs, labels = batch[:-1], batch[-1:]
+            tok = tel.step_start()
             out = self.eval_batch(inputs, labels)
+            tel.step_end(tok, mode="eval",
+                         batch_size=(np.shape(labels[0])[0]
+                                     if labels else None))
             losses = out[0] if isinstance(out, tuple) else out
             if losses:
                 total_loss += losses[0]

@@ -232,6 +232,22 @@ def _get_checked(data_queue, workers, timeout):
                     f"batch")
 
 
+def _timed_iter(it, tel):
+    """Wrap a batch iterator, reporting how long the consumer waited on
+    each ``next()`` (input-pipeline stall time) to telemetry. Only
+    installed while telemetry is enabled — the disabled path hands the
+    raw iterator through."""
+    import time as _time
+    while True:
+        t0 = _time.perf_counter()
+        try:
+            batch = next(it)
+        except StopIteration:
+            return
+        tel.data_wait(_time.perf_counter() - t0)
+        yield batch
+
+
 class DataLoader:
     def __init__(self, dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
@@ -275,11 +291,16 @@ class DataLoader:
 
     def __iter__(self):
         if self._iterable_mode:
-            yield from self._iter_iterable()
+            it = self._iter_iterable()
         elif self.num_workers == 0:
-            yield from self._iter_single()
+            it = self._iter_single()
         else:
-            yield from self._iter_multiprocess()
+            it = self._iter_multiprocess()
+        from ..observability import get_telemetry
+        tel = get_telemetry()
+        if not tel.enabled:
+            return it
+        return _timed_iter(it, tel)
 
     # -- single process with thread prefetch --------------------------------
     def _iter_single(self):

@@ -1,0 +1,48 @@
+"""Runtime observability: metrics, step telemetry, events, health.
+
+The third leg of the reliability stack — tpu-lint catches host-sync
+hazards statically, the checkpoint layer makes runs crash-consistent,
+and this package answers *"is this run healthy, how fast is each step,
+and did something silently recompile?"* at runtime:
+
+ - :mod:`.metrics`    thread-safe label-aware Counter/Gauge/Histogram
+                      registry; Prometheus text + JSON snapshots
+ - :mod:`.telemetry`  ``TrainingTelemetry``: step wall time and
+                      throughput, device-memory gauges, per-callable
+                      compile counts and the recompile sentinel
+ - :mod:`.events`     per-process, size-rotated JSONL event stream
+ - :mod:`.server`     stdlib HTTP endpoint: ``/metrics`` + ``/healthz``
+ - :mod:`.logs`       the library logger that bare ``print`` is banned
+                      in favor of (lint rule TPU010)
+
+Everything is inert until asked: importing this package creates no
+threads, opens no files, and never initializes a jax backend; with
+telemetry disabled (the default) every instrumentation hook in the hot
+paths is a single attribute check.  Enable per process with::
+
+    from paddle_tpu.observability import configure
+    configure(enabled=True, jsonl_dir="/tmp/tele", http_port=9400)
+
+or via environment: ``PT_TELEMETRY=1`` (+ ``PT_TELEMETRY_DIR``,
+``PT_METRICS_PORT``, ``PT_RECOMPILE_THRESHOLD``, ``PT_LOG_LEVEL``).
+"""
+from __future__ import annotations
+
+from .logs import get_logger
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
+                      get_registry, reset_registry, log_buckets)
+from .events import EventSink
+from .telemetry import (TrainingTelemetry, StepTimer, CompileWatcher,
+                        RecompileSentinel, get_telemetry, configure,
+                        reset)
+from .server import MetricsServer, start_http_server
+
+__all__ = [
+    "get_logger",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "get_registry", "reset_registry", "log_buckets",
+    "EventSink",
+    "TrainingTelemetry", "StepTimer", "CompileWatcher",
+    "RecompileSentinel", "get_telemetry", "configure", "reset",
+    "MetricsServer", "start_http_server",
+]

@@ -1,0 +1,89 @@
+"""JSONL event sink: append-only structured telemetry stream.
+
+One file per process (``<prefix>-<pid>.jsonl``) so concurrent hosts or
+data workers never interleave half-lines; size-rotated by renaming the
+current file to ``.1`` (single generation — the aggregation story is
+"ship/merge per-process files", see ROADMAP multi-host drills).  Each
+record is one JSON object with an ISO-8601 UTC timestamp:
+
+    {"ts": "2026-08-05T12:00:00.123+00:00", "pid": 4242,
+     "event": "step", "step": 17, "duration_sec": 0.0123, ...}
+
+Lazy by construction: the directory and file are only created on the
+first ``emit`` — constructing a sink does no I/O, so telemetry setup
+stays import/enable-safe.  A failing write never raises into the
+training loop; it is counted in ``dropped`` and retried on the next
+emit.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from datetime import datetime, timezone
+
+__all__ = ["EventSink"]
+
+DEFAULT_MAX_BYTES = 32 << 20
+
+
+class EventSink:
+    def __init__(self, directory, prefix="telemetry",
+                 max_bytes=DEFAULT_MAX_BYTES):
+        self.directory = directory
+        self.prefix = prefix
+        self.max_bytes = int(max_bytes)
+        self.dropped = 0
+        self._lock = threading.Lock()
+        self._fh = None
+        self._size = 0
+
+    @property
+    def path(self):
+        return os.path.join(self.directory,
+                            f"{self.prefix}-{os.getpid()}.jsonl")
+
+    def _open(self):
+        os.makedirs(self.directory, exist_ok=True)
+        self._fh = open(self.path, "a", encoding="utf-8")
+        self._size = self._fh.tell()
+
+    def _rotate(self):
+        self._fh.close()
+        self._fh = None
+        os.replace(self.path, self.path + ".1")
+        self._open()
+
+    def emit(self, event, **fields):
+        """Append one record. Returns True if it reached the file."""
+        rec = {"ts": datetime.now(timezone.utc).isoformat(
+                   timespec="milliseconds"),
+               "pid": os.getpid(), "event": event}
+        rec.update(fields)
+        line = json.dumps(rec, default=str) + "\n"
+        with self._lock:
+            try:
+                if self._fh is None:
+                    self._open()
+                elif self._size + len(line) > self.max_bytes:
+                    self._rotate()
+                self._fh.write(line)
+                self._fh.flush()
+                self._size += len(line)
+                return True
+            except (OSError, ValueError):
+                # telemetry must never take down the run it watches
+                # (ValueError: write to a file closed under us, e.g.
+                # interpreter shutdown or a fork closing descriptors)
+                self.dropped += 1
+                self._fh = None
+                return False
+
+    def close(self):
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None

@@ -1,0 +1,60 @@
+"""Library logging entry point.
+
+Every ``paddle_tpu`` module that wants to talk to a human goes through
+``get_logger`` instead of ``print`` (enforced by lint rule TPU010):
+stdlib logging can be rate-limited, filtered per subsystem, and
+collected per process, none of which a bare ``print`` allows.
+
+Import-time contract (shared by the whole observability package): this
+module configures NOTHING — no handlers, no levels, no files.  The
+hosting application owns the logging tree; we only namespace under
+``paddle_tpu``.  ``PT_LOG_LEVEL`` is applied lazily on the first
+``get_logger`` call so a bare script still gets output when it asks
+for it, without us touching the root logger.
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+__all__ = ["get_logger", "ROOT_LOGGER_NAME"]
+
+ROOT_LOGGER_NAME = "paddle_tpu"
+
+_level_applied = False
+
+
+def _apply_env_level():
+    global _level_applied
+    if _level_applied:
+        return
+    _level_applied = True
+    level = os.environ.get("PT_LOG_LEVEL", "").strip().upper()
+    if not level:
+        return
+    root = logging.getLogger(ROOT_LOGGER_NAME)
+    try:
+        root.setLevel(level)
+    except ValueError:
+        return
+    # only attach our own handler when nothing upstream would show the
+    # records anyway — never stomp on an app-configured logging tree
+    if not root.handlers and not logging.getLogger().handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s %(name)s: %(message)s"))
+        root.addHandler(h)
+
+
+def get_logger(name: str | None = None) -> logging.Logger:
+    """A logger under the ``paddle_tpu`` namespace.
+
+    ``name`` may be a module's ``__name__`` (kept as-is when it already
+    lives under the namespace) or a short suffix.
+    """
+    _apply_env_level()
+    if not name:
+        return logging.getLogger(ROOT_LOGGER_NAME)
+    if name == ROOT_LOGGER_NAME or name.startswith(ROOT_LOGGER_NAME + "."):
+        return logging.getLogger(name)
+    return logging.getLogger(ROOT_LOGGER_NAME + "." + name)

@@ -1,0 +1,626 @@
+"""Step telemetry: wall time, throughput, device memory, compile events.
+
+``TrainingTelemetry`` is the process singleton every instrumented hot
+path talks to (``hapi.Model`` loops, ``auto_parallel.Engine.fit``,
+``CheckpointManager``, elastic heartbeats, collectives, ``DataLoader``).
+Design rules, in priority order:
+
+1. **Zero cost while disabled.**  Every hook starts with a plain
+   attribute check (``if not self.enabled: return``); no metric objects
+   exist, no file/socket/thread is ever created, and nothing touches
+   jax.  ``import paddle_tpu.observability`` is side-effect-free.
+2. **Never sync the device.**  Step timing is host wall-clock around
+   the (async-dispatch) step call; collective byte counts come from
+   array metadata; device memory uses ``Device.memory_stats()`` only
+   when a backend already exists.  The telemetry layer must not create
+   the host round-trips tpu-lint exists to catch.
+3. **Never take down the run.**  Sink write failures are counted and
+   dropped; the compile-log filter swallows its own exceptions.
+
+Compile visibility: jax logs every XLA compile ("Compiling <fn> with
+global shapes and types ...", ``jax/_src/interpreters/pxla.py``) when
+``jax_log_compiles`` is on.  :class:`CompileWatcher` flips that config
+and installs a ``logging.Filter`` on the emitting loggers, which sees
+each record's structured args (function name + abstract signature),
+feeds the metrics/sentinel, and suppresses the record so user stderr
+stays clean (unless the user had the config on already).  The
+:class:`RecompileSentinel` is the dynamic twin of lint rule TPU001's
+retrace-storm heuristics: N compiles of the SAME callable with N
+distinct signatures means shape/weak-type churn, and it names the
+offender at runtime.
+
+Enable explicitly (``configure(enabled=True, ...)``) or via env:
+``PT_TELEMETRY=1`` [+ ``PT_TELEMETRY_DIR``, ``PT_METRICS_PORT``],
+checked once, lazily, on the first ``get_telemetry()`` call.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+import time
+from collections import deque
+
+from .events import EventSink
+from .logs import get_logger
+from .metrics import get_registry
+
+__all__ = [
+    "TrainingTelemetry", "StepTimer", "CompileWatcher",
+    "RecompileSentinel", "get_telemetry", "configure", "reset",
+]
+
+logger = get_logger(__name__)
+
+_TRUTHY = {"1", "true", "yes", "on"}
+
+# loggers jax emits per-compile records on (jit/pjit path + dispatch)
+_JAX_COMPILE_LOGGERS = ("jax._src.interpreters.pxla", "jax._src.dispatch")
+
+
+def _env_flag(name):
+    return os.environ.get(name, "").strip().lower() in _TRUTHY
+
+
+class RecompileSentinel:
+    """Detects recompile storms and names the offending callable.
+
+    Trips when one callable has been compiled ``threshold`` times with
+    ``threshold`` distinct signatures — steady-state training compiles a
+    step function once (or once per real shape bucket); per-step fresh
+    signatures mean the input shapes / weak types churn every call.
+    """
+
+    def __init__(self, threshold=5, keep_recent=4):
+        self.threshold = max(2, int(threshold))
+        self._keep_recent = keep_recent
+        self._lock = threading.Lock()
+        self._state: dict = {}
+        self._tripped: dict = {}
+
+    def observe(self, name, signature=""):
+        """Record one compile; returns trip info the first time ``name``
+        crosses the threshold, else None."""
+        with self._lock:
+            st = self._state.get(name)
+            if st is None:
+                st = self._state[name] = {
+                    "count": 0, "sig_hashes": set(),
+                    "recent": deque(maxlen=self._keep_recent)}
+            st["count"] += 1
+            if len(st["sig_hashes"]) < 4096:
+                st["sig_hashes"].add(hash(signature))
+            if signature:
+                st["recent"].append(str(signature)[:400])
+            if (name not in self._tripped
+                    and st["count"] >= self.threshold
+                    and len(st["sig_hashes"]) >= self.threshold):
+                info = {"callable": name,
+                        "compiles": st["count"],
+                        "distinct_signatures": len(st["sig_hashes"]),
+                        "recent_signatures": list(st["recent"])}
+                self._tripped[name] = info
+                return info
+        return None
+
+    def compile_counts(self):
+        with self._lock:
+            return {n: st["count"] for n, st in self._state.items()}
+
+    def tripped(self):
+        """{callable_name: trip info} for every storm seen so far."""
+        with self._lock:
+            return dict(self._tripped)
+
+
+class _CompileLogFilter:
+    """``logging.Filter`` duck-type: parses jax's per-compile records,
+    optionally suppressing them (when WE turned the logging on)."""
+
+    def __init__(self, telemetry, swallow):
+        self._tel = telemetry
+        self._swallow = swallow
+
+    def filter(self, record):
+        try:
+            msg = record.msg if isinstance(record.msg, str) else ""
+            if msg.startswith("Compiling ") and record.args:
+                args = (record.args if isinstance(record.args, tuple)
+                        else (record.args,))
+                name = str(args[0])
+                sig = "; ".join(str(a)[:400] for a in args[1:])
+                self._tel._on_compile(name, sig)
+                return not self._swallow
+            if msg.startswith("Finished "):
+                # log_elapsed_time spans ("Finished tracing...", "Finished
+                # XLA compilation...") promoted to WARNING by the very
+                # config we flipped on; drop them unless the user had
+                # jax_log_compiles enabled themselves
+                return not self._swallow
+        except Exception:  # a broken filter must never break jax logging
+            return True
+        return True
+
+
+class CompileWatcher:
+    """Hooks jax's compile path via ``jax_log_compiles`` + log filters.
+
+    Install is lazy and idempotent: a no-op until jax has been imported
+    by someone else (telemetry never imports jax itself), retried from
+    the step hooks so late jax imports still get coverage.  Uninstall
+    restores the user's prior ``jax_log_compiles`` value.
+    """
+
+    def __init__(self, telemetry):
+        self._tel = telemetry
+        self._filters: list = []
+        self._prev_log_compiles = None
+        self.installed = False
+
+    def install(self):
+        if self.installed or "jax" not in sys.modules:
+            return self.installed
+        try:
+            jax = sys.modules["jax"]
+            prev = bool(jax.config.jax_log_compiles)
+            if not prev:
+                jax.config.update("jax_log_compiles", True)
+            self._prev_log_compiles = prev
+        except Exception as e:
+            logger.debug("compile watcher: cannot enable "
+                         "jax_log_compiles: %s", e)
+            return False
+        for name in _JAX_COMPILE_LOGGERS:
+            f = _CompileLogFilter(self._tel, swallow=not prev)
+            logging.getLogger(name).addFilter(f)
+            self._filters.append((name, f))
+        self.installed = True
+        return True
+
+    def uninstall(self):
+        if not self.installed:
+            return
+        for name, f in self._filters:
+            logging.getLogger(name).removeFilter(f)
+        self._filters = []
+        if self._prev_log_compiles is False:
+            try:
+                sys.modules["jax"].config.update("jax_log_compiles", False)
+            except Exception as e:
+                logger.debug("compile watcher: restore failed: %s", e)
+        self.installed = False
+
+
+class StepTimer:
+    """``with tel.step(batch_size=..., mode=...):`` convenience span."""
+
+    __slots__ = ("_tel", "_mode", "_batch_size", "_token")
+
+    def __init__(self, telemetry, mode="train", batch_size=None):
+        self._tel = telemetry
+        self._mode = mode
+        self._batch_size = batch_size
+        self._token = None
+
+    def __enter__(self):
+        self._token = self._tel.step_start()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            self._tel.step_end(self._token, batch_size=self._batch_size,
+                               mode=self._mode)
+        return False
+
+
+class TrainingTelemetry:
+    """Process-wide telemetry hub (see module docstring for contract)."""
+
+    def __init__(self):
+        self.enabled = False
+        self._lock = threading.RLock()
+        self.sentinel = RecompileSentinel(
+            threshold=int(os.environ.get("PT_RECOMPILE_THRESHOLD") or 5))
+        self._watcher = CompileWatcher(self)
+        self.sink: EventSink | None = None
+        self.server = None
+        self._metrics_made = False
+        self._start_ts = time.time()
+        self._steps = 0
+        self._step_times = deque(maxlen=512)
+        self._last_step_ts = None
+        self._last_ckpt_step = None
+        self._last_heartbeat_ts = None
+        self._lease_ttl = None
+        # refresh device-memory gauges every N steps (stats read is a
+        # host-side allocator query, cheap but not free)
+        self._mem_every = 32
+
+    # -- lifecycle ----------------------------------------------------------
+
+    @property
+    def registry(self):
+        return get_registry()
+
+    def enable(self, jsonl_dir=None, http_port=None, compile_watch=True):
+        """Turn telemetry on (idempotent; each facility added at most
+        once).  ``http_port=0`` binds an ephemeral port; ``None`` means
+        no endpoint.  Returns self."""
+        with self._lock:
+            if not self.enabled:
+                self.enabled = True
+                self._make_metrics()
+            if compile_watch:
+                self._watcher.install()
+            if jsonl_dir is not None and self.sink is None:
+                self.sink = EventSink(str(jsonl_dir))
+            if http_port is not None and self.server is None:
+                from .server import MetricsServer
+                self.server = MetricsServer(self.registry,
+                                            health_cb=self.healthz,
+                                            port=int(http_port))
+                self.server.start()
+        return self
+
+    def disable(self):
+        with self._lock:
+            self.enabled = False
+            self._watcher.uninstall()
+            if self.server is not None:
+                self.server.stop()
+                self.server = None
+            if self.sink is not None:
+                self.sink.close()
+                self.sink = None
+        return self
+
+    def _make_metrics(self):
+        if self._metrics_made:
+            return
+        self._metrics_made = True
+        r = self.registry
+        self._m_steps = r.counter(
+            "pt_steps_total", "training/eval steps completed", ("mode",))
+        self._m_step_time = r.histogram(
+            "pt_step_time_seconds", "per-step wall time", ("mode",))
+        self._m_throughput = r.gauge(
+            "pt_throughput_samples_per_second",
+            "samples/sec of the most recent step", ("mode",))
+        self._m_last_step_ts = r.gauge(
+            "pt_last_step_timestamp_seconds",
+            "unix time the last step finished")
+        self._m_compiles = r.counter(
+            "pt_compiles_total", "XLA compilations observed", ("fn",))
+        self._m_storms = r.counter(
+            "pt_recompile_storms_total",
+            "callables that tripped the recompile sentinel")
+        self._m_data_wait = r.histogram(
+            "pt_data_wait_seconds",
+            "time the training loop waited for the next batch")
+        self._m_batches = r.counter(
+            "pt_data_batches_total", "batches produced by DataLoader")
+        self._m_coll_ops = r.counter(
+            "pt_collective_ops_total", "collective op invocations",
+            ("op",))
+        self._m_coll_bytes = r.counter(
+            "pt_collective_bytes_total",
+            "input bytes entering collectives (metadata-derived)",
+            ("op",))
+        self._m_ckpt_ops = r.counter(
+            "pt_checkpoint_ops_total", "checkpoint operations",
+            ("op", "status"))
+        self._m_ckpt_save_s = r.histogram(
+            "pt_checkpoint_save_seconds", "checkpoint commit duration")
+        self._m_ckpt_restore_s = r.histogram(
+            "pt_checkpoint_restore_seconds",
+            "checkpoint restore duration")
+        self._m_ckpt_latest = r.gauge(
+            "pt_checkpoint_latest_step",
+            "newest committed checkpoint step")
+        self._m_ckpt_gc = r.counter(
+            "pt_checkpoint_gc_deleted_total",
+            "checkpoint directories removed by retention GC")
+        self._m_hb = r.counter(
+            "pt_elastic_heartbeats_total", "elastic store heartbeats",
+            ("status",))
+        self._m_hb_ts = r.gauge(
+            "pt_elastic_last_heartbeat_timestamp_seconds",
+            "unix time of the last successful heartbeat")
+        self._m_mem = r.gauge(
+            "pt_device_memory_bytes",
+            "allocator stats summed over local devices", ("stat",))
+
+    # -- step timing --------------------------------------------------------
+
+    def step(self, mode="train", batch_size=None):
+        return StepTimer(self, mode=mode, batch_size=batch_size)
+
+    def step_start(self):
+        """Opaque token for ``step_end`` (None while disabled — both
+        hooks are no-ops then)."""
+        if not self.enabled:
+            return None
+        return time.perf_counter()
+
+    def step_end(self, token, batch_size=None, mode="train"):
+        if token is None or not self.enabled:
+            return
+        dt = time.perf_counter() - token
+        self.observe_step(dt, mode=mode, batch_size=batch_size)
+
+    def observe_step(self, seconds, mode="train", batch_size=None):
+        """Record one completed step of ``seconds`` wall time."""
+        if not self.enabled:
+            return
+        now = time.time()
+        self._m_steps.inc(mode=mode)
+        self._m_step_time.observe(seconds, mode=mode)
+        self._m_last_step_ts.set(now)
+        throughput = None
+        if batch_size and seconds > 0:
+            throughput = batch_size / seconds
+            self._m_throughput.set(throughput, mode=mode)
+        with self._lock:
+            self._steps += 1
+            steps = self._steps
+            self._last_step_ts = now
+            self._step_times.append(float(seconds))
+        if not self._watcher.installed:
+            self._watcher.install()  # jax may have appeared since enable
+        if steps % self._mem_every == 0:
+            self._update_memory_gauges()
+        if self.sink is not None:
+            self.sink.emit("step", step=steps, mode=mode,
+                           duration_sec=round(float(seconds), 6),
+                           batch_size=batch_size,
+                           throughput=(round(throughput, 2)
+                                       if throughput else None))
+
+    # -- data / collectives -------------------------------------------------
+
+    def data_wait(self, seconds):
+        if not self.enabled:
+            return
+        self._m_data_wait.observe(seconds)
+        self._m_batches.inc()
+
+    def collective_op(self, op, nbytes=0):
+        if not self.enabled:
+            return
+        self._m_coll_ops.inc(op=op)
+        if nbytes:
+            self._m_coll_bytes.inc(nbytes, op=op)
+
+    # -- checkpoints ----------------------------------------------------------
+
+    def record_checkpoint_save(self, seconds, step=None, mode="sync",
+                               ok=True):
+        if not self.enabled:
+            return
+        self._m_ckpt_ops.inc(op="save",
+                             status="ok" if ok else f"{mode}_error")
+        self._m_ckpt_save_s.observe(seconds)
+        if ok and step is not None:
+            with self._lock:
+                self._last_ckpt_step = int(step)
+            self._m_ckpt_latest.set(int(step))
+        if self.sink is not None:
+            self.sink.emit("checkpoint_save", step=step, mode=mode,
+                           ok=ok, duration_sec=round(float(seconds), 6))
+
+    def record_checkpoint_restore(self, seconds, step=None, ok=True):
+        if not self.enabled:
+            return
+        self._m_ckpt_ops.inc(op="restore", status="ok" if ok else "error")
+        self._m_ckpt_restore_s.observe(seconds)
+        if ok and step is not None:
+            with self._lock:
+                self._last_ckpt_step = int(step)
+            self._m_ckpt_latest.set(int(step))
+        if self.sink is not None:
+            self.sink.emit("checkpoint_restore", step=step, ok=ok,
+                           duration_sec=round(float(seconds), 6))
+
+    def record_checkpoint_gc(self, deleted):
+        if not self.enabled or not deleted:
+            return
+        self._m_ckpt_gc.inc(deleted)
+
+    def record_async_save_failure(self, step, error):
+        """Async writer failed — the manager re-raises it on the next
+        call, but the metric/event makes the failure visible NOW."""
+        if not self.enabled:
+            return
+        self._m_ckpt_ops.inc(op="save", status="async_error")
+        if self.sink is not None:
+            self.sink.emit("checkpoint_async_save_failed", step=step,
+                           error=str(error)[:400])
+
+    # -- elastic heartbeats -------------------------------------------------
+
+    def heartbeat(self, ok=True, lease_ttl=None):
+        if not self.enabled:
+            return
+        self._m_hb.inc(status="ok" if ok else "error")
+        if lease_ttl is not None:
+            with self._lock:
+                self._lease_ttl = float(lease_ttl)
+        if ok:
+            now = time.time()
+            self._m_hb_ts.set(now)
+            with self._lock:
+                self._last_heartbeat_ts = now
+
+    # -- compiles (called from the log filter) ------------------------------
+
+    def _on_compile(self, name, signature=""):
+        if self.enabled:
+            self._m_compiles.inc(fn=name)
+        if self.sink is not None:
+            self.sink.emit("compile", fn=name,
+                           signature=signature[:400] or None)
+        trip = self.sentinel.observe(name, signature)
+        if trip is not None:
+            if self.enabled:
+                self._m_storms.inc()
+            logger.warning(
+                "recompile storm: %s compiled %d times with %d distinct "
+                "signatures — input shape/weak-type churn; pad to fixed "
+                "shapes or mark changing args static",
+                name, trip["compiles"], trip["distinct_signatures"])
+            if self.sink is not None:
+                self.sink.emit("recompile_storm", **trip)
+
+    # -- device memory ------------------------------------------------------
+
+    def device_memory(self):
+        """Summed allocator stats over local devices; {} when no jax
+        backend exists yet (never initializes one just to ask)."""
+        xb = sys.modules.get("jax._src.xla_bridge")
+        jax = sys.modules.get("jax")
+        if jax is None or xb is None or not getattr(xb, "_backends", None):
+            return {}
+        out = {}
+        try:
+            devices = jax.local_devices()
+        except Exception:
+            return {}
+        for d in devices:
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                stats = None
+            if not stats:
+                continue
+            for k in ("bytes_in_use", "peak_bytes_in_use",
+                      "bytes_limit"):
+                if k in stats:
+                    out[k] = out.get(k, 0) + int(stats[k])
+        return out
+
+    def _update_memory_gauges(self):
+        mem = self.device_memory()
+        if not mem:
+            return
+        for k, v in mem.items():
+            self._m_mem.set(v, stat=k)
+
+    # -- snapshots / health -------------------------------------------------
+
+    def step_percentiles_ms(self):
+        """Exact host-side p50/p95 over the last <=512 steps."""
+        with self._lock:
+            times = sorted(self._step_times)
+        if not times:
+            return {"p50": None, "p95": None}
+        def pick(q):
+            i = min(len(times) - 1, int(q * (len(times) - 1) + 0.5))
+            return round(times[i] * 1000, 3)
+        return {"p50": pick(0.50), "p95": pick(0.95)}
+
+    def snapshot(self):
+        """Compact JSON-ready health summary (attached to bench
+        records; the full registry dump is ``registry.snapshot()``)."""
+        compile_counts = self.sentinel.compile_counts()
+        top = sorted(compile_counts.items(), key=lambda kv: -kv[1])[:8]
+        pct = self.step_percentiles_ms()
+        with self._lock:
+            steps = self._steps
+            last_ckpt = self._last_ckpt_step
+        mem = self.device_memory()
+        return {
+            "enabled": self.enabled,
+            "pid": os.getpid(),
+            "steps": steps,
+            "step_ms_p50": pct["p50"],
+            "step_ms_p95": pct["p95"],
+            "compiles": sum(compile_counts.values()),
+            "compiles_by_fn": dict(top),
+            "recompile_storms": sorted(self.sentinel.tripped()),
+            "peak_device_memory_bytes": mem.get("peak_bytes_in_use"),
+            "device_memory_bytes": mem.get("bytes_in_use"),
+            "last_checkpoint_step": last_ckpt,
+            "events_dropped": self.sink.dropped if self.sink else 0,
+        }
+
+    def healthz(self):
+        """Liveness summary served on ``/healthz``.  ``ok`` is False
+        only on positive evidence of trouble (an expired heartbeat
+        lease) — a run that simply has no elastic layer is healthy."""
+        now = time.time()
+        with self._lock:
+            last_step_ts = self._last_step_ts
+            last_hb = self._last_heartbeat_ts
+            ttl = self._lease_ttl
+            steps = self._steps
+            last_ckpt = self._last_ckpt_step
+        elastic = None
+        lease_ok = None
+        if last_hb is not None:
+            age = now - last_hb
+            lease_ok = (age <= ttl) if ttl is not None else True
+            elastic = {"last_heartbeat_age_sec": round(age, 3),
+                       "lease_ttl_sec": ttl, "lease_ok": lease_ok}
+        return {
+            "ok": lease_ok is not False,
+            "pid": os.getpid(),
+            "uptime_sec": round(now - self._start_ts, 1),
+            "steps": steps,
+            "last_step_age_sec": (round(now - last_step_ts, 3)
+                                  if last_step_ts is not None else None),
+            "last_checkpoint_step": last_ckpt,
+            "elastic": elastic,
+            "recompile_storms": len(self.sentinel.tripped()),
+        }
+
+
+# -- process singleton ------------------------------------------------------
+
+_telemetry: TrainingTelemetry | None = None
+_telemetry_lock = threading.Lock()
+
+
+def get_telemetry() -> TrainingTelemetry:
+    """The process-global telemetry hub.  Created (disabled) on first
+    call; auto-enabled here iff ``PT_TELEMETRY`` is truthy — the env is
+    consulted lazily so plain imports stay side-effect-free."""
+    global _telemetry
+    if _telemetry is None:
+        with _telemetry_lock:
+            if _telemetry is None:
+                t = TrainingTelemetry()
+                if _env_flag("PT_TELEMETRY"):
+                    port = os.environ.get("PT_METRICS_PORT", "").strip()
+                    t.enable(
+                        jsonl_dir=(os.environ.get("PT_TELEMETRY_DIR")
+                                   or None),
+                        http_port=int(port) if port else None)
+                _telemetry = t
+    return _telemetry
+
+
+def configure(enabled=True, jsonl_dir=None, http_port=None,
+              compile_watch=True) -> TrainingTelemetry:
+    """Programmatic switch: ``configure(enabled=True, ...)`` turns the
+    global hub on (see :meth:`TrainingTelemetry.enable`);
+    ``enabled=False`` turns it off."""
+    t = get_telemetry()
+    if enabled:
+        t.enable(jsonl_dir=jsonl_dir, http_port=http_port,
+                 compile_watch=compile_watch)
+    else:
+        t.disable()
+    return t
+
+
+def reset():
+    """Tear down the global hub AND the global registry (test
+    isolation; not needed in production)."""
+    global _telemetry
+    with _telemetry_lock:
+        t, _telemetry = _telemetry, None
+    if t is not None:
+        t.disable()
+    from .metrics import reset_registry
+    reset_registry()

@@ -38,8 +38,10 @@ class LRScheduler:
             self.last_epoch = epoch
         self.last_lr = self.get_lr()
         if self.verbose:
-            print(f"Epoch {self.last_epoch}: set learning rate to "
-                  f"{self.last_lr}.")
+            from ..observability import get_logger
+            get_logger(__name__).info(
+                "Epoch %s: set learning rate to %s.",
+                self.last_epoch, self.last_lr)
 
     def state_dict(self):
         return {k: v for k, v in self.__dict__.items()
@@ -290,7 +292,10 @@ class ReduceOnPlateau(LRScheduler):
             if self.last_lr - new_lr > self.epsilon:
                 self.last_lr = new_lr
                 if self.verbose:
-                    print(f"Epoch {self.last_epoch}: reducing lr to {new_lr}")
+                    from ..observability import get_logger
+                    get_logger(__name__).info(
+                        "Epoch %s: reducing lr to %s",
+                        self.last_epoch, new_lr)
             self.cooldown_counter = self.cooldown
             self.num_bad = 0
 

@@ -234,11 +234,18 @@ class Linter(ast.NodeVisitor):
         self._pre.visit(self._tree)
         # path-derived context
         p = path.replace(os.sep, "/")
+        self.path_posix = p
         self.kernel_path = bool(re.search(
             r"(^|/)(ops|kernels|nn/functional)(/|$)", p))
         self.distributed_path = bool(re.search(
             r"(^|/)(distributed|fleet|collective)(/|\.py$|$)", p))
         self.core_path = bool(re.search(r"(^|/)core(/|\.py$|$)", p))
+        # library code proper: inside the paddle_tpu package but not its
+        # CLI/developer-tool surfaces (whose contract IS stdout)
+        self.library_path = bool(
+            re.search(r"(^|/)paddle_tpu(/|$)", p)
+            and not re.search(r"(^|/)(tests?|tools)(/|$)"
+                              r"|(^|/)(cli|__main__)\.py$", p))
 
     # -- context helpers used by rules --------------------------------
 

@@ -450,3 +450,28 @@ class RawSleepPollLoop(Rule):
                        "utils.retry.retry_call/wait_until (jittered "
                        "backoff, deadline) or suppress if a fixed "
                        "cadence is genuinely wanted")
+
+
+@register
+class BarePrintInLibrary(Rule):
+    id = "TPU010"
+    name = "bare-print-in-library"
+    rationale = ("print() in library code writes to stdout unconditionally"
+                 " — it can't be filtered, rate-limited, or collected per"
+                 " process, and it corrupts machine-read stdout (bench JSON"
+                 " lines, launch protocols); route messages through"
+                 " paddle_tpu.observability (get_logger / the event sink)."
+                 " CLI entry points, tools/ and tests are exempt, as is"
+                 " print(..., file=...) which targets a stream on purpose")
+
+    def on_call(self, node, ctx):
+        if not ctx.library_path:
+            return
+        if dotted(node.func) != "print":
+            return
+        if any(kw.arg == "file" for kw in node.keywords):
+            return  # explicit stream choice (stderr protocols etc.)
+        ctx.report(node, self.id,
+                   "bare print() in paddle_tpu library code; use "
+                   "observability.get_logger(__name__) (or emit a "
+                   "structured event), or pass an explicit file=")

@@ -1,0 +1,435 @@
+"""Observability subsystem tests: metrics registry, exposition format,
+event sink, HTTP endpoint, recompile sentinel, and the hapi/checkpoint
+integration path."""
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu.observability as obs
+from paddle_tpu.observability import (
+    Counter, EventSink, Gauge, Histogram, MetricsRegistry, MetricsServer,
+    RecompileSentinel, get_registry, get_telemetry, log_buckets,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _isolated(monkeypatch):
+    # env must never leak enablement into (or out of) a test
+    for var in ("PT_TELEMETRY", "PT_TELEMETRY_DIR", "PT_METRICS_PORT",
+                "PT_RECOMPILE_THRESHOLD"):
+        monkeypatch.delenv(var, raising=False)
+    obs.reset()
+    yield
+    obs.reset()
+
+
+# -- import hygiene ----------------------------------------------------------
+
+def test_import_is_side_effect_free(tmp_path):
+    """Tier-1 guard: importing the package must not start threads, touch
+    the filesystem, or initialize a jax backend."""
+    script = (
+        "import threading, sys, os\n"
+        "import paddle_tpu.observability\n"
+        "assert threading.active_count() == 1, threading.enumerate()\n"
+        "xb = sys.modules.get('jax._src.xla_bridge')\n"
+        "assert xb is None or not xb._backends, 'jax backend initialized'\n"
+        "assert os.listdir('.') == [], os.listdir('.')\n"
+        "print('CLEAN')\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("PT_TELEMETRY", None)
+    out = subprocess.run([sys.executable, "-c", script], cwd=str(tmp_path),
+                         env=env, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "CLEAN" in out.stdout
+
+
+# -- metrics registry --------------------------------------------------------
+
+def test_counter_labels_and_conflicts():
+    reg = MetricsRegistry()
+    c = reg.counter("req_total", "requests", labelnames=("code",))
+    c.inc(code="200")
+    c.inc(2, code="500")
+    assert c.value(code="200") == 1
+    assert c.value(code="500") == 2
+    # idempotent getter returns the same child-bearing metric
+    assert reg.counter("req_total", labelnames=("code",)) is c
+    with pytest.raises(ValueError):
+        c.inc(-1, code="200")
+    with pytest.raises(ValueError):
+        reg.gauge("req_total")            # kind conflict
+    with pytest.raises(ValueError):
+        reg.counter("req_total", labelnames=("method",))  # label conflict
+
+
+def test_gauge_set_inc_dec():
+    reg = MetricsRegistry()
+    g = reg.gauge("inflight", "in flight")
+    g.set(5)
+    g.inc()
+    g.dec(2)
+    assert g.value() == 4
+
+
+def test_histogram_percentile_and_buckets():
+    bks = log_buckets(1e-3, 10.0, 3)
+    assert bks == sorted(bks) and bks[0] <= 1e-3 and bks[-1] >= 10.0
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", "latency", buckets=[0.1, 1.0, 10.0])
+    for v in [0.05] * 50 + [0.5] * 40 + [5.0] * 10:
+        h.observe(v)
+    p50 = h.percentile(0.50)
+    p95 = h.percentile(0.95)
+    assert p50 <= 0.1          # half the mass sits in the first bucket
+    assert 1.0 < p95 <= 10.0   # rank 95 lands past the 90 below le=1.0
+    assert h.percentile(0.999) <= 10.0
+
+
+def test_registry_concurrent_increments():
+    reg = MetricsRegistry()
+    c = reg.counter("hits", "hits", labelnames=("t",))
+
+    def work(tid):
+        for _ in range(2000):
+            c.inc(t=str(tid % 2))
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value(t="0") + c.value(t="1") == 8000
+
+
+SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? (-?[0-9.e+-]+|\+Inf|NaN)$")
+
+
+def _validate_prometheus(text):
+    """Minimal exposition-format 0.0.4 checker."""
+    typed = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            assert kind in ("counter", "gauge", "histogram"), line
+            typed[name] = kind
+        elif line.startswith("# HELP "):
+            assert len(line.split(" ", 3)) == 4, line
+        else:
+            assert SAMPLE_RE.match(line), f"bad sample line: {line!r}"
+    return typed
+
+
+def test_prometheus_text_is_valid_exposition():
+    reg = MetricsRegistry()
+    reg.counter("a_total", "with \\ and \n escapes", ("x",)).inc(x='q"v')
+    reg.gauge("b", "gauge").set(3.5)
+    h = reg.histogram("c_seconds", "hist", buckets=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(50)
+    text = reg.prometheus_text()
+    typed = _validate_prometheus(text)
+    assert typed == {"a_total": "counter", "b": "gauge",
+                     "c_seconds": "histogram"}
+    # histogram contract: cumulative buckets, +Inf bucket == _count
+    counts = [int(float(m.group(1))) for m in re.finditer(
+        r'c_seconds_bucket\{le="[^"]+"\} ([0-9.]+)', text)]
+    assert counts == sorted(counts), "buckets must be cumulative"
+    inf = re.search(r'c_seconds_bucket\{le="\+Inf"\} ([0-9.]+)', text)
+    cnt = re.search(r"c_seconds_count ([0-9.]+)", text)
+    assert inf and cnt and float(inf.group(1)) == float(cnt.group(1)) == 3
+
+
+def test_snapshot_json_round_trips():
+    reg = MetricsRegistry()
+    reg.counter("n_total", "n", ("k",)).inc(5, k="a")
+    snap = json.loads(reg.snapshot_json())
+    assert snap["n_total"]["kind"] == "counter"
+    assert snap["n_total"]["series"]['k=a'] == 5
+
+
+# -- event sink --------------------------------------------------------------
+
+def test_event_sink_writes_and_rotates(tmp_path):
+    sink = EventSink(str(tmp_path), max_bytes=256)
+    for i in range(30):
+        sink.emit("step", idx=i, pad="x" * 32)
+    sink.close()
+    main, rotated = sink.path, sink.path + ".1"
+    assert os.path.exists(main) and os.path.exists(rotated)
+    for line in open(main):
+        rec = json.loads(line)
+        assert rec["event"] == "step" and "ts" in rec and "pid" in rec
+        # ISO-8601 UTC timestamp
+        assert re.match(r"\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}\.\d+", rec["ts"])
+    assert sink.dropped == 0
+
+
+def test_event_sink_never_raises_on_io_error(tmp_path):
+    sink = EventSink(str(tmp_path))
+    sink.emit("warm")            # opens the file
+    sink._fh.close()             # force the next write to fail
+    sink.emit("after-close")     # must not raise
+    assert sink.dropped >= 1
+
+
+# -- HTTP endpoint -----------------------------------------------------------
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+            return r.status, r.headers.get("Content-Type"), r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers.get("Content-Type"), e.read().decode()
+
+
+def test_metrics_server_serves_and_stops():
+    reg = MetricsRegistry()
+    reg.counter("pings_total", "pings").inc(7)
+    health = {"ok": True, "steps": 1}
+    srv = MetricsServer(reg, health_cb=lambda: health, port=0)
+    srv.start()
+    try:
+        code, ctype, body = _get(srv.port, "/metrics")
+        assert code == 200 and ctype.startswith("text/plain")
+        assert "version=0.0.4" in ctype
+        assert "pings_total 7" in body
+        _validate_prometheus(body)
+
+        code, ctype, body = _get(srv.port, "/healthz")
+        assert code == 200 and json.loads(body)["steps"] == 1
+
+        health["ok"] = False
+        code, _, _ = _get(srv.port, "/healthz")
+        assert code == 503
+
+        code, _, _ = _get(srv.port, "/nope")
+        assert code == 404
+    finally:
+        srv.stop()
+    with pytest.raises(Exception):
+        _get(srv.port, "/metrics")
+
+
+# -- recompile sentinel ------------------------------------------------------
+
+def test_sentinel_requires_distinct_signatures():
+    s = RecompileSentinel(threshold=3)
+    for _ in range(10):                      # same signature: cache thrash
+        assert s.observe("f", "(f32[2])") is None   # is not churn
+    assert not s.tripped()
+    trip = None
+    for i in range(4):
+        trip = s.observe("g", f"(f32[{i}])") or trip
+    assert trip and trip["callable"] == "g"
+    assert trip["compiles"] >= 3 and trip["distinct_signatures"] >= 3
+    assert set(s.tripped()) == {"g"}
+    # reported once, not every compile after the trip
+    assert s.observe("g", "(f32[99])") is None
+
+
+def test_sentinel_trips_on_real_shape_churn():
+    """Acceptance: a jitted loop fed changing shapes trips the sentinel
+    and names the offending callable; a stable-shape loop does not."""
+    import jax
+    import jax.numpy as jnp
+
+    tel = get_telemetry().enable(compile_watch=True)
+
+    @jax.jit
+    def stable_fn(a):
+        return (a * 2.0).sum()
+
+    for _ in range(8):
+        stable_fn(jnp.ones((4,), jnp.float32)).block_until_ready()
+    assert "stable_fn" not in tel.sentinel.tripped()
+    assert tel.sentinel.compile_counts().get("stable_fn", 0) <= 1
+
+    @jax.jit
+    def churn_fn(a):
+        return (a * 2.0).sum()
+
+    for n in range(2, 9):                    # 7 distinct shapes
+        churn_fn(jnp.ones((n,), jnp.float32)).block_until_ready()
+    assert "churn_fn" in tel.sentinel.tripped()
+    counts = tel.sentinel.compile_counts()
+    assert counts["churn_fn"] >= 5
+    snap = tel.snapshot()
+    assert "churn_fn" in snap["recompile_storms"]
+    assert snap["compiles"] >= counts["churn_fn"]
+
+
+def test_compile_watcher_restores_jax_config():
+    import jax
+    prev = jax.config.jax_log_compiles
+    tel = get_telemetry().enable(compile_watch=True)
+    assert jax.config.jax_log_compiles is True
+    tel.disable()
+    assert jax.config.jax_log_compiles == prev
+
+
+# -- telemetry hub -----------------------------------------------------------
+
+def test_disabled_hub_is_inert(tmp_path):
+    tel = get_telemetry()
+    assert not tel.enabled
+    assert tel.step_start() is None
+    tel.step_end(None)
+    tel.data_wait(0.1)
+    tel.collective_op("all_reduce", 1024)
+    tel.record_checkpoint_save(0.1, step=1)
+    tel.heartbeat()
+    assert tel.snapshot()["enabled"] is False
+    assert tel.snapshot()["steps"] == 0
+    assert get_registry().snapshot() == {}
+    assert os.listdir(str(tmp_path)) == []
+
+
+def test_step_timing_and_percentiles():
+    tel = get_telemetry().enable(compile_watch=False)
+    for ms in (1, 2, 3, 4, 100):
+        tel.observe_step(ms / 1e3, mode="train", batch_size=32)
+    snap = tel.snapshot()
+    assert snap["steps"] == 5
+    assert 1 <= snap["step_ms_p50"] <= 4
+    assert snap["step_ms_p95"] >= 4
+    text = tel.registry.prometheus_text()
+    assert 'pt_steps_total{mode="train"} 5' in text
+    assert "pt_step_time_seconds_bucket" in text
+
+
+def test_healthz_lease_expiry():
+    tel = get_telemetry().enable(compile_watch=False)
+    tel.heartbeat(ok=True, lease_ttl=30.0)
+    hz = tel.healthz()
+    assert hz["ok"] is True and hz["elastic"]["lease_ok"] is True
+
+    tel.heartbeat(ok=True, lease_ttl=0.01)
+    time.sleep(0.05)
+    hz = tel.healthz()
+    assert hz["ok"] is False
+    assert hz["elastic"]["lease_ok"] is False
+    assert hz["elastic"]["last_heartbeat_age_sec"] > 0.01
+
+
+def test_healthz_without_elastic_is_healthy():
+    tel = get_telemetry().enable(compile_watch=False)
+    hz = tel.healthz()
+    assert hz["ok"] is True and hz["elastic"] is None
+
+
+def test_env_auto_enable(monkeypatch, tmp_path):
+    monkeypatch.setenv("PT_TELEMETRY", "1")
+    monkeypatch.setenv("PT_TELEMETRY_DIR", str(tmp_path))
+    tel = get_telemetry()   # first call after reset: consults the env
+    assert tel.enabled and tel.sink is not None
+    assert tel.sink.path.startswith(str(tmp_path))
+
+
+def test_checkpoint_counters():
+    tel = get_telemetry().enable(compile_watch=False)
+    tel.record_checkpoint_save(0.5, step=10, mode="sync", ok=True)
+    tel.record_checkpoint_save(0.1, step=11, mode="async", ok=False)
+    tel.record_checkpoint_restore(0.2, step=10, ok=True)
+    tel.record_checkpoint_gc(3)
+    text = tel.registry.prometheus_text()
+    assert 'pt_checkpoint_ops_total{op="save",status="ok"} 1' in text
+    assert 'pt_checkpoint_ops_total{op="save",status="async_error"} 1' in text
+    assert 'pt_checkpoint_ops_total{op="restore",status="ok"} 1' in text
+    assert "pt_checkpoint_gc_deleted_total 3" in text
+    assert tel.healthz()["last_checkpoint_step"] == 10
+
+
+def test_lint_clean_over_observability_package():
+    """Tier-1 guard: the new package holds itself to the linter it ships
+    next to — zero violations, no baseline allowance."""
+    from paddle_tpu.tools.lint import run_paths
+    pkg = os.path.join(REPO, "paddle_tpu", "observability")
+    violations, errors = run_paths([pkg])
+    assert not errors, errors
+    assert violations == [], [f"{v.path}:{v.line} {v.rule}"
+                              for v in violations]
+
+
+# -- integration -------------------------------------------------------------
+
+def test_fit_and_checkpoint_end_to_end(tmp_path):
+    """Short hapi fit with telemetry on: JSONL stream, /metrics scrape
+    with step-time histogram + compile counter + checkpoint-save
+    duration, /healthz carrying the last checkpoint step."""
+    import paddle_tpu as pt
+    from paddle_tpu.distributed.checkpoint_manager import CheckpointManager
+    from paddle_tpu.vision.datasets import FakeData
+
+    tel = get_telemetry().enable(jsonl_dir=str(tmp_path / "ev"), http_port=0,
+                                 compile_watch=True)
+
+    net = pt.nn.Sequential(pt.nn.Flatten(), pt.nn.Linear(3 * 8 * 8, 4))
+    model = pt.Model(net)
+    model.prepare(optimizer=pt.optimizer.SGD(
+                      learning_rate=0.01, parameters=net.parameters()),
+                  loss=pt.nn.CrossEntropyLoss())
+    data = FakeData(size=64, image_shape=(3, 8, 8), num_classes=4)
+    model.fit(data, epochs=1, batch_size=16, verbose=0)
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), durable=False)
+    mgr.save(3, {"w": np.ones((4, 4), np.float32)})
+
+    code, _, text = _get(tel.server.port, "/metrics")
+    assert code == 200
+    _validate_prometheus(text)
+    assert "pt_step_time_seconds_bucket" in text
+    assert "pt_compiles_total" in text
+    assert "pt_checkpoint_save_seconds_count 1" in text
+    assert "pt_data_wait_seconds" in text
+
+    code, _, body = _get(tel.server.port, "/healthz")
+    hz = json.loads(body)
+    assert code == 200 and hz["ok"] is True
+    assert hz["steps"] >= 4
+    assert hz["last_checkpoint_step"] == 3
+
+    events = [json.loads(l) for l in open(tel.sink.path)]
+    kinds = {e["event"] for e in events}
+    assert "step" in kinds and "checkpoint_save" in kinds
+    steps = [e for e in events if e["event"] == "step"]
+    assert all(e["duration_sec"] > 0 for e in steps)
+
+    snap = tel.snapshot()
+    assert snap["steps"] >= 4 and snap["compiles"] >= 1
+
+
+def test_fit_with_telemetry_disabled_emits_nothing(tmp_path):
+    import paddle_tpu as pt
+    from paddle_tpu.vision.datasets import FakeData
+
+    net = pt.nn.Sequential(pt.nn.Flatten(), pt.nn.Linear(3 * 8 * 8, 4))
+    model = pt.Model(net)
+    model.prepare(optimizer=pt.optimizer.SGD(
+                      learning_rate=0.01, parameters=net.parameters()),
+                  loss=pt.nn.CrossEntropyLoss())
+    model.fit(FakeData(size=32, image_shape=(3, 8, 8), num_classes=4),
+              epochs=1, batch_size=16, verbose=0)
+
+    assert get_telemetry().snapshot()["steps"] == 0
+    assert get_registry().snapshot() == {}
+    assert os.listdir(str(tmp_path)) == []

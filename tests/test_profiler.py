@@ -27,6 +27,79 @@ class TestScheduler:
         assert states[5] == ProfilerState.CLOSED          # repeat exhausted
         assert states[6] == ProfilerState.CLOSED
 
+    def test_repeat_zero_cycles_forever(self):
+        sched = make_scheduler(closed=1, ready=1, record=2, repeat=0)
+        period = [ProfilerState.CLOSED, ProfilerState.READY,
+                  ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN]
+        assert [sched(i) for i in range(12)] == period * 3
+
+    def test_no_warmup_record_only(self):
+        # closed=0, ready=0: recording from step 0, last step of each
+        # window returns the trace
+        sched = make_scheduler(closed=0, ready=0, record=3, repeat=1)
+        assert [sched(i) for i in range(4)] == [
+            ProfilerState.RECORD, ProfilerState.RECORD,
+            ProfilerState.RECORD_AND_RETURN, ProfilerState.CLOSED]
+
+    def test_record_window_of_one_always_returns(self):
+        # a one-step record window never yields plain RECORD
+        sched = make_scheduler(closed=1, ready=0, record=1, repeat=0)
+        states = [sched(i) for i in range(8)]
+        assert ProfilerState.RECORD not in states
+        assert states[1] == ProfilerState.RECORD_AND_RETURN
+
+    def test_skip_first_is_a_pure_offset(self):
+        base = make_scheduler(closed=1, ready=1, record=2, repeat=2)
+        offs = make_scheduler(closed=1, ready=1, record=2, repeat=2,
+                              skip_first=3)
+        for step in range(12):
+            assert offs(step + 3) == base(step)
+        assert all(offs(i) == ProfilerState.CLOSED for i in range(3))
+
+
+class TestRecordEventNesting:
+    def setup_method(self):
+        import paddle_tpu.core as core
+        core.tracer_disable()
+        core.tracer_clear()
+
+    def teardown_method(self):
+        import paddle_tpu.core as core
+        core.tracer_disable()
+
+    def test_nested_spans_contained_in_parent(self):
+        import paddle_tpu.core as core
+        core.tracer_enable()
+        with RecordEvent("outer"):
+            with RecordEvent("inner"):
+                sum(range(1000))
+        spans = {n: (s, s + d) for (n, s, d, _tid) in core.tracer_events()
+                 if n in ("outer", "inner")}
+        assert set(spans) == {"outer", "inner"}
+        (os_, oe), (is_, ie) = spans["outer"], spans["inner"]
+        assert os_ <= is_ and ie <= oe, "inner span escapes outer span"
+        assert oe - os_ >= ie - is_ >= 0
+
+    def test_disabled_tracer_records_nothing(self):
+        import paddle_tpu.core as core
+        with RecordEvent("ghost"):
+            pass
+        assert "ghost" not in [e[0] for e in core.tracer_events()]
+
+    def test_on_trace_ready_fires_once_per_repeat(self):
+        fired = []
+        p = Profiler(targets=[ProfilerTarget.CPU],
+                     scheduler=make_scheduler(closed=0, ready=0, record=2,
+                                              repeat=2),
+                     on_trace_ready=lambda prof: fired.append(prof.step_num))
+        p.start()
+        for _ in range(4):
+            with RecordEvent("w"):
+                pass
+            p.step()
+        p.stop()
+        assert len(fired) == 2
+
 
 class TestProfiler:
     def setup_method(self):

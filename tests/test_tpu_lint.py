@@ -194,6 +194,20 @@ FIXTURES = {
                        desc="barrier")
         """,
     ),
+    "TPU010": (
+        "paddle_tpu/hapi/mod.py",
+        """
+        def fit_hook(epoch, loss):
+            print(f"epoch {epoch}: loss={loss}")
+        """,
+        """
+        import sys
+        from ..observability import get_logger
+        def fit_hook(epoch, loss):
+            get_logger(__name__).info("epoch %s: loss=%s", epoch, loss)
+            print("progress", file=sys.stderr)
+        """,
+    ),
 }
 
 
@@ -327,6 +341,30 @@ def test_tpu009_sleep_outside_loop_is_silent():
         time.sleep(0.1)
     """
     assert "TPU009" not in rules_fired(src, path="pkg/distributed/mod.py")
+
+
+def test_tpu010_scoped_to_library_code_only():
+    src = """
+    def report(msg):
+        print(msg)
+    """
+    assert "TPU010" in rules_fired(src, path="paddle_tpu/optimizer/lr.py")
+    # CLI entry points, tools and tests own their stdout
+    assert "TPU010" not in rules_fired(src, path="paddle_tpu/tools/lint/cli.py")
+    assert "TPU010" not in rules_fired(src, path="paddle_tpu/tests/test_x.py")
+    assert "TPU010" not in rules_fired(src, path="tests/test_x.py")
+    assert "TPU010" not in rules_fired(src, path="bench.py")
+    assert "TPU010" not in rules_fired(src, path="paddle_tpu/cli.py")
+
+
+def test_tpu010_explicit_file_kwarg_is_silent():
+    src = """
+    import sys
+    def report(msg, stream):
+        print(msg, file=stream)
+        print("fatal", file=sys.stderr)
+    """
+    assert "TPU010" not in rules_fired(src, path="paddle_tpu/hapi/model.py")
 
 
 def test_tpu008_bare_except_flagged_only_in_distributed_paths():
