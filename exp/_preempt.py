@@ -1,0 +1,104 @@
+"""Preemption-safe `exp/` runs, on by default.
+
+Every long-running exp/ entry point wraps its train state in an
+:class:`ExpRunGuard`:
+
+    guard = ExpRunGuard("mfu_ablate_" + NAME)
+    state, start = guard.restore({"params": params, "opt": opt_state})
+    for i in range(start, ITERS):
+        ... run one step ...
+        guard.update(i + 1, state)   # in-memory handoff, no disk I/O
+    guard.finish()                   # completed: drop the resume dir
+
+Semantics:
+
+ - A SIGTERM (cloud preemption notice) triggers ONE synchronous
+   CheckpointManager save of the newest state handed to ``update``,
+   then exit 143; a failed save exits 75 (EX_TEMPFAIL) so the operator
+   can tell the difference (see fleet.elastic.preemption).  The
+   relaunched run's ``restore`` resumes from the newest committed step.
+ - ``update`` itself only swaps in-memory references (a benchmark's
+   step timing must not absorb checkpoint I/O); pass ``every=N`` to
+   also commit periodically — that's the SIGKILL story, where no
+   handler gets to run.  Note the donation caveat: if SIGTERM lands
+   while a donating compiled step is executing, the held references
+   point at donated buffers and the save fails — that's the 75 path,
+   and the relaunch falls back to the last committed step.
+ - Opt out with ``EXP_CKPT=0`` (every method no-ops); redirect the
+   checkpoint root with ``EXP_CKPT_DIR`` (default
+   ``exp/ckpt/<name>``).  Crash debris from earlier preempted runs is
+   janitored by the manager's startup sweep.
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+from paddle_tpu.distributed.checkpoint_manager import CheckpointManager
+from paddle_tpu.distributed.fleet.elastic.preemption import (
+    clear_preemption_handler, on_preemption)
+
+__all__ = ["ExpRunGuard"]
+
+logger = logging.getLogger(__name__)
+
+
+class ExpRunGuard:
+    def __init__(self, name, root=None, enabled=None, every=None,
+                 keep_last_n=2):
+        if enabled is None:
+            enabled = os.environ.get("EXP_CKPT", "1") != "0"
+        self.enabled = enabled
+        self.every = every
+        self._step = 0
+        self._state = None
+        self._mgr = None
+        if not enabled:
+            return
+        if root is None:
+            base = os.environ.get(
+                "EXP_CKPT_DIR",
+                os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "ckpt"))
+            root = os.path.join(base, name)
+        self.root = root
+        self._mgr = CheckpointManager(root, keep_last_n=keep_last_n,
+                                      durable=True)
+        on_preemption(self._save_now)
+
+    def _save_now(self):
+        if self._mgr is None or self._state is None:
+            return
+        logger.warning("preemption: committing step %d to %s",
+                       self._step, self.root)
+        self._mgr.save(self._step, self._state, block=True)
+
+    def restore(self, template):
+        """Resume point: ``(state, start_step)`` — ``(template, 0)`` on
+        a fresh run or when disabled."""
+        if self._mgr is None:
+            return template, 0
+        state, step = self._mgr.restore_latest(template=template)
+        if step is not None:
+            logger.warning("resuming %s from preempted step %d",
+                           self.root, step)
+        return state, step or 0
+
+    def update(self, step, state):
+        """Hand the guard the newest state (cheap: reference swap)."""
+        self._step, self._state = int(step), state
+        if self._mgr is not None and self.every \
+                and step % self.every == 0:
+            self._mgr.save(step, state, block=True)
+
+    def finish(self):
+        """The run completed: uninstall the handler and remove the
+        resume directory — a finished experiment must not be 'resumed'
+        past its end by the next launch."""
+        if self._mgr is None:
+            return
+        clear_preemption_handler()
+        self._mgr.close()
+        import shutil
+        shutil.rmtree(self.root, ignore_errors=True)
+        self._state = None

@@ -98,6 +98,19 @@ else:  # step / loss_sum: full train step
     n_state = 2
 
 step = jax.jit(step_fn, donate_argnums=donate)
+
+# preemption-safe by default: SIGTERM commits {params, opt} and exits
+# 143; a relaunch resumes from the newest committed iteration.  Only
+# stateful modes carry anything worth resuming. Opt out: EXP_CKPT=0.
+from _preempt import ExpRunGuard  # noqa: E402
+
+guard = None
+done = 0
+if n_state:
+    guard = ExpRunGuard(f"mfu_ablate_{NAME}")
+    restored, done = guard.restore({"params": params, "opt": opt_state})
+    params, opt_state = restored["params"], restored["opt"]
+
 rng = np.random.RandomState(0)
 ids = jnp.asarray(rng.randint(0, mcfg.vocab_size, (BATCH, SEQ))
                   .astype(np.int32))
@@ -125,24 +138,34 @@ except Exception:
 state = [params, opt_state][:n_state]
 rest = [params, opt_state][n_state:] + [ids, labels]
 out = None
-for _ in range(WARMUP):
+for _ in range(max(0, WARMUP - done)):
     out = compiled(*state, *rest)
     if n_state:
         state = list(out[1:1 + n_state])
-jax.block_until_ready(out)
+        done += 1
+        guard.update(done, {"params": state[0], "opt": state[1]})
+if out is not None:
+    jax.block_until_ready(out)
+# a resumed run times only the remaining iterations (step_ms math below
+# divides by the count actually executed, so the rate stays honest)
+timed = max(1, WARMUP + ITERS - done) if n_state else ITERS
 t0 = time.perf_counter()
-for _ in range(ITERS):
+for _ in range(timed):
     out = compiled(*state, *rest)
     if n_state:
         state = list(out[1:1 + n_state])
+        done += 1
+        guard.update(done, {"params": state[0], "opt": state[1]})
 jax.block_until_ready(out)
 dt = time.perf_counter() - t0
+if guard is not None:
+    guard.finish()
 # read back the loss: proves the steps really executed on-device (a
 # too-good-to-be-true step time with a NaN/garbage loss = broken run)
 res["final_loss"] = float(np.asarray(out[0]))
 
-res["step_ms"] = round(dt / ITERS * 1000, 2)
-tps = BATCH * SEQ * ITERS / dt
+res["step_ms"] = round(dt / timed * 1000, 2)
+tps = BATCH * SEQ * timed / dt
 res["tokens_per_sec"] = round(tps, 1)
 per_token = 6 * n_params + 6 * mcfg.num_layers * SEQ * mcfg.hidden_size
 res["mfu_model"] = round(tps * per_token / 197e12, 4)

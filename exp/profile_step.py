@@ -52,13 +52,23 @@ ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (BATCH, SEQ))
                   .astype(np.int32))
 labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (BATCH, SEQ))
                      .astype(np.int32))
+# preemption-safe by default (EXP_CKPT=0 opts out): SIGTERM saves
+# {params, opt} and exits 143; a relaunch resumes the warmed-up state
+from _preempt import ExpRunGuard  # noqa: E402
+
+guard = ExpRunGuard(f"profile_step_d{DROPOUT}")
+restored, done = guard.restore({"params": params, "opt": opt_state})
+params, opt_state = restored["params"], restored["opt"]
+
 print("compiling...", flush=True)
 compiled = step.lower(params, opt_state, ids, labels).compile()
 state = (params, opt_state)
-for _ in range(2):
+for _ in range(max(0, 2 - done)):
     out = compiled(*state, ids, labels)
     state = (out[1], out[2])
-jax.block_until_ready(out[0])
+    done += 1
+    guard.update(done, {"params": state[0], "opt": state[1]})
+jax.block_until_ready(state[0])
 
 logdir = "/tmp/jaxtrace"
 os.system(f"rm -rf {logdir}")
@@ -67,7 +77,10 @@ with jax.profiler.trace(logdir):
     for _ in range(3):
         out = compiled(*state, ids, labels)
         state = (out[1], out[2])
+        done += 1
+        guard.update(done, {"params": state[0], "opt": state[1]})
     jax.block_until_ready(out[0])
+guard.finish()
 
 files = glob.glob(f"{logdir}/**/*.xplane.pb", recursive=True)
 print("xplane files:", files, flush=True)

@@ -48,6 +48,7 @@ from . import checkpoint  # noqa: F401
 from .checkpoint import (  # noqa: F401
     save_sharded, load_sharded, save_state, load_state,
     CheckpointCorruptError, is_committed, verify_checkpoint, store_barrier,
+    ReshardError, HostLocalShard, sweep_staging, read_leaf,
 )
 from .checkpoint_manager import (  # noqa: F401
     CheckpointManager, latest_checkpoint,

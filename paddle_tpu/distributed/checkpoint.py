@@ -28,17 +28,32 @@ ANY instant of a save, so durability is enforced by construction:
  - every payload write is fsynced, then a ``COMMIT.<proc>`` marker — a
    manifest of per-file CRC32s and sizes — is written LAST;
  - single-host saves stage everything in ``<path>.tmp.<nonce>`` and
-   commit via one atomic ``os.rename``; multi-host saves (shared fs)
-   write in place and a checkpoint counts as committed only when the
-   markers of all ``world_size`` processes exist (optionally sealed by a
-   TCPStore barrier, :func:`store_barrier`);
+   commit via one atomic ``os.rename``; multi-host saves with a
+   coordination ``store`` stage into one shared ``<path>.tmp.<nonce>``
+   (nonce published by rank 0), barrier on all ``COMMIT.<proc>``
+   markers (:func:`store_barrier` — a timeout names exactly the ranks
+   that never arrived), then rank 0 promotes with one atomic rename —
+   so a whole-process SIGKILL at any phase leaves only staging debris,
+   never a half-committed final directory.  Store-less multi-host saves
+   (shared fs, no rendezvous) fall back to in-place per-marker commit;
  - ``load_sharded`` verifies marker presence, shard existence, size,
    CRC and full window coverage of each leaf BEFORE constructing
    arrays, raising :class:`CheckpointCorruptError` naming the offending
-   leaf/file instead of mmap-ing garbage weights.
+   leaf/file instead of mmap-ing garbage weights;
+ - elastic resume: ``load_sharded(..., elastic=True)`` re-shards a
+   checkpoint written by ``world_size=M`` into a run with a different
+   process count, stitching each leaf from whichever committed ranks'
+   shard windows cover it; an uncoverable leaf raises
+   :class:`ReshardError` (never a silent zero-fill);
+ - :func:`sweep_staging` is the startup janitor for crash debris:
+   age-gated removal of orphaned ``*.tmp.<nonce>`` staging dirs and
+   partial-marker directories, never touching the newest in-flight
+   nonce.
 
 Works for any pytree of jax.Arrays (params / optimizer slots / stacked
-``__ppstack__.*`` pipeline leaves alike).
+``__ppstack__.*`` pipeline leaves alike); :class:`HostLocalShard`
+leaves let a multi-process job without a global jax mesh save
+host-partitioned numpy state through the same protocol.
 """
 from __future__ import annotations
 
@@ -48,6 +63,7 @@ import logging
 import os
 import re
 import shutil
+import time
 import uuid
 import zlib
 
@@ -60,18 +76,66 @@ from . import mesh as _mesh_mod
 from ..utils.retry import wait_until
 
 __all__ = ["save_sharded", "load_sharded", "save_state", "load_state",
-           "CheckpointCorruptError", "is_committed", "verify_checkpoint",
-           "store_barrier"]
+           "CheckpointCorruptError", "ReshardError", "HostLocalShard",
+           "is_committed", "verify_checkpoint", "store_barrier",
+           "sweep_staging", "read_leaf"]
 
 logger = logging.getLogger(__name__)
 
 _COMMIT_RE = re.compile(r"^COMMIT\.(\d+)$")
+_STAGING_RE = re.compile(r"\.(tmp|old)\.[0-9a-fA-F]+$")
 
 
 class CheckpointCorruptError(RuntimeError):
     """A checkpoint directory failed commit/integrity verification:
     missing COMMIT markers, a missing/truncated/bit-flipped shard file,
     or shard windows that do not cover a leaf's full shape."""
+
+
+class ReshardError(CheckpointCorruptError):
+    """An elastic resume could not re-shard the checkpoint: the shard
+    windows of the committed ranks leave a hole in some leaf, so the
+    state cannot be reconstructed at the new world size.  Subclasses
+    :class:`CheckpointCorruptError` so resume-from-latest fallback
+    logic treats it as "this step is unusable", never as fatal."""
+
+
+class HostLocalShard:
+    """This process's window of a logically-global array.
+
+    For multi-process jobs that do NOT run a global jax mesh (each
+    process holds a host-local numpy block — drill workers, data-loader
+    state, CPU-side optimizer tails): ``save_sharded`` records the
+    declared ``global_shape``/``window`` instead of deriving them from
+    device sharding, so N processes jointly write one resharding-capable
+    checkpoint through the ordinary commit protocol.  ``window`` is
+    ``[[start, stop], ...]`` per dimension into the global array and
+    defaults to the full shape (a replicated leaf — every process
+    writes it, windows overlap, any one covers it on elastic resume).
+    """
+
+    __slots__ = ("data", "window", "global_shape")
+
+    def __init__(self, data, window=None, global_shape=None):
+        self.data = np.asarray(data)
+        self.global_shape = tuple(
+            int(d) for d in (self.data.shape if global_shape is None
+                             else global_shape))
+        if window is None:
+            window = [[0, d] for d in self.data.shape]
+        self.window = [[int(a), int(b)] for a, b in window]
+        if len(self.window) != len(self.global_shape):
+            raise ValueError(
+                f"window rank {len(self.window)} != global rank "
+                f"{len(self.global_shape)}")
+        for (a, b), dim in zip(self.window, self.global_shape):
+            if not (0 <= a <= b <= dim):
+                raise ValueError(f"window {self.window} out of bounds "
+                                 f"for global shape {self.global_shape}")
+        want = tuple(b - a for a, b in self.window)
+        if want != tuple(self.data.shape):
+            raise ValueError(f"data shape {self.data.shape} does not "
+                             f"fill window {self.window}")
 
 _SEP = "."  # flattened-tree key separator
 
@@ -190,6 +254,18 @@ def _shard_records(state, proc):
     index = {}
     for p, arr in _flat_items(state):
         leaf = _leaf_name(p)
+        if isinstance(arr, HostLocalShard):
+            # host-declared window: no device sharding to consult
+            fs = _fs_name(leaf)
+            fname = f"{proc}_0.npy"
+            index[leaf] = {"shape": list(arr.global_shape),
+                           "dtype": str(arr.data.dtype),
+                           "spec": None,
+                           "shards": [{"file": f"{fs}/{fname}",
+                                       "index": [list(w)
+                                                 for w in arr.window]}]}
+            yield (f"data/{fs}/{fname}", _npy_bytes(arr.data))
+            continue
         arr = arr if isinstance(arr, jax.Array) else jnp.asarray(arr)
         spec = None
         if isinstance(arr.sharding, NamedSharding):
@@ -233,15 +309,28 @@ def _write_records(root, records, durable=True):
     return manifest
 
 
-def _write_commit_marker(root, proc, world, manifest, durable=True):
+def _write_commit_marker(root, proc, world, manifest, durable=True,
+                         nonce=None):
     marker = {"format": 1, "proc": proc, "world": world, "files": manifest}
+    if nonce:
+        marker["nonce"] = nonce
     _write_file(os.path.join(root, f"COMMIT.{proc}"),
                 json.dumps(marker).encode(), durable=durable)
     _fsync_dir(root)
 
 
+def _committed_nonce(path):
+    """The staging nonce recorded in ``path``'s COMMIT markers, or None
+    when the directory is absent / not fully committed / pre-nonce."""
+    try:
+        markers = _read_markers(path)
+    except (FileNotFoundError, CheckpointCorruptError):
+        return None
+    return next(iter(markers.values())).get("nonce")
+
+
 def _save_records(records, path, proc, world, store=None, durable=True,
-                  nonce=None):
+                  nonce=None, run_id=None, barrier_timeout=300.0):
     """The commit protocol over pre-serialized records (shared by
     :func:`save_sharded` and the CheckpointManager async writer)."""
     if world <= 1:
@@ -251,54 +340,128 @@ def _save_records(records, path, proc, world, store=None, durable=True,
         tmp = f"{path}.tmp.{nonce}"
         shutil.rmtree(tmp, ignore_errors=True)
         manifest = _write_records(tmp, records, durable=durable)
-        _write_commit_marker(tmp, proc, world, manifest, durable=durable)
+        _write_commit_marker(tmp, proc, world, manifest, durable=durable,
+                             nonce=nonce)
         _replace_dir(tmp, path)
+    elif store is not None:
+        # multi-host staged commit: all procs write into ONE shared
+        # staging dir (nonce published by rank 0 — a relaunch after a
+        # crashed save gets a fresh nonce, so stale attempts can never
+        # mix into this one), barrier on all COMMIT markers, then rank 0
+        # promotes with a single atomic rename.  A SIGKILL at any phase
+        # leaves only `.tmp.<nonce>` debris for the janitor.
+        base = os.path.basename(path)
+        tag = f"ckpt/{run_id or '0'}/{base}"
+        if proc == 0:
+            nonce = nonce or uuid.uuid4().hex[:8]
+            store.set(f"{tag}/nonce", nonce)
+        else:
+            got = store.get(f"{tag}/nonce", wait=True,
+                            timeout=barrier_timeout)
+            nonce = got.decode() if isinstance(got, bytes) else str(got)
+        tmp = f"{path}.tmp.{nonce}"
+        manifest = _write_records(tmp, records, durable=durable)
+        _write_commit_marker(tmp, proc, world, manifest, durable=durable,
+                             nonce=nonce)
+        store_barrier(store, f"{tag}/{nonce}/commit", world, rank=proc,
+                      timeout=barrier_timeout)
+        if proc == 0:
+            _replace_dir(tmp, path)
+            store.set(f"{tag}/{nonce}/promoted", b"1")
+        else:
+            # rank 0 may die between rename and flag: the marker nonce
+            # in the final dir is the authoritative promote signal
+            wait_until(
+                lambda: (store.get(f"{tag}/{nonce}/promoted", wait=False)
+                         is not None
+                         or _committed_nonce(path) == nonce),
+                barrier_timeout,
+                desc=f"checkpoint promote of {base} (nonce {nonce})")
     else:
-        # multi-host shared fs: every proc writes its own files in place;
-        # the checkpoint is committed only once ALL COMMIT.<proc> markers
-        # exist, so a partial save is detectable, never loadable
+        # store-less multi-host shared fs: every proc writes its own
+        # files in place; the checkpoint is committed only once ALL
+        # COMMIT.<proc> markers exist, so a partial save is detectable,
+        # never loadable — but a crashed attempt leaves a partial marker
+        # set in the FINAL dir (see sweep_staging), which the staged
+        # path above avoids entirely
         os.makedirs(path, exist_ok=True)
         manifest = _write_records(path, records, durable=durable)
         _write_commit_marker(path, proc, world, manifest, durable=durable)
-        if store is not None:
-            store_barrier(store, f"ckpt/{os.path.basename(path)}/commit",
-                          world)
 
 
 def save_sharded(state, path, process_index=None, *, world_size=None,
-                 store=None, durable=True):
+                 store=None, durable=True, run_id=None,
+                 barrier_timeout=300.0):
     """Save a pytree of jax.Arrays as a crash-consistent sharded
     checkpoint directory.
 
     Each host writes only its addressable, replica-0 shards; call on every
     process of a multi-host job (single-controller semantics preserved:
     identical code path everywhere).  Single-process saves are atomic
-    (stage + rename); multi-process saves commit via per-process
-    ``COMMIT.<proc>`` markers — pass ``store`` (a
-    :class:`paddle_tpu.core.TCPStore`) to barrier on all markers before
-    returning.  ``durable=False`` skips fsyncs (tests / throwaway dirs).
+    (stage + rename).  Multi-process saves with ``store`` (a
+    :class:`paddle_tpu.core.TCPStore`) use the staged protocol: shared
+    ``<path>.tmp.<nonce>`` staging, a COMMIT barrier over all
+    ``world_size`` processes, one atomic promote by rank 0 — ``run_id``
+    (defaults to ``$PT_RUN_ID``) isolates barrier keys across
+    relaunches of the same job.  Without a store, multi-process saves
+    commit in place via per-process markers.  ``durable=False`` skips
+    fsyncs (tests / throwaway dirs).
     """
     proc = jax.process_index() if process_index is None else process_index
     world = jax.process_count() if world_size is None else world_size
     _save_records(_shard_records(state, proc), path, proc, world,
-                  store=store, durable=durable)
+                  store=store, durable=durable,
+                  run_id=run_id or os.environ.get("PT_RUN_ID"),
+                  barrier_timeout=barrier_timeout)
 
 
-def store_barrier(store, key, world, timeout=300.0):
+def _barrier_arrive(store, key, rank=None):
+    """Announce this process at the barrier (the per-rank key makes a
+    hung barrier diagnosable: the waiters can name who never arrived)."""
+    if rank is not None:
+        store.set(f"{key}/rank/{rank}", b"1")
+    return store.add(key, 1)
+
+
+def store_barrier(store, key, world, rank=None, timeout=300.0):
     """Block until ``world`` processes have entered this barrier (one
     `add` each on ``key``) — the multi-host commit seal: after it
-    returns, every process's COMMIT marker is on the shared filesystem."""
-    store.add(key, 1)
-    wait_until(lambda: store.add(key, 0) >= world, timeout,
-               desc=f"checkpoint barrier {key!r} ({world} procs)")
+    returns, every process's COMMIT marker is on the shared filesystem.
+
+    Pass ``rank`` so a timeout names exactly which ranks are missing
+    (diff of arrived per-rank keys vs the expected set) instead of only
+    a count — one log line locates the dead process in a hung drill.
+    """
+    from ..observability import get_telemetry
+
+    def _missing_ranks():
+        arrived = sorted(
+            p for p in range(world)
+            if store.get(f"{key}/rank/{p}", wait=False) is not None)
+        missing = sorted(set(range(world)) - set(arrived))
+        return (f"{len(arrived)}/{world} ranks arrived; missing ranks "
+                f"{missing} (arrived: {arrived})")
+
+    t0 = time.monotonic()
+    ok = False
+    _barrier_arrive(store, key, rank)
+    try:
+        wait_until(lambda: store.add(key, 0) >= world, timeout,
+                   desc=f"checkpoint barrier {key!r} ({world} procs)",
+                   diag=_missing_ranks if rank is not None else None)
+        ok = True
+    finally:
+        get_telemetry().record_barrier_wait(time.monotonic() - t0, ok=ok)
 
 
 # -- commit / integrity verification ----------------------------------------
 
-def _read_markers(path):
+def _read_markers(path, elastic=False):
     """Parse every COMMIT.<proc> marker under ``path``; raises
-    CheckpointCorruptError when none exist, any is unreadable, or the
-    set is short of the recorded world size."""
+    CheckpointCorruptError when none exist, any is unreadable, or —
+    unless ``elastic`` — the set is short of the recorded world size
+    (``elastic=True`` accepts a partial set and lets coverage stitching
+    decide whether the committed ranks' windows suffice)."""
     if not os.path.isdir(path):
         raise FileNotFoundError(f"no checkpoint directory at {path}")
     markers = {}
@@ -310,6 +473,10 @@ def _read_markers(path):
             with open(os.path.join(path, n)) as f:
                 markers[int(m.group(1))] = json.load(f)
         except (OSError, ValueError) as e:
+            if elastic:
+                logger.warning("%s: skipping unreadable commit marker "
+                               "%s for elastic resume: %s", path, n, e)
+                continue
             raise CheckpointCorruptError(
                 f"{path}: unreadable commit marker {n}: {e}")
     if not markers:
@@ -319,16 +486,30 @@ def _read_markers(path):
     world = max(mk.get("world", 1) for mk in markers.values())
     missing = [p for p in range(world) if p not in markers]
     if missing:
-        raise CheckpointCorruptError(
-            f"{path}: committed by {sorted(markers)} but world_size="
-            f"{world}; missing COMMIT markers for procs {missing}")
+        if not elastic:
+            raise CheckpointCorruptError(
+                f"{path}: partially committed checkpoint: COMMIT markers "
+                f"present for ranks {sorted(markers)} but the recorded "
+                f"world_size={world} expects ranks "
+                f"{list(range(world))}; missing ranks {missing}. If the "
+                f"fleet changed size or lost hosts, resume elastically "
+                f"(load_sharded(..., elastic=True) / "
+                f"CheckpointManager(..., elastic=True)) to re-shard from "
+                f"the committed ranks' shard windows")
+        logger.warning(
+            "%s: elastic resume from a partial commit — using ranks %s "
+            "of world_size=%d (missing %s); leaf coverage will be "
+            "verified before any array is built",
+            path, sorted(markers), world, missing)
     return markers
 
 
-def _verify_manifest(path, markers, integrity="full"):
+def _verify_manifest(path, markers, integrity="full", elastic=False):
     """Check every manifested file for existence/size (and CRC32 when
     ``integrity='full'``); stray index files outside any manifest are
-    corruption too (debris of an aborted multi-host save)."""
+    corruption too (debris of an aborted multi-host save) — except under
+    ``elastic``, where files of non-committed ranks are expected debris
+    and simply ignored."""
     manifest = {}
     for mk in markers.values():
         manifest.update(mk.get("files", {}))
@@ -351,24 +532,30 @@ def _verify_manifest(path, markers, integrity="full"):
                 raise CheckpointCorruptError(
                     f"{path}: {rel} failed CRC32 check "
                     f"(bit rot or partial write)")
-    for n in os.listdir(path):
-        if n.startswith("index.") and n.endswith(".json") \
-                and n not in manifest:
-            raise CheckpointCorruptError(
-                f"{path}: index file {n} is not covered by any COMMIT "
-                f"manifest (debris of an aborted save?)")
+    if not elastic:
+        for n in os.listdir(path):
+            if n.startswith("index.") and n.endswith(".json") \
+                    and n not in manifest:
+                raise CheckpointCorruptError(
+                    f"{path}: index file {n} is not covered by any COMMIT "
+                    f"manifest (debris of an aborted save?)")
     return manifest
 
 
-def _verify_coverage(path, leaf, entry):
+def _verify_coverage(path, leaf, entry, elastic=False, committed=None):
     """Every shard window in bounds + windows jointly covering the full
-    shape (volume test; saved shards never overlap, so a deficit means a
-    hole a load would silently zero-fill via mmap garbage)."""
+    shape.  The volume test is exact for the save path (windows of one
+    world never overlap) and conservative under elastic stitching
+    (replicated leaves overlap, making ``covered > total`` — a deficit
+    therefore always means a real hole a load would otherwise fill with
+    mmap garbage).  Under ``elastic`` a hole raises :class:`ReshardError`
+    naming the committed ranks so the operator can see whose windows are
+    gone."""
     shape = tuple(entry["shape"])
     total = int(np.prod(shape)) if shape else 1
+    exc = ReshardError if elastic else CheckpointCorruptError
     if not entry["shards"]:
-        raise CheckpointCorruptError(
-            f"{path}: leaf '{leaf}' has no shard files")
+        raise exc(f"{path}: leaf '{leaf}' has no shard files")
     covered = 0
     for sh in entry["shards"]:
         win = sh["index"]
@@ -385,6 +572,13 @@ def _verify_coverage(path, leaf, entry):
             vol *= b - a
         covered += vol
     if covered < total:
+        if elastic:
+            raise ReshardError(
+                f"{path}: cannot re-shard leaf '{leaf}': the windows of "
+                f"committed ranks {committed} cover only {covered} of "
+                f"{total} elements of shape {list(shape)} — the missing "
+                f"ranks' shard files are required and a zero-fill would "
+                f"silently corrupt the state")
         raise CheckpointCorruptError(
             f"{path}: leaf '{leaf}' shards cover {covered} of {total} "
             f"elements — missing shard files for shape {list(shape)}")
@@ -400,28 +594,37 @@ def is_committed(path):
         return False
 
 
-def verify_checkpoint(path, integrity="full"):
+def verify_checkpoint(path, integrity="full", elastic=False):
     """Full integrity audit of a checkpoint directory; raises
     :class:`CheckpointCorruptError` (or FileNotFoundError) naming the
     offending file/leaf. ``integrity``: "full" checks CRC32s, "size"
     only existence+size (cheap scan), "off" checks markers only.
-    Returns the merged leaf index on success."""
-    markers = _read_markers(path)
+    ``elastic=True`` accepts a partially-committed checkpoint as long as
+    the committed ranks' windows still cover every leaf (raising
+    :class:`ReshardError` otherwise).  Returns the merged leaf index on
+    success."""
+    markers = _read_markers(path, elastic=elastic)
     if integrity in ("full", "size"):
-        _verify_manifest(path, markers, integrity=integrity)
-    merged = _read_index(path, verify=False)
+        _verify_manifest(path, markers, integrity=integrity,
+                         elastic=elastic)
+    merged = _merge_index(path, procs=sorted(markers))
     if integrity in ("full", "size"):
         for leaf, entry in merged.items():
-            _verify_coverage(path, leaf, entry)
+            _verify_coverage(path, leaf, entry, elastic=elastic,
+                             committed=sorted(markers))
     return merged
 
 
-def _read_index(path, verify=True, integrity="full"):
-    if verify:
-        return verify_checkpoint(path, integrity=integrity)
+def _merge_index(path, procs=None):
+    """Merge ``index.<proc>.json`` files into one leaf index.  ``procs``
+    restricts the merge to the given (committed) ranks — the elastic
+    stitching rule: never read a window a dead rank may have torn."""
     merged = {}
     names = sorted(n for n in os.listdir(path)
                    if n.startswith("index.") and n.endswith(".json"))
+    if procs is not None:
+        want = {f"index.{p}.json" for p in procs}
+        names = [n for n in names if n in want]
     if not names:
         raise FileNotFoundError(f"no index.*.json under {path}")
     for n in names:
@@ -433,6 +636,112 @@ def _read_index(path, verify=True, integrity="full"):
             else:
                 merged[leaf] = entry
     return merged
+
+
+def _read_index(path, verify=True, integrity="full", elastic=False):
+    if verify:
+        return verify_checkpoint(path, integrity=integrity,
+                                 elastic=elastic)
+    return _merge_index(path)
+
+
+def sweep_staging(root, max_age=3600.0, now=None):
+    """Startup janitor: remove crash debris under checkpoint root
+    ``root``.
+
+    Sweeps two kinds of orphans a SIGKILL mid-save leaves behind:
+
+     - staging/backup directories (``*.tmp.<nonce>`` / ``*.old.<nonce>``)
+       — except the NEWEST staging dir, which may belong to a
+       still-running save on a shared filesystem (the "never touch the
+       newest in-flight nonce" rule), and
+     - partially-committed checkpoint directories (a marker/index/data
+       set short of its recorded world size — debris of a store-less
+       in-place multi-host save; the staged protocol never creates
+       these) — a later in-place re-save could otherwise mix stale
+       markers of a dead generation into a new commit.
+
+    Both are age-gated: only entries whose mtime is older than
+    ``max_age`` seconds are touched, so a concurrently-starting peer's
+    fresh files survive.  Fully committed checkpoints are never removed
+    here (retention is the CheckpointManager GC's job).  Returns the
+    number of directories removed; filesystem races are swallowed — a
+    janitor must never take down a starting run.
+    """
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return 0
+    now = time.time() if now is None else now
+    staging, partial = [], []
+    for n in names:
+        p = os.path.join(root, n)
+        if not os.path.isdir(p):
+            continue
+        try:
+            age = now - os.path.getmtime(p)
+        except OSError:
+            continue
+        if _STAGING_RE.search(n):
+            staging.append((age, p))
+        elif age > max_age and _looks_like_checkpoint(p) \
+                and not is_committed(p):
+            partial.append(p)
+    if staging:
+        # newest in-flight nonce is spared unconditionally
+        staging.sort()
+        partial.extend(p for age, p in staging[1:] if age > max_age)
+    swept = 0
+    for p in partial:
+        logger.info("checkpoint janitor: sweeping orphaned %s", p)
+        shutil.rmtree(p, ignore_errors=True)
+        swept += 1
+    if swept:
+        from ..observability import get_telemetry
+        get_telemetry().record_staging_sweep(swept)
+    return swept
+
+
+def _looks_like_checkpoint(path):
+    """Only directories bearing checkpoint artifacts are janitor
+    candidates — never an arbitrary user directory under the root."""
+    try:
+        names = os.listdir(path)
+    except OSError:
+        return False
+    return any(_COMMIT_RE.match(n) or n == "data"
+               or (n.startswith("index.") and n.endswith(".json"))
+               for n in names)
+
+
+def read_leaf(path, leaf, window=None, integrity="size", elastic=False):
+    """Host-side window read of one saved leaf as a plain numpy array —
+    no jax arrays, no mesh (drill workers / inspection tooling).
+
+    ``window``: ``[[start, stop], ...]`` into the global shape (defaults
+    to the full array).  The checkpoint is verified first at
+    ``integrity`` level — but coverage only for the REQUESTED leaf, so
+    an elastic hole elsewhere doesn't block reading an intact leaf;
+    ``elastic=True`` stitches from the committed ranks only (raising
+    :class:`ReshardError` when this leaf has a hole).
+    """
+    markers = _read_markers(path, elastic=elastic)
+    if integrity in ("full", "size"):
+        _verify_manifest(path, markers, integrity=integrity,
+                         elastic=elastic)
+    index = _merge_index(path, procs=sorted(markers))
+    if leaf in index and integrity in ("full", "size"):
+        _verify_coverage(path, leaf, index[leaf], elastic=elastic,
+                         committed=sorted(markers))
+    if leaf not in index:
+        raise KeyError(f"{path}: no leaf {leaf!r} "
+                       f"(have: {sorted(index)[:16]})")
+    reader = _LeafReader(path, index[leaf])
+    if window is None:
+        sel = tuple(slice(0, d) for d in reader.shape)
+    else:
+        sel = tuple(slice(int(a), int(b)) for a, b in window)
+    return reader.read(sel)
 
 
 class _LeafReader:
@@ -671,7 +980,7 @@ def _target_spec(saved_spec, shape, mesh):
 
 
 def load_sharded(path, mesh=None, shardings=None, template=None,
-                 integrity="full"):
+                 integrity="full", elastic=False):
     """Load a sharded checkpoint onto the current (possibly different)
     mesh.
 
@@ -686,10 +995,17 @@ def load_sharded(path, mesh=None, shardings=None, template=None,
     checkpoint raises :class:`CheckpointCorruptError` naming the
     offending leaf/file instead of mmap-ing garbage into weights.
 
+    ``elastic=True`` is the changed-world-size resume path: a checkpoint
+    written by ``world_size=M`` (even one whose marker set is partial
+    after losing hosts) is re-sharded onto the current run by stitching
+    each leaf from the committed ranks' shard windows; an uncoverable
+    leaf raises :class:`ReshardError` rather than zero-filling.
+
     Returns the restored pytree (nested dicts mirroring the saved tree).
     """
     mesh = mesh or _mesh_mod.get_mesh()
-    index = _read_index(path, verify=True, integrity=integrity)
+    index = _read_index(path, verify=True, integrity=integrity,
+                        elastic=elastic)
     tmpl_flat = {}
     if template is not None:
         tmpl_flat = {_leaf_name(p): a for p, a in _flat_items(template)}

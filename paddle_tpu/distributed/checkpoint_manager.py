@@ -62,11 +62,27 @@ class CheckpointManager:
         integrity: verification level for restores — "full" (CRC32),
             "size", or "off" (markers only).
         durable: fsync every write (disable only in tests).
+        run_id: isolates multi-host commit-barrier keys across
+            relaunches of the same job (defaults to ``$PT_RUN_ID``) —
+            a relaunched fleet must never count a dead generation's
+            barrier arrivals.
+        barrier_timeout: seconds each process waits at the multi-host
+            commit barrier before the timeout names the missing ranks.
+        elastic: accept checkpoints written at a DIFFERENT world size
+            (including partial marker sets after losing hosts) on
+            restore, re-sharding from the committed ranks' windows;
+            a leaf with a coverage hole makes that step invalid
+            (``ReshardError``) and restore falls back.
+        orphan_age: on construction, sweep staging/partial-commit
+            debris older than this many seconds from ``root``
+            (:func:`checkpoint.sweep_staging`); None disables the
+            janitor.
     """
 
     def __init__(self, root, keep_last_n=3, async_save=False, store=None,
                  world_size=None, process_index=None, integrity="full",
-                 durable=True):
+                 durable=True, run_id=None, barrier_timeout=300.0,
+                 elastic=False, orphan_age=3600.0):
         if keep_last_n is not None and keep_last_n < 1:
             raise ValueError(f"keep_last_n must be >= 1, got {keep_last_n}")
         self.root = root
@@ -77,7 +93,13 @@ class CheckpointManager:
         self.process_index = process_index
         self.integrity = integrity
         self.durable = durable
+        self.run_id = run_id if run_id is not None \
+            else os.environ.get("PT_RUN_ID")
+        self.barrier_timeout = barrier_timeout
+        self.elastic = elastic
         os.makedirs(root, exist_ok=True)
+        if orphan_age is not None:
+            _ckpt.sweep_staging(root, max_age=orphan_age)
         self._bad: set[int] = set()     # steps that failed a full verify
         self._err: BaseException | None = None
         self._lock = threading.Lock()
@@ -112,7 +134,8 @@ class CheckpointManager:
             if step in self._bad:
                 continue
             try:
-                _ckpt.verify_checkpoint(d, integrity="size")
+                _ckpt.verify_checkpoint(d, integrity="size",
+                                        elastic=self.elastic)
             except (CheckpointCorruptError, FileNotFoundError,
                     ValueError) as e:
                 logger.debug("checkpoint %s not valid: %s", d, e)
@@ -155,7 +178,9 @@ class CheckpointManager:
             try:
                 _ckpt._save_records(_ckpt._shard_records(state, proc),
                                     path, proc, world, store=self.store,
-                                    durable=self.durable)
+                                    durable=self.durable,
+                                    run_id=self.run_id,
+                                    barrier_timeout=self.barrier_timeout)
             except BaseException:
                 tel.record_checkpoint_save(time.perf_counter() - t0,
                                            step=step, mode="sync",
@@ -174,7 +199,9 @@ class CheckpointManager:
             t0 = time.perf_counter()
             try:
                 _ckpt._save_records(records, path, proc, world,
-                                    store=self.store, durable=self.durable)
+                                    store=self.store, durable=self.durable,
+                                    run_id=self.run_id,
+                                    barrier_timeout=self.barrier_timeout)
                 tel.record_checkpoint_save(time.perf_counter() - t0,
                                            step=step, mode="async")
                 self._gc()
@@ -215,7 +242,8 @@ class CheckpointManager:
                 state = _ckpt.load_sharded(d, mesh=mesh,
                                            shardings=shardings,
                                            template=template,
-                                           integrity=self.integrity)
+                                           integrity=self.integrity,
+                                           elastic=self.elastic)
                 tel.record_checkpoint_restore(time.perf_counter() - t0,
                                               step=step)
                 return state, step
@@ -272,6 +300,7 @@ def latest_checkpoint(root):
     is a manager root at all)."""
     if not os.path.isdir(root):
         return None
-    mgr = CheckpointManager(root, keep_last_n=None)
+    # read-only probe: no janitor sweep from a mere path lookup
+    mgr = CheckpointManager(root, keep_last_n=None, orphan_age=None)
     step = mgr.latest_step()
     return None if step is None else mgr.step_dir(step)

@@ -1,0 +1,52 @@
+"""Multi-process fault drills: kill a REAL process mid-save, prove the
+fleet recovers.
+
+The crash-consistency layer (:mod:`..checkpoint`) is easy to test with
+simulated kills (tests/fault_injection.py raises through the write
+seams) — but a simulated kill cannot lie about OS-level atomicity the
+way a real SIGKILL can: a whole process dying takes its page cache,
+its file descriptors and its barrier participation with it.  This
+package drills exactly that:
+
+ - :mod:`.runner` spawns N real worker subprocesses coordinated by a
+   TCPStore (``JAX_PLATFORMS=cpu`` — the protocol under test is
+   filesystem + store, not XLA), SIGKILLs a scripted victim at a
+   scripted phase of a scripted save, then asserts the survivors fail
+   *cleanly* and a relaunched fleet — possibly at a different world
+   size — restores the newest fully-committed step bit-for-bit.
+ - :mod:`.worker` is the subprocess entry point
+   (``python -m paddle_tpu.distributed.drill.worker``): a deterministic
+   numpy "training" loop whose state is saved through
+   :class:`~paddle_tpu.distributed.checkpoint_manager.CheckpointManager`
+   with :class:`~paddle_tpu.distributed.checkpoint.HostLocalShard`
+   row-partitioned leaves, so the runner can replay a bit-exact oracle.
+ - :mod:`.injector` arms the kill: SIGKILL of the *whole process* at
+   one of four phases of the commit protocol — ``mid-stage`` (torn
+   data file), ``pre-marker`` (all data staged, no COMMIT marker),
+   ``mid-marker`` (torn COMMIT marker), ``mid-barrier`` (marker
+   durable, victim announced at the commit barrier, then death).
+
+What each phase proves (victim = non-zero rank, staged store commit):
+
+ ============  =====================================================
+ phase         expected recovery
+ ============  =====================================================
+ mid-stage     staging dir torn → step K never promotes; resume K-1
+ pre-marker    victim's marker missing → barrier times out naming
+               the victim's rank; resume K-1
+ mid-marker    torn COMMIT bytes stay in staging; resume K-1
+ mid-barrier   victim arrived ⇒ rank 0 promotes K; survivors fail at
+               K+1; resume K (kill rank 0 instead ⇒ no promote, K-1)
+ ============  =====================================================
+"""
+__all__ = ["KillSpec", "run_drill", "spawn_worker", "reap_all"]
+
+
+def __getattr__(name):
+    # lazy: `python -m paddle_tpu.distributed.drill.worker` must not
+    # pre-import the worker module through the package (runpy warns),
+    # and a worker subprocess has no use for the runner
+    if name in __all__:
+        from . import runner
+        return getattr(runner, name)
+    raise AttributeError(name)

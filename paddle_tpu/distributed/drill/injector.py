@@ -1,0 +1,99 @@
+"""Whole-process kill injection for fault drills.
+
+Unlike tests/fault_injection.py (which raises a catchable exception
+through the write seams), this module's only weapon is
+``SIGKILL(self)`` — nothing unwinds, no ``finally`` runs, fds and
+barrier membership vanish exactly as on a real preemption or OOM kill.
+
+Armed from environment variables (set by the drill runner on every
+worker; each worker self-selects by rank):
+
+ - ``DRILL_KILL_PHASE``: ``mid-stage`` | ``pre-marker`` | ``mid-marker``
+   | ``mid-barrier`` | ``none``/unset
+ - ``DRILL_KILL_STEP``:  the checkpoint step whose save is sabotaged
+ - ``DRILL_KILL_RANK``:  which rank dies (compared to ``DRILL_RANK``)
+
+The patches target the same module-level seams the in-process fault
+harness uses (``_write_file`` / ``_write_commit_marker`` /
+``_barrier_arrive``), so a drill exercises the identical code paths a
+production save takes.
+"""
+from __future__ import annotations
+
+import os
+import signal
+
+from .. import checkpoint as _ckpt
+
+__all__ = ["PHASES", "install", "install_from_env"]
+
+PHASES = ("mid-stage", "pre-marker", "mid-marker", "mid-barrier")
+
+
+def _die():
+    """SIGKILL our own process — the one fault no handler can soften."""
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def _torn_write(path, data):
+    """Leave a half-written file behind, bypassing fsync — what the
+    kernel plausibly persists when a process dies mid-write."""
+    with open(path, "wb") as f:
+        f.write(data[: max(1, len(data) // 2)])
+
+
+def install(phase, step):
+    """Patch the checkpoint seams so THIS process SIGKILLs itself at
+    ``phase`` of the save of checkpoint step ``step``."""
+    if phase not in PHASES:
+        raise ValueError(f"unknown drill phase {phase!r}; "
+                         f"expected one of {PHASES}")
+    needle = f"step_{int(step):08d}"
+    real_write = _ckpt._write_file
+    real_marker = _ckpt._write_commit_marker
+    real_arrive = _ckpt._barrier_arrive
+
+    if phase == "mid-stage":
+        def _write(path, data, durable=True):
+            if needle in path and f"{os.sep}data{os.sep}" in path:
+                _torn_write(path, data)
+                _die()
+            return real_write(path, data, durable=durable)
+        _ckpt._write_file = _write
+    elif phase == "mid-marker":
+        def _write(path, data, durable=True):
+            if needle in path and \
+                    os.path.basename(path).startswith("COMMIT."):
+                _torn_write(path, data)
+                _die()
+            return real_write(path, data, durable=durable)
+        _ckpt._write_file = _write
+    elif phase == "pre-marker":
+        def _marker(root, proc, world, manifest, durable=True,
+                    nonce=None):
+            if needle in root:
+                _die()
+            return real_marker(root, proc, world, manifest,
+                               durable=durable, nonce=nonce)
+        _ckpt._write_commit_marker = _marker
+    else:  # mid-barrier: announce arrival, then die before the seal
+        def _arrive(store, key, rank=None):
+            if needle in key:
+                real_arrive(store, key, rank)
+                _die()
+            return real_arrive(store, key, rank)
+        _ckpt._barrier_arrive = _arrive
+
+
+def install_from_env():
+    """Arm the kill described by ``DRILL_KILL_*`` if this rank is the
+    victim; returns True when armed."""
+    phase = os.environ.get("DRILL_KILL_PHASE", "")
+    if not phase or phase == "none":
+        return False
+    rank = int(os.environ.get("DRILL_RANK", "0"))
+    victim = int(os.environ.get("DRILL_KILL_RANK", "0"))
+    if rank != victim:
+        return False
+    install(phase, int(os.environ.get("DRILL_KILL_STEP", "0")))
+    return True

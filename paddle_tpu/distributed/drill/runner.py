@@ -1,0 +1,226 @@
+"""Drill runner: spawn real worker fleets, kill one, prove recovery.
+
+The runner is the drill's control plane AND its oracle: it hosts the
+TCPStore master, launches each generation of workers
+(``python -m paddle_tpu.distributed.drill.worker``), waits for the
+scripted SIGKILL to play out, then independently replays the
+deterministic update (:func:`..drill.worker.advance`) and compares the
+newest committed checkpoint bit-for-bit (``ndarray.tobytes()`` — CRC
+verification happens inside ``verify_checkpoint`` first).
+
+Every spawned process is tracked in a module-level registry so a test
+harness can guarantee no leaked children regardless of how an
+assertion fails (see tests/drills/conftest.py's reaper fixture).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import subprocess
+import sys
+import uuid
+
+from ...core import TCPStore
+from ...utils.retry import wait_until
+from ..checkpoint import read_leaf, verify_checkpoint
+from ..checkpoint_manager import CheckpointManager
+from .worker import EXIT_SAVE_FAILED, advance, init_state
+
+__all__ = ["KillSpec", "DrillFailure", "spawn_worker", "run_drill",
+           "reap_all"]
+
+logger = logging.getLogger(__name__)
+
+# repo root (…/paddle_tpu/distributed/drill/runner.py → 4 levels up) so
+# spawned workers can import the package without an install step
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+_LIVE: set = set()  # every Popen this module ever spawned, minus reaped
+
+
+class DrillFailure(AssertionError):
+    """A drill's recovery invariant did not hold."""
+
+
+class KillSpec:
+    """Scripted kill: SIGKILL ``rank`` at ``phase`` of step ``step``'s
+    save (phases: see :mod:`.injector`)."""
+
+    __slots__ = ("phase", "step", "rank")
+
+    def __init__(self, phase, step, rank=1):
+        self.phase = phase
+        self.step = int(step)
+        self.rank = int(rank)
+
+    def expected_commit(self):
+        """Newest step that must be committed after this kill plays
+        out: ``mid-barrier`` is the one phase where the victim has
+        already sealed its part, so rank 0 still promotes step K —
+        unless the victim IS rank 0, which dies before promoting."""
+        if self.phase == "mid-barrier" and self.rank != 0:
+            return self.step
+        return self.step - 1
+
+
+def reap_all():
+    """SIGKILL + wait every worker this module spawned and is still
+    tracking — the no-leaked-children guarantee for test harnesses."""
+    for p in list(_LIVE):
+        if p.poll() is None:
+            try:
+                p.kill()
+            except OSError:
+                pass
+        try:
+            p.wait(timeout=10)
+        except Exception:
+            logger.warning("drill reaper: pid %s did not exit", p.pid)
+        _LIVE.discard(p)
+
+
+def spawn_worker(rank, world, *, root, port, total_steps, run_id,
+                 barrier_timeout, kill=None, elastic=True,
+                 orphan_age=None, log_path=None):
+    """Launch one drill worker subprocess; returns its Popen (also
+    registered for :func:`reap_all`)."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("DRILL_")}
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PT_RUN_ID": run_id,
+        "DRILL_RANK": str(rank),
+        "DRILL_WORLD": str(world),
+        "DRILL_CKPT": root,
+        "DRILL_STORE_PORT": str(port),
+        "DRILL_TOTAL_STEPS": str(total_steps),
+        "DRILL_RUN_ID": run_id,
+        "DRILL_BARRIER_TIMEOUT": str(barrier_timeout),
+        "DRILL_ELASTIC": "1" if elastic else "0",
+    })
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if orphan_age is not None:
+        env["DRILL_ORPHAN_AGE"] = str(orphan_age)
+    if kill is not None:
+        env["DRILL_KILL_PHASE"] = kill.phase
+        env["DRILL_KILL_STEP"] = str(kill.step)
+        env["DRILL_KILL_RANK"] = str(kill.rank)
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.drill.worker"]
+    if log_path:
+        with open(log_path, "ab") as out:
+            p = subprocess.Popen(cmd, env=env, stdout=out,
+                                 stderr=subprocess.STDOUT)
+    else:
+        p = subprocess.Popen(cmd, env=env,
+                             stdout=subprocess.DEVNULL,
+                             stderr=subprocess.DEVNULL)
+    _LIVE.add(p)
+    return p
+
+
+def _wait_fleet(procs, timeout):
+    """Block until every proc exits; returns their return codes.  On
+    timeout the fleet is reaped and the drill fails."""
+    try:
+        wait_until(lambda: all(p.poll() is not None for p in procs),
+                   timeout, desc=f"drill fleet of {len(procs)} to exit")
+    except TimeoutError as e:
+        reap_all()
+        raise DrillFailure(f"drill generation hung: {e}") from e
+    rcs = []
+    for p in procs:
+        rcs.append(p.wait())
+        _LIVE.discard(p)
+    return rcs
+
+
+def _latest_step(root):
+    # read-only probe (orphan_age=None: the probe must not janitor)
+    return CheckpointManager(root, keep_last_n=None,
+                             orphan_age=None).latest_step()
+
+
+def _verify_bit_for_bit(root, step):
+    """CRC-verify step's checkpoint, then compare every leaf byte-wise
+    against the replayed oracle."""
+    d = os.path.join(root, f"step_{int(step):08d}")
+    verify_checkpoint(d, integrity="full")
+    w0, b0 = init_state()
+    we, be = advance(w0, b0, int(step))
+    w = read_leaf(d, "w", integrity="off")
+    b = read_leaf(d, "bias", integrity="off")
+    if w.tobytes() != we.tobytes() or b.tobytes() != be.tobytes():
+        raise DrillFailure(
+            f"step {step} restored state is not bit-identical to the "
+            f"oracle replay (max |w-we| = {abs(w - we).max()})")
+
+
+def run_drill(root, generations, total_steps, *, barrier_timeout=6.0,
+              gen_timeout=120.0, orphan_age=None, log_dir=None):
+    """Run a multi-generation fault drill.
+
+    ``generations``: list of ``(world_size, KillSpec-or-None)``.  Each
+    generation is a full fleet launch sharing the checkpoint ``root``;
+    a generation with a kill is expected to end with the victim
+    SIGKILLed (rc ``-9``) and every survivor exiting
+    ``EXIT_SAVE_FAILED`` after its commit barrier names the dead rank
+    — after which the newest committed step must equal the kill's
+    :meth:`KillSpec.expected_commit` and verify bit-for-bit.  The last
+    generation should have no kill: it must run to ``total_steps`` with
+    every rank exiting 0, resuming elastically when its world size
+    differs from the writer's.
+
+    Returns a per-generation report (worlds, return codes, newest
+    committed step) for further assertions.
+    """
+    master = TCPStore("127.0.0.1", 0, is_master=True)
+    report = []
+    try:
+        for g, (world, kill) in enumerate(generations):
+            run_id = f"g{g}-{uuid.uuid4().hex[:6]}"
+            procs = [
+                spawn_worker(
+                    r, world, root=root, port=master.port,
+                    total_steps=total_steps, run_id=run_id,
+                    barrier_timeout=barrier_timeout, kill=kill,
+                    orphan_age=orphan_age,
+                    log_path=(os.path.join(log_dir, f"gen{g}_rank{r}.log")
+                              if log_dir else None))
+                for r in range(world)
+            ]
+            rcs = _wait_fleet(procs, gen_timeout)
+            latest = _latest_step(root)
+            report.append({"world": world, "rcs": rcs, "latest": latest})
+            if kill is None:
+                if any(rc != 0 for rc in rcs):
+                    raise DrillFailure(
+                        f"generation {g} (no kill) exit codes {rcs}")
+                if latest != total_steps:
+                    raise DrillFailure(
+                        f"generation {g} finished but newest committed "
+                        f"step is {latest}, wanted {total_steps}")
+            else:
+                if rcs[kill.rank] != -signal.SIGKILL:
+                    raise DrillFailure(
+                        f"generation {g}: victim rank {kill.rank} "
+                        f"exited {rcs[kill.rank]}, expected SIGKILL")
+                survivors = [rc for r, rc in enumerate(rcs)
+                             if r != kill.rank]
+                if any(rc != EXIT_SAVE_FAILED for rc in survivors):
+                    raise DrillFailure(
+                        f"generation {g}: survivor exit codes "
+                        f"{survivors}, expected all {EXIT_SAVE_FAILED}")
+                want = kill.expected_commit()
+                if (latest or 0) != want:
+                    raise DrillFailure(
+                        f"generation {g}: newest committed step is "
+                        f"{latest} after a {kill.phase} kill at step "
+                        f"{kill.step}, expected {want}")
+            if latest is not None:
+                _verify_bit_for_bit(root, latest)
+    finally:
+        reap_all()
+        master.close()
+    return report

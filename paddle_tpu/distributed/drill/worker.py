@@ -1,0 +1,147 @@
+"""Drill worker subprocess: a deterministic mini training loop under
+CheckpointManager.
+
+Run as ``python -m paddle_tpu.distributed.drill.worker`` with the
+``DRILL_*`` environment contract (set by :mod:`.runner`):
+
+ - ``DRILL_RANK`` / ``DRILL_WORLD``: this process's rank and the fleet
+   size of THIS generation (may differ from the generation that wrote
+   the checkpoint being resumed — that's the elastic drill).
+ - ``DRILL_STORE_PORT``: TCPStore master (hosted by the runner) on
+   127.0.0.1.
+ - ``DRILL_CKPT``: CheckpointManager root directory.
+ - ``DRILL_TOTAL_STEPS``: run until this step is committed, then exit 0.
+ - ``DRILL_RUN_ID``: per-generation id isolating commit-barrier keys —
+   a relaunch must never count a dead generation's barrier arrivals.
+ - ``DRILL_BARRIER_TIMEOUT``: seconds before a commit barrier gives up.
+ - ``DRILL_ELASTIC``: "1" → restore accepts partial marker sets.
+ - ``DRILL_ORPHAN_AGE``: run the staging janitor on startup with this
+   max age (seconds); unset → no sweep.
+ - ``DRILL_KILL_*``: see :mod:`.injector`.
+
+The "model" is a (12, 4) fp32 array row-partitioned across ranks via
+:class:`~paddle_tpu.distributed.checkpoint.HostLocalShard` (12 divides
+evenly for worlds 1/2/3/4/6) plus a replicated ``bias`` leaf whose
+overlapping windows exercise the elastic any-one-covers-it rule.  Each
+step applies the same elementwise fp32 update to every element, so the
+state after step N is bit-identical for ANY partitioning and the runner
+replays an exact oracle (:func:`advance`).
+
+Exit codes: 0 = reached ``DRILL_TOTAL_STEPS``; 17 = a save failed
+cleanly (barrier timeout after a peer died — the survivor's correct
+move is to exit and await relaunch); SIGKILL death reports -9 to the
+runner.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+import numpy as np
+
+ROWS, COLS = 12, 4
+EXIT_SAVE_FAILED = 17
+
+logger = logging.getLogger("paddle_tpu.drill.worker")
+
+
+def window(rank, world):
+    """This rank's row window [lo, hi) of the global (ROWS, COLS) state."""
+    return rank * ROWS // world, (rank + 1) * ROWS // world
+
+
+def init_state():
+    """Step-0 global state: (w, bias)."""
+    w = (np.arange(ROWS * COLS, dtype=np.float32) + 1.0).reshape(ROWS, COLS)
+    bias = np.linspace(-1.0, 1.0, COLS, dtype=np.float32)
+    return w, bias
+
+
+def advance(w, bias, steps=1):
+    """The per-step update — elementwise fp32, therefore bit-identical
+    across any row partitioning (the oracle property every drill
+    assertion rests on)."""
+    for _ in range(steps):
+        w = w * np.float32(1.01) + np.float32(0.125)
+        bias = bias * np.float32(0.99) - np.float32(0.0625)
+    return w, bias
+
+
+def main():
+    env = os.environ
+    rank = int(env["DRILL_RANK"])
+    world = int(env["DRILL_WORLD"])
+    total = int(env["DRILL_TOTAL_STEPS"])
+    root = env["DRILL_CKPT"]
+    port = int(env["DRILL_STORE_PORT"])
+    run_id = env.get("DRILL_RUN_ID", "0")
+    barrier_timeout = float(env.get("DRILL_BARRIER_TIMEOUT", "10"))
+    elastic = env.get("DRILL_ELASTIC", "1") == "1"
+    orphan_age = env.get("DRILL_ORPHAN_AGE")
+
+    logging.basicConfig(
+        level=logging.INFO, stream=sys.stderr,
+        format=f"[drill rank {rank}] %(levelname)s %(message)s")
+
+    # arm the scripted kill BEFORE any checkpoint machinery runs
+    from . import injector
+    armed = injector.install_from_env()
+    if armed:
+        logger.info("armed kill: phase=%s step=%s",
+                    env.get("DRILL_KILL_PHASE"),
+                    env.get("DRILL_KILL_STEP"))
+
+    from ...core import TCPStore
+    from ..checkpoint import HostLocalShard, read_leaf
+    from ..checkpoint_manager import CheckpointManager
+
+    store = None
+    if world > 1:
+        store = TCPStore("127.0.0.1", port, is_master=False,
+                         timeout=barrier_timeout + 30.0)
+    mgr = CheckpointManager(
+        root, keep_last_n=None, store=store, world_size=world,
+        process_index=rank, durable=True, run_id=run_id,
+        barrier_timeout=barrier_timeout, elastic=elastic,
+        orphan_age=float(orphan_age) if orphan_age else None)
+
+    lo, hi = window(rank, world)
+    start = mgr.latest_step()
+    if start is None:
+        start = 0
+        w_full, bias = init_state()
+        w = w_full[lo:hi]
+        logger.info("fresh start")
+    else:
+        # numpy-only window restore: re-shards whatever world size
+        # wrote the checkpoint into THIS rank's rows
+        d = mgr.step_dir(start)
+        w = read_leaf(d, "w", window=[[lo, hi], [0, COLS]],
+                      elastic=elastic)
+        bias = read_leaf(d, "bias", elastic=elastic)
+        logger.info("resumed from committed step %d", start)
+
+    for step in range(start + 1, total + 1):
+        w = w * np.float32(1.01) + np.float32(0.125)
+        bias = bias * np.float32(0.99) - np.float32(0.0625)
+        state = {
+            "w": HostLocalShard(w, window=[[lo, hi], [0, COLS]],
+                                global_shape=(ROWS, COLS)),
+            "bias": HostLocalShard(bias),  # replicated: full window
+        }
+        try:
+            mgr.save(step, state)
+        except BaseException as e:
+            # a dead peer shows up here as a barrier/promote timeout
+            # naming the missing ranks; exiting cleanly IS the correct
+            # survivor behavior — the relaunch resumes from the newest
+            # committed step
+            logger.error("save of step %d failed: %s", step, e)
+            sys.exit(EXIT_SAVE_FAILED)
+        logger.info("committed step %d", step)
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -321,6 +321,13 @@ class TrainingTelemetry:
         self._m_ckpt_gc = r.counter(
             "pt_checkpoint_gc_deleted_total",
             "checkpoint directories removed by retention GC")
+        self._m_ckpt_barrier_s = r.histogram(
+            "pt_checkpoint_barrier_wait_seconds",
+            "time spent in the multi-host commit barrier", ("status",))
+        self._m_ckpt_swept = r.counter(
+            "pt_checkpoint_staging_orphans_swept_total",
+            "orphaned staging/partial-commit dirs removed by the "
+            "startup janitor")
         self._m_hb = r.counter(
             "pt_elastic_heartbeats_total", "elastic store heartbeats",
             ("status",))
@@ -426,6 +433,27 @@ class TrainingTelemetry:
         if not self.enabled or not deleted:
             return
         self._m_ckpt_gc.inc(deleted)
+
+    def record_barrier_wait(self, seconds, ok=True):
+        """Time one process spent in the checkpoint commit barrier —
+        a stalled barrier (straggler or dead rank) shows up here long
+        before the timeout names the missing ranks."""
+        if not self.enabled:
+            return
+        self._m_ckpt_barrier_s.observe(seconds,
+                                       status="ok" if ok else "timeout")
+        if not ok and self.sink is not None:
+            self.sink.emit("checkpoint_barrier_timeout",
+                           duration_sec=round(float(seconds), 6))
+
+    def record_staging_sweep(self, n):
+        """The startup janitor removed ``n`` orphaned staging dirs /
+        partial marker sets (crash debris of dead save attempts)."""
+        if not self.enabled or not n:
+            return
+        self._m_ckpt_swept.inc(n)
+        if self.sink is not None:
+            self.sink.emit("checkpoint_staging_swept", count=int(n))
 
     def record_async_save_failure(self, step, error):
         """Async writer failed — the manager re-raises it on the next

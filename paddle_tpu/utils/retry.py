@@ -90,16 +90,19 @@ def retry_call(fn, *args, retry_on=(Exception,), deadline=None,
             sleep(d)
 
 
-def wait_until(pred, timeout=None, *, desc=None, base=0.02, factor=1.5,
-               max_delay=0.5, jitter=0.25, rng=None, sleep=time.sleep,
-               clock=time.monotonic):
+def wait_until(pred, timeout=None, *, desc=None, diag=None, base=0.02,
+               factor=1.5, max_delay=0.5, jitter=0.25, rng=None,
+               sleep=time.sleep, clock=time.monotonic):
     """Poll ``pred()`` with jittered backoff until it returns a truthy
     value (returned), or ``timeout`` seconds elapse.
 
     On timeout raises :class:`TimeoutError` naming ``desc`` (or the
     predicate) — a wait that can hang forever with no diagnostic is how
-    one dead rank silently wedges a whole job.  ``timeout=None`` polls
-    forever (the caller owns liveness, e.g. a supervising loop).
+    one dead rank silently wedges a whole job.  ``diag``, when given, is
+    called once at timeout and its string return is appended to the
+    error (e.g. which barrier ranks never arrived); a failing diag never
+    masks the timeout itself.  ``timeout=None`` polls forever (the
+    caller owns liveness, e.g. a supervising loop).
     """
     delays = backoff_delays(base=base, factor=factor, max_delay=max_delay,
                             jitter=jitter, deadline=timeout, rng=rng,
@@ -111,6 +114,13 @@ def wait_until(pred, timeout=None, *, desc=None, base=0.02, factor=1.5,
         d = next(delays, None)
         if d is None:
             what = desc or getattr(pred, "__name__", repr(pred))
+            extra = ""
+            if diag is not None:
+                try:
+                    extra = str(diag() or "")
+                except Exception as e:  # diagnostics must not mask timeout
+                    extra = f"(diagnostic probe failed: {e})"
             raise TimeoutError(
-                f"wait_until: {what} still false after {timeout}s")
+                f"wait_until: {what} still false after {timeout}s"
+                + (f" — {extra}" if extra else ""))
         sleep(d)

@@ -1,0 +1,16 @@
+"""Drill-suite fixtures: the no-leaked-children guarantee.
+
+Every worker subprocess a drill spawns is registered in
+``paddle_tpu.distributed.drill.runner._LIVE``; this autouse reaper
+SIGKILLs and waits any stragglers after EVERY test in this directory,
+no matter how the test failed — a hung drill must never outlive its
+test or poison a rerun."""
+import pytest
+
+from paddle_tpu.distributed.drill import runner as _runner
+
+
+@pytest.fixture(autouse=True)
+def _reap_drill_children():
+    yield
+    _runner.reap_all()

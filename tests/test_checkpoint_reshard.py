@@ -1,0 +1,342 @@
+"""Elastic resume (world-size M→N resharding), staged multi-host
+commit, barrier diagnostics, the staging janitor, and their metrics.
+
+In-process, fast (tier-1) counterpart to the real-SIGKILL drills in
+tests/drills/: the same protocol surfaces exercised through threads,
+fabricated directories and a real TCPStore — no subprocesses."""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.observability as obs
+from paddle_tpu.core import TCPStore
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.distributed.checkpoint import (
+    CheckpointCorruptError, HostLocalShard, ReshardError, read_leaf,
+    store_barrier, sweep_staging, verify_checkpoint)
+from paddle_tpu.distributed.checkpoint_manager import CheckpointManager
+
+ROWS, COLS = 12, 4
+
+
+def _global_state(seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(ROWS, COLS).astype(np.float32),
+            rng.randn(COLS).astype(np.float32))
+
+
+def _save_world(path, world, w, bias):
+    """Store-less multi-host save: each rank writes its row window of
+    ``w`` plus the replicated ``bias`` (overlapping full windows)."""
+    for rank in range(world):
+        lo, hi = rank * ROWS // world, (rank + 1) * ROWS // world
+        state = {
+            "w": HostLocalShard(w[lo:hi], window=[[lo, hi], [0, COLS]],
+                                global_shape=(ROWS, COLS)),
+            "bias": HostLocalShard(bias),
+        }
+        ckpt.save_sharded(state, path, process_index=rank,
+                          world_size=world, durable=False)
+
+
+# -- HostLocalShard contract -------------------------------------------------
+
+def test_hostlocalshard_validates_window():
+    with pytest.raises(ValueError, match="window rank"):
+        HostLocalShard(np.zeros((2, 3)), window=[[0, 2]],
+                       global_shape=(4, 3))
+    with pytest.raises(ValueError, match="out of bounds"):
+        HostLocalShard(np.zeros((2, 3)), window=[[3, 5], [0, 3]],
+                       global_shape=(4, 3))
+    with pytest.raises(ValueError, match="does not fill"):
+        HostLocalShard(np.zeros((2, 3)), window=[[0, 3], [0, 3]],
+                       global_shape=(4, 3))
+
+
+# -- M -> N resharding -------------------------------------------------------
+
+@pytest.mark.parametrize("m,n", [(2, 1), (1, 2), (3, 2), (2, 3)])
+def test_reshard_roundtrip_across_world_sizes(tmp_path, m, n):
+    """A checkpoint written by M processes hands an N-process fleet its
+    exact rows back — the coverage-window stitching on per-shard
+    manifests, no jax involved."""
+    w, bias = _global_state()
+    path = str(tmp_path / "step")
+    _save_world(path, m, w, bias)
+    verify_checkpoint(path, integrity="full")
+    for rank in range(n):
+        lo, hi = rank * ROWS // n, (rank + 1) * ROWS // n
+        got = read_leaf(path, "w", window=[[lo, hi], [0, COLS]])
+        assert got.tobytes() == w[lo:hi].tobytes()
+    assert read_leaf(path, "bias").tobytes() == bias.tobytes()
+
+
+def test_load_sharded_elastic_full_tree(tmp_path):
+    w, bias = _global_state()
+    path = str(tmp_path / "step")
+    _save_world(path, 2, w, bias)
+    out = ckpt.load_sharded(path, elastic=True)
+    assert np.asarray(out["w"]).tobytes() == w.tobytes()
+    assert np.asarray(out["bias"]).tobytes() == bias.tobytes()
+
+
+def test_overlapping_windows_any_one_covers(tmp_path):
+    """Replicated leaves are saved by every rank with full overlapping
+    windows; elastic resume must be able to stitch from any survivor."""
+    w, bias = _global_state()
+    path = str(tmp_path / "step")
+    _save_world(path, 3, w, bias)
+    # lose ranks 1 and 2: bias still fully covered by rank 0's window
+    os.remove(os.path.join(path, "COMMIT.1"))
+    os.remove(os.path.join(path, "COMMIT.2"))
+    got = read_leaf(path, "bias", elastic=True)
+    assert got.tobytes() == bias.tobytes()
+
+
+def test_gapped_windows_raise_reshard_error(tmp_path):
+    """A window set with a hole must raise — never silently zero-fill —
+    and the error names the committed ranks."""
+    w, bias = _global_state()
+    path = str(tmp_path / "step")
+    _save_world(path, 3, w, bias)
+    os.remove(os.path.join(path, "COMMIT.1"))  # rows [4, 8) now gone
+    with pytest.raises(ReshardError, match=r"committed ranks \[0, 2\]"):
+        read_leaf(path, "w", elastic=True)
+    with pytest.raises(ReshardError):
+        ckpt.load_sharded(path, elastic=True)
+    # ReshardError subclasses CheckpointCorruptError so resume-latest
+    # fallback machinery treats the step as unusable, not fatal
+    assert issubclass(ReshardError, CheckpointCorruptError)
+
+
+def test_world_size_mismatch_error_is_actionable(tmp_path):
+    """Strict load of a partial marker set must name the committed
+    ranks, the expected set, and point at the elastic reshard path."""
+    w, bias = _global_state()
+    path = str(tmp_path / "step")
+    _save_world(path, 2, w, bias)
+    os.remove(os.path.join(path, "COMMIT.1"))
+    with pytest.raises(CheckpointCorruptError) as ei:
+        ckpt.load_sharded(path)
+    msg = str(ei.value)
+    assert "ranks [0]" in msg
+    assert "expects ranks [0, 1]" in msg
+    assert "missing ranks [1]" in msg
+    assert "elastic=True" in msg
+
+
+def test_elastic_never_reads_uncommitted_rank_data(tmp_path):
+    """An uncommitted rank's shard files may be torn — elastic stitching
+    must ignore them even when they are present on disk."""
+    w, bias = _global_state()
+    path = str(tmp_path / "step")
+    _save_world(path, 2, w, bias)
+    os.remove(os.path.join(path, "COMMIT.1"))
+    # corrupt rank 1's (now uncommitted) shard file; a correct elastic
+    # reader never opens it, so only ReshardError may surface
+    for f in os.listdir(os.path.join(path, "data", "w")):
+        if f.startswith("1_"):
+            with open(os.path.join(path, "data", "w", f), "wb") as fh:
+                fh.write(b"garbage")
+    with pytest.raises(ReshardError):
+        read_leaf(path, "w", elastic=True)
+
+
+# -- staged multi-host commit over a real store ------------------------------
+
+def test_staged_commit_two_ranks_threads(tmp_path):
+    """Both ranks stage into ONE shared tmp dir, barrier, rank 0
+    promotes atomically: the final dir is fully committed and no
+    staging debris survives a successful save."""
+    w, bias = _global_state()
+    root = str(tmp_path / "run")
+    master = TCPStore("127.0.0.1", 0, is_master=True)
+    errs = []
+
+    def one_rank(rank):
+        try:
+            store = TCPStore("127.0.0.1", master.port, is_master=False)
+            mgr = CheckpointManager(root, keep_last_n=None, store=store,
+                                    world_size=2, process_index=rank,
+                                    durable=False, run_id="t-reshard",
+                                    barrier_timeout=30.0)
+            lo, hi = rank * ROWS // 2, (rank + 1) * ROWS // 2
+            state = {"w": HostLocalShard(
+                w[lo:hi], window=[[lo, hi], [0, COLS]],
+                global_shape=(ROWS, COLS))}
+            mgr.save(7, state)
+        except BaseException as e:  # pragma: no cover - failure path
+            errs.append((rank, e))
+
+    ts = [threading.Thread(target=one_rank, args=(r,)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    master.close()
+    assert not errs, errs
+    step = os.path.join(root, "step_00000007")
+    verify_checkpoint(step, integrity="full")
+    assert read_leaf(step, "w").tobytes() == w.tobytes()
+    assert not [n for n in os.listdir(root) if ".tmp." in n]
+    # markers record the staging nonce (the promote-safety signal)
+    mk = json.load(open(os.path.join(step, "COMMIT.0")))
+    assert mk.get("nonce")
+
+
+def test_store_barrier_timeout_names_missing_ranks():
+    master = TCPStore("127.0.0.1", 0, is_master=True)
+    try:
+        with pytest.raises(TimeoutError) as ei:
+            store_barrier(master, "b/x", world=3, rank=0, timeout=0.4)
+        msg = str(ei.value)
+        assert "missing ranks [1, 2]" in msg
+        assert "arrived: [0]" in msg
+    finally:
+        master.close()
+
+
+def test_store_barrier_without_rank_keeps_count_only_diag():
+    """rank=None is the legacy contract: stores that only implement
+    ``add`` (no per-rank keys) must still barrier."""
+
+    class _AddOnly:
+        def __init__(self):
+            self.n = 0
+
+        def add(self, key, amount):
+            self.n += amount
+            return self.n
+
+    s = _AddOnly()
+    s.n = 1  # one peer already arrived
+    store_barrier(s, "k", world=2, timeout=5.0)
+
+
+# -- janitor -----------------------------------------------------------------
+
+def test_sweep_staging_age_gate_and_newest_spared(tmp_path):
+    root = str(tmp_path)
+    w, bias = _global_state()
+    _save_world(os.path.join(root, "step_00000001"), 1, w, bias)
+    old = time.time() - 7200
+    for name, aged in [("step_00000002.tmp.aaaa", True),
+                       ("step_00000002.old.bbbb", True),
+                       ("step_00000003.tmp.cccc", False)]:
+        d = os.path.join(root, name, "data")
+        os.makedirs(d)
+        if aged:
+            os.utime(os.path.join(root, name), (old, old))
+    # an aged directory that is NOT checkpoint-shaped must survive
+    os.makedirs(os.path.join(root, "notes"))
+    os.utime(os.path.join(root, "notes"), (old, old))
+    n = sweep_staging(root, max_age=3600.0)
+    assert n == 2
+    left = sorted(os.listdir(root))
+    assert "step_00000003.tmp.cccc" in left          # newest spared
+    assert "step_00000001" in left                   # committed spared
+    assert "notes" in left                           # not ours to touch
+    assert "step_00000002.tmp.aaaa" not in left
+    assert "step_00000002.old.bbbb" not in left
+
+
+def test_sweep_staging_removes_aged_partial_marker_dirs(tmp_path):
+    """Store-less in-place saves that died mid-fleet leave a partial
+    marker set in the FINAL dir; aged ones are debris."""
+    root = str(tmp_path)
+    w, bias = _global_state()
+    path = os.path.join(root, "step_00000004")
+    lo, hi = 0, ROWS // 2
+    ckpt.save_sharded(
+        {"w": HostLocalShard(w[lo:hi], window=[[lo, hi], [0, COLS]],
+                             global_shape=(ROWS, COLS))},
+        path, process_index=0, world_size=2, durable=False)
+    assert not ckpt.is_committed(path)
+    old = time.time() - 7200
+    os.utime(path, (old, old))
+    assert sweep_staging(root, max_age=3600.0) == 1
+    assert not os.path.exists(path)
+    # a FRESH partial dir (possibly a fleet mid-save) is left alone
+    ckpt.save_sharded(
+        {"w": HostLocalShard(w[lo:hi], window=[[lo, hi], [0, COLS]],
+                             global_shape=(ROWS, COLS))},
+        path, process_index=0, world_size=2, durable=False)
+    assert sweep_staging(root, max_age=3600.0) == 0
+    assert os.path.exists(path)
+
+
+def test_sweep_staging_missing_root_is_noop(tmp_path):
+    assert sweep_staging(str(tmp_path / "nope")) == 0
+
+
+# -- CheckpointManager elastic wiring ---------------------------------------
+
+def test_manager_elastic_restore_and_fallback(tmp_path):
+    root = str(tmp_path / "run")
+    os.makedirs(root)
+    w1, b1 = _global_state(1)
+    w2, b2 = _global_state(2)
+    _save_world(os.path.join(root, "step_00000001"), 1, w1, b1)
+    _save_world(os.path.join(root, "step_00000002"), 2, w2, b2)
+    # step 2 loses rank 1: a genuine hole in "w"
+    os.remove(os.path.join(root, "step_00000002", "COMMIT.1"))
+    mgr = CheckpointManager(root, keep_last_n=None, elastic=True,
+                            orphan_age=None)
+    assert mgr.valid_steps() == [1]  # holey step 2 is not a resume point
+    state, step = mgr.restore_latest()
+    assert step == 1
+    assert np.asarray(state["w"]).tobytes() == w1.tobytes()
+    # strict manager agrees step 2 is unusable
+    strict = CheckpointManager(root, keep_last_n=None, orphan_age=None)
+    assert strict.valid_steps() == [1]
+
+
+def test_manager_init_runs_janitor(tmp_path):
+    root = str(tmp_path / "run")
+    os.makedirs(os.path.join(root, "step_00000001.tmp.aaaa", "data"))
+    os.makedirs(os.path.join(root, "step_00000002.tmp.bbbb", "data"))
+    old = time.time() - 7200
+    os.utime(os.path.join(root, "step_00000001.tmp.aaaa"), (old, old))
+    CheckpointManager(root, orphan_age=3600.0)
+    assert not os.path.exists(
+        os.path.join(root, "step_00000001.tmp.aaaa"))
+    assert os.path.exists(os.path.join(root, "step_00000002.tmp.bbbb"))
+
+
+# -- observability ----------------------------------------------------------
+
+@pytest.fixture
+def _tel():
+    obs.reset()
+    tel = obs.get_telemetry().enable(compile_watch=False)
+    yield tel
+    obs.reset()
+
+
+def test_barrier_and_sweep_metrics(tmp_path, _tel):
+    master = TCPStore("127.0.0.1", 0, is_master=True)
+    try:
+        store_barrier(master, "m/ok", world=1, rank=0, timeout=5.0)
+        with pytest.raises(TimeoutError):
+            store_barrier(master, "m/t", world=2, rank=0, timeout=0.2)
+    finally:
+        master.close()
+    root = str(tmp_path)
+    os.makedirs(os.path.join(root, "a.tmp.1111", "data"))
+    os.makedirs(os.path.join(root, "b.tmp.2222", "data"))
+    old = time.time() - 7200
+    for n in ("a.tmp.1111", "b.tmp.2222"):
+        os.utime(os.path.join(root, n), (old, old))
+    assert sweep_staging(root, max_age=3600.0) == 1
+    text = _tel.registry.prometheus_text()
+    assert 'pt_checkpoint_barrier_wait_seconds_count{status="ok"} 1' \
+        in text
+    assert 'pt_checkpoint_barrier_wait_seconds_count{status="timeout"}' \
+        in text
+    assert "pt_checkpoint_staging_orphans_swept_total 1" in text
