@@ -31,7 +31,12 @@ __all__ = [
     "native_available", "RecordEvent", "tracer_enable", "tracer_disable",
     "tracer_dump", "tracer_clear", "tracer_events", "HostBufferPool",
     "host_memory_stats", "WorkQueue", "TCPStore",
+    "DurableTCPStoreServer", "StoreWAL", "replay_wal", "GENERATION_KEY",
 ]
+
+from .store_server import (  # noqa: E402  (stdlib-only, no cycle)
+    GENERATION_KEY, DurableTCPStoreServer, StoreWAL, replay_wal,
+)
 
 _lib = None
 _lib_err = None
@@ -378,19 +383,35 @@ class TCPStore:
     loopback client); workers connect with ``is_master=False``. ``get``
     blocks until the key is set (the reference's semantics); ``add`` is the
     atomic counter used for barriers.
+
+    ``is_master=True, wal_path=...`` starts the pure-Python
+    :class:`~paddle_tpu.core.store_server.DurableTCPStoreServer` instead
+    of the native one: every mutation is journaled to the WAL and a
+    respawned master replays it, restoring keys / counters / barrier
+    arrivals and bumping the ``store/generation`` fencing key.  The
+    loopback client is the native ctypes client either way — the wire
+    protocol is identical.
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 is_master: bool = False, timeout: float = 30.0):
+                 is_master: bool = False, timeout: float = 30.0,
+                 wal_path: str | None = None):
         lib = _load()
         self._lib = lib
         self._server = None
+        self._py_server = None
         self._client = None
         if lib is None:
             raise RuntimeError("TCPStore requires the native core "
                                f"(unavailable: {_lib_err}); use "
                                "jax.distributed rendezvous instead")
-        if is_master:
+        if is_master and wal_path is not None:
+            from . import store_server as _ss
+            self._py_server = _ss.DurableTCPStoreServer(
+                port=port, wal_path=wal_path)
+            port = self._py_server.port
+            host = "127.0.0.1"
+        elif is_master:
             self._server = lib.pt_store_server_start(port)
             if not self._server:
                 raise OSError(f"cannot bind TCPStore on port {port}")
@@ -426,7 +447,9 @@ class TCPStore:
         rc = self._lib.pt_store_set(self._client, key.encode(), value,
                                     len(value))
         if rc != 0:
-            raise ConnectionError("TCPStore set failed")
+            raise ConnectionError(
+                f"TCPStore set failed for key '{key}' at "
+                f"{self.host}:{self.port} (master down or unreachable)")
 
     def get(self, key: str, wait: bool = True,
             timeout: float | None = None) -> bytes | None:
@@ -448,7 +471,10 @@ class TCPStore:
                                                buf, len(buf), 0)
                 return (buf.raw[:n],)  # 1-tuple: b"" is a real value
             if n != -1:
-                raise ConnectionError("TCPStore get failed")
+                raise ConnectionError(
+                    f"TCPStore get failed for key '{key}' at "
+                    f"{self.host}:{self.port} (master down or "
+                    f"unreachable)")
             return None
 
         got = _poll()
@@ -465,8 +491,18 @@ class TCPStore:
     def add(self, key: str, delta: int = 1) -> int:
         v = self._lib.pt_store_add(self._client, key.encode(), delta)
         if v == -(2**63):
-            raise ConnectionError("TCPStore add failed")
+            raise ConnectionError(
+                f"TCPStore add failed for key '{key}' at "
+                f"{self.host}:{self.port} (master down or unreachable)")
         return int(v)
+
+    @property
+    def generation(self) -> int | None:
+        """Master generation when served by a durable (WAL) server;
+        ``None`` on workers and volatile masters."""
+        if self._py_server is not None:
+            return self._py_server.generation
+        return None
 
     def delete(self, key: str) -> None:
         self._lib.pt_store_del(self._client, key.encode())
@@ -490,6 +526,9 @@ class TCPStore:
         if self._server:
             self._lib.pt_store_server_stop(self._server)
             self._server = None
+        if self._py_server is not None:
+            self._py_server.stop()
+            self._py_server = None
 
     def __del__(self):
         try:

@@ -1,0 +1,338 @@
+"""Durable TCPStore master: WAL-backed, wire-compatible, pure Python.
+
+The native TCPStore server (``native/store.cc``) keeps its keys,
+counters and barrier state in process memory — SIGKILL the master and
+every barrier, heartbeat and staged commit in the job wedges on a store
+that no longer remembers them.  This module is the durable master:
+
+ - :class:`StoreWAL` journals every mutation (``set`` / ``add`` /
+   ``delete``) as one JSON line in a per-run append-only file, fsynced
+   before the op is acknowledged; :func:`replay_wal` rebuilds the
+   key-value map on restart, ignoring a torn tail line (the bytes a
+   mid-``write(2)`` death plausibly leaves behind).
+ - :class:`DurableTCPStoreServer` speaks the exact wire protocol of
+   ``store.cc`` (the native ctypes *client* connects to it unchanged),
+   applies mutations through the WAL, and — when durable — maintains a
+   monotonic **generation** under :data:`GENERATION_KEY`: replay bumps
+   it by one, so a respawned master advertises ``gen+1`` while an
+   amnesiac one (WAL lost / disabled) advertises nothing.  Clients
+   (``distributed.resilient_store.ResilientStore``) fence on it: a
+   reconnect that observes a LOWER generation than ever seen before is
+   talking to a master that forgot their barriers, and must fail
+   loudly rather than rendezvous against empty state.
+
+Stdlib-only on purpose: the drill supervisor respawns this server via
+``drill/store_master.py`` with a direct file import, so a master
+restart costs a Python interpreter start — not a jax import.
+
+Wire protocol (little-endian, mirrors ``store.cc``):
+  request:  u8 op | u32 klen | key bytes | u32 vlen | value bytes
+  ops: 1=SET 2=GET(nonblock) 3=WAIT(block until set) 4=ADD(v=i64 delta)
+       5=DEL 6=NUMKEYS
+  reply: i32 status(0 ok, -1 missing) | u32 vlen | value bytes
+"""
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import os
+import socket
+import struct
+import threading
+
+__all__ = ["GENERATION_KEY", "StoreWAL", "replay_wal",
+           "DurableTCPStoreServer"]
+
+logger = logging.getLogger(__name__)
+
+# ASCII-decimal master generation, bumped on every WAL replay; absent on
+# non-durable masters (native server, wal_path=None) so fencing stays
+# inert where there is nothing durable to fence against.
+GENERATION_KEY = "store/generation"
+
+_I64 = struct.Struct("<q")
+
+
+def _counter_add(kv, key, delta):
+    """The ADD op's 8-byte little-endian counter semantics, shared by
+    the live server and WAL replay so both agree bit-for-bit."""
+    cur = 0
+    old = kv.get(key)
+    if old is not None and len(old) == 8:
+        cur = _I64.unpack(old)[0]
+    cur += int(delta)
+    kv[key] = _I64.pack(cur)
+    return cur
+
+
+def _apply_record(kv, rec):
+    """Apply one WAL record to ``kv`` (replay = re-run the mutation)."""
+    op = rec.get("op")
+    if op == "set":
+        kv[rec["k"]] = base64.b64decode(rec["v"])
+    elif op == "add":
+        _counter_add(kv, rec["k"], rec["d"])
+    elif op == "del":
+        kv.pop(rec["k"], None)
+    else:
+        raise ValueError(f"unknown WAL op {op!r}")
+
+
+def replay_wal(path):
+    """Rebuild the key-value map from a WAL file.
+
+    A torn tail — the final line missing its newline or not parsing as
+    JSON (the debris of a master SIGKILLed mid-append) — ends the
+    replay at the last intact record instead of failing it; every
+    acknowledged mutation was fsynced as a complete line, so only an
+    unacknowledged trailing op can be torn.  Returns ``{}`` when the
+    file does not exist.
+    """
+    kv: dict[str, bytes] = {}
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except FileNotFoundError:
+        return kv
+    lines = raw.split(b"\n")
+    # no trailing newline -> the final segment is a torn, unacked write
+    torn = lines.pop() if lines and lines[-1] != b"" else None
+    for i, line in enumerate(lines):
+        if not line:
+            continue
+        try:
+            _apply_record(kv, json.loads(line))
+        except (ValueError, KeyError, TypeError) as e:
+            # mid-file damage: stop at the last intact prefix — the
+            # records after a corrupt line may depend on lost state
+            logger.warning("store WAL %s: stopping replay at corrupt "
+                           "line %d: %s", path, i + 1, e)
+            break
+    if torn:
+        logger.warning("store WAL %s: ignoring torn tail (%d bytes, "
+                       "master died mid-append)", path, len(torn))
+    return kv
+
+
+class StoreWAL:
+    """Append-only mutation journal; one fsynced JSON line per op."""
+
+    def __init__(self, path, fsync=True):
+        self.path = path
+        self.fsync = fsync
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        self._f = open(path, "ab")
+        self._lock = threading.Lock()
+
+    def _append(self, rec):
+        data = json.dumps(rec, separators=(",", ":")).encode() + b"\n"
+        with self._lock:
+            self._f.write(data)
+            self._f.flush()
+            if self.fsync:
+                os.fsync(self._f.fileno())
+
+    def record_set(self, key, value):
+        self._append({"op": "set", "k": key,
+                      "v": base64.b64encode(value).decode("ascii")})
+
+    def record_add(self, key, delta):
+        self._append({"op": "add", "k": key, "d": int(delta)})
+
+    def record_delete(self, key):
+        self._append({"op": "del", "k": key})
+
+    def close(self):
+        with self._lock:
+            try:
+                self._f.close()
+            except OSError as e:
+                logger.warning("store WAL %s: close failed: %s",
+                               self.path, e)
+
+
+class DurableTCPStoreServer:
+    """Wire-compatible TCPStore master with optional WAL durability.
+
+    ``wal_path=None`` behaves like the native server (volatile, no
+    generation key).  With a WAL, construction replays the journal,
+    bumps the generation, and journals every subsequent mutation before
+    acknowledging it — so a respawn restores keys, ADD counters and
+    barrier arrival state exactly, and advertises a strictly higher
+    generation than any client has seen.
+    """
+
+    def __init__(self, port=0, host="127.0.0.1", wal_path=None,
+                 wal_fsync=True):
+        self._kv = replay_wal(wal_path) if wal_path else {}
+        self._wal = StoreWAL(wal_path, fsync=wal_fsync) if wal_path \
+            else None
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._stop = False
+        self.generation = None
+        if self._wal is not None:
+            prev = self._kv.get(GENERATION_KEY, b"0")
+            try:
+                gen = int(prev.decode("ascii") or 0) + 1
+            except (ValueError, UnicodeDecodeError):
+                logger.warning("store WAL %s: unparseable generation "
+                               "%r; restarting at 1", wal_path, prev)
+                gen = 1
+            self.generation = gen
+            value = str(gen).encode("ascii")
+            self._kv[GENERATION_KEY] = value
+            self._wal.record_set(GENERATION_KEY, value)
+        self._listen = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listen.bind((host, int(port)))
+        self._listen.listen(128)
+        self.host = host
+        self.port = self._listen.getsockname()[1]
+        self._conns: list[socket.socket] = []
+        self._threads: list[threading.Thread] = []
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="pt-store-accept", daemon=True)
+        self._accept_thread.start()
+
+    # -- plumbing -----------------------------------------------------------
+
+    @staticmethod
+    def _read_full(conn, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _accept_loop(self):
+        while True:
+            try:
+                conn, _addr = self._listen.accept()
+            except OSError:
+                return  # listener closed by stop()
+            with self._mu:
+                if self._stop:
+                    conn.close()
+                    return
+                self._conns.append(conn)
+                t = threading.Thread(target=self._serve_conn,
+                                     args=(conn,), daemon=True)
+                self._threads.append(t)
+            t.start()
+
+    def _serve_conn(self, conn):
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while True:
+                head = self._read_full(conn, 5)
+                if head is None:
+                    return
+                op, klen = struct.unpack("<BI", head)
+                key = self._read_full(conn, klen) if klen else b""
+                if key is None:
+                    return
+                vraw = self._read_full(conn, 4)
+                if vraw is None:
+                    return
+                (vlen,) = struct.unpack("<I", vraw)
+                val = self._read_full(conn, vlen) if vlen else b""
+                if val is None:
+                    return
+                status, out = self._handle(op, key.decode("utf-8"), val)
+                reply = struct.pack("<iI", status, len(out)) + out
+                conn.sendall(reply)
+        except OSError:
+            return  # peer died / stop() shut the socket down
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                # fd already gone (stop() raced the handler); nothing
+                # left to release
+                return
+
+    # -- op dispatch --------------------------------------------------------
+
+    def _handle(self, op, key, val):
+        """Returns (status, reply_bytes).  Mutations journal-then-apply
+        under the lock so the WAL and the live map never diverge."""
+        if op == 1:  # SET
+            with self._cv:
+                if self._wal is not None:
+                    self._wal.record_set(key, val)
+                self._kv[key] = val
+                self._cv.notify_all()
+            return 0, b""
+        if op == 2:  # GET (nonblocking)
+            with self._mu:
+                v = self._kv.get(key)
+            return (-1, b"") if v is None else (0, v)
+        if op == 3:  # WAIT (block until the key exists)
+            with self._cv:
+                self._cv.wait_for(
+                    lambda: self._stop or key in self._kv)
+                v = self._kv.get(key)
+            return (-1, b"") if v is None else (0, v)
+        if op == 4:  # ADD (atomic i64 counter)
+            delta = _I64.unpack(val)[0] if len(val) == 8 else 0
+            with self._cv:
+                if self._wal is not None:
+                    self._wal.record_add(key, delta)
+                cur = _counter_add(self._kv, key, delta)
+                self._cv.notify_all()
+            return 0, _I64.pack(cur)
+        if op == 5:  # DEL
+            with self._mu:
+                if self._wal is not None:
+                    self._wal.record_delete(key)
+                self._kv.pop(key, None)
+            return 0, b""
+        if op == 6:  # NUMKEYS
+            with self._mu:
+                n = len(self._kv)
+            return 0, _I64.pack(n)
+        return -1, b""
+
+    def num_keys(self):
+        with self._mu:
+            return len(self._kv)
+
+    def stop(self):
+        """Graceful shutdown (tests / clean exits — the drill's weapon
+        against this server is SIGKILL, which runs none of this)."""
+        with self._cv:
+            if self._stop:
+                return
+            self._stop = True
+            self._cv.notify_all()
+        try:
+            self._listen.close()
+        except OSError as e:
+            logger.debug("store server: listener close failed: %s", e)
+        with self._mu:
+            conns, self._conns = self._conns, []
+            threads, self._threads = self._threads, []
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                # already closed by its handler thread — the handler
+                # owns the close; nothing to unwind here
+                continue
+        if self._accept_thread.is_alive():
+            self._accept_thread.join(timeout=5.0)
+        for t in threads:
+            t.join(timeout=5.0)
+        if self._wal is not None:
+            self._wal.close()
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass

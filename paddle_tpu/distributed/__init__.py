@@ -53,6 +53,10 @@ from .checkpoint import (  # noqa: F401
 from .checkpoint_manager import (  # noqa: F401
     CheckpointManager, latest_checkpoint,
 )
+from .resilient_store import (  # noqa: F401
+    ResilientStore, StoreUnavailableError, read_endpoint_file,
+    write_endpoint_file,
+)
 
 # spawn-style launch (ref: python/paddle/distributed/spawn.py)
 from .launch_api import spawn, launch  # noqa: F401

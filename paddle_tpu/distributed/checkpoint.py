@@ -73,7 +73,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from . import mesh as _mesh_mod
-from ..utils.retry import wait_until
+from ..utils.retry import retry_call, wait_until
 
 __all__ = ["save_sharded", "load_sharded", "save_state", "load_state",
            "CheckpointCorruptError", "ReshardError", "HostLocalShard",
@@ -423,30 +423,89 @@ def _barrier_arrive(store, key, rank=None):
     return store.add(key, 1)
 
 
+class _StoreGone(Exception):
+    """Internal carrier: a StoreUnavailableError inside a retried
+    barrier step.  StoreUnavailableError subclasses ConnectionError, so
+    retry_call's transient filter would keep retrying it — this wrapper
+    pierces the filter (terminal: the client already exhausted ITS
+    deadline / was generation-fenced) and the original is re-raised at
+    the barrier boundary via ``__cause__``."""
+
+
 def store_barrier(store, key, world, rank=None, timeout=300.0):
-    """Block until ``world`` processes have entered this barrier (one
-    `add` each on ``key``) — the multi-host commit seal: after it
-    returns, every process's COMMIT marker is on the shared filesystem.
+    """Block until ``world`` processes have entered this barrier — the
+    multi-host commit seal: after it returns, every process's COMMIT
+    marker is on the shared filesystem.
 
     Pass ``rank`` so a timeout names exactly which ranks are missing
     (diff of arrived per-rank keys vs the expected set) instead of only
     a count — one log line locates the dead process in a hung drill.
+
+    Fault semantics: a transient ``ConnectionError``/``TimeoutError``
+    while arriving or polling (store master restarting) is retried
+    within ``timeout`` instead of failing the commit instantly; a
+    :class:`~paddle_tpu.distributed.resilient_store.StoreUnavailableError`
+    (the client's own deadline already spent, or an amnesiac master
+    fenced) is terminal and propagates at once.  With ``rank`` the seal
+    is the set of idempotent per-rank arrival keys, so a retried
+    arrival that double-bumps the shared counter can never release the
+    barrier early; ``rank=None`` keeps the legacy counter-only contract
+    (stores that only implement ``add``).
     """
     from ..observability import get_telemetry
+    from .resilient_store import StoreUnavailableError
+
+    _transient = (ConnectionError, TimeoutError, OSError)
 
     def _missing_ranks():
-        arrived = sorted(
-            p for p in range(world)
-            if store.get(f"{key}/rank/{p}", wait=False) is not None)
+        try:
+            arrived = sorted(
+                p for p in range(world)
+                if store.get(f"{key}/rank/{p}", wait=False) is not None)
+        except _transient as e:
+            return (f"store unreachable while probing arrivals "
+                    f"({type(e).__name__}: {e})")
         missing = sorted(set(range(world)) - set(arrived))
         return (f"{len(arrived)}/{world} ranks arrived; missing ranks "
                 f"{missing} (arrived: {arrived})")
 
+    def _arrive_once():
+        try:
+            return _barrier_arrive(store, key, rank)
+        except StoreUnavailableError as e:
+            raise _StoreGone() from e
+
+    arrived_cache: set[int] = set()
+
+    def _sealed():
+        try:
+            if rank is not None:
+                # idempotent seal: per-rank keys, monotonic accumulate
+                for p in range(world):
+                    if p not in arrived_cache and store.get(
+                            f"{key}/rank/{p}", wait=False) is not None:
+                        arrived_cache.add(p)
+                return len(arrived_cache) >= world
+            return store.add(key, 0) >= world
+        except StoreUnavailableError:
+            raise  # client deadline spent / fenced: terminal
+        except _transient as e:
+            logger.warning(
+                "checkpoint barrier %r: transient store error while "
+                "polling (%s: %s); retrying within deadline",
+                key, type(e).__name__, e)
+            return False
+
     t0 = time.monotonic()
     ok = False
-    _barrier_arrive(store, key, rank)
     try:
-        wait_until(lambda: store.add(key, 0) >= world, timeout,
+        try:
+            retry_call(_arrive_once, retry_on=_transient,
+                       deadline=timeout, base=0.05, max_delay=1.0)
+        except _StoreGone as e:
+            raise e.__cause__
+        remaining = max(0.0, timeout - (time.monotonic() - t0))
+        wait_until(_sealed, remaining,
                    desc=f"checkpoint barrier {key!r} ({world} procs)",
                    diag=_missing_ranks if rank is not None else None)
         ok = True

@@ -38,8 +38,21 @@ What each phase proves (victim = non-zero rank, staged store commit):
  mid-barrier   victim arrived ⇒ rank 0 promotes K; survivors fail at
                K+1; resume K (kill rank 0 instead ⇒ no promote, K-1)
  ============  =====================================================
+
+Store-failover drills (:func:`.runner.run_store_kill_drill`) invert
+the victim: the TCPStore MASTER itself is SIGKILLed mid-save while
+every worker rank is provably in-flight (a ready/go rendezvous through
+the doomed master), then respawned from its WAL
+(:mod:`paddle_tpu.core.store_server`) — clients reconnect through
+:class:`~paddle_tpu.distributed.resilient_store.ResilientStore`, the
+respawned master seals the commit barrier from REPLAYED arrivals, and
+the run finishes bit-for-bit.  Respawned WITHOUT the WAL, the
+generation fence trips and every rank exits ``EXIT_STORE_LOST``
+within its deadline instead of hanging.
 """
-__all__ = ["KillSpec", "run_drill", "spawn_worker", "reap_all"]
+__all__ = ["KillSpec", "StoreKillSpec", "run_drill",
+           "run_store_kill_drill", "spawn_worker", "spawn_store_master",
+           "reap_all"]
 
 
 def __getattr__(name):

@@ -25,9 +25,12 @@ from ...core import TCPStore
 from ...utils.retry import wait_until
 from ..checkpoint import read_leaf, verify_checkpoint
 from ..checkpoint_manager import CheckpointManager
-from .worker import EXIT_SAVE_FAILED, advance, init_state
+from ..resilient_store import ResilientStore, read_endpoint_file
+from .worker import (EXIT_SAVE_FAILED, EXIT_STORE_LOST, advance,
+                     init_state)
 
-__all__ = ["KillSpec", "DrillFailure", "spawn_worker", "run_drill",
+__all__ = ["KillSpec", "StoreKillSpec", "DrillFailure", "spawn_worker",
+           "spawn_store_master", "run_drill", "run_store_kill_drill",
            "reap_all"]
 
 logger = logging.getLogger(__name__)
@@ -65,6 +68,22 @@ class KillSpec:
         return self.step - 1
 
 
+class StoreKillSpec:
+    """Scripted STORE-MASTER kill: every rank rendezvouses at ``phase``
+    of step ``step``'s save (``pre-save`` | ``mid-barrier``), and the
+    runner SIGKILLs the master inside that window.  ``timeout`` bounds
+    each rank's wait for the post-respawn release key."""
+
+    __slots__ = ("phase", "step", "timeout")
+
+    def __init__(self, phase, step, timeout=60.0):
+        if phase not in ("pre-save", "mid-barrier"):
+            raise ValueError(f"unknown storekill phase {phase!r}")
+        self.phase = phase
+        self.step = int(step)
+        self.timeout = float(timeout)
+
+
 def reap_all():
     """SIGKILL + wait every worker this module spawned and is still
     tracking — the no-leaked-children guarantee for test harnesses."""
@@ -81,11 +100,18 @@ def reap_all():
         _LIVE.discard(p)
 
 
-def spawn_worker(rank, world, *, root, port, total_steps, run_id,
+def spawn_worker(rank, world, *, root, port=0, total_steps, run_id,
                  barrier_timeout, kill=None, elastic=True,
-                 orphan_age=None, log_path=None):
+                 orphan_age=None, log_path=None, endpoint_file=None,
+                 store_deadline=None, storekill=None):
     """Launch one drill worker subprocess; returns its Popen (also
-    registered for :func:`reap_all`)."""
+    registered for :func:`reap_all`).
+
+    ``endpoint_file`` switches the worker to a ResilientStore resolved
+    through that file (the store-failover mode; ``port`` is then
+    ignored); ``storekill`` (a :class:`StoreKillSpec`) arms the
+    master-kill rendezvous in every rank.
+    """
     env = {k: v for k, v in os.environ.items()
            if not k.startswith("DRILL_")}
     env.update({
@@ -107,6 +133,14 @@ def spawn_worker(rank, world, *, root, port, total_steps, run_id,
         env["DRILL_KILL_PHASE"] = kill.phase
         env["DRILL_KILL_STEP"] = str(kill.step)
         env["DRILL_KILL_RANK"] = str(kill.rank)
+    if endpoint_file is not None:
+        env["DRILL_ENDPOINT_FILE"] = endpoint_file
+    if store_deadline is not None:
+        env["DRILL_STORE_DEADLINE"] = str(store_deadline)
+    if storekill is not None:
+        env["DRILL_STOREKILL_PHASE"] = storekill.phase
+        env["DRILL_STOREKILL_STEP"] = str(storekill.step)
+        env["DRILL_STOREKILL_TIMEOUT"] = str(storekill.timeout)
     cmd = [sys.executable, "-m", "paddle_tpu.distributed.drill.worker"]
     if log_path:
         with open(log_path, "ab") as out:
@@ -118,6 +152,50 @@ def spawn_worker(rank, world, *, root, port, total_steps, run_id,
                              stderr=subprocess.DEVNULL)
     _LIVE.add(p)
     return p
+
+
+def spawn_store_master(*, endpoint_file, wal_path=None, port=0,
+                       log_path=None, spawn_timeout=30.0):
+    """Launch (or respawn) a store-master subprocess and wait for it to
+    publish its endpoint.  Returns ``(Popen, (host, port))``; the
+    process is registered for :func:`reap_all` like any drill child.
+
+    The endpoint file is unlinked FIRST so a client re-resolving during
+    the respawn can never read the dead master's address as current.
+    """
+    try:
+        os.unlink(endpoint_file)
+    except FileNotFoundError:
+        pass
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "store_master.py")
+    cmd = [sys.executable, script, "--endpoint-file", endpoint_file,
+           "--port", str(port)]
+    if wal_path:
+        cmd += ["--wal", wal_path]
+    if log_path:
+        with open(log_path, "ab") as out:
+            p = subprocess.Popen(cmd, stdout=out,
+                                 stderr=subprocess.STDOUT)
+    else:
+        p = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                             stderr=subprocess.DEVNULL)
+    _LIVE.add(p)
+
+    def _published():
+        if p.poll() is not None:
+            raise DrillFailure(
+                f"store master died during startup (rc {p.poll()})")
+        return read_endpoint_file(endpoint_file)
+
+    try:
+        ep = wait_until(_published, spawn_timeout,
+                        desc="store master to publish its endpoint")
+    except TimeoutError as e:
+        raise DrillFailure(f"store master never came up: {e}") from e
+    logger.info("store master pid %d serving at %s:%d (wal=%s)",
+                p.pid, ep[0], ep[1], wal_path)
+    return p, ep
 
 
 def _wait_fleet(procs, timeout):
@@ -223,4 +301,153 @@ def run_drill(root, generations, total_steps, *, barrier_timeout=6.0,
     finally:
         reap_all()
         master.close()
+    return report
+
+
+def run_store_kill_drill(root, *, world=2, total_steps=5, kill_step=3,
+                         phase="mid-barrier", wal=True, respawn=True,
+                         respawn_with_wal=True, barrier_timeout=10.0,
+                         store_deadline=8.0, storekill_timeout=45.0,
+                         gen_timeout=120.0, log_dir=None,
+                         relaunch_extra_steps=0):
+    """SIGKILL the TCPStore MASTER mid-save and prove the fleet either
+    recovers (durable master respawned from its WAL) or degrades
+    cleanly (``StoreUnavailableError`` → every rank exits
+    ``EXIT_STORE_LOST`` within its deadline — never a hang).
+
+    Deterministic kill window: every rank rendezvouses at ``phase`` of
+    step ``kill_step``'s save (``ready`` keys through the doomed
+    master, blocking on a ``go`` key), the runner SIGKILLs the master
+    only once ALL ranks are provably in-flight, then — when ``respawn``
+    — relaunches it (from the WAL, or amnesiac when
+    ``respawn_with_wal=False``) and releases ``go`` through the new
+    master.  Recovery asserts every rank finishes to ``total_steps``
+    with the respawned master sealing the barrier from REPLAYED
+    arrivals, bit-for-bit verified; ``relaunch_extra_steps > 0`` then
+    runs a fresh no-kill generation against the same master to prove a
+    relaunch resumes bit-for-bit too.
+
+    Returns a report dict (``rcs``, ``latest``, ``generations``
+    observed from the release client, endpoints, recovery mode).
+    """
+    endpoint_file = os.path.join(root, "store.endpoint")
+    wal_path = os.path.join(root, "store.wal") if wal else None
+    expect_recovery = respawn and respawn_with_wal and wal
+
+    def _log(name):
+        return os.path.join(log_dir, name) if log_dir else None
+
+    master, ep0 = spawn_store_master(
+        endpoint_file=endpoint_file, wal_path=wal_path,
+        log_path=_log("store_master_0.log"))
+    report = {"endpoints": [ep0], "recovered": expect_recovery}
+    try:
+        run_id = f"storekill-{uuid.uuid4().hex[:6]}"
+        sk = StoreKillSpec(phase, kill_step, timeout=storekill_timeout)
+        procs = [
+            spawn_worker(
+                r, world, root=root, total_steps=total_steps,
+                run_id=run_id, barrier_timeout=barrier_timeout,
+                endpoint_file=endpoint_file,
+                store_deadline=store_deadline, storekill=sk,
+                log_path=_log(f"storekill_rank{r}.log"))
+            for r in range(world)
+        ]
+
+        # wait until EVERY rank is provably inside the kill window
+        watch = ResilientStore(endpoint_file=endpoint_file,
+                               deadline=store_deadline)
+        try:
+            for r in range(world):
+                watch.get(f"storekill/{run_id}/ready/{r}", wait=True,
+                          timeout=gen_timeout / 2)
+        finally:
+            watch.close()
+        logger.info("all %d ranks at the storekill rendezvous; "
+                    "SIGKILLing master pid %d", world, master.pid)
+        master.kill()
+        master.wait(timeout=30)
+        _LIVE.discard(master)
+
+        gen = None
+        if respawn:
+            master, ep1 = spawn_store_master(
+                endpoint_file=endpoint_file,
+                wal_path=wal_path if respawn_with_wal else None,
+                log_path=_log("store_master_1.log"))
+            report["endpoints"].append(ep1)
+            # release the fleet through the NEW master (fresh client:
+            # the release must work even against an amnesiac master —
+            # it is the WORKERS whose fence must trip, not ours)
+            release = ResilientStore(endpoint_file=endpoint_file,
+                                     deadline=store_deadline)
+            try:
+                release.set(f"storekill/{run_id}/go", b"1")
+                gen = release.generation
+            finally:
+                release.close()
+        report["generation"] = gen
+
+        rcs = _wait_fleet(procs, gen_timeout)
+        latest = _latest_step(root)
+        report.update({"rcs": rcs, "latest": latest})
+
+        if expect_recovery:
+            if any(rc != 0 for rc in rcs):
+                raise DrillFailure(
+                    f"store-kill recovery: exit codes {rcs}, expected "
+                    f"all 0 (master respawned from WAL should have "
+                    f"sealed the barrier from replayed arrivals)")
+            if latest != total_steps:
+                raise DrillFailure(
+                    f"store-kill recovery: newest committed step is "
+                    f"{latest}, wanted {total_steps}")
+            if gen is None or gen < 2:
+                raise DrillFailure(
+                    f"respawned WAL master advertises generation {gen}, "
+                    f"expected >= 2 (replay must bump it)")
+        else:
+            if any(rc != EXIT_STORE_LOST for rc in rcs):
+                raise DrillFailure(
+                    f"store-kill clean-failure: exit codes {rcs}, "
+                    f"expected all {EXIT_STORE_LOST} "
+                    f"(StoreUnavailableError)")
+            want = kill_step - 1
+            if (latest or 0) != want:
+                raise DrillFailure(
+                    f"store-kill clean-failure: newest committed step "
+                    f"is {latest}, expected {want} (step {kill_step} "
+                    f"must never have promoted)")
+        if latest:
+            _verify_bit_for_bit(root, latest)
+
+        if expect_recovery and relaunch_extra_steps > 0:
+            # relaunch generation: fresh fleet, same respawned master,
+            # resumes from `latest` and runs further — the
+            # resume-bit-for-bit half of the acceptance criterion
+            run_id2 = f"storekill-relaunch-{uuid.uuid4().hex[:6]}"
+            more = total_steps + relaunch_extra_steps
+            procs2 = [
+                spawn_worker(
+                    r, world, root=root, total_steps=more,
+                    run_id=run_id2, barrier_timeout=barrier_timeout,
+                    endpoint_file=endpoint_file,
+                    store_deadline=store_deadline,
+                    log_path=_log(f"relaunch_rank{r}.log"))
+                for r in range(world)
+            ]
+            rcs2 = _wait_fleet(procs2, gen_timeout)
+            latest2 = _latest_step(root)
+            report.update({"relaunch_rcs": rcs2,
+                           "relaunch_latest": latest2})
+            if any(rc != 0 for rc in rcs2):
+                raise DrillFailure(
+                    f"relaunch after store failover: exit codes {rcs2}")
+            if latest2 != more:
+                raise DrillFailure(
+                    f"relaunch after store failover: newest step "
+                    f"{latest2}, wanted {more}")
+            _verify_bit_for_bit(root, latest2)
+    finally:
+        reap_all()
     return report

@@ -1,0 +1,71 @@
+"""Standalone TCPStore-master process for store-failover drills.
+
+Run by FILE PATH (``python .../drill/store_master.py``), never as a
+package module: a respawn after SIGKILL must cost one interpreter
+start, not a jax import, so this script path-loads the stdlib-only
+``paddle_tpu.core.store_server`` module directly and touches nothing
+else in the package.
+
+Publishes ``host:port`` to ``--endpoint-file`` (atomic tmp+rename)
+once the server is listening — the drill runner and every
+ResilientStore client resolve the master through that file, so a
+respawn on a fresh ephemeral port is transparent.  ``--wal`` makes the
+master durable (replay + generation bump); omit it to spawn the
+amnesiac master the fencing drills need.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import threading
+
+# load core/store_server.py as a top-level module: no package import,
+# no native lib, no jax — the whole point of the standalone entry
+_CORE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "core")
+sys.path.insert(0, _CORE_DIR)
+import store_server  # noqa: E402
+
+logger = logging.getLogger("paddle_tpu.drill.store_master")
+
+
+def _write_endpoint(path, host, port):
+    # atomic publish (mirrors resilient_store.write_endpoint_file,
+    # which this script must not import)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="ascii") as f:
+        f.write(f"{host}:{int(port)}\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--endpoint-file", required=True)
+    ap.add_argument("--wal", default=None,
+                    help="WAL path; omit for a volatile (amnesiac) "
+                         "master")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO, stream=sys.stderr,
+        format="[store-master] %(levelname)s %(message)s")
+
+    server = store_server.DurableTCPStoreServer(
+        port=args.port, host=args.host, wal_path=args.wal)
+    _write_endpoint(args.endpoint_file, server.host, server.port)
+    logger.info("serving on %s:%d (generation=%s, wal=%s)",
+                server.host, server.port, server.generation, args.wal)
+    # block until killed — the drill's weapon is SIGKILL, so there is
+    # deliberately no graceful-shutdown path to hide behind
+    threading.Event().wait()
+
+
+if __name__ == "__main__":
+    main()

@@ -18,6 +18,19 @@ Run as ``python -m paddle_tpu.distributed.drill.worker`` with the
  - ``DRILL_ORPHAN_AGE``: run the staging janitor on startup with this
    max age (seconds); unset → no sweep.
  - ``DRILL_KILL_*``: see :mod:`.injector`.
+ - ``DRILL_ENDPOINT_FILE``: use a
+   :class:`~paddle_tpu.distributed.resilient_store.ResilientStore`
+   resolved through this endpoint file instead of a fixed-port raw
+   TCPStore — the store-failover drills, where the master is SIGKILLed
+   and respawned on a fresh port mid-run.
+ - ``DRILL_STORE_DEADLINE``: ResilientStore per-op retry budget.
+ - ``DRILL_STOREKILL_STEP`` / ``DRILL_STOREKILL_PHASE``
+   (``pre-save`` | ``mid-barrier``) / ``DRILL_STOREKILL_TIMEOUT``: the
+   master-kill rendezvous — at the scripted point every rank announces
+   ``storekill/<run_id>/ready/<rank>`` then blocks on
+   ``storekill/<run_id>/go``; the runner kills the master only after
+   all ranks are provably in-flight, and sets ``go`` through the
+   respawned one.
 
 The "model" is a (12, 4) fp32 array row-partitioned across ranks via
 :class:`~paddle_tpu.distributed.checkpoint.HostLocalShard` (12 divides
@@ -29,8 +42,10 @@ replays an exact oracle (:func:`advance`).
 
 Exit codes: 0 = reached ``DRILL_TOTAL_STEPS``; 17 = a save failed
 cleanly (barrier timeout after a peer died — the survivor's correct
-move is to exit and await relaunch); SIGKILL death reports -9 to the
-runner.
+move is to exit and await relaunch); 19 = the store master stayed
+unreachable or was generation-fenced (StoreUnavailableError — the
+clean degradation the failover drills assert); SIGKILL death reports
+-9 to the runner.
 """
 from __future__ import annotations
 
@@ -42,6 +57,7 @@ import numpy as np
 
 ROWS, COLS = 12, 4
 EXIT_SAVE_FAILED = 17
+EXIT_STORE_LOST = 19
 
 logger = logging.getLogger("paddle_tpu.drill.worker")
 
@@ -68,13 +84,58 @@ def advance(w, bias, steps=1):
     return w, bias
 
 
+def _arm_storekill(store, rank, run_id, step, phase, timeout):
+    """Wire the master-kill rendezvous: returns ``(phase, rendezvous)``.
+
+    ``rendezvous()`` announces this rank at
+    ``storekill/<run_id>/ready/<rank>`` and blocks on
+    ``storekill/<run_id>/go`` — the window in which the runner SIGKILLs
+    the master, so the blocking ``get`` rides the ResilientStore
+    reconnect path against the respawned (or absent, or amnesiac)
+    master.  ``mid-barrier`` patches the ``_barrier_arrive`` seam so
+    the rendezvous fires AFTER the real arrival (the arrival must land
+    in the WAL for the respawned master to seal the barrier);
+    ``pre-save`` fires from the worker loop before the save starts.
+    Runs at most once — a retried arrival must not re-rendezvous.
+    """
+    from .. import checkpoint as _ckpt
+
+    ready_key = f"storekill/{run_id}/ready/{rank}"
+    go_key = f"storekill/{run_id}/go"
+    fired = []
+
+    def rendezvous():
+        if fired:
+            return
+        fired.append(True)
+        logger.info("storekill rendezvous: ready at %s, awaiting %s "
+                    "(master kill window)", ready_key, go_key)
+        store.set(ready_key, b"1")
+        store.get(go_key, wait=True, timeout=timeout)
+        logger.info("storekill rendezvous released (master "
+                    "generation %s)", getattr(store, "generation", None))
+
+    if phase == "mid-barrier":
+        needle = f"step_{int(step):08d}"
+        real_arrive = _ckpt._barrier_arrive
+
+        def _arrive(store_, key, rank_=None):
+            n = real_arrive(store_, key, rank_)
+            if needle in key:
+                rendezvous()
+            return n
+
+        _ckpt._barrier_arrive = _arrive
+    return phase, rendezvous
+
+
 def main():
     env = os.environ
     rank = int(env["DRILL_RANK"])
     world = int(env["DRILL_WORLD"])
     total = int(env["DRILL_TOTAL_STEPS"])
     root = env["DRILL_CKPT"]
-    port = int(env["DRILL_STORE_PORT"])
+    port = int(env.get("DRILL_STORE_PORT", "0"))
     run_id = env.get("DRILL_RUN_ID", "0")
     barrier_timeout = float(env.get("DRILL_BARRIER_TIMEOUT", "10"))
     elastic = env.get("DRILL_ELASTIC", "1") == "1"
@@ -95,11 +156,30 @@ def main():
     from ...core import TCPStore
     from ..checkpoint import HostLocalShard, read_leaf
     from ..checkpoint_manager import CheckpointManager
+    from ..resilient_store import ResilientStore, StoreUnavailableError
 
+    endpoint_file = env.get("DRILL_ENDPOINT_FILE")
     store = None
-    if world > 1:
+    if endpoint_file:
+        store = ResilientStore(
+            endpoint_file=endpoint_file,
+            deadline=float(env.get("DRILL_STORE_DEADLINE",
+                                   str(barrier_timeout))))
+    elif world > 1:
         store = TCPStore("127.0.0.1", port, is_master=False,
                          timeout=barrier_timeout + 30.0)
+
+    sk_phase = None
+    sk_step = None
+    storekill_rendezvous = None
+    if env.get("DRILL_STOREKILL_STEP") is not None and store is not None:
+        sk_step = int(env["DRILL_STOREKILL_STEP"])
+        sk_phase, storekill_rendezvous = _arm_storekill(
+            store, rank, run_id, sk_step,
+            env.get("DRILL_STOREKILL_PHASE", "mid-barrier"),
+            float(env.get("DRILL_STOREKILL_TIMEOUT", "60")))
+        logger.info("armed storekill rendezvous: phase=%s step=%d",
+                    sk_phase, sk_step)
     mgr = CheckpointManager(
         root, keep_last_n=None, store=store, world_size=world,
         process_index=rank, durable=True, run_id=run_id,
@@ -131,7 +211,16 @@ def main():
             "bias": HostLocalShard(bias),  # replicated: full window
         }
         try:
+            if sk_phase == "pre-save" and step == sk_step:
+                storekill_rendezvous()
             mgr.save(step, state)
+        except StoreUnavailableError as e:
+            # the master stayed dead past the client deadline, or a
+            # respawn was generation-fenced as amnesiac — clean
+            # degradation, distinct from a peer-death save failure
+            logger.error("store lost during save of step %d: %s",
+                         step, e)
+            sys.exit(EXIT_STORE_LOST)
         except BaseException as e:
             # a dead peer shows up here as a barrier/promote timeout
             # naming the missing ranks; exiting cleanly IS the correct

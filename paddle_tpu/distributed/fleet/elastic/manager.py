@@ -61,7 +61,17 @@ class ElasticManager:
 
     Args mirror the reference: ``np`` may be "min:max" for elastic range.
     ``store`` is a connected :class:`paddle_tpu.core.TCPStore` (master on
-    rank-0's host).
+    rank-0's host) — or a
+    :class:`~paddle_tpu.distributed.resilient_store.ResilientStore` for
+    store-failover tolerance: heartbeats then ride the reconnect path
+    across a master SIGKILL/respawn, and a reconnect that lands within
+    the lease TTL costs the node nothing (the respawned durable master
+    replays the slot keys, and the next ``_beat`` refreshes the lease
+    before peers evict it).  Size the client's ``deadline`` BELOW
+    ``lease_ttl`` so a beat either lands in time or fails loudly
+    (``StoreUnavailableError`` is a ``ConnectionError``, so the
+    heartbeat loop's existing error path and ``register``'s retries
+    already handle it).
     """
 
     def __init__(self, store, host, np="1", heartbeat_interval=1.0,

@@ -1,0 +1,351 @@
+"""Auto-reconnecting TCPStore client with endpoint re-resolution and
+generation fencing.
+
+The raw :class:`~paddle_tpu.core.TCPStore` client dies with the master:
+one ``ConnectionError`` and every barrier, heartbeat and staged commit
+built on it fails instantly — even though a supervised master respawns
+from its WAL within a second.  :class:`ResilientStore` is the client
+half of store failover:
+
+ - every op runs through :func:`~paddle_tpu.utils.retry.retry_call`
+   backoff: a transient ``ConnectionError`` / ``TimeoutError`` /
+   ``OSError`` tears down the cached connection, re-resolves the master
+   endpoint (from the on-disk **endpoint file** the supervisor rewrites
+   on respawn — the respawned master may sit on a new port), reconnects,
+   and retries the op;
+ - reconnects are **generation-fenced**: a durable master advertises a
+   monotonic ``store/generation`` key (WAL replay bumps it).  Once a
+   client has observed generation ``g >= 1``, a reconnect that finds a
+   LOWER generation — in particular a missing key, i.e. a master that
+   lost or never had its WAL — is an amnesiac master that forgot every
+   barrier arrival and lease; rendezvousing against it would deadlock
+   or, worse, release barriers early.  The client refuses, immediately
+   and permanently.
+ - after ``deadline`` seconds of failed attempts the op raises
+   :class:`StoreUnavailableError` naming the endpoint, op, key and
+   elapsed time — callers degrade loudly, never hang.
+
+``set``/``get``/``delete``/``wait``/``num_keys`` are idempotent and
+retried transparently.  ``add`` is retried too but is **at-least-once**:
+a reply lost to the crash re-applies the delta on retry.  Barrier code
+must therefore seal on idempotent per-rank keys, not on the counter
+value (see ``checkpoint.store_barrier``).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+from ..utils.retry import retry_call, wait_until
+
+__all__ = ["StoreUnavailableError", "ResilientStore", "GENERATION_KEY",
+           "write_endpoint_file", "read_endpoint_file"]
+
+logger = logging.getLogger(__name__)
+
+# mirrors core.store_server.GENERATION_KEY without importing core here
+# (this module must stay importable in processes that never load the
+# native lib); the test suite pins the two constants equal.
+GENERATION_KEY = "store/generation"
+
+_TRANSIENT = (ConnectionError, TimeoutError, OSError)
+
+
+class StoreUnavailableError(ConnectionError):
+    """The store master stayed unreachable (or was fenced as amnesiac)
+    past the client's deadline.
+
+    Subclasses ``ConnectionError`` so pre-existing ``except
+    ConnectionError`` consumers keep working, but carries structured
+    context: ``endpoint``, ``op``, ``key``, ``elapsed``.
+    """
+
+    def __init__(self, message, *, endpoint=None, op=None, key=None,
+                 elapsed=None):
+        super().__init__(message)
+        self.endpoint = endpoint
+        self.op = op
+        self.key = key
+        self.elapsed = elapsed
+
+
+class _FencedMaster(RuntimeError):
+    """Internal: reconnect found a lower generation than ever observed.
+    Deliberately NOT a ConnectionError so it pierces retry_call's
+    ``retry_on=_TRANSIENT`` filter — fencing is terminal, not
+    transient."""
+
+
+def write_endpoint_file(path, host, port):
+    """Atomically publish ``host:port`` (tmp + rename: a reader never
+    sees a torn endpoint, only the old one or the new one)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="ascii") as f:
+        f.write(f"{host}:{int(port)}\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def read_endpoint_file(path):
+    """Parse ``(host, port)`` from an endpoint file; None while the
+    file is absent or torn (supervisor mid-respawn)."""
+    try:
+        with open(path, "r", encoding="ascii") as f:
+            text = f.read().strip()
+    except (OSError, UnicodeDecodeError):
+        return None
+    if ":" not in text:
+        return None
+    host, _, port = text.rpartition(":")
+    try:
+        return host, int(port)
+    except ValueError:
+        return None
+
+
+class ResilientStore:
+    """TCPStore client that survives master restarts.
+
+    Fixed endpoint: ``ResilientStore(host, port)``.  Supervised master:
+    ``ResilientStore(endpoint_file=...)`` — each (re)connect re-reads
+    the file, so a respawn on a new port is transparent.
+
+    ``deadline`` bounds every op's total retry budget; ``store_factory``
+    is injectable for tests (defaults to the native TCPStore client).
+    """
+
+    def __init__(self, host=None, port=None, *, endpoint_file=None,
+                 deadline=60.0, connect_timeout=5.0, store_factory=None):
+        if endpoint_file is None and (host is None or port is None):
+            raise ValueError("ResilientStore needs host+port or an "
+                             "endpoint_file")
+        self._host = host
+        self._port = port
+        self._endpoint_file = endpoint_file
+        self.deadline = float(deadline)
+        self.connect_timeout = float(connect_timeout)
+        self._factory = store_factory or self._default_factory
+        self._store = None
+        self._gen = None  # highest generation ever observed
+
+    @staticmethod
+    def _default_factory(host, port, timeout):
+        from ..core import TCPStore
+        return TCPStore(host, port, is_master=False, timeout=timeout)
+
+    # -- connection management ----------------------------------------------
+
+    def _resolve(self):
+        if self._endpoint_file is not None:
+            ep = read_endpoint_file(self._endpoint_file)
+            if ep is None:
+                raise ConnectionError(
+                    f"store endpoint file {self._endpoint_file} absent "
+                    f"or unparseable (master not (re)spawned yet?)")
+            return ep
+        return self._host, self._port
+
+    def _drop(self):
+        s, self._store = self._store, None
+        if s is not None:
+            try:
+                s.close()
+            except Exception as e:
+                logger.debug("store close failed (already dead): %s", e)
+
+    def _connect_once(self):
+        host, port = self._resolve()
+        store = self._factory(host, port, self.connect_timeout)
+        try:
+            self._fence(store, host, port)
+        except BaseException:
+            try:
+                store.close()
+            except Exception as e:
+                logger.debug("store close failed: %s", e)
+            raise
+        self._store = store
+        return store
+
+    def _fence(self, store, host, port):
+        """Refuse a master whose generation moved backwards: it lost
+        the WAL (or never had one) and forgot this client's barrier
+        arrivals/leases."""
+        raw = store.get(GENERATION_KEY, wait=False)
+        gen = 0
+        if raw is not None:
+            try:
+                gen = int(raw.decode("ascii"))
+            except (ValueError, UnicodeDecodeError):
+                gen = 0
+        if self._gen is not None and self._gen >= 1 and gen < self._gen:
+            raise _FencedMaster(
+                f"store master at {host}:{port} advertises generation "
+                f"{gen} but this client already observed generation "
+                f"{self._gen} — an amnesiac master (lost/disabled WAL) "
+                f"that forgot barrier and lease state; refusing to "
+                f"rendezvous against it")
+        if gen > 0:
+            self._gen = gen
+
+    def _conn(self):
+        return self._store if self._store is not None \
+            else self._connect_once()
+
+    # -- op plumbing --------------------------------------------------------
+
+    def _run(self, op, key, fn):
+        """Run ``fn(store)`` with transparent reconnect-and-retry; after
+        ``self.deadline`` of transient failures (or instantly on a
+        fence) raise StoreUnavailableError."""
+        t0 = time.monotonic()
+
+        def _attempt():
+            try:
+                return fn(self._conn())
+            except _TRANSIENT:
+                self._drop()
+                raise
+
+        def _on_retry(attempt, exc, delay):
+            logger.warning(
+                "store %s(%s) failed (%s: %s); reconnect attempt %d in "
+                "%.2fs", op, key if key is not None else "",
+                type(exc).__name__, exc, attempt, delay)
+            _telemetry_reconnect(op)
+
+        try:
+            result = retry_call(_attempt, retry_on=_TRANSIENT,
+                                deadline=self.deadline, base=0.05,
+                                max_delay=1.0, on_retry=_on_retry)
+        except (_FencedMaster, *_TRANSIENT) as e:
+            elapsed = time.monotonic() - t0
+            endpoint = self._endpoint_str()
+            _telemetry_unavailable(elapsed, op=op, endpoint=endpoint)
+            raise StoreUnavailableError(
+                f"store {op} for key {key!r} failed against master "
+                f"{endpoint} after {elapsed:.1f}s "
+                f"(deadline {self.deadline:.1f}s): {e}",
+                endpoint=endpoint, op=op, key=key,
+                elapsed=elapsed) from e
+        _telemetry_ok(self._gen)
+        return result
+
+    def _endpoint_str(self):
+        try:
+            host, port = self._resolve()
+            return f"{host}:{port}"
+        except ConnectionError:
+            if self._endpoint_file is not None:
+                return f"<unresolved: {self._endpoint_file}>"
+            return f"{self._host}:{self._port}"
+
+    # -- public store API ---------------------------------------------------
+
+    @property
+    def generation(self):
+        """Highest master generation observed (None before the first
+        contact with a durable master)."""
+        return self._gen
+
+    @property
+    def host(self):
+        h, _p = (self._resolve() if self._store is None
+                 else (self._store.host, self._store.port))
+        return h
+
+    @property
+    def port(self):
+        _h, p = (self._resolve() if self._store is None
+                 else (self._store.host, self._store.port))
+        return p
+
+    def set(self, key, value):
+        """Idempotent; retried transparently."""
+        return self._run("set", key,
+                         lambda s: s.set(key, value))
+
+    def get(self, key, wait=True, timeout=None):
+        """Nonblocking fetch, or ``wait=True`` poll until the key is
+        set.  The wait loop lives HERE (client side, over nonblocking
+        gets) so an inner TimeoutError can only ever mean connection
+        trouble — retryable — never 'key still absent', which must keep
+        polling until ``timeout``."""
+        if not wait:
+            return self._run("get", key,
+                             lambda s: s.get(key, wait=False))
+
+        def _poll():
+            v = self._run("get", key, lambda s: s.get(key, wait=False))
+            return (v,) if v is not None else None  # b"" is a value
+
+        got = _poll()
+        if got is None:
+            try:
+                got = wait_until(_poll, timeout, base=0.01, factor=1.5,
+                                 max_delay=0.25, desc=f"key {key!r}")
+            except TimeoutError:
+                raise TimeoutError(
+                    f"store: key '{key}' not set within {timeout}s at "
+                    f"{self._endpoint_str()} (a peer rank may have died "
+                    f"before rendezvous)")
+        return got[0]
+
+    def add(self, key, delta=1):
+        """At-least-once under reconnect (a lost reply re-applies the
+        delta) — callers needing exactly-once must seal on idempotent
+        per-rank keys instead of the counter value."""
+        return self._run("add", key, lambda s: s.add(key, delta))
+
+    def delete(self, key):
+        return self._run("delete", key, lambda s: s.delete(key))
+
+    def num_keys(self):
+        return self._run("num_keys", None, lambda s: s.num_keys())
+
+    def wait(self, keys, timeout=300.0):
+        if isinstance(keys, str):
+            keys = [keys]
+        deadline = time.monotonic() + timeout
+        for k in keys:
+            self.get(k, wait=True,
+                     timeout=max(0.0, deadline - time.monotonic()))
+
+    def close(self):
+        self._drop()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False
+
+
+# -- telemetry shims (observability is optional at this layer) --------------
+
+def _telemetry_ok(generation):
+    try:
+        from ..observability import get_telemetry
+        get_telemetry().record_store_op(generation=generation)
+    except Exception as e:
+        logger.debug("store telemetry hook failed: %s", e)
+
+
+def _telemetry_reconnect(op):
+    try:
+        from ..observability import get_telemetry
+        get_telemetry().record_store_reconnect(op)
+    except Exception as e:
+        logger.debug("store telemetry hook failed: %s", e)
+
+
+def _telemetry_unavailable(elapsed, op=None, endpoint=None):
+    try:
+        from ..observability import get_telemetry
+        get_telemetry().record_store_unavailable(elapsed, op=op,
+                                                 endpoint=endpoint)
+    except Exception as e:
+        logger.debug("store telemetry hook failed: %s", e)

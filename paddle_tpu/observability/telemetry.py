@@ -233,6 +233,9 @@ class TrainingTelemetry:
         self._last_ckpt_step = None
         self._last_heartbeat_ts = None
         self._lease_ttl = None
+        self._store_last_ok_ts = None
+        self._store_last_fail_ts = None
+        self._store_generation = None
         # refresh device-memory gauges every N steps (stats read is a
         # host-side allocator query, cheap but not free)
         self._mem_every = 32
@@ -337,6 +340,20 @@ class TrainingTelemetry:
         self._m_mem = r.gauge(
             "pt_device_memory_bytes",
             "allocator stats summed over local devices", ("stat",))
+        self._m_store_reconnects = r.counter(
+            "pt_store_reconnects_total",
+            "TCPStore client reconnect attempts (transient master "
+            "outages absorbed by ResilientStore)", ("op",))
+        self._m_store_unavail_s = r.histogram(
+            "pt_store_unavailable_seconds",
+            "time spent retrying before declaring the store master "
+            "unavailable")
+        self._m_store_gen = r.gauge(
+            "pt_store_generation",
+            "master generation last observed by this process")
+        self._m_store_ok_ts = r.gauge(
+            "pt_store_last_ok_timestamp_seconds",
+            "unix time of the last successful store op")
 
     # -- step timing --------------------------------------------------------
 
@@ -480,6 +497,43 @@ class TrainingTelemetry:
             with self._lock:
                 self._last_heartbeat_ts = now
 
+    # -- coordination store -------------------------------------------------
+
+    def record_store_op(self, generation=None):
+        """One store op succeeded (through ResilientStore).  Feeds the
+        ``store`` healthz block: last-ok age + current generation."""
+        if not self.enabled:
+            return
+        now = time.time()
+        self._m_store_ok_ts.set(now)
+        with self._lock:
+            self._store_last_ok_ts = now
+            if generation is not None:
+                self._store_generation = int(generation)
+        if generation is not None:
+            self._m_store_gen.set(int(generation))
+
+    def record_store_reconnect(self, op):
+        """A store op hit a transient connection failure and is being
+        retried against a (possibly respawned) master."""
+        if not self.enabled:
+            return
+        self._m_store_reconnects.inc(op=str(op))
+        if self.sink is not None:
+            self.sink.emit("store_reconnect", op=str(op))
+
+    def record_store_unavailable(self, seconds, op=None, endpoint=None):
+        """ResilientStore exhausted its deadline — the master stayed
+        unreachable for ``seconds``.  Positive evidence for healthz."""
+        if not self.enabled:
+            return
+        self._m_store_unavail_s.observe(float(seconds))
+        with self._lock:
+            self._store_last_fail_ts = time.time()
+        if self.sink is not None:
+            self.sink.emit("store_unavailable", op=op, endpoint=endpoint,
+                           duration_sec=round(float(seconds), 3))
+
     # -- compiles (called from the log filter) ------------------------------
 
     def _on_compile(self, name, signature=""):
@@ -583,6 +637,9 @@ class TrainingTelemetry:
             ttl = self._lease_ttl
             steps = self._steps
             last_ckpt = self._last_ckpt_step
+            store_ok_ts = self._store_last_ok_ts
+            store_fail_ts = self._store_last_fail_ts
+            store_gen = self._store_generation
         elastic = None
         lease_ok = None
         if last_hb is not None:
@@ -590,8 +647,23 @@ class TrainingTelemetry:
             lease_ok = (age <= ttl) if ttl is not None else True
             elastic = {"last_heartbeat_age_sec": round(age, 3),
                        "lease_ttl_sec": ttl, "lease_ok": lease_ok}
+        # store block: unhealthy only on positive evidence — a declared
+        # unavailability NOT followed by a later successful op.  A run
+        # with no store, or one that recovered, is healthy.
+        store = None
+        store_ok = None
+        if store_ok_ts is not None or store_fail_ts is not None:
+            store_ok = not (store_fail_ts is not None
+                            and (store_ok_ts is None
+                                 or store_fail_ts > store_ok_ts))
+            store = {
+                "last_ok_age_sec": (round(now - store_ok_ts, 3)
+                                    if store_ok_ts is not None else None),
+                "generation": store_gen,
+                "ok": store_ok,
+            }
         return {
-            "ok": lease_ok is not False,
+            "ok": lease_ok is not False and store_ok is not False,
             "pid": os.getpid(),
             "uptime_sec": round(now - self._start_ts, 1),
             "steps": steps,
@@ -599,6 +671,7 @@ class TrainingTelemetry:
                                   if last_step_ts is not None else None),
             "last_checkpoint_step": last_ckpt,
             "elastic": elastic,
+            "store": store,
             "recompile_storms": len(self.sentinel.tripped()),
         }
 

@@ -1,10 +1,13 @@
 """Drill-suite fixtures: the no-leaked-children guarantee.
 
-Every worker subprocess a drill spawns is registered in
+Every subprocess a drill spawns — worker ranks AND store-master
+processes, including masters RESPAWNED mid-drill by the failover
+supervisor — is registered in
 ``paddle_tpu.distributed.drill.runner._LIVE``; this autouse reaper
 SIGKILLs and waits any stragglers after EVERY test in this directory,
-no matter how the test failed — a hung drill must never outlive its
-test or poison a rerun."""
+no matter how the test failed — a hung drill or an orphaned respawned
+master must never outlive its test or poison a rerun with a stale
+endpoint file pointing at a live port."""
 import pytest
 
 from paddle_tpu.distributed.drill import runner as _runner

@@ -1,0 +1,174 @@
+"""Store-failover drills: SIGKILL the TCPStore MASTER mid-save, prove
+the fleet recovers through the WAL or degrades cleanly.
+
+Inverts the victim of tests/drills/test_fault_drills.py: the worker
+ranks survive and the coordination master dies.  Each drill spawns a
+real durable store-master subprocess (``drill/store_master.py``), a
+fleet of ``drill.worker`` ranks connected through ``ResilientStore``
+(endpoint-file resolution), rendezvouses every rank inside the kill
+window, SIGKILLs the master there, and asserts:
+
+ - respawned WITH its WAL → the new master replays keys, counters and
+   barrier arrivals, clients reconnect (generation bumped, fence
+   passes), the in-flight staged commit completes, and a relaunch
+   resumes bit-for-bit (tier-1);
+ - respawned WITHOUT the WAL → the generation fence trips and every
+   rank exits ``EXIT_STORE_LOST`` (StoreUnavailableError naming the
+   master endpoint) within its deadline — never a hang (tier-1);
+ - a mid-heartbeat kill/respawn must not cost an ElasticManager node
+   its lease when the reconnect lands within the TTL (tier-1);
+ - the pre-save phase and the never-respawned master are the ``@slow``
+   matrix.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+from paddle_tpu.distributed.drill import run_store_kill_drill
+
+pytestmark = pytest.mark.skipif(os.name != "posix",
+                                reason="drills SIGKILL real processes")
+
+
+def _roots(tmp_path):
+    root = str(tmp_path / "ckpt")
+    logs = str(tmp_path / "logs")
+    os.makedirs(root, exist_ok=True)
+    os.makedirs(logs, exist_ok=True)
+    return root, logs
+
+
+def test_store_master_kill_mid_barrier_recovers(tmp_path):
+    """Tier-1 acceptance drill: master SIGKILLed while both ranks are
+    mid-barrier at step 3 → respawn replays the WAL (arrivals
+    included), generation bumps to 2, the commit completes, the run
+    finishes, and a relaunched fleet resumes bit-for-bit."""
+    root, logs = _roots(tmp_path)
+    report = run_store_kill_drill(
+        root, world=2, total_steps=5, kill_step=3, phase="mid-barrier",
+        relaunch_extra_steps=2, log_dir=logs)
+    assert report["rcs"] == [0, 0]
+    assert report["latest"] == 5
+    assert report["generation"] == 2  # WAL replay bumped it
+    assert report["relaunch_rcs"] == [0, 0]
+    assert report["relaunch_latest"] == 7
+    # the respawned master really is a different process on (almost
+    # certainly) a different port: two endpoints were published
+    assert len(report["endpoints"]) == 2
+    # a worker log shows the reconnect riding through the outage
+    log0 = open(os.path.join(logs, "storekill_rank0.log")).read()
+    assert "storekill rendezvous released" in log0
+    assert "committed step 5" in log0
+
+
+def test_store_master_amnesiac_respawn_fails_clean(tmp_path):
+    """Tier-1 fencing drill: same kill, but the respawned master has no
+    WAL → it advertises no generation, the clients' fence trips, and
+    every rank exits EXIT_STORE_LOST with a StoreUnavailableError
+    naming the master endpoint — well before the barrier deadline
+    could be mistaken for a hang."""
+    root, logs = _roots(tmp_path)
+    t0 = time.monotonic()
+    report = run_store_kill_drill(
+        root, world=2, total_steps=5, kill_step=3, phase="mid-barrier",
+        respawn_with_wal=False, store_deadline=4.0, barrier_timeout=6.0,
+        log_dir=logs)
+    elapsed = time.monotonic() - t0
+    assert report["rcs"] == [19, 19]
+    assert report["latest"] == 2  # step 3 must never have promoted
+    assert elapsed < 60, f"clean failure took {elapsed:.0f}s — a hang"
+    log0 = open(os.path.join(logs, "storekill_rank0.log")).read()
+    assert "store lost during save of step 3" in log0
+    assert "amnesiac master" in log0
+    # the error names the master endpoint (host:port)
+    host, port = report["endpoints"][1]
+    assert f"{host}:{port}" in log0
+
+
+def test_elastic_lease_survives_master_respawn(tmp_path):
+    """Mid-heartbeat kill: an ElasticManager heartbeating through a
+    ResilientStore keeps its lease across a master SIGKILL + WAL
+    respawn — the reconnect lands within the TTL, the slot keys are
+    replayed, and alive_nodes() never loses the host."""
+    from paddle_tpu.distributed.drill.runner import (_LIVE,
+                                                     spawn_store_master)
+    from paddle_tpu.distributed.fleet.elastic.manager import \
+        ElasticManager
+    from paddle_tpu.distributed.resilient_store import ResilientStore
+
+    root, logs = _roots(tmp_path)
+    endpoint_file = os.path.join(root, "store.endpoint")
+    wal_path = os.path.join(root, "store.wal")
+    master, _ep = spawn_store_master(
+        endpoint_file=endpoint_file, wal_path=wal_path,
+        log_path=os.path.join(logs, "master.log"))
+    store = ResilientStore(endpoint_file=endpoint_file, deadline=3.0)
+    mgr = ElasticManager(store, "nodeA", np="1",
+                         heartbeat_interval=0.2, lease_ttl=4.0)
+    try:
+        mgr.register()
+        assert mgr.alive_nodes() == ["nodeA"]
+        # kill the master mid-heartbeat, respawn from WAL
+        master.kill()
+        master.wait(timeout=30)
+        _LIVE.discard(master)
+        master, _ep2 = spawn_store_master(
+            endpoint_file=endpoint_file, wal_path=wal_path,
+            log_path=os.path.join(logs, "master2.log"))
+        # within one TTL the lease must still hold: the slot keys were
+        # replayed and a reconnected beat refreshed the heartbeat key
+        deadline = time.monotonic() + mgr.ttl
+        while time.monotonic() < deadline:
+            assert mgr.alive_nodes() == ["nodeA"], \
+                "node lost its lease across a master respawn"
+            time.sleep(0.3)
+        assert store.generation == 2
+    finally:
+        mgr.exit()
+        store.close()
+
+
+@pytest.mark.slow
+def test_store_master_kill_pre_save_recovers(tmp_path):
+    """The pre-save phase: the master dies before the nonce exchange —
+    the whole staged-commit protocol (nonce publish, barrier, promote
+    flag) then runs against the respawned master."""
+    root, logs = _roots(tmp_path)
+    report = run_store_kill_drill(
+        root, world=2, total_steps=5, kill_step=3, phase="pre-save",
+        relaunch_extra_steps=2, log_dir=logs)
+    assert report["rcs"] == [0, 0]
+    assert report["latest"] == 5
+    assert report["relaunch_latest"] == 7
+
+
+@pytest.mark.slow
+def test_store_master_never_respawned_fails_within_deadline(tmp_path):
+    """No supervisor: the master stays dead.  Every rank must exhaust
+    its client deadline and exit EXIT_STORE_LOST — bounded, clean,
+    step ``kill_step`` never committed."""
+    root, logs = _roots(tmp_path)
+    t0 = time.monotonic()
+    report = run_store_kill_drill(
+        root, world=2, total_steps=5, kill_step=3, phase="pre-save",
+        respawn=False, store_deadline=3.0, barrier_timeout=5.0,
+        storekill_timeout=10.0, gen_timeout=60.0, log_dir=logs)
+    assert report["rcs"] == [19, 19]
+    assert report["latest"] == 2
+    assert time.monotonic() - t0 < 60
+
+
+@pytest.mark.slow
+def test_store_master_kill_3proc_recovers(tmp_path):
+    """Same mid-barrier failover at world=3: three ranks' arrivals must
+    all come back from the WAL for the respawned master to seal."""
+    root, logs = _roots(tmp_path)
+    report = run_store_kill_drill(
+        root, world=3, total_steps=5, kill_step=3, phase="mid-barrier",
+        relaunch_extra_steps=1, log_dir=logs)
+    assert report["rcs"] == [0, 0, 0]
+    assert report["latest"] == 5
+    assert report["relaunch_latest"] == 6

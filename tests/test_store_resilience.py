@@ -1,0 +1,511 @@
+"""Store-failover units: WAL round-trips, torn tails, generation
+fencing, ResilientStore reconnect/fencing/deadline semantics, the
+TCPStore satellite fixes (error context, large-value resize, b""
+1-tuple), the store_barrier transient-retry contract, and the
+store telemetry/healthz block.
+
+The real kill-the-master drills live in
+tests/drills/test_store_failover_drills.py; everything here is
+in-process and fast.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.core import (GENERATION_KEY, DurableTCPStoreServer,
+                             StoreWAL, TCPStore, native_available,
+                             replay_wal)
+from paddle_tpu.core import store_server as _ss
+from paddle_tpu.distributed import resilient_store as _rs
+from paddle_tpu.distributed.checkpoint import store_barrier
+from paddle_tpu.distributed.resilient_store import (
+    ResilientStore, StoreUnavailableError, read_endpoint_file,
+    write_endpoint_file)
+
+from fault_injection import truncate_file
+
+needs_native = pytest.mark.skipif(not native_available(),
+                                  reason="native TCPStore client "
+                                         "unavailable")
+
+
+# -- WAL units ---------------------------------------------------------------
+
+def test_wal_set_add_delete_roundtrip(tmp_path):
+    wal = str(tmp_path / "store.wal")
+    w = StoreWAL(wal)
+    w.record_set("a", b"hello")
+    w.record_set("empty", b"")
+    w.record_add("cnt", 5)
+    w.record_add("cnt", -2)
+    w.record_set("gone", b"x")
+    w.record_delete("gone")
+    w.close()
+    kv = replay_wal(wal)
+    assert kv["a"] == b"hello"
+    assert kv["empty"] == b""
+    assert struct.unpack("<q", kv["cnt"])[0] == 3
+    assert "gone" not in kv
+
+
+def test_wal_replay_missing_file_is_empty(tmp_path):
+    assert replay_wal(str(tmp_path / "nope.wal")) == {}
+
+
+def test_wal_torn_tail_ignored(tmp_path):
+    """A master SIGKILLed mid-append leaves a half line; replay must
+    keep every intact record and drop only the torn tail."""
+    wal = str(tmp_path / "store.wal")
+    w = StoreWAL(wal)
+    w.record_set("a", b"1")
+    w.record_set("b", b"2")
+    w.close()
+    # tear the final record mid-line (no trailing newline survives)
+    truncate_file(wal, keep=os.path.getsize(wal) - 5)
+    kv = replay_wal(wal)
+    assert kv["a"] == b"1"
+    assert "b" not in kv
+
+
+def test_wal_binary_values_roundtrip(tmp_path):
+    """Arbitrary bytes (not utf-8) survive the JSON journal — base64."""
+    wal = str(tmp_path / "store.wal")
+    blob = bytes(range(256)) * 3
+    w = StoreWAL(wal)
+    w.record_set("blob", blob)
+    w.close()
+    assert replay_wal(wal)["blob"] == blob
+
+
+def test_wal_counter_replay_matches_live_semantics(tmp_path):
+    """ADD replay must agree bit-for-bit with the live 8-byte-LE
+    counter: a replayed barrier count IS the barrier state."""
+    wal = str(tmp_path / "store.wal")
+    w = StoreWAL(wal)
+    w.record_set("cnt", b"not-a-counter")  # overwritten by first add
+    w.record_add("cnt", 7)
+    w.close()
+    kv = replay_wal(wal)
+    live = {}
+    _ss._counter_add(live, "cnt", 7)
+    assert kv["cnt"] == live["cnt"]
+
+
+# -- durable server vs native client ----------------------------------------
+
+@needs_native
+def test_durable_server_restart_restores_state(tmp_path):
+    wal = str(tmp_path / "store.wal")
+    m = TCPStore(is_master=True, wal_path=wal)
+    assert m.generation == 1
+    m.set("k", b"v")
+    assert m.add("cnt", 4) == 4
+    m.delete("k2-never")
+    m.close()
+
+    m2 = TCPStore(is_master=True, wal_path=wal)
+    try:
+        assert m2.generation == 2
+        assert m2.get("k", wait=False) == b"v"
+        assert m2.add("cnt", 0) == 4  # counter restored exactly
+        assert m2.get(GENERATION_KEY, wait=False) == b"2"
+    finally:
+        m2.close()
+
+
+@needs_native
+@pytest.mark.parametrize("wal", [False, True])
+def test_get_large_value_resize_and_empty_tuple_semantics(tmp_path, wal):
+    """Satellite coverage: values beyond the 1 MiB first-shot buffer
+    take the resize-retry path, and b'' is a real value (1-tuple
+    internally, never confused with 'missing') — against BOTH the
+    native server and the durable Python one."""
+    kw = {"wal_path": str(tmp_path / "s.wal")} if wal else {}
+    m = TCPStore(is_master=True, **kw)
+    try:
+        big = os.urandom((1 << 20) + 4097)
+        m.set("big", big)
+        assert m.get("big", wait=False) == big
+        m.set("empty", b"")
+        assert m.get("empty", wait=False) == b""
+        assert m.get("missing", wait=False) is None
+    finally:
+        m.close()
+
+
+@needs_native
+def test_store_errors_name_endpoint_key_and_op(tmp_path):
+    """Satellite: a dead master's errors must say WHAT failed WHERE —
+    host:port, key and op — not a bare 'TCPStore set failed'."""
+    m = TCPStore(is_master=True)
+    host, port = m.host, m.port
+    w = TCPStore(host, port, is_master=False, timeout=5)
+    m.close()  # kill the master under the connected worker
+    with pytest.raises(ConnectionError) as ei:
+        w.set("some/key", b"v")
+    msg = str(ei.value)
+    assert "set" in msg and "some/key" in msg and f"{host}:{port}" in msg
+    with pytest.raises(ConnectionError) as ei:
+        w.add("cnt/key", 1)
+    msg = str(ei.value)
+    assert "add" in msg and "cnt/key" in msg and f"{host}:{port}" in msg
+    w.close()
+
+
+@needs_native
+def test_durable_server_blocking_wait_op(tmp_path):
+    """Protocol op 3 (server-side blocking WAIT) releases when the key
+    appears — the native client's `wait` path must work unchanged
+    against the Python server."""
+    m = TCPStore(is_master=True, wal_path=str(tmp_path / "s.wal"))
+    try:
+        t = threading.Thread(target=lambda: (time.sleep(0.1),
+                                             m.set("late", b"v")))
+        t.start()
+        got = m.get("late", wait=True, timeout=5.0)
+        t.join()
+        assert got == b"v"
+    finally:
+        m.close()
+
+
+# -- endpoint file -----------------------------------------------------------
+
+def test_endpoint_file_roundtrip_and_torn_reads(tmp_path):
+    p = str(tmp_path / "ep")
+    assert read_endpoint_file(p) is None  # absent
+    write_endpoint_file(p, "10.0.0.7", 12345)
+    assert read_endpoint_file(p) == ("10.0.0.7", 12345)
+    with open(p, "w") as f:
+        f.write("garbage-no-colon")
+    assert read_endpoint_file(p) is None
+    with open(p, "w") as f:
+        f.write("host:notaport")
+    assert read_endpoint_file(p) is None
+
+
+def test_generation_key_constants_agree():
+    """resilient_store deliberately does not import core; the two
+    GENERATION_KEY constants must stay identical."""
+    assert _rs.GENERATION_KEY == _ss.GENERATION_KEY
+
+
+# -- ResilientStore (fake factory: no sockets) ------------------------------
+
+class _FakeStore:
+    """In-memory TCPStore double with scriptable failures."""
+
+    def __init__(self, kv=None, generation=None, fail_ops=0):
+        self.kv = dict(kv or {})
+        if generation is not None:
+            self.kv[GENERATION_KEY] = str(generation).encode()
+        self.fail_ops = fail_ops  # raise on the next N mutating ops
+        self.closed = False
+
+    def _maybe_fail(self):
+        if self.fail_ops > 0:
+            self.fail_ops -= 1
+            raise ConnectionError("fake: master gone")
+
+    def get(self, key, wait=True, timeout=None):
+        v = self.kv.get(key)
+        return v
+
+    def set(self, key, value):
+        self._maybe_fail()
+        self.kv[key] = value if isinstance(value, bytes) \
+            else value.encode()
+
+    def add(self, key, delta=1):
+        self._maybe_fail()
+        cur = int(self.kv.get(key, b"\0" * 8) and
+                  struct.unpack("<q", self.kv.get(key, b"\0" * 8))[0])
+        cur += delta
+        self.kv[key] = struct.pack("<q", cur)
+        return cur
+
+    def delete(self, key):
+        self.kv.pop(key, None)
+
+    def num_keys(self):
+        return len(self.kv)
+
+    def close(self):
+        self.closed = True
+
+
+def test_resilient_store_retries_transparently():
+    """A transient ConnectionError mid-op reconnects and retries —
+    the caller never sees it."""
+    backend = _FakeStore(generation=1, fail_ops=1)
+    calls = []
+
+    def factory(host, port, timeout):
+        calls.append((host, port))
+        return backend
+
+    rs = ResilientStore("h", 1, deadline=5.0, store_factory=factory)
+    rs.set("k", b"v")  # first set fails once, retried after reconnect
+    assert backend.kv["k"] == b"v"
+    assert len(calls) == 2  # initial connect + one reconnect
+    assert rs.generation == 1
+
+
+def test_resilient_store_deadline_raises_unavailable():
+    def factory(host, port, timeout):
+        raise ConnectionError("nobody home")
+
+    rs = ResilientStore("deadhost", 99, deadline=0.3,
+                        store_factory=factory)
+    t0 = time.monotonic()
+    with pytest.raises(StoreUnavailableError) as ei:
+        rs.set("k", b"v")
+    assert time.monotonic() - t0 < 5.0
+    e = ei.value
+    assert e.endpoint == "deadhost:99"
+    assert e.op == "set" and e.key == "k"
+    assert e.elapsed is not None and e.elapsed >= 0.3
+    # structured fields also appear in the message
+    msg = str(e)
+    assert "deadhost:99" in msg and "set" in msg and "'k'" in msg
+    # and it still IS a ConnectionError (legacy except clauses work)
+    assert isinstance(e, ConnectionError)
+
+
+def test_resilient_store_fences_amnesiac_master_immediately():
+    """Once generation >= 1 was observed, a reconnect seeing a lower
+    (or missing) generation must fail fast — no deadline burn."""
+    stores = [_FakeStore(generation=3), _FakeStore()]  # amnesiac 2nd
+
+    def factory(host, port, timeout):
+        return stores.pop(0)
+
+    rs = ResilientStore("h", 1, deadline=30.0, store_factory=factory)
+    rs.set("k", b"v")
+    assert rs.generation == 3
+    rs.close()  # force reconnect; next store has NO generation key
+    t0 = time.monotonic()
+    with pytest.raises(StoreUnavailableError) as ei:
+        rs.set("k2", b"v2")
+    assert time.monotonic() - t0 < 5.0  # fence, not deadline
+    assert "amnesiac" in str(ei.value)
+
+
+def test_resilient_store_accepts_generation_bump():
+    stores = [_FakeStore(generation=1), _FakeStore(generation=2)]
+
+    def factory(host, port, timeout):
+        return stores.pop(0)
+
+    rs = ResilientStore("h", 1, deadline=5.0, store_factory=factory)
+    rs.set("a", b"1")
+    rs.close()
+    rs.set("b", b"2")  # respawned master, gen 2: allowed
+    assert rs.generation == 2
+
+
+def test_resilient_store_plain_master_never_arms_fence():
+    """Masters that never advertise a generation (native volatile
+    server) stay fully compatible: the fence never arms."""
+    stores = [_FakeStore(), _FakeStore()]
+
+    def factory(host, port, timeout):
+        return stores.pop(0)
+
+    rs = ResilientStore("h", 1, deadline=5.0, store_factory=factory)
+    rs.set("a", b"1")
+    assert rs.generation is None
+    rs.close()
+    rs.set("b", b"2")  # reconnect to another gen-less master: fine
+
+
+def test_resilient_store_get_wait_and_empty_value():
+    backend = _FakeStore(generation=1)
+    rs = ResilientStore("h", 1, deadline=5.0,
+                        store_factory=lambda *a: backend)
+    backend.kv["empty"] = b""
+    assert rs.get("empty", wait=True, timeout=1.0) == b""  # 1-tuple
+    assert rs.get("missing", wait=False) is None
+    with pytest.raises(TimeoutError):
+        rs.get("never", wait=True, timeout=0.2)
+
+
+def test_resilient_store_endpoint_file_reresolution(tmp_path):
+    """Each reconnect re-reads the endpoint file — a respawn on a new
+    port is transparent."""
+    ep = str(tmp_path / "ep")
+    write_endpoint_file(ep, "hostA", 1111)
+    seen = []
+    backend = _FakeStore(generation=1)
+
+    def factory(host, port, timeout):
+        seen.append((host, port))
+        return backend
+
+    rs = ResilientStore(endpoint_file=ep, deadline=5.0,
+                        store_factory=factory)
+    rs.set("a", b"1")
+    assert seen == [("hostA", 1111)]
+    rs.close()
+    write_endpoint_file(ep, "hostB", 2222)  # master moved
+    rs.set("b", b"2")
+    assert seen[-1] == ("hostB", 2222)
+
+
+# -- store_barrier transient-retry contract ---------------------------------
+
+class _FlakyBarrierStore(_FakeStore):
+    """Fails every op while `down` is set — a master mid-respawn."""
+
+    def __init__(self):
+        super().__init__()
+        self.down = False
+
+    def _gate(self):
+        if self.down:
+            raise ConnectionError("master restarting")
+
+    def get(self, key, wait=True, timeout=None):
+        self._gate()
+        return super().get(key, wait=wait, timeout=timeout)
+
+    def set(self, key, value):
+        self._gate()
+        return super().set(key, value)
+
+    def add(self, key, delta=1):
+        self._gate()
+        return super().add(key, delta)
+
+
+def test_store_barrier_rides_transient_outage():
+    """A ConnectionError while polling is retried within the deadline
+    instead of failing the commit instantly (satellite)."""
+    s = _FlakyBarrierStore()
+    s.set("b/rank/1", b"1")  # peer already arrived
+    s.add("b", 1)
+
+    def _restore():
+        time.sleep(0.3)
+        s.down = False
+
+    t = threading.Thread(target=_restore)
+    s.down = True
+    t.start()
+    try:
+        # arrival itself must also ride the outage
+        store_barrier(s, "b", world=2, rank=0, timeout=10.0)
+    finally:
+        t.join()
+
+
+def test_store_barrier_terminal_on_store_unavailable():
+    """StoreUnavailableError from a ResilientStore that exhausted ITS
+    deadline is terminal — the barrier must not burn its own timeout
+    re-retrying a lost cause."""
+
+    class _Gone:
+        def set(self, key, value):
+            raise StoreUnavailableError("master gone for good",
+                                        endpoint="h:1", op="set",
+                                        key=key, elapsed=9.9)
+
+        def add(self, key, delta=1):
+            raise StoreUnavailableError("master gone for good",
+                                        endpoint="h:1", op="add",
+                                        key=key, elapsed=9.9)
+
+        def get(self, key, wait=True, timeout=None):
+            return None
+
+    t0 = time.monotonic()
+    with pytest.raises(StoreUnavailableError):
+        store_barrier(_Gone(), "b", world=2, rank=0, timeout=30.0)
+    assert time.monotonic() - t0 < 5.0  # terminal, not 30s of retries
+
+
+def test_store_barrier_double_arrival_cannot_release_early():
+    """With per-rank sealing, a retried arrival that double-bumps the
+    shared counter must NOT release the barrier while a rank is truly
+    missing (the at-least-once `add` hazard)."""
+    s = _FakeStore()
+    # rank 0 arrived TWICE (retry after a lost reply): counter says 2
+    s.set("b/rank/0", b"1")
+    s.add("b", 1)
+    s.add("b", 1)
+    assert s.add("b", 0) == 2  # the counter alone would (wrongly) seal
+    with pytest.raises(TimeoutError) as ei:
+        store_barrier(s, "b", world=2, rank=0, timeout=0.4)
+    assert "missing ranks [1]" in str(ei.value)
+    assert "arrived: [0]" in str(ei.value)
+
+
+# -- telemetry / healthz ----------------------------------------------------
+
+def test_healthz_store_block_positive_evidence_only():
+    from paddle_tpu.observability import telemetry as tel_mod
+    tel_mod.reset()
+    try:
+        t = tel_mod.get_telemetry()
+        t.enable()
+        # no store activity at all: no block, healthy
+        h = t.healthz()
+        assert h["store"] is None and h["ok"] is True
+        # successful ops: block present, healthy, generation surfaced
+        t.record_store_op(generation=2)
+        h = t.healthz()
+        assert h["ok"] is True
+        assert h["store"]["ok"] is True
+        assert h["store"]["generation"] == 2
+        assert h["store"]["last_ok_age_sec"] is not None
+        # a declared unavailability AFTER the last success: unhealthy
+        t.record_store_unavailable(7.5, op="set", endpoint="h:1")
+        h = t.healthz()
+        assert h["store"]["ok"] is False and h["ok"] is False
+        # recovery: a later successful op clears it
+        t.record_store_op(generation=3)
+        h = t.healthz()
+        assert h["store"]["ok"] is True and h["ok"] is True
+    finally:
+        tel_mod.reset()
+
+
+def test_store_metrics_reconnects_and_unavailable_histogram():
+    from paddle_tpu.observability import telemetry as tel_mod
+    tel_mod.reset()
+    try:
+        t = tel_mod.get_telemetry()
+        t.enable()
+        t.record_store_reconnect("set")
+        t.record_store_reconnect("set")
+        t.record_store_reconnect("get")
+        t.record_store_unavailable(3.0, op="get", endpoint="h:1")
+        text = t.registry.prometheus_text()
+        assert 'pt_store_reconnects_total{op="set"} 2' in text
+        assert 'pt_store_reconnects_total{op="get"} 1' in text
+        assert "pt_store_unavailable_seconds" in text
+    finally:
+        tel_mod.reset()
+
+
+def test_resilient_store_emits_reconnect_metric():
+    """The ResilientStore wiring feeds pt_store_reconnects_total."""
+    from paddle_tpu.observability import telemetry as tel_mod
+    tel_mod.reset()
+    try:
+        t = tel_mod.get_telemetry()
+        t.enable()
+        backend = _FakeStore(generation=1, fail_ops=1)
+        rs = ResilientStore("h", 1, deadline=5.0,
+                            store_factory=lambda *a: backend)
+        rs.set("k", b"v")
+        text = t.registry.prometheus_text()
+        assert 'pt_store_reconnects_total{op="set"} 1' in text
+        assert "pt_store_generation 1" in text
+    finally:
+        tel_mod.reset()
