@@ -220,6 +220,19 @@ def _spread_ms(times):
             "max": round(s[-1], 2)}
 
 
+def _cluster_snapshot():
+    """Aggregated cluster view for the record: skew, per-rank step
+    p50/p95, total recompiles — from a running aggregator when
+    PT_AGGREGATOR_URL is set, else a single-rank local summary.  Must
+    never sink a bench run: failures come back as {"error": ...}."""
+    try:
+        from paddle_tpu.observability import cluster_snapshot
+        return cluster_snapshot(
+            url=os.environ.get("PT_AGGREGATOR_URL") or None)
+    except Exception as e:  # snapshot is best-effort by contract
+        return {"error": str(e)[:200]}
+
+
 # ---------------------------------------------------------------------------
 # Legs (each runs inside its own subprocess; writes into `result`)
 # ---------------------------------------------------------------------------
@@ -731,6 +744,7 @@ def main():
         else:
             result.pop("errors", None)
         result["telemetry_driver"] = tel.snapshot()
+        result["telemetry_cluster"] = _cluster_snapshot()
         print(json.dumps(result), flush=True)
 
     def merge(rec, stage):

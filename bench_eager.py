@@ -111,6 +111,12 @@ def main():
         if res["raw_jax"] else None
     res["value"] = res["tape_on"]
     res["telemetry"] = tel.snapshot()
+    try:
+        from paddle_tpu.observability import cluster_snapshot
+        res["telemetry_cluster"] = cluster_snapshot(
+            url=os.environ.get("PT_AGGREGATOR_URL") or None)
+    except Exception as e:  # snapshot is best-effort by contract
+        res["telemetry_cluster"] = {"error": str(e)[:200]}
     print(json.dumps(res))
 
 

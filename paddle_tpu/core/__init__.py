@@ -32,10 +32,12 @@ __all__ = [
     "tracer_dump", "tracer_clear", "tracer_events", "HostBufferPool",
     "host_memory_stats", "WorkQueue", "TCPStore",
     "DurableTCPStoreServer", "StoreWAL", "replay_wal", "GENERATION_KEY",
+    "obs_endpoint_key", "obs_world_key",
 ]
 
 from .store_server import (  # noqa: E402  (stdlib-only, no cycle)
     GENERATION_KEY, DurableTCPStoreServer, StoreWAL, replay_wal,
+    obs_endpoint_key, obs_world_key,
 )
 
 _lib = None

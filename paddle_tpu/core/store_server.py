@@ -42,7 +42,7 @@ import struct
 import threading
 
 __all__ = ["GENERATION_KEY", "StoreWAL", "replay_wal",
-           "DurableTCPStoreServer"]
+           "DurableTCPStoreServer", "obs_endpoint_key", "obs_world_key"]
 
 logger = logging.getLogger(__name__)
 
@@ -50,6 +50,24 @@ logger = logging.getLogger(__name__)
 # non-durable masters (native server, wal_path=None) so fencing stays
 # inert where there is nothing durable to fence against.
 GENERATION_KEY = "store/generation"
+
+
+def obs_endpoint_key(run_id, process_index):
+    """Canonical store key under which rank ``process_index`` of run
+    ``run_id`` publishes its "host:port" metrics endpoint.  Mirrored
+    (not imported — this module must stay stdlib-only and the
+    observability package jax/core-free) by
+    ``observability.aggregator.endpoint_key``; the test suite pins the
+    two formats equal."""
+    return f"obs/{run_id}/endpoint/{int(process_index)}"
+
+
+def obs_world_key(run_id):
+    """Canonical store key holding run ``run_id``'s expected world
+    size (ASCII decimal).  Mirror of
+    ``observability.aggregator.world_key``."""
+    return f"obs/{run_id}/world"
+
 
 _I64 = struct.Struct("<q")
 

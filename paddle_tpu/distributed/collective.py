@@ -26,6 +26,9 @@ semantics for test parity, SURVEY §5):
 """
 from __future__ import annotations
 
+import functools
+import time
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -223,6 +226,37 @@ def _observe(op, x):
     tel.collective_op(op, nbytes)
 
 
+def _timed(op):
+    """Per-op host-boundary latency: ``pt_collective_time_seconds{op}``
+    around the whole public call (dispatch + the eager shard_map
+    execution).  Recorded ONLY outside traces — inside a trace the
+    wall clock would measure tracing, not transport, so a dirty trace
+    state skips the observation (``_observe``'s count/bytes still fire
+    once per trace).  Wall time around async dispatch is a lower
+    bound; eager collectives here execute via ``Group._shard_eval``,
+    which materializes, so the number is the honest host cost."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            from ..observability import get_telemetry
+            tel = get_telemetry()
+            if not tel.enabled:
+                return fn(*args, **kwargs)
+            try:
+                tracing = not jax.core.trace_state_clean()
+            except Exception:
+                tracing = True  # unknown trace state: don't time
+            if tracing:
+                return fn(*args, **kwargs)
+            t0 = time.perf_counter()
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                tel.collective_time(op, time.perf_counter() - t0)
+        return wrapper
+    return deco
+
+
 def _ret(x, like):
     if isinstance(like, Tensor):
         like._data = x
@@ -257,6 +291,7 @@ def wait(tensor, group=None, use_calc_stream=True):
 # collectives
 # ---------------------------------------------------------------------------
 
+@_timed("all_reduce")
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """ref: ``communication/all_reduce.py`` → ``ProcessGroupNCCL::AllReduce``
     (``process_group_nccl.cc:160``). SPMD: ``lax.psum`` family. Eager:
@@ -284,6 +319,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     return res
 
 
+@_timed("all_gather")
 def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True,
                axis=0):
     """ref: ``communication/all_gather.py``. Two call forms like the
@@ -326,6 +362,7 @@ def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True,
     return Tensor(out)
 
 
+@_timed("gather")
 def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
     """ref: ``communication/gather.py``: collect per-rank tensors into
     ``gather_list`` on ``dst``. Single-controller eager mode sees every
@@ -357,6 +394,7 @@ def all_gather_object(object_list, obj, group=None):
     return object_list
 
 
+@_timed("broadcast")
 def broadcast(tensor, src=0, group=None, sync_op=True):
     """ref: ``communication/broadcast.py``. SPMD: select src's value via
     all_gather+index (compiled to a broadcast over ICI)."""
@@ -387,6 +425,7 @@ def broadcast_object_list(object_list, src=0, group=None):
     return object_list
 
 
+@_timed("reduce")
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     """ref: ``communication/reduce.py``: only dst's slot keeps the result,
     other slots keep their input (matching NCCL reduce semantics)."""
@@ -416,6 +455,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     return _ret(out, tensor)
 
 
+@_timed("scatter")
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     """ref: ``communication/scatter.py``: src rank's list is distributed,
     one element per rank."""
@@ -451,6 +491,7 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0,
     return out_object_list
 
 
+@_timed("alltoall")
 def alltoall(out_tensor_list, in_tensor_list=None, group=None, sync_op=True):
     """ref: ``communication/all_to_all.py``. Eager rank-major form: input
     ``[nranks, nranks, ...]`` (slot [i, j] = rank i's tensor for rank j)
@@ -497,6 +538,7 @@ def alltoall(out_tensor_list, in_tensor_list=None, group=None, sync_op=True):
 all_to_all = alltoall
 
 
+@_timed("alltoall_single")
 def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
                     out_split_sizes=None, group=None, sync_op=True):
     """Even-split all_to_all on one tensor (ref:
@@ -528,6 +570,7 @@ def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
     return Tensor(out)
 
 
+@_timed("reduce_scatter")
 def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
                    sync_op=True):
     """ref: ``communication/reduce_scatter.py``: each rank's input is the
@@ -573,6 +616,7 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
 _MAILBOX: dict[tuple, list] = {}
 
 
+@_timed("send")
 def send(tensor, dst=0, group=None, sync_op=True):
     g = _group_of(group)
     if _in_axis_scope(g.axis_name):
@@ -585,6 +629,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
     return _Task()
 
 
+@_timed("recv")
 def recv(tensor, src=0, group=None, sync_op=True):
     g = _group_of(group)
     box = _MAILBOX.get((g.id, src, max(g.rank, 0)), None)
@@ -615,6 +660,7 @@ def batch_isend_irecv(p2p_op_list):
     return tasks
 
 
+@_timed("barrier")
 def barrier(group=None):
     """All ranks sync. XLA programs are bulk-synchronous; eager barrier is a
     tiny psum across the group's devices."""

@@ -49,10 +49,21 @@ respawned master seals the commit barrier from REPLAYED arrivals, and
 the run finishes bit-for-bit.  Respawned WITHOUT the WAL, the
 generation fence trips and every rank exits ``EXIT_STORE_LOST``
 within its deadline instead of hanging.
+
+Scrape drills (:func:`.runner.run_scrape_drill`) exercise the
+cluster-observability plane instead of the checkpoint plane: every
+worker publishes its real /metrics endpoint into the store, a real
+aggregator subprocess (``python -m paddle_tpu.observability.aggregator``)
+discovers and scrapes the fleet, and the drill proves summed counters,
+merged histogram buckets, nonzero cross-rank step-time skew, the
+cross-rank recompile-storm alarm, stale-marking of a SIGKILLed rank
+(bounded — never a hang), aggregator restart reconvergence, and the
+``observability.merge`` CLI stitching per-rank telemetry JSONL into
+one time-ordered stream.
 """
-__all__ = ["KillSpec", "StoreKillSpec", "run_drill",
-           "run_store_kill_drill", "spawn_worker", "spawn_store_master",
-           "reap_all"]
+__all__ = ["KillSpec", "StoreKillSpec", "ObsSpec", "run_drill",
+           "run_store_kill_drill", "run_scrape_drill", "spawn_worker",
+           "spawn_store_master", "spawn_aggregator", "reap_all"]
 
 
 def __getattr__(name):

@@ -14,11 +14,14 @@ assertion fails (see tests/drills/conftest.py's reaper fixture).
 """
 from __future__ import annotations
 
+import json
 import logging
 import os
 import signal
 import subprocess
 import sys
+import urllib.error
+import urllib.request
 import uuid
 
 from ...core import TCPStore
@@ -27,10 +30,11 @@ from ..checkpoint import read_leaf, verify_checkpoint
 from ..checkpoint_manager import CheckpointManager
 from ..resilient_store import ResilientStore, read_endpoint_file
 from .worker import (EXIT_SAVE_FAILED, EXIT_STORE_LOST, advance,
-                     init_state)
+                     init_state, obs_ready_key, obs_release_key)
 
-__all__ = ["KillSpec", "StoreKillSpec", "DrillFailure", "spawn_worker",
-           "spawn_store_master", "run_drill", "run_store_kill_drill",
+__all__ = ["KillSpec", "StoreKillSpec", "ObsSpec", "DrillFailure",
+           "spawn_worker", "spawn_store_master", "spawn_aggregator",
+           "run_drill", "run_store_kill_drill", "run_scrape_drill",
            "reap_all"]
 
 logger = logging.getLogger(__name__)
@@ -68,6 +72,24 @@ class KillSpec:
         return self.step - 1
 
 
+class ObsSpec:
+    """Scripted cluster-observability worker (``DRILL_OBS=1``): enable
+    real telemetry, publish the /metrics endpoint, record a rank-skewed
+    synthetic step profile (+ optionally a genuine recompile-sentinel
+    trip), then hold the endpoint open until released."""
+
+    __slots__ = ("telemetry_dir", "step_base", "storm",
+                 "sentinel_threshold", "hold_timeout")
+
+    def __init__(self, telemetry_dir, step_base=0.01, storm=True,
+                 sentinel_threshold=3, hold_timeout=120.0):
+        self.telemetry_dir = telemetry_dir
+        self.step_base = float(step_base)
+        self.storm = bool(storm)
+        self.sentinel_threshold = int(sentinel_threshold)
+        self.hold_timeout = float(hold_timeout)
+
+
 class StoreKillSpec:
     """Scripted STORE-MASTER kill: every rank rendezvouses at ``phase``
     of step ``step``'s save (``pre-save`` | ``mid-barrier``), and the
@@ -103,20 +125,24 @@ def reap_all():
 def spawn_worker(rank, world, *, root, port=0, total_steps, run_id,
                  barrier_timeout, kill=None, elastic=True,
                  orphan_age=None, log_path=None, endpoint_file=None,
-                 store_deadline=None, storekill=None):
+                 store_deadline=None, storekill=None, obs=None):
     """Launch one drill worker subprocess; returns its Popen (also
     registered for :func:`reap_all`).
 
     ``endpoint_file`` switches the worker to a ResilientStore resolved
     through that file (the store-failover mode; ``port`` is then
     ignored); ``storekill`` (a :class:`StoreKillSpec`) arms the
-    master-kill rendezvous in every rank.
+    master-kill rendezvous in every rank; ``obs`` (an
+    :class:`ObsSpec`) switches the worker to the cluster-observability
+    mode (requires ``endpoint_file``; ``total_steps`` becomes the
+    synthetic step count).
     """
     env = {k: v for k, v in os.environ.items()
            if not k.startswith("DRILL_")}
     env.update({
         "JAX_PLATFORMS": "cpu",
         "PT_RUN_ID": run_id,
+        "PT_PROCESS_INDEX": str(rank),
         "DRILL_RANK": str(rank),
         "DRILL_WORLD": str(world),
         "DRILL_CKPT": root,
@@ -141,6 +167,16 @@ def spawn_worker(rank, world, *, root, port=0, total_steps, run_id,
         env["DRILL_STOREKILL_PHASE"] = storekill.phase
         env["DRILL_STOREKILL_STEP"] = str(storekill.step)
         env["DRILL_STOREKILL_TIMEOUT"] = str(storekill.timeout)
+    if obs is not None:
+        if endpoint_file is None:
+            raise ValueError("ObsSpec workers publish endpoints via "
+                             "the store: endpoint_file is required")
+        env["DRILL_OBS"] = "1"
+        env["DRILL_TELEMETRY_DIR"] = obs.telemetry_dir
+        env["DRILL_OBS_STEP_BASE"] = str(obs.step_base)
+        env["DRILL_OBS_STORM"] = "1" if obs.storm else "0"
+        env["DRILL_OBS_TIMEOUT"] = str(obs.hold_timeout)
+        env["PT_RECOMPILE_THRESHOLD"] = str(obs.sentinel_threshold)
     cmd = [sys.executable, "-m", "paddle_tpu.distributed.drill.worker"]
     if log_path:
         with open(log_path, "ab") as out:
@@ -196,6 +232,85 @@ def spawn_store_master(*, endpoint_file, wal_path=None, port=0,
     logger.info("store master pid %d serving at %s:%d (wal=%s)",
                 p.pid, ep[0], ep[1], wal_path)
     return p, ep
+
+
+def spawn_aggregator(*, endpoint_file, run_id, port_file,
+                     interval=0.25, stale_after=2.0, storm_threshold=1,
+                     scrape_timeout=2.0, store_deadline=10.0,
+                     log_path=None, spawn_timeout=60.0):
+    """Launch the cluster aggregator as a REAL subprocess
+    (``python -m paddle_tpu.observability.aggregator``) discovering
+    rank endpoints through the store, and wait for it to publish its
+    own bound address into ``port_file``.  Returns
+    ``(Popen, (host, port))``; registered for :func:`reap_all`."""
+    try:
+        os.unlink(port_file)
+    except FileNotFoundError:
+        pass
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [sys.executable, "-m", "paddle_tpu.observability.aggregator",
+           "--run-id", run_id,
+           "--store-endpoint-file", endpoint_file,
+           "--store-deadline", str(store_deadline),
+           "--port-file", port_file,
+           "--interval", str(interval),
+           "--stale-after", str(stale_after),
+           "--scrape-timeout", str(scrape_timeout),
+           "--storm-threshold", str(storm_threshold)]
+    if log_path:
+        with open(log_path, "ab") as out:
+            p = subprocess.Popen(cmd, env=env, stdout=out,
+                                 stderr=subprocess.STDOUT)
+    else:
+        p = subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
+                             stderr=subprocess.DEVNULL)
+    _LIVE.add(p)
+
+    def _published():
+        if p.poll() is not None:
+            raise DrillFailure(
+                f"aggregator died during startup (rc {p.poll()})")
+        return read_endpoint_file(port_file)
+
+    try:
+        ep = wait_until(_published, spawn_timeout,
+                        desc="aggregator to publish its endpoint")
+    except TimeoutError as e:
+        raise DrillFailure(f"aggregator never came up: {e}") from e
+    logger.info("aggregator pid %d serving at %s:%d", p.pid, ep[0],
+                ep[1])
+    return p, ep
+
+
+def _http_get(url, timeout=5.0):
+    """Bounded GET returning (status, body-text); a 503 (/healthz with
+    the alarm up) still returns its body."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, resp.read().decode("utf-8")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode("utf-8")
+
+
+def _sample_value(families, name, **labels):
+    """First sample of ``name`` whose labels are a superset of
+    ``labels`` (None when absent) — tolerant of extra labels like
+    run_id so drill assertions only pin what they mean to pin."""
+    fam = families.get(name)
+    if fam is None:
+        for f in families.values():
+            for sname, lbls, value in f["samples"]:
+                if sname == name and all(
+                        lbls.get(k) == v for k, v in labels.items()):
+                    return value
+        return None
+    for sname, lbls, value in fam["samples"]:
+        if sname == name and all(lbls.get(k) == v
+                                 for k, v in labels.items()):
+            return value
+    return None
 
 
 def _wait_fleet(procs, timeout):
@@ -449,5 +564,302 @@ def run_store_kill_drill(root, *, world=2, total_steps=5, kill_step=3,
                     f"{latest2}, wanted {more}")
             _verify_bit_for_bit(root, latest2)
     finally:
+        reap_all()
+    return report
+
+
+def run_scrape_drill(root, *, world=3, steps=12, step_base=0.01,
+                     kill_rank=2, storm=True, restart_aggregator=False,
+                     respawn_master=False, stale_after=2.0,
+                     scrape_interval=0.25, store_deadline=10.0,
+                     gen_timeout=120.0, log_dir=None):
+    """End-to-end cluster-observability drill: ``world`` REAL worker
+    processes publish their /metrics endpoints into the store, a REAL
+    aggregator subprocess discovers and scrapes them, and the runner
+    asserts the cluster view — summed counters, merged histogram
+    buckets, a nonzero cross-rank step-time skew (each rank's synthetic
+    step profile is ``step_base * (1 + rank)``), and (when ``storm``)
+    the recompile-storm alarm tripping on the CROSS-RANK aggregate.
+
+    ``kill_rank`` (None to skip) is then SIGKILLed while still holding
+    its endpoint open: the aggregator must mark it stale
+    (``pt_rank_up 0``, ``pt_cluster_ranks_up`` down by one) within
+    bounded polls — never hang.  ``restart_aggregator`` kills and
+    respawns the aggregator itself mid-drill (its cluster view must
+    reconverge from store discovery alone); ``respawn_master``
+    SIGKILLs the WAL-backed store master and proves discovery survives
+    the failover.  Finally the fleet is released, exit codes checked,
+    and ``python -m paddle_tpu.observability.merge`` stitches the
+    per-rank telemetry JSONL into one time-ordered rank-labeled stream
+    that is validated line-for-line.  Returns a report dict.
+    """
+    endpoint_file = os.path.join(root, "store.endpoint")
+    wal_path = os.path.join(root, "store.wal")
+    port_file = os.path.join(root, "aggregator.endpoint")
+    telemetry_dir = os.path.join(root, "telemetry")
+    os.makedirs(telemetry_dir, exist_ok=True)
+    sentinel_threshold = 3
+    storm_threshold = world if storm else world * 1000
+
+    def _log(name):
+        return os.path.join(log_dir, name) if log_dir else None
+
+    master, _ep = spawn_store_master(
+        endpoint_file=endpoint_file, wal_path=wal_path,
+        log_path=_log("store_master.log"))
+    run_id = f"obs-{uuid.uuid4().hex[:6]}"
+    spec = ObsSpec(telemetry_dir=telemetry_dir, step_base=step_base,
+                   storm=storm, sentinel_threshold=sentinel_threshold,
+                   hold_timeout=gen_timeout)
+    report = {"run_id": run_id, "world": world, "steps": steps,
+              "aggregator_restarted": False, "master_respawned": False}
+    watch = None
+    try:
+        procs = [
+            spawn_worker(
+                r, world, root=root, total_steps=steps, run_id=run_id,
+                barrier_timeout=gen_timeout,
+                endpoint_file=endpoint_file,
+                store_deadline=store_deadline, obs=spec,
+                log_path=_log(f"obs_rank{r}.log"))
+            for r in range(world)
+        ]
+
+        # every rank has published its endpoint, observed its steps
+        # (and tripped its sentinel) before we let the aggregator judge
+        watch = ResilientStore(endpoint_file=endpoint_file,
+                               deadline=store_deadline)
+        for r in range(world):
+            watch.get(obs_ready_key(run_id, r), wait=True,
+                      timeout=gen_timeout / 2)
+
+        agg, (ahost, aport) = spawn_aggregator(
+            endpoint_file=endpoint_file, run_id=run_id,
+            port_file=port_file, interval=scrape_interval,
+            stale_after=stale_after, storm_threshold=storm_threshold,
+            store_deadline=store_deadline,
+            log_path=_log("aggregator.log"))
+        base = f"http://{ahost}:{aport}"
+
+        from ...observability.aggregator import parse_prometheus_text
+
+        def _cluster_families():
+            """One bounded scrape of the aggregator; None while it is
+            still converging or between restarts."""
+            if agg.poll() is not None:
+                raise DrillFailure(
+                    f"aggregator exited mid-drill (rc {agg.poll()})")
+            try:
+                _status, body = _http_get(base + "/metrics", timeout=5.0)
+            except OSError:
+                return None
+            try:
+                return parse_prometheus_text(body)
+            except ValueError as e:
+                raise DrillFailure(
+                    f"aggregated /metrics is not valid exposition "
+                    f"format: {e}") from e
+
+        def _converged(want_up, want_steps):
+            def poll():
+                fams = _cluster_families()
+                if fams is None:
+                    return None
+                up = _sample_value(fams, "pt_cluster_ranks_up")
+                total = _sample_value(fams, "pt_steps_total",
+                                      mode="train")
+                if up == want_up and (
+                        want_steps is None or total == want_steps):
+                    return fams
+                return None
+            return poll
+
+        fams = wait_until(
+            _converged(world, float(world * steps)), gen_timeout / 2,
+            desc=f"aggregator to converge on {world} fresh ranks")
+
+        # --- the cluster view: sums, merged buckets, skew, storms ----
+        skew = _sample_value(fams, "pt_step_time_skew_seconds",
+                             mode="train")
+        if not skew or skew <= 0.0:
+            raise DrillFailure(
+                f"pt_step_time_skew_seconds is {skew!r}; rank-skewed "
+                f"step profiles must yield a positive cross-rank skew")
+        straggler = _sample_value(
+            fams, "pt_step_time_straggler_ratio", mode="train")
+        if not straggler or straggler < 1.0:
+            raise DrillFailure(
+                f"straggler ratio {straggler!r}, expected >= 1.0")
+        hist_count = _sample_value(fams, "pt_step_time_seconds_count",
+                                   mode="train")
+        if hist_count != float(world * steps):
+            raise DrillFailure(
+                f"merged pt_step_time_seconds_count is {hist_count}, "
+                f"expected {world * steps} (bucket merge lost samples)")
+        storms_total = _sample_value(
+            fams, "pt_cluster_recompile_storms_total")
+        alarm = _sample_value(fams, "pt_cluster_recompile_storm_alarm")
+        status, hbody = _http_get(base + "/healthz", timeout=5.0)
+        health = json.loads(hbody)
+        if storm:
+            if storms_total != float(world):
+                raise DrillFailure(
+                    f"cluster recompile storms {storms_total}, expected "
+                    f"{world} (one sentinel trip per rank)")
+            if alarm != 1.0:
+                raise DrillFailure(
+                    f"storm alarm is {alarm}, expected 1 at cross-rank "
+                    f"aggregate >= threshold {storm_threshold}")
+            if status != 503 or not health.get("storm_alarm"):
+                raise DrillFailure(
+                    f"/healthz returned {status} storm_alarm="
+                    f"{health.get('storm_alarm')}, expected 503/true")
+        else:
+            if alarm not in (0.0, None):
+                raise DrillFailure(
+                    f"storm alarm tripped ({alarm}) without a storm")
+            if status != 200:
+                raise DrillFailure(
+                    f"/healthz returned {status}, expected 200")
+        report.update({
+            "skew_seconds": skew, "straggler_ratio": straggler,
+            "merged_steps": hist_count, "storms_total": storms_total,
+            "storm_alarm": alarm, "healthz": health,
+        })
+
+        if respawn_master:
+            # store failover: the aggregator's discovery client must
+            # ride the endpoint-file re-resolve onto the new master,
+            # whose WAL replay still holds every published endpoint
+            watch.close()
+            watch = None
+            master.kill()
+            master.wait(timeout=30)
+            _LIVE.discard(master)
+            master, _ep = spawn_store_master(
+                endpoint_file=endpoint_file, wal_path=wal_path,
+                log_path=_log("store_master_respawn.log"))
+            watch = ResilientStore(endpoint_file=endpoint_file,
+                                   deadline=store_deadline)
+            # prove the replayed master bumped its generation
+            watch.get(obs_ready_key(run_id, 0), wait=False)
+            gen = watch.generation
+            if gen is None or gen < 2:
+                raise DrillFailure(
+                    f"respawned store master advertises generation "
+                    f"{gen}, expected >= 2")
+            report["store_generation"] = gen
+            wait_until(
+                _converged(world, float(world * steps)), gen_timeout / 2,
+                desc="aggregator to reconverge after master respawn")
+            report["master_respawned"] = True
+
+        if kill_rank is not None:
+            # a rank goes silent mid-run: the aggregator must mark it
+            # stale within bounded scrapes — each poll here is itself
+            # bounded, so a hang in the aggregator fails loudly
+            procs[kill_rank].kill()
+
+            def _stale():
+                fams = _cluster_families()
+                if fams is None:
+                    return None
+                dead = _sample_value(fams, "pt_rank_up",
+                                     process_index=str(kill_rank))
+                up = _sample_value(fams, "pt_cluster_ranks_up")
+                if dead == 0.0 and up == float(world - 1):
+                    return fams
+                return None
+
+            wait_until(
+                _stale, gen_timeout / 4,
+                desc=f"aggregator to mark killed rank {kill_rank} "
+                     f"stale")
+            report["stale_after_kill"] = True
+
+        if restart_aggregator:
+            # the aggregator itself dies and respawns: its cluster view
+            # must reconverge from store discovery alone
+            agg.kill()
+            agg.wait(timeout=30)
+            _LIVE.discard(agg)
+            agg, (ahost, aport) = spawn_aggregator(
+                endpoint_file=endpoint_file, run_id=run_id,
+                port_file=port_file, interval=scrape_interval,
+                stale_after=stale_after,
+                storm_threshold=storm_threshold,
+                store_deadline=store_deadline,
+                log_path=_log("aggregator_restart.log"))
+            base = f"http://{ahost}:{aport}"
+            live = world - (0 if kill_rank is None else 1)
+            live_steps = float(live * steps)
+            wait_until(
+                _converged(live, live_steps), gen_timeout / 2,
+                desc="respawned aggregator to reconverge")
+            report["aggregator_restarted"] = True
+
+        # release the fleet and collect exit codes
+        watch.set(obs_release_key(run_id), b"1")
+        rcs = _wait_fleet(procs, gen_timeout)
+        report["rcs"] = rcs
+        for r, rc in enumerate(rcs):
+            if kill_rank is not None and r == kill_rank:
+                if rc != -signal.SIGKILL:
+                    raise DrillFailure(
+                        f"killed rank {r} exited {rc}, expected SIGKILL")
+            elif rc != 0:
+                raise DrillFailure(
+                    f"obs rank {r} exited {rc}, expected 0")
+
+        # --- merge CLI: one time-ordered rank-labeled stream ---------
+        merged_path = os.path.join(root, "merged.jsonl")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH",
+                                                         "")
+        cli = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.observability.merge",
+             telemetry_dir, "--output", merged_path],
+            env=env, capture_output=True, text=True, timeout=60)
+        if cli.returncode != 0:
+            raise DrillFailure(
+                f"merge CLI exited {cli.returncode}: {cli.stderr}")
+        expected_lines = 0
+        for name in os.listdir(telemetry_dir):
+            if name.endswith(".jsonl") or name.endswith(".jsonl.1"):
+                with open(os.path.join(telemetry_dir, name)) as f:
+                    expected_lines += sum(1 for ln in f if ln.strip())
+        ranks_seen, run_ids, last_ts, merged_lines = set(), set(), "", 0
+        with open(merged_path) as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                merged_lines += 1
+                rec = json.loads(line)
+                ranks_seen.add(rec.get("process_index"))
+                run_ids.add(rec.get("run_id"))
+                ts = rec.get("ts") or ""
+                if ts < last_ts:
+                    raise DrillFailure(
+                        f"merged stream is not time-ordered: {ts!r} "
+                        f"after {last_ts!r}")
+                last_ts = ts
+        if merged_lines != expected_lines:
+            raise DrillFailure(
+                f"merge CLI wrote {merged_lines} records from "
+                f"{expected_lines} input lines")
+        if ranks_seen != set(range(world)):
+            raise DrillFailure(
+                f"merged stream labels ranks {sorted(ranks_seen)}, "
+                f"expected 0..{world - 1}")
+        if run_ids != {run_id}:
+            raise DrillFailure(
+                f"merged stream run_ids {run_ids}, expected "
+                f"{{{run_id!r}}}")
+        report.update({"merge_lines": merged_lines,
+                       "expected_lines": expected_lines})
+    finally:
+        if watch is not None:
+            watch.close()
         reap_all()
     return report

@@ -31,6 +31,18 @@ Run as ``python -m paddle_tpu.distributed.drill.worker`` with the
    ``storekill/<run_id>/go``; the runner kills the master only after
    all ranks are provably in-flight, and sets ``go`` through the
    respawned one.
+ - ``DRILL_OBS=1``: cluster-observability mode (:func:`_obs_main`) —
+   no checkpoints at all.  The worker enables real telemetry with an
+   ephemeral ``/metrics`` endpoint + JSONL sink
+   (``DRILL_TELEMETRY_DIR``), publishes the endpoint into the store,
+   records a rank-skewed synthetic step profile
+   (``DRILL_OBS_STEP_BASE`` × (1 + rank) — nonzero cross-rank skew by
+   construction, no sleeping) and optionally a genuine
+   recompile-sentinel trip (``DRILL_OBS_STORM=1``), then announces
+   ``obs/<run_id>/ready/<rank>`` and holds the endpoint open until the
+   runner sets ``obs/<run_id>/release`` (bounded by
+   ``DRILL_OBS_TIMEOUT``) — the window in which the aggregator
+   scrapes, a victim is SIGKILLed, masters respawn.
 
 The "model" is a (12, 4) fp32 array row-partitioned across ranks via
 :class:`~paddle_tpu.distributed.checkpoint.HostLocalShard` (12 divides
@@ -82,6 +94,56 @@ def advance(w, bias, steps=1):
         w = w * np.float32(1.01) + np.float32(0.125)
         bias = bias * np.float32(0.99) - np.float32(0.0625)
     return w, bias
+
+
+def obs_ready_key(run_id, rank):
+    """Rank announces 'endpoint published, profile recorded' here."""
+    return f"obs/{run_id}/ready/{rank}"
+
+
+def obs_release_key(run_id):
+    """Runner sets this to let the obs fleet exit 0."""
+    return f"obs/{run_id}/release"
+
+
+def _obs_main(env, rank, world, total, run_id):
+    """Cluster-observability drill mode (``DRILL_OBS=1``); see the
+    module docstring for the env contract."""
+    from ...observability import get_telemetry
+    from ..resilient_store import ResilientStore, StoreUnavailableError
+
+    hold = float(env.get("DRILL_OBS_TIMEOUT", "120"))
+    store = ResilientStore(
+        endpoint_file=env["DRILL_ENDPOINT_FILE"],
+        deadline=float(env.get("DRILL_STORE_DEADLINE", "10")))
+    tel = get_telemetry().enable(
+        jsonl_dir=env.get("DRILL_TELEMETRY_DIR") or None,
+        http_port=0, compile_watch=False)
+    try:
+        tel.publish_endpoint(store, world_size=world)
+        base = float(env.get("DRILL_OBS_STEP_BASE", "0.01"))
+        for _ in range(total):
+            # synthetic, rank-scaled durations: rank r's mean step is
+            # base*(1+r), so cluster skew is exactly base*(world-1)>0
+            # without any real sleeping
+            tel.observe_step(base * (1.0 + rank), mode="train",
+                             batch_size=8)
+        if env.get("DRILL_OBS_STORM") == "1":
+            # a genuine sentinel trip: threshold compiles of ONE
+            # callable with threshold distinct signatures
+            for k in range(tel.sentinel.threshold):
+                tel.record_compile("drill_step_fn",
+                                   f"(f32[{k + 2},8])")
+        store.set(obs_ready_key(run_id, rank), b"1")
+        logger.info("obs worker ready; holding endpoint open")
+        store.get(obs_release_key(run_id), wait=True, timeout=hold)
+    except (StoreUnavailableError, TimeoutError) as e:
+        logger.error("obs drill: store lost while holding: %s", e)
+        sys.exit(EXIT_STORE_LOST)
+    finally:
+        store.close()
+    logger.info("obs worker released")
+    sys.exit(0)
 
 
 def _arm_storekill(store, rank, run_id, step, phase, timeout):
@@ -144,6 +206,10 @@ def main():
     logging.basicConfig(
         level=logging.INFO, stream=sys.stderr,
         format=f"[drill rank {rank}] %(levelname)s %(message)s")
+
+    if env.get("DRILL_OBS") == "1":
+        _obs_main(env, rank, world, total, run_id)
+        return  # unreachable (_obs_main exits), defensive only
 
     # arm the scripted kill BEFORE any checkpoint machinery runs
     from . import injector

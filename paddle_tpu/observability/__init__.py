@@ -12,6 +12,12 @@ and did something silently recompile?"* at runtime:
                       compile counts and the recompile sentinel
  - :mod:`.events`     per-process, size-rotated JSONL event stream
  - :mod:`.server`     stdlib HTTP endpoint: ``/metrics`` + ``/healthz``
+ - :mod:`.aggregator` cluster view: scrape every rank's endpoint
+                      (store-discovered), merge series, derive
+                      step-time skew / straggler ratio / the
+                      cross-rank recompile-storm alarm, re-serve
+ - :mod:`.merge`      CLI stitching per-process telemetry JSONL
+                      streams into one time-ordered rank-labeled one
  - :mod:`.logs`       the library logger that bare ``print`` is banned
                       in favor of (lint rule TPU010)
 
@@ -37,6 +43,22 @@ from .telemetry import (TrainingTelemetry, StepTimer, CompileWatcher,
                         reset)
 from .server import MetricsServer, start_http_server
 
+# Aggregator exports resolve lazily: eagerly importing the submodule
+# here would shadow `python -m paddle_tpu.observability.aggregator`
+# (runpy warns when the module is already in sys.modules) and ranks
+# that never aggregate shouldn't pay for the parser.
+_AGGREGATOR_EXPORTS = ("ClusterAggregator", "MergeConflict",
+                       "parse_prometheus_text", "merge_scrapes",
+                       "render_exposition", "cluster_snapshot")
+
+
+def __getattr__(name):
+    if name in _AGGREGATOR_EXPORTS:
+        from . import aggregator
+        return getattr(aggregator, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
 __all__ = [
     "get_logger",
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
@@ -45,4 +67,6 @@ __all__ = [
     "TrainingTelemetry", "StepTimer", "CompileWatcher",
     "RecompileSentinel", "get_telemetry", "configure", "reset",
     "MetricsServer", "start_http_server",
+    "ClusterAggregator", "MergeConflict", "parse_prometheus_text",
+    "merge_scrapes", "render_exposition", "cluster_snapshot",
 ]

@@ -1,0 +1,867 @@
+"""Cluster-level observability: scrape every rank, merge, re-serve.
+
+The per-process half of the package gives each rank its own
+``/metrics`` endpoint with ``process_index``/``run_id`` const labels
+(:meth:`~.metrics.MetricsRegistry.set_const_labels`, stamped by
+``TrainingTelemetry.enable``) and publishes the endpoint into the
+coordination store (``TrainingTelemetry.publish_endpoint``).  This
+module is the other half — the one process that answers cluster-level
+questions:
+
+ - :func:`parse_prometheus_text`  text exposition 0.0.4 → families
+ - :func:`merge_scrapes`          cross-rank merge: counters summed
+   (``process_index`` dropped), histogram buckets summed bucket-by-
+   bucket (cumulative counts add because sums of cumulatives are the
+   cumulative of the sum; mismatched ``le`` layouts are a
+   :class:`MergeConflict`), gauges kept per-rank labeled (an identical
+   label set from two ranks is a conflict — it would silently
+   last-write-win)
+ - :class:`ClusterAggregator`     discovery (store keys or a static
+   map) + a bounded-time scrape loop + derived cluster metrics:
+   cross-rank step-time skew (max−min of per-rank means), the p95
+   straggler ratio (slowest rank's p95 / cluster-median p95), per-rank
+   liveness, and a recompile-storm alarm that trips on sentinel counts
+   SUMMED across ranks (one rank tripping N times or N ranks tripping
+   once look the same to the job)
+ - ``python -m paddle_tpu.observability.aggregator``  serves the
+   merged view as cluster ``/metrics`` + ``/healthz`` (HTTP 503 while
+   the storm alarm is up)
+ - :func:`cluster_snapshot`       the compact dict bench records attach
+
+Liveness contract: a rank going silent must never stall the cluster
+view.  Every scrape is bounded by ``scrape_timeout``; a rank whose
+last good scrape is older than ``stale_after`` is dropped from merges
+but stays visible as ``pt_rank_up{process_index=...} 0``.
+
+Import contract: stdlib-only at module level (no jax, no
+``paddle_tpu.distributed``) so the aggregator process stays cheap to
+spawn; ``ResilientStore`` is imported lazily by the CLI.
+
+Env (all read by :func:`main` as flag defaults): ``PT_AGGREGATOR_PORT``
+``PT_AGGREGATOR_INTERVAL`` ``PT_AGGREGATOR_STALE_AFTER``
+``PT_AGGREGATOR_SCRAPE_TIMEOUT`` ``PT_AGGREGATOR_STORM_THRESHOLD``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from .logs import get_logger
+from .metrics import _escape_help, _fmt, _labels_text
+
+__all__ = [
+    "MergeConflict", "parse_prometheus_text", "merge_scrapes",
+    "render_exposition", "bucket_percentile", "ClusterAggregator",
+    "cluster_snapshot", "endpoint_key", "world_key", "main",
+]
+
+logger = get_logger(__name__)
+
+_INF = float("inf")
+
+# -- store key conventions ---------------------------------------------------
+# mirrored as core.store_server.obs_endpoint_key/obs_world_key (which
+# stdlib-only tools share) WITHOUT importing core here; the test suite
+# pins the two formats equal.
+
+
+def endpoint_key(run_id, process_index):
+    """Store key under which rank ``process_index`` publishes its
+    "host:port" metrics endpoint."""
+    return f"obs/{run_id}/endpoint/{int(process_index)}"
+
+
+def world_key(run_id):
+    """Store key holding run ``run_id``'s expected world size."""
+    return f"obs/{run_id}/world"
+
+
+# -- exposition parsing ------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)(?:\s+-?\d+)?$")
+
+_HISTO_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _parse_value(s):
+    if s == "+Inf":
+        return _INF
+    if s == "-Inf":
+        return -_INF
+    return float(s)  # float("NaN") handles NaN
+
+
+def _unescape_label(s):
+    out = []
+    i, n = 0, len(s)
+    while i < n:
+        c = s[i]
+        if c == "\\" and i + 1 < n:
+            out.append({"n": "\n", '"': '"', "\\": "\\"}.get(
+                s[i + 1], "\\" + s[i + 1]))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _parse_labels(block):
+    """``name="value",...`` inside the braces; values may contain
+    escaped quotes/backslashes/newlines and commas."""
+    labels = {}
+    i, n = 0, len(block)
+    while i < n:
+        if block[i] in ", ":
+            i += 1
+            continue
+        eq = block.find("=", i)
+        if eq < 0 or eq + 1 >= n or block[eq + 1] != '"':
+            raise ValueError(f"malformed label block: {block!r}")
+        name = block[i:eq].strip()
+        j = eq + 2
+        buf = []
+        while j < n and block[j] != '"':
+            if block[j] == "\\" and j + 1 < n:
+                buf.append(block[j:j + 2])
+                j += 2
+            else:
+                buf.append(block[j])
+                j += 1
+        if j >= n:
+            raise ValueError(f"unterminated label value: {block!r}")
+        labels[name] = _unescape_label("".join(buf))
+        i = j + 1
+    return labels
+
+
+def parse_prometheus_text(text):
+    """Parse text exposition 0.0.4 into ``{family_name: {"kind",
+    "help", "samples": [(sample_name, labels_dict, value), ...]}}``.
+
+    Histogram children (``*_bucket``/``*_sum``/``*_count``) are folded
+    into their family (declared by the preceding ``# TYPE``).  Raises
+    ``ValueError`` on a malformed line — a scrape either parses or is
+    discarded whole.
+    """
+    families: dict = {}
+
+    def fam(name):
+        f = families.get(name)
+        if f is None:
+            f = families[name] = {"kind": "untyped", "help": "",
+                                  "samples": []}
+        return f
+
+    for line in text.splitlines():
+        stripped = line.strip()
+        if not stripped:
+            continue
+        if stripped.startswith("# TYPE "):
+            name, _, kind = stripped[len("# TYPE "):].partition(" ")
+            fam(name)["kind"] = kind.strip() or "untyped"
+            continue
+        if stripped.startswith("# HELP "):
+            name, _, help_ = stripped[len("# HELP "):].partition(" ")
+            fam(name)["help"] = help_
+            continue
+        if stripped.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(stripped)
+        if not m:
+            raise ValueError(f"unparseable exposition line: {line!r}")
+        sname, lblock, raw = m.group(1), m.group(2), m.group(3)
+        try:
+            value = _parse_value(raw)
+        except ValueError:
+            raise ValueError(f"bad sample value in line: {line!r}")
+        labels = _parse_labels(lblock) if lblock else {}
+        family = sname
+        for suf in _HISTO_SUFFIXES:
+            base = sname[:-len(suf)] if sname.endswith(suf) else None
+            if base and base in families \
+                    and families[base]["kind"] == "histogram":
+                family = base
+                break
+        fam(family)["samples"].append((sname, labels, value))
+    return families
+
+
+# -- cross-rank merge --------------------------------------------------------
+
+
+class MergeConflict(ValueError):
+    """Two ranks' series cannot be merged: kind mismatch, identical
+    gauge label sets, or misaligned histogram bucket layouts."""
+
+
+def _label_key(labels, drop=()):
+    return tuple(sorted((k, v) for k, v in labels.items()
+                        if k not in drop))
+
+
+def _merge_family(m, name, fam, rank, drop):
+    kind = m["kind"]
+    if kind == "counter":
+        for sname, labels, value in fam["samples"]:
+            key = _label_key(labels, drop)
+            m["series"][key] = m["series"].get(key, 0.0) + value
+    elif kind == "histogram":
+        staged: dict = {}
+        for sname, labels, value in fam["samples"]:
+            if sname.endswith("_bucket"):
+                le = _parse_value(labels.get("le", "+Inf"))
+                rest = {k: v for k, v in labels.items() if k != "le"}
+                h = staged.setdefault(_label_key(rest, drop),
+                                      {"buckets": {}, "sum": 0.0,
+                                       "count": 0.0})
+                h["buckets"][le] = value
+            elif sname.endswith("_sum"):
+                h = staged.setdefault(_label_key(labels, drop),
+                                      {"buckets": {}, "sum": 0.0,
+                                       "count": 0.0})
+                h["sum"] = value
+            elif sname.endswith("_count"):
+                h = staged.setdefault(_label_key(labels, drop),
+                                      {"buckets": {}, "sum": 0.0,
+                                       "count": 0.0})
+                h["count"] = value
+            else:
+                raise MergeConflict(
+                    f"{name}: unexpected histogram sample {sname!r}")
+        for key, h in staged.items():
+            cur = m["series"].get(key)
+            if cur is None:
+                m["series"][key] = h
+            else:
+                if set(cur["buckets"]) != set(h["buckets"]):
+                    raise MergeConflict(
+                        f"{name}{dict(key)}: histogram bucket layouts "
+                        f"differ across ranks (rank {rank} disagrees) "
+                        f"— cumulative counts cannot be summed")
+                for le, c in h["buckets"].items():
+                    cur["buckets"][le] += c
+                cur["sum"] += h["sum"]
+                cur["count"] += h["count"]
+    else:  # gauge / untyped: keep the full per-rank label set
+        for sname, labels, value in fam["samples"]:
+            key = _label_key(labels)
+            if key in m["series"]:
+                raise MergeConflict(
+                    f"{name}{dict(key)}: identical label set exported "
+                    f"by two scrapes (second seen on rank {rank}) — a "
+                    f"per-rank series needs a process_index label, "
+                    f"merging would silently last-write-win")
+            m["series"][key] = value
+
+
+def merge_scrapes(scrapes, drop_labels=("process_index",),
+                  on_conflict="raise"):
+    """Merge per-rank parsed scrapes (``{rank: families}`` as returned
+    by :func:`parse_prometheus_text`) into one cluster view.
+
+    Returns ``(merged, conflicts)`` where ``merged`` maps family name →
+    ``{"kind", "help", "series"}`` (counter/gauge series keyed by label
+    tuple → value; histogram series → ``{"buckets": {le: cum}, "sum",
+    "count"}``) and ``conflicts`` lists human-readable rejections.
+    ``on_conflict="raise"`` (tests, CI) raises :class:`MergeConflict`
+    on the first one; ``"skip"`` (the serving loop) drops the whole
+    conflicted family and keeps going — a bad series must not take
+    down the cluster view.
+    """
+    if on_conflict not in ("raise", "skip"):
+        raise ValueError(f"on_conflict must be raise|skip, "
+                         f"got {on_conflict!r}")
+    drop = tuple(drop_labels)
+    merged: dict = {}
+    rejected: set = set()
+    conflicts: list = []
+    for rank in sorted(scrapes):
+        for name, fam in scrapes[rank].items():
+            if name in rejected:
+                continue
+            m = merged.get(name)
+            try:
+                if m is None:
+                    m = merged[name] = {"kind": fam["kind"],
+                                        "help": fam["help"],
+                                        "series": {}}
+                elif m["kind"] != fam["kind"]:
+                    raise MergeConflict(
+                        f"{name}: kind {m['kind']} vs {fam['kind']} "
+                        f"(rank {rank}) — same name must mean the "
+                        f"same instrument on every rank")
+                _merge_family(m, name, fam, rank, drop)
+            except MergeConflict as e:
+                if on_conflict == "raise":
+                    raise
+                conflicts.append(str(e))
+                rejected.add(name)
+                merged.pop(name, None)
+    return merged, conflicts
+
+
+def render_exposition(merged):
+    """Merged families (from :func:`merge_scrapes`) → exposition text,
+    deterministically ordered."""
+    out = []
+    for name in sorted(merged):
+        m = merged[name]
+        out.append(f"# HELP {name} {_escape_help(m['help'])}")
+        out.append(f"# TYPE {name} {m['kind']}")
+        if m["kind"] == "histogram":
+            for key in sorted(m["series"]):
+                h = m["series"][key]
+                names = [k for k, _ in key]
+                values = [v for _, v in key]
+                for le in sorted(h["buckets"]):
+                    lt = _labels_text(names, values,
+                                      extra=(("le", _fmt(le)),))
+                    out.append(f"{name}_bucket{lt} "
+                               f"{_fmt(h['buckets'][le])}")
+                lbl = _labels_text(names, values)
+                out.append(f"{name}_sum{lbl} {_fmt(h['sum'])}")
+                out.append(f"{name}_count{lbl} {_fmt(h['count'])}")
+        else:
+            for key in sorted(m["series"]):
+                names = [k for k, _ in key]
+                values = [v for _, v in key]
+                out.append(f"{name}{_labels_text(names, values)} "
+                           f"{_fmt(m['series'][key])}")
+    return "\n".join(out) + ("\n" if out else "")
+
+
+def bucket_percentile(buckets, count, q):
+    """Bucket-interpolated percentile from cumulative ``{le: cum}`` —
+    the parsed-scrape twin of :meth:`.metrics.Histogram.percentile`
+    (None while empty)."""
+    if not count:
+        return None
+    target = q * count
+    prev_cum, lo = 0.0, 0.0
+    for le in sorted(buckets):
+        cum = buckets[le]
+        n = cum - prev_cum
+        if cum >= target and n:
+            if le == _INF:
+                return lo
+            return lo + (le - lo) * ((target - prev_cum) / n)
+        prev_cum = cum
+        if le != _INF:
+            lo = le
+    return lo
+
+
+def _rank_step_stats(families):
+    """Per-mode ``{count, mean, p50, p95}`` from one rank's
+    ``pt_step_time_seconds`` (empty dict when the rank has none)."""
+    fam = families.get("pt_step_time_seconds")
+    if fam is None:
+        return {}
+    per_mode: dict = {}
+    for sname, labels, value in fam["samples"]:
+        mode = labels.get("mode", "")
+        rec = per_mode.setdefault(mode, {"buckets": {}, "sum": 0.0,
+                                         "count": 0.0})
+        if sname.endswith("_bucket"):
+            rec["buckets"][_parse_value(labels.get("le", "+Inf"))] = value
+        elif sname.endswith("_sum"):
+            rec["sum"] = value
+        elif sname.endswith("_count"):
+            rec["count"] = value
+    out = {}
+    for mode, rec in per_mode.items():
+        c = rec["count"]
+        out[mode] = {
+            "count": int(c),
+            "mean": (rec["sum"] / c) if c else None,
+            "p50": bucket_percentile(rec["buckets"], c, 0.50),
+            "p95": bucket_percentile(rec["buckets"], c, 0.95),
+        }
+    return out
+
+
+def _family_total(families, name):
+    """Sum of every sample of a counter family (0.0 when absent)."""
+    fam = families.get(name)
+    if fam is None:
+        return 0.0
+    return sum(v for sname, _labels, v in fam["samples"]
+               if sname == name)
+
+
+def _median(xs):
+    s = sorted(xs)
+    n = len(s)
+    if not n:
+        return None
+    mid = n // 2
+    return s[mid] if n % 2 else (s[mid - 1] + s[mid]) / 2.0
+
+
+# -- the aggregator ----------------------------------------------------------
+
+
+class ClusterAggregator:
+    """Discover rank endpoints, scrape them on a bounded clock, merge,
+    and derive cluster metrics (see module docstring for semantics).
+
+    ``endpoints`` is a static ``{rank: "host:port"}`` map; ``store``
+    (any TCPStore-shaped client, normally a ``ResilientStore``) adds
+    dynamic discovery through the ``obs/<run_id>/...`` keys — both may
+    be used together, the store refreshing/overriding the static map.
+    """
+
+    def __init__(self, *, endpoints=None, store=None, run_id="local",
+                 stale_after=5.0, scrape_timeout=2.0, storm_threshold=1,
+                 interval=1.0, drop_labels=("process_index",)):
+        self.run_id = str(run_id)
+        self.stale_after = float(stale_after)
+        self.scrape_timeout = float(scrape_timeout)
+        self.storm_threshold = int(storm_threshold)
+        self.interval = float(interval)
+        self.drop_labels = tuple(drop_labels)
+        self._store = store
+        self._endpoints = {int(r): str(ep)
+                           for r, ep in (endpoints or {}).items()}
+        self._scrapes: dict = {}  # rank -> {"ts", "families", "error"}
+        self._conflicts_total = 0
+        self._scrape_errors_total = 0
+        self._lock = threading.Lock()
+        self._text = "\n".join([
+            "# HELP pt_cluster_ranks_up ranks scraped fresh",
+            "# TYPE pt_cluster_ranks_up gauge",
+            "pt_cluster_ranks_up 0",
+        ]) + "\n"
+        self._health = {"ok": True, "run_id": self.run_id,
+                        "ranks_discovered": 0, "ranks_up": 0}
+        self._thread = None
+        self._stop = threading.Event()
+
+    # -- discovery / scraping -----------------------------------------------
+
+    def discover(self):
+        """Refresh the rank → endpoint map from the store (no-op
+        without one).  Discovery failures are logged, never raised —
+        the loop keeps serving the last known endpoints."""
+        if self._store is not None:
+            try:
+                raw = self._store.get(world_key(self.run_id), wait=False)
+                world = int(raw.decode("ascii")) if raw else 0
+                for r in range(world):
+                    v = self._store.get(endpoint_key(self.run_id, r),
+                                        wait=False)
+                    if v:
+                        self._endpoints[r] = \
+                            v.decode("ascii").strip()
+            except Exception as e:
+                logger.warning("aggregator discovery failed (will "
+                               "retry): %s", e)
+        return dict(self._endpoints)
+
+    def scrape_once(self):
+        """One bounded pass: scrape every known endpoint (each GET
+        capped at ``scrape_timeout`` — a dead rank costs one timeout,
+        never a hang), then re-render the merged view."""
+        for rank, ep in sorted(self.discover().items()):
+            url = f"http://{ep}/metrics"
+            try:
+                with urllib.request.urlopen(
+                        url, timeout=self.scrape_timeout) as resp:
+                    text = resp.read().decode("utf-8")
+                families = parse_prometheus_text(text)
+            except Exception as e:
+                self._scrape_errors_total += 1
+                err = f"{type(e).__name__}: {e}"
+                prev = self._scrapes.get(rank)
+                if prev is None:
+                    self._scrapes[rank] = {"ts": None, "families": None,
+                                           "error": err}
+                else:
+                    prev["error"] = err  # keep the last good families
+                continue
+            self._scrapes[rank] = {"ts": time.monotonic(),
+                                   "families": families, "error": None}
+        self._render()
+        return self
+
+    # -- merged view ----------------------------------------------------------
+
+    def _render(self):
+        now = time.monotonic()
+        fresh = {}
+        meta = {}
+        for rank, s in sorted(self._scrapes.items()):
+            age = (now - s["ts"]) if s["ts"] is not None else None
+            up = age is not None and age <= self.stale_after
+            meta[rank] = {"up": up, "age": age, "error": s["error"]}
+            if up:
+                fresh[rank] = s["families"]
+        merged, conflicts = merge_scrapes(
+            fresh, drop_labels=self.drop_labels, on_conflict="skip")
+        for c in conflicts:
+            logger.warning("aggregator merge conflict (family "
+                           "dropped): %s", c)
+        self._conflicts_total += len(conflicts)
+
+        # derived cluster families, rendered as extra exposition text
+        extra = []
+
+        def gauge(name, help_, samples):
+            extra.append(f"# HELP {name} {_escape_help(help_)}")
+            extra.append(f"# TYPE {name} gauge")
+            for labels, value in samples:
+                extra.append(f"{name}{_labels_text([], [], extra=labels)}"
+                             f" {_fmt(value)}")
+
+        def counter(name, help_, value):
+            extra.append(f"# HELP {name} {_escape_help(help_)}")
+            extra.append(f"# TYPE {name} counter")
+            extra.append(f"{name} {_fmt(value)}")
+
+        gauge("pt_cluster_ranks",
+              "ranks with a discovered metrics endpoint",
+              [((), len(self._endpoints))])
+        gauge("pt_cluster_ranks_up",
+              "ranks whose last scrape is fresher than stale_after",
+              [((), len(fresh))])
+        gauge("pt_rank_up",
+              "1 while the rank's scrape is fresh, 0 once stale",
+              [((("process_index", str(r)),), 1 if m["up"] else 0)
+               for r, m in meta.items()])
+        gauge("pt_rank_scrape_age_seconds",
+              "age of the rank's last successful scrape",
+              [((("process_index", str(r)),), round(m["age"], 3))
+               for r, m in meta.items() if m["age"] is not None])
+
+        # per-rank step stats + cross-rank skew / straggler ratio
+        stats = {r: _rank_step_stats(f) for r, f in fresh.items()}
+        rank_samples = []
+        for r, per_mode in sorted(stats.items()):
+            for mode, st in sorted(per_mode.items()):
+                for qname in ("p50", "p95"):
+                    if st[qname] is not None:
+                        rank_samples.append((
+                            (("mode", mode),
+                             ("process_index", str(r)),
+                             ("quantile", qname)), st[qname]))
+        gauge("pt_rank_step_time_seconds",
+              "per-rank step-time quantiles (bucket-interpolated from "
+              "the rank's own histogram)", rank_samples)
+
+        modes = sorted({m for per in stats.values() for m in per})
+        skew_samples, ratio_samples = [], []
+        skew_by_mode, ratio_by_mode = {}, {}
+        for mode in modes:
+            means = [per[mode]["mean"] for per in stats.values()
+                     if mode in per and per[mode]["mean"] is not None]
+            p95s = [per[mode]["p95"] for per in stats.values()
+                    if mode in per and per[mode]["p95"] is not None]
+            if means:
+                skew = max(means) - min(means)
+                skew_by_mode[mode] = skew
+                skew_samples.append(((("mode", mode),), skew))
+            med = _median(p95s)
+            if med:
+                ratio = max(p95s) / med
+                ratio_by_mode[mode] = ratio
+                ratio_samples.append(((("mode", mode),), ratio))
+        gauge("pt_step_time_skew_seconds",
+              "cross-rank step-time skew: max minus min of per-rank "
+              "mean step time (stragglers dominate synchronous SPMD)",
+              skew_samples)
+        gauge("pt_step_time_straggler_ratio",
+              "slowest rank's p95 step time over the cluster-median "
+              "p95 (1.0 = perfectly even)", ratio_samples)
+
+        # recompile-storm alarm on the CROSS-RANK aggregate
+        storms_total = sum(
+            _family_total(f, "pt_recompile_storms_total")
+            for f in fresh.values())
+        alarm = (self.storm_threshold > 0
+                 and storms_total >= self.storm_threshold)
+        counter("pt_cluster_recompile_storms_total",
+                "recompile-sentinel trips summed across ranks",
+                storms_total)
+        gauge("pt_cluster_recompile_storm_alarm",
+              "1 while summed sentinel trips >= the storm threshold",
+              [((), 1 if alarm else 0)])
+        counter("pt_cluster_merge_conflicts_total",
+                "families dropped from the merged view over this "
+                "aggregator's lifetime", self._conflicts_total)
+        counter("pt_cluster_scrape_errors_total",
+                "failed scrape attempts (timeouts, refused "
+                "connections, parse errors)", self._scrape_errors_total)
+
+        text = render_exposition(merged) + "\n".join(extra) + "\n"
+
+        ranks_health = {}
+        for r, m in sorted(meta.items()):
+            entry = {"up": m["up"],
+                     "scrape_age_sec": (round(m["age"], 3)
+                                        if m["age"] is not None
+                                        else None),
+                     "error": m["error"]}
+            if r in fresh:
+                entry["steps"] = int(_family_total(fresh[r],
+                                                   "pt_steps_total"))
+                entry["step_time"] = {
+                    mode: {"count": st["count"],
+                           "mean_ms": (round(st["mean"] * 1e3, 3)
+                                       if st["mean"] is not None
+                                       else None),
+                           "p50_ms": (round(st["p50"] * 1e3, 3)
+                                      if st["p50"] is not None
+                                      else None),
+                           "p95_ms": (round(st["p95"] * 1e3, 3)
+                                      if st["p95"] is not None
+                                      else None)}
+                    for mode, st in sorted(stats[r].items())}
+                entry["recompile_storms"] = _family_total(
+                    fresh[r], "pt_recompile_storms_total")
+            ranks_health[str(r)] = entry
+        health = {
+            "ok": not alarm,
+            "run_id": self.run_id,
+            "ranks_discovered": len(self._endpoints),
+            "ranks_up": len(fresh),
+            "stale_ranks": sorted(r for r, m in meta.items()
+                                  if not m["up"]),
+            "ranks": ranks_health,
+            "step_time_skew_seconds": {
+                m: round(v, 6) for m, v in skew_by_mode.items()},
+            "step_time_straggler_ratio": {
+                m: round(v, 4) for m, v in ratio_by_mode.items()},
+            "recompile_storms_total": storms_total,
+            "storm_alarm": alarm,
+            "storm_threshold": self.storm_threshold,
+            "merge_conflicts_total": self._conflicts_total,
+            "scrape_errors_total": self._scrape_errors_total,
+        }
+        with self._lock:
+            self._text = text
+            self._health = health
+
+    # -- serving --------------------------------------------------------------
+
+    def prometheus_text(self):
+        with self._lock:
+            return self._text
+
+    def healthz(self):
+        with self._lock:
+            return dict(self._health)
+
+    def start(self):
+        """Run the scrape loop on a daemon thread. Idempotent."""
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def _loop():
+            while not self._stop.is_set():
+                try:
+                    self.scrape_once()
+                except Exception as e:
+                    logger.warning("aggregator scrape cycle failed: "
+                                   "%s", e)
+                self._stop.wait(self.interval)
+
+        self._thread = threading.Thread(
+            target=_loop, name="pt-cluster-aggregator", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=10.0)
+
+
+# -- bench snapshot ----------------------------------------------------------
+
+
+def cluster_snapshot(url=None, timeout=3.0, storm_threshold=1):
+    """Compact cluster dict for bench/MULTICHIP JSON records: skew,
+    per-rank step p50/p95, total recompile storms.
+
+    With ``url`` (normally ``$PT_AGGREGATOR_URL``) the running
+    aggregator's ``/healthz`` IS the snapshot (a 503 body — alarm up —
+    still counts as a successful fetch); without one, the local
+    process's registry is summarized as a single-rank cluster so the
+    record shape is identical either way.  Never raises: failures come
+    back as ``{"error": ...}``.
+    """
+    if url:
+        target = url.rstrip("/")
+        if not target.endswith("/healthz"):
+            target += "/healthz"
+        try:
+            with urllib.request.urlopen(target, timeout=timeout) as r:
+                snap = json.loads(r.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            try:
+                snap = json.loads(e.read().decode("utf-8"))
+            except Exception:
+                return {"error": f"HTTP {e.code}", "source": target}
+        except Exception as e:
+            return {"error": f"{type(e).__name__}: {e}",
+                    "source": target}
+        snap["source"] = target
+        return snap
+    from .metrics import get_registry
+    from .telemetry import get_telemetry
+    tel = get_telemetry()
+    agg = ClusterAggregator(run_id=tel.run_id,
+                            storm_threshold=storm_threshold)
+    try:
+        families = parse_prometheus_text(
+            get_registry().prometheus_text())
+    except ValueError as e:
+        return {"error": str(e), "source": "local"}
+    agg._endpoints[tel.process_index] = "local"
+    agg._scrapes[tel.process_index] = {"ts": time.monotonic(),
+                                       "families": families,
+                                       "error": None}
+    agg._render()
+    snap = agg.healthz()
+    snap["source"] = "local"
+    return snap
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+def _write_endpoint_atomic(path, host, port):
+    # local copy of the atomic publish pattern (tmp + fsync + rename)
+    # so this module needs nothing from paddle_tpu.distributed
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="ascii") as f:
+        f.write(f"{host}:{port}\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _env(name, default):
+    v = os.environ.get(name, "").strip()
+    return v if v else default
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.observability.aggregator",
+        description="Scrape every rank's /metrics, merge, and serve "
+                    "the cluster-level /metrics + /healthz.")
+    ap.add_argument("--run-id",
+                    default=_env("PT_RUN_ID", "local"),
+                    help="run whose obs/<run_id>/... keys to watch")
+    ap.add_argument("--store-endpoint-file", default=None,
+                    help="coordination-store endpoint file (discovery "
+                         "survives master respawn)")
+    ap.add_argument("--store", default=None, metavar="HOST:PORT",
+                    help="fixed coordination-store master address")
+    ap.add_argument("--endpoints", default=None,
+                    metavar="RANK=HOST:PORT,...",
+                    help="static endpoint map (no store needed)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int,
+                    default=int(_env("PT_AGGREGATOR_PORT", "0")),
+                    help="cluster endpoint port (0 = ephemeral)")
+    ap.add_argument("--port-file", default=None,
+                    help="atomically publish the bound host:port here")
+    ap.add_argument("--interval", type=float,
+                    default=float(_env("PT_AGGREGATOR_INTERVAL", "1.0")))
+    ap.add_argument("--stale-after", type=float,
+                    default=float(_env("PT_AGGREGATOR_STALE_AFTER",
+                                       "5.0")),
+                    help="seconds without a good scrape before a rank "
+                         "is dropped from merges")
+    ap.add_argument("--scrape-timeout", type=float,
+                    default=float(_env("PT_AGGREGATOR_SCRAPE_TIMEOUT",
+                                       "2.0")))
+    ap.add_argument("--storm-threshold", type=int,
+                    default=int(_env("PT_AGGREGATOR_STORM_THRESHOLD",
+                                     "1")),
+                    help="summed sentinel trips that flip /healthz to "
+                         "503 (0 disables the alarm)")
+    ap.add_argument("--store-deadline", type=float, default=5.0,
+                    help="ResilientStore per-op retry budget")
+    ap.add_argument("--once", action="store_true",
+                    help="single scrape pass; merged exposition to "
+                         "stdout, exit 0")
+    args = ap.parse_args(argv)
+
+    endpoints = {}
+    if args.endpoints:
+        for part in args.endpoints.split(","):
+            r, sep, ep = part.partition("=")
+            if not sep:
+                ap.error(f"--endpoints entry {part!r} is not "
+                         f"RANK=HOST:PORT")
+            endpoints[int(r)] = ep.strip()
+    store = None
+    if args.store_endpoint_file or args.store:
+        # the one non-stdlib dependency, loaded only when store
+        # discovery is requested (keeps `--endpoints` mode jax-free)
+        from ..distributed.resilient_store import ResilientStore
+        if args.store_endpoint_file:
+            store = ResilientStore(
+                endpoint_file=args.store_endpoint_file,
+                deadline=args.store_deadline)
+        else:
+            host, sep, port = args.store.rpartition(":")
+            if not sep:
+                ap.error(f"--store {args.store!r} is not HOST:PORT")
+            store = ResilientStore(host, int(port),
+                                   deadline=args.store_deadline)
+    if store is None and not endpoints:
+        ap.error("need --store-endpoint-file, --store, or --endpoints")
+
+    agg = ClusterAggregator(
+        endpoints=endpoints, store=store, run_id=args.run_id,
+        stale_after=args.stale_after,
+        scrape_timeout=args.scrape_timeout,
+        storm_threshold=args.storm_threshold, interval=args.interval)
+    if args.once:
+        agg.scrape_once()
+        sys.stdout.write(agg.prometheus_text())
+        return 0
+
+    from .server import MetricsServer
+    srv = MetricsServer(metrics_cb=agg.prometheus_text,
+                        health_cb=agg.healthz, host=args.host,
+                        port=args.port).start()
+    agg.start()
+    if args.port_file:
+        _write_endpoint_atomic(args.port_file, args.host, srv.port)
+    logger.info("cluster aggregator for run %s on http://%s:%d "
+                "(interval=%.2fs stale_after=%.2fs storm_threshold=%d)",
+                args.run_id, args.host, srv.port, args.interval,
+                args.stale_after, args.storm_threshold)
+
+    import signal
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, lambda *_: stop.set())
+        except (ValueError, OSError):
+            pass
+    while not stop.is_set():
+        stop.wait(3600.0)
+    agg.stop()
+    srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,12 +1,20 @@
 """JSONL event sink: append-only structured telemetry stream.
 
-One file per process (``<prefix>-<pid>.jsonl``) so concurrent hosts or
-data workers never interleave half-lines; size-rotated by renaming the
-current file to ``.1`` (single generation — the aggregation story is
-"ship/merge per-process files", see ROADMAP multi-host drills).  Each
-record is one JSON object with an ISO-8601 UTC timestamp:
+One file per process so concurrent hosts or data workers never
+interleave half-lines; size-rotated by renaming the current file to
+``.1`` (single generation — cross-process aggregation is
+``python -m paddle_tpu.observability.merge`` over the per-process
+files).  With a cluster identity (``run_id`` + ``process_index``,
+resolved by :mod:`.telemetry` from ``PT_RUN_ID`` /
+``PT_PROCESS_INDEX`` / ``PADDLE_TRAINER_ID``) the file is
+``<prefix>-<run_id>-<rank>.jsonl`` — pids are NOT stable across
+elastic restarts, so the rank-keyed name is what survives a relaunch;
+without one it stays the legacy ``<prefix>-<pid>.jsonl``, which the
+merge CLI still reads.  Each record is one JSON object with an
+ISO-8601 UTC timestamp:
 
     {"ts": "2026-08-05T12:00:00.123+00:00", "pid": 4242,
+     "run_id": "r7", "process_index": 1,
      "event": "step", "step": 17, "duration_sec": 0.0123, ...}
 
 Lazy by construction: the directory and file are only created on the
@@ -19,6 +27,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import threading
 from datetime import datetime, timezone
 
@@ -26,13 +35,21 @@ __all__ = ["EventSink"]
 
 DEFAULT_MAX_BYTES = 32 << 20
 
+# run_id appears in the filename; keep it shell/fs-safe there (records
+# carry the raw value)
+_UNSAFE = re.compile(r"[^A-Za-z0-9._-]")
+
 
 class EventSink:
     def __init__(self, directory, prefix="telemetry",
-                 max_bytes=DEFAULT_MAX_BYTES):
+                 max_bytes=DEFAULT_MAX_BYTES, run_id=None,
+                 process_index=None):
         self.directory = directory
         self.prefix = prefix
         self.max_bytes = int(max_bytes)
+        self.run_id = run_id
+        self.process_index = (int(process_index)
+                              if process_index is not None else None)
         self.dropped = 0
         self._lock = threading.Lock()
         self._fh = None
@@ -40,6 +57,11 @@ class EventSink:
 
     @property
     def path(self):
+        if self.run_id is not None and self.process_index is not None:
+            rid = _UNSAFE.sub("_", str(self.run_id))
+            return os.path.join(
+                self.directory,
+                f"{self.prefix}-{rid}-{self.process_index}.jsonl")
         return os.path.join(self.directory,
                             f"{self.prefix}-{os.getpid()}.jsonl")
 
@@ -59,6 +81,10 @@ class EventSink:
         rec = {"ts": datetime.now(timezone.utc).isoformat(
                    timespec="milliseconds"),
                "pid": os.getpid(), "event": event}
+        if self.run_id is not None:
+            rec["run_id"] = self.run_id
+        if self.process_index is not None:
+            rec["process_index"] = self.process_index
         rec.update(fields)
         line = json.dumps(rec, default=str) + "\n"
         with self._lock:

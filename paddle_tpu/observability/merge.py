@@ -1,0 +1,161 @@
+"""Stitch per-process telemetry JSONL streams into one cluster stream.
+
+``python -m paddle_tpu.observability.merge <files-or-dirs> [-o OUT]``
+
+Inputs are :class:`~.events.EventSink` files: the identity-aware
+``telemetry-<run_id>-<rank>.jsonl`` (plus its rotated ``.jsonl.1``
+generation) and the legacy ``telemetry-<pid>.jsonl``.  The output is
+one time-ordered JSONL stream in which every record carries
+``process_index`` and ``run_id`` — taken from the record itself when
+present (pids are not stable across elastic restarts, so in-record
+identity always wins) and otherwise recovered from the filename;
+legacy pid-named files with no in-record identity keep ``null`` there
+rather than inventing one.  Ordering is by timestamp with (input file,
+line number) as a stable tiebreaker, so equal-timestamp records never
+shuffle between runs.  Corrupt lines — the torn tail of a SIGKILLed
+rank — are skipped and counted on stderr, never fatal.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from datetime import datetime
+
+__all__ = ["discover_files", "merge_records", "main"]
+
+# telemetry-<run_id>-<rank>.jsonl[.1] — run_id may itself contain
+# dashes, so the rank is the LAST -<digits> group (greedy run match).
+# The legacy telemetry-<pid>.jsonl form has only ONE dash group and
+# deliberately does not match: a pid is not a rank.
+_NEW_NAME = re.compile(
+    r"^(?P<prefix>.+)-(?P<run>.+)-(?P<rank>\d+)\.jsonl(?:\.1)?$")
+
+
+def _file_identity(path):
+    """(run_id, rank) recovered from an EventSink filename; (None,
+    None) for the legacy pid-named form (a pid is not a rank)."""
+    name = os.path.basename(path)
+    m = _NEW_NAME.match(name)
+    if m:
+        return m.group("run"), int(m.group("rank"))
+    return None, None
+
+
+def discover_files(paths):
+    """Expand directories into their telemetry JSONL files; explicit
+    file paths pass through.  Rotated ``.jsonl.1`` generations sort
+    before their live file (they hold the OLDER records)."""
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for name in sorted(os.listdir(p)):
+                if name.endswith(".jsonl") or name.endswith(".jsonl.1"):
+                    out.append(os.path.join(p, name))
+        else:
+            out.append(p)
+
+    def order(path):
+        base = os.path.basename(path)
+        return (base.replace(".jsonl.1", ".jsonl"),
+                0 if base.endswith(".jsonl.1") else 1)
+
+    out.sort(key=order)
+    return out
+
+
+# fromisoformat before 3.11 only accepts 3- or 6-digit fractions;
+# telemetry from other writers may carry any width
+_FRACTION = re.compile(r"\.(\d+)")
+
+
+def _parse_ts(raw):
+    s = str(raw)
+    try:
+        return datetime.fromisoformat(s).timestamp()
+    except (ValueError, TypeError):
+        pass
+    try:
+        fixed = _FRACTION.sub(
+            lambda m: "." + m.group(1)[:6].ljust(6, "0"), s, count=1)
+        return datetime.fromisoformat(fixed).timestamp()
+    except (ValueError, TypeError):
+        return None
+
+
+def merge_records(files):
+    """Read every file, label records with identity, sort by time.
+
+    Returns ``(records, skipped)`` — ``skipped`` counts unparseable
+    lines and unreadable files (both survivable by design: a SIGKILLed
+    rank may leave a torn final line).
+    """
+    keyed = []
+    skipped = 0
+    for order, path in enumerate(files):
+        f_run, f_rank = _file_identity(path)
+        try:
+            fh = open(path, "r", encoding="utf-8")
+        except OSError as e:
+            print(f"merge: cannot read {path}: {e}", file=sys.stderr)
+            skipped += 1
+            continue
+        with fh:
+            for lineno, line in enumerate(fh):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    skipped += 1
+                    continue
+                if not isinstance(rec, dict):
+                    skipped += 1
+                    continue
+                if rec.get("process_index") is None:
+                    rec["process_index"] = f_rank
+                if rec.get("run_id") is None:
+                    rec["run_id"] = f_run
+                ts = _parse_ts(rec.get("ts"))
+                keyed.append((ts if ts is not None else float("inf"),
+                              order, lineno, rec))
+    keyed.sort(key=lambda item: item[:3])
+    return [item[3] for item in keyed], skipped
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.observability.merge",
+        description="Merge per-process telemetry JSONL streams into "
+                    "one time-ordered, rank-labeled stream.")
+    ap.add_argument("paths", nargs="+",
+                    help="JSONL files, or directories containing "
+                         "telemetry-*.jsonl[.1]")
+    ap.add_argument("--output", "-o", default="-",
+                    help="output file (default '-': stdout)")
+    args = ap.parse_args(argv)
+
+    files = discover_files(args.paths)
+    if not files:
+        ap.error("no telemetry JSONL files found under the given paths")
+    records, skipped = merge_records(files)
+
+    out = (sys.stdout if args.output == "-"
+           else open(args.output, "w", encoding="utf-8"))
+    try:
+        for rec in records:
+            out.write(json.dumps(rec, default=str) + "\n")
+    finally:
+        if out is not sys.stdout:
+            out.close()
+    if skipped:
+        print(f"merge: skipped {skipped} unreadable line(s)/file(s)",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

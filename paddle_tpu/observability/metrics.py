@@ -134,10 +134,10 @@ class Counter(_Metric):
     def value(self, **labels):
         return self._child(labels).value
 
-    def expose(self, out):
+    def expose(self, out, const=()):
         for key, c in self._items():
             out.append(f"{self.name}"
-                       f"{_labels_text(self.labelnames, key)} "
+                       f"{_labels_text(self.labelnames, key, extra=const)} "
                        f"{_fmt(c.value)}")
 
     def snapshot_values(self):
@@ -196,15 +196,15 @@ class Histogram(_Metric):
             c.sum += v
             c.count += 1
 
-    def expose(self, out):
+    def expose(self, out, const=()):
         for key, c in self._items():
             cum = 0
             for b, n in zip(self.buckets, c.counts):
                 cum += n
                 le = _labels_text(self.labelnames, key,
-                                  extra=(("le", _fmt(b)),))
+                                  extra=tuple(const) + (("le", _fmt(b)),))
                 out.append(f"{self.name}_bucket{le} {cum}")
-            lbl = _labels_text(self.labelnames, key)
+            lbl = _labels_text(self.labelnames, key, extra=const)
             out.append(f"{self.name}_sum{lbl} {_fmt(c.sum)}")
             out.append(f"{self.name}_count{lbl} {cum}")
 
@@ -244,6 +244,22 @@ class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._metrics: dict[str, _Metric] = {}
+        self._const_labels: tuple = ()
+
+    def set_const_labels(self, **labels):
+        """Labels stamped on EVERY exposed sample (after each metric's
+        declared labels, before a histogram's ``le``) — the identity of
+        this process in a cluster scrape: ``process_index``, ``run_id``.
+        Idempotent; sorted by name so exposition text is stable."""
+        with self._lock:
+            self._const_labels = tuple(
+                sorted((str(k), str(v)) for k, v in labels.items()))
+        return self
+
+    @property
+    def const_labels(self):
+        with self._lock:
+            return dict(self._const_labels)
 
     def _get_or_make(self, cls, name, help, labelnames, **kw):
         labelnames = tuple(labelnames)
@@ -275,11 +291,13 @@ class MetricsRegistry:
 
     def prometheus_text(self):
         """Prometheus text exposition format 0.0.4."""
+        with self._lock:
+            const = self._const_labels
         out = []
         for m in self.collect():
             out.append(f"# HELP {m.name} {_escape_help(m.help)}")
             out.append(f"# TYPE {m.name} {m.kind}")
-            m.expose(out)
+            m.expose(out, const=const)
         return "\n".join(out) + ("\n" if out else "")
 
     def snapshot(self):

@@ -28,15 +28,24 @@ CONTENT_TYPE_METRICS = "text/plain; version=0.0.4; charset=utf-8"
 
 class MetricsServer:
     def __init__(self, registry=None, health_cb=None, host="127.0.0.1",
-                 port=0):
+                 port=0, metrics_cb=None):
+        """``metrics_cb`` (a zero-arg callable returning exposition
+        text) overrides the registry render — how the cluster
+        aggregator re-serves its merged view through this same
+        endpoint."""
         self._registry = registry if registry is not None \
             else get_registry()
+        self._metrics_cb = metrics_cb
         self._health_cb = health_cb
         self._host = host
         self._requested_port = int(port)
         self._httpd = None
         self._thread = None
         self.port = None
+
+    @property
+    def host(self):
+        return self._host
 
     def start(self):
         """Bind + serve on a daemon thread. Idempotent."""
@@ -45,6 +54,8 @@ class MetricsServer:
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         registry = self._registry
+        metrics_cb = (self._metrics_cb if self._metrics_cb is not None
+                      else registry.prometheus_text)
         health_cb = self._health_cb
 
         class _Handler(BaseHTTPRequestHandler):
@@ -59,7 +70,7 @@ class MetricsServer:
                 path = self.path.split("?", 1)[0]
                 try:
                     if path == "/metrics":
-                        body = registry.prometheus_text().encode("utf-8")
+                        body = metrics_cb().encode("utf-8")
                         self._send(200, CONTENT_TYPE_METRICS, body)
                     elif path == "/healthz":
                         health = (health_cb() if health_cb is not None

@@ -63,6 +63,25 @@ def _env_flag(name):
     return os.environ.get(name, "").strip().lower() in _TRUTHY
 
 
+def _resolve_identity():
+    """(process_index, run_id) of this process in a cluster launch.
+
+    ``PT_PROCESS_INDEX`` wins over the launcher-set
+    ``PADDLE_TRAINER_ID``; both default to 0 (a single-process run IS
+    rank 0 of a world of 1).  ``PT_RUN_ID`` defaults to ``"local"``.
+    Pids are deliberately NOT part of the identity — they change on
+    every elastic restart while (run_id, rank) survives.
+    """
+    raw = (os.environ.get("PT_PROCESS_INDEX")
+           or os.environ.get("PADDLE_TRAINER_ID") or "").strip()
+    try:
+        idx = int(raw) if raw else 0
+    except ValueError:
+        idx = 0
+    run_id = (os.environ.get("PT_RUN_ID") or "").strip() or "local"
+    return idx, run_id
+
+
 class RecompileSentinel:
     """Detects recompile storms and names the offending callable.
 
@@ -219,6 +238,7 @@ class TrainingTelemetry:
 
     def __init__(self):
         self.enabled = False
+        self.process_index, self.run_id = _resolve_identity()
         self._lock = threading.RLock()
         self.sentinel = RecompileSentinel(
             threshold=int(os.environ.get("PT_RECOMPILE_THRESHOLD") or 5))
@@ -246,18 +266,29 @@ class TrainingTelemetry:
     def registry(self):
         return get_registry()
 
-    def enable(self, jsonl_dir=None, http_port=None, compile_watch=True):
+    def enable(self, jsonl_dir=None, http_port=None, compile_watch=True,
+               process_index=None, run_id=None):
         """Turn telemetry on (idempotent; each facility added at most
         once).  ``http_port=0`` binds an ephemeral port; ``None`` means
-        no endpoint.  Returns self."""
+        no endpoint.  ``process_index``/``run_id`` override the
+        env-resolved identity stamped on every metric series and JSONL
+        record.  Returns self."""
         with self._lock:
+            if process_index is not None:
+                self.process_index = int(process_index)
+            if run_id is not None:
+                self.run_id = str(run_id)
             if not self.enabled:
                 self.enabled = True
                 self._make_metrics()
+            self.registry.set_const_labels(
+                process_index=self.process_index, run_id=self.run_id)
             if compile_watch:
                 self._watcher.install()
             if jsonl_dir is not None and self.sink is None:
-                self.sink = EventSink(str(jsonl_dir))
+                self.sink = EventSink(str(jsonl_dir),
+                                      run_id=self.run_id,
+                                      process_index=self.process_index)
             if http_port is not None and self.server is None:
                 from .server import MetricsServer
                 self.server = MetricsServer(self.registry,
@@ -265,6 +296,33 @@ class TrainingTelemetry:
                                             port=int(http_port))
                 self.server.start()
         return self
+
+    def publish_endpoint(self, store, world_size=None):
+        """Publish this rank's ``/metrics`` endpoint into the
+        coordination store under ``obs/<run_id>/endpoint/<rank>`` so the
+        cluster aggregator can discover it; also (re)sets
+        ``obs/<run_id>/world`` when ``world_size`` is given — EVERY rank
+        writing it keeps discovery alive across a master respawn with a
+        partial WAL.  ``store`` is any TCPStore-shaped client; pass a
+        :class:`~paddle_tpu.distributed.resilient_store.ResilientStore`
+        to survive master failover.  Returns the published "host:port".
+        """
+        with self._lock:
+            server = self.server
+        if server is None or server.port is None:
+            raise RuntimeError(
+                "publish_endpoint: no metrics server is running — "
+                "enable(http_port=...) first")
+        from .aggregator import endpoint_key, world_key
+        ep = f"{server.host}:{server.port}"
+        store.set(endpoint_key(self.run_id, self.process_index),
+                  ep.encode("ascii"))
+        if world_size is not None:
+            store.set(world_key(self.run_id),
+                      str(int(world_size)).encode("ascii"))
+        logger.info("published metrics endpoint %s as rank %d of run "
+                    "%s", ep, self.process_index, self.run_id)
+        return ep
 
     def disable(self):
         with self._lock:
@@ -310,6 +368,10 @@ class TrainingTelemetry:
             "pt_collective_bytes_total",
             "input bytes entering collectives (metadata-derived)",
             ("op",))
+        self._m_coll_time = r.histogram(
+            "pt_collective_time_seconds",
+            "host-boundary wall time of eagerly dispatched collectives "
+            "(not recorded inside traces)", ("op",))
         self._m_ckpt_ops = r.counter(
             "pt_checkpoint_ops_total", "checkpoint operations",
             ("op", "status"))
@@ -415,6 +477,14 @@ class TrainingTelemetry:
         self._m_coll_ops.inc(op=op)
         if nbytes:
             self._m_coll_bytes.inc(nbytes, op=op)
+
+    def collective_time(self, op, seconds):
+        """Host wall time around ONE eager collective dispatch (the
+        caller guarantees it is not tracing — see
+        ``distributed.collective._timed``)."""
+        if not self.enabled:
+            return
+        self._m_coll_time.observe(float(seconds), op=op)
 
     # -- checkpoints ----------------------------------------------------------
 
@@ -536,6 +606,12 @@ class TrainingTelemetry:
 
     # -- compiles (called from the log filter) ------------------------------
 
+    def record_compile(self, name, signature=""):
+        """Public compile-event feed for sources other than jax's
+        compile log (AOT pipelines, drills) — same metrics/sentinel
+        path as the log filter."""
+        self._on_compile(name, signature)
+
     def _on_compile(self, name, signature=""):
         if self.enabled:
             self._m_compiles.inc(fn=name)
@@ -614,6 +690,8 @@ class TrainingTelemetry:
         return {
             "enabled": self.enabled,
             "pid": os.getpid(),
+            "process_index": self.process_index,
+            "run_id": self.run_id,
             "steps": steps,
             "step_ms_p50": pct["p50"],
             "step_ms_p95": pct["p95"],
@@ -665,6 +743,8 @@ class TrainingTelemetry:
         return {
             "ok": lease_ok is not False and store_ok is not False,
             "pid": os.getpid(),
+            "process_index": self.process_index,
+            "run_id": self.run_id,
             "uptime_sec": round(now - self._start_ts, 1),
             "steps": steps,
             "last_step_age_sec": (round(now - last_step_ts, 3)

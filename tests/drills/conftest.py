@@ -1,8 +1,8 @@
 """Drill-suite fixtures: the no-leaked-children guarantee.
 
-Every subprocess a drill spawns — worker ranks AND store-master
-processes, including masters RESPAWNED mid-drill by the failover
-supervisor — is registered in
+Every subprocess a drill spawns — worker ranks, store-master
+processes (including masters RESPAWNED mid-drill by the failover
+supervisor) AND cluster-observability aggregators — is registered in
 ``paddle_tpu.distributed.drill.runner._LIVE``; this autouse reaper
 SIGKILLs and waits any stragglers after EVERY test in this directory,
 no matter how the test failed — a hung drill or an orphaned respawned

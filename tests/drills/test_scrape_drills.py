@@ -1,0 +1,86 @@
+"""Cluster-observability scrape drills: 3 REAL workers + a REAL
+aggregator subprocess, scrape -> merge -> skew end-to-end.
+
+Each drill spawns a durable store master, ``world`` drill workers in
+observability mode (real telemetry enabled, /metrics endpoint
+published into the store under ``obs/<run_id>/endpoint/<rank>``), and
+the cluster aggregator (``python -m paddle_tpu.observability.aggregator``)
+discovering the fleet through the same store.  The tier-1 drill
+asserts the full acceptance chain:
+
+ - counters summed and histogram buckets merged across ranks
+   (``pt_step_time_seconds_count == world * steps``);
+ - a NONZERO ``pt_step_time_skew_seconds`` (each rank's synthetic step
+   profile is ``step_base * (1 + rank)``);
+ - the recompile-storm alarm tripping on the CROSS-RANK aggregate
+   (each rank trips its local sentinel once; threshold == world);
+ - a SIGKILLed rank marked stale within bounded polls — never a hang;
+ - the merge CLI stitching the per-rank telemetry JSONL files into one
+   time-ordered rank-labeled stream, validated line-for-line.
+
+The ``@slow`` matrix adds the aggregator-restart and store-master
+respawn legs (discovery must survive both).
+"""
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from paddle_tpu.distributed.drill import run_scrape_drill
+
+pytestmark = pytest.mark.skipif(os.name != "posix",
+                                reason="drills SIGKILL real processes")
+
+
+def test_scrape_merge_skew_drill(tmp_path):
+    """Tier-1 acceptance drill: 3 workers + aggregator -> summed
+    counters, merged histograms, nonzero skew, cross-rank storm alarm
+    (healthz 503), kill -> stale, merge CLI one ordered stream."""
+    logs = str(tmp_path / "logs")
+    os.makedirs(logs, exist_ok=True)
+    report = run_scrape_drill(
+        str(tmp_path), world=3, steps=10, kill_rank=2, storm=True,
+        log_dir=logs)
+    assert report["skew_seconds"] > 0.0
+    assert report["straggler_ratio"] > 1.0
+    assert report["merged_steps"] == 30.0  # 3 ranks x 10 steps summed
+    assert report["storms_total"] == 3.0
+    assert report["storm_alarm"] == 1.0
+    assert report["healthz"]["storm_alarm"] is True
+    assert report["healthz"]["ranks_up"] == 3
+    assert report["stale_after_kill"] is True
+    assert report["rcs"][2] == -9 and report["rcs"][:2] == [0, 0]
+    assert report["merge_lines"] == report["expected_lines"] > 0
+    # per-rank step-time percentiles made it into the cluster health
+    ranks = report["healthz"]["ranks"]
+    assert set(ranks) == {"0", "1", "2"}
+    p95s = [ranks[r]["step_time"]["train"]["p95_ms"] for r in ranks]
+    assert max(p95s) > min(p95s)  # the skew is visible per-rank too
+
+
+@pytest.mark.slow
+def test_scrape_drill_aggregator_restart(tmp_path):
+    """@slow: kill the aggregator mid-drill and respawn it — the
+    cluster view must reconverge from store discovery alone (at
+    world-1: the killed rank stays dead across the restart)."""
+    report = run_scrape_drill(
+        str(tmp_path), world=3, steps=8, kill_rank=1, storm=False,
+        restart_aggregator=True)
+    assert report["aggregator_restarted"] is True
+    assert report["storm_alarm"] in (0.0, None)
+    assert report["rcs"][1] == -9
+
+
+@pytest.mark.slow
+def test_scrape_drill_survives_master_respawn(tmp_path):
+    """@slow: SIGKILL the WAL-backed store master mid-drill — the
+    respawned master replays every published endpoint (generation
+    bumped), and the aggregator's discovery rides the failover."""
+    report = run_scrape_drill(
+        str(tmp_path), world=3, steps=8, kill_rank=None, storm=True,
+        respawn_master=True)
+    assert report["master_respawned"] is True
+    assert report["store_generation"] >= 2
+    assert report["rcs"] == [0, 0, 0]
+    assert report["storm_alarm"] == 1.0

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import threading
 import time
 
@@ -335,8 +336,10 @@ def test_barrier_and_sweep_metrics(tmp_path, _tel):
         os.utime(os.path.join(root, n), (old, old))
     assert sweep_staging(root, max_age=3600.0) == 1
     text = _tel.registry.prometheus_text()
-    assert 'pt_checkpoint_barrier_wait_seconds_count{status="ok"} 1' \
-        in text
-    assert 'pt_checkpoint_barrier_wait_seconds_count{status="timeout"}' \
-        in text
-    assert "pt_checkpoint_staging_orphans_swept_total 1" in text
+    # const identity labels ride along -> match by label subset
+    assert re.search(r'pt_checkpoint_barrier_wait_seconds_count'
+                     r'\{[^}]*status="ok"[^}]*\} 1\b', text)
+    assert re.search(r'pt_checkpoint_barrier_wait_seconds_count'
+                     r'\{[^}]*status="timeout"[^}]*\}', text)
+    assert re.search(r'pt_checkpoint_staging_orphans_swept_total'
+                     r'(\{[^}]*\})? 1\b', text)

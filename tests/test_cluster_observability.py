@@ -1,0 +1,437 @@
+"""Cluster observability units: exposition parsing, cross-rank merge
+semantics, skew/percentile math, the in-process aggregator end-to-end
+(real MetricsServers scraped over HTTP), the telemetry-JSONL merge CLI,
+store-key convention pins, and metric-series identity labels.
+
+The multi-PROCESS acceptance drills live in
+tests/drills/test_scrape_drills.py; everything here is in-process and
+fast."""
+from __future__ import annotations
+
+import io
+import json
+import os
+import re
+import sys
+import time
+
+import pytest
+
+import paddle_tpu.observability as obs
+from paddle_tpu.observability import (
+    ClusterAggregator, EventSink, MergeConflict, MetricsRegistry,
+    MetricsServer, cluster_snapshot, get_registry, get_telemetry,
+    merge_scrapes, parse_prometheus_text, render_exposition,
+)
+from paddle_tpu.observability.aggregator import (
+    bucket_percentile, endpoint_key, world_key,
+)
+from paddle_tpu.observability import merge as merge_cli
+
+
+@pytest.fixture(autouse=True)
+def _isolated(monkeypatch):
+    for var in ("PT_TELEMETRY", "PT_TELEMETRY_DIR", "PT_METRICS_PORT",
+                "PT_RECOMPILE_THRESHOLD", "PT_PROCESS_INDEX",
+                "PT_RUN_ID", "PADDLE_TRAINER_ID"):
+        monkeypatch.delenv(var, raising=False)
+    obs.reset()
+    yield
+    obs.reset()
+
+
+def _registry_text(rank, run_id="r1", steps=5, step_ms=10.0):
+    """One rank's realistic exposition text: identity const labels,
+    a counter, a histogram, and a per-rank gauge."""
+    reg = MetricsRegistry()
+    reg.set_const_labels(process_index=rank, run_id=run_id)
+    reg.counter("pt_steps_total", "steps", ("mode",)).inc(
+        steps, mode="train")
+    h = reg.histogram("pt_step_time_seconds", "step time", ("mode",),
+                      buckets=[0.005, 0.05, 0.5])
+    for _ in range(steps):
+        h.observe(step_ms / 1e3, mode="train")
+    reg.gauge("pt_throughput_samples_per_second", "tput",
+              ("mode",)).set(100.0 / (rank + 1), mode="train")
+    return reg.prometheus_text()
+
+
+# -- exposition parsing ------------------------------------------------------
+
+def test_parse_round_trips_registry_output():
+    text = _registry_text(0)
+    fams = parse_prometheus_text(text)
+    assert fams["pt_steps_total"]["kind"] == "counter"
+    assert fams["pt_step_time_seconds"]["kind"] == "histogram"
+    # histogram children folded into the base family
+    assert "pt_step_time_seconds_bucket" not in fams
+    names = {s[0] for s in fams["pt_step_time_seconds"]["samples"]}
+    assert names == {"pt_step_time_seconds_bucket",
+                     "pt_step_time_seconds_sum",
+                     "pt_step_time_seconds_count"}
+    (sname, labels, value), = fams["pt_steps_total"]["samples"]
+    assert labels == {"mode": "train", "process_index": "0",
+                      "run_id": "r1"}
+    assert value == 5.0
+
+
+def test_parse_label_escapes_and_inf():
+    text = ('# TYPE weird gauge\n'
+            'weird{msg="a\\"b\\\\c\\nd",le="+Inf"} 3\n')
+    fams = parse_prometheus_text(text)
+    (_, labels, value), = fams["weird"]["samples"]
+    assert labels["msg"] == 'a"b\\c\nd'
+    assert value == 3.0
+
+
+def test_parse_rejects_malformed_lines():
+    with pytest.raises(ValueError):
+        parse_prometheus_text("this is not exposition format\n")
+    with pytest.raises(ValueError):
+        parse_prometheus_text("ok_metric not-a-number\n")
+
+
+# -- merge semantics ---------------------------------------------------------
+
+def test_merge_sums_counters_dropping_process_index():
+    scrapes = {r: parse_prometheus_text(_registry_text(r, steps=5))
+               for r in range(3)}
+    merged, conflicts = merge_scrapes(scrapes)
+    assert conflicts == []
+    series = merged["pt_steps_total"]["series"]
+    key = (("mode", "train"), ("run_id", "r1"))
+    assert series == {key: 15.0}  # summed, process_index dropped
+
+
+def test_merge_sums_histogram_buckets():
+    scrapes = {r: parse_prometheus_text(
+        _registry_text(r, steps=4, step_ms=10.0)) for r in range(2)}
+    merged, _ = merge_scrapes(scrapes)
+    (h,) = merged["pt_step_time_seconds"]["series"].values()
+    assert h["count"] == 8.0
+    assert h["buckets"][float("inf")] == 8.0
+    assert h["buckets"][0.05] == 8.0   # every 10ms sample <= 50ms
+    assert h["buckets"][0.005] == 0.0
+    assert h["sum"] == pytest.approx(8 * 0.010)
+
+
+def test_merge_rejects_mismatched_bucket_layouts():
+    def one(buckets):
+        reg = MetricsRegistry()
+        reg.histogram("h_seconds", "h", buckets=buckets).observe(0.1)
+        return parse_prometheus_text(reg.prometheus_text())
+
+    with pytest.raises(MergeConflict):
+        merge_scrapes({0: one([0.1, 1.0]), 1: one([0.2, 1.0])})
+    # on_conflict="skip": the whole family is dropped, not half-merged
+    merged, conflicts = merge_scrapes(
+        {0: one([0.1, 1.0]), 1: one([0.2, 1.0])}, on_conflict="skip")
+    assert "h_seconds" not in merged
+    assert len(conflicts) == 1 and "bucket layouts" in conflicts[0]
+
+
+def test_merge_keeps_gauges_per_rank_and_rejects_collisions():
+    scrapes = {r: parse_prometheus_text(_registry_text(r))
+               for r in range(2)}
+    merged, _ = merge_scrapes(scrapes)
+    series = merged["pt_throughput_samples_per_second"]["series"]
+    assert len(series) == 2  # one labeled series per rank
+    by_rank = {dict(k)["process_index"]: v for k, v in series.items()}
+    assert by_rank == {"0": 100.0, "1": 50.0}
+
+    # identical label sets from two scrapes would last-write-win:
+    # that is a conflict, not a merge
+    same = parse_prometheus_text("# TYPE g gauge\ng 1\n")
+    same2 = parse_prometheus_text("# TYPE g gauge\ng 2\n")
+    with pytest.raises(MergeConflict):
+        merge_scrapes({0: same, 1: same2})
+
+
+def test_merge_rejects_kind_mismatch():
+    a = parse_prometheus_text("# TYPE m counter\nm 1\n")
+    b = parse_prometheus_text("# TYPE m gauge\nm 1\n")
+    with pytest.raises(MergeConflict):
+        merge_scrapes({0: a, 1: b})
+    merged, conflicts = merge_scrapes({0: a, 1: b}, on_conflict="skip")
+    assert "m" not in merged and len(conflicts) == 1
+
+
+def test_merged_output_is_valid_exposition():
+    """The aggregated view must itself satisfy the exposition-format
+    validator (round-trip through the parser proves it)."""
+    scrapes = {r: parse_prometheus_text(_registry_text(r))
+               for r in range(3)}
+    merged, _ = merge_scrapes(scrapes)
+    text = render_exposition(merged)
+    again = parse_prometheus_text(text)  # would raise on bad lines
+    assert set(again) == set(merged)
+    # cumulative-bucket contract survives the merge
+    counts = [float(m.group(1)) for m in re.finditer(
+        r'pt_step_time_seconds_bucket\{[^}]*\} ([0-9.]+)', text)]
+    assert counts == sorted(counts)
+
+
+def test_bucket_percentile_matches_histogram_percentile():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", "latency", buckets=[0.1, 1.0, 10.0])
+    for v in [0.05] * 50 + [0.5] * 40 + [5.0] * 10:
+        h.observe(v)
+    fams = parse_prometheus_text(reg.prometheus_text())
+    buckets, count = {}, 0.0
+    for sname, labels, value in fams["lat"]["samples"]:
+        if sname.endswith("_bucket"):
+            le = float("inf") if labels["le"] == "+Inf" \
+                else float(labels["le"])
+            buckets[le] = value
+        elif sname.endswith("_count"):
+            count = value
+    for q in (0.5, 0.9, 0.95, 0.999):
+        assert bucket_percentile(buckets, count, q) == \
+            pytest.approx(h.percentile(q))
+    assert bucket_percentile({}, 0, 0.5) is None
+
+
+# -- in-process aggregator ---------------------------------------------------
+
+def _serve_rank(rank, run_id="agg", steps=6, step_ms=10.0, storms=0):
+    reg = MetricsRegistry()
+    reg.set_const_labels(process_index=rank, run_id=run_id)
+    reg.counter("pt_steps_total", "steps", ("mode",)).inc(
+        steps, mode="train")
+    h = reg.histogram("pt_step_time_seconds", "t", ("mode",),
+                      buckets=[0.005, 0.02, 0.05, 0.5])
+    for _ in range(steps):
+        h.observe(step_ms / 1e3, mode="train")
+    if storms:
+        reg.counter("pt_recompile_storms_total", "storms").inc(storms)
+    srv = MetricsServer(reg, port=0).start()
+    return srv
+
+
+def test_aggregator_end_to_end_skew_storm_and_staleness():
+    """Two REAL MetricsServers scraped over HTTP: merged counters,
+    nonzero skew, straggler ratio, the cross-rank storm alarm (503
+    semantics via healthz ok=False), then one server stops and must be
+    marked stale — within bounded time, never hanging."""
+    s0 = _serve_rank(0, step_ms=10.0, storms=1)
+    s1 = _serve_rank(1, step_ms=30.0, storms=1)
+    agg = ClusterAggregator(
+        endpoints={0: f"127.0.0.1:{s0.port}",
+                   1: f"127.0.0.1:{s1.port}"},
+        stale_after=0.5, scrape_timeout=2.0, storm_threshold=2)
+    try:
+        t0 = time.monotonic()
+        agg.scrape_once()
+        assert time.monotonic() - t0 < 5.0
+        text = agg.prometheus_text()
+        fams = parse_prometheus_text(text)  # valid exposition
+
+        def val(name, **labels):
+            for f in fams.values():
+                for sname, lbls, v in f["samples"]:
+                    if sname == name and all(
+                            lbls.get(k) == x
+                            for k, x in labels.items()):
+                        return v
+            return None
+
+        assert val("pt_cluster_ranks_up") == 2.0
+        assert val("pt_steps_total", mode="train") == 12.0
+        skew = val("pt_step_time_skew_seconds", mode="train")
+        assert skew == pytest.approx(0.020, rel=0.2)
+        assert val("pt_step_time_straggler_ratio", mode="train") > 1.0
+        assert val("pt_cluster_recompile_storms_total") == 2.0
+        assert val("pt_cluster_recompile_storm_alarm") == 1.0
+        assert val("pt_rank_up", process_index="1") == 1.0
+        # per-rank quantiles are first-class labeled series
+        assert val("pt_rank_step_time_seconds", process_index="1",
+                   quantile="p95") is not None
+        health = agg.healthz()
+        assert health["ok"] is False  # alarm up -> healthz 503
+        assert health["storm_alarm"] is True
+        assert health["ranks_up"] == 2
+        assert health["step_time_skew_seconds"]["train"] > 0
+
+        # rank 1 goes silent: bounded scrape, marked stale, dropped
+        # from merges but still visible as pt_rank_up 0
+        s1.stop()
+        time.sleep(0.6)  # > stale_after
+        t0 = time.monotonic()
+        agg.scrape_once()
+        assert time.monotonic() - t0 < 5.0
+        fams = parse_prometheus_text(agg.prometheus_text())
+        assert val("pt_cluster_ranks_up") == 1.0
+        assert val("pt_rank_up", process_index="1") == 0.0
+        assert val("pt_steps_total", mode="train") == 6.0
+        health = agg.healthz()
+        assert health["stale_ranks"] == [1]
+        assert health["ranks"]["1"]["up"] is False
+        assert health["scrape_errors_total"] >= 1
+    finally:
+        agg.stop()
+        s0.stop()
+        s1.stop()
+
+
+def test_aggregator_healthz_503_through_metrics_server():
+    """The aggregator's own serving contract: /healthz returns 503
+    while the storm alarm is up (MetricsServer keys off ok=False)."""
+    import urllib.error
+    import urllib.request
+
+    s0 = _serve_rank(0, storms=3)
+    agg = ClusterAggregator(endpoints={0: f"127.0.0.1:{s0.port}"},
+                            storm_threshold=1)
+    agg.scrape_once()
+    srv = MetricsServer(metrics_cb=agg.prometheus_text,
+                        health_cb=agg.healthz, port=0).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz", timeout=5)
+        assert ei.value.code == 503
+        body = json.loads(ei.value.read().decode())
+        assert body["storm_alarm"] is True
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=5) as r:
+            text = r.read().decode()
+        assert "pt_cluster_recompile_storm_alarm 1" in text
+    finally:
+        srv.stop()
+        agg.stop()
+        s0.stop()
+
+
+def test_cluster_snapshot_local_mode_shape():
+    tel = get_telemetry().enable(compile_watch=False, process_index=4,
+                                 run_id="snaprun")
+    tel.observe_step(0.01, mode="train", batch_size=8)
+    snap = cluster_snapshot()
+    assert snap["source"] == "local"
+    assert snap["run_id"] == "snaprun"
+    assert snap["ranks_up"] == 1
+    assert snap["ranks"]["4"]["steps"] == 1
+    assert snap["ranks"]["4"]["step_time"]["train"]["count"] == 1
+
+
+# -- store key conventions ---------------------------------------------------
+
+def test_obs_store_key_formats_pinned_equal():
+    """core.store_server mirrors the aggregator's key formats without
+    importing it (stdlib-only contract) — pin them equal forever."""
+    from paddle_tpu.core import store_server as ss
+    assert ss.obs_endpoint_key("run-x", 3) == endpoint_key("run-x", 3)
+    assert ss.obs_world_key("run-x") == world_key("run-x")
+    assert endpoint_key("r", 2) == "obs/r/endpoint/2"
+    assert world_key("r") == "obs/r/world"
+
+
+# -- identity: const labels, JSONL fields, filenames -------------------------
+
+def test_identity_env_resolution(monkeypatch):
+    monkeypatch.setenv("PT_PROCESS_INDEX", "7")
+    monkeypatch.setenv("PT_RUN_ID", "envrun")
+    obs.reset()
+    tel = get_telemetry().enable(compile_watch=False)
+    assert (tel.process_index, tel.run_id) == (7, "envrun")
+    tel.observe_step(0.01)
+    text = get_registry().prometheus_text()
+    assert re.search(
+        r'pt_steps_total\{mode="train",process_index="7",'
+        r'run_id="envrun"\} 1\b', text)
+    hz = tel.healthz()
+    assert hz["process_index"] == 7 and hz["run_id"] == "envrun"
+
+
+def test_paddle_trainer_id_fallback(monkeypatch):
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+    obs.reset()
+    tel = get_telemetry()
+    assert tel.process_index == 2 and tel.run_id == "local"
+
+
+def test_event_sink_identity_filename_and_fields(tmp_path):
+    sink = EventSink(str(tmp_path), run_id="abc/x", process_index=2)
+    assert os.path.basename(sink.path) == "telemetry-abc_x-2.jsonl"
+    sink.emit("step", idx=1)
+    sink.close()
+    (rec,) = [json.loads(l) for l in open(sink.path)]
+    assert rec["process_index"] == 2 and rec["run_id"] == "abc/x"
+    # legacy pid naming is untouched when identity is absent
+    legacy = EventSink(str(tmp_path))
+    assert f"-{os.getpid()}.jsonl" in legacy.path
+    legacy.emit("e")
+    legacy.close()
+    (rec,) = [json.loads(l) for l in open(legacy.path)]
+    assert "process_index" not in rec and "run_id" not in rec
+
+
+# -- merge CLI ---------------------------------------------------------------
+
+def _write_jsonl(path, records):
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_merge_cli_orders_and_labels(tmp_path, capsys):
+    d = str(tmp_path)
+    _write_jsonl(os.path.join(d, "telemetry-run1-0.jsonl"), [
+        {"ts": "2026-08-05T10:00:02.0", "event": "step", "step": 2},
+        {"ts": "2026-08-05T10:00:04.0", "event": "step", "step": 4},
+    ])
+    _write_jsonl(os.path.join(d, "telemetry-run1-1.jsonl"), [
+        {"ts": "2026-08-05T10:00:01.0", "event": "step", "step": 1,
+         "process_index": 1, "run_id": "run1"},
+        {"ts": "2026-08-05T10:00:03.0", "event": "step", "step": 3,
+         "process_index": 1, "run_id": "run1"},
+    ])
+    # legacy pid-named file: identity stays null (a pid is NOT a rank)
+    _write_jsonl(os.path.join(d, "telemetry-12345.jsonl"), [
+        {"ts": "2026-08-05T10:00:00.5", "event": "boot"},
+    ])
+    # torn tail of a SIGKILLed rank: skipped, counted, never fatal
+    with open(os.path.join(d, "telemetry-run1-0.jsonl"), "a") as f:
+        f.write('{"ts": "2026-08-05T10:00:05.0", "event":')
+
+    out = os.path.join(d, "merged.jsonl")
+    rc = merge_cli.main([d, "--output", out])
+    assert rc == 0
+    assert "skipped 1" in capsys.readouterr().err
+    recs = [json.loads(l) for l in open(out)]
+    assert [r["ts"] for r in recs] == sorted(r["ts"] for r in recs)
+    assert recs[0]["event"] == "boot"
+    assert recs[0]["process_index"] is None  # legacy: no invented rank
+    # filename-derived identity for rank 0, in-record for rank 1
+    by_step = {r.get("step"): r for r in recs if "step" in r}
+    assert by_step[2]["process_index"] == 0
+    assert by_step[2]["run_id"] == "run1"
+    assert by_step[1]["process_index"] == 1
+    assert [by_step[i]["step"] for i in (1, 2, 3, 4)] == [1, 2, 3, 4]
+
+
+def test_merge_cli_reads_rotated_generations_first(tmp_path):
+    d = str(tmp_path)
+    # rotated .1 file holds OLDER records with equal timestamps: the
+    # stable (file, lineno) tiebreaker must keep it first
+    _write_jsonl(os.path.join(d, "telemetry-r-0.jsonl.1"),
+                 [{"ts": "2026-08-05T10:00:00", "event": "old"}])
+    _write_jsonl(os.path.join(d, "telemetry-r-0.jsonl"),
+                 [{"ts": "2026-08-05T10:00:00", "event": "new"}])
+    files = merge_cli.discover_files([d])
+    assert [os.path.basename(f) for f in files] == \
+        ["telemetry-r-0.jsonl.1", "telemetry-r-0.jsonl"]
+    records, skipped = merge_cli.merge_records(files)
+    assert skipped == 0
+    assert [r["event"] for r in records] == ["old", "new"]
+    assert all(r["process_index"] == 0 and r["run_id"] == "r"
+               for r in records)
+
+
+def test_merge_cli_stdout_default(tmp_path, capsys):
+    _write_jsonl(str(tmp_path / "telemetry-z-3.jsonl"),
+                 [{"ts": "2026-08-05T11:00:00", "event": "e"}])
+    rc = merge_cli.main([str(tmp_path)])
+    assert rc == 0
+    (line,) = [l for l in capsys.readouterr().out.splitlines() if l]
+    assert json.loads(line)["process_index"] == 3

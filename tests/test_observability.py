@@ -29,7 +29,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def _isolated(monkeypatch):
     # env must never leak enablement into (or out of) a test
     for var in ("PT_TELEMETRY", "PT_TELEMETRY_DIR", "PT_METRICS_PORT",
-                "PT_RECOMPILE_THRESHOLD"):
+                "PT_RECOMPILE_THRESHOLD", "PT_PROCESS_INDEX",
+                "PT_RUN_ID", "PADDLE_TRAINER_ID"):
         monkeypatch.delenv(var, raising=False)
     obs.reset()
     yield
@@ -313,7 +314,9 @@ def test_step_timing_and_percentiles():
     assert 1 <= snap["step_ms_p50"] <= 4
     assert snap["step_ms_p95"] >= 4
     text = tel.registry.prometheus_text()
-    assert 'pt_steps_total{mode="train"} 5' in text
+    # const identity labels ride along -> match by label subset
+    assert re.search(r'pt_steps_total\{[^}]*mode="train"[^}]*\} 5\b',
+                     text)
     assert "pt_step_time_seconds_bucket" in text
 
 
@@ -352,11 +355,76 @@ def test_checkpoint_counters():
     tel.record_checkpoint_restore(0.2, step=10, ok=True)
     tel.record_checkpoint_gc(3)
     text = tel.registry.prometheus_text()
-    assert 'pt_checkpoint_ops_total{op="save",status="ok"} 1' in text
-    assert 'pt_checkpoint_ops_total{op="save",status="async_error"} 1' in text
-    assert 'pt_checkpoint_ops_total{op="restore",status="ok"} 1' in text
-    assert "pt_checkpoint_gc_deleted_total 3" in text
+
+    def sample(name, labels, value):
+        return re.search(rf'{name}\{{[^}}]*{labels}[^}}]*\}} {value}\b',
+                         text)
+
+    assert sample("pt_checkpoint_ops_total", 'op="save",status="ok"', 1)
+    assert sample("pt_checkpoint_ops_total",
+                  'op="save",status="async_error"', 1)
+    assert sample("pt_checkpoint_ops_total", 'op="restore",status="ok"',
+                  1)
+    assert sample("pt_checkpoint_gc_deleted_total", "", 3)
     assert tel.healthz()["last_checkpoint_step"] == 10
+
+
+def test_collective_time_histogram_eager():
+    """Satellite: an eagerly dispatched collective records one
+    pt_collective_time_seconds{op=...} observation, timed at the host
+    boundary."""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.tensor import Tensor
+
+    tel = get_telemetry().enable(compile_watch=False)
+    import jax
+    n = jax.device_count()  # rank-major eager layout
+    out = dist.all_reduce(Tensor(np.ones((n, 4), np.float32)))
+    assert out is not None
+    text = tel.registry.prometheus_text()
+    assert re.search(r'pt_collective_time_seconds_count'
+                     r'\{[^}]*op="all_reduce"[^}]*\} 1\b', text)
+    assert re.search(r'pt_collective_time_seconds_sum'
+                     r'\{[^}]*op="all_reduce"[^}]*\} [0-9.]', text)
+
+
+def test_collective_time_is_tracer_safe():
+    """The timing wrapper must record NOTHING while tracing — a traced
+    perf_counter would time tracing, not execution, and a host
+    callback inside jit would be a TPU008-class hazard."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.distributed import collective as coll
+
+    tel = get_telemetry().enable(compile_watch=False)
+
+    @coll._timed("probe")
+    def inner(a):
+        return a * 2.0
+
+    @jax.jit
+    def traced(a):
+        return inner(a)
+
+    traced(jnp.ones((4,), jnp.float32)).block_until_ready()
+    text = tel.registry.prometheus_text()
+    assert 'op="probe"' not in text  # traced call: not timed
+
+    inner(jnp.ones((4,), jnp.float32))  # eager call: timed
+    text = tel.registry.prometheus_text()
+    assert re.search(r'pt_collective_time_seconds_count'
+                     r'\{[^}]*op="probe"[^}]*\} 1\b', text)
+
+
+def test_collective_time_disabled_hub_records_nothing():
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.tensor import Tensor
+
+    import jax
+    n = jax.device_count()
+    dist.all_reduce(Tensor(np.ones((n, 4), np.float32)))
+    assert get_registry().snapshot() == {}
 
 
 def test_lint_clean_over_observability_package():
@@ -399,7 +467,8 @@ def test_fit_and_checkpoint_end_to_end(tmp_path):
     _validate_prometheus(text)
     assert "pt_step_time_seconds_bucket" in text
     assert "pt_compiles_total" in text
-    assert "pt_checkpoint_save_seconds_count 1" in text
+    assert re.search(r"pt_checkpoint_save_seconds_count(\{[^}]*\})? 1\b",
+                     text)
     assert "pt_data_wait_seconds" in text
 
     code, _, body = _get(tel.server.port, "/healthz")
