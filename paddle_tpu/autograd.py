@@ -303,17 +303,26 @@ def record(fn, tensors, outputs_wrap, name=""):
         res = _static_recorder(fn, tensors, outputs_wrap, name)
         if res is not _STATIC_SENTINEL:
             return res
-    datas = tuple(t._data for t in tensors)
     # inlined is_grad_enabled()/in_functional_mode(): the per-op eager
     # path is the framework's dispatch floor (bench_eager.py tracks it),
-    # so thread-local state is read via one __dict__ lookup each
+    # so thread-local state is read via one __dict__ lookup each; the
+    # 1/2-arity cases (the whole elementwise funnel) skip the generic
+    # tuple build + stop_gradient loop
     st = _state.__dict__
-    needs_grad = False
-    if st.get("enabled", True) and not st.get("functional", 0):
-        for t in tensors:
-            if not t.stop_gradient:
-                needs_grad = True
-                break
+    n = len(tensors)
+    if n == 2:
+        a, b = tensors
+        datas = (a._data, b._data)
+        needs_grad = not (a.stop_gradient and b.stop_gradient)
+    elif n == 1:
+        a = tensors[0]
+        datas = (a._data,)
+        needs_grad = not a.stop_gradient
+    else:
+        datas = tuple(t._data for t in tensors)
+        needs_grad = any(not t.stop_gradient for t in tensors)
+    if needs_grad and (not st.get("enabled", True) or st.get("functional", 0)):
+        needs_grad = False
     raw = fn(*datas)
     if outputs_wrap is _single_wrap_fn:
         t = _single_ctor(raw, needs_grad)

@@ -233,9 +233,14 @@ class VisualDL(Callback):
         os.makedirs(self.log_dir, exist_ok=True)
         self._step += 1
         with open(os.path.join(self.log_dir, "scalars.jsonl"), "a") as f:
+            # float(v) also catches hapi's lazy LossScalar (this logger
+            # writes per batch, so the read — and the device sync it
+            # implies — is this callback's own documented cost)
             f.write(json.dumps({"step": self._step,
-                                **{k: v for k, v in (logs or {}).items()
-                                   if isinstance(v, (int, float))}}) + "\n")
+                                **{k: float(v)
+                                   for k, v in (logs or {}).items()
+                                   if isinstance(v, (int, float))
+                                   or hasattr(v, "__float__")}}) + "\n")
 
 
 def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,

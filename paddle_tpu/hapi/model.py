@@ -22,7 +22,7 @@ from ..observability import get_telemetry
 from .. import autograd
 from .callbacks import config_callbacks
 
-__all__ = ["Model"]
+__all__ = ["Model", "LossScalar"]
 
 
 def _to_list(x):
@@ -39,6 +39,96 @@ def _arrays(batch):
         else:
             out.append(jnp.asarray(np.asarray(b)))
     return out
+
+
+def _unwrap(o):
+    return o._sync() if isinstance(o, LossScalar) else o
+
+
+class LossScalar:
+    """Lazy handle over the on-device loss scalar.
+
+    ``train_batch`` returns as soon as the step is DISPATCHED; the
+    device→host copy (the per-step sync that stalls the TPU pipeline,
+    tpu-lint TPU007) happens at the first read — ``float()``, a
+    comparison, formatting — which in the fit loop is the callback/log
+    cadence, not every batch. Reads memoize, so the sync is paid once.
+    Behaves like the float it wraps everywhere the hapi loop and the
+    stock callbacks consume it."""
+
+    __slots__ = ("_arr", "_val")
+
+    def __init__(self, arr):
+        self._arr = arr
+        self._val = None
+
+    def _sync(self):
+        v = self._val
+        if v is None:
+            v = self._val = float(np.asarray(self._arr))
+            self._arr = None  # drop the device buffer once materialized
+        return v
+
+    def __float__(self):
+        return self._sync()
+
+    def __repr__(self):
+        return repr(self._sync())
+
+    def __str__(self):
+        return str(self._sync())
+
+    def __format__(self, spec):
+        return format(self._sync(), spec)
+
+    def __bool__(self):
+        return bool(self._sync())
+
+    def __hash__(self):
+        return hash(self._sync())
+
+    def __eq__(self, o):
+        return self._sync() == _unwrap(o)
+
+    def __lt__(self, o):
+        return self._sync() < _unwrap(o)
+
+    def __le__(self, o):
+        return self._sync() <= _unwrap(o)
+
+    def __gt__(self, o):
+        return self._sync() > _unwrap(o)
+
+    def __ge__(self, o):
+        return self._sync() >= _unwrap(o)
+
+    def __add__(self, o):
+        return self._sync() + _unwrap(o)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._sync() - _unwrap(o)
+
+    def __rsub__(self, o):
+        return _unwrap(o) - self._sync()
+
+    def __mul__(self, o):
+        return self._sync() * _unwrap(o)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._sync() / _unwrap(o)
+
+    def __rtruediv__(self, o):
+        return _unwrap(o) / self._sync()
+
+    def __neg__(self):
+        return -self._sync()
+
+    def __array__(self, dtype=None):
+        return np.asarray(self._sync(), dtype=dtype)
 
 
 class Model:
@@ -150,8 +240,10 @@ class Model:
         for m in self._metrics:
             corr = m.compute(Tensor(preds[0]), Tensor(_arrays(labels)[0]))
             metrics_out.append(m.update(corr))
-        return ([float(np.asarray(loss_v))], metrics_out) if metrics_out \
-            else [float(np.asarray(loss_v))]
+        # lazy: the step stays dispatched-but-unread until a callback or
+        # caller actually looks at the number (LossScalar docstring)
+        loss_out = [LossScalar(loss_v)]
+        return (loss_out, metrics_out) if metrics_out else loss_out
 
     def eval_batch(self, inputs, labels=None):
         with autograd.functional_guard():

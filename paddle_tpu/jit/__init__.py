@@ -3,6 +3,7 @@ from .api import (to_static, not_to_static, StaticFunction, InputSpec,  # noqa: 
                   functional_call, enable_static, disable_static,
                   in_dynamic_mode, ignore_module)
 from .save_load import save, load, TranslatedLayer  # noqa: F401
+from .capture import capture_step, CapturedStep  # noqa: F401
 
 
 # -- debugging toggles (ref python/paddle/jit/dy2static/logging_utils.py)

@@ -256,6 +256,8 @@ class TrainingTelemetry:
         self._store_last_ok_ts = None
         self._store_last_fail_ts = None
         self._store_generation = None
+        self._capture_hits = 0
+        self._capture_misses: dict = {}
         # refresh device-memory gauges every N steps (stats read is a
         # host-side allocator query, cheap but not free)
         self._mem_every = 32
@@ -416,6 +418,12 @@ class TrainingTelemetry:
         self._m_store_ok_ts = r.gauge(
             "pt_store_last_ok_timestamp_seconds",
             "unix time of the last successful store op")
+        self._m_capture_hits = r.counter(
+            "pt_capture_cache_hits_total",
+            "captured-step signature-cache hits (replays with no retrace)")
+        self._m_capture_misses = r.counter(
+            "pt_capture_cache_misses_total",
+            "captured-step cache misses", ("reason",))
 
     # -- step timing --------------------------------------------------------
 
@@ -604,6 +612,24 @@ class TrainingTelemetry:
             self.sink.emit("store_unavailable", op=op, endpoint=endpoint,
                            duration_sec=round(float(seconds), 3))
 
+    # -- capture cache (jit.capture_step) -----------------------------------
+
+    def capture_cache_hit(self):
+        """One captured-step call replayed from the signature cache."""
+        self._capture_hits += 1  # GIL-atomic; host-side counter feeds
+        if self.enabled:         # snapshot() even while metrics are off
+            self._m_capture_hits.inc()
+
+    def capture_cache_miss(self, reason):
+        """One captured-step call that could not replay; ``reason`` is
+        one of first_trace / signature_change / capture_unsafe /
+        unsupported_args."""
+        reason = str(reason)
+        self._capture_misses[reason] = \
+            self._capture_misses.get(reason, 0) + 1
+        if self.enabled:
+            self._m_capture_misses.inc(reason=reason)
+
     # -- compiles (called from the log filter) ------------------------------
 
     def record_compile(self, name, signature=""):
@@ -698,6 +724,8 @@ class TrainingTelemetry:
             "compiles": sum(compile_counts.values()),
             "compiles_by_fn": dict(top),
             "recompile_storms": sorted(self.sentinel.tripped()),
+            "capture": {"hits": self._capture_hits,
+                        "misses": dict(self._capture_misses)},
             "peak_device_memory_bytes": mem.get("peak_bytes_in_use"),
             "device_memory_bytes": mem.get("bytes_in_use"),
             "last_checkpoint_step": last_ckpt,

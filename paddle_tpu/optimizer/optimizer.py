@@ -106,8 +106,17 @@ class Optimizer:
         Computed in fp32 when a master weight is threaded as `p`."""
         raise NotImplementedError
 
+    # set while jit.capture_step traces this optimizer: step() must run
+    # the pure tree update over the THREADED state (tracer step counter,
+    # runtime lr) — the eager per-param path would bake this trace's
+    # global_step as a constant into the compiled program
+    _capture_hook = None
+
     # -- eager step ----------------------------------------------------------
     def step(self):
+        if self._capture_hook is not None:
+            self._capture_hook(self)
+            return
         self._global_step += 1
         params_grads = [(p, p.grad) for p in self._parameter_list
                         if not p.stop_gradient and p.grad is not None]

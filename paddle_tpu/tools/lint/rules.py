@@ -475,3 +475,156 @@ class BarePrintInLibrary(Rule):
                    "bare print() in paddle_tpu library code; use "
                    "observability.get_logger(__name__) (or emit a "
                    "structured event), or pass an explicit file=")
+
+
+def _donate_spec(call: ast.Call):
+    """Donated positions of a jit construction, or None if it donates
+    nothing.  ``"all"`` when the spec is present but not a literal int
+    tuple (donate_argnames, computed specs) — every positional arg is
+    then treated as consumed."""
+    for kw in call.keywords:
+        if kw.arg not in ("donate_argnums", "donate_argnames"):
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int) \
+                and not isinstance(v.value, bool):
+            return {v.value}
+        if isinstance(v, (ast.Tuple, ast.List, ast.Set)):
+            out = set()
+            for el in v.elts:
+                if (isinstance(el, ast.Constant)
+                        and isinstance(el.value, int)
+                        and not isinstance(el.value, bool)):
+                    out.add(el.value)
+                else:
+                    return "all"
+            return out
+        return "all"
+    return None
+
+
+@register
+class DonatedBufferReuse(Rule):
+    id = "TPU011"
+    name = "donated-buffer-reuse"
+    rationale = ("an argument passed at a donate_argnums position is "
+                 "invalidated by the call — XLA aliases its buffer into "
+                 "the output — so reading it afterwards raises 'Array "
+                 "has been deleted' (or reads reused memory on backends "
+                 "that alias eagerly); rebind the name to the call's "
+                 "output instead")
+
+    # flow-sensitive, so the analysis is a private in-order scan of each
+    # function body rather than the shared on_call/on_assign events
+    # (which carry no statement-order state)
+    def on_funcdef(self, node, ctx):
+        st = ({}, {}, set())  # donating, consumed, reported node ids
+        for stmt in node.body:
+            self._stmt(stmt, st, ctx)
+
+    def _stmt(self, s, st, ctx):
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            return  # nested scopes get their own on_funcdef pass
+        if isinstance(s, (ast.For, ast.AsyncFor)):
+            self._simple(s.iter, st, ctx)
+            self._clear_stores(s.target, st)
+            # two passes over a loop body: the second catches
+            # loop-carried reuse (f(params) every iteration with no
+            # rebind donates an already-deleted buffer on iteration 2)
+            for _ in (0, 1):
+                for sub in s.body:
+                    self._stmt(sub, st, ctx)
+            for sub in s.orelse:
+                self._stmt(sub, st, ctx)
+            return
+        if isinstance(s, ast.While):
+            self._simple(s.test, st, ctx)
+            for _ in (0, 1):
+                for sub in s.body:
+                    self._stmt(sub, st, ctx)
+            for sub in s.orelse:
+                self._stmt(sub, st, ctx)
+            return
+        if isinstance(s, ast.If):
+            self._simple(s.test, st, ctx)
+            for sub in s.body + s.orelse:
+                self._stmt(sub, st, ctx)
+            return
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            for item in s.items:
+                self._simple(item, st, ctx)
+            for sub in s.body:
+                self._stmt(sub, st, ctx)
+            return
+        if isinstance(s, ast.Try):
+            for sub in s.body:
+                self._stmt(sub, st, ctx)
+            for h in s.handlers:
+                for sub in h.body:
+                    self._stmt(sub, st, ctx)
+            for sub in s.orelse + s.finalbody:
+                self._stmt(sub, st, ctx)
+            return
+        self._simple(s, st, ctx)
+
+    def _simple(self, s, st, ctx):
+        donating, consumed, reported = st
+        # consuming calls in this statement: a bound donating callable,
+        # or a direct jax.jit(fn, donate_argnums=...)(args) invocation
+        consuming = []
+        for c in ast.walk(s):
+            if not isinstance(c, ast.Call):
+                continue
+            spec = None
+            if isinstance(c.func, ast.Call) and _is_jit_call(c.func):
+                spec = _donate_spec(c.func)
+            elif not _is_jit_call(c):
+                name = dotted(c.func)
+                if name:
+                    spec = donating.get(name)
+            if spec is not None:
+                consuming.append((c, spec))
+        # reads are checked against names consumed BEFORE this
+        # statement, so a consuming call's own arguments only fire when
+        # an earlier call already donated them
+        for n in ast.walk(s):
+            if (isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+                    and n.id in consumed and id(n) not in reported):
+                reported.add(id(n))
+                line, callee = consumed[n.id]
+                ctx.report(n, self.id,
+                           f"{n.id!r} was donated to {callee}() at line "
+                           f"{line} and its buffer is no longer valid; "
+                           f"rebind the name to the call's output (or "
+                           f"drop donate_argnums for this argument)")
+        for c, spec in consuming:
+            callee = dotted(c.func) or "a jitted callable"
+            for pos, a in enumerate(c.args):
+                if isinstance(a, ast.Name) and (spec == "all"
+                                                or pos in spec):
+                    consumed[a.id] = (c.lineno, callee)
+        # stores AFTER consumption: `params = f(params)` rebinds the
+        # name to the fresh output, clearing the hazard
+        if isinstance(s, ast.Assign):
+            v = s.value
+            if isinstance(v, ast.Call) and _is_jit_call(v) \
+                    and _donate_spec(v) is not None:
+                for t in s.targets:
+                    tname = dotted(t)
+                    if tname:
+                        donating[tname] = _donate_spec(v)
+            for t in s.targets:
+                self._clear_stores(t, st)
+        elif isinstance(s, (ast.AugAssign, ast.AnnAssign)):
+            self._clear_stores(s.target, st)
+        elif isinstance(s, ast.Delete):
+            for t in s.targets:
+                self._clear_stores(t, st)
+
+    @staticmethod
+    def _clear_stores(target, st):
+        _, consumed, _ = st
+        for n in ast.walk(target):
+            if isinstance(n, ast.Name):
+                consumed.pop(n.id, None)

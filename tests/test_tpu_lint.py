@@ -208,6 +208,23 @@ FIXTURES = {
             print("progress", file=sys.stderr)
         """,
     ),
+    "TPU011": (
+        "pkg/mod.py",
+        """
+        import jax
+        def train(step_fn, params, batch):
+            f = jax.jit(step_fn, donate_argnums=(0,))
+            new_params = f(params, batch)
+            return params["w"], new_params
+        """,
+        """
+        import jax
+        def train(step_fn, params, batch):
+            f = jax.jit(step_fn, donate_argnums=(0,))
+            params = f(params, batch)
+            return params["w"]
+        """,
+    ),
 }
 
 
@@ -377,6 +394,65 @@ def test_tpu008_bare_except_flagged_only_in_distributed_paths():
     """
     assert "TPU008" in rules_fired(src, path="pkg/fleet/util.py")
     assert "TPU008" not in rules_fired(src, path="pkg/vision/util.py")
+
+
+def test_tpu011_loop_carried_reuse_fires():
+    # f(params) every iteration without rebinding: iteration 2 passes a
+    # buffer iteration 1 already donated
+    src = """
+    import jax
+    def train(step_fn, params, batches):
+        f = jax.jit(step_fn, donate_argnums=(0,))
+        for b in batches:
+            out = f(params, b)
+        return out
+    """
+    assert "TPU011" in rules_fired(src)
+
+
+def test_tpu011_loop_rebind_is_silent():
+    src = """
+    import jax
+    def train(step_fn, params, batches):
+        f = jax.jit(step_fn, donate_argnums=(0,))
+        for b in batches:
+            params = f(params, b)
+        return params
+    """
+    assert "TPU011" not in rules_fired(src)
+
+
+def test_tpu011_non_donated_position_is_silent():
+    # only position 0 is donated; `batch` stays readable
+    src = """
+    import jax
+    def train(step_fn, params, batch):
+        f = jax.jit(step_fn, donate_argnums=(0,))
+        out = f(params, batch)
+        return batch.shape, out
+    """
+    assert "TPU011" not in rules_fired(src)
+
+
+def test_tpu011_direct_jit_call_fires():
+    src = """
+    import jax
+    def train(step_fn, params, batch):
+        out = jax.jit(step_fn, donate_argnums=0)(params, batch)
+        return params["w"], out
+    """
+    assert "TPU011" in rules_fired(src)
+
+
+def test_tpu011_plain_jit_without_donation_is_silent():
+    src = """
+    import jax
+    def train(step_fn, params, batch):
+        f = jax.jit(step_fn)
+        out = f(params, batch)
+        return params["w"], out
+    """
+    assert "TPU011" not in rules_fired(src)
 
 
 # -- suppressions ------------------------------------------------------------
