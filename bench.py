@@ -58,7 +58,7 @@ _T = (lambda full, smoke: smoke if SMOKE else full)
 LEG_TIMEOUT = {
     "canary": _T(300, 120), "canary_retry": _T(420, 120),
     "resnet": _T(600, 300), "gpt": _T(900, 300), "bert": _T(600, 300),
-    "ring": _T(600, 300), "packed": _T(600, 300),
+    "ring": _T(600, 300), "packed": _T(600, 300), "kernels": _T(600, 300),
 }
 
 # Driver-captured r03 numbers (BENCH_r03.json, 2026-07-30) — the
@@ -632,6 +632,89 @@ def bench_packed(result):
     return ms_packed
 
 
+def bench_kernels(result):
+    """Fusion-cluster microbench: each fused Pallas kernel vs the XLA
+    lowering of its pure-jnp reference, fwd+bwd, at the bench models'
+    shapes (GPT-345M hidden/vocab, BERT hidden, ResNet50 head). The
+    autotuner searches launch configs first — winning config, search
+    seconds, and timed/pruned counts ride on the record's ``autotune``
+    block — then the timed runs consume the cached winners exactly like
+    a real train step would."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops import autotune as at
+    from paddle_tpu.ops import fused_kernels as fk
+    from paddle_tpu.ops.pallas_ops import mha, mha_reference, tune_mha
+
+    result["device_kind"] = _device_kind()
+    interp = None if SMOKE else False  # SMOKE runs on CPU via interpret
+    iters = 2 if SMOKE else 20
+    rng = np.random.RandomState(0)
+    kernels: dict = {}
+
+    def fwdbwd_ms(fn, *args):
+        f = jax.jit(jax.grad(
+            lambda *a: jnp.sum(fn(*a).astype(jnp.float32))))
+        jax.block_until_ready(f(*args))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            g = f(*args)
+        jax.block_until_ready(g)
+        return (time.perf_counter() - t0) / iters * 1000
+
+    def record(name, pallas_ms, xla_ms):
+        kernels[name] = {"pallas_ms": round(pallas_ms, 3),
+                         "xla_ms": round(xla_ms, 3),
+                         "speedup": round(xla_ms / max(pallas_ms, 1e-9), 2)}
+
+    # -- fused layernorm: GPT-345M and BERT token×hidden shapes --------
+    ln_shapes = [("gpt345m", 8 * GPT_SEQ, 1024), ("bert", 32 * BERT_SEQ,
+                                                  768)]
+    for tag, rows, d in ln_shapes:
+        if SMOKE:
+            rows, d = min(rows, 512), min(d, 256)
+        x = jnp.asarray(rng.randn(rows, d).astype(np.float32)).astype(
+            jnp.bfloat16)
+        w = jnp.ones((d,), jnp.bfloat16)
+        b = jnp.zeros((d,), jnp.bfloat16)
+        fk.tune_layer_norm(x, w, b, interpret=interp)
+        record(f"fused_layer_norm_{tag}",
+               fwdbwd_ms(lambda a: fk.fused_layer_norm(
+                   a, w, b, interpret=interp), x),
+               fwdbwd_ms(lambda a: fk.layer_norm_reference(a, w, b), x))
+
+    # -- fused softmax-xent: GPT vocab, BERT vocab, ResNet50 head ------
+    xe_shapes = [("gpt345m", 1024, 50304), ("bert", 1024, 30592),
+                 ("resnet50_head", 256, 1000)]
+    for tag, rows, V in xe_shapes:
+        if SMOKE:
+            rows, V = min(rows, 64), min(V, 512)
+        logits = jnp.asarray(
+            rng.randn(rows, V).astype(np.float32)).astype(jnp.bfloat16)
+        lab = jnp.asarray(rng.randint(0, V, rows).astype(np.int32))
+        fk.tune_softmax_xent(logits, lab, interpret=interp)
+        record(f"fused_softmax_xent_{tag}",
+               fwdbwd_ms(lambda a: fk.fused_softmax_xent(
+                   a, lab, interpret=interp), logits),
+               fwdbwd_ms(lambda a: fk.softmax_xent_reference(a, lab),
+                         logits))
+
+    # -- flash attention at the GPT-345M attention shape ---------------
+    S = GPT_SEQ
+    q, k, v = (jnp.asarray(rng.randn(1, 16, S, 64).astype(
+        np.float32)).astype(jnp.bfloat16) for _ in range(3))
+    tune_mha(q, k, v, causal=True, interpret=interp)
+    record("flash_mha_gpt345m",
+           fwdbwd_ms(lambda a: mha(a, k, v, causal=True,
+                                   interpret=interp), q),
+           fwdbwd_ms(lambda a: mha_reference(a, k, v, causal=True), q))
+
+    result["kernels"] = kernels
+    result["autotune"] = at.summary()
+    return kernels
+
+
 # ---------------------------------------------------------------------------
 # Leg subprocess plumbing
 # ---------------------------------------------------------------------------
@@ -657,6 +740,8 @@ def _leg_main(name, batch, recompute):
             bench_ring(fields)
         elif name == "packed":
             bench_packed(fields)
+        elif name == "kernels":
+            bench_kernels(fields)
         else:
             raise ValueError(f"unknown leg {name}")
     except Exception:
@@ -874,9 +959,10 @@ def main():
                 pass
 
         # new-kernel evidence legs before bert (bert has 3 prior
-        # driver captures already; packed/ring have none)
+        # driver captures already; packed/ring/kernels have none)
         try_leg("packed")
         try_leg("ring")
+        try_leg("kernels")
 
         def bert_ladder():
             for b in (32, 16, 8):

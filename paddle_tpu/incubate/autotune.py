@@ -15,8 +15,8 @@ import json
 from ..ops import autotune as _at
 from ..ops.pallas_ops import tune_mha
 
-__all__ = ["set_config", "tune_flash_attention", "save_cache",
-           "load_cache"]
+__all__ = ["set_config", "tune_flash_attention", "tune_layer_norm",
+           "tune_softmax_cross_entropy", "save_cache", "load_cache"]
 
 
 def set_config(config=None):
@@ -33,22 +33,45 @@ def set_config(config=None):
     _at.set_enabled(bool(kcfg.get("enable", False)))
 
 
+def _arr(x):
+    import jax.numpy as jnp
+    from ..tensor import Tensor
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
 def tune_flash_attention(query, key, value, *, causal=False,
                          interpret=None):
-    """Eagerly time flash-attention block configs for these shapes and
+    """Eagerly search flash-attention block configs for these shapes and
     cache the winner (picked up by all subsequent calls, traced or not).
     Accepts Tensors or arrays in paddle (B, S, H, D) layout. Returns
     (best_config, timings)."""
     import jax.numpy as jnp
-    from ..tensor import Tensor
 
-    def arr(x):
-        return x._data if isinstance(x, Tensor) else jnp.asarray(x)
-
-    q = jnp.swapaxes(arr(query), 1, 2)
-    k = jnp.swapaxes(arr(key), 1, 2)
-    v = jnp.swapaxes(arr(value), 1, 2)
+    q = jnp.swapaxes(_arr(query), 1, 2)
+    k = jnp.swapaxes(_arr(key), 1, 2)
+    v = jnp.swapaxes(_arr(value), 1, 2)
     return tune_mha(q, k, v, causal=causal, interpret=interpret)
+
+
+def tune_layer_norm(x, weight=None, bias=None, *, epsilon=1e-5,
+                    interpret=None):
+    """Warmup search for the fused layernorm launch config; ``x`` is the
+    (rows, d) view the hot path will see (flatten leading dims first).
+    Returns (best_config, timings)."""
+    from ..ops.fused_kernels import tune_layer_norm as _tune
+    return _tune(_arr(x),
+                 None if weight is None else _arr(weight),
+                 None if bias is None else _arr(bias),
+                 epsilon=epsilon, interpret=interpret)
+
+
+def tune_softmax_cross_entropy(logits, labels, *, ignore_index=-100,
+                               label_smoothing=0.0, interpret=None):
+    """Warmup search for the fused softmax-cross-entropy launch config
+    at this (rows, V) logits shape. Returns (best_config, timings)."""
+    from ..ops.fused_kernels import tune_softmax_xent as _tune
+    return _tune(_arr(logits), _arr(labels), ignore_index=ignore_index,
+                 label_smoothing=label_smoothing, interpret=interpret)
 
 
 save_cache = _at.save_cache

@@ -363,12 +363,16 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                   and _flags.flag("use_pallas_kernels")
                   and _on_tpu() and _flash_usable())
     eff_drop = dropout_p if training else 0.0
+    from ...ops.fused_kernels import record_dispatch as _record
     if use_pallas:
         try:
             from ...ops.pallas_ops import flash_attention as _fa
-            return _fa(q, k_, v, causal=is_causal, dropout_p=eff_drop)
+            out = _fa(q, k_, v, causal=is_causal, dropout_p=eff_drop)
+            _record("flash_mha", "pallas")
+            return out
         except Exception:
             pass  # fall back to XLA path
+    _record("flash_mha", "fallback")
 
     key_rng = _random.next_key() if (dropout_p > 0.0 and training) else None
 
@@ -438,6 +442,31 @@ def _flash_usable():
             interpret=False).astype(jnp.float32).sum())(x)
         return out, outd, g
     return _kernel_canary("flash_mha", probe)
+
+
+def _fused_ln_usable():
+    def probe():
+        from ...ops.fused_kernels import fused_layer_norm
+        x = jnp.zeros((8, 256), jnp.bfloat16)
+        w = jnp.ones((256,), jnp.bfloat16)
+        b = jnp.zeros((256,), jnp.bfloat16)
+        out = fused_layer_norm(x, w, b, interpret=False)
+        g = jax.grad(lambda a: fused_layer_norm(
+            a, w, b, interpret=False).astype(jnp.float32).sum())(x)
+        return out, g
+    return _kernel_canary("fused_layer_norm", probe)
+
+
+def _fused_xent_usable():
+    def probe():
+        from ...ops.fused_kernels import fused_softmax_xent
+        x = jnp.zeros((8, 384), jnp.float32)
+        y = jnp.zeros((8,), jnp.int32)
+        loss = fused_softmax_xent(x, y, interpret=False)
+        g = jax.grad(lambda a: fused_softmax_xent(a, y,
+                                                  interpret=False).sum())(x)
+        return loss, g
+    return _kernel_canary("fused_softmax_xent", probe)
 
 
 def _on_tpu():

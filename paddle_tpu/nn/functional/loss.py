@@ -39,6 +39,13 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
                   use_softmax=True, label_smoothing=0.0, name=None):
     input, label = ensure_tensor(input), ensure_tensor(label)
 
+    fused = _maybe_fused_cross_entropy(
+        input, label, weight=weight, ignore_index=ignore_index,
+        reduction=reduction, soft_label=soft_label, axis=axis,
+        use_softmax=use_softmax, label_smoothing=label_smoothing)
+    if fused is not None:
+        return fused
+
     def f(logits, lab, *w):
         ax = axis % logits.ndim
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=ax) \
@@ -83,6 +90,62 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
     args = [input, label] + ([ensure_tensor(weight)] if weight is not None
                              else [])
     return nary(f, args, name="cross_entropy")
+
+
+def _maybe_fused_cross_entropy(input, label, *, weight, ignore_index,
+                               reduction, soft_label, axis, use_softmax,
+                               label_smoothing):
+    """Route hard-label cross-entropy through the fused Pallas
+    softmax-xent kernel (same gate shape as
+    ``scaled_dot_product_attention``: flag + hardware + one-time
+    lowering canary, XLA fallback on any failure or ineligible shape).
+    Returns the loss Tensor, or None when the caller should take the
+    XLA path. Soft labels, class weights, and non-trailing class axes
+    stay on XLA."""
+    from ...framework import flags as _flags
+    from ...ops.fused_kernels import record_dispatch as _record
+    try:
+        eligible = (use_softmax and not soft_label and weight is None
+                    and input.ndim >= 1
+                    and axis % input.ndim == input.ndim - 1
+                    and not (label.ndim == input.ndim
+                             and label.shape[-1] == input.shape[-1]
+                             and jnp.issubdtype(label._data.dtype,
+                                                jnp.floating))
+                    and jnp.issubdtype(label._data.dtype, jnp.integer))
+    except Exception:
+        eligible = False
+    if not (eligible and _flags.flag("use_pallas_kernels")):
+        _record("fused_softmax_xent", "fallback")
+        return None
+    from .common import _on_tpu, _fused_xent_usable
+    if not (_on_tpu() and _fused_xent_usable()):
+        _record("fused_softmax_xent", "fallback")
+        return None
+
+    def f(logits, lab):
+        from ...ops.fused_kernels import fused_softmax_xent
+        n_class = logits.shape[-1]
+        lab_i = lab.astype(jnp.int32)
+        if lab_i.ndim == logits.ndim:
+            lab_i = jnp.squeeze(lab_i, axis=-1)
+        rows = int(np.prod(lab_i.shape)) if lab_i.ndim else 1
+        loss = fused_softmax_xent(
+            logits.reshape(rows, n_class), lab_i.reshape(rows),
+            ignore_index=ignore_index, label_smoothing=label_smoothing)
+        loss = loss.reshape(lab_i.shape)
+        if reduction == "mean":
+            valid = (lab_i != ignore_index).astype(jnp.float32)
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(valid), 1.0)
+        return _reduce(loss, reduction)
+
+    try:
+        out = nary(f, [input, label], name="cross_entropy")
+        _record("fused_softmax_xent", "pallas")
+        return out
+    except Exception:
+        _record("fused_softmax_xent", "fallback")
+        return None
 
 
 def softmax_with_cross_entropy(logits, label, soft_label=False,

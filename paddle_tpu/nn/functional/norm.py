@@ -93,6 +93,45 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
     n_axes = len(tuple(normalized_shape))
     axes = tuple(range(x.ndim - n_axes, x.ndim))
 
+    # fused Pallas path (same gate shape as scaled_dot_product_attention:
+    # flag + hardware + one-time lowering canary, XLA fallback on any
+    # failure); the kernel normalizes a flattened (rows, d) view
+    from ...framework import flags as _flags
+    from ...ops.fused_kernels import record_dispatch as _record
+    use_fused = False
+    if _flags.flag("use_pallas_kernels") and x.ndim >= n_axes > 0:
+        from .common import _on_tpu, _fused_ln_usable
+        use_fused = _on_tpu() and _fused_ln_usable()
+    if use_fused:
+        d = int(np.prod(tuple(normalized_shape)))
+
+        def f_fused(dd, *wb):
+            from ...ops.fused_kernels import fused_layer_norm
+            rows = int(np.prod(dd.shape[:dd.ndim - n_axes])) \
+                if dd.ndim > n_axes else 1
+            i = 0
+            w2 = b2 = None
+            if weight is not None:
+                w2, i = wb[i].reshape(d), i + 1
+            if bias is not None:
+                b2 = wb[i].reshape(d)
+            out = fused_layer_norm(dd.reshape(rows, d), w2, b2,
+                                   epsilon=epsilon)
+            return out.reshape(dd.shape)
+
+        args = [x]
+        if weight is not None:
+            args.append(ensure_tensor(weight))
+        if bias is not None:
+            args.append(ensure_tensor(bias))
+        try:
+            out = nary(f_fused, args, name="layer_norm")
+            _record("fused_layer_norm", "pallas")
+            return out
+        except Exception:
+            pass  # fall back to XLA path
+    _record("fused_layer_norm", "fallback")
+
     def f(d, *wb):
         m = jnp.mean(d.astype(jnp.float32), axis=axes, keepdims=True)
         v = jnp.var(d.astype(jnp.float32), axis=axes, keepdims=True)

@@ -1,25 +1,59 @@
-"""Kernel autotune cache (ref: ``paddle/phi/kernels/autotune/`` —
-``cache.h`` AutoTuneCache, ``auto_tune_base.h`` timing loop, enabled via
-``paddle.incubate.autotune.set_config``).
+"""Search-based kernel autotuner (ref: ``paddle/phi/kernels/autotune/``
+— ``cache.h`` AutoTuneCache, ``auto_tune_base.h`` timing loop, enabled
+via ``paddle.incubate.autotune.set_config``).
 
 TPU-native scope: XLA already autotunes its own kernels; what remains is
-the choice of PALLAS kernel launch configs (flash-attention block sizes).
+the choice of PALLAS kernel launch configs (flash-attention block sizes,
+fused layernorm row tiles + grid semantics, fused softmax-xent tiles).
 Because Pallas calls usually execute inside a jit trace (where nothing
-can be timed), tuning is a WARMUP step: time candidates eagerly once per
-(shape, dtype, flags) key, cache the winner, and let traced calls read
-the cache. The cache persists to JSON like the reference's autotune
-cache file.
+can be timed), tuning is a WARMUP step: :func:`search` runs once per
+(shape, dtype, flags) key — candidates are first pruned by a
+``cost_model/`` seed (analytic FLOPs/bytes → roofline ordering; configs
+whose tiles overflow vmem or underfill the MXU are rejected before any
+timing), the survivors are timed eagerly, and the winner is cached for
+traced calls to read.
+
+Cache keys include the device kind, jax version, and a per-kernel
+schema version, so a cache tuned in CPU interpret mode is never served
+to a real TPU run (or to a kernel whose meaning of "config" changed).
+The cache persists to JSON (``save_cache``/``load_cache``, or
+automatically via the ``PT_AUTOTUNE_CACHE`` env var) and stale entries
+are dropped on load rather than crashing.
 """
 from __future__ import annotations
 
 import json
+import os
 import time
 
 __all__ = ["enabled", "set_enabled", "cache_get", "cache_put",
-           "cache_clear", "save_cache", "load_cache", "time_candidates"]
+           "cache_clear", "save_cache", "load_cache", "time_candidates",
+           "search", "prune_candidates", "roofline_seconds",
+           "analytic_seed", "summary", "KERNEL_SCHEMA",
+           "VMEM_LIMIT_BYTES"]
 
 _enabled = False
 _cache: dict = {}
+_autoloaded = False
+_searches: dict = {}          # kernel -> last search stats (bench block)
+
+# Config schema version per kernel: bump when the meaning of a cached
+# config tuple changes (e.g. flash_mha grew tuner-owned clamping in v2).
+KERNEL_SCHEMA = {
+    "flash_mha": 2,
+    "fused_layer_norm": 1,
+    "fused_softmax_xent": 1,
+}
+
+# Roofline constants: v4-class core (~275 TFLOP/s bf16 MXU, ~1.2 TB/s
+# HBM). Only the RATIO matters — the roofline orders candidates, the
+# timing loop decides.
+PEAK_FLOPS = 275e12
+HBM_BW = 1.2e12
+# ~16 MB vmem/core, minus headroom for Mosaic's own buffers.
+VMEM_LIMIT_BYTES = 12 * 1024 * 1024
+
+_ENV_CACHE_VAR = "PT_AUTOTUNE_CACHE"
 
 
 def enabled() -> bool:
@@ -31,11 +65,44 @@ def set_enabled(flag: bool):
     _enabled = bool(flag)
 
 
+# ---------------------------------------------------------------------------
+# cache keys + persistence
+# ---------------------------------------------------------------------------
+def _env_fingerprint():
+    """(device_kind, jax_version) of the process — part of every cache
+    key so interpret-mode CPU tunings never leak onto real TPUs."""
+    import jax
+    try:
+        kind = jax.devices()[0].device_kind
+    except Exception:
+        kind = "unknown"
+    return str(kind), str(jax.__version__)
+
+
 def _key(kernel: str, key: tuple) -> str:
-    return json.dumps([kernel, list(key)])
+    kind, ver = _env_fingerprint()
+    return json.dumps(
+        [kernel, KERNEL_SCHEMA.get(kernel, 1), kind, ver, list(key)])
+
+
+def _autoload():
+    """Lazily pull the persisted cache named by PT_AUTOTUNE_CACHE (if
+    any) the first time the cache is consulted, so a second process
+    reloads winners without re-searching."""
+    global _autoloaded
+    if _autoloaded:
+        return
+    _autoloaded = True
+    path = os.environ.get(_ENV_CACHE_VAR)
+    if path and os.path.exists(path):
+        try:
+            load_cache(path)
+        except Exception:
+            pass
 
 
 def cache_get(kernel: str, key: tuple):
+    _autoload()
     hit = _cache.get(_key(kernel, key))
     return tuple(hit) if hit is not None else None
 
@@ -46,6 +113,7 @@ def cache_put(kernel: str, key: tuple, config):
 
 def cache_clear():
     _cache.clear()
+    _searches.clear()
 
 
 def save_cache(path: str):
@@ -54,10 +122,84 @@ def save_cache(path: str):
 
 
 def load_cache(path: str):
+    """Merge a persisted cache, dropping entries whose device kind, jax
+    version, or kernel schema no longer match this process (stale keys
+    are invalidated, not an error)."""
     with open(path) as f:
-        _cache.update(json.load(f))
+        raw = json.load(f)
+    kind, ver = _env_fingerprint()
+    for k, v in raw.items():
+        try:
+            kernel, schema, k_kind, k_ver, _ = json.loads(k)
+        except Exception:
+            continue                      # pre-schema or corrupt entry
+        if (k_kind, k_ver) != (kind, ver):
+            continue
+        if schema != KERNEL_SCHEMA.get(kernel, 1):
+            continue
+        _cache[k] = v
 
 
+# ---------------------------------------------------------------------------
+# cost-model seed + pruning
+# ---------------------------------------------------------------------------
+def analytic_seed(fn, *example_args):
+    """Seed a kernel's cost function from ``cost_model``: XLA's analytic
+    FLOPs/bytes for the pure-jnp reference of the fused cluster. Returns
+    ``{"flops", "bytes"}`` or None when the analysis is unavailable (the
+    caller falls back to its closed-form estimate)."""
+    try:
+        from ..cost_model.cost_model import CostModel
+        c = CostModel.analytic_cost(fn, *example_args)
+        flops = float(c.get("flops", 0.0))
+        bytes_ = float(c.get("bytes accessed", c.get("bytes", 0.0)))
+        if flops <= 0.0 and bytes_ <= 0.0:
+            return None
+        return {"flops": flops, "bytes": bytes_}
+    except Exception:
+        return None
+
+
+def roofline_seconds(flops: float, bytes_: float) -> float:
+    """Roofline time estimate: the kernel is bound by whichever of MXU
+    throughput or HBM bandwidth it saturates first."""
+    return max(float(flops) / PEAK_FLOPS, float(bytes_) / HBM_BW)
+
+
+def prune_candidates(candidates, cost, vmem_limit=None):
+    """Filter a candidate list through a per-config cost estimate before
+    any timing. ``cost(cfg)`` returns a dict with ``vmem_bytes`` (tile
+    working set), ``mxu_underfill`` (tiles below the native compute tile
+    → rejected), and ``flops``/``bytes`` feeding the roofline ordering;
+    returning None rejects the config outright.
+
+    Returns (survivors_sorted_best_first, pruned_configs)."""
+    if vmem_limit is None:
+        vmem_limit = VMEM_LIMIT_BYTES
+    scored, pruned = [], []
+    for cfg in candidates:
+        try:
+            c = cost(cfg)
+        except Exception:
+            c = None
+        if c is None:
+            pruned.append(cfg)
+            continue
+        if float(c.get("vmem_bytes", 0.0)) > vmem_limit:
+            pruned.append(cfg)
+            continue
+        if c.get("mxu_underfill", False):
+            pruned.append(cfg)
+            continue
+        scored.append((roofline_seconds(c.get("flops", 0.0),
+                                        c.get("bytes", 0.0)), cfg))
+    scored.sort(key=lambda sc: sc[0])
+    return [cfg for _, cfg in scored], pruned
+
+
+# ---------------------------------------------------------------------------
+# timing + search
+# ---------------------------------------------------------------------------
 def time_candidates(run, candidates, warmup=1, iters=3):
     """Pick the fastest config: ``run(config)`` must execute the kernel
     and block until ready (ref ``auto_tune_base.h`` RunAndMeasureKernel).
@@ -81,3 +223,81 @@ def time_candidates(run, candidates, warmup=1, iters=3):
     if best is None:
         raise RuntimeError("no autotune candidate ran successfully")
     return best, timings
+
+
+def _metrics():
+    try:
+        from ..observability.metrics import get_registry
+        from ..observability.telemetry import get_telemetry
+        if not get_telemetry().enabled:
+            return None, None, None
+        reg = get_registry()
+        return (reg.counter("pt_autotune_cache_hits_total",
+                            "Autotune searches answered from cache",
+                            labelnames=("kernel",)),
+                reg.counter("pt_autotune_cache_misses_total",
+                            "Autotune searches that had to time candidates",
+                            labelnames=("kernel",)),
+                reg.counter("pt_autotune_search_seconds",
+                            "Wall seconds spent timing autotune candidates",
+                            labelnames=("kernel",)))
+    except Exception:
+        return None, None, None
+
+
+def search(kernel: str, key: tuple, run, candidates, cost=None,
+           vmem_limit=None, warmup=1, iters=3):
+    """The tuner's front door: cache hit → return the cached winner
+    without running anything; miss → prune ``candidates`` through
+    ``cost`` (see :func:`prune_candidates`), time the survivors with
+    ``run``, cache + (if ``PT_AUTOTUNE_CACHE`` is set) persist the
+    winner. Returns (best_config, {config: seconds}) — timings empty on
+    a cache hit."""
+    hits, misses, secs = _metrics()
+    cached = cache_get(kernel, key)
+    if cached is not None:
+        if hits is not None:
+            hits.inc(kernel=kernel)
+        return cached, {}
+    if misses is not None:
+        misses.inc(kernel=kernel)
+
+    candidates = list(candidates)
+    if cost is not None:
+        survivors, pruned = prune_candidates(candidates, cost, vmem_limit)
+    else:
+        survivors, pruned = candidates, []
+    if not survivors:
+        raise RuntimeError(
+            f"autotune[{kernel}]: cost model pruned every candidate "
+            f"({len(pruned)} rejected)")
+
+    t0 = time.perf_counter()
+    best, timings = time_candidates(run, survivors, warmup=warmup,
+                                    iters=iters)
+    elapsed = time.perf_counter() - t0
+    if secs is not None:
+        secs.inc(elapsed, kernel=kernel)
+
+    cache_put(kernel, key, best)
+    _searches[kernel] = {
+        "key": list(key),
+        "best": list(best),
+        "search_seconds": elapsed,
+        "timed": len(timings),
+        "pruned": len(pruned),
+    }
+    path = os.environ.get(_ENV_CACHE_VAR)
+    if path:
+        try:
+            save_cache(path)
+        except Exception:
+            pass
+    return tuple(best), timings
+
+
+def summary():
+    """Per-kernel stats of the searches this process ran (winning
+    config, search seconds, timed/pruned counts) — attached to bench
+    records as the ``autotune`` block."""
+    return {k: dict(v) for k, v in _searches.items()}

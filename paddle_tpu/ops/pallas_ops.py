@@ -609,19 +609,50 @@ def mha(q, k, v, *, causal=False, sm_scale=None, block_q=None, block_k=None,
     return out[:, :sq, :d].reshape(b, h, sq, d)
 
 
+def _mha_cost_fn(b, h, sq, skv, d, itemsize):
+    """Per-candidate cost estimate for the flash-attention search:
+    analytic FLOPs/bytes of the XLA reference (scaled from a small
+    sample) order the survivors on the roofline; the vmem working set
+    and MXU-fill checks reject configs before any timing."""
+    from . import autotune as _at
+    d_p = _ceil_to(d, _LANES)
+    sb, ss = min(b * h, 4), min(sq, 256)
+    sample = jnp.zeros((1, sb, ss, d), jnp.float32)
+    seed = _at.analytic_seed(
+        lambda a: mha_reference(a, a, a), sample)
+    scale = (b * h * sq * skv) / max(sb * ss * ss, 1)
+    flops = seed["flops"] * scale if seed else 4.0 * b * h * sq * skv * d
+    bytes_ = seed["bytes"] * scale if seed else \
+        4.0 * b * h * (sq + skv) * d * itemsize
+
+    def cost(cfg):
+        bq = min(int(cfg[0]), _ceil_to(sq, 8))
+        bk = min(int(cfg[1]), _ceil_to(skv, 8))
+        # per-grid-step tiles: q/o in native dtype + f32 acc, k/v
+        # blocks, and the (bq, 128) m/l scratch rows
+        vmem = (2 * bq * d_p * itemsize + bq * d_p * 4
+                + 2 * bk * d_p * itemsize + 2 * bq * _LANES * 4)
+        return {"flops": flops, "bytes": bytes_, "vmem_bytes": vmem,
+                "mxu_underfill": min(bq, bk) < 8}
+    return cost
+
+
 def tune_mha(q, k, v, *, causal=False, interpret=None,
              candidates=((128, 128), (256, 256), (512, 256), (512, 512),
                          (1024, 256), (1024, 512))):
-    """Warmup autotune for :func:`mha`: eagerly time the candidate
-    (block_q, block_k) configs on REAL arrays, cache the winner keyed by
-    (seq, d, dtype, causal) so subsequent (including traced) calls pick
-    it up. Returns (best_config, timings). Candidates larger than the
-    padded sequence are deduplicated after clamping."""
+    """Warmup autotune for :func:`mha`: candidate (block_q, block_k)
+    configs are pruned by the cost-model roofline (vmem overflow / MXU
+    underfill rejected before timing — see :func:`autotune.search`),
+    survivors are eagerly timed on REAL arrays, and the winner is cached
+    keyed by (seq, d, dtype, causal) so subsequent (including traced)
+    calls pick it up. Returns (best_config, timings). Candidates larger
+    than the padded sequence are deduplicated after clamping."""
     from . import autotune as _at
 
     if interpret is None:
         interpret = _interpret_default()
-    sq, skv = q.shape[2], k.shape[2]
+    b, h, sq, d = q.shape
+    skv = k.shape[2]
     seen, todo = set(), []
     for bq, bk in candidates:
         clamped = (min(bq, _ceil_to(sq, 8)), min(bk, _ceil_to(skv, 8)))
@@ -641,8 +672,9 @@ def tune_mha(q, k, v, *, causal=False, interpret=None,
         state["q"] = (out.astype(jnp.float32) * 1e-3).astype(q.dtype)
         float(jnp.sum(state["q"].astype(jnp.float32)))
 
-    best, timings = _at.time_candidates(run, todo)
-    _at.cache_put("flash_mha", _mha_tune_key(q, k, causal, interpret), best)
+    best, timings = _at.search(
+        "flash_mha", _mha_tune_key(q, k, causal, interpret), run, todo,
+        cost=_mha_cost_fn(b, h, sq, skv, d, q.dtype.itemsize))
     # explicit tuning is intent: turn cache consumption on (still
     # switch-offable via incubate.autotune.set_config kernel.enable=False)
     _at.set_enabled(True)

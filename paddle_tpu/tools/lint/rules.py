@@ -628,3 +628,31 @@ class DonatedBufferReuse(Rule):
         for n in ast.walk(target):
             if isinstance(n, ast.Name):
                 consumed.pop(n.id, None)
+
+
+@register
+class RawPallasCall(Rule):
+    id = "TPU012"
+    name = "raw-pallas-call-outside-ops"
+    rationale = ("direct pl.pallas_call outside paddle_tpu/ops/ bypasses "
+                 "the kernel dispatch layer — the use_pallas_kernels "
+                 "flag, the one-time lowering canary with XLA fallback, "
+                 "and the autotuner cache all live there; a raw call "
+                 "site can't be switched off, falls over instead of "
+                 "falling back when Mosaic rejects the kernel, and runs "
+                 "with unsearched launch configs. Wrap the kernel in "
+                 "paddle_tpu/ops/ and dispatch through nn.functional")
+
+    _PALLAS_CALLS = {"pl.pallas_call", "pallas_call",
+                     "pallas.pallas_call",
+                     "jax.experimental.pallas.pallas_call"}
+
+    def on_call(self, node, ctx):
+        if re.search(r"(^|/)paddle_tpu/ops(/|$)", ctx.path_posix):
+            return
+        if dotted(node.func) in self._PALLAS_CALLS:
+            ctx.report(node, self.id,
+                       "raw pallas_call outside paddle_tpu/ops/; move "
+                       "the kernel into paddle_tpu/ops/ and route "
+                       "callers through the dispatch layer (flag + "
+                       "fallback canary + autotuner)")

@@ -225,6 +225,19 @@ FIXTURES = {
             return params["w"]
         """,
     ),
+    "TPU012": (
+        "pkg/mod.py",
+        """
+        from jax.experimental import pallas as pl
+        def attention(q, k, v):
+            return pl.pallas_call(_kernel, out_shape=q)(q, k, v)
+        """,
+        """
+        def attention(q, k, v):
+            from paddle_tpu.ops.pallas_ops import mha
+            return mha(q, k, v, causal=True)
+        """,
+    ),
 }
 
 
@@ -453,6 +466,42 @@ def test_tpu011_plain_jit_without_donation_is_silent():
         return params["w"], out
     """
     assert "TPU011" not in rules_fired(src)
+
+
+PALLAS_SRC = """
+from jax.experimental import pallas as pl
+def kernel_entry(x):
+    return pl.pallas_call(_body, out_shape=x)(x)
+"""
+
+
+def test_tpu012_inside_ops_is_silent():
+    # the dispatch layer itself is where raw pallas_call belongs
+    assert "TPU012" not in rules_fired(
+        PALLAS_SRC, path="paddle_tpu/ops/pallas_ops.py")
+    assert "TPU012" not in rules_fired(
+        PALLAS_SRC, path="paddle_tpu/ops/fused_kernels.py")
+
+
+def test_tpu012_fires_outside_ops():
+    for path in ("paddle_tpu/nn/functional/common.py", "exp/bench_flash.py",
+                 "bench.py"):
+        assert "TPU012" in rules_fired(PALLAS_SRC, path=path)
+
+
+def test_tpu012_alternate_spellings_fire():
+    src = """
+    from jax.experimental.pallas import pallas_call
+    def f(x):
+        return pallas_call(_body, out_shape=x)(x)
+    """
+    assert "TPU012" in rules_fired(src)
+    src = """
+    import jax
+    def f(x):
+        return jax.experimental.pallas.pallas_call(_body, out_shape=x)(x)
+    """
+    assert "TPU012" in rules_fired(src)
 
 
 # -- suppressions ------------------------------------------------------------
