@@ -70,12 +70,10 @@ _DRIVER_BASELINE = {
     "bert_base_seq_per_sec": 809.1,
 }
 
-# bf16 peak FLOP/s per chip by device kind (public spec sheets)
-_PEAK = {
-    "TPU v4": 275e12, "TPU v5": 459e12, "TPU v5p": 459e12,
-    "TPU v5e": 197e12, "TPU v5 lite": 197e12, "TPU v6e": 918e12,
-    "TPU v6 lite": 918e12, "TPU v3": 123e12, "TPU v2": 45e12,
-}
+# bf16 peak FLOP/s per chip: the ONE shared table lives in
+# observability.trace (PEAK_FLOPS) so bench records and the
+# pt_mfu_analytic gauge can never disagree about a chip's peak
+from paddle_tpu.observability.trace import peak_flops as _peak_flops  # noqa: E402
 
 
 def _error_tail(tb: str) -> str:
@@ -135,13 +133,18 @@ def _memory_report(compiled):
         return None
 
 
-def _peak_flops(device_kind):
-    kind = (device_kind or "").lower()
-    # longest prefix wins ("TPU v5 lite" must not match "TPU v5")
-    for k in sorted(_PEAK, key=len, reverse=True):
-        if kind.startswith(k.lower()):
-            return _PEAK[k]
-    return None
+def _feed_tracer(program, flops, step_seconds):
+    """Feed the step tracer the leg's measured program cost so the
+    record's ``trace`` block (and pt_mfu_analytic) agrees with the
+    leg's own MFU arithmetic."""
+    from paddle_tpu.observability.trace import get_tracer
+    tr = get_tracer()
+    if not tr.enabled:
+        return
+    if flops:
+        tr.record_program_flops(program, flops)
+    if step_seconds:
+        tr.on_step(step_seconds)
 
 
 def _device_kind():
@@ -310,6 +313,7 @@ def bench_resnet(result):
     peak = _peak_flops(result.get("device_kind"))
     if flops and peak:
         result["mfu"] = round(flops / step / peak, 4)
+    _feed_tracer("resnet50_step", flops, step)
     return ips
 
 
@@ -398,6 +402,7 @@ def bench_gpt(result, batch, recompute=True):
         per_token = (6 * n_params
                      + 6 * cfg.num_layers * GPT_SEQ * cfg.hidden_size)
         result["gpt345m_mfu_model"] = round(tps * per_token / peak, 4)
+    _feed_tracer("gpt345m_step", flops, step)
     return tps
 
 
@@ -469,6 +474,7 @@ def bench_bert(result, batch):
     peak = _peak_flops(result.get("device_kind"))
     if flops and peak:
         result["bert_base_mfu"] = round(flops / step / peak, 4)
+    _feed_tracer("bert_base_step", flops, step)
     return sps
 
 
@@ -724,7 +730,9 @@ def _leg_main(name, batch, recompute):
     (errors travel in the JSON)."""
     _honor_cpu_override()
     from paddle_tpu.observability import get_telemetry
+    from paddle_tpu.observability.trace import get_tracer
     tel = get_telemetry().enable()  # metrics + compile watch, no sink/server
+    tr = get_tracer().enable()      # span sink + analytic-MFU accounting
     fields: dict = {}
     rec = {"ok": True, "fields": fields}
     try:
@@ -752,6 +760,7 @@ def _leg_main(name, batch, recompute):
     # health snapshot rides along even when the leg died: compile count,
     # step p50/p95, peak device memory at the moment of failure
     fields[f"telemetry_{name}"] = tel.snapshot()
+    fields[f"trace_{name}"] = tr.snapshot()
     print(json.dumps(rec), flush=True)
 
 
@@ -816,7 +825,9 @@ def main():
     # but it carries pid/health onto every emitted record including the
     # tpu_unreachable fast-fail, where the leg snapshots never happen
     from paddle_tpu.observability import get_telemetry
+    from paddle_tpu.observability.trace import get_tracer
     tel = get_telemetry().enable()
+    tr = get_tracer().enable()
 
     def remaining():
         return BUDGET_SEC - (time.time() - t_start)
@@ -830,6 +841,9 @@ def main():
             result.pop("errors", None)
         result["telemetry_driver"] = tel.snapshot()
         result["telemetry_cluster"] = _cluster_snapshot()
+        # every printed record carries a trace block — including the
+        # tpu_unreachable fast-fail, where only the CPU leg ran
+        result["trace"] = tr.snapshot()
         print(json.dumps(result), flush=True)
 
     def merge(rec, stage):
@@ -862,6 +876,9 @@ def main():
             k: eager[k] for k in ("raw_jax", "tape_off", "tape_on",
                                   "jit_chain", "tape_overhead_ratio")
             if k in eager}
+        # the CPU leg's trace block: analytic MFU against the nominal
+        # cpu peak — present even when every TPU leg dies
+        result["trace_eager"] = eager.get("trace")
     except Exception:
         errors["eager_dispatch"] = _error_tail(traceback.format_exc(limit=5))
     emit()

@@ -69,6 +69,8 @@ def _capture_contract(pt):
     import paddle_tpu.nn as nn
     from paddle_tpu.observability import get_telemetry
 
+    from paddle_tpu.observability.trace import get_tracer
+
     np.random.seed(0)
     pt.seed(0)
     model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
@@ -87,10 +89,16 @@ def _capture_contract(pt):
     x = pt.to_tensor(np.random.randn(4, 8).astype(np.float32))
     y = pt.to_tensor(np.random.randn(4, 1).astype(np.float32))
     first = last = None
+    t0 = time.perf_counter()
     for i in range(10):
         loss = float(np.asarray(step(x, y)._data))
         first = loss if first is None else first
         last = loss
+    # feed the tracer the measured step time: with the captured
+    # program's cost_analysis FLOPs (harvested at compile) and the
+    # nominal cpu peak, the record's trace block carries a real
+    # analytic-MFU figure even with the TPU unreachable
+    get_tracer().on_step((time.perf_counter() - t0) / 10)
     storms = get_telemetry().snapshot()["recompile_storms"]
     return {
         "steps": 10,
@@ -145,7 +153,11 @@ def main():
         return z
 
     from paddle_tpu.observability import get_telemetry
+    from paddle_tpu.observability.trace import get_tracer
     tel = get_telemetry().enable()
+    # tracing on for the whole bench: capture harvests per-program
+    # cost_analysis FLOPs at compile time, replays record compute spans
+    tr = get_tracer().enable()
 
     # the chain takes its inputs as ARGUMENTS: closed-over operands let
     # XLA constant-fold the whole program into one literal, which would
@@ -198,6 +210,7 @@ def main():
     res["value"] = res["tape_on"]
     res["capture"] = _capture_contract(pt)
     res["telemetry"] = tel.snapshot()
+    res["trace"] = tr.snapshot()
     try:
         from paddle_tpu.observability import cluster_snapshot
         res["telemetry_cluster"] = cluster_snapshot(

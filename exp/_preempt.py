@@ -43,6 +43,17 @@ __all__ = ["ExpRunGuard"]
 logger = logging.getLogger(__name__)
 
 
+def _tracer():
+    """The step tracer, or None — the guard must keep working when
+    observability is stripped, and a broken import must never turn a
+    preemption save into a crash."""
+    try:
+        from paddle_tpu.observability.trace import get_tracer
+        return get_tracer()
+    except Exception:
+        return None
+
+
 class ExpRunGuard:
     def __init__(self, name, root=None, enabled=None, every=None,
                  keep_last_n=2):
@@ -67,11 +78,21 @@ class ExpRunGuard:
         on_preemption(self._save_now)
 
     def _save_now(self):
+        tr = _tracer()
+        if tr is not None and tr.enabled:
+            # the flight recorder's SIGTERM trigger: dump the span window
+            # BEFORE the save — if the save fails (donated buffers, full
+            # disk) the recorder still has the run's last moments
+            tr.flight_dump(reason="sigterm")
         if self._mgr is None or self._state is None:
             return
         logger.warning("preemption: committing step %d to %s",
                        self._step, self.root)
-        self._mgr.save(self._step, self._state, block=True)
+        if tr is not None and tr.enabled:
+            with tr.phase("checkpoint"):
+                self._mgr.save(self._step, self._state, block=True)
+        else:
+            self._mgr.save(self._step, self._state, block=True)
 
     def restore(self, template):
         """Resume point: ``(state, start_step)`` — ``(template, 0)`` on
@@ -89,7 +110,12 @@ class ExpRunGuard:
         self._step, self._state = int(step), state
         if self._mgr is not None and self.every \
                 and step % self.every == 0:
-            self._mgr.save(step, state, block=True)
+            tr = _tracer()
+            if tr is not None and tr.enabled:
+                with tr.phase("checkpoint"):
+                    self._mgr.save(step, state, block=True)
+            else:
+                self._mgr.save(step, state, block=True)
 
     def finish(self):
         """The run completed: uninstall the handler and remove the

@@ -140,6 +140,24 @@ _py_events: list[tuple[str, float, float, int]] = []
 _py_trace_on = False
 _py_mu = threading.Lock()
 
+# observability span sink: every RecordEvent is forwarded to
+# paddle_tpu.observability.trace when that tracer is enabled, making it
+# the single sink for host spans.  Resolved lazily (core must not
+# import observability at module level) and cached as the *getter* so a
+# test-reset tracer singleton is picked up.
+_obs_get = None
+
+
+def _obs_tracer():
+    global _obs_get
+    if _obs_get is None:
+        try:
+            from ..observability.trace import get_tracer as _g
+            _obs_get = _g
+        except Exception:
+            _obs_get = False
+    return _obs_get() if _obs_get else None
+
 
 def tracer_enable(level: int = 1) -> None:
     lib = _load()
@@ -189,6 +207,10 @@ class RecordEvent:
         return False
 
     def begin(self):
+        tr = _obs_tracer()
+        if tr is not None and tr.enabled:
+            import time
+            self._obs_t0 = time.perf_counter_ns()
         lib = _load()
         if lib:
             lib.pt_trace_push(self.name.encode(), self.level)
@@ -197,6 +219,14 @@ class RecordEvent:
             self._t0 = time.perf_counter_ns()
 
     def end(self):
+        t0 = getattr(self, "_obs_t0", None)
+        if t0 is not None:
+            self._obs_t0 = None
+            tr = _obs_tracer()
+            if tr is not None and tr.enabled:
+                import time
+                tr.record_span(self.name, "host", t0,
+                               time.perf_counter_ns())
         lib = _load()
         if lib:
             lib.pt_trace_pop()

@@ -234,13 +234,17 @@ def _timed(op):
     state skips the observation (``_observe``'s count/bytes still fire
     once per trace).  Wall time around async dispatch is a lower
     bound; eager collectives here execute via ``Group._shard_eval``,
-    which materializes, so the number is the honest host cost."""
+    which materializes, so the number is the honest host cost.  The
+    same interval feeds the step-phase tracer as a "collective" span —
+    the raw material of the compute↔collective overlap fraction."""
     def deco(fn):
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
             from ..observability import get_telemetry
             tel = get_telemetry()
-            if not tel.enabled:
+            from ..observability.trace import get_tracer
+            tr = get_tracer()
+            if not (tel.enabled or tr.enabled):
                 return fn(*args, **kwargs)
             try:
                 tracing = not jax.core.trace_state_clean()
@@ -248,11 +252,14 @@ def _timed(op):
                 tracing = True  # unknown trace state: don't time
             if tracing:
                 return fn(*args, **kwargs)
-            t0 = time.perf_counter()
+            t0 = time.perf_counter_ns()
             try:
                 return fn(*args, **kwargs)
             finally:
-                tel.collective_time(op, time.perf_counter() - t0)
+                t1 = time.perf_counter_ns()
+                tel.collective_time(op, (t1 - t0) / 1e9)
+                if tr.enabled:
+                    tr.phase_record("collective", t0, t1)
         return wrapper
     return deco
 

@@ -60,10 +60,20 @@ cross-rank recompile-storm alarm, stale-marking of a SIGKILLed rank
 (bounded — never a hang), aggregator restart reconvergence, and the
 ``observability.merge`` CLI stitching per-rank telemetry JSONL into
 one time-ordered stream.
+
+Trace drills (:func:`.runner.run_trace_drill`) exercise the step
+tracer: every worker records a deterministic staggered
+compute/collective step profile, exports a per-rank Chrome trace and
+a flight dump, and the ``observability.merge --trace`` CLI stitches
+the per-rank files into ONE schema-valid cluster timeline (rank as
+pid) with a strictly positive measured overlap fraction.  Fault
+drills run with ``flight_dir`` set additionally prove the SIGKILLed
+victim left a parseable flight-recorder dump behind.
 """
-__all__ = ["KillSpec", "StoreKillSpec", "ObsSpec", "run_drill",
-           "run_store_kill_drill", "run_scrape_drill", "spawn_worker",
-           "spawn_store_master", "spawn_aggregator", "reap_all"]
+__all__ = ["KillSpec", "StoreKillSpec", "ObsSpec", "TraceSpec",
+           "run_drill", "run_store_kill_drill", "run_scrape_drill",
+           "run_trace_drill", "spawn_worker", "spawn_store_master",
+           "spawn_aggregator", "reap_all"]
 
 
 def __getattr__(name):

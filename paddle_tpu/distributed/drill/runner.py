@@ -30,12 +30,13 @@ from ..checkpoint import read_leaf, verify_checkpoint
 from ..checkpoint_manager import CheckpointManager
 from ..resilient_store import ResilientStore, read_endpoint_file
 from .worker import (EXIT_SAVE_FAILED, EXIT_STORE_LOST, advance,
-                     init_state, obs_ready_key, obs_release_key)
+                     init_state, obs_ready_key, obs_release_key,
+                     trace_report_path)
 
-__all__ = ["KillSpec", "StoreKillSpec", "ObsSpec", "DrillFailure",
-           "spawn_worker", "spawn_store_master", "spawn_aggregator",
-           "run_drill", "run_store_kill_drill", "run_scrape_drill",
-           "reap_all"]
+__all__ = ["KillSpec", "StoreKillSpec", "ObsSpec", "TraceSpec",
+           "DrillFailure", "spawn_worker", "spawn_store_master",
+           "spawn_aggregator", "run_drill", "run_store_kill_drill",
+           "run_scrape_drill", "run_trace_drill", "reap_all"]
 
 logger = logging.getLogger(__name__)
 
@@ -90,6 +91,22 @@ class ObsSpec:
         self.hold_timeout = float(hold_timeout)
 
 
+class TraceSpec:
+    """Scripted step-tracing worker (``DRILL_TRACE=1``): enable the
+    real tracer, record a deterministic staggered compute/collective
+    step profile (synthetic timestamps, no sleeping), export a
+    per-rank Chrome trace into ``trace_dir`` and — when ``flight_dir``
+    is set — a flight dump, then write a report JSON with the tracer
+    snapshot."""
+
+    __slots__ = ("trace_dir", "flight_dir", "step_ms")
+
+    def __init__(self, trace_dir, flight_dir=None, step_ms=10.0):
+        self.trace_dir = trace_dir
+        self.flight_dir = flight_dir
+        self.step_ms = float(step_ms)
+
+
 class StoreKillSpec:
     """Scripted STORE-MASTER kill: every rank rendezvouses at ``phase``
     of step ``step``'s save (``pre-save`` | ``mid-barrier``), and the
@@ -125,7 +142,8 @@ def reap_all():
 def spawn_worker(rank, world, *, root, port=0, total_steps, run_id,
                  barrier_timeout, kill=None, elastic=True,
                  orphan_age=None, log_path=None, endpoint_file=None,
-                 store_deadline=None, storekill=None, obs=None):
+                 store_deadline=None, storekill=None, obs=None,
+                 trace=None, flight_dir=None):
     """Launch one drill worker subprocess; returns its Popen (also
     registered for :func:`reap_all`).
 
@@ -135,7 +153,9 @@ def spawn_worker(rank, world, *, root, port=0, total_steps, run_id,
     master-kill rendezvous in every rank; ``obs`` (an
     :class:`ObsSpec`) switches the worker to the cluster-observability
     mode (requires ``endpoint_file``; ``total_steps`` becomes the
-    synthetic step count).
+    synthetic step count); ``trace`` (a :class:`TraceSpec`) switches
+    to the storeless step-tracing mode; ``flight_dir`` arms the flight
+    recorder in a checkpoint-mode worker (``PT_FLIGHT_RECORDER``).
     """
     env = {k: v for k, v in os.environ.items()
            if not k.startswith("DRILL_")}
@@ -177,6 +197,14 @@ def spawn_worker(rank, world, *, root, port=0, total_steps, run_id,
         env["DRILL_OBS_STORM"] = "1" if obs.storm else "0"
         env["DRILL_OBS_TIMEOUT"] = str(obs.hold_timeout)
         env["PT_RECOMPILE_THRESHOLD"] = str(obs.sentinel_threshold)
+    if trace is not None:
+        env["DRILL_TRACE"] = "1"
+        env["DRILL_TRACE_DIR"] = trace.trace_dir
+        env["DRILL_TRACE_STEP_MS"] = str(trace.step_ms)
+        if trace.flight_dir:
+            env["PT_FLIGHT_RECORDER"] = trace.flight_dir
+    if flight_dir is not None:
+        env["PT_FLIGHT_RECORDER"] = flight_dir
     cmd = [sys.executable, "-m", "paddle_tpu.distributed.drill.worker"]
     if log_path:
         with open(log_path, "ab") as out:
@@ -351,7 +379,8 @@ def _verify_bit_for_bit(root, step):
 
 
 def run_drill(root, generations, total_steps, *, barrier_timeout=6.0,
-              gen_timeout=120.0, orphan_age=None, log_dir=None):
+              gen_timeout=120.0, orphan_age=None, log_dir=None,
+              flight_dir=None):
     """Run a multi-generation fault drill.
 
     ``generations``: list of ``(world_size, KillSpec-or-None)``.  Each
@@ -365,8 +394,13 @@ def run_drill(root, generations, total_steps, *, barrier_timeout=6.0,
     every rank exiting 0, resuming elastically when its world size
     differs from the writer's.
 
+    ``flight_dir`` arms the flight recorder in every worker: a killed
+    generation then additionally asserts the SIGKILLed victim left a
+    parseable ``flight-<run_id>-<rank>.json`` behind — the recorder's
+    no-handlers-run acceptance (arm-time dump + watchdog refresh).
+
     Returns a per-generation report (worlds, return codes, newest
-    committed step) for further assertions.
+    committed step, run_id) for further assertions.
     """
     master = TCPStore("127.0.0.1", 0, is_master=True)
     report = []
@@ -378,14 +412,16 @@ def run_drill(root, generations, total_steps, *, barrier_timeout=6.0,
                     r, world, root=root, port=master.port,
                     total_steps=total_steps, run_id=run_id,
                     barrier_timeout=barrier_timeout, kill=kill,
-                    orphan_age=orphan_age,
+                    orphan_age=orphan_age, flight_dir=flight_dir,
                     log_path=(os.path.join(log_dir, f"gen{g}_rank{r}.log")
                               if log_dir else None))
                 for r in range(world)
             ]
             rcs = _wait_fleet(procs, gen_timeout)
             latest = _latest_step(root)
-            report.append({"world": world, "rcs": rcs, "latest": latest})
+            gen_report = {"world": world, "rcs": rcs, "latest": latest,
+                          "run_id": run_id}
+            report.append(gen_report)
             if kill is None:
                 if any(rc != 0 for rc in rcs):
                     raise DrillFailure(
@@ -411,6 +447,28 @@ def run_drill(root, generations, total_steps, *, barrier_timeout=6.0,
                         f"generation {g}: newest committed step is "
                         f"{latest} after a {kill.phase} kill at step "
                         f"{kill.step}, expected {want}")
+                if flight_dir is not None:
+                    # SIGKILL runs no handlers: the dump on disk is the
+                    # arm-time/watchdog one, and it must be whole
+                    fpath = os.path.join(
+                        flight_dir,
+                        f"flight-{run_id}-{kill.rank}.json")
+                    try:
+                        with open(fpath, "r", encoding="utf-8") as f:
+                            flight = json.load(f)
+                    except (OSError, ValueError) as e:
+                        raise DrillFailure(
+                            f"generation {g}: SIGKILLed rank "
+                            f"{kill.rank} left no parseable flight "
+                            f"dump at {fpath}: {e}") from e
+                    if flight.get("process_index") != kill.rank or \
+                            flight.get("run_id") != run_id:
+                        raise DrillFailure(
+                            f"generation {g}: flight dump identity "
+                            f"{flight.get('run_id')!r}/"
+                            f"{flight.get('process_index')!r} does not "
+                            f"match victim {run_id!r}/{kill.rank}")
+                    gen_report["flight"] = fpath
             if latest is not None:
                 _verify_bit_for_bit(root, latest)
     finally:
@@ -861,5 +919,140 @@ def run_scrape_drill(root, *, world=3, steps=12, step_base=0.01,
     finally:
         if watch is not None:
             watch.close()
+        reap_all()
+    return report
+
+
+def run_trace_drill(root, *, world=2, steps=6, step_ms=10.0,
+                    gen_timeout=60.0, log_dir=None):
+    """Multi-process step-tracing drill: ``world`` REAL worker
+    processes each enable the tracer, record a deterministic staggered
+    compute/collective step profile, and export per-rank Chrome traces
+    plus flight dumps; the runner then stitches the traces with the
+    REAL merge CLI (``python -m paddle_tpu.observability.merge
+    --trace``) and asserts ONE schema-valid cluster timeline — every
+    rank present as a pid with its process_name metadata, "X" events
+    complete and time-ordered — and that each rank's measured
+    compute↔collective overlap fraction is strictly positive (the
+    scripted stagger makes the analytic value 0.6).  Storeless: no
+    TCPStore master, no checkpoints.  Returns a report dict."""
+    trace_dir = os.path.join(root, "traces")
+    flight_dir = os.path.join(root, "flight")
+    os.makedirs(trace_dir, exist_ok=True)
+    run_id = f"trace-{uuid.uuid4().hex[:6]}"
+    spec = TraceSpec(trace_dir=trace_dir, flight_dir=flight_dir,
+                     step_ms=step_ms)
+    report = {"run_id": run_id, "world": world, "steps": steps}
+    try:
+        procs = [
+            spawn_worker(
+                r, world, root=root, total_steps=steps, run_id=run_id,
+                barrier_timeout=gen_timeout, trace=spec,
+                log_path=(os.path.join(log_dir, f"trace_rank{r}.log")
+                          if log_dir else None))
+            for r in range(world)
+        ]
+        rcs = _wait_fleet(procs, gen_timeout)
+        report["rcs"] = rcs
+        if any(rc != 0 for rc in rcs):
+            raise DrillFailure(f"trace drill exit codes {rcs}, "
+                               f"expected all 0")
+
+        # --- per-rank artifacts: report, chrome export, flight dump --
+        overlaps = []
+        for r in range(world):
+            rep_path = trace_report_path(trace_dir, r)
+            try:
+                with open(rep_path, "r", encoding="utf-8") as f:
+                    snap = json.load(f)
+            except (OSError, ValueError) as e:
+                raise DrillFailure(
+                    f"rank {r} wrote no parseable trace report at "
+                    f"{rep_path}: {e}") from e
+            ov = snap.get("overlap_fraction")
+            if not ov or ov <= 0.0:
+                raise DrillFailure(
+                    f"rank {r} measured overlap fraction {ov!r}; the "
+                    f"staggered collectives must yield > 0")
+            overlaps.append(ov)
+            if not snap.get("phase_ms"):
+                raise DrillFailure(
+                    f"rank {r} report has no phase percentiles")
+            tpath = os.path.join(trace_dir,
+                                 f"trace-{run_id}-{r}.json")
+            if not os.path.exists(tpath):
+                raise DrillFailure(
+                    f"rank {r} Chrome export missing at {tpath}")
+            fpath = os.path.join(flight_dir,
+                                 f"flight-{run_id}-{r}.json")
+            try:
+                with open(fpath, "r", encoding="utf-8") as f:
+                    flight = json.load(f)
+            except (OSError, ValueError) as e:
+                raise DrillFailure(
+                    f"rank {r} flight dump unreadable at {fpath}: "
+                    f"{e}") from e
+            if flight.get("process_index") != r or not flight.get("spans"):
+                raise DrillFailure(
+                    f"rank {r} flight dump carries identity "
+                    f"{flight.get('process_index')!r} and "
+                    f"{len(flight.get('spans') or [])} spans")
+        report["overlaps"] = overlaps
+
+        # --- merge CLI: one schema-valid cluster timeline ------------
+        merged_path = os.path.join(root, "merged_trace.json")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH",
+                                                         "")
+        cli = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.observability.merge",
+             "--trace", trace_dir, "--output", merged_path],
+            env=env, capture_output=True, text=True, timeout=60)
+        if cli.returncode != 0:
+            raise DrillFailure(
+                f"merge --trace CLI exited {cli.returncode}: "
+                f"{cli.stderr}")
+        with open(merged_path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+        evs = doc.get("traceEvents") if isinstance(doc, dict) else None
+        if not isinstance(evs, list) or not evs:
+            raise DrillFailure(
+                f"merged trace is not a Chrome trace document: "
+                f"{type(evs).__name__}")
+        pids, meta_ranks, last_ts, x_events = set(), set(), None, 0
+        for ev in evs:
+            if not isinstance(ev, dict) or "name" not in ev \
+                    or "ph" not in ev or "pid" not in ev:
+                raise DrillFailure(f"malformed trace event: {ev!r}")
+            pids.add(ev["pid"])
+            if ev["ph"] == "M" and ev["name"] == "process_name":
+                meta_ranks.add(ev["pid"])
+            elif ev["ph"] == "X":
+                x_events += 1
+                if not {"ts", "dur", "cat"} <= ev.keys():
+                    raise DrillFailure(
+                        f"incomplete X event: {ev!r}")
+                if last_ts is not None and ev["ts"] < last_ts:
+                    raise DrillFailure(
+                        f"merged trace is not time-ordered: "
+                        f"{ev['ts']} after {last_ts}")
+                last_ts = ev["ts"]
+        if pids != set(range(world)):
+            raise DrillFailure(
+                f"merged trace pids {sorted(pids)}, expected ranks "
+                f"0..{world - 1}")
+        if meta_ranks != set(range(world)):
+            raise DrillFailure(
+                f"process_name metadata for ranks "
+                f"{sorted(meta_ranks)}, expected all {world}")
+        # 4 phase spans per step per rank land in the merged doc
+        if x_events != world * steps * 4:
+            raise DrillFailure(
+                f"merged trace holds {x_events} X events from "
+                f"{world} ranks x {steps} steps x 4 phases")
+        report.update({"merged_events": x_events,
+                       "merged_path": merged_path})
+    finally:
         reap_all()
     return report

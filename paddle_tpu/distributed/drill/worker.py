@@ -31,6 +31,18 @@ Run as ``python -m paddle_tpu.distributed.drill.worker`` with the
    ``storekill/<run_id>/go``; the runner kills the master only after
    all ranks are provably in-flight, and sets ``go`` through the
    respawned one.
+ - ``DRILL_TRACE=1``: step-tracing mode (:func:`_trace_main`) — no
+   store, no checkpoints.  The worker enables the real step tracer,
+   records a deterministic staggered compute/collective step profile
+   (synthetic timestamps, no sleeping), exports its per-rank Chrome
+   trace into ``DRILL_TRACE_DIR`` (virtual step length
+   ``DRILL_TRACE_STEP_MS``), dumps a final flight record when
+   ``PT_FLIGHT_RECORDER`` is set, and writes a report JSON with the
+   tracer snapshot (overlap fraction, phase percentiles).
+ - ``PT_FLIGHT_RECORDER`` (checkpoint mode): arms the flight recorder
+   — the worker records real ``backward``/``checkpoint`` phase spans
+   around its update/save so a SIGKILLed victim leaves a flight dump
+   behind (written at arm time, refreshed by the span watchdog).
  - ``DRILL_OBS=1``: cluster-observability mode (:func:`_obs_main`) —
    no checkpoints at all.  The worker enables real telemetry with an
    ephemeral ``/metrics`` endpoint + JSONL sink
@@ -61,9 +73,11 @@ clean degradation the failover drills assert); SIGKILL death reports
 """
 from __future__ import annotations
 
+import json
 import logging
 import os
 import sys
+import time
 
 import numpy as np
 
@@ -146,6 +160,57 @@ def _obs_main(env, rank, world, total, run_id):
     sys.exit(0)
 
 
+def trace_report_path(trace_dir, rank):
+    """Per-rank trace-drill report (tracer snapshot JSON)."""
+    return os.path.join(trace_dir, f"trace_report-{rank}.json")
+
+
+def _trace_main(env, rank, world, total, run_id):
+    """Step-tracing drill mode (``DRILL_TRACE=1``): storeless.
+
+    Timestamps are synthetic offsets from one ``perf_counter`` origin —
+    no sleeping — with a fixed stagger per virtual step: ``data_wait``
+    covers [0, 0.1), the fused fwd+bwd ``backward`` span [0.1, 0.7),
+    the ``collective`` [0.4, 0.9) and the ``optimizer`` [0.9, 1.0) of
+    the step, so the compute∩collective overlap is exactly 0.3/0.5 =
+    0.6 of collective time on every rank — the runner asserts the
+    measured fraction is strictly positive.
+    """
+    from ...observability.trace import get_tracer
+
+    trace_dir = env["DRILL_TRACE_DIR"]
+    tr = get_tracer().enable(
+        trace_dir=trace_dir,
+        flight_dir=env.get("PT_FLIGHT_RECORDER") or None,
+        process_index=rank, run_id=run_id)
+    step_ns = int(float(env.get("DRILL_TRACE_STEP_MS", "10")) * 1e6)
+    base = time.perf_counter_ns()
+    for s in range(total):
+        t0 = base + s * step_ns
+        tr.phase_record("data_wait", t0, t0 + step_ns // 10)
+        c0 = t0 + step_ns // 10
+        tr.phase_record("backward", c0, c0 + (step_ns * 6) // 10)
+        tr.phase_record("collective", c0 + (step_ns * 3) // 10,
+                        c0 + (step_ns * 8) // 10)
+        tr.phase_record("optimizer", c0 + (step_ns * 8) // 10,
+                        t0 + step_ns)
+        tr.on_step(step_ns / 1e9)
+    out = tr.export_chrome()
+    if out is None:
+        logger.error("trace drill: chrome export failed")
+        sys.exit(1)
+    tr.flight_dump(reason="drill-exit")
+    snap = tr.snapshot()
+    report = trace_report_path(trace_dir, rank)
+    tmp = f"{report}.tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(snap, f)
+    os.replace(tmp, report)
+    logger.info("trace drill: exported %s (overlap=%s)", out,
+                snap["overlap_fraction"])
+    sys.exit(0)
+
+
 def _arm_storekill(store, rank, run_id, step, phase, timeout):
     """Wire the master-kill rendezvous: returns ``(phase, rendezvous)``.
 
@@ -207,6 +272,9 @@ def main():
         level=logging.INFO, stream=sys.stderr,
         format=f"[drill rank {rank}] %(levelname)s %(message)s")
 
+    if env.get("DRILL_TRACE") == "1":
+        _trace_main(env, rank, world, total, run_id)
+        return  # unreachable (_trace_main exits), defensive only
     if env.get("DRILL_OBS") == "1":
         _obs_main(env, rank, world, total, run_id)
         return  # unreachable (_obs_main exits), defensive only
@@ -218,6 +286,16 @@ def main():
         logger.info("armed kill: phase=%s step=%s",
                     env.get("DRILL_KILL_PHASE"),
                     env.get("DRILL_KILL_STEP"))
+
+    # flight recorder: arm BEFORE the loop so the arm-time dump exists
+    # no matter when the scripted SIGKILL lands (get_tracer() reads
+    # PT_TRACE / PT_FLIGHT_RECORDER from the env the runner set)
+    tracer = None
+    if env.get("PT_FLIGHT_RECORDER") or env.get("PT_TRACE"):
+        from ...observability.trace import get_tracer
+        t = get_tracer()
+        if t.enabled:
+            tracer = t
 
     from ...core import TCPStore
     from ..checkpoint import HostLocalShard, read_leaf
@@ -269,8 +347,11 @@ def main():
         logger.info("resumed from committed step %d", start)
 
     for step in range(start + 1, total + 1):
+        t0 = time.perf_counter_ns()
         w = w * np.float32(1.01) + np.float32(0.125)
         bias = bias * np.float32(0.99) - np.float32(0.0625)
+        if tracer is not None:
+            tracer.phase_record("backward", t0, time.perf_counter_ns())
         state = {
             "w": HostLocalShard(w, window=[[lo, hi], [0, COLS]],
                                 global_shape=(ROWS, COLS)),
@@ -279,7 +360,11 @@ def main():
         try:
             if sk_phase == "pre-save" and step == sk_step:
                 storekill_rendezvous()
-            mgr.save(step, state)
+            if tracer is not None:
+                with tracer.phase("checkpoint"):
+                    mgr.save(step, state)
+            else:
+                mgr.save(step, state)
         except StoreUnavailableError as e:
             # the master stayed dead past the client deadline, or a
             # respawn was generation-fenced as amnesiac — clean

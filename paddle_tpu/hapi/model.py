@@ -19,6 +19,7 @@ from ..nn.layer.layers import Layer
 from ..metric import Metric
 from ..framework import random as _random
 from ..observability import get_telemetry
+from ..observability.trace import get_tracer
 from .. import autograd
 from .callbacks import config_callbacks
 
@@ -166,7 +167,7 @@ class Model:
         if isinstance(fwd, StaticFunction):
             fwd = fwd._orig_fn
 
-        def train_step(params, buffers, opt_state, key, inputs, labels):
+        def grad_step(params, buffers, key, inputs, labels):
             def loss_of(p):
                 with _random.trace_key_scope(key):
                     outs, new_buffers = functional_call(
@@ -183,8 +184,15 @@ class Model:
 
             (loss_v, (preds, new_buffers)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(params)
-            new_params, new_opt_state = opt.apply_gradients_tree(
-                params, grads, opt_state)
+            return loss_v, preds, new_buffers, grads
+
+        def apply_step(params, grads, opt_state):
+            return opt.apply_gradients_tree(params, grads, opt_state)
+
+        def train_step(params, buffers, opt_state, key, inputs, labels):
+            loss_v, preds, new_buffers, grads = grad_step(
+                params, buffers, key, inputs, labels)
+            new_params, new_opt_state = apply_step(params, grads, opt_state)
             return loss_v, preds, new_params, new_buffers, new_opt_state
 
         def eval_step(params, buffers, inputs, labels):
@@ -203,7 +211,13 @@ class Model:
                 loss_v = loss._data
             return loss_v, [o._data for o in outs]
 
+        # One fused program per step is the perf contract; the split
+        # grad/apply pair exists ONLY for the step-phase tracer, which
+        # needs a host boundary between backward and optimizer to time.
+        # jax.jit is lazy, so the untaken pair never compiles.
         self._train_step_jit = jax.jit(train_step) if opt is not None else None
+        self._grad_step_jit = jax.jit(grad_step) if opt is not None else None
+        self._apply_step_jit = jax.jit(apply_step) if opt is not None else None
         self._eval_step_jit = jax.jit(eval_step)
 
     def _param_arrays(self):
@@ -228,9 +242,24 @@ class Model:
             if self._opt_state is None:
                 self._opt_state = self._optimizer.init_state_tree(params)
             key = _random.next_key()
-            loss_v, preds, new_params, new_buffers, new_opt = \
-                self._train_step_jit(params, buffers, self._opt_state, key,
-                                     _arrays(inputs), _arrays(labels))
+            tr = get_tracer()
+            if tr.enabled:
+                # split path: "backward" is the fused forward+backward
+                # value_and_grad program (no pure-forward phase exists in
+                # a train step), "optimizer" the parameter update. Spans
+                # time dispatch — never a forced device sync.
+                with tr.phase("backward"):
+                    loss_v, preds, new_buffers, grads = self._grad_step_jit(
+                        params, buffers, key,
+                        _arrays(inputs), _arrays(labels))
+                with tr.phase("optimizer"):
+                    new_params, new_opt = self._apply_step_jit(
+                        params, grads, self._opt_state)
+            else:
+                loss_v, preds, new_params, new_buffers, new_opt = \
+                    self._train_step_jit(params, buffers, self._opt_state,
+                                         key, _arrays(inputs),
+                                         _arrays(labels))
             if update:
                 self._write_back(new_params, new_buffers)
                 self._opt_state = new_opt
@@ -247,9 +276,10 @@ class Model:
 
     def eval_batch(self, inputs, labels=None):
         with autograd.functional_guard():
-            loss_v, preds = self._eval_step_jit(
-                self._param_arrays(), self._buffer_arrays(),
-                _arrays(inputs), _arrays(labels))
+            with get_tracer().phase("forward"):
+                loss_v, preds = self._eval_step_jit(
+                    self._param_arrays(), self._buffer_arrays(),
+                    _arrays(inputs), _arrays(labels))
         metrics_out = []
         for m in self._metrics:
             corr = m.compute(Tensor(preds[0]), Tensor(_arrays(labels)[0]))
@@ -259,9 +289,10 @@ class Model:
 
     def predict_batch(self, inputs):
         with autograd.functional_guard():
-            _, preds = self._eval_step_jit(
-                self._param_arrays(), self._buffer_arrays(),
-                _arrays(inputs), [])
+            with get_tracer().phase("forward"):
+                _, preds = self._eval_step_jit(
+                    self._param_arrays(), self._buffer_arrays(),
+                    _arrays(inputs), [])
         return [Tensor(p) for p in preds]
 
     # -- loops ---------------------------------------------------------------

@@ -232,19 +232,24 @@ def _get_checked(data_queue, workers, timeout):
                     f"batch")
 
 
-def _timed_iter(it, tel):
+def _timed_iter(it, tel, tr):
     """Wrap a batch iterator, reporting how long the consumer waited on
-    each ``next()`` (input-pipeline stall time) to telemetry. Only
-    installed while telemetry is enabled — the disabled path hands the
-    raw iterator through."""
+    each ``next()`` (input-pipeline stall time) to telemetry and, as a
+    ``data_wait`` phase span, to the step tracer. Only installed while
+    one of the two is enabled — the disabled path hands the raw
+    iterator through."""
     import time as _time
     while True:
-        t0 = _time.perf_counter()
+        t0 = _time.perf_counter_ns()
         try:
             batch = next(it)
         except StopIteration:
             return
-        tel.data_wait(_time.perf_counter() - t0)
+        t1 = _time.perf_counter_ns()
+        if tel.enabled:
+            tel.data_wait((t1 - t0) / 1e9)
+        if tr.enabled:
+            tr.phase_record("data_wait", t0, t1)
         yield batch
 
 
@@ -297,10 +302,12 @@ class DataLoader:
         else:
             it = self._iter_multiprocess()
         from ..observability import get_telemetry
+        from ..observability.trace import get_tracer
         tel = get_telemetry()
-        if not tel.enabled:
+        tr = get_tracer()
+        if not (tel.enabled or tr.enabled):
             return it
-        return _timed_iter(it, tel)
+        return _timed_iter(it, tel, tr)
 
     # -- single process with thread prefetch --------------------------------
     def _iter_single(self):

@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import functools
 import os
+import time
 import warnings
 
 import numpy as np
@@ -126,6 +127,11 @@ def _tel():
     return get_telemetry()
 
 
+def _tracer():
+    from ..observability.trace import get_tracer
+    return get_tracer()
+
+
 class _LiveState:
     """Capture-private mutable state shared by all signature entries of
     one CapturedStep: the donated param/buffer/opt-state arrays plus the
@@ -138,7 +144,7 @@ class _LiveState:
 
 class _Entry:
     __slots__ = ("jitted", "struct", "traced_idx", "sg_flags", "statics",
-                 "n_leaves", "sig", "name", "ran")
+                 "n_leaves", "sig", "name", "ran", "flops")
 
 
 class CapturedStep:
@@ -374,6 +380,7 @@ class CapturedStep:
         entry.sig = sig
         entry.name = pure.__name__
         entry.ran = False
+        entry.flops = None
         return entry
 
     # -- replay -------------------------------------------------------------
@@ -388,12 +395,26 @@ class CapturedStep:
         # lr-schedule change never retraces (train_step.py pattern)
         lrs = [float(opt.get_lr()) for opt in st.opts]
         call = entry.jitted
+        tr = _tracer()
         if not entry.ran:
+            if tr.enabled and entry.flops is None:
+                # analytic MFU source: cost_analysis() at compile time,
+                # while the donated input arrays are still live. The AOT
+                # lower+compile is redundant with the call below but its
+                # XLA compile is cache-shared, and it only happens once
+                # per signature — the replay hot path never pays it.
+                from ..observability.trace import program_flops
+                entry.flops = program_flops(
+                    call, st.params, st.buffers, st.opt_states, st.rng_ctr,
+                    lrs, traced)
+                if entry.flops:
+                    tr.record_program_flops(entry.name, entry.flops)
             with warnings.catch_warnings():
                 # backends without donation (cpu) warn once at compile;
                 # the annotation is still correct where it counts
                 warnings.filterwarnings(
                     "ignore", message="Some donated buffers were not usable")
+                t0 = time.perf_counter_ns()
                 outs = call(st.params, st.buffers, st.opt_states, st.rng_ctr,
                             lrs, traced)
             entry.ran = True  # only after the trace actually succeeded
@@ -405,8 +426,13 @@ class CapturedStep:
                 # filter records this compile; both would double-count)
                 tel.record_compile(entry.name, f"sig={entry.sig}")
         else:
+            t0 = time.perf_counter_ns()
             outs = call(st.params, st.buffers, st.opt_states, st.rng_ctr,
                         lrs, traced)
+        if tr.enabled:
+            # dispatch-side span: async under jax, so this is dispatch +
+            # any implicit materialization, never a forced device sync
+            tr.record_span(entry.name, "compute", t0, time.perf_counter_ns())
         st.rng_ctr += 1
         out_arrays, st.params, st.buffers, st.opt_states = outs
         for name, t in st.param_tensors.items():

@@ -18,6 +18,10 @@ and did something silently recompile?"* at runtime:
                       cross-rank recompile-storm alarm, re-serve
  - :mod:`.merge`      CLI stitching per-process telemetry JSONL
                       streams into one time-ordered rank-labeled one
+                      (``--trace`` stitches per-rank Chrome traces)
+ - :mod:`.trace`      ``Tracer``: step-phase span ring buffer, Chrome
+                      trace export, analytic MFU, and the crash
+                      flight recorder
  - :mod:`.logs`       the library logger that bare ``print`` is banned
                       in favor of (lint rule TPU010)
 
@@ -51,11 +55,21 @@ _AGGREGATOR_EXPORTS = ("ClusterAggregator", "MergeConflict",
                        "parse_prometheus_text", "merge_scrapes",
                        "render_exposition", "cluster_snapshot")
 
+# Trace exports resolve lazily for the same runpy-shadowing reason —
+# and because get_tracer() consults PT_TRACE/PT_FLIGHT_RECORDER, which
+# plain `import paddle_tpu.observability` must never do.
+_TRACE_EXPORTS = ("Tracer", "Span", "PHASES", "PEAK_FLOPS",
+                  "peak_flops", "program_flops", "get_tracer",
+                  "current_tracer", "reset_tracer")
+
 
 def __getattr__(name):
     if name in _AGGREGATOR_EXPORTS:
         from . import aggregator
         return getattr(aggregator, name)
+    if name in _TRACE_EXPORTS:
+        from . import trace
+        return getattr(trace, name)
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}")
 
@@ -69,4 +83,6 @@ __all__ = [
     "MetricsServer", "start_http_server",
     "ClusterAggregator", "MergeConflict", "parse_prometheus_text",
     "merge_scrapes", "render_exposition", "cluster_snapshot",
+    "Tracer", "Span", "PHASES", "PEAK_FLOPS", "peak_flops",
+    "program_flops", "get_tracer", "current_tracer", "reset_tracer",
 ]

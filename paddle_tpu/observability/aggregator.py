@@ -37,9 +37,18 @@ Import contract: stdlib-only at module level (no jax, no
 ``paddle_tpu.distributed``) so the aggregator process stays cheap to
 spawn; ``ResilientStore`` is imported lazily by the CLI.
 
+Long-horizon view: every render also appends a compact cluster point
+(ranks up, skew, straggler ratio, storm count) to a
+:class:`RetentionBuffer` — a time-bounded, memory-capped history whose
+resolution degrades gracefully with age (old points thin out, recent
+points stay dense), so a week-long run's aggregator never grows
+without bound.  Window set by ``PT_AGGREGATOR_RETENTION`` seconds
+(0 disables).
+
 Env (all read by :func:`main` as flag defaults): ``PT_AGGREGATOR_PORT``
 ``PT_AGGREGATOR_INTERVAL`` ``PT_AGGREGATOR_STALE_AFTER``
-``PT_AGGREGATOR_SCRAPE_TIMEOUT`` ``PT_AGGREGATOR_STORM_THRESHOLD``.
+``PT_AGGREGATOR_SCRAPE_TIMEOUT`` ``PT_AGGREGATOR_STORM_THRESHOLD``
+``PT_AGGREGATOR_RETENTION``.
 """
 from __future__ import annotations
 
@@ -58,8 +67,9 @@ from .metrics import _escape_help, _fmt, _labels_text
 
 __all__ = [
     "MergeConflict", "parse_prometheus_text", "merge_scrapes",
-    "render_exposition", "bucket_percentile", "ClusterAggregator",
-    "cluster_snapshot", "endpoint_key", "world_key", "main",
+    "render_exposition", "bucket_percentile", "RetentionBuffer",
+    "ClusterAggregator", "cluster_snapshot", "endpoint_key",
+    "world_key", "main",
 ]
 
 logger = get_logger(__name__)
@@ -407,6 +417,59 @@ def _median(xs):
     return s[mid] if n % 2 else (s[mid - 1] + s[mid]) / 2.0
 
 
+# -- long-horizon retention --------------------------------------------------
+
+
+class RetentionBuffer:
+    """Time-bounded, memory-capped history of (ts, point) samples.
+
+    Two limits compose: points older than ``retention`` seconds are
+    evicted, and the buffer never holds more than ``max_points``
+    regardless of the window.  Hitting the cap triggers a halving-style
+    downsample — every other point in the OLDER half is dropped — so a
+    scrape cadence far faster than the window degrades old-history
+    resolution instead of either evicting recent points or growing
+    unbounded.  All methods are cheap enough for the render path; the
+    caller serializes access (the aggregator renders under one thread).
+    """
+
+    def __init__(self, retention=3600.0, max_points=512):
+        self.retention = float(retention)
+        self.max_points = max(int(max_points), 8)
+        self._points: list = []  # [(ts, point), ...] ts-ascending
+        self.downsampled_total = 0
+
+    def append(self, ts, point):
+        self._points.append((float(ts), point))
+        cutoff = float(ts) - self.retention
+        i = 0
+        n = len(self._points)
+        while i < n and self._points[i][0] < cutoff:
+            i += 1
+        if i:
+            del self._points[:i]
+        if len(self._points) > self.max_points:
+            half = len(self._points) // 2
+            old, recent = self._points[:half], self._points[half:]
+            kept = old[::2]
+            self.downsampled_total += len(old) - len(kept)
+            self._points = kept + recent
+
+    def points(self):
+        return list(self._points)
+
+    def summary(self):
+        pts = self._points
+        return {
+            "retention_seconds": self.retention,
+            "max_points": self.max_points,
+            "points": len(pts),
+            "span_seconds": (round(pts[-1][0] - pts[0][0], 3)
+                             if len(pts) > 1 else 0.0),
+            "downsampled_total": self.downsampled_total,
+        }
+
+
 # -- the aggregator ----------------------------------------------------------
 
 
@@ -422,8 +485,11 @@ class ClusterAggregator:
 
     def __init__(self, *, endpoints=None, store=None, run_id="local",
                  stale_after=5.0, scrape_timeout=2.0, storm_threshold=1,
-                 interval=1.0, drop_labels=("process_index",)):
+                 interval=1.0, drop_labels=("process_index",),
+                 retention=3600.0, history_max_points=512):
         self.run_id = str(run_id)
+        self._history = (RetentionBuffer(retention, history_max_points)
+                         if retention and retention > 0 else None)
         self.stale_after = float(stale_after)
         self.scrape_timeout = float(scrape_timeout)
         self.storm_threshold = int(storm_threshold)
@@ -646,6 +712,16 @@ class ClusterAggregator:
             "merge_conflicts_total": self._conflicts_total,
             "scrape_errors_total": self._scrape_errors_total,
         }
+        if self._history is not None:
+            self._history.append(time.time(), {
+                "ranks_up": len(fresh),
+                "skew": {m: round(v, 6)
+                         for m, v in skew_by_mode.items()},
+                "straggler": {m: round(v, 4)
+                              for m, v in ratio_by_mode.items()},
+                "storms": storms_total,
+            })
+            health["history"] = self._history.summary()
         with self._lock:
             self._text = text
             self._health = health
@@ -659,6 +735,13 @@ class ClusterAggregator:
     def healthz(self):
         with self._lock:
             return dict(self._health)
+
+    def history(self):
+        """The retained (ts, point) cluster history (empty when
+        retention is disabled)."""
+        with self._lock:
+            return self._history.points() if self._history is not None \
+                else []
 
     def start(self):
         """Run the scrape loop on a daemon thread. Idempotent."""
@@ -794,6 +877,11 @@ def main(argv=None):
                                      "1")),
                     help="summed sentinel trips that flip /healthz to "
                          "503 (0 disables the alarm)")
+    ap.add_argument("--retention", type=float,
+                    default=float(_env("PT_AGGREGATOR_RETENTION",
+                                       "3600")),
+                    help="seconds of downsampled cluster history to "
+                         "retain, memory-capped (0 disables)")
     ap.add_argument("--store-deadline", type=float, default=5.0,
                     help="ResilientStore per-op retry budget")
     ap.add_argument("--once", action="store_true",
@@ -831,7 +919,8 @@ def main(argv=None):
         endpoints=endpoints, store=store, run_id=args.run_id,
         stale_after=args.stale_after,
         scrape_timeout=args.scrape_timeout,
-        storm_threshold=args.storm_threshold, interval=args.interval)
+        storm_threshold=args.storm_threshold, interval=args.interval,
+        retention=args.retention)
     if args.once:
         agg.scrape_once()
         sys.stdout.write(agg.prometheus_text())

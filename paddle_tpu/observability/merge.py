@@ -14,6 +14,14 @@ rather than inventing one.  Ordering is by timestamp with (input file,
 line number) as a stable tiebreaker, so equal-timestamp records never
 shuffle between runs.  Corrupt lines — the torn tail of a SIGKILLed
 rank — are skipped and counted on stderr, never fatal.
+
+``--trace`` switches to Chrome trace-event mode: inputs are the
+per-rank ``trace-<run_id>-<rank>.json`` files the step tracer exports
+(``Tracer.export_chrome``), and the output is ONE schema-valid Chrome
+trace document whose pid axis is the rank — every rank's timeline in
+one chrome://tracing / Perfetto view.  Per-rank files share a wall-
+clock epoch anchor, so cross-rank span alignment is real time, not
+per-process monotonic origins.
 """
 from __future__ import annotations
 
@@ -24,7 +32,8 @@ import re
 import sys
 from datetime import datetime
 
-__all__ = ["discover_files", "merge_records", "main"]
+__all__ = ["discover_files", "merge_records", "discover_trace_files",
+           "merge_traces", "main"]
 
 # telemetry-<run_id>-<rank>.jsonl[.1] — run_id may itself contain
 # dashes, so the rank is the LAST -<digits> group (greedy run match).
@@ -126,6 +135,75 @@ def merge_records(files):
     return [item[3] for item in keyed], skipped
 
 
+# trace-<run_id>-<rank>.json — same last--<digits> rank rule as the
+# JSONL form above.
+_TRACE_NAME = re.compile(r"^(?P<prefix>.+)-(?P<run>.+)-(?P<rank>\d+)\.json$")
+
+
+def _trace_identity(path):
+    name = os.path.basename(path)
+    m = _TRACE_NAME.match(name)
+    if m:
+        return m.group("run"), int(m.group("rank"))
+    return None, None
+
+
+def discover_trace_files(paths):
+    """Expand directories into their per-rank Chrome trace exports
+    (``trace-*.json``); explicit file paths pass through."""
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for name in sorted(os.listdir(p)):
+                if name.startswith("trace-") and name.endswith(".json"):
+                    out.append(os.path.join(p, name))
+        else:
+            out.append(p)
+    return out
+
+
+def merge_traces(files):
+    """Stitch per-rank Chrome trace docs into one cluster timeline.
+
+    The pid of every event becomes the rank — recovered from the
+    filename when possible, else taken from the event's own pid (the
+    tracer already stamps pid=process_index).  Returns ``(doc,
+    skipped)``; ``doc`` is a dict ready for ``json.dump``.
+    """
+    events = []
+    skipped = 0
+    seen_ranks = {}
+    for path in files:
+        _run, rank = _trace_identity(path)
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError) as e:
+            print(f"merge: cannot read trace {path}: {e}", file=sys.stderr)
+            skipped += 1
+            continue
+        evs = doc.get("traceEvents") if isinstance(doc, dict) else None
+        if not isinstance(evs, list):
+            skipped += 1
+            continue
+        for ev in evs:
+            if not isinstance(ev, dict):
+                skipped += 1
+                continue
+            pid = rank if rank is not None else ev.get("pid", 0)
+            if ev.get("ph") == "M":
+                # keep ONE process_name metadata event per rank
+                if ev.get("name") == "process_name" and \
+                        pid not in seen_ranks:
+                    seen_ranks[pid] = dict(ev, pid=pid)
+                continue
+            events.append(dict(ev, pid=pid))
+    events.sort(key=lambda ev: (ev.get("ts", 0), ev.get("pid", 0)))
+    meta = [seen_ranks[r] for r in sorted(seen_ranks)]
+    return {"traceEvents": meta + events,
+            "displayTimeUnit": "ms"}, skipped
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.observability.merge",
@@ -133,10 +211,32 @@ def main(argv=None):
                     "one time-ordered, rank-labeled stream.")
     ap.add_argument("paths", nargs="+",
                     help="JSONL files, or directories containing "
-                         "telemetry-*.jsonl[.1]")
+                         "telemetry-*.jsonl[.1] (with --trace: "
+                         "trace-*.json Chrome exports)")
     ap.add_argument("--output", "-o", default="-",
                     help="output file (default '-': stdout)")
+    ap.add_argument("--trace", action="store_true",
+                    help="stitch per-rank Chrome trace JSON exports "
+                         "into one cluster timeline (pid = rank)")
     args = ap.parse_args(argv)
+
+    if args.trace:
+        files = discover_trace_files(args.paths)
+        if not files:
+            ap.error("no trace-*.json files found under the given paths")
+        doc, skipped = merge_traces(files)
+        out = (sys.stdout if args.output == "-"
+               else open(args.output, "w", encoding="utf-8"))
+        try:
+            json.dump(doc, out)
+            out.write("\n")
+        finally:
+            if out is not sys.stdout:
+                out.close()
+        if skipped:
+            print(f"merge: skipped {skipped} unreadable "
+                  f"event(s)/file(s)", file=sys.stderr)
+        return 0
 
     files = discover_files(args.paths)
     if not files:

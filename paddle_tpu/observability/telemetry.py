@@ -370,6 +370,13 @@ class TrainingTelemetry:
             "pt_collective_bytes_total",
             "input bytes entering collectives (metadata-derived)",
             ("op",))
+        from .metrics import log_buckets
+        self._m_coll_bytes_hist = r.histogram(
+            "pt_collective_bytes",
+            "per-invocation input bytes of collectives "
+            "(metadata-derived distribution; the ROADMAP 'time + "
+            "bytes' pair with pt_collective_time_seconds)", ("op",),
+            buckets=log_buckets(1e2, 1e9, per_decade=1))
         self._m_coll_time = r.histogram(
             "pt_collective_time_seconds",
             "host-boundary wall time of eagerly dispatched collectives "
@@ -470,6 +477,14 @@ class TrainingTelemetry:
                            batch_size=batch_size,
                            throughput=(round(throughput, 2)
                                        if throughput else None))
+        # derived trace gauges (overlap fraction, analytic MFU) refresh
+        # per step; sys.modules-gated so a run that never imported the
+        # tracer pays nothing here
+        tr_mod = sys.modules.get("paddle_tpu.observability.trace")
+        if tr_mod is not None:
+            tr = tr_mod.current_tracer()
+            if tr is not None and tr.enabled:
+                tr.on_step(seconds)
 
     # -- data / collectives -------------------------------------------------
 
@@ -485,6 +500,7 @@ class TrainingTelemetry:
         self._m_coll_ops.inc(op=op)
         if nbytes:
             self._m_coll_bytes.inc(nbytes, op=op)
+            self._m_coll_bytes_hist.observe(nbytes, op=op)
 
     def collective_time(self, op, seconds):
         """Host wall time around ONE eager collective dispatch (the
@@ -768,6 +784,14 @@ class TrainingTelemetry:
                 "generation": store_gen,
                 "ok": store_ok,
             }
+        # flight-recorder path (if the tracer exists and has one armed)
+        # — read-only: healthz must never trigger env-based enablement
+        flight = None
+        tr_mod = sys.modules.get("paddle_tpu.observability.trace")
+        if tr_mod is not None:
+            tr = tr_mod.current_tracer()
+            if tr is not None:
+                flight = tr.flight_path
         return {
             "ok": lease_ok is not False and store_ok is not False,
             "pid": os.getpid(),
@@ -781,6 +805,7 @@ class TrainingTelemetry:
             "elastic": elastic,
             "store": store,
             "recompile_storms": len(self.sentinel.tripped()),
+            "flight_recorder": flight,
         }
 
 
@@ -831,5 +856,7 @@ def reset():
         t, _telemetry = _telemetry, None
     if t is not None:
         t.disable()
+    from .trace import reset_tracer
+    reset_tracer()  # its metric handles die with the registry below
     from .metrics import reset_registry
     reset_registry()

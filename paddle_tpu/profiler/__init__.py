@@ -81,6 +81,22 @@ def _default_schedule(step: int) -> ProfilerState:
     return ProfilerState.RECORD
 
 
+def _dump_chrome(path: str) -> None:
+    """Single-sink chrome export: when the observability tracer is
+    enabled, its span window IS the trace (every RecordEvent is
+    forwarded there, plus step-phase spans, stamped with rank/run_id);
+    otherwise fall back to the raw core tracer dump."""
+    try:
+        from ..observability.trace import get_tracer
+        tr = get_tracer()
+    except Exception:
+        tr = None
+    if tr is not None and tr.enabled:
+        if tr.export_chrome(path) is not None:
+            return
+    _core.tracer_dump(path)
+
+
 def export_chrome_tracing(dir_name: str, worker_name: str | None = None):
     """Ref ``profiler.py:215``: returns an on_trace_ready callback that dumps
     chrome://tracing JSON into ``dir_name``."""
@@ -89,7 +105,7 @@ def export_chrome_tracing(dir_name: str, worker_name: str | None = None):
         os.makedirs(dir_name, exist_ok=True)
         name = worker_name or f"host_{os.getpid()}"
         path = os.path.join(dir_name, f"{name}_step{prof.step_num}.json")
-        _core.tracer_dump(path)
+        _dump_chrome(path)
         prof._exported_paths.append(path)
 
     return handle
@@ -263,7 +279,7 @@ class Profiler:
         return view.table(sorted_by)
 
     def export(self, path: str, format: str = "json"):
-        _core.tracer_dump(path)
+        _dump_chrome(path)
 
 
 def load_profiler_result(filename: str):

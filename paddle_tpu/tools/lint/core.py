@@ -372,6 +372,12 @@ class Linter(ast.NodeVisitor):
         self._dispatch("on_except", node)
         self.generic_visit(node)
 
+    def visit_With(self, node):
+        self._dispatch("on_with", node)
+        self.generic_visit(node)
+
+    visit_AsyncWith = visit_With
+
 
 def _param_names(fn: ast.AST) -> set[str]:
     # *args/**kwargs are python containers — truthiness on them is

@@ -7,9 +7,9 @@ against — retrace storms, host round-trips in step loops, tracer leaks —
 plus the classic ones the JAX docs warn about.
 
 Rules are small classes with event hooks (``on_call``, ``on_if``,
-``on_assign``, ``on_except``, ``on_while``, ``on_for``); the
-:class:`~.core.Linter` owns all traversal and scope state.  Register new
-rules with :func:`register`.
+``on_assign``, ``on_except``, ``on_while``, ``on_for``, ``on_with``);
+the :class:`~.core.Linter` owns all traversal and scope state.  Register
+new rules with :func:`register`.
 """
 from __future__ import annotations
 
@@ -656,3 +656,76 @@ class RawPallasCall(Rule):
                        "the kernel into paddle_tpu/ops/ and route "
                        "callers through the dispatch layer (flag + "
                        "fallback canary + autotuner)")
+
+
+@register
+class HostSyncInSpan(Rule):
+    id = "TPU013"
+    name = "host-sync-inside-open-trace-span"
+    rationale = ("`.item()`/np.asarray/block_until_ready inside an open "
+                 "RecordEvent / tracer phase span blocks the host while "
+                 "the span clock runs — the span then measures the "
+                 "device drain, not the work it names, poisoning phase "
+                 "histograms and the overlap fraction; sync after the "
+                 "span closes (spans must time dispatch, not transfers)")
+
+    # `with RecordEvent("name"):` in any spelling, and the step
+    # tracer's context managers: `with tr.phase("backward"):` /
+    # `with tracer.span(...)`
+    _SPAN_FUNCS = {"RecordEvent"}
+    _SPAN_ATTRS = {"phase", "span"}
+    _SYNC_METHODS = {"item", "numpy", "tolist", "__array__",
+                     "block_until_ready"}
+    _SYNC_FUNCS = {"np.asarray", "np.array", "numpy.asarray",
+                   "numpy.array", "jax.device_get", "device_get",
+                   "jax.block_until_ready", "block_until_ready"}
+
+    def _opens_span(self, node):
+        for item in node.items:
+            ce = item.context_expr
+            if not isinstance(ce, ast.Call):
+                continue
+            name = dotted(ce.func)
+            if name in self._SPAN_FUNCS \
+                    or name.rpartition(".")[2] in self._SPAN_FUNCS:
+                return name or "RecordEvent"
+            # attribute form survives non-name receivers
+            # (get_tracer().phase(...)) that dotted() can't render
+            if isinstance(ce.func, ast.Attribute) \
+                    and ce.func.attr in self._SPAN_ATTRS:
+                return name or f"<tracer>.{ce.func.attr}"
+        return None
+
+    def on_with(self, node, ctx):
+        span = self._opens_span(node)
+        if span is None:
+            return
+        for call, what in self._sync_calls(node.body):
+            ctx.report(call, self.id,
+                       f"{what} while the {span} span is open blocks "
+                       f"the host inside the timed window; move the "
+                       f"sync outside the span")
+
+    def _sync_calls(self, body):
+        hits = []
+
+        def walk(n):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+                return  # deferred execution — not inside the span
+            if isinstance(n, ast.Call):
+                name = dotted(n.func)
+                if (isinstance(n.func, ast.Attribute)
+                        and n.func.attr in self._SYNC_METHODS):
+                    if not _receiver_already_synced(n.func.value,
+                                                    self._SYNC_METHODS):
+                        hits.append((n, f".{n.func.attr}()"))
+                elif name in self._SYNC_FUNCS:
+                    if not (n.args and _literal(n.args[0])):
+                        hits.append((n, f"{name}()"))
+            for c in ast.iter_child_nodes(n):
+                walk(c)
+
+        for stmt in body:
+            walk(stmt)
+        return hits

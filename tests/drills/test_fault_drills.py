@@ -15,6 +15,7 @@ reaper guarantees no leaked children.
 """
 from __future__ import annotations
 
+import json
 import os
 
 import pytest
@@ -37,10 +38,14 @@ def _drill(tmp_path, generations, total_steps=5, **kw):
 def test_kill_mid_marker_2proc_recovers(tmp_path):
     """Tier-1 drill: rank 1 SIGKILLed while its COMMIT marker bytes are
     half-written at step 3 → step 3 never promotes, survivor exits
-    cleanly, relaunch resumes from step 2 and finishes bit-for-bit."""
+    cleanly, relaunch resumes from step 2 and finishes bit-for-bit —
+    and the armed flight recorder leaves a parseable dump for the
+    victim (SIGKILL runs no handlers; the arm-time dump must)."""
+    flight_dir = str(tmp_path / "flight")
     root, logs, report = _drill(
         tmp_path,
-        [(2, KillSpec("mid-marker", 3, rank=1)), (2, None)])
+        [(2, KillSpec("mid-marker", 3, rank=1)), (2, None)],
+        flight_dir=flight_dir)
     assert report[0]["latest"] == 2
     assert report[1]["latest"] == 5
     assert report[1]["rcs"] == [0, 0]
@@ -48,6 +53,12 @@ def test_kill_mid_marker_2proc_recovers(tmp_path):
     log0 = open(os.path.join(logs, "gen0_rank0.log")).read()
     assert "missing ranks [1]" in log0
     assert "arrived: [0]" in log0
+    # run_drill already validated the victim's flight dump; pin the
+    # identity fields here too
+    with open(report[0]["flight"]) as f:
+        flight = json.load(f)
+    assert flight["process_index"] == 1
+    assert flight["run_id"] == report[0]["run_id"]
 
 
 @pytest.mark.slow

@@ -238,6 +238,23 @@ FIXTURES = {
             return mha(q, k, v, causal=True)
         """,
     ),
+    "TPU013": (
+        "pkg/mod.py",
+        """
+        from paddle_tpu.core import RecordEvent
+        def step(model, x):
+            with RecordEvent("forward"):
+                loss = model(x)
+                return loss.item()
+        """,
+        """
+        from paddle_tpu.core import RecordEvent
+        def step(model, x):
+            with RecordEvent("forward"):
+                loss = model(x)
+            return loss.item()
+        """,
+    ),
 }
 
 
@@ -502,6 +519,52 @@ def test_tpu012_alternate_spellings_fire():
         return jax.experimental.pallas.pallas_call(_body, out_shape=x)(x)
     """
     assert "TPU012" in rules_fired(src)
+
+
+def test_tpu013_fires_in_tracer_phase_span():
+    src = """
+    import numpy as np
+    def step(tr, model, x):
+        with tr.phase("backward"):
+            loss = model(x)
+            host = np.asarray(loss._data)
+        return host
+    """
+    assert "TPU013" in rules_fired(src)
+
+
+def test_tpu013_fires_on_get_tracer_receiver():
+    src = """
+    from paddle_tpu.observability.trace import get_tracer
+    def step(model, x):
+        with get_tracer().phase("forward"):
+            return model(x).numpy()
+    """
+    assert "TPU013" in rules_fired(src)
+
+
+def test_tpu013_silent_on_deferred_def_inside_span():
+    # a function DEFINED inside the span runs later — not a sync in the
+    # timed window
+    src = """
+    from paddle_tpu.core import RecordEvent
+    def build(model, x):
+        with RecordEvent("build"):
+            def hook(t):
+                return t.item()
+            return hook
+    """
+    assert "TPU013" not in rules_fired(src)
+
+
+def test_tpu013_suppression_comment():
+    src = """
+    from paddle_tpu.core import RecordEvent
+    def step(model, x):
+        with RecordEvent("forward"):
+            return model(x).item()  # tpu-lint: disable=TPU013
+    """
+    assert "TPU013" not in rules_fired(src)
 
 
 # -- suppressions ------------------------------------------------------------

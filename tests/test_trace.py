@@ -1,0 +1,439 @@
+"""Step-phase tracer tests: ring buffer, phase spans, overlap math,
+Chrome export + cluster merge, analytic MFU, and the flight recorder.
+
+Everything here follows the telemetry contract: disabled hooks are
+no-ops, enable is explicit (or env-driven through ``get_tracer()``),
+and nothing ever syncs the device or raises off the hot path.  The
+multi-process half (per-rank exports stitched across real workers,
+flight-on-SIGKILL) lives in ``tests/drills/test_trace_drills.py``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu.observability as obs
+from paddle_tpu.observability.merge import (
+    discover_trace_files, merge_traces,
+)
+from paddle_tpu.observability.trace import (
+    PEAK_FLOPS, PHASES, Tracer, current_tracer, get_tracer, peak_flops,
+    program_flops, reset_tracer,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _isolated(monkeypatch):
+    # env must never leak enablement into (or out of) a test
+    for var in ("PT_TELEMETRY", "PT_TELEMETRY_DIR", "PT_METRICS_PORT",
+                "PT_RECOMPILE_THRESHOLD", "PT_PROCESS_INDEX", "PT_RUN_ID",
+                "PADDLE_TRAINER_ID", "PT_TRACE", "PT_TRACE_DIR",
+                "PT_FLIGHT_RECORDER"):
+        monkeypatch.delenv(var, raising=False)
+    obs.reset()
+    yield
+    obs.reset()
+
+
+# -- lifecycle / env enablement ---------------------------------------------
+
+def test_singleton_disabled_by_default_and_hooks_noop(tmp_path):
+    tr = get_tracer()
+    assert tr.enabled is False
+    assert current_tracer() is tr
+    # every hook is a no-op while disabled
+    with tr.phase("backward"):
+        pass
+    tr.phase_record("backward", 0, 10)
+    tr.record_span("x", "compute", 0, 10)
+    tr.on_step(0.1)
+    assert tr.spans() == []
+    assert tr.flight_dump() is None
+    snap = tr.snapshot()
+    assert snap["enabled"] is False
+    assert snap["spans"] == 0
+
+
+def test_env_pt_trace_auto_enables(monkeypatch, tmp_path):
+    monkeypatch.setenv("PT_TRACE", "1")
+    monkeypatch.setenv("PT_TRACE_DIR", str(tmp_path))
+    tr = get_tracer()
+    assert tr.enabled
+    assert tr.trace_dir == str(tmp_path)
+    assert tr.flight_path is None
+
+
+def test_env_flight_recorder_implies_enable_and_arms(monkeypatch, tmp_path):
+    flight = tmp_path / "flight"
+    monkeypatch.setenv("PT_FLIGHT_RECORDER", str(flight))
+    tr = get_tracer()
+    assert tr.enabled
+    assert tr.flight_path is not None
+    # arming dumps immediately: a SIGKILL can land before the first
+    # watchdog refresh and must still find a parseable file
+    with open(tr.flight_path) as f:
+        doc = json.load(f)
+    assert doc["reason"] == "armed"
+    assert doc["process_index"] == tr.process_index
+    assert doc["run_id"] == tr.run_id
+
+
+def test_enable_idempotent_and_identity_override(tmp_path):
+    tr = Tracer()
+    tr.enable(process_index=3, run_id="r9", trace_dir=str(tmp_path))
+    tr.enable()  # second enable must not reset anything
+    assert tr.process_index == 3 and tr.run_id == "r9"
+    assert tr.default_trace_path().endswith("trace-r9-3.json")
+
+
+# -- ring buffer + phase spans -----------------------------------------------
+
+def test_ring_buffer_bounded_keeps_newest():
+    tr = Tracer(capacity=8).enable()
+    for i in range(20):
+        tr.record_span(f"s{i}", "host", i, i + 1)
+    spans = tr.spans()
+    assert len(spans) == 8
+    assert [s.name for s in spans] == [f"s{i}" for i in range(12, 20)]
+
+
+def test_phase_ctx_manager_records_span_and_histogram():
+    tr = Tracer().enable()
+    with tr.phase("backward"):
+        pass
+    spans = tr.spans()
+    assert len(spans) == 1
+    assert spans[0].name == "backward" and spans[0].cat == "compute"
+    assert spans[0].t1_ns >= spans[0].t0_ns
+    assert "backward" in tr.phase_percentiles_ms()
+
+
+def test_phase_span_skipped_inside_jax_trace():
+    import jax
+
+    tr = Tracer().enable()
+
+    @jax.jit
+    def f(x):
+        with tr.phase("forward"):
+            return x + 1
+
+    f(np.ones(2, np.float32))
+    # the trace ran the body, but wall-timing a tracer is meaningless:
+    # no span may land
+    assert tr.spans() == []
+
+
+def test_phase_taxonomy_categories():
+    tr = Tracer().enable()
+    for p in PHASES:
+        tr.phase_record(p, 0, 10)
+    cats = {s.name: s.cat for s in tr.spans()}
+    assert cats["forward"] == cats["backward"] == cats["optimizer"] \
+        == "compute"
+    assert cats["collective"] == "collective"
+    assert cats["data_wait"] == cats["checkpoint"] == "host"
+
+
+# -- overlap fraction --------------------------------------------------------
+
+def test_overlap_fraction_math():
+    tr = Tracer().enable()
+    tr.record_span("bwd", "compute", 0, 100)
+    tr.record_span("ar", "collective", 50, 150)
+    assert tr.overlap_fraction() == pytest.approx(0.5)
+
+
+def test_overlap_fraction_none_without_collectives():
+    tr = Tracer().enable()
+    tr.record_span("bwd", "compute", 0, 100)
+    assert tr.overlap_fraction() is None
+
+
+def test_overlap_fraction_merges_compute_and_caps_at_one():
+    tr = Tracer().enable()
+    # two overlapping compute spans must merge, not double-count
+    tr.record_span("a", "compute", 0, 80)
+    tr.record_span("b", "compute", 40, 120)
+    tr.record_span("ar", "collective", 0, 100)
+    assert tr.overlap_fraction() == pytest.approx(1.0)
+
+
+# -- Chrome export + cluster merge -------------------------------------------
+
+def test_export_chrome_without_path_raises():
+    tr = Tracer().enable()
+    with pytest.raises(ValueError):
+        tr.export_chrome()
+
+
+def test_chrome_export_roundtrips_through_merge(tmp_path):
+    """Two standalone rank tracers export; ``merge --trace`` semantics
+    stitch them into one timeline with pid = rank and a single
+    process_name metadata event per rank."""
+    trace_dir = str(tmp_path)
+    for rank in (0, 1):
+        tr = Tracer().enable(trace_dir=trace_dir, process_index=rank,
+                             run_id="mergetest")
+        tr.record_span("backward", "compute", 1000, 2000)
+        tr.record_span("all_reduce", "collective", 1500, 2500)
+        out = tr.export_chrome()
+        assert out == os.path.join(trace_dir,
+                                   f"trace-mergetest-{rank}.json")
+    # a corrupt file must be skipped, never fatal
+    with open(os.path.join(trace_dir, "trace-mergetest-2.json"), "w") as f:
+        f.write("{not json")
+    files = discover_trace_files([trace_dir])
+    assert len(files) == 3
+    doc, skipped = merge_traces(files)
+    assert skipped == 1
+    evs = doc["traceEvents"]
+    meta = [e for e in evs if e.get("ph") == "M"]
+    xs = [e for e in evs if e.get("ph") == "X"]
+    assert {m["pid"] for m in meta} == {0, 1}
+    assert {e["pid"] for e in xs} == {0, 1}
+    assert len(xs) == 4
+    ts = [e["ts"] for e in xs]
+    assert ts == sorted(ts)
+    for e in xs:
+        assert set(e) >= {"name", "cat", "ts", "dur", "pid", "tid"}
+        assert e["args"]["run_id"] == "mergetest"
+
+
+# -- analytic MFU ------------------------------------------------------------
+
+def test_peak_flops_prefix_matching():
+    assert peak_flops("TPU v5 lite podslice") == PEAK_FLOPS["TPU v5 lite"]
+    assert peak_flops("TPU v4") == PEAK_FLOPS["TPU v4"]
+    assert peak_flops("cpu") == PEAK_FLOPS["cpu"]
+    assert peak_flops("Banana9000") is None
+    assert peak_flops(None) is None
+
+
+def test_program_flops_and_mfu_on_cpu_jit():
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((64, 64), jnp.float32)
+    jitted = jax.jit(lambda a: a @ a)
+    flops = program_flops(jitted, x)
+    assert flops and flops > 0
+    tr = Tracer().enable()
+    tr.record_program_flops("matmul", flops)
+    assert tr.flops_per_step() == flops
+    # backend is initialized by the lowering above, so device_kind is
+    # the cpu backend's and the nominal cpu peak applies
+    mfu = tr.mfu_analytic(step_seconds=0.01)
+    assert mfu == pytest.approx(flops / (0.01 * PEAK_FLOPS["cpu"]))
+
+
+def test_mfu_none_when_factors_missing():
+    tr = Tracer().enable()
+    assert tr.mfu_analytic(step_seconds=0.01) is None  # no flops
+    tr.record_program_flops("p", 1e9)
+    assert tr.mfu_analytic() is None  # no step time yet
+
+
+def test_on_step_refreshes_overlap_and_mfu():
+    tr = Tracer().enable()
+    tr.record_span("bwd", "compute", 0, 100)
+    tr.record_span("ar", "collective", 50, 150)
+    tr.record_program_flops("p", 1e9)
+    tr.on_step(0.25)
+    assert tr._last_step_seconds == 0.25
+    assert tr._last_overlap == pytest.approx(0.5)
+    snap = tr.snapshot()
+    assert snap["overlap_fraction"] == pytest.approx(0.5)
+    assert snap["flops_per_step"] == 1e9
+
+
+# -- flight recorder ---------------------------------------------------------
+
+def test_flight_dump_document(tmp_path):
+    tr = Tracer().enable(flight_dir=str(tmp_path), process_index=2,
+                         run_id="fr")
+    tr.record_span("bwd", "compute", 0, 100)
+    path = tr.flight_dump(reason="manual")
+    assert path == str(tmp_path / "flight-fr-2.json")
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["reason"] == "manual"
+    assert doc["process_index"] == 2 and doc["run_id"] == "fr"
+    assert {"ts", "pid", "last_step_seconds", "overlap_fraction",
+            "mfu_analytic", "program_flops", "spans",
+            "telemetry"} <= set(doc)
+    assert doc["spans"][-1]["name"] == "bwd"
+
+
+def test_flight_watchdog_refreshes_from_hot_path(tmp_path):
+    import time
+
+    tr = Tracer().enable(flight_dir=str(tmp_path))
+    tr._flight_last_ns = 0  # force the cadence check to fire
+    now = time.perf_counter_ns()
+    tr.phase_record("backward", now - 100, now)
+    with open(tr.flight_path) as f:
+        doc = json.load(f)
+    assert doc["reason"] == "watchdog"
+    assert tr._flight_last_ns > 0
+
+
+def test_excepthook_dumps_then_chains(tmp_path, monkeypatch):
+    seen = []
+    monkeypatch.setattr(sys, "excepthook",
+                        lambda *a: seen.append(a))
+    tr = Tracer().enable(flight_dir=str(tmp_path))
+    assert sys.excepthook == tr._excepthook
+    err = ValueError("boom")
+    sys.excepthook(ValueError, err, None)
+    with open(tr.flight_path) as f:
+        assert json.load(f)["reason"] == "crash:ValueError"
+    assert seen and seen[0][1] is err  # previous hook still ran
+    tr.disable()
+    assert sys.excepthook is not tr._excepthook  # restored
+
+
+def test_flight_dump_never_raises_on_bad_dir(tmp_path):
+    target = tmp_path / "blocked"
+    target.write_text("a file, not a dir")
+    tr = Tracer().enable()
+    tr.flight_path = str(target / "flight-x-0.json")
+    assert tr.flight_dump() is None
+    assert tr.dropped == 1
+
+
+# -- integration: RecordEvent / capture / hapi / telemetry -------------------
+
+def test_record_event_feeds_tracer():
+    from paddle_tpu.core import RecordEvent
+
+    tr = get_tracer().enable()
+    with RecordEvent("io_read"):
+        pass
+    spans = tr.spans()
+    assert [s.name for s in spans] == ["io_read"]
+    assert spans[0].cat == "host"
+
+
+def test_capture_step_harvests_flops_and_compute_spans():
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+
+    tr = get_tracer().enable()
+    pt.seed(0)
+    model = nn.Linear(4, 2)
+    opt = pt.optimizer.SGD(learning_rate=0.1,
+                           parameters=model.parameters())
+    mse = nn.MSELoss()
+
+    @pt.jit.capture_step
+    def step(x, y):
+        loss = mse(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    x = pt.to_tensor(np.random.randn(4, 4).astype(np.float32))
+    y = pt.to_tensor(np.random.randn(4, 2).astype(np.float32))
+    for _ in range(3):
+        step(x, y)
+    assert tr.flops_per_step() and tr.flops_per_step() > 0
+    comp = [s for s in tr.spans() if s.cat == "compute"]
+    assert len(comp) == 3  # one dispatch span per captured call
+    assert tr.mfu_analytic(step_seconds=1.0) is not None
+
+
+def test_hapi_fit_records_step_phases():
+    import paddle_tpu as pt
+    from paddle_tpu.vision.datasets import FakeData
+
+    tr = get_tracer().enable()
+    net = pt.nn.Sequential(pt.nn.Flatten(), pt.nn.Linear(3 * 8 * 8, 4))
+    model = pt.Model(net)
+    model.prepare(
+        optimizer=pt.optimizer.SGD(learning_rate=0.01,
+                                   parameters=net.parameters()),
+        loss=pt.nn.CrossEntropyLoss())
+    model.fit(FakeData(size=32, image_shape=(3, 8, 8), num_classes=4),
+              epochs=1, batch_size=16, verbose=0)
+    phases = set(tr.phase_percentiles_ms())
+    assert {"backward", "optimizer"} <= phases
+
+
+def test_collective_bytes_histogram():
+    from paddle_tpu.observability import get_registry, get_telemetry
+
+    tel = get_telemetry().enable()
+    tel.collective_op("all_reduce", nbytes=4096)
+    tel.collective_op("all_reduce", nbytes=8192)
+    snap = get_registry().snapshot()
+    hist = snap["pt_collective_bytes"]["series"]["op=all_reduce"]
+    assert hist["count"] == 2
+    assert hist["sum"] == 12288
+    assert snap["pt_collective_bytes_total"]["series"]["op=all_reduce"] \
+        == 12288
+    text = get_registry().prometheus_text()
+    assert "pt_collective_bytes_bucket" in text
+
+
+def test_observe_step_feeds_tracer_gauges():
+    from paddle_tpu.observability import get_telemetry
+
+    tr = get_tracer().enable()
+    tel = get_telemetry().enable()
+    tr.record_span("bwd", "compute", 0, 100)
+    tr.record_span("ar", "collective", 0, 100)
+    tel.observe_step(0.125)
+    assert tr._last_step_seconds == 0.125
+    assert tr._last_overlap == pytest.approx(1.0)
+
+
+def test_healthz_surfaces_flight_path(tmp_path):
+    from paddle_tpu.observability import get_telemetry
+
+    tr = get_tracer().enable(flight_dir=str(tmp_path))
+    tel = get_telemetry().enable()
+    doc = tel.healthz()
+    assert doc["flight_recorder"] == tr.flight_path
+
+
+# -- aggregator retention ----------------------------------------------------
+
+def test_retention_buffer_evicts_and_downsamples():
+    from paddle_tpu.observability.aggregator import RetentionBuffer
+
+    buf = RetentionBuffer(retention=10.0, max_points=8)
+    for t in range(12):
+        buf.append(float(t), {"v": t})
+    pts = buf.points()
+    # ts=12-built window: points older than last-10s are gone, and the
+    # cap forced at least one halving pass on the older half
+    assert all(ts >= 11 - 10.0 for ts, _ in pts)
+    assert len(pts) <= 8
+    assert pts[-1][0] == 11.0
+    assert buf.downsampled_total > 0
+    s = buf.summary()
+    assert s["retention_seconds"] == 10.0
+    assert s["max_points"] == 8
+    assert s["points"] == len(pts)
+    assert s["downsampled_total"] == buf.downsampled_total
+    assert s["span_seconds"] >= 0
+
+
+def test_retention_buffer_keeps_recent_resolution():
+    from paddle_tpu.observability.aggregator import RetentionBuffer
+
+    buf = RetentionBuffer(retention=1e9, max_points=4)
+    for t in range(8):
+        buf.append(float(t), t)
+    pts = buf.points()
+    # the newest points always survive downsampling intact
+    assert pts[-1] == (7.0, 7)
+    assert pts[-2] == (6.0, 6)
