@@ -34,7 +34,12 @@ import subprocess
 import sys
 import time
 import traceback
+from paddle_tpu.device import enable_overlap_flags as _enable_overlap_flags
 from paddle_tpu.distributed._jax_compat import shard_map as _shard_map, use_mesh as _use_mesh
+
+# latency-hiding-scheduler / async-collective flags must precede backend
+# init; idempotent + env-gated, no-op off TPU (device/xla_flags.py)
+_enable_overlap_flags()
 
 SMOKE = bool(os.environ.get("BENCH_SMOKE"))  # tiny-shape CI structure check
 RESNET_BATCH = 8 if SMOKE else 256

@@ -8,6 +8,8 @@ from ..framework.device import (  # noqa: F401
 )
 from .plugin import (  # noqa: F401
     load_custom_runtime_lib, load_custom_device_plugins, registered_plugins)
+from .xla_flags import (  # noqa: F401
+    enable_overlap_flags, overlap_flags_active, OVERLAP_XLA_FLAGS)
 
 __all__ = ["set_device", "get_device", "get_all_devices", "device_count",
            "is_compiled_with_cuda", "is_compiled_with_tpu", "cuda",
@@ -16,7 +18,8 @@ __all__ = ["set_device", "get_device", "get_all_devices", "device_count",
            "get_cudnn_version", "IPUPlace", "is_compiled_with_ipu",
            "get_all_device_type", "get_all_custom_device_type",
            "Stream", "Event", "current_stream", "set_stream",
-           "stream_guard", "synchronize"]
+           "stream_guard", "synchronize",
+           "enable_overlap_flags", "overlap_flags_active"]
 
 
 def get_available_device():

@@ -11,9 +11,53 @@ container actually has.
 """
 from __future__ import annotations
 
+import contextvars
+import functools
+
 import jax
 
-__all__ = ["shard_map", "use_mesh", "axis_size"]
+__all__ = ["shard_map", "use_mesh", "axis_size", "declared_manual_axes"]
+
+# Manual-axes declaration for the old-jax shard_map path. New jax honors
+# ``axis_names`` (undeclared mesh axes stay automatic, so
+# ``lax.axis_index`` on them fails and axis-scope probes answer "no").
+# Old jax runs fully manual over EVERY mesh axis, which makes physical
+# axis-env probes lie: an axis the caller left automatic still resolves,
+# flipping dual-mode layers (mp_layers) into their manual path while
+# their operands arrived replicated. We record the caller's declared set
+# here so ``collective._in_axis_scope`` can answer like new jax does.
+# ``None`` = no declaration active (plain traces, or shard_maps that
+# passed no axis_names and really do own every axis, e.g. the eager
+# collective submesh evaluator).
+_MANUAL_AXES: contextvars.ContextVar = contextvars.ContextVar(
+    "pt_manual_axes", default=None)
+
+
+def declared_manual_axes():
+    """The axis_names set of the innermost compat shard_map, or None."""
+    return _MANUAL_AXES.get()
+
+
+def in_compat_manual_region():
+    """True while tracing the body of an old-jax compat ``shard_map``.
+
+    There EVERY mesh axis is physically manual, so named sharding
+    constraints on mesh axes fail at lowering ("axis also found in
+    manual_axes") — hint emitters must skip rather than rely on
+    trace-time exception guards. Never True on new jax (the wrapper is
+    only installed on the experimental path)."""
+    return _MANUAL_AXES.get() is not None
+
+
+def _with_declared_axes(fn, axes):
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        token = _MANUAL_AXES.set(frozenset(axes))
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            _MANUAL_AXES.reset(token)
+    return wrapped
 
 
 def shard_map(fn, mesh, in_specs, out_specs, axis_names=None,
@@ -36,7 +80,12 @@ def shard_map(fn, mesh, in_specs, out_specs, axis_names=None,
     # unsupported PartitionId lowering under SPMD partitioning (notably on
     # CPU), so run fully manual instead: axes the caller left automatic are
     # simply unmentioned in the specs, i.e. replicated — correct, if less
-    # parallel, which is the right trade for a compatibility path.
+    # parallel, which is the right trade for a compatibility path.  The
+    # declaration context keeps axis-scope probes honest inside the body:
+    # without it, replicated-in operands would hit manual-mode layer paths
+    # (wrong math), the exact failure the dual-mode TP layers guard on.
+    if axis_names is not None:
+        fn = _with_declared_axes(fn, axis_names)
     return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                check_rep=check_vma)
 

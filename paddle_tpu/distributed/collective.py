@@ -198,7 +198,18 @@ def _group_of(group) -> Group:
 
 
 def _in_axis_scope(name: str) -> bool:
-    """True when called under a trace with mesh axis `name` in scope."""
+    """True when called under a trace with mesh axis `name` in scope.
+
+    Under the old-jax compat ``shard_map`` (fully manual over every mesh
+    axis) the physical axis env would say yes for ALL axes; honor the
+    caller's ``axis_names`` declaration instead so an axis left automatic
+    (operands replicated, not per-rank blocks) answers "no" exactly like
+    new jax — mp_layers' dual-mode dispatch depends on this.
+    """
+    from ._jax_compat import declared_manual_axes
+    declared = declared_manual_axes()
+    if declared is not None and name not in declared:
+        return False
     try:
         lax.axis_index(name)
         return True

@@ -69,11 +69,18 @@ the per-rank files into ONE schema-valid cluster timeline (rank as
 pid) with a strictly positive measured overlap fraction.  Fault
 drills run with ``flight_dir`` set additionally prove the SIGKILLed
 victim left a parseable flight-recorder dump behind.
+
+Overlap drills (:func:`.runner.run_overlap_drill`) exercise the
+optimization half of GC3: the span timelines pinned down by the
+bucketed vs monolithic gradient reduction (real ``partition_buckets``
+output, synthetic timestamps) feed the real tracer, proving the
+measured ``pt_compute_collective_overlap_fraction`` is strictly
+higher with bucketing enabled than disabled.
 """
 __all__ = ["KillSpec", "StoreKillSpec", "ObsSpec", "TraceSpec",
            "run_drill", "run_store_kill_drill", "run_scrape_drill",
-           "run_trace_drill", "spawn_worker", "spawn_store_master",
-           "spawn_aggregator", "reap_all"]
+           "run_trace_drill", "run_overlap_drill", "spawn_worker",
+           "spawn_store_master", "spawn_aggregator", "reap_all"]
 
 
 def __getattr__(name):

@@ -4,9 +4,14 @@ ref: ``python/paddle/distributed/fleet/base/distributed_strategy.py`` backed
 by ``paddle/fluid/framework/distributed_strategy.proto``. The TPU build
 replaces the protobuf with a plain typed attribute tree (SURVEY §5 config
 stance: one typed config + env overrides); the attribute NAMES match the
-reference so user strategy code ports unchanged. Toggles that are NCSL/NCCL
-mechanics with no XLA meaning (e.g. ``fuse_grad_size_in_MB``) are accepted
-and ignored — XLA owns those decisions.
+reference so user strategy code ports unchanged. Toggles that are NCCL
+mechanics with no XLA meaning are accepted and ignored — with two
+exceptions made meaningful by the overlap layer (PR 10):
+``fuse_all_reduce_ops``/``fuse_grad_size_in_MB`` drive the bucketed
+gradient reduction (``distributed/grad_buckets.py``) and
+``pipeline_configs["overlap_p2p_comm"]`` the double-buffered 1F1B hop
+(``meta_parallel/pp_spmd.py``). :func:`strategy_overlap_setup` is the
+one translation point.
 """
 from __future__ import annotations
 
@@ -44,13 +49,18 @@ class DistributedStrategy:
         self.pipeline_configs = {"accumulate_steps": 1,
                                  "micro_batch_size": 1,
                                  "schedule_mode": "1F1B",
-                                 "virtual_pp_degree": 1}
+                                 "virtual_pp_degree": 1,
+                                 # double-buffered ring hop (pp_spmd
+                                 # overlap); None = PT_PP_OVERLAP env
+                                 "overlap_p2p_comm": None}
         # gradient merge
         self.gradient_merge = False
         self.gradient_merge_configs = {"k_steps": 1, "avg": True}
-        # misc toggles kept for parity (no-ops under XLA)
+        # grad-fusion knobs — MEANINGFUL since PR 10: size target of the
+        # bucketed dp gradient reduction (PT_GRAD_BUCKET_MB env wins)
         self.fuse_all_reduce_ops = True
         self.fuse_grad_size_in_MB = 32
+        # misc toggles kept for parity (no-ops under XLA)
         self.nccl_comm_num = 1
         self.sync_nccl_allreduce = False
         self.find_unused_parameters = False
@@ -79,6 +89,25 @@ class DistributedStrategy:
     def __repr__(self):
         rows = [f"  {k}={v!r}" for k, v in sorted(self.__dict__.items())]
         return "DistributedStrategy(\n" + "\n".join(rows) + "\n)"
+
+
+def strategy_overlap_setup(strategy):
+    """Translate the strategy's comm-overlap knobs for
+    ``build_train_step``: returns ``(grad_bucket_mb, pipeline_overlap)``.
+
+    ``grad_bucket_mb``: the bucketed-reduction size target —
+    ``fuse_grad_size_in_MB`` when ``fuse_all_reduce_ops`` is on, else 0
+    (disabled). ``pipeline_overlap``:
+    ``pipeline_configs["overlap_p2p_comm"]`` (None defers to the
+    ``PT_PP_OVERLAP`` env default inside ``pp_spmd``).
+    """
+    if strategy is None:
+        return None, None
+    bucket_mb = (getattr(strategy, "fuse_grad_size_in_MB", None)
+                 if getattr(strategy, "fuse_all_reduce_ops", True) else 0)
+    overlap = getattr(strategy, "pipeline_configs",
+                      {}).get("overlap_p2p_comm")
+    return bucket_mb, overlap
 
 
 def strategy_amp_setup(strategy, model=None):

@@ -29,6 +29,10 @@ class Fleet:
         if strategy is None:
             strategy = DistributedStrategy()
         self._user_defined_strategy = strategy
+        # comm-overlap compiler flags must land before the backend spins
+        # up; idempotent, env-gated, no-op off TPU (device/xla_flags.py)
+        from ...device import enable_overlap_flags
+        enable_overlap_flags()
         init_parallel_env()
 
         hc = strategy.hybrid_configs

@@ -95,7 +95,7 @@ def microbatch_utilization(num_microbatches, pp):
 
 def pipeline_spmd(stage_fn, stage_params, x, num_microbatches, *,
                   mesh=None, axis_name="pp", remat=True, extras=(),
-                  virtual_stages=1):
+                  virtual_stages=1, overlap=None):
     """Run ``x`` through ``pp`` pipeline stages as one compiled schedule.
 
     stage_fn(stage_params_group, h, *extras_mb) -> h' where
@@ -140,11 +140,28 @@ def pipeline_spmd(stage_fn, stage_params, x, num_microbatches, *,
     micro-batches and indexed at the micro-batch each chip is processing;
     other extras (broadcast masks etc.) pass through whole.
 
+    overlap: double-buffer the ring hop so tick ``t`` TRANSPORTS tick
+    ``t-1``'s activations while COMPUTING tick ``t``'s — the ``ppermute``
+    has no data dependence on the tick's stage compute, letting XLA's
+    async collectives run the hop on the ICI under the MXU work (the
+    compiled analog of the reference's separate P2P comm stream,
+    ``pp_utils/p2p_communication.py``). Hop latency becomes 2 ticks: the
+    ring deepens to ``2·pp`` slots (two interleaved phases), fill/drain
+    doubles but steady-state stays one micro-batch per tick, so
+    ``T₂ = τ₂(M−1) + 2·v·pp − 1`` with
+    ``τ₂(m) = (m // 2pp)·2·v·pp + m % 2pp``. Default from
+    ``PT_PP_OVERLAP`` (on); pass ``False``/``True`` to force.
+
     Returns ``[B, ...]`` activations leaving the last stage (read from the
     last stage's shard — no all-reduce; XLA broadcasts on consumption).
     Differentiable (gradients flow to ``stage_params``, ``x`` and split
     ``extras``).
     """
+    import os
+    if overlap is None:
+        overlap = os.environ.get("PT_PP_OVERLAP", "1") not in (
+            "0", "false", "off")
+    overlap = bool(overlap)
     mesh = mesh or _mesh_mod.get_mesh()
     pp = mesh.shape.get(axis_name, 1)
     M = int(num_microbatches)
@@ -168,7 +185,14 @@ def pipeline_spmd(stage_fn, stage_params, x, num_microbatches, *,
         jnp.reshape(e, (M, B // M) + tuple(e.shape[1:])) if sp else e
         for e, sp in zip(extras, split_mask))
     body = jax.checkpoint(stage_fn) if remat else stage_fn
-    T = ((M - 1) // pp) * v * pp + (M - 1) % pp + v * pp
+    if overlap:
+        # 2-tick hop: ring deepens to 2·pp slots (two interleaved
+        # phases); injection blocks only once 2·pp micro-batches are in
+        # flight, each occupying its slot 2·v·pp ticks
+        T = (((M - 1) // (2 * pp)) * 2 * v * pp + (M - 1) % (2 * pp)
+             + 2 * v * pp - 1)
+    else:
+        T = ((M - 1) // pp) * v * pp + (M - 1) % pp + v * pp
 
     def pipelined(sp, mbs, key, *extras_mb):
         # sp leaves arrive [n_local, ...] (v==1) or [v, Lv, ...] (v>1):
@@ -179,8 +203,10 @@ def pipeline_spmd(stage_fn, stage_params, x, num_microbatches, *,
         stage_key = jax.random.fold_in(key, idx)
         perm = [(i, (i + 1) % pp) for i in range(pp)]
 
-        def tick(carry, t):
-            act, r, m, n_inj, out_buf = carry
+        def process(act, r, m, n_inj, out_buf, t):
+            """One stage visit: inject at stage 0 into a free slot, run
+            the stage body, write finished micro-batches, advance laps.
+            Returns the outgoing (y, r_next, m_cur) slot."""
             # the arriving ring slot is free iff its occupant has finished
             # all v laps (init: r = v marks every slot free)
             inject = (idx == 0) & (r >= v) & (n_inj < M)
@@ -207,16 +233,41 @@ def pipeline_spmd(stage_fn, stage_params, x, num_microbatches, *,
             out_buf = lax.dynamic_update_index_in_dim(out_buf, upd, mb_i, 0)
             # laps advance when the activation wraps pp-1 -> 0
             r_next = jnp.where(idx == pp - 1, r_cur + 1, r_cur)
+            return (y, r_next, m_cur), n_inj, out_buf
+
+        def hop(slot):
             # hand (activation, lap, micro-batch id) to the next stage
-            act = lax.ppermute(y, axis_name, perm)
-            r = lax.ppermute(r_next, axis_name, perm)
-            m = lax.ppermute(m_cur, axis_name, perm)
+            return tuple(lax.ppermute(s, axis_name, perm) for s in slot)
+
+        def tick(carry, t):
+            act, r, m, n_inj, out_buf = carry
+            out_slot, n_inj, out_buf = process(act, r, m, n_inj, out_buf, t)
+            act, r, m = hop(out_slot)
             return (act, r, m, n_inj, out_buf), None
 
-        init = (jnp.zeros(mb_shape[1:], x.dtype),
-                jnp.int32(v), jnp.int32(0), jnp.int32(0),
-                jnp.zeros(mb_shape, x.dtype))
-        (_, _, _, _, out_buf), _ = lax.scan(tick, init, jnp.arange(T))
+        def tick_overlap(carry, t):
+            # double-buffered edge state: transport LAST tick's output
+            # while running THIS tick's compute — the ppermute has no
+            # data dependence on process(), so the latency-hiding
+            # scheduler runs it under the stage body (async collective
+            # on ICI). The hop takes 2 ticks; even/odd ticks form two
+            # interleaved pipeline phases.
+            cur, pend, n_inj, out_buf = carry
+            arrived = hop(pend)
+            act, r, m = cur
+            out_slot, n_inj, out_buf = process(act, r, m, n_inj, out_buf, t)
+            return (arrived, out_slot, n_inj, out_buf), None
+
+        free_slot = (jnp.zeros(mb_shape[1:], x.dtype),
+                     jnp.int32(v), jnp.int32(0))
+        out0 = jnp.zeros(mb_shape, x.dtype)
+        if overlap:
+            init = (free_slot, free_slot, jnp.int32(0), out0)
+            (_, _, _, out_buf), _ = lax.scan(
+                tick_overlap, init, jnp.arange(T))
+        else:
+            init = free_slot + (jnp.int32(0), out0)
+            (_, _, _, _, out_buf), _ = lax.scan(tick, init, jnp.arange(T))
         # out_specs stacks the per-stage buffers over pp; only the last
         # stage's row is real (cheaper than the old full-output psum:
         # consumers slice row pp-1 and XLA broadcasts just that)

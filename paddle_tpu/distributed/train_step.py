@@ -192,9 +192,90 @@ def _scaler_finish(scaler, grads, scale, old_state):
     return grads, select, sstate
 
 
+def _bucket_plan_for(params, mesh, zero, grad_bucket_mb):
+    """A :class:`grad_buckets.BucketPlan` when the bucketed-reduction
+    path applies, else None.
+
+    Bucketed reduction is the data-parallel gradient fusion of the
+    reference's ``EagerReducer``/``fuse_grad_size_in_MB``: it replaces
+    the implicit GSPMD dp-grad reduction with explicit per-bucket fused
+    pmeans placed mid-backward. It therefore engages only on pure-dp
+    meshes (every non-dp axis size 1 — with mp/sep/sharding in play the
+    reduction is GSPMD's to schedule) and without ZeRO (whose
+    reduce-scatter layout owns the grads). ``PT_GRAD_BUCKETS=0``
+    disables; ``grad_bucket_mb=0`` disables per call site.
+    """
+    import os
+    from . import grad_buckets as _gb
+    if grad_bucket_mb is not None and not grad_bucket_mb:
+        return None
+    if os.environ.get("PT_GRAD_BUCKETS", "1") in ("0", "false", "off"):
+        return None
+    if zero is not None or mesh.shape.get("dp", 1) <= 1:
+        return None
+    if any(n > 1 for ax, n in mesh.shape.items() if ax != "dp"):
+        return None
+    plan = _gb.partition_buckets(
+        params, _gb.default_bucket_bytes(grad_bucket_mb))
+    plan.record_metrics()
+    return plan
+
+
+def _bucketed_value_and_grad(model, fwd, loss_fn, autocast, plan, mesh,
+                             state, scale, x, labels):
+    """Loss + grads with per-bucket fused dp reductions, as one
+    ``shard_map`` manual over ``dp``: the batch arrives as the local
+    shard, the loss is the local mean, and each bucket's grads are
+    pmean-reduced over dp by its marker's backward — emitted exactly
+    where that bucket's last cotangent forms, so the reductions
+    interleave with (and can hide behind) the remaining backward."""
+    from .grad_buckets import apply_bucketed_reduction
+    from ._jax_compat import shard_map
+
+    def body(params, buffers, key, scale, x, *labels):
+        # per-shard dropout stream: fold the dp coordinate so shards
+        # draw independent masks (the global-batch analog)
+        key = jax.random.fold_in(key, jax.lax.axis_index("dp"))
+
+        def loss_of(p):
+            p = apply_bucketed_reduction(p, plan, "dp")
+            with _random.trace_key_scope(key), \
+                    (autocast() if autocast is not None
+                     else contextlib.nullcontext()):
+                out, new_buffers = functional_call(
+                    model, p, buffers, (Tensor(x),), training=True,
+                    forward_fn=fwd)
+                loss = loss_fn(out, *[Tensor(l) for l in labels])
+            loss_arr = loss._data if isinstance(loss, Tensor) else loss
+            loss_arr = loss_arr.astype(jnp.float32)
+            return loss_arr * scale, (loss_arr, new_buffers)
+
+        (_, (loss, new_buffers)), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(params)
+        loss = jax.lax.pmean(loss, "dp")
+        # float buffers (running stats) merge as the dp mean; others are
+        # deterministic/replicated and pass through from the local shard
+        new_buffers = {
+            k: (jax.lax.pmean(b, "dp")
+                if jnp.issubdtype(b.dtype, jnp.floating) else b)
+            for k, b in new_buffers.items()}
+        return loss, grads, new_buffers
+
+    key = _random.next_key()
+    n_lab = len(labels)
+    mapped = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), P(), P(), P(), P("dp")) + tuple(
+            P("dp") for _ in range(n_lab)),
+        out_specs=(P(), P(), P()), axis_names={"dp"}, check_vma=False)
+    return mapped(state["params"], state["buffers"], key, scale, x,
+                  *labels)
+
+
 def build_train_step(model: Layer, loss_fn, optimizer, mesh=None,
                      donate=True, pipeline_microbatches=None, scaler=None,
-                     pipeline_virtual_stages=1, autocast=None):
+                     pipeline_virtual_stages=1, autocast=None,
+                     grad_bucket_mb=None, pipeline_overlap=None):
     """Returns (step_fn, state) where
     ``state = {"params", "buffers", "opt"}`` is mesh-placed and
     ``step_fn(state, *batch) -> (loss, state)`` is one compiled program.
@@ -239,9 +320,10 @@ def build_train_step(model: Layer, loss_fn, optimizer, mesh=None,
         return _build_pipelined_train_step(
             model, loss_fn, optimizer, mesh, donate,
             pipeline_microbatches or pp, scaler,
-            pipeline_virtual_stages, autocast)
+            pipeline_virtual_stages, autocast, pipeline_overlap)
     params, buffers, shardings = shard_model_state(model, mesh)
     zero = _zero_level(optimizer)
+    bucket_plan = _bucket_plan_for(params, mesh, zero, grad_bucket_mb)
     opt_state, opt_sh = _place_opt_state(optimizer, params, shardings,
                                          mesh, zero)
     state = {"params": params, "buffers": buffers, "opt": opt_state}
@@ -269,8 +351,13 @@ def build_train_step(model: Layer, loss_fn, optimizer, mesh=None,
             loss_arr = loss_arr.astype(jnp.float32)
             return loss_arr * scale, (loss_arr, new_buffers)
 
-        (_, (loss, new_buffers)), grads = jax.value_and_grad(
-            loss_of, has_aux=True)(state["params"])
+        if bucket_plan is not None:
+            loss, grads, new_buffers = _bucketed_value_and_grad(
+                model, fwd, loss_fn, autocast, bucket_plan, mesh,
+                state, scale, x, labels)
+        else:
+            (_, (loss, new_buffers)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(state["params"])
         if zero == "os_g":
             # ZeRO-2: constrain grads to the optimizer-state partition —
             # GSPMD turns the dp grad all-reduce into reduce-scatter and
@@ -351,7 +438,8 @@ def pipeline_compatible(model, pp):
 
 def _build_pipelined_train_step(model, loss_fn, optimizer, mesh, donate,
                                 num_microbatches, scaler=None,
-                                virtual_stages=1, autocast=None):
+                                virtual_stages=1, autocast=None,
+                                pipeline_overlap=None):
     """Pipeline-parallel variant of :func:`build_train_step`.
 
     State layout: the homogeneous blocks' parameters are stacked into
@@ -453,7 +541,8 @@ def _build_pipelined_train_step(model, loss_fn, optimizer, mesh, donate,
                     return t._data
                 y = pipeline_spmd(stage_fn, sp, h._data, num_microbatches,
                                   mesh=mesh, extras=e_arrs,
-                                  virtual_stages=vstages)
+                                  virtual_stages=vstages,
+                                  overlap=pipeline_overlap)
                 return Tensor(y)
 
             with pipeline_executor_scope(executor), \

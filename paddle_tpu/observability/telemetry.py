@@ -381,6 +381,15 @@ class TrainingTelemetry:
             "pt_collective_time_seconds",
             "host-boundary wall time of eagerly dispatched collectives "
             "(not recorded inside traces)", ("op",))
+        self._m_grad_buckets = r.counter(
+            "pt_grad_buckets_total",
+            "gradient-reduction buckets built by train-step tracing")
+        self._m_grad_bucket_bytes = r.histogram(
+            "pt_grad_bucket_bytes",
+            "flat-concatenated payload bytes of each gradient bucket "
+            "(the fused all-reduce granularity, vs the per-parameter "
+            "sizes it replaced)",
+            buckets=log_buckets(1e2, 1e9, per_decade=1))
         self._m_ckpt_ops = r.counter(
             "pt_checkpoint_ops_total", "checkpoint operations",
             ("op", "status"))
@@ -509,6 +518,16 @@ class TrainingTelemetry:
         if not self.enabled:
             return
         self._m_coll_time.observe(float(seconds), op=op)
+
+    def grad_bucket(self, nbytes):
+        """One gradient bucket materialized at train-step trace time;
+        ``nbytes`` is the flat-concatenated payload of its fused
+        reduction (recorded once per trace — the honest count, like
+        ``collective_op``)."""
+        if not self.enabled:
+            return
+        self._m_grad_buckets.inc()
+        self._m_grad_bucket_bytes.observe(float(nbytes))
 
     # -- checkpoints ----------------------------------------------------------
 

@@ -729,3 +729,65 @@ class HostSyncInSpan(Rule):
         for stmt in body:
             walk(stmt)
         return hits
+
+
+@register
+class CollectiveInParamLoop(Rule):
+    id = "TPU014"
+    name = "unfused-collective-in-param-loop"
+    rationale = ("a psum/all_reduce per parameter inside a Python loop "
+                 "emits hundreds of latency-bound small collectives per "
+                 "step — each pays the full ICI round-trip for a few KB; "
+                 "flat-concat the group and reduce once per size-targeted "
+                 "bucket (distributed/grad_buckets.py), which also gives "
+                 "the latency-hiding scheduler one fusible op to overlap")
+
+    # reduction-family collectives (jax.lax + this repo's wrappers);
+    # matched on the last dotted component so `lax.psum`, `dist.
+    # all_reduce` and bare `psum` all hit
+    _COLLECTIVES = {"psum", "pmean", "psum_scatter", "all_reduce",
+                    "all_gather", "reduce_scatter"}
+    # the loop looks per-parameter: its target/iterable mentions
+    # params/grads/weights (model.parameters(), grads.items(), ...)
+    _PARAM_ITER = re.compile(
+        r"(param|grad|weight|named_parameters|state_dict|\.values\(\))",
+        re.IGNORECASE)
+
+    def _per_param(self, node):
+        try:
+            text = ast.unparse(node.target) + " " + ast.unparse(node.iter)
+        except Exception:
+            return False
+        return bool(self._PARAM_ITER.search(text))
+
+    def on_for(self, node, ctx):
+        if not ctx.library_path:
+            return
+        if not self._per_param(node):
+            return
+        for call, name in self._collective_calls(node.body):
+            ctx.report(call, self.id,
+                       f"{name}() per parameter in a Python loop; "
+                       f"flat-concat the group and emit ONE reduction "
+                       f"per bucket (distributed/grad_buckets.py "
+                       f"partition_buckets/apply_bucketed_reduction)")
+
+    def _collective_calls(self, body):
+        hits = []
+
+        def walk(n):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+                return  # deferred execution — not per-iteration work
+            if isinstance(n, ast.For) and self._per_param(n):
+                return  # the nested loop's own on_for event reports it
+            if isinstance(n, ast.Call):
+                name = dotted(n.func)
+                if name.rpartition(".")[2] in self._COLLECTIVES:
+                    hits.append((n, name))
+            for c in ast.iter_child_nodes(n):
+                walk(c)
+
+        for stmt in body:
+            walk(stmt)
+        return hits

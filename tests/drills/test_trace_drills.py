@@ -76,3 +76,28 @@ def test_trace_drill_per_rank_artifacts(tmp_path):
             flight = json.load(f)
         assert flight["reason"] == "drill-exit"
         assert len(flight["spans"]) == 4 * 4  # 4 phases x 4 steps
+
+
+def test_overlap_drill_bucketing_raises_overlap(tmp_path):
+    """GC3 optimization acceptance: on the same synthetic model the
+    bucketed reduction's measured overlap fraction is strictly above
+    the monolithic reduction's (which is exactly 0 — the single
+    all-reduce has no compute left to hide under)."""
+    from paddle_tpu.distributed.drill import run_overlap_drill
+    report = run_overlap_drill(str(tmp_path / "overlap"))
+    assert report["overlap_unbucketed"] == 0.0
+    assert report["overlap_bucketed"] > 0.5
+    assert report["n_buckets"] >= 2
+    with open(report["report_path"], "r", encoding="utf-8") as f:
+        assert json.load(f)["overlap_bucketed"] == \
+            report["overlap_bucketed"]
+
+
+def test_overlap_drill_rejects_single_bucket(tmp_path):
+    """A target so large everything lands in one bucket can't show
+    overlap — the drill must refuse, not vacuously pass."""
+    from paddle_tpu.distributed.drill import run_overlap_drill
+    from paddle_tpu.distributed.drill.runner import DrillFailure
+    with pytest.raises(DrillFailure):
+        run_overlap_drill(str(tmp_path / "overlap1"),
+                          bucket_kb=1 << 20)

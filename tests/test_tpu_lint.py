@@ -255,6 +255,24 @@ FIXTURES = {
             return loss.item()
         """,
     ),
+    "TPU014": (
+        "paddle_tpu/distributed/mod.py",
+        """
+        import jax.lax as lax
+        def reduce_grads(grads):
+            out = {}
+            for name, g in grads.items():
+                out[name] = lax.psum(g, "dp")
+            return out
+        """,
+        """
+        import jax.numpy as jnp
+        import jax.lax as lax
+        def reduce_grads(grads, plan):
+            flat = jnp.concatenate([jnp.ravel(g) for g in grads.values()])
+            return lax.psum(flat, "dp")
+        """,
+    ),
 }
 
 
@@ -565,6 +583,71 @@ def test_tpu013_suppression_comment():
             return model(x).item()  # tpu-lint: disable=TPU013
     """
     assert "TPU013" not in rules_fired(src)
+
+
+def test_tpu014_fires_on_repo_all_reduce_wrapper():
+    src = """
+    import paddle_tpu.distributed as dist
+    def sync_grads(model):
+        for p in model.parameters():
+            dist.all_reduce(p.grad)
+    """
+    assert "TPU014" in rules_fired(src, path="paddle_tpu/x.py")
+
+
+def test_tpu014_silent_on_non_param_loop():
+    src = """
+    import jax.lax as lax
+    def losses(batches):
+        return [lax.pmean(b, "dp") for b in batches] + [
+            lax.psum(b, "dp") for b in batches]
+    def accumulate(batches):
+        tot = 0
+        for b in batches:
+            tot = tot + lax.psum(b, "dp")
+        return tot
+    """
+    assert "TPU014" not in rules_fired(src, path="paddle_tpu/x.py")
+
+
+def test_tpu014_silent_outside_library_code():
+    src = """
+    import jax.lax as lax
+    def check(grads):
+        for g in grads.values():
+            assert lax.psum(g, "dp") is not None
+    """
+    assert "TPU014" not in rules_fired(src, path="tests/test_x.py")
+    assert "TPU014" not in rules_fired(src, path="paddle_tpu/tools/x.py")
+
+
+def test_tpu014_nested_param_loop_reports_once_per_call():
+    from paddle_tpu.tools.lint import lint_source
+    import textwrap
+    src = textwrap.dedent("""
+    import jax.lax as lax
+    def sync(groups):
+        for group in groups.values():
+            for name, g in group.grads.items():
+                g = lax.psum(g, "dp")
+    """)
+    hits = [v for v in lint_source(src, path="paddle_tpu/x.py")
+            if v.rule == "TPU014"]
+    assert len(hits) == 1
+
+
+def test_tpu014_silent_on_deferred_def_in_param_loop():
+    src = """
+    import jax.lax as lax
+    def build_hooks(params):
+        hooks = []
+        for p in params:
+            def hook(g):
+                return lax.psum(g, "dp")
+            hooks.append(hook)
+        return hooks
+    """
+    assert "TPU014" not in rules_fired(src, path="paddle_tpu/x.py")
 
 
 # -- suppressions ------------------------------------------------------------
