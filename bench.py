@@ -495,7 +495,9 @@ def bench_ring(result):
     import numpy as np
     import jax
     import jax.numpy as jnp
-    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.sharding import Mesh
+    from paddle_tpu.distributed.auto_parallel.spec_layout import \
+        default_layout
     from paddle_tpu.distributed.fleet.meta_parallel.sequence_parallel \
         import ring_attention
 
@@ -512,10 +514,11 @@ def bench_ring(result):
 
     def fwd_bwd(q, k, v):
         def loss(q):
+            ring_spec = default_layout().seq_heads(ndim=4, seq_dim=2)
             out = _shard_map(
                 lambda a, b, c: ring_attention(a, b, c, causal=True),
-                mesh=mesh, in_specs=(P(None, None, "sep"),) * 3,
-                out_specs=P(None, None, "sep"))(q, k, v)
+                mesh=mesh, in_specs=(ring_spec,) * 3,
+                out_specs=ring_spec)(q, k, v)
             return jnp.sum(out.astype(jnp.float32)), out
         (s, out), dq = jax.value_and_grad(loss, has_aux=True)(q)
         return s, dq

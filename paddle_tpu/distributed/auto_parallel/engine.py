@@ -128,13 +128,14 @@ class Engine:
                 raise ValueError("Engine.fit requires a loss")
             from ..fleet.base.distributed_strategy import \
                 strategy_overlap_setup
-            bucket_mb, pp_overlap = strategy_overlap_setup(s)
+            bucket_mb, pp_overlap, coll_sched = strategy_overlap_setup(s)
             self._step_fn, self._state = build_train_step(
                 self._model, self._loss_adapter, self._optimizer,
                 mesh=mesh, pipeline_microbatches=n_micro,
                 scaler=self._scaler, pipeline_virtual_stages=v_pp,
                 autocast=autocast, grad_bucket_mb=bucket_mb,
-                pipeline_overlap=pp_overlap)
+                pipeline_overlap=pp_overlap,
+                collective_schedule=coll_sched)
         return self
 
     def _loss_adapter(self, out, *labels):

@@ -1,0 +1,235 @@
+"""Canonical dp×fsdp×tp ``PartitionSpec`` layout engine.
+
+One authoritative table of partition specs for transformer-block
+parameters and activations, replacing the ad-hoc per-call-site
+``PartitionSpec`` construction that used to live in the TP layers, the
+bench models and the bench harness (SNIPPETS [3] is the exemplar: a
+frozen ``SpecLayout`` whose methods name the ROLE — qkv, attn-out,
+ffn up/down — instead of the axes).  Why a table and not inline specs:
+
+ - axis NAMES live in exactly one place, so renaming a mesh axis (or
+   running a model annotated for tp on a dp-only mesh) cannot fork
+   between call sites;
+ - the Megatron pairing rules (column-parallel out-dim over tp, its
+   bias with it; row-parallel in-dim over tp, its bias replicated) are
+   encoded once, reviewable once;
+ - the fsdp placement and the ZeRO optimizer-state placement share ONE
+   rule (:func:`place_axis` — largest free dim divisible by the axis
+   size), so parameter and state shards always align.
+
+Everything here is mesh-free and jax-light (only ``PartitionSpec`` is
+imported): the module is a leaf, importable from anywhere in the
+package without cycles.  Validity against a concrete mesh (dropping
+absent axes, divisibility fallback) is :func:`resolve_spec` — the one
+resolution path ``train_step.param_shardings`` and the checkpoint
+loader both use.
+
+tpu-lint rule TPU015 enforces consumption: model/bench code building a
+``PartitionSpec`` inline instead of asking this table is flagged.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["SpecLayout", "default_layout", "resolve_spec", "place_axis",
+           "spec_axes"]
+
+
+def spec_axes(entry):
+    """Mesh axis names of ONE PartitionSpec entry (str | tuple | None)."""
+    if entry is None:
+        return ()
+    if isinstance(entry, tuple):
+        return tuple(entry)
+    return (entry,)
+
+
+def place_axis(spec, shape, n, axis):
+    """Insert ``axis`` on the largest dim of ``shape`` that is free in
+    ``spec`` and divisible by ``n`` — the canonical fsdp/ZeRO placement
+    rule (largest dim ⇒ biggest per-device byte win; divisibility ⇒ the
+    shard is exact, never padded).
+
+    Returns ``spec`` unchanged when ``n <= 1``, when ``axis`` already
+    appears (a param fsdp-sharded up front keeps its placement — the
+    optimizer state then inherits it), or when no free dim divides
+    (replicated leaf, e.g. a rank-1 bias of odd length).
+    """
+    if n <= 1:
+        return spec
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    if any(axis in spec_axes(e) for e in entries):
+        return spec
+    for d in sorted(range(len(shape)), key=lambda d: -shape[d]):
+        if entries[d] is None and shape[d] % n == 0:
+            entries[d] = axis
+            return P(*entries)
+    return spec
+
+
+def resolve_spec(spec, shape, mesh):
+    """Canonicalize an annotation against a concrete mesh: drop axis
+    names the mesh doesn't have (or has at size 1), and fall back to
+    replicated when a kept axis doesn't divide its dim.  ``None`` means
+    un-annotated → replicated."""
+    if spec is None:
+        return P()
+    axes = []
+    for entry in spec:
+        if entry is None:
+            axes.append(None)
+        elif isinstance(entry, tuple):
+            kept = tuple(a for a in entry if a in mesh.shape
+                         and mesh.shape[a] > 1)
+            axes.append(kept if kept else None)
+        else:
+            axes.append(entry if entry in mesh.shape
+                        and mesh.shape[entry] > 1 else None)
+    for d, a in enumerate(axes):
+        names = spec_axes(a)
+        size = int(np.prod([mesh.shape[nm] for nm in names])) if names else 1
+        if size > 1 and shape[d] % size:
+            return P()
+    return P(*axes)
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecLayout:
+    """Canonical PartitionSpecs for transformer-block parameters and
+    activations over a ``data × fsdp × tp (× sep)`` mesh.
+
+    Axis defaults match this repo's hybrid mesh names
+    (``mesh.HYBRID_AXES``): ``dp`` for data, ``sharding`` for
+    fsdp/ZeRO, ``mp`` for tensor parallel, ``sep`` for sequence
+    parallel.  Instantiate with other names to retarget a differently
+    labelled mesh — every consumer keys off the layout, not the
+    literal strings.
+
+    Parameter methods take ``fsdp=True`` to additionally place the
+    fsdp axis on the conventional free dim of that role (the dim NOT
+    carrying tp).  Weight convention is this repo's ``Linear``:
+    ``[in_features, out_features]``.
+    """
+
+    data_axis: str = "dp"
+    fsdp_axis: str = "sharding"
+    tp_axis: str = "mp"
+    sep_axis: str = "sep"
+
+    # -- embeddings ---------------------------------------------------------
+    def vocab_embedding(self, fsdp=False):
+        """``[vocab, hidden]`` — vocab dim over tp (VocabParallel)."""
+        return P(self.tp_axis, self.fsdp_axis if fsdp else None)
+
+    def position_embedding(self, fsdp=False):
+        """``[positions, hidden]`` — replicated over tp."""
+        return P(self.fsdp_axis if fsdp else None, None)
+
+    # -- attention ----------------------------------------------------------
+    def qkv_weight(self, fsdp=False):
+        """``[hidden, 3*hidden]`` — column parallel: out dim over tp."""
+        return P(self.fsdp_axis if fsdp else None, self.tp_axis)
+
+    def qkv_bias(self):
+        """``[3*hidden]`` — follows the column shards."""
+        return P(self.tp_axis)
+
+    def attn_out_weight(self, fsdp=False):
+        """``[hidden, hidden]`` — row parallel: in dim over tp."""
+        return P(self.tp_axis, self.fsdp_axis if fsdp else None)
+
+    def attn_out_bias(self):
+        """``[hidden]`` — replicated; added after the row reduce."""
+        return P()
+
+    # -- mlp ----------------------------------------------------------------
+    def ffn_up_weight(self, fsdp=False):
+        """``[hidden, 4*hidden]`` — column parallel."""
+        return P(self.fsdp_axis if fsdp else None, self.tp_axis)
+
+    def ffn_up_bias(self):
+        return P(self.tp_axis)
+
+    def ffn_down_weight(self, fsdp=False):
+        """``[4*hidden, hidden]`` — row parallel."""
+        return P(self.tp_axis, self.fsdp_axis if fsdp else None)
+
+    def ffn_down_bias(self):
+        return P()
+
+    # -- norms / head -------------------------------------------------------
+    def norm(self):
+        """LayerNorm scale/bias — always replicated (tiny, hot)."""
+        return P()
+
+    def lm_head(self, fsdp=False):
+        """``[hidden, vocab]`` — vocab dim over tp (tied or untied)."""
+        return P(self.fsdp_axis if fsdp else None, self.tp_axis)
+
+    # -- generic megatron roles (what the parallel layer classes ask) -------
+    def column_weight(self, fsdp=False):
+        return P(self.fsdp_axis if fsdp else None, self.tp_axis)
+
+    def column_bias(self):
+        return P(self.tp_axis)
+
+    def row_weight(self, fsdp=False):
+        return P(self.tp_axis, self.fsdp_axis if fsdp else None)
+
+    def row_bias(self):
+        return P()
+
+    # -- activations / data -------------------------------------------------
+    def batch(self, ndim=2):
+        """Input batch: leading dim over data; rest replicated."""
+        return P(self.data_axis, *([None] * (ndim - 1)))
+
+    def batch_seq(self, ndim=2):
+        """``[batch, seq, ...]`` activations: batch over data, seq over
+        sep (long-context sequence parallelism)."""
+        return P(self.data_axis, self.sep_axis, *([None] * (ndim - 2)))
+
+    def seq_heads(self, ndim=4, seq_dim=2):
+        """``[B, H, S, D]``-shaped attention operands with the sequence
+        dim over sep (ring attention's ring dimension)."""
+        entries = [None] * ndim
+        entries[seq_dim] = self.sep_axis
+        return P(*entries)
+
+    # -- derived placements -------------------------------------------------
+    def with_fsdp(self, spec, shape):
+        """``spec`` with the fsdp axis placed per :func:`place_axis`,
+        sized by the ambient mesh (no-op when the axis is absent/1)."""
+        from .. import mesh as _mesh_mod
+        n = _mesh_mod.mesh_axis_size(self.fsdp_axis)
+        return place_axis(spec if spec is not None else P(), shape, n,
+                          self.fsdp_axis)
+
+    def zero_spec(self, spec, shape, n):
+        """Optimizer-state placement for ZeRO: the param's spec with
+        the fsdp axis added per :func:`place_axis` (shared rule ⇒ state
+        shards always align with fsdp param shards)."""
+        return place_axis(spec, shape, n, self.fsdp_axis)
+
+    def annotate_fsdp(self, layer, min_size=1024):
+        """Annotate every parameter of ``layer`` (≥ ``min_size``
+        elements) with an fsdp placement on top of any existing spec
+        (the ``annotate_fsdp_specs`` walk, keyed by this layout's
+        axis name)."""
+        from ..fleet.meta_parallel.sharding_parallel import \
+            annotate_fsdp_specs
+        return annotate_fsdp_specs(layer, axis=self.fsdp_axis,
+                                   min_size=min_size)
+
+
+_DEFAULT = SpecLayout()
+
+
+def default_layout() -> SpecLayout:
+    """The process-wide canonical layout (this repo's hybrid axis
+    names).  Models targeting a custom-named mesh construct their own
+    :class:`SpecLayout` instead of mutating this one."""
+    return _DEFAULT

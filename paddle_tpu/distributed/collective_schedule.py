@@ -1,0 +1,135 @@
+"""Mesh-aware collective schedule planning (GC3 mold).
+
+XLA's default lowering of a gradient reduction on a hybrid mesh is a
+single fused collective over the product communicator — correct, but
+blind to topology: it moves the FULL gradient payload across the
+slowest link and gives the scheduler one monolithic op to overlap.
+GC3-style planning instead composes the reduction from per-axis stages
+ordered fast-link-first:
+
+    reduce_scatter(ici axis)   # full payload, but over fast in-node ICI
+    all_reduce(dcn axes)       # only 1/n of the payload crosses DCN
+    all_gather(ici axis)       # reassemble over ICI
+
+The payload crossing the slow data-parallel links shrinks by the
+sharding-axis size, and each stage is a separately schedulable op the
+latency-hiding scheduler can overlap with backward compute.
+
+This module is the *planner*: pure metadata, no jax imports, safe to
+call at trace time.  Execution lives in the per-bucket ``custom_vjp``
+markers in :mod:`paddle_tpu.distributed.grad_buckets`, which interpret
+a :class:`CollectiveSchedule` stage list inside their transpose.
+
+Topology heuristic: TPU mesh axes are ICI (in-slice) unless named in
+``PT_DCN_AXES`` (comma-separated; default ``dp,pp`` — data and
+pipeline parallelism are the axes conventionally mapped across slices
+/ hosts).  ``PT_COLLECTIVE_SCHEDULE=0`` is the kill switch: planning
+returns ``None`` and callers fall back to the pre-PR-11 behavior
+(pure-dp bucketing only; GSPMD owns sharded-mesh reductions).
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+
+__all__ = [
+    "Stage", "CollectiveSchedule", "schedule_enabled", "dcn_axes",
+    "plan_grad_reduction",
+]
+
+_DEFAULT_DCN_AXES = ("dp", "pp")
+
+
+@dataclasses.dataclass(frozen=True)
+class Stage:
+    """One collective in a planned reduction: ``op`` over mesh ``axis``.
+
+    ``op`` ∈ {"reduce_scatter", "all_reduce", "all_gather"}.  ``size``
+    is the axis size the plan was made for (recorded so executors can
+    sanity-check against the mesh they run on).
+    """
+    op: str
+    axis: str
+    size: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveSchedule:
+    """An ordered stage list for one logical gradient reduction, plus
+    the bookkeeping executors need: ``shard_axis``/``shard_size`` name
+    the axis whose reduce-scatter windows are the ZeRO optimizer-state
+    shards (None when the plan is a plain all-reduce)."""
+
+    stages: tuple = ()
+    shard_axis: str | None = None
+    shard_size: int = 1
+
+    @property
+    def scatters(self) -> bool:
+        return any(s.op == "reduce_scatter" for s in self.stages)
+
+    @property
+    def kind(self) -> str:
+        """Reduction kind label for telemetry (`pt_grad_buckets_total`)."""
+        return "reduce_scatter" if self.scatters else "all_reduce"
+
+    def describe(self) -> str:
+        return " -> ".join(f"{s.op}({s.axis}:{s.size})"
+                           for s in self.stages) or "noop"
+
+
+def schedule_enabled(flag=None) -> bool:
+    """Is collective-schedule planning on?  ``flag`` (a strategy-level
+    override, e.g. ``sharding_configs.comm_overlap``) can force it off;
+    the ``PT_COLLECTIVE_SCHEDULE`` env var (default on) is the global
+    kill switch and wins over everything."""
+    if os.environ.get("PT_COLLECTIVE_SCHEDULE", "1") in ("0", "false",
+                                                         "False"):
+        return False
+    if flag is not None and not flag:
+        return False
+    return True
+
+
+def dcn_axes() -> tuple:
+    """Mesh axes assumed to cross slow (DCN / cross-host) links.
+    ``PT_DCN_AXES`` overrides the ``dp,pp`` default, e.g.
+    ``PT_DCN_AXES=dp`` on a single-pod multi-slice job."""
+    raw = os.environ.get("PT_DCN_AXES")
+    if raw is None:
+        return _DEFAULT_DCN_AXES
+    return tuple(a.strip() for a in raw.split(",") if a.strip())
+
+
+def plan_grad_reduction(axis_sizes, zero=None, enabled=None):
+    """Plan the per-bucket gradient reduction for a mesh.
+
+    ``axis_sizes`` maps mesh axis name -> size (only dp/sharding
+    participate in grad reduction; mp/sep/ep gradients are handled by
+    GSPMD inside the model and make the mesh ineligible upstream).
+    ``zero`` is the repo's ZeRO level marker ("os", "os_g", or None).
+
+    Returns ``None`` when planning is disabled or there is nothing to
+    plan (single device).  Otherwise a :class:`CollectiveSchedule`:
+
+    - dp only, no ZeRO:       all_reduce(dp)           (PR 10 plan)
+    - dp × sharding + ZeRO:   reduce_scatter(sharding) -> all_reduce(dp)
+                              -> all_gather(sharding)  (hierarchical)
+    - sharding only + ZeRO:   reduce_scatter -> all_gather
+    """
+    if not schedule_enabled(enabled):
+        return None
+    n_dp = int(axis_sizes.get("dp", 1))
+    n_sh = int(axis_sizes.get("sharding", 1))
+    if zero is not None and n_sh > 1:
+        stages = [Stage("reduce_scatter", "sharding", n_sh)]
+        if n_dp > 1:
+            stages.append(Stage("all_reduce", "dp", n_dp))
+        stages.append(Stage("all_gather", "sharding", n_sh))
+        return CollectiveSchedule(tuple(stages), shard_axis="sharding",
+                                  shard_size=n_sh)
+    if n_dp > 1 and n_sh <= 1 and zero is None:
+        return CollectiveSchedule((Stage("all_reduce", "dp", n_dp),))
+    # remaining shapes (single device; ZeRO without a sharding axis;
+    # sharded mesh without ZeRO) keep their pre-existing reduction path
+    return None

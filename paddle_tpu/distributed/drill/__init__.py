@@ -75,11 +75,17 @@ optimization half of GC3: the span timelines pinned down by the
 bucketed vs monolithic gradient reduction (real ``partition_buckets``
 output, synthetic timestamps) feed the real tracer, proving the
 measured ``pt_compute_collective_overlap_fraction`` is strictly
-higher with bucketing enabled than disabled.
+higher with bucketing enabled than disabled.  The sharded-mesh
+variant (:func:`.runner.run_sharded_overlap_drill`) replays the ZeRO
+dp×sharding timelines — the GSPMD monolithic reduction vs the
+planned per-bucket ``reduce_scatter → all_reduce → all_gather``
+schedule — and proves the scheduled buckets lift overlap from 0 to
+above one half.
 """
 __all__ = ["KillSpec", "StoreKillSpec", "ObsSpec", "TraceSpec",
            "run_drill", "run_store_kill_drill", "run_scrape_drill",
-           "run_trace_drill", "run_overlap_drill", "spawn_worker",
+           "run_trace_drill", "run_overlap_drill",
+           "run_sharded_overlap_drill", "spawn_worker",
            "spawn_store_master", "spawn_aggregator", "reap_all"]
 
 

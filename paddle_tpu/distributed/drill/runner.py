@@ -37,7 +37,7 @@ __all__ = ["KillSpec", "StoreKillSpec", "ObsSpec", "TraceSpec",
            "DrillFailure", "spawn_worker", "spawn_store_master",
            "spawn_aggregator", "run_drill", "run_store_kill_drill",
            "run_scrape_drill", "run_trace_drill", "run_overlap_drill",
-           "reap_all"]
+           "run_sharded_overlap_drill", "reap_all"]
 
 logger = logging.getLogger(__name__)
 
@@ -1059,6 +1059,61 @@ def run_trace_drill(root, *, world=2, steps=6, step_ms=10.0,
     return report
 
 
+def _overlap_param_tree(layers, hidden):
+    """Synthetic MLP parameter tree (registration order: first→last)
+    plus per-name and total byte counts."""
+    import numpy as np
+
+    params = {}
+    for i in range(layers):
+        params[f"l{i}.weight"] = np.zeros((hidden, hidden), np.float32)
+        params[f"l{i}.bias"] = np.zeros((hidden,), np.float32)
+    nbytes = {k: v.size * v.dtype.itemsize for k, v in params.items()}
+    return params, nbytes, sum(nbytes.values())
+
+
+def _overlap_replay(params, nbytes, spans_fn, run_id,
+                    compute_bytes_per_ns):
+    """Replay one reduction mode's span timeline through the REAL
+    tracer and return its snapshot.
+
+    The backward is a per-param compute span, last-registered first
+    (the order autodiff produces grads); ``spans_fn(tr, ready,
+    bwd_end) -> coll_end`` records that mode's collective spans given
+    each grad's ready time; the optimizer span starts after the last
+    collective (it waits for every reduced grad)."""
+    from ...observability.trace import get_tracer, reset_tracer
+
+    total_bytes = sum(nbytes.values())
+    reset_tracer()
+    tr = get_tracer().enable(process_index=0, run_id=run_id)
+    t, ready = 1_000_000, {}
+    for name in reversed(params):
+        dur = max(int(nbytes[name] / compute_bytes_per_ns), 1)
+        tr.phase_record("backward", t, t + dur)
+        t += dur
+        ready[name] = t
+    coll_end = max(spans_fn(tr, ready, t), t)
+    opt_end = coll_end + max(int(total_bytes / compute_bytes_per_ns
+                                 / 10), 1)
+    tr.phase_record("optimizer", coll_end, opt_end)
+    tr.on_step((opt_end - 1_000_000) / 1e9)
+    snap = tr.snapshot()
+    reset_tracer()
+    return snap
+
+
+def _write_overlap_report(root, name, report):
+    os.makedirs(root, exist_ok=True)
+    path = os.path.join(root, name)
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(report, f, indent=2)
+    os.replace(tmp, path)
+    report["report_path"] = path
+    return report
+
+
 def run_overlap_drill(root, *, layers=8, hidden=256, bucket_kb=256,
                       comm_bytes_per_ns=2.0, compute_bytes_per_ns=1.0):
     """Compute↔collective overlap drill: prove the bucketed gradient
@@ -1085,66 +1140,34 @@ def run_overlap_drill(root, *, layers=8, hidden=256, bucket_kb=256,
     drill asserts bucketed > unbucketed ≥ 0 and writes a report JSON.
     Returns the report dict.
     """
-    import numpy as np
-
-    from ...observability.trace import get_tracer, reset_tracer
     from ..grad_buckets import partition_buckets
 
-    # synthetic MLP parameter tree (registration order: first→last)
-    params = {}
-    for i in range(layers):
-        params[f"l{i}.weight"] = np.zeros((hidden, hidden), np.float32)
-        params[f"l{i}.bias"] = np.zeros((hidden,), np.float32)
-    nbytes = {k: v.size * v.dtype.itemsize for k, v in params.items()}
-    total_bytes = sum(nbytes.values())
+    params, nbytes, total_bytes = _overlap_param_tree(layers, hidden)
     plan = partition_buckets(params, int(bucket_kb) * 1024)
     if plan.n_buckets < 2:
         raise DrillFailure(
             f"bucket_kb={bucket_kb} yields {plan.n_buckets} bucket(s); "
             f"the drill needs >= 2 to show overlap")
 
-    def backward_schedule(tr, base):
-        """Per-param backward compute spans, last-registered first
-        (the order autodiff produces grads). Returns (grad-ready time
-        per name, backward end)."""
-        t, ready = base, {}
-        for name in reversed(params):
-            dur = max(int(nbytes[name] / compute_bytes_per_ns), 1)
-            tr.phase_record("backward", t, t + dur)
-            t += dur
-            ready[name] = t
-        return ready, t
+    def unbucketed(tr, ready, bwd_end):
+        dur = max(int(total_bytes / comm_bytes_per_ns), 1)
+        tr.record_span("all_reduce", "collective", bwd_end,
+                       bwd_end + dur)
+        return bwd_end + dur
 
-    def replay(mode):
-        reset_tracer()
-        tr = get_tracer().enable(process_index=0,
-                                 run_id=f"overlap-{mode}")
-        base = 1_000_000
-        ready, bwd_end = backward_schedule(tr, base)
+    def bucketed(tr, ready, bwd_end):
         coll_end = bwd_end
-        if mode == "unbucketed":
-            dur = max(int(total_bytes / comm_bytes_per_ns), 1)
-            tr.record_span("all_reduce", "collective", bwd_end,
-                           bwd_end + dur)
-            coll_end = bwd_end + dur
-        else:
-            for b in plan.buckets:
-                t0 = max(ready[n] for n in b.names)
-                dur = max(int(b.nbytes / comm_bytes_per_ns), 1)
-                tr.record_span("all_reduce", "collective", t0, t0 + dur)
-                coll_end = max(coll_end, t0 + dur)
-        # optimizer waits for every reduced grad (compute category, but
-        # after the last collective by construction)
-        opt_end = coll_end + max(int(total_bytes / compute_bytes_per_ns
-                                     / 10), 1)
-        tr.phase_record("optimizer", coll_end, opt_end)
-        tr.on_step((opt_end - base) / 1e9)
-        snap = tr.snapshot()
-        reset_tracer()
-        return snap
+        for b in plan.buckets:
+            t0 = max(ready[n] for n in b.names)
+            dur = max(int(b.nbytes / comm_bytes_per_ns), 1)
+            tr.record_span("all_reduce", "collective", t0, t0 + dur)
+            coll_end = max(coll_end, t0 + dur)
+        return coll_end
 
-    snap_un = replay("unbucketed")
-    snap_bk = replay("bucketed")
+    snap_un = _overlap_replay(params, nbytes, unbucketed,
+                              "overlap-unbucketed", compute_bytes_per_ns)
+    snap_bk = _overlap_replay(params, nbytes, bucketed,
+                              "overlap-bucketed", compute_bytes_per_ns)
     ov_un = snap_un.get("overlap_fraction")
     ov_bk = snap_bk.get("overlap_fraction")
     if ov_un is None or ov_bk is None:
@@ -1164,11 +1187,115 @@ def run_overlap_drill(root, *, layers=8, hidden=256, bucket_kb=256,
         "overlap_unbucketed": ov_un,
         "overlap_bucketed": ov_bk,
     }
-    os.makedirs(root, exist_ok=True)
-    path = os.path.join(root, "overlap_report.json")
-    tmp = f"{path}.tmp{os.getpid()}"
-    with open(tmp, "w", encoding="utf-8") as f:
-        json.dump(report, f, indent=2)
-    os.replace(tmp, path)
-    report["report_path"] = path
-    return report
+    return _write_overlap_report(root, "overlap_report.json", report)
+
+
+def run_sharded_overlap_drill(root, *, layers=8, hidden=256,
+                              bucket_kb=256, n_dp=2, n_shard=4,
+                              ici_bytes_per_ns=4.0, dcn_bytes_per_ns=1.0,
+                              compute_bytes_per_ns=1.0):
+    """Sharded-mesh (ZeRO dp×sharding) overlap drill.
+
+    Same replay harness as :func:`run_overlap_drill`, but the two
+    timelines are the ones the collective-schedule pass chooses
+    between on a ZeRO mesh:
+
+    - *unbucketed (GSPMD)*: backward runs end-to-end, then ONE
+      monolithic reduction of every gradient byte over the product
+      communicator — the full payload crosses the slow dp links and
+      nothing hides it: overlap 0.
+    - *bucketed + scheduled*: the REAL partitioner (with the params'
+      ``place_axis`` scatter dims) and the REAL planner
+      (:func:`~paddle_tpu.distributed.collective_schedule.
+      plan_grad_reduction`) produce per-bucket
+      ``reduce_scatter(sharding) → all_reduce(dp) → all_gather``
+      chains, each issued at its bucket's grad-ready time.  The
+      reduce-scatter/all-gather legs move at ICI speed and the dp leg
+      carries only ``1/n_shard`` of the bytes at DCN speed, while the
+      remaining backward hides all but the last bucket's chain.
+
+    Asserts the scheduled overlap is strictly above the monolithic
+    baseline AND above 0.5 — the bar ``dryrun_multichip`` reports for
+    sharded configs.  Writes/returns the report dict.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..auto_parallel.spec_layout import place_axis, spec_axes
+    from ..collective_schedule import plan_grad_reduction
+    from ..grad_buckets import partition_buckets
+
+    params, nbytes, total_bytes = _overlap_param_tree(layers, hidden)
+    scatter_dims = {}
+    for k, v in params.items():
+        zs = place_axis(P(), v.shape, n_shard, "sharding")
+        scatter_dims[k] = next(
+            (d for d, e in enumerate(zs) if "sharding" in spec_axes(e)),
+            None)
+    plan = partition_buckets(params, int(bucket_kb) * 1024,
+                             scatter_dims=scatter_dims)
+    sched = plan_grad_reduction({"dp": n_dp, "sharding": n_shard}, "os")
+    if sched is None or not sched.scatters:
+        raise DrillFailure(
+            f"planner produced no scatter schedule for dp={n_dp} "
+            f"sharding={n_shard}")
+    if plan.n_buckets < 2:
+        raise DrillFailure(
+            f"bucket_kb={bucket_kb} yields {plan.n_buckets} bucket(s); "
+            f"the drill needs >= 2 to show overlap")
+
+    def unbucketed(tr, ready, bwd_end):
+        # GSPMD's monolithic post-backward reduction: every byte over
+        # the slow link, one op, nothing left to hide it under
+        dur = max(int(total_bytes / dcn_bytes_per_ns), 1)
+        tr.record_span("all_reduce", "collective", bwd_end,
+                       bwd_end + dur)
+        return bwd_end + dur
+
+    def scheduled(tr, ready, bwd_end):
+        coll_end = bwd_end
+        for b in plan.buckets:
+            t = max(ready[n] for n in b.names)
+            for st in sched.stages:
+                if b.kind != "reduce_scatter" and st.op != "all_reduce":
+                    continue  # unscatterable buckets: plain dp pmean
+                payload = b.nbytes
+                if b.kind == "reduce_scatter" and st.op != "reduce_scatter":
+                    payload = b.nbytes // sched.shard_size
+                rate = (dcn_bytes_per_ns if st.axis == "dp"
+                        else ici_bytes_per_ns)
+                dur = max(int(payload / rate), 1)
+                tr.record_span(st.op, "collective", t, t + dur)
+                t += dur
+            coll_end = max(coll_end, t)
+        return coll_end
+
+    snap_un = _overlap_replay(params, nbytes, unbucketed,
+                              "sharded-overlap-unbucketed",
+                              compute_bytes_per_ns)
+    snap_bk = _overlap_replay(params, nbytes, scheduled,
+                              "sharded-overlap-scheduled",
+                              compute_bytes_per_ns)
+    ov_un = snap_un.get("overlap_fraction")
+    ov_bk = snap_bk.get("overlap_fraction")
+    if ov_un is None or ov_bk is None:
+        raise DrillFailure(
+            f"tracer measured no overlap fraction (unbucketed={ov_un!r} "
+            f"scheduled={ov_bk!r}) — collective spans missing?")
+    if not ov_bk > ov_un:
+        raise DrillFailure(
+            f"scheduled overlap {ov_bk} not strictly above the "
+            f"monolithic baseline {ov_un}")
+    if not ov_bk > 0.5:
+        raise DrillFailure(
+            f"scheduled overlap {ov_bk} below the 0.5 bar")
+    report = {
+        "n_buckets": plan.n_buckets,
+        "bucket_bytes": [b.nbytes for b in plan.buckets],
+        "total_bytes": total_bytes,
+        "schedule": sched.describe(),
+        "mesh": {"dp": n_dp, "sharding": n_shard},
+        "overlap_unbucketed": ov_un,
+        "overlap_scheduled": ov_bk,
+    }
+    return _write_overlap_report(root, "sharded_overlap_report.json",
+                                 report)

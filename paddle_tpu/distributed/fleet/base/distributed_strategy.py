@@ -40,10 +40,14 @@ class DistributedStrategy:
         # recompute
         self.recompute = False
         self.recompute_configs = {"checkpoints": [], "enable_offload": False}
-        # sharding (ZeRO)
+        # sharding (ZeRO). comm_overlap (ref group_sharded knob of the
+        # same name) enables the mesh-aware collective-schedule pass —
+        # reduce-scatter bucketing on dp×sharding meshes; the
+        # PT_COLLECTIVE_SCHEDULE env kill switch wins over it
         self.sharding = False
         self.sharding_configs = {"stage": 1, "degree": 8,
-                                 "offload": False}
+                                 "offload": False,
+                                 "comm_overlap": True}
         # pipeline
         self.pipeline = False
         self.pipeline_configs = {"accumulate_steps": 1,
@@ -93,21 +97,27 @@ class DistributedStrategy:
 
 def strategy_overlap_setup(strategy):
     """Translate the strategy's comm-overlap knobs for
-    ``build_train_step``: returns ``(grad_bucket_mb, pipeline_overlap)``.
+    ``build_train_step``: returns ``(grad_bucket_mb, pipeline_overlap,
+    collective_schedule)``.
 
     ``grad_bucket_mb``: the bucketed-reduction size target —
     ``fuse_grad_size_in_MB`` when ``fuse_all_reduce_ops`` is on, else 0
     (disabled). ``pipeline_overlap``:
     ``pipeline_configs["overlap_p2p_comm"]`` (None defers to the
     ``PT_PP_OVERLAP`` env default inside ``pp_spmd``).
+    ``collective_schedule``: ``sharding_configs["comm_overlap"]`` — the
+    mesh-aware collective-schedule pass enable (ZeRO reduce-scatter
+    bucketing; the ``PT_COLLECTIVE_SCHEDULE`` env kill switch wins).
     """
     if strategy is None:
-        return None, None
+        return None, None, None
     bucket_mb = (getattr(strategy, "fuse_grad_size_in_MB", None)
                  if getattr(strategy, "fuse_all_reduce_ops", True) else 0)
     overlap = getattr(strategy, "pipeline_configs",
                       {}).get("overlap_p2p_comm")
-    return bucket_mb, overlap
+    schedule = getattr(strategy, "sharding_configs",
+                       {}).get("comm_overlap", True)
+    return bucket_mb, overlap, schedule
 
 
 def strategy_amp_setup(strategy, model=None):

@@ -37,6 +37,13 @@ __all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
 _MP = "mp"
 
 
+def _layout():
+    # parameter specs come from the canonical layout table (lazy: the
+    # auto_parallel package imports the engine, which imports fleet)
+    from ....auto_parallel.spec_layout import default_layout
+    return default_layout()
+
+
 def _mp_degree(mp_group):
     if mp_group is not None:
         return mp_group.nranks
@@ -76,7 +83,7 @@ class VocabParallelEmbedding(Layer):
         self.weight = self.create_parameter(
             [num_embeddings, embedding_dim], attr=weight_attr,
             default_initializer=I.XavierNormal())
-        self.weight._spec = P(_MP, None)
+        self.weight._spec = _layout().vocab_embedding()
         self.weight.is_distributed = self.world_size > 1
 
     def forward(self, x):
@@ -121,13 +128,13 @@ class ColumnParallelLinear(Layer):
                 f"{self.world_size}")
         self.weight = self.create_parameter(
             [in_features, out_features], attr=weight_attr)
-        self.weight._spec = P(None, _MP)
+        self.weight._spec = _layout().column_weight()
         self.weight.is_distributed = self.world_size > 1
         self.bias = self.create_parameter(
             [out_features], attr=has_bias if has_bias is not True else None,
             is_bias=True) if has_bias else None
         if self.bias is not None:
-            self.bias._spec = P(_MP)
+            self.bias._spec = _layout().column_bias()
             self.bias.is_distributed = self.world_size > 1
 
     def forward(self, x):
@@ -170,7 +177,7 @@ class RowParallelLinear(Layer):
                 f"{self.world_size}")
         self.weight = self.create_parameter(
             [in_features, out_features], attr=weight_attr)
-        self.weight._spec = P(_MP, None)
+        self.weight._spec = _layout().row_weight()
         self.weight.is_distributed = self.world_size > 1
         # bias is replicated, added AFTER the reduce (ref :411)
         self.bias = self.create_parameter(

@@ -154,13 +154,15 @@ class PipelineParallel(Layer):
             # BEFORE rebuild re-extracts them (optimizer swap mid-run)
             self._sync_state_to_layers()
             from ..base.distributed_strategy import strategy_overlap_setup
-            bucket_mb, pp_overlap = strategy_overlap_setup(self._strategy)
+            bucket_mb, pp_overlap, coll_sched = strategy_overlap_setup(
+                self._strategy)
             self._pp_step, self._pp_state = build_train_step(
                 self._layers, self._layers._loss_fn, optimizer,
                 pipeline_microbatches=n_micro, scaler=scaler,
                 pipeline_virtual_stages=v,
                 autocast=getattr(self._strategy, "_amp_autocast", None),
-                grad_bucket_mb=bucket_mb, pipeline_overlap=pp_overlap)
+                grad_bucket_mb=bucket_mb, pipeline_overlap=pp_overlap,
+                collective_schedule=coll_sched)
             self._pp_optimizer = optimizer
             self._pp_scaler = scaler
         loss, self._pp_state = self._pp_step(self._pp_state, inputs, labels)

@@ -19,24 +19,21 @@ __all__ = ["ShardingParallel", "annotate_fsdp_specs"]
 
 def annotate_fsdp_specs(layer: Layer, axis="sharding", min_size=1024):
     """Give every parameter a spec sharding its largest dim divisible by
-    the axis size (keeping any existing mp spec on other dims)."""
+    the axis size (keeping any existing mp spec on other dims).
+
+    Placement delegates to the canonical layout engine's
+    ``place_axis`` — the same rule ``zero_spec`` uses for optimizer
+    state, so param and state shards always align.
+    """
+    from ...auto_parallel.spec_layout import place_axis
     n = _mesh_mod.mesh_axis_size(axis)
     if n <= 1:
         return layer
     for _, p in layer.named_parameters():
         if p.size < min_size:
             continue
-        existing = list(p._spec) if p._spec is not None \
-            else [None] * p.ndim
-        while len(existing) < p.ndim:
-            existing.append(None)
-        # choose the largest dim not already sharded and divisible by n
-        dims = sorted(range(p.ndim), key=lambda d: -p.shape[d])
-        for d in dims:
-            if existing[d] is None and p.shape[d] % n == 0:
-                existing[d] = axis
-                break
-        p._spec = P(*existing)
+        spec = p._spec if p._spec is not None else P(*([None] * p.ndim))
+        p._spec = place_axis(spec, tuple(p.shape), n, axis)
     return layer
 
 

@@ -1,4 +1,5 @@
-"""Bucketed data-parallel gradient reduction.
+"""Bucketed gradient reduction: fused dp all-reduce and ZeRO
+reduce-scatter.
 
 ref: the reference's ``EagerReducer`` (``python/paddle/distributed/
 parallel.py``) and the ``fuse_grad_size_in_MB`` DistributedStrategy knob:
@@ -10,17 +11,45 @@ are complete, overlapping the remaining backward compute.
 
 TPU-native realization: no hooks, no streams. Each bucket's parameters
 are flat-concatenated through :func:`bucket_reduce_marker` — a
-``custom_vjp`` identity whose backward performs a single ``lax.pmean``
-over the ``dp`` mesh axis on the flat cotangent. Autodiff then *places*
-that fused reduction at exactly the point in the backward stream where
-the bucket's last member grad is formed (the transpose of the
-concat/split plumbing), so XLA's latency-hiding scheduler can run it on
-the ICI while the MXU continues with earlier layers' backward — the
-compiled analog of the reference's reducer-hook + comm-stream overlap.
+``custom_vjp`` identity whose backward performs the bucket's planned
+collective stages on the flat cotangent. Autodiff then *places* that
+fused reduction at exactly the point in the backward stream where the
+bucket's last member grad is formed (the transpose of the concat/split
+plumbing), so XLA's latency-hiding scheduler can run it on the ICI
+while the MXU continues with earlier layers' backward — the compiled
+analog of the reference's reducer-hook + comm-stream overlap.
 
-Used by :func:`distributed.train_step.build_train_step` on pure-dp
-meshes (bucketed reduction is a data-parallel concept there too), and
-unit-tested standalone on CPU meshes.
+Two bucket kinds:
+
+- ``all_reduce`` (PR 10): one ``lax.pmean`` over ``dp`` per bucket.
+- ``reduce_scatter`` (ZeRO stages 1–3, this PR): the bucket executes a
+  planned :class:`~paddle_tpu.distributed.collective_schedule.
+  CollectiveSchedule` — ``reduce_scatter(sharding)`` so each rank
+  receives exactly its ``zero_spec`` window, ``all_reduce(dp)`` on the
+  1/n scattered payload (the GC3 hierarchical win: only 1/n of the
+  gradient bytes cross the slow dp links), then ``all_gather``.  The
+  gather is required because a ``custom_vjp`` backward must return a
+  cotangent of the primal's (full) shape; outside the step the ZeRO-2
+  ``with_sharding_constraint`` re-slices, and XLA routinely cancels
+  the adjacent gather/slice pair.
+
+For scatter windows to BE the ``zero_spec`` windows, scatterable
+buckets are packed **rank-major**: each member is reshaped so its
+sharding-dim windows become the leading axis, members are concatenated
+along axis 1 into ``(n_shard, numel/n_shard)``, and the flat vector is
+the ravel of that — row ``r`` is rank ``r``'s windows of every member,
+back to back.  ``psum_scatter`` over axis 0 of the ``(n, W)`` reshape
+then hands rank ``r`` row ``r`` exactly.
+
+Numerics: the batch is sharded over ``dp`` only, so along ``sharding``
+every rank computes identical grads; the scatter contributes only rank
+0's copy (adding zeros is exact, where summing ``n`` identical copies
+and dividing by ``n`` rounds with the backend's psum order), and the
+dp stage is the same pmean PR 10 proved bit-parity for — so the
+bucketed sharded step is bit-identical to the unbucketed GSPMD step.
+
+Used by :func:`distributed.train_step.build_train_step` on pure-dp and
+dp×sharding ZeRO meshes, and unit-tested standalone on CPU meshes.
 """
 from __future__ import annotations
 
@@ -53,11 +82,16 @@ def default_bucket_bytes(strategy_mb=None):
 @dataclass
 class Bucket:
     """One reduction bucket: parameter names (reverse-backward order),
-    their flat sizes, one dtype, total payload bytes."""
+    their flat sizes, one dtype, total payload bytes.  ``kind`` is the
+    reduction this bucket's marker performs (``all_reduce`` |
+    ``reduce_scatter``); for scatterable buckets ``dims`` holds each
+    member's zero_spec scatter dim (parallel to ``names``)."""
     names: list = field(default_factory=list)
     sizes: list = field(default_factory=list)
     dtype: object = None
     nbytes: int = 0
+    kind: str = "all_reduce"
+    dims: list = field(default_factory=list)
 
     @property
     def numel(self):
@@ -68,10 +102,20 @@ class Bucket:
 class BucketPlan:
     buckets: list = field(default_factory=list)
     target_bytes: int = 0
+    # CollectiveSchedule executed by reduce_scatter-kind buckets (None
+    # on pure-dp plans, where every bucket is a dp pmean)
+    schedule: object = None
 
     @property
     def n_buckets(self):
         return len(self.buckets)
+
+    @property
+    def mapped_axes(self):
+        """Mesh axes the bucketed shard_map must run manual over."""
+        if self.schedule is not None and self.schedule.shard_axis:
+            return ("dp", self.schedule.shard_axis)
+        return ("dp",)
 
     def record_metrics(self):
         """pt_grad_buckets_total / pt_grad_bucket_bytes, once per build
@@ -80,10 +124,10 @@ class BucketPlan:
         from ..observability import get_telemetry
         tel = get_telemetry()
         for b in self.buckets:
-            tel.grad_bucket(b.nbytes)
+            tel.grad_bucket(b.nbytes, kind=b.kind)
 
 
-def partition_buckets(params, bucket_bytes, order=None):
+def partition_buckets(params, bucket_bytes, order=None, scatter_dims=None):
     """Greedy size-targeted partition of ``params`` ({name: array-like})
     into :class:`Bucket` groups.
 
@@ -92,13 +136,20 @@ def partition_buckets(params, bucket_bytes, order=None):
     fill early in the backward pass and their reductions ship early
     (ref ``EagerReducer`` builds groups the same way). A bucket closes
     when adding the next parameter would cross ``bucket_bytes`` (a
-    single parameter larger than the target gets a bucket of its own)
-    or when the dtype changes — buckets are flat-concatenated, so they
-    are dtype-homogeneous rather than cast.
+    single parameter larger than the target gets a bucket of its own),
+    when the dtype changes — buckets are flat-concatenated, so they
+    are dtype-homogeneous rather than cast — or when the reduction
+    kind changes.
+
+    ``scatter_dims`` ({name: dim | None}) marks params whose grads are
+    reduce-scattered over the sharding axis on ``dim`` (their
+    ``zero_spec`` placement); unlisted/None params stay ``all_reduce``
+    kind. Kinds never share a bucket: a fused collective is one op.
     """
     if bucket_bytes <= 0:
         raise ValueError(f"bucket_bytes must be positive, got {bucket_bytes}")
     names = list(order) if order is not None else list(reversed(params))
+    scatter_dims = scatter_dims or {}
     plan = BucketPlan(target_bytes=int(bucket_bytes))
     cur = None
     for k in names:
@@ -106,12 +157,15 @@ def partition_buckets(params, bucket_bytes, order=None):
         dt = jnp.dtype(p.dtype)
         size = int(np.prod(p.shape)) if p.shape else 1
         nb = size * dt.itemsize
-        if (cur is None or cur.dtype != dt
+        dim = scatter_dims.get(k)
+        kind = "all_reduce" if dim is None else "reduce_scatter"
+        if (cur is None or cur.dtype != dt or cur.kind != kind
                 or (cur.nbytes and cur.nbytes + nb > plan.target_bytes)):
-            cur = Bucket(dtype=dt)
+            cur = Bucket(dtype=dt, kind=kind)
             plan.buckets.append(cur)
         cur.names.append(k)
         cur.sizes.append(size)
+        cur.dims.append(dim)
         cur.nbytes += nb
     return plan
 
@@ -140,11 +194,86 @@ def _make_marker(axis_name, nbytes):
     return marker
 
 
-def bucket_reduce_marker(flat, axis_name="dp"):
-    """Identity on ``flat`` whose backward pmean-reduces the cotangent
-    over ``axis_name`` as one fused collective."""
+def _make_schedule_marker(stages):
+    """custom_vjp identity whose backward executes a planned collective
+    stage list on the flat cotangent.  ``reduce_scatter`` reshapes the
+    rank-major flat to ``(n, W)`` and psum-scatters row ``r`` to rank
+    ``r`` (masked to rank 0's contribution — along the sharding axis
+    the rows are ``n`` identical replicas, the batch being dp-sharded
+    only); ``all_reduce`` pmeans the (now 1/n-sized) payload over dp;
+    ``all_gather``
+    reassembles to the primal's full flat shape, as custom_vjp
+    requires."""
+
+    @jax.custom_vjp
+    def marker(flat):
+        return flat
+
+    def fwd(flat):
+        return flat, None
+
+    def bwd(_, ct):
+        from .collective import _observe
+        full_shape = ct.shape
+        x = ct
+        for st in stages:
+            if st.op == "reduce_scatter":
+                _observe("reduce_scatter", x)
+                x = x.reshape(st.size, x.size // st.size)
+                # grads are replica-identical along the sharding axis
+                # (the batch is dp-sharded only), so the reduce is
+                # "pick one": contribute rank 0's copy and let the
+                # scatter sum zeros. Summing the n identical copies and
+                # dividing by n instead rounds (the backend's psum
+                # order isn't a pure tree), breaking bit-parity with
+                # the unbucketed step; adding zeros is exact.
+                keep = lax.axis_index(st.axis) == 0
+                x = lax.psum_scatter(
+                    jnp.where(keep, x, jnp.zeros_like(x)), st.axis,
+                    scatter_dimension=0, tiled=False)
+            elif st.op == "all_reduce":
+                _observe("all_reduce", x)
+                x = lax.pmean(x, st.axis)
+            elif st.op == "all_gather":
+                _observe("all_gather", x)
+                x = lax.all_gather(x, st.axis, axis=0, tiled=False)
+                x = x.reshape(full_shape)
+            else:
+                raise ValueError(f"unknown collective stage op: {st.op}")
+        return (x,)
+
+    marker.defvjp(fwd, bwd)
+    return marker
+
+
+def bucket_reduce_marker(flat, axis_name="dp", schedule=None):
+    """Identity on ``flat`` whose backward reduces the cotangent as one
+    fused collective: a pmean over ``axis_name``, or — when a
+    :class:`CollectiveSchedule` is given — its planned stage list."""
+    if schedule is not None:
+        return _make_schedule_marker(schedule.stages)(flat)
     nbytes = int(flat.size) * flat.dtype.itemsize
     return _make_marker(axis_name, nbytes)(flat)
+
+
+def _to_rank_major(arr, dim, n):
+    """Reshape ``arr`` to ``(n, size/n)`` where row ``r`` is the ravel
+    of ``arr``'s r-th window along ``dim`` — its zero_spec shard."""
+    shape = arr.shape
+    pre = int(np.prod(shape[:dim])) if dim else 1
+    blk = shape[dim] // n
+    post = int(np.prod(shape[dim + 1:])) if dim + 1 < len(shape) else 1
+    x = arr.reshape(pre, n, blk, post)
+    return jnp.transpose(x, (1, 0, 2, 3)).reshape(n, arr.size // n)
+
+
+def _from_rank_major(x, shape, dim, n):
+    """Inverse of :func:`_to_rank_major`."""
+    pre = int(np.prod(shape[:dim])) if dim else 1
+    blk = shape[dim] // n
+    post = int(np.prod(shape[dim + 1:])) if dim + 1 < len(shape) else 1
+    return jnp.transpose(x.reshape(n, pre, blk, post),
+                         (1, 0, 2, 3)).reshape(shape)
 
 
 def apply_bucketed_reduction(params, plan, axis_name="dp"):
@@ -155,16 +284,37 @@ def apply_bucketed_reduction(params, plan, axis_name="dp"):
     split back to their original shapes. Forward math is unchanged
     (identity); under ``jax.grad`` each bucket's parameter cotangents
     accumulate into the flat vector (the split's transpose), are
-    reduced by ONE ``pmean(axis_name)``, and slice back apart — the
-    whole bucketed-overlapped reduction emerges from autodiff ordering.
+    reduced by the bucket's fused collective(s), and slice back apart —
+    the whole bucketed-overlapped reduction emerges from autodiff
+    ordering.
+
+    ``reduce_scatter`` buckets pack **rank-major** (see module
+    docstring): members are concatenated as ``(n_shard, W)`` columns so
+    the scatter's per-rank rows are exactly the members' ``zero_spec``
+    windows.
     """
     out = dict(params)
+    n_sh = plan.schedule.shard_size if plan.schedule is not None else 1
     for b in plan.buckets:
-        flat = jnp.concatenate([jnp.ravel(params[k]) for k in b.names])
-        flat = bucket_reduce_marker(flat, axis_name)
-        off = 0
-        for k, size in zip(b.names, b.sizes):
-            out[k] = lax.slice_in_dim(flat, off, off + size).reshape(
-                params[k].shape)
-            off += size
+        if b.kind == "reduce_scatter":
+            stacked = jnp.concatenate(
+                [_to_rank_major(params[k], d, n_sh)
+                 for k, d in zip(b.names, b.dims)], axis=1)
+            flat = bucket_reduce_marker(stacked.reshape(-1),
+                                        schedule=plan.schedule)
+            stacked = flat.reshape(n_sh, -1)
+            off = 0
+            for k, size, d in zip(b.names, b.sizes, b.dims):
+                w = size // n_sh
+                col = lax.slice_in_dim(stacked, off, off + w, axis=1)
+                out[k] = _from_rank_major(col, params[k].shape, d, n_sh)
+                off += w
+        else:
+            flat = jnp.concatenate([jnp.ravel(params[k]) for k in b.names])
+            flat = bucket_reduce_marker(flat, axis_name)
+            off = 0
+            for k, size in zip(b.names, b.sizes):
+                out[k] = lax.slice_in_dim(flat, off, off + size).reshape(
+                    params[k].shape)
+                off += size
     return out

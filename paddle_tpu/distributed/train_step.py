@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import contextlib
 
-import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -36,29 +35,12 @@ from ._jax_compat import use_mesh as _use_mesh  # noqa: E402
 
 
 def _spec_for(p, mesh):
-    spec = getattr(p, "_spec", None)
-    if spec is None:
-        return P()
-    # drop axis names the mesh doesn't have (e.g. model built with TP
-    # annotations but run on a dp-only mesh)
-    axes = []
-    for entry in spec:
-        if entry is None:
-            axes.append(None)
-        elif isinstance(entry, tuple):
-            kept = tuple(a for a in entry if a in mesh.shape
-                         and mesh.shape[a] > 1)
-            axes.append(kept if kept else None)
-        else:
-            axes.append(entry if entry in mesh.shape and
-                        mesh.shape[entry] > 1 else None)
-    # verify divisibility; fall back to replicated otherwise
-    for d, a in enumerate(axes):
-        names = (a,) if isinstance(a, str) else (a or ())
-        size = int(np.prod([mesh.shape[n] for n in names])) if names else 1
-        if size > 1 and p.shape[d] % size:
-            return P()
-    return P(*axes)
+    """Canonicalize a parameter's annotation against the mesh — the
+    layout engine's :func:`resolve_spec` (drop absent/size-1 axes,
+    e.g. a model built with TP annotations run on a dp-only mesh;
+    replicate on indivisibility)."""
+    from .auto_parallel.spec_layout import resolve_spec
+    return resolve_spec(getattr(p, "_spec", None), tuple(p.shape), mesh)
 
 
 def param_shardings(layer: Layer, mesh=None):
@@ -75,21 +57,8 @@ def zero_spec(spec, shape, mesh, axis="sharding"):
     LIST per rank; sharding each state tensor over the same mesh axis is
     the SPMD equivalent — per-device state bytes shrink ~1/N and XLA runs
     the update shard-local)."""
-    n = mesh.shape.get(axis, 1)
-    if n <= 1:
-        return spec
-    entries = list(spec) + [None] * (len(shape) - len(spec))
-
-    def _axes(e):
-        return (e,) if isinstance(e, str) else tuple(e or ())
-
-    if any(axis in _axes(e) for e in entries):
-        return spec  # param already fsdp-sharded; state inherits it
-    for d in sorted(range(len(shape)), key=lambda d: -shape[d]):
-        if entries[d] is None and shape[d] % n == 0:
-            entries[d] = axis
-            return P(*entries)
-    return spec  # no divisible dim: this leaf stays replicated
+    from .auto_parallel.spec_layout import place_axis
+    return place_axis(spec, tuple(shape), mesh.shape.get(axis, 1), axis)
 
 
 def _zero_level(optimizer):
@@ -192,18 +161,31 @@ def _scaler_finish(scaler, grads, scale, old_state):
     return grads, select, sstate
 
 
-def _bucket_plan_for(params, mesh, zero, grad_bucket_mb):
+def _bucket_plan_for(params, mesh, zero, grad_bucket_mb, shardings=None,
+                     collective_schedule=None):
     """A :class:`grad_buckets.BucketPlan` when the bucketed-reduction
     path applies, else None.
 
-    Bucketed reduction is the data-parallel gradient fusion of the
-    reference's ``EagerReducer``/``fuse_grad_size_in_MB``: it replaces
-    the implicit GSPMD dp-grad reduction with explicit per-bucket fused
-    pmeans placed mid-backward. It therefore engages only on pure-dp
-    meshes (every non-dp axis size 1 — with mp/sep/sharding in play the
-    reduction is GSPMD's to schedule) and without ZeRO (whose
-    reduce-scatter layout owns the grads). ``PT_GRAD_BUCKETS=0``
-    disables; ``grad_bucket_mb=0`` disables per call site.
+    Bucketed reduction is the gradient fusion of the reference's
+    ``EagerReducer``/``fuse_grad_size_in_MB``: it replaces the implicit
+    GSPMD grad reduction with explicit per-bucket fused collectives
+    placed mid-backward. Two eligible mesh families:
+
+    - **pure dp, no ZeRO** (PR 10): every non-dp axis size 1; each
+      bucket is one fused pmean over dp.
+    - **dp × sharding with ZeRO** (stages 1–3): the collective-schedule
+      planner (:mod:`collective_schedule`) plans each bucket as
+      reduce_scatter(sharding) → all_reduce(dp) → all_gather, the
+      per-rank scatter windows being the params' ``zero_spec`` windows
+      (``shardings`` supplies the base specs).  Params the placement
+      rule can't scatter (already fsdp-sharded, or no divisible dim)
+      ride in plain all_reduce buckets.  ``PT_COLLECTIVE_SCHEDULE=0``
+      (or a falsy ``collective_schedule`` strategy flag) disables this
+      family only, restoring the pre-PR-11 GSPMD behavior.
+
+    With mp/sep/ep/pp in play the reduction is GSPMD's to schedule —
+    ineligible. ``PT_GRAD_BUCKETS=0`` disables all bucketing;
+    ``grad_bucket_mb=0`` disables per call site.
     """
     import os
     from . import grad_buckets as _gb
@@ -211,30 +193,71 @@ def _bucket_plan_for(params, mesh, zero, grad_bucket_mb):
         return None
     if os.environ.get("PT_GRAD_BUCKETS", "1") in ("0", "false", "off"):
         return None
-    if zero is not None or mesh.shape.get("dp", 1) <= 1:
+    if any(n > 1 for ax, n in mesh.shape.items()
+           if ax not in ("dp", "sharding")):
         return None
-    if any(n > 1 for ax, n in mesh.shape.items() if ax != "dp"):
+    n_dp = mesh.shape.get("dp", 1)
+    n_sh = mesh.shape.get("sharding", 1)
+    if zero is None:
+        if n_dp <= 1 or n_sh > 1:
+            return None  # sharded mesh without ZeRO: GSPMD owns layout
+        plan = _gb.partition_buckets(
+            params, _gb.default_bucket_bytes(grad_bucket_mb))
+        plan.record_metrics()
+        return plan
+    from . import collective_schedule as _cs
+    from .auto_parallel.spec_layout import spec_axes
+    sched = _cs.plan_grad_reduction(dict(mesh.shape), zero,
+                                    enabled=collective_schedule)
+    if sched is None or not sched.scatters:
         return None
+    # per-param scatter dim: where zero_spec places the sharding axis
+    # (None when the param is already fsdp-sharded or nothing divides —
+    # those reduce as plain dp pmeans and re-slice outside)
+    scatter_dims = {}
+    for k, p in params.items():
+        base = shardings[k].spec if shardings is not None else P()
+        zs = zero_spec(base, p.shape, mesh)
+        dim = None
+        if zs is not base:
+            base_e = list(base) + [None] * (len(zs) - len(base))
+            for d, e in enumerate(zs):
+                if "sharding" in spec_axes(e) \
+                        and "sharding" not in spec_axes(base_e[d]):
+                    dim = d
+                    break
+        scatter_dims[k] = dim
     plan = _gb.partition_buckets(
-        params, _gb.default_bucket_bytes(grad_bucket_mb))
+        params, _gb.default_bucket_bytes(grad_bucket_mb),
+        scatter_dims=scatter_dims)
+    plan.schedule = sched
     plan.record_metrics()
     return plan
 
 
 def _bucketed_value_and_grad(model, fwd, loss_fn, autocast, plan, mesh,
                              state, scale, x, labels):
-    """Loss + grads with per-bucket fused dp reductions, as one
-    ``shard_map`` manual over ``dp``: the batch arrives as the local
-    shard, the loss is the local mean, and each bucket's grads are
-    pmean-reduced over dp by its marker's backward — emitted exactly
-    where that bucket's last cotangent forms, so the reductions
-    interleave with (and can hide behind) the remaining backward."""
+    """Loss + grads with per-bucket fused reductions, as one
+    ``shard_map`` manual over the plan's mapped axes (``dp``, plus
+    ``sharding`` for ZeRO reduce-scatter plans): the batch arrives as
+    the local dp shard, the loss is the local mean, and each bucket's
+    grads are reduced by its marker's backward — emitted exactly where
+    that bucket's last cotangent forms, so the reductions interleave
+    with (and can hide behind) the remaining backward.  Along
+    ``sharding`` the batch is replicated, every rank computes identical
+    grads, and the markers' psum_scatter hands each rank its zero_spec
+    window."""
     from .grad_buckets import apply_bucketed_reduction
     from ._jax_compat import shard_map
 
+    axes = tuple(plan.mapped_axes)
+
     def body(params, buffers, key, scale, x, *labels):
         # per-shard dropout stream: fold the dp coordinate so shards
-        # draw independent masks (the global-batch analog)
+        # draw independent masks (the global-batch analog). The
+        # sharding coordinate is NOT folded: sharding ranks must draw
+        # identical masks so their grads stay replica-identical (what
+        # makes the scatter exact).
         key = jax.random.fold_in(key, jax.lax.axis_index("dp"))
 
         def loss_of(p):
@@ -267,7 +290,7 @@ def _bucketed_value_and_grad(model, fwd, loss_fn, autocast, plan, mesh,
         body, mesh=mesh,
         in_specs=(P(), P(), P(), P(), P("dp")) + tuple(
             P("dp") for _ in range(n_lab)),
-        out_specs=(P(), P(), P()), axis_names={"dp"}, check_vma=False)
+        out_specs=(P(), P(), P()), axis_names=set(axes), check_vma=False)
     return mapped(state["params"], state["buffers"], key, scale, x,
                   *labels)
 
@@ -275,7 +298,8 @@ def _bucketed_value_and_grad(model, fwd, loss_fn, autocast, plan, mesh,
 def build_train_step(model: Layer, loss_fn, optimizer, mesh=None,
                      donate=True, pipeline_microbatches=None, scaler=None,
                      pipeline_virtual_stages=1, autocast=None,
-                     grad_bucket_mb=None, pipeline_overlap=None):
+                     grad_bucket_mb=None, pipeline_overlap=None,
+                     collective_schedule=None):
     """Returns (step_fn, state) where
     ``state = {"params", "buffers", "opt"}`` is mesh-placed and
     ``step_fn(state, *batch) -> (loss, state)`` is one compiled program.
@@ -302,6 +326,11 @@ def build_train_step(model: Layer, loss_fn, optimizer, mesh=None,
     (e.g. ``lambda: amp.auto_cast(level="O1", dtype="float16")``) entered
     around the forward at trace time — O1 white-list casts compile into
     the step.
+
+    ``collective_schedule``: strategy-level enable flag for the
+    mesh-aware collective-schedule pass (ZeRO reduce-scatter bucketing;
+    ``sharding_configs.comm_overlap``). ``None`` defers to the
+    ``PT_COLLECTIVE_SCHEDULE`` env default (on).
     """
     mesh = mesh or _mesh_mod.get_mesh()
     if scaler is not None and not scaler.is_enable():
@@ -323,7 +352,9 @@ def build_train_step(model: Layer, loss_fn, optimizer, mesh=None,
             pipeline_virtual_stages, autocast, pipeline_overlap)
     params, buffers, shardings = shard_model_state(model, mesh)
     zero = _zero_level(optimizer)
-    bucket_plan = _bucket_plan_for(params, mesh, zero, grad_bucket_mb)
+    bucket_plan = _bucket_plan_for(params, mesh, zero, grad_bucket_mb,
+                                   shardings=shardings,
+                                   collective_schedule=collective_schedule)
     opt_state, opt_sh = _place_opt_state(optimizer, params, shardings,
                                          mesh, zero)
     state = {"params": params, "buffers": buffers, "opt": opt_state}

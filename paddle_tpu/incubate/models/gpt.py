@@ -28,7 +28,6 @@ import math
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
 
 from ...tensor import Tensor
 from ...nn.layer.layers import Layer
@@ -82,9 +81,10 @@ def _seq_constraint(t: Tensor) -> Tensor:
     if in_compat_manual_region():
         return t
     from jax.sharding import NamedSharding
+    from ...distributed.auto_parallel.spec_layout import default_layout
     try:
         t._data = jax.lax.with_sharding_constraint(
-            t._data, NamedSharding(mesh, P("dp", "sep")))
+            t._data, NamedSharding(mesh, default_layout().batch_seq()))
     except Exception:
         pass
     return t

@@ -383,7 +383,10 @@ class TrainingTelemetry:
             "(not recorded inside traces)", ("op",))
         self._m_grad_buckets = r.counter(
             "pt_grad_buckets_total",
-            "gradient-reduction buckets built by train-step tracing")
+            "gradient-reduction buckets built by train-step tracing, "
+            "by reduction kind (all_reduce = fused dp pmean; "
+            "reduce_scatter = planned ZeRO hierarchical schedule)",
+            ("kind",))
         self._m_grad_bucket_bytes = r.histogram(
             "pt_grad_bucket_bytes",
             "flat-concatenated payload bytes of each gradient bucket "
@@ -519,14 +522,14 @@ class TrainingTelemetry:
             return
         self._m_coll_time.observe(float(seconds), op=op)
 
-    def grad_bucket(self, nbytes):
+    def grad_bucket(self, nbytes, kind="all_reduce"):
         """One gradient bucket materialized at train-step trace time;
         ``nbytes`` is the flat-concatenated payload of its fused
         reduction (recorded once per trace — the honest count, like
-        ``collective_op``)."""
+        ``collective_op``) and ``kind`` the reduction it compiles to."""
         if not self.enabled:
             return
-        self._m_grad_buckets.inc()
+        self._m_grad_buckets.inc(kind=kind)
         self._m_grad_bucket_bytes.observe(float(nbytes))
 
     # -- checkpoints ----------------------------------------------------------

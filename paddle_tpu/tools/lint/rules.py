@@ -791,3 +791,37 @@ class CollectiveInParamLoop(Rule):
         for stmt in body:
             walk(stmt)
         return hits
+
+
+@register
+class AdHocPartitionSpecInModel(Rule):
+    id = "TPU015"
+    name = "ad-hoc-partitionspec-in-model-code"
+    rationale = ("an inline PartitionSpec in model/bench code forks the "
+                 "sharding layout from the canonical SpecLayout table "
+                 "(distributed/auto_parallel/spec_layout.py) — a mesh-"
+                 "axis rename or a layout fix then silently misses the "
+                 "call site, and the Megatron pairing rules (column out-"
+                 "dim + its bias over tp; row in-dim over tp, bias "
+                 "replicated) stop being reviewable in one place; ask "
+                 "the layout table for the role instead")
+
+    # model/bench code — where layouts must come from the table. The
+    # layout engine, train_step and the parallel-layer library are the
+    # table's implementation/plumbing and stay free to build specs.
+    _MODEL_PATHS = re.compile(
+        r"((^|/)paddle_tpu/(incubate|vision)/models(/|$)"
+        r"|(^|/)bench[^/]*\.py$)")
+    _SPEC_CALLS = {"PartitionSpec", "P", "PS"}
+
+    def on_call(self, node, ctx):
+        if not self._MODEL_PATHS.search(ctx.path_posix):
+            return
+        name = dotted(node.func)
+        if name.rpartition(".")[2] in self._SPEC_CALLS:
+            ctx.report(node, self.id,
+                       f"inline {name}(...) in model/bench code; take "
+                       f"the spec from the canonical layout table "
+                       f"(distributed/auto_parallel/spec_layout."
+                       f"SpecLayout) so dp/fsdp/tp placements stay in "
+                       f"one reviewable place")

@@ -1,0 +1,89 @@
+"""Mesh-aware collective-schedule planning (distributed/collective_schedule).
+
+The planner is pure metadata: given mesh axis sizes and a ZeRO level it
+composes the per-bucket gradient reduction from per-axis stages ordered
+fast-link-first (reduce_scatter over in-node ICI, all_reduce across DCN
+on the 1/n payload, all_gather back).  These are trace-time decisions —
+unit-testable without devices.
+"""
+import pytest
+
+from paddle_tpu.distributed.collective_schedule import (
+    CollectiveSchedule, Stage, dcn_axes, plan_grad_reduction,
+    schedule_enabled)
+
+
+# -- plans -------------------------------------------------------------------
+
+def test_pure_dp_plan_is_single_all_reduce():
+    s = plan_grad_reduction({"dp": 8}, zero=None)
+    assert s.stages == (Stage("all_reduce", "dp", 8),)
+    assert not s.scatters and s.kind == "all_reduce"
+    assert s.shard_axis is None and s.shard_size == 1
+
+
+def test_zero_sharded_plan_is_hierarchical():
+    s = plan_grad_reduction({"dp": 2, "sharding": 4}, zero="os")
+    assert [st.op for st in s.stages] == \
+        ["reduce_scatter", "all_reduce", "all_gather"]
+    assert s.scatters and s.kind == "reduce_scatter"
+    assert s.shard_axis == "sharding" and s.shard_size == 4
+    assert s.describe() == ("reduce_scatter(sharding:4) -> "
+                            "all_reduce(dp:2) -> all_gather(sharding:4)")
+
+
+def test_zero_sharding_only_plan_skips_dp_stage():
+    s = plan_grad_reduction({"dp": 1, "sharding": 8}, zero="os_g")
+    assert [st.op for st in s.stages] == ["reduce_scatter", "all_gather"]
+    assert s.shard_size == 8
+
+
+def test_nothing_to_plan_returns_none():
+    # single device
+    assert plan_grad_reduction({"dp": 1}, zero=None) is None
+    # ZeRO without a sharding axis: the pre-existing GSPMD/zero_spec
+    # path owns the reduction — planning must NOT claim it
+    assert plan_grad_reduction({"dp": 8}, zero="os") is None
+    assert plan_grad_reduction({"dp": 8, "sharding": 1}, zero="os_g") is None
+    # sharded mesh without ZeRO: GSPMD owns layout
+    assert plan_grad_reduction({"dp": 2, "sharding": 4}, zero=None) is None
+
+
+# -- kill switches -----------------------------------------------------------
+
+def test_env_kill_switch(monkeypatch):
+    monkeypatch.delenv("PT_COLLECTIVE_SCHEDULE", raising=False)
+    assert schedule_enabled()
+    for off in ("0", "false", "False"):
+        monkeypatch.setenv("PT_COLLECTIVE_SCHEDULE", off)
+        assert not schedule_enabled()
+        assert plan_grad_reduction({"dp": 2, "sharding": 4}, "os") is None
+    monkeypatch.setenv("PT_COLLECTIVE_SCHEDULE", "1")
+    assert schedule_enabled()
+
+
+def test_strategy_flag_forces_off_but_env_wins(monkeypatch):
+    monkeypatch.delenv("PT_COLLECTIVE_SCHEDULE", raising=False)
+    assert not schedule_enabled(False)
+    assert plan_grad_reduction({"dp": 2, "sharding": 4}, "os",
+                               enabled=False) is None
+    # flag=None means "no opinion", not off
+    assert schedule_enabled(None)
+    # the env kill switch wins over an explicit strategy opt-in
+    monkeypatch.setenv("PT_COLLECTIVE_SCHEDULE", "0")
+    assert not schedule_enabled(True)
+
+
+# -- topology ----------------------------------------------------------------
+
+def test_dcn_axes_default_and_override(monkeypatch):
+    monkeypatch.delenv("PT_DCN_AXES", raising=False)
+    assert dcn_axes() == ("dp", "pp")
+    monkeypatch.setenv("PT_DCN_AXES", "dp")
+    assert dcn_axes() == ("dp",)
+    monkeypatch.setenv("PT_DCN_AXES", " dp , sharding ")
+    assert dcn_axes() == ("dp", "sharding")
+
+
+def test_describe_noop():
+    assert CollectiveSchedule().describe() == "noop"

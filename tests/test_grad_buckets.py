@@ -222,9 +222,10 @@ def test_bucket_metrics_record_fused_payload():
     jax.block_until_ready(loss)
     snap = obs.get_registry().snapshot()
     # one pt_grad_buckets_total sample per bucket, sized by flat payload
-    prev = pre["pt_grad_buckets_total"]["series"].get("", 0)
-    assert (snap["pt_grad_buckets_total"]["series"][""] - prev
-            == plan.n_buckets)
+    # (labeled by reduction kind: pure-dp plans are all_reduce buckets)
+    prev = pre["pt_grad_buckets_total"]["series"].get("kind=all_reduce", 0)
+    assert (snap["pt_grad_buckets_total"]["series"]["kind=all_reduce"]
+            - prev == plan.n_buckets)
     hist = snap["pt_grad_bucket_bytes"]["series"][""]
     assert hist["sum"] >= sum(b.nbytes for b in plan.buckets)
     # collective byte accounting is the FUSED payload: trace-time

@@ -1,0 +1,248 @@
+"""Reduce-scatter gradient bucketing on dp×sharding ZeRO meshes
+(distributed/grad_buckets.py + the collective-schedule planner wired
+through train_step._bucket_plan_for).
+
+Covers: plan eligibility and both kill switches (``PT_GRAD_BUCKETS``,
+``PT_COLLECTIVE_SCHEDULE``), the rank-major packing invariant (scatter
+rows ARE the ``zero_spec`` windows), the scheduled marker's backward
+semantics under shard_map, train-step parity on the 8-device CPU mesh,
+and the reduce_scatter telemetry contract.
+
+Parity is asserted two ways, deliberately:
+
+- **bit parity (0.0)** between fused buckets and one-bucket-per-param
+  (``grad_bucket_mb=2e-6`` → 2-byte target): same program structure,
+  exactly what fusion replaces.
+- **atol ≤ 1.4e-6** against the unbucketed GSPMD step: XLA's
+  partitioner is free to re-associate the loss/grad reductions over the
+  sharding devices, so the GSPMD baseline's own step-1 loss shifts by
+  1 ulp on identical params — exact equality with it is not a property
+  any explicit-collective implementation can promise.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.distributed as dist
+import paddle_tpu.observability as obs
+from paddle_tpu.distributed._jax_compat import shard_map
+from paddle_tpu.distributed.collective_schedule import plan_grad_reduction
+from paddle_tpu.distributed.grad_buckets import (
+    _from_rank_major, _to_rank_major, bucket_reduce_marker,
+    partition_buckets)
+from paddle_tpu.distributed.sharding import group_sharded_parallel
+from paddle_tpu.distributed.train_step import (
+    _bucket_plan_for, build_train_step, zero_spec)
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    yield
+    dist.set_mesh(None)
+    dist.destroy_process_group()
+    obs.reset()
+
+
+# -- plan eligibility --------------------------------------------------------
+
+def test_rs_plan_shape_and_gating(monkeypatch):
+    params = {"w": np.zeros((64, 64), np.float32)}
+    mesh = dist.init_mesh({"dp": 2, "sharding": 4})
+    plan = _bucket_plan_for(params, mesh, "os", None)
+    assert plan is not None and plan.schedule is not None
+    assert plan.schedule.describe() == (
+        "reduce_scatter(sharding:4) -> all_reduce(dp:2) -> "
+        "all_gather(sharding:4)")
+    assert plan.mapped_axes == ("dp", "sharding")
+    assert all(b.kind == "reduce_scatter" for b in plan.buckets)
+    # strategy-level off (sharding_configs.comm_overlap = False)
+    assert _bucket_plan_for(params, mesh, "os", None,
+                            collective_schedule=False) is None
+    # env kill switches
+    monkeypatch.setenv("PT_COLLECTIVE_SCHEDULE", "0")
+    assert _bucket_plan_for(params, mesh, "os", None) is None
+    monkeypatch.delenv("PT_COLLECTIVE_SCHEDULE")
+    monkeypatch.setenv("PT_GRAD_BUCKETS", "0")
+    assert _bucket_plan_for(params, mesh, "os", None) is None
+    monkeypatch.delenv("PT_GRAD_BUCKETS")
+    # ZeRO without a sharding axis: prior behavior (no bucketing)
+    mesh_dp = dist.init_mesh({"dp": 8})
+    assert _bucket_plan_for(params, mesh_dp, "os", None) is None
+    # mp in play: GSPMD owns the gradient reduction
+    mesh_mp = dist.init_mesh({"dp": 2, "sharding": 2, "mp": 2})
+    assert _bucket_plan_for(params, mesh_mp, "os", None) is None
+
+
+def test_unscatterable_params_ride_all_reduce_buckets():
+    # 7x9 has no dim divisible by 4 -> zero_spec leaves it replicated,
+    # so its grad reduces as a plain dp pmean; kinds never share buckets
+    params = {"odd": np.zeros((7, 9), np.float32),
+              "w": np.zeros((64, 64), np.float32)}
+    mesh = dist.init_mesh({"dp": 2, "sharding": 4})
+    plan = _bucket_plan_for(params, mesh, "os", None)
+    kinds = {n: b.kind for b in plan.buckets for n in b.names}
+    assert kinds == {"odd": "all_reduce", "w": "reduce_scatter"}
+    assert plan.n_buckets == 2
+
+
+def test_scatter_dims_match_zero_spec_windows():
+    mesh = dist.init_mesh({"dp": 2, "sharding": 4})
+    params = {"w1": np.zeros((64, 128), np.float32),   # largest dim 1
+              "w2": np.zeros((128, 64), np.float32),   # largest dim 0
+              "b": np.zeros((128,), np.float32)}       # rank-1, dim 0
+    plan = _bucket_plan_for(params, mesh, "os", None)
+    dims = {n: d for b in plan.buckets
+            for n, d in zip(b.names, b.dims)}
+    assert dims == {"w1": 1, "w2": 0, "b": 0}
+    # the dim IS where zero_spec put the sharding axis
+    assert zero_spec(P(), (64, 128), mesh) == P(None, "sharding")
+    assert zero_spec(P(), (128, 64), mesh) == P("sharding", None)
+    assert zero_spec(P(), (128,), mesh) == P("sharding")
+
+
+# -- rank-major packing ------------------------------------------------------
+
+def test_rank_major_rows_are_shard_windows():
+    arr = np.arange(8 * 6, dtype=np.float32).reshape(8, 6)
+    rm = np.asarray(_to_rank_major(jnp.asarray(arr), 0, 4))
+    assert rm.shape == (4, 12)
+    for r in range(4):
+        np.testing.assert_array_equal(rm[r], arr[2 * r:2 * r + 2].ravel())
+    rm1 = np.asarray(_to_rank_major(jnp.asarray(arr), 1, 2))
+    for r in range(2):
+        np.testing.assert_array_equal(rm1[r], arr[:, 3 * r:3 * r + 3].ravel())
+    # inverse round-trips
+    np.testing.assert_array_equal(
+        np.asarray(_from_rank_major(jnp.asarray(rm), (8, 6), 0, 4)), arr)
+    np.testing.assert_array_equal(
+        np.asarray(_from_rank_major(jnp.asarray(rm1), (8, 6), 1, 2)), arr)
+
+
+# -- scheduled marker semantics ----------------------------------------------
+
+def test_schedule_marker_backward_is_dp_mean():
+    # grads are replica-identical along sharding (the batch is dp-sharded
+    # only); the full rs -> ar -> ag pipeline must therefore equal one
+    # pmean over dp — scatter picks rank 0's copy, gather reassembles
+    mesh = dist.init_mesh({"dp": 4, "sharding": 2})
+    sched = plan_grad_reduction({"dp": 4, "sharding": 2}, "os")
+
+    def body(x):
+        def loss(v):
+            v = bucket_reduce_marker(v, schedule=sched)
+            rank = jax.lax.axis_index("dp").astype(jnp.float32)
+            return (v * rank).sum()
+        return jax.grad(loss)(x)
+
+    g = jax.jit(shard_map(body, mesh=mesh, in_specs=P(), out_specs=P(),
+                          axis_names={"dp", "sharding"},
+                          check_vma=False))(jnp.ones(8))
+    # local grad on dp rank r is r; pmean over 4 ranks = mean(0..3) = 1.5
+    np.testing.assert_allclose(np.asarray(g), 1.5, rtol=1e-6)
+
+
+# -- train-step parity on the dp×sharding mesh -------------------------------
+
+def _mlp():
+    pt.seed(7)
+    return nn.Sequential(nn.Linear(64, 128), nn.ReLU(),
+                         nn.Linear(128, 128), nn.ReLU(),
+                         nn.Linear(128, 8))
+
+
+def _loss_fn(out, y):
+    return pt.nn.functional.cross_entropy(out, y)
+
+
+def _batch():
+    rng = np.random.RandomState(0)
+    return (rng.rand(16, 64).astype(np.float32),
+            rng.randint(0, 8, (16,)).astype(np.int64))
+
+
+_CACHE = {}
+
+
+def _train(level, grad_bucket_mb, steps=4):
+    key = (level, grad_bucket_mb, steps)
+    if key in _CACHE:
+        return _CACHE[key]
+    mesh = dist.init_mesh({"dp": 2, "sharding": 4})
+    model = _mlp()
+    opt = pt.optimizer.AdamW(learning_rate=1e-3,
+                             parameters=model.parameters())
+    model, opt, _ = group_sharded_parallel(model, opt, level=level)
+    step, state = build_train_step(model, _loss_fn, opt, mesh=mesh,
+                                   grad_bucket_mb=grad_bucket_mb)
+    x, y = _batch()
+    losses = []
+    for _ in range(steps):
+        loss, state = step(state, x, y)
+        losses.append(float(loss))
+    params = {k: np.asarray(v) for k, v in state["params"].items()}
+    _CACHE[key] = (losses, params)
+    return _CACHE[key]
+
+
+@pytest.mark.parametrize("level", [
+    "os", pytest.param("os_g", marks=pytest.mark.slow)])
+def test_fused_vs_per_param_bit_parity(level):
+    # 2e-6 MB ~= a 2-byte target: every parameter gets its own bucket.
+    # Fusing buckets must not change a single bit over 4 steps.
+    fused_l, fused_p = _train(level, 0.05)
+    per_l, per_p = _train(level, 2e-6)
+    assert fused_l == per_l, (fused_l, per_l)
+    for k in fused_p:
+        np.testing.assert_array_equal(fused_p[k], per_p[k], err_msg=k)
+
+
+def test_bucketed_vs_gspmd_unbucketed_parity():
+    fused_l, fused_p = _train("os", 0.05)
+    base_l, base_p = _train("os", 0)  # mb=0 disables bucketing entirely
+    np.testing.assert_allclose(fused_l, base_l, rtol=0, atol=1.4e-6)
+    for k in fused_p:
+        np.testing.assert_allclose(fused_p[k], base_p[k], rtol=0,
+                                   atol=1e-6, err_msg=k)
+
+
+# -- telemetry ---------------------------------------------------------------
+
+def test_reduce_scatter_metrics_record_fused_payload():
+    obs.get_telemetry().enable()
+    mesh = dist.init_mesh({"dp": 2, "sharding": 4})
+    model = _mlp()
+    params = {k: p._data for k, p in model.named_parameters()}
+    expected = _bucket_plan_for(params, mesh, "os", 0.05)
+    rs = [b for b in expected.buckets if b.kind == "reduce_scatter"]
+    assert expected.n_buckets > 1 and rs
+    opt = pt.optimizer.AdamW(learning_rate=1e-3,
+                             parameters=model.parameters())
+    model2, opt, _ = group_sharded_parallel(model, opt, level="os")
+    pre = obs.get_registry().snapshot()
+    step, state = build_train_step(model2, _loss_fn, opt, mesh=mesh,
+                                   grad_bucket_mb=0.05)
+    x, y = _batch()
+    loss, state = step(state, x, y)
+    jax.block_until_ready(loss)
+    snap = obs.get_registry().snapshot()
+
+    def series(s, name, key, field=None):
+        v = s.get(name, {}).get("series", {}).get(key, 0)
+        return v[field] if field and v else (v or 0)
+
+    # one pt_grad_buckets_total{kind=reduce_scatter} per rs bucket
+    assert (series(snap, "pt_grad_buckets_total", "kind=reduce_scatter")
+            - series(pre, "pt_grad_buckets_total", "kind=reduce_scatter")
+            == len(rs))
+    # pt_collective_bytes{op=reduce_scatter}: ONE sample per bucket,
+    # payload = the fused flat bytes (not one sample per parameter)
+    pre_c = pre.get("pt_collective_bytes", {}).get("series", {}).get(
+        "op=reduce_scatter", {"count": 0, "sum": 0})
+    cur = snap["pt_collective_bytes"]["series"]["op=reduce_scatter"]
+    assert cur["count"] - pre_c["count"] == len(rs)
+    assert cur["sum"] - pre_c["sum"] == sum(b.nbytes for b in rs)

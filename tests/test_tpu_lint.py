@@ -255,6 +255,20 @@ FIXTURES = {
             return loss.item()
         """,
     ),
+    "TPU015": (
+        "paddle_tpu/incubate/models/m.py",
+        """
+        from jax.sharding import PartitionSpec as P
+        def seq_constraint(x):
+            return P("dp", "sep")
+        """,
+        """
+        def seq_constraint(x):
+            from paddle_tpu.distributed.auto_parallel.spec_layout import (
+                default_layout)
+            return default_layout().batch_seq(x.ndim)
+        """,
+    ),
     "TPU014": (
         "paddle_tpu/distributed/mod.py",
         """
@@ -648,6 +662,45 @@ def test_tpu014_silent_on_deferred_def_in_param_loop():
         return hooks
     """
     assert "TPU014" not in rules_fired(src, path="paddle_tpu/x.py")
+
+
+def test_tpu015_scoped_to_model_and_bench_paths():
+    src = """
+    from jax.sharding import PartitionSpec as P
+    def spec():
+        return P("dp")
+    """
+    assert "TPU015" in rules_fired(src, path="paddle_tpu/incubate/models/g.py")
+    assert "TPU015" in rules_fired(src, path="paddle_tpu/vision/models/r.py")
+    assert "TPU015" in rules_fired(src, path="bench.py")
+    assert "TPU015" in rules_fired(src, path="bench_eager.py")
+    # library / infra code is where PartitionSpec construction BELONGS
+    assert "TPU015" not in rules_fired(
+        src, path="paddle_tpu/distributed/train_step.py")
+    assert "TPU015" not in rules_fired(
+        src, path="paddle_tpu/distributed/auto_parallel/spec_layout.py")
+
+
+def test_tpu015_alternate_spellings_fire():
+    src = """
+    import jax.sharding as shd
+    from jax.sharding import PartitionSpec
+    def specs():
+        return [PartitionSpec("mp"), shd.PartitionSpec(None, "mp")]
+    """
+    fired = rules_fired(src, path="paddle_tpu/incubate/models/g.py")
+    assert "TPU015" in fired
+
+
+def test_tpu015_layout_helper_is_silent():
+    src = """
+    def spec(x):
+        from paddle_tpu.distributed.auto_parallel.spec_layout import (
+            default_layout)
+        return default_layout().batch(x.ndim)
+    """
+    assert "TPU015" not in rules_fired(
+        src, path="paddle_tpu/incubate/models/g.py")
 
 
 # -- suppressions ------------------------------------------------------------

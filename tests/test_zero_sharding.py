@@ -90,6 +90,15 @@ class TestZeroSpec:
         mesh = dist.init_mesh({"dp": 2, "sharding": 4})
         assert zero_spec(P(), (7, 9), mesh) == P()
 
+    def test_rank1_bias_leaves(self):
+        mesh = dist.init_mesh({"dp": 2, "sharding": 4})
+        # a divisible bias shards over its only dim
+        assert zero_spec(P(), (256,), mesh) == P("sharding")
+        # an indivisible one stays replicated
+        assert zero_spec(P(), (6,), mesh) == P()
+        # already sharded: inherited unchanged, no double insert
+        assert zero_spec(P("sharding"), (256,), mesh) == P("sharding")
+
 
 class TestZeroStage12:
     def test_os_state_is_partitioned(self):
