@@ -356,6 +356,13 @@ def bench_gpt(result, batch, recompute=True):
     n_params = sum(int(np.prod(p.shape)) for p in params.values())
     result["gpt345m_n_params"] = n_params
 
+    # the graph-level fusion pass wraps the LOSS function (not the whole
+    # step): grad-side consumption of forward intermediates would break
+    # cluster closure on the whole-step jaxpr, while wrapping loss_of
+    # lets the fused kernels' custom VJPs own the backward
+    from paddle_tpu.ops import fusion_pass as _fusion
+    _fusion.reset_stats()
+
     def train_step(params, buffers, opt_state, ids, labels):
         def loss_of(p):
             out, new_buffers = functional_call(
@@ -365,7 +372,7 @@ def bench_gpt(result, batch, recompute=True):
             return loss._data.astype(jnp.float32), new_buffers
 
         (loss, new_buffers), grads = jax.value_and_grad(
-            loss_of, has_aux=True)(params)
+            _fusion.wrap(loss_of), has_aux=True)(params)
         new_params, new_opt = opt.apply_gradients_tree(params, grads,
                                                        opt_state)
         return loss, new_params, new_buffers, new_opt
@@ -380,6 +387,10 @@ def bench_gpt(result, batch, recompute=True):
     t0 = time.perf_counter()
     compiled = step.lower(params, buffers, opt_state, ids, labels).compile()
     result["gpt345m_compile_sec"] = round(time.perf_counter() - t0, 2)
+    # fusion block: which patterns got rewritten at trace time, and which
+    # fell back to the XLA mirror (tpu_unreachable on the CPU fast-fail
+    # path, canary_failed when Mosaic rejects a kernel)
+    result["fusion"] = _fusion.summary()
     flops = _flops_per_step(compiled)
     result["gpt345m_flops_per_step"] = flops
     result["gpt345m_memory"] = _memory_report(compiled)
@@ -698,6 +709,23 @@ def bench_kernels(result):
                    a, w, b, interpret=interp), x),
                fwdbwd_ms(lambda a: fk.layer_norm_reference(a, w, b), x))
 
+    # -- fused-block rows: residual+LN (the fusion pass's residual_ln
+    # cluster — in-kernel add before the stats) at the same shapes ------
+    for tag, rows, d in ln_shapes:
+        if SMOKE:
+            rows, d = min(rows, 512), min(d, 256)
+        x = jnp.asarray(rng.randn(rows, d).astype(np.float32)).astype(
+            jnp.bfloat16)
+        r = jnp.asarray(rng.randn(rows, d).astype(np.float32)).astype(
+            jnp.bfloat16)
+        w = jnp.ones((d,), jnp.bfloat16)
+        b = jnp.zeros((d,), jnp.bfloat16)
+        record(f"residual_ln_{tag}",
+               fwdbwd_ms(lambda a, rr: fk.fused_layer_norm(
+                   a, w, b, residual=rr, interpret=interp), x, r),
+               fwdbwd_ms(lambda a, rr: fk.layer_norm_reference(
+                   a, w, b, residual=rr), x, r))
+
     # -- fused softmax-xent: GPT vocab, BERT vocab, ResNet50 head ------
     xe_shapes = [("gpt345m", 1024, 50304), ("bert", 1024, 30592),
                  ("resnet50_head", 256, 1000)]
@@ -723,6 +751,22 @@ def bench_kernels(result):
            fwdbwd_ms(lambda a: mha(a, k, v, causal=True,
                                    interpret=interp), q),
            fwdbwd_ms(lambda a: mha_reference(a, k, v, causal=True), q))
+
+    # -- attention-block cluster (qk+scale+softmax+pv, the fusion
+    # pass's attention_block rewrite target) at GPT and BERT shapes ----
+    attn_shapes = [("gpt345m", 16, GPT_SEQ, True),
+                   ("bert", 12, BERT_SEQ, False)]
+    for tag, heads, seq, causal in attn_shapes:
+        if SMOKE:
+            heads, seq = min(heads, 4), min(seq, 64)
+        q2, k2, v2 = (jnp.asarray(rng.randn(1, heads, seq, 64).astype(
+            np.float32)).astype(jnp.bfloat16) for _ in range(3))
+        tune_mha(q2, k2, v2, causal=causal, interpret=interp)
+        record(f"attention_block_{tag}",
+               fwdbwd_ms(lambda a: fk.fused_attention_block(
+                   a, k2, v2, causal=causal, interpret=interp), q2),
+               fwdbwd_ms(lambda a: fk.attention_block_reference(
+                   a, k2, v2, causal=causal), q2))
 
     result["kernels"] = kernels
     result["autotune"] = at.summary()

@@ -105,9 +105,9 @@ class BertLayer(Layer):
         self.dropout = Dropout(cfg.hidden_dropout_prob)
 
     def forward(self, x, attention_mask=None):
-        x = self.ln1(x + self.attention(x, attention_mask))
+        x = self.ln1(x, residual=self.attention(x, attention_mask))
         h = self.fc2(F.gelu(self.fc1(x)))
-        return self.ln2(x + self.dropout(h))
+        return self.ln2(x, residual=self.dropout(h))
 
 
 def additive_attention_mask(attention_mask):

@@ -144,7 +144,7 @@ class _LiveState:
 
 class _Entry:
     __slots__ = ("jitted", "struct", "traced_idx", "sg_flags", "statics",
-                 "n_leaves", "sig", "name", "ran", "flops")
+                 "n_leaves", "sig", "name", "ran", "flops", "fusion")
 
 
 class CapturedStep:
@@ -156,7 +156,8 @@ class CapturedStep:
         self._state = None
         self._fallback_reason = None
         self.stats = {"hits": 0, "misses": 0, "compiles": 0,
-                      "fallback": None}
+                      "fallback": None, "fusion_rewrites": 0,
+                      "fusion_patterns": {}}
         try:
             functools.update_wrapper(self, fn)
         except AttributeError:
@@ -370,8 +371,15 @@ class CapturedStep:
         pure.__name__ = f"captured_step({fname})"
         pure.__qualname__ = pure.__name__
 
+        # graph-level fusion: rewrite matched clusters (residual+LN,
+        # LN+matmul, attention block, matmul+bias+gelu) to block-fused
+        # kernels at trace time, before XLA ever sees the step. The wrap
+        # is a transparent passthrough when PT_FUSION_PASS=0 or nothing
+        # matches.
+        from ..ops import fusion_pass as _fusion
+
         entry = _Entry()
-        entry.jitted = jax.jit(pure, donate_argnums=(0, 1, 2))
+        entry.jitted = jax.jit(_fusion.wrap(pure), donate_argnums=(0, 1, 2))
         entry.struct = struct
         entry.traced_idx = tuple(traced_idx)
         entry.sg_flags = tuple(sg_flags)
@@ -381,6 +389,7 @@ class CapturedStep:
         entry.name = pure.__name__
         entry.ran = False
         entry.flops = None
+        entry.fusion = None
         return entry
 
     # -- replay -------------------------------------------------------------
@@ -409,6 +418,8 @@ class CapturedStep:
                     lrs, traced)
                 if entry.flops:
                     tr.record_program_flops(entry.name, entry.flops)
+            from ..ops import fusion_pass as _fusion
+            fusion_before = _fusion.summary()["rewrites"]
             with warnings.catch_warnings():
                 # backends without donation (cpu) warn once at compile;
                 # the annotation is still correct where it counts
@@ -418,6 +429,18 @@ class CapturedStep:
                 outs = call(st.params, st.buffers, st.opt_states, st.rng_ctr,
                             lrs, traced)
             entry.ran = True  # only after the trace actually succeeded
+            # the trace just happened inside that call: the fusion-pass
+            # rewrite delta is this entry's pattern census (part of the
+            # capture contract surfaced by bench_eager)
+            fusion_after = _fusion.summary()["rewrites"]
+            entry.fusion = {
+                k: fusion_after.get(k, 0) - fusion_before.get(k, 0)
+                for k in fusion_after
+                if fusion_after.get(k, 0) > fusion_before.get(k, 0)}
+            for k, n in entry.fusion.items():
+                self.stats["fusion_patterns"][k] = \
+                    self.stats["fusion_patterns"].get(k, 0) + n
+                self.stats["fusion_rewrites"] += n
             self.stats["compiles"] += 1
             tel = _tel()
             if not tel._watcher.installed:

@@ -132,9 +132,9 @@ class LayerNorm(Layer):
         else:
             self.bias = None
 
-    def forward(self, x):
+    def forward(self, x, residual=None):
         return F.layer_norm(x, self._normalized_shape, self.weight, self.bias,
-                            self._epsilon)
+                            self._epsilon, residual=residual)
 
     def extra_repr(self):
         return f"normalized_shape={self._normalized_shape}"

@@ -29,8 +29,8 @@ import time
 __all__ = ["enabled", "set_enabled", "cache_get", "cache_put",
            "cache_clear", "save_cache", "load_cache", "time_candidates",
            "search", "prune_candidates", "roofline_seconds",
-           "analytic_seed", "summary", "KERNEL_SCHEMA",
-           "VMEM_LIMIT_BYTES"]
+           "analytic_seed", "generate_candidates", "bump_schema",
+           "summary", "KERNEL_SCHEMA", "VMEM_LIMIT_BYTES"]
 
 _enabled = False
 _cache: dict = {}
@@ -38,12 +38,28 @@ _autoloaded = False
 _searches: dict = {}          # kernel -> last search stats (bench block)
 
 # Config schema version per kernel: bump when the meaning of a cached
-# config tuple changes (e.g. flash_mha grew tuner-owned clamping in v2).
+# config tuple changes (e.g. flash_mha grew tuner-owned clamping in v2;
+# ln/xent moved from static candidate tables to generated spaces in v2
+# so PR 8-era winners can't be served to the generator-backed search).
 KERNEL_SCHEMA = {
     "flash_mha": 2,
-    "fused_layer_norm": 1,
-    "fused_softmax_xent": 1,
+    "fused_layer_norm": 2,
+    "fused_softmax_xent": 2,
+    "fused_ln_matmul": 1,
+    "fused_matmul_bias_gelu": 1,
 }
+
+
+def bump_schema(kernel: str) -> int:
+    """Bump (register-if-new) a kernel's config schema version.
+
+    The schema version is part of every cache key, so bumping it makes
+    previously persisted winners invisible to :func:`cache_get` and
+    dropped by :func:`load_cache` — the next :func:`search` re-times and
+    re-persists under the new version instead of serving a config whose
+    meaning changed. Returns the new version."""
+    KERNEL_SCHEMA[kernel] = KERNEL_SCHEMA.get(kernel, 1) + 1
+    return KERNEL_SCHEMA[kernel]
 
 # Roofline constants: v4-class core (~275 TFLOP/s bf16 MXU, ~1.2 TB/s
 # HBM). Only the RATIO matters — the roofline orders candidates, the
@@ -195,6 +211,55 @@ def prune_candidates(candidates, cost, vmem_limit=None):
                                         c.get("bytes", 0.0)), cfg))
     scored.sort(key=lambda sc: sc[0])
     return [cfg for _, cfg in scored], pruned
+
+
+def _tile_options(total: int, align: int):
+    """Aligned power-of-two tile sizes up to (and including) ``total``
+    rounded up to ``align`` — the hardware-shaped axis walk every
+    generated candidate space is built from."""
+    cap = max(align, ((int(total) + align - 1) // align) * align)
+    out, t = [], align
+    while t < cap:
+        out.append(t)
+        t *= 2
+    out.append(cap)
+    return sorted(set(out))
+
+
+def generate_candidates(axes, cost, vmem_limit=None, max_candidates=10):
+    """Cost-model-guided candidate *generation* (vs the PR 8 static
+    tables): emit launch-config tuples for a fused cluster from its
+    shape, prune them through ``cost`` exactly like :func:`search`
+    does (vmem overflow / MXU underfill rejected, survivors roofline-
+    ordered), and keep the ``max_candidates`` best for timing.
+
+    ``axes`` describes one config-tuple position each, in order:
+
+    - ``("tile", total, align)`` — aligned pow-2 tile sizes covering
+      ``total`` (clamped to its padded extent),
+    - ``("choice", (a, b, ...))`` — an enumerated option (e.g. the
+      parallel/arbitrary grid-semantics bit).
+
+    Returns the survivors best-roofline-first; raises when the cost
+    model prunes every generated config (same contract as search)."""
+    import itertools
+    options = []
+    for ax in axes:
+        kind = ax[0]
+        if kind == "tile":
+            _, total, align = ax
+            options.append(_tile_options(total, align))
+        elif kind == "choice":
+            options.append(list(ax[1]))
+        else:
+            raise ValueError(f"unknown candidate axis kind {kind!r}")
+    cands = [tuple(c) for c in itertools.product(*options)]
+    survivors, pruned = prune_candidates(cands, cost, vmem_limit)
+    if not survivors:
+        raise RuntimeError(
+            f"autotune: candidate generator pruned every config "
+            f"({len(pruned)} generated and rejected)")
+    return survivors[:max_candidates]
 
 
 # ---------------------------------------------------------------------------

@@ -825,3 +825,62 @@ class AdHocPartitionSpecInModel(Rule):
                        f"(distributed/auto_parallel/spec_layout."
                        f"SpecLayout) so dp/fsdp/tp placements stay in "
                        f"one reviewable place")
+
+
+@register
+class UnfusedResidualNorm(Rule):
+    id = "TPU016"
+    name = "manually-composed-fusable-sequence"
+    rationale = ("a residual add composed inline with a layer norm "
+                 "(`ln(x + attn)`) materializes the sum as a separate HBM "
+                 "round-trip and hides the pair from call sites that "
+                 "bypass the jaxpr fusion pass; layer_norm and "
+                 "nn.LayerNorm take residual= (fused_add_layer_norm is "
+                 "the named form), which feeds the fused_layer_norm "
+                 "kernel's in-kernel add and is also what the graph-level "
+                 "fusion pass recognizes as one residual_ln cluster")
+
+    # model-layer code where fusable sequences get hand-written; ops/
+    # and the lint tool itself stay free to compose primitives
+    _FUSABLE_PATHS = re.compile(
+        r"(^|/)paddle_tpu/(nn|incubate/models)(/|$)")
+    # a LayerNorm module bound on self/a module object: self.ln1, the
+    # embedding's self.layer_norm, post_norm, ...
+    _NORM_ATTR = re.compile(r"^((layer_?)?norm\d*|ln\d*)$", re.IGNORECASE)
+
+    def _is_norm_call(self, node):
+        name = dotted(node.func)
+        last = name.rpartition(".")[2]
+        if last == "layer_norm":
+            return name or last
+        # attribute form only for self-bound layers (self.ln1, self.
+        # layer_norm) — jnp.linalg.norm and friends are not layer norms
+        if (isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"
+                and self._NORM_ATTR.match(node.func.attr)):
+            return name or node.func.attr
+        return None
+
+    @staticmethod
+    def _is_add(expr):
+        if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+            return True
+        return (isinstance(expr, ast.Call)
+                and dotted(expr.func).rpartition(".")[2] == "add")
+
+    def on_call(self, node, ctx):
+        if not self._FUSABLE_PATHS.search(ctx.path_posix):
+            return
+        name = self._is_norm_call(node)
+        if name is None or not node.args:
+            return
+        if any(kw.arg == "residual" for kw in node.keywords):
+            return  # already on the fused entry point
+        if self._is_add(node.args[0]):
+            ctx.report(node, self.id,
+                       f"residual add composed inline with {name}(); "
+                       f"pass the addend as residual= (or call "
+                       f"fused_add_layer_norm) so the add+LN pair runs "
+                       f"as one fused kernel and the fusion pass sees "
+                       f"one residual_ln cluster")

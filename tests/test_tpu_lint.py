@@ -269,6 +269,19 @@ FIXTURES = {
             return default_layout().batch_seq(x.ndim)
         """,
     ),
+    "TPU016": (
+        "paddle_tpu/incubate/models/m.py",
+        """
+        class Block:
+            def forward(self, x, mask):
+                return self.ln1(x + self.attention(x, mask))
+        """,
+        """
+        class Block:
+            def forward(self, x, mask):
+                return self.ln1(x, residual=self.attention(x, mask))
+        """,
+    ),
     "TPU014": (
         "paddle_tpu/distributed/mod.py",
         """
@@ -701,6 +714,48 @@ def test_tpu015_layout_helper_is_silent():
     """
     assert "TPU015" not in rules_fired(
         src, path="paddle_tpu/incubate/models/g.py")
+
+
+def test_tpu016_functional_and_add_call_forms_fire():
+    src = """
+    import paddle_tpu.nn.functional as F
+    def block(x, r, w, b):
+        return F.layer_norm(x + r, 16, w, b)
+    """
+    assert "TPU016" in rules_fired(src, path="paddle_tpu/nn/mod.py")
+    src2 = """
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    def block(x, r, w, b):
+        return F.layer_norm(paddle.add(x, r), 16, w, b)
+    """
+    assert "TPU016" in rules_fired(src2, path="paddle_tpu/nn/mod.py")
+
+
+def test_tpu016_scoped_to_nn_and_incubate_models():
+    src = """
+    def block(self, x, r):
+        return self.ln1(x + r)
+    """
+    assert "TPU016" not in rules_fired(src, path="tests/test_x.py")
+    assert "TPU016" not in rules_fired(src, path="paddle_tpu/ops/mod.py")
+    assert "TPU016" not in rules_fired(src, path="bench.py")
+
+
+def test_tpu016_vector_norms_and_fused_entry_are_silent():
+    # jnp.linalg.norm is a vector norm, not a layer norm
+    src = """
+    import jax.numpy as jnp
+    def penalty(a, b):
+        return jnp.linalg.norm(a + b)
+    """
+    assert "TPU016" not in rules_fired(src, path="paddle_tpu/nn/mod.py")
+    src2 = """
+    import paddle_tpu.nn.functional as F
+    def block(x, r, w, b):
+        return F.fused_add_layer_norm(x, r, 16, w, b)
+    """
+    assert "TPU016" not in rules_fired(src2, path="paddle_tpu/nn/mod.py")
 
 
 # -- suppressions ------------------------------------------------------------
