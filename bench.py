@@ -783,8 +783,11 @@ def _leg_main(name, batch, recompute):
     _honor_cpu_override()
     from paddle_tpu.observability import get_telemetry
     from paddle_tpu.observability.trace import get_tracer
+    from paddle_tpu.observability.goodput import get_goodput
+    from paddle_tpu.observability.numerics import get_monitor
     tel = get_telemetry().enable()  # metrics + compile watch, no sink/server
     tr = get_tracer().enable()      # span sink + analytic-MFU accounting
+    gp = get_goodput().enable()     # wall-clock decomposition over spans
     fields: dict = {}
     rec = {"ok": True, "fields": fields}
     try:
@@ -813,6 +816,8 @@ def _leg_main(name, batch, recompute):
     # step p50/p95, peak device memory at the moment of failure
     fields[f"telemetry_{name}"] = tel.snapshot()
     fields[f"trace_{name}"] = tr.snapshot()
+    fields[f"goodput_{name}"] = gp.snapshot()
+    fields[f"numerics_{name}"] = get_monitor().snapshot()
     print(json.dumps(rec), flush=True)
 
 
@@ -878,8 +883,11 @@ def main():
     # tpu_unreachable fast-fail, where the leg snapshots never happen
     from paddle_tpu.observability import get_telemetry
     from paddle_tpu.observability.trace import get_tracer
+    from paddle_tpu.observability.goodput import get_goodput
+    from paddle_tpu.observability.numerics import get_monitor
     tel = get_telemetry().enable()
     tr = get_tracer().enable()
+    gp = get_goodput().enable()
 
     def remaining():
         return BUDGET_SEC - (time.time() - t_start)
@@ -896,6 +904,14 @@ def main():
         # every printed record carries a trace block — including the
         # tpu_unreachable fast-fail, where only the CPU leg ran
         result["trace"] = tr.snapshot()
+        # …and the goodput/numerics pair rides the same guarantee: the
+        # driver-side decomposition (mostly badput — the parent never
+        # trains) plus the anomaly ledger, best-effort by contract
+        try:
+            result["goodput"] = gp.snapshot()
+            result["numerics"] = get_monitor().snapshot()
+        except Exception:
+            pass
         print(json.dumps(result), flush=True)
 
     def merge(rec, stage):

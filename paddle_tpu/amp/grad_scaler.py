@@ -30,6 +30,7 @@ class AmpScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        self._found_inf_param = None
         self._opt_states = {}
 
     def is_enable(self):
@@ -53,12 +54,18 @@ class AmpScaler:
 
     def _check_grads(self, optimizer):
         found = False
+        self._found_inf_param = None
         for p in optimizer._parameter_list:
             if p.grad is None:
                 continue
             g = p.grad._data
+            # eager AMP legitimately syncs here: the skip decision IS
+            # the host branch. Captured steps route through the
+            # in-graph numerics monitor instead.
+            # tpu-lint: disable=TPU017
             if bool(jnp.any(~jnp.isfinite(g.astype(jnp.float32)))):
                 found = True
+                self._found_inf_param = getattr(p, "name", None)
                 break
         self._found_inf = found
         return found
@@ -82,6 +89,15 @@ class AmpScaler:
         self.unscale_(optimizer)
         if not self._check_grads(optimizer):
             optimizer.step()
+        else:
+            # a skipped step is a classified anomaly, not silence: AMP
+            # runs surface their skip rate through the same counter as
+            # every other numerics trip (never halts — the skip IS the
+            # scaler's recovery mechanism)
+            from ..observability.numerics import get_monitor
+            get_monitor().record_anomaly(
+                "scaler_skip", tensor=self._found_inf_param,
+                detail="loss_scale=%g" % self._scale, halt_ok=False)
         self._opt_states[id(optimizer)] = OptimizerState.STEPPED
 
     def update(self):

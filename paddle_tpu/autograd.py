@@ -383,6 +383,9 @@ def _check_nan_inf(tensors, name):
         if isinstance(d, jax.core.Tracer):
             continue
         if np.issubdtype(np.dtype(d.dtype), np.floating) or d.dtype == jnp.bfloat16:
+            # debug-mode op-output audit: concrete (non-tracer)
+            # values only, and raising eagerly is the feature
+            # tpu-lint: disable=TPU017
             if bool(jnp.any(~jnp.isfinite(d))):
                 raise FloatingPointError(
                     f"NaN/Inf detected in output of op '{name or 'unknown'}'")

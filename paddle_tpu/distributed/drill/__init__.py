@@ -70,6 +70,17 @@ pid) with a strictly positive measured overlap fraction.  Fault
 drills run with ``flight_dir`` set additionally prove the SIGKILLed
 victim left a parseable flight-recorder dump behind.
 
+Numerics drills (:func:`.runner.run_numerics_drill`) exercise the
+numerics sentinels end-to-end: every worker trains a REAL captured
+MLP with the monitor armed, one rank's input is poisoned with a NaN
+at a scripted step (same shape/dtype — no retrace), and the drill
+proves the poisoned rank detected the trip within one cadence window,
+named the offending parameter path, and left a flight dump carrying
+that name — while every clean rank stayed quiet and each captured
+step compiled exactly once.  The halt variant proves
+``PT_NUMERICS_HALT`` converts the trip into a clean
+``EXIT_NUMERICS_HALT`` exit instead of a poisoned-forever run.
+
 Overlap drills (:func:`.runner.run_overlap_drill`) exercise the
 optimization half of GC3: the span timelines pinned down by the
 bucketed vs monolithic gradient reduction (real ``partition_buckets``
@@ -83,8 +94,9 @@ schedule — and proves the scheduled buckets lift overlap from 0 to
 above one half.
 """
 __all__ = ["KillSpec", "StoreKillSpec", "ObsSpec", "TraceSpec",
-           "run_drill", "run_store_kill_drill", "run_scrape_drill",
-           "run_trace_drill", "run_overlap_drill",
+           "NumericsSpec", "run_drill", "run_store_kill_drill",
+           "run_scrape_drill", "run_trace_drill",
+           "run_numerics_drill", "run_overlap_drill",
            "run_sharded_overlap_drill", "spawn_worker",
            "spawn_store_master", "spawn_aggregator", "reap_all"]
 

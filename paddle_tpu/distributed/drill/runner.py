@@ -29,15 +29,18 @@ from ...utils.retry import wait_until
 from ..checkpoint import read_leaf, verify_checkpoint
 from ..checkpoint_manager import CheckpointManager
 from ..resilient_store import ResilientStore, read_endpoint_file
-from .worker import (EXIT_SAVE_FAILED, EXIT_STORE_LOST, advance,
-                     init_state, obs_ready_key, obs_release_key,
-                     trace_report_path)
+from .worker import (EXIT_NUMERICS_HALT, EXIT_SAVE_FAILED,
+                     EXIT_STORE_LOST, advance, init_state,
+                     numerics_report_path, obs_ready_key,
+                     obs_release_key, trace_report_path)
 
 __all__ = ["KillSpec", "StoreKillSpec", "ObsSpec", "TraceSpec",
-           "DrillFailure", "spawn_worker", "spawn_store_master",
-           "spawn_aggregator", "run_drill", "run_store_kill_drill",
-           "run_scrape_drill", "run_trace_drill", "run_overlap_drill",
-           "run_sharded_overlap_drill", "reap_all"]
+           "NumericsSpec", "DrillFailure", "spawn_worker",
+           "spawn_store_master", "spawn_aggregator", "run_drill",
+           "run_store_kill_drill", "run_scrape_drill",
+           "run_trace_drill", "run_numerics_drill",
+           "run_overlap_drill", "run_sharded_overlap_drill",
+           "reap_all"]
 
 logger = logging.getLogger(__name__)
 
@@ -81,15 +84,17 @@ class ObsSpec:
     trip), then hold the endpoint open until released."""
 
     __slots__ = ("telemetry_dir", "step_base", "storm",
-                 "sentinel_threshold", "hold_timeout")
+                 "sentinel_threshold", "hold_timeout", "anomalies")
 
     def __init__(self, telemetry_dir, step_base=0.01, storm=True,
-                 sentinel_threshold=3, hold_timeout=120.0):
+                 sentinel_threshold=3, hold_timeout=120.0,
+                 anomalies=0):
         self.telemetry_dir = telemetry_dir
         self.step_base = float(step_base)
         self.storm = bool(storm)
         self.sentinel_threshold = int(sentinel_threshold)
         self.hold_timeout = float(hold_timeout)
+        self.anomalies = int(anomalies)
 
 
 class TraceSpec:
@@ -106,6 +111,26 @@ class TraceSpec:
         self.trace_dir = trace_dir
         self.flight_dir = flight_dir
         self.step_ms = float(step_ms)
+
+
+class NumericsSpec:
+    """Scripted NaN-injection worker (``DRILL_NUMERICS=1``): train a
+    real captured MLP with the numerics monitor armed, poison one
+    input element with NaN on ``poison_rank`` at ``poison_step``, and
+    write a per-rank detection report into ``out_dir``.  ``halt``
+    arms ``PT_NUMERICS_HALT`` semantics (worker exits
+    ``EXIT_NUMERICS_HALT`` after the sentinel raises)."""
+
+    __slots__ = ("out_dir", "poison_step", "poison_rank", "cadence",
+                 "halt")
+
+    def __init__(self, out_dir, poison_step=5, poison_rank=1,
+                 cadence=4, halt=False):
+        self.out_dir = out_dir
+        self.poison_step = int(poison_step)
+        self.poison_rank = int(poison_rank)
+        self.cadence = int(cadence)
+        self.halt = bool(halt)
 
 
 class StoreKillSpec:
@@ -144,7 +169,7 @@ def spawn_worker(rank, world, *, root, port=0, total_steps, run_id,
                  barrier_timeout, kill=None, elastic=True,
                  orphan_age=None, log_path=None, endpoint_file=None,
                  store_deadline=None, storekill=None, obs=None,
-                 trace=None, flight_dir=None):
+                 trace=None, numerics=None, flight_dir=None):
     """Launch one drill worker subprocess; returns its Popen (also
     registered for :func:`reap_all`).
 
@@ -155,8 +180,10 @@ def spawn_worker(rank, world, *, root, port=0, total_steps, run_id,
     :class:`ObsSpec`) switches the worker to the cluster-observability
     mode (requires ``endpoint_file``; ``total_steps`` becomes the
     synthetic step count); ``trace`` (a :class:`TraceSpec`) switches
-    to the storeless step-tracing mode; ``flight_dir`` arms the flight
-    recorder in a checkpoint-mode worker (``PT_FLIGHT_RECORDER``).
+    to the storeless step-tracing mode; ``numerics`` (a
+    :class:`NumericsSpec`) switches to the storeless NaN-injection
+    mode; ``flight_dir`` arms the flight recorder
+    (``PT_FLIGHT_RECORDER``).
     """
     env = {k: v for k, v in os.environ.items()
            if not k.startswith("DRILL_")}
@@ -198,12 +225,21 @@ def spawn_worker(rank, world, *, root, port=0, total_steps, run_id,
         env["DRILL_OBS_STORM"] = "1" if obs.storm else "0"
         env["DRILL_OBS_TIMEOUT"] = str(obs.hold_timeout)
         env["PT_RECOMPILE_THRESHOLD"] = str(obs.sentinel_threshold)
+        if obs.anomalies:
+            env["DRILL_OBS_ANOMALIES"] = str(obs.anomalies)
     if trace is not None:
         env["DRILL_TRACE"] = "1"
         env["DRILL_TRACE_DIR"] = trace.trace_dir
         env["DRILL_TRACE_STEP_MS"] = str(trace.step_ms)
         if trace.flight_dir:
             env["PT_FLIGHT_RECORDER"] = trace.flight_dir
+    if numerics is not None:
+        env["DRILL_NUMERICS"] = "1"
+        env["DRILL_NUMERICS_DIR"] = numerics.out_dir
+        env["DRILL_POISON_STEP"] = str(numerics.poison_step)
+        env["DRILL_POISON_RANK"] = str(numerics.poison_rank)
+        env["DRILL_NUMERICS_CADENCE"] = str(numerics.cadence)
+        env["DRILL_NUMERICS_HALT"] = "1" if numerics.halt else "0"
     if flight_dir is not None:
         env["PT_FLIGHT_RECORDER"] = flight_dir
     cmd = [sys.executable, "-m", "paddle_tpu.distributed.drill.worker"]
@@ -265,8 +301,9 @@ def spawn_store_master(*, endpoint_file, wal_path=None, port=0,
 
 def spawn_aggregator(*, endpoint_file, run_id, port_file,
                      interval=0.25, stale_after=2.0, storm_threshold=1,
-                     scrape_timeout=2.0, store_deadline=10.0,
-                     log_path=None, spawn_timeout=60.0):
+                     anomaly_threshold=10, scrape_timeout=2.0,
+                     store_deadline=10.0, log_path=None,
+                     spawn_timeout=60.0):
     """Launch the cluster aggregator as a REAL subprocess
     (``python -m paddle_tpu.observability.aggregator``) discovering
     rank endpoints through the store, and wait for it to publish its
@@ -287,7 +324,8 @@ def spawn_aggregator(*, endpoint_file, run_id, port_file,
            "--interval", str(interval),
            "--stale-after", str(stale_after),
            "--scrape-timeout", str(scrape_timeout),
-           "--storm-threshold", str(storm_threshold)]
+           "--storm-threshold", str(storm_threshold),
+           "--anomaly-threshold", str(anomaly_threshold)]
     if log_path:
         with open(log_path, "ab") as out:
             p = subprocess.Popen(cmd, env=env, stdout=out,
@@ -628,7 +666,8 @@ def run_store_kill_drill(root, *, world=2, total_steps=5, kill_step=3,
 
 
 def run_scrape_drill(root, *, world=3, steps=12, step_base=0.01,
-                     kill_rank=2, storm=True, restart_aggregator=False,
+                     kill_rank=2, storm=True, anomalies=0,
+                     restart_aggregator=False,
                      respawn_master=False, stale_after=2.0,
                      scrape_interval=0.25, store_deadline=10.0,
                      gen_timeout=120.0, log_dir=None):
@@ -639,6 +678,14 @@ def run_scrape_drill(root, *, world=3, steps=12, step_base=0.01,
     buckets, a nonzero cross-rank step-time skew (each rank's synthetic
     step profile is ``step_base * (1 + rank)``), and (when ``storm``)
     the recompile-storm alarm tripping on the CROSS-RANK aggregate.
+
+    Every obs worker also feeds a deterministic synthetic goodput
+    profile (1/5 data_wait, 4/5 compute per virtual step), so the
+    derived ``pt_cluster_goodput`` min/mean must both read exactly
+    0.8; ``anomalies`` (per-rank scripted numerics trips) arms the
+    cross-rank anomaly alarm, whose threshold is then set to
+    ``world * anomalies`` so it trips exactly — and flips /healthz to
+    503 even without a recompile storm.
 
     ``kill_rank`` (None to skip) is then SIGKILLed while still holding
     its endpoint open: the aggregator must mark it stale
@@ -659,6 +706,7 @@ def run_scrape_drill(root, *, world=3, steps=12, step_base=0.01,
     os.makedirs(telemetry_dir, exist_ok=True)
     sentinel_threshold = 3
     storm_threshold = world if storm else world * 1000
+    anomaly_threshold = world * anomalies if anomalies else world * 1000
 
     def _log(name):
         return os.path.join(log_dir, name) if log_dir else None
@@ -669,7 +717,7 @@ def run_scrape_drill(root, *, world=3, steps=12, step_base=0.01,
     run_id = f"obs-{uuid.uuid4().hex[:6]}"
     spec = ObsSpec(telemetry_dir=telemetry_dir, step_base=step_base,
                    storm=storm, sentinel_threshold=sentinel_threshold,
-                   hold_timeout=gen_timeout)
+                   hold_timeout=gen_timeout, anomalies=anomalies)
     report = {"run_id": run_id, "world": world, "steps": steps,
               "aggregator_restarted": False, "master_respawned": False}
     watch = None
@@ -696,6 +744,7 @@ def run_scrape_drill(root, *, world=3, steps=12, step_base=0.01,
             endpoint_file=endpoint_file, run_id=run_id,
             port_file=port_file, interval=scrape_interval,
             stale_after=stale_after, storm_threshold=storm_threshold,
+            anomaly_threshold=anomaly_threshold,
             store_deadline=store_deadline,
             log_path=_log("aggregator.log"))
         base = f"http://{ahost}:{aport}"
@@ -777,13 +826,51 @@ def run_scrape_drill(root, *, world=3, steps=12, step_base=0.01,
             if alarm not in (0.0, None):
                 raise DrillFailure(
                     f"storm alarm tripped ({alarm}) without a storm")
-            if status != 200:
+            want = 503 if anomalies else 200
+            if status != want:
                 raise DrillFailure(
-                    f"/healthz returned {status}, expected 200")
+                    f"/healthz returned {status}, expected {want}")
+
+        # --- derived fleet goodput: every obs worker's synthetic span
+        # profile is 1/5 data_wait + 4/5 compute, so min == mean == 0.8
+        gp_min = _sample_value(fams, "pt_cluster_goodput", stat="min")
+        gp_mean = _sample_value(fams, "pt_cluster_goodput", stat="mean")
+        for label, v in (("min", gp_min), ("mean", gp_mean)):
+            if v is None or abs(v - 0.8) > 1e-6:
+                raise DrillFailure(
+                    f"pt_cluster_goodput{{stat={label}}} is {v!r}; the "
+                    f"scripted span profile pins it to 0.8 exactly")
+        hgp = health.get("cluster_goodput") or {}
+        if abs(hgp.get("min", -1.0) - 0.8) > 1e-6:
+            raise DrillFailure(
+                f"/healthz cluster_goodput {hgp!r}, expected min 0.8")
+
+        # --- cross-rank anomaly storm, mirroring the recompile trip --
+        anomalies_total = _sample_value(
+            fams, "pt_cluster_numerics_anomalies_total")
+        anomaly_alarm = _sample_value(
+            fams, "pt_cluster_numerics_anomaly_alarm")
+        if anomalies:
+            if anomalies_total != float(world * anomalies):
+                raise DrillFailure(
+                    f"cluster numerics anomalies {anomalies_total}, "
+                    f"expected {world * anomalies}")
+            if anomaly_alarm != 1.0 or not health.get("anomaly_alarm"):
+                raise DrillFailure(
+                    f"anomaly alarm metric={anomaly_alarm} "
+                    f"healthz={health.get('anomaly_alarm')}, expected "
+                    f"tripped at threshold {anomaly_threshold}")
+        elif anomaly_alarm not in (0.0, None):
+            raise DrillFailure(
+                f"anomaly alarm tripped ({anomaly_alarm}) without "
+                f"scripted anomalies")
         report.update({
             "skew_seconds": skew, "straggler_ratio": straggler,
             "merged_steps": hist_count, "storms_total": storms_total,
             "storm_alarm": alarm, "healthz": health,
+            "cluster_goodput": {"min": gp_min, "mean": gp_mean},
+            "anomalies_total": anomalies_total,
+            "anomaly_alarm": anomaly_alarm,
         })
 
         if respawn_master:
@@ -847,6 +934,7 @@ def run_scrape_drill(root, *, world=3, steps=12, step_base=0.01,
                 port_file=port_file, interval=scrape_interval,
                 stale_after=stale_after,
                 storm_threshold=storm_threshold,
+                anomaly_threshold=anomaly_threshold,
                 store_deadline=store_deadline,
                 log_path=_log("aggregator_restart.log"))
             base = f"http://{ahost}:{aport}"
@@ -1054,6 +1142,144 @@ def run_trace_drill(root, *, world=2, steps=6, step_ms=10.0,
                 f"{world} ranks x {steps} steps x 4 phases")
         report.update({"merged_events": x_events,
                        "merged_path": merged_path})
+    finally:
+        reap_all()
+    return report
+
+
+def run_numerics_drill(root, *, world=2, steps=12, poison_step=5,
+                       poison_rank=1, cadence=4, halt=False,
+                       gen_timeout=120.0, log_dir=None):
+    """NaN-injection numerics drill: ``world`` REAL worker processes
+    each train a captured MLP on CPU with the numerics monitor armed;
+    ``poison_rank`` overwrites one input element with NaN at
+    ``poison_step`` (same shape/dtype — the capture cache must not
+    retrace).  The runner asserts from each rank's report that the
+    poisoned rank's sentinel fired within ONE cadence window of the
+    injection, named a real parameter path (or the loss), and left a
+    flight dump whose recorded reason carries that name; that every
+    clean rank stayed quiet (zero anomalies); and that every rank
+    compiled its captured step exactly once.  With ``halt`` the
+    poisoned worker must exit ``EXIT_NUMERICS_HALT`` cleanly (report
+    still written); otherwise every rank exits 0.  Storeless: no
+    TCPStore master, no checkpoints.  Returns a report dict."""
+    out_dir = os.path.join(root, "numerics")
+    flight_dir = os.path.join(root, "flight")
+    os.makedirs(out_dir, exist_ok=True)
+    run_id = f"numerics-{uuid.uuid4().hex[:6]}"
+    spec = NumericsSpec(out_dir=out_dir, poison_step=poison_step,
+                        poison_rank=poison_rank, cadence=cadence,
+                        halt=halt)
+    report = {"run_id": run_id, "world": world, "steps": steps,
+              "poison_step": poison_step, "poison_rank": poison_rank,
+              "cadence": cadence, "halt": halt}
+    try:
+        procs = [
+            spawn_worker(
+                r, world, root=root, total_steps=steps, run_id=run_id,
+                barrier_timeout=gen_timeout, numerics=spec,
+                flight_dir=flight_dir,
+                log_path=(os.path.join(log_dir, f"numerics_rank{r}.log")
+                          if log_dir else None))
+            for r in range(world)
+        ]
+        rcs = _wait_fleet(procs, gen_timeout)
+        report["rcs"] = rcs
+        for r, rc in enumerate(rcs):
+            want = EXIT_NUMERICS_HALT if (halt and r == poison_rank) \
+                else 0
+            if rc != want:
+                raise DrillFailure(
+                    f"numerics rank {r} exited {rc}, expected {want}")
+
+        ranks = {}
+        for r in range(world):
+            rep_path = numerics_report_path(out_dir, r)
+            try:
+                with open(rep_path, "r", encoding="utf-8") as f:
+                    rep = json.load(f)
+            except (OSError, ValueError) as e:
+                raise DrillFailure(
+                    f"rank {r} wrote no parseable numerics report at "
+                    f"{rep_path}: {e}") from e
+            ranks[r] = rep
+            if rep.get("compiles") != 1:
+                raise DrillFailure(
+                    f"rank {r} compiled its captured step "
+                    f"{rep.get('compiles')} times; the monitored step "
+                    f"must stay at exactly 1 compile")
+            if rep.get("fallback"):
+                raise DrillFailure(
+                    f"rank {r} fell back to eager "
+                    f"{rep.get('fallback')} times")
+        report["ranks"] = ranks
+
+        # --- the poisoned rank: detection, naming, flight dump -------
+        rep = ranks[poison_rank]
+        detected = rep.get("detected_step")
+        if detected is None:
+            raise DrillFailure(
+                f"poisoned rank {poison_rank} never detected the "
+                f"injected NaN: {rep!r}")
+        if not poison_step <= detected <= poison_step + cadence:
+            raise DrillFailure(
+                f"detection at step {detected} is outside one cadence "
+                f"window [{poison_step}, {poison_step + cadence}] of "
+                f"the injection")
+        if not rep.get("anomalies", {}).get("nonfinite"):
+            raise DrillFailure(
+                f"poisoned rank booked no 'nonfinite' anomaly: "
+                f"{rep.get('anomalies')!r}")
+        param_trips = [t for t in rep.get("tripped") or []
+                       if t != "loss"]
+        if not param_trips:
+            raise DrillFailure(
+                f"sentinel named no parameter path, only "
+                f"{rep.get('tripped')!r}; a poisoned input must "
+                f"surface non-finite grads by name")
+        if halt and not rep.get("halted"):
+            raise DrillFailure(
+                "halt variant: the sentinel raise was never observed")
+        fpath = rep.get("flight")
+        try:
+            with open(fpath, "r", encoding="utf-8") as f:
+                flight = json.load(f)
+        except (TypeError, OSError, ValueError) as e:
+            raise DrillFailure(
+                f"poisoned rank's flight dump unreadable at "
+                f"{fpath!r}: {e}") from e
+        reason = flight.get("reason") or ""
+        named = reason.split(":", 2)[2] if reason.count(":") >= 2 \
+            else ""
+        if not reason.startswith("numerics:nonfinite") \
+                or named not in param_trips:
+            raise DrillFailure(
+                f"flight dump reason {reason!r} must pin the first "
+                f"non-finite trip to a parameter path (one of "
+                f"{param_trips!r})")
+        if flight.get("process_index") != poison_rank:
+            raise DrillFailure(
+                f"flight dump identity "
+                f"{flight.get('process_index')!r} != poisoned rank "
+                f"{poison_rank}")
+        report.update({"detected_step": detected,
+                       "named_tensor": named,
+                       "flight_reason": reason})
+
+        # --- clean ranks stay quiet ----------------------------------
+        for r in range(world):
+            if r == poison_rank:
+                continue
+            rep = ranks[r]
+            if rep.get("anomalies"):
+                raise DrillFailure(
+                    f"clean rank {r} booked anomalies "
+                    f"{rep['anomalies']!r}; the sentinel must stay "
+                    f"quiet on healthy data")
+            if rep.get("detected_step") is not None:
+                raise DrillFailure(
+                    f"clean rank {r} claims detection at step "
+                    f"{rep['detected_step']}")
     finally:
         reap_all()
     return report

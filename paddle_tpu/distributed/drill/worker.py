@@ -54,7 +54,17 @@ Run as ``python -m paddle_tpu.distributed.drill.worker`` with the
    ``obs/<run_id>/ready/<rank>`` and holds the endpoint open until the
    runner sets ``obs/<run_id>/release`` (bounded by
    ``DRILL_OBS_TIMEOUT``) — the window in which the aggregator
-   scrapes, a victim is SIGKILLed, masters respawn.
+   scrapes, a victim is SIGKILLed, masters respawn.  Obs workers also
+   expose a deterministic ``pt_goodput_fraction`` (0.8 by synthetic
+   span construction) and ``DRILL_OBS_ANOMALIES=n`` scripted numerics
+   anomalies, feeding the aggregator's fleet-goodput series and
+   anomaly-storm alarm.
+ - ``DRILL_NUMERICS=1``: NaN-injection mode (:func:`_numerics_main`) —
+   storeless.  Each rank trains a real captured MLP with the numerics
+   monitor armed; ``DRILL_POISON_STEP``/``DRILL_POISON_RANK`` script
+   the injection, ``DRILL_NUMERICS_CADENCE`` the read cadence,
+   ``DRILL_NUMERICS_HALT=1`` the halt variant (clean exit 21), and the
+   per-rank report lands in ``DRILL_NUMERICS_DIR``.
 
 The "model" is a (12, 4) fp32 array row-partitioned across ranks via
 :class:`~paddle_tpu.distributed.checkpoint.HostLocalShard` (12 divides
@@ -68,8 +78,9 @@ Exit codes: 0 = reached ``DRILL_TOTAL_STEPS``; 17 = a save failed
 cleanly (barrier timeout after a peer died — the survivor's correct
 move is to exit and await relaunch); 19 = the store master stayed
 unreachable or was generation-fenced (StoreUnavailableError — the
-clean degradation the failover drills assert); SIGKILL death reports
--9 to the runner.
+clean degradation the failover drills assert); 21 = the numerics
+sentinel halted the run (PT_NUMERICS_HALT — the clean stop the NaN
+drill asserts); SIGKILL death reports -9 to the runner.
 """
 from __future__ import annotations
 
@@ -84,6 +95,7 @@ import numpy as np
 ROWS, COLS = 12, 4
 EXIT_SAVE_FAILED = 17
 EXIT_STORE_LOST = 19
+EXIT_NUMERICS_HALT = 21
 
 logger = logging.getLogger("paddle_tpu.drill.worker")
 
@@ -136,6 +148,31 @@ def _obs_main(env, rank, world, total, run_id):
     try:
         tel.publish_endpoint(store, world_size=world)
         base = float(env.get("DRILL_OBS_STEP_BASE", "0.01"))
+        # goodput feed: a deterministic synthetic span profile — each
+        # virtual step is 1/5 data_wait, 4/5 compute — so every rank
+        # exposes pt_goodput_fraction == 0.8 exactly and the aggregator's
+        # pt_cluster_goodput min/mean derivation is assertable
+        from ...observability.goodput import get_goodput
+        from ...observability.trace import get_tracer
+        tr = get_tracer().enable(process_index=rank, run_id=run_id)
+        gp = get_goodput().enable()
+        step_ns = 10_000_000
+        origin = time.perf_counter_ns()
+        for s in range(total):
+            t0 = origin + s * step_ns
+            tr.phase_record("data_wait", t0, t0 + step_ns // 5)
+            tr.phase_record("backward", t0 + step_ns // 5, t0 + step_ns)
+        gp.refresh()
+        n_anoms = int(env.get("DRILL_OBS_ANOMALIES", "0"))
+        if n_anoms:
+            # scripted numerics anomalies: feeds the aggregator's
+            # anomaly-storm alarm the same way OBS_STORM feeds the
+            # recompile alarm
+            from ...observability.numerics import get_monitor
+            mon = get_monitor().enable()
+            for _ in range(n_anoms):
+                mon.record_anomaly("drill", tensor="drill::w",
+                                   halt_ok=False)
         for _ in range(total):
             # synthetic, rank-scaled durations: rank r's mean step is
             # base*(1+r), so cluster skew is exactly base*(world-1)>0
@@ -211,6 +248,104 @@ def _trace_main(env, rank, world, total, run_id):
     sys.exit(0)
 
 
+def numerics_report_path(out_dir, rank):
+    """Per-rank numerics-drill report (detection evidence JSON)."""
+    return os.path.join(out_dir, f"numerics_report-{rank}.json")
+
+
+def _numerics_main(env, rank, world, total, run_id):
+    """NaN-injection drill mode (``DRILL_NUMERICS=1``): storeless.
+
+    Each rank trains a real captured MLP on CPU with the numerics
+    monitor armed (cadence ``DRILL_NUMERICS_CADENCE``). At step
+    ``DRILL_POISON_STEP`` the poison rank (``DRILL_POISON_RANK``)
+    overwrites one input element with NaN — same shape and dtype, so
+    the capture cache must NOT retrace — which poisons that step's
+    loss, grads, and (through the momentum update) every parameter
+    after it. The report records when the sentinel fired, what it
+    named, and the flight-dump path; with ``DRILL_NUMERICS_HALT=1``
+    the raise is caught and the worker exits ``EXIT_NUMERICS_HALT``
+    cleanly after writing its report.
+    """
+    out_dir = env["DRILL_NUMERICS_DIR"]
+    poison_step = int(env.get("DRILL_POISON_STEP", "-1"))
+    poison_rank = int(env.get("DRILL_POISON_RANK", "0"))
+    cadence = int(env.get("DRILL_NUMERICS_CADENCE", "4"))
+    halt = env.get("DRILL_NUMERICS_HALT") == "1"
+
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+    from ...observability.numerics import get_monitor, NumericsHaltError
+    from ...observability.trace import get_tracer
+
+    mon = get_monitor().enable(cadence=cadence, halt=halt)
+    tr = get_tracer()  # enabled iff the runner set PT_FLIGHT_RECORDER
+
+    np.random.seed(rank)
+    pt.seed(rank)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    opt = pt.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                parameters=model.parameters())
+    mse = nn.MSELoss()
+
+    @pt.jit.capture_step
+    def step(x, y):
+        loss = mse(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    x = np.random.randn(4, 8).astype(np.float32)
+    y = pt.to_tensor(np.random.randn(4, 1).astype(np.float32))
+    detected_step = None
+    halted = False
+    for s in range(1, total + 1):
+        xb = x.copy()
+        if rank == poison_rank and s == poison_step:
+            xb[0, 0] = np.nan
+            logger.info("poisoning input at step %d", s)
+        try:
+            step(pt.to_tensor(xb), y)
+        except NumericsHaltError as e:
+            logger.info("sentinel halt at step %d: %s", s, e)
+            halted = True
+            detected_step = s
+            break
+        if detected_step is None and mon.anomaly_count("nonfinite"):
+            detected_step = s
+    if detected_step is None:
+        mon.flush()  # end-of-run read covers runs shorter than cadence
+        if mon.anomaly_count("nonfinite"):
+            detected_step = total
+    snap = mon.snapshot()
+    report = {
+        "rank": rank,
+        "world": world,
+        "steps": total,
+        "poison_step": poison_step if rank == poison_rank else None,
+        "cadence": cadence,
+        "halt": halt,
+        "halted": halted,
+        "detected_step": detected_step,
+        "anomalies": snap["anomalies"],
+        "tripped": snap["tripped"],
+        "last_anomaly": snap["last_anomaly"],
+        "reads": snap["reads"],
+        "compiles": step.stats["compiles"],
+        "fallback": step.stats["fallback"],
+        "flight": tr.flight_path if tr.enabled else None,
+    }
+    path = numerics_report_path(out_dir, rank)
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(report, f)
+    os.replace(tmp, path)
+    logger.info("numerics drill: detected_step=%s anomalies=%s",
+                detected_step, snap["anomalies"])
+    sys.exit(EXIT_NUMERICS_HALT if halted else 0)
+
+
 def _arm_storekill(store, rank, run_id, step, phase, timeout):
     """Wire the master-kill rendezvous: returns ``(phase, rendezvous)``.
 
@@ -278,6 +413,9 @@ def main():
     if env.get("DRILL_OBS") == "1":
         _obs_main(env, rank, world, total, run_id)
         return  # unreachable (_obs_main exits), defensive only
+    if env.get("DRILL_NUMERICS") == "1":
+        _numerics_main(env, rank, world, total, run_id)
+        return  # unreachable (_numerics_main exits), defensive only
 
     # arm the scripted kill BEFORE any checkpoint machinery runs
     from . import injector

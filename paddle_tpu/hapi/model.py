@@ -144,6 +144,9 @@ class Model:
         self._eval_step_fn = None
         self._opt_state = None
         self.stop_training = False
+        self._monitor = None
+        self._mon_names = []
+        self._mon_step = 0
 
     # -- setup ---------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
@@ -189,11 +192,37 @@ class Model:
         def apply_step(params, grads, opt_state):
             return opt.apply_gradients_tree(params, grads, opt_state)
 
+        # numerics sentinel: like capture, the decision is baked at
+        # build/trace time so health outputs compile into the same
+        # program — a monitored fit never gains a second compile or a
+        # per-step host sync
+        from ..observability import numerics as _numerics
+        mon = _numerics.get_monitor()
+        mon = mon if mon.enabled else None
+        self._monitor = mon
+        self._mon_names = mon_box = []
+        self._mon_step = 0
+
         def train_step(params, buffers, opt_state, key, inputs, labels):
             loss_v, preds, new_buffers, grads = grad_step(
                 params, buffers, key, inputs, labels)
             new_params, new_opt_state = apply_step(params, grads, opt_state)
-            return loss_v, preds, new_params, new_buffers, new_opt_state
+            if mon is None:
+                return loss_v, preds, new_params, new_buffers, new_opt_state
+            names, health = _numerics.health_outputs(
+                grads, loss=loss_v, with_stats=mon.stats_on)
+            mon_box[:] = [names]
+            return (loss_v, preds, new_params, new_buffers, new_opt_state,
+                    health)
+
+        def apply_step_mon(params, grads, opt_state, loss_v):
+            # split-path twin (tracer on): health rides on the
+            # optimizer program, where the grads are already in hand
+            new_params, new_opt_state = apply_step(params, grads, opt_state)
+            names, health = _numerics.health_outputs(
+                grads, loss=loss_v, with_stats=mon.stats_on)
+            mon_box[:] = [names]
+            return new_params, new_opt_state, health
 
         def eval_step(params, buffers, inputs, labels):
             outs, _ = functional_call(
@@ -222,7 +251,9 @@ class Model:
             if opt is not None else None
         self._grad_step_jit = jax.jit(_fusion.wrap(grad_step)) \
             if opt is not None else None
-        self._apply_step_jit = jax.jit(apply_step) if opt is not None else None
+        self._apply_step_jit = jax.jit(
+            apply_step_mon if mon is not None else apply_step) \
+            if opt is not None else None
         self._eval_step_jit = jax.jit(_fusion.wrap(eval_step))
 
     def _param_arrays(self):
@@ -248,6 +279,8 @@ class Model:
                 self._opt_state = self._optimizer.init_state_tree(params)
             key = _random.next_key()
             tr = get_tracer()
+            mon = self._monitor
+            health = None
             if tr.enabled:
                 # split path: "backward" is the fused forward+backward
                 # value_and_grad program (no pure-forward phase exists in
@@ -258,8 +291,17 @@ class Model:
                         params, buffers, key,
                         _arrays(inputs), _arrays(labels))
                 with tr.phase("optimizer"):
-                    new_params, new_opt = self._apply_step_jit(
-                        params, grads, self._opt_state)
+                    if mon is not None:
+                        new_params, new_opt, health = self._apply_step_jit(
+                            params, grads, self._opt_state, loss_v)
+                    else:
+                        new_params, new_opt = self._apply_step_jit(
+                            params, grads, self._opt_state)
+            elif mon is not None:
+                (loss_v, preds, new_params, new_buffers, new_opt,
+                 health) = self._train_step_jit(
+                    params, buffers, self._opt_state, key,
+                    _arrays(inputs), _arrays(labels))
             else:
                 loss_v, preds, new_params, new_buffers, new_opt = \
                     self._train_step_jit(params, buffers, self._opt_state,
@@ -270,6 +312,12 @@ class Model:
                 self._opt_state = new_opt
                 if self._optimizer._learning_rate_scheduler is not None:
                     pass  # stepped per-epoch by callbacks/fit
+            if mon is not None and health is not None and self._mon_names:
+                # after the writeback so a PT_NUMERICS_HALT raise leaves
+                # the model in the post-step state (same as capture)
+                step_i = self._mon_step
+                self._mon_step += 1
+                mon.watch(step_i, self._mon_names[0], health)
         metrics_out = []
         for m in self._metrics:
             corr = m.compute(Tensor(preds[0]), Tensor(_arrays(labels)[0]))

@@ -144,7 +144,8 @@ class _LiveState:
 
 class _Entry:
     __slots__ = ("jitted", "struct", "traced_idx", "sg_flags", "statics",
-                 "n_leaves", "sig", "name", "ran", "flops", "fusion")
+                 "n_leaves", "sig", "name", "ran", "flops", "fusion",
+                 "monitored", "monitor_names")
 
 
 class CapturedStep:
@@ -311,10 +312,18 @@ class CapturedStep:
             st.opts
         opt_param_names = st.opt_param_names
         rng_base = st.rng_base
+        # numerics sentinel: the enable decision is baked per entry at
+        # trace time, so a monitored step carries its health outputs in
+        # the SAME program — still exactly one compile per signature
+        from ..observability import numerics as _numerics
+        mon = _numerics.get_monitor()
+        mon = mon if mon.enabled else None
+        mon_box = []  # filled with the tensor-name tuple during trace
 
         def pure(params, buffers, opt_states, ctr, lrs, traced):
             key = jax.random.fold_in(rng_base, ctr)
             new_opt_states = list(opt_states)
+            mon_grads = {}
 
             def mk_hook(oi):
                 opt, onames = opts[oi], opt_param_names[oi]
@@ -326,6 +335,8 @@ class CapturedStep:
                         t = p_tensors[n]
                         if not t.stop_gradient and t._grad is not None:
                             grads[n] = t._grad._data
+                    if mon is not None:
+                        mon_grads.update(grads)
                     new_p, new_s = opt.apply_gradients_tree(
                         cur_params, grads, new_opt_states[oi], lr=lrs[oi])
                     for n, arr in new_p.items():
@@ -359,8 +370,34 @@ class CapturedStep:
                     is_leaf=lambda t: isinstance(t, Tensor))
                 new_params = {n: t._data for n, t in p_tensors.items()}
                 new_buffers = {n: t._data for n, t in b_tensors.items()}
+                if mon is None:
+                    return (out_arrays, new_params, new_buffers,
+                            new_opt_states)
+                # first scalar inexact output is treated as the loss
+                loss = None
+                for leaf in jax.tree_util.tree_leaves(out_arrays):
+                    if (hasattr(leaf, "dtype") and hasattr(leaf, "size")
+                            and leaf.size == 1
+                            and jnp.issubdtype(leaf.dtype, jnp.inexact)):
+                        loss = leaf
+                        break
+                # flag the UPDATED parameters, not the raw grads: the
+                # new params are already materialized program outputs,
+                # so their per-tensor reductions extend no intermediate
+                # lifetimes (grad-side reductions measurably inhibit
+                # XLA's backward/update fusion), a non-finite grad
+                # corrupts its param in this same step (same detection
+                # latency, same parameter-path naming), and state
+                # corruption — what persists into every later step — is
+                # the thing worth naming. The explosion detector still
+                # watches the true grad norm via norm_over.
+                monitored = {n: new_params[n] for n in mon_grads}
+                mnames, health = _numerics.health_outputs(
+                    monitored, loss=loss, with_stats=mon.stats_on,
+                    norm_over=mon_grads)
+                mon_box[:] = [mnames]
                 return (out_arrays, new_params, new_buffers,
-                        new_opt_states)
+                        new_opt_states, health)
             finally:
                 for t, d, g, nd in saved:
                     t._data, t._grad, t._node = d, g, nd
@@ -390,6 +427,8 @@ class CapturedStep:
         entry.ran = False
         entry.flops = None
         entry.fusion = None
+        entry.monitored = mon is not None
+        entry.monitor_names = mon_box  # resolved after the first trace
         return entry
 
     # -- replay -------------------------------------------------------------
@@ -405,6 +444,7 @@ class CapturedStep:
         lrs = [float(opt.get_lr()) for opt in st.opts]
         call = entry.jitted
         tr = _tracer()
+        was_compile = not entry.ran
         if not entry.ran:
             if tr.enabled and entry.flops is None:
                 # analytic MFU source: cost_analysis() at compile time,
@@ -454,10 +494,22 @@ class CapturedStep:
                         lrs, traced)
         if tr.enabled:
             # dispatch-side span: async under jax, so this is dispatch +
-            # any implicit materialization, never a forced device sync
-            tr.record_span(entry.name, "compute", t0, time.perf_counter_ns())
+            # any implicit materialization, never a forced device sync.
+            # The first call is dominated by trace+compile and is billed
+            # as such — the goodput ledger classifies it as overhead,
+            # not productive compute.
+            if was_compile:
+                tr.record_span(f"compile:{entry.name}", "host", t0,
+                               time.perf_counter_ns())
+            else:
+                tr.record_span(entry.name, "compute", t0,
+                               time.perf_counter_ns())
+        step_idx = st.rng_ctr
         st.rng_ctr += 1
-        out_arrays, st.params, st.buffers, st.opt_states = outs
+        if entry.monitored:
+            out_arrays, st.params, st.buffers, st.opt_states, health = outs
+        else:
+            out_arrays, st.params, st.buffers, st.opt_states = outs
         for name, t in st.param_tensors.items():
             t._data = st.params[name]
         for name, t in st.buffer_tensors.items():
@@ -471,6 +523,15 @@ class CapturedStep:
                     opt._accumulators[slot][pname] = s["slots"][slot][n]
                 if n in s["master"]:
                     opt._master_weights[pname] = s["master"][n]
+        if entry.monitored:
+            # hand the (tiny) health arrays to the monitor; it reads
+            # the previous packet at cadence boundaries, so this never
+            # blocks the step. May raise NumericsHaltError (after the
+            # state writeback above) when PT_NUMERICS_HALT=1.
+            from ..observability import numerics as _numerics
+            m = _numerics.current_monitor()
+            if m is not None and entry.monitor_names:
+                m.watch(step_idx, entry.monitor_names[0], health)
         return jax.tree_util.tree_map(
             lambda a: Tensor(a) if _is_arraylike(a) else a, out_arrays)
 

@@ -118,6 +118,9 @@ def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
         total = jnp.sum(jnp.stack(
             [jnp.sum(jnp.abs(g._data.astype(jnp.float32)) ** norm_type)
              for g in grads])) ** (1.0 / norm_type)
+    # error_if_nonfinite's API contract IS the host branch+raise;
+    # callers opt into the sync explicitly
+    # tpu-lint: disable=TPU017
     if error_if_nonfinite and not bool(jnp.isfinite(total)):
         raise RuntimeError("non-finite gradient norm")
     scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)

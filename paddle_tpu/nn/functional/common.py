@@ -486,6 +486,9 @@ def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
 
     def f(a, b):
         d = a - b + epsilon
+        # p is the host-side norm order (a python scalar), not a
+        # device value — no transfer happens here
+        # tpu-lint: disable=TPU017
         if _math.isinf(float(p)):
             out = jnp.max(jnp.abs(d), axis=-1, keepdims=keepdim) \
                 if p > 0 else jnp.min(jnp.abs(d), axis=-1, keepdims=keepdim)

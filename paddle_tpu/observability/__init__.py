@@ -22,6 +22,13 @@ and did something silently recompile?"* at runtime:
  - :mod:`.trace`      ``Tracer``: step-phase span ring buffer, Chrome
                       trace export, analytic MFU, and the crash
                       flight recorder
+ - :mod:`.numerics`   device-side numerics sentinels: in-graph
+                      non-finite flags over loss/grads read at a
+                      cadence, EWMA spike detectors, per-tensor stats,
+                      ``pt_numerics_anomalies_total{kind}``
+ - :mod:`.goodput`    wall-clock goodput ledger over the tracer's
+                      spans: ``pt_goodput_fraction`` +
+                      ``pt_badput_seconds{cause}``
  - :mod:`.logs`       the library logger that bare ``print`` is banned
                       in favor of (lint rule TPU010)
 
@@ -62,6 +69,16 @@ _TRACE_EXPORTS = ("Tracer", "Span", "PHASES", "PEAK_FLOPS",
                   "peak_flops", "program_flops", "get_tracer",
                   "current_tracer", "reset_tracer")
 
+# Numerics/goodput resolve lazily too: get_monitor()/get_goodput()
+# consult PT_NUMERICS/PT_GOODPUT on first call, which a plain package
+# import must never trigger.
+_NUMERICS_EXPORTS = ("NumericsMonitor", "NumericsHaltError",
+                     "health_outputs", "get_monitor", "current_monitor",
+                     "reset_monitor")
+
+_GOODPUT_EXPORTS = ("GoodputLedger", "decompose_spans", "get_goodput",
+                    "current_ledger", "reset_goodput")
+
 
 def __getattr__(name):
     if name in _AGGREGATOR_EXPORTS:
@@ -70,6 +87,12 @@ def __getattr__(name):
     if name in _TRACE_EXPORTS:
         from . import trace
         return getattr(trace, name)
+    if name in _NUMERICS_EXPORTS:
+        from . import numerics
+        return getattr(numerics, name)
+    if name in _GOODPUT_EXPORTS:
+        from . import goodput
+        return getattr(goodput, name)
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}")
 
@@ -85,4 +108,8 @@ __all__ = [
     "merge_scrapes", "render_exposition", "cluster_snapshot",
     "Tracer", "Span", "PHASES", "PEAK_FLOPS", "peak_flops",
     "program_flops", "get_tracer", "current_tracer", "reset_tracer",
+    "NumericsMonitor", "NumericsHaltError", "health_outputs",
+    "get_monitor", "current_monitor", "reset_monitor",
+    "GoodputLedger", "decompose_spans", "get_goodput",
+    "current_ledger", "reset_goodput",
 ]

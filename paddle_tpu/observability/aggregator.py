@@ -408,6 +408,17 @@ def _family_total(families, name):
                if sname == name)
 
 
+def _gauge_value(families, name):
+    """First sample value of a (labelless) gauge family, or None."""
+    fam = families.get(name)
+    if fam is None:
+        return None
+    for sname, _labels, v in fam["samples"]:
+        if sname == name:
+            return v
+    return None
+
+
 def _median(xs):
     s = sorted(xs)
     n = len(s)
@@ -485,7 +496,8 @@ class ClusterAggregator:
 
     def __init__(self, *, endpoints=None, store=None, run_id="local",
                  stale_after=5.0, scrape_timeout=2.0, storm_threshold=1,
-                 interval=1.0, drop_labels=("process_index",),
+                 anomaly_threshold=10, interval=1.0,
+                 drop_labels=("process_index",),
                  retention=3600.0, history_max_points=512):
         self.run_id = str(run_id)
         self._history = (RetentionBuffer(retention, history_max_points)
@@ -493,6 +505,7 @@ class ClusterAggregator:
         self.stale_after = float(stale_after)
         self.scrape_timeout = float(scrape_timeout)
         self.storm_threshold = int(storm_threshold)
+        self.anomaly_threshold = int(anomaly_threshold)
         self.interval = float(interval)
         self.drop_labels = tuple(drop_labels)
         self._store = store
@@ -667,6 +680,37 @@ class ClusterAggregator:
                 "failed scrape attempts (timeouts, refused "
                 "connections, parse errors)", self._scrape_errors_total)
 
+        # fleet goodput: the min is the number that matters — one rank
+        # stuck compiling or waiting on data gates every synchronous
+        # step, so the fleet's effective goodput is its worst rank's
+        goodputs = {r: _gauge_value(f, "pt_goodput_fraction")
+                    for r, f in fresh.items()}
+        goodputs = {r: v for r, v in goodputs.items() if v is not None}
+        cluster_goodput = {}
+        if goodputs:
+            vals = list(goodputs.values())
+            cluster_goodput = {"min": min(vals),
+                               "mean": sum(vals) / len(vals)}
+            gauge("pt_cluster_goodput",
+                  "fleet goodput over fresh ranks reporting "
+                  "pt_goodput_fraction (min gates synchronous steps)",
+                  [((("stat", "min"),), cluster_goodput["min"]),
+                   ((("stat", "mean"),), cluster_goodput["mean"])])
+
+        # anomaly-storm alarm, mirroring the recompile-storm trip: a
+        # fleet-wide burst of numerics anomalies flips /healthz to 503
+        anomalies_total = sum(
+            _family_total(f, "pt_numerics_anomalies_total")
+            for f in fresh.values())
+        anomaly_alarm = (self.anomaly_threshold > 0
+                         and anomalies_total >= self.anomaly_threshold)
+        counter("pt_cluster_numerics_anomalies_total",
+                "numerics anomalies summed across ranks",
+                anomalies_total)
+        gauge("pt_cluster_numerics_anomaly_alarm",
+              "1 while summed numerics anomalies >= the anomaly "
+              "threshold", [((), 1 if anomaly_alarm else 0)])
+
         text = render_exposition(merged) + "\n".join(extra) + "\n"
 
         ranks_health = {}
@@ -693,9 +737,13 @@ class ClusterAggregator:
                     for mode, st in sorted(stats[r].items())}
                 entry["recompile_storms"] = _family_total(
                     fresh[r], "pt_recompile_storms_total")
+                if r in goodputs:
+                    entry["goodput_fraction"] = round(goodputs[r], 6)
+                entry["numerics_anomalies"] = _family_total(
+                    fresh[r], "pt_numerics_anomalies_total")
             ranks_health[str(r)] = entry
         health = {
-            "ok": not alarm,
+            "ok": not alarm and not anomaly_alarm,
             "run_id": self.run_id,
             "ranks_discovered": len(self._endpoints),
             "ranks_up": len(fresh),
@@ -709,6 +757,11 @@ class ClusterAggregator:
             "recompile_storms_total": storms_total,
             "storm_alarm": alarm,
             "storm_threshold": self.storm_threshold,
+            "cluster_goodput": {k: round(v, 6)
+                                for k, v in cluster_goodput.items()},
+            "numerics_anomalies_total": anomalies_total,
+            "anomaly_alarm": anomaly_alarm,
+            "anomaly_threshold": self.anomaly_threshold,
             "merge_conflicts_total": self._conflicts_total,
             "scrape_errors_total": self._scrape_errors_total,
         }
@@ -877,6 +930,11 @@ def main(argv=None):
                                      "1")),
                     help="summed sentinel trips that flip /healthz to "
                          "503 (0 disables the alarm)")
+    ap.add_argument("--anomaly-threshold", type=int,
+                    default=int(_env("PT_AGGREGATOR_ANOMALY_THRESHOLD",
+                                     "10")),
+                    help="summed numerics anomalies that flip /healthz "
+                         "to 503 (0 disables the alarm)")
     ap.add_argument("--retention", type=float,
                     default=float(_env("PT_AGGREGATOR_RETENTION",
                                        "3600")),
@@ -919,8 +977,9 @@ def main(argv=None):
         endpoints=endpoints, store=store, run_id=args.run_id,
         stale_after=args.stale_after,
         scrape_timeout=args.scrape_timeout,
-        storm_threshold=args.storm_threshold, interval=args.interval,
-        retention=args.retention)
+        storm_threshold=args.storm_threshold,
+        anomaly_threshold=args.anomaly_threshold,
+        interval=args.interval, retention=args.retention)
     if args.once:
         agg.scrape_once()
         sys.stdout.write(agg.prometheus_text())

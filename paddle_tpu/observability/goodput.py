@@ -1,0 +1,275 @@
+"""Wall-clock goodput ledger.
+
+The span tracer (``observability/trace.py``) already records every
+phase of every step — compute spans from captured replays, data_wait /
+checkpoint host spans, collective spans, compile spans for the first
+call of each captured program. What was missing is the *decomposition*:
+of the wall-clock this process spent, how much was productive training
+math and how much was overhead, by cause? That single fraction — the
+fleet's goodput — is the number a capacity owner actually watches, and
+it is what the aggregator rolls up across ranks as
+``pt_cluster_goodput``.
+
+Classification over the tracer's span ring:
+
+  - ``compute`` spans (forward/backward/optimizer, captured replays)
+    are **productive**; overlapping compute intervals are merged first
+    so concurrent streams don't double-count.
+  - ``data_wait`` and ``checkpoint`` spans are **badput** under their
+    own cause.
+  - ``collective`` spans are badput only for their **exposed** part —
+    the sub-interval not hidden under merged compute (the overlap
+    machinery the tracer already uses for
+    ``pt_compute_collective_overlap_fraction``).
+  - ``compile`` spans (capture's first call, name ``compile:<entry>``)
+    are badput under ``compile``.
+  - restart replay — steps re-run after an elastic restore — is fed
+    explicitly via :meth:`GoodputLedger.record_restart_replay`, since
+    by construction those spans look like ordinary compute.
+  - any other host span is badput under ``host_other``.
+
+``pt_goodput_fraction`` = productive / (productive + total badput),
+refreshed from ``telemetry.observe_step`` (same sys.modules-gated feed
+the tracer uses), plus per-cause ``pt_badput_seconds{cause}`` gauges.
+Every bench record attaches :meth:`GoodputLedger.snapshot`.
+
+Environment: ``PT_GOODPUT=1`` enables on first ``get_goodput()``.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+__all__ = [
+    "GoodputLedger",
+    "decompose_spans",
+    "get_goodput",
+    "current_ledger",
+    "reset_goodput",
+]
+
+# span-name → badput cause for host-cat spans
+_HOST_CAUSES = ("data_wait", "checkpoint")
+CAUSES = ("data_wait", "checkpoint", "collective_exposed", "compile",
+          "restart_replay", "host_other")
+
+
+def _merge(intervals):
+    """Merge overlapping (t0, t1) intervals; returns disjoint sorted."""
+    merged = []
+    for t0, t1 in sorted(intervals):
+        if merged and t0 <= merged[-1][1]:
+            if t1 > merged[-1][1]:
+                merged[-1] = (merged[-1][0], t1)
+        else:
+            merged.append((t0, t1))
+    return merged
+
+
+def _overlap_ns(t0, t1, merged):
+    hidden = 0
+    for c0, c1 in merged:
+        lo, hi = max(t0, c0), min(t1, c1)
+        if hi > lo:
+            hidden += hi - lo
+        if c0 >= t1:
+            break
+    return hidden
+
+
+def decompose_spans(spans):
+    """Pure classification of a span list into productive seconds and
+    per-cause badput seconds. Unit-testable against a hand-computed
+    decomposition; the ledger and the bench block both go through
+    here."""
+    compute, collectives = [], []
+    badput = {}
+
+    def _add(cause, ns):
+        badput[cause] = badput.get(cause, 0.0) + ns / 1e9
+
+    for s in spans:
+        dur = s.t1_ns - s.t0_ns
+        if dur <= 0:
+            continue
+        if s.cat == "compute":
+            compute.append((s.t0_ns, s.t1_ns))
+        elif s.cat == "collective":
+            collectives.append((s.t0_ns, s.t1_ns))
+        elif s.name in _HOST_CAUSES:
+            _add(s.name, dur)
+        elif s.name == "compile" or s.name.startswith("compile:"):
+            _add("compile", dur)
+        else:
+            _add("host_other", dur)
+    merged = _merge(compute)
+    productive_ns = sum(t1 - t0 for t0, t1 in merged)
+    for t0, t1 in collectives:
+        exposed = (t1 - t0) - _overlap_ns(t0, t1, merged)
+        if exposed > 0:
+            _add("collective_exposed", exposed)
+    productive = productive_ns / 1e9
+    total_bad = sum(badput.values())
+    wall = productive + total_bad
+    return {
+        "productive_seconds": productive,
+        "badput_seconds": badput,
+        "badput_total_seconds": total_bad,
+        "accounted_seconds": wall,
+        "goodput_fraction": (productive / wall) if wall > 0 else None,
+    }
+
+
+class GoodputLedger:
+    """Windowed goodput over the tracer's span ring plus explicit
+    cumulative feeds for causes spans can't express."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.enabled = False
+        self._metrics = None
+        self._restart_s = 0.0
+        self._extra_compile_s = 0.0
+        self._last = None  # last decomposition dict
+
+    def enable(self):
+        with self._lock:
+            self.enabled = True
+            self._make_metrics()
+        return self
+
+    def disable(self):
+        with self._lock:
+            self.enabled = False
+        return self
+
+    def _make_metrics(self):
+        if self._metrics is not None:
+            return
+        try:
+            from .metrics import get_registry
+            r = get_registry()
+            self._metrics = {
+                "fraction": r.gauge(
+                    "pt_goodput_fraction",
+                    "Productive fraction of accounted wall-clock "
+                    "(windowed over the span ring)"),
+                "badput": r.gauge(
+                    "pt_badput_seconds",
+                    "Overhead wall-clock by cause, over the span "
+                    "window", ("cause",)),
+            }
+        except Exception:
+            self._metrics = None
+
+    # -- explicit feeds ----------------------------------------------
+
+    def record_restart_replay(self, seconds):
+        """Steps re-executed after an elastic restore: indistinguishable
+        from productive compute in the span stream, so the restore path
+        reports them here."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._restart_s += float(seconds)
+
+    def record_compile(self, seconds):
+        """Compile time observed outside a traced span (e.g. AOT warmup
+        with tracing off)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._extra_compile_s += float(seconds)
+
+    # -- refresh / summary -------------------------------------------
+
+    def refresh(self, spans=None):
+        """Recompute the decomposition (from the tracer ring unless a
+        span list is given) and publish the gauges. Called from
+        ``telemetry.observe_step`` once per step — pure host arithmetic
+        over the in-memory ring, never touches the device."""
+        if not self.enabled:
+            return None
+        if spans is None:
+            tr_mod = sys.modules.get("paddle_tpu.observability.trace")
+            if tr_mod is None:
+                return None
+            tr = tr_mod.current_tracer()
+            if tr is None or not tr.enabled:
+                return None
+            spans = tr.spans()
+        dec = decompose_spans(spans)
+        with self._lock:
+            bad = dict(dec["badput_seconds"])
+            if self._restart_s > 0:
+                bad["restart_replay"] = (
+                    bad.get("restart_replay", 0.0) + self._restart_s)
+            if self._extra_compile_s > 0:
+                bad["compile"] = bad.get("compile", 0.0) \
+                    + self._extra_compile_s
+            total_bad = sum(bad.values())
+            wall = dec["productive_seconds"] + total_bad
+            dec = dict(dec, badput_seconds=bad,
+                       badput_total_seconds=total_bad,
+                       accounted_seconds=wall,
+                       goodput_fraction=(dec["productive_seconds"] / wall
+                                         if wall > 0 else None))
+            self._last = dec
+            metrics = self._metrics
+        if metrics is not None:
+            try:
+                if dec["goodput_fraction"] is not None:
+                    metrics["fraction"].set(dec["goodput_fraction"])
+                for cause, sec in dec["badput_seconds"].items():
+                    metrics["badput"].set(sec, cause=cause)
+            except Exception:
+                pass
+        return dec
+
+    def snapshot(self):
+        """JSON-ready block for bench records; refreshes first so the
+        block reflects the final span window."""
+        dec = self.refresh()
+        with self._lock:
+            if dec is None:
+                dec = self._last
+            return {
+                "enabled": self.enabled,
+                "restart_replay_seconds": self._restart_s,
+                **({k: (round(v, 6) if isinstance(v, float) else
+                        {c: round(s, 6) for c, s in v.items()}
+                        if isinstance(v, dict) else v)
+                    for k, v in dec.items()} if dec else {}),
+            }
+
+
+_ledger = None
+_ledger_lock = threading.Lock()
+
+
+def _truthy(v):
+    return str(v).lower() not in ("", "0", "false", "no", "off", "none")
+
+
+def get_goodput():
+    """Process singleton; first call applies PT_GOODPUT env config."""
+    global _ledger
+    with _ledger_lock:
+        if _ledger is None:
+            _ledger = GoodputLedger()
+            if _truthy(os.environ.get("PT_GOODPUT", "")):
+                _ledger.enable()
+        return _ledger
+
+
+def current_ledger():
+    """The singleton if it exists, else None (no env enablement)."""
+    return _ledger
+
+
+def reset_goodput():
+    """Drop the singleton (tests)."""
+    global _ledger
+    with _ledger_lock:
+        _ledger = None

@@ -1,0 +1,450 @@
+"""Device-side numerics health sentinels.
+
+The failure mode this module exists for: an AMP run diverges at step
+40k and the only artifact is a loss curve that went to NaN — nobody can
+say *which tensor* went non-finite first, and by the time a human adds
+``print(float(loss))`` probes the run is gone (and the probes add a
+host sync per step, which is its own regression — tpu-lint TPU017
+flags exactly that spelling).
+
+Instead the monitor folds a tiny health program *inside* the jitted /
+captured step — per-tensor ``isfinite`` flags over loss and every
+gradient, a global squared grad-norm, and (opt-in) per-tensor
+statistics — and reads the resulting scalar outputs on the host
+**asynchronously at a cadence**: at every ``PT_NUMERICS_CADENCE``-th
+step the packet from the *previous* step is materialized, by which
+point the device finished it long ago, so steady-state steps never
+gain a host sync. On a trip the offending tensor is named by parameter
+path, ``pt_numerics_anomalies_total{kind}`` is bumped, the flight
+recorder dumps (reason ``numerics:<kind>:<tensor>``), and with
+``PT_NUMERICS_HALT=1`` the step raises :class:`NumericsHaltError` so
+the train loop can stop burning accelerator hours on NaN.
+
+Contract (shared with the rest of ``observability``): zero cost while
+disabled, never sync the device on the hot path, never take down the
+run unless halting was explicitly requested, side-effect-free import.
+
+Environment:
+  - ``PT_NUMERICS=1``          enable on first ``get_monitor()``
+  - ``PT_NUMERICS_CADENCE=n``  host read cadence in steps (default 16)
+  - ``PT_NUMERICS_STATS=1``    opt-in per-tensor mean/std/max-abs/
+                               underflow-fraction sampling
+  - ``PT_NUMERICS_HALT=1``     raise ``NumericsHaltError`` on a
+                               non-finite trip
+"""
+from __future__ import annotations
+
+import logging
+import math
+import os
+import sys
+import threading
+
+logger = logging.getLogger("paddle_tpu.observability.numerics")
+
+__all__ = [
+    "NumericsMonitor",
+    "NumericsHaltError",
+    "health_outputs",
+    "get_monitor",
+    "current_monitor",
+    "reset_monitor",
+]
+
+# kinds emitted through pt_numerics_anomalies_total{kind}
+KINDS = ("nonfinite", "loss_spike", "grad_explosion", "scaler_skip")
+
+# |x| below the smallest f32/bf16 normal (2**-126) but not exactly zero
+# counts as underflowed: in bf16 those values flush to zero and the
+# underflow fraction is the early-warning signal for vanishing grads.
+_TINY_NORMAL = 2.0 ** -126
+
+
+class NumericsHaltError(RuntimeError):
+    """Raised from a monitored step when PT_NUMERICS_HALT=1 and a
+    non-finite loss/grad tripped the sentinel."""
+
+
+def health_outputs(named, loss=None, with_stats=False, norm_over=None):
+    """Build the device-side health program over a dict of named arrays.
+
+    Called at *trace time* from inside a jitted step (capture's
+    ``pure`` or hapi's ``train_step``); the returned arrays become
+    extra program outputs, so the health check compiles into the same
+    executable — no second program, no extra compile.
+
+    Returns ``(names, health)`` where ``names`` is the host-side tuple
+    naming each row of ``health["flags"]`` (sorted parameter paths,
+    plus ``"loss"`` last when a loss is given) and ``health`` is a dict
+    of small device arrays:
+
+      - ``flags``:        bool[n] — per-tensor any-non-finite
+      - ``grad_norm_sq``: f32 scalar — global squared norm, over
+                          ``norm_over`` when given, else over ``named``
+      - ``loss``:         f32 scalar (only when ``loss`` is given)
+      - ``stats``:        f32[n, 4] — mean, std, max-abs, underflow
+                          fraction per tensor (only ``with_stats``)
+
+    Each per-tensor flag is derived from the tensor's squared sum —
+    any NaN/Inf propagates through ``sum(x*x)`` — so the health
+    program costs ONE reduction per tensor, shared with the norm,
+    instead of a separate ``isfinite`` sweep (the reduction count, not
+    the element pass, is what shows up as per-step overhead). The one
+    false-positive mode is f32 overflow of the squared sum, i.e.
+    magnitudes past ~1e19 — firing on those is the sentinel doing its
+    job.
+
+    ``norm_over`` exists so a caller can flag one set of tensors while
+    taking the norm of another: capture flags the UPDATED parameters —
+    already-materialized program outputs, so their reductions extend no
+    intermediate lifetimes — while the EWMA explosion detector still
+    watches the squared norm of the raw gradients.
+    """
+    import jax.numpy as jnp
+
+    names = tuple(sorted(named))
+    flags = []
+    stats = []
+    norm_sq = jnp.zeros((), jnp.float32)
+    for name in names:
+        x = named[name]
+        if not jnp.issubdtype(x.dtype, jnp.inexact):
+            # integer/bool tensors are finite by construction
+            flags.append(jnp.zeros((), jnp.bool_))
+            if with_stats:
+                stats.append(jnp.zeros((4,), jnp.float32))
+            continue
+        xf = x.astype(jnp.float32)
+        sq = jnp.sum(xf * xf)
+        flags.append(~jnp.isfinite(sq))
+        if norm_over is None:
+            norm_sq = norm_sq + sq
+        if with_stats:
+            ax = jnp.abs(xf)
+            under = jnp.mean(
+                ((ax > 0) & (ax < _TINY_NORMAL)).astype(jnp.float32))
+            stats.append(jnp.stack(
+                [jnp.mean(xf), jnp.std(xf), jnp.max(ax), under]))
+    if norm_over is not None:
+        for x in norm_over.values():
+            if jnp.issubdtype(x.dtype, jnp.inexact):
+                xf = x.astype(jnp.float32)
+                norm_sq = norm_sq + jnp.sum(xf * xf)
+    loss_f = None
+    if loss is not None:
+        loss_f = jnp.mean(jnp.asarray(loss).astype(jnp.float32))
+        names = names + ("loss",)
+        flags.append(~jnp.isfinite(loss_f))
+        if with_stats:
+            stats.append(jnp.stack(
+                [loss_f, jnp.zeros(()), jnp.abs(loss_f), jnp.zeros(())]))
+    health = {
+        "flags": (jnp.stack(flags) if flags
+                  else jnp.zeros((0,), jnp.bool_)),
+        "grad_norm_sq": norm_sq,
+    }
+    if loss_f is not None:
+        health["loss"] = loss_f
+    if with_stats:
+        health["stats"] = (jnp.stack(stats) if stats
+                           else jnp.zeros((0, 4), jnp.float32))
+    return names, health
+
+
+class NumericsMonitor:
+    """Host-side half of the sentinel: holds the latest health packet,
+    materializes the previous one at cadence boundaries, runs the
+    detectors, and books anomalies."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.enabled = False
+        self.cadence = 16
+        self.stats_on = False
+        self.halt = False
+        self.ewma_alpha = 0.9
+        self.spike_factor = 10.0
+        self.warmup_reads = 3
+        self._metrics = None
+        self._reset_state()
+
+    def _reset_state(self):
+        # host counters work even while disabled (the scaler-skip path
+        # books through here unconditionally); metrics only if enabled
+        self._anomalies = {}
+        self._last_anomaly = None
+        self._pending = None          # (step, names, health) latest packet
+        self._last_read_step = None
+        self._steps_observed = 0
+        self._reads = 0
+        self._loss_ewma = None
+        self._gnorm_ewma = None
+        self._finite_reads = 0
+        self._last_loss = None
+        self._last_grad_norm = None
+        self._last_stats = None       # {tensor: {mean, std, max_abs, ...}}
+        self._tripped = set()         # tensor paths already reported
+
+    # -- lifecycle ---------------------------------------------------
+
+    def enable(self, cadence=None, stats=None, halt=None,
+               ewma_alpha=None, spike_factor=None):
+        with self._lock:
+            self.enabled = True
+            if cadence is not None:
+                self.cadence = max(1, int(cadence))
+            if stats is not None:
+                self.stats_on = bool(stats)
+            if halt is not None:
+                self.halt = bool(halt)
+            if ewma_alpha is not None:
+                self.ewma_alpha = float(ewma_alpha)
+            if spike_factor is not None:
+                self.spike_factor = float(spike_factor)
+            self._make_metrics()
+        return self
+
+    def disable(self):
+        with self._lock:
+            self.enabled = False
+        return self
+
+    def _make_metrics(self):
+        if self._metrics is not None:
+            return
+        try:
+            from .metrics import get_registry
+            r = get_registry()
+            self._metrics = {
+                "anomalies": r.counter(
+                    "pt_numerics_anomalies_total",
+                    "Numerics anomalies tripped, by kind",
+                    ("kind",)),
+                "grad_norm": r.gauge(
+                    "pt_numerics_grad_norm",
+                    "Last grad norm read by the numerics monitor"),
+            }
+        except Exception:  # metrics are optional plumbing
+            self._metrics = None
+
+    # -- hot path ----------------------------------------------------
+
+    def watch(self, step, names, health):
+        """Per-step hook from the captured/jitted step's replay path.
+
+        Holds a reference to the (tiny) health arrays; at every
+        cadence boundary the packet from the *previous* step is
+        inspected — one full step of dispatch separates enqueue from
+        read, so ``np.asarray`` finds the buffers already materialized
+        and the read never blocks the step. Detection latency is at
+        most one cadence window.
+        """
+        if not self.enabled:
+            return
+        with self._lock:
+            prev = self._pending
+            self._pending = (int(step), names, health)
+            self._steps_observed += 1
+            due = (prev is not None
+                   and (self._last_read_step is None
+                        or prev[0] - self._last_read_step >= self.cadence))
+        if due:
+            self._inspect(*prev)
+
+    def flush(self):
+        """Materialize and inspect the held packet now (end of run,
+        drills, tests). The one place a blocking read is acceptable."""
+        with self._lock:
+            pending, self._pending = self._pending, None
+        if pending is not None:
+            self._inspect(*pending)
+        return self
+
+    # -- detectors ---------------------------------------------------
+
+    def _inspect(self, step, names, health):
+        import numpy as np
+
+        try:
+            flags = np.asarray(health["flags"])
+            norm_sq = float(np.asarray(health["grad_norm_sq"]))
+            loss = (float(np.asarray(health["loss"]))
+                    if "loss" in health else None)
+            stats = (np.asarray(health["stats"])
+                     if "stats" in health else None)
+        except Exception:
+            # a failed read must never take down the run
+            logger.debug("numerics read failed", exc_info=True)
+            return
+        with self._lock:
+            self._last_read_step = step
+            self._reads += 1
+        bad = [names[i] for i in range(len(flags)) if bool(flags[i])]
+        for tensor in bad:
+            if tensor in self._tripped:
+                continue
+            self._tripped.add(tensor)
+            self.record_anomaly(
+                "nonfinite", tensor=tensor, step=step,
+                detail="non-finite values detected")
+        if stats is not None and len(names) == len(stats):
+            self._last_stats = {
+                names[i]: {
+                    "mean": float(stats[i][0]),
+                    "std": float(stats[i][1]),
+                    "max_abs": float(stats[i][2]),
+                    "underflow_frac": float(stats[i][3]),
+                }
+                for i in range(len(names))
+            }
+        if bad:
+            return  # EWMA baselines stay clean of non-finite reads
+        grad_norm = math.sqrt(norm_sq) if norm_sq >= 0 else float("nan")
+        with self._lock:
+            self._last_loss = loss
+            self._last_grad_norm = grad_norm
+            self._finite_reads += 1
+            warm = self._finite_reads > self.warmup_reads
+            loss_spike = (
+                loss is not None and warm and self._loss_ewma is not None
+                and abs(loss) > self.spike_factor
+                * max(abs(self._loss_ewma), 1e-8))
+            grad_spike = (
+                math.isfinite(grad_norm) and warm
+                and self._gnorm_ewma is not None
+                and grad_norm > self.spike_factor
+                * max(self._gnorm_ewma, 1e-8))
+            a = self.ewma_alpha
+            if loss is not None and not loss_spike:
+                self._loss_ewma = (loss if self._loss_ewma is None
+                                   else a * self._loss_ewma + (1 - a) * loss)
+            if math.isfinite(grad_norm) and not grad_spike:
+                self._gnorm_ewma = (
+                    grad_norm if self._gnorm_ewma is None
+                    else a * self._gnorm_ewma + (1 - a) * grad_norm)
+            if self._metrics is not None:
+                try:
+                    self._metrics["grad_norm"].set(grad_norm)
+                except Exception:
+                    pass
+        if loss_spike:
+            self.record_anomaly(
+                "loss_spike", tensor="loss", step=step,
+                detail="loss=%.6g ewma=%.6g" % (loss, self._loss_ewma),
+                halt_ok=False)
+        if grad_spike:
+            self.record_anomaly(
+                "grad_explosion", tensor="grad_norm", step=step,
+                detail="norm=%.6g ewma=%.6g" % (grad_norm,
+                                                self._gnorm_ewma),
+                halt_ok=False)
+
+    # -- anomaly sink ------------------------------------------------
+
+    def record_anomaly(self, kind, tensor=None, step=None, detail=None,
+                       halt_ok=True):
+        """Book one anomaly: host counter (always), metric counter
+        (when enabled), a warning naming the tensor, a flight-recorder
+        dump, and — for hard non-finite trips with halting armed — a
+        :class:`NumericsHaltError`."""
+        with self._lock:
+            self._anomalies[kind] = self._anomalies.get(kind, 0) + 1
+            self._last_anomaly = {
+                "kind": kind, "tensor": tensor, "step": step,
+                "detail": detail,
+            }
+            metrics = self._metrics if self.enabled else None
+        if metrics is not None:
+            try:
+                metrics["anomalies"].inc(kind=kind)
+            except Exception:
+                pass
+        logger.warning("numerics anomaly: kind=%s tensor=%s step=%s %s",
+                       kind, tensor, step, detail or "")
+        # the flight dump pins the FIRST non-finite trip: one bad step
+        # usually flags several tensors at once (params before the
+        # aggregate "loss" in inspection order), and the most specific
+        # name — the first parameter path — is the one worth debugging
+        reason = "numerics:%s:%s" % (kind, tensor or "")
+        dump = kind != "nonfinite" or self._anomalies[kind] == 1
+        tr_mod = (sys.modules.get("paddle_tpu.observability.trace")
+                  if dump else None)
+        if tr_mod is not None:
+            try:
+                tr = tr_mod.current_tracer()
+                if tr is not None and tr.enabled:
+                    tr.flight_dump(reason=reason)
+            except Exception:
+                pass
+        if self.halt and halt_ok and kind == "nonfinite":
+            raise NumericsHaltError(
+                "numerics sentinel tripped: %s in %r at step %s "
+                "(PT_NUMERICS_HALT=1)" % (kind, tensor, step))
+
+    # -- reporting ---------------------------------------------------
+
+    def anomaly_count(self, kind=None):
+        with self._lock:
+            if kind is not None:
+                return self._anomalies.get(kind, 0)
+            return sum(self._anomalies.values())
+
+    def snapshot(self):
+        with self._lock:
+            snap = {
+                "enabled": self.enabled,
+                "cadence": self.cadence,
+                "stats": self.stats_on,
+                "halt": self.halt,
+                "steps_observed": self._steps_observed,
+                "reads": self._reads,
+                "anomalies": dict(self._anomalies),
+                "anomalies_total": sum(self._anomalies.values()),
+                "tripped": sorted(self._tripped),
+                "last_anomaly": (dict(self._last_anomaly)
+                                 if self._last_anomaly else None),
+                "loss_ewma": self._loss_ewma,
+                "grad_norm_ewma": self._gnorm_ewma,
+                "last_loss": self._last_loss,
+                "last_grad_norm": self._last_grad_norm,
+            }
+            if self._last_stats is not None:
+                snap["tensor_stats"] = {
+                    k: dict(v) for k, v in self._last_stats.items()}
+            return snap
+
+
+_monitor = None
+_monitor_lock = threading.Lock()
+
+
+def _truthy(v):
+    return str(v).lower() not in ("", "0", "false", "no", "off", "none")
+
+
+def get_monitor():
+    """Process singleton; first call applies PT_NUMERICS_* env config."""
+    global _monitor
+    with _monitor_lock:
+        if _monitor is None:
+            _monitor = NumericsMonitor()
+            if _truthy(os.environ.get("PT_NUMERICS", "")):
+                _monitor.enable(
+                    cadence=os.environ.get("PT_NUMERICS_CADENCE") or None,
+                    stats=_truthy(os.environ.get("PT_NUMERICS_STATS", "")),
+                    halt=_truthy(os.environ.get("PT_NUMERICS_HALT", "")),
+                )
+        return _monitor
+
+
+def current_monitor():
+    """The singleton if it exists, else None — read-only accessor that
+    never triggers env-based enablement (hot paths use this)."""
+    return _monitor
+
+
+def reset_monitor():
+    """Drop the singleton (tests)."""
+    global _monitor
+    with _monitor_lock:
+        _monitor = None

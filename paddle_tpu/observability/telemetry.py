@@ -507,6 +507,13 @@ class TrainingTelemetry:
             tr = tr_mod.current_tracer()
             if tr is not None and tr.enabled:
                 tr.on_step(seconds)
+        # goodput gauges refresh per step over the same span ring; same
+        # sys.modules gate — never imports, never touches the device
+        gp_mod = sys.modules.get("paddle_tpu.observability.goodput")
+        if gp_mod is not None:
+            gp = gp_mod.current_ledger()
+            if gp is not None and gp.enabled:
+                gp.refresh()
 
     # -- data / collectives -------------------------------------------------
 
@@ -781,6 +788,33 @@ class TrainingTelemetry:
             steps = self._steps
             last_ckpt = self._last_ckpt_step
         mem = self.device_memory()
+        # numerics block: anomaly counts (incl. AMP scaler skips) ride
+        # along in every snapshot. sys.modules-gated like the tracer
+        # feed — read-only, never triggers enablement.
+        numerics = None
+        n_mod = sys.modules.get("paddle_tpu.observability.numerics")
+        if n_mod is not None:
+            m = n_mod.current_monitor()
+            if m is not None:
+                ns = m.snapshot()
+                numerics = {
+                    "enabled": ns["enabled"],
+                    "anomalies": ns["anomalies"],
+                    "anomalies_total": ns["anomalies_total"],
+                    "last_anomaly": ns["last_anomaly"],
+                    "reads": ns["reads"],
+                }
+        goodput = None
+        gp_mod = sys.modules.get("paddle_tpu.observability.goodput")
+        if gp_mod is not None:
+            gp = gp_mod.current_ledger()
+            if gp is not None and gp.enabled:
+                dec = gp.refresh()
+                if dec is not None:
+                    goodput = {
+                        "goodput_fraction": dec["goodput_fraction"],
+                        "badput_seconds": dec["badput_seconds"],
+                    }
         return {
             "enabled": self.enabled,
             "pid": os.getpid(),
@@ -800,6 +834,8 @@ class TrainingTelemetry:
             "device_memory_bytes": mem.get("bytes_in_use"),
             "last_checkpoint_step": last_ckpt,
             "events_dropped": self.sink.dropped if self.sink else 0,
+            "numerics": numerics,
+            "goodput": goodput,
         }
 
     def healthz(self):
@@ -912,5 +948,9 @@ def reset():
         t.disable()
     from .trace import reset_tracer
     reset_tracer()  # its metric handles die with the registry below
+    from .numerics import reset_monitor
+    reset_monitor()
+    from .goodput import reset_goodput
+    reset_goodput()
     from .metrics import reset_registry
     reset_registry()

@@ -328,6 +328,9 @@ def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
         if p == 0.0:
             return jnp.sum((d != 0).astype(a.dtype), axis=-1)
         import math
+        # p is the host-side norm order (a python scalar), not a
+        # device value — no transfer happens here
+        # tpu-lint: disable=TPU017
         if math.isinf(float(p)):
             return jnp.max(jnp.abs(d), axis=-1)
         return jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)

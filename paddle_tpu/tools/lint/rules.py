@@ -884,3 +884,98 @@ class UnfusedResidualNorm(Rule):
                        f"fused_add_layer_norm) so the add+LN pair runs "
                        f"as one fused kernel and the fusion pass sees "
                        f"one residual_ln cluster")
+
+
+@register
+class HostSideNanCheck(Rule):
+    id = "TPU017"
+    name = "host-side-nan-check"
+    rationale = ("pulling a value to the host just to ask `isnan` — "
+                 "math.isnan(float(loss)), np.isnan(x.numpy()), "
+                 "bool(jnp.isnan(...)) — stalls the device pipeline "
+                 "every step for a check the device can run for free; "
+                 "fold the flag into the jitted step "
+                 "(observability.numerics.health_outputs) and read it "
+                 "asynchronously at a cadence "
+                 "(NumericsMonitor.watch)")
+
+    _NAN_FUNCS = {"isnan", "isinf", "isfinite"}
+    _SYNC_METHODS = {"item", "numpy", "tolist", "__array__"}
+    # host casts/transfers that force the device->host sync
+    _SYNC_WRAPPERS = {"bool", "float", "int", "np.asarray", "np.array",
+                      "numpy.asarray", "numpy.array", "jax.device_get",
+                      "device_get"}
+    # same scope gate as TPU007: library code, or any function whose
+    # name says it is a training loop
+    _LOOP_FUNC = re.compile(r"(train|fit|epoch|run_steps?|step_loop)",
+                            re.IGNORECASE)
+
+    def _applicable(self, ctx):
+        return ctx.library_path or any(
+            self._LOOP_FUNC.search(fi.name) for fi in ctx.func_stack)
+
+    def _walk_calls(self, tree):
+        """Call nodes under ``tree`` (itself included), skipping
+        deferred-execution bodies."""
+        stack = [tree]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+                continue
+            if isinstance(n, ast.Call):
+                yield n
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _has_nan_call(self, tree):
+        return any(
+            dotted(c.func).rpartition(".")[2] in self._NAN_FUNCS
+            for c in self._walk_calls(tree))
+
+    def _has_sync(self, tree):
+        for c in self._walk_calls(tree):
+            if (isinstance(c.func, ast.Attribute)
+                    and c.func.attr in self._SYNC_METHODS):
+                return True
+            if dotted(c.func) in self._SYNC_WRAPPERS:
+                return True
+        return False
+
+    def on_call(self, node, ctx):
+        if not self._applicable(ctx):
+            return
+        name = dotted(node.func)
+        # spelling 1: sync method chained onto the device-side check —
+        # jnp.isnan(loss).item(), jnp.any(jnp.isnan(g)).numpy()
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in self._SYNC_METHODS
+                and self._has_nan_call(node.func.value)):
+            ctx.report(node, self.id,
+                       f".{node.func.attr}() on a device-side nan/inf "
+                       f"check syncs the host every call; compile the "
+                       f"flag into the step (numerics.health_outputs) "
+                       f"and read it at a cadence")
+            return
+        # spelling 2: host cast wrapped around the device-side check —
+        # bool(jnp.any(~jnp.isfinite(g))), np.asarray(jnp.isnan(x))
+        if name in self._SYNC_WRAPPERS and node.args:
+            arg = node.args[0]
+            # an inner sync already carries the report (spelling 1/3)
+            if self._has_nan_call(arg) and not self._has_sync(arg):
+                ctx.report(node, self.id,
+                           f"{name}() around a device-side nan/inf "
+                           f"check forces a blocking device->host sync; "
+                           f"compile the flag into the step "
+                           f"(numerics.health_outputs) and read it at "
+                           f"a cadence")
+            return
+        # spelling 3: host-side check fed by an explicit sync —
+        # math.isnan(float(loss)), np.isnan(x.numpy())
+        if (name.rpartition(".")[2] in self._NAN_FUNCS
+                and any(self._has_sync(a) for a in node.args)):
+            ctx.report(node, self.id,
+                       f"{name}() over a synced host value checks "
+                       f"non-finiteness one device round-trip too "
+                       f"late; compile the flag into the step "
+                       f"(numerics.health_outputs) and read it at a "
+                       f"cadence")

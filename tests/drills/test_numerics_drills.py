@@ -1,0 +1,91 @@
+"""NaN-injection numerics drills: REAL workers train a captured MLP,
+one rank's input is poisoned, the device-side sentinel must name it.
+
+Each drill spawns ``world`` drill workers in numerics mode
+(``DRILL_NUMERICS=1``, storeless): every rank trains a real captured
+MLP on CPU with the numerics monitor armed; the poison rank overwrites
+one input element with NaN at a scripted step — same shape and dtype,
+so the capture cache must NOT retrace — which floods that step's loss
+and grads with non-finite values.  The runner asserts from the
+per-rank reports that the poisoned rank detected the trip within ONE
+cadence window of the injection, that the flight-recorder dump pins a
+real parameter path (not just the aggregate ``loss``), that every
+clean rank stayed quiet, and that every captured step compiled exactly
+once (the monitor folds into the SAME program).  The ``@slow`` matrix
+adds the PT_NUMERICS_HALT variant (clean ``EXIT_NUMERICS_HALT``),
+a 3-rank fleet, and a cadence-1 immediate-read run.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from paddle_tpu.distributed.drill import run_numerics_drill
+from paddle_tpu.distributed.drill.worker import EXIT_NUMERICS_HALT
+
+pytestmark = pytest.mark.skipif(os.name != "posix",
+                                reason="drills spawn real processes")
+
+
+def test_numerics_drill_detects_injected_nan(tmp_path):
+    """Tier-1 acceptance drill: 2 workers x 12 steps, rank 1 poisoned
+    at step 5, cadence 4 -> detection within one cadence window, the
+    flight dump naming a parameter path, clean rank silent, exactly
+    one compile per rank."""
+    logs = str(tmp_path / "logs")
+    os.makedirs(logs, exist_ok=True)
+    report = run_numerics_drill(str(tmp_path), world=2, steps=12,
+                                poison_step=5, poison_rank=1,
+                                cadence=4, log_dir=logs)
+    assert report["rcs"] == [0, 0]
+    # the detection-latency contract: at most one cadence window late
+    assert 5 <= report["detected_step"] <= 5 + 4
+    # the sentinel named a real parameter path, not just "loss"
+    assert report["named_tensor"].startswith("model::")
+    assert report["flight_reason"] == (
+        "numerics:nonfinite:" + report["named_tensor"])
+    poisoned = report["ranks"][1]
+    assert poisoned["anomalies"]["nonfinite"] >= 1
+    assert "loss" in poisoned["tripped"]
+    # monitors fold into the SAME captured program: one compile, ever
+    for r in range(2):
+        assert report["ranks"][r]["compiles"] == 1
+    clean = report["ranks"][0]
+    assert clean["anomalies"] == {}
+    assert clean["detected_step"] is None
+    # the dump itself is a parseable flight-recorder artifact carrying
+    # the poisoned rank's identity
+    with open(poisoned["flight"]) as f:
+        flight = json.load(f)
+    assert flight["process_index"] == 1
+    assert flight["reason"].startswith("numerics:nonfinite:model::")
+
+
+@pytest.mark.slow
+def test_numerics_drill_halt_variant(tmp_path):
+    """@slow: PT_NUMERICS_HALT=1 converts the sentinel trip into a
+    clean EXIT_NUMERICS_HALT exit on the poisoned rank — report still
+    written, clean ranks finish 0."""
+    report = run_numerics_drill(str(tmp_path), world=2, steps=12,
+                                poison_step=5, poison_rank=1,
+                                cadence=4, halt=True)
+    assert report["rcs"] == [0, EXIT_NUMERICS_HALT]
+    assert report["ranks"][1]["halted"] is True
+    assert 5 <= report["detected_step"] <= 5 + 4
+    assert report["named_tensor"].startswith("model::")
+
+
+@pytest.mark.slow
+def test_numerics_drill_three_ranks_cadence_one(tmp_path):
+    """@slow: a 3-rank fleet at cadence 1 — reads every step, so the
+    detection lag is exactly the one-step dispatch pipeline; both
+    clean ranks stay quiet."""
+    report = run_numerics_drill(str(tmp_path), world=3, steps=8,
+                                poison_step=3, poison_rank=2,
+                                cadence=1)
+    assert report["rcs"] == [0, 0, 0]
+    assert 3 <= report["detected_step"] <= 3 + 1
+    for r in (0, 1):
+        assert report["ranks"][r]["anomalies"] == {}

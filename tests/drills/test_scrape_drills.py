@@ -57,6 +57,36 @@ def test_scrape_merge_skew_drill(tmp_path):
     assert set(ranks) == {"0", "1", "2"}
     p95s = [ranks[r]["step_time"]["train"]["p95_ms"] for r in ranks]
     assert max(p95s) > min(p95s)  # the skew is visible per-rank too
+    # fleet goodput derived from every rank's pt_goodput_fraction:
+    # the scripted span profile (1/5 data_wait, 4/5 compute) pins
+    # min == mean == 0.8 exactly
+    assert abs(report["cluster_goodput"]["min"] - 0.8) < 1e-6
+    assert abs(report["cluster_goodput"]["mean"] - 0.8) < 1e-6
+    assert report["healthz"]["cluster_goodput"]["min"] == 0.8
+    # no scripted anomalies -> the anomaly alarm stays down
+    assert report["anomaly_alarm"] in (0.0, None)
+    assert report["healthz"]["anomaly_alarm"] is False
+
+
+def test_scrape_drill_anomaly_storm(tmp_path):
+    """A fleet-wide numerics-anomaly burst (each rank books 3 scripted
+    trips) crosses the cluster threshold: summed counter, alarm gauge,
+    per-rank counts in health, and /healthz flipped to 503 — with NO
+    recompile storm in sight."""
+    report = run_scrape_drill(
+        str(tmp_path), world=2, steps=6, kill_rank=None, storm=False,
+        anomalies=3)
+    assert report["anomalies_total"] == 6.0
+    assert report["anomaly_alarm"] == 1.0
+    health = report["healthz"]
+    assert health["ok"] is False
+    assert health["anomaly_alarm"] is True
+    assert health["numerics_anomalies_total"] == 6.0
+    assert health["storm_alarm"] is False
+    for r in ("0", "1"):
+        assert health["ranks"][r]["numerics_anomalies"] == 3.0
+    # goodput is orthogonal to the anomaly burst: still 0.8
+    assert abs(report["cluster_goodput"]["mean"] - 0.8) < 1e-6
 
 
 @pytest.mark.slow

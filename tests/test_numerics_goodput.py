@@ -1,0 +1,420 @@
+"""Numerics health sentinels + goodput ledger contract tests.
+
+The observability contract applies throughout: everything is inert
+until enabled, the hot path never syncs the device (the monitor reads
+the health packet from the PREVIOUS step at cadence boundaries, a full
+dispatch behind), a tripped sentinel names the offending tensor by
+parameter path, and the monitored captured step stays at exactly ONE
+compile with bit-identical losses — the health outputs ride inside the
+same program.  The goodput half is pure span arithmetic: the
+acceptance test hand-computes a wall-clock decomposition and pins
+``pt_goodput_fraction`` to it.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import paddle_tpu.observability as obs
+from paddle_tpu.observability.goodput import (
+    decompose_spans, get_goodput, reset_goodput,
+)
+from paddle_tpu.observability.numerics import (
+    NumericsHaltError, current_monitor, get_monitor, health_outputs,
+    reset_monitor,
+)
+from paddle_tpu.observability.trace import Span, get_tracer, reset_tracer
+
+
+@pytest.fixture(autouse=True)
+def _isolated(monkeypatch):
+    for var in ("PT_TELEMETRY", "PT_TELEMETRY_DIR", "PT_METRICS_PORT",
+                "PT_NUMERICS", "PT_NUMERICS_CADENCE", "PT_NUMERICS_STATS",
+                "PT_NUMERICS_HALT", "PT_GOODPUT", "PT_TRACE",
+                "PT_TRACE_DIR", "PT_FLIGHT_RECORDER", "PT_PROCESS_INDEX",
+                "PT_RUN_ID"):
+        monkeypatch.delenv(var, raising=False)
+    obs.reset()
+    reset_tracer()
+    yield
+    obs.reset()
+    reset_tracer()
+
+
+def _packet(n_tensors=1, bad=(), loss=1.0, norm_sq=1.0):
+    """A materialized health packet the monitor can inspect without a
+    device in sight — names + plain numpy arrays."""
+    names = tuple(f"p{i}" for i in range(n_tensors)) + ("loss",)
+    flags = np.array([names[i] in bad for i in range(len(names))])
+    health = {"flags": flags,
+              "grad_norm_sq": np.float32(norm_sq),
+              "loss": np.float32(loss)}
+    return names, health
+
+
+# -- health_outputs: the in-graph half --------------------------------------
+
+def test_health_outputs_flags_norm_and_loss():
+    import jax.numpy as jnp
+
+    named = {"b": jnp.array([1.0, 2.0]),
+             "a": jnp.array([3.0, jnp.nan]),
+             "count": jnp.array([4], dtype=jnp.int32)}  # non-inexact
+    names, health = health_outputs(named, loss=jnp.float32(0.5))
+    assert names == ("a", "b", "count", "loss")
+    flags = np.asarray(health["flags"])
+    assert flags.tolist() == [True, False, False, False]
+    # the poisoned tensor's nan propagates through the squared norm
+    assert not np.isfinite(float(np.asarray(health["grad_norm_sq"])))
+    assert float(np.asarray(health["loss"])) == 0.5
+    assert "stats" not in health
+
+
+def test_health_outputs_stats_block():
+    import jax.numpy as jnp
+
+    named = {"w": jnp.array([1.0, -3.0, 2.0, 0.0])}
+    names, health = health_outputs(named, with_stats=True)
+    stats = np.asarray(health["stats"])
+    assert stats.shape == (1, 4)
+    mean, std, max_abs, underflow = stats[0]
+    assert mean == pytest.approx(0.0)
+    assert max_abs == pytest.approx(3.0)
+    assert 0.0 <= underflow <= 1.0
+
+
+# -- the monitor: cadence reads, detectors, halt ----------------------------
+
+def test_watch_reads_previous_packet_at_cadence_and_flush_drains():
+    mon = get_monitor().enable(cadence=4)
+    for s in range(10):
+        mon.watch(s, *_packet())
+    snap = mon.snapshot()
+    # inspected packets: step 0 (first boundary), 4, 8 — each read one
+    # call AFTER its dispatch, so it never blocks the live step
+    assert snap["reads"] == 3
+    assert snap["steps_observed"] == 10
+    mon.flush()  # end-of-run: the held packet (step 9) is read now
+    assert mon.snapshot()["reads"] == 4
+    assert mon.anomaly_count() == 0
+
+
+def test_nonfinite_trip_names_tensor_once():
+    mon = get_monitor().enable(cadence=1)
+    mon.watch(0, *_packet(n_tensors=2))
+    for s in (1, 2, 3):
+        mon.watch(s, *_packet(n_tensors=2, bad=("p1",)))
+    mon.flush()
+    # p1 tripped in three inspected packets but is booked exactly once
+    assert mon.anomaly_count("nonfinite") == 1
+    snap = mon.snapshot()
+    assert snap["last_anomaly"]["kind"] == "nonfinite"
+    assert snap["last_anomaly"]["tensor"] == "p1"
+    assert snap["tripped"] == ["p1"]
+
+
+def test_ewma_loss_spike_and_grad_explosion_detectors():
+    mon = get_monitor().enable(cadence=1, spike_factor=10.0)
+    step = 0
+    for _ in range(6):  # build a warm, calm baseline
+        mon.watch(step, *_packet(loss=1.0, norm_sq=1.0))
+        step += 1
+    mon.watch(step, *_packet(loss=100.0, norm_sq=1.0))
+    step += 1
+    mon.watch(step, *_packet(loss=1.0, norm_sq=1.0))  # reads the spike
+    assert mon.anomaly_count("loss_spike") == 1
+    # the spike never contaminated the EWMA baseline
+    assert mon.snapshot()["loss_ewma"] == pytest.approx(1.0, abs=0.05)
+    mon.watch(step + 1, *_packet(loss=1.0, norm_sq=1.0e6))  # norm 1000
+    mon.watch(step + 2, *_packet(loss=1.0, norm_sq=1.0))
+    assert mon.anomaly_count("grad_explosion") == 1
+
+
+def test_halt_mode_raises_from_the_read():
+    mon = get_monitor().enable(cadence=1, halt=True)
+    mon.watch(0, *_packet())
+    mon.watch(1, *_packet(bad=("p0",)))
+    with pytest.raises(NumericsHaltError, match="p0"):
+        mon.watch(2, *_packet())  # this call inspects the poisoned one
+    # spike detectors never halt: only hard non-finite trips do
+    reset_monitor()
+    mon2 = get_monitor().enable(cadence=1, halt=True)
+    for s in range(6):
+        mon2.watch(s, *_packet(loss=1.0))
+    mon2.watch(6, *_packet(loss=500.0))
+    mon2.watch(7, *_packet(loss=1.0))
+    assert mon2.anomaly_count("loss_spike") == 1
+
+
+def test_disabled_monitor_is_inert_but_counts_host_anomalies():
+    mon = get_monitor()
+    assert not mon.enabled
+    mon.watch(0, *_packet(bad=("p0",)))
+    mon.flush()
+    assert mon.snapshot()["steps_observed"] == 0
+    assert mon.anomaly_count() == 0
+    # the scaler-skip path books through here even while disabled
+    mon.record_anomaly("scaler_skip", tensor="w", halt_ok=False)
+    assert mon.anomaly_count("scaler_skip") == 1
+
+
+def test_env_enablement(monkeypatch):
+    monkeypatch.setenv("PT_NUMERICS", "1")
+    monkeypatch.setenv("PT_NUMERICS_CADENCE", "7")
+    monkeypatch.setenv("PT_NUMERICS_HALT", "1")
+    reset_monitor()
+    mon = get_monitor()
+    assert mon.enabled and mon.cadence == 7 and mon.halt
+    assert current_monitor() is mon
+    monkeypatch.setenv("PT_GOODPUT", "1")
+    reset_goodput()
+    assert get_goodput().enabled
+
+
+# -- GradScaler: skipped steps are classified anomalies ---------------------
+
+def test_scaler_skip_books_anomaly_with_param_name():
+    import jax.numpy as jnp
+    from paddle_tpu.amp.grad_scaler import GradScaler
+
+    class _Grad:
+        def __init__(self, data):
+            self._data = data
+
+    class _Param:
+        def __init__(self, name, data):
+            self.name = name
+            self.grad = _Grad(data)
+
+    class _Opt:
+        def __init__(self, params):
+            self._parameter_list = params
+            self.stepped = 0
+
+        def step(self):
+            self.stepped += 1
+
+    scaler = GradScaler(init_loss_scaling=16.0)
+    opt = _Opt([_Param("good", jnp.ones(2)),
+                _Param("w::bad", jnp.array([1.0, jnp.inf]))])
+    scaler.step(opt)
+    scaler.update()
+    assert opt.stepped == 0  # the skip IS the recovery
+    mon = get_monitor()
+    assert mon.anomaly_count("scaler_skip") == 1
+    last = mon.snapshot()["last_anomaly"]
+    assert last["tensor"] == "w::bad"
+    assert scaler.get_loss_scaling() == 8.0  # dynamic backoff ran
+    # a clean step books nothing
+    opt2 = _Opt([_Param("good", jnp.ones(2))])
+    scaler.step(opt2)
+    assert opt2.stepped == 1
+    assert mon.anomaly_count("scaler_skip") == 1
+
+
+# -- goodput: the span ledger -----------------------------------------------
+
+def test_decompose_spans_matches_hand_computation():
+    S = 1_000_000_000  # 1s in ns
+    spans = [
+        Span("step", "compute", 0 * S, 1 * S, 0),
+        Span("step", "compute", 2 * S, 3 * S, 0),
+        # collective 1s long, 0.5s hidden under compute -> 0.5 exposed
+        Span("allreduce", "collective", S // 2, 3 * S // 2, 0),
+        Span("compile:step", "host", 3 * S, 5 * S, 0),
+        Span("data_wait", "host", 5 * S, 11 * S // 2, 0),
+        Span("checkpoint", "host", 11 * S // 2, 23 * S // 4, 0),
+    ]
+    d = decompose_spans(spans)
+    # hand decomposition: productive 2.0; badput = compile 2.0 +
+    # data_wait 0.5 + checkpoint 0.25 + collective_exposed 0.5 = 3.25
+    assert d["productive_seconds"] == pytest.approx(2.0)
+    bp = d["badput_seconds"]
+    assert bp["compile"] == pytest.approx(2.0)
+    assert bp["data_wait"] == pytest.approx(0.5)
+    assert bp["checkpoint"] == pytest.approx(0.25)
+    assert bp["collective_exposed"] == pytest.approx(0.5)
+    assert d["badput_total_seconds"] == pytest.approx(3.25)
+    assert d["goodput_fraction"] == pytest.approx(2.0 / 5.25)
+
+
+def test_decompose_overlapping_compute_merges_before_counting():
+    S = 1_000_000_000
+    spans = [  # two overlapping dispatch spans must not double-count
+        Span("a", "compute", 0, 2 * S, 0),
+        Span("b", "compute", S, 3 * S, 0),
+    ]
+    d = decompose_spans(spans)
+    assert d["productive_seconds"] == pytest.approx(3.0)
+    assert d["goodput_fraction"] == pytest.approx(1.0)
+
+
+def test_ledger_refresh_reads_tracer_and_feeds_restart_replay():
+    tr = get_tracer().enable()
+    S = 1_000_000_000
+    tr.phase_record("backward", 0, 4 * S)
+    tr.phase_record("data_wait", 4 * S, 5 * S)
+    gp = get_goodput().enable()
+    gp.record_restart_replay(1.0)
+    snap = gp.snapshot()
+    assert snap["enabled"]
+    assert snap["productive_seconds"] == pytest.approx(4.0)
+    assert snap["badput_seconds"]["data_wait"] == pytest.approx(1.0)
+    assert snap["badput_seconds"]["restart_replay"] == pytest.approx(1.0)
+    assert snap["goodput_fraction"] == pytest.approx(4.0 / 6.0)
+
+
+def test_disabled_ledger_is_inert():
+    gp = get_goodput()
+    assert not gp.enabled
+    gp.record_restart_replay(5.0)
+    snap = gp.snapshot()
+    assert snap["enabled"] is False
+
+
+# -- capture integration: monitors inside the SAME program ------------------
+
+def _mlp(seed=0):
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+
+    np.random.seed(seed)
+    pt.seed(seed)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    opt = pt.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                parameters=model.parameters())
+    return model, opt
+
+
+def _captured_step(model, opt):
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+
+    mse = nn.MSELoss()
+
+    @pt.jit.capture_step
+    def step(x, y):
+        loss = mse(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    return step
+
+
+def _run_10(monitored, cadence=3):
+    import paddle_tpu as pt
+
+    reset_monitor()
+    if monitored:
+        get_monitor().enable(cadence=cadence)
+    model, opt = _mlp(seed=7)
+    step = _captured_step(model, opt)
+    rng = np.random.RandomState(3)
+    x = pt.to_tensor(rng.randn(4, 8).astype(np.float32))
+    y = pt.to_tensor(rng.randn(4, 1).astype(np.float32))
+    losses = [np.asarray(step(x, y)._data).tobytes() for _ in range(10)]
+    return losses, step.stats
+
+
+def test_monitored_capture_bitwise_identical_one_compile():
+    base, base_stats = _run_10(monitored=False)
+    mon_losses, mon_stats = _run_10(monitored=True)
+    # monitors ride inside the same program: one compile, no fallback
+    assert mon_stats["compiles"] == 1 and mon_stats["hits"] == 9
+    assert not mon_stats["fallback"]
+    # and they never perturb the math: losses are bit-identical
+    assert mon_losses == base
+    mon = get_monitor()
+    assert mon.anomaly_count() == 0  # sentinel quiet on healthy training
+    assert mon.snapshot()["reads"] >= 2
+    assert mon.snapshot()["last_grad_norm"] is not None
+    reset_monitor()
+
+
+def test_monitored_capture_detects_poisoned_input():
+    import paddle_tpu as pt
+
+    reset_monitor()
+    get_monitor().enable(cadence=2)
+    model, opt = _mlp(seed=1)
+    step = _captured_step(model, opt)
+    rng = np.random.RandomState(5)
+    x = rng.randn(4, 8).astype(np.float32)
+    y = pt.to_tensor(rng.randn(4, 1).astype(np.float32))
+    for s in range(8):
+        xb = x.copy()
+        if s == 4:
+            xb[0, 0] = np.nan
+        step(pt.to_tensor(xb), y)
+    get_monitor().flush()
+    mon = get_monitor()
+    assert step.stats["compiles"] == 1  # the poison never retraced
+    assert mon.anomaly_count("nonfinite") >= 1
+    tripped = mon.snapshot()["tripped"]
+    assert any(t.startswith("model::") for t in tripped)
+    reset_monitor()
+
+
+def test_monitored_capture_with_stats_sampling():
+    import paddle_tpu as pt
+
+    reset_monitor()
+    get_monitor().enable(cadence=2, stats=True)
+    model, opt = _mlp(seed=2)
+    step = _captured_step(model, opt)
+    rng = np.random.RandomState(6)
+    x = pt.to_tensor(rng.randn(4, 8).astype(np.float32))
+    y = pt.to_tensor(rng.randn(4, 1).astype(np.float32))
+    for _ in range(6):
+        step(x, y)
+    get_monitor().flush()
+    stats = get_monitor().snapshot().get("tensor_stats")
+    assert stats and any(k.startswith("model::") for k in stats)
+    for entry in stats.values():
+        assert set(entry) == {"mean", "std", "max_abs", "underflow_frac"}
+    reset_monitor()
+
+
+def test_hapi_train_batch_feeds_the_monitor():
+    import paddle_tpu as pt
+
+    reset_monitor()
+    get_monitor().enable(cadence=2)
+    pt.seed(0)
+    net = pt.nn.Sequential(pt.nn.Linear(8, 4), pt.nn.ReLU(),
+                           pt.nn.Linear(4, 2))
+    model = pt.Model(net)
+    model.prepare(
+        optimizer=pt.optimizer.SGD(learning_rate=0.05,
+                                   parameters=net.parameters()),
+        loss=pt.nn.CrossEntropyLoss())
+    rng = np.random.RandomState(2)
+    x = rng.randn(16, 8).astype(np.float32)
+    y = rng.randint(0, 2, size=(16, 1)).astype(np.int64)
+    for _ in range(6):
+        model.train_batch([x], [y])
+    mon = get_monitor()
+    assert mon.snapshot()["reads"] >= 2
+    assert mon.anomaly_count() == 0
+    reset_monitor()
+
+
+# -- telemetry snapshot carries both blocks ---------------------------------
+
+def test_telemetry_snapshot_numerics_and_goodput_blocks():
+    tel = obs.get_telemetry().enable()
+    get_monitor().enable(cadence=1)
+    tr = get_tracer().enable()
+    S = 1_000_000_000
+    tr.phase_record("backward", 0, 3 * S)
+    tr.phase_record("data_wait", 3 * S, 4 * S)
+    get_goodput().enable()
+    tel.observe_step(0.01, mode="train")
+    snap = tel.snapshot()
+    assert snap["numerics"]["enabled"] is True
+    assert snap["numerics"]["anomalies_total"] == 0
+    assert snap["goodput"]["goodput_fraction"] == pytest.approx(0.75)
+    assert snap["goodput"]["badput_seconds"]["data_wait"] == \
+        pytest.approx(1.0)

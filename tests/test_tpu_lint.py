@@ -282,6 +282,25 @@ FIXTURES = {
                 return self.ln1(x, residual=self.attention(x, mask))
         """,
     ),
+    "TPU017": (
+        "paddle_tpu/hapi/mod.py",
+        """
+        import math
+        def train_loop(model, data):
+            for x, y in data:
+                loss = model.train_batch(x, y)
+                if math.isnan(float(loss)):
+                    raise RuntimeError("diverged")
+        """,
+        """
+        from paddle_tpu.observability.numerics import get_monitor
+        def train_loop(model, data):
+            for x, y in data:
+                model.train_batch(x, y)
+            if get_monitor().anomaly_count("nonfinite"):
+                raise RuntimeError("diverged")
+        """,
+    ),
     "TPU014": (
         "paddle_tpu/distributed/mod.py",
         """
@@ -740,6 +759,91 @@ def test_tpu016_scoped_to_nn_and_incubate_models():
     assert "TPU016" not in rules_fired(src, path="tests/test_x.py")
     assert "TPU016" not in rules_fired(src, path="paddle_tpu/ops/mod.py")
     assert "TPU016" not in rules_fired(src, path="bench.py")
+
+
+def test_tpu017_all_three_spellings_fire():
+    # sync method chained onto the device-side check
+    src = """
+    import jax.numpy as jnp
+    def check(loss):
+        return jnp.isnan(loss).item()
+    """
+    assert "TPU017" in rules_fired(src, path="paddle_tpu/hapi/m.py")
+    # host cast wrapped around the device-side check
+    src2 = """
+    import jax.numpy as jnp
+    def check(grads):
+        return bool(jnp.any(~jnp.isfinite(grads)))
+    """
+    assert "TPU017" in rules_fired(src2, path="paddle_tpu/hapi/m.py")
+    # host-side check fed by an explicit sync
+    src3 = """
+    import numpy as np
+    def check(x):
+        return np.isnan(x.numpy()).any()
+    """
+    assert "TPU017" in rules_fired(src3, path="paddle_tpu/hapi/m.py")
+
+
+def test_tpu017_scoped_to_library_and_train_loops():
+    src = """
+    import math
+    def train_steps(model, batches):
+        for b in batches:
+            if math.isnan(float(model.step(b))):
+                break
+    """
+    # train-loop functions fire even outside the library tree...
+    assert "TPU017" in rules_fired(src, path="myscript.py")
+    # ...but an arbitrary user helper does not
+    src2 = """
+    import math
+    def summarize(v):
+        return math.isnan(float(v))
+    """
+    assert "TPU017" not in rules_fired(src2, path="myscript.py")
+    assert "TPU017" in rules_fired(src2, path="paddle_tpu/hapi/m.py")
+
+
+def test_tpu017_device_side_checks_are_silent():
+    # in-graph nan handling never leaves the device: no sync, no report
+    src = """
+    import jax.numpy as jnp
+    def sanitize(x):
+        return jnp.where(jnp.isnan(x), 0.0, x)
+    """
+    assert "TPU017" not in rules_fired(src, path="paddle_tpu/ops/m.py")
+    # math.isnan on a plain host scalar is not a device sync either
+    src2 = """
+    import math
+    def valid(lr):
+        return not math.isnan(lr)
+    """
+    assert "TPU017" not in rules_fired(src2, path="paddle_tpu/ops/m.py")
+
+
+def test_tpu017_inner_sync_carries_the_report_once():
+    # bool(math.isnan(float(x))): the inner spelling-3 call reports;
+    # the wrapper must not double-book the same sync
+    src = """
+    import math
+    def check(x):
+        return bool(math.isnan(float(x)))
+    """
+    vs = [v for v in lint_source(textwrap.dedent(src),
+                                 path="paddle_tpu/hapi/m.py")
+          if v.rule == "TPU017"]
+    assert len(vs) == 1
+
+
+def test_tpu017_suppression_directive_respected():
+    src = """
+    import jax.numpy as jnp
+    def audit(out):
+        # tpu-lint: disable=TPU017
+        return bool(jnp.all(jnp.isfinite(out)))
+    """
+    assert "TPU017" not in rules_fired(src, path="paddle_tpu/ops/m.py")
 
 
 def test_tpu016_vector_norms_and_fused_entry_are_silent():
