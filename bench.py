@@ -785,9 +785,11 @@ def _leg_main(name, batch, recompute):
     from paddle_tpu.observability.trace import get_tracer
     from paddle_tpu.observability.goodput import get_goodput
     from paddle_tpu.observability.numerics import get_monitor
+    from paddle_tpu.observability.memory import get_memory_monitor
     tel = get_telemetry().enable()  # metrics + compile watch, no sink/server
     tr = get_tracer().enable()      # span sink + analytic-MFU accounting
     gp = get_goodput().enable()     # wall-clock decomposition over spans
+    mm = get_memory_monitor().enable()  # footprints + watermarks + OOM
     fields: dict = {}
     rec = {"ok": True, "fields": fields}
     try:
@@ -818,6 +820,7 @@ def _leg_main(name, batch, recompute):
     fields[f"trace_{name}"] = tr.snapshot()
     fields[f"goodput_{name}"] = gp.snapshot()
     fields[f"numerics_{name}"] = get_monitor().snapshot()
+    fields[f"memory_{name}"] = mm.snapshot()
     print(json.dumps(rec), flush=True)
 
 
@@ -885,9 +888,11 @@ def main():
     from paddle_tpu.observability.trace import get_tracer
     from paddle_tpu.observability.goodput import get_goodput
     from paddle_tpu.observability.numerics import get_monitor
+    from paddle_tpu.observability.memory import get_memory_monitor
     tel = get_telemetry().enable()
     tr = get_tracer().enable()
     gp = get_goodput().enable()
+    mm = get_memory_monitor().enable()
 
     def remaining():
         return BUDGET_SEC - (time.time() - t_start)
@@ -910,6 +915,9 @@ def main():
         try:
             result["goodput"] = gp.snapshot()
             result["numerics"] = get_monitor().snapshot()
+            # …and the memory block: fit verdicts + watermark summary,
+            # {} stats on the tpu_unreachable CPU fast-fail
+            result["memory"] = mm.snapshot()
         except Exception:
             pass
         print(json.dumps(result), flush=True)

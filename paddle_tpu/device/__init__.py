@@ -50,21 +50,11 @@ class cuda:
 
     @staticmethod
     def max_memory_allocated(device=None):
-        import jax
-        try:
-            stats = jax.devices()[0].memory_stats()
-            return stats.get("peak_bytes_in_use", 0)
-        except Exception:
-            return 0
+        return _mem_stat("peak_bytes_in_use")
 
     @staticmethod
     def memory_allocated(device=None):
-        import jax
-        try:
-            stats = jax.devices()[0].memory_stats()
-            return stats.get("bytes_in_use", 0)
-        except Exception:
-            return 0
+        return _mem_stat("bytes_in_use")
 
 
 # -- stream/event surface (ref device/__init__.py:410-877) ---------------
@@ -197,12 +187,10 @@ cuda.stream_guard = stream_guard
 
 
 def _mem_stat(which, device=None):
-    try:
-        import jax
-        stats = jax.local_devices()[0].memory_stats() or {}
-        return int(stats.get(which, 0))
-    except Exception:
-        return 0
+    # the ONE allocator read every memory shim routes through: guarded
+    # (never initializes a jax backend just to ask), 0 when absent
+    from ..observability.memory import device_memory_stat
+    return device_memory_stat(which)
 
 
 def _memory_reserved(device=None):

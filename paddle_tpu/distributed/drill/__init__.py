@@ -81,6 +81,17 @@ step compiled exactly once.  The halt variant proves
 ``PT_NUMERICS_HALT`` converts the trip into a clean
 ``EXIT_NUMERICS_HALT`` exit instead of a poisoned-forever run.
 
+OOM drills (:func:`.runner.run_oom_drill`) exercise the memory
+postmortem end-to-end: every worker trains a REAL captured MLP with
+the memory monitor armed, one rank's compiled entry is swapped for a
+``RESOURCE_EXHAUSTED``-raising callable at a scripted step, and the
+drill proves the capture intercept booked a flight dump pinning
+``oom:<program>:<parameter path>`` (census + per-program footprints +
+watermark history in ``extra.memory``), the victim exited ``EXIT_OOM``
+cleanly, clean ranks booked nothing — and, replaying each rank's
+metrics exposition through a local aggregator, that the fleet sees the
+cross-rank memory skew and the near-OOM health trip.
+
 Overlap drills (:func:`.runner.run_overlap_drill`) exercise the
 optimization half of GC3: the span timelines pinned down by the
 bucketed vs monolithic gradient reduction (real ``partition_buckets``
@@ -94,11 +105,12 @@ schedule — and proves the scheduled buckets lift overlap from 0 to
 above one half.
 """
 __all__ = ["KillSpec", "StoreKillSpec", "ObsSpec", "TraceSpec",
-           "NumericsSpec", "run_drill", "run_store_kill_drill",
-           "run_scrape_drill", "run_trace_drill",
-           "run_numerics_drill", "run_overlap_drill",
-           "run_sharded_overlap_drill", "spawn_worker",
-           "spawn_store_master", "spawn_aggregator", "reap_all"]
+           "NumericsSpec", "OomSpec", "run_drill",
+           "run_store_kill_drill", "run_scrape_drill",
+           "run_trace_drill", "run_numerics_drill", "run_oom_drill",
+           "run_overlap_drill", "run_sharded_overlap_drill",
+           "spawn_worker", "spawn_store_master", "spawn_aggregator",
+           "reap_all"]
 
 
 def __getattr__(name):

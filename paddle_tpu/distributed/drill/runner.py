@@ -20,6 +20,7 @@ import os
 import signal
 import subprocess
 import sys
+import time
 import urllib.error
 import urllib.request
 import uuid
@@ -29,16 +30,17 @@ from ...utils.retry import wait_until
 from ..checkpoint import read_leaf, verify_checkpoint
 from ..checkpoint_manager import CheckpointManager
 from ..resilient_store import ResilientStore, read_endpoint_file
-from .worker import (EXIT_NUMERICS_HALT, EXIT_SAVE_FAILED,
+from .worker import (EXIT_NUMERICS_HALT, EXIT_OOM, EXIT_SAVE_FAILED,
                      EXIT_STORE_LOST, advance, init_state,
                      numerics_report_path, obs_ready_key,
-                     obs_release_key, trace_report_path)
+                     obs_release_key, oom_metrics_path,
+                     oom_report_path, trace_report_path)
 
 __all__ = ["KillSpec", "StoreKillSpec", "ObsSpec", "TraceSpec",
-           "NumericsSpec", "DrillFailure", "spawn_worker",
+           "NumericsSpec", "OomSpec", "DrillFailure", "spawn_worker",
            "spawn_store_master", "spawn_aggregator", "run_drill",
            "run_store_kill_drill", "run_scrape_drill",
-           "run_trace_drill", "run_numerics_drill",
+           "run_trace_drill", "run_numerics_drill", "run_oom_drill",
            "run_overlap_drill", "run_sharded_overlap_drill",
            "reap_all"]
 
@@ -84,17 +86,22 @@ class ObsSpec:
     trip), then hold the endpoint open until released."""
 
     __slots__ = ("telemetry_dir", "step_base", "storm",
-                 "sentinel_threshold", "hold_timeout", "anomalies")
+                 "sentinel_threshold", "hold_timeout", "anomalies",
+                 "mem_bytes")
 
     def __init__(self, telemetry_dir, step_base=0.01, storm=True,
                  sentinel_threshold=3, hold_timeout=120.0,
-                 anomalies=0):
+                 anomalies=0, mem_bytes=0):
         self.telemetry_dir = telemetry_dir
         self.step_base = float(step_base)
         self.storm = bool(storm)
         self.sentinel_threshold = int(sentinel_threshold)
         self.hold_timeout = float(hold_timeout)
         self.anomalies = int(anomalies)
+        # nonzero: feed a rank-scaled synthetic memory watermark
+        # (mem_bytes * (1 + rank)) so the aggregator's skew/near-OOM
+        # derivations are assertable
+        self.mem_bytes = int(mem_bytes)
 
 
 class TraceSpec:
@@ -133,6 +140,24 @@ class NumericsSpec:
         self.halt = bool(halt)
 
 
+class OomSpec:
+    """Scripted allocator-exhaustion worker (``DRILL_OOM=1``): train a
+    real captured MLP with the memory monitor armed, inject a
+    ``RESOURCE_EXHAUSTED`` into ``oom_rank``'s compiled entry at
+    ``oom_step``, and write the postmortem evidence (report + metrics
+    exposition) into ``out_dir``.  ``mem_bytes`` scales each rank's
+    synthetic watermark feed (rank r exports ``mem_bytes * (1 + r)``)."""
+
+    __slots__ = ("out_dir", "oom_step", "oom_rank", "mem_bytes")
+
+    def __init__(self, out_dir, oom_step=5, oom_rank=1,
+                 mem_bytes=1_000_000):
+        self.out_dir = out_dir
+        self.oom_step = int(oom_step)
+        self.oom_rank = int(oom_rank)
+        self.mem_bytes = int(mem_bytes)
+
+
 class StoreKillSpec:
     """Scripted STORE-MASTER kill: every rank rendezvouses at ``phase``
     of step ``step``'s save (``pre-save`` | ``mid-barrier``), and the
@@ -169,7 +194,7 @@ def spawn_worker(rank, world, *, root, port=0, total_steps, run_id,
                  barrier_timeout, kill=None, elastic=True,
                  orphan_age=None, log_path=None, endpoint_file=None,
                  store_deadline=None, storekill=None, obs=None,
-                 trace=None, numerics=None, flight_dir=None):
+                 trace=None, numerics=None, oom=None, flight_dir=None):
     """Launch one drill worker subprocess; returns its Popen (also
     registered for :func:`reap_all`).
 
@@ -182,7 +207,8 @@ def spawn_worker(rank, world, *, root, port=0, total_steps, run_id,
     synthetic step count); ``trace`` (a :class:`TraceSpec`) switches
     to the storeless step-tracing mode; ``numerics`` (a
     :class:`NumericsSpec`) switches to the storeless NaN-injection
-    mode; ``flight_dir`` arms the flight recorder
+    mode; ``oom`` (an :class:`OomSpec`) switches to the storeless
+    OOM-postmortem mode; ``flight_dir`` arms the flight recorder
     (``PT_FLIGHT_RECORDER``).
     """
     env = {k: v for k, v in os.environ.items()
@@ -227,6 +253,8 @@ def spawn_worker(rank, world, *, root, port=0, total_steps, run_id,
         env["PT_RECOMPILE_THRESHOLD"] = str(obs.sentinel_threshold)
         if obs.anomalies:
             env["DRILL_OBS_ANOMALIES"] = str(obs.anomalies)
+        if obs.mem_bytes:
+            env["DRILL_OBS_MEM_BYTES"] = str(obs.mem_bytes)
     if trace is not None:
         env["DRILL_TRACE"] = "1"
         env["DRILL_TRACE_DIR"] = trace.trace_dir
@@ -240,6 +268,12 @@ def spawn_worker(rank, world, *, root, port=0, total_steps, run_id,
         env["DRILL_POISON_RANK"] = str(numerics.poison_rank)
         env["DRILL_NUMERICS_CADENCE"] = str(numerics.cadence)
         env["DRILL_NUMERICS_HALT"] = "1" if numerics.halt else "0"
+    if oom is not None:
+        env["DRILL_OOM"] = "1"
+        env["DRILL_OOM_DIR"] = oom.out_dir
+        env["DRILL_OOM_STEP"] = str(oom.oom_step)
+        env["DRILL_OOM_RANK"] = str(oom.oom_rank)
+        env["DRILL_OOM_MEM_BYTES"] = str(oom.mem_bytes)
     if flight_dir is not None:
         env["PT_FLIGHT_RECORDER"] = flight_dir
     cmd = [sys.executable, "-m", "paddle_tpu.distributed.drill.worker"]
@@ -301,9 +335,9 @@ def spawn_store_master(*, endpoint_file, wal_path=None, port=0,
 
 def spawn_aggregator(*, endpoint_file, run_id, port_file,
                      interval=0.25, stale_after=2.0, storm_threshold=1,
-                     anomaly_threshold=10, scrape_timeout=2.0,
-                     store_deadline=10.0, log_path=None,
-                     spawn_timeout=60.0):
+                     anomaly_threshold=10, mem_threshold=0,
+                     scrape_timeout=2.0, store_deadline=10.0,
+                     log_path=None, spawn_timeout=60.0):
     """Launch the cluster aggregator as a REAL subprocess
     (``python -m paddle_tpu.observability.aggregator``) discovering
     rank endpoints through the store, and wait for it to publish its
@@ -326,6 +360,8 @@ def spawn_aggregator(*, endpoint_file, run_id, port_file,
            "--scrape-timeout", str(scrape_timeout),
            "--storm-threshold", str(storm_threshold),
            "--anomaly-threshold", str(anomaly_threshold)]
+    if mem_threshold:
+        cmd += ["--mem-threshold", str(mem_threshold)]
     if log_path:
         with open(log_path, "ab") as out:
             p = subprocess.Popen(cmd, env=env, stdout=out,
@@ -667,6 +703,7 @@ def run_store_kill_drill(root, *, world=2, total_steps=5, kill_step=3,
 
 def run_scrape_drill(root, *, world=3, steps=12, step_base=0.01,
                      kill_rank=2, storm=True, anomalies=0,
+                     mem_bytes=0, mem_threshold=0,
                      restart_aggregator=False,
                      respawn_master=False, stale_after=2.0,
                      scrape_interval=0.25, store_deadline=10.0,
@@ -685,7 +722,12 @@ def run_scrape_drill(root, *, world=3, steps=12, step_base=0.01,
     0.8; ``anomalies`` (per-rank scripted numerics trips) arms the
     cross-rank anomaly alarm, whose threshold is then set to
     ``world * anomalies`` so it trips exactly — and flips /healthz to
-    503 even without a recompile storm.
+    503 even without a recompile storm.  ``mem_bytes`` feeds each rank
+    a synthetic allocator watermark (rank r exports
+    ``mem_bytes * (1 + r)``) so the cluster memory-skew gauge must
+    read exactly ``mem_bytes * (world - 1)``; with ``mem_threshold``
+    at or below ``mem_bytes * world`` the near-OOM alarm must trip and
+    flip /healthz to 503 on the memory signal alone.
 
     ``kill_rank`` (None to skip) is then SIGKILLed while still holding
     its endpoint open: the aggregator must mark it stale
@@ -717,7 +759,11 @@ def run_scrape_drill(root, *, world=3, steps=12, step_base=0.01,
     run_id = f"obs-{uuid.uuid4().hex[:6]}"
     spec = ObsSpec(telemetry_dir=telemetry_dir, step_base=step_base,
                    storm=storm, sentinel_threshold=sentinel_threshold,
-                   hold_timeout=gen_timeout, anomalies=anomalies)
+                   hold_timeout=gen_timeout, anomalies=anomalies,
+                   mem_bytes=mem_bytes)
+    mem_alarm_expected = bool(
+        mem_bytes and mem_threshold
+        and mem_bytes * world >= mem_threshold)
     report = {"run_id": run_id, "world": world, "steps": steps,
               "aggregator_restarted": False, "master_respawned": False}
     watch = None
@@ -745,6 +791,7 @@ def run_scrape_drill(root, *, world=3, steps=12, step_base=0.01,
             port_file=port_file, interval=scrape_interval,
             stale_after=stale_after, storm_threshold=storm_threshold,
             anomaly_threshold=anomaly_threshold,
+            mem_threshold=mem_threshold,
             store_deadline=store_deadline,
             log_path=_log("aggregator.log"))
         base = f"http://{ahost}:{aport}"
@@ -826,7 +873,7 @@ def run_scrape_drill(root, *, world=3, steps=12, step_base=0.01,
             if alarm not in (0.0, None):
                 raise DrillFailure(
                     f"storm alarm tripped ({alarm}) without a storm")
-            want = 503 if anomalies else 200
+            want = 503 if (anomalies or mem_alarm_expected) else 200
             if status != want:
                 raise DrillFailure(
                     f"/healthz returned {status}, expected {want}")
@@ -864,6 +911,32 @@ def run_scrape_drill(root, *, world=3, steps=12, step_base=0.01,
             raise DrillFailure(
                 f"anomaly alarm tripped ({anomaly_alarm}) without "
                 f"scripted anomalies")
+
+        # --- fleet memory view: skew gauge + the near-OOM trip -------
+        mem_skew = _sample_value(fams, "pt_cluster_memory_skew_bytes")
+        mem_alarm = _sample_value(fams, "pt_cluster_memory_alarm")
+        if mem_bytes:
+            want_skew = float(mem_bytes * (world - 1))
+            if mem_skew != want_skew:
+                raise DrillFailure(
+                    f"pt_cluster_memory_skew_bytes is {mem_skew!r}; "
+                    f"rank-scaled watermarks pin it to {want_skew}")
+            if mem_alarm != (1.0 if mem_alarm_expected else 0.0):
+                raise DrillFailure(
+                    f"memory alarm is {mem_alarm!r}, expected "
+                    f"{mem_alarm_expected} at threshold "
+                    f"{mem_threshold} with max {mem_bytes * world}")
+            hmem = health.get("memory") or {}
+            if hmem.get("bytes_in_use_max") != mem_bytes * world \
+                    or bool(hmem.get("mem_alarm")) != mem_alarm_expected:
+                raise DrillFailure(
+                    f"/healthz memory block {hmem!r} disagrees with "
+                    f"the scripted watermarks (max "
+                    f"{mem_bytes * world}, alarm {mem_alarm_expected})")
+        elif mem_alarm not in (0.0, None):
+            raise DrillFailure(
+                f"memory alarm tripped ({mem_alarm}) without scripted "
+                f"watermarks")
         report.update({
             "skew_seconds": skew, "straggler_ratio": straggler,
             "merged_steps": hist_count, "storms_total": storms_total,
@@ -871,6 +944,8 @@ def run_scrape_drill(root, *, world=3, steps=12, step_base=0.01,
             "cluster_goodput": {"min": gp_min, "mean": gp_mean},
             "anomalies_total": anomalies_total,
             "anomaly_alarm": anomaly_alarm,
+            "memory_skew_bytes": mem_skew,
+            "memory_alarm": mem_alarm,
         })
 
         if respawn_master:
@@ -1280,6 +1355,192 @@ def run_numerics_drill(root, *, world=2, steps=12, poison_step=5,
                 raise DrillFailure(
                     f"clean rank {r} claims detection at step "
                     f"{rep['detected_step']}")
+    finally:
+        reap_all()
+    return report
+
+
+def run_oom_drill(root, *, world=2, steps=8, oom_step=5, oom_rank=1,
+                  mem_bytes=1_000_000, mem_threshold=None,
+                  gen_timeout=120.0, log_dir=None):
+    """OOM-postmortem drill: ``world`` REAL worker processes each
+    train a captured MLP on CPU with the memory monitor armed;
+    ``oom_rank`` swaps its compiled cache entry for a callable raising
+    ``RESOURCE_EXHAUSTED`` at ``oom_step``, so the capture replay's
+    intercept must book a flight dump whose reason pins
+    ``oom:<program>:<buffer>`` with the buffer being a PARAMETER PATH
+    (the drill model's first weight dominates every other live array
+    by construction) and whose ``extra.memory`` payload carries the
+    census, per-program footprints and watermark history.  The victim
+    exits ``EXIT_OOM`` (23) cleanly after writing its report; clean
+    ranks exit 0 with zero postmortems; every rank compiles exactly
+    once (the armed failure is a cache HIT, never a retrace).
+
+    Each rank also exports a rank-scaled synthetic watermark
+    (``mem_bytes * (1 + rank)``) and dumps its /metrics exposition;
+    the runner replays those dumps through a LOCAL
+    :class:`~paddle_tpu.observability.aggregator.ClusterAggregator`
+    (threshold ``mem_threshold``, default ``mem_bytes * world`` so the
+    near-OOM trip fires exactly) and asserts the fleet view: skew
+    gauge ``mem_bytes * (world - 1)``, per-rank bytes in /healthz, and
+    the memory alarm flipping health to not-ok.  Storeless: no
+    TCPStore master, no checkpoints.  Returns a report dict."""
+    out_dir = os.path.join(root, "oom")
+    flight_dir = os.path.join(root, "flight")
+    os.makedirs(out_dir, exist_ok=True)
+    run_id = f"oom-{uuid.uuid4().hex[:6]}"
+    if mem_threshold is None:
+        mem_threshold = mem_bytes * world
+    spec = OomSpec(out_dir=out_dir, oom_step=oom_step,
+                   oom_rank=oom_rank, mem_bytes=mem_bytes)
+    report = {"run_id": run_id, "world": world, "steps": steps,
+              "oom_step": oom_step, "oom_rank": oom_rank,
+              "mem_bytes": mem_bytes, "mem_threshold": mem_threshold}
+    try:
+        procs = [
+            spawn_worker(
+                r, world, root=root, total_steps=steps, run_id=run_id,
+                barrier_timeout=gen_timeout, oom=spec,
+                flight_dir=flight_dir,
+                log_path=(os.path.join(log_dir, f"oom_rank{r}.log")
+                          if log_dir else None))
+            for r in range(world)
+        ]
+        rcs = _wait_fleet(procs, gen_timeout)
+        report["rcs"] = rcs
+        for r, rc in enumerate(rcs):
+            want = EXIT_OOM if r == oom_rank else 0
+            if rc != want:
+                raise DrillFailure(
+                    f"oom rank {r} exited {rc}, expected {want}")
+
+        ranks = {}
+        for r in range(world):
+            rep_path = oom_report_path(out_dir, r)
+            try:
+                with open(rep_path, "r", encoding="utf-8") as f:
+                    rep = json.load(f)
+            except (OSError, ValueError) as e:
+                raise DrillFailure(
+                    f"rank {r} wrote no parseable oom report at "
+                    f"{rep_path}: {e}") from e
+            ranks[r] = rep
+            if rep.get("compiles") != 1:
+                raise DrillFailure(
+                    f"rank {r} compiled its captured step "
+                    f"{rep.get('compiles')} times; the armed failure "
+                    f"must replay a cache hit, never retrace")
+            if rep.get("fallback"):
+                raise DrillFailure(
+                    f"rank {r} fell back to eager: "
+                    f"{rep.get('fallback')!r}")
+        report["ranks"] = ranks
+
+        # --- the victim: postmortem booked, flight dump pins a param -
+        rep = ranks[oom_rank]
+        if not rep.get("caught") or rep.get("oom_events") != 1:
+            raise DrillFailure(
+                f"victim rank {oom_rank} booked "
+                f"{rep.get('oom_events')} postmortems (caught="
+                f"{rep.get('caught')!r}), expected exactly 1")
+        fpath = rep.get("flight")
+        try:
+            with open(fpath, "r", encoding="utf-8") as f:
+                flight = json.load(f)
+        except (TypeError, OSError, ValueError) as e:
+            raise DrillFailure(
+                f"victim's flight dump unreadable at {fpath!r}: "
+                f"{e}") from e
+        reason = flight.get("reason") or ""
+        named = reason.split(":", 2)[2] if reason.count(":") >= 2 \
+            else ""
+        if not reason.startswith("oom:") \
+                or not named.startswith("param::"):
+            raise DrillFailure(
+                f"flight dump reason {reason!r} must pin the top live "
+                f"buffer to a parameter path (param::...)")
+        if flight.get("process_index") != oom_rank:
+            raise DrillFailure(
+                f"flight dump identity "
+                f"{flight.get('process_index')!r} != victim rank "
+                f"{oom_rank}")
+        mem_doc = (flight.get("extra") or {}).get("memory") or {}
+        census = mem_doc.get("census") or {}
+        top = census.get("top") or []
+        if mem_doc.get("top_buffer") != named or not top \
+                or top[0].get("name") != named:
+            raise DrillFailure(
+                f"postmortem census top {top[:1]!r} disagrees with "
+                f"the flight reason's buffer {named!r}")
+        if not mem_doc.get("programs"):
+            raise DrillFailure(
+                "postmortem carries no per-program footprints; the "
+                "compile-time harvest must ride into the flight dump")
+        if not mem_doc.get("watermarks"):
+            raise DrillFailure(
+                "postmortem carries no watermark history; the "
+                "synthetic samples must ride into the flight dump")
+        report.update({"flight_reason": reason, "named_buffer": named,
+                       "census_categories":
+                           sorted(census.get("by_category") or {})})
+
+        # --- clean ranks booked nothing ------------------------------
+        for r in range(world):
+            if r == oom_rank:
+                continue
+            if ranks[r].get("oom_events") or ranks[r].get("caught"):
+                raise DrillFailure(
+                    f"clean rank {r} booked an OOM postmortem: "
+                    f"{ranks[r]!r}")
+
+        # --- fleet view: replay the per-rank expositions through a
+        # local aggregator and assert skew + the near-OOM trip --------
+        from ...observability.aggregator import (ClusterAggregator,
+                                                 parse_prometheus_text)
+        agg = ClusterAggregator(
+            endpoints={r: f"drill-rank-{r}" for r in range(world)},
+            run_id=run_id, mem_threshold=mem_threshold)
+        for r in range(world):
+            mpath = oom_metrics_path(out_dir, r)
+            try:
+                with open(mpath, "r", encoding="utf-8") as f:
+                    fams = parse_prometheus_text(f.read())
+            except (OSError, ValueError) as e:
+                raise DrillFailure(
+                    f"rank {r} exposition dump unreadable at "
+                    f"{mpath}: {e}") from e
+            agg._scrapes[r] = {"ts": time.monotonic(),
+                               "families": fams, "error": None}
+        agg._render()
+        fams = parse_prometheus_text(agg.prometheus_text())
+        skew = _sample_value(fams, "pt_cluster_memory_skew_bytes")
+        # the victim died before feeding a watermark only when the
+        # injection step precedes its first sample; every surviving
+        # rank r published mem_bytes * (1 + r)
+        live = [r for r in range(world)
+                if ranks[r].get("watermark_samples")]
+        want_skew = float(mem_bytes * (max(live) - min(live)))
+        if skew != want_skew:
+            raise DrillFailure(
+                f"fleet memory skew {skew!r}, expected {want_skew} "
+                f"from ranks {live} at base {mem_bytes}")
+        health = agg.healthz()
+        hmem = health.get("memory") or {}
+        want_alarm = mem_bytes * (1 + max(live)) >= mem_threshold
+        if bool(hmem.get("mem_alarm")) != want_alarm \
+                or health.get("ok") != (not want_alarm):
+            raise DrillFailure(
+                f"aggregator health {hmem!r} ok={health.get('ok')}; "
+                f"expected mem_alarm={want_alarm} at threshold "
+                f"{mem_threshold}")
+        oom_total = _sample_value(fams, "pt_cluster_oom_events_total")
+        if oom_total is None:
+            oom_total = sum(
+                ranks[r].get("oom_events", 0) for r in range(world))
+        report.update({"fleet_skew_bytes": skew,
+                       "mem_alarm": bool(hmem.get("mem_alarm")),
+                       "healthz": health,
+                       "oom_events_total": oom_total})
     finally:
         reap_all()
     return report

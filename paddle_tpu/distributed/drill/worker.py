@@ -65,6 +65,19 @@ Run as ``python -m paddle_tpu.distributed.drill.worker`` with the
    the injection, ``DRILL_NUMERICS_CADENCE`` the read cadence,
    ``DRILL_NUMERICS_HALT=1`` the halt variant (clean exit 21), and the
    per-rank report lands in ``DRILL_NUMERICS_DIR``.
+ - ``DRILL_OOM=1``: OOM-postmortem mode (:func:`_oom_main`) —
+   storeless.  Each rank trains a real captured MLP with the memory
+   monitor armed and feeds a rank-scaled synthetic allocator watermark
+   (``DRILL_OOM_MEM_BYTES`` × (1 + rank) — CPU reports no allocator
+   stats, so the watermark pipeline is driven through its public
+   ``observe_sample`` seam); at ``DRILL_OOM_STEP`` the victim
+   (``DRILL_OOM_RANK``) swaps its compiled entry for a callable
+   raising ``RESOURCE_EXHAUSTED``, the capture replay's intercept
+   books the memory postmortem into the flight recorder, and the
+   worker exits ``EXIT_OOM`` (23) after writing its report + a
+   ``/metrics`` exposition dump into ``DRILL_OOM_DIR`` (the runner
+   feeds those to a local aggregator to assert the fleet-level
+   memory-skew view).
 
 The "model" is a (12, 4) fp32 array row-partitioned across ranks via
 :class:`~paddle_tpu.distributed.checkpoint.HostLocalShard` (12 divides
@@ -96,6 +109,7 @@ ROWS, COLS = 12, 4
 EXIT_SAVE_FAILED = 17
 EXIT_STORE_LOST = 19
 EXIT_NUMERICS_HALT = 21
+EXIT_OOM = 23
 
 logger = logging.getLogger("paddle_tpu.drill.worker")
 
@@ -163,6 +177,19 @@ def _obs_main(env, rank, world, total, run_id):
             tr.phase_record("data_wait", t0, t0 + step_ns // 5)
             tr.phase_record("backward", t0 + step_ns // 5, t0 + step_ns)
         gp.refresh()
+        mem_bytes = int(env.get("DRILL_OBS_MEM_BYTES", "0"))
+        if mem_bytes:
+            # rank-scaled synthetic allocator watermark (CPU exposes
+            # no allocator stats, so the public observe_sample seam
+            # drives the same export pipeline): rank r publishes
+            # mem_bytes * (1 + r), making the aggregator's cross-rank
+            # skew exactly mem_bytes * (world - 1) and its near-OOM
+            # trip point mem_bytes * world
+            from ...observability.memory import get_memory_monitor
+            get_memory_monitor().enable().observe_sample({
+                "bytes_in_use": mem_bytes * (1 + rank),
+                "peak_bytes_in_use": mem_bytes * (1 + rank),
+                "bytes_reserved": mem_bytes * (1 + rank)})
         n_anoms = int(env.get("DRILL_OBS_ANOMALIES", "0"))
         if n_anoms:
             # scripted numerics anomalies: feeds the aggregator's
@@ -346,6 +373,126 @@ def _numerics_main(env, rank, world, total, run_id):
     sys.exit(EXIT_NUMERICS_HALT if halted else 0)
 
 
+def oom_report_path(out_dir, rank):
+    """Per-rank OOM-drill report (postmortem evidence JSON)."""
+    return os.path.join(out_dir, f"oom_report-{rank}.json")
+
+
+def oom_metrics_path(out_dir, rank):
+    """Per-rank /metrics exposition dump (the runner replays these
+    through a local aggregator to assert the fleet memory-skew view)."""
+    return os.path.join(out_dir, f"oom_metrics-{rank}.prom")
+
+
+def _oom_main(env, rank, world, total, run_id):
+    """OOM-postmortem drill mode (``DRILL_OOM=1``): storeless.
+
+    Each rank trains a real captured MLP on CPU with the memory
+    monitor armed.  The model's first weight (64×256 fp32, 64 KiB)
+    dominates every other live buffer, so the census top entry is a
+    parameter path by construction.  At ``DRILL_OOM_STEP`` the victim
+    rank swaps its compiled cache entry for a callable that raises a
+    ``RESOURCE_EXHAUSTED`` — exactly what a real allocator failure
+    looks like to the replay — and the capture intercept must book a
+    flight dump whose reason pins ``oom:<program>:<param path>``.
+    Synthetic rank-scaled watermarks (CPU has no allocator stats) feed
+    the exported ``pt_memory_watermark_bytes`` gauge each virtual
+    step, giving the runner's aggregator a nonzero cross-rank skew.
+    """
+    out_dir = env["DRILL_OOM_DIR"]
+    oom_step = int(env.get("DRILL_OOM_STEP", "-1"))
+    oom_rank = int(env.get("DRILL_OOM_RANK", "0"))
+    mem_bytes = int(env.get("DRILL_OOM_MEM_BYTES", "1000000"))
+
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+    from ...observability import memory as _memory
+    from ...observability.metrics import get_registry
+    from ...observability.trace import get_tracer
+
+    mm = _memory.get_memory_monitor().enable()
+    tr = get_tracer()  # enabled iff the runner set PT_FLIGHT_RECORDER
+
+    np.random.seed(rank)
+    pt.seed(rank)
+    # SGD (stateless) keeps optimizer slots out of the census so the
+    # 64 KiB first weight is the unambiguous top buffer
+    model = nn.Sequential(nn.Linear(64, 256), nn.ReLU(),
+                          nn.Linear(256, 1))
+    opt = pt.optimizer.SGD(learning_rate=0.01,
+                           parameters=model.parameters())
+    mse = nn.MSELoss()
+
+    @pt.jit.capture_step
+    def step(x, y):
+        loss = mse(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    x = pt.to_tensor(np.random.randn(8, 64).astype(np.float32))
+    y = pt.to_tensor(np.random.randn(8, 1).astype(np.float32))
+    caught = None
+    for s in range(1, total + 1):
+        if rank == oom_rank and s == oom_step and step._cache:
+            entry = next(iter(step._cache.values()))
+
+            def _exhausted(*a, **k):
+                raise RuntimeError(
+                    "RESOURCE_EXHAUSTED: Out of memory while trying "
+                    "to allocate 1073741824 bytes.")
+
+            entry.jitted = _exhausted
+            logger.info("armed RESOURCE_EXHAUSTED at step %d", s)
+        try:
+            step(x, y)
+        except RuntimeError as e:
+            if not _memory.is_oom_error(e):
+                raise
+            caught = f"{type(e).__name__}: {e}"
+            logger.info("allocator exhaustion surfaced at step %d", s)
+            break
+        # rank-scaled synthetic watermark: skew across the fleet is
+        # mem_bytes * (world - 1) > 0 by construction
+        mm.observe_sample({
+            "bytes_in_use": mem_bytes * (1 + rank),
+            "peak_bytes_in_use": mem_bytes * (1 + rank),
+            "bytes_reserved": mem_bytes * (1 + rank) + mem_bytes // 8,
+        })
+
+    with open(oom_metrics_path(out_dir, rank) + f".tmp{os.getpid()}",
+              "w") as f:
+        f.write(get_registry().prometheus_text())
+    os.replace(oom_metrics_path(out_dir, rank) + f".tmp{os.getpid()}",
+               oom_metrics_path(out_dir, rank))
+
+    snap = mm.snapshot()
+    report = {
+        "rank": rank,
+        "world": world,
+        "steps": total,
+        "oom_step": oom_step if rank == oom_rank else None,
+        "mem_bytes": mem_bytes,
+        "caught": caught,
+        "oom_events": snap["oom_events"],
+        "last_oom": snap["last_oom"],
+        "watermark_samples": snap["samples"],
+        "programs": sorted(snap["programs"]),
+        "compiles": step.stats["compiles"],
+        "fallback": step.stats["fallback"],
+        "flight": tr.flight_path if tr.enabled else None,
+    }
+    path = oom_report_path(out_dir, rank)
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(report, f)
+    os.replace(tmp, path)
+    logger.info("oom drill: caught=%s oom_events=%d", bool(caught),
+                snap["oom_events"])
+    sys.exit(EXIT_OOM if caught else 0)
+
+
 def _arm_storekill(store, rank, run_id, step, phase, timeout):
     """Wire the master-kill rendezvous: returns ``(phase, rendezvous)``.
 
@@ -416,6 +563,9 @@ def main():
     if env.get("DRILL_NUMERICS") == "1":
         _numerics_main(env, rank, world, total, run_id)
         return  # unreachable (_numerics_main exits), defensive only
+    if env.get("DRILL_OOM") == "1":
+        _oom_main(env, rank, world, total, run_id)
+        return  # unreachable (_oom_main exits), defensive only
 
     # arm the scripted kill BEFORE any checkpoint machinery runs
     from . import injector

@@ -281,32 +281,39 @@ class Model:
             tr = get_tracer()
             mon = self._monitor
             health = None
-            if tr.enabled:
-                # split path: "backward" is the fused forward+backward
-                # value_and_grad program (no pure-forward phase exists in
-                # a train step), "optimizer" the parameter update. Spans
-                # time dispatch — never a forced device sync.
-                with tr.phase("backward"):
-                    loss_v, preds, new_buffers, grads = self._grad_step_jit(
-                        params, buffers, key,
+            try:
+                if tr.enabled:
+                    # split path: "backward" is the fused forward+backward
+                    # value_and_grad program (no pure-forward phase exists
+                    # in a train step), "optimizer" the parameter update.
+                    # Spans time dispatch — never a forced device sync.
+                    with tr.phase("backward"):
+                        loss_v, preds, new_buffers, grads = \
+                            self._grad_step_jit(
+                                params, buffers, key,
+                                _arrays(inputs), _arrays(labels))
+                    with tr.phase("optimizer"):
+                        if mon is not None:
+                            new_params, new_opt, health = \
+                                self._apply_step_jit(
+                                    params, grads, self._opt_state, loss_v)
+                        else:
+                            new_params, new_opt = self._apply_step_jit(
+                                params, grads, self._opt_state)
+                elif mon is not None:
+                    (loss_v, preds, new_params, new_buffers, new_opt,
+                     health) = self._train_step_jit(
+                        params, buffers, self._opt_state, key,
                         _arrays(inputs), _arrays(labels))
-                with tr.phase("optimizer"):
-                    if mon is not None:
-                        new_params, new_opt, health = self._apply_step_jit(
-                            params, grads, self._opt_state, loss_v)
-                    else:
-                        new_params, new_opt = self._apply_step_jit(
-                            params, grads, self._opt_state)
-            elif mon is not None:
-                (loss_v, preds, new_params, new_buffers, new_opt,
-                 health) = self._train_step_jit(
-                    params, buffers, self._opt_state, key,
-                    _arrays(inputs), _arrays(labels))
-            else:
-                loss_v, preds, new_params, new_buffers, new_opt = \
-                    self._train_step_jit(params, buffers, self._opt_state,
-                                         key, _arrays(inputs),
-                                         _arrays(labels))
+                else:
+                    loss_v, preds, new_params, new_buffers, new_opt = \
+                        self._train_step_jit(params, buffers,
+                                             self._opt_state,
+                                             key, _arrays(inputs),
+                                             _arrays(labels))
+            except Exception as e:
+                self._book_oom("hapi.train_batch", e)
+                raise
             if update:
                 self._write_back(new_params, new_buffers)
                 self._opt_state = new_opt
@@ -327,12 +334,34 @@ class Model:
         loss_out = [LossScalar(loss_v)]
         return (loss_out, metrics_out) if metrics_out else loss_out
 
+    def _book_oom(self, program, exc):
+        """RESOURCE_EXHAUSTED intercept for the hapi step paths: pin
+        the memory postmortem (census attributed to this network's
+        parameter paths) before the error propagates — same trip path
+        as ``jit.capture``. Never raises; callers re-raise."""
+        try:
+            from ..observability import memory as _memory
+            if not _memory.is_oom_error(exc):
+                return
+            named = {f"param::{k}": p._data
+                     for k, p in self.network.named_parameters()}
+            named.update({f"buffer::{k}": b._data
+                          for k, b in self.network.named_buffers()})
+            _memory.oom_postmortem(program=program, exc=exc,
+                                   extra_named=named)
+        except Exception:
+            pass
+
     def eval_batch(self, inputs, labels=None):
         with autograd.functional_guard():
-            with get_tracer().phase("forward"):
-                loss_v, preds = self._eval_step_jit(
-                    self._param_arrays(), self._buffer_arrays(),
-                    _arrays(inputs), _arrays(labels))
+            try:
+                with get_tracer().phase("forward"):
+                    loss_v, preds = self._eval_step_jit(
+                        self._param_arrays(), self._buffer_arrays(),
+                        _arrays(inputs), _arrays(labels))
+            except Exception as e:
+                self._book_oom("hapi.eval_batch", e)
+                raise
         metrics_out = []
         for m in self._metrics:
             corr = m.compute(Tensor(preds[0]), Tensor(_arrays(labels)[0]))
@@ -342,10 +371,14 @@ class Model:
 
     def predict_batch(self, inputs):
         with autograd.functional_guard():
-            with get_tracer().phase("forward"):
-                _, preds = self._eval_step_jit(
-                    self._param_arrays(), self._buffer_arrays(),
-                    _arrays(inputs), [])
+            try:
+                with get_tracer().phase("forward"):
+                    _, preds = self._eval_step_jit(
+                        self._param_arrays(), self._buffer_arrays(),
+                        _arrays(inputs), [])
+            except Exception as e:
+                self._book_oom("hapi.predict_batch", e)
+                raise
         return [Tensor(p) for p in preds]
 
     # -- loops ---------------------------------------------------------------

@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import functools
 import os
+import sys
 import time
 import warnings
 
@@ -145,7 +146,7 @@ class _LiveState:
 class _Entry:
     __slots__ = ("jitted", "struct", "traced_idx", "sg_flags", "statics",
                  "n_leaves", "sig", "name", "ran", "flops", "fusion",
-                 "monitored", "monitor_names")
+                 "memory", "monitored", "monitor_names")
 
 
 class CapturedStep:
@@ -289,7 +290,50 @@ class CapturedStep:
         # dispatch path (~70µs/call) — the counter form costs ~6µs
         st.rng_base = _random.next_key()
         st.rng_ctr = 0
+        # census attribution: hand the memory monitor a weakly-held
+        # view of the capture-private state so live_arrays() bytes
+        # resolve to parameter paths (the enable decision is baked at
+        # build time, like the numerics sentinel)
+        from ..observability import memory as _memory
+        if _memory.get_memory_monitor().enabled:
+            _memory.get_memory_monitor().register_provider(
+                self._memory_named)
         return st
+
+    def _memory_named(self):
+        """Attribution view for the memory census/postmortem: every
+        capture-private array by qualified path (``param::<path>``,
+        ``buffer::<path>``, ``opt<i>::<slot>::<path>``)."""
+        st = self._state
+        if st is None:
+            return {}
+        named = {}
+        for n, a in st.params.items():
+            named[f"param::{n}"] = a
+        for n, a in st.buffers.items():
+            named[f"buffer::{n}"] = a
+        for oi, state in enumerate(st.opt_states):
+            for slot, d in state.get("slots", {}).items():
+                for n, a in d.items():
+                    named[f"opt{oi}::{slot}::{n}"] = a
+            for n, a in state.get("master", {}).items():
+                named[f"opt{oi}::master::{n}"] = a
+        return named
+
+    def _book_oom(self, entry, exc):
+        """RESOURCE_EXHAUSTED intercept: pin the memory postmortem
+        (census + footprints + watermark history) into the flight
+        recorder before the error propagates — the same trip path the
+        numerics sentinels use. Never raises; the caller re-raises the
+        original error."""
+        try:
+            from ..observability import memory as _memory
+            if not _memory.is_oom_error(exc):
+                return
+            _memory.oom_postmortem(program=entry.name, exc=exc,
+                                   extra_named=self._memory_named())
+        except Exception:
+            pass
 
     def _compile(self, args, kwargs, sig):
         if self._state is None:
@@ -427,6 +471,7 @@ class CapturedStep:
         entry.ran = False
         entry.flops = None
         entry.fusion = None
+        entry.memory = None
         entry.monitored = mon is not None
         entry.monitor_names = mon_box  # resolved after the first trace
         return entry
@@ -458,6 +503,17 @@ class CapturedStep:
                     lrs, traced)
                 if entry.flops:
                     tr.record_program_flops(entry.name, entry.flops)
+            from ..observability import memory as _memory
+            _mm = _memory.get_memory_monitor()
+            if _mm.enabled and entry.memory is None:
+                # compile-time footprint + pre-flight fit check:
+                # memory_analysis() harvested beside the FLOPs, from
+                # the same cache-shared AOT compile, BEFORE the first
+                # replay below can discover an unfit program as a raw
+                # RESOURCE_EXHAUSTED
+                entry.memory = _mm.harvest_program(
+                    entry.name, call, st.params, st.buffers,
+                    st.opt_states, st.rng_ctr, lrs, traced)
             from ..ops import fusion_pass as _fusion
             fusion_before = _fusion.summary()["rewrites"]
             with warnings.catch_warnings():
@@ -466,8 +522,12 @@ class CapturedStep:
                 warnings.filterwarnings(
                     "ignore", message="Some donated buffers were not usable")
                 t0 = time.perf_counter_ns()
-                outs = call(st.params, st.buffers, st.opt_states, st.rng_ctr,
-                            lrs, traced)
+                try:
+                    outs = call(st.params, st.buffers, st.opt_states,
+                                st.rng_ctr, lrs, traced)
+                except Exception as e:
+                    self._book_oom(entry, e)
+                    raise
             entry.ran = True  # only after the trace actually succeeded
             # the trace just happened inside that call: the fusion-pass
             # rewrite delta is this entry's pattern census (part of the
@@ -490,8 +550,12 @@ class CapturedStep:
                 tel.record_compile(entry.name, f"sig={entry.sig}")
         else:
             t0 = time.perf_counter_ns()
-            outs = call(st.params, st.buffers, st.opt_states, st.rng_ctr,
-                        lrs, traced)
+            try:
+                outs = call(st.params, st.buffers, st.opt_states,
+                            st.rng_ctr, lrs, traced)
+            except Exception as e:
+                self._book_oom(entry, e)
+                raise
         if tr.enabled:
             # dispatch-side span: async under jax, so this is dispatch +
             # any implicit materialization, never a forced device sync.
@@ -523,6 +587,14 @@ class CapturedStep:
                     opt._accumulators[slot][pname] = s["slots"][slot][n]
                 if n in s["master"]:
                     opt._master_weights[pname] = s["master"][n]
+        # watermark timeline: step-boundary allocator sample. sys.modules-
+        # gated like the telemetry hooks — a run that never imported the
+        # memory module pays one dict lookup here.
+        mem_mod = sys.modules.get("paddle_tpu.observability.memory")
+        if mem_mod is not None:
+            mm = mem_mod.current_memory_monitor()
+            if mm is not None and mm.enabled:
+                mm.on_step(step_idx)
         if entry.monitored:
             # hand the (tiny) health arrays to the monitor; it reads
             # the previous packet at cadence boundaries, so this never

@@ -29,6 +29,11 @@ and did something silently recompile?"* at runtime:
  - :mod:`.goodput`    wall-clock goodput ledger over the tracer's
                       spans: ``pt_goodput_fraction`` +
                       ``pt_badput_seconds{cause}``
+ - :mod:`.memory`     device-memory accounting: compile-time
+                      ``memory_analysis`` footprints + pre-flight fit
+                      checks, ``jax.live_arrays()`` census attributed
+                      to parameter paths, watermark timeline
+                      (Chrome counter track), OOM postmortems
  - :mod:`.logs`       the library logger that bare ``print`` is banned
                       in favor of (lint rule TPU010)
 
@@ -79,6 +84,15 @@ _NUMERICS_EXPORTS = ("NumericsMonitor", "NumericsHaltError",
 _GOODPUT_EXPORTS = ("GoodputLedger", "decompose_spans", "get_goodput",
                     "current_ledger", "reset_goodput")
 
+# Memory resolves lazily for the same reason: get_memory_monitor()
+# consults PT_MEMORY on first call, and the guarded allocator reads
+# must stay importable without dragging in a jax backend.
+_MEMORY_EXPORTS = ("MemoryMonitor", "device_memory_stats",
+                   "device_memory_stat", "program_memory_analysis",
+                   "is_oom_error", "oom_postmortem",
+                   "get_memory_monitor", "current_memory_monitor",
+                   "reset_memory_monitor")
+
 
 def __getattr__(name):
     if name in _AGGREGATOR_EXPORTS:
@@ -93,6 +107,9 @@ def __getattr__(name):
     if name in _GOODPUT_EXPORTS:
         from . import goodput
         return getattr(goodput, name)
+    if name in _MEMORY_EXPORTS:
+        from . import memory
+        return getattr(memory, name)
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}")
 
@@ -112,4 +129,8 @@ __all__ = [
     "get_monitor", "current_monitor", "reset_monitor",
     "GoodputLedger", "decompose_spans", "get_goodput",
     "current_ledger", "reset_goodput",
+    "MemoryMonitor", "device_memory_stats", "device_memory_stat",
+    "program_memory_analysis", "is_oom_error", "oom_postmortem",
+    "get_memory_monitor", "current_memory_monitor",
+    "reset_memory_monitor",
 ]

@@ -419,6 +419,20 @@ def _gauge_value(families, name):
     return None
 
 
+def _labeled_gauge_value(families, name, **labels):
+    """First sample of a labeled gauge matching every given label
+    pair, or None (identity labels like process_index are ignored —
+    the caller matches on semantic labels such as ``stat``)."""
+    fam = families.get(name)
+    if fam is None:
+        return None
+    want = {(str(k), str(v)) for k, v in labels.items()}
+    for sname, slabels, v in fam["samples"]:
+        if sname == name and want.issubset(set(slabels.items())):
+            return v
+    return None
+
+
 def _median(xs):
     s = sorted(xs)
     n = len(s)
@@ -496,7 +510,7 @@ class ClusterAggregator:
 
     def __init__(self, *, endpoints=None, store=None, run_id="local",
                  stale_after=5.0, scrape_timeout=2.0, storm_threshold=1,
-                 anomaly_threshold=10, interval=1.0,
+                 anomaly_threshold=10, mem_threshold=0, interval=1.0,
                  drop_labels=("process_index",),
                  retention=3600.0, history_max_points=512):
         self.run_id = str(run_id)
@@ -506,6 +520,10 @@ class ClusterAggregator:
         self.scrape_timeout = float(scrape_timeout)
         self.storm_threshold = int(storm_threshold)
         self.anomaly_threshold = int(anomaly_threshold)
+        # near-OOM trip: any rank's bytes_in_use at/over this flips
+        # /healthz to 503 (0 disables — there is no portable default
+        # limit, HBM size varies by device generation)
+        self.mem_threshold = int(mem_threshold or 0)
         self.interval = float(interval)
         self.drop_labels = tuple(drop_labels)
         self._store = store
@@ -711,6 +729,39 @@ class ClusterAggregator:
               "1 while summed numerics anomalies >= the anomaly "
               "threshold", [((), 1 if anomaly_alarm else 0)])
 
+        # device-memory skew + the near-OOM trip: a rank whose
+        # allocator is pinned at the limit stalls (or kills) every
+        # synchronous step, and uneven bytes_in_use across an SPMD
+        # fleet means uneven sharding — both are fleet-level signals.
+        # The watermark gauge (memory monitor) is preferred; the
+        # coarse telemetry gauge is the fallback.
+        rank_mem = {}
+        for r, f in fresh.items():
+            v = _labeled_gauge_value(f, "pt_memory_watermark_bytes",
+                                     stat="bytes_in_use")
+            if v is None:
+                v = _labeled_gauge_value(f, "pt_device_memory_bytes",
+                                         stat="bytes_in_use")
+            if v is not None:
+                rank_mem[r] = v
+        mem_skew = (max(rank_mem.values()) - min(rank_mem.values())
+                    if rank_mem else None)
+        mem_max = max(rank_mem.values()) if rank_mem else None
+        mem_alarm = (self.mem_threshold > 0 and mem_max is not None
+                     and mem_max >= self.mem_threshold)
+        if rank_mem:
+            gauge("pt_cluster_memory_bytes",
+                  "fleet device-memory bytes_in_use over fresh ranks",
+                  [((("stat", "max"),), mem_max),
+                   ((("stat", "min"),), min(rank_mem.values()))])
+            gauge("pt_cluster_memory_skew_bytes",
+                  "cross-rank bytes_in_use skew: max minus min over "
+                  "fresh ranks (uneven sharding / leak on one rank)",
+                  [((), mem_skew)])
+        gauge("pt_cluster_memory_alarm",
+              "1 while any rank's bytes_in_use >= the near-OOM "
+              "threshold", [((), 1 if mem_alarm else 0)])
+
         text = render_exposition(merged) + "\n".join(extra) + "\n"
 
         ranks_health = {}
@@ -741,9 +792,11 @@ class ClusterAggregator:
                     entry["goodput_fraction"] = round(goodputs[r], 6)
                 entry["numerics_anomalies"] = _family_total(
                     fresh[r], "pt_numerics_anomalies_total")
+                if r in rank_mem:
+                    entry["memory_bytes_in_use"] = int(rank_mem[r])
             ranks_health[str(r)] = entry
         health = {
-            "ok": not alarm and not anomaly_alarm,
+            "ok": not alarm and not anomaly_alarm and not mem_alarm,
             "run_id": self.run_id,
             "ranks_discovered": len(self._endpoints),
             "ranks_up": len(fresh),
@@ -762,6 +815,14 @@ class ClusterAggregator:
             "numerics_anomalies_total": anomalies_total,
             "anomaly_alarm": anomaly_alarm,
             "anomaly_threshold": self.anomaly_threshold,
+            "memory": {
+                "bytes_in_use_max": (int(mem_max)
+                                     if mem_max is not None else None),
+                "skew_bytes": (int(mem_skew)
+                               if mem_skew is not None else None),
+                "mem_alarm": mem_alarm,
+                "mem_threshold": self.mem_threshold,
+            },
             "merge_conflicts_total": self._conflicts_total,
             "scrape_errors_total": self._scrape_errors_total,
         }
@@ -935,6 +996,12 @@ def main(argv=None):
                                      "10")),
                     help="summed numerics anomalies that flip /healthz "
                          "to 503 (0 disables the alarm)")
+    ap.add_argument("--mem-threshold", type=int,
+                    default=int(_env("PT_AGGREGATOR_MEM_THRESHOLD",
+                                     "0")),
+                    help="near-OOM trip: any rank's bytes_in_use at/"
+                         "over this many bytes flips /healthz to 503 "
+                         "(0 disables the alarm)")
     ap.add_argument("--retention", type=float,
                     default=float(_env("PT_AGGREGATOR_RETENTION",
                                        "3600")),
@@ -979,6 +1046,7 @@ def main(argv=None):
         scrape_timeout=args.scrape_timeout,
         storm_threshold=args.storm_threshold,
         anomaly_threshold=args.anomaly_threshold,
+        mem_threshold=args.mem_threshold,
         interval=args.interval, retention=args.retention)
     if args.once:
         agg.scrape_once()

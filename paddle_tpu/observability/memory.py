@@ -1,0 +1,596 @@
+"""Device-memory accounting: compile-time footprints, live-buffer
+attribution, watermark timelines, and OOM postmortems.
+
+The failure mode this module exists for: a run dies at step 12k with a
+raw ``RESOURCE_EXHAUSTED`` naming nothing — no record of which program
+grew, which buffer owned the bytes, or how close to the limit the run
+had been cruising. HBM was the last instrumentation blind spot (steps,
+compiles, numerics and goodput are all observed; memory was three
+scattered ``memory_stats()`` reads).
+
+Four instruments, one monitor:
+
+1. **Compile-time footprint** — ``jit/capture`` harvests each compiled
+   program's ``memory_analysis()`` beside the FLOPs harvest and feeds
+   :meth:`MemoryMonitor.record_program_memory`; the per-kind bytes are
+   exported as ``pt_program_memory_bytes{program,kind}`` and a
+   pre-flight **fit check** against ``memory_stats()["bytes_limit"]``
+   warns once, naming the program and the shortfall, *before* the
+   first replay can OOM.
+2. **Live-buffer census** — :meth:`MemoryMonitor.live_buffer_census`
+   walks ``jax.live_arrays()`` and attributes bytes to parameter paths
+   (``param::model::1.weight`` — the same path naming the numerics
+   sentinels trip on), capture-private donated buffers, optimizer
+   state, or ``unattributed``, with a top-K table.
+3. **Watermark timeline** — ``bytes_in_use`` / ``peak_bytes_in_use`` /
+   fragmentation (``bytes_reserved − bytes_in_use``) sampled at step
+   boundaries (:meth:`on_step`, fed from ``telemetry.observe_step``
+   and the capture replay) into a bounded history, exported as
+   ``pt_memory_watermark_bytes{stat}`` gauges and Chrome-trace counter
+   events (``ph:"C"``) through the tracer, so ``observability.merge
+   --trace`` stitches a per-rank memory track into the cluster
+   timeline.
+4. **OOM postmortem** — the capture replay and hapi ``Model`` steps
+   intercept ``RESOURCE_EXHAUSTED``, call :func:`oom_postmortem`
+   (census + per-program footprints + watermark history pinned into a
+   flight-recorder dump, reason ``oom:<program>:<top buffer>``), then
+   re-raise — mirroring the numerics non-finite trip path.
+
+Contract (shared with the rest of ``observability``): zero cost while
+disabled, never sync the device, never initialize a jax backend just
+to read allocator stats, never raise into the run, side-effect-free
+import. :func:`device_memory_stats` is the ONE guarded read every
+other call site (telemetry gauges, ``device.cuda`` parity shims)
+routes through.
+
+Environment:
+  - ``PT_MEMORY=1``       enable on first ``get_memory_monitor()``
+  - ``PT_MEMORY_TOPK=n``  census table size (default 10)
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+import time
+import weakref
+from collections import deque
+
+logger = logging.getLogger("paddle_tpu.observability.memory")
+
+__all__ = [
+    "MemoryMonitor",
+    "device_memory_stats",
+    "device_memory_stat",
+    "program_memory_analysis",
+    "is_oom_error",
+    "oom_postmortem",
+    "get_memory_monitor",
+    "current_memory_monitor",
+    "reset_memory_monitor",
+]
+
+# the per-program footprint kinds exported through
+# pt_program_memory_bytes{program,kind}
+KINDS = ("argument", "output", "temp", "generated_code")
+
+# memory_analysis() attribute per kind ("alias" rides along so the fit
+# check can credit donation: donated outputs reuse argument buffers)
+_ANALYSIS_ATTRS = {
+    "argument": "argument_size_in_bytes",
+    "output": "output_size_in_bytes",
+    "temp": "temp_size_in_bytes",
+    "generated_code": "generated_code_size_in_bytes",
+    "alias": "alias_size_in_bytes",
+}
+
+# allocator stats summed by device_memory_stats (bytes_reserved feeds
+# the fragmentation series where the allocator reports it)
+_STAT_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+              "bytes_reserved")
+
+# substrings that identify an allocator-exhaustion failure across jax /
+# jaxlib / XLA versions (string match: the concrete exception class
+# moved between releases, the message text did not)
+OOM_NEEDLES = (
+    "RESOURCE_EXHAUSTED", "Resource exhausted", "out of memory",
+    "Out of memory", "OOM", "Allocation failure",
+    "exceeds the memory capacity", "exceeds available memory",
+)
+
+
+def _truthy(v):
+    return str(v).lower() not in ("", "0", "false", "no", "off", "none")
+
+
+# -- the one guarded allocator read ----------------------------------------
+
+def device_memory_stats(per_device=False):
+    """Allocator stats over local devices; ``{}`` (or ``[]``) when no
+    jax backend exists yet — NEVER initializes one just to ask (same
+    rule as ``trace._device_kind``). Default is one dict summed over
+    devices; ``per_device=True`` returns a list of raw per-device
+    dicts. Backends without allocator stats (cpu) contribute nothing.
+    """
+    xb = sys.modules.get("jax._src.xla_bridge")
+    jax = sys.modules.get("jax")
+    empty = [] if per_device else {}
+    if jax is None or xb is None or not getattr(xb, "_backends", None):
+        return empty
+    try:
+        devices = jax.local_devices()
+    except Exception:
+        return empty
+    per = []
+    out = {}
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        per.append(dict(stats))
+        for k in _STAT_KEYS:
+            if k in stats:
+                out[k] = out.get(k, 0) + int(stats[k])
+    return per if per_device else out
+
+
+def device_memory_stat(which, device_index=0):
+    """One allocator stat of one local device as an int (0 when the
+    backend/stat is absent) — the ``device.cuda`` parity-shim read."""
+    per = device_memory_stats(per_device=True)
+    try:
+        return int(per[device_index].get(which, 0))
+    except (IndexError, AttributeError, TypeError, ValueError):
+        return 0
+
+
+# -- compile-time footprint -------------------------------------------------
+
+def program_memory_analysis(jitted, *args, **kwargs):
+    """Per-kind byte footprint of one jitted program from XLA's
+    ``memory_analysis()`` (None when the backend can't say). Lowers +
+    compiles AOT — call at compile time (the XLA compile is
+    cache-shared with the first real call), never per step."""
+    try:
+        ma = jitted.lower(*args, **kwargs).compile().memory_analysis()
+        if ma is None:
+            return None
+        if isinstance(ma, (list, tuple)):
+            ma = ma[0] if ma else None
+            if ma is None:
+                return None
+        out = {k: int(getattr(ma, attr, 0) or 0)
+               for k, attr in _ANALYSIS_ATTRS.items()}
+        return out if any(out.values()) else None
+    except Exception:
+        return None
+
+
+def is_oom_error(exc):
+    """True when an exception (or message string) is an allocator
+    exhaustion — the intercept predicate for the postmortem path."""
+    if exc is None:
+        return False
+    msg = exc if isinstance(exc, str) else \
+        f"{type(exc).__name__}: {exc}"
+    return any(n in msg for n in OOM_NEEDLES)
+
+
+class MemoryMonitor:
+    """Host-side device-memory accountant (see module docstring)."""
+
+    def __init__(self, topk=10, history=512):
+        self._lock = threading.RLock()
+        self.enabled = False
+        self.topk = int(topk)
+        self.sample_every = 1
+        self._metrics = None
+        self._history = deque(maxlen=int(history))
+        self._reset_state()
+
+    def _reset_state(self):
+        self._programs = {}        # name -> {kind: bytes}
+        self._fit = {}             # name -> fit verdict dict
+        self._fit_warned = set()
+        self._providers = []       # weak/strong attribution callables
+        self._steps = 0
+        self._oom_events = 0
+        self._last_oom = None
+        self._history.clear()
+
+    # -- lifecycle ---------------------------------------------------
+
+    def enable(self, topk=None, sample_every=None):
+        with self._lock:
+            self.enabled = True
+            if topk is not None:
+                self.topk = max(1, int(topk))
+            if sample_every is not None:
+                self.sample_every = max(1, int(sample_every))
+            self._make_metrics()
+        return self
+
+    def disable(self):
+        with self._lock:
+            self.enabled = False
+        return self
+
+    def _make_metrics(self):
+        if self._metrics is not None:
+            return
+        try:
+            from .metrics import get_registry
+            r = get_registry()
+            self._metrics = {
+                "program": r.gauge(
+                    "pt_program_memory_bytes",
+                    "per-compiled-program byte footprint from XLA "
+                    "memory_analysis, by kind", ("program", "kind")),
+                "watermark": r.gauge(
+                    "pt_memory_watermark_bytes",
+                    "device allocator watermark sampled at step "
+                    "boundaries", ("stat",)),
+                "oom": r.counter(
+                    "pt_oom_events_total",
+                    "RESOURCE_EXHAUSTED failures intercepted by the "
+                    "postmortem path"),
+            }
+        except Exception:  # metrics are optional plumbing
+            self._metrics = None
+
+    # -- compile-time footprint --------------------------------------
+
+    def harvest_program(self, name, jitted, *args, **kwargs):
+        """AOT-harvest one program's footprint and book it (compile
+        time only). Returns the per-kind dict or None."""
+        mem = program_memory_analysis(jitted, *args, **kwargs)
+        if mem is not None:
+            self.record_program_memory(name, mem)
+        return mem
+
+    def record_program_memory(self, name, mem):
+        """Book one program's per-kind footprint (dict or a raw
+        ``memory_analysis()`` object) and run the pre-flight fit
+        check. Never raises."""
+        try:
+            if not isinstance(mem, dict):
+                mem = {k: int(getattr(mem, attr, 0) or 0)
+                       for k, attr in _ANALYSIS_ATTRS.items()}
+            name = str(name)
+            with self._lock:
+                self._programs[name] = dict(mem)
+                metrics = self._metrics if self.enabled else None
+            if metrics is not None:
+                for kind in KINDS:
+                    metrics["program"].set(
+                        int(mem.get(kind, 0)), program=name, kind=kind)
+            self._fit_check(name, mem)
+        except Exception:
+            logger.debug("record_program_memory failed", exc_info=True)
+
+    @staticmethod
+    def required_bytes(mem):
+        """Peak device bytes one program needs: arguments + outputs +
+        temps + generated code, minus donation aliasing (aliased
+        outputs reuse argument buffers)."""
+        req = sum(int(mem.get(k, 0)) for k in KINDS)
+        return max(req - int(mem.get("alias", 0)), 0)
+
+    def _fit_check(self, name, mem):
+        """Pre-flight verdict for one program against the device
+        limit; warns ONCE per program when it cannot fit — before the
+        first replay would discover it as a raw RESOURCE_EXHAUSTED."""
+        limit = device_memory_stats().get("bytes_limit")
+        required = self.required_bytes(mem)
+        fits = None if not limit else required <= int(limit)
+        verdict = {
+            "fits": fits,
+            "required_bytes": required,
+            "limit_bytes": int(limit) if limit else None,
+            "shortfall_bytes": (max(required - int(limit), 0)
+                                if limit else None),
+        }
+        with self._lock:
+            self._fit[name] = verdict
+            warn = fits is False and name not in self._fit_warned
+            if warn:
+                self._fit_warned.add(name)
+        if warn:
+            logger.warning(
+                "memory fit check: program %r needs %d bytes but the "
+                "device limit is %d — short by %d bytes; the first "
+                "replay will OOM unless buffers shrink (reduce batch/"
+                "model size or shard the state)",
+                name, required, verdict["limit_bytes"],
+                verdict["shortfall_bytes"])
+        return verdict
+
+    # -- live-buffer census ------------------------------------------
+
+    def register_provider(self, fn):
+        """Register an attribution source: a callable returning
+        ``{qualified_name: array}`` (names like
+        ``param::model::1.weight``, ``opt0::velocity::...``,
+        ``buffer::...``). Bound methods are held weakly so the census
+        never keeps a training step alive."""
+        try:
+            ref = weakref.WeakMethod(fn)
+        except TypeError:
+            ref = None
+        with self._lock:
+            self._providers.append(ref if ref is not None else fn)
+
+    def _named_arrays(self, extra=None):
+        named = {}
+        with self._lock:
+            providers = list(self._providers)
+        dead = []
+        for p in providers:
+            fn = p() if isinstance(p, weakref.WeakMethod) else p
+            if fn is None:
+                dead.append(p)
+                continue
+            try:
+                named.update(fn() or {})
+            except Exception:
+                continue
+        if dead:
+            with self._lock:
+                self._providers = [p for p in self._providers
+                                   if p not in dead]
+        if extra:
+            named.update(extra)
+        return named
+
+    def live_buffer_census(self, extra_named=None, topk=None):
+        """Walk ``jax.live_arrays()`` and attribute bytes.
+
+        Attribution is by array identity against the registered
+        providers (+ ``extra_named``): each qualified name's prefix
+        (``param`` / ``buffer`` / ``opt*`` / ...) becomes its
+        category; live arrays nobody claims are ``unattributed``.
+        Returns ``{total_bytes, count, by_category, top}`` where
+        ``top`` is the top-K table (name, bytes, shape, dtype).
+        Host-side only: identity + nbytes, never a device sync."""
+        k = int(topk or self.topk)
+        out = {"total_bytes": 0, "count": 0, "by_category": {},
+               "top": []}
+        jax = sys.modules.get("jax")
+        if jax is None:
+            return out
+        try:
+            live = jax.live_arrays()
+        except Exception:
+            return out
+        named = self._named_arrays(extra_named)
+        by_id = {}
+        for name, arr in named.items():
+            try:
+                by_id[id(arr)] = name
+            except Exception:
+                continue
+        rows = []
+        for arr in live:
+            try:
+                nbytes = int(arr.nbytes)
+                shape = tuple(arr.shape)
+                dtype = str(arr.dtype)
+            except Exception:
+                continue
+            name = by_id.get(id(arr), "unattributed")
+            cat = name.split("::", 1)[0] if name != "unattributed" \
+                else "unattributed"
+            out["total_bytes"] += nbytes
+            out["count"] += 1
+            out["by_category"][cat] = \
+                out["by_category"].get(cat, 0) + nbytes
+            rows.append((nbytes, name, shape, dtype))
+        rows.sort(key=lambda r: (-r[0], r[1]))
+        out["top"] = [
+            {"name": n, "bytes": b, "shape": list(s), "dtype": d}
+            for b, n, s, d in rows[:k]]
+        return out
+
+    # -- watermark timeline ------------------------------------------
+
+    def on_step(self, step=None):
+        """Step-boundary hook (telemetry.observe_step / capture
+        replay): samples the allocator watermark at the configured
+        cadence. Plain host reads, never a device sync."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._steps += 1
+            due = self._steps % self.sample_every == 0
+        if due:
+            self.sample_watermark()
+
+    def sample_watermark(self):
+        """Read the allocator once and book the sample (no-op when no
+        backend / no allocator stats — cpu)."""
+        stats = device_memory_stats()
+        if stats:
+            self.observe_sample(stats)
+
+    def observe_sample(self, stats, t_ns=None):
+        """Book one watermark sample. Public so drills/tests (and
+        backends without allocator stats) can inject synthetic
+        readings through the same pipeline: history + gauges + a
+        Chrome-trace counter event per rank."""
+        try:
+            in_use = int(stats.get("bytes_in_use", 0))
+            peak = int(stats.get("peak_bytes_in_use", 0))
+            reserved = stats.get("bytes_reserved")
+            frag = (max(int(reserved) - in_use, 0)
+                    if reserved is not None else 0)
+            if t_ns is None:
+                t_ns = time.perf_counter_ns()
+            sample = {"t_ns": int(t_ns), "bytes_in_use": in_use,
+                      "peak_bytes_in_use": peak,
+                      "fragmentation_bytes": frag}
+            with self._lock:
+                self._history.append(sample)
+                metrics = self._metrics if self.enabled else None
+            if metrics is not None:
+                g = metrics["watermark"]
+                g.set(in_use, stat="bytes_in_use")
+                g.set(peak, stat="peak_bytes_in_use")
+                g.set(frag, stat="fragmentation")
+            tr_mod = sys.modules.get("paddle_tpu.observability.trace")
+            if tr_mod is not None:
+                tr = tr_mod.current_tracer()
+                if tr is not None and tr.enabled:
+                    tr.record_counter(
+                        "device_memory", t_ns,
+                        {"bytes_in_use": in_use,
+                         "peak_bytes_in_use": peak,
+                         "fragmentation": frag})
+        except Exception:
+            logger.debug("watermark sample failed", exc_info=True)
+
+    def watermarks(self):
+        """Snapshot of the watermark history (oldest first)."""
+        with self._lock:
+            return [dict(s) for s in self._history]
+
+    # -- OOM postmortem ----------------------------------------------
+
+    def record_oom(self, program=None, exc=None, extra_named=None):
+        """Book one allocator-exhaustion failure: census + per-program
+        footprints + watermark history, pinned into a flight-recorder
+        dump (reason ``oom:<program>:<top buffer>``). Runs even while
+        disabled — an OOM is terminal, the cost argument is over.
+        Never raises; the caller re-raises the original error."""
+        try:
+            census = self.live_buffer_census(extra_named=extra_named)
+            top = census["top"][0]["name"] if census["top"] \
+                else "unattributed"
+            with self._lock:
+                self._oom_events += 1
+                doc = {
+                    "program": str(program) if program else None,
+                    "error": (f"{type(exc).__name__}: {str(exc)[:500]}"
+                              if exc is not None else None),
+                    "top_buffer": top,
+                    "census": census,
+                    "programs": {n: dict(m)
+                                 for n, m in self._programs.items()},
+                    "fit": {n: dict(v) for n, v in self._fit.items()},
+                    "watermarks": [dict(s) for s in self._history],
+                }
+                self._last_oom = doc
+                metrics = self._metrics
+            if metrics is not None:
+                try:
+                    metrics["oom"].inc()
+                except Exception:
+                    pass
+            logger.error(
+                "OOM postmortem: program=%s top_buffer=%s "
+                "live_bytes=%d across %d arrays",
+                doc["program"], top, census["total_bytes"],
+                census["count"])
+            reason = "oom:%s:%s" % (doc["program"] or "", top)
+            tr_mod = sys.modules.get("paddle_tpu.observability.trace")
+            if tr_mod is not None:
+                try:
+                    tr = tr_mod.current_tracer()
+                    if tr is not None and tr.enabled:
+                        tr.flight_dump(reason=reason,
+                                       extra={"memory": doc})
+                except Exception:
+                    pass
+            return doc
+        except Exception:
+            logger.debug("oom postmortem failed", exc_info=True)
+            return None
+
+    # -- reporting ---------------------------------------------------
+
+    def snapshot(self):
+        """Compact JSON-ready summary (attached to bench records and
+        the telemetry snapshot)."""
+        stats = device_memory_stats()
+        with self._lock:
+            last = self._history[-1] if self._history else None
+            fit = {n: dict(v) for n, v in self._fit.items()}
+            programs = {n: dict(m) for n, m in self._programs.items()}
+            oom_events = self._oom_events
+            last_oom = self._last_oom
+        verdicts = [v["fits"] for v in fit.values()]
+        fit_ok = (False if any(v is False for v in verdicts)
+                  else True if verdicts
+                  and all(v is True for v in verdicts) else None)
+        return {
+            "enabled": self.enabled,
+            "topk": self.topk,
+            "bytes_in_use": stats.get(
+                "bytes_in_use",
+                last["bytes_in_use"] if last else None),
+            "peak_bytes_in_use": stats.get(
+                "peak_bytes_in_use",
+                last["peak_bytes_in_use"] if last else None),
+            "bytes_limit": stats.get("bytes_limit"),
+            "fragmentation_bytes": (
+                last["fragmentation_bytes"] if last else
+                (max(stats.get("bytes_reserved", 0)
+                     - stats.get("bytes_in_use", 0), 0)
+                 if "bytes_reserved" in stats else None)),
+            "fit_ok": fit_ok,
+            "fit": fit,
+            "programs": programs,
+            "samples": len(self._history),
+            "oom_events": oom_events,
+            "last_oom": ({"program": last_oom["program"],
+                          "top_buffer": last_oom["top_buffer"],
+                          "error": last_oom["error"]}
+                         if last_oom else None),
+        }
+
+
+# -- module-level postmortem entry point ------------------------------------
+
+def oom_postmortem(program=None, exc=None, extra_named=None):
+    """Book an OOM through the singleton (created if needed — the
+    error path is cold and terminal, env laziness no longer matters).
+    Never raises; callers re-raise the original exception."""
+    try:
+        return get_memory_monitor().record_oom(
+            program=program, exc=exc, extra_named=extra_named)
+    except Exception:
+        return None
+
+
+# -- process singleton ------------------------------------------------------
+
+_monitor = None
+_monitor_lock = threading.Lock()
+
+
+def get_memory_monitor():
+    """Process singleton; first call applies PT_MEMORY_* env config."""
+    global _monitor
+    with _monitor_lock:
+        if _monitor is None:
+            _monitor = MemoryMonitor()
+            if _truthy(os.environ.get("PT_MEMORY", "")):
+                _monitor.enable(
+                    topk=os.environ.get("PT_MEMORY_TOPK") or None)
+        return _monitor
+
+
+def current_memory_monitor():
+    """The singleton if it exists, else None — read-only accessor that
+    never triggers env-based enablement (hot paths use this)."""
+    return _monitor
+
+
+def reset_memory_monitor():
+    """Drop the singleton (tests)."""
+    global _monitor
+    with _monitor_lock:
+        _monitor = None

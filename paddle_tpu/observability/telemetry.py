@@ -514,6 +514,13 @@ class TrainingTelemetry:
             gp = gp_mod.current_ledger()
             if gp is not None and gp.enabled:
                 gp.refresh()
+        # memory watermark timeline samples at step boundaries through
+        # the same gate — allocator reads only, never a device sync
+        mem_mod = sys.modules.get("paddle_tpu.observability.memory")
+        if mem_mod is not None:
+            mm = mem_mod.current_memory_monitor()
+            if mm is not None and mm.enabled:
+                mm.on_step(steps)
 
     # -- data / collectives -------------------------------------------------
 
@@ -735,28 +742,12 @@ class TrainingTelemetry:
 
     def device_memory(self):
         """Summed allocator stats over local devices; {} when no jax
-        backend exists yet (never initializes one just to ask)."""
-        xb = sys.modules.get("jax._src.xla_bridge")
-        jax = sys.modules.get("jax")
-        if jax is None or xb is None or not getattr(xb, "_backends", None):
-            return {}
-        out = {}
-        try:
-            devices = jax.local_devices()
-        except Exception:
-            return {}
-        for d in devices:
-            try:
-                stats = d.memory_stats()
-            except Exception:
-                stats = None
-            if not stats:
-                continue
-            for k in ("bytes_in_use", "peak_bytes_in_use",
-                      "bytes_limit"):
-                if k in stats:
-                    out[k] = out.get(k, 0) + int(stats[k])
-        return out
+        backend exists yet (never initializes one just to ask).
+        Delegates to the one guarded read in ``observability.memory``
+        — the consolidation point shared with the ``device.cuda``
+        parity shims."""
+        from .memory import device_memory_stats
+        return device_memory_stats()
 
     def _update_memory_gauges(self):
         mem = self.device_memory()
@@ -815,6 +806,20 @@ class TrainingTelemetry:
                         "goodput_fraction": dec["goodput_fraction"],
                         "badput_seconds": dec["badput_seconds"],
                     }
+        memory = None
+        mem_mod = sys.modules.get("paddle_tpu.observability.memory")
+        if mem_mod is not None:
+            mm = mem_mod.current_memory_monitor()
+            if mm is not None:
+                ms = mm.snapshot()
+                memory = {
+                    "enabled": ms["enabled"],
+                    "fit_ok": ms["fit_ok"],
+                    "programs": len(ms["programs"]),
+                    "fragmentation_bytes": ms["fragmentation_bytes"],
+                    "oom_events": ms["oom_events"],
+                    "last_oom": ms["last_oom"],
+                }
         return {
             "enabled": self.enabled,
             "pid": os.getpid(),
@@ -836,6 +841,7 @@ class TrainingTelemetry:
             "events_dropped": self.sink.dropped if self.sink else 0,
             "numerics": numerics,
             "goodput": goodput,
+            "memory": memory,
         }
 
     def healthz(self):
@@ -952,5 +958,7 @@ def reset():
     reset_monitor()
     from .goodput import reset_goodput
     reset_goodput()
+    from .memory import reset_memory_monitor
+    reset_memory_monitor()
     from .metrics import reset_registry
     reset_registry()

@@ -191,6 +191,9 @@ class Tracer:
         self.process_index, self.run_id = _resolve_identity()
         self._lock = threading.Lock()
         self._spans: deque = deque(maxlen=int(capacity))
+        # counter samples ((name, t_ns, ((series, value), ...))) feed
+        # Chrome ph:"C" counter tracks — the memory watermark timeline
+        self._counters: deque = deque(maxlen=int(capacity))
         self._metrics_made = False
         self.trace_dir = None
         self.flight_dir = None
@@ -301,12 +304,28 @@ class Tracer:
                                 int(t1_ns), int(tid)))
         self._maybe_flight_refresh(t1_ns)
 
+    def record_counter(self, name, t_ns, values):
+        """One counter sample (e.g. the memory watermark): ``values``
+        is ``{series: number}``, exported as a Chrome ``ph:"C"``
+        counter event so the merged cluster timeline carries a
+        per-rank track. Appends are GIL-atomic like spans."""
+        if not self.enabled:
+            return
+        self._counters.append((str(name), int(t_ns),
+                               tuple((str(k), float(v))
+                                     for k, v in values.items())))
+
+    def counters(self):
+        """Snapshot of the counter-sample ring (oldest first)."""
+        return [(n, t, dict(vals)) for n, t, vals in self._counters]
+
     def spans(self):
         """Snapshot of the ring buffer (oldest first)."""
         return list(self._spans)
 
     def clear(self):
         self._spans.clear()
+        self._counters.clear()
 
     # -- analytic MFU -------------------------------------------------------
 
@@ -411,6 +430,13 @@ class Tracer:
                 "pid": self.process_index, "tid": s.tid,
                 "args": {"run_id": self.run_id},
             })
+        for name, t_ns, vals in self._counters:
+            events.append({
+                "name": name, "ph": "C",
+                "ts": (t_ns + self._epoch_ns) / 1e3,
+                "pid": self.process_index, "tid": 0,
+                "args": dict(vals),
+            })
         return events
 
     def export_chrome(self, path=None):
@@ -438,10 +464,12 @@ class Tracer:
 
     # -- flight recorder ----------------------------------------------------
 
-    def flight_dump(self, reason="manual", last_n=256):
+    def flight_dump(self, reason="manual", last_n=256, extra=None):
         """Dump the last ``last_n`` spans + a telemetry snapshot to the
-        flight file; returns the path or None.  Safe from signal
-        handlers and excepthooks (never raises)."""
+        flight file; returns the path or None.  ``extra`` (a JSON-ready
+        dict) rides along under ``"extra"`` — the OOM postmortem books
+        its census/footprint/watermark evidence through it.  Safe from
+        signal handlers and excepthooks (never raises)."""
         path = self.flight_path
         if path is None:
             return None
@@ -467,6 +495,8 @@ class Tracer:
                            "tid": s.tid} for s in spans],
                 "telemetry": tel_snap,
             }
+            if extra:
+                doc["extra"] = dict(extra)
             os.makedirs(os.path.dirname(os.path.abspath(path)),
                         exist_ok=True)
             tmp = f"{path}.tmp{os.getpid()}"
@@ -525,6 +555,7 @@ class Tracer:
             "process_index": self.process_index,
             "run_id": self.run_id,
             "spans": len(self._spans),
+            "counters": len(self._counters),
             "phase_ms": self.phase_percentiles_ms(),
             "overlap_fraction": (round(ov, 4) if ov is not None
                                  else None),

@@ -887,6 +887,137 @@ class UnfusedResidualNorm(Rule):
 
 
 @register
+class DeviceArrayAccumulation(Rule):
+    id = "TPU018"
+    name = "device-array-accumulation-in-step-loop"
+    rationale = ("appending per-step device results (losses, logits, "
+                 "grads) to a Python container inside a training loop "
+                 "pins every step's HBM buffer for the life of the list "
+                 "— the run leaks device memory linearly in steps and "
+                 "OOMs long after the step itself fits; convert to a "
+                 "host scalar first (float(loss) / .item() — one sync "
+                 "on the logging cadence) or let telemetry keep the "
+                 "bounded history")
+
+    # same scope gate as TPU007: only loops owned by a function whose
+    # name says it is a training loop
+    _LOOP_FUNC = re.compile(r"(train|fit|epoch|run_steps?|step_loop)",
+                            re.IGNORECASE)
+    _ACCUM_METHODS = {"append", "extend", "insert"}
+    # host conversions that detach the value from device memory — an
+    # accumulation wrapped in (or chained through) one of these is the
+    # correct idiom, not a leak
+    _HOST_CASTS = {"float", "int", "bool", "str", "np.asarray",
+                   "np.array", "numpy.asarray", "numpy.array",
+                   "jax.device_get", "device_get"}
+    _SYNC_METHODS = {"item", "numpy", "tolist", "tobytes", "__array__"}
+    # identifier components that name per-step device results; matched
+    # as WHOLE dotted components so `step_times` / `lossy` never hit
+    _DEVICE_NAMES = re.compile(
+        r"^(steps?|train_step|model|net|forward|criterion|loss_fn|"
+        r"loss(es)?|logits?|grads?|gradients?|preds?|predictions?|"
+        r"outputs?|y_hat|activations?)$", re.IGNORECASE)
+
+    def _in_step_loop(self, ctx):
+        return any(self._LOOP_FUNC.search(fi.name)
+                   for fi in ctx.func_stack)
+
+    def on_for(self, node, ctx):
+        if self._in_step_loop(ctx):
+            self._scan(node.body, ctx)
+
+    def on_while(self, node, ctx):
+        if self._in_step_loop(ctx):
+            self._scan(node.body, ctx)
+
+    def _device_callee(self, call):
+        """True when a call plausibly returns a device array: a step/
+        model/loss-named callable or a jnp/jax.numpy op."""
+        name = dotted(call.func)
+        if name.startswith(("jnp.", "jax.numpy.")):
+            return True
+        return any(self._DEVICE_NAMES.match(part)
+                   for part in name.split(".") if part)
+
+    def _is_host_conversion(self, call):
+        if dotted(call.func) in self._HOST_CASTS:
+            return True
+        return (isinstance(call.func, ast.Attribute)
+                and call.func.attr in self._SYNC_METHODS)
+
+    def _device_value(self, expr, device_names, host_names):
+        """The device-ish thing accumulated by ``expr`` (a name), or
+        None.  Host conversions prune the walk: float(loss) is safe,
+        and so is a name rebound from one (`loss = float(raw)`)."""
+        stack = [expr]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, ast.Call):
+                if self._is_host_conversion(n):
+                    continue  # converted to host — and its args with it
+                if self._device_callee(n):
+                    return f"{dotted(n.func)}()"
+                stack.extend(n.args)
+                stack.extend(kw.value for kw in n.keywords)
+                continue
+            if isinstance(n, ast.Name):
+                if n.id in host_names:
+                    continue
+                if n.id in device_names \
+                        or self._DEVICE_NAMES.match(n.id):
+                    return n.id
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+        return None
+
+    def _scan(self, body, ctx):
+        # names bound to a device-call result earlier in THIS loop body
+        # (`loss = step(x, y)`); any other rebind (host conversion,
+        # constant) moves the name to the host set
+        device_names = set()
+        host_names = set()
+
+        def walk(n):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef, ast.For,
+                              ast.AsyncFor, ast.While)):
+                return  # nested loops get their own on_for/on_while
+            if isinstance(n, ast.Assign):
+                names = [sub.id for t in n.targets
+                         for sub in ast.walk(t)
+                         if isinstance(sub, ast.Name)]
+                if (isinstance(n.value, ast.Call)
+                        and not self._is_host_conversion(n.value)
+                        and self._device_callee(n.value)):
+                    device_names.update(names)
+                    host_names.difference_update(names)
+                else:
+                    device_names.difference_update(names)
+                    host_names.update(names)
+            if (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in self._ACCUM_METHODS):
+                for arg in n.args:
+                    what = self._device_value(arg, device_names,
+                                              host_names)
+                    if what:
+                        recv = dotted(n.func.value) or "container"
+                        ctx.report(
+                            n, self.id,
+                            f"{recv}.{n.func.attr}({what}) accumulates "
+                            f"a device array per step — every buffer "
+                            f"stays live in HBM until the container "
+                            f"dies; append float(x)/.item() on the "
+                            f"logging cadence instead")
+                        break
+            for c in ast.iter_child_nodes(n):
+                walk(c)
+
+        for stmt in body:
+            walk(stmt)
+
+
+@register
 class HostSideNanCheck(Rule):
     id = "TPU017"
     name = "host-side-nan-check"

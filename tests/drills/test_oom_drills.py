@@ -1,0 +1,92 @@
+"""OOM-postmortem drills: REAL workers train a captured MLP, one
+rank's allocator "fails", the flight dump must name the top buffer.
+
+Each drill spawns ``world`` drill workers in OOM mode (``DRILL_OOM=1``,
+storeless): every rank trains a real captured MLP on CPU with the
+memory monitor armed; the victim rank swaps its compiled cache entry
+for a callable raising ``RESOURCE_EXHAUSTED`` at a scripted step —
+exactly what an allocator exhaustion looks like to the capture replay.
+The runner asserts that the intercept booked ONE postmortem whose
+flight-recorder reason pins ``oom:<program>:<parameter path>`` (the
+drill model's first weight dominates every live buffer by
+construction), that the ``extra.memory`` payload carries the census,
+per-program footprints and watermark history, that the victim exited
+``EXIT_OOM`` cleanly while clean ranks booked nothing — and, replaying
+the per-rank metrics expositions through a LOCAL aggregator, that the
+fleet view derives the cross-rank memory skew and trips the near-OOM
+health alarm at the scripted threshold.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from paddle_tpu.distributed.drill import run_oom_drill
+from paddle_tpu.distributed.drill.worker import EXIT_OOM
+
+pytestmark = pytest.mark.skipif(os.name != "posix",
+                                reason="drills spawn real processes")
+
+
+def test_oom_drill_books_postmortem_and_fleet_skew(tmp_path):
+    """Tier-1 acceptance drill: 2 workers x 8 steps, rank 1's compiled
+    entry raises RESOURCE_EXHAUSTED at step 5 -> flight dump pinning a
+    parameter path, EXIT_OOM, clean rank silent, one compile per rank,
+    fleet skew + near-OOM alarm through the aggregator replay."""
+    logs = str(tmp_path / "logs")
+    os.makedirs(logs, exist_ok=True)
+    report = run_oom_drill(str(tmp_path), world=2, steps=8,
+                           oom_step=5, oom_rank=1,
+                           mem_bytes=1_000_000, log_dir=logs)
+    assert report["rcs"] == [0, EXIT_OOM]
+    # the postmortem names the dominant buffer BY PARAMETER PATH
+    assert report["named_buffer"].startswith("param::")
+    assert report["flight_reason"] == (
+        "oom:captured_step(step):" + report["named_buffer"])
+    assert "param" in report["census_categories"]
+    victim = report["ranks"][1]
+    assert victim["oom_events"] == 1
+    assert victim["last_oom"]["top_buffer"] == report["named_buffer"]
+    assert "RESOURCE_EXHAUSTED" in victim["caught"]
+    # the armed failure replays a cache HIT: one compile, ever
+    for r in range(2):
+        assert report["ranks"][r]["compiles"] == 1
+        assert not report["ranks"][r]["fallback"]
+    clean = report["ranks"][0]
+    assert clean["oom_events"] == 0 and clean["caught"] is None
+    # the flight dump itself carries the full evidence payload
+    with open(victim["flight"]) as f:
+        flight = json.load(f)
+    assert flight["process_index"] == 1
+    mem = flight["extra"]["memory"]
+    assert mem["top_buffer"] == report["named_buffer"]
+    assert mem["census"]["total_bytes"] > 0
+    assert "captured_step(step)" in mem["programs"]
+    assert len(mem["watermarks"]) == victim["watermark_samples"] > 0
+    # fleet view from the exposition replay: rank r published
+    # mem_bytes * (1 + r), so skew == mem_bytes and the default
+    # threshold (mem_bytes * world) trips exactly
+    assert report["fleet_skew_bytes"] == 1_000_000.0
+    assert report["mem_alarm"] is True
+    assert report["healthz"]["ok"] is False
+    assert report["healthz"]["memory"]["bytes_in_use_max"] == 2_000_000
+    assert report["oom_events_total"] == 1
+
+
+@pytest.mark.slow
+def test_oom_drill_three_ranks_no_alarm_below_threshold(tmp_path):
+    """@slow: a 3-rank fleet with the threshold ABOVE every rank's
+    watermark — the skew gauge still derives, but the near-OOM alarm
+    must stay down and health stays ok-modulo-the-victim."""
+    report = run_oom_drill(str(tmp_path), world=3, steps=8,
+                           oom_step=4, oom_rank=2,
+                           mem_bytes=1_000_000,
+                           mem_threshold=100_000_000)
+    assert report["rcs"] == [0, 0, EXIT_OOM]
+    assert report["named_buffer"].startswith("param::")
+    assert report["fleet_skew_bytes"] == 2_000_000.0
+    assert report["mem_alarm"] is False
+    for r in (0, 1):
+        assert report["ranks"][r]["oom_events"] == 0

@@ -89,6 +89,32 @@ def test_scrape_drill_anomaly_storm(tmp_path):
     assert abs(report["cluster_goodput"]["mean"] - 0.8) < 1e-6
 
 
+def test_scrape_drill_memory_near_oom_503(tmp_path):
+    """Each rank feeds a rank-scaled synthetic allocator watermark
+    (rank r exports 5 MB * (1 + r)); the aggregator derives the exact
+    cross-rank skew, and with the near-OOM threshold at the fleet max
+    the memory alarm alone must flip /healthz to 503 — no recompile
+    storm, no anomalies."""
+    report = run_scrape_drill(
+        str(tmp_path), world=2, steps=6, kill_rank=None, storm=False,
+        mem_bytes=5_000_000, mem_threshold=10_000_000)
+    assert report["memory_skew_bytes"] == 5_000_000.0
+    assert report["memory_alarm"] == 1.0
+    health = report["healthz"]
+    assert health["ok"] is False
+    mem = health["memory"]
+    assert mem["mem_alarm"] is True
+    assert mem["bytes_in_use_max"] == 10_000_000
+    assert mem["skew_bytes"] == 5_000_000
+    assert mem["mem_threshold"] == 10_000_000
+    # orthogonal alarms stay down; per-rank bytes land in health
+    assert health["storm_alarm"] is False
+    assert health["anomaly_alarm"] is False
+    for r in ("0", "1"):
+        assert health["ranks"][r]["memory_bytes_in_use"] == \
+            5_000_000 * (1 + int(r))
+
+
 @pytest.mark.slow
 def test_scrape_drill_aggregator_restart(tmp_path):
     """@slow: kill the aggregator mid-drill and respawn it — the

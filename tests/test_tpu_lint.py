@@ -301,6 +301,26 @@ FIXTURES = {
                 raise RuntimeError("diverged")
         """,
     ),
+    "TPU018": (
+        "pkg/mod.py",
+        """
+        def train_loop(step, data):
+            losses = []
+            for x, y in data:
+                loss = step(x, y)
+                losses.append(loss)
+            return losses
+        """,
+        """
+        def train_loop(step, data):
+            losses = []
+            for i, (x, y) in enumerate(data):
+                loss = step(x, y)
+                if i % 100 == 0:
+                    losses.append(float(loss))
+            return losses
+        """,
+    ),
     "TPU014": (
         "paddle_tpu/distributed/mod.py",
         """
@@ -844,6 +864,116 @@ def test_tpu017_suppression_directive_respected():
         return bool(jnp.all(jnp.isfinite(out)))
     """
     assert "TPU017" not in rules_fired(src, path="paddle_tpu/ops/m.py")
+
+
+def test_tpu018_direct_call_and_jnp_results_fire():
+    # appending the step call's result directly — no intermediate name
+    src = """
+    def run_steps(step, batches):
+        out = []
+        for b in batches:
+            out.append(step(b))
+        return out
+    """
+    assert "TPU018" in rules_fired(src, path="myscript.py")
+    # a jnp op's result is a device array too
+    src2 = """
+    import jax.numpy as jnp
+    def train(grads_seq):
+        norms = []
+        for g in grads_seq:
+            norms.append(jnp.sqrt(jnp.sum(g * g)))
+        return norms
+    """
+    assert "TPU018" in rules_fired(src2, path="myscript.py")
+    # insert/extend accumulate the same way append does
+    src3 = """
+    def fit(step, data):
+        history = []
+        for x in data:
+            logits = step(x)
+            history.insert(0, logits)
+    """
+    assert "TPU018" in rules_fired(src3, path="myscript.py")
+
+
+def test_tpu018_host_conversions_are_silent():
+    # every host-detaching spelling the rule pushes toward
+    for conv in ("float(loss)", "loss.item()", "loss.numpy()",
+                 "loss.tolist()", "np.asarray(loss)",
+                 "jax.device_get(loss)", "loss.numpy().tobytes()"):
+        src = f"""
+        import numpy as np
+        import jax
+        def train_loop(step, data):
+            losses = []
+            for x in data:
+                loss = step(x)
+                losses.append({conv})
+            return losses
+        """
+        assert "TPU018" not in rules_fired(src, path="myscript.py"), conv
+
+
+def test_tpu018_scoped_to_step_loops_only():
+    # identical accumulation outside a train-named function: silent
+    src = """
+    def collect(step, data):
+        losses = []
+        for x in data:
+            losses.append(step(x))
+        return losses
+    """
+    assert "TPU018" not in rules_fired(src, path="myscript.py")
+    # host-side bookkeeping in a train loop: silent (no device name)
+    src2 = """
+    import time
+    def train_loop(run, data):
+        step_times = []
+        for x in data:
+            t0 = time.perf_counter()
+            run(x)
+            step_times.append(time.perf_counter() - t0)
+    """
+    assert "TPU018" not in rules_fired(src2, path="myscript.py")
+
+
+def test_tpu018_host_rebind_clears_the_name():
+    # `loss = float(raw)` rebinds the device-ish NAME to a host value;
+    # accumulating it afterwards is the correct cadence idiom
+    src = """
+    def train_loop(step, data):
+        losses = []
+        for x in data:
+            loss = float(step(x))
+            losses.append(loss)
+        return losses
+    """
+    assert "TPU018" not in rules_fired(src, path="myscript.py")
+
+
+def test_tpu018_deferred_bodies_and_nested_loops_report_once():
+    # a callback def'd in the loop is deferred execution — silent
+    src = """
+    def train_loop(step, data, on_done):
+        for x in data:
+            def cb(loss):
+                results.append(loss)
+            on_done(cb)
+    """
+    assert "TPU018" not in rules_fired(src, path="myscript.py")
+    # the inner loop's own event carries the report — exactly one
+    src2 = """
+    def train_epoch(step, loader):
+        losses = []
+        for epoch in range(3):
+            for x in loader:
+                losses.append(step(x))
+    """
+    vs = [v for v in lint_source(textwrap.dedent(src2),
+                                 path="myscript.py")
+          if v.rule == "TPU018"]
+    assert len(vs) == 1
 
 
 def test_tpu016_vector_norms_and_fused_entry_are_silent():

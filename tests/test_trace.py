@@ -206,6 +206,79 @@ def test_chrome_export_roundtrips_through_merge(tmp_path):
         assert e["args"]["run_id"] == "mergetest"
 
 
+def test_counter_events_export_as_chrome_counter_track():
+    """record_counter lands ph:"C" events (the memory watermark track)
+    carrying the series values in args, on the rank's pid."""
+    tr = Tracer().enable(process_index=5)
+    tr.record_counter("device_memory", 1_000_000,
+                      {"bytes_in_use": 1024.0, "fragmentation": 64.0})
+    tr.record_counter("device_memory", 2_000_000,
+                      {"bytes_in_use": 2048.0, "fragmentation": 0.0})
+    assert tr.snapshot()["counters"] == 2
+    cs = [e for e in tr.chrome_events() if e["ph"] == "C"]
+    assert len(cs) == 2
+    for e in cs:
+        assert e["name"] == "device_memory"
+        assert e["pid"] == 5
+        assert set(e["args"]) == {"bytes_in_use", "fragmentation"}
+    assert cs[0]["ts"] < cs[1]["ts"]
+    assert cs[1]["args"]["bytes_in_use"] == 2048.0
+    # counters ride the ring-buffer clear like spans (the process_name
+    # meta event survives by design)
+    tr.clear()
+    assert tr.counters() == []
+    assert [e for e in tr.chrome_events() if e["ph"] != "M"] == []
+
+
+def test_counter_hooks_noop_while_disabled():
+    tr = Tracer()
+    tr.record_counter("device_memory", 0, {"bytes_in_use": 1.0})
+    assert tr.counters() == []
+
+
+def test_merge_trace_stitches_counter_tracks_per_rank(tmp_path):
+    """``merge --trace`` with counter events interleaved among duration
+    spans: every rank's C events keep their pid (per-rank track
+    identity), the merged stream stays ts-ordered across BOTH event
+    kinds, and a corrupt per-rank file is skipped, never fatal."""
+    trace_dir = str(tmp_path)
+    for rank in (0, 1):
+        tr = Tracer().enable(trace_dir=trace_dir, process_index=rank,
+                             run_id="memtrack")
+        # counters interleave INSIDE the span window on purpose
+        tr.record_span("backward", "compute", 1000, 5000)
+        tr.record_counter("device_memory", 2000,
+                          {"bytes_in_use": float(100 * (rank + 1))})
+        tr.record_counter("device_memory", 4000,
+                          {"bytes_in_use": float(200 * (rank + 1))})
+        tr.record_span("optimizer", "compute", 5000, 6000)
+        assert tr.export_chrome() is not None
+    with open(os.path.join(trace_dir, "trace-memtrack-7.json"),
+              "w") as f:
+        f.write("{torn")
+    files = discover_trace_files([trace_dir])
+    assert len(files) == 3
+    doc, skipped = merge_traces(files)
+    assert skipped == 1
+    evs = [e for e in doc["traceEvents"] if e.get("ph") != "M"]
+    cs = [e for e in evs if e["ph"] == "C"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert len(cs) == 4 and len(xs) == 4
+    # per-rank track identity: each rank's counter series survives on
+    # its own pid with its own values
+    for rank in (0, 1):
+        mine = [e["args"]["bytes_in_use"] for e in cs
+                if e["pid"] == rank]
+        assert mine == [100.0 * (rank + 1), 200.0 * (rank + 1)]
+    # one ts-ordered stream across spans AND counters
+    ts = [e["ts"] for e in evs]
+    assert ts == sorted(ts)
+    # and the counters really interleave among the duration events
+    kinds = [e["ph"] for e in sorted(evs, key=lambda e: e["ts"])
+             if e["pid"] == 0]
+    assert kinds.index("C") > 0 and "X" in kinds[kinds.index("C"):]
+
+
 # -- analytic MFU ------------------------------------------------------------
 
 def test_peak_flops_prefix_matching():
