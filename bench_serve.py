@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""bench_serve.py — load generator for the AOT serving engine.
+
+Open-loop arrival process (Poisson interarrivals at ``--rate``
+requests/sec, or all-at-once when ``--rate 0``) against an in-process
+:class:`paddle_tpu.serving.ServingEngine`, with the scheduler's
+continuous-batching loop on a background thread — the same topology as
+the HTTP front end minus the socket hop.
+
+Emits ONE JSON record as the last stdout line (BENCH_* house style),
+including:
+
+ - ``latency_p50_ms`` / ``latency_p99_ms`` and tokens/sec,
+ - batch occupancy and KV-pool utilization,
+ - the zero-compile verdict: ``unexpected_compiles`` must be 0 after
+   warmup for the run to pass (exit code 1 otherwise),
+ - a ``tpu_unreachable`` fast-fail record when the device canary hangs
+   (same contract as bench.py: the record still emits, rc=1, no
+   stacked watchdogs).
+
+CPU example (the tier-1-adjacent smoke used in the acceptance run):
+
+    JAX_PLATFORMS=cpu python bench_serve.py --streams 64 --max-new 8
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--streams", type=int, default=64,
+                    help="concurrent request streams to issue")
+    ap.add_argument("--rate", type=float, default=0.0,
+                    help="open-loop arrival rate in requests/sec "
+                         "(0 = all at once)")
+    ap.add_argument("--prompt-len", type=int, default=12,
+                    help="max prompt length (sampled 3..N per stream)")
+    ap.add_argument("--max-new", type=int, default=8,
+                    help="tokens to generate per request")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--vocab", type=int, default=256)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--max-seq", type=int, default=128)
+    ap.add_argument("--canary-timeout", type=float, default=120.0,
+                    help="seconds before declaring the device "
+                         "unreachable (fast-fail)")
+    ap.add_argument("--result-timeout", type=float, default=300.0,
+                    help="per-stream result wait budget")
+    ap.add_argument("--out", default=None,
+                    help="also write the record to this JSON file")
+    return ap.parse_args(argv)
+
+
+def emit(record, out=None):
+    if out:
+        try:
+            with open(out, "w") as f:
+                json.dump(record, f, indent=2, sort_keys=True)
+        except OSError as e:
+            record.setdefault("errors", {})["out_file"] = str(e)
+    print(json.dumps(record), flush=True)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    t_start = time.time()
+    record = {
+        "bench": "serve",
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+        "ok": False,
+        "streams": args.streams,
+        "rate": args.rate,
+        "max_new_tokens": args.max_new,
+        "platform": os.environ.get("JAX_PLATFORMS", ""),
+    }
+
+    # device canary under a watchdog: if a tiny jit matmul can't finish,
+    # the AOT build (dozens of compiles) never will — emit the fast-fail
+    # record instead of hanging the whole bench budget
+    canary_done = threading.Event()
+    canary_err = []
+
+    def _canary():
+        try:
+            import jax
+            import jax.numpy as jnp
+            x = jnp.ones((8, 8), jnp.float32)
+            jax.jit(lambda a: a @ a)(x).block_until_ready()
+            record["backend"] = jax.default_backend()
+            canary_done.set()
+        except Exception as e:  # fast failure still beats a hang
+            canary_err.append(str(e))
+            canary_done.set()
+
+    threading.Thread(target=_canary, daemon=True).start()
+    if not canary_done.wait(args.canary_timeout) or canary_err:
+        record["tpu_unreachable"] = True
+        record["error"] = (canary_err[0] if canary_err else
+                           "canary watchdog timeout — device "
+                           "unreachable; serve leg skipped (fast-fail)")
+        record["bench_wall_sec"] = round(time.time() - t_start, 1)
+        emit(record, args.out)
+        return 1
+
+    from paddle_tpu.observability.telemetry import get_telemetry
+    from paddle_tpu.serving import (ModelSpec, ServeConfig, ServingEngine,
+                                    init_params)
+    from paddle_tpu.serving.scheduler import EngineSaturated
+
+    get_telemetry().enable()  # metrics + compile watcher
+
+    spec = ModelSpec(vocab_size=args.vocab, hidden=args.hidden,
+                     layers=args.layers, heads=args.heads,
+                     max_seq_len=args.max_seq)
+    cfg = ServeConfig.from_env()
+    if not os.environ.get("PT_SERVE_MAX_INFLIGHT"):
+        cfg = cfg.replace(max_inflight=max(cfg.max_inflight,
+                                           args.streams + 1))
+    if not os.environ.get("PT_SERVE_KV_PAGES"):
+        # enough headroom that admission control, not pool sizing,
+        # shapes the run: ~half the streams resident at worst case
+        worst = -(-(args.prompt_len + args.max_new) // cfg.page_size)
+        cfg = cfg.replace(kv_pages=max(cfg.kv_pages,
+                                       worst * (args.streams // 2) + 2))
+
+    t_build0 = time.time()
+    engine = ServingEngine(spec, init_params(spec, args.seed), cfg)
+    record["aot_build_sec"] = round(time.time() - t_build0, 3)
+    record["compiled_programs"] = engine.compiled_programs
+    record["decode_buckets"] = list(engine.config.decode_buckets)
+    record["prefill_buckets"] = list(engine.config.prefill_buckets)
+    record["kv_pages"] = engine.config.kv_pages
+
+    engine.scheduler.start()
+    rng = np.random.RandomState(args.seed)
+    prompts = [
+        rng.randint(1, spec.vocab_size,
+                    size=rng.randint(3, max(4, args.prompt_len + 1)))
+        .tolist()
+        for _ in range(args.streams)]
+
+    streams = [None] * args.streams
+    saturation_retries = 0
+    t_load0 = time.monotonic()
+    for i, prompt in enumerate(prompts):
+        # open-loop Poisson arrivals: the schedule does not slow down
+        # when the engine backs up — that pressure is the point
+        if args.rate > 0:
+            time.sleep(float(rng.exponential(1.0 / args.rate)))
+        while streams[i] is None:
+            try:
+                streams[i] = engine.scheduler.submit(
+                    prompt, max_new_tokens=args.max_new)
+            except EngineSaturated:
+                saturation_retries += 1
+                time.sleep(0.002)
+
+    errors = {}
+    latencies = []
+    tokens_generated = 0
+    for i, st in enumerate(streams):
+        try:
+            out = st.result(timeout=args.result_timeout)
+            tokens_generated += len(out)
+            latencies.append(st.latency)
+        except Exception as e:
+            errors[f"stream_{i}"] = str(e)
+    t_load = time.monotonic() - t_load0
+    engine.scheduler.stop()
+
+    sched = engine.scheduler.snapshot()
+    kv = engine.pool.snapshot()
+    lat_ms = np.asarray([l * 1e3 for l in latencies if l is not None])
+    record.update({
+        "completed_streams": len(latencies),
+        "errors": errors or None,
+        "saturation_retries": saturation_retries,
+        "load_wall_sec": round(t_load, 3),
+        "tokens_generated": tokens_generated,
+        "tokens_per_sec": round(tokens_generated / t_load, 2)
+        if t_load > 0 else None,
+        "requests_per_sec": round(len(latencies) / t_load, 2)
+        if t_load > 0 else None,
+        "latency_p50_ms": round(float(np.percentile(lat_ms, 50)), 3)
+        if lat_ms.size else None,
+        "latency_p99_ms": round(float(np.percentile(lat_ms, 99)), 3)
+        if lat_ms.size else None,
+        "latency_mean_ms": round(float(lat_ms.mean()), 3)
+        if lat_ms.size else None,
+        "batch_occupancy_mean": round(sched["batch_occupancy_mean"], 4),
+        "peak_active_sequences": sched["peak_active"],
+        "scheduler_steps": sched["steps"],
+        "admission_refusals_kv": sched["refused_kv"],
+        "kv_pages_peak_used": kv["high_watermark"],
+        "kv_utilization_peak": round(
+            kv["high_watermark"] / max(1, kv["usable_pages"]), 4),
+        "unexpected_compiles": engine.unexpected_compiles,
+        "zero_compile_after_warmup": engine.unexpected_compiles == 0,
+        "healthz_ok": engine.healthz()["ok"],
+    })
+    record["ok"] = (not errors
+                    and len(latencies) == args.streams
+                    and engine.unexpected_compiles == 0)
+    record["bench_wall_sec"] = round(time.time() - t_start, 1)
+    engine.close()
+    emit(record, args.out)
+    return 0 if record["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,7 +33,7 @@ __all__ = ["Config", "Predictor", "create_predictor", "Tensor",
 
 
 class PrecisionType:
-    """ref ``paddle/fidle/inference/api/paddle_analysis_config.h``
+    """ref ``paddle/fluid/inference/api/paddle_analysis_config.h``
     Precision enum; bf16 is the TPU-native half type."""
     Float32 = 0
     Half = 1
@@ -168,9 +168,25 @@ class Tensor:
             (self._spec or {}).get("dtype", "float32")
 
 
+class _HostTensor(Tensor):
+    """Input handle that stays on the host: the serving engine's
+    request path is numpy-only (a ``jnp.asarray`` here would book a
+    tiny convert compile and trip the serve zero-compile sentinel)."""
+
+    def copy_from_cpu(self, arr):
+        self._value = np.asarray(arr)
+
+    def share_external_data(self, arr):
+        if isinstance(arr, _PTensor):
+            arr = arr._data
+        self._value = np.asarray(arr)
+
+
 class Predictor:
     """Loads a StableHLO artifact and serves it (AnalysisPredictor
-    analog)."""
+    analog).  A served-model directory (``serve_config.json`` written
+    by ``serving.save_served_model``) routes through the AOT serving
+    engine instead."""
 
     def __init__(self, config: Config, _share_from: "Predictor" = None):
         prefix = config.model_prefix
@@ -181,17 +197,24 @@ class Predictor:
             # share the deserialized program + weights (PredictorPool):
             # only the IO handles are per-predictor
             self._call = _share_from._call
+            self._engine = getattr(_share_from, "_engine", None)
             self._in_names = list(_share_from._in_names)
             self._in_specs = list(_share_from._in_specs)
             self._out_names = (list(_share_from._out_names)
                                if _share_from._out_names else None)
-            self._inputs = {n: Tensor(n, s) for n, s in
+            tcls = _HostTensor if self._engine is not None else Tensor
+            self._inputs = {n: tcls(n, s) for n, s in
                             zip(self._in_names, self._in_specs)}
             self._outputs = None
             return
         self._load(prefix)
 
     def _load(self, prefix):
+        self._engine = None
+        from ..serving.engine import is_served_model_dir
+        if is_served_model_dir(prefix):  # serving-engine model dir
+            self._load_served(prefix)
+            return
         if os.path.exists(prefix + ".stablehlo"):  # jit.save artifact
             from ..jit.save_load import load as jit_load
             layer = jit_load(prefix)
@@ -214,6 +237,28 @@ class Predictor:
                 f"no inference artifact at '{prefix}' (.stablehlo from "
                 "jit.save or .pdmodel from save_inference_model)")
         self._inputs = {n: Tensor(n, s)
+                        for n, s in zip(self._in_names, self._in_specs)}
+        self._outputs = None
+
+    def _load_served(self, path):
+        """Route a served-model dir (``serve_config.json`` + weights)
+        through the AOT serving engine: same Predictor surface, but
+        run() is a full generate loop over the zero-compile serve
+        graphs instead of a single forward."""
+        from ..serving import load_engine
+        engine = load_engine(path)
+        self._engine = engine
+
+        def _generate(tokens):
+            prompt = [int(t) for t in np.asarray(tokens).reshape(-1)]
+            out = engine.generate([prompt])[0]
+            return (np.asarray(out, np.int32),)
+
+        self._call = _generate
+        self._in_names = ["tokens"]
+        self._in_specs = [{"shape": [-1], "dtype": "int32"}]
+        self._out_names = ["generated_ids"]
+        self._inputs = {n: _HostTensor(n, s)
                         for n, s in zip(self._in_names, self._in_specs)}
         self._outputs = None
 

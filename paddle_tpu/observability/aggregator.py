@@ -48,7 +48,7 @@ without bound.  Window set by ``PT_AGGREGATOR_RETENTION`` seconds
 Env (all read by :func:`main` as flag defaults): ``PT_AGGREGATOR_PORT``
 ``PT_AGGREGATOR_INTERVAL`` ``PT_AGGREGATOR_STALE_AFTER``
 ``PT_AGGREGATOR_SCRAPE_TIMEOUT`` ``PT_AGGREGATOR_STORM_THRESHOLD``
-``PT_AGGREGATOR_RETENTION``.
+``PT_AGGREGATOR_SERVE_THRESHOLD`` ``PT_AGGREGATOR_RETENTION``.
 """
 from __future__ import annotations
 
@@ -399,6 +399,27 @@ def _rank_step_stats(families):
     return out
 
 
+def _rank_serve_stats(families):
+    """One rank's ``pt_serve_request_latency_seconds`` histogram as
+    ``{buckets, sum, count}`` (None when the rank serves nothing).
+    Bucket maps are summable across ranks — all serve histograms share
+    the default log-bucket ladder."""
+    fam = families.get("pt_serve_request_latency_seconds")
+    if fam is None:
+        return None
+    buckets: dict = {}
+    total_sum, count = 0.0, 0.0
+    for sname, labels, value in fam["samples"]:
+        if sname.endswith("_bucket"):
+            le = _parse_value(labels.get("le", "+Inf"))
+            buckets[le] = buckets.get(le, 0.0) + value
+        elif sname.endswith("_sum"):
+            total_sum += value
+        elif sname.endswith("_count"):
+            count += value
+    return {"buckets": buckets, "sum": total_sum, "count": count}
+
+
 def _family_total(families, name):
     """Sum of every sample of a counter family (0.0 when absent)."""
     fam = families.get(name)
@@ -510,8 +531,8 @@ class ClusterAggregator:
 
     def __init__(self, *, endpoints=None, store=None, run_id="local",
                  stale_after=5.0, scrape_timeout=2.0, storm_threshold=1,
-                 anomaly_threshold=10, mem_threshold=0, interval=1.0,
-                 drop_labels=("process_index",),
+                 anomaly_threshold=10, mem_threshold=0, serve_threshold=0.0,
+                 interval=1.0, drop_labels=("process_index",),
                  retention=3600.0, history_max_points=512):
         self.run_id = str(run_id)
         self._history = (RetentionBuffer(retention, history_max_points)
@@ -524,6 +545,9 @@ class ClusterAggregator:
         # /healthz to 503 (0 disables — there is no portable default
         # limit, HBM size varies by device generation)
         self.mem_threshold = int(mem_threshold or 0)
+        # serving saturation trip: cluster p99 request latency at/over
+        # this many seconds flips /healthz to 503 (0 disables)
+        self.serve_threshold = float(serve_threshold or 0.0)
         self.interval = float(interval)
         self.drop_labels = tuple(drop_labels)
         self._store = store
@@ -762,6 +786,58 @@ class ClusterAggregator:
               "1 while any rank's bytes_in_use >= the near-OOM "
               "threshold", [((), 1 if mem_alarm else 0)])
 
+        # serving fleet: bucket-merged request-latency percentiles,
+        # queue depth, and the saturation trip.  A serving fleet's SLO
+        # is the CLUSTER p99 — one saturated replica hides inside
+        # per-rank views but dominates the merged tail.
+        serve_stats = {}
+        for r, f in fresh.items():
+            st = _rank_serve_stats(f)
+            if st is not None and st["count"]:
+                serve_stats[r] = st
+        serve_p50 = serve_p99 = None
+        serve_count = 0
+        if serve_stats:
+            merged_buckets: dict = {}
+            for st in serve_stats.values():
+                for le, cum in st["buckets"].items():
+                    merged_buckets[le] = merged_buckets.get(le, 0.0) + cum
+            serve_count = sum(st["count"] for st in serve_stats.values())
+            serve_p50 = bucket_percentile(merged_buckets, serve_count, 0.50)
+            serve_p99 = bucket_percentile(merged_buckets, serve_count, 0.99)
+            if serve_p50 is not None:
+                gauge("pt_cluster_serve_p50_seconds",
+                      "cluster p50 serve request latency "
+                      "(bucket-merged over fresh ranks)",
+                      [((), serve_p50)])
+            if serve_p99 is not None:
+                gauge("pt_cluster_serve_p99_seconds",
+                      "cluster p99 serve request latency "
+                      "(bucket-merged over fresh ranks)",
+                      [((), serve_p99)])
+        rank_queue = {r: _gauge_value(f, "pt_serve_queue_depth")
+                      for r, f in fresh.items()}
+        rank_queue = {r: v for r, v in rank_queue.items() if v is not None}
+        if rank_queue:
+            gauge("pt_cluster_serve_queue_depth",
+                  "serve admission-queue depth over fresh ranks (sum = "
+                  "fleet backlog; max = worst replica)",
+                  [((("stat", "sum"),), sum(rank_queue.values())),
+                   ((("stat", "max"),), max(rank_queue.values()))])
+        serve_compiles = sum(
+            _family_total(f, "pt_serve_unexpected_compiles_total")
+            for f in fresh.values())
+        if serve_stats or rank_queue or serve_compiles:
+            counter("pt_cluster_serve_unexpected_compiles_total",
+                    "request-path compiles after warmup summed across "
+                    "ranks (any non-zero value is an SLO violation)",
+                    serve_compiles)
+        serve_alarm = (self.serve_threshold > 0 and serve_p99 is not None
+                       and serve_p99 >= self.serve_threshold)
+        gauge("pt_cluster_serve_alarm",
+              "1 while cluster serve p99 >= the saturation threshold",
+              [((), 1 if serve_alarm else 0)])
+
         text = render_exposition(merged) + "\n".join(extra) + "\n"
 
         ranks_health = {}
@@ -796,7 +872,8 @@ class ClusterAggregator:
                     entry["memory_bytes_in_use"] = int(rank_mem[r])
             ranks_health[str(r)] = entry
         health = {
-            "ok": not alarm and not anomaly_alarm and not mem_alarm,
+            "ok": (not alarm and not anomaly_alarm and not mem_alarm
+                   and not serve_alarm),
             "run_id": self.run_id,
             "ranks_discovered": len(self._endpoints),
             "ranks_up": len(fresh),
@@ -822,6 +899,20 @@ class ClusterAggregator:
                                if mem_skew is not None else None),
                 "mem_alarm": mem_alarm,
                 "mem_threshold": self.mem_threshold,
+            },
+            "serve": {
+                "requests_total": int(serve_count),
+                "p50_seconds": (round(serve_p50, 6)
+                                if serve_p50 is not None else None),
+                "p99_seconds": (round(serve_p99, 6)
+                                if serve_p99 is not None else None),
+                "queue_depth_sum": (int(sum(rank_queue.values()))
+                                    if rank_queue else None),
+                "queue_depth_max": (int(max(rank_queue.values()))
+                                    if rank_queue else None),
+                "unexpected_compiles_total": int(serve_compiles),
+                "serve_alarm": serve_alarm,
+                "serve_threshold": self.serve_threshold,
             },
             "merge_conflicts_total": self._conflicts_total,
             "scrape_errors_total": self._scrape_errors_total,
@@ -1002,6 +1093,12 @@ def main(argv=None):
                     help="near-OOM trip: any rank's bytes_in_use at/"
                          "over this many bytes flips /healthz to 503 "
                          "(0 disables the alarm)")
+    ap.add_argument("--serve-threshold", type=float,
+                    default=float(_env("PT_AGGREGATOR_SERVE_THRESHOLD",
+                                       "0")),
+                    help="serving saturation trip: cluster p99 request "
+                         "latency at/over this many seconds flips "
+                         "/healthz to 503 (0 disables the alarm)")
     ap.add_argument("--retention", type=float,
                     default=float(_env("PT_AGGREGATOR_RETENTION",
                                        "3600")),
@@ -1047,6 +1144,7 @@ def main(argv=None):
         storm_threshold=args.storm_threshold,
         anomaly_threshold=args.anomaly_threshold,
         mem_threshold=args.mem_threshold,
+        serve_threshold=args.serve_threshold,
         interval=args.interval, retention=args.retention)
     if args.once:
         agg.scrape_once()

@@ -260,6 +260,7 @@ class TrainingTelemetry:
         self._capture_misses: dict = {}
         self._fusion_rewrites: dict = {}
         self._fusion_fallbacks: dict = {}
+        self._compile_listeners: list = []
         # refresh device-memory gauges every N steps (stats read is a
         # host-side allocator query, cheap but not free)
         self._mem_every = 32
@@ -720,7 +721,36 @@ class TrainingTelemetry:
         path as the log filter."""
         self._on_compile(name, signature)
 
+    def ensure_compile_watch(self):
+        """Install the jax compile-log watcher without flipping the rest
+        of telemetry on.  Lets the serving engine's zero-compile
+        sentinel see compile events even when metrics are disabled
+        (compile events still reach listeners/sentinel; only metric
+        booking is gated on ``enabled``)."""
+        return self._watcher.install()
+
+    def add_compile_listener(self, fn):
+        """Register ``fn(name, signature)`` to be invoked on every
+        observed compile (log-filter or :meth:`record_compile`).
+        Listener exceptions are swallowed — observers must not break
+        the compile path."""
+        with self._lock:
+            if fn not in self._compile_listeners:
+                self._compile_listeners.append(fn)
+
+    def remove_compile_listener(self, fn):
+        with self._lock:
+            try:
+                self._compile_listeners.remove(fn)
+            except ValueError:
+                pass
+
     def _on_compile(self, name, signature=""):
+        for fn in list(self._compile_listeners):
+            try:
+                fn(name, signature)
+            except Exception:
+                pass
         if self.enabled:
             self._m_compiles.inc(fn=name)
         if self.sink is not None:

@@ -1,0 +1,197 @@
+"""Decode-shaped fused attention over a paged KV-cache.
+
+The serving engine (:mod:`paddle_tpu.serving`) keeps each sequence's KV
+history in fixed-size *pages* owned by a block pool
+(:class:`paddle_tpu.serving.kv_cache.PagePool`); a decode step attends
+one new query token per sequence against that sequence's page list.
+The reference stack reaches the same shape through
+``paddle/fluid/inference`` + external serving engines; here the op is
+first-class:
+
+ - :func:`paged_attention_reference` — the XLA path: gather the page
+   window ``k_pages[page_tables]`` → masked softmax attention.  Row
+   independent by construction, which is what makes continuous
+   batching bit-stable (a sequence's logits do not depend on its batch
+   neighbours or on which physical pages it landed in).
+ - :func:`paged_attention` — dispatcher: Pallas kernel on TPU (canary
+   probed once, silent XLA fallback — the :mod:`.fused_kernels`
+   convention), reference elsewhere.
+ - ``_paged_attention_pallas`` — the kernel: grid ``(batch, pages)``
+   with the per-sequence page table scalar-prefetched so each grid
+   step's ``BlockSpec`` index map *is* the page-table lookup (the page
+   gather never materialises in HBM), online-softmax accumulators in
+   VMEM scratch.  Interpret-runnable off-TPU; MXU tiling/tuning on a
+   real device is a follow-on (ROADMAP real-TPU evidence round).
+
+Shapes (one layer; the model loops layers):
+  q            (B, H, D)        one query token per sequence
+  k/v_pages    (P, ps, H, D)    the whole pool, P pages of ps tokens
+  page_tables  (B, max_pages)   int32 page ids, position t lives in
+                                page ``pt[b, t // ps]`` slot ``t % ps``
+  lengths      (B,) int32       valid context per row (pos of the new
+                                token + 1; masks padding AND the
+                                reserved null page 0 that pads short
+                                page tables)
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_ops import _CompilerParams, _NEG_INF, _interpret_default
+
+__all__ = ["paged_attention", "paged_attention_reference"]
+
+
+def paged_attention_reference(q, k_pages, v_pages, page_tables, lengths,
+                              *, sm_scale=None):
+    """XLA reference: gather the page window, masked softmax attention.
+
+    f32 scores/accumulation regardless of operand dtype (the MXU
+    contract from :mod:`.pallas_ops`); output in ``q.dtype``.
+    """
+    b, h, d = q.shape
+    ps = k_pages.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    # (B, max_pages, ps, H, D) -> (B, C, H, D); position t sits at
+    # context index t because pages fill in order
+    k_ctx = k_pages[page_tables].reshape(b, -1, h, d).astype(jnp.float32)
+    v_ctx = v_pages[page_tables].reshape(b, -1, h, d).astype(jnp.float32)
+    s = jnp.einsum("bhd,bchd->bhc", q.astype(jnp.float32), k_ctx) * sm_scale
+    c = k_ctx.shape[1]
+    mask = jnp.arange(c, dtype=jnp.int32)[None, :] < lengths[:, None]
+    s = jnp.where(mask[:, None, :], s, _NEG_INF)
+    w = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhc,bchd->bhd", w, v_ctx)
+    return o.astype(q.dtype)
+
+
+def _paged_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_scr, l_scr, acc_scr, *, ps, max_pages, sm_scale):
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, _NEG_INF, jnp.float32)
+        l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    length = len_ref[b]
+
+    @pl.when(i * ps < length)
+    def _page():
+        q = q_ref[...].astype(jnp.float32)          # (H, D)
+        k = k_ref[...].astype(jnp.float32)          # (ps, H, D)
+        v = v_ref[...].astype(jnp.float32)
+        s = jnp.einsum("hd,phd->hp", q, k) * sm_scale
+        pos = i * ps + jax.lax.broadcasted_iota(jnp.int32, (1, ps), 1)
+        valid = pos < length                         # (1, ps)
+        s = jnp.where(valid, s, _NEG_INF)
+        m_prev, l_prev = m_scr[...], l_scr[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        # re-mask after the exp: on a fully-dead page m_new stays at
+        # _NEG_INF and exp(s - m_new) would be exp(0) = 1 mass
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[...] * alpha \
+            + jnp.einsum("hp,phd->hd", p, v)
+        m_scr[:] = m_new
+        l_scr[:] = l_new
+
+    @pl.when(i == max_pages - 1)
+    def _finalize():
+        l = l_scr[...]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[...] = (acc_scr[...] / l).astype(o_ref.dtype)
+
+
+def _paged_attention_pallas(q, k_pages, v_pages, page_tables, lengths,
+                            *, sm_scale, interpret):
+    b, h, d = q.shape
+    ps = k_pages.shape[1]
+    max_pages = page_tables.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, max_pages),
+        in_specs=[
+            pl.BlockSpec((None, h, d), lambda bi, i, pt, ln: (bi, 0, 0)),
+            pl.BlockSpec((None, ps, h, d),
+                         lambda bi, i, pt, ln: (pt[bi, i], 0, 0, 0)),
+            pl.BlockSpec((None, ps, h, d),
+                         lambda bi, i, pt, ln: (pt[bi, i], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, h, d), lambda bi, i, pt, ln: (bi, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((h, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_paged_kernel, ps=ps, max_pages=max_pages,
+                               sm_scale=sm_scale)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(page_tables, lengths, q, k_pages, v_pages)
+
+
+_canary_ok = None
+
+
+def _canary():
+    """One-shot probe: run the kernel at a toy shape before trusting it
+    for dispatch (the SDPA/fused-kernel convention — a broken lowering
+    degrades to XLA instead of poisoning the serve path)."""
+    global _canary_ok
+    if _canary_ok is None:
+        try:
+            q = jnp.zeros((2, 2, 8), jnp.float32)
+            kp = jnp.zeros((3, 4, 2, 8), jnp.float32)
+            pt = jnp.zeros((2, 2), jnp.int32)
+            ln = jnp.ones((2,), jnp.int32)
+            _paged_attention_pallas(q, kp, kp, pt, ln,
+                                    sm_scale=1.0,
+                                    interpret=_interpret_default())
+            _canary_ok = True
+        except Exception:
+            _canary_ok = False
+    return _canary_ok
+
+
+def paged_attention(q, k_pages, v_pages, page_tables, lengths, *,
+                    sm_scale=None, use_pallas=None, interpret=None):
+    """Dispatching entry: Pallas paged-attention kernel when eligible,
+    XLA gather+softmax reference otherwise.
+
+    Off-TPU the default is the reference (interpret-mode Pallas is a
+    correctness vehicle, not a fast path); pass ``use_pallas=True`` to
+    force the kernel (tests).  Dispatch decisions are trace-time
+    events booked on ``pt_pallas_calls_total{kernel="paged_attention"}``.
+    """
+    from .fused_kernels import record_dispatch
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = _interpret_default()
+    if use_pallas is None:
+        use_pallas = not interpret  # on-TPU default; reference on CPU
+    if use_pallas and _canary():
+        record_dispatch("paged_attention", "pallas")
+        return _paged_attention_pallas(q, k_pages, v_pages, page_tables,
+                                       lengths, sm_scale=sm_scale,
+                                       interpret=interpret)
+    record_dispatch("paged_attention", "fallback")
+    return paged_attention_reference(q, k_pages, v_pages, page_tables,
+                                     lengths, sm_scale=sm_scale)

@@ -1,0 +1,520 @@
+"""AOT-compiled serving engine: per-bucket zero-compile serve graphs.
+
+At construction the engine lowers+compiles every program it will ever
+run — one prefill executable per sequence bucket and one decode
+executable per batch bucket — then executes each once (warmup) and
+arms the **serve compile sentinel**: from that point, any compile
+observed in the process books ``pt_serve_unexpected_compiles_total``
+and flips ``/healthz`` to 503.  The PR 3 recompile sentinel thereby
+becomes an SLO alarm: on a serving box, a compile IS an incident.
+
+Request-path discipline that keeps the sentinel quiet (enforced by
+tpu-lint TPU019): the scheduler/HTTP layers touch only numpy and the
+pre-compiled executables.  Even a stray ``jnp.asarray`` on the request
+path would book a tiny convert/copy compile.
+
+KV state is donated: each executable takes the pool arrays, writes the
+step's K/V in place (XLA aliases the buffers — the PR 7 capture
+convention), and the engine rebinds the pool to the returned arrays.
+
+Zero-downtime weight swap: with a ``CheckpointManager`` attached,
+:meth:`ServingEngine.maybe_reload` hot-swaps to generation N+1 between
+steps while requests keep flowing — same program executables, new
+param buffers (no recompile: shapes are the signature, not values).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import warnings
+from dataclasses import dataclass, asdict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from .kv_cache import PagePool, NULL_PAGE
+from .model import ModelSpec, init_params, prefill_step, decode_step
+
+logger = logging.getLogger("paddle_tpu.serving")
+
+__all__ = ["ServeConfig", "ServingEngine", "save_served_model",
+           "load_engine", "SERVE_CONFIG_NAME"]
+
+SERVE_CONFIG_NAME = "serve_config.json"
+
+# CPU/interpret runs can't honor every donation; the engine's rebind
+# protocol is correct either way (the capture-layer convention)
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable")
+
+# >0 while ANY engine in the process is inside its sanctioned AOT
+# build; armed sentinels ignore those compiles (a second engine coming
+# up — blue/green, tests — is not a request-path incident)
+_AOT_BUILD_DEPTH = 0
+_AOT_BUILD_LOCK = threading.Lock()
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return int(v) if v else default
+
+
+def _env_buckets(name: str, default: Tuple[int, ...]) -> Tuple[int, ...]:
+    v = os.environ.get(name)
+    if not v:
+        return tuple(default)
+    return tuple(int(x) for x in v.replace(";", ",").split(",") if x.strip())
+
+
+@dataclass(frozen=True)
+class ServeConfig:
+    """Engine shape/capacity configuration.
+
+    Every field has an env override (read by :meth:`from_env`) so a
+    deployment can retune the ladder without touching the served model
+    dir:
+
+      PT_SERVE_BUCKETS          decode batch ladder, e.g. "2,4,8,16"
+      PT_SERVE_PREFILL_BUCKETS  prompt seq ladder, e.g. "16,32,64"
+      PT_SERVE_KV_PAGES         total pool pages (incl. null page)
+      PT_SERVE_PAGE_SIZE        tokens per page
+      PT_SERVE_MAX_INFLIGHT     admission cap (queued + active)
+    """
+
+    decode_buckets: Tuple[int, ...] = (2, 4, 8, 16)
+    prefill_buckets: Tuple[int, ...] = (16, 32, 64)
+    kv_pages: int = 128
+    page_size: int = 16
+    max_inflight: int = 64
+    max_new_tokens: int = 32
+    eos_id: int = -1          # <0: never stops early (length-bounded)
+
+    @classmethod
+    def from_env(cls, **overrides) -> "ServeConfig":
+        base = cls(
+            decode_buckets=_env_buckets(
+                "PT_SERVE_BUCKETS", cls.decode_buckets),
+            prefill_buckets=_env_buckets(
+                "PT_SERVE_PREFILL_BUCKETS", cls.prefill_buckets),
+            kv_pages=_env_int("PT_SERVE_KV_PAGES", cls.kv_pages),
+            page_size=_env_int("PT_SERVE_PAGE_SIZE", cls.page_size),
+            max_inflight=_env_int("PT_SERVE_MAX_INFLIGHT",
+                                  cls.max_inflight),
+            max_new_tokens=_env_int("PT_SERVE_MAX_NEW_TOKENS",
+                                    cls.max_new_tokens),
+            eos_id=_env_int("PT_SERVE_EOS_ID", cls.eos_id),
+        )
+        return base.replace(**overrides) if overrides else base
+
+    def replace(self, **kw) -> "ServeConfig":
+        d = asdict(self)
+        d.update(kw)
+        d["decode_buckets"] = tuple(d["decode_buckets"])
+        d["prefill_buckets"] = tuple(d["prefill_buckets"])
+        return ServeConfig(**d)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = asdict(self)
+        d["decode_buckets"] = list(self.decode_buckets)
+        d["prefill_buckets"] = list(self.prefill_buckets)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ServeConfig":
+        names = set(cls.__dataclass_fields__)
+        kw = {k: v for k, v in d.items() if k in names}
+        for key in ("decode_buckets", "prefill_buckets"):
+            if key in kw:
+                kw[key] = tuple(int(x) for x in kw[key])
+        return cls(**kw)
+
+    def normalized(self, spec: ModelSpec) -> "ServeConfig":
+        """Clamp the ladders to what the model/pool can serve.
+
+        Decode buckets are clamped to >= 2: XLA's batch-1 gemv path
+        has a different reduction order, and bit-identical decode
+        across batch compositions (the continuous-batching contract)
+        only holds for matmul-shaped batches.  A solo sequence decodes
+        in a 2-bucket with a null padding row instead.
+        """
+        dec = sorted({max(2, int(b)) for b in self.decode_buckets})
+        pre = sorted({int(s) for s in self.prefill_buckets
+                      if int(s) <= spec.max_seq_len})
+        if not pre:
+            pre = [spec.max_seq_len]
+        if self.page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        return self.replace(decode_buckets=tuple(dec),
+                            prefill_buckets=tuple(pre))
+
+
+def _struct_like(tree):
+    return jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tree)
+
+
+def _to_serve_device(tree):
+    # pin to ONE device: the executables are compiled against
+    # SingleDeviceSharding, but checkpoint restores (and callers running
+    # under a distributed mesh) may hand us NamedSharded arrays
+    dev = jax.local_devices()[0]
+    return jax.tree_util.tree_map(lambda a: jax.device_put(a, dev), tree)
+
+
+class ServingEngine:
+    """Programs + paged KV pool + hot-swappable weights.
+
+    The request path (scheduler / HTTP) calls :meth:`prefill` and
+    :meth:`decode`, which only ever touch numpy and the AOT-compiled
+    executables built in ``_build_programs``.
+    """
+
+    def __init__(self, spec: ModelSpec, params, config: ServeConfig = None,
+                 checkpoint_manager=None, weights_step: Optional[int] = None):
+        self.spec = spec
+        self.config = (config or ServeConfig.from_env()).normalized(spec)
+        self.checkpoint_manager = checkpoint_manager
+        self.max_pages_per_seq = -(-spec.max_seq_len // self.config.page_size)
+        # the whole construction is a sanctioned build phase: pool
+        # creation (jnp.zeros fill) and warmup compile too, and must not
+        # trip an already-armed sentinel on another live engine
+        global _AOT_BUILD_DEPTH
+        with _AOT_BUILD_LOCK:
+            _AOT_BUILD_DEPTH += 1
+        try:
+            self.pool = PagePool(
+                layers=spec.layers, pages=self.config.kv_pages,
+                page_size=self.config.page_size, heads=spec.heads,
+                head_dim=spec.head_dim)
+            self._params = _to_serve_device(params)
+            self._weights_step = weights_step
+            self._weights_lock = threading.Lock()
+            self.unexpected_compiles = 0
+            self._warmed = False
+            self._prefill_exe: Dict[int, Any] = {}
+            self._decode_exe: Dict[int, Any] = {}
+            self.compiled_programs = 0
+            self._build_programs()
+            self._warmup()
+        finally:
+            with _AOT_BUILD_LOCK:
+                _AOT_BUILD_DEPTH -= 1
+        self._arm_sentinel()
+        from .scheduler import ContinuousScheduler
+        self.scheduler = ContinuousScheduler(self)
+
+    # -- AOT build (the only place that is ALLOWED to compile) --------------
+
+    def _build_programs(self) -> None:
+        """Lower+compile the full program ladder ahead of time."""
+        global _AOT_BUILD_DEPTH
+        with _AOT_BUILD_LOCK:
+            _AOT_BUILD_DEPTH += 1
+        try:
+            self._build_programs_inner()
+        finally:
+            with _AOT_BUILD_LOCK:
+                _AOT_BUILD_DEPTH -= 1
+
+    def _build_programs_inner(self) -> None:
+        spec, cfg = self.spec, self.config
+        ps = cfg.page_size
+        p_struct = _struct_like(self._params)
+        k_struct = _struct_like(self.pool.k_flat)
+        i32 = np.int32
+
+        def _pf(params, k_flat, v_flat, tokens, length, page_table):
+            return prefill_step(spec, params, k_flat, v_flat, tokens,
+                                length, page_table, page_size=ps)
+
+        def _dec(params, k_flat, v_flat, tokens, positions, page_tables):
+            return decode_step(spec, params, k_flat, v_flat, tokens,
+                               positions, page_tables, page_size=ps)
+
+        pf_jit = jax.jit(_pf, donate_argnums=(1, 2))
+        dec_jit = jax.jit(_dec, donate_argnums=(1, 2))
+
+        for s in cfg.prefill_buckets:
+            self._prefill_exe[s] = pf_jit.lower(
+                p_struct, k_struct, k_struct,
+                jax.ShapeDtypeStruct((s,), i32),
+                jax.ShapeDtypeStruct((), i32),
+                jax.ShapeDtypeStruct((self.max_pages_per_seq,), i32)
+            ).compile()
+            self._account_compile(f"serve_prefill_s{s}")
+
+        for b in cfg.decode_buckets:
+            self._decode_exe[b] = dec_jit.lower(
+                p_struct, k_struct, k_struct,
+                jax.ShapeDtypeStruct((b,), i32),
+                jax.ShapeDtypeStruct((b,), i32),
+                jax.ShapeDtypeStruct((b, self.max_pages_per_seq), i32)
+            ).compile()
+            self._account_compile(f"serve_decode_b{b}")
+
+        self.compiled_programs = len(self._prefill_exe) + len(self._decode_exe)
+        logger.info(
+            "serve programs compiled: %d prefill buckets %s, %d decode "
+            "buckets %s", len(self._prefill_exe),
+            list(cfg.prefill_buckets), len(self._decode_exe),
+            list(cfg.decode_buckets))
+
+    def _account_compile(self, name: str) -> None:
+        """Book load-time compiles on the standard compile feed (only
+        when the log watcher isn't already counting them — the capture
+        layer convention)."""
+        try:
+            from ..observability.telemetry import get_telemetry
+            tel = get_telemetry()
+            if not tel._watcher.installed:
+                tel.record_compile(name, signature="aot-build")
+        except Exception:
+            pass
+
+    def _warmup(self) -> None:
+        """Execute every program once so first-request latency pays no
+        lazy initialization, and the sentinel can be armed on a
+        provably quiet path.  Warmup traffic writes only the null page."""
+        maxp = self.max_pages_per_seq
+        for s, exe in self._prefill_exe.items():
+            k2, v2, _, _ = exe(self._params, self.pool.k_flat,
+                               self.pool.v_flat,
+                               np.zeros((s,), np.int32), np.int32(1),
+                               np.zeros((maxp,), np.int32))
+            self.pool.swap(k2, v2)
+        for b, exe in self._decode_exe.items():
+            k2, v2, _, _ = exe(self._params, self.pool.k_flat,
+                               self.pool.v_flat,
+                               np.zeros((b,), np.int32),
+                               np.zeros((b,), np.int32),
+                               np.zeros((b, maxp), np.int32))
+            self.pool.swap(k2, v2)
+        jax.block_until_ready(self.pool.k_flat)
+
+    def _arm_sentinel(self) -> None:
+        """After this point, ANY observed compile is a request-path
+        compile: book it and trip health."""
+        try:
+            from ..observability.telemetry import get_telemetry
+            tel = get_telemetry()
+            tel.ensure_compile_watch()
+            tel.add_compile_listener(self._on_compile_event)
+        except Exception:
+            logger.exception("serve compile sentinel not armed")
+        self._warmed = True
+
+    def _on_compile_event(self, name: str, signature: str = "") -> None:
+        if not self._warmed or _AOT_BUILD_DEPTH > 0:
+            return
+        self.unexpected_compiles += 1
+        logger.warning(
+            "unexpected request-path compile: %s — the serve ladder "
+            "should cover every shape; /healthz now degraded", name)
+        try:
+            from ..observability.metrics import get_registry
+            from ..observability.telemetry import get_telemetry
+            if get_telemetry().enabled:
+                get_registry().counter(
+                    "pt_serve_unexpected_compiles_total",
+                    "Compiles observed after serve warmup (SLO alarm)",
+                    labelnames=("fn",)).inc(fn=name)
+        except Exception:
+            pass
+
+    def close(self) -> None:
+        try:
+            from ..observability.telemetry import get_telemetry
+            get_telemetry().remove_compile_listener(self._on_compile_event)
+        except Exception:
+            pass
+
+    # -- request path (numpy + compiled executables ONLY) -------------------
+
+    def prefill_bucket_for(self, n: int) -> int:
+        for s in self.config.prefill_buckets:
+            if n <= s:
+                return s
+        raise ValueError(
+            f"prompt length {n} exceeds largest prefill bucket "
+            f"{self.config.prefill_buckets[-1]}")
+
+    def decode_bucket_for(self, n: int) -> int:
+        for b in self.config.decode_buckets:
+            if n <= b:
+                return b
+        raise ValueError(
+            f"{n} active sequences exceed largest decode bucket "
+            f"{self.config.decode_buckets[-1]}")
+
+    def prefill(self, tokens: Sequence[int],
+                page_table: np.ndarray) -> int:
+        """Run one prompt; returns the first generated token."""
+        n = len(tokens)
+        s = self.prefill_bucket_for(n)
+        padded = np.zeros((s,), np.int32)
+        padded[:n] = np.asarray(tokens, np.int32)
+        with self._weights_lock:
+            params = self._params
+        k2, v2, nxt, _ = self._prefill_exe[s](
+            params, self.pool.k_flat, self.pool.v_flat,
+            padded, np.int32(n), np.asarray(page_table, np.int32))
+        self.pool.swap(k2, v2)
+        return int(nxt)
+
+    def decode(self, tokens: np.ndarray, positions: np.ndarray,
+               page_tables: np.ndarray) -> np.ndarray:
+        """One decode step over ``n`` active rows, padded to a bucket.
+
+        Padding rows carry position 0 + the all-null page table, so
+        their (garbage) K/V writes land in the null page.
+        """
+        n = tokens.shape[0]
+        b = self.decode_bucket_for(max(n, 1))
+        maxp = self.max_pages_per_seq
+        tok = np.zeros((b,), np.int32)
+        pos = np.zeros((b,), np.int32)
+        pt = np.full((b, maxp), NULL_PAGE, np.int32)
+        tok[:n] = tokens
+        pos[:n] = positions
+        pt[:n] = page_tables
+        with self._weights_lock:
+            params = self._params
+        k2, v2, nxt, _ = self._decode_exe[b](
+            params, self.pool.k_flat, self.pool.v_flat, tok, pos, pt)
+        self.pool.swap(k2, v2)
+        return np.asarray(nxt)[:n]
+
+    # -- weights ------------------------------------------------------------
+
+    @property
+    def weights_step(self) -> Optional[int]:
+        return self._weights_step
+
+    def install_weights(self, params, step: Optional[int] = None) -> None:
+        """Hot-swap to a new weight generation between steps.
+
+        Same treedef/shapes required — the executables' signature is
+        structural, so matching weights swap with zero compiles.
+        """
+        old = jax.tree_util.tree_structure(self._params)
+        new = jax.tree_util.tree_structure(params)
+        if old != new:
+            raise ValueError("weight swap changes the parameter tree "
+                             f"({new} vs {old})")
+        for (_, a), (_, b) in zip(
+                sorted(self._params.items()), sorted(params.items())):
+            if a.shape != b.shape:
+                raise ValueError(
+                    f"weight swap changes a shape: {b.shape} vs {a.shape}")
+        dev = _to_serve_device(params)
+        with self._weights_lock:
+            self._params = dev
+            self._weights_step = step
+        logger.info("weights swapped to generation step=%s", step)
+
+    def maybe_reload(self) -> Optional[int]:
+        """Swap in a newer checkpoint generation if one exists
+        (zero-downtime: serving N while loading N+1)."""
+        mgr = self.checkpoint_manager
+        if mgr is None:
+            return None
+        latest = mgr.latest_step()
+        if latest is None or latest == self._weights_step:
+            return None
+        state, step = mgr.restore_latest(template=self._params)
+        if step is None:
+            return None
+        self.install_weights(state, step)
+        return step
+
+    # -- convenience / health ----------------------------------------------
+
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 max_new_tokens: Optional[int] = None) -> List[List[int]]:
+        """Synchronous batch generate through the continuous-batching
+        scheduler (submits all, drains the loop)."""
+        streams = [self.scheduler.submit(p, max_new_tokens=max_new_tokens)
+                   for p in prompts]
+        self.scheduler.drain()
+        return [st.result() for st in streams]
+
+    def healthz(self) -> Dict[str, Any]:
+        sched = getattr(self, "scheduler", None)
+        h = {
+            "ok": self.unexpected_compiles == 0,
+            "unexpected_compiles": self.unexpected_compiles,
+            "compiled_programs": self.compiled_programs,
+            "decode_buckets": list(self.config.decode_buckets),
+            "prefill_buckets": list(self.config.prefill_buckets),
+            "weights_step": self._weights_step,
+            "kv": self.pool.snapshot(),
+        }
+        if sched is not None:
+            h.update(sched.snapshot())
+        return h
+
+
+# -- served-model directory format ------------------------------------------
+
+def save_served_model(path: str, spec: ModelSpec, params,
+                      config: Optional[ServeConfig] = None,
+                      step: int = 0) -> str:
+    """Write a self-describing served-model dir:
+    ``serve_config.json`` (architecture + serve shapes) plus a
+    CheckpointManager weight tree — the unit `Predictor` and
+    :func:`load_engine` consume, and the unit the trainer republishes
+    for zero-downtime swaps."""
+    from ..distributed.checkpoint_manager import CheckpointManager
+    os.makedirs(path, exist_ok=True)
+    cfg = config or ServeConfig.from_env()
+    with open(os.path.join(path, SERVE_CONFIG_NAME), "w") as f:
+        json.dump({"model": spec.to_dict(), "serve": cfg.to_dict()},
+                  f, indent=2, sort_keys=True)
+    mgr = CheckpointManager(os.path.join(path, "weights"))
+    mgr.save(step, dict(params), block=True)
+    return path
+
+
+def is_served_model_dir(path: str) -> bool:
+    return os.path.isdir(path) and \
+        os.path.exists(os.path.join(path, SERVE_CONFIG_NAME))
+
+
+def load_engine(path: str, config: Optional[ServeConfig] = None,
+                **config_overrides) -> ServingEngine:
+    """Build a :class:`ServingEngine` from a served-model dir.
+
+    Config precedence: explicit ``config`` arg > env overrides >
+    ``serve_config.json`` on disk.
+    """
+    from ..distributed.checkpoint_manager import CheckpointManager
+    with open(os.path.join(path, SERVE_CONFIG_NAME)) as f:
+        meta = json.load(f)
+    spec = ModelSpec.from_dict(meta.get("model", {}))
+    if config is None:
+        file_cfg = ServeConfig.from_dict(meta.get("serve", {}))
+        env_kw = {}
+        for fname, env in (
+                ("decode_buckets", "PT_SERVE_BUCKETS"),
+                ("prefill_buckets", "PT_SERVE_PREFILL_BUCKETS"),
+                ("kv_pages", "PT_SERVE_KV_PAGES"),
+                ("page_size", "PT_SERVE_PAGE_SIZE"),
+                ("max_inflight", "PT_SERVE_MAX_INFLIGHT"),
+                ("max_new_tokens", "PT_SERVE_MAX_NEW_TOKENS"),
+                ("eos_id", "PT_SERVE_EOS_ID")):
+            if os.environ.get(env):
+                env_kw[fname] = getattr(ServeConfig.from_env(), fname)
+        config = file_cfg.replace(**env_kw) if env_kw else file_cfg
+    if config_overrides:
+        config = config.replace(**config_overrides)
+    mgr = CheckpointManager(os.path.join(path, "weights"))
+    template = init_params(spec, seed=0)
+    params, step = mgr.restore_latest(template=template)
+    if step is None:
+        raise FileNotFoundError(
+            f"no valid weight checkpoint under {path}/weights")
+    return ServingEngine(spec, params, config,
+                         checkpoint_manager=mgr, weights_step=step)

@@ -1,0 +1,219 @@
+"""Stdlib HTTP front end for the serving engine.
+
+Follows the :mod:`paddle_tpu.observability.server` shape (daemon
+``ThreadingHTTPServer``, ephemeral ``port=0`` default, no socket bound
+at import) and adds the serve surface:
+
+ - ``GET  /healthz``      engine + scheduler health; **503 once the
+                          zero-compile sentinel has tripped** (any
+                          request-path compile) — the SLO alarm
+ - ``GET  /metrics``      Prometheus exposition of the registry
+ - ``POST /v1/generate``  ``{"tokens": [...], "max_new_tokens": N}`` →
+                          ``{"tokens": [...], ...}``; 429 on
+                          saturation, 400 on bad input
+ - ``POST /v1/reload``    swap to the newest checkpoint generation
+                          (zero-downtime weight swap); also runs on a
+                          background poll when ``reload_interval`` is
+                          set
+
+Handler threads only ever submit numpy work to the scheduler and wait;
+all device interaction happens on the scheduler's step loop.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Optional
+
+logger = logging.getLogger("paddle_tpu.serving")
+
+__all__ = ["ServeHTTPServer"]
+
+_CTYPE_JSON = "application/json"
+_CTYPE_METRICS = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class ServeHTTPServer:
+    def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
+                 request_timeout: float = 120.0,
+                 reload_interval: Optional[float] = None):
+        self.engine = engine
+        self._host = host
+        self._requested_port = int(port)
+        self._request_timeout = request_timeout
+        self._reload_interval = reload_interval
+        self._httpd = None
+        self._thread = None
+        self._reload_thread = None
+        self._stop = threading.Event()
+        self.port = None
+
+    @property
+    def host(self) -> str:
+        return self._host
+
+    def start(self) -> "ServeHTTPServer":
+        """Bind + serve on daemon threads; starts the scheduler loop.
+        Idempotent."""
+        if self._httpd is not None:
+            return self
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        engine = self.engine
+        timeout = self._request_timeout
+        engine.scheduler.start()
+
+        class _Handler(BaseHTTPRequestHandler):
+            def _send(self, code, ctype, body):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _send_json(self, code, obj):
+                self._send(code, _CTYPE_JSON,
+                           (json.dumps(obj) + "\n").encode())
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/metrics":
+                        from ..observability.metrics import get_registry
+                        self._send(200, _CTYPE_METRICS,
+                                   get_registry().prometheus_text()
+                                   .encode("utf-8"))
+                    elif path == "/healthz":
+                        health = engine.healthz()
+                        self._send_json(200 if health.get("ok") else 503,
+                                        health)
+                    else:
+                        self._send(404, "text/plain; charset=utf-8",
+                                   b"not found; try /healthz /metrics "
+                                   b"/v1/generate\n")
+                except Exception as e:
+                    logger.warning("serve endpoint error on %s: %s",
+                                   path, e)
+                    try:
+                        self._send_json(500, {"error": str(e)})
+                    except OSError:
+                        pass
+
+            def do_POST(self):
+                path = self.path.split("?", 1)[0]
+                try:
+                    n = int(self.headers.get("Content-Length") or 0)
+                    raw = self.rfile.read(n) if n else b"{}"
+                    if path == "/v1/generate":
+                        self._generate(raw)
+                    elif path == "/v1/reload":
+                        step = engine.maybe_reload()
+                        self._send_json(200, {
+                            "reloaded": step is not None,
+                            "weights_step": engine.weights_step})
+                    else:
+                        self._send_json(404, {"error": "unknown route"})
+                except Exception as e:
+                    logger.warning("serve endpoint error on %s: %s",
+                                   path, e)
+                    try:
+                        self._send_json(500, {"error": str(e)})
+                    except OSError:
+                        pass
+
+            def _generate(self, raw):
+                from .scheduler import EngineSaturated
+                t0 = time.monotonic()
+                try:
+                    body = json.loads(raw.decode("utf-8"))
+                    tokens = body["tokens"]
+                    max_new = body.get("max_new_tokens")
+                except (ValueError, KeyError, TypeError) as e:
+                    self._send_json(400, {"error": f"bad request: {e}"})
+                    return
+                try:
+                    stream = engine.scheduler.submit(
+                        tokens, max_new_tokens=max_new)
+                except EngineSaturated as e:
+                    self._send_json(429, {"error": str(e)})
+                    return
+                except ValueError as e:
+                    self._send_json(400, {"error": str(e)})
+                    return
+                try:
+                    out = stream.result(timeout=timeout)
+                except TimeoutError as e:
+                    self._send_json(504, {"error": str(e)})
+                    return
+                wall = time.monotonic() - t0
+                _book_http_latency(wall)
+                self._send_json(200, {
+                    "tokens": [int(t) for t in out],
+                    "request_id": stream.request_id,
+                    "latency_ms": wall * 1e3,
+                    "weights_step": engine.weights_step,
+                })
+
+            def log_message(self, fmt, *args):
+                logger.debug("serve-http: " + fmt, *args)
+
+        self._httpd = ThreadingHTTPServer(
+            (self._host, self._requested_port), _Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="pt-serve-http",
+            daemon=True)
+        self._thread.start()
+        if self._reload_interval:
+            self._stop.clear()
+            self._reload_thread = threading.Thread(
+                target=self._reload_loop, name="pt-serve-reload",
+                daemon=True)
+            self._reload_thread.start()
+        logger.info("serve endpoint on http://%s:%d (/v1/generate, "
+                    "/healthz, /metrics)", self._host, self.port)
+        return self
+
+    def _reload_loop(self):
+        """Poll the checkpoint root and hot-swap newer generations —
+        serving N while loading N+1."""
+        while not self._stop.wait(self._reload_interval):
+            try:
+                step = self.engine.maybe_reload()
+                if step is not None:
+                    logger.info("background weight swap -> step %s", step)
+            except Exception:
+                logger.exception("background weight reload failed")
+
+    def stop(self):
+        self._stop.set()
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if self._reload_thread is not None:
+            self._reload_thread.join(timeout=5.0)
+            self._reload_thread = None
+        self.engine.scheduler.stop()
+        self.port = None
+
+
+def _book_http_latency(seconds: float) -> None:
+    """HTTP-level wall latency (includes queueing); inert while
+    telemetry is off."""
+    try:
+        from ..observability.metrics import get_registry
+        from ..observability.telemetry import get_telemetry
+        if not get_telemetry().enabled:
+            return
+        get_registry().histogram(
+            "pt_serve_http_request_seconds",
+            "Wall time of /v1/generate requests").observe(seconds)
+    except Exception:
+        pass

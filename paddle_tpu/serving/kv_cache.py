@@ -1,0 +1,242 @@
+"""Paged KV-cache: a block pool of fixed-size pages with free-list reuse.
+
+The pool owns the device arrays the decode/prefill programs donate and
+rebind each step (``k_flat``/``v_flat``, shape ``(L, P*ps, H, D)``), a
+host-side free list of page ids, and a *reservation* ledger used for
+admission control: the scheduler reserves a sequence's worst-case page
+count (prompt + max_new_tokens) before prefill so a sequence admitted
+into the batch can never stall mid-decode waiting for a page.
+
+Page 0 is reserved as the **null page**: padding rows of a batch
+bucket and the unused tail of every page table point at it, so the
+programs' scatter/gather of padding lanes touch real (never-read)
+storage instead of needing per-lane predication.
+
+Observability rides the PR 14 rails: when telemetry is on, occupancy
+gauges (``pt_serve_kv_pages{state=used|free|reserved}``) are updated on
+every alloc/free, and the pool registers a live-buffer attribution
+provider so the memory census names the pools ``kv::k_pages`` /
+``kv::v_pages``.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["PagePool", "KVPoolExhausted", "NULL_PAGE"]
+
+NULL_PAGE = 0
+
+
+class KVPoolExhausted(RuntimeError):
+    """Raised when an alloc/reserve exceeds pool headroom."""
+
+
+class PagePool:
+    """Block-pool allocator over the serve KV arrays.
+
+    Thread-safety: all bookkeeping is lock-guarded; the device arrays
+    themselves are only rebound from the engine's step loop.
+    """
+
+    def __init__(self, *, layers: int, pages: int, page_size: int,
+                 heads: int, head_dim: int, dtype=jnp.float32):
+        if pages < 2:
+            raise ValueError("pages must be >= 2 (page 0 is the null page)")
+        self.layers = layers
+        self.pages = pages
+        self.page_size = page_size
+        self.heads = heads
+        self.head_dim = head_dim
+        self.dtype = dtype
+        shape = (layers, pages * page_size, heads, head_dim)
+        self.k_flat = jnp.zeros(shape, dtype)
+        self.v_flat = jnp.zeros(shape, dtype)
+        self._lock = threading.Lock()
+        # LIFO free list: hot pages get reused while still cache/HBM warm
+        self._free: List[int] = list(range(pages - 1, 0, -1))
+        self._reserved = 0
+        self.stats = {
+            "allocs": 0, "frees": 0, "alloc_failures": 0,
+            "reserve_refusals": 0, "high_watermark": 0,
+        }
+        self._register_memory_provider()
+
+    # -- capacity ----------------------------------------------------------
+
+    @property
+    def usable_pages(self) -> int:
+        return self.pages - 1  # minus the null page
+
+    def pages_needed(self, tokens: int) -> int:
+        return max(1, -(-int(tokens) // self.page_size))
+
+    @property
+    def free_pages(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        with self._lock:
+            return self.usable_pages - len(self._free)
+
+    @property
+    def reserved_pages(self) -> int:
+        with self._lock:
+            return self._reserved
+
+    def headroom(self) -> int:
+        """Pages available to NEW admissions (free minus already promised)."""
+        with self._lock:
+            return len(self._free) - self._reserved
+
+    # -- admission-control reservations ------------------------------------
+
+    def can_admit(self, n_pages: int) -> bool:
+        return self.headroom() >= n_pages
+
+    def reserve(self, n_pages: int) -> None:
+        """Promise ``n_pages`` to a sequence about to be admitted."""
+        with self._lock:
+            if len(self._free) - self._reserved < n_pages:
+                self.stats["reserve_refusals"] += 1
+                raise KVPoolExhausted(
+                    f"reserve({n_pages}): only "
+                    f"{len(self._free) - self._reserved} unreserved pages")
+            self._reserved += n_pages
+        self._gauges()
+
+    def release_reservation(self, n_pages: int) -> None:
+        """Return unused promised pages (sequence finished early)."""
+        with self._lock:
+            self._reserved = max(0, self._reserved - n_pages)
+        self._gauges()
+
+    # -- alloc / free -------------------------------------------------------
+
+    def alloc(self, n_pages: int = 1, *, reserved: bool = False) -> List[int]:
+        """Pop ``n_pages`` page ids off the free list.
+
+        ``reserved=True`` draws down a prior :meth:`reserve` promise
+        (the scheduler's path); an unreserved alloc can fail even when
+        pages are free if they are all promised elsewhere.
+        """
+        with self._lock:
+            avail = len(self._free) if reserved \
+                else len(self._free) - self._reserved
+            if avail < n_pages:
+                self.stats["alloc_failures"] += 1
+                raise KVPoolExhausted(
+                    f"alloc({n_pages}): {avail} pages available")
+            ids = [self._free.pop() for _ in range(n_pages)]
+            if reserved:
+                self._reserved = max(0, self._reserved - n_pages)
+            self.stats["allocs"] += n_pages
+            used = self.usable_pages - len(self._free)
+            self.stats["high_watermark"] = max(
+                self.stats["high_watermark"], used)
+        self._gauges()
+        return ids
+
+    def free(self, page_ids: Sequence[int]) -> None:
+        """Return a retired sequence's pages to the free list."""
+        with self._lock:
+            for pid in page_ids:
+                if pid == NULL_PAGE:
+                    raise ValueError("cannot free the null page")
+                if not (0 < pid < self.pages):
+                    raise ValueError(f"page id {pid} out of range")
+                if pid in self._free:
+                    raise ValueError(f"double free of page {pid}")
+                self._free.append(pid)
+            self.stats["frees"] += len(page_ids)
+        self._gauges()
+
+    def check_consistency(self) -> None:
+        """Invariant check used by tests: no duplicate/lost pages."""
+        with self._lock:
+            assert len(set(self._free)) == len(self._free), "dup free ids"
+            assert all(0 < p < self.pages for p in self._free)
+            assert 0 <= self._reserved <= len(self._free), \
+                f"reserved {self._reserved} > free {len(self._free)}"
+
+    # -- device state -------------------------------------------------------
+
+    def swap(self, k_flat, v_flat) -> None:
+        """Rebind the pools to a program's donated outputs."""
+        self.k_flat = k_flat
+        self.v_flat = v_flat
+
+    def utilization(self) -> float:
+        with self._lock:
+            return (self.usable_pages - len(self._free)) / \
+                max(1, self.usable_pages)
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            free = len(self._free)
+            return {
+                "pages": self.pages,
+                "usable_pages": self.usable_pages,
+                "free_pages": free,
+                "used_pages": self.usable_pages - free,
+                "reserved_pages": self._reserved,
+                "utilization": (self.usable_pages - free) /
+                max(1, self.usable_pages),
+                **self.stats,
+            }
+
+    # -- observability ------------------------------------------------------
+
+    def _gauges(self) -> None:
+        """Occupancy gauges; inert while telemetry is off (registry must
+        stay empty then — the record_dispatch contract)."""
+        try:
+            from ..observability.metrics import get_registry
+            from ..observability.telemetry import get_telemetry
+            if not get_telemetry().enabled:
+                return
+            with self._lock:
+                free = len(self._free)
+                reserved = self._reserved
+            g = get_registry().gauge(
+                "pt_serve_kv_pages",
+                "Serve KV page-pool occupancy by state",
+                labelnames=("state",))
+            g.set(self.usable_pages - free, state="used")
+            g.set(free, state="free")
+            g.set(reserved, state="reserved")
+            get_registry().gauge(
+                "pt_serve_kv_utilization",
+                "Fraction of usable KV pages in use").set(
+                (self.usable_pages - free) / max(1, self.usable_pages))
+        except Exception:
+            pass
+
+    def _register_memory_provider(self) -> None:
+        try:
+            from ..observability import memory as _memory
+            mon = _memory.get_memory_monitor()
+            if mon.enabled:
+                mon.register_provider(self._memory_named)
+        except Exception:
+            pass
+
+    def _memory_named(self):
+        """Live-buffer attribution for the PR 14 census: the two pools
+        under ``kv::`` paths."""
+        return {"kv::k_pages": self.k_flat, "kv::v_pages": self.v_flat}
+
+    def null_padded_table(self, page_ids: Sequence[int],
+                          max_pages: int) -> np.ndarray:
+        """Host-side page table row: ids then null-page padding."""
+        if len(page_ids) > max_pages:
+            raise ValueError(
+                f"{len(page_ids)} pages exceed table width {max_pages}")
+        row = np.full((max_pages,), NULL_PAGE, np.int32)
+        row[:len(page_ids)] = np.asarray(page_ids, np.int32)
+        return row

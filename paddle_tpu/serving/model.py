@@ -1,0 +1,220 @@
+"""Serve-side decoder model: pure functions over a paged KV-cache.
+
+The engine AOT-compiles two program families over these functions
+(:mod:`.engine`):
+
+ - ``prefill`` — one sequence, one padded seq-bucket: run the prompt
+   through the stack with a causal+length mask, scatter the prompt's
+   K/V into the sequence's pages, emit the first generated token.
+ - ``decode`` — one padded batch-bucket: one new token per row,
+   append its K/V at the row's write slot, attend over the row's page
+   list via :func:`paddle_tpu.ops.paged_attention.paged_attention`.
+
+Everything is shaped by :class:`ModelSpec`, a plain dataclass that
+round-trips through ``serve_config.json`` so a served model dir is
+self-describing (the `paddle/fluid/inference` saved-model contract).
+
+Determinism contract (load-bearing for continuous batching): decode
+math is strictly row-independent — same weights + same per-row state
+produce bit-identical logits regardless of batch composition or
+physical page placement.  The one XLA exception is batch=1, which hits
+a gemv path with a different reduction order; the engine therefore
+clamps its decode bucket ladder to >= 2 rows (see
+``ServeConfig._normalize``), and tests pin the bit-identity claim.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.paged_attention import paged_attention
+
+__all__ = ["ModelSpec", "init_params", "prefill_step", "decode_step"]
+
+_LN_EPS = 1e-5
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    """Architecture hyperparameters of a served decoder."""
+
+    vocab_size: int = 256
+    hidden: int = 64
+    layers: int = 2
+    heads: int = 4
+    max_seq_len: int = 256
+    ffn_mult: int = 4
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.heads
+
+    def __post_init__(self):
+        if self.hidden % self.heads:
+            raise ValueError(
+                f"hidden={self.hidden} not divisible by heads={self.heads}")
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ModelSpec":
+        names = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: int(v) for k, v in d.items() if k in names})
+
+
+def init_params(spec: ModelSpec, seed: int = 0) -> Dict[str, jnp.ndarray]:
+    """Flat ``path -> array`` dict (checkpoint-manager friendly)."""
+    rng = jax.random.PRNGKey(seed)
+    p: Dict[str, jnp.ndarray] = {}
+
+    def _w(key, shape, scale=0.02):
+        return (jax.random.normal(key, shape, jnp.float32) * scale)
+
+    keys = jax.random.split(rng, 2 + spec.layers * 6)
+    p["embed"] = _w(keys[0], (spec.vocab_size, spec.hidden))
+    p["pos"] = _w(keys[1], (spec.max_seq_len, spec.hidden))
+    for i in range(spec.layers):
+        k = keys[2 + i * 6: 8 + i * 6]
+        ffn = spec.hidden * spec.ffn_mult
+        p[f"h{i}.ln1.w"] = jnp.ones((spec.hidden,), jnp.float32)
+        p[f"h{i}.ln1.b"] = jnp.zeros((spec.hidden,), jnp.float32)
+        p[f"h{i}.attn.wq"] = _w(k[0], (spec.hidden, spec.hidden))
+        p[f"h{i}.attn.wk"] = _w(k[1], (spec.hidden, spec.hidden))
+        p[f"h{i}.attn.wv"] = _w(k[2], (spec.hidden, spec.hidden))
+        p[f"h{i}.attn.wo"] = _w(k[3], (spec.hidden, spec.hidden))
+        p[f"h{i}.ln2.w"] = jnp.ones((spec.hidden,), jnp.float32)
+        p[f"h{i}.ln2.b"] = jnp.zeros((spec.hidden,), jnp.float32)
+        p[f"h{i}.mlp.w1"] = _w(k[4], (spec.hidden, ffn))
+        p[f"h{i}.mlp.b1"] = jnp.zeros((ffn,), jnp.float32)
+        p[f"h{i}.mlp.w2"] = _w(k[5], (ffn, spec.hidden))
+        p[f"h{i}.mlp.b2"] = jnp.zeros((spec.hidden,), jnp.float32)
+    p["lnf.w"] = jnp.ones((spec.hidden,), jnp.float32)
+    p["lnf.b"] = jnp.zeros((spec.hidden,), jnp.float32)
+    return p
+
+
+def _ln(x, w, b):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
+    return (x32 - mu) * jax.lax.rsqrt(var + _LN_EPS) * w + b
+
+
+def _mlp(spec, params, i, x):
+    h = x @ params[f"h{i}.mlp.w1"] + params[f"h{i}.mlp.b1"]
+    h = jax.nn.gelu(h)
+    return h @ params[f"h{i}.mlp.w2"] + params[f"h{i}.mlp.b2"]
+
+
+def _flat_dest(page_table, positions, page_size):
+    """Flat pool row for each position via its page table.
+
+    ``page_table`` rows hold page ids; position ``t`` lives at flat
+    index ``pt[t // ps] * ps + t % ps``.  Works batched (page_table
+    (B, maxp), positions (B,)) and single (maxp,)/(S,).
+    """
+    page = jnp.take_along_axis(
+        page_table, (positions // page_size)[..., None], axis=-1)[..., 0] \
+        if page_table.ndim == 2 else page_table[positions // page_size]
+    return page * page_size + positions % page_size
+
+
+def prefill_step(spec: ModelSpec, params, k_flat, v_flat,
+                 tokens, length, page_table, *, page_size: int
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Run one prompt (padded to a seq bucket) and seed its KV pages.
+
+    Args:
+      k_flat/v_flat: donated pools ``(L, P*ps, H, D)``.
+      tokens: ``(S,)`` int32, padded prompt (bucket size S).
+      length: scalar int32, true prompt length (1 <= length <= S).
+      page_table: ``(max_pages,)`` int32 pages owned by this sequence
+        (unused tail = 0, the reserved null page).
+      page_size: static tokens-per-page (trace-time constant).
+
+    Returns ``(k_flat, v_flat, next_token, logits)`` where
+    ``next_token`` is the greedy token following position length-1.
+    """
+    s = tokens.shape[0]
+    h = params["embed"][tokens] + params["pos"][:s]
+    pos_ids = jnp.arange(s, dtype=jnp.int32)
+    # causal AND inside the true prompt: key j visible to query i iff
+    # j <= i and j < length
+    mask = (pos_ids[None, :] <= pos_ids[:, None]) & (pos_ids[None, :] < length)
+    scale = 1.0 / math.sqrt(spec.head_dim)
+    ks, vs = [], []
+    for i in range(spec.layers):
+        x = _ln(h, params[f"h{i}.ln1.w"], params[f"h{i}.ln1.b"])
+        q = (x @ params[f"h{i}.attn.wq"]).reshape(s, spec.heads, spec.head_dim)
+        k = (x @ params[f"h{i}.attn.wk"]).reshape(s, spec.heads, spec.head_dim)
+        v = (x @ params[f"h{i}.attn.wv"]).reshape(s, spec.heads, spec.head_dim)
+        att = jnp.einsum("ihd,jhd->hij", q, k) * scale
+        att = jnp.where(mask[None, :, :], att, -1e30)
+        w = jax.nn.softmax(att, axis=-1)
+        o = jnp.einsum("hij,jhd->ihd", w, v).reshape(s, spec.hidden)
+        h = h + o @ params[f"h{i}.attn.wo"]
+        x2 = _ln(h, params[f"h{i}.ln2.w"], params[f"h{i}.ln2.b"])
+        h = h + _mlp(spec, params, i, x2)
+        ks.append(k)
+        vs.append(v)
+    hf = _ln(h, params["lnf.w"], params["lnf.b"])
+    logits_all = hf @ params["embed"].T                    # (S, V)
+    logits = jnp.take(logits_all, length - 1, axis=0)      # (V,)
+    next_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    # scatter prompt K/V into this sequence's pages; padding rows are
+    # routed to flat row 0 (inside the reserved null page, never read
+    # unmasked)
+    dest = jnp.where(pos_ids < length,
+                     _flat_dest(page_table, pos_ids, page_size), 0)
+    k_stack = jnp.stack(ks)                                # (L, S, H, D)
+    v_stack = jnp.stack(vs)
+    k_flat = k_flat.at[:, dest].set(k_stack.astype(k_flat.dtype))
+    v_flat = v_flat.at[:, dest].set(v_stack.astype(v_flat.dtype))
+    return k_flat, v_flat, next_token, logits
+
+
+def decode_step(spec: ModelSpec, params, k_flat, v_flat,
+                tokens, positions, page_tables, *, page_size: int
+                ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One decode step for a padded batch bucket.
+
+    Args:
+      k_flat/v_flat: donated pools ``(L, P*ps, H, D)``.
+      tokens: ``(B,)`` int32 current token per row.
+      positions: ``(B,)`` int32 position of that token (0-based);
+        padding rows point at position 0 with page_table row 0 so
+        their writes land in the null page.
+      page_tables: ``(B, max_pages)`` int32.
+      page_size: static tokens-per-page (trace-time constant).
+
+    Returns ``(k_flat, v_flat, next_tokens, logits)``.
+    """
+    b = tokens.shape[0]
+    num_pages = k_flat.shape[1] // page_size
+    dest = _flat_dest(page_tables, positions, page_size)   # (B,)
+    lengths = positions + 1
+    h = params["embed"][tokens] + params["pos"][positions]
+    for i in range(spec.layers):
+        x = _ln(h, params[f"h{i}.ln1.w"], params[f"h{i}.ln1.b"])
+        q = (x @ params[f"h{i}.attn.wq"]).reshape(b, spec.heads, spec.head_dim)
+        k = (x @ params[f"h{i}.attn.wk"]).reshape(b, spec.heads, spec.head_dim)
+        v = (x @ params[f"h{i}.attn.wv"]).reshape(b, spec.heads, spec.head_dim)
+        k_flat = k_flat.at[i, dest].set(k.astype(k_flat.dtype))
+        v_flat = v_flat.at[i, dest].set(v.astype(v_flat.dtype))
+        k_pages = k_flat[i].reshape(num_pages, page_size,
+                                    spec.heads, spec.head_dim)
+        v_pages = v_flat[i].reshape(num_pages, page_size,
+                                    spec.heads, spec.head_dim)
+        o = paged_attention(q, k_pages, v_pages, page_tables, lengths)
+        h = h + o.reshape(b, spec.hidden) @ params[f"h{i}.attn.wo"]
+        x2 = _ln(h, params[f"h{i}.ln2.w"], params[f"h{i}.ln2.b"])
+        h = h + _mlp(spec, params, i, x2)
+    hf = _ln(h, params["lnf.w"], params["lnf.b"])
+    logits = hf @ params["embed"].T                        # (B, V)
+    next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return k_flat, v_flat, next_tokens, logits

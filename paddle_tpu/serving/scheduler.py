@@ -1,0 +1,374 @@
+"""Continuous (in-flight) batching over the AOT serve programs.
+
+One scheduler tick = one *step boundary*:
+
+ 1. **retire** sequences that finished last step (free pages, release
+    unused reservations, resolve the caller's stream),
+ 2. **admit** queued sequences while a decode slot AND worst-case KV
+    headroom exist — admission reserves ``ceil((prompt+max_new)/ps)``
+    pages up front so an admitted sequence can never stall mid-decode
+    waiting for a page (admission control against pool headroom),
+ 3. **decode** one token for every active row, padded to the smallest
+    compiled batch bucket.
+
+Sequences join and leave a *running* batch only at these boundaries,
+and the decode math is row-independent (see
+:mod:`paddle_tpu.serving.model`), so a sequence's tokens are
+bit-identical whether it decoded solo or wove through an ever-changing
+batch — the property the continuous-batching tests pin.
+
+The whole request path here is numpy + pre-compiled executables; a
+single stray jnp call would book an unexpected compile on the
+engine's sentinel (tpu-lint TPU019 polices this statically).
+"""
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .kv_cache import KVPoolExhausted
+
+logger = logging.getLogger("paddle_tpu.serving")
+
+__all__ = ["ContinuousScheduler", "GenerationStream", "EngineSaturated"]
+
+
+class EngineSaturated(RuntimeError):
+    """submit() refused: in-flight cap reached (caller should shed load
+    or retry with backoff — the HTTP front end maps this to 429)."""
+
+
+class GenerationStream:
+    """Future-like handle for one submitted request."""
+
+    _ids = itertools.count()
+
+    def __init__(self, prompt: List[int], max_new_tokens: int):
+        self.request_id = next(self._ids)
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.tokens: List[int] = []
+        self.submitted_ts = time.monotonic()
+        self.finished_ts: Optional[float] = None
+        self._done = threading.Event()
+        self._error: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request_id} not finished in {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self.tokens
+
+    @property
+    def latency(self) -> Optional[float]:
+        if self.finished_ts is None:
+            return None
+        return self.finished_ts - self.submitted_ts
+
+    def _finish(self, error: Optional[BaseException] = None) -> None:
+        self.finished_ts = time.monotonic()
+        self._error = error
+        self._done.set()
+
+
+class _Active:
+    """Per-sequence decode state while resident in the batch."""
+
+    __slots__ = ("stream", "page_ids", "page_table", "pos", "last_token",
+                 "reserved_left")
+
+    def __init__(self, stream, page_ids, page_table, pos, last_token,
+                 reserved_left):
+        self.stream = stream
+        self.page_ids = page_ids        # owned pages, in position order
+        self.page_table = page_table    # np (max_pages,) int32
+        self.pos = pos                  # position last_token will occupy
+        self.last_token = last_token
+        self.reserved_left = reserved_left
+
+
+class ContinuousScheduler:
+    """Admission + step loop; owns the queue and the active batch."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._queue: deque = deque()
+        self._active: List[_Active] = []
+        self._lock = threading.RLock()
+        self._cv = threading.Condition(self._lock)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.stats = {
+            "submitted": 0, "completed": 0, "refused_inflight": 0,
+            "refused_kv": 0, "steps": 0, "tokens_generated": 0,
+            "occupancy_sum": 0.0, "occupancy_steps": 0,
+            "peak_active": 0,
+        }
+
+    # -- submission ----------------------------------------------------------
+
+    def submit(self, prompt: Sequence[int],
+               max_new_tokens: Optional[int] = None) -> GenerationStream:
+        cfg = self.engine.config
+        spec = self.engine.spec
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if any(t < 0 or t >= spec.vocab_size for t in prompt):
+            raise ValueError("prompt token out of vocab range")
+        self.engine.prefill_bucket_for(len(prompt))  # raises if too long
+        max_new = int(max_new_tokens if max_new_tokens is not None
+                      else cfg.max_new_tokens)
+        max_new = max(1, min(max_new, spec.max_seq_len - len(prompt)))
+        with self._cv:
+            inflight = len(self._queue) + len(self._active)
+            if inflight >= cfg.max_inflight:
+                self.stats["refused_inflight"] += 1
+                self._book("pt_serve_admission_refusals_total",
+                           kind="counter", reason="inflight_cap")
+                raise EngineSaturated(
+                    f"{inflight} requests in flight (cap "
+                    f"{cfg.max_inflight})")
+            st = GenerationStream(prompt, max_new)
+            self._queue.append(st)
+            self.stats["submitted"] += 1
+            self._book("pt_serve_requests_total", kind="counter")
+            self._gauges_locked()
+            self._cv.notify()
+        return st
+
+    # -- the step loop -------------------------------------------------------
+
+    def step(self) -> bool:
+        """One step boundary: retire / admit / decode.  Returns whether
+        any work was done."""
+        with self._lock:
+            self._admit_locked()
+            worked = self._decode_locked()
+            self.stats["steps"] += 1 if worked else 0
+            self._gauges_locked()
+            return worked or bool(self._queue)
+
+    def _admit_locked(self) -> None:
+        pool = self.engine.pool
+        max_batch = self.engine.config.decode_buckets[-1]
+        while self._queue and len(self._active) < max_batch:
+            st = self._queue[0]
+            worst_case = pool.pages_needed(len(st.prompt) + st.max_new_tokens)
+            if not pool.can_admit(worst_case):
+                # head-of-line blocking is deliberate: skipping ahead
+                # would starve large requests under sustained load
+                self.stats["refused_kv"] += 1
+                self._book("pt_serve_admission_refusals_total",
+                           kind="counter", reason="kv_headroom")
+                break
+            self._queue.popleft()
+            try:
+                pool.reserve(worst_case)
+            except KVPoolExhausted:
+                self.stats["refused_kv"] += 1
+                self._queue.appendleft(st)
+                break
+            prompt_pages = pool.pages_needed(len(st.prompt))
+            page_ids = pool.alloc(prompt_pages, reserved=True)
+            reserved_left = worst_case - prompt_pages
+            page_table = pool.null_padded_table(
+                page_ids, self.engine.max_pages_per_seq)
+            try:
+                first = self.engine.prefill(st.prompt, page_table)
+            except Exception as exc:  # resolve the caller, keep serving
+                pool.free(page_ids)
+                pool.release_reservation(reserved_left)
+                st._finish(error=exc)
+                logger.exception("prefill failed for request %d",
+                                 st.request_id)
+                continue
+            st.tokens.append(first)
+            self._book("pt_serve_tokens_total", kind="counter")
+            self.stats["tokens_generated"] += 1
+            act = _Active(st, page_ids, page_table, pos=len(st.prompt),
+                          last_token=first, reserved_left=reserved_left)
+            if self._is_finished(act):
+                self._retire_locked(act)
+            else:
+                self._active.append(act)
+                self.stats["peak_active"] = max(
+                    self.stats["peak_active"], len(self._active))
+
+    def _decode_locked(self) -> bool:
+        if not self._active:
+            return False
+        pool = self.engine.pool
+        ps = self.engine.config.page_size
+        # grow page tables for rows whose next write crosses a page
+        # boundary — drawn from the admission-time reservation, so this
+        # alloc cannot fail
+        for a in self._active:
+            need = a.pos // ps + 1
+            if need > len(a.page_ids):
+                new = pool.alloc(need - len(a.page_ids), reserved=True)
+                for pid in new:
+                    a.page_table[len(a.page_ids)] = pid
+                    a.page_ids.append(pid)
+                a.reserved_left -= len(new)
+        n = len(self._active)
+        tokens = np.asarray([a.last_token for a in self._active], np.int32)
+        positions = np.asarray([a.pos for a in self._active], np.int32)
+        tables = np.stack([a.page_table for a in self._active])
+        nxt = self.engine.decode(tokens, positions, tables)
+        bucket = self.engine.decode_bucket_for(n)
+        self.stats["occupancy_sum"] += n / bucket
+        self.stats["occupancy_steps"] += 1
+        self._book("pt_serve_batch_occupancy", kind="gauge",
+                   value=n / bucket)
+        still = []
+        for a, t in zip(self._active, nxt):
+            a.pos += 1
+            a.last_token = int(t)
+            a.stream.tokens.append(int(t))
+            self.stats["tokens_generated"] += 1
+            self._book("pt_serve_tokens_total", kind="counter")
+            if self._is_finished(a):
+                self._retire_locked(a)
+            else:
+                still.append(a)
+        self._active = still
+        return True
+
+    def _is_finished(self, a: _Active) -> bool:
+        st = a.stream
+        if len(st.tokens) >= st.max_new_tokens:
+            return True
+        eos = self.engine.config.eos_id
+        return eos >= 0 and a.last_token == eos
+
+    def _retire_locked(self, a: _Active) -> None:
+        pool = self.engine.pool
+        pool.free(a.page_ids)
+        if a.reserved_left:
+            pool.release_reservation(a.reserved_left)
+        a.stream._finish()
+        self.stats["completed"] += 1
+        lat = a.stream.latency
+        self._book("pt_serve_request_latency_seconds", kind="histogram",
+                   value=lat)
+        self._book("pt_serve_completed_total", kind="counter")
+
+    # -- loop management -----------------------------------------------------
+
+    def start(self) -> None:
+        """Run the step loop on a background thread (HTTP-serving mode)."""
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="pt-serve-scheduler", daemon=True)
+            self._thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            with self._cv:
+                while (not self._queue and not self._active
+                       and not self._stop.is_set()):
+                    self._cv.wait(0.05)
+            if self._stop.is_set():
+                return
+            try:
+                self.step()
+            except Exception:
+                logger.exception("scheduler step failed")
+                time.sleep(0.01)
+
+    def drain(self) -> None:
+        """Block until queue and batch are empty.  Steps inline when no
+        background loop is running (synchronous/generate mode)."""
+        if self._thread is not None and self._thread.is_alive():
+            while True:
+                with self._lock:
+                    if not self._queue and not self._active:
+                        return
+                time.sleep(0.002)
+        while True:
+            with self._lock:
+                if not self._queue and not self._active:
+                    return
+            self.step()
+
+    # -- observability -------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            occ = (self.stats["occupancy_sum"] /
+                   max(1, self.stats["occupancy_steps"]))
+            return {
+                "queue_depth": len(self._queue),
+                "active_sequences": len(self._active),
+                "batch_occupancy_mean": occ,
+                **{k: v for k, v in self.stats.items()
+                   if k not in ("occupancy_sum",)},
+            }
+
+    def _gauges_locked(self) -> None:
+        self._book("pt_serve_queue_depth", kind="gauge",
+                   value=len(self._queue))
+        self._book("pt_serve_active_sequences", kind="gauge",
+                   value=len(self._active))
+
+    def _book(self, name: str, *, kind: str, value: float = 1.0,
+              **labels) -> None:
+        """Metric booking; inert while telemetry is off (registry must
+        stay empty then)."""
+        try:
+            from ..observability.metrics import get_registry
+            from ..observability.telemetry import get_telemetry
+            if not get_telemetry().enabled:
+                return
+            reg = get_registry()
+            help_ = _METRIC_HELP.get(name, "")
+            if kind == "counter":
+                reg.counter(name, help_,
+                            labelnames=tuple(labels)).inc(value, **labels)
+            elif kind == "gauge":
+                reg.gauge(name, help_,
+                          labelnames=tuple(labels)).set(value, **labels)
+            else:
+                reg.histogram(name, help_,
+                              labelnames=tuple(labels)).observe(
+                    value, **labels)
+        except Exception:
+            pass
+
+
+_METRIC_HELP = {
+    "pt_serve_requests_total": "Requests accepted by the serve scheduler",
+    "pt_serve_completed_total": "Requests completed",
+    "pt_serve_admission_refusals_total":
+        "Admissions refused, by reason (inflight_cap|kv_headroom)",
+    "pt_serve_tokens_total": "Tokens generated by the serve engine",
+    "pt_serve_queue_depth": "Requests waiting for admission",
+    "pt_serve_active_sequences": "Sequences resident in the decode batch",
+    "pt_serve_batch_occupancy":
+        "Active rows / decode bucket size of the last step",
+    "pt_serve_request_latency_seconds":
+        "End-to-end request latency (submit to last token)",
+}

@@ -240,6 +240,8 @@ class Linter(ast.NodeVisitor):
         self.distributed_path = bool(re.search(
             r"(^|/)(distributed|fleet|collective)(/|\.py$|$)", p))
         self.core_path = bool(re.search(r"(^|/)core(/|\.py$|$)", p))
+        # the serving request path: zero-compile discipline (TPU019)
+        self.serving_path = bool(re.search(r"(^|/)serving(/|$)", p))
         # library code proper: inside the paddle_tpu package but not its
         # CLI/developer-tool surfaces (whose contract IS stdout)
         self.library_path = bool(

@@ -1110,3 +1110,60 @@ class HostSideNanCheck(Rule):
                        f"late; compile the flag into the step "
                        f"(numerics.health_outputs) and read it at a "
                        f"cadence")
+
+
+@register
+class RequestPathCompile(Rule):
+    id = "TPU019"
+    name = "request-path-compile"
+    rationale = ("the serving engine's SLO contract is ZERO compiles on "
+                 "the request path — every serveable shape is "
+                 "AOT-compiled into the bucket ladder at engine load, "
+                 "and any later compile books "
+                 "pt_serve_unexpected_compiles_total and trips /healthz; "
+                 "a jax.jit/pjit/lower() reachable from serving "
+                 "request-handling code stalls a live request behind an "
+                 "XLA compile (seconds, not microseconds) the first time "
+                 "an unplanned shape arrives — move the compile into the "
+                 "engine's build/warmup phase and extend the bucket "
+                 "ladder instead")
+
+    _JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit",
+                  "jax.experimental.pjit.pjit"}
+    # engine phases that are ALLOWED to compile: the AOT build/warmup
+    # surface (ServingEngine._build_programs and friends)
+    _BUILD_FUNC = re.compile(
+        r"(build|warm|aot|compile|lower|export|program|canary|load|init)",
+        re.IGNORECASE)
+
+    def _in_build_phase(self, ctx):
+        return any(self._BUILD_FUNC.search(fi.name)
+                   for fi in ctx.func_stack)
+
+    def on_call(self, node, ctx):
+        if not ctx.serving_path or self._in_build_phase(ctx):
+            return
+        name = dotted(node.func)
+        if name in self._JIT_NAMES:
+            ctx.report(node, self.id,
+                       f"{name}() on the serving request path compiles "
+                       f"on first call and stalls a live request; "
+                       f"AOT-compile it in the engine's "
+                       f"_build_programs/warmup phase and serve from "
+                       f"the bucket ladder")
+            return
+        # AOT entry points invoked outside the build phase:
+        # jit(f).lower(...) chains, or .lower(...)/.aot_compile(...)
+        # on a stored jitted callable.  str.lower() takes no
+        # arguments, so an argumentful .lower(...) is an XLA lowering.
+        if isinstance(node.func, ast.Attribute) and node.func.attr in (
+                "lower", "aot_compile"):
+            if node.args or node.keywords or (
+                    isinstance(node.func.value, ast.Call)
+                    and dotted(node.func.value.func) in self._JIT_NAMES):
+                ctx.report(node, self.id,
+                           f".{node.func.attr}() on the serving request "
+                           f"path triggers XLA lowering+compilation "
+                           f"mid-request; precompile every bucket shape "
+                           f"at engine load (the zero-compile sentinel "
+                           f"will book this as an SLO violation)")

@@ -314,6 +314,125 @@ def test_cluster_snapshot_local_mode_shape():
     assert snap["ranks"]["4"]["step_time"]["train"]["count"] == 1
 
 
+def _serve_replica(rank, latencies, queue_depth=0, compiles=0,
+                   run_id="srv"):
+    """One serving replica's exposition: the request-latency histogram,
+    queue-depth gauge, and unexpected-compile counter the engine books."""
+    reg = MetricsRegistry()
+    reg.set_const_labels(process_index=rank, run_id=run_id)
+    h = reg.histogram("pt_serve_request_latency_seconds",
+                      "End-to-end request latency",
+                      buckets=[0.01, 0.05, 0.25, 1.0, 5.0])
+    for v in latencies:
+        h.observe(v)
+    reg.gauge("pt_serve_queue_depth", "queue").set(queue_depth)
+    if compiles:
+        reg.counter("pt_serve_unexpected_compiles_total", "compiles",
+                    ("fn",)).inc(compiles, fn="decode")
+    return MetricsServer(reg, port=0).start()
+
+
+def test_aggregator_serve_latency_queue_and_saturation_alarm():
+    """Two serving replicas scraped over HTTP: merged p50/p99 from the
+    summed bucket maps, fleet queue depth (sum + worst replica), the
+    cross-rank unexpected-compile counter, and the saturation alarm
+    (p99 >= PT_AGGREGATOR_SERVE_THRESHOLD -> healthz ok=False -> 503)."""
+    import urllib.error
+    import urllib.request
+
+    # rank 0 fast, rank 1 saturated: merged p99 lands in the 5.0 bucket
+    s0 = _serve_replica(0, [0.02] * 50, queue_depth=1)
+    s1 = _serve_replica(1, [0.02] * 30 + [2.0] * 20, queue_depth=7,
+                        compiles=2)
+    agg = ClusterAggregator(
+        endpoints={0: f"127.0.0.1:{s0.port}",
+                   1: f"127.0.0.1:{s1.port}"},
+        scrape_timeout=2.0, serve_threshold=1.0)
+    srv = MetricsServer(metrics_cb=agg.prometheus_text,
+                        health_cb=agg.healthz, port=0).start()
+    try:
+        agg.scrape_once()
+        fams = parse_prometheus_text(agg.prometheus_text())
+
+        def val(name, **labels):
+            for f in fams.values():
+                for sname, lbls, v in f["samples"]:
+                    if sname == name and all(lbls.get(k) == x
+                                             for k, x in labels.items()):
+                        return v
+            return None
+
+        # 100 requests fleet-wide, 20 of them in the (1.0, 5.0] bucket:
+        # p50 <= 0.05 while p99 is in the slow tail
+        assert val("pt_cluster_serve_p50_seconds") <= 0.05
+        p99 = val("pt_cluster_serve_p99_seconds")
+        assert 1.0 < p99 <= 5.0
+        assert val("pt_cluster_serve_queue_depth", stat="sum") == 8.0
+        assert val("pt_cluster_serve_queue_depth", stat="max") == 7.0
+        assert val("pt_cluster_serve_unexpected_compiles_total") == 2.0
+        assert val("pt_cluster_serve_alarm") == 1.0
+
+        health = agg.healthz()
+        assert health["ok"] is False  # saturation -> 503
+        assert health["serve"]["serve_alarm"] is True
+        assert health["serve"]["requests_total"] == 100
+        assert health["serve"]["queue_depth_sum"] == 8
+        assert health["serve"]["queue_depth_max"] == 7
+        assert health["serve"]["unexpected_compiles_total"] == 2
+        assert health["serve"]["p99_seconds"] == pytest.approx(p99)
+
+        # the re-served endpoint carries the 503 to the load balancer
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz", timeout=5)
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read().decode())["serve"][
+            "serve_alarm"] is True
+    finally:
+        srv.stop()
+        agg.stop()
+        s0.stop()
+        s1.stop()
+
+
+def test_aggregator_serve_quiet_fleet_and_below_threshold():
+    # training-only rank: no serve families -> no serve series at all
+    s0 = _serve_rank(0)
+    agg = ClusterAggregator(endpoints={0: f"127.0.0.1:{s0.port}"},
+                            serve_threshold=1.0)
+    try:
+        agg.scrape_once()
+        text = agg.prometheus_text()
+        assert "pt_cluster_serve_p99_seconds" not in text
+        assert "pt_cluster_serve_queue_depth" not in text
+        assert "pt_cluster_serve_unexpected_compiles_total" not in text
+        assert "pt_cluster_serve_alarm 0" in text
+        health = agg.healthz()
+        assert health["ok"] is True
+        assert health["serve"]["p99_seconds"] is None
+        assert health["serve"]["queue_depth_sum"] is None
+    finally:
+        agg.stop()
+        s0.stop()
+
+    # healthy replica under the threshold: series present, no alarm
+    s1 = _serve_replica(0, [0.02] * 40)
+    agg2 = ClusterAggregator(endpoints={0: f"127.0.0.1:{s1.port}"},
+                             serve_threshold=1.0)
+    try:
+        agg2.scrape_once()
+        fams = parse_prometheus_text(agg2.prometheus_text())
+        samples = [s for f in fams.values() for s in f["samples"]]
+        p99 = [v for n, _, v in samples
+               if n == "pt_cluster_serve_p99_seconds"]
+        assert p99 and p99[0] <= 0.05
+        assert agg2.healthz()["ok"] is True
+        assert agg2.healthz()["serve"]["serve_alarm"] is False
+    finally:
+        agg2.stop()
+        s1.stop()
+
+
 # -- store key conventions ---------------------------------------------------
 
 def test_obs_store_key_formats_pinned_equal():

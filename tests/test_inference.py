@@ -80,6 +80,55 @@ class TestPredictorStaticArtifact:
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
 
 
+class TestPredictorServedModel:
+    """A serving-engine model dir routes through the AOT engine: the
+    Predictor surface is unchanged, but run() is a full generate loop
+    over the zero-compile serve graphs."""
+
+    @pytest.fixture(scope="class")
+    def served_dir(self, tmp_path_factory):
+        from paddle_tpu.serving import (
+            ModelSpec, ServeConfig, init_params, save_served_model)
+        spec = ModelSpec(vocab_size=64, hidden=32, layers=1, heads=2,
+                         max_seq_len=64)
+        cfg = ServeConfig(decode_buckets=(2,), prefill_buckets=(16,),
+                          kv_pages=16, page_size=4,
+                          max_new_tokens=4)
+        root = str(tmp_path_factory.mktemp("served") / "model")
+        save_served_model(root, spec, init_params(spec, seed=0),
+                          config=cfg, step=1)
+        return root
+
+    def test_served_dir_round_trip(self, served_dir):
+        pred = infer.create_predictor(infer.Config(served_dir))
+        assert pred.get_input_names() == ["tokens"]
+        h = pred.get_input_handle("tokens")
+        h.copy_from_cpu(np.array([5, 9, 2], np.int32))
+        pred.run()
+        out = pred.get_output_handle(
+            pred.get_output_names()[0]).copy_to_cpu()
+        assert out.dtype == np.int32 and out.shape == (4,)
+        # same tokens as the engine's own generate path
+        eng = pred._engine
+        assert out.tolist() == eng.generate([[5, 9, 2]],
+                                            max_new_tokens=4)[0]
+        assert eng.unexpected_compiles == 0
+        eng.close()
+
+    def test_non_served_prefix_unaffected(self, tmp_path):
+        # the routing probe must not misfire on ordinary jit artifacts
+        _, prefix = _save_jit_artifact(tmp_path)
+        pred = infer.create_predictor(infer.Config(prefix))
+        assert pred._engine is None
+        assert pred.get_input_names() != ["tokens"]
+
+
+def test_precision_type_docstring_names_fluid():
+    # the reference path is paddle/fluid/ — regression-pin the typo fix
+    assert "paddle/fluid/" in infer.PrecisionType.__doc__
+    assert "fidle" not in infer.PrecisionType.__doc__
+
+
 @pytest.mark.slow
 class TestFullModelRoundTrip:
     """VERDICT weak #7: full exported model artifacts must round-trip

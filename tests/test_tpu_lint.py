@@ -321,6 +321,20 @@ FIXTURES = {
             return losses
         """,
     ),
+    "TPU019": (
+        "paddle_tpu/serving/handlers.py",
+        """
+        import jax
+        def handle_generate(engine, tokens):
+            fn = jax.jit(engine.decode_fn)
+            return fn(tokens)
+        """,
+        """
+        def handle_generate(engine, tokens):
+            exe = engine.decode_exe[engine.decode_bucket_for(len(tokens))]
+            return exe(tokens)
+        """,
+    ),
     "TPU014": (
         "paddle_tpu/distributed/mod.py",
         """
@@ -990,6 +1004,68 @@ def test_tpu016_vector_norms_and_fused_entry_are_silent():
         return F.fused_add_layer_norm(x, r, 16, w, b)
     """
     assert "TPU016" not in rules_fired(src2, path="paddle_tpu/nn/mod.py")
+
+
+def test_tpu019_lower_chain_fires_in_handler():
+    # jit(f).lower(x).compile() mid-request: both the jit() and the
+    # argumentful .lower() are request-path compiles
+    src = """
+    import jax
+    def serve_request(engine, tokens):
+        exe = jax.jit(engine.step).lower(tokens).compile()
+        return exe(tokens)
+    """
+    vs = [v for v in lint_source(textwrap.dedent(src),
+                                 path="paddle_tpu/serving/http.py")
+          if v.rule == "TPU019"]
+    assert len(vs) >= 1
+
+
+def test_tpu019_str_lower_is_silent():
+    # str.lower() takes no arguments — not an XLA lowering
+    src = """
+    def handle_request(payload):
+        method = payload["method"].lower()
+        return method
+    """
+    assert "TPU019" not in rules_fired(
+        src, path="paddle_tpu/serving/http.py")
+
+
+def test_tpu019_scoped_to_serving_paths():
+    # identical jit-in-handler outside paddle_tpu/serving/: other
+    # rules' business, not TPU019's
+    src = """
+    import jax
+    def handle_generate(engine, tokens):
+        return jax.jit(engine.step)(tokens)
+    """
+    assert "TPU019" not in rules_fired(src, path="paddle_tpu/hapi/m.py")
+    assert "TPU019" not in rules_fired(src, path="tests/test_x.py")
+
+
+def test_tpu019_build_phase_is_exempt():
+    # the engine's AOT build/warmup surface is WHERE compiles belong
+    src = """
+    import jax
+    class Engine:
+        def _build_programs(self, buckets, structs):
+            jit = jax.jit(self._step, donate_argnums=(1, 2))
+            return {b: jit.lower(structs[b]).compile() for b in buckets}
+        def _warmup(self):
+            for exe in self._exes.values():
+                exe(self._zeros)
+    """
+    assert "TPU019" not in rules_fired(
+        src, path="paddle_tpu/serving/engine.py")
+
+
+def test_tpu019_serving_tree_is_clean():
+    # the shipped serving package must satisfy its own rule
+    violations, errors = run_paths(
+        [os.path.join(ROOT, "paddle_tpu", "serving")])
+    assert errors == {}
+    assert [v for v in violations if v.rule == "TPU019"] == []
 
 
 # -- suppressions ------------------------------------------------------------
