@@ -385,12 +385,25 @@ def bench_gpt(result, batch, recompute=True):
                          .astype(np.int32))
 
     t0 = time.perf_counter()
-    compiled = step.lower(params, buffers, opt_state, ids, labels).compile()
+    traced = step.trace(params, buffers, opt_state, ids, labels)
+    compiled = traced.lower().compile()
     result["gpt345m_compile_sec"] = round(time.perf_counter() - t0, 2)
     # fusion block: which patterns got rewritten at trace time, and which
     # fell back to the XLA mirror (tpu_unreachable on the CPU fast-fail
     # path, canary_failed when Mosaic rejects a kernel)
     result["fusion"] = _fusion.summary()
+    # graph audit: the AOT trace above already holds the step jaxpr, so
+    # the auditor costs zero extra traces here (compile-time only)
+    from paddle_tpu.tools.audit import runtime as _audit
+    if _audit.audit_enabled():
+        from paddle_tpu.tools.audit.core import AuditProgram
+        n_donated = len(jax.tree_util.tree_leaves(
+            (params, buffers, opt_state)))
+        _audit.audit_program(AuditProgram(
+            name="bench_gpt_step", jaxpr=traced.jaxpr, kind="capture",
+            donated=range(n_donated),
+            fusion_expected=_fusion.fusion_enabled(),
+            fusion_rewrites=result["fusion"].get("rewrites")))
     flops = _flops_per_step(compiled)
     result["gpt345m_flops_per_step"] = flops
     result["gpt345m_memory"] = _memory_report(compiled)
@@ -786,10 +799,12 @@ def _leg_main(name, batch, recompute):
     from paddle_tpu.observability.goodput import get_goodput
     from paddle_tpu.observability.numerics import get_monitor
     from paddle_tpu.observability.memory import get_memory_monitor
+    from paddle_tpu.tools.audit import runtime as audit_rt
     tel = get_telemetry().enable()  # metrics + compile watch, no sink/server
     tr = get_tracer().enable()      # span sink + analytic-MFU accounting
     gp = get_goodput().enable()     # wall-clock decomposition over spans
     mm = get_memory_monitor().enable()  # footprints + watermarks + OOM
+    audit_rt.enable()               # graph audit at capture/serve compiles
     fields: dict = {}
     rec = {"ok": True, "fields": fields}
     try:
@@ -821,6 +836,7 @@ def _leg_main(name, batch, recompute):
     fields[f"goodput_{name}"] = gp.snapshot()
     fields[f"numerics_{name}"] = get_monitor().snapshot()
     fields[f"memory_{name}"] = mm.snapshot()
+    fields[f"audit_{name}"] = audit_rt.snapshot()
     print(json.dumps(rec), flush=True)
 
 
@@ -889,10 +905,12 @@ def main():
     from paddle_tpu.observability.goodput import get_goodput
     from paddle_tpu.observability.numerics import get_monitor
     from paddle_tpu.observability.memory import get_memory_monitor
+    from paddle_tpu.tools.audit import runtime as audit_rt
     tel = get_telemetry().enable()
     tr = get_tracer().enable()
     gp = get_goodput().enable()
     mm = get_memory_monitor().enable()
+    audit_rt.enable()
 
     def remaining():
         return BUDGET_SEC - (time.time() - t_start)
@@ -918,6 +936,10 @@ def main():
             # …and the memory block: fit verdicts + watermark summary,
             # {} stats on the tpu_unreachable CPU fast-fail
             result["memory"] = mm.snapshot()
+            # …and the audit block: the driver never compiles, so this
+            # stays empty here; per-leg audit_{name} blocks carry the
+            # findings booked inside the leg subprocesses
+            result["audit"] = audit_rt.snapshot()
         except Exception:
             pass
         print(json.dumps(result), flush=True)

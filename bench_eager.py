@@ -436,6 +436,11 @@ def main():
     # the record like telemetry/trace do
     from paddle_tpu.observability.goodput import get_goodput
     gp = get_goodput().enable()
+    # graph audit on for the whole bench: every capture_step compile in
+    # this file (the chain, the contract runs, the fusion A/B) gets its
+    # pre-fusion jaxpr audited at capture time — replays cost nothing
+    from paddle_tpu.tools.audit import runtime as audit_rt
+    audit_rt.enable()
 
     # the chain takes its inputs as ARGUMENTS: closed-over operands let
     # XLA constant-fold the whole program into one literal, which would
@@ -497,6 +502,7 @@ def main():
     res["numerics"] = get_monitor().snapshot()
     from paddle_tpu.observability.memory import get_memory_monitor
     res["memory"] = get_memory_monitor().snapshot()
+    res["audit"] = audit_rt.snapshot()
     try:
         from paddle_tpu.observability import cluster_snapshot
         res["telemetry_cluster"] = cluster_snapshot(

@@ -109,6 +109,12 @@ def main(argv=None):
                            "canary watchdog timeout — device "
                            "unreachable; serve leg skipped (fast-fail)")
         record["bench_wall_sec"] = round(time.time() - t_start, 1)
+        # the audit block rides the fast-fail record too, synthesized
+        # inline: importing paddle_tpu here would run package init and
+        # block on the same backend-init lock the canary is hung on
+        record["audit"] = {"enabled": False, "programs": [],
+                           "findings": 0, "by_rule": {},
+                           "by_severity": {}}
         emit(record, args.out)
         return 1
 
@@ -118,6 +124,10 @@ def main(argv=None):
     from paddle_tpu.serving.scheduler import EngineSaturated
 
     get_telemetry().enable()  # metrics + compile watcher
+    # graph audit on for the AOT build: every bucket executable's traced
+    # jaxpr is audited while the ladder compiles (load-time only)
+    from paddle_tpu.tools.audit import runtime as audit_rt
+    audit_rt.enable()
 
     spec = ModelSpec(vocab_size=args.vocab, hidden=args.hidden,
                      layers=args.layers, heads=args.heads,
@@ -207,6 +217,7 @@ def main(argv=None):
         "unexpected_compiles": engine.unexpected_compiles,
         "zero_compile_after_warmup": engine.unexpected_compiles == 0,
         "healthz_ok": engine.healthz()["ok"],
+        "audit": audit_rt.snapshot(),
     })
     record["ok"] = (not errors
                     and len(latencies) == args.streams

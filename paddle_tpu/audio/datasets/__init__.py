@@ -3,7 +3,8 @@
 classification datasets over the framework Dataset protocol, with
 on-the-fly feature extraction through :mod:`paddle_tpu.audio.features`.
 
-``DATA_HOME`` honors the ``PADDLE_TPU_DATA_HOME`` env var so tests and
+``data_home()`` reads the ``PADDLE_TPU_DATA_HOME`` env var lazily (at
+call time, never at import) so tests and
 offline machines can point at pre-extracted archives (zero-egress: the
 download only triggers when the directory is absent).
 """

@@ -9,10 +9,13 @@ import numpy as np
 from ...io import Dataset
 from .. import features as _features
 
-DATA_HOME = os.environ.get(
-    "PADDLE_TPU_DATA_HOME",
-    os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
-                 "datasets"))
+def data_home() -> str:
+    """Dataset cache root — resolved lazily so ``PADDLE_TPU_DATA_HOME``
+    set after import (tests, launchers) is honored."""
+    return os.environ.get(
+        "PADDLE_TPU_DATA_HOME",
+        os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                     "datasets"))
 
 feat_funcs = {
     "raw": None,

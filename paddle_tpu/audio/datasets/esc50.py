@@ -6,7 +6,7 @@ import collections
 import csv
 import os
 
-from .dataset import DATA_HOME, AudioClassificationDataset
+from .dataset import AudioClassificationDataset, data_home
 
 __all__ = ["ESC50"]
 
@@ -39,21 +39,21 @@ class ESC50(AudioClassificationDataset):
                          **kwargs)
 
     def _get_meta_info(self):
-        with open(os.path.join(DATA_HOME, self.meta)) as f:
+        with open(os.path.join(data_home(), self.meta)) as f:
             rows = list(csv.reader(f))
         return [self.meta_info(*r[:7]) for r in rows[1:]]
 
     def _get_data(self, mode, split):
-        if not os.path.isdir(os.path.join(DATA_HOME, self.audio_path)) \
-                or not os.path.isfile(os.path.join(DATA_HOME, self.meta)):
+        if not os.path.isdir(os.path.join(data_home(), self.audio_path)) \
+                or not os.path.isfile(os.path.join(data_home(), self.meta)):
             from ...utils.download import get_path_from_url
-            get_path_from_url(self.archive["url"], DATA_HOME,
+            get_path_from_url(self.archive["url"], data_home(),
                               self.archive["md5"], decompress=True)
         files, labels = [], []
         for sample in self._get_meta_info():
             dev = int(sample.fold) == split
             if (mode == "train") != dev:
-                files.append(os.path.join(DATA_HOME, self.audio_path,
+                files.append(os.path.join(data_home(), self.audio_path,
                                           sample.filename))
                 labels.append(int(sample.target))
         return files, labels

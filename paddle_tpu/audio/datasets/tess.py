@@ -5,7 +5,7 @@ from __future__ import annotations
 import collections
 import os
 
-from .dataset import DATA_HOME, AudioClassificationDataset
+from .dataset import AudioClassificationDataset, data_home
 
 __all__ = ["TESS"]
 
@@ -47,10 +47,10 @@ class TESS(AudioClassificationDataset):
                 for f in files]
 
     def _get_data(self, mode, n_folds, split):
-        root = os.path.join(DATA_HOME, self.audio_path)
+        root = os.path.join(data_home(), self.audio_path)
         if not os.path.isdir(root):
             from ...utils.download import get_path_from_url
-            get_path_from_url(self.archive["url"], DATA_HOME,
+            get_path_from_url(self.archive["url"], data_home(),
                               self.archive["md5"], decompress=True)
         wav_files = sorted(
             os.path.join(base, f)

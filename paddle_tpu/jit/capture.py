@@ -146,7 +146,7 @@ class _LiveState:
 class _Entry:
     __slots__ = ("jitted", "struct", "traced_idx", "sg_flags", "statics",
                  "n_leaves", "sig", "name", "ran", "flops", "fusion",
-                 "memory", "monitored", "monitor_names")
+                 "memory", "monitored", "monitor_names", "pure", "audit")
 
 
 class CapturedStep:
@@ -460,6 +460,11 @@ class CapturedStep:
         from ..ops import fusion_pass as _fusion
 
         entry = _Entry()
+        # the UN-wrapped pure fn is kept for the graph auditor: its
+        # pre-fusion jaxpr is exactly what the fusion pass matched, so
+        # the missed-fusion cross-check compares like with like
+        entry.pure = pure
+        entry.audit = None
         entry.jitted = jax.jit(_fusion.wrap(pure), donate_argnums=(0, 1, 2))
         entry.struct = struct
         entry.traced_idx = tuple(traced_idx)
@@ -548,6 +553,18 @@ class CapturedStep:
                 # log isn't being watched (watcher installed → the log
                 # filter records this compile; both would double-count)
                 tel.record_compile(entry.name, f"sig={entry.sig}")
+            if entry.audit is None:
+                # graph audit (tools/audit): static findings over the
+                # pre-fusion step jaxpr, harvested once per signature
+                # in the same compile-time window as the FLOPs/memory
+                # passes above — the replay hot path never pays it
+                from ..tools.audit import runtime as _audit_rt
+                if _audit_rt.audit_enabled():
+                    entry.audit = _audit_rt.audit_captured_step(
+                        entry, st.params, st.buffers, st.opt_states,
+                        st.rng_ctr, lrs, traced)
+                else:
+                    entry.audit = ()
         else:
             t0 = time.perf_counter_ns()
             try:

@@ -155,7 +155,7 @@ class Assign(Initializer):
 
     def __call__(self, shape, dtype=jnp.float32):
         v = self.value
-        arr = v._data if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+        arr = v._data if isinstance(v, Tensor) else jnp.asarray(v)
         if tuple(arr.shape) != tuple(shape):
             arr = arr.reshape(tuple(shape))
         return arr.astype(dtype)

@@ -172,7 +172,7 @@ def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
 
 def assign(x, output=None):
     x = ensure_tensor(x) if not isinstance(x, (np.ndarray, list, tuple, int, float)) \
-        else Tensor(np.asarray(x))
+        else Tensor(jnp.asarray(x))
     out = unary(jnp.copy, x, name="assign")
     if output is not None:
         output.set_value(out._data)

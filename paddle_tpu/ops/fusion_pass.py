@@ -43,7 +43,7 @@ import jax.numpy as jnp
 from jax import core as jcore
 
 __all__ = [
-    "PATTERNS", "wrap", "match_jaxpr", "count_patterns",
+    "PATTERNS", "wrap", "match_jaxpr", "match_report", "count_patterns",
     "fusion_enabled", "disabled_patterns", "summary", "reset_stats",
 ]
 
@@ -759,6 +759,67 @@ def count_patterns(fn, *args, **kwargs):
     for cl in match_jaxpr(closed.jaxpr):
         counts[cl.pattern] = counts.get(cl.pattern, 0) + 1
     return counts
+
+
+def match_report(jaxpr, disabled=None):
+    """Eligibility census for the graph auditor (``tools/audit``):
+    like :func:`match_jaxpr`, but additionally keeps the structural
+    matches that FAILED the closure test, each with a string naming the
+    first blocking escape.
+
+    Returns ``(clusters, near_misses)``: the eligible clusters exactly
+    as :func:`match_jaxpr` would pick them, plus a list of
+    ``(cluster, blocker)`` pairs where ``blocker`` names the interior
+    value and the outside consumer that pins it (the jaxpr output, a
+    foreign eqn, or an effectful member eqn)."""
+    if disabled is None:
+        disabled = disabled_patterns()
+    g = _Graph(jaxpr)
+    clusters, near, claimed, near_claimed = [], [], set(), set()
+
+    def _blocker(cl):
+        for i in sorted(cl.covered):
+            eqn = g.eqns[i]
+            if eqn.effects:
+                return f"member eqn {eqn.primitive.name} carries effects"
+            for ov in eqn.outvars:
+                if ov is cl.outvar:
+                    continue
+                for ci in g.consumers.get(ov, []):
+                    if ci == _OUT:
+                        return (f"interior {eqn.primitive.name} result "
+                                f"{ov.aval.str_short()} escapes to the "
+                                "program output")
+                    if ci not in cl.covered:
+                        return (f"interior {eqn.primitive.name} result "
+                                f"{ov.aval.str_short()} escapes to eqn "
+                                f"{g.eqns[ci].primitive.name}")
+        return None
+
+    def take(cl):
+        if cl is None or cl.pattern in disabled:
+            return
+        if cl.covered & claimed:
+            return
+        b = _blocker(cl)
+        if b is not None:
+            if not (cl.covered & near_claimed):
+                near_claimed.update(cl.covered)
+                near.append((cl, b))
+            return
+        claimed.update(cl.covered)
+        clusters.append(cl)
+
+    for i in range(len(g.eqns)):
+        take(_match_attention(g, i))
+    for i in range(len(g.eqns)):
+        take(_match_mbg_tanh(g, i))
+        take(_match_mbg_erf(g, i))
+    for i in range(len(g.eqns)):
+        take(_match_ln(g, i, claimed))
+    clusters.sort(key=lambda c: c.root)
+    near.sort(key=lambda nb: nb[0].root)
+    return clusters, near
 
 
 def _bvec(v, ndim):

@@ -426,19 +426,22 @@ def masked_select(x, mask, name=None):
             "masked_select has a data-dependent output shape and cannot be "
             "traced under jit; use paddle_tpu.where / multiplication by the "
             "mask instead.")
-    m = np.asarray(mask._data)
-    idx = np.nonzero(np.broadcast_to(m, x._data.shape).ravel())[0]
-    return nary(lambda d: jnp.take(d.ravel(), jnp.asarray(idx)), [x],
+    # eager jnp.nonzero keeps the index computation on device — the
+    # data-dependent output shape is why this stays eager-only, but the
+    # gather itself never needs a host round-trip
+    m = jnp.broadcast_to(mask._data, x._data.shape).ravel()
+    (idx,) = jnp.nonzero(m)
+    return nary(lambda d: jnp.take(d.ravel(), idx), [x],
                 name="masked_select")
 
 
 def masked_scatter(x, mask, value, name=None):
     x, mask = ensure_tensor(x), ensure_tensor(mask)
-    m = np.asarray(mask._data)
-    flat_idx = np.nonzero(np.broadcast_to(m, x._data.shape).ravel())[0]
+    m = jnp.broadcast_to(mask._data, x._data.shape).ravel()
+    (flat_idx,) = jnp.nonzero(m)
 
     def f(d, v):
-        return d.ravel().at[jnp.asarray(flat_idx)].set(
+        return d.ravel().at[flat_idx].set(
             v.ravel()[:flat_idx.size]).reshape(d.shape)
     return nary(f, [x, ensure_tensor(value)], name="masked_scatter")
 
@@ -446,7 +449,7 @@ def masked_scatter(x, mask, value, name=None):
 def where(condition, x=None, y=None, name=None):
     condition = ensure_tensor(condition)
     if x is None and y is None:
-        return tuple(Tensor(a) for a in jnp.nonzero(np.asarray(condition._data)))
+        return tuple(Tensor(a) for a in jnp.nonzero(condition._data))
     return nary(lambda c, a, b: jnp.where(c, a, b),
                 [condition, x, y], name="where")
 

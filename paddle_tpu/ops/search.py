@@ -116,10 +116,10 @@ def nonzero(x, as_tuple=False):
     x = ensure_tensor(x)
     if isinstance(x._data, jax.core.Tracer):
         raise RuntimeError("nonzero has data-dependent shape; eager only")
-    idx = np.nonzero(np.asarray(x._data))
+    idx = jnp.nonzero(x._data)  # eager: on-device, no host round-trip
     if as_tuple:
-        return tuple(Tensor(jnp.asarray(i)) for i in idx)
-    return Tensor(jnp.asarray(np.stack(idx, axis=1)))
+        return tuple(Tensor(i) for i in idx)
+    return Tensor(jnp.stack(idx, axis=1))
 
 
 def searchsorted(sorted_sequence, values, out_int32=False, right=False,

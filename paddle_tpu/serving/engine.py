@@ -237,23 +237,43 @@ class ServingEngine:
         pf_jit = jax.jit(_pf, donate_argnums=(1, 2))
         dec_jit = jax.jit(_dec, donate_argnums=(1, 2))
 
+        # graph audit (tools/audit): when enabled, every bucket
+        # program's traced jaxpr is audited during the build — load
+        # time only, sharing the trace the AOT lower needs anyway.
+        # The donation layout handed over mirrors donate_argnums=(1,2).
+        aud = None
+        from ..tools.audit import runtime as _audit_rt
+        if _audit_rt.audit_enabled():
+            aud = _audit_rt
+            n_p = len(jax.tree_util.tree_leaves(p_struct))
+            n_kv = 2 * len(jax.tree_util.tree_leaves(k_struct))
+
+        def _compile(jitted, name, *args):
+            if aud is None:
+                exe = jitted.lower(*args).compile()
+            else:
+                traced = jitted.trace(*args)
+                aud.audit_serve_trace(name, traced.jaxpr, n_p, n_kv,
+                                      args)
+                exe = traced.lower().compile()
+            self._account_compile(name)
+            return exe
+
         for s in cfg.prefill_buckets:
-            self._prefill_exe[s] = pf_jit.lower(
+            self._prefill_exe[s] = _compile(
+                pf_jit, f"serve_prefill_s{s}",
                 p_struct, k_struct, k_struct,
                 jax.ShapeDtypeStruct((s,), i32),
                 jax.ShapeDtypeStruct((), i32),
-                jax.ShapeDtypeStruct((self.max_pages_per_seq,), i32)
-            ).compile()
-            self._account_compile(f"serve_prefill_s{s}")
+                jax.ShapeDtypeStruct((self.max_pages_per_seq,), i32))
 
         for b in cfg.decode_buckets:
-            self._decode_exe[b] = dec_jit.lower(
+            self._decode_exe[b] = _compile(
+                dec_jit, f"serve_decode_b{b}",
                 p_struct, k_struct, k_struct,
                 jax.ShapeDtypeStruct((b,), i32),
                 jax.ShapeDtypeStruct((b,), i32),
-                jax.ShapeDtypeStruct((b, self.max_pages_per_seq), i32)
-            ).compile()
-            self._account_compile(f"serve_decode_b{b}")
+                jax.ShapeDtypeStruct((b, self.max_pages_per_seq), i32))
 
         self.compiled_programs = len(self._prefill_exe) + len(self._decode_exe)
         logger.info(

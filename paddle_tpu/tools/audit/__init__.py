@@ -1,0 +1,16 @@
+"""Graph auditor: jaxpr-level static analysis of captured and
+AOT-served programs (``python -m paddle_tpu.tools.audit``).
+
+tpu-lint reads source; this reads the lowered program.  See
+:mod:`.core` for the finding/baseline machinery, :mod:`.rules` for the
+AUD001+ catalog, :mod:`.runtime` for the capture/serving hooks.
+"""
+from .core import AuditProgram, Finding, run_rules, walk_jaxprs
+from .rules import RULES, default_rules, rule_catalog
+from .runtime import (audit_enabled, audit_program, enable, findings,
+                      reset, snapshot)
+
+__all__ = ["AuditProgram", "Finding", "RULES", "audit_enabled",
+           "audit_program", "default_rules", "enable", "findings",
+           "reset", "rule_catalog", "run_rules", "snapshot",
+           "walk_jaxprs"]

@@ -1,0 +1,38 @@
+"""Audit baseline: the same grandfather-then-gate contract as
+``tools/lint/baseline.py`` — that module's loader and multiset differ
+are reused verbatim, only the header and default path differ.  Keys
+are ``program::RULE::<provenance>``: content-addressed and free of eqn
+indices, so unrelated model edits never invalidate the file.
+"""
+from __future__ import annotations
+
+import os
+
+from ..lint.baseline import diff_against_baseline, load_baseline
+
+__all__ = ["default_baseline_path", "load_baseline", "write_baseline",
+           "diff_against_baseline"]
+
+_HEADER = """\
+# graph-audit baseline — grandfathered findings.
+#
+# Every entry is `program::RULE::<provenance>`.  The gate fails only
+# on findings NOT in this file.  Regenerate after intentional changes
+# with:
+#     python -m paddle_tpu.tools.audit --write-baseline
+# Shrink it over time; never grow it to dodge a fix.
+"""
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.txt")
+
+
+def write_baseline(path: str, findings) -> int:
+    keys = sorted(f.key for f in findings)
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(_HEADER)
+        for k in keys:
+            fh.write(k + "\n")
+    return len(keys)

@@ -1,0 +1,189 @@
+"""Graph-audit CLI.
+
+``python -m paddle_tpu.tools.audit`` — builds the in-tree reference
+programs (the bench GPT-class captured train step and a tiny served
+engine's AOT program ladder), audits them through the same hooks
+production capture/serving use, and gates the findings against the
+committed ``tools/audit/baseline.txt``.  Exit codes mirror tpu-lint:
+0 clean against the baseline, 1 new findings (or a broken build),
+2 usage error.
+
+The default run is the tier-1 self-clean gate
+(``tests/test_graph_audit.py``): every in-tree step function must
+audit clean, and the five rule classes are proven live on synthetic
+violating programs by the test fixtures instead.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import tempfile
+
+from .baseline import (default_baseline_path, diff_against_baseline,
+                       load_baseline, write_baseline)
+from .rules import default_rules, rule_catalog
+from . import runtime
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="graph-audit",
+        description="jaxpr-level static auditor over the framework's "
+                    "captured-step and AOT-served programs (the IR "
+                    "sibling of tpu-lint).")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help="baseline file (default: the committed "
+                        "tools/audit/baseline.txt)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding, ignoring the baseline")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="regenerate the baseline from the current "
+                        "programs and exit 0")
+    p.add_argument("--select", default=None, metavar="RULES",
+                   help="comma-separated rule ids to run "
+                        "(e.g. AUD002,AUD003)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    p.add_argument("--skip-capture", action="store_true",
+                   help="skip the captured GPT train-step target")
+    p.add_argument("--skip-serve", action="store_true",
+                   help="skip the serving-engine target")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="suppress per-finding output; summary only")
+    return p
+
+
+def _build_captured_gpt() -> None:
+    """The bench GPT captured step (gpt_tiny class, same model family
+    bench.py trains): capturing it with the auditor enabled routes the
+    program through the production capture hook."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu.incubate.models import (GPTForCausalLM,
+                                            GPTPretrainingCriterion,
+                                            gpt_tiny)
+
+    pt.seed(0)
+    cfg = gpt_tiny(tensor_parallel=False, use_recompute=False)
+    model = GPTForCausalLM(cfg)
+    crit = GPTPretrainingCriterion()
+    opt = pt.optimizer.AdamW(learning_rate=1e-4,
+                             parameters=model.parameters())
+
+    @pt.jit.capture_step
+    def gpt_step(ids, labels):
+        loss = crit(model(ids), labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    rng = np.random.RandomState(0)
+    batch, seq = 2, 32
+    ids = pt.to_tensor(rng.randint(0, cfg.vocab_size,
+                                   (batch, seq)).astype(np.int64))
+    labels = pt.to_tensor(rng.randint(0, cfg.vocab_size,
+                                      (batch, seq)).astype(np.int64))
+    gpt_step(ids, labels)          # first replay: compile + audit hook
+
+
+def _build_served_engine() -> None:
+    """A tiny served-model dir loaded through ``load_engine`` — the
+    production load path, so every AOT bucket program passes through
+    the serving audit hook."""
+    from paddle_tpu.serving import (ModelSpec, ServeConfig, init_params,
+                                    load_engine, save_served_model)
+
+    spec = ModelSpec(vocab_size=64, hidden=32, layers=2, heads=2,
+                     max_seq_len=64)
+    cfg = ServeConfig(decode_buckets=(4,), prefill_buckets=(16,),
+                      kv_pages=32, page_size=4, max_inflight=16,
+                      max_new_tokens=8)
+    with tempfile.TemporaryDirectory(prefix="pt_audit_serve_") as root:
+        save_served_model(root, spec, init_params(spec, seed=0),
+                          config=cfg)
+        engine = load_engine(root)
+        engine.close()
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rid, name, rationale in rule_catalog():
+            print(f"{rid}  {name}")
+            print(f"       {rationale}")
+        return 0
+
+    select = ([r.strip().upper() for r in args.select.split(",")
+               if r.strip()] if args.select else None)
+    if select and not all(s in {r[0] for r in rule_catalog()}
+                          for s in select):
+        print(f"graph-audit: unknown rule in --select: {args.select}",
+              file=sys.stderr)
+        return 2
+    if select is not None:
+        # narrow the hook-side rule set for this process too
+        import os
+        keep = set(select)
+        disabled = [rid for rid, _, _ in rule_catalog()
+                    if rid not in keep]
+        os.environ["PT_AUDIT_DISABLE"] = ",".join(disabled)
+
+    runtime.reset()
+    runtime.enable()
+    errors = []
+    try:
+        if not args.skip_capture:
+            try:
+                _build_captured_gpt()
+            except Exception as e:
+                errors.append(f"captured GPT step build failed: "
+                              f"{type(e).__name__}: {e}")
+        if not args.skip_serve:
+            try:
+                _build_served_engine()
+            except Exception as e:
+                errors.append(f"serving engine build failed: "
+                              f"{type(e).__name__}: {e}")
+        found = runtime.findings()
+    finally:
+        runtime.reset()
+
+    for msg in errors:
+        print(f"graph-audit: ERROR {msg}", file=sys.stderr)
+
+    baseline_path = args.baseline or default_baseline_path()
+    if args.write_baseline:
+        n = write_baseline(baseline_path, found)
+        print(f"graph-audit: wrote {n} baseline entr"
+              f"{'y' if n == 1 else 'ies'} to {baseline_path}")
+        return 0
+
+    if args.no_baseline:
+        new, old, stale = found, [], []
+    else:
+        new, old, stale = diff_against_baseline(
+            found, load_baseline(baseline_path))
+
+    if not args.quiet:
+        for f in new:
+            print(f.render())
+        for k in stale:
+            print(f"stale baseline entry (finding no longer present — "
+                  f"prune it): {k}", file=sys.stderr)
+
+    summary = (f"graph-audit: {len(new)} new finding"
+               f"{'' if len(new) == 1 else 's'}")
+    if old:
+        summary += f", {len(old)} baselined"
+    if stale:
+        summary += f", {len(stale)} stale baseline entries"
+    if errors:
+        summary += f", {len(errors)} build errors"
+    print(summary)
+    return 1 if (new or errors) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

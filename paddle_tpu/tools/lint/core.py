@@ -63,31 +63,66 @@ class Suppressions:
     A directive on a code line suppresses that line; a directive on a
     standalone comment line suppresses the next line (pylint semantics).
     ``disable=all`` suppresses every rule.
+
+    Violations are reported at a node's FIRST physical line, but a
+    multi-line statement may only have room for the directive on a
+    later one (e.g. after the closing paren of a wrapped call) —
+    :meth:`is_suppressed` therefore takes the node's full line span
+    and honors a directive anywhere inside it.
     """
 
     def __init__(self, source: str):
         self._by_line: dict[int, set[str]] = {}
         try:
+            # a trailing directive anywhere on a multi-line statement
+            # must cover the WHOLE logical line: the violation reports
+            # at the statement's first physical line, while the closing
+            # paren is often the only line with room for the comment.
+            # Track the current logical line and spread pending
+            # directives over its span at the NEWLINE that ends it.
+            pending: list[set[str]] = []
+            logical_start: int | None = None
             tokens = tokenize.generate_tokens(io.StringIO(source).readline)
             for tok in tokens:
-                if tok.type != tokenize.COMMENT:
+                if tok.type == tokenize.COMMENT:
+                    m = _DIRECTIVE.search(tok.string)
+                    if not m:
+                        continue
+                    rules = {r.strip().upper()
+                             for r in m.group(1).split(",") if r.strip()}
+                    line = tok.start[0]
+                    self._by_line.setdefault(line, set()).update(rules)
+                    if tok.line.lstrip().startswith("#"):
+                        # standalone comment: applies to the next line
+                        self._by_line.setdefault(line + 1,
+                                                 set()).update(rules)
+                    else:
+                        pending.append(rules)
                     continue
-                m = _DIRECTIVE.search(tok.string)
-                if not m:
+                if tok.type == tokenize.NEWLINE:
+                    if pending and logical_start is not None:
+                        for ln in range(logical_start, tok.start[0] + 1):
+                            for rules in pending:
+                                self._by_line.setdefault(
+                                    ln, set()).update(rules)
+                    pending, logical_start = [], None
                     continue
-                rules = {r.strip().upper()
-                         for r in m.group(1).split(",") if r.strip()}
-                line = tok.start[0]
-                self._by_line.setdefault(line, set()).update(rules)
-                if tok.line.lstrip().startswith("#"):
-                    # standalone comment: applies to the following line
-                    self._by_line.setdefault(line + 1, set()).update(rules)
+                if tok.type in (tokenize.NL, tokenize.INDENT,
+                                tokenize.DEDENT, tokenize.ENDMARKER):
+                    continue
+                if logical_start is None:
+                    logical_start = tok.start[0]
         except (tokenize.TokenError, IndentationError, SyntaxError):
             pass  # unparseable comments never block the AST pass
 
-    def is_suppressed(self, rule: str, line: int) -> bool:
-        s = self._by_line.get(line)
-        return bool(s) and ("ALL" in s or rule.upper() in s)
+    def is_suppressed(self, rule: str, line: int,
+                      end_line: int | None = None) -> bool:
+        rule = rule.upper()
+        for ln in range(line, max(end_line or line, line) + 1):
+            s = self._by_line.get(ln)
+            if s and ("ALL" in s or rule in s):
+                return True
+        return False
 
 
 # -- scope bookkeeping ------------------------------------------------------
@@ -282,7 +317,17 @@ class Linter(ast.NodeVisitor):
 
     def report(self, node: ast.AST, rule: str, message: str):
         line = getattr(node, "lineno", 1)
-        if self.suppressions.is_suppressed(rule, line):
+        # honor a directive anywhere on the node's physical span: for
+        # compound statements (With/For/If...) the span stops before
+        # the body so a directive deep inside a block never bleeds
+        # onto the header's own violations
+        body = getattr(node, "body", None)
+        if isinstance(body, list) and body \
+                and hasattr(body[0], "lineno"):
+            end = max(line, body[0].lineno - 1)
+        else:
+            end = getattr(node, "end_lineno", None) or line
+        if self.suppressions.is_suppressed(rule, line, end):
             return
         self.violations.append(Violation(
             self.path, line, getattr(node, "col_offset", 0) + 1,

@@ -1113,6 +1113,70 @@ class HostSideNanCheck(Rule):
 
 
 @register
+class ImportTimeEnvRead(Rule):
+    id = "TPU020"
+    name = "env-read-at-import-time"
+    rationale = ("os.environ read at module import time freezes the "
+                 "value at whatever the environment held when the module "
+                 "first loaded — exports made after import are silently "
+                 "ignored, tests can't override the knob without a "
+                 "module reload, and the launcher's per-worker env "
+                 "injection races the import order; read the variable "
+                 "lazily inside the function that needs it (the repo's "
+                 "PT_* knobs all resolve at call time for this reason). "
+                 "tools/, tests and CLI entry points are exempt")
+
+    _ENV_CALLS = {"os.getenv", "getenv", "os.environ.get", "environ.get",
+                  "os.environ.setdefault", "environ.setdefault"}
+    _ENV_OBJS = {"os.environ", "environ"}
+
+    def _applicable(self, node, ctx):
+        # module scope only (class bodies included — they run at
+        # import); function bodies are the lazy pattern we want
+        if not ctx.library_path or ctx.func_stack:
+            return False
+        # a module-level `lambda: os.getenv(...)` defers the read — the
+        # Linter doesn't push a scope for lambdas, so span-check here
+        spans = getattr(ctx, "_tpu020_lambda_spans", None)
+        if spans is None:
+            spans = [(n.lineno, getattr(n, "end_lineno", n.lineno))
+                     for n in ast.walk(ctx._tree)
+                     if isinstance(n, ast.Lambda)]
+            ctx._tpu020_lambda_spans = spans
+        line = getattr(node, "lineno", 0)
+        return not any(lo <= line <= hi for lo, hi in spans)
+
+    def on_call(self, node, ctx):
+        if not self._applicable(node, ctx):
+            return
+        name = dotted(node.func)
+        if name in self._ENV_CALLS:
+            ctx.report(node, self.id,
+                       f"{name}() at module import time pins the value "
+                       f"at first-load; resolve the variable lazily "
+                       f"inside the function that uses it")
+
+    def on_assign(self, node, ctx):
+        # subscript reads (`X = os.environ["K"]`) aren't calls; catch
+        # them on the assignment event
+        if not self._applicable(node, ctx):
+            return
+        value = getattr(node, "value", None)
+        if value is None:
+            return
+        for sub in ast.walk(value):
+            if (isinstance(sub, ast.Subscript)
+                    and isinstance(sub.ctx, ast.Load)
+                    and dotted(sub.value) in self._ENV_OBJS):
+                ctx.report(node, self.id,
+                           f"{dotted(sub.value)}[...] read at module "
+                           f"import time pins the value at first-load; "
+                           f"resolve the variable lazily inside the "
+                           f"function that uses it")
+                return
+
+
+@register
 class RequestPathCompile(Rule):
     id = "TPU019"
     name = "request-path-compile"

@@ -14,12 +14,17 @@ import os.path as osp
 import tarfile
 import zipfile
 
-__all__ = ["is_url", "get_weights_path_from_url", "get_path_from_url"]
+__all__ = ["is_url", "get_weights_path_from_url", "get_path_from_url",
+           "weights_home"]
 
-WEIGHTS_HOME = osp.join(
-    os.environ.get("PADDLE_TPU_HOME",
-                   osp.join(osp.expanduser("~"), ".cache", "paddle_tpu")),
-    "weights")
+def weights_home() -> str:
+    """Weight cache root — resolved lazily so ``PADDLE_TPU_HOME`` set
+    after import (tests, launchers) is honored."""
+    return osp.join(
+        os.environ.get("PADDLE_TPU_HOME",
+                       osp.join(osp.expanduser("~"), ".cache",
+                                "paddle_tpu")),
+        "weights")
 
 
 def is_url(path):
@@ -27,14 +32,14 @@ def is_url(path):
 
 
 def _search_dirs():
-    dirs = [WEIGHTS_HOME]
+    dirs = [weights_home()]
     extra = os.environ.get("PADDLE_TPU_WEIGHT_PATH", "")
     dirs += [d for d in extra.split(os.pathsep) if d]
     return dirs
 
 
 def get_weights_path_from_url(url, md5sum=None):
-    return get_path_from_url(url, WEIGHTS_HOME, md5sum)
+    return get_path_from_url(url, weights_home(), md5sum)
 
 
 def get_path_from_url(url, root_dir=None, md5sum=None, check_exist=True,
@@ -55,7 +60,7 @@ def get_path_from_url(url, root_dir=None, md5sum=None, check_exist=True,
             return fullname
     raise RuntimeError(
         f"cannot fetch {url}: this build runs without network access. "
-        f"Place the file at {osp.join(root_dir or WEIGHTS_HOME, fname)} "
+        f"Place the file at {osp.join(root_dir or weights_home(), fname)} "
         f"or add its directory to $PADDLE_TPU_WEIGHT_PATH.")
 
 

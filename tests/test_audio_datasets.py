@@ -19,12 +19,10 @@ def _tone(i):
 
 @pytest.fixture
 def data_home(tmp_path, monkeypatch):
+    # data_home() resolves the env var lazily, so a plain setenv is
+    # enough — no module-attribute surgery
     home = str(tmp_path)
-    import paddle_tpu.audio.datasets.dataset as dsm
-    import paddle_tpu.audio.datasets.tess as tm
-    import paddle_tpu.audio.datasets.esc50 as em
-    for mod in (dsm, tm, em):
-        monkeypatch.setattr(mod, "DATA_HOME", home)
+    monkeypatch.setenv("PADDLE_TPU_DATA_HOME", home)
     return home
 
 
