@@ -51,6 +51,11 @@ def parse_args(argv=None):
     ap.add_argument("--layers", type=int, default=2)
     ap.add_argument("--heads", type=int, default=4)
     ap.add_argument("--max-seq", type=int, default=128)
+    ap.add_argument("--deadline-ms", type=float, default=0.0,
+                    help="per-request deadline budget in ms (0 = none); "
+                         "each request samples uniformly from "
+                         "[0.75x, 1.25x] so admission control sees a "
+                         "distribution, not a step function")
     ap.add_argument("--canary-timeout", type=float, default=120.0,
                     help="seconds before declaring the device "
                          "unreachable (fast-fail)")
@@ -81,6 +86,7 @@ def main(argv=None):
         "streams": args.streams,
         "rate": args.rate,
         "max_new_tokens": args.max_new,
+        "deadline_ms": args.deadline_ms or None,
         "platform": os.environ.get("JAX_PLATFORMS", ""),
     }
 
@@ -115,13 +121,19 @@ def main(argv=None):
         record["audit"] = {"enabled": False, "programs": [],
                            "findings": 0, "by_rule": {},
                            "by_severity": {}}
+        # resilience accounting rides the fast-fail record too, zeroed:
+        # downstream dashboards key on the fields existing every run
+        record.update({"shed_total": 0, "cancelled_total": 0,
+                       "deadline_exceeded_total": 0, "goodput": None})
         emit(record, args.out)
         return 1
 
     from paddle_tpu.observability.telemetry import get_telemetry
     from paddle_tpu.serving import (ModelSpec, ServeConfig, ServingEngine,
                                     init_params)
-    from paddle_tpu.serving.scheduler import EngineSaturated
+    from paddle_tpu.serving.scheduler import (DeadlineExceeded,
+                                              EngineSaturated,
+                                              RequestShed)
 
     get_telemetry().enable()  # metrics + compile watcher
     # graph audit on for the AOT build: every bucket executable's traced
@@ -161,28 +173,42 @@ def main(argv=None):
 
     streams = [None] * args.streams
     saturation_retries = 0
+    shed_at_submit = 0
     t_load0 = time.monotonic()
     for i, prompt in enumerate(prompts):
         # open-loop Poisson arrivals: the schedule does not slow down
         # when the engine backs up — that pressure is the point
         if args.rate > 0:
             time.sleep(float(rng.exponential(1.0 / args.rate)))
+        deadline_ms = (float(rng.uniform(0.75, 1.25)) * args.deadline_ms
+                       if args.deadline_ms > 0 else None)
         while streams[i] is None:
             try:
                 streams[i] = engine.scheduler.submit(
-                    prompt, max_new_tokens=args.max_new)
+                    prompt, max_new_tokens=args.max_new,
+                    deadline_ms=deadline_ms)
             except EngineSaturated:
                 saturation_retries += 1
                 time.sleep(0.002)
+            except RequestShed:
+                # a shed request is NOT retried — admission control
+                # refusing infeasible work is the behaviour under test
+                shed_at_submit += 1
+                break
 
     errors = {}
     latencies = []
     tokens_generated = 0
+    deadline_losses = 0
     for i, st in enumerate(streams):
+        if st is None:
+            continue  # shed at admission
         try:
             out = st.result(timeout=args.result_timeout)
             tokens_generated += len(out)
             latencies.append(st.latency)
+        except DeadlineExceeded:
+            deadline_losses += 1
         except Exception as e:
             errors[f"stream_{i}"] = str(e)
     t_load = time.monotonic() - t_load0
@@ -218,9 +244,21 @@ def main(argv=None):
         "zero_compile_after_warmup": engine.unexpected_compiles == 0,
         "healthz_ok": engine.healthz()["ok"],
         "audit": audit_rt.snapshot(),
+        # resilience accounting: under a deadline regime shed/expired
+        # requests are EXPECTED losses — goodput is the figure of merit
+        "shed_total": sched["shed"],
+        "cancelled_total": sched["cancelled"],
+        "deadline_exceeded_total": sched["deadline_exceeded"],
+        "goodput": round(len(latencies) / args.streams, 4)
+        if args.streams else None,
     })
+    # with no deadline regime every stream must complete; with one,
+    # shed + expired requests are the shedder doing its job — the run
+    # passes on zero UNEXPECTED errors and zero request-path compiles
+    expected_done = (args.streams - shed_at_submit - deadline_losses
+                     if args.deadline_ms > 0 else args.streams)
     record["ok"] = (not errors
-                    and len(latencies) == args.streams
+                    and len(latencies) == expected_done
                     and engine.unexpected_compiles == 0)
     record["bench_wall_sec"] = round(time.time() - t_start, 1)
     engine.close()

@@ -218,8 +218,12 @@ class CheckpointManager:
     def wait(self):
         """Drain any in-flight async save; re-raises its failure."""
         t, self._inflight = self._inflight, None
-        if t is not None:
-            t.join()
+        while t is not None and t.is_alive():
+            t.join(timeout=60.0)
+            if t.is_alive():
+                logging.getLogger("paddle_tpu.checkpoint").warning(
+                    "async save %s still writing after 60s; waiting",
+                    t.name)
         self._raise_pending()
 
     # -- restore ------------------------------------------------------------

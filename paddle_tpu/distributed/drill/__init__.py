@@ -61,6 +61,17 @@ cross-rank recompile-storm alarm, stale-marking of a SIGKILLed rank
 ``observability.merge`` CLI stitching per-rank telemetry JSONL into
 one time-ordered stream.
 
+Serve chaos drills (:func:`.runner.run_serve_chaos_drill`) point the
+same real-subprocess discipline at the serving plane: a real engine
+(``python -m paddle_tpu.serving``) is SIGKILLed mid-decode (the
+relaunch must rebuild its AOT ladder, report a clean page pool, and
+serve bit-identically to a solo-decode oracle with zero request-path
+compiles), deadline-stormed (every infeasible deadline shed 429 +
+Retry-After, zero page leaks afterward), abandoned by a disconnecting
+client (cancelled, pages recovered), and finally SIGTERMed under load
+(in-flight requests complete in full, drain-window admission answers
+503, exit code 143).
+
 Trace drills (:func:`.runner.run_trace_drill`) exercise the step
 tracer: every worker records a deterministic staggered
 compute/collective step profile, exports a per-rank Chrome trace and
@@ -107,10 +118,11 @@ above one half.
 __all__ = ["KillSpec", "StoreKillSpec", "ObsSpec", "TraceSpec",
            "NumericsSpec", "OomSpec", "run_drill",
            "run_store_kill_drill", "run_scrape_drill",
+           "run_serve_chaos_drill",
            "run_trace_drill", "run_numerics_drill", "run_oom_drill",
            "run_overlap_drill", "run_sharded_overlap_drill",
            "spawn_worker", "spawn_store_master", "spawn_aggregator",
-           "reap_all"]
+           "spawn_serve_worker", "reap_all"]
 
 
 def __getattr__(name):

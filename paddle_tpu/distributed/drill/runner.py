@@ -38,8 +38,10 @@ from .worker import (EXIT_NUMERICS_HALT, EXIT_OOM, EXIT_SAVE_FAILED,
 
 __all__ = ["KillSpec", "StoreKillSpec", "ObsSpec", "TraceSpec",
            "NumericsSpec", "OomSpec", "DrillFailure", "spawn_worker",
-           "spawn_store_master", "spawn_aggregator", "run_drill",
+           "spawn_store_master", "spawn_aggregator",
+           "spawn_serve_worker", "run_drill",
            "run_store_kill_drill", "run_scrape_drill",
+           "run_serve_chaos_drill",
            "run_trace_drill", "run_numerics_drill", "run_oom_drill",
            "run_overlap_drill", "run_sharded_overlap_drill",
            "reap_all"]
@@ -87,11 +89,11 @@ class ObsSpec:
 
     __slots__ = ("telemetry_dir", "step_base", "storm",
                  "sentinel_threshold", "hold_timeout", "anomalies",
-                 "mem_bytes")
+                 "mem_bytes", "shed", "served")
 
     def __init__(self, telemetry_dir, step_base=0.01, storm=True,
                  sentinel_threshold=3, hold_timeout=120.0,
-                 anomalies=0, mem_bytes=0):
+                 anomalies=0, mem_bytes=0, shed=0, served=0):
         self.telemetry_dir = telemetry_dir
         self.step_base = float(step_base)
         self.storm = bool(storm)
@@ -102,6 +104,12 @@ class ObsSpec:
         # (mem_bytes * (1 + rank)) so the aggregator's skew/near-OOM
         # derivations are assertable
         self.mem_bytes = int(mem_bytes)
+        # scripted serve admission profile: each rank books ``shed``
+        # load-shed refusals and ``served`` accepted requests, so the
+        # aggregator's fleet shed ratio is exactly
+        # shed / (shed + served) and its shed-storm alarm assertable
+        self.shed = int(shed)
+        self.served = int(served)
 
 
 class TraceSpec:
@@ -255,6 +263,10 @@ def spawn_worker(rank, world, *, root, port=0, total_steps, run_id,
             env["DRILL_OBS_ANOMALIES"] = str(obs.anomalies)
         if obs.mem_bytes:
             env["DRILL_OBS_MEM_BYTES"] = str(obs.mem_bytes)
+        if obs.shed:
+            env["DRILL_OBS_SHED"] = str(obs.shed)
+        if obs.served:
+            env["DRILL_OBS_SERVED"] = str(obs.served)
     if trace is not None:
         env["DRILL_TRACE"] = "1"
         env["DRILL_TRACE_DIR"] = trace.trace_dir
@@ -336,6 +348,7 @@ def spawn_store_master(*, endpoint_file, wal_path=None, port=0,
 def spawn_aggregator(*, endpoint_file, run_id, port_file,
                      interval=0.25, stale_after=2.0, storm_threshold=1,
                      anomaly_threshold=10, mem_threshold=0,
+                     shed_threshold=0.0,
                      scrape_timeout=2.0, store_deadline=10.0,
                      log_path=None, spawn_timeout=60.0):
     """Launch the cluster aggregator as a REAL subprocess
@@ -362,6 +375,8 @@ def spawn_aggregator(*, endpoint_file, run_id, port_file,
            "--anomaly-threshold", str(anomaly_threshold)]
     if mem_threshold:
         cmd += ["--mem-threshold", str(mem_threshold)]
+    if shed_threshold:
+        cmd += ["--shed-threshold", str(shed_threshold)]
     if log_path:
         with open(log_path, "ab") as out:
             p = subprocess.Popen(cmd, env=env, stdout=out,
@@ -427,7 +442,9 @@ def _wait_fleet(procs, timeout):
         raise DrillFailure(f"drill generation hung: {e}") from e
     rcs = []
     for p in procs:
-        rcs.append(p.wait())
+        # poll() above proved exit; the wait just reaps, so a short
+        # bound is safe
+        rcs.append(p.wait(timeout=5.0))
         _LIVE.discard(p)
     return rcs
 
@@ -704,6 +721,7 @@ def run_store_kill_drill(root, *, world=2, total_steps=5, kill_step=3,
 def run_scrape_drill(root, *, world=3, steps=12, step_base=0.01,
                      kill_rank=2, storm=True, anomalies=0,
                      mem_bytes=0, mem_threshold=0,
+                     shed=0, served=0, shed_threshold=0.0,
                      restart_aggregator=False,
                      respawn_master=False, stale_after=2.0,
                      scrape_interval=0.25, store_deadline=10.0,
@@ -727,7 +745,13 @@ def run_scrape_drill(root, *, world=3, steps=12, step_base=0.01,
     ``mem_bytes * (1 + r)``) so the cluster memory-skew gauge must
     read exactly ``mem_bytes * (world - 1)``; with ``mem_threshold``
     at or below ``mem_bytes * world`` the near-OOM alarm must trip and
-    flip /healthz to 503 on the memory signal alone.
+    flip /healthz to 503 on the memory signal alone.  ``shed`` /
+    ``served`` script a per-rank serve admission profile (each rank
+    books that many ``pt_serve_shed_total`` refusals and accepted
+    requests), pinning the aggregator's fleet shed ratio to exactly
+    ``shed / (shed + served)``; with ``shed_threshold`` at or below
+    that ratio the shed-storm alarm must trip and flip /healthz to
+    503 on the load-shedding signal alone.
 
     ``kill_rank`` (None to skip) is then SIGKILLed while still holding
     its endpoint open: the aggregator must mark it stale
@@ -760,10 +784,15 @@ def run_scrape_drill(root, *, world=3, steps=12, step_base=0.01,
     spec = ObsSpec(telemetry_dir=telemetry_dir, step_base=step_base,
                    storm=storm, sentinel_threshold=sentinel_threshold,
                    hold_timeout=gen_timeout, anomalies=anomalies,
-                   mem_bytes=mem_bytes)
+                   mem_bytes=mem_bytes, shed=shed, served=served)
     mem_alarm_expected = bool(
         mem_bytes and mem_threshold
         and mem_bytes * world >= mem_threshold)
+    shed_ratio_expected = (
+        shed / float(shed + served) if (shed or served) else None)
+    shed_alarm_expected = bool(
+        shed_threshold and shed_ratio_expected is not None
+        and shed_ratio_expected >= shed_threshold)
     report = {"run_id": run_id, "world": world, "steps": steps,
               "aggregator_restarted": False, "master_respawned": False}
     watch = None
@@ -792,6 +821,7 @@ def run_scrape_drill(root, *, world=3, steps=12, step_base=0.01,
             stale_after=stale_after, storm_threshold=storm_threshold,
             anomaly_threshold=anomaly_threshold,
             mem_threshold=mem_threshold,
+            shed_threshold=shed_threshold,
             store_deadline=store_deadline,
             log_path=_log("aggregator.log"))
         base = f"http://{ahost}:{aport}"
@@ -873,7 +903,8 @@ def run_scrape_drill(root, *, world=3, steps=12, step_base=0.01,
             if alarm not in (0.0, None):
                 raise DrillFailure(
                     f"storm alarm tripped ({alarm}) without a storm")
-            want = 503 if (anomalies or mem_alarm_expected) else 200
+            want = 503 if (anomalies or mem_alarm_expected
+                           or shed_alarm_expected) else 200
             if status != want:
                 raise DrillFailure(
                     f"/healthz returned {status}, expected {want}")
@@ -937,6 +968,40 @@ def run_scrape_drill(root, *, world=3, steps=12, step_base=0.01,
             raise DrillFailure(
                 f"memory alarm tripped ({mem_alarm}) without scripted "
                 f"watermarks")
+
+        # --- fleet load-shedding view: shed ratio + shed-storm trip --
+        shed_total = _sample_value(fams, "pt_cluster_serve_shed_total")
+        shed_ratio = _sample_value(fams, "pt_cluster_serve_shed_ratio")
+        shed_alarm = _sample_value(fams, "pt_cluster_serve_shed_alarm")
+        if shed or served:
+            if shed_total != float(world * shed):
+                raise DrillFailure(
+                    f"pt_cluster_serve_shed_total is {shed_total!r}, "
+                    f"expected {world * shed} (scripted sheds summed "
+                    f"across ranks)")
+            if shed_ratio is None \
+                    or abs(shed_ratio - shed_ratio_expected) > 1e-6:
+                raise DrillFailure(
+                    f"pt_cluster_serve_shed_ratio is {shed_ratio!r}; "
+                    f"the scripted admission profile pins it to "
+                    f"{shed_ratio_expected}")
+            if shed_alarm != (1.0 if shed_alarm_expected else 0.0):
+                raise DrillFailure(
+                    f"shed-storm alarm is {shed_alarm!r}, expected "
+                    f"{shed_alarm_expected} at threshold "
+                    f"{shed_threshold} with ratio {shed_ratio_expected}")
+            hserve = health.get("serve") or {}
+            if hserve.get("shed_total") != world * shed \
+                    or bool(hserve.get("shed_alarm")) \
+                    != shed_alarm_expected:
+                raise DrillFailure(
+                    f"/healthz serve block {hserve!r} disagrees with "
+                    f"the scripted shed profile (total {world * shed},"
+                    f" alarm {shed_alarm_expected})")
+        elif shed_alarm not in (0.0, None):
+            raise DrillFailure(
+                f"shed-storm alarm tripped ({shed_alarm}) without "
+                f"scripted sheds")
         report.update({
             "skew_seconds": skew, "straggler_ratio": straggler,
             "merged_steps": hist_count, "storms_total": storms_total,
@@ -946,6 +1011,9 @@ def run_scrape_drill(root, *, world=3, steps=12, step_base=0.01,
             "anomaly_alarm": anomaly_alarm,
             "memory_skew_bytes": mem_skew,
             "memory_alarm": mem_alarm,
+            "shed_total": shed_total,
+            "shed_ratio": shed_ratio,
+            "shed_alarm": shed_alarm,
         })
 
         if respawn_master:
@@ -1786,3 +1854,389 @@ def run_sharded_overlap_drill(root, *, layers=8, hidden=256,
     }
     return _write_overlap_report(root, "sharded_overlap_report.json",
                                  report)
+
+
+# -- serving chaos drill -----------------------------------------------------
+
+def _http_post(url, obj, timeout=30.0):
+    """Bounded JSON POST returning (status, body-text, headers); 4xx/5xx
+    responses return their body instead of raising."""
+    data = json.dumps(obj).encode("utf-8")
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read().decode("utf-8"), resp.headers
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode("utf-8"), e.headers
+
+
+def spawn_serve_worker(*, root, name, spec, seed=0, request_timeout=60.0,
+                       env_extra=None, log_path=None, spawn_timeout=240.0):
+    """Launch the serving engine as a REAL subprocess
+    (``python -m paddle_tpu.serving --spec ...``) and wait for it to
+    build its AOT ladder and publish ``host:port`` into
+    ``<root>/<name>.endpoint``.  Returns ``(Popen, (host, port))``;
+    registered for :func:`reap_all`."""
+    port_file = os.path.join(root, f"{name}.endpoint")
+    try:
+        os.unlink(port_file)
+    except FileNotFoundError:
+        pass
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(env_extra or {})
+    cmd = [sys.executable, "-m", "paddle_tpu.serving",
+           "--spec", json.dumps(spec), "--seed", str(seed),
+           "--port-file", port_file,
+           "--request-timeout", str(request_timeout)]
+    if log_path:
+        with open(log_path, "ab") as out:
+            p = subprocess.Popen(cmd, env=env, stdout=out,
+                                 stderr=subprocess.STDOUT)
+    else:
+        p = subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
+                             stderr=subprocess.DEVNULL)
+    _LIVE.add(p)
+
+    def _published():
+        if p.poll() is not None:
+            raise DrillFailure(
+                f"serve worker {name} died during startup "
+                f"(rc {p.poll()})")
+        return read_endpoint_file(port_file)
+
+    try:
+        # the endpoint lands only AFTER the AOT ladder finished
+        # compiling, so this wait covers the whole cold start
+        ep = wait_until(_published, spawn_timeout,
+                        desc=f"serve worker {name} to publish its "
+                             f"endpoint")
+    except TimeoutError as e:
+        raise DrillFailure(f"serve worker {name} never came up: {e}") \
+            from e
+    logger.info("serve worker %s pid %d at %s:%d", name, p.pid,
+                ep[0], ep[1])
+    return p, ep
+
+
+def run_serve_chaos_drill(root, *, max_new=8, storm_requests=6,
+                          request_timeout=60.0, gen_timeout=240.0,
+                          log_dir=None):
+    """End-to-end serving resilience drill against REAL engine
+    subprocesses (``python -m paddle_tpu.serving``), with an in-process
+    solo-decode oracle built from the same ModelSpec + seed:
+
+     1. **SIGKILL mid-decode** — generation 1 is killed while /healthz
+        shows active sequences; nothing survives it but the OS.
+     2. **Relaunch recovers** — generation 2 rebuilds the AOT ladder
+        from scratch, reports a consistent empty page pool, serves
+        every prompt with tokens bit-identical to the oracle's solo
+        decode, and books ZERO request-path compiles.
+     3. **Deadline storm sheds, never breaks** — after a warm request
+        seeds the throughput EWMA, ``storm_requests`` infeasible
+        deadlines (``deadline_ms=0.001``) must ALL be refused with 429
+        + ``Retry-After`` (shed, not queued), while an interleaved
+        generous request still returns bit-identical tokens; the shed
+        counter accounts for every refusal and the pool ends the storm
+        with zero used/reserved pages.
+     4. **Disconnecting client** — a caller that drops its socket
+        mid-request is cancelled (``cause="disconnect"``) and its
+        pages come back.
+     5. **SIGTERM graceful drain** — in-flight requests submitted just
+        before SIGTERM all complete with FULL token counts (no partial
+        responses), a request posted during the drain window is
+        refused 503 ``draining``, and the process exits 143.
+
+    Returns a report dict; raises :class:`DrillFailure` on any broken
+    invariant.
+    """
+    import threading
+
+    spec = {"vocab_size": 128, "hidden": 64, "layers": 4, "heads": 2,
+            "max_seq_len": 64}
+    seed = 7
+    prompts = [[3, 1, 4, 1, 5], [2, 7, 18, 28], [31, 41, 5, 9, 26, 53]]
+    env_serve = {
+        "PT_SERVE_BUCKETS": "2,4",
+        "PT_SERVE_PREFILL_BUCKETS": "16",
+        "PT_SERVE_KV_PAGES": "64",
+        "PT_SERVE_PAGE_SIZE": "8",
+        "PT_SERVE_DRAIN_S": "20",
+    }
+
+    def _log(name):
+        return os.path.join(log_dir, name) if log_dir else None
+
+    # ---- the oracle: same spec + seed, solo decode in-process -------
+    from ...serving import (ModelSpec, ServeConfig, ServingEngine,
+                            init_params)
+    mspec = ModelSpec.from_dict(spec)
+    cfg = ServeConfig(decode_buckets=(2, 4), prefill_buckets=(16,),
+                      kv_pages=64, page_size=8)
+    oracle_engine = ServingEngine(mspec, init_params(mspec, seed), cfg)
+    oracle = [oracle_engine.generate([p], max_new_tokens=max_new)[0]
+              for p in prompts]
+    oracle_engine.scheduler.stop()
+
+    report = {"oracle_lens": [len(t) for t in oracle]}
+
+    def _healthz(base):
+        status, body = _http_get(base + "/healthz", timeout=5.0)
+        return status, json.loads(body)
+
+    # ---- leg 1: SIGKILL mid-decode ----------------------------------
+    p1, (h1, port1) = spawn_serve_worker(
+        root=root, name="serve_gen1", spec=spec, seed=seed,
+        request_timeout=request_timeout, env_extra=env_serve,
+        log_path=_log("serve_gen1.log"), spawn_timeout=gen_timeout)
+    base1 = f"http://{h1}:{port1}"
+
+    def _fire(base, body, out):
+        try:
+            out.append(_http_post(base + "/v1/generate", body,
+                                  timeout=request_timeout))
+        except OSError as e:       # the SIGKILL resets these sockets
+            out.append(("conn-error", str(e), None))
+
+    doomed = []
+    threads = [
+        threading.Thread(
+            target=_fire, daemon=True,
+            args=(base1,
+                  {"tokens": prompts[i % len(prompts)],
+                   "max_new_tokens": 48},
+                  doomed))
+        for i in range(6)
+    ]
+    for t in threads:
+        t.start()
+
+    def _busy():
+        _status, health = _healthz(base1)
+        snap = health.get("active_sequences", 0) or 0
+        return True if snap > 0 else None
+
+    wait_until(_busy, gen_timeout / 4,
+               desc="generation 1 to show active decode sequences")
+    p1.kill()
+    rc1 = p1.wait(timeout=30)
+    _LIVE.discard(p1)
+    if rc1 != -signal.SIGKILL:
+        raise DrillFailure(
+            f"generation 1 exited {rc1}, expected SIGKILL (-9)")
+    for t in threads:
+        t.join(timeout=request_timeout)
+    report["gen1_rc"] = rc1
+
+    # ---- leg 2: relaunch recovers, zero request-path compiles -------
+    p2, (h2, port2) = spawn_serve_worker(
+        root=root, name="serve_gen2", spec=spec, seed=seed,
+        request_timeout=request_timeout, env_extra=env_serve,
+        log_path=_log("serve_gen2.log"), spawn_timeout=gen_timeout)
+    base2 = f"http://{h2}:{port2}"
+    try:
+        status, health = _healthz(base2)
+        if status != 200 or not health.get("ok"):
+            raise DrillFailure(
+                f"relaunched engine unhealthy: {status} {health}")
+        kv = health.get("kv") or {}
+        if kv.get("used_pages") or kv.get("reserved_pages") \
+                or not health.get("kv_consistent"):
+            raise DrillFailure(
+                f"relaunched page pool not a clean slate: {kv}")
+        for i, prompt in enumerate(prompts):
+            status, body, _hdrs = _http_post(
+                base2 + "/v1/generate",
+                {"tokens": prompt, "max_new_tokens": max_new},
+                timeout=request_timeout)
+            if status != 200:
+                raise DrillFailure(
+                    f"relaunched engine refused prompt {i}: "
+                    f"{status} {body}")
+            tokens = json.loads(body)["tokens"]
+            if tokens != oracle[i]:
+                raise DrillFailure(
+                    f"prompt {i} after relaunch decoded {tokens}, "
+                    f"oracle solo decode says {oracle[i]} — "
+                    f"recovery broke bit-identity")
+        _status, health = _healthz(base2)
+        if health.get("unexpected_compiles"):
+            raise DrillFailure(
+                f"{health['unexpected_compiles']} request-path "
+                f"compiles after relaunch — the AOT ladder has a hole")
+        report["gen2_recovered"] = True
+
+        # ---- leg 3: deadline storm sheds, never breaks --------------
+        shed_429 = 0
+        for _ in range(storm_requests):
+            status, body, hdrs = _http_post(
+                base2 + "/v1/generate",
+                {"tokens": prompts[0], "max_new_tokens": 32,
+                 "deadline_ms": 0.001},
+                timeout=request_timeout)
+            if status != 429:
+                raise DrillFailure(
+                    f"infeasible deadline answered {status} {body}, "
+                    f"expected 429 (shed)")
+            if json.loads(body).get("reason") != "deadline_infeasible":
+                raise DrillFailure(
+                    f"shed reason {body}, expected deadline_infeasible")
+            if int(hdrs.get("Retry-After", 0)) < 1:
+                raise DrillFailure(
+                    "429 without a usable Retry-After header")
+            shed_429 += 1
+        # a generous request rides through the storm untouched
+        status, body, _hdrs = _http_post(
+            base2 + "/v1/generate",
+            {"tokens": prompts[1], "max_new_tokens": max_new},
+            timeout=request_timeout)
+        if status != 200 or json.loads(body)["tokens"] != oracle[1]:
+            raise DrillFailure(
+                f"generous request during the storm: {status} {body}")
+        _status, mbody = _http_get(base2 + "/metrics", timeout=5.0)
+        from ...observability.aggregator import parse_prometheus_text
+        fams = parse_prometheus_text(mbody)
+        shed_metric = _sample_value(fams, "pt_serve_shed_total",
+                                    reason="deadline_infeasible")
+        if not shed_metric or shed_metric < storm_requests:
+            raise DrillFailure(
+                f"pt_serve_shed_total{{deadline_infeasible}} is "
+                f"{shed_metric!r}, expected >= {storm_requests}")
+        report["storm_shed"] = shed_429
+
+        # ---- leg 4: a disconnecting client is cancelled -------------
+        # fill the decode batch with long well-behaved requests first,
+        # so the disconnectors' requests are still in flight (queued
+        # or decoding) when the handler's socket watch looks — a tiny
+        # model can otherwise finish before the first check
+        import socket as _socket
+        blocked = []
+        blockers = [
+            threading.Thread(
+                target=_fire, daemon=True,
+                args=(base2,
+                      {"tokens": prompts[i % len(prompts)],
+                       "max_new_tokens": 48},
+                      blocked))
+            for i in range(4)
+        ]
+        for t in blockers:
+            t.start()
+
+        def _batch_busy():
+            _s, health = _healthz(base2)
+            return True if (health.get("active_sequences", 0) or 0) \
+                >= 2 else None
+
+        wait_until(_batch_busy, gen_timeout / 4,
+                   desc="blocker requests to fill the decode batch")
+        payload = json.dumps({"tokens": prompts[2],
+                              "max_new_tokens": 48}).encode()
+        for _ in range(3):          # three callers walk away mid-decode
+            s = _socket.create_connection((h2, port2), timeout=5.0)
+            s.sendall(b"POST /v1/generate HTTP/1.1\r\n"
+                      b"Host: drill\r\n"
+                      b"Content-Type: application/json\r\n"
+                      + f"Content-Length: {len(payload)}\r\n\r\n"
+                      .encode() + payload)
+            s.close()
+        for t in blockers:
+            t.join(timeout=request_timeout)
+        if any(status != 200 for status, _b, _h in blocked):
+            raise DrillFailure(
+                f"blocker requests failed during the disconnect leg: "
+                f"{[(s, b) for s, b, _h in blocked]}")
+
+        def _disconnect_seen():
+            _s, mb = _http_get(base2 + "/metrics", timeout=5.0)
+            v = _sample_value(parse_prometheus_text(mb),
+                              "pt_serve_cancelled_total",
+                              cause="disconnect")
+            return True if v else None
+
+        wait_until(_disconnect_seen, gen_timeout / 4,
+                   desc="disconnected client to be cancelled")
+
+        def _pool_quiet():
+            _s, health = _healthz(base2)
+            kv = health.get("kv") or {}
+            if kv.get("used_pages") == 0 and \
+                    kv.get("reserved_pages") == 0:
+                return True
+            return None
+
+        wait_until(_pool_quiet, gen_timeout / 4,
+                   desc="page pool to return to baseline after the "
+                        "storm (zero leaks)")
+        report["disconnect_cancelled"] = True
+
+        # ---- leg 5: SIGTERM graceful drain (exit 143) ---------------
+        inflight = []
+        dthreads = [
+            threading.Thread(
+                target=_fire, daemon=True,
+                args=(base2,
+                      {"tokens": prompts[i], "max_new_tokens": max_new},
+                      inflight))
+            for i in range(len(prompts))
+        ]
+        for t in dthreads:
+            t.start()
+
+        def _admitted():
+            _s, health = _healthz(base2)
+            depth = (health.get("active_sequences", 0) or 0) + \
+                (health.get("queue_depth", 0) or 0)
+            return True if depth >= len(dthreads) else None
+
+        wait_until(_admitted, gen_timeout / 4,
+                   desc="drain-leg requests to be admitted")
+        p2.send_signal(signal.SIGTERM)
+        # the drain window: admission must already be closed while the
+        # listener is still up (settle_s keeps it serving 503s); the
+        # handler needs a beat to flip the draining flag
+        time.sleep(0.1)
+        status, body, _hdrs = _http_post(
+            base2 + "/v1/generate",
+            {"tokens": prompts[0], "max_new_tokens": max_new},
+            timeout=request_timeout)
+        if status != 503:
+            raise DrillFailure(
+                f"request during drain answered {status} {body}, "
+                f"expected 503 (admission closed)")
+        for t in dthreads:
+            t.join(timeout=request_timeout)
+        if len(inflight) != len(dthreads):
+            raise DrillFailure(
+                f"only {len(inflight)}/{len(dthreads)} drain-leg "
+                f"responses arrived")
+        for status, body, _hdrs in inflight:
+            if status != 200:
+                raise DrillFailure(
+                    f"in-flight request cut short by the drain: "
+                    f"{status} {body} — partial/failed response")
+        # full-length AND bit-identical to the solo oracle: the drain
+        # finished these requests, it did not truncate or corrupt them
+        got = sorted(tuple(json.loads(body)["tokens"])
+                     for _status, body, _hdrs in inflight)
+        want = sorted(tuple(t) for t in oracle)
+        if got != want:
+            raise DrillFailure(
+                f"drained responses {got} disagree with the solo "
+                f"oracle {want} — partial or corrupted responses")
+        rc2 = p2.wait(timeout=60)
+        _LIVE.discard(p2)
+        if rc2 != 143:
+            raise DrillFailure(
+                f"drained process exited {rc2}, expected 143 "
+                f"(128 + SIGTERM)")
+        report["drain_rc"] = rc2
+        report["drain_responses"] = len(inflight)
+    finally:
+        if p2.poll() is None:
+            p2.kill()
+            p2.wait(timeout=30)
+        _LIVE.discard(p2)
+    return report

@@ -63,8 +63,11 @@ def main(argv=None):
     logger.info("serving on %s:%d (generation=%s, wal=%s)",
                 server.host, server.port, server.generation, args.wal)
     # block until killed — the drill's weapon is SIGKILL, so there is
-    # deliberately no graceful-shutdown path to hide behind
-    threading.Event().wait()
+    # deliberately no graceful-shutdown path to hide behind (bounded
+    # waits in a loop, never one unbounded park)
+    hold = threading.Event()
+    while not hold.wait(60.0):
+        pass
 
 
 if __name__ == "__main__":

@@ -200,6 +200,26 @@ def _obs_main(env, rank, world, total, run_id):
             for _ in range(n_anoms):
                 mon.record_anomaly("drill", tensor="drill::w",
                                    halt_ok=False)
+        n_shed = int(env.get("DRILL_OBS_SHED", "0"))
+        n_served = int(env.get("DRILL_OBS_SERVED", "0"))
+        if n_shed or n_served:
+            # scripted serve admission profile: books the same
+            # counters the serve scheduler's load shedder books, so
+            # the aggregator's fleet shed ratio is assertable as
+            # exactly shed / (shed + served)
+            from ...observability.metrics import get_registry
+            reg = get_registry()
+            if n_shed:
+                reg.counter(
+                    "pt_serve_shed_total",
+                    "Requests shed at admission, by reason",
+                    labelnames=("reason",)).inc(
+                        n_shed, reason="deadline_infeasible")
+            if n_served:
+                reg.counter(
+                    "pt_serve_requests_total",
+                    "Requests accepted by the serve scheduler",
+                ).inc(n_served)
         for _ in range(total):
             # synthetic, rank-scaled durations: rank r's mean step is
             # base*(1+r), so cluster skew is exactly base*(world-1)>0

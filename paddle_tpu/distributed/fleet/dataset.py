@@ -115,7 +115,13 @@ class DatasetBase:
                             yield self._parse_line(line, meta)
                 finally:
                     proc.stdout.close()
-                    rc = proc.wait()
+                    try:
+                        rc = proc.wait(timeout=600.0)
+                    except subprocess.TimeoutExpired:
+                        # a preprocessor ignoring a closed stdout is
+                        # wedged — kill it and fail the stream loudly
+                        proc.kill()
+                        rc = proc.wait(timeout=10.0)
                 # a crashed preprocessor must fail loudly — silently
                 # training on a truncated stream is the worst outcome
                 if rc != 0:

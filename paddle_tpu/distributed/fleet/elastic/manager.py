@@ -49,7 +49,7 @@ class LauncherInterface:
             self.proc.wait(timeout)
         except subprocess.TimeoutExpired:
             self.proc.kill()
-            self.proc.wait()
+            self.proc.wait(timeout)
 
     def watch(self):
         """Returns exit code or None while running."""

@@ -41,7 +41,10 @@ def spawn(func, args=(), nprocs=1, join=True, daemon=False, **options):
         procs.append(p)
     if join:
         for p in procs:
-            p.join()
+            # bounded joins: a wedged worker keeps surfacing here every
+            # minute instead of hanging the launcher invisibly
+            while p.is_alive():
+                p.join(timeout=60.0)
         bad = [p.exitcode for p in procs if p.exitcode]
         if bad:
             raise RuntimeError(f"spawned workers failed with codes {bad}")

@@ -532,6 +532,7 @@ class ClusterAggregator:
     def __init__(self, *, endpoints=None, store=None, run_id="local",
                  stale_after=5.0, scrape_timeout=2.0, storm_threshold=1,
                  anomaly_threshold=10, mem_threshold=0, serve_threshold=0.0,
+                 shed_threshold=0.0,
                  interval=1.0, drop_labels=("process_index",),
                  retention=3600.0, history_max_points=512):
         self.run_id = str(run_id)
@@ -548,6 +549,11 @@ class ClusterAggregator:
         # serving saturation trip: cluster p99 request latency at/over
         # this many seconds flips /healthz to 503 (0 disables)
         self.serve_threshold = float(serve_threshold or 0.0)
+        # shed-storm trip: fleet shed ratio (shed / (shed + accepted))
+        # at/over this fraction flips /healthz to 503 (0 disables) —
+        # sustained shedding means the fleet is undersized or a replica
+        # fell out and the survivors are drowning
+        self.shed_threshold = float(shed_threshold or 0.0)
         self.interval = float(interval)
         self.drop_labels = tuple(drop_labels)
         self._store = store
@@ -837,6 +843,28 @@ class ClusterAggregator:
         gauge("pt_cluster_serve_alarm",
               "1 while cluster serve p99 >= the saturation threshold",
               [((), 1 if serve_alarm else 0)])
+        # load-shed accounting: the resilience layer's admission
+        # refusals (deadline_infeasible/queue_full/draining), summed
+        # fleet-wide and expressed as a ratio of admission attempts
+        serve_shed = sum(_family_total(f, "pt_serve_shed_total")
+                         for f in fresh.values())
+        serve_accepted = sum(_family_total(f, "pt_serve_requests_total")
+                             for f in fresh.values())
+        shed_ratio = None
+        if serve_shed or serve_accepted:
+            counter("pt_cluster_serve_shed_total",
+                    "requests shed at admission summed across ranks, "
+                    "all reasons", serve_shed)
+            shed_ratio = serve_shed / max(1.0, serve_shed + serve_accepted)
+            gauge("pt_cluster_serve_shed_ratio",
+                  "fraction of fleet admission attempts shed "
+                  "(shed / (shed + accepted)) over fresh ranks",
+                  [((), shed_ratio)])
+        shed_alarm = (self.shed_threshold > 0 and shed_ratio is not None
+                      and shed_ratio >= self.shed_threshold)
+        gauge("pt_cluster_serve_shed_alarm",
+              "1 while the fleet shed ratio >= the shed-storm threshold",
+              [((), 1 if shed_alarm else 0)])
 
         text = render_exposition(merged) + "\n".join(extra) + "\n"
 
@@ -873,7 +901,7 @@ class ClusterAggregator:
             ranks_health[str(r)] = entry
         health = {
             "ok": (not alarm and not anomaly_alarm and not mem_alarm
-                   and not serve_alarm),
+                   and not serve_alarm and not shed_alarm),
             "run_id": self.run_id,
             "ranks_discovered": len(self._endpoints),
             "ranks_up": len(fresh),
@@ -913,6 +941,11 @@ class ClusterAggregator:
                 "unexpected_compiles_total": int(serve_compiles),
                 "serve_alarm": serve_alarm,
                 "serve_threshold": self.serve_threshold,
+                "shed_total": int(serve_shed),
+                "shed_ratio": (round(shed_ratio, 6)
+                               if shed_ratio is not None else None),
+                "shed_alarm": shed_alarm,
+                "shed_threshold": self.shed_threshold,
             },
             "merge_conflicts_total": self._conflicts_total,
             "scrape_errors_total": self._scrape_errors_total,
@@ -1099,6 +1132,12 @@ def main(argv=None):
                     help="serving saturation trip: cluster p99 request "
                          "latency at/over this many seconds flips "
                          "/healthz to 503 (0 disables the alarm)")
+    ap.add_argument("--shed-threshold", type=float,
+                    default=float(_env("PT_AGGREGATOR_SHED_THRESHOLD",
+                                       "0")),
+                    help="shed-storm trip: fleet shed ratio "
+                         "(shed / (shed + accepted)) at/over this "
+                         "fraction flips /healthz to 503 (0 disables)")
     ap.add_argument("--retention", type=float,
                     default=float(_env("PT_AGGREGATOR_RETENTION",
                                        "3600")),
@@ -1145,6 +1184,7 @@ def main(argv=None):
         anomaly_threshold=args.anomaly_threshold,
         mem_threshold=args.mem_threshold,
         serve_threshold=args.serve_threshold,
+        shed_threshold=args.shed_threshold,
         interval=args.interval, retention=args.retention)
     if args.once:
         agg.scrape_once()

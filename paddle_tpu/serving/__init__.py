@@ -26,7 +26,9 @@ from .model import ModelSpec, init_params, prefill_step, decode_step
 from .kv_cache import PagePool, KVPoolExhausted, NULL_PAGE
 from .engine import (ServeConfig, ServingEngine, save_served_model,
                      load_engine, is_served_model_dir, SERVE_CONFIG_NAME)
-from .scheduler import ContinuousScheduler, GenerationStream, EngineSaturated
+from .scheduler import (ContinuousScheduler, GenerationStream,
+                        EngineSaturated, RequestShed, RequestCancelled,
+                        DeadlineExceeded, WATCHDOG_EXIT_CODE)
 
 __all__ = [
     "ModelSpec", "init_params", "prefill_step", "decode_step",
@@ -34,4 +36,6 @@ __all__ = [
     "ServeConfig", "ServingEngine", "save_served_model", "load_engine",
     "is_served_model_dir", "SERVE_CONFIG_NAME",
     "ContinuousScheduler", "GenerationStream", "EngineSaturated",
+    "RequestShed", "RequestCancelled", "DeadlineExceeded",
+    "WATCHDOG_EXIT_CODE",
 ]

@@ -62,6 +62,11 @@ def _env_int(name: str, default: int) -> int:
     return int(v) if v else default
 
 
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    return float(v) if v else default
+
+
 def _env_buckets(name: str, default: Tuple[int, ...]) -> Tuple[int, ...]:
     v = os.environ.get(name)
     if not v:
@@ -82,6 +87,9 @@ class ServeConfig:
       PT_SERVE_KV_PAGES         total pool pages (incl. null page)
       PT_SERVE_PAGE_SIZE        tokens per page
       PT_SERVE_MAX_INFLIGHT     admission cap (queued + active)
+      PT_SERVE_DEADLINE_MS      server-default request deadline (0 = none)
+      PT_SERVE_MAX_QUEUE        bounded admission queue (0 = unbounded)
+      PT_SERVE_DRAIN_S          graceful-drain budget on SIGTERM
     """
 
     decode_buckets: Tuple[int, ...] = (2, 4, 8, 16)
@@ -91,6 +99,9 @@ class ServeConfig:
     max_inflight: int = 64
     max_new_tokens: int = 32
     eos_id: int = -1          # <0: never stops early (length-bounded)
+    deadline_ms: float = 0.0  # server default; 0 = no deadline
+    max_queue: int = 256      # bounded queue; 0 = unbounded
+    drain_s: float = 10.0     # SIGTERM drain budget (seconds)
 
     @classmethod
     def from_env(cls, **overrides) -> "ServeConfig":
@@ -106,6 +117,10 @@ class ServeConfig:
             max_new_tokens=_env_int("PT_SERVE_MAX_NEW_TOKENS",
                                     cls.max_new_tokens),
             eos_id=_env_int("PT_SERVE_EOS_ID", cls.eos_id),
+            deadline_ms=_env_float("PT_SERVE_DEADLINE_MS",
+                                   cls.deadline_ms),
+            max_queue=_env_int("PT_SERVE_MAX_QUEUE", cls.max_queue),
+            drain_s=_env_float("PT_SERVE_DRAIN_S", cls.drain_s),
         )
         return base.replace(**overrides) if overrides else base
 
@@ -459,12 +474,28 @@ class ServingEngine:
         streams = [self.scheduler.submit(p, max_new_tokens=max_new_tokens)
                    for p in prompts]
         self.scheduler.drain()
-        return [st.result() for st in streams]
+        # the drain above already emptied the loop; the bound is a
+        # backstop so a wedged stream can never hang the caller forever
+        return [st.result(timeout=300.0) for st in streams]
 
     def healthz(self) -> Dict[str, Any]:
         sched = getattr(self, "scheduler", None)
+        draining = bool(sched is not None and sched.draining)
+        hang = bool(sched is not None and sched.hang_detected)
+        try:
+            self.pool.check_consistency()
+            kv_consistent = True
+        except AssertionError:
+            kv_consistent = False
         h = {
-            "ok": self.unexpected_compiles == 0,
+            # degraded while draining (LBs must stop routing here), on
+            # any request-path compile, a tripped hang watchdog, or a
+            # page-pool invariant violation
+            "ok": (self.unexpected_compiles == 0 and not draining
+                   and not hang and kv_consistent),
+            "draining": draining,
+            "hang_detected": hang,
+            "kv_consistent": kv_consistent,
             "unexpected_compiles": self.unexpected_compiles,
             "compiled_programs": self.compiled_programs,
             "decode_buckets": list(self.config.decode_buckets),
@@ -524,7 +555,10 @@ def load_engine(path: str, config: Optional[ServeConfig] = None,
                 ("page_size", "PT_SERVE_PAGE_SIZE"),
                 ("max_inflight", "PT_SERVE_MAX_INFLIGHT"),
                 ("max_new_tokens", "PT_SERVE_MAX_NEW_TOKENS"),
-                ("eos_id", "PT_SERVE_EOS_ID")):
+                ("eos_id", "PT_SERVE_EOS_ID"),
+                ("deadline_ms", "PT_SERVE_DEADLINE_MS"),
+                ("max_queue", "PT_SERVE_MAX_QUEUE"),
+                ("drain_s", "PT_SERVE_DRAIN_S")):
             if os.environ.get(env):
                 env_kw[fname] = getattr(ServeConfig.from_env(), fname)
         config = file_cfg.replace(**env_kw) if env_kw else file_cfg

@@ -156,13 +156,23 @@ class PagePool:
             self.stats["frees"] += len(page_ids)
         self._gauges()
 
-    def check_consistency(self) -> None:
-        """Invariant check used by tests: no duplicate/lost pages."""
+    def check_consistency(self, expect_all_free: bool = False) -> None:
+        """Invariant check used by tests and the serve chaos drills:
+        no duplicate/lost pages.  ``expect_all_free=True`` additionally
+        proves a clean slate — every usable page back on the free list
+        and zero outstanding reservations (the post-drain / post-storm
+        zero-leak assertion)."""
         with self._lock:
             assert len(set(self._free)) == len(self._free), "dup free ids"
             assert all(0 < p < self.pages for p in self._free)
             assert 0 <= self._reserved <= len(self._free), \
                 f"reserved {self._reserved} > free {len(self._free)}"
+            if expect_all_free:
+                assert len(self._free) == self.usable_pages, \
+                    (f"page leak: {self.usable_pages - len(self._free)} "
+                     f"of {self.usable_pages} pages unaccounted for")
+                assert self._reserved == 0, \
+                    f"{self._reserved} pages still reserved"
 
     # -- device state -------------------------------------------------------
 

@@ -1231,3 +1231,51 @@ class RequestPathCompile(Rule):
                            f"mid-request; precompile every bucket shape "
                            f"at engine load (the zero-compile sentinel "
                            f"will book this as an SLO violation)")
+
+
+@register
+class UnboundedBlockingCall(Rule):
+    id = "TPU021"
+    name = "unbounded-blocking-call"
+    rationale = ("a .join()/.wait()/.result()/.acquire() with no timeout "
+                 "on a serving or distributed request path turns a hung "
+                 "peer into a hung server: the caller blocks forever, "
+                 "holds its KV pages/locks, and is indistinguishable "
+                 "from load to everything upstream — the exact failure "
+                 "the serve hang watchdog and drain budgets exist to "
+                 "bound.  Pass a timeout (retry in a loop if the wait "
+                 "is legitimately long) so a wedged dependency surfaces "
+                 "as a timeout the resilience layer can act on instead "
+                 "of an invisible stall")
+
+    _BLOCKING = {"join", "wait", "result", "acquire"}
+    _TIMEOUT_KWARGS = {"timeout", "timeout_s", "timeout_ms", "deadline"}
+
+    def on_call(self, node, ctx):
+        # request-path discipline only: serving/ and the distributed
+        # control planes (fleet, collective, drill supervisors)
+        if not (ctx.serving_path or ctx.distributed_path):
+            return
+        f = node.func
+        if not isinstance(f, ast.Attribute) or f.attr not in self._BLOCKING:
+            return
+        # a positional arg (join(5), wait(0.1), acquire(False)) or an
+        # explicit timeout/deadline kwarg bounds the call
+        if node.args:
+            return
+        if any(kw.arg in self._TIMEOUT_KWARGS for kw in node.keywords):
+            return
+        if f.attr == "acquire" and any(
+                kw.arg == "blocking" and isinstance(kw.value, ast.Constant)
+                and kw.value.value is False for kw in node.keywords):
+            return  # non-blocking acquire
+        # wrapper deferral: `self.wait()` where this same file defines
+        # a `wait` — the wrapper's own body gets linted instead, so a
+        # bounded implementation isn't flagged at every internal call
+        if dotted(f.value) == "self" and f.attr in ctx._pre.by_name:
+            return
+        ctx.report(node, self.id,
+                   f".{f.attr}() with no timeout blocks this "
+                   f"serving/distributed path forever if the other side "
+                   f"is wedged; pass a timeout (looping if needed) so a "
+                   f"hang surfaces as an actionable error")

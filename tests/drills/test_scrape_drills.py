@@ -115,6 +115,44 @@ def test_scrape_drill_memory_near_oom_503(tmp_path):
             5_000_000 * (1 + int(r))
 
 
+def test_scrape_drill_shed_storm_503(tmp_path):
+    """Each rank scripts a serve admission profile of 3 sheds to 1
+    accepted request; the aggregator derives the exact fleet shed
+    ratio (0.75), and with the shed-storm threshold at 0.5 the
+    load-shedding signal alone must flip /healthz to 503 — no
+    recompile storm, no anomalies, no memory pressure."""
+    report = run_scrape_drill(
+        str(tmp_path), world=2, steps=6, kill_rank=None, storm=False,
+        shed=3, served=1, shed_threshold=0.5)
+    assert report["shed_total"] == 6.0
+    assert abs(report["shed_ratio"] - 0.75) < 1e-6
+    assert report["shed_alarm"] == 1.0
+    health = report["healthz"]
+    assert health["ok"] is False
+    serve = health["serve"]
+    assert serve["shed_alarm"] is True
+    assert serve["shed_total"] == 6
+    assert abs(serve["shed_ratio"] - 0.75) < 1e-6
+    assert serve["shed_threshold"] == 0.5
+    # orthogonal alarms stay down
+    assert health["storm_alarm"] is False
+    assert health["anomaly_alarm"] is False
+
+
+def test_scrape_drill_shed_below_threshold_stays_healthy(tmp_path):
+    """Light shedding below the storm threshold is accounted (ratio
+    exported) but does NOT trip the alarm or degrade /healthz."""
+    report = run_scrape_drill(
+        str(tmp_path), world=2, steps=6, kill_rank=None, storm=False,
+        shed=1, served=9, shed_threshold=0.5)
+    assert report["shed_total"] == 2.0
+    assert abs(report["shed_ratio"] - 0.1) < 1e-6
+    assert report["shed_alarm"] == 0.0
+    health = report["healthz"]
+    assert health["ok"] is True
+    assert health["serve"]["shed_alarm"] is False
+
+
 @pytest.mark.slow
 def test_scrape_drill_aggregator_restart(tmp_path):
     """@slow: kill the aggregator mid-drill and respawn it — the

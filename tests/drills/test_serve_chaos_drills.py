@@ -1,0 +1,49 @@
+"""Serving chaos drills: a REAL serving engine subprocess
+(``python -m paddle_tpu.serving``) is SIGKILLed mid-decode,
+deadline-stormed, abandoned by a disconnecting client, and SIGTERMed
+under load — and every resilience invariant holds.
+
+The drill's oracle is an in-process engine built from the same
+ModelSpec + seed (``init_params`` is deterministic) decoding each
+prompt SOLO: surviving/relaunched generations must answer
+bit-identically, proving recovery changed nothing about the math.
+
+Tier-1 acceptance chain (one drill run — cold starts dominate, so the
+legs share two engine generations):
+
+ - generation 1 SIGKILLed while /healthz shows active sequences;
+ - generation 2 relaunches with a consistent EMPTY page pool, serves
+   bit-identically to the solo oracle, books ZERO request-path
+   compiles;
+ - a deadline storm is fully shed (429 + Retry-After, reason
+   ``deadline_infeasible``), a generous request rides through it
+   bit-identically, and the pool returns to zero used/reserved pages
+   (no leaks);
+ - a client that drops its socket mid-request is cancelled
+   (``pt_serve_cancelled_total{cause="disconnect"}``);
+ - SIGTERM under load: every in-flight request completes IN FULL
+   (bit-identical — no partial responses), a request posted during
+   the drain window is refused 503, and the process exits 143.
+"""
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from paddle_tpu.distributed.drill import run_serve_chaos_drill
+
+pytestmark = pytest.mark.skipif(os.name != "posix",
+                                reason="drills SIGKILL real processes")
+
+
+def test_serve_chaos_drill(tmp_path):
+    logs = str(tmp_path / "logs")
+    os.makedirs(logs, exist_ok=True)
+    report = run_serve_chaos_drill(str(tmp_path), log_dir=logs)
+    assert report["gen1_rc"] == -9
+    assert report["gen2_recovered"] is True
+    assert report["storm_shed"] == 6
+    assert report["disconnect_cancelled"] is True
+    assert report["drain_rc"] == 143
+    assert report["drain_responses"] == 3

@@ -1,0 +1,428 @@
+"""Serving resilience: deadlines, cancellation, load shedding,
+graceful drain, fault isolation, and the hang watchdog.
+
+The load-bearing guarantees under test:
+
+ - an abandoned request NEVER keeps decoding on borrowed KV pages:
+   ``result(timeout)`` cancels on timeout and the pool returns to
+   baseline even after a timeout storm (the page-leak regression pin);
+ - deadlines are enforced at step boundaries — queued or active, an
+   expired request is evicted with its pages released and resolves
+   with ``DeadlineExceeded``;
+ - the load shedder refuses infeasible work at admission (429-shaped
+   ``RequestShed``) instead of queueing it to die;
+ - one poisoned request — or one failed device step — fails alone;
+   the step loop keeps serving and every page comes back;
+ - SIGTERM's ``drain_gracefully`` finishes in-flight work inside the
+   budget and sheds new admissions while draining;
+ - a hung decode step trips the watchdog: ``hang_detected`` flips
+   /healthz without needing the (held) scheduler lock.
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from paddle_tpu.serving import (
+    DeadlineExceeded, ModelSpec, PagePool, RequestCancelled, RequestShed,
+    ServeConfig, ServingEngine, init_params)
+from paddle_tpu.serving.scheduler import ContinuousScheduler
+
+SPEC = ModelSpec(vocab_size=64, hidden=32, layers=2, heads=2,
+                 max_seq_len=64)
+CFG = ServeConfig(decode_buckets=(4,), prefill_buckets=(16,),
+                  kv_pages=32, page_size=4, max_inflight=16,
+                  max_new_tokens=8)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = ServingEngine(SPEC, init_params(SPEC, seed=0), CFG)
+    yield eng
+    eng.close()
+
+
+def _fresh(engine):
+    """A scheduler with clean stats over the shared (pre-built) engine;
+    the pool is shared, so every test must leave it at baseline."""
+    return ContinuousScheduler(engine)
+
+
+def _assert_pool_baseline(engine):
+    snap = engine.pool.snapshot()
+    assert snap["used_pages"] == 0, snap
+    assert snap["reserved_pages"] == 0, snap
+    engine.pool.check_consistency(expect_all_free=True)
+
+
+# -- result(timeout) cancels: the page-leak regression pin -------------------
+
+def test_result_timeout_cancels_queued_request(engine):
+    sched = _fresh(engine)
+    st = sched.submit([1, 2, 3])
+    with pytest.raises(TimeoutError):
+        st.result(timeout=0.01)
+    assert st.cancel_cause == "timeout"
+    assert sched.stats["cancelled"] == 1
+    # the queue no longer owes this request any work
+    assert sched.snapshot()["queue_depth"] == 0
+    _assert_pool_baseline(engine)
+
+
+def test_result_timeout_storm_releases_every_page(engine):
+    """Six abandoned requests mid-decode: every page and reservation
+    must come back — this is the leak ``result(timeout)`` used to
+    have."""
+    sched = _fresh(engine)
+    streams = [sched.submit([1, 2, 3, 4], max_new_tokens=8)
+               for _ in range(6)]
+    sched.step()  # admit + first decode step: pages now allocated
+    assert engine.pool.snapshot()["used_pages"] > 0
+    for st in streams:
+        with pytest.raises(TimeoutError):
+            st.result(timeout=0.001)
+    assert sched.stats["cancelled"] == 6
+    _assert_pool_baseline(engine)
+    # the loop is still healthy after the storm
+    st = sched.submit([5, 6], max_new_tokens=4)
+    sched.drain()
+    assert len(st.result(timeout=5.0)) == 4
+    _assert_pool_baseline(engine)
+
+
+def test_cancel_api_queued_active_and_done(engine):
+    sched = _fresh(engine)
+    a = sched.submit([1, 2], max_new_tokens=4)
+    b = sched.submit([3, 4], max_new_tokens=4)
+    assert sched.cancel(a.request_id) is True          # queued
+    with pytest.raises(RequestCancelled) as ei:
+        a.result(timeout=1.0)
+    assert ei.value.cause == "client"
+    sched.step()                                       # admit b
+    assert sched.cancel(b.request_id, cause="client") is True  # active
+    with pytest.raises(RequestCancelled):
+        b.result(timeout=1.0)
+    c = sched.submit([5, 6], max_new_tokens=2)
+    sched.drain()
+    assert len(c.result(timeout=5.0)) == 2
+    assert sched.cancel(c.request_id) is False         # already done
+    _assert_pool_baseline(engine)
+
+
+# -- deadlines ---------------------------------------------------------------
+
+def test_deadline_evicts_mid_decode(engine, monkeypatch):
+    """An active request whose deadline passes is evicted at the next
+    step boundary with partial tokens and zero leaked pages."""
+    sched = _fresh(engine)
+    orig = engine.decode
+
+    def slow_decode(*args, **kw):
+        time.sleep(0.02)
+        return orig(*args, **kw)
+
+    monkeypatch.setattr(engine, "decode", slow_decode)
+    st = sched.submit([1, 2, 3], max_new_tokens=8, deadline_ms=50)
+    deadline = time.monotonic() + 10.0
+    while not st.done() and time.monotonic() < deadline:
+        sched.step()
+    with pytest.raises(DeadlineExceeded):
+        st.result(timeout=1.0)
+    assert st.cancel_cause == "deadline"
+    assert len(st.tokens) < 8          # partial: it was cut mid-decode
+    assert sched.stats["deadline_exceeded"] == 1
+    _assert_pool_baseline(engine)
+
+
+def test_shed_infeasible_deadline_at_admission(engine):
+    """Once throughput is measured, a deadline the backlog can't meet
+    is refused at submit — not queued to die."""
+    sched = _fresh(engine)
+    sched._step_ewma = 0.05            # 50ms/step measured
+    with pytest.raises(RequestShed) as ei:
+        sched.submit([1, 2, 3], max_new_tokens=8, deadline_ms=10)
+    assert ei.value.reason == "deadline_infeasible"
+    assert sched.stats["shed"] == 1
+    # before any throughput measurement the shedder admits
+    # optimistically — the step-boundary sweep still backstops it
+    sched2 = _fresh(engine)
+    st = sched2.submit([1, 2, 3], max_new_tokens=8, deadline_ms=10)
+    sched2.cancel(st.request_id)
+    _assert_pool_baseline(engine)
+
+
+def test_shed_queue_full_evicts_expired_first(engine, monkeypatch):
+    monkeypatch.setattr(engine, "config", engine.config.replace(
+        max_queue=2))
+    sched = _fresh(engine)
+    doomed = sched.submit([1, 2], max_new_tokens=4, deadline_ms=1)
+    sched.submit([3, 4], max_new_tokens=4)
+    time.sleep(0.005)                  # doomed's deadline passes
+    # the bounded queue makes room by evicting the expired entry
+    # (oldest first) instead of refusing fresh work
+    st = sched.submit([5, 6], max_new_tokens=4)
+    with pytest.raises(DeadlineExceeded):
+        doomed.result(timeout=1.0)
+    # now genuinely full: two live entries, no expired to evict
+    with pytest.raises(RequestShed) as ei:
+        sched.submit([7, 8], max_new_tokens=4)
+    assert ei.value.reason == "queue_full"
+    sched.drain()
+    assert len(st.result(timeout=5.0)) == 4
+    _assert_pool_baseline(engine)
+
+
+def test_shed_while_draining_and_healthz(engine, monkeypatch):
+    sched = _fresh(engine)
+    monkeypatch.setattr(engine, "scheduler", sched)
+    sched.begin_drain()
+    with pytest.raises(RequestShed) as ei:
+        sched.submit([1, 2])
+    assert ei.value.reason == "draining"
+    health = engine.healthz()
+    assert health["ok"] is False and health["draining"] is True
+
+
+# -- fault isolation ---------------------------------------------------------
+
+def test_decode_failure_fails_batch_not_engine(engine, monkeypatch):
+    """A failed device step fails every RESIDENT request — pages
+    returned — and the loop keeps serving the next submission."""
+    sched = _fresh(engine)
+    streams = [sched.submit([1, 2, 3], max_new_tokens=8)
+               for _ in range(3)]
+    orig = engine.decode
+    monkeypatch.setattr(
+        engine, "decode",
+        lambda *a, **k: (_ for _ in ()).throw(RuntimeError("boom")))
+    sched.step()                       # admit + the poisoned step
+    for st in streams:
+        with pytest.raises(RuntimeError, match="boom"):
+            st.result(timeout=1.0)
+    assert sched.stats["failed"] == 3
+    _assert_pool_baseline(engine)
+    monkeypatch.setattr(engine, "decode", orig)
+    st = sched.submit([4, 5], max_new_tokens=4)
+    sched.drain()
+    assert len(st.result(timeout=5.0)) == 4
+    _assert_pool_baseline(engine)
+
+
+def test_poisoned_row_fails_alone(engine):
+    """Per-row isolation: one request whose post-step bookkeeping
+    raises fails by itself; its batch neighbours decode to completion
+    and its pages come back."""
+
+    class _BoomTokens(list):
+        def append(self, _x):
+            raise RuntimeError("row poison")
+
+    sched = _fresh(engine)
+    victim = sched.submit([1, 2, 3], max_new_tokens=8)
+    others = [sched.submit([4, 5, 6], max_new_tokens=8)
+              for _ in range(2)]
+    sched.step()                       # admit everyone (prefill token)
+    victim.tokens = _BoomTokens(victim.tokens)
+    sched.drain()
+    with pytest.raises(RuntimeError, match="row poison"):
+        victim.result(timeout=1.0)
+    for st in others:
+        assert len(st.result(timeout=5.0)) == 8
+    assert sched.stats["failed"] == 1
+    assert sched.stats["completed"] == 2
+    _assert_pool_baseline(engine)
+
+
+# -- graceful drain ----------------------------------------------------------
+
+def test_drain_gracefully_finishes_inflight(engine):
+    sched = _fresh(engine)
+    streams = [sched.submit([1, 2], max_new_tokens=4) for _ in range(3)]
+    clean = sched.drain_gracefully(budget_s=10.0)
+    assert clean is True
+    for st in streams:
+        assert len(st.result(timeout=1.0)) == 4
+    assert sched.stats["drain_seconds"] is not None
+    assert sched.draining is True
+    with pytest.raises(RequestShed):
+        sched.submit([3, 4])
+    _assert_pool_baseline(engine)
+
+
+def test_drain_budget_cancels_leftovers(engine, monkeypatch):
+    """A drain whose budget expires cancels the stragglers with
+    ``cause="drain"`` — pages released, nothing hangs."""
+    sched = _fresh(engine)
+    orig = engine.decode
+
+    def slow_decode(*args, **kw):
+        time.sleep(0.05)
+        return orig(*args, **kw)
+
+    monkeypatch.setattr(engine, "decode", slow_decode)
+    streams = [sched.submit([1, 2], max_new_tokens=8) for _ in range(2)]
+    sched.step()                       # admitted, now mid-decode
+    clean = sched.drain_gracefully(budget_s=0.0)
+    assert clean is False
+    for st in streams:
+        with pytest.raises(RequestCancelled) as ei:
+            st.result(timeout=1.0)
+        assert ei.value.cause == "drain"
+    _assert_pool_baseline(engine)
+
+
+# -- hang watchdog -----------------------------------------------------------
+
+def test_watchdog_trips_on_hung_step(engine, monkeypatch):
+    monkeypatch.setenv("PT_SERVE_WATCHDOG", "1")
+    monkeypatch.setenv("PT_SERVE_WATCHDOG_FLOOR_S", "0.2")
+    sched = _fresh(engine)
+    monkeypatch.setattr(engine, "scheduler", sched)
+    orig = engine.decode
+
+    def hung_decode(*args, **kw):
+        time.sleep(1.0)
+        return orig(*args, **kw)
+
+    monkeypatch.setattr(engine, "decode", hung_decode)
+    sched.start()
+    try:
+        st = sched.submit([1, 2, 3], max_new_tokens=4)
+        deadline = time.monotonic() + 10.0
+        while not sched.hang_detected and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert sched.hang_detected is True
+        assert sched.stats["watchdog_trips"] == 1
+        health = engine.healthz()
+        assert health["ok"] is False and health["hang_detected"] is True
+        st.result(timeout=10.0)        # the slow step does finish here
+    finally:
+        sched.stop(timeout=10.0)
+    _assert_pool_baseline(engine)
+
+
+def test_watchdog_stays_quiet_on_healthy_load(engine, monkeypatch):
+    monkeypatch.setenv("PT_SERVE_WATCHDOG", "1")
+    monkeypatch.setenv("PT_SERVE_WATCHDOG_FLOOR_S", "1.0")
+    sched = _fresh(engine)
+    sched.start()
+    try:
+        st = sched.submit([1, 2], max_new_tokens=8)
+        assert len(st.result(timeout=10.0)) == 8
+        assert sched.hang_detected is False
+        assert sched.stats["watchdog_trips"] == 0
+    finally:
+        sched.stop(timeout=10.0)
+    _assert_pool_baseline(engine)
+
+
+# -- pool clean-slate proof --------------------------------------------------
+
+def test_check_consistency_expect_all_free():
+    pool = PagePool(layers=1, pages=8, page_size=4, heads=1, head_dim=4)
+    got = pool.alloc(2)
+    pool.check_consistency()           # internally consistent...
+    with pytest.raises(AssertionError):
+        pool.check_consistency(expect_all_free=True)  # ...but not empty
+    pool.free(got)
+    pool.check_consistency(expect_all_free=True)
+    pool.reserve(1)
+    with pytest.raises(AssertionError):
+        pool.check_consistency(expect_all_free=True)
+    pool.release_reservation(1)
+    pool.check_consistency(expect_all_free=True)
+
+
+# -- HTTP error mapping (kept last: the server owns the shared engine's
+#    scheduler lifecycle) ----------------------------------------------------
+
+def _post(base, path, obj, timeout=30.0):
+    data = json.dumps(obj).encode()
+    req = urllib.request.Request(
+        base + path, data=data,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read()), resp.headers
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), e.headers
+
+
+def test_http_resilience_status_mapping(engine):
+    """429 + Retry-After (shed), 503 (draining), 504 (wall timeout),
+    499 (client cancel via /v1/cancel) — the full refusal taxonomy over
+    one server."""
+    from paddle_tpu.serving.http import ServeHTTPServer
+
+    srv = ServeHTTPServer(engine, port=0, request_timeout=0.5).start()
+    base = f"http://{srv.host}:{srv.port}"
+    sched = engine.scheduler
+    hold = threading.Event()
+    orig_step = sched.step
+
+    def stalled_step():
+        hold.wait(5.0)
+        return orig_step()
+
+    try:
+        # -- 429 shed with a usable Retry-After ----------------------
+        sched._step_ewma = 0.05
+        status, body, hdrs = _post(base, "/v1/generate",
+                                   {"tokens": [1, 2, 3],
+                                    "max_new_tokens": 8,
+                                    "deadline_ms": 10})
+        assert status == 429
+        assert body["reason"] == "deadline_infeasible"
+        assert int(hdrs.get("Retry-After", 0)) >= 1
+        sched._step_ewma = None
+
+        # -- 503 while draining --------------------------------------
+        sched.begin_drain()
+        try:
+            status, body, _h = _post(base, "/v1/generate",
+                                     {"tokens": [1, 2]})
+            assert status == 503 and body["reason"] == "draining"
+            status, _b = _get_healthz(base)
+            assert status == 503
+        finally:
+            sched._draining = False
+
+        # -- 504: the handler's wall timeout cancels the request -----
+        sched.step = stalled_step
+        status, body, _h = _post(base, "/v1/generate",
+                                 {"tokens": [1, 2], "max_new_tokens": 2})
+        assert status == 504
+        assert sched.snapshot()["queue_depth"] == 0  # cancelled, not left
+        # -- 499: cancelled through /v1/cancel -----------------------
+        results = []
+        t = threading.Thread(
+            target=lambda: results.append(
+                _post(base, "/v1/generate",
+                      {"tokens": [3, 4], "max_new_tokens": 4})))
+        t.start()
+        deadline = time.monotonic() + 5.0
+        while not sched._queue and time.monotonic() < deadline:
+            time.sleep(0.01)
+        rid = sched._queue[0].request_id
+        status, body, _h = _post(base, "/v1/cancel",
+                                 {"request_id": rid})
+        assert status == 200 and body["cancelled"] is True
+        t.join(timeout=10.0)
+        status, body, _h = results[0]
+        assert status == 499 and body["cause"] == "client"
+    finally:
+        hold.set()
+        sched.step = orig_step
+        srv.stop()
+    _assert_pool_baseline(engine)
+
+
+def _get_healthz(base):
+    try:
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())

@@ -347,6 +347,21 @@ FIXTURES = {
             return os.environ.get("PT_CACHE_HOME", "/tmp/cache")
         """,
     ),
+    "TPU021": (
+        "paddle_tpu/serving/mod.py",
+        """
+        def handle(stream, worker):
+            out = stream.result()
+            worker.join()
+            return out
+        """,
+        """
+        def handle(stream, worker):
+            out = stream.result(timeout=120.0)
+            worker.join(5.0)
+            return out
+        """,
+    ),
     "TPU014": (
         "paddle_tpu/distributed/mod.py",
         """
@@ -1134,6 +1149,74 @@ def test_tpu020_package_has_no_import_time_env_reads():
     violations, errors = run_paths(GATE_PATHS)
     assert errors == {}
     assert [v for v in violations if v.rule == "TPU020"] == []
+
+
+def test_tpu021_every_blocking_name_fires():
+    src = """
+    def serve(stream, thread, lock, ev):
+        stream.result()
+        thread.join()
+        lock.acquire()
+        ev.wait()
+    """
+    for path in ("paddle_tpu/serving/x.py", "paddle_tpu/distributed/x.py",
+                 "paddle_tpu/distributed/fleet/x.py"):
+        vs = [v for v in lint_source(textwrap.dedent(src), path=path)
+              if v.rule == "TPU021"]
+        assert len(vs) == 4, path
+
+
+def test_tpu021_bounded_and_nonblocking_forms_are_quiet():
+    src = """
+    def serve(stream, thread, lock, ev):
+        stream.result(timeout=30)
+        thread.join(5.0)
+        lock.acquire(False)
+        lock.acquire(blocking=False)
+        ev.wait(0.05)
+    """
+    assert "TPU021" not in rules_fired(src, path="paddle_tpu/serving/x.py")
+
+
+def test_tpu021_self_wrapper_deferral():
+    # `self.wait()` where the same file defines a bounded wait(): the
+    # wrapper body is the lint target, not every internal call site
+    src = """
+    class Handle:
+        def wait(self):
+            while not self._done.wait(60.0):
+                pass
+        def synchronize(self):
+            self.wait()
+    """
+    assert "TPU021" not in rules_fired(src, path="paddle_tpu/distributed/x.py")
+    # ...but an unbounded wait on anything else still fires
+    src2 = """
+    class Handle:
+        def synchronize(self, other):
+            other.wait()
+    """
+    assert "TPU021" in rules_fired(src2, path="paddle_tpu/distributed/x.py")
+
+
+def test_tpu021_scoped_to_serving_and_distributed_paths():
+    src = """
+    def trainer(thread):
+        thread.join()
+    """
+    for path in ("paddle_tpu/nn/x.py", "paddle_tpu/optimizer/x.py",
+                 "tests/test_x.py"):
+        assert "TPU021" not in rules_fired(src, path=path), path
+
+
+def test_tpu021_request_paths_have_no_unbounded_blocking_calls():
+    # satellite contract: self-clean at ZERO baseline entries — every
+    # serving/distributed blocking call in-tree carries a bound
+    bl = load_baseline(default_baseline_path())
+    assert not [k for k in bl if "::TPU021::" in k]
+    violations, errors = run_paths(GATE_PATHS)
+    assert errors == {}
+    assert [v for v in violations if v.rule == "TPU021"] == []
 
 
 # -- suppressions ------------------------------------------------------------
