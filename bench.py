@@ -894,6 +894,10 @@ def main():
         "unit": "images/sec",
         "vs_baseline": None,
         "device_kind": None,
+        # master-weight precision of the headline training legs (the
+        # gpt AMP leg casts compute to bf16 under O2 but keeps fp32
+        # masters); serving precision lives on bench_serve records
+        "precision": "fp32",
     }
 
     # parent-side telemetry: cheap (the parent never touches the device —

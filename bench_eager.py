@@ -122,6 +122,101 @@ def _capture_contract(pt):
     }
 
 
+def _amp_contract(pt):
+    """AMP O2 acceptance check: the 10-step MLP train run captured with
+    bf16-decorated params (fp32 master weights in the optimizer) vs the
+    fp32 baseline from identical seeds.  The contract is exactly 1
+    compile each, a quiet numerics sentinel riding inside the AMP
+    program, a decreasing loss, and a final loss within tolerance of
+    fp32 — low precision must change throughput, not where the model
+    goes.  Timing uses the same interleaved min-of-rounds discipline as
+    ``_numerics_contract`` (on CPU bf16 is emulated, so the ratio is
+    reported, not gated)."""
+    import numpy as np
+    import jax
+    import paddle_tpu.nn as nn
+    from paddle_tpu.observability.numerics import get_monitor, \
+        reset_monitor
+
+    def build(amp):
+        reset_monitor()
+        if amp:
+            get_monitor().enable(cadence=4)
+        np.random.seed(3)
+        pt.seed(3)
+        model = nn.Sequential(nn.Linear(256, 256), nn.ReLU(),
+                              nn.Linear(256, 1))
+        if amp:
+            pt.amp.decorate(model, level="O2", dtype="bfloat16")
+        opt = pt.optimizer.Momentum(learning_rate=0.005, momentum=0.9,
+                                    parameters=model.parameters(),
+                                    multi_precision=True)
+        mse = nn.MSELoss()
+
+        @pt.jit.capture_step
+        def step(x, y):
+            loss = mse(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        return step
+
+    rng = np.random.RandomState(4)
+    xs = rng.randn(4096, 256).astype(np.float32)
+    ys = rng.randn(4096, 1).astype(np.float32)
+    y = pt.to_tensor(ys)
+    # the AMP step eats bf16 activations end to end — feeding it fp32
+    # inputs would silently promote every matmul back to full precision
+    x32 = pt.to_tensor(xs)
+    x16 = pt.to_tensor(xs).astype("bfloat16")
+
+    def run10(step, x):
+        return [float(np.asarray(step(x, y)._data, np.float32))
+                for _ in range(10)]
+
+    step_off = build(False)
+    losses_off = run10(step_off, x32)
+    step_amp = build(True)
+    losses_amp = run10(step_amp, x16)
+    mon = get_monitor()
+    quiet = mon.anomaly_count() == 0
+    final_off, final_amp = losses_off[-1], losses_amp[-1]
+    gap = abs(final_amp - final_off)
+    tol = max(0.05, 0.05 * abs(final_off))
+
+    best = {False: float("inf"), True: float("inf")}
+    steps = {False: (step_off, x32), True: (step_amp, x16)}
+    for r in range(20):
+        order = (False, True) if r % 2 == 0 else (True, False)
+        for amp in order:
+            s, x = steps[amp]
+            jax.block_until_ready(s(x, y)._data)
+            t0 = time.perf_counter()
+            jax.block_until_ready(s(x, y)._data)
+            best[amp] = min(best[amp], time.perf_counter() - t0)
+    return {
+        "steps": 10,
+        "compiles_fp32": step_off.stats["compiles"],
+        "compiles_amp": step_amp.stats["compiles"],
+        "loss_final_fp32": round(final_off, 6),
+        "loss_final_amp": round(final_amp, 6),
+        "loss_gap": round(gap, 6),
+        "loss_tolerance": round(tol, 6),
+        "sentinel_quiet": quiet,
+        "step_us_fp32": round(best[False] * 1e6, 1),
+        "step_us_amp": round(best[True] * 1e6, 1),
+        "amp_speedup_x": round(best[False] / best[True], 3)
+        if best[True] else None,
+        "ok": (step_off.stats["compiles"] == 1
+               and step_amp.stats["compiles"] == 1
+               and quiet and gap <= tol
+               and losses_off[-1] < losses_off[0]
+               and losses_amp[-1] < losses_amp[0]),
+    }
+
+
 def _numerics_contract(pt):
     """Monitored-capture acceptance check: the same 10-step MLP run
     with the numerics sentinel on vs off. The monitor's health outputs
@@ -491,9 +586,11 @@ def main():
         round(res["captured_step"] / res["jit_chain"], 2) \
         if res["jit_chain"] else None
     res["value"] = res["tape_on"]
+    res["precision"] = "fp32"
     res["capture"] = _capture_contract(pt)
     res["fusion"] = _fusion_bench(pt)
     res["numerics_contract"] = _numerics_contract(pt)
+    res["amp_contract"] = _amp_contract(pt)
     res["memory_contract"] = _memory_contract(pt)
     res["telemetry"] = tel.snapshot()
     res["trace"] = tr.snapshot()

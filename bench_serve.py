@@ -45,6 +45,10 @@ def parse_args(argv=None):
                     help="max prompt length (sampled 3..N per stream)")
     ap.add_argument("--max-new", type=int, default=8,
                     help="tokens to generate per request")
+    ap.add_argument("--precision", default=None,
+                    choices=("fp32", "bf16", "int8"),
+                    help="serve precision (overrides PT_SERVE_PRECISION; "
+                         "int8 = PTQ weights + int8 paged KV-cache)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--vocab", type=int, default=256)
     ap.add_argument("--hidden", type=int, default=64)
@@ -79,6 +83,8 @@ def emit(record, out=None):
 def main(argv=None):
     args = parse_args(argv)
     t_start = time.time()
+    precision = (args.precision
+                 or os.environ.get("PT_SERVE_PRECISION") or "fp32")
     record = {
         "bench": "serve",
         "ts": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
@@ -87,6 +93,7 @@ def main(argv=None):
         "rate": args.rate,
         "max_new_tokens": args.max_new,
         "deadline_ms": args.deadline_ms or None,
+        "precision": precision,
         "platform": os.environ.get("JAX_PLATFORMS", ""),
     }
 
@@ -124,7 +131,10 @@ def main(argv=None):
         # resilience accounting rides the fast-fail record too, zeroed:
         # downstream dashboards key on the fields existing every run
         record.update({"shed_total": 0, "cancelled_total": 0,
-                       "deadline_exceeded_total": 0, "goodput": None})
+                       "deadline_exceeded_total": 0, "goodput": None,
+                       "kv_pool_dtype": None, "kv_pool_pages": None,
+                       "kv_page_headroom_x": None,
+                       "max_logit_divergence": None})
         emit(record, args.out)
         return 1
 
@@ -144,7 +154,7 @@ def main(argv=None):
     spec = ModelSpec(vocab_size=args.vocab, hidden=args.hidden,
                      layers=args.layers, heads=args.heads,
                      max_seq_len=args.max_seq)
-    cfg = ServeConfig.from_env()
+    cfg = ServeConfig.from_env().replace(precision=precision)
     if not os.environ.get("PT_SERVE_MAX_INFLIGHT"):
         cfg = cfg.replace(max_inflight=max(cfg.max_inflight,
                                            args.streams + 1))
@@ -162,6 +172,13 @@ def main(argv=None):
     record["decode_buckets"] = list(engine.config.decode_buckets)
     record["prefill_buckets"] = list(engine.config.prefill_buckets)
     record["kv_pages"] = engine.config.kv_pages
+    pool_snap = engine.pool.snapshot()
+    record["kv_pool_dtype"] = pool_snap["dtype"]
+    record["kv_pool_pages"] = pool_snap["usable_pages"]
+    # admission headroom vs an fp32 pool under the SAME byte budget
+    # (PT_SERVE_KV_PAGES is fp32-denominated): the int8 memory win
+    record["kv_page_headroom_x"] = round(
+        pool_snap["usable_pages"] / max(1, cfg.kv_pages - 1), 2)
 
     engine.scheduler.start()
     rng = np.random.RandomState(args.seed)
@@ -260,8 +277,20 @@ def main(argv=None):
     record["ok"] = (not errors
                     and len(latencies) == expected_done
                     and engine.unexpected_compiles == 0)
-    record["bench_wall_sec"] = round(time.time() - t_start, 1)
     engine.close()
+    # quality leg: max-logit-divergence vs the fp32 oracle, replayed
+    # eagerly AFTER close (the compile sentinel is disarmed, so the
+    # oracle's eager compiles can't book as request-path compiles)
+    if precision == "int8":
+        from paddle_tpu.serving.quant import (default_calibration_prompts,
+                                              logit_divergence)
+        record["max_logit_divergence"] = round(logit_divergence(
+            spec, init_params(spec, args.seed),
+            default_calibration_prompts(spec),
+            page_size=cfg.page_size), 6)
+    else:
+        record["max_logit_divergence"] = 0.0
+    record["bench_wall_sec"] = round(time.time() - t_start, 1)
     emit(record, args.out)
     return 0 if record["ok"] else 1
 

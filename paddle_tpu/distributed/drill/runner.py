@@ -1973,8 +1973,13 @@ def run_serve_chaos_drill(root, *, max_new=8, storm_requests=6,
     from ...serving import (ModelSpec, ServeConfig, ServingEngine,
                             init_params)
     mspec = ModelSpec.from_dict(spec)
+    # the oracle honors PT_SERVE_PRECISION so the bit-identity legs
+    # hold at every fixed precision (the engine subprocesses inherit
+    # the same env): int8 oracle vs int8 workers, never cross-precision
     cfg = ServeConfig(decode_buckets=(2, 4), prefill_buckets=(16,),
-                      kv_pages=64, page_size=8)
+                      kv_pages=64, page_size=8,
+                      precision=os.environ.get("PT_SERVE_PRECISION")
+                      or "fp32")
     oracle_engine = ServingEngine(mspec, init_params(mspec, seed), cfg)
     oracle = [oracle_engine.generate([p], max_new_tokens=max_new)[0]
               for p in prompts]
@@ -2186,10 +2191,14 @@ def run_serve_chaos_drill(root, *, max_new=8, storm_requests=6,
             t.start()
 
         def _admitted():
+            # count responses that already landed as admitted too: on a
+            # fast host a request can finish before the last one is even
+            # submitted, so instantaneous depth alone never reaches the
+            # target and the wait would time out on a healthy server
             _s, health = _healthz(base2)
             depth = (health.get("active_sequences", 0) or 0) + \
                 (health.get("queue_depth", 0) or 0)
-            return True if depth >= len(dthreads) else None
+            return True if depth + len(inflight) >= len(dthreads) else None
 
         wait_until(_admitted, gen_timeout / 4,
                    desc="drain-leg requests to be admitted")

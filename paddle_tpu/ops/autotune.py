@@ -47,6 +47,8 @@ KERNEL_SCHEMA = {
     "fused_softmax_xent": 2,
     "fused_ln_matmul": 1,
     "fused_matmul_bias_gelu": 1,
+    "w8a16_matmul": 1,
+    "paged_attention_int8": 1,
 }
 
 

@@ -45,7 +45,9 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .pallas_ops import _CompilerParams, _NEG_INF, _interpret_default
 
-__all__ = ["paged_attention", "paged_attention_reference"]
+__all__ = ["paged_attention", "paged_attention_reference",
+           "paged_attention_int8", "paged_attention_int8_reference",
+           "tune_paged_attention_int8"]
 
 
 def paged_attention_reference(q, k_pages, v_pages, page_tables, lengths,
@@ -61,14 +63,16 @@ def paged_attention_reference(q, k_pages, v_pages, page_tables, lengths,
         sm_scale = 1.0 / math.sqrt(d)
     # (B, max_pages, ps, H, D) -> (B, C, H, D); position t sits at
     # context index t because pages fill in order
-    k_ctx = k_pages[page_tables].reshape(b, -1, h, d).astype(jnp.float32)
-    v_ctx = v_pages[page_tables].reshape(b, -1, h, d).astype(jnp.float32)
-    s = jnp.einsum("bhd,bchd->bhc", q.astype(jnp.float32), k_ctx) * sm_scale
+    k_ctx = k_pages[page_tables].reshape(b, -1, h, d)
+    v_ctx = v_pages[page_tables].reshape(b, -1, h, d)
+    s = jnp.einsum("bhd,bchd->bhc", q, k_ctx,
+                   preferred_element_type=jnp.float32) * sm_scale
     c = k_ctx.shape[1]
     mask = jnp.arange(c, dtype=jnp.int32)[None, :] < lengths[:, None]
     s = jnp.where(mask[:, None, :], s, _NEG_INF)
     w = jax.nn.softmax(s, axis=-1)
-    o = jnp.einsum("bhc,bchd->bhd", w, v_ctx)
+    o = jnp.einsum("bhc,bchd->bhd", w.astype(v_ctx.dtype), v_ctx,
+                   preferred_element_type=jnp.float32)
     return o.astype(q.dtype)
 
 
@@ -195,3 +199,208 @@ def paged_attention(q, k_pages, v_pages, page_tables, lengths, *,
     record_dispatch("paged_attention", "fallback")
     return paged_attention_reference(q, k_pages, v_pages, page_tables,
                                      lengths, sm_scale=sm_scale)
+
+
+# ---------------------------------------------------------------------------
+# int8-KV variant (the low-precision serving subsystem)
+# ---------------------------------------------------------------------------
+# Same attention, but the pool stores int8 values with per-(token, head)
+# f32 scales riding beside them (``PagePool(dtype=int8, scale_pages=
+# True)``): k/v_pages are (P, ps, H, D) int8 and k/v_scale are
+# (P, ps, H) f32.  Dequantization happens at the attention's edge —
+# scores and accumulation stay f32, so the math after the unpack is the
+# exact fp32 kernel above and the row-independence (bit-identity)
+# argument carries over unchanged.
+
+def paged_attention_int8_reference(q, k_pages, v_pages, k_scale, v_scale,
+                                   page_tables, lengths, *, sm_scale=None):
+    """XLA reference for int8 pages: gather values AND scales through
+    the page table, dequantize, masked softmax attention (f32)."""
+    b, h, d = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    k_ctx = (k_pages[page_tables].astype(jnp.float32)
+             * k_scale[page_tables][..., None]).reshape(b, -1, h, d)
+    v_ctx = (v_pages[page_tables].astype(jnp.float32)
+             * v_scale[page_tables][..., None]).reshape(b, -1, h, d)
+    s = jnp.einsum("bhd,bchd->bhc", q.astype(jnp.float32), k_ctx) * sm_scale
+    c = k_ctx.shape[1]
+    mask = jnp.arange(c, dtype=jnp.int32)[None, :] < lengths[:, None]
+    s = jnp.where(mask[:, None, :], s, _NEG_INF)
+    w = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhc,bchd->bhd", w, v_ctx)
+    return o.astype(q.dtype)
+
+
+def _paged_kernel_int8(pt_ref, len_ref, q_ref, k_ref, v_ref, ks_ref,
+                       vs_ref, o_ref, m_scr, l_scr, acc_scr, *, ps,
+                       max_pages, sm_scale):
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, _NEG_INF, jnp.float32)
+        l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    length = len_ref[b]
+
+    @pl.when(i * ps < length)
+    def _page():
+        q = q_ref[...].astype(jnp.float32)          # (H, D)
+        # unpack at the edge: int8 page * per-(token, head) scale
+        k = k_ref[...].astype(jnp.float32) * ks_ref[...][..., None]
+        v = v_ref[...].astype(jnp.float32) * vs_ref[...][..., None]
+        s = jnp.einsum("hd,phd->hp", q, k) * sm_scale
+        pos = i * ps + jax.lax.broadcasted_iota(jnp.int32, (1, ps), 1)
+        valid = pos < length                         # (1, ps)
+        s = jnp.where(valid, s, _NEG_INF)
+        m_prev, l_prev = m_scr[...], l_scr[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        # re-mask after the exp (see _paged_kernel)
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[...] * alpha \
+            + jnp.einsum("hp,phd->hd", p, v)
+        m_scr[:] = m_new
+        l_scr[:] = l_new
+
+    @pl.when(i == max_pages - 1)
+    def _finalize():
+        l = l_scr[...]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[...] = (acc_scr[...] / l).astype(o_ref.dtype)
+
+
+def _paged_attention_int8_pallas(q, k_pages, v_pages, k_scale, v_scale,
+                                 page_tables, lengths, *, sm_scale,
+                                 interpret, batch_semantics="parallel"):
+    b, h, d = q.shape
+    ps = k_pages.shape[1]
+    max_pages = page_tables.shape[1]
+    page_spec = pl.BlockSpec((None, ps, h, d),
+                             lambda bi, i, pt, ln: (pt[bi, i], 0, 0, 0))
+    scale_spec = pl.BlockSpec((None, ps, h),
+                              lambda bi, i, pt, ln: (pt[bi, i], 0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, max_pages),
+        in_specs=[
+            pl.BlockSpec((None, h, d), lambda bi, i, pt, ln: (bi, 0, 0)),
+            page_spec, page_spec, scale_spec, scale_spec,
+        ],
+        out_specs=pl.BlockSpec((None, h, d), lambda bi, i, pt, ln: (bi, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((h, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_paged_kernel_int8, ps=ps,
+                               max_pages=max_pages, sm_scale=sm_scale)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=(batch_semantics, "arbitrary")),
+        interpret=interpret,
+    )(page_tables, lengths, q, k_pages, v_pages, k_scale, v_scale)
+
+
+_canary_int8_ok = None
+
+
+def _canary_int8():
+    global _canary_int8_ok
+    if _canary_int8_ok is None:
+        try:
+            q = jnp.zeros((2, 2, 8), jnp.float32)
+            kp = jnp.zeros((3, 4, 2, 8), jnp.int8)
+            ks = jnp.ones((3, 4, 2), jnp.float32)
+            pt = jnp.zeros((2, 2), jnp.int32)
+            ln = jnp.ones((2,), jnp.int32)
+            _paged_attention_int8_pallas(q, kp, kp, ks, ks, pt, ln,
+                                         sm_scale=1.0,
+                                         interpret=_interpret_default())
+            _canary_int8_ok = True
+        except Exception:
+            _canary_int8_ok = False
+    return _canary_int8_ok
+
+
+def paged_attention_int8(q, k_pages, v_pages, k_scale, v_scale,
+                         page_tables, lengths, *, sm_scale=None,
+                         use_pallas=None, interpret=None):
+    """Dispatching entry for the int8-KV pool: Pallas kernel when
+    eligible (canary-probed), XLA gather+dequant+softmax reference
+    otherwise — booked on
+    ``pt_pallas_calls_total{kernel="paged_attention_int8"}``."""
+    from .fused_kernels import record_dispatch
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = _interpret_default()
+    if use_pallas is None:
+        use_pallas = not interpret  # on-TPU default; reference on CPU
+    if use_pallas and _canary_int8():
+        from . import autotune as _at
+        sem = "parallel"
+        if _at.enabled():
+            cached = _at.cache_get("paged_attention_int8",
+                                   _int8_tune_key(q, k_pages, interpret))
+            if cached:
+                sem = str(cached[0])
+        record_dispatch("paged_attention_int8", "pallas")
+        return _paged_attention_int8_pallas(
+            q, k_pages, v_pages, k_scale, v_scale, page_tables, lengths,
+            sm_scale=sm_scale, interpret=interpret, batch_semantics=sem)
+    record_dispatch("paged_attention_int8", "fallback")
+    return paged_attention_int8_reference(
+        q, k_pages, v_pages, k_scale, v_scale, page_tables, lengths,
+        sm_scale=sm_scale)
+
+
+def _int8_tune_key(q, k_pages, interpret):
+    b, h, d = q.shape
+    return (b, h, d, int(k_pages.shape[0]), int(k_pages.shape[1]),
+            int(interpret))
+
+
+def tune_paged_attention_int8(q, k_pages, v_pages, k_scale, v_scale,
+                              page_tables, lengths, *, interpret=None):
+    """Warmup autotune over the kernel's grid-semantics choice (the
+    batch axis can run parallel or arbitrary; which wins depends on the
+    page count per core) via :func:`autotune.search` under the
+    ``paged_attention_int8`` schema."""
+    from . import autotune as _at
+    if interpret is None:
+        interpret = _interpret_default()
+    b, h, d = q.shape
+    ps = k_pages.shape[1]
+    # int8 k/v page tiles + f32 scales + online-softmax scratch per step
+    vmem = 2 * ps * h * d + 2 * ps * h * 4 + h * d * 4 + 2 * h * 4
+
+    def cost(cfg):
+        return {"flops": 4.0 * b * h * ps * d * page_tables.shape[1],
+                "bytes": float(q.size * 4 + 2 * k_pages.size
+                               + 2 * k_scale.size * 4),
+                "vmem_bytes": vmem, "mxu_underfill": False}
+
+    cands = _at.generate_candidates(
+        [("choice", ("parallel", "arbitrary"))], cost)
+
+    def run(cfg):
+        out = _paged_attention_int8_pallas(
+            q, k_pages, v_pages, k_scale, v_scale, page_tables, lengths,
+            sm_scale=1.0 / math.sqrt(d), interpret=interpret,
+            batch_semantics=str(cfg[0]))
+        float(jnp.sum(out.astype(jnp.float32)))
+
+    best, timings = _at.search(
+        "paged_attention_int8", _int8_tune_key(q, k_pages, interpret),
+        run, cands, cost=cost)
+    _at.set_enabled(True)
+    return best, timings

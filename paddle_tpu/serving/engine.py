@@ -24,6 +24,7 @@ param buffers (no recompile: shapes are the signature, not values).
 """
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import os
@@ -33,10 +34,13 @@ from dataclasses import dataclass, asdict
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
-from .kv_cache import PagePool, NULL_PAGE
+from .kv_cache import PagePool, NULL_PAGE, kv_page_budget
 from .model import ModelSpec, init_params, prefill_step, decode_step
+
+PRECISIONS = ("fp32", "bf16", "int8")
 
 logger = logging.getLogger("paddle_tpu.serving")
 
@@ -55,6 +59,22 @@ warnings.filterwarnings(
 # up — blue/green, tests — is not a request-path incident)
 _AOT_BUILD_DEPTH = 0
 _AOT_BUILD_LOCK = threading.Lock()
+
+
+@contextlib.contextmanager
+def aot_build_phase():
+    """Mark the enclosed work as a sanctioned (non-request-path) compile
+    phase.  Engine construction uses it, and so does the PTQ tooling
+    (``serving/quant.py``) whose eager calibration/quality replays must
+    not book ``pt_serve_unexpected_compiles_total`` on a live engine."""
+    global _AOT_BUILD_DEPTH
+    with _AOT_BUILD_LOCK:
+        _AOT_BUILD_DEPTH += 1
+    try:
+        yield
+    finally:
+        with _AOT_BUILD_LOCK:
+            _AOT_BUILD_DEPTH -= 1
 
 
 def _env_int(name: str, default: int) -> int:
@@ -90,6 +110,12 @@ class ServeConfig:
       PT_SERVE_DEADLINE_MS      server-default request deadline (0 = none)
       PT_SERVE_MAX_QUEUE        bounded admission queue (0 = unbounded)
       PT_SERVE_DRAIN_S          graceful-drain budget on SIGTERM
+      PT_SERVE_PRECISION        serve numerics: fp32 | bf16 | int8
+
+    ``kv_pages`` is denominated in fp32 pages (a byte budget): lower
+    precisions scale the physical page count up at pool construction
+    (:func:`.kv_cache.kv_page_budget`), which is where the int8 mode's
+    ~2x+ admission headroom comes from.
     """
 
     decode_buckets: Tuple[int, ...] = (2, 4, 8, 16)
@@ -102,6 +128,7 @@ class ServeConfig:
     deadline_ms: float = 0.0  # server default; 0 = no deadline
     max_queue: int = 256      # bounded queue; 0 = unbounded
     drain_s: float = 10.0     # SIGTERM drain budget (seconds)
+    precision: str = "fp32"   # fp32 | bf16 | int8
 
     @classmethod
     def from_env(cls, **overrides) -> "ServeConfig":
@@ -121,6 +148,7 @@ class ServeConfig:
                                    cls.deadline_ms),
             max_queue=_env_int("PT_SERVE_MAX_QUEUE", cls.max_queue),
             drain_s=_env_float("PT_SERVE_DRAIN_S", cls.drain_s),
+            precision=os.environ.get("PT_SERVE_PRECISION") or cls.precision,
         )
         return base.replace(**overrides) if overrides else base
 
@@ -162,6 +190,9 @@ class ServeConfig:
             pre = [spec.max_seq_len]
         if self.page_size < 1:
             raise ValueError("page_size must be >= 1")
+        if self.precision not in PRECISIONS:
+            raise ValueError(
+                f"precision {self.precision!r} not in {PRECISIONS}")
         return self.replace(decode_buckets=tuple(dec),
                             prefill_buckets=tuple(pre))
 
@@ -196,15 +227,21 @@ class ServingEngine:
         # the whole construction is a sanctioned build phase: pool
         # creation (jnp.zeros fill) and warmup compile too, and must not
         # trip an already-armed sentinel on another live engine
-        global _AOT_BUILD_DEPTH
-        with _AOT_BUILD_LOCK:
-            _AOT_BUILD_DEPTH += 1
-        try:
+        with aot_build_phase():
+            prec = self.config.precision
+            kv_dtype = {"fp32": jnp.float32, "bf16": jnp.bfloat16,
+                        "int8": jnp.int8}[prec]
+            # the configured kv_pages is an fp32 byte budget — lower
+            # precisions buy more physical pages for the same spend,
+            # which is the admission-headroom win the bench measures
             self.pool = PagePool(
-                layers=spec.layers, pages=self.config.kv_pages,
+                layers=spec.layers,
+                pages=kv_page_budget(self.config.kv_pages, prec,
+                                     spec.head_dim),
                 page_size=self.config.page_size, heads=spec.heads,
-                head_dim=spec.head_dim)
-            self._params = _to_serve_device(params)
+                head_dim=spec.head_dim, dtype=kv_dtype,
+                scale_pages=(prec == "int8"))
+            self._params = _to_serve_device(self._prepare_params(params))
             self._weights_step = weights_step
             self._weights_lock = threading.Lock()
             self.unexpected_compiles = 0
@@ -214,54 +251,99 @@ class ServingEngine:
             self.compiled_programs = 0
             self._build_programs()
             self._warmup()
-        finally:
-            with _AOT_BUILD_LOCK:
-                _AOT_BUILD_DEPTH -= 1
         self._arm_sentinel()
         from .scheduler import ContinuousScheduler
         self.scheduler = ContinuousScheduler(self)
+
+    def _prepare_params(self, params):
+        """Convert an incoming weight tree to the engine's precision.
+
+        int8: deterministic inline PTQ (same weights always quantize to
+        the same bytes, so an fp32 dir served under
+        ``PT_SERVE_PRECISION=int8`` matches a saved quantized dir bit
+        for bit); already-quantized trees pass through.  bf16: cast
+        every float leaf.  fp32: identity.
+        """
+        prec = self.config.precision
+        if prec == "int8":
+            from . import quant as _quant
+            if not _quant.is_quantized_params(params):
+                params = _quant.quantize_params(params, self.spec)
+            return params
+        if prec == "bf16":
+            return jax.tree_util.tree_map(
+                lambda a: a.astype(jnp.bfloat16)
+                if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
+                else a, dict(params))
+        return params
 
     # -- AOT build (the only place that is ALLOWED to compile) --------------
 
     def _build_programs(self) -> None:
         """Lower+compile the full program ladder ahead of time."""
-        global _AOT_BUILD_DEPTH
-        with _AOT_BUILD_LOCK:
-            _AOT_BUILD_DEPTH += 1
-        try:
+        with aot_build_phase():
             self._build_programs_inner()
-        finally:
-            with _AOT_BUILD_LOCK:
-                _AOT_BUILD_DEPTH -= 1
 
     def _build_programs_inner(self) -> None:
         spec, cfg = self.spec, self.config
         ps = cfg.page_size
+        int8 = cfg.precision == "int8"
         p_struct = _struct_like(self._params)
         k_struct = _struct_like(self.pool.k_flat)
+        s_struct = _struct_like(self.pool.k_scale) if int8 else None
         i32 = np.int32
+        # fp32 keeps its PR 15 program names so audit/bench baselines
+        # stay comparable; other precisions are distinct programs
+        sfx = "" if cfg.precision == "fp32" else f"_{cfg.precision}"
 
-        def _pf(params, k_flat, v_flat, tokens, length, page_table):
-            return prefill_step(spec, params, k_flat, v_flat, tokens,
-                                length, page_table, page_size=ps)
+        if int8:
+            # the scale pools are donated state exactly like the value
+            # pools — the step rewrites both and the engine rebinds all
+            # four (donate_argnums covers 1..4)
+            def _pf(params, k_flat, v_flat, k_scale, v_scale, tokens,
+                    length, page_table):
+                return prefill_step(spec, params, k_flat, v_flat, tokens,
+                                    length, page_table, page_size=ps,
+                                    k_scale=k_scale, v_scale=v_scale)
 
-        def _dec(params, k_flat, v_flat, tokens, positions, page_tables):
-            return decode_step(spec, params, k_flat, v_flat, tokens,
-                               positions, page_tables, page_size=ps)
+            def _dec(params, k_flat, v_flat, k_scale, v_scale, tokens,
+                     positions, page_tables):
+                return decode_step(spec, params, k_flat, v_flat, tokens,
+                                   positions, page_tables, page_size=ps,
+                                   k_scale=k_scale, v_scale=v_scale)
 
-        pf_jit = jax.jit(_pf, donate_argnums=(1, 2))
-        dec_jit = jax.jit(_dec, donate_argnums=(1, 2))
+            donate = (1, 2, 3, 4)
+            labels = ("params", "k_flat", "v_flat", "k_scale", "v_scale",
+                      "tokens", "positions", "page_tables")
+            kv_args = (k_struct, k_struct, s_struct, s_struct)
+        else:
+            def _pf(params, k_flat, v_flat, tokens, length, page_table):
+                return prefill_step(spec, params, k_flat, v_flat, tokens,
+                                    length, page_table, page_size=ps)
+
+            def _dec(params, k_flat, v_flat, tokens, positions,
+                     page_tables):
+                return decode_step(spec, params, k_flat, v_flat, tokens,
+                                   positions, page_tables, page_size=ps)
+
+            donate = (1, 2)
+            labels = ("params", "k_flat", "v_flat", "tokens",
+                      "positions", "page_tables")
+            kv_args = (k_struct, k_struct)
+
+        pf_jit = jax.jit(_pf, donate_argnums=donate)
+        dec_jit = jax.jit(_dec, donate_argnums=donate)
 
         # graph audit (tools/audit): when enabled, every bucket
         # program's traced jaxpr is audited during the build — load
         # time only, sharing the trace the AOT lower needs anyway.
-        # The donation layout handed over mirrors donate_argnums=(1,2).
+        # The donation layout handed over mirrors donate_argnums.
         aud = None
         from ..tools.audit import runtime as _audit_rt
         if _audit_rt.audit_enabled():
             aud = _audit_rt
             n_p = len(jax.tree_util.tree_leaves(p_struct))
-            n_kv = 2 * len(jax.tree_util.tree_leaves(k_struct))
+            n_kv = len(kv_args) * len(jax.tree_util.tree_leaves(k_struct))
 
         def _compile(jitted, name, *args):
             if aud is None:
@@ -269,23 +351,23 @@ class ServingEngine:
             else:
                 traced = jitted.trace(*args)
                 aud.audit_serve_trace(name, traced.jaxpr, n_p, n_kv,
-                                      args)
+                                      args, labels=labels)
                 exe = traced.lower().compile()
             self._account_compile(name)
             return exe
 
         for s in cfg.prefill_buckets:
             self._prefill_exe[s] = _compile(
-                pf_jit, f"serve_prefill_s{s}",
-                p_struct, k_struct, k_struct,
+                pf_jit, f"serve_prefill_s{s}{sfx}",
+                p_struct, *kv_args,
                 jax.ShapeDtypeStruct((s,), i32),
                 jax.ShapeDtypeStruct((), i32),
                 jax.ShapeDtypeStruct((self.max_pages_per_seq,), i32))
 
         for b in cfg.decode_buckets:
             self._decode_exe[b] = _compile(
-                dec_jit, f"serve_decode_b{b}",
-                p_struct, k_struct, k_struct,
+                dec_jit, f"serve_decode_b{b}{sfx}",
+                p_struct, *kv_args,
                 jax.ShapeDtypeStruct((b,), i32),
                 jax.ShapeDtypeStruct((b,), i32),
                 jax.ShapeDtypeStruct((b, self.max_pages_per_seq), i32))
@@ -309,24 +391,30 @@ class ServingEngine:
         except Exception:
             pass
 
+    def _kv_state(self):
+        """The donated pool arrays in program argument order (value
+        pools, plus scale pools on a quantized engine)."""
+        if self.pool.scale_pages:
+            return (self.pool.k_flat, self.pool.v_flat,
+                    self.pool.k_scale, self.pool.v_scale)
+        return (self.pool.k_flat, self.pool.v_flat)
+
     def _warmup(self) -> None:
         """Execute every program once so first-request latency pays no
         lazy initialization, and the sentinel can be armed on a
         provably quiet path.  Warmup traffic writes only the null page."""
         maxp = self.max_pages_per_seq
         for s, exe in self._prefill_exe.items():
-            k2, v2, _, _ = exe(self._params, self.pool.k_flat,
-                               self.pool.v_flat,
+            *state, _, _ = exe(self._params, *self._kv_state(),
                                np.zeros((s,), np.int32), np.int32(1),
                                np.zeros((maxp,), np.int32))
-            self.pool.swap(k2, v2)
+            self.pool.swap(*state)
         for b, exe in self._decode_exe.items():
-            k2, v2, _, _ = exe(self._params, self.pool.k_flat,
-                               self.pool.v_flat,
+            *state, _, _ = exe(self._params, *self._kv_state(),
                                np.zeros((b,), np.int32),
                                np.zeros((b,), np.int32),
                                np.zeros((b, maxp), np.int32))
-            self.pool.swap(k2, v2)
+            self.pool.swap(*state)
         jax.block_until_ready(self.pool.k_flat)
 
     def _arm_sentinel(self) -> None:
@@ -393,10 +481,10 @@ class ServingEngine:
         padded[:n] = np.asarray(tokens, np.int32)
         with self._weights_lock:
             params = self._params
-        k2, v2, nxt, _ = self._prefill_exe[s](
-            params, self.pool.k_flat, self.pool.v_flat,
+        *state, nxt, _ = self._prefill_exe[s](
+            params, *self._kv_state(),
             padded, np.int32(n), np.asarray(page_table, np.int32))
-        self.pool.swap(k2, v2)
+        self.pool.swap(*state)
         return int(nxt)
 
     def decode(self, tokens: np.ndarray, positions: np.ndarray,
@@ -417,9 +505,9 @@ class ServingEngine:
         pt[:n] = page_tables
         with self._weights_lock:
             params = self._params
-        k2, v2, nxt, _ = self._decode_exe[b](
-            params, self.pool.k_flat, self.pool.v_flat, tok, pos, pt)
-        self.pool.swap(k2, v2)
+        *state, nxt, _ = self._decode_exe[b](
+            params, *self._kv_state(), tok, pos, pt)
+        self.pool.swap(*state)
         return np.asarray(nxt)[:n]
 
     # -- weights ------------------------------------------------------------
@@ -433,7 +521,10 @@ class ServingEngine:
 
         Same treedef/shapes required — the executables' signature is
         structural, so matching weights swap with zero compiles.
+        Incoming weights pass through the engine's precision conversion
+        first (fp32 trees quantize/cast to match).
         """
+        params = self._prepare_params(params)
         old = jax.tree_util.tree_structure(self._params)
         new = jax.tree_util.tree_structure(params)
         if old != new:
@@ -498,6 +589,7 @@ class ServingEngine:
             "kv_consistent": kv_consistent,
             "unexpected_compiles": self.unexpected_compiles,
             "compiled_programs": self.compiled_programs,
+            "precision": self.config.precision,
             "decode_buckets": list(self.config.decode_buckets),
             "prefill_buckets": list(self.config.prefill_buckets),
             "weights_step": self._weights_step,
@@ -558,15 +650,30 @@ def load_engine(path: str, config: Optional[ServeConfig] = None,
                 ("eos_id", "PT_SERVE_EOS_ID"),
                 ("deadline_ms", "PT_SERVE_DEADLINE_MS"),
                 ("max_queue", "PT_SERVE_MAX_QUEUE"),
-                ("drain_s", "PT_SERVE_DRAIN_S")):
+                ("drain_s", "PT_SERVE_DRAIN_S"),
+                ("precision", "PT_SERVE_PRECISION")):
             if os.environ.get(env):
                 env_kw[fname] = getattr(ServeConfig.from_env(), fname)
         config = file_cfg.replace(**env_kw) if env_kw else file_cfg
     if config_overrides:
         config = config.replace(**config_overrides)
     mgr = CheckpointManager(os.path.join(path, "weights"))
-    template = init_params(spec, seed=0)
-    params, step = mgr.restore_latest(template=template)
+    precision_meta = meta.get("precision") or {}
+    with aot_build_phase():
+        # template construction + checkpoint restore run jnp ops before
+        # ServingEngine's own sanctioned phase opens — keep them from
+        # booking compiles on other live engines in the process
+        if precision_meta.get("mode") == "int8":
+            # quantized dir: the restore template mirrors the quantized
+            # tree (``::q``/``::scale`` + ``act::`` leaves) so treedef
+            # validation still bites
+            from .quant import quantized_template
+            template = quantized_template(
+                spec,
+                act_sites=sorted(precision_meta.get("act_scales", {})))
+        else:
+            template = init_params(spec, seed=0)
+        params, step = mgr.restore_latest(template=template)
     if step is None:
         raise FileNotFoundError(
             f"no valid weight checkpoint under {path}/weights")

@@ -26,9 +26,32 @@ from typing import Dict, List, Sequence
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["PagePool", "KVPoolExhausted", "NULL_PAGE"]
+__all__ = ["PagePool", "KVPoolExhausted", "NULL_PAGE", "kv_page_budget"]
 
 NULL_PAGE = 0
+
+
+def kv_page_budget(pages: int, precision: str, head_dim: int) -> int:
+    """Scale an fp32-denominated page budget to a precision's real cost.
+
+    ``PT_SERVE_KV_PAGES`` is a BYTE budget expressed in fp32 pages (so
+    deployments compare precisions at identical HBM spend).  Per
+    (token, head) an fp32 page row costs ``4*D`` bytes; bf16 halves it;
+    int8 costs ``D`` for the values plus 4 for the f32 scale riding in
+    the scale pages.  The null page scales with everything else, so the
+    *usable* count is what gets the ratio — int8 at D=16 yields 3.2x
+    the admission headroom at the same byte spend.
+    """
+    if precision in ("fp32", "float32"):
+        return pages
+    fp32_cost = 4.0 * head_dim
+    if precision in ("bf16", "bfloat16"):
+        cost = 2.0 * head_dim
+    elif precision == "int8":
+        cost = head_dim + 4.0
+    else:
+        raise ValueError(f"unknown serve precision {precision!r}")
+    return 1 + int((pages - 1) * fp32_cost / cost)
 
 
 class KVPoolExhausted(RuntimeError):
@@ -43,7 +66,8 @@ class PagePool:
     """
 
     def __init__(self, *, layers: int, pages: int, page_size: int,
-                 heads: int, head_dim: int, dtype=jnp.float32):
+                 heads: int, head_dim: int, dtype=jnp.float32,
+                 scale_pages: bool = False):
         if pages < 2:
             raise ValueError("pages must be >= 2 (page 0 is the null page)")
         self.layers = layers
@@ -52,9 +76,18 @@ class PagePool:
         self.heads = heads
         self.head_dim = head_dim
         self.dtype = dtype
+        # quantized pools carry per-(token, head) f32 scales in shadow
+        # "scale pages" addressed by the same page table (the scale
+        # travels with the tensor — the TPU022 contract)
+        self.scale_pages = bool(scale_pages)
         shape = (layers, pages * page_size, heads, head_dim)
         self.k_flat = jnp.zeros(shape, dtype)
         self.v_flat = jnp.zeros(shape, dtype)
+        sshape = (layers, pages * page_size, heads)
+        self.k_scale = jnp.zeros(sshape, jnp.float32) \
+            if self.scale_pages else None
+        self.v_scale = jnp.zeros(sshape, jnp.float32) \
+            if self.scale_pages else None
         self._lock = threading.Lock()
         # LIFO free list: hot pages get reused while still cache/HBM warm
         self._free: List[int] = list(range(pages - 1, 0, -1))
@@ -176,10 +209,17 @@ class PagePool:
 
     # -- device state -------------------------------------------------------
 
-    def swap(self, k_flat, v_flat) -> None:
-        """Rebind the pools to a program's donated outputs."""
+    def swap(self, k_flat, v_flat, k_scale=None, v_scale=None) -> None:
+        """Rebind the pools to a program's donated outputs (scale pools
+        included when this is a quantized pool)."""
         self.k_flat = k_flat
         self.v_flat = v_flat
+        if self.scale_pages:
+            if k_scale is None or v_scale is None:
+                raise ValueError(
+                    "quantized pool swap requires k_scale and v_scale")
+            self.k_scale = k_scale
+            self.v_scale = v_scale
 
     def utilization(self) -> float:
         with self._lock:
@@ -191,6 +231,8 @@ class PagePool:
             free = len(self._free)
             return {
                 "pages": self.pages,
+                "dtype": np.dtype(self.dtype).name,
+                "scale_pages": self.scale_pages,
                 "usable_pages": self.usable_pages,
                 "free_pages": free,
                 "used_pages": self.usable_pages - free,
@@ -237,9 +279,14 @@ class PagePool:
             pass
 
     def _memory_named(self):
-        """Live-buffer attribution for the PR 14 census: the two pools
-        under ``kv::`` paths."""
-        return {"kv::k_pages": self.k_flat, "kv::v_pages": self.v_flat}
+        """Live-buffer attribution for the PR 14 census: the pools
+        (and, for quantized pools, their scale shadows) under ``kv::``
+        paths."""
+        named = {"kv::k_pages": self.k_flat, "kv::v_pages": self.v_flat}
+        if self.scale_pages:
+            named["kv::k_scales"] = self.k_scale
+            named["kv::v_scales"] = self.v_scale
+        return named
 
     def null_padded_table(self, page_ids: Sequence[int],
                           max_pages: int) -> np.ndarray:

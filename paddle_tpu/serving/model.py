@@ -26,16 +26,44 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Any, Dict, Tuple
+from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp
 
-from ..ops.paged_attention import paged_attention
+from ..ops.paged_attention import paged_attention, paged_attention_int8
+from ..ops.quant_kernels import quantize_kv, w8a16_matmul
 
-__all__ = ["ModelSpec", "init_params", "prefill_step", "decode_step"]
+__all__ = ["ModelSpec", "init_params", "prefill_step", "decode_step",
+           "QUANT_WEIGHT_NAMES"]
 
 _LN_EPS = 1e-5
+
+
+def QUANT_WEIGHT_NAMES(spec: "ModelSpec"):
+    """The weight matrices the int8 serve path quantizes: every
+    projection/MLP matmul.  Embedding, positional table, norms and
+    biases stay f32 (tiny, and the tied logits matmul wants the full-
+    precision embedding)."""
+    names = []
+    for i in range(spec.layers):
+        names += [f"h{i}.attn.wq", f"h{i}.attn.wk", f"h{i}.attn.wv",
+                  f"h{i}.attn.wo", f"h{i}.mlp.w1", f"h{i}.mlp.w2"]
+    return names
+
+
+def _matmul(params, name, x, tap=None):
+    """Precision-dispatching matmul: a weight present as ``name::q`` +
+    ``name::scale`` (the :mod:`.quant` checkpoint layout) runs through
+    the w8a16 kernel; otherwise the plain dense path.  ``tap`` is the
+    calibration hook — called with the matmul's input activation so the
+    PTQ observers see the same tensors the serve program computes."""
+    if tap is not None:
+        tap(name, x)
+    qk = name + "::q"
+    if qk in params:
+        return w8a16_matmul(x, params[qk], params[name + "::scale"])
+    return x @ params[name]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -105,10 +133,10 @@ def _ln(x, w, b):
     return (x32 - mu) * jax.lax.rsqrt(var + _LN_EPS) * w + b
 
 
-def _mlp(spec, params, i, x):
-    h = x @ params[f"h{i}.mlp.w1"] + params[f"h{i}.mlp.b1"]
+def _mlp(spec, params, i, x, tap=None):
+    h = _matmul(params, f"h{i}.mlp.w1", x, tap) + params[f"h{i}.mlp.b1"]
     h = jax.nn.gelu(h)
-    return h @ params[f"h{i}.mlp.w2"] + params[f"h{i}.mlp.b2"]
+    return _matmul(params, f"h{i}.mlp.w2", h, tap) + params[f"h{i}.mlp.b2"]
 
 
 def _flat_dest(page_table, positions, page_size):
@@ -125,8 +153,8 @@ def _flat_dest(page_table, positions, page_size):
 
 
 def prefill_step(spec: ModelSpec, params, k_flat, v_flat,
-                 tokens, length, page_table, *, page_size: int
-                 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+                 tokens, length, page_table, *, page_size: int,
+                 k_scale=None, v_scale=None, tap=None):
     """Run one prompt (padded to a seq bucket) and seed its KV pages.
 
     Args:
@@ -136,12 +164,21 @@ def prefill_step(spec: ModelSpec, params, k_flat, v_flat,
       page_table: ``(max_pages,)`` int32 pages owned by this sequence
         (unused tail = 0, the reserved null page).
       page_size: static tokens-per-page (trace-time constant).
+      k_scale/v_scale: donated scale pools ``(L, P*ps, H)`` f32 when
+        the KV pool is int8 (``k_flat.dtype``); the prompt's K/V are
+        quantized per (token, head) at write time.
+      tap: optional calibration hook ``tap(site, activation)`` — only
+        ever non-None in the eager PTQ harness, never in a serve trace.
 
-    Returns ``(k_flat, v_flat, next_token, logits)`` where
-    ``next_token`` is the greedy token following position length-1.
+    Returns ``(k_flat, v_flat, next_token, logits)``, with the two
+    scale pools spliced in after ``v_flat`` when they were passed.
+    Prefill attends over the in-layer full-precision K/V (the stored
+    pages are for later decode steps), matching standard PTQ serving
+    stacks.
     """
     s = tokens.shape[0]
     h = params["embed"][tokens] + params["pos"][:s]
+    cdt = params["embed"].dtype
     pos_ids = jnp.arange(s, dtype=jnp.int32)
     # causal AND inside the true prompt: key j visible to query i iff
     # j <= i and j < length
@@ -149,20 +186,30 @@ def prefill_step(spec: ModelSpec, params, k_flat, v_flat,
     scale = 1.0 / math.sqrt(spec.head_dim)
     ks, vs = [], []
     for i in range(spec.layers):
-        x = _ln(h, params[f"h{i}.ln1.w"], params[f"h{i}.ln1.b"])
-        q = (x @ params[f"h{i}.attn.wq"]).reshape(s, spec.heads, spec.head_dim)
-        k = (x @ params[f"h{i}.attn.wk"]).reshape(s, spec.heads, spec.head_dim)
-        v = (x @ params[f"h{i}.attn.wv"]).reshape(s, spec.heads, spec.head_dim)
-        att = jnp.einsum("ihd,jhd->hij", q, k) * scale
+        x = _ln(h, params[f"h{i}.ln1.w"],
+                params[f"h{i}.ln1.b"]).astype(cdt)
+        q = _matmul(params, f"h{i}.attn.wq", x,
+                    tap).reshape(s, spec.heads, spec.head_dim)
+        k = _matmul(params, f"h{i}.attn.wk", x,
+                    tap).reshape(s, spec.heads, spec.head_dim)
+        v = _matmul(params, f"h{i}.attn.wv", x,
+                    tap).reshape(s, spec.heads, spec.head_dim)
+        att = jnp.einsum("ihd,jhd->hij", q, k,
+                         preferred_element_type=jnp.float32) * scale
         att = jnp.where(mask[None, :, :], att, -1e30)
         w = jax.nn.softmax(att, axis=-1)
-        o = jnp.einsum("hij,jhd->ihd", w, v).reshape(s, spec.hidden)
-        h = h + o @ params[f"h{i}.attn.wo"]
-        x2 = _ln(h, params[f"h{i}.ln2.w"], params[f"h{i}.ln2.b"])
-        h = h + _mlp(spec, params, i, x2)
+        o = jnp.einsum("hij,jhd->ihd", w.astype(v.dtype), v,
+                       preferred_element_type=jnp.float32
+                       ).reshape(s, spec.hidden).astype(cdt)
+        h = h + _matmul(params, f"h{i}.attn.wo", o, tap)
+        x2 = _ln(h, params[f"h{i}.ln2.w"],
+                 params[f"h{i}.ln2.b"]).astype(cdt)
+        h = h + _mlp(spec, params, i, x2, tap)
         ks.append(k)
         vs.append(v)
-    hf = _ln(h, params["lnf.w"], params["lnf.b"])
+    hf = _ln(h, params["lnf.w"], params["lnf.b"]).astype(cdt)
+    if tap is not None:
+        tap("head", hf)
     logits_all = hf @ params["embed"].T                    # (S, V)
     logits = jnp.take(logits_all, length - 1, axis=0)      # (V,)
     next_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -173,14 +220,24 @@ def prefill_step(spec: ModelSpec, params, k_flat, v_flat,
                      _flat_dest(page_table, pos_ids, page_size), 0)
     k_stack = jnp.stack(ks)                                # (L, S, H, D)
     v_stack = jnp.stack(vs)
-    k_flat = k_flat.at[:, dest].set(k_stack.astype(k_flat.dtype))
-    v_flat = v_flat.at[:, dest].set(v_stack.astype(v_flat.dtype))
+    if k_flat.dtype == jnp.int8:
+        kq, ksc = quantize_kv(k_stack)
+        vq, vsc = quantize_kv(v_stack)
+        k_flat = k_flat.at[:, dest].set(kq)
+        v_flat = v_flat.at[:, dest].set(vq)
+        k_scale = k_scale.at[:, dest].set(ksc)
+        v_scale = v_scale.at[:, dest].set(vsc)
+    else:
+        k_flat = k_flat.at[:, dest].set(k_stack.astype(k_flat.dtype))
+        v_flat = v_flat.at[:, dest].set(v_stack.astype(v_flat.dtype))
+    if k_scale is not None:
+        return k_flat, v_flat, k_scale, v_scale, next_token, logits
     return k_flat, v_flat, next_token, logits
 
 
 def decode_step(spec: ModelSpec, params, k_flat, v_flat,
-                tokens, positions, page_tables, *, page_size: int
-                ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+                tokens, positions, page_tables, *, page_size: int,
+                k_scale=None, v_scale=None, tap=None):
     """One decode step for a padded batch bucket.
 
     Args:
@@ -191,30 +248,65 @@ def decode_step(spec: ModelSpec, params, k_flat, v_flat,
         their writes land in the null page.
       page_tables: ``(B, max_pages)`` int32.
       page_size: static tokens-per-page (trace-time constant).
+      k_scale/v_scale: donated scale pools ``(L, P*ps, H)`` f32 for an
+        int8 pool; the step's K/V quantize per (token, head) at write
+        time — a pure per-row function, so row bytes never depend on
+        batch neighbours (the bit-identity contract survives int8).
+      tap: optional calibration hook (eager PTQ harness only).
 
-    Returns ``(k_flat, v_flat, next_tokens, logits)``.
+    Returns ``(k_flat, v_flat, next_tokens, logits)``, with the scale
+    pools spliced in after ``v_flat`` when they were passed.
     """
     b = tokens.shape[0]
     num_pages = k_flat.shape[1] // page_size
+    quant = k_flat.dtype == jnp.int8
     dest = _flat_dest(page_tables, positions, page_size)   # (B,)
     lengths = positions + 1
     h = params["embed"][tokens] + params["pos"][positions]
+    cdt = params["embed"].dtype
     for i in range(spec.layers):
-        x = _ln(h, params[f"h{i}.ln1.w"], params[f"h{i}.ln1.b"])
-        q = (x @ params[f"h{i}.attn.wq"]).reshape(b, spec.heads, spec.head_dim)
-        k = (x @ params[f"h{i}.attn.wk"]).reshape(b, spec.heads, spec.head_dim)
-        v = (x @ params[f"h{i}.attn.wv"]).reshape(b, spec.heads, spec.head_dim)
-        k_flat = k_flat.at[i, dest].set(k.astype(k_flat.dtype))
-        v_flat = v_flat.at[i, dest].set(v.astype(v_flat.dtype))
-        k_pages = k_flat[i].reshape(num_pages, page_size,
-                                    spec.heads, spec.head_dim)
-        v_pages = v_flat[i].reshape(num_pages, page_size,
-                                    spec.heads, spec.head_dim)
-        o = paged_attention(q, k_pages, v_pages, page_tables, lengths)
-        h = h + o.reshape(b, spec.hidden) @ params[f"h{i}.attn.wo"]
-        x2 = _ln(h, params[f"h{i}.ln2.w"], params[f"h{i}.ln2.b"])
-        h = h + _mlp(spec, params, i, x2)
-    hf = _ln(h, params["lnf.w"], params["lnf.b"])
+        x = _ln(h, params[f"h{i}.ln1.w"],
+                params[f"h{i}.ln1.b"]).astype(cdt)
+        q = _matmul(params, f"h{i}.attn.wq", x,
+                    tap).reshape(b, spec.heads, spec.head_dim)
+        k = _matmul(params, f"h{i}.attn.wk", x,
+                    tap).reshape(b, spec.heads, spec.head_dim)
+        v = _matmul(params, f"h{i}.attn.wv", x,
+                    tap).reshape(b, spec.heads, spec.head_dim)
+        if quant:
+            kq, ksc = quantize_kv(k)
+            vq, vsc = quantize_kv(v)
+            k_flat = k_flat.at[i, dest].set(kq)
+            v_flat = v_flat.at[i, dest].set(vq)
+            k_scale = k_scale.at[i, dest].set(ksc)
+            v_scale = v_scale.at[i, dest].set(vsc)
+            o = paged_attention_int8(
+                q,
+                k_flat[i].reshape(num_pages, page_size, spec.heads,
+                                  spec.head_dim),
+                v_flat[i].reshape(num_pages, page_size, spec.heads,
+                                  spec.head_dim),
+                k_scale[i].reshape(num_pages, page_size, spec.heads),
+                v_scale[i].reshape(num_pages, page_size, spec.heads),
+                page_tables, lengths)
+        else:
+            k_flat = k_flat.at[i, dest].set(k.astype(k_flat.dtype))
+            v_flat = v_flat.at[i, dest].set(v.astype(v_flat.dtype))
+            k_pages = k_flat[i].reshape(num_pages, page_size,
+                                        spec.heads, spec.head_dim)
+            v_pages = v_flat[i].reshape(num_pages, page_size,
+                                        spec.heads, spec.head_dim)
+            o = paged_attention(q, k_pages, v_pages, page_tables, lengths)
+        h = h + _matmul(params, f"h{i}.attn.wo",
+                        o.reshape(b, spec.hidden), tap)
+        x2 = _ln(h, params[f"h{i}.ln2.w"],
+                 params[f"h{i}.ln2.b"]).astype(cdt)
+        h = h + _mlp(spec, params, i, x2, tap)
+    hf = _ln(h, params["lnf.w"], params["lnf.b"]).astype(cdt)
+    if tap is not None:
+        tap("head", hf)
     logits = hf @ params["embed"].T                        # (B, V)
     next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if k_scale is not None:
+        return k_flat, v_flat, k_scale, v_scale, next_tokens, logits
     return k_flat, v_flat, next_tokens, logits

@@ -28,6 +28,11 @@ AUD004  host-transfer        callbacks/infeed/outfeed in the program —
 AUD005  missed-fusion        clusters the fusion pass should have
                              claimed but did not, with the blocking
                              escape named (``fusion_pass.match_report``)
+AUD006  dequant-placement    an int8→float dequantize whose result
+                             reaches more than one ``dot_general`` —
+                             XLA must materialize the full-precision
+                             copy in HBM, forfeiting the int8 memory
+                             win; an error in serve programs
 ======  ===================  ==========================================
 """
 from __future__ import annotations
@@ -468,6 +473,84 @@ class HostTransfer(Rule):
                              + (" — on the serving request path this "
                                 "stalls every token"
                                 if prog.kind == "serve" else ""))))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# AUD006 — dequant placement
+# ---------------------------------------------------------------------------
+@register
+class DequantPlacement(Rule):
+    id = "AUD006"
+    name = "dequant-placement"
+    rationale = ("an int8→float convert_element_type feeding more than "
+                 "one dot_general forces XLA to materialize the "
+                 "dequantized copy in HBM and keep it live across every "
+                 "consumer — the int8 storage win is forfeited exactly "
+                 "where it was supposed to pay; dequantize per use site "
+                 "(one convert, one dot) so the upcast fuses into the "
+                 "dot it feeds, the w8a16_matmul_reference form")
+
+    _QUANT = frozenset(("int8", "uint8", "int4", "uint4"))
+    # ops a dequantized value flows through without the copy stopping
+    # being "the dequantized copy" — the scale multiply and gathers of
+    # the reference kernels live here
+    _FOLLOW = _LAYOUT_TRANSPARENT | _ELEMENTWISE | frozenset((
+        "transpose", "concatenate", "gather", "dynamic_slice"))
+
+    def _dot_fanout(self, g: GraphView, v, max_nodes: int = 256) -> int:
+        """Distinct dot_generals reachable from ``v`` through
+        value-forwarding ops."""
+        dots, seen, frontier, n = set(), set(), [v], 0
+        while frontier and n < max_nodes:
+            n += 1
+            u = frontier.pop()
+            if id(u) in seen:
+                continue
+            seen.add(id(u))
+            for ci in g.consumers.get(u, ()):
+                if ci == g.OUT:
+                    continue
+                eqn = g.eqns[ci]
+                prim = eqn.primitive.name
+                if prim == "dot_general":
+                    dots.add(ci)
+                elif prim in self._FOLLOW:
+                    frontier.extend(eqn.outvars)
+        return len(dots)
+
+    def check(self, prog: AuditProgram) -> List[Finding]:
+        severity = "error" if prog.kind == "serve" else "warning"
+        findings: List[Finding] = []
+        for jaxpr, path in walk_jaxprs(prog.jaxpr):
+            g = None
+            for eqn in jaxpr.eqns:
+                if eqn.primitive.name != "convert_element_type":
+                    continue
+                src, out = eqn.invars[0], eqn.outvars[0]
+                if not (hasattr(src, "aval") and hasattr(out, "aval")):
+                    continue
+                if _dtype_name(src.aval) not in self._QUANT:
+                    continue
+                if not np.issubdtype(np.dtype(out.aval.dtype),
+                                     np.floating):
+                    continue
+                if g is None:
+                    g = GraphView(jaxpr)
+                dots = self._dot_fanout(g, out)
+                if dots <= 1:
+                    continue
+                where = f" inside {path}" if path else ""
+                findings.append(Finding(
+                    rule=self.id, severity=severity, program=prog.name,
+                    provenance=(f"dequant[{src.aval.str_short()}->"
+                                f"{_dtype_name(out.aval)}x{dots}]"),
+                    message=(f"dequantized {src.aval.str_short()} feeds "
+                             f"{dots} dot_generals{where} — XLA holds "
+                             "the full-precision copy live across all "
+                             "of them; dequantize per dot (one convert "
+                             "per use) so the upcast fuses into the "
+                             "dot's operand read")))
         return findings
 
 

@@ -183,14 +183,17 @@ _ARG_LABELS_SERVE = ("params", "k_flat", "v_flat", "tokens",
 
 
 def audit_serve_trace(name: str, closed, n_params: int,
-                      n_kv: int, args=None) -> List[Finding]:
+                      n_kv: int, args=None, labels=None) -> List[Finding]:
     """Audit one AOT serve program from its traced jaxpr.  Donation
-    layout mirrors the engine's ``donate_argnums=(1, 2)``: the KV pool
-    leaves right after the ``n_params`` weight leaves."""
+    layout mirrors the engine's donate_argnums: the ``n_kv`` KV pool
+    leaves (value pools, plus scale pools on a quantized ladder) right
+    after the ``n_params`` weight leaves.  ``labels`` overrides the
+    positional arg names when the engine's argument layout differs
+    from the fp32 default (the int8 ladder inserts k_scale/v_scale)."""
     names = None
     if args is not None:
         try:
-            names = _flat_arg_names(args, _ARG_LABELS_SERVE)
+            names = _flat_arg_names(args, labels or _ARG_LABELS_SERVE)
         except Exception:
             names = None
     prog = AuditProgram(

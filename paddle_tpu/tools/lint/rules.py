@@ -1177,6 +1177,62 @@ class ImportTimeEnvRead(Rule):
 
 
 @register
+class RawQuantDtypeCast(Rule):
+    id = "TPU022"
+    name = "raw-quant-dtype-cast-outside-quant-layers"
+    rationale = ("a bare astype(int8)/view(int8) outside paddle_tpu/ops/ "
+                 "and paddle_tpu/quantization/ is a lossy cast with no "
+                 "scale attached — astype saturates/wraps without "
+                 "recording the absmax, view reinterprets bytes, and "
+                 "either way the consumer can't dequantize; the "
+                 "framework's quant numerics live in "
+                 "ops/quant_kernels.py (quantize_weight/quantize_kv "
+                 "return the int8 payload WITH its scale) and the "
+                 "observer machinery in quantization/ — route casts "
+                 "through them so every int8 tensor in flight carries "
+                 "its dequant contract")
+
+    _CAST_ATTRS = {"astype", "view"}
+    _QUANT_DTYPES = {"int8", "int4", "uint4",
+                     "float8_e4m3fn", "float8_e5m2"}
+    # astype(uint8) is the image-pixel idiom (vision transforms) and
+    # stays legal; view(uint8) is a byte reinterpretation and is not
+    _VIEW_ONLY_DTYPES = {"uint8"}
+    # the layers that OWN quant casts: the kernel/dispatch layer and the
+    # observer/fake-quant machinery
+    _EXEMPT = re.compile(r"(^|/)paddle_tpu/(ops|quantization)(/|$)")
+
+    def _quant_dtype(self, node, allowed):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value if node.value in allowed else None
+        name = dotted(node)
+        if name.rpartition(".")[2] in allowed:
+            return name
+        return None
+
+    def on_call(self, node, ctx):
+        if not ctx.library_path or self._EXEMPT.search(ctx.path_posix):
+            return
+        f = node.func
+        if not isinstance(f, ast.Attribute) or f.attr not in self._CAST_ATTRS:
+            return
+        allowed = self._QUANT_DTYPES if f.attr == "astype" \
+            else self._QUANT_DTYPES | self._VIEW_ONLY_DTYPES
+        dtype_exprs = list(node.args) + [kw.value for kw in node.keywords
+                                         if kw.arg == "dtype"]
+        for expr in dtype_exprs:
+            dt = self._quant_dtype(expr, allowed)
+            if dt:
+                ctx.report(node, self.id,
+                           f".{f.attr}({dt}) outside the quant layers "
+                           f"drops the scale the int8 payload needs; use "
+                           f"ops.quant_kernels.quantize_weight/"
+                           f"quantize_kv (payload + scale together) or "
+                           f"move the cast into paddle_tpu/ops/")
+                return
+
+
+@register
 class RequestPathCompile(Rule):
     id = "TPU019"
     name = "request-path-compile"

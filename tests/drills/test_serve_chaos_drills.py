@@ -47,3 +47,20 @@ def test_serve_chaos_drill(tmp_path):
     assert report["disconnect_cancelled"] is True
     assert report["drain_rc"] == 143
     assert report["drain_responses"] == 3
+
+
+def test_serve_chaos_drill_int8(tmp_path, monkeypatch):
+    """The full resilience drill holds at int8: the serve subprocess
+    inherits PT_SERVE_PRECISION=int8 (quantized weights, int8 KV pool)
+    and the oracle quantizes identically, so recovery/drain legs still
+    compare bit-identical token streams."""
+    monkeypatch.setenv("PT_SERVE_PRECISION", "int8")
+    logs = str(tmp_path / "logs")
+    os.makedirs(logs, exist_ok=True)
+    report = run_serve_chaos_drill(str(tmp_path), log_dir=logs)
+    assert report["gen1_rc"] == -9
+    assert report["gen2_recovered"] is True
+    assert report["storm_shed"] == 6
+    assert report["disconnect_cancelled"] is True
+    assert report["drain_rc"] == 143
+    assert report["drain_responses"] == 3

@@ -214,6 +214,63 @@ def test_aud005_silent_when_fusion_not_expected():
     assert not fired(audit(prog), "AUD005")
 
 
+def test_aud006_fires_on_shared_dequant():
+    # one int8→f32 convert feeding two dots: the f32 copy outlives both
+    def bad(w_q, x1, x2):
+        w = w_q.astype(jnp.float32)
+        return x1 @ w, x2 @ w
+
+    jx = jax.make_jaxpr(bad)(jnp.ones((8, 8), jnp.int8),
+                             jnp.ones((4, 8)), jnp.ones((4, 8)))
+    hits = fired(audit(AuditProgram("srv", jx, kind="serve")), "AUD006")
+    assert hits and hits[0].severity == "error"
+    assert "dequant[" in hits[0].provenance and "x2]" in hits[0].provenance
+
+
+def test_aud006_silent_on_per_dot_dequant():
+    # the w8a16_matmul_reference form: one convert per dot, scale in
+    # the epilogue — each upcast fuses into the dot it feeds
+    def good(w_q, s, x1, x2):
+        a = (x1 @ w_q.astype(jnp.float32)) * s
+        b = (x2 @ w_q.astype(jnp.float32)) * s
+        return a, b
+
+    jx = jax.make_jaxpr(good)(jnp.ones((8, 8), jnp.int8), jnp.ones((8,)),
+                              jnp.ones((4, 8)), jnp.ones((4, 8)))
+    assert not fired(audit(AuditProgram("srv", jx, kind="serve")),
+                     "AUD006")
+
+
+def test_aud006_warning_outside_serve_and_follows_elementwise():
+    # capture programs warn rather than error, and the walk follows the
+    # scale multiply (dequant = convert * scale) to both dots
+    def bad(w_q, s, x1, x2):
+        w = w_q.astype(jnp.float32) * s
+        return x1 @ w, x2 @ w
+
+    jx = jax.make_jaxpr(bad)(jnp.ones((8, 8), jnp.int8), jnp.ones((8,)),
+                             jnp.ones((4, 8)), jnp.ones((4, 8)))
+    hits = fired(audit(AuditProgram("cap", jx, kind="capture")), "AUD006")
+    assert hits and hits[0].severity == "warning"
+
+
+def test_aud006_int8_serve_ladder_is_clean(audit_on):
+    # the shipped int8 engine satisfies its own rule: every dequant in
+    # the AOT ladder feeds exactly one dot
+    from paddle_tpu.serving import ModelSpec, ServeConfig, init_params
+    from paddle_tpu.serving.engine import ServingEngine
+    spec = ModelSpec(vocab_size=64, hidden=32, layers=2, heads=2,
+                     max_seq_len=64)
+    cfg = ServeConfig(decode_buckets=(2,), prefill_buckets=(16,),
+                      kv_pages=32, page_size=8, precision="int8")
+    engine = ServingEngine(spec, init_params(spec, seed=0), cfg)
+    engine.close()
+    progs = runtime.snapshot()["programs"]
+    assert any(p.endswith("_int8") for p in progs)
+    assert not [f for f in runtime.findings()
+                if f.program.endswith("_int8")]
+
+
 # -- machinery ---------------------------------------------------------------
 
 def test_catalog_covers_all_five_rule_classes():

@@ -181,3 +181,188 @@ class TestPTQ:
         got = deployed(pt.to_tensor(X)).numpy()
         want = _model()(pt.to_tensor(X)).numpy()  # same seed -> same init
         np.testing.assert_allclose(got, want, atol=0.15)
+
+
+class TestObserverRoundTrip:
+    """Observer-driven fake-quant round-trips: scale SHAPES (per-tensor
+    scalar vs per-channel vector), the symmetric zero-point-free
+    contract, bf16 inputs, and zero-input degeneracy."""
+
+    def test_scale_shapes_per_tensor_vs_per_channel(self):
+        x = np.random.RandomState(0).randn(4, 6).astype(np.float32)
+        per_t = Q.AbsmaxObserver()
+        per_t.observe(pt.to_tensor(x))
+        assert np.ndim(per_t.scales()) == 0          # one scalar scale
+        assert per_t.quant_axis() is None
+        per_c = Q.PerChannelAbsmaxObserver(quant_axis_=1)
+        per_c.observe(pt.to_tensor(x))
+        s = np.asarray(per_c.scales())
+        assert s.shape == (6,)                       # one scale per channel
+        assert per_c.quant_axis() == 1
+        np.testing.assert_allclose(s, np.abs(x).max(axis=0))
+
+    def test_per_channel_roundtrip_beats_per_tensor(self):
+        # channel magnitudes spanning 100x: the global absmax scale
+        # wipes out the small channel, per-channel scales keep it
+        rng = np.random.RandomState(1)
+        x = (rng.randn(64, 3) * np.array([0.05, 1.0, 5.0])) \
+            .astype(np.float32)
+        per_c = Q.PerChannelAbsmaxObserver(quant_axis_=1)
+        per_c.observe(pt.to_tensor(x))
+        s = np.asarray(per_c.scales(), np.float32)
+        out_c = Q.quant_dequant(pt.to_tensor(x), scale=s,
+                                channel_axis=1).numpy()
+        # round-to-nearest on each channel's k*s/127 grid: error <= s/254
+        assert np.all(np.abs(out_c - x) <= s / 254 + 1e-7)
+        per_t = Q.AbsmaxObserver()
+        per_t.observe(pt.to_tensor(x))
+        out_t = Q.quant_dequant(pt.to_tensor(x),
+                                scale=float(per_t.scales())).numpy()
+        small = np.abs(x[:, 0])
+        assert np.abs(out_c[:, 0] - x[:, 0]).max() \
+            < np.abs(out_t[:, 0] - x[:, 0]).max()
+        assert small.max() > 0  # the comparison above was non-vacuous
+
+    def test_symmetric_scheme_has_no_zero_point(self):
+        # symmetric int8: zero maps to exactly zero and the grid is odd
+        # (q(-x) == -q(x)) — there is no zero-point offset to carry
+        x = np.array([0.0, 0.37, -0.37, 0.99, -0.99], np.float32)
+        out = np.asarray(Q.quant_dequant(x, scale=1.0, bit_length=8))
+        assert out[0] == 0.0
+        np.testing.assert_allclose(out[1::2], -out[2::2])
+
+    def test_bf16_inputs(self):
+        x = np.random.RandomState(2).randn(8, 16).astype(np.float32)
+        t = pt.to_tensor(x).astype("bfloat16")
+        obs = Q.AbsmaxObserver()
+        obs.observe(t)
+        # bf16 rounds the input, so the scale matches within bf16 eps
+        assert obs.scales() == pytest.approx(np.abs(x).max(), rel=0.01)
+        per_c = Q.PerChannelAbsmaxObserver(quant_axis_=1)
+        per_c.observe(t)
+        assert np.asarray(per_c.scales()).shape == (16,)
+        out = Q.quant_dequant(t, scale=float(obs.scales()), bit_length=8)
+        assert "bfloat16" in str(out.dtype)          # dtype preserved
+        step = float(obs.scales()) / 127
+        np.testing.assert_allclose(
+            np.asarray(out.numpy(), np.float32), x,
+            atol=step / 2 + 0.01 * np.abs(x).max())  # grid + bf16 rounding
+
+    def test_zero_input_degenerate(self):
+        obs = Q.AbsmaxObserver()
+        assert obs.scales() == pytest.approx(1e-9)   # never-observed floor
+        obs.observe(pt.to_tensor(np.zeros(4, np.float32)))
+        assert obs.scales() == 0.0
+        out = np.asarray(Q.quant_dequant(np.zeros(4, np.float32),
+                                         scale=obs.scales()))
+        assert np.isfinite(out).all() and not out.any()
+
+
+class TestQuantKernels:
+    """ops/quant_kernels: the serve-side int8 pack/unpack + w8a16
+    matmul (every raw quant-dtype cast in the tree lives there)."""
+
+    def _wx(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(5, 16).astype(np.float32)
+        w = (rng.randn(16, 8) * np.linspace(0.1, 4.0, 8)) \
+            .astype(np.float32)
+        return x, w
+
+    def test_quantize_weight_shapes_dtypes_grid(self):
+        from paddle_tpu.ops import quant_kernels as qk
+        x, w = self._wx()
+        q, s = qk.quantize_weight(w, axis=1)
+        assert str(q.dtype) == "int8" and q.shape == w.shape
+        assert s.shape == (8,) and str(s.dtype) == "float32"
+        assert np.abs(np.asarray(q, np.int32)).max() <= 127
+        deq = np.asarray(qk.dequantize_weight(q, s, axis=1))
+        # round-to-nearest on each column's grid: error <= scale/2
+        assert np.all(np.abs(deq - w) <= np.asarray(s)[None, :] / 2 + 1e-7)
+
+    def test_quantize_weight_zero_channel(self):
+        from paddle_tpu.ops import quant_kernels as qk
+        w = np.zeros((4, 3), np.float32)
+        w[:, 1] = [1.0, -2.0, 0.5, 0.0]
+        q, s = qk.quantize_weight(w, axis=1)
+        assert np.isfinite(np.asarray(s)).all()
+        deq = np.asarray(qk.dequantize_weight(q, s, axis=1))
+        assert not deq[:, 0].any() and not deq[:, 2].any()
+
+    def test_quantize_kv_row_independent_and_roundtrip(self):
+        from paddle_tpu.ops import quant_kernels as qk
+        kv = np.random.RandomState(3).randn(6, 2, 16).astype(np.float32)
+        qb, sb = qk.quantize_kv(kv)
+        assert qb.shape == kv.shape and sb.shape == (6, 2)
+        # a row's stored bytes must not depend on its batch neighbours
+        # (the continuous-batching bit-identity contract at int8)
+        q1, s1 = qk.quantize_kv(kv[3])
+        assert np.array_equal(np.asarray(qb)[3], np.asarray(q1))
+        assert np.array_equal(np.asarray(sb)[3], np.asarray(s1))
+        deq = np.asarray(qk.dequantize_kv(qb, sb))
+        assert np.all(np.abs(deq - kv) <= np.asarray(sb)[..., None] / 2
+                      + 1e-7)
+
+    def test_w8a16_matmul_reference_numerics(self):
+        from paddle_tpu.ops import quant_kernels as qk
+        x, w = self._wx()
+        q, s = qk.quantize_weight(w, axis=1)
+        got = np.asarray(qk.w8a16_matmul_reference(x, q, s))
+        # (x @ q) * s is x @ dequant(q, s) up to f32 reassociation
+        deq = np.asarray(qk.dequantize_weight(q, s, axis=1))
+        np.testing.assert_allclose(got, x @ deq, atol=1e-4)
+        # and within the analytic quant bound of the fp32 matmul
+        bound = np.abs(x) @ np.ones_like(w) * (np.asarray(s) / 2)
+        assert np.all(np.abs(got - x @ w) <= bound + 1e-5)
+
+    def test_w8a16_pallas_interpret_bit_identical_to_mirror(self):
+        import jax.numpy as jnp
+        from paddle_tpu.ops import quant_kernels as qk
+        x, w = self._wx()          # m=5, n=8: both block pads exercised
+        q, s = qk.quantize_weight(w, axis=1)
+        out_p = np.asarray(qk.w8a16_matmul(jnp.asarray(x), q, s,
+                                           use_pallas=True,
+                                           interpret=True))
+        out_r = np.asarray(qk.w8a16_matmul_reference(jnp.asarray(x), q, s))
+        assert np.array_equal(out_p, out_r)
+
+    def test_w8a16_bf16_activations(self):
+        import jax.numpy as jnp
+        from paddle_tpu.ops import quant_kernels as qk
+        x, w = self._wx()
+        q, s = qk.quantize_weight(w, axis=1)
+        ref = np.asarray(qk.w8a16_matmul_reference(x, q, s))
+        out = qk.w8a16_matmul_reference(jnp.asarray(x, jnp.bfloat16), q, s)
+        assert str(out.dtype) == "bfloat16"          # "a16" half honoured
+        rel = np.abs(np.asarray(out, np.float32) - ref).max() \
+            / np.abs(ref).max()
+        assert rel < 0.02                            # bf16 rounding only
+
+    def test_kernel_schema_has_quant_entries(self):
+        from paddle_tpu.ops.autotune import KERNEL_SCHEMA
+        assert "w8a16_matmul" in KERNEL_SCHEMA
+        assert "paged_attention_int8" in KERNEL_SCHEMA
+
+    def test_paged_attention_int8_matches_fp32_within_quant_tol(self):
+        from paddle_tpu.ops import quant_kernels as qk
+        from paddle_tpu.ops.paged_attention import (
+            paged_attention_reference, paged_attention_int8,
+            paged_attention_int8_reference)
+        rng = np.random.RandomState(4)
+        P, ps, H, D = 5, 4, 2, 8
+        kp = rng.randn(P, ps, H, D).astype(np.float32)
+        vp = rng.randn(P, ps, H, D).astype(np.float32)
+        kq, ks = qk.quantize_kv(kp)
+        vq, vs = qk.quantize_kv(vp)
+        qact = rng.randn(2, H, D).astype(np.float32)
+        ptab = np.array([[0, 2], [3, 1]], np.int32)
+        ln = np.array([3, 7], np.int32)
+        o32 = np.asarray(paged_attention_reference(qact, kp, vp, ptab, ln))
+        o8 = np.asarray(paged_attention_int8_reference(
+            qact, kq, vq, ks, vs, ptab, ln))
+        np.testing.assert_allclose(o8, o32, atol=0.05)
+        # the CPU dispatcher must be the reference bit-for-bit — the
+        # serve path's numerics definition off-TPU
+        o8d = np.asarray(paged_attention_int8(qact, kq, vq, ks, vs,
+                                              ptab, ln))
+        assert np.array_equal(o8d, o8)

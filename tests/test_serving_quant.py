@@ -1,0 +1,220 @@
+"""Low-precision serving: the bf16/int8 ladders of the AOT engine.
+
+Pins the contracts the low-precision subsystem ships on:
+
+ - byte-budget page scaling (``kv_page_budget``): int8 buys >= 1.9x
+   usable pages at the same HBM spend (the acceptance floor);
+ - zero request-path compiles at EVERY precision — the per-precision
+   bucket ladders are AOT-built like the fp32 one;
+ - continuous-batching join/leave bit-identity at bf16 AND int8 (the
+   fp32 contract survives the drop: per-row dynamic KV scales);
+ - quantized served-model dirs: save -> load round-trips bit-identical
+   to an engine that quantized the same fp32 weights inline;
+ - PT_SERVE_PRECISION env plumbing and its validation;
+ - the quality contract: max logit divergence of the int8 serve path
+   vs the fp32 oracle stays inside the pinned tolerance.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from paddle_tpu.serving import quant as sq
+from paddle_tpu.serving.engine import ServeConfig, ServingEngine, load_engine
+from paddle_tpu.serving.kv_cache import kv_page_budget
+from paddle_tpu.serving.model import ModelSpec, init_params
+
+SPEC = ModelSpec(vocab_size=64, hidden=32, layers=2, heads=2,
+                 max_seq_len=64)
+CFG = ServeConfig(decode_buckets=(4,), prefill_buckets=(16,),
+                  kv_pages=32, page_size=4, max_inflight=16,
+                  max_new_tokens=8)
+
+# the int8 quality bar: max |logit gap| vs the fp32 oracle across the
+# calibration prompts (measured ~2.5e-3 at this spec; an order of
+# magnitude of slack, still far below anything that flips an argmax
+# on this vocab)
+DIVERGENCE_TOL = 0.05
+
+
+def _params():
+    return init_params(SPEC, seed=0)
+
+
+def _prompts(n=7):
+    rng = np.random.RandomState(2)
+    return [rng.randint(1, SPEC.vocab_size,
+                        size=rng.randint(2, 12)).tolist()
+            for _ in range(n)]
+
+
+@pytest.fixture(scope="module")
+def int8_engine():
+    eng = ServingEngine(SPEC, _params(), CFG.replace(precision="int8"))
+    yield eng
+    eng.close()
+
+
+@pytest.fixture(scope="module")
+def bf16_engine():
+    eng = ServingEngine(SPEC, _params(), CFG.replace(precision="bf16"))
+    yield eng
+    eng.close()
+
+
+# -- byte-budget page scaling ------------------------------------------------
+class TestPageBudget:
+    def test_fp32_budget_is_identity(self):
+        assert kv_page_budget(32, "fp32", 16) == 32
+
+    def test_bf16_doubles_usable_pages(self):
+        # 31 usable fp32 pages at 64 B/row -> 62 usable at 32 B/row
+        assert kv_page_budget(32, "bf16", 16) == 63
+
+    def test_int8_clears_the_headroom_floor(self):
+        # int8 at D=16 costs D + 4 B/row (values + the f32 scale riding
+        # in the shadow scale pages): 1 + int(31 * 64 / 20) = 100
+        pages = kv_page_budget(32, "int8", 16)
+        assert pages == 100
+        assert (pages - 1) / 31 >= 1.9      # the acceptance floor
+
+    def test_unknown_precision_raises(self):
+        with pytest.raises(ValueError):
+            kv_page_budget(32, "fp8", 16)
+
+
+# -- the bf16 / int8 ladders -------------------------------------------------
+class TestInt8Engine:
+    def test_pool_is_int8_with_scale_shadows(self, int8_engine):
+        snap = int8_engine.pool.snapshot()
+        assert snap["dtype"] == "int8"
+        assert snap["scale_pages"] is True
+        want = kv_page_budget(CFG.kv_pages, "int8", SPEC.head_dim)
+        assert snap["usable_pages"] == want - 1   # minus the null page
+
+    def test_healthz_reports_precision(self, int8_engine):
+        health = int8_engine.healthz()
+        assert health["precision"] == "int8"
+        assert health["unexpected_compiles"] == 0
+
+    def test_join_leave_bit_identity_and_zero_compiles(self, int8_engine):
+        # the fp32 continuous-batching contract, unchanged at int8:
+        # per-(token, head) KV scales are a pure per-row function, so a
+        # sequence's bytes never depend on its batch neighbours
+        prompts = _prompts()
+        solo = [int8_engine.generate([p], max_new_tokens=8)[0]
+                for p in prompts]
+        batched = int8_engine.generate(prompts, max_new_tokens=8)
+        assert batched == solo
+        assert int8_engine.unexpected_compiles == 0
+
+    def test_generate_is_deterministic(self, int8_engine):
+        prompts = _prompts(3)
+        first = int8_engine.generate(prompts, max_new_tokens=8)
+        second = int8_engine.generate(prompts, max_new_tokens=8)
+        assert first == second
+
+
+class TestBf16Engine:
+    def test_pool_is_bf16(self, bf16_engine):
+        snap = bf16_engine.pool.snapshot()
+        assert snap["dtype"] == "bfloat16"
+        assert snap["scale_pages"] is False
+        want = kv_page_budget(CFG.kv_pages, "bf16", SPEC.head_dim)
+        assert snap["usable_pages"] == want - 1
+
+    def test_join_leave_bit_identity_and_zero_compiles(self, bf16_engine):
+        prompts = _prompts()
+        solo = [bf16_engine.generate([p], max_new_tokens=8)[0]
+                for p in prompts]
+        batched = bf16_engine.generate(prompts, max_new_tokens=8)
+        assert batched == solo
+        assert bf16_engine.unexpected_compiles == 0
+
+
+# -- quantized served-model dirs ---------------------------------------------
+class TestQuantizedDir:
+    def test_save_load_bit_identical_to_inline(self, tmp_path,
+                                               int8_engine):
+        path = sq.save_quantized_model(str(tmp_path / "m"), SPEC,
+                                       _params(), config=CFG)
+        eng = load_engine(path)
+        try:
+            assert eng.config.precision == "int8"
+            prompts = _prompts(4)
+            got = eng.generate(prompts, max_new_tokens=8)
+            want = int8_engine.generate(prompts, max_new_tokens=8)
+            # a dir saved from fp32 weights serves bit-for-bit like an
+            # engine that quantized the same weights inline
+            assert got == want
+            assert eng.unexpected_compiles == 0
+        finally:
+            eng.close()
+
+    def test_template_matches_quantized_tree(self):
+        tmpl = sq.quantized_template(SPEC)
+        qp = sq.quantize_params(_params(), SPEC)
+        assert set(tmpl) == set(qp)
+        for name in tmpl:
+            assert tmpl[name].shape == qp[name].shape, name
+            assert tmpl[name].dtype == qp[name].dtype, name
+
+    def test_quantize_params_idempotent_and_detectable(self):
+        p = _params()
+        assert not sq.is_quantized_params(p)
+        qp = sq.quantize_params(p, SPEC)
+        assert sq.is_quantized_params(qp)
+        again = sq.quantize_params(qp, SPEC)
+        assert set(again) == set(qp)      # second pass is a no-op
+
+
+# -- env plumbing ------------------------------------------------------------
+class TestEnvPrecision:
+    def test_env_override(self, monkeypatch):
+        monkeypatch.setenv("PT_SERVE_PRECISION", "int8")
+        assert ServeConfig.from_env().precision == "int8"
+
+    def test_explicit_override_beats_env(self, monkeypatch):
+        monkeypatch.setenv("PT_SERVE_PRECISION", "int8")
+        assert ServeConfig.from_env(
+            precision="bf16").precision == "bf16"
+
+    def test_bad_precision_rejected(self, monkeypatch):
+        # from_env passes the raw string through; the gate is
+        # normalized(), which every engine build runs before compiling
+        monkeypatch.setenv("PT_SERVE_PRECISION", "fp8")
+        cfg = ServeConfig.from_env()
+        assert cfg.precision == "fp8"
+        with pytest.raises(ValueError, match="precision"):
+            cfg.normalized(SPEC)
+
+
+# -- calibration + the quality contract --------------------------------------
+class TestQuality:
+    def test_calibrate_records_positive_scales(self):
+        cal = sq.calibrate(SPEC, _params(),
+                           sq.default_calibration_prompts(SPEC),
+                           page_size=CFG.page_size)
+        assert cal["samples"] > 0
+        assert cal["act_scales"]
+        for site, scale in cal["act_scales"].items():
+            assert np.isfinite(scale) and scale > 0, site
+
+    def test_logit_divergence_within_pinned_tolerance(self):
+        div = sq.logit_divergence(SPEC, _params(),
+                                  page_size=CFG.page_size)
+        assert 0.0 <= div < DIVERGENCE_TOL
+
+    def test_eager_quant_tooling_is_sanctioned_next_to_live_engine(
+            self, int8_engine):
+        # calibration/quality replays compile eagerly; run beside a LIVE
+        # armed engine they must ride the sanctioned build phase instead
+        # of booking request-path compiles on it
+        before = int8_engine.unexpected_compiles
+        sq.calibrate(SPEC, _params(),
+                     sq.default_calibration_prompts(SPEC, n=1),
+                     page_size=CFG.page_size)
+        sq.logit_divergence(SPEC, _params(), prompts=[[3, 5, 7]],
+                            page_size=CFG.page_size)
+        assert int8_engine.unexpected_compiles == before
+        assert int8_engine.healthz()["ok"]

@@ -362,6 +362,20 @@ FIXTURES = {
             return out
         """,
     ),
+    "TPU022": (
+        "paddle_tpu/serving/mod.py",
+        """
+        import jax.numpy as jnp
+        def pack(x):
+            return x.astype(jnp.int8)
+        """,
+        """
+        from paddle_tpu.ops.quant_kernels import quantize_kv
+        def pack(x):
+            q, scale = quantize_kv(x)
+            return q, scale
+        """,
+    ),
     "TPU014": (
         "paddle_tpu/distributed/mod.py",
         """
@@ -1217,6 +1231,68 @@ def test_tpu021_request_paths_have_no_unbounded_blocking_calls():
     violations, errors = run_paths(GATE_PATHS)
     assert errors == {}
     assert [v for v in violations if v.rule == "TPU021"] == []
+
+
+def test_tpu022_every_cast_spelling_fires():
+    # attribute dtype, string dtype, dtype= kwarg, and the view form
+    src = """
+    import numpy as np
+    import jax.numpy as jnp
+    def f(x):
+        a = x.astype(jnp.int8)
+        b = x.astype("int8")
+        c = x.astype(dtype=np.int8)
+        d = x.view(jnp.int8)
+        return a, b, c, d
+    """
+    vs = [v for v in lint_source(textwrap.dedent(src),
+                                 path="paddle_tpu/serving/x.py")
+          if v.rule == "TPU022"]
+    assert len(vs) == 4
+
+
+def test_tpu022_quant_layers_are_exempt():
+    src = """
+    import jax.numpy as jnp
+    def quantize(x):
+        return x.astype(jnp.int8)
+    """
+    for path in ("paddle_tpu/ops/quant_kernels.py",
+                 "paddle_tpu/quantization/functional.py",
+                 "tests/test_x.py", "bench.py"):
+        assert "TPU022" not in rules_fired(src, path=path), path
+    assert "TPU022" in rules_fired(src, path="paddle_tpu/serving/x.py")
+
+
+def test_tpu022_wide_dtypes_and_uint8_images_are_silent():
+    # non-quant dtypes cast freely; astype(uint8) is the image-pixel
+    # idiom (vision transforms) — only view(uint8) reinterprets bytes
+    src = """
+    import numpy as np
+    import jax.numpy as jnp
+    def f(x):
+        a = x.astype(jnp.bfloat16)
+        b = x.astype(jnp.int32)
+        c = (x * 255.0).astype(np.uint8)
+        return a, b, c
+    """
+    assert "TPU022" not in rules_fired(src, path="paddle_tpu/vision/x.py")
+    src2 = """
+    import numpy as np
+    def f(x):
+        return x.view(np.uint8)
+    """
+    assert "TPU022" in rules_fired(src2, path="paddle_tpu/serving/x.py")
+
+
+def test_tpu022_package_has_no_raw_quant_casts():
+    # satellite contract: zero baseline entries for TPU022, ever — all
+    # in-tree int8 casts live in ops/quant_kernels.py + quantization/
+    bl = load_baseline(default_baseline_path())
+    assert not [k for k in bl if "::TPU022::" in k]
+    violations, errors = run_paths(GATE_PATHS)
+    assert errors == {}
+    assert [v for v in violations if v.rule == "TPU022"] == []
 
 
 # -- suppressions ------------------------------------------------------------
