@@ -944,6 +944,14 @@ def main():
             # stays empty here; per-leg audit_{name} blocks carry the
             # findings booked inside the leg subprocesses
             result["audit"] = audit_rt.snapshot()
+            # …and the supervision block: restart counts, store
+            # promotions and replay badput from the most recent
+            # Supervisor in this process — the all-zero default when
+            # nothing was supervised, so it rides every record
+            # including the tpu_unreachable fast-fail
+            from paddle_tpu.distributed.supervisor import \
+                supervision_snapshot
+            result["supervision"] = supervision_snapshot()
         except Exception:
             pass
         print(json.dumps(result), flush=True)

@@ -600,6 +600,8 @@ def main():
     from paddle_tpu.observability.memory import get_memory_monitor
     res["memory"] = get_memory_monitor().snapshot()
     res["audit"] = audit_rt.snapshot()
+    from paddle_tpu.distributed.supervisor import supervision_snapshot
+    res["supervision"] = supervision_snapshot()
     try:
         from paddle_tpu.observability import cluster_snapshot
         res["telemetry_cluster"] = cluster_snapshot(

@@ -41,7 +41,7 @@ import socket
 import struct
 import threading
 
-__all__ = ["GENERATION_KEY", "StoreWAL", "replay_wal",
+__all__ = ["GENERATION_KEY", "StoreWAL", "replay_wal", "StoreFollower",
            "DurableTCPStoreServer", "obs_endpoint_key", "obs_world_key"]
 
 logger = logging.getLogger(__name__)
@@ -134,15 +134,42 @@ def replay_wal(path):
 
 
 class StoreWAL:
-    """Append-only mutation journal; one fsynced JSON line per op."""
+    """Append-only mutation journal; one fsynced JSON line per op.
 
-    def __init__(self, path, fsync=True):
+    ``truncate_torn=True`` (the server's append path after a replay)
+    first cuts the file back to its last complete line: a torn tail is
+    unacknowledged debris, and appending a fresh record directly after
+    it would glue the two into one unparseable line — turning ignorable
+    tail damage into mid-file corruption that ends the NEXT replay
+    early.
+    """
+
+    def __init__(self, path, fsync=True, truncate_torn=False):
         self.path = path
         self.fsync = fsync
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
+        if truncate_torn:
+            self._truncate_torn_tail(path)
         self._f = open(path, "ab")
         self._lock = threading.Lock()
+
+    @staticmethod
+    def _truncate_torn_tail(path):
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except FileNotFoundError:
+            return
+        if not raw or raw.endswith(b"\n"):
+            return
+        keep = raw.rfind(b"\n") + 1  # 0 when no complete line exists
+        logger.warning("store WAL %s: truncating %d torn tail bytes "
+                       "before appending", path, len(raw) - keep)
+        with open(path, "r+b") as f:
+            f.truncate(keep)
+            f.flush()
+            os.fsync(f.fileno())
 
     def _append(self, rec):
         data = json.dumps(rec, separators=(",", ":")).encode() + b"\n"
@@ -171,6 +198,105 @@ class StoreWAL:
                                self.path, e)
 
 
+class StoreFollower:
+    """Hot standby: incrementally tails a master's WAL into an
+    in-memory key-value map, ready to be promoted the moment the
+    master dies.
+
+    The follower never serves and never writes — it only reads the WAL
+    file the (possibly still-running) master appends to, applying each
+    COMPLETE newline-terminated record through the same
+    :func:`_apply_record` the replay path uses.  A partial line at EOF
+    is the master mid-``write(2)``: the bytes are buffered and applied
+    once the rest arrives, never half-applied.  A complete line that
+    fails to parse is mid-file corruption: the follower stops applying
+    (``self.broken`` names the damage) so promotion can never serve
+    state past a hole.
+
+    :meth:`promote` is the failover: one final catch-up poll, then a
+    serving :class:`DurableTCPStoreServer` seeded from the tailed map —
+    no full-file re-replay — appending to the SAME WAL with the
+    generation bumped, so ``ResilientStore`` clients re-resolve onto a
+    strictly higher generation and their fence holds.
+    """
+
+    def __init__(self, wal_path):
+        self.wal_path = wal_path
+        self.kv: dict[str, bytes] = {}
+        self.records_applied = 0
+        self.broken = None  # description of mid-file damage, or None
+        self._pos = 0       # file offset of the first unconsumed byte
+        self._buf = b""     # partial (torn-so-far) line at the tail
+
+    def poll(self):
+        """Consume every complete WAL line appended since the last
+        poll; returns the number of records applied by this call."""
+        if self.broken is not None:
+            return 0
+        try:
+            with open(self.wal_path, "rb") as f:
+                f.seek(self._pos)
+                chunk = f.read()
+        except FileNotFoundError:
+            return 0
+        if not chunk:
+            return 0
+        self._pos += len(chunk)
+        data = self._buf + chunk
+        lines = data.split(b"\n")
+        self._buf = lines.pop()  # b"" when data ends with a newline
+        applied = 0
+        for line in lines:
+            if not line:
+                continue
+            try:
+                _apply_record(self.kv, json.loads(line))
+            except (ValueError, KeyError, TypeError) as e:
+                self.broken = (f"corrupt WAL line after "
+                               f"{self.records_applied} records: {e}")
+                logger.warning("store follower %s: %s — no further "
+                               "records will be applied", self.wal_path,
+                               self.broken)
+                return applied
+            applied += 1
+            self.records_applied += 1
+        return applied
+
+    @property
+    def generation(self):
+        """Master generation as tailed so far (None before the first
+        generation record arrives)."""
+        raw = self.kv.get(GENERATION_KEY)
+        if raw is None:
+            return None
+        try:
+            return int(raw.decode("ascii"))
+        except (ValueError, UnicodeDecodeError):
+            return None
+
+    def promote(self, port=0, host="127.0.0.1", wal_fsync=True):
+        """Become the master: final catch-up, then a serving
+        :class:`DurableTCPStoreServer` seeded from the tailed map.
+
+        Any bytes still torn at promote time are an unacknowledged
+        write of the dead master and are dropped (the server's append
+        path truncates them from the file too).  Raises RuntimeError
+        when the tail hit mid-file corruption — serving state with a
+        hole would violate the clients' generation-fence contract.
+        """
+        self.poll()
+        if self.broken is not None:
+            raise RuntimeError(
+                f"store follower cannot promote: {self.broken}")
+        if self._buf:
+            logger.warning("store follower %s: dropping %d torn tail "
+                           "bytes at promote (master died mid-append)",
+                           self.wal_path, len(self._buf))
+        return DurableTCPStoreServer(
+            port=port, host=host, wal_path=self.wal_path,
+            wal_fsync=wal_fsync, seed_kv=dict(self.kv))
+
+
 class DurableTCPStoreServer:
     """Wire-compatible TCPStore master with optional WAL durability.
 
@@ -179,13 +305,20 @@ class DurableTCPStoreServer:
     bumps the generation, and journals every subsequent mutation before
     acknowledging it — so a respawn restores keys, ADD counters and
     barrier arrival state exactly, and advertises a strictly higher
-    generation than any client has seen.
+    generation than any client has seen.  ``seed_kv`` (a promoted
+    :class:`StoreFollower`'s tailed map) replaces the full-file replay:
+    the state was already built incrementally, so construction costs
+    one generation bump, not a re-read of the journal.
     """
 
     def __init__(self, port=0, host="127.0.0.1", wal_path=None,
-                 wal_fsync=True):
-        self._kv = replay_wal(wal_path) if wal_path else {}
-        self._wal = StoreWAL(wal_path, fsync=wal_fsync) if wal_path \
+                 wal_fsync=True, seed_kv=None):
+        if seed_kv is not None:
+            self._kv = dict(seed_kv)
+        else:
+            self._kv = replay_wal(wal_path) if wal_path else {}
+        self._wal = StoreWAL(wal_path, fsync=wal_fsync,
+                             truncate_torn=True) if wal_path \
             else None
         self._mu = threading.Lock()
         self._cv = threading.Condition(self._mu)

@@ -25,6 +25,8 @@ a checkpoint error must surface, not vanish with a daemon thread.
 """
 from __future__ import annotations
 
+import itertools
+import json
 import logging
 import os
 import re
@@ -156,7 +158,18 @@ class CheckpointManager:
         if err is not None:
             raise err
 
-    def save(self, step, state, block=False):
+    def _data_state_records(self, proc, data_state):
+        """Prepend the input-pipeline cursor (``DataLoader.state_dict``)
+        as a per-process JSON record: it rides the same atomic commit as
+        the params, so a restored step always carries the matching
+        mid-epoch data position — never a half-step drift between the
+        two."""
+        if data_state is None:
+            return ()
+        blob = json.dumps(data_state, sort_keys=True).encode("utf-8")
+        return ((f"data_state.{proc}.json", blob),)
+
+    def save(self, step, state, block=False, data_state=None):
         """Commit ``state`` as step ``step``.
 
         Sync mode writes + commits before returning.  Async mode copies
@@ -164,6 +177,10 @@ class CheckpointManager:
         of the background commit is raised by the NEXT save()/wait().
         ``block=True`` forces a synchronous commit even in async mode
         (preemption handlers must not race process exit).
+        ``data_state`` (a JSON-able dict, typically
+        ``DataLoader.state_dict()``) is committed atomically beside the
+        params and read back with :meth:`load_data_state` for mid-epoch
+        input-pipeline resume.
         """
         self._raise_pending()
         proc = (jax.process_index() if self.process_index is None
@@ -171,16 +188,19 @@ class CheckpointManager:
         world = (jax.process_count() if self.world_size is None
                  else self.world_size)
         path = self.step_dir(step)
+        extra = self._data_state_records(proc, data_state)
         tel = get_telemetry()
         if not self.async_save or block:
             self.wait()
             t0 = time.perf_counter()
             try:
-                _ckpt._save_records(_ckpt._shard_records(state, proc),
-                                    path, proc, world, store=self.store,
-                                    durable=self.durable,
-                                    run_id=self.run_id,
-                                    barrier_timeout=self.barrier_timeout)
+                _ckpt._save_records(
+                    itertools.chain(extra,
+                                    _ckpt._shard_records(state, proc)),
+                    path, proc, world, store=self.store,
+                    durable=self.durable,
+                    run_id=self.run_id,
+                    barrier_timeout=self.barrier_timeout)
             except BaseException:
                 tel.record_checkpoint_save(time.perf_counter() - t0,
                                            step=step, mode="sync",
@@ -192,7 +212,8 @@ class CheckpointManager:
             return
         # device->host copy on the caller: the training loop may donate
         # or overwrite these buffers the moment we return
-        records = list(_ckpt._shard_records(state, proc))
+        records = list(itertools.chain(
+            extra, _ckpt._shard_records(state, proc)))
         self.wait()  # one writer at a time; serializes step order
 
         def _write():
@@ -260,6 +281,26 @@ class CheckpointManager:
                     "falling back to an earlier step", step, d, e)
                 self._bad.add(step)
         return template, None
+
+    def load_data_state(self, step=None, process_index=None):
+        """Read back the ``data_state`` committed with ``save(...,
+        data_state=...)`` for ``step`` (default: the newest valid step).
+        Returns None when that step carries no data state (older
+        checkpoints stay loadable)."""
+        self.wait()
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                return None
+        proc = process_index if process_index is not None else (
+            jax.process_index() if self.process_index is None
+            else self.process_index)
+        path = os.path.join(self.step_dir(step), f"data_state.{proc}.json")
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
 
     # -- retention ----------------------------------------------------------
     def _gc(self):

@@ -61,6 +61,17 @@ cross-rank recompile-storm alarm, stale-marking of a SIGKILLed rank
 ``observability.merge`` CLI stitching per-rank telemetry JSONL into
 one time-ordered stream.
 
+Supervisor drills (:func:`.runner.run_supervisor_drill`) put the
+self-healing supervisor (:mod:`paddle_tpu.distributed.supervisor`) on
+trial: a SIGKILLed worker must cost exactly one budgeted fleet
+relaunch and still converge bit-for-bit; a SIGKILLed store MASTER must
+cost *nothing* — the supervisor's hot standby (a
+:class:`~paddle_tpu.core.store_server.StoreFollower` tailing the WAL)
+is promoted, the endpoint file atomically republished, and every
+worker rides through with zero exits at a bumped store generation; a
+deterministically crash-looping rank must exhaust its restart budget
+and fail the job naming the rank and its quarantined data shard.
+
 Serve chaos drills (:func:`.runner.run_serve_chaos_drill`) point the
 same real-subprocess discipline at the serving plane: a real engine
 (``python -m paddle_tpu.serving``) is SIGKILLed mid-decode (the
@@ -118,7 +129,7 @@ above one half.
 __all__ = ["KillSpec", "StoreKillSpec", "ObsSpec", "TraceSpec",
            "NumericsSpec", "OomSpec", "run_drill",
            "run_store_kill_drill", "run_scrape_drill",
-           "run_serve_chaos_drill",
+           "run_serve_chaos_drill", "run_supervisor_drill",
            "run_trace_drill", "run_numerics_drill", "run_oom_drill",
            "run_overlap_drill", "run_sharded_overlap_drill",
            "spawn_worker", "spawn_store_master", "spawn_aggregator",

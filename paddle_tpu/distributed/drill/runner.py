@@ -41,7 +41,7 @@ __all__ = ["KillSpec", "StoreKillSpec", "ObsSpec", "TraceSpec",
            "spawn_store_master", "spawn_aggregator",
            "spawn_serve_worker", "run_drill",
            "run_store_kill_drill", "run_scrape_drill",
-           "run_serve_chaos_drill",
+           "run_serve_chaos_drill", "run_supervisor_drill",
            "run_trace_drill", "run_numerics_drill", "run_oom_drill",
            "run_overlap_drill", "run_sharded_overlap_drill",
            "reap_all"]
@@ -202,7 +202,8 @@ def spawn_worker(rank, world, *, root, port=0, total_steps, run_id,
                  barrier_timeout, kill=None, elastic=True,
                  orphan_age=None, log_path=None, endpoint_file=None,
                  store_deadline=None, storekill=None, obs=None,
-                 trace=None, numerics=None, oom=None, flight_dir=None):
+                 trace=None, numerics=None, oom=None, flight_dir=None,
+                 fail=None, data_shard=None):
     """Launch one drill worker subprocess; returns its Popen (also
     registered for :func:`reap_all`).
 
@@ -217,7 +218,11 @@ def spawn_worker(rank, world, *, root, port=0, total_steps, run_id,
     :class:`NumericsSpec`) switches to the storeless NaN-injection
     mode; ``oom`` (an :class:`OomSpec`) switches to the storeless
     OOM-postmortem mode; ``flight_dir`` arms the flight recorder
-    (``PT_FLIGHT_RECORDER``).
+    (``PT_FLIGHT_RECORDER``); ``fail=(step, exit_code)`` scripts a
+    deterministic crash at the top of ``step`` (the supervisor drill's
+    crash-loop: a resumed worker reaches the same step and dies again);
+    ``data_shard`` names the worker's data shard (``PT_DATA_SHARD``)
+    for crash/shard correlation diagnostics.
     """
     env = {k: v for k, v in os.environ.items()
            if not k.startswith("DRILL_")}
@@ -288,6 +293,11 @@ def spawn_worker(rank, world, *, root, port=0, total_steps, run_id,
         env["DRILL_OOM_MEM_BYTES"] = str(oom.mem_bytes)
     if flight_dir is not None:
         env["PT_FLIGHT_RECORDER"] = flight_dir
+    if fail is not None:
+        env["DRILL_FAIL_STEP"] = str(fail[0])
+        env["DRILL_FAIL_EXIT"] = str(fail[1])
+    if data_shard is not None:
+        env["PT_DATA_SHARD"] = str(data_shard)
     cmd = [sys.executable, "-m", "paddle_tpu.distributed.drill.worker"]
     if log_path:
         with open(log_path, "ab") as out:
@@ -2248,4 +2258,189 @@ def run_serve_chaos_drill(root, *, max_new=8, storm_requests=6,
             p2.kill()
             p2.wait(timeout=30)
         _LIVE.discard(p2)
+    return report
+
+
+def run_supervisor_drill(root, *, scenario="worker-kill", world=2,
+                         total_steps=6, kill_step=3, crash_rank=1,
+                         max_restarts=3, restart_window=300.0,
+                         quarantine_threshold=2, barrier_timeout=6.0,
+                         store_deadline=20.0, gen_timeout=180.0,
+                         log_dir=None):
+    """Prove the self-healing supervisor end to end, on CPU, with real
+    subprocesses.  Three scenarios:
+
+    - ``worker-kill``: generation 0 carries a scripted mid-barrier
+      SIGKILL of rank ``crash_rank`` at step ``kill_step``; the
+      supervisor must relaunch the fleet at a fresh run id and the
+      final checkpoint at ``total_steps`` must verify bit-for-bit
+      against the replayed oracle — restart-then-resume loses nothing.
+    - ``store-kill``: the fleet runs clean while the runner SIGKILLs
+      the TCPStore MASTER mid-run; the supervisor's
+      :class:`~..supervisor.StandbyStoreGuard` must promote the
+      WAL-tailing standby and republish the endpoint, the workers must
+      ride through with ZERO exits (no restart budget spent), and the
+      promoted store must advertise generation >= 2.
+    - ``crash-loop``: rank ``crash_rank`` crashes deterministically at
+      ``kill_step`` every generation; the supervisor must exhaust the
+      restart budget and raise
+      :class:`~..supervisor.RestartBudgetExhausted` naming the rank
+      and — because every failure correlates with that rank's data
+      shard — the quarantined shard.
+
+    Returns a report dict (supervision snapshot, final rcs, newest
+    step, promotions/generation, exhaustion details).
+    """
+    from ..supervisor import (RestartBudgetExhausted, StandbyStoreGuard,
+                              Supervisor)
+
+    if scenario not in ("worker-kill", "store-kill", "crash-loop"):
+        raise ValueError(f"unknown supervisor drill scenario {scenario!r}")
+    ckpt_root = os.path.join(root, "ckpt")
+    store_root = os.path.join(root, "store")
+    os.makedirs(ckpt_root, exist_ok=True)
+    os.makedirs(store_root, exist_ok=True)
+
+    def _log(name):
+        return os.path.join(log_dir, name) if log_dir else None
+
+    guard = StandbyStoreGuard(store_root, log_dir=log_dir,
+                              track=_LIVE.add)
+    guard.start()
+    final_rcs = {}
+
+    def spawn(rank, w, run_id, generation):
+        kill = None
+        fail = None
+        if scenario == "worker-kill" and generation == 0:
+            kill = KillSpec("mid-barrier", kill_step, rank=crash_rank)
+        if scenario == "crash-loop" and rank == crash_rank:
+            fail = (kill_step, 1)
+        return spawn_worker(
+            rank, w, root=ckpt_root, total_steps=total_steps,
+            run_id=run_id, barrier_timeout=barrier_timeout,
+            endpoint_file=guard.endpoint_file,
+            store_deadline=store_deadline, kill=kill, fail=fail,
+            data_shard=f"shard-{rank}",
+            log_path=_log(f"sup_{scenario}_g{generation}_rank{rank}.log"))
+
+    sup = Supervisor(
+        spawn, world, max_restarts=max_restarts,
+        restart_window=restart_window,
+        shard_of=lambda r: f"shard-{r}",
+        quarantine_threshold=quarantine_threshold,
+        grace=3.0 * barrier_timeout, store_guard=guard,
+        generation_timeout=gen_timeout,
+        run_id_prefix=f"supdrill-{uuid.uuid4().hex[:6]}")
+
+    report = {"scenario": scenario}
+    killer = None
+    try:
+        if scenario == "store-kill":
+            # SIGKILL the master once the fleet is provably mid-run
+            # (at least one step committed); the supervisor's watch
+            # loop must promote while workers keep training
+            import threading as _threading
+
+            def _assassinate():
+                try:
+                    wait_until(
+                        lambda: (_latest_step(ckpt_root) or 0) >= 1,
+                        gen_timeout / 2,
+                        desc="first committed step before master kill")
+                    logger.info("supervisor drill: SIGKILLing store "
+                                "master pid %d", guard.master.pid)
+                    guard.kill_master()
+                except BaseException:
+                    logger.exception("store assassin failed")
+
+            killer = _threading.Thread(target=_assassinate, daemon=True)
+            killer.start()
+
+        try:
+            snap = sup.run()
+            report["supervision"] = snap
+            final_rcs = snap.get("final_rcs") or {}
+        except RestartBudgetExhausted as e:
+            report["supervision"] = sup.snapshot()
+            report["exhausted"] = {"message": str(e), "rank": e.rank,
+                                   "shard": e.shard, "cause": e.cause}
+            if scenario != "crash-loop":
+                raise DrillFailure(
+                    f"{scenario}: restart budget unexpectedly "
+                    f"exhausted: {e}") from e
+
+        if killer is not None:
+            killer.join(timeout=gen_timeout)
+
+        latest = _latest_step(ckpt_root)
+        report["latest"] = latest
+        snap = report["supervision"]
+
+        if scenario == "worker-kill":
+            if any(rc != 0 for rc in final_rcs.values()):
+                raise DrillFailure(
+                    f"worker-kill: final generation rcs {final_rcs}, "
+                    f"expected all 0")
+            if snap["restarts_total"] < 1 or \
+                    snap["restarts_by_cause"].get("killed", 0) < 1:
+                raise DrillFailure(
+                    f"worker-kill: supervisor booked no 'killed' "
+                    f"restart: {snap['restarts_by_cause']}")
+            if latest != total_steps:
+                raise DrillFailure(
+                    f"worker-kill: newest committed step {latest}, "
+                    f"wanted {total_steps}")
+            _verify_bit_for_bit(ckpt_root, latest)
+        elif scenario == "store-kill":
+            if any(rc != 0 for rc in final_rcs.values()):
+                raise DrillFailure(
+                    f"store-kill: worker exits {final_rcs}, expected "
+                    f"all 0 — workers must ride through a promotion")
+            if snap["restarts_total"] != 0:
+                raise DrillFailure(
+                    f"store-kill: {snap['restarts_total']} restarts "
+                    f"booked; promotion must not cost worker restarts")
+            if snap["promotions"] < 1:
+                raise DrillFailure("store-kill: no promotion happened")
+            probe = ResilientStore(endpoint_file=guard.endpoint_file,
+                                   deadline=store_deadline)
+            try:
+                probe.get("store/generation", wait=False)
+                gen = probe.generation
+            finally:
+                probe.close()
+            report["generation"] = gen
+            if gen is None or gen < 2:
+                raise DrillFailure(
+                    f"store-kill: promoted master advertises "
+                    f"generation {gen}, expected >= 2")
+            if latest != total_steps:
+                raise DrillFailure(
+                    f"store-kill: newest committed step {latest}, "
+                    f"wanted {total_steps}")
+            _verify_bit_for_bit(ckpt_root, latest)
+        else:  # crash-loop
+            ex = report.get("exhausted")
+            if ex is None:
+                raise DrillFailure(
+                    "crash-loop: supervisor did not exhaust the "
+                    "restart budget")
+            if ex["rank"] != crash_rank:
+                raise DrillFailure(
+                    f"crash-loop: exhaustion names rank {ex['rank']}, "
+                    f"expected {crash_rank}")
+            if ex["shard"] != f"shard-{crash_rank}":
+                raise DrillFailure(
+                    f"crash-loop: exhaustion names shard "
+                    f"{ex['shard']!r}, expected "
+                    f"'shard-{crash_rank}' (data-correlated loop)")
+            if f"rank {crash_rank}" not in ex["message"] or \
+                    f"shard-{crash_rank}" not in ex["message"]:
+                raise DrillFailure(
+                    f"crash-loop: diagnostic does not name the rank "
+                    f"and shard: {ex['message']!r}")
+    finally:
+        guard.stop()
+        reap_all()
     return report

@@ -43,6 +43,40 @@ def _write_endpoint(path, host, port):
     os.replace(tmp, path)
 
 
+def _hold_forever():
+    # block until killed — the drill's weapon is SIGKILL, so there is
+    # deliberately no graceful-shutdown path to hide behind (bounded
+    # waits in a loop, never one unbounded park)
+    hold = threading.Event()
+    while not hold.wait(60.0):
+        pass
+
+
+def _standby_main(args):
+    """Hot-standby mode: tail the master's WAL with a StoreFollower,
+    promote the moment ``--promote-file`` appears, atomically republish
+    the endpoint file, then serve until killed.
+
+    The promote trigger is a file (touched by the supervisor) rather
+    than a signal so the drill can assert the exact promote moment and
+    the standby stays testable on any POSIX host.
+    """
+    follower = store_server.StoreFollower(args.wal)
+    logger.info("standby tailing %s (promote trigger: %s)",
+                args.wal, args.promote_file)
+    wait = threading.Event()
+    while not os.path.exists(args.promote_file):
+        follower.poll()
+        wait.wait(args.poll_interval)
+    server = follower.promote(port=args.port, host=args.host)
+    _write_endpoint(args.endpoint_file, server.host, server.port)
+    logger.info(
+        "promoted: serving on %s:%d (generation=%s, %d records tailed)",
+        server.host, server.port, server.generation,
+        follower.records_applied)
+    _hold_forever()
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--port", type=int, default=0)
@@ -51,23 +85,32 @@ def main(argv=None):
     ap.add_argument("--wal", default=None,
                     help="WAL path; omit for a volatile (amnesiac) "
                          "master")
+    ap.add_argument("--standby", action="store_true",
+                    help="hot-standby mode: tail --wal without serving, "
+                         "promote (and republish --endpoint-file) when "
+                         "--promote-file appears")
+    ap.add_argument("--promote-file", default=None,
+                    help="standby mode's promote trigger file")
+    ap.add_argument("--poll-interval", type=float, default=0.05,
+                    help="standby WAL/trigger poll cadence (seconds)")
     args = ap.parse_args(argv)
 
     logging.basicConfig(
         level=logging.INFO, stream=sys.stderr,
         format="[store-master] %(levelname)s %(message)s")
 
+    if args.standby:
+        if not args.wal or not args.promote_file:
+            ap.error("--standby requires --wal and --promote-file")
+        _standby_main(args)
+        return
+
     server = store_server.DurableTCPStoreServer(
         port=args.port, host=args.host, wal_path=args.wal)
     _write_endpoint(args.endpoint_file, server.host, server.port)
     logger.info("serving on %s:%d (generation=%s, wal=%s)",
                 server.host, server.port, server.generation, args.wal)
-    # block until killed — the drill's weapon is SIGKILL, so there is
-    # deliberately no graceful-shutdown path to hide behind (bounded
-    # waits in a loop, never one unbounded park)
-    hold = threading.Event()
-    while not hold.wait(60.0):
-        pass
+    _hold_forever()
 
 
 if __name__ == "__main__":

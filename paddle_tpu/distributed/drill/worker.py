@@ -105,11 +105,10 @@ import time
 
 import numpy as np
 
+from ..exit_codes import (EXIT_NUMERICS_HALT, EXIT_OOM,  # noqa: F401
+                          EXIT_SAVE_FAILED, EXIT_STORE_LOST)
+
 ROWS, COLS = 12, 4
-EXIT_SAVE_FAILED = 17
-EXIT_STORE_LOST = 19
-EXIT_NUMERICS_HALT = 21
-EXIT_OOM = 23
 
 logger = logging.getLogger("paddle_tpu.drill.worker")
 
@@ -638,6 +637,16 @@ def main():
         barrier_timeout=barrier_timeout, elastic=elastic,
         orphan_age=float(orphan_age) if orphan_age else None)
 
+    # scripted crash loop (supervisor drills): die with DRILL_FAIL_EXIT
+    # the moment step DRILL_FAIL_STEP would run — every relaunch resumes
+    # below the fail step and dies again, the deterministic crash loop a
+    # restart budget must cut short.  PT_DATA_SHARD names the data shard
+    # this rank was assigned, so the supervisor can correlate the loop
+    # with one poisoned shard.
+    fail_step = int(env.get("DRILL_FAIL_STEP", "-1"))
+    fail_exit = int(env.get("DRILL_FAIL_EXIT", "1"))
+    data_shard = env.get("PT_DATA_SHARD")
+
     lo, hi = window(rank, world)
     start = mgr.latest_step()
     if start is None:
@@ -655,6 +664,10 @@ def main():
         logger.info("resumed from committed step %d", start)
 
     for step in range(start + 1, total + 1):
+        if step == fail_step:
+            logger.error("scripted crash at step %d (data shard %s)",
+                         step, data_shard)
+            sys.exit(fail_exit)
         t0 = time.perf_counter_ns()
         w = w * np.float32(1.01) + np.float32(0.125)
         bias = bias * np.float32(0.99) - np.float32(0.0625)

@@ -1,0 +1,111 @@
+"""Canonical process exit-code taxonomy for the self-healing job runtime.
+
+A supervisor restarting workers can only act on what an exit status
+tells it, so the codes are the contract between every process this
+framework spawns (serving engines, preempted trainers, drill workers)
+and the thing that relaunches them.  They were historically scattered
+as magic numbers across ``serving/http.py`` (143), ``serving/
+scheduler.py`` (70), ``fleet/elastic/preemption.py`` (75/143) and the
+drill workers (17/19/21/23); this module is the one place they are
+defined, and :func:`classify` is the supervisor's decision table.
+
+Stdlib-only on purpose: the drill's path-loaded store master and the
+supervisor must be importable without jax.
+
+ ==================  =====  ==============================================
+ name                code   meaning
+ ==================  =====  ==============================================
+ EXIT_OK                0   ran to completion
+ EXIT_SAVE_FAILED      17   a checkpoint save failed cleanly (commit
+                            barrier timed out after a peer died); the
+                            survivor exited awaiting relaunch
+ EXIT_STORE_LOST       19   the coordination store stayed unreachable
+                            past the client deadline, or a respawned
+                            master was generation-fenced as amnesiac
+ EXIT_NUMERICS_HALT    21   the numerics sentinel halted the run
+                            (PT_NUMERICS_HALT)
+ EXIT_OOM              23   allocator exhaustion surfaced and the memory
+                            postmortem was booked
+ EXIT_WATCHDOG         70   the serve hang watchdog force-exited a wedged
+                            process (BSD EX_SOFTWARE)
+ EXIT_TEMPFAIL         75   a preemption save FAILED; the relaunch falls
+                            back to an older checkpoint (BSD EX_TEMPFAIL)
+ EXIT_DRAIN           143   128+SIGTERM: asked to stop, stopped cleanly
+                            (graceful drain / preemption save succeeded)
+ ==================  =====  ==============================================
+
+A negative status from ``Popen.poll()`` is death by signal
+(``-9`` = SIGKILL): the process had no chance to report anything.
+"""
+from __future__ import annotations
+
+__all__ = [
+    "EXIT_OK", "EXIT_SAVE_FAILED", "EXIT_STORE_LOST",
+    "EXIT_NUMERICS_HALT", "EXIT_OOM", "EXIT_WATCHDOG", "EXIT_TEMPFAIL",
+    "EXIT_DRAIN", "classify", "describe", "RESTARTABLE_CAUSES",
+]
+
+EXIT_OK = 0
+EXIT_SAVE_FAILED = 17
+EXIT_STORE_LOST = 19
+EXIT_NUMERICS_HALT = 21
+EXIT_OOM = 23
+EXIT_WATCHDOG = 70
+EXIT_TEMPFAIL = 75
+EXIT_DRAIN = 143
+
+_CAUSES = {
+    EXIT_OK: "ok",
+    EXIT_SAVE_FAILED: "save_failed",
+    EXIT_STORE_LOST: "store_lost",
+    EXIT_NUMERICS_HALT: "numerics_halt",
+    EXIT_OOM: "oom",
+    EXIT_WATCHDOG: "watchdog",
+    EXIT_TEMPFAIL: "tempfail",
+    EXIT_DRAIN: "drain",
+}
+
+_DESCRIPTIONS = {
+    "ok": "ran to completion",
+    "save_failed": "checkpoint save failed cleanly (peer died at the "
+                   "commit barrier); relaunch resumes from the newest "
+                   "committed step",
+    "store_lost": "coordination store unreachable past the client "
+                  "deadline or generation-fenced as amnesiac",
+    "numerics_halt": "numerics sentinel halted the run",
+    "oom": "allocator exhaustion (memory postmortem booked)",
+    "watchdog": "hang watchdog force-exited a wedged process",
+    "tempfail": "preemption save failed (EX_TEMPFAIL); relaunch falls "
+                "back to an older checkpoint",
+    "drain": "asked to stop via SIGTERM, stopped cleanly",
+    "killed": "killed by signal (no chance to report)",
+    "crash": "unclassified non-zero exit",
+}
+
+#: causes a supervisor should relaunch (vs. fail the job on): every
+#: taxonomy member is a *clean* degradation whose designed recovery is a
+#: relaunch — including a raw signal kill, which is exactly what a
+#: preemption without notice looks like.
+RESTARTABLE_CAUSES = frozenset({
+    "save_failed", "store_lost", "watchdog", "tempfail", "drain",
+    "killed", "oom",
+})
+
+
+def classify(returncode):
+    """Map a ``Popen`` return code to its restart-ledger cause label."""
+    if returncode is None:
+        return "running"
+    rc = int(returncode)
+    if rc < 0:
+        return "killed"
+    return _CAUSES.get(rc, "crash")
+
+
+def describe(returncode):
+    """Human-readable one-liner for a return code (diagnostics/logs)."""
+    cause = classify(returncode)
+    base = _DESCRIPTIONS.get(cause, cause)
+    if cause == "killed":
+        return f"{base} (signal {-int(returncode)})"
+    return f"{base} (exit {returncode})" if cause == "crash" else base

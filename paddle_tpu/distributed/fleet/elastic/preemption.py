@@ -23,20 +23,23 @@ import signal
 import sys
 import threading
 
+from ...exit_codes import EXIT_DRAIN, EXIT_TEMPFAIL
+
 __all__ = ["on_preemption", "clear_preemption_handler",
            "SAVE_FAILED_EXIT_CODE"]
 
 logger = logging.getLogger(__name__)
 
 #: default exit code when save_fn raises (EX_TEMPFAIL: retry-able — the
-#: relaunched job falls back to the previous committed checkpoint)
-SAVE_FAILED_EXIT_CODE = 75
+#: relaunched job falls back to the previous committed checkpoint);
+#: canonical taxonomy: distributed/exit_codes.py
+SAVE_FAILED_EXIT_CODE = EXIT_TEMPFAIL
 
 _state = threading.local()
 _installed: dict[int, object] = {}
 
 
-def on_preemption(save_fn, signals=(signal.SIGTERM,), exit_code=143,
+def on_preemption(save_fn, signals=(signal.SIGTERM,), exit_code=EXIT_DRAIN,
                   exit=True, error_exit_code=SAVE_FAILED_EXIT_CODE):
     """Install ``save_fn()`` as the preemption handler.
 

@@ -56,6 +56,22 @@ def parse_args(argv=None):
                    help="virtual device count per proc for CPU simulation")
     p.add_argument("--run_mode", default="collective")
     p.add_argument("--max_restart", type=int, default=0)
+    p.add_argument("--elastic", action="store_true",
+                   help="supervise workers with the self-healing "
+                        "supervisor: per-rank restart budgets "
+                        "(PT_SUPERVISOR_MAX_RESTARTS over "
+                        "PT_SUPERVISOR_RESTART_WINDOW), backoff "
+                        "relaunch at a fresh run id per generation, "
+                        "elastic downsize when a rank is dead past "
+                        "its lease")
+    p.add_argument("--with_store", action="store_true",
+                   help="(elastic) run a WAL-durable TCPStore master "
+                        "plus a hot standby that is auto-promoted if "
+                        "the master dies; workers get "
+                        "PT_STORE_ENDPOINT_FILE")
+    p.add_argument("--min_world", type=int, default=1,
+                   help="(elastic) smallest world size a lease-expiry "
+                        "downsize may reach")
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -135,9 +151,79 @@ def _run_once(args, nnodes):
     return fail
 
 
+def _run_supervised(args, nnodes):
+    """``--elastic``: run the fleet under the self-healing supervisor
+    (restart budgets, fresh run id per generation, standby-store
+    promotion with ``--with_store``, lease-based downsize)."""
+    from ..supervisor import (RestartBudgetExhausted, SpawnFailed,
+                              StandbyStoreGuard, Supervisor)
+
+    os.makedirs(args.log_dir, exist_ok=True)
+    cmd = [sys.executable, "-u", args.training_script,
+           *args.training_script_args]
+    live = []
+
+    def spawn(rank, world, run_id, generation):
+        env = _build_env(args, rank % args.nproc_per_node, nnodes)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PT_RUN_ID": run_id,
+            "PT_RESTART_GENERATION": str(generation),
+            "PADDLE_ELASTIC": "1",
+        })
+        if guard is not None:
+            env["PT_STORE_ENDPOINT_FILE"] = guard.endpoint_file
+        log_path = os.path.join(args.log_dir,
+                                f"workerlog.{rank}.g{generation}")
+        try:
+            logf = open(log_path, "w")
+            proc = subprocess.Popen(cmd, env=env, stdout=logf,
+                                    stderr=subprocess.STDOUT)
+        except OSError as e:
+            raise SpawnFailed(f"rank {rank}: {e}") from e
+        logf.close()  # child holds its own fd
+        live.append(proc)
+        return proc
+
+    guard = None
+    if args.with_store:
+        guard = StandbyStoreGuard(args.log_dir, log_dir=args.log_dir)
+        guard.start()
+
+    def _kill_all(*_):
+        for pr in live:
+            if pr.poll() is None:
+                pr.terminate()
+
+    sup = Supervisor(
+        spawn, nnodes * args.nproc_per_node,
+        max_restarts=args.max_restart if args.max_restart > 0 else None,
+        min_world=args.min_world, store_guard=guard,
+        run_id_prefix=args.job_id)
+    old = signal.signal(signal.SIGTERM, _kill_all)
+    try:
+        report = sup.run()
+    except RestartBudgetExhausted as e:
+        where = "store master" if e.rank is None else f"rank {e.rank}"
+        print(f"launch: giving up ({where}"
+              + (f", quarantined shard {e.shard!r}" if e.shard else "")
+              + f"): {e}", file=sys.stderr)
+        return 1
+    finally:
+        signal.signal(signal.SIGTERM, old)
+        _kill_all()
+        if guard is not None:
+            guard.stop()
+    print(f"launch: done — supervision: {report}", file=sys.stderr)
+    return 0
+
+
 def main(argv=None):
     args = parse_args(argv)
     nnodes = int(str(args.nnodes).split(":")[0])
+    if args.elastic:
+        return _run_supervised(args, nnodes)
     restarts = 0
     while True:
         code = _run_once(args, nnodes)

@@ -206,12 +206,14 @@ def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id,
             data_queue.put((batch_id, None, traceback.format_exc()))
 
 
-def _get_checked(data_queue, workers, timeout):
+def _get_checked(data_queue, workers, timeout, last_sent=None):
     """Blocking queue get that notices dead workers instead of hanging
     forever (the reference's ``_DataLoaderIterMultiProcess`` does the same
     via ``_check_worker_status``: a crashed/killed worker raises
     'DataLoader worker exited unexpectedly' rather than deadlocking the
-    training loop)."""
+    training loop).  ``last_sent`` maps worker id -> last batch index
+    dispatched to it, so the error names exactly which batch died with
+    the worker (a poisoned sample is findable from the message alone)."""
     import time as _time
     deadline = (_time.monotonic() + timeout) if timeout else None
     while True:
@@ -221,11 +223,16 @@ def _get_checked(data_queue, workers, timeout):
         try:
             return data_queue.get(timeout=tick)
         except queue.Empty:
-            dead = [w for w in workers if not w.is_alive()]
+            dead = [(wid, w) for wid, w in enumerate(workers)
+                    if not w.is_alive()]
             if dead:
+                detail = "; ".join(
+                    f"worker {wid} (pid {w.pid}) exitcode {w.exitcode}, "
+                    f"last dispatched batch index "
+                    f"{(last_sent or {}).get(wid, 'none')}"
+                    for wid, w in dead)
                 raise RuntimeError(
-                    f"DataLoader worker(s) exited unexpectedly (exitcodes "
-                    f"{[w.exitcode for w in dead]})")
+                    f"DataLoader worker(s) exited unexpectedly: {detail}")
             if deadline is not None and _time.monotonic() >= deadline:
                 raise RuntimeError(
                     f"DataLoader timed out after {timeout}s waiting for a "
@@ -283,6 +290,10 @@ class DataLoader:
                 self.batch_sampler = BatchSampler(
                     dataset, shuffle=shuffle, batch_size=batch_size,
                     drop_last=drop_last)
+        # batches actually handed to the consumer this epoch — the
+        # mid-epoch resume cursor. Sampler-side counters run ahead of
+        # this by the prefetch depth, so state_dict() trusts only it.
+        self._delivered = 0
 
     def __len__(self):
         if self._iterable_mode:
@@ -301,6 +312,7 @@ class DataLoader:
             it = self._iter_single()
         else:
             it = self._iter_multiprocess()
+        it = self._counted(it)
         from ..observability import get_telemetry
         from ..observability.trace import get_tracer
         tel = get_telemetry()
@@ -308,6 +320,42 @@ class DataLoader:
         if not (tel.enabled or tr.enabled):
             return it
         return _timed_iter(it, tel, tr)
+
+    def _counted(self, it):
+        # a resumed epoch starts its delivered count at the sampler's
+        # skip cursor (absolute position within the epoch); a fresh
+        # epoch starts at 0
+        self._delivered = getattr(self.batch_sampler, "_resume_skip", 0)
+        for batch in it:
+            self._delivered += 1
+            yield batch
+
+    def state_dict(self):
+        """Mid-epoch input-pipeline position, persistable beside the
+        model checkpoint (``CheckpointManager.save(...,
+        data_state=...)``).  ``cursor`` is the *delivered* batch count
+        — prefetch means the sampler itself has already run ahead."""
+        sd = {"delivered": self._delivered}
+        bs = self.batch_sampler
+        if bs is not None and hasattr(bs, "state_dict"):
+            s = dict(bs.state_dict())
+            s["cursor"] = self._delivered
+            sd["sampler"] = s
+        return sd
+
+    def load_state_dict(self, state):
+        """Restore a :meth:`state_dict`: the next ``__iter__`` resumes
+        mid-epoch, skipping already-delivered batches at the *index*
+        level (no dataset element is fetched for a skipped batch), so
+        the resumed loss trajectory is bit-identical to an
+        uninterrupted run — no replayed and no skipped batches."""
+        bs = self.batch_sampler
+        samp = state.get("sampler")
+        if bs is not None and samp is not None \
+                and hasattr(bs, "load_state_dict"):
+            bs.load_state_dict(samp)
+        self._delivered = getattr(bs, "_resume_skip", 0) if bs is not None \
+            else 0
 
     # -- single process with thread prefetch --------------------------------
     def _iter_single(self):
@@ -378,6 +426,7 @@ class DataLoader:
             w.start()
             workers.append(w)
         reorder: dict = {}
+        last_sent: dict = {}  # worker id -> last batch index dispatched
         try:
             batches = list(self.batch_sampler)
             n = len(batches)
@@ -387,6 +436,7 @@ class DataLoader:
                 for wid in range(self.num_workers):
                     if next_send < n:
                         index_queues[wid].put((next_send, batches[next_send]))
+                        last_sent[wid] = next_send
                         next_send += 1
             next_yield = 0
             while next_yield < n:
@@ -396,14 +446,15 @@ class DataLoader:
                     yield _to_tensor_tree(data)
                     continue
                 batch_id, data, err = _get_checked(data_queue, workers,
-                                                   self.timeout)
+                                                   self.timeout, last_sent)
                 if err is not None:
                     raise RuntimeError(f"DataLoader worker failed:\n{err}")
                 if _is_shm_payload(data):
                     data = _shm_unpack(data)
                 if next_send < n:
-                    index_queues[batch_id % self.num_workers].put(
-                        (next_send, batches[next_send]))
+                    wid = batch_id % self.num_workers
+                    index_queues[wid].put((next_send, batches[next_send]))
+                    last_sent[wid] = next_send
                     next_send += 1
                 reorder[batch_id] = data
         finally:

@@ -36,11 +36,23 @@ class RandomSampler(Sampler):
     def num_samples(self):
         return self._num_samples or len(self.data_source)
 
+    def _rng(self):
+        # honor an injected generator (np.random.RandomState /
+        # np.random.Generator) so a resumed run can replay the exact
+        # sample order; fall back to the global stream like the
+        # reference
+        return self.generator if self.generator is not None else np.random
+
     def __iter__(self):
         n = len(self.data_source)
+        rng = self._rng()
         if self.replacement:
-            return iter(np.random.randint(0, n, self.num_samples).tolist())
-        perm = np.random.permutation(n)[:self.num_samples]
+            if hasattr(rng, "randint"):  # RandomState / np.random
+                idx = rng.randint(0, n, self.num_samples)
+            else:  # np.random.Generator
+                idx = rng.integers(0, n, self.num_samples)
+            return iter(idx.tolist())
+        perm = rng.permutation(n)[:self.num_samples]
         return iter(perm.tolist())
 
     def __len__(self):
@@ -89,22 +101,46 @@ class BatchSampler(Sampler):
         self.batch_size = batch_size
         self.drop_last = drop_last
         self.shuffle = shuffle
+        self._cursor = 0       # index batches handed out this epoch
+        self._resume_skip = 0  # batches to drop at the next __iter__
 
     def __iter__(self):
+        skip = self._resume_skip
+        self._resume_skip = 0
+        self._cursor = skip
         batch = []
+        produced = 0
         for idx in self.sampler:
             batch.append(idx)
             if len(batch) == self.batch_size:
-                yield batch
+                produced += 1
+                if produced > skip:  # index-level skip: no data fetched
+                    self._cursor += 1
+                    yield batch
                 batch = []
         if batch and not self.drop_last:
-            yield batch
+            produced += 1
+            if produced > skip:
+                self._cursor += 1
+                yield batch
 
     def __len__(self):
         n = len(self.sampler)
         if self.drop_last:
             return n // self.batch_size
         return (n + self.batch_size - 1) // self.batch_size
+
+    def state_dict(self):
+        """Mid-epoch position; the DataLoader overwrites ``cursor``
+        with its *delivered* count (prefetch makes this one run
+        ahead)."""
+        return {"cursor": self._cursor}
+
+    def load_state_dict(self, state):
+        cursor = int(state.get("cursor", 0))
+        if cursor >= len(self):  # checkpoint fell on the epoch boundary
+            cursor = 0
+        self._resume_skip = cursor
 
 
 class DistributedBatchSampler(BatchSampler):
@@ -126,12 +162,19 @@ class DistributedBatchSampler(BatchSampler):
         self.shuffle = shuffle
         self.drop_last = drop_last
         self.epoch = 0
+        self._iter_epoch = 0   # epoch of the in-flight permutation
+        self._cursor = 0       # index batches handed out this epoch
+        self._resume_skip = 0  # batches to drop at the next __iter__
         import math
         self.num_samples = int(math.ceil(len(dataset) / self.nranks))
         self.total_size = self.num_samples * self.nranks
 
     def __iter__(self):
         n = len(self.dataset)
+        # the permutation is a pure function of the epoch number, so
+        # (epoch, cursor) fully determines mid-epoch state — that is
+        # what makes state_dict()/load_state_dict() resume bit-exact
+        self._iter_epoch = self.epoch
         if self.shuffle:
             rng = np.random.RandomState(self.epoch)
             indices = rng.permutation(n).tolist()
@@ -141,13 +184,21 @@ class DistributedBatchSampler(BatchSampler):
         # pad to make evenly divisible
         indices += indices[:(self.total_size - len(indices))]
         indices = indices[self.local_rank:self.total_size:self.nranks]
+        skip = self._resume_skip
+        self._resume_skip = 0
+        self._cursor = skip
+        # index-level resume: drop whole batches of *indices* — no
+        # dataset element is fetched for a skipped batch
+        indices = indices[skip * self.batch_size:]
         batch = []
         for idx in indices:
             batch.append(idx)
             if len(batch) == self.batch_size:
+                self._cursor += 1
                 yield batch
                 batch = []
         if batch and not self.drop_last:
+            self._cursor += 1
             yield batch
 
     def __len__(self):
@@ -157,3 +208,20 @@ class DistributedBatchSampler(BatchSampler):
 
     def set_epoch(self, epoch):
         self.epoch = epoch
+
+    def state_dict(self):
+        """Mid-epoch resume state: the epoch whose permutation is in
+        flight plus the batch cursor (the DataLoader overwrites
+        ``cursor`` with its delivered count — sampler-side counting
+        runs ahead of the consumer by the prefetch depth)."""
+        return {"epoch": self._iter_epoch, "cursor": self._cursor}
+
+    def load_state_dict(self, state):
+        epoch = int(state.get("epoch", 0))
+        cursor = int(state.get("cursor", 0))
+        if cursor >= len(self):  # checkpoint fell on the epoch boundary
+            epoch += 1
+            cursor = 0
+        self.epoch = epoch
+        self._iter_epoch = epoch
+        self._resume_skip = cursor

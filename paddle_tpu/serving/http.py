@@ -52,8 +52,9 @@ _CTYPE_JSON = "application/json"
 _CTYPE_METRICS = "text/plain; version=0.0.4; charset=utf-8"
 
 # 128 + SIGTERM: the exit status a supervisor reads as "asked to stop,
-# stopped cleanly" after a graceful drain
-DRAIN_EXIT_CODE = 143
+# stopped cleanly" after a graceful drain (canonical taxonomy:
+# distributed/exit_codes.py)
+from ..distributed.exit_codes import EXIT_DRAIN as DRAIN_EXIT_CODE  # noqa: E402
 
 
 def _client_gone(sock) -> bool:

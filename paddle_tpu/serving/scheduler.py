@@ -67,8 +67,9 @@ __all__ = ["ContinuousScheduler", "GenerationStream", "EngineSaturated",
 
 # fast-exit status when PT_SERVE_WATCHDOG=exit trips: distinct from the
 # drain exit (143) so a supervisor can tell "hung device" from "asked
-# to stop" in the restart ledger
-WATCHDOG_EXIT_CODE = 70
+# to stop" in the restart ledger (canonical taxonomy:
+# distributed/exit_codes.py)
+from ..distributed.exit_codes import EXIT_WATCHDOG as WATCHDOG_EXIT_CODE  # noqa: E402
 
 
 class EngineSaturated(RuntimeError):

@@ -1335,3 +1335,44 @@ class UnboundedBlockingCall(Rule):
                    f"serving/distributed path forever if the other side "
                    f"is wedged; pass a timeout (looping if needed) so a "
                    f"hang surfaces as an actionable error")
+
+
+@register
+class SignalHandlerInLibrary(Rule):
+    id = "TPU023"
+    name = "signal-handler-in-library"
+    rationale = ("signal.signal() registers a PROCESS-global handler — "
+                 "there is exactly one disposition per signal, so a "
+                 "library module installing one silently evicts the "
+                 "owner's (the preemption checkpoint hook, the serving "
+                 "drain handler, the launcher's fleet killer) and is "
+                 "evicted in turn, which is how a preemption SIGTERM "
+                 "stops saving checkpoints; handlers belong to process "
+                 "OWNERS — the sanctioned entrypoints "
+                 "(fleet/elastic/preemption.py, distributed/launch/, "
+                 "serving/http.py's drain installer, the observability "
+                 "aggregator's main) — and library code should raise, "
+                 "return errors, or accept a callback instead")
+
+    _SIGNAL_CALLS = {"signal.signal", "signal.sigaction", "_signal.signal"}
+    # the process-owner surfaces that legitimately install handlers:
+    # preemption hook, launcher entrypoints, the serving drain
+    # installer, and the aggregator daemon's main
+    _SANCTIONED = re.compile(
+        r"(^|/)paddle_tpu/(fleet/elastic/preemption\.py"
+        r"|distributed/fleet/elastic/preemption\.py"
+        r"|distributed/launch/"
+        r"|serving/http\.py"
+        r"|observability/aggregator\.py)")
+
+    def on_call(self, node, ctx):
+        if not ctx.library_path or self._SANCTIONED.search(ctx.path_posix):
+            return
+        name = dotted(node.func)
+        if name in self._SIGNAL_CALLS:
+            ctx.report(node, self.id,
+                       f"{name}() in library code evicts the process "
+                       f"owner's handler (preemption save, serving "
+                       f"drain, launcher kill); only the sanctioned "
+                       f"entrypoints install handlers — accept a "
+                       f"callback or surface an error instead")

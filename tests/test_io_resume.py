@@ -1,0 +1,236 @@
+"""Mid-epoch data-pipeline resume: DataLoader/sampler ``state_dict``
+round-trips, the bit-identical loss-trajectory pin, the
+CheckpointManager ``data_state`` ride-along, and the SIGKILLed-worker
+diagnostic (never a hang).
+
+The contract under test: interrupt a shuffled multi-epoch run
+anywhere, persist ``DataLoader.state_dict()`` beside the params,
+rebuild the pipeline from scratch, ``load_state_dict()``, and the
+remaining batches — hence the loss trajectory — are bit-identical to
+an uninterrupted oracle: no replayed and no skipped samples.
+"""
+from __future__ import annotations
+
+import multiprocessing
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io import DataLoader
+from paddle_tpu.io.dataset import Dataset
+from paddle_tpu.io.sampler import (BatchSampler, DistributedBatchSampler,
+                                   RandomSampler)
+
+
+class _Arange(Dataset):
+    def __init__(self, n=24, dim=3):
+        self.n, self.dim = n, dim
+
+    def __getitem__(self, i):
+        return np.full((self.dim,), float(i), np.float64)
+
+    def __len__(self):
+        return self.n
+
+
+def _sampler(n=24, batch_size=2):
+    return DistributedBatchSampler(_Arange(n), batch_size=batch_size,
+                                   num_replicas=1, rank=0, shuffle=True)
+
+
+def _loader(n=24, batch_size=2):
+    ds = _Arange(n)
+    return DataLoader(ds, batch_sampler=_sampler(n, batch_size))
+
+
+def _train(loader, w, total_batches):
+    """Deterministic numpy 'training': returns the per-batch loss
+    trajectory; mutates ``w`` in place.  Pure fp64 arithmetic, so two
+    runs over the same batch sequence are bit-identical."""
+    losses = []
+    while len(losses) < total_batches:
+        for batch in loader:
+            x = np.asarray(batch._data, np.float64)
+            g = x.mean(axis=0)
+            losses.append(float(np.dot(w, g)))
+            w -= 0.01 * g
+            if len(losses) >= total_batches:
+                break
+    return losses
+
+
+# -- the pinned acceptance test ----------------------------------------------
+
+def test_resumed_loss_trajectory_is_bit_identical_to_oracle():
+    """Tier-1 pin: interrupt a shuffled 3-epoch run mid-epoch-1, resume
+    through a FRESH DataLoader from state_dict() — every remaining loss
+    is bit-identical (exact float equality) to the uninterrupted
+    oracle's."""
+    epochs, per_epoch = 3, len(_sampler())
+    total = epochs * per_epoch
+
+    oracle_w = np.zeros(3, np.float64)
+    oracle = _train(_loader(), oracle_w, total)
+    assert len(set(oracle)) > 1  # the trajectory actually moves
+
+    # interrupted run: stop mid-epoch-1 (an awkward, non-boundary spot)
+    stop = per_epoch + 3
+    w = np.zeros(3, np.float64)
+    first_leg = _train(_loader_with_capture := _loader(), w, stop)
+    state = _loader_with_capture.state_dict()
+    assert state["delivered"] == 3  # 3 batches into epoch 1
+    assert state["sampler"] == {"epoch": 1, "cursor": 3}
+
+    resumed = _loader()  # brand-new pipeline, as after a real restart
+    resumed.load_state_dict(state)
+    second_leg = _train(resumed, w, total - stop)
+
+    assert first_leg + second_leg == oracle  # bit-identical, all 36
+    np.testing.assert_array_equal(w, oracle_w)
+
+
+def test_resume_at_exact_epoch_boundary_rolls_over():
+    per_epoch = len(_sampler())
+    loader = _loader()
+    w = np.zeros(3, np.float64)
+    _train(loader, w, per_epoch)  # exactly one full epoch
+    state = loader.state_dict()
+    assert state["sampler"]["cursor"] == per_epoch
+
+    oracle = _train(_loader(), np.zeros(3, np.float64), 2 * per_epoch)
+    resumed = _loader()
+    resumed.load_state_dict(state)
+    # the rollover must start epoch 1 at cursor 0 — not replay epoch 0
+    # and not skip epoch 1
+    assert _train(resumed, w, per_epoch) == oracle[per_epoch:]
+
+
+def test_skipped_batches_fetch_no_data():
+    fetched = []
+
+    class Spy(_Arange):
+        def __getitem__(self, i):
+            fetched.append(i)
+            return super().__getitem__(i)
+
+    sampler = _sampler()
+    loader = DataLoader(Spy(), batch_sampler=sampler)
+    loader.load_state_dict(
+        {"delivered": 4, "sampler": {"epoch": 0, "cursor": 4}})
+    batches = list(loader)
+    assert len(batches) == len(sampler) - 4
+    # index-level skip: the 8 samples of the 4 skipped batches were
+    # never touched
+    assert len(fetched) == 2 * len(batches)
+
+
+# -- sampler state round-trips ------------------------------------------------
+
+def test_batch_sampler_state_roundtrip():
+    bs = BatchSampler(_Arange(10), batch_size=2)
+    it = iter(bs)
+    first = [next(it), next(it)]
+    assert first == [[0, 1], [2, 3]]
+    assert bs.state_dict() == {"cursor": 2}
+    bs2 = BatchSampler(_Arange(10), batch_size=2)
+    bs2.load_state_dict(bs.state_dict())
+    assert list(bs2) == [[4, 5], [6, 7], [8, 9]]
+    # a full-epoch cursor wraps to a fresh epoch
+    bs3 = BatchSampler(_Arange(10), batch_size=2)
+    bs3.load_state_dict({"cursor": 5})
+    assert list(bs3) == [[0, 1], [2, 3], [4, 5], [6, 7], [8, 9]]
+
+
+def test_distributed_batch_sampler_permutation_is_epoch_pure():
+    a, b = _sampler(), _sampler()
+    assert list(a) == list(b)  # same epoch → same permutation
+    b.set_epoch(5)
+    epoch5 = list(b)
+    assert epoch5 != list(a)   # different epoch → different permutation
+    b.set_epoch(5)
+    assert list(b) == epoch5   # and it is a pure function of the epoch
+
+
+def test_random_sampler_honors_generator():
+    order1 = list(RandomSampler(_Arange(16),
+                                generator=np.random.RandomState(7)))
+    order2 = list(RandomSampler(_Arange(16),
+                                generator=np.random.RandomState(7)))
+    assert order1 == order2
+    assert sorted(order1) == list(range(16))
+    order3 = list(RandomSampler(_Arange(16),
+                                generator=np.random.default_rng(7)))
+    assert sorted(order3) == list(range(16))
+
+
+# -- CheckpointManager data_state ride-along ----------------------------------
+
+@pytest.mark.parametrize("async_save", [False, True])
+def test_checkpoint_manager_persists_data_state(tmp_path, async_save):
+    from paddle_tpu.distributed.checkpoint_manager import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path), process_index=0, world_size=1,
+                            async_save=async_save)
+    loader = _loader()
+    w = np.zeros(3, np.float64)
+    _train(loader, w, 5)
+    mgr.save(5, {"w": w}, data_state=loader.state_dict(),
+             block=async_save)
+    mgr.wait()
+
+    mgr2 = CheckpointManager(str(tmp_path), process_index=0, world_size=1)
+    ds = mgr2.load_data_state()
+    assert ds == loader.state_dict()
+    resumed = _loader()
+    resumed.load_state_dict(ds)
+    total = 3 * len(_sampler())
+    oracle = _train(_loader(), np.zeros(3, np.float64), total)
+    assert _train(resumed, w, total - 5) == oracle[5:]
+
+
+def test_checkpoint_without_data_state_loads_none(tmp_path):
+    from paddle_tpu.distributed.checkpoint_manager import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path), process_index=0, world_size=1)
+    mgr.save(1, {"w": np.arange(4.0)})
+    assert mgr.load_data_state() is None
+    assert mgr.load_data_state(step=99) is None
+
+
+# -- dead multiprocess worker: named diagnostic, never a hang -----------------
+
+@pytest.mark.skipif(os.name != "posix", reason="SIGKILLs a real worker")
+def test_sigkilled_worker_raises_naming_worker_and_batch():
+    """Regression pin for the dead-worker path: SIGKILL one worker
+    mid-epoch → the iterator raises within its timeout naming the
+    worker id, its pid, and the last batch index dispatched to it —
+    it must never hang."""
+
+    class Slow(_Arange):  # locally defined → unpicklable → fork ctx
+        def __getitem__(self, i):
+            time.sleep(0.05)
+            return super().__getitem__(i)
+
+    before = set(multiprocessing.active_children())
+    loader = DataLoader(Slow(64), batch_size=2, num_workers=2,
+                        use_shared_memory=False, timeout=60)
+    it = iter(loader)
+    next(it)
+    workers = [p for p in multiprocessing.active_children()
+               if p not in before]
+    assert len(workers) == 2
+    victim = workers[0]
+    os.kill(victim.pid, signal.SIGKILL)
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError) as ei:
+        for _ in it:
+            pass
+    elapsed = time.monotonic() - t0
+    msg = str(ei.value)
+    assert "exited unexpectedly" in msg
+    assert f"pid {victim.pid}" in msg
+    assert "last dispatched batch index" in msg
+    assert elapsed < 30, f"dead-worker detection took {elapsed:.0f}s"

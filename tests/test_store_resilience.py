@@ -28,7 +28,7 @@ from paddle_tpu.distributed.resilient_store import (
     ResilientStore, StoreUnavailableError, read_endpoint_file,
     write_endpoint_file)
 
-from fault_injection import truncate_file
+from fault_injection import corrupt_file, truncate_file
 
 needs_native = pytest.mark.skipif(not native_available(),
                                   reason="native TCPStore client "
@@ -514,3 +514,103 @@ def test_resilient_store_emits_reconnect_metric():
         assert re.search(r"pt_store_generation(\{[^}]*\})? 1\b", text)
     finally:
         tel_mod.reset()
+
+
+# -- hot-standby follower edges ----------------------------------------------
+# (the live promote-under-fire drill is tests/drills/test_supervisor_drills.py;
+#  these pin the StoreFollower tail/promote edges in-process)
+
+def test_follower_tails_incrementally_and_buffers_torn_tail(tmp_path):
+    """Mid-replication torn tail: the master is mid-write(2) — the
+    follower must buffer the half line, apply NOTHING of it, and apply
+    it exactly once when the rest of the bytes land."""
+    wal = str(tmp_path / "store.wal")
+    w = StoreWAL(wal)
+    w.record_set("a", b"1")
+    f = _ss.StoreFollower(wal)
+    assert f.poll() == 1
+    assert f.kv["a"] == b"1"
+    # append a record, then tear its tail off the file — exactly the
+    # bytes a follower sees racing the master's in-flight write(2)
+    w.record_set("b", b"22222222")
+    with open(wal, "rb") as fh:
+        full = fh.read()
+    truncate_file(wal, keep=len(full) - 6)
+    assert f.poll() == 0        # half a line: buffered, not applied
+    assert "b" not in f.kv
+    assert f.broken is None     # a torn TAIL is not corruption
+    # the rest of the write lands: restore the missing 6 bytes
+    with open(wal, "ab") as fh:
+        fh.write(full[-6:])
+    assert f.poll() == 1        # the buffered half + the rest = one record
+    assert f.kv["b"] == b"22222222"
+    assert f.broken is None
+    w.close()
+
+
+def test_follower_behind_at_promote_catches_up_first(tmp_path):
+    """Follower behind at promote: records appended after the last
+    poll() must still be served by the promoted master — promote()
+    does one final catch-up before seeding the server."""
+    wal = str(tmp_path / "store.wal")
+    w = StoreWAL(wal)
+    w.record_set("early", b"1")
+    f = _ss.StoreFollower(wal)
+    assert f.poll() == 1
+    # the master keeps writing; the follower never polls again
+    w.record_set("late", b"2")
+    w.record_add("cnt", 9)
+    w.close()
+    srv = f.promote()
+    try:
+        assert srv._kv["early"] == b"1"
+        assert srv._kv["late"] == b"2"
+        assert struct.unpack("<q", srv._kv["cnt"])[0] == 9
+        assert srv.generation == 1  # no prior generation record → 1
+    finally:
+        srv.stop()
+
+
+def test_promote_during_write_drops_unacked_tail(tmp_path):
+    """Promote-during-write: the master died mid-append — the torn
+    bytes were never acknowledged to any client, so the promoted
+    master must drop them (from memory AND from the shared WAL file)
+    and serve every complete record."""
+    wal = str(tmp_path / "store.wal")
+    srv0 = DurableTCPStoreServer(wal_path=wal, wal_fsync=False)
+    srv0.stop()
+    w = StoreWAL(wal)
+    w.record_set("acked", b"yes")
+    w.close()
+    truncate_file(wal, keep=os.path.getsize(wal) - 4)  # mid-append death
+    f = _ss.StoreFollower(wal)
+    f.poll()
+    assert f._buf  # the torn fragment is sitting in the buffer
+    srv = f.promote()
+    try:
+        assert "acked" not in srv._kv  # torn record: never acked, gone
+        assert srv.generation == 2     # bumped past the dead master's 1
+        # the promoted master's append path truncated the torn bytes:
+        # a full re-replay of the shared WAL sees no damage
+        kv = replay_wal(wal)
+        assert kv[GENERATION_KEY] == b"2"
+    finally:
+        srv.stop()
+
+
+def test_follower_mid_file_corruption_refuses_promotion(tmp_path):
+    """A hole in the MIDDLE of the journal (bit-rot, not a torn tail)
+    must brick the follower: applying records past a hole would serve
+    wrong state behind an intact generation fence."""
+    wal = str(tmp_path / "store.wal")
+    w = StoreWAL(wal)
+    w.record_set("a", b"1")
+    w.record_set("b", b"2")
+    w.record_set("c", b"3")
+    w.close()
+    corrupt_file(wal, offset=os.path.getsize(wal) // 2)
+    f = _ss.StoreFollower(wal)
+    f.poll()
+    assert f.broken is not None
+    with pytest.raises(RuntimeError, match="cannot promote"):
+        f.promote()

@@ -1,0 +1,235 @@
+"""Fast unit tests for the self-healing supervisor (no drills here —
+the real-subprocess end-to-end proof lives in
+tests/drills/test_supervisor_drills.py).
+
+Workers are real (tiny ``sys.executable -c`` children, so Popen
+semantics are honest) but exit codes are scripted per generation, and
+the budget ledger is exercised directly with an injected fake clock so
+the rolling window is tested to the second without sleeping.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from paddle_tpu.distributed import exit_codes
+from paddle_tpu.distributed import supervisor as sup_mod
+from paddle_tpu.distributed.exit_codes import (EXIT_DRAIN, EXIT_SAVE_FAILED,
+                                               EXIT_STORE_LOST,
+                                               EXIT_TEMPFAIL, EXIT_WATCHDOG)
+from paddle_tpu.distributed.supervisor import (RestartBudgetExhausted,
+                                               SpawnFailed, Supervisor,
+                                               supervision_snapshot)
+
+
+def _child(code=0):
+    return subprocess.Popen(
+        [sys.executable, "-c", f"import sys; sys.exit({int(code)})"])
+
+
+def _scripted(plan):
+    """spawn() whose exit codes follow ``plan[generation][rank]``
+    (missing entries exit 0); also journals every call."""
+    calls = []
+
+    def spawn(rank, world, run_id, generation):
+        calls.append((generation, rank, world, run_id))
+        code = plan.get(generation, {}).get(rank, 0)
+        return _child(code)
+
+    spawn.calls = calls
+    return spawn
+
+
+def _fast(spawn, world, **kw):
+    kw.setdefault("backoff_base", 0.0)
+    kw.setdefault("backoff_max", 0.0)
+    kw.setdefault("grace", 5.0)
+    kw.setdefault("generation_timeout", 60.0)
+    return Supervisor(spawn, world, **kw)
+
+
+# -- exit-code taxonomy (satellite: one canonical module) --------------------
+
+def test_exit_code_taxonomy_is_canonical():
+    assert (EXIT_SAVE_FAILED, EXIT_STORE_LOST, EXIT_WATCHDOG,
+            EXIT_TEMPFAIL, EXIT_DRAIN) == (17, 19, 70, 75, 143)
+    assert exit_codes.classify(0) == "ok"
+    assert exit_codes.classify(EXIT_DRAIN) == "drain"
+    assert exit_codes.classify(EXIT_TEMPFAIL) == "tempfail"
+    assert exit_codes.classify(EXIT_WATCHDOG) == "watchdog"
+    assert exit_codes.classify(EXIT_STORE_LOST) == "store_lost"
+    assert exit_codes.classify(-9) == "killed"
+    assert exit_codes.classify(1) == "crash"
+    assert "store" in exit_codes.describe(EXIT_STORE_LOST)
+
+
+def test_exit_codes_have_one_home():
+    # the magic numbers must come from distributed/exit_codes.py, not be
+    # re-declared: every other in-package definition is an import/re-export
+    out = subprocess.run(
+        ["grep", "-rn", r"EXIT_STORE_LOST\s*=\s*[0-9]", "paddle_tpu/"],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True, text=True).stdout
+    homes = [ln for ln in out.splitlines() if ln.strip()]
+    assert homes and all("distributed/exit_codes.py" in ln for ln in homes), \
+        f"EXIT_STORE_LOST literal re-declared outside exit_codes.py: {homes}"
+
+
+# -- clean + single-restart paths --------------------------------------------
+
+def test_clean_fleet_single_generation():
+    sup = _fast(_scripted({}), 2)
+    snap = sup.run()
+    assert snap["final_rcs"] == {0: 0, 1: 0}
+    assert snap["generations"] == 1
+    assert snap["restarts_total"] == 0
+    assert snap["quarantined_shards"] == []
+
+
+def test_tempfail_costs_one_restart_with_fresh_run_id():
+    spawn = _scripted({0: {1: EXIT_TEMPFAIL}})
+    sup = _fast(spawn, 2, run_id_prefix="job")
+    snap = sup.run()
+    assert snap["generations"] == 2
+    assert snap["restarts_by_cause"] == {"tempfail": 1}
+    run_ids = sorted({c[3] for c in spawn.calls})
+    assert run_ids == ["job-g0", "job-g1"]
+    assert snap["restart_replay_seconds"] >= 0.0
+
+
+def test_save_failed_peers_are_not_charged():
+    # rank 0 is the root cause (watchdog); rank 1 exits the
+    # EXIT_SAVE_FAILED consequence code — only rank 0's budget is hit
+    spawn = _scripted({0: {0: EXIT_WATCHDOG, 1: EXIT_SAVE_FAILED}})
+    sup = _fast(spawn, 2)
+    snap = sup.run()
+    assert snap["restarts_by_cause"] == {"watchdog": 1}
+    assert list(sup._failures) == [0]
+
+
+def test_diagnose_all_save_failed_falls_back_to_first_nonzero():
+    rank, rc, cause = Supervisor._diagnose(
+        {0: EXIT_SAVE_FAILED, 1: EXIT_SAVE_FAILED})
+    assert (rank, rc) == (0, EXIT_SAVE_FAILED)
+    rank, rc, cause = Supervisor._diagnose({0: 0, 1: -9, 2: EXIT_SAVE_FAILED})
+    assert (rank, rc, cause) == (1, -9, "killed")
+
+
+# -- restart budget / rolling window -----------------------------------------
+
+def test_crash_loop_exhausts_budget_naming_rank():
+    plan = {g: {1: 1} for g in range(10)}
+    sup = _fast(_scripted(plan), 2, max_restarts=2)
+    with pytest.raises(RestartBudgetExhausted) as ei:
+        sup.run()
+    assert ei.value.rank == 1
+    assert ei.value.cause == "crash"
+    assert "rank 1" in str(ei.value)
+    assert "budget 2" in str(ei.value)
+
+
+def test_rolling_window_prunes_old_failures():
+    t = [1000.0]
+    sup = Supervisor(_scripted({}), 2, max_restarts=2,
+                     restart_window=60.0, clock=lambda: t[0],
+                     sleep=lambda s: None)
+    sup._charge(1, 1, "crash")
+    t[0] += 59.0
+    sup._charge(1, 1, "crash")  # 2 in window == budget: still alive
+    t[0] += 59.0               # first failure now 118s old → pruned
+    sup._charge(1, 1, "crash")
+    t[0] += 1.0
+    with pytest.raises(RestartBudgetExhausted):
+        sup._charge(1, 1, "crash")  # 3 inside 60s > budget of 2
+
+
+def test_store_lost_is_charged_to_the_store_not_a_rank():
+    plan = {g: {0: EXIT_STORE_LOST} for g in range(10)}
+    sup = _fast(_scripted(plan), 2, max_restarts=1)
+    with pytest.raises(RestartBudgetExhausted) as ei:
+        sup.run()
+    assert ei.value.rank is None
+    assert ei.value.cause == "store_lost"
+    assert "store master" in str(ei.value)
+    assert list(sup._failures) == ["store"]
+
+
+# -- shard quarantine ---------------------------------------------------------
+
+def test_correlated_crash_loop_quarantines_the_shard():
+    plan = {g: {1: 1} for g in range(10)}
+    sup = _fast(_scripted(plan), 2, max_restarts=2,
+                shard_of=lambda r: f"shard-{r}", quarantine_threshold=2)
+    with pytest.raises(RestartBudgetExhausted) as ei:
+        sup.run()
+    assert ei.value.shard == "shard-1"
+    assert "shard-1" in str(ei.value)
+    assert "quarantined" in str(ei.value)
+    assert sup.quarantined_shards == {"shard-1"}
+
+
+def test_uncorrelated_failures_do_not_quarantine():
+    # failures alternate between rank 0's and rank 1's shards — no
+    # single-shard correlation, so nothing is quarantined
+    plan = {0: {0: 1}, 1: {1: 1}, 2: {0: 1}, 3: {1: 1}}
+    sup = _fast(_scripted(plan), 2, max_restarts=3,
+                shard_of=lambda r: f"shard-{r}", quarantine_threshold=2)
+    snap = sup.run()
+    assert snap["quarantined_shards"] == []
+    assert snap["restarts_total"] == 4
+
+
+# -- lease expiry / elastic downsizing ---------------------------------------
+
+def test_dead_rank_past_lease_downsizes_the_world():
+    calls = []
+
+    def spawn(rank, world, run_id, generation):
+        calls.append((generation, rank, world))
+        if generation == 0 and rank == 2:
+            raise SpawnFailed("host gone")
+        return _child(0)
+
+    sup = _fast(spawn, 3, spawn_lease=0.2, min_world=1)
+    snap = sup.run()
+    assert snap["world"] == 2
+    assert snap["final_rcs"] == {0: 0, 1: 0}
+    assert snap["resizes"] == [{"generation": 0, "from_world": 3,
+                                "to_world": 2, "dead_ranks": [2]}]
+    assert snap["restarts_by_cause"] == {"lease_expired": 1}
+    # generation 1 respawned everyone at the smaller world
+    assert {(r, w) for g, r, w in calls if g == 1} == {(0, 2), (1, 2)}
+
+
+def test_downsizing_below_min_world_fails_loudly():
+    def spawn(rank, world, run_id, generation):
+        raise SpawnFailed("cluster gone")
+
+    sup = _fast(spawn, 2, spawn_lease=0.2, min_world=2)
+    with pytest.raises(RestartBudgetExhausted) as ei:
+        sup.run()
+    assert ei.value.cause == "lease_expired"
+    assert "min_world=2" in str(ei.value)
+
+
+# -- snapshots ----------------------------------------------------------------
+
+def test_supervision_snapshot_defaults_to_zero_block(monkeypatch):
+    monkeypatch.setattr(sup_mod, "_LAST_SUPERVISOR", None)
+    snap = supervision_snapshot()
+    assert snap == {"world": 0, "generations": 0, "restarts_total": 0,
+                    "restarts_by_cause": {}, "promotions": 0,
+                    "quarantined_shards": [], "resizes": [],
+                    "restart_replay_seconds": 0.0}
+
+
+def test_supervision_snapshot_reflects_last_supervisor():
+    sup = _fast(_scripted({0: {0: EXIT_DRAIN}}), 1)
+    sup.run()
+    snap = supervision_snapshot()
+    assert snap["restarts_by_cause"] == {"drain": 1}
+    assert snap["generations"] == 2

@@ -376,6 +376,23 @@ FIXTURES = {
             return q, scale
         """,
     ),
+    "TPU023": (
+        "paddle_tpu/core/mod.py",
+        """
+        import signal
+        def arm(cb):
+            signal.signal(signal.SIGTERM, cb)
+        """,
+        """
+        import signal
+        def arm(cb, install=None):
+            # library code surfaces the callback; the process OWNER
+            # (preemption hook / launcher / drain installer) registers
+            if install is not None:
+                install(signal.SIGTERM, cb)
+            return cb
+        """,
+    ),
     "TPU014": (
         "paddle_tpu/distributed/mod.py",
         """
@@ -1293,6 +1310,37 @@ def test_tpu022_package_has_no_raw_quant_casts():
     violations, errors = run_paths(GATE_PATHS)
     assert errors == {}
     assert [v for v in violations if v.rule == "TPU022"] == []
+
+
+def test_tpu023_sanctioned_entrypoints_are_exempt():
+    # process-global signal disposition belongs to the process owner —
+    # the launch entrypoint, the serving frontend, the aggregator, the
+    # preemption hook.  Everything else must accept a callback instead.
+    src = """
+    import signal
+    def install(cb):
+        signal.signal(signal.SIGTERM, cb)
+    """
+    for path in ("paddle_tpu/distributed/launch/main.py",
+                 "paddle_tpu/serving/http.py",
+                 "paddle_tpu/observability/aggregator.py",
+                 "paddle_tpu/distributed/fleet/elastic/preemption.py",
+                 "tests/test_x.py", "bench.py"):
+        assert "TPU023" not in rules_fired(src, path=path), path
+    for path in ("paddle_tpu/core/mod.py",
+                 "paddle_tpu/distributed/supervisor.py",
+                 "paddle_tpu/io/dataloader.py"):
+        assert "TPU023" in rules_fired(src, path=path), path
+
+
+def test_tpu023_package_has_zero_baseline_entries():
+    # satellite contract: zero baseline entries for TPU023, ever —
+    # library code takes shutdown callbacks, it never owns the handler
+    bl = load_baseline(default_baseline_path())
+    assert not [k for k in bl if "::TPU023::" in k]
+    violations, errors = run_paths(GATE_PATHS)
+    assert errors == {}
+    assert [v for v in violations if v.rule == "TPU023"] == []
 
 
 # -- suppressions ------------------------------------------------------------
