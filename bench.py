@@ -798,6 +798,7 @@ def _leg_main(name, batch, recompute):
     from paddle_tpu.observability.trace import get_tracer
     from paddle_tpu.observability.goodput import get_goodput
     from paddle_tpu.observability.numerics import get_monitor
+    from paddle_tpu.observability.sdc import get_monitor as sdc_monitor
     from paddle_tpu.observability.memory import get_memory_monitor
     from paddle_tpu.tools.audit import runtime as audit_rt
     tel = get_telemetry().enable()  # metrics + compile watch, no sink/server
@@ -835,6 +836,7 @@ def _leg_main(name, batch, recompute):
     fields[f"trace_{name}"] = tr.snapshot()
     fields[f"goodput_{name}"] = gp.snapshot()
     fields[f"numerics_{name}"] = get_monitor().snapshot()
+    fields[f"sdc_{name}"] = sdc_monitor().snapshot()
     fields[f"memory_{name}"] = mm.snapshot()
     fields[f"audit_{name}"] = audit_rt.snapshot()
     print(json.dumps(rec), flush=True)
@@ -908,6 +910,7 @@ def main():
     from paddle_tpu.observability.trace import get_tracer
     from paddle_tpu.observability.goodput import get_goodput
     from paddle_tpu.observability.numerics import get_monitor
+    from paddle_tpu.observability.sdc import get_monitor as sdc_monitor
     from paddle_tpu.observability.memory import get_memory_monitor
     from paddle_tpu.tools.audit import runtime as audit_rt
     tel = get_telemetry().enable()
@@ -937,6 +940,10 @@ def main():
         try:
             result["goodput"] = gp.snapshot()
             result["numerics"] = get_monitor().snapshot()
+            # …and the SDC sentry block: fingerprint reads, votes, and
+            # divergence verdicts — the all-zero disabled snapshot when
+            # the sentry never armed, so it rides every record too
+            result["sdc"] = sdc_monitor().snapshot()
             # …and the memory block: fit verdicts + watermark summary,
             # {} stats on the tpu_unreachable CPU fast-fail
             result["memory"] = mm.snapshot()

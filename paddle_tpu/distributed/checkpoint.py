@@ -246,6 +246,19 @@ def _npy_bytes(arr):
     return buf.getvalue()
 
 
+def _content_digest(arr):
+    """CRC32 over the LOGICAL element bytes of one shard, taken from the
+    live in-memory array at save time — before any serialization.
+
+    Distinct from the COMMIT manifest's per-file CRC on purpose: the
+    manifest CRC is computed over the .npy write buffer, so corruption
+    that lands between device memory and serialization is sealed INTO
+    the manifest and passes file verification forever.  The content
+    digest is the end-to-end witness: it can only be reproduced by the
+    same element bytes that were alive in the tree at save."""
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
+
+
 def _shard_records(state, proc):
     """Yield ``(relpath, bytes)`` for every durable file of this
     process's part of the checkpoint: each addressable replica-0 shard as
@@ -263,7 +276,9 @@ def _shard_records(state, proc):
                            "spec": None,
                            "shards": [{"file": f"{fs}/{fname}",
                                        "index": [list(w)
-                                                 for w in arr.window]}]}
+                                                 for w in arr.window],
+                                       "digest": _content_digest(
+                                           arr.data)}]}
             yield (f"data/{fs}/{fname}", _npy_bytes(arr.data))
             continue
         arr = arr if isinstance(arr, jax.Array) else jnp.asarray(arr)
@@ -285,9 +300,11 @@ def _shard_records(state, proc):
                        int(sl.stop if sl.stop is not None else dim)]
                       for sl, dim in zip(shard.index, arr.shape)]
             # 0-d arrays: shard.index is (), window is []
+            data = np.asarray(shard.data)
             entry["shards"].append({"file": f"{fs}/{fname}",
-                                    "index": window})
-            yield (f"data/{fs}/{fname}", _npy_bytes(np.asarray(shard.data)))
+                                    "index": window,
+                                    "digest": _content_digest(data)})
+            yield (f"data/{fs}/{fname}", _npy_bytes(data))
         index[leaf] = entry
     yield (f"index.{proc}.json", json.dumps(index).encode())
 
@@ -643,6 +660,34 @@ def _verify_coverage(path, leaf, entry, elastic=False, committed=None):
             f"elements — missing shard files for shape {list(shape)}")
 
 
+def _verify_leaf_digests(path, leaf, entry):
+    """Recompute each shard's content digest from the reconstructed
+    element bytes and compare against the value recorded from the live
+    array at save.  Per shard file, so it holds under elastic M→N
+    restitch (the saved windows are verified regardless of the target
+    partitioning).  Shards without a recorded digest — checkpoints
+    written before digests existed — are skipped, keeping old
+    checkpoints loadable."""
+    for sh in entry.get("shards", ()):
+        want = sh.get("digest")
+        if want is None:
+            continue
+        fp = os.path.join(path, "data", sh["file"])
+        try:
+            src = np.load(fp, mmap_mode="r")
+        except Exception as e:
+            raise CheckpointCorruptError(
+                f"{path}: leaf '{leaf}' shard {sh['file']} is "
+                f"unreadable: {e}") from e
+        got = _content_digest(src)
+        if got != int(want):
+            raise CheckpointCorruptError(
+                f"{path}: leaf '{leaf}' shard {sh['file']} failed its "
+                f"content digest check (recorded {int(want):#010x} from "
+                f"the live array at save, reconstructed {got:#010x}) — "
+                f"silent corruption between device memory and restore")
+
+
 def is_committed(path):
     """True iff ``path`` holds a fully committed checkpoint (all
     ``COMMIT.<proc>`` markers present and parseable). Cheap: no CRC."""
@@ -671,6 +716,8 @@ def verify_checkpoint(path, integrity="full", elastic=False):
         for leaf, entry in merged.items():
             _verify_coverage(path, leaf, entry, elastic=elastic,
                              committed=sorted(markers))
+            if integrity == "full":
+                _verify_leaf_digests(path, leaf, entry)
     return merged
 
 
@@ -792,6 +839,8 @@ def read_leaf(path, leaf, window=None, integrity="size", elastic=False):
     if leaf in index and integrity in ("full", "size"):
         _verify_coverage(path, leaf, index[leaf], elastic=elastic,
                          committed=sorted(markers))
+        if integrity == "full":
+            _verify_leaf_digests(path, leaf, index[leaf])
     if leaf not in index:
         raise KeyError(f"{path}: no leaf {leaf!r} "
                        f"(have: {sorted(index)[:16]})")

@@ -114,6 +114,23 @@ cleanly, clean ranks booked nothing — and, replaying each rank's
 metrics exposition through a local aggregator, that the fleet sees the
 cross-rank memory skew and the near-OOM health trip.
 
+SDC drills (:func:`.runner.run_sdc_drill`) exercise the
+silent-data-corruption sentry end-to-end: ``world`` dp-replica
+workers train the SAME captured MLP from the SAME seed (bit-identical
+by construction) with the consensus fingerprints armed, one rank
+flips ONE mantissa bit of a parameter mid-run — finite everywhere,
+invisible to the numerics sentinel — and the drill proves the
+majority vote fingered exactly that rank within one cadence window,
+named a divergent tensor, pinned a flight dump, and halted the victim
+into a clean ``EXIT_SDC`` while clean ranks attributed the verdict
+and finished.  The quarantine scenario reruns the poisoned fleet
+under a real Supervisor: repeated verdicts charge the hardware ledger
+(never the code-crash budget), quarantine the rank, and the fleet
+downsizes elastically around the suspect host; the restore scenario
+plants a bit flip UNDER a committed checkpoint's manifest CRC
+(:func:`.runner.poison_shard`) and proves only the per-leaf content
+digests refuse the restore, naming the leaf.
+
 Overlap drills (:func:`.runner.run_overlap_drill`) exercise the
 optimization half of GC3: the span timelines pinned down by the
 bucketed vs monolithic gradient reduction (real ``partition_buckets``
@@ -127,11 +144,12 @@ schedule — and proves the scheduled buckets lift overlap from 0 to
 above one half.
 """
 __all__ = ["KillSpec", "StoreKillSpec", "ObsSpec", "TraceSpec",
-           "NumericsSpec", "OomSpec", "run_drill",
+           "NumericsSpec", "OomSpec", "SdcSpec", "run_drill",
            "run_store_kill_drill", "run_scrape_drill",
            "run_serve_chaos_drill", "run_supervisor_drill",
            "run_trace_drill", "run_numerics_drill", "run_oom_drill",
-           "run_overlap_drill", "run_sharded_overlap_drill",
+           "run_sdc_drill", "run_overlap_drill",
+           "run_sharded_overlap_drill", "poison_shard",
            "spawn_worker", "spawn_store_master", "spawn_aggregator",
            "spawn_serve_worker", "reap_all"]
 

@@ -27,24 +27,26 @@ import uuid
 
 from ...core import TCPStore
 from ...utils.retry import wait_until
-from ..checkpoint import read_leaf, verify_checkpoint
+from ..checkpoint import (CheckpointCorruptError, read_leaf,
+                          verify_checkpoint)
 from ..checkpoint_manager import CheckpointManager
 from ..resilient_store import ResilientStore, read_endpoint_file
 from .worker import (EXIT_NUMERICS_HALT, EXIT_OOM, EXIT_SAVE_FAILED,
-                     EXIT_STORE_LOST, advance, init_state,
+                     EXIT_SDC, EXIT_STORE_LOST, advance, init_state,
                      numerics_report_path, obs_ready_key,
                      obs_release_key, oom_metrics_path,
-                     oom_report_path, trace_report_path)
+                     oom_report_path, sdc_report_path,
+                     trace_report_path)
 
 __all__ = ["KillSpec", "StoreKillSpec", "ObsSpec", "TraceSpec",
-           "NumericsSpec", "OomSpec", "DrillFailure", "spawn_worker",
-           "spawn_store_master", "spawn_aggregator",
-           "spawn_serve_worker", "run_drill",
+           "NumericsSpec", "OomSpec", "SdcSpec", "DrillFailure",
+           "spawn_worker", "spawn_store_master", "spawn_aggregator",
+           "spawn_serve_worker", "poison_shard", "run_drill",
            "run_store_kill_drill", "run_scrape_drill",
            "run_serve_chaos_drill", "run_supervisor_drill",
            "run_trace_drill", "run_numerics_drill", "run_oom_drill",
-           "run_overlap_drill", "run_sharded_overlap_drill",
-           "reap_all"]
+           "run_sdc_drill", "run_overlap_drill",
+           "run_sharded_overlap_drill", "reap_all"]
 
 logger = logging.getLogger(__name__)
 
@@ -89,11 +91,12 @@ class ObsSpec:
 
     __slots__ = ("telemetry_dir", "step_base", "storm",
                  "sentinel_threshold", "hold_timeout", "anomalies",
-                 "mem_bytes", "shed", "served")
+                 "mem_bytes", "shed", "served", "sdc_verdicts")
 
     def __init__(self, telemetry_dir, step_base=0.01, storm=True,
                  sentinel_threshold=3, hold_timeout=120.0,
-                 anomalies=0, mem_bytes=0, shed=0, served=0):
+                 anomalies=0, mem_bytes=0, shed=0, served=0,
+                 sdc_verdicts=0):
         self.telemetry_dir = telemetry_dir
         self.step_base = float(step_base)
         self.storm = bool(storm)
@@ -110,6 +113,10 @@ class ObsSpec:
         # shed / (shed + served) and its shed-storm alarm assertable
         self.shed = int(shed)
         self.served = int(served)
+        # scripted SDC consensus verdicts: each rank books this many
+        # pt_sdc_divergence_total increments (fingering a fixed peer,
+        # halt disarmed), arming the aggregator's cluster SDC alarm
+        self.sdc_verdicts = int(sdc_verdicts)
 
 
 class TraceSpec:
@@ -166,6 +173,26 @@ class OomSpec:
         self.mem_bytes = int(mem_bytes)
 
 
+class SdcSpec:
+    """Scripted silent-data-corruption worker (``DRILL_SDC=1``): every
+    rank trains the SAME captured MLP from the SAME seed with the SDC
+    sentry armed and its fingerprint exchange wired to the drill
+    store; ``poison_rank`` (-1 = nobody) flips one mantissa bit of its
+    first captured parameter at ``poison_step``."""
+
+    __slots__ = ("out_dir", "poison_step", "poison_rank", "cadence",
+                 "bit", "exchange_timeout")
+
+    def __init__(self, out_dir, poison_step=5, poison_rank=1,
+                 cadence=4, bit=3, exchange_timeout=30.0):
+        self.out_dir = out_dir
+        self.poison_step = int(poison_step)
+        self.poison_rank = int(poison_rank)
+        self.cadence = int(cadence)
+        self.bit = int(bit)
+        self.exchange_timeout = float(exchange_timeout)
+
+
 class StoreKillSpec:
     """Scripted STORE-MASTER kill: every rank rendezvouses at ``phase``
     of step ``step``'s save (``pre-save`` | ``mid-barrier``), and the
@@ -202,7 +229,8 @@ def spawn_worker(rank, world, *, root, port=0, total_steps, run_id,
                  barrier_timeout, kill=None, elastic=True,
                  orphan_age=None, log_path=None, endpoint_file=None,
                  store_deadline=None, storekill=None, obs=None,
-                 trace=None, numerics=None, oom=None, flight_dir=None,
+                 trace=None, numerics=None, oom=None, sdc=None,
+                 restore_integrity=None, flight_dir=None,
                  fail=None, data_shard=None):
     """Launch one drill worker subprocess; returns its Popen (also
     registered for :func:`reap_all`).
@@ -217,7 +245,12 @@ def spawn_worker(rank, world, *, root, port=0, total_steps, run_id,
     to the storeless step-tracing mode; ``numerics`` (a
     :class:`NumericsSpec`) switches to the storeless NaN-injection
     mode; ``oom`` (an :class:`OomSpec`) switches to the storeless
-    OOM-postmortem mode; ``flight_dir`` arms the flight recorder
+    OOM-postmortem mode; ``sdc`` (an :class:`SdcSpec`) switches to the
+    silent-data-corruption consensus mode (needs a store for the
+    fingerprint exchange: ``port`` or ``endpoint_file``);
+    ``restore_integrity`` sets the checkpoint-mode resume integrity
+    level ("full" also recomputes per-leaf content digests; a refusal
+    exits ``EXIT_SDC``); ``flight_dir`` arms the flight recorder
     (``PT_FLIGHT_RECORDER``); ``fail=(step, exit_code)`` scripts a
     deterministic crash at the top of ``step`` (the supervisor drill's
     crash-loop: a resumed worker reaches the same step and dies again);
@@ -272,6 +305,8 @@ def spawn_worker(rank, world, *, root, port=0, total_steps, run_id,
             env["DRILL_OBS_SHED"] = str(obs.shed)
         if obs.served:
             env["DRILL_OBS_SERVED"] = str(obs.served)
+        if obs.sdc_verdicts:
+            env["DRILL_OBS_SDC"] = str(obs.sdc_verdicts)
     if trace is not None:
         env["DRILL_TRACE"] = "1"
         env["DRILL_TRACE_DIR"] = trace.trace_dir
@@ -291,6 +326,16 @@ def spawn_worker(rank, world, *, root, port=0, total_steps, run_id,
         env["DRILL_OOM_STEP"] = str(oom.oom_step)
         env["DRILL_OOM_RANK"] = str(oom.oom_rank)
         env["DRILL_OOM_MEM_BYTES"] = str(oom.mem_bytes)
+    if sdc is not None:
+        env["DRILL_SDC"] = "1"
+        env["DRILL_SDC_DIR"] = sdc.out_dir
+        env["DRILL_POISON_STEP"] = str(sdc.poison_step)
+        env["DRILL_POISON_RANK"] = str(sdc.poison_rank)
+        env["DRILL_SDC_CADENCE"] = str(sdc.cadence)
+        env["DRILL_SDC_BIT"] = str(sdc.bit)
+        env["DRILL_SDC_EXCHANGE_TIMEOUT"] = str(sdc.exchange_timeout)
+    if restore_integrity is not None:
+        env["DRILL_RESTORE_INTEGRITY"] = str(restore_integrity)
     if flight_dir is not None:
         env["PT_FLIGHT_RECORDER"] = flight_dir
     if fail is not None:
@@ -357,8 +402,8 @@ def spawn_store_master(*, endpoint_file, wal_path=None, port=0,
 
 def spawn_aggregator(*, endpoint_file, run_id, port_file,
                      interval=0.25, stale_after=2.0, storm_threshold=1,
-                     anomaly_threshold=10, mem_threshold=0,
-                     shed_threshold=0.0,
+                     anomaly_threshold=10, sdc_threshold=None,
+                     mem_threshold=0, shed_threshold=0.0,
                      scrape_timeout=2.0, store_deadline=10.0,
                      log_path=None, spawn_timeout=60.0):
     """Launch the cluster aggregator as a REAL subprocess
@@ -383,6 +428,8 @@ def spawn_aggregator(*, endpoint_file, run_id, port_file,
            "--scrape-timeout", str(scrape_timeout),
            "--storm-threshold", str(storm_threshold),
            "--anomaly-threshold", str(anomaly_threshold)]
+    if sdc_threshold is not None:
+        cmd += ["--sdc-threshold", str(sdc_threshold)]
     if mem_threshold:
         cmd += ["--mem-threshold", str(mem_threshold)]
     if shed_threshold:
@@ -478,6 +525,70 @@ def _verify_bit_for_bit(root, step):
         raise DrillFailure(
             f"step {step} restored state is not bit-identical to the "
             f"oracle replay (max |w-we| = {abs(w - we).max()})")
+
+
+def poison_shard(ckpt_dir, rel_path=None, bit=0, offset=None):
+    """Flip one payload bit in a committed shard file AND re-seal the
+    COMMIT manifest's crc32 to match the corrupted bytes.
+
+    This models silent corruption that happened between device memory
+    and serialization: the file-level CRC was computed over an
+    already-corrupt buffer, so manifest verification passes and only
+    the per-leaf *content* digest (recorded from the live array at
+    save) can refuse the restore.  Returns the relative path of the
+    poisoned file.  Canonical here — the restore-refusal leg of
+    :func:`run_sdc_drill` is the primary consumer — and re-exported by
+    tests/fault_injection.py for the checkpoint-digest unit tests.
+
+    ``offset`` is the byte offset inside the .npy payload to hit
+    (defaults to the last byte — element data, safely past the
+    header); ``bit`` selects the bit within that byte.
+    """
+    import zlib
+
+    files = []
+    data_root = os.path.join(ckpt_dir, "data")
+    for droot, _dirs, fnames in os.walk(data_root):
+        for fn in fnames:
+            files.append(os.path.relpath(os.path.join(droot, fn),
+                                         ckpt_dir))
+    files.sort()
+    if not files:
+        raise ValueError(f"no shard files under {ckpt_dir}")
+    rel = rel_path or files[0]
+    path = os.path.join(ckpt_dir, rel)
+    with open(path, "r+b") as f:
+        if offset is None:
+            f.seek(-1, os.SEEK_END)
+        else:
+            f.seek(offset)
+        pos = f.tell()
+        b = f.read(1)
+        if not b:
+            raise ValueError(f"offset {offset} out of range for {path}")
+        f.seek(pos)
+        f.write(bytes([b[0] ^ (1 << (int(bit) % 8))]))
+    with open(path, "rb") as f:
+        data = f.read()
+    crc = zlib.crc32(data) & 0xFFFFFFFF
+    patched = False
+    for name in os.listdir(ckpt_dir):
+        if not name.startswith("COMMIT."):
+            continue
+        marker_path = os.path.join(ckpt_dir, name)
+        with open(marker_path) as f:
+            marker = json.load(f)
+        entry = marker.get("files", {}).get(rel)
+        if entry is None:
+            continue
+        entry["crc32"] = crc
+        entry["size"] = len(data)
+        with open(marker_path, "w") as f:
+            json.dump(marker, f)
+        patched = True
+    if not patched:
+        raise ValueError(f"{rel} is not covered by any COMMIT manifest")
+    return rel
 
 
 def run_drill(root, generations, total_steps, *, barrier_timeout=6.0,
@@ -730,6 +841,7 @@ def run_store_kill_drill(root, *, world=2, total_steps=5, kill_step=3,
 
 def run_scrape_drill(root, *, world=3, steps=12, step_base=0.01,
                      kill_rank=2, storm=True, anomalies=0,
+                     sdc_verdicts=0,
                      mem_bytes=0, mem_threshold=0,
                      shed=0, served=0, shed_threshold=0.0,
                      restart_aggregator=False,
@@ -750,7 +862,13 @@ def run_scrape_drill(root, *, world=3, steps=12, step_base=0.01,
     0.8; ``anomalies`` (per-rank scripted numerics trips) arms the
     cross-rank anomaly alarm, whose threshold is then set to
     ``world * anomalies`` so it trips exactly — and flips /healthz to
-    503 even without a recompile storm.  ``mem_bytes`` feeds each rank
+    503 even without a recompile storm.  ``sdc_verdicts`` does the
+    same for the silent-data-corruption plane: each rank books that
+    many scripted consensus divergence verdicts (fingering a fixed
+    peer, halt disarmed), the aggregator's SDC threshold is set to
+    ``world * sdc_verdicts`` so ``pt_cluster_sdc_alarm`` trips
+    exactly, and /healthz must answer 503 on the corruption signal
+    alone.  ``mem_bytes`` feeds each rank
     a synthetic allocator watermark (rank r exports
     ``mem_bytes * (1 + r)``) so the cluster memory-skew gauge must
     read exactly ``mem_bytes * (world - 1)``; with ``mem_threshold``
@@ -783,6 +901,8 @@ def run_scrape_drill(root, *, world=3, steps=12, step_base=0.01,
     sentinel_threshold = 3
     storm_threshold = world if storm else world * 1000
     anomaly_threshold = world * anomalies if anomalies else world * 1000
+    sdc_threshold = (world * sdc_verdicts if sdc_verdicts
+                     else world * 1000)
 
     def _log(name):
         return os.path.join(log_dir, name) if log_dir else None
@@ -794,7 +914,8 @@ def run_scrape_drill(root, *, world=3, steps=12, step_base=0.01,
     spec = ObsSpec(telemetry_dir=telemetry_dir, step_base=step_base,
                    storm=storm, sentinel_threshold=sentinel_threshold,
                    hold_timeout=gen_timeout, anomalies=anomalies,
-                   mem_bytes=mem_bytes, shed=shed, served=served)
+                   mem_bytes=mem_bytes, shed=shed, served=served,
+                   sdc_verdicts=sdc_verdicts)
     mem_alarm_expected = bool(
         mem_bytes and mem_threshold
         and mem_bytes * world >= mem_threshold)
@@ -830,6 +951,7 @@ def run_scrape_drill(root, *, world=3, steps=12, step_base=0.01,
             port_file=port_file, interval=scrape_interval,
             stale_after=stale_after, storm_threshold=storm_threshold,
             anomaly_threshold=anomaly_threshold,
+            sdc_threshold=sdc_threshold,
             mem_threshold=mem_threshold,
             shed_threshold=shed_threshold,
             store_deadline=store_deadline,
@@ -913,7 +1035,8 @@ def run_scrape_drill(root, *, world=3, steps=12, step_base=0.01,
             if alarm not in (0.0, None):
                 raise DrillFailure(
                     f"storm alarm tripped ({alarm}) without a storm")
-            want = 503 if (anomalies or mem_alarm_expected
+            want = 503 if (anomalies or sdc_verdicts
+                           or mem_alarm_expected
                            or shed_alarm_expected) else 200
             if status != want:
                 raise DrillFailure(
@@ -952,6 +1075,26 @@ def run_scrape_drill(root, *, world=3, steps=12, step_base=0.01,
             raise DrillFailure(
                 f"anomaly alarm tripped ({anomaly_alarm}) without "
                 f"scripted anomalies")
+
+        # --- cluster SDC verdicts + the corruption alarm -------------
+        sdc_total = _sample_value(
+            fams, "pt_cluster_sdc_divergences_total")
+        sdc_alarm = _sample_value(fams, "pt_cluster_sdc_alarm")
+        if sdc_verdicts:
+            if sdc_total != float(world * sdc_verdicts):
+                raise DrillFailure(
+                    f"cluster SDC verdicts {sdc_total!r}, expected "
+                    f"{world * sdc_verdicts} (scripted divergences "
+                    f"summed across ranks)")
+            if sdc_alarm != 1.0 or not health.get("sdc_alarm"):
+                raise DrillFailure(
+                    f"SDC alarm metric={sdc_alarm} "
+                    f"healthz={health.get('sdc_alarm')}, expected "
+                    f"tripped at threshold {sdc_threshold}")
+        elif sdc_alarm not in (0.0, None):
+            raise DrillFailure(
+                f"SDC alarm tripped ({sdc_alarm}) without scripted "
+                f"divergence verdicts")
 
         # --- fleet memory view: skew gauge + the near-OOM trip -------
         mem_skew = _sample_value(fams, "pt_cluster_memory_skew_bytes")
@@ -1019,6 +1162,8 @@ def run_scrape_drill(root, *, world=3, steps=12, step_base=0.01,
             "cluster_goodput": {"min": gp_min, "mean": gp_mean},
             "anomalies_total": anomalies_total,
             "anomaly_alarm": anomaly_alarm,
+            "sdc_divergences_total": sdc_total,
+            "sdc_alarm": sdc_alarm,
             "memory_skew_bytes": mem_skew,
             "memory_alarm": mem_alarm,
             "shed_total": shed_total,
@@ -1088,6 +1233,7 @@ def run_scrape_drill(root, *, world=3, steps=12, step_base=0.01,
                 stale_after=stale_after,
                 storm_threshold=storm_threshold,
                 anomaly_threshold=anomaly_threshold,
+                sdc_threshold=sdc_threshold,
                 store_deadline=store_deadline,
                 log_path=_log("aggregator_restart.log"))
             base = f"http://{ahost}:{aport}"
@@ -1433,6 +1579,339 @@ def run_numerics_drill(root, *, world=2, steps=12, poison_step=5,
                 raise DrillFailure(
                     f"clean rank {r} claims detection at step "
                     f"{rep['detected_step']}")
+    finally:
+        reap_all()
+    return report
+
+
+def run_sdc_drill(root, *, scenario="consensus", world=3, steps=12,
+                  poison_step=5, poison_rank=1, cadence=4, bit=3,
+                  quarantine_threshold=2, sdc_max_restarts=4,
+                  barrier_timeout=6.0, gen_timeout=180.0, log_dir=None):
+    """Silent-data-corruption drill: REAL worker processes, a real bit
+    flip, and the full detect → attribute → quarantine → refuse chain.
+    Three scenarios:
+
+    - ``consensus``: ``world`` dp-replica workers (same seed, same
+      data — bit-identical by construction) train a captured MLP with
+      the SDC sentry armed, exchanging fingerprints through a real
+      TCPStore.  The victim flips ONE mantissa bit of its first
+      parameter at ``poison_step``; the majority vote must finger
+      exactly that rank within one cadence window, name a divergent
+      tensor path, pin a flight dump on the victim, and halt it into
+      ``EXIT_SDC`` — while every clean rank books the verdict against
+      the victim (and only the victim) and runs to completion with
+      exactly one compile.  ``poison_rank=-1`` is the control run:
+      everyone must stay verdict-free and exit 0.
+    - ``quarantine``: the same poisoned fleet under a real
+      :class:`~..supervisor.Supervisor`.  The victim re-poisons every
+      generation at the original world size — a sticky bad host — so
+      consensus fingers it ``quarantine_threshold`` times; the
+      supervisor must charge every ``EXIT_SDC`` to the hardware ledger
+      (never the code-crash budget), quarantine the rank, downsize the
+      fleet around it, and the downsized generation (poison disabled:
+      the bad host left the pool) must finish cleanly.
+    - ``restore``: a clean single-rank checkpoint run, then
+      :func:`poison_shard` plants a bit flip in the committed shard
+      AND re-seals the manifest CRC over the corrupted bytes — the
+      corruption a file-level CRC can never catch.  Manifest
+      verification must still pass, ``integrity="full"`` must refuse
+      naming the leaf and the digests, and a relaunched worker
+      resuming with ``DRILL_RESTORE_INTEGRITY=full`` must exit
+      ``EXIT_SDC`` instead of training on corrupt state.
+
+    Returns a report dict for further assertions.
+    """
+    if scenario not in ("consensus", "quarantine", "restore"):
+        raise ValueError(f"unknown sdc drill scenario {scenario!r}")
+    out_dir = os.path.join(root, "sdc")
+    flight_dir = os.path.join(root, "flight")
+    os.makedirs(out_dir, exist_ok=True)
+    exch_timeout = min(30.0, gen_timeout / 3.0)
+
+    def _log(name):
+        return os.path.join(log_dir, name) if log_dir else None
+
+    report = {"scenario": scenario, "world": world, "steps": steps,
+              "poison_step": poison_step, "poison_rank": poison_rank,
+              "cadence": cadence, "bit": bit}
+
+    if scenario == "restore":
+        return _run_sdc_restore_leg(root, report, steps=steps, bit=bit,
+                                    barrier_timeout=barrier_timeout,
+                                    gen_timeout=gen_timeout, _log=_log)
+
+    master = TCPStore("127.0.0.1", 0, is_master=True)
+    try:
+        if scenario == "consensus":
+            run_id = f"sdc-{uuid.uuid4().hex[:6]}"
+            spec = SdcSpec(out_dir=out_dir, poison_step=poison_step,
+                           poison_rank=poison_rank, cadence=cadence,
+                           bit=bit, exchange_timeout=exch_timeout)
+            procs = [
+                spawn_worker(
+                    r, world, root=root, port=master.port,
+                    total_steps=steps, run_id=run_id,
+                    barrier_timeout=gen_timeout, sdc=spec,
+                    flight_dir=flight_dir,
+                    log_path=_log(f"sdc_rank{r}.log"))
+                for r in range(world)
+            ]
+            rcs = _wait_fleet(procs, gen_timeout)
+            report["rcs"] = rcs
+            _assert_sdc_consensus(report, out_dir, rcs, world=world,
+                                  steps=steps, poison_step=poison_step,
+                                  poison_rank=poison_rank,
+                                  cadence=cadence)
+        else:  # quarantine
+            from ..supervisor import Supervisor
+
+            world0 = world
+
+            def spawn(rank, w, run_id, generation):
+                gdir = os.path.join(out_dir, f"g{generation}")
+                os.makedirs(gdir, exist_ok=True)
+                # the bad host re-poisons while it is in the pool; the
+                # post-quarantine downsized world runs clean
+                spec = SdcSpec(
+                    out_dir=gdir, poison_step=poison_step,
+                    poison_rank=poison_rank if w == world0 else -1,
+                    cadence=cadence, bit=bit,
+                    exchange_timeout=exch_timeout)
+                return spawn_worker(
+                    rank, w, root=root, port=master.port,
+                    total_steps=steps, run_id=run_id,
+                    barrier_timeout=gen_timeout, sdc=spec,
+                    log_path=_log(f"sdc_q_g{generation}_rank{rank}.log"))
+
+            sup = Supervisor(
+                spawn, world, sdc_max_restarts=sdc_max_restarts,
+                sdc_quarantine_threshold=quarantine_threshold,
+                grace=3.0 * barrier_timeout,
+                generation_timeout=gen_timeout,
+                run_id_prefix=f"sdcq-{uuid.uuid4().hex[:6]}")
+            snap = sup.run()
+            report["supervision"] = snap
+            _assert_sdc_quarantine(report, snap,
+                                   poison_rank=poison_rank,
+                                   threshold=quarantine_threshold,
+                                   world=world)
+    finally:
+        try:
+            master.close()
+        except Exception as e:
+            logger.debug("sdc drill: master close after run: %s", e)
+        reap_all()
+    return report
+
+
+def _assert_sdc_consensus(report, out_dir, rcs, *, world, steps,
+                          poison_step, poison_rank, cadence):
+    """Assertions for the consensus scenario (shared with the control
+    run, where ``poison_rank`` is -1 and nobody may be fingered)."""
+    clean_run = poison_rank < 0
+    for r, rc in enumerate(rcs):
+        want = EXIT_SDC if (not clean_run and r == poison_rank) else 0
+        if rc != want:
+            raise DrillFailure(
+                f"sdc rank {r} exited {rc}, expected {want}")
+    ranks = {}
+    for r in range(world):
+        rep_path = sdc_report_path(out_dir, r)
+        try:
+            with open(rep_path, "r", encoding="utf-8") as f:
+                rep = json.load(f)
+        except (OSError, ValueError) as e:
+            raise DrillFailure(
+                f"rank {r} wrote no parseable sdc report at "
+                f"{rep_path}: {e}") from e
+        ranks[r] = rep
+        if rep.get("compiles") != 1:
+            raise DrillFailure(
+                f"rank {r} compiled its captured step "
+                f"{rep.get('compiles')} times; the fingerprinted step "
+                f"must stay at exactly 1 compile")
+        if rep.get("fallback"):
+            raise DrillFailure(
+                f"rank {r} fell back to eager "
+                f"{rep.get('fallback')} times")
+    report["ranks"] = ranks
+
+    if clean_run:
+        for r, rep in ranks.items():
+            if rep.get("divergences_total"):
+                raise DrillFailure(
+                    f"control run: rank {r} booked verdicts "
+                    f"{rep.get('divergences')!r} on bit-identical "
+                    f"replicas")
+        return
+
+    # --- the victim: halt, detection window, attribution, flight -----
+    rep = ranks[poison_rank]
+    if not rep.get("halted"):
+        raise DrillFailure(
+            f"victim rank {poison_rank} never halted: {rep!r}")
+    detected = rep.get("detected_step")
+    if detected is None or \
+            not poison_step < detected <= poison_step + cadence:
+        raise DrillFailure(
+            f"detection at step {detected} is outside one cadence "
+            f"window ({poison_step}, {poison_step + cadence}] of the "
+            f"injection")
+    last = rep.get("last_divergence") or {}
+    if last.get("rank") != poison_rank:
+        raise DrillFailure(
+            f"victim's own verdict names rank {last.get('rank')!r}, "
+            f"expected {poison_rank}")
+    named = last.get("tensor")
+    if not named or not (named.startswith("param::")
+                         or named.startswith("opt")):
+        raise DrillFailure(
+            f"consensus named no fingerprinted tensor path: {named!r}")
+    fpath = rep.get("flight")
+    try:
+        with open(fpath, "r", encoding="utf-8") as f:
+            flight = json.load(f)
+    except (TypeError, OSError, ValueError) as e:
+        raise DrillFailure(
+            f"victim's flight dump unreadable at {fpath!r}: {e}") from e
+    reason = flight.get("reason") or ""
+    if not reason.startswith("sdc:divergence:") or named not in reason:
+        raise DrillFailure(
+            f"flight dump reason {reason!r} must pin the divergent "
+            f"tensor {named!r}")
+    if flight.get("process_index") != poison_rank:
+        raise DrillFailure(
+            f"flight dump identity {flight.get('process_index')!r} != "
+            f"victim rank {poison_rank}")
+    report.update({"detected_step": detected, "named_tensor": named,
+                   "flight_reason": reason})
+
+    # --- clean ranks: correct attribution, nothing else --------------
+    for r in range(world):
+        if r == poison_rank:
+            continue
+        rep = ranks[r]
+        if rep.get("halted"):
+            raise DrillFailure(f"clean rank {r} halted")
+        div = rep.get("divergences") or {}
+        if list(div) != [str(poison_rank)]:
+            raise DrillFailure(
+                f"clean rank {r} booked verdicts against {sorted(div)}"
+                f", expected exactly [{poison_rank!r}] — consensus "
+                f"must finger the victim and nobody else")
+        peer_last = rep.get("last_divergence") or {}
+        if peer_last.get("rank") != poison_rank:
+            raise DrillFailure(
+                f"clean rank {r} attributes the divergence to rank "
+                f"{peer_last.get('rank')!r}, expected {poison_rank}")
+
+
+def _assert_sdc_quarantine(report, snap, *, poison_rank, threshold,
+                           world):
+    """Assertions for the quarantine scenario."""
+    final_rcs = snap.get("final_rcs") or {}
+    if not final_rcs or any(rc != 0 for rc in final_rcs.values()):
+        raise DrillFailure(
+            f"quarantine: final generation rcs {final_rcs}, expected "
+            f"a clean downsized fleet (all 0)")
+    if snap.get("quarantined_ranks") != [poison_rank]:
+        raise DrillFailure(
+            f"quarantine: quarantined_ranks "
+            f"{snap.get('quarantined_ranks')}, expected "
+            f"[{poison_rank}]")
+    verdicts = (snap.get("sdc_verdicts") or {}).get(str(poison_rank), 0)
+    if verdicts < threshold:
+        raise DrillFailure(
+            f"quarantine: only {verdicts} consensus verdicts against "
+            f"rank {poison_rank}, expected >= {threshold}")
+    by_cause = snap.get("restarts_by_cause") or {}
+    if by_cause.get("sdc", 0) < threshold:
+        raise DrillFailure(
+            f"quarantine: restarts_by_cause {by_cause} books "
+            f"{by_cause.get('sdc', 0)} 'sdc' restarts, expected >= "
+            f"{threshold} — EXIT_SDC must charge the hardware ledger")
+    if any(c in by_cause for c in ("crashed", "killed")):
+        raise DrillFailure(
+            f"quarantine: consensus verdicts leaked into the "
+            f"code-crash budget: {by_cause}")
+    quarantine_resizes = [rz for rz in snap.get("resizes") or []
+                          if rz.get("quarantined")]
+    if not quarantine_resizes or \
+            quarantine_resizes[0].get("dead_ranks") != [poison_rank]:
+        raise DrillFailure(
+            f"quarantine: no elastic downsize around rank "
+            f"{poison_rank}: {snap.get('resizes')!r}")
+    if snap.get("world") != world - 1:
+        raise DrillFailure(
+            f"quarantine: final world {snap.get('world')}, expected "
+            f"{world - 1} (the suspect host left the pool)")
+
+
+def _run_sdc_restore_leg(root, report, *, steps, bit, barrier_timeout,
+                         gen_timeout, _log):
+    """The restore scenario: clean run → poison_shard → manifest still
+    verifies → full integrity refuses naming the leaf → resuming
+    worker exits ``EXIT_SDC``."""
+    ckpt_root = os.path.join(root, "ckpt")
+    os.makedirs(ckpt_root, exist_ok=True)
+    try:
+        p = spawn_worker(0, 1, root=ckpt_root, total_steps=steps,
+                         run_id=f"sdcr-{uuid.uuid4().hex[:6]}",
+                         barrier_timeout=barrier_timeout,
+                         log_path=_log("sdc_restore_g0.log"))
+        rcs = _wait_fleet([p], gen_timeout)
+        if rcs != [0]:
+            raise DrillFailure(
+                f"restore: clean generation exited {rcs}, expected [0]")
+        latest = _latest_step(ckpt_root)
+        if latest != steps:
+            raise DrillFailure(
+                f"restore: newest committed step {latest}, wanted "
+                f"{steps}")
+        d = os.path.join(ckpt_root, f"step_{int(latest):08d}")
+        verify_checkpoint(d, integrity="full")  # clean before poison
+        rel = poison_shard(d, bit=bit)
+        report["poisoned_file"] = rel
+        leaf = rel.split(os.sep)[1] if rel.count(os.sep) >= 2 else rel
+        # the sealed manifest CRC passes — the corruption is silent at
+        # the file level...
+        verify_checkpoint(d, integrity="size")
+        if read_leaf(d, leaf, integrity="size") is None:
+            raise DrillFailure("restore: size-integrity read failed")
+        # ...and only the content digest refuses, naming the leaf
+        try:
+            verify_checkpoint(d, integrity="full")
+        except CheckpointCorruptError as e:
+            msg = str(e)
+            if "content digest" not in msg or f"'{leaf}'" not in msg:
+                raise DrillFailure(
+                    f"restore: refusal does not name the poisoned "
+                    f"leaf {leaf!r} and its digest: {msg!r}") from e
+            report["refusal"] = msg
+        else:
+            raise DrillFailure(
+                f"restore: poisoned checkpoint (file {rel!r}) passed "
+                f"full verification — the content digest caught "
+                f"nothing")
+        p = spawn_worker(0, 1, root=ckpt_root, total_steps=steps * 2,
+                         run_id=f"sdcr-{uuid.uuid4().hex[:6]}",
+                         barrier_timeout=barrier_timeout,
+                         restore_integrity="full",
+                         log_path=_log("sdc_restore_g1.log"))
+        rc = _wait_fleet([p], gen_timeout)[0]
+        report["resume_rc"] = rc
+        if rc != EXIT_SDC:
+            raise DrillFailure(
+                f"restore: resuming worker exited {rc}, expected "
+                f"EXIT_SDC ({EXIT_SDC}) — it must refuse to train on "
+                f"bit-rotted state")
+        latest2 = _latest_step(ckpt_root)
+        if latest2 != steps:
+            raise DrillFailure(
+                f"restore: refused resume advanced the checkpoint to "
+                f"{latest2} (was {steps}) — nothing may be written "
+                f"past a refused restore")
     finally:
         reap_all()
     return report

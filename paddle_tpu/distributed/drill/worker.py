@@ -56,15 +56,33 @@ Run as ``python -m paddle_tpu.distributed.drill.worker`` with the
    ``DRILL_OBS_TIMEOUT``) — the window in which the aggregator
    scrapes, a victim is SIGKILLed, masters respawn.  Obs workers also
    expose a deterministic ``pt_goodput_fraction`` (0.8 by synthetic
-   span construction) and ``DRILL_OBS_ANOMALIES=n`` scripted numerics
-   anomalies, feeding the aggregator's fleet-goodput series and
-   anomaly-storm alarm.
+   span construction), ``DRILL_OBS_ANOMALIES=n`` scripted numerics
+   anomalies, and ``DRILL_OBS_SDC=n`` scripted SDC consensus verdicts
+   (each fingering a fixed peer, halt disarmed), feeding the
+   aggregator's fleet-goodput series, anomaly-storm alarm, and
+   cluster SDC alarm.
  - ``DRILL_NUMERICS=1``: NaN-injection mode (:func:`_numerics_main`) —
    storeless.  Each rank trains a real captured MLP with the numerics
    monitor armed; ``DRILL_POISON_STEP``/``DRILL_POISON_RANK`` script
    the injection, ``DRILL_NUMERICS_CADENCE`` the read cadence,
    ``DRILL_NUMERICS_HALT=1`` the halt variant (clean exit 21), and the
    per-rank report lands in ``DRILL_NUMERICS_DIR``.
+ - ``DRILL_SDC=1``: silent-data-corruption mode (:func:`_sdc_main`).
+   Every rank trains the SAME captured MLP from the SAME seed — dp
+   replicas are bit-identical by construction — with the SDC sentry
+   armed (``DRILL_SDC_CADENCE``) and its fingerprint exchange wired to
+   the drill store.  At ``DRILL_POISON_STEP`` the victim
+   (``DRILL_POISON_RANK``; -1 = nobody) flips ONE mantissa bit
+   (``DRILL_SDC_BIT``) of its first parameter in the captured state —
+   a finite, silent corruption the numerics sentinel cannot see — and
+   the consensus vote must finger exactly that rank within one cadence
+   window; the victim exits ``EXIT_SDC`` after writing its report to
+   ``DRILL_SDC_DIR``, clean ranks book the verdict and run to
+   completion.
+ - ``DRILL_RESTORE_INTEGRITY`` (checkpoint mode): integrity level for
+   the resume-time ``read_leaf`` (default ``size``); ``full`` also
+   recomputes the per-leaf content digests, and a digest refusal —
+   corruption the file CRC was sealed over — exits ``EXIT_SDC``.
  - ``DRILL_OOM=1``: OOM-postmortem mode (:func:`_oom_main`) —
    storeless.  Each rank trains a real captured MLP with the memory
    monitor armed and feeds a rank-scaled synthetic allocator watermark
@@ -93,7 +111,10 @@ move is to exit and await relaunch); 19 = the store master stayed
 unreachable or was generation-fenced (StoreUnavailableError — the
 clean degradation the failover drills assert); 21 = the numerics
 sentinel halted the run (PT_NUMERICS_HALT — the clean stop the NaN
-drill asserts); SIGKILL death reports -9 to the runner.
+drill asserts); 25 = replica consensus fingered this rank's state as
+silently corrupt, or a restore-time content digest refused a
+bit-rotted checkpoint (EXIT_SDC); SIGKILL death reports -9 to the
+runner.
 """
 from __future__ import annotations
 
@@ -106,7 +127,7 @@ import time
 import numpy as np
 
 from ..exit_codes import (EXIT_NUMERICS_HALT, EXIT_OOM,  # noqa: F401
-                          EXIT_SAVE_FAILED, EXIT_STORE_LOST)
+                          EXIT_SAVE_FAILED, EXIT_SDC, EXIT_STORE_LOST)
 
 ROWS, COLS = 12, 4
 
@@ -199,6 +220,19 @@ def _obs_main(env, rank, world, total, run_id):
             for _ in range(n_anoms):
                 mon.record_anomaly("drill", tensor="drill::w",
                                    halt_ok=False)
+        n_sdc = int(env.get("DRILL_OBS_SDC", "0"))
+        if n_sdc:
+            # scripted SDC consensus verdicts: books the same
+            # pt_sdc_divergence_total counter the fingerprint vote
+            # books, fingering a fixed PEER (never self — no halt, no
+            # flight dump), so the aggregator's cluster SDC alarm is
+            # assertable without a real bit flip
+            from ...observability.sdc import get_monitor as sdc_monitor
+            smon = sdc_monitor().enable(rank=rank, halt=False)
+            for k in range(n_sdc):
+                smon.record_divergence((rank + 1) % max(world, 2),
+                                       tensor="drill::w", step=k,
+                                       world=world)
         n_shed = int(env.get("DRILL_OBS_SHED", "0"))
         n_served = int(env.get("DRILL_OBS_SERVED", "0"))
         if n_shed or n_served:
@@ -390,6 +424,176 @@ def _numerics_main(env, rank, world, total, run_id):
     logger.info("numerics drill: detected_step=%s anomalies=%s",
                 detected_step, snap["anomalies"])
     sys.exit(EXIT_NUMERICS_HALT if halted else 0)
+
+
+def flip_bit(array, bit=0, index=0):
+    """Return a copy of ``array`` with exactly one bit flipped.
+
+    ``index`` addresses a flat element, ``bit`` a bit inside that
+    element's raw bytes (0 = LSB of its first byte, so ``bit`` ranges
+    over ``itemsize * 8``).  Deterministic by construction — the same
+    (bit, index) always flips the same physical bit — which is what
+    the SDC drill needs to prove one-cadence-window detection latency.
+    The input is never mutated; dtype, shape and every other bit are
+    preserved exactly.  Canonical here (the drill worker is the one
+    consumer that cannot import tests/); re-exported by
+    tests/fault_injection.py for the digest and consensus unit tests.
+    """
+    a = np.ascontiguousarray(array)
+    index = int(index) % max(a.size, 1)
+    nbits = a.itemsize * 8
+    bit = int(bit) % nbits
+    raw = bytearray(a.tobytes())
+    byte_off = index * a.itemsize + bit // 8
+    raw[byte_off] ^= 1 << (bit % 8)
+    return np.frombuffer(bytes(raw), dtype=a.dtype).reshape(a.shape)
+
+
+def sdc_report_path(out_dir, rank):
+    """Per-rank SDC-drill report (consensus evidence JSON)."""
+    return os.path.join(out_dir, f"sdc_report-{rank}.json")
+
+
+def _sdc_main(env, rank, world, total, run_id):
+    """Silent-data-corruption drill mode (``DRILL_SDC=1``).
+
+    Unlike the numerics drill — which seeds every rank DIFFERENTLY to
+    prove per-rank isolation — this mode seeds every rank the SAME, so
+    the fleet is a genuine set of dp replicas: bit-identical params,
+    optimizer slots and inputs on every rank, every step.  The only
+    divergence the drill can possibly produce is the one it injects:
+    at ``DRILL_POISON_STEP`` the victim flips one low mantissa bit of
+    its first parameter inside the captured state (:func:`flip_bit` on
+    the live leaf — same shape and dtype, so the capture cache must
+    NOT retrace), a corruption that is finite everywhere and invisible
+    to the numerics sentinel.  The SDC fingerprints disagree from that
+    step's packet on; the consensus vote (exchanged through the drill
+    store) must finger exactly the victim within one cadence window,
+    name the divergent tensor, pin a flight dump, and halt the victim
+    into a clean ``EXIT_SDC`` — the exit the supervisor charges to
+    hardware.  Clean ranks book the verdict, drop the exchange (the
+    dead peer is the supervisor's department) and run to completion.
+    """
+    out_dir = env["DRILL_SDC_DIR"]
+    poison_step = int(env.get("DRILL_POISON_STEP", "-1"))
+    poison_rank = int(env.get("DRILL_POISON_RANK", "-1"))
+    cadence = int(env.get("DRILL_SDC_CADENCE", "4"))
+    bit = int(env.get("DRILL_SDC_BIT", "3"))
+    exch_timeout = float(env.get("DRILL_SDC_EXCHANGE_TIMEOUT", "30"))
+
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+    from ...observability.sdc import (SdcHaltError, get_monitor,
+                                      store_exchange)
+    from ...observability.trace import get_tracer
+    from ..resilient_store import ResilientStore
+
+    endpoint_file = env.get("DRILL_ENDPOINT_FILE")
+    if endpoint_file:
+        store = ResilientStore(
+            endpoint_file=endpoint_file,
+            deadline=float(env.get("DRILL_STORE_DEADLINE",
+                                   str(exch_timeout))))
+    else:
+        from ...core import TCPStore
+        store = TCPStore("127.0.0.1",
+                         int(env.get("DRILL_STORE_PORT", "0")),
+                         is_master=False, timeout=exch_timeout + 30.0)
+
+    mon = get_monitor().enable(
+        cadence=cadence, halt=True, rank=rank,
+        exchange=store_exchange(store, run_id, rank, world,
+                                timeout=exch_timeout))
+    tr = get_tracer()  # enabled iff the runner set PT_FLIGHT_RECORDER
+
+    # IDENTICAL seeds everywhere: the replica-consensus precondition
+    np.random.seed(0)
+    pt.seed(0)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    opt = pt.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                parameters=model.parameters())
+    mse = nn.MSELoss()
+
+    @pt.jit.capture_step
+    def step(x, y):
+        loss = mse(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    x = pt.to_tensor(np.random.randn(4, 8).astype(np.float32))
+    y = pt.to_tensor(np.random.randn(4, 1).astype(np.float32))
+    detected_step = None
+    poisoned_tensor = None
+    halted = False
+    for s in range(1, total + 1):
+        if rank == poison_rank and s == poison_step \
+                and step._state is not None:
+            # flip one bit of the first captured parameter leaf — the
+            # SDC model: corruption lands in device state, not in code
+            st = step._state
+            name = sorted(st.params)[0]
+            st.params[name] = flip_bit(np.asarray(st.params[name]),
+                                       bit=bit, index=0)
+            poisoned_tensor = f"param::{name}"
+            logger.info("flipped bit %d of %s before step %d",
+                        bit, name, s)
+        try:
+            step(x, y)
+        except SdcHaltError as e:
+            logger.info("sdc halt at step %d: %s", s, e)
+            halted = True
+            detected_step = s
+            break
+        if detected_step is None and mon.divergence_count():
+            # a clean rank's vote fingered the victim; stop exchanging
+            # — the fingered rank is halting and will publish no more
+            detected_step = s
+            mon.exchange = None
+    if detected_step is None:
+        try:
+            mon.flush()  # end-of-run vote covers runs under one cadence
+        except SdcHaltError as e:
+            logger.info("sdc halt at flush: %s", e)
+            halted = True
+            detected_step = total
+        if detected_step is None and mon.divergence_count():
+            detected_step = total
+    try:
+        store.close()
+    except Exception as e:
+        # the exchange may already have torn the connection down after
+        # a halt — worth a breadcrumb, never worth failing the report
+        logger.debug("sdc drill: store close after run: %s", e)
+    snap = mon.snapshot()
+    report = {
+        "rank": rank,
+        "world": world,
+        "steps": total,
+        "poison_step": poison_step if rank == poison_rank else None,
+        "poison_bit": bit if rank == poison_rank else None,
+        "poisoned_tensor": poisoned_tensor,
+        "cadence": cadence,
+        "halted": halted,
+        "detected_step": detected_step,
+        "divergences": snap["divergences"],
+        "divergences_total": snap["divergences_total"],
+        "last_divergence": snap["last_divergence"],
+        "reads": snap["reads"],
+        "votes": snap["votes"],
+        "compiles": step.stats["compiles"],
+        "fallback": step.stats["fallback"],
+        "flight": tr.flight_path if tr.enabled else None,
+    }
+    path = sdc_report_path(out_dir, rank)
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(report, f)
+    os.replace(tmp, path)
+    logger.info("sdc drill: detected_step=%s divergences=%s",
+                detected_step, snap["divergences"])
+    sys.exit(EXIT_SDC if halted else 0)
 
 
 def oom_report_path(out_dir, rank):
@@ -585,6 +789,9 @@ def main():
     if env.get("DRILL_OOM") == "1":
         _oom_main(env, rank, world, total, run_id)
         return  # unreachable (_oom_main exits), defensive only
+    if env.get("DRILL_SDC") == "1":
+        _sdc_main(env, rank, world, total, run_id)
+        return  # unreachable (_sdc_main exits), defensive only
 
     # arm the scripted kill BEFORE any checkpoint machinery runs
     from . import injector
@@ -605,7 +812,8 @@ def main():
             tracer = t
 
     from ...core import TCPStore
-    from ..checkpoint import HostLocalShard, read_leaf
+    from ..checkpoint import (CheckpointCorruptError, HostLocalShard,
+                              read_leaf)
     from ..checkpoint_manager import CheckpointManager
     from ..resilient_store import ResilientStore, StoreUnavailableError
 
@@ -658,9 +866,18 @@ def main():
         # numpy-only window restore: re-shards whatever world size
         # wrote the checkpoint into THIS rank's rows
         d = mgr.step_dir(start)
-        w = read_leaf(d, "w", window=[[lo, hi], [0, COLS]],
-                      elastic=elastic)
-        bias = read_leaf(d, "bias", elastic=elastic)
+        integrity = env.get("DRILL_RESTORE_INTEGRITY") or "size"
+        try:
+            w = read_leaf(d, "w", window=[[lo, hi], [0, COLS]],
+                          elastic=elastic, integrity=integrity)
+            bias = read_leaf(d, "bias", elastic=elastic,
+                             integrity=integrity)
+        except CheckpointCorruptError as e:
+            # a content digest caught bit-rot the file CRC was sealed
+            # over — refusing to resume from corrupt state IS the SDC
+            # sentry's restore-side half
+            logger.error("restore of step %d refused: %s", start, e)
+            sys.exit(EXIT_SDC)
         logger.info("resumed from committed step %d", start)
 
     for step in range(start + 1, total + 1):

@@ -26,6 +26,9 @@ supervisor must be importable without jax.
                             (PT_NUMERICS_HALT)
  EXIT_OOM              23   allocator exhaustion surfaced and the memory
                             postmortem was booked
+ EXIT_SDC              25   cross-replica consensus fingered this rank's
+                            state as silently corrupt (bit-level replica
+                            divergence, no non-finite trip)
  EXIT_WATCHDOG         70   the serve hang watchdog force-exited a wedged
                             process (BSD EX_SOFTWARE)
  EXIT_TEMPFAIL         75   a preemption save FAILED; the relaunch falls
@@ -41,8 +44,9 @@ from __future__ import annotations
 
 __all__ = [
     "EXIT_OK", "EXIT_SAVE_FAILED", "EXIT_STORE_LOST",
-    "EXIT_NUMERICS_HALT", "EXIT_OOM", "EXIT_WATCHDOG", "EXIT_TEMPFAIL",
-    "EXIT_DRAIN", "classify", "describe", "RESTARTABLE_CAUSES",
+    "EXIT_NUMERICS_HALT", "EXIT_OOM", "EXIT_SDC", "EXIT_WATCHDOG",
+    "EXIT_TEMPFAIL", "EXIT_DRAIN", "classify", "describe",
+    "RESTARTABLE_CAUSES",
 ]
 
 EXIT_OK = 0
@@ -50,6 +54,7 @@ EXIT_SAVE_FAILED = 17
 EXIT_STORE_LOST = 19
 EXIT_NUMERICS_HALT = 21
 EXIT_OOM = 23
+EXIT_SDC = 25
 EXIT_WATCHDOG = 70
 EXIT_TEMPFAIL = 75
 EXIT_DRAIN = 143
@@ -60,6 +65,7 @@ _CAUSES = {
     EXIT_STORE_LOST: "store_lost",
     EXIT_NUMERICS_HALT: "numerics_halt",
     EXIT_OOM: "oom",
+    EXIT_SDC: "sdc",
     EXIT_WATCHDOG: "watchdog",
     EXIT_TEMPFAIL: "tempfail",
     EXIT_DRAIN: "drain",
@@ -74,6 +80,9 @@ _DESCRIPTIONS = {
                   "deadline or generation-fenced as amnesiac",
     "numerics_halt": "numerics sentinel halted the run",
     "oom": "allocator exhaustion (memory postmortem booked)",
+    "sdc": "cross-replica consensus fingered this rank's state as "
+           "silently corrupt (bit-level divergence from the replica "
+           "majority); suspect hardware, not code",
     "watchdog": "hang watchdog force-exited a wedged process",
     "tempfail": "preemption save failed (EX_TEMPFAIL); relaunch falls "
                 "back to an older checkpoint",
@@ -88,7 +97,7 @@ _DESCRIPTIONS = {
 #: preemption without notice looks like.
 RESTARTABLE_CAUSES = frozenset({
     "save_failed", "store_lost", "watchdog", "tempfail", "drain",
-    "killed", "oom",
+    "killed", "oom", "sdc",
 })
 
 

@@ -64,6 +64,13 @@ logger = logging.getLogger(__name__)
 DEFAULT_MAX_RESTARTS = 5
 #: rolling-window length (seconds) for the restart budget
 DEFAULT_RESTART_WINDOW = 300.0
+#: hardware budget: EXIT_SDC verdicts are charged to a SEPARATE
+#: per-rank ledger — a chip flipping bits is not a code crash, and one
+#: must not eat the other's budget
+DEFAULT_SDC_MAX_RESTARTS = 3
+#: consensus verdicts against one rank before it is quarantined and the
+#: fleet downsizes around it
+DEFAULT_SDC_QUARANTINE_THRESHOLD = 2
 
 _STORE_MASTER_SCRIPT = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "drill", "store_master.py")
@@ -316,12 +323,21 @@ class Supervisor:
     diagnostic, surfaced on :class:`RestartBudgetExhausted` and in
     :meth:`snapshot`) so the operator knows it is a *data* problem,
     not a host problem.
+
+    ``EXIT_SDC`` verdicts get the mirror-image treatment on the
+    *hardware* side: they are charged to a separate per-rank ledger
+    (``sdc_max_restarts``, never mixed with code-crash charges), and a
+    rank fingered ``sdc_quarantine_threshold`` times by replica
+    consensus is quarantined — a named ``RankQuarantine`` diagnostic,
+    after which the next generation elastically downsizes around the
+    suspect host exactly like an expired spawn lease.
     """
 
     def __init__(self, spawn, world, *,
                  max_restarts=None, restart_window=None,
                  min_world=1, spawn_lease=5.0,
                  shard_of=None, quarantine_threshold=3,
+                 sdc_max_restarts=None, sdc_quarantine_threshold=None,
                  grace=20.0, kill_grace=10.0, generation_timeout=None,
                  store_guard=None, poll_interval=0.1,
                  backoff_base=0.05, backoff_factor=2.0, backoff_max=1.0,
@@ -333,6 +349,14 @@ class Supervisor:
         if restart_window is None:
             restart_window = float(os.environ.get(
                 "PT_SUPERVISOR_RESTART_WINDOW", str(DEFAULT_RESTART_WINDOW)))
+        if sdc_max_restarts is None:
+            sdc_max_restarts = int(os.environ.get(
+                "PT_SUPERVISOR_SDC_MAX_RESTARTS",
+                str(DEFAULT_SDC_MAX_RESTARTS)))
+        if sdc_quarantine_threshold is None:
+            sdc_quarantine_threshold = int(os.environ.get(
+                "PT_SUPERVISOR_SDC_QUARANTINE_THRESHOLD",
+                str(DEFAULT_SDC_QUARANTINE_THRESHOLD)))
         self._spawn = spawn
         self.world = int(world)
         self.max_restarts = int(max_restarts)
@@ -341,6 +365,8 @@ class Supervisor:
         self.spawn_lease = float(spawn_lease)
         self.shard_of = shard_of if shard_of is not None else str
         self.quarantine_threshold = int(quarantine_threshold)
+        self.sdc_max_restarts = int(sdc_max_restarts)
+        self.sdc_quarantine_threshold = int(sdc_quarantine_threshold)
         self.grace = float(grace)
         self.kill_grace = float(kill_grace)
         self.generation_timeout = generation_timeout
@@ -353,10 +379,14 @@ class Supervisor:
                                       factor=backoff_factor,
                                       max_delay=backoff_max,
                                       clock=clock)
-        # budget ledgers: key is a rank (int) or "store"
+        # budget ledgers: key is a rank (int), "store", or "sdc:<rank>"
+        # (the hardware ledger — EXIT_SDC charges never share a key
+        # with code-crash charges)
         self._failures = collections.defaultdict(collections.deque)
         self._shard_failures = collections.Counter()
+        self._sdc_failures = collections.Counter()  # rank -> verdicts
         self.quarantined_shards = set()
+        self.quarantined_ranks = set()
         self.restarts = collections.Counter()  # cause -> count
         self.resizes = []
         self.generation = 0
@@ -494,7 +524,10 @@ class Supervisor:
     def _charge(self, rank, rc, cause):
         """Charge one failure against the budget; raises
         :class:`RestartBudgetExhausted` when the rolling window
-        overflows."""
+        overflows.  Returns the rank to quarantine when this charge
+        crossed the SDC consensus threshold (else ``None``)."""
+        if cause == "sdc":
+            return self._charge_sdc(rank, rc)
         key = "store" if cause == "store_lost" else rank
         now = self._clock()
         dq = self._failures[key]
@@ -530,6 +563,44 @@ class Supervisor:
             raise RestartBudgetExhausted(
                 msg, rank=None if key == "store" else rank,
                 shard=quarantined, cause=cause)
+        return None
+
+    def _charge_sdc(self, rank, rc):
+        """Charge an ``EXIT_SDC`` verdict to the *hardware* ledger.
+
+        Consensus verdicts never touch the code-crash budget (a flaky
+        chip must not exhaust a rank's crash allowance, nor hide behind
+        it); instead each verdict accrues toward quarantine, and a rank
+        fingered ``sdc_quarantine_threshold`` times is handed back to
+        :meth:`run` for an elastic downsize around the suspect host."""
+        now = self._clock()
+        dq = self._failures[f"sdc:{rank}"]
+        dq.append(now)
+        while dq and now - dq[0] > self.restart_window:
+            dq.popleft()
+        self._sdc_failures[rank] += 1
+        verdicts = self._sdc_failures[rank]
+        if (rank not in self.quarantined_ranks
+                and verdicts >= self.sdc_quarantine_threshold):
+            self.quarantined_ranks.add(rank)
+            logger.error(
+                "RankQuarantine: rank %d quarantined — fingered by "
+                "replica consensus %d times (%s); silent data "
+                "corruption is a hardware fault, and the next "
+                "generation downsizes around the suspect host",
+                rank, verdicts, describe(rc))
+            _inc_counter("pt_supervisor_rank_quarantines_total",
+                         "Ranks quarantined after repeated SDC "
+                         "consensus verdicts")
+            return rank
+        if len(dq) > self.sdc_max_restarts:
+            raise RestartBudgetExhausted(
+                f"hardware restart budget exhausted: rank {rank} was "
+                f"fingered by replica consensus {len(dq)} times inside "
+                f"{self.restart_window:.0f}s (sdc budget "
+                f"{self.sdc_max_restarts}); last exit {describe(rc)}",
+                rank=rank, cause="sdc")
+        return None
 
     # -- main loop ----------------------------------------------------------
 
@@ -571,7 +642,26 @@ class Supervisor:
                 "generation %d failed: root cause rank %d exited %s "
                 "(full rcs: %s)", self.generation, rank, describe(rc),
                 {r: rcs[r] for r in sorted(rcs)})
-            self._charge(rank, rc, cause)
+            quarantine = self._charge(rank, rc, cause)
+            if quarantine is not None:
+                new_world = world - 1
+                if new_world < self.min_world:
+                    raise RestartBudgetExhausted(
+                        f"cannot downsize below min_world="
+                        f"{self.min_world}: rank {quarantine} is "
+                        f"quarantined after repeated SDC consensus "
+                        f"verdicts at world={world}",
+                        rank=quarantine, cause="sdc")
+                logger.warning(
+                    "generation %d: quarantined rank %d absorbed by "
+                    "elastic downsize; relaunching survivors at "
+                    "world=%d", self.generation, quarantine, new_world)
+                self.resizes.append({"generation": self.generation,
+                                     "from_world": world,
+                                     "to_world": new_world,
+                                     "dead_ranks": [quarantine],
+                                     "quarantined": True})
+                world = self.world = new_world
             self._sleep(next(self._delays))
             outage = max(0.0, self._clock() - fail_t)
             self._book_restart(cause, outage)
@@ -602,6 +692,9 @@ class Supervisor:
             "promotions": (self.store_guard.promotions
                            if self.store_guard is not None else 0),
             "quarantined_shards": sorted(self.quarantined_shards),
+            "quarantined_ranks": sorted(self.quarantined_ranks),
+            "sdc_verdicts": {str(r): n
+                             for r, n in sorted(self._sdc_failures.items())},
             "resizes": list(self.resizes),
             "restart_replay_seconds": round(self.replay_seconds, 6),
         }
@@ -630,6 +723,8 @@ def supervision_snapshot():
         "restarts_by_cause": {},
         "promotions": 0,
         "quarantined_shards": [],
+        "quarantined_ranks": [],
+        "sdc_verdicts": {},
         "resizes": [],
         "restart_replay_seconds": 0.0,
     }

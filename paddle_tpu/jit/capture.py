@@ -146,7 +146,8 @@ class _LiveState:
 class _Entry:
     __slots__ = ("jitted", "struct", "traced_idx", "sg_flags", "statics",
                  "n_leaves", "sig", "name", "ran", "flops", "fusion",
-                 "memory", "monitored", "monitor_names", "pure", "audit")
+                 "memory", "monitored", "monitor_names", "sdc",
+                 "sdc_names", "pure", "audit")
 
 
 class CapturedStep:
@@ -363,6 +364,12 @@ class CapturedStep:
         mon = _numerics.get_monitor()
         mon = mon if mon.enabled else None
         mon_box = []  # filled with the tensor-name tuple during trace
+        # SDC sentry: same per-entry bake as the numerics sentinel —
+        # the replica fingerprint vector rides the same program
+        from ..observability import sdc as _sdc
+        smon = _sdc.get_monitor()
+        smon = smon if smon.enabled else None
+        sdc_box = []  # filled with the fingerprint-name tuple during trace
 
         def pure(params, buffers, opt_states, ctr, lrs, traced):
             key = jax.random.fold_in(rng_base, ctr)
@@ -414,34 +421,56 @@ class CapturedStep:
                     is_leaf=lambda t: isinstance(t, Tensor))
                 new_params = {n: t._data for n, t in p_tensors.items()}
                 new_buffers = {n: t._data for n, t in b_tensors.items()}
-                if mon is None:
-                    return (out_arrays, new_params, new_buffers,
-                            new_opt_states)
-                # first scalar inexact output is treated as the loss
-                loss = None
-                for leaf in jax.tree_util.tree_leaves(out_arrays):
-                    if (hasattr(leaf, "dtype") and hasattr(leaf, "size")
-                            and leaf.size == 1
-                            and jnp.issubdtype(leaf.dtype, jnp.inexact)):
-                        loss = leaf
-                        break
-                # flag the UPDATED parameters, not the raw grads: the
-                # new params are already materialized program outputs,
-                # so their per-tensor reductions extend no intermediate
-                # lifetimes (grad-side reductions measurably inhibit
-                # XLA's backward/update fusion), a non-finite grad
-                # corrupts its param in this same step (same detection
-                # latency, same parameter-path naming), and state
-                # corruption — what persists into every later step — is
-                # the thing worth naming. The explosion detector still
-                # watches the true grad norm via norm_over.
-                monitored = {n: new_params[n] for n in mon_grads}
-                mnames, health = _numerics.health_outputs(
-                    monitored, loss=loss, with_stats=mon.stats_on,
-                    norm_over=mon_grads)
-                mon_box[:] = [mnames]
-                return (out_arrays, new_params, new_buffers,
-                        new_opt_states, health)
+                ret = [out_arrays, new_params, new_buffers,
+                       new_opt_states]
+                if mon is not None:
+                    # first scalar inexact output is treated as the loss
+                    loss = None
+                    for leaf in jax.tree_util.tree_leaves(out_arrays):
+                        if (hasattr(leaf, "dtype")
+                                and hasattr(leaf, "size")
+                                and leaf.size == 1
+                                and jnp.issubdtype(leaf.dtype,
+                                                   jnp.inexact)):
+                            loss = leaf
+                            break
+                    # flag the UPDATED parameters, not the raw grads:
+                    # the new params are already materialized program
+                    # outputs, so their per-tensor reductions extend no
+                    # intermediate lifetimes (grad-side reductions
+                    # measurably inhibit XLA's backward/update fusion),
+                    # a non-finite grad corrupts its param in this same
+                    # step (same detection latency, same parameter-path
+                    # naming), and state corruption — what persists
+                    # into every later step — is the thing worth
+                    # naming. The explosion detector still watches the
+                    # true grad norm via norm_over.
+                    monitored = {n: new_params[n] for n in mon_grads}
+                    mnames, health = _numerics.health_outputs(
+                        monitored, loss=loss, with_stats=mon.stats_on,
+                        norm_over=mon_grads)
+                    mon_box[:] = [mnames]
+                    ret.append(health)
+                if smon is not None:
+                    # replica fingerprints cover the persistent state a
+                    # flipped bit would poison: every updated param plus
+                    # every optimizer slot / master weight — all already
+                    # materialized program outputs, so the digests cost
+                    # one fused reduction each and extend no lifetimes
+                    fp_named = {f"param::{n}": a
+                                for n, a in new_params.items()}
+                    for oi, s in enumerate(new_opt_states):
+                        if not isinstance(s, dict):
+                            continue
+                        for slot, per in (s.get("slots") or {}).items():
+                            for n, a in per.items():
+                                fp_named[f"opt{oi}::{slot}::{n}"] = a
+                        for n, a in (s.get("master") or {}).items():
+                            fp_named[f"opt{oi}::master::{n}"] = a
+                    snames, fp = _sdc.fingerprint_outputs(fp_named)
+                    sdc_box[:] = [snames]
+                    ret.append(fp)
+                return tuple(ret)
             finally:
                 for t, d, g, nd in saved:
                     t._data, t._grad, t._node = d, g, nd
@@ -479,6 +508,8 @@ class CapturedStep:
         entry.memory = None
         entry.monitored = mon is not None
         entry.monitor_names = mon_box  # resolved after the first trace
+        entry.sdc = smon is not None
+        entry.sdc_names = sdc_box      # resolved after the first trace
         return entry
 
     # -- replay -------------------------------------------------------------
@@ -587,10 +618,10 @@ class CapturedStep:
                                time.perf_counter_ns())
         step_idx = st.rng_ctr
         st.rng_ctr += 1
-        if entry.monitored:
-            out_arrays, st.params, st.buffers, st.opt_states, health = outs
-        else:
-            out_arrays, st.params, st.buffers, st.opt_states = outs
+        outs = list(outs)
+        fp = outs.pop() if entry.sdc else None
+        health = outs.pop() if entry.monitored else None
+        out_arrays, st.params, st.buffers, st.opt_states = outs
         for name, t in st.param_tensors.items():
             t._data = st.params[name]
         for name, t in st.buffer_tensors.items():
@@ -621,6 +652,14 @@ class CapturedStep:
             m = _numerics.current_monitor()
             if m is not None and entry.monitor_names:
                 m.watch(step_idx, entry.monitor_names[0], health)
+        if entry.sdc:
+            # same discipline for the SDC fingerprint packet: held one
+            # dispatch behind, voted on at cadence boundaries. May
+            # raise SdcHaltError when consensus fingers this rank.
+            from ..observability import sdc as _sdc
+            sm = _sdc.current_monitor()
+            if sm is not None and entry.sdc_names:
+                sm.watch(step_idx, entry.sdc_names[0], fp)
         return jax.tree_util.tree_map(
             lambda a: Tensor(a) if _is_arraylike(a) else a, out_arrays)
 

@@ -26,6 +26,11 @@ and did something silently recompile?"* at runtime:
                       non-finite flags over loss/grads read at a
                       cadence, EWMA spike detectors, per-tensor stats,
                       ``pt_numerics_anomalies_total{kind}``
+ - :mod:`.sdc`        silent-data-corruption sentry: in-graph replica
+                      fingerprints (bit-pattern digests of updated
+                      params + optimizer slots) voted on across dp
+                      ranks — a minority rank is fingered as corrupt,
+                      ``pt_sdc_divergence_total{rank}``
  - :mod:`.goodput`    wall-clock goodput ledger over the tracer's
                       spans: ``pt_goodput_fraction`` +
                       ``pt_badput_seconds{cause}``
@@ -84,6 +89,12 @@ _NUMERICS_EXPORTS = ("NumericsMonitor", "NumericsHaltError",
 _GOODPUT_EXPORTS = ("GoodputLedger", "decompose_spans", "get_goodput",
                     "current_ledger", "reset_goodput")
 
+# SDC resolves lazily (get_monitor() consults PT_SDC on first call);
+# only the names that don't collide with numerics' are re-exported —
+# the monitor accessors live on paddle_tpu.observability.sdc itself.
+_SDC_EXPORTS = ("SdcMonitor", "SdcHaltError", "fingerprint_outputs",
+                "store_exchange")
+
 # Memory resolves lazily for the same reason: get_memory_monitor()
 # consults PT_MEMORY on first call, and the guarded allocator reads
 # must stay importable without dragging in a jax backend.
@@ -107,6 +118,9 @@ def __getattr__(name):
     if name in _GOODPUT_EXPORTS:
         from . import goodput
         return getattr(goodput, name)
+    if name in _SDC_EXPORTS:
+        from . import sdc
+        return getattr(sdc, name)
     if name in _MEMORY_EXPORTS:
         from . import memory
         return getattr(memory, name)
@@ -129,6 +143,8 @@ __all__ = [
     "get_monitor", "current_monitor", "reset_monitor",
     "GoodputLedger", "decompose_spans", "get_goodput",
     "current_ledger", "reset_goodput",
+    "SdcMonitor", "SdcHaltError", "fingerprint_outputs",
+    "store_exchange",
     "MemoryMonitor", "device_memory_stats", "device_memory_stat",
     "program_memory_analysis", "is_oom_error", "oom_postmortem",
     "get_memory_monitor", "current_memory_monitor",

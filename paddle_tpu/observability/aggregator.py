@@ -531,8 +531,8 @@ class ClusterAggregator:
 
     def __init__(self, *, endpoints=None, store=None, run_id="local",
                  stale_after=5.0, scrape_timeout=2.0, storm_threshold=1,
-                 anomaly_threshold=10, mem_threshold=0, serve_threshold=0.0,
-                 shed_threshold=0.0,
+                 anomaly_threshold=10, sdc_threshold=1, mem_threshold=0,
+                 serve_threshold=0.0, shed_threshold=0.0,
                  interval=1.0, drop_labels=("process_index",),
                  retention=3600.0, history_max_points=512):
         self.run_id = str(run_id)
@@ -542,6 +542,11 @@ class ClusterAggregator:
         self.scrape_timeout = float(scrape_timeout)
         self.storm_threshold = int(storm_threshold)
         self.anomaly_threshold = int(anomaly_threshold)
+        # silent-data-corruption trip: consensus divergence verdicts
+        # summed over fresh ranks at/over this flip /healthz to 503
+        # (0 disables).  Default 1 — a single fingered rank is already
+        # a hardware incident, not noise
+        self.sdc_threshold = int(sdc_threshold)
         # near-OOM trip: any rank's bytes_in_use at/over this flips
         # /healthz to 503 (0 disables — there is no portable default
         # limit, HBM size varies by device generation)
@@ -759,6 +764,21 @@ class ClusterAggregator:
               "1 while summed numerics anomalies >= the anomaly "
               "threshold", [((), 1 if anomaly_alarm else 0)])
 
+        # silent-data-corruption alarm: consensus fingerprint verdicts
+        # booked by ANY fresh rank (pt_sdc_divergence_total carries the
+        # fingered rank as a label; the sum counts verdicts fleet-wide)
+        sdc_total = sum(
+            _family_total(f, "pt_sdc_divergence_total")
+            for f in fresh.values())
+        sdc_alarm = (self.sdc_threshold > 0
+                     and sdc_total >= self.sdc_threshold)
+        counter("pt_cluster_sdc_divergences_total",
+                "SDC consensus divergence verdicts summed across ranks",
+                sdc_total)
+        gauge("pt_cluster_sdc_alarm",
+              "1 while summed SDC divergence verdicts >= the SDC "
+              "threshold", [((), 1 if sdc_alarm else 0)])
+
         # device-memory skew + the near-OOM trip: a rank whose
         # allocator is pinned at the limit stalls (or kills) every
         # synchronous step, and uneven bytes_in_use across an SPMD
@@ -896,12 +916,15 @@ class ClusterAggregator:
                     entry["goodput_fraction"] = round(goodputs[r], 6)
                 entry["numerics_anomalies"] = _family_total(
                     fresh[r], "pt_numerics_anomalies_total")
+                entry["sdc_divergences"] = _family_total(
+                    fresh[r], "pt_sdc_divergence_total")
                 if r in rank_mem:
                     entry["memory_bytes_in_use"] = int(rank_mem[r])
             ranks_health[str(r)] = entry
         health = {
-            "ok": (not alarm and not anomaly_alarm and not mem_alarm
-                   and not serve_alarm and not shed_alarm),
+            "ok": (not alarm and not anomaly_alarm and not sdc_alarm
+                   and not mem_alarm and not serve_alarm
+                   and not shed_alarm),
             "run_id": self.run_id,
             "ranks_discovered": len(self._endpoints),
             "ranks_up": len(fresh),
@@ -920,6 +943,9 @@ class ClusterAggregator:
             "numerics_anomalies_total": anomalies_total,
             "anomaly_alarm": anomaly_alarm,
             "anomaly_threshold": self.anomaly_threshold,
+            "sdc_divergences_total": sdc_total,
+            "sdc_alarm": sdc_alarm,
+            "sdc_threshold": self.sdc_threshold,
             "memory": {
                 "bytes_in_use_max": (int(mem_max)
                                      if mem_max is not None else None),
@@ -1120,6 +1146,12 @@ def main(argv=None):
                                      "10")),
                     help="summed numerics anomalies that flip /healthz "
                          "to 503 (0 disables the alarm)")
+    ap.add_argument("--sdc-threshold", type=int,
+                    default=int(_env("PT_AGGREGATOR_SDC_THRESHOLD",
+                                     "1")),
+                    help="summed SDC consensus divergence verdicts "
+                         "that flip /healthz to 503 (0 disables the "
+                         "alarm)")
     ap.add_argument("--mem-threshold", type=int,
                     default=int(_env("PT_AGGREGATOR_MEM_THRESHOLD",
                                      "0")),
@@ -1182,6 +1214,7 @@ def main(argv=None):
         scrape_timeout=args.scrape_timeout,
         storm_threshold=args.storm_threshold,
         anomaly_threshold=args.anomaly_threshold,
+        sdc_threshold=args.sdc_threshold,
         mem_threshold=args.mem_threshold,
         serve_threshold=args.serve_threshold,
         shed_threshold=args.shed_threshold,

@@ -1,0 +1,422 @@
+"""Silent-data-corruption sentry: cross-replica consensus fingerprints.
+
+The failure mode this module exists for: a flipped bit in a gradient,
+an optimizer slot or a parameter update corrupts training *silently* —
+the value is still finite, so the numerics sentinels never trip, the
+loss curve drifts instead of exploding, and by the time anyone notices
+the run has burned weeks on one bad chip.  At fleet scale this is the
+dominant unhandled fault class, and data parallelism already carries
+the oracle needed to catch it: dp-replicated ranks hold bit-identical
+params after gradient reduction, so any bit-level disagreement between
+replicas IS corruption, and a majority vote names the liar.
+
+The device-side half mirrors the numerics health packet exactly:
+:func:`fingerprint_outputs` folds one tiny fused reduction per updated
+tensor — the wraparound-mod-2^32 sum of the tensor's raw bits viewed
+as uint32 words, bitcast to int32 — into the captured step as extra
+program outputs.  One compile, bit-identical loss, no host sync on the
+hot path: the monitor reads the *previous* step's fingerprint vector
+at every ``PT_SDC_CADENCE``-th step, long after the device finished
+it.  Any single-bit flip in any element changes the word sum, and the
+per-tensor digest vector means the first divergent index names the
+first divergent parameter path.
+
+The host-side half compares fingerprints across dp ranks through a
+pluggable ``exchange`` callback (:func:`store_exchange` wires it to
+the coordination store for multi-process fleets; ``None`` leaves the
+monitor in standalone recording mode).  Majority vote over the digest
+vectors: a rank in the minority books
+``pt_sdc_divergence_total{rank}``, pins a flight dump (reason
+``sdc:divergence:<tensor>``) and — with halting armed, the default —
+raises :class:`SdcHaltError` so the worker can exit ``EXIT_SDC`` and
+the supervisor can charge the failure to hardware and quarantine the
+rank.  Majority ranks book the divergent rank's counter and keep
+training, so the cluster aggregator sees the divergence even after
+the bad rank dies.
+
+Contract (shared with the rest of ``observability``): zero cost while
+disabled, never sync the device on the hot path, never take down the
+run unless halting is armed, side-effect-free import.
+
+Environment:
+  - ``PT_SDC=1``           enable on first ``get_monitor()``
+  - ``PT_SDC_CADENCE=n``   host read cadence in steps (default 16)
+  - ``PT_SDC_HALT=0``      disarm the EXIT_SDC halt on self-divergence
+                           (armed by default: a corrupt rank must not
+                           keep training)
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+import zlib
+
+logger = logging.getLogger("paddle_tpu.observability.sdc")
+
+__all__ = [
+    "SdcMonitor",
+    "SdcHaltError",
+    "fingerprint_outputs",
+    "store_exchange",
+    "get_monitor",
+    "current_monitor",
+    "reset_monitor",
+]
+
+
+class SdcHaltError(RuntimeError):
+    """Raised from a monitored step when replica consensus fingered
+    THIS rank's state as corrupt and halting is armed; the worker's
+    designed response is ``sys.exit(EXIT_SDC)``."""
+
+
+def _digest(x):
+    """Device-side content digest of one tensor: the wraparound sum of
+    its raw bits viewed as uint32 words, bitcast to int32.
+
+    Any single-bit flip changes exactly one word, which changes the
+    mod-2^32 sum — so the digest is sensitive to every bit while
+    costing ONE fused reduction per tensor (the same budget as the
+    numerics sentinel's ``sum(x*x)``).  Bitcasting — never a value
+    cast — keeps the digest a statement about the bit pattern: two
+    NaNs with different payloads, or -0.0 vs +0.0, digest differently.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    x = jnp.asarray(x)
+    if x.dtype == jnp.bool_:
+        # bools are canonical 0/1; a value cast IS the bit pattern
+        words = x.astype(jnp.uint32)
+    elif x.dtype.itemsize == 1:
+        words = lax.bitcast_convert_type(x, jnp.uint8).astype(jnp.uint32)
+    elif x.dtype.itemsize == 2:
+        words = lax.bitcast_convert_type(x, jnp.uint16).astype(jnp.uint32)
+    else:
+        # 4-byte dtypes bitcast in place; 8-byte dtypes gain a trailing
+        # axis of two words — both reduce the same way
+        words = lax.bitcast_convert_type(x, jnp.uint32)
+    s = jnp.sum(words.astype(jnp.uint32), dtype=jnp.uint32)
+    return lax.bitcast_convert_type(s, jnp.int32)
+
+
+def fingerprint_outputs(named):
+    """Build the device-side fingerprint program over named arrays.
+
+    Called at *trace time* from inside a jitted step (capture's
+    ``pure``), exactly like ``numerics.health_outputs``: the returned
+    vector becomes one extra program output, so the fingerprint
+    compiles into the same executable — no second program, no extra
+    compile, loss untouched.
+
+    Returns ``(names, fp)`` where ``names`` is the host-side tuple
+    naming each slot (sorted paths) and ``fp`` is an ``int32[n]``
+    device array of per-tensor digests.  Keeping one digest per tensor
+    (rather than one per step) is what lets consensus name the FIRST
+    divergent parameter path, not just the divergent rank.
+    """
+    import jax.numpy as jnp
+
+    names = tuple(sorted(named))
+    digests = [_digest(named[name]) for name in names]
+    fp = (jnp.stack(digests) if digests
+          else jnp.zeros((0,), jnp.int32))
+    return names, fp
+
+
+class SdcMonitor:
+    """Host-side half of the sentry: holds the latest fingerprint
+    packet, materializes the previous one at cadence boundaries,
+    exchanges it with peer ranks, and runs the majority vote."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.enabled = False
+        self.cadence = 16
+        self.halt = True
+        self.exchange = None   # callable(step, digest_bytes) -> {rank: bytes}
+        self.rank = 0
+        self._metrics = None
+        self._reset_state()
+
+    def _reset_state(self):
+        self._pending = None          # (step, names, fp) latest packet
+        self._last_read_step = None
+        self._steps_observed = 0
+        self._reads = 0
+        self._votes = 0
+        self._divergences = {}        # rank -> count (this rank's view)
+        self._last_divergence = None  # {step, rank, tensor, world}
+        self._last_fingerprint = None # crc32 hex of the full vector
+
+    # -- lifecycle ---------------------------------------------------
+
+    def enable(self, cadence=None, halt=None, exchange=None, rank=None):
+        with self._lock:
+            self.enabled = True
+            if cadence is not None:
+                self.cadence = max(1, int(cadence))
+            if halt is not None:
+                self.halt = bool(halt)
+            if exchange is not None:
+                self.exchange = exchange
+            if rank is not None:
+                self.rank = int(rank)
+            self._make_metrics()
+        return self
+
+    def disable(self):
+        with self._lock:
+            self.enabled = False
+        return self
+
+    def _make_metrics(self):
+        if self._metrics is not None:
+            return
+        try:
+            from .metrics import get_registry
+            r = get_registry()
+            self._metrics = {
+                "divergences": r.counter(
+                    "pt_sdc_divergence_total",
+                    "Replica fingerprint divergences, by fingered rank",
+                    ("rank",)),
+            }
+        except Exception:  # metrics are optional plumbing
+            self._metrics = None
+
+    # -- hot path ----------------------------------------------------
+
+    def watch(self, step, names, fp):
+        """Per-step hook from the captured step's replay path.
+
+        Same asynchronous-read discipline as the numerics monitor: the
+        packet inspected at a cadence boundary is the *previous* one,
+        one full dispatch behind, so ``np.asarray`` finds the buffers
+        already materialized and never blocks the step.  Detection
+        latency is at most one cadence window.
+        """
+        if not self.enabled:
+            return
+        with self._lock:
+            prev = self._pending
+            self._pending = (int(step), names, fp)
+            self._steps_observed += 1
+            due = (prev is not None
+                   and (self._last_read_step is None
+                        or prev[0] - self._last_read_step >= self.cadence))
+        if due:
+            self._inspect(*prev)
+
+    def flush(self):
+        """Materialize and vote on the held packet now (end of run,
+        drills, tests). The one place a blocking read is acceptable."""
+        with self._lock:
+            pending, self._pending = self._pending, None
+        if pending is not None:
+            self._inspect(*pending)
+        return self
+
+    # -- consensus ---------------------------------------------------
+
+    def _inspect(self, step, names, fp):
+        import numpy as np
+
+        try:
+            vec = np.ascontiguousarray(np.asarray(fp), dtype=np.int32)
+        except Exception:
+            # a failed read must never take down the run
+            logger.debug("sdc fingerprint read failed", exc_info=True)
+            return
+        digest = vec.tobytes()
+        with self._lock:
+            self._last_read_step = step
+            self._reads += 1
+            self._last_fingerprint = format(
+                zlib.crc32(digest) & 0xFFFFFFFF, "08x")
+            exchange = self.exchange
+        if exchange is None:
+            return  # standalone recording mode (bench, single process)
+        try:
+            peers = exchange(step, digest)
+        except SdcHaltError:
+            raise
+        except Exception:
+            # a dead peer or store hiccup is a LOUD failure with its
+            # own recovery path; the sentry only judges what it can see
+            logger.warning("sdc fingerprint exchange failed at step %s",
+                           step, exc_info=True)
+            return
+        self._vote(step, names, vec, digest, dict(peers or {}))
+
+    def _vote(self, step, names, vec, digest, peers):
+        import numpy as np
+
+        peers.setdefault(self.rank, digest)
+        if len(peers) < 2:
+            return  # no quorum of one
+        tally = {}
+        for _r, d in peers.items():
+            tally[d] = tally.get(d, 0) + 1
+        majority = max(tally, key=lambda d: (tally[d], d))
+        with self._lock:
+            self._votes += 1
+        if tally[majority] <= len(peers) // 2:
+            # no strict majority: an even split names nobody — refuse
+            # to guess rather than quarantine half the fleet
+            logger.warning(
+                "sdc consensus inconclusive at step %s: %d distinct "
+                "fingerprints over %d ranks", step, len(tally), len(peers))
+            return
+        maj_vec = np.frombuffer(majority, dtype=np.int32)
+        for rank in sorted(peers):
+            if peers[rank] == majority:
+                continue
+            peer_vec = np.frombuffer(peers[rank], dtype=np.int32)
+            tensor = None
+            if peer_vec.shape == maj_vec.shape:
+                diff = np.nonzero(peer_vec != maj_vec)[0]
+                if diff.size and diff[0] < len(names):
+                    tensor = names[diff[0]]
+            self.record_divergence(rank, tensor=tensor, step=step,
+                                   world=len(peers))
+
+    # -- divergence sink ---------------------------------------------
+
+    def record_divergence(self, rank, tensor=None, step=None, world=None):
+        """Book one consensus verdict against ``rank``: host counter
+        (always), metric counter (when enabled), a warning naming the
+        rank and tensor — and, when the fingered rank is THIS process,
+        a flight dump plus :class:`SdcHaltError` if halting is armed.
+        """
+        rank = int(rank)
+        is_self = rank == self.rank
+        with self._lock:
+            self._divergences[rank] = self._divergences.get(rank, 0) + 1
+            first = self._divergences[rank] == 1
+            self._last_divergence = {
+                "step": step, "rank": rank, "tensor": tensor,
+                "world": world,
+            }
+            metrics = self._metrics if self.enabled else None
+        if metrics is not None:
+            try:
+                metrics["divergences"].inc(rank=str(rank))
+            except Exception:
+                pass
+        logger.warning(
+            "sdc divergence: rank=%s tensor=%s step=%s%s", rank, tensor,
+            step, " (this rank)" if is_self else "")
+        if not is_self:
+            return
+        # the flight dump pins the FIRST self-divergence: the most
+        # specific artifact — which tensor's bits disagree — recorded
+        # before the halt tears the process down
+        reason = "sdc:divergence:%s" % (tensor or "")
+        tr_mod = (sys.modules.get("paddle_tpu.observability.trace")
+                  if first else None)
+        if tr_mod is not None:
+            try:
+                tr = tr_mod.current_tracer()
+                if tr is not None and tr.enabled:
+                    tr.flight_dump(reason=reason)
+            except Exception:
+                pass
+        if self.halt:
+            raise SdcHaltError(
+                "sdc sentry: replica consensus fingered this rank "
+                "(process_index %s) as corrupt at step %s, first "
+                "divergent tensor %r" % (rank, step, tensor))
+
+    # -- reporting ---------------------------------------------------
+
+    def divergence_count(self, rank=None):
+        with self._lock:
+            if rank is not None:
+                return self._divergences.get(int(rank), 0)
+            return sum(self._divergences.values())
+
+    def snapshot(self):
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "cadence": self.cadence,
+                "halt": self.halt,
+                "rank": self.rank,
+                "steps_observed": self._steps_observed,
+                "reads": self._reads,
+                "votes": self._votes,
+                "divergences": {str(r): n
+                                for r, n in sorted(self._divergences.items())},
+                "divergences_total": sum(self._divergences.values()),
+                "last_divergence": (dict(self._last_divergence)
+                                    if self._last_divergence else None),
+                "last_fingerprint": self._last_fingerprint,
+            }
+
+
+def store_exchange(store, run_id, rank, world, timeout=30.0):
+    """Wire a monitor's ``exchange`` to the coordination store.
+
+    Each rank publishes its digest under an idempotent per-rank key
+    (``sdc/<run_id>/<step>/<rank>``, hex-encoded) and polls for every
+    peer's with a bounded wait — the all_gather of the fingerprint
+    vector, host-side.  A peer that dies before publishing surfaces as
+    a TimeoutError, which the monitor downgrades to a warning: dead
+    ranks are the supervisor's department, silent ones this module's.
+    """
+    rank = int(rank)
+    world = int(world)
+
+    def exchange(step, digest):
+        store.set("sdc/%s/%d/%d" % (run_id, step, rank), digest.hex())
+        out = {}
+        for r in range(world):
+            if r == rank:
+                out[r] = digest
+                continue
+            v = store.get("sdc/%s/%d/%d" % (run_id, step, r),
+                          wait=True, timeout=timeout)
+            if isinstance(v, bytes):
+                v = v.decode("ascii")
+            out[r] = bytes.fromhex(v)
+        return out
+
+    return exchange
+
+
+_monitor = None
+_monitor_lock = threading.Lock()
+
+
+def _truthy(v):
+    return str(v).lower() not in ("", "0", "false", "no", "off", "none")
+
+
+def get_monitor():
+    """Process singleton; first call applies PT_SDC_* env config."""
+    global _monitor
+    with _monitor_lock:
+        if _monitor is None:
+            _monitor = SdcMonitor()
+            if _truthy(os.environ.get("PT_SDC", "")):
+                _monitor.enable(
+                    cadence=os.environ.get("PT_SDC_CADENCE") or None,
+                    halt=_truthy(os.environ.get("PT_SDC_HALT", "1")),
+                )
+        return _monitor
+
+
+def current_monitor():
+    """The singleton if it exists, else None — read-only accessor that
+    never triggers env-based enablement (hot paths use this)."""
+    return _monitor
+
+
+def reset_monitor():
+    """Drop the singleton (tests)."""
+    global _monitor
+    with _monitor_lock:
+        _monitor = None

@@ -986,6 +986,8 @@ def reset():
     reset_tracer()  # its metric handles die with the registry below
     from .numerics import reset_monitor
     reset_monitor()
+    from .sdc import reset_monitor as reset_sdc_monitor
+    reset_sdc_monitor()
     from .goodput import reset_goodput
     reset_goodput()
     from .memory import reset_memory_monitor

@@ -1376,3 +1376,97 @@ class SignalHandlerInLibrary(Rule):
                        f"drain, launcher kill); only the sanctioned "
                        f"entrypoints install handlers — accept a "
                        f"callback or surface an error instead")
+
+
+@register
+class HostNondeterminismInStep(Rule):
+    id = "TPU024"
+    name = "host-nondeterminism-in-captured-step"
+    rationale = ("a nondeterministic host call (time.time(), module-"
+                 "level random.*/np.random.* draws, os.urandom, "
+                 "uuid.uuid4) inside a traced function is either baked "
+                 "in as a compile-time constant (silently frozen at "
+                 "first trace) or re-evaluated per step on the HOST — "
+                 "and in both cases evaluates DIFFERENTLY on each dp "
+                 "replica, so bit-identical replicas diverge without "
+                 "any hardware fault and the SDC consensus fingerprint "
+                 "vote fingers a healthy rank as corrupt; the same "
+                 "hazard hides in host-side step/train loops when such "
+                 "a call feeds a tensor constructor or PRNG key.  "
+                 "Thread randomness in as a seeded, rank-agnostic "
+                 "jax.random key (fold_in(key, step)) or an explicit "
+                 "traced input instead")
+
+    # exact nondeterministic host calls.  perf_counter/monotonic are
+    # deliberately absent: timing reads are legitimate host telemetry
+    # and never belong in tensors anyway — flagging them would bury
+    # the signal
+    _NONDET = {
+        "time.time", "time.time_ns", "os.urandom",
+        "uuid.uuid4", "uuid.uuid1",
+        "datetime.now", "datetime.utcnow",
+        "datetime.datetime.now", "datetime.datetime.utcnow",
+    }
+    # module-level stateful PRNG draws (random.random(), np.random.*):
+    # the global generator's state differs across replicas
+    _NONDET_PREFIXES = ("random.", "np.random.", "numpy.random.")
+    # names under those prefixes that ARE the seeded discipline —
+    # seeding calls and explicit-generator constructors
+    _SEEDED_OK = {"seed", "RandomState", "default_rng", "Generator",
+                  "get_state", "set_state"}
+    # host-side training surfaces: a step/train-named function on the
+    # call stack marks the per-step loop
+    _STEP_FUNC = re.compile(r"(^|_)(step|train)(_|$)")
+    # tensor sinks: a nondet call nested in these args crosses onto
+    # the device and into the replicated state
+    _SINKS = {"to_tensor", "array", "asarray", "full", "constant",
+              "PRNGKey", "key", "fold_in", "seed"}
+
+    def _is_nondet(self, name: str) -> bool:
+        if name in self._NONDET:
+            return True
+        for p in self._NONDET_PREFIXES:
+            if name.startswith(p):
+                return name.rpartition(".")[2] not in self._SEEDED_OK
+        return False
+
+    def on_call(self, node, ctx):
+        if not ctx.library_path:
+            return
+        name = dotted(node.func)
+        if ctx.innermost_traced() is not None:
+            # under a trace ANY nondeterministic host call is a replica-
+            # divergence hazard, tensor-bound or not
+            if self._is_nondet(name):
+                ctx.report(node, self.id,
+                           f"{name}() under jit/grad tracing is frozen "
+                           f"at trace time (or re-runs per step on the "
+                           f"host) with a DIFFERENT value on every dp "
+                           f"replica — replicas diverge bit-for-bit and "
+                           f"the SDC consensus vote fingers a healthy "
+                           f"rank; pass it in as a traced input or "
+                           f"derive it from a seeded key")
+            return
+        # host side: only step/train loops, and only when the nondet
+        # value actually feeds a tensor sink — host-only uses (log
+        # timestamps, run ids) are fine
+        if name.rpartition(".")[2] not in self._SINKS:
+            return
+        if not any(self._STEP_FUNC.search(fi.name)
+                   for fi in ctx.func_stack):
+            return
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            for sub in ast.walk(arg):
+                if (isinstance(sub, ast.Call)
+                        and self._is_nondet(dotted(sub.func))):
+                    src = dotted(sub.func)
+                    ctx.report(node, self.id,
+                               f"{src}() feeding {name}() in a "
+                               f"step/train loop puts a per-replica-"
+                               f"different host value into replicated "
+                               f"tensor state — dp ranks diverge and "
+                               f"the SDC sentry fingers one as corrupt; "
+                               f"use a seeded jax.random key "
+                               f"(fold_in(key, step)) or a shared "
+                               f"traced input")
+                    return

@@ -63,9 +63,11 @@ def test_scrape_merge_skew_drill(tmp_path):
     assert abs(report["cluster_goodput"]["min"] - 0.8) < 1e-6
     assert abs(report["cluster_goodput"]["mean"] - 0.8) < 1e-6
     assert report["healthz"]["cluster_goodput"]["min"] == 0.8
-    # no scripted anomalies -> the anomaly alarm stays down
+    # no scripted anomalies or divergences -> those alarms stay down
     assert report["anomaly_alarm"] in (0.0, None)
     assert report["healthz"]["anomaly_alarm"] is False
+    assert report["sdc_alarm"] in (0.0, None)
+    assert report["healthz"]["sdc_alarm"] is False
 
 
 def test_scrape_drill_anomaly_storm(tmp_path):
@@ -87,6 +89,30 @@ def test_scrape_drill_anomaly_storm(tmp_path):
         assert health["ranks"][r]["numerics_anomalies"] == 3.0
     # goodput is orthogonal to the anomaly burst: still 0.8
     assert abs(report["cluster_goodput"]["mean"] - 0.8) < 1e-6
+
+
+def test_scrape_drill_sdc_alarm_503(tmp_path):
+    """Each rank books 2 scripted SDC consensus verdicts (fingering a
+    fixed peer, halt disarmed); the aggregator sums the per-rank
+    ``pt_sdc_divergence_total`` counters to exactly world * 2, trips
+    ``pt_cluster_sdc_alarm`` at its threshold, and the corruption
+    signal alone flips /healthz to 503 — no recompile storm, no
+    numerics anomalies."""
+    report = run_scrape_drill(
+        str(tmp_path), world=2, steps=6, kill_rank=None, storm=False,
+        sdc_verdicts=2)
+    assert report["sdc_divergences_total"] == 4.0
+    assert report["sdc_alarm"] == 1.0
+    health = report["healthz"]
+    assert health["ok"] is False
+    assert health["sdc_alarm"] is True
+    assert health["sdc_divergences_total"] == 4.0
+    assert health["sdc_threshold"] == 4
+    # orthogonal alarms stay down; per-rank verdicts land in health
+    assert health["storm_alarm"] is False
+    assert health["anomaly_alarm"] is False
+    for r in ("0", "1"):
+        assert health["ranks"][r]["sdc_divergences"] == 2.0
 
 
 def test_scrape_drill_memory_near_oom_503(tmp_path):

@@ -1,0 +1,112 @@
+"""Silent-data-corruption drills: REAL dp-replica workers, a real bit
+flip, consensus attribution, supervisor quarantine, restore refusal.
+
+Each drill spawns ``world`` drill workers in SDC mode (``DRILL_SDC=1``)
+— every rank trains the SAME captured MLP from the SAME seed, so the
+fleet is bit-identical by construction and the only divergence the
+drill can produce is the one it injects: the victim flips ONE mantissa
+bit of its first captured parameter mid-run, a corruption that is
+finite everywhere and invisible to the numerics sentinel.  The
+fingerprint exchange runs over a real TCPStore; the majority vote must
+finger exactly the victim within one cadence window, name a divergent
+tensor, pin a flight dump, and halt the victim into ``EXIT_SDC`` (25).
+The ``@slow`` matrix adds the supervisor quarantine scenario (two
+verdicts -> RankQuarantine -> elastic downsize -> clean relaunch), the
+bit-poisoned-checkpoint restore refusal, and the no-poison control.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from paddle_tpu.distributed.drill import run_sdc_drill
+from paddle_tpu.distributed.drill.worker import EXIT_SDC
+
+pytestmark = pytest.mark.skipif(os.name != "posix",
+                                reason="drills spawn real processes")
+
+
+def test_sdc_drill_consensus_fingers_the_flipped_rank(tmp_path):
+    """Tier-1 acceptance drill: 3 dp replicas x 12 steps, rank 1 flips
+    one parameter bit at step 5, cadence 4 -> consensus fingers rank 1
+    within one cadence window, names a fingerprinted tensor path, pins
+    a flight dump, and the victim exits EXIT_SDC while both clean
+    ranks attribute the verdict to rank 1 and finish 0 with exactly
+    one compile each."""
+    logs = str(tmp_path / "logs")
+    os.makedirs(logs, exist_ok=True)
+    report = run_sdc_drill(str(tmp_path), world=3, steps=12,
+                           poison_step=5, poison_rank=1, cadence=4,
+                           log_dir=logs)
+    assert report["rcs"] == [0, EXIT_SDC, 0]
+    # detection-latency contract: at most one cadence window late
+    assert 5 < report["detected_step"] <= 5 + 4
+    # the vote names a tensor that lives in the fingerprint vector
+    assert report["named_tensor"].startswith(("param::", "opt"))
+    assert report["flight_reason"] == (
+        "sdc:divergence:" + report["named_tensor"])
+    victim = report["ranks"][1]
+    assert victim["halted"] is True
+    assert victim["poisoned_tensor"].startswith("param::")
+    assert victim["last_divergence"]["rank"] == 1
+    # fingerprints fold into the SAME captured program: 1 compile, ever
+    for r in range(3):
+        assert report["ranks"][r]["compiles"] == 1
+    # clean ranks: correct attribution, against the victim and nobody
+    # else, and a clean run to completion
+    for r in (0, 2):
+        clean = report["ranks"][r]
+        assert clean["halted"] is False
+        assert list(clean["divergences"]) == ["1"]
+        assert clean["last_divergence"]["rank"] == 1
+    # the dump itself is a parseable flight-recorder artifact carrying
+    # the fingered rank's identity
+    with open(victim["flight"]) as f:
+        flight = json.load(f)
+    assert flight["process_index"] == 1
+    assert flight["reason"].startswith("sdc:divergence:")
+
+
+@pytest.mark.slow
+def test_sdc_drill_supervisor_quarantines_the_bad_host(tmp_path):
+    """@slow: the same poisoned fleet under a real Supervisor — two
+    consensus verdicts charge the hardware ledger (never the
+    code-crash budget), quarantine rank 1, downsize 3 -> 2, and the
+    downsized generation finishes cleanly."""
+    report = run_sdc_drill(str(tmp_path), scenario="quarantine",
+                           world=3, steps=12, poison_step=5,
+                           poison_rank=1, cadence=4,
+                           quarantine_threshold=2)
+    snap = report["supervision"]
+    assert snap["quarantined_ranks"] == [1]
+    assert snap["sdc_verdicts"] == {"1": 2}
+    assert snap["restarts_by_cause"] == {"sdc": 2}
+    assert snap["world"] == 2
+    assert all(rc == 0 for rc in snap["final_rcs"].values())
+    assert [rz for rz in snap["resizes"] if rz.get("quarantined")]
+
+
+@pytest.mark.slow
+def test_sdc_drill_restore_refuses_poisoned_checkpoint(tmp_path):
+    """@slow: a bit flip sealed UNDER the manifest CRC passes file
+    verification but fails the per-leaf content digest; the resuming
+    worker must exit EXIT_SDC instead of training on corrupt state."""
+    report = run_sdc_drill(str(tmp_path), scenario="restore", steps=4)
+    assert report["resume_rc"] == EXIT_SDC
+    assert "content digest" in report["refusal"]
+    assert "silent corruption" in report["refusal"]
+
+
+@pytest.mark.slow
+def test_sdc_drill_control_run_stays_quiet(tmp_path):
+    """@slow: no injection — bit-identical replicas must produce zero
+    verdicts over the whole run (the false-positive guard for the
+    consensus fingerprints)."""
+    report = run_sdc_drill(str(tmp_path), world=3, steps=12,
+                           poison_rank=-1, cadence=4)
+    assert report["rcs"] == [0, 0, 0]
+    for r in range(3):
+        assert report["ranks"][r]["divergences_total"] == 0
+        assert report["ranks"][r]["votes"] >= 1

@@ -11,14 +11,25 @@ SIGKILL at ANY point of a save without subprocesses or timing games:
     # disk now holds whatever a real crash would have left behind
 
 ``corrupt_file`` / ``truncate_file`` simulate post-commit bit-rot and
-torn writes for the integrity-verification paths.
+torn writes for the integrity-verification paths.  ``flip_bit`` is the
+in-memory counterpart — a deterministic single-bit tensor corruption
+for the SDC consensus drills and content-digest tests — and
+``poison_shard`` plants a bit-flip in a committed shard file while
+re-sealing the COMMIT manifest CRC over the corrupted bytes, modelling
+corruption that happened *before* serialization: only the per-leaf
+content digests can catch it.
 """
 import os
 
 from paddle_tpu.distributed import checkpoint as ckpt
+# canonical fault primitives live in the drill package (the drill
+# worker/runner cannot import tests/); re-exported here so unit tests
+# and drills share ONE definition of each corruption
+from paddle_tpu.distributed.drill.runner import poison_shard  # noqa: F401
+from paddle_tpu.distributed.drill.worker import flip_bit  # noqa: F401
 
 __all__ = ["KilledSave", "FaultInjector", "corrupt_file", "truncate_file",
-           "data_files"]
+           "data_files", "flip_bit", "poison_shard"]
 
 
 class KilledSave(BaseException):
@@ -129,3 +140,5 @@ def data_files(ckpt_dir):
         for fn in files:
             out.append(os.path.relpath(os.path.join(root, fn), ckpt_dir))
     return sorted(out)
+
+

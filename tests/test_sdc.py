@@ -1,0 +1,371 @@
+"""Silent-data-corruption sentry contract tests.
+
+The same observability contract the numerics sentinels honor: inert
+until enabled, the hot path never syncs the device (the fingerprint
+packet inspected at a cadence boundary is the PREVIOUS one), and the
+monitored captured step stays at exactly ONE compile with bit-identical
+losses — the replica fingerprints ride inside the same program.
+
+The consensus half is tested with a fake exchange (no store, no
+subprocesses — the real multi-process proof is
+tests/drills/test_sdc_drills.py): majority vote fingers the minority
+rank, an even split names nobody, the first divergent digest index
+names the first divergent tensor path, and a fingered self raises
+``SdcHaltError`` only with halting armed.  The checkpoint half pins the
+per-leaf content digests: a bit flip sealed UNDER the manifest CRC is
+invisible to ``integrity="size"``/file-CRC verification and refused by
+``integrity="full"`` naming the leaf.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.observability as obs
+from paddle_tpu.observability.sdc import (
+    SdcHaltError, fingerprint_outputs, get_monitor, reset_monitor,
+    store_exchange,
+)
+from tests.fault_injection import flip_bit, poison_shard
+
+
+@pytest.fixture(autouse=True)
+def _isolated(monkeypatch):
+    for var in ("PT_SDC", "PT_SDC_CADENCE", "PT_SDC_HALT",
+                "PT_NUMERICS", "PT_TELEMETRY", "PT_FLIGHT_RECORDER"):
+        monkeypatch.delenv(var, raising=False)
+    obs.reset()
+    yield
+    obs.reset()
+
+
+# -- flip_bit: the canonical fault primitive ---------------------------------
+
+def test_flip_bit_is_a_deterministic_single_bit_involution():
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    b = flip_bit(a, bit=3, index=5)
+    assert b.shape == a.shape and b.dtype == a.dtype
+    # exactly one element changed, and exactly one bit of it
+    changed = np.nonzero(a != b)
+    assert len(changed[0]) == 1
+    xor = a.view(np.uint32) ^ b.view(np.uint32)
+    assert np.count_nonzero(xor) == 1 and int(xor.max()) == 1 << 3
+    # flipping the same bit again restores the original exactly
+    assert flip_bit(b, bit=3, index=5).tobytes() == a.tobytes()
+    # the input is never mutated
+    assert a[1, 1] == 5.0
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64, np.int32,
+                                   np.int8, np.uint16])
+def test_flip_bit_covers_dtypes_and_wraps_indices(dtype):
+    a = np.ones(7, dtype=dtype)
+    b = flip_bit(a, bit=0, index=7)  # wraps to index 0
+    assert (a != b).sum() == 1 and a[0] != b[0]
+
+
+# -- fingerprint_outputs: the in-graph half ----------------------------------
+
+def test_fingerprint_changes_on_any_single_bit_flip():
+    import jax
+
+    a = np.arange(32, dtype=np.float32).reshape(4, 8)
+    base = np.asarray(jax.jit(lambda x: fingerprint_outputs(
+        {"w": x})[1])(a))
+    for bit in (0, 13, 31):
+        for index in (0, 17, 31):
+            poisoned = np.asarray(jax.jit(lambda x: fingerprint_outputs(
+                {"w": x})[1])(flip_bit(a, bit=bit, index=index)))
+            assert poisoned.tobytes() != base.tobytes(), \
+                f"bit {bit} at index {index} left the digest unchanged"
+
+
+def test_fingerprint_distinguishes_bit_patterns_not_values():
+    # -0.0 == +0.0 by value; a bit-pattern digest must tell them apart
+    names, fp0 = fingerprint_outputs({"w": np.zeros(4, np.float32)})
+    _, fp1 = fingerprint_outputs(
+        {"w": np.array([0.0, -0.0, 0.0, 0.0], np.float32)})
+    assert names == ("w",)
+    assert np.asarray(fp0).tobytes() != np.asarray(fp1).tobytes()
+
+
+def test_fingerprint_names_are_sorted_and_dtypes_covered():
+    named = {
+        "b::bool": np.array([True, False]),
+        "a::f64": np.arange(3, dtype=np.float64),
+        "c::i8": np.arange(4, dtype=np.int8),
+        "d::f16": np.arange(5, dtype=np.float16),
+    }
+    names, fp = fingerprint_outputs(named)
+    assert names == tuple(sorted(named))
+    vec = np.asarray(fp)
+    assert vec.shape == (4,) and vec.dtype == np.int32
+    # each slot is sensitive to its own tensor's bits
+    named["c::i8"] = flip_bit(named["c::i8"], bit=1, index=2)
+    vec2 = np.asarray(fingerprint_outputs(named)[1])
+    assert vec2[2] != vec[2]
+    assert (np.delete(vec2, 2) == np.delete(vec, 2)).all()
+
+
+# -- consensus vote over a fake exchange -------------------------------------
+
+def _fake_exchange(peer_digests):
+    """exchange(step, digest) that returns a scripted peer map."""
+    def exchange(step, digest):
+        return dict(peer_digests)
+    return exchange
+
+
+def _vec(*words):
+    return np.asarray(words, dtype=np.int32).tobytes()
+
+
+def test_majority_fingers_the_minority_and_names_the_tensor():
+    reset_monitor()
+    mon = get_monitor().enable(cadence=1, halt=False, rank=0)
+    good, bad = _vec(1, 2, 3), _vec(1, 99, 3)
+    mon.exchange = _fake_exchange({0: good, 1: bad, 2: good})
+    mon.watch(0, ("pa", "pb", "pc"), np.frombuffer(good, np.int32))
+    mon.flush()
+    snap = mon.snapshot()
+    assert snap["votes"] >= 1
+    assert snap["divergences"] == {"1": 1}
+    last = snap["last_divergence"]
+    # index 1 is the first divergent digest slot -> second tensor name
+    assert last["rank"] == 1 and last["tensor"] == "pb"
+    assert last["world"] == 3
+    reset_monitor()
+
+
+def test_even_split_names_nobody():
+    reset_monitor()
+    mon = get_monitor().enable(cadence=1, halt=False, rank=0)
+    a, b = _vec(1), _vec(2)
+    mon.exchange = _fake_exchange({0: a, 1: b})
+    mon.watch(0, ("p",), np.frombuffer(a, np.int32))
+    mon.flush()
+    snap = mon.snapshot()
+    assert snap["votes"] >= 1
+    assert snap["divergences_total"] == 0  # refuse to guess at 1 vs 1
+    reset_monitor()
+
+
+def test_fingered_self_halts_only_when_armed():
+    reset_monitor()
+    mon = get_monitor().enable(cadence=1, halt=False, rank=1)
+    good, bad = _vec(7), _vec(8)
+    mon.exchange = _fake_exchange({0: good, 1: bad, 2: good})
+    mon.watch(0, ("p",), np.frombuffer(bad, np.int32))
+    mon.flush()  # halt disarmed: books the verdict, keeps going
+    assert mon.divergence_count(1) == 1
+    mon.enable(halt=True)
+    mon.watch(1, ("p",), np.frombuffer(bad, np.int32))
+    with pytest.raises(SdcHaltError) as ei:
+        mon.flush()
+    assert "process_index 1" in str(ei.value)
+    assert mon.divergence_count(1) == 2
+    reset_monitor()
+
+
+def test_watch_inspects_previous_packet_at_cadence():
+    reset_monitor()
+    seen = []
+
+    def exchange(step, digest):
+        seen.append(step)
+        return {0: digest}  # no quorum: the vote is a no-op
+
+    mon = get_monitor().enable(cadence=4, halt=False, rank=0)
+    mon.exchange = exchange
+    fp = np.asarray([5], np.int32)
+    for s in range(10):
+        mon.watch(s, ("p",), fp)
+    # reads happen one dispatch behind, every 4th observed step
+    assert seen == [0, 4, 8]
+    mon.flush()
+    assert seen == [0, 4, 8, 9]
+    snap = mon.snapshot()
+    assert snap["steps_observed"] == 10 and snap["reads"] == 4
+    assert snap["last_fingerprint"] is not None
+    reset_monitor()
+
+
+def test_disabled_monitor_is_inert_and_exchange_failure_is_nonfatal():
+    reset_monitor()
+    mon = get_monitor()
+    mon.watch(0, ("p",), np.asarray([1], np.int32))
+    assert mon.snapshot()["steps_observed"] == 0
+
+    def broken(step, digest):
+        raise ConnectionError("store hiccup")
+
+    mon.enable(cadence=1, halt=True, rank=0)
+    mon.exchange = broken
+    mon.watch(0, ("p",), np.asarray([1], np.int32))
+    mon.flush()  # the exchange failure downgrades to a warning
+    assert mon.divergence_count() == 0
+    reset_monitor()
+
+
+def test_env_enablement(monkeypatch):
+    monkeypatch.setenv("PT_SDC", "1")
+    monkeypatch.setenv("PT_SDC_CADENCE", "7")
+    monkeypatch.setenv("PT_SDC_HALT", "0")
+    reset_monitor()
+    mon = get_monitor()
+    assert mon.enabled and mon.cadence == 7 and mon.halt is False
+    reset_monitor()
+
+
+# -- store_exchange over the real TCPStore -----------------------------------
+
+def test_store_exchange_all_gathers_digests():
+    from paddle_tpu.core import TCPStore
+
+    master = TCPStore("127.0.0.1", 0, is_master=True)
+    try:
+        ex0 = store_exchange(master, "run", 0, 2, timeout=10.0)
+        ex1 = store_exchange(master, "run", 1, 2, timeout=10.0)
+        d0, d1 = _vec(1, 2), _vec(1, 3)
+        # publish rank 1 first so rank 0's bounded wait finds it
+        import threading
+        out1 = {}
+        t = threading.Thread(
+            target=lambda: out1.update(ex1(5, d1)))
+        t.start()
+        out0 = ex0(5, d0)
+        t.join(timeout=30)
+        assert out0 == {0: d0, 1: d1}
+        assert out1 == {0: d0, 1: d1}
+    finally:
+        master.close()
+
+
+# -- the captured-step contract: 1 compile, bit-identical loss ---------------
+
+def _mlp(seed):
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+
+    np.random.seed(seed)
+    pt.seed(seed)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    opt = pt.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                parameters=model.parameters())
+    return model, opt
+
+
+def _run_10(fingerprinted, cadence=3):
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+
+    reset_monitor()
+    if fingerprinted:
+        get_monitor().enable(cadence=cadence, halt=False)
+    model, opt = _mlp(seed=7)
+    mse = nn.MSELoss()
+
+    @pt.jit.capture_step
+    def step(x, y):
+        loss = mse(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    rng = np.random.RandomState(3)
+    x = pt.to_tensor(rng.randn(4, 8).astype(np.float32))
+    y = pt.to_tensor(rng.randn(4, 1).astype(np.float32))
+    losses = [np.asarray(step(x, y)._data).tobytes() for _ in range(10)]
+    return losses, step.stats
+
+
+def test_fingerprinted_capture_bitwise_identical_one_compile():
+    base, _ = _run_10(fingerprinted=False)
+    fp_losses, stats = _run_10(fingerprinted=True)
+    # the fingerprints ride inside the same program: one compile ever
+    assert stats["compiles"] == 1 and stats["hits"] == 9
+    assert not stats["fallback"]
+    # and never perturb the math: losses are bit-identical
+    assert fp_losses == base
+    mon = get_monitor()
+    snap = mon.snapshot()
+    assert snap["reads"] >= 2
+    assert snap["divergences_total"] == 0  # standalone mode: no vote
+    assert snap["last_fingerprint"] is not None
+    reset_monitor()
+
+
+def test_fingerprint_slots_cover_params_and_optimizer_state():
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+
+    reset_monitor()
+    get_monitor().enable(cadence=1, halt=False)
+    model, opt = _mlp(seed=2)
+    mse = nn.MSELoss()
+
+    @pt.jit.capture_step
+    def step(x, y):
+        loss = mse(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    rng = np.random.RandomState(6)
+    x = pt.to_tensor(rng.randn(4, 8).astype(np.float32))
+    y = pt.to_tensor(rng.randn(4, 1).astype(np.float32))
+    step(x, y)
+    entry = next(iter(step._cache.values()))
+    names = entry.sdc_names[0]
+    assert any(n.startswith("param::") for n in names)
+    assert any(n.startswith("opt0::") for n in names)
+    assert list(names) == sorted(names)
+    reset_monitor()
+
+
+# -- checkpoint content digests ----------------------------------------------
+
+def _save_one(tmp_path):
+    from paddle_tpu.distributed.checkpoint import save_sharded
+
+    path = str(tmp_path / "step_00000003")
+    state = {"w": np.arange(24, dtype=np.float32).reshape(4, 6),
+             "bias": np.ones(4, dtype=np.float32)}
+    save_sharded(state, path, process_index=0, world_size=1)
+    return path, state
+
+
+def test_content_digest_round_trip_and_verify_full(tmp_path):
+    from paddle_tpu.distributed.checkpoint import (read_leaf,
+                                                   verify_checkpoint)
+
+    path, state = _save_one(tmp_path)
+    verify_checkpoint(path, integrity="full")
+    got = read_leaf(path, "w", integrity="full")
+    assert got.tobytes() == state["w"].tobytes()
+
+
+def test_poisoned_shard_passes_size_and_crc_but_fails_full(tmp_path):
+    from paddle_tpu.distributed.checkpoint import (
+        CheckpointCorruptError, read_leaf, verify_checkpoint)
+
+    path, state = _save_one(tmp_path)
+    rel = poison_shard(path, bit=2)
+    leaf = rel.split(os.sep)[1]
+    # the flip is sealed UNDER the manifest CRC: file-level checks pass
+    verify_checkpoint(path, integrity="size")
+    np.testing.assert_array_equal(
+        read_leaf(path, leaf, integrity="size").shape,
+        state[leaf].shape)
+    # only the per-leaf content digest refuses, naming the leaf
+    with pytest.raises(CheckpointCorruptError) as ei:
+        verify_checkpoint(path, integrity="full")
+    msg = str(ei.value)
+    assert "content digest" in msg and f"'{leaf}'" in msg
+    assert "silent corruption" in msg
+    with pytest.raises(CheckpointCorruptError):
+        read_leaf(path, leaf, integrity="full")

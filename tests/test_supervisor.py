@@ -18,7 +18,7 @@ import pytest
 from paddle_tpu.distributed import exit_codes
 from paddle_tpu.distributed import supervisor as sup_mod
 from paddle_tpu.distributed.exit_codes import (EXIT_DRAIN, EXIT_SAVE_FAILED,
-                                               EXIT_STORE_LOST,
+                                               EXIT_SDC, EXIT_STORE_LOST,
                                                EXIT_TEMPFAIL, EXIT_WATCHDOG)
 from paddle_tpu.distributed.supervisor import (RestartBudgetExhausted,
                                                SpawnFailed, Supervisor,
@@ -55,28 +55,35 @@ def _fast(spawn, world, **kw):
 # -- exit-code taxonomy (satellite: one canonical module) --------------------
 
 def test_exit_code_taxonomy_is_canonical():
-    assert (EXIT_SAVE_FAILED, EXIT_STORE_LOST, EXIT_WATCHDOG,
-            EXIT_TEMPFAIL, EXIT_DRAIN) == (17, 19, 70, 75, 143)
+    assert (EXIT_SAVE_FAILED, EXIT_STORE_LOST, EXIT_SDC, EXIT_WATCHDOG,
+            EXIT_TEMPFAIL, EXIT_DRAIN) == (17, 19, 25, 70, 75, 143)
     assert exit_codes.classify(0) == "ok"
     assert exit_codes.classify(EXIT_DRAIN) == "drain"
     assert exit_codes.classify(EXIT_TEMPFAIL) == "tempfail"
     assert exit_codes.classify(EXIT_WATCHDOG) == "watchdog"
     assert exit_codes.classify(EXIT_STORE_LOST) == "store_lost"
+    assert exit_codes.classify(EXIT_SDC) == "sdc"
     assert exit_codes.classify(-9) == "killed"
     assert exit_codes.classify(1) == "crash"
     assert "store" in exit_codes.describe(EXIT_STORE_LOST)
+    # the SDC verdict blames the machine, not the program — the
+    # description must steer the operator at the hardware
+    assert "hardware" in exit_codes.describe(EXIT_SDC)
+    assert "sdc" in exit_codes.RESTARTABLE_CAUSES
 
 
 def test_exit_codes_have_one_home():
     # the magic numbers must come from distributed/exit_codes.py, not be
     # re-declared: every other in-package definition is an import/re-export
-    out = subprocess.run(
-        ["grep", "-rn", r"EXIT_STORE_LOST\s*=\s*[0-9]", "paddle_tpu/"],
-        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        capture_output=True, text=True).stdout
-    homes = [ln for ln in out.splitlines() if ln.strip()]
-    assert homes and all("distributed/exit_codes.py" in ln for ln in homes), \
-        f"EXIT_STORE_LOST literal re-declared outside exit_codes.py: {homes}"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for name in ("EXIT_STORE_LOST", "EXIT_SDC"):
+        out = subprocess.run(
+            ["grep", "-rn", rf"{name}\s*=\s*[0-9]", "paddle_tpu/"],
+            cwd=repo, capture_output=True, text=True).stdout
+        homes = [ln for ln in out.splitlines() if ln.strip()]
+        assert homes and all("distributed/exit_codes.py" in ln
+                             for ln in homes), \
+            f"{name} literal re-declared outside exit_codes.py: {homes}"
 
 
 # -- clean + single-restart paths --------------------------------------------
@@ -183,6 +190,49 @@ def test_uncorrelated_failures_do_not_quarantine():
     assert snap["restarts_total"] == 4
 
 
+# -- SDC hardware ledger / rank quarantine -----------------------------------
+
+def test_sdc_verdicts_quarantine_without_touching_crash_budget():
+    # rank 1 is fingered by replica consensus twice; with the code-crash
+    # budget at ZERO the run must still reach quarantine + downsize —
+    # proof the hardware ledger never shares a key with crash charges
+    plan = {0: {1: EXIT_SDC}, 1: {1: EXIT_SDC}}
+    sup = _fast(_scripted(plan), 2, max_restarts=0, min_world=1,
+                sdc_quarantine_threshold=2)
+    snap = sup.run()
+    assert snap["quarantined_ranks"] == [1]
+    assert snap["sdc_verdicts"] == {"1": 2}
+    assert snap["restarts_by_cause"] == {"sdc": 2}
+    assert snap["world"] == 1
+    assert snap["final_rcs"] == {0: 0}
+    resize = [rz for rz in snap["resizes"] if rz.get("quarantined")]
+    assert resize and resize[0]["dead_ranks"] == [1]
+    # the crash ledger never saw rank 1 — only the sdc:<rank> key did
+    assert 1 not in sup._failures
+    assert "sdc:1" in sup._failures
+
+
+def test_sdc_restart_budget_exhausts_naming_the_hardware():
+    plan = {g: {0: EXIT_SDC} for g in range(10)}
+    sup = _fast(_scripted(plan), 1, sdc_max_restarts=1,
+                sdc_quarantine_threshold=99)
+    with pytest.raises(RestartBudgetExhausted) as ei:
+        sup.run()
+    assert ei.value.rank == 0
+    assert ei.value.cause == "sdc"
+    assert "hardware" in str(ei.value)
+
+
+def test_sdc_quarantine_below_min_world_fails_loudly():
+    plan = {g: {1: EXIT_SDC} for g in range(10)}
+    sup = _fast(_scripted(plan), 2, min_world=2,
+                sdc_quarantine_threshold=1)
+    with pytest.raises(RestartBudgetExhausted) as ei:
+        sup.run()
+    assert ei.value.cause == "sdc"
+    assert "min_world=2" in str(ei.value)
+
+
 # -- lease expiry / elastic downsizing ---------------------------------------
 
 def test_dead_rank_past_lease_downsizes_the_world():
@@ -223,7 +273,8 @@ def test_supervision_snapshot_defaults_to_zero_block(monkeypatch):
     snap = supervision_snapshot()
     assert snap == {"world": 0, "generations": 0, "restarts_total": 0,
                     "restarts_by_cause": {}, "promotions": 0,
-                    "quarantined_shards": [], "resizes": [],
+                    "quarantined_shards": [], "quarantined_ranks": [],
+                    "sdc_verdicts": {}, "resizes": [],
                     "restart_replay_seconds": 0.0}
 
 

@@ -393,6 +393,26 @@ FIXTURES = {
             return cb
         """,
     ),
+    "TPU024": (
+        "paddle_tpu/core/mod.py",
+        """
+        import time
+        import jax
+        @jax.jit
+        def step(params, x):
+            noise = time.time()
+            return params * x + noise
+        """,
+        """
+        import jax
+        import jax.random as jrandom
+        @jax.jit
+        def step(params, x, key, step_idx):
+            k = jrandom.fold_in(key, step_idx)
+            noise = jrandom.normal(k, x.shape)
+            return params * x + noise
+        """,
+    ),
     "TPU014": (
         "paddle_tpu/distributed/mod.py",
         """
@@ -1341,6 +1361,109 @@ def test_tpu023_package_has_zero_baseline_entries():
     violations, errors = run_paths(GATE_PATHS)
     assert errors == {}
     assert [v for v in violations if v.rule == "TPU023"] == []
+
+
+def test_tpu024_host_step_loop_flags_only_tensor_bound_nondeterminism():
+    # host-side train loop: time.time() into a log line is fine;
+    # time.time() into a tensor constructor / PRNG seed is a replica-
+    # divergence hazard the SDC sentry would later finger as corruption
+    src = """
+    import time
+    import jax.numpy as jnp
+    def train_step(params, x, log):
+        log.info("step at %s", time.time())
+        noise = jnp.full(x.shape, time.time())
+        return params + noise
+    """
+    assert "TPU024" in rules_fired(src, path="paddle_tpu/core/mod.py")
+    src2 = """
+    import time
+    def train_step(params, x, log):
+        log.info("step at %s", time.time())
+        return params + x
+    """
+    assert "TPU024" not in rules_fired(src2, path="paddle_tpu/core/mod.py")
+
+
+def test_tpu024_unseeded_prngkey_in_train_loop_fires():
+    src = """
+    import time
+    import jax.random as jrandom
+    def train(params, xs):
+        for i, x in enumerate(xs):
+            key = jrandom.PRNGKey(time.time_ns())
+            params = params + jrandom.normal(key, x.shape)
+        return params
+    """
+    assert "TPU024" in rules_fired(src, path="paddle_tpu/core/mod.py")
+    # a constant-seeded key folded per step is the sanctioned idiom
+    src2 = """
+    import jax.random as jrandom
+    def train(params, xs, seed):
+        key = jrandom.PRNGKey(seed)
+        for i, x in enumerate(xs):
+            k = jrandom.fold_in(key, i)
+            params = params + jrandom.normal(k, x.shape)
+        return params
+    """
+    assert "TPU024" not in rules_fired(src2, path="paddle_tpu/core/mod.py")
+
+
+def test_tpu024_module_prng_draws_in_trace_fire_seeded_apis_do_not():
+    src = """
+    import jax
+    import numpy as np
+    @jax.jit
+    def step(x):
+        return x + np.random.rand()
+    """
+    assert "TPU024" in rules_fired(src, path="paddle_tpu/core/mod.py")
+    # explicit-generator construction and seeding are the discipline,
+    # not the hazard — and perf_counter is host telemetry, never flagged
+    src2 = """
+    import time
+    import numpy as np
+    def train_step(rng, x):
+        gen = np.random.default_rng(1234)
+        np.random.seed(0)
+        t0 = time.perf_counter()
+        return x + gen.standard_normal(x.shape), t0
+    """
+    assert "TPU024" not in rules_fired(src2, path="paddle_tpu/core/mod.py")
+
+
+def test_tpu024_outside_step_functions_and_library_stays_silent():
+    # nondeterminism feeding tensors OUTSIDE step/train loops (dataset
+    # shuffling setup, run-id minting) is not this rule's business,
+    # and non-library paths (tests, bench) are exempt wholesale
+    src = """
+    import time
+    import jax.numpy as jnp
+    def make_run_banner(x):
+        return jnp.full((1,), time.time())
+    """
+    assert "TPU024" not in rules_fired(src, path="paddle_tpu/core/mod.py")
+    src2 = """
+    import time
+    import jax
+    @jax.jit
+    def step(x):
+        return x + time.time()
+    """
+    for path in ("tests/test_x.py", "bench.py",
+                 "paddle_tpu/tools/lint/rules.py"):
+        assert "TPU024" not in rules_fired(src2, path=path), path
+
+
+def test_tpu024_package_has_zero_baseline_entries():
+    # satellite contract: zero baseline entries for TPU024, ever — the
+    # captured step is deterministic by construction (the SDC consensus
+    # fingerprints depend on it)
+    bl = load_baseline(default_baseline_path())
+    assert not [k for k in bl if "::TPU024::" in k]
+    violations, errors = run_paths(GATE_PATHS)
+    assert errors == {}
+    assert [v for v in violations if v.rule == "TPU024"] == []
 
 
 # -- suppressions ------------------------------------------------------------
